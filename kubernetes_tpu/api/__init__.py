@@ -1,0 +1,3 @@
+from . import types, wrappers  # noqa: F401
+from .types import Node, Pod  # noqa: F401
+from .wrappers import make_node, make_pod  # noqa: F401

@@ -1,0 +1,154 @@
+"""Host-side authoritative cluster state with the assume/forget protocol.
+
+Mirrors the responsibilities of the reference's scheduler cache
+(pkg/scheduler/backend/cache/cache.go): it is the source of truth the device
+snapshot is built from, and it implements optimistic binding — `assume_pod`
+records a pod on its chosen node immediately so the next scheduling batch sees
+it, `finish_binding`/`forget_pod` resolve the optimism when the (async) bind
+succeeds or fails (cache.go:361 AssumePod, :376 FinishBinding, :404 ForgetPod).
+
+Unlike the reference there is no per-cycle snapshot copy: the device mirror in
+SnapshotBuilder *is* the snapshot, updated incrementally row-by-row (the
+analog of UpdateSnapshot's generation diff, cache.go:186)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .api import types as t
+from .snapshot import SnapshotBuilder
+
+
+@dataclass
+class NodeRecord:
+    node: t.Node
+    row: int
+    pods: dict[str, t.Pod] = field(default_factory=dict)  # uid → pod
+    generation: int = 0
+
+
+@dataclass
+class PodRecord:
+    pod: t.Pod
+    node_name: str
+    delta: dict  # the precomputed row-delta vectors applied to the node row
+    assumed: bool = False
+    bound: bool = False
+    assumed_at: float = 0.0
+
+
+class Cache:
+    def __init__(self, builder: SnapshotBuilder):
+        self.builder = builder
+        self.nodes: dict[str, NodeRecord] = {}
+        self.pods: dict[str, PodRecord] = {}
+        self._free_rows: list[int] = []
+        self._next_row = 0
+        self._generation = 0
+        self._row_to_name: dict[int, str] = {}
+
+    # -- nodes ---------------------------------------------------------------
+
+    def node_count(self) -> int:
+        return len(self.nodes)
+
+    def row_of(self, node_name: str) -> int:
+        return self.nodes[node_name].row
+
+    def node_name_at_row(self, row: int) -> str | None:
+        return self._row_to_name.get(row)
+
+    def add_node(self, node: t.Node) -> None:
+        if node.name in self.nodes:
+            self.update_node(node)
+            return
+        row = self._free_rows.pop() if self._free_rows else self._next_row
+        if row == self._next_row:
+            self._next_row += 1
+        self._generation += 1
+        self.nodes[node.name] = NodeRecord(node=node, row=row, generation=self._generation)
+        self.builder.set_node_row(row, node)
+        self._row_to_name[row] = node.name
+
+    def update_node(self, node: t.Node) -> None:
+        rec = self.nodes[node.name]
+        rec.node = node
+        self._generation += 1
+        rec.generation = self._generation
+        # set_node_row rewrites only the node's static attributes; pod-derived
+        # state (req/num_pods/counts) lives in separate arrays and is untouched.
+        self.builder.set_node_row(rec.row, node)
+
+    def remove_node(self, name: str) -> None:
+        rec = self.nodes.pop(name)
+        self.builder.clear_node_row(rec.row)
+        self._free_rows.append(rec.row)
+        self._row_to_name.pop(rec.row, None)
+        for uid in list(rec.pods):
+            pr = self.pods.pop(uid, None)
+            del pr  # pods on a removed node vanish from scheduling state
+
+    # -- pods ----------------------------------------------------------------
+
+    def add_pod(self, pod: t.Pod, node_name: str | None = None, device_already: bool = False) -> None:
+        """Record an assigned pod (from the informer path or a fresh bind)."""
+        node_name = node_name or pod.spec.node_name
+        rec = self.nodes[node_name]
+        delta = self.builder.pod_delta_vectors(pod)
+        pr = PodRecord(pod=pod, node_name=node_name, delta=delta, bound=True)
+        self.pods[pod.uid] = pr
+        rec.pods[pod.uid] = pod
+        self.builder.apply_pod_delta(rec.row, delta, +1, device_already=device_already)
+
+    def assume_pod(
+        self,
+        pod: t.Pod,
+        node_name: str,
+        device_already: bool = True,
+        delta: dict | None = None,
+    ) -> None:
+        """Optimistically place a pod (cache.go:361). device_already=True when
+        the engine's scan already committed the delta on device; `delta` skips
+        re-featurizing when the batch featurizer already computed it."""
+        rec = self.nodes[node_name]
+        if delta is None:
+            delta = self.builder.pod_delta_vectors(pod)
+        pr = PodRecord(
+            pod=pod, node_name=node_name, delta=delta, assumed=True, assumed_at=time.monotonic()
+        )
+        self.pods[pod.uid] = pr
+        rec.pods[pod.uid] = pod
+        self.builder.apply_pod_delta(rec.row, delta, +1, device_already=device_already)
+
+    def finish_binding(self, uid: str) -> None:
+        pr = self.pods[uid]
+        pr.assumed, pr.bound = False, True
+
+    def forget_pod(self, uid: str) -> None:
+        """Undo an assume after a failed bind (cache.go:404)."""
+        pr = self.pods.pop(uid)
+        rec = self.nodes[pr.node_name]
+        rec.pods.pop(uid, None)
+        self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
+
+    def remove_pod(self, uid: str) -> None:
+        pr = self.pods.pop(uid, None)
+        if pr is None:
+            return
+        rec = self.nodes.get(pr.node_name)
+        if rec is not None:
+            rec.pods.pop(uid, None)
+            self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
+
+    def cleanup_assumed(self, ttl_s: float = 30.0) -> list[str]:
+        """Expire assumed-but-never-bound pods (cache.go:730 cleanupAssumedPods)."""
+        now = time.monotonic()
+        expired = [
+            uid
+            for uid, pr in self.pods.items()
+            if pr.assumed and not pr.bound and now - pr.assumed_at > ttl_s
+        ]
+        for uid in expired:
+            self.forget_pod(uid)
+        return expired

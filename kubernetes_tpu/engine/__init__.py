@@ -1,0 +1,2 @@
+from .features import build_pod_batch  # noqa: F401
+from .pass_ import PassCache, PassResult, build_pass, select_host  # noqa: F401

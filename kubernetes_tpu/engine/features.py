@@ -1,0 +1,83 @@
+"""Pod-batch featurization: list[Pod] → padded device feature tensors.
+
+The host-side analog of the reference's PreFilter extension point
+(runtime/framework.go:698): everything about a pod that the device pass needs
+is computed once per pod here (resource vectors, interned ids, compiled
+selector programs) and shipped as one (K, …) batch.  Padding rows carry
+valid=False and are ignored by the engine's commit."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import types as t
+from ..framework.config import Profile
+from ..ops import common as opcommon
+from ..snapshot import SnapshotBuilder
+
+# Host-port slots per pod in the batch features (pods with more host ports
+# than this are rejected at featurization — the reference has no limit, but
+# >8 distinct host ports on one pod is pathological).
+POD_PORT_SLOTS = 8
+
+
+def build_pod_batch(
+    pods: list[t.Pod], builder: SnapshotBuilder, profile: Profile, k: int
+) -> tuple[dict, list[dict]]:
+    """Featurize up to ``k`` pods into a dict of (k, …) numpy arrays, plus the
+    per-pod commit deltas (reused by the cache's assume step so pods are
+    featurized exactly once).
+
+    Featurization may grow vocabularies/schema (new scalar resources, label
+    pairs, topology keys), which is why it must run before the device state is
+    flushed for the pass."""
+    assert len(pods) <= k
+    fctx = opcommon.FeaturizeContext(builder=builder)
+    ops = [opcommon.get(name) for name in dict.fromkeys(
+        list(profile.filters) + [s for s, _ in profile.scorers]
+    )]
+    per_pod: list[dict] = []
+    deltas: list[dict] = []
+    for pod in pods:
+        delta = builder.pod_delta_vectors(pod)
+        deltas.append(delta)
+        # Host ports are base commit features: the scan's _commit and the host
+        # apply_pod_delta must apply the *same* delta or the mirrors desync.
+        port_triples = np.full(POD_PORT_SLOTS, -1, np.int32)
+        port_keys = np.full(POD_PORT_SLOTS, -1, np.int32)
+        for j, (triple, pk, _wild) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
+            port_triples[j] = triple
+            port_keys[j] = pk
+        feats = {
+            "req": delta["req"],
+            "nonzero": delta["nonzero"],
+            "group": np.int32(delta["group"]),
+            "priority": np.int32(pod.spec.priority),
+            "port_triples": port_triples,
+            "port_keys": port_keys,
+        }
+        for op in ops:
+            if op.featurize is not None:
+                feats.update(op.featurize(pod, fctx))
+        per_pod.append(feats)
+
+    if not per_pod:
+        raise ValueError("empty pod batch")
+
+    # Stack + pad. Schema growth during featurization means early pods may
+    # have shorter resource vectors than late ones — re-pad to current schema.
+    r = builder.schema.R
+    for feats in per_pod:
+        if feats["req"].shape[0] != r:
+            feats["req"] = np.pad(feats["req"], (0, r - feats["req"].shape[0]))
+
+    keys = per_pod[-1].keys()
+    batch: dict = {}
+    for key in keys:
+        rows = [f[key] for f in per_pod]
+        stacked = np.stack(rows)
+        pad_width = [(0, k - len(pods))] + [(0, 0)] * (stacked.ndim - 1)
+        batch[key] = np.pad(stacked, pad_width)
+    batch["valid"] = np.zeros(k, np.bool_)
+    batch["valid"][: len(pods)] = True
+    return batch, deltas

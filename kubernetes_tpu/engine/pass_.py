@@ -1,0 +1,156 @@
+"""The batched scheduling pass: one device dispatch schedules a whole batch.
+
+This replaces both reference hot loops — the goroutine-parallel Filter over
+nodes (schedule_one.go:591 findNodesThatPassFilters) and the 3-pass parallel
+Score (runtime/framework.go:1101) — with vectorized ops over the node axis,
+and replaces the serialized one-pod-at-a-time outer loop (scheduler.go:470)
+with a `lax.scan` over the pod batch.  Each scan step is sequential-equivalent
+to one reference scheduling cycle: filter → score → selectHost → assume, with
+the assume's row-delta applied to the carried ClusterState so the next pod in
+the batch observes it (the reference gets the same effect through its cache
+assume protocol, cache.go:361).
+
+Why scan and not vmap: pod placements are not independent — pod i+1 must see
+pod i's resources committed.  The scan keeps the dependency chain on device,
+which is what makes batch size ≈ free (no host↔device round trip per pod).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.config import Profile
+from ..ops import common as opcommon
+from ..snapshot import ClusterState, Schema
+
+
+class PassResult(NamedTuple):
+    picks: jax.Array  # (K,) i32 — chosen node row, -1 = unschedulable
+    scores: jax.Array  # (K,) i64 — winning node's total score
+    feasible_counts: jax.Array  # (K,) i32 — nodes passing all filters
+
+
+def _hash_u32(x: jax.Array) -> jax.Array:
+    """splitmix32-style avalanche; deterministic counter-based tie-break RNG.
+
+    The reference breaks score ties with reservoir sampling over math/rand
+    (schedule_one.go:888–899).  For cross-run determinism (and Go↔device
+    parity) we instead pick the h(seed, step)-th tie in snapshot row order —
+    still uniform over ties, but a pure function of (seed, step)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def select_host(feasible: jax.Array, total: jax.Array, tie_rand: jax.Array):
+    """argmax with uniform tie-break among max-score feasible nodes.
+
+    Mirrors selectHost (schedule_one.go:873): highest TotalScore wins;
+    ties broken uniformly (see _hash_u32 docstring for the parity rule)."""
+    neg = jnp.int64(-(2**62))
+    masked = jnp.where(feasible, total, neg)
+    best = jnp.max(masked)
+    ties = feasible & (masked == best)
+    m = jnp.sum(ties.astype(jnp.int32))
+    kth = (tie_rand % jnp.maximum(m, 1).astype(jnp.uint32)).astype(jnp.int32)
+    # Index of the (kth+1)-th True in `ties`.
+    order = jnp.cumsum(ties.astype(jnp.int32)) - 1
+    pick = jnp.argmax(ties & (order == kth)).astype(jnp.int32)
+    pick = jnp.where(m > 0, pick, -1)
+    return pick, best, m
+
+
+def _commit(state: ClusterState, pf: dict, pick: jax.Array, do: jax.Array) -> ClusterState:
+    """Apply the chosen pod's row-delta on device (NodeInfo.AddPodInfo,
+    framework/types.go:990). All updates are predicated on `do` so padded or
+    unschedulable pods commit nothing."""
+    row = jnp.where(do, pick, 0)
+    zero64 = jnp.int64(0)
+    new = dict(
+        req=state.req.at[row].add(jnp.where(do, pf["req"], zero64)),
+        nonzero_req=state.nonzero_req.at[row].add(jnp.where(do, pf["nonzero"], zero64)),
+        num_pods=state.num_pods.at[row].add(do.astype(jnp.int32)),
+        group_counts=state.group_counts.at[pf["group"], row].add(do.astype(jnp.int32)),
+    )
+    if "port_triples" in pf:
+        inc = (do & (pf["port_triples"] >= 0)).astype(jnp.int32)
+        safe_t = jnp.maximum(pf["port_triples"], 0)
+        safe_k = jnp.maximum(pf["port_keys"], 0)
+        new["port_counts"] = state.port_counts.at[safe_t, row].add(inc)
+        new["portkey_counts"] = state.portkey_counts.at[safe_k, row].add(inc)
+    if "anti_term_ids" in pf:
+        inc = (do & (pf["anti_term_ids"] >= 0)).astype(jnp.int32)
+        safe_a = jnp.maximum(pf["anti_term_ids"], 0)
+        new["at_counts"] = state.at_counts.at[safe_a, row].add(inc)
+    return dataclasses.replace(state, **new)
+
+
+def build_pass(profile: Profile, schema: Schema, builder_res_col: dict[str, int]):
+    """Compile the batch pass for one (profile, schema) pair.
+
+    Returns run(state, batch, seed_base) → (state, PassResult). Recompiles
+    only when the profile or a bucketed schema capacity changes — the analog
+    of building a frameworkImpl per profile (profile/profile.go:50), plus
+    XLA compilation."""
+    filter_ops = [opcommon.get(n) for n in profile.filters]
+    score_ops = [(opcommon.get(n), w) for n, w in profile.scorers]
+    static: dict = {}
+    for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
+        if op.static is not None:
+            static.update(op.static(profile, schema, builder_res_col))
+    ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
+
+    def step(state: ClusterState, xs):
+        pf, step_idx = xs
+        feasible = state.valid
+        for op in filter_ops:
+            if op.filter is not None:
+                feasible &= op.filter(state, pf, ctx)
+        total = jnp.zeros(schema.N, jnp.int64)
+        for op, weight in score_ops:
+            if op.score is not None:
+                # Plugin scores are pre-normalized to [0, MaxNodeScore]; the
+                # framework applies the weight (runtime/framework.go:1188).
+                total += op.score(state, pf, ctx) * jnp.int64(weight)
+        tie_rand = _hash_u32(
+            jnp.uint32(profile.tie_break_seed) * jnp.uint32(2654435761) + step_idx.astype(jnp.uint32)
+        )
+        pick, best, m = select_host(feasible, total, tie_rand)
+        do = pf["valid"] & (pick >= 0)
+        state = _commit(state, pf, pick, do)
+        return state, PassResult(
+            picks=jnp.where(pf["valid"], pick, -1),
+            scores=best,
+            feasible_counts=m,
+        )
+
+    @jax.jit
+    def run(state: ClusterState, batch: dict, seed_base: jax.Array):
+        k = batch["valid"].shape[0]
+        steps = seed_base.astype(jnp.uint32) + jnp.arange(k, dtype=jnp.uint32)
+        state, out = lax.scan(step, state, (batch, steps))
+        return state, out
+
+    return run
+
+
+class PassCache:
+    """Compiled-pass cache keyed by (profile, schema, resource columns)."""
+
+    def __init__(self) -> None:
+        self._cache: dict = {}
+
+    def get(self, profile: Profile, schema: Schema, res_col: dict[str, int]):
+        key = (profile, schema, tuple(sorted(res_col.items())))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build_pass(profile, schema, res_col)
+            self._cache[key] = fn
+        return fn

@@ -1,0 +1,8 @@
+from .config import (  # noqa: F401
+    DEFAULT_PLUGIN_WEIGHTS,
+    DEFAULT_PROFILE,
+    MAX_NODE_SCORE,
+    Profile,
+    ScoringStrategy,
+)
+from .status import Code, Status  # noqa: F401

@@ -1,0 +1,80 @@
+"""Profiles: which vectorized plugins run, with what weights.
+
+Mirrors KubeSchedulerConfiguration profiles (reference:
+pkg/scheduler/apis/config/types.go:37) and the default plugin set + weights
+(apis/config/v1/default_plugins.go:32–52).  A Profile is static under jit —
+it selects which op branches are traced into the compiled batch pass, so each
+profile compiles to its own XLA program (the analog of the reference building
+one frameworkImpl per profile, profile/profile.go:50)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MAX_NODE_SCORE = 100  # framework.MaxNodeScore (interface.go)
+
+# Scoring strategy types (apis/config/types_pluginargs.go:187–194).
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+
+@dataclass(frozen=True)
+class ScoringStrategy:
+    type: str = LEAST_ALLOCATED
+    # (resource name, weight) — default cpu/memory weight 1 each
+    # (v1/default_plugins.go defaultResourceSpec).
+    resources: tuple[tuple[str, int], ...] = (("cpu", 1), ("memory", 1))
+    # RequestedToCapacityRatio shape points: (utilization%, score 0..10),
+    # rescaled to MaxNodeScore like the reference's buildRequestedToCapacityRatioScorerFunction.
+    shape: tuple[tuple[int, int], ...] = ((0, 0), (100, 10))
+
+
+@dataclass(frozen=True)
+class Profile:
+    """One scheduler profile = one compiled device program variant."""
+
+    name: str = "default-scheduler"
+    # Filter plugins, in the reference's MultiPoint order
+    # (v1/default_plugins.go:32–52).
+    filters: tuple[str, ...] = (
+        "NodeUnschedulable",
+        "NodeName",
+        "TaintToleration",
+        "NodeAffinity",
+        "NodePorts",
+        "NodeResourcesFit",
+        "PodTopologySpread",
+        "InterPodAffinity",
+    )
+    # (score plugin, weight) — default weights from default_plugins.go.
+    scorers: tuple[tuple[str, int], ...] = (
+        ("TaintToleration", 3),
+        ("NodeAffinity", 2),
+        ("NodeResourcesFit", 1),
+        ("PodTopologySpread", 2),
+        ("InterPodAffinity", 2),
+        ("NodeResourcesBalancedAllocation", 1),
+        ("ImageLocality", 1),
+    )
+    scoring_strategy: ScoringStrategy = field(default_factory=ScoringStrategy)
+    # None → adaptive default formula 50 − nodes/125 (schedule_one.go:676);
+    # 100 → evaluate all nodes (the TPU-native default: full evaluation is a
+    # small matrix op, truncation only exists for upstream-parity configs).
+    percentage_of_nodes_to_score: int | None = 100
+    # Deterministic tie-break seed (parity mode: both sides share it).
+    tie_break_seed: int = 0
+
+
+DEFAULT_PLUGIN_WEIGHTS = {name: w for name, w in Profile().scorers}
+
+DEFAULT_PROFILE = Profile()
+
+
+def fit_only_profile() -> Profile:
+    """NodeResourcesFit-only profile (BASELINE config #1 shape)."""
+    return Profile(
+        name="fit-only",
+        filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit"),
+        scorers=(("NodeResourcesFit", 1),),
+    )

@@ -1,0 +1,51 @@
+"""Status codes of the scheduling framework — the contract every extension
+point speaks (reference: pkg/scheduler/framework/interface.go:191–419)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Code(enum.IntEnum):
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4
+    SKIP = 5
+    PENDING = 6
+
+
+@dataclass
+class Status:
+    code: Code = Code.SUCCESS
+    reasons: tuple[str, ...] = ()
+    plugin: str = ""
+
+    def is_success(self) -> bool:
+        return self.code == Code.SUCCESS
+
+    def is_rejected(self) -> bool:
+        return self.code in (
+            Code.UNSCHEDULABLE,
+            Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
+            Code.PENDING,
+        )
+
+
+@dataclass
+class Diagnosis:
+    """Why a pod failed to schedule (framework/types.go Diagnosis): per-node
+    (or aggregated) plugin failures, used for events and requeue hints."""
+
+    node_to_plugin: dict[str, str] = field(default_factory=dict)  # node → failing plugin
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    pre_filter_msg: str = ""
+
+
+@dataclass
+class FitError(Exception):
+    pod_uid: str
+    num_all_nodes: int
+    diagnosis: Diagnosis = field(default_factory=Diagnosis)

@@ -1,0 +1,102 @@
+"""String interning: the bridge from the reference's string-keyed world
+(labels, taints, topology values, resource names) to dense integer ids that
+vectorize on device.
+
+The reference matches labels with string comparisons inside the per-node hot
+loop (e.g. labels.Selector in every affinity plugin).  Arbitrary string ops do
+not vectorize on a TPU, so every string the device needs is interned host-side
+into a vocabulary; device tensors hold only ids.  Vocabularies only grow;
+ids are stable for the life of the process, so device tensors never need
+re-keying when new strings appear.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Iterable
+
+
+class Vocab:
+    """A grow-only bijection value → dense id (0-based). Thread-hostile by
+    design: interning happens only on the (single-threaded) snapshot path,
+    matching the reference's single scheduling goroutine."""
+
+    __slots__ = ("_to_id", "_to_val", "name")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._to_id: dict[Hashable, int] = {}
+        self._to_val: list[Hashable] = []
+
+    def id(self, value: Hashable) -> int:
+        """Intern value, returning its id (allocating if new)."""
+        i = self._to_id.get(value)
+        if i is None:
+            i = len(self._to_val)
+            self._to_id[value] = i
+            self._to_val.append(value)
+        return i
+
+    def get(self, value: Hashable) -> int:
+        """Return id or -1 without interning (for read-only lookups)."""
+        return self._to_id.get(value, -1)
+
+    def value(self, i: int) -> Hashable:
+        return self._to_val[i]
+
+    def ids(self, values: Iterable[Hashable]) -> list[int]:
+        return [self.id(v) for v in values]
+
+    def __len__(self) -> int:
+        return len(self._to_val)
+
+    def __contains__(self, value: Hashable) -> bool:
+        return value in self._to_id
+
+
+class InternTable:
+    """All vocabularies the snapshot/feature builders share.
+
+    - ``label_keys``:   label key → id (for Exists/DoesNotExist ops)
+    - ``label_pairs``:  (key, value) → id (for In/NotIn/equality ops)
+    - ``taints``:       (key, value, effect) → id
+    - ``topo_keys``:    topology key → per-key slot index (bounded by schema.TK)
+    - ``topo_vals[k]``: per-topology-key value vocab (node's zone id, etc.)
+    - ``namespaces``:   namespace → id
+    - ``groups``:       (namespace_id, frozenset(labels.items())) → pod group id
+    - ``ports``:        (protocol, hostIP, port) → id
+    - ``images``:       image name → id
+    - ``node_names``:   node name → id (== snapshot row index is NOT guaranteed;
+                        row index mapping lives in the cache)
+    """
+
+    def __init__(self) -> None:
+        self.label_keys = Vocab("label_keys")
+        self.label_pairs = Vocab("label_pairs")
+        self.taints = Vocab("taints")
+        self.topo_keys = Vocab("topo_keys")
+        self.topo_vals: list[Vocab] = []
+        self.namespaces = Vocab("namespaces")
+        self.groups = Vocab("groups")
+        self.ports = Vocab("ports")
+        self.images = Vocab("images")
+        self.node_names = Vocab("node_names")
+
+    def topo_key_slot(self, key: str) -> int:
+        slot = self.topo_keys.id(key)
+        while len(self.topo_vals) <= slot:
+            self.topo_vals.append(Vocab(f"topo_vals[{len(self.topo_vals)}]"))
+        return slot
+
+    def topo_value_id(self, key: str, value: str) -> int:
+        return self.topo_vals[self.topo_key_slot(key)].id(value)
+
+    def group_id(self, namespace: str, labels: dict[str, str]) -> int:
+        """Pod label-group id: pods with identical (namespace, labels) share a
+        group.  Affinity/spread counting then becomes per-group arithmetic —
+        the device never sees individual pod labels."""
+        key = (self.namespaces.id(namespace), frozenset(labels.items()))
+        return self.groups.id(key)
+
+    def group_labels(self, gid: int) -> tuple[str, dict[str, str]]:
+        ns_id, fs = self.groups.value(gid)  # type: ignore[misc]
+        return str(self.namespaces.value(ns_id)), dict(fs)

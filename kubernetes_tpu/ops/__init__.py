@@ -1,0 +1,4 @@
+from . import common  # noqa: F401
+
+# Importing an op module registers its OpDefs.
+from . import noderesources, trivial  # noqa: F401

@@ -1,0 +1,91 @@
+"""Vectorized-plugin op interface.
+
+Each op is the TPU-native re-design of one in-tree scheduling plugin
+(reference: pkg/scheduler/framework/plugins/): instead of a per-node Filter /
+Score callback invoked from a goroutine pool (runtime/framework.go:861,1101),
+an op contributes
+
+  featurize(pod, fctx) → per-pod feature dict (host, numpy; stacked over the
+      batch by the engine; every value must have a schema-static shape), and
+  filter(state, pf, ctx)  → (N,) bool feasibility over all node rows at once,
+  score(state, pf, ctx)   → (N,) int64 in [0, MAX_NODE_SCORE] (already
+      normalized — the engine applies the plugin weight and sums),
+
+where `pf` is the batch feature dict sliced to one pod by `lax.scan`.  Ops are
+pure jax; everything dynamic about the cluster lives in ClusterState, and
+everything static (profile, schema) in PassContext so it is baked into the
+compiled program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..framework.config import Profile
+from ..snapshot import Schema, SnapshotBuilder
+
+
+@dataclass
+class FeaturizeContext:
+    """Host-side context handed to op featurizers."""
+
+    builder: SnapshotBuilder
+
+    @property
+    def interns(self):
+        return self.builder.interns
+
+    @property
+    def schema(self) -> Schema:
+        return self.builder.schema
+
+
+@dataclass(frozen=True)
+class PassContext:
+    """Static (trace-time) context for op filter/score functions.  `static`
+    holds per-profile resolved config (e.g. scoring-strategy resource columns)
+    baked into the trace — it is never a traced value."""
+
+    profile: Profile
+    schema: Schema
+    static: dict = None  # type: ignore[assignment]
+
+
+@dataclass(frozen=True)
+class OpDef:
+    name: str
+    featurize: Optional[Callable] = None  # (pod, FeaturizeContext) -> dict[str, np.ndarray]
+    filter: Optional[Callable] = None  # (state, pf, PassContext) -> (N,) bool
+    score: Optional[Callable] = None  # (state, pf, PassContext) -> (N,) i64
+    # Trace-time config resolver: (profile, schema, builder_res_col) -> dict,
+    # merged into PassContext.static under this op's keys.
+    static: Optional[Callable] = None
+
+
+_REGISTRY: dict[str, OpDef] = {}
+
+
+def register(op: OpDef) -> OpDef:
+    _REGISTRY[op.name] = op
+    return op
+
+
+def get(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def all_ops() -> dict[str, OpDef]:
+    return dict(_REGISTRY)
+
+
+def registered_subset(profile: Profile) -> Profile:
+    """Restrict a profile to plugins with registered ops (build-out aid while
+    the op inventory grows; a fully-built tree is a no-op)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        profile,
+        filters=tuple(f for f in profile.filters if f in _REGISTRY),
+        scorers=tuple((s, w) for s, w in profile.scorers if s in _REGISTRY),
+    )

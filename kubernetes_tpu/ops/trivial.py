@@ -1,0 +1,51 @@
+"""NodeName and NodeUnschedulable — the two one-comparison filters.
+
+Reference: plugins/nodename/node_name.go (pod.Spec.NodeName == node.Name) and
+plugins/nodeunschedulable/node_unschedulable.go (node.Spec.Unschedulable,
+unless the pod tolerates the node.kubernetes.io/unschedulable:NoSchedule
+taint)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import types as t
+from .common import FeaturizeContext, OpDef, PassContext, register
+
+UNSCHEDULABLE_TAINT = t.Taint(
+    key="node.kubernetes.io/unschedulable", effect=t.EFFECT_NO_SCHEDULE
+)
+
+
+def nodename_featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    name = pod.spec.node_name
+    nid = fctx.interns.node_names.get(name) if name else -1
+    # A named node that does not exist matches no row: use -2 (never equals a
+    # row's name_id, and != -1 which means "no constraint").
+    if name and nid < 0:
+        nid = -2
+    return {"nodename_id": np.int32(nid)}
+
+
+def nodename_filter(state, pf, ctx: PassContext):
+    want = pf["nodename_id"]
+    return (want == -1) | (state.name_id == want)
+
+
+def unschedulable_featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    tolerated = any(tol.tolerates(UNSCHEDULABLE_TAINT) for tol in pod.spec.tolerations)
+    return {"tolerates_unschedulable": np.bool_(tolerated)}
+
+
+def unschedulable_filter(state, pf, ctx: PassContext):
+    return ~state.unschedulable | pf["tolerates_unschedulable"]
+
+
+register(OpDef(name="NodeName", featurize=nodename_featurize, filter=nodename_filter))
+register(
+    OpDef(
+        name="NodeUnschedulable",
+        featurize=unschedulable_featurize,
+        filter=unschedulable_filter,
+    )
+)

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, shard_cluster_state, shard_pod_batch  # noqa: F401

@@ -1,0 +1,52 @@
+"""Multi-chip scaling: shard the node axis across a device mesh.
+
+The reference scales its Filter/Score hot loop with a 16-goroutine pool over
+the node list (parallelize/parallelism.go). The TPU-native equivalent shards
+the node axis of ClusterState across chips with `jax.sharding` — every
+vectorized op is elementwise or a reduction over N, so GSPMD partitions them
+for free and inserts the ICI collectives (the argmax/cumsum in select_host
+become cross-chip reductions; see SURVEY.md §2.3). Nothing in the ops needs to
+change: this module only places the data.
+
+Pod batches are replicated (the scan is a sequential dependency chain — its
+parallelism is across the node axis, not pods)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..snapshot import _NODE_AXIS, ClusterState
+
+NODE_AXIS_NAME = "nodes"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (NODE_AXIS_NAME,))
+
+
+def _spec_for(field: str) -> P:
+    if _NODE_AXIS[field] == 0:
+        return P(NODE_AXIS_NAME)
+    return P(None, NODE_AXIS_NAME)
+
+
+def shard_cluster_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+    """Place every field with its node axis split across the mesh."""
+    out = {}
+    for f in dataclasses.fields(ClusterState):
+        arr = getattr(state, f.name)
+        sharding = NamedSharding(mesh, _spec_for(f.name))
+        out[f.name] = jax.device_put(arr, sharding)
+    return ClusterState(**out)
+
+
+def shard_pod_batch(batch: dict, mesh: Mesh) -> dict:
+    """Replicate the pod batch on every chip."""
+    repl = NamedSharding(mesh, P())
+    return {k: jax.device_put(v, repl) for k, v in batch.items()}

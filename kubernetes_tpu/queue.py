@@ -1,0 +1,216 @@
+"""Three-stage scheduling queue: activeQ / backoffQ / unschedulable pool.
+
+Mirrors the reference's PriorityQueue (pkg/scheduler/backend/queue/
+scheduling_queue.go:152): activeQ is a heap ordered by the QueueSort plugin
+(priority desc, then enqueue time — queuesort/priority_sort.go), backoffQ
+holds pods whose backoff hasn't expired (1s initial, ×2 per attempt, 10s cap —
+scheduling_queue.go:73–81), and the unschedulable pool holds pods waiting for
+a cluster event that might make them schedulable again
+(flushUnschedulablePodsLeftover re-activates them after 5min, :807).
+
+Requeue-on-event hints are simplified to event bitmasks per rejection source
+(the analog of isPodWorthRequeuing's per-plugin QueueingHintFn, :406)."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import IntFlag, auto
+
+from .api import types as t
+
+
+class Event(IntFlag):
+    """Cluster event kinds driving requeue (framework/events.go:40)."""
+
+    NODE_ADD = auto()
+    NODE_UPDATE = auto()
+    NODE_TAINT = auto()
+    NODE_LABEL = auto()
+    POD_ADD = auto()
+    POD_UPDATE = auto()
+    POD_DELETE = auto()
+    ANY = (
+        NODE_ADD | NODE_UPDATE | NODE_TAINT | NODE_LABEL | POD_ADD | POD_UPDATE | POD_DELETE
+    )
+
+
+# Which events can unblock a pod rejected by a given plugin — the static core
+# of the reference's per-plugin EventsToRegister (e.g. fit.go:253 queueing hints).
+PLUGIN_REQUEUE_EVENTS: dict[str, Event] = {
+    "NodeResourcesFit": Event.NODE_ADD | Event.NODE_UPDATE | Event.POD_DELETE | Event.POD_UPDATE,
+    "NodeAffinity": Event.NODE_ADD | Event.NODE_LABEL,
+    "NodeName": Event.NODE_ADD,
+    "NodeUnschedulable": Event.NODE_ADD | Event.NODE_UPDATE,
+    "TaintToleration": Event.NODE_ADD | Event.NODE_TAINT,
+    "NodePorts": Event.NODE_ADD | Event.POD_DELETE,
+    "PodTopologySpread": Event.NODE_ADD | Event.NODE_LABEL | Event.POD_ADD | Event.POD_DELETE | Event.POD_UPDATE,
+    "InterPodAffinity": Event.NODE_ADD | Event.NODE_LABEL | Event.POD_ADD | Event.POD_DELETE | Event.POD_UPDATE,
+}
+
+DEFAULT_POD_INITIAL_BACKOFF_S = 1.0
+DEFAULT_POD_MAX_BACKOFF_S = 10.0
+DEFAULT_MAX_UNSCHEDULABLE_DURATION_S = 300.0
+
+
+@dataclass(order=False)
+class QueuedPodInfo:
+    """Mirror of framework.QueuedPodInfo (types.go:362)."""
+
+    pod: t.Pod
+    timestamp: float = 0.0  # time added to activeQ this round
+    initial_attempt_timestamp: float = 0.0
+    attempts: int = 0
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    gated: bool = False
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        initial_backoff_s: float = DEFAULT_POD_INITIAL_BACKOFF_S,
+        max_backoff_s: float = DEFAULT_POD_MAX_BACKOFF_S,
+        max_unschedulable_s: float = DEFAULT_MAX_UNSCHEDULABLE_DURATION_S,
+        clock=time.monotonic,
+    ):
+        self._clock = clock
+        self._seq = itertools.count()
+        self._active: list = []  # heap of (-priority, timestamp, seq, uid)
+        self._backoff: list = []  # heap of (expiry, seq, uid)
+        self._unschedulable: dict[str, QueuedPodInfo] = {}
+        self._info: dict[str, QueuedPodInfo] = {}
+        self._in_active: set[str] = set()
+        self.initial_backoff_s = initial_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.max_unschedulable_s = max_unschedulable_s
+        self._gated: dict[str, QueuedPodInfo] = {}
+
+    def __len__(self) -> int:
+        return len(self._in_active)
+
+    def pending_count(self) -> int:
+        return len(self._in_active) + len(self._backoff) + len(self._unschedulable) + len(self._gated)
+
+    # -- add / pop -----------------------------------------------------------
+
+    def add(self, pod: t.Pod) -> None:
+        now = self._clock()
+        qp = self._info.get(pod.uid)
+        if qp is None:
+            qp = QueuedPodInfo(pod=pod, timestamp=now, initial_attempt_timestamp=now)
+            self._info[pod.uid] = qp
+        qp.pod = pod
+        # PreEnqueue: SchedulingGates holds gated pods out of every queue
+        # (plugins/schedulinggates/scheduling_gates.go).
+        if pod.spec.scheduling_gates:
+            qp.gated = True
+            self._gated[pod.uid] = qp
+            return
+        qp.gated = False
+        self._push_active(qp)
+
+    def _push_active(self, qp: QueuedPodInfo) -> None:
+        if qp.pod.uid in self._in_active:
+            return
+        qp.timestamp = self._clock()
+        heapq.heappush(
+            self._active,
+            (-qp.pod.spec.priority, qp.timestamp, next(self._seq), qp.pod.uid),
+        )
+        self._in_active.add(qp.pod.uid)
+        self._unschedulable.pop(qp.pod.uid, None)
+
+    def pop_batch(self, k: int) -> list[QueuedPodInfo]:
+        """Pop up to k pods in QueueSort order — the batch analog of
+        activeQueue.pop (active_queue.go:186)."""
+        self.flush_backoff()
+        out: list[QueuedPodInfo] = []
+        while self._active and len(out) < k:
+            _, _, _, uid = heapq.heappop(self._active)
+            if uid not in self._in_active:
+                continue
+            self._in_active.discard(uid)
+            qp = self._info[uid]
+            qp.attempts += 1
+            out.append(qp)
+        return out
+
+    # -- failure / backoff -----------------------------------------------------
+
+    def backoff_duration(self, attempts: int) -> float:
+        d = self.initial_backoff_s
+        for _ in range(1, attempts):
+            d *= 2
+            if d >= self.max_backoff_s:
+                return self.max_backoff_s
+        return d
+
+    def add_unschedulable(self, qp: QueuedPodInfo, plugins: set[str]) -> None:
+        """AddUnschedulableIfNotPresent (scheduling_queue.go:728): pods that
+        failed go to the unschedulable pool keyed by what rejected them."""
+        qp.unschedulable_plugins = plugins
+        self._unschedulable[qp.pod.uid] = qp
+
+    def add_backoff(self, qp: QueuedPodInfo) -> None:
+        expiry = self._clock() + self.backoff_duration(qp.attempts)
+        heapq.heappush(self._backoff, (expiry, next(self._seq), qp.pod.uid))
+
+    def flush_backoff(self) -> int:
+        """Move expired backoff pods to activeQ (flushBackoffQCompleted :777)."""
+        now = self._clock()
+        n = 0
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, uid = heapq.heappop(self._backoff)
+            qp = self._info.get(uid)
+            if qp is not None:
+                self._push_active(qp)
+                n += 1
+        return n
+
+    def flush_unschedulable_leftover(self) -> int:
+        """Re-activate pods stuck unschedulable > max duration (:807)."""
+        now = self._clock()
+        stale = [
+            uid
+            for uid, qp in self._unschedulable.items()
+            if now - qp.timestamp > self.max_unschedulable_s
+        ]
+        for uid in stale:
+            self._push_active(self._unschedulable.pop(uid))
+        return len(stale)
+
+    # -- events ----------------------------------------------------------------
+
+    def on_event(self, event: Event) -> int:
+        """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1029): wake
+        unschedulable pods whose rejecting plugins care about this event."""
+        woken = []
+        for uid, qp in self._unschedulable.items():
+            interested = Event(0)
+            for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
+                interested |= PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY)
+            if interested & event:
+                woken.append(uid)
+        for uid in woken:
+            qp = self._unschedulable.pop(uid)
+            self.add_backoff(qp)
+        return len(woken)
+
+    def remove_gate(self, uid: str) -> None:
+        """A pod's scheduling gates were cleared; admit it."""
+        qp = self._gated.pop(uid, None)
+        if qp is not None:
+            qp.gated = False
+            self._push_active(qp)
+
+    def delete(self, uid: str) -> None:
+        self._in_active.discard(uid)
+        self._unschedulable.pop(uid, None)
+        self._gated.pop(uid, None)
+        self._info.pop(uid, None)
+
+    def done(self, uid: str) -> None:
+        """Pod scheduled successfully; drop bookkeeping."""
+        self._info.pop(uid, None)

@@ -1,0 +1,177 @@
+"""The scheduler: the driving loop over queue → device pass → bind.
+
+The batched equivalent of ScheduleOne (pkg/scheduler/schedule_one.go:65):
+instead of popping one pod, running the framework's extension points over a
+goroutine pool, and binding asynchronously, we pop a batch in QueueSort order,
+run the compiled device pass (filter+score+select+commit for every pod in the
+batch in one dispatch), then apply the resulting assignments to the host cache
+(the assume step — the device already committed them to its state) and hand
+unschedulable pods back to the queue."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from .api import types as t
+from .cache import Cache
+from .engine.features import build_pod_batch
+from .engine.pass_ import PassCache
+from .framework.config import DEFAULT_PROFILE, Profile
+from .intern import InternTable
+from .ops.common import registered_subset
+from .queue import Event, QueuedPodInfo, SchedulingQueue
+from .snapshot import SnapshotBuilder
+
+
+@dataclass
+class ScheduleOutcome:
+    pod: t.Pod
+    node_name: str | None  # None → unschedulable this round
+    score: int = 0
+    feasible_nodes: int = 0
+
+
+@dataclass
+class SchedulerMetrics:
+    """Counters mirroring the reference's core series
+    (pkg/scheduler/metrics/metrics.go:138 schedule_attempts_total etc.)."""
+
+    schedule_attempts: int = 0
+    scheduled: int = 0
+    unschedulable: int = 0
+    batches: int = 0
+    device_time_s: float = 0.0
+    featurize_time_s: float = 0.0
+    first_scheduled_ts: float = 0.0
+    last_scheduled_ts: float = 0.0
+    throughput_samples: list = field(default_factory=list)
+
+
+class TPUScheduler:
+    def __init__(
+        self,
+        profile: Profile = DEFAULT_PROFILE,
+        batch_size: int = 256,
+        queue: SchedulingQueue | None = None,
+    ):
+        # Restrict to plugins whose vectorized ops are registered (a no-op
+        # once the op inventory is complete; prevents KeyError mid-build-out).
+        self.profile = registered_subset(profile)
+        self.batch_size = batch_size
+        self.interns = InternTable()
+        self.builder = SnapshotBuilder(self.interns)
+        self.cache = Cache(self.builder)
+        self.queue = queue or SchedulingQueue()
+        self.passes = PassCache()
+        self.metrics = SchedulerMetrics()
+        self._cycle = 0
+        # Pre-intern the hot topology keys so node rows materialize them.
+        for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
+                    "topology.kubernetes.io/region"):
+            self.builder.ensure_topo_key(key)
+
+    # -- cluster events (the informer surface, eventhandlers.go:341) ---------
+
+    def add_node(self, node: t.Node) -> None:
+        self.cache.add_node(node)
+        self.queue.on_event(Event.NODE_ADD)
+
+    def update_node(self, node: t.Node) -> None:
+        self.cache.update_node(node)
+        self.queue.on_event(Event.NODE_UPDATE)
+
+    def remove_node(self, name: str) -> None:
+        self.cache.remove_node(name)
+
+    def add_pod(self, pod: t.Pod) -> None:
+        """Unassigned pods enter the queue; assigned pods enter the cache
+        (eventhandlers.go:126 addPodToSchedulingQueue / :203 addPodToCache)."""
+        if pod.spec.node_name:
+            self.cache.add_pod(pod)
+            self.queue.on_event(Event.POD_ADD)
+        else:
+            self.queue.add(pod)
+
+    def delete_pod(self, uid: str) -> None:
+        if uid in self.cache.pods:
+            self.cache.remove_pod(uid)
+            self.queue.on_event(Event.POD_DELETE)
+        else:
+            self.queue.delete(uid)
+
+    # -- scheduling ------------------------------------------------------------
+
+    def schedule_batch(self) -> list[ScheduleOutcome]:
+        """Pop up to batch_size pods and schedule them in one device pass."""
+        infos = self.queue.pop_batch(self.batch_size)
+        if not infos:
+            return []
+        return self._schedule_infos(infos)
+
+    def _schedule_infos(self, infos: list[QueuedPodInfo]) -> list[ScheduleOutcome]:
+        pods = [qp.pod for qp in infos]
+        t0 = time.perf_counter()
+        # Featurize first: it may grow vocab/schema (forcing a rebuild below).
+        # Always pad to the full batch size: one batch shape → one XLA program
+        # (a short tail batch costs a few idle scan steps, ~µs; a second
+        # compiled shape costs tens of seconds).
+        batch, deltas = build_pod_batch(pods, self.builder, self.profile, self.batch_size)
+        t1 = time.perf_counter()
+        state = self.builder.state()
+        run = self.passes.get(self.profile, self.builder.schema, self.builder.res_col)
+        new_state, result = run(state, batch, np.uint32(self._cycle))
+        # One host round trip for all result arrays (the tunnel to the device
+        # has high per-transfer latency; never sync field-by-field).
+        picks, scores, feas = jax.device_get((result.picks, result.scores, result.feasible_counts))
+        t2 = time.perf_counter()
+        self._cycle += len(infos)
+        self.builder.absorb_device_state(new_state)
+
+        outcomes: list[ScheduleOutcome] = []
+        now = time.monotonic()
+        m = self.metrics
+        m.batches += 1
+        m.featurize_time_s += t1 - t0
+        m.device_time_s += t2 - t1
+        for i, qp in enumerate(infos):
+            m.schedule_attempts += 1
+            row = int(picks[i])
+            if row >= 0:
+                node_name = self.cache.node_name_at_row(row)
+                assert node_name is not None, f"pick={row} maps to no node"
+                # assume: the device committed the delta in-scan; mirror it on
+                # the host (cache.go:361 AssumePod) and finish the binding —
+                # in-process bind has no async API round trip to wait for.
+                self.cache.assume_pod(qp.pod, node_name, device_already=True, delta=deltas[i])
+                qp.pod.spec.node_name = node_name
+                self.cache.finish_binding(qp.pod.uid)
+                self.queue.done(qp.pod.uid)
+                if m.scheduled == 0:
+                    m.first_scheduled_ts = now
+                m.scheduled += 1
+                m.last_scheduled_ts = now
+                outcomes.append(
+                    ScheduleOutcome(qp.pod, node_name, int(scores[i]), int(feas[i]))
+                )
+            else:
+                m.unschedulable += 1
+                # Without per-plugin diagnosis (the fast path), requeue waits
+                # on any event the profile's filters care about.
+                self.queue.add_unschedulable(qp, set(self.profile.filters))
+                outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
+        return outcomes
+
+    def schedule_all_pending(self, max_rounds: int = 10_000) -> list[ScheduleOutcome]:
+        """Drain the active queue (benchmark driver)."""
+        all_outcomes: list[ScheduleOutcome] = []
+        for _ in range(max_rounds):
+            out = self.schedule_batch()
+            if not out:
+                break
+            all_outcomes.extend(out)
+        return all_outcomes
+

@@ -1,0 +1,414 @@
+"""Device tensor schema and the host→device snapshot engine.
+
+This is the TPU-native replacement for the reference's `NodeInfo` aggregation
+(pkg/scheduler/framework/types.go:714) and incremental `Cache.UpdateSnapshot`
+(pkg/scheduler/backend/cache/cache.go:186).  Where the reference keeps one Go
+struct per node and copies changed nodes into a per-cycle `Snapshot`, we keep
+the whole cluster as a struct-of-arrays (one row per node, padded to a bucketed
+capacity) mirrored between host numpy staging arrays and device HBM:
+
+  * Host-driven changes (node add/update/remove, pod delete, informer events)
+    dirty individual rows; `flush()` ships only dirty rows via a jitted row
+    scatter — the analog of the generation-diff copy in UpdateSnapshot.
+  * Device-driven changes (the engine's scan commits a pod per step) already
+    live on device; the host applies the same deltas to its staging arrays
+    after each batch so the mirrors stay equal without re-upload.
+
+All shapes are static under jit; capacities grow in buckets (powers of two) so
+shape changes — and hence XLA recompiles — are logarithmic in cluster growth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .api import types as t
+from .intern import InternTable
+
+# Sentinel for "label value is not an integer" (Gt/Lt operators).
+INT_SENTINEL = np.int64(-(2**62))
+
+# Fixed resource columns; scalar/extended resources are interned after these.
+RES_CPU, RES_MEMORY, RES_EPHEMERAL = 0, 1, 2
+FIXED_RESOURCES = (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Smallest power-of-two capacity ≥ n (min floor)."""
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Static capacities of the device tensors (jit shape parameters)."""
+
+    N: int = 64  # node rows
+    R: int = 4  # resource columns (fixed 3 + scalars)
+    LS: int = 16  # label slots per node
+    TS: int = 8  # taint slots per node
+    TK: int = 4  # topology-key slots
+    G: int = 8  # pod label-group rows
+    AT: int = 8  # existing-pod required-anti-affinity term rows
+    P: int = 8  # host-port (proto,ip,port) triple rows
+    PK: int = 8  # host-port (proto,port) key rows
+    IM: int = 8  # image slots per node
+
+    def grown(self, **mins: int) -> "Schema":
+        """Return a schema with each named capacity grown to cover its min."""
+        changes = {}
+        for name, need in mins.items():
+            cur = getattr(self, name)
+            if need > cur:
+                changes[name] = _bucket(need, cur)
+        return dataclasses.replace(self, **changes) if changes else self
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ClusterState:
+    """The device-resident cluster: one row per node (axis sized Schema.N).
+
+    This is the tensorized `NodeInfo` (types.go:714): Allocatable/Requested
+    become (N, R) int64 matrices, labels/taints become interned id slots,
+    affinity bookkeeping becomes per-group and per-term count matrices.
+    """
+
+    # Row occupancy & scalars -------------------------------------------------
+    valid: jax.Array  # (N,) bool — row holds a live node
+    name_id: jax.Array  # (N,) i32 — interned node name (NodeName plugin)
+    unschedulable: jax.Array  # (N,) bool — node.Spec.Unschedulable
+    num_pods: jax.Array  # (N,) i32 — len(NodeInfo.Pods)
+    allowed_pods: jax.Array  # (N,) i32 — Allocatable.AllowedPodNumber
+
+    # Resources ---------------------------------------------------------------
+    alloc: jax.Array  # (N, R) i64 — NodeInfo.Allocatable
+    req: jax.Array  # (N, R) i64 — NodeInfo.Requested
+    nonzero_req: jax.Array  # (N, 2) i64 — NodeInfo.NonZeroRequested (cpu, mem)
+
+    # Labels (node affinity / selectors) --------------------------------------
+    label_key_ids: jax.Array  # (N, LS) i32, -1 pad
+    label_pair_ids: jax.Array  # (N, LS) i32, -1 pad
+    label_int_vals: jax.Array  # (N, LS) i64, INT_SENTINEL if not integral
+
+    # Topology ----------------------------------------------------------------
+    topo_vals: jax.Array  # (N, TK) i32 — per topo-key-slot value id, -1 missing
+
+    # Taints ------------------------------------------------------------------
+    taint_ids: jax.Array  # (N, TS) i32, -1 pad
+
+    # Host ports --------------------------------------------------------------
+    port_counts: jax.Array  # (P, N) i32 — pods using exact (proto,ip,port)
+    portkey_counts: jax.Array  # (PK, N) i32 — pods using (proto,port) any ip
+
+    # Affinity bookkeeping ----------------------------------------------------
+    group_counts: jax.Array  # (G, N) i32 — pods of label-group g on node n
+    at_counts: jax.Array  # (AT, N) i32 — pods w/ required anti-affinity term a
+
+    # Images ------------------------------------------------------------------
+    image_ids: jax.Array  # (N, IM) i32, -1 pad
+    image_sizes: jax.Array  # (N, IM) i64 — size of image at same slot
+
+
+# Field → which axis indexes nodes (0 = leading, 1 = trailing).
+_NODE_AXIS: dict[str, int] = {
+    "valid": 0,
+    "name_id": 0,
+    "unschedulable": 0,
+    "num_pods": 0,
+    "allowed_pods": 0,
+    "alloc": 0,
+    "req": 0,
+    "nonzero_req": 0,
+    "label_key_ids": 0,
+    "label_pair_ids": 0,
+    "label_int_vals": 0,
+    "topo_vals": 0,
+    "taint_ids": 0,
+    "port_counts": 1,
+    "portkey_counts": 1,
+    "group_counts": 1,
+    "at_counts": 1,
+    "image_ids": 0,
+    "image_sizes": 0,
+}
+
+
+def _host_arrays(s: Schema) -> dict[str, np.ndarray]:
+    return {
+        "valid": np.zeros(s.N, np.bool_),
+        "name_id": np.full(s.N, -1, np.int32),
+        "unschedulable": np.zeros(s.N, np.bool_),
+        "num_pods": np.zeros(s.N, np.int32),
+        "allowed_pods": np.zeros(s.N, np.int32),
+        "alloc": np.zeros((s.N, s.R), np.int64),
+        "req": np.zeros((s.N, s.R), np.int64),
+        "nonzero_req": np.zeros((s.N, 2), np.int64),
+        "label_key_ids": np.full((s.N, s.LS), -1, np.int32),
+        "label_pair_ids": np.full((s.N, s.LS), -1, np.int32),
+        "label_int_vals": np.full((s.N, s.LS), INT_SENTINEL, np.int64),
+        "topo_vals": np.full((s.N, s.TK), -1, np.int32),
+        "taint_ids": np.full((s.N, s.TS), -1, np.int32),
+        "port_counts": np.zeros((s.P, s.N), np.int32),
+        "portkey_counts": np.zeros((s.PK, s.N), np.int32),
+        "group_counts": np.zeros((s.G, s.N), np.int32),
+        "at_counts": np.zeros((s.AT, s.N), np.int32),
+        "image_ids": np.full((s.N, s.IM), -1, np.int32),
+        "image_sizes": np.zeros((s.N, s.IM), np.int64),
+    }
+
+
+def parse_label_int(v: str) -> int:
+    """Value of a label as int for Gt/Lt, or INT_SENTINEL."""
+    try:
+        return int(v)
+    except ValueError:
+        return int(INT_SENTINEL)
+
+
+class SnapshotBuilder:
+    """Owns the host staging arrays, the intern table, and the device mirror.
+
+    The scheduler's cache calls ``set_node_row`` / ``clear_node_row`` /
+    ``apply_pod_delta`` as cluster events arrive; the engine calls ``state()``
+    before each device pass to get an up-to-date ClusterState (flushing dirty
+    rows), and ``absorb_device_state`` after the pass to adopt the
+    scan-committed tensors as the new device truth.
+    """
+
+    def __init__(self, interns: InternTable | None = None, schema: Schema | None = None):
+        self.interns = interns or InternTable()
+        self.schema = schema or Schema()
+        self.host = _host_arrays(self.schema)
+        self._device: ClusterState | None = None
+        self._dirty_rows: set[int] = set()
+        self._dirty_all = True  # device needs a full (re)build
+        # Resource-name → column index (fixed columns pre-assigned).
+        self.res_col: dict[str, int] = {r: i for i, r in enumerate(FIXED_RESOURCES)}
+
+    # -- capacity management -------------------------------------------------
+
+    def _ensure(self, **mins: int) -> None:
+        grown = self.schema.grown(**mins)
+        if grown is self.schema:
+            return
+        old, olds = self.host, self.schema
+        self.schema = grown
+        self.host = _host_arrays(grown)
+        for k, a in old.items():
+            sl = tuple(slice(0, d) for d in a.shape)
+            self.host[k][sl] = a
+        del olds
+        self._dirty_all = True
+
+    def resource_column(self, name: str) -> int:
+        col = self.res_col.get(name)
+        if col is None:
+            col = len(self.res_col)
+            self._ensure(R=col + 1)
+            self.res_col[name] = col
+        return col
+
+    # -- node rows -------------------------------------------------------------
+
+    def set_node_row(self, row: int, node: t.Node) -> None:
+        """(Re)write a node's static attributes into its row. Pod-derived
+        state (req, counts) is managed separately via apply_pod_delta."""
+        it = self.interns
+        labels = node.metadata.labels
+        self._ensure(
+            N=row + 1,
+            LS=len(labels),
+            TS=len(node.spec.taints),
+            IM=len(node.status.images),
+        )
+        # Pre-intern all resource columns so R is final before writing.
+        for rname in node.status.allocatable:
+            if rname != t.PODS:
+                self.resource_column(rname)
+        h = self.host
+        h["valid"][row] = True
+        h["name_id"][row] = it.node_names.id(node.name)
+        h["unschedulable"][row] = node.spec.unschedulable
+        h["allowed_pods"][row] = node.status.allocatable.get(t.PODS, 110)
+        h["alloc"][row] = 0
+        for rname, v in node.status.allocatable.items():
+            if rname == t.PODS:
+                continue
+            h["alloc"][row, self.resource_column(rname)] = v
+        # Labels.
+        h["label_key_ids"][row] = -1
+        h["label_pair_ids"][row] = -1
+        h["label_int_vals"][row] = INT_SENTINEL
+        for i, (k, v) in enumerate(labels.items()):
+            h["label_key_ids"][row, i] = it.label_keys.id(k)
+            h["label_pair_ids"][row, i] = it.label_pairs.id((k, v))
+            h["label_int_vals"][row, i] = parse_label_int(v)
+        # Topology: every label key is a potential topology key; we only
+        # materialize keys something has referenced (lazily via featurize), but
+        # hostname/zone/region are always hot, so intern any key already known.
+        h["topo_vals"][row] = -1
+        for k, v in labels.items():
+            if k in it.topo_keys:
+                slot = it.topo_key_slot(k)
+                if slot < self.schema.TK:
+                    h["topo_vals"][row, slot] = it.topo_value_id(k, v)
+        # Taints.
+        h["taint_ids"][row] = -1
+        for i, taint in enumerate(node.spec.taints):
+            h["taint_ids"][row, i] = it.taints.id((taint.key, taint.value, taint.effect))
+        # Images.
+        h["image_ids"][row] = -1
+        h["image_sizes"][row] = 0
+        for i, img in enumerate(node.status.images):
+            # All names of one image share a size; intern each name.
+            h["image_ids"][row, i] = it.images.id(img.names[0])
+            h["image_sizes"][row, i] = img.size_bytes
+            for alias in img.names[1:]:
+                it.images.id(alias)
+        self._dirty_rows.add(row)
+
+    def ensure_topo_key(self, key: str) -> int:
+        """Intern a topology key and backfill topo_vals for existing nodes.
+        Returns the key's slot. Called by featurization when a pod references
+        a topology key no node row has materialized yet."""
+        known = key in self.interns.topo_keys
+        slot = self.interns.topo_key_slot(key)
+        self._ensure(TK=slot + 1)
+        if not known:
+            # Backfill: topo value = node's label value for this key.
+            pair_col = self.host["label_key_ids"]
+            key_id = self.interns.label_keys.get(key)
+            if key_id >= 0:
+                rows = np.nonzero((pair_col == key_id).any(axis=1))[0]
+                for row in rows:
+                    s = int(np.nonzero(pair_col[row] == key_id)[0][0])
+                    pair = self.interns.label_pairs.value(int(self.host["label_pair_ids"][row, s]))
+                    self.host["topo_vals"][row, slot] = self.interns.topo_value_id(key, pair[1])
+                    self._dirty_rows.add(row)
+        return slot
+
+    def clear_node_row(self, row: int) -> None:
+        h = self.host
+        for k, a in _host_arrays(Schema(N=1, R=self.schema.R, LS=self.schema.LS,
+                                        TS=self.schema.TS, TK=self.schema.TK,
+                                        G=self.schema.G, AT=self.schema.AT,
+                                        P=self.schema.P, PK=self.schema.PK,
+                                        IM=self.schema.IM)).items():
+            if _NODE_AXIS[k] == 0:
+                h[k][row] = a[0]
+            else:
+                h[k][:, row] = 0
+        self._dirty_rows.add(row)
+
+    # -- pod deltas ------------------------------------------------------------
+
+    def pod_delta_vectors(self, pod: t.Pod) -> dict:
+        """Precompute the row-delta a pod applies when (un)assigned to a node.
+        Mirrors NodeInfo.AddPodInfo / RemovePod (types.go:990,1022)."""
+        request = pod.resource_request()
+        cols = {r: self.resource_column(r) for r in request if r != t.PODS}
+        req_vec = np.zeros(self.schema.R, np.int64)
+        for rname, col in cols.items():
+            req_vec[col] = request[rname]
+        cpu, mem = pod.non_zero_request()
+        gid = self.interns.group_id(pod.namespace, pod.metadata.labels)
+        self._ensure(G=gid + 1)
+        host_ports = pod.host_ports()
+        assert len(host_ports) <= 8, f"pod {pod.uid} has {len(host_ports)} host ports (max 8)"
+        ports = []
+        for proto, ip, port in host_ports:
+            triple = self.interns.ports.id((proto, ip, port))
+            wild = self.interns.ports.id((proto, "0.0.0.0", port))
+            pk = self.interns.ports.id((proto, None, port))  # key-level row
+            self._ensure(P=max(triple, wild) + 1, PK=pk + 1)
+            ports.append((triple, pk, ip == "0.0.0.0"))
+        return {
+            "req": req_vec,
+            "nonzero": np.array([cpu, mem], np.int64),
+            "group": gid,
+            "ports": ports,
+        }
+
+    def apply_pod_delta(self, row: int, delta: dict, sign: int, device_already: bool) -> None:
+        """Apply a pod's delta to host staging.  ``device_already=True`` when
+        the device applied the same commit inside the scan (no re-upload).
+
+        The delta may predate later resource-column growth (deltas live in
+        PodRecords for the pod's lifetime); re-pad to the current schema."""
+        h = self.host
+        if delta["req"].shape[0] < self.schema.R:
+            delta["req"] = np.pad(delta["req"], (0, self.schema.R - delta["req"].shape[0]))
+        h["req"][row] += sign * delta["req"]
+        h["nonzero_req"][row] += sign * delta["nonzero"]
+        h["num_pods"][row] += sign
+        h["group_counts"][delta["group"], row] += sign
+        for triple, pk, _ in delta["ports"]:
+            h["port_counts"][triple, row] += sign
+            h["portkey_counts"][pk, row] += sign
+        for at_id in delta.get("anti_terms", ()):
+            h["at_counts"][at_id, row] += sign
+        if not device_already:
+            self._dirty_rows.add(row)
+
+    # -- device mirror ---------------------------------------------------------
+
+    def state(self) -> ClusterState:
+        """Return the device ClusterState, flushing pending host changes."""
+        if self._dirty_all or self._device is None:
+            self._device = ClusterState(
+                **{k: jnp.asarray(v) for k, v in self.host.items()}
+            )
+            self._dirty_all = False
+            self._dirty_rows.clear()
+            return self._device
+        if self._dirty_rows:
+            rows = np.fromiter(self._dirty_rows, np.int32)
+            # Pad to a bucket so jit sees few distinct shapes; padding repeats
+            # row[0] (idempotent scatter of identical values).
+            padded = np.full(_bucket(len(rows)), rows[0], np.int32)
+            padded[: len(rows)] = rows
+            updates0 = {
+                k: self.host[k][padded] for k, ax in _NODE_AXIS.items() if ax == 0
+            }
+            updates1 = {
+                k: self.host[k][:, padded] for k, ax in _NODE_AXIS.items() if ax == 1
+            }
+            self._device = _scatter_rows(self._device, jnp.asarray(padded), updates0, updates1)
+            self._dirty_rows.clear()
+        return self._device
+
+    def absorb_device_state(self, state: ClusterState) -> None:
+        """Adopt the post-scan device tensors as the current device mirror."""
+        self._device = state
+
+    def host_mirror_equal(self, atol: int = 0) -> bool:
+        """Consistency check host staging vs device (the analog of the cache
+        comparer in backend/cache/debugger): True iff mirrors agree."""
+        if self._device is None:
+            return True
+        st = self.state()
+        for k, hv in self.host.items():
+            dv = np.asarray(getattr(st, k))
+            if not np.array_equal(hv, dv):
+                return False
+        return True
+
+
+@jax.jit
+def _scatter_rows(state: ClusterState, idx: jax.Array, updates0: dict, updates1: dict) -> ClusterState:
+    new = {}
+    for f in dataclasses.fields(ClusterState):
+        arr = getattr(state, f.name)
+        if f.name in updates0:
+            new[f.name] = arr.at[idx].set(updates0[f.name])
+        else:
+            new[f.name] = arr.at[:, idx].set(updates1[f.name])
+    return ClusterState(**new)

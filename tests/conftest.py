@@ -1,0 +1,17 @@
+"""Test env: force CPU with 8 virtual devices so multi-chip sharding tests run
+without TPU hardware (the driver validates the real multi-chip path via
+__graft_entry__.dryrun_multichip). Must run before jax is imported."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image pre-loads an 'axon' TPU platform plugin that overrides
+# JAX_PLATFORMS from the environment; pin the config explicitly so tests run
+# on the 8 virtual CPU devices, not through the TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,194 @@
+"""Scalar (pure-Python) reference implementations of the plugin semantics,
+written straight from the Go sources — the oracle the vectorized device ops
+are tested against (SURVEY.md §4: "table-driven plugin-semantics unit tests
+comparing vectorized ops against scalar reference implementations").
+
+Each function takes plain Pod/Node objects plus explicit cluster state
+(pods-per-node etc.) and returns what the corresponding Go code returns."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from kubernetes_tpu.api import types as t
+
+MAX_NODE_SCORE = 100
+
+
+@dataclass
+class RefNodeState:
+    """Scalar mirror of NodeInfo (framework/types.go:714)."""
+
+    node: t.Node
+    pods: list[t.Pod] = field(default_factory=list)
+
+    @property
+    def requested(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for p in self.pods:
+            for k, v in p.resource_request().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    @property
+    def nonzero_requested(self) -> tuple[int, int]:
+        cpu = sum(p.non_zero_request()[0] for p in self.pods)
+        mem = sum(p.non_zero_request()[1] for p in self.pods)
+        return cpu, mem
+
+
+def fits_request(pod: t.Pod, ns: RefNodeState) -> list[str]:
+    """fitsRequest (noderesources/fit.go:488): list of insufficient resources."""
+    insufficient = []
+    alloc = ns.node.status.allocatable
+    allowed = alloc.get(t.PODS, 110)
+    if len(ns.pods) + 1 > allowed:
+        insufficient.append("Too many pods")
+    req = pod.resource_request()
+    interesting = {k: v for k, v in req.items() if k != t.PODS and v > 0}
+    if not interesting:
+        return insufficient
+    used = ns.requested
+    for rname, rq in interesting.items():
+        if rq > alloc.get(rname, 0) - used.get(rname, 0):
+            insufficient.append(f"Insufficient {rname}")
+    return insufficient
+
+
+def least_requested_score(requested: int, capacity: int) -> int:
+    # least_allocated.go:97
+    if capacity == 0:
+        return 0
+    if requested > capacity:
+        return 0
+    return ((capacity - requested) * MAX_NODE_SCORE) // capacity
+
+
+def most_requested_score(requested: int, capacity: int) -> int:
+    if capacity == 0:
+        return 0
+    if requested > capacity:
+        return 0
+    return (requested * MAX_NODE_SCORE) // capacity
+
+
+def fit_score(
+    pod: t.Pod,
+    ns: RefNodeState,
+    strategy: str = "LeastAllocated",
+    resources: tuple[tuple[str, int], ...] = (("cpu", 1), ("memory", 1)),
+) -> int:
+    """resourceAllocationScorer.score with the given strategy
+    (resource_allocation.go:55)."""
+    node_score = 0
+    weight_sum = 0
+    pod_cpu, pod_mem = pod.non_zero_request()
+    pod_req = pod.resource_request()
+    nz_cpu, nz_mem = ns.nonzero_requested
+    for rname, weight in resources:
+        alloc = ns.node.status.allocatable.get(rname, 0)
+        if rname == t.CPU:
+            reqd = nz_cpu + pod_cpu
+        elif rname == t.MEMORY:
+            reqd = nz_mem + pod_mem
+        else:
+            reqd = ns.requested.get(rname, 0) + pod_req.get(rname, 0)
+        if alloc == 0:
+            continue
+        scorer = least_requested_score if strategy == "LeastAllocated" else most_requested_score
+        node_score += scorer(reqd, alloc) * weight
+        weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    return node_score // weight_sum
+
+
+def balanced_allocation_score(
+    pod: t.Pod,
+    ns: RefNodeState,
+    resources: tuple[str, ...] = ("cpu", "memory"),
+) -> int:
+    """balancedResourceScorer (balanced_allocation.go:138): plain Requested."""
+    pod_req = pod.resource_request()
+    used = ns.requested
+    fractions = []
+    for rname in resources:
+        alloc = ns.node.status.allocatable.get(rname, 0)
+        if alloc == 0:
+            continue
+        fr = (used.get(rname, 0) + pod_req.get(rname, 0)) / alloc
+        fractions.append(min(fr, 1.0))
+    if len(fractions) == 2:
+        std = abs(fractions[0] - fractions[1]) / 2
+    elif len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    else:
+        std = 0.0
+    return int((1 - std) * MAX_NODE_SCORE)
+
+
+def taint_toleration_filter(pod: t.Pod, node: t.Node) -> bool:
+    """TaintToleration Filter (tainttoleration/taint_toleration.go:110):
+    every NoSchedule/NoExecute taint must be tolerated."""
+    for taint in node.spec.taints:
+        if taint.effect not in (t.EFFECT_NO_SCHEDULE, t.EFFECT_NO_EXECUTE):
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.spec.tolerations):
+            return False
+    return True
+
+
+def taint_toleration_score_raw(pod: t.Pod, node: t.Node) -> int:
+    """CountIntolerableTaintsPreferNoSchedule (taint_toleration.go:171):
+    the raw per-node count before NormalizeScore inverts it."""
+    n = 0
+    for taint in node.spec.taints:
+        if taint.effect != t.EFFECT_PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.spec.tolerations):
+            n += 1
+    return n
+
+
+def node_affinity_filter(pod: t.Pod, node: t.Node) -> bool:
+    """NodeAffinity Filter: nodeSelector AND required node affinity
+    (nodeaffinity/node_affinity.go:146 + GetRequiredNodeAffinity)."""
+    labels = node.metadata.labels
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity and aff.node_affinity.required:
+        return t.node_selector_matches(aff.node_affinity.required, labels, node.name)
+    return True
+
+
+def node_affinity_score_raw(pod: t.Pod, node: t.Node) -> int:
+    """Sum of matching preferred term weights (node_affinity.go Score)."""
+    aff = pod.spec.affinity
+    if not aff or not aff.node_affinity:
+        return 0
+    total = 0
+    for pref in aff.node_affinity.preferred:
+        if pref.weight and t.node_selector_term_matches(
+            pref.preference, node.metadata.labels, node.name
+        ):
+            total += pref.weight
+    return total
+
+
+def node_ports_filter(pod: t.Pod, existing: list[t.Pod]) -> bool:
+    """NodePorts Filter (nodeports/node_ports.go): no host-port conflicts."""
+    used: set[tuple[str, str, int]] = set()
+    for p in existing:
+        used.update(p.host_ports())
+
+    for proto, ip, port in pod.host_ports():
+        for uproto, uip, uport in used:
+            if proto != uproto or port != uport:
+                continue
+            if ip == uip or ip == "0.0.0.0" or uip == "0.0.0.0":
+                return False
+    return True

@@ -1,0 +1,123 @@
+"""Snapshot builder: interning, row round-trips, incremental flush, growth."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.cache import Cache
+from kubernetes_tpu.intern import InternTable
+from kubernetes_tpu.snapshot import INT_SENTINEL, Schema, SnapshotBuilder
+
+
+def test_schema_growth_buckets():
+    s = Schema()
+    g = s.grown(N=100)
+    assert g.N == 128
+    assert g.R == s.R
+    assert s.grown(N=10) is s  # no-grow returns the same schema object
+
+
+def test_node_row_roundtrip():
+    b = SnapshotBuilder()
+    node = (
+        make_node("n1")
+        .capacity({"cpu": "4", "memory": "8Gi", "pods": 16})
+        .label("zone", "a")
+        .label("size", "64")
+        .taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE)
+        .obj()
+    )
+    b.set_node_row(0, node)
+    h = b.host
+    assert h["valid"][0]
+    assert h["allowed_pods"][0] == 16
+    assert h["alloc"][0, 0] == 4000
+    assert h["alloc"][0, 1] == 8 * 1024**3
+    # Labels interned (hostname + zone + size).
+    assert (h["label_key_ids"][0] >= 0).sum() == 3
+    # "size"=64 parses as int for Gt/Lt; "a" does not.
+    vals = h["label_int_vals"][0]
+    assert 64 in vals
+    assert (vals == INT_SENTINEL).sum() >= 1
+    assert (h["taint_ids"][0] >= 0).sum() == 1
+
+
+def test_scalar_resource_grows_columns():
+    b = SnapshotBuilder()
+    node = make_node("n1").capacity({"cpu": "1", "nvidia.com/gpu": 8}).obj()
+    b.set_node_row(0, node)
+    col = b.res_col["nvidia.com/gpu"]
+    assert col == 3
+    assert b.host["alloc"][0, col] == 8
+
+
+def test_incremental_flush_only_dirty_rows():
+    b = SnapshotBuilder()
+    for i in range(4):
+        b.set_node_row(i, make_node(f"n{i}").capacity({"cpu": "1"}).obj())
+    st = b.state()  # full build
+    assert np.asarray(st.valid)[:4].all()
+    # Dirty one row, flush: device must pick it up via row scatter.
+    b.set_node_row(2, make_node("n2b").capacity({"cpu": "7"}).obj())
+    st2 = b.state()
+    assert np.asarray(st2.alloc)[2, 0] == 7000
+    assert np.asarray(st2.alloc)[1, 0] == 1000
+
+
+def test_pod_delta_apply_and_reverse():
+    b = SnapshotBuilder()
+    b.set_node_row(0, make_node("n").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+    pod = make_pod("p").req({"cpu": "1", "memory": "1Gi"}).label("app", "x").obj()
+    d = b.pod_delta_vectors(pod)
+    b.apply_pod_delta(0, d, +1, device_already=False)
+    assert b.host["req"][0, 0] == 1000
+    assert b.host["num_pods"][0] == 1
+    assert b.host["group_counts"][d["group"], 0] == 1
+    b.apply_pod_delta(0, d, -1, device_already=False)
+    assert b.host["req"][0, 0] == 0
+    assert b.host["num_pods"][0] == 0
+
+
+def test_cache_assume_forget():
+    b = SnapshotBuilder()
+    c = Cache(b)
+    c.add_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+    pod = make_pod("p1").req({"cpu": "2"}).obj()
+    c.assume_pod(pod, "n1", device_already=False)
+    assert b.host["req"][0, 0] == 2000
+    c.forget_pod(pod.uid)
+    assert b.host["req"][0, 0] == 0
+    assert pod.uid not in c.pods
+
+
+def test_cache_node_remove_frees_row():
+    b = SnapshotBuilder()
+    c = Cache(b)
+    c.add_node(make_node("n1").capacity({"cpu": "4"}).obj())
+    c.add_node(make_node("n2").capacity({"cpu": "4"}).obj())
+    c.remove_node("n1")
+    assert not b.host["valid"][0]
+    c.add_node(make_node("n3").capacity({"cpu": "2"}).obj())
+    assert c.row_of("n3") == 0  # reuses the freed row
+    assert b.host["alloc"][0, 0] == 2000
+
+
+def test_node_capacity_growth_preserves_rows():
+    b = SnapshotBuilder()
+    for i in range(100):  # force N growth past the default 64
+        b.set_node_row(i, make_node(f"n{i}").capacity({"cpu": str(i + 1)}).obj())
+    assert b.schema.N == 128
+    assert b.host["alloc"][99, 0] == 100_000
+    assert b.host["alloc"][0, 0] == 1000
+
+
+def test_interning_stable():
+    it = InternTable()
+    a = it.label_pairs.id(("zone", "a"))
+    b_ = it.label_pairs.id(("zone", "b"))
+    assert it.label_pairs.id(("zone", "a")) == a
+    assert a != b_
+    g1 = it.group_id("default", {"app": "web"})
+    g2 = it.group_id("default", {"app": "web"})
+    g3 = it.group_id("other", {"app": "web"})
+    assert g1 == g2 != g3

@@ -1,0 +1,113 @@
+"""Quantity parsing, pod resource computation, scalar selector semantics."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+
+def test_parse_quantity_cpu():
+    assert t.parse_quantity("100m", t.CPU) == 100
+    assert t.parse_quantity("2", t.CPU) == 2000
+    assert t.parse_quantity("1.5", t.CPU) == 1500
+    assert t.parse_quantity(2, t.CPU) == 2000
+
+
+def test_parse_quantity_memory():
+    assert t.parse_quantity("1Gi", t.MEMORY) == 1024**3
+    assert t.parse_quantity("500Mi", t.MEMORY) == 500 * 1024**2
+    assert t.parse_quantity("1G", t.MEMORY) == 10**9
+    assert t.parse_quantity("128", t.MEMORY) == 128
+    # Fractions round up.
+    assert t.parse_quantity("1.5", t.MEMORY) == 2
+
+
+def test_pod_resource_request_containers_sum():
+    pod = make_pod().req({"cpu": "100m", "memory": "1Gi"}).obj()
+    pod.spec.containers.append(
+        t.Container(name="c1", requests={"cpu": 200, "memory": 1024})
+    )
+    req = pod.resource_request()
+    assert req[t.CPU] == 300
+    assert req[t.MEMORY] == 1024**3 + 1024
+
+
+def test_pod_resource_request_init_peak():
+    pod = (
+        make_pod()
+        .req({"cpu": "100m"})
+        .init_req({"cpu": "500m"})
+        .obj()
+    )
+    assert pod.resource_request()[t.CPU] == 500
+
+
+def test_pod_resource_request_sidecar():
+    pod = (
+        make_pod()
+        .req({"cpu": "100m"})
+        .init_req({"cpu": "50m"}, restart_policy=t.RESTART_POLICY_ALWAYS)
+        .obj()
+    )
+    # Sidecar adds to the running total.
+    assert pod.resource_request()[t.CPU] == 150
+
+
+def test_pod_resource_request_overhead():
+    pod = make_pod().req({"cpu": "100m"}).overhead({"cpu": "10m"}).obj()
+    assert pod.resource_request()[t.CPU] == 110
+
+
+def test_non_zero_request_defaults():
+    pod = make_pod().obj()  # no requests at all
+    cpu, mem = pod.non_zero_request()
+    assert cpu == t.DEFAULT_MILLI_CPU_REQUEST
+    assert mem == t.DEFAULT_MEMORY_REQUEST
+
+
+def test_non_zero_request_partial():
+    pod = make_pod().req({"cpu": "250m"}).obj()
+    cpu, mem = pod.non_zero_request()
+    assert cpu == 250
+    assert mem == t.DEFAULT_MEMORY_REQUEST
+
+
+def test_label_selector():
+    sel = t.LabelSelector(match_labels=(("app", "web"),))
+    assert t.label_selector_matches(sel, {"app": "web", "x": "y"})
+    assert not t.label_selector_matches(sel, {"app": "db"})
+    assert not t.label_selector_matches(None, {"app": "web"})
+    # Empty selector matches everything.
+    assert t.label_selector_matches(t.LabelSelector(), {})
+
+
+def test_node_selector_ops():
+    labels = {"zone": "a", "mem": "64"}
+    r = t.NodeSelectorRequirement
+    assert t.node_selector_requirement_matches(r("zone", t.OP_IN, ("a", "b")), labels)
+    assert not t.node_selector_requirement_matches(r("zone", t.OP_IN, ("c",)), labels)
+    assert t.node_selector_requirement_matches(r("zone", t.OP_NOT_IN, ("c",)), labels)
+    assert t.node_selector_requirement_matches(r("missing", t.OP_NOT_IN, ("c",)), labels)
+    assert t.node_selector_requirement_matches(r("zone", t.OP_EXISTS, ()), labels)
+    assert t.node_selector_requirement_matches(r("missing", t.OP_DOES_NOT_EXIST, ()), labels)
+    assert t.node_selector_requirement_matches(r("mem", t.OP_GT, ("32",)), labels)
+    assert not t.node_selector_requirement_matches(r("mem", t.OP_GT, ("64",)), labels)
+    assert t.node_selector_requirement_matches(r("mem", t.OP_LT, ("128",)), labels)
+    # Non-integer values never match Gt/Lt.
+    assert not t.node_selector_requirement_matches(r("zone", t.OP_GT, ("1",)), labels)
+
+
+def test_toleration_tolerates():
+    taint = t.Taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE)
+    assert t.Toleration("dedicated", t.TOLERATION_OP_EQUAL, "gpu").tolerates(taint)
+    assert not t.Toleration("dedicated", t.TOLERATION_OP_EQUAL, "cpu").tolerates(taint)
+    assert t.Toleration("dedicated", t.TOLERATION_OP_EXISTS).tolerates(taint)
+    assert t.Toleration(operator=t.TOLERATION_OP_EXISTS).tolerates(taint)  # empty key + Exists
+    assert not t.Toleration(
+        "dedicated", t.TOLERATION_OP_EXISTS, effect=t.EFFECT_NO_EXECUTE
+    ).tolerates(taint)
+
+
+def test_wrappers_node():
+    node = make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 110}).zone("z1").obj()
+    assert node.status.allocatable[t.CPU] == 4000
+    assert node.metadata.labels["topology.kubernetes.io/zone"] == "z1"
+    assert node.metadata.labels["kubernetes.io/hostname"] == "n1"
