@@ -13,12 +13,7 @@ import numpy as np
 from ..api import types as t
 from ..framework.config import Profile
 from ..ops import common as opcommon
-from ..snapshot import SnapshotBuilder
-
-# Host-port slots per pod in the batch features (pods with more host ports
-# than this are rejected at featurization — the reference has no limit, but
-# >8 distinct host ports on one pod is pathological).
-POD_PORT_SLOTS = 8
+from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder
 
 
 def build_pod_batch(
@@ -45,7 +40,7 @@ def build_pod_batch(
         # apply_pod_delta must apply the *same* delta or the mirrors desync.
         port_triples = np.full(POD_PORT_SLOTS, -1, np.int32)
         port_keys = np.full(POD_PORT_SLOTS, -1, np.int32)
-        for j, (triple, pk, _wild) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
+        for j, (triple, pk) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
             port_triples[j] = triple
             port_keys[j] = pk
         feats = {
@@ -64,14 +59,20 @@ def build_pod_batch(
     if not per_pod:
         raise ValueError("empty pod batch")
 
-    # Stack + pad. Schema growth during featurization means early pods may
-    # have shorter resource vectors than late ones — re-pad to current schema.
-    r = builder.schema.R
-    for feats in per_pod:
-        if feats["req"].shape[0] != r:
-            feats["req"] = np.pad(feats["req"], (0, r - feats["req"].shape[0]))
-
+    # Stack + pad. Schema/vocab growth during featurization means early pods
+    # may have shorter feature arrays than late ones — pad every key to the
+    # per-key max shape with its registered fill (0 for counts, -1 for ids).
     keys = per_pod[-1].keys()
+    for key in keys:
+        shapes = {f[key].shape for f in per_pod}
+        if len(shapes) > 1:
+            target = tuple(max(dims) for dims in zip(*shapes))
+            fill = opcommon.FEATURE_FILLS.get(key, 0)
+            for f in per_pod:
+                a = f[key]
+                if a.shape != target:
+                    pad = [(0, tgt - cur) for cur, tgt in zip(a.shape, target)]
+                    f[key] = np.pad(a, pad, constant_values=fill)
     batch: dict = {}
     for key in keys:
         rows = [f[key] for f in per_pod]

@@ -116,19 +116,20 @@ def build_pass(profile: Profile, schema: Schema, builder_res_col: dict[str, int]
         total = jnp.zeros(schema.N, jnp.int64)
         for op, weight in score_ops:
             if op.score is not None:
-                # Plugin scores are pre-normalized to [0, MaxNodeScore]; the
-                # framework applies the weight (runtime/framework.go:1188).
-                total += op.score(state, pf, ctx) * jnp.int64(weight)
+                # Plugin scores are pre-normalized to [0, MaxNodeScore] over
+                # the feasible set; the framework applies the weight
+                # (runtime/framework.go:1188).
+                total += op.score(state, pf, ctx, feasible) * jnp.int64(weight)
         tie_rand = _hash_u32(
             jnp.uint32(profile.tie_break_seed) * jnp.uint32(2654435761) + step_idx.astype(jnp.uint32)
         )
-        pick, best, m = select_host(feasible, total, tie_rand)
+        pick, best, _ties = select_host(feasible, total, tie_rand)
         do = pf["valid"] & (pick >= 0)
         state = _commit(state, pf, pick, do)
         return state, PassResult(
             picks=jnp.where(pf["valid"], pick, -1),
             scores=best,
-            feasible_counts=m,
+            feasible_counts=jnp.sum(feasible.astype(jnp.int32)),
         )
 
     @jax.jit

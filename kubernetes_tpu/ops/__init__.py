@@ -1,4 +1,4 @@
 from . import common  # noqa: F401
 
 # Importing an op module registers its OpDefs.
-from . import noderesources, trivial  # noqa: F401
+from . import nodeports, noderesources, tainttoleration, trivial  # noqa: F401

@@ -57,10 +57,26 @@ class OpDef:
     name: str
     featurize: Optional[Callable] = None  # (pod, FeaturizeContext) -> dict[str, np.ndarray]
     filter: Optional[Callable] = None  # (state, pf, PassContext) -> (N,) bool
-    score: Optional[Callable] = None  # (state, pf, PassContext) -> (N,) i64
+    # (state, pf, PassContext, feasible (N,) bool) -> (N,) i64 in
+    # [0, MAX_NODE_SCORE].  `feasible` is the post-filter mask: the reference
+    # scores (and normalizes over) only nodes that passed Filter
+    # (schedule_one.go:755 prioritizeNodes runs on `feasibleNodes`).
+    score: Optional[Callable] = None
     # Trace-time config resolver: (profile, schema, builder_res_col) -> dict,
     # merged into PassContext.static under this op's keys.
     static: Optional[Callable] = None
+
+
+from ..snapshot import POD_PORT_SLOTS  # noqa: F401  (re-export for ops)
+
+# Pad fill per feature key when featurization grows the schema mid-batch and
+# early pods' arrays are shorter than the final schema shape (0 is correct for
+# counts/requests; id slots pad with -1 = "empty").
+FEATURE_FILLS: dict[str, int] = {}
+
+
+def feature_fill(key: str, fill: int) -> None:
+    FEATURE_FILLS[key] = fill
 
 
 _REGISTRY: dict[str, OpDef] = {}

@@ -1,0 +1,31 @@
+"""Shared jax helpers for vectorized score ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.config import MAX_NODE_SCORE
+
+
+def default_normalize_score(raw: jnp.ndarray, feasible: jnp.ndarray, reverse: bool) -> jnp.ndarray:
+    """Vectorized DefaultNormalizeScore (plugins/helper/normalize_score.go):
+    rescale raw scores by the max over *feasible* nodes to [0, MaxNodeScore];
+    with ``reverse`` higher raw scores map to lower results.  maxCount == 0
+    short-circuits (all MaxNodeScore when reversed, all 0 otherwise)."""
+    raw = raw.astype(jnp.int64)
+    max_count = jnp.max(jnp.where(feasible, raw, 0))
+    safe_max = jnp.maximum(max_count, 1)
+    scaled = raw * MAX_NODE_SCORE // safe_max
+    if reverse:
+        scaled = MAX_NODE_SCORE - scaled
+        return jnp.where(max_count == 0, MAX_NODE_SCORE, scaled)
+    return jnp.where(max_count == 0, 0, scaled)
+
+
+def gather_mask(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """table[ids] with -1-padded ids contributing False/0.
+
+    ``table`` is a per-pod vocabulary mask (V,); ``ids`` node slot ids (N, S).
+    """
+    safe = jnp.maximum(ids, 0)
+    return jnp.where(ids >= 0, table[safe], jnp.zeros((), table.dtype))

@@ -1,0 +1,66 @@
+"""NodePorts, vectorized.
+
+Reference (plugins/nodeports/node_ports.go): a pod with hostPort requests is
+infeasible on a node where any wanted (hostIP, protocol, hostPort) conflicts
+with a port already in use (HostPortInfo.CheckConflict,
+framework/types.go): a wildcard-IP want conflicts with any same
+(protocol, port) use; a specific-IP want conflicts with the same triple or a
+wildcard-IP use of the same (protocol, port).
+
+TPU design: the snapshot keeps per-node usage counts keyed by interned port
+ids — ``port_counts`` rows for exact (proto, ip, port) triples and
+``portkey_counts`` rows for (proto, *, port) — so the filter is a handful of
+row gathers compared against zero.  The engine's base features already carry
+the pod's port ids (they double as commit deltas); this op adds the wildcard
+triple for the specific-IP conflict rule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import types as t
+from .common import (
+    POD_PORT_SLOTS,
+    FeaturizeContext,
+    OpDef,
+    PassContext,
+    feature_fill,
+    register,
+)
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    # Recompute the interned ids (cheap dict hits — the ids were already
+    # interned by the engine's pod_delta_vectors call for this pod).
+    wild_triples = np.full(POD_PORT_SLOTS, -1, np.int32)
+    is_wild = np.zeros(POD_PORT_SLOTS, np.bool_)
+    ports = fctx.interns.ports
+    for j, (proto, ip, port) in enumerate(pod.host_ports()[:POD_PORT_SLOTS]):
+        wild_triples[j] = ports.id((proto, "0.0.0.0", port))
+        is_wild[j] = ip == "0.0.0.0"
+    return {"port_wild_triples": wild_triples, "port_is_wild": is_wild}
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    import jax.numpy as jnp
+
+    triples = pf["port_triples"]  # (S,) -1 pad
+    keys = pf["port_keys"]
+    wilds = pf["port_wild_triples"]
+    is_wild = pf["port_is_wild"]
+    active = triples >= 0
+    # (S, N) usage counts for each wanted port.
+    exact = state.port_counts[jnp.maximum(triples, 0)]
+    wild_use = state.port_counts[jnp.maximum(wilds, 0)]
+    any_ip = state.portkey_counts[jnp.maximum(keys, 0)]
+    # Wildcard want: conflicts with any same (proto, port) use.
+    # Specific want: conflicts with same triple or wildcard-IP use.
+    conflict = jnp.where(is_wild[:, None], any_ip > 0, (exact > 0) | (wild_use > 0))
+    return ~(conflict & active[:, None]).any(axis=0)
+
+
+feature_fill("port_wild_triples", -1)
+feature_fill("port_triples", -1)
+feature_fill("port_keys", -1)
+register(OpDef(name="NodePorts", featurize=featurize, filter=filter_fn))

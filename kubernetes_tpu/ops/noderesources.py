@@ -113,7 +113,7 @@ def _ratio_scorer(shape):
     return f
 
 
-def score_fn(state, pf, ctx: PassContext):
+def score_fn(state, pf, ctx: PassContext, feasible=None):
     cols = ctx.static["fit_strategy_cols"]
     strat = ctx.profile.scoring_strategy.type
     if strat == REQUESTED_TO_CAPACITY_RATIO:
@@ -134,7 +134,7 @@ def score_fn(state, pf, ctx: PassContext):
     return jnp.where(weight_sum > 0, node_score // jnp.maximum(weight_sum, 1), 0)
 
 
-def balanced_score_fn(state, pf, ctx: PassContext):
+def balanced_score_fn(state, pf, ctx: PassContext, feasible=None):
     """balancedResourceScorer: fractions of Requested/Allocatable (capped at
     1), score = (1 − std) * MaxNodeScore.  Uses plain Requested (useRequested,
     balanced_allocation.go:135) — no nonzero defaults."""

@@ -1,0 +1,67 @@
+"""TaintToleration, vectorized.
+
+Reference (plugins/tainttoleration/taint_toleration.go):
+  * Filter (:110): node is infeasible if it has any NoSchedule/NoExecute
+    taint the pod does not tolerate (FindMatchingUntoleratedTaint with
+    DoNotScheduleTaintsFilterFunc).
+  * Score (:171): count of PreferNoSchedule taints not tolerated by the
+    pod's PreferNoSchedule-effect tolerations; NormalizeScore reverses
+    (DefaultNormalizeScore(MaxNodeScore, true)).
+
+TPU design: taints are interned host-side into a (key, value, effect) vocab;
+node rows carry taint-id slots.  Featurization evaluates the pod's tolerations
+against the whole vocabulary once, producing two (TV,) bitmasks; the device
+filter/score is then two gathers — no string ops on device, and the work is
+O(vocab) per pod instead of O(nodes × taints).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import types as t
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import default_normalize_score, gather_mask
+
+_DO_NOT_SCHEDULE = (t.EFFECT_NO_SCHEDULE, t.EFFECT_NO_EXECUTE)
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    it = fctx.interns
+    builder = fctx.builder
+    builder._ensure(TV=max(len(it.taints), 1))
+    tv = builder.schema.TV
+    intol_hard = np.zeros(tv, np.bool_)
+    intol_pref = np.zeros(tv, np.bool_)
+    tols = pod.spec.tolerations
+    # getAllTolerationPreferNoSchedule (taint_toleration.go:143): only
+    # empty-effect / PreferNoSchedule tolerations count for scoring.
+    pref_tols = tuple(
+        tol for tol in tols if not tol.effect or tol.effect == t.EFFECT_PREFER_NO_SCHEDULE
+    )
+    for tid in range(len(it.taints)):
+        key, value, effect = it.taints.value(tid)  # type: ignore[misc]
+        taint = t.Taint(key, value, effect)
+        if effect in _DO_NOT_SCHEDULE:
+            intol_hard[tid] = not any(tol.tolerates(taint) for tol in tols)
+        elif effect == t.EFFECT_PREFER_NO_SCHEDULE:
+            intol_pref[tid] = not any(tol.tolerates(taint) for tol in pref_tols)
+    return {"taint_intol_hard": intol_hard, "taint_intol_pref": intol_pref}
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    return ~gather_mask(pf["taint_intol_hard"], state.taint_ids).any(axis=1)
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    import jax.numpy as jnp
+
+    count = gather_mask(pf["taint_intol_pref"], state.taint_ids).astype(jnp.int64).sum(axis=1)
+    return default_normalize_score(count, feasible, reverse=True)
+
+
+feature_fill("taint_intol_hard", 0)
+feature_fill("taint_intol_pref", 0)
+register(
+    OpDef(name="TaintToleration", featurize=featurize, filter=filter_fn, score=score_fn)
+)

@@ -33,6 +33,11 @@ from .intern import InternTable
 # Sentinel for "label value is not an integer" (Gt/Lt operators).
 INT_SENTINEL = np.int64(-(2**62))
 
+# Host-port slots per pod in the batch features.  The reference has no limit,
+# but the device commit needs a static shape; >8 distinct host ports on one
+# pod is pathological, and such pods are rejected at delta time.
+POD_PORT_SLOTS = 8
+
 # Fixed resource columns; scalar/extended resources are interned after these.
 RES_CPU, RES_MEMORY, RES_EPHEMERAL = 0, 1, 2
 FIXED_RESOURCES = (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
@@ -54,6 +59,7 @@ class Schema:
     R: int = 4  # resource columns (fixed 3 + scalars)
     LS: int = 16  # label slots per node
     TS: int = 8  # taint slots per node
+    TV: int = 8  # taint vocabulary size (pod intolerable-taint bitmasks)
     TK: int = 4  # topology-key slots
     G: int = 8  # pod label-group rows
     AT: int = 8  # existing-pod required-anti-affinity term rows
@@ -297,11 +303,7 @@ class SnapshotBuilder:
 
     def clear_node_row(self, row: int) -> None:
         h = self.host
-        for k, a in _host_arrays(Schema(N=1, R=self.schema.R, LS=self.schema.LS,
-                                        TS=self.schema.TS, TK=self.schema.TK,
-                                        G=self.schema.G, AT=self.schema.AT,
-                                        P=self.schema.P, PK=self.schema.PK,
-                                        IM=self.schema.IM)).items():
+        for k, a in _host_arrays(dataclasses.replace(self.schema, N=1)).items():
             if _NODE_AXIS[k] == 0:
                 h[k][row] = a[0]
             else:
@@ -322,14 +324,19 @@ class SnapshotBuilder:
         gid = self.interns.group_id(pod.namespace, pod.metadata.labels)
         self._ensure(G=gid + 1)
         host_ports = pod.host_ports()
-        assert len(host_ports) <= 8, f"pod {pod.uid} has {len(host_ports)} host ports (max 8)"
+        if len(host_ports) > POD_PORT_SLOTS:
+            raise ValueError(
+                f"pod {pod.uid} has {len(host_ports)} host ports (max {POD_PORT_SLOTS})"
+            )
         ports = []
         for proto, ip, port in host_ports:
             triple = self.interns.ports.id((proto, ip, port))
+            # Intern the wildcard triple too so P covers it (NodePorts' filter
+            # gathers it for the specific-IP conflict rule).
             wild = self.interns.ports.id((proto, "0.0.0.0", port))
             pk = self.interns.ports.id((proto, None, port))  # key-level row
             self._ensure(P=max(triple, wild) + 1, PK=pk + 1)
-            ports.append((triple, pk, ip == "0.0.0.0"))
+            ports.append((triple, pk))
         return {
             "req": req_vec,
             "nonzero": np.array([cpu, mem], np.int64),
@@ -350,7 +357,7 @@ class SnapshotBuilder:
         h["nonzero_req"][row] += sign * delta["nonzero"]
         h["num_pods"][row] += sign
         h["group_counts"][delta["group"], row] += sign
-        for triple, pk, _ in delta["ports"]:
+        for triple, pk in delta["ports"]:
             h["port_counts"][triple, row] += sign
             h["portkey_counts"][pk, row] += sign
         for at_id in delta.get("anti_terms", ()):

@@ -1,0 +1,205 @@
+"""TaintToleration + NodePorts vectorized ops vs scalar reference semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import (
+    taint_toleration_filter,
+    taint_toleration_score_raw,
+    node_ports_filter,
+)
+
+
+def taint_profile():
+    return Profile(
+        name="taints",
+        filters=("TaintToleration",),
+        scorers=(("TaintToleration", 3),),
+    )
+
+
+def ports_profile():
+    return Profile(name="ports", filters=("NodePorts", "NodeResourcesFit"), scorers=())
+
+
+def test_untolerated_noschedule_taint_filters_node():
+    s = TPUScheduler(profile=taint_profile(), batch_size=8)
+    s.add_node(make_node("tainted").capacity({"cpu": "4", "pods": 110}).taint("dedicated", "gpu").obj())
+    s.add_node(make_node("clean").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "clean"
+
+
+def test_toleration_admits_tainted_node():
+    s = TPUScheduler(profile=taint_profile(), batch_size=8)
+    s.add_node(make_node("tainted").capacity({"cpu": "4", "pods": 110}).taint("dedicated", "gpu").obj())
+    s.add_pod(
+        make_pod("p")
+        .req({"cpu": "1"})
+        .toleration(key="dedicated", value="gpu", effect=t.EFFECT_NO_SCHEDULE)
+        .obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "tainted"
+
+
+def test_exists_toleration_any_effect():
+    s = TPUScheduler(profile=taint_profile(), batch_size=8)
+    s.add_node(
+        make_node("t1").capacity({"cpu": "4", "pods": 110})
+        .taint("k1", "v1", t.EFFECT_NO_EXECUTE).obj()
+    )
+    s.add_pod(make_pod("p").req({"cpu": "1"}).toleration(key="k1", op=t.TOLERATION_OP_EXISTS).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "t1"
+
+
+def test_prefer_no_schedule_scores_lower():
+    """Node with an intolerable PreferNoSchedule taint loses to a clean one."""
+    s = TPUScheduler(profile=taint_profile(), batch_size=8)
+    s.add_node(
+        make_node("soft-tainted").capacity({"cpu": "4", "pods": 110})
+        .taint("soft", "x", t.EFFECT_PREFER_NO_SCHEDULE).obj()
+    )
+    s.add_node(make_node("clean").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "clean"
+
+
+def test_taint_filter_matches_reference_randomized():
+    rng = np.random.default_rng(7)
+    effects = [t.EFFECT_NO_SCHEDULE, t.EFFECT_NO_EXECUTE, t.EFFECT_PREFER_NO_SCHEDULE]
+    nodes = []
+    for i in range(24):
+        w = make_node(f"n{i}").capacity({"cpu": "64", "pods": 110})
+        for j in range(int(rng.integers(0, 4))):
+            w = w.taint(f"k{rng.integers(0, 5)}", f"v{rng.integers(0, 3)}", effects[int(rng.integers(0, 3))])
+        nodes.append(w.obj())
+
+    pods = []
+    for i in range(30):
+        w = make_pod(f"p{i}").req({"cpu": "1m"})
+        for j in range(int(rng.integers(0, 4))):
+            op = t.TOLERATION_OP_EXISTS if rng.integers(0, 2) else t.TOLERATION_OP_EQUAL
+            eff = "" if rng.integers(0, 3) == 0 else effects[int(rng.integers(0, 3))]
+            w = w.toleration(key=f"k{rng.integers(0, 5)}", op=op, value=f"v{rng.integers(0, 3)}", effect=eff)
+        pods.append(w.obj())
+
+    s = TPUScheduler(profile=taint_profile(), batch_size=32)
+    for n in nodes:
+        s.add_node(n)
+    for p in pods:
+        s.add_pod(p)
+    out = {o.pod.name: o for o in s.schedule_all_pending()}
+
+    for p in pods:
+        feas_ref = [n for n in nodes if taint_toleration_filter(p, n)]
+        o = out[p.name]
+        assert (o.node_name is not None) == bool(feas_ref), p.name
+        assert o.feasible_nodes == len(feas_ref), (p.name, o.feasible_nodes, len(feas_ref))
+        if feas_ref:
+            # winner must be among the reference's min-intolerable-count nodes
+            # (weight 3 × normalized reverse score → max total ⇔ min raw count).
+            counts = {n.name: taint_toleration_score_raw(p, n) for n in feas_ref}
+            best = min(counts.values())
+            assert counts[o.node_name] == best, (p.name, o.node_name, counts)
+
+
+def test_host_port_conflict():
+    s = TPUScheduler(profile=ports_profile(), batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).host_port(8080).obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).host_port(8080).obj())
+    s.add_pod(make_pod("p3").req({"cpu": "1"}).host_port(8080).obj())
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    # Two nodes, one 8080 each; third pod unschedulable.
+    assert {out["p1"], out["p2"]} == {"n1", "n2"}
+    assert out["p3"] is None
+
+
+def test_host_port_wildcard_vs_specific_ip():
+    s = TPUScheduler(profile=ports_profile(), batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    # Specific-IP use of 9090.
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).host_port(9090, host_ip="10.0.0.1").obj())
+    out1 = s.schedule_all_pending()
+    assert out1[0].node_name == "n1"
+    # A different specific IP does not conflict.
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).host_port(9090, host_ip="10.0.0.2").obj())
+    out2 = s.schedule_all_pending()
+    assert out2[0].node_name == "n1"
+    # A wildcard use conflicts with any same (proto, port).
+    s.add_pod(make_pod("p3").req({"cpu": "1"}).host_port(9090).obj())
+    out3 = s.schedule_all_pending()
+    assert out3[0].node_name is None
+
+
+def test_different_protocols_do_not_conflict():
+    s = TPUScheduler(profile=ports_profile(), batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).host_port(53, protocol="UDP").obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).host_port(53, protocol="TCP").obj())
+    out = [o.node_name for o in s.schedule_all_pending()]
+    assert out == ["n1", "n1"]
+
+
+def test_ports_match_reference_randomized():
+    rng = np.random.default_rng(11)
+    nodes = [make_node(f"n{i}").capacity({"cpu": "64", "pods": 110}).obj() for i in range(6)]
+    pods = []
+    for i in range(40):
+        w = make_pod(f"p{i}").req({"cpu": "1m"})
+        for _ in range(int(rng.integers(0, 3))):
+            ip = ["", "10.0.0.1", "10.0.0.2"][int(rng.integers(0, 3))]
+            w = w.host_port(int(rng.integers(8000, 8004)), host_ip=ip)
+        pods.append(w.obj())
+
+    s = TPUScheduler(profile=ports_profile(), batch_size=64)
+    for n in nodes:
+        s.add_node(n)
+    for p in pods:
+        s.add_pod(p)
+    got = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+
+    # Replay sequentially with the scalar oracle, honoring the device's picks
+    # (decisions interact through committed state; verify each pick was legal
+    # and that "unschedulable" pods truly had no feasible node).
+    on_node: dict[str, list] = {n.name: [] for n in nodes}
+    for p in pods:
+        pick = got[p.name]
+        feas = [n.name for n in nodes if node_ports_filter(p, on_node[n.name])]
+        if pick is None:
+            assert not feas, (p.name, feas)
+        else:
+            assert pick in feas, (p.name, pick, feas)
+            on_node[pick].append(p)
+
+
+def test_mirror_consistency_with_ports_and_taints():
+    s = TPUScheduler(
+        profile=Profile(
+            name="mix",
+            filters=("NodeResourcesFit", "TaintToleration", "NodePorts"),
+            scorers=(("NodeResourcesFit", 1), ("TaintToleration", 3)),
+        ),
+        batch_size=16,
+    )
+    for i in range(4):
+        w = make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 64})
+        if i % 2:
+            w = w.taint("soft", "x", t.EFFECT_PREFER_NO_SCHEDULE)
+        s.add_node(w.obj())
+    for i in range(12):
+        w = make_pod(f"p{i}").req({"cpu": "500m"})
+        if i % 3 == 0:
+            w = w.host_port(7000 + i)
+        s.add_pod(w.obj())
+    s.schedule_all_pending()
+    assert s.builder.host_mirror_equal()
