@@ -90,6 +90,10 @@ class InternTable:
     def topo_value_id(self, key: str, value: str) -> int:
         return self.topo_vals[self.topo_key_slot(key)].id(value)
 
+    def max_topo_vocab(self) -> int:
+        """Largest per-key domain vocabulary (drives Schema.DV)."""
+        return max((len(v) for v in self.topo_vals), default=0)
+
     def group_id(self, namespace: str, labels: dict[str, str]) -> int:
         """Pod label-group id: pods with identical (namespace, labels) share a
         group.  Affinity/spread counting then becomes per-group arithmetic —

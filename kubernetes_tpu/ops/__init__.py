@@ -1,4 +1,11 @@
 from . import common  # noqa: F401
 
 # Importing an op module registers its OpDefs.
-from . import nodeports, noderesources, tainttoleration, trivial  # noqa: F401
+from . import (  # noqa: F401
+    nodeaffinity,
+    nodeports,
+    noderesources,
+    podtopologyspread,
+    tainttoleration,
+    trivial,
+)

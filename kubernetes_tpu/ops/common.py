@@ -8,8 +8,9 @@ an op contributes
   featurize(pod, fctx) → per-pod feature dict (host, numpy; stacked over the
       batch by the engine; every value must have a schema-static shape), and
   filter(state, pf, ctx)  → (N,) bool feasibility over all node rows at once,
-  score(state, pf, ctx)   → (N,) int64 in [0, MAX_NODE_SCORE] (already
-      normalized — the engine applies the plugin weight and sums),
+  score(state, pf, ctx, feasible) → (N,) int64 in [0, MAX_NODE_SCORE]
+      (already normalized over the post-filter ``feasible`` mask — the
+      engine applies the plugin weight and sums),
 
 where `pf` is the batch feature dict sliced to one pod by `lax.scan`.  Ops are
 pure jax; everything dynamic about the cluster lives in ClusterState, and

@@ -1,0 +1,229 @@
+"""NodeAffinity, vectorized.
+
+Reference (plugins/nodeaffinity/node_affinity.go):
+  * Filter (:146): pod.Spec.NodeSelector (map, ANDed) AND required node
+    affinity (`nodeaffinity.GetRequiredNodeAffinity`,
+    component-helpers/scheduling/corev1/nodeaffinity/nodeaffinity.go):
+    a NodeSelector is an OR of terms; a term is an AND of matchExpressions +
+    matchFields; operators In/NotIn/Exists/DoesNotExist/Gt/Lt; the only
+    supported field is metadata.name.
+  * Score: sum of weights of matching preferredDuringScheduling terms,
+    then DefaultNormalizeScore (not reversed).
+
+TPU design: featurization compiles the pod's selector into a *requirement
+program* — dense (T, Q) opcode/key tensors plus (T, Q, V) value-id tensors,
+bucketed to powers of two so XLA sees few distinct shapes — and the device
+evaluates every requirement against every node's interned label slots in one
+broadcast (string matching became integer equality at intern time).  In/NotIn
+compare (key, value) pair ids; Exists/DoesNotExist compare key ids; Gt/Lt use
+the pre-parsed per-slot integer label values; name ops compare node-name ids.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from ..snapshot import INT_SENTINEL, _bucket
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import default_normalize_score
+
+# Requirement opcodes. Pad slots are OP_PAD and evaluate True (AND identity).
+OP_PAD = -1
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_NOT_EXISTS = 3
+OP_GT = 4
+OP_LT = 5
+OP_NAME_IN = 6
+OP_NAME_NOT_IN = 7
+OP_FALSE = 8  # unsupported field/operator or unparseable Gt/Lt operand
+
+_OPCODE = {
+    t.OP_IN: OP_IN,
+    t.OP_NOT_IN: OP_NOT_IN,
+    t.OP_EXISTS: OP_EXISTS,
+    t.OP_DOES_NOT_EXIST: OP_NOT_EXISTS,
+    t.OP_GT: OP_GT,
+    t.OP_LT: OP_LT,
+}
+
+
+class _Program:
+    """Mutable builder for a (T, Q, V) requirement program."""
+
+    def __init__(self) -> None:
+        self.terms: list[list[tuple[int, int, list[int], int]]] = []  # op,key,vals,int
+
+    def add_term(self, term: t.NodeSelectorTerm, it) -> None:
+        """Compile one NodeSelectorTerm; empty terms match nothing
+        (nodeaffinity.go nodeSelectorTermsMatch skips them)."""
+        reqs: list[tuple[int, int, list[int], int]] = []
+        for r in term.match_expressions:
+            op = _OPCODE.get(r.operator, None)
+            if op is None:
+                reqs.append((OP_FALSE, -1, [], 0))
+                continue
+            key_id = it.label_keys.id(r.key)
+            if op in (OP_IN, OP_NOT_IN):
+                vals = [it.label_pairs.id((r.key, v)) for v in r.values]
+                reqs.append((op, key_id, vals, 0))
+            elif op in (OP_GT, OP_LT):
+                if len(r.values) != 1:
+                    reqs.append((OP_FALSE, -1, [], 0))
+                    continue
+                try:
+                    rhs = int(r.values[0])
+                except ValueError:
+                    reqs.append((OP_FALSE, -1, [], 0))
+                    continue
+                reqs.append((op, key_id, [], rhs))
+            else:
+                reqs.append((op, key_id, [], 0))
+        for r in term.match_fields:
+            # Only metadata.name is a valid field selector.
+            if r.key != "metadata.name" or r.operator not in (t.OP_IN, t.OP_NOT_IN):
+                reqs.append((OP_FALSE, -1, [], 0))
+                continue
+            op = OP_NAME_IN if r.operator == t.OP_IN else OP_NAME_NOT_IN
+            # Unknown node names intern fine; they simply match no live row.
+            vals = [it.node_names.id(v) for v in r.values]
+            reqs.append((op, -1, vals, 0))
+        if reqs:
+            self.terms.append(reqs)
+
+    def tensors(self, prefix: str) -> dict:
+        tdim = _bucket(max(len(self.terms), 1), 1)
+        qdim = _bucket(max((len(te) for te in self.terms), default=1) or 1, 1)
+        vdim = _bucket(
+            max((len(v) for te in self.terms for _, _, v, _ in te), default=1) or 1, 1
+        )
+        ops = np.full((tdim, qdim), OP_PAD, np.int32)
+        keys = np.full((tdim, qdim), -1, np.int32)
+        vals = np.full((tdim, qdim, vdim), -1, np.int32)
+        ints = np.zeros((tdim, qdim), np.int64)
+        valid = np.zeros(tdim, np.bool_)
+        for ti, te in enumerate(self.terms):
+            valid[ti] = True
+            for qi, (op, key, vlist, rhs) in enumerate(te):
+                ops[ti, qi] = op
+                keys[ti, qi] = key
+                vals[ti, qi, : len(vlist)] = vlist
+                ints[ti, qi] = rhs
+        return {
+            f"{prefix}_op": ops,
+            f"{prefix}_key": keys,
+            f"{prefix}_vals": vals,
+            f"{prefix}_int": ints,
+            f"{prefix}_term_valid": valid,
+        }
+
+
+def _eval_terms(state, ops, keys, vals, ints):
+    """Evaluate a requirement program on every node: (T, N) term matches."""
+    lk = state.label_key_ids  # (N, LS)
+    lp = state.label_pair_ids  # (N, LS)
+    li = state.label_int_vals  # (N, LS)
+    keymatch = lk[None, None, :, :] == keys[:, :, None, None]  # (T, Q, N, LS)
+    has_key = keymatch.any(-1)  # (T, Q, N)
+    pair_hit = (lp[None, None, None, :, :] == vals[:, :, :, None, None]) & (
+        vals >= 0
+    )[:, :, :, None, None]
+    pair_any = pair_hit.any((-1, -3))  # over LS and V → (T, Q, N)
+    # Per-slot int label value; exactly one slot holds a given key, so a
+    # masked sum extracts it (INT_SENTINEL marks non-integer values).
+    label_int = jnp.sum(jnp.where(keymatch, li[None, None, :, :], 0), axis=-1)
+    int_ok = has_key & (label_int != INT_SENTINEL)
+    name_hit = (state.name_id[None, None, None, :] == vals[:, :, :, None]) & (
+        vals >= 0
+    )[:, :, :, None]
+    name_any = name_hit.any(-2)  # over V → (T, Q, N)
+
+    op = ops[:, :, None]
+    result = jnp.where(op == OP_IN, pair_any, True)
+    result &= jnp.where(op == OP_NOT_IN, ~pair_any, True)
+    result &= jnp.where(op == OP_EXISTS, has_key, True)
+    result &= jnp.where(op == OP_NOT_EXISTS, ~has_key, True)
+    result &= jnp.where(op == OP_GT, int_ok & (label_int > ints[:, :, None]), True)
+    result &= jnp.where(op == OP_LT, int_ok & (label_int < ints[:, :, None]), True)
+    result &= jnp.where(op == OP_NAME_IN, name_any, True)
+    result &= jnp.where(op == OP_NAME_NOT_IN, ~name_any, True)
+    result = jnp.where(op == OP_FALSE, False, result)
+    return result.all(axis=1)  # AND over Q → (T, N)
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    it = fctx.interns
+    # spec.nodeSelector map: every (k, v) pair must be present on the node.
+    sel_pairs = [it.label_pairs.id((k, v)) for k, v in sorted(pod.spec.node_selector.items())]
+    sdim = _bucket(max(len(sel_pairs), 1), 1)
+    sel = np.full(sdim, -1, np.int32)
+    sel[: len(sel_pairs)] = sel_pairs
+
+    aff = pod.spec.affinity
+    na = aff.node_affinity if aff else None
+    req_prog = _Program()
+    has_required = False
+    if na and na.required is not None:
+        has_required = True
+        for term in na.required.terms:
+            req_prog.add_term(term, it)
+    pref_prog = _Program()
+    weights: list[int] = []
+    if na:
+        for p in na.preferred:
+            before = len(pref_prog.terms)
+            pref_prog.add_term(p.preference, it)
+            if len(pref_prog.terms) > before:
+                weights.append(p.weight)
+    feats = {"na_sel_pairs": sel, "na_has_required": np.bool_(has_required)}
+    feats.update(req_prog.tensors("na_req"))
+    pref = pref_prog.tensors("na_pref")
+    w = np.zeros(pref["na_pref_term_valid"].shape[0], np.int64)
+    w[: len(weights)] = weights
+    pref["na_pref_weight"] = w
+    del pref["na_pref_term_valid"]  # weight 0 already neutralizes pad terms
+    feats.update(pref)
+    return feats
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    lp = state.label_pair_ids  # (N, LS)
+    sel = pf["na_sel_pairs"]  # (S,)
+    sel_hit = (lp[None, :, :] == sel[:, None, None]).any(-1)  # (S, N)
+    sel_ok = (sel_hit | (sel < 0)[:, None]).all(0)  # pads auto-pass
+
+    term_match = _eval_terms(
+        state, pf["na_req_op"], pf["na_req_key"], pf["na_req_vals"], pf["na_req_int"]
+    )
+    any_term = (term_match & pf["na_req_term_valid"][:, None]).any(0)
+    affinity_ok = jnp.where(pf["na_has_required"], any_term, True)
+    return sel_ok & affinity_ok
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    term_match = _eval_terms(
+        state, pf["na_pref_op"], pf["na_pref_key"], pf["na_pref_vals"], pf["na_pref_int"]
+    )
+    raw = jnp.sum(term_match * pf["na_pref_weight"][:, None], axis=0)
+    return default_normalize_score(raw, feasible, reverse=False)
+
+
+for _k, _fill in [
+    ("na_sel_pairs", -1),
+    ("na_req_op", OP_PAD),
+    ("na_req_key", -1),
+    ("na_req_vals", -1),
+    ("na_req_int", 0),
+    ("na_req_term_valid", 0),
+    ("na_pref_op", OP_PAD),
+    ("na_pref_key", -1),
+    ("na_pref_vals", -1),
+    ("na_pref_int", 0),
+    ("na_pref_weight", 0),
+]:
+    feature_fill(_k, _fill)
+
+register(OpDef(name="NodeAffinity", featurize=featurize, filter=filter_fn, score=score_fn))

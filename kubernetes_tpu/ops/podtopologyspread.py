@@ -1,0 +1,259 @@
+"""PodTopologySpread, vectorized.
+
+Reference (plugins/podtopologyspread/):
+  * Filter (filtering.go:283): for each DoNotSchedule constraint, the
+    candidate node must carry the topology key, and
+    ``matchNum + selfMatch − minMatchNum ≤ maxSkew`` where matchNum counts
+    selector-matching pods in the candidate's topology domain and minMatchNum
+    is the global minimum over existing domains (0 if fewer than minDomains
+    domains exist; MaxInt32 when no eligible domain exists —
+    newCriticalPaths, filtering.go:113).
+  * Score (scoring.go): per ScheduleAnyway constraint, a node is credited
+    ``cnt × log(topoSize+2) + (maxSkew−1)`` (scoreForCount :318) where cnt is
+    the domain's matching-pod count (per-node count for the hostname key,
+    :254); nodes missing a topology key are "ignored" → score 0; the final
+    normalization maps to ``100 × (max + min − s) / max`` (:276).
+  * Domain counting eligibility (filtering.go:262 processNode): nodes must
+    carry all constraint topology keys, and per-constraint node inclusion
+    policies apply (nodeAffinityPolicy Honor → pod's nodeSelector/required
+    affinity; nodeTaintsPolicy Honor → pod tolerates the node's
+    hard taints; defaults Honor/Ignore).
+
+TPU design: pods with identical (namespace, labels) share an interned *group*;
+the cluster state keeps per-(group, node) pod counts.  A constraint's selector
+is compiled host-side to a (G,) group bitmask, so per-node matching-pod counts
+are one f32 matmul ``(C,G) × (G,N)`` on the MXU.  Domains are interned
+topology-value ids; per-domain sums/minima are segment reductions into a
+(DV,)-bucketed table, gathered back per node.  Node-inclusion policies reuse
+the NodeAffinity and TaintToleration ops' device filters on the same pod
+features.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from ..framework.config import MAX_NODE_SCORE
+from ..snapshot import _bucket
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from . import nodeaffinity, tainttoleration
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+MAX_INT32 = np.int64(2**31 - 1)
+
+
+def groups_matching(it, g_cap: int, ns_ids: set[int] | None, selector) -> np.ndarray:
+    """(G,) bitmask of pod label-groups matched by ``selector`` within the
+    given namespace-id set (None = any namespace).  The host-side analog of
+    countPodsMatchSelector (podtopologyspread/common.go)."""
+    mask = np.zeros(g_cap, np.bool_)
+    for gid in range(len(it.groups)):
+        ns_id, fs = it.groups.value(gid)  # type: ignore[misc]
+        if ns_ids is not None and ns_id not in ns_ids:
+            continue
+        if t.label_selector_matches(selector, dict(fs)):
+            mask[gid] = True
+    return mask
+
+
+def _constraint_feats(
+    constraints, pod: t.Pod, fctx: FeaturizeContext, prefix: str
+) -> dict:
+    it = fctx.interns
+    builder = fctx.builder
+    cdim = _bucket(max(len(constraints), 1), 1)
+    ns_id = it.namespaces.id(pod.namespace)
+    slots = np.zeros(cdim, np.int32)
+    skew = np.ones(cdim, np.int32)
+    mindom = np.ones(cdim, np.int32)
+    selfm = np.zeros(cdim, np.bool_)
+    hostname = np.zeros(cdim, np.bool_)
+    honor_aff = np.zeros(cdim, np.bool_)
+    honor_taint = np.zeros(cdim, np.bool_)
+    valid = np.zeros(cdim, np.bool_)
+    masks = np.zeros((cdim, builder.schema.G), np.bool_)
+    for i, c in enumerate(constraints):
+        slot = builder.ensure_topo_key(c.topology_key)
+        valid[i] = True
+        slots[i] = slot
+        skew[i] = c.max_skew
+        mindom[i] = c.min_domains or 1
+        selfm[i] = t.label_selector_matches(c.label_selector, pod.metadata.labels)
+        hostname[i] = c.topology_key == HOSTNAME_KEY
+        honor_aff[i] = c.node_affinity_policy == t.POLICY_HONOR
+        honor_taint[i] = c.node_taints_policy == t.POLICY_HONOR
+        m = groups_matching(it, builder.schema.G, {ns_id}, c.label_selector)
+        masks[i, : m.shape[0]] = m
+    return {
+        f"{prefix}_valid": valid,
+        f"{prefix}_slot": slots,
+        f"{prefix}_skew": skew,
+        f"{prefix}_mindom": mindom,
+        f"{prefix}_self": selfm,
+        f"{prefix}_hostname": hostname,
+        f"{prefix}_aff": honor_aff,
+        f"{prefix}_taint": honor_taint,
+        f"{prefix}_groups": masks,
+    }
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    hard = [
+        c
+        for c in pod.spec.topology_spread_constraints
+        if c.when_unsatisfiable == t.DO_NOT_SCHEDULE
+    ]
+    soft = [
+        c
+        for c in pod.spec.topology_spread_constraints
+        if c.when_unsatisfiable == t.SCHEDULE_ANYWAY
+    ]
+    feats = _constraint_feats(hard, pod, fctx, "tps_h")
+    feats.update(_constraint_feats(soft, pod, fctx, "tps_s"))
+    # Node-inclusion policies are evaluated with the NodeAffinity and
+    # TaintToleration device filters — ensure their features exist even when
+    # those plugins aren't in the profile (idempotent when they are).
+    feats.update(nodeaffinity.featurize(pod, fctx))
+    feats.update(tainttoleration.featurize(pod, fctx))
+    return feats
+
+
+def _per_constraint(state, pf, ctx: PassContext, prefix: str):
+    """Shared geometry: values, key presence, counting eligibility, counts.
+
+    Returns (valid (C,), vals (C,N), key_present (C,N), all_keys (N,),
+    elig (C,N), cnt (C,N) f32)."""
+    valid = pf[f"{prefix}_valid"]  # (C,)
+    slots = pf[f"{prefix}_slot"]  # (C,)
+    vals = jnp.take(state.topo_vals, slots, axis=1).T  # (C, N)
+    key_present = vals >= 0
+    all_keys = (key_present | ~valid[:, None]).all(0)  # (N,)
+    na_ok = nodeaffinity.filter_fn(state, pf, ctx)  # (N,)
+    taint_ok = tainttoleration.filter_fn(state, pf, ctx)  # (N,)
+    elig = (
+        state.valid[None, :]
+        & all_keys[None, :]
+        & jnp.where(pf[f"{prefix}_aff"][:, None], na_ok[None, :], True)
+        & jnp.where(pf[f"{prefix}_taint"][:, None], taint_ok[None, :], True)
+    )
+    # Matching-pod counts per node: (C,G) × (G,N) matmul.  Counts are small
+    # integers — f32 is exact far beyond any real pod count.
+    cnt_raw = jnp.einsum(
+        "cg,gn->cn",
+        pf[f"{prefix}_groups"].astype(jnp.float32),
+        state.group_counts.astype(jnp.float32),
+    )
+    cnt = jnp.where(elig, cnt_raw, 0.0)
+    return valid, vals, key_present, all_keys, elig, cnt, cnt_raw
+
+
+def _segment_tables(vals, elig, cnt, dv):
+    """Per-domain totals and presence: (C, DV) tables."""
+    safe_vals = jnp.maximum(vals, 0)  # ineligible rows carry zeros anyway
+
+    def one(v, c, e):
+        tbl = jax.ops.segment_sum(c, v, num_segments=dv)
+        present = jax.ops.segment_sum(e.astype(jnp.int32), v, num_segments=dv) > 0
+        return tbl, present
+
+    return jax.vmap(one)(safe_vals, cnt, elig)
+
+
+def _segment_presence(vals, mask, dv):
+    """(C, DV) bool: domains containing a True-masked node."""
+    safe_vals = jnp.maximum(vals, 0)
+
+    def one(v, m):
+        return jax.ops.segment_sum(m.astype(jnp.int32), v, num_segments=dv) > 0
+
+    return jax.vmap(one)(safe_vals, mask)
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    valid, vals, key_present, _all_keys, elig, cnt, _raw = _per_constraint(
+        state, pf, ctx, "tps_h"
+    )
+    tbl, present = _segment_tables(vals, elig, cnt, ctx.schema.DV)
+    tbl = tbl.astype(jnp.int64)
+    # Global minimum over existing domains; MaxInt32 when none exist
+    # (newCriticalPaths) — then every skew check passes, like the reference.
+    min_tbl = jnp.min(jnp.where(present, tbl, MAX_INT32), axis=1)  # (C,)
+    domains = present.sum(axis=1)
+    min_match = jnp.where(domains < pf["tps_h_mindom"], 0, min_tbl)
+    match_n = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (C, N)
+    skew = match_n + pf["tps_h_self"][:, None].astype(jnp.int64) - min_match[:, None]
+    ok = key_present & (skew <= pf["tps_h_skew"][:, None])
+    return (ok | ~valid[:, None]).all(0)
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    valid, vals, key_present, all_keys, elig, cnt, cnt_raw = _per_constraint(
+        state, pf, ctx, "tps_s"
+    )
+    any_constraint = valid.any()
+    # Pod-defined constraints require all topology keys on scored nodes
+    # (requireAllTopologies, scoring.go:150); nodes missing one are "ignored"
+    # and end at score 0 via the final `scored` mask.
+    scored = feasible & all_keys
+
+    tbl, _present = _segment_tables(vals, elig, cnt, ctx.schema.DV)
+    # Domains/topoSize count distinct pairs among *scored candidate* nodes
+    # (initPreScoreState iterates filteredNodes); hostname topoSize is the
+    # number of scored nodes.
+    present_cand = _segment_presence(
+        vals, jnp.broadcast_to(scored[None, :], vals.shape), ctx.schema.DV
+    )
+    pair_cnt = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (C, N)
+    # Hostname counts the node's own pods directly, with no counting-
+    # eligibility mask (scoring.go:254 uses nodeInfo.Pods).
+    cnt_for_node = jnp.where(pf["tps_s_hostname"][:, None], cnt_raw, pair_cnt)
+    topo_size = jnp.where(
+        pf["tps_s_hostname"],
+        scored.sum(),
+        present_cand.sum(axis=1),
+    )  # (C,)
+    w = jnp.log(topo_size.astype(jnp.float64) + 2.0)
+    term = key_present * (
+        cnt_for_node.astype(jnp.float64) * w[:, None]
+        + (pf["tps_s_skew"][:, None].astype(jnp.float64) - 1.0)
+    )
+    raw = jnp.where(valid[:, None], term, 0.0).sum(0)  # (N,)
+    # math.Round semantics (half away from zero); terms are non-negative.
+    raw = jnp.floor(raw + 0.5).astype(jnp.int64)
+
+    big = jnp.int64(2**62)
+    mn = jnp.min(jnp.where(scored, raw, big))
+    mn = jnp.where(scored.any(), mn, 0)
+    mx = jnp.max(jnp.where(scored, raw, 0))
+    norm = jnp.where(
+        mx == 0,
+        MAX_NODE_SCORE,
+        MAX_NODE_SCORE * (mx + mn - raw) // jnp.maximum(mx, 1),
+    )
+    norm = jnp.where(scored, norm, 0)
+    # No soft constraints → plugin is skipped (PreScore returns Skip):
+    # contribute 0 everywhere.
+    return jnp.where(any_constraint, norm, 0)
+
+
+for _k, _fill in [
+    ("tps_h_valid", 0), ("tps_h_slot", 0), ("tps_h_skew", 1), ("tps_h_mindom", 1),
+    ("tps_h_self", 0), ("tps_h_hostname", 0), ("tps_h_aff", 0), ("tps_h_taint", 0),
+    ("tps_h_groups", 0),
+    ("tps_s_valid", 0), ("tps_s_slot", 0), ("tps_s_skew", 1), ("tps_s_mindom", 1),
+    ("tps_s_self", 0), ("tps_s_hostname", 0), ("tps_s_aff", 0), ("tps_s_taint", 0),
+    ("tps_s_groups", 0),
+]:
+    feature_fill(_k, _fill)
+
+register(
+    OpDef(
+        name="PodTopologySpread",
+        featurize=featurize,
+        filter=filter_fn,
+        score=score_fn,
+    )
+)

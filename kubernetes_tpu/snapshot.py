@@ -61,6 +61,7 @@ class Schema:
     TS: int = 8  # taint slots per node
     TV: int = 8  # taint vocabulary size (pod intolerable-taint bitmasks)
     TK: int = 4  # topology-key slots
+    DV: int = 8  # max domain (topology-value) vocabulary across topo keys
     G: int = 8  # pod label-group rows
     AT: int = 8  # existing-pod required-anti-affinity term rows
     P: int = 8  # host-port (proto,ip,port) triple rows
@@ -279,6 +280,9 @@ class SnapshotBuilder:
             h["image_sizes"][row, i] = img.size_bytes
             for alias in img.names[1:]:
                 it.images.id(alias)
+        # Last: growth swaps self.host for fresh copies, so every write via
+        # the local alias above must land before it.
+        self._ensure(DV=it.max_topo_vocab())
         self._dirty_rows.add(row)
 
     def ensure_topo_key(self, key: str) -> int:
@@ -299,6 +303,7 @@ class SnapshotBuilder:
                     pair = self.interns.label_pairs.value(int(self.host["label_pair_ids"][row, s]))
                     self.host["topo_vals"][row, slot] = self.interns.topo_value_id(key, pair[1])
                     self._dirty_rows.add(row)
+            self._ensure(DV=self.interns.max_topo_vocab())
         return slot
 
     def clear_node_row(self, row: int) -> None:

@@ -192,3 +192,123 @@ def node_ports_filter(pod: t.Pod, existing: list[t.Pod]) -> bool:
             if ip == uip or ip == "0.0.0.0" or uip == "0.0.0.0":
                 return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread (plugins/podtopologyspread/filtering.go, scoring.go)
+# ---------------------------------------------------------------------------
+
+
+def _spread_count(c, pod, pods_on_node) -> int:
+    """countPodsMatchSelector: same namespace + selector match."""
+    return sum(
+        1
+        for p in pods_on_node
+        if p.namespace == pod.namespace
+        and t.label_selector_matches(c.label_selector, p.metadata.labels)
+    )
+
+
+def _spread_eligible(c, pod, node, all_keys: list[str]) -> bool:
+    """processNode eligibility: all constraint topo keys present + per-
+    constraint node inclusion policies (matchNodeInclusionPolicies)."""
+    if any(k not in node.metadata.labels for k in all_keys):
+        return False
+    if c.node_affinity_policy == t.POLICY_HONOR and not node_affinity_filter(pod, node):
+        return False
+    if c.node_taints_policy == t.POLICY_HONOR and not taint_toleration_filter(pod, node):
+        return False
+    return True
+
+
+def _spread_pair_counts(cons, pod, nodes, pods_on) -> dict:
+    keys = [c.topology_key for c in cons]
+    out = {}
+    for c in cons:
+        d: dict[str, int] = {}
+        for n in nodes:
+            if not _spread_eligible(c, pod, n, keys):
+                continue
+            v = n.metadata.labels[c.topology_key]
+            d[v] = d.get(v, 0) + _spread_count(c, pod, pods_on.get(n.name, []))
+        out[id(c)] = d
+    return out
+
+
+def spread_filter(pod, nodes, pods_on: dict) -> dict[str, bool]:
+    """PodTopologySpread Filter for every node (filtering.go:283)."""
+    cons = [
+        c
+        for c in pod.spec.topology_spread_constraints
+        if c.when_unsatisfiable == t.DO_NOT_SCHEDULE
+    ]
+    if not cons:
+        return {n.name: True for n in nodes}
+    pair = _spread_pair_counts(cons, pod, nodes, pods_on)
+    result = {}
+    for n in nodes:
+        ok = True
+        for c in cons:
+            v = n.metadata.labels.get(c.topology_key)
+            if v is None:
+                ok = False
+                break
+            d = pair[id(c)]
+            min_match = min(d.values()) if d else 2**31 - 1
+            if len(d) < (c.min_domains or 1):
+                min_match = 0
+            self_match = 1 if t.label_selector_matches(c.label_selector, pod.metadata.labels) else 0
+            if d.get(v, 0) + self_match - min_match > c.max_skew:
+                ok = False
+                break
+        result[n.name] = ok
+    return result
+
+
+def spread_score(pod, nodes, pods_on: dict, feasible: dict[str, bool]) -> dict[str, int]:
+    """PodTopologySpread Score + NormalizeScore over feasible nodes
+    (scoring.go).  Returns the final normalized per-node scores."""
+    cons = [
+        c
+        for c in pod.spec.topology_spread_constraints
+        if c.when_unsatisfiable == t.SCHEDULE_ANYWAY
+    ]
+    if not cons:
+        return {n.name: 0 for n in nodes}
+    keys = [c.topology_key for c in cons]
+    hostname = "kubernetes.io/hostname"
+    pair = _spread_pair_counts(cons, pod, nodes, pods_on)
+    candidates = [n for n in nodes if feasible.get(n.name)]
+    ignored = {n.name for n in candidates if any(k not in node_labels(n) for k in keys)}
+    scored = [n for n in candidates if n.name not in ignored]
+    raws: dict[str, int] = {}
+    for n in scored:
+        total = 0.0
+        for c in cons:
+            v = n.metadata.labels.get(c.topology_key)
+            if v is None:
+                continue
+            if c.topology_key == hostname:
+                cnt = _spread_count(c, pod, pods_on.get(n.name, []))
+                size = len(scored)
+            else:
+                cnt = pair[id(c)].get(v, 0)
+                size = len(
+                    {
+                        node_labels(m)[c.topology_key]
+                        for m in scored
+                        if c.topology_key in node_labels(m)
+                    }
+                )
+            total += cnt * math.log(size + 2) + (c.max_skew - 1)
+        raws[n.name] = int(math.floor(total + 0.5))
+    out = {n.name: 0 for n in nodes}
+    if raws:
+        mx, mn = max(raws.values()), min(raws.values())
+        for name, s in raws.items():
+            out[name] = MAX_NODE_SCORE if mx == 0 else MAX_NODE_SCORE * (mx + mn - s) // mx
+    return out
+
+
+def node_labels(n) -> dict[str, str]:
+    return n.metadata.labels

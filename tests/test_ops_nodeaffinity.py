@@ -1,0 +1,183 @@
+"""NodeAffinity vectorized op vs scalar reference semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import node_affinity_filter, node_affinity_score_raw
+
+
+def na_profile():
+    return Profile(
+        name="na", filters=("NodeAffinity",), scorers=(("NodeAffinity", 2),)
+    )
+
+
+def sched(profile=None, batch_size=16):
+    return TPUScheduler(profile=profile or na_profile(), batch_size=batch_size)
+
+
+def _with_required(pod, *terms):
+    pod.spec.affinity = t.Affinity(
+        node_affinity=t.NodeAffinity(required=t.NodeSelector(terms=tuple(terms)))
+    )
+    return pod
+
+
+def test_node_selector_map():
+    s = sched()
+    s.add_node(make_node("gpu").capacity({"cpu": "4", "pods": 110}).label("accel", "tpu").obj())
+    s.add_node(make_node("plain").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).node_selector({"accel": "tpu"}).obj())
+    assert s.schedule_all_pending()[0].node_name == "gpu"
+
+
+def test_required_in_operator():
+    s = sched()
+    s.add_node(make_node("a").capacity({"cpu": "4", "pods": 110}).label("disk", "ssd").obj())
+    s.add_node(make_node("b").capacity({"cpu": "4", "pods": 110}).label("disk", "hdd").obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).node_affinity_in("disk", ["ssd"]).obj())
+    assert s.schedule_all_pending()[0].node_name == "a"
+
+
+def test_terms_are_ored():
+    s = sched()
+    s.add_node(make_node("a").capacity({"cpu": "4", "pods": 110}).label("disk", "hdd").obj())
+    term1 = t.NodeSelectorTerm(
+        match_expressions=(t.NodeSelectorRequirement("disk", t.OP_IN, ("ssd",)),)
+    )
+    term2 = t.NodeSelectorTerm(
+        match_expressions=(t.NodeSelectorRequirement("disk", t.OP_IN, ("hdd",)),)
+    )
+    pod = _with_required(make_pod("p").req({"cpu": "1"}).obj(), term1, term2)
+    s.add_pod(pod)
+    assert s.schedule_all_pending()[0].node_name == "a"
+
+
+def test_gt_lt_operators():
+    s = sched()
+    s.add_node(make_node("big").capacity({"cpu": "4", "pods": 110}).label("cores", "64").obj())
+    s.add_node(make_node("small").capacity({"cpu": "4", "pods": 110}).label("cores", "8").obj())
+    s.add_node(make_node("weird").capacity({"cpu": "4", "pods": 110}).label("cores", "banana").obj())
+    term = t.NodeSelectorTerm(
+        match_expressions=(t.NodeSelectorRequirement("cores", t.OP_GT, ("16",)),)
+    )
+    s.add_pod(_with_required(make_pod("p").req({"cpu": "1"}).obj(), term))
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "big"
+    assert out[0].feasible_nodes == 1  # non-integer label can never satisfy Gt
+
+
+def test_match_fields_node_name():
+    s = sched()
+    for i in range(3):
+        s.add_node(make_node(f"n{i}").capacity({"cpu": "4", "pods": 110}).obj())
+    term = t.NodeSelectorTerm(
+        match_fields=(t.NodeSelectorRequirement("metadata.name", t.OP_IN, ("n1",)),)
+    )
+    s.add_pod(_with_required(make_pod("p").req({"cpu": "1"}).obj(), term))
+    assert s.schedule_all_pending()[0].node_name == "n1"
+
+
+def test_empty_required_terms_match_nothing():
+    s = sched()
+    s.add_node(make_node("n0").capacity({"cpu": "4", "pods": 110}).obj())
+    pod = make_pod("p").req({"cpu": "1"}).obj()
+    pod.spec.affinity = t.Affinity(node_affinity=t.NodeAffinity(required=t.NodeSelector(terms=())))
+    s.add_pod(pod)
+    assert s.schedule_all_pending()[0].node_name is None
+
+
+def test_unknown_label_key_selector():
+    """Selecting on a key no node carries is simply infeasible (and must not
+    crash interning)."""
+    s = sched()
+    s.add_node(make_node("n0").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).node_selector({"never-seen": "x"}).obj())
+    assert s.schedule_all_pending()[0].node_name is None
+
+
+def test_preferred_weights_pick_heavier_match():
+    s = sched()
+    s.add_node(make_node("a").capacity({"cpu": "4", "pods": 110}).label("tier", "gold").obj())
+    s.add_node(make_node("b").capacity({"cpu": "4", "pods": 110}).label("tier", "silver").obj())
+    s.add_pod(
+        make_pod("p")
+        .req({"cpu": "1"})
+        .preferred_node_affinity_in("tier", ["gold"], weight=10)
+        .preferred_node_affinity_in("tier", ["silver"], weight=3)
+        .obj()
+    )
+    assert s.schedule_all_pending()[0].node_name == "a"
+
+
+def _random_requirement(rng) -> t.NodeSelectorRequirement:
+    keys = [f"k{i}" for i in range(4)] + ["num"]
+    ops = [t.OP_IN, t.OP_NOT_IN, t.OP_EXISTS, t.OP_DOES_NOT_EXIST, t.OP_GT, t.OP_LT]
+    op = ops[int(rng.integers(0, len(ops)))]
+    key = keys[int(rng.integers(0, len(keys)))]
+    if op in (t.OP_GT, t.OP_LT):
+        return t.NodeSelectorRequirement("num", op, (str(int(rng.integers(0, 100))),))
+    vals = tuple(f"v{int(rng.integers(0, 4))}" for _ in range(int(rng.integers(1, 3))))
+    return t.NodeSelectorRequirement(key, op, vals if op in (t.OP_IN, t.OP_NOT_IN) else ())
+
+
+def test_matches_reference_randomized():
+    rng = np.random.default_rng(3)
+    nodes = []
+    for i in range(30):
+        w = make_node(f"n{i}").capacity({"cpu": "64", "pods": 110})
+        for k in range(4):
+            if rng.integers(0, 2):
+                w = w.label(f"k{k}", f"v{int(rng.integers(0, 4))}")
+        if rng.integers(0, 2):
+            w = w.label("num", str(int(rng.integers(0, 100))))
+        nodes.append(w.obj())
+
+    pods = []
+    for i in range(40):
+        w = make_pod(f"p{i}").req({"cpu": "1m"})
+        pod = w.obj()
+        n_terms = int(rng.integers(0, 3))
+        terms = []
+        for _ in range(n_terms):
+            reqs = tuple(_random_requirement(rng) for _ in range(int(rng.integers(1, 3))))
+            terms.append(t.NodeSelectorTerm(match_expressions=reqs))
+        preferred = []
+        for _ in range(int(rng.integers(0, 3))):
+            reqs = tuple(_random_requirement(rng) for _ in range(int(rng.integers(1, 3))))
+            preferred.append(
+                t.PreferredSchedulingTerm(
+                    weight=int(rng.integers(1, 20)),
+                    preference=t.NodeSelectorTerm(match_expressions=reqs),
+                )
+            )
+        if terms or preferred:
+            pod.spec.affinity = t.Affinity(
+                node_affinity=t.NodeAffinity(
+                    required=t.NodeSelector(terms=tuple(terms)) if terms else None,
+                    preferred=tuple(preferred),
+                )
+            )
+        pods.append(pod)
+
+    s = sched(batch_size=64)
+    for n in nodes:
+        s.add_node(n)
+    for p in pods:
+        s.add_pod(p)
+    out = {o.pod.name: o for o in s.schedule_all_pending()}
+
+    for p in pods:
+        feas = [n for n in nodes if node_affinity_filter(p, n)]
+        o = out[p.name]
+        assert o.feasible_nodes == len(feas), (p.name, o.feasible_nodes, len(feas))
+        if feas:
+            raws = {n.name: node_affinity_score_raw(p, n) for n in feas}
+            best = max(raws.values())
+            assert raws[o.node_name] == best, (p.name, o.node_name, raws)
+        else:
+            assert o.node_name is None

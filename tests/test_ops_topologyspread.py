@@ -1,0 +1,195 @@
+"""PodTopologySpread vectorized op vs scalar reference semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import spread_filter, spread_score
+
+
+def tps_profile(with_score=True):
+    return Profile(
+        name="tps",
+        filters=("NodeResourcesFit", "PodTopologySpread"),
+        scorers=(("PodTopologySpread", 2),) if with_score else (),
+    )
+
+
+def cluster(s, n_per_zone=2, zones=("a", "b", "c")):
+    for z in zones:
+        for i in range(n_per_zone):
+            s.add_node(
+                make_node(f"n-{z}{i}")
+                .capacity({"cpu": "64", "pods": 110})
+                .zone(z)
+                .obj()
+            )
+
+
+def spread_pod(name, max_skew=1, when=t.DO_NOT_SCHEDULE, topo="topology.kubernetes.io/zone", **kw):
+    return (
+        make_pod(name)
+        .req({"cpu": "100m"})
+        .label("app", "web")
+        .spread_constraint(max_skew, topo, when, "app", ["web"], **kw)
+        .obj()
+    )
+
+
+def test_hard_zone_spread_balances():
+    s = TPUScheduler(profile=tps_profile(False), batch_size=16)
+    cluster(s)
+    for i in range(6):
+        s.add_pod(spread_pod(f"p{i}"))
+    out = s.schedule_all_pending()
+    zones = {}
+    for o in out:
+        assert o.node_name is not None
+        z = o.node_name.split("-")[1][0]
+        zones[z] = zones.get(z, 0) + 1
+    assert zones == {"a": 2, "b": 2, "c": 2}
+
+
+def test_hard_spread_blocks_over_skew():
+    s = TPUScheduler(profile=tps_profile(False), batch_size=16)
+    # One zone only has capacity → after maxSkew pods the rest are blocked.
+    s.add_node(make_node("n-a0").capacity({"cpu": "64", "pods": 110}).zone("a").obj())
+    s.add_node(make_node("n-b0").capacity({"cpu": "64", "pods": 110}).zone("b").unschedulable().obj())
+    prof = Profile(
+        name="tps-u",
+        filters=("NodeUnschedulable", "NodeResourcesFit", "PodTopologySpread"),
+        scorers=(),
+    )
+    s2 = TPUScheduler(profile=prof, batch_size=16)
+    s2.add_node(make_node("n-a0").capacity({"cpu": "64", "pods": 110}).zone("a").obj())
+    s2.add_node(make_node("n-b0").capacity({"cpu": "64", "pods": 110}).zone("b").unschedulable().obj())
+    for i in range(3):
+        s2.add_pod(spread_pod(f"p{i}"))
+    out = {o.pod.name: o.node_name for o in s2.schedule_all_pending()}
+    # Zone b exists as a domain (node b0 is eligible for counting — it is not
+    # excluded by affinity/taint policies) with 0 pods, so zone a can take
+    # maxSkew (1) pod before skew would exceed.
+    assert out["p0"] == "n-a0"
+    assert out["p1"] is None and out["p2"] is None
+
+
+def test_min_domains_zeroes_global_min():
+    s = TPUScheduler(profile=tps_profile(False), batch_size=16)
+    cluster(s, n_per_zone=1, zones=("a", "b"))
+    # minDomains=3 but only 2 domains → min treated as 0 → skew = count+1.
+    p = (
+        make_pod("p0")
+        .req({"cpu": "100m"})
+        .label("app", "web")
+        .spread_constraint(1, "topology.kubernetes.io/zone", t.DO_NOT_SCHEDULE, "app", ["web"], min_domains=3)
+        .obj()
+    )
+    s.add_pod(p)
+    out = s.schedule_all_pending()
+    assert out[0].node_name is not None  # 0 existing pods: skew 1 ≤ 1 OK
+
+    p2 = (
+        make_pod("p1")
+        .req({"cpu": "100m"})
+        .label("app", "web")
+        .spread_constraint(1, "topology.kubernetes.io/zone", t.DO_NOT_SCHEDULE, "app", ["web"], min_domains=3)
+        .obj()
+    )
+    s.add_pod(p2)
+    out2 = s.schedule_all_pending()
+    # One zone now has 1 pod; with min forced to 0, that zone is blocked
+    # (skew 2 > 1) but the empty zone still admits (skew 1 ≤ 1).
+    assert out2[0].node_name is not None
+    placed_zone = out2[0].node_name
+    assert placed_zone != out[0].node_name
+
+
+def test_soft_spread_prefers_emptier_zone():
+    s = TPUScheduler(profile=tps_profile(True), batch_size=16)
+    cluster(s, n_per_zone=1, zones=("a", "b"))
+    # Preload zone a with one matching pod.
+    s.add_pod(make_pod("existing").req({"cpu": "100m"}).label("app", "web").node("n-a0").obj())
+    s.add_pod(spread_pod("p0", when=t.SCHEDULE_ANYWAY))
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-b0"
+
+
+def test_hostname_soft_spread():
+    s = TPUScheduler(profile=tps_profile(True), batch_size=16)
+    for i in range(3):
+        s.add_node(make_node(f"n{i}").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_pod(make_pod("e1").req({"cpu": "100m"}).label("app", "web").node("n0").obj())
+    s.add_pod(make_pod("e2").req({"cpu": "100m"}).label("app", "web").node("n0").obj())
+    s.add_pod(make_pod("e3").req({"cpu": "100m"}).label("app", "web").node("n1").obj())
+    s.add_pod(spread_pod("p0", when=t.SCHEDULE_ANYWAY, topo="kubernetes.io/hostname"))
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n2"
+
+
+def test_node_missing_topo_key_is_infeasible_for_hard():
+    s = TPUScheduler(profile=tps_profile(False), batch_size=16)
+    s.add_node(make_node("zoned").capacity({"cpu": "64", "pods": 110}).zone("a").obj())
+    s.add_node(make_node("bare").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_pod(spread_pod("p0"))
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "zoned"
+    assert out[0].feasible_nodes == 1
+
+
+def test_matches_reference_randomized():
+    rng = np.random.default_rng(17)
+    zones = ["za", "zb", "zc"]
+    nodes = []
+    for i in range(18):
+        w = make_node(f"n{i}").capacity({"cpu": "640", "pods": 200})
+        if rng.integers(0, 5):  # some nodes lack the zone label
+            w = w.zone(zones[int(rng.integers(0, 3))])
+        nodes.append(w.obj())
+
+    apps = ["web", "db", "cache"]
+    pods = []
+    for i in range(50):
+        app = apps[int(rng.integers(0, 3))]
+        w = make_pod(f"p{i}").req({"cpu": "100m"}).label("app", app)
+        r = int(rng.integers(0, 4))
+        if r == 0:
+            w = w.spread_constraint(
+                int(rng.integers(1, 3)), "topology.kubernetes.io/zone",
+                t.DO_NOT_SCHEDULE, "app", [app],
+            )
+        elif r == 1:
+            w = w.spread_constraint(
+                int(rng.integers(1, 3)), "topology.kubernetes.io/zone",
+                t.SCHEDULE_ANYWAY, "app", [app],
+            )
+        elif r == 2:
+            w = w.spread_constraint(
+                1, "kubernetes.io/hostname", t.SCHEDULE_ANYWAY, "app", [app]
+            )
+        pods.append(w.obj())
+
+    s = TPUScheduler(profile=tps_profile(True), batch_size=64)
+    for n in nodes:
+        s.add_node(n)
+    for p in pods:
+        s.add_pod(p)
+    out = {o.pod.name: o for o in s.schedule_all_pending()}
+
+    # Replay sequentially with the oracle, honoring device picks.
+    pods_on: dict[str, list] = {n.name: [] for n in nodes}
+    for p in pods:
+        o = out[p.name]
+        feas = spread_filter(p, nodes, pods_on)
+        n_feas = sum(feas.values())
+        assert o.feasible_nodes == n_feas, (p.name, o.feasible_nodes, n_feas)
+        if o.node_name is None:
+            assert n_feas == 0, p.name
+            continue
+        assert feas[o.node_name], (p.name, o.node_name)
+        scores = spread_score(p, nodes, pods_on, feas)
+        best = max(s_ for name, s_ in scores.items() if feas[name])
+        assert scores[o.node_name] == best, (p.name, o.node_name, scores)
+        pods_on[o.node_name].append(p)
