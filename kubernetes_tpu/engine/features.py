@@ -13,7 +13,9 @@ import numpy as np
 from ..api import types as t
 from ..framework.config import Profile
 from ..ops import common as opcommon
-from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder
+from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder, _bucket
+
+opcommon.feature_fill("ipa_own_terms", -1)
 
 
 def build_pod_batch(
@@ -27,7 +29,7 @@ def build_pod_batch(
     pairs, topology keys), which is why it must run before the device state is
     flushed for the pass."""
     assert len(pods) <= k
-    fctx = opcommon.FeaturizeContext(builder=builder)
+    fctx = opcommon.FeaturizeContext(builder=builder, profile=profile)
     ops = [opcommon.get(name) for name in dict.fromkeys(
         list(profile.filters) + [s for s, _ in profile.scorers]
     )]
@@ -43,7 +45,11 @@ def build_pod_batch(
         for j, (triple, pk) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
             port_triples[j] = triple
             port_keys[j] = pk
+        own = delta["own_terms"]
+        own_terms = np.full(_bucket(max(len(own), 1), 1), -1, np.int32)
+        own_terms[: len(own)] = own
         feats = {
+            "ipa_own_terms": own_terms,
             "req": delta["req"],
             "nonzero": delta["nonzero"],
             "group": np.int32(delta["group"]),

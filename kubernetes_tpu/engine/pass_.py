@@ -85,10 +85,10 @@ def _commit(state: ClusterState, pf: dict, pick: jax.Array, do: jax.Array) -> Cl
         safe_k = jnp.maximum(pf["port_keys"], 0)
         new["port_counts"] = state.port_counts.at[safe_t, row].add(inc)
         new["portkey_counts"] = state.portkey_counts.at[safe_k, row].add(inc)
-    if "anti_term_ids" in pf:
-        inc = (do & (pf["anti_term_ids"] >= 0)).astype(jnp.int32)
-        safe_a = jnp.maximum(pf["anti_term_ids"], 0)
-        new["at_counts"] = state.at_counts.at[safe_a, row].add(inc)
+    if "ipa_own_terms" in pf:
+        inc = (do & (pf["ipa_own_terms"] >= 0)).astype(jnp.int32)
+        safe_a = jnp.maximum(pf["ipa_own_terms"], 0)
+        new["et_counts"] = state.et_counts.at[safe_a, row].add(inc)
     return dataclasses.replace(state, **new)
 
 

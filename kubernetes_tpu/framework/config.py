@@ -62,6 +62,10 @@ class Profile:
     # 100 → evaluate all nodes (the TPU-native default: full evaluation is a
     # small matrix op, truncation only exists for upstream-parity configs).
     percentage_of_nodes_to_score: int | None = 100
+    # InterPodAffinityArgs.HardPodAffinityWeight (types_pluginargs.go:28):
+    # score bonus per existing pod whose required affinity matches the
+    # incoming pod.
+    hard_pod_affinity_weight: int = 1
     # Deterministic tie-break seed (parity mode: both sides share it).
     tie_break_seed: int = 0
 

@@ -15,6 +15,17 @@ from __future__ import annotations
 from typing import Hashable, Iterable
 
 
+def term_key(category: int, weight: int, term, namespace: str) -> tuple:
+    """Canonical hashable identity of a pod (anti-)affinity term.
+
+    Namespaces default to the owning pod's namespace when the term names none
+    and has no namespaceSelector (framework/types.go newAffinityTerm)."""
+    ns = tuple(sorted(term.namespaces))
+    if not ns and term.namespace_selector is None:
+        ns = (namespace,)
+    return (category, weight, term.topology_key, ns, term.namespace_selector, term.label_selector)
+
+
 class Vocab:
     """A grow-only bijection value → dense id (0-based). Thread-hostile by
     design: interning happens only on the (single-threaded) snapshot path,
@@ -77,6 +88,7 @@ class InternTable:
         self.topo_vals: list[Vocab] = []
         self.namespaces = Vocab("namespaces")
         self.groups = Vocab("groups")
+        self.terms = Vocab("terms")  # existing-pod (anti-)affinity terms
         self.ports = Vocab("ports")
         self.images = Vocab("images")
         self.node_names = Vocab("node_names")
@@ -93,6 +105,13 @@ class InternTable:
     def max_topo_vocab(self) -> int:
         """Largest per-key domain vocabulary (drives Schema.DV)."""
         return max((len(v) for v in self.topo_vals), default=0)
+
+    def term_id(self, category: int, weight: int, term, namespace: str) -> int:
+        """Intern a pod (anti-)affinity term of an existing pod.
+
+        ``category``: 0 required-affinity, 1 required-anti-affinity,
+        2 preferred-affinity, 3 preferred-anti-affinity."""
+        return self.terms.id(term_key(category, weight, term, namespace))
 
     def group_id(self, namespace: str, labels: dict[str, str]) -> int:
         """Pod label-group id: pods with identical (namespace, labels) share a

@@ -2,6 +2,8 @@ from . import common  # noqa: F401
 
 # Importing an op module registers its OpDefs.
 from . import (  # noqa: F401
+    imagelocality,
+    interpodaffinity,
     nodeaffinity,
     nodeports,
     noderesources,

@@ -32,6 +32,7 @@ class FeaturizeContext:
     """Host-side context handed to op featurizers."""
 
     builder: SnapshotBuilder
+    profile: Optional[Profile] = None
 
     @property
     def interns(self):

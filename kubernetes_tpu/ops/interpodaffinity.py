@@ -1,0 +1,272 @@
+"""InterPodAffinity, vectorized.
+
+Reference (plugins/interpodaffinity/):
+  * Filter (filtering.go:354–383 satisfy*): three checks against
+    topology-pair match counts —
+    (1) existing pods' required anti-affinity terms matching the incoming pod
+        forbid every node sharing the term's topology pair with a carrier
+        (existingAntiAffinityCounts; the node fails if ANY of its topology
+        pairs has a positive count, :306);
+    (2) the incoming pod's required affinity terms need, per term, a node
+        whose (topologyKey, value) domain hosts a pod matching ALL terms
+        (affinityCounts; all topology keys must exist on the node, with the
+        lonely-first-pod self-match exception, :337–351);
+    (3) the incoming pod's required anti-affinity terms forbid domains
+        hosting any matching pod (antiAffinityCounts, :322).
+  * Score (scoring.go:80–124 processExistingPod): per existing pod E on node
+    m, weights accumulate onto m's (topologyKey, value) pairs — the incoming
+    pod's preferred (anti-)affinity terms matching E contribute ±weight; E's
+    required affinity terms matching the pod contribute HardPodAffinityWeight;
+    E's preferred (anti-)affinity terms matching the pod contribute ±weight.
+    A node's raw score sums its pairs' weights (:243); NormalizeScore maps
+    [min,max] over feasible nodes to [0,100] (:265).
+
+TPU design: existing pods' terms are interned into a term vocabulary; the
+cluster state carries per-(term, node) carrier counts (et_counts), updated by
+the same commit delta that moves resources.  Featurization matches the
+incoming pod against every interned term once (host-side string work), and
+compiles the pod's own terms to group bitmasks, so the device computes all
+domain tallies with (T,G)×(G,N) matmuls plus segment reductions over interned
+topology values — replacing the reference's O(pods × nodes) goroutine sweep
+(the BASELINE config #3 worst case) with dense linear algebra.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from ..framework.config import MAX_NODE_SCORE
+from ..intern import term_key
+from ..snapshot import _bucket
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .podtopologyspread import groups_matching
+
+# Existing-term categories (intern.term_id).
+CAT_REQ_AFF, CAT_REQ_ANTI, CAT_PREF_AFF, CAT_PREF_ANTI = 0, 1, 2, 3
+
+
+def _term_matches_pod(term_key, pod: t.Pod, ns_labels: dict[str, dict[str, str]]) -> bool:
+    """AffinityTerm.Matches (framework/types.go:479): namespace membership or
+    namespaceSelector over the pod's namespace labels, AND label selector."""
+    _cat, _w, _topo, ns_tuple, ns_sel, selector = term_key
+    ns_ok = pod.namespace in ns_tuple or (
+        ns_sel is not None
+        and t.label_selector_matches(ns_sel, ns_labels.get(pod.namespace, {}))
+    )
+    return ns_ok and t.label_selector_matches(selector, pod.metadata.labels)
+
+
+def _term_group_ns_ids(term: t.PodAffinityTerm, pod: t.Pod, fctx: FeaturizeContext):
+    """Namespace-id set an incoming pod's term selects."""
+    it = fctx.interns
+    ns = set(term.namespaces)
+    if not ns and term.namespace_selector is None:
+        ns = {pod.namespace}
+    ids = {it.namespaces.id(n) for n in ns}
+    if term.namespace_selector is not None:
+        # Evaluate the selector over every namespace any group references.
+        nsl = fctx.builder.namespace_labels
+        for nid in range(len(it.namespaces)):
+            name = it.namespaces.value(nid)
+            if t.label_selector_matches(term.namespace_selector, nsl.get(name, {})):
+                ids.add(nid)
+    return ids
+
+
+def _own_term_feats(
+    terms, pod: t.Pod, fctx: FeaturizeContext, prefix: str, weights=None
+) -> dict:
+    """Compile the incoming pod's terms: per-term topo slot + group bitmask."""
+    builder = fctx.builder
+    dim = _bucket(max(len(terms), 1), 1)
+    valid = np.zeros(dim, np.bool_)
+    slots = np.zeros(dim, np.int32)
+    masks = np.zeros((dim, builder.schema.G), np.bool_)
+    wvec = np.zeros(dim, np.int64)
+    for i, term in enumerate(terms):
+        valid[i] = True
+        slots[i] = builder.ensure_topo_key(term.topology_key)
+        ns_ids = _term_group_ns_ids(term, pod, fctx)
+        m = groups_matching(fctx.interns, builder.schema.G, ns_ids, term.label_selector)
+        masks[i, : m.shape[0]] = m
+        if weights is not None:
+            wvec[i] = weights[i]
+    out = {
+        f"{prefix}_valid": valid,
+        f"{prefix}_slot": slots,
+        f"{prefix}_groups": masks,
+    }
+    if weights is not None:
+        out[f"{prefix}_w"] = wvec
+    return out
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    it = fctx.interns
+    builder = fctx.builder
+    aff = pod.spec.affinity
+    pa = aff.pod_affinity if aff else None
+    paa = aff.pod_anti_affinity if aff else None
+    req_aff = list(pa.required) if pa else []
+    req_anti = list(paa.required) if paa else []
+    pref = [(wt.term, wt.weight) for wt in (pa.preferred if pa else ())]
+    pref += [(wt.term, -wt.weight) for wt in (paa.preferred if paa else ())]
+
+    feats = _own_term_feats(req_aff, pod, fctx, "ipa_ra")
+    feats.update(_own_term_feats(req_anti, pod, fctx, "ipa_rs"))
+    feats.update(
+        _own_term_feats(
+            [term for term, _ in pref], pod, fctx, "ipa_pf", [w for _, w in pref]
+        )
+    )
+    # Required affinity counts pods matching ALL terms (podMatchesAllAffinityTerms)
+    # — intersect the per-term group masks.
+    if req_aff:
+        allmask = feats["ipa_ra_groups"][: len(req_aff)].all(axis=0)
+    else:
+        allmask = np.zeros(builder.schema.G, np.bool_)
+    feats["ipa_ra_allmask"] = allmask
+    # podMatchesAllAffinityTerms(pod's own terms, pod) for the lonely-first-pod
+    # exception (filtering.go:345).
+    feats["ipa_ra_self"] = np.bool_(
+        bool(req_aff)
+        and all(
+            _term_matches_pod(
+                term_key(CAT_REQ_AFF, 0, term, pod.namespace), pod, builder.namespace_labels
+            )
+            for term in req_aff
+        )
+    )
+
+    # Match the pod against every interned existing-pod term.
+    builder._ensure(ET=max(len(it.terms), 1))
+    et = builder.schema.ET
+    et_match = np.zeros(et, np.bool_)
+    et_anti = np.zeros(et, np.bool_)
+    et_w = np.zeros(et, np.int64)
+    et_slot = np.zeros(et, np.int32)
+    hard_w = fctx.profile.hard_pod_affinity_weight if fctx.profile else 1
+    for tid in range(len(it.terms)):
+        key = it.terms.value(tid)
+        cat, weight, topo_key = key[0], key[1], key[2]
+        et_slot[tid] = builder.ensure_topo_key(topo_key)
+        if not _term_matches_pod(key, pod, builder.namespace_labels):
+            continue
+        et_match[tid] = True
+        if cat == CAT_REQ_ANTI:
+            et_anti[tid] = True
+        elif cat == CAT_REQ_AFF:
+            et_w[tid] = hard_w
+        elif cat == CAT_PREF_AFF:
+            et_w[tid] = weight
+        elif cat == CAT_PREF_ANTI:
+            et_w[tid] = -weight
+    feats.update(
+        ipa_et_match=et_match, ipa_et_anti=et_anti, ipa_et_w=et_w, ipa_et_slot=et_slot
+    )
+    return feats
+
+
+def _domain_tables(state, slots, counts, dv):
+    """Per-term domain tallies: (T, N) values + (T, DV) segment sums.
+
+    ``counts`` (T, N) f32 contributions; nodes missing the term's topology
+    key contribute nothing (the reference's map update skips them)."""
+    vals = jnp.take(state.topo_vals, slots, axis=1).T  # (T, N)
+    key_present = vals >= 0
+    masked = jnp.where(key_present, counts, 0.0)
+
+    def one(v, c):
+        return jax.ops.segment_sum(c, jnp.maximum(v, 0), num_segments=dv)
+
+    tbl = jax.vmap(one)(vals, masked)  # (T, DV)
+    at_node = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (T, N)
+    return vals, key_present, tbl, at_node
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    gc = state.group_counts.astype(jnp.float32)  # (G, N)
+    dv = ctx.schema.DV
+
+    # (1) Existing pods' required anti-affinity.
+    active_e = pf["ipa_et_match"] & pf["ipa_et_anti"]  # (ET,)
+    carriers = state.et_counts.astype(jnp.float32)  # (ET, N)
+    _v, key_e, _tbl, at_node_e = _domain_tables(state, pf["ipa_et_slot"], carriers, dv)
+    fail_existing = (active_e[:, None] & key_e & (at_node_e > 0.5)).any(0)
+
+    # (2) Incoming required affinity.
+    ra_valid = pf["ipa_ra_valid"]  # (RA,)
+    any_ra = ra_valid.any()
+    cnt_all = pf["ipa_ra_allmask"].astype(jnp.float32) @ gc  # (N,)
+    ra_counts = jnp.broadcast_to(cnt_all[None, :], (ra_valid.shape[0], cnt_all.shape[0]))
+    _v, key_ra, tbl_ra, at_ra = _domain_tables(state, pf["ipa_ra_slot"], ra_counts, dv)
+    keys_ok = (key_ra | ~ra_valid[:, None]).all(0)
+    pods_exist = ((at_ra > 0.5) | ~ra_valid[:, None]).all(0)
+    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], tbl_ra, 0.0)) == 0
+    aff_ok = ~any_ra | (keys_ok & (pods_exist | (counts_empty & pf["ipa_ra_self"])))
+
+    # (3) Incoming required anti-affinity.
+    rs_valid = pf["ipa_rs_valid"]
+    cnt_rs = pf["ipa_rs_groups"].astype(jnp.float32) @ gc  # (RS, N)
+    _v, key_rs, _tbl, at_rs = _domain_tables(state, pf["ipa_rs_slot"], cnt_rs, dv)
+    fail_anti = (rs_valid[:, None] & key_rs & (at_rs > 0.5)).any(0)
+
+    return ~fail_existing & aff_ok & ~fail_anti
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    gc = state.group_counts.astype(jnp.float32)
+    dv = ctx.schema.DV
+
+    # Incoming pod's preferred terms: ±w × (matching pods in the node's domain).
+    pf_valid = pf["ipa_pf_valid"]
+    cnt_p = pf["ipa_pf_groups"].astype(jnp.float32) @ gc  # (PP, N)
+    _v, key_p, _tbl, at_p = _domain_tables(state, pf["ipa_pf_slot"], cnt_p, dv)
+    raw = jnp.sum(
+        jnp.where(pf_valid[:, None] & key_p, at_p, 0.0)
+        * pf["ipa_pf_w"][:, None].astype(jnp.float32),
+        axis=0,
+    )
+
+    # Existing pods' terms matching the incoming pod: carriers in the node's
+    # domain × signed weight (hard affinity / preferred ±w).
+    active_e = pf["ipa_et_match"] & (pf["ipa_et_w"] != 0)
+    carriers = state.et_counts.astype(jnp.float32)
+    _v, key_e, _tbl, at_e = _domain_tables(state, pf["ipa_et_slot"], carriers, dv)
+    raw += jnp.sum(
+        jnp.where(active_e[:, None] & key_e, at_e, 0.0)
+        * pf["ipa_et_w"][:, None].astype(jnp.float32),
+        axis=0,
+    )
+    raw = raw.astype(jnp.int64)
+
+    big = jnp.int64(2**62)
+    mn = jnp.min(jnp.where(feasible, raw, big))
+    mx = jnp.max(jnp.where(feasible, raw, -big))
+    diff = mx - mn
+    norm = jnp.where(
+        diff > 0, MAX_NODE_SCORE * (raw - mn) // jnp.maximum(diff, 1), 0
+    )
+    return jnp.where(feasible, norm, 0)
+
+
+for _k, _fill in [
+    ("ipa_ra_valid", 0), ("ipa_ra_slot", 0), ("ipa_ra_groups", 0),
+    ("ipa_ra_allmask", 0), ("ipa_ra_self", 0),
+    ("ipa_rs_valid", 0), ("ipa_rs_slot", 0), ("ipa_rs_groups", 0),
+    ("ipa_pf_valid", 0), ("ipa_pf_slot", 0), ("ipa_pf_groups", 0), ("ipa_pf_w", 0),
+    ("ipa_et_match", 0), ("ipa_et_anti", 0), ("ipa_et_w", 0), ("ipa_et_slot", 0),
+]:
+    feature_fill(_k, _fill)
+
+register(
+    OpDef(
+        name="InterPodAffinity",
+        featurize=featurize,
+        filter=filter_fn,
+        score=score_fn,
+    )
+)

@@ -114,10 +114,15 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
     feats = _constraint_feats(hard, pod, fctx, "tps_h")
     feats.update(_constraint_feats(soft, pod, fctx, "tps_s"))
     # Node-inclusion policies are evaluated with the NodeAffinity and
-    # TaintToleration device filters — ensure their features exist even when
-    # those plugins aren't in the profile (idempotent when they are).
-    feats.update(nodeaffinity.featurize(pod, fctx))
-    feats.update(tainttoleration.featurize(pod, fctx))
+    # TaintToleration device filters — ensure their features exist when those
+    # plugins aren't in the profile (the engine's op loop already produces
+    # the identical keys when they are).
+    prof = fctx.profile
+    enabled = set(prof.filters) | {n for n, _ in prof.scorers} if prof else set()
+    if "NodeAffinity" not in enabled:
+        feats.update(nodeaffinity.featurize(pod, fctx))
+    if "TaintToleration" not in enabled:
+        feats.update(tainttoleration.featurize(pod, fctx))
     return feats
 
 

@@ -63,7 +63,7 @@ class Schema:
     TK: int = 4  # topology-key slots
     DV: int = 8  # max domain (topology-value) vocabulary across topo keys
     G: int = 8  # pod label-group rows
-    AT: int = 8  # existing-pod required-anti-affinity term rows
+    ET: int = 8  # existing-pod (anti-)affinity term rows
     P: int = 8  # host-port (proto,ip,port) triple rows
     PK: int = 8  # host-port (proto,port) key rows
     IM: int = 8  # image slots per node
@@ -117,7 +117,7 @@ class ClusterState:
 
     # Affinity bookkeeping ----------------------------------------------------
     group_counts: jax.Array  # (G, N) i32 — pods of label-group g on node n
-    at_counts: jax.Array  # (AT, N) i32 — pods w/ required anti-affinity term a
+    et_counts: jax.Array  # (ET, N) i32 — pods carrying interned term e
 
     # Images ------------------------------------------------------------------
     image_ids: jax.Array  # (N, IM) i32, -1 pad
@@ -142,7 +142,7 @@ _NODE_AXIS: dict[str, int] = {
     "port_counts": 1,
     "portkey_counts": 1,
     "group_counts": 1,
-    "at_counts": 1,
+    "et_counts": 1,
     "image_ids": 0,
     "image_sizes": 0,
 }
@@ -166,7 +166,7 @@ def _host_arrays(s: Schema) -> dict[str, np.ndarray]:
         "port_counts": np.zeros((s.P, s.N), np.int32),
         "portkey_counts": np.zeros((s.PK, s.N), np.int32),
         "group_counts": np.zeros((s.G, s.N), np.int32),
-        "at_counts": np.zeros((s.AT, s.N), np.int32),
+        "et_counts": np.zeros((s.ET, s.N), np.int32),
         "image_ids": np.full((s.N, s.IM), -1, np.int32),
         "image_sizes": np.zeros((s.N, s.IM), np.int64),
     }
@@ -193,6 +193,10 @@ class SnapshotBuilder:
     def __init__(self, interns: InternTable | None = None, schema: Schema | None = None):
         self.interns = interns or InternTable()
         self.schema = schema or Schema()
+        # Namespace → labels, for namespaceSelector matching in affinity terms
+        # (the analog of the scheduler's namespace lister snapshot,
+        # interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).
+        self.namespace_labels: dict[str, dict[str, str]] = {}
         self.host = _host_arrays(self.schema)
         self._device: ClusterState | None = None
         self._dirty_rows: set[int] = set()
@@ -234,7 +238,7 @@ class SnapshotBuilder:
             N=row + 1,
             LS=len(labels),
             TS=len(node.spec.taints),
-            IM=len(node.status.images),
+            IM=sum(len(img.names) for img in node.status.images),
         )
         # Pre-intern all resource columns so R is final before writing.
         for rname in node.status.allocatable:
@@ -271,15 +275,16 @@ class SnapshotBuilder:
         h["taint_ids"][row] = -1
         for i, taint in enumerate(node.spec.taints):
             h["taint_ids"][row, i] = it.taints.id((taint.key, taint.value, taint.effect))
-        # Images.
+        # Images: one slot per (image, name) alias so lookups by any CRI name
+        # hit (NodeInfo.ImageStates is keyed by every name, types.go).
         h["image_ids"][row] = -1
         h["image_sizes"][row] = 0
-        for i, img in enumerate(node.status.images):
-            # All names of one image share a size; intern each name.
-            h["image_ids"][row, i] = it.images.id(img.names[0])
-            h["image_sizes"][row, i] = img.size_bytes
-            for alias in img.names[1:]:
-                it.images.id(alias)
+        slot = 0
+        for img in node.status.images:
+            for alias in img.names:
+                h["image_ids"][row, slot] = it.images.id(alias)
+                h["image_sizes"][row, slot] = img.size_bytes
+                slot += 1
         # Last: growth swaps self.host for fresh copies, so every write via
         # the local alias above must land before it.
         self._ensure(DV=it.max_topo_vocab())
@@ -328,6 +333,22 @@ class SnapshotBuilder:
         cpu, mem = pod.non_zero_request()
         gid = self.interns.group_id(pod.namespace, pod.metadata.labels)
         self._ensure(G=gid + 1)
+        # Intern the pod's own (anti-)affinity terms so assigning it bumps
+        # et_counts — the state behind InterPodAffinity's
+        # existingAntiAffinityCounts and existing-pod score terms
+        # (interpodaffinity/filtering.go:155 getExistingAntiAffinityCounts,
+        # scoring.go:106-123 processExistingPod).
+        own_terms: list[int] = []
+        aff = pod.spec.affinity
+        if aff is not None:
+            pa, paa = aff.pod_affinity, aff.pod_anti_affinity
+            for cat, terms in ((0, pa.required if pa else ()), (1, paa.required if paa else ())):
+                for term in terms:
+                    own_terms.append(self.interns.term_id(cat, 0, term, pod.namespace))
+            for cat, wterms in ((2, pa.preferred if pa else ()), (3, paa.preferred if paa else ())):
+                for wt in wterms:
+                    own_terms.append(self.interns.term_id(cat, wt.weight, wt.term, pod.namespace))
+        self._ensure(ET=len(self.interns.terms))
         host_ports = pod.host_ports()
         if len(host_ports) > POD_PORT_SLOTS:
             raise ValueError(
@@ -347,6 +368,7 @@ class SnapshotBuilder:
             "nonzero": np.array([cpu, mem], np.int64),
             "group": gid,
             "ports": ports,
+            "own_terms": own_terms,
         }
 
     def apply_pod_delta(self, row: int, delta: dict, sign: int, device_already: bool) -> None:
@@ -365,8 +387,8 @@ class SnapshotBuilder:
         for triple, pk in delta["ports"]:
             h["port_counts"][triple, row] += sign
             h["portkey_counts"][pk, row] += sign
-        for at_id in delta.get("anti_terms", ()):
-            h["at_counts"][at_id, row] += sign
+        for tid in delta.get("own_terms", ()):
+            h["et_counts"][tid, row] += sign
         if not device_already:
             self._dirty_rows.add(row)
 

@@ -312,3 +312,155 @@ def spread_score(pod, nodes, pods_on: dict, feasible: dict[str, bool]) -> dict[s
 
 def node_labels(n) -> dict[str, str]:
     return n.metadata.labels
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity (plugins/interpodaffinity/filtering.go, scoring.go)
+# ---------------------------------------------------------------------------
+
+
+def _ipa_term_matches(term, owner_ns: str, target, ns_labels: dict) -> bool:
+    """AffinityTerm.Matches with newAffinityTerm's namespace defaulting."""
+    ns = set(term.namespaces)
+    if not ns and term.namespace_selector is None:
+        ns = {owner_ns}
+    ns_ok = target.namespace in ns or (
+        term.namespace_selector is not None
+        and t.label_selector_matches(
+            term.namespace_selector, ns_labels.get(target.namespace, {})
+        )
+    )
+    return ns_ok and t.label_selector_matches(term.label_selector, target.metadata.labels)
+
+
+def _ipa_terms(pod):
+    aff = pod.spec.affinity
+    pa = aff.pod_affinity if aff else None
+    paa = aff.pod_anti_affinity if aff else None
+    return (
+        list(pa.required) if pa else [],
+        list(paa.required) if paa else [],
+        list(pa.preferred) if pa else [],
+        list(paa.preferred) if paa else [],
+    )
+
+
+def ipa_filter(pod, nodes, pods_on: dict, ns_labels: dict | None = None) -> dict[str, bool]:
+    """InterPodAffinity Filter for every node (filtering.go:354–383)."""
+    ns_labels = ns_labels or {}
+    req_aff, req_anti, _, _ = _ipa_terms(pod)
+
+    # existingAntiAffinityCounts: pairs forbidden by existing pods' terms.
+    existing_anti: dict[tuple[str, str], int] = {}
+    incoming_aff: dict[tuple[str, str], int] = {}
+    incoming_anti: dict[tuple[str, str], int] = {}
+    for n in nodes:
+        for e in pods_on.get(n.name, []):
+            e_req_aff, e_req_anti, _, _ = _ipa_terms(e)
+            for term in e_req_anti:
+                if _ipa_term_matches(term, e.namespace, pod, ns_labels):
+                    v = n.metadata.labels.get(term.topology_key)
+                    if v is not None:
+                        existing_anti[(term.topology_key, v)] = (
+                            existing_anti.get((term.topology_key, v), 0) + 1
+                        )
+            if req_aff and all(
+                _ipa_term_matches(term2, pod.namespace, e, ns_labels) for term2 in req_aff
+            ):
+                for term2 in req_aff:
+                    v = n.metadata.labels.get(term2.topology_key)
+                    if v is not None:
+                        incoming_aff[(term2.topology_key, v)] = (
+                            incoming_aff.get((term2.topology_key, v), 0) + 1
+                        )
+            for term2 in req_anti:
+                if _ipa_term_matches(term2, pod.namespace, e, ns_labels):
+                    v = n.metadata.labels.get(term2.topology_key)
+                    if v is not None:
+                        incoming_anti[(term2.topology_key, v)] = (
+                            incoming_anti.get((term2.topology_key, v), 0) + 1
+                        )
+
+    self_match = bool(req_aff) and all(
+        _ipa_term_matches(term, pod.namespace, pod, ns_labels) for term in req_aff
+    )
+    out = {}
+    for n in nodes:
+        labels = n.metadata.labels
+        # (1) existing pods' anti-affinity: any of the node's own pairs hit.
+        ok = not any(existing_anti.get((k, v), 0) > 0 for k, v in labels.items())
+        # (2) incoming required affinity.
+        if ok and req_aff:
+            pods_exist = True
+            for term in req_aff:
+                v = labels.get(term.topology_key)
+                if v is None:
+                    ok = False
+                    break
+                if incoming_aff.get((term.topology_key, v), 0) <= 0:
+                    pods_exist = False
+            if ok and not pods_exist:
+                ok = not incoming_aff and self_match
+        # (3) incoming required anti-affinity.
+        if ok:
+            for term in req_anti:
+                v = labels.get(term.topology_key)
+                if v is not None and incoming_anti.get((term.topology_key, v), 0) > 0:
+                    ok = False
+                    break
+        out[n.name] = ok
+    return out
+
+
+def ipa_score(
+    pod,
+    nodes,
+    pods_on: dict,
+    feasible: dict[str, bool],
+    hard_weight: int = 1,
+    ns_labels: dict | None = None,
+) -> dict[str, int]:
+    """InterPodAffinity Score + NormalizeScore (scoring.go:80–124, 265)."""
+    ns_labels = ns_labels or {}
+    _, _, pref_aff, pref_anti = _ipa_terms(pod)
+    topo: dict[tuple[str, str], int] = {}
+
+    def bump(node, key, w):
+        v = node.metadata.labels.get(key)
+        if v is not None:
+            topo[(key, v)] = topo.get((key, v), 0) + w
+
+    for n in nodes:
+        for e in pods_on.get(n.name, []):
+            for wt in pref_aff:
+                if _ipa_term_matches(wt.term, pod.namespace, e, ns_labels):
+                    bump(n, wt.term.topology_key, wt.weight)
+            for wt in pref_anti:
+                if _ipa_term_matches(wt.term, pod.namespace, e, ns_labels):
+                    bump(n, wt.term.topology_key, -wt.weight)
+            e_req_aff, _, e_pref_aff, e_pref_anti = _ipa_terms(e)
+            if hard_weight > 0:
+                for term in e_req_aff:
+                    if _ipa_term_matches(term, e.namespace, pod, ns_labels):
+                        bump(n, term.topology_key, hard_weight)
+            for wt in e_pref_aff:
+                if _ipa_term_matches(wt.term, e.namespace, pod, ns_labels):
+                    bump(n, wt.term.topology_key, wt.weight)
+            for wt in e_pref_anti:
+                if _ipa_term_matches(wt.term, e.namespace, pod, ns_labels):
+                    bump(n, wt.term.topology_key, -wt.weight)
+
+    raws = {}
+    for n in nodes:
+        if not feasible.get(n.name):
+            continue
+        raws[n.name] = sum(
+            topo.get((k, v), 0) for k, v in n.metadata.labels.items()
+        )
+    out = {n.name: 0 for n in nodes}
+    if raws:
+        mx, mn = max(raws.values()), min(raws.values())
+        diff = mx - mn
+        for name, s in raws.items():
+            out[name] = MAX_NODE_SCORE * (s - mn) // diff if diff > 0 else 0
+    return out

@@ -1,0 +1,95 @@
+"""ImageLocality vectorized op vs scalar reference semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+MB = 1024 * 1024
+
+
+def il_profile():
+    return Profile(
+        name="il", filters=("NodeResourcesFit",), scorers=(("ImageLocality", 1),)
+    )
+
+
+def ref_score(pod_images, node_images: dict[str, int], all_nodes_images, n_containers):
+    """image_locality.go calculatePriority ∘ sumImageScores."""
+    total = len(all_nodes_images)
+    s = 0
+    for img in pod_images:
+        if img in node_images:
+            num = sum(1 for ni in all_nodes_images if img in ni)
+            s += int(node_images[img] * (num / total))
+    mn, mx = 23 * MB, 1000 * MB * n_containers
+    s = min(max(s, mn), mx)
+    return 100 * (s - mn) // (mx - mn)
+
+
+def test_prefers_node_with_image():
+    s = TPUScheduler(profile=il_profile(), batch_size=8)
+    s.add_node(
+        make_node("with-img").capacity({"cpu": "4", "pods": 110})
+        .image("redis:7", 300 * MB).obj()
+    )
+    s.add_node(make_node("without").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).container_image("redis:7").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "with-img"
+
+
+def test_untagged_ref_normalizes_to_latest():
+    s = TPUScheduler(profile=il_profile(), batch_size=8)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "4", "pods": 110})
+        .image("nginx:latest", 200 * MB).obj()
+    )
+    s.add_node(make_node("n2").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).container_image("nginx").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n1"
+
+
+def test_spread_scaling_matches_reference():
+    rng = np.random.default_rng(5)
+    images = [f"img{i}:v1" for i in range(6)]
+    sizes = {img: int(rng.integers(30, 900)) * MB for img in images}
+    s = TPUScheduler(profile=il_profile(), batch_size=8)
+    node_imgs = []
+    for i in range(8):
+        have = {img: sizes[img] for img in images if rng.integers(0, 2)}
+        w = make_node(f"n{i}").capacity({"cpu": "64", "pods": 110})
+        for img, sz in have.items():
+            w = w.image(img, sz)
+        s.add_node(w.obj())
+        node_imgs.append(have)
+
+    pod_images = [images[0], images[3]]
+    w = make_pod("p").req({"cpu": "1"})
+    for img in pod_images:
+        w = w.container_image(img)
+    s.add_pod(w.obj())
+    out = s.schedule_all_pending()
+
+    scores = {
+        f"n{i}": ref_score(pod_images, node_imgs[i], node_imgs, 1) for i in range(8)
+    }
+    best = max(scores.values())
+    assert scores[out[0].node_name] == best, (out[0].node_name, scores)
+
+
+def test_image_alias_matches():
+    from kubernetes_tpu.api import types as t
+
+    s = TPUScheduler(profile=il_profile(), batch_size=8)
+    node = make_node("n1").capacity({"cpu": "4", "pods": 110}).obj()
+    node.status.images += (
+        t.ContainerImage(names=("docker.io/library/app:1", "app:1"), size_bytes=400 * MB),
+    )
+    s.add_node(node)
+    s.add_node(make_node("n2").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).container_image("app:1").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n1"

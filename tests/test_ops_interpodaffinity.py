@@ -1,0 +1,232 @@
+"""InterPodAffinity vectorized op vs scalar reference semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import ipa_filter, ipa_score
+
+
+def ipa_profile(with_score=True):
+    return Profile(
+        name="ipa",
+        filters=("NodeResourcesFit", "InterPodAffinity"),
+        scorers=(("InterPodAffinity", 2),) if with_score else (),
+    )
+
+
+def zones(s, per_zone=2, names=("a", "b", "c")):
+    nodes = []
+    for z in names:
+        for i in range(per_zone):
+            n = (
+                make_node(f"n-{z}{i}")
+                .capacity({"cpu": "64", "pods": 110})
+                .zone(z)
+                .obj()
+            )
+            s.add_node(n)
+            nodes.append(n)
+    return nodes
+
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def test_required_affinity_needs_matching_pod():
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s)
+    s.add_pod(make_pod("existing").req({"cpu": "1"}).label("app", "db").node("n-b0").obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).pod_affinity_in("app", ["db"], ZONE).obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name in ("n-b0", "n-b1")
+    assert out[0].feasible_nodes == 2
+
+
+def test_lonely_first_pod_self_match():
+    """A pod with affinity to its own labels schedules when no pods match."""
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s)
+    s.add_pod(
+        make_pod("p")
+        .req({"cpu": "1"})
+        .label("app", "web")
+        .pod_affinity_in("app", ["web"], ZONE)
+        .obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name is not None
+    assert out[0].feasible_nodes == 6
+
+
+def test_lonely_first_pod_without_self_match_stays_pending():
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s)
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).pod_affinity_in("app", ["db"], ZONE).obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+
+
+def test_required_anti_affinity_blocks_domain():
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s)
+    s.add_pod(make_pod("existing").req({"cpu": "1"}).label("app", "db").node("n-a0").obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).pod_anti_affinity_in("app", ["db"], ZONE).obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name is not None
+    assert not out[0].node_name.startswith("n-a")
+    assert out[0].feasible_nodes == 4
+
+
+def test_existing_pod_anti_affinity_repels_incoming():
+    """An existing pod's required anti-affinity keeps matching pods away."""
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s)
+    s.add_pod(
+        make_pod("guard")
+        .req({"cpu": "1"})
+        .pod_anti_affinity_in("app", ["web"], ZONE)
+        .node("n-c0")
+        .obj()
+    )
+    s.add_pod(make_pod("p").req({"cpu": "1"}).label("app", "web").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name is not None
+    assert not out[0].node_name.startswith("n-c")
+    assert out[0].feasible_nodes == 4
+
+
+def test_within_batch_anti_affinity_sequencing():
+    """Pods committed earlier in the same batch repel later ones."""
+    s = TPUScheduler(profile=ipa_profile(False), batch_size=8)
+    zones(s, per_zone=1)
+    for i in range(4):
+        s.add_pod(
+            make_pod(f"p{i}")
+            .req({"cpu": "1"})
+            .label("app", "web")
+            .pod_anti_affinity_in("app", ["web"], ZONE)
+            .obj()
+        )
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    placed = [v for v in out.values() if v]
+    assert len(placed) == 3  # one pod per zone, fourth unschedulable
+    assert len(set(placed)) == 3
+
+
+def test_preferred_affinity_attracts():
+    s = TPUScheduler(profile=ipa_profile(True), batch_size=8)
+    zones(s, per_zone=1)
+    s.add_pod(make_pod("buddy").req({"cpu": "1"}).label("app", "db").node("n-b0").obj())
+    s.add_pod(
+        make_pod("p")
+        .req({"cpu": "1"})
+        .preferred_pod_affinity_in("app", ["db"], ZONE, weight=50)
+        .obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-b0"
+
+
+def test_preferred_anti_affinity_repels():
+    s = TPUScheduler(profile=ipa_profile(True), batch_size=8)
+    zones(s, per_zone=1, names=("a", "b"))
+    s.add_pod(make_pod("noisy").req({"cpu": "1"}).label("app", "db").node("n-b0").obj())
+    s.add_pod(
+        make_pod("p")
+        .req({"cpu": "1"})
+        .preferred_pod_affinity_in("app", ["db"], ZONE, weight=50, anti=True)
+        .obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-a0"
+
+
+def test_existing_pods_preferred_terms_score_incoming():
+    """Existing pods' preferred affinity pulls matching incoming pods."""
+    s = TPUScheduler(profile=ipa_profile(True), batch_size=8)
+    zones(s, per_zone=1, names=("a", "b"))
+    magnet = (
+        make_pod("magnet")
+        .req({"cpu": "1"})
+        .preferred_pod_affinity_in("app", ["web"], ZONE, weight=80)
+        .node("n-a0")
+        .obj()
+    )
+    s.add_pod(magnet)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).label("app", "web").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-a0"
+
+
+def test_matches_reference_randomized():
+    rng = np.random.default_rng(23)
+    apps = ["web", "db", "cache"]
+    nodes = []
+    s = TPUScheduler(profile=ipa_profile(True), batch_size=64)
+    for i in range(12):
+        n = (
+            make_node(f"n{i}")
+            .capacity({"cpu": "640", "pods": 200})
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+        s.add_node(n)
+        nodes.append(n)
+
+    pods = []
+    for i in range(40):
+        app = apps[int(rng.integers(0, 3))]
+        w = make_pod(f"p{i}").req({"cpu": "100m"}).label("app", app)
+        r = int(rng.integers(0, 5))
+        target = apps[int(rng.integers(0, 3))]
+        topo = ZONE if rng.integers(0, 2) else "kubernetes.io/hostname"
+        if r == 0:
+            w = w.pod_affinity_in("app", [target], topo)
+        elif r == 1:
+            w = w.pod_anti_affinity_in("app", [target], topo)
+        elif r == 2:
+            w = w.preferred_pod_affinity_in("app", [target], topo, weight=int(rng.integers(1, 100)))
+        elif r == 3:
+            w = w.preferred_pod_affinity_in("app", [target], topo, weight=int(rng.integers(1, 100)), anti=True)
+        pods.append(w.obj())
+
+    for p in pods:
+        s.add_pod(p)
+    out = {o.pod.name: o for o in s.schedule_all_pending()}
+
+    pods_on: dict[str, list] = {n.name: [] for n in nodes}
+    for p in pods:
+        o = out[p.name]
+        feas = ipa_filter(p, nodes, pods_on)
+        n_feas = sum(feas.values())
+        assert o.feasible_nodes == n_feas, (p.name, o.feasible_nodes, n_feas)
+        if o.node_name is None:
+            assert n_feas == 0, (p.name, feas)
+            continue
+        assert feas[o.node_name], (p.name, o.node_name)
+        scores = ipa_score(p, nodes, pods_on, feas)
+        best = max(sc for name, sc in scores.items() if feas[name])
+        assert scores[o.node_name] == best, (p.name, o.node_name, scores)
+        pods_on[o.node_name].append(p)
+
+
+def test_mirror_consistency_with_affinity():
+    s = TPUScheduler(profile=ipa_profile(True), batch_size=16)
+    zones(s, per_zone=1)
+    for i in range(9):
+        w = make_pod(f"p{i}").req({"cpu": "100m"}).label("app", "web")
+        if i % 3 == 0:
+            w = w.pod_anti_affinity_in("app", ["web"], ZONE)
+        s.add_pod(w.obj())
+    s.schedule_all_pending()
+    assert s.builder.host_mirror_equal()
