@@ -305,6 +305,10 @@ class ObjectMeta:
     annotations: dict[str, str] = field(default_factory=dict)
 
 
+PREEMPT_LOWER_PRIORITY = "PreemptLowerPriority"
+PREEMPT_NEVER = "Never"
+
+
 @dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
@@ -315,6 +319,7 @@ class PodSpec:
     tolerations: tuple[Toleration, ...] = ()
     topology_spread_constraints: tuple[TopologySpreadConstraint, ...] = ()
     priority: int = 0
+    preemption_policy: str = PREEMPT_LOWER_PRIORITY
     node_name: str = ""
     scheduler_name: str = "default-scheduler"
     scheduling_gates: tuple[PodSchedulingGate, ...] = ()
@@ -324,6 +329,7 @@ class PodSpec:
 class PodStatus:
     nominated_node_name: str = ""
     phase: str = "Pending"
+    start_time: float = 0.0  # pod start timestamp (preemption tie-break)
 
 
 @dataclass

@@ -67,6 +67,13 @@ class OpDef:
     # Trace-time config resolver: (profile, schema, builder_res_col) -> dict,
     # merged into PassContext.static under this op's keys.
     static: Optional[Callable] = None
+    # (state, pf, PassContext) -> (N,) bool of nodes whose rejection by this
+    # op is UNRESOLVABLE by preemption (the reference's
+    # UnschedulableAndUnresolvable status, which excludes a node from
+    # preemption candidates — preemption.go:216 findCandidates /
+    # nodesWherePreemptionMightHelp).  None ⇒ this op's failures are
+    # resolvable (e.g. resource fit, ports, anti-affinity).
+    hard_filter: Optional[Callable] = None
 
 
 from ..snapshot import POD_PORT_SLOTS  # noqa: F401  (re-export for ops)
@@ -79,6 +86,15 @@ FEATURE_FILLS: dict[str, int] = {}
 
 def feature_fill(key: str, fill: int) -> None:
     FEATURE_FILLS[key] = fill
+
+
+def invert_filter(filter_fn: Callable) -> Callable:
+    """hard_filter adapter for ops whose every rejection is unresolvable."""
+
+    def hard(state, pf, ctx):
+        return ~filter_fn(state, pf, ctx)
+
+    return hard
 
 
 _REGISTRY: dict[str, OpDef] = {}

@@ -187,6 +187,21 @@ def _domain_tables(state, slots, counts, dv):
     return vals, key_present, tbl, at_node
 
 
+def _affinity_ok(state, pf, ctx: PassContext):
+    """Incoming required-affinity check (2) — its failures are
+    UnschedulableAndUnresolvable (ErrReasonAffinityRulesNotMatch)."""
+    gc = state.group_counts.astype(jnp.float32)
+    ra_valid = pf["ipa_ra_valid"]  # (RA,)
+    any_ra = ra_valid.any()
+    cnt_all = pf["ipa_ra_allmask"].astype(jnp.float32) @ gc  # (N,)
+    ra_counts = jnp.broadcast_to(cnt_all[None, :], (ra_valid.shape[0], cnt_all.shape[0]))
+    _v, key_ra, tbl_ra, at_ra = _domain_tables(state, pf["ipa_ra_slot"], ra_counts, ctx.schema.DV)
+    keys_ok = (key_ra | ~ra_valid[:, None]).all(0)
+    pods_exist = ((at_ra > 0.5) | ~ra_valid[:, None]).all(0)
+    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], tbl_ra, 0.0)) == 0
+    return ~any_ra | (keys_ok & (pods_exist | (counts_empty & pf["ipa_ra_self"])))
+
+
 def filter_fn(state, pf, ctx: PassContext):
     gc = state.group_counts.astype(jnp.float32)  # (G, N)
     dv = ctx.schema.DV
@@ -198,15 +213,7 @@ def filter_fn(state, pf, ctx: PassContext):
     fail_existing = (active_e[:, None] & key_e & (at_node_e > 0.5)).any(0)
 
     # (2) Incoming required affinity.
-    ra_valid = pf["ipa_ra_valid"]  # (RA,)
-    any_ra = ra_valid.any()
-    cnt_all = pf["ipa_ra_allmask"].astype(jnp.float32) @ gc  # (N,)
-    ra_counts = jnp.broadcast_to(cnt_all[None, :], (ra_valid.shape[0], cnt_all.shape[0]))
-    _v, key_ra, tbl_ra, at_ra = _domain_tables(state, pf["ipa_ra_slot"], ra_counts, dv)
-    keys_ok = (key_ra | ~ra_valid[:, None]).all(0)
-    pods_exist = ((at_ra > 0.5) | ~ra_valid[:, None]).all(0)
-    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], tbl_ra, 0.0)) == 0
-    aff_ok = ~any_ra | (keys_ok & (pods_exist | (counts_empty & pf["ipa_ra_self"])))
+    aff_ok = _affinity_ok(state, pf, ctx)
 
     # (3) Incoming required anti-affinity.
     rs_valid = pf["ipa_rs_valid"]
@@ -215,6 +222,10 @@ def filter_fn(state, pf, ctx: PassContext):
     fail_anti = (rs_valid[:, None] & key_rs & (at_rs > 0.5)).any(0)
 
     return ~fail_existing & aff_ok & ~fail_anti
+
+
+def hard_filter_fn(state, pf, ctx: PassContext):
+    return ~_affinity_ok(state, pf, ctx)
 
 
 def score_fn(state, pf, ctx: PassContext, feasible):
@@ -268,5 +279,6 @@ register(
         featurize=featurize,
         filter=filter_fn,
         score=score_fn,
+        hard_filter=hard_filter_fn,
     )
 )

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..api import types as t
 from ..snapshot import INT_SENTINEL, _bucket
-from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, invert_filter, register
 from .helpers import default_normalize_score
 
 # Requirement opcodes. Pad slots are OP_PAD and evaluate True (AND identity).
@@ -226,4 +226,12 @@ for _k, _fill in [
 ]:
     feature_fill(_k, _fill)
 
-register(OpDef(name="NodeAffinity", featurize=featurize, filter=filter_fn, score=score_fn))
+register(
+    OpDef(
+        name="NodeAffinity",
+        featurize=featurize,
+        filter=filter_fn,
+        score=score_fn,
+        hard_filter=invert_filter(filter_fn),
+    )
+)

@@ -254,11 +254,21 @@ for _k, _fill in [
 ]:
     feature_fill(_k, _fill)
 
+def hard_filter_fn(state, pf, ctx: PassContext):
+    """Missing topology keys are UnschedulableAndUnresolvable
+    (filtering.go:337 ErrReasonNodeLabelNotMatch); skew violations are not."""
+    valid = pf["tps_h_valid"]
+    slots = pf["tps_h_slot"]
+    vals = jnp.take(state.topo_vals, slots, axis=1).T
+    return ((vals < 0) & valid[:, None]).any(0)
+
+
 register(
     OpDef(
         name="PodTopologySpread",
         featurize=featurize,
         filter=filter_fn,
         score=score_fn,
+        hard_filter=hard_filter_fn,
     )
 )

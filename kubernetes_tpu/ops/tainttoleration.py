@@ -20,7 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..api import types as t
-from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, invert_filter, register
 from .helpers import default_normalize_score, gather_mask
 
 _DO_NOT_SCHEDULE = (t.EFFECT_NO_SCHEDULE, t.EFFECT_NO_EXECUTE)
@@ -63,5 +63,11 @@ def score_fn(state, pf, ctx: PassContext, feasible):
 feature_fill("taint_intol_hard", 0)
 feature_fill("taint_intol_pref", 0)
 register(
-    OpDef(name="TaintToleration", featurize=featurize, filter=filter_fn, score=score_fn)
+    OpDef(
+        name="TaintToleration",
+        featurize=featurize,
+        filter=filter_fn,
+        score=score_fn,
+        hard_filter=invert_filter(filter_fn),
+    )
 )

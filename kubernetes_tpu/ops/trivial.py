@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..api import types as t
-from .common import FeaturizeContext, OpDef, PassContext, register
+from .common import FeaturizeContext, OpDef, PassContext, invert_filter, register
 
 UNSCHEDULABLE_TAINT = t.Taint(
     key="node.kubernetes.io/unschedulable", effect=t.EFFECT_NO_SCHEDULE
@@ -41,11 +41,19 @@ def unschedulable_filter(state, pf, ctx: PassContext):
     return ~state.unschedulable | pf["tolerates_unschedulable"]
 
 
-register(OpDef(name="NodeName", featurize=nodename_featurize, filter=nodename_filter))
+register(
+    OpDef(
+        name="NodeName",
+        featurize=nodename_featurize,
+        filter=nodename_filter,
+        hard_filter=invert_filter(nodename_filter),
+    )
+)
 register(
     OpDef(
         name="NodeUnschedulable",
         featurize=unschedulable_featurize,
         filter=unschedulable_filter,
+        hard_filter=invert_filter(unschedulable_filter),
     )
 )

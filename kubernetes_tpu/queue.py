@@ -157,6 +157,10 @@ class SchedulingQueue:
         expiry = self._clock() + self.backoff_duration(qp.attempts)
         heapq.heappush(self._backoff, (expiry, next(self._seq), qp.pod.uid))
 
+    def next_backoff_expiry(self) -> float | None:
+        """Earliest backoff expiry, or None when the backoffQ is empty."""
+        return self._backoff[0][0] if self._backoff else None
+
     def flush_backoff(self) -> int:
         """Move expired backoff pods to activeQ (flushBackoffQCompleted :777)."""
         now = self._clock()

@@ -23,6 +23,7 @@ from .engine.pass_ import PassCache
 from .framework.config import DEFAULT_PROFILE, Profile
 from .intern import InternTable
 from .ops.common import registered_subset
+from .preemption import PreemptionEvaluator
 from .queue import Event, QueuedPodInfo, SchedulingQueue
 from .snapshot import SnapshotBuilder
 
@@ -33,6 +34,8 @@ class ScheduleOutcome:
     node_name: str | None  # None → unschedulable this round
     score: int = 0
     feasible_nodes: int = 0
+    nominated_node: str | None = None  # set when preemption picked victims
+    victims: int = 0
 
 
 @dataclass
@@ -43,6 +46,7 @@ class SchedulerMetrics:
     schedule_attempts: int = 0
     scheduled: int = 0
     unschedulable: int = 0
+    preemptions: int = 0
     batches: int = 0
     device_time_s: float = 0.0
     featurize_time_s: float = 0.0
@@ -57,6 +61,7 @@ class TPUScheduler:
         profile: Profile = DEFAULT_PROFILE,
         batch_size: int = 256,
         queue: SchedulingQueue | None = None,
+        enable_preemption: bool = True,
     ):
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
@@ -68,6 +73,7 @@ class TPUScheduler:
         self.queue = queue or SchedulingQueue()
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
+        self.preemption = PreemptionEvaluator(self) if enable_preemption else None
         self._cycle = 0
         # Pre-intern the hot topology keys so node rows materialize them.
         for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
@@ -137,6 +143,7 @@ class TPUScheduler:
         m.batches += 1
         m.featurize_time_s += t1 - t0
         m.device_time_s += t2 - t1
+        failed: list[tuple[int, QueuedPodInfo, ScheduleOutcome]] = []
         for i, qp in enumerate(infos):
             m.schedule_attempts += 1
             row = int(picks[i])
@@ -159,18 +166,56 @@ class TPUScheduler:
                 )
             else:
                 m.unschedulable += 1
+                outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]))
+                outcomes.append(outcome)
+                failed.append((i, qp, outcome))
+
+        # PostFilter: one batched preemption pass for every failure
+        # (schedule_one.go:196 RunPostFilterPlugins → DefaultPreemption).
+        results = [None] * len(failed)
+        if failed and self.preemption is not None:
+            rows = {
+                key: [np.asarray(arr)[i] for i, _, _ in failed]
+                for key, arr in batch.items()
+                if key != "valid"
+            }
+            results = self.preemption.preempt_batch([qp.pod for _, qp, _ in failed], rows)
+        any_victims = False
+        for (_, qp, outcome), res in zip(failed, results):
+            if res is not None:
+                m.preemptions += 1
+                outcome.nominated_node = res.node_name
+                outcome.victims = len(res.victims)
+                any_victims = any_victims or bool(res.victims)
+                # The reference waits for the victims' graceful deletion
+                # (requeue on their delete events); in-process deletion is
+                # synchronous, so the nominated pod can retry immediately.
+                self.queue.add(qp.pod)
+            else:
                 # Without per-plugin diagnosis (the fast path), requeue waits
                 # on any event the profile's filters care about.
                 self.queue.add_unschedulable(qp, set(self.profile.filters))
-                outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
+        if any_victims:
+            self.queue.on_event(Event.POD_DELETE)
         return outcomes
 
-    def schedule_all_pending(self, max_rounds: int = 10_000) -> list[ScheduleOutcome]:
-        """Drain the active queue (benchmark driver)."""
+    def schedule_all_pending(
+        self, max_rounds: int = 10_000, wait_backoff: bool = False
+    ) -> list[ScheduleOutcome]:
+        """Drain the active queue (benchmark driver).  With ``wait_backoff``
+        the loop also sleeps through backoff expiries (so preempted pods get
+        their retry) until only unschedulable/gated pods remain."""
         all_outcomes: list[ScheduleOutcome] = []
         for _ in range(max_rounds):
             out = self.schedule_batch()
             if not out:
+                if wait_backoff:
+                    expiry = self.queue.next_backoff_expiry()
+                    if expiry is not None:
+                        # Expiries live in the queue's clock domain (it may be
+                        # a fake clock in tests).
+                        time.sleep(max(0.0, expiry - self.queue._clock()) + 1e-3)
+                        continue
                 break
             all_outcomes.extend(out)
         return all_outcomes

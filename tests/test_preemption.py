@@ -1,0 +1,173 @@
+"""Batched preemption vs the reference's victim-selection semantics."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile, fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def sched(batch_size=8, profile=None):
+    return TPUScheduler(profile=profile or fit_only_profile(), batch_size=batch_size)
+
+
+def test_preempts_lower_priority_pod():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("victim").req({"cpu": "2"}).priority(1).node("n1").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    by_name = {o.pod.name: o for o in out}
+    assert by_name["vip"].nominated_node == "n1" or by_name["vip"].node_name == "n1"
+    final = [o for o in out if o.pod.name == "vip" and o.node_name]
+    assert final and final[0].node_name == "n1"
+    assert "default/victim" not in s.cache.pods
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("incumbent").req({"cpu": "2"}).priority(100).node("n1").obj())
+    s.add_pod(make_pod("peer").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert all(o.node_name is None for o in out if o.pod.name == "peer")
+    assert "default/incumbent" in s.cache.pods
+
+
+def test_preemption_policy_never():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("victim").req({"cpu": "2"}).priority(1).node("n1").obj())
+    s.add_pod(
+        make_pod("meek").req({"cpu": "2"}).priority(100)
+        .preemption_policy(t.PREEMPT_NEVER).obj()
+    )
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert all(o.node_name is None for o in out if o.pod.name == "meek")
+    assert "default/victim" in s.cache.pods
+
+
+def test_minimal_victim_set():
+    """Only as many victims as needed are removed, least important first."""
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("v-lo").req({"cpu": "2"}).priority(1).node("n1").obj())
+    s.add_pod(make_pod("v-hi").req({"cpu": "2"}).priority(50).node("n1").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert any(o.node_name == "n1" for o in out if o.pod.name == "vip")
+    assert "default/v-lo" not in s.cache.pods  # lowest priority evicted
+    assert "default/v-hi" in s.cache.pods  # reprieved
+
+
+def test_picks_node_with_lowest_max_victim_priority():
+    s = sched()
+    s.add_node(make_node("cheap").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_node(make_node("dear").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("low").req({"cpu": "2"}).priority(5).node("cheap").obj())
+    s.add_pod(make_pod("high").req({"cpu": "2"}).priority(50).node("dear").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip"]
+    assert vip[0].nominated_node == "cheap"
+    assert "default/low" not in s.cache.pods and "default/high" in s.cache.pods
+
+
+def test_fewest_victims_tiebreak():
+    s = sched()
+    s.add_node(make_node("many").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_node(make_node("one").capacity({"cpu": "2", "pods": 110}).obj())
+    for i in range(2):
+        s.add_pod(make_pod(f"m{i}").req({"cpu": "1"}).priority(5).node("many").obj())
+    s.add_pod(make_pod("solo").req({"cpu": "2"}).priority(5).node("one").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip"]
+    assert vip[0].nominated_node == "one"
+    assert vip[0].victims == 1
+
+
+def test_unresolvable_nodes_excluded():
+    """Preemption cannot fix a missing node-affinity label."""
+    prof = Profile(
+        name="na-fit",
+        filters=("NodeResourcesFit", "NodeAffinity"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+    s = sched(profile=prof)
+    s.add_node(make_node("wrong").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_node(make_node("right").capacity({"cpu": "2", "pods": 110}).label("disk", "ssd").obj())
+    s.add_pod(make_pod("v1").req({"cpu": "2"}).priority(1).node("right").obj())
+    s.add_pod(make_pod("v2").req({"cpu": "4"}).priority(1).node("wrong").obj())
+    s.add_pod(
+        make_pod("vip").req({"cpu": "2"}).priority(100)
+        .node_affinity_in("disk", ["ssd"]).obj()
+    )
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip"]
+    assert vip[0].nominated_node == "right"
+    assert "default/v2" in s.cache.pods  # the unresolvable node's pod untouched
+    assert any(o.node_name == "right" for o in out if o.pod.name == "vip")
+
+
+def test_preemption_randomized_resource_only():
+    """Chosen node must satisfy the lexicographic criteria vs a scalar oracle."""
+    rng = np.random.default_rng(31)
+    s = sched(batch_size=16)
+    n_nodes = 10
+    caps = {}
+    for i in range(n_nodes):
+        cpu = int(rng.integers(2, 8))
+        caps[f"n{i}"] = cpu * 1000
+        s.add_node(make_node(f"n{i}").capacity({"cpu": cpu, "pods": 110}).obj())
+    pods_on = {f"n{i}": [] for i in range(n_nodes)}
+    uid = 0
+    for name in pods_on:
+        free = caps[name]
+        while free >= 1000 and rng.integers(0, 4):
+            cpu = int(rng.integers(1, max(free // 1000, 2))) * 1000
+            prio = int(rng.integers(1, 50))
+            p = (
+                make_pod(f"bg{uid}").req({"cpu": f"{cpu}m"}).priority(prio)
+                .start_time(float(uid)).node(name).obj()
+            )
+            s.add_pod(p)
+            pods_on[name].append((prio, cpu, f"bg{uid}"))
+            free -= cpu
+            uid += 1
+
+    vip_cpu = 2000
+    s.add_pod(make_pod("vip").req({"cpu": f"{vip_cpu}m"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip"]
+
+    # Oracle: minimal victim prefix per node (priority asc), then criteria.
+    def plan(name):
+        used = sum(c for _, c, _ in pods_on[name])
+        free = caps[name] - used
+        if free >= vip_cpu:
+            return None  # no preemption needed — would have scheduled
+        vics = sorted(pods_on[name], key=lambda v: v[0])
+        rel, chosen = 0, []
+        for prio, cpu, uid_ in vics:
+            if free + rel >= vip_cpu:
+                break
+            rel += cpu
+            chosen.append((prio, cpu, uid_))
+        if free + rel < vip_cpu:
+            return None
+        return chosen
+
+    plans = {name: plan(name) for name in pods_on}
+    direct = [n for n, used in plans.items() if used is None and
+              caps[n] - sum(c for _, c, _ in pods_on[n]) >= vip_cpu]
+    if direct:
+        assert vip[0].node_name in direct
+        return
+    viable = {n: p for n, p in plans.items() if p}
+    assert viable, "oracle says nothing viable"
+    assert vip[0].nominated_node in viable
+    got = viable[vip[0].nominated_node]
+    best_maxprio = min(max(pr for pr, _, _ in p) for p in viable.values())
+    assert max(pr for pr, _, _ in got) == best_maxprio
