@@ -1,83 +1,38 @@
-"""Benchmark: pods scheduled/sec at 5k-node scale on one TPU chip.
+"""Benchmark driver: ONE JSON line for the headline metric.
 
-Mirrors the shape of the reference's scheduler_perf SchedulingBasic workload
-(test/integration/scheduler_perf/config/performance-config.yaml — 5000 nodes,
-measured pods scheduled per second; upstream CI threshold 270 pods/s on the
-5000Nodes_10000Pods case).  Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
+Headline: pods scheduled/sec at 5k-node/30k-pod scale with the full default
+plugin profile on one TPU chip (BASELINE config #4; upstream CI threshold for
+the closest case, SchedulingBasic 5000Nodes_10000Pods, is 270 pods/s —
+test/integration/scheduler_perf/config/performance-config.yaml:51).
+
+Run ``python -m kubernetes_tpu.benchmarks.harness`` for the full
+scheduler_perf-style suite (each workload prints its own JSON DataItem).
 """
 
 from __future__ import annotations
 
 import json
-import time
 
 UPSTREAM_BASELINE_PODS_PER_SEC = 270.0  # performance-config.yaml:51 threshold
 
 
-def run(n_nodes: int = 5000, n_pods: int = 30000, batch_size: int = 4096) -> dict:
-    from kubernetes_tpu.api.wrappers import make_node, make_pod
-    from kubernetes_tpu.framework.config import DEFAULT_PROFILE
-    from kubernetes_tpu.ops.common import registered_subset
-    from kubernetes_tpu.scheduler import TPUScheduler
-
-    sched = TPUScheduler(profile=registered_subset(DEFAULT_PROFILE), batch_size=batch_size)
-    for i in range(n_nodes):
-        sched.add_node(
-            make_node(f"node-{i}")
-            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
-            .zone(f"zone-{i % 3}")
-            .region("region-1")
-            .obj()
-        )
-    pods = [
-        make_pod(f"pod-{i}")
-        .req({"cpu": "900m", "memory": "2Gi"})
-        .label("app", f"app-{i % 10}")
-        .obj()
-        for i in range(n_pods)
-    ]
-
-    # Warm up compilation on a throwaway batch shape.
-    warm = [make_pod(f"warm-{i}").req({"cpu": "100m"}).obj() for i in range(batch_size)]
-    for p in warm:
-        sched.add_pod(p)
-    sched.schedule_all_pending()
-
-    for p in pods:
-        sched.add_pod(p)
-    t0 = time.perf_counter()
-    out = sched.schedule_all_pending()
-    dt = time.perf_counter() - t0
-    scheduled = sum(1 for o in out if o.node_name)
-    m = sched.metrics
-    return {
-        "pods": n_pods,
-        "nodes": n_nodes,
-        "scheduled": scheduled,
-        "seconds": dt,
-        "pods_per_sec": scheduled / dt if dt > 0 else 0.0,
-        "device_s": m.device_time_s,
-        "featurize_s": m.featurize_time_s,
-        "batches": m.batches,
-    }
-
-
 def main() -> None:
-    r = run()
-    value = round(r["pods_per_sec"], 1)
+    from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
+
+    r = run_workload(WORKLOADS["density_5kn_30kpods_default"])
     print(
         json.dumps(
             {
                 "metric": "scheduling_throughput_5k_nodes_30k_pods_default_plugins",
-                "value": value,
+                "value": r["pods_per_sec"],
                 "unit": "pods/s",
-                "vs_baseline": round(value / UPSTREAM_BASELINE_PODS_PER_SEC, 2),
+                "vs_baseline": round(r["pods_per_sec"] / UPSTREAM_BASELINE_PODS_PER_SEC, 2),
                 "detail": {
                     "scheduled": r["scheduled"],
-                    "seconds": round(r["seconds"], 3),
-                    "device_s": round(r["device_s"], 3),
-                    "featurize_s": round(r["featurize_s"], 3),
+                    "seconds": r["seconds"],
+                    "throughput": r["throughput"],
+                    "device_s": r["device_s"],
+                    "featurize_s": r["featurize_s"],
                     "batches": r["batches"],
                 },
             }

@@ -1,0 +1,1 @@
+from .harness import run_workload, WORKLOADS  # noqa: F401

@@ -1,0 +1,448 @@
+"""scheduler_perf-style benchmark harness.
+
+Mirrors the reference's config-driven workload runner
+(test/integration/scheduler_perf/scheduler_perf.go): a workload is a list of
+ops — createNodes, createPods (optionally measured), churn, barrier — and the
+headline metric is SchedulingThroughput: pods scheduled per second, with
+avg/p50/p90/p99 computed over 1-second windows exactly like
+scheduler_perf's util.go:629 collector.  Output is a JSON DataItems list in
+the same spirit (util.go:191).
+
+Workloads include TPU-native ports of the upstream performance-config.yaml
+cases whose thresholds are recorded in BASELINE.md, plus the five
+BASELINE.json A/B configs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..api import types as t
+from ..api.wrappers import make_node, make_pod
+from ..framework.config import DEFAULT_PROFILE, Profile, fit_only_profile
+from ..ops.common import registered_subset
+from ..scheduler import TPUScheduler
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+@dataclass
+class Workload:
+    name: str
+    baseline_pods_per_sec: float  # upstream threshold (BASELINE.md) or 0
+    build: Callable[[], TPUScheduler]
+    nodes: Callable[[TPUScheduler], None]
+    warmup: Callable[[TPUScheduler], None]
+    measured: Callable[[TPUScheduler], int]  # returns expected pod count
+    wait_backoff: bool = False
+
+
+def _throughput_percentiles(samples: list[float]) -> dict:
+    if not samples:
+        return {"avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+    a = np.asarray(samples, np.float64)
+    return {
+        "avg": float(a.mean()),
+        "p50": float(np.percentile(a, 50)),
+        "p90": float(np.percentile(a, 90)),
+        "p99": float(np.percentile(a, 99)),
+    }
+
+
+def run_workload(w: Workload) -> dict:
+    sched = w.build()
+    w.nodes(sched)
+    w.warmup(sched)
+    sched.schedule_all_pending(wait_backoff=w.wait_backoff)
+    # Reset measurement state after warmup compilations.
+    m = sched.metrics
+    m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
+    m.preemptions = 0
+    m.device_time_s = m.featurize_time_s = 0.0
+
+    expected = w.measured(sched)
+    windows: list[tuple[float, int]] = []  # (timestamp, scheduled so far)
+    t0 = time.perf_counter()
+    scheduled = 0
+    while True:
+        out = sched.schedule_batch()
+        if not out:
+            if w.wait_backoff and sched.queue.sleep_until_backoff():
+                continue
+            break
+        scheduled += sum(1 for o in out if o.node_name)
+        windows.append((time.perf_counter(), scheduled))
+    dt = time.perf_counter() - t0
+
+    # 1-second-window throughput samples (util.go:629): resample the batch
+    # completion curve onto a 1s grid.
+    samples: list[float] = []
+    if windows and dt > 0:
+        grid = np.arange(1.0, max(dt, 1.0) + 1e-9, 1.0)
+        ts = np.asarray([w_[0] - t0 for w_ in windows])
+        counts = np.asarray([w_[1] for w_ in windows], np.float64)
+        prev = 0.0
+        for g in grid:
+            c = float(np.interp(g, ts, counts, left=0.0, right=counts[-1]))
+            samples.append(c - prev)
+            prev = c
+        if not samples:
+            samples = [scheduled / dt]
+    pct = _throughput_percentiles(samples)
+
+    return {
+        "name": w.name,
+        "scheduled": scheduled,
+        "expected": expected,
+        "seconds": round(dt, 3),
+        "pods_per_sec": round(scheduled / dt, 1) if dt > 0 else 0.0,
+        "throughput": {k: round(v, 1) for k, v in pct.items()},
+        "baseline": w.baseline_pods_per_sec,
+        "vs_baseline": round(scheduled / dt / w.baseline_pods_per_sec, 2)
+        if dt > 0 and w.baseline_pods_per_sec
+        else None,
+        "device_s": round(m.device_time_s, 3),
+        "featurize_s": round(m.featurize_time_s, 3),
+        "batches": m.batches,
+        "preemptions": m.preemptions,
+    }
+
+
+# --------------------------------------------------------------------------
+# Workload definitions
+# --------------------------------------------------------------------------
+
+
+def _basic_nodes(n: int, zones: int = 3, cpu: str = "16", mem: str = "64Gi"):
+    def add(s: TPUScheduler):
+        for i in range(n):
+            s.add_node(
+                make_node(f"node-{i}")
+                .capacity({"cpu": cpu, "memory": mem, "pods": 110})
+                .zone(f"zone-{i % zones}")
+                .region("region-1")
+                .obj()
+            )
+
+    return add
+
+
+def _warm(template: Callable[[int], t.Pod], count: int = 2048):
+    def warm(s: TPUScheduler):
+        for i in range(count):
+            p = template(10**6 + i)
+            p.metadata.name = f"warm-{i}"
+            s.add_pod(p)
+
+    return warm
+
+
+def _measured(template: Callable[[int], t.Pod], count: int):
+    def measure(s: TPUScheduler) -> int:
+        for i in range(count):
+            s.add_pod(template(i))
+        return count
+
+    return measure
+
+
+def _pod_basic(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "900m", "memory": "2Gi"})
+        .label("app", f"app-{i % 10}")
+        .obj()
+    )
+
+
+def _pod_anti_affinity(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("color", f"c{i % 100}")
+        .pod_anti_affinity_in("color", [f"c{i % 100}"], ZONE)
+        .obj()
+    )
+
+
+def _pod_affinity(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("color", f"c{i % 50}")
+        .pod_affinity_in("color", [f"c{i % 50}"], ZONE)
+        .obj()
+    )
+
+
+def _pod_pref_affinity(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("color", f"c{i % 50}")
+        .preferred_pod_affinity_in("color", [f"c{i % 50}"], ZONE, weight=10)
+        .obj()
+    )
+
+
+def _pod_spread(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("app", f"app-{i % 10}")
+        .spread_constraint(1, ZONE, t.DO_NOT_SCHEDULE, "app", [f"app-{i % 10}"])
+        .obj()
+    )
+
+
+def _pod_node_affinity(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "900m", "memory": "2Gi"})
+        .node_affinity_in(ZONE, [f"zone-{i % 3}"])
+        .obj()
+    )
+
+
+def _default(batch: int = 4096) -> Callable[[], TPUScheduler]:
+    return lambda: TPUScheduler(
+        profile=registered_subset(DEFAULT_PROFILE), batch_size=batch
+    )
+
+
+def _fit(batch: int = 4096) -> Callable[[], TPUScheduler]:
+    return lambda: TPUScheduler(profile=fit_only_profile(), batch_size=batch)
+
+
+WORKLOADS: dict[str, Workload] = {}
+
+
+def _register(w: Workload) -> None:
+    WORKLOADS[w.name] = w
+
+
+# BASELINE config #1: SchedulingBasic 500 nodes / 1k pods, fit-only.
+_register(
+    Workload(
+        name="basic_500n_1kpods_fitonly",
+        baseline_pods_per_sec=270.0,
+        build=_fit(1024),
+        nodes=_basic_nodes(500),
+        warmup=_warm(_pod_basic, 1024),
+        measured=_measured(lambda i: make_pod(f"m-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj(), 1000),
+    )
+)
+
+# Upstream SchedulingBasic shape: 5k nodes / 10k pods, default plugins.
+_register(
+    Workload(
+        name="basic_5kn_10kpods",
+        baseline_pods_per_sec=270.0,
+        build=_default(),
+        nodes=_basic_nodes(5000),
+        warmup=_warm(_pod_basic),
+        measured=_measured(_pod_basic, 10000),
+    )
+)
+
+# BASELINE config #2: spread + node affinity, 1k nodes / 5k pods, 3 zones.
+def _pod_spread_na(i: int) -> t.Pod:
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("app", f"app-{i % 10}")
+        .spread_constraint(2, ZONE, t.DO_NOT_SCHEDULE, "app", [f"app-{i % 10}"])
+        .node_affinity_in(ZONE, ["zone-0", "zone-1", "zone-2"])
+        .obj()
+    )
+
+
+_register(
+    Workload(
+        name="spread_nodeaffinity_1kn_5kpods",
+        baseline_pods_per_sec=85.0,
+        build=_default(),
+        nodes=_basic_nodes(1000),
+        warmup=_warm(_pod_spread_na, 1024),
+        measured=_measured(_pod_spread_na, 5000),
+    )
+)
+
+# BASELINE config #3: InterPodAffinity-heavy, 1k nodes / 10k pods.
+def _pod_ipa_heavy(i: int) -> t.Pod:
+    if i % 2:
+        return _pod_affinity(i)
+    return _pod_anti_affinity(i)
+
+
+_register(
+    Workload(
+        name="interpodaffinity_1kn_10kpods",
+        baseline_pods_per_sec=35.0,
+        build=_default(),
+        nodes=_basic_nodes(1000, zones=10),
+        warmup=_warm(_pod_ipa_heavy, 1024),
+        measured=_measured(_pod_ipa_heavy, 10000),
+    )
+)
+
+# BASELINE config #4 (headline): 5k nodes / 30k pods, full default profile.
+_register(
+    Workload(
+        name="density_5kn_30kpods_default",
+        baseline_pods_per_sec=270.0,
+        build=_default(),
+        nodes=_basic_nodes(5000),
+        warmup=_warm(_pod_basic),
+        measured=_measured(_pod_basic, 30000),
+    )
+)
+
+# BASELINE config #5: gang-style 15k-pod queue in large co-scheduled batches.
+_register(
+    Workload(
+        name="gang_15kpods_batch",
+        baseline_pods_per_sec=270.0,
+        build=_default(8192),
+        nodes=_basic_nodes(5000),
+        warmup=_warm(_pod_basic),
+        measured=_measured(_pod_basic, 15000),
+    )
+)
+
+# Upstream SchedulingPodAntiAffinity: 5k nodes / 2k pods.
+_register(
+    Workload(
+        name="pod_anti_affinity_5kn_2kpods",
+        baseline_pods_per_sec=70.0,
+        build=_default(2048),
+        nodes=_basic_nodes(5000, zones=100),
+        warmup=_warm(_pod_anti_affinity, 512),
+        measured=_measured(_pod_anti_affinity, 2000),
+    )
+)
+
+# Upstream SchedulingPodAffinity: 5k nodes / 5k pods.
+_register(
+    Workload(
+        name="pod_affinity_5kn_5kpods",
+        baseline_pods_per_sec=35.0,
+        build=_default(),
+        nodes=_basic_nodes(5000, zones=50),
+        warmup=_warm(_pod_affinity, 1024),
+        measured=_measured(_pod_affinity, 5000),
+    )
+)
+
+# Upstream SchedulingPreferredPodAffinity: 5k nodes / 5k pods.
+_register(
+    Workload(
+        name="preferred_pod_affinity_5kn_5kpods",
+        baseline_pods_per_sec=90.0,
+        build=_default(),
+        nodes=_basic_nodes(5000, zones=50),
+        warmup=_warm(_pod_pref_affinity, 1024),
+        measured=_measured(_pod_pref_affinity, 5000),
+    )
+)
+
+# Upstream TopologySpreading: 5k nodes / 5k pods.
+_register(
+    Workload(
+        name="topology_spreading_5kn_5kpods",
+        baseline_pods_per_sec=85.0,
+        build=_default(),
+        nodes=_basic_nodes(5000, zones=10),
+        warmup=_warm(_pod_spread, 1024),
+        measured=_measured(_pod_spread, 5000),
+    )
+)
+
+# Upstream SchedulingNodeAffinity: 5k nodes / 10k pods.
+_register(
+    Workload(
+        name="node_affinity_5kn_10kpods",
+        baseline_pods_per_sec=220.0,
+        build=_default(),
+        nodes=_basic_nodes(5000),
+        warmup=_warm(_pod_node_affinity, 1024),
+        measured=_measured(_pod_node_affinity, 10000),
+    )
+)
+
+# Upstream PreemptionBasic: 500 nodes, low-priority fill then high-priority wave.
+def _preemption_nodes(s: TPUScheduler):
+    _basic_nodes(500, cpu="4", mem="16Gi")(s)
+
+
+def _preemption_warm(s: TPUScheduler):
+    for i in range(2000):
+        s.add_pod(
+            make_pod(f"bg-{i}").req({"cpu": "1", "memory": "2Gi"}).priority(1)
+            .start_time(float(i)).obj()
+        )
+
+
+def _preemption_measured(s: TPUScheduler) -> int:
+    for i in range(500):
+        s.add_pod(
+            make_pod(f"vip-{i}").req({"cpu": "2", "memory": "4Gi"}).priority(1000).obj()
+        )
+    return 500
+
+
+_register(
+    Workload(
+        name="preemption_500n",
+        baseline_pods_per_sec=18.0,
+        build=_fit(512),
+        nodes=_preemption_nodes,
+        warmup=_preemption_warm,
+        measured=_preemption_measured,
+        wait_backoff=True,
+    )
+)
+
+# Upstream Unschedulable: 5k nodes, 10k pods that cannot schedule + churn pods.
+def _unsched_measured(s: TPUScheduler) -> int:
+    for i in range(5000):
+        s.add_pod(
+            make_pod(f"stuck-{i}").req({"cpu": "999", "memory": "2Gi"}).obj()
+        )
+    for i in range(5000):
+        s.add_pod(_pod_basic(i))
+    return 5000
+
+
+_register(
+    Workload(
+        name="unschedulable_5kn_10kpods",
+        baseline_pods_per_sec=200.0,
+        build=_default(),
+        nodes=_basic_nodes(5000),
+        warmup=_warm(_pod_basic),
+        measured=_unsched_measured,
+    )
+)
+
+
+def main(names: list[str] | None = None) -> list[dict]:
+    results = []
+    for name, w in WORKLOADS.items():
+        if names and name not in names:
+            continue
+        r = run_workload(w)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:] or None)

@@ -110,20 +110,36 @@ def build_preempt_pass(profile: Profile, schema: Schema, builder_res_col):
         possible = candidate & any_fit & (n_vic >= 1) & pf["valid"]
 
         idx = jnp.maximum(k_star - 1, 0)
-        run_max_prio = lax.associative_scan(
-            jnp.maximum, jnp.where(lower, vic_prio, -1), axis=1
+
+        # Running (max victim priority, earliest start AMONG those
+        # max-priority victims) — criterion 5 compares the highest-priority
+        # victims' start times only (GetEarliestPodStartTime,
+        # preemption.go pickOneNodeForPreemption).
+        def _combine(a, b):
+            ap, as_ = a
+            bp, bs = b
+            p = jnp.maximum(ap, bp)
+            s = jnp.where(
+                ap == bp,
+                jnp.minimum(as_, bs),
+                jnp.where(ap > bp, as_, bs),
+            )
+            return p, s
+
+        run_max_prio, run_start = lax.associative_scan(
+            _combine,
+            (
+                jnp.where(lower, vic_prio, -1),
+                jnp.where(lower, vic_start, jnp.inf),
+            ),
+            axis=1,
         )
         max_prio = jnp.take_along_axis(run_max_prio, idx[:, None], axis=1)[:, 0]
         prio_sum = jnp.take_along_axis(
             jnp.cumsum(jnp.where(lower, vic_prio, 0).astype(jnp.int64), axis=1),
             idx[:, None], axis=1,
         )[:, 0]
-        run_min_start = jnp.take_along_axis(
-            lax.associative_scan(
-                jnp.minimum, jnp.where(lower, vic_start, jnp.inf), axis=1
-            ),
-            idx[:, None], axis=1,
-        )[:, 0]
+        run_min_start = jnp.take_along_axis(run_start, idx[:, None], axis=1)[:, 0]
 
         big = jnp.int64(2**62)
 
@@ -201,7 +217,22 @@ class PreemptionEvaluator:
         cache, builder = sched.cache, sched.builder
         schema = builder.schema
 
-        eligible = [p.spec.preemption_policy != t.PREEMPT_NEVER for p in pods]
+        # Cheap host-side prune: a pod whose demand exceeds every node's
+        # allocatable can never be helped by deletion (prevents repacking
+        # victim tensors for perma-stuck pods every batch).
+        max_alloc = builder.host["alloc"].max(axis=0)
+        max_allowed = int(builder.host["allowed_pods"].max(initial=0))
+
+        def can_ever_fit(p: t.Pod) -> bool:
+            pr = cache.pods.get(p.uid)
+            delta = pr.delta if pr else builder.pod_delta_vectors(p)
+            req = delta["req"]
+            return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
+
+        eligible = [
+            p.spec.preemption_policy != t.PREEMPT_NEVER and can_ever_fit(p)
+            for p in pods
+        ]
         if not any(eligible):
             return [None] * len(pods)
 

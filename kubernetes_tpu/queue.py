@@ -161,6 +161,16 @@ class SchedulingQueue:
         """Earliest backoff expiry, or None when the backoffQ is empty."""
         return self._backoff[0][0] if self._backoff else None
 
+    def sleep_until_backoff(self) -> bool:
+        """Sleep until the earliest backoff expires.  Returns False when
+        there is nothing to wait for — including under an injected test
+        clock, which wall-clock sleeping can never advance."""
+        expiry = self.next_backoff_expiry()
+        if expiry is None or self._clock is not time.monotonic:
+            return False
+        time.sleep(max(0.0, expiry - self._clock()) + 1e-3)
+        return True
+
     def flush_backoff(self) -> int:
         """Move expired backoff pods to activeQ (flushBackoffQCompleted :777)."""
         now = self._clock()

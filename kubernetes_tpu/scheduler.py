@@ -62,6 +62,7 @@ class TPUScheduler:
         batch_size: int = 256,
         queue: SchedulingQueue | None = None,
         enable_preemption: bool = True,
+        mesh=None,
     ):
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
@@ -74,6 +75,10 @@ class TPUScheduler:
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None
+        if mesh is not None:
+            # Multi-chip: node axis sharded over the mesh (parallel/mesh.py);
+            # XLA inserts the ICI collectives for the cross-shard reductions.
+            self.builder.set_mesh(mesh)
         self._cycle = 0
         # Pre-intern the hot topology keys so node rows materialize them.
         for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
@@ -209,13 +214,8 @@ class TPUScheduler:
         for _ in range(max_rounds):
             out = self.schedule_batch()
             if not out:
-                if wait_backoff:
-                    expiry = self.queue.next_backoff_expiry()
-                    if expiry is not None:
-                        # Expiries live in the queue's clock domain (it may be
-                        # a fake clock in tests).
-                        time.sleep(max(0.0, expiry - self.queue._clock()) + 1e-3)
-                        continue
+                if wait_backoff and self.queue.sleep_until_backoff():
+                    continue
                 break
             all_outcomes.extend(out)
         return all_outcomes

@@ -197,6 +197,9 @@ class SnapshotBuilder:
         # (the analog of the scheduler's namespace lister snapshot,
         # interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).
         self.namespace_labels: dict[str, dict[str, str]] = {}
+        # Optional multi-chip mesh: node axis sharded, everything else
+        # replicated (parallel/mesh.py).
+        self.mesh = None
         self.host = _host_arrays(self.schema)
         self._device: ClusterState | None = None
         self._dirty_rows: set[int] = set()
@@ -394,12 +397,21 @@ class SnapshotBuilder:
 
     # -- device mirror ---------------------------------------------------------
 
+    def set_mesh(self, mesh) -> None:
+        """Shard the node axis over ``mesh`` from the next full flush on."""
+        self.mesh = mesh
+        self._dirty_all = True
+
     def state(self) -> ClusterState:
         """Return the device ClusterState, flushing pending host changes."""
         if self._dirty_all or self._device is None:
             self._device = ClusterState(
                 **{k: jnp.asarray(v) for k, v in self.host.items()}
             )
+            if self.mesh is not None:
+                from .parallel.mesh import shard_cluster_state
+
+                self._device = shard_cluster_state(self._device, self.mesh)
             self._dirty_all = False
             self._dirty_rows.clear()
             return self._device

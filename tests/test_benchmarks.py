@@ -1,0 +1,41 @@
+"""Benchmark harness smoke tests (tiny shapes; CPU)."""
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.benchmarks.harness import WORKLOADS, Workload, run_workload
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def test_workload_registry_covers_baseline_configs():
+    names = set(WORKLOADS)
+    # The five BASELINE.json A/B configs all have harness entries.
+    assert "basic_500n_1kpods_fitonly" in names  # config 1
+    assert "spread_nodeaffinity_1kn_5kpods" in names  # config 2
+    assert "interpodaffinity_1kn_10kpods" in names  # config 3
+    assert "density_5kn_30kpods_default" in names  # config 4
+    assert "gang_15kpods_batch" in names  # config 5
+
+
+def test_run_workload_smoke():
+    w = Workload(
+        name="tiny",
+        baseline_pods_per_sec=10.0,
+        build=lambda: TPUScheduler(profile=fit_only_profile(), batch_size=32),
+        nodes=lambda s: [
+            s.add_node(make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj())
+            for i in range(8)
+        ],
+        warmup=lambda s: [
+            s.add_pod(make_pod(f"w{i}").req({"cpu": "100m"}).obj()) for i in range(4)
+        ],
+        measured=lambda s: [
+            s.add_pod(make_pod(f"m{i}").req({"cpu": "100m"}).obj()) for i in range(16)
+        ]
+        and 16,
+    )
+    r = run_workload(w)
+    assert r["scheduled"] == 16
+    assert r["expected"] == 16
+    assert r["pods_per_sec"] > 0
+    assert set(r["throughput"]) == {"avg", "p50", "p90", "p99"}
+    assert r["vs_baseline"] is not None

@@ -171,3 +171,23 @@ def test_preemption_randomized_resource_only():
     got = viable[vip[0].nominated_node]
     best_maxprio = min(max(pr for pr, _, _ in p) for p in viable.values())
     assert max(pr for pr, _, _ in got) == best_maxprio
+
+
+def test_latest_start_tiebreak_uses_highest_priority_victims():
+    """Criterion 5 compares earliest start among HIGHEST-priority victims
+    (GetEarliestPodStartTime), not among all victims."""
+    s = sched()
+    s.add_node(make_node("a").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_node(make_node("b").capacity({"cpu": "2", "pods": 110}).obj())
+    # Node a: prio-5 victim started at 10, prio-1 victim started at 1.
+    s.add_pod(make_pod("a5").req({"cpu": "1"}).priority(5).start_time(10.0).node("a").obj())
+    s.add_pod(make_pod("a1").req({"cpu": "1"}).priority(1).start_time(1.0).node("a").obj())
+    # Node b: prio-5 victim started at 5, prio-1 victim started at 2.
+    s.add_pod(make_pod("b5").req({"cpu": "1"}).priority(5).start_time(5.0).node("b").obj())
+    s.add_pod(make_pod("b1").req({"cpu": "1"}).priority(1).start_time(2.0).node("b").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip"]
+    # Ties on criteria 1-4 (max prio 5, sum 6, two victims); highest-priority
+    # victims' earliest starts are 10 (a) vs 5 (b) → latest wins → node a.
+    assert vip[0].nominated_node == "a"
