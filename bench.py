@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import json
 
-UPSTREAM_BASELINE_PODS_PER_SEC = 270.0  # performance-config.yaml:51 threshold
-
 
 def main() -> None:
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
@@ -26,7 +24,7 @@ def main() -> None:
                 "metric": "scheduling_throughput_5k_nodes_30k_pods_default_plugins",
                 "value": r["pods_per_sec"],
                 "unit": "pods/s",
-                "vs_baseline": round(r["pods_per_sec"] / UPSTREAM_BASELINE_PODS_PER_SEC, 2),
+                "vs_baseline": r["vs_baseline"],
                 "detail": {
                     "scheduled": r["scheduled"],
                     "seconds": r["seconds"],

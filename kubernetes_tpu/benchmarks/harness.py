@@ -80,19 +80,23 @@ def run_workload(w: Workload) -> dict:
     dt = time.perf_counter() - t0
 
     # 1-second-window throughput samples (util.go:629): resample the batch
-    # completion curve onto a 1s grid.
+    # completion curve onto a 1s grid.  Runs shorter than one window, and the
+    # final partial window, fall back to / are scaled by their true duration.
     samples: list[float] = []
     if windows and dt > 0:
-        grid = np.arange(1.0, max(dt, 1.0) + 1e-9, 1.0)
-        ts = np.asarray([w_[0] - t0 for w_ in windows])
-        counts = np.asarray([w_[1] for w_ in windows], np.float64)
-        prev = 0.0
-        for g in grid:
-            c = float(np.interp(g, ts, counts, left=0.0, right=counts[-1]))
-            samples.append(c - prev)
-            prev = c
-        if not samples:
+        if dt < 1.0:
             samples = [scheduled / dt]
+        else:
+            ts = np.asarray([w_[0] - t0 for w_ in windows])
+            counts = np.asarray([w_[1] for w_ in windows], np.float64)
+            prev = 0.0
+            for g in np.arange(1.0, dt + 1e-9, 1.0):
+                c = float(np.interp(g, ts, counts, left=0.0, right=counts[-1]))
+                samples.append(c - prev)
+                prev = c
+            tail = dt - float(int(dt))
+            if tail > 0.05:  # rate over the final partial window
+                samples.append((scheduled - prev) / tail)
     pct = _throughput_percentiles(samples)
 
     return {
@@ -432,6 +436,12 @@ _register(
 
 
 def main(names: list[str] | None = None) -> list[dict]:
+    if names:
+        unknown = [n for n in names if n not in WORKLOADS]
+        if unknown:
+            raise SystemExit(
+                f"unknown workload(s): {unknown}; available: {sorted(WORKLOADS)}"
+            )
     results = []
     for name, w in WORKLOADS.items():
         if names and name not in names:

@@ -20,19 +20,28 @@ opcommon.feature_fill("ipa_own_terms", -1)
 
 def build_pod_batch(
     pods: list[t.Pod], builder: SnapshotBuilder, profile: Profile, k: int
-) -> tuple[dict, list[dict]]:
+) -> tuple[dict, list[dict], frozenset[str]]:
     """Featurize up to ``k`` pods into a dict of (k, …) numpy arrays, plus the
     per-pod commit deltas (reused by the cache's assume step so pods are
-    featurized exactly once).
+    featurized exactly once) and the batch's ACTIVE op set — ops whose
+    ``is_active`` predicate is False for every pod are skipped here and
+    compiled out of the batch's pass (the batch analog of PreFilter Skip).
 
     Featurization may grow vocabularies/schema (new scalar resources, label
     pairs, topology keys), which is why it must run before the device state is
     flushed for the pass."""
     assert len(pods) <= k
     fctx = opcommon.FeaturizeContext(builder=builder, profile=profile)
-    ops = [opcommon.get(name) for name in dict.fromkeys(
+    all_ops = [opcommon.get(name) for name in dict.fromkeys(
         list(profile.filters) + [s for s, _ in profile.scorers]
     )]
+    ops = [
+        op
+        for op in all_ops
+        if op.is_active is None or any(op.is_active(p, fctx) for p in pods)
+    ]
+    active = frozenset(op.name for op in ops)
+    fctx.active = active
     per_pod: list[dict] = []
     deltas: list[dict] = []
     for pod in pods:
@@ -87,4 +96,4 @@ def build_pod_batch(
         batch[key] = np.pad(stacked, pad_width)
     batch["valid"] = np.zeros(k, np.bool_)
     batch["valid"][: len(pods)] = True
-    return batch, deltas
+    return batch, deltas, active

@@ -92,15 +92,28 @@ def _commit(state: ClusterState, pf: dict, pick: jax.Array, do: jax.Array) -> Cl
     return dataclasses.replace(state, **new)
 
 
-def build_pass(profile: Profile, schema: Schema, builder_res_col: dict[str, int]):
-    """Compile the batch pass for one (profile, schema) pair.
+def build_pass(
+    profile: Profile,
+    schema: Schema,
+    builder_res_col: dict[str, int],
+    active: frozenset[str] | None = None,
+):
+    """Compile the batch pass for one (profile, schema, active-op-set).
 
     Returns run(state, batch, seed_base) → (state, PassResult). Recompiles
-    only when the profile or a bucketed schema capacity changes — the analog
-    of building a frameworkImpl per profile (profile/profile.go:50), plus
-    XLA compilation."""
-    filter_ops = [opcommon.get(n) for n in profile.filters]
-    score_ops = [(opcommon.get(n), w) for n, w in profile.scorers]
+    only when the profile, a bucketed schema capacity, or the batch-active
+    op set changes — the analog of building a frameworkImpl per profile
+    (profile/profile.go:50) with per-cycle Skip sets, plus XLA compilation."""
+    filter_ops = [
+        opcommon.get(n)
+        for n in profile.filters
+        if active is None or n in active
+    ]
+    score_ops = [
+        (opcommon.get(n), w)
+        for n, w in profile.scorers
+        if active is None or n in active
+    ]
     static: dict = {}
     for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
         if op.static is not None:
@@ -143,15 +156,22 @@ def build_pass(profile: Profile, schema: Schema, builder_res_col: dict[str, int]
 
 
 class PassCache:
-    """Compiled-pass cache keyed by (profile, schema, resource columns)."""
+    """Compiled-pass cache keyed by (profile, schema, resource columns,
+    batch-active op set)."""
 
     def __init__(self) -> None:
         self._cache: dict = {}
 
-    def get(self, profile: Profile, schema: Schema, res_col: dict[str, int]):
-        key = (profile, schema, tuple(sorted(res_col.items())))
+    def get(
+        self,
+        profile: Profile,
+        schema: Schema,
+        res_col: dict[str, int],
+        active: frozenset[str] | None = None,
+    ):
+        key = (profile, schema, tuple(sorted(res_col.items())), active)
         fn = self._cache.get(key)
         if fn is None:
-            fn = build_pass(profile, schema, res_col)
+            fn = build_pass(profile, schema, res_col, active)
             self._cache[key] = fn
         return fn

@@ -33,6 +33,9 @@ class FeaturizeContext:
 
     builder: SnapshotBuilder
     profile: Optional[Profile] = None
+    # Batch-active op names (None until build_pod_batch resolves them) —
+    # lets one op skip recomputing features another active op produces.
+    active: Optional[frozenset] = None
 
     @property
     def interns(self):
@@ -74,6 +77,14 @@ class OpDef:
     # nodesWherePreemptionMightHelp).  None ⇒ this op's failures are
     # resolvable (e.g. resource fit, ports, anti-affinity).
     hard_filter: Optional[Callable] = None
+    # (pod, FeaturizeContext) -> bool: does this op do anything for this pod
+    # in this cluster?  The batch analog of the reference's PreFilter/PreScore
+    # Skip status (framework/cycle_state.go skip sets): an op inactive for an
+    # ENTIRE batch is compiled out of that batch's pass.  MUST be
+    # conservative — skipping an inactive op must not change any decision
+    # (its filter would pass every node; its score would add a constant).
+    # None ⇒ always active.
+    is_active: Optional[Callable] = None
 
 
 from ..snapshot import POD_PORT_SLOTS  # noqa: F401  (re-export for ops)

@@ -75,4 +75,16 @@ def score_fn(state, pf, ctx: PassContext, feasible):
 
 feature_fill("il_image_ids", -1)
 feature_fill("il_ncontainers", 1)
-register(OpDef(name="ImageLocality", featurize=featurize, score=score_fn))
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # Score is 0 for every node when the pod names no images or no node
+    # reports any (min-threshold clamp maps empty sums to 0).
+    if len(fctx.interns.images) == 0:
+        return False
+    return any(
+        c.images for c in list(pod.spec.init_containers) + list(pod.spec.containers)
+    )
+
+
+register(
+    OpDef(name="ImageLocality", featurize=featurize, score=score_fn, is_active=is_active)
+)

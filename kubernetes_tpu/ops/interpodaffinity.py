@@ -273,6 +273,16 @@ for _k, _fill in [
 ]:
     feature_fill(_k, _fill)
 
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # Inactive only when the pod has no pod (anti-)affinity AND no existing
+    # pod carries any term (existing pods' terms score/filter incoming pods
+    # regardless of the incoming spec — PreFilter Skip, filtering.go:257).
+    if len(fctx.interns.terms) > 0:
+        return True
+    aff = pod.spec.affinity
+    return bool(aff and (aff.pod_affinity or aff.pod_anti_affinity))
+
+
 register(
     OpDef(
         name="InterPodAffinity",
@@ -280,5 +290,6 @@ register(
         filter=filter_fn,
         score=score_fn,
         hard_filter=hard_filter_fn,
+        is_active=is_active,
     )
 )

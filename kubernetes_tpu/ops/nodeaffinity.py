@@ -226,6 +226,13 @@ for _k, _fill in [
 ]:
     feature_fill(_k, _fill)
 
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # No nodeSelector and no node affinity: filter passes everywhere, score
+    # is uniformly zero.
+    aff = pod.spec.affinity
+    return bool(pod.spec.node_selector) or bool(aff and aff.node_affinity)
+
+
 register(
     OpDef(
         name="NodeAffinity",
@@ -233,5 +240,6 @@ register(
         filter=filter_fn,
         score=score_fn,
         hard_filter=invert_filter(filter_fn),
+        is_active=is_active,
     )
 )

@@ -63,4 +63,12 @@ def filter_fn(state, pf, ctx: PassContext):
 feature_fill("port_wild_triples", -1)
 feature_fill("port_triples", -1)
 feature_fill("port_keys", -1)
-register(OpDef(name="NodePorts", featurize=featurize, filter=filter_fn))
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # A pod without hostPort requests conflicts with nothing (PreFilter
+    # returns Skip, node_ports.go:97).
+    return bool(pod.host_ports())
+
+
+register(
+    OpDef(name="NodePorts", featurize=featurize, filter=filter_fn, is_active=is_active)
+)

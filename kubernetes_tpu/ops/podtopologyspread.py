@@ -114,14 +114,13 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
     feats = _constraint_feats(hard, pod, fctx, "tps_h")
     feats.update(_constraint_feats(soft, pod, fctx, "tps_s"))
     # Node-inclusion policies are evaluated with the NodeAffinity and
-    # TaintToleration device filters — ensure their features exist when those
-    # plugins aren't in the profile (the engine's op loop already produces
-    # the identical keys when they are).
-    prof = fctx.profile
-    enabled = set(prof.filters) | {n for n, _ in prof.scorers} if prof else set()
-    if "NodeAffinity" not in enabled:
+    # TaintToleration device filters — their features must exist whenever
+    # spread is active, even when those ops are absent from the profile or
+    # batch-inactive (skipped by their is_active predicates).  When they ARE
+    # batch-active the engine's op loop produces the identical keys already.
+    if fctx.active is None or "NodeAffinity" not in fctx.active:
         feats.update(nodeaffinity.featurize(pod, fctx))
-    if "TaintToleration" not in enabled:
+    if fctx.active is None or "TaintToleration" not in fctx.active:
         feats.update(tainttoleration.featurize(pod, fctx))
     return feats
 
@@ -263,6 +262,12 @@ def hard_filter_fn(state, pf, ctx: PassContext):
     return ((vals < 0) & valid[:, None]).any(0)
 
 
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # No constraints: both PreFilter and PreScore return Skip
+    # (filtering.go:152, scoring.go:140).
+    return bool(pod.spec.topology_spread_constraints)
+
+
 register(
     OpDef(
         name="PodTopologySpread",
@@ -270,5 +275,6 @@ register(
         filter=filter_fn,
         score=score_fn,
         hard_filter=hard_filter_fn,
+        is_active=is_active,
     )
 )

@@ -62,6 +62,13 @@ def score_fn(state, pf, ctx: PassContext, feasible):
 
 feature_fill("taint_intol_hard", 0)
 feature_fill("taint_intol_pref", 0)
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # With no taints interned anywhere, the filter passes every node and the
+    # score is a uniform MaxNodeScore (reverse-normalize of all-zero counts)
+    # — a constant offset that cannot change any decision.
+    return len(fctx.interns.taints) > 0
+
+
 register(
     OpDef(
         name="TaintToleration",
@@ -69,5 +76,6 @@ register(
         filter=filter_fn,
         score=score_fn,
         hard_filter=invert_filter(filter_fn),
+        is_active=is_active,
     )
 )

@@ -65,9 +65,20 @@ class PreemptStep(NamedTuple):
     n_victims: jax.Array  # (K,) i32 victims inside that prefix
 
 
-def build_preempt_pass(profile: Profile, schema: Schema, builder_res_col):
-    """Compile the scan-over-preemptors dry-run for one (profile, schema)."""
-    filter_ops = [opcommon.get(n) for n in profile.filters]
+def build_preempt_pass(
+    profile: Profile,
+    schema: Schema,
+    builder_res_col,
+    active: frozenset[str] | None = None,
+):
+    """Compile the scan-over-preemptors dry-run for one (profile, schema,
+    active-op-set) — the active set must match the scheduling batch whose
+    feature rows feed this pass."""
+    filter_ops = [
+        opcommon.get(n)
+        for n in profile.filters
+        if active is None or n in active
+    ]
     static: dict = {}
     for op in {o.name: o for o in filter_ops}.values():
         if op.static is not None:
@@ -199,17 +210,20 @@ class PreemptionEvaluator:
         self.sched = scheduler
         self._cache: dict = {}
 
-    def _pass(self):
+    def _pass(self, active: frozenset[str] | None):
         b = self.sched.builder
-        key = (self.sched.profile, b.schema, tuple(sorted(b.res_col.items())))
+        key = (self.sched.profile, b.schema, tuple(sorted(b.res_col.items())), active)
         fn = self._cache.get(key)
         if fn is None:
-            fn = build_preempt_pass(self.sched.profile, b.schema, b.res_col)
+            fn = build_preempt_pass(self.sched.profile, b.schema, b.res_col, active)
             self._cache[key] = fn
         return fn
 
     def preempt_batch(
-        self, pods: list[t.Pod], batch_rows: dict
+        self,
+        pods: list[t.Pod],
+        batch_rows: dict,
+        active: frozenset[str] | None = None,
     ) -> list[PreemptionResult | None]:
         """Run preemption for the failed pods of one scheduling batch.
         ``batch_rows`` are each pod's already-built feature dict rows."""
@@ -273,7 +287,7 @@ class PreemptionEvaluator:
         batch["valid"][: len(pods)] = eligible
 
         state = builder.state()
-        out = self._pass()(
+        out = self._pass(active)(
             state, batch, jnp.asarray(vic_prio), jnp.asarray(vic_req),
             jnp.asarray(vic_nonzero), jnp.asarray(vic_start),
         )

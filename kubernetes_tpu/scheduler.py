@@ -130,10 +130,12 @@ class TPUScheduler:
         # Always pad to the full batch size: one batch shape → one XLA program
         # (a short tail batch costs a few idle scan steps, ~µs; a second
         # compiled shape costs tens of seconds).
-        batch, deltas = build_pod_batch(pods, self.builder, self.profile, self.batch_size)
+        batch, deltas, active = build_pod_batch(
+            pods, self.builder, self.profile, self.batch_size
+        )
         t1 = time.perf_counter()
         state = self.builder.state()
-        run = self.passes.get(self.profile, self.builder.schema, self.builder.res_col)
+        run = self.passes.get(self.profile, self.builder.schema, self.builder.res_col, active)
         new_state, result = run(state, batch, np.uint32(self._cycle))
         # One host round trip for all result arrays (the tunnel to the device
         # has high per-transfer latency; never sync field-by-field).
@@ -184,7 +186,9 @@ class TPUScheduler:
                 for key, arr in batch.items()
                 if key != "valid"
             }
-            results = self.preemption.preempt_batch([qp.pod for _, qp, _ in failed], rows)
+            results = self.preemption.preempt_batch(
+                [qp.pod for _, qp, _ in failed], rows, active
+            )
         any_victims = False
         for (_, qp, outcome), res in zip(failed, results):
             if res is not None:

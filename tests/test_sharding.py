@@ -46,14 +46,14 @@ def build_cluster(n_nodes=32, n_pods=16):
     for p in pods:
         s.add_pod(p)
     infos = s.queue.pop_batch(n_pods)
-    batch, _ = build_pod_batch([qp.pod for qp in infos], s.builder, s.profile, n_pods)
+    batch, _, active = build_pod_batch([qp.pod for qp in infos], s.builder, s.profile, n_pods)
     state = s.builder.state()
-    return s, state, batch
+    return s, state, batch, active
 
 
 def test_sharded_pass_matches_unsharded():
-    s, state, batch = build_cluster()
-    fn = build_pass(s.profile, s.builder.schema, s.builder.res_col)
+    s, state, batch, active = build_cluster()
+    fn = build_pass(s.profile, s.builder.schema, s.builder.res_col, active)
     ref_state, ref_out = fn(state, batch, np.uint32(0))
 
     mesh = make_mesh(8)
@@ -76,7 +76,7 @@ def test_sharded_pass_matches_unsharded():
 
 def test_sharded_state_placement():
     """Node-axis fields actually split across the mesh; batch replicates."""
-    s, state, batch = build_cluster()
+    s, state, batch, active = build_cluster()
     mesh = make_mesh(8)
     sh_state = shard_cluster_state(state, mesh)
     shardings = {d.device for d in sh_state.alloc.addressable_shards}
