@@ -323,6 +323,7 @@ class PodSpec:
     node_name: str = ""
     scheduler_name: str = "default-scheduler"
     scheduling_gates: tuple[PodSchedulingGate, ...] = ()
+    volumes: tuple["Volume", ...] = ()
 
 
 @dataclass
@@ -553,3 +554,75 @@ def node_selector_matches(
     if not sel.terms:
         return False
     return any(node_selector_term_matches(t, labels, node_name) for t in sel.terms)
+
+
+# ---------------------------------------------------------------------------
+# Volumes (PV / PVC / StorageClass / CSINode) — the subset the scheduler's
+# volume plugins consume (reference: pkg/scheduler/framework/plugins/
+# volumebinding, volumezone, volumerestrictions, nodevolumelimits).
+# ---------------------------------------------------------------------------
+
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+RWO = "ReadWriteOnce"
+ROX = "ReadOnlyMany"
+RWX = "ReadWriteMany"
+RWOP = "ReadWriteOncePod"
+
+
+@dataclass
+class StorageClass:
+    name: str
+    provisioner: str = "kubernetes.io/no-provisioner"
+    binding_mode: str = BINDING_IMMEDIATE
+    # Topology restriction for dynamically provisioned volumes
+    # (StorageClass.allowedTopologies): OR of terms like a NodeSelector.
+    allowed_topologies: Optional[NodeSelector] = None
+
+
+@dataclass
+class PersistentVolume:
+    name: str
+    capacity: int = 0  # bytes
+    access_modes: tuple[str, ...] = (RWO,)
+    storage_class: str = ""
+    # PV.spec.nodeAffinity.required — where this volume is reachable.
+    node_affinity: Optional[NodeSelector] = None
+    labels: dict[str, str] = field(default_factory=dict)  # incl. zone/region
+    claim_ref: Optional[str] = None  # "ns/name" of the bound PVC
+    csi_driver: str = ""  # CSI driver name (for attach limits)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str
+    namespace: str = "default"
+    storage_class: str = ""
+    access_modes: tuple[str, ...] = (RWO,)
+    request: int = 0  # bytes
+    volume_name: str = ""  # bound PV, "" = unbound
+
+    @property
+    def uid(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class Volume:
+    """One pod volume: a PVC reference or an in-tree device volume
+    (GCE PD / AWS EBS / AzureDisk / ISCSI modeled uniformly as a device id
+    with the reference's both-read-only exemption)."""
+
+    name: str = ""
+    pvc: str = ""  # PVC name (pod's namespace)
+    device_id: str = ""  # in-tree volume unique device id
+    read_only: bool = False
+
+
+@dataclass
+class CSINode:
+    """CSINode.spec.drivers[*].allocatable.count per driver."""
+
+    name: str  # node name
+    driver_limits: dict[str, int] = field(default_factory=dict)

@@ -16,6 +16,9 @@ from ..ops import common as opcommon
 from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder, _bucket
 
 opcommon.feature_fill("ipa_own_terms", -1)
+opcommon.feature_fill("vol_dev_ids", -1)
+opcommon.feature_fill("vol_dev_rw", 0)
+opcommon.feature_fill("vol_drivers", 0)
 
 
 def build_pod_batch(
@@ -57,8 +60,17 @@ def build_pod_batch(
         own = delta["own_terms"]
         own_terms = np.full(_bucket(max(len(own), 1), 1), -1, np.int32)
         own_terms[: len(own)] = own
+        devs = delta["devices"]
+        dev_ids = np.full(_bucket(max(len(devs), 1), 1), -1, np.int32)
+        dev_rw = np.zeros(dev_ids.shape[0], np.bool_)
+        for j, (vid, rw) in enumerate(devs):
+            dev_ids[j] = vid
+            dev_rw[j] = rw
         feats = {
             "ipa_own_terms": own_terms,
+            "vol_dev_ids": dev_ids,
+            "vol_dev_rw": dev_rw,
+            "vol_drivers": delta["drivers"],
             "req": delta["req"],
             "nonzero": delta["nonzero"],
             "group": np.int32(delta["group"]),

@@ -89,6 +89,17 @@ def _commit(state: ClusterState, pf: dict, pick: jax.Array, do: jax.Array) -> Cl
         inc = (do & (pf["ipa_own_terms"] >= 0)).astype(jnp.int32)
         safe_a = jnp.maximum(pf["ipa_own_terms"], 0)
         new["et_counts"] = state.et_counts.at[safe_a, row].add(inc)
+    if "vol_dev_ids" in pf:
+        inc = (do & (pf["vol_dev_ids"] >= 0)).astype(jnp.int32)
+        safe_d = jnp.maximum(pf["vol_dev_ids"], 0)
+        new["dev_counts"] = state.dev_counts.at[safe_d, row].add(inc)
+        new["dev_rw_counts"] = state.dev_rw_counts.at[safe_d, row].add(
+            inc * pf["vol_dev_rw"].astype(jnp.int32)
+        )
+    if "vol_drivers" in pf:
+        new["csi_used"] = state.csi_used.at[:, row].add(
+            jnp.where(do, pf["vol_drivers"], 0)
+        )
     return dataclasses.replace(state, **new)
 
 

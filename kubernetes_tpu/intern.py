@@ -89,6 +89,8 @@ class InternTable:
         self.namespaces = Vocab("namespaces")
         self.groups = Vocab("groups")
         self.terms = Vocab("terms")  # existing-pod (anti-)affinity terms
+        self.devices = Vocab("devices")  # in-tree device-volume ids
+        self.drivers = Vocab("drivers")  # CSI driver names
         self.ports = Vocab("ports")
         self.images = Vocab("images")
         self.node_names = Vocab("node_names")
@@ -102,9 +104,18 @@ class InternTable:
     def topo_value_id(self, key: str, value: str) -> int:
         return self.topo_vals[self.topo_key_slot(key)].id(value)
 
+    HOSTNAME_KEY = "kubernetes.io/hostname"
+
     def max_topo_vocab(self) -> int:
-        """Largest per-key domain vocabulary (drives Schema.DV)."""
-        return max((len(v) for v in self.topo_vals), default=0)
+        """Largest per-key domain vocabulary EXCLUDING the hostname key
+        (drives Schema.DV).  Hostname domains are one-node domains and every
+        device op takes a per-node fast path for them, so their huge
+        vocabulary must not inflate the segment tables."""
+        host_slot = self.topo_keys.get(self.HOSTNAME_KEY)
+        return max(
+            (len(v) for i, v in enumerate(self.topo_vals) if i != host_slot),
+            default=0,
+        )
 
     def term_id(self, category: int, weight: int, term, namespace: str) -> int:
         """Intern a pod (anti-)affinity term of an existing pod.

@@ -22,6 +22,31 @@ def default_normalize_score(raw: jnp.ndarray, feasible: jnp.ndarray, reverse: bo
     return jnp.where(max_count == 0, 0, scaled)
 
 
+def domain_tables(state, slots, counts, dv):
+    """Per-term domain sums as MXU matmuls (no scatters).
+
+    ``slots`` (T,) topology-key slot per term; ``counts`` (T, N) f32
+    contributions.  Returns (vals (T,N), key_present (T,N), masked (T,N),
+    tbl (T, DV)) where ``tbl[t, d] = Σ_n masked[t, n]·[vals[t, n] == d]``.
+    The one-hot of topo_vals is shared across terms, so the reduction is one
+    ``(T,N)×(N,TK·DV)`` einsum — scatter-free, which is what the TPU wants.
+    Hostname-key values exceed DV by design (excluded from the vocabulary);
+    callers take the per-node fast path for them."""
+    vals_all = state.topo_vals  # (N, TK)
+    vals = jnp.take(vals_all, slots, axis=1).T  # (T, N)
+    key_present = vals >= 0
+    masked = jnp.where(key_present, counts, 0.0)
+    onehot = (
+        (vals_all[:, :, None] == jnp.arange(dv)[None, None, :])
+        & (vals_all >= 0)[:, :, None]
+    ).astype(counts.dtype)  # (N, TK, DV)
+    tbl_all = jnp.einsum("tn,nkd->tkd", masked, onehot)  # (T, TK, DV)
+    tbl = jnp.take_along_axis(
+        tbl_all, slots[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]  # (T, DV)
+    return vals, key_present, masked, tbl
+
+
 def gather_mask(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
     """table[ids] with -1-padded ids contributing False/0.
 

@@ -42,6 +42,7 @@ from ..framework.config import MAX_NODE_SCORE
 from ..intern import term_key
 from ..snapshot import _bucket
 from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import domain_tables
 from .podtopologyspread import groups_matching
 
 # Existing-term categories (intern.term_id).
@@ -94,10 +95,14 @@ def _own_term_feats(
         masks[i, : m.shape[0]] = m
         if weights is not None:
             wvec[i] = weights[i]
+    host = np.zeros(dim, np.bool_)
+    for i, term in enumerate(terms):
+        host[i] = term.topology_key == fctx.interns.HOSTNAME_KEY
     out = {
         f"{prefix}_valid": valid,
         f"{prefix}_slot": slots,
         f"{prefix}_groups": masks,
+        f"{prefix}_host": host,
     }
     if weights is not None:
         out[f"{prefix}_w"] = wvec
@@ -148,11 +153,13 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
     et_anti = np.zeros(et, np.bool_)
     et_w = np.zeros(et, np.int64)
     et_slot = np.zeros(et, np.int32)
+    et_host = np.zeros(et, np.bool_)
     hard_w = fctx.profile.hard_pod_affinity_weight if fctx.profile else 1
     for tid in range(len(it.terms)):
         key = it.terms.value(tid)
         cat, weight, topo_key = key[0], key[1], key[2]
         et_slot[tid] = builder.ensure_topo_key(topo_key)
+        et_host[tid] = topo_key == it.HOSTNAME_KEY
         if not _term_matches_pod(key, pod, builder.namespace_labels):
             continue
         et_match[tid] = True
@@ -165,26 +172,27 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
         elif cat == CAT_PREF_ANTI:
             et_w[tid] = -weight
     feats.update(
-        ipa_et_match=et_match, ipa_et_anti=et_anti, ipa_et_w=et_w, ipa_et_slot=et_slot
+        ipa_et_match=et_match,
+        ipa_et_anti=et_anti,
+        ipa_et_w=et_w,
+        ipa_et_slot=et_slot,
+        ipa_et_host=et_host,
     )
     return feats
 
 
-def _domain_tables(state, slots, counts, dv):
-    """Per-term domain tallies: (T, N) values + (T, DV) segment sums.
+def _domain_tables(state, slots, counts, host, dv):
+    """Per-term domain tallies gathered back per node: (T, N).
 
     ``counts`` (T, N) f32 contributions; nodes missing the term's topology
-    key contribute nothing (the reference's map update skips them)."""
-    vals = jnp.take(state.topo_vals, slots, axis=1).T  # (T, N)
-    key_present = vals >= 0
-    masked = jnp.where(key_present, counts, 0.0)
-
-    def one(v, c):
-        return jax.ops.segment_sum(c, jnp.maximum(v, 0), num_segments=dv)
-
-    tbl = jax.vmap(one)(vals, masked)  # (T, DV)
-    at_node = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (T, N)
-    return vals, key_present, tbl, at_node
+    key contribute nothing (the reference's map update skips them).
+    ``host`` (T,) marks hostname-key terms: their domains are single nodes
+    (the hostname vocabulary is excluded from DV), so the tally at a node is
+    the node's own count — no domain table."""
+    vals, key_present, masked, tbl = domain_tables(state, slots, counts, dv)
+    gathered = jnp.take_along_axis(tbl, jnp.clip(vals, 0, dv - 1), axis=1)
+    at_node = jnp.where(host[:, None], masked, gathered)  # (T, N)
+    return vals, key_present, masked, at_node
 
 
 def _affinity_ok(state, pf, ctx: PassContext):
@@ -195,10 +203,13 @@ def _affinity_ok(state, pf, ctx: PassContext):
     any_ra = ra_valid.any()
     cnt_all = pf["ipa_ra_allmask"].astype(jnp.float32) @ gc  # (N,)
     ra_counts = jnp.broadcast_to(cnt_all[None, :], (ra_valid.shape[0], cnt_all.shape[0]))
-    _v, key_ra, tbl_ra, at_ra = _domain_tables(state, pf["ipa_ra_slot"], ra_counts, ctx.schema.DV)
+    _v, key_ra, masked_ra, at_ra = _domain_tables(
+        state, pf["ipa_ra_slot"], ra_counts, pf["ipa_ra_host"], ctx.schema.DV
+    )
     keys_ok = (key_ra | ~ra_valid[:, None]).all(0)
     pods_exist = ((at_ra > 0.5) | ~ra_valid[:, None]).all(0)
-    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], tbl_ra, 0.0)) == 0
+    # len(affinityCounts) == 0 ⟺ no key-bearing node hosts a matching pod.
+    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], masked_ra, 0.0)) == 0
     return ~any_ra | (keys_ok & (pods_exist | (counts_empty & pf["ipa_ra_self"])))
 
 
@@ -209,7 +220,9 @@ def filter_fn(state, pf, ctx: PassContext):
     # (1) Existing pods' required anti-affinity.
     active_e = pf["ipa_et_match"] & pf["ipa_et_anti"]  # (ET,)
     carriers = state.et_counts.astype(jnp.float32)  # (ET, N)
-    _v, key_e, _tbl, at_node_e = _domain_tables(state, pf["ipa_et_slot"], carriers, dv)
+    _v, key_e, _m, at_node_e = _domain_tables(
+        state, pf["ipa_et_slot"], carriers, pf["ipa_et_host"], dv
+    )
     fail_existing = (active_e[:, None] & key_e & (at_node_e > 0.5)).any(0)
 
     # (2) Incoming required affinity.
@@ -218,7 +231,9 @@ def filter_fn(state, pf, ctx: PassContext):
     # (3) Incoming required anti-affinity.
     rs_valid = pf["ipa_rs_valid"]
     cnt_rs = pf["ipa_rs_groups"].astype(jnp.float32) @ gc  # (RS, N)
-    _v, key_rs, _tbl, at_rs = _domain_tables(state, pf["ipa_rs_slot"], cnt_rs, dv)
+    _v, key_rs, _m, at_rs = _domain_tables(
+        state, pf["ipa_rs_slot"], cnt_rs, pf["ipa_rs_host"], dv
+    )
     fail_anti = (rs_valid[:, None] & key_rs & (at_rs > 0.5)).any(0)
 
     return ~fail_existing & aff_ok & ~fail_anti
@@ -235,7 +250,9 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     # Incoming pod's preferred terms: ±w × (matching pods in the node's domain).
     pf_valid = pf["ipa_pf_valid"]
     cnt_p = pf["ipa_pf_groups"].astype(jnp.float32) @ gc  # (PP, N)
-    _v, key_p, _tbl, at_p = _domain_tables(state, pf["ipa_pf_slot"], cnt_p, dv)
+    _v, key_p, _m, at_p = _domain_tables(
+        state, pf["ipa_pf_slot"], cnt_p, pf["ipa_pf_host"], dv
+    )
     raw = jnp.sum(
         jnp.where(pf_valid[:, None] & key_p, at_p, 0.0)
         * pf["ipa_pf_w"][:, None].astype(jnp.float32),
@@ -246,7 +263,9 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     # domain × signed weight (hard affinity / preferred ±w).
     active_e = pf["ipa_et_match"] & (pf["ipa_et_w"] != 0)
     carriers = state.et_counts.astype(jnp.float32)
-    _v, key_e, _tbl, at_e = _domain_tables(state, pf["ipa_et_slot"], carriers, dv)
+    _v, key_e, _m, at_e = _domain_tables(
+        state, pf["ipa_et_slot"], carriers, pf["ipa_et_host"], dv
+    )
     raw += jnp.sum(
         jnp.where(active_e[:, None] & key_e, at_e, 0.0)
         * pf["ipa_et_w"][:, None].astype(jnp.float32),
@@ -266,10 +285,12 @@ def score_fn(state, pf, ctx: PassContext, feasible):
 
 for _k, _fill in [
     ("ipa_ra_valid", 0), ("ipa_ra_slot", 0), ("ipa_ra_groups", 0),
-    ("ipa_ra_allmask", 0), ("ipa_ra_self", 0),
-    ("ipa_rs_valid", 0), ("ipa_rs_slot", 0), ("ipa_rs_groups", 0),
+    ("ipa_ra_allmask", 0), ("ipa_ra_self", 0), ("ipa_ra_host", 0),
+    ("ipa_rs_valid", 0), ("ipa_rs_slot", 0), ("ipa_rs_groups", 0), ("ipa_rs_host", 0),
     ("ipa_pf_valid", 0), ("ipa_pf_slot", 0), ("ipa_pf_groups", 0), ("ipa_pf_w", 0),
+    ("ipa_pf_host", 0),
     ("ipa_et_match", 0), ("ipa_et_anti", 0), ("ipa_et_w", 0), ("ipa_et_slot", 0),
+    ("ipa_et_host", 0),
 ]:
     feature_fill(_k, _fill)
 

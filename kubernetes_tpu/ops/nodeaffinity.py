@@ -94,8 +94,12 @@ class _Program:
         if reqs:
             self.terms.append(reqs)
 
-    def tensors(self, prefix: str) -> dict:
-        tdim = _bucket(max(len(self.terms), 1), 1)
+    def tensors(self, prefix: str, min_terms: int = 1) -> dict:
+        """Pack into dense tensors.  A term with zero requirements is
+        all-OP_PAD and evaluates True everywhere — _Program.add_term never
+        produces one, but grouped volume programs use them as always-true
+        entries (ops/volumes._GroupedProgram)."""
+        tdim = _bucket(max(len(self.terms), min_terms, 1), 1)
         qdim = _bucket(max((len(te) for te in self.terms), default=1) or 1, 1)
         vdim = _bucket(
             max((len(v) for te in self.terms for _, _, v, _ in te), default=1) or 1, 1

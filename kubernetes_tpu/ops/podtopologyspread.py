@@ -39,9 +39,12 @@ from ..api import types as t
 from ..framework.config import MAX_NODE_SCORE
 from ..snapshot import _bucket
 from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import domain_tables
 from . import nodeaffinity, tainttoleration
 
-HOSTNAME_KEY = "kubernetes.io/hostname"
+from ..intern import InternTable
+
+HOSTNAME_KEY = InternTable.HOSTNAME_KEY
 MAX_INT32 = np.int64(2**31 - 1)
 
 
@@ -154,40 +157,41 @@ def _per_constraint(state, pf, ctx: PassContext, prefix: str):
     return valid, vals, key_present, all_keys, elig, cnt, cnt_raw
 
 
-def _segment_tables(vals, elig, cnt, dv):
-    """Per-domain totals and presence: (C, DV) tables."""
-    safe_vals = jnp.maximum(vals, 0)  # ineligible rows carry zeros anyway
-
-    def one(v, c, e):
-        tbl = jax.ops.segment_sum(c, v, num_segments=dv)
-        present = jax.ops.segment_sum(e.astype(jnp.int32), v, num_segments=dv) > 0
-        return tbl, present
-
-    return jax.vmap(one)(safe_vals, cnt, elig)
+def _segment_tables(state, slots, elig, cnt, dv):
+    """Per-domain totals and presence: (C, DV) tables (MXU matmuls)."""
+    _v, _k, _m, tbl = domain_tables(state, slots, cnt, dv)
+    _v, _k, _m, pres = domain_tables(state, slots, elig.astype(jnp.float32), dv)
+    return tbl, pres > 0.5
 
 
-def _segment_presence(vals, mask, dv):
+def _segment_presence(state, slots, mask, dv):
     """(C, DV) bool: domains containing a True-masked node."""
-    safe_vals = jnp.maximum(vals, 0)
-
-    def one(v, m):
-        return jax.ops.segment_sum(m.astype(jnp.int32), v, num_segments=dv) > 0
-
-    return jax.vmap(one)(safe_vals, mask)
+    _v, _k, _m, pres = domain_tables(state, slots, mask.astype(jnp.float32), dv)
+    return pres > 0.5
 
 
 def filter_fn(state, pf, ctx: PassContext):
     valid, vals, key_present, _all_keys, elig, cnt, _raw = _per_constraint(
         state, pf, ctx, "tps_h"
     )
-    tbl, present = _segment_tables(vals, elig, cnt, ctx.schema.DV)
+    host = pf["tps_h_hostname"]  # (C,)
+    # Generic path: per-domain tables over the (hostname-free) DV vocabulary.
+    tbl, present = _segment_tables(state, pf["tps_h_slot"], elig, cnt, ctx.schema.DV)
     tbl = tbl.astype(jnp.int64)
+    min_g = jnp.min(jnp.where(present, tbl, MAX_INT32), axis=1)  # (C,)
+    dom_g = present.sum(axis=1)
+    match_g = jnp.take_along_axis(tbl, jnp.clip(vals, 0, ctx.schema.DV - 1), axis=1)
+    # Hostname fast path: every domain is a single node (its vocabulary is
+    # excluded from DV), so counts/minima are per-node reductions.
+    cnt_i = cnt.astype(jnp.int64)
+    min_h = jnp.min(jnp.where(elig, cnt_i, MAX_INT32), axis=1)
+    dom_h = elig.sum(axis=1)
     # Global minimum over existing domains; MaxInt32 when none exist
     # (newCriticalPaths) — then every skew check passes, like the reference.
-    min_tbl = jnp.min(jnp.where(present, tbl, MAX_INT32), axis=1)  # (C,)
-    domains = present.sum(axis=1)
+    min_tbl = jnp.where(host, min_h, min_g)
+    domains = jnp.where(host, dom_h, dom_g)
+    match_n = jnp.where(host[:, None], cnt_i, match_g)  # (C, N)
     min_match = jnp.where(domains < pf["tps_h_mindom"], 0, min_tbl)
-    match_n = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (C, N)
     skew = match_n + pf["tps_h_self"][:, None].astype(jnp.int64) - min_match[:, None]
     ok = key_present & (skew <= pf["tps_h_skew"][:, None])
     return (ok | ~valid[:, None]).all(0)
@@ -203,14 +207,17 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     # and end at score 0 via the final `scored` mask.
     scored = feasible & all_keys
 
-    tbl, _present = _segment_tables(vals, elig, cnt, ctx.schema.DV)
+    tbl, _present = _segment_tables(state, pf["tps_s_slot"], elig, cnt, ctx.schema.DV)
     # Domains/topoSize count distinct pairs among *scored candidate* nodes
     # (initPreScoreState iterates filteredNodes); hostname topoSize is the
     # number of scored nodes.
     present_cand = _segment_presence(
-        vals, jnp.broadcast_to(scored[None, :], vals.shape), ctx.schema.DV
+        state,
+        pf["tps_s_slot"],
+        jnp.broadcast_to(scored[None, :], vals.shape),
+        ctx.schema.DV,
     )
-    pair_cnt = jnp.take_along_axis(tbl, jnp.maximum(vals, 0), axis=1)  # (C, N)
+    pair_cnt = jnp.take_along_axis(tbl, jnp.clip(vals, 0, ctx.schema.DV - 1), axis=1)  # (C, N)
     # Hostname counts the node's own pods directly, with no counting-
     # eligibility mask (scoring.go:254 uses nodeInfo.Pods).
     cnt_for_node = jnp.where(pf["tps_s_hostname"][:, None], cnt_raw, pair_cnt)

@@ -32,8 +32,11 @@ class Event(IntFlag):
     POD_ADD = auto()
     POD_UPDATE = auto()
     POD_DELETE = auto()
+    PV_ADD = auto()
+    PVC_ADD = auto()
     ANY = (
-        NODE_ADD | NODE_UPDATE | NODE_TAINT | NODE_LABEL | POD_ADD | POD_UPDATE | POD_DELETE
+        NODE_ADD | NODE_UPDATE | NODE_TAINT | NODE_LABEL | POD_ADD | POD_UPDATE
+        | POD_DELETE | PV_ADD | PVC_ADD
     )
 
 
@@ -48,6 +51,10 @@ PLUGIN_REQUEUE_EVENTS: dict[str, Event] = {
     "NodePorts": Event.NODE_ADD | Event.POD_DELETE,
     "PodTopologySpread": Event.NODE_ADD | Event.NODE_LABEL | Event.POD_ADD | Event.POD_DELETE | Event.POD_UPDATE,
     "InterPodAffinity": Event.NODE_ADD | Event.NODE_LABEL | Event.POD_ADD | Event.POD_DELETE | Event.POD_UPDATE,
+    "VolumeBinding": Event.NODE_ADD | Event.PV_ADD | Event.PVC_ADD | Event.POD_DELETE,
+    "VolumeZone": Event.NODE_ADD | Event.NODE_LABEL | Event.PV_ADD | Event.PVC_ADD,
+    "VolumeRestrictions": Event.POD_DELETE | Event.PV_ADD | Event.PVC_ADD | Event.NODE_ADD,
+    "NodeVolumeLimits": Event.NODE_ADD | Event.NODE_UPDATE | Event.POD_DELETE | Event.PVC_ADD,
 }
 
 DEFAULT_POD_INITIAL_BACKOFF_S = 1.0

@@ -89,6 +89,10 @@ class TPUScheduler:
 
     def add_node(self, node: t.Node) -> None:
         self.cache.add_node(node)
+        # Replay a CSINode that arrived before its Node (informer races).
+        csinode = self.builder.volumes.csinodes.get(node.name)
+        if csinode is not None:
+            self.builder.set_csinode_limits(self.cache.row_of(node.name), csinode)
         self.queue.on_event(Event.NODE_ADD)
 
     def update_node(self, node: t.Node) -> None:
@@ -113,6 +117,27 @@ class TPUScheduler:
             self.queue.on_event(Event.POD_DELETE)
         else:
             self.queue.delete(uid)
+
+    # -- volume objects (PV/PVC/StorageClass/CSINode informers) --------------
+
+    def add_pv(self, pv: t.PersistentVolume) -> None:
+        self.builder.volumes.add_pv(pv)
+        self.queue.on_event(Event.PV_ADD)
+
+    def add_pvc(self, pvc: t.PersistentVolumeClaim) -> None:
+        self.builder.volumes.add_pvc(pvc)
+        self.queue.on_event(Event.PVC_ADD)
+
+    def add_storage_class(self, sc: t.StorageClass) -> None:
+        self.builder.volumes.add_class(sc)
+        self.queue.on_event(Event.PVC_ADD)
+
+    def add_csinode(self, csinode: t.CSINode) -> None:
+        self.builder.volumes.add_csinode(csinode)
+        rec = self.cache.nodes.get(csinode.name)
+        if rec is not None:
+            self.builder.set_csinode_limits(rec.row, csinode)
+        self.queue.on_event(Event.NODE_UPDATE)
 
     # -- scheduling ------------------------------------------------------------
 
@@ -158,9 +183,20 @@ class TPUScheduler:
                 node_name = self.cache.node_name_at_row(row)
                 assert node_name is not None, f"pick={row} maps to no node"
                 # assume: the device committed the delta in-scan; mirror it on
-                # the host (cache.go:361 AssumePod) and finish the binding —
-                # in-process bind has no async API round trip to wait for.
+                # the host (cache.go:361 AssumePod).
                 self.cache.assume_pod(qp.pod, node_name, device_already=True, delta=deltas[i])
+                # PreBind (VolumeBinding PreBind, volume_binding.go:521):
+                # bind delayed claims on the chosen node.  A pod that lost a
+                # same-batch PV race is forgotten and retried — the
+                # assume/forget protocol (cache.go:404 ForgetPod).
+                if any(v.pvc for v in qp.pod.spec.volumes):
+                    node = self.cache.nodes[node_name].node
+                    if not self.builder.volumes.bind_pod_volumes(qp.pod, node):
+                        self.cache.forget_pod(qp.pod.uid)
+                        self.queue.add_backoff(qp)
+                        m.unschedulable += 1
+                        outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
+                        continue
                 qp.pod.spec.node_name = node_name
                 self.cache.finish_binding(qp.pod.uid)
                 self.queue.done(qp.pod.uid)

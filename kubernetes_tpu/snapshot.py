@@ -64,6 +64,8 @@ class Schema:
     DV: int = 8  # max domain (topology-value) vocabulary across topo keys
     G: int = 8  # pod label-group rows
     ET: int = 8  # existing-pod (anti-)affinity term rows
+    VD: int = 8  # in-tree device-volume vocabulary rows
+    DR: int = 8  # CSI driver vocabulary rows
     P: int = 8  # host-port (proto,ip,port) triple rows
     PK: int = 8  # host-port (proto,port) key rows
     IM: int = 8  # image slots per node
@@ -119,6 +121,12 @@ class ClusterState:
     group_counts: jax.Array  # (G, N) i32 — pods of label-group g on node n
     et_counts: jax.Array  # (ET, N) i32 — pods carrying interned term e
 
+    # Volumes -----------------------------------------------------------------
+    dev_counts: jax.Array  # (VD, N) i32 — pods using in-tree device d
+    dev_rw_counts: jax.Array  # (VD, N) i32 — non-read-only uses of device d
+    csi_used: jax.Array  # (DR, N) i32 — attached volumes per CSI driver
+    csi_limit: jax.Array  # (DR, N) i32 — CSINode allocatable count (default inf)
+
     # Images ------------------------------------------------------------------
     image_ids: jax.Array  # (N, IM) i32, -1 pad
     image_sizes: jax.Array  # (N, IM) i64 — size of image at same slot
@@ -143,6 +151,10 @@ _NODE_AXIS: dict[str, int] = {
     "portkey_counts": 1,
     "group_counts": 1,
     "et_counts": 1,
+    "dev_counts": 1,
+    "dev_rw_counts": 1,
+    "csi_used": 1,
+    "csi_limit": 1,
     "image_ids": 0,
     "image_sizes": 0,
 }
@@ -167,6 +179,10 @@ def _host_arrays(s: Schema) -> dict[str, np.ndarray]:
         "portkey_counts": np.zeros((s.PK, s.N), np.int32),
         "group_counts": np.zeros((s.G, s.N), np.int32),
         "et_counts": np.zeros((s.ET, s.N), np.int32),
+        "dev_counts": np.zeros((s.VD, s.N), np.int32),
+        "dev_rw_counts": np.zeros((s.VD, s.N), np.int32),
+        "csi_used": np.zeros((s.DR, s.N), np.int32),
+        "csi_limit": np.full((s.DR, s.N), 2**31 - 1, np.int32),
         "image_ids": np.full((s.N, s.IM), -1, np.int32),
         "image_sizes": np.zeros((s.N, s.IM), np.int64),
     }
@@ -200,6 +216,10 @@ class SnapshotBuilder:
         # Optional multi-chip mesh: node axis sharded, everything else
         # replicated (parallel/mesh.py).
         self.mesh = None
+        # Host-side volume objects (PV/PVC/StorageClass/CSINode).
+        from .volumes import VolumeCatalog
+
+        self.volumes = VolumeCatalog()
         self.host = _host_arrays(self.schema)
         self._device: ClusterState | None = None
         self._dirty_rows: set[int] = set()
@@ -293,6 +313,15 @@ class SnapshotBuilder:
         self._ensure(DV=it.max_topo_vocab())
         self._dirty_rows.add(row)
 
+    def set_csinode_limits(self, row: int, csinode) -> None:
+        """Apply CSINode.spec.drivers allocatable counts to a node row
+        (nodevolumelimits/csi.go reads CSINode for the attach limit)."""
+        for driver, limit in csinode.driver_limits.items():
+            did = self.interns.drivers.id(driver)
+            self._ensure(DR=did + 1)
+            self.host["csi_limit"][did, row] = limit
+        self._dirty_rows.add(row)
+
     def ensure_topo_key(self, key: str) -> int:
         """Intern a topology key and backfill topo_vals for existing nodes.
         Returns the key's slot. Called by featurization when a pod references
@@ -320,7 +349,7 @@ class SnapshotBuilder:
             if _NODE_AXIS[k] == 0:
                 h[k][row] = a[0]
             else:
-                h[k][:, row] = 0
+                h[k][:, row] = a[:, 0]
         self._dirty_rows.add(row)
 
     # -- pod deltas ------------------------------------------------------------
@@ -352,6 +381,29 @@ class SnapshotBuilder:
                 for wt in wterms:
                     own_terms.append(self.interns.term_id(cat, wt.weight, wt.term, pod.namespace))
         self._ensure(ET=len(self.interns.terms))
+        # Volumes: in-tree device uses, per-driver CSI counts, PVC refs.
+        devices: list[tuple[int, bool]] = []
+        pvc_uids: list[str] = []
+        driver_counts: dict[int, int] = {}
+        for vol in pod.spec.volumes:
+            if vol.device_id:
+                vid = self.interns.devices.id(vol.device_id)
+                devices.append((vid, not vol.read_only))
+            if vol.pvc:
+                uid = f"{pod.namespace}/{vol.pvc}"
+                pvc_uids.append(uid)
+                pvc = self.volumes.pvcs.get(uid)
+                if pvc is not None:
+                    driver = self.volumes.pvc_driver(pvc)
+                    if driver:
+                        did = self.interns.drivers.id(driver)
+                        driver_counts[did] = driver_counts.get(did, 0) + 1
+        self._ensure(
+            VD=len(self.interns.devices), DR=len(self.interns.drivers)
+        )
+        drivers_vec = np.zeros(self.schema.DR, np.int32)
+        for did, cnt in driver_counts.items():
+            drivers_vec[did] = cnt
         host_ports = pod.host_ports()
         if len(host_ports) > POD_PORT_SLOTS:
             raise ValueError(
@@ -372,6 +424,9 @@ class SnapshotBuilder:
             "group": gid,
             "ports": ports,
             "own_terms": own_terms,
+            "devices": devices,
+            "drivers": drivers_vec,
+            "pvcs": pvc_uids,
         }
 
     def apply_pod_delta(self, row: int, delta: dict, sign: int, device_already: bool) -> None:
@@ -392,6 +447,17 @@ class SnapshotBuilder:
             h["portkey_counts"][pk, row] += sign
         for tid in delta.get("own_terms", ()):
             h["et_counts"][tid, row] += sign
+        for vid, rw in delta.get("devices", ()):
+            h["dev_counts"][vid, row] += sign
+            if rw:
+                h["dev_rw_counts"][vid, row] += sign
+        drv = delta.get("drivers")
+        if drv is not None and drv.any():
+            if drv.shape[0] < self.schema.DR:
+                drv = np.pad(drv, (0, self.schema.DR - drv.shape[0]))
+                delta["drivers"] = drv
+            h["csi_used"][:, row] += sign * drv
+        self.volumes.adjust_pvc_users(delta.get("pvcs", []), sign)
         if not device_already:
             self._dirty_rows.add(row)
 

@@ -1,0 +1,250 @@
+"""Volume plugins: VolumeBinding, VolumeZone, VolumeRestrictions,
+NodeVolumeLimits — semantics vs the reference plugins."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod, make_pv, make_pvc
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def vol_profile(extra=()):
+    return Profile(
+        name="vol",
+        filters=(
+            "NodeResourcesFit",
+            "VolumeRestrictions",
+            "NodeVolumeLimits",
+            "VolumeBinding",
+            "VolumeZone",
+        )
+        + tuple(extra),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+
+
+def sched(batch_size=8):
+    return TPUScheduler(profile=vol_profile(), batch_size=batch_size)
+
+
+def zoned_nodes(s, zones=("a", "b")):
+    for z in zones:
+        s.add_node(
+            make_node(f"n-{z}").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).zone(z).obj()
+        )
+
+
+def test_bound_pv_node_affinity_restricts():
+    s = sched()
+    zoned_nodes(s)
+    s.add_pv(make_pv("pv1", storage_class="fast", node_affinity_zone=["b"]))
+    pvc = make_pvc("claim", storage_class="fast", volume_name="pv1")
+    s.add_pvc(pvc)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-b"
+    assert out[0].feasible_nodes == 1
+
+
+def test_volume_zone_labels_restrict():
+    s = sched()
+    zoned_nodes(s)
+    pv = make_pv("pv1", storage_class="", zone="a")
+    s.add_pv(pv)
+    s.add_pvc(make_pvc("claim", volume_name="pv1"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-a"
+
+
+def test_zone_label_value_set():
+    """PV zone labels may be __-separated sets (LabelZonesToSet)."""
+    s = sched()
+    zoned_nodes(s, zones=("a", "b", "c"))
+    pv = make_pv("pv1", zone="a__b")
+    s.add_pv(pv)
+    s.add_pvc(make_pvc("claim", volume_name="pv1"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name in ("n-a", "n-b")
+    assert out[0].feasible_nodes == 2
+
+
+def test_unbound_immediate_claim_unschedulable():
+    s = sched()
+    zoned_nodes(s)
+    s.add_storage_class(t.StorageClass(name="slow", binding_mode=t.BINDING_IMMEDIATE))
+    s.add_pvc(make_pvc("claim", storage_class="slow"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+
+
+def test_wait_for_first_consumer_binds_on_matching_node():
+    s = sched()
+    zoned_nodes(s)
+    s.add_storage_class(
+        t.StorageClass(name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    s.add_pv(make_pv("pv-b", storage_class="local", node_affinity_zone=["b"]))
+    pvc = make_pvc("claim", storage_class="local")
+    s.add_pvc(pvc)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-b"
+    # PreBind bound the claim to the PV.
+    assert pvc.volume_name == "pv-b"
+    assert s.builder.volumes.pvs["pv-b"].claim_ref == pvc.uid
+
+
+def test_wfc_same_batch_pv_race_loser_retries():
+    """Two pods racing for one local PV: one binds, the other is forgotten
+    and retried (assume/forget), ending unschedulable."""
+    s = sched()
+    zoned_nodes(s)
+    s.add_storage_class(
+        t.StorageClass(name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    s.add_pv(make_pv("only-pv", storage_class="local", node_affinity_zone=["a"]))
+    s.add_pvc(make_pvc("c1", storage_class="local"))
+    s.add_pvc(make_pvc("c2", storage_class="local"))
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).pvc_volume("c1").obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).pvc_volume("c2").obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.node_name]
+    assert len(placed) == 1 and placed[0].node_name == "n-a"
+    assert s.builder.host_mirror_equal()
+
+
+def test_dynamic_provisioning_with_allowed_topologies():
+    s = sched()
+    zoned_nodes(s)
+    topo = t.NodeSelector(
+        terms=(
+            t.NodeSelectorTerm(
+                match_expressions=(
+                    t.NodeSelectorRequirement(
+                        "topology.kubernetes.io/zone", t.OP_IN, ("b",)
+                    ),
+                )
+            ),
+        )
+    )
+    s.add_storage_class(
+        t.StorageClass(
+            name="dyn",
+            provisioner="ebs.csi.aws.com",
+            binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+            allowed_topologies=topo,
+        )
+    )
+    pvc = make_pvc("claim", storage_class="dyn")
+    s.add_pvc(pvc)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n-b"
+    assert pvc.volume_name  # provisioned + bound at PreBind
+
+
+def test_device_volume_conflict():
+    s = sched()
+    zoned_nodes(s)
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).device_volume("gce-pd-1").obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).device_volume("gce-pd-1").obj())
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    # Same writable device cannot attach to two nodes... it CAN conflict only
+    # per-node: second pod must land on the other node.
+    assert out["p1"] != out["p2"]
+    s.add_pod(make_pod("p3").req({"cpu": "1"}).device_volume("gce-pd-1").obj())
+    out3 = s.schedule_all_pending()
+    assert out3[0].node_name is None  # both nodes now hold a writer
+
+
+def test_device_volume_both_read_only_ok():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).device_volume("disk", read_only=True).obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).device_volume("disk", read_only=True).obj())
+    out = [o.node_name for o in s.schedule_all_pending()]
+    assert out == ["n1", "n1"]
+
+
+def test_rwop_claim_blocks_second_pod():
+    s = sched()
+    zoned_nodes(s)
+    s.add_pv(make_pv("pv1", access_modes=(t.RWOP,)))
+    s.add_pvc(make_pvc("claim", volume_name="pv1", access_modes=(t.RWOP,)))
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out1 = s.schedule_all_pending()
+    assert out1[0].node_name is not None
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out2 = s.schedule_all_pending()
+    assert out2[0].node_name is None
+
+
+def test_csi_attach_limits():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_csinode(t.CSINode(name="n1", driver_limits={"ebs.csi.aws.com": 2}))
+    s.add_csinode(t.CSINode(name="n2", driver_limits={"ebs.csi.aws.com": 1}))
+    s.add_storage_class(
+        t.StorageClass(name="ebs", provisioner="ebs.csi.aws.com",
+                       binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    for i in range(4):
+        s.add_pvc(make_pvc(f"c{i}", storage_class="ebs"))
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).pvc_volume(f"c{i}").obj())
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending(wait_backoff=True)}
+    placed = [n for n in out.values() if n]
+    # 2 + 1 = 3 attachable volumes total; the 4th pod stays pending.
+    assert len(placed) == 3
+    assert sorted(placed).count("n1") == 2 and placed.count("n2") == 1
+    assert s.builder.host_mirror_equal()
+
+
+def test_unsatisfiable_wfc_claim_is_filtered_not_churned():
+    """A WFC claim with no candidate PVs and no provisioner filters the pod
+    out (empty group) instead of pick-and-forget churning."""
+    s = sched()
+    zoned_nodes(s)
+    s.add_storage_class(
+        t.StorageClass(name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    s.add_pvc(make_pvc("claim", storage_class="local"))
+    # A second bound claim so the program has a satisfiable group too.
+    s.add_pv(make_pv("pv1", node_affinity_zone=["a"]))
+    s.add_pvc(make_pvc("bound-claim", volume_name="pv1"))
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).pvc_volume("bound-claim").pvc_volume("claim").obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+    assert out[0].feasible_nodes == 0
+
+
+def test_rwop_same_batch_race():
+    """Two pods sharing an RWOP claim in one batch: exactly one binds."""
+    s = sched()
+    zoned_nodes(s)
+    s.add_pv(make_pv("pv1", access_modes=(t.RWOP,)))
+    s.add_pvc(make_pvc("claim", volume_name="pv1", access_modes=(t.RWOP,)))
+    s.add_pod(make_pod("p1").req({"cpu": "1"}).pvc_volume("claim").obj())
+    s.add_pod(make_pod("p2").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    placed = [o for o in out if o.node_name]
+    assert len(placed) == 1
+    assert s.builder.host_mirror_equal()
+
+
+def test_csinode_before_node_still_limits():
+    s = sched()
+    s.add_csinode(t.CSINode(name="late", driver_limits={"d1": 1}))
+    s.add_node(make_node("late").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_storage_class(
+        t.StorageClass(name="c", provisioner="d1", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    for i in range(2):
+        s.add_pvc(make_pvc(f"c{i}", storage_class="c"))
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).pvc_volume(f"c{i}").obj())
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending(wait_backoff=True)}
+    assert sum(1 for v in out.values() if v) == 1
