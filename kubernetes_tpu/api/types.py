@@ -324,6 +324,8 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     scheduling_gates: tuple[PodSchedulingGate, ...] = ()
     volumes: tuple["Volume", ...] = ()
+    # Gang scheduling (coscheduling-style): name of the pod's PodGroup.
+    pod_group: str = ""
 
 
 @dataclass
@@ -626,3 +628,13 @@ class CSINode:
 
     name: str  # node name
     driver_limits: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling group (the out-of-tree coscheduling plugin's
+    PodGroup CRD): at least ``min_member`` pods schedule together or none
+    do."""
+
+    name: str
+    min_member: int = 1

@@ -98,6 +98,10 @@ class PodWrapper:
         )
         return self
 
+    def pod_group(self, name: str) -> "PodWrapper":
+        self._pod.spec.pod_group = name
+        return self
+
     def scheduling_gate(self, name: str) -> "PodWrapper":
         self._pod.spec.scheduling_gates += (t.PodSchedulingGate(name),)
         return self

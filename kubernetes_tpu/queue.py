@@ -55,6 +55,8 @@ PLUGIN_REQUEUE_EVENTS: dict[str, Event] = {
     "VolumeZone": Event.NODE_ADD | Event.NODE_LABEL | Event.PV_ADD | Event.PVC_ADD,
     "VolumeRestrictions": Event.POD_DELETE | Event.PV_ADD | Event.PVC_ADD | Event.NODE_ADD,
     "NodeVolumeLimits": Event.NODE_ADD | Event.NODE_UPDATE | Event.POD_DELETE | Event.PVC_ADD,
+    # Gang members wait for more members (pod adds) or capacity.
+    "GangScheduling": Event.POD_ADD | Event.POD_DELETE | Event.NODE_ADD,
 }
 
 DEFAULT_POD_INITIAL_BACKOFF_S = 1.0

@@ -75,6 +75,10 @@ class TPUScheduler:
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None
+        # Gang scheduling (the out-of-tree coscheduling plugin's PodGroup):
+        # group name → PodGroup; bound-member counts for quorum checks.
+        self.pod_groups: dict[str, t.PodGroup] = {}
+        self.gang_bound: dict[str, int] = {}
         if mesh is not None:
             # Multi-chip: node axis sharded over the mesh (parallel/mesh.py);
             # XLA inserts the ICI collectives for the cross-shard reductions.
@@ -117,6 +121,12 @@ class TPUScheduler:
             self.queue.on_event(Event.POD_DELETE)
         else:
             self.queue.delete(uid)
+
+    def add_pod_group(self, group: t.PodGroup) -> None:
+        """Register a gang (coscheduling-style PodGroup: all-or-nothing
+        below minMember)."""
+        self.pod_groups[group.name] = group
+        self.queue.on_event(Event.POD_ADD)
 
     # -- volume objects (PV/PVC/StorageClass/CSINode informers) --------------
 
@@ -176,42 +186,78 @@ class TPUScheduler:
         m.featurize_time_s += t1 - t0
         m.device_time_s += t2 - t1
         failed: list[tuple[int, QueuedPodInfo, ScheduleOutcome]] = []
+        # Phase 1 — assume every pick (cache.go:361 AssumePod; the device
+        # already committed the deltas in-scan).
+        placed: list[tuple[int, QueuedPodInfo, str]] = []
         for i, qp in enumerate(infos):
             m.schedule_attempts += 1
             row = int(picks[i])
             if row >= 0:
                 node_name = self.cache.node_name_at_row(row)
                 assert node_name is not None, f"pick={row} maps to no node"
-                # assume: the device committed the delta in-scan; mirror it on
-                # the host (cache.go:361 AssumePod).
                 self.cache.assume_pod(qp.pod, node_name, device_already=True, delta=deltas[i])
-                # PreBind (VolumeBinding PreBind, volume_binding.go:521):
-                # bind delayed claims on the chosen node.  A pod that lost a
-                # same-batch PV race is forgotten and retried — the
-                # assume/forget protocol (cache.go:404 ForgetPod).
-                if any(v.pvc for v in qp.pod.spec.volumes):
-                    node = self.cache.nodes[node_name].node
-                    if not self.builder.volumes.bind_pod_volumes(qp.pod, node):
-                        self.cache.forget_pod(qp.pod.uid)
-                        self.queue.add_backoff(qp)
-                        m.unschedulable += 1
-                        outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
-                        continue
-                qp.pod.spec.node_name = node_name
-                self.cache.finish_binding(qp.pod.uid)
-                self.queue.done(qp.pod.uid)
-                if m.scheduled == 0:
-                    m.first_scheduled_ts = now
-                m.scheduled += 1
-                m.last_scheduled_ts = now
-                outcomes.append(
-                    ScheduleOutcome(qp.pod, node_name, int(scores[i]), int(feas[i]))
-                )
+                placed.append((i, qp, node_name))
             else:
+                failed.append((i, qp, None))
+
+        # Phase 2 — Permit: gang quorum (the coscheduling plugin's Permit
+        # gate, which runs BEFORE PreBind so rollback never has to unbind
+        # volumes).  Gangs below minMember forget all their assumed members.
+        rollback: set[str] = set()
+        if self.pod_groups:
+            gang_placed: dict[str, int] = {}
+            for _i, qp, _n in placed:
+                g = qp.pod.spec.pod_group
+                if g:
+                    gang_placed[g] = gang_placed.get(g, 0) + 1
+            for g, count in gang_placed.items():
+                pg = self.pod_groups.get(g)
+                if pg is None:
+                    continue
+                if self.gang_bound.get(g, 0) + count < pg.min_member:
+                    rollback.add(g)
+        for i, qp, node_name in placed:
+            g = qp.pod.spec.pod_group
+            if g in rollback:
+                self.cache.forget_pod(qp.pod.uid)
                 m.unschedulable += 1
-                outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]))
-                outcomes.append(outcome)
-                failed.append((i, qp, outcome))
+                outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
+                # Wake on new pod arrivals (more gang members) only.
+                self.queue.add_unschedulable(qp, {"GangScheduling"})
+                continue
+            # Phase 3 — PreBind (VolumeBinding PreBind, volume_binding.go:521):
+            # bind delayed claims on the chosen node.  A pod that lost a
+            # same-batch PV race is forgotten and retried — the
+            # assume/forget protocol (cache.go:404 ForgetPod).
+            if any(v.pvc for v in qp.pod.spec.volumes):
+                node = self.cache.nodes[node_name].node
+                if not self.builder.volumes.bind_pod_volumes(qp.pod, node):
+                    self.cache.forget_pod(qp.pod.uid)
+                    self.queue.add_backoff(qp)
+                    m.unschedulable += 1
+                    outcomes.append(ScheduleOutcome(qp.pod, None, 0, int(feas[i])))
+                    continue
+            qp.pod.spec.node_name = node_name
+            self.cache.finish_binding(qp.pod.uid)
+            self.queue.done(qp.pod.uid)
+            if qp.pod.spec.pod_group:
+                self.gang_bound[qp.pod.spec.pod_group] = (
+                    self.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
+                )
+            if m.scheduled == 0:
+                m.first_scheduled_ts = now
+            m.scheduled += 1
+            m.last_scheduled_ts = now
+            outcomes.append(
+                ScheduleOutcome(qp.pod, node_name, int(scores[i]), int(feas[i]))
+            )
+        failed2 = []
+        for i, qp, _ in failed:
+            outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]))
+            m.unschedulable += 1
+            outcomes.append(outcome)
+            failed2.append((i, qp, outcome))
+        failed = failed2
 
         # PostFilter: one batched preemption pass for every failure
         # (schedule_one.go:196 RunPostFilterPlugins → DefaultPreemption).
