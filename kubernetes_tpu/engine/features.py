@@ -8,6 +8,8 @@ valid=False and are ignored by the engine's commit."""
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from ..api import types as t
@@ -19,10 +21,38 @@ opcommon.feature_fill("ipa_own_terms", -1)
 opcommon.feature_fill("vol_dev_ids", -1)
 opcommon.feature_fill("vol_dev_rw", 0)
 opcommon.feature_fill("vol_drivers", 0)
+opcommon.feature_fill("has_pvc", 0)
+
+_DC_FIELDS: dict[type, tuple[str, ...]] = {}
+
+
+def _sig(o):
+    """Canonical hashable signature of an API object tree.  Workload pods are
+    stamped from templates, so (namespace, labels, spec) collapses thousands
+    of pods onto a handful of signatures — the key of the featurization cache
+    (names/uids are excluded: featurization never reads them)."""
+    if isinstance(o, (str, int, float, bool, type(None))):
+        return o
+    if isinstance(o, dict):
+        return tuple(sorted((k, _sig(v)) for k, v in o.items()))
+    if isinstance(o, (list, tuple)):
+        return tuple(_sig(x) for x in o)
+    cls = o.__class__
+    flds = _DC_FIELDS.get(cls)
+    if flds is None:
+        if not dataclasses.is_dataclass(o):
+            return o  # hashable leaf (frozen helper types)
+        flds = tuple(f.name for f in dataclasses.fields(o))
+        _DC_FIELDS[cls] = flds
+    return (cls.__qualname__,) + tuple(_sig(getattr(o, n)) for n in flds)
 
 
 def build_pod_batch(
-    pods: list[t.Pod], builder: SnapshotBuilder, profile: Profile, k: int
+    pods: list[t.Pod],
+    builder: SnapshotBuilder,
+    profile: Profile,
+    k: int,
+    force_active: frozenset[str] | None = None,
 ) -> tuple[dict, list[dict], frozenset[str]]:
     """Featurize up to ``k`` pods into a dict of (k, …) numpy arrays, plus the
     per-pod commit deltas (reused by the cache's assume step so pods are
@@ -38,16 +68,40 @@ def build_pod_batch(
     all_ops = [opcommon.get(name) for name in dict.fromkeys(
         list(profile.filters) + [s for s, _ in profile.scorers]
     )]
-    ops = [
-        op
-        for op in all_ops
-        if op.is_active is None or any(op.is_active(p, fctx) for p in pods)
-    ]
+    if force_active is not None:
+        # Rebuild for the strict tail: the pass is already compiled for this
+        # op set; features must match it exactly.
+        ops = [op for op in all_ops if op.name in force_active]
+    else:
+        ops = [
+            op
+            for op in all_ops
+            if op.is_active is None or any(op.is_active(p, fctx) for p in pods)
+        ]
     active = frozenset(op.name for op in ops)
     fctx.active = active
     per_pod: list[dict] = []
     deltas: list[dict] = []
+    # Featurization cache: identical (namespace, labels, spec) pods produce
+    # identical features/deltas as long as nothing featurization reads has
+    # changed (vocabularies, schema, volumes, namespace labels — the version
+    # token).  An entry whose own featurization grew a vocabulary is NOT
+    # cached: a pod featurized before term/group T was interned legitimately
+    # lacks T's feature bits only because every pod of T's group schedules
+    # after it — reusing those features for a later pod would break that
+    # ordering invariant.
+    version = (builder.feature_version(), profile, active)
+    if builder.feat_cache is None or builder.feat_cache[0] != version:
+        builder.feat_cache = (version, {})
+    store = builder.feat_cache[1]
     for pod in pods:
+        key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
+        hit = store.get(key)
+        if hit is not None:
+            feats, delta = dict(hit[0]), dict(hit[1])
+            deltas.append(delta)
+            per_pod.append(feats)
+            continue
         delta = builder.pod_delta_vectors(pod)
         deltas.append(delta)
         # Host ports are base commit features: the scan's _commit and the host
@@ -77,11 +131,22 @@ def build_pod_batch(
             "priority": np.int32(pod.spec.priority),
             "port_triples": port_triples,
             "port_keys": port_keys,
+            # Chunked-pass conflict class (engine/pass_.py _conflict_pairs).
+            "has_pvc": np.bool_(bool(delta["pvcs"])),
         }
         for op in ops:
             if op.featurize is not None:
                 feats.update(op.featurize(pod, fctx))
         per_pod.append(feats)
+        v2 = (builder.feature_version(), profile, active)
+        if v2 == version:
+            if len(store) > 8192:
+                store.clear()
+            store[key] = (dict(feats), dict(delta))
+        else:  # this pod grew a vocabulary — new cache generation, skip entry
+            version = v2
+            store = {}
+            builder.feat_cache = (version, store)
 
     if not per_pod:
         raise ValueError("empty pod batch")

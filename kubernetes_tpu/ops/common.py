@@ -50,11 +50,15 @@ class FeaturizeContext:
 class PassContext:
     """Static (trace-time) context for op filter/score functions.  `static`
     holds per-profile resolved config (e.g. scoring-strategy resource columns)
-    baked into the trace — it is never a traced value."""
+    baked into the trace — it is never a traced value.  ``dom`` is the one
+    exception: the engine rebinds it per trace (dataclasses.replace) to the
+    pass's DomTables — the hoisted topology one-hot plus the incrementally
+    maintained per-domain count tables (engine/pass_.py)."""
 
     profile: Profile
     schema: Schema
     static: dict = None  # type: ignore[assignment]
+    dom: object = None  # engine.pass_.DomTables, bound per trace
 
 
 @dataclass(frozen=True)

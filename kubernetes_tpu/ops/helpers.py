@@ -22,7 +22,22 @@ def default_normalize_score(raw: jnp.ndarray, feasible: jnp.ndarray, reverse: bo
     return jnp.where(max_count == 0, 0, scaled)
 
 
-def domain_tables(state, slots, counts, dv):
+def make_topo_onehot(topo_vals: jnp.ndarray, dv: int) -> jnp.ndarray:
+    """(N, TK, DV) f32 one-hot of the per-node topology values.  Scan-invariant
+    (node topology never changes while a batch commits pods), so the engine
+    computes it ONCE per device pass and closes the scan body over it — the
+    hoist that turns the per-step domain reductions from O(N·TK·DV) rebuilds
+    into cheap table gathers.  Hostname-key values exceed DV by design
+    (excluded from the vocabulary); ops take a per-node fast path for them,
+    and any hostname ids that happen to fall inside [0, DV) produce garbage
+    table rows that every reader masks out via its ``host`` flags."""
+    return (
+        (topo_vals[:, :, None] == jnp.arange(dv)[None, None, :])
+        & (topo_vals >= 0)[:, :, None]
+    ).astype(jnp.float32)
+
+
+def domain_tables(state, slots, counts, dv, onehot=None):
     """Per-term domain sums as MXU matmuls (no scatters).
 
     ``slots`` (T,) topology-key slot per term; ``counts`` (T, N) f32
@@ -30,17 +45,15 @@ def domain_tables(state, slots, counts, dv):
     tbl (T, DV)) where ``tbl[t, d] = Σ_n masked[t, n]·[vals[t, n] == d]``.
     The one-hot of topo_vals is shared across terms, so the reduction is one
     ``(T,N)×(N,TK·DV)`` einsum — scatter-free, which is what the TPU wants.
-    Hostname-key values exceed DV by design (excluded from the vocabulary);
-    callers take the per-node fast path for them."""
+    Pass the engine's hoisted ``onehot`` (ctx.dom.onehot) so the scan does not
+    rebuild it every step."""
     vals_all = state.topo_vals  # (N, TK)
     vals = jnp.take(vals_all, slots, axis=1).T  # (T, N)
     key_present = vals >= 0
     masked = jnp.where(key_present, counts, 0.0)
-    onehot = (
-        (vals_all[:, :, None] == jnp.arange(dv)[None, None, :])
-        & (vals_all >= 0)[:, :, None]
-    ).astype(counts.dtype)  # (N, TK, DV)
-    tbl_all = jnp.einsum("tn,nkd->tkd", masked, onehot)  # (T, TK, DV)
+    if onehot is None:
+        onehot = make_topo_onehot(vals_all, dv)
+    tbl_all = jnp.einsum("tn,nkd->tkd", masked, onehot.astype(counts.dtype))
     tbl = jnp.take_along_axis(
         tbl_all, slots[:, None, None].astype(jnp.int32), axis=1
     )[:, 0, :]  # (T, DV)

@@ -25,10 +25,13 @@ TPU design: existing pods' terms are interned into a term vocabulary; the
 cluster state carries per-(term, node) carrier counts (et_counts), updated by
 the same commit delta that moves resources.  Featurization matches the
 incoming pod against every interned term once (host-side string work), and
-compiles the pod's own terms to group bitmasks, so the device computes all
-domain tallies with (T,G)×(G,N) matmuls plus segment reductions over interned
-topology values — replacing the reference's O(pods × nodes) goroutine sweep
-(the BASELINE config #3 worst case) with dense linear algebra.
+compiles the pod's own terms to group bitmasks.  On device, all domain
+tallies come from the engine's DomTables (engine/pass_.py): ``group_dom``
+(G, TK, DV) and ``et_dom`` (ET, DV) are built once per pass with MXU matmuls
+and updated incrementally as the scan commits pods, so each step only does
+tiny (T,G)×(G,DV) contractions and (N, TK) gathers — replacing the
+reference's O(pods × nodes) goroutine sweep (the BASELINE config #3 worst
+case) with dense linear algebra whose per-pod cost is near-constant.
 """
 
 from __future__ import annotations
@@ -42,7 +45,6 @@ from ..framework.config import MAX_NODE_SCORE
 from ..intern import term_key
 from ..snapshot import _bucket
 from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
-from .helpers import domain_tables
 from .podtopologyspread import groups_matching
 
 # Existing-term categories (intern.term_id).
@@ -146,20 +148,18 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
         )
     )
 
-    # Match the pod against every interned existing-pod term.
+    # Match the pod against every interned existing-pod term.  The terms'
+    # topology slots/host flags are batch-invariant and live in the engine's
+    # DomTables (built by SnapshotBuilder.batch_invariants), not per pod.
     builder._ensure(ET=max(len(it.terms), 1))
     et = builder.schema.ET
     et_match = np.zeros(et, np.bool_)
     et_anti = np.zeros(et, np.bool_)
     et_w = np.zeros(et, np.int64)
-    et_slot = np.zeros(et, np.int32)
-    et_host = np.zeros(et, np.bool_)
     hard_w = fctx.profile.hard_pod_affinity_weight if fctx.profile else 1
     for tid in range(len(it.terms)):
         key = it.terms.value(tid)
-        cat, weight, topo_key = key[0], key[1], key[2]
-        et_slot[tid] = builder.ensure_topo_key(topo_key)
-        et_host[tid] = topo_key == it.HOSTNAME_KEY
+        cat, weight = key[0], key[1]
         if not _term_matches_pod(key, pod, builder.namespace_labels):
             continue
         et_match[tid] = True
@@ -171,68 +171,94 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
             et_w[tid] = weight
         elif cat == CAT_PREF_ANTI:
             et_w[tid] = -weight
-    feats.update(
-        ipa_et_match=et_match,
-        ipa_et_anti=et_anti,
-        ipa_et_w=et_w,
-        ipa_et_slot=et_slot,
-        ipa_et_host=et_host,
-    )
+    feats.update(ipa_et_match=et_match, ipa_et_anti=et_anti, ipa_et_w=et_w)
     return feats
 
 
-def _domain_tables(state, slots, counts, host, dv):
-    """Per-term domain tallies gathered back per node: (T, N).
+def _own_term_tallies(state, dom, slots, masks, host):
+    """Per-term domain tallies for the incoming pod's own terms: (T, N).
 
-    ``counts`` (T, N) f32 contributions; nodes missing the term's topology
-    key contribute nothing (the reference's map update skips them).
-    ``host`` (T,) marks hostname-key terms: their domains are single nodes
-    (the hostname vocabulary is excluded from DV), so the tally at a node is
-    the node's own count — no domain table."""
-    vals, key_present, masked, tbl = domain_tables(state, slots, counts, dv)
-    gathered = jnp.take_along_axis(tbl, jnp.clip(vals, 0, dv - 1), axis=1)
-    at_node = jnp.where(host[:, None], masked, gathered)  # (T, N)
-    return vals, key_present, masked, at_node
+    ``masks`` (T, G) group bitmasks, ``slots`` (T,) topo-key slots.  Generic
+    terms contract the engine's group_dom table — (T,G)×(G,DV) per slot, no
+    node-axis work; hostname terms (single-node domains, their vocabulary is
+    excluded from DV) take the per-node (T,G)×(G,N) matmul fast path.
+    Returns (vals (T,N), key_present (T,N), cnt_node (T,N), at_node (T,N))
+    where cnt_node is the per-node matching count and at_node the term's
+    domain tally at each node (0 where the key is missing)."""
+    masks = masks.astype(jnp.float32)
+    vals = jnp.take(state.topo_vals, slots, axis=1).T  # (T, N)
+    key_present = vals >= 0
+    cnt_node = masks @ state.group_counts.astype(jnp.float32)  # (T, N)
+    gd = jnp.take(dom.group_dom, slots, axis=1)  # (G, T, DV)
+    tbl = jnp.einsum("tg,gtd->td", masks, gd)  # (T, DV)
+    gathered = jnp.take_along_axis(tbl, jnp.clip(vals, 0, tbl.shape[1] - 1), axis=1)
+    at_node = jnp.where(key_present, jnp.where(host[:, None], cnt_node, gathered), 0.0)
+    return vals, key_present, cnt_node, at_node, tbl
 
 
 def _affinity_ok(state, pf, ctx: PassContext):
     """Incoming required-affinity check (2) — its failures are
     UnschedulableAndUnresolvable (ErrReasonAffinityRulesNotMatch)."""
-    gc = state.group_counts.astype(jnp.float32)
+    dom = ctx.dom
     ra_valid = pf["ipa_ra_valid"]  # (RA,)
     any_ra = ra_valid.any()
-    cnt_all = pf["ipa_ra_allmask"].astype(jnp.float32) @ gc  # (N,)
-    ra_counts = jnp.broadcast_to(cnt_all[None, :], (ra_valid.shape[0], cnt_all.shape[0]))
-    _v, key_ra, masked_ra, at_ra = _domain_tables(
-        state, pf["ipa_ra_slot"], ra_counts, pf["ipa_ra_host"], ctx.schema.DV
+    host = pf["ipa_ra_host"]
+    # All required terms share one intersection mask (podMatchesAllAffinityTerms).
+    allmask = jnp.broadcast_to(
+        pf["ipa_ra_allmask"][None, :], (ra_valid.shape[0], pf["ipa_ra_allmask"].shape[0])
+    )
+    _v, key_ra, cnt_node, at_ra, tbl = _own_term_tallies(
+        state, dom, pf["ipa_ra_slot"], allmask, host
     )
     keys_ok = (key_ra | ~ra_valid[:, None]).all(0)
     pods_exist = ((at_ra > 0.5) | ~ra_valid[:, None]).all(0)
     # len(affinityCounts) == 0 ⟺ no key-bearing node hosts a matching pod.
-    counts_empty = jnp.sum(jnp.where(ra_valid[:, None], masked_ra, 0.0)) == 0
+    per_term_total = jnp.where(
+        host,
+        (key_ra.astype(jnp.float32) * cnt_node).sum(1),
+        tbl.sum(1),
+    )  # (T,)
+    counts_empty = jnp.sum(jnp.where(ra_valid, per_term_total, 0.0)) == 0
     return ~any_ra | (keys_ok & (pods_exist | (counts_empty & pf["ipa_ra_self"])))
 
 
-def filter_fn(state, pf, ctx: PassContext):
-    gc = state.group_counts.astype(jnp.float32)  # (G, N)
-    dv = ctx.schema.DV
-
-    # (1) Existing pods' required anti-affinity.
+def _existing_anti_fail(state, pf, ctx: PassContext):
+    """(1) Existing pods' required anti-affinity: a node fails if any of its
+    topology domains carries a matching term (filtering.go:306).  Reduced to a
+    (TK, DV) forbidden-domain table (terms merge per slot) + an (N, TK)
+    gather; hostname terms check their per-node carrier counts directly."""
+    dom = ctx.dom
+    tk, dv = ctx.schema.TK, ctx.schema.DV
     active_e = pf["ipa_et_match"] & pf["ipa_et_anti"]  # (ET,)
-    carriers = state.et_counts.astype(jnp.float32)  # (ET, N)
-    _v, key_e, _m, at_node_e = _domain_tables(
-        state, pf["ipa_et_slot"], carriers, pf["ipa_et_host"], dv
-    )
-    fail_existing = (active_e[:, None] & key_e & (at_node_e > 0.5)).any(0)
+    nonhost = active_e & ~dom.et_host
+    slot_oh = (dom.et_slot[:, None] == jnp.arange(tk)[None, :]).astype(jnp.float32)
+    forbidden_kd = jnp.einsum(
+        "tk,td->kd",
+        jnp.where(nonhost[:, None], slot_oh, 0.0),
+        (dom.et_dom > 0.5).astype(jnp.float32),
+    )  # (TK, DV)
+    dvals = state.topo_vals  # (N, TK)
+    hit = forbidden_kd[jnp.arange(tk)[None, :], jnp.clip(dvals, 0, dv - 1)]  # (N, TK)
+    fail_nonhost = ((hit > 0.5) & (dvals >= 0)).any(axis=1)
+    host_active = (active_e & dom.et_host).astype(jnp.float32)
+    key_e = dom.et_vals >= 0  # (ET, N)
+    fail_host = (
+        host_active @ ((state.et_counts > 0) & key_e).astype(jnp.float32)
+    ) > 0.5
+    return fail_nonhost | fail_host
+
+
+def filter_fn(state, pf, ctx: PassContext):
+    # (1) Existing pods' required anti-affinity.
+    fail_existing = _existing_anti_fail(state, pf, ctx)
 
     # (2) Incoming required affinity.
     aff_ok = _affinity_ok(state, pf, ctx)
 
     # (3) Incoming required anti-affinity.
     rs_valid = pf["ipa_rs_valid"]
-    cnt_rs = pf["ipa_rs_groups"].astype(jnp.float32) @ gc  # (RS, N)
-    _v, key_rs, _m, at_rs = _domain_tables(
-        state, pf["ipa_rs_slot"], cnt_rs, pf["ipa_rs_host"], dv
+    _v, key_rs, _cnt, at_rs, _tbl = _own_term_tallies(
+        state, ctx.dom, pf["ipa_rs_slot"], pf["ipa_rs_groups"], pf["ipa_rs_host"]
     )
     fail_anti = (rs_valid[:, None] & key_rs & (at_rs > 0.5)).any(0)
 
@@ -244,14 +270,13 @@ def hard_filter_fn(state, pf, ctx: PassContext):
 
 
 def score_fn(state, pf, ctx: PassContext, feasible):
-    gc = state.group_counts.astype(jnp.float32)
-    dv = ctx.schema.DV
+    dom = ctx.dom
+    tk, dv = ctx.schema.TK, ctx.schema.DV
 
     # Incoming pod's preferred terms: ±w × (matching pods in the node's domain).
     pf_valid = pf["ipa_pf_valid"]
-    cnt_p = pf["ipa_pf_groups"].astype(jnp.float32) @ gc  # (PP, N)
-    _v, key_p, _m, at_p = _domain_tables(
-        state, pf["ipa_pf_slot"], cnt_p, pf["ipa_pf_host"], dv
+    _v, key_p, _cnt, at_p, _tbl = _own_term_tallies(
+        state, dom, pf["ipa_pf_slot"], pf["ipa_pf_groups"], pf["ipa_pf_host"]
     )
     raw = jnp.sum(
         jnp.where(pf_valid[:, None] & key_p, at_p, 0.0)
@@ -260,17 +285,24 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     )
 
     # Existing pods' terms matching the incoming pod: carriers in the node's
-    # domain × signed weight (hard affinity / preferred ±w).
+    # domain × signed weight (hard affinity / preferred ±w).  Terms collapse
+    # into a (TK, DV) weighted-domain table, read back with one (N, TK)
+    # gather; hostname terms use their per-node carrier counts via a matvec.
     active_e = pf["ipa_et_match"] & (pf["ipa_et_w"] != 0)
-    carriers = state.et_counts.astype(jnp.float32)
-    _v, key_e, _m, at_e = _domain_tables(
-        state, pf["ipa_et_slot"], carriers, pf["ipa_et_host"], dv
-    )
-    raw += jnp.sum(
-        jnp.where(active_e[:, None] & key_e, at_e, 0.0)
-        * pf["ipa_et_w"][:, None].astype(jnp.float32),
-        axis=0,
-    )
+    wts = pf["ipa_et_w"].astype(jnp.float32)
+    slot_oh = (dom.et_slot[:, None] == jnp.arange(tk)[None, :]).astype(jnp.float32)
+    wsum_kd = jnp.einsum(
+        "t,tk,td->kd",
+        jnp.where(active_e & ~dom.et_host, wts, 0.0),
+        slot_oh,
+        dom.et_dom,
+    )  # (TK, DV)
+    dvals = state.topo_vals  # (N, TK)
+    hit = wsum_kd[jnp.arange(tk)[None, :], jnp.clip(dvals, 0, dv - 1)]  # (N, TK)
+    raw += jnp.where(dvals >= 0, hit, 0.0).sum(axis=1)
+    host_w = jnp.where(active_e & dom.et_host, wts, 0.0)
+    key_e = dom.et_vals >= 0  # (ET, N)
+    raw += host_w @ (state.et_counts.astype(jnp.float32) * key_e)
     raw = raw.astype(jnp.int64)
 
     big = jnp.int64(2**62)
@@ -289,8 +321,7 @@ for _k, _fill in [
     ("ipa_rs_valid", 0), ("ipa_rs_slot", 0), ("ipa_rs_groups", 0), ("ipa_rs_host", 0),
     ("ipa_pf_valid", 0), ("ipa_pf_slot", 0), ("ipa_pf_groups", 0), ("ipa_pf_w", 0),
     ("ipa_pf_host", 0),
-    ("ipa_et_match", 0), ("ipa_et_anti", 0), ("ipa_et_w", 0), ("ipa_et_slot", 0),
-    ("ipa_et_host", 0),
+    ("ipa_et_match", 0), ("ipa_et_anti", 0), ("ipa_et_w", 0),
 ]:
     feature_fill(_k, _fill)
 

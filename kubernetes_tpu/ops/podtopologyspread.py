@@ -157,17 +157,25 @@ def _per_constraint(state, pf, ctx: PassContext, prefix: str):
     return valid, vals, key_present, all_keys, elig, cnt, cnt_raw
 
 
-def _segment_tables(state, slots, elig, cnt, dv):
-    """Per-domain totals and presence: (C, DV) tables (MXU matmuls)."""
-    _v, _k, _m, tbl = domain_tables(state, slots, cnt, dv)
-    _v, _k, _m, pres = domain_tables(state, slots, elig.astype(jnp.float32), dv)
+def _segment_tables(state, slots, elig, cnt, dv, onehot=None):
+    """Per-domain totals and presence: (C, DV) tables (MXU matmuls).
+
+    The counting-eligibility mask is per-pod (node-inclusion policies), so
+    these stay per-step einsums — but over the engine's hoisted one-hot
+    (ctx.dom.onehot), never rebuilding the (N, TK, DV) tensor in the scan."""
+    _v, _k, _m, tbl = domain_tables(state, slots, cnt, dv, onehot)
+    _v, _k, _m, pres = domain_tables(state, slots, elig.astype(jnp.float32), dv, onehot)
     return tbl, pres > 0.5
 
 
-def _segment_presence(state, slots, mask, dv):
+def _segment_presence(state, slots, mask, dv, onehot=None):
     """(C, DV) bool: domains containing a True-masked node."""
-    _v, _k, _m, pres = domain_tables(state, slots, mask.astype(jnp.float32), dv)
+    _v, _k, _m, pres = domain_tables(state, slots, mask.astype(jnp.float32), dv, onehot)
     return pres > 0.5
+
+
+def _onehot(ctx: PassContext):
+    return ctx.dom.onehot if ctx.dom is not None else None
 
 
 def filter_fn(state, pf, ctx: PassContext):
@@ -176,7 +184,9 @@ def filter_fn(state, pf, ctx: PassContext):
     )
     host = pf["tps_h_hostname"]  # (C,)
     # Generic path: per-domain tables over the (hostname-free) DV vocabulary.
-    tbl, present = _segment_tables(state, pf["tps_h_slot"], elig, cnt, ctx.schema.DV)
+    tbl, present = _segment_tables(
+        state, pf["tps_h_slot"], elig, cnt, ctx.schema.DV, _onehot(ctx)
+    )
     tbl = tbl.astype(jnp.int64)
     min_g = jnp.min(jnp.where(present, tbl, MAX_INT32), axis=1)  # (C,)
     dom_g = present.sum(axis=1)
@@ -207,7 +217,9 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     # and end at score 0 via the final `scored` mask.
     scored = feasible & all_keys
 
-    tbl, _present = _segment_tables(state, pf["tps_s_slot"], elig, cnt, ctx.schema.DV)
+    tbl, _present = _segment_tables(
+        state, pf["tps_s_slot"], elig, cnt, ctx.schema.DV, _onehot(ctx)
+    )
     # Domains/topoSize count distinct pairs among *scored candidate* nodes
     # (initPreScoreState iterates filteredNodes); hostname topoSize is the
     # number of scored nodes.
@@ -216,6 +228,7 @@ def score_fn(state, pf, ctx: PassContext, feasible):
         pf["tps_s_slot"],
         jnp.broadcast_to(scored[None, :], vals.shape),
         ctx.schema.DV,
+        _onehot(ctx),
     )
     pair_cnt = jnp.take_along_axis(tbl, jnp.clip(vals, 0, ctx.schema.DV - 1), axis=1)  # (C, N)
     # Hostname counts the node's own pods directly, with no counting-

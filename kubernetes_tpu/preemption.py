@@ -85,13 +85,13 @@ def build_preempt_pass(
             static.update(op.static(profile, schema, builder_res_col))
     ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
 
-    def step(carry, pf):
+    def step(carry, pf, dctx):
         state, vic_prio, vic_req, vic_nonzero, vic_start = carry
         # Candidate nodes: valid and not unresolvably rejected.
         candidate = state.valid
         for op in filter_ops:
             if op.hard_filter is not None:
-                candidate &= ~op.hard_filter(state, pf, ctx)
+                candidate &= ~op.hard_filter(state, pf, dctx)
 
         n, v = vic_prio.shape
         prio = pf["priority"].astype(jnp.int32)
@@ -194,9 +194,17 @@ def build_preempt_pass(
         return (state, vic_prio, vic_req, vic_nonzero, vic_start), out
 
     @jax.jit
-    def run(state, batch, vic_prio, vic_req, vic_nonzero, vic_start):
+    def run(state, batch, inv, vic_prio, vic_req, vic_nonzero, vic_start):
+        # Domain tables for the hard filters (e.g. InterPodAffinity's
+        # required-affinity check).  The dry-run carry releases resources
+        # only — group/term counts never change — so one build at entry
+        # serves every scan step (engine/pass_.py build_dom).
+        from .engine.pass_ import build_dom
+
+        dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        dctx = dataclasses.replace(ctx, dom=dom)
         carry = (state, vic_prio, vic_req, vic_nonzero, vic_start)
-        carry, out = lax.scan(step, carry, batch)
+        carry, out = lax.scan(lambda c, pf: step(c, pf, dctx), carry, batch)
         return out
 
     return run
@@ -224,6 +232,7 @@ class PreemptionEvaluator:
         pods: list[t.Pod],
         batch_rows: dict,
         active: frozenset[str] | None = None,
+        inv: dict | None = None,
     ) -> list[PreemptionResult | None]:
         """Run preemption for the failed pods of one scheduling batch.
         ``batch_rows`` are each pod's already-built feature dict rows."""
@@ -286,9 +295,11 @@ class PreemptionEvaluator:
         batch["valid"] = np.zeros(k, np.bool_)
         batch["valid"][: len(pods)] = eligible
 
+        if inv is None:
+            inv = builder.batch_invariants()
         state = builder.state()
         out = self._pass(active)(
-            state, batch, jnp.asarray(vic_prio), jnp.asarray(vic_req),
+            state, batch, inv, jnp.asarray(vic_prio), jnp.asarray(vic_req),
             jnp.asarray(vic_nonzero), jnp.asarray(vic_start),
         )
         picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)

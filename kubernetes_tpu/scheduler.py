@@ -47,6 +47,7 @@ class SchedulerMetrics:
     scheduled: int = 0
     unschedulable: int = 0
     preemptions: int = 0
+    deferred: int = 0  # chunk-conflict deferrals resolved by the strict tail
     batches: int = 0
     device_time_s: float = 0.0
     featurize_time_s: float = 0.0
@@ -63,11 +64,19 @@ class TPUScheduler:
         queue: SchedulingQueue | None = None,
         enable_preemption: bool = True,
         mesh=None,
+        chunk_size: int = 1,
     ):
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
         self.profile = registered_subset(profile)
         self.batch_size = batch_size
+        # chunk_size=1 → strictly sequential-equivalent scan (parity mode);
+        # >1 → C pods per device step with conflict-deferral + a strict tail
+        # pass for the deferred readers (engine/pass_.py module docstring).
+        assert batch_size % chunk_size == 0, "batch_size must be a chunk multiple"
+        self.chunk_size = chunk_size
+        # Strict tail batches are padded to this fixed shape (one compile).
+        self.tail_size = min(batch_size, 256)
         self.interns = InternTable()
         self.builder = SnapshotBuilder(self.interns)
         self.cache = Cache(self.builder)
@@ -84,10 +93,33 @@ class TPUScheduler:
             # XLA inserts the ICI collectives for the cross-shard reductions.
             self.builder.set_mesh(mesh)
         self._cycle = 0
+        # Shapes of the last scheduled batch (for warm_tail precompilation).
+        self._last_batch_meta: tuple | None = None
         # Pre-intern the hot topology keys so node rows materialize them.
         for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
                     "topology.kubernetes.io/region"):
             self.builder.ensure_topo_key(key)
+
+    def warm_tail(self) -> None:
+        """Pre-compile the strict tail pass (chunk=1) with an all-invalid
+        batch so a mid-run deferral doesn't pay XLA compilation inside a
+        measured window.  No-op when nothing has been scheduled yet or in
+        strict mode."""
+        if self.chunk_size == 1 or self._last_batch_meta is None:
+            return
+        shapes, active = self._last_batch_meta
+        ts = self.tail_size
+        sub = {
+            k: np.zeros((ts,) + shape[1:], dtype) for k, (shape, dtype) in shapes.items()
+        }
+        sub["valid"] = np.zeros(ts, np.bool_)
+        inv = self.builder.batch_invariants()
+        state = self.builder.state()
+        strict = self.passes.get(
+            self.profile, self.builder.schema, self.builder.res_col, active, 1
+        )
+        # All-invalid batch: commits nothing; discard the (identical) state.
+        strict(state, sub, inv, np.uint32(0))
 
     # -- cluster events (the informer surface, eventhandlers.go:341) ---------
 
@@ -168,15 +200,70 @@ class TPUScheduler:
         batch, deltas, active = build_pod_batch(
             pods, self.builder, self.profile, self.batch_size
         )
+        # Batch invariants (interned term → topo slot) may grow TK/DV: build
+        # them after featurization, before the state flush.
+        inv = self.builder.batch_invariants()
         t1 = time.perf_counter()
         state = self.builder.state()
-        run = self.passes.get(self.profile, self.builder.schema, self.builder.res_col, active)
-        new_state, result = run(state, batch, np.uint32(self._cycle))
+        run = self.passes.get(
+            self.profile, self.builder.schema, self.builder.res_col, active,
+            self.chunk_size,
+        )
+        new_state, result = run(state, batch, inv, np.uint32(self._cycle))
         # One host round trip for all result arrays (the tunnel to the device
         # has high per-transfer latency; never sync field-by-field).
         picks, scores, feas = jax.device_get((result.picks, result.scores, result.feasible_counts))
-        t2 = time.perf_counter()
         self._cycle += len(infos)
+        # Strict tail: chunk-deferred pods (pick == -2) re-run through the
+        # sequential-equivalent chunk=1 pass against the committed state, in
+        # original order, until none remain (a deferred pod never defers
+        # again there).  The tail REORDERS commits after later chunks, so the
+        # deferred pods are RE-FEATURIZED against the now-complete term/group
+        # vocabularies — a pod's original features only matched the terms
+        # interned before it, which is sound solely under batch-order commits.
+        deferred = [i for i in range(len(infos)) if picks[i] == -2]
+        if deferred:
+            picks, scores, feas = picks.copy(), scores.copy(), feas.copy()
+            strict = self.passes.get(
+                self.profile, self.builder.schema, self.builder.res_col, active, 1
+            )
+            ts = self.tail_size
+            for lo in range(0, len(deferred), ts):
+                idx = deferred[lo : lo + ts]
+                sub, sub_deltas, _ = build_pod_batch(
+                    [infos[i].pod for i in idx], self.builder, self.profile,
+                    ts, force_active=active,
+                )
+                for j, i in enumerate(idx):
+                    deltas[i] = sub_deltas[j]
+                # Per-pod bucket dims (own terms, devices) are padded to the
+                # sub-batch max; pad up to the original batch's shapes so the
+                # compiled tail sees one shape set.
+                from .ops.common import FEATURE_FILLS
+
+                for key2, arr in sub.items():
+                    tgt = batch[key2].shape[1:]
+                    if arr.shape[1:] != tgt:
+                        padw = [(0, 0)] + [
+                            (0, tg - cur) for cur, tg in zip(arr.shape[1:], tgt)
+                        ]
+                        sub[key2] = np.pad(
+                            arr, padw, constant_values=FEATURE_FILLS.get(key2, 0)
+                        )
+                new_state, res = strict(new_state, sub, inv, np.uint32(self._cycle))
+                p2, s2, f2 = jax.device_get(
+                    (res.picks, res.scores, res.feasible_counts)
+                )
+                self._cycle += len(idx)
+                picks[idx], scores[idx], feas[idx] = (
+                    p2[: len(idx)], s2[: len(idx)], f2[: len(idx)],
+                )
+            self.metrics.deferred += len(deferred)
+        t2 = time.perf_counter()
+        self._last_batch_meta = (
+            {k: (v.shape, np.asarray(v).dtype) for k, v in batch.items()},
+            active,
+        )
         self.builder.absorb_device_state(new_state)
 
         outcomes: list[ScheduleOutcome] = []
@@ -269,7 +356,7 @@ class TPUScheduler:
                 if key != "valid"
             }
             results = self.preemption.preempt_batch(
-                [qp.pod for _, qp, _ in failed], rows, active
+                [qp.pod for _, qp, _ in failed], rows, active, inv
             )
         any_victims = False
         for (_, qp, outcome), res in zip(failed, results):

@@ -211,8 +211,10 @@ class SnapshotBuilder:
         self.schema = schema or Schema()
         # Namespace → labels, for namespaceSelector matching in affinity terms
         # (the analog of the scheduler's namespace lister snapshot,
-        # interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).
+        # interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).  Update via
+        # set_namespace_labels (bumps ns_epoch for the featurization cache).
         self.namespace_labels: dict[str, dict[str, str]] = {}
+        self.ns_epoch = 0
         # Optional multi-chip mesh: node axis sharded, everything else
         # replicated (parallel/mesh.py).
         self.mesh = None
@@ -226,6 +228,9 @@ class SnapshotBuilder:
         self._dirty_all = True  # device needs a full (re)build
         # Resource-name → column index (fixed columns pre-assigned).
         self.res_col: dict[str, int] = {r: i for i, r in enumerate(FIXED_RESOURCES)}
+        # Featurization cache (engine/features.py): version token → per-pod
+        # feature/delta entries valid only while no vocabulary/schema grows.
+        self.feat_cache: tuple[tuple, dict] | None = None
 
     # -- capacity management -------------------------------------------------
 
@@ -342,6 +347,55 @@ class SnapshotBuilder:
                     self._dirty_rows.add(row)
             self._ensure(DV=self.interns.max_topo_vocab())
         return slot
+
+    def batch_invariants(self) -> dict[str, np.ndarray]:
+        """Batch-invariant device inputs for the engine's DomTables: every
+        interned (anti-)affinity term's topology slot and hostname flag.
+        These are properties of the term vocabulary, not of any pod — built
+        once per batch (after featurization interned new terms, before the
+        state flush, since interning a term's topology key can grow TK/DV
+        and backfill node rows)."""
+        it = self.interns
+        self._ensure(ET=max(len(it.terms), 1))
+        for tid in range(len(it.terms)):
+            self.ensure_topo_key(it.terms.value(tid)[2])
+        et_slot = np.zeros(self.schema.ET, np.int32)
+        et_host = np.zeros(self.schema.ET, np.bool_)
+        for tid in range(len(it.terms)):
+            topo_key = it.terms.value(tid)[2]
+            et_slot[tid] = it.topo_keys.get(topo_key)
+            et_host[tid] = topo_key == it.HOSTNAME_KEY
+        return {"et_slot": et_slot, "et_host": et_host}
+
+    def set_namespace_labels(self, namespace: str, labels: dict[str, str]) -> None:
+        """Namespace label updates (the namespace informer feeding
+        interpodaffinity's namespaceSelector matching).  Mutate ONLY through
+        this method: the featurization cache keys on ns_epoch."""
+        self.namespace_labels[namespace] = dict(labels)
+        self.ns_epoch += 1
+
+    def feature_version(self) -> tuple:
+        """Cheap O(#vocabs) token identifying everything pod featurization
+        can read besides the pod itself; any change invalidates cached
+        features.  Called once per cache-missing pod — no content hashing."""
+        it = self.interns
+        return (
+            self.schema,
+            len(it.terms),
+            len(it.groups),
+            len(it.namespaces),
+            len(it.label_keys),
+            len(it.label_pairs),
+            len(it.taints),
+            len(it.devices),
+            len(it.drivers),
+            len(it.ports),
+            len(it.images),
+            len(it.node_names),
+            tuple(len(v) for v in it.topo_vals),
+            self.volumes.epoch,
+            self.ns_epoch,
+        )
 
     def clear_node_row(self, row: int) -> None:
         h = self.host

@@ -36,24 +36,33 @@ class VolumeCatalog:
     # PVC uid → number of pods using it (for ReadWriteOncePod conflicts,
     # volumerestrictions/volume_restrictions.go).
     pvc_users: dict[str, int] = field(default_factory=dict)
+    # Bumped on every catalog mutation; featurization caches key on it so a
+    # PV/PVC/class change invalidates cached pod features.
+    epoch: int = 0
 
     # -- object events -------------------------------------------------------
 
     def add_pv(self, pv: t.PersistentVolume) -> None:
         self.pvs[pv.name] = pv
+        self.epoch += 1
 
     def add_pvc(self, pvc: t.PersistentVolumeClaim) -> None:
         self.pvcs[pvc.uid] = pvc
+        self.epoch += 1
 
     def add_class(self, sc: t.StorageClass) -> None:
         self.classes[sc.name] = sc
+        self.epoch += 1
 
     def add_csinode(self, csinode: t.CSINode) -> None:
         self.csinodes[csinode.name] = csinode
+        self.epoch += 1
 
     def adjust_pvc_users(self, pvc_uids: list[str], delta: int) -> None:
         for uid in pvc_uids:
             self.pvc_users[uid] = self.pvc_users.get(uid, 0) + delta
+        if pvc_uids:
+            self.epoch += 1
 
     # -- pod classification --------------------------------------------------
 
@@ -193,4 +202,5 @@ class VolumeCatalog:
             else:
                 pv.claim_ref = pvc.uid
                 pvc.volume_name = pv.name
+                self.epoch += 1
         return True

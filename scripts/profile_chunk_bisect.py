@@ -1,0 +1,130 @@
+"""Bisect the chunked fit-only step: which phase costs what at C=64."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.engine.features import build_pod_batch
+from kubernetes_tpu.engine.pass_ import (
+    DomTables, _commit_chunk, _conflict_pairs, _hash_u32, build_dom, select_host,
+)
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.ops import common as opcommon
+from kubernetes_tpu.scheduler import TPUScheduler
+
+K, C = 2048, 64
+
+
+def build():
+    s = TPUScheduler(profile=fit_only_profile(), batch_size=K)
+    for i in range(5000):
+        s.add_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % 10}")
+            .obj()
+        )
+    pods = [
+        make_pod(f"pod-{i}").req({"cpu": "100m", "memory": "256Mi"}).obj()
+        for i in range(K)
+    ]
+    for p in pods:
+        s.add_pod(p)
+    infos = s.queue.pop_batch(K)
+    batch, _, active = build_pod_batch([qp.pod for qp in infos], s.builder, s.profile, K)
+    inv = s.builder.batch_invariants()
+    state = s.builder.state()
+    return s, state, batch, active, inv
+
+
+s, state, batch, active, inv = build()
+schema = s.builder.schema
+profile = s.profile
+filter_ops = [opcommon.get(n) for n in profile.filters if n in active]
+score_ops = [(opcommon.get(n), w) for n, w in profile.scorers if n in active]
+static = {}
+for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
+    if op.static is not None:
+        static.update(op.static(profile, schema, s.builder.res_col))
+ctx0 = opcommon.PassContext(profile=profile, schema=schema, static=static)
+
+
+def make_run(mode):
+    import dataclasses
+
+    @jax.jit
+    def run(state, batch, inv, seed_base):
+        dom0 = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        cbatch = jax.tree_util.tree_map(
+            lambda x: x.reshape((K // C, C) + x.shape[1:]), batch
+        )
+        steps = (seed_base.astype(jnp.uint32) + jnp.arange(K, dtype=jnp.uint32)).reshape(K // C, C)
+
+        def eval_pod(state, dctx, pf, step_idx):
+            feasible = state.valid
+            if mode >= 1:
+                for op in filter_ops:
+                    if op.filter is not None:
+                        feasible &= op.filter(state, pf, dctx)
+            total = jnp.zeros(schema.N, jnp.int64)
+            if mode >= 2:
+                for op, weight in score_ops:
+                    if op.score is not None:
+                        total += op.score(state, pf, dctx, feasible) * jnp.int64(weight)
+            if mode >= 3:
+                tie_rand = _hash_u32(jnp.uint32(7) + step_idx.astype(jnp.uint32))
+                pick, best, _ = select_host(feasible, total, tie_rand)
+            else:
+                pick = jnp.argmax(feasible).astype(jnp.int32)
+                best = jnp.int64(0)
+            return pick, best, jnp.sum(feasible.astype(jnp.int32))
+
+        def step(carry, xs):
+            state, gd, ed = carry
+            pf, step_idx = xs
+            dom = dom0._replace(group_dom=gd, et_dom=ed)
+            dctx = dataclasses.replace(ctx0, dom=dom)
+            picks, bests, feas = jax.vmap(lambda p, si: eval_pod(state, dctx, p, si))(pf, step_idx)
+            att = pf["valid"] & (picks >= 0)
+            if mode >= 5:
+                pairs = _conflict_pairs(pf, schema)
+                before = jnp.triu(jnp.ones((C, C), jnp.bool_), k=1)
+                defer = (pairs & before & att[:, None]).any(axis=0) & att
+                att = att & ~defer
+                samei = (
+                    (picks[:, None] == picks[None, :]) & att[:, None] & att[None, :]
+                    & jnp.triu(jnp.ones((C, C), jnp.bool_))
+                )
+                cum_req = jnp.where(samei[:, :, None], pf["req"][:, None, :], jnp.int64(0)).sum(axis=0)
+                cum_cnt = samei.sum(axis=0).astype(jnp.int32)
+                rows = jnp.where(att, picks, 0)
+                free = (state.alloc - state.req)[rows]
+                ok = (cum_req <= free).all(axis=-1) & (
+                    state.num_pods[rows] + cum_cnt <= state.allowed_pods[rows]
+                )
+                att = att & ok
+            if mode >= 4:
+                state, dom = _commit_chunk(state, dom, pf, picks, att)
+            return (state, dom.group_dom, dom.et_dom), (picks, bests, feas)
+
+        (state, _g, _e), out = lax.scan(step, (state, dom0.group_dom, dom0.et_dom), (cbatch, steps))
+        return state, out
+
+    return run
+
+
+names = ["baseline(no ops)", "+filter", "+score", "+select", "+commit", "+conflict"]
+for mode in range(6):
+    fn = make_run(mode)
+    st, out = fn(state, batch, inv, np.uint32(0))
+    jax.device_get(out[0])
+    t0 = time.perf_counter()
+    st, out = fn(state, batch, inv, np.uint32(1))
+    jax.device_get(out[0])
+    dt = time.perf_counter() - t0
+    print(f"mode {mode} {names[mode]:18s} {dt*1000:8.1f} ms")

@@ -1,0 +1,143 @@
+"""Microbench each IPA device piece inside a 2048-step scan to find the
+per-step bottleneck on real TPU. Ad-hoc, not part of the suite."""
+
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N, TK, DV, G, ET, K, T = 5120, 4, 128, 128, 128, 2048, 2
+
+
+def mk(shape, dtype=jnp.float32, lo=0, hi=2):
+    rng = np.random.default_rng(0)
+    if dtype == jnp.float32:
+        return jnp.asarray(rng.random(shape, np.float32))
+    return jnp.asarray(rng.integers(lo, hi, shape).astype(np.int32))
+
+
+topo_vals = mk((N, TK), jnp.int32, 0, DV)
+group_counts = mk((G, N), jnp.int32, 0, 3)
+et_counts = mk((ET, N), jnp.int32, 0, 3)
+group_dom = mk((G, TK, DV))
+et_dom = mk((ET, DV))
+et_slot = mk((ET,), jnp.int32, 0, TK)
+et_vals = mk((ET, N), jnp.int32, 0, DV)
+key_e = et_vals >= 1
+masks = mk((K, T, G), jnp.int32, 0, 2).astype(jnp.bool_)
+slots = mk((K, T), jnp.int32, 0, TK)
+groups = mk((K,), jnp.int32, 0, G)
+picks = mk((K,), jnp.int32, 0, N)
+
+
+def bench(name, step, carry, xs):
+    @jax.jit
+    def run(carry, xs):
+        return lax.scan(step, carry, xs)
+
+    out = run(carry, xs)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = run(carry, xs)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:28s} {dt*1000:8.1f} ms  ({dt/K*1e6:6.1f} us/step)")
+
+
+# 1. cnt_node matmul (T,G)x(G,N)
+bench(
+    "own matmul (T,G)x(G,N)",
+    lambda c, m: (c, (m.astype(jnp.float32) @ c.astype(jnp.float32)).sum()),
+    group_counts,
+    masks,
+)
+
+# 2. group_dom take + einsum
+def step2(c, xs):
+    m, sl = xs
+    gd = jnp.take(c, sl, axis=1)  # (G, T, DV)
+    tbl = jnp.einsum("tg,gtd->td", m.astype(jnp.float32), gd)
+    return c, tbl.sum()
+
+
+bench("group_dom take+einsum", step2, group_dom, (masks, slots))
+
+# 3. vals gather (N,T) via take
+def step3(c, sl):
+    vals = jnp.take(c, sl, axis=1).T
+    return c, vals.sum()
+
+
+bench("topo_vals take (T,N)", step3, topo_vals, slots)
+
+# 4. host matvec (ET,)x(ET,N) with bool elementwise
+def step4(c, w):
+    f = ((c > 0) & key_e).astype(jnp.float32)
+    return c, (w.astype(jnp.float32) @ f).sum()
+
+
+bench("host matvec + bool (ET,N)", step4, et_counts, mk((K, ET), jnp.int32, 0, 2))
+
+# 5. forbidden_kd einsum + gather
+slot_oh = (et_slot[:, None] == jnp.arange(TK)[None, :]).astype(jnp.float32)
+
+
+def step5(c, a):
+    fkd = jnp.einsum("tk,td->kd", jnp.where(a[:, None] > 0, slot_oh, 0.0), (c > 0.5).astype(jnp.float32))
+    hit = fkd[jnp.arange(TK)[None, :], jnp.clip(topo_vals, 0, DV - 1)]
+    return c, hit.sum()
+
+
+bench("fkd einsum + (N,TK) gather", step5, et_dom, mk((K, ET), jnp.int32, 0, 2))
+
+# 6. commit scatter into group_dom + et_dom
+def step6(c, xs):
+    gd, ed = c
+    g, p = xs
+    dvals = topo_vals[p]
+    gd = gd.at[g, jnp.arange(TK), jnp.clip(dvals, 0)].add(1.0)
+    ed = ed.at[jnp.clip(g, 0, ET - 1), jnp.clip(dvals[0], 0)].add(1.0)
+    return (gd, ed), g
+
+
+bench("dom scatters", step6, (group_dom, et_dom), (groups, picks))
+
+# 7. big state scatter: group_counts.at[g, row].add
+def step7(c, xs):
+    g, p = xs
+    return c.at[g, p].add(1), g
+
+
+bench("group_counts scatter", step7, group_counts, (groups, picks))
+
+# 8. take_along_axis gather (T,N) from (T,DV)
+tblc = mk((T, DV))
+valsc = mk((T, N), jnp.int32, 0, DV)
+
+
+def step8(c, _):
+    at = jnp.take_along_axis(c, jnp.clip(valsc, 0, DV - 1), axis=1)
+    return c, at.sum()
+
+
+bench("take_along (T,N) of (T,DV)", step8, tblc, picks)
+
+# 9. int64-style normalize over N
+raw0 = mk((N,), jnp.int32, 0, 1000)
+
+
+def step9(c, _):
+    raw = c.astype(jnp.int64)
+    big = jnp.int64(2**62)
+    feas = raw > 10
+    mn = jnp.min(jnp.where(feas, raw, big))
+    mx = jnp.max(jnp.where(feas, raw, -big))
+    norm = jnp.where(mx > mn, 100 * (raw - mn) // jnp.maximum(mx - mn, 1), 0)
+    return c, norm.sum()
+
+
+bench("i64 normalize (N,)", step9, raw0, picks)

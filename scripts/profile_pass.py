@@ -1,0 +1,92 @@
+"""Ad-hoc per-op scan cost profiler: times the anti-affinity batch pass with
+op subsets to locate the per-step bottleneck. Not part of the test suite."""
+
+import sys
+import time
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.engine.features import build_pod_batch
+from kubernetes_tpu.engine.pass_ import build_pass
+from kubernetes_tpu.framework.config import DEFAULT_PROFILE, Profile
+from kubernetes_tpu.ops.common import registered_subset
+from kubernetes_tpu.scheduler import TPUScheduler
+
+ZONE = "topology.kubernetes.io/zone"
+K = 2048
+
+
+def build(n_nodes=5000, zones=100):
+    s = TPUScheduler(profile=registered_subset(DEFAULT_PROFILE), batch_size=K)
+    for i in range(n_nodes):
+        s.add_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % zones}")
+            .region("region-1")
+            .obj()
+        )
+    pods = []
+    for i in range(K):
+        pods.append(
+            make_pod(f"pod-{i}")
+            .req({"cpu": "100m", "memory": "256Mi"})
+            .label("color", f"c{i % 100}")
+            .pod_anti_affinity_in("color", [f"c{i % 100}"], ZONE)
+            .obj()
+        )
+    for p in pods:
+        s.add_pod(p)
+    infos = s.queue.pop_batch(K)
+    batch, _, active = build_pod_batch([qp.pod for qp in infos], s.builder, s.profile, K)
+    inv = s.builder.batch_invariants()
+    state = s.builder.state()
+    return s, state, batch, active, inv
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)  # compile
+    import jax
+
+    jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    s, state, batch, active, inv = build()
+    print("active ops:", sorted(active), file=sys.stderr)
+    variants = {
+        "full": active,
+        "fit_only": frozenset({"NodeResourcesFit"}),
+    }
+    import jax
+
+    for name, sub in variants.items():
+        for chunk in (64, 128, 256, 512):
+            fn = build_pass(
+                s.profile, s.builder.schema, s.builder.res_col, sub, chunk
+            )
+            t0 = time.perf_counter()
+            new_state, out = fn(state, batch, inv, np.uint32(0))
+            picks = jax.device_get(out.picks)
+            t_first = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _, out2 = fn(new_state, batch, inv, np.uint32(1))
+            jax.device_get((out2.picks, out2.scores, out2.feasible_counts))
+            t_get = time.perf_counter() - t0
+            print(
+                f"{name:12s} c={chunk:3d} first={t_first:6.2f}s "
+                f"steady={t_get*1000:8.1f}ms sched={int((picks >= 0).sum())}/{K}"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -47,19 +47,20 @@ def build_cluster(n_nodes=32, n_pods=16):
         s.add_pod(p)
     infos = s.queue.pop_batch(n_pods)
     batch, _, active = build_pod_batch([qp.pod for qp in infos], s.builder, s.profile, n_pods)
+    inv = s.builder.batch_invariants()
     state = s.builder.state()
-    return s, state, batch, active
+    return s, state, batch, active, inv
 
 
 def test_sharded_pass_matches_unsharded():
-    s, state, batch, active = build_cluster()
+    s, state, batch, active, inv = build_cluster()
     fn = build_pass(s.profile, s.builder.schema, s.builder.res_col, active)
-    ref_state, ref_out = fn(state, batch, np.uint32(0))
+    ref_state, ref_out = fn(state, batch, inv, np.uint32(0))
 
     mesh = make_mesh(8)
     sh_state = shard_cluster_state(state, mesh)
     sh_batch = shard_pod_batch(batch, mesh)
-    got_state, got_out = fn(sh_state, sh_batch, np.uint32(0))
+    got_state, got_out = fn(sh_state, sh_batch, inv, np.uint32(0))
 
     np.testing.assert_array_equal(np.asarray(ref_out.picks), np.asarray(got_out.picks))
     np.testing.assert_array_equal(np.asarray(ref_out.scores), np.asarray(got_out.scores))
@@ -76,7 +77,7 @@ def test_sharded_pass_matches_unsharded():
 
 def test_sharded_state_placement():
     """Node-axis fields actually split across the mesh; batch replicates."""
-    s, state, batch, active = build_cluster()
+    s, state, batch, active, _inv = build_cluster()
     mesh = make_mesh(8)
     sh_state = shard_cluster_state(state, mesh)
     shardings = {d.device for d in sh_state.alloc.addressable_shards}
