@@ -179,6 +179,71 @@ class PodWrapper:
             self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
         return self
 
+    def ns_selector_pod_affinity_in(
+        self,
+        key: str,
+        values: list[str],
+        topo: str,
+        ns_key: str,
+        ns_values: list[str],
+        anti: bool = False,
+        preferred_weight: int | None = None,
+    ) -> "PodWrapper":
+        """(Anti-)affinity term selecting pods across namespaces via a
+        namespaceSelector (the NSSelector scheduler_perf cases)."""
+        term = t.PodAffinityTerm(
+            label_selector=t.LabelSelector(
+                match_expressions=(
+                    t.LabelSelectorRequirement(key, t.OP_IN, tuple(values)),
+                )
+            ),
+            topology_key=topo,
+            namespace_selector=t.LabelSelector(
+                match_expressions=(
+                    t.LabelSelectorRequirement(ns_key, t.OP_IN, tuple(ns_values)),
+                )
+            ),
+        )
+        a = self._affinity()
+        if preferred_weight is not None:
+            wterm = t.WeightedPodAffinityTerm(preferred_weight, term)
+            if anti:
+                pa = a.pod_anti_affinity or t.PodAntiAffinity()
+                pa = t.PodAntiAffinity(pa.required, pa.preferred + (wterm,))
+                self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
+            else:
+                pa = a.pod_affinity or t.PodAffinity()
+                pa = t.PodAffinity(pa.required, pa.preferred + (wterm,))
+                self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
+        elif anti:
+            pa = a.pod_anti_affinity or t.PodAntiAffinity()
+            pa = t.PodAntiAffinity(pa.required + (term,), pa.preferred)
+            self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
+        else:
+            pa = a.pod_affinity or t.PodAffinity()
+            pa = t.PodAffinity(pa.required + (term,), pa.preferred)
+            self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
+        return self
+
+    def node_name_affinity(self, node_name: str) -> "PodWrapper":
+        """DaemonSet-style pinning: required node affinity on the
+        metadata.name matchField (what the DaemonSet controller emits)."""
+        term = t.NodeSelectorTerm(
+            match_fields=(
+                t.NodeSelectorRequirement(
+                    "metadata.name", t.OP_IN, (node_name,)
+                ),
+            )
+        )
+        a = self._affinity()
+        na = a.node_affinity or t.NodeAffinity()
+        req = na.required or t.NodeSelector()
+        na = t.NodeAffinity(
+            required=t.NodeSelector(req.terms + (term,)), preferred=na.preferred
+        )
+        self._pod.spec.affinity = t.Affinity(na, a.pod_affinity, a.pod_anti_affinity)
+        return self
+
     def spread_constraint(
         self,
         max_skew: int,

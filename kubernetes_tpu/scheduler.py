@@ -54,6 +54,9 @@ class SchedulerMetrics:
     first_scheduled_ts: float = 0.0
     last_scheduled_ts: float = 0.0
     throughput_samples: list = field(default_factory=list)
+    # Per-pod e2e scheduling latency (enqueue → bind), the analog of
+    # pod_scheduling_sli_duration_seconds (metrics/metrics.go:225).
+    e2e_latency_samples: list = field(default_factory=list)
 
 
 class TPUScheduler:
@@ -335,6 +338,7 @@ class TPUScheduler:
                 m.first_scheduled_ts = now
             m.scheduled += 1
             m.last_scheduled_ts = now
+            m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
             outcomes.append(
                 ScheduleOutcome(qp.pod, node_name, int(scores[i]), int(feas[i]))
             )
