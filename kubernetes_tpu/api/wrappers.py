@@ -150,34 +150,44 @@ class PodWrapper:
             topology_key=topo,
         )
 
-    def pod_affinity_in(self, key: str, values: list[str], topo: str) -> "PodWrapper":
+    def _attach_pod_term(
+        self, term: t.PodAffinityTerm, anti: bool, weight: int | None
+    ) -> "PodWrapper":
+        """Attach a (weighted) pod (anti-)affinity term — the single place
+        that rebuilds the immutable Affinity tuple tree."""
         a = self._affinity()
-        pa = a.pod_affinity or t.PodAffinity()
-        pa = t.PodAffinity(pa.required + (self._pod_term(key, values, topo),), pa.preferred)
-        self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
+        if anti:
+            pa = a.pod_anti_affinity or t.PodAntiAffinity()
+            if weight is None:
+                pa = t.PodAntiAffinity(pa.required + (term,), pa.preferred)
+            else:
+                pa = t.PodAntiAffinity(
+                    pa.required,
+                    pa.preferred + (t.WeightedPodAffinityTerm(weight, term),),
+                )
+            self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
+        else:
+            pa = a.pod_affinity or t.PodAffinity()
+            if weight is None:
+                pa = t.PodAffinity(pa.required + (term,), pa.preferred)
+            else:
+                pa = t.PodAffinity(
+                    pa.required,
+                    pa.preferred + (t.WeightedPodAffinityTerm(weight, term),),
+                )
+            self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
         return self
 
+    def pod_affinity_in(self, key: str, values: list[str], topo: str) -> "PodWrapper":
+        return self._attach_pod_term(self._pod_term(key, values, topo), False, None)
+
     def pod_anti_affinity_in(self, key: str, values: list[str], topo: str) -> "PodWrapper":
-        a = self._affinity()
-        pa = a.pod_anti_affinity or t.PodAntiAffinity()
-        pa = t.PodAntiAffinity(pa.required + (self._pod_term(key, values, topo),), pa.preferred)
-        self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
-        return self
+        return self._attach_pod_term(self._pod_term(key, values, topo), True, None)
 
     def preferred_pod_affinity_in(
         self, key: str, values: list[str], topo: str, weight: int = 1, anti: bool = False
     ) -> "PodWrapper":
-        a = self._affinity()
-        wterm = t.WeightedPodAffinityTerm(weight, self._pod_term(key, values, topo))
-        if anti:
-            pa = a.pod_anti_affinity or t.PodAntiAffinity()
-            pa = t.PodAntiAffinity(pa.required, pa.preferred + (wterm,))
-            self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
-        else:
-            pa = a.pod_affinity or t.PodAffinity()
-            pa = t.PodAffinity(pa.required, pa.preferred + (wterm,))
-            self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
-        return self
+        return self._attach_pod_term(self._pod_term(key, values, topo), anti, weight)
 
     def ns_selector_pod_affinity_in(
         self,
@@ -204,26 +214,7 @@ class PodWrapper:
                 )
             ),
         )
-        a = self._affinity()
-        if preferred_weight is not None:
-            wterm = t.WeightedPodAffinityTerm(preferred_weight, term)
-            if anti:
-                pa = a.pod_anti_affinity or t.PodAntiAffinity()
-                pa = t.PodAntiAffinity(pa.required, pa.preferred + (wterm,))
-                self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
-            else:
-                pa = a.pod_affinity or t.PodAffinity()
-                pa = t.PodAffinity(pa.required, pa.preferred + (wterm,))
-                self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
-        elif anti:
-            pa = a.pod_anti_affinity or t.PodAntiAffinity()
-            pa = t.PodAntiAffinity(pa.required + (term,), pa.preferred)
-            self._pod.spec.affinity = t.Affinity(a.node_affinity, a.pod_affinity, pa)
-        else:
-            pa = a.pod_affinity or t.PodAffinity()
-            pa = t.PodAffinity(pa.required + (term,), pa.preferred)
-            self._pod.spec.affinity = t.Affinity(a.node_affinity, pa, a.pod_anti_affinity)
-        return self
+        return self._attach_pod_term(term, anti, preferred_weight)
 
     def node_name_affinity(self, node_name: str) -> "PodWrapper":
         """DaemonSet-style pinning: required node affinity on the

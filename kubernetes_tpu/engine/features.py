@@ -20,7 +20,8 @@ from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder, _bucket
 opcommon.feature_fill("ipa_own_terms", -1)
 opcommon.feature_fill("vol_dev_ids", -1)
 opcommon.feature_fill("vol_dev_rw", 0)
-opcommon.feature_fill("vol_drivers", 0)
+opcommon.feature_fill("vol_csi_ids", -1)
+opcommon.feature_fill("vol_csi_drv", -1)
 opcommon.feature_fill("has_pvc", 0)
 
 _DC_FIELDS: dict[type, tuple[str, ...]] = {}
@@ -120,11 +121,18 @@ def build_pod_batch(
         for j, (vid, rw) in enumerate(devs):
             dev_ids[j] = vid
             dev_rw[j] = rw
+        cvols = delta["csivols"]
+        csi_ids = np.full(_bucket(max(len(cvols), 1), 1), -1, np.int32)
+        csi_drv = np.full(csi_ids.shape[0], -1, np.int32)
+        for j, (vid, did) in enumerate(cvols):
+            csi_ids[j] = vid
+            csi_drv[j] = did
         feats = {
             "ipa_own_terms": own_terms,
             "vol_dev_ids": dev_ids,
             "vol_dev_rw": dev_rw,
-            "vol_drivers": delta["drivers"],
+            "vol_csi_ids": csi_ids,
+            "vol_csi_drv": csi_drv,
             "req": delta["req"],
             "nonzero": delta["nonzero"],
             "group": np.int32(delta["group"]),

@@ -52,6 +52,21 @@ class PassResult(NamedTuple):
     picks: jax.Array  # (K,) i32 — chosen node row, -1 = unschedulable
     scores: jax.Array  # (K,) i64 — winning node's total score
     feasible_counts: jax.Array  # (K,) i32 — nodes passing all filters
+    # (K,) u32 — bit b set ⟺ filter op b rejected ≥1 node that passed every
+    # earlier filter: the batch analog of Diagnosis.UnschedulablePlugins
+    # (the reference records each node's FIRST failing plugin,
+    # runtime/framework.go:861 RunFilterPlugins).  Bit order =
+    # filter_op_names(profile, active).
+    fail_masks: jax.Array
+
+
+def filter_op_names(profile: Profile, active: frozenset[str] | None) -> list[str]:
+    """Filter-op bit order of PassResult.fail_masks for one compiled pass."""
+    return [
+        n
+        for n in profile.filters
+        if (active is None or n in active) and opcommon.get(n).filter is not None
+    ]
 
 
 class DomTables(NamedTuple):
@@ -174,9 +189,24 @@ def _commit_chunk(
         new["dev_rw_counts"] = state.dev_rw_counts.at[safe_d, rows[:, None]].add(
             inc * pf["vol_dev_rw"].astype(jnp.int32)
         )
-    if "vol_drivers" in pf:
+    if "vol_csi_ids" in pf:
+        # Distinct-volume accounting (nodevolumelimits/csi.go:219): a volume
+        # counts against the driver limit only when its per-node pod count
+        # crosses 0→1.  Safe to read-before-scatter: volume-using pods are a
+        # conflict class in _conflict_pairs, so at most one commits per chunk.
+        ids = pf["vol_csi_ids"]  # (C, S)
+        act = do[:, None] & (ids >= 0)
+        safe_v = jnp.maximum(ids, 0)
+        prev = state.csivol_counts[safe_v, rows[:, None]]  # (C, S)
+        new["csivol_counts"] = state.csivol_counts.at[safe_v, rows[:, None]].add(
+            act.astype(jnp.int32)
+        )
+        newly = act & (prev == 0)  # (C, S)
+        drv_oh = (
+            pf["vol_csi_drv"][:, :, None] == jnp.arange(state.csi_used.shape[0])[None, None, :]
+        ) & newly[:, :, None]  # (C, S, DR)
         new["csi_used"] = state.csi_used.at[:, rows].add(
-            jnp.where(do[:, None], pf["vol_drivers"], 0).T
+            drv_oh.sum(axis=1).astype(jnp.int32).T
         )
     return dataclasses.replace(state, **new), dom._replace(
         group_dom=group_dom, et_dom=et_dom
@@ -231,7 +261,7 @@ def _conflict_pairs(pf: dict, schema: Schema) -> jax.Array:
             )
             > 0.5
         )
-    has_vol = (pf["vol_dev_ids"] >= 0).any(axis=1) | (pf["vol_drivers"] != 0).any(
+    has_vol = (pf["vol_dev_ids"] >= 0).any(axis=1) | (pf["vol_csi_ids"] >= 0).any(
         axis=1
     )
     if "has_pvc" in pf:
@@ -294,9 +324,17 @@ def build_pass(
         def eval_pod(state, dctx, pf, step_idx):
             """One reference scheduling cycle's decision (no commit)."""
             feasible = state.valid
+            fail_mask = jnp.uint32(0)
+            bit = 0
             for op in filter_ops:
                 if op.filter is not None:
-                    feasible &= op.filter(state, pf, dctx)
+                    ok = op.filter(state, pf, dctx)
+                    newly = feasible & ~ok
+                    fail_mask = fail_mask | jnp.where(
+                        newly.any(), jnp.uint32(1 << bit), jnp.uint32(0)
+                    )
+                    bit += 1
+                    feasible &= ok
             total = jnp.zeros(schema.N, jnp.int64)
             for op, weight in score_ops:
                 if op.score is not None:
@@ -309,14 +347,14 @@ def build_pass(
                 + step_idx.astype(jnp.uint32)
             )
             pick, best, _ties = select_host(feasible, total, tie_rand)
-            return pick, best, jnp.sum(feasible.astype(jnp.int32))
+            return pick, best, jnp.sum(feasible.astype(jnp.int32)), fail_mask
 
         def step(carry, xs):
             state, group_dom, et_dom = carry
             pf, step_idx = xs  # pf leaves (C, …)
             dom = dom0._replace(group_dom=group_dom, et_dom=et_dom)
             dctx = dataclasses.replace(ctx, dom=dom)
-            picks, bests, feas = jax.vmap(
+            picks, bests, feas, fails = jax.vmap(
                 lambda p, si: eval_pod(state, dctx, p, si)
             )(pf, step_idx)
             att = pf["valid"] & (picks >= 0)  # attempting placement
@@ -359,7 +397,8 @@ def build_pass(
             state, dom = _commit_chunk(state, dom, pf, picks, att)
             out_picks = jnp.where(defer, -2, jnp.where(pf["valid"], picks, -1))
             return (state, dom.group_dom, dom.et_dom), PassResult(
-                picks=out_picks, scores=bests, feasible_counts=feas
+                picks=out_picks, scores=bests, feasible_counts=feas,
+                fail_masks=fails,
             )
 
         (state, _gd, _ed), out = lax.scan(

@@ -91,6 +91,11 @@ class InternTable:
         self.terms = Vocab("terms")  # existing-pod (anti-)affinity terms
         self.devices = Vocab("devices")  # in-tree device-volume ids
         self.drivers = Vocab("drivers")  # CSI driver names
+        # CSI volume unique names (nodevolumelimits/csi.go volumeUniqueName:
+        # bound → driver/volumeHandle; unbound → driver/claim-uid), so a
+        # volume shared by several pods on a node attaches — and counts —
+        # once.
+        self.csivols = Vocab("csivols")
         self.ports = Vocab("ports")
         self.images = Vocab("images")
         self.node_names = Vocab("node_names")

@@ -234,6 +234,8 @@ def score_fn(state, pf, ctx: PassContext, feasible):
     # Hostname counts the node's own pods directly, with no counting-
     # eligibility mask (scoring.go:254 uses nodeInfo.Pods).
     cnt_for_node = jnp.where(pf["tps_s_hostname"][:, None], cnt_raw, pair_cnt)
+    # Hostname topoSize = len(filteredNodes) − len(IgnoredNodes)
+    # (scoring.go:104) = the scored set (feasible ∧ all keys present).
     topo_size = jnp.where(
         pf["tps_s_hostname"],
         scored.sum(),

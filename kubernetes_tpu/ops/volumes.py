@@ -236,9 +236,17 @@ def _vr_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
 
 
 def _nvl_filter(state, pf, ctx: PassContext):
-    new = pf["vol_drivers"]  # (DR,) engine base features
-    ok = state.csi_used + new[:, None] <= state.csi_limit
-    return (ok | (new == 0)[:, None]).all(0)
+    """Attach-limit check by DISTINCT volume (csi.go:219): the pod's volumes
+    already attached to the node (csivol_counts > 0) do not count again."""
+    ids = pf["vol_csi_ids"]  # (S,) engine base features, -1 pad
+    act = ids >= 0
+    present = state.csivol_counts[jnp.maximum(ids, 0)] > 0  # (S, N)
+    newv = act[:, None] & ~present  # (S, N) — genuinely new attachments
+    dr = state.csi_used.shape[0]
+    drv_oh = (pf["vol_csi_drv"][:, None] == jnp.arange(dr)[None, :]) & act[:, None]
+    new_cnt = (drv_oh[:, :, None] & newv[:, None, :]).sum(0)  # (DR, N)
+    ok = state.csi_used + new_cnt <= state.csi_limit
+    return (ok | (new_cnt == 0)).all(0)
 
 
 def _nvl_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:

@@ -22,8 +22,13 @@ the carried tensors so later preemptors in the batch cannot double-claim
 them.  Unlike the reference, which dry-runs only a rotating percentage of
 candidates, the full node axis is evaluated.
 
-Divergence (documented): the in-scan fit check releases resources and pod
-slots only; port/anti-affinity release is not re-simulated.  Two effects:
+Divergence (documented): victim selection takes the minimal fitting PREFIX
+of the least-important-first list, whereas the reference's
+SelectVictimsOnNode greedily reprieves most-important-first and can keep a
+non-contiguous subset — for multi-resource fits the prefix rule may evict a
+different (never smaller-priority-first) set.  Also, the in-scan fit check
+releases resources and pod slots only; port/anti-affinity release is not
+re-simulated.  Two effects:
 a nomination may still fail the next full filter pass (the retry then runs
 with the victims actually gone, matching the reference's post-deletion
 behavior), and — the false-negative direction — a node whose only failure

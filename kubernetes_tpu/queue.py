@@ -95,12 +95,76 @@ class SchedulingQueue:
         self.max_backoff_s = max_backoff_s
         self.max_unschedulable_s = max_unschedulable_s
         self._gated: dict[str, QueuedPodInfo] = {}
+        # Gang admission (the coscheduling plugin's PreEnqueue/Permit pair):
+        # members of a registered PodGroup park here until the gang can meet
+        # quorum — parked + already-bound credit ≥ minMember — then release
+        # TOGETHER so they land in one batch (all-or-nothing co-scheduling;
+        # without this, members scatter across pools and quorum never forms).
+        self._gang_pool: dict[str, dict[str, QueuedPodInfo]] = {}
+        self.gang_min: dict[str, int] = {}
+        # Credit per gang beyond the parked members (bound members + members
+        # waiting on Permit); the scheduler injects this so PreEnqueue
+        # admission and the Permit gate agree.
+        self.gang_credit = lambda g: 0
+        # Members currently queued anywhere (active/backoff/unschedulable/
+        # gated/pool), per gang — the Permit gate asks "are enough members
+        # still coming?" before deciding wait-vs-rollback (WaitOnPermit).
+        self._gang_members: dict[str, set[str]] = {}
 
     def __len__(self) -> int:
         return len(self._in_active)
 
     def pending_count(self) -> int:
-        return len(self._in_active) + len(self._backoff) + len(self._unschedulable) + len(self._gated)
+        return (
+            len(self._in_active)
+            + len(self._backoff)
+            + len(self._unschedulable)
+            + len(self._gated)
+            + sum(len(p) for p in self._gang_pool.values())
+        )
+
+    # -- gang admission --------------------------------------------------------
+
+    def register_gang(self, name: str, min_member: int) -> None:
+        self.gang_min[name] = min_member
+        self._try_admit_gang(name)
+
+    def gang_pending(self, g: str) -> int:
+        """Members of gang g currently queued anywhere (not in-flight)."""
+        return len(self._gang_members.get(g, ()))
+
+    def _track_gang_member(self, qp: QueuedPodInfo) -> None:
+        self._gang_members.setdefault(qp.pod.spec.pod_group, set()).add(qp.pod.uid)
+
+    def _untrack_gang_member(self, pod: t.Pod) -> None:
+        g = pod.spec.pod_group
+        if g:
+            members = self._gang_members.get(g)
+            if members is not None:
+                members.discard(pod.uid)
+                if not members:
+                    self._gang_members.pop(g, None)
+
+    def _park_gang_member(self, qp: QueuedPodInfo) -> None:
+        self._gang_pool.setdefault(qp.pod.spec.pod_group, {})[qp.pod.uid] = qp
+        self._track_gang_member(qp)
+
+    def _gang_admissible(self, g: str) -> bool:
+        pool = self._gang_pool.get(g)
+        return pool is not None and len(pool) + self.gang_credit(g) >= self.gang_min.get(g, 1)
+
+    def _try_admit_gang(self, g: str, via_backoff: bool = False) -> bool:
+        """Release every parked member of gang ``g`` if quorum is reachable.
+        ``via_backoff`` damps event-driven re-admission after a rollback (the
+        gang failed with these exact members, so retry behind backoff)."""
+        if not self._gang_admissible(g):
+            return False
+        for qp in self._gang_pool.pop(g).values():
+            if via_backoff:
+                self.add_backoff(qp)
+            else:
+                self._push_active(qp)
+        return True
 
     # -- add / pop -----------------------------------------------------------
 
@@ -118,7 +182,32 @@ class SchedulingQueue:
             self._gated[pod.uid] = qp
             return
         qp.gated = False
+        g = pod.spec.pod_group
+        if g:
+            self._track_gang_member(qp)
+            if g in self.gang_min:
+                # New member arrival: park, then admit the whole gang at
+                # once if quorum is now reachable.
+                self._park_gang_member(qp)
+                self._try_admit_gang(g)
+                return
         self._push_active(qp)
+
+    def requeue_gang_member(self, qp: QueuedPodInfo) -> None:
+        """Park a rolled-back gang member WITHOUT instant re-admission — the
+        gang just failed with exactly these members, so re-admission waits
+        for a cluster event (damped through backoff in on_event) or an
+        explicit readmit_gang from the scheduler.  Takes the original
+        QueuedPodInfo so attempts/first-enqueue survive the rollback
+        (backoff damping and e2e latency stay honest)."""
+        self._info[qp.pod.uid] = qp
+        self._park_gang_member(qp)
+
+    def readmit_gang(self, g: str) -> bool:
+        """Retry a parked gang behind backoff (transient failures — e.g. a
+        same-batch PV race — must not strand a quorum-complete gang in a
+        quiet cluster where no event would ever re-admit it)."""
+        return self._try_admit_gang(g, via_backoff=True)
 
     def _push_active(self, qp: QueuedPodInfo) -> None:
         if qp.pod.uid in self._in_active:
@@ -143,6 +232,7 @@ class SchedulingQueue:
             self._in_active.discard(uid)
             qp = self._info[uid]
             qp.attempts += 1
+            self._untrack_gang_member(qp.pod)  # in-flight, no longer pending
             out.append(qp)
         return out
 
@@ -158,8 +248,15 @@ class SchedulingQueue:
 
     def add_unschedulable(self, qp: QueuedPodInfo, plugins: set[str]) -> None:
         """AddUnschedulableIfNotPresent (scheduling_queue.go:728): pods that
-        failed go to the unschedulable pool keyed by what rejected them."""
+        failed go to the unschedulable pool keyed by what rejected them.
+        Members of a registered gang park in the gang pool instead."""
         qp.unschedulable_plugins = plugins
+        g = qp.pod.spec.pod_group
+        if g:
+            self._track_gang_member(qp)
+            if g in self.gang_min:
+                self._park_gang_member(qp)
+                return
         self._unschedulable[qp.pod.uid] = qp
 
     def add_backoff(self, qp: QueuedPodInfo) -> None:
@@ -193,7 +290,8 @@ class SchedulingQueue:
         return n
 
     def flush_unschedulable_leftover(self) -> int:
-        """Re-activate pods stuck unschedulable > max duration (:807)."""
+        """Re-activate pods stuck unschedulable > max duration (:807).
+        Stale parked gangs get a re-admission attempt too."""
         now = self._clock()
         stale = [
             uid
@@ -202,7 +300,14 @@ class SchedulingQueue:
         ]
         for uid in stale:
             self._push_active(self._unschedulable.pop(uid))
-        return len(stale)
+        n = len(stale)
+        for g in list(self._gang_pool):
+            if any(
+                now - qp.timestamp > self.max_unschedulable_s
+                for qp in self._gang_pool[g].values()
+            ) and self._try_admit_gang(g):
+                n += 1
+        return n
 
     # -- events ----------------------------------------------------------------
 
@@ -219,6 +324,18 @@ class SchedulingQueue:
         for uid in woken:
             qp = self._unschedulable.pop(uid)
             self.add_backoff(qp)
+        # Parked gangs re-try when an event the gang cares about fires —
+        # membership changes (the GangScheduling mask) OR anything the
+        # members' own rejecting plugins wait on (a gang blocked by taints
+        # wakes on the taint removal, like a solo pod would).  Re-admission
+        # goes through backoff (the gang already failed once as-is).
+        for g in list(self._gang_pool):
+            interested = PLUGIN_REQUEUE_EVENTS["GangScheduling"]
+            for qp in self._gang_pool[g].values():
+                for pl in qp.unschedulable_plugins:
+                    interested |= PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY)
+            if interested & event and self._try_admit_gang(g, via_backoff=True):
+                woken.append(g)
         return len(woken)
 
     def remove_gate(self, uid: str) -> None:
@@ -232,7 +349,14 @@ class SchedulingQueue:
         self._in_active.discard(uid)
         self._unschedulable.pop(uid, None)
         self._gated.pop(uid, None)
-        self._info.pop(uid, None)
+        qp = self._info.pop(uid, None)
+        if qp is not None and qp.pod.spec.pod_group:
+            self._untrack_gang_member(qp.pod)
+            pool = self._gang_pool.get(qp.pod.spec.pod_group)
+            if pool is not None:
+                pool.pop(uid, None)
+                if not pool:
+                    self._gang_pool.pop(qp.pod.spec.pod_group, None)
 
     def done(self, uid: str) -> None:
         """Pod scheduled successfully; drop bookkeeping."""

@@ -66,6 +66,7 @@ class Schema:
     ET: int = 8  # existing-pod (anti-)affinity term rows
     VD: int = 8  # in-tree device-volume vocabulary rows
     DR: int = 8  # CSI driver vocabulary rows
+    CV: int = 8  # CSI volume unique-name vocabulary rows
     P: int = 8  # host-port (proto,ip,port) triple rows
     PK: int = 8  # host-port (proto,port) key rows
     IM: int = 8  # image slots per node
@@ -124,8 +125,9 @@ class ClusterState:
     # Volumes -----------------------------------------------------------------
     dev_counts: jax.Array  # (VD, N) i32 — pods using in-tree device d
     dev_rw_counts: jax.Array  # (VD, N) i32 — non-read-only uses of device d
-    csi_used: jax.Array  # (DR, N) i32 — attached volumes per CSI driver
+    csi_used: jax.Array  # (DR, N) i32 — DISTINCT attached volumes per driver
     csi_limit: jax.Array  # (DR, N) i32 — CSINode allocatable count (default inf)
+    csivol_counts: jax.Array  # (CV, N) i32 — pods on node using CSI volume v
 
     # Images ------------------------------------------------------------------
     image_ids: jax.Array  # (N, IM) i32, -1 pad
@@ -155,6 +157,7 @@ _NODE_AXIS: dict[str, int] = {
     "dev_rw_counts": 1,
     "csi_used": 1,
     "csi_limit": 1,
+    "csivol_counts": 1,
     "image_ids": 0,
     "image_sizes": 0,
 }
@@ -183,6 +186,7 @@ def _host_arrays(s: Schema) -> dict[str, np.ndarray]:
         "dev_rw_counts": np.zeros((s.VD, s.N), np.int32),
         "csi_used": np.zeros((s.DR, s.N), np.int32),
         "csi_limit": np.full((s.DR, s.N), 2**31 - 1, np.int32),
+        "csivol_counts": np.zeros((s.CV, s.N), np.int32),
         "image_ids": np.full((s.N, s.IM), -1, np.int32),
         "image_sizes": np.zeros((s.N, s.IM), np.int64),
     }
@@ -435,10 +439,14 @@ class SnapshotBuilder:
                 for wt in wterms:
                     own_terms.append(self.interns.term_id(cat, wt.weight, wt.term, pod.namespace))
         self._ensure(ET=len(self.interns.terms))
-        # Volumes: in-tree device uses, per-driver CSI counts, PVC refs.
+        # Volumes: in-tree device uses, CSI volume attachments, PVC refs.
+        # CSI attachments are keyed by volume UNIQUE NAME and deduped within
+        # the pod (nodevolumelimits/csi.go:219 — a claim referenced twice, or
+        # a volume shared with pods already on the node, attaches once; the
+        # presence check against csivol_counts happens at filter/commit time).
         devices: list[tuple[int, bool]] = []
         pvc_uids: list[str] = []
-        driver_counts: dict[int, int] = {}
+        csivols: dict[int, int] = {}  # volume id → driver id (dedup by volume)
         for vol in pod.spec.volumes:
             if vol.device_id:
                 vid = self.interns.devices.id(vol.device_id)
@@ -451,13 +459,16 @@ class SnapshotBuilder:
                     driver = self.volumes.pvc_driver(pvc)
                     if driver:
                         did = self.interns.drivers.id(driver)
-                        driver_counts[did] = driver_counts.get(did, 0) + 1
+                        # Keyed by claim uid: a PV carries one claim_ref, so
+                        # pods share a volume only through a shared PVC — and
+                        # the claim key is stable across the unbound→bound
+                        # transition (the PV name is not).
+                        csivols[self.interns.csivols.id(f"{driver}^{uid}")] = did
         self._ensure(
-            VD=len(self.interns.devices), DR=len(self.interns.drivers)
+            VD=len(self.interns.devices),
+            DR=len(self.interns.drivers),
+            CV=len(self.interns.csivols),
         )
-        drivers_vec = np.zeros(self.schema.DR, np.int32)
-        for did, cnt in driver_counts.items():
-            drivers_vec[did] = cnt
         host_ports = pod.host_ports()
         if len(host_ports) > POD_PORT_SLOTS:
             raise ValueError(
@@ -479,7 +490,7 @@ class SnapshotBuilder:
             "ports": ports,
             "own_terms": own_terms,
             "devices": devices,
-            "drivers": drivers_vec,
+            "csivols": sorted(csivols.items()),
             "pvcs": pvc_uids,
         }
 
@@ -505,12 +516,13 @@ class SnapshotBuilder:
             h["dev_counts"][vid, row] += sign
             if rw:
                 h["dev_rw_counts"][vid, row] += sign
-        drv = delta.get("drivers")
-        if drv is not None and drv.any():
-            if drv.shape[0] < self.schema.DR:
-                drv = np.pad(drv, (0, self.schema.DR - drv.shape[0]))
-                delta["drivers"] = drv
-            h["csi_used"][:, row] += sign * drv
+        for vid, did in delta.get("csivols", ()):
+            # Distinct-volume accounting: csi_used counts volumes whose
+            # per-node pod count crosses 0↔1, not pod references.
+            prev = h["csivol_counts"][vid, row]
+            h["csivol_counts"][vid, row] = prev + sign
+            if (sign > 0 and prev == 0) or (sign < 0 and prev == 1):
+                h["csi_used"][did, row] += sign
         self.volumes.adjust_pvc_users(delta.get("pvcs", []), sign)
         if not device_already:
             self._dirty_rows.add(row)

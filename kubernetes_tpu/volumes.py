@@ -137,11 +137,13 @@ class VolumeCatalog:
 
     # -- bind (the PreBind step) --------------------------------------------
 
-    def bind_pod_volumes(self, pod: t.Pod, node: t.Node) -> bool:
+    def bind_pod_volumes(self, pod: t.Pod, node: t.Node) -> list | None:
         """Bind the pod's delayed claims on the chosen node (the in-process
         analog of volumebinding PreBind, volume_binding.go:521).  Returns
-        False when a claim can no longer be satisfied there (a same-batch
-        race lost); the caller forgets the pod (assume/forget protocol)."""
+        None when a claim can no longer be satisfied there (a same-batch
+        race lost) — the caller forgets the pod (assume/forget protocol) —
+        else a list of undo records for ``unbind_pod_volumes`` (a gang whose
+        Permit admission later collapses must revert its members' binds)."""
         chosen: list[tuple[t.PersistentVolumeClaim, t.PersistentVolume | None]] = []
         own_refs: dict[str, int] = {}
         for vol in pod.spec.volumes:
@@ -150,19 +152,19 @@ class VolumeCatalog:
                 own_refs[uid] = own_refs.get(uid, 0) + 1
         for pvc in self.pod_pvcs(pod):
             if pvc is None:
-                return False
+                return None
             # Re-check ReadWriteOncePod here: a same-batch peer may have
             # assumed the claim after this pod was featurized (the pod's own
             # assume already counted its references).
             if t.RWOP in pvc.access_modes:
                 others = self.pvc_users.get(pvc.uid, 0) - own_refs.get(pvc.uid, 0)
                 if others > 0:
-                    return False
+                    return None
             kind, *_rest = self.classify(pvc)
             if kind in ("bound",):
                 continue
             if kind in ("lost", "unbound_immediate"):
-                return False
+                return None
             sc = self.classes.get(pvc.storage_class)
             cands = [
                 pv
@@ -181,10 +183,11 @@ class VolumeCatalog:
                     sc.allowed_topologies, node.metadata.labels, node.name
                 )
                 if not ok:
-                    return False
+                    return None
                 chosen.append((pvc, None))  # dynamically provisioned
             else:
-                return False
+                return None
+        undo: list[tuple[str, t.PersistentVolumeClaim, str]] = []
         for pvc, pv in chosen:
             if pv is None:
                 name = f"provisioned-{pvc.namespace}-{pvc.name}"
@@ -199,8 +202,24 @@ class VolumeCatalog:
                     )
                 )
                 pvc.volume_name = name
+                undo.append(("provisioned", pvc, name))
             else:
                 pv.claim_ref = pvc.uid
                 pvc.volume_name = pv.name
                 self.epoch += 1
-        return True
+                undo.append(("static", pvc, pv.name))
+        return undo
+
+    def unbind_pod_volumes(self, undo: list) -> None:
+        """Revert a bind_pod_volumes (gang Permit collapse after PreBind):
+        release static PVs, delete phantom provisioned PVs."""
+        for kind, pvc, pv_name in undo:
+            pvc.volume_name = ""
+            if kind == "provisioned":
+                self.pvs.pop(pv_name, None)
+            else:
+                pv = self.pvs.get(pv_name)
+                if pv is not None:
+                    pv.claim_ref = None
+        if undo:
+            self.epoch += 1

@@ -1,0 +1,94 @@
+"""Per-pod failure diagnosis and precise requeue hints.
+
+The device pass returns a per-op fail bitmask (the batch analog of
+Diagnosis.UnschedulablePlugins, framework/types.go); the scheduler turns it
+into narrow requeue hints, and update_node diffs the node record to emit
+NODE_TAINT/NODE_LABEL (eventhandlers.go nodeSchedulingPropertiesChange)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def tainted_node(name: str, cpu: str = "8"):
+    return (
+        make_node(name)
+        .capacity({"cpu": cpu, "memory": "16Gi", "pods": 110})
+        .taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE)
+        .obj()
+    )
+
+
+def test_taint_rejection_diagnosis_and_requeue_on_taint_removal():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(tainted_node("n1"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+    assert out[0].diagnosis is not None
+    assert out[0].diagnosis.unschedulable_plugins == {"TaintToleration"}
+    uid = out[0].pod.uid
+    assert uid in s.queue._unschedulable
+
+    # A capacity-only change emits NODE_UPDATE — TaintToleration does not
+    # care, so the pod must NOT wake.
+    s.update_node(
+        make_node("n1")
+        .capacity({"cpu": "16", "memory": "16Gi", "pods": 110})
+        .taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE)
+        .obj()
+    )
+    assert uid in s.queue._unschedulable
+
+    # Removing the taint emits NODE_TAINT → the pod wakes and schedules.
+    s.update_node(
+        make_node("n1").capacity({"cpu": "16", "memory": "16Gi", "pods": 110}).obj()
+    )
+    assert uid not in s.queue._unschedulable
+    s.queue.flush_backoff()  # backoff may not have expired under real clock
+    for qp in list(s.queue._info.values()):
+        s.queue._push_active(qp)
+    out2 = s.schedule_all_pending()
+    assert out2 and out2[0].node_name == "n1"
+
+
+def test_label_change_wakes_node_affinity_rejection():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).node_affinity_in("disk", ["ssd"]).obj()
+    )
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+    assert out[0].diagnosis.unschedulable_plugins == {"NodeAffinity"}
+    uid = out[0].pod.uid
+    assert uid in s.queue._unschedulable
+
+    s.update_node(
+        make_node("n1").capacity({"cpu": "8", "pods": 110}).label("disk", "ssd").obj()
+    )
+    assert uid not in s.queue._unschedulable
+
+
+def test_mixed_failures_report_both_plugins():
+    """One node fails on taints, the other on resources → both plugins in
+    the diagnosis (each rejected a node that passed everything earlier)."""
+    s = TPUScheduler(batch_size=8)
+    s.add_node(tainted_node("big", cpu="64"))
+    s.add_node(make_node("small").capacity({"cpu": "1", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "8"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+    assert out[0].diagnosis.unschedulable_plugins == {
+        "TaintToleration",
+        "NodeResourcesFit",
+    }
+
+
+def test_scheduled_pod_has_no_diagnosis():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n1"
+    assert out[0].diagnosis is None

@@ -1,0 +1,226 @@
+"""Gang scheduling: PodGroup admission (PreEnqueue parking), all-or-nothing
+Permit quorum, rollback, and bound-member credit accounting.
+
+Models the out-of-tree coscheduling plugin's PodGroup semantics on top of the
+reference framework's Permit/WaitOnPermit extension points
+(runtime/framework.go:1443)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def gang_pod(name: str, group: str, cpu: str = "1") -> t.Pod:
+    return make_pod(name).req({"cpu": cpu}).pod_group(group).obj()
+
+
+def big_node(name: str, cpu: str = "16"):
+    return make_node(name).capacity({"cpu": cpu, "memory": "64Gi", "pods": 110}).obj()
+
+
+def test_gang_parks_below_quorum_then_schedules_together():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=3))
+    for i in range(2):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    # Two of three members: nothing schedules, nothing is attempted — the
+    # members are parked in the gang pool, not churned through the cycle.
+    assert s.schedule_all_pending() == []
+    assert s.queue.pending_count() == 2
+
+    # The third member releases the gang into ONE batch.
+    s.add_pod(gang_pod("m2", "g1"))
+    out = s.schedule_all_pending()
+    assert sorted(o.pod.name for o in out if o.node_name) == ["m0", "m1", "m2"]
+    assert s.metrics.batches == 1
+    assert s.gang_bound == {"g1": 3}
+    assert s.builder.host_mirror_equal()
+
+
+def test_gang_quorum_failure_rolls_back_all_members():
+    s = TPUScheduler(batch_size=8)
+    # Capacity for only 2 of the 3 members.
+    s.add_node(big_node("n1", cpu="2"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=3))
+    for i in range(3):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    out = s.schedule_all_pending()
+    # All-or-nothing: no member stays bound.
+    assert all(o.node_name is None for o in out)
+    assert s.gang_bound == {}
+    assert sum(r.bound for r in s.cache.pods.values()) == 0
+    assert s.builder.host_mirror_equal()
+
+    # Capacity arrives → the gang re-admits (damped via backoff) and binds.
+    s.add_node(big_node("n2", cpu="2"))
+    out2 = s.schedule_all_pending(wait_backoff=True)
+    assert sorted(o.pod.name for o in out2 if o.node_name) == ["m0", "m1", "m2"]
+    assert s.gang_bound == {"g1": 3}
+
+
+def test_gang_bound_credit_admits_partial_refill():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(gang_pod("m0", "g1"))
+    s.add_pod(gang_pod("m1", "g1"))
+    assert len([o for o in s.schedule_all_pending() if o.node_name]) == 2
+    # One bound member dies; a single replacement reaches quorum with the
+    # surviving member's credit (gang_bound == 1).
+    s.delete_pod("default/m0")
+    assert s.gang_bound == {"g1": 1}
+    s.add_pod(gang_pod("m2", "g1"))
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert [o.pod.name for o in out if o.node_name] == ["m2"]
+    assert s.gang_bound == {"g1": 2}
+
+
+def test_gang_members_before_group_registration():
+    """Members arriving before their PodGroup object park only once the
+    group is registered; registration itself triggers admission."""
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    for i in range(2):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert sorted(o.pod.name for o in out if o.node_name) == ["m0", "m1"]
+
+
+def test_node_removal_debits_gang_credit():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    s.add_node(big_node("n2", cpu="1"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(gang_pod("m0", "g1"))
+    s.add_pod(gang_pod("m1", "g1"))
+    assert len([o for o in s.schedule_all_pending() if o.node_name]) == 2
+    assert s.gang_bound == {"g1": 2}
+    s.remove_node("n1")  # both members were on n1
+    assert s.gang_bound == {}
+
+
+def test_gang_split_across_batch_boundary_converges():
+    """batch_size=2, gang of 3: WaitOnPermit holds the first batch's members
+    assumed until the second batch delivers the third (the r2 review's
+    stranding repro)."""
+    s = TPUScheduler(batch_size=2)
+    s.add_node(big_node("n1", cpu="64"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=3))
+    for i in range(3):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    out = s.schedule_all_pending()
+    assert sorted(o.pod.name for o in out if o.node_name) == ["m0", "m1", "m2"]
+    assert s.gang_bound == {"g1": 3}
+    assert s.queue.pending_count() == 0
+    assert s.builder.host_mirror_equal()
+
+
+def test_gang_rollback_reverts_volume_binds():
+    """A gang member losing the PV race rolls the gang back AND releases the
+    peers' already-bound PVs (no phantom claims for a cancelled cycle)."""
+    from kubernetes_tpu.api.wrappers import make_pv, make_pvc
+
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    s.add_storage_class(
+        t.StorageClass(name="wfc", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER)
+    )
+    # ONE static PV, no provisioner: only one of the two claims can bind.
+    s.add_pv(make_pv("pv1", storage_class="wfc"))
+    s.add_pvc(make_pvc("ca", storage_class="wfc"))
+    s.add_pvc(make_pvc("cb", storage_class="wfc"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(make_pod("pa").req({"cpu": "1"}).pod_group("g1").pvc_volume("ca").obj())
+    s.add_pod(make_pod("pb").req({"cpu": "1"}).pod_group("g1").pvc_volume("cb").obj())
+    out = s.schedule_all_pending()
+    assert all(o.node_name is None for o in out)
+    # The winner's bind was reverted: pv1 unclaimed, both claims unbound.
+    assert s.builder.volumes.pvs["pv1"].claim_ref is None
+    assert s.builder.volumes.pvcs["default/ca"].volume_name == ""
+    assert s.builder.volumes.pvcs["default/cb"].volume_name == ""
+    assert s.gang_bound == {}
+
+
+def test_taint_blocked_gang_wakes_on_taint_removal():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+        .taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE).obj()
+    )
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    for i in range(2):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    out = s.schedule_all_pending()
+    assert all(o.node_name is None for o in out)
+    # Members parked with TaintToleration in their unschedulable plugins →
+    # the NODE_TAINT event re-admits the gang.
+    s.update_node(
+        make_node("n1").capacity({"cpu": "16", "memory": "64Gi", "pods": 110}).obj()
+    )
+    out2 = s.schedule_all_pending(wait_backoff=True)
+    assert sorted(o.pod.name for o in out2 if o.node_name) == ["m0", "m1"]
+
+
+def test_pv_race_rollback_readmits_without_events():
+    """A gang rolled back by a same-batch PV race must retry on a timer —
+    a quiet cluster fires no event to re-admit it (r2 review)."""
+    from kubernetes_tpu.api.wrappers import make_pv, make_pvc
+
+    s = TPUScheduler(batch_size=8)
+    s.add_node(big_node("n1"))
+    s.add_storage_class(
+        t.StorageClass(name="wfc", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+                       provisioner="csi.x")
+    )
+    s.add_pv(make_pv("pv1", storage_class="wfc"))
+    s.add_pvc(make_pvc("ca", storage_class="wfc"))
+    s.add_pvc(make_pvc("cb", storage_class="wfc"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(make_pod("pa").req({"cpu": "1"}).pod_group("g1").pvc_volume("ca").obj())
+    s.add_pod(make_pod("pb").req({"cpu": "1"}).pod_group("g1").pvc_volume("cb").obj())
+    # With a provisioner the retry can dynamically provision the second
+    # claim; the first attempt may hit the same-batch race, roll back, and
+    # must converge WITHOUT any further cluster events.
+    out = s.schedule_all_pending(wait_backoff=True)
+    placed = sorted(o.pod.name for o in out if o.node_name)
+    assert placed == ["pa", "pb"]
+    assert s.gang_bound == {"g1": 2}
+
+
+def test_delete_waiting_gang_member_keeps_scheduler_alive():
+    """Deleting a WaitOnPermit member must drop its waiting-room entry
+    (r2 review: stale entry crashed the next expiry/admission)."""
+    s = TPUScheduler(batch_size=2)
+    s.add_node(big_node("n1", cpu="64"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=3))
+    for i in range(2):
+        s.add_pod(gang_pod(f"m{i}", "g1"))
+    s.add_pod(make_pod("x").req({"cpu": "1"}).obj())  # filler, other batch
+    # Batch 1: m0, m1 placed → wait (m2's slot suggested by... none: only 2
+    # members exist, so total+pending < min → rollback, park).  Add a third
+    # member mid-flight instead: use batch boundary.
+    s.add_pod(gang_pod("m2", "g1"))
+    out = s.schedule_all_pending()
+    assert sorted(o.pod.name for o in out if o.node_name) == ["m0", "m1", "m2", "x"]
+    # Now a waiting scenario: gang of 3 with only 2 members + a pending 3rd
+    # that never schedules (gated) is hard to build; instead delete a waiter
+    # directly while it waits.
+    s2 = TPUScheduler(batch_size=1)
+    s2.add_node(big_node("n2", cpu="64"))
+    s2.add_pod_group(t.PodGroup(name="g2", min_member=2))
+    s2.add_pod(gang_pod("w0", "g2"))
+    s2.add_pod(gang_pod("w1", "g2"))
+    # batch_size=1: w0 placed first → WaitOnPermit (w1 pending).
+    out0 = s2.schedule_batch()
+    assert out0 == [] or all(o.node_name is None for o in out0)
+    assert s2.permit_waiting
+    s2.delete_pod("default/w0")
+    assert not any(
+        e[0].pod.uid == "default/w0"
+        for lst in s2.permit_waiting.values() for e in lst
+    )
+    # Scheduler keeps running; w1 alone can still wait/park without a crash.
+    s2.expire_waiting_gangs(timeout_s=0.0)
+    s2.schedule_all_pending()

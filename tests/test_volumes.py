@@ -248,3 +248,39 @@ def test_csinode_before_node_still_limits():
         s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).pvc_volume(f"c{i}").obj())
     out = {o.pod.name: o.node_name for o in s.schedule_all_pending(wait_backoff=True)}
     assert sum(1 for v in out.values() if v) == 1
+
+
+def test_shared_pvc_counts_once_against_attach_limit():
+    """A PVC shared by several pods on one node is ONE attachment
+    (csi.go:219 dedup by volume unique name — ADVICE r1 medium)."""
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_csinode(t.CSINode(name="n1", driver_limits={"ebs.csi.aws.com": 1}))
+    s.add_pv(make_pv("pv1", csi_driver="ebs.csi.aws.com", access_modes=(t.RWX,)))
+    s.add_pvc(make_pvc("shared", volume_name="pv1", access_modes=(t.RWX,)))
+    for i in range(3):
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).pvc_volume("shared").obj())
+    out = [o.node_name for o in s.schedule_all_pending()]
+    # Limit is 1 volume, but all three pods share it → all schedule.
+    assert out == ["n1", "n1", "n1"]
+    assert s.builder.host_mirror_equal()
+    # The one attachment is released only when the LAST sharer leaves.
+    s.delete_pod("default/p0")
+    s.delete_pod("default/p1")
+    assert int(s.builder.host["csi_used"].max()) == 1
+    s.delete_pod("default/p2")
+    assert int(s.builder.host["csi_used"].max()) == 0
+
+
+def test_pod_with_two_refs_to_one_claim_counts_once():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_csinode(t.CSINode(name="n1", driver_limits={"d1": 1}))
+    s.add_pv(make_pv("pv1", csi_driver="d1"))
+    s.add_pvc(make_pvc("c1", volume_name="pv1"))
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).pvc_volume("c1").pvc_volume("c1").obj()
+    )
+    out = [o.node_name for o in s.schedule_all_pending()]
+    assert out == ["n1"]
+    assert s.builder.host_mirror_equal()
