@@ -16,8 +16,24 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from .api import types as t
 from .snapshot import SnapshotBuilder
+
+# Zone label keys, GA + legacy beta (utilnode.GetZoneKey).
+_ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+
+
+def _zone_of(node: t.Node) -> str:
+    for key in _ZONE_LABELS:
+        z = node.metadata.labels.get(key)
+        if z:
+            return z
+    return ""
 
 
 @dataclass
@@ -26,6 +42,46 @@ class NodeRecord:
     row: int
     pods: dict[str, t.Pod] = field(default_factory=dict)  # uid → pod
     generation: int = 0
+    zone: str = ""
+
+
+class NodeTree:
+    """Zone → node-name lists with round-robin interleaved iteration — the
+    reference's nodeTree (backend/cache/node_tree.go:119 list()): snapshot
+    order spreads consecutive scan positions across zones so truncated
+    search (percentageOfNodesToScore) samples every zone fairly."""
+
+    def __init__(self) -> None:
+        self.zones: dict[str, list[str]] = {}
+
+    def add(self, zone: str, name: str) -> None:
+        self.zones.setdefault(zone, []).append(name)
+
+    def remove(self, zone: str, name: str) -> None:
+        names = self.zones.get(zone)
+        if names is not None:
+            try:
+                names.remove(name)
+            except ValueError:
+                pass
+            if not names:
+                self.zones.pop(zone, None)
+
+    def list(self) -> list[str]:
+        """Round-robin over zones: zone0[0], zone1[0], …, zone0[1], …"""
+        out: list[str] = []
+        idx = 0
+        exhausted = 0
+        zone_lists = list(self.zones.values())
+        while zone_lists and exhausted < len(zone_lists):
+            exhausted = 0
+            for names in zone_lists:
+                if idx < len(names):
+                    out.append(names[idx])
+                else:
+                    exhausted += 1
+            idx += 1
+        return out
 
 
 @dataclass
@@ -47,6 +103,8 @@ class Cache:
         self._next_row = 0
         self._generation = 0
         self._row_to_name: dict[int, str] = {}
+        self.node_tree = NodeTree()
+        self._order_cache: tuple[int, np.ndarray] | None = None
 
     # -- nodes ---------------------------------------------------------------
 
@@ -67,15 +125,24 @@ class Cache:
         if row == self._next_row:
             self._next_row += 1
         self._generation += 1
-        self.nodes[node.name] = NodeRecord(node=node, row=row, generation=self._generation)
+        zone = _zone_of(node)
+        self.nodes[node.name] = NodeRecord(
+            node=node, row=row, generation=self._generation, zone=zone
+        )
         self.builder.set_node_row(row, node)
         self._row_to_name[row] = node.name
+        self.node_tree.add(zone, node.name)
 
     def update_node(self, node: t.Node) -> None:
         rec = self.nodes[node.name]
         rec.node = node
         self._generation += 1
         rec.generation = self._generation
+        zone = _zone_of(node)
+        if zone != rec.zone:
+            self.node_tree.remove(rec.zone, node.name)
+            self.node_tree.add(zone, node.name)
+            rec.zone = zone
         # set_node_row rewrites only the node's static attributes; pod-derived
         # state (req/num_pods/counts) lives in separate arrays and is untouched.
         self.builder.set_node_row(rec.row, node)
@@ -85,9 +152,25 @@ class Cache:
         self.builder.clear_node_row(rec.row)
         self._free_rows.append(rec.row)
         self._row_to_name.pop(rec.row, None)
+        self.node_tree.remove(rec.zone, name)
+        self._generation += 1
         for uid in list(rec.pods):
             pr = self.pods.pop(uid, None)
             del pr  # pods on a removed node vanish from scheduling state
+
+    def order_pos(self, n: int) -> np.ndarray:
+        """(n,) i32: each row's position in the zone-interleaved node order
+        (node_tree.go:119); unoccupied rows get a huge sentinel.  Cached per
+        cache generation."""
+        if self._order_cache is not None and self._order_cache[0] == self._generation:
+            arr = self._order_cache[1]
+            if arr.shape[0] == n:
+                return arr
+        arr = np.full(n, 2**30, np.int32)
+        for i, name in enumerate(self.node_tree.list()):
+            arr[self.nodes[name].row] = i
+        self._order_cache = (self._generation, arr)
+        return arr
 
     # -- pods ----------------------------------------------------------------
 

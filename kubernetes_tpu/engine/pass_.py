@@ -52,6 +52,10 @@ class PassResult(NamedTuple):
     picks: jax.Array  # (K,) i32 — chosen node row, -1 = unschedulable
     scores: jax.Array  # (K,) i64 — winning node's total score
     feasible_counts: jax.Array  # (K,) i32 — nodes passing all filters
+    # (K,) i32 — nodes examined this cycle in truncated (parity) mode: the
+    # rotation increment (schedule_one.go:519 processedNodes).  Zero when
+    # percentage_of_nodes_to_score == 100 (full evaluation).
+    processed: jax.Array
     # (K,) u32 — bit b set ⟺ filter op b rejected ≥1 node that passed every
     # earlier filter: the batch analog of Diagnosis.UnschedulablePlugins
     # (the reference records each node's FIRST failing plugin,
@@ -120,20 +124,32 @@ def _hash_u32(x: jax.Array) -> jax.Array:
     return x
 
 
-def select_host(feasible: jax.Array, total: jax.Array, tie_rand: jax.Array):
+def select_host(
+    feasible: jax.Array, total: jax.Array, tie_rand: jax.Array,
+    pos: jax.Array | None = None,
+):
     """argmax with uniform tie-break among max-score feasible nodes.
 
     Mirrors selectHost (schedule_one.go:873): highest TotalScore wins;
-    ties broken uniformly (see _hash_u32 docstring for the parity rule)."""
+    ties broken uniformly (see _hash_u32 docstring for the parity rule).
+    With ``pos`` (truncated/parity mode) ties enumerate in rotated scan
+    order — the order the reference's feasible list is built in — instead
+    of snapshot row order."""
     neg = jnp.int64(-(2**62))
     masked = jnp.where(feasible, total, neg)
     best = jnp.max(masked)
     ties = feasible & (masked == best)
     m = jnp.sum(ties.astype(jnp.int32))
     kth = (tie_rand % jnp.maximum(m, 1).astype(jnp.uint32)).astype(jnp.int32)
-    # Index of the (kth+1)-th True in `ties`.
-    order = jnp.cumsum(ties.astype(jnp.int32)) - 1
-    pick = jnp.argmax(ties & (order == kth)).astype(jnp.int32)
+    if pos is None:
+        # Index of the (kth+1)-th True in `ties`, row order.
+        order = jnp.cumsum(ties.astype(jnp.int32)) - 1
+        pick = jnp.argmax(ties & (order == kth)).astype(jnp.int32)
+    else:
+        big = jnp.int32(2**30)
+        tpos = jnp.where(ties, pos, big)
+        thr = jnp.sort(tpos)[jnp.clip(kth, 0, tpos.shape[0] - 1)]
+        pick = jnp.argmax(ties & (tpos == thr)).astype(jnp.int32)
     pick = jnp.where(m > 0, pick, -1)
     return pick, best, m
 
@@ -305,6 +321,30 @@ def build_pass(
     ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
     c = chunk
 
+    # Truncated (parity) mode — percentage_of_nodes_to_score != 100:
+    # reproduce the reference's adaptive search truncation semantics
+    # sequentially: numFeasibleNodesToFind (schedule_one.go:676, formula
+    # 50 − nodes/125 clamped to ≥5% when unset, floor 100 nodes), the
+    # zone-interleaved scan order (node_tree.go:119 via inv["order_pos"]),
+    # and the rotating start index (schedule_one.go:628, carried through
+    # the scan; the per-cycle increment is processedNodes, :519).  The
+    # reference's parallel checkNode makes WHICH feasible nodes win the
+    # race nondeterministic; the deterministic parity semantic is the
+    # sequential scan (parallelism=1), which is what a batch scan step is.
+    truncated = profile.percentage_of_nodes_to_score != 100
+    pct_cfg = profile.percentage_of_nodes_to_score
+    if truncated:
+        assert c == 1, "truncation/parity mode requires chunk_size=1"
+
+    def _num_to_find(nvalid: jax.Array) -> jax.Array:
+        """numFeasibleNodesToFind (schedule_one.go:676–702)."""
+        if pct_cfg:
+            percentage = jnp.int32(pct_cfg)
+        else:  # unset → adaptive formula, min 5%
+            percentage = jnp.maximum(50 - nvalid // 125, 5).astype(jnp.int32)
+        num = jnp.maximum(nvalid * percentage // 100, 100)
+        return jnp.where(nvalid < 100, nvalid, num)
+
     @jax.jit
     def run(state: ClusterState, batch: dict, inv: dict, seed_base: jax.Array):
         # Domain tables: rebuilt once per pass, maintained incrementally by
@@ -321,7 +361,7 @@ def build_pass(
             seed_base.astype(jnp.uint32) + jnp.arange(k, dtype=jnp.uint32)
         ).reshape(k // c, c)
 
-        def eval_pod(state, dctx, pf, step_idx):
+        def eval_pod(state, dctx, pf, step_idx, start):
             """One reference scheduling cycle's decision (no commit)."""
             feasible = state.valid
             fail_mask = jnp.uint32(0)
@@ -335,28 +375,59 @@ def build_pass(
                     )
                     bit += 1
                     feasible &= ok
+            pos = None
+            processed = jnp.int32(0)
+            if truncated:
+                # Truncate the feasible set to the first `limit` feasible
+                # nodes in rotated zone-interleaved order (the sequential
+                # findNodesThatPassFilters semantics): positions sort, the
+                # limit-th smallest is the cutoff; processedNodes is the
+                # (limit+1)-th feasible position (the node whose check
+                # tripped the cancel) or the whole list.
+                nvalid = jnp.sum(state.valid.astype(jnp.int32))
+                nv = jnp.maximum(nvalid, 1)
+                limit = _num_to_find(nvalid)
+                big = jnp.int32(2**30)
+                pos = jnp.where(
+                    state.valid,
+                    (inv["order_pos"] - start.astype(jnp.int32)) % nv,
+                    big,
+                )
+                total_feas = jnp.sum(feasible.astype(jnp.int32))
+                fpos = jnp.sort(jnp.where(feasible, pos, big))
+                n = fpos.shape[0]
+                over = total_feas > limit
+                cutoff = fpos[jnp.clip(limit - 1, 0, n - 1)]
+                feasible = jnp.where(over, feasible & (pos <= cutoff), feasible)
+                processed = jnp.where(over, fpos[jnp.clip(limit, 0, n - 1)], nvalid)
             total = jnp.zeros(schema.N, jnp.int64)
             for op, weight in score_ops:
                 if op.score is not None:
                     # Plugin scores are pre-normalized to [0, MaxNodeScore]
-                    # over the feasible set; the framework applies the weight
-                    # (runtime/framework.go:1188).
+                    # over the feasible (post-truncation) set; the framework
+                    # applies the weight (runtime/framework.go:1188).
                     total += op.score(state, pf, dctx, feasible) * jnp.int64(weight)
             tie_rand = _hash_u32(
                 jnp.uint32(profile.tie_break_seed) * jnp.uint32(2654435761)
                 + step_idx.astype(jnp.uint32)
             )
-            pick, best, _ties = select_host(feasible, total, tie_rand)
-            return pick, best, jnp.sum(feasible.astype(jnp.int32)), fail_mask
+            pick, best, _ties = select_host(feasible, total, tie_rand, pos)
+            return pick, best, jnp.sum(feasible.astype(jnp.int32)), fail_mask, processed
 
         def step(carry, xs):
-            state, group_dom, et_dom = carry
+            state, group_dom, et_dom, start = carry
             pf, step_idx = xs  # pf leaves (C, …)
             dom = dom0._replace(group_dom=group_dom, et_dom=et_dom)
             dctx = dataclasses.replace(ctx, dom=dom)
-            picks, bests, feas, fails = jax.vmap(
-                lambda p, si: eval_pod(state, dctx, p, si)
+            picks, bests, feas, fails, processed = jax.vmap(
+                lambda p, si: eval_pod(state, dctx, p, si, start)
             )(pf, step_idx)
+            if truncated:
+                # Rotation advances only for real pods (padding must not
+                # skew the start index across batches).
+                inc = jnp.where(pf["valid"], processed, 0).sum().astype(jnp.uint32)
+                nv = jnp.maximum(jnp.sum(state.valid.astype(jnp.int32)), 1)
+                start = (start + inc) % nv.astype(jnp.uint32)
             att = pf["valid"] & (picks >= 0)  # attempting placement
             defer = jnp.zeros((c,), jnp.bool_)
             if c > 1:
@@ -396,13 +467,17 @@ def build_pass(
                 att = att & ~overflow
             state, dom = _commit_chunk(state, dom, pf, picks, att)
             out_picks = jnp.where(defer, -2, jnp.where(pf["valid"], picks, -1))
-            return (state, dom.group_dom, dom.et_dom), PassResult(
+            return (state, dom.group_dom, dom.et_dom, start), PassResult(
                 picks=out_picks, scores=bests, feasible_counts=feas,
                 fail_masks=fails,
+                processed=jnp.where(pf["valid"], processed, 0),
             )
 
-        (state, _gd, _ed), out = lax.scan(
-            step, (state, dom0.group_dom, dom0.et_dom), (cbatch, steps)
+        start0 = (
+            inv["scan_start"].astype(jnp.uint32) if truncated else jnp.uint32(0)
+        )
+        (state, _gd, _ed, _st), out = lax.scan(
+            step, (state, dom0.group_dom, dom0.et_dom, start0), (cbatch, steps)
         )
         out = jax.tree_util.tree_map(
             lambda x: x.reshape((k,) + x.shape[2:]), out

@@ -110,6 +110,17 @@ class TPUScheduler:
             # XLA inserts the ICI collectives for the cross-shard reductions.
             self.builder.set_mesh(mesh)
         self._cycle = 0
+        # Truncated (parity) mode: percentage_of_nodes_to_score != 100
+        # reproduces the reference's adaptive search truncation + rotating
+        # start + zone-interleaved order; needs the sequential scan.
+        self._truncated = self.profile.percentage_of_nodes_to_score != 100
+        if self._truncated:
+            assert chunk_size == 1, (
+                "percentage_of_nodes_to_score != 100 (parity mode) requires "
+                "chunk_size=1 (sequential-equivalent scan)"
+            )
+        # Rotating scan start (schedule_one.go nextStartNodeIndex).
+        self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
         self._last_batch_meta: tuple | None = None
         # Pre-intern the hot topology keys so node rows materialize them.
@@ -130,7 +141,7 @@ class TPUScheduler:
             k: np.zeros((ts,) + shape[1:], dtype) for k, (shape, dtype) in shapes.items()
         }
         sub["valid"] = np.zeros(ts, np.bool_)
-        inv = self.builder.batch_invariants()
+        inv = self._full_inv()
         state = self.builder.state()
         strict = self.passes.get(
             self.profile, self.builder.schema, self.builder.res_col, active, 1
@@ -188,19 +199,8 @@ class TPUScheduler:
         # assumption (cache.remove_node vaporized their records): send them
         # back to the gang pool to retry with their gang.
         if rec is not None and self.permit_waiting:
-            for g in list(self.permit_waiting):
-                entries = self.permit_waiting[g]
-                kept, lost = [], []
-                for e in entries:
-                    (lost if e[0].pod.uid in rec.pods else kept).append(e)
-                if lost:
-                    if kept:
-                        self.permit_waiting[g] = kept
-                    else:
-                        self.permit_waiting.pop(g)
-                        self.permit_wait_since.pop(g, None)
-                    for qp, _n, _s, _f in lost:
-                        self.queue.requeue_gang_member(qp)
+            for qp, _n, _s, _f in self._drop_permit_waiters(set(rec.pods)):
+                self.queue.requeue_gang_member(qp)
 
     def add_pod(self, pod: t.Pod) -> None:
         """Unassigned pods enter the queue; assigned pods enter the cache
@@ -217,22 +217,25 @@ class TPUScheduler:
         else:
             self.queue.add(pod)
 
-    def _drop_permit_waiter(self, uid: str) -> None:
-        """Remove a deleted/vaporized pod from the WaitOnPermit room so its
-        gang's quorum credit and later finalize/expiry don't see a ghost."""
+    def _drop_permit_waiters(self, uids) -> list:
+        """Remove the given pods from the WaitOnPermit room (deleted pods,
+        pods vaporized by node removal) so gang quorum credit and later
+        finalize/expiry don't see ghosts.  Returns the dropped entries."""
+        dropped: list = []
         for g in list(self.permit_waiting):
             entries = self.permit_waiting[g]
-            kept = [e for e in entries if e[0].pod.uid != uid]
+            kept = [e for e in entries if e[0].pod.uid not in uids]
             if len(kept) != len(entries):
+                dropped.extend(e for e in entries if e[0].pod.uid in uids)
                 if kept:
                     self.permit_waiting[g] = kept
                 else:
                     self.permit_waiting.pop(g)
                     self.permit_wait_since.pop(g, None)
-                return
+        return dropped
 
     def delete_pod(self, uid: str) -> None:
-        self._drop_permit_waiter(uid)
+        self._drop_permit_waiters({uid})
         rec = self.cache.pods.get(uid)
         if rec is not None:
             # A bound gang member leaving drops its gang below quorum for
@@ -301,6 +304,16 @@ class TPUScheduler:
                 n += 1
         return n
 
+    def _full_inv(self) -> dict:
+        """Batch invariants, plus — in truncated (parity) mode only — the
+        scan-order inputs (zone-interleaved positions, rotating start); the
+        full-evaluation pass never reads them, so skip the O(N) rebuild."""
+        inv = self.builder.batch_invariants()
+        if self._truncated:
+            inv["order_pos"] = self.cache.order_pos(self.builder.schema.N)
+            inv["scan_start"] = np.uint32(self._next_start)
+        return inv
+
     def schedule_batch(self) -> list[ScheduleOutcome]:
         """Pop up to batch_size pods and schedule them in one device pass."""
         if self.permit_wait_since:
@@ -322,7 +335,7 @@ class TPUScheduler:
         )
         # Batch invariants (interned term → topo slot) may grow TK/DV: build
         # them after featurization, before the state flush.
-        inv = self.builder.batch_invariants()
+        inv = self._full_inv()
         t1 = time.perf_counter()
         state = self.builder.state()
         run = self.passes.get(
@@ -332,9 +345,16 @@ class TPUScheduler:
         new_state, result = run(state, batch, inv, np.uint32(self._cycle))
         # One host round trip for all result arrays (the tunnel to the device
         # has high per-transfer latency; never sync field-by-field).
-        picks, scores, feas, fails = jax.device_get(
-            (result.picks, result.scores, result.feasible_counts, result.fail_masks)
+        picks, scores, feas, fails, processed = jax.device_get(
+            (result.picks, result.scores, result.feasible_counts,
+             result.fail_masks, result.processed)
         )
+        if self._truncated:
+            # Advance the rotating start by this batch's processedNodes sum
+            # (modular sums compose across the scan's per-step updates).
+            self._next_start = (self._next_start + int(processed.sum())) % max(
+                self.cache.node_count(), 1
+            )
         self._cycle += len(infos)
         # Strict tail: chunk-deferred pods (pick == -2) re-run through the
         # sequential-equivalent chunk=1 pass against the committed state, in
@@ -474,7 +494,10 @@ class TPUScheduler:
             if g in rollback:
                 self.cache.forget_pod(qp.pod.uid)
                 outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
-                self.queue.add_unschedulable(qp, {"GangScheduling"})
+                # requeue_gang_member (not add_unschedulable): an ex-waiter's
+                # queue._info entry was dropped by done() when it entered the
+                # waiting room and must be restored with the original qp.
+                self.queue.requeue_gang_member(qp)
                 continue
             if g in wait:
                 # WaitOnPermit: off-queue, still assumed, until quorum or

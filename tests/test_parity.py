@@ -1,0 +1,180 @@
+"""Parity mode: percentage_of_nodes_to_score truncation, rotating start
+index, zone-interleaved scan order, and the seeded tie-break — the device
+pass must reproduce, decision for decision, a scalar sequential scheduler
+implementing the reference semantics (schedule_one.go:53–58,628,676–702;
+node_tree.go:119)."""
+
+from dataclasses import replace
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import RefNodeState, fit_score, fits_request
+
+MIN_FEASIBLE = 100  # minFeasibleNodesToFind (schedule_one.go:53)
+
+
+def num_feasible_nodes_to_find(pct: int | None, num_all: int) -> int:
+    """Scalar numFeasibleNodesToFind (schedule_one.go:676–702)."""
+    if num_all < MIN_FEASIBLE:
+        return num_all
+    percentage = pct or 0
+    if percentage == 0:
+        percentage = 50 - num_all // 125
+        percentage = max(percentage, 5)
+    num = num_all * percentage // 100
+    return max(num, MIN_FEASIBLE)
+
+
+def hash_u32(x: int) -> int:
+    """Scalar mirror of engine.pass_._hash_u32 (splitmix32 avalanche)."""
+    x &= 0xFFFFFFFF
+    x = ((x ^ (x >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+    x = ((x ^ (x >> 15)) * 0x846CA68B) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def interleave_zones(nodes_by_zone: dict[str, list[str]]) -> list[str]:
+    """node_tree.go:119 list(): round-robin across zones."""
+    out, idx = [], 0
+    lists = list(nodes_by_zone.values())
+    while True:
+        exhausted = 0
+        for names in lists:
+            if idx < len(names):
+                out.append(names[idx])
+            else:
+                exhausted += 1
+        if exhausted >= len(lists):
+            return out
+        idx += 1
+
+
+class OracleScheduler:
+    """Sequential scalar scheduler with the reference's truncation/rotation
+    semantics (parallelism=1 — the deterministic parity configuration)."""
+
+    def __init__(self, nodes: list[t.Node], pct: int | None, seed: int = 0):
+        self.states = {n.name: RefNodeState(node=n) for n in nodes}
+        by_zone: dict[str, list[str]] = {}
+        for n in nodes:
+            z = n.metadata.labels.get("topology.kubernetes.io/zone", "")
+            by_zone.setdefault(z, []).append(n.name)
+        self.order = interleave_zones(by_zone)
+        self.pct = pct
+        self.seed = seed
+        self.start = 0
+        self.step = 0
+
+    def schedule(self, pod: t.Pod) -> str | None:
+        n_all = len(self.order)
+        limit = num_feasible_nodes_to_find(self.pct, n_all)
+        feasible: list[str] = []  # in rotated scan order
+        processed = n_all
+        for j in range(n_all):
+            name = self.order[(self.start + j) % n_all]
+            if fits_request(pod, self.states[name]):  # non-empty → fails
+                continue  # recorded as a failure status
+            if len(feasible) == limit:
+                # The (limit+1)-th feasible node trips the cancel; it is
+                # neither recorded as feasible nor as a failure, so
+                # processedNodes = its scan position.
+                processed = j
+                break
+            feasible.append(name)
+        tie_rand = hash_u32((self.seed * 2654435761 + self.step) & 0xFFFFFFFF)
+        self.step += 1
+        self.start = (self.start + processed) % n_all
+        if not feasible:
+            return None
+        scores = {name: fit_score(pod, self.states[name]) for name in feasible}
+        best = max(scores.values())
+        ties = [name for name in feasible if scores[name] == best]  # pos order
+        pick = ties[tie_rand % len(ties)]
+        self.states[pick].pods.append(pod)
+        return pick
+
+
+def _nodes(n: int, zones: int = 4) -> list[t.Node]:
+    out = []
+    for i in range(n):
+        cpu = "4" if i % 3 else "8"  # heterogeneous → real score spread
+        out.append(
+            make_node(f"node-{i:04d}")
+            .capacity({"cpu": cpu, "memory": "16Gi", "pods": 110})
+            .zone(f"zone-{i % zones}")
+            .obj()
+        )
+    return out
+
+
+def _pod(i: int) -> t.Pod:
+    return make_pod(f"pod-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
+
+
+def test_num_feasible_nodes_to_find_formula():
+    # (numAllNodes, pct) → expected, from the reference formula.
+    cases = [
+        (50, None, 50),      # below the 100-node floor: all nodes
+        (99, 70, 99),
+        (100, 50, 100),      # 50 → clamped up to minFeasibleNodesToFind
+        (304, None, 145),    # adaptive: 50-304//125=48 → 304*48//100=145
+        (1000, None, 420),   # 50-8=42 → 420
+        (5000, None, 500),   # 50-40=10 → 500
+        (6000, None, 300),   # 50-48=2 → clamped to 5% → 300
+        (20000, None, 1000),  # formula floor 5% → 1000
+        (5000, 20, 1000),
+    ]
+    for n_all, pct, want in cases:
+        assert num_feasible_nodes_to_find(pct, n_all) == want, (n_all, pct)
+
+
+def test_parity_sequence_adaptive_truncation():
+    """304 nodes / 4 zones, adaptive percentage: the device engine and the
+    scalar oracle must make IDENTICAL decisions for 120 pods."""
+    nodes = _nodes(304)
+    prof = replace(fit_only_profile(), percentage_of_nodes_to_score=None)
+    s = TPUScheduler(profile=prof, batch_size=32, chunk_size=1,
+                     enable_preemption=False)
+    for n in nodes:
+        s.add_node(n)
+    oracle = OracleScheduler(nodes, pct=None, seed=prof.tie_break_seed)
+
+    for i in range(120):
+        s.add_pod(_pod(i))
+    got = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    want = {f"pod-{i}": oracle.schedule(_pod(i)) for i in range(120)}
+    diffs = {k: (got.get(k), want[k]) for k in want if got.get(k) != want[k]}
+    assert not diffs, f"{len(diffs)} mismatches, first 5: {dict(list(diffs.items())[:5])}"
+    # Rotation really advanced (the config field is not dead).
+    assert s._next_start == oracle.start != 0
+
+
+def test_parity_sequence_fixed_percentage():
+    """Fixed 40%: truncation honors the explicit config value."""
+    nodes = _nodes(256, zones=3)
+    prof = replace(fit_only_profile(), percentage_of_nodes_to_score=40)
+    s = TPUScheduler(profile=prof, batch_size=16, chunk_size=1,
+                     enable_preemption=False)
+    for n in nodes:
+        s.add_node(n)
+    oracle = OracleScheduler(nodes, pct=40, seed=prof.tie_break_seed)
+    for i in range(60):
+        s.add_pod(_pod(i))
+    got = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    want = {f"pod-{i}": oracle.schedule(_pod(i)) for i in range(60)}
+    assert got == want
+
+
+def test_full_evaluation_unaffected_by_parity_inputs():
+    """pct=100 (default): no truncation, no rotation."""
+    s = TPUScheduler(batch_size=8)
+    for n in _nodes(16):
+        s.add_node(n)
+    for i in range(8):
+        s.add_pod(_pod(i))
+    out = s.schedule_all_pending()
+    assert all(o.node_name for o in out)
+    assert s._next_start == 0
