@@ -326,6 +326,8 @@ class PodSpec:
     volumes: tuple["Volume", ...] = ()
     # Gang scheduling (coscheduling-style): name of the pod's PodGroup.
     pod_group: str = ""
+    # ResourceClaim names in the pod's namespace (spec.resourceClaims).
+    resource_claims: tuple[str, ...] = ()
 
 
 @dataclass
@@ -638,3 +640,44 @@ class PodGroup:
 
     name: str
     min_member: int = 1
+
+
+@dataclass
+class ResourceClaim:
+    """resource.k8s.io ResourceClaim, reduced to counted-device structured
+    parameters (plugins/dynamicresources/): a request for ``count`` devices
+    of a device class; allocation pins the claim to one node."""
+
+    name: str
+    device_class: str
+    count: int = 1
+    namespace: str = "default"
+    allocated_node: str = ""  # "" = unallocated (delayed allocation)
+    reserved_for: tuple[str, ...] = ()  # pod uids (status.reservedFor)
+
+    @property
+    def uid(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ResourceSlice:
+    """resource.k8s.io ResourceSlice: the devices a node publishes for one
+    device class (counted form)."""
+
+    node_name: str
+    device_class: str
+    count: int = 1
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PodDisruptionBudget, reduced to what preemption needs:
+    the selector and the live status.disruptionsAllowed count
+    (framework/preemption/preemption.go filterPodsWithPDBViolation reads
+    pdb.Status.DisruptionsAllowed)."""
+
+    name: str
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+    namespace: str = "default"

@@ -106,6 +106,16 @@ class PodWrapper:
         self._pod.spec.scheduling_gates += (t.PodSchedulingGate(name),)
         return self
 
+    def scheduler(self, name: str) -> "PodWrapper":
+        """Profile selection (pod.spec.schedulerName)."""
+        self._pod.spec.scheduler_name = name
+        return self
+
+    def resource_claim(self, name: str) -> "PodWrapper":
+        """Reference a ResourceClaim (spec.resourceClaims, DRA)."""
+        self._pod.spec.resource_claims += (name,)
+        return self
+
     # -- affinity ----------------------------------------------------------
     def _affinity(self) -> t.Affinity:
         if self._pod.spec.affinity is None:

@@ -1,0 +1,116 @@
+"""Dynamic Resource Allocation: host-side claim catalog + allocation.
+
+The host half of the DynamicResources plugin
+(pkg/scheduler/framework/plugins/dynamicresources/, wired at
+scheduler.go:298–302 through the claim assume-cache), reduced to the
+counted-device form of structured parameters: a ResourceClaim asks for N
+devices of a device class; ResourceSlices publish per-node per-class device
+counts.  Allocation is delayed (the scheduler allocates at PreBind, like
+WaitForFirstConsumer volume binding) and pins the claim to one node;
+deallocation happens when the last reserving pod goes away.
+
+Device-side accounting lives in ClusterState.dra_cap/dra_alloc (per-class
+per-node counts) committed per-reservation by the engine; this catalog is
+the allocation truth the PreBind re-check runs against (the assume-cache
+race pattern shared with volumes.VolumeCatalog.bind_pod_volumes)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .api import types as t
+
+
+@dataclass
+class ClaimCatalog:
+    claims: dict[str, t.ResourceClaim] = field(default_factory=dict)
+    # (node, device_class) → published device count.
+    slices: dict[tuple[str, str], int] = field(default_factory=dict)
+    # (node, device_class) → devices consumed by allocated claims.
+    allocated: dict[tuple[str, str], int] = field(default_factory=dict)
+    epoch: int = 0  # featurization cache token
+
+    def add_claim(self, claim: t.ResourceClaim) -> None:
+        self.claims[claim.uid] = claim
+        self.epoch += 1
+
+    def add_slice(self, s: t.ResourceSlice) -> None:
+        key = (s.node_name, s.device_class)
+        self.slices[key] = self.slices.get(key, 0) + s.count
+        self.epoch += 1
+
+    def pod_claims(self, pod: t.Pod) -> list[t.ResourceClaim | None]:
+        return [
+            self.claims.get(f"{pod.namespace}/{name}")
+            for name in pod.spec.resource_claims
+        ]
+
+    def free(self, node: str, device_class: str) -> int:
+        key = (node, device_class)
+        return self.slices.get(key, 0) - self.allocated.get(key, 0)
+
+    def allocate_pod_claims(self, pod: t.Pod, node: str) -> list | None:
+        """Allocate/reserve the pod's claims on ``node`` (the PreBind step,
+        dynamicresources' claim assume + API write).  Returns undo records,
+        or None when a claim can no longer be satisfied there (allocation
+        race lost — the caller forgets the pod and retries)."""
+        # Validate first (all-or-nothing): per-class demand of the pod's
+        # still-unallocated claims vs free devices.
+        need: dict[str, int] = {}
+        for claim in self.pod_claims(pod):
+            if claim is None:
+                return None
+            if claim.allocated_node:
+                if claim.allocated_node != node:
+                    return None
+                continue
+            need[claim.device_class] = need.get(claim.device_class, 0) + claim.count
+        for cls, cnt in need.items():
+            if self.free(node, cls) < cnt:
+                return None
+        undo: list[tuple[str, t.ResourceClaim, str]] = []
+        for claim in self.pod_claims(pod):
+            if not claim.allocated_node:
+                claim.allocated_node = node
+                key = (node, claim.device_class)
+                self.allocated[key] = self.allocated.get(key, 0) + claim.count
+                undo.append(("allocated", claim, ""))
+            if pod.uid not in claim.reserved_for:
+                claim.reserved_for += (pod.uid,)
+                undo.append(("reserved", claim, pod.uid))
+        if undo:
+            self.epoch += 1
+        return undo
+
+    def unallocate(self, undo: list) -> None:
+        """Revert allocate_pod_claims (gang rollback)."""
+        for kind, claim, uid in undo:
+            if kind == "reserved":
+                claim.reserved_for = tuple(
+                    u for u in claim.reserved_for if u != uid
+                )
+            else:
+                key = (claim.allocated_node, claim.device_class)
+                self.allocated[key] = self.allocated.get(key, 0) - claim.count
+                claim.allocated_node = ""
+        if undo:
+            self.epoch += 1
+
+    def release_pod(self, pod_uid: str) -> None:
+        """Drop the pod's reservations; deallocate claims nobody reserves
+        (the resourceclaim controller's cleanup, in-process)."""
+        changed = False
+        for claim in self.claims.values():
+            if pod_uid in claim.reserved_for:
+                claim.reserved_for = tuple(
+                    u for u in claim.reserved_for if u != pod_uid
+                )
+                changed = True
+                if not claim.reserved_for and claim.allocated_node:
+                    key = (claim.allocated_node, claim.device_class)
+                    self.allocated[key] = (
+                        self.allocated.get(key, 0) - claim.count
+                    )
+                    claim.allocated_node = ""
+        if changed:
+            self.epoch += 1

@@ -23,6 +23,12 @@ opcommon.feature_fill("vol_dev_rw", 0)
 opcommon.feature_fill("vol_csi_ids", -1)
 opcommon.feature_fill("vol_csi_drv", -1)
 opcommon.feature_fill("has_pvc", 0)
+opcommon.feature_fill("dra_claim_ids", -1)
+opcommon.feature_fill("dra_claim_cls", -1)
+opcommon.feature_fill("dra_claim_cnt", 0)
+# Injected by the scheduler AFTER featurization (nomination lives in pod
+# STATUS; the featurize cache keys on spec only).
+opcommon.feature_fill("nominated_row", -1)
 
 _DC_FIELDS: dict[type, tuple[str, ...]] = {}
 
@@ -121,6 +127,14 @@ def build_pod_batch(
         for j, (vid, rw) in enumerate(devs):
             dev_ids[j] = vid
             dev_rw[j] = rw
+        dcl = delta["dra_claims"]
+        dra_ids = np.full(_bucket(max(len(dcl), 1), 1), -1, np.int32)
+        dra_cls = np.full(dra_ids.shape[0], -1, np.int32)
+        dra_cnt = np.zeros(dra_ids.shape[0], np.int32)
+        for j, (kid, (cid, cnt)) in enumerate(dcl):
+            dra_ids[j] = kid
+            dra_cls[j] = cid
+            dra_cnt[j] = cnt
         cvols = delta["csivols"]
         csi_ids = np.full(_bucket(max(len(cvols), 1), 1), -1, np.int32)
         csi_drv = np.full(csi_ids.shape[0], -1, np.int32)
@@ -139,6 +153,9 @@ def build_pod_batch(
             "priority": np.int32(pod.spec.priority),
             "port_triples": port_triples,
             "port_keys": port_keys,
+            "dra_claim_ids": dra_ids,
+            "dra_claim_cls": dra_cls,
+            "dra_claim_cnt": dra_cnt,
             # Chunked-pass conflict class (engine/pass_.py _conflict_pairs).
             "has_pvc": np.bool_(bool(delta["pvcs"])),
         }

@@ -224,6 +224,27 @@ def _commit_chunk(
         new["csi_used"] = state.csi_used.at[:, rows].add(
             drv_oh.sum(axis=1).astype(jnp.int32).T
         )
+    if "dra_claim_ids" in pf:
+        # DRA distinct-claim accounting (the csivol pattern): a claim's
+        # devices charge dra_alloc only on its 0→1 reservation transition
+        # on the node.  Safe to read-before-scatter: DRA pods are a
+        # conflict class, at most one commits per chunk.
+        kids = pf["dra_claim_ids"]  # (C, S)
+        act = do[:, None] & (kids >= 0)
+        safe_k = jnp.maximum(kids, 0)
+        prev = state.dra_claim_counts[safe_k, rows[:, None]]  # (C, S)
+        new["dra_claim_counts"] = state.dra_claim_counts.at[
+            safe_k, rows[:, None]
+        ].add(act.astype(jnp.int32))
+        newly = act & (prev == 0)
+        dc = state.dra_alloc.shape[0]
+        cls_oh = (
+            pf["dra_claim_cls"][:, :, None] == jnp.arange(dc)[None, None, :]
+        ) & newly[:, :, None]  # (C, S, DC)
+        inc_dc = (cls_oh * pf["dra_claim_cnt"][:, :, None]).sum(axis=1)  # (C, DC)
+        new["dra_alloc"] = state.dra_alloc.at[:, rows].add(
+            inc_dc.astype(jnp.int32).T
+        )
     return dataclasses.replace(state, **new), dom._replace(
         group_dom=group_dom, et_dom=et_dom
     )
@@ -282,6 +303,9 @@ def _conflict_pairs(pf: dict, schema: Schema) -> jax.Array:
     )
     if "has_pvc" in pf:
         has_vol = has_vol | pf["has_pvc"]
+    if "dra_claim_ids" in pf:
+        # DRA reservations race like volumes: readers defer behind writers.
+        has_vol = has_vol | (pf["dra_claim_ids"] >= 0).any(axis=1)
     pairs = pairs | (has_vol[:, None] & has_vol[None, :])
     c = pairs.shape[0]
     return pairs & ~jnp.eye(c, dtype=jnp.bool_)
@@ -352,6 +376,18 @@ def build_pass(
         # scan-invariant, so the scan body closes over them instead of
         # recomputing per step (the r1 anti-affinity bottleneck).
         dom0 = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        # Nominated-pod overlay for the fit filter (framework.go:973
+        # RunFilterPluginsWithNominatedPods); the scheduler always ships it
+        # (zeros when no pods are nominated, so the compiled program is
+        # stable); direct callers (tests/profiling) may omit it.
+        ctx_nom = dataclasses.replace(
+            ctx,
+            nom=(
+                (inv["nom_req"], inv["nom_cnt"], inv["nom_prio"])
+                if "nom_req" in inv
+                else None
+            ),
+        )
         k = batch["valid"].shape[0]
         assert k % c == 0, f"batch size {k} not a multiple of chunk {c}"
         cbatch = jax.tree_util.tree_map(
@@ -412,13 +448,22 @@ def build_pass(
                 + step_idx.astype(jnp.uint32)
             )
             pick, best, _ties = select_host(feasible, total, tie_rand, pos)
+            # Nominated-node fast path (schedule_one.go:491–502): a pod
+            # whose preemption nominated a node takes it whenever it is
+            # feasible, without re-ranking the whole cluster.
+            nomr = pf.get("nominated_row")
+            if nomr is not None:
+                safe_nom = jnp.maximum(nomr, 0)
+                use_nom = (nomr >= 0) & feasible[safe_nom]
+                pick = jnp.where(use_nom, safe_nom, pick)
+                best = jnp.where(use_nom, total[safe_nom], best)
             return pick, best, jnp.sum(feasible.astype(jnp.int32)), fail_mask, processed
 
         def step(carry, xs):
             state, group_dom, et_dom, start = carry
             pf, step_idx = xs  # pf leaves (C, …)
             dom = dom0._replace(group_dom=group_dom, et_dom=et_dom)
-            dctx = dataclasses.replace(ctx, dom=dom)
+            dctx = dataclasses.replace(ctx_nom, dom=dom)
             picks, bests, feas, fails, processed = jax.vmap(
                 lambda p, si: eval_pod(state, dctx, p, si, start)
             )(pf, step_idx)
@@ -483,6 +528,58 @@ def build_pass(
             lambda x: x.reshape((k,) + x.shape[2:]), out
         )
         return state, out
+
+    return run
+
+
+def build_eval_pass(
+    profile: Profile,
+    schema: Schema,
+    builder_res_col: dict[str, int],
+    active: frozenset[str] | None = None,
+):
+    """Eval-only single-pod pass: filter + score masks with NO commit.
+
+    The extender scheduling path (extender.py) needs the full per-node
+    verdicts on the host — the extender chain filters/prioritizes between
+    the in-process pass and selectHost, so the pick cannot be made on
+    device.  Returns run(state, pf, inv) → (feasible (N,) bool,
+    total (N,) i64)."""
+    filter_ops = [
+        opcommon.get(n) for n in profile.filters if active is None or n in active
+    ]
+    score_ops = [
+        (opcommon.get(n), w)
+        for n, w in profile.scorers
+        if active is None or n in active
+    ]
+    static: dict = {}
+    for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
+        if op.static is not None:
+            static.update(op.static(profile, schema, builder_res_col))
+    ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
+
+    @jax.jit
+    def run(state: ClusterState, pf: dict, inv: dict):
+        dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        dctx = dataclasses.replace(
+            ctx,
+            dom=dom,
+            nom=(
+                (inv["nom_req"], inv["nom_cnt"], inv["nom_prio"])
+                if "nom_req" in inv
+                else None
+            ),
+        )
+        feasible = state.valid
+        for op in filter_ops:
+            if op.filter is not None:
+                feasible &= op.filter(state, pf, dctx)
+        total = jnp.zeros(schema.N, jnp.int64)
+        for op, weight in score_ops:
+            if op.score is not None:
+                total += op.score(state, pf, dctx, feasible) * jnp.int64(weight)
+        return feasible, total
 
     return run
 

@@ -50,6 +50,7 @@ class Profile:
         "VolumeZone",
         "PodTopologySpread",
         "InterPodAffinity",
+        "DynamicResources",
     )
     # (score plugin, weight) — default weights from default_plugins.go.
     scorers: tuple[tuple[str, int], ...] = (

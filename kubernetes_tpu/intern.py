@@ -96,6 +96,8 @@ class InternTable:
         # volume shared by several pods on a node attaches — and counts —
         # once.
         self.csivols = Vocab("csivols")
+        self.device_classes = Vocab("device_classes")  # DRA device classes
+        self.dra_claims = Vocab("dra_claims")  # DRA claim uids
         self.ports = Vocab("ports")
         self.images = Vocab("images")
         self.node_names = Vocab("node_names")

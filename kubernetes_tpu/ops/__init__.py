@@ -2,6 +2,7 @@ from . import common  # noqa: F401
 
 # Importing an op module registers its OpDefs.
 from . import (  # noqa: F401
+    dynamicresources,
     imagelocality,
     interpodaffinity,
     nodeaffinity,

@@ -59,6 +59,13 @@ class PassContext:
     schema: Schema
     static: dict = None  # type: ignore[assignment]
     dom: object = None  # engine.pass_.DomTables, bound per trace
+    # Nominated-pod overlay, bound per trace by the engine: (nom_req (N,R)
+    # i64, nom_cnt (N,) i32, nom_prio (N,) i32 = max nominated priority, or
+    # INT32_MIN when none).  The batch analog of
+    # RunFilterPluginsWithNominatedPods (runtime/framework.go:973): a pod
+    # must fit with higher-or-equal-priority nominated pods' resources
+    # counted, so a preemptor's freed node is not stolen by the next batch.
+    nom: object = None
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,93 @@
+"""DynamicResources plugin, vectorized (counted-device form).
+
+Reference: pkg/scheduler/framework/plugins/dynamicresources/ (973 LoC, wired
+via the claim assume-cache at scheduler.go:298–302).  Scheduler-relevant
+semantics reduced to structured parameters' counted devices:
+
+  * A pod referencing a MISSING claim is UnschedulableAndUnresolvable until
+    the claim appears (the plugin's PreEnqueue/PreFilter checks).
+  * An ALLOCATED claim pins the pod to the claim's node (the allocation
+    result's node selector).
+  * UNALLOCATED claims demand free devices of their class on the node:
+    dra_alloc + need ≤ dra_cap per class (the allocator's device fit).
+
+Allocation itself happens host-side at PreBind (dra.ClaimCatalog — the
+Reserve/PreBind extension points), with the same race-recheck pattern as
+volume binding."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+
+_PIN_SLOTS = 4  # static pin capacity; >4 distinct allocated claims per pod
+# would need a bigger slot count (rejected at featurize time).
+
+
+def _dra_featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    delta_pins = []
+    missing = False
+    for claim in fctx.builder.dra.pod_claims(pod):
+        if claim is None:
+            missing = True
+        elif claim.allocated_node:
+            delta_pins.append(fctx.interns.node_names.id(claim.allocated_node))
+    if len(delta_pins) > _PIN_SLOTS:
+        raise ValueError(f"pod {pod.uid}: >{_PIN_SLOTS} allocated claims")
+    pins = np.full(_PIN_SLOTS, -1, np.int32)
+    pins[: len(delta_pins)] = delta_pins
+    return {"dra_pin_ids": pins, "dra_missing": np.bool_(missing)}
+
+
+def _dra_filter(state, pf, ctx: PassContext):
+    # Demand per class per node from the pod's claims NOT already reserved
+    # on the node (distinct-claim accounting, like csivol attach limits):
+    # claims someone on the node already reserves are free rides.
+    kids = pf["dra_claim_ids"]  # (S,) engine base feature, -1 pad
+    act = kids >= 0
+    present = state.dra_claim_counts[jnp.maximum(kids, 0)] > 0  # (S, N)
+    dc = state.dra_cap.shape[0]
+    cls_oh = (
+        pf["dra_claim_cls"][:, None] == jnp.arange(dc)[None, :]
+    ) & act[:, None]  # (S, DC)
+    new_cnt = (
+        (cls_oh[:, :, None] & ~present[:, None, :])
+        * pf["dra_claim_cnt"][:, None, None]
+    ).sum(0)  # (DC, N)
+    fits = ((new_cnt == 0) | (state.dra_alloc + new_cnt <= state.dra_cap)).all(0)
+    pins = pf["dra_pin_ids"]  # (S,)
+    pin_ok = (
+        (pins[:, None] < 0) | (state.name_id[None, :] == pins[:, None])
+    ).all(0)
+    return ~pf["dra_missing"] & fits & pin_ok
+
+
+def _dra_hard(state, pf, ctx: PassContext):
+    """Missing claims and allocation pins are unresolvable by preemption
+    (deleting pods moves no allocation); device shortage IS resolvable."""
+    pins = pf["dra_pin_ids"]
+    pin_ok = (
+        (pins[:, None] < 0) | (state.name_id[None, :] == pins[:, None])
+    ).all(0)
+    return pf["dra_missing"] | ~pin_ok
+
+
+def _dra_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    return bool(pod.spec.resource_claims)
+
+
+for _k, _fill in [("dra_pin_ids", -1), ("dra_missing", 0)]:
+    feature_fill(_k, _fill)
+
+register(
+    OpDef(
+        name="DynamicResources",
+        featurize=_dra_featurize,
+        filter=_dra_filter,
+        hard_filter=_dra_hard,
+        is_active=_dra_active,
+    )
+)

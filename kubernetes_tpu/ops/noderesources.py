@@ -61,6 +61,27 @@ def filter_fn(state, pf, ctx: PassContext):
     req = pf["req"]  # (R,) i64
     free = state.alloc - state.req  # (N, R)
     fits &= jnp.all((req[None, :] == 0) | (req[None, :] <= free), axis=1)
+    if ctx.nom is not None:
+        # Nominated-pod accounting (RunFilterPluginsWithNominatedPods,
+        # runtime/framework.go:973): the pod must ALSO fit with nominated
+        # pods' resources counted on their nominated nodes.  Applied per
+        # node when the pod's priority ≤ the node's max nominated priority
+        # (conservative: the reference adds only the ≥-priority subset).
+        # The pod's own nomination is excluded (framework.go skips same-UID).
+        nom_req, nom_cnt, nom_prio = ctx.nom
+        n = state.alloc.shape[0]
+        own = pf["nominated_row"]
+        self_mask = (jnp.arange(n) == own) & (own >= 0)
+        eff_req = jnp.maximum(
+            nom_req - jnp.where(self_mask[:, None], req[None, :], 0), 0
+        )
+        eff_cnt = jnp.maximum(nom_cnt - self_mask.astype(jnp.int32), 0)
+        fits_nom = jnp.all(
+            (req[None, :] == 0) | (req[None, :] <= free - eff_req), axis=1
+        )
+        fits_nom &= state.num_pods + 1 + eff_cnt <= state.allowed_pods
+        applies = pf["priority"] <= nom_prio  # (N,)
+        fits &= fits_nom | ~applies
     return fits
 
 

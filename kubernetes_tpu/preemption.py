@@ -22,21 +22,23 @@ the carried tensors so later preemptors in the batch cannot double-claim
 them.  Unlike the reference, which dry-runs only a rotating percentage of
 candidates, the full node axis is evaluated.
 
-Divergence (documented): victim selection takes the minimal fitting PREFIX
-of the least-important-first list, whereas the reference's
-SelectVictimsOnNode greedily reprieves most-important-first and can keep a
-non-contiguous subset — for multi-resource fits the prefix rule may evict a
-different (never smaller-priority-first) set.  Also, the in-scan fit check
-releases resources and pod slots only; port/anti-affinity release is not
-re-simulated.  Two effects:
-a nomination may still fail the next full filter pass (the retry then runs
-with the victims actually gone, matching the reference's post-deletion
-behavior), and — the false-negative direction — a node whose only failure
-is a resolvable non-resource conflict (a victim's host port or anti-affinity
-pair) is never nominated, because zero victims are needed resource-wise.
-Full-filter dry-run over victim prefixes closes that gap in a later round.
-PDB violation counting arrives with the disruption controller (criterion 1
-is currently a constant 0).
+Candidacy and victim counts run the preemptor's FULL active filter set
+against per-node what-if states (resources, pod counts, group/term/port
+tensors released via scatter), found by a lockstep binary search over
+victim slot-prefixes — one filter evaluation per search iteration.  This
+kills the r1 false negative (a node whose only failure was a victim's host
+port or anti-affinity pair was never nominated).
+
+Divergences (documented): victim selection takes the minimal fitting PREFIX
+of the (non-PDB-violating first, then least-important-first) list, whereas
+the reference's SelectVictimsOnNode greedily reprieves most-important-first
+and can keep a non-contiguous subset — for multi-resource fits the prefix
+rule may evict a different (never smaller-priority-first) set.  The binary
+search assumes filters are monotone in pod removals (true for fit, ports,
+and anti-affinity; PodTopologySpread's min-domain interplay is the
+near-exception).  Later preemptors in one batch see consumed victims'
+group/term/port counts un-released (conservative; the retry runs against
+truth).  Volume state is not released in the what-if.
 """
 
 from __future__ import annotations
@@ -75,6 +77,7 @@ def build_preempt_pass(
     schema: Schema,
     builder_res_col,
     active: frozenset[str] | None = None,
+    n_pdbs: int = 1,
 ):
     """Compile the scan-over-preemptors dry-run for one (profile, schema,
     active-op-set) — the active set must match the scheduling batch whose
@@ -90,13 +93,47 @@ def build_preempt_pass(
             static.update(op.static(profile, schema, builder_res_col))
     ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
 
-    def step(carry, pf, dctx):
+    import math
+
+    # Whether the active filter set reads the domain tables (rebuilt per
+    # what-if inside full_ok when so).
+    needs_dom = any(
+        op.name in ("InterPodAffinity", "PodTopologySpread") for op in filter_ops
+    )
+    # Filters whose verdict can change when pods are removed from a node.
+    # NodeResourcesFit has a CLOSED FORM over victim prefixes (the resource
+    # cumsum argmax); _SEARCHABLE ops get the per-prefix what-if evaluation
+    # (their release overlays are simulated); the REST of the
+    # release-dependent set (volume/DRA tensors, whose release is not
+    # simulated) contributes only its hard_filter to candidacy — their
+    # failures are treated as preemption-resolvable, and the nominee's
+    # retry validates against truth.  Release-INdependent filters (taints,
+    # node affinity, volume zones, …) run once on the live state.
+    _RELEASE_DEPENDENT = {
+        "NodeResourcesFit", "NodePorts", "InterPodAffinity",
+        "PodTopologySpread", "VolumeRestrictions", "NodeVolumeLimits",
+        "DynamicResources",
+    }
+    _SEARCHABLE = {"NodePorts", "InterPodAffinity", "PodTopologySpread"}
+    search_ops = [
+        op
+        for op in filter_ops
+        if op.name in _SEARCHABLE and op.filter is not None
+    ]
+    invariant_ops = [
+        op
+        for op in filter_ops
+        if op.name not in _RELEASE_DEPENDENT and op.filter is not None
+    ]
+    resolvable_ops = [
+        op
+        for op in filter_ops
+        if op.name in _RELEASE_DEPENDENT - _SEARCHABLE - {"NodeResourcesFit"}
+        and op.hard_filter is not None
+    ]
+
+    def step(carry, pf, dctx, vfeat, vic_pdb, pdb_allowed):
         state, vic_prio, vic_req, vic_nonzero, vic_start = carry
-        # Candidate nodes: valid and not unresolvably rejected.
-        candidate = state.valid
-        for op in filter_ops:
-            if op.hard_filter is not None:
-                candidate &= ~op.hard_filter(state, pf, dctx)
 
         n, v = vic_prio.shape
         prio = pf["priority"].astype(jnp.int32)
@@ -112,18 +149,125 @@ def build_preempt_pass(
         n_lower = jnp.cumsum(lower.astype(jnp.int32), axis=1)
         n_lower = jnp.concatenate([jnp.zeros((n, 1), jnp.int32), n_lower], axis=1)
 
+        rows2 = jnp.broadcast_to(jnp.arange(n)[:, None], (n, v))
+
+        def released(kvec):
+            """ClusterState with each node's first-kvec(n) slots' lower
+            victims removed — the per-node what-if the reference builds with
+            NodeInfo.Snapshot()+RemovePod per candidate
+            (DryRunPreemption, preemption.go:541)."""
+            mask = lower & (jnp.arange(v)[None, :] < kvec[:, None])  # (N, V)
+            rel_k = jnp.take_along_axis(
+                rel, kvec[:, None, None], axis=1
+            )[:, 0]  # (N, R)
+            relnz_k = jnp.take_along_axis(rel_nz, kvec[:, None, None], axis=1)[:, 0]
+            nl_k = jnp.take_along_axis(n_lower, kvec[:, None], axis=1)[:, 0]
+            new = dict(
+                req=state.req - rel_k,
+                nonzero_req=state.nonzero_req - relnz_k,
+                num_pods=state.num_pods - nl_k,
+            )
+            if "group" in vfeat:
+                g = vfeat["group"]  # (N, V)
+                new["group_counts"] = state.group_counts.at[
+                    jnp.maximum(g, 0), rows2
+                ].add(-(mask & (g >= 0)).astype(jnp.int32))
+            if "terms" in vfeat:
+                tm = vfeat["terms"]  # (N, V, TS)
+                new["et_counts"] = state.et_counts.at[
+                    jnp.maximum(tm, 0), rows2[:, :, None]
+                ].add(-(mask[:, :, None] & (tm >= 0)).astype(jnp.int32))
+            if "port_triples" in vfeat:
+                pt, pk = vfeat["port_triples"], vfeat["port_keys"]
+                dec = (mask[:, :, None] & (pt >= 0)).astype(jnp.int32)
+                new["port_counts"] = state.port_counts.at[
+                    jnp.maximum(pt, 0), rows2[:, :, None]
+                ].add(-dec)
+                new["portkey_counts"] = state.portkey_counts.at[
+                    jnp.maximum(pk, 0), rows2[:, :, None]
+                ].add(-dec)
+            return dataclasses.replace(state, **new)
+
+        # Release-independent filters: one evaluation on the live state —
+        # pod removal never fixes a taint/node-affinity/zone rejection, so
+        # these also subsume UnschedulableAndUnresolvable candidacy.
+        base_ok = state.valid
+        for op in invariant_ops:
+            base_ok &= op.filter(state, pf, dctx)
+        # Resolvable-but-unsimulated ops (DRA, volume limits/conflicts):
+        # only their UNRESOLVABLE portion constrains candidacy (missing
+        # claims, allocation pins — the hard_filter contract).  Track which
+        # nodes currently FAIL such an op: they need victims even when the
+        # resource prefix is empty (the eviction is what frees the
+        # device/volume; see the k-bump below).
+        res_fail = jnp.zeros(state.valid.shape, jnp.bool_)
+        for op in resolvable_ops:
+            base_ok &= ~op.hard_filter(state, pf, dctx)
+            if op.filter is not None:
+                res_fail |= ~op.filter(state, pf, dctx)
+
+        # NodeResourcesFit over every prefix, closed form: resources and
+        # pod-count checks against the release cumsums.
         demand = pf["req"]  # (R,)
         free = state.alloc[:, None, :] - (state.req[:, None, :] - rel)
-        fits_res = ((demand[None, None, :] == 0) | (demand[None, None, :] <= free)).all(-1)
-        ks = jnp.arange(v + 1)[None, :]
-        fits_cnt = state.num_pods[:, None] - n_lower + 1 <= state.allowed_pods[:, None]
-        fits = fits_res & fits_cnt & (ks <= v)
+        fits = (
+            (demand[None, None, :] == 0) | (demand[None, None, :] <= free)
+        ).all(-1)
+        fits &= state.num_pods[:, None] - n_lower + 1 <= state.allowed_pods[:, None]
 
-        k_star = jnp.argmax(fits, axis=1)
-        any_fit = fits.any(axis=1)
+        if search_ops:
+
+            def others_ok(kvec):
+                """Release-dependent non-fit filters against the released
+                state — exact candidacy (kills the r1 resources-only false
+                negative: a node whose sole failure is a victim's port or
+                anti-affinity pair)."""
+                st2 = released(kvec)
+                if needs_dom:
+                    from .engine.pass_ import build_dom
+
+                    dom0 = dctx.dom
+                    dom2 = build_dom(st2, dom0.et_slot, dom0.et_host, schema.DV)
+                    d2 = dataclasses.replace(dctx, dom=dom2)
+                else:
+                    d2 = dctx
+                ok = st2.valid
+                for op in search_ops:
+                    ok &= op.filter(st2, pf, d2)
+                return ok
+
+            def ok_at(kvec):
+                return (
+                    jnp.take_along_axis(fits, kvec[:, None], axis=1)[:, 0]
+                    & others_ok(kvec)
+                )
+
+            # Minimal victim slot-prefix per node: lockstep binary search,
+            # one what-if evaluation per iteration (filters are monotone in
+            # removals; PodTopologySpread's min-domain shift is the
+            # documented near-exception).
+            feas_max = ok_at(jnp.full((n,), v, jnp.int32))
+            lo = jnp.zeros(n, jnp.int32)
+            hi = jnp.full(n, v, jnp.int32)
+            for _ in range(max(1, math.ceil(math.log2(v + 1)))):
+                mid = (lo + hi) // 2
+                ok = ok_at(mid)
+                hi = jnp.where(ok, mid, hi)
+                lo = jnp.where(ok, lo, jnp.minimum(mid + 1, v))
+            k_star = hi
+        else:
+            # Fit-only fast path: first fitting prefix by argmax.
+            k_star = jnp.argmax(fits, axis=1).astype(jnp.int32)
+            feas_max = fits.any(axis=1)
+        # A node failing only an unsimulated-resolvable op (a victim's DRA
+        # device / volume hold) needs victims although zero may be needed
+        # resource-wise: evict every lower-priority pod there.  Criterion 4
+        # (fewest victims) keeps such nodes a last resort, and the retry
+        # validates against post-eviction truth.
+        k_star = jnp.where(res_fail, jnp.int32(v), k_star)
         n_vic = jnp.take_along_axis(n_lower, k_star[:, None], axis=1)[:, 0]
         # At least one victim, else deletion can't be what fixes this node.
-        possible = candidate & any_fit & (n_vic >= 1) & pf["valid"]
+        possible = base_ok & feas_max & (n_vic >= 1) & pf["valid"]
 
         idx = jnp.maximum(k_star - 1, 0)
 
@@ -163,7 +307,18 @@ def build_preempt_pass(
             best = jnp.min(jnp.where(mask, key, big))
             return mask & (key == best)
 
+        # Criterion 1 — fewest PDB violations at the chosen prefix
+        # (pickOneNodeForPreemption, preemption.go:424): per PDB, victims
+        # matched beyond its remaining allowed disruptions count as
+        # violations.
+        prefix = lower & (jnp.arange(v)[None, :] < k_star[:, None])  # (N, V)
+        cnt_p = jnp.einsum(
+            "nv,nvp->np", prefix.astype(jnp.float32), vic_pdb.astype(jnp.float32)
+        ).astype(jnp.int64)  # (N, P)
+        violations = jnp.maximum(cnt_p - pdb_allowed[None, :], 0).sum(axis=1)
+
         mask = possible
+        mask = narrow(mask, violations)
         mask = narrow(mask, max_prio.astype(jnp.int64))
         mask = narrow(mask, prio_sum)
         mask = narrow(mask, n_vic.astype(jnp.int64))
@@ -199,17 +354,22 @@ def build_preempt_pass(
         return (state, vic_prio, vic_req, vic_nonzero, vic_start), out
 
     @jax.jit
-    def run(state, batch, inv, vic_prio, vic_req, vic_nonzero, vic_start):
-        # Domain tables for the hard filters (e.g. InterPodAffinity's
-        # required-affinity check).  The dry-run carry releases resources
-        # only — group/term counts never change — so one build at entry
-        # serves every scan step (engine/pass_.py build_dom).
+    def run(
+        state, batch, inv, vic_prio, vic_req, vic_nonzero, vic_start,
+        vfeat, vic_pdb, pdb_allowed,
+    ):
+        # Domain tables for the filters.  The scan carry releases resources
+        # only; the per-prefix what-if rebuilds its own tables inside
+        # full_ok when an affinity/spread op is active.
         from .engine.pass_ import build_dom
 
         dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
         dctx = dataclasses.replace(ctx, dom=dom)
         carry = (state, vic_prio, vic_req, vic_nonzero, vic_start)
-        carry, out = lax.scan(lambda c, pf: step(c, pf, dctx), carry, batch)
+        carry, out = lax.scan(
+            lambda c, pf: step(c, pf, dctx, vfeat, vic_pdb, pdb_allowed),
+            carry, batch,
+        )
         return out
 
     return run
@@ -223,12 +383,17 @@ class PreemptionEvaluator:
         self.sched = scheduler
         self._cache: dict = {}
 
-    def _pass(self, active: frozenset[str] | None):
+    def _pass(self, profile, active: frozenset[str] | None, n_pdbs: int):
         b = self.sched.builder
-        key = (self.sched.profile, b.schema, tuple(sorted(b.res_col.items())), active)
+        key = (
+            profile, b.schema, tuple(sorted(b.res_col.items())),
+            active, n_pdbs,
+        )
         fn = self._cache.get(key)
         if fn is None:
-            fn = build_preempt_pass(self.sched.profile, b.schema, b.res_col, active)
+            fn = build_preempt_pass(
+                profile, b.schema, b.res_col, active, n_pdbs
+            )
             self._cache[key] = fn
         return fn
 
@@ -238,10 +403,12 @@ class PreemptionEvaluator:
         batch_rows: dict,
         active: frozenset[str] | None = None,
         inv: dict | None = None,
+        profile=None,
     ) -> list[PreemptionResult | None]:
         """Run preemption for the failed pods of one scheduling batch.
         ``batch_rows`` are each pod's already-built feature dict rows."""
         sched = self.sched
+        profile = profile or sched.profile
         cache, builder = sched.cache, sched.builder
         schema = builder.schema
 
@@ -264,13 +431,36 @@ class PreemptionEvaluator:
         if not any(eligible):
             return [None] * len(pods)
 
-        # Pack every node's pods, least important first.
+        # PDBs: per-victim matched budgets.  A victim is "violating" when it
+        # matches a PDB with no disruptions left; such pods sort LAST in the
+        # eviction order (the reference reprieves violating victims first —
+        # filterPodsWithPDBViolation + the reprieve loop), so the minimal
+        # fitting prefix prefers non-violating victims.
+        pdbs = list(getattr(sched, "pdbs", {}).values())
+        n_pdbs = _bucket(len(pdbs), 1)
+
+        def matched_pdbs(p: t.Pod) -> list[int]:
+            return [
+                i
+                for i, pdb in enumerate(pdbs)
+                if pdb.namespace == p.namespace
+                and t.label_selector_matches(pdb.selector, p.metadata.labels)
+            ]
+
+        def violating(p: t.Pod) -> bool:
+            return any(pdbs[i].disruptions_allowed <= 0 for i in matched_pdbs(p))
+
+        # Pack every node's pods: non-violating least-important first.
         per_node: dict[int, list] = {}
         vmax = 1
         for rec in cache.nodes.values():
             vics = sorted(
                 rec.pods.values(),
-                key=lambda p: (p.spec.priority, -p.status.start_time),
+                key=lambda p: (
+                    violating(p) if pdbs else False,
+                    p.spec.priority,
+                    -p.status.start_time,
+                ),
             )
             per_node[rec.row] = vics
             vmax = max(vmax, len(vics))
@@ -280,6 +470,35 @@ class PreemptionEvaluator:
         vic_req = np.zeros((n, v, schema.R), np.int64)
         vic_nonzero = np.zeros((n, v, 2), np.int64)
         vic_start = np.full((n, v), np.inf, np.float64)
+        vic_pdb = np.zeros((n, v, n_pdbs), np.bool_)
+        pdb_allowed = np.full(n_pdbs, I32_MAX, np.int64)
+        for i, pdb in enumerate(pdbs):
+            pdb_allowed[i] = max(pdb.disruptions_allowed, 0)
+        # What-if release features, gated by what the active filters read
+        # (the pass branches on the same key set at trace time).
+        names = set(
+            profile.filters if active is None else active
+        )
+        vfeat: dict[str, np.ndarray] = {}
+        if names & {"InterPodAffinity", "PodTopologySpread"}:
+            ts = _bucket(
+                max(
+                    (
+                        len(cache.pods[p.uid].delta["own_terms"])
+                        for vics in per_node.values()
+                        for p in vics
+                    ),
+                    default=1,
+                ),
+                1,
+            )
+            vfeat["group"] = np.full((n, v), -1, np.int32)
+            vfeat["terms"] = np.full((n, v, ts), -1, np.int32)
+        if "NodePorts" in names:
+            from .snapshot import POD_PORT_SLOTS
+
+            vfeat["port_triples"] = np.full((n, v, POD_PORT_SLOTS), -1, np.int32)
+            vfeat["port_keys"] = np.full((n, v, POD_PORT_SLOTS), -1, np.int32)
         for row, vics in per_node.items():
             for j, p in enumerate(vics):
                 pr = cache.pods[p.uid]
@@ -288,10 +507,25 @@ class PreemptionEvaluator:
                 vic_req[row, j, : req.shape[0]] = req
                 vic_nonzero[row, j] = pr.delta["nonzero"]
                 vic_start[row, j] = p.status.start_time
+                if pdbs:
+                    for i in matched_pdbs(p):
+                        vic_pdb[row, j, i] = True
+                if "group" in vfeat:
+                    vfeat["group"][row, j] = pr.delta["group"]
+                    for a, tid in enumerate(pr.delta["own_terms"]):
+                        vfeat["terms"][row, j, a] = tid
+                if "port_triples" in vfeat:
+                    for a, (triple, pk) in enumerate(pr.delta["ports"]):
+                        vfeat["port_triples"][row, j, a] = triple
+                        vfeat["port_keys"][row, j, a] = pk
 
         # Stack the failed pods' feature rows into a (K, …) batch; mark
-        # ineligible rows invalid so their step is a no-op.
-        k = _bucket(len(pods), 1)
+        # ineligible rows invalid so their step is a no-op.  K is always the
+        # scheduler's batch size (failed ⊆ batch): ONE compiled shape, so a
+        # 1-pod warm preemption covers the full-batch measured shape (the
+        # variable-bucket shapes used to recompile inside the measured
+        # window).  Idle padded steps are cheap relative to a recompile.
+        k = self.sched.batch_size
         batch: dict = {}
         for key_, rows in batch_rows.items():
             stacked = np.stack(rows)
@@ -303,9 +537,11 @@ class PreemptionEvaluator:
         if inv is None:
             inv = builder.batch_invariants()
         state = builder.state()
-        out = self._pass(active)(
+        out = self._pass(profile, active, n_pdbs)(
             state, batch, inv, jnp.asarray(vic_prio), jnp.asarray(vic_req),
             jnp.asarray(vic_nonzero), jnp.asarray(vic_start),
+            {k: jnp.asarray(a) for k, a in vfeat.items()},
+            jnp.asarray(vic_pdb), jnp.asarray(pdb_allowed),
         )
         picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)
 
@@ -327,7 +563,15 @@ class PreemptionEvaluator:
             # device (the in-scan release was resources-only).
             for vic in victims:
                 consumed.add(vic.uid)
-                cache.remove_pod(vic.uid)
+                # Full deletion path: releases DRA claim reservations, gang
+                # credit, and fires the victim's delete event — a victim is
+                # an API DELETE, not just a cache eviction.
+                sched.delete_pod(vic.uid)
+                # Evicting a PDB-covered pod consumes its budget (the
+                # disruption controller would rebuild DisruptionsAllowed;
+                # in-process we decrement directly).
+                for i in matched_pdbs(vic):
+                    pdbs[i].disruptions_allowed -= 1
             pod.status.nominated_node_name = node_name
             results.append(PreemptionResult(node_name=node_name, victims=victims))
         return results

@@ -34,9 +34,10 @@ class Event(IntFlag):
     POD_DELETE = auto()
     PV_ADD = auto()
     PVC_ADD = auto()
+    CLAIM_ADD = auto()  # ResourceClaim/ResourceSlice events (DRA)
     ANY = (
         NODE_ADD | NODE_UPDATE | NODE_TAINT | NODE_LABEL | POD_ADD | POD_UPDATE
-        | POD_DELETE | PV_ADD | PVC_ADD
+        | POD_DELETE | PV_ADD | PVC_ADD | CLAIM_ADD
     )
 
 
@@ -57,6 +58,8 @@ PLUGIN_REQUEUE_EVENTS: dict[str, Event] = {
     "NodeVolumeLimits": Event.NODE_ADD | Event.NODE_UPDATE | Event.POD_DELETE | Event.PVC_ADD,
     # Gang members wait for more members (pod adds) or capacity.
     "GangScheduling": Event.POD_ADD | Event.POD_DELETE | Event.NODE_ADD,
+    "DynamicResources": Event.CLAIM_ADD | Event.POD_DELETE | Event.NODE_ADD
+    | Event.NODE_UPDATE,
 }
 
 DEFAULT_POD_INITIAL_BACKOFF_S = 1.0

@@ -72,10 +72,23 @@ class TPUScheduler:
         enable_preemption: bool = True,
         mesh=None,
         chunk_size: int = 1,
+        profiles: list[Profile] | None = None,
+        extenders: list | None = None,
     ):
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
         self.profile = registered_subset(profile)
+        # Multi-profile map (profile/profile.go:47): schedulerName →
+        # compiled program variant.  `profile` stays the default; extra
+        # profiles get their own XLA programs via PassCache and pods select
+        # by .spec.scheduler_name.  Pods naming an unknown scheduler are not
+        # ours (eventhandlers.go responsibleForPod) and are ignored.
+        self.profiles: dict[str, Profile] = {self.profile.name: self.profile}
+        for p in profiles or ():
+            self.profiles[p.name] = registered_subset(p)
+        # Out-of-process extenders (pkg/scheduler/extender.go); a non-empty
+        # chain routes scheduling through the per-pod eval-only path.
+        self.extenders = list(extenders or ())
         self.batch_size = batch_size
         # chunk_size=1 → strictly sequential-equivalent scan (parity mode);
         # >1 → C pods per device step with conflict-deferral + a strict tail
@@ -97,6 +110,15 @@ class TPUScheduler:
         # parking and the Permit gate agree.
         self.pod_groups: dict[str, t.PodGroup] = {}
         self.gang_bound: dict[str, int] = {}
+        # PodDisruptionBudgets (preemption criterion 1, the disruption
+        # controller's state in-process).
+        self.pdbs: dict[str, t.PodDisruptionBudget] = {}
+        # Nominator (backend/queue/nominator.go): preemptors' claims on
+        # their freed nodes — uid → (node name, row delta, priority).  The
+        # fit filter counts these on their nodes so a same/next-batch pod
+        # cannot steal a freed node (framework.go:973), and the retrying
+        # preemptor takes its nominated node via the engine's fast path.
+        self.nominator: dict[str, tuple[str, dict, int]] = {}
         # WaitOnPermit room (framework.go:1503): gang → [(qp, node, score,
         # feasible)] of members assumed-but-not-bound until quorum forms.
         self.permit_waiting: dict[str, list] = {}
@@ -113,12 +135,15 @@ class TPUScheduler:
         # Truncated (parity) mode: percentage_of_nodes_to_score != 100
         # reproduces the reference's adaptive search truncation + rotating
         # start + zone-interleaved order; needs the sequential scan.
-        self._truncated = self.profile.percentage_of_nodes_to_score != 100
+        self._truncated = any(
+            p.percentage_of_nodes_to_score != 100 for p in self.profiles.values()
+        )
         if self._truncated:
             assert chunk_size == 1, (
                 "percentage_of_nodes_to_score != 100 (parity mode) requires "
                 "chunk_size=1 (sequential-equivalent scan)"
             )
+        self._eval_passes: dict = {}  # extender path: per-profile eval pass
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -153,10 +178,14 @@ class TPUScheduler:
 
     def add_node(self, node: t.Node) -> None:
         self.cache.add_node(node)
-        # Replay a CSINode that arrived before its Node (informer races).
+        # Replay CSINode/ResourceSlices that arrived before their Node
+        # (informer races).
         csinode = self.builder.volumes.csinodes.get(node.name)
         if csinode is not None:
             self.builder.set_csinode_limits(self.cache.row_of(node.name), csinode)
+        for (nname, cls) in self.builder.dra.slices:
+            if nname == node.name:
+                self.builder.set_dra_cap(self.cache.row_of(node.name), nname, cls)
         self.queue.on_event(Event.NODE_ADD)
 
     def update_node(self, node: t.Node) -> None:
@@ -205,6 +234,8 @@ class TPUScheduler:
     def add_pod(self, pod: t.Pod) -> None:
         """Unassigned pods enter the queue; assigned pods enter the cache
         (eventhandlers.go:126 addPodToSchedulingQueue / :203 addPodToCache)."""
+        if not pod.spec.node_name and self._profile_for(pod) is None:
+            return  # another scheduler's pod (responsibleForPod)
         if pod.spec.node_name:
             self.cache.add_pod(pod)
             # Informer-delivered bound gang members count toward quorum —
@@ -236,6 +267,10 @@ class TPUScheduler:
 
     def delete_pod(self, uid: str) -> None:
         self._drop_permit_waiters({uid})
+        self.nominator.pop(uid, None)
+        # DRA: drop the pod's claim reservations; claims nobody reserves
+        # deallocate (the resourceclaim controller's cleanup).
+        self.builder.dra.release_pod(uid)
         rec = self.cache.pods.get(uid)
         if rec is not None:
             # A bound gang member leaving drops its gang below quorum for
@@ -247,6 +282,11 @@ class TPUScheduler:
             self.queue.on_event(Event.POD_DELETE)
         else:
             self.queue.delete(uid)
+
+    def add_pdb(self, pdb: t.PodDisruptionBudget) -> None:
+        """PodDisruptionBudget informer: preemption counts victims against
+        these budgets (pickOneNodeForPreemption criterion 1)."""
+        self.pdbs[pdb.name] = pdb
 
     def _debit_gang(self, group: str) -> None:
         left = self.gang_bound.get(group, 0) - 1
@@ -277,6 +317,19 @@ class TPUScheduler:
         self.builder.volumes.add_class(sc)
         self.queue.on_event(Event.PVC_ADD)
 
+    def add_resource_claim(self, claim: t.ResourceClaim) -> None:
+        """ResourceClaim informer (DRA)."""
+        self.builder.dra.add_claim(claim)
+        self.queue.on_event(Event.CLAIM_ADD)
+
+    def add_resource_slice(self, s: t.ResourceSlice) -> None:
+        """ResourceSlice informer (DRA): per-node published device counts."""
+        self.builder.dra.add_slice(s)
+        rec = self.cache.nodes.get(s.node_name)
+        if rec is not None:
+            self.builder.set_dra_cap(rec.row, s.node_name, s.device_class)
+        self.queue.on_event(Event.CLAIM_ADD)
+
     def add_csinode(self, csinode: t.CSINode) -> None:
         self.builder.volumes.add_csinode(csinode)
         rec = self.cache.nodes.get(csinode.name)
@@ -304,26 +357,164 @@ class TPUScheduler:
                 n += 1
         return n
 
+    def _profile_for(self, pod: t.Pod) -> Profile | None:
+        """frameworkForPod (schedule_one.go:379): exact schedulerName match;
+        an UNSET name (the API default "default-scheduler") falls to the
+        default profile, any other unknown name is not our pod."""
+        p = self.profiles.get(pod.spec.scheduler_name)
+        if p is not None:
+            return p
+        if pod.spec.scheduler_name == "default-scheduler":
+            return self.profile
+        return None
+
+    def _schedule_one_extender(self, qp: QueuedPodInfo) -> ScheduleOutcome:
+        """One reference scheduling cycle with an extender chain: eval-only
+        device pass → host extender filter/prioritize → host selectHost →
+        assume/bind (findNodesThatPassExtenders, schedule_one.go:704;
+        prioritizeNodes, :799).  Gang/preemption semantics are not combined
+        with extenders in this round."""
+        from .engine.pass_ import build_eval_pass
+        from .extender import run_extender_chain
+
+        profile = self._profile_for(qp.pod) or self.profile
+        m = self.metrics
+        m.schedule_attempts += 1
+        m.batches += 1
+        t0 = time.perf_counter()
+        batch, deltas, active = build_pod_batch([qp.pod], self.builder, profile, 1)
+        inv = self._full_inv()
+        t1 = time.perf_counter()
+        state = self.builder.state()
+        key = (
+            profile, self.builder.schema,
+            tuple(sorted(self.builder.res_col.items())), active,
+        )
+        run = self._eval_passes.get(key)
+        if run is None:
+            run = build_eval_pass(
+                profile, self.builder.schema, self.builder.res_col, active
+            )
+            self._eval_passes[key] = run
+        pf = {k: np.asarray(v)[0] for k, v in batch.items() if k != "valid"}
+        pf["nominated_row"] = np.int32(-1)
+        feasible, total = jax.device_get(run(state, pf, inv))
+        m.featurize_time_s += t1 - t0
+        m.device_time_s += time.perf_counter() - t1
+        rows = np.nonzero(feasible)[0]
+        names = [self.cache.node_name_at_row(int(r)) for r in rows]
+        scores = {nm: int(total[r]) for nm, r in zip(names, rows)}
+        now = time.monotonic()
+        try:
+            nodes, combined, _unres = run_extender_chain(
+                self.extenders, qp.pod, names, scores
+            )
+        except Exception:
+            # A non-ignorable extender failed: a cycle ERROR, not pod-level
+            # unschedulability — retry on a timer (handleSchedulingFailure).
+            self.queue.add_backoff(qp)
+            m.unschedulable += 1
+            return ScheduleOutcome(qp.pod, None, 0, len(names))
+        if not nodes:
+            m.unschedulable += 1
+            # Extender rejections requeue on any event (schedule_one.go:528).
+            plugins = {"Extender"} if names else set(profile.filters)
+            self.queue.add_unschedulable(qp, plugins)
+            return ScheduleOutcome(
+                qp.pod, None, 0, len(names),
+                diagnosis=Diagnosis(unschedulable_plugins=plugins),
+            )
+        best = max(enumerate(nodes), key=lambda p: (combined[p[1]], -p[0]))[1]
+        self.cache.assume_pod(qp.pod, best, device_already=False, delta=deltas[0])
+
+        def _fail_bind(undo_vol, undo_dra):
+            if undo_vol:
+                self.builder.volumes.unbind_pod_volumes(undo_vol)
+            if undo_dra:
+                self.builder.dra.unallocate(undo_dra)
+            self.cache.forget_pod(qp.pod.uid)
+            self.queue.add_backoff(qp)
+            m.unschedulable += 1
+            return ScheduleOutcome(qp.pod, None, 0, len(nodes))
+
+        undo_dra: list | None = []
+        if qp.pod.spec.resource_claims:
+            undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, best)
+            if undo_dra is None:
+                return _fail_bind([], [])
+        undo_vol: list | None = []
+        if any(v.pvc for v in qp.pod.spec.volumes):
+            node = self.cache.nodes[best].node
+            undo_vol = self.builder.volumes.bind_pod_volumes(qp.pod, node)
+            if undo_vol is None:
+                return _fail_bind([], undo_dra)
+        binder = next((ex for ex in self.extenders if getattr(ex, "bind_verb", "")), None)
+        if binder is not None and not binder.bind(qp.pod, best):
+            return _fail_bind(undo_vol, undo_dra)
+        qp.pod.spec.node_name = best
+        self.cache.finish_binding(qp.pod.uid)
+        self.queue.done(qp.pod.uid)
+        if m.scheduled == 0:
+            m.first_scheduled_ts = now
+        m.scheduled += 1
+        m.last_scheduled_ts = now
+        m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
+        return ScheduleOutcome(qp.pod, best, combined[best], len(nodes))
+
     def _full_inv(self) -> dict:
         """Batch invariants, plus — in truncated (parity) mode only — the
         scan-order inputs (zone-interleaved positions, rotating start); the
-        full-evaluation pass never reads them, so skip the O(N) rebuild."""
+        full-evaluation pass never reads them, so skip the O(N) rebuild.
+        Always carries the nominated-pod overlay (zeros when empty, so the
+        compiled program never changes shape)."""
         inv = self.builder.batch_invariants()
         if self._truncated:
             inv["order_pos"] = self.cache.order_pos(self.builder.schema.N)
             inv["scan_start"] = np.uint32(self._next_start)
+        s = self.builder.schema
+        nom_req = np.zeros((s.N, s.R), np.int64)
+        nom_cnt = np.zeros(s.N, np.int32)
+        nom_prio = np.full(s.N, -(2**31), np.int32)
+        for _uid, (node_name, delta, prio) in self.nominator.items():
+            rec = self.cache.nodes.get(node_name)
+            if rec is None:
+                continue
+            d = delta["req"]
+            nom_req[rec.row, : d.shape[0]] += d
+            nom_cnt[rec.row] += 1
+            nom_prio[rec.row] = max(nom_prio[rec.row], prio)
+        inv["nom_req"], inv["nom_cnt"], inv["nom_prio"] = nom_req, nom_cnt, nom_prio
         return inv
 
     def schedule_batch(self) -> list[ScheduleOutcome]:
-        """Pop up to batch_size pods and schedule them in one device pass."""
+        """Pop up to batch_size pods and schedule them in one device pass
+        per profile (pods group by .spec.scheduler_name)."""
         if self.permit_wait_since:
             self.expire_waiting_gangs()
         infos = self.queue.pop_batch(self.batch_size)
         if not infos:
             return []
-        return self._schedule_infos(infos)
+        if self.extenders:
+            # Extender chain: per-pod eval-only path (see extender.py).
+            out: list[ScheduleOutcome] = []
+            for qp in infos:
+                out.append(self._schedule_one_extender(qp))
+            return out
+        if len(self.profiles) == 1:
+            return self._schedule_infos(infos, self.profile)
+        by_profile: dict[str, list[QueuedPodInfo]] = {}
+        for qp in infos:
+            prof = self._profile_for(qp.pod) or self.profile
+            by_profile.setdefault(prof.name, []).append(qp)
+        out = []
+        for name, group in by_profile.items():
+            out.extend(self._schedule_infos(group, self.profiles[name]))
+        return out
 
-    def _schedule_infos(self, infos: list[QueuedPodInfo]) -> list[ScheduleOutcome]:
+    def _schedule_infos(
+        self, infos: list[QueuedPodInfo], profile: Profile | None = None
+    ) -> list[ScheduleOutcome]:
+        profile = profile or self.profile
         pods = [qp.pod for qp in infos]
         t0 = time.perf_counter()
         # Featurize first: it may grow vocab/schema (forcing a rebuild below).
@@ -331,15 +522,27 @@ class TPUScheduler:
         # (a short tail batch costs a few idle scan steps, ~µs; a second
         # compiled shape costs tens of seconds).
         batch, deltas, active = build_pod_batch(
-            pods, self.builder, self.profile, self.batch_size
+            pods, self.builder, profile, self.batch_size
         )
+        # Nominated rows are injected AFTER featurization — nomination is
+        # pod STATUS, and the featurize cache keys on (namespace, labels,
+        # spec) only.
+        nomrow = np.full(self.batch_size, -1, np.int32)
+        if self.nominator:
+            for i, qp in enumerate(infos):
+                nn = qp.pod.status.nominated_node_name
+                if nn:
+                    rec = self.cache.nodes.get(nn)
+                    if rec is not None:
+                        nomrow[i] = rec.row
+        batch["nominated_row"] = nomrow
         # Batch invariants (interned term → topo slot) may grow TK/DV: build
         # them after featurization, before the state flush.
         inv = self._full_inv()
         t1 = time.perf_counter()
         state = self.builder.state()
         run = self.passes.get(
-            self.profile, self.builder.schema, self.builder.res_col, active,
+            profile, self.builder.schema, self.builder.res_col, active,
             self.chunk_size,
         )
         new_state, result = run(state, batch, inv, np.uint32(self._cycle))
@@ -369,15 +572,17 @@ class TPUScheduler:
                 picks.copy(), scores.copy(), feas.copy(), fails.copy()
             )
             strict = self.passes.get(
-                self.profile, self.builder.schema, self.builder.res_col, active, 1
+                profile, self.builder.schema, self.builder.res_col, active, 1
             )
             ts = self.tail_size
             for lo in range(0, len(deferred), ts):
                 idx = deferred[lo : lo + ts]
                 sub, sub_deltas, _ = build_pod_batch(
-                    [infos[i].pod for i in idx], self.builder, self.profile,
+                    [infos[i].pod for i in idx], self.builder, profile,
                     ts, force_active=active,
                 )
+                sub["nominated_row"] = np.full(ts, -1, np.int32)
+                sub["nominated_row"][: len(idx)] = nomrow[idx]
                 for j, i in enumerate(idx):
                     deltas[i] = sub_deltas[j]
                 # Per-pod bucket dims (own terms, devices) are padded to the
@@ -427,6 +632,11 @@ class TPUScheduler:
                 node_name = self.cache.node_name_at_row(row)
                 assert node_name is not None, f"pick={row} maps to no node"
                 self.cache.assume_pod(qp.pod, node_name, device_already=True, delta=deltas[i])
+                # A placed pod's nomination is spent (nominator.go deletes
+                # on assume).
+                if self.nominator:
+                    self.nominator.pop(qp.pod.uid, None)
+                qp.pod.status.nominated_node_name = ""
                 placed.append((i, qp, node_name))
             else:
                 failed.append((i, qp, None))
@@ -509,28 +719,39 @@ class TPUScheduler:
                 self.permit_wait_since.setdefault(g, now)
                 continue
             undo: list | None = []
-            if any(v.pvc for v in qp.pod.spec.volumes):
+            undo_dra: list | None = []
+            if qp.pod.spec.resource_claims:
+                # DRA Reserve/PreBind: allocate + reserve claims on the
+                # chosen node (dynamicresources' assume-cache write).
+                undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, node_name)
+            if undo_dra is not None and any(v.pvc for v in qp.pod.spec.volumes):
                 node = self.cache.nodes[node_name].node
                 undo = self.builder.volumes.bind_pod_volumes(qp.pod, node)
-                if undo is None:
-                    self.cache.forget_pod(qp.pod.uid)
-                    outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
-                    if g:
-                        # The whole gang retries together from the gang pool.
-                        rollback.add(g)
-                        race_rollback.add(g)
-                        self.queue.requeue_gang_member(qp)
-                        for qp2, out2, undo2 in finalized_by_gang.pop(g, ()):
-                            if undo2:
-                                self.builder.volumes.unbind_pod_volumes(undo2)
-                            self.cache.forget_pod(qp2.pod.uid)
-                            qp2.pod.spec.node_name = None
-                            self._debit_gang(g)
-                            out2.node_name, out2.score = None, 0
-                            self.queue.requeue_gang_member(qp2)
-                    else:
-                        self.queue.add_backoff(qp)
-                    continue
+                if undo is None and undo_dra:
+                    self.builder.dra.unallocate(undo_dra)
+            if undo is None or undo_dra is None:
+                # PreBind lost a same-batch race (PV or claim allocation).
+                self.cache.forget_pod(qp.pod.uid)
+                outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
+                if g:
+                    # The whole gang retries together from the gang pool,
+                    # with peers' binds/allocations reverted.
+                    rollback.add(g)
+                    race_rollback.add(g)
+                    self.queue.requeue_gang_member(qp)
+                    for qp2, out2, undo2, undo2d in finalized_by_gang.pop(g, ()):
+                        if undo2:
+                            self.builder.volumes.unbind_pod_volumes(undo2)
+                        if undo2d:
+                            self.builder.dra.unallocate(undo2d)
+                        self.cache.forget_pod(qp2.pod.uid)
+                        qp2.pod.spec.node_name = None
+                        self._debit_gang(g)
+                        out2.node_name, out2.score = None, 0
+                        self.queue.requeue_gang_member(qp2)
+                else:
+                    self.queue.add_backoff(qp)
+                continue
             qp.pod.spec.node_name = node_name
             self.cache.finish_binding(qp.pod.uid)
             self.queue.done(qp.pod.uid)
@@ -539,7 +760,9 @@ class TPUScheduler:
             latency_qps.append(qp)
             if g:
                 self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
-                finalized_by_gang.setdefault(g, []).append((qp, outcome, undo))
+                finalized_by_gang.setdefault(g, []).append(
+                    (qp, outcome, undo, undo_dra)
+                )
         # A gang rolled back by a transient PV race re-admits behind backoff
         # right away — no cluster event will ever fire in a quiet cluster,
         # and the race loser's next attempt resolves against the updated
@@ -560,7 +783,7 @@ class TPUScheduler:
                 m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
         # Diagnosis from the device's per-op fail bitmask (bit order =
         # filter_op_names): which plugins rejected nodes this cycle.
-        bit_names = filter_op_names(self.profile, active)
+        bit_names = filter_op_names(profile, active)
         failed2 = []
         for i, qp, _ in failed:
             mask = int(fails[i])
@@ -584,15 +807,22 @@ class TPUScheduler:
                 if key != "valid"
             }
             results = self.preemption.preempt_batch(
-                [qp.pod for _, qp, _ in failed], rows, active, inv
+                [qp.pod for _, qp, _ in failed], rows, active, inv,
+                profile=profile,
             )
         any_victims = False
-        for (_, qp, outcome), res in zip(failed, results):
+        for (i, qp, outcome), res in zip(failed, results):
             if res is not None:
                 m.preemptions += 1
                 outcome.nominated_node = res.node_name
                 outcome.victims = len(res.victims)
                 any_victims = any_victims or bool(res.victims)
+                # Record the claim: the fit overlay protects the freed node
+                # from same/next-batch stealers, and the retry's fast path
+                # takes it (nominator.go AddNominatedPod).
+                self.nominator[qp.pod.uid] = (
+                    res.node_name, deltas[i], qp.pod.spec.priority
+                )
                 # The reference waits for the victims' graceful deletion
                 # (requeue on their delete events); in-process deletion is
                 # synchronous, so the nominated pod can retry immediately.
@@ -604,7 +834,7 @@ class TPUScheduler:
                 # nodes) falls back to the whole filter set.
                 plugins = outcome.diagnosis.unschedulable_plugins if outcome.diagnosis else set()
                 self.queue.add_unschedulable(
-                    qp, plugins or set(self.profile.filters)
+                    qp, plugins or set(profile.filters)
                 )
         if any_victims:
             self.queue.on_event(Event.POD_DELETE)

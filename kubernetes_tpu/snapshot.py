@@ -67,6 +67,8 @@ class Schema:
     VD: int = 8  # in-tree device-volume vocabulary rows
     DR: int = 8  # CSI driver vocabulary rows
     CV: int = 8  # CSI volume unique-name vocabulary rows
+    DC: int = 4  # DRA device-class vocabulary rows
+    CLM: int = 8  # DRA claim vocabulary rows
     P: int = 8  # host-port (proto,ip,port) triple rows
     PK: int = 8  # host-port (proto,port) key rows
     IM: int = 8  # image slots per node
@@ -128,6 +130,9 @@ class ClusterState:
     csi_used: jax.Array  # (DR, N) i32 — DISTINCT attached volumes per driver
     csi_limit: jax.Array  # (DR, N) i32 — CSINode allocatable count (default inf)
     csivol_counts: jax.Array  # (CV, N) i32 — pods on node using CSI volume v
+    dra_cap: jax.Array  # (DC, N) i32 — devices published per class (ResourceSlices)
+    dra_alloc: jax.Array  # (DC, N) i32 — devices consumed by DISTINCT claims
+    dra_claim_counts: jax.Array  # (CLM, N) i32 — pods on node reserving claim c
 
     # Images ------------------------------------------------------------------
     image_ids: jax.Array  # (N, IM) i32, -1 pad
@@ -158,6 +163,9 @@ _NODE_AXIS: dict[str, int] = {
     "csi_used": 1,
     "csi_limit": 1,
     "csivol_counts": 1,
+    "dra_cap": 1,
+    "dra_alloc": 1,
+    "dra_claim_counts": 1,
     "image_ids": 0,
     "image_sizes": 0,
 }
@@ -187,6 +195,9 @@ def _host_arrays(s: Schema) -> dict[str, np.ndarray]:
         "csi_used": np.zeros((s.DR, s.N), np.int32),
         "csi_limit": np.full((s.DR, s.N), 2**31 - 1, np.int32),
         "csivol_counts": np.zeros((s.CV, s.N), np.int32),
+        "dra_cap": np.zeros((s.DC, s.N), np.int32),
+        "dra_alloc": np.zeros((s.DC, s.N), np.int32),
+        "dra_claim_counts": np.zeros((s.CLM, s.N), np.int32),
         "image_ids": np.full((s.N, s.IM), -1, np.int32),
         "image_sizes": np.zeros((s.N, s.IM), np.int64),
     }
@@ -226,6 +237,10 @@ class SnapshotBuilder:
         from .volumes import VolumeCatalog
 
         self.volumes = VolumeCatalog()
+        # Host-side DRA objects (ResourceClaims/ResourceSlices).
+        from .dra import ClaimCatalog
+
+        self.dra = ClaimCatalog()
         self.host = _host_arrays(self.schema)
         self._device: ClusterState | None = None
         self._dirty_rows: set[int] = set()
@@ -322,6 +337,16 @@ class SnapshotBuilder:
         self._ensure(DV=it.max_topo_vocab())
         self._dirty_rows.add(row)
 
+    def set_dra_cap(self, row: int, node_name: str, device_class: str) -> None:
+        """Refresh a node row's published device count for one class from
+        the claim catalog (ResourceSlice informer)."""
+        cid = self.interns.device_classes.id(device_class)
+        self._ensure(DC=cid + 1)
+        self.host["dra_cap"][cid, row] = self.dra.slices.get(
+            (node_name, device_class), 0
+        )
+        self._dirty_rows.add(row)
+
     def set_csinode_limits(self, row: int, csinode) -> None:
         """Apply CSINode.spec.drivers allocatable counts to a node row
         (nodevolumelimits/csi.go reads CSINode for the attach limit)."""
@@ -397,7 +422,9 @@ class SnapshotBuilder:
             len(it.images),
             len(it.node_names),
             tuple(len(v) for v in it.topo_vals),
+            len(it.device_classes),
             self.volumes.epoch,
+            self.dra.epoch,
             self.ns_epoch,
         )
 
@@ -469,6 +496,20 @@ class SnapshotBuilder:
             DR=len(self.interns.drivers),
             CV=len(self.interns.csivols),
         )
+        # DRA claims (counted-device form), deduped by claim and accounted
+        # per DISTINCT claim like CSI volumes: dra_alloc moves only on a
+        # claim's 0↔1 reservation transition on a node, so the device
+        # tensors and the ClaimCatalog (which allocates per claim) can never
+        # diverge for shared claims.
+        dra_claims: dict[int, tuple[int, int]] = {}  # claim id → (class id, count)
+        if pod.spec.resource_claims:
+            for claim in self.dra.pod_claims(pod):
+                if claim is None:
+                    continue  # missing claims are the op's featurize concern
+                cid = self.interns.device_classes.id(claim.device_class)
+                kid = self.interns.dra_claims.id(claim.uid)
+                self._ensure(DC=cid + 1, CLM=kid + 1)
+                dra_claims[kid] = (cid, claim.count)
         host_ports = pod.host_ports()
         if len(host_ports) > POD_PORT_SLOTS:
             raise ValueError(
@@ -492,6 +533,7 @@ class SnapshotBuilder:
             "devices": devices,
             "csivols": sorted(csivols.items()),
             "pvcs": pvc_uids,
+            "dra_claims": sorted(dra_claims.items()),
         }
 
     def apply_pod_delta(self, row: int, delta: dict, sign: int, device_already: bool) -> None:
@@ -516,6 +558,11 @@ class SnapshotBuilder:
             h["dev_counts"][vid, row] += sign
             if rw:
                 h["dev_rw_counts"][vid, row] += sign
+        for kid, (cid, cnt) in delta.get("dra_claims", ()):
+            prev = h["dra_claim_counts"][kid, row]
+            h["dra_claim_counts"][kid, row] = prev + sign
+            if (sign > 0 and prev == 0) or (sign < 0 and prev == 1):
+                h["dra_alloc"][cid, row] += sign * cnt
         for vid, did in delta.get("csivols", ()):
             # Distinct-volume accounting: csi_used counts volumes whose
             # per-node pod count crosses 0↔1, not pod references.

@@ -1,0 +1,100 @@
+"""Multi-profile scheduling (profile/profile.go:47) and the extender chain
+(pkg/scheduler/extender.go; wire types extender/v1/types.go:73–124)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.extender import ExtenderFilterResult, HostPriority
+from kubernetes_tpu.framework.config import Profile, fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def nodes(s, n=4, cpu="8"):
+    for i in range(n):
+        s.add_node(
+            make_node(f"n{i}").capacity({"cpu": cpu, "memory": "16Gi", "pods": 110})
+            .label("tier", "gold" if i % 2 else "bronze").obj()
+        )
+
+
+class FakeExtender:
+    """In-process fake implementing the Extender surface (the shape of
+    testing/fake_extender.go)."""
+
+    name = "fake"
+    weight = 1
+    ignorable = False
+    bind_verb = ""
+
+    def __init__(self, allow=None, scores=None):
+        self.allow = allow  # set of node names, or None = all
+        self.scores = scores or {}
+        self.filter_calls = 0
+        self.prioritize_calls = 0
+
+    def is_interested(self, pod):
+        return True
+
+    def filter(self, pod, nodes):
+        self.filter_calls += 1
+        keep = [n for n in nodes if self.allow is None or n in self.allow]
+        return ExtenderFilterResult(node_names=keep)
+
+    def prioritize(self, pod, nodes):
+        self.prioritize_calls += 1
+        return [HostPriority(n, self.scores.get(n, 0)) for n in nodes]
+
+    def bind(self, pod, node):
+        return True
+
+
+def test_extender_filters_and_scores():
+    ex = FakeExtender(allow={"n1", "n3"}, scores={"n3": 10})
+    s = TPUScheduler(profile=fit_only_profile(), batch_size=4, extenders=[ex])
+    nodes(s)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    # n3 wins: it survives the filter and gets +10×weight extender score.
+    assert out[0].node_name == "n3"
+    assert ex.filter_calls == 1 and ex.prioritize_calls == 1
+    assert s.builder.host_mirror_equal()
+
+
+def test_extender_rejection_requeues():
+    ex = FakeExtender(allow=set())  # rejects everything
+    s = TPUScheduler(profile=fit_only_profile(), batch_size=4, extenders=[ex])
+    nodes(s)
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name is None
+    assert out[0].diagnosis.unschedulable_plugins == {"Extender"}
+    # Any event wakes extender-rejected pods (schedule_one.go:528).
+    ex.allow = None
+    s.add_node(make_node("n9").capacity({"cpu": "8", "pods": 110}).obj())
+    out2 = s.schedule_all_pending(wait_backoff=True)
+    assert [o.node_name for o in out2 if o.node_name]
+
+
+def test_two_profiles_compile_distinct_programs():
+    """Two schedulerNames → two compiled program variants; pods route by
+    .spec.scheduler_name; unknown names are not our pods."""
+    strict = Profile(
+        name="gold-only",
+        filters=("NodeUnschedulable", "NodeName", "NodeAffinity", "NodeResourcesFit"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+    s = TPUScheduler(
+        profile=fit_only_profile(), batch_size=8, profiles=[strict]
+    )
+    nodes(s)
+    s.add_pod(make_pod("default-pod").req({"cpu": "1"}).scheduler("fit-only").obj())
+    s.add_pod(
+        make_pod("gold-pod").req({"cpu": "1"}).scheduler("gold-only")
+        .node_affinity_in("tier", ["gold"]).obj()
+    )
+    s.add_pod(make_pod("alien").req({"cpu": "1"}).scheduler("other-scheduler").obj())
+    out = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+    assert out["default-pod"] is not None
+    assert out["gold-pod"] in ("n1", "n3")  # gold tier only
+    assert "alien" not in out  # ignored: not responsible for it
+    assert s.queue.pending_count() == 0
+    assert s.builder.host_mirror_equal()

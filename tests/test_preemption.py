@@ -191,3 +191,101 @@ def test_latest_start_tiebreak_uses_highest_priority_victims():
     # Ties on criteria 1-4 (max prio 5, sum 6, two victims); highest-priority
     # victims' earliest starts are 10 (a) vs 5 (b) → latest wins → node a.
     assert vip[0].nominated_node == "a"
+
+
+def test_pdb_violations_decide_winner():
+    """pickOneNodeForPreemption criterion 1: with two otherwise-identical
+    candidates, the node whose victims violate a PDB loses."""
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "2", "pods": 110}).obj())
+    # Same priority/start on both nodes; n1's victim is PDB-protected.
+    s.add_pod(
+        make_pod("protected").req({"cpu": "2"}).priority(5)
+        .label("app", "db").start_time(10.0).node("n1").obj()
+    )
+    s.add_pod(
+        make_pod("plain").req({"cpu": "2"}).priority(5)
+        .start_time(10.0).node("n2").obj()
+    )
+    s.add_pdb(
+        t.PodDisruptionBudget(
+            name="db-pdb",
+            selector=t.LabelSelector(match_labels=(("app", "db"),)),
+            disruptions_allowed=0,
+        )
+    )
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip" and o.node_name]
+    assert vip and vip[0].node_name == "n2"
+    assert "default/protected" in s.cache.pods
+    assert "default/plain" not in s.cache.pods
+
+
+def test_pdb_budget_consumed_across_preemptions():
+    """A PDB with one allowed disruption protects its second pod."""
+    s = sched()
+    for i in (1, 2):
+        s.add_node(make_node(f"n{i}").capacity({"cpu": "2", "pods": 110}).obj())
+        s.add_pod(
+            make_pod(f"db-{i}").req({"cpu": "2"}).priority(5)
+            .label("app", "db").node(f"n{i}").obj()
+        )
+    s.add_pdb(
+        t.PodDisruptionBudget(
+            name="db-pdb",
+            selector=t.LabelSelector(match_labels=(("app", "db"),)),
+            disruptions_allowed=1,
+        )
+    )
+    s.add_pod(make_pod("vip-1").req({"cpu": "2"}).priority(100).obj())
+    s.schedule_all_pending(wait_backoff=True)
+    # One db pod evicted, budget now 0; preferring the protected victim's
+    # node would violate, so count the survivors.
+    assert sum(1 for uid in s.cache.pods if uid.startswith("default/db")) == 1
+    assert s.pdbs["db-pdb"].disruptions_allowed == 0
+
+
+def test_port_conflict_preemption_nominates():
+    """The r1 false negative: the node has spare CPU but a lower-priority
+    pod holds the host port the preemptor needs.  The full-filter dry-run
+    must nominate the node and evict the port holder."""
+    prof = Profile(
+        name="fit-ports",
+        filters=("NodeUnschedulable", "NodeName", "NodePorts", "NodeResourcesFit"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+    s = sched(profile=prof)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("holder").req({"cpu": "1"}).priority(1)
+        .host_port(8080).node("n1").obj()
+    )
+    s.add_pod(
+        make_pod("vip").req({"cpu": "1"}).priority(100).host_port(8080).obj()
+    )
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip" and o.node_name]
+    assert vip and vip[0].node_name == "n1"
+    assert "default/holder" not in s.cache.pods
+
+
+def test_nominated_node_not_stolen_by_next_batch():
+    """After preemption frees a node for a nominated pod, a lower-priority
+    pod arriving before the retry must not steal the capacity
+    (RunFilterPluginsWithNominatedPods, framework.go:973)."""
+    s = sched(batch_size=4)
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("victim").req({"cpu": "2"}).priority(1).node("n1").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out1 = s.schedule_batch()  # vip fails, preempts, nominates n1
+    assert out1[0].nominated_node == "n1"
+    assert "default/vip" in s.nominator
+    # A lower-priority pod shows up before vip's retry: it must NOT fit on
+    # n1 (the nominated resources are counted against it).
+    s.add_pod(make_pod("sneak").req({"cpu": "2"}).priority(1).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    landed = {o.pod.name: o.node_name for o in out if o.node_name}
+    assert landed.get("vip") == "n1"
+    assert "sneak" not in landed
