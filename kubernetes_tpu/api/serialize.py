@@ -1,0 +1,102 @@
+"""Canonical JSON (de)serialization for the API object model.
+
+The sidecar wire protocol ships cluster objects as JSON — the same choice
+the reference's extender protocol makes for v1.Pod (extender/v1/types.go
+ExtenderArgs) — so any host scheduler (Go, C++, Python) can produce them
+without sharing our dataclasses.  Encoding is a direct field mapping:
+dataclass → object, tuple → array, INT_SENTINEL-free primitives as-is."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+from . import types as t
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def to_dict(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: to_dict(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    return obj
+
+
+def to_json(obj: Any) -> bytes:
+    return json.dumps(to_dict(obj), sort_keys=True).encode()
+
+
+def _build(tp: Any, data: Any) -> Any:
+    """Reconstruct a value of type ``tp`` from plain JSON data."""
+    if data is None:
+        return None
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X] and unions
+        for arg in get_args(tp):
+            if arg is type(None):
+                continue
+            return _build(arg, data)
+        return None
+    if origin in (tuple,):
+        args = get_args(tp)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(_build(args[0], x) for x in data)
+        return tuple(_build(a, x) for a, x in zip(args, data))
+    if origin in (list,):
+        (elem,) = get_args(tp) or (Any,)
+        return [_build(elem, x) for x in data]
+    if origin in (dict,):
+        kt, vt = get_args(tp) or (Any, Any)
+        return {k: _build(vt, v) for k, v in data.items()}
+    if isinstance(tp, type) and dataclasses.is_dataclass(tp):
+        hints = _HINTS_CACHE.get(tp)
+        if hints is None:
+            hints = get_type_hints(tp)
+            _HINTS_CACHE[tp] = hints
+        kwargs = {
+            f.name: _build(hints[f.name], data[f.name])
+            for f in dataclasses.fields(tp)
+            if f.name in data
+        }
+        return tp(**kwargs)
+    return data
+
+
+def pod_from_json(raw: bytes | str) -> t.Pod:
+    return _build(t.Pod, json.loads(raw))
+
+
+def node_from_json(raw: bytes | str) -> t.Node:
+    return _build(t.Node, json.loads(raw))
+
+
+# Kind name → (type, scheduler add-method name) for the sidecar's AddObject.
+KINDS: dict[str, tuple[type, str]] = {
+    # update_node diffs against the cached record for precise requeue
+    # events and falls back to add for unknown nodes — upserts over the
+    # wire must not fire NODE_ADD per heartbeat.
+    "Node": (t.Node, "update_node"),
+    "Pod": (t.Pod, "add_pod"),
+    "PersistentVolume": (t.PersistentVolume, "add_pv"),
+    "PersistentVolumeClaim": (t.PersistentVolumeClaim, "add_pvc"),
+    "StorageClass": (t.StorageClass, "add_storage_class"),
+    "CSINode": (t.CSINode, "add_csinode"),
+    "PodGroup": (t.PodGroup, "add_pod_group"),
+    "PodDisruptionBudget": (t.PodDisruptionBudget, "add_pdb"),
+    "ResourceClaim": (t.ResourceClaim, "add_resource_claim"),
+    "ResourceSlice": (t.ResourceSlice, "add_resource_slice"),
+}
+
+
+def from_json(kind: str, raw: bytes | str):
+    tp, _ = KINDS[kind]
+    return _build(tp, json.loads(raw))

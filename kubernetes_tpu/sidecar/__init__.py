@@ -1,0 +1,5 @@
+"""Out-of-process sidecar: the framed-socket protocol a host scheduler
+(the Go kube-scheduler's out-of-tree plugin set, or the bundled native C++
+client) uses to drive the TPU engine.  See proto/sidecar.proto."""
+
+from .server import SidecarClient, SidecarServer, read_frame, write_frame  # noqa: F401

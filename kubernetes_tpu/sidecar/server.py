@@ -1,0 +1,215 @@
+"""Sidecar server: hosts a TPUScheduler behind the framed-socket protocol.
+
+This is the process boundary SURVEY §7 phase 6 describes: the host
+scheduler keeps its informers/queue/binding and streams snapshot deltas +
+pod batches here; the device pass answers with bindings, scores and
+diagnosis (proto/sidecar.proto).  Framing is 4-byte big-endian length +
+Envelope payload over a unix-domain (or TCP) socket — message-compatible
+with a gRPC transport, which needs only the stub layer on the Go side.
+
+The server is intentionally single-threaded per connection: the scheduler
+is a sequential state machine (the reference's scheduling loop is too);
+concurrency belongs to the host side (async binding, informers)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+
+from ..api import serialize
+from ..scheduler import TPUScheduler
+from . import sidecar_pb2 as pb
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20
+
+
+def write_frame(sock: socket.socket, env: pb.Envelope) -> None:
+    payload = env.SerializeToString()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> pb.Envelope | None:
+    header = _read_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    payload = _read_exact(sock, n)
+    if payload is None:
+        return None
+    env = pb.Envelope()
+    env.ParseFromString(payload)
+    return env
+
+
+class SidecarServer:
+    """Serves one TPUScheduler over a unix-domain socket."""
+
+    def __init__(self, path: str, scheduler: TPUScheduler | None = None, **kw):
+        self.path = path
+        self.scheduler = scheduler or TPUScheduler(**kw)
+        self._thread: threading.Thread | None = None
+
+        sched = self.scheduler
+        # The scheduler is a sequential state machine; connections are
+        # threaded but dispatch is serialized (concurrency belongs to the
+        # host side).
+        lock = threading.Lock()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        env = read_frame(self.request)
+                    except (ConnectionError, ValueError):
+                        return
+                    if env is None:
+                        return
+                    out = pb.Envelope(seq=env.seq)
+                    try:
+                        with lock:
+                            _dispatch(sched, env, out)
+                    except Exception as exc:  # surface, don't kill the server
+                        out.response.error = f"{type(exc).__name__}: {exc}"
+                    write_frame(self.request, out)
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        if os.path.exists(path):
+            os.unlink(path)
+        self._server = Server(path, Handler)
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+def _dispatch(sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope) -> None:
+    kind = env.WhichOneof("msg")
+    if kind == "add":
+        if env.add.kind == "NamespaceLabels":
+            # {"namespace": ..., "labels": {...}} — the namespace informer
+            # feeding affinity namespaceSelector matching.
+            import json
+
+            data = json.loads(env.add.object_json)
+            sched.builder.set_namespace_labels(data["namespace"], data["labels"])
+            out.response.SetInParent()
+            return
+        obj = serialize.from_json(env.add.kind, env.add.object_json)
+        getattr(sched, serialize.KINDS[env.add.kind][1])(obj)
+        out.response.SetInParent()
+    elif kind == "remove":
+        if env.remove.kind == "Node":
+            sched.remove_node(env.remove.uid)
+        elif env.remove.kind == "Pod":
+            sched.delete_pod(env.remove.uid)
+        else:
+            raise ValueError(f"cannot remove kind {env.remove.kind}")
+        out.response.SetInParent()
+    elif kind == "schedule":
+        for raw in env.schedule.pod_json:
+            sched.add_pod(serialize.pod_from_json(raw))
+        outcomes = (
+            sched.schedule_all_pending()
+            if env.schedule.drain
+            else sched.schedule_batch()
+        )
+        for o in outcomes:
+            r = out.response.results.add()
+            r.pod_uid = o.pod.uid
+            r.node_name = o.node_name or ""
+            r.score = o.score
+            r.feasible_nodes = o.feasible_nodes
+            r.nominated_node = o.nominated_node or ""
+            r.victims = o.victims
+            if o.diagnosis is not None:
+                r.unschedulable_plugins.extend(
+                    sorted(o.diagnosis.unschedulable_plugins)
+                )
+    else:
+        raise ValueError(f"unhandled message {kind}")
+
+
+class SidecarClient:
+    """Minimal Python client (the same framing the native C++ client in
+    native/sidecar_client.cc speaks)."""
+
+    def __init__(self, path: str):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(path)
+        self._seq = 0
+
+    def _call(self, env: pb.Envelope) -> pb.Envelope:
+        self._seq += 1
+        env.seq = self._seq
+        write_frame(self.sock, env)
+        resp = read_frame(self.sock)
+        if resp is None:
+            raise ConnectionError("sidecar closed the connection")
+        if resp.seq != self._seq:
+            raise RuntimeError(
+                f"protocol desync: seq {resp.seq} != {self._seq}"
+            )
+        if resp.response.error:
+            raise RuntimeError(resp.response.error)
+        return resp
+
+    def set_namespace_labels(self, namespace: str, labels: dict) -> None:
+        import json
+
+        env = pb.Envelope()
+        env.add.kind = "NamespaceLabels"
+        env.add.object_json = json.dumps(
+            {"namespace": namespace, "labels": labels}
+        ).encode()
+        self._call(env)
+
+    def add(self, kind: str, obj) -> None:
+        env = pb.Envelope()
+        env.add.kind = kind
+        env.add.object_json = serialize.to_json(obj)
+        self._call(env)
+
+    def remove(self, kind: str, uid: str) -> None:
+        env = pb.Envelope()
+        env.remove.kind = kind
+        env.remove.uid = uid
+        self._call(env)
+
+    def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
+        env = pb.Envelope()
+        env.schedule.drain = drain
+        for p in pods:
+            env.schedule.pod_json.append(serialize.to_json(p))
+        return list(self._call(env).response.results)
+
+    def close(self) -> None:
+        self.sock.close()

@@ -1,0 +1,174 @@
+// Native sidecar client: the embeddable C++ half of the out-of-process
+// protocol (proto/sidecar.proto) — what a host scheduler links to drive
+// the TPU engine the way the reference's kube-scheduler drives an HTTP
+// extender (pkg/scheduler/extender.go), but with protobuf frames over a
+// unix socket instead of JSON-over-HTTP round trips.
+//
+// Framing: 4-byte big-endian payload length | Envelope payload — matching
+// kubernetes_tpu/sidecar/server.py.  Cluster objects ride as canonical
+// JSON (the same encoding kubernetes_tpu/api/serialize.py emits), so this
+// client needs no copy of the Python object model.
+//
+// Build: `make -C native` (needs protoc-generated sidecar.pb.{h,cc} and
+// libprotobuf, both present in the image).  The demo main builds a small
+// cluster, schedules a pod wave, and prints one binding per line — the
+// integration tests run it against a live server.
+
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "sidecar.pb.h"
+
+namespace sidecar {
+
+namespace v1 = kubernetes_tpu::sidecar::v1;
+
+class Client {
+ public:
+  explicit Client(const std::string& path) {
+    fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect(" + path + ") failed");
+  }
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  void AddObject(const std::string& kind, const std::string& json) {
+    v1::Envelope env;
+    env.mutable_add()->set_kind(kind);
+    env.mutable_add()->set_object_json(json);
+    Call(env);
+  }
+
+  void RemoveObject(const std::string& kind, const std::string& uid) {
+    v1::Envelope env;
+    env.mutable_remove()->set_kind(kind);
+    env.mutable_remove()->set_uid(uid);
+    Call(env);
+  }
+
+  std::vector<v1::PodResult> Schedule(const std::vector<std::string>& pods,
+                                      bool drain = true) {
+    v1::Envelope env;
+    auto* req = env.mutable_schedule();
+    req->set_drain(drain);
+    for (const auto& p : pods) req->add_pod_json(p);
+    v1::Envelope resp = Call(env);
+    std::vector<v1::PodResult> out(resp.response().results().begin(),
+                                   resp.response().results().end());
+    return out;
+  }
+
+ private:
+  v1::Envelope Call(v1::Envelope& env) {
+    env.set_seq(++seq_);
+    std::string payload;
+    env.SerializeToString(&payload);
+    uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+    SendAll(reinterpret_cast<const char*>(&len), sizeof(len));
+    SendAll(payload.data(), payload.size());
+
+    uint32_t rlen_be;
+    RecvAll(reinterpret_cast<char*>(&rlen_be), sizeof(rlen_be));
+    const uint32_t rlen = ntohl(rlen_be);
+    constexpr uint32_t kMaxFrame = 64u << 20;  // server.py MAX_FRAME
+    if (rlen > kMaxFrame)
+      throw std::runtime_error("frame too large (stream desync?)");
+    std::string rbuf(rlen, '\0');
+    RecvAll(rbuf.data(), rbuf.size());
+    v1::Envelope resp;
+    if (!resp.ParseFromString(rbuf))
+      throw std::runtime_error("bad response frame");
+    if (resp.seq() != seq_) throw std::runtime_error("seq mismatch");
+    if (!resp.response().error().empty())
+      throw std::runtime_error("server error: " + resp.response().error());
+    return resp;
+  }
+
+  void SendAll(const char* data, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::send(fd_, data, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      data += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void RecvAll(char* data, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::recv(fd_, data, n, 0);
+      if (r <= 0) throw std::runtime_error("recv failed (connection closed)");
+      data += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  int fd_ = -1;
+  uint64_t seq_ = 0;
+};
+
+std::string NodeJson(const std::string& name, int cpu_milli,
+                     long long mem_bytes, const std::string& zone) {
+  std::ostringstream o;
+  o << "{\"metadata\":{\"name\":\"" << name << "\",\"labels\":{"
+    << "\"topology.kubernetes.io/zone\":\"" << zone << "\"}},"
+    << "\"status\":{\"allocatable\":{\"cpu\":" << cpu_milli
+    << ",\"memory\":" << mem_bytes << ",\"pods\":110}}}";
+  return o.str();
+}
+
+std::string PodJson(const std::string& name, int cpu_milli,
+                    long long mem_bytes) {
+  std::ostringstream o;
+  o << "{\"metadata\":{\"name\":\"" << name << "\"},"
+    << "\"spec\":{\"containers\":[{\"name\":\"c\",\"requests\":{"
+    << "\"cpu\":" << cpu_milli << ",\"memory\":" << mem_bytes << "}}]}}";
+  return o.str();
+}
+
+}  // namespace sidecar
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: " << argv[0] << " <socket-path> [nodes] [pods]\n";
+    return 2;
+  }
+  const std::string path = argv[1];
+  const int n_nodes = argc > 2 ? std::atoi(argv[2]) : 4;
+  const int n_pods = argc > 3 ? std::atoi(argv[3]) : 8;
+  try {
+    sidecar::Client client(path);
+    for (int i = 0; i < n_nodes; ++i) {
+      client.AddObject("Node",
+                       sidecar::NodeJson("node-" + std::to_string(i), 8000,
+                                         16LL << 30,
+                                         "zone-" + std::to_string(i % 3)));
+    }
+    std::vector<std::string> pods;
+    for (int i = 0; i < n_pods; ++i)
+      pods.push_back(
+          sidecar::PodJson("pod-" + std::to_string(i), 500, 1LL << 30));
+    auto results = client.Schedule(pods);
+    for (const auto& r : results)
+      std::cout << r.pod_uid() << " -> "
+                << (r.node_name().empty() ? "<unschedulable>" : r.node_name())
+                << " score=" << r.score() << "\n";
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,72 @@
+"""A/B parity harness: upstream-semantics oracle vs the TPU engine OVER THE
+SIDECAR WIRE, same fixture, fixed seeds — diff the bindings.
+
+The in-repo analog of the integration pattern SURVEY §4 prescribes
+(test/integration/util/util.go:579: boot two schedulers against one
+apiserver, diff bindings).  The "upstream" side is the scalar sequential
+scheduler implementing the reference's truncation/rotation/interleave/
+tie-break semantics (tests/test_parity.py OracleScheduler); the TPU side
+runs in parity mode (percentage_of_nodes_to_score=None, chunk_size=1)
+behind the framed-socket sidecar, so the comparison crosses the real
+process boundary a Go host would use.
+
+Usage: python scripts/parity_ab.py [nodes] [pods]
+Prints one JSON line: {"parity": true/false, "mismatches": N, ...}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+from dataclasses import replace
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+from kubernetes_tpu.framework.config import fit_only_profile  # noqa: E402
+from kubernetes_tpu.scheduler import TPUScheduler  # noqa: E402
+from kubernetes_tpu.sidecar import SidecarClient, SidecarServer  # noqa: E402
+from test_parity import OracleScheduler, _nodes, _pod  # noqa: E402
+
+
+def main(n_nodes: int = 304, n_pods: int = 200) -> dict:
+    nodes = _nodes(n_nodes)
+    prof = replace(fit_only_profile(), percentage_of_nodes_to_score=None)
+
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(
+        path,
+        scheduler=TPUScheduler(
+            profile=prof, batch_size=32, chunk_size=1, enable_preemption=False
+        ),
+    )
+    srv.serve_background()
+    client = SidecarClient(path)
+    try:
+        for n in nodes:
+            client.add("Node", n)
+        results = client.schedule([_pod(i) for i in range(n_pods)])
+        tpu = {r.pod_uid: r.node_name or None for r in results}
+    finally:
+        client.close()
+        srv.close()
+
+    oracle = OracleScheduler(nodes, pct=None, seed=prof.tie_break_seed)
+    want = {_pod(i).uid: oracle.schedule(_pod(i)) for i in range(n_pods)}
+
+    mismatches = {k: (tpu.get(k), want[k]) for k in want if tpu.get(k) != want[k]}
+    out = {
+        "parity": not mismatches,
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "mismatches": len(mismatches),
+        "sample": dict(list(mismatches.items())[:3]),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:3]]
+    result = main(*args)
+    sys.exit(0 if result["parity"] else 1)
