@@ -80,6 +80,66 @@ DEFAULT_PLUGIN_WEIGHTS = {name: w for name, w in Profile().scorers}
 DEFAULT_PROFILE = Profile()
 
 
+def validate_profile(profile: Profile) -> list[str]:
+    """Strict config validation, the analog of
+    pkg/scheduler/apis/config/validation (ValidateKubeSchedulerConfiguration
+    + validation_pluginargs).  Returns a list of violations (empty = valid)."""
+    from ..ops import common as opcommon
+
+    errs: list[str] = []
+    if not profile.name:
+        errs.append("profile.name must be non-empty")
+    seen_f: set[str] = set()
+    for name in profile.filters:
+        if not opcommon.has(name):
+            errs.append(f"filters[{name!r}]: unknown plugin")
+        if name in seen_f:
+            errs.append(f"filters[{name!r}]: duplicate entry")
+        seen_f.add(name)
+    seen: set[str] = set()
+    for name, weight in profile.scorers:
+        if not opcommon.has(name):
+            errs.append(f"scorers[{name!r}]: unknown plugin")
+        if name in seen:
+            errs.append(f"scorers[{name!r}]: duplicate entry")
+        seen.add(name)
+        # Weight bounds (validation.go validatePluginConfig: weight 1..100).
+        if not 1 <= weight <= 100:
+            errs.append(f"scorers[{name!r}]: weight {weight} outside [1, 100]")
+    pct = profile.percentage_of_nodes_to_score
+    if pct is not None and not 0 <= pct <= 100:
+        errs.append(f"percentage_of_nodes_to_score {pct} outside [0, 100]")
+    strat = profile.scoring_strategy
+    if strat.type not in (LEAST_ALLOCATED, MOST_ALLOCATED, REQUESTED_TO_CAPACITY_RATIO):
+        errs.append(f"scoring_strategy.type {strat.type!r} unknown")
+    if not strat.resources:
+        errs.append("scoring_strategy.resources must be non-empty")
+    for rname, weight in strat.resources:
+        if not 1 <= weight <= 100:
+            errs.append(
+                f"scoring_strategy.resources[{rname!r}]: weight {weight} outside [1, 100]"
+            )
+    if strat.type == REQUESTED_TO_CAPACITY_RATIO:
+        # validateFunctionShape: ≥2 points, utilization STRICTLY increasing
+        # in [0, 100], scores in [0, 10].
+        utils = [p[0] for p in strat.shape]
+        if len(strat.shape) < 2 or any(
+            b <= a for a, b in zip(utils, utils[1:])
+        ):
+            errs.append(
+                "scoring_strategy.shape must be ≥2 points with strictly "
+                "increasing utilization"
+            )
+        for u, score in strat.shape:
+            if not 0 <= u <= 100:
+                errs.append(f"scoring_strategy.shape utilization {u} outside [0, 100]")
+            if not 0 <= score <= 10:
+                errs.append(f"scoring_strategy.shape score {score} outside [0, 10]")
+    if profile.hard_pod_affinity_weight < 0 or profile.hard_pod_affinity_weight > 100:
+        errs.append("hard_pod_affinity_weight outside [0, 100]")
+    return errs
+
+
 def fit_only_profile() -> Profile:
     """NodeResourcesFit-only profile (BASELINE config #1 shape)."""
     return Profile(

@@ -1,0 +1,104 @@
+"""Scheduler metrics: histograms + counters in the reference's shape.
+
+The analog of pkg/scheduler/metrics/metrics.go: per-extension-point
+duration histograms (framework_extension_point_duration_seconds:245),
+e2e scheduling SLI (pod_scheduling_sli_duration_seconds:225), and the
+attempt counters.  Prometheus-style exponential buckets; `summary()`
+renders the same quantities scheduler_perf thresholds read."""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+# metrics.go:156 scheduling_attempt_duration_seconds buckets.
+DURATION_BUCKETS = exponential_buckets(0.001, 2, 20)
+
+
+@dataclass
+class Histogram:
+    """Fixed-bucket histogram (component-base/metrics HistogramVec cell)."""
+
+    buckets: list[float] = field(default_factory=lambda: DURATION_BUCKETS)
+    counts: list[int] = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (what Prometheus histogram_quantile
+        computes)."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            if seen + c >= target:
+                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                frac = (target - seen) / c if c else 0.0
+                return lo + (hi - lo) * frac
+            seen += c
+            lo = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+        return lo
+
+    def summary(self) -> dict:
+        return {
+            "count": self.n,
+            "avg": self.total / self.n if self.n else 0.0,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+# Extension points the batch engine times (the batch analogs of the
+# reference's per-point spans).
+EXTENSION_POINTS = (
+    "Featurize",   # PreFilter analog: host featurization per batch
+    "DevicePass",  # Filter+Score+Select+Commit, one dispatch
+    "PostFilter",  # batched preemption
+    "PreBind",     # volume/DRA binds, host
+)
+
+
+@dataclass
+class MetricsRegistry:
+    """Per-scheduler registry (the component-base registry analog)."""
+
+    extension_point: dict[str, Histogram] = field(
+        default_factory=lambda: {p: Histogram() for p in EXTENSION_POINTS}
+    )
+    # pod_scheduling_sli_duration_seconds (enqueue → bind).
+    scheduling_sli: Histogram = field(default_factory=Histogram)
+    # scheduling_attempt_duration_seconds (one batch / attempts in it).
+    attempt_duration: Histogram = field(default_factory=Histogram)
+
+    def observe_point(self, point: str, seconds: float) -> None:
+        self.extension_point[point].observe(seconds)
+
+    def summary(self) -> dict:
+        return {
+            "extension_point_duration_seconds": {
+                p: h.summary() for p, h in self.extension_point.items() if h.n
+            },
+            "pod_scheduling_sli_duration_seconds": self.scheduling_sli.summary(),
+            "scheduling_attempt_duration_seconds": self.attempt_duration.summary(),
+        }

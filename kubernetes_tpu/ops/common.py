@@ -131,6 +131,10 @@ def get(name: str) -> OpDef:
     return _REGISTRY[name]
 
 
+def has(name: str) -> bool:
+    return name in _REGISTRY
+
+
 def all_ops() -> dict[str, OpDef]:
     return dict(_REGISTRY)
 

@@ -21,6 +21,7 @@ from .cache import Cache
 from .engine.features import build_pod_batch
 from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
+from .framework.metrics import MetricsRegistry
 from .framework.status import Diagnosis
 from .intern import InternTable
 from .ops.common import registered_subset
@@ -61,6 +62,9 @@ class SchedulerMetrics:
     # Per-pod e2e scheduling latency (enqueue → bind), the analog of
     # pod_scheduling_sli_duration_seconds (metrics/metrics.go:225).
     e2e_latency_samples: list = field(default_factory=list)
+    # Histograms: per-extension-point durations + SLI
+    # (framework_extension_point_duration_seconds, metrics.go:245).
+    registry: MetricsRegistry = field(default_factory=MetricsRegistry)
 
 
 class TPUScheduler:
@@ -750,6 +754,9 @@ class TPUScheduler:
         m.batches += 1
         m.featurize_time_s += ctx["feat_s"]
         m.device_time_s += t2 - t1
+        m.registry.observe_point("Featurize", ctx["feat_s"])
+        m.registry.observe_point("DevicePass", t2 - t1)
+        m.registry.attempt_duration.observe(t2 - t1 + ctx["feat_s"])
         failed: list[tuple[int, QueuedPodInfo, ScheduleOutcome]] = []
         # Phase 1 — assume every pick (cache.go:361 AssumePod; the device
         # already committed the deltas in-scan).
@@ -830,6 +837,7 @@ class TPUScheduler:
         finalized_by_gang: dict[str, list] = {}
         latency_qps: list[QueuedPodInfo] = []
         race_rollback: set[str] = set()  # transient (PV race): retry on timer
+        prebind_s = 0.0
         for qp, node_name, score, feasn in entries:
             g = qp.pod.spec.pod_group
             if g in rollback:
@@ -851,6 +859,10 @@ class TPUScheduler:
                 continue
             undo: list | None = []
             undo_dra: list | None = []
+            has_prebind = bool(qp.pod.spec.resource_claims) or any(
+                v.pvc for v in qp.pod.spec.volumes
+            )
+            t_pb = time.perf_counter() if has_prebind else 0.0
             if qp.pod.spec.resource_claims:
                 # DRA Reserve/PreBind: allocate + reserve claims on the
                 # chosen node (dynamicresources' assume-cache write).
@@ -860,6 +872,8 @@ class TPUScheduler:
                 undo = self.builder.volumes.bind_pod_volumes(qp.pod, node)
                 if undo is None and undo_dra:
                     self.builder.dra.unallocate(undo_dra)
+            if has_prebind:
+                prebind_s += time.perf_counter() - t_pb
             if undo is None or undo_dra is None:
                 # PreBind lost a same-batch race (PV or claim allocation).
                 self.cache.forget_pod(qp.pod.uid)
@@ -900,6 +914,8 @@ class TPUScheduler:
         # volume catalog.
         for g in race_rollback:
             self.queue.readmit_gang(g)
+        if prebind_s:
+            m.registry.observe_point("PreBind", prebind_s)
         # Metrics after rollbacks settled (success = outcome kept its node).
         for outcome in outcomes:
             if outcome.node_name:
@@ -911,7 +927,9 @@ class TPUScheduler:
                 m.unschedulable += 1
         for qp in latency_qps:
             if qp.pod.spec.node_name:
-                m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
+                lat = now - qp.initial_attempt_timestamp
+                m.e2e_latency_samples.append(lat)
+                m.registry.scheduling_sli.observe(lat)
         # Diagnosis from the device's per-op fail bitmask (bit order =
         # filter_op_names): which plugins rejected nodes this cycle.
         bit_names = filter_op_names(profile, active)
@@ -931,9 +949,12 @@ class TPUScheduler:
         # PostFilter: one batched preemption pass for every failure
         # (schedule_one.go:196 RunPostFilterPlugins → DefaultPreemption).
         results = [None] * len(failed)
+        ran_postfilter = False
+        t_post = time.perf_counter()
         # (Preemption also sits out a schema-grown batch: its pass would mix
         # old-shape feature rows with rebuilt state; failures just requeue.)
         if failed and self.preemption is not None and not schema_grew:
+            ran_postfilter = True
             rows = {
                 key: [np.asarray(arr)[i] for i, _, _ in failed]
                 for key, arr in batch.items()
@@ -971,6 +992,8 @@ class TPUScheduler:
                 )
         if any_victims:
             self.queue.on_event(Event.POD_DELETE)
+        if ran_postfilter:
+            m.registry.observe_point("PostFilter", time.perf_counter() - t_post)
         return outcomes
 
     def schedule_all_pending(

@@ -1,0 +1,70 @@
+"""Config validation (apis/config/validation analog) and metrics
+histograms (metrics/metrics.go analog)."""
+
+from dataclasses import replace
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import (
+    DEFAULT_PROFILE,
+    Profile,
+    ScoringStrategy,
+    validate_profile,
+)
+from kubernetes_tpu.framework.metrics import Histogram
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def test_default_profile_validates_clean():
+    assert validate_profile(DEFAULT_PROFILE) == []
+
+
+def test_validation_catches_violations():
+    bad = Profile(
+        name="",
+        filters=("NoSuchPlugin", "NodeResourcesFit"),
+        scorers=(("NodeResourcesFit", 0), ("NodeResourcesFit", 101)),
+        percentage_of_nodes_to_score=150,
+        scoring_strategy=ScoringStrategy(type="Bogus", resources=()),
+        hard_pod_affinity_weight=-1,
+    )
+    errs = validate_profile(bad)
+    joined = "\n".join(errs)
+    for needle in (
+        "profile.name", "NoSuchPlugin", "duplicate", "weight 0",
+        "percentage_of_nodes_to_score 150", "'Bogus' unknown",
+        "resources must be non-empty", "hard_pod_affinity_weight",
+    ):
+        assert needle in joined, (needle, errs)
+
+
+def test_ratio_shape_must_be_sorted():
+    p = replace(
+        DEFAULT_PROFILE,
+        scoring_strategy=ScoringStrategy(
+            type="RequestedToCapacityRatio", shape=((100, 0), (0, 10))
+        ),
+    )
+    assert any("shape" in e for e in validate_profile(p))
+
+
+def test_histogram_quantiles():
+    h = Histogram()
+    for v in [0.001] * 50 + [0.5] * 50:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 100
+    assert s["p50"] <= 0.01 < s["p99"]
+    assert abs(s["avg"] - 0.2505) < 1e-6
+
+
+def test_scheduler_records_extension_point_histograms():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    for i in range(4):
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    s.schedule_all_pending()
+    summary = s.metrics.registry.summary()
+    points = summary["extension_point_duration_seconds"]
+    assert points["Featurize"]["count"] >= 1
+    assert points["DevicePass"]["count"] >= 1
+    assert summary["pod_scheduling_sli_duration_seconds"]["count"] == 4
