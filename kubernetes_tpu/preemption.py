@@ -681,27 +681,16 @@ class PreemptionEvaluator:
         d_vfeat = {key_: jnp.asarray(a) for key_, a in vfeat.items()}
         d_pdb = jnp.asarray(vic_pdb)
         d_allowed = jnp.asarray(pdb_allowed)
-        out, d_state, d_vic_prio = self._pass(profile, active, n_pdbs, chunk)(
+        out, _final_state, _final_prio = self._pass(profile, active, n_pdbs, chunk)(
             state, batch, inv, jnp.asarray(vic_prio), d_vic_req,
             d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
         )
         picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)
-        # Strict re-run for chunk-deferred preemptors (same-node picks):
-        # sequential-equivalent against the committed carry.
-        deferred = np.nonzero(picks == -2)[0]
-        if deferred.size:
-            picks, kstars = picks.copy(), kstars.copy()
-            batch2 = dict(batch)
-            valid2 = np.zeros(k, np.bool_)
-            valid2[deferred] = batch["valid"][deferred]
-            batch2["valid"] = valid2
-            out2, _s, _p = self._pass(profile, active, n_pdbs, 1)(
-                d_state, batch2, inv, d_vic_prio, d_vic_req,
-                d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
-            )
-            p2, k2 = np.asarray(out2.picks), np.asarray(out2.k_star)
-            picks[deferred] = p2[deferred]
-            kstars[deferred] = k2[deferred]
+        # Chunk-deferred preemptors (same-node collisions, heterogeneous
+        # signatures, exhausted ranks) return None: the scheduler requeues
+        # them and the NEXT chunked pass — against post-eviction truth — is
+        # far cheaper than a sequential k-step re-scan here (the victims'
+        # delete events wake them).
 
         results: list[PreemptionResult | None] = []
         consumed: set[str] = set()
