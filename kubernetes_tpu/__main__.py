@@ -1,0 +1,177 @@
+"""CLI: the cmd/kube-scheduler analog (config load → validate → run).
+
+Subcommands:
+  validate <config.json>          strict config validation (apis/config/validation)
+  serve --socket PATH [...]       host the engine behind the sidecar protocol
+  bench [workload ...]            the scheduler_perf-style harness
+  dump --socket PATH              debugger state dump of a live sidecar
+
+Config file format (the KubeSchedulerConfiguration analog, JSON):
+  {
+    "profiles": [
+      {"name": "default-scheduler",
+       "filters": ["NodeResourcesFit", ...],
+       "scorers": [["NodeResourcesFit", 1], ...],
+       "percentage_of_nodes_to_score": 100,
+       "scoring_strategy": {"type": "LeastAllocated",
+                             "resources": [["cpu", 1], ["memory", 1]]}}
+    ],
+    "batch_size": 4096, "chunk_size": 64
+  }
+Omitted fields default like the in-tree defaults (default_plugins.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .framework.config import DEFAULT_PROFILE, Profile, ScoringStrategy, validate_profile
+
+
+_PROFILE_KEYS = {
+    "name", "filters", "scorers", "percentage_of_nodes_to_score",
+    "hard_pod_affinity_weight", "tie_break_seed", "scoring_strategy",
+}
+_TOP_KEYS = {"profiles", "batch_size", "chunk_size"}
+
+
+def load_config(path: str) -> dict:
+    """Load + STRICTLY parse a config file: unknown keys are errors (the
+    strict decoding the reference's scheme gives component configs)."""
+    with open(path) as f:
+        raw = json.load(f)
+    unknown = set(raw) - _TOP_KEYS
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    profiles = []
+    for p in raw.get("profiles", []):
+        bad = set(p) - _PROFILE_KEYS
+        if bad:
+            raise ValueError(
+                f"profile {p.get('name', '?')!r}: unknown keys {sorted(bad)}"
+            )
+        kwargs: dict = {}
+        if "name" in p:
+            kwargs["name"] = p["name"]
+        if "filters" in p:
+            kwargs["filters"] = tuple(p["filters"])
+        if "scorers" in p:
+            kwargs["scorers"] = tuple((n, int(w)) for n, w in p["scorers"])
+        if "percentage_of_nodes_to_score" in p:
+            kwargs["percentage_of_nodes_to_score"] = p["percentage_of_nodes_to_score"]
+        if "hard_pod_affinity_weight" in p:
+            kwargs["hard_pod_affinity_weight"] = p["hard_pod_affinity_weight"]
+        if "tie_break_seed" in p:
+            kwargs["tie_break_seed"] = p["tie_break_seed"]
+        if "scoring_strategy" in p:
+            ss = p["scoring_strategy"]
+            kwargs["scoring_strategy"] = ScoringStrategy(
+                type=ss.get("type", "LeastAllocated"),
+                resources=tuple(
+                    (n, int(w)) for n, w in ss.get("resources", [["cpu", 1], ["memory", 1]])
+                ),
+                shape=tuple(
+                    (int(u), int(s)) for u, s in ss.get("shape", [[0, 0], [100, 10]])
+                ),
+            )
+        profiles.append(Profile(**kwargs))
+    return {
+        "profiles": profiles or [DEFAULT_PROFILE],
+        "batch_size": int(raw.get("batch_size", 256)),
+        "chunk_size": int(raw.get("chunk_size", 1)),
+    }
+
+
+def cmd_validate(args) -> int:
+    try:
+        cfg = load_config(args.config)
+    except ValueError as exc:
+        print(f"config: {exc}")
+        return 1
+    bad = 0
+    if cfg["batch_size"] % cfg["chunk_size"]:
+        print(
+            f"batch_size {cfg['batch_size']} is not a multiple of "
+            f"chunk_size {cfg['chunk_size']}"
+        )
+        bad += 1
+    for p in cfg["profiles"]:
+        errs = validate_profile(p)
+        for e in errs:
+            print(f"{p.name}: {e}")
+        bad += len(errs)
+    print(f"{len(cfg['profiles'])} profile(s), {bad} violation(s)")
+    return 1 if bad else 0
+
+
+def cmd_serve(args) -> int:
+    from .scheduler import TPUScheduler
+    from .sidecar import SidecarServer
+
+    if args.config:
+        cfg = load_config(args.config)
+        profiles = cfg["profiles"]
+        sched = TPUScheduler(
+            profile=profiles[0],
+            profiles=profiles[1:],
+            batch_size=cfg["batch_size"],
+            chunk_size=cfg["chunk_size"],
+        )
+    else:
+        sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)
+    srv = SidecarServer(args.socket, scheduler=sched)
+    print(f"sidecar listening on {args.socket}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.close()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from .benchmarks.harness import main as bench_main
+
+    bench_main(args.workloads or None)
+    return 0
+
+
+def cmd_dump(args) -> int:
+    from .sidecar import SidecarClient
+
+    client = SidecarClient(args.socket)
+    print(json.dumps(client.dump(), indent=2, sort_keys=True))
+    client.close()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="validate a scheduler config file")
+    v.add_argument("config")
+    v.set_defaults(fn=cmd_validate)
+
+    s = sub.add_parser("serve", help="serve the sidecar protocol")
+    s.add_argument("--socket", required=True)
+    s.add_argument("--config", default="")
+    s.add_argument("--batch-size", type=int, default=256)
+    s.add_argument("--chunk-size", type=int, default=1)
+    s.set_defaults(fn=cmd_serve)
+
+    b = sub.add_parser("bench", help="run benchmark workloads")
+    b.add_argument("workloads", nargs="*")
+    b.set_defaults(fn=cmd_bench)
+
+    d = sub.add_parser("dump", help="debugger dump of a live sidecar")
+    d.add_argument("--socket", required=True)
+    d.set_defaults(fn=cmd_dump)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

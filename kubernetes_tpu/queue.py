@@ -364,3 +364,14 @@ class SchedulingQueue:
     def done(self, uid: str) -> None:
         """Pod scheduled successfully; drop bookkeeping."""
         self._info.pop(uid, None)
+
+    def dump(self) -> dict:
+        """Queue state for the debugger dump (keeps the privates here)."""
+        return {
+            "active": len(self._in_active),
+            "backoff": len(self._backoff),
+            "pending": self.pending_count(),
+            "unschedulable": len(self._unschedulable),
+            "gated": len(self._gated),
+            "gang_pool": {g: sorted(p) for g, p in self._gang_pool.items()},
+        }

@@ -78,6 +78,7 @@ class TPUScheduler:
         chunk_size: int = 1,
         profiles: list[Profile] | None = None,
         extenders: list | None = None,
+        consistency_check_every: int = 0,
     ):
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
@@ -148,6 +149,9 @@ class TPUScheduler:
                 "chunk_size=1 (sequential-equivalent scan)"
             )
         self._eval_passes: dict = {}  # extender path: per-profile eval pass
+        # Periodic host↔device comparer (the cache debugger's SIGUSR2 check
+        # run on a schedule): 0 = disabled.
+        self.consistency_check_every = consistency_check_every
         # Prefetched next batch: (infos, featurize work) — schedule_batch
         # featurizes batch k+1 while the device crunches batch k.
         self._prefetched: tuple | None = None
@@ -363,6 +367,44 @@ class TPUScheduler:
 
     # -- scheduling ------------------------------------------------------------
 
+    def dump_state(self) -> dict:
+        """Debugger dump (backend/cache/debugger CacheDumper.DumpAll): per-
+        node pod counts, queue depths, gang/nominator state, and the
+        host↔device mirror comparison."""
+        return {
+            "nodes": {
+                name: {
+                    "row": rec.row,
+                    "pods": sorted(rec.pods),
+                    "zone": rec.zone,
+                }
+                for name, rec in self.cache.nodes.items()
+            },
+            "pods": {
+                uid: {"node": pr.node_name, "assumed": pr.assumed, "bound": pr.bound}
+                for uid, pr in self.cache.pods.items()
+            },
+            "queue": self.queue.dump(),
+            "gang_bound": dict(self.gang_bound),
+            "nominated": {u: n for u, (n, _d, _p) in self.nominator.items()},
+            "permit_waiting": {
+                g: [e[0].pod.uid for e in lst]
+                for g, lst in self.permit_waiting.items()
+            },
+            "mirror_equal": self.builder.host_mirror_equal(),
+            "metrics": self.metrics.registry.summary(),
+        }
+
+    def check_consistency(self) -> None:
+        """The cache comparer (debugger/comparer.go): verify the host
+        staging arrays and the device mirror agree.  Called every
+        ``consistency_check_every`` batches when configured.  Raises (not
+        assert — the configured comparer must survive ``python -O``)."""
+        if not self.builder.host_mirror_equal():
+            raise RuntimeError(
+                "host/device mirror divergence — dump_state() for details"
+            )
+
     def expire_waiting_gangs(self, timeout_s: float | None = None) -> int:
         """WaitOnPermit timeout: forget and re-park members of gangs whose
         missing peers never arrived (framework.go:1503 WaitOnPermit;
@@ -483,6 +525,11 @@ class TPUScheduler:
         m.scheduled += 1
         m.last_scheduled_ts = now
         m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
+        if (
+            self.consistency_check_every
+            and m.batches % self.consistency_check_every == 0
+        ):
+            self.check_consistency()
         return ScheduleOutcome(qp.pod, best, combined[best], len(nodes))
 
     def _full_inv(self) -> dict:
@@ -994,6 +1041,12 @@ class TPUScheduler:
             self.queue.on_event(Event.POD_DELETE)
         if ran_postfilter:
             m.registry.observe_point("PostFilter", time.perf_counter() - t_post)
+        if (
+            self.consistency_check_every
+            and m.batches % self.consistency_check_every == 0
+        ):
+            # Quiescent point: host assume/forget deltas all applied.
+            self.check_consistency()
         return outcomes
 
     def schedule_all_pending(

@@ -134,6 +134,10 @@ def _dispatch(sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope) -> None:
         else:
             raise ValueError(f"cannot remove kind {env.remove.kind}")
         out.response.SetInParent()
+    elif kind == "dump":
+        import json
+
+        out.response.dump_json = json.dumps(sched.dump_state()).encode()
     elif kind == "schedule":
         for raw in env.schedule.pod_json:
             sched.add_pod(serialize.pod_from_json(raw))
@@ -203,6 +207,14 @@ class SidecarClient:
         env.remove.kind = kind
         env.remove.uid = uid
         self._call(env)
+
+    def dump(self) -> dict:
+        """Debugger state dump of the live scheduler (the SIGUSR2 analog)."""
+        import json
+
+        env = pb.Envelope()
+        env.dump.SetInParent()
+        return json.loads(self._call(env).response.dump_json)
 
     def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
         env = pb.Envelope()

@@ -68,3 +68,43 @@ def test_scheduler_records_extension_point_histograms():
     assert points["Featurize"]["count"] >= 1
     assert points["DevicePass"]["count"] >= 1
     assert summary["pod_scheduling_sli_duration_seconds"]["count"] == 4
+
+
+def test_cli_validate_and_config_load(tmp_path):
+    import json
+
+    from kubernetes_tpu.__main__ import load_config, main
+
+    cfg = tmp_path / "sched.json"
+    cfg.write_text(json.dumps({
+        "profiles": [
+            {"name": "a", "filters": ["NodeResourcesFit"],
+             "scorers": [["NodeResourcesFit", 1]]},
+            {"name": "b"},
+        ],
+        "batch_size": 128,
+        "chunk_size": 32,
+    }))
+    loaded = load_config(str(cfg))
+    assert [p.name for p in loaded["profiles"]] == ["a", "b"]
+    assert loaded["batch_size"] == 128 and loaded["chunk_size"] == 32
+    assert main(["validate", str(cfg)]) == 0
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "profiles": [{"name": "x", "filters": ["NoSuchPlugin"]}]
+    }))
+    assert main(["validate", str(bad)]) == 1
+
+
+def test_dump_state_and_consistency_check():
+    s = TPUScheduler(batch_size=8, consistency_check_every=1)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    s.schedule_all_pending()  # the per-batch comparer runs and passes
+    d = s.dump_state()
+    assert d["mirror_equal"] is True
+    assert d["nodes"]["n1"]["pods"] == ["default/p"]
+    assert d["pods"]["default/p"]["bound"] is True
+    assert d["queue"]["pending"] == 0
+    s.check_consistency()
