@@ -76,6 +76,12 @@ def run_workload(w: Workload) -> dict:
     scheduled = 0
     batch_i = 0
     while True:
+        # stopCollectingMetrics semantics (scheduler_perf.go): the clock
+        # stops when every measured pod is scheduled; background churn
+        # (woken unschedulable pods re-failing) continues outside the
+        # measured window, exactly as upstream's collector treats it.
+        if scheduled >= expected:
+            break
         out = sched.schedule_batch()
         if not out:
             if len(sched.queue) or sched._prefetched is not None:
