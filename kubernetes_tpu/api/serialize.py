@@ -85,7 +85,11 @@ KINDS: dict[str, tuple[type, str]] = {
     # events and falls back to add for unknown nodes — upserts over the
     # wire must not fire NODE_ADD per heartbeat.
     "Node": (t.Node, "update_node"),
-    "Pod": (t.Pod, "add_pod"),
+    # update_pod diffs against the cached/queued record (no-op for
+    # status-only re-deliveries) and falls back to add for unknown pods —
+    # re-running add_pod per watch upsert would double-apply a bound pod's
+    # resource delta and gang quorum credit (ADVICE r2).
+    "Pod": (t.Pod, "update_pod"),
     "PersistentVolume": (t.PersistentVolume, "add_pv"),
     "PersistentVolumeClaim": (t.PersistentVolumeClaim, "add_pvc"),
     "StorageClass": (t.StorageClass, "add_storage_class"),

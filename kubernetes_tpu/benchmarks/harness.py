@@ -473,8 +473,12 @@ def _preemption_warm(s: TPUScheduler):
             make_pod(f"bg-{i}").req({"cpu": "1", "memory": "2Gi"}).priority(1)
             .start_time(float(i)).obj()
         )
-    # One warm preemptor so the preemption pass compiles during warmup, not
-    # inside the measured window (its victims are part of warmup state).
+    # Drain the background fill FIRST, then add the warm preemptor: a
+    # high-priority pod pops ahead of everything (QueueSort), so added
+    # together it would bind to a still-empty node and the preemption pass
+    # would pay its XLA compile inside the measured window (r2: the
+    # 1.9s PostFilter outlier in preemption_async).
+    s.schedule_all_pending(wait_backoff=True)
     s.add_pod(
         make_pod("warm-vip").req({"cpu": "2", "memory": "4Gi"}).priority(1000).obj()
     )
@@ -803,6 +807,7 @@ def _preemption_pv_warm(s: TPUScheduler):
             make_pod(f"bg-{i}").req({"cpu": "1", "memory": "2Gi"}).priority(1)
             .start_time(float(i)).pvc_volume(f"bgclaim-{i}").obj()
         )
+    s.schedule_all_pending(wait_backoff=True)  # see _preemption_warm
     s.add_pod(
         make_pod("warm-vip").req({"cpu": "2", "memory": "4Gi"}).priority(1000).obj()
     )
@@ -828,6 +833,9 @@ def _preemption_async_warm(s: TPUScheduler):
             make_pod(f"bg-{i}").req({"cpu": "3900m", "memory": "15Gi"}).priority(1)
             .start_time(float(i)).obj()
         )
+    # Drain first so the warm preemptor finds full nodes and actually
+    # compiles the preemption pass + nominated-retry path in warmup.
+    s.schedule_all_pending(wait_backoff=True)
     s.add_pod(
         make_pod("warm-vip").req({"cpu": "2", "memory": "4Gi"}).priority(1000).obj()
     )

@@ -224,6 +224,20 @@ class Cache:
             rec.pods.pop(uid, None)
             self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
 
+    def update_pod(self, pod: t.Pod) -> None:
+        """Re-apply a cached pod's row delta after an object update
+        (cache.go updatePod: removePod + addPod).  The device mirror's
+        group/term/port counts follow through apply_pod_delta, so a bound
+        pod's label change rewrites the node's domain tensors."""
+        pr = self.pods[pod.uid]
+        rec = self.nodes[pr.node_name]
+        self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
+        delta = self.builder.pod_delta_vectors(pod)
+        pr.pod = pod
+        pr.delta = delta
+        rec.pods[pod.uid] = pod
+        self.builder.apply_pod_delta(rec.row, delta, +1, device_already=False)
+
     def cleanup_assumed(self, ttl_s: float = 30.0) -> list[str]:
         """Expire assumed-but-never-bound pods (cache.go:730 cleanupAssumedPods)."""
         now = time.monotonic()

@@ -368,8 +368,12 @@ def build_preempt_pass(
         def sat(x, bits):
             return jnp.clip(x.astype(jnp.int64), 0, (1 << bits) - 1)
 
+        # 7-bit violations field: 127<<55 = 2^62 − 2^55 keeps every packed
+        # key strictly below the infeasible sentinel 2^62 (8 bits saturated
+        # at 255<<55 ≈ 9.2e18 would overflow past it, silently hiding
+        # feasible nodes with ≥128 violations).
         key = (
-            (sat(violations, 8) << 55)
+            (sat(violations, 7) << 55)
             | (sat(max_prio.astype(jnp.int64) + 1, 21) << 34)
             | (sat(prio_sum >> 6, 14) << 20)
             | (sat(n_vic, 8) << 12)
@@ -585,7 +589,11 @@ class PreemptionEvaluator:
             )
             per_node[rec.row] = vics
             vmax = max(vmax, len(vics))
-        v = _bucket(vmax, 1)
+        # Floor 8: the victim axis stays one shape across the common range,
+        # so a node gaining a pod mid-run (vmax 1→2) doesn't recompile the
+        # pass and re-negotiate every transfer layout inside the measured
+        # window (~15ms/array first-shape cost through the tunnel).
+        v = _bucket(vmax)
         n = schema.N
         vic_prio = np.full((n, v), I32_MAX, np.int32)
         vic_req = np.zeros((n, v, schema.R), np.int64)
@@ -602,7 +610,7 @@ class PreemptionEvaluator:
         )
         vfeat: dict[str, np.ndarray] = {}
         if names & {"InterPodAffinity", "PodTopologySpread"}:
-            ts = _bucket(
+            ts = _bucket(  # floor 8: shape-stable like the victim axis
                 max(
                     (
                         len(cache.pods[p.uid].delta["own_terms"])
@@ -611,7 +619,6 @@ class PreemptionEvaluator:
                     ),
                     default=1,
                 ),
-                1,
             )
             vfeat["group"] = np.full((n, v), -1, np.int32)
             vfeat["terms"] = np.full((n, v, ts), -1, np.int32)
@@ -675,14 +682,18 @@ class PreemptionEvaluator:
         chunk = max(1, min(chunk, k))
         while k % chunk:
             chunk //= 2
-        d_vic_req = jnp.asarray(vic_req)
-        d_vic_nonzero = jnp.asarray(vic_nonzero)
-        d_vic_start = jnp.asarray(vic_start)
-        d_vfeat = {key_: jnp.asarray(a) for key_, a in vfeat.items()}
-        d_pdb = jnp.asarray(vic_pdb)
-        d_allowed = jnp.asarray(pdb_allowed)
+        # ONE coalesced host→device transfer for every input (per-array
+        # device_put costs a full tunnel round trip when the device is busy;
+        # already-on-device leaves — e.g. the scheduler's inv — pass through).
+        (
+            batch_d, inv_d, d_prio, d_vic_req, d_vic_nonzero, d_vic_start,
+            d_vfeat, d_pdb, d_allowed,
+        ) = jax.device_put(
+            (batch, inv, vic_prio, vic_req, vic_nonzero, vic_start, vfeat,
+             vic_pdb, pdb_allowed)
+        )
         out, _final_state, _final_prio = self._pass(profile, active, n_pdbs, chunk)(
-            state, batch, inv, jnp.asarray(vic_prio), d_vic_req,
+            state, batch_d, inv_d, d_prio, d_vic_req,
             d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
         )
         picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)

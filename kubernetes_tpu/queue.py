@@ -19,6 +19,8 @@ import time
 from dataclasses import dataclass, field
 from enum import IntFlag, auto
 
+import numpy as np
+
 from .api import types as t
 
 
@@ -67,6 +69,47 @@ DEFAULT_POD_MAX_BACKOFF_S = 10.0
 DEFAULT_MAX_UNSCHEDULABLE_DURATION_S = 300.0
 
 
+@dataclass
+class EventCtx:
+    """Event-object payload for object-aware queueing hints — the batch
+    analog of the oldObj/newObj arguments the reference passes to each
+    plugin's QueueingHintFn (scheduling_queue.go:406 isPodWorthRequeuing;
+    e.g. fit.go:253 isSchedulableAfterPodChange checks whether the deleted
+    pod actually frees enough for the waiting pod).
+
+    ``max_free``/``max_slots`` summarize capacity freed or added by the
+    event, elementwise-maxed over every affected node (nominated pods'
+    claims already subtracted).  The max is an upper bound on any single
+    node's free vector, so hints stay conservative: a pod that fits some
+    affected node always fits the max and is woken; a pod that cannot fit
+    the max cannot fit anywhere and is skipped."""
+
+    max_free: np.ndarray | None = None  # (R,) free allocatable upper bound
+    max_slots: int = 0  # free pod slots upper bound
+
+
+def _fit_hint(qp: "QueuedPodInfo", event: "Event", ctx: EventCtx) -> bool:
+    """NodeResourcesFit QueueingHint (fit.go:253 isSchedulableAfterPodChange
+    / :300 isSchedulableAfterNodeChange): requeue only when the event's
+    freed/added capacity could actually seat this pod."""
+    if ctx.max_free is None or qp.delta is None:
+        return True  # no object info — conservative requeue
+    if ctx.max_slots < 1:
+        return False
+    req = qp.delta["req"]
+    r = min(req.shape[0], ctx.max_free.shape[0])
+    if req.shape[0] > r and req[r:].any():
+        return False  # needs a resource the affected nodes don't expose
+    return bool((req[:r] <= ctx.max_free[:r]).all())
+
+
+# Object-aware per-plugin hints; plugins absent here fall back to the static
+# event-mask behavior (PLUGIN_REQUEUE_EVENTS alone).
+PLUGIN_HINTS = {
+    "NodeResourcesFit": _fit_hint,
+}
+
+
 @dataclass(order=False)
 class QueuedPodInfo:
     """Mirror of framework.QueuedPodInfo (types.go:362)."""
@@ -77,6 +120,10 @@ class QueuedPodInfo:
     attempts: int = 0
     unschedulable_plugins: set[str] = field(default_factory=set)
     gated: bool = False
+    # The pod's featurized commit delta from its last attempt (request
+    # vector etc.) — the object-aware hints read it; None before the first
+    # attempt or after a spec update invalidated it.
+    delta: dict | None = None
 
 
 class SchedulingQueue:
@@ -314,15 +361,26 @@ class SchedulingQueue:
 
     # -- events ----------------------------------------------------------------
 
-    def on_event(self, event: Event) -> int:
+    def _worth_requeuing(self, qp: QueuedPodInfo, event: Event, ctx: EventCtx | None) -> bool:
+        """isPodWorthRequeuing (scheduling_queue.go:406): the pod requeues
+        when ANY plugin that rejected it (a) registered for this event kind
+        and (b) — when an object-aware hint and event payload exist — says
+        the event object could actually unblock it."""
+        for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
+            if not (PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY) & event):
+                continue
+            hint = PLUGIN_HINTS.get(pl)
+            if hint is None or ctx is None or hint(qp, event, ctx):
+                return True
+        return False
+
+    def on_event(self, event: Event, ctx: EventCtx | None = None) -> int:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1029): wake
-        unschedulable pods whose rejecting plugins care about this event."""
+        unschedulable pods whose rejecting plugins care about this event
+        (filtered through the object-aware hints when ``ctx`` is given)."""
         woken = []
         for uid, qp in self._unschedulable.items():
-            interested = Event(0)
-            for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
-                interested |= PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY)
-            if interested & event:
+            if self._worth_requeuing(qp, event, ctx):
                 woken.append(uid)
         for uid in woken:
             qp = self._unschedulable.pop(uid)
@@ -340,6 +398,29 @@ class SchedulingQueue:
             if interested & event and self._try_admit_gang(g, via_backoff=True):
                 woken.append(g)
         return len(woken)
+
+    def update(self, pod: t.Pod) -> None:
+        """updatePodInSchedulingQueue (eventhandlers.go:136): refresh the
+        queued object; a scheduling-relevant change (labels, spec) to an
+        unschedulable pod may have made it schedulable — move it straight to
+        activeQ (the reference's isPodUpdated → queue.Update path).  Pods in
+        activeQ/backoffQ just get the fresher object."""
+        qp = self._info.get(pod.uid)
+        if qp is None:
+            self.add(pod)
+            return
+        changed = (
+            qp.pod.metadata.labels != pod.metadata.labels
+            or qp.pod.spec != pod.spec
+        )
+        qp.pod = pod
+        if qp.gated and not pod.spec.scheduling_gates:
+            self.remove_gate(pod.uid)
+            return
+        if changed:
+            qp.delta = None  # featurization delta is stale
+            if pod.uid in self._unschedulable:
+                self._push_active(qp)
 
     def remove_gate(self, uid: str) -> None:
         """A pod's scheduling gates were cleared; admit it."""

@@ -628,17 +628,26 @@ class SnapshotBuilder:
             return self._device
         if self._dirty_rows:
             rows = np.fromiter(self._dirty_rows, np.int32)
-            # Pad to a bucket so jit sees few distinct shapes; padding repeats
-            # row[0] (idempotent scatter of identical values).
-            padded = np.full(_bucket(len(rows)), rows[0], np.int32)
-            padded[: len(rows)] = rows
-            updates0 = {
-                k: self.host[k][padded] for k, ax in _NODE_AXIS.items() if ax == 0
-            }
-            updates1 = {
-                k: self.host[k][:, padded] for k, ax in _NODE_AXIS.items() if ax == 1
-            }
-            self._device = _scatter_rows(self._device, jnp.asarray(padded), updates0, updates1)
+            # FIXED chunk shape so the scatter compiles exactly once per
+            # schema (a per-bucket shape costs a fresh ~0.5s XLA compile the
+            # first time a workload dirties that many rows — inside the
+            # measured window for preemption bursts).  Padding repeats
+            # row[0] (idempotent scatter of identical values); scattering
+            # 1024 rows when few are dirty is trivial device work.
+            CH = 1024
+            for lo in range(0, len(rows), CH):
+                sl = rows[lo : lo + CH]
+                padded = np.full(CH, sl[0], np.int32)
+                padded[: len(sl)] = sl
+                updates0 = {
+                    k: self.host[k][padded] for k, ax in _NODE_AXIS.items() if ax == 0
+                }
+                updates1 = {
+                    k: self.host[k][:, padded] for k, ax in _NODE_AXIS.items() if ax == 1
+                }
+                # One coalesced transfer for index + all update arrays.
+                idx_d, up0_d, up1_d = jax.device_put((padded, updates0, updates1))
+                self._device = _scatter_rows(self._device, idx_d, up0_d, up1_d)
             self._dirty_rows.clear()
         return self._device
 

@@ -1,0 +1,42 @@
+"""Profile the preemption_async measured window (where does non-device time go)."""
+
+import cProfile
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubernetes_tpu.benchmarks.harness import WORKLOADS
+
+w = WORKLOADS["preemption_async_5kn"]
+s = w.build()
+w.nodes(s)
+w.warmup(s)
+s.schedule_all_pending(wait_backoff=True)
+s.warm_tail()
+m = s.metrics
+m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
+m.device_time_s = m.featurize_time_s = 0.0
+
+expected = w.measured(s)
+t0 = time.perf_counter()
+prof = cProfile.Profile()
+prof.enable()
+scheduled = 0
+while scheduled < expected:
+    out = s.schedule_batch()
+    if not out:
+        if len(s.queue) or s._prefetched is not None:
+            continue
+        if s.queue.sleep_until_backoff():
+            continue
+        break
+    scheduled += sum(1 for o in out if o.node_name)
+prof.disable()
+dt = time.perf_counter() - t0
+print(f"scheduled={scheduled} dt={dt:.2f}s device={m.device_time_s:.2f}s "
+      f"featurize={m.featurize_time_s:.2f}s batches={m.batches}", file=sys.stderr)
+stats = pstats.Stats(prof, stream=sys.stderr)
+stats.sort_stats("cumulative").print_stats(30)
