@@ -1,0 +1,25 @@
+// kube-scheduler-tpu: an UNMODIFIED kube-scheduler binary with the
+// TPUBatchScore plugin registered out-of-tree — the exact pattern the
+// reference exposes for this purpose (cmd/kube-scheduler/app/server.go:80
+// NewSchedulerCommand + WithPlugin → WithFrameworkOutOfTreeRegistry,
+// pkg/scheduler/scheduler.go:195).  No in-tree code is modified; the TPU
+// backend is selected purely through KubeSchedulerConfiguration (see
+// ../../tpubatchscore/plugin.go for the profile snippet).
+package main
+
+import (
+	"os"
+
+	"k8s.io/component-base/cli"
+	"k8s.io/kubernetes/cmd/kube-scheduler/app"
+
+	"tpu-scheduler/tpubatchscore"
+)
+
+func main() {
+	command := app.NewSchedulerCommand(
+		app.WithPlugin(tpubatchscore.Name, tpubatchscore.New),
+	)
+	code := cli.Run(command)
+	os.Exit(code)
+}

@@ -1,0 +1,83 @@
+// client.go: framed-socket sidecar client.  One connection, serialized
+// request/response (the sidecar is a sequential state machine; the
+// scheduler's own cycle is too — schedule_one.go runs one pod at a time).
+package tpubatchscore
+
+import (
+	"fmt"
+	"net"
+	"sync"
+)
+
+// Client speaks the sidecar protocol over a unix-domain (or TCP) socket.
+type Client struct {
+	mu   sync.Mutex
+	conn net.Conn
+	seq  uint64
+}
+
+// Dial connects to the sidecar.  network is "unix" or "tcp".
+func Dial(network, addr string) (*Client, error) {
+	conn, err := net.Dial(network, addr)
+	if err != nil {
+		return nil, err
+	}
+	return &Client{conn: conn}, nil
+}
+
+func (c *Client) Close() error { return c.conn.Close() }
+
+// call sends one envelope and waits for its response.
+func (c *Client) call(env *Envelope) (*Response, error) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	c.seq++
+	env.Seq = c.seq
+	if err := WriteFrame(c.conn, env); err != nil {
+		return nil, err
+	}
+	resp, err := ReadFrame(c.conn)
+	if err != nil {
+		return nil, err
+	}
+	if resp.Seq != env.Seq {
+		return nil, fmt.Errorf("seq mismatch: sent %d got %d", env.Seq, resp.Seq)
+	}
+	if resp.Response == nil {
+		return nil, fmt.Errorf("response envelope missing response message")
+	}
+	if resp.Response.Error != "" {
+		return nil, fmt.Errorf("sidecar: %s", resp.Response.Error)
+	}
+	return resp.Response, nil
+}
+
+// AddObject upserts a cluster object (Node, Pod, PersistentVolume, …).
+func (c *Client) AddObject(kind string, objectJSON []byte) error {
+	_, err := c.call(&Envelope{Add: &AddObject{Kind: kind, ObjectJSON: objectJSON}})
+	return err
+}
+
+// RemoveObject deletes a Node or Pod by uid.
+func (c *Client) RemoveObject(kind, uid string) error {
+	_, err := c.call(&Envelope{Remove: &RemoveObject{Kind: kind, UID: uid}})
+	return err
+}
+
+// Schedule submits unassigned pods and returns their results.
+func (c *Client) Schedule(podJSON [][]byte, drain bool) ([]PodResult, error) {
+	resp, err := c.call(&Envelope{Schedule: &ScheduleBatchRequest{PodJSON: podJSON, Drain: drain}})
+	if err != nil {
+		return nil, err
+	}
+	return resp.Results, nil
+}
+
+// Dump fetches the sidecar's debugger state (cache/queue/mirror check).
+func (c *Client) Dump() ([]byte, error) {
+	resp, err := c.call(&Envelope{Dump: &DumpRequest{}})
+	if err != nil {
+		return nil, err
+	}
+	return resp.DumpJSON, nil
+}

@@ -1,0 +1,439 @@
+// convert.go: v1.Pod / v1.Node → the sidecar's canonical JSON object model
+// (kubernetes_tpu/api/types.py dataclasses, snake_case fields, quantities
+// canonicalized to integer units: CPU in millicores, everything else in
+// base units — exactly what types.py parse_quantity produces, so the
+// sidecar's from_json consumes these without a parse step).
+//
+// Only the scheduler-consumed subset is converted (the same subset
+// api/types.py models); unknown fields on the sidecar side default.
+package tpubatchscore
+
+import (
+	"encoding/json"
+
+	v1 "k8s.io/api/core/v1"
+)
+
+// --- canonical JSON shapes (mirror api/types.py) ---------------------------
+
+type jMeta struct {
+	Annotations map[string]string `json:"annotations"`
+	Labels      map[string]string `json:"labels"`
+	Name        string            `json:"name"`
+	Namespace   string            `json:"namespace"`
+	UID         string            `json:"uid"`
+}
+
+type jSelectorReq struct {
+	Key      string   `json:"key"`
+	Operator string   `json:"operator"`
+	Values   []string `json:"values"`
+}
+
+type jNodeSelectorTerm struct {
+	MatchExpressions []jSelectorReq `json:"match_expressions"`
+	MatchFields      []jSelectorReq `json:"match_fields"`
+}
+
+type jNodeSelector struct {
+	Terms []jNodeSelectorTerm `json:"terms"`
+}
+
+type jPreferredSchedulingTerm struct {
+	Preference jNodeSelectorTerm `json:"preference"`
+	Weight     int32             `json:"weight"`
+}
+
+type jNodeAffinity struct {
+	Preferred []jPreferredSchedulingTerm `json:"preferred"`
+	Required  *jNodeSelector             `json:"required"`
+}
+
+type jLabelSelector struct {
+	MatchExpressions []jSelectorReq `json:"match_expressions"`
+	MatchLabels      [][2]string    `json:"match_labels"`
+}
+
+type jPodAffinityTerm struct {
+	LabelSelector     *jLabelSelector `json:"label_selector"`
+	NamespaceSelector *jLabelSelector `json:"namespace_selector"`
+	Namespaces        []string        `json:"namespaces"`
+	TopologyKey       string          `json:"topology_key"`
+}
+
+type jWeightedPodAffinityTerm struct {
+	Term   jPodAffinityTerm `json:"term"`
+	Weight int32            `json:"weight"`
+}
+
+type jPodAffinity struct {
+	Preferred []jWeightedPodAffinityTerm `json:"preferred"`
+	Required  []jPodAffinityTerm         `json:"required"`
+}
+
+type jAffinity struct {
+	NodeAffinity    *jNodeAffinity `json:"node_affinity"`
+	PodAffinity     *jPodAffinity  `json:"pod_affinity"`
+	PodAntiAffinity *jPodAffinity  `json:"pod_anti_affinity"`
+}
+
+type jToleration struct {
+	Effect   string `json:"effect"`
+	Key      string `json:"key"`
+	Operator string `json:"operator"`
+	Value    string `json:"value"`
+}
+
+type jSpreadConstraint struct {
+	LabelSelector      *jLabelSelector `json:"label_selector"`
+	MaxSkew            int32           `json:"max_skew"`
+	MinDomains         *int32          `json:"min_domains"`
+	NodeAffinityPolicy string          `json:"node_affinity_policy"`
+	NodeTaintsPolicy   string          `json:"node_taints_policy"`
+	TopologyKey        string          `json:"topology_key"`
+	WhenUnsatisfiable  string          `json:"when_unsatisfiable"`
+}
+
+type jContainerPort struct {
+	ContainerPort int32  `json:"container_port"`
+	HostIP        string `json:"host_ip"`
+	HostPort      int32  `json:"host_port"`
+	Protocol      string `json:"protocol"`
+}
+
+type jContainer struct {
+	Images        []string         `json:"images"`
+	Limits        map[string]int64 `json:"limits"`
+	Name          string           `json:"name"`
+	Ports         []jContainerPort `json:"ports"`
+	Requests      map[string]int64 `json:"requests"`
+	RestartPolicy *string          `json:"restart_policy"`
+}
+
+type jSchedulingGate struct {
+	Name string `json:"name"`
+}
+
+type jVolume struct {
+	DeviceID string `json:"device_id"`
+	Name     string `json:"name"`
+	PVC      string `json:"pvc"`
+	ReadOnly bool   `json:"read_only"`
+}
+
+type jPodSpec struct {
+	Affinity                  *jAffinity          `json:"affinity"`
+	Containers                []jContainer        `json:"containers"`
+	InitContainers            []jContainer        `json:"init_containers"`
+	NodeName                  string              `json:"node_name"`
+	NodeSelector              map[string]string   `json:"node_selector"`
+	Overhead                  map[string]int64    `json:"overhead"`
+	PodGroup                  string              `json:"pod_group"`
+	PreemptionPolicy          string              `json:"preemption_policy"`
+	Priority                  int32               `json:"priority"`
+	ResourceClaims            []string            `json:"resource_claims"`
+	SchedulerName             string              `json:"scheduler_name"`
+	SchedulingGates           []jSchedulingGate   `json:"scheduling_gates"`
+	Tolerations               []jToleration       `json:"tolerations"`
+	TopologySpreadConstraints []jSpreadConstraint `json:"topology_spread_constraints"`
+	Volumes                   []jVolume           `json:"volumes"`
+}
+
+type jPodStatus struct {
+	NominatedNodeName string  `json:"nominated_node_name"`
+	Phase             string  `json:"phase"`
+	StartTime         float64 `json:"start_time"`
+}
+
+type jPod struct {
+	Metadata jMeta      `json:"metadata"`
+	Spec     jPodSpec   `json:"spec"`
+	Status   jPodStatus `json:"status"`
+}
+
+type jTaint struct {
+	Effect string `json:"effect"`
+	Key    string `json:"key"`
+	Value  string `json:"value"`
+}
+
+type jNodeSpec struct {
+	Taints        []jTaint `json:"taints"`
+	Unschedulable bool     `json:"unschedulable"`
+}
+
+type jContainerImage struct {
+	Names     []string `json:"names"`
+	SizeBytes int64    `json:"size_bytes"`
+}
+
+type jNodeStatus struct {
+	Allocatable map[string]int64 `json:"allocatable"`
+	Capacity    map[string]int64 `json:"capacity"`
+	Images      []jContainerImage `json:"images"`
+}
+
+type jNode struct {
+	Metadata jMeta       `json:"metadata"`
+	Spec     jNodeSpec   `json:"spec"`
+	Status   jNodeStatus `json:"status"`
+}
+
+// --- conversion ------------------------------------------------------------
+
+// canonQty canonicalizes a resource list: CPU → millicores, everything
+// else → base-unit integers (types.py parse_quantity's output format).
+func canonQty(rl v1.ResourceList) map[string]int64 {
+	out := map[string]int64{}
+	for name, q := range rl {
+		if name == v1.ResourceCPU {
+			out[string(name)] = q.MilliValue()
+		} else {
+			out[string(name)] = q.Value()
+		}
+	}
+	return out
+}
+
+func convSelectorReqs(reqs []v1.NodeSelectorRequirement) []jSelectorReq {
+	out := make([]jSelectorReq, 0, len(reqs))
+	for _, r := range reqs {
+		out = append(out, jSelectorReq{Key: r.Key, Operator: string(r.Operator), Values: r.Values})
+	}
+	return out
+}
+
+func convLabelSelector(s *v1.LabelSelector) *jLabelSelector {
+	if s == nil {
+		return nil
+	}
+	out := &jLabelSelector{MatchLabels: [][2]string{}}
+	for k, v := range s.MatchLabels {
+		out.MatchLabels = append(out.MatchLabels, [2]string{k, v})
+	}
+	for _, e := range s.MatchExpressions {
+		vals := append([]string(nil), e.Values...)
+		out.MatchExpressions = append(out.MatchExpressions, jSelectorReq{
+			Key: e.Key, Operator: string(e.Operator), Values: vals,
+		})
+	}
+	return out
+}
+
+func convPodAffinityTerms(terms []v1.PodAffinityTerm) []jPodAffinityTerm {
+	out := make([]jPodAffinityTerm, 0, len(terms))
+	for _, t := range terms {
+		out = append(out, jPodAffinityTerm{
+			LabelSelector:     convLabelSelector(t.LabelSelector),
+			NamespaceSelector: convLabelSelector(t.NamespaceSelector),
+			Namespaces:        t.Namespaces,
+			TopologyKey:       t.TopologyKey,
+		})
+	}
+	return out
+}
+
+func convWeighted(terms []v1.WeightedPodAffinityTerm) []jWeightedPodAffinityTerm {
+	out := make([]jWeightedPodAffinityTerm, 0, len(terms))
+	for _, t := range terms {
+		out = append(out, jWeightedPodAffinityTerm{
+			Weight: t.Weight,
+			Term:   convPodAffinityTerms([]v1.PodAffinityTerm{t.PodAffinityTerm})[0],
+		})
+	}
+	return out
+}
+
+func convAffinity(a *v1.Affinity) *jAffinity {
+	if a == nil {
+		return nil
+	}
+	out := &jAffinity{}
+	if na := a.NodeAffinity; na != nil {
+		j := &jNodeAffinity{}
+		if na.RequiredDuringSchedulingIgnoredDuringExecution != nil {
+			sel := &jNodeSelector{}
+			for _, t := range na.RequiredDuringSchedulingIgnoredDuringExecution.NodeSelectorTerms {
+				sel.Terms = append(sel.Terms, jNodeSelectorTerm{
+					MatchExpressions: convSelectorReqs(t.MatchExpressions),
+					MatchFields:      convSelectorReqs(t.MatchFields),
+				})
+			}
+			j.Required = sel
+		}
+		for _, p := range na.PreferredDuringSchedulingIgnoredDuringExecution {
+			j.Preferred = append(j.Preferred, jPreferredSchedulingTerm{
+				Weight: p.Weight,
+				Preference: jNodeSelectorTerm{
+					MatchExpressions: convSelectorReqs(p.Preference.MatchExpressions),
+					MatchFields:      convSelectorReqs(p.Preference.MatchFields),
+				},
+			})
+		}
+		out.NodeAffinity = j
+	}
+	if pa := a.PodAffinity; pa != nil {
+		out.PodAffinity = &jPodAffinity{
+			Required:  convPodAffinityTerms(pa.RequiredDuringSchedulingIgnoredDuringExecution),
+			Preferred: convWeighted(pa.PreferredDuringSchedulingIgnoredDuringExecution),
+		}
+	}
+	if pa := a.PodAntiAffinity; pa != nil {
+		out.PodAntiAffinity = &jPodAffinity{
+			Required:  convPodAffinityTerms(pa.RequiredDuringSchedulingIgnoredDuringExecution),
+			Preferred: convWeighted(pa.PreferredDuringSchedulingIgnoredDuringExecution),
+		}
+	}
+	return out
+}
+
+func convContainers(cs []v1.Container) []jContainer {
+	out := make([]jContainer, 0, len(cs))
+	for _, c := range cs {
+		jc := jContainer{
+			Name:     c.Name,
+			Requests: canonQty(c.Resources.Requests),
+			Limits:   canonQty(c.Resources.Limits),
+		}
+		if c.Image != "" {
+			jc.Images = []string{c.Image}
+		}
+		if c.RestartPolicy != nil {
+			s := string(*c.RestartPolicy)
+			jc.RestartPolicy = &s
+		}
+		for _, p := range c.Ports {
+			jc.Ports = append(jc.Ports, jContainerPort{
+				HostPort: p.HostPort, ContainerPort: p.ContainerPort,
+				Protocol: string(p.Protocol), HostIP: p.HostIP,
+			})
+		}
+		out = append(out, jc)
+	}
+	return out
+}
+
+// ConvertPod renders a v1.Pod as the sidecar's canonical Pod JSON.
+func ConvertPod(pod *v1.Pod) ([]byte, error) {
+	j := jPod{
+		Metadata: jMeta{
+			Name: pod.Name, Namespace: pod.Namespace, UID: string(pod.UID),
+			Labels: pod.Labels, Annotations: pod.Annotations,
+		},
+		Spec: jPodSpec{
+			Containers:     convContainers(pod.Spec.Containers),
+			InitContainers: convContainers(pod.Spec.InitContainers),
+			Overhead:       canonQty(pod.Spec.Overhead),
+			NodeSelector:   pod.Spec.NodeSelector,
+			Affinity:       convAffinity(pod.Spec.Affinity),
+			NodeName:       pod.Spec.NodeName,
+			SchedulerName:  pod.Spec.SchedulerName,
+		},
+		Status: jPodStatus{
+			NominatedNodeName: pod.Status.NominatedNodeName,
+			Phase:             string(pod.Status.Phase),
+		},
+	}
+	if pod.Spec.Priority != nil {
+		j.Spec.Priority = *pod.Spec.Priority
+	}
+	j.Spec.PreemptionPolicy = "PreemptLowerPriority"
+	if pod.Spec.PreemptionPolicy != nil {
+		j.Spec.PreemptionPolicy = string(*pod.Spec.PreemptionPolicy)
+	}
+	if pod.Status.StartTime != nil {
+		j.Status.StartTime = float64(pod.Status.StartTime.Unix())
+	}
+	for _, t := range pod.Spec.Tolerations {
+		j.Spec.Tolerations = append(j.Spec.Tolerations, jToleration{
+			Key: t.Key, Operator: string(t.Operator), Value: t.Value,
+			Effect: string(t.Effect),
+		})
+	}
+	for _, c := range pod.Spec.TopologySpreadConstraints {
+		sc := jSpreadConstraint{
+			MaxSkew: c.MaxSkew, TopologyKey: c.TopologyKey,
+			WhenUnsatisfiable: string(c.WhenUnsatisfiable),
+			LabelSelector:     convLabelSelector(c.LabelSelector),
+			MinDomains:        c.MinDomains,
+			NodeAffinityPolicy: "Honor", NodeTaintsPolicy: "Ignore",
+		}
+		if c.NodeAffinityPolicy != nil {
+			sc.NodeAffinityPolicy = string(*c.NodeAffinityPolicy)
+		}
+		if c.NodeTaintsPolicy != nil {
+			sc.NodeTaintsPolicy = string(*c.NodeTaintsPolicy)
+		}
+		j.Spec.TopologySpreadConstraints = append(j.Spec.TopologySpreadConstraints, sc)
+	}
+	for _, g := range pod.Spec.SchedulingGates {
+		j.Spec.SchedulingGates = append(j.Spec.SchedulingGates, jSchedulingGate{Name: g.Name})
+	}
+	for _, v := range pod.Spec.Volumes {
+		jv := jVolume{Name: v.Name}
+		if v.PersistentVolumeClaim != nil {
+			jv.PVC = v.PersistentVolumeClaim.ClaimName
+			jv.ReadOnly = v.PersistentVolumeClaim.ReadOnly
+		} else if v.GCEPersistentDisk != nil {
+			jv.DeviceID = "gce/" + v.GCEPersistentDisk.PDName
+			jv.ReadOnly = v.GCEPersistentDisk.ReadOnly
+		} else if v.AWSElasticBlockStore != nil {
+			jv.DeviceID = "aws/" + v.AWSElasticBlockStore.VolumeID
+			jv.ReadOnly = v.AWSElasticBlockStore.ReadOnly
+		} else if v.AzureDisk != nil {
+			jv.DeviceID = "azure/" + v.AzureDisk.DiskName
+			if v.AzureDisk.ReadOnly != nil {
+				jv.ReadOnly = *v.AzureDisk.ReadOnly
+			}
+		} else if v.ISCSI != nil {
+			jv.DeviceID = "iscsi/" + v.ISCSI.IQN
+			jv.ReadOnly = v.ISCSI.ReadOnly
+		} else {
+			continue // volume kinds invisible to scheduling
+		}
+		j.Spec.Volumes = append(j.Spec.Volumes, jv)
+	}
+	// The out-of-tree coscheduling convention: pod-group label.
+	if g, ok := pod.Labels["scheduling.x-k8s.io/pod-group"]; ok {
+		j.Spec.PodGroup = g
+	}
+	for _, rc := range pod.Spec.ResourceClaims {
+		j.Spec.ResourceClaims = append(j.Spec.ResourceClaims, rc.Name)
+	}
+	return json.Marshal(j)
+}
+
+// ConvertNode renders a v1.Node as the sidecar's canonical Node JSON.
+func ConvertNode(node *v1.Node) ([]byte, error) {
+	j := jNode{
+		Metadata: jMeta{
+			Name: node.Name, Namespace: "", UID: string(node.UID),
+			Labels: node.Labels, Annotations: node.Annotations,
+		},
+		Spec: jNodeSpec{Unschedulable: node.Spec.Unschedulable},
+		Status: jNodeStatus{
+			Capacity:    canonQty(node.Status.Capacity),
+			Allocatable: canonQty(node.Status.Allocatable),
+		},
+	}
+	for _, t := range node.Spec.Taints {
+		j.Spec.Taints = append(j.Spec.Taints, jTaint{
+			Key: t.Key, Value: t.Value, Effect: string(t.Effect),
+		})
+	}
+	for _, im := range node.Status.Images {
+		j.Status.Images = append(j.Status.Images, jContainerImage{
+			Names: im.Names, SizeBytes: im.SizeBytes,
+		})
+	}
+	return json.Marshal(j)
+}
+
+// UIDOf is the sidecar's pod identity: metadata.uid, or namespace/name
+// when unset (api/types.py Pod.uid).
+func UIDOf(pod *v1.Pod) string {
+	if pod.UID != "" {
+		return string(pod.UID)
+	}
+	return pod.Namespace + "/" + pod.Name
+}

@@ -1,0 +1,184 @@
+// convert_test.go: the converter's canonical JSON must be SEMANTICALLY
+// identical to the sidecar's own serialization of the same objects
+// (../../tests/golden/golden_pod.json / golden_node.json, emitted by
+// scripts/gen_golden_transcripts.py from the Python object model).
+// Comparison is structural (parsed values), not byte-level: the two
+// languages differ in null-vs-[] for empty lists and whitespace, and the
+// sidecar's JSON decoder treats both identically (missing/None fields
+// default).
+package tpubatchscore
+
+import (
+	"encoding/json"
+	"os"
+	"path/filepath"
+	"reflect"
+	"testing"
+
+	v1 "k8s.io/api/core/v1"
+	"k8s.io/apimachinery/pkg/api/resource"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+)
+
+// normalize collapses JSON-decoded trees for structural comparison:
+// nulls and empty containers are equivalent (the sidecar's from_json
+// defaults them), numbers compare as float64.
+func normalize(v interface{}) interface{} {
+	switch x := v.(type) {
+	case map[string]interface{}:
+		out := map[string]interface{}{}
+		for k, val := range x {
+			n := normalize(val)
+			if n == nil {
+				continue
+			}
+			out[k] = n
+		}
+		if len(out) == 0 {
+			return nil
+		}
+		return out
+	case []interface{}:
+		if len(x) == 0 {
+			return nil
+		}
+		out := make([]interface{}, 0, len(x))
+		for _, e := range x {
+			out = append(out, normalize(e))
+		}
+		return out
+	case string:
+		if x == "" {
+			return nil
+		}
+		return x
+	case float64:
+		if x == 0 {
+			return nil
+		}
+		return x
+	case bool:
+		if !x {
+			return nil
+		}
+		return x
+	}
+	return v
+}
+
+func loadGolden(t *testing.T, name string) interface{} {
+	t.Helper()
+	raw, err := os.ReadFile(filepath.Join("..", "..", "tests", "golden", name))
+	if err != nil {
+		t.Fatalf("reading %s: %v", name, err)
+	}
+	var v interface{}
+	if err := json.Unmarshal(raw, &v); err != nil {
+		t.Fatal(err)
+	}
+	return normalize(v)
+}
+
+func TestConvertPodMatchesGolden(t *testing.T) {
+	prio := int32(7)
+	pod := &v1.Pod{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: "golden", Namespace: "ns1",
+			Labels: map[string]string{"app": "web"},
+		},
+		Spec: v1.PodSpec{
+			SchedulerName: "default-scheduler",
+			Priority:      &prio,
+			Containers: []v1.Container{{
+				Name: "c0",
+				Resources: v1.ResourceRequirements{
+					Requests: v1.ResourceList{
+						v1.ResourceCPU:    resource.MustParse("1500m"),
+						v1.ResourceMemory: resource.MustParse("2Gi"),
+					},
+				},
+				Ports: []v1.ContainerPort{{HostPort: 8080, Protocol: v1.ProtocolTCP}},
+			}},
+			Tolerations: []v1.Toleration{{
+				Key: "dedicated", Operator: v1.TolerationOpEqual,
+				Value: "gpu", Effect: v1.TaintEffectNoSchedule,
+			}},
+			Affinity: &v1.Affinity{
+				PodAntiAffinity: &v1.PodAntiAffinity{
+					RequiredDuringSchedulingIgnoredDuringExecution: []v1.PodAffinityTerm{{
+						LabelSelector: &metav1.LabelSelector{
+							MatchExpressions: []metav1.LabelSelectorRequirement{{
+								Key: "app", Operator: metav1.LabelSelectorOpIn,
+								Values: []string{"web"},
+							}},
+						},
+						TopologyKey: "topology.kubernetes.io/zone",
+					}},
+				},
+			},
+			TopologySpreadConstraints: []v1.TopologySpreadConstraint{{
+				MaxSkew: 1, TopologyKey: "topology.kubernetes.io/zone",
+				WhenUnsatisfiable: v1.DoNotSchedule,
+				LabelSelector: &metav1.LabelSelector{
+					MatchExpressions: []metav1.LabelSelectorRequirement{{
+						Key: "app", Operator: metav1.LabelSelectorOpIn,
+						Values: []string{"web"},
+					}},
+				},
+			}},
+		},
+	}
+	raw, err := ConvertPod(pod)
+	if err != nil {
+		t.Fatal(err)
+	}
+	var got interface{}
+	if err := json.Unmarshal(raw, &got); err != nil {
+		t.Fatal(err)
+	}
+	want := loadGolden(t, "golden_pod.json")
+	gotN := normalize(got)
+	if !reflect.DeepEqual(gotN, want) {
+		g, _ := json.MarshalIndent(gotN, "", " ")
+		w, _ := json.MarshalIndent(want, "", " ")
+		t.Errorf("converted pod diverged from golden\nwant:\n%s\ngot:\n%s", w, g)
+	}
+}
+
+func TestConvertNodeMatchesGolden(t *testing.T) {
+	node := &v1.Node{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: "node-0",
+			Labels: map[string]string{
+				"kubernetes.io/hostname":      "node-0",
+				"topology.kubernetes.io/zone": "zone-0",
+			},
+		},
+		Status: v1.NodeStatus{
+			Capacity: v1.ResourceList{
+				v1.ResourceCPU:    resource.MustParse("4"),
+				v1.ResourceMemory: resource.MustParse("16Gi"),
+				v1.ResourcePods:   resource.MustParse("16"),
+			},
+			Allocatable: v1.ResourceList{
+				v1.ResourceCPU:    resource.MustParse("4"),
+				v1.ResourceMemory: resource.MustParse("16Gi"),
+				v1.ResourcePods:   resource.MustParse("16"),
+			},
+		},
+	}
+	raw, err := ConvertNode(node)
+	if err != nil {
+		t.Fatal(err)
+	}
+	var got interface{}
+	if err := json.Unmarshal(raw, &got); err != nil {
+		t.Fatal(err)
+	}
+	want := loadGolden(t, "golden_node.json")
+	if !reflect.DeepEqual(normalize(got), want) {
+		g, _ := json.MarshalIndent(normalize(got), "", " ")
+		w, _ := json.MarshalIndent(want, "", " ")
+		t.Errorf("converted node diverged from golden\nwant:\n%s\ngot:\n%s", w, g)
+	}
+}

@@ -1,0 +1,315 @@
+// plugin.go: the TPUBatchScore out-of-tree plugin set.
+//
+// An UNMODIFIED kube-scheduler loads this via the out-of-tree registry
+// (pkg/scheduler/scheduler.go:195 WithFrameworkOutOfTreeRegistry — see
+// ../cmd/kube-scheduler-tpu/main.go) and selects it as a profile in
+// KubeSchedulerConfiguration:
+//
+//	profiles:
+//	- schedulerName: tpu-batch-score
+//	  plugins:
+//	    multiPoint:
+//	      enabled: [{name: TPUBatchScore}]
+//	      disabled: [{name: "*"}]
+//	  pluginConfig:
+//	  - name: TPUBatchScore
+//	    args: {"socket": "/var/run/tpu-sidecar.sock"}
+//
+// Division of labor (SURVEY §7 two-tier design): the Go scheduler keeps
+// informers, queue, binding, and API writes; the sidecar owns the batched
+// Filter/Score/preemption computation on device.  The plugin implements:
+//
+//   - PreFilter: streams the pod to the sidecar (ScheduleBatchRequest) and
+//     narrows the node set to the sidecar's pick via PreFilterResult
+//     (framework/interface.go:513 — a one-node NodeNames set makes the
+//     host's Filter loop O(1), so the Go hot loop disappears).
+//   - Filter: passes only the picked node (defense against races between
+//     the sidecar's snapshot and the host's).
+//   - Score: returns the sidecar's score for the picked node.
+//   - PostFilter: surfaces the sidecar's preemption nomination; deletes
+//     the chosen victims via the API (prepareCandidate,
+//     framework/preemption/preemption.go:342) and returns the nominated
+//     node so the host writes .status.nominatedNodeName.
+//   - EventsToRegister: Pod/Node deltas, mirroring the sidecar's own
+//     requeue interests (queue.py PLUGIN_REQUEUE_EVENTS).
+//
+// Consistency contract with the sidecar:
+//   - The sidecar's pick is an ASSUME on its mirror.  A failed host bind
+//     rolls it back with RemoveObject(Pod) (cache.go:404 ForgetPod analog);
+//     the informer's eventual bound-pod upsert is idempotent on the
+//     sidecar side (serialize.py routes Pod upserts through update_pod).
+//   - Informer Node/Pod events stream as AddObject/RemoveObject so the
+//     sidecar mirror tracks the host's view between cycles.
+package tpubatchscore
+
+import (
+	"context"
+	"encoding/json"
+	"fmt"
+	"sync"
+
+	v1 "k8s.io/api/core/v1"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+	"k8s.io/apimachinery/pkg/runtime"
+	"k8s.io/apimachinery/pkg/util/sets"
+	"k8s.io/client-go/tools/cache"
+	"k8s.io/kubernetes/pkg/scheduler/framework"
+)
+
+// Name is the plugin name registered in the out-of-tree registry and used
+// in KubeSchedulerConfiguration.
+const Name = "TPUBatchScore"
+
+// Args is the pluginConfig args payload.
+type Args struct {
+	// Socket is the sidecar address: "unix:///path.sock" semantics — the
+	// path of a unix-domain socket, or "host:port" when Network is "tcp".
+	Socket  string `json:"socket"`
+	Network string `json:"network,omitempty"` // default "unix"
+}
+
+type stateData struct {
+	result PodResult
+}
+
+func (s *stateData) Clone() framework.StateData { return s }
+
+const stateKey = "tpubatchscore/result"
+
+// Plugin implements PreFilter, Filter, Score, PostFilter and
+// EnqueueExtensions against the sidecar.
+type Plugin struct {
+	handle framework.Handle
+	client *Client
+	mu     sync.Mutex
+}
+
+var (
+	_ framework.PreFilterPlugin  = &Plugin{}
+	_ framework.FilterPlugin     = &Plugin{}
+	_ framework.ScorePlugin      = &Plugin{}
+	_ framework.PostFilterPlugin = &Plugin{}
+	_ framework.EnqueueExtensions = &Plugin{}
+)
+
+// New is the PluginFactory registered via app.WithPlugin (see
+// ../cmd/kube-scheduler-tpu/main.go).
+func New(_ context.Context, obj runtime.Object, h framework.Handle) (framework.Plugin, error) {
+	args := Args{Network: "unix"}
+	if obj != nil {
+		if u, ok := obj.(*runtime.Unknown); ok && len(u.Raw) > 0 {
+			if err := json.Unmarshal(u.Raw, &args); err != nil {
+				return nil, fmt.Errorf("parsing TPUBatchScore args: %w", err)
+			}
+		}
+	}
+	if args.Socket == "" {
+		return nil, fmt.Errorf("TPUBatchScore requires args.socket")
+	}
+	if args.Network == "" {
+		args.Network = "unix"
+	}
+	client, err := Dial(args.Network, args.Socket)
+	if err != nil {
+		return nil, fmt.Errorf("dialing sidecar %s: %w", args.Socket, err)
+	}
+	p := &Plugin{handle: h, client: client}
+	p.wireInformers(h)
+	return p, nil
+}
+
+func (p *Plugin) Name() string { return Name }
+
+// wireInformers streams Node/Pod deltas to the sidecar — the snapshot
+// feed (eventhandlers.go:341 addAllEventHandlers analog; deltas keyed by
+// object, the sidecar diffs on its side).
+func (p *Plugin) wireInformers(h framework.Handle) {
+	nodeInformer := h.SharedInformerFactory().Core().V1().Nodes().Informer()
+	nodeInformer.AddEventHandler(cache.ResourceEventHandlerFuncs{
+		AddFunc: func(obj interface{}) {
+			if n, ok := obj.(*v1.Node); ok {
+				if raw, err := ConvertNode(n); err == nil {
+					_ = p.client.AddObject("Node", raw)
+				}
+			}
+		},
+		UpdateFunc: func(_, obj interface{}) {
+			if n, ok := obj.(*v1.Node); ok {
+				if raw, err := ConvertNode(n); err == nil {
+					_ = p.client.AddObject("Node", raw)
+				}
+			}
+		},
+		DeleteFunc: func(obj interface{}) {
+			if n, ok := asNode(obj); ok {
+				_ = p.client.RemoveObject("Node", n.Name)
+			}
+		},
+	})
+	podInformer := h.SharedInformerFactory().Core().V1().Pods().Informer()
+	podInformer.AddEventHandler(cache.FilteringResourceEventHandler{
+		// Only ASSIGNED pods reach the sidecar cache (the scheduler's own
+		// queue feeds unassigned ones through PreFilter); mirrors
+		// eventhandlers.go:312 assignedPod.
+		FilterFunc: func(obj interface{}) bool {
+			pod, ok := asPod(obj) // tombstoned deletes must pass through
+			return ok && pod.Spec.NodeName != ""
+		},
+		Handler: cache.ResourceEventHandlerFuncs{
+			AddFunc: func(obj interface{}) {
+				if pod, ok := obj.(*v1.Pod); ok {
+					if raw, err := ConvertPod(pod); err == nil {
+						_ = p.client.AddObject("Pod", raw)
+					}
+				}
+			},
+			UpdateFunc: func(_, obj interface{}) {
+				if pod, ok := obj.(*v1.Pod); ok {
+					if raw, err := ConvertPod(pod); err == nil {
+						_ = p.client.AddObject("Pod", raw)
+					}
+				}
+			},
+			DeleteFunc: func(obj interface{}) {
+				if pod, ok := asPod(obj); ok {
+					_ = p.client.RemoveObject("Pod", UIDOf(pod))
+				}
+			},
+		},
+	})
+}
+
+// asNode / asPod unwrap cache.DeletedFinalStateUnknown tombstones —
+// deletions delivered after a watch relist arrive wrapped, and dropping
+// them would leak phantom objects in the sidecar cache
+// (eventhandlers.go handles the same case).
+func asNode(obj interface{}) (*v1.Node, bool) {
+	if n, ok := obj.(*v1.Node); ok {
+		return n, true
+	}
+	if ts, ok := obj.(cache.DeletedFinalStateUnknown); ok {
+		n, ok := ts.Obj.(*v1.Node)
+		return n, ok
+	}
+	return nil, false
+}
+
+func asPod(obj interface{}) (*v1.Pod, bool) {
+	if p, ok := obj.(*v1.Pod); ok {
+		return p, true
+	}
+	if ts, ok := obj.(cache.DeletedFinalStateUnknown); ok {
+		p, ok := ts.Obj.(*v1.Pod)
+		return p, ok
+	}
+	return nil, false
+}
+
+// PreFilter ships the pod to the sidecar and narrows the node set to its
+// pick.  An unschedulable verdict surfaces the sidecar's Diagnosis so the
+// host's PostFilter/requeue machinery behaves as with in-tree plugins.
+func (p *Plugin) PreFilter(ctx context.Context, state *framework.CycleState, pod *v1.Pod) (*framework.PreFilterResult, *framework.Status) {
+	raw, err := ConvertPod(pod)
+	if err != nil {
+		return nil, framework.AsStatus(err)
+	}
+	p.mu.Lock()
+	results, err := p.client.Schedule([][]byte{raw}, false)
+	p.mu.Unlock()
+	if err != nil {
+		return nil, framework.AsStatus(err)
+	}
+	if len(results) == 0 {
+		return nil, framework.NewStatus(framework.Error, "sidecar returned no result")
+	}
+	r := results[0]
+	state.Write(stateKey, &stateData{result: r})
+	if r.NodeName == "" {
+		msg := "sidecar: no feasible node"
+		if len(r.UnschedulablePlugins) > 0 {
+			msg = fmt.Sprintf("sidecar rejected by %v", r.UnschedulablePlugins)
+		}
+		return nil, framework.NewStatus(framework.Unschedulable, msg)
+	}
+	return &framework.PreFilterResult{NodeNames: sets.New(r.NodeName)}, nil
+}
+
+func (p *Plugin) PreFilterExtensions() framework.PreFilterExtensions { return nil }
+
+// Filter accepts only the sidecar's pick.
+func (p *Plugin) Filter(ctx context.Context, state *framework.CycleState, pod *v1.Pod, nodeInfo *framework.NodeInfo) *framework.Status {
+	d, err := state.Read(stateKey)
+	if err != nil {
+		return framework.AsStatus(err)
+	}
+	sd := d.(*stateData)
+	if nodeInfo.Node().Name != sd.result.NodeName {
+		return framework.NewStatus(framework.Unschedulable, "not the sidecar's pick")
+	}
+	return nil
+}
+
+// Score returns the sidecar's combined weighted score for the picked node.
+func (p *Plugin) Score(ctx context.Context, state *framework.CycleState, pod *v1.Pod, nodeName string) (int64, *framework.Status) {
+	d, err := state.Read(stateKey)
+	if err != nil {
+		return 0, framework.AsStatus(err)
+	}
+	sd := d.(*stateData)
+	if nodeName == sd.result.NodeName {
+		return sd.result.Score, nil
+	}
+	return 0, nil
+}
+
+func (p *Plugin) ScoreExtensions() framework.ScoreExtensions { return nil }
+
+// PostFilter relays the sidecar's preemption decision: deletes the chosen
+// victims via the API (async, like the reference's prepareCandidate
+// goroutines) and nominates the freed node.
+func (p *Plugin) PostFilter(ctx context.Context, state *framework.CycleState, pod *v1.Pod, _ framework.NodeToStatusReader) (*framework.PostFilterResult, *framework.Status) {
+	d, err := state.Read(stateKey)
+	if err != nil {
+		return nil, framework.AsStatus(err)
+	}
+	sd := d.(*stateData)
+	if sd.result.NominatedNode == "" {
+		return nil, framework.NewStatus(framework.Unschedulable, "sidecar found no preemption candidate")
+	}
+	cs := p.handle.ClientSet()
+	for _, ref := range sd.result.VictimNames {
+		ns, name := splitRef(ref)
+		// Deletion must outlive the scheduling cycle: the per-cycle ctx
+		// is cancelled as soon as PostFilter returns, which would abort
+		// the in-flight DELETEs (the reference's prepareCandidate also
+		// detaches its victim deletions from the cycle).
+		go func() {
+			_ = cs.CoreV1().Pods(ns).Delete(
+				context.Background(), name, metav1.DeleteOptions{})
+		}()
+	}
+	return framework.NewPostFilterResultWithNominatedNode(sd.result.NominatedNode),
+		framework.NewStatus(framework.Success)
+}
+
+// splitRef splits the sidecar's "namespace/name" victim refs
+// (PodResult.victim_names — uids are opaque and cannot address an API
+// DELETE).
+func splitRef(ref string) (namespace, name string) {
+	for i := 0; i < len(ref); i++ {
+		if ref[i] == '/' {
+			return ref[:i], ref[i+1:]
+		}
+	}
+	return "default", ref
+}
+
+// EventsToRegister mirrors the sidecar's requeue interests: pods blocked
+// there wake on Pod/Node deltas (the sidecar applies its own
+// object-aware hints; the host queue's hints stay coarse).
+func (p *Plugin) EventsToRegister(_ context.Context) ([]framework.ClusterEventWithHint, error) {
+	return []framework.ClusterEventWithHint{
+		{Event: framework.ClusterEvent{Resource: framework.Pod, ActionType: framework.Delete | framework.Add | framework.Update}},
+		{Event: framework.ClusterEvent{Resource: framework.Node, ActionType: framework.Add | framework.Update}},
+	}, nil
+}

@@ -1,0 +1,435 @@
+// Package tpubatchscore is the out-of-tree scheduler plugin set that backs
+// the kube-scheduler Filter/Score hot loop with the TPU sidecar
+// (proto/sidecar.proto over a framed unix-domain socket).
+//
+// wire.go: hand-rolled protobuf encoding for the sidecar message set.
+// The messages are tiny and fixed, so the codec is written out by hand —
+// no protoc-generated dependency, and the byte output is deterministic
+// (fields emitted in ascending tag order), which is what the golden
+// wire-transcript fixtures under ../../tests/golden/ assert.  The same
+// fixtures are replayed by the Python test suite against the live sidecar
+// (tests/test_golden_transcripts.py), so both sides of the protocol are
+// pinned to identical bytes.
+//
+// Reference precedent for an out-of-process scheduling backend:
+// pkg/scheduler/extender.go (HTTP+JSON); this is its socket+proto analog.
+package tpubatchscore
+
+import (
+	"encoding/binary"
+	"fmt"
+	"io"
+)
+
+// --- protobuf primitives ---------------------------------------------------
+
+func appendVarint(b []byte, v uint64) []byte {
+	for v >= 0x80 {
+		b = append(b, byte(v)|0x80)
+		v >>= 7
+	}
+	return append(b, byte(v))
+}
+
+func appendTag(b []byte, field int, wire int) []byte {
+	return appendVarint(b, uint64(field)<<3|uint64(wire))
+}
+
+func appendBytesField(b []byte, field int, v []byte) []byte {
+	b = appendTag(b, field, 2)
+	b = appendVarint(b, uint64(len(v)))
+	return append(b, v...)
+}
+
+func appendStringField(b []byte, field int, v string) []byte {
+	return appendBytesField(b, field, []byte(v))
+}
+
+func appendUintField(b []byte, field int, v uint64) []byte {
+	b = appendTag(b, field, 0)
+	return appendVarint(b, v)
+}
+
+func readVarint(b []byte) (uint64, int, error) {
+	var v uint64
+	var shift uint
+	for i := 0; i < len(b); i++ {
+		v |= uint64(b[i]&0x7f) << shift
+		if b[i] < 0x80 {
+			return v, i + 1, nil
+		}
+		shift += 7
+		if shift >= 64 {
+			break
+		}
+	}
+	return 0, 0, fmt.Errorf("truncated varint")
+}
+
+// --- message types ---------------------------------------------------------
+
+// Envelope mirrors sidecar.proto Envelope; exactly one of the oneof
+// pointers is set.
+type Envelope struct {
+	Seq      uint64
+	Add      *AddObject
+	Remove   *RemoveObject
+	Schedule *ScheduleBatchRequest
+	Response *Response
+	Dump     *DumpRequest
+}
+
+type AddObject struct {
+	Kind       string
+	ObjectJSON []byte
+}
+
+type RemoveObject struct {
+	Kind string
+	UID  string
+}
+
+type ScheduleBatchRequest struct {
+	PodJSON [][]byte
+	Drain   bool
+}
+
+type DumpRequest struct{}
+
+type PodResult struct {
+	PodUID               string
+	NodeName             string
+	Score                int64
+	FeasibleNodes        int32
+	UnschedulablePlugins []string
+	NominatedNode        string
+	Victims              int32
+	VictimUIDs           []string
+	VictimNames          []string // "namespace/name" refs for API DELETEs
+}
+
+type Response struct {
+	Error    string
+	Results  []PodResult
+	DumpJSON []byte
+}
+
+// --- marshal ---------------------------------------------------------------
+
+func (m *AddObject) marshal() []byte {
+	var b []byte
+	if m.Kind != "" {
+		b = appendStringField(b, 1, m.Kind)
+	}
+	if len(m.ObjectJSON) > 0 {
+		b = appendBytesField(b, 2, m.ObjectJSON)
+	}
+	return b
+}
+
+func (m *RemoveObject) marshal() []byte {
+	var b []byte
+	if m.Kind != "" {
+		b = appendStringField(b, 1, m.Kind)
+	}
+	if m.UID != "" {
+		b = appendStringField(b, 2, m.UID)
+	}
+	return b
+}
+
+func (m *ScheduleBatchRequest) marshal() []byte {
+	var b []byte
+	for _, p := range m.PodJSON {
+		b = appendBytesField(b, 1, p)
+	}
+	if m.Drain {
+		b = appendUintField(b, 2, 1)
+	}
+	return b
+}
+
+func (m *PodResult) marshal() []byte {
+	var b []byte
+	if m.PodUID != "" {
+		b = appendStringField(b, 1, m.PodUID)
+	}
+	if m.NodeName != "" {
+		b = appendStringField(b, 2, m.NodeName)
+	}
+	if m.Score != 0 {
+		b = appendUintField(b, 3, uint64(m.Score))
+	}
+	if m.FeasibleNodes != 0 {
+		b = appendUintField(b, 4, uint64(uint32(m.FeasibleNodes)))
+	}
+	for _, p := range m.UnschedulablePlugins {
+		b = appendStringField(b, 5, p)
+	}
+	if m.NominatedNode != "" {
+		b = appendStringField(b, 6, m.NominatedNode)
+	}
+	if m.Victims != 0 {
+		b = appendUintField(b, 7, uint64(uint32(m.Victims)))
+	}
+	for _, u := range m.VictimUIDs {
+		b = appendStringField(b, 8, u)
+	}
+	for _, n := range m.VictimNames {
+		b = appendStringField(b, 9, n)
+	}
+	return b
+}
+
+func (m *Response) marshal() []byte {
+	var b []byte
+	if m.Error != "" {
+		b = appendStringField(b, 1, m.Error)
+	}
+	for i := range m.Results {
+		b = appendBytesField(b, 2, m.Results[i].marshal())
+	}
+	if len(m.DumpJSON) > 0 {
+		b = appendBytesField(b, 3, m.DumpJSON)
+	}
+	return b
+}
+
+// Marshal emits the Envelope in ascending tag order — byte-identical to
+// what protobuf serializers produce for this message set, pinned by the
+// golden fixtures.
+func (m *Envelope) Marshal() []byte {
+	var b []byte
+	if m.Seq != 0 {
+		b = appendUintField(b, 1, m.Seq)
+	}
+	switch {
+	case m.Add != nil:
+		b = appendBytesField(b, 2, m.Add.marshal())
+	case m.Remove != nil:
+		b = appendBytesField(b, 3, m.Remove.marshal())
+	case m.Schedule != nil:
+		b = appendBytesField(b, 4, m.Schedule.marshal())
+	case m.Response != nil:
+		b = appendBytesField(b, 5, m.Response.marshal())
+	case m.Dump != nil:
+		b = appendBytesField(b, 6, []byte{})
+	}
+	return b
+}
+
+// --- unmarshal -------------------------------------------------------------
+
+type field struct {
+	tag  int
+	wire int
+	num  uint64
+	buf  []byte
+}
+
+func fields(b []byte) ([]field, error) {
+	var out []field
+	for len(b) > 0 {
+		key, n, err := readVarint(b)
+		if err != nil {
+			return nil, err
+		}
+		b = b[n:]
+		f := field{tag: int(key >> 3), wire: int(key & 7)}
+		switch f.wire {
+		case 0:
+			f.num, n, err = readVarint(b)
+			if err != nil {
+				return nil, err
+			}
+			b = b[n:]
+		case 2:
+			ln, n, err := readVarint(b)
+			if err != nil {
+				return nil, err
+			}
+			b = b[n:]
+			if uint64(len(b)) < ln {
+				return nil, fmt.Errorf("truncated bytes field %d", f.tag)
+			}
+			f.buf = b[:ln]
+			b = b[ln:]
+		default:
+			return nil, fmt.Errorf("unsupported wire type %d", f.wire)
+		}
+		out = append(out, f)
+	}
+	return out, nil
+}
+
+func unmarshalPodResult(b []byte) (PodResult, error) {
+	var r PodResult
+	fs, err := fields(b)
+	if err != nil {
+		return r, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			r.PodUID = string(f.buf)
+		case 2:
+			r.NodeName = string(f.buf)
+		case 3:
+			r.Score = int64(f.num)
+		case 4:
+			r.FeasibleNodes = int32(f.num)
+		case 5:
+			r.UnschedulablePlugins = append(r.UnschedulablePlugins, string(f.buf))
+		case 6:
+			r.NominatedNode = string(f.buf)
+		case 7:
+			r.Victims = int32(f.num)
+		case 8:
+			r.VictimUIDs = append(r.VictimUIDs, string(f.buf))
+		case 9:
+			r.VictimNames = append(r.VictimNames, string(f.buf))
+		}
+	}
+	return r, nil
+}
+
+func unmarshalResponse(b []byte) (*Response, error) {
+	r := &Response{}
+	fs, err := fields(b)
+	if err != nil {
+		return nil, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			r.Error = string(f.buf)
+		case 2:
+			pr, err := unmarshalPodResult(f.buf)
+			if err != nil {
+				return nil, err
+			}
+			r.Results = append(r.Results, pr)
+		case 3:
+			r.DumpJSON = append([]byte(nil), f.buf...)
+		}
+	}
+	return r, nil
+}
+
+func unmarshalAddObject(b []byte) (*AddObject, error) {
+	m := &AddObject{}
+	fs, err := fields(b)
+	if err != nil {
+		return nil, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			m.Kind = string(f.buf)
+		case 2:
+			m.ObjectJSON = append([]byte(nil), f.buf...)
+		}
+	}
+	return m, nil
+}
+
+func unmarshalRemoveObject(b []byte) (*RemoveObject, error) {
+	m := &RemoveObject{}
+	fs, err := fields(b)
+	if err != nil {
+		return nil, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			m.Kind = string(f.buf)
+		case 2:
+			m.UID = string(f.buf)
+		}
+	}
+	return m, nil
+}
+
+func unmarshalSchedule(b []byte) (*ScheduleBatchRequest, error) {
+	m := &ScheduleBatchRequest{}
+	fs, err := fields(b)
+	if err != nil {
+		return nil, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			m.PodJSON = append(m.PodJSON, append([]byte(nil), f.buf...))
+		case 2:
+			m.Drain = f.num != 0
+		}
+	}
+	return m, nil
+}
+
+// Unmarshal parses an Envelope — both directions, so the golden-fixture
+// round-trip test can re-marshal recorded request frames byte-for-byte.
+func (m *Envelope) Unmarshal(b []byte) error {
+	fs, err := fields(b)
+	if err != nil {
+		return err
+	}
+	for _, f := range fs {
+		var err error
+		switch f.tag {
+		case 1:
+			m.Seq = f.num
+		case 2:
+			m.Add, err = unmarshalAddObject(f.buf)
+		case 3:
+			m.Remove, err = unmarshalRemoveObject(f.buf)
+		case 4:
+			m.Schedule, err = unmarshalSchedule(f.buf)
+		case 5:
+			m.Response, err = unmarshalResponse(f.buf)
+		case 6:
+			m.Dump = &DumpRequest{}
+		}
+		if err != nil {
+			return err
+		}
+	}
+	return nil
+}
+
+// --- framing ---------------------------------------------------------------
+
+const maxFrame = 64 << 20
+
+// WriteFrame writes 4-byte big-endian length + payload (sidecar framing).
+func WriteFrame(w io.Writer, env *Envelope) error {
+	payload := env.Marshal()
+	hdr := make([]byte, 4)
+	binary.BigEndian.PutUint32(hdr, uint32(len(payload)))
+	if _, err := w.Write(hdr); err != nil {
+		return err
+	}
+	_, err := w.Write(payload)
+	return err
+}
+
+// ReadFrame reads one framed Envelope.
+func ReadFrame(r io.Reader) (*Envelope, error) {
+	hdr := make([]byte, 4)
+	if _, err := io.ReadFull(r, hdr); err != nil {
+		return nil, err
+	}
+	n := binary.BigEndian.Uint32(hdr)
+	if n > maxFrame {
+		return nil, fmt.Errorf("frame too large: %d", n)
+	}
+	payload := make([]byte, n)
+	if _, err := io.ReadFull(r, payload); err != nil {
+		return nil, err
+	}
+	env := &Envelope{}
+	if err := env.Unmarshal(payload); err != nil {
+		return nil, err
+	}
+	return env, nil
+}

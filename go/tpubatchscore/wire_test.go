@@ -1,0 +1,103 @@
+// wire_test.go: holds the hand-rolled codec to the golden wire transcript
+// (../../tests/golden/basic_session.framestream, recorded by
+// scripts/gen_golden_transcripts.py and replayed by the Python suite).
+// Every frame — requests produced by the Python client and responses
+// produced by the sidecar — must parse and re-marshal byte-identically,
+// proving the Go codec writes exactly the bytes the sidecar's protobuf
+// implementation does for this message set.
+//
+// Runs wherever a Go toolchain exists (the sidecar image has none):
+//   cd go && go test ./tpubatchscore/
+package tpubatchscore
+
+import (
+	"bytes"
+	"encoding/binary"
+	"os"
+	"path/filepath"
+	"testing"
+)
+
+func readFixture(t *testing.T) [][2][]byte {
+	t.Helper()
+	path := filepath.Join("..", "..", "tests", "golden", "basic_session.framestream")
+	data, err := os.ReadFile(path)
+	if err != nil {
+		t.Fatalf("reading fixture: %v", err)
+	}
+	var frames [][2][]byte
+	for off := 0; off < len(data); {
+		dir := data[off : off+1]
+		n := binary.BigEndian.Uint32(data[off+1 : off+5])
+		payload := data[off+5 : off+5+int(n)]
+		frames = append(frames, [2][]byte{dir, payload})
+		off += 5 + int(n)
+	}
+	return frames
+}
+
+func TestGoldenFramesRoundTrip(t *testing.T) {
+	frames := readFixture(t)
+	if len(frames) == 0 {
+		t.Fatal("empty fixture")
+	}
+	var sawSchedule, sawVictims bool
+	for i, f := range frames {
+		env := &Envelope{}
+		if err := env.Unmarshal(f[1]); err != nil {
+			t.Fatalf("frame %d: unmarshal: %v", i, err)
+		}
+		out := env.Marshal()
+		if !bytes.Equal(out, f[1]) {
+			t.Errorf("frame %d (%s): re-marshal diverged\nwant %x\ngot  %x",
+				i, f[0], f[1], out)
+		}
+		if env.Schedule != nil {
+			sawSchedule = true
+		}
+		if env.Response != nil {
+			for _, r := range env.Response.Results {
+				if len(r.VictimUIDs) > 0 {
+					sawVictims = true
+				}
+			}
+		}
+	}
+	if !sawSchedule || !sawVictims {
+		t.Error("fixture no longer exercises schedule + preemption victims")
+	}
+}
+
+func TestFramingRoundTrip(t *testing.T) {
+	env := &Envelope{
+		Schedule: &ScheduleBatchRequest{
+			PodJSON: [][]byte{[]byte(`{"a":1}`), []byte(`{"b":2}`)},
+			Drain:   true,
+		},
+	}
+	env.Seq = 7
+	var buf bytes.Buffer
+	if err := WriteFrame(&buf, env); err != nil {
+		t.Fatal(err)
+	}
+	back, err := ReadFrame(&buf)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if back.Seq != 7 || back.Schedule == nil || !back.Schedule.Drain ||
+		len(back.Schedule.PodJSON) != 2 {
+		t.Fatalf("round trip mismatch: %+v", back)
+	}
+}
+
+func TestNegativeScoreVarint(t *testing.T) {
+	r := PodResult{PodUID: "u", Score: -5}
+	b := r.marshal()
+	back, err := unmarshalPodResult(b)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if back.Score != -5 {
+		t.Fatalf("negative score: got %d", back.Score)
+	}
+}

@@ -1041,7 +1041,52 @@ def main(names: list[str] | None = None) -> list[dict]:
     return results
 
 
+def main_isolated(names: list[str] | None = None) -> list[dict]:
+    """Run each workload in a FRESH subprocess — the sweep analog of
+    scheduler_perf's per-case process isolation.  A long-lived process
+    accumulates host allocator/GC pressure that degrades later workloads
+    ~1.5-2× versus their solo numbers (r2: secrets 16× in-sweep vs 29×
+    solo); XLA compiles stay warm across processes via the persistent
+    compilation cache (kubernetes_tpu/__init__.py)."""
+    import subprocess
+    import sys as _sys
+
+    if names:
+        unknown = [n for n in names if n not in WORKLOADS]
+        if unknown:
+            raise SystemExit(
+                f"unknown workload(s): {unknown}; available: {sorted(WORKLOADS)}"
+            )
+    selected = [n for n in WORKLOADS if not names or n in names]
+    results = []
+    for name in selected:
+        proc = subprocess.run(
+            [_sys.executable, "-m", "kubernetes_tpu.benchmarks.harness", name],
+            capture_output=True, text=True,
+        )
+        line = ""
+        for ln in proc.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                line = ln
+        if not line:
+            line = json.dumps(
+                {"name": name, "error": (proc.stderr or "no output")[-400:]}
+            )
+        print(line, flush=True)
+        results.append(json.loads(line))
+    return results
+
+
 if __name__ == "__main__":
     import sys
 
-    main(sys.argv[1:] or None)
+    args = sys.argv[1:]
+    if args and args[0] == "--isolated":
+        main_isolated(args[1:] or None)
+    elif len(args) == 1:
+        main(args)  # single workload: in-process (the subprocess leaf)
+    elif not args:
+        main_isolated(None)  # default sweep: per-workload isolation
+    else:
+        main(args)

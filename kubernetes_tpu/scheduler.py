@@ -38,6 +38,11 @@ class ScheduleOutcome:
     feasible_nodes: int = 0
     nominated_node: str | None = None  # set when preemption picked victims
     victims: int = 0
+    # Victim identities for an out-of-process host's async DELETE calls
+    # (prepareCandidate, preemption.go:342): uids for sidecar-cache
+    # addressing, namespace/name refs for the API DELETE.
+    victim_uids: tuple[str, ...] = ()
+    victim_names: tuple[str, ...] = ()
     # Why the pod failed (framework/types.go Diagnosis): which plugins
     # rejected nodes, from the device pass's per-op fail bitmask.
     diagnosis: Diagnosis | None = None
@@ -1117,6 +1122,10 @@ class TPUScheduler:
                 m.preemptions += 1
                 outcome.nominated_node = res.node_name
                 outcome.victims = len(res.victims)
+                outcome.victim_uids = tuple(v.uid for v in res.victims)
+                outcome.victim_names = tuple(
+                    f"{v.namespace}/{v.name}" for v in res.victims
+                )
                 any_victims = any_victims or bool(res.victims)
                 # Record the claim: the fit overlay protects the freed node
                 # from same/next-batch stealers, and the retry's fast path

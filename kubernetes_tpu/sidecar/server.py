@@ -154,6 +154,8 @@ def _dispatch(sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope) -> None:
             r.feasible_nodes = o.feasible_nodes
             r.nominated_node = o.nominated_node or ""
             r.victims = o.victims
+            r.victim_uids.extend(o.victim_uids)
+            r.victim_names.extend(o.victim_names)
             if o.diagnosis is not None:
                 r.unschedulable_plugins.extend(
                     sorted(o.diagnosis.unschedulable_plugins)

@@ -1,0 +1,192 @@
+"""Generate the golden wire-transcript fixtures under tests/golden/.
+
+Runs a fixed, fully deterministic scenario through the Python sidecar
+client against an in-process server and records every frame byte-for-byte.
+The fixtures pin the wire protocol for BOTH sides:
+
+- tests/test_golden_transcripts.py replays the request frames against a
+  live server and asserts the response frames match — server conformance,
+  CI-tested on every run.
+- go/tpubatchscore/wire_test.go parses each frame with the hand-rolled Go
+  codec, re-marshals it, and asserts byte identity — Go codec conformance,
+  runnable wherever a Go toolchain exists (none in this image).
+
+Container format (.framestream): repeated records of
+  1 byte direction ('>' = client→server, '<' = server→client)
+  4-byte big-endian length
+  Envelope protobuf payload
+
+Also emits pod/node canonical-JSON fixtures (golden_pod.json,
+golden_node.json) for go/tpubatchscore/convert_test.go.
+
+Rerun after any protocol change:  JAX_PLATFORMS=cpu python
+scripts/gen_golden_transcripts.py
+"""
+
+import json
+import os
+import struct
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubernetes_tpu.api import serialize, types as t  # noqa: E402
+from kubernetes_tpu.api.wrappers import make_node, make_pod  # noqa: E402
+from kubernetes_tpu.framework.config import fit_only_profile  # noqa: E402
+from kubernetes_tpu.scheduler import TPUScheduler  # noqa: E402
+from kubernetes_tpu.sidecar import server as sidecar  # noqa: E402
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "..", "tests", "golden")
+
+
+def scenario_objects():
+    """The fixed scenario: 4 nodes, 3 bound pods, 4 pending pods (one
+    triggers preemption, one is unschedulable)."""
+    nodes = [
+        make_node(f"node-{i}")
+        .capacity({"cpu": "4", "memory": "16Gi", "pods": 16})
+        .zone(f"zone-{i % 2}")
+        .obj()
+        for i in range(4)
+    ]
+    bound = [
+        make_pod(f"bound-{i}")
+        .req({"cpu": "3", "memory": "2Gi"})
+        .label("app", "base")
+        .priority(1)
+        .start_time(float(i))
+        .node(f"node-{i}")
+        .obj()
+        for i in range(4)
+    ]
+    pending = [
+        make_pod("easy").req({"cpu": "1"}).label("app", "web").obj(),
+        make_pod("picky").req({"cpu": "2"}).label("app", "web").obj(),
+        make_pod("vip").req({"cpu": "3"}).priority(100).obj(),  # preempts
+        make_pod("huge").req({"cpu": "99"}).obj(),  # unschedulable
+    ]
+    return nodes, bound, pending
+
+
+def record_frames():
+    frames: list[tuple[bytes, bytes]] = []  # (direction, payload)
+
+    class RecordingSocket:
+        """Wraps the client socket, recording raw frames both ways."""
+
+        def __init__(self, sock):
+            self._sock = sock
+            self._rx = b""
+
+        def sendall(self, data):
+            # client frames arrive fully formed (len+payload)
+            (n,) = struct.unpack(">I", data[:4])
+            assert len(data) == 4 + n
+            frames.append((b">", data[4:]))
+            self._sock.sendall(data)
+
+        def recv(self, n):
+            chunk = self._sock.recv(n)
+            self._rx += chunk
+            while len(self._rx) >= 4:
+                (ln,) = struct.unpack(">I", self._rx[:4])
+                if len(self._rx) < 4 + ln:
+                    break
+                frames.append((b"<", self._rx[4 : 4 + ln]))
+                self._rx = self._rx[4 + ln :]
+            return chunk
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "sidecar.sock")
+        srv = sidecar.SidecarServer(
+            path,
+            scheduler=TPUScheduler(
+                profile=fit_only_profile(), batch_size=8, chunk_size=1
+            ),
+        )
+        srv.serve_background()
+        try:
+            client = sidecar.SidecarClient(path)
+            client.sock = RecordingSocket(client.sock)
+            nodes, bound, pending = scenario_objects()
+            for n in nodes:
+                client.add("Node", n)
+            for p in bound:
+                client.add("Pod", p)
+            client.add(
+                "PodDisruptionBudget",
+                t.PodDisruptionBudget(
+                    name="base-pdb",
+                    namespace="default",
+                    selector=t.LabelSelector(match_labels=(("app", "base"),)),
+                    disruptions_allowed=2,
+                ),
+            )
+            results = client.schedule(pods=pending, drain=True)
+            # Deleting a bound pod frees 3 cpu: the object-aware fit hint
+            # wakes "picky" (2 cpu) but not "huge" (99 cpu); after its
+            # backoff expires the drain binds it.
+            client.remove("Pod", "default/bound-2")
+            import time
+
+            time.sleep(1.2)
+            results2 = client.schedule(pods=[], drain=True)
+            return frames, results, results2
+        finally:
+            srv.close()
+
+
+def main():
+    os.makedirs(GOLDEN, exist_ok=True)
+    frames, results, results2 = record_frames()
+    out = os.path.join(GOLDEN, "basic_session.framestream")
+    with open(out, "wb") as f:
+        for direction, payload in frames:
+            f.write(direction + struct.pack(">I", len(payload)) + payload)
+    # Human-readable summary next to the binary (review aid; not asserted).
+    summary = {
+        "frames": len(frames),
+        "schedule_results": [
+            {
+                "pod": r.pod_uid,
+                "node": r.node_name,
+                "nominated": r.nominated_node,
+                "victims": list(r.victim_uids),
+            }
+            for r in results
+        ],
+        "after_delete": [
+            {"pod": r.pod_uid, "node": r.node_name} for r in results2
+        ],
+    }
+    with open(os.path.join(GOLDEN, "basic_session.json"), "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+    # Canonical-JSON object fixtures for the Go converter test.
+    nodes, bound, _pending = scenario_objects()
+    with open(os.path.join(GOLDEN, "golden_node.json"), "wb") as f:
+        f.write(serialize.to_json(nodes[0]))
+    pod = (
+        make_pod("golden", namespace="ns1")
+        .req({"cpu": "1500m", "memory": "2Gi"})
+        .label("app", "web")
+        .priority(7)
+        .toleration("dedicated", value="gpu", effect=t.EFFECT_NO_SCHEDULE)
+        .host_port(8080)
+        .pod_anti_affinity_in("app", ["web"], "topology.kubernetes.io/zone")
+        .spread_constraint(
+            1, "topology.kubernetes.io/zone", t.DO_NOT_SCHEDULE, "app", ["web"]
+        )
+        .obj()
+    )
+    with open(os.path.join(GOLDEN, "golden_pod.json"), "wb") as f:
+        f.write(serialize.to_json(pod))
+    print(f"wrote {len(frames)} frames + object fixtures to {GOLDEN}")
+
+
+if __name__ == "__main__":
+    main()
