@@ -30,6 +30,12 @@ import jax
 # ((capacity-requested)*MaxNodeScore)/capacity must truncate identically).
 # Kubernetes memory quantities are int64 bytes and exceed int32 range.
 jax.config.update("jax_enable_x64", True)
+# All matmuls in this framework are integer-count/score math cast to f32
+# for the MXU (domain tables, selector masks, weighted sums).  The TPU
+# default (bfloat16 passes) truncates integers above 256 — a domain holding
+# 300 pods would read back as 298/302 and flip exact skew/affinity
+# comparisons — so force full-f32 accumulation: counts < 2^24 stay exact.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persist XLA compilations across processes: the batch pass compiles once per
 # (profile, schema, batch-size) and those shapes are stable run-to-run.

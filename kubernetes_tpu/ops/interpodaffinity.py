@@ -45,7 +45,6 @@ from ..framework.config import MAX_NODE_SCORE
 from ..intern import term_key
 from ..snapshot import _bucket
 from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
-from .podtopologyspread import groups_matching
 
 # Existing-term categories (intern.term_id).
 CAT_REQ_AFF, CAT_REQ_ANTI, CAT_PREF_AFF, CAT_PREF_ANTI = 0, 1, 2, 3
@@ -93,7 +92,7 @@ def _own_term_feats(
         valid[i] = True
         slots[i] = builder.ensure_topo_key(term.topology_key)
         ns_ids = _term_group_ns_ids(term, pod, fctx)
-        m = groups_matching(fctx.interns, builder.schema.G, ns_ids, term.label_selector)
+        m = builder.group_index.match_selector(term.label_selector, ns_ids)
         masks[i, : m.shape[0]] = m
         if weights is not None:
             wvec[i] = weights[i]
@@ -148,29 +147,37 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
         )
     )
 
-    # Match the pod against every interned existing-pod term.  The terms'
-    # topology slots/host flags are batch-invariant and live in the engine's
-    # DomTables (built by SnapshotBuilder.batch_invariants), not per pod.
+    # Match the pod against every interned existing-pod term: one COLUMN of
+    # the incremental term↔group matrix (intern.TermIndex) — pods with
+    # identical (namespace, labels) share a group, so this replaces the
+    # per-pod O(ET) Python loop that dominated featurization on the
+    # affinity-heavy configs (BASELINE #3).  The terms' topology slots/host
+    # flags are batch-invariant and live in the engine's DomTables.
     builder._ensure(ET=max(len(it.terms), 1))
     et = builder.schema.ET
     et_match = np.zeros(et, np.bool_)
     et_anti = np.zeros(et, np.bool_)
     et_w = np.zeros(et, np.int64)
     hard_w = fctx.profile.hard_pod_affinity_weight if fctx.profile else 1
-    for tid in range(len(it.terms)):
-        key = it.terms.value(tid)
-        cat, weight = key[0], key[1]
-        if not _term_matches_pod(key, pod, builder.namespace_labels):
-            continue
-        et_match[tid] = True
-        if cat == CAT_REQ_ANTI:
-            et_anti[tid] = True
-        elif cat == CAT_REQ_AFF:
-            et_w[tid] = hard_w
-        elif cat == CAT_PREF_AFF:
-            et_w[tid] = weight
-        elif cat == CAT_PREF_ANTI:
-            et_w[tid] = -weight
+    gid = it.group_id(pod.namespace, pod.metadata.labels)
+    builder.term_index.sync(builder.ns_epoch)
+    col, cats, weights = builder.term_index.column(gid)
+    nt = col.shape[0]
+    et_match[:nt] = col
+    et_anti[:nt] = col & (cats == CAT_REQ_ANTI)
+    et_w[:nt] = np.where(
+        col,
+        np.where(
+            cats == CAT_REQ_AFF,
+            hard_w,
+            np.where(
+                cats == CAT_PREF_AFF,
+                weights,
+                np.where(cats == CAT_PREF_ANTI, -weights, 0),
+            ),
+        ),
+        0,
+    )
     feats.update(ipa_et_match=et_match, ipa_et_anti=et_anti, ipa_et_w=et_w)
     return feats
 
@@ -191,7 +198,20 @@ def _own_term_tallies(state, dom, slots, masks, host):
     cnt_node = masks @ state.group_counts.astype(jnp.float32)  # (T, N)
     gd = jnp.take(dom.group_dom, slots, axis=1)  # (G, T, DV)
     tbl = jnp.einsum("tg,gtd->td", masks, gd)  # (T, DV)
-    gathered = jnp.take_along_axis(tbl, jnp.clip(vals, 0, tbl.shape[1] - 1), axis=1)
+    # Read tbl back per node via the hoisted one-hot — an MXU contraction,
+    # not a take_along_axis: node-axis gathers are the slow path on TPU
+    # (this was ~60% of the IPA-active per-pod cost).  The slot one-hot
+    # keeps the contraction over the shared (N, TK·DV) table — a per-pod
+    # take of dom.onehot would materialize (N, T, DV) per batch lane.
+    # Invalid topo values have all-zero one-hot rows, so key_present
+    # masking is preserved.
+    n, tk, dv = dom.onehot.shape
+    slot_oh = (slots[:, None] == jnp.arange(tk)[None, :]).astype(jnp.float32)
+    # Explicit order: expand tbl over its slot (tiny), then ONE flat
+    # (T, TK·DV)×(TK·DV, N) MXU matmul — a single einsum here lets XLA
+    # pick a contraction order that materializes (T, N, DV) per lane.
+    tbl_kd = jnp.einsum("td,tk->tkd", tbl, slot_oh).reshape(-1, tk * dv)
+    gathered = tbl_kd @ dom.onehot.reshape(n, tk * dv).T  # (T, N)
     at_node = jnp.where(key_present, jnp.where(host[:, None], cnt_node, gathered), 0.0)
     return vals, key_present, cnt_node, at_node, tbl
 
@@ -237,9 +257,13 @@ def _existing_anti_fail(state, pf, ctx: PassContext):
         jnp.where(nonhost[:, None], slot_oh, 0.0),
         (dom.et_dom > 0.5).astype(jnp.float32),
     )  # (TK, DV)
-    dvals = state.topo_vals  # (N, TK)
-    hit = forbidden_kd[jnp.arange(tk)[None, :], jnp.clip(dvals, 0, dv - 1)]  # (N, TK)
-    fail_nonhost = ((hit > 0.5) & (dvals >= 0)).any(axis=1)
+    # Read-back as ONE flat (TK·DV) matvec against the hoisted one-hot
+    # (gather-free; invalid topo values have all-zero one-hot rows, so the
+    # summed hit count only sees present keys — a node fails iff any of
+    # its domains is forbidden ⟺ the sum is positive).
+    n, tk2, dv2 = dom.onehot.shape
+    hit_sum = dom.onehot.reshape(n, tk2 * dv2) @ forbidden_kd.reshape(tk2 * dv2)
+    fail_nonhost = hit_sum > 0.5
     host_active = (active_e & dom.et_host).astype(jnp.float32)
     key_e = dom.et_vals >= 0  # (ET, N)
     fail_host = (
@@ -297,9 +321,10 @@ def score_fn(state, pf, ctx: PassContext, feasible):
         slot_oh,
         dom.et_dom,
     )  # (TK, DV)
-    dvals = state.topo_vals  # (N, TK)
-    hit = wsum_kd[jnp.arange(tk)[None, :], jnp.clip(dvals, 0, dv - 1)]  # (N, TK)
-    raw += jnp.where(dvals >= 0, hit, 0.0).sum(axis=1)
+    # One flat matvec against the hoisted one-hot (see filter; invalid
+    # topo values contribute zero rows, replacing the dvals>=0 mask).
+    n2, tk2, dv2 = dom.onehot.shape
+    raw += dom.onehot.reshape(n2, tk2 * dv2) @ wsum_kd.reshape(tk2 * dv2)
     host_w = jnp.where(active_e & dom.et_host, wts, 0.0)
     key_e = dom.et_vals >= 0  # (ET, N)
     raw += host_w @ (state.et_counts.astype(jnp.float32) * key_e)

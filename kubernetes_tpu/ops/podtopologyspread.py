@@ -50,8 +50,10 @@ MAX_INT32 = np.int64(2**31 - 1)
 
 def groups_matching(it, g_cap: int, ns_ids: set[int] | None, selector) -> np.ndarray:
     """(G,) bitmask of pod label-groups matched by ``selector`` within the
-    given namespace-id set (None = any namespace).  The host-side analog of
-    countPodsMatchSelector (podtopologyspread/common.go)."""
+    given namespace-id set (None = any namespace) — the host-side analog of
+    countPodsMatchSelector (podtopologyspread/common.go).  Scalar reference
+    implementation; the hot paths use the vectorized
+    GroupIndex.match_selector (intern.py), which must stay equivalent."""
     mask = np.zeros(g_cap, np.bool_)
     for gid in range(len(it.groups)):
         ns_id, fs = it.groups.value(gid)  # type: ignore[misc]
@@ -88,7 +90,7 @@ def _constraint_feats(
         hostname[i] = c.topology_key == HOSTNAME_KEY
         honor_aff[i] = c.node_affinity_policy == t.POLICY_HONOR
         honor_taint[i] = c.node_taints_policy == t.POLICY_HONOR
-        m = groups_matching(it, builder.schema.G, {ns_id}, c.label_selector)
+        m = builder.group_index.match_selector(c.label_selector, {ns_id})
         masks[i, : m.shape[0]] = m
     return {
         f"{prefix}_valid": valid,
@@ -190,7 +192,20 @@ def filter_fn(state, pf, ctx: PassContext):
     tbl = tbl.astype(jnp.int64)
     min_g = jnp.min(jnp.where(present, tbl, MAX_INT32), axis=1)  # (C,)
     dom_g = present.sum(axis=1)
-    match_g = jnp.take_along_axis(tbl, jnp.clip(vals, 0, ctx.schema.DV - 1), axis=1)
+    # Table read-back as a one-hot MXU contraction, not a node-axis gather
+    # (gathers are the TPU slow path; invalid vals have all-zero one-hot
+    # rows and are masked by key_present downstream).  Contract over the
+    # shared (N, TK·DV) one-hot via the slot one-hot — a per-pod take of
+    # the table would materialize (N, C, DV) per batch lane.
+    oh = _onehot(ctx)
+    n_, tk_, dv_ = oh.shape
+    slot_oh = (
+        pf["tps_h_slot"][:, None] == jnp.arange(tk_)[None, :]
+    ).astype(jnp.float32)
+    tbl_kd = jnp.einsum(
+        "cd,ck->ckd", tbl.astype(jnp.float32), slot_oh
+    ).reshape(-1, tk_ * dv_)
+    match_g = (tbl_kd @ oh.reshape(n_, tk_ * dv_).T).astype(jnp.int64)
     # Hostname fast path: every domain is a single node (its vocabulary is
     # excluded from DV), so counts/minima are per-node reductions.
     cnt_i = cnt.astype(jnp.int64)
@@ -230,7 +245,16 @@ def score_fn(state, pf, ctx: PassContext, feasible):
         ctx.schema.DV,
         _onehot(ctx),
     )
-    pair_cnt = jnp.take_along_axis(tbl, jnp.clip(vals, 0, ctx.schema.DV - 1), axis=1)  # (C, N)
+    # One-hot contraction instead of a node-axis gather (see filter_fn).
+    oh_s = _onehot(ctx)
+    n_, tk_, dv_ = oh_s.shape
+    slot_oh_s = (
+        pf["tps_s_slot"][:, None] == jnp.arange(tk_)[None, :]
+    ).astype(jnp.float32)
+    tbl_kd_s = jnp.einsum(
+        "cd,ck->ckd", tbl.astype(jnp.float32), slot_oh_s
+    ).reshape(-1, tk_ * dv_)
+    pair_cnt = (tbl_kd_s @ oh_s.reshape(n_, tk_ * dv_).T).astype(tbl.dtype)  # (C, N)
     # Hostname counts the node's own pods directly, with no counting-
     # eligibility mask (scoring.go:254 uses nodeInfo.Pods).
     cnt_for_node = jnp.where(pf["tps_s_hostname"][:, None], cnt_raw, pair_cnt)

@@ -106,7 +106,11 @@ class TPUScheduler:
         assert batch_size % chunk_size == 0, "batch_size must be a chunk multiple"
         self.chunk_size = chunk_size
         # Strict tail batches are padded to this fixed shape (one compile).
-        self.tail_size = min(batch_size, 256)
+        # Small on purpose: the chunk=1 tail pass costs one scan step per
+        # SLOT whether occupied or not, so a 64-slot tail is 4× cheaper
+        # than 256 for the common few-dozen-deferral case; large deferral
+        # bursts are first drained by a chunked replay (see _complete_batch).
+        self.tail_size = min(batch_size, 64)
         self.interns = InternTable()
         self.builder = SnapshotBuilder(self.interns)
         self.cache = Cache(self.builder)
@@ -170,10 +174,20 @@ class TPUScheduler:
             self.builder.ensure_topo_key(key)
 
     def warm_tail(self) -> None:
-        """Pre-compile the strict tail pass (chunk=1) with an all-invalid
-        batch so a mid-run deferral doesn't pay XLA compilation inside a
-        measured window.  No-op when nothing has been scheduled yet or in
-        strict mode."""
+        """Pre-compile the programs a measured window would otherwise
+        compile lazily: the dirty-row scatter flush (always) and the strict
+        tail pass (chunked mode, once a batch has established shapes)."""
+        # Warmup binds are device-side commits (never dirty), so without
+        # this the first host-side mutation (node churn, a delete) pays the
+        # scatter's XLA compile inside the measured window.  The device
+        # mirror must exist first — a flush against no mirror takes the
+        # full-rebuild branch and compiles nothing — and flushing a clean
+        # row is idempotent (host == device values).
+        if self.cache.nodes:
+            self.builder.state()  # ensure the mirror exists
+            rec = next(iter(self.cache.nodes.values()))
+            self.builder._dirty_rows.add(rec.row)
+            self.builder.state()
         if self.chunk_size == 1 or self._last_batch_meta is None:
             return
         shapes, active = self._last_batch_meta
@@ -801,7 +815,7 @@ class TPUScheduler:
         return dict(
             work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
             new_state=new_state, result=result, t1=t1,
-            schema=self.builder.schema,
+            schema=self.builder.schema, chunk=chunk,
         )
 
     def _schedule_infos(
@@ -853,46 +867,67 @@ class TPUScheduler:
             picks, scores, feas, fails = (
                 picks.copy(), scores.copy(), feas.copy(), fails.copy()
             )
-            strict = self.passes.get(
-                profile, self.builder.schema, self.builder.res_col, active, 1
-            )
-            ts = self.tail_size
-            for lo in range(0, len(deferred), ts):
-                idx = deferred[lo : lo + ts]
-                sub, sub_deltas, _ = build_pod_batch(
-                    [infos[i].pod for i in idx], self.builder, profile,
-                    ts, force_active=active,
-                )
-                sub["nominated_row"] = np.full(ts, -1, np.int32)
-                sub["nominated_row"][: len(idx)] = nomrow[idx]
-                for j, i in enumerate(idx):
-                    deltas[i] = sub_deltas[j]
-                # Per-pod bucket dims (own terms, devices) are padded to the
-                # sub-batch max; pad up to the original batch's shapes so the
-                # compiled tail sees one shape set.
-                from .ops.common import FEATURE_FILLS
-
-                for key2, arr in sub.items():
-                    tgt = batch[key2].shape[1:]
-                    if arr.shape[1:] != tgt:
-                        padw = [(0, 0)] + [
-                            (0, tg - cur) for cur, tg in zip(arr.shape[1:], tgt)
-                        ]
-                        sub[key2] = np.pad(
-                            arr, padw, constant_values=FEATURE_FILLS.get(key2, 0)
-                        )
-                sub_d = jax.device_put(sub)  # one coalesced transfer
-                new_state, res = strict(
-                    new_state, sub_d, ctx["inv_d"], np.uint32(self._cycle)
-                )
-                p2, s2, f2, fl2 = jax.device_get(
-                    (res.picks, res.scores, res.feasible_counts, res.fail_masks)
-                )
-                self._cycle += len(idx)
-                picks[idx], scores[idx], feas[idx], fails[idx] = (
-                    p2[: len(idx)], s2[: len(idx)], f2[: len(idx)], fl2[: len(idx)],
-                )
             self.metrics.deferred += len(deferred)
+
+            def run_tail(idx_list: list[int], chunk_level: int, size: int) -> list[int]:
+                """Re-featurize + re-run the given pods against the committed
+                state; fills the result arrays and returns indices that
+                deferred AGAIN (possible only when chunk_level > 1)."""
+                nonlocal new_state
+                run2 = self.passes.get(
+                    profile, self.builder.schema, self.builder.res_col,
+                    active, chunk_level,
+                )
+                still: list[int] = []
+                for lo in range(0, len(idx_list), size):
+                    idx = idx_list[lo : lo + size]
+                    sub, sub_deltas, _ = build_pod_batch(
+                        [infos[i].pod for i in idx], self.builder, profile,
+                        size, force_active=active,
+                    )
+                    sub["nominated_row"] = np.full(size, -1, np.int32)
+                    sub["nominated_row"][: len(idx)] = nomrow[idx]
+                    for j, i in enumerate(idx):
+                        deltas[i] = sub_deltas[j]
+                    # Per-pod bucket dims (own terms, devices) are padded to
+                    # the sub-batch max; pad up to the original batch's
+                    # shapes so the compiled pass sees one shape set.
+                    from .ops.common import FEATURE_FILLS
+
+                    for key2, arr in sub.items():
+                        tgt = batch[key2].shape[1:]
+                        if arr.shape[1:] != tgt:
+                            padw = [(0, 0)] + [
+                                (0, tg - cur) for cur, tg in zip(arr.shape[1:], tgt)
+                            ]
+                            sub[key2] = np.pad(
+                                arr, padw, constant_values=FEATURE_FILLS.get(key2, 0)
+                            )
+                    sub_d = jax.device_put(sub)  # one coalesced transfer
+                    new_state, res = run2(
+                        new_state, sub_d, ctx["inv_d"], np.uint32(self._cycle)
+                    )
+                    p2, s2, f2, fl2 = jax.device_get(
+                        (res.picks, res.scores, res.feasible_counts, res.fail_masks)
+                    )
+                    self._cycle += len(idx)
+                    picks[idx], scores[idx], feas[idx], fails[idx] = (
+                        p2[: len(idx)], s2[: len(idx)], f2[: len(idx)], fl2[: len(idx)],
+                    )
+                    still.extend(i for j, i in enumerate(idx) if p2[j] == -2)
+                return still
+
+            # Round 1 — large bursts replay through the SAME chunked program
+            # against the committed state: most deferrals are positional
+            # (e.g. a freshly-added empty node attracting every chunk-mate,
+            # the churn-workload magnet); once earlier commits are visible
+            # they place cleanly in one pass instead of one scan step each.
+            if ctx["chunk"] > 1 and len(deferred) > self.tail_size:
+                deferred = run_tail(deferred, ctx["chunk"], self.batch_size)
+            # Round 2 — strict sequential-equivalent finisher (chunk=1
+            # never defers, so this always terminates).
+            if deferred:
+                run_tail(deferred, 1, self.tail_size)
         t2 = time.perf_counter()
         self._last_batch_meta = (
             {k: (v.shape, np.asarray(v).dtype) for k, v in batch.items()},

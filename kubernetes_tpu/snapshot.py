@@ -224,12 +224,21 @@ class SnapshotBuilder:
     def __init__(self, interns: InternTable | None = None, schema: Schema | None = None):
         self.interns = interns or InternTable()
         self.schema = schema or Schema()
+        # Vectorized selector↔group matching + the incremental (ET, G)
+        # term↔group match matrix — the featurization hot path (replaces
+        # per-pod Python loops over every interned term/group).
+        from .intern import GroupIndex, TermIndex
+
+        self.group_index = GroupIndex(self.interns)
         # Namespace → labels, for namespaceSelector matching in affinity terms
         # (the analog of the scheduler's namespace lister snapshot,
         # interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).  Update via
         # set_namespace_labels (bumps ns_epoch for the featurization cache).
         self.namespace_labels: dict[str, dict[str, str]] = {}
         self.ns_epoch = 0
+        self.term_index = TermIndex(
+            self.interns, self.group_index, self.namespace_labels
+        )
         # Optional multi-chip mesh: node axis sharded, everything else
         # replicated (parallel/mesh.py).
         self.mesh = None
@@ -406,22 +415,28 @@ class SnapshotBuilder:
     def feature_version(self) -> tuple:
         """Cheap O(#vocabs) token identifying everything pod featurization
         can read besides the pod itself; any change invalidates cached
-        features.  Called once per cache-missing pod — no content hashing."""
+        features (and drops the prefetched batch).  Called once per
+        cache-missing pod — no content hashing.
+
+        Deliberately EXCLUDES vocabularies whose growth cannot change any
+        cached feature: node_names / label_keys / label_pairs / ports /
+        images / topo value vocabularies are referenced by STABLE ids inside
+        compiled requirement programs and delta vectors, never by
+        vocabulary-sized arrays.  (Node churn interns a fresh node name +
+        hostname value every add — including those here re-featurized every
+        batch and killed the prefetch overlap: the r2 mixed-churn laggard.)
+        terms/groups stay: ET/G-sized masks AND the batch-ordering
+        invariant (engine/features.py) both depend on them; taints stay
+        (TV-sized toleration masks)."""
         it = self.interns
         return (
             self.schema,
             len(it.terms),
             len(it.groups),
             len(it.namespaces),
-            len(it.label_keys),
-            len(it.label_pairs),
             len(it.taints),
             len(it.devices),
             len(it.drivers),
-            len(it.ports),
-            len(it.images),
-            len(it.node_names),
-            tuple(len(v) for v in it.topo_vals),
             len(it.device_classes),
             self.volumes.epoch,
             self.dra.epoch,

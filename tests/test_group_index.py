@@ -1,0 +1,167 @@
+"""Vectorized selector/term matching (intern.GroupIndex / TermIndex) must be
+equivalent to the scalar reference paths (label_selector_matches /
+groups_matching) — the same oracle pattern SURVEY §4 prescribes for the
+device ops, applied to the featurization hot path."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.intern import InternTable
+from kubernetes_tpu.ops.podtopologyspread import groups_matching
+from kubernetes_tpu.snapshot import SnapshotBuilder
+
+
+def _random_selector(rng) -> t.LabelSelector:
+    # Vocabulary wide enough to cross the 64-column initial matrix capacity
+    # (the incremental-growth/boundary paths are where the bugs live).
+    kind = rng.randrange(5)
+    key = f"k{rng.randrange(12)}"
+    vals = tuple(f"v{rng.randrange(10)}" for _ in range(rng.randrange(1, 3)))
+    if kind == 0:
+        return t.LabelSelector(match_labels=((key, vals[0]),))
+    if kind == 1:
+        return t.LabelSelector(
+            match_expressions=(t.LabelSelectorRequirement(key, t.OP_IN, vals),)
+        )
+    if kind == 2:
+        return t.LabelSelector(
+            match_expressions=(t.LabelSelectorRequirement(key, t.OP_NOT_IN, vals),)
+        )
+    if kind == 3:
+        return t.LabelSelector(
+            match_expressions=(t.LabelSelectorRequirement(key, t.OP_EXISTS),)
+        )
+    return t.LabelSelector(
+        match_expressions=(
+            t.LabelSelectorRequirement(key, t.OP_IN, vals),
+            t.LabelSelectorRequirement(f"k{rng.randrange(4)}", t.OP_DOES_NOT_EXIST),
+        )
+    )
+
+
+def _random_labels(rng) -> dict:
+    return {
+        f"k{i}": f"v{rng.randrange(10)}"
+        for i in range(12)
+        if rng.random() < 0.4
+    }
+
+
+def test_group_index_matches_scalar_reference():
+    rng = random.Random(7)
+    b = SnapshotBuilder()
+    it = b.interns
+    # Interleave group creation and matching so the incremental growth paths
+    # (new pairs, new keys, capacity doubling) are all exercised.
+    for round_ in range(30):
+        for _ in range(5):
+            ns = f"ns{rng.randrange(3)}"
+            it.group_id(ns, _random_labels(rng))
+        sel = _random_selector(rng)
+        ns_ids = {it.namespaces.id(f"ns{rng.randrange(3)}")} if rng.random() < 0.5 else None
+        want = groups_matching(it, len(it.groups), ns_ids, sel)
+        got = b.group_index.match_selector(sel, ns_ids)
+        assert np.array_equal(got, want[: got.shape[0]]), (round_, sel)
+    # None selector selects nothing; empty selector selects everything.
+    assert not b.group_index.match_selector(None).any()
+    assert b.group_index.match_selector(t.LabelSelector()).all()
+
+
+def test_match_selector_pair_interned_outside_sync():
+    """A label pair interned past the matrix capacity by a NON-group path
+    (term encoding, node rows) must read as carried-by-no-group, not crash
+    (r3 review: IndexError at the power-of-two column boundary)."""
+    b = SnapshotBuilder()
+    it = b.interns
+    it.group_id("default", {"app": "web"})
+    b.group_index.sync()
+    # Fill the pair vocabulary to (past) the initial 64-column capacity
+    # without creating any new group.
+    for i in range(70):
+        it.label_pairs.id(("boundary", f"v{i}"))
+    sel = t.LabelSelector(
+        match_expressions=(
+            t.LabelSelectorRequirement("boundary", t.OP_IN, ("v65",)),
+        )
+    )
+    assert not b.group_index.match_selector(sel).any()
+    sel2 = t.LabelSelector(match_labels=(("boundary", "v66"),))
+    assert not b.group_index.match_selector(sel2).any()
+
+
+def test_term_index_empty_in_values():
+    """In with an empty value set matches nothing, regardless of whether
+    the group was interned before or after the term."""
+    b = SnapshotBuilder()
+    it = b.interns
+    g_before = it.group_id("default", {"app": "web"})
+    term = t.PodAffinityTerm(
+        label_selector=t.LabelSelector(
+            match_expressions=(t.LabelSelectorRequirement("app", t.OP_IN, ()),)
+        ),
+        topology_key="z",
+        namespaces=("default",),
+    )
+    tid = it.term_id(1, 1, term, "default")
+    b.term_index.sync(b.ns_epoch)
+    g_after = it.group_id("default", {"app": "db"})
+    b.term_index.sync(b.ns_epoch)
+    assert not b.term_index.column(g_before)[0][tid]
+    assert not b.term_index.column(g_after)[0][tid]
+
+
+def _scalar_term_match(it, builder, tid, gid) -> bool:
+    from kubernetes_tpu.ops.interpodaffinity import _term_matches_pod
+
+    ns, labels = it.group_labels(gid)
+    pod = t.Pod(metadata=t.ObjectMeta(name="x", namespace=ns, labels=labels))
+    return _term_matches_pod(it.terms.value(tid), pod, builder.namespace_labels)
+
+
+def test_term_index_matches_scalar_reference():
+    rng = random.Random(11)
+    b = SnapshotBuilder()
+    it = b.interns
+    b.set_namespace_labels("ns0", {"team": "red"})
+    b.set_namespace_labels("ns1", {"team": "blue"})
+    for round_ in range(20):
+        # New groups and terms arrive interleaved (the mid-batch pattern).
+        for _ in range(4):
+            it.group_id(f"ns{rng.randrange(3)}", _random_labels(rng))
+        for _ in range(3):
+            term = t.PodAffinityTerm(
+                label_selector=_random_selector(rng),
+                topology_key="topology.kubernetes.io/zone",
+                namespaces=(f"ns{rng.randrange(3)}",) if rng.random() < 0.7 else (),
+                namespace_selector=(
+                    t.LabelSelector(match_labels=(("team", "red"),))
+                    if rng.random() < 0.3
+                    else None
+                ),
+            )
+            it.term_id(rng.randrange(4), rng.randrange(1, 100), term, "ns0")
+        b.term_index.sync(b.ns_epoch)
+        for gid in range(len(it.groups)):
+            col, _cats, _w = b.term_index.column(gid)
+            for tid in range(len(it.terms)):
+                want = _scalar_term_match(it, b, tid, gid)
+                assert col[tid] == want, (round_, tid, gid, it.terms.value(tid))
+
+
+def test_term_index_ns_epoch_invalidation():
+    b = SnapshotBuilder()
+    it = b.interns
+    gid = it.group_id("ns0", {"app": "web"})
+    term = t.PodAffinityTerm(
+        label_selector=t.LabelSelector(match_labels=(("app", "web"),)),
+        topology_key="z",
+        namespace_selector=t.LabelSelector(match_labels=(("team", "red"),)),
+    )
+    tid = it.term_id(1, 1, term, "ns0")
+    b.term_index.sync(b.ns_epoch)
+    assert not b.term_index.column(gid)[0][tid]  # ns0 has no labels yet
+    b.set_namespace_labels("ns0", {"team": "red"})
+    b.term_index.sync(b.ns_epoch)
+    assert b.term_index.column(gid)[0][tid]
