@@ -12,33 +12,44 @@ TPU design: both parallel axes of the reference map onto one dispatch — the
 candidate-node axis is the device vector axis, and the queue of failed pods
 becomes a `lax.scan` whose carry commits each preemption's resource release
 before the next preemptor looks (mirroring the scheduling pass).  The host
-packs every node's pods sorted least-important-first (priority asc,
-start-time desc) into (N, V) tensors once per batch; each scan step masks the
-entries below its own preemptor's priority, prefix-sums their releases, finds
-the minimal fitting prefix k*(n) per node, excludes nodes any unresolvable
-filter rejects (the UnschedulableAndUnresolvable analog, :216), and reduces
-the pick criteria as masked argmins.  Chosen victims are marked consumed in
-the carried tensors so later preemptors in the batch cannot double-claim
-them.  Unlike the reference, which dry-runs only a rotating percentage of
-candidates, the full node axis is evaluated.
+packs every node's pods into (N, V) tensors once per batch (non-violating
+first, least-important-first within each class — violating classified with
+simulated per-PDB budget consumption most-important-first, exactly
+filterPodsWithPDBViolation); each scan step masks the entries below its own
+preemptor's priority and excludes nodes any unresolvable filter rejects
+(the UnschedulableAndUnresolvable analog, :216).  Chosen victims are marked
+consumed in the carried tensors so later preemptors in the batch cannot
+double-claim them.  Unlike the reference, which dry-runs only a rotating
+percentage of candidates, the full node axis is evaluated.
 
-Candidacy and victim counts run the preemptor's FULL active filter set
+Candidacy and feasibility run the preemptor's FULL active filter set
 against per-node what-if states (resources, pod counts, group/term/port
-tensors released via scatter), found by a lockstep binary search over
-victim slot-prefixes — one filter evaluation per search iteration.  This
-kills the r1 false negative (a node whose only failure was a victim's host
-port or anti-affinity pair was never nominated).
+tensors released via scatter) — a node whose only failure is a victim's
+host port or anti-affinity pair is still found (the r1 false negative).
 
-Divergences (documented): victim selection takes the minimal fitting PREFIX
-of the (non-PDB-violating first, then least-important-first) list, whereas
-the reference's SelectVictimsOnNode greedily reprieves most-important-first
-and can keep a non-contiguous subset — for multi-resource fits the prefix
-rule may evict a different (never smaller-priority-first) set.  The binary
-search assumes filters are monotone in pod removals (true for fit, ports,
-and anti-affinity; PodTopologySpread's min-domain interplay is the
-near-exception).  Later preemptors in one batch see consumed victims'
-group/term/port counts un-released (conservative; the retry runs against
-truth).  Volume state is not released in the what-if.
+Victim selection is the reference's GREEDY REPRIEVE (SelectVictimsOnNode):
+start from every lower-priority pod removed, then walk victims in reverse
+slot order — violating most-important-first, then non-violating
+most-important-first, the reference's exact reprieve order — re-admitting
+each one whose return keeps the preemptor feasible, yielding possibly
+NON-CONTIGUOUS victim sets.  Criterion 1's violation count is thereby
+minimized per candidate, as in pickOneNodeForPreemption.  On device the
+reprieve is a lax.scan over victim slots whose carry is the per-node
+removal mask — each step one batched what-if filter evaluation (O(V) evals
+of O(N·V·R) masked sums; V buckets at 8 for realistic pods-per-node, so
+the quadratic term stays small — an incremental-carry formulation is the
+known optimization if dense nodes ever dominate).
+
+Divergences (documented): later preemptors in one batch see consumed
+victims' group/term/port counts un-released (conservative; the retry runs
+against truth).  Volume/DRA state is not released in the what-if — those
+ops contribute candidacy via hard_filter only, and nodes failing them
+evict all lower-priority pods (no reprieve) so the retry validates
+against post-eviction truth.  PDB-violation classification simulates
+budget consumption over ALL of a node's pods (preemptor-independent
+packing); with mixed preemptor priorities in one batch the reference
+classifies per preemptor over only its potential victims, which can
+order the reprieve differently.
 """
 
 from __future__ import annotations
@@ -68,8 +79,8 @@ class PreemptionResult:
 
 class PreemptStep(NamedTuple):
     picks: jax.Array  # (K,) i32 node row, -1 = no candidate
-    k_star: jax.Array  # (K,) i32 prefix length at the picked node
-    n_victims: jax.Array  # (K,) i32 victims inside that prefix
+    vic_mask: jax.Array  # (K, V) bool — chosen victims at the picked node
+    n_victims: jax.Array  # (K,) i32 victims in that mask
 
 
 def build_preempt_pass(
@@ -103,22 +114,20 @@ def build_preempt_pass(
             static.update(op.static(profile, schema, builder_res_col))
     ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
 
-    import math
-
     # Whether the active filter set reads the domain tables (rebuilt per
     # what-if inside full_ok when so).
     needs_dom = any(
         op.name in ("InterPodAffinity", "PodTopologySpread") for op in filter_ops
     )
     # Filters whose verdict can change when pods are removed from a node.
-    # NodeResourcesFit has a CLOSED FORM over victim prefixes (the resource
-    # cumsum argmax); _SEARCHABLE ops get the per-prefix what-if evaluation
-    # (their release overlays are simulated); the REST of the
-    # release-dependent set (volume/DRA tensors, whose release is not
-    # simulated) contributes only its hard_filter to candidacy — their
-    # failures are treated as preemption-resolvable, and the nominee's
-    # retry validates against truth.  Release-INdependent filters (taints,
-    # node affinity, volume zones, …) run once on the live state.
+    # NodeResourcesFit evaluates in closed form against the masked release
+    # sums; _SEARCHABLE ops get the per-mask what-if evaluation (their
+    # release overlays are simulated); the REST of the release-dependent
+    # set (volume/DRA tensors, whose release is not simulated) contributes
+    # only its hard_filter to candidacy — their failures are treated as
+    # preemption-resolvable, and the nominee's retry validates against
+    # truth.  Release-INdependent filters (taints, node affinity, volume
+    # zones, …) run once on the live state.
     _RELEASE_DEPENDENT = {
         "NodeResourcesFit", "NodePorts", "InterPodAffinity",
         "PodTopologySpread", "VolumeRestrictions", "NodeVolumeLimits",
@@ -147,38 +156,31 @@ def build_preempt_pass(
         vic_pdb, pdb_allowed,
     ):
         """One preemptor's dry-run against the given victim state: returns
-        the pick and its commit ingredients (no state mutation)."""
+        the pick and its commit ingredients (no state mutation).
+
+        Victim selection = the reference's SelectVictimsOnNode: remove ALL
+        lower-priority pods, then reprieve most-important-first (reverse
+        slot order; PDB-violating victims are packed last, so they get
+        their reprieve attempt first)."""
         n, v = vic_prio.shape
         prio = pf["priority"].astype(jnp.int32)
         lower = vic_prio < prio  # (N, V) — consumed victims carry I32_MAX
-        rel = jnp.cumsum(jnp.where(lower[:, :, None], vic_req, 0), axis=1)
-        rel = jnp.concatenate(
-            [jnp.zeros((n, 1, rel.shape[2]), rel.dtype), rel], axis=1
-        )  # (N, V+1, R)
-        rel_nz = jnp.cumsum(jnp.where(lower[:, :, None], vic_nonzero, 0), axis=1)
-        rel_nz = jnp.concatenate(
-            [jnp.zeros((n, 1, 2), rel_nz.dtype), rel_nz], axis=1
-        )
-        n_lower = jnp.cumsum(lower.astype(jnp.int32), axis=1)
-        n_lower = jnp.concatenate([jnp.zeros((n, 1), jnp.int32), n_lower], axis=1)
 
         rows2 = jnp.broadcast_to(jnp.arange(n)[:, None], (n, v))
 
-        def released(kvec):
-            """ClusterState with each node's first-kvec(n) slots' lower
-            victims removed — the per-node what-if the reference builds with
-            NodeInfo.Snapshot()+RemovePod per candidate
-            (DryRunPreemption, preemption.go:541)."""
-            mask = lower & (jnp.arange(v)[None, :] < kvec[:, None])  # (N, V)
-            rel_k = jnp.take_along_axis(
-                rel, kvec[:, None, None], axis=1
-            )[:, 0]  # (N, R)
-            relnz_k = jnp.take_along_axis(rel_nz, kvec[:, None, None], axis=1)[:, 0]
-            nl_k = jnp.take_along_axis(n_lower, kvec[:, None], axis=1)[:, 0]
+        def released(mask):
+            """ClusterState with each node's masked victims removed — the
+            per-node what-if the reference builds with NodeInfo.Snapshot()
+            + RemovePod per candidate (DryRunPreemption, preemption.go:541).
+            ``mask`` (N, V) bool."""
+            rel_m = jnp.sum(jnp.where(mask[:, :, None], vic_req, 0), axis=1)
+            relnz_m = jnp.sum(
+                jnp.where(mask[:, :, None], vic_nonzero, 0), axis=1
+            )
             new = dict(
-                req=state.req - rel_k,
-                nonzero_req=state.nonzero_req - relnz_k,
-                num_pods=state.num_pods - nl_k,
+                req=state.req - rel_m,
+                nonzero_req=state.nonzero_req - relnz_m,
+                num_pods=state.num_pods - mask.sum(axis=1).astype(jnp.int32),
             )
             if "group" in vfeat:
                 g = vfeat["group"]  # (N, V)
@@ -209,33 +211,32 @@ def build_preempt_pass(
             base_ok &= op.filter(state, pf, dctx)
         # Resolvable-but-unsimulated ops (DRA, volume limits/conflicts):
         # only their UNRESOLVABLE portion constrains candidacy (missing
-        # claims, allocation pins — the hard_filter contract).  Track which
-        # nodes currently FAIL such an op: they need victims even when the
-        # resource prefix is empty (the eviction is what frees the
-        # device/volume; see the k-bump below).
+        # claims, allocation pins — the hard_filter contract).  Nodes
+        # currently failing such an op need the eviction itself to free the
+        # device/volume: every lower-priority pod goes, no reprieve, and
+        # the retry validates against post-eviction truth.
         res_fail = jnp.zeros(state.valid.shape, jnp.bool_)
         for op in resolvable_ops:
             base_ok &= ~op.hard_filter(state, pf, dctx)
             if op.filter is not None:
                 res_fail |= ~op.filter(state, pf, dctx)
 
-        # NodeResourcesFit over every prefix, closed form: resources and
-        # pod-count checks against the release cumsums.
         demand = pf["req"]  # (R,)
-        free = state.alloc[:, None, :] - (state.req[:, None, :] - rel)
-        fits = (
-            (demand[None, None, :] == 0) | (demand[None, None, :] <= free)
-        ).all(-1)
-        fits &= state.num_pods[:, None] - n_lower + 1 <= state.allowed_pods[:, None]
 
-        if search_ops:
-
-            def others_ok(kvec):
-                """Release-dependent non-fit filters against the released
-                state — exact candidacy (kills the r1 resources-only false
-                negative: a node whose sole failure is a victim's port or
-                anti-affinity pair)."""
-                st2 = released(kvec)
+        def ok_under(mask):
+            """Full feasibility of the preemptor with ``mask`` removed:
+            closed-form fit + the release-dependent filter set against the
+            released state (exact candidacy — a node whose sole failure is
+            a victim's port or anti-affinity pair is still found)."""
+            rel_m = jnp.sum(jnp.where(mask[:, :, None], vic_req, 0), axis=1)
+            free = state.alloc - (state.req - rel_m)
+            ok = ((demand[None, :] == 0) | (demand[None, :] <= free)).all(-1)
+            ok &= (
+                state.num_pods - mask.sum(axis=1).astype(jnp.int32) + 1
+                <= state.allowed_pods
+            )
+            if search_ops:
+                st2 = released(mask)
                 if needs_dom:
                     from .engine.pass_ import build_dom
 
@@ -244,75 +245,55 @@ def build_preempt_pass(
                     d2 = dataclasses.replace(dctx, dom=dom2)
                 else:
                     d2 = dctx
-                ok = st2.valid
                 for op in search_ops:
                     ok &= op.filter(st2, pf, d2)
-                return ok
+            return ok
 
-            def ok_at(kvec):
-                return (
-                    jnp.take_along_axis(fits, kvec[:, None], axis=1)[:, 0]
-                    & others_ok(kvec)
-                )
+        # Phase 1 — all lower-priority pods removed: the candidacy check
+        # (SelectVictimsOnNode's initial RemovePod sweep).
+        feas_all = ok_under(lower)
 
-            # Minimal victim slot-prefix per node: lockstep binary search,
-            # one what-if evaluation per iteration (filters are monotone in
-            # removals; PodTopologySpread's min-domain shift is the
-            # documented near-exception).
-            feas_max = ok_at(jnp.full((n,), v, jnp.int32))
-            lo = jnp.zeros(n, jnp.int32)
-            hi = jnp.full(n, v, jnp.int32)
-            for _ in range(max(1, math.ceil(math.log2(v + 1)))):
-                mid = (lo + hi) // 2
-                ok = ok_at(mid)
-                hi = jnp.where(ok, mid, hi)
-                lo = jnp.where(ok, lo, jnp.minimum(mid + 1, v))
-            k_star = hi
-        else:
-            # Fit-only fast path: first fitting prefix by argmax.
-            k_star = jnp.argmax(fits, axis=1).astype(jnp.int32)
-            feas_max = fits.any(axis=1)
-        # A node failing only an unsimulated-resolvable op (a victim's DRA
-        # device / volume hold) needs victims although zero may be needed
-        # resource-wise: evict every lower-priority pod there.  Criterion 4
-        # (fewest victims) keeps such nodes a last resort, and the retry
-        # validates against post-eviction truth.
-        k_star = jnp.where(res_fail, jnp.int32(v), k_star)
-        n_vic = jnp.take_along_axis(n_lower, k_star[:, None], axis=1)[:, 0]
+        # Phase 2 — greedy reprieve, most-important-first = reverse slot
+        # order (slots are least-important-first, PDB-violating last, so
+        # violating victims get their reprieve attempt first — exactly
+        # filterPodsWithPDBViolation + the two reprieve loops).  Nodes
+        # failing an unsimulated-resolvable op skip reprieve entirely.
+        can_reprieve = feas_all & ~res_fail
+
+        def reprieve_step(mask, s):
+            tentative = mask & ~(jnp.arange(v)[None, :] == s)
+            ok = ok_under(tentative)
+            take = can_reprieve & ok & mask[:, s]
+            return jnp.where(take[:, None], tentative, mask), None
+
+        vic_mask, _ = lax.scan(
+            reprieve_step, lower, jnp.arange(v - 1, -1, -1)
+        )
+
+        n_vic = vic_mask.sum(axis=1).astype(jnp.int32)
         # At least one victim, else deletion can't be what fixes this node.
-        possible = base_ok & feas_max & (n_vic >= 1) & pf["valid"]
+        possible = base_ok & feas_all & (n_vic >= 1) & pf["valid"]
 
-        idx = jnp.maximum(k_star - 1, 0)
-
-        # Running (max victim priority, earliest start AMONG those
-        # max-priority victims) — criterion 5 compares the highest-priority
-        # victims' start times only (GetEarliestPodStartTime,
-        # preemption.go pickOneNodeForPreemption).
-        def _combine(a, b):
-            ap, as_ = a
-            bp, bs = b
-            p = jnp.maximum(ap, bp)
-            s = jnp.where(
-                ap == bp,
-                jnp.minimum(as_, bs),
-                jnp.where(ap > bp, as_, bs),
-            )
-            return p, s
-
-        run_max_prio, run_start = lax.associative_scan(
-            _combine,
-            (
-                jnp.where(lower, vic_prio, -1),
-                jnp.where(lower, vic_start, jnp.inf),
+        # Criteria over the FINAL victim set (pickOneNodeForPreemption,
+        # preemption.go:424): fewest PDB violations → lowest max victim
+        # priority → smallest priority sum → fewest victims → latest
+        # earliest start AMONG the highest-priority victims
+        # (GetEarliestPodStartTime).
+        cnt_p = jnp.einsum(
+            "nv,nvp->np", vic_mask.astype(jnp.float32),
+            vic_pdb.astype(jnp.float32),
+        ).astype(jnp.int64)  # (N, P)
+        violations = jnp.maximum(cnt_p - pdb_allowed[None, :], 0).sum(axis=1)
+        max_prio = jnp.max(jnp.where(vic_mask, vic_prio, -1), axis=1)
+        prio_sum = jnp.sum(
+            jnp.where(vic_mask, vic_prio, 0).astype(jnp.int64), axis=1
+        )
+        min_start = jnp.min(
+            jnp.where(
+                vic_mask & (vic_prio == max_prio[:, None]), vic_start, jnp.inf
             ),
             axis=1,
         )
-        max_prio = jnp.take_along_axis(run_max_prio, idx[:, None], axis=1)[:, 0]
-        prio_sum = jnp.take_along_axis(
-            jnp.cumsum(jnp.where(lower, vic_prio, 0).astype(jnp.int64), axis=1),
-            idx[:, None], axis=1,
-        )[:, 0]
-        run_min_start = jnp.take_along_axis(run_start, idx[:, None], axis=1)[:, 0]
 
         big = jnp.int64(2**62)
 
@@ -320,21 +301,16 @@ def build_preempt_pass(
             best = jnp.min(jnp.where(mask, key, big))
             return mask & (key == best)
 
-        # Criterion 1 — fewest PDB violations at the chosen prefix
-        # (pickOneNodeForPreemption, preemption.go:424): per PDB, victims
-        # matched beyond its remaining allowed disruptions count as
-        # violations.
-        prefix = lower & (jnp.arange(v)[None, :] < k_star[:, None])  # (N, V)
-        cnt_p = jnp.einsum(
-            "nv,nvp->np", prefix.astype(jnp.float32), vic_pdb.astype(jnp.float32)
-        ).astype(jnp.int64)  # (N, P)
-        violations = jnp.maximum(cnt_p - pdb_allowed[None, :], 0).sum(axis=1)
-
         # Latest earliest-start wins: minimize the negated key, in
         # microseconds so sub-second differences survive the int cast.
         start_key = jnp.where(
-            jnp.isfinite(run_min_start), -run_min_start * 1e6, -jnp.float64(2**61)
+            jnp.isfinite(min_start), -min_start * 1e6, -jnp.float64(2**61)
         ).astype(jnp.int64)
+
+        rel_all = jnp.sum(jnp.where(vic_mask[:, :, None], vic_req, 0), axis=1)
+        relnz_all = jnp.sum(
+            jnp.where(vic_mask[:, :, None], vic_nonzero, 0), axis=1
+        )
 
         if chunk == 1:
             # Exact lexicographic narrowing (parity-grade semantics).
@@ -348,14 +324,13 @@ def build_preempt_pass(
             do = possible.any()
             pick = jnp.where(do, pick, -1)
             row = jnp.maximum(pick, 0)
-            kp = jnp.where(do, k_star[row], 0)
-            chosen = (jnp.arange(v) < kp) & lower[row] & do  # (V,)
-            rel_vec = jnp.where(do, rel[row, kp], 0)
-            rel_nz_vec = jnp.where(do, rel_nz[row, kp], 0)
+            chosen = vic_mask[row] & do  # (V,)
+            rel_vec = jnp.where(do, rel_all[row], 0)
+            rel_nz_vec = jnp.where(do, relnz_all[row], 0)
             nvic = jnp.where(do, n_vic[row], 0)
             return (
-                pick, kp.astype(jnp.int32), nvic.astype(jnp.int32),
-                rel_vec, rel_nz_vec, chosen,
+                pick, chosen, nvic.astype(jnp.int32),
+                rel_vec, rel_nz_vec,
             )
 
         # Chunked mode: one PACKED key per node — the five criteria as
@@ -379,16 +354,14 @@ def build_preempt_pass(
             | (sat(n_vic, 8) << 12)
             | sat((start_key + (jnp.int64(1) << 61)) >> 50, 12)
         )
-        rel_k = jnp.take_along_axis(rel, k_star[:, None, None], axis=1)[:, 0]
-        relnz_k = jnp.take_along_axis(rel_nz, k_star[:, None, None], axis=1)[:, 0]
-        return key, possible, k_star, n_vic, rel_k, relnz_k, lower
+        return key, possible, vic_mask, n_vic, rel_all, relnz_all
 
     def step(carry, pf, dctx, vfeat, vic_pdb, pdb_allowed):
         state, vic_prio, vic_req, vic_nonzero, vic_start = carry
         c = pf["valid"].shape[0]
         n, v = vic_prio.shape
         if chunk == 1:
-            picks, kps, nvics, rel_vecs, relnz_vecs, chosens = jax.vmap(
+            picks, chosens, nvics, rel_vecs, relnz_vecs = jax.vmap(
                 lambda p: eval_one(
                     state, vic_prio, vic_req, vic_nonzero, vic_start, p, dctx,
                     vfeat, vic_pdb, pdb_allowed,
@@ -401,10 +374,10 @@ def build_preempt_pass(
             # mate-0's signature (priority + request — their dry-runs would
             # be identical) take the 1st, 2nd, … best nodes by the packed
             # key, emulating the sequential take-next-best without C copies
-            # of the (N, V+1, R) release cumsums.  Mates with a different
+            # of the per-preemptor release tensors.  Mates with a different
             # signature defer to the strict chunk=1 re-run.
             pf0 = jax.tree_util.tree_map(lambda x: x[0], pf)
-            key, possible, k_star, n_vic_all, rel_k, relnz_k, lower = eval_one(
+            key, possible, vic_mask_all, n_vic_all, rel_all, relnz_all = eval_one(
                 state, vic_prio, vic_req, vic_nonzero, vic_start, pf0, dctx,
                 vfeat, vic_pdb, pdb_allowed,
             )
@@ -430,13 +403,10 @@ def build_preempt_pass(
             defer = pf["valid"] & ~has
             do = has
             rows_safe = jnp.where(do, picks, 0)
-            kps = jnp.where(do, k_star[rows_safe], 0).astype(jnp.int32)
             nvics = jnp.where(do, n_vic_all[rows_safe], 0).astype(jnp.int32)
-            rel_vecs = jnp.where(do[:, None], rel_k[rows_safe], 0)
-            relnz_vecs = jnp.where(do[:, None], relnz_k[rows_safe], 0)
-            chosens = (
-                (jnp.arange(v)[None, :] < kps[:, None]) & lower[rows_safe]
-            )
+            rel_vecs = jnp.where(do[:, None], rel_all[rows_safe], 0)
+            relnz_vecs = jnp.where(do[:, None], relnz_all[rows_safe], 0)
+            chosens = vic_mask_all[rows_safe] & do[:, None]
         rows = jnp.where(do, picks, 0)
         state = dataclasses.replace(
             state,
@@ -454,7 +424,7 @@ def build_preempt_pass(
         )
         vic_prio = vic_prio.at[rows].max(upd)
         out = PreemptStep(
-            picks=jnp.where(defer, -2, picks), k_star=kps, n_victims=nvics
+            picks=jnp.where(defer, -2, picks), vic_mask=chosens, n_victims=nvics
         )
         return (state, vic_prio, vic_req, vic_nonzero, vic_start), out
 
@@ -464,8 +434,8 @@ def build_preempt_pass(
         vfeat, vic_pdb, pdb_allowed,
     ):
         # Domain tables for the filters.  The scan carry releases resources
-        # only; the per-prefix what-if rebuilds its own tables inside
-        # full_ok when an affinity/spread op is active.
+        # only; the per-mask what-if rebuilds its own tables inside
+        # ok_under when an affinity/spread op is active.
         from .engine.pass_ import build_dom
 
         dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
@@ -572,17 +542,33 @@ class PreemptionEvaluator:
                 and t.label_selector_matches(pdb.selector, p.metadata.labels)
             ]
 
-        def violating(p: t.Pod) -> bool:
-            return any(pdbs[i].disruptions_allowed <= 0 for i in matched_pdbs(p))
-
-        # Pack every node's pods: non-violating least-important first.
+        # Pack every node's pods: non-violating first, least-important-first
+        # within each class.  "Violating" is classified with SIMULATED
+        # per-PDB budget consumption, walking the node's pods
+        # most-important-first (filterPodsWithPDBViolation: the most
+        # important matching pods claim the remaining disruptions; the rest
+        # are violating and therefore reprieved first).
         per_node: dict[int, list] = {}
         vmax = 1
         for rec in cache.nodes.values():
+            viol: dict[str, bool] = {}
+            if pdbs:
+                remaining = [max(p.disruptions_allowed, 0) for p in pdbs]
+                for p in sorted(
+                    rec.pods.values(),
+                    key=lambda p: (-p.spec.priority, p.status.start_time),
+                ):
+                    v = False
+                    for pi in matched_pdbs(p):
+                        if remaining[pi] > 0:
+                            remaining[pi] -= 1
+                        else:
+                            v = True
+                    viol[p.uid] = v
             vics = sorted(
                 rec.pods.values(),
                 key=lambda p: (
-                    violating(p) if pdbs else False,
+                    viol.get(p.uid, False),
                     p.spec.priority,
                     -p.status.start_time,
                 ),
@@ -696,7 +682,7 @@ class PreemptionEvaluator:
             state, batch_d, inv_d, d_prio, d_vic_req,
             d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
         )
-        picks, kstars = np.asarray(out.picks), np.asarray(out.k_star)
+        picks, vmasks = np.asarray(out.picks), np.asarray(out.vic_mask)
         # Chunk-deferred preemptors (same-node collisions, heterogeneous
         # signatures, exhausted ranks) return None: the scheduler requeues
         # them and the NEXT chunked pass — against post-eviction truth — is
@@ -706,15 +692,18 @@ class PreemptionEvaluator:
         results: list[PreemptionResult | None] = []
         consumed: set[str] = set()
         for i, pod in enumerate(pods):
-            pick, kp = int(picks[i]), int(kstars[i])
+            pick = int(picks[i])
             if pick < 0:
                 results.append(None)
                 continue
             node_name = cache.node_name_at_row(pick)
+            vics = per_node[pick]
             victims = [
-                p
-                for p in per_node[pick][:kp]
-                if p.spec.priority < pod.spec.priority and p.uid not in consumed
+                vics[j]
+                for j in np.nonzero(vmasks[i])[0]
+                if j < len(vics)
+                and vics[j].spec.priority < pod.spec.priority
+                and vics[j].uid not in consumed
             ]
             # prepareCandidate: delete victims, nominate the node.  The host
             # deltas mark rows dirty; the next state() flush re-syncs the
@@ -727,8 +716,8 @@ class PreemptionEvaluator:
                 # Evicting a PDB-covered pod consumes its budget (the
                 # disruption controller would rebuild DisruptionsAllowed;
                 # in-process we decrement directly).
-                for i in matched_pdbs(vic):
-                    pdbs[i].disruptions_allowed -= 1
+                for pi in matched_pdbs(vic):
+                    pdbs[pi].disruptions_allowed -= 1
             pod.status.nominated_node_name = node_name
             results.append(PreemptionResult(node_name=node_name, victims=victims))
         return results

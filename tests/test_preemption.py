@@ -289,3 +289,94 @@ def test_nominated_node_not_stolen_by_next_batch():
     landed = {o.pod.name: o.node_name for o in out if o.node_name}
     assert landed.get("vip") == "n1"
     assert "sneak" not in landed
+
+
+def test_greedy_reprieve_keeps_mid_priority_victim():
+    """SelectVictimsOnNode's most-important-first reprieve keeps a
+    mid-priority pod whose eviction would not help — the old minimal-PREFIX
+    rule would have evicted it (r2 VERDICT missing-5 done criterion;
+    preemption.go:541 reprieve loop).
+
+    Node (cpu 4, mem 16Gi) holds A(prio 1, cpu 2), B(prio 2, mem 8Gi),
+    C(prio 3, cpu 2); the preemptor needs cpu 4.  The prefix rule must take
+    [A, B, C] (contiguous least-important-first until 4 cpu free); the
+    reprieve re-admits B (its memory frees no cpu) and evicts only {A, C}."""
+    s = sched()
+    s.add_node(
+        make_node("n1").capacity({"cpu": "4", "memory": "16Gi", "pods": 110}).obj()
+    )
+    s.add_pod(make_pod("a").req({"cpu": "2"}).priority(1).node("n1").obj())
+    s.add_pod(make_pod("b").req({"memory": "8Gi"}).priority(2).node("n1").obj())
+    s.add_pod(make_pod("c").req({"cpu": "2"}).priority(3).node("n1").obj())
+    s.add_pod(make_pod("vip").req({"cpu": "4"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    landed = {o.pod.name: o.node_name for o in out if o.node_name}
+    assert landed.get("vip") == "n1"
+    assert "default/b" in s.cache.pods, "mid-priority B must be reprieved"
+    assert "default/a" not in s.cache.pods
+    assert "default/c" not in s.cache.pods
+
+
+def test_reprieve_order_prefers_keeping_pdb_covered_victims():
+    """PDB-violating victims are reprieved FIRST (filterPodsWithPDBViolation
+    + the two reprieve loops): with capacity to spare one victim, the
+    PDB-covered pod survives even when a same-priority uncovered pod could
+    have been kept instead."""
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pdb(
+        t.PodDisruptionBudget(
+            name="guard",
+            namespace="default",
+            selector=t.LabelSelector(match_labels=(("app", "guarded"),)),
+            disruptions_allowed=0,
+        )
+    )
+    s.add_pod(
+        make_pod("covered").req({"cpu": "2"}).priority(1)
+        .label("app", "guarded").start_time(1.0).node("n1").obj()
+    )
+    s.add_pod(
+        make_pod("plain").req({"cpu": "2"}).priority(1)
+        .start_time(2.0).node("n1").obj()
+    )
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    landed = {o.pod.name: o.node_name for o in out if o.node_name}
+    assert landed.get("vip") == "n1"
+    assert "default/covered" in s.cache.pods, "PDB-covered victim reprieved first"
+    assert "default/plain" not in s.cache.pods
+
+
+def test_pdb_budget_simulation_in_violation_classification():
+    """filterPodsWithPDBViolation consumes the remaining budget walking
+    most-important-first: with disruptions_allowed=1 over two equal-priority
+    pods, the MORE important one claims the budget (non-violating) and the
+    LESS important one is violating — so the less important pod is
+    reprieved first and survives, and the more important one is evicted."""
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pdb(
+        t.PodDisruptionBudget(
+            name="one-left",
+            namespace="default",
+            selector=t.LabelSelector(match_labels=(("app", "db"),)),
+            disruptions_allowed=1,
+        )
+    )
+    # x is more important (earlier start) at equal priority.
+    s.add_pod(
+        make_pod("x").req({"cpu": "2"}).priority(1).label("app", "db")
+        .start_time(1.0).node("n1").obj()
+    )
+    s.add_pod(
+        make_pod("y").req({"cpu": "2"}).priority(1).label("app", "db")
+        .start_time(2.0).node("n1").obj()
+    )
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    landed = {o.pod.name: o.node_name for o in out if o.node_name}
+    assert landed.get("vip") == "n1"
+    # y was violating (budget claimed by x) -> reprieved first -> survives.
+    assert "default/y" in s.cache.pods
+    assert "default/x" not in s.cache.pods
