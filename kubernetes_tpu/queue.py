@@ -243,6 +243,23 @@ class SchedulingQueue:
                 return
         self._push_active(qp)
 
+    def reactivate(self, qp: QueuedPodInfo) -> None:
+        """Return an in-flight pod to the ACTIVE queue for a next-batch
+        retry (prefetch dissolution, schema-grown-batch fallbacks).
+        Restores the bookkeeping pop_batch dropped — the info entry and
+        gang membership; registered-gang members re-park so the
+        all-or-nothing release is preserved, with an instant re-admission
+        attempt (this retry is not a quorum failure)."""
+        self._info[qp.pod.uid] = qp
+        g = qp.pod.spec.pod_group
+        if g:
+            self._track_gang_member(qp)
+            if g in self.gang_min:
+                self._park_gang_member(qp)
+                self._try_admit_gang(g)
+                return
+        self._push_active(qp)
+
     def requeue_gang_member(self, qp: QueuedPodInfo) -> None:
         """Park a rolled-back gang member WITHOUT instant re-admission — the
         gang just failed with exactly these members, so re-admission waits

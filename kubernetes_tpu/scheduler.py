@@ -387,8 +387,7 @@ class TPUScheduler:
                 if qp.pod.uid == uid:
                     self.queue._info.pop(uid, None)
                     continue
-                self.queue._info[qp.pod.uid] = qp
-                self.queue._push_active(qp)
+                self.queue.reactivate(qp)
         self._drop_permit_waiters({uid})
         self.nominator.pop(uid, None)
         # DRA: drop the pod's claim reservations; claims nobody reserves
@@ -857,9 +856,7 @@ class TPUScheduler:
         schema_grew = ctx["schema"] != self.builder.schema
         if deferred and schema_grew:
             for i in deferred:
-                qp = infos[i]
-                self.queue._info[qp.pod.uid] = qp
-                self.queue._push_active(qp)
+                self.queue.reactivate(infos[i])
             picks = picks.copy()
             picks[deferred] = -3  # handled: neither bind nor failure
             deferred = []
@@ -1172,6 +1169,13 @@ class TPUScheduler:
                 # (requeue on their delete events); in-process deletion is
                 # synchronous, so the nominated pod can retry immediately.
                 self.queue.add(qp.pod)
+            elif self.preemption is not None and schema_grew:
+                # Preemption sat this batch out (its compiled pass cannot
+                # mix old-shape feature rows with the rebuilt state) — the
+                # failure must RETRY next batch rather than park: in a
+                # quiet cluster no event would ever wake it, while the
+                # reference would have run PostFilter on this very cycle.
+                self.queue.reactivate(qp)
             else:
                 # Precise requeue hints: wait only on events the plugins that
                 # actually rejected nodes care about (isPodWorthRequeuing,

@@ -1,0 +1,487 @@
+"""Full default-profile scalar oracle: a sequential scheduler composing the
+per-plugin scalar references (reference_impl.py) into end-to-end decisions —
+filters → truncation → fused normalized-weighted scoring → seeded tie-break
+→ greedy-reprieve preemption → nominated retry — mirroring, decision for
+decision, the device engine in parity mode (chunk_size=1).
+
+Used by tests/test_parity.py (in-process) and scripts/parity_ab.py (over
+the sidecar wire) for the bit-identical-bindings A/B the north star
+requires (schedule_one.go:411–920, preemption.go:148–470).
+
+Scope: the default profile's compute plugins (unschedulable/name/taints/
+node-affinity/ports/fit/spread/inter-pod-affinity + all five scorers).
+Volume/DRA/gates are exercised by their own suites; fixtures here carry no
+such objects, so those plugins are inactive on both sides."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+from kubernetes_tpu.api import types as t
+
+from reference_impl import (
+    MAX_NODE_SCORE,
+    RefNodeState,
+    balanced_allocation_score,
+    fit_score,
+    fits_request,
+    ipa_filter,
+    ipa_score,
+    node_affinity_filter,
+    node_affinity_score_raw,
+    node_ports_filter,
+    spread_filter,
+    spread_score,
+    taint_toleration_filter,
+    taint_toleration_score_raw,
+)
+from test_parity import hash_u32, interleave_zones, num_feasible_nodes_to_find
+
+
+def default_normalize(raws: dict[str, int], feasible: list[str], reverse: bool) -> dict[str, int]:
+    """Scalar DefaultNormalizeScore (plugins/helper/normalize_score.go)."""
+    mx = max((raws.get(n, 0) for n in feasible), default=0)
+    out = {}
+    for n in feasible:
+        if mx == 0:
+            out[n] = MAX_NODE_SCORE if reverse else 0
+            continue
+        s = raws.get(n, 0) * MAX_NODE_SCORE // mx
+        out[n] = MAX_NODE_SCORE - s if reverse else s
+    return out
+
+
+@dataclass
+class Decision:
+    pod: t.Pod
+    node: str | None
+    nominated: str | None = None
+    victims: tuple[str, ...] = ()
+
+
+@dataclass
+class _Queued:
+    pod: t.Pod
+    nominated: str | None = None
+
+
+class FullOracleScheduler:
+    """Sequential scalar scheduler over the default plugin set with the
+    engine's queue/batch/preemption discipline (parity mode)."""
+
+    def __init__(
+        self,
+        nodes: list[t.Node],
+        pct: int | None = None,
+        seed: int = 0,
+        hard_pod_affinity_weight: int = 1,
+        batch_size: int = 128,
+        ns_labels: dict[str, dict[str, str]] | None = None,
+        pdbs: list[t.PodDisruptionBudget] | None = None,
+    ):
+        self.nodes = list(nodes)  # row order = insertion order
+        self.states = {n.name: RefNodeState(node=n) for n in nodes}
+        by_zone: dict[str, list[str]] = {}
+        for n in nodes:
+            z = n.metadata.labels.get("topology.kubernetes.io/zone", "")
+            by_zone.setdefault(z, []).append(n.name)
+        self.order = interleave_zones(by_zone)
+        self.pct = pct
+        self.seed = seed
+        self.hard_w = hard_pod_affinity_weight
+        self.batch_size = batch_size
+        self.ns_labels = ns_labels or {}
+        self.pdbs = list(pdbs or [])
+        self.start = 0
+        self.step = 0
+        self._seq = itertools.count()
+        self._heap: list = []
+        self._info: dict[str, _Queued] = {}
+        # Nominator overlay: uid → (node, pod) — freed capacity a preemptor
+        # claimed; other pods' fit checks count it (framework.go:973).
+        self.nominator: dict[str, tuple[str, t.Pod]] = {}
+
+    # -- cluster mutation (bound pods) --------------------------------------
+
+    def add_bound(self, pod: t.Pod) -> None:
+        self.states[pod.spec.node_name].pods.append(pod)
+
+    # -- queue --------------------------------------------------------------
+
+    def add(self, pod: t.Pod, nominated: str | None = None) -> None:
+        q = self._info.get(pod.uid)
+        if q is None:
+            q = _Queued(pod=pod)
+            self._info[pod.uid] = q
+        q.nominated = nominated
+        heapq.heappush(
+            self._heap, (-pod.spec.priority, next(self._seq), pod.uid)
+        )
+
+    def _pop_batch(self) -> list[_Queued]:
+        out = []
+        while self._heap and len(out) < self.batch_size:
+            _, _, uid = heapq.heappop(self._heap)
+            q = self._info.pop(uid, None)
+            if q is not None:
+                out.append(q)
+        return out
+
+    # -- one scheduling cycle ----------------------------------------------
+
+    def _pods_on(self) -> dict[str, list[t.Pod]]:
+        return {name: st.pods for name, st in self.states.items()}
+
+    def _filter(self, pod: t.Pod, exclude_uid: str | None = None) -> dict[str, bool]:
+        """All filter plugins in profile order, incl. the nominator overlay
+        (a nominated pod's claim counts against OTHER pods' fit)."""
+        pods_on = self._pods_on()
+        spread_ok = spread_filter(pod, self.nodes, pods_on)
+        ipa_ok = ipa_filter(pod, self.nodes, pods_on, self.ns_labels)
+        out = {}
+        unsched_taint = t.Taint(
+            key="node.kubernetes.io/unschedulable", effect=t.EFFECT_NO_SCHEDULE
+        )
+        for n in self.nodes:
+            st = self.states[n.name]
+            ok = not n.spec.unschedulable or any(
+                tol.tolerates(unsched_taint) for tol in pod.spec.tolerations
+            )
+            if ok and pod.spec.node_name:
+                ok = pod.spec.node_name == n.name
+            ok = ok and taint_toleration_filter(pod, n)
+            ok = ok and node_affinity_filter(pod, n)
+            ok = ok and node_ports_filter(pod, st.pods)
+            if ok:
+                ok = not fits_request(pod, st)
+            if ok:
+                # Nominator overlay (RunFilterPluginsWithNominatedPods /
+                # ops/noderesources.py): when the pod's priority ≤ the
+                # node's max nominated priority, it must ALSO fit with
+                # every nominated pod's claim counted (self excluded).
+                overlay = [
+                    p
+                    for uid2, (nn, p) in self.nominator.items()
+                    if nn == n.name and uid2 != (exclude_uid or "")
+                ]
+                if overlay and pod.spec.priority <= max(
+                    p.spec.priority for p in overlay
+                ):
+                    st2 = RefNodeState(node=n, pods=st.pods + overlay)
+                    ok = not fits_request(pod, st2)
+            ok = ok and spread_ok[n.name] and ipa_ok[n.name]
+            out[n.name] = ok
+        return out
+
+    def _score(self, pod: t.Pod, feasible: list[str]) -> dict[str, int]:
+        pods_on = self._pods_on()
+        feas_map = {n: n in feasible for n in self.states}
+        taint = default_normalize(
+            {n.name: taint_toleration_score_raw(pod, n) for n in self.nodes},
+            feasible, reverse=True,
+        )
+        naff = default_normalize(
+            {n.name: node_affinity_score_raw(pod, n) for n in self.nodes},
+            feasible, reverse=False,
+        )
+        spread = spread_score(pod, self.nodes, pods_on, feas_map)
+        ipa = ipa_score(
+            pod, self.nodes, pods_on, feas_map, self.hard_w, self.ns_labels
+        )
+        total = {}
+        for name in feasible:
+            st = self.states[name]
+            total[name] = (
+                3 * taint[name]
+                + 2 * naff[name]
+                + 1 * fit_score(pod, st)
+                + 2 * spread[name]
+                + 2 * ipa[name]
+                + 1 * balanced_allocation_score(pod, st)
+                # ImageLocality: fixtures carry no images → inactive on the
+                # engine side; a uniform 0 here never changes the argmax.
+            )
+        return total
+
+    def _schedule_one(self, q: _Queued) -> Decision:
+        pod = q.pod
+        n_all = len(self.order)
+        limit = num_feasible_nodes_to_find(self.pct, n_all)
+        full = self._filter(pod, exclude_uid=pod.uid)
+        feasible: list[str] = []  # rotated scan order
+        processed = n_all
+        for j in range(n_all):
+            name = self.order[(self.start + j) % n_all]
+            if not full[name]:
+                continue
+            if len(feasible) == limit:
+                processed = j
+                break
+            feasible.append(name)
+        tie_rand = hash_u32((self.seed * 2654435761 + self.step) & 0xFFFFFFFF)
+        self.step += 1
+        self.start = (self.start + processed) % n_all
+        if not feasible:
+            return Decision(pod=pod, node=None)
+        # Nominated fast path (schedule_one.go:491–502 / engine eval_pod):
+        # take the nominated node whenever it is feasible.
+        if q.nominated and q.nominated in feasible:
+            pick = q.nominated
+        else:
+            scores = self._score(pod, feasible)
+            best = max(scores.values())
+            ties = [n for n in feasible if scores[n] == best]
+            pick = ties[tie_rand % len(ties)]
+        self.states[pick].pods.append(pod)
+        self.nominator.pop(pod.uid, None)
+        return Decision(pod=pod, node=pick)
+
+    # -- preemption (greedy reprieve, scalar) --------------------------------
+
+    def _preempt(self, pod: t.Pod) -> Decision:
+        if pod.spec.preemption_policy == t.PREEMPT_NEVER:
+            return Decision(pod=pod, node=None)
+        prio = pod.spec.priority
+        pods_on = self._pods_on()
+
+        def matched(p: t.Pod) -> list[int]:
+            return [
+                i
+                for i, pdb in enumerate(self.pdbs)
+                if pdb.namespace == p.namespace
+                and t.label_selector_matches(pdb.selector, p.metadata.labels)
+            ]
+
+        candidates: list[tuple[str, list[t.Pod]]] = []
+        for n in self.nodes:
+            st = self.states[n.name]
+            lower = [p for p in st.pods if p.spec.priority < prio]
+            if not lower:
+                continue
+            # Release-independent filters must already pass.
+            if not (
+                (not n.spec.unschedulable)
+                and taint_toleration_filter(pod, n)
+                and node_affinity_filter(pod, n)
+            ):
+                continue
+            keep = [p for p in st.pods if p.spec.priority >= prio]
+
+            def ok_with(removed: list[t.Pod]) -> bool:
+                trial = {
+                    name: (
+                        [p for p in ps if p not in removed]
+                        if name == n.name
+                        else ps
+                    )
+                    for name, ps in pods_on.items()
+                }
+                st2 = RefNodeState(node=n, pods=trial[n.name])
+                if fits_request(pod, st2):
+                    return False
+                if not node_ports_filter(pod, st2.pods):
+                    return False
+                if not spread_filter(pod, self.nodes, trial)[n.name]:
+                    return False
+                if not ipa_filter(pod, self.nodes, trial, self.ns_labels)[n.name]:
+                    return False
+                return True
+
+            if not ok_with(lower):
+                continue
+            # Violating classification with simulated budget consumption,
+            # most-important-first (filterPodsWithPDBViolation).
+            remaining = [max(p.disruptions_allowed, 0) for p in self.pdbs]
+            viol: dict[str, bool] = {}
+            for p in sorted(
+                st.pods, key=lambda p: (-p.spec.priority, p.status.start_time)
+            ):
+                v = False
+                for i in matched(p):
+                    if remaining[i] > 0:
+                        remaining[i] -= 1
+                    else:
+                        v = True
+                viol[p.uid] = v
+            # Greedy reprieve: violating most-important-first, then
+            # non-violating most-important-first.
+            victims = list(lower)
+            order = sorted(
+                lower,
+                key=lambda p: (
+                    not viol.get(p.uid, False),
+                    -p.spec.priority,
+                    p.status.start_time,
+                ),
+            )
+            for p in order:
+                trial_victims = [v for v in victims if v is not p]
+                if ok_with(trial_victims):
+                    victims = trial_victims
+            if victims:
+                candidates.append((n.name, victims))
+
+        if not candidates:
+            return Decision(pod=pod, node=None)
+
+        def criteria(entry):
+            name, victims = entry
+            viols = 0
+            rem = [max(p.disruptions_allowed, 0) for p in self.pdbs]
+            cnt = [0] * len(self.pdbs)
+            for p in victims:
+                for i in matched(p):
+                    cnt[i] += 1
+            viols = sum(max(c - r, 0) for c, r in zip(cnt, rem))
+            mx = max(p.spec.priority for p in victims)
+            ssum = sum(p.spec.priority for p in victims)
+            earliest = min(
+                (p.status.start_time for p in victims if p.spec.priority == mx),
+            )
+            start_key = -int(earliest * 1e6)
+            return (viols, mx, ssum, len(victims), start_key)
+
+        # Lexicographic minimum; ties → lowest row index (engine argmax).
+        row = {n.name: i for i, n in enumerate(self.nodes)}
+        best = min(candidates, key=lambda e: (criteria(e), row[e[0]]))
+        name, victims = best
+        for v in victims:
+            self.states[name].pods.remove(v)
+            for i in matched(v):
+                self.pdbs[i].disruptions_allowed -= 1
+        self.nominator[pod.uid] = (name, pod)
+        return Decision(
+            pod=pod, node=None, nominated=name,
+            victims=tuple(v.uid for v in victims),
+        )
+
+    # -- driver (mirrors schedule_batch + prefetch ordering) -----------------
+
+    def run(self, pods: list[t.Pod], max_rounds: int = 1000) -> list[Decision]:
+        for p in pods:
+            self.add(p)
+        decisions: list[Decision] = []
+        prefetched: list[_Queued] | None = None
+        for _ in range(max_rounds):
+            batch = prefetched if prefetched is not None else self._pop_batch()
+            prefetched = None
+            if not batch:
+                break
+            results = [self._schedule_one(q) for q in batch]
+            # The engine prefetches the NEXT batch before completing this
+            # one, so this batch's preemption requeues land in batch k+2.
+            nxt = self._pop_batch()
+            prefetched = nxt if nxt else None
+            for q, d in zip(batch, results):
+                if d.node is None:
+                    d = self._preempt(q.pod)
+                    if d.nominated:
+                        self.add(q.pod, nominated=d.nominated)
+                decisions.append(d)
+        return decisions
+
+
+# ---------------------------------------------------------------------------
+# Shared A/B fixture (tests/test_parity_default.py + scripts/parity_ab.py)
+# ---------------------------------------------------------------------------
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def build_fixture(n_nodes: int = 304, n_pending: int = 120, n_tiny: int = 10):
+    """Deterministic default-profile A/B fixture: heterogeneous tainted/
+    labeled nodes, seeded bound pods, a pending mix exercising every
+    compute plugin, and a preemption theater (tiny saturated pool + vips).
+    Every non-vip pod is schedulable on first attempt, so oracle and
+    engine agree on the event-free flow."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+    nodes = []
+    for i in range(n_nodes):
+        w = (
+            make_node(f"node-{i:04d}")
+            .capacity({"cpu": "8" if i % 3 else "16", "memory": "32Gi", "pods": 64})
+            .zone(f"zone-{i % 4}")
+            .region("r1")
+        )
+        if i % 7 == 0:
+            w = w.taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE)
+        if i % 11 == 0:
+            w = w.label("disk", "ssd")
+        nodes.append(w.obj())
+    for i in range(n_tiny):
+        nodes.append(
+            make_node(f"tiny-{i}")
+            .capacity({"cpu": "1", "memory": "4Gi", "pods": 8})
+            .zone(f"zone-{i % 4}")
+            .region("r1")
+            .label("pool", "tiny")
+            .obj()
+        )
+
+    bound = []
+    for i in range(max(n_nodes // 8, 8)):
+        bound.append(
+            make_pod(f"seed-{i}")
+            .req({"cpu": "500m", "memory": "1Gi"})
+            .label("color", f"c{i % 8}")
+            .start_time(float(i))
+            .node(f"node-{(i * 13) % n_nodes:04d}")
+            .obj()
+        )
+    for i in range(n_tiny):
+        bound.append(
+            make_pod(f"filler-{i}")
+            .req({"cpu": "800m", "memory": "1Gi"})
+            .label("app", "low")
+            .priority(1)
+            .start_time(100.0 + i)
+            .node(f"tiny-{i}")
+            .obj()
+        )
+
+    pending = []
+    for i in range(n_pending):
+        kind = i % 6
+        w = make_pod(f"p-{i:04d}").req({"cpu": "700m", "memory": "1Gi"})
+        if kind == 0:
+            w = w.label("app", f"a{i % 5}")
+        elif kind == 1:
+            w = w.preferred_node_affinity_in(ZONE, [f"zone-{i % 4}"], weight=30)
+        elif kind == 2:
+            w = (
+                w.toleration("dedicated", value="gpu", effect=t.EFFECT_NO_SCHEDULE)
+                .preferred_node_affinity_in("disk", ["ssd"], weight=10)
+            )
+        elif kind == 3:
+            w = w.label("color", f"c{i % 8}").preferred_pod_affinity_in(
+                "color", [f"c{i % 8}"], ZONE, weight=25
+            )
+        elif kind == 4:
+            w = w.label("anti", f"x{i}").pod_anti_affinity_in(
+                "anti", [f"x{i}"], ZONE
+            )
+        else:
+            w = w.label("app", f"s{i % 3}").spread_constraint(
+                2, ZONE, t.SCHEDULE_ANYWAY, "app", [f"s{i % 3}"]
+            )
+        pending.append(w.obj())
+    for i in range(max(n_tiny - 4, 2)):
+        pending.append(
+            make_pod(f"vip-{i}")
+            .req({"cpu": "900m"})
+            .priority(50)
+            .node_affinity_in("pool", ["tiny"])
+            .obj()
+        )
+    pdbs = [
+        t.PodDisruptionBudget(
+            name="low-guard",
+            namespace="default",
+            selector=t.LabelSelector(match_labels=(("app", "low"),)),
+            disruptions_allowed=max(n_tiny - 2, 1),
+        )
+    ]
+    return nodes, bound, pending, pdbs

@@ -1,0 +1,82 @@
+"""Default-profile decision parity: the device engine in parity mode vs the
+full scalar oracle (oracle_full.FullOracleScheduler), decision for decision —
+filters, truncation/rotation, fused weighted scoring, seeded tie-breaks,
+greedy-reprieve preemption, and the nominated retry (VERDICT r3 next-2;
+match: schedule_one.go:411–920, preemption.go:148–470)."""
+
+from dataclasses import replace
+
+from kubernetes_tpu.framework.config import DEFAULT_PROFILE
+from kubernetes_tpu.ops.common import registered_subset
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from oracle_full import FullOracleScheduler, build_fixture
+
+
+def test_default_profile_decision_parity_with_preemption():
+    nodes, bound, pending, pdbs = build_fixture()
+    prof = replace(
+        registered_subset(DEFAULT_PROFILE), percentage_of_nodes_to_score=None
+    )
+    s = TPUScheduler(profile=prof, batch_size=64, chunk_size=1)
+    for n in nodes:
+        s.add_node(n)
+    for p in bound:
+        s.add_pod(p)
+    for pdb in pdbs:
+        s.add_pdb(pdb)
+
+    import copy
+
+    oracle = FullOracleScheduler(
+        nodes,
+        pct=None,
+        seed=prof.tie_break_seed,
+        hard_pod_affinity_weight=prof.hard_pod_affinity_weight,
+        batch_size=64,
+        pdbs=[copy.deepcopy(p) for p in pdbs],
+    )
+    for p in bound:
+        oracle.add_bound(copy.deepcopy(p))
+
+    # Pre-grow every vocabulary/schema bucket the pending pods will need:
+    # featurization interns without committing.  Mid-run schema growth makes
+    # the engine defer preemption by one batch (sound, but it shifts the
+    # tie-break step counter relative to the oracle).
+    from kubernetes_tpu.engine.features import build_pod_batch
+
+    warm = [copy.deepcopy(p) for p in pending]
+    build_pod_batch(warm, s.builder, s.profile, len(warm))
+
+    for p in pending:
+        s.add_pod(copy.deepcopy(p))
+    got_out = s.schedule_all_pending(wait_backoff=True)
+    want_out = oracle.run([copy.deepcopy(p) for p in pending])
+
+    got_bind = {o.pod.name: o.node_name for o in got_out if o.node_name}
+    want_bind = {d.pod.name: d.node for d in want_out if d.node}
+    got_nom = {
+        o.pod.name: o.nominated_node for o in got_out if o.nominated_node
+    }
+    want_nom = {d.pod.name: d.nominated for d in want_out if d.nominated}
+    got_vic = {
+        o.pod.name: tuple(sorted(o.victim_uids)) for o in got_out if o.victim_uids
+    }
+    want_vic = {
+        d.pod.name: tuple(sorted(d.victims)) for d in want_out if d.victims
+    }
+
+    diffs = {
+        k: (got_bind.get(k), want_bind.get(k))
+        for k in set(got_bind) | set(want_bind)
+        if got_bind.get(k) != want_bind.get(k)
+    }
+    assert not diffs, (
+        f"{len(diffs)} binding mismatches, first 5: {dict(list(sorted(diffs.items()))[:5])}"
+    )
+    assert got_nom == want_nom, (got_nom, want_nom)
+    assert got_vic == want_vic, (got_vic, want_vic)
+    # The preemption theater actually ran (fixture guard).
+    assert want_nom, "fixture no longer exercises preemption"
+    assert all(f"vip-{i}" in got_bind for i in range(6))
+    assert s.builder.host_mirror_equal()
