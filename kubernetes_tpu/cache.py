@@ -238,14 +238,22 @@ class Cache:
         rec.pods[pod.uid] = pod
         self.builder.apply_pod_delta(rec.row, delta, +1, device_already=False)
 
-    def cleanup_assumed(self, ttl_s: float = 30.0) -> list[str]:
-        """Expire assumed-but-never-bound pods (cache.go:730 cleanupAssumedPods)."""
+    def cleanup_assumed(
+        self, ttl_s: float = 30.0, skip: frozenset[str] | set[str] = frozenset()
+    ):
+        """Expire assumed-but-never-bound pods (cache.go:730 cleanupAssumedPods).
+        ``skip`` excludes pods whose assume is deliberate and governed by
+        another expiry (the WaitOnPermit room's gang timeout).  Returns the
+        expired pod objects so a caller without an informer can requeue them."""
         now = time.monotonic()
         expired = [
-            uid
+            pr.pod
             for uid, pr in self.pods.items()
-            if pr.assumed and not pr.bound and now - pr.assumed_at > ttl_s
+            if pr.assumed
+            and not pr.bound
+            and uid not in skip
+            and now - pr.assumed_at > ttl_s
         ]
-        for uid in expired:
-            self.forget_pod(uid)
+        for pod in expired:
+            self.forget_pod(pod.uid)
         return expired

@@ -138,6 +138,10 @@ class TPUScheduler:
         self.permit_waiting: dict[str, list] = {}
         self.permit_wait_since: dict[str, float] = {}
         self.permit_timeout_s = 60.0  # coscheduling PermitWaitingTimeSeconds
+        # Assumed-pod TTL (cache.go:42 ticks cleanupAssumedPods at 1s; the
+        # 30s expiry mirrors durationToExpireAssumedPod's safety-net role).
+        self.assume_ttl_s = 30.0
+        self._next_assumed_sweep = 0.0
         self.queue.gang_credit = lambda g: self.gang_bound.get(g, 0) + len(
             self.permit_waiting.get(g, ())
         )
@@ -385,7 +389,11 @@ class TPUScheduler:
             self._prefetched = None
             for qp in infos_p:
                 if qp.pod.uid == uid:
+                    # Prefetch re-tracked this member in _gang_members
+                    # (gang_pending quorum credit); untrack or the dead uid
+                    # overcounts quorum forever and Permit waits on a ghost.
                     self.queue._info.pop(uid, None)
+                    self.queue._untrack_gang_member(qp.pod)
                     continue
                 self.queue.reactivate(qp)
         self._drop_permit_waiters({uid})
@@ -662,6 +670,23 @@ class TPUScheduler:
         per profile (pods group by .spec.scheduler_name)."""
         if self.permit_wait_since:
             self.expire_waiting_gangs()
+        now = time.monotonic()
+        if now >= self._next_assumed_sweep:
+            # cache.go:42 starts cleanupAssumedPods on a 1s ticker; the batch
+            # loop's analog is a time-gated sweep at the top of each batch.
+            # Permit-room waiters are assumed deliberately (gang quorum) and
+            # expire through expire_waiting_gangs, not the TTL.
+            self._next_assumed_sweep = now + 1.0
+            waiting = {
+                e[0].pod.uid
+                for entries in self.permit_waiting.values()
+                for e in entries
+            }
+            for pod in self.cache.cleanup_assumed(self.assume_ttl_s, skip=waiting):
+                # No informer to re-deliver the still-pending pod (the
+                # reference relies on the apiserver watch for that) — requeue
+                # directly so the pod gets another cycle.
+                self.queue.add(pod)
         pre = self._prefetched
         self._prefetched = None
         if pre is not None:
@@ -1098,6 +1123,13 @@ class TPUScheduler:
         # volume catalog.
         for g in race_rollback:
             self.queue.readmit_gang(g)
+        # Members that just entered the WaitOnPermit room grew their gang's
+        # quorum credit (queue.gang_credit counts waiters) — a peer parked in
+        # the gang pool (e.g. a schema-grown deferral reactivated mid-batch
+        # while this one was merely "placed") may now make the gang
+        # admissible, and no cluster event fires in a quiet cluster.
+        for g in wait:
+            self.queue._try_admit_gang(g)
         if prebind_s:
             m.registry.observe_point("PreBind", prebind_s)
         # Metrics after rollbacks settled (success = outcome kept its node).

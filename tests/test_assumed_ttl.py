@@ -1,0 +1,70 @@
+"""Assumed-pod TTL cleanup (cache.go:730 cleanupAssumedPods, ticked from
+cache.go:42).  The batch loop sweeps expired assumes at the top of each
+batch; permit-room gang waiters are exempt (their expiry is the gang
+timeout, scheduler.expire_waiting_gangs)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def test_expired_assume_is_forgotten_and_requeued():
+    s = TPUScheduler(batch_size=4)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+    )
+    # Simulate a bind confirmation that never arrived: assume directly,
+    # never finish_binding, and age the record past the TTL.
+    ghost = make_pod("ghost").req({"cpu": "1"}).obj()
+    s.cache.assume_pod(ghost, "n1", device_already=False)
+    s.cache.pods[ghost.uid].assumed_at -= 31.0
+    assert s.cache.pods[ghost.uid].assumed
+
+    # A fresh assumed pod under the TTL must survive the sweep.
+    fresh = make_pod("fresh").req({"cpu": "1"}).obj()
+    s.cache.assume_pod(fresh, "n1", device_already=False)
+
+    out = s.schedule_all_pending()
+    # The ghost was forgotten (resources released) and requeued — the batch
+    # loop then scheduled it for real.
+    assert any(o.pod.name == "ghost" and o.node_name == "n1" for o in out)
+    pr = s.cache.pods[ghost.uid]
+    assert pr.bound and not pr.assumed
+    # The fresh assume is untouched.
+    assert s.cache.pods[fresh.uid].assumed
+    assert s.builder.host_mirror_equal()
+
+
+def test_permit_waiters_survive_ttl_sweep():
+    # batch_size=1 with a 2-member gang: the first member schedules alone and
+    # parks in the WaitOnPermit room as assumed-not-bound.
+    s = TPUScheduler(batch_size=1)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "16", "memory": "64Gi", "pods": 110}).obj()
+    )
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(make_pod("w0").req({"cpu": "1"}).pod_group("g1").obj())
+    s.add_pod(make_pod("w1").req({"cpu": "1"}).pod_group("g1").obj())
+    out0 = s.schedule_batch()
+    assert out0 == [] or all(o.node_name is None for o in out0)
+    assert len(s.permit_waiting.get("g1", ())) == 1
+    waiter_uid = s.permit_waiting["g1"][0][0].pod.uid
+    # Age the waiter's assume far past the TTL, then force a sweep with an
+    # empty queue so only the sweep runs.
+    s.cache.pods[waiter_uid].assumed_at -= 3600.0
+    s._next_assumed_sweep = 0.0
+    saved_pre = s._prefetched
+    s._prefetched = None
+    drained = s.queue.pop_batch(64)
+    s.schedule_batch()
+    # Still assumed, still waiting — the TTL sweep skipped it.
+    assert s.cache.pods[waiter_uid].assumed
+    assert len(s.permit_waiting.get("g1", ())) == 1
+    # Restore and finish: the second member completes the gang.
+    s._prefetched = saved_pre
+    for qp in drained:
+        s.queue.add(qp.pod)
+    out = s.schedule_all_pending()
+    bound = sorted(o.pod.name for o in out if o.node_name)
+    assert "w0" in bound and "w1" in bound
+    assert s.builder.host_mirror_equal()

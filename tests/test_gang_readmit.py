@@ -1,0 +1,73 @@
+"""Regression tests for gang re-admission corner cases (code-review r3).
+
+1. A gang member parked in the gang pool mid-batch (schema-grown deferral
+   reactivated while its peer was merely "placed") must be re-admitted when
+   the peer enters the WaitOnPermit room — waiter credit growth re-attempts
+   admission; nothing else fires in a quiet cluster.
+2. Deleting a pod that sits in the PREFETCHED batch must untrack its gang
+   membership, or the ghost uid overcounts quorum and Permit waits forever
+   on a member that no longer exists.
+"""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def big_node(name: str, cpu: str = "16"):
+    return make_node(name).capacity({"cpu": cpu, "memory": "64Gi", "pods": 110}).obj()
+
+
+def gang_pod(name: str, group: str) -> t.Pod:
+    return make_pod(name).req({"cpu": "1"}).pod_group(group).obj()
+
+
+def test_pool_member_readmitted_when_peer_enters_permit_room():
+    s = TPUScheduler(batch_size=1)
+    s.add_node(big_node("n1"))
+    s.add_pod_group(t.PodGroup(name="g1", min_member=2))
+    s.add_pod(gang_pod("m0", "g1"))
+    s.add_pod(gang_pod("m1", "g1"))
+    # Pull both members out of the queue, then hand-craft the bug's state:
+    # m1 parked in the gang pool (as a schema-grown deferral would), m0 back
+    # on the active queue alone.
+    popped = {qp.pod.name: qp for qp in s.queue.pop_batch(2)}
+    qp0, qp1 = popped["m0"], popped["m1"]
+    s.queue._info[qp1.pod.uid] = qp1
+    s.queue._park_gang_member(qp1)          # pool only — no admission attempt
+    s.queue._info[qp0.pod.uid] = qp0
+    s.queue._push_active(qp0)
+    # Batch 1: m0 places, quorum unmet (m1 parked counts as pending) → m0
+    # waits on Permit.  The waiter's credit must re-admit m1 from the pool.
+    s.schedule_batch()
+    assert len(s.permit_waiting.get("g1", ())) == 1
+    assert "g1" not in s.queue._gang_pool  # m1 released to activeQ
+    out = s.schedule_all_pending()
+    assert sorted(o.pod.name for o in out if o.node_name) == ["m0", "m1"]
+    assert s.gang_bound == {"g1": 2}
+    assert s.builder.host_mirror_equal()
+
+
+def test_deleting_prefetched_gang_member_untracks_quorum_credit():
+    s = TPUScheduler(batch_size=1)
+    s.add_node(big_node("n1"))
+    s.add_pod_group(t.PodGroup(name="g2", min_member=2))
+    s.add_pod(make_pod("x").req({"cpu": "1"}).obj())  # filler: batch 1
+    s.add_pod(gang_pod("w0", "g2"))
+    s.add_pod(gang_pod("w1", "g2"))
+    # Batch 1 schedules the filler and prefetches the next batch (w0).
+    s.schedule_batch()
+    assert s._prefetched is not None
+    pre_names = [qp.pod.name for qp in s._prefetched[0]]
+    assert pre_names == ["w0"]
+    # Delete the prefetched member: the prefetch dissolves; its gang
+    # tracking must dissolve with it.
+    s.delete_pod("default/w0")
+    assert s.queue.gang_pending("g2") == 1  # w1 only, no ghost
+    # w1 alone can never reach quorum: it must roll back (not sit assumed in
+    # the WaitOnPermit room behind a ghost's credit).
+    out = s.schedule_all_pending()
+    assert all(o.node_name is None for o in out if o.pod.name == "w1")
+    assert not s.permit_waiting
+    assert not any(pr.assumed for pr in s.cache.pods.values())
+    assert s.builder.host_mirror_equal()
