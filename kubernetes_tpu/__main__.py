@@ -39,9 +39,18 @@ _TOP_KEYS = {"profiles", "batch_size", "chunk_size"}
 
 def load_config(path: str) -> dict:
     """Load + STRICTLY parse a config file: unknown keys are errors (the
-    strict decoding the reference's scheme gives component configs)."""
+    strict decoding the reference's scheme gives component configs).
+
+    Two formats: the versioned external
+    ``kubescheduler.config.k8s.io/v1`` form (detected by apiVersion/kind;
+    defaulting + conversion in framework/configv1.py) and the flat native
+    form below."""
     with open(path) as f:
         raw = json.load(f)
+    from .framework import configv1
+
+    if configv1.is_versioned(raw):
+        return configv1.convert(raw)
     unknown = set(raw) - _TOP_KEYS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -81,6 +90,7 @@ def load_config(path: str) -> dict:
         "profiles": profiles or [DEFAULT_PROFILE],
         "batch_size": int(raw.get("batch_size", 256)),
         "chunk_size": int(raw.get("chunk_size", 1)),
+        "feature_gates": None,  # legacy format has no gate surface
     }
 
 
@@ -118,6 +128,7 @@ def cmd_serve(args) -> int:
             profiles=profiles[1:],
             batch_size=cfg["batch_size"],
             chunk_size=cfg["chunk_size"],
+            feature_gates=cfg.get("feature_gates"),
         )
     else:
         sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)

@@ -10,6 +10,10 @@ one frameworkImpl per profile, profile/profile.go:50)."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..api import types as t
 
 MAX_NODE_SCORE = 100  # framework.MaxNodeScore (interface.go)
 
@@ -71,6 +75,23 @@ class Profile:
     # score bonus per existing pod whose required affinity matches the
     # incoming pod.
     hard_pod_affinity_weight: int = 1
+    # NodeAffinityArgs.AddedAffinity (types_pluginargs.go:90): a per-profile
+    # NodeAffinity ANDed with every pod's own — required terms join the
+    # filter (node_affinity.go:146), preferred terms join the score.
+    added_affinity: Optional["t.NodeAffinity"] = None
+    # NodeResourcesFitArgs.IgnoredResources / IgnoredResourceGroups
+    # (types_pluginargs.go:45): EXTENDED resources (never cpu/memory/
+    # ephemeral-storage/pods) the fit FILTER skips; groups match the prefix
+    # before "/" (fit.go:488 fitsRequest).
+    fit_ignored_resources: tuple[str, ...] = ()
+    fit_ignored_resource_groups: tuple[str, ...] = ()
+    # PodTopologySpreadArgs.DefaultConstraints (types_pluginargs.go:72, List
+    # defaulting): applied to pods with no constraints of their own.  The
+    # reference derives each constraint's selector from the services/
+    # replicasets owning the pod (plugins/helper DefaultSelector); without a
+    # controller model the analog is the pod's own full label set, and
+    # label-less pods are skipped like selector-less defaults are.
+    pts_default_constraints: tuple["t.TopologySpreadConstraint", ...] = ()
     # Deterministic tie-break seed (parity mode: both sides share it).
     tie_break_seed: int = 0
 
@@ -137,6 +158,40 @@ def validate_profile(profile: Profile) -> list[str]:
                 errs.append(f"scoring_strategy.shape score {score} outside [0, 10]")
     if profile.hard_pod_affinity_weight < 0 or profile.hard_pod_affinity_weight > 100:
         errs.append("hard_pod_affinity_weight outside [0, 100]")
+    from ..api import types as t
+
+    fixed = {t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE, "pods"}
+    for rname in profile.fit_ignored_resources:
+        # validation_pluginargs.go ValidateNodeResourcesFitArgs: only
+        # extended resources may be ignored.
+        if rname in fixed:
+            errs.append(
+                f"fit_ignored_resources[{rname!r}]: built-in resources "
+                "cannot be ignored"
+            )
+    for g in profile.fit_ignored_resource_groups:
+        if "/" in g:
+            errs.append(
+                f"fit_ignored_resource_groups[{g!r}]: group must not "
+                "contain '/'"
+            )
+    if profile.added_affinity is not None and profile.added_affinity.required:
+        if not profile.added_affinity.required.terms:
+            errs.append("added_affinity.required must have ≥1 term")
+    for i, c in enumerate(profile.pts_default_constraints):
+        if c.max_skew < 1:
+            errs.append(f"pts_default_constraints[{i}]: max_skew must be ≥1")
+        if c.when_unsatisfiable not in (t.DO_NOT_SCHEDULE, t.SCHEDULE_ANYWAY):
+            errs.append(
+                f"pts_default_constraints[{i}]: unknown whenUnsatisfiable "
+                f"{c.when_unsatisfiable!r}"
+            )
+        if c.label_selector is not None:
+            # validation_pluginargs.go: default constraints must not carry
+            # selectors — they are derived per pod.
+            errs.append(
+                f"pts_default_constraints[{i}]: label_selector must be unset"
+            )
     return errs
 
 

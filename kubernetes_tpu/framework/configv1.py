@@ -1,0 +1,337 @@
+"""Versioned external config: the ``kubescheduler.config.k8s.io/v1``
+KubeSchedulerConfiguration analog with defaulting + conversion into the
+internal Profile (the scheme conversion path,
+pkg/scheduler/apis/config/v1/ + staging/src/k8s.io/kube-scheduler/config/v1).
+
+External shape (JSON; camelCase like the reference wire form):
+
+    {"apiVersion": "kubescheduler.config.k8s.io/v1",
+     "kind": "KubeSchedulerConfiguration",
+     "percentageOfNodesToScore": 100,
+     "featureGates": {"SchedulerQueueingHints": true},
+     "batchSize": 4096, "chunkSize": 64,          # TPU-native extensions
+     "profiles": [
+       {"schedulerName": "default-scheduler",
+        "percentageOfNodesToScore": 100,
+        "plugins": {
+          "filter": {"enabled": [{"name": "NodePorts"}],
+                      "disabled": [{"name": "*"}]},
+          "score":  {"enabled": [{"name": "NodeResourcesFit", "weight": 2}],
+                      "disabled": [{"name": "ImageLocality"}]}},
+        "pluginConfig": [
+          {"name": "NodeResourcesFit",
+           "args": {"scoringStrategy": {"type": "LeastAllocated",
+                     "resources": [{"name": "cpu", "weight": 1}]},
+                    "ignoredResources": [], "ignoredResourceGroups": []}},
+          {"name": "InterPodAffinity",
+           "args": {"hardPodAffinityWeight": 1}},
+          {"name": "NodeAffinity", "args": {"addedAffinity": {...}}},
+          {"name": "PodTopologySpread",
+           "args": {"defaultConstraints": [...], "defaultingType": "List"}}
+        ]}]}
+
+Defaulting mirrors v1/default_plugins.go: a profile starts from the default
+plugin set; ``disabled`` entries (or ``{"name": "*"}``) remove from it,
+``enabled`` entries append after it — the mergePlugins order
+(default_plugins.go:81).  Unknown keys are strict errors everywhere (the
+scheme's strict decoding)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from .config import DEFAULT_PROFILE, Profile, ScoringStrategy
+from .features import DEFAULT_GATES, FeatureGates, parse_feature_gates
+
+API_VERSION = "kubescheduler.config.k8s.io/v1"
+KIND = "KubeSchedulerConfiguration"
+
+_TOP_KEYS = {
+    "apiVersion", "kind", "percentageOfNodesToScore", "featureGates",
+    "profiles", "batchSize", "chunkSize",
+}
+_PROFILE_KEYS = {"schedulerName", "percentageOfNodesToScore", "plugins", "pluginConfig"}
+_PLUGIN_SET_KEYS = {"filter", "score"}
+_PLUGIN_LIST_KEYS = {"enabled", "disabled"}
+_ARG_PLUGINS = {
+    "NodeResourcesFit", "InterPodAffinity", "NodeAffinity", "PodTopologySpread",
+}
+
+
+def is_versioned(raw: dict) -> bool:
+    return "apiVersion" in raw or "kind" in raw
+
+
+def _err(path: str, msg: str) -> ValueError:
+    return ValueError(f"{path}: {msg}")
+
+
+def _merge_plugin_list(defaults, raw: dict, path: str, weighted: bool):
+    """mergePlugins (default_plugins.go:81): defaults minus ``disabled``
+    plus ``enabled`` appended in order."""
+    unknown = set(raw) - _PLUGIN_LIST_KEYS
+    if unknown:
+        raise _err(path, f"unknown keys {sorted(unknown)}")
+    for d in raw.get("disabled", []):
+        bad = set(d) - {"name"}
+        if bad:
+            raise _err(path, f"disabled entry: unknown keys {sorted(bad)}")
+        if not d.get("name"):
+            raise _err(path, "disabled entry missing name")
+    disabled = {d["name"] for d in raw.get("disabled", [])}
+    if "*" in disabled:
+        out = []
+    elif weighted:
+        out = [(n, w) for n, w in defaults if n not in disabled]
+    else:
+        out = [n for n in defaults if n not in disabled]
+    for e in raw.get("enabled", []):
+        bad = set(e) - {"name", "weight"}
+        if bad:
+            raise _err(path, f"enabled entry: unknown keys {sorted(bad)}")
+        name = e.get("name")
+        if not name:
+            raise _err(path, "enabled entry missing name")
+        if weighted:
+            out.append((name, int(e.get("weight", 1))))
+        elif "weight" in e:
+            raise _err(path, f"enabled[{name!r}]: weight is a score-phase field")
+        else:
+            out.append(name)
+    return tuple(out)
+
+
+def _selector_term(raw: dict, path: str) -> t.NodeSelectorTerm:
+    bad = set(raw) - {"matchExpressions", "matchFields"}
+    if bad:
+        raise _err(path, f"unknown keys {sorted(bad)}")
+
+    def reqs(key):
+        out = []
+        for r in raw.get(key, []):
+            rbad = set(r) - {"key", "operator", "values"}
+            if rbad:
+                raise _err(path, f"{key}: unknown keys {sorted(rbad)}")
+            out.append(
+                t.NodeSelectorRequirement(
+                    key=r["key"], operator=r["operator"],
+                    values=tuple(r.get("values", ())),
+                )
+            )
+        return tuple(out)
+
+    return t.NodeSelectorTerm(
+        match_expressions=reqs("matchExpressions"),
+        match_fields=reqs("matchFields"),
+    )
+
+
+def _added_affinity(raw: dict, path: str) -> t.NodeAffinity:
+    req_key = "requiredDuringSchedulingIgnoredDuringExecution"
+    pref_key = "preferredDuringSchedulingIgnoredDuringExecution"
+    bad = set(raw) - {req_key, pref_key}
+    if bad:
+        raise _err(path, f"unknown keys {sorted(bad)}")
+    required = None
+    if req_key in raw:
+        sel = raw[req_key]
+        sbad = set(sel) - {"nodeSelectorTerms"}
+        if sbad:
+            raise _err(path, f"unknown keys {sorted(sbad)}")
+        required = t.NodeSelector(
+            terms=tuple(
+                _selector_term(term, f"{path}.{req_key}")
+                for term in sel.get("nodeSelectorTerms", [])
+            )
+        )
+    preferred = tuple(
+        t.PreferredSchedulingTerm(
+            weight=int(p["weight"]),
+            preference=_selector_term(p["preference"], f"{path}.{pref_key}"),
+        )
+        for p in raw.get(pref_key, [])
+    )
+    return t.NodeAffinity(required=required, preferred=preferred)
+
+
+def _spread_constraint(raw: dict, path: str) -> t.TopologySpreadConstraint:
+    bad = set(raw) - {"maxSkew", "topologyKey", "whenUnsatisfiable"}
+    if bad:
+        # validation_pluginargs.go: default constraints must not carry
+        # selectors (they are derived per pod) — so reject them here too.
+        raise _err(path, f"unknown keys {sorted(bad)}")
+    return t.TopologySpreadConstraint(
+        max_skew=int(raw["maxSkew"]),
+        topology_key=raw["topologyKey"],
+        when_unsatisfiable=raw["whenUnsatisfiable"],
+    )
+
+
+def _apply_plugin_config(kwargs: dict, entries: list, path: str) -> None:
+    seen: set[str] = set()
+    for i, pc in enumerate(entries):
+        p = f"{path}.pluginConfig[{i}]"
+        bad = set(pc) - {"name", "args"}
+        if bad:
+            raise _err(p, f"unknown keys {sorted(bad)}")
+        name = pc.get("name")
+        if name not in _ARG_PLUGINS:
+            raise _err(p, f"no args surface for plugin {name!r}")
+        if name in seen:
+            raise _err(p, f"duplicate pluginConfig for {name!r}")
+        seen.add(name)
+        args = pc.get("args", {})
+        if name == "NodeResourcesFit":
+            bad = set(args) - {"scoringStrategy", "ignoredResources", "ignoredResourceGroups"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            if "scoringStrategy" in args:
+                ss = args["scoringStrategy"]
+                sbad = set(ss) - {"type", "resources", "requestedToCapacityRatio"}
+                if sbad:
+                    raise _err(p, f"scoringStrategy: unknown keys {sorted(sbad)}")
+                shape = ((0, 0), (100, 10))
+                if "requestedToCapacityRatio" in ss:
+                    shape = tuple(
+                        (int(pt["utilization"]), int(pt["score"]))
+                        for pt in ss["requestedToCapacityRatio"].get("shape", [])
+                    ) or shape
+                kwargs["scoring_strategy"] = ScoringStrategy(
+                    type=ss.get("type", "LeastAllocated"),
+                    resources=tuple(
+                        (r["name"], int(r.get("weight", 1)))
+                        for r in ss.get("resources", [])
+                    )
+                    or ScoringStrategy().resources,
+                    shape=shape,
+                )
+            kwargs["fit_ignored_resources"] = tuple(args.get("ignoredResources", ()))
+            kwargs["fit_ignored_resource_groups"] = tuple(
+                args.get("ignoredResourceGroups", ())
+            )
+        elif name == "InterPodAffinity":
+            bad = set(args) - {"hardPodAffinityWeight"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            if "hardPodAffinityWeight" in args:
+                kwargs["hard_pod_affinity_weight"] = int(args["hardPodAffinityWeight"])
+        elif name == "NodeAffinity":
+            bad = set(args) - {"addedAffinity"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            if "addedAffinity" in args:
+                kwargs["added_affinity"] = _added_affinity(
+                    args["addedAffinity"], f"{p}.addedAffinity"
+                )
+        elif name == "PodTopologySpread":
+            bad = set(args) - {"defaultConstraints", "defaultingType"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            dt = args.get("defaultingType", "List")
+            if dt not in ("List", "System"):
+                raise _err(p, f"defaultingType {dt!r} unknown")
+            if dt == "System":
+                # v1 system defaults (default_plugins.go): zone maxSkew 3 +
+                # hostname maxSkew 5, both ScheduleAnyway.
+                kwargs["pts_default_constraints"] = (
+                    t.TopologySpreadConstraint(
+                        max_skew=3,
+                        topology_key="topology.kubernetes.io/zone",
+                        when_unsatisfiable=t.SCHEDULE_ANYWAY,
+                    ),
+                    t.TopologySpreadConstraint(
+                        max_skew=5,
+                        topology_key="kubernetes.io/hostname",
+                        when_unsatisfiable=t.SCHEDULE_ANYWAY,
+                    ),
+                )
+            else:
+                kwargs["pts_default_constraints"] = tuple(
+                    _spread_constraint(c, f"{p}.defaultConstraints[{j}]")
+                    for j, c in enumerate(args.get("defaultConstraints", []))
+                )
+
+
+def convert(raw: dict) -> dict:
+    """Convert + default an external v1 config into the internal form:
+    {"profiles": [Profile], "batch_size", "chunk_size", "feature_gates"}."""
+    if raw.get("apiVersion") != API_VERSION:
+        raise _err("apiVersion", f"expected {API_VERSION!r}, got {raw.get('apiVersion')!r}")
+    if raw.get("kind") != KIND:
+        raise _err("kind", f"expected {KIND!r}, got {raw.get('kind')!r}")
+    unknown = set(raw) - _TOP_KEYS
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    gates: FeatureGates = DEFAULT_GATES
+    if "featureGates" in raw:
+        gates, errs = parse_feature_gates(raw["featureGates"])
+        if errs:
+            raise ValueError("; ".join(errs))
+    top_pct = raw.get("percentageOfNodesToScore")
+    profiles: list[Profile] = []
+    for pi, rp in enumerate(raw.get("profiles", [])):
+        path = f"profiles[{pi}]"
+        bad = set(rp) - _PROFILE_KEYS
+        if bad:
+            raise _err(path, f"unknown keys {sorted(bad)}")
+        kwargs: dict = {}
+        if "schedulerName" in rp:
+            kwargs["name"] = rp["schedulerName"]
+        pct = rp.get("percentageOfNodesToScore", top_pct)
+        if pct is not None:
+            kwargs["percentage_of_nodes_to_score"] = int(pct)
+        plugins = rp.get("plugins", {})
+        badp = set(plugins) - _PLUGIN_SET_KEYS
+        if badp:
+            raise _err(f"{path}.plugins", f"unknown extension points {sorted(badp)}")
+        if "filter" in plugins:
+            kwargs["filters"] = _merge_plugin_list(
+                DEFAULT_PROFILE.filters, plugins["filter"],
+                f"{path}.plugins.filter", weighted=False,
+            )
+        if "score" in plugins:
+            kwargs["scorers"] = _merge_plugin_list(
+                DEFAULT_PROFILE.scorers, plugins["score"],
+                f"{path}.plugins.score", weighted=True,
+            )
+        _apply_plugin_config(kwargs, rp.get("pluginConfig", []), path)
+        if not gates.enabled("DynamicResourceAllocation"):
+            # plugins/registry.go:49 — the plugin is not registered when the
+            # gate is off, so EXPLICITLY enabling it is a config error.  The
+            # default set's copy is stripped by TPUScheduler (the single
+            # gate-strip site) when these gates reach it.
+            if "plugins" in rp and "filter" in rp["plugins"] and any(
+                e.get("name") == "DynamicResources"
+                for e in rp["plugins"]["filter"].get("enabled", [])
+            ):
+                raise _err(
+                    f"{path}.plugins.filter",
+                    "DynamicResources requires the DynamicResourceAllocation "
+                    "feature gate",
+                )
+        profiles.append(Profile(**kwargs))
+    if not profiles:
+        default = DEFAULT_PROFILE
+        if top_pct is not None:
+            default = dataclasses.replace(
+                default, percentage_of_nodes_to_score=int(top_pct)
+            )
+        profiles = [default]
+    # The reference validates component config at startup
+    # (apis/config/validation); reject semantically invalid profiles here so
+    # `serve --config` refuses them, not just the validate subcommand.
+    from .config import validate_profile
+
+    for p in profiles:
+        errs = validate_profile(p)
+        if errs:
+            raise ValueError(
+                f"profile {p.name!r}: " + "; ".join(errs)
+            )
+    return {
+        "profiles": profiles,
+        "batch_size": int(raw.get("batchSize", 256)),
+        "chunk_size": int(raw.get("chunkSize", 1)),
+        "feature_gates": gates,
+    }

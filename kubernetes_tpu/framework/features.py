@@ -1,0 +1,65 @@
+"""Feature gates (pkg/features/kube_features.go via component-base
+featuregate): named runtime behavior switches with per-gate defaults,
+settable from the versioned config's ``featureGates`` map.
+
+The reference carries 118 gates; this build registers the scheduler-relevant
+subset.  Gates marked "wired" change behavior; the others are accepted and
+validated (so upstream configs parse) but their on-state is the only one
+this build implements — setting one to a non-default value is an error
+rather than a silent no-op."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# name → (default, wired).  Wired gates actually switch behavior here:
+#   SchedulerQueueingHints — object-aware requeue hints (queue.PLUGIN_HINTS;
+#       off = the reference's pre-hint behavior: static event masks only).
+#   DynamicResourceAllocation — the DynamicResources plugin may appear in
+#       profiles (plugins/registry.go:49 gates registration).
+KNOWN_GATES: dict[str, tuple[bool, bool]] = {
+    "SchedulerQueueingHints": (True, True),
+    "DynamicResourceAllocation": (True, True),
+    "NodeInclusionPolicyInPodTopologySpread": (True, False),
+    "MatchLabelKeysInPodTopologySpread": (True, False),
+    "PodSchedulingReadiness": (True, False),  # scheduling gates
+}
+
+
+@dataclass(frozen=True)
+class FeatureGates:
+    overrides: tuple[tuple[str, bool], ...] = ()
+
+    def enabled(self, name: str) -> bool:
+        for k, v in self.overrides:
+            if k == name:
+                return v
+        default, _wired = KNOWN_GATES[name]
+        return default
+
+
+DEFAULT_GATES = FeatureGates()
+
+
+def parse_feature_gates(raw: dict) -> tuple[FeatureGates, list[str]]:
+    """Validate a ``featureGates`` map (--feature-gates).  Unknown gates and
+    non-default values for unwired gates are errors."""
+    errs: list[str] = []
+    overrides: list[tuple[str, bool]] = []
+    for name, val in sorted(raw.items()):
+        known = KNOWN_GATES.get(name)
+        if known is None:
+            errs.append(f"featureGates[{name!r}]: unknown feature gate")
+            continue
+        if not isinstance(val, bool):
+            errs.append(f"featureGates[{name!r}]: value must be boolean")
+            continue
+        default, wired = known
+        if not wired and val != default:
+            errs.append(
+                f"featureGates[{name!r}]: this build only implements the "
+                f"{default}-state of the gate"
+            )
+            continue
+        overrides.append((name, val))
+    return FeatureGates(tuple(overrides)), errs

@@ -182,8 +182,28 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
             pref_prog.add_term(p.preference, it)
             if len(pref_prog.terms) > before:
                 weights.append(p.weight)
+    # NodeAffinityArgs.AddedAffinity (node_affinity.go:117): the profile's
+    # affinity is a SEPARATE required selector ANDed with the pod's own
+    # (two OR-of-term groups, both must match), and its preferred terms
+    # join the pod's in Score.  Featurized per pod so the batch feature
+    # cache (keyed on profile) stays coherent across profiles.
+    added = fctx.profile.added_affinity if fctx.profile else None
+    add_prog = _Program()
+    has_added = False
+    if added is not None:
+        if added.required is not None and added.required.terms:
+            has_added = True
+            for term in added.required.terms:
+                add_prog.add_term(term, it)
+        for p in added.preferred:
+            before = len(pref_prog.terms)
+            pref_prog.add_term(p.preference, it)
+            if len(pref_prog.terms) > before:
+                weights.append(p.weight)
     feats = {"na_sel_pairs": sel, "na_has_required": np.bool_(has_required)}
     feats.update(req_prog.tensors("na_req"))
+    feats["na_has_added"] = np.bool_(has_added)
+    feats.update(add_prog.tensors("na_add"))
     pref = pref_prog.tensors("na_pref")
     w = np.zeros(pref["na_pref_term_valid"].shape[0], np.int64)
     w[: len(weights)] = weights
@@ -204,7 +224,12 @@ def filter_fn(state, pf, ctx: PassContext):
     )
     any_term = (term_match & pf["na_req_term_valid"][:, None]).any(0)
     affinity_ok = jnp.where(pf["na_has_required"], any_term, True)
-    return sel_ok & affinity_ok
+    add_match = _eval_terms(
+        state, pf["na_add_op"], pf["na_add_key"], pf["na_add_vals"], pf["na_add_int"]
+    )
+    add_any = (add_match & pf["na_add_term_valid"][:, None]).any(0)
+    added_ok = jnp.where(pf["na_has_added"], add_any, True)
+    return sel_ok & affinity_ok & added_ok
 
 
 def score_fn(state, pf, ctx: PassContext, feasible):
@@ -227,12 +252,20 @@ for _k, _fill in [
     ("na_pref_vals", -1),
     ("na_pref_int", 0),
     ("na_pref_weight", 0),
+    ("na_add_op", OP_PAD),
+    ("na_add_key", -1),
+    ("na_add_vals", -1),
+    ("na_add_int", 0),
+    ("na_add_term_valid", 0),
 ]:
     feature_fill(_k, _fill)
 
 def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
     # No nodeSelector and no node affinity: filter passes everywhere, score
-    # is uniformly zero.
+    # is uniformly zero.  A profile-level addedAffinity applies to EVERY
+    # pod of the profile.
+    if fctx.profile is not None and fctx.profile.added_affinity is not None:
+        return True
     aff = pod.spec.affinity
     return bool(pod.spec.node_selector) or bool(aff and aff.node_affinity)
 

@@ -59,8 +59,13 @@ def filter_fn(state, pf, ctx: PassContext):
     # Pod count check always applies (fit.go:491).
     fits = state.num_pods + 1 <= state.allowed_pods
     req = pf["req"]  # (R,) i64
+    # NodeResourcesFitArgs.IgnoredResources: zero the demand in the FIT
+    # check only — bind-time accounting still charges the full delta
+    # (fit.go:488 skips ignoredExtendedResources in fitsRequest).
+    ig = ctx.static.get("fit_ignored_cols", ()) if ctx.static else ()
+    req_fit = req.at[np.array(ig, np.int32)].set(0) if ig else req
     free = state.alloc - state.req  # (N, R)
-    fits &= jnp.all((req[None, :] == 0) | (req[None, :] <= free), axis=1)
+    fits &= jnp.all((req_fit[None, :] == 0) | (req_fit[None, :] <= free), axis=1)
     if ctx.nom is not None:
         # Nominated-pod accounting (RunFilterPluginsWithNominatedPods,
         # runtime/framework.go:973): the pod must ALSO fit with nominated
@@ -77,7 +82,7 @@ def filter_fn(state, pf, ctx: PassContext):
         )
         eff_cnt = jnp.maximum(nom_cnt - self_mask.astype(jnp.int32), 0)
         fits_nom = jnp.all(
-            (req[None, :] == 0) | (req[None, :] <= free - eff_req), axis=1
+            (req_fit[None, :] == 0) | (req_fit[None, :] <= free - eff_req), axis=1
         )
         fits_nom &= state.num_pods + 1 + eff_cnt <= state.allowed_pods
         applies = pf["priority"] <= nom_prio  # (N,)
@@ -186,12 +191,29 @@ def balanced_score_fn(state, pf, ctx: PassContext, feasible=None):
 
 def static_features(profile, schema, builder_res_col: dict[str, int]) -> dict:
     """Static (non-tensor) per-profile config the score fns need."""
+    from ..snapshot import FIXED_RESOURCES
+
+    ignored = set(profile.fit_ignored_resources)
+    groups = set(profile.fit_ignored_resource_groups)
     return {
         "fit_strategy_cols": strategy_columns(profile, builder_res_col),
         "balanced_cols": tuple(
             (builder_res_col[name],)
             for name, _ in profile.scoring_strategy.resources
             if name in builder_res_col
+        ),
+        # Only EXTENDED resources may be ignored (fit.go:488; built-ins are
+        # always checked).  Groups match the "<group>/<name>" prefix.
+        "fit_ignored_cols": tuple(
+            sorted(
+                col
+                for name, col in builder_res_col.items()
+                if name not in FIXED_RESOURCES
+                and (
+                    name in ignored
+                    or ("/" in name and name.split("/", 1)[0] in groups)
+                )
+            )
         ),
     }
 

@@ -105,17 +105,34 @@ def _constraint_feats(
     }
 
 
+def _effective_constraints(pod: t.Pod, fctx: FeaturizeContext):
+    """Pod constraints, or the profile's defaultConstraints for pods without
+    any (PodTopologySpreadArgs List defaulting, types_pluginargs.go:72).
+    The reference derives each default's selector from the pod's owning
+    services/replicasets (plugins/helper.DefaultSelector); without a
+    controller model the analog is the pod's own full label set, and
+    label-less pods skip defaulting (like selector-less defaults do)."""
+    cons = pod.spec.topology_spread_constraints
+    if cons:
+        return cons
+    prof = fctx.profile
+    if prof is None or not prof.pts_default_constraints or not pod.metadata.labels:
+        return cons
+    import dataclasses
+
+    sel = t.LabelSelector(
+        match_labels=tuple(sorted(pod.metadata.labels.items()))
+    )
+    return tuple(
+        dataclasses.replace(c, label_selector=sel)
+        for c in prof.pts_default_constraints
+    )
+
+
 def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
-    hard = [
-        c
-        for c in pod.spec.topology_spread_constraints
-        if c.when_unsatisfiable == t.DO_NOT_SCHEDULE
-    ]
-    soft = [
-        c
-        for c in pod.spec.topology_spread_constraints
-        if c.when_unsatisfiable == t.SCHEDULE_ANYWAY
-    ]
+    cons = _effective_constraints(pod, fctx)
+    hard = [c for c in cons if c.when_unsatisfiable == t.DO_NOT_SCHEDULE]
+    soft = [c for c in cons if c.when_unsatisfiable == t.SCHEDULE_ANYWAY]
     feats = _constraint_feats(hard, pod, fctx, "tps_h")
     feats.update(_constraint_feats(soft, pod, fctx, "tps_s"))
     # Node-inclusion policies are evaluated with the NodeAffinity and
@@ -310,8 +327,9 @@ def hard_filter_fn(state, pf, ctx: PassContext):
 
 def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
     # No constraints: both PreFilter and PreScore return Skip
-    # (filtering.go:152, scoring.go:140).
-    return bool(pod.spec.topology_spread_constraints)
+    # (filtering.go:152, scoring.go:140).  Profile defaultConstraints make
+    # the op active for any labelled pod of the profile.
+    return bool(_effective_constraints(pod, fctx))
 
 
 register(

@@ -160,6 +160,10 @@ class SchedulingQueue:
         # gated/pool), per gang — the Permit gate asks "are enough members
         # still coming?" before deciding wait-vs-rollback (WaitOnPermit).
         self._gang_members: dict[str, set[str]] = {}
+        # SchedulerQueueingHints feature gate: when False, requeue decisions
+        # use the static per-plugin event masks alone (the reference's
+        # pre-hint behavior); object-aware PLUGIN_HINTS are skipped.
+        self.use_queueing_hints = True
 
     def __len__(self) -> int:
         return len(self._in_active)
@@ -386,7 +390,7 @@ class SchedulingQueue:
         for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
             if not (PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY) & event):
                 continue
-            hint = PLUGIN_HINTS.get(pl)
+            hint = PLUGIN_HINTS.get(pl) if self.use_queueing_hints else None
             if hint is None or ctx is None or hint(qp, event, ctx):
                 return True
         return False

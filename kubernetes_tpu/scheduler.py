@@ -84,7 +84,13 @@ class TPUScheduler:
         profiles: list[Profile] | None = None,
         extenders: list | None = None,
         consistency_check_every: int = 0,
+        feature_gates=None,
     ):
+        from .framework.features import DEFAULT_GATES
+
+        # Feature gates (pkg/features/kube_features.go): runtime behavior
+        # switches; see framework/features.py for the wired subset.
+        self.feature_gates = feature_gates or DEFAULT_GATES
         # Restrict to plugins whose vectorized ops are registered (a no-op
         # once the op inventory is complete; prevents KeyError mid-build-out).
         self.profile = registered_subset(profile)
@@ -96,6 +102,25 @@ class TPUScheduler:
         self.profiles: dict[str, Profile] = {self.profile.name: self.profile}
         for p in profiles or ():
             self.profiles[p.name] = registered_subset(p)
+        if not self.feature_gates.enabled("DynamicResourceAllocation"):
+            # plugins/registry.go:49: the DRA plugin is only registered when
+            # the gate is on; with it off the plugin simply doesn't exist.
+            import dataclasses as _dc
+
+            self.profiles = {
+                name: _dc.replace(
+                    p,
+                    filters=tuple(
+                        f for f in p.filters if f != "DynamicResources"
+                    ),
+                )
+                for name, p in self.profiles.items()
+            }
+            self.profile = self.profiles[self.profile.name]
+        # Gate off ⇒ the plugin exists at NO extension point: claims are
+        # never allocated at Reserve/PreBind either (the reference scheduler
+        # simply has no DRA code registered).
+        self._dra_enabled = self.feature_gates.enabled("DynamicResourceAllocation")
         # Out-of-process extenders (pkg/scheduler/extender.go); a non-empty
         # chain routes scheduling through the per-pod eval-only path.
         self.extenders = list(extenders or ())
@@ -115,6 +140,9 @@ class TPUScheduler:
         self.builder = SnapshotBuilder(self.interns)
         self.cache = Cache(self.builder)
         self.queue = queue or SchedulingQueue()
+        self.queue.use_queueing_hints = self.feature_gates.enabled(
+            "SchedulerQueueingHints"
+        )
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None
@@ -612,7 +640,7 @@ class TPUScheduler:
             return ScheduleOutcome(qp.pod, None, 0, len(nodes))
 
         undo_dra: list | None = []
-        if qp.pod.spec.resource_claims:
+        if self._dra_enabled and qp.pod.spec.resource_claims:
             undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, best)
             if undo_dra is None:
                 return _fail_bind([], [])
@@ -1068,11 +1096,12 @@ class TPUScheduler:
                 continue
             undo: list | None = []
             undo_dra: list | None = []
-            has_prebind = bool(qp.pod.spec.resource_claims) or any(
+            dra_claims = self._dra_enabled and bool(qp.pod.spec.resource_claims)
+            has_prebind = dra_claims or any(
                 v.pvc for v in qp.pod.spec.volumes
             )
             t_pb = time.perf_counter() if has_prebind else 0.0
-            if qp.pod.spec.resource_claims:
+            if dra_claims:
                 # DRA Reserve/PreBind: allocate + reserve claims on the
                 # chosen node (dynamicresources' assume-cache write).
                 undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, node_name)

@@ -1,0 +1,307 @@
+"""Versioned config (kubescheduler.config.k8s.io/v1) conversion+defaulting
+and feature gates (pkg/scheduler/apis/config/v1/, pkg/features)."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework import configv1
+from kubernetes_tpu.framework.config import DEFAULT_PROFILE
+from kubernetes_tpu.framework.features import parse_feature_gates
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def v1(**kw) -> dict:
+    base = {"apiVersion": configv1.API_VERSION, "kind": configv1.KIND}
+    base.update(kw)
+    return base
+
+
+def test_empty_config_defaults_to_default_profile():
+    cfg = configv1.convert(v1())
+    assert len(cfg["profiles"]) == 1
+    assert cfg["profiles"][0] == DEFAULT_PROFILE
+    assert cfg["feature_gates"].enabled("SchedulerQueueingHints")
+
+
+def test_plugin_merge_disable_star_and_enable():
+    cfg = configv1.convert(
+        v1(
+            profiles=[
+                {
+                    "schedulerName": "fit-only",
+                    "plugins": {
+                        "filter": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [
+                                {"name": "NodeUnschedulable"},
+                                {"name": "NodeResourcesFit"},
+                            ],
+                        },
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "NodeResourcesFit", "weight": 2}],
+                        },
+                    },
+                }
+            ]
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.name == "fit-only"
+    assert p.filters == ("NodeUnschedulable", "NodeResourcesFit")
+    assert p.scorers == (("NodeResourcesFit", 2),)
+
+
+def test_plugin_merge_disable_one_keeps_order():
+    cfg = configv1.convert(
+        v1(
+            profiles=[
+                {
+                    "plugins": {
+                        "score": {"disabled": [{"name": "ImageLocality"}]},
+                    }
+                }
+            ]
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.filters == DEFAULT_PROFILE.filters  # untouched point
+    assert ("ImageLocality", 1) not in p.scorers
+    assert p.scorers[0] == DEFAULT_PROFILE.scorers[0]
+
+
+def test_plugin_args_convert():
+    cfg = configv1.convert(
+        v1(
+            percentageOfNodesToScore=50,
+            profiles=[
+                {
+                    "pluginConfig": [
+                        {
+                            "name": "NodeResourcesFit",
+                            "args": {
+                                "scoringStrategy": {
+                                    "type": "MostAllocated",
+                                    "resources": [{"name": "cpu", "weight": 3}],
+                                },
+                                "ignoredResources": ["example.com/foo"],
+                            },
+                        },
+                        {
+                            "name": "InterPodAffinity",
+                            "args": {"hardPodAffinityWeight": 7},
+                        },
+                        {
+                            "name": "NodeAffinity",
+                            "args": {
+                                "addedAffinity": {
+                                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                                        "nodeSelectorTerms": [
+                                            {
+                                                "matchExpressions": [
+                                                    {
+                                                        "key": "zone",
+                                                        "operator": "In",
+                                                        "values": ["a"],
+                                                    }
+                                                ]
+                                            }
+                                        ]
+                                    }
+                                }
+                            },
+                        },
+                        {
+                            "name": "PodTopologySpread",
+                            "args": {"defaultingType": "System"},
+                        },
+                    ]
+                }
+            ],
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.scoring_strategy.type == "MostAllocated"
+    assert p.scoring_strategy.resources == (("cpu", 3),)
+    assert p.fit_ignored_resources == ("example.com/foo",)
+    assert p.hard_pod_affinity_weight == 7
+    assert p.added_affinity.required.terms[0].match_expressions[0].key == "zone"
+    assert p.percentage_of_nodes_to_score == 50
+    assert len(p.pts_default_constraints) == 2  # System defaults: zone+host
+    assert all(
+        c.when_unsatisfiable == t.SCHEDULE_ANYWAY
+        for c in p.pts_default_constraints
+    )
+
+
+def test_convert_rejects_semantically_invalid_profile():
+    # The serve path must refuse what validate would flag (the reference
+    # validates component config at startup).
+    with pytest.raises(ValueError, match="max_skew"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "pluginConfig": [
+                            {
+                                "name": "PodTopologySpread",
+                                "args": {
+                                    "defaultConstraints": [
+                                        {
+                                            "maxSkew": 0,
+                                            "topologyKey": "kubernetes.io/hostname",
+                                            "whenUnsatisfiable": "DoNotSchedule",
+                                        }
+                                    ]
+                                },
+                            }
+                        ]
+                    }
+                ]
+            )
+        )
+    with pytest.raises(ValueError, match="cannot be ignored"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "pluginConfig": [
+                            {
+                                "name": "NodeResourcesFit",
+                                "args": {"ignoredResources": ["cpu"]},
+                            }
+                        ]
+                    }
+                ]
+            )
+        )
+
+
+def test_strict_unknown_keys():
+    with pytest.raises(ValueError, match="unknown config keys"):
+        configv1.convert(v1(bogus=1))
+    with pytest.raises(ValueError, match="disabled entry"):
+        configv1.convert(
+            v1(profiles=[{"plugins": {"score": {"disabled": [{"nmae": "X"}]}}}])
+        )
+    with pytest.raises(ValueError, match="unknown keys"):
+        configv1.convert(v1(profiles=[{"nope": 1}]))
+    with pytest.raises(ValueError, match="unknown extension points"):
+        configv1.convert(v1(profiles=[{"plugins": {"preBind": {}}}]))
+    with pytest.raises(ValueError, match="no args surface"):
+        configv1.convert(
+            v1(profiles=[{"pluginConfig": [{"name": "NodePorts", "args": {}}]}])
+        )
+    with pytest.raises(ValueError, match="apiVersion"):
+        configv1.convert({"apiVersion": "v2", "kind": configv1.KIND})
+
+
+def test_feature_gates_parse_and_validate():
+    gates, errs = parse_feature_gates({"SchedulerQueueingHints": False})
+    assert not errs and not gates.enabled("SchedulerQueueingHints")
+    _, errs = parse_feature_gates({"NoSuchGate": True})
+    assert errs and "unknown" in errs[0]
+    # Unwired gates only accept their default state.
+    _, errs = parse_feature_gates(
+        {"NodeInclusionPolicyInPodTopologySpread": False}
+    )
+    assert errs and "only implements" in errs[0]
+
+
+def test_dra_gate_off_strips_plugin_and_rejects_explicit():
+    cfg = configv1.convert(v1(featureGates={"DynamicResourceAllocation": False}))
+    # The strip happens at the single scheduler-side site, not in convert.
+    s = TPUScheduler(
+        profile=cfg["profiles"][0], feature_gates=cfg["feature_gates"]
+    )
+    assert "DynamicResources" not in s.profile.filters
+    with pytest.raises(ValueError, match="feature gate"):
+        configv1.convert(
+            v1(
+                featureGates={"DynamicResourceAllocation": False},
+                profiles=[
+                    {
+                        "plugins": {
+                            "filter": {"enabled": [{"name": "DynamicResources"}]}
+                        }
+                    }
+                ],
+            )
+        )
+
+
+def test_dra_gate_off_skips_claim_allocation_everywhere():
+    # Gate off ⇒ the plugin exists at NO extension point: the filter is
+    # stripped AND Reserve/PreBind never allocates claims (the reference
+    # scheduler simply has no DRA code registered).
+    from kubernetes_tpu.framework.features import FeatureGates
+
+    s = TPUScheduler(
+        batch_size=4,
+        feature_gates=FeatureGates((("DynamicResourceAllocation", False),)),
+    )
+    s.add_node(
+        make_node("n0").capacity({"cpu": "16", "memory": "64Gi", "pods": 110}).obj()
+    )
+    s.add_resource_claim(
+        t.ResourceClaim(name="c0", device_class="gpu.example.com", count=1)
+    )
+    s.add_pod(make_pod("p0").req({"cpu": "1"}).resource_claim("c0").obj())
+    out = s.schedule_all_pending()
+    # No devices exist anywhere — with the gate on this pod could never
+    # schedule; with it off the claim is invisible and the pod binds.
+    assert [o.node_name for o in out] == ["n0"]
+    assert not any(
+        c.allocated_node for c in s.builder.dra.claims.values()
+    )
+    assert s.builder.host_mirror_equal()
+
+
+def test_queueing_hints_gate_off_vs_on_precise():
+    from kubernetes_tpu.framework.features import FeatureGates
+
+    def build(gate: bool) -> TPUScheduler:
+        s = TPUScheduler(
+            batch_size=4,
+            feature_gates=FeatureGates((("SchedulerQueueingHints", gate),)),
+        )
+        s.add_node(
+            make_node("n1").capacity({"cpu": "8", "memory": "32Gi", "pods": 10}).obj()
+        )
+        # Two 3-cpu residents + one 2-cpu resident fill the node (8 cpu).
+        for i, c in enumerate((3, 3, 2)):
+            s.add_pod(make_pod(f"r{i}").req({"cpu": str(c)}).obj())
+        s.add_pod(make_pod("big").req({"cpu": "7"}).obj())
+        out = s.schedule_all_pending()
+        assert {o.pod.name: o.node_name for o in out}["big"] is None
+        assert "default/big" in s.queue._unschedulable
+        return s
+
+    # Gate ON: deleting the 2-cpu resident frees only 2 (free becomes 2);
+    # 7-cpu `big` cannot fit → object-aware hint skips the wake.
+    s_on = build(True)
+    s_on.delete_pod("default/r2")
+    assert "default/big" in s_on.queue._unschedulable
+    # Gate OFF: the static POD_DELETE mask wakes it regardless.
+    s_off = build(False)
+    s_off.delete_pod("default/r2")
+    assert "default/big" not in s_off.queue._unschedulable
+
+
+def test_cli_loads_versioned_config(tmp_path):
+    cfg = v1(
+        batchSize=64,
+        chunkSize=8,
+        profiles=[{"schedulerName": "custom"}],
+    )
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(cfg))
+    from kubernetes_tpu.__main__ import load_config
+
+    loaded = load_config(str(path))
+    assert loaded["batch_size"] == 64
+    assert loaded["chunk_size"] == 8
+    assert loaded["profiles"][0].name == "custom"

@@ -1,0 +1,198 @@
+"""Per-plugin args (apis/config/types_pluginargs.go:28–194):
+NodeResourcesFitArgs.ignoredResources/ignoredResourceGroups,
+NodeAffinityArgs.addedAffinity, PodTopologySpreadArgs.defaultConstraints —
+wired through Profile into featurize/static."""
+
+import dataclasses
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import DEFAULT_PROFILE, Profile, validate_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def _node(name, **labels):
+    w = make_node(name).capacity(
+        {"cpu": "8", "memory": "32Gi", "pods": 110, "example.com/foo": 1}
+    )
+    for k, v in labels.items():
+        w = w.label(k.replace("_", "."), v)
+    return w.obj()
+
+
+# --- NodeResourcesFitArgs.ignoredResources -------------------------------
+
+
+def test_fit_ignored_resources_skips_extended_resource():
+    # Baseline: demand 2 > capacity 1 → unschedulable.
+    s = TPUScheduler(batch_size=4)
+    s.add_node(_node("n1"))
+    s.add_pod(make_pod("p").req({"cpu": "1", "example.com/foo": 2}).obj())
+    out = s.schedule_all_pending()
+    assert out and all(o.node_name is None for o in out)
+
+    # Ignored by name: the fit filter skips the column; the pod binds.
+    prof = dataclasses.replace(
+        DEFAULT_PROFILE, fit_ignored_resources=("example.com/foo",)
+    )
+    s2 = TPUScheduler(batch_size=4, profile=prof)
+    s2.add_node(_node("n2"))
+    s2.add_pod(make_pod("p2").req({"cpu": "1", "example.com/foo": 2}).obj())
+    out2 = s2.schedule_all_pending()
+    assert [o.node_name for o in out2] == ["n2"]
+    # Bind-time accounting still charges the full delta (fit.go ignores the
+    # resource only in fitsRequest).
+    col = s2.builder.res_col["example.com/foo"]
+    assert s2.builder.host["req"][s2.cache.nodes["n2"].row, col] == 2
+    assert s2.builder.host_mirror_equal()
+
+
+def test_fit_ignored_resource_groups_matches_prefix():
+    prof = dataclasses.replace(
+        DEFAULT_PROFILE, fit_ignored_resource_groups=("example.com",)
+    )
+    s = TPUScheduler(batch_size=4, profile=prof)
+    s.add_node(_node("n1"))
+    s.add_pod(make_pod("p").req({"cpu": "1", "example.com/foo": 5}).obj())
+    out = s.schedule_all_pending()
+    assert [o.node_name for o in out] == ["n1"]
+
+
+def test_fit_ignored_validation():
+    bad = dataclasses.replace(
+        DEFAULT_PROFILE,
+        fit_ignored_resources=("cpu",),
+        fit_ignored_resource_groups=("example.com/foo",),
+    )
+    errs = validate_profile(bad)
+    assert any("cannot be ignored" in e for e in errs)
+    assert any("must not contain" in e for e in errs)
+
+
+# --- NodeAffinityArgs.addedAffinity --------------------------------------
+
+
+def _added_affinity(key, values):
+    return t.NodeAffinity(
+        required=t.NodeSelector(
+            terms=(
+                t.NodeSelectorTerm(
+                    match_expressions=(
+                        t.NodeSelectorRequirement(
+                            key=key, operator=t.OP_IN, values=tuple(values)
+                        ),
+                    )
+                ),
+            )
+        )
+    )
+
+
+def test_added_affinity_restricts_plain_pods():
+    prof = dataclasses.replace(
+        DEFAULT_PROFILE,
+        added_affinity=_added_affinity("node-class", ["fast"]),
+    )
+    s = TPUScheduler(batch_size=4, profile=prof)
+    s.add_node(_node("slow1"))
+    s.add_node(
+        make_node("fast1")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": 110})
+        .label("node-class", "fast")
+        .obj()
+    )
+    # A pod with NO affinity of its own must still honor the profile's.
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert [o.node_name for o in out] == ["fast1"]
+
+
+def test_added_affinity_ands_with_pod_affinity():
+    prof = dataclasses.replace(
+        DEFAULT_PROFILE,
+        added_affinity=_added_affinity("node-class", ["fast"]),
+    )
+    s = TPUScheduler(batch_size=4, profile=prof)
+    s.add_node(
+        make_node("fast-a")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": 110})
+        .label("node-class", "fast")
+        .label("zone", "a")
+        .obj()
+    )
+    s.add_node(
+        make_node("slow-b")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": 110})
+        .label("zone", "b")
+        .obj()
+    )
+    # Pod requires zone=b; profile requires node-class=fast; no node has
+    # both → unschedulable (the two selectors AND, node_affinity.go:146).
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"}).node_affinity_in("zone", ["b"]).obj()
+    )
+    out = s.schedule_all_pending()
+    assert all(o.node_name is None for o in out)
+    # Pod requiring zone=a lands on the fast node.
+    s.add_pod(
+        make_pod("q").req({"cpu": "1"}).node_affinity_in("zone", ["a"]).obj()
+    )
+    out2 = s.schedule_all_pending()
+    assert [o.node_name for o in out2 if o.pod.name == "q"] == ["fast-a"]
+
+
+# --- PodTopologySpreadArgs.defaultConstraints ----------------------------
+
+
+def test_default_constraints_spread_unconstrained_pods():
+    prof = dataclasses.replace(
+        DEFAULT_PROFILE,
+        pts_default_constraints=(
+            t.TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable=t.DO_NOT_SCHEDULE,
+            ),
+        ),
+    )
+    s = TPUScheduler(batch_size=4, profile=prof)
+    for zone, name in (("a", "za1"), ("a", "za2"), ("b", "zb1"), ("b", "zb2")):
+        s.add_node(
+            make_node(name)
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": 110})
+            .label("topology.kubernetes.io/zone", zone)
+            .obj()
+        )
+    # Labelled pods with NO constraints of their own spread by the default.
+    for i in range(4):
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).label("app", "web").obj())
+    out = s.schedule_all_pending()
+    zones = {}
+    for o in out:
+        assert o.node_name is not None
+        zone = "a" if o.node_name.startswith("za") else "b"
+        zones[zone] = zones.get(zone, 0) + 1
+    assert zones == {"a": 2, "b": 2}
+    # A label-less pod skips defaulting entirely (no derived selector).
+    s.add_pod(make_pod("bare").req({"cpu": "1"}).obj())
+    out2 = s.schedule_all_pending()
+    assert out2[0].node_name is not None
+    assert s.builder.host_mirror_equal()
+
+
+def test_default_constraints_validation():
+    bad = dataclasses.replace(
+        DEFAULT_PROFILE,
+        pts_default_constraints=(
+            t.TopologySpreadConstraint(
+                max_skew=0,
+                topology_key="zone",
+                when_unsatisfiable="Bogus",
+                label_selector=t.LabelSelector(),
+            ),
+        ),
+    )
+    errs = validate_profile(bad)
+    assert any("max_skew" in e for e in errs)
+    assert any("whenUnsatisfiable" in e for e in errs)
+    assert any("label_selector" in e for e in errs)
