@@ -145,14 +145,22 @@ def _added_affinity(raw: dict, path: str) -> t.NodeAffinity:
                 for term in sel.get("nodeSelectorTerms", [])
             )
         )
-    preferred = tuple(
-        t.PreferredSchedulingTerm(
-            weight=int(p["weight"]),
-            preference=_selector_term(p["preference"], f"{path}.{pref_key}"),
+    preferred = []
+    for j, p in enumerate(raw.get(pref_key, [])):
+        pbad = set(p) - {"weight", "preference"}
+        if pbad:
+            raise _err(f"{path}.{pref_key}[{j}]", f"unknown keys {sorted(pbad)}")
+        if "preference" not in p:
+            raise _err(f"{path}.{pref_key}[{j}]", "missing preference")
+        preferred.append(
+            t.PreferredSchedulingTerm(
+                weight=int(p.get("weight", 1)),
+                preference=_selector_term(
+                    p["preference"], f"{path}.{pref_key}[{j}]"
+                ),
+            )
         )
-        for p in raw.get(pref_key, [])
-    )
-    return t.NodeAffinity(required=required, preferred=preferred)
+    return t.NodeAffinity(required=required, preferred=tuple(preferred))
 
 
 def _spread_constraint(raw: dict, path: str) -> t.TopologySpreadConstraint:
@@ -193,10 +201,30 @@ def _apply_plugin_config(kwargs: dict, entries: list, path: str) -> None:
                     raise _err(p, f"scoringStrategy: unknown keys {sorted(sbad)}")
                 shape = ((0, 0), (100, 10))
                 if "requestedToCapacityRatio" in ss:
-                    shape = tuple(
-                        (int(pt["utilization"]), int(pt["score"]))
-                        for pt in ss["requestedToCapacityRatio"].get("shape", [])
-                    ) or shape
+                    if ss.get("type") != "RequestedToCapacityRatio":
+                        # validation_pluginargs.go: the shape is only legal
+                        # with the matching strategy type — silently unused
+                        # config is an error, not a default.
+                        raise _err(
+                            p,
+                            "requestedToCapacityRatio requires "
+                            "type=RequestedToCapacityRatio",
+                        )
+                    rtcr = ss["requestedToCapacityRatio"]
+                    rbad = set(rtcr) - {"shape"}
+                    if rbad:
+                        raise _err(
+                            p, f"requestedToCapacityRatio: unknown keys {sorted(rbad)}"
+                        )
+                    pts = []
+                    for pt in rtcr.get("shape", []):
+                        ptbad = set(pt) - {"utilization", "score"}
+                        if ptbad:
+                            raise _err(
+                                p, f"shape point: unknown keys {sorted(ptbad)}"
+                            )
+                        pts.append((int(pt["utilization"]), int(pt["score"])))
+                    shape = tuple(pts) or shape
                 kwargs["scoring_strategy"] = ScoringStrategy(
                     type=ss.get("type", "LeastAllocated"),
                     resources=tuple(
@@ -270,12 +298,19 @@ def convert(raw: dict) -> dict:
             raise ValueError("; ".join(errs))
     top_pct = raw.get("percentageOfNodesToScore")
     profiles: list[Profile] = []
+    seen_names: set[str] = set()
     for pi, rp in enumerate(raw.get("profiles", [])):
         path = f"profiles[{pi}]"
         bad = set(rp) - _PROFILE_KEYS
         if bad:
             raise _err(path, f"unknown keys {sorted(bad)}")
         kwargs: dict = {}
+        name = rp.get("schedulerName", Profile().name)
+        if name in seen_names:
+            # validation.go ValidateKubeSchedulerConfiguration: duplicate
+            # schedulerNames are rejected (the profile map is name-keyed).
+            raise _err(path, f"duplicate schedulerName {name!r}")
+        seen_names.add(name)
         if "schedulerName" in rp:
             kwargs["name"] = rp["schedulerName"]
         pct = rp.get("percentageOfNodesToScore", top_pct)

@@ -328,8 +328,16 @@ def hard_filter_fn(state, pf, ctx: PassContext):
 def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
     # No constraints: both PreFilter and PreScore return Skip
     # (filtering.go:152, scoring.go:140).  Profile defaultConstraints make
-    # the op active for any labelled pod of the profile.
-    return bool(_effective_constraints(pod, fctx))
+    # the op active for any labelled pod of the profile (cheap check only —
+    # the derived constraints are built in featurize, not here).
+    if pod.spec.topology_spread_constraints:
+        return True
+    prof = fctx.profile
+    return bool(
+        prof is not None
+        and prof.pts_default_constraints
+        and pod.metadata.labels
+    )
 
 
 register(

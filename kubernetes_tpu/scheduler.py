@@ -540,6 +540,14 @@ class TPUScheduler:
                 "host/device mirror divergence — dump_state() for details"
             )
 
+    def rebuild_device_state(self) -> None:
+        """Recovery: drop the device mirror and rebuild everything from host
+        truth on the next pass (the builder's _dirty_all path).  The restart
+        analog of the reference's informer resync (app/server.go:249–271) for
+        a live process whose device state is suspect — host staging is the
+        authoritative cache, the device tensors are a pure mirror of it."""
+        self.builder.invalidate_device()
+
     def expire_waiting_gangs(self, timeout_s: float | None = None) -> int:
         """WaitOnPermit timeout: forget and re-park members of gangs whose
         missing peers never arrived (framework.go:1503 WaitOnPermit;

@@ -1,0 +1,144 @@
+"""Host-side resync: survive a sidecar crash/restart.
+
+The reference scheduler is stateless across restarts — etcd is the truth
+and a restarted scheduler rebuilds cache+queue from informer LIST+WATCH
+(app/server.go:249–271 informers Start + WaitForCacheSync).  In the
+two-tier split, the HOST holds that informer truth and the sidecar's
+device state is a pure cache of it — so when the sidecar dies, the host
+reconnects and replays its object store, and the fresh sidecar rebuilds
+exactly like the reference rebuilds from the apiserver.
+
+``ResyncingClient`` is that host piece: a SidecarClient wrapper that
+mirrors every object it ships (the informer-store analog), detects a dead
+connection on any call, reconnects with backoff, replays the full store
+in dependency order, and then re-issues the failed call.  Bound pods are
+replayed WITH their node (the host learned the binding from the schedule
+response — in the reference the binding lives in etcd), so a restarted
+sidecar's resource accounting matches the pre-crash cluster."""
+
+from __future__ import annotations
+
+import time
+
+from ..api import serialize
+from . import sidecar_pb2 as pb
+from .server import SidecarClient
+
+# Replay order: everything a pod references must exist before the pod.
+_REPLAY_ORDER = (
+    "Node", "StorageClass", "PersistentVolume", "PersistentVolumeClaim",
+    "CSINode", "PodGroup", "PodDisruptionBudget", "ResourceSlice",
+    "ResourceClaim", "Pod",
+)
+
+
+def _key(kind: str, obj) -> str:
+    # remove("Node", uid) takes the node NAME; pods key by uid.
+    return obj.uid if kind == "Pod" else obj.name
+
+
+class ResyncingClient:
+    def __init__(
+        self,
+        path: str,
+        max_reconnect_s: float = 10.0,
+        retry_interval_s: float = 0.05,
+    ):
+        self.path = path
+        self.max_reconnect_s = max_reconnect_s
+        self.retry_interval_s = retry_interval_s
+        self.resyncs = 0  # observable: how many times the store was replayed
+        self._store: dict[str, dict[str, object]] = {k: {} for k in _REPLAY_ORDER}
+        self._ns_labels: dict[str, dict] = {}
+        self._client = SidecarClient(path)
+
+    # -- informer-store bookkeeping ---------------------------------------
+
+    def _record(self, kind: str, obj) -> None:
+        self._store.setdefault(kind, {})[_key(kind, obj)] = obj
+
+    # -- reconnect + replay ------------------------------------------------
+
+    def _reconnect(self) -> None:
+        deadline = time.monotonic() + self.max_reconnect_s
+        while True:
+            try:
+                self._client = SidecarClient(self.path)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"sidecar at {self.path} did not come back within "
+                        f"{self.max_reconnect_s}s"
+                    )
+                time.sleep(self.retry_interval_s)
+        self._replay()
+        self.resyncs += 1
+
+    def _replay(self) -> None:
+        for ns, labels in self._ns_labels.items():
+            self._client.set_namespace_labels(ns, labels)
+        for kind in _REPLAY_ORDER:
+            for obj in self._store.get(kind, {}).values():
+                self._client.add(kind, obj)
+
+    def _with_resync(self, fn):
+        """Run ``fn`` against the live client; on a dead connection,
+        reconnect+replay once and re-issue."""
+        try:
+            return fn()
+        except (ConnectionError, BrokenPipeError, OSError):
+            self._reconnect()
+            return fn()
+
+    # -- client surface ----------------------------------------------------
+
+    def set_namespace_labels(self, namespace: str, labels: dict) -> None:
+        self._ns_labels[namespace] = dict(labels)
+        self._with_resync(
+            lambda: self._client.set_namespace_labels(namespace, labels)
+        )
+
+    def add(self, kind: str, obj) -> None:
+        self._record(kind, obj)
+        self._with_resync(lambda: self._client.add(kind, obj))
+
+    def remove(self, kind: str, uid: str) -> None:
+        self._store.get(kind, {}).pop(uid, None)
+        self._with_resync(lambda: self._client.remove(kind, uid))
+
+    def dump(self) -> dict:
+        # NB: lambda re-reads self._client so the retry after a reconnect
+        # targets the NEW connection, not the dead one's bound method.
+        return self._with_resync(lambda: self._client.dump())
+
+    def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
+        # Pending pods enter the store UNBOUND first: if the sidecar dies
+        # mid-call the replay re-submits them (at-least-once; the engine's
+        # upsert path makes re-delivery idempotent).
+        pods = list(pods)
+        for p in pods:
+            self._record("Pod", p)
+        results = self._with_resync(
+            lambda: self._client.schedule(pods, drain=drain)
+        )
+        # Record bindings: the reference host persists them via the
+        # apiserver; here the store is that persistence, so a later replay
+        # re-adds bound pods as cache adds with their node set.
+        by_uid = {p.uid: p for p in pods}
+        for r in results:
+            p = by_uid.get(r.pod_uid)
+            if p is None:
+                rec = self._store["Pod"].get(r.pod_uid)
+                p = rec if rec is not None else None
+            if p is None:
+                continue
+            if r.node_name:
+                p.spec.node_name = r.node_name
+            for vu in r.victim_uids:
+                # Preemption victims were deleted sidecar-side; mirror that.
+                self._store["Pod"].pop(vu, None)
+        return results
+
+    def close(self) -> None:
+        self._client.close()

@@ -71,12 +71,21 @@ class SidecarServer:
         # host side).
         lock = threading.Lock()
 
+        conns: set[socket.socket] = set()
+        self._conns = conns
+
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                try:
+                    self._serve_frames()
+                finally:
+                    conns.discard(self.request)
+
+            def _serve_frames(self) -> None:
                 while True:
                     try:
                         env = read_frame(self.request)
-                    except (ConnectionError, ValueError):
+                    except (ValueError, OSError):
                         return
                     if env is None:
                         return
@@ -86,10 +95,21 @@ class SidecarServer:
                             _dispatch(sched, env, out)
                     except Exception as exc:  # surface, don't kill the server
                         out.response.error = f"{type(exc).__name__}: {exc}"
-                    write_frame(self.request, out)
+                    try:
+                        write_frame(self.request, out)
+                    except OSError:  # peer (or close()) severed mid-dispatch
+                        return
 
         class Server(socketserver.ThreadingUnixStreamServer):
             daemon_threads = True
+
+            def process_request(self, request, client_address):
+                # Register in the ACCEPT thread, before the handler thread
+                # spawns: close() then cannot miss a just-accepted socket
+                # (shutdown() stops this loop first, so registration
+                # happens-before the close() snapshot).
+                conns.add(request)
+                super().process_request(request, client_address)
 
         if os.path.exists(path):
             os.unlink(path)
@@ -107,6 +127,19 @@ class SidecarServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Sever live connections too: handler threads otherwise keep
+        # serving established sockets after shutdown(), so a "stopped"
+        # server would silently answer from stale state (and a crash —
+        # the case the host's resync exists for — kills them anyway).
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         if os.path.exists(self.path):
             os.unlink(self.path)
 

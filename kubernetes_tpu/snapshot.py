@@ -670,6 +670,13 @@ class SnapshotBuilder:
         """Adopt the post-scan device tensors as the current device mirror."""
         self._device = state
 
+    def invalidate_device(self) -> None:
+        """Recovery: drop the device mirror (and the featurization cache)
+        so the next state() rebuilds everything from host staging — host
+        truth is authoritative, the device tensors are a pure cache."""
+        self._dirty_all = True
+        self.feat_cache = None
+
     def host_mirror_equal(self, atol: int = 0) -> bool:
         """Consistency check host staging vs device (the analog of the cache
         comparer in backend/cache/debugger): True iff mirrors agree."""

@@ -180,6 +180,15 @@ def test_convert_rejects_semantically_invalid_profile():
         )
 
 
+def test_duplicate_scheduler_names_rejected():
+    with pytest.raises(ValueError, match="duplicate schedulerName"):
+        configv1.convert(v1(profiles=[{}, {}]))  # both default-named
+    with pytest.raises(ValueError, match="duplicate schedulerName"):
+        configv1.convert(
+            v1(profiles=[{"schedulerName": "x"}, {"schedulerName": "x"}])
+        )
+
+
 def test_strict_unknown_keys():
     with pytest.raises(ValueError, match="unknown config keys"):
         configv1.convert(v1(bogus=1))
