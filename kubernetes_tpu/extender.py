@@ -93,6 +93,7 @@ class HTTPExtender:
     filter_verb: str = ""
     prioritize_verb: str = ""
     bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     ignorable: bool = False
     timeout_s: float = 5.0
@@ -148,6 +149,32 @@ class HTTPExtender:
             {"PodName": pod.metadata.name, "PodNamespace": pod.namespace, "Node": node},
         )
         return not (out or {}).get("Error")
+
+    @property
+    def supports_preemption(self) -> bool:
+        # extender.go SupportsPreemption: declared by a preempt verb.
+        return bool(self.preempt_verb)
+
+    def process_preemption(
+        self, pod: t.Pod, node_to_victims: dict[str, list[t.Pod]]
+    ) -> dict[str, list[str]]:
+        """ProcessPreemption (extender.go, wire types extender/v1
+        ExtenderPreemptionArgs/Result): POST the candidate victim map as
+        NodeNameToMetaVictims ({node: {Pods: [{UID}]}}), get back the
+        subset of nodes (with victim uids) the extender accepts."""
+        payload = {
+            "Pod": ExtenderArgs(pod, []).to_json()["Pod"],
+            "NodeNameToMetaVictims": {
+                node: {"Pods": [{"UID": v.uid} for v in victims]}
+                for node, victims in node_to_victims.items()
+            },
+        }
+        out = self._post(self.preempt_verb, payload)
+        result = out.get("NodeNameToMetaVictims") or {}
+        return {
+            node: [p.get("UID", "") for p in (meta or {}).get("Pods", [])]
+            for node, meta in result.items()
+        }
 
 
 def run_extender_chain(

@@ -152,9 +152,12 @@ def _added_affinity(raw: dict, path: str) -> t.NodeAffinity:
             raise _err(f"{path}.{pref_key}[{j}]", f"unknown keys {sorted(pbad)}")
         if "preference" not in p:
             raise _err(f"{path}.{pref_key}[{j}]", "missing preference")
+        if "weight" not in p:
+            # validation: weight is required (1..100), not defaulted.
+            raise _err(f"{path}.{pref_key}[{j}]", "missing weight")
         preferred.append(
             t.PreferredSchedulingTerm(
-                weight=int(p.get("weight", 1)),
+                weight=int(p["weight"]),
                 preference=_selector_term(
                     p["preference"], f"{path}.{pref_key}[{j}]"
                 ),

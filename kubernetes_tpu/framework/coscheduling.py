@@ -1,0 +1,70 @@
+"""Coscheduling as a PermitPlugin: gang quorum behind the generic Permit
+extension point.
+
+The out-of-tree coscheduling plugin's Permit gate (its PodGroupManager
+counts assumed members and holds the gang in the waiting-pods map until
+minMember forms) expressed as one batch-level judgement: per gang with
+members placed this batch,
+
+  allow  — bound + placed + already-waiting ≥ minMember;
+  wait   — quorum unmet but enough members still queued: placed members
+           stay assumed in the waiting room (WaitOnPermit,
+           runtime/framework.go:1503) so a gang split across batch
+           boundaries converges instead of thrashing;
+  reject — quorum unreachable: members (and waiters) roll back to the
+           gang pool.
+
+Gang STATE stays on the scheduler (pod_groups, gang_bound — they are
+also informer-fed objects); this plugin owns the POLICY."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from .hostplugins import BatchPermit
+
+
+class CoschedulingPermit:
+    name = "Coscheduling"
+
+    def group_of(self, pod: t.Pod):
+        return pod.spec.pod_group or None
+
+    def judge_batch(self, placed, sched) -> BatchPermit:
+        out = BatchPermit()
+        if not (sched.pod_groups or sched.permit_waiting):
+            return out
+        gang_placed: dict[str, int] = {}
+        for qp, _node in placed:
+            g = qp.pod.spec.pod_group
+            if g:
+                gang_placed[g] = gang_placed.get(g, 0) + 1
+        for g, count in gang_placed.items():
+            pg = sched.pod_groups.get(g)
+            if pg is None:
+                continue  # unregistered group: no admission constraint
+            waiting = len(sched.permit_waiting.get(g, ()))
+            total = sched.gang_bound.get(g, 0) + count + waiting
+            if total >= pg.min_member:
+                out.admit.add(g)
+            elif total + sched.queue.gang_pending(g) >= pg.min_member:
+                out.wait.add(g)
+            else:
+                out.reject.add(g)
+        return out
+
+    def on_rollback(self, qp, sched) -> None:
+        # Back to the gang pool (not backoff): the gang failed with exactly
+        # these members, so re-admission waits for a cluster event or an
+        # explicit readmit.
+        sched.queue.requeue_gang_member(qp)
+
+    def timeout_s(self, sched) -> float:
+        return sched.permit_timeout_s  # PermitWaitingTimeSeconds
+
+    def post_batch(self, wait_groups, sched) -> None:
+        # Members that just entered the waiting room grew their gang's
+        # quorum credit (queue.gang_credit counts waiters) — a peer parked
+        # in the gang pool may now make the gang admissible, and no cluster
+        # event fires in a quiet cluster.
+        for g in wait_groups:
+            sched.queue._try_admit_gang(g)

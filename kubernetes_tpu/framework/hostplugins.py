@@ -1,0 +1,127 @@
+"""Host-side plugin surface: the Reserve and Permit extension points.
+
+The device ops (ops/common.OpDef) are the vectorized analog of Filter/
+Score; these host plugins are the analog of the STATEFUL extension points
+the reference framework runs around them (runtime/framework.go:1359
+RunReservePlugins, :1443 RunPermitPlugins + WaitOnPermit :1503):
+
+* ``ReservePlugin`` — IO-bound per-pod reservation between selection and
+  bind (volume binding, DRA claim allocation).  Reserve returns an opaque
+  undo token, or None for failure; Unreserve reverts it.  Plugins run in
+  registration order; on a failure the already-reserved plugins unwind in
+  reverse (runtime.RunReservePluginsReserve's error path).
+
+* ``PermitPlugin`` — batch-level admission.  The reference runs Permit
+  per pod and lets a plugin hold pods in the waiting-pods map until a
+  condition forms (the out-of-tree coscheduling plugin's quorum gate);
+  the batch engine's analog judges each batch's placed pods at once and
+  returns group-level decisions.  The scheduler owns the generic
+  machinery (waiting room, rollback bookkeeping, timeouts); plugins own
+  the policy.
+
+The scheduler loop special-cases NOTHING about gangs: coscheduling is
+one PermitPlugin (framework/coscheduling.py), and another co-scheduling-
+like feature is a new plugin, not a loop rewrite."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, runtime_checkable
+
+from ..api import types as t
+
+
+@dataclass
+class BatchPermit:
+    """One Permit plugin's judgement over a batch.
+
+    Groups absent from all three sets are implicitly allowed.  ``reject``
+    rolls back every member (placed this batch AND already waiting);
+    ``wait`` parks the batch's placed members in the waiting room;
+    ``admit`` releases a waiting group into this batch's finalize list."""
+
+    reject: set[str] = field(default_factory=set)
+    wait: set[str] = field(default_factory=set)
+    admit: set[str] = field(default_factory=set)
+
+
+@runtime_checkable
+class PermitPlugin(Protocol):
+    name: str
+
+    def group_of(self, pod: t.Pod) -> Optional[str]:
+        """The waiting-group this pod belongs to (None: plugin indifferent —
+        the pod is allowed as far as this plugin is concerned)."""
+
+    def judge_batch(self, placed, sched) -> BatchPermit:
+        """Judge a batch: ``placed`` is [(qp, node_name)] for every pod the
+        device pass seated (already assumed in the cache)."""
+
+    def on_rollback(self, qp, sched) -> None:
+        """Requeue a rolled-back member (the pod is already forgotten from
+        the cache).  Owns the WHERE: pool, backoff, unschedulable."""
+
+    def timeout_s(self, sched) -> float:
+        """Waiting-room expiry for groups this plugin parked."""
+
+    def post_batch(self, wait_groups: set[str], sched) -> None:
+        """After the batch settles, with the plugin's groups that are now
+        waiting — e.g. re-attempt queue admission now that waiter credit
+        grew (no cluster event fires in a quiet cluster)."""
+
+
+@runtime_checkable
+class ReservePlugin(Protocol):
+    name: str
+
+    def relevant(self, pod: t.Pod, sched) -> bool:
+        """Does this pod need this plugin's Reserve at all?  (Cheap check —
+        irrelevant plugins add zero per-pod cost.)"""
+
+    def reserve(self, pod: t.Pod, node_name: str, sched):
+        """Reserve host-side state for the pod on its chosen node.  Returns
+        an opaque undo token (truthy or empty) on success, None on failure
+        (the pod is forgotten and retried)."""
+
+    def unreserve(self, undo, sched) -> None:
+        """Revert a successful reserve (runtime.RunReservePluginsUnreserve)."""
+
+
+class DRAReserve:
+    """DynamicResources' Reserve: allocate + reserve the pod's claims on the
+    chosen node (plugins/dynamicresources/ Reserve; the assume-cache
+    write).  Gated by the DynamicResourceAllocation feature."""
+
+    name = "DynamicResources"
+
+    def relevant(self, pod: t.Pod, sched) -> bool:
+        # Gate off ⇒ the plugin exists at no extension point.
+        return sched._dra_enabled and bool(pod.spec.resource_claims)
+
+    def reserve(self, pod: t.Pod, node_name: str, sched):
+        return sched.builder.dra.allocate_pod_claims(pod, node_name)
+
+    def unreserve(self, undo, sched) -> None:
+        if undo:
+            sched.builder.dra.unallocate(undo)
+
+
+class VolumeReserve:
+    """VolumeBinding's Reserve/PreBind: bind delayed (WFFC) claims on the
+    chosen node with a race re-check (volume_binding.go:521)."""
+
+    name = "VolumeBinding"
+
+    def relevant(self, pod: t.Pod, sched) -> bool:
+        return any(v.pvc for v in pod.spec.volumes)
+
+    def reserve(self, pod: t.Pod, node_name: str, sched):
+        node = sched.cache.nodes[node_name].node
+        return sched.builder.volumes.bind_pod_volumes(pod, node)
+
+    def unreserve(self, undo, sched) -> None:
+        if undo:
+            sched.builder.volumes.unbind_pod_volumes(undo)
+
+
+DEFAULT_RESERVE_PLUGINS = (DRAReserve(), VolumeReserve())

@@ -491,9 +491,17 @@ class PreemptionEvaluator:
         active: frozenset[str] | None = None,
         inv: dict | None = None,
         profile=None,
+        candidate_filter=None,
     ) -> list[PreemptionResult | None]:
         """Run preemption for the failed pods of one scheduling batch.
-        ``batch_rows`` are each pod's already-built feature dict rows."""
+        ``batch_rows`` are each pod's already-built feature dict rows.
+
+        ``candidate_filter(pod, node_name, victims) -> bool`` vetoes a
+        chosen candidate BEFORE its victims are deleted — the extender
+        ProcessPreemption hook (preemption.go:249 callExtenders).  The
+        reference consults extenders over the full candidate list before
+        selection; the batched engine selects first and filters the one
+        chosen candidate (divergence documented in extender.py)."""
         sched = self.sched
         profile = profile or sched.profile
         cache, builder = sched.cache, sched.builder
@@ -705,6 +713,11 @@ class PreemptionEvaluator:
                 and vics[j].spec.priority < pod.spec.priority
                 and vics[j].uid not in consumed
             ]
+            if candidate_filter is not None and not candidate_filter(
+                pod, node_name, victims
+            ):
+                results.append(None)
+                continue
             # prepareCandidate: delete victims, nominate the node.  The host
             # deltas mark rows dirty; the next state() flush re-syncs the
             # device (the in-scan release was resources-only).

@@ -166,6 +166,17 @@ class TPUScheduler:
         self.permit_waiting: dict[str, list] = {}
         self.permit_wait_since: dict[str, float] = {}
         self.permit_timeout_s = 60.0  # coscheduling PermitWaitingTimeSeconds
+        # Host-side extension points (framework/hostplugins.py): the loop
+        # runs whatever is registered here and special-cases nothing —
+        # coscheduling is one PermitPlugin, volume/DRA reservation are
+        # ReservePlugins (runtime/framework.go:1359,1443).
+        from .framework.coscheduling import CoschedulingPermit
+        from .framework.hostplugins import DEFAULT_RESERVE_PLUGINS
+
+        self.permit_plugins = [CoschedulingPermit()]
+        self.reserve_plugins = list(DEFAULT_RESERVE_PLUGINS)
+        # Waiting-room group → owning PermitPlugin (for timeout/rollback).
+        self.permit_wait_owner: dict[str, object] = {}
         # Assumed-pod TTL (cache.go:42 ticks cleanupAssumedPods at 1s; the
         # 30s expiry mirrors durationToExpireAssumedPod's safety-net role).
         self.assume_ttl_s = 30.0
@@ -402,6 +413,7 @@ class TPUScheduler:
                 else:
                     self.permit_waiting.pop(g)
                     self.permit_wait_since.pop(g, None)
+                    self.permit_wait_owner.pop(g, None)
         return dropped
 
     def delete_pod(self, uid: str, notify: bool = True) -> None:
@@ -548,21 +560,53 @@ class TPUScheduler:
         authoritative cache, the device tensors are a pure mirror of it."""
         self.builder.invalidate_device()
 
+    def _record_preemption(self, qp: QueuedPodInfo, outcome, res, delta) -> None:
+        """Shared PostFilter bookkeeping for a successful preemption
+        (prepareCandidate, preemption.go:342): outcome fields, the
+        nominator's claim on the freed node, and the immediate retry (the
+        reference waits on the victims' graceful deletion; in-process
+        deletion is synchronous)."""
+        self.metrics.preemptions += 1
+        outcome.nominated_node = res.node_name
+        outcome.victims = len(res.victims)
+        outcome.victim_uids = tuple(v.uid for v in res.victims)
+        outcome.victim_names = tuple(
+            f"{v.namespace}/{v.name}" for v in res.victims
+        )
+        self.nominator[qp.pod.uid] = (
+            res.node_name, delta, qp.pod.spec.priority
+        )
+        self.queue.add(qp.pod)
+
+    def _permit_group(self, pod: t.Pod):
+        """The (group, owning PermitPlugin) a pod waits under, or
+        (None, None) when no registered plugin claims it."""
+        for pl in self.permit_plugins:
+            g = pl.group_of(pod)
+            if g is not None:
+                return g, pl
+        return None, None
+
     def expire_waiting_gangs(self, timeout_s: float | None = None) -> int:
-        """WaitOnPermit timeout: forget and re-park members of gangs whose
+        """WaitOnPermit timeout: forget and re-park members of groups whose
         missing peers never arrived (framework.go:1503 WaitOnPermit;
-        coscheduling's PermitWaitingTimeSeconds)."""
-        timeout = self.permit_timeout_s if timeout_s is None else timeout_s
+        coscheduling's PermitWaitingTimeSeconds).  Each group expires on
+        its owning plugin's timeout; the plugin owns the requeue."""
         now = time.monotonic()
-        expired = [
-            g for g, since in self.permit_wait_since.items() if now - since > timeout
-        ]
+        default = self.permit_plugins[0] if self.permit_plugins else None
+        expired = []
+        for g, since in self.permit_wait_since.items():
+            pl = self.permit_wait_owner.get(g, default)
+            timeout = pl.timeout_s(self) if timeout_s is None else timeout_s
+            if now - since > timeout:
+                expired.append((g, pl))
         n = 0
-        for g in expired:
+        for g, pl in expired:
             self.permit_wait_since.pop(g, None)
+            self.permit_wait_owner.pop(g, None)
             for qp, _node, _s, _f in self.permit_waiting.pop(g, ()):
                 self.cache.forget_pod(qp.pod.uid)
-                self.queue.requeue_gang_member(qp)
+                pl.on_rollback(qp, self)
                 n += 1
         return n
 
@@ -580,9 +624,11 @@ class TPUScheduler:
     def _schedule_one_extender(self, qp: QueuedPodInfo) -> ScheduleOutcome:
         """One reference scheduling cycle with an extender chain: eval-only
         device pass → host extender filter/prioritize → host selectHost →
-        assume/bind (findNodesThatPassExtenders, schedule_one.go:704;
-        prioritizeNodes, :799).  Gang/preemption semantics are not combined
-        with extenders in this round."""
+        assume → Reserve plugins → bind (findNodesThatPassExtenders,
+        schedule_one.go:704; prioritizeNodes, :799).  Unschedulable pods
+        run PostFilter preemption with extender ProcessPreemption veto
+        (schedule_one.go:749); gang Permit semantics remain batch-path
+        only (an extender profile schedules pod-at-a-time)."""
         from .engine.pass_ import build_eval_pass
         from .extender import run_extender_chain
 
@@ -606,7 +652,16 @@ class TPUScheduler:
             )
             self._eval_passes[key] = run
         pf = {k: np.asarray(v)[0] for k, v in batch.items() if k != "valid"}
-        pf["nominated_row"] = np.int32(-1)
+        # Resolve the pod's own nomination to a row (like _inject_nomrows):
+        # without it, a retrying preemptor's own nominated claim in the fit
+        # overlay makes its freed node look full to itself.
+        nomrow = -1
+        nn = qp.pod.status.nominated_node_name
+        if nn and self.nominator:
+            rec_n = self.cache.nodes.get(nn)
+            if rec_n is not None:
+                nomrow = rec_n.row
+        pf["nominated_row"] = np.int32(nomrow)
         feasible, total = jax.device_get(run(state, pf, inv))
         m.featurize_time_s += t1 - t0
         m.device_time_s += time.perf_counter() - t1
@@ -629,38 +684,84 @@ class TPUScheduler:
             # Extender rejections requeue on any event (schedule_one.go:528).
             plugins = {"Extender"} if names else set(profile.filters)
             qp.delta = deltas[0]
-            self.queue.add_unschedulable(qp, plugins)
-            return ScheduleOutcome(
+            outcome = ScheduleOutcome(
                 qp.pod, None, 0, len(names),
                 diagnosis=Diagnosis(unschedulable_plugins=plugins),
             )
+            # PostFilter (schedule_one.go:749): extender profiles run
+            # preemption too; extenders with a preempt verb veto the chosen
+            # candidate (ProcessPreemption, preemption.go:249).
+            if self.preemption is not None:
+                rows = {
+                    k: [np.asarray(v)[0]] for k, v in batch.items() if k != "valid"
+                }
+                preempt_exts = [
+                    ex
+                    for ex in self.extenders
+                    if getattr(ex, "supports_preemption", False)
+                    and ex.is_interested(qp.pod)
+                ]
+
+                def _ext_ok(pod, node_name, victims) -> bool:
+                    want = {v.uid for v in victims}
+                    for ex in preempt_exts:
+                        try:
+                            kept = ex.process_preemption(
+                                pod, {node_name: victims}
+                            )
+                        except Exception:
+                            if ex.ignorable:
+                                continue
+                            return False
+                        # The engine picked a MINIMAL victim set: the node
+                        # survives only if the extender keeps all of it.
+                        if node_name not in kept or set(
+                            kept[node_name]
+                        ) != want:
+                            return False
+                    return True
+
+                res = self.preemption.preempt_batch(
+                    [qp.pod], rows, active, inv, profile=profile,
+                    candidate_filter=_ext_ok if preempt_exts else None,
+                )[0]
+                # A zero-victim "candidate" here means the node was already
+                # engine-feasible and only the EXTENDER rejected it — a
+                # retry would hot-loop against the same rejection, so only
+                # an eviction counts as progress on this path.
+                if res is not None and res.victims:
+                    self._record_preemption(qp, outcome, res, deltas[0])
+                    if res.node_name in self.cache.nodes:
+                        freed = {self.cache.nodes[res.node_name].row}
+                        self.queue.on_event(
+                            Event.POD_DELETE, self._free_ctx(freed)
+                        )
+                    return outcome
+            self.queue.add_unschedulable(qp, plugins)
+            return outcome
         best = max(enumerate(nodes), key=lambda p: (combined[p[1]], -p[0]))[1]
         self.cache.assume_pod(qp.pod, best, device_already=False, delta=deltas[0])
 
-        def _fail_bind(undo_vol, undo_dra):
-            if undo_vol:
-                self.builder.volumes.unbind_pod_volumes(undo_vol)
-            if undo_dra:
-                self.builder.dra.unallocate(undo_dra)
+        def _fail_bind(undos):
+            for rp2, u2 in reversed(undos):
+                rp2.unreserve(u2, self)
             self.cache.forget_pod(qp.pod.uid)
             self.queue.add_backoff(qp)
             m.unschedulable += 1
             return ScheduleOutcome(qp.pod, None, 0, len(nodes))
 
-        undo_dra: list | None = []
-        if self._dra_enabled and qp.pod.spec.resource_claims:
-            undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, best)
-            if undo_dra is None:
-                return _fail_bind([], [])
-        undo_vol: list | None = []
-        if any(v.pvc for v in qp.pod.spec.volumes):
-            node = self.cache.nodes[best].node
-            undo_vol = self.builder.volumes.bind_pod_volumes(qp.pod, node)
-            if undo_vol is None:
-                return _fail_bind([], undo_dra)
+        # Reserve through the same plugin chain the batch path runs.
+        undos: list = []
+        for rp in self.reserve_plugins:
+            if not rp.relevant(qp.pod, self):
+                continue
+            u = rp.reserve(qp.pod, best, self)
+            if u is None:
+                return _fail_bind(undos)
+            undos.append((rp, u))
         binder = next((ex for ex in self.extenders if getattr(ex, "bind_verb", "")), None)
         if binder is not None and not binder.bind(qp.pod, best):
-            return _fail_bind(undo_vol, undo_dra)
+            return _fail_bind(undos)
         qp.pod.spec.node_name = best
         self.cache.finish_binding(qp.pod.uid)
         self.queue.done(qp.pod.uid)
@@ -1024,122 +1125,123 @@ class TPUScheduler:
             else:
                 failed.append((i, qp, None))
 
-        # Phase 2 — Permit (the coscheduling plugin's Permit gate; reference
-        # extension-point order: Permit precedes PreBind, so a cancelled
-        # gang never durably binds volumes).  Per gang placed this batch
-        # (RunPermitPlugins, runtime/framework.go:1443):
-        #   allow  — bound + placed + already-waiting ≥ minMember;
-        #   wait   — quorum unmet but enough members still queued: members
-        #            stay assumed in the waiting room (WaitOnPermit,
-        #            framework.go:1503) so a gang split across batch
-        #            boundaries converges instead of thrashing;
-        #   reject — quorum unreachable: members (and waiters) roll back to
-        #            the gang pool.
+        # Phase 2 — Permit (RunPermitPlugins, runtime/framework.go:1443;
+        # reference extension-point order: Permit precedes PreBind, so a
+        # cancelled group never durably binds volumes).  Each registered
+        # PermitPlugin judges the batch's placed pods and returns
+        # group-level allow/wait/reject; the loop owns only the generic
+        # mechanics (waiting room, rollback, timeouts).
         rollback: set[str] = set()
         wait: set[str] = set()
         admitted: set[str] = set()
-        if self.pod_groups or self.permit_waiting:
-            gang_placed: dict[str, int] = {}
-            for _i, qp, _n in placed:
-                g = qp.pod.spec.pod_group
-                if g:
-                    gang_placed[g] = gang_placed.get(g, 0) + 1
-            for g, count in gang_placed.items():
-                pg = self.pod_groups.get(g)
-                if pg is None:
-                    continue
-                waiting = len(self.permit_waiting.get(g, ()))
-                total = self.gang_bound.get(g, 0) + count + waiting
-                if total >= pg.min_member:
-                    admitted.add(g)
-                elif total + self.queue.gang_pending(g) >= pg.min_member:
-                    wait.add(g)
-                else:
+        owner: dict[str, object] = {}
+        if placed or self.permit_waiting:
+            placed_view = [(qp, node) for _i, qp, node in placed]
+            decisions = [
+                (plugin, plugin.judge_batch(placed_view, self))
+                for plugin in self.permit_plugins
+            ]
+            # Most-restrictive-wins across plugins (RunPermitPlugins stops
+            # at the first reject; any wait holds the pod): reject > wait >
+            # admit, with the group owned by its most restrictive decider.
+            for plugin, dec in decisions:
+                for g in dec.reject:
                     rollback.add(g)
+                    owner[g] = plugin
+            for plugin, dec in decisions:
+                for g in dec.wait - rollback:
+                    wait.add(g)
+                    owner.setdefault(g, plugin)
+            for plugin, dec in decisions:
+                for g in dec.admit - rollback - wait:
+                    admitted.add(g)
+                    owner.setdefault(g, plugin)
 
-        # Waiters of rejected gangs roll back with their gang; waiters of
-        # admitted gangs join this batch's finalize list.
+        # Waiters of rejected groups roll back with their group; waiters of
+        # admitted groups join this batch's finalize list.
         entries: list[tuple[QueuedPodInfo, str, int, int]] = [
             (qp, node, int(scores[i]), int(feas[i])) for i, qp, node in placed
         ]
         for g in rollback:
             self.permit_wait_since.pop(g, None)
+            pl = owner.get(g) or self.permit_wait_owner.get(g)
+            self.permit_wait_owner.pop(g, None)
             for qp, _node, _s, feasn in self.permit_waiting.pop(g, ()):
                 self.cache.forget_pod(qp.pod.uid)
                 outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
-                self.queue.requeue_gang_member(qp)
+                pl.on_rollback(qp, self)
         for g in admitted:
             self.permit_wait_since.pop(g, None)
+            self.permit_wait_owner.pop(g, None)
             entries.extend(self.permit_waiting.pop(g, ()))
 
-        # Phase 3 — PreBind + bind (VolumeBinding PreBind,
-        # volume_binding.go:521): bind delayed claims on the chosen node.
-        # A pod that lost a same-batch PV race is forgotten and retried —
-        # the assume/forget protocol (cache.go:404 ForgetPod).  If the loser
-        # is a gang member, the whole gang rolls back with it — including
-        # reverting peers' volume binds — so a gang never lands partially
+        # Phase 3 — Reserve + PreBind + bind: each registered ReservePlugin
+        # reserves host-side state on the chosen node (VolumeBinding PreBind
+        # volume_binding.go:521, DRA claim allocation), unwinding in reverse
+        # on failure (RunReservePluginsUnreserve).  A pod that lost a
+        # same-batch race is forgotten and retried — the assume/forget
+        # protocol (cache.go:404 ForgetPod).  If the loser belongs to a
+        # permit group, the whole group rolls back with it — including
+        # reverting peers' reservations — so a gang never lands partially
         # bound below minMember (ADVICE r1).
-        finalized_by_gang: dict[str, list] = {}
+        finalized_by_group: dict[str, list] = {}
         latency_qps: list[QueuedPodInfo] = []
         race_rollback: set[str] = set()  # transient (PV race): retry on timer
         prebind_s = 0.0
         for qp, node_name, score, feasn in entries:
-            g = qp.pod.spec.pod_group
+            g, gpl = self._permit_group(qp.pod)
             if g in rollback:
                 self.cache.forget_pod(qp.pod.uid)
                 outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
-                # requeue_gang_member (not add_unschedulable): an ex-waiter's
+                # Plugin rollback (not add_unschedulable): an ex-waiter's
                 # queue._info entry was dropped by done() when it entered the
                 # waiting room and must be restored with the original qp.
-                self.queue.requeue_gang_member(qp)
+                gpl.on_rollback(qp, self)
                 continue
             if g in wait:
                 # WaitOnPermit: off-queue, still assumed, until quorum or
-                # expire_waiting_gangs' timeout.
+                # the owning plugin's timeout (expire_waiting_gangs).
                 self.queue.done(qp.pod.uid)
                 self.permit_waiting.setdefault(g, []).append(
                     (qp, node_name, score, feasn)
                 )
                 self.permit_wait_since.setdefault(g, now)
+                self.permit_wait_owner[g] = owner.get(g, gpl)
                 continue
-            undo: list | None = []
-            undo_dra: list | None = []
-            dra_claims = self._dra_enabled and bool(qp.pod.spec.resource_claims)
-            has_prebind = dra_claims or any(
-                v.pvc for v in qp.pod.spec.volumes
-            )
-            t_pb = time.perf_counter() if has_prebind else 0.0
-            if dra_claims:
-                # DRA Reserve/PreBind: allocate + reserve claims on the
-                # chosen node (dynamicresources' assume-cache write).
-                undo_dra = self.builder.dra.allocate_pod_claims(qp.pod, node_name)
-            if undo_dra is not None and any(v.pvc for v in qp.pod.spec.volumes):
-                node = self.cache.nodes[node_name].node
-                undo = self.builder.volumes.bind_pod_volumes(qp.pod, node)
-                if undo is None and undo_dra:
-                    self.builder.dra.unallocate(undo_dra)
-            if has_prebind:
+            undos: list = []  # [(plugin, undo)] in reserve order
+            reserve_failed = False
+            relevant = [
+                rp for rp in self.reserve_plugins if rp.relevant(qp.pod, self)
+            ]
+            t_pb = time.perf_counter() if relevant else 0.0
+            for rp in relevant:
+                u = rp.reserve(qp.pod, node_name, self)
+                if u is None:
+                    for rp2, u2 in reversed(undos):
+                        rp2.unreserve(u2, self)
+                    reserve_failed = True
+                    break
+                undos.append((rp, u))
+            if relevant:
                 prebind_s += time.perf_counter() - t_pb
-            if undo is None or undo_dra is None:
-                # PreBind lost a same-batch race (PV or claim allocation).
+            if reserve_failed:
+                # Reserve lost a same-batch race (PV or claim allocation).
                 self.cache.forget_pod(qp.pod.uid)
                 outcomes.append(ScheduleOutcome(qp.pod, None, 0, feasn))
                 if g:
-                    # The whole gang retries together from the gang pool,
-                    # with peers' binds/allocations reverted.
+                    # The whole group retries together, with peers'
+                    # reservations reverted.
                     rollback.add(g)
                     race_rollback.add(g)
-                    self.queue.requeue_gang_member(qp)
-                    for qp2, out2, undo2, undo2d in finalized_by_gang.pop(g, ()):
-                        if undo2:
-                            self.builder.volumes.unbind_pod_volumes(undo2)
-                        if undo2d:
-                            self.builder.dra.unallocate(undo2d)
+                    gpl.on_rollback(qp, self)
+                    for qp2, out2, undos2 in finalized_by_group.pop(g, ()):
+                        for rp2, u2 in reversed(undos2):
+                            rp2.unreserve(u2, self)
                         self.cache.forget_pod(qp2.pod.uid)
                         qp2.pod.spec.node_name = None
                         self._debit_gang(g)
                         out2.node_name, out2.score = None, 0
-                        self.queue.requeue_gang_member(qp2)
+                        gpl.on_rollback(qp2, self)
                 else:
                     self.queue.add_backoff(qp)
                 continue
@@ -1149,24 +1251,28 @@ class TPUScheduler:
             outcome = ScheduleOutcome(qp.pod, node_name, score, feasn)
             outcomes.append(outcome)
             latency_qps.append(qp)
-            if g:
-                self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
-                finalized_by_gang.setdefault(g, []).append(
-                    (qp, outcome, undo, undo_dra)
+            if qp.pod.spec.pod_group:
+                # Gang STATE bookkeeping (informer-style, like add_pod's
+                # bound-member credit) — stays with the scheduler.
+                self.gang_bound[qp.pod.spec.pod_group] = (
+                    self.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
                 )
-        # A gang rolled back by a transient PV race re-admits behind backoff
+            if g:
+                finalized_by_group.setdefault(g, []).append(
+                    (qp, outcome, undos)
+                )
+        # A group rolled back by a transient PV race re-admits behind backoff
         # right away — no cluster event will ever fire in a quiet cluster,
         # and the race loser's next attempt resolves against the updated
         # volume catalog.
         for g in race_rollback:
             self.queue.readmit_gang(g)
-        # Members that just entered the WaitOnPermit room grew their gang's
-        # quorum credit (queue.gang_credit counts waiters) — a peer parked in
-        # the gang pool (e.g. a schema-grown deferral reactivated mid-batch
-        # while this one was merely "placed") may now make the gang
-        # admissible, and no cluster event fires in a quiet cluster.
-        for g in wait:
-            self.queue._try_admit_gang(g)
+        # Plugins see their groups that are now waiting (e.g. coscheduling
+        # re-attempts queue admission: waiter credit grew).
+        for plugin in self.permit_plugins:
+            plugin_waits = {g for g in wait if owner.get(g) is plugin}
+            if plugin_waits:
+                plugin.post_batch(plugin_waits, self)
         if prebind_s:
             m.registry.observe_point("PreBind", prebind_s)
         # Metrics after rollbacks settled (success = outcome kept its node).
@@ -1220,24 +1326,11 @@ class TPUScheduler:
         any_victims = False
         for (i, qp, outcome), res in zip(failed, results):
             if res is not None:
-                m.preemptions += 1
-                outcome.nominated_node = res.node_name
-                outcome.victims = len(res.victims)
-                outcome.victim_uids = tuple(v.uid for v in res.victims)
-                outcome.victim_names = tuple(
-                    f"{v.namespace}/{v.name}" for v in res.victims
-                )
+                # The fit overlay protects the freed node from same/next-
+                # batch stealers, and the retry's fast path takes it
+                # (nominator.go AddNominatedPod).
+                self._record_preemption(qp, outcome, res, deltas[i])
                 any_victims = any_victims or bool(res.victims)
-                # Record the claim: the fit overlay protects the freed node
-                # from same/next-batch stealers, and the retry's fast path
-                # takes it (nominator.go AddNominatedPod).
-                self.nominator[qp.pod.uid] = (
-                    res.node_name, deltas[i], qp.pod.spec.priority
-                )
-                # The reference waits for the victims' graceful deletion
-                # (requeue on their delete events); in-process deletion is
-                # synchronous, so the nominated pod can retry immediately.
-                self.queue.add(qp.pod)
             elif self.preemption is not None and schema_grew:
                 # Preemption sat this batch out (its compiled pass cannot
                 # mix old-shape feature rows with the rebuilt state) — the

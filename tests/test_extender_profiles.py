@@ -98,3 +98,59 @@ def test_two_profiles_compile_distinct_programs():
     assert "alien" not in out  # ignored: not responsible for it
     assert s.queue.pending_count() == 0
     assert s.builder.host_mirror_equal()
+
+
+def test_extender_profile_runs_preemption():
+    """PostFilter through the extender path (schedule_one.go:749): an
+    unschedulable pod preempts, with preemption-capable extenders vetoing
+    or accepting the chosen candidate (ProcessPreemption)."""
+
+    class PreemptingExtender(FakeExtender):
+        supports_preemption = True
+
+        def __init__(self, veto=False, **kw):
+            super().__init__(**kw)
+            self.veto = veto
+            self.preempt_calls = 0
+
+        def process_preemption(self, pod, node_to_victims):
+            self.preempt_calls += 1
+            if self.veto:
+                return {}
+            return {
+                node: [v.uid for v in victims]
+                for node, victims in node_to_victims.items()
+            }
+
+    def build(ex):
+        s = TPUScheduler(batch_size=4, extenders=[ex])
+        s.add_node(
+            make_node("n0").capacity({"cpu": "4", "memory": "16Gi", "pods": 10}).obj()
+        )
+        s.add_pod(make_pod("low").req({"cpu": "4"}).priority(1).obj())
+        assert [o.node_name for o in s.schedule_all_pending()] == ["n0"]
+        s.add_pod(make_pod("high").req({"cpu": "4"}).priority(100).obj())
+        return s
+
+    # Accepting extender: the high-priority pod evicts `low` and retries
+    # onto its nominated node.
+    ex = PreemptingExtender()
+    s = build(ex)
+    out = s.schedule_all_pending(wait_backoff=True)
+    by_name = {o.pod.name: o for o in out}
+    assert ex.preempt_calls == 1
+    assert any(
+        o.pod.name == "high" and o.node_name == "n0" for o in out
+    ), by_name
+    assert "default/low" not in s.cache.pods
+    assert s.builder.host_mirror_equal()
+
+    # Vetoing extender: preemption abandoned, the pod parks unschedulable,
+    # the victim survives.
+    ex2 = PreemptingExtender(veto=True)
+    s2 = build(ex2)
+    out2 = s2.schedule_all_pending()
+    assert ex2.preempt_calls == 1
+    assert all(o.node_name is None for o in out2 if o.pod.name == "high")
+    assert "default/low" in s2.cache.pods
+    assert "default/high" in s2.queue._unschedulable

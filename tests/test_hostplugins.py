@@ -1,0 +1,87 @@
+"""The generic host-plugin surface (framework/hostplugins.py): a custom
+PermitPlugin — NOT coscheduling — drives WaitOnPermit through the same
+machinery, proving the loop special-cases nothing about gangs
+(runtime/framework.go:1443 RunPermitPlugins as an extension point)."""
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.hostplugins import BatchPermit
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+class PairPermit:
+    """Toy policy: pods labelled pair=<g> bind only in twos."""
+
+    name = "PairPermit"
+
+    def __init__(self):
+        self.bound: dict[str, int] = {}
+
+    def group_of(self, pod):
+        return pod.metadata.labels.get("pair")
+
+    def judge_batch(self, placed, sched):
+        out = BatchPermit()
+        counts: dict[str, int] = {}
+        for qp, _node in placed:
+            g = self.group_of(qp.pod)
+            if g:
+                counts[g] = counts.get(g, 0) + 1
+        for g, n in counts.items():
+            waiting = len(sched.permit_waiting.get(g, ()))
+            if self.bound.get(g, 0) + n + waiting >= 2:
+                out.admit.add(g)
+            else:
+                out.wait.add(g)
+        return out
+
+    def on_rollback(self, qp, sched):
+        sched.queue.add_backoff(qp)
+
+    def timeout_s(self, sched):
+        return 60.0
+
+    def post_batch(self, wait_groups, sched):
+        pass
+
+
+def test_custom_permit_plugin_waits_and_admits():
+    s = TPUScheduler(batch_size=1)
+    plugin = PairPermit()
+    s.permit_plugins = [plugin]
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "32Gi", "pods": 110}).obj()
+    )
+    s.add_pod(make_pod("a1").req({"cpu": "1"}).label("pair", "ab").obj())
+    # Lone pair member: placed, then parked in the waiting room.
+    out1 = s.schedule_batch()
+    assert out1 == []
+    assert len(s.permit_waiting.get("ab", ())) == 1
+    assert s.permit_wait_owner["ab"] is plugin
+    assert s.cache.pods["default/a1"].assumed
+    # The second member arrives: quorum of two → both finalize.
+    s.add_pod(make_pod("a2").req({"cpu": "1"}).label("pair", "ab").obj())
+    out2 = s.schedule_all_pending()
+    assert sorted(o.pod.name for o in out2 if o.node_name) == ["a1", "a2"]
+    assert s.cache.pods["default/a1"].bound
+    assert s.builder.host_mirror_equal()
+
+
+def test_custom_permit_plugin_expiry_uses_plugin_rollback():
+    s = TPUScheduler(batch_size=1)
+    plugin = PairPermit()
+    s.permit_plugins = [plugin]
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "32Gi", "pods": 110}).obj()
+    )
+    s.add_pod(make_pod("solo").req({"cpu": "1"}).label("pair", "xy").obj())
+    s.schedule_batch()
+    assert len(s.permit_waiting.get("xy", ())) == 1
+    # Expire: the waiter is forgotten and requeued via the PLUGIN's
+    # rollback (backoff — not the gang pool).
+    n = s.expire_waiting_gangs(timeout_s=0.0)
+    assert n == 1
+    assert not s.permit_waiting
+    assert not s.cache.pods["default/solo"].assumed if "default/solo" in s.cache.pods else True
+    assert "default/solo" not in s.cache.pods
+    assert len(s.queue._backoff) == 1
