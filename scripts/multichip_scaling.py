@@ -38,10 +38,12 @@ from kubernetes_tpu.api.wrappers import make_node, make_pod  # noqa: E402
 from kubernetes_tpu.engine.features import build_pod_batch  # noqa: E402
 from kubernetes_tpu.engine.pass_ import build_pass  # noqa: E402
 from kubernetes_tpu.parallel.mesh import (  # noqa: E402
+    _spec_for,
     make_mesh,
     shard_cluster_state,
     shard_pod_batch,
 )
+from kubernetes_tpu.snapshot import _NODE_AXIS  # noqa: E402
 from kubernetes_tpu.scheduler import TPUScheduler  # noqa: E402
 
 
@@ -95,6 +97,117 @@ def main(n_nodes: int = 16384, n_pods: int = 256) -> dict:
     return result
 
 
+def beyond_hbm(n_nodes_big: int = 4_194_304, n_pods: int = 192) -> dict:
+    """Beyond-HBM evidence (VERDICT r2 next-8): the capacity claim behind
+    node-axis sharding, measured — per-device memory of the COMPILED full
+    batch pass at a node count whose working set exceeds one chip's HBM.
+
+    XLA's compiled memory analysis is exact per-device accounting
+    (arguments + temps + outputs of the SPMD program each device runs),
+    so the number is real without materializing terabytes on this host:
+    the 1-shard program cannot fit a 16 GiB v5e; the same pass sharded
+    8-ways fits with room.  Shapes-only lowering (ShapeDtypeStruct) —
+    no tensor of this size is ever allocated."""
+    import dataclasses as dc
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubernetes_tpu.snapshot import ClusterState
+
+    HBM = 16 * 1024**3  # v5e HBM bytes
+
+    # Small REAL cluster: its featurized batch/state provide the exact
+    # dtypes + vocab dims; only the node axis is scaled up abstractly.
+    s = TPUScheduler(batch_size=n_pods, chunk_size=64)
+    for i in range(300):
+        s.add_node(
+            make_node(f"n{i:05d}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % 8}")
+            .obj()
+        )
+    pods = [
+        make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"})
+        .label("app", f"a{i % 8}").obj()
+        for i in range(n_pods)
+    ]
+    batch, _, active = build_pod_batch(pods, s.builder, s.profile, n_pods)
+    batch["nominated_row"] = np.full(n_pods, -1, np.int32)
+    inv = s._full_inv()
+    state = s.builder.state()
+    n_small = s.builder.schema.N
+    assert n_nodes_big % 8 == 0
+    schema_big = dc.replace(s.builder.schema, N=n_nodes_big)
+    fn = build_pass(s.profile, schema_big, s.builder.res_col, active, 64)
+
+    def lower_for(shards: int):
+        mesh = make_mesh(shards) if shards > 1 else None
+
+        def state_abs():
+            fields = {}
+            for f in dc.fields(ClusterState):
+                arr = getattr(state, f.name)
+                ax = _NODE_AXIS[f.name]
+                shape = list(arr.shape)
+                assert shape[ax] == n_small, (f.name, arr.shape)
+                shape[ax] = n_nodes_big
+                sh = NamedSharding(mesh, _spec_for(f.name)) if mesh else None
+                fields[f.name] = jax.ShapeDtypeStruct(
+                    tuple(shape), arr.dtype, sharding=sh
+                )
+            return ClusterState(**fields)
+
+        def other_abs(d):
+            out = {}
+            for k, v in d.items():
+                v = np.asarray(v)
+                shape = tuple(
+                    n_nodes_big if dim == n_small else dim for dim in v.shape
+                )
+                spec = P(
+                    *["nodes" if dim == n_nodes_big else None for dim in shape]
+                )
+                sh = NamedSharding(mesh, spec) if mesh else None
+                out[k] = jax.ShapeDtypeStruct(shape, v.dtype, sharding=sh)
+            return out
+
+        lo = fn.lower(
+            state_abs(), other_abs(batch), other_abs(inv), np.uint32(0)
+        )
+        ma = lo.compile().memory_analysis()
+        per_dev = (
+            ma.argument_size_in_bytes
+            + ma.temp_size_in_bytes
+            + ma.output_size_in_bytes
+        )
+        return {
+            "shards": shards,
+            "argument_gib": round(ma.argument_size_in_bytes / 1024**3, 2),
+            "temp_gib": round(ma.temp_size_in_bytes / 1024**3, 2),
+            "output_gib": round(ma.output_size_in_bytes / 1024**3, 2),
+            "per_device_gib": round(per_dev / 1024**3, 2),
+            "fits_v5e_hbm": per_dev < HBM,
+        }
+
+    table = [lower_for(1), lower_for(8)]
+    result = {
+        "mode": "beyond-hbm",
+        "nodes": n_nodes_big,
+        "pods_per_batch": n_pods,
+        "chunk": 64,
+        "hbm_gib": 16,
+        "table": table,
+    }
+    print(json.dumps(result))
+    assert not table[0]["fits_v5e_hbm"], "pick a larger node count"
+    assert table[1]["fits_v5e_hbm"], "8-shard should fit"
+    return result
+
+
 if __name__ == "__main__":
-    args = [int(a) for a in sys.argv[1:3]]
-    main(*args)
+    if "--beyond-hbm" in sys.argv:
+        rest = [int(a) for a in sys.argv[1:] if not a.startswith("-")]
+        beyond_hbm(*rest)
+    else:
+        args = [int(a) for a in sys.argv[1:3]]
+        main(*args)
