@@ -16,6 +16,7 @@ from ..api import types as t
 from ..framework.config import Profile
 from ..ops import common as opcommon
 from ..snapshot import POD_PORT_SLOTS, SnapshotBuilder, _bucket
+from ..utils import const_array
 
 opcommon.feature_fill("ipa_own_terms", -1)
 opcommon.feature_fill("vol_dev_ids", -1)
@@ -33,6 +34,13 @@ opcommon.feature_fill("dra_claim_unalloc", 0)
 opcommon.feature_fill("nominated_row", -1)
 
 _DC_FIELDS: dict[type, tuple[str, ...]] = {}
+
+# The empty-case singletons (hoisted: building even a cache key per pod
+# costs more than it saves at millions of pods).
+_PORTS_EMPTY = const_array(POD_PORT_SLOTS, -1, np.int32)
+_I32_NEG1 = const_array(1, -1, np.int32)
+_I32_ZERO = const_array(1, 0, np.int32)
+_BOOL_FALSE = const_array(1, 0, np.bool_)
 
 
 def _sig(o):
@@ -104,7 +112,16 @@ def build_pod_batch(
         builder.feat_cache = (version, {})
     store = builder.feat_cache[1]
     for pod in pods:
-        key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
+        # The signature is memoized on the pod object: hashing the spec tree
+        # is ~half of featurize cost for unique-spec workloads (daemonset's
+        # per-node name affinity), and a pod's spec/labels only change by
+        # arriving as a NEW object on the informer path (update_pod) — the
+        # one in-place mutation, bind's spec.node_name write, happens after
+        # the pod's last featurization.
+        key = getattr(pod, "_featsig", None)
+        if key is None:
+            key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
+            pod._featsig = key
         hit = store.get(key)
         if hit is not None:
             feats, delta = dict(hit[0]), dict(hit[1])
@@ -115,36 +132,57 @@ def build_pod_batch(
         deltas.append(delta)
         # Host ports are base commit features: the scan's _commit and the host
         # apply_pod_delta must apply the *same* delta or the mirrors desync.
-        port_triples = np.full(POD_PORT_SLOTS, -1, np.int32)
-        port_keys = np.full(POD_PORT_SLOTS, -1, np.int32)
-        for j, (triple, pk) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
-            port_triples[j] = triple
-            port_keys[j] = pk
+        # Empty-case arrays are shared immutable singletons (const_array):
+        # most pods carry no ports/devices/claims, and per-pod allocation of
+        # all-pad arrays was a measurable slice of featurize cost.
+        if delta["ports"]:
+            port_triples = np.full(POD_PORT_SLOTS, -1, np.int32)
+            port_keys = np.full(POD_PORT_SLOTS, -1, np.int32)
+            for j, (triple, pk) in enumerate(delta["ports"][:POD_PORT_SLOTS]):
+                port_triples[j] = triple
+                port_keys[j] = pk
+        else:
+            port_triples = port_keys = _PORTS_EMPTY
         own = delta["own_terms"]
-        own_terms = np.full(_bucket(max(len(own), 1), 1), -1, np.int32)
-        own_terms[: len(own)] = own
+        if own:
+            own_terms = np.full(_bucket(len(own), 1), -1, np.int32)
+            own_terms[: len(own)] = own
+        else:
+            own_terms = _I32_NEG1
         devs = delta["devices"]
-        dev_ids = np.full(_bucket(max(len(devs), 1), 1), -1, np.int32)
-        dev_rw = np.zeros(dev_ids.shape[0], np.bool_)
-        for j, (vid, rw) in enumerate(devs):
-            dev_ids[j] = vid
-            dev_rw[j] = rw
+        if devs:
+            dev_ids = np.full(_bucket(len(devs), 1), -1, np.int32)
+            dev_rw = np.zeros(dev_ids.shape[0], np.bool_)
+            for j, (vid, rw) in enumerate(devs):
+                dev_ids[j] = vid
+                dev_rw[j] = rw
+        else:
+            dev_ids = _I32_NEG1
+            dev_rw = _BOOL_FALSE
         dcl = delta["dra_claims"]
-        dra_ids = np.full(_bucket(max(len(dcl), 1), 1), -1, np.int32)
-        dra_cls = np.full(dra_ids.shape[0], -1, np.int32)
-        dra_cnt = np.zeros(dra_ids.shape[0], np.int32)
-        dra_unalloc = np.zeros(dra_ids.shape[0], np.bool_)
-        for j, (kid, (cid, cnt, unalloc)) in enumerate(dcl):
-            dra_ids[j] = kid
-            dra_cls[j] = cid
-            dra_cnt[j] = cnt
-            dra_unalloc[j] = unalloc
+        if dcl:
+            dra_ids = np.full(_bucket(len(dcl), 1), -1, np.int32)
+            dra_cls = np.full(dra_ids.shape[0], -1, np.int32)
+            dra_cnt = np.zeros(dra_ids.shape[0], np.int32)
+            dra_unalloc = np.zeros(dra_ids.shape[0], np.bool_)
+            for j, (kid, (cid, cnt, unalloc)) in enumerate(dcl):
+                dra_ids[j] = kid
+                dra_cls[j] = cid
+                dra_cnt[j] = cnt
+                dra_unalloc[j] = unalloc
+        else:
+            dra_ids = dra_cls = _I32_NEG1
+            dra_cnt = _I32_ZERO
+            dra_unalloc = _BOOL_FALSE
         cvols = delta["csivols"]
-        csi_ids = np.full(_bucket(max(len(cvols), 1), 1), -1, np.int32)
-        csi_drv = np.full(csi_ids.shape[0], -1, np.int32)
-        for j, (vid, did) in enumerate(cvols):
-            csi_ids[j] = vid
-            csi_drv[j] = did
+        if cvols:
+            csi_ids = np.full(_bucket(len(cvols), 1), -1, np.int32)
+            csi_drv = np.full(csi_ids.shape[0], -1, np.int32)
+            for j, (vid, did) in enumerate(cvols):
+                csi_ids[j] = vid
+                csi_drv[j] = did
+        else:
+            csi_ids = csi_drv = _I32_NEG1
         feats = {
             "ipa_own_terms": own_terms,
             "vol_dev_ids": dev_ids,

@@ -51,6 +51,12 @@ _OPCODE = {
 }
 
 
+from ..utils import const_array as _const
+
+_EMPTY_PROG: dict[str, dict] = {}
+_EMPTY_SEL = _const(1, -1, np.int32)
+
+
 class _Program:
     """Mutable builder for a (T, Q, V) requirement program."""
 
@@ -99,6 +105,21 @@ class _Program:
         all-OP_PAD and evaluates True everywhere — _Program.add_term never
         produces one, but grouped volume programs use them as always-true
         entries (ops/volumes._GroupedProgram)."""
+        if not self.terms and min_terms <= 1:
+            # Empty program (no affinity): shared immutable all-pad tensors
+            # — allocated once per prefix, not per pod (most pods have no
+            # affinity of the given kind).
+            cached = _EMPTY_PROG.get(prefix)
+            if cached is None:
+                cached = {
+                    f"{prefix}_op": _const((1, 1), OP_PAD, np.int32),
+                    f"{prefix}_key": _const((1, 1), -1, np.int32),
+                    f"{prefix}_vals": _const((1, 1, 1), -1, np.int32),
+                    f"{prefix}_int": _const((1, 1), 0, np.int64),
+                    f"{prefix}_term_valid": _const(1, 0, np.bool_),
+                }
+                _EMPTY_PROG[prefix] = cached
+            return dict(cached)
         tdim = _bucket(max(len(self.terms), min_terms, 1), 1)
         qdim = _bucket(max((len(te) for te in self.terms), default=1) or 1, 1)
         vdim = _bucket(
@@ -161,10 +182,15 @@ def _eval_terms(state, ops, keys, vals, ints):
 def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
     it = fctx.interns
     # spec.nodeSelector map: every (k, v) pair must be present on the node.
-    sel_pairs = [it.label_pairs.id((k, v)) for k, v in sorted(pod.spec.node_selector.items())]
-    sdim = _bucket(max(len(sel_pairs), 1), 1)
-    sel = np.full(sdim, -1, np.int32)
-    sel[: len(sel_pairs)] = sel_pairs
+    if pod.spec.node_selector:
+        sel_pairs = [
+            it.label_pairs.id((k, v))
+            for k, v in sorted(pod.spec.node_selector.items())
+        ]
+        sel = np.full(_bucket(len(sel_pairs), 1), -1, np.int32)
+        sel[: len(sel_pairs)] = sel_pairs
+    else:
+        sel = _EMPTY_SEL
 
     aff = pod.spec.affinity
     na = aff.node_affinity if aff else None
@@ -188,9 +214,15 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
     # join the pod's in Score.  Featurized per pod so the batch feature
     # cache (keyed on profile) stays coherent across profiles.
     added = fctx.profile.added_affinity if fctx.profile else None
-    add_prog = _Program()
-    has_added = False
+    feats = {"na_sel_pairs": sel, "na_has_required": np.bool_(has_required)}
+    feats.update(req_prog.tensors("na_req"))
     if added is not None:
+        # Profile is trace-static: profiles WITHOUT addedAffinity emit no
+        # na_add features and their compiled filter skips the whole added
+        # branch (a per-pod program build + a (T,Q,N,LS) device broadcast
+        # that regressed the daemonset workload when done unconditionally).
+        add_prog = _Program()
+        has_added = False
         if added.required is not None and added.required.terms:
             has_added = True
             for term in added.required.terms:
@@ -200,10 +232,8 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
             pref_prog.add_term(p.preference, it)
             if len(pref_prog.terms) > before:
                 weights.append(p.weight)
-    feats = {"na_sel_pairs": sel, "na_has_required": np.bool_(has_required)}
-    feats.update(req_prog.tensors("na_req"))
-    feats["na_has_added"] = np.bool_(has_added)
-    feats.update(add_prog.tensors("na_add"))
+        feats["na_has_added"] = np.bool_(has_added)
+        feats.update(add_prog.tensors("na_add"))
     pref = pref_prog.tensors("na_pref")
     w = np.zeros(pref["na_pref_term_valid"].shape[0], np.int64)
     w[: len(weights)] = weights
@@ -224,12 +254,15 @@ def filter_fn(state, pf, ctx: PassContext):
     )
     any_term = (term_match & pf["na_req_term_valid"][:, None]).any(0)
     affinity_ok = jnp.where(pf["na_has_required"], any_term, True)
-    add_match = _eval_terms(
-        state, pf["na_add_op"], pf["na_add_key"], pf["na_add_vals"], pf["na_add_int"]
-    )
-    add_any = (add_match & pf["na_add_term_valid"][:, None]).any(0)
-    added_ok = jnp.where(pf["na_has_added"], add_any, True)
-    return sel_ok & affinity_ok & added_ok
+    ok = sel_ok & affinity_ok
+    if ctx.profile.added_affinity is not None:  # static trace-time branch
+        add_match = _eval_terms(
+            state, pf["na_add_op"], pf["na_add_key"], pf["na_add_vals"],
+            pf["na_add_int"],
+        )
+        add_any = (add_match & pf["na_add_term_valid"][:, None]).any(0)
+        ok &= jnp.where(pf["na_has_added"], add_any, True)
+    return ok
 
 
 def score_fn(state, pf, ctx: PassContext, feasible):

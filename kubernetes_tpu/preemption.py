@@ -67,6 +67,7 @@ from .api import types as t
 from .framework.config import Profile
 from .ops import common as opcommon
 from .snapshot import Schema, _bucket
+from .utils import device_fetch
 
 I32_MAX = np.int32(2**31 - 1)
 
@@ -518,18 +519,23 @@ class PreemptionEvaluator:
             (pr.pod.spec.priority for pr in cache.pods.values()), default=None
         )
 
-        def can_ever_fit(p: t.Pod) -> bool:
-            pr = cache.pods.get(p.uid)
-            delta = pr.delta if pr else builder.pod_delta_vectors(p)
-            req = delta["req"]
+        batch_req = batch_rows.get("req")
+
+        def can_ever_fit(i: int, p: t.Pod) -> bool:
+            if batch_req is not None:
+                req = np.asarray(batch_req[i])  # already featurized this batch
+            else:
+                pr = cache.pods.get(p.uid)
+                delta = pr.delta if pr else builder.pod_delta_vectors(p)
+                req = delta["req"]
             return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
 
         eligible = [
             p.spec.preemption_policy != t.PREEMPT_NEVER
             and min_prio is not None
             and p.spec.priority > min_prio
-            and can_ever_fit(p)
-            for p in pods
+            and can_ever_fit(i, p)
+            for i, p in enumerate(pods)
         ]
         if not any(eligible):
             return [None] * len(pods)
@@ -690,7 +696,7 @@ class PreemptionEvaluator:
             state, batch_d, inv_d, d_prio, d_vic_req,
             d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
         )
-        picks, vmasks = np.asarray(out.picks), np.asarray(out.vic_mask)
+        picks, vmasks = device_fetch((out.picks, out.vic_mask))
         # Chunk-deferred preemptors (same-node collisions, heterogeneous
         # signatures, exhausted ranks) return None: the scheduler requeues
         # them and the NEXT chunked pass — against post-eviction truth — is

@@ -88,19 +88,32 @@ class EventCtx:
     max_slots: int = 0  # free pod slots upper bound
 
 
+def _fits_free(reqs: list[np.ndarray], ctx: EventCtx) -> np.ndarray:
+    """(K,) bool: which request vectors the event's freed capacity could
+    seat — THE fit predicate, shared by the scalar hint and the queue's
+    batched wake path so the two cannot drift.  A pod needing a resource
+    column the affected nodes don't expose never wakes."""
+    k = len(reqs)
+    if ctx.max_slots < 1:
+        return np.zeros(k, np.bool_)
+    r = ctx.max_free.shape[0]
+    reqm = np.zeros((k, r), np.int64)
+    overflow = np.zeros(k, np.bool_)
+    for i, req in enumerate(reqs):
+        n = min(req.shape[0], r)
+        reqm[i, :n] = req[:n]
+        if req.shape[0] > r and req[r:].any():
+            overflow[i] = True
+    return (reqm <= ctx.max_free[None, :]).all(axis=1) & ~overflow
+
+
 def _fit_hint(qp: "QueuedPodInfo", event: "Event", ctx: EventCtx) -> bool:
     """NodeResourcesFit QueueingHint (fit.go:253 isSchedulableAfterPodChange
     / :300 isSchedulableAfterNodeChange): requeue only when the event's
     freed/added capacity could actually seat this pod."""
     if ctx.max_free is None or qp.delta is None:
         return True  # no object info — conservative requeue
-    if ctx.max_slots < 1:
-        return False
-    req = qp.delta["req"]
-    r = min(req.shape[0], ctx.max_free.shape[0])
-    if req.shape[0] > r and req[r:].any():
-        return False  # needs a resource the affected nodes don't expose
-    return bool((req[:r] <= ctx.max_free[:r]).all())
+    return bool(_fits_free([qp.delta["req"]], ctx)[0])
 
 
 # Object-aware per-plugin hints; plugins absent here fall back to the static
@@ -395,14 +408,47 @@ class SchedulingQueue:
                 return True
         return False
 
+    def _worth_or_fit_deferred(self, qp, event, ctx):
+        """Like _worth_requeuing, but returns 'fit' when the ONLY deciding
+        hint is the fit hint — the caller batches those into one vectorized
+        check (a preemption burst scans a 15k-pod pool per POD_DELETE;
+        per-pod Python is ~20% of the measured window)."""
+        defer_fit = False
+        for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
+            if not (PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY) & event):
+                continue
+            hint = PLUGIN_HINTS.get(pl) if self.use_queueing_hints else None
+            if hint is None:
+                return True
+            if hint is _fit_hint and qp.delta is not None:
+                defer_fit = True
+                continue
+            if hint(qp, event, ctx):
+                return True
+        return "fit" if defer_fit else False
+
     def on_event(self, event: Event, ctx: EventCtx | None = None) -> int:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1029): wake
         unschedulable pods whose rejecting plugins care about this event
         (filtered through the object-aware hints when ``ctx`` is given)."""
         woken = []
-        for uid, qp in self._unschedulable.items():
-            if self._worth_requeuing(qp, event, ctx):
-                woken.append(uid)
+        if ctx is None or ctx.max_free is None:
+            for uid, qp in self._unschedulable.items():
+                if self._worth_requeuing(qp, event, ctx):
+                    woken.append(uid)
+        else:
+            fit_uids: list[str] = []
+            fit_reqs: list[np.ndarray] = []
+            for uid, qp in self._unschedulable.items():
+                verdict = self._worth_or_fit_deferred(qp, event, ctx)
+                if verdict is True:
+                    woken.append(uid)
+                elif verdict == "fit":
+                    fit_uids.append(uid)
+                    fit_reqs.append(qp.delta["req"])
+            if fit_uids:
+                fits = _fits_free(fit_reqs, ctx)
+                woken.extend(uid for uid, ok in zip(fit_uids, fits) if ok)
         for uid in woken:
             qp = self._unschedulable.pop(uid)
             self.add_backoff(qp)

@@ -27,6 +27,7 @@ from .intern import InternTable
 from .ops.common import registered_subset
 from .preemption import PreemptionEvaluator
 from .queue import Event, EventCtx, QueuedPodInfo, SchedulingQueue
+from .utils import device_fetch
 from .snapshot import SnapshotBuilder
 
 
@@ -662,7 +663,7 @@ class TPUScheduler:
             if rec_n is not None:
                 nomrow = rec_n.row
         pf["nominated_row"] = np.int32(nomrow)
-        feasible, total = jax.device_get(run(state, pf, inv))
+        feasible, total = device_fetch(run(state, pf, inv))
         m.featurize_time_s += t1 - t0
         m.device_time_s += time.perf_counter() - t1
         rows = np.nonzero(feasible)[0]
@@ -992,7 +993,7 @@ class TPUScheduler:
         new_state, result, t1 = ctx["new_state"], ctx["result"], ctx["t1"]
         # One host round trip for all result arrays (the tunnel to the device
         # has high per-transfer latency; never sync field-by-field).
-        picks, scores, feas, fails, processed = jax.device_get(
+        picks, scores, feas, fails, processed = device_fetch(
             (result.picks, result.scores, result.feasible_counts,
              result.fail_masks, result.processed)
         )
@@ -1066,7 +1067,7 @@ class TPUScheduler:
                     new_state, res = run2(
                         new_state, sub_d, ctx["inv_d"], np.uint32(self._cycle)
                     )
-                    p2, s2, f2, fl2 = jax.device_get(
+                    p2, s2, f2, fl2 = device_fetch(
                         (res.picks, res.scores, res.feasible_counts, res.fail_masks)
                     )
                     self._cycle += len(idx)

@@ -1,0 +1,30 @@
+"""Small host-side utilities."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def const_array(shape, fill, dtype) -> np.ndarray:
+    """Shared immutable constant array: allocate ONCE at module scope and
+    reuse per pod.  Per-pod feature dicts are full of all-pad arrays (a pod
+    with no host ports still carries port slots, a pod with no claims still
+    carries claim slots…) — allocating them per pod is a measurable slice
+    of featurize cost.  Read-only; np.stack copies it into the batch."""
+    a = np.full(shape, fill, dtype)
+    a.flags.writeable = False
+    return a
+
+
+def device_fetch(tree):
+    """jax.device_get with the per-leaf round trips PIPELINED: start every
+    leaf's device→host copy asynchronously, then collect.  device_get alone
+    blocks one full round trip PER LEAF — through a remote-TPU tunnel
+    (~35-70 ms per trip) a 5-leaf result costs ~200 ms serialized vs ~40 ms
+    pipelined.  Co-located HBM→host copies see the same effect at a smaller
+    scale (one DMA wait instead of N)."""
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    return jax.device_get(tree)
