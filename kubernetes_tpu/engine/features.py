@@ -43,6 +43,28 @@ _I32_ZERO = const_array(1, 0, np.int32)
 _BOOL_FALSE = const_array(1, 0, np.bool_)
 
 
+def pin_name(pod: t.Pod):
+    """The single node a pod's own constraints reduce its candidate set to,
+    or None: a required node affinity of exactly one term with one
+    metadata.name In [one value] matchFields (nodeaffinity.go PreFilter's
+    PreFilterResult.NodeNames).  Doubles as the featurize-cache skip: a
+    name-pinned pod's spec is unique by construction (distinct pin names),
+    so key hashing + store lookups are pure overhead for them."""
+    aff = pod.spec.affinity
+    na = aff.node_affinity if aff else None
+    if na is not None and na.required is not None and len(na.required.terms) == 1:
+        term = na.required.terms[0]
+        if not term.match_expressions and len(term.match_fields) == 1:
+            mf = term.match_fields[0]
+            if (
+                mf.key == "metadata.name"
+                and mf.operator == t.OP_IN
+                and len(mf.values) == 1
+            ):
+                return mf.values[0]
+    return None
+
+
 def _sig(o):
     """Canonical hashable signature of an API object tree.  Workload pods are
     stamped from templates, so (namespace, labels, spec) collapses thousands
@@ -119,10 +141,10 @@ def build_pod_batch(
         # one in-place mutation, bind's spec.node_name write, happens after
         # the pod's last featurization.
         key = getattr(pod, "_featsig", None)
-        if key is None:
+        if key is None and pin_name(pod) is None:
             key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
             pod._featsig = key
-        hit = store.get(key)
+        hit = store.get(key) if key is not None else None
         if hit is not None:
             feats, delta = dict(hit[0]), dict(hit[1])
             deltas.append(delta)
@@ -210,14 +232,14 @@ def build_pod_batch(
                 feats.update(op.featurize(pod, fctx))
         per_pod.append(feats)
         v2 = (builder.feature_version(), profile, active)
-        if v2 == version:
-            if len(store) > 8192:
-                store.clear()
-            store[key] = (dict(feats), dict(delta))
-        else:  # this pod grew a vocabulary — new cache generation, skip entry
+        if v2 != version:  # this pod grew a vocabulary — new cache generation
             version = v2
             store = {}
             builder.feat_cache = (version, store)
+        elif key is not None:  # pinned pods (key None) skip the store only
+            if len(store) > 8192:
+                store.clear()
+            store[key] = (dict(feats), dict(delta))
 
     if not per_pod:
         raise ValueError("empty pod batch")

@@ -613,6 +613,165 @@ def build_eval_pass(
     return run
 
 
+# Ops whose filter/score read ONLY node-axis state (no domain tables, no
+# cross-pod conflict classes) — the op subset the pinned fast path handles.
+PINNED_SAFE_OPS = frozenset({
+    "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+    "NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
+})
+
+
+def build_pinned_pass(
+    profile: Profile,
+    schema: Schema,
+    builder_res_col: dict[str, int],
+    active: frozenset[str] | None = None,
+):
+    """Pinned-batch fast path: every pod arrives pre-resolved to ONE
+    candidate row (``batch["pin_row"]``) — the TPU analog of PreFilter
+    node-set reduction (nodeaffinity.go PreFilter returns the name set for
+    metadata.name matchFields; NodeName via spec.nodeName;
+    schedule_one.go:504 evaluates only those nodes).  The (K, N) matrix
+    scan collapses to one vmapped own-row evaluation: each pod's active
+    filters/scorers run against a single-row slice of the state, and
+    same-row capacity interaction is a closed-form segmented prefix — no
+    sequential scan; placed pods commit in ONE _commit_chunk scatter (a
+    per-row host flush of thousands of dirty rows costs more than the
+    whole evaluation).
+
+    Decision-identical to the full pass for eligible batches: a pinned
+    pod's only feasible node IS its pin (the NodeName/NodeAffinity filters
+    guarantee it), so filter verdicts, the pick, and even the normalized
+    score (over a single-node feasible set either way) agree.  Same-row
+    mates whose cumulative demand overflows defer (pick -2) to the strict
+    tail, exactly like the chunked scan's overflow rule.  Eligibility
+    (every pod pinned, active ⊆ PINNED_SAFE_OPS, not truncated) is the
+    scheduler's job."""
+    filter_ops = [
+        opcommon.get(n) for n in profile.filters if active is None or n in active
+    ]
+    score_ops = [
+        (opcommon.get(n), w)
+        for n, w in profile.scorers
+        if active is None or n in active
+    ]
+    static: dict = {}
+    for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
+        if op.static is not None:
+            static.update(op.static(profile, schema, builder_res_col))
+    ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
+
+    @jax.jit
+    def run(state: ClusterState, batch: dict, inv: dict):
+        from ..snapshot import _NODE_AXIS
+
+        rows = batch["pin_row"]  # (K,) i32; -1 ⇒ pin names no live node
+        k = rows.shape[0]
+        safe = jnp.maximum(rows, 0)
+        # Per-pod single-row state slices: node-axis gathered to the front,
+        # then a kept axis of size 1 so every op sees its usual layout.
+        sliced = {}
+        for f in dataclasses.fields(ClusterState):
+            a = getattr(state, f.name)
+            if _NODE_AXIS[f.name] == 0:
+                sliced[f.name] = jnp.expand_dims(a[safe], 1)
+            else:  # (X, N) fields
+                sliced[f.name] = jnp.expand_dims(
+                    jnp.moveaxis(a[:, safe], 1, 0), 2
+                )
+        state_k = ClusterState(**sliced)
+        if "nom_req" in inv:
+            nom_k = (
+                jnp.expand_dims(inv["nom_req"][safe], 1),
+                jnp.expand_dims(inv["nom_cnt"][safe], 1),
+                jnp.expand_dims(inv["nom_prio"][safe], 1),
+            )
+        else:
+            nom_k = None
+        # The fit filter's nominated self-exclusion indexes LOCAL rows.
+        batch2 = dict(batch)
+        if "nominated_row" in batch2:
+            batch2["nominated_row"] = jnp.where(
+                batch2["nominated_row"] == rows, 0, -1
+            ).astype(jnp.int32)
+
+        def eval_own(st1: ClusterState, pf: dict, nom1):
+            dctx = dataclasses.replace(ctx, dom=None, nom=nom1)
+            feasible = st1.valid  # (1,)
+            fail_mask = jnp.uint32(0)
+            bit = 0
+            for op in filter_ops:
+                if op.filter is not None:
+                    ok = op.filter(st1, pf, dctx)
+                    newly = feasible & ~ok
+                    fail_mask = fail_mask | jnp.where(
+                        newly.any(), jnp.uint32(1 << bit), jnp.uint32(0)
+                    )
+                    bit += 1
+                    feasible &= ok
+            total = jnp.zeros(1, jnp.int64)
+            for op, weight in score_ops:
+                if op.score is not None:
+                    total += op.score(st1, pf, dctx, feasible) * jnp.int64(weight)
+            return feasible[0], total[0], fail_mask
+
+        if nom_k is None:
+            feas_k, score_k, fail_k = jax.vmap(
+                lambda st1, pf: eval_own(st1, pf, None)
+            )(state_k, batch2)
+        else:
+            feas_k, score_k, fail_k = jax.vmap(eval_own)(state_k, batch2, nom_k)
+        feas_k &= (rows >= 0) & batch["valid"]
+
+        # Same-row sequential capacity: segmented inclusive prefixes over
+        # feasible mates in lane order (the chunked scan's cumulative-fit
+        # rule (b), in closed form).  Later mates whose prefix overflows
+        # DEFER to the strict tail rather than fail — an earlier mate's
+        # failure could have freed the room.
+        order = jnp.argsort(rows, stable=True)
+        r_s = rows[order]
+        req_s = batch["req"][order]  # (K, R)
+        feas_s = feas_k[order]
+        idx = jnp.arange(k)
+        segnew = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), r_s[1:] != r_s[:-1]]
+        )
+        start = lax.cummax(jnp.where(segnew, idx, 0))  # segment-start index
+        contrib = jnp.where(feas_s[:, None], req_s, 0)
+        csum = jnp.cumsum(contrib, axis=0)
+        within = csum - csum[start] + contrib[start]  # inclusive prefix
+        cnt = (
+            jnp.cumsum(feas_s.astype(jnp.int32))
+            - jnp.cumsum(feas_s.astype(jnp.int32))[start]
+            + feas_s[start].astype(jnp.int32)
+        )
+        r_safe = jnp.maximum(r_s, 0)
+        free_s = (state.alloc - state.req)[r_safe]
+        fit_s = ((req_s == 0) | (within <= free_s)).all(axis=-1) & (
+            state.num_pods[r_safe] + cnt <= state.allowed_pods[r_safe]
+        )
+        place_s = feas_s & fit_s
+        picks_s = jnp.where(
+            place_s, r_s, jnp.where(feas_s, jnp.int32(-2), jnp.int32(-1))
+        )
+        picks = jnp.zeros(k, jnp.int32).at[order].set(picks_s)
+        picks = jnp.where(batch["valid"], picks, -1)
+        att = picks >= 0
+        # One whole-batch commit (duplicate rows scatter-accumulate; -2
+        # deferrals commit nothing and retry next batch).
+        dom0 = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        new_state, _dom = _commit_chunk(state, dom0, batch2, picks, att)
+        return new_state, PassResult(
+            picks=picks,
+            scores=score_k.astype(jnp.int64),
+            feasible_counts=feas_k.astype(jnp.int32),
+            fail_masks=fail_k,
+            processed=jnp.zeros(k, jnp.int32),
+        )
+
+    return run
+
+
 class PassCache:
     """Compiled-pass cache keyed by (profile, schema, resource columns,
     batch-active op set, chunk)."""
@@ -632,5 +791,19 @@ class PassCache:
         fn = self._cache.get(key)
         if fn is None:
             fn = build_pass(profile, schema, res_col, active, chunk)
+            self._cache[key] = fn
+        return fn
+
+    def get_pinned(
+        self,
+        profile: Profile,
+        schema: Schema,
+        res_col: dict[str, int],
+        active: frozenset[str] | None = None,
+    ):
+        key = (profile, schema, tuple(sorted(res_col.items())), active, "pin")
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build_pinned_pass(profile, schema, res_col, active)
             self._cache[key] = fn
         return fn

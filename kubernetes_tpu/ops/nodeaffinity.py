@@ -55,6 +55,19 @@ from ..utils import const_array as _const
 
 _EMPTY_PROG: dict[str, dict] = {}
 _EMPTY_SEL = _const(1, -1, np.int32)
+# Name-pin fast-path singletons: the fixed parts of a single
+# metadata.name In [v] program (only the value id differs per pod).
+_NAME_PIN_OP = _const((1, 1), OP_NAME_IN, np.int32)
+_NAME_PIN_KEY = _const((1, 1), -1, np.int32)
+_NAME_PIN_INT = _const((1, 1), 0, np.int64)
+_NAME_PIN_VALID = _const(1, 1, np.bool_)
+_EMPTY_PREF = {
+    "na_pref_op": _const((1, 1), OP_PAD, np.int32),
+    "na_pref_key": _const((1, 1), -1, np.int32),
+    "na_pref_vals": _const((1, 1, 1), -1, np.int32),
+    "na_pref_int": _const((1, 1), 0, np.int64),
+    "na_pref_weight": _const(1, 0, np.int64),
+}
 
 
 class _Program:
@@ -194,6 +207,32 @@ def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
 
     aff = pod.spec.affinity
     na = aff.node_affinity if aff else None
+    # Name-pin fast path: a required affinity of exactly one
+    # metadata.name In [one value] matchFields term (the daemonset shape —
+    # one unique program per pod, so the general builder's Python cost is
+    # paid 15k times per workload) compiles to fixed-shape tensors with
+    # just the interned name id filled in.  The SAME shape test
+    # (features.pin_name) gates the pinned scheduling pass, so the two
+    # definitions of "name-pinned" cannot drift.
+    from ..engine.features import pin_name
+
+    pinned_name = pin_name(pod)
+    if (
+        pinned_name is not None
+        and (fctx.profile is None or fctx.profile.added_affinity is None)
+        and not na.preferred
+    ):
+        name_id = it.node_names.id(pinned_name)
+        feats = {"na_sel_pairs": sel, "na_has_required": np.bool_(True)}
+        feats["na_req_op"] = _NAME_PIN_OP
+        feats["na_req_key"] = _NAME_PIN_KEY
+        vals = np.empty((1, 1, 1), np.int32)
+        vals[0, 0, 0] = name_id
+        feats["na_req_vals"] = vals
+        feats["na_req_int"] = _NAME_PIN_INT
+        feats["na_req_term_valid"] = _NAME_PIN_VALID
+        feats.update(_EMPTY_PREF)
+        return feats
     req_prog = _Program()
     has_required = False
     if na and na.required is not None:

@@ -104,7 +104,11 @@ def _fits_free(reqs: list[np.ndarray], ctx: EventCtx) -> np.ndarray:
         reqm[i, :n] = req[:n]
         if req.shape[0] > r and req[r:].any():
             overflow[i] = True
-    return (reqm <= ctx.max_free[None, :]).all(axis=1) & ~overflow
+    # The fit filter's per-resource escape: a resource the pod does not
+    # request never blocks it (negative free in an unrequested column —
+    # nominated-claim subtraction — must not pin the pod asleep).
+    fits = ((reqm == 0) | (reqm <= ctx.max_free[None, :])).all(axis=1)
+    return fits & ~overflow
 
 
 def _fit_hint(qp: "QueuedPodInfo", event: "Event", ctx: EventCtx) -> bool:
@@ -395,60 +399,52 @@ class SchedulingQueue:
 
     # -- events ----------------------------------------------------------------
 
-    def _worth_requeuing(self, qp: QueuedPodInfo, event: Event, ctx: EventCtx | None) -> bool:
-        """isPodWorthRequeuing (scheduling_queue.go:406): the pod requeues
-        when ANY plugin that rejected it (a) registered for this event kind
-        and (b) — when an object-aware hint and event payload exist — says
-        the event object could actually unblock it."""
-        for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
-            if not (PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY) & event):
-                continue
-            hint = PLUGIN_HINTS.get(pl) if self.use_queueing_hints else None
-            if hint is None or ctx is None or hint(qp, event, ctx):
-                return True
-        return False
-
-    def _worth_or_fit_deferred(self, qp, event, ctx):
-        """Like _worth_requeuing, but returns 'fit' when the ONLY deciding
-        hint is the fit hint — the caller batches those into one vectorized
-        check (a preemption burst scans a 15k-pod pool per POD_DELETE;
-        per-pod Python is ~20% of the measured window)."""
+    def _requeue_verdict(self, qp: QueuedPodInfo, event: Event, ctx: EventCtx | None):
+        """isPodWorthRequeuing (scheduling_queue.go:406), three-valued: the
+        pod requeues when ANY plugin that rejected it (a) registered for
+        this event kind and (b) — when an object-aware hint and event
+        payload exist — says the event object could actually unblock it.
+        Returns True/False, or 'fit' when the only deciding hint is the
+        fit hint with a usable payload — the caller batches those into one
+        vectorized check (a preemption burst scans a 15k-pod pool per
+        POD_DELETE; per-pod Python was ~20% of the measured window)."""
         defer_fit = False
         for pl in qp.unschedulable_plugins or {"NodeResourcesFit"}:
             if not (PLUGIN_REQUEUE_EVENTS.get(pl, Event.ANY) & event):
                 continue
             hint = PLUGIN_HINTS.get(pl) if self.use_queueing_hints else None
-            if hint is None:
+            if hint is None or ctx is None:
                 return True
-            if hint is _fit_hint and qp.delta is not None:
+            if hint is _fit_hint and qp.delta is not None and ctx.max_free is not None:
                 defer_fit = True
                 continue
             if hint(qp, event, ctx):
                 return True
         return "fit" if defer_fit else False
 
+    def _worth_requeuing(self, qp: QueuedPodInfo, event: Event, ctx: EventCtx | None) -> bool:
+        v = self._requeue_verdict(qp, event, ctx)
+        if v == "fit":
+            return _fit_hint(qp, event, ctx)
+        return v
+
     def on_event(self, event: Event, ctx: EventCtx | None = None) -> int:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1029): wake
         unschedulable pods whose rejecting plugins care about this event
         (filtered through the object-aware hints when ``ctx`` is given)."""
         woken = []
-        if ctx is None or ctx.max_free is None:
-            for uid, qp in self._unschedulable.items():
-                if self._worth_requeuing(qp, event, ctx):
-                    woken.append(uid)
-        else:
-            fit_uids: list[str] = []
-            fit_reqs: list[np.ndarray] = []
-            for uid, qp in self._unschedulable.items():
-                verdict = self._worth_or_fit_deferred(qp, event, ctx)
-                if verdict is True:
-                    woken.append(uid)
-                elif verdict == "fit":
-                    fit_uids.append(uid)
-                    fit_reqs.append(qp.delta["req"])
-            if fit_uids:
-                fits = _fits_free(fit_reqs, ctx)
-                woken.extend(uid for uid, ok in zip(fit_uids, fits) if ok)
+        fit_uids: list[str] = []
+        fit_reqs: list[np.ndarray] = []
+        for uid, qp in self._unschedulable.items():
+            verdict = self._requeue_verdict(qp, event, ctx)
+            if verdict is True:
+                woken.append(uid)
+            elif verdict == "fit":
+                fit_uids.append(uid)
+                fit_reqs.append(qp.delta["req"])
+        if fit_uids:
+            fits = _fits_free(fit_reqs, ctx)
+            woken.extend(uid for uid, ok in zip(fit_uids, fits) if ok)
         for uid in woken:
             qp = self._unschedulable.pop(uid)
             self.add_backoff(qp)

@@ -59,6 +59,7 @@ class SchedulerMetrics:
     unschedulable: int = 0
     preemptions: int = 0
     deferred: int = 0  # chunk-conflict deferrals resolved by the strict tail
+    pinned_batches: int = 0  # batches served by the pinned fast path
     batches: int = 0
     device_time_s: float = 0.0
     featurize_time_s: float = 0.0
@@ -886,6 +887,27 @@ class TPUScheduler:
             "version": self.builder.feature_version(),
         }
 
+    @staticmethod
+    def _pin_name(pod: t.Pod) -> str | None:
+        """See engine.features.pin_name (PreFilterResult node-set reduction,
+        schedule_one.go:504).  spec.nodeName pods never reach the queue
+        (they arrive bound)."""
+        from .engine.features import pin_name
+
+        return pin_name(pod)
+
+    def _pin_rows(self, infos: list[QueuedPodInfo]) -> np.ndarray | None:
+        """(batch,) pinned row per pod, or None unless EVERY pod is pinned
+        (-1 rows mean the pin names no live node — immediately infeasible)."""
+        rows = np.full(self.batch_size, -1, np.int32)
+        for i, qp in enumerate(infos):
+            name = self._pin_name(qp.pod)
+            if name is None:
+                return None
+            rec = self.cache.nodes.get(name)
+            rows[i] = rec.row if rec is not None else -1
+        return rows
+
     def _inject_nomrows(self, work: dict, infos: list[QueuedPodInfo]) -> None:
         """Resolve nominated node names to ROW indices at DISPATCH time, not
         featurize time: a remove_node/add_node pair between prefetch and
@@ -921,6 +943,31 @@ class TPUScheduler:
         # them after featurization, before the state flush.
         inv = self._full_inv()
         state = self.builder.state()
+        # Pinned fast path (PreFilterResult node-set reduction): every pod
+        # resolved to one candidate row and no active op needs the domain
+        # tables ⇒ one vmapped own-row evaluation instead of the (K, N)
+        # scan.  Decision-identical (see build_pinned_pass); truncated
+        # (parity) mode keeps the full pass for its processed-node counters.
+        from .engine.pass_ import PINNED_SAFE_OPS
+
+        if not self._truncated and work["active"] <= PINNED_SAFE_OPS:
+            pin_rows = self._pin_rows(infos)
+            if pin_rows is not None:
+                work["batch"]["pin_row"] = pin_rows
+                run = self.passes.get_pinned(
+                    profile, self.builder.schema, self.builder.res_col,
+                    work["active"],
+                )
+                batch_d, inv_d = jax.device_put((work["batch"], inv))
+                new_state, result = run(state, batch_d, inv_d)
+                self._cycle += len(infos)
+                self.metrics.pinned_batches += 1
+                return dict(
+                    work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
+                    new_state=new_state, result=result, t1=t1,
+                    schema=self.builder.schema, chunk=self.chunk_size,
+                    pinned=True,
+                )
         chunk = self.chunk_size
         if chunk > 1 and work["active"] & {
             "PodTopologySpread", "InterPodAffinity", "NodePorts"
@@ -1011,6 +1058,15 @@ class TPUScheduler:
         # vocabularies — a pod's original features only matched the terms
         # interned before it, which is sound solely under batch-order commits.
         deferred = [i for i in range(len(infos)) if picks[i] == -2]
+        if deferred and ctx.get("pinned"):
+            # Pinned same-row overflow mates retry next batch (an earlier
+            # mate's failure may have freed their room; the strict-tail
+            # machinery keys on scan internals the pinned pass lacks).
+            picks = picks.copy()
+            for i in deferred:
+                self.queue.reactivate(infos[i])
+                picks[i] = -3  # handled: neither bind nor failure
+            deferred = []
         # Prefetch featurization of batch k+1 may have GROWN the schema
         # while batch k was in flight; the compiled tail/preemption programs
         # for the old shapes cannot mix with the rebuilt state.  Rare (a
@@ -1318,7 +1374,7 @@ class TPUScheduler:
             rows = {
                 key: [np.asarray(arr)[i] for i, _, _ in failed]
                 for key, arr in batch.items()
-                if key != "valid"
+                if key not in ("valid", "pin_row")
             }
             results = self.preemption.preempt_batch(
                 [qp.pod for _, qp, _ in failed], rows, active, ctx["inv_d"],
