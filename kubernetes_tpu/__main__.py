@@ -144,7 +144,16 @@ def cmd_serve(args) -> int:
 def cmd_bench(args) -> int:
     from .benchmarks.harness import main as bench_main
 
-    bench_main(args.workloads or None)
+    if args.profile_dir:
+        # Device-side visibility (SURVEY §5: "add JAX profiler traces on
+        # the sidecar"): a TensorBoard-loadable XPlane trace of the run.
+        import jax
+
+        with jax.profiler.trace(args.profile_dir):
+            bench_main(args.workloads or None)
+        print(f"jax profiler trace written to {args.profile_dir}")
+    else:
+        bench_main(args.workloads or None)
     return 0
 
 
@@ -158,6 +167,13 @@ def cmd_dump(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import logging
+
+    # Surface the cycle spans (framework/tracing.py LogIfLong) and other
+    # library logs on the CLI; library embedders configure their own.
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
     ap = argparse.ArgumentParser(prog="kubernetes_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -174,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
 
     b = sub.add_parser("bench", help="run benchmark workloads")
     b.add_argument("workloads", nargs="*")
+    b.add_argument("--profile-dir", default="", help="write a jax.profiler trace here")
     b.set_defaults(fn=cmd_bench)
 
     d = sub.add_parser("dump", help="debugger dump of a live sidecar")

@@ -1,0 +1,59 @@
+"""Tracing: the utiltrace analog + the JAX profiler hook.
+
+The reference wraps each scheduling cycle in a poor-man's span trace and
+dumps the step log only when the cycle was slow (schedule_one.go:412
+``utiltrace.New("Scheduling", ...)`` + ``LogIfLong(100ms)``); real OTel
+spans exist in the apiserver/kubelet but not the scheduler.  This module
+is that shape: cheap always-on step timestamps, emitted only past a
+threshold.  For deep device-side visibility the CLI's ``bench
+--profile-dir`` wraps the run in ``jax.profiler.trace`` (SURVEY §5:
+"add JAX profiler traces on the sidecar")."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("kubernetes_tpu")
+
+
+class Trace:
+    """utiltrace.New analog: record (step, t) pairs; log them all iff the
+    total exceeded ``threshold_s`` (LogIfLong)."""
+
+    __slots__ = ("name", "threshold_s", "fields", "_t0", "_steps")
+
+    def __init__(self, name: str, threshold_s: float = 0.1, **fields):
+        self.name = name
+        self.threshold_s = threshold_s
+        self.fields = fields
+        self._t0 = time.perf_counter()
+        self._steps: list[tuple[str, float]] = []
+
+    def step(self, msg: str) -> None:
+        self._steps.append((msg, time.perf_counter()))
+
+    def log_if_long(self, threshold_s: float | None = None) -> bool:
+        """Emit the step log when the span ran long.  Returns whether it
+        logged (the reference logs at V(2) through klog; here the
+        ``kubernetes_tpu`` logger at INFO)."""
+        threshold = self.threshold_s if threshold_s is None else threshold_s
+        total = time.perf_counter() - self._t0
+        if total <= threshold:
+            return False
+        parts = [
+            f'"{self.name}" total={total * 1000:.1f}ms '
+            + " ".join(f"{k}={v}" for k, v in self.fields.items())
+        ]
+        prev = self._t0
+        for msg, ts in self._steps:
+            parts.append(f"  {msg} (+{(ts - prev) * 1000:.1f}ms)")
+            prev = ts
+        logger.info("\n".join(parts))
+        return True
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.log_if_long()

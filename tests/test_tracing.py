@@ -1,0 +1,37 @@
+"""utiltrace analog: cycle spans logged only past the threshold
+(schedule_one.go:412 LogIfLong)."""
+
+import logging
+
+from kubernetes_tpu.framework.tracing import Trace
+
+
+def test_trace_silent_when_fast(caplog):
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+        with Trace("fast", threshold_s=10.0, pods=3) as tr:
+            tr.step("a")
+    assert not caplog.records
+
+
+def test_trace_logs_steps_when_slow(caplog):
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+        tr = Trace("slow", threshold_s=0.0, pods=3)
+        tr.step("featurized")
+        tr.step("dispatched")
+        assert tr.log_if_long()
+    text = caplog.text
+    assert "slow" in text and "pods=3" in text
+    assert "featurized" in text and "dispatched" in text
+
+
+def test_scheduler_batch_emits_span_when_slow(caplog):
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    s = TPUScheduler(batch_size=4)
+    s.trace_threshold_s = 0.0  # everything is "long"
+    s.add_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).obj())
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+        s.schedule_all_pending()
+    assert any("ScheduleBatch" in r.message for r in caplog.records)
