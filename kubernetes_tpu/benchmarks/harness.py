@@ -1051,17 +1051,27 @@ def main_isolated(names: list[str] | None = None) -> list[dict]:
     import subprocess
     import sys as _sys
 
+    from .integrated import INTEGRATED
+
+    known = set(WORKLOADS) | set(INTEGRATED)
     if names:
-        unknown = [n for n in names if n not in WORKLOADS]
+        unknown = [n for n in names if n not in known]
         if unknown:
             raise SystemExit(
-                f"unknown workload(s): {unknown}; available: {sorted(WORKLOADS)}"
+                f"unknown workload(s): {unknown}; available: {sorted(known)}"
             )
-    selected = [n for n in WORKLOADS if not names or n in names]
+    selected = [
+        n for n in list(WORKLOADS) + list(INTEGRATED) if not names or n in names
+    ]
     results = []
     for name in selected:
+        module = (
+            "kubernetes_tpu.benchmarks.integrated"
+            if name in INTEGRATED
+            else "kubernetes_tpu.benchmarks.harness"
+        )
         proc = subprocess.run(
-            [_sys.executable, "-m", "kubernetes_tpu.benchmarks.harness", name],
+            [_sys.executable, "-m", module, name],
             capture_output=True, text=True,
         )
         line = ""

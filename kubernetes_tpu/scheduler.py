@@ -212,8 +212,11 @@ class TPUScheduler:
         # run on a schedule): 0 = disabled.
         self.consistency_check_every = consistency_check_every
         # Prefetched next batch: (infos, featurize work) — schedule_batch
-        # featurizes batch k+1 while the device crunches batch k.
+        # featurizes batch k+1 while the device crunches batch k.  The
+        # speculative sidecar frontend disables this (its batches run
+        # synchronously inside a request; a prefetch would strand pods).
         self._prefetched: tuple | None = None
+        self._prefetch_enabled = True
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -880,7 +883,9 @@ class TPUScheduler:
         # ceiling.  Gated off when the active ops read mutable host
         # catalogs (volume/DRA binds bump the feature version every
         # batch, which would drop the prefetch anyway).
-        if not ctx["active"] & {"VolumeBinding", "DynamicResources"}:
+        if self._prefetch_enabled and not ctx["active"] & {
+            "VolumeBinding", "DynamicResources"
+        }:
             nxt = self.queue.pop_batch(self.batch_size)
             if nxt:
                 # Prefetched gang members still count as "coming" for

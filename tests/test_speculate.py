@@ -1,0 +1,278 @@
+"""Speculative batching frontend (sidecar/speculate.py): the integrated
+one-pod-per-call path answered from batch-computed decisions.
+
+The Go plugin's PreFilter asks for one pod per wire call (the reference's
+serialized ScheduleOne loop, scheduler.go:470).  With PendingPod hints
+streamed ahead, the sidecar schedules whole batches speculatively and
+serves the per-pod calls from cache — these tests pin the cache's hit,
+invalidation, confirmation, and parity behavior."""
+
+import tempfile
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+
+def node(name: str, cpu: str = "8"):
+    return make_node(name).capacity(
+        {"cpu": cpu, "memory": "32Gi", "pods": 110}
+    ).obj()
+
+
+def pod(name: str, cpu: str = "1", priority: int = 0):
+    p = make_pod(name).req({"cpu": cpu})
+    if priority:
+        p = p.priority(priority)
+    return p.obj()
+
+
+def _spec_server(batch_size=8, lookahead=None):
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(
+        path,
+        scheduler=TPUScheduler(batch_size=batch_size),
+        speculate=True,
+        lookahead=lookahead,
+    )
+    srv.serve_background()
+    return srv, SidecarClient(path)
+
+
+def test_hints_turn_per_pod_calls_into_cache_hits():
+    srv, client = _spec_server()
+    try:
+        for i in range(4):
+            client.add("Node", node(f"n{i}"))
+        pods = [pod(f"p{i}") for i in range(8)]
+        for p in pods:
+            client.add("PendingPod", p)
+        # The integrated pattern: one pod per Schedule call, serialized.
+        bound = {}
+        for p in pods:
+            (r,) = client.schedule([p], drain=False)
+            assert r.pod_uid == p.uid
+            assert r.node_name
+            bound[r.pod_uid] = r.node_name
+        stats = client.dump()["speculation"]
+        assert stats["misses"] == 1  # one device batch served all 8 calls
+        assert stats["hits"] == 7
+        assert stats["speculated"] == 7
+        # Capacity respected: 8 one-cpu pods over 4 eight-cpu nodes.
+        per_node = {}
+        for n in bound.values():
+            per_node[n] = per_node.get(n, 0) + 1
+        assert sum(per_node.values()) == 8
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_speculative_decisions_match_drain_batch():
+    """Same arrival order ⇒ the speculative per-pod path and a plain drain
+    batch commit identical assignments (the QueueSort-order contract)."""
+    pods = [pod(f"p{i}", priority=i % 3) for i in range(12)]
+
+    path = tempfile.mktemp(suffix=".sock")
+    plain = SidecarServer(path, scheduler=TPUScheduler(batch_size=16))
+    plain.serve_background()
+    c1 = SidecarClient(path)
+    for i in range(4):
+        c1.add("Node", node(f"n{i}"))
+    want = {r.pod_uid: r.node_name for r in c1.schedule(pods, drain=True)}
+    c1.close()
+    plain.close()
+
+    srv, client = _spec_server(batch_size=16)
+    try:
+        for i in range(4):
+            client.add("Node", node(f"n{i}"))
+        for p in pods:
+            client.add("PendingPod", p)
+        got = {}
+        for p in sorted(pods, key=lambda p: -p.spec.priority):
+            (r,) = client.schedule([p], drain=False)
+            got[r.pod_uid] = r.node_name
+        assert got == want
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_mutation_invalidates_and_rolls_back():
+    srv, client = _spec_server()
+    try:
+        for i in range(2):
+            client.add("Node", node(f"n{i}", cpu="4"))
+        pods = [pod(f"p{i}", cpu="1") for i in range(6)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        # A real cluster mutation: a NEW node appears.
+        client.add("Node", node("n-new", cpu="4"))
+        (r1,) = client.schedule([pods[1]], drain=False)
+        assert r1.node_name
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] >= 1
+        assert stats["rolled_back"] >= 1
+        # Remaining pods still schedule, against the post-mutation state.
+        for p in pods[2:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        dump = client.dump()
+        assert dump["mirror_equal"]
+        # Every pod is bound exactly once; per-node cpu stays within 4.
+        per_node = {}
+        for uid, rec in dump["pods"].items():
+            per_node[rec["node"]] = per_node.get(rec["node"], 0) + 1
+        assert sum(per_node.values()) == 6
+        assert all(c <= 4 for c in per_node.values())
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_bind_confirmation_preserves_cache():
+    srv, client = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        # The host binds the pick and the informer echoes the bound pod —
+        # a confirmation, not a mutation.
+        pods[0].spec.node_name = r0.node_name
+        client.add("Pod", pods[0])
+        (r1,) = client.schedule([pods[1]], drain=False)
+        assert r1.node_name
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] == 0
+        assert stats["hits"] >= 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_node_heartbeat_preserves_cache():
+    srv, client = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        client.schedule([pods[0]], drain=False)
+        client.add("Node", node("n0"))  # status-only re-delivery
+        client.schedule([pods[1]], drain=False)
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] == 0
+        assert stats["hits"] >= 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_pod_delete_drops_undelivered_decision():
+    srv, client = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        client.schedule([pods[0]], drain=False)
+        # p2 is deleted before the host ever asks about it.
+        client.remove("Pod", pods[2].uid)
+        for p in (pods[1], pods[3]):
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        dump = client.dump()
+        assert pods[2].uid not in dump["pods"]
+        assert dump["mirror_equal"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_without_speculation_hints_are_dropped():
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(path, scheduler=TPUScheduler(batch_size=8))
+    srv.serve_background()
+    client = SidecarClient(path)
+    try:
+        client.add("Node", node("n0"))
+        p = pod("p0")
+        client.add("PendingPod", p)  # no-op without the frontend
+        (r,) = client.schedule([p], drain=False)
+        assert r.node_name
+        assert "speculation" not in client.dump()
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_stale_hint_for_scheduled_pod_not_readmitted():
+    """A pod that rode in via a plain informer add AND a hint must not be
+    double-committed when its stale hint is admitted later (review
+    finding: _admit_hints re-checks committed state at admit time)."""
+    from kubernetes_tpu.scheduler import TPUScheduler
+    from kubernetes_tpu.sidecar.speculate import SpeculativeFrontend
+
+    s = TPUScheduler(batch_size=4)
+    f = SpeculativeFrontend(s)
+    s.add_node(node("n0"))
+    p = pod("p0")
+    f.add_hint(p)
+    # The pod gets scheduled through the plain queue path meanwhile.
+    s.add_pod(p)
+    outs = s.schedule_all_pending()
+    assert outs and outs[0].node_name
+    assert p.uid in s.cache.pods
+    # Admitting the stale hint must drop it, not requeue the bound pod.
+    f._admit_hints(10)
+    assert len(s.queue) == 0
+    assert not f.hints
+
+
+def test_uid_fallback_matches_dataclass_default():
+    """Raw pod JSON without metadata.namespace must key the cache under the
+    same uid t.Pod.uid computes ('default/<name>'), or hits become
+    permanent misses and outcomes are lost."""
+    import json
+
+    from kubernetes_tpu.scheduler import TPUScheduler
+    from kubernetes_tpu.sidecar.speculate import SpeculativeFrontend
+
+    s = TPUScheduler(batch_size=4)
+    f = SpeculativeFrontend(s)
+    s.add_node(node("n0"))
+    raw = json.dumps(
+        {"metadata": {"name": "bare"}, "spec": {"requests": {"cpu": "1"}}}
+    ).encode()
+    f.add_hint_raw(raw)
+    (r,) = f.schedule_raw([raw])
+    assert r.node_name
+    assert r.pod.uid == "default/bare"
+
+
+def test_spec_change_invalidates_cached_decision():
+    srv, client = _spec_server()
+    try:
+        client.add("Node", node("n0", cpu="8"))
+        pods = [pod(f"p{i}", cpu="1") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        client.schedule([pods[0]], drain=False)  # batch commits all 4
+        # p2's resources change while its decision is still cached.
+        bigger = pod("p2", cpu="2")
+        client.add("Pod", bigger)
+        (r,) = client.schedule([bigger], drain=False)
+        assert r.node_name
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] >= 1
+        dump = client.dump()
+        assert dump["mirror_equal"]
+        assert len(dump["pods"]) == 4
+    finally:
+        client.close()
+        srv.close()
