@@ -46,13 +46,14 @@ import (
 	"context"
 	"encoding/json"
 	"fmt"
-	"sync"
 
 	v1 "k8s.io/api/core/v1"
+	apierrors "k8s.io/apimachinery/pkg/api/errors"
 	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
 	"k8s.io/apimachinery/pkg/runtime"
 	"k8s.io/apimachinery/pkg/util/sets"
 	"k8s.io/client-go/tools/cache"
+	"k8s.io/klog/v2"
 	"k8s.io/kubernetes/pkg/scheduler/framework"
 )
 
@@ -66,6 +67,11 @@ type Args struct {
 	// path of a unix-domain socket, or "host:port" when Network is "tcp".
 	Socket  string `json:"socket"`
 	Network string `json:"network,omitempty"` // default "unix"
+	// SchedulerName scopes the PendingPod hint stream to pods of this
+	// profile (responsibleForPod, eventhandlers.go:317).  Defaults to
+	// "tpu-batch-score" — set it to the profile's schedulerName when the
+	// profile is registered under a different name.
+	SchedulerName string `json:"schedulerName,omitempty"`
 }
 
 type stateData struct {
@@ -79,9 +85,9 @@ const stateKey = "tpubatchscore/result"
 // Plugin implements PreFilter, Filter, Score, PostFilter and
 // EnqueueExtensions against the sidecar.
 type Plugin struct {
-	handle framework.Handle
-	client *Client
-	mu     sync.Mutex
+	handle      framework.Handle
+	client      *Client
+	profileName string
 }
 
 var (
@@ -109,11 +115,14 @@ func New(_ context.Context, obj runtime.Object, h framework.Handle) (framework.P
 	if args.Network == "" {
 		args.Network = "unix"
 	}
+	if args.SchedulerName == "" {
+		args.SchedulerName = "tpu-batch-score"
+	}
 	client, err := Dial(args.Network, args.Socket)
 	if err != nil {
 		return nil, fmt.Errorf("dialing sidecar %s: %w", args.Socket, err)
 	}
-	p := &Plugin{handle: h, client: client}
+	p := &Plugin{handle: h, client: client, profileName: args.SchedulerName}
 	p.wireInformers(h)
 	return p, nil
 }
@@ -146,37 +155,61 @@ func (p *Plugin) wireInformers(h framework.Handle) {
 			}
 		},
 	})
+	// ONE unfiltered pod handler routing by state.  Not two
+	// FilteringResourceEventHandlers: client-go synthesizes OnDelete(old)
+	// when an update transitions an object OUT of a filter's set, so a
+	// bind (unassigned→assigned) would fire a phantom delete from the
+	// pending-side handler racing the bound-side add — and tombstoned
+	// deletes of unassigned pods would pass neither filter, leaking hints.
+	//
+	//   - ASSIGNED pods upsert the sidecar cache (eventhandlers.go:312
+	//     assignedPod); the bind of OUR pick is a confirmation the
+	//     speculative frontend recognizes (speculate.py note_add).
+	//   - UNASSIGNED pods of this profile stream as PendingPod hints: the
+	//     speculative frontend (sidecar/speculate.py) co-schedules hinted
+	//     pods in one device batch and answers the serialized per-pod
+	//     PreFilter calls from its cache — winning back the batching the
+	//     one-pod-per-cycle loop (scheduler.go:470) otherwise forfeits.
+	//     Hints are dropped server-side unless speculation is enabled, so
+	//     streaming them is safe unconditionally.
+	//   - Deletes (tombstone-aware) always remove by uid; removing a pod
+	//     the sidecar never knew is a no-op there.
 	podInformer := h.SharedInformerFactory().Core().V1().Pods().Informer()
-	podInformer.AddEventHandler(cache.FilteringResourceEventHandler{
-		// Only ASSIGNED pods reach the sidecar cache (the scheduler's own
-		// queue feeds unassigned ones through PreFilter); mirrors
-		// eventhandlers.go:312 assignedPod.
-		FilterFunc: func(obj interface{}) bool {
-			pod, ok := asPod(obj) // tombstoned deletes must pass through
-			return ok && pod.Spec.NodeName != ""
+	podInformer.AddEventHandler(cache.ResourceEventHandlerFuncs{
+		AddFunc: func(obj interface{}) {
+			if pod, ok := obj.(*v1.Pod); ok {
+				p.upsertPod(pod)
+			}
 		},
-		Handler: cache.ResourceEventHandlerFuncs{
-			AddFunc: func(obj interface{}) {
-				if pod, ok := obj.(*v1.Pod); ok {
-					if raw, err := ConvertPod(pod); err == nil {
-						_ = p.client.AddObject("Pod", raw)
-					}
-				}
-			},
-			UpdateFunc: func(_, obj interface{}) {
-				if pod, ok := obj.(*v1.Pod); ok {
-					if raw, err := ConvertPod(pod); err == nil {
-						_ = p.client.AddObject("Pod", raw)
-					}
-				}
-			},
-			DeleteFunc: func(obj interface{}) {
-				if pod, ok := asPod(obj); ok {
-					_ = p.client.RemoveObject("Pod", UIDOf(pod))
-				}
-			},
+		UpdateFunc: func(_, obj interface{}) {
+			if pod, ok := obj.(*v1.Pod); ok {
+				p.upsertPod(pod)
+			}
+		},
+		DeleteFunc: func(obj interface{}) {
+			if pod, ok := asPod(obj); ok {
+				_ = p.client.RemoveObject("Pod", UIDOf(pod))
+			}
 		},
 	})
+}
+
+// upsertPod routes an informer add/update: assigned pods to the cache
+// feed, this profile's pending pods to the speculative hint stream
+// (responsibleForPod, eventhandlers.go:317).
+func (p *Plugin) upsertPod(pod *v1.Pod) {
+	if pod.Spec.NodeName != "" {
+		if raw, err := ConvertPod(pod); err == nil {
+			_ = p.client.AddObject("Pod", raw)
+		}
+		return
+	}
+	if pod.Spec.SchedulerName != p.profileName {
+		return
+	}
+	if raw, err := ConvertPod(pod); err == nil {
+		_ = p.client.AddObject("PendingPod", raw)
+	}
 }
 
 // asNode / asPod unwrap cache.DeletedFinalStateUnknown tombstones —
@@ -213,16 +246,25 @@ func (p *Plugin) PreFilter(ctx context.Context, state *framework.CycleState, pod
 	if err != nil {
 		return nil, framework.AsStatus(err)
 	}
-	p.mu.Lock()
+	// No plugin-level mutex: the Client serializes the wire itself, and the
+	// scheduling loop is one pod at a time anyway (scheduler.go:470).
 	results, err := p.client.Schedule([][]byte{raw}, false)
-	p.mu.Unlock()
 	if err != nil {
 		return nil, framework.AsStatus(err)
 	}
-	if len(results) == 0 {
-		return nil, framework.NewStatus(framework.Error, "sidecar returned no result")
+	// Match by uid, not position: a speculative sidecar answers exactly the
+	// requested pods, but defensive matching costs nothing.
+	idx := -1
+	for i := range results {
+		if results[i].PodUID == UIDOf(pod) {
+			idx = i
+			break
+		}
 	}
-	r := results[0]
+	if idx < 0 {
+		return nil, framework.NewStatus(framework.Error, "sidecar returned no result for pod")
+	}
+	r := results[idx]
 	state.Write(stateKey, &stateData{result: r})
 	if r.NodeName == "" {
 		msg := "sidecar: no feasible node"
@@ -276,17 +318,30 @@ func (p *Plugin) PostFilter(ctx context.Context, state *framework.CycleState, po
 	if sd.result.NominatedNode == "" {
 		return nil, framework.NewStatus(framework.Unschedulable, "sidecar found no preemption candidate")
 	}
+	// Victim deletion mirrors prepareCandidate (preemption.go:342): run the
+	// DELETEs before returning the nomination, on a detached context (the
+	// per-cycle ctx is cancelled the moment PostFilter returns, which would
+	// abort in-flight calls).  A failed delete means the nomination must
+	// NOT be surfaced — the node was never freed; the pod goes back to the
+	// queue via the Unschedulable status and retries on the victims'
+	// eventual events, instead of claiming a node that still holds them.
 	cs := p.handle.ClientSet()
+	var firstErr error
 	for _, ref := range sd.result.VictimNames {
 		ns, name := splitRef(ref)
-		// Deletion must outlive the scheduling cycle: the per-cycle ctx
-		// is cancelled as soon as PostFilter returns, which would abort
-		// the in-flight DELETEs (the reference's prepareCandidate also
-		// detaches its victim deletions from the cycle).
-		go func() {
-			_ = cs.CoreV1().Pods(ns).Delete(
-				context.Background(), name, metav1.DeleteOptions{})
-		}()
+		err := cs.CoreV1().Pods(ns).Delete(
+			context.Background(), name, metav1.DeleteOptions{})
+		if err != nil && !apierrors.IsNotFound(err) {
+			klog.ErrorS(err, "preempting pod: victim delete failed",
+				"victim", ref, "pod", klog.KObj(pod))
+			if firstErr == nil {
+				firstErr = err
+			}
+		}
+	}
+	if firstErr != nil {
+		return nil, framework.NewStatus(framework.Unschedulable,
+			fmt.Sprintf("victim deletion failed: %v", firstErr))
 	}
 	return framework.NewPostFilterResultWithNominatedNode(sd.result.NominatedNode),
 		framework.NewStatus(framework.Success)

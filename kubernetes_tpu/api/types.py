@@ -226,6 +226,33 @@ class TopologySpreadConstraint:
     min_domains: Optional[int] = None
     node_affinity_policy: str = POLICY_HONOR
     node_taints_policy: str = POLICY_IGNORE
+    # matchLabelKeys (gated by MatchLabelKeysInPodTopologySpread): the
+    # pod's values for these keys merge into the effective selector, so
+    # spreading counts only pods of the same rollout generation
+    # (podtopologyspread/filtering.go mergeLabelSetWithSelector).
+    match_label_keys: tuple[str, ...] = ()
+
+
+def spread_effective_selector(
+    c: "TopologySpreadConstraint", pod_labels
+) -> Optional[LabelSelector]:
+    """The constraint's selector with matchLabelKeys merged in: each listed
+    key present on the pod adds an exact-match requirement with the pod's
+    value; absent keys are skipped (filtering.go — requirements are built
+    from the pod's own label set).  Shared by the engine featurizer and
+    the scalar test oracle so both sides compute one semantics."""
+    if not c.match_label_keys:
+        return c.label_selector
+    extra = tuple(
+        (k, pod_labels[k]) for k in c.match_label_keys if k in pod_labels
+    )
+    if not extra:
+        return c.label_selector
+    base = c.label_selector or LabelSelector()
+    return LabelSelector(
+        match_labels=base.match_labels + extra,
+        match_expressions=base.match_expressions,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -255,6 +255,7 @@ class PodWrapper:
         min_domains: Optional[int] = None,
         node_affinity_policy: str = t.POLICY_HONOR,
         node_taints_policy: str = t.POLICY_IGNORE,
+        match_label_keys: tuple[str, ...] = (),
     ) -> "PodWrapper":
         self._pod.spec.topology_spread_constraints += (
             t.TopologySpreadConstraint(
@@ -269,6 +270,7 @@ class PodWrapper:
                 min_domains=min_domains,
                 node_affinity_policy=node_affinity_policy,
                 node_taints_policy=node_taints_policy,
+                match_label_keys=tuple(match_label_keys),
             ),
         )
         return self

@@ -3,10 +3,9 @@ featuregate): named runtime behavior switches with per-gate defaults,
 settable from the versioned config's ``featureGates`` map.
 
 The reference carries 118 gates; this build registers the scheduler-relevant
-subset.  Gates marked "wired" change behavior; the others are accepted and
-validated (so upstream configs parse) but their on-state is the only one
-this build implements — setting one to a non-default value is an error
-rather than a silent no-op."""
+subset, and every registered gate is WIRED — both states change behavior.
+A gate added here unwired (validate-only) must reject its non-default value
+rather than silently no-op."""
 
 from __future__ import annotations
 
@@ -17,12 +16,19 @@ from dataclasses import dataclass, field
 #       off = the reference's pre-hint behavior: static event masks only).
 #   DynamicResourceAllocation — the DynamicResources plugin may appear in
 #       profiles (plugins/registry.go:49 gates registration).
+#   NodeInclusionPolicyInPodTopologySpread — off: PTS ignores the pod's
+#       nodeAffinityPolicy/nodeTaintsPolicy and uses the legacy fixed
+#       policy (honor affinity, ignore taints) — ops/podtopologyspread.py.
+#   MatchLabelKeysInPodTopologySpread — off: constraint matchLabelKeys are
+#       ignored instead of merged into the effective selector.
+#   PodSchedulingReadiness — off: .spec.schedulingGates is ignored (the
+#       SchedulingGates plugin is simply not registered) — queue.py.
 KNOWN_GATES: dict[str, tuple[bool, bool]] = {
     "SchedulerQueueingHints": (True, True),
     "DynamicResourceAllocation": (True, True),
-    "NodeInclusionPolicyInPodTopologySpread": (True, False),
-    "MatchLabelKeysInPodTopologySpread": (True, False),
-    "PodSchedulingReadiness": (True, False),  # scheduling gates
+    "NodeInclusionPolicyInPodTopologySpread": (True, True),
+    "MatchLabelKeysInPodTopologySpread": (True, True),
+    "PodSchedulingReadiness": (True, True),
 }
 
 

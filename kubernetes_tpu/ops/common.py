@@ -45,6 +45,16 @@ class FeaturizeContext:
     def schema(self) -> Schema:
         return self.builder.schema
 
+    @property
+    def gates(self):
+        """Feature gates (the plfeature.Features analog): stamped on the
+        builder by the scheduler; defaults when driving the builder bare."""
+        if self.builder.feature_gates is not None:
+            return self.builder.feature_gates
+        from ..framework.features import DEFAULT_GATES
+
+        return DEFAULT_GATES
+
 
 @dataclass(frozen=True)
 class PassContext:

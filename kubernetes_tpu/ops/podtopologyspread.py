@@ -80,17 +80,28 @@ def _constraint_feats(
     honor_taint = np.zeros(cdim, np.bool_)
     valid = np.zeros(cdim, np.bool_)
     masks = np.zeros((cdim, builder.schema.G), np.bool_)
+    # Gates (plfeature.Features analog): inclusion policies fall back to
+    # the legacy fixed policy (honor affinity, ignore taints) when
+    # NodeInclusionPolicyInPodTopologySpread is off; matchLabelKeys is
+    # ignored when MatchLabelKeysInPodTopologySpread is off.
+    incl = fctx.gates.enabled("NodeInclusionPolicyInPodTopologySpread")
+    mlk = fctx.gates.enabled("MatchLabelKeysInPodTopologySpread")
     for i, c in enumerate(constraints):
         slot = builder.ensure_topo_key(c.topology_key)
         valid[i] = True
         slots[i] = slot
         skew[i] = c.max_skew
         mindom[i] = c.min_domains or 1
-        selfm[i] = t.label_selector_matches(c.label_selector, pod.metadata.labels)
+        sel = (
+            t.spread_effective_selector(c, pod.metadata.labels)
+            if mlk
+            else c.label_selector
+        )
+        selfm[i] = t.label_selector_matches(sel, pod.metadata.labels)
         hostname[i] = c.topology_key == HOSTNAME_KEY
-        honor_aff[i] = c.node_affinity_policy == t.POLICY_HONOR
-        honor_taint[i] = c.node_taints_policy == t.POLICY_HONOR
-        m = builder.group_index.match_selector(c.label_selector, {ns_id})
+        honor_aff[i] = (c.node_affinity_policy == t.POLICY_HONOR) if incl else True
+        honor_taint[i] = (c.node_taints_policy == t.POLICY_HONOR) if incl else False
+        m = builder.group_index.match_selector(sel, {ns_id})
         masks[i, : m.shape[0]] = m
     return {
         f"{prefix}_valid": valid,

@@ -181,6 +181,10 @@ class SchedulingQueue:
         # use the static per-plugin event masks alone (the reference's
         # pre-hint behavior); object-aware PLUGIN_HINTS are skipped.
         self.use_queueing_hints = True
+        # PodSchedulingReadiness gate: off ⇒ the SchedulingGates plugin is
+        # not registered (plugins/registry.go), so .spec.schedulingGates is
+        # ignored and gated pods enter the queue like any other.
+        self.respect_scheduling_gates = True
 
     def __len__(self) -> int:
         return len(self._in_active)
@@ -248,7 +252,7 @@ class SchedulingQueue:
         qp.pod = pod
         # PreEnqueue: SchedulingGates holds gated pods out of every queue
         # (plugins/schedulinggates/scheduling_gates.go).
-        if pod.spec.scheduling_gates:
+        if self.respect_scheduling_gates and pod.spec.scheduling_gates:
             qp.gated = True
             self._gated[pod.uid] = qp
             return

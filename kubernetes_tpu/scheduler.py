@@ -146,6 +146,12 @@ class TPUScheduler:
         self.queue.use_queueing_hints = self.feature_gates.enabled(
             "SchedulerQueueingHints"
         )
+        self.queue.respect_scheduling_gates = self.feature_gates.enabled(
+            "PodSchedulingReadiness"
+        )
+        # Featurizers read gates via FeaturizeContext.gates (the
+        # plfeature.Features snapshot, plugins/registry.go:49).
+        self.builder.feature_gates = self.feature_gates
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None

@@ -164,10 +164,15 @@ class SpeculativeFrontend:
                     # decision.  A spec/label change makes it stale —
                     # invalidate so the recompute sees the new object; an
                     # identical re-delivery (watch relist) changes nothing.
+                    # Compare modulo the binding the commit stamped on our
+                    # copy (spec.node_name) — the re-delivered object is
+                    # unassigned by definition of this branch.
+                    import dataclasses
+
                     old = out.pod
-                    if (
-                        old.metadata.labels != obj.metadata.labels
-                        or old.spec != obj.spec
+                    if old.metadata.labels != obj.metadata.labels or (
+                        dataclasses.replace(old.spec, node_name=None)
+                        != dataclasses.replace(obj.spec, node_name=None)
                     ):
                         self.invalidate()
                         self.add_hint(obj)
@@ -195,6 +200,15 @@ class SpeculativeFrontend:
         self.invalidate()
 
     def note_remove(self, kind: str, uid: str) -> None:
+        if kind == "Pod" and not (
+            uid in self.cached
+            or uid in self.delivered
+            or uid in self.sched.cache.pods
+        ):
+            # The pod touches nothing committed (a hint, or a pod parked in
+            # the queue): dropping it cannot stale any cached decision.
+            self.hints.pop(uid, None)
+            return
         # Unwind first (invalidate returns cached pods to the hint pool),
         # THEN forget the deleted pod everywhere — so a pod deleted with an
         # undelivered decision doesn't resurrect as a hint.
@@ -214,8 +228,12 @@ class SpeculativeFrontend:
         for uid, out in self.cached.items():
             if out.node_name:
                 # Assumed+finalized in the mirror: remove cleanly (resource
-                # delta, gang credit, DRA reservations all unwind).
+                # delta, gang credit, DRA reservations all unwind).  The
+                # commit path stamped spec.node_name on the pod object —
+                # scrub it, or re-admission would take the bound-pod path
+                # and re-bind to the old node with no re-filtering.
                 self.sched.delete_pod(uid, notify=False)
+                out.pod.spec.node_name = None
                 self.stats.rolled_back += 1
             elif out.nominated_node:
                 # Undelivered nomination: release the claim on the freed

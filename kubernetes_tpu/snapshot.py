@@ -236,6 +236,11 @@ class SnapshotBuilder:
         # set_namespace_labels (bumps ns_epoch for the featurization cache).
         self.namespace_labels: dict[str, dict[str, str]] = {}
         self.ns_epoch = 0
+        # Feature gates snapshot (plugins/registry.go:49 snapshots gates
+        # into plfeature.Features for plugin constructors); the scheduler
+        # stamps its gates here so featurizers see them via
+        # FeaturizeContext.gates.  None → defaults.
+        self.feature_gates = None
         self.term_index = TermIndex(
             self.interns, self.group_index, self.namespace_labels
         )

@@ -235,13 +235,30 @@ def _spread_pair_counts(cons, pod, nodes, pods_on) -> dict:
     return out
 
 
+def _spread_with_mlk(pod, cons):
+    """matchLabelKeys → effective selector (mergeLabelSetWithSelector),
+    via the same shared helper the engine featurizer uses."""
+    import dataclasses
+
+    return [
+        dataclasses.replace(
+            c,
+            label_selector=t.spread_effective_selector(
+                c, pod.metadata.labels
+            ),
+            match_label_keys=(),
+        )
+        for c in cons
+    ]
+
+
 def spread_filter(pod, nodes, pods_on: dict) -> dict[str, bool]:
     """PodTopologySpread Filter for every node (filtering.go:283)."""
-    cons = [
+    cons = _spread_with_mlk(pod, [
         c
         for c in pod.spec.topology_spread_constraints
         if c.when_unsatisfiable == t.DO_NOT_SCHEDULE
-    ]
+    ])
     if not cons:
         return {n.name: True for n in nodes}
     pair = _spread_pair_counts(cons, pod, nodes, pods_on)
@@ -268,11 +285,11 @@ def spread_filter(pod, nodes, pods_on: dict) -> dict[str, bool]:
 def spread_score(pod, nodes, pods_on: dict, feasible: dict[str, bool]) -> dict[str, int]:
     """PodTopologySpread Score + NormalizeScore over feasible nodes
     (scoring.go).  Returns the final normalized per-node scores."""
-    cons = [
+    cons = _spread_with_mlk(pod, [
         c
         for c in pod.spec.topology_spread_constraints
         if c.when_unsatisfiable == t.SCHEDULE_ANYWAY
-    ]
+    ])
     if not cons:
         return {n.name: 0 for n in nodes}
     keys = [c.topology_key for c in cons]

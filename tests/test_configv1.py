@@ -213,11 +213,13 @@ def test_feature_gates_parse_and_validate():
     assert not errs and not gates.enabled("SchedulerQueueingHints")
     _, errs = parse_feature_gates({"NoSuchGate": True})
     assert errs and "unknown" in errs[0]
-    # Unwired gates only accept their default state.
-    _, errs = parse_feature_gates(
+    # Every registered gate is wired (r4): the off-state parses and takes
+    # effect (behavior pinned in test_feature_gates_wired.py).
+    gates2, errs = parse_feature_gates(
         {"NodeInclusionPolicyInPodTopologySpread": False}
     )
-    assert errs and "only implements" in errs[0]
+    assert not errs
+    assert not gates2.enabled("NodeInclusionPolicyInPodTopologySpread")
 
 
 def test_dra_gate_off_strips_plugin_and_rejects_explicit():

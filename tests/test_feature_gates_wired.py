@@ -1,0 +1,129 @@
+"""Wired feature gates (framework/features.py): both states of every
+registered gate change behavior, mirroring the reference's gate checks
+(pkg/features/kube_features.go; plugins snapshot them via
+plugins/feature/feature.go).
+
+Covered here: MatchLabelKeysInPodTopologySpread (selector merge on/off),
+NodeInclusionPolicyInPodTopologySpread (legacy fixed policy when off),
+PodSchedulingReadiness (schedulingGates ignored when off).  The two gates
+wired in earlier rounds (SchedulerQueueingHints, DynamicResourceAllocation)
+are covered by test_queue/test_dra."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.engine.features import build_pod_batch
+from kubernetes_tpu.framework.features import FeatureGates
+from kubernetes_tpu.scheduler import TPUScheduler
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def gates(**overrides) -> FeatureGates:
+    return FeatureGates(tuple(overrides.items()))
+
+
+def _mlk_cluster(fg: FeatureGates) -> TPUScheduler:
+    """Two nodes; two old-generation pods (gen=1) bound on n0; the new pod
+    (gen=2) spreads on hostname with matchLabelKeys=[gen]."""
+    s = TPUScheduler(batch_size=4, feature_gates=fg)
+    for name in ("n0", "n1"):
+        s.add_node(
+            make_node(name).capacity({"cpu": "8", "memory": "32Gi", "pods": 10}).obj()
+        )
+    for i in range(2):
+        s.add_pod(
+            make_pod(f"old-{i}")
+            .label("app", "web").label("gen", "1")
+            .req({"cpu": "1"})
+            .node("n0")
+            .obj()
+        )
+    return s
+
+
+def _mlk_pod():
+    return (
+        make_pod("new")
+        .label("app", "web").label("gen", "2")
+        .req({"cpu": "1"})
+        .spread_constraint(
+            1, HOSTNAME, t.DO_NOT_SCHEDULE, "app", ["web"],
+            match_label_keys=("gen",),
+        )
+        .obj()
+    )
+
+
+def test_match_label_keys_on_excludes_other_generations():
+    s = _mlk_cluster(gates())
+    s.add_pod(_mlk_pod())
+    (out,) = s.schedule_all_pending()
+    # gen=1 pods don't count against the gen=2 rollout: both nodes feasible.
+    assert out.node_name
+    assert out.feasible_nodes == 2
+
+
+def test_match_label_keys_off_counts_all_matching_pods():
+    s = _mlk_cluster(gates(MatchLabelKeysInPodTopologySpread=False))
+    s.add_pod(_mlk_pod())
+    (out,) = s.schedule_all_pending()
+    # The two app=web pods on n0 count: only n1 keeps skew within 1.
+    assert out.node_name == "n1"
+    assert out.feasible_nodes == 1
+
+
+def test_inclusion_policy_gate_off_forces_legacy_policy():
+    """Gate off ⇒ nodeTaintsPolicy=Honor is ignored (legacy: taints
+    ignored) and nodeAffinityPolicy=Ignore is ignored (legacy: honored).
+    Asserted at the featurization seam the compiled pass consumes."""
+    pod = (
+        make_pod("p")
+        .label("app", "web")
+        .req({"cpu": "1"})
+        .spread_constraint(
+            1, HOSTNAME, t.DO_NOT_SCHEDULE, "app", ["web"],
+            node_affinity_policy=t.POLICY_IGNORE,
+            node_taints_policy=t.POLICY_HONOR,
+        )
+        .obj()
+    )
+    for fg, want_aff, want_taint in (
+        (gates(), False, True),  # wired on: pod's policies respected
+        (gates(NodeInclusionPolicyInPodTopologySpread=False), True, False),
+    ):
+        s = TPUScheduler(batch_size=2, feature_gates=fg)
+        s.add_node(
+            make_node("n0").capacity({"cpu": "8", "memory": "32Gi", "pods": 10}).obj()
+        )
+        batch, _deltas, active = build_pod_batch(
+            [pod], s.builder, s.profile, 2
+        )
+        assert "PodTopologySpread" in active
+        assert bool(np.asarray(batch["tps_h_aff"])[0, 0]) is want_aff
+        assert bool(np.asarray(batch["tps_h_taint"])[0, 0]) is want_taint
+
+
+def test_pod_scheduling_readiness_off_ignores_gates():
+    s = TPUScheduler(
+        batch_size=2, feature_gates=gates(PodSchedulingReadiness=False)
+    )
+    s.add_node(
+        make_node("n0").capacity({"cpu": "8", "memory": "32Gi", "pods": 10}).obj()
+    )
+    s.add_pod(
+        make_pod("gated").req({"cpu": "1"}).scheduling_gate("example.com/hold").obj()
+    )
+    (out,) = s.schedule_all_pending()
+    assert out.node_name  # scheduled despite the gate
+
+    # Control: with the gate on (default) the pod parks.
+    s2 = TPUScheduler(batch_size=2)
+    s2.add_node(
+        make_node("n0").capacity({"cpu": "8", "memory": "32Gi", "pods": 10}).obj()
+    )
+    s2.add_pod(
+        make_pod("gated").req({"cpu": "1"}).scheduling_gate("example.com/hold").obj()
+    )
+    assert s2.schedule_all_pending() == []

@@ -276,3 +276,79 @@ def test_spec_change_invalidates_cached_decision():
     finally:
         client.close()
         srv.close()
+
+
+def test_invalidation_rollback_refilters_not_rebinds():
+    """Rolled-back pods must re-enter as UNASSIGNED (review finding: the
+    commit stamps spec.node_name on the cached pod; a stale stamp would
+    re-bind without filtering and double-commit resources)."""
+    srv, client = _spec_server(batch_size=4)
+    try:
+        client.add("Node", node("n0", cpu="4"))
+        pods = [pod(f"p{i}", cpu="1") for i in range(3)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)  # commits all 3
+        assert r0.node_name == "node-n0" or r0.node_name  # placed
+        # Any non-Pod mutation invalidates (PDB here); undelivered p1/p2
+        # roll back and must re-filter on the recompute.
+        from kubernetes_tpu.api import types as t
+
+        client.add(
+            "PodDisruptionBudget",
+            t.PodDisruptionBudget(name="pdb"),
+        )
+        for p in pods[1:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        # No double-commit: a 4th 1-cpu pod still fits the 4-cpu node.
+        p3 = pod("p3", cpu="1")
+        (r3,) = client.schedule([p3], drain=False)
+        assert r3.node_name
+        dump = client.dump()
+        assert len(dump["pods"]) == 4
+        assert dump["mirror_equal"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_unassigned_relist_of_cached_pod_is_noop():
+    """An identical unassigned re-delivery (watch relist) of a pod with a
+    committed decision must not invalidate (the comparison ignores the
+    node_name the commit stamped on the sidecar's copy)."""
+    srv, client = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        client.schedule([pods[0]], drain=False)  # commits all 4
+        client.add("Pod", pod("p2"))  # relist: identical, unassigned
+        client.schedule([pods[1]], drain=False)
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] == 0
+        assert stats["hits"] >= 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_delete_of_plain_hint_keeps_cache():
+    """Deleting a pod known only as a hint must not discard the decision
+    cache (review finding: note_remove over-invalidation)."""
+    srv, client = _spec_server(batch_size=4, lookahead=3)
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(6)]
+        for p in pods:
+            client.add("PendingPod", p)
+        client.schedule([pods[0]], drain=False)  # admits 4, two hints left
+        client.remove("Pod", pods[5].uid)  # still a pure hint
+        client.schedule([pods[1]], drain=False)
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] == 0
+        assert stats["hits"] >= 1
+    finally:
+        client.close()
+        srv.close()
