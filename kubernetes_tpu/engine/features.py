@@ -47,9 +47,7 @@ def pin_name(pod: t.Pod):
     """The single node a pod's own constraints reduce its candidate set to,
     or None: a required node affinity of exactly one term with one
     metadata.name In [one value] matchFields (nodeaffinity.go PreFilter's
-    PreFilterResult.NodeNames).  Doubles as the featurize-cache skip: a
-    name-pinned pod's spec is unique by construction (distinct pin names),
-    so key hashing + store lookups are pure overhead for them."""
+    PreFilterResult.NodeNames)."""
     aff = pod.spec.affinity
     na = aff.node_affinity if aff else None
     if na is not None and na.required is not None and len(na.required.terms) == 1:
@@ -86,6 +84,45 @@ def _sig(o):
     return (cls.__qualname__,) + tuple(_sig(getattr(o, n)) for n in flds)
 
 
+_PODSPEC_FIELDS: tuple[str, ...] = ()
+
+
+def _spec_eq_mod_pin(a: t.PodSpec, b: t.PodSpec) -> bool:
+    """Structural equality of two PIN-SHAPED pod specs modulo the pinned
+    node name (both already passed pin_name, so the affinity shape is
+    exactly one required term with one single-value matchField).  Direct
+    field comparison — no tree hashing: for the daemonset template this is
+    ~20 mostly-None comparisons, an order of magnitude cheaper than a
+    canonical signature walk."""
+    global _PODSPEC_FIELDS
+    if not _PODSPEC_FIELDS:
+        # node_name excluded: pods are always UNASSIGNED when featurized,
+        # but a stored template's spec mutates at bind (the in-place
+        # spec.node_name write) — comparing it would kill every later hit.
+        _PODSPEC_FIELDS = tuple(
+            f.name
+            for f in dataclasses.fields(t.PodSpec)
+            if f.name not in ("affinity", "node_name")
+        )
+    for name in _PODSPEC_FIELDS:
+        if getattr(a, name) != getattr(b, name):
+            return False
+    aa, bb = a.affinity, b.affinity
+    if (
+        aa.pod_affinity != bb.pod_affinity
+        or aa.pod_anti_affinity != bb.pod_anti_affinity
+    ):
+        return False
+    na, nb = aa.node_affinity, bb.node_affinity
+    if na.preferred != nb.preferred:
+        return False
+    ta, tb = na.required.terms[0], nb.required.terms[0]
+    if ta.match_expressions != tb.match_expressions:
+        return False
+    ma, mb = ta.match_fields[0], tb.match_fields[0]
+    return ma.key == mb.key and ma.operator == mb.operator
+
+
 def build_pod_batch(
     pods: list[t.Pod],
     builder: SnapshotBuilder,
@@ -107,15 +144,72 @@ def build_pod_batch(
     all_ops = [opcommon.get(name) for name in dict.fromkeys(
         list(profile.filters) + [s for s, _ in profile.scorers]
     )]
+    # Cache keys first (memoized on the pod object — hashing the spec tree
+    # is ~half of featurize cost; a pod's spec/labels only change by
+    # arriving as a NEW object on the informer path; bind's in-place
+    # spec.node_name write happens after the pod's last featurization).
+    # NAME-PINNED pods (the daemonset shape — thousands of pods differing
+    # only in the matchFields node name) skip signatures entirely: they
+    # match against pin TEMPLATES by direct field comparison, and a hit
+    # stamps only the interned pin id (see the template block below).
+    # Pinned pods whose NodeAffinity featurize would take the general path
+    # (addedAffinity / preferred terms embed the name id in program
+    # tensors a patch can't reach) are featurized per pod, uncached.
+    templatable = profile.added_affinity is None
+    keys: list = []
+    pins: list = []
+    for pod in pods:
+        memo = getattr(pod, "_featsig", None)
+        if memo is not None and memo[0] == profile.name:
+            keys.append(memo[1])
+            pins.append(None)
+            continue
+        pin = pin_name(pod)
+        if pin is not None:
+            keys.append(None)
+            pins.append(
+                pin
+                if templatable and not pod.spec.affinity.node_affinity.preferred
+                else None
+            )
+            continue
+        key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
+        pod._featsig = (profile.name, key)
+        keys.append(key)
+        pins.append(None)
     if force_active is not None:
         # Rebuild for the strict tail: the pass is already compiled for this
         # op set; features must match it exactly.
         ops = [op for op in all_ops if op.name in force_active]
     else:
+        # is_active reads only (labels, spec) and builder catalogs, so one
+        # REPRESENTATIVE per distinct key/template suffices — template
+        # workloads collapse 4096 predicate scans to a handful (the
+        # O(ops × pods) inactive-op scan was a measured featurize cost).
+        seen: dict = {}
+        pin_reps: list = []
+        pin_buckets: dict = {}  # (ns, labels-items) → candidate reps
+        for pod, key, pin in zip(pods, keys, pins):
+            if key is not None:
+                seen.setdefault(key, pod)
+            elif pin is not None:
+                bkey = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
+                bucket = pin_buckets.setdefault(bkey, [])
+                # Spec-distinct pods within a bucket are rare; past the cap
+                # just take every pod as a rep (the pre-optimization
+                # behavior — only extra is_active calls, never wrong).
+                if len(bucket) > 16 or not any(
+                    _spec_eq_mod_pin(pod.spec, rep.spec) for rep in bucket
+                ):
+                    bucket.append(pod)
+                    pin_reps.append(pod)
+            else:
+                pin_reps.append(pod)  # unique-featurized pinned pod
+        reps = list(seen.values()) + pin_reps
         ops = [
             op
             for op in all_ops
-            if op.is_active is None or any(op.is_active(p, fctx) for p in pods)
+            if op.is_active is None or any(op.is_active(p, fctx) for p in reps)
         ]
     active = frozenset(op.name for op in ops)
     fctx.active = active
@@ -131,25 +225,42 @@ def build_pod_batch(
     # ordering invariant.
     version = (builder.feature_version(), profile, active)
     if builder.feat_cache is None or builder.feat_cache[0] != version:
-        builder.feat_cache = (version, {})
+        builder.feat_cache = (version, {}, [])
     store = builder.feat_cache[1]
-    for pod in pods:
-        # The signature is memoized on the pod object: hashing the spec tree
-        # is ~half of featurize cost for unique-spec workloads (daemonset's
-        # per-node name affinity), and a pod's spec/labels only change by
-        # arriving as a NEW object on the informer path (update_pod) — the
-        # one in-place mutation, bind's spec.node_name write, happens after
-        # the pod's last featurization.
-        key = getattr(pod, "_featsig", None)
-        if key is None and pin_name(pod) is None:
-            key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
-            pod._featsig = key
-        hit = store.get(key) if key is not None else None
-        if hit is not None:
-            feats, delta = dict(hit[0]), dict(hit[1])
-            deltas.append(delta)
-            per_pod.append(feats)
-            continue
+    # Pin templates: (ns, labels, spec, feats, delta) per distinct pinned
+    # template, living beside the key store under the same version token.
+    templates = builder.feat_cache[2]
+    for pod, key, pin in zip(pods, keys, pins):
+        if key is not None:
+            hit = store.get(key)
+            if hit is not None:
+                deltas.append(dict(hit[1]))
+                per_pod.append(dict(hit[0]))
+                continue
+        elif pin is not None:
+            tmpl = None
+            for cand in templates:
+                if (
+                    pod.namespace == cand[0]
+                    and pod.metadata.labels == cand[1]
+                    and _spec_eq_mod_pin(pod.spec, cand[2])
+                ):
+                    tmpl = cand
+                    break
+            if tmpl is not None:
+                feats = dict(tmpl[3])
+                # The ONLY pin-dependent feature is the interned name id
+                # (the NodeAffinity pin fast path's (1,1,1) value tensor).
+                # Present only when NodeAffinity is in the profile — a
+                # NodeAffinity-less profile still pins via the host-side
+                # pin_row, and its dicts must stay homogeneous.
+                if "na_req_vals" in feats:
+                    vals = np.empty((1, 1, 1), np.int32)
+                    vals[0, 0, 0] = fctx.interns.node_names.id(pin)
+                    feats["na_req_vals"] = vals
+                deltas.append(dict(tmpl[4]))
+                per_pod.append(feats)
+                continue
         delta = builder.pod_delta_vectors(pod)
         deltas.append(delta)
         # Host ports are base commit features: the scan's _commit and the host
@@ -235,11 +346,17 @@ def build_pod_batch(
         if v2 != version:  # this pod grew a vocabulary — new cache generation
             version = v2
             store = {}
-            builder.feat_cache = (version, store)
-        elif key is not None:  # pinned pods (key None) skip the store only
+            templates = []
+            builder.feat_cache = (version, store, templates)
+        elif key is not None:
             if len(store) > 8192:
                 store.clear()
             store[key] = (dict(feats), dict(delta))
+        elif pin is not None and len(templates) < 8:
+            templates.append(
+                (pod.namespace, dict(pod.metadata.labels), pod.spec,
+                 dict(feats), dict(delta))
+            )
 
     if not per_pod:
         raise ValueError("empty pod batch")

@@ -263,7 +263,7 @@ class SnapshotBuilder:
         self.res_col: dict[str, int] = {r: i for i, r in enumerate(FIXED_RESOURCES)}
         # Featurization cache (engine/features.py): version token → per-pod
         # feature/delta entries valid only while no vocabulary/schema grows.
-        self.feat_cache: tuple[tuple, dict] | None = None
+        self.feat_cache: tuple[tuple, dict, list] | None = None
 
     # -- capacity management -------------------------------------------------
 

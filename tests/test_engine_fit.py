@@ -188,3 +188,32 @@ def test_host_device_mirror_consistency():
         s.add_pod(make_pod(f"p{i}").req({"cpu": "500m", "memory": "256Mi"}).obj())
     s.schedule_all_pending()
     assert s.builder.host_mirror_equal()
+
+
+def test_pinned_template_without_nodeaffinity_op():
+    """Name-pinned pods under a profile WITHOUT NodeAffinity (pin enforced
+    by the host-side pin_row, not the filter): template hits must not
+    inject na_req_vals into dicts that never had it (review finding —
+    heterogeneous dicts crash the stack step)."""
+    from kubernetes_tpu.framework.config import fit_only_profile
+
+    s = TPUScheduler(profile=fit_only_profile(), batch_size=8)
+    for i in range(8):
+        s.add_node(
+            make_node(f"node-{i}").capacity(
+                {"cpu": "8", "memory": "32Gi", "pods": 10}
+            ).obj()
+        )
+    for i in range(8):
+        s.add_pod(
+            make_pod(f"ds-{i}")
+            .req({"cpu": "1"})
+            .node_name_affinity(f"node-{i}")
+            .obj()
+        )
+    outs = s.schedule_all_pending()
+    assert len(outs) == 8
+    # NodeAffinity is not in the profile, so the pin is enforced by the
+    # pinned pass itself.
+    for o in outs:
+        assert o.node_name == f"node-{o.pod.name.split('-')[1]}"
