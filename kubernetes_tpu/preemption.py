@@ -224,51 +224,70 @@ def build_preempt_pass(
 
         demand = pf["req"]  # (R,)
 
-        def ok_under(mask):
-            """Full feasibility of the preemptor with ``mask`` removed:
-            closed-form fit + the release-dependent filter set against the
-            released state (exact candidacy — a node whose sole failure is
-            a victim's port or anti-affinity pair is still found)."""
-            rel_m = jnp.sum(jnp.where(mask[:, :, None], vic_req, 0), axis=1)
+        def ok_closed(rel_m, cnt):
+            """Closed-form fit of the preemptor given released resources
+            ``rel_m`` (N, R) and removed-pod count ``cnt`` (N,)."""
             free = state.alloc - (state.req - rel_m)
             ok = ((demand[None, :] == 0) | (demand[None, :] <= free)).all(-1)
-            ok &= (
-                state.num_pods - mask.sum(axis=1).astype(jnp.int32) + 1
-                <= state.allowed_pods
-            )
-            if search_ops:
-                st2 = released(mask)
-                if needs_dom:
-                    from .engine.pass_ import build_dom
+            ok &= state.num_pods - cnt + 1 <= state.allowed_pods
+            return ok
 
-                    dom0 = dctx.dom
-                    dom2 = build_dom(st2, dom0.et_slot, dom0.et_host, schema.DV)
-                    d2 = dataclasses.replace(dctx, dom=dom2)
-                else:
-                    d2 = dctx
-                for op in search_ops:
-                    ok &= op.filter(st2, pf, d2)
+        def ok_search(mask):
+            """The release-dependent filter set against the released state
+            (exact candidacy — a node whose sole failure is a victim's
+            port or anti-affinity pair is still found)."""
+            st2 = released(mask)
+            if needs_dom:
+                from .engine.pass_ import build_dom
+
+                dom0 = dctx.dom
+                dom2 = build_dom(st2, dom0.et_slot, dom0.et_host, schema.DV)
+                d2 = dataclasses.replace(dctx, dom=dom2)
+            else:
+                d2 = dctx
+            ok = jnp.ones(state.valid.shape, jnp.bool_)
+            for op in search_ops:
+                ok &= op.filter(st2, pf, d2)
             return ok
 
         # Phase 1 — all lower-priority pods removed: the candidacy check
         # (SelectVictimsOnNode's initial RemovePod sweep).
-        feas_all = ok_under(lower)
+        rel_lower = jnp.sum(jnp.where(lower[:, :, None], vic_req, 0), axis=1)
+        cnt_lower = lower.sum(axis=1).astype(jnp.int32)
+        feas_all = ok_closed(rel_lower, cnt_lower)
+        if search_ops:
+            feas_all &= ok_search(lower)
 
         # Phase 2 — greedy reprieve, most-important-first = reverse slot
         # order (slots are least-important-first, PDB-violating last, so
         # violating victims get their reprieve attempt first — exactly
         # filterPodsWithPDBViolation + the two reprieve loops).  Nodes
         # failing an unsimulated-resolvable op skip reprieve entirely.
+        # The release sums ride the carry INCREMENTALLY — each step
+        # adjusts (N, R) by one slot instead of re-reducing (N, V, R)
+        # (the O(V) full evaluations were the preemption-async device
+        # ceiling; search ops still pay their full what-if per step).
         can_reprieve = feas_all & ~res_fail
 
-        def reprieve_step(mask, s):
+        def reprieve_step(carry, s):
+            mask, rel_m, cnt = carry
+            has = mask[:, s]
+            t_rel = rel_m - jnp.where(has[:, None], vic_req[:, s], 0)
+            t_cnt = cnt - has.astype(jnp.int32)
+            ok = ok_closed(t_rel, t_cnt)
             tentative = mask & ~(jnp.arange(v)[None, :] == s)
-            ok = ok_under(tentative)
-            take = can_reprieve & ok & mask[:, s]
-            return jnp.where(take[:, None], tentative, mask), None
+            if search_ops:
+                ok &= ok_search(tentative)
+            take = can_reprieve & ok & has
+            mask = jnp.where(take[:, None], tentative, mask)
+            rel_m = jnp.where(take[:, None], t_rel, rel_m)
+            cnt = jnp.where(take, t_cnt, cnt)
+            return (mask, rel_m, cnt), None
 
-        vic_mask, _ = lax.scan(
-            reprieve_step, lower, jnp.arange(v - 1, -1, -1)
+        (vic_mask, rel_all, _cnt_final), _ = lax.scan(
+            reprieve_step,
+            (lower, rel_lower, cnt_lower),
+            jnp.arange(v - 1, -1, -1),
         )
 
         n_vic = vic_mask.sum(axis=1).astype(jnp.int32)
@@ -308,7 +327,8 @@ def build_preempt_pass(
             jnp.isfinite(min_start), -min_start * 1e6, -jnp.float64(2**61)
         ).astype(jnp.int64)
 
-        rel_all = jnp.sum(jnp.where(vic_mask[:, :, None], vic_req, 0), axis=1)
+        # rel_all rode the reprieve carry; only the nonzero companion needs
+        # its (single) masked reduce.
         relnz_all = jnp.sum(
             jnp.where(vic_mask[:, :, None], vic_nonzero, 0), axis=1
         )
@@ -439,7 +459,14 @@ def build_preempt_pass(
         # ok_under when an affinity/spread op is active.
         from .engine.pass_ import build_dom
 
-        dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        # Domain tables only when an active op reads them (XLA would DCE
+        # the dead matmuls anyway, but the explicit gate keeps the trace —
+        # and the compile — small for the fit-only shape).
+        dom = (
+            build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+            if needs_dom
+            else None
+        )
         dctx = dataclasses.replace(ctx, dom=dom)
         k = next(iter(batch.values())).shape[0]
         assert k % chunk == 0, f"preempt batch {k} not a multiple of {chunk}"
@@ -468,6 +495,26 @@ class PreemptionEvaluator:
     def __init__(self, scheduler) -> None:
         self.sched = scheduler
         self._cache: dict = {}
+        # Sticky hint from the driver: recent batches produced failures, so
+        # the next batch prepacks victim tensors concurrently with its
+        # device pass (scheduler._batch_traced).
+        self.expect_failures = False
+
+    def worth_prepacking(self, pods) -> bool:
+        """Cheap eligibility precheck before a speculative pack: packing is
+        pure waste when NO pod in the batch could ever have victims (the
+        perma-stuck Unschedulable-workload shape, whose failures would
+        otherwise keep expect_failures — and the packing walk — on every
+        batch).  Mirrors preempt_batch's min-priority prune."""
+        cache = self.sched.cache
+        if not cache.pods:
+            return False
+        min_prio = min(pr.pod.spec.priority for pr in cache.pods.values())
+        return any(
+            p.spec.priority > min_prio
+            and p.spec.preemption_policy != t.PREEMPT_NEVER
+            for p in pods
+        )
 
     def _pass(
         self, profile, active: frozenset[str] | None, n_pdbs: int, chunk: int
@@ -485,61 +532,18 @@ class PreemptionEvaluator:
             self._cache[key] = fn
         return fn
 
-    def preempt_batch(
-        self,
-        pods: list[t.Pod],
-        batch_rows: dict,
-        active: frozenset[str] | None = None,
-        inv: dict | None = None,
-        profile=None,
-        candidate_filter=None,
-    ) -> list[PreemptionResult | None]:
-        """Run preemption for the failed pods of one scheduling batch.
-        ``batch_rows`` are each pod's already-built feature dict rows.
-
-        ``candidate_filter(pod, node_name, victims) -> bool`` vetoes a
-        chosen candidate BEFORE its victims are deleted — the extender
-        ProcessPreemption hook (preemption.go:249 callExtenders).  The
-        reference consults extenders over the full candidate list before
-        selection; the batched engine selects first and filters the one
-        chosen candidate (divergence documented in extender.py)."""
+    def pack_victims(self, profile, active: frozenset[str] | None) -> dict:
+        """Build (and ship to device) the per-node victim tensors for one
+        dry-run — separable from preempt_batch so the driver can OVERLAP
+        packing + transfer with the failing batch's device pass
+        (_batch_traced prepacks when recent batches produced failures).
+        Packed from the CURRENT cache state: prepacking therefore sees the
+        pre-batch snapshot, i.e. same-batch placements are not victim
+        candidates — the reference's dry-run runs on the cycle snapshot
+        the same way (DryRunPreemption, preemption.go:541)."""
         sched = self.sched
-        profile = profile or sched.profile
         cache, builder = sched.cache, sched.builder
         schema = builder.schema
-
-        # Cheap host-side prunes: (a) a pod whose demand exceeds every
-        # node's allocatable can never be helped by deletion; (b) a pod
-        # whose priority doesn't exceed the LOWEST bound-pod priority has
-        # no victims anywhere.  Both prevent repacking victim tensors for
-        # perma-stuck pods every batch (the Unschedulable-workload shape).
-        max_alloc = builder.host["alloc"].max(axis=0)
-        max_allowed = int(builder.host["allowed_pods"].max(initial=0))
-        min_prio = min(
-            (pr.pod.spec.priority for pr in cache.pods.values()), default=None
-        )
-
-        batch_req = batch_rows.get("req")
-
-        def can_ever_fit(i: int, p: t.Pod) -> bool:
-            if batch_req is not None:
-                req = np.asarray(batch_req[i])  # already featurized this batch
-            else:
-                pr = cache.pods.get(p.uid)
-                delta = pr.delta if pr else builder.pod_delta_vectors(p)
-                req = delta["req"]
-            return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
-
-        eligible = [
-            p.spec.preemption_policy != t.PREEMPT_NEVER
-            and min_prio is not None
-            and p.spec.priority > min_prio
-            and can_ever_fit(i, p)
-            for i, p in enumerate(pods)
-        ]
-        if not any(eligible):
-            return [None] * len(pods)
-
         # PDBs: per-victim matched budgets.  A victim is "violating" when it
         # matches a PDB with no disruptions left; such pods sort LAST in the
         # eviction order (the reference reprieves violating victims first —
@@ -647,6 +651,85 @@ class PreemptionEvaluator:
                         vfeat["port_triples"][row, j, a] = triple
                         vfeat["port_keys"][row, j, a] = pk
 
+        d_prio, d_vic_req, d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed = (
+            jax.device_put(
+                (vic_prio, vic_req, vic_nonzero, vic_start, vfeat, vic_pdb,
+                 pdb_allowed)
+            )
+        )
+        return dict(
+            profile=profile, active=active, pdbs=pdbs, n_pdbs=n_pdbs,
+            matched_pdbs=matched_pdbs, per_node=per_node,
+            d_prio=d_prio, d_vic_req=d_vic_req, d_vic_nonzero=d_vic_nonzero,
+            d_vic_start=d_vic_start, d_vfeat=d_vfeat, d_pdb=d_pdb,
+            d_allowed=d_allowed,
+        )
+
+    def preempt_batch(
+        self,
+        pods: list[t.Pod],
+        batch_rows: dict,
+        active: frozenset[str] | None = None,
+        inv: dict | None = None,
+        profile=None,
+        candidate_filter=None,
+        prepacked: dict | None = None,
+    ) -> list[PreemptionResult | None]:
+        """Run preemption for the failed pods of one scheduling batch.
+        ``batch_rows`` are each pod's already-built feature dict rows.
+
+        ``candidate_filter(pod, node_name, victims) -> bool`` vetoes a
+        chosen candidate BEFORE its victims are deleted — the extender
+        ProcessPreemption hook (preemption.go:249 callExtenders).  The
+        reference consults extenders over the full candidate list before
+        selection; the batched engine selects first and filters the one
+        chosen candidate (divergence documented in extender.py)."""
+        sched = self.sched
+        profile = profile or sched.profile
+        cache, builder = sched.cache, sched.builder
+        schema = builder.schema
+
+        # Cheap host-side prunes: (a) a pod whose demand exceeds every
+        # node's allocatable can never be helped by deletion; (b) a pod
+        # whose priority doesn't exceed the LOWEST bound-pod priority has
+        # no victims anywhere.  Both prevent repacking victim tensors for
+        # perma-stuck pods every batch (the Unschedulable-workload shape).
+        max_alloc = builder.host["alloc"].max(axis=0)
+        max_allowed = int(builder.host["allowed_pods"].max(initial=0))
+        min_prio = min(
+            (pr.pod.spec.priority for pr in cache.pods.values()), default=None
+        )
+
+        batch_req = batch_rows.get("req")
+
+        def can_ever_fit(i: int, p: t.Pod) -> bool:
+            if batch_req is not None:
+                req = np.asarray(batch_req[i])  # already featurized this batch
+            else:
+                pr = cache.pods.get(p.uid)
+                delta = pr.delta if pr else builder.pod_delta_vectors(p)
+                req = delta["req"]
+            return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
+
+        eligible = [
+            p.spec.preemption_policy != t.PREEMPT_NEVER
+            and min_prio is not None
+            and p.spec.priority > min_prio
+            and can_ever_fit(i, p)
+            for i, p in enumerate(pods)
+        ]
+        if not any(eligible):
+            return [None] * len(pods)
+
+        pack = prepacked
+        if (
+            pack is None
+            or pack["profile"] is not profile
+            or pack["active"] != active
+        ):
+            pack = self.pack_victims(profile, active)
+        pdbs, n_pdbs = pack["pdbs"], pack["n_pdbs"]
+        matched_pdbs, per_node = pack["matched_pdbs"], pack["per_node"]
         # Stack the failed pods' feature rows into a (K, …) batch; mark
         # ineligible rows invalid so their step is a no-op.  K is always the
         # scheduler's batch size (failed ⊆ batch): ONE compiled shape, so a
@@ -663,13 +746,18 @@ class PreemptionEvaluator:
         batch["valid"][: len(pods)] = eligible
         # Chunk-sharing signature: pods with the same featurize-cache key
         # have identical dry-runs and may split one evaluation's node
-        # ranking (build_preempt_pass step).
+        # ranking (build_preempt_pass step).  Reuse the memoized featurize
+        # signature — these pods were just featurized by the failing batch.
         from .engine.features import _sig
 
         sig_first: dict = {}
         sigs = np.zeros(k, np.int32)
         for i, p in enumerate(pods):
-            key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
+            memo = getattr(p, "_featsig", None)
+            if memo is not None and memo[0] == profile.name:
+                key_ = memo[1]
+            else:
+                key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
             sigs[i] = sig_first.setdefault(key_, i)
         batch["sig"] = sigs
 
@@ -678,23 +766,29 @@ class PreemptionEvaluator:
         state = builder.state()
         # Chunk like the scheduling pass (same dispatch-overhead economics);
         # the scheduler's chunk_size governs strict (parity) mode too.
-        chunk = min(self.sched.chunk_size if self.sched.chunk_size > 1 else 1, 64)
+        # A batch whose eligible preemptors ALL share one signature (the
+        # async-preemption shape: N identical VIPs) runs as ONE step — the
+        # rank-split assigns the 1st..Nth best nodes from a single dry-run,
+        # so 16 chunked re-evaluations collapse to one (the chunked-mode
+        # approximation is the same either way; chunk boundaries only
+        # change where the ranking refreshes).
+        if self.sched.chunk_size > 1 and len(sig_first) == 1:
+            chunk = k
+        else:
+            chunk = min(
+                self.sched.chunk_size if self.sched.chunk_size > 1 else 1, 64
+            )
         chunk = max(1, min(chunk, k))
         while k % chunk:
             chunk //= 2
-        # ONE coalesced host→device transfer for every input (per-array
-        # device_put costs a full tunnel round trip when the device is busy;
-        # already-on-device leaves — e.g. the scheduler's inv — pass through).
-        (
-            batch_d, inv_d, d_prio, d_vic_req, d_vic_nonzero, d_vic_start,
-            d_vfeat, d_pdb, d_allowed,
-        ) = jax.device_put(
-            (batch, inv, vic_prio, vic_req, vic_nonzero, vic_start, vfeat,
-             vic_pdb, pdb_allowed)
-        )
+        # ONE coalesced host→device transfer for the per-call inputs (the
+        # victim tensors were shipped by pack_victims, possibly overlapped
+        # with the failing batch's device pass).
+        batch_d, inv_d = jax.device_put((batch, inv))
         out, _final_state, _final_prio = self._pass(profile, active, n_pdbs, chunk)(
-            state, batch_d, inv_d, d_prio, d_vic_req,
-            d_vic_nonzero, d_vic_start, d_vfeat, d_pdb, d_allowed,
+            state, batch_d, inv_d, pack["d_prio"], pack["d_vic_req"],
+            pack["d_vic_nonzero"], pack["d_vic_start"], pack["d_vfeat"],
+            pack["d_pdb"], pack["d_allowed"],
         )
         picks, vmasks = device_fetch((out.picks, out.vic_mask))
         # Chunk-deferred preemptors (same-node collisions, heterogeneous

@@ -126,6 +126,8 @@ PLUGIN_HINTS = {
     "NodeResourcesFit": _fit_hint,
 }
 
+_MISS = object()  # verdict-cache sentinel (None is not a verdict)
+
 
 @dataclass(order=False)
 class QueuedPodInfo:
@@ -141,6 +143,10 @@ class QueuedPodInfo:
     # vector etc.) — the object-aware hints read it; None before the first
     # attempt or after a spec update invalidated it.
     delta: dict | None = None
+    # A nominated-pin evaluation failed for this pod: its next attempt
+    # takes the full pass (the scheduler's _pin_rows skips it).  Reset when
+    # a fresh nomination is recorded.
+    nom_pin_failed: bool = False
 
 
 class SchedulingQueue:
@@ -439,8 +445,30 @@ class SchedulingQueue:
         woken = []
         fit_uids: list[str] = []
         fit_reqs: list[np.ndarray] = []
+        # The verdict depends only on (rejecting plugins, delta presence)
+        # as long as every registered hint is the BATCHED fit hint — one
+        # computation per distinct class instead of per pod (a preemption
+        # burst scans a 15k-pod pool per POD_DELETE; the per-pod verdict
+        # walk was ~15% of the preemption-async measured window).
+        vcache: dict | None = (
+            {}
+            if all(h is _fit_hint for h in PLUGIN_HINTS.values())
+            else None
+        )
         for uid, qp in self._unschedulable.items():
-            verdict = self._requeue_verdict(qp, event, ctx)
+            if vcache is not None:
+                ck = (
+                    frozenset(qp.unschedulable_plugins)
+                    if qp.unschedulable_plugins
+                    else None,
+                    qp.delta is None,
+                )
+                verdict = vcache.get(ck, _MISS)
+                if verdict is _MISS:
+                    verdict = self._requeue_verdict(qp, event, ctx)
+                    vcache[ck] = verdict
+            else:
+                verdict = self._requeue_verdict(qp, event, ctx)
             if verdict is True:
                 woken.append(uid)
             elif verdict == "fit":

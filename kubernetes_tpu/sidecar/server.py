@@ -70,6 +70,10 @@ class SidecarServer:
     ):
         self.path = path
         self.scheduler = scheduler or TPUScheduler(**kw)
+        # Wire deployments hand nominations back to the host (it owns the
+        # victims' API deletes); the in-process inline commit would act on
+        # them sidecar-side and desync the two views.
+        self.scheduler.inline_preempt_commit = False
         self._thread: threading.Thread | None = None
         # Speculative batching frontend (speculate.py): PendingPod hints +
         # a decision cache let the one-pod-per-call integrated path keep

@@ -380,3 +380,21 @@ def test_pdb_budget_simulation_in_violation_classification():
     # y was violating (budget claimed by x) -> reprieved first -> survives.
     assert "default/y" in s.cache.pods
     assert "default/x" not in s.cache.pods
+
+
+def test_inline_commit_spends_stale_nomination():
+    """A pod committing inline must pop its nominator claim (review
+    finding: a bound pod would otherwise hold a phantom claim forever)."""
+    s = TPUScheduler(batch_size=4, chunk_size=2)
+    assert s.inline_preempt_commit
+    s.add_node(make_node("n0").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("bg").req({"cpu": "4"}).priority(1).obj())
+    s.schedule_all_pending()
+    vip = make_pod("vip").req({"cpu": "2"}).priority(100).obj()
+    # Seed a stale nomination claim as if an earlier nominate round ran.
+    s.nominator[vip.uid] = ("n0", {"req": __import__("numpy").zeros(4, "int64")}, 100)
+    s.add_pod(vip)
+    outs = s.schedule_all_pending(wait_backoff=True)
+    ok = [o for o in outs if o.pod.uid == vip.uid and o.node_name]
+    assert ok, outs
+    assert vip.uid not in s.nominator
