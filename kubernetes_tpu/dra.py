@@ -29,10 +29,64 @@ class ClaimCatalog:
     # (node, device_class) → devices consumed by allocated claims.
     allocated: dict[tuple[str, str], int] = field(default_factory=dict)
     epoch: int = 0  # featurization cache token
+    # External-allocation row charges (see add_claim): claims whose phantom
+    # reservation is applied to a node row, and those waiting for their
+    # node to appear (the claim-before-node informer race — the same one
+    # add_node replays CSINode/ResourceSlices for).
+    row_charged: dict[str, tuple[str, str, int]] = field(default_factory=dict)
+    pending_external: dict[str, tuple[str, str, int]] = field(default_factory=dict)
 
-    def add_claim(self, claim: t.ResourceClaim) -> None:
+    def add_claim(
+        self, claim: t.ResourceClaim
+    ) -> list[tuple[str, str, int, int]]:
+        """Upsert a claim (informer).  Returns row-charge deltas
+        [(node, class, count, ±1)] for EXTERNAL allocation changes — an
+        allocation written by another scheduler (or a restart replay)
+        consumes devices the moment it arrives, exactly as the reference's
+        claim assume-cache sees it.  The charge rides a PHANTOM
+        reservation (SnapshotBuilder.apply_external_claim) so a local pod
+        later reserving the same claim cannot double-charge.
+
+        Assume-cache semantics (the reference accepts only informer
+        objects newer than its assumed version): an upsert that would
+        DE-allocate a claim with live local reservations is a stale watch
+        echo of the pre-allocation object and is dropped; an upsert whose
+        allocation matches the current record replaces the object without
+        touching accounting (local reservations carry over)."""
+        old = self.claims.get(claim.uid)
+        if old is not None:
+            if old.reserved_for and not claim.allocated_node:
+                return []  # stale echo: local truth wins until released
+            # Local reservations survive the object replacement.
+            merged = tuple(dict.fromkeys(old.reserved_for + claim.reserved_for))
+            claim.reserved_for = merged
+        old_alloc = (
+            (old.allocated_node, old.device_class, old.count)
+            if old is not None and old.allocated_node
+            else None
+        )
+        new_alloc = (
+            (claim.allocated_node, claim.device_class, claim.count)
+            if claim.allocated_node
+            else None
+        )
+        deltas: list[tuple[str, str, int, int]] = []
+        if old_alloc != new_alloc:
+            if old_alloc is not None:
+                node, cls, cnt = old_alloc
+                self.allocated[(node, cls)] = (
+                    self.allocated.get((node, cls), 0) - cnt
+                )
+                deltas.append((node, cls, cnt, -1))
+            if new_alloc is not None:
+                node, cls, cnt = new_alloc
+                self.allocated[(node, cls)] = (
+                    self.allocated.get((node, cls), 0) + cnt
+                )
+                deltas.append((node, cls, cnt, +1))
         self.claims[claim.uid] = claim
         self.epoch += 1
+        return deltas
 
     def add_slice(self, s: t.ResourceSlice) -> None:
         key = (s.node_name, s.device_class)
@@ -96,10 +150,14 @@ class ClaimCatalog:
         if undo:
             self.epoch += 1
 
-    def release_pod(self, pod_uid: str) -> None:
+    def release_pod(self, pod_uid: str) -> list[tuple[str, str, str, int]]:
         """Drop the pod's reservations; deallocate claims nobody reserves
-        (the resourceclaim controller's cleanup, in-process)."""
+        (the resourceclaim controller's cleanup, in-process).  Returns row
+        discharges [(uid, node, class, count)] for deallocated claims whose
+        charge was EXTERNAL (row_charged) — locally-charged claims
+        discharge through the removing pod's own delta transition."""
         changed = False
+        discharges: list[tuple[str, str, str, int]] = []
         for claim in self.claims.values():
             if pod_uid in claim.reserved_for:
                 claim.reserved_for = tuple(
@@ -111,6 +169,14 @@ class ClaimCatalog:
                     self.allocated[key] = (
                         self.allocated.get(key, 0) - claim.count
                     )
+                    charged = self.row_charged.pop(claim.uid, None)
+                    self.pending_external.pop(claim.uid, None)
+                    if charged is not None:
+                        discharges.append(
+                            (claim.uid, claim.allocated_node,
+                             claim.device_class, claim.count)
+                        )
                     claim.allocated_node = ""
         if changed:
             self.epoch += 1
+        return discharges

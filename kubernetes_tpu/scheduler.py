@@ -288,6 +288,14 @@ class TPUScheduler:
         for (nname, cls) in self.builder.dra.slices:
             if nname == node.name:
                 self.builder.set_dra_cap(self.cache.row_of(node.name), nname, cls)
+        cat = self.builder.dra
+        for uid, (nname, cls, cnt) in list(cat.pending_external.items()):
+            if nname == node.name:
+                del cat.pending_external[uid]
+                self.builder.apply_external_claim(
+                    self.cache.row_of(node.name), uid, cls, cnt, +1
+                )
+                cat.row_charged[uid] = (nname, cls, cnt)
         self.queue.on_event(
             Event.NODE_ADD, self._free_ctx({self.cache.row_of(node.name)})
         )
@@ -322,6 +330,14 @@ class TPUScheduler:
             self.queue.on_event(ev, self._free_ctx({old.row}))
 
     def remove_node(self, name: str) -> None:
+        # Externally-charged claims on the vanishing node: the row is
+        # cleared wholesale, so re-park their charges as pending (a
+        # returning node replays them, like slices/CSINode).
+        cat = self.builder.dra
+        for uid, (nname, cls, cnt) in list(cat.row_charged.items()):
+            if nname == name:
+                del cat.row_charged[uid]
+                cat.pending_external[uid] = (nname, cls, cnt)
         # Bound gang members vanish with the node; their quorum credit must
         # go with them (same invariant as delete_pod).
         rec = self.cache.nodes.get(name)
@@ -468,8 +484,12 @@ class TPUScheduler:
         self._drop_permit_waiters({uid})
         self.nominator.pop(uid, None)
         # DRA: drop the pod's claim reservations; claims nobody reserves
-        # deallocate (the resourceclaim controller's cleanup).
-        self.builder.dra.release_pod(uid)
+        # deallocate (the resourceclaim controller's cleanup).  Externally-
+        # charged claims discharge their phantom row reservation here.
+        for cuid, node_name, cls, cnt in self.builder.dra.release_pod(uid):
+            nrec = self.cache.nodes.get(node_name)
+            if nrec is not None:
+                self.builder.apply_external_claim(nrec.row, cuid, cls, cnt, -1)
         rec = self.cache.pods.get(uid)
         if rec is not None:
             # A bound gang member leaving drops its gang below quorum for
@@ -522,8 +542,29 @@ class TPUScheduler:
         self.queue.on_event(Event.PVC_ADD)
 
     def add_resource_claim(self, claim: t.ResourceClaim) -> None:
-        """ResourceClaim informer (DRA)."""
-        self.builder.dra.add_claim(claim)
+        """ResourceClaim informer (DRA).  Externally-allocated claims
+        charge their node's device row immediately as phantom reservations
+        (the claim assume-cache sees status.allocation; without this an
+        informer-delivered allocated claim would leave the node's devices
+        looking free).  Charges for nodes not yet cached park in
+        pending_external — add_node replays them, like CSINode/slices."""
+        cat = self.builder.dra
+        uid = claim.uid
+        for node_name, cls, cnt, sign in cat.add_claim(claim):
+            rec = self.cache.nodes.get(node_name)
+            if sign > 0:
+                if rec is None:
+                    cat.pending_external[uid] = (node_name, cls, cnt)
+                else:
+                    self.builder.apply_external_claim(rec.row, uid, cls, cnt, +1)
+                    cat.row_charged[uid] = (node_name, cls, cnt)
+            else:
+                if cat.pending_external.pop(uid, None) is None:
+                    charged = cat.row_charged.pop(uid, None)
+                    if charged is not None and rec is not None:
+                        self.builder.apply_external_claim(
+                            rec.row, uid, cls, cnt, -1
+                        )
         self.queue.on_event(Event.CLAIM_ADD)
 
     def add_resource_slice(self, s: t.ResourceSlice) -> None:

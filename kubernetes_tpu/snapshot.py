@@ -361,6 +361,25 @@ class SnapshotBuilder:
         )
         self._dirty_rows.add(row)
 
+    def apply_external_claim(
+        self, row: int, claim_uid: str, device_class: str, cnt: int, sign: int
+    ) -> None:
+        """Charge/release an EXTERNALLY-allocated claim on a node row as a
+        PHANTOM reservation: it rides the same per-claim 0↔1 transition
+        accounting local reservations use (apply_pod_delta / the in-scan
+        commit), so a local pod reserving the same claim sees prev ≥ 1 and
+        cannot double-charge the devices — and its later removal (a 2→1
+        transition) cannot discharge them either."""
+        kid = self.interns.dra_claims.id(claim_uid)
+        cid = self.interns.device_classes.id(device_class)
+        self._ensure(CLM=kid + 1, DC=cid + 1)
+        h = self.host
+        prev = h["dra_claim_counts"][kid, row]
+        h["dra_claim_counts"][kid, row] = prev + sign
+        if (sign > 0 and prev == 0) or (sign < 0 and prev == 1):
+            h["dra_alloc"][cid, row] += sign * cnt
+        self._dirty_rows.add(row)
+
     def set_csinode_limits(self, row: int, csinode) -> None:
         """Apply CSINode.spec.drivers allocatable counts to a node row
         (nodevolumelimits/csi.go reads CSINode for the attach limit)."""

@@ -43,11 +43,14 @@ def main_default(n_nodes: int = 1000, n_pending: int = 1200) -> dict:
 
     from oracle_full import FullOracleScheduler, build_fixture
 
-    nodes, bound, pending, pdbs = build_fixture(n_nodes, n_pending)
+    nodes, bound, pending, pdbs, objs = build_fixture(n_nodes, n_pending, volumes=True)
     prof = replace(
         registered_subset(DEFAULT_PROFILE), percentage_of_nodes_to_score=None
     )
     sched = TPUScheduler(profile=prof, batch_size=128, chunk_size=1)
+    # One deterministic requeue alignment for the A/B: volume/DRA-active
+    # batches gate prefetch off anyway (see oracle_full.run docstring).
+    sched._prefetch_enabled = False
     path = tempfile.mktemp(suffix=".sock")
     srv = SidecarServer(path, scheduler=sched)
     srv.serve_background()
@@ -55,6 +58,20 @@ def main_default(n_nodes: int = 1000, n_pending: int = 1200) -> dict:
     try:
         for n in nodes:
             client.add("Node", n)
+        # The full host-state surface crosses the WIRE too: storage
+        # classes, PVs, PVCs, CSINode limits, DRA slices/claims.
+        for sc in objs["classes"]:
+            client.add("StorageClass", sc)
+        for pv in objs["pvs"]:
+            client.add("PersistentVolume", pv)
+        for pvc in objs["pvcs"]:
+            client.add("PersistentVolumeClaim", pvc)
+        for cn in objs["csinodes"]:
+            client.add("CSINode", cn)
+        for sl in objs["slices"]:
+            client.add("ResourceSlice", sl)
+        for cl in objs["dclaims"]:
+            client.add("ResourceClaim", cl)
         for p in bound:
             client.add("Pod", p)
         for pdb in pdbs:
@@ -77,14 +94,26 @@ def main_default(n_nodes: int = 1000, n_pending: int = 1200) -> dict:
         client.close()
         srv.close()
 
+    from reference_impl import RefClaims, RefVolumes
+
     oracle = FullOracleScheduler(
         nodes, pct=None, seed=prof.tie_break_seed,
         hard_pod_affinity_weight=prof.hard_pod_affinity_weight,
         batch_size=128, pdbs=[copy.deepcopy(p) for p in pdbs],
+        vols=RefVolumes(
+            pvs=copy.deepcopy(objs["pvs"]),
+            pvcs=copy.deepcopy(objs["pvcs"]),
+            classes=copy.deepcopy(objs["classes"]),
+            csinodes=copy.deepcopy(objs["csinodes"]),
+        ),
+        claims=RefClaims(
+            claims=copy.deepcopy(objs["dclaims"]),
+            slices=copy.deepcopy(objs["slices"]),
+        ),
     )
     for p in bound:
         oracle.add_bound(copy.deepcopy(p))
-    want = oracle.run([copy.deepcopy(p) for p in pending])
+    want = oracle.run([copy.deepcopy(p) for p in pending], prefetch=False)
     want_bind = {d.pod.uid: d.node for d in want if d.node}
     want_nom = {d.pod.uid: d.nominated for d in want if d.nominated}
     want_vic = {d.pod.uid: tuple(sorted(d.victims)) for d in want if d.victims}

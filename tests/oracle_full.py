@@ -8,10 +8,14 @@ Used by tests/test_parity.py (in-process) and scripts/parity_ab.py (over
 the sidecar wire) for the bit-identical-bindings A/B the north star
 requires (schedule_one.go:411–920, preemption.go:148–470).
 
-Scope: the default profile's compute plugins (unschedulable/name/taints/
-node-affinity/ports/fit/spread/inter-pod-affinity + all five scorers).
-Volume/DRA/gates are exercised by their own suites; fixtures here carry no
-such objects, so those plugins are inactive on both sides."""
+Scope (r4): the FULL default profile — the compute plugins (unschedulable/
+name/taints/node-affinity/ports/fit/spread/inter-pod-affinity + all five
+scorers) AND the host-state plugins: VolumeBinding (bound PV affinity,
+WFFC candidate/provisioner topology, PreBind binding with smallest-fitting
+PV), VolumeZone, VolumeRestrictions (device conflicts + RWOP),
+NodeVolumeLimits (CSI attach limits), DynamicResources (counted devices,
+delayed allocation), and SchedulingGates (gated pods never enter the
+queue).  build_fixture carries the objects that make them ACTIVE."""
 
 from __future__ import annotations
 
@@ -23,8 +27,12 @@ from kubernetes_tpu.api import types as t
 
 from reference_impl import (
     MAX_NODE_SCORE,
+    RefClaims,
     RefNodeState,
+    RefVolumes,
     balanced_allocation_score,
+    dra_commit,
+    dra_filter,
     fit_score,
     fits_request,
     ipa_filter,
@@ -32,10 +40,15 @@ from reference_impl import (
     node_affinity_filter,
     node_affinity_score_raw,
     node_ports_filter,
+    node_volume_limits_filter,
     spread_filter,
     spread_score,
     taint_toleration_filter,
     taint_toleration_score_raw,
+    volume_binding_filter,
+    volume_commit,
+    volume_restrictions_filter,
+    volume_zone_filter,
 )
 from test_parity import hash_u32, interleave_zones, num_feasible_nodes_to_find
 
@@ -80,6 +93,8 @@ class FullOracleScheduler:
         batch_size: int = 128,
         ns_labels: dict[str, dict[str, str]] | None = None,
         pdbs: list[t.PodDisruptionBudget] | None = None,
+        vols: RefVolumes | None = None,
+        claims: RefClaims | None = None,
     ):
         self.nodes = list(nodes)  # row order = insertion order
         self.states = {n.name: RefNodeState(node=n) for n in nodes}
@@ -102,15 +117,27 @@ class FullOracleScheduler:
         # Nominator overlay: uid → (node, pod) — freed capacity a preemptor
         # claimed; other pods' fit checks count it (framework.go:973).
         self.nominator: dict[str, tuple[str, t.Pod]] = {}
+        self.vols = vols or RefVolumes()
+        self.claims = claims or RefClaims()
+        self.pvc_users: dict[str, int] = {}
+        self.gated: list[t.Pod] = []
 
     # -- cluster mutation (bound pods) --------------------------------------
 
     def add_bound(self, pod: t.Pod) -> None:
         self.states[pod.spec.node_name].pods.append(pod)
+        for pvc in self.vols.pod_pvcs(pod):
+            if pvc is not None:
+                self.pvc_users[pvc.uid] = self.pvc_users.get(pvc.uid, 0) + 1
 
     # -- queue --------------------------------------------------------------
 
     def add(self, pod: t.Pod, nominated: str | None = None) -> None:
+        if pod.spec.scheduling_gates:
+            # PreEnqueue: SchedulingGates parks gated pods out of every
+            # queue (schedulinggates/scheduling_gates.go).
+            self.gated.append(pod)
+            return
         q = self._info.get(pod.uid)
         if q is None:
             q = _Queued(pod=pod)
@@ -172,6 +199,14 @@ class FullOracleScheduler:
                     st2 = RefNodeState(node=n, pods=st.pods + overlay)
                     ok = not fits_request(pod, st2)
             ok = ok and spread_ok[n.name] and ipa_ok[n.name]
+            # Host-state plugins (volume quartet + DRA).
+            ok = ok and volume_restrictions_filter(
+                pod, st.pods, self.vols, self.pvc_users
+            )
+            ok = ok and node_volume_limits_filter(pod, n, st.pods, self.vols)
+            ok = ok and volume_binding_filter(pod, n, self.vols)
+            ok = ok and volume_zone_filter(pod, n, self.vols)
+            ok = ok and dra_filter(pod, n, self.claims)
             out[n.name] = ok
         return out
 
@@ -236,6 +271,10 @@ class FullOracleScheduler:
             pick = ties[tie_rand % len(ties)]
         self.states[pick].pods.append(pod)
         self.nominator.pop(pod.uid, None)
+        # Reserve/PreBind: bind delayed volumes + allocate claims on the
+        # chosen node (volume_binding.go:521; dynamicresources PreBind).
+        volume_commit(pod, self.states[pick].node, self.vols, self.pvc_users)
+        dra_commit(pod, pick, self.claims)
         return Decision(pod=pod, node=pick)
 
     # -- preemption (greedy reprieve, scalar) --------------------------------
@@ -260,13 +299,32 @@ class FullOracleScheduler:
             lower = [p for p in st.pods if p.spec.priority < prio]
             if not lower:
                 continue
-            # Release-independent filters must already pass.
+            # Release-independent filters must already pass (VolumeBinding
+            # and VolumeZone are invariant under pod removal — evicting
+            # moves no volume; build_preempt_pass treats them the same).
             if not (
                 (not n.spec.unschedulable)
                 and taint_toleration_filter(pod, n)
                 and node_affinity_filter(pod, n)
+                and volume_binding_filter(pod, n, self.vols)
+                and volume_zone_filter(pod, n, self.vols)
             ):
                 continue
+            # DRA hard candidacy: a missing claim or a claim pinned to
+            # another node is unresolvable by eviction; a device SHORTAGE
+            # is resolvable but skips the reprieve (every lower-priority
+            # pod goes; the retry validates against post-eviction truth —
+            # preemption.py _RELEASE_DEPENDENT/resolvable_ops).
+            dra_hard_ok = True
+            for claim in self.claims.pod_claims(pod):
+                if claim is None or (
+                    claim.allocated_node and claim.allocated_node != n.name
+                ):
+                    dra_hard_ok = False
+                    break
+            if not dra_hard_ok:
+                continue
+            res_fail = not dra_filter(pod, n, self.claims)
             keep = [p for p in st.pods if p.spec.priority >= prio]
 
             def ok_with(removed: list[t.Pod]) -> bool:
@@ -306,20 +364,23 @@ class FullOracleScheduler:
                         v = True
                 viol[p.uid] = v
             # Greedy reprieve: violating most-important-first, then
-            # non-violating most-important-first.
+            # non-violating most-important-first.  Nodes whose failure
+            # includes an unsimulated-resolvable op (DRA shortage) skip
+            # reprieve: every lower-priority pod goes.
             victims = list(lower)
-            order = sorted(
-                lower,
-                key=lambda p: (
-                    not viol.get(p.uid, False),
-                    -p.spec.priority,
-                    p.status.start_time,
-                ),
-            )
-            for p in order:
-                trial_victims = [v for v in victims if v is not p]
-                if ok_with(trial_victims):
-                    victims = trial_victims
+            if not res_fail:
+                order = sorted(
+                    lower,
+                    key=lambda p: (
+                        not viol.get(p.uid, False),
+                        -p.spec.priority,
+                        p.status.start_time,
+                    ),
+                )
+                for p in order:
+                    trial_victims = [v for v in victims if v is not p]
+                    if ok_with(trial_victims):
+                        victims = trial_victims
             if victims:
                 candidates.append((n.name, victims))
 
@@ -359,7 +420,17 @@ class FullOracleScheduler:
 
     # -- driver (mirrors schedule_batch + prefetch ordering) -----------------
 
-    def run(self, pods: list[t.Pod], max_rounds: int = 1000) -> list[Decision]:
+    def run(
+        self, pods: list[t.Pod], max_rounds: int = 1000,
+        prefetch: bool = True,
+    ) -> list[Decision]:
+        """``prefetch`` mirrors the engine's featurize-overlap: when on,
+        this batch's preemption requeues land in batch k+2.  The engine
+        gates prefetch OFF for batches whose active ops read mutable host
+        catalogs (VolumeBinding/DynamicResources — scheduler.py
+        _batch_traced), so full-surface fixtures run both sides with
+        prefetch=False (and the engine pinned off) for a deterministic
+        alignment."""
         for p in pods:
             self.add(p)
         decisions: list[Decision] = []
@@ -370,9 +441,7 @@ class FullOracleScheduler:
             if not batch:
                 break
             results = [self._schedule_one(q) for q in batch]
-            # The engine prefetches the NEXT batch before completing this
-            # one, so this batch's preemption requeues land in batch k+2.
-            nxt = self._pop_batch()
+            nxt = self._pop_batch() if prefetch else []
             prefetched = nxt if nxt else None
             for q, d in zip(batch, results):
                 if d.node is None:
@@ -390,13 +459,22 @@ class FullOracleScheduler:
 ZONE = "topology.kubernetes.io/zone"
 
 
-def build_fixture(n_nodes: int = 304, n_pending: int = 120, n_tiny: int = 10):
+def build_fixture(n_nodes: int = 304, n_pending: int = 120, n_tiny: int = 10,
+                  volumes: bool = False):
     """Deterministic default-profile A/B fixture: heterogeneous tainted/
     labeled nodes, seeded bound pods, a pending mix exercising every
     compute plugin, and a preemption theater (tiny saturated pool + vips).
     Every non-vip pod is schedulable on first attempt, so oracle and
-    engine agree on the event-free flow."""
-    from kubernetes_tpu.api.wrappers import make_node, make_pod
+    engine agree on the event-free flow.
+
+    ``volumes=True`` (r4) adds the host-state surface: bound-PV pods
+    (VolumeBinding affinity + VolumeZone), WFFC static PVs with forced
+    smallest-fitting choice, dynamically provisioned claims under
+    allowedTopologies, CSI attach limits, an RWOP contention pair,
+    counted-device DRA claims (incl. one missing claim), and gated pods.
+    Returns (nodes, bound, pending, pdbs, objects) where ``objects`` is
+    the extra-object dict (empty when volumes=False)."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod, make_pv, make_pvc
 
     nodes = []
     for i in range(n_nodes):
@@ -484,4 +562,120 @@ def build_fixture(n_nodes: int = 304, n_pending: int = 120, n_tiny: int = 10):
             disruptions_allowed=max(n_tiny - 2, 1),
         )
     ]
-    return nodes, bound, pending, pdbs
+    objects: dict = {}
+    if volumes:
+        classes = [
+            # One static class per WFFC claim: candidate sets don't overlap,
+            # so no same-batch PV race (the engine resolves races by
+            # reserve-failure + retry — covered in test_volumes — which a
+            # sequential oracle cannot mirror step-for-step).
+            *[
+                t.StorageClass(
+                    name=f"sc-static-{i}",
+                    provisioner="kubernetes.io/no-provisioner",
+                    binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+                )
+                for i in range(4)
+            ],
+            t.StorageClass(
+                name="sc-dyn", provisioner="csi.example.com",
+                binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+                allowed_topologies=t.NodeSelector(terms=(
+                    t.NodeSelectorTerm(match_expressions=(
+                        t.NodeSelectorRequirement(
+                            ZONE, t.OP_IN, ("zone-0", "zone-1")
+                        ),
+                    )),
+                )),
+            ),
+        ]
+        pvs, pvcs = [], []
+        # Bound-PV pods: PV pinned to one zone via node affinity AND zone
+        # labels (VolumeBinding + VolumeZone both constrain).
+        for i in range(6):
+            z = f"zone-{i % 4}"
+            pvs.append(make_pv(f"pv-bound-{i}", capacity="8Gi",
+                               zone=z, node_affinity_zone=[z]))
+            pvcs.append(make_pvc(f"bpvc-{i}", volume_name=f"pv-bound-{i}"))
+            pvs[-1].claim_ref = f"default/bpvc-{i}"
+        # WFFC static pool: distinct capacities force the smallest-fitting
+        # choice (FindMatchingVolume) deterministically on both sides.
+        for i in range(4):
+            pvs.append(make_pv(f"pv-wffc-{i}", capacity=f"{2 + i}Gi",
+                               storage_class=f"sc-static-{i}",
+                               node_affinity_zone=[f"zone-{i % 4}"]))
+            pvcs.append(make_pvc(f"wpvc-{i}", storage_class=f"sc-static-{i}",
+                                 request=f"{2 + i}Gi"))
+        # Dynamic provisioning under allowedTopologies (zone-0/1 only).
+        for i in range(4):
+            pvcs.append(make_pvc(f"dpvc-{i}", storage_class="sc-dyn",
+                                 request="1Gi"))
+        # RWOP contention: two pods want the same single-writer claim.
+        pvs.append(make_pv("pv-rwop", capacity="4Gi",
+                           access_modes=(t.RWOP,)))
+        pvcs.append(make_pvc("rwop-claim", volume_name="pv-rwop",
+                             access_modes=(t.RWOP,)))
+        pvs[-1].claim_ref = "default/rwop-claim"
+        # CSI attach limits on the ssd nodes (driver = sc-dyn provisioner).
+        csinodes = [
+            t.CSINode(name=f"node-{i:04d}", driver_limits={"csi.example.com": 2})
+            for i in range(0, n_nodes, 11)
+        ]
+        # DRA: gpu devices on the first 8 nodes, 2 each; 6 one-device
+        # claims (fits), plus a pod referencing a claim that doesn't exist.
+        slices = [
+            t.ResourceSlice(node_name=f"node-{i:04d}", device_class="gpu", count=2)
+            for i in range(8)
+        ]
+        dclaims = [
+            t.ResourceClaim(name=f"gclaim-{i}", device_class="gpu", count=1)
+            for i in range(6)
+        ]
+        vol_pending = []
+        for i in range(6):
+            vol_pending.append(
+                make_pod(f"vb-{i}").req({"cpu": "200m"}).pvc_volume(f"bpvc-{i}").obj()
+            )
+        for i in range(4):
+            vol_pending.append(
+                make_pod(f"vw-{i}").req({"cpu": "200m"}).pvc_volume(f"wpvc-{i}").obj()
+            )
+        for i in range(4):
+            # ssd affinity makes the CSI attach limit BITE (only the ssd
+            # nodes carry CSINode records).
+            vol_pending.append(
+                make_pod(f"vd-{i}").req({"cpu": "200m"})
+                .node_affinity_in("disk", ["ssd"])
+                .pvc_volume(f"dpvc-{i}").obj()
+            )
+        # rw-a gets priority so it pops (and commits) in an EARLIER batch
+        # than rw-b: featurization is batch-wide, so the loser must be
+        # featurized after the winner's PreBind bumped the RWOP use count.
+        vol_pending.append(
+            make_pod("rw-a").req({"cpu": "100m"}).priority(5)
+            .pvc_volume("rwop-claim").obj()
+        )
+        vol_pending.append(
+            make_pod("rw-b").req({"cpu": "100m"}).pvc_volume("rwop-claim").obj()
+        )
+        for i in range(6):
+            vol_pending.append(
+                make_pod(f"dra-{i}").req({"cpu": "100m"})
+                .resource_claim(f"gclaim-{i}").obj()
+            )
+        vol_pending.append(
+            make_pod("dra-missing").req({"cpu": "100m"})
+            .resource_claim("no-such-claim").obj()
+        )
+        gated = [
+            make_pod(f"gated-{i}").req({"cpu": "100m"})
+            .scheduling_gate("example.com/hold").obj()
+            for i in range(2)
+        ]
+        pending = pending + vol_pending + gated
+        objects = dict(
+            classes=classes, pvs=pvs, pvcs=pvcs, csinodes=csinodes,
+            slices=slices, dclaims=dclaims,
+            gated_uids={p.uid for p in gated},
+        )
+    return nodes, bound, pending, pdbs, objects

@@ -481,3 +481,260 @@ def ipa_score(
         for name, s in raws.items():
             out[name] = MAX_NODE_SCORE * (s - mn) // diff if diff > 0 else 0
     return out
+
+
+# ---------------------------------------------------------------------------
+# Volume plugins (plugins/volumebinding, volumezone, volumerestrictions,
+# nodevolumelimits) — scalar references over a plain-dict catalog mirror,
+# independent of kubernetes_tpu.volumes.VolumeCatalog.
+# ---------------------------------------------------------------------------
+
+_ZONE_KEYS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+_REGION_KEYS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+_NO_PROVISIONER = "kubernetes.io/no-provisioner"
+
+
+class RefVolumes:
+    """Scalar PV/PVC/StorageClass/CSINode state for the oracle."""
+
+    def __init__(self, pvs=(), pvcs=(), classes=(), csinodes=()):
+        self.pvs = {pv.name: pv for pv in pvs}
+        self.pvcs = {pvc.uid: pvc for pvc in pvcs}
+        self.classes = {sc.name: sc for sc in classes}
+        self.csinodes = {cn.name: cn for cn in csinodes}
+
+    def pod_pvcs(self, pod):
+        return [
+            self.pvcs.get(f"{pod.namespace}/{v.pvc}")
+            for v in pod.spec.volumes
+            if v.pvc
+        ]
+
+    def classify(self, pvc):
+        if pvc.volume_name:
+            pv = self.pvs.get(pvc.volume_name)
+            return ("bound", pv) if pv is not None else ("lost", None)
+        sc = self.classes.get(pvc.storage_class)
+        if sc is not None and sc.binding_mode == t.BINDING_WAIT_FOR_FIRST_CONSUMER:
+            return ("delayed", self.candidates_for(pvc), sc)
+        return ("unbound_immediate", None)
+
+    def candidates_for(self, pvc):
+        out = []
+        for pv in self.pvs.values():
+            if pv.claim_ref or pv.storage_class != pvc.storage_class:
+                continue
+            if not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            if pv.capacity < pvc.request:
+                continue
+            out.append(pv)
+        return out
+
+    def pvc_driver(self, pvc):
+        if pvc.volume_name:
+            pv = self.pvs.get(pvc.volume_name)
+            return pv.csi_driver if pv is not None else ""
+        sc = self.classes.get(pvc.storage_class)
+        if sc is not None and sc.provisioner != _NO_PROVISIONER:
+            return sc.provisioner
+        return ""
+
+
+def _pv_fits_node(pv, node) -> bool:
+    return t.node_selector_matches(
+        pv.node_affinity, node.metadata.labels, node.name
+    )
+
+
+def volume_binding_filter(pod, node, vols: RefVolumes) -> bool:
+    """VolumeBinding Filter (volume_binding.go): bound claims need their
+    PV's node affinity to match; delayed (WFFC) claims need a matching
+    unbound PV or a provisioner whose allowedTopologies fit; unbound
+    Immediate / lost claims fail everywhere."""
+    for pvc in vols.pod_pvcs(pod):
+        if pvc is None:
+            return False
+        kind, *rest = vols.classify(pvc)
+        if kind in ("lost", "unbound_immediate"):
+            return False
+        if kind == "bound":
+            if not _pv_fits_node(rest[0], node):
+                return False
+            continue
+        candidates, sc = rest
+        ok = any(_pv_fits_node(pv, node) for pv in candidates)
+        if not ok and sc.provisioner != _NO_PROVISIONER:
+            ok = sc.allowed_topologies is None or t.node_selector_matches(
+                sc.allowed_topologies, node.metadata.labels, node.name
+            )
+        if not ok:
+            return False
+    return True
+
+
+def volume_zone_filter(pod, node, vols: RefVolumes) -> bool:
+    """VolumeZone (volume_zone.go): each bound PV's zone/region labels —
+    possibly ``__``-separated value sets — must match the node."""
+    for pvc in vols.pod_pvcs(pod):
+        if pvc is None:
+            return False
+        kind, *rest = vols.classify(pvc)
+        if kind in ("lost", "unbound_immediate"):
+            return False
+        if kind != "bound":
+            continue
+        pv = rest[0]
+        for key in _ZONE_KEYS + _REGION_KEYS:
+            v = pv.labels.get(key)
+            if v is None:
+                continue
+            if node.metadata.labels.get(key) not in v.split("__"):
+                return False
+    return True
+
+
+def volume_restrictions_filter(pod, node_pods, vols: RefVolumes,
+                               pvc_users: dict) -> bool:
+    """VolumeRestrictions (volume_restrictions.go): in-tree device volume
+    conflicts (both-read-only exempt) + ReadWriteOncePod exclusivity."""
+    for pvc in vols.pod_pvcs(pod):
+        if pvc is not None and t.RWOP in pvc.access_modes:
+            if pvc_users.get(pvc.uid, 0) > 0:
+                return False
+    for v in pod.spec.volumes:
+        if not v.device_id:
+            continue
+        for p in node_pods:
+            for v2 in p.spec.volumes:
+                if v2.device_id != v.device_id:
+                    continue
+                if not (v.read_only and v2.read_only):
+                    return False
+    return True
+
+
+def node_volume_limits_filter(pod, node, node_pods, vols: RefVolumes) -> bool:
+    """NodeVolumeLimits CSI (nodevolumelimits/csi.go): per driver, distinct
+    attached volumes + the pod's genuinely NEW volumes must stay within the
+    CSINode allocatable count.  Volume identity = bound PV name or the
+    unbound claim's uid (one attach per distinct volume)."""
+    cn = vols.csinodes.get(node.name)
+    if cn is None or not cn.driver_limits:
+        return True
+
+    def pod_vols(p):
+        out = {}
+        for pvc in vols.pod_pvcs(p):
+            if pvc is None:
+                continue
+            drv = vols.pvc_driver(pvc)
+            if not drv:
+                continue
+            vol_id = pvc.volume_name or pvc.uid
+            out[(drv, vol_id)] = True
+        return out
+
+    attached = {}
+    for p in node_pods:
+        attached.update(pod_vols(p))
+    new = {k: True for k in pod_vols(pod) if k not in attached}
+    per_driver: dict[str, int] = {}
+    for (drv, _vid) in attached:
+        per_driver[drv] = per_driver.get(drv, 0) + 1
+    for (drv, _vid) in new:
+        per_driver[drv] = per_driver.get(drv, 0) + 1
+        limit = cn.driver_limits.get(drv)
+        if limit is not None and per_driver[drv] > limit:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# DynamicResources (plugins/dynamicresources/, counted-device form)
+# ---------------------------------------------------------------------------
+
+
+class RefClaims:
+    """Scalar DRA state: claims + per-(node, class) published/allocated."""
+
+    def __init__(self, claims=(), slices=()):
+        self.claims = {c.uid: c for c in claims}
+        self.slices: dict[tuple[str, str], int] = {}
+        for s in slices:
+            key = (s.node_name, s.device_class)
+            self.slices[key] = self.slices.get(key, 0) + s.count
+        self.allocated: dict[tuple[str, str], int] = {}
+
+    def pod_claims(self, pod):
+        return [
+            self.claims.get(f"{pod.namespace}/{name}")
+            for name in pod.spec.resource_claims
+        ]
+
+    def free(self, node, cls):
+        return self.slices.get((node, cls), 0) - self.allocated.get((node, cls), 0)
+
+
+def dra_filter(pod, node, claims: RefClaims) -> bool:
+    """DynamicResources Filter: every claim either allocated on THIS node
+    or satisfiable from the node's free devices (per-class sums)."""
+    need: dict[str, int] = {}
+    for claim in claims.pod_claims(pod):
+        if claim is None:
+            return False
+        if claim.allocated_node:
+            if claim.allocated_node != node.name:
+                return False
+            continue
+        need[claim.device_class] = need.get(claim.device_class, 0) + claim.count
+    for cls, cnt in need.items():
+        if claims.free(node.name, cls) < cnt:
+            return False
+    return True
+
+
+def dra_commit(pod, node_name, claims: RefClaims) -> None:
+    """Allocate the pod's claims on the chosen node (PreBind)."""
+    for claim in claims.pod_claims(pod):
+        if claim is None:
+            continue
+        if not claim.allocated_node:
+            claim.allocated_node = node_name
+            key = (node_name, claim.device_class)
+            claims.allocated[key] = claims.allocated.get(key, 0) + claim.count
+        if pod.uid not in claim.reserved_for:
+            claim.reserved_for += (pod.uid,)
+
+
+def volume_commit(pod, node, vols: RefVolumes, pvc_users: dict) -> None:
+    """Bind the pod's delayed claims on the chosen node (PreBind,
+    volume_binding.go:521): smallest fitting PV, else dynamic provisioning;
+    bump RWOP usage counts."""
+    for pvc in vols.pod_pvcs(pod):
+        if pvc is None:
+            continue
+        pvc_users[pvc.uid] = pvc_users.get(pvc.uid, 0) + 1
+        kind, *rest = vols.classify(pvc)
+        if kind != "delayed":
+            continue
+        candidates, sc = rest
+        fitting = [pv for pv in candidates if _pv_fits_node(pv, node)]
+        if fitting:
+            pv = min(fitting, key=lambda p: p.capacity)
+            pv.claim_ref = pvc.uid
+            pvc.volume_name = pv.name
+        elif sc.provisioner != _NO_PROVISIONER:
+            name = f"provisioned-{pvc.namespace}-{pvc.name}"
+            vols.pvs[name] = t.PersistentVolume(
+                name=name, capacity=pvc.request, access_modes=pvc.access_modes,
+                storage_class=pvc.storage_class, claim_ref=pvc.uid,
+                csi_driver=vols.pvc_driver(pvc),
+            )
+            pvc.volume_name = name

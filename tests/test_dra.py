@@ -146,3 +146,81 @@ def test_dra_device_shortage_is_preemptible():
     assert {o.pod.name: o.node_name for o in out if o.node_name} == {"vip": "n0"}
     assert "default/holder" not in s.cache.pods
     assert s.builder.dra.claims["default/held"].allocated_node == ""  # released
+
+
+def test_external_allocation_charges_devices_once():
+    """An informer-delivered allocated claim consumes devices immediately,
+    and a local pod reserving the SAME claim must not double-charge
+    (review findings r4: phantom-reservation accounting)."""
+    s = TPUScheduler(batch_size=4)
+    gpu_cluster(s, counts=(2,))  # n0 publishes 2 gpu devices
+    ext = t.ResourceClaim(
+        name="ext", device_class="gpu.example.com", count=1, allocated_node="n0",
+        reserved_for=("other-scheduler/pod",),
+    )
+    s.add_resource_claim(ext)
+    # One device consumed externally: a 2-device claim no longer fits.
+    s.add_resource_claim(claim("big", count=2))
+    s.add_pod(claim_pod("pbig", "big"))
+    outs = s.schedule_all_pending()
+    assert not [o for o in outs if o.node_name], outs
+    # A 1-device claim still fits (free = 2 - 1).
+    s.add_resource_claim(claim("one", count=1))
+    s.add_pod(claim_pod("pone", "one"))
+    (o,) = [o for o in s.schedule_all_pending() if o.pod.name == "pone"]
+    assert o.node_name == "n0"
+    # A local pod reserving the EXTERNAL claim: no double charge — the
+    # node must still show exactly 2 consumed (1 ext + 1 local).
+    s.add_pod(claim_pod("pext", "ext"))
+    (o2,) = [o for o in s.schedule_all_pending() if o.pod.name == "pext"]
+    assert o2.node_name == "n0"
+    row = s.cache.nodes["n0"].row
+    cid = s.builder.interns.device_classes.id("gpu.example.com")
+    assert s.builder.host["dra_alloc"][cid, row] == 2
+    # Deleting the local reserver must NOT free the external device.
+    s.delete_pod(o2.pod.uid)
+    assert s.builder.host["dra_alloc"][cid, row] == 2
+    assert s.builder.host_mirror_equal()
+
+
+def test_allocated_claim_before_node_replays():
+    """Claim-before-node informer race: the allocation charge parks and
+    replays when the node arrives (review finding r4-2)."""
+    s = TPUScheduler(batch_size=4)
+    s.add_resource_claim(
+        t.ResourceClaim(name="early", device_class="gpu.example.com", count=2,
+                        allocated_node="late-node",
+                        reserved_for=("elsewhere/pod",))
+    )
+    s.add_resource_slice(
+        t.ResourceSlice(node_name="late-node", device_class="gpu.example.com", count=2)
+    )
+    s.add_node(
+        make_node("late-node").capacity({"cpu": "8", "pods": 110}).obj()
+    )
+    # Both devices are consumed by the external allocation.
+    s.add_resource_claim(claim("want", count=1))
+    s.add_pod(claim_pod("p", "want"))
+    assert not [o for o in s.schedule_all_pending() if o.node_name]
+    assert s.builder.host_mirror_equal()
+
+
+def test_stale_unallocated_echo_ignored():
+    """A watch echo of the pre-allocation claim object must not release a
+    locally-reserved allocation (review finding r4-3: assume-cache
+    version semantics)."""
+    s = TPUScheduler(batch_size=4)
+    gpu_cluster(s, counts=(1,))
+    s.add_resource_claim(claim("c", count=1))
+    s.add_pod(claim_pod("p", "c"))
+    (o,) = [o for o in s.schedule_all_pending() if o.pod.name == "p"]
+    assert o.node_name == "n0"
+    # Stale echo: the claim as it looked BEFORE allocation.
+    s.add_resource_claim(claim("c", count=1))
+    # The devices stay consumed: another 1-device claim cannot land.
+    s.add_resource_claim(claim("c2", count=1))
+    s.add_pod(claim_pod("p2", "c2"))
+    assert not [
+        o for o in s.schedule_all_pending() if o.pod.name == "p2" and o.node_name
+    ]
+    assert s.builder.host_mirror_equal()
