@@ -11,9 +11,18 @@
 //	    multiPoint:
 //	      enabled: [{name: TPUBatchScore}]
 //	      disabled: [{name: "*"}]
+//	    queueSort:
+//	      enabled: [{name: PrioritySort}]
+//	    bind:
+//	      enabled: [{name: DefaultBinder}]
 //	  pluginConfig:
 //	  - name: TPUBatchScore
 //	    args: {"socket": "/var/run/tpu-sidecar.sock"}
+//
+// (multiPoint `disabled: "*"` wipes the default set, so the mandatory
+// queueSort/bind plugins are re-enabled at their specific extension points
+// — NewFramework requires exactly one queue sort and ≥1 bind plugin,
+// runtime/framework.go:361–365.)
 //
 // Division of labor (SURVEY §7 two-tier design): the Go scheduler keeps
 // informers, queue, binding, and API writes; the sidecar owns the batched

@@ -100,6 +100,8 @@ def cmd_validate(args) -> int:
     except ValueError as exc:
         print(f"config: {exc}")
         return 1
+    for w in cfg.get("warnings", ()):
+        print(f"warning: {w}")
     bad = 0
     if cfg["batch_size"] % cfg["chunk_size"]:
         print(
@@ -122,13 +124,25 @@ def cmd_serve(args) -> int:
 
     if args.config:
         cfg = load_config(args.config)
+        for w in cfg.get("warnings", ()):
+            print(f"warning: {w}", flush=True)
         profiles = cfg["profiles"]
+        queue = None
+        if "pod_initial_backoff_s" in cfg or "pod_max_backoff_s" in cfg:
+            from .queue import SchedulingQueue
+
+            queue = SchedulingQueue(
+                initial_backoff_s=cfg.get("pod_initial_backoff_s", 1.0),
+                max_backoff_s=cfg.get("pod_max_backoff_s", 10.0),
+            )
         sched = TPUScheduler(
             profile=profiles[0],
             profiles=profiles[1:],
             batch_size=cfg["batch_size"],
             chunk_size=cfg["chunk_size"],
             feature_gates=cfg.get("feature_gates"),
+            extenders=cfg.get("extenders"),
+            queue=queue,
         )
     else:
         sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)

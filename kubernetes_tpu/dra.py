@@ -35,6 +35,11 @@ class ClaimCatalog:
     # add_node replays CSINode/ResourceSlices for).
     row_charged: dict[str, tuple[str, str, int]] = field(default_factory=dict)
     pending_external: dict[str, tuple[str, str, int]] = field(default_factory=dict)
+    # claim uid → pod uids reserved IN-PROCESS (allocate_pod_claims).  The
+    # assume-cache stale-echo guard keys off these, not off the informer's
+    # status.reservedFor — external consumers releasing a claim is a real
+    # deallocation, not an echo.
+    local_reserved: dict[str, set[str]] = field(default_factory=dict)
 
     def add_claim(
         self, claim: t.ResourceClaim
@@ -55,10 +60,16 @@ class ClaimCatalog:
         touching accounting (local reservations carry over)."""
         old = self.claims.get(claim.uid)
         if old is not None:
-            if old.reserved_for and not claim.allocated_node:
+            local = self.local_reserved.get(claim.uid, ())
+            if local and not claim.allocated_node:
                 return []  # stale echo: local truth wins until released
-            # Local reservations survive the object replacement.
-            merged = tuple(dict.fromkeys(old.reserved_for + claim.reserved_for))
+            # Local reservations survive the object replacement; an
+            # external consumer vanishing from status.reservedFor does not
+            # get resurrected from the old object.
+            merged = tuple(dict.fromkeys(
+                claim.reserved_for
+                + tuple(u for u in old.reserved_for if u in local)
+            ))
             claim.reserved_for = merged
         old_alloc = (
             (old.allocated_node, old.device_class, old.count)
@@ -131,6 +142,7 @@ class ClaimCatalog:
                 undo.append(("allocated", claim, ""))
             if pod.uid not in claim.reserved_for:
                 claim.reserved_for += (pod.uid,)
+                self.local_reserved.setdefault(claim.uid, set()).add(pod.uid)
                 undo.append(("reserved", claim, pod.uid))
         if undo:
             self.epoch += 1
@@ -143,6 +155,7 @@ class ClaimCatalog:
                 claim.reserved_for = tuple(
                     u for u in claim.reserved_for if u != uid
                 )
+                self.local_reserved.get(claim.uid, set()).discard(uid)
             else:
                 key = (claim.allocated_node, claim.device_class)
                 self.allocated[key] = self.allocated.get(key, 0) - claim.count
@@ -163,6 +176,7 @@ class ClaimCatalog:
                 claim.reserved_for = tuple(
                     u for u in claim.reserved_for if u != pod_uid
                 )
+                self.local_reserved.get(claim.uid, set()).discard(pod_uid)
                 changed = True
                 if not claim.reserved_for and claim.allocated_node:
                     key = (claim.allocated_node, claim.device_class)

@@ -16,6 +16,110 @@ if TYPE_CHECKING:
     from ..api import types as t
 
 MAX_NODE_SCORE = 100  # framework.MaxNodeScore (interface.go)
+MAX_TOTAL_SCORE = (1 << 63) - 1  # framework.MaxTotalScore (interface.go)
+
+# The 12 extension points of the Scheduling Framework
+# (framework/interface.go:453–687), in invocation order.
+EXTENSION_POINTS = (
+    "preEnqueue", "queueSort", "preFilter", "filter", "postFilter",
+    "preScore", "score", "reserve", "permit", "preBind", "bind", "postBind",
+)
+
+# External point name → Profile field holding its plugin list ("score" is
+# the weighted ``scorers`` tuple).  The single source for the config
+# parser, dump(), and validate_profile.
+POINT_FIELD = {
+    "preEnqueue": "pre_enqueue",
+    "queueSort": "queue_sort",
+    "preFilter": "pre_filter",
+    "filter": "filters",
+    "postFilter": "post_filter",
+    "preScore": "pre_score",
+    "score": "scorers",
+    "reserve": "reserve",
+    "permit": "permit",
+    "preBind": "pre_bind",
+    "bind": "bind",
+    "postBind": "post_bind",
+}
+
+# Which extension points each plugin implements — the analog of the
+# reference's interface assertions (``var _ framework.FilterPlugin = ...``
+# in each plugin file) that expandMultiPointPlugins reflects over
+# (runtime/framework.go:511).  Device ops collapse PreFilter into the
+# featurize step, but the declared surface mirrors the reference so
+# multiPoint expansion produces the same per-point lists.
+PLUGIN_POINTS: dict[str, frozenset] = {
+    "SchedulingGates": frozenset({"preEnqueue"}),
+    "PrioritySort": frozenset({"queueSort"}),
+    "NodeUnschedulable": frozenset({"filter"}),
+    "NodeName": frozenset({"filter"}),
+    "TaintToleration": frozenset({"filter", "preScore", "score"}),
+    "NodeAffinity": frozenset({"preFilter", "filter", "preScore", "score"}),
+    "NodePorts": frozenset({"preFilter", "filter"}),
+    "NodeResourcesFit": frozenset({"preFilter", "filter", "preScore", "score"}),
+    "VolumeRestrictions": frozenset({"preFilter", "filter"}),
+    "NodeVolumeLimits": frozenset({"preFilter", "filter"}),
+    "VolumeBinding": frozenset({"preFilter", "filter", "reserve", "preBind"}),
+    "VolumeZone": frozenset({"preFilter", "filter"}),
+    "PodTopologySpread": frozenset({"preFilter", "filter", "preScore", "score"}),
+    "InterPodAffinity": frozenset({"preFilter", "filter", "preScore", "score"}),
+    # dynamicresources.go:192–198 interface assertions.
+    "DynamicResources": frozenset(
+        {"preEnqueue", "preFilter", "filter", "postFilter", "reserve", "preBind"}
+    ),
+    "DefaultPreemption": frozenset({"postFilter"}),
+    "NodeResourcesBalancedAllocation": frozenset({"preScore", "score"}),
+    "ImageLocality": frozenset({"score"}),
+    "DefaultBinder": frozenset({"bind"}),
+    # TPU-native host plugins (framework/hostplugins.py): the gang gate is
+    # a PermitPlugin (framework/coscheduling.py) — enabled by default as a
+    # documented extension beyond the upstream default set.
+    "Coscheduling": frozenset({"permit"}),
+}
+
+# Known out-of-tree plugins: names the config parser accepts with opaque
+# ``args`` even though no device op backs them in-process.  TPUBatchScore is
+# the Go-side plugin (go/tpubatchscore/plugin.go) whose profile snippet must
+# parse with this parser (the sidecar serves it; the Python engine never
+# runs it as an op).
+FOREIGN_PLUGIN_POINTS: dict[str, frozenset] = {
+    "TPUBatchScore": frozenset({"preFilter", "filter", "score", "postFilter"}),
+}
+
+# The default MultiPoint enablement with weights
+# (apis/config/v1/default_plugins.go:30–54; DynamicResources inserted
+# before DefaultPreemption by applyFeatureGates when the gate is on).
+DEFAULT_MULTIPOINT: tuple[tuple[str, int], ...] = (
+    ("SchedulingGates", 0),
+    ("PrioritySort", 0),
+    ("NodeUnschedulable", 0),
+    ("NodeName", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("VolumeRestrictions", 0),
+    ("NodeVolumeLimits", 0),
+    ("VolumeBinding", 0),
+    ("VolumeZone", 0),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("DynamicResources", 0),
+    ("DefaultPreemption", 0),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("DefaultBinder", 0),
+    ("Coscheduling", 0),
+)
+
+
+def expand_point(point: str, multipoint=DEFAULT_MULTIPOINT) -> tuple[str, ...]:
+    """Plugins of ``multipoint`` implementing ``point``, in order."""
+    return tuple(
+        name for name, _w in multipoint
+        if point in PLUGIN_POINTS.get(name, FOREIGN_PLUGIN_POINTS.get(name, frozenset()))
+    )
 
 # Scoring strategy types (apis/config/types_pluginargs.go:187–194).
 LEAST_ALLOCATED = "LeastAllocated"
@@ -94,6 +198,38 @@ class Profile:
     pts_default_constraints: tuple["t.TopologySpreadConstraint", ...] = ()
     # Deterministic tie-break seed (parity mode: both sides share it).
     tie_break_seed: int = 0
+    # The remaining extension-point lists (types.go Plugins struct; effective
+    # defaults = multiPoint expansion, runtime/framework.go:511).  ``filters``
+    # and ``scorers`` above are the filter/score lists; these map to host
+    # behaviors: preEnqueue → queue gating, postFilter → preemption,
+    # reserve/preBind → host ReservePlugins, permit → PermitPlugins,
+    # bind → the in-process binder.  preFilter/preScore are accepted and
+    # validated for config parity; the device engine fuses those phases into
+    # featurize + the compiled pass, so membership there has no separate
+    # runtime switch (the fused op activates off filters/scorers).
+    pre_enqueue: tuple[str, ...] = ("SchedulingGates", "DynamicResources")
+    queue_sort: tuple[str, ...] = ("PrioritySort",)
+    pre_filter: tuple[str, ...] = (
+        "NodeAffinity", "NodePorts", "NodeResourcesFit", "VolumeRestrictions",
+        "NodeVolumeLimits", "VolumeBinding", "VolumeZone", "PodTopologySpread",
+        "InterPodAffinity", "DynamicResources",
+    )
+    post_filter: tuple[str, ...] = ("DynamicResources", "DefaultPreemption")
+    pre_score: tuple[str, ...] = (
+        "TaintToleration", "NodeAffinity", "NodeResourcesFit",
+        "PodTopologySpread", "InterPodAffinity",
+        "NodeResourcesBalancedAllocation",
+    )
+    reserve: tuple[str, ...] = ("VolumeBinding", "DynamicResources")
+    permit: tuple[str, ...] = ("Coscheduling",)
+    pre_bind: tuple[str, ...] = ("VolumeBinding", "DynamicResources")
+    bind: tuple[str, ...] = ("DefaultBinder",)
+    post_bind: tuple[str, ...] = ()
+    # Out-of-tree plugins accepted by the config surface with opaque args
+    # (name → args dict); see FOREIGN_PLUGIN_POINTS.  A profile scheduling
+    # through a foreign plugin set (the Go-side TPUBatchScore) is valid
+    # config but is served by the sidecar, not the in-process engine.
+    foreign: tuple[tuple[str, str], ...] = ()  # (name, json-encoded args)
 
 
 DEFAULT_PLUGIN_WEIGHTS = {name: w for name, w in Profile().scorers}
@@ -110,16 +246,26 @@ def validate_profile(profile: Profile) -> list[str]:
     errs: list[str] = []
     if not profile.name:
         errs.append("profile.name must be non-empty")
+    def _known(name: str, point: str) -> bool:
+        if name in FOREIGN_PLUGIN_POINTS:
+            return point in FOREIGN_PLUGIN_POINTS[name]
+        if name in PLUGIN_POINTS:
+            # NewFramework's "does not extend" check (framework.go:334):
+            # a declared in-tree plugin must implement the point.
+            return point in PLUGIN_POINTS[name]
+        # TPU-native extra ops outside the upstream inventory.
+        return opcommon.has(name)
+
     seen_f: set[str] = set()
     for name in profile.filters:
-        if not opcommon.has(name):
+        if not _known(name, "filter"):
             errs.append(f"filters[{name!r}]: unknown plugin")
         if name in seen_f:
             errs.append(f"filters[{name!r}]: duplicate entry")
         seen_f.add(name)
     seen: set[str] = set()
     for name, weight in profile.scorers:
-        if not opcommon.has(name):
+        if not _known(name, "score"):
             errs.append(f"scorers[{name!r}]: unknown plugin")
         if name in seen:
             errs.append(f"scorers[{name!r}]: duplicate entry")
@@ -192,6 +338,34 @@ def validate_profile(profile: Profile) -> list[str]:
             errs.append(
                 f"pts_default_constraints[{i}]: label_selector must be unset"
             )
+    # Host extension-point lists: every member must declare the point
+    # (the reflect.Implements check in expandMultiPointPlugins /
+    # NewFramework, runtime/framework.go:334 "does not extend"), no dups.
+    host_lists = {
+        point: getattr(profile, fld)
+        for point, fld in POINT_FIELD.items()
+        if point not in ("filter", "score")  # those two validated above
+    }
+    for point, names in host_lists.items():
+        seen_p: set[str] = set()
+        for name in names:
+            pts = PLUGIN_POINTS.get(name, FOREIGN_PLUGIN_POINTS.get(name))
+            if pts is None:
+                errs.append(f"{point}[{name!r}]: unknown plugin")
+            elif point not in pts:
+                errs.append(f"{point}[{name!r}]: plugin does not extend {point}")
+            if name in seen_p:
+                errs.append(f"{point}[{name!r}]: duplicate entry")
+            seen_p.add(name)
+    # validation.go validateKubeSchedulerProfile: exactly one queueSort
+    # plugin, and at least one bind plugin.
+    if len(profile.queue_sort) != 1:
+        errs.append("queueSort: exactly one queue sort plugin is required")
+    if not profile.bind:
+        errs.append("bind: at least one bind plugin is required")
+    for name, args_json in profile.foreign:
+        if name not in FOREIGN_PLUGIN_POINTS:
+            errs.append(f"foreign[{name!r}]: unknown out-of-tree plugin")
     return errs
 
 

@@ -39,9 +39,21 @@ scheme's strict decoding)."""
 from __future__ import annotations
 
 import dataclasses
+import json
 
 from ..api import types as t
-from .config import DEFAULT_PROFILE, Profile, ScoringStrategy
+from .config import (
+    DEFAULT_MULTIPOINT,
+    DEFAULT_PROFILE,
+    EXTENSION_POINTS,
+    FOREIGN_PLUGIN_POINTS,
+    MAX_NODE_SCORE,
+    MAX_TOTAL_SCORE,
+    PLUGIN_POINTS,
+    POINT_FIELD,
+    Profile,
+    ScoringStrategy,
+)
 from .features import DEFAULT_GATES, FeatureGates, parse_feature_gates
 
 API_VERSION = "kubescheduler.config.k8s.io/v1"
@@ -49,14 +61,35 @@ KIND = "KubeSchedulerConfiguration"
 
 _TOP_KEYS = {
     "apiVersion", "kind", "percentageOfNodesToScore", "featureGates",
-    "profiles", "batchSize", "chunkSize",
+    "profiles", "batchSize", "chunkSize", "extenders",
+    "podInitialBackoffSeconds", "podMaxBackoffSeconds",
+}
+# KubeSchedulerConfiguration fields every upstream config carries that have
+# no analog here (no HTTP serving, no client-go, device parallelism):
+# accepted with a warning instead of a strict-decode error so an upstream
+# config file loads unmodified (apis/config/types.go:37–97).
+_TOP_IGNORED_KEYS = {
+    "parallelism", "leaderElection", "clientConnection", "healthzBindAddress",
+    "metricsBindAddress", "enableProfiling", "enableContentionProfiling",
+    "delayCacheUntilActive",
 }
 _PROFILE_KEYS = {"schedulerName", "percentageOfNodesToScore", "plugins", "pluginConfig"}
-_PLUGIN_SET_KEYS = {"filter", "score"}
+_PLUGIN_SET_KEYS = {"multiPoint", *EXTENSION_POINTS}
 _PLUGIN_LIST_KEYS = {"enabled", "disabled"}
 _ARG_PLUGINS = {
     "NodeResourcesFit", "InterPodAffinity", "NodeAffinity", "PodTopologySpread",
 }
+_EXTENDER_KEYS = {
+    "urlPrefix", "filterVerb", "preemptVerb", "prioritizeVerb", "weight",
+    "bindVerb", "enableHTTPS", "tlsConfig", "httpTimeout", "nodeCacheCapable",
+    "managedResources", "ignorable",
+}
+# Profile field each extension point's expanded list lands in.
+_POINT_FIELD = POINT_FIELD
+
+
+def _points_of(name: str):
+    return PLUGIN_POINTS.get(name, FOREIGN_PLUGIN_POINTS.get(name))
 
 
 def is_versioned(raw: dict) -> bool:
@@ -67,9 +100,9 @@ def _err(path: str, msg: str) -> ValueError:
     return ValueError(f"{path}: {msg}")
 
 
-def _merge_plugin_list(defaults, raw: dict, path: str, weighted: bool):
-    """mergePlugins (default_plugins.go:81): defaults minus ``disabled``
-    plus ``enabled`` appended in order."""
+def _parse_plugin_set(raw: dict, path: str):
+    """Parse one v1 PluginSet: {"enabled": [(name, weight|None)...],
+    "disabled": {names}} with strict key checking."""
     unknown = set(raw) - _PLUGIN_LIST_KEYS
     if unknown:
         raise _err(path, f"unknown keys {sorted(unknown)}")
@@ -80,12 +113,7 @@ def _merge_plugin_list(defaults, raw: dict, path: str, weighted: bool):
         if not d.get("name"):
             raise _err(path, "disabled entry missing name")
     disabled = {d["name"] for d in raw.get("disabled", [])}
-    if "*" in disabled:
-        out = []
-    elif weighted:
-        out = [(n, w) for n, w in defaults if n not in disabled]
-    else:
-        out = [n for n in defaults if n not in disabled]
+    enabled: list[tuple[str, int | None]] = []
     for e in raw.get("enabled", []):
         bad = set(e) - {"name", "weight"}
         if bad:
@@ -93,13 +121,85 @@ def _merge_plugin_list(defaults, raw: dict, path: str, weighted: bool):
         name = e.get("name")
         if not name:
             raise _err(path, "enabled entry missing name")
-        if weighted:
-            out.append((name, int(e.get("weight", 1))))
-        elif "weight" in e:
-            raise _err(path, f"enabled[{name!r}]: weight is a score-phase field")
-        else:
-            out.append(name)
-    return tuple(out)
+        enabled.append((name, int(e["weight"]) if "weight" in e else None))
+    return enabled, disabled
+
+
+def _merge_plugin_set(default_enabled, custom_enabled, custom_disabled):
+    """mergePluginSet (default_plugins.go:110): defaults minus disabled,
+    with explicitly re-configured defaults replaced IN PLACE; then the
+    remaining custom entries appended in order."""
+    enabled_custom = {name: (i, (name, w)) for i, (name, w) in enumerate(custom_enabled)}
+    replaced: set[int] = set()
+    out: list[tuple[str, int | None]] = []
+    if "*" not in custom_disabled:
+        for name, w in default_enabled:
+            if name in custom_disabled:
+                continue
+            if name in enabled_custom:
+                idx, entry = enabled_custom[name]
+                replaced.add(idx)
+                out.append(entry)
+            else:
+                out.append((name, w))
+    for i, entry in enumerate(custom_enabled):
+        if i not in replaced:
+            out.append(entry)
+    return out
+
+
+def _expand_points(plugin_sets: dict, path: str, gates: FeatureGates):
+    """The per-point effective plugin lists: mergePlugins over the default
+    MultiPoint set (default_plugins.go:81) followed by
+    expandMultiPointPlugins' ordering (runtime/framework.go:511):
+    part 1 — specific-point entries overriding a MultiPoint plugin, in
+    specific order; part 2 — MultiPoint-only plugins; part 3 — remaining
+    specific-point entries.  Returns {point: [(name, weight|None)]}."""
+    default_mp = [
+        (n, w if w else None)
+        for n, w in DEFAULT_MULTIPOINT
+        if gates.enabled("DynamicResourceAllocation") or n != "DynamicResources"
+    ]
+    mp_enabled, mp_disabled = plugin_sets.get("multiPoint", ([], set()))
+    merged_mp = _merge_plugin_set(default_mp, mp_enabled, mp_disabled)
+    out: dict[str, list[tuple[str, int | None]]] = {}
+    for point in EXTENSION_POINTS:
+        specific_enabled, specific_disabled = plugin_sets.get(point, ([], set()))
+        enabled_names = [n for n, _w in specific_enabled]
+        if "*" in specific_disabled:
+            # expandMultiPointPlugins: all defaults disabled for this point —
+            # only the explicitly-enabled specific plugins run.
+            out[point] = list(specific_enabled)
+            continue
+        multipoint_only: list[tuple[str, int | None]] = []
+        override_names: set[str] = set()
+        seen_mp: set[str] = set()
+        for name, w in merged_mp:
+            pts = _points_of(name)
+            if pts is None:
+                raise _err(
+                    f"{path}.plugins.multiPoint", f"plugin {name!r} does not exist"
+                )
+            if point not in pts:
+                continue
+            if name in specific_disabled:
+                continue
+            if name in enabled_names:
+                override_names.add(name)
+                continue
+            if name in seen_mp:
+                raise _err(
+                    f"{path}.plugins.multiPoint",
+                    f"plugin {name!r} already registered as {point}",
+                )
+            seen_mp.add(name)
+            multipoint_only.append((name, w))
+        final: list[tuple[str, int | None]] = []
+        final.extend(e for e in specific_enabled if e[0] in override_names)
+        final.extend(multipoint_only)
+        final.extend(e for e in specific_enabled if e[0] not in override_names)
+        out[point] = final
+    return out
 
 
 def _selector_term(raw: dict, path: str) -> t.NodeSelectorTerm:
@@ -179,7 +279,10 @@ def _spread_constraint(raw: dict, path: str) -> t.TopologySpreadConstraint:
     )
 
 
-def _apply_plugin_config(kwargs: dict, entries: list, path: str) -> None:
+def _apply_plugin_config(
+    kwargs: dict, entries: list, path: str, foreign_enabled: list[str] = ()
+) -> None:
+    foreign_args: dict[str, str] = {n: "{}" for n in foreign_enabled}
     seen: set[str] = set()
     for i, pc in enumerate(entries):
         p = f"{path}.pluginConfig[{i}]"
@@ -187,6 +290,20 @@ def _apply_plugin_config(kwargs: dict, entries: list, path: str) -> None:
         if bad:
             raise _err(p, f"unknown keys {sorted(bad)}")
         name = pc.get("name")
+        if name in FOREIGN_PLUGIN_POINTS:
+            # Out-of-tree plugins (the Go-side TPUBatchScore) keep their
+            # args opaque: runtime.Unknown payloads decoded by the plugin's
+            # own factory, not this scheme (runtime/registry.go).
+            if name in seen:
+                raise _err(p, f"duplicate pluginConfig for {name!r}")
+            seen.add(name)
+            try:
+                foreign_args[name] = json.dumps(
+                    pc.get("args", {}), sort_keys=True
+                )
+            except (TypeError, ValueError) as e:
+                raise _err(p, f"args not JSON-serializable: {e}")
+            continue
         if name not in _ARG_PLUGINS:
             raise _err(p, f"no args surface for plugin {name!r}")
         if name in seen:
@@ -282,16 +399,106 @@ def _apply_plugin_config(kwargs: dict, entries: list, path: str) -> None:
                     _spread_constraint(c, f"{p}.defaultConstraints[{j}]")
                     for j, c in enumerate(args.get("defaultConstraints", []))
                 )
+    if foreign_args:
+        kwargs["foreign"] = tuple(sorted(foreign_args.items()))
+
+
+def _parse_duration_s(v, path: str) -> float:
+    """metav1.Duration JSON form ("30s", "100ms", "1m30s") or a number of
+    seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    import re
+
+    # Longest-first alternation: "ms" must not parse as minutes+stray "s".
+    if not isinstance(v, str) or not re.fullmatch(
+        r"(\d+(\.\d+)?(ms|us|ns|h|m|s))+", v
+    ):
+        raise _err(path, f"invalid duration {v!r}")
+    unit_s = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+    return sum(
+        float(num) * unit_s[unit]
+        for num, _frac, unit in re.findall(r"(\d+(\.\d+)?)(ms|us|ns|h|m|s)", v)
+    )
+
+
+def _parse_extenders(raw_list: list, warnings: list[str]):
+    """The top-level ``extenders`` stanza (apis/config/types.go:259
+    Extender) → HTTPExtender clients + the extender-managed resources the
+    fit filter must ignore (buildExtenders, scheduler.go:496–536).
+    Returns (extenders, ignored_resources)."""
+    from ..extender import HTTPExtender
+
+    extenders = []
+    ignored: list[str] = []
+    binders = 0
+    for i, re_ in enumerate(raw_list):
+        path = f"extenders[{i}]"
+        bad = set(re_) - _EXTENDER_KEYS
+        if bad:
+            raise _err(path, f"unknown keys {sorted(bad)}")
+        url = re_.get("urlPrefix")
+        if not url:
+            # validation.go ValidateExtender: URLPrefix is required.
+            raise _err(path, "urlPrefix is required")
+        weight = int(re_.get("weight", 1))
+        if re_.get("prioritizeVerb") and weight <= 0:
+            raise _err(path, "weight must be positive with prioritizeVerb")
+        if re_.get("bindVerb"):
+            binders += 1
+            if binders > 1:
+                # validation.go: only one extender may implement bind.
+                raise _err(path, "only one extender can implement bind")
+        for key in ("enableHTTPS", "tlsConfig", "nodeCacheCapable"):
+            if re_.get(key):
+                warnings.append(
+                    f"{path}.{key}: accepted but ignored (plain-HTTP "
+                    "full-payload extender client)"
+                )
+        managed: list[str] = []
+        for j, mr in enumerate(re_.get("managedResources", [])):
+            mbad = set(mr) - {"name", "ignoredByScheduler"}
+            if mbad:
+                raise _err(path, f"managedResources[{j}]: unknown keys {sorted(mbad)}")
+            if not mr.get("name"):
+                raise _err(path, f"managedResources[{j}]: name is required")
+            managed.append(mr["name"])
+            if mr.get("ignoredByScheduler"):
+                ignored.append(mr["name"])
+        timeout_s = 5.0
+        if "httpTimeout" in re_:
+            timeout_s = _parse_duration_s(re_["httpTimeout"], f"{path}.httpTimeout")
+        extenders.append(
+            HTTPExtender(
+                url_prefix=url,
+                filter_verb=re_.get("filterVerb", ""),
+                prioritize_verb=re_.get("prioritizeVerb", ""),
+                bind_verb=re_.get("bindVerb", ""),
+                preempt_verb=re_.get("preemptVerb", ""),
+                weight=weight,
+                ignorable=bool(re_.get("ignorable", False)),
+                timeout_s=timeout_s,
+                managed_resources=tuple(managed),
+            )
+        )
+    return extenders, ignored
 
 
 def convert(raw: dict) -> dict:
     """Convert + default an external v1 config into the internal form:
-    {"profiles": [Profile], "batch_size", "chunk_size", "feature_gates"}."""
+    {"profiles": [Profile], "batch_size", "chunk_size", "feature_gates",
+    "extenders", "pod_initial_backoff_s", "pod_max_backoff_s", "warnings"}."""
     if raw.get("apiVersion") != API_VERSION:
         raise _err("apiVersion", f"expected {API_VERSION!r}, got {raw.get('apiVersion')!r}")
     if raw.get("kind") != KIND:
         raise _err("kind", f"expected {KIND!r}, got {raw.get('kind')!r}")
-    unknown = set(raw) - _TOP_KEYS
+    warnings: list[str] = []
+    for key in sorted(set(raw) & _TOP_IGNORED_KEYS):
+        # Upstream configs carry these (types.go:37–97); none have an analog
+        # here (no HTTP serving / client-go / host parallelism), so they are
+        # accepted with a warning rather than a strict-decode error.
+        warnings.append(f"{key}: accepted but ignored")
+    unknown = set(raw) - _TOP_KEYS - _TOP_IGNORED_KEYS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
     gates: FeatureGates = DEFAULT_GATES
@@ -323,31 +530,48 @@ def convert(raw: dict) -> dict:
         badp = set(plugins) - _PLUGIN_SET_KEYS
         if badp:
             raise _err(f"{path}.plugins", f"unknown extension points {sorted(badp)}")
-        if "filter" in plugins:
-            kwargs["filters"] = _merge_plugin_list(
-                DEFAULT_PROFILE.filters, plugins["filter"],
-                f"{path}.plugins.filter", weighted=False,
-            )
-        if "score" in plugins:
-            kwargs["scorers"] = _merge_plugin_list(
-                DEFAULT_PROFILE.scorers, plugins["score"],
-                f"{path}.plugins.score", weighted=True,
-            )
-        _apply_plugin_config(kwargs, rp.get("pluginConfig", []), path)
+        plugin_sets = {
+            key: _parse_plugin_set(plugins[key], f"{path}.plugins.{key}")
+            for key in plugins
+        }
         if not gates.enabled("DynamicResourceAllocation"):
             # plugins/registry.go:49 — the plugin is not registered when the
             # gate is off, so EXPLICITLY enabling it is a config error.  The
             # default set's copy is stripped by TPUScheduler (the single
             # gate-strip site) when these gates reach it.
-            if "plugins" in rp and "filter" in rp["plugins"] and any(
-                e.get("name") == "DynamicResources"
-                for e in rp["plugins"]["filter"].get("enabled", [])
-            ):
-                raise _err(
-                    f"{path}.plugins.filter",
-                    "DynamicResources requires the DynamicResourceAllocation "
-                    "feature gate",
-                )
+            for key, (enabled, _dis) in plugin_sets.items():
+                if any(n == "DynamicResources" for n, _w in enabled):
+                    raise _err(
+                        f"{path}.plugins.{key}",
+                        "DynamicResources requires the DynamicResourceAllocation "
+                        "feature gate",
+                    )
+        expanded = _expand_points(plugin_sets, path, gates)
+        foreign_enabled: list[str] = []
+        if plugins:
+            for point in EXTENSION_POINTS:
+                field_name = _POINT_FIELD[point]
+                entries = expanded[point]
+                for n, _w in entries:
+                    if n in FOREIGN_PLUGIN_POINTS and n not in foreign_enabled:
+                        foreign_enabled.append(n)
+                if point == "score":
+                    # getScoreWeights (runtime/framework.go:449): the entry's
+                    # weight, defaulting 0/absent to 1; overflow guarded
+                    # against MaxTotalScore.
+                    scorers = tuple((n, w if w else 1) for n, w in entries)
+                    total = sum(w for _n, w in scorers) * MAX_NODE_SCORE
+                    if total > MAX_TOTAL_SCORE:
+                        raise _err(
+                            f"{path}.plugins.score",
+                            "total score of Score plugins could overflow",
+                        )
+                    kwargs["scorers"] = scorers
+                else:
+                    kwargs[field_name] = tuple(n for n, _w in entries)
+        _apply_plugin_config(
+            kwargs, rp.get("pluginConfig", []), path, foreign_enabled
+        )
         profiles.append(Profile(**kwargs))
     if not profiles:
         default = DEFAULT_PROFILE
@@ -361,15 +585,200 @@ def convert(raw: dict) -> dict:
     # `serve --config` refuses them, not just the validate subcommand.
     from .config import validate_profile
 
+    extenders, ext_ignored = _parse_extenders(raw.get("extenders", []), warnings)
+    if ext_ignored:
+        # buildExtenders (scheduler.go:496–536): resources managed by an
+        # extender with ignoredByScheduler join the fit filter's ignored set
+        # for every profile.
+        profiles = [
+            dataclasses.replace(
+                p,
+                fit_ignored_resources=tuple(
+                    dict.fromkeys((*p.fit_ignored_resources, *ext_ignored))
+                ),
+            )
+            for p in profiles
+        ]
     for p in profiles:
         errs = validate_profile(p)
         if errs:
             raise ValueError(
                 f"profile {p.name!r}: " + "; ".join(errs)
             )
-    return {
+    out = {
         "profiles": profiles,
         "batch_size": int(raw.get("batchSize", 256)),
         "chunk_size": int(raw.get("chunkSize", 1)),
         "feature_gates": gates,
+        "extenders": extenders,
+        "warnings": warnings,
     }
+    # PodInitialBackoffSeconds / PodMaxBackoffSeconds (types.go:71–76) wire
+    # into the queue's backoff heap (queue.py).
+    if "podInitialBackoffSeconds" in raw:
+        out["pod_initial_backoff_s"] = float(raw["podInitialBackoffSeconds"])
+        if out["pod_initial_backoff_s"] <= 0:
+            # validation.go: must be greater than 0.
+            raise ValueError("podInitialBackoffSeconds must be positive")
+    if "podMaxBackoffSeconds" in raw:
+        out["pod_max_backoff_s"] = float(raw["podMaxBackoffSeconds"])
+        if out["pod_max_backoff_s"] <= 0:
+            raise ValueError("podMaxBackoffSeconds must be positive")
+    if (
+        out.get("pod_initial_backoff_s", 1.0) > out.get("pod_max_backoff_s", 10.0)
+    ):
+        raise ValueError(
+            "podInitialBackoffSeconds must not exceed podMaxBackoffSeconds"
+        )
+    return out
+
+
+def _dump_selector_term(term: t.NodeSelectorTerm) -> dict:
+    out: dict = {}
+    if term.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in term.match_expressions
+        ]
+    if term.match_fields:
+        out["matchFields"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in term.match_fields
+        ]
+    return out
+
+
+def _dump_added_affinity(aff: t.NodeAffinity) -> dict:
+    out: dict = {}
+    if aff.required is not None:
+        out["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [
+                _dump_selector_term(term) for term in aff.required.terms
+            ]
+        }
+    if aff.preferred:
+        out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": p.weight, "preference": _dump_selector_term(p.preference)}
+            for p in aff.preferred
+        ]
+    return out
+
+
+def dump(cfg: dict) -> dict:
+    """The internal form back to external v1 — the /configz analog
+    (component-base configz; kube-scheduler --write-config-to).  Per-point
+    plugin lists are emitted explicitly with ``disabled: [{"name": "*"}]``
+    so ``convert(dump(convert(x)))`` reproduces ``convert(x)`` exactly."""
+    gates: FeatureGates = cfg.get("feature_gates") or DEFAULT_GATES
+    out: dict = {"apiVersion": API_VERSION, "kind": KIND}
+    if gates.overrides:
+        out["featureGates"] = {k: v for k, v in gates.overrides}
+    out["batchSize"] = cfg.get("batch_size", 256)
+    out["chunkSize"] = cfg.get("chunk_size", 1)
+    if "pod_initial_backoff_s" in cfg:
+        out["podInitialBackoffSeconds"] = cfg["pod_initial_backoff_s"]
+    if "pod_max_backoff_s" in cfg:
+        out["podMaxBackoffSeconds"] = cfg["pod_max_backoff_s"]
+    ext_out = []
+    for ex in cfg.get("extenders", []):
+        e: dict = {"urlPrefix": ex.url_prefix}
+        if ex.filter_verb:
+            e["filterVerb"] = ex.filter_verb
+        if ex.prioritize_verb:
+            e["prioritizeVerb"] = ex.prioritize_verb
+        if ex.bind_verb:
+            e["bindVerb"] = ex.bind_verb
+        if ex.preempt_verb:
+            e["preemptVerb"] = ex.preempt_verb
+        e["weight"] = ex.weight
+        if ex.ignorable:
+            e["ignorable"] = True
+        e["httpTimeout"] = f"{ex.timeout_s:g}s"
+        if ex.managed_resources:
+            e["managedResources"] = [
+                {"name": r} for r in ex.managed_resources
+            ]
+        ext_out.append(e)
+    if ext_out:
+        out["extenders"] = ext_out
+    profs = []
+    for p in cfg.get("profiles", []):
+        rp: dict = {"schedulerName": p.name}
+        if p.percentage_of_nodes_to_score is not None:
+            rp["percentageOfNodesToScore"] = p.percentage_of_nodes_to_score
+        plugins: dict = {
+            "multiPoint": {"disabled": [{"name": "*"}]},
+        }
+        point_values = {
+            point: getattr(p, fld) for point, fld in POINT_FIELD.items()
+        }
+        for point, values in point_values.items():
+            entries = []
+            for v in values:
+                if point == "score":
+                    name, w = v
+                    entries.append({"name": name, "weight": w})
+                else:
+                    entries.append({"name": v})
+            plugins[point] = {
+                "enabled": entries,
+                "disabled": [{"name": "*"}],
+            }
+        rp["plugins"] = plugins
+        pc = []
+        strat = p.scoring_strategy
+        fit_args: dict = {
+            "scoringStrategy": {
+                "type": strat.type,
+                "resources": [
+                    {"name": n, "weight": w} for n, w in strat.resources
+                ],
+            }
+        }
+        if strat.type == "RequestedToCapacityRatio":
+            fit_args["scoringStrategy"]["requestedToCapacityRatio"] = {
+                "shape": [
+                    {"utilization": u, "score": s} for u, s in strat.shape
+                ]
+            }
+        if p.fit_ignored_resources:
+            fit_args["ignoredResources"] = list(p.fit_ignored_resources)
+        if p.fit_ignored_resource_groups:
+            fit_args["ignoredResourceGroups"] = list(p.fit_ignored_resource_groups)
+        pc.append({"name": "NodeResourcesFit", "args": fit_args})
+        pc.append(
+            {
+                "name": "InterPodAffinity",
+                "args": {"hardPodAffinityWeight": p.hard_pod_affinity_weight},
+            }
+        )
+        if p.added_affinity is not None:
+            pc.append(
+                {
+                    "name": "NodeAffinity",
+                    "args": {"addedAffinity": _dump_added_affinity(p.added_affinity)},
+                }
+            )
+        if p.pts_default_constraints:
+            pc.append(
+                {
+                    "name": "PodTopologySpread",
+                    "args": {
+                        "defaultingType": "List",
+                        "defaultConstraints": [
+                            {
+                                "maxSkew": c.max_skew,
+                                "topologyKey": c.topology_key,
+                                "whenUnsatisfiable": c.when_unsatisfiable,
+                            }
+                            for c in p.pts_default_constraints
+                        ],
+                    },
+                }
+            )
+        for name, args_json in p.foreign:
+            pc.append({"name": name, "args": json.loads(args_json)})
+        rp["pluginConfig"] = pc
+        profs.append(rp)
+    out["profiles"] = profs
+    return out

@@ -191,6 +191,10 @@ class SchedulingQueue:
         # not registered (plugins/registry.go), so .spec.schedulingGates is
         # ignored and gated pods enter the queue like any other.
         self.respect_scheduling_gates = True
+        # Per-profile PreEnqueue (profile.pre_enqueue): the scheduler
+        # installs a pod → bool predicate saying whether the pod's profile
+        # runs SchedulingGates; None = every profile does.
+        self.gates_apply_to = None
 
     def __len__(self) -> int:
         return len(self._in_active)
@@ -258,7 +262,11 @@ class SchedulingQueue:
         qp.pod = pod
         # PreEnqueue: SchedulingGates holds gated pods out of every queue
         # (plugins/schedulinggates/scheduling_gates.go).
-        if self.respect_scheduling_gates and pod.spec.scheduling_gates:
+        if (
+            self.respect_scheduling_gates
+            and pod.spec.scheduling_gates
+            and (self.gates_apply_to is None or self.gates_apply_to(pod))
+        ):
             qp.gated = True
             self._gated[pod.uid] = qp
             return

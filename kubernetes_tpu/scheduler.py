@@ -114,9 +114,15 @@ class TPUScheduler:
             self.profiles = {
                 name: _dc.replace(
                     p,
-                    filters=tuple(
-                        f for f in p.filters if f != "DynamicResources"
-                    ),
+                    **{
+                        fld: tuple(
+                            f for f in getattr(p, fld) if f != "DynamicResources"
+                        )
+                        for fld in (
+                            "filters", "pre_enqueue", "pre_filter",
+                            "post_filter", "reserve", "pre_bind",
+                        )
+                    },
                 )
                 for name, p in self.profiles.items()
             }
@@ -149,6 +155,9 @@ class TPUScheduler:
         )
         self.queue.respect_scheduling_gates = self.feature_gates.enabled(
             "PodSchedulingReadiness"
+        )
+        self.queue.gates_apply_to = lambda pod: "SchedulingGates" in (
+            (self._profile_for(pod) or self.profile).pre_enqueue
         )
         # Featurizers read gates via FeaturizeContext.gates (the
         # plfeature.Features snapshot, plugins/registry.go:49).
@@ -656,7 +665,7 @@ class TPUScheduler:
         g, _pl = self._permit_group(qp.pod)
         if g is not None:
             return False
-        return not any(rp.relevant(qp.pod, self) for rp in self.reserve_plugins)
+        return not any(rp.relevant(qp.pod, self) for rp in self._reserve_for(qp.pod))
 
     def _commit_preempted(
         self, qp: QueuedPodInfo, outcome, res, delta, now: float
@@ -697,12 +706,36 @@ class TPUScheduler:
 
     def _permit_group(self, pod: t.Pod):
         """The (group, owning PermitPlugin) a pod waits under, or
-        (None, None) when no registered plugin claims it."""
+        (None, None) when no registered plugin claims it.  Plugins run only
+        for profiles listing them at the permit point (the per-profile
+        framework: a profile without the plugin simply lacks it)."""
+        from .framework.config import PLUGIN_POINTS
+
+        permitted = (self._profile_for(pod) or self.profile).permit
         for pl in self.permit_plugins:
+            name = getattr(pl, "name", None)
+            # Only config-addressable plugins are subject to the profile's
+            # permit list; programmatically-registered ones (the generic
+            # host-plugin surface) always run.
+            if name in PLUGIN_POINTS and name not in permitted:
+                continue
             g = pl.group_of(pod)
             if g is not None:
                 return g, pl
         return None, None
+
+    def _reserve_for(self, pod: t.Pod) -> list:
+        """Reserve plugins enabled for the pod's profile (profile.reserve —
+        the per-profile Reserve list, types.go Plugins.Reserve).  Plugins
+        not addressable from config (no registered name) always run."""
+        from .framework.config import PLUGIN_POINTS
+
+        enabled = (self._profile_for(pod) or self.profile).reserve
+        return [
+            rp for rp in self.reserve_plugins
+            if getattr(rp, "name", None) not in PLUGIN_POINTS
+            or rp.name in enabled
+        ]
 
     def expire_waiting_gangs(self, timeout_s: float | None = None) -> int:
         """WaitOnPermit timeout: forget and re-park members of groups whose
@@ -808,7 +841,10 @@ class TPUScheduler:
             # PostFilter (schedule_one.go:749): extender profiles run
             # preemption too; extenders with a preempt verb veto the chosen
             # candidate (ProcessPreemption, preemption.go:249).
-            if self.preemption is not None:
+            if (
+                self.preemption is not None
+                and "DefaultPreemption" in profile.post_filter
+            ):
                 rows = {
                     k: [np.asarray(v)[0]] for k, v in batch.items() if k != "valid"
                 }
@@ -869,7 +905,7 @@ class TPUScheduler:
 
         # Reserve through the same plugin chain the batch path runs.
         undos: list = []
-        for rp in self.reserve_plugins:
+        for rp in self._reserve_for(qp.pod):
             if not rp.relevant(qp.pod, self):
                 continue
             u = rp.reserve(qp.pod, best, self)
@@ -1452,7 +1488,7 @@ class TPUScheduler:
             undos: list = []  # [(plugin, undo)] in reserve order
             reserve_failed = False
             relevant = [
-                rp for rp in self.reserve_plugins if rp.relevant(qp.pod, self)
+                rp for rp in self._reserve_for(qp.pod) if rp.relevant(qp.pod, self)
             ]
             t_pb = time.perf_counter() if relevant else 0.0
             for rp in relevant:
@@ -1553,7 +1589,12 @@ class TPUScheduler:
         t_post = time.perf_counter()
         # (Preemption also sits out a schema-grown batch: its pass would mix
         # old-shape feature rows with rebuilt state; failures just requeue.)
-        if failed and self.preemption is not None and not schema_grew:
+        if (
+            failed
+            and self.preemption is not None
+            and "DefaultPreemption" in profile.post_filter
+            and not schema_grew
+        ):
             ran_postfilter = True
             rows = {
                 key: [np.asarray(arr)[i] for i, _, _ in failed]

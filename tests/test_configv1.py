@@ -199,7 +199,7 @@ def test_strict_unknown_keys():
     with pytest.raises(ValueError, match="unknown keys"):
         configv1.convert(v1(profiles=[{"nope": 1}]))
     with pytest.raises(ValueError, match="unknown extension points"):
-        configv1.convert(v1(profiles=[{"plugins": {"preBind": {}}}]))
+        configv1.convert(v1(profiles=[{"plugins": {"fooPoint": {}}}]))
     with pytest.raises(ValueError, match="no args surface"):
         configv1.convert(
             v1(profiles=[{"pluginConfig": [{"name": "NodePorts", "args": {}}]}])
@@ -316,3 +316,358 @@ def test_cli_loads_versioned_config(tmp_path):
     assert loaded["batch_size"] == 64
     assert loaded["chunk_size"] == 8
     assert loaded["profiles"][0].name == "custom"
+
+
+# ---------------------------------------------------------------------------
+# Round-4 surface: multiPoint, all extension points, extenders, warn-keys
+# (apis/config/v1/default_plugins.go:81 mergePlugins,
+#  runtime/framework.go:511 expandMultiPointPlugins, types.go:259 Extender).
+
+
+def test_multipoint_expansion_defaults_every_point():
+    cfg = configv1.convert(
+        v1(profiles=[{"schedulerName": "x", "plugins": {"multiPoint": {}}}])
+    )
+    p = cfg["profiles"][0]
+    assert p.filters == DEFAULT_PROFILE.filters
+    assert p.scorers == DEFAULT_PROFILE.scorers
+    assert p.pre_enqueue == DEFAULT_PROFILE.pre_enqueue
+    assert p.queue_sort == ("PrioritySort",)
+    assert p.post_filter == ("DynamicResources", "DefaultPreemption")
+    assert p.reserve == ("VolumeBinding", "DynamicResources")
+    assert p.pre_bind == ("VolumeBinding", "DynamicResources")
+    assert p.bind == ("DefaultBinder",)
+
+
+def test_multipoint_disable_star_with_specific_reenables():
+    # The plugin.go doc-comment profile (the out-of-tree TPUBatchScore
+    # registration shape).
+    cfg = configv1.convert(
+        v1(
+            profiles=[
+                {
+                    "schedulerName": "tpu-batch-score",
+                    "plugins": {
+                        "multiPoint": {
+                            "enabled": [{"name": "TPUBatchScore"}],
+                            "disabled": [{"name": "*"}],
+                        },
+                        "queueSort": {"enabled": [{"name": "PrioritySort"}]},
+                        "bind": {"enabled": [{"name": "DefaultBinder"}]},
+                    },
+                    "pluginConfig": [
+                        {
+                            "name": "TPUBatchScore",
+                            "args": {"socket": "/var/run/tpu-sidecar.sock"},
+                        }
+                    ],
+                }
+            ]
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.filters == ("TPUBatchScore",)
+    assert p.scorers == (("TPUBatchScore", 1),)
+    assert p.post_filter == ("TPUBatchScore",)
+    assert p.queue_sort == ("PrioritySort",)
+    assert p.bind == ("DefaultBinder",)
+    assert p.permit == ()
+    assert dict(p.foreign)["TPUBatchScore"] == json.dumps(
+        {"socket": "/var/run/tpu-sidecar.sock"}, sort_keys=True
+    )
+
+
+def test_multipoint_override_moves_to_front_with_specific_weight():
+    # expandMultiPointPlugins part-1 ordering: a specific-point re-config of
+    # a multiPoint plugin overrides AND leads the list.
+    cfg = configv1.convert(
+        v1(
+            profiles=[
+                {
+                    "schedulerName": "x",
+                    "plugins": {
+                        "score": {
+                            "enabled": [{"name": "ImageLocality", "weight": 9}]
+                        }
+                    },
+                }
+            ]
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.scorers[0] == ("ImageLocality", 9)
+    assert ("ImageLocality", 1) not in p.scorers
+    assert len([s for s in p.scorers if s[0] == "ImageLocality"]) == 1
+
+
+def test_multipoint_unknown_plugin_errors():
+    with pytest.raises(ValueError, match="does not exist"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "schedulerName": "x",
+                        "plugins": {
+                            "multiPoint": {"enabled": [{"name": "NoSuchPlugin"}]}
+                        },
+                    }
+                ]
+            )
+        )
+
+
+def test_per_point_disabled_star_keeps_only_specific():
+    cfg = configv1.convert(
+        v1(
+            profiles=[
+                {
+                    "schedulerName": "x",
+                    "plugins": {
+                        "postFilter": {"disabled": [{"name": "*"}]},
+                        "permit": {"disabled": [{"name": "*"}]},
+                    },
+                }
+            ]
+        )
+    )
+    p = cfg["profiles"][0]
+    assert p.post_filter == ()
+    assert p.permit == ()
+    # other points keep defaults
+    assert p.filters == DEFAULT_PROFILE.filters
+
+
+def test_queue_sort_and_bind_are_mandatory():
+    with pytest.raises(ValueError, match="queue sort"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "schedulerName": "x",
+                        "plugins": {"queueSort": {"disabled": [{"name": "*"}]}},
+                    }
+                ]
+            )
+        )
+    with pytest.raises(ValueError, match="bind"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "schedulerName": "x",
+                        "plugins": {"bind": {"disabled": [{"name": "*"}]}},
+                    }
+                ]
+            )
+        )
+
+
+def test_upstream_shaped_config_accepted_with_warnings():
+    cfg = configv1.convert(
+        v1(
+            clientConnection={"kubeconfig": "/etc/kubernetes/scheduler.conf"},
+            leaderElection={"leaderElect": True},
+            parallelism=16,
+            enableProfiling=True,
+            healthzBindAddress="0.0.0.0:10251",
+            metricsBindAddress="0.0.0.0:10251",
+            podInitialBackoffSeconds=1,
+            podMaxBackoffSeconds=10,
+            profiles=[{"schedulerName": "default-scheduler"}],
+        )
+    )
+    assert cfg["profiles"][0].filters == DEFAULT_PROFILE.filters
+    assert cfg["pod_initial_backoff_s"] == 1.0
+    assert cfg["pod_max_backoff_s"] == 10.0
+    warned = {w.split(":")[0] for w in cfg["warnings"]}
+    assert {"clientConnection", "leaderElection", "parallelism"} <= warned
+
+
+def test_backoff_bounds_validated():
+    with pytest.raises(ValueError, match="podInitialBackoffSeconds"):
+        configv1.convert(v1(podInitialBackoffSeconds=20, podMaxBackoffSeconds=10))
+
+
+def test_extenders_stanza_parses_and_validates():
+    cfg = configv1.convert(
+        v1(
+            extenders=[
+                {
+                    "urlPrefix": "http://127.0.0.1:8888/sched",
+                    "filterVerb": "filter",
+                    "prioritizeVerb": "prioritize",
+                    "weight": 2,
+                    "httpTimeout": "30s",
+                    "ignorable": True,
+                    "managedResources": [
+                        {"name": "example.com/foo", "ignoredByScheduler": True}
+                    ],
+                }
+            ]
+        )
+    )
+    (ex,) = cfg["extenders"]
+    assert ex.url_prefix == "http://127.0.0.1:8888/sched"
+    assert ex.timeout_s == 30.0 and ex.weight == 2 and ex.ignorable
+    # buildExtenders (scheduler.go:496): ignoredByScheduler resources join
+    # the fit filter's ignored set.
+    assert "example.com/foo" in cfg["profiles"][0].fit_ignored_resources
+    with pytest.raises(ValueError, match="urlPrefix"):
+        configv1.convert(v1(extenders=[{"filterVerb": "f"}]))
+    with pytest.raises(ValueError, match="one extender"):
+        configv1.convert(
+            v1(
+                extenders=[
+                    {"urlPrefix": "http://a", "bindVerb": "bind"},
+                    {"urlPrefix": "http://b", "bindVerb": "bind"},
+                ]
+            )
+        )
+
+
+def test_dump_round_trips():
+    src = v1(
+        featureGates={"SchedulerQueueingHints": False},
+        extenders=[
+            {
+                "urlPrefix": "http://127.0.0.1:8888/sched",
+                "filterVerb": "filter",
+                "weight": 3,
+                "httpTimeout": "2s",
+            }
+        ],
+        profiles=[
+            {
+                "schedulerName": "custom",
+                "percentageOfNodesToScore": 50,
+                "plugins": {
+                    "score": {"enabled": [{"name": "ImageLocality", "weight": 4}]},
+                    "permit": {"disabled": [{"name": "*"}]},
+                },
+                "pluginConfig": [
+                    {"name": "InterPodAffinity", "args": {"hardPodAffinityWeight": 7}}
+                ],
+            }
+        ],
+    )
+    cfg = configv1.convert(src)
+    cfg2 = configv1.convert(configv1.dump(cfg))
+    assert cfg2["profiles"] == cfg["profiles"]
+    assert [e.url_prefix for e in cfg2["extenders"]] == [
+        e.url_prefix for e in cfg["extenders"]
+    ]
+    assert cfg2["feature_gates"] == cfg["feature_gates"]
+
+
+def test_profile_postfilter_gates_preemption():
+    # A profile without DefaultPreemption at postFilter never preempts
+    # (RunPostFilterPlugins runs only registered plugins, framework.go:908).
+    sched = TPUScheduler(batch_size=4)
+    import dataclasses
+
+    sched.profile = dataclasses.replace(sched.profile, post_filter=())
+    sched.profiles[sched.profile.name] = sched.profile
+    sched.add_node(
+        make_node("n1").capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj()
+    )
+    low = make_pod("low").req({"cpu": "2"}).priority(1).obj()
+    sched.add_pod(low)
+    sched.schedule_batch()
+    assert low.spec.node_name == "n1"
+    high = make_pod("high").req({"cpu": "2"}).priority(100).obj()
+    sched.add_pod(high)
+    outcomes = sched.schedule_batch()
+    assert all(o.node_name is None for o in outcomes if o.pod.uid == high.uid)
+    assert sched.metrics.preemptions == 0
+    assert low.spec.node_name == "n1"  # victim untouched
+
+
+def test_profile_without_scheduling_gates_ignores_gates():
+    sched = TPUScheduler(batch_size=4)
+    import dataclasses
+
+    sched.profile = dataclasses.replace(sched.profile, pre_enqueue=())
+    sched.profiles[sched.profile.name] = sched.profile
+    sched.queue.gates_apply_to = lambda pod: "SchedulingGates" in (
+        (sched._profile_for(pod) or sched.profile).pre_enqueue
+    )
+    sched.add_node(
+        make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+    )
+    gated = make_pod("g").req({"cpu": "1"}).obj()
+    gated.spec.scheduling_gates = ("wait",)
+    sched.add_pod(gated)
+    sched.schedule_batch()
+    # Without the SchedulingGates plugin the gate field is inert.
+    assert gated.spec.node_name == "n1"
+
+
+def test_default_profile_fields_match_multipoint_expansion():
+    # Profile's per-point defaults are hand-written literals; they must
+    # stay exactly the expansion of the default MultiPoint set
+    # (default_plugins.go:30–54 expanded per expandMultiPointPlugins).
+    from kubernetes_tpu.framework.config import POINT_FIELD, expand_point
+
+    for point, fld in POINT_FIELD.items():
+        expanded = expand_point(point)
+        value = getattr(DEFAULT_PROFILE, fld)
+        names = tuple(n for n, _w in value) if point == "score" else value
+        assert names == expanded, (point, names, expanded)
+
+
+def test_backoff_must_be_positive():
+    with pytest.raises(ValueError, match="positive"):
+        configv1.convert(v1(podInitialBackoffSeconds=0))
+    with pytest.raises(ValueError, match="positive"):
+        configv1.convert(v1(podInitialBackoffSeconds=-3, podMaxBackoffSeconds=-1))
+
+
+def test_duration_parse_units():
+    from kubernetes_tpu.framework.configv1 import _parse_duration_s
+
+    assert _parse_duration_s("100ms", "t") == pytest.approx(0.1)
+    assert _parse_duration_s("1m30s", "t") == pytest.approx(90.0)
+    assert _parse_duration_s("2h", "t") == pytest.approx(7200.0)
+    assert _parse_duration_s(2.5, "t") == 2.5
+    with pytest.raises(ValueError):
+        _parse_duration_s("5 parsecs", "t")
+
+
+def test_filter_list_rejects_score_only_plugin():
+    # NewFramework "does not extend" (runtime/framework.go:334).
+    with pytest.raises(ValueError, match="unknown plugin|does not extend"):
+        configv1.convert(
+            v1(
+                profiles=[
+                    {
+                        "schedulerName": "x",
+                        "plugins": {
+                            "filter": {"enabled": [{"name": "ImageLocality"}]}
+                        },
+                    }
+                ]
+            )
+        )
+
+
+def test_dra_external_release_discharges():
+    # An external consumer releasing a claim (allocation + reservedFor
+    # cleared by its own scheduler) must deallocate — only LOCAL
+    # reservations are protected by the stale-echo guard (the claim
+    # assume-cache semantics).
+    from kubernetes_tpu.dra import ClaimCatalog
+
+    cat = ClaimCatalog()
+    claim = t.ResourceClaim(
+        name="c1", namespace="default", device_class="gpu", count=2,
+        allocated_node="n1", reserved_for=("ext-pod",),
+    )
+    deltas = cat.add_claim(claim)
+    assert deltas == [("n1", "gpu", 2, +1)]
+    released = t.ResourceClaim(
+        name="c1", namespace="default", device_class="gpu", count=2,
+        allocated_node="", reserved_for=(),
+    )
+    deltas = cat.add_claim(released)
+    assert deltas == [("n1", "gpu", 2, -1)]
+    assert cat.allocated[("n1", "gpu")] == 0
