@@ -86,6 +86,15 @@ class ReservePlugin(Protocol):
     def unreserve(self, undo, sched) -> None:
         """Revert a successful reserve (runtime.RunReservePluginsUnreserve)."""
 
+    # Optional hook (plugins without slow-path PreBinds omit it): keys the
+    # pod's PreBind still waits on after reserve — e.g. open provisioning
+    # intents ("pvc:<uid>").  A pod with pending keys parks in the
+    # scheduler's prebind waiting room instead of binding; events resolve
+    # keys via TPUScheduler.notify_prebind, and the room's timeout
+    # unreserves (the RunPreBindPlugins wait inside the detached
+    # bindingCycle, volume_binding.go:521 BindPodVolumes + bindTimeout).
+    # def prebind_pending(self, pod, undo, sched) -> tuple[str, ...]
+
 
 class DRAReserve:
     """DynamicResources' Reserve: allocate + reserve the pod's claims on the
@@ -122,6 +131,13 @@ class VolumeReserve:
     def unreserve(self, undo, sched) -> None:
         if undo:
             sched.builder.volumes.unbind_pod_volumes(undo)
+
+    def prebind_pending(self, pod: t.Pod, undo, sched) -> tuple[str, ...]:
+        """Open provisioning intents the bind must wait for (wffc "wait"
+        mode; empty in "sync" mode where the PV is created in-process)."""
+        return tuple(
+            f"pvc:{pvc.uid}" for kind, pvc, _x in (undo or ()) if kind == "intent"
+        )
 
 
 DEFAULT_RESERVE_PLUGINS = (DRAReserve(), VolumeReserve())

@@ -208,6 +208,22 @@ class TPUScheduler:
         self.reserve_plugins = list(DEFAULT_RESERVE_PLUGINS)
         # Waiting-room group → owning PermitPlugin (for timeout/rollback).
         self.permit_wait_owner: dict[str, object] = {}
+        # PreBind wait room (the blocking tail of volume_binding.go:521
+        # BindPodVolumes, made non-blocking): pod uid → entry while an
+        # external provisioner works; see notify_prebind /
+        # expire_waiting_prebinds.  Timeout = the reference's bindTimeout
+        # default (volumebinding DefaultBindTimeoutSeconds, 600s).
+        self.prebind_waiting: dict[str, dict] = {}
+        self.prebind_timeout_s = 600.0
+        # Gang members whose PreBind completed while group-mates still wait:
+        # group → [{qp, undos, node}].  A later timeout in the group rolls
+        # these back too (all-or-nothing); the group's last completion
+        # clears its list.
+        self.prebind_done_pending: dict[str, list[dict]] = {}
+        # Binds completed by informer-driven notify_prebind between batches;
+        # the next schedule_batch returns them so outcome-consuming drivers
+        # (the benchmark harness) observe wait-mode binds.
+        self._prebind_outcomes: list[ScheduleOutcome] = []
         # Assumed-pod TTL (cache.go:42 ticks cleanupAssumedPods at 1s; the
         # 30s expiry mirrors durationToExpireAssumedPod's safety-net role).
         self.assume_ttl_s = 30.0
@@ -491,6 +507,21 @@ class TPUScheduler:
                     continue
                 self.queue.reactivate(qp)
         self._drop_permit_waiters({uid})
+        # A deleted pod leaves the PreBind wait room: revert its Reserve
+        # chain now (the cache entry goes below with the delete); scrub it
+        # from gang-rollback records so a later group timeout cannot unwind
+        # a pod that no longer exists.
+        entry = self.prebind_waiting.pop(uid, None)
+        if entry is not None:
+            for rp, u in reversed(entry["undos"]):
+                rp.unreserve(u, self)
+        for e in self.prebind_waiting.values():
+            e["mates"] = [m for m in e["mates"] if m[0].pod.uid != uid]
+        for g in list(self.prebind_done_pending):
+            self.prebind_done_pending[g] = [
+                d for d in self.prebind_done_pending[g]
+                if d["qp"].pod.uid != uid
+            ]
         self.nominator.pop(uid, None)
         # DRA: drop the pod's claim reservations; claims nobody reserves
         # deallocate (the resourceclaim controller's cleanup).  Externally-
@@ -539,7 +570,11 @@ class TPUScheduler:
     # -- volume objects (PV/PVC/StorageClass/CSINode informers) --------------
 
     def add_pv(self, pv: t.PersistentVolume) -> None:
-        self.builder.volumes.add_pv(pv)
+        fulfilled = self.builder.volumes.add_pv(pv)
+        if fulfilled:
+            # The provisioner delivered a claimRef'd PV for an open intent:
+            # complete the waiting PreBinds.
+            self.notify_prebind({f"pvc:{u}" for u in fulfilled})
         self.queue.on_event(Event.PV_ADD)
 
     def add_pvc(self, pvc: t.PersistentVolumeClaim) -> None:
@@ -760,6 +795,124 @@ class TPUScheduler:
                 n += 1
         return n
 
+    def notify_prebind(self, keys) -> list[ScheduleOutcome]:
+        """Resolve PreBind wait keys (an informer event satisfied them —
+        e.g. the provisioner's PV arrived).  Entries whose last key
+        resolves complete their bind.  The outcomes are ALSO queued for the
+        next schedule_batch return (outcome-consuming drivers observe
+        wait-mode binds there); the returned list is informational."""
+        done: list[ScheduleOutcome] = []
+        if not self.prebind_waiting:
+            return done
+        keys = set(keys)
+        now = time.monotonic()
+        for uid in list(self.prebind_waiting):
+            entry = self.prebind_waiting[uid]
+            entry["keys"] -= keys
+            if entry["keys"]:
+                continue
+            del self.prebind_waiting[uid]
+            done.append(self._complete_prebind(entry, now))
+        self._prebind_outcomes.extend(done)
+        return done
+
+    def _complete_prebind(self, entry: dict, now: float) -> ScheduleOutcome:
+        """The bind tail a parked pod skipped (finish_binding + metrics)."""
+        qp = entry["qp"]
+        g = entry["g"]
+        m = self.metrics
+        qp.pod.spec.node_name = entry["node"]
+        self.cache.finish_binding(qp.pod.uid)
+        if qp.pod.spec.pod_group:
+            self.gang_bound[qp.pod.spec.pod_group] = (
+                self.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
+            )
+        if g:
+            # Group-mates still waiting?  This bind stays revocable until
+            # the whole group lands (all-or-nothing gang contract).
+            if any(e["g"] == g for e in self.prebind_waiting.values()):
+                self.prebind_done_pending.setdefault(g, []).append(
+                    {"qp": qp, "undos": entry["undos"], "node": entry["node"]}
+                )
+            else:
+                self.prebind_done_pending.pop(g, None)
+        if m.scheduled == 0:
+            m.first_scheduled_ts = now
+        m.scheduled += 1
+        m.last_scheduled_ts = now
+        lat = now - qp.initial_attempt_timestamp
+        m.e2e_latency_samples.append(lat)
+        m.registry.scheduling_sli.observe(lat)
+        return ScheduleOutcome(
+            qp.pod, entry["node"], entry["score"], entry["feasn"]
+        )
+
+    def _unwind_reserved(self, uid: str, undos, was_bound: bool) -> None:
+        """Revert a pod's Reserve chain + cache assume (the shared unwind of
+        the PreBind-timeout paths).  ``was_bound`` keeps the throughput
+        metrics honest: a finalized bind that reverts post-batch leaves
+        ``scheduled``."""
+        for rp, u in reversed(undos):
+            rp.unreserve(u, self)
+        if uid in self.cache.pods:
+            self.cache.forget_pod(uid)
+        m = self.metrics
+        if was_bound:
+            m.scheduled -= 1
+        m.unschedulable += 1
+
+    def expire_waiting_prebinds(self, timeout_s: float | None = None) -> int:
+        """Time out PreBind waits (the bindTimeout unwind: Unreserve +
+        requeue, volume_binding.go PreBind error path).  A gang member's
+        timeout rolls its whole group back — the gang contract is
+        all-or-nothing, so batch-mates bound immediately AND members whose
+        own waits already completed (prebind_done_pending) revert like a
+        lost PV race."""
+        now = time.monotonic()
+        limit = self.prebind_timeout_s if timeout_s is None else timeout_s
+        n = 0
+        for uid in [
+            u for u, e in self.prebind_waiting.items()
+            if now - e["since"] > limit
+        ]:
+            entry = self.prebind_waiting.pop(uid, None)
+            if entry is None:
+                continue  # a mate's rollback already consumed it
+            n += 1
+            self._unwind_reserved(uid, entry["undos"], was_bound=False)
+            qp, g, gpl = entry["qp"], entry["g"], entry["gpl"]
+            if g:
+                gpl.on_rollback(qp, self)
+                for qp2, _out2, undos2 in entry["mates"]:
+                    self._unwind_reserved(qp2.pod.uid, undos2, was_bound=True)
+                    qp2.pod.spec.node_name = None
+                    self._debit_gang(g)
+                    gpl.on_rollback(qp2, self)
+                # Fellow parked members of the SAME group revert too.
+                for uid2 in [
+                    u for u, e in self.prebind_waiting.items() if e["g"] == g
+                ]:
+                    e2 = self.prebind_waiting.pop(uid2)
+                    self._unwind_reserved(uid2, e2["undos"], was_bound=False)
+                    gpl.on_rollback(e2["qp"], self)
+                # Members whose own provisioning completed while the group
+                # was still pending revert with it.
+                for d in self.prebind_done_pending.pop(g, ()):
+                    qp3 = d["qp"]
+                    self._unwind_reserved(
+                        qp3.pod.uid, d["undos"], was_bound=True
+                    )
+                    qp3.pod.spec.node_name = None
+                    self._debit_gang(g)
+                    gpl.on_rollback(qp3, self)
+                self.queue.readmit_gang(g)
+            else:
+                # done() dropped the queue's info entry when the pod
+                # parked — restore it before the backoff round-trip.
+                self.queue._info[qp.pod.uid] = qp
+                self.queue.add_backoff(qp)
+        return n
+
     def _profile_for(self, pod: t.Pod) -> Profile | None:
         """frameworkForPod (schedule_one.go:379): exact schedulerName match;
         an UNSET name (the API default "default-scheduler") falls to the
@@ -957,9 +1110,20 @@ class TPUScheduler:
 
     def schedule_batch(self) -> list[ScheduleOutcome]:
         """Pop up to batch_size pods and schedule them in one device pass
-        per profile (pods group by .spec.scheduler_name)."""
+        per profile (pods group by .spec.scheduler_name).  Binds completed
+        between batches by informer-driven notify_prebind are prepended to
+        the returned outcomes."""
+        out = self._schedule_batch_inner()
+        if self._prebind_outcomes:
+            out = self._prebind_outcomes + list(out)
+            self._prebind_outcomes = []
+        return out
+
+    def _schedule_batch_inner(self) -> list[ScheduleOutcome]:
         if self.permit_wait_since:
             self.expire_waiting_gangs()
+        if self.prebind_waiting:
+            self.expire_waiting_prebinds()
         now = time.monotonic()
         if now >= self._next_assumed_sweep:
             # cache.go:42 starts cleanupAssumedPods on a 1s ticker; the batch
@@ -972,6 +1136,9 @@ class TPUScheduler:
                 for entries in self.permit_waiting.values()
                 for e in entries
             }
+            # PreBind-waiting pods are deliberately assumed too; they
+            # expire through expire_waiting_prebinds, not the TTL.
+            waiting |= set(self.prebind_waiting)
             for pod in self.cache.cleanup_assumed(self.assume_ttl_s, skip=waiting):
                 # No informer to re-deliver the still-pending pod (the
                 # reference relies on the apiserver watch for that) — requeue
@@ -1464,6 +1631,7 @@ class TPUScheduler:
         finalized_by_group: dict[str, list] = {}
         latency_qps: list[QueuedPodInfo] = []
         race_rollback: set[str] = set()  # transient (PV race): retry on timer
+        prebind_parked: set[str] = set()  # pods gone to the PreBind wait room
         prebind_s = 0.0
         for qp, node_name, score, feasn in entries:
             g, gpl = self._permit_group(qp.pod)
@@ -1519,8 +1687,43 @@ class TPUScheduler:
                         self._debit_gang(g)
                         out2.node_name, out2.score = None, 0
                         gpl.on_rollback(qp2, self)
+                    # Same-batch mates already parked in the PreBind wait
+                    # room revert with the group too.
+                    for uid2 in [
+                        u for u, e in self.prebind_waiting.items()
+                        if e["g"] == g
+                    ]:
+                        e = self.prebind_waiting.pop(uid2)
+                        prebind_parked.discard(uid2)
+                        for rp2, u2 in reversed(e["undos"]):
+                            rp2.unreserve(u2, self)
+                        self.cache.forget_pod(uid2)
+                        outcomes.append(
+                            ScheduleOutcome(e["qp"].pod, None, 0, e["feasn"])
+                        )
+                        gpl.on_rollback(e["qp"], self)
                 else:
                     self.queue.add_backoff(qp)
+                continue
+            pending = set()
+            for rp, u in undos:
+                hook = getattr(rp, "prebind_pending", None)
+                if hook is not None:
+                    pending.update(hook(qp.pod, u, self))
+            if pending:
+                # PreBind wait (RunPreBindPlugins inside the detached
+                # bindingCycle, volume_binding.go:521): the pod stays
+                # ASSUMED off-queue until every key resolves
+                # (notify_prebind) or the bind timeout unreserves it —
+                # the batch itself never blocks.
+                self.queue.done(qp.pod.uid)
+                self.prebind_waiting[qp.pod.uid] = {
+                    "qp": qp, "node": node_name, "score": score,
+                    "feasn": feasn, "undos": undos, "keys": pending,
+                    "g": g, "gpl": gpl, "since": time.monotonic(),
+                    "mates": [],
+                }
+                prebind_parked.add(qp.pod.uid)
                 continue
             qp.pod.spec.node_name = node_name
             self.cache.finish_binding(qp.pod.uid)
@@ -1538,6 +1741,13 @@ class TPUScheduler:
                 finalized_by_group.setdefault(g, []).append(
                     (qp, outcome, undos)
                 )
+        # A parked gang member pins its batch-mates' records so a PreBind
+        # timeout can roll the whole gang back (the repo's gang contract is
+        # all-or-nothing; mates bound this batch revert like a lost PV race).
+        for uid in prebind_parked:
+            entry = self.prebind_waiting.get(uid)
+            if entry is not None and entry["g"]:
+                entry["mates"] = list(finalized_by_group.get(entry["g"], ()))
         # A group rolled back by a transient PV race re-admits behind backoff
         # right away — no cluster event will ever fire in a quiet cluster,
         # and the race loser's next attempt resolves against the updated

@@ -47,10 +47,24 @@ class VolumeCatalog:
     unbound: dict[str, dict[str, "t.PersistentVolume"]] = field(
         default_factory=dict
     )
+    # WFFC dynamic provisioning mode.  "sync" models an instantaneous
+    # provisioner (the PreBind creates the PV in-process — the round-3
+    # behavior, right for self-contained benchmarks).  "wait" mirrors the
+    # reference (volume_binding.go:521 BindPodVolumes): PreBind writes a
+    # provisioning INTENT (the volume.kubernetes.io/selected-node
+    # annotation trigger) and the bind completes only when the external
+    # provisioner's PV arrives via add_pv, or times out and unreserves.
+    wffc_provisioning: str = "sync"
+    # pvc uid → selected node name, while a provisioning intent is open.
+    provisioning: dict[str, str] = field(default_factory=dict)
 
     # -- object events -------------------------------------------------------
 
-    def add_pv(self, pv: t.PersistentVolume) -> None:
+    def add_pv(self, pv: t.PersistentVolume) -> list[str]:
+        """Upsert a PV (informer).  Returns the uids of PVCs whose open
+        provisioning intent this PV fulfils (the provisioner created the
+        volume pre-bound via claimRef) — the scheduler completes their
+        waiting PreBinds."""
         old = self.pvs.get(pv.name)
         if old is not None and not old.claim_ref:
             self.unbound.get(old.storage_class, {}).pop(old.name, None)
@@ -58,6 +72,14 @@ class VolumeCatalog:
         if not pv.claim_ref:
             self.unbound.setdefault(pv.storage_class, {})[pv.name] = pv
         self.epoch += 1
+        fulfilled: list[str] = []
+        if pv.claim_ref and pv.claim_ref in self.provisioning:
+            pvc = self.pvcs.get(pv.claim_ref)
+            if pvc is not None and not pvc.volume_name:
+                pvc.volume_name = pv.name
+                del self.provisioning[pv.claim_ref]
+                fulfilled.append(pvc.uid)
+        return fulfilled
 
     def class_has_static_candidates(self, storage_class: str) -> bool:
         """Any unclaimed static PV in this class?  (Chunk-conflict gate:
@@ -204,6 +226,15 @@ class VolumeCatalog:
         undo: list[tuple[str, t.PersistentVolumeClaim, str]] = []
         for pvc, pv in chosen:
             if pv is None:
+                if self.wffc_provisioning == "wait":
+                    # The provisioning trigger (AssumePodVolumes + the
+                    # selected-node annotation): the claim stays unbound
+                    # until the provisioner's PV lands (add_pv) or the
+                    # PreBind wait times out.
+                    self.provisioning[pvc.uid] = node.name
+                    self.epoch += 1
+                    undo.append(("intent", pvc, node.name))
+                    continue
                 name = f"provisioned-{pvc.namespace}-{pvc.name}"
                 self.add_pv(
                     t.PersistentVolume(
@@ -229,6 +260,14 @@ class VolumeCatalog:
         """Revert a bind_pod_volumes (gang Permit collapse after PreBind):
         release static PVs, delete phantom provisioned PVs."""
         for kind, pvc, pv_name in undo:
+            if kind == "intent":
+                # Withdraw the provisioning trigger; a PV the provisioner
+                # already delivered stays in the catalog (the claim keeps
+                # its binding — rebinding elsewhere later is a no-op race
+                # the classify() bound path resolves).
+                if not pvc.volume_name:
+                    self.provisioning.pop(pvc.uid, None)
+                continue
             pvc.volume_name = ""
             if kind == "provisioned":
                 self.pvs.pop(pv_name, None)

@@ -1,0 +1,199 @@
+"""VolumeBinding provisioning-wait: PreBind writes a provisioning intent
+and the bind completes on the provisioner's PV (or times out and
+unreserves) without blocking the batch — the non-blocking analog of
+BindPodVolumes' wait (volume_binding.go:521, bindTimeout unwind)."""
+
+import time
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod, make_pv, make_pvc
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def vol_profile():
+    return Profile(
+        name="vol",
+        filters=("NodeResourcesFit", "VolumeBinding"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+
+
+def wffc_sched(batch_size=8):
+    s = TPUScheduler(profile=vol_profile(), batch_size=batch_size)
+    s.builder.volumes.wffc_provisioning = "wait"
+    s.add_storage_class(
+        t.StorageClass(
+            name="dyn",
+            provisioner="csi.example.com",
+            binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj()
+    )
+    return s
+
+
+def provisioner_deliver(s, pvc_uid: str, name: str = "pv-prov"):
+    """The external provisioner: a PV pre-bound to the claim arrives via
+    the informer."""
+    pv = make_pv(name, storage_class="dyn", csi_driver="csi.example.com")
+    pv.claim_ref = pvc_uid
+    s.add_pv(pv)
+
+
+def test_provisioning_delays_bind_without_blocking_batch():
+    s = wffc_sched()
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(make_pod("waits").req({"cpu": "1"}).pvc_volume("claim").obj())
+    s.add_pod(make_pod("plain").req({"cpu": "1"}).obj())
+    out = s.schedule_batch()
+    # The plain pod bound in the same batch; the WFFC pod parked.
+    by_name = {o.pod.name: o for o in out}
+    assert by_name["plain"].node_name == "n1"
+    assert "waits" not in by_name
+    assert "default/waits" in s.prebind_waiting
+    waits = s.prebind_waiting["default/waits"]["qp"].pod
+    assert not waits.spec.node_name
+    # Intent recorded; no PV conjured in-process.
+    assert s.builder.volumes.provisioning == {"default/claim": "n1"}
+    assert not any(p.name.startswith("provisioned-") for p in s.builder.volumes.pvs.values())
+    # The provisioner delivers → the bind completes.
+    provisioner_deliver(s, "default/claim")
+    assert not s.prebind_waiting
+    assert waits.spec.node_name == "n1"
+    assert s.builder.volumes.pvcs["default/claim"].volume_name == "pv-prov"
+    assert s.metrics.scheduled == 2
+
+
+def test_provisioning_timeout_unreserves_and_retries():
+    s = wffc_sched()
+    s.prebind_timeout_s = 0.05
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    s.schedule_batch()
+    assert "default/p" in s.prebind_waiting
+    time.sleep(0.06)
+    assert s.expire_waiting_prebinds() == 1
+    # Unreserved: intent withdrawn, pod forgotten and back on backoff.
+    assert s.builder.volumes.provisioning == {}
+    assert "default/p" not in s.prebind_waiting
+    assert s.queue.pending_count() == 1
+    # A later retry with the provisioner ready (sync mode models that)
+    # binds normally.
+    s.builder.volumes.wffc_provisioning = "sync"
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert out and out[-1].node_name == "n1"
+
+
+def test_gang_mate_rolls_back_on_provisioning_timeout():
+    s = wffc_sched()
+    s.prebind_timeout_s = 0.05
+    s.add_pod_group(t.PodGroup(name="g", min_member=2))
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(
+        make_pod("a").req({"cpu": "1"}).pvc_volume("claim").pod_group("g").obj()
+    )
+    s.add_pod(make_pod("b").req({"cpu": "1"}).pod_group("g").obj())
+    out = s.schedule_batch()
+    # Gang passed Permit: b bound, a parked on provisioning.
+    bound_b = [o for o in out if o.pod.name == "b"]
+    assert bound_b and bound_b[0].node_name == "n1"
+    assert "default/a" in s.prebind_waiting
+    time.sleep(0.06)
+    assert s.expire_waiting_prebinds() == 1
+    # The whole gang rolled back: b unbound, credit debited, group parked
+    # for re-admission (all-or-nothing gang contract).
+    b = bound_b[0].pod
+    assert not b.spec.node_name
+    assert s.gang_bound.get("g", 0) == 0
+    assert s.builder.volumes.provisioning == {}
+    assert not s.prebind_waiting
+
+
+def test_gang_completes_when_provisioner_delivers():
+    s = wffc_sched()
+    s.add_pod_group(t.PodGroup(name="g", min_member=2))
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(
+        make_pod("a").req({"cpu": "1"}).pvc_volume("claim").pod_group("g").obj()
+    )
+    s.add_pod(make_pod("b").req({"cpu": "1"}).pod_group("g").obj())
+    s.schedule_batch()
+    provisioner_deliver(s, "default/claim")
+    a = s.builder.volumes.pvcs["default/claim"]
+    assert a.volume_name == "pv-prov"
+    assert s.gang_bound.get("g", 0) == 2
+    assert s.metrics.scheduled == 2
+    assert not s.prebind_waiting
+
+
+def test_sync_mode_unchanged():
+    # Default mode keeps the round-3 instantaneous-provisioner behavior.
+    s = TPUScheduler(profile=vol_profile(), batch_size=4)
+    s.add_storage_class(
+        t.StorageClass(
+            name="dyn",
+            provisioner="csi.example.com",
+            binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj()
+    )
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "n1"
+    assert not s.prebind_waiting
+
+
+def test_completed_member_rolls_back_when_group_mate_times_out():
+    # Both gang members park; one completes via the provisioner, the other
+    # times out — the completed one reverts too (all-or-nothing).
+    s = wffc_sched()
+    s.prebind_timeout_s = 0.05
+    s.add_pod_group(t.PodGroup(name="g", min_member=2))
+    s.add_pvc(make_pvc("c-a", storage_class="dyn"))
+    s.add_pvc(make_pvc("c-b", storage_class="dyn"))
+    a = make_pod("a").req({"cpu": "1"}).pvc_volume("c-a").pod_group("g").obj()
+    b = make_pod("b").req({"cpu": "1"}).pvc_volume("c-b").pod_group("g").obj()
+    s.add_pod(a)
+    s.add_pod(b)
+    s.schedule_batch()
+    assert set(s.prebind_waiting) == {"default/a", "default/b"}
+    provisioner_deliver(s, "default/c-a", name="pv-a")
+    assert a.spec.node_name == "n1"
+    assert s.metrics.scheduled == 1
+    time.sleep(0.06)
+    assert s.expire_waiting_prebinds() == 1
+    # b timed out -> a (already bound) reverts with the group.
+    assert not a.spec.node_name and not b.spec.node_name
+    assert s.gang_bound.get("g", 0) == 0
+    assert s.metrics.scheduled == 0
+    assert not s.prebind_waiting and not s.prebind_done_pending
+
+
+def test_deleted_parked_pod_reconciles():
+    s = wffc_sched()
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    s.schedule_batch()
+    assert "default/p" in s.prebind_waiting
+    s.delete_pod("default/p")
+    assert "default/p" not in s.prebind_waiting
+    assert s.builder.volumes.provisioning == {}  # intent withdrawn
+    # Late provisioner delivery and the timeout sweep are both no-ops.
+    provisioner_deliver(s, "default/claim")
+    assert s.expire_waiting_prebinds(timeout_s=0) == 0
+
+
+def test_wait_mode_binds_surface_in_next_batch_outcomes():
+    s = wffc_sched()
+    s.add_pvc(make_pvc("claim", storage_class="dyn"))
+    s.add_pod(make_pod("p").req({"cpu": "1"}).pvc_volume("claim").obj())
+    s.schedule_batch()
+    provisioner_deliver(s, "default/claim")
+    out = s.schedule_batch()  # empty queue, but the completed bind surfaces
+    assert [(o.pod.name, o.node_name) for o in out] == [("p", "n1")]
