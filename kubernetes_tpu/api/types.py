@@ -670,17 +670,52 @@ class PodGroup:
 
 
 @dataclass
-class ResourceClaim:
-    """resource.k8s.io ResourceClaim, reduced to counted-device structured
-    parameters (plugins/dynamicresources/): a request for ``count`` devices
-    of a device class; allocation pins the claim to one node."""
+class Device:
+    """resource.k8s.io BasicDevice (api/resource/v1alpha3/types.go:205):
+    one named device instance with typed attributes (bool/int/string)."""
+
+    name: str
+    attributes: dict = field(default_factory=dict)
+
+
+@dataclass
+class DeviceRequest:
+    """ResourceClaim.spec.devices.requests[i]: ``count`` devices of a
+    class, narrowed by CEL selectors (DeviceRequest.Selectors;
+    dra_cel.py compiles the vectorizable subset)."""
 
     name: str
     device_class: str
     count: int = 1
+    selectors: tuple[str, ...] = ()  # CEL expressions, ANDed
+
+
+@dataclass
+class ResourceClaim:
+    """resource.k8s.io ResourceClaim with structured parameters
+    (plugins/dynamicresources/, staging dynamic-resource-allocation/
+    structured/): device requests with CEL selectors; allocation pins the
+    claim to one node and names the chosen devices.  The single-request
+    counted shorthand (device_class + count, the round-2 form) remains the
+    default when ``requests`` is empty."""
+
+    name: str
+    device_class: str = ""
+    count: int = 1
     namespace: str = "default"
     allocated_node: str = ""  # "" = unallocated (delayed allocation)
     reserved_for: tuple[str, ...] = ()  # pod uids (status.reservedFor)
+    requests: tuple[DeviceRequest, ...] = ()
+    # Allocation result (status.allocation.devices.results): the chosen
+    # (request name, device name) pairs.
+    allocated_devices: tuple[tuple[str, str], ...] = ()
+
+    def device_requests(self) -> tuple[DeviceRequest, ...]:
+        """The claim's requests; the counted shorthand synthesizes one
+        selector-less request."""
+        if self.requests:
+            return self.requests
+        return (DeviceRequest("r0", self.device_class, self.count),)
 
     @property
     def uid(self) -> str:
@@ -690,11 +725,15 @@ class ResourceClaim:
 @dataclass
 class ResourceSlice:
     """resource.k8s.io ResourceSlice: the devices a node publishes for one
-    device class (counted form)."""
+    device class.  ``devices`` carries named instances with attributes
+    (ResourceSlice.spec.devices, types.go:144); the counted form
+    (``count`` with no devices) publishes anonymous attribute-less
+    instances."""
 
     node_name: str
     device_class: str
     count: int = 1
+    devices: tuple[Device, ...] = ()
 
 
 @dataclass

@@ -1,0 +1,194 @@
+"""CEL device-selector compilation — the vectorizable subset.
+
+The reference evaluates request selectors as CEL programs over
+``device.attributes`` (staging/src/k8s.io/dynamic-resource-allocation/cel/
+compile.go; expressions like ``device.attributes["gpu.example.com/memory"]
+.int >= 40`` — see structured/allocator_test.go and
+dynamicresources_test.go:117).  Full CEL cannot run on device; this build
+takes the NodeAffinity playbook (compiled requirement programs): the
+selector grammar below — attribute comparisons joined by ``&&`` — compiles
+once into requirement tuples evaluated host-side per DEVICE when selector
+POOLS are (re)computed, so the per-pod/per-node hot path only reads pool
+count columns.  Anything outside the subset is a hard config error, not a
+silent mismatch (the reference likewise fails allocation on CEL compile
+errors, allocator.go:159).
+
+Grammar (conjunction of terms):
+
+    expr     := term ("&&" term)*
+    term     := attr [accessor] op literal
+              | attr [".bool"]                (truthy)
+              | "!" attr [".bool"]
+              | STRING "in" "device.attributes"
+              | "!(" STRING "in device.attributes" ")"
+    attr     := device.attributes["KEY"]
+    accessor := .bool | .int | .string
+    op       := == | != | >= | <= | > | < | in
+    literal  := int | "string" | true | false | [literal, ...]
+
+CEL semantics note: a missing attribute makes the reference's expression
+error, which the allocator treats as the device not matching; here a term
+over a missing key evaluates false, the same observable outcome."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_ATTR = r'device\.attributes\["(?P<key>[^"\]]+)"\](?:\.(?P<acc>bool|int|string))?'
+_LIT = r"""(?P<num>-?\d+)|"(?P<str>[^"]*)"|(?P<bool>true|false)|(?P<list>\[[^\]]*\])"""
+_TERM_CMP = re.compile(
+    rf"^{_ATTR}\s*(?P<op>==|!=|>=|<=|>|<|\bin\b)\s*(?:{_LIT})$"
+)
+_TERM_TRUTHY = re.compile(rf"^(?P<neg>!\s*)?{_ATTR}$")
+_TERM_EXISTS = re.compile(
+    r'^(?P<neg>!\s*\(\s*)?"(?P<key>[^"]+)"\s+in\s+device\.attributes\s*(?(neg)\))$'
+)
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One compiled term: ``key op value`` over a device's attributes."""
+
+    key: str
+    op: str  # Eq | Ne | Ge | Le | Gt | Lt | In | Exists | DoesNotExist | Truthy | Falsy
+    values: tuple = ()
+
+    def matches(self, attrs: dict) -> bool:
+        present = self.key in attrs
+        if self.op == "Exists":
+            return present
+        if self.op == "DoesNotExist":
+            return not present
+        if not present:
+            return False  # CEL errors on missing attrs → device no-match
+        v = attrs[self.key]
+        if self.op == "Truthy":
+            return v is True
+        if self.op == "Falsy":
+            return v is False
+        if self.op == "Eq":
+            return v == self.values[0]
+        if self.op == "Ne":
+            return v != self.values[0]
+        if self.op == "In":
+            return v in self.values
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            return False  # ordered ops need numbers
+        w = self.values[0]
+        return (
+            v >= w if self.op == "Ge"
+            else v <= w if self.op == "Le"
+            else v > w if self.op == "Gt"
+            else v < w
+        )
+
+
+def _parse_literal(m: re.Match):
+    if m.group("num") is not None:
+        return int(m.group("num"))
+    if m.group("str") is not None:
+        return m.group("str")
+    if m.group("bool") is not None:
+        return m.group("bool") == "true"
+    inner = m.group("list")[1:-1].strip()
+    vals = []
+    for part in re.findall(r'-?\d+|"[^"]*"', inner):
+        vals.append(part[1:-1] if part.startswith('"') else int(part))
+    return tuple(vals)
+
+
+_OPS = {"==": "Eq", "!=": "Ne", ">=": "Ge", "<=": "Le", ">": "Gt", "<": "Lt", "in": "In"}
+
+
+def compile_selector(expr: str) -> tuple[Requirement, ...]:
+    """Compile one CEL selector expression into requirement tuples.
+    Raises ValueError outside the supported subset."""
+    reqs: list[Requirement] = []
+    for raw in _split_conjunction(expr):
+        term = raw.strip()
+        if not term:
+            raise ValueError(f"empty term in CEL selector {expr!r}")
+        m = _TERM_CMP.match(term)
+        if m:
+            lit = _parse_literal(m)
+            op = _OPS[m.group("op")]
+            if op == "In":
+                if not isinstance(lit, tuple):
+                    raise ValueError(f"'in' needs a list literal: {term!r}")
+                reqs.append(Requirement(m.group("key"), "In", lit))
+            else:
+                acc = m.group("acc")
+                if acc == "int" and not isinstance(lit, int):
+                    raise ValueError(f".int compared to non-int: {term!r}")
+                if acc == "string" and not isinstance(lit, str):
+                    raise ValueError(f".string compared to non-string: {term!r}")
+                if acc == "bool" and not isinstance(lit, bool):
+                    raise ValueError(f".bool compared to non-bool: {term!r}")
+                if op in ("Ge", "Le", "Gt", "Lt") and not isinstance(lit, int):
+                    raise ValueError(f"ordered compare needs an int: {term!r}")
+                reqs.append(Requirement(m.group("key"), op, (lit,)))
+            continue
+        m = _TERM_EXISTS.match(term)
+        if m:
+            reqs.append(
+                Requirement(
+                    m.group("key"),
+                    "DoesNotExist" if m.group("neg") else "Exists",
+                )
+            )
+            continue
+        m = _TERM_TRUTHY.match(term)
+        if m:
+            if m.group("acc") not in (None, "bool"):
+                raise ValueError(f"bare attribute term must be bool: {term!r}")
+            reqs.append(
+                Requirement(m.group("key"), "Falsy" if m.group("neg") else "Truthy")
+            )
+            continue
+        raise ValueError(
+            f"CEL selector term outside the vectorizable subset: {term!r}"
+        )
+    return tuple(reqs)
+
+
+def _split_conjunction(expr: str) -> list[str]:
+    """Split on && outside quotes/brackets (no precedence — the subset has
+    no ||)."""
+    if "||" in expr:
+        raise ValueError(f"'||' is outside the vectorizable subset: {expr!r}")
+    parts, depth, quote, start = [], 0, False, 0
+    i = 0
+    while i < len(expr):
+        c = expr[i]
+        if c == '"':
+            quote = not quote
+        elif not quote and c in "([":
+            depth += 1
+        elif not quote and c in ")]":
+            depth -= 1
+        elif not quote and depth == 0 and expr.startswith("&&", i):
+            parts.append(expr[start:i])
+            i += 2
+            start = i
+            continue
+        i += 1
+    parts.append(expr[start:])
+    return parts
+
+
+def canonical(selectors: tuple[str, ...]) -> str:
+    """Canonical signature of a selector set for pool interning: the sorted
+    requirement tuples, so differently-written equivalent selectors share a
+    pool."""
+    reqs: list[Requirement] = []
+    for s in selectors:
+        reqs.extend(compile_selector(s))
+    return ";".join(
+        f"{r.key}\x00{r.op}\x00{','.join(map(repr, r.values))}"
+        for r in sorted(reqs, key=lambda r: (r.key, r.op, r.values))
+    )
+
+
+def matches(reqs: tuple[Requirement, ...], attrs: dict) -> bool:
+    return all(r.matches(attrs) for r in reqs)

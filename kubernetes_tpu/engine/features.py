@@ -28,6 +28,7 @@ opcommon.feature_fill("vol_csi_lim", 0)
 opcommon.feature_fill("dra_claim_ids", -1)
 opcommon.feature_fill("dra_claim_cls", -1)
 opcommon.feature_fill("dra_claim_cnt", 0)
+opcommon.feature_fill("dra_claim_first", False)
 opcommon.feature_fill("dra_claim_unalloc", 0)
 # Injected by the scheduler AFTER featurization (nomination lives in pod
 # STATUS; the featurize cache keys on spec only).
@@ -294,19 +295,24 @@ def build_pod_batch(
             dev_rw = _BOOL_FALSE
         dcl = delta["dra_claims"]
         if dcl:
+            # One slot per device REQUEST (structured parameters); slots of
+            # a claim share kid, `first` marks the count-moving one.
             dra_ids = np.full(_bucket(len(dcl), 1), -1, np.int32)
             dra_cls = np.full(dra_ids.shape[0], -1, np.int32)
             dra_cnt = np.zeros(dra_ids.shape[0], np.int32)
             dra_unalloc = np.zeros(dra_ids.shape[0], np.bool_)
-            for j, (kid, (cid, cnt, unalloc)) in enumerate(dcl):
+            dra_first = np.zeros(dra_ids.shape[0], np.bool_)
+            for j, (kid, cid, cnt, unalloc, first) in enumerate(dcl):
                 dra_ids[j] = kid
                 dra_cls[j] = cid
                 dra_cnt[j] = cnt
                 dra_unalloc[j] = unalloc
+                dra_first[j] = first
         else:
             dra_ids = dra_cls = _I32_NEG1
             dra_cnt = _I32_ZERO
             dra_unalloc = _BOOL_FALSE
+            dra_first = _BOOL_FALSE
         cvols = delta["csivols"]
         if cvols:
             csi_ids = np.full(_bucket(len(cvols), 1), -1, np.int32)
@@ -331,6 +337,7 @@ def build_pod_batch(
             "dra_claim_ids": dra_ids,
             "dra_claim_cls": dra_cls,
             "dra_claim_cnt": dra_cnt,
+            "dra_claim_first": dra_first,
             "dra_claim_unalloc": dra_unalloc,
             # Chunked-pass conflict classes (engine/pass_.py _conflict_pairs):
             # only PreBind-racing claims (unbound WFC) conflict any-vs-any;

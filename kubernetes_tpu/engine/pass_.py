@@ -233,9 +233,13 @@ def _commit_chunk(
         act = do[:, None] & (kids >= 0)
         safe_k = jnp.maximum(kids, 0)
         prev = state.dra_claim_counts[safe_k, rows[:, None]]  # (C, S)
+        # Slots are per device REQUEST; only a claim's `first` slot moves
+        # its count (the others charge their own selector pools below).
+        # prev reads pre-scatter state, so same-claim slots agree on the
+        # 0↔1 transition.
         new["dra_claim_counts"] = state.dra_claim_counts.at[
             safe_k, rows[:, None]
-        ].add(act.astype(jnp.int32))
+        ].add((act & pf["dra_claim_first"]).astype(jnp.int32))
         newly = act & (prev == 0)
         dc = state.dra_alloc.shape[0]
         cls_oh = (

@@ -108,11 +108,16 @@ class DRAReserve:
         return sched._dra_enabled and bool(pod.spec.resource_claims)
 
     def reserve(self, pod: t.Pod, node_name: str, sched):
-        return sched.builder.dra.allocate_pod_claims(pod, node_name)
+        undo = sched.builder.dra.allocate_pod_claims(pod, node_name)
+        # Named devices may overlap pools beyond the request pools; the
+        # catalog queued the row corrections.
+        sched._drain_dra_corrections()
+        return undo
 
     def unreserve(self, undo, sched) -> None:
         if undo:
             sched.builder.dra.unallocate(undo)
+            sched._drain_dra_corrections()
 
 
 class VolumeReserve:

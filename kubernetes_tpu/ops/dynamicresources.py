@@ -1,19 +1,27 @@
-"""DynamicResources plugin, vectorized (counted-device form).
+"""DynamicResources plugin, vectorized over selector POOLS (structured
+parameters).
 
 Reference: pkg/scheduler/framework/plugins/dynamicresources/ (973 LoC, wired
-via the claim assume-cache at scheduler.go:298–302).  Scheduler-relevant
-semantics reduced to structured parameters' counted devices:
+via the claim assume-cache at scheduler.go:298–302) + staging
+dynamic-resource-allocation/structured/allocator.go.  Scheduler-relevant
+semantics:
 
   * A pod referencing a MISSING claim is UnschedulableAndUnresolvable until
     the claim appears (the plugin's PreEnqueue/PreFilter checks).
   * An ALLOCATED claim pins the pod to the claim's node (the allocation
     result's node selector).
-  * UNALLOCATED claims demand free devices of their class on the node:
-    dra_alloc + need ≤ dra_cap per class (the allocator's device fit).
+  * UNALLOCATED claims demand free devices per REQUEST from the request's
+    selector pool — a (device class, canonical CEL selector) column pair
+    (dra.pool_sig; dra_cel compiles the vectorizable CEL subset) — AND
+    from the bare class pool: dra_alloc + need ≤ dra_cap per pool.  One
+    feature slot per (request × charged pool), slots of a claim sharing
+    its id (snapshot.py pod delta).
 
-Allocation itself happens host-side at PreBind (dra.ClaimCatalog — the
-Reserve/PreBind extension points), with the same race-recheck pattern as
-volume binding."""
+Exact named-device allocation happens host-side at Reserve
+(dra.ClaimCatalog.allocate_pod_claims), with the same race-recheck pattern
+as volume binding; selector-vs-selector pool overlap inside one batch is
+resolved there and back-propagated as correction charges
+(ClaimCatalog.corr_events)."""
 
 from __future__ import annotations
 

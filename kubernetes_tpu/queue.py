@@ -369,6 +369,13 @@ class SchedulingQueue:
         expiry = self._clock() + self.backoff_duration(qp.attempts)
         heapq.heappush(self._backoff, (expiry, next(self._seq), qp.pod.uid))
 
+    def restore_backoff(self, qp: QueuedPodInfo) -> None:
+        """Re-own a pod released with done() (e.g. from an off-queue wait
+        room) and park it behind backoff — restores the info entry
+        done() dropped, like reactivate does for the active queue."""
+        self._info[qp.pod.uid] = qp
+        self.add_backoff(qp)
+
     def next_backoff_expiry(self) -> float | None:
         """Earliest backoff expiry, or None when the backoffQ is empty."""
         return self._backoff[0][0] if self._backoff else None

@@ -314,13 +314,28 @@ class TPUScheduler:
             if nname == node.name:
                 self.builder.set_dra_cap(self.cache.row_of(node.name), nname, cls)
         cat = self.builder.dra
-        for uid, (nname, cls, cnt) in list(cat.pending_external.items()):
-            if nname == node.name:
+        for uid, charges in list(cat.pending_external.items()):
+            if charges and charges[0][0] == node.name:
                 del cat.pending_external[uid]
                 self.builder.apply_external_claim(
-                    self.cache.row_of(node.name), uid, cls, cnt, +1
+                    self.cache.row_of(node.name), uid,
+                    [(sig, cnt) for _n, sig, cnt in charges], +1,
                 )
-                cat.row_charged[uid] = (nname, cls, cnt)
+                cat.row_charged[uid] = charges
+        # Replay parked pool-overlap corrections whose base charges just
+        # replayed (external claims of this node).
+        for uid in list(cat.pending_corr):
+            claim = cat.claims.get(uid)
+            if (
+                claim is not None
+                and claim.allocated_node == node.name
+                and uid in cat.row_charged
+            ):
+                corr = cat.pending_corr.pop(uid)
+                cat.corrections[uid] = corr
+                self.builder.apply_dra_correction(
+                    self.cache.row_of(node.name), corr, +1
+                )
         self.queue.on_event(
             Event.NODE_ADD, self._free_ctx({self.cache.row_of(node.name)})
         )
@@ -359,10 +374,16 @@ class TPUScheduler:
         # cleared wholesale, so re-park their charges as pending (a
         # returning node replays them, like slices/CSINode).
         cat = self.builder.dra
-        for uid, (nname, cls, cnt) in list(cat.row_charged.items()):
-            if nname == name:
+        for uid, charges in list(cat.row_charged.items()):
+            if charges and charges[0][0] == name:
                 del cat.row_charged[uid]
-                cat.pending_external[uid] = (nname, cls, cnt)
+                cat.pending_external[uid] = charges
+        # Applied pool-overlap corrections died with the row too: park them
+        # for replay alongside the base charges.
+        for uid in list(cat.corrections):
+            claim = cat.claims.get(uid)
+            if claim is not None and claim.allocated_node == name:
+                cat.pending_corr[uid] = cat.corrections.pop(uid)
         # Bound gang members vanish with the node; their quorum credit must
         # go with them (same invariant as delete_pod).
         rec = self.cache.nodes.get(name)
@@ -526,10 +547,14 @@ class TPUScheduler:
         # DRA: drop the pod's claim reservations; claims nobody reserves
         # deallocate (the resourceclaim controller's cleanup).  Externally-
         # charged claims discharge their phantom row reservation here.
-        for cuid, node_name, cls, cnt in self.builder.dra.release_pod(uid):
+        by_claim: dict[tuple[str, str], list[tuple[str, int]]] = {}
+        for cuid, node_name, sig, cnt in self.builder.dra.release_pod(uid):
+            by_claim.setdefault((cuid, node_name), []).append((sig, cnt))
+        for (cuid, node_name), charges in by_claim.items():
             nrec = self.cache.nodes.get(node_name)
             if nrec is not None:
-                self.builder.apply_external_claim(nrec.row, cuid, cls, cnt, -1)
+                self.builder.apply_external_claim(nrec.row, cuid, charges, -1)
+        self._drain_dra_corrections()
         rec = self.cache.pods.get(uid)
         if rec is not None:
             # A bound gang member leaving drops its gang below quorum for
@@ -594,22 +619,66 @@ class TPUScheduler:
         pending_external — add_node replays them, like CSINode/slices."""
         cat = self.builder.dra
         uid = claim.uid
-        for node_name, cls, cnt, sign in cat.add_claim(claim):
-            rec = self.cache.nodes.get(node_name)
-            if sign > 0:
-                if rec is None:
-                    cat.pending_external[uid] = (node_name, cls, cnt)
-                else:
-                    self.builder.apply_external_claim(rec.row, uid, cls, cnt, +1)
-                    cat.row_charged[uid] = (node_name, cls, cnt)
-            else:
-                if cat.pending_external.pop(uid, None) is None:
-                    charged = cat.row_charged.pop(uid, None)
-                    if charged is not None and rec is not None:
+        deltas = cat.add_claim(claim)
+        neg = [(n, sig, cnt) for n, sig, cnt, s in deltas if s < 0]
+        pos = [(n, sig, cnt) for n, sig, cnt, s in deltas if s > 0]
+        if neg:
+            if cat.pending_external.pop(uid, None) is None:
+                charged = cat.row_charged.pop(uid, None)
+                if charged is not None:
+                    rec = self.cache.nodes.get(charged[0][0])
+                    if rec is not None:
                         self.builder.apply_external_claim(
-                            rec.row, uid, cls, cnt, -1
+                            rec.row, uid,
+                            [(sig, cnt) for _n, sig, cnt in charged], -1,
                         )
+        if pos:
+            rec = self.cache.nodes.get(pos[0][0])  # one node per allocation
+            if rec is None:
+                cat.pending_external[uid] = pos
+            else:
+                self.builder.apply_external_claim(
+                    rec.row, uid, [(sig, cnt) for _n, sig, cnt in pos], +1
+                )
+                cat.row_charged[uid] = pos
+        self._drain_new_pools()
+        self._drain_dra_corrections()
         self.queue.on_event(Event.CLAIM_ADD)
+
+    def _drain_new_pools(self) -> None:
+        """Backfill cap AND alloc columns for selector pools registered
+        since the last drain (a claim introduced a new (class, selector)
+        pool; every cached node publishing that class gets its
+        matching-device count, and devices already owned under other pools
+        charge the new one)."""
+        cat = self.builder.dra
+        if not cat.new_pools:
+            return
+        sigs, cat.new_pools = list(cat.new_pools), []
+        for sig in sigs:
+            cls, _reqs = cat.pools[sig]
+            for (nname, c) in list(cat.slices):
+                if c != cls:
+                    continue
+                rec = self.cache.nodes.get(nname)
+                if rec is not None:
+                    self.builder.set_pool_cap(rec.row, nname, sig)
+                    alloc = cat.new_pool_alloc(nname, sig)
+                    if alloc:
+                        self.builder.set_pool_alloc(rec.row, sig, alloc)
+
+    def _drain_dra_corrections(self) -> None:
+        """Apply queued pool-overlap corrections (ClaimCatalog.corr_events)
+        to node rows — allocation named devices that overlap pools beyond
+        the claim's request pools (or a deallocation reversed them)."""
+        cat = self.builder.dra
+        if not cat.corr_events:
+            return
+        events, cat.corr_events = cat.corr_events, []
+        for node_name, charges, sign in events:
+            rec = self.cache.nodes.get(node_name)
+            if rec is not None:
+                self.builder.apply_dra_correction(rec.row, charges, sign)
 
     def add_resource_slice(self, s: t.ResourceSlice) -> None:
         """ResourceSlice informer (DRA): per-node published device counts."""
@@ -908,9 +977,8 @@ class TPUScheduler:
                 self.queue.readmit_gang(g)
             else:
                 # done() dropped the queue's info entry when the pod
-                # parked — restore it before the backoff round-trip.
-                self.queue._info[qp.pod.uid] = qp
-                self.queue.add_backoff(qp)
+                # parked — restore_backoff re-owns it.
+                self.queue.restore_backoff(qp)
         return n
 
     def _profile_for(self, pod: t.Pod) -> Profile | None:

@@ -352,32 +352,66 @@ class SnapshotBuilder:
         self._dirty_rows.add(row)
 
     def set_dra_cap(self, row: int, node_name: str, device_class: str) -> None:
-        """Refresh a node row's published device count for one class from
-        the claim catalog (ResourceSlice informer)."""
-        cid = self.interns.device_classes.id(device_class)
+        """Refresh a node row's device-count columns for one class — the
+        bare-class pool AND every selector pool of the class — from the
+        claim catalog (ResourceSlice informer)."""
+        self.dra.ensure_pool(device_class, ())
+        for sig in self.dra.pools_by_class.get(device_class, ()):
+            self.set_pool_cap(row, node_name, sig)
+
+    def set_pool_cap(self, row: int, node_name: str, sig: str) -> None:
+        """One pool's cap column for one node (new-pool backfill path)."""
+        cid = self.interns.device_classes.id(sig)
         self._ensure(DC=cid + 1)
-        self.host["dra_cap"][cid, row] = self.dra.slices.get(
-            (node_name, device_class), 0
-        )
+        self.host["dra_cap"][cid, row] = self.dra.pool_cap(node_name, sig)
+        self._dirty_rows.add(row)
+
+    def apply_dra_correction(self, row: int, charges, sign: int) -> None:
+        """Pool-overlap correction charges (ClaimCatalog.corr_events): a
+        direct dra_alloc adjustment outside the claim-transition system —
+        applied once at allocation, reversed once at deallocation."""
+        cids = [
+            (self.interns.device_classes.id(sig), cnt) for sig, cnt in charges
+        ]
+        self._ensure(DC=max((c for c, _ in cids), default=-1) + 1)
+        for cid, cnt in cids:
+            self.host["dra_alloc"][cid, row] += sign * cnt
+        self._dirty_rows.add(row)
+
+    def set_pool_alloc(self, row: int, sig: str, value: int) -> None:
+        """New-pool alloc backfill: owned devices matching a pool that was
+        registered after their allocation."""
+        cid = self.interns.device_classes.id(sig)
+        self._ensure(DC=cid + 1)
+        self.host["dra_alloc"][cid, row] = value
         self._dirty_rows.add(row)
 
     def apply_external_claim(
-        self, row: int, claim_uid: str, device_class: str, cnt: int, sign: int
+        self, row: int, claim_uid: str, charges, sign: int
     ) -> None:
         """Charge/release an EXTERNALLY-allocated claim on a node row as a
         PHANTOM reservation: it rides the same per-claim 0↔1 transition
         accounting local reservations use (apply_pod_delta / the in-scan
         commit), so a local pod reserving the same claim sees prev ≥ 1 and
         cannot double-charge the devices — and its later removal (a 2→1
-        transition) cannot discharge them either."""
+        transition) cannot discharge them either.  ``charges`` lists the
+        claim's per-request (pool sig, count) — the claim count moves once,
+        every request pool charges."""
         kid = self.interns.dra_claims.id(claim_uid)
-        cid = self.interns.device_classes.id(device_class)
-        self._ensure(CLM=kid + 1, DC=cid + 1)
+        cids = [
+            (self.interns.device_classes.id(sig), cnt) for sig, cnt in charges
+        ]
+        # Intern + grow BEFORE taking the host alias (_ensure swaps
+        # self.host for fresh copies on growth).
+        self._ensure(
+            CLM=kid + 1, DC=max((c for c, _ in cids), default=-1) + 1
+        )
         h = self.host
         prev = h["dra_claim_counts"][kid, row]
         h["dra_claim_counts"][kid, row] = prev + sign
         if (sign > 0 and prev == 0) or (sign < 0 and prev == 1):
-            h["dra_alloc"][cid, row] += sign * cnt
+            for cid, cnt in cids:
+                h["dra_alloc"][cid, row] += sign * cnt
         self._dirty_rows.add(row)
 
     def set_csinode_limits(self, row: int, csinode) -> None:
@@ -556,22 +590,30 @@ class SnapshotBuilder:
             DR=len(self.interns.drivers),
             CV=len(self.interns.csivols),
         )
-        # DRA claims (counted-device form), deduped by claim and accounted
-        # per DISTINCT claim like CSI volumes: dra_alloc moves only on a
-        # claim's 0↔1 reservation transition on a node, so the device
-        # tensors and the ClaimCatalog (which allocates per claim) can never
-        # diverge for shared claims.
-        # claim id → (class id, count, unallocated?) — only UNALLOCATED
-        # claims race over the free-device pool (chunk-conflict gate).
-        dra_claims: dict[int, tuple[int, int, bool]] = {}
+        # DRA claims, deduped by claim and accounted per DISTINCT claim like
+        # CSI volumes: dra_alloc moves only on a claim's 0↔1 reservation
+        # transition on a node, so the device tensors and the ClaimCatalog
+        # (which allocates per claim) can never diverge for shared claims.
+        # One SLOT per device REQUEST (structured parameters): slots of the
+        # same claim share its id; ``first`` marks the slot that moves the
+        # claim count, every slot charges its own selector POOL.  Only
+        # UNALLOCATED claims race over the free-device pool (chunk-conflict
+        # gate).
+        dra_claims: list[tuple[int, int, int, bool, bool]] = []
         if pod.spec.resource_claims:
+            seen_claims: set[str] = set()
             for claim in self.dra.pod_claims(pod):
-                if claim is None:
+                if claim is None or claim.uid in seen_claims:
                     continue  # missing claims are the op's featurize concern
-                cid = self.interns.device_classes.id(claim.device_class)
+                seen_claims.add(claim.uid)
                 kid = self.interns.dra_claims.id(claim.uid)
-                self._ensure(DC=cid + 1, CLM=kid + 1)
-                dra_claims[kid] = (cid, claim.count, not claim.allocated_node)
+                unalloc = not claim.allocated_node
+                first = True
+                for sig, cnt in self.dra.charge_pools(claim):
+                    cid = self.interns.device_classes.id(sig)
+                    self._ensure(DC=cid + 1, CLM=kid + 1)
+                    dra_claims.append((kid, cid, cnt, unalloc, first))
+                    first = False
         host_ports = pod.host_ports()
         if len(host_ports) > POD_PORT_SLOTS:
             raise ValueError(
@@ -597,7 +639,7 @@ class SnapshotBuilder:
             "pvcs": pvc_uids,
             "vol_unbound": vol_unbound,
             "vol_csi_lim": vol_csi_lim,
-            "dra_claims": sorted(dra_claims.items()),
+            "dra_claims": dra_claims,
         }
 
     def apply_pod_delta(self, row: int, delta: dict, sign: int, device_already: bool) -> None:
@@ -622,9 +664,12 @@ class SnapshotBuilder:
             h["dev_counts"][vid, row] += sign
             if rw:
                 h["dev_rw_counts"][vid, row] += sign
-        for kid, (cid, cnt, _unalloc) in delta.get("dra_claims", ()):
-            prev = h["dra_claim_counts"][kid, row]
-            h["dra_claim_counts"][kid, row] = prev + sign
+        prev_by_kid: dict[int, int] = {}
+        for kid, cid, cnt, _unalloc, first in delta.get("dra_claims", ()):
+            if first:
+                prev_by_kid[kid] = h["dra_claim_counts"][kid, row]
+                h["dra_claim_counts"][kid, row] += sign
+            prev = prev_by_kid[kid]
             if (sign > 0 and prev == 0) or (sign < 0 and prev == 1):
                 h["dra_alloc"][cid, row] += sign * cnt
         for vid, did in delta.get("csivols", ()):

@@ -738,3 +738,94 @@ def volume_commit(pod, node, vols: RefVolumes, pvc_users: dict) -> None:
                 csi_driver=vols.pvc_driver(pvc),
             )
             pvc.volume_name = name
+
+
+class RefStructuredClaims:
+    """Scalar structured-parameters DRA state (staging
+    dynamic-resource-allocation/structured/allocator.go): named devices
+    with attributes per (node, class); request selectors are supplied by
+    the TEST as plain predicates over an attribute dict — deliberately
+    independent of the engine's CEL compiler (dra_cel.py), so the parity
+    test cross-checks both the compilation and the vectorized pools."""
+
+    def __init__(self, claims=(), slices=(), predicates=None):
+        self.claims = {c.uid: c for c in claims}
+        # (node, class) → {device name → attrs}
+        self.devices: dict[tuple[str, str], dict[str, dict]] = {}
+        for s in slices:
+            key = (s.node_name, s.device_class)
+            devs = self.devices.setdefault(key, {})
+            if s.devices:
+                for d in s.devices:
+                    devs[d.name] = d.attributes
+            else:
+                base = len(devs)
+                for i in range(s.count):
+                    devs[f"{s.device_class}-{base + i}"] = {}
+        # claim uid → {request name → predicate(attrs) -> bool}
+        self.predicates = predicates or {}
+        self.owner: dict[tuple[str, str], dict[str, str]] = {}
+
+    def pod_claims(self, pod):
+        return [
+            self.claims.get(f"{pod.namespace}/{name}")
+            for name in pod.spec.resource_claims
+        ]
+
+    def _free_matching(self, node, req, claim_uid):
+        key = (node, req.device_class)
+        owners = self.owner.get(key, {})
+        pred = self.predicates.get(claim_uid, {}).get(
+            req.name, lambda attrs: True
+        )
+        return sorted(
+            name
+            for name, attrs in self.devices.get(key, {}).items()
+            if name not in owners and pred(attrs)
+        )
+
+    def filter(self, pod, node) -> bool:
+        """Every claim either allocated on THIS node or satisfiable from
+        the node's free matching devices (per-request, all-or-nothing)."""
+        taken: dict[tuple[str, str], set] = {}
+        for claim in self.pod_claims(pod):
+            if claim is None:
+                return False
+            if claim.allocated_node:
+                if claim.allocated_node != node.name:
+                    return False
+                continue
+            for req in claim.device_requests():
+                free = [
+                    n
+                    for n in self._free_matching(node.name, req, claim.uid)
+                    if n not in taken.get((node.name, req.device_class), set())
+                ]
+                if len(free) < req.count:
+                    return False
+                taken.setdefault((node.name, req.device_class), set()).update(
+                    free[: req.count]
+                )
+        return True
+
+    def commit(self, pod, node_name) -> None:
+        """Allocate the pod's claims (sorted-name greedy pick — mirrors the
+        catalog's deterministic order)."""
+        for claim in self.pod_claims(pod):
+            if claim is None:
+                continue
+            if not claim.allocated_node:
+                claim.allocated_node = node_name
+                chosen = []
+                for req in claim.device_requests():
+                    names = self._free_matching(node_name, req, claim.uid)[
+                        : req.count
+                    ]
+                    for n in names:
+                        self.owner.setdefault(
+                            (node_name, req.device_class), {}
+                        )[n] = claim.uid
+                        chosen.append((req.name, n))
+                claim.allocated_devices = tuple(chosen)
+            if pod.uid not in claim.reserved_for:
+                claim.reserved_for += (pod.uid,)

@@ -1,0 +1,392 @@
+"""DRA structured parameters: named devices with attributes, CEL-subset
+request selectors compiled into vectorized pools, exact host allocation —
+parity against an independent scalar oracle (reference:
+plugins/dynamicresources/, staging dynamic-resource-allocation/structured/
+allocator.go; CEL shapes per cel/compile.go)."""
+
+import copy
+
+import pytest
+
+from kubernetes_tpu import dra_cel
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from reference_impl import RefStructuredClaims, fits_request, fit_score
+
+
+# ---------------------------------------------------------------------------
+# CEL-subset compiler
+
+
+def test_cel_compile_comparisons():
+    reqs = dra_cel.compile_selector(
+        'device.attributes["gpu.example.com/memory"].int >= 40'
+    )
+    assert reqs[0].matches({"gpu.example.com/memory": 80})
+    assert not reqs[0].matches({"gpu.example.com/memory": 16})
+    assert not reqs[0].matches({})  # CEL error on missing attr → no match
+
+
+def test_cel_compile_conjunction_and_types():
+    reqs = dra_cel.compile_selector(
+        'device.attributes["arch"].string == "hopper" && '
+        'device.attributes["nvlink"].bool == true'
+    )
+    assert dra_cel.matches(reqs, {"arch": "hopper", "nvlink": True})
+    assert not dra_cel.matches(reqs, {"arch": "hopper", "nvlink": False})
+    assert not dra_cel.matches(reqs, {"arch": "ada", "nvlink": True})
+
+
+def test_cel_in_exists_truthy():
+    assert dra_cel.compile_selector(
+        'device.attributes["arch"] in ["a", "b"]'
+    )[0].matches({"arch": "b"})
+    assert dra_cel.compile_selector('"cc" in device.attributes')[0].matches(
+        {"cc": 9}
+    )
+    assert dra_cel.compile_selector(
+        '!("cc" in device.attributes)'
+    )[0].matches({})
+    assert dra_cel.compile_selector('device.attributes["nvlink"]')[0].matches(
+        {"nvlink": True}
+    )
+    assert dra_cel.compile_selector(
+        '!device.attributes["nvlink"]'
+    )[0].matches({"nvlink": False})
+
+
+def test_cel_rejects_unsupported():
+    for bad in (
+        'device.attributes["x"].int >= 40 || device.attributes["y"].bool',
+        "device.capacity['x'] > quantity('1Gi')",
+        'device.attributes["x"].matches("re.*")',
+    ):
+        with pytest.raises(ValueError):
+            dra_cel.compile_selector(bad)
+
+
+def test_canonical_signature_dedups_equivalent():
+    a = dra_cel.canonical(('device.attributes["m"].int >= 40 && device.attributes["a"].string == "x"',))
+    b = dra_cel.canonical(
+        ('device.attributes["a"].string == "x"', 'device.attributes["m"].int >= 40')
+    )
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Fixture: heterogeneous devices + selective claims
+
+
+GPU = "gpu.example.com"
+
+
+def make_devices(mems, archs, nvlinks):
+    return tuple(
+        t.Device(
+            name=f"d{i}",
+            attributes={"memory": m, "arch": a, "nvlink": v},
+        )
+        for i, (m, a, v) in enumerate(zip(mems, archs, nvlinks))
+    )
+
+
+def build_cluster(s=None):
+    """4 nodes with distinct fit utilizations (unambiguous scoring) and
+    heterogeneous device inventories."""
+    nodes = []
+    specs = [
+        ("n0", "30", make_devices([16, 16], ["ada", "ada"], [False, False])),
+        ("n1", "22", make_devices([40, 80], ["hopper", "hopper"], [True, True])),
+        ("n2", "14", make_devices([80], ["hopper"], [False])),
+        ("n3", "6", make_devices([40, 16, 80], ["ada", "hopper", "hopper"], [True, False, True])),
+    ]
+    for name, cpu, devs in specs:
+        node = make_node(name).capacity(
+            {"cpu": cpu, "memory": "64Gi", "pods": 110}
+        ).obj()
+        nodes.append(node)
+        if s is not None:
+            s.add_node(node)
+            s.add_resource_slice(
+                t.ResourceSlice(node_name=name, device_class=GPU, devices=devs)
+            )
+    slices = [
+        t.ResourceSlice(node_name=name, device_class=GPU, devices=devs)
+        for name, _cpu, devs in specs
+    ]
+    return nodes, slices
+
+
+BIG_MEM = f'device.attributes["memory"].int >= 40'
+HOPPER_LINKED = (
+    'device.attributes["arch"].string == "hopper" && device.attributes["nvlink"].bool == true'
+)
+
+
+def big_mem_pred(attrs):
+    return attrs.get("memory", 0) >= 40
+
+
+def hopper_linked_pred(attrs):
+    return attrs.get("arch") == "hopper" and attrs.get("nvlink") is True
+
+
+def test_selector_restricts_placement():
+    s = TPUScheduler(
+        profile=Profile(
+            name="dra",
+            filters=("NodeResourcesFit", "DynamicResources"),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+    )
+    build_cluster(s)
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="linked",
+            requests=(
+                t.DeviceRequest("r0", GPU, count=2, selectors=(HOPPER_LINKED,)),
+            ),
+        )
+    )
+    s.add_pod(make_pod("p").req({"cpu": "1"}).resource_claim("linked").obj())
+    out = s.schedule_all_pending()
+    # Only n1 has TWO hopper+nvlink devices (n3 has one hopper+nvlink).
+    assert out[0].node_name == "n1"
+    claim = s.builder.dra.claims["default/linked"]
+    assert claim.allocated_node == "n1"
+    assert len(claim.allocated_devices) == 2
+    assert s.builder.host_mirror_equal()
+
+
+def test_structured_parity_vs_scalar_oracle():
+    """Engine decisions == independent scalar oracle over a mixed batch of
+    counted, big-memory, and hopper+nvlink claims (greedy in queue order,
+    unambiguous fit scores)."""
+    profile = Profile(
+        name="dra",
+        filters=("NodeResourcesFit", "DynamicResources"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+    s = TPUScheduler(profile=profile, batch_size=4)
+    nodes, slices = build_cluster(s)
+
+    claims = []
+    predicates = {}
+    pods = []
+    shapes = [
+        ("counted", (t.DeviceRequest("r0", GPU, count=1),), {}),
+        ("bigmem", (t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+         {"r0": big_mem_pred}),
+        ("linked", (t.DeviceRequest("r0", GPU, count=1, selectors=(HOPPER_LINKED,)),),
+         {"r0": hopper_linked_pred}),
+    ]
+    for i in range(8):
+        kind, reqs, preds = shapes[i % 3]
+        c = t.ResourceClaim(name=f"c{i}", requests=copy.deepcopy(reqs))
+        claims.append(c)
+        predicates[c.uid] = preds
+        s.add_resource_claim(copy.deepcopy(c))
+        pod = make_pod(f"p{i}").req({"cpu": "1"}).resource_claim(f"c{i}").obj()
+        pods.append(pod)
+        s.add_pod(copy.deepcopy(pod))
+
+    engine = {
+        o.pod.name: o.node_name for o in s.schedule_all_pending()
+    }
+
+    # Scalar mirror: same pod order, feasibility = fit + structured DRA,
+    # choice = max fit score (ties broken by node order — scores are
+    # distinct by construction), greedy commit.
+    oracle_claims = RefStructuredClaims(
+        claims=copy.deepcopy(claims), slices=slices, predicates=predicates
+    )
+    from reference_impl import RefNodeState
+
+    states = {n.name: RefNodeState(node=n) for n in nodes}
+    expected = {}
+    for pod in pods:
+        feasible = [
+            n
+            for n in nodes
+            if not fits_request(pod, states[n.name])
+            and oracle_claims.filter(pod, n)
+        ]
+        if not feasible:
+            expected[pod.name] = None
+            continue
+        scored = [
+            (fit_score(pod, states[n.name], "LeastAllocated"), -i, n.name)
+            for i, n in enumerate(nodes)
+            if n in feasible
+        ]
+        best = max(scored)[2]
+        expected[pod.name] = best
+        oracle_claims.commit(pod, best)
+        states[best].pods.append(pod)
+    assert engine == expected, (engine, expected)
+    assert s.builder.host_mirror_equal()
+
+
+def test_victim_deletion_frees_selector_devices():
+    """Deleting a claim-holding pod releases its named devices and pools;
+    a waiting selector pod then fits (the resourceclaim controller cleanup
+    + CLAIM release path preemption victims take)."""
+    s = TPUScheduler(
+        profile=Profile(
+            name="dra",
+            filters=("NodeResourcesFit", "DynamicResources"),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=4,
+    )
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj()
+    )
+    s.add_resource_slice(
+        t.ResourceSlice(
+            node_name="n1", device_class=GPU,
+            devices=make_devices([80], ["hopper"], [True]),
+        )
+    )
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="holder",
+            requests=(t.DeviceRequest("r0", GPU, count=1),),
+        )
+    )
+    holder = make_pod("holder").req({"cpu": "1"}).resource_claim("holder").obj()
+    s.add_pod(holder)
+    assert s.schedule_all_pending()[0].node_name == "n1"
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="wants",
+            requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        )
+    )
+    wants = make_pod("wants").req({"cpu": "1"}).resource_claim("wants").obj()
+    s.add_pod(wants)
+    out = s.schedule_all_pending()
+    assert out[-1].node_name is None  # device owned by holder
+    s.delete_pod(holder.uid)
+    # Claim deallocated, device freed, pools discharged.
+    assert s.builder.dra.claims["default/holder"].allocated_node == ""
+    assert s.builder.dra.device_owner.get(("n1", GPU), {}) == {}
+    out2 = s.schedule_all_pending(wait_backoff=True)
+    assert [o.node_name for o in out2 if o.node_name] == ["n1"]
+    assert s.builder.host_mirror_equal()
+
+
+def _dra_sched():
+    s = TPUScheduler(
+        profile=Profile(
+            name="dra",
+            filters=("NodeResourcesFit", "DynamicResources"),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=4,
+    )
+    s.add_node(
+        make_node("n1").capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj()
+    )
+    s.add_resource_slice(
+        t.ResourceSlice(
+            node_name="n1", device_class=GPU,
+            devices=make_devices([80], ["hopper"], [True]),
+        )
+    )
+    return s
+
+
+def test_external_named_claim_backfill_no_double_discharge():
+    """An externally-allocated claim with named devices arriving while its
+    pools are new must not double-discharge on release (review r4)."""
+    s = _dra_sched()
+    ext = t.ResourceClaim(
+        name="ext",
+        requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        allocated_node="n1",
+        allocated_devices=(("r0", "d0"),),
+        reserved_for=("other-pod",),
+    )
+    s.add_resource_claim(ext)
+    cat = s.builder.dra
+    it = s.builder.interns.device_classes
+    row = s.cache.nodes["n1"].row
+    bare = it.id(GPU)
+    sel = it.id([p for p in cat.pools_by_class[GPU] if p != GPU][0])
+    assert s.builder.host["dra_alloc"][bare, row] == 1
+    assert s.builder.host["dra_alloc"][sel, row] == 1
+    # External release: allocation + reservedFor cleared.
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="ext",
+            requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        )
+    )
+    assert s.builder.host["dra_alloc"][bare, row] == 0
+    assert s.builder.host["dra_alloc"][sel, row] == 0
+    assert cat.device_owner.get(("n1", GPU), {}) == {}
+    # The freed device is usable again.
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="mine",
+            requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        )
+    )
+    s.add_pod(make_pod("p").req({"cpu": "1"}).resource_claim("mine").obj())
+    assert s.schedule_all_pending()[0].node_name == "n1"
+    assert s.builder.host_mirror_equal()
+
+
+def test_node_remove_readd_replays_corrections():
+    """remove_node + add_node must replay an external claim's base charges
+    AND its pool-overlap corrections (review r4)."""
+    s = _dra_sched()
+    # External claim charged under the selector pool; its device also
+    # consumes the bare pool via the charge_pools bare entry, and a LATER
+    # pool registration adds a correction.
+    ext = t.ResourceClaim(
+        name="ext",
+        requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        allocated_node="n1",
+        allocated_devices=(("r0", "d0"),),
+        reserved_for=("other-pod",),
+    )
+    s.add_resource_claim(ext)
+    # New pool (nvlink) registered after allocation → correction on ext.
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="probe",
+            requests=(
+                t.DeviceRequest(
+                    "r0", GPU, count=1,
+                    selectors=('device.attributes["nvlink"].bool == true',),
+                ),
+            ),
+        )
+    )
+    cat = s.builder.dra
+    it = s.builder.interns.device_classes
+    nv_sig = [p for p in cat.pools_by_class[GPU] if "nvlink" in p][0]
+    row = s.cache.nodes["n1"].row
+    assert s.builder.host["dra_alloc"][it.id(nv_sig), row] == 1
+    node_obj = s.cache.nodes["n1"].node
+    s.remove_node("n1")
+    assert cat.pending_corr.get("default/ext")
+    s.add_node(node_obj)
+    row2 = s.cache.nodes["n1"].row
+    assert s.builder.host["dra_alloc"][it.id(nv_sig), row2] == 1
+    assert s.builder.host["dra_alloc"][it.id(GPU), row2] == 1
+    # External release after the round-trip: everything discharges to 0.
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="ext",
+            requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        )
+    )
+    for sig in cat.pools_by_class[GPU]:
+        assert s.builder.host["dra_alloc"][it.id(sig), row2] == 0, sig
