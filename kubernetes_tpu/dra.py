@@ -247,10 +247,13 @@ class ClaimCatalog:
         ]
         if sign < 0:
             # Corrections recorded for this claim (new-pool backfill over
-            # its named devices) reverse with the external deallocation.
+            # its named devices) reverse with the external deallocation;
+            # node-removal-parked ones die unapplied (their charges went
+            # with the row) — never replay them against a later allocation.
             corr = self.corrections.pop(claim.uid, None)
             if corr:
                 self.corr_events.append((node, corr, -1))
+            self.pending_corr.pop(claim.uid, None)
         if claim.allocated_devices:
             # The allocation result names its devices: own/free them so
             # selector pools see exact availability.
@@ -319,9 +322,13 @@ class ClaimCatalog:
         taken: dict[tuple[str, str], set[str]] = {}
         need_counter: dict[str, int] = {}
         picks: dict[str, list[tuple[str, str, str]]] = {}  # claim → [(req, cls, dev)]
+        seen_claims: set[str] = set()
         for claim in self.pod_claims(pod):
             if claim is None:
                 return None
+            if claim.uid in seen_claims:
+                continue  # a pod may reference the same claim twice
+            seen_claims.add(claim.uid)
             if claim.allocated_node:
                 if claim.allocated_node != node:
                     return None
@@ -348,7 +355,11 @@ class ClaimCatalog:
             if self.free(node, cls) < cnt:
                 return None
         undo: list[tuple[str, t.ResourceClaim, str]] = []
+        committed: set[str] = set()
         for claim in self.pod_claims(pod):
+            if claim.uid in committed:
+                continue
+            committed.add(claim.uid)
             if not claim.allocated_node:
                 claim.allocated_node = node
                 claim.allocated_devices = tuple(

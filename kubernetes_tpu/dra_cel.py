@@ -46,6 +46,11 @@ _TERM_EXISTS = re.compile(
 )
 
 
+def _same_kind(a, b) -> bool:
+    """bool and int are distinct CEL types (True must not equal 1)."""
+    return isinstance(a, bool) == isinstance(b, bool)
+
+
 @dataclass(frozen=True)
 class Requirement:
     """One compiled term: ``key op value`` over a device's attributes."""
@@ -67,12 +72,15 @@ class Requirement:
             return v is True
         if self.op == "Falsy":
             return v is False
+        # CEL is type-strict: bool-vs-int comparisons type-error, which the
+        # allocator reads as no-match (Python's True == 1 must not leak in,
+        # and a type-error makes Ne false too, not true).
         if self.op == "Eq":
-            return v == self.values[0]
+            return _same_kind(v, self.values[0]) and v == self.values[0]
         if self.op == "Ne":
-            return v != self.values[0]
+            return _same_kind(v, self.values[0]) and v != self.values[0]
         if self.op == "In":
-            return v in self.values
+            return any(_same_kind(v, w) and v == w for w in self.values)
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             return False  # ordered ops need numbers
         w = self.values[0]

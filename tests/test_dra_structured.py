@@ -390,3 +390,83 @@ def test_node_remove_readd_replays_corrections():
     )
     for sig in cat.pools_by_class[GPU]:
         assert s.builder.host["dra_alloc"][it.id(sig), row2] == 0, sig
+
+
+def test_cel_bool_int_type_strict():
+    # CEL type-errors on bool-vs-int (True must not equal 1); Ne on a type
+    # error is also a no-match, not a match.
+    eq = dra_cel.compile_selector('device.attributes["nvlink"].bool == true')[0]
+    assert not eq.matches({"nvlink": 1})
+    assert eq.matches({"nvlink": True})
+    ne = dra_cel.compile_selector('device.attributes["nvlink"].bool != true')[0]
+    assert not ne.matches({"nvlink": 1})
+    assert ne.matches({"nvlink": False})
+    inop = dra_cel.compile_selector('device.attributes["x"] in [1, 2]')[0]
+    assert not inop.matches({"x": True})
+    assert inop.matches({"x": 1})
+
+
+def test_pod_referencing_claim_twice_allocates_once():
+    s = _dra_sched()
+    s.add_resource_slice(
+        t.ResourceSlice(
+            node_name="n1", device_class=GPU,
+            devices=make_devices([40], ["ada"], [False]),
+        )
+    )
+    s.add_resource_claim(
+        t.ResourceClaim(name="c", requests=(t.DeviceRequest("r0", GPU, 1),))
+    )
+    pod = make_pod("p").req({"cpu": "1"}).resource_claim("c").resource_claim("c").obj()
+    s.add_pod(pod)
+    assert s.schedule_all_pending()[0].node_name == "n1"
+    claim = s.builder.dra.claims["default/c"]
+    assert len(claim.allocated_devices) == 1
+    owners = s.builder.dra.device_owner[("n1", GPU)]
+    assert list(owners.values()) == ["default/c"] and len(owners) == 1
+    assert s.builder.dra.allocated[("n1", GPU)] == 1
+    assert s.builder.host_mirror_equal()
+
+
+def test_stale_parked_correction_not_replayed_after_external_realloc():
+    """External dealloc while a node-removal-parked correction exists must
+    drop the parked record; a later re-allocation on the returning node
+    must not inherit it (review r4)."""
+    s = _dra_sched()
+    ext = t.ResourceClaim(
+        name="ext",
+        requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        allocated_node="n1",
+        allocated_devices=(("r0", "d0"),),
+        reserved_for=("other-pod",),
+    )
+    s.add_resource_claim(ext)
+    # Late pool registration → correction for ext (d0 is nvlink-linked).
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="probe",
+            requests=(
+                t.DeviceRequest(
+                    "r0", GPU, count=1,
+                    selectors=('device.attributes["nvlink"].bool == true',),
+                ),
+            ),
+        )
+    )
+    cat = s.builder.dra
+    node_obj = s.cache.nodes["n1"].node
+    s.remove_node("n1")
+    assert cat.pending_corr.get("default/ext")
+    # External dealloc while parked.
+    s.add_resource_claim(
+        t.ResourceClaim(
+            name="ext",
+            requests=(t.DeviceRequest("r0", GPU, count=1, selectors=(BIG_MEM,)),),
+        )
+    )
+    assert "default/ext" not in cat.pending_corr
+    s.add_node(node_obj)
+    it = s.builder.interns.device_classes
+    row = s.cache.nodes["n1"].row
+    nv_sig = [p for p in cat.pools_by_class[GPU] if "nvlink" in p][0]
+    assert s.builder.host["dra_alloc"][it.id(nv_sig), row] == 0
