@@ -86,6 +86,7 @@ type jToleration struct {
 
 type jSpreadConstraint struct {
 	LabelSelector      *jLabelSelector `json:"label_selector"`
+	MatchLabelKeys     []string        `json:"match_label_keys"`
 	MaxSkew            int32           `json:"max_skew"`
 	MinDomains         *int32          `json:"min_domains"`
 	NodeAffinityPolicy string          `json:"node_affinity_policy"`
@@ -355,6 +356,7 @@ func ConvertPod(pod *v1.Pod) ([]byte, error) {
 			MaxSkew: c.MaxSkew, TopologyKey: c.TopologyKey,
 			WhenUnsatisfiable: string(c.WhenUnsatisfiable),
 			LabelSelector:     convLabelSelector(c.LabelSelector),
+			MatchLabelKeys:    append([]string{}, c.MatchLabelKeys...),
 			MinDomains:        c.MinDomains,
 			NodeAffinityPolicy: "Honor", NodeTaintsPolicy: "Ignore",
 		}

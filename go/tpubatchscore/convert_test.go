@@ -182,3 +182,132 @@ func TestConvertNodeMatchesGolden(t *testing.T) {
 		t.Errorf("converted node diverged from golden\nwant:\n%s\ngot:\n%s", w, g)
 	}
 }
+
+// Round-4 full-surface fixtures (default_session scenario objects).
+
+func convertAndCompare(t *testing.T, raw []byte, err error, golden string) {
+	t.Helper()
+	if err != nil {
+		t.Fatal(err)
+	}
+	var got interface{}
+	if err := json.Unmarshal(raw, &got); err != nil {
+		t.Fatal(err)
+	}
+	want := loadGolden(t, golden)
+	if !reflect.DeepEqual(normalize(got), want) {
+		g, _ := json.MarshalIndent(normalize(got), "", " ")
+		w, _ := json.MarshalIndent(want, "", " ")
+		t.Errorf("converted object diverged from %s\nwant:\n%s\ngot:\n%s",
+			golden, w, g)
+	}
+}
+
+func TestConvertNamespaceSelectorPodMatchesGolden(t *testing.T) {
+	pod := &v1.Pod{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: "nssel", Namespace: "default",
+			Labels: map[string]string{"app": "nssel"},
+		},
+		Spec: v1.PodSpec{
+			SchedulerName: "default-scheduler",
+			Containers: []v1.Container{{
+				Name: "c0",
+				Resources: v1.ResourceRequirements{
+					Requests: v1.ResourceList{
+						v1.ResourceCPU: resource.MustParse("500m"),
+					},
+				},
+			}},
+			Affinity: &v1.Affinity{
+				PodAntiAffinity: &v1.PodAntiAffinity{
+					RequiredDuringSchedulingIgnoredDuringExecution: []v1.PodAffinityTerm{{
+						LabelSelector: &metav1.LabelSelector{
+							MatchExpressions: []metav1.LabelSelectorRequirement{{
+								Key: "app", Operator: metav1.LabelSelectorOpIn,
+								Values: []string{"ml"},
+							}},
+						},
+						NamespaceSelector: &metav1.LabelSelector{
+							MatchExpressions: []metav1.LabelSelectorRequirement{{
+								Key: "team", Operator: metav1.LabelSelectorOpIn,
+								Values: []string{"ml"},
+							}},
+						},
+						TopologyKey: "topology.kubernetes.io/zone",
+					}},
+				},
+			},
+		},
+	}
+	raw, err := ConvertPod(pod)
+	convertAndCompare(t, raw, err, "golden_full_pod.json")
+}
+
+func TestConvertMatchLabelKeysSpreadPodMatchesGolden(t *testing.T) {
+	minDomains := int32(2)
+	pod := &v1.Pod{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: "spread-0", Namespace: "default",
+			Labels: map[string]string{"app": "sp", "rev": "r1"},
+		},
+		Spec: v1.PodSpec{
+			SchedulerName: "default-scheduler",
+			Containers: []v1.Container{{
+				Name: "c0",
+				Resources: v1.ResourceRequirements{
+					Requests: v1.ResourceList{
+						v1.ResourceCPU: resource.MustParse("250m"),
+					},
+				},
+			}},
+			TopologySpreadConstraints: []v1.TopologySpreadConstraint{{
+				MaxSkew: 1, TopologyKey: "topology.kubernetes.io/zone",
+				WhenUnsatisfiable: v1.DoNotSchedule,
+				LabelSelector: &metav1.LabelSelector{
+					MatchExpressions: []metav1.LabelSelectorRequirement{{
+						Key: "app", Operator: metav1.LabelSelectorOpIn,
+						Values: []string{"sp"},
+					}},
+				},
+				MinDomains:     &minDomains,
+				MatchLabelKeys: []string{"rev"},
+			}},
+		},
+	}
+	raw, err := ConvertPod(pod)
+	convertAndCompare(t, raw, err, "golden_spread_pod.json")
+}
+
+func TestConvertTaintedNodeMatchesGolden(t *testing.T) {
+	node := &v1.Node{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: "nd1",
+			Labels: map[string]string{
+				"kubernetes.io/hostname":      "nd1",
+				"topology.kubernetes.io/zone": "zone-a",
+				"disk":                        "hdd",
+			},
+		},
+		Spec: v1.NodeSpec{
+			Taints: []v1.Taint{{
+				Key: "dedicated", Value: "gpu",
+				Effect: v1.TaintEffectNoSchedule,
+			}},
+		},
+		Status: v1.NodeStatus{
+			Capacity: v1.ResourceList{
+				v1.ResourceCPU:    resource.MustParse("4"),
+				v1.ResourceMemory: resource.MustParse("16Gi"),
+				v1.ResourcePods:   resource.MustParse("20"),
+			},
+			Allocatable: v1.ResourceList{
+				v1.ResourceCPU:    resource.MustParse("4"),
+				v1.ResourceMemory: resource.MustParse("16Gi"),
+				v1.ResourcePods:   resource.MustParse("20"),
+			},
+		},
+	}
+	raw, err := ConvertNode(node)
+	convertAndCompare(t, raw, err, "golden_full_node.json")
+}

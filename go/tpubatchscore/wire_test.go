@@ -1,6 +1,9 @@
-// wire_test.go: holds the hand-rolled codec to the golden wire transcript
-// (../../tests/golden/basic_session.framestream, recorded by
-// scripts/gen_golden_transcripts.py and replayed by the Python suite).
+// wire_test.go: holds the hand-rolled codec to the golden wire transcripts
+// (../../tests/golden/*.framestream, recorded by
+// scripts/gen_golden_transcripts.py and replayed by the Python suite —
+// basic_session is the fit-only scenario, default_session carries the
+// FULL object surface: affinity/spread/volume/DRA payloads, namespace
+// labels, multi-victim preemption, pod updates, and dump frames).
 // Every frame — requests produced by the Python client and responses
 // produced by the sidecar — must parse and re-marshal byte-identically,
 // proving the Go codec writes exactly the bytes the sidecar's protobuf
@@ -18,9 +21,9 @@ import (
 	"testing"
 )
 
-func readFixture(t *testing.T) [][2][]byte {
+func readFixture(t *testing.T, name string) [][2][]byte {
 	t.Helper()
-	path := filepath.Join("..", "..", "tests", "golden", "basic_session.framestream")
+	path := filepath.Join("..", "..", "tests", "golden", name)
 	data, err := os.ReadFile(path)
 	if err != nil {
 		t.Fatalf("reading fixture: %v", err)
@@ -37,34 +40,44 @@ func readFixture(t *testing.T) [][2][]byte {
 }
 
 func TestGoldenFramesRoundTrip(t *testing.T) {
-	frames := readFixture(t)
-	if len(frames) == 0 {
-		t.Fatal("empty fixture")
+	pattern := filepath.Join("..", "..", "tests", "golden", "*.framestream")
+	paths, err := filepath.Glob(pattern)
+	if err != nil || len(paths) == 0 {
+		t.Fatalf("no framestream fixtures at %s: %v", pattern, err)
 	}
-	var sawSchedule, sawVictims bool
-	for i, f := range frames {
-		env := &Envelope{}
-		if err := env.Unmarshal(f[1]); err != nil {
-			t.Fatalf("frame %d: unmarshal: %v", i, err)
+	var sawSchedule, sawVictims, sawDump bool
+	for _, p := range paths {
+		frames := readFixture(t, filepath.Base(p))
+		if len(frames) == 0 {
+			t.Fatalf("%s: empty fixture", p)
 		}
-		out := env.Marshal()
-		if !bytes.Equal(out, f[1]) {
-			t.Errorf("frame %d (%s): re-marshal diverged\nwant %x\ngot  %x",
-				i, f[0], f[1], out)
-		}
-		if env.Schedule != nil {
-			sawSchedule = true
-		}
-		if env.Response != nil {
-			for _, r := range env.Response.Results {
-				if len(r.VictimUIDs) > 0 {
-					sawVictims = true
+		for i, f := range frames {
+			env := &Envelope{}
+			if err := env.Unmarshal(f[1]); err != nil {
+				t.Fatalf("%s frame %d: unmarshal: %v", p, i, err)
+			}
+			out := env.Marshal()
+			if !bytes.Equal(out, f[1]) {
+				t.Errorf("%s frame %d (%s): re-marshal diverged\nwant %x\ngot  %x",
+					p, i, f[0], f[1], out)
+			}
+			if env.Schedule != nil {
+				sawSchedule = true
+			}
+			if env.Dump != nil {
+				sawDump = true
+			}
+			if env.Response != nil {
+				for _, r := range env.Response.Results {
+					if len(r.VictimUIDs) > 1 {
+						sawVictims = true
+					}
 				}
 			}
 		}
 	}
-	if !sawSchedule || !sawVictims {
-		t.Error("fixture no longer exercises schedule + preemption victims")
+	if !sawSchedule || !sawVictims || !sawDump {
+		t.Error("fixtures no longer exercise schedule + multi-victim preemption + dump")
 	}
 }
 

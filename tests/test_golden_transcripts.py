@@ -27,12 +27,41 @@ from kubernetes_tpu.scheduler import TPUScheduler
 from kubernetes_tpu.sidecar import server as sidecar
 from kubernetes_tpu.sidecar import sidecar_pb2 as pb
 
-GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "basic_session.framestream")
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+GOLDEN = os.path.join(GOLDEN_DIR, "basic_session.framestream")
+
+# The scheduler factories come from the GENERATOR (the single source): a
+# fixture can never be regenerated under one configuration and replayed
+# under another.
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+)
+from gen_golden_transcripts import session_schedulers  # noqa: E402
+
+SESSIONS = {f"{stem}.framestream": stem for stem in session_schedulers()}
 
 
-def read_fixture():
+def _make_scheduler(stem: str) -> TPUScheduler:
+    return session_schedulers()[stem]()
+
+
+def test_every_framestream_fixture_is_replayed():
+    """A new .framestream fixture must join SESSIONS (the Go round-trip
+    test globs; the Python replay must not silently skip it)."""
+    import glob
+
+    on_disk = {
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(GOLDEN_DIR, "*.framestream"))
+    }
+    assert on_disk == set(SESSIONS)
+
+
+def read_fixture(path=GOLDEN):
     frames = []
-    with open(GOLDEN, "rb") as f:
+    with open(path, "rb") as f:
         data = f.read()
     off = 0
     while off < len(data):
@@ -44,28 +73,35 @@ def read_fixture():
 
 
 @pytest.fixture()
-def server_sock():
-    with tempfile.TemporaryDirectory() as td:
-        path = os.path.join(td, "sidecar.sock")
-        srv = sidecar.SidecarServer(
-            path,
-            scheduler=TPUScheduler(
-                profile=fit_only_profile(), batch_size=8, chunk_size=1
-            ),
-        )
-        srv.serve_background()
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.connect(path)
-        try:
-            yield sock
-        finally:
-            sock.close()
-            srv.close()
+def make_server_sock():
+    import contextlib
+
+    @contextlib.contextmanager
+    def _make(profile_name):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "sidecar.sock")
+            srv = sidecar.SidecarServer(path, scheduler=_make_scheduler(profile_name))
+            srv.serve_background()
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(path)
+            try:
+                yield sock
+            finally:
+                sock.close()
+                srv.close()
+
+    return _make
 
 
-def test_replay_golden_session(server_sock):
-    frames = read_fixture()
+@pytest.mark.parametrize("fixture_name", sorted(SESSIONS))
+def test_replay_golden_session(make_server_sock, fixture_name):
+    frames = read_fixture(os.path.join(GOLDEN_DIR, fixture_name))
     assert frames, "empty fixture — regenerate with scripts/gen_golden_transcripts.py"
+    with make_server_sock(SESSIONS[fixture_name]) as server_sock:
+        _replay(frames, server_sock)
+
+
+def _replay(frames, server_sock):
     i = 0
     while i < len(frames):
         direction, payload = frames[i]
@@ -82,12 +118,32 @@ def test_replay_golden_session(server_sock):
         assert i + 1 < len(frames) and frames[i + 1][0] == b"<"
         want = frames[i + 1][1]
         got = _read_frame(server_sock)
-        assert got == want, (
-            f"response frame {i + 1} diverged from the golden recording\n"
-            f"want: {pb.Envelope.FromString(want)}\n"
-            f"got:  {pb.Envelope.FromString(got)}"
-        )
+        if _dump_body(want) is not None:
+            # Debugger dumps embed wall-clock metrics; compare the
+            # structural state with the timing series stripped.
+            assert _dump_body(got) == _dump_body(want), (
+                f"dump frame {i + 1} diverged from the golden recording"
+            )
+        else:
+            assert got == want, (
+                f"response frame {i + 1} diverged from the golden recording\n"
+                f"want: {pb.Envelope.FromString(want)}\n"
+                f"got:  {pb.Envelope.FromString(got)}"
+            )
         i += 2
+
+
+def _dump_body(payload: bytes):
+    """(seq, canonical dump state minus metrics) for dump responses, else
+    None."""
+    import json as _json
+
+    env = pb.Envelope.FromString(payload)
+    if env.WhichOneof("msg") != "response" or not env.response.dump_json:
+        return None
+    d = _json.loads(env.response.dump_json)
+    d.pop("metrics", None)
+    return env.seq, _json.dumps(d, sort_keys=True)
 
 
 def _read_frame(sock) -> bytes:
@@ -115,3 +171,58 @@ def test_fixture_contains_protocol_surface():
                 victims += len(r.victim_uids)
     assert {"add", "remove", "schedule", "response"} <= kinds
     assert victims >= 1, "fixture no longer exercises preemption victim uids"
+
+
+def test_default_fixture_covers_full_surface():
+    """The default-profile session must keep every wire kind and the
+    hairy decision shapes on the recorded wire (VERDICT r3 weak-5):
+    affinity/spread/volume/DRA payloads, namespace labels, a multi-victim
+    preemption, pod update, node remove, and a dump frame."""
+    import json as _json
+
+    msg_kinds = set()
+    obj_kinds = set()
+    victims = []
+    nominated = set()
+    for direction, payload in read_fixture(
+        os.path.join(GOLDEN_DIR, "default_session.framestream")
+    ):
+        env = pb.Envelope()
+        env.ParseFromString(payload)
+        which = env.WhichOneof("msg")
+        msg_kinds.add(which)
+        if which == "add":
+            obj_kinds.add(env.add.kind)
+        elif which == "remove":
+            obj_kinds.add(f"remove:{env.remove.kind}")
+        elif which == "response" and direction == b"<":
+            for r in env.response.results:
+                victims.extend(r.victim_uids)
+                if r.nominated_node:
+                    nominated.add(r.pod_uid)
+    assert {"add", "remove", "schedule", "response", "dump"} <= msg_kinds
+    assert {
+        "Node", "Pod", "PersistentVolume", "PersistentVolumeClaim",
+        "StorageClass", "CSINode", "PodGroup", "PodDisruptionBudget",
+        "ResourceClaim", "ResourceSlice", "NamespaceLabels",
+    } <= obj_kinds
+    assert "remove:Pod" in obj_kinds and "remove:Node" in obj_kinds
+    assert len(set(victims)) >= 2, "multi-victim preemption left the fixture"
+    assert nominated, "nomination left the fixture"
+    # The summary JSON stays in sync with the binary.
+    summary = _json.load(
+        open(os.path.join(GOLDEN_DIR, "default_session.json"))
+    )
+    assert summary["frames"] == len(
+        read_fixture(os.path.join(GOLDEN_DIR, "default_session.framestream"))
+    )
+    # Decision spot-checks pinning the hairy plugins' visible effects:
+    rows_by_pod: dict[str, list] = {}
+    for r in summary["schedule_results"]:
+        rows_by_pod.setdefault(r["pod"], []).append(r)
+    assert rows_by_pod["default/tol"][0]["node"] == "nd1"  # only via toleration
+    vip_rows = rows_by_pod["default/vip"]
+    assert vip_rows[0]["victims"] == ["default/base-0", "default/base-1"]
+    assert vip_rows[0]["nominated"] == "nd5"
+    assert vip_rows[-1]["node"] == "nd5"  # bound after the victims fell
+    assert rows_by_pod["default/huge"][0]["node"] == ""
