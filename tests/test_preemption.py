@@ -398,3 +398,30 @@ def test_inline_commit_spends_stale_nomination():
     ok = [o for o in outs if o.pod.uid == vip.uid and o.node_name]
     assert ok, outs
     assert vip.uid not in s.nominator
+
+
+def test_preemption_with_more_pdbs_than_nodes():
+    """pdb_allowed rides inside the victim mega-buffer only while
+    n_pdbs <= node rows; beyond that it takes its own transfer (review
+    r4: the inline stash must not crash tiny clusters with many PDBs)."""
+    s = TPUScheduler(batch_size=4)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj()
+    )
+    for i in range(20):  # n_pdbs buckets past the 8-row node axis
+        s.add_pdb(
+            t.PodDisruptionBudget(
+                name=f"pdb-{i}", namespace="default",
+                selector=t.LabelSelector(match_labels=(("app", f"a{i}"),)),
+                disruptions_allowed=1,
+            )
+        )
+    low = make_pod("low").req({"cpu": "2"}).priority(1).label("app", "a0").obj()
+    s.add_pod(low)
+    s.schedule_all_pending()
+    assert low.spec.node_name == "n1"
+    vip = make_pod("vip").req({"cpu": "2"}).priority(100).obj()
+    s.add_pod(vip)
+    s.schedule_all_pending(wait_backoff=True)
+    assert vip.spec.node_name == "n1"
+    assert s.metrics.preemptions == 1
