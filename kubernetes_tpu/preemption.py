@@ -100,6 +100,20 @@ def _unpack_victims(buf, spec):
     return tuple(out)
 
 
+@partial(jax.jit, static_argnums=0)
+def _chain_speculative(fn, state, batch_d, picks, elig_sigs, inv_d, *pack_arrays):
+    """Run a compiled dry-run with its valid mask derived from the main
+    pass's DEVICE-resident picks (valid = eligible ∧ pick < 0 — scan
+    failures AND chunk-deferrals speculate; results for pods the strict
+    tail later places are simply never applied).  fn is static (the cached
+    compiled pass), so this wrapper inlines into one dispatched program."""
+    elig, sigs = elig_sigs
+    b = dict(batch_d)
+    b["valid"] = elig & (picks < 0)
+    b["sig"] = sigs
+    return fn(state, b, inv_d, *pack_arrays)
+
+
 @dataclass
 class PreemptionResult:
     node_name: str
@@ -425,7 +439,14 @@ def build_preempt_pass(
             # key, emulating the sequential take-next-best without C copies
             # of the per-preemptor release tensors.  Mates with a different
             # signature defer to the strict chunk=1 re-run.
-            pf0 = jax.tree_util.tree_map(lambda x: x[0], pf)
+            # The representative mate is the first VALID one — under the
+            # speculative chained dispatch the chunk is the ORIGINAL batch,
+            # whose leading pods may have PLACED (valid False, features
+            # gated off); evaluating those would turn the whole rank-split
+            # into defers.  (Sync mode stacks failed pods from index 0, so
+            # idx0 == 0 there — behavior unchanged.)
+            idx0 = jnp.argmax(pf["valid"])
+            pf0 = jax.tree_util.tree_map(lambda x: x[idx0], pf)
             key, possible, vic_mask_all, n_vic_all, rel_all, relnz_all = eval_one(
                 state, vic_prio, vic_req, vic_nonzero, vic_start, pf0, dctx,
                 vfeat, vic_pdb, pdb_allowed,
@@ -433,9 +454,9 @@ def build_preempt_pass(
             # Signature = the featurize-cache identity (namespace + labels +
             # full spec), computed host-side: equal sigs ⇒ identical feature
             # rows ⇒ identical dry-runs.  Priority/req equality alone would
-            # wrongly share mate-0's feasibility with pods whose FILTERS
-            # differ (node affinity, taints, ports — r2 review).
-            samesig = pf["sig"] == pf["sig"][0]
+            # wrongly share the representative's feasibility with pods whose
+            # FILTERS differ (node affinity, taints, ports — r2 review).
+            samesig = pf["sig"] == pf["sig"][idx0]
             eligible = pf["valid"] & samesig
             big = jnp.int64(2**62)
             masked = jnp.where(possible, key, big)  # (N,)
@@ -772,35 +793,7 @@ class PreemptionEvaluator:
         cache, builder = sched.cache, sched.builder
         schema = builder.schema
 
-        # Cheap host-side prunes: (a) a pod whose demand exceeds every
-        # node's allocatable can never be helped by deletion; (b) a pod
-        # whose priority doesn't exceed the LOWEST bound-pod priority has
-        # no victims anywhere.  Both prevent repacking victim tensors for
-        # perma-stuck pods every batch (the Unschedulable-workload shape).
-        max_alloc = builder.host["alloc"].max(axis=0)
-        max_allowed = int(builder.host["allowed_pods"].max(initial=0))
-        min_prio = min(
-            (pr.pod.spec.priority for pr in cache.pods.values()), default=None
-        )
-
-        batch_req = batch_rows.get("req")
-
-        def can_ever_fit(i: int, p: t.Pod) -> bool:
-            if batch_req is not None:
-                req = np.asarray(batch_req[i])  # already featurized this batch
-            else:
-                pr = cache.pods.get(p.uid)
-                delta = pr.delta if pr else builder.pod_delta_vectors(p)
-                req = delta["req"]
-            return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
-
-        eligible = [
-            p.spec.preemption_policy != t.PREEMPT_NEVER
-            and min_prio is not None
-            and p.spec.priority > min_prio
-            and can_ever_fit(i, p)
-            for i, p in enumerate(pods)
-        ]
+        eligible = self._eligibility(pods, batch_rows.get("req"))
         if not any(eligible):
             return [None] * len(pods)
 
@@ -829,41 +822,19 @@ class PreemptionEvaluator:
         batch["valid"][: len(pods)] = eligible
         # Chunk-sharing signature: pods with the same featurize-cache key
         # have identical dry-runs and may split one evaluation's node
-        # ranking (build_preempt_pass step).  Reuse the memoized featurize
+        # ranking (build_preempt_pass step).  Reuses the memoized featurize
         # signature — these pods were just featurized by the failing batch.
-        from .engine.features import _sig
-
-        sig_first: dict = {}
-        sigs = np.zeros(k, np.int32)
-        for i, p in enumerate(pods):
-            memo = getattr(p, "_featsig", None)
-            if memo is not None and memo[0] == profile.name:
-                key_ = memo[1]
-            else:
-                key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
-            sigs[i] = sig_first.setdefault(key_, i)
+        sigs, sig_first = self._sig_ids(pods, profile, k)
         batch["sig"] = sigs
 
         if inv is None:
             inv = builder.batch_invariants()
         state = builder.state()
         # Chunk like the scheduling pass (same dispatch-overhead economics);
-        # the scheduler's chunk_size governs strict (parity) mode too.
-        # A batch whose eligible preemptors ALL share one signature (the
-        # async-preemption shape: N identical VIPs) runs as ONE step — the
-        # rank-split assigns the 1st..Nth best nodes from a single dry-run,
-        # so 16 chunked re-evaluations collapse to one (the chunked-mode
-        # approximation is the same either way; chunk boundaries only
-        # change where the ranking refreshes).
-        if self.sched.chunk_size > 1 and len(sig_first) == 1:
-            chunk = k
-        else:
-            chunk = min(
-                self.sched.chunk_size if self.sched.chunk_size > 1 else 1, 64
-            )
-        chunk = max(1, min(chunk, k))
-        while k % chunk:
-            chunk //= 2
+        # a batch whose eligible preemptors ALL share one signature (the
+        # async-preemption shape: N identical VIPs) runs as ONE rank-split
+        # step (_chunk_for).
+        chunk = self._chunk_for(sig_first, k)
         # ONE coalesced host→device transfer for the per-call inputs (the
         # victim tensors were shipped by pack_victims, possibly overlapped
         # with the failing batch's device pass).
@@ -880,11 +851,136 @@ class PreemptionEvaluator:
         # far cheaper than a sequential k-step re-scan here (the victims'
         # delete events wake them).
 
+        return self._interpret_dryrun(
+            pods, picks, vmasks, pack, candidate_filter
+        )
+
+    def _eligibility(self, pods, batch_req=None) -> list[bool]:
+        """Cheap host-side prunes: (a) a pod whose demand exceeds every
+        node's allocatable can never be helped by deletion; (b) a pod
+        whose priority doesn't exceed the LOWEST bound-pod priority has
+        no victims anywhere.  Both prevent repacking victim tensors for
+        perma-stuck pods every batch (the Unschedulable-workload shape)."""
+        cache, builder = self.sched.cache, self.sched.builder
+        max_alloc = builder.host["alloc"].max(axis=0)
+        max_allowed = int(builder.host["allowed_pods"].max(initial=0))
+        min_prio = min(
+            (pr.pod.spec.priority for pr in cache.pods.values()), default=None
+        )
+
+        def can_ever_fit(i: int, p: t.Pod) -> bool:
+            if batch_req is not None:
+                req = np.asarray(batch_req[i])  # already featurized this batch
+            else:
+                pr = cache.pods.get(p.uid)
+                delta = pr.delta if pr else builder.pod_delta_vectors(p)
+                req = delta["req"]
+            return bool((req <= max_alloc[: req.shape[0]]).all()) and max_allowed >= 1
+
+        return [
+            p.spec.preemption_policy != t.PREEMPT_NEVER
+            and min_prio is not None
+            and p.spec.priority > min_prio
+            and can_ever_fit(i, p)
+            for i, p in enumerate(pods)
+        ]
+
+    def _sig_ids(self, pods, profile, k: int):
+        """Chunk-sharing signatures (first-index representative ids) for
+        the dry-run's rank-split, padded to k."""
+        from .engine.features import _sig
+
+        sig_first: dict = {}
+        sigs = np.zeros(k, np.int32)
+        for i, p in enumerate(pods):
+            memo = getattr(p, "_featsig", None)
+            if memo is not None and memo[0] == profile.name:
+                key_ = memo[1]
+            else:
+                key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
+            sigs[i] = sig_first.setdefault(key_, i)
+        return sigs, sig_first
+
+    def dispatch_speculative(self, ctx: dict, pack: dict):
+        """Dispatch the dry-run CHAINED on the in-flight main pass's
+        device-resident verdicts (valid = eligible ∧ pick < 0) — zero host
+        round trips between the phases and no re-upload of the pod batch
+        (ctx["batch_d"] is reused).  The dry-run sees the post-scan state
+        (ctx["new_state"]); strict-tail commits land after dispatch, so
+        the scheduler re-validates capacity before an INLINE commit of a
+        speculative result (collect path) — nominate-and-retry results
+        validate themselves on retry.  Returns a handle for
+        collect_speculative, or None when speculation doesn't apply."""
+        sched = self.sched
+        if ctx.get("pinned") or "batch_d" not in ctx:
+            return None
+        infos, profile, active = ctx["infos"], ctx["profile"], ctx["active"]
+        pods = [qp.pod for qp in infos]
+        eligible = self._eligibility(pods, ctx["batch"].get("req"))
+        if not any(eligible):
+            return None
+        k = sched.batch_size
+        elig = np.zeros(k, np.bool_)
+        elig[: len(pods)] = eligible
+        sigs, sig_first = self._sig_ids(pods, profile, k)
+        chunk = self._chunk_for(sig_first, k)
+        fn = self._pass(profile, active, pack["n_pdbs"], chunk)
+        out, _fs, _fp = _chain_speculative(
+            fn, ctx["new_state"], ctx["batch_d"], ctx["result"].picks,
+            jax.device_put((elig, sigs)), ctx["inv_d"], pack["d_prio"],
+            pack["d_vic_req"], pack["d_vic_nonzero"], pack["d_vic_start"],
+            pack["d_vfeat"], pack["d_pdb"], pack["d_allowed"],
+        )
+        return dict(out=out, pack=pack)
+
+    def _chunk_for(self, sig_first: dict, k: int) -> int:
+        """Dry-run chunking, shared by the sync and speculative paths (a
+        divergence here would double the compiled-pass cache and split
+        behavior for the same batch shape): uniform-signature batches
+        collapse to ONE rank-split step; otherwise the scheduler's chunk
+        clamped to 64, halved until it divides k."""
+        if self.sched.chunk_size > 1 and len(sig_first) == 1:
+            chunk = k
+        else:
+            chunk = min(
+                self.sched.chunk_size if self.sched.chunk_size > 1 else 1, 64
+            )
+        chunk = max(1, min(chunk, k))
+        while k % chunk:
+            chunk //= 2
+        return chunk
+
+    def collect_speculative(
+        self, spec: dict, fetched, failed_pods_by_index: dict
+    ) -> dict:
+        """Interpret speculative results for the batch indices that FAILED
+        (scan or tail).  ``fetched`` = (picks, vic_mask) numpy arrays from
+        the combined fetch; indices that placed in the strict tail are
+        skipped (their dry-run was computed but never applied — pure
+        compute, no side effects).  Returns {batch index: result}."""
+        picks, vmasks = fetched
+        idxs = sorted(failed_pods_by_index)
+        pods = [failed_pods_by_index[i] for i in idxs]
+        results = self._interpret_dryrun(
+            pods, picks[idxs], vmasks[idxs], spec["pack"]
+        )
+        return dict(zip(idxs, results))
+
+    def _interpret_dryrun(
+        self, pods, picks, vmasks, pack, candidate_filter=None
+    ) -> list[PreemptionResult | None]:
+        """prepareCandidate over fetched dry-run results: delete victims,
+        nominate; consumed victims dedup across same-pass preemptors.
+        Shared by the synchronous path and collect_speculative."""
+        sched = self.sched
+        cache = sched.cache
+        pdbs, matched_pdbs = pack["pdbs"], pack["matched_pdbs"]
+        per_node = pack["per_node"]
         results: list[PreemptionResult | None] = []
         consumed: set[str] = set()
         for i, pod in enumerate(pods):
             pick = int(picks[i])
-            if pick < 0:
+            if pick < 0 or pod is None:
                 results.append(None)
                 continue
             node_name = cache.node_name_at_row(pick)

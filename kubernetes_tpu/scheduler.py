@@ -75,6 +75,19 @@ class SchedulerMetrics:
     registry: MetricsRegistry = field(default_factory=MetricsRegistry)
 
 
+# Hard filters that read MUTABLE per-node state (pods' labels/ports/volumes
+# on the node — anything a strict-tail placement can change).  Node-static
+# filters (taints, labels, capacity, unschedulable) are NOT here: tail
+# commits cannot invalidate them, and resources re-check via _fits_now.
+DYNAMIC_HARD_OPS = frozenset(
+    {
+        "InterPodAffinity", "PodTopologySpread", "NodePorts",
+        "VolumeRestrictions", "NodeVolumeLimits", "VolumeBinding",
+        "VolumeZone", "DynamicResources",
+    }
+)
+
+
 class TPUScheduler:
     def __init__(
         self,
@@ -762,6 +775,24 @@ class TPUScheduler:
         qp.nom_pin_failed = False  # fresh nomination: the pin may try again
         self.queue.add(qp.pod)
 
+    def _fits_now(self, node_name: str, delta: dict) -> bool:
+        """Host-truth capacity re-check before INLINE-committing a
+        SPECULATIVE preemption result: its dry-run saw the post-scan state,
+        so a strict-tail commit landing on the chosen node after dispatch
+        could invalidate it (the victims are already evicted from host
+        truth when this runs).  A failed check falls back to the
+        nominate-and-retry path, which validates itself."""
+        rec = self.cache.nodes.get(node_name)
+        if rec is None:
+            return False
+        h = self.builder.host
+        row = rec.row
+        req = delta["req"]
+        free = h["alloc"][row, : req.shape[0]] - h["req"][row, : req.shape[0]]
+        if ((req > 0) & (req > free)).any():
+            return False
+        return h["num_pods"][row] < h["allowed_pods"][row]
+
     def _can_commit_inline(self, qp: QueuedPodInfo) -> bool:
         """Inline preemptor commit is limited to pods with no Permit group
         and no relevant Reserve plugin — those chains run on the
@@ -1270,6 +1301,14 @@ class TPUScheduler:
             prepacked = self.preemption.pack_victims(self.profile, ctx["active"])
             tr.step("prepacked victim tensors")
         ctx["prepacked"] = prepacked
+        if prepacked is not None:
+            # Chain the dry-run on the in-flight pass's device verdicts —
+            # its compute overlaps the main fetch + strict tail, and its
+            # results ride the first host round trip (ADVICE: the three
+            # fetches of a failing batch collapse toward one).
+            ctx["spec"] = self.preemption.dispatch_speculative(ctx, prepacked)
+            if ctx["spec"] is not None:
+                tr.step("dispatched speculative preemption")
         # Overlap featurize(k+1) with device(k) — the VERDICT r1 host
         # ceiling.  Gated off when the active ops read mutable host
         # catalogs (volume/DRA binds bump the feature version every
@@ -1469,7 +1508,7 @@ class TPUScheduler:
         self._cycle += len(infos)
         return dict(
             work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
-            new_state=new_state, result=result, t1=t1,
+            batch_d=batch_d, new_state=new_state, result=result, t1=t1,
             schema=self.builder.schema, chunk=chunk,
         )
 
@@ -1485,11 +1524,22 @@ class TPUScheduler:
         nomrow, inv = ctx["nomrow"], ctx["inv"]
         new_state, result, t1 = ctx["new_state"], ctx["result"], ctx["t1"]
         # One host round trip for all result arrays (the tunnel to the device
-        # has high per-transfer latency; never sync field-by-field).
-        picks, scores, feas, fails, processed = device_fetch(
-            (result.picks, result.scores, result.feasible_counts,
-             result.fail_masks, result.processed)
-        )
+        # has high per-transfer latency; never sync field-by-field) — the
+        # speculative preemption results ride the same fetch.
+        spec = ctx.get("spec")
+        if spec is not None:
+            (picks, scores, feas, fails, processed,
+             sp_picks, sp_vmask) = device_fetch(
+                (result.picks, result.scores, result.feasible_counts,
+                 result.fail_masks, result.processed,
+                 spec["out"].picks, spec["out"].vic_mask)
+            )
+            ctx["spec_res"] = (sp_picks, sp_vmask)
+        else:
+            picks, scores, feas, fails, processed = device_fetch(
+                (result.picks, result.scores, result.feasible_counts,
+                 result.fail_masks, result.processed)
+            )
         if self._truncated:
             # Advance the rotating start by this batch's processedNodes sum
             # (modular sums compose across the scan's per-step updates).
@@ -1519,6 +1569,7 @@ class TPUScheduler:
         # vocab crossed a power-of-two bucket): requeue the affected pods —
         # they reschedule next batch under the grown schema.
         schema_grew = ctx["schema"] != self.builder.schema
+        tail_placed = False  # did the strict tail COMMIT anything?
         if deferred and schema_grew:
             for i in deferred:
                 self.queue.reactivate(infos[i])
@@ -1584,12 +1635,14 @@ class TPUScheduler:
             # (e.g. a freshly-added empty node attracting every chunk-mate,
             # the churn-workload magnet); once earlier commits are visible
             # they place cleanly in one pass instead of one scan step each.
+            all_deferred = list(deferred)
             if ctx["chunk"] > 1 and len(deferred) > self.tail_size:
                 deferred = run_tail(deferred, ctx["chunk"], self.batch_size)
             # Round 2 — strict sequential-equivalent finisher (chunk=1
             # never defers, so this always terminates).
             if deferred:
                 run_tail(deferred, 1, self.tail_size)
+            tail_placed = any(picks[i] >= 0 for i in all_deferred)
         t2 = time.perf_counter()
         self._last_batch_meta = (
             {k: (v.shape, np.asarray(v).dtype) for k, v in batch.items()},
@@ -1867,6 +1920,7 @@ class TPUScheduler:
         t_post = time.perf_counter()
         # (Preemption also sits out a schema-grown batch: its pass would mix
         # old-shape feature rows with rebuilt state; failures just requeue.)
+        spec_applied = False
         if (
             failed
             and self.preemption is not None
@@ -1874,22 +1928,54 @@ class TPUScheduler:
             and not schema_grew
         ):
             ran_postfilter = True
-            rows = {
-                key: [np.asarray(arr)[i] for i, _, _ in failed]
-                for key, arr in batch.items()
-                if key not in ("valid", "pin_row")
-            }
-            results = self.preemption.preempt_batch(
-                [qp.pod for _, qp, _ in failed], rows, active, ctx["inv_d"],
-                profile=profile, prepacked=ctx.get("prepacked"),
-            )
+            if spec is not None and "spec_res" in ctx:
+                # The dry-run already ran, chained on the scan's verdicts;
+                # interpret its results for the pods that FINALLY failed
+                # (tail placements simply never apply theirs).
+                by_index = self.preemption.collect_speculative(
+                    spec, ctx["spec_res"],
+                    {i: qp.pod for i, qp, _ in failed},
+                )
+                results = [by_index.get(i) for i, _qp, _ in failed]
+                spec_applied = True
+            else:
+                rows = {
+                    key: [np.asarray(arr)[i] for i, _, _ in failed]
+                    for key, arr in batch.items()
+                    if key not in ("valid", "pin_row")
+                }
+                results = self.preemption.preempt_batch(
+                    [qp.pod for _, qp, _ in failed], rows, active,
+                    ctx["inv_d"], profile=profile,
+                    prepacked=ctx.get("prepacked"),
+                )
         if self.preemption is not None:
             # Prepack victim tensors next batch only while failures recur.
             self.preemption.expect_failures = bool(failed)
         any_victims = False
+        # A SPECULATIVE result's dry-run predates the strict tail.  Inline
+        # commit needs its verdict still valid against post-tail truth:
+        # resources re-check via _fits_now always; hard filters that read
+        # MUTABLE node state (affinity/spread/ports/volumes/DRA) cannot be
+        # re-checked host-side, so when the tail actually placed something
+        # AND such an op is active, speculative results take the
+        # nominate-and-retry path (which re-validates on device).
+        spec_inline_ok = not spec_applied or not tail_placed or not (
+            active & DYNAMIC_HARD_OPS
+        )
         for (i, qp, outcome), res in zip(failed, results):
             if res is not None:
-                if self.inline_preempt_commit and self._can_commit_inline(qp):
+                if (
+                    self.inline_preempt_commit
+                    and self._can_commit_inline(qp)
+                    and (
+                        not spec_applied
+                        or (
+                            spec_inline_ok
+                            and self._fits_now(res.node_name, deltas[i])
+                        )
+                    )
+                ):
                     self._commit_preempted(qp, outcome, res, deltas[i], now)
                 else:
                     # The fit overlay protects the freed node from same/

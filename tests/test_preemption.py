@@ -425,3 +425,40 @@ def test_preemption_with_more_pdbs_than_nodes():
     s.schedule_all_pending(wait_backoff=True)
     assert vip.spec.node_name == "n1"
     assert s.metrics.preemptions == 1
+
+
+def test_speculative_chained_preemption_mixed_batch():
+    """The chained dry-run (dispatch_speculative): a batch mixing a pod
+    that PLACES with pods that need preemption must still preempt — the
+    rank-split's representative is the first VALID mate, not index 0
+    (which may have placed and carries valid=False)."""
+    s = TPUScheduler(profile=fit_only_profile(), batch_size=8, chunk_size=4)
+    for i in range(3):
+        s.add_node(
+            make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "16Gi", "pods": 20}
+            ).obj()
+        )
+    for i in range(2):  # fill n-two nodes; one node keeps room
+        s.add_pod(make_pod(f"bg-{i}").req({"cpu": "3900m"}).priority(1).obj())
+    s.schedule_all_pending(wait_backoff=True)
+    s.preemption.expect_failures = True  # speculate on the next batch
+    fits = make_pod("fits").req({"cpu": "1"}).priority(100).obj()
+    vips = [
+        make_pod(f"vip-{i}").req({"cpu": "3"}).priority(100).obj()
+        for i in range(2)
+    ]
+    s.add_pod(fits)
+    for p in vips:
+        s.add_pod(p)
+    # ONE batch: with the index-0 representative bug the failed vip's
+    # speculative dry-run deferred (None) and no preemption happened this
+    # batch; the fix preempts inline within it.
+    s.schedule_batch()
+    assert fits.spec.node_name  # placed without eviction
+    assert s.metrics.preemptions >= 1
+    placed = [p for p in vips if p.spec.node_name]
+    assert placed, "no vip placed in the speculative batch"
+    s.schedule_all_pending(wait_backoff=True)
+    assert all(p.spec.node_name for p in vips)
+    assert s.builder.host_mirror_equal()
