@@ -9,6 +9,7 @@ valid=False and are ignored by the engine's commit."""
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -130,6 +131,7 @@ def build_pod_batch(
     profile: Profile,
     k: int,
     force_active: frozenset[str] | None = None,
+    sample_into: dict | None = None,
 ) -> tuple[dict, list[dict], frozenset[str]]:
     """Featurize up to ``k`` pods into a dict of (k, …) numpy arrays, plus the
     per-pod commit deltas (reused by the cache's assume step so pods are
@@ -345,9 +347,21 @@ def build_pod_batch(
             "vol_unbound": np.bool_(delta["vol_unbound"]),
             "vol_csi_lim": np.bool_(delta["vol_csi_lim"]),
         }
+        # plugin_execution_duration_seconds{plugin, Featurize}: the
+        # per-plugin measurable unit of the batch engine (the device pass
+        # fuses the rest), recorded only on ~10% of batches like the
+        # reference (schedule_one.go:48 pluginMetricsSamplePercent).
         for op in ops:
             if op.featurize is not None:
-                feats.update(op.featurize(pod, fctx))
+                if sample_into is None:
+                    feats.update(op.featurize(pod, fctx))
+                else:
+                    t0 = time.perf_counter()
+                    feats.update(op.featurize(pod, fctx))
+                    sample_into[op.name] = (
+                        sample_into.get(op.name, 0.0)
+                        + time.perf_counter() - t0
+                    )
         per_pod.append(feats)
         v2 = (builder.feature_version(), profile, active)
         if v2 != version:  # this pod grew a vocabulary — new cache generation

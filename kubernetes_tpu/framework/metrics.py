@@ -90,6 +90,32 @@ class MetricsRegistry:
     scheduling_sli: Histogram = field(default_factory=Histogram)
     # scheduling_attempt_duration_seconds (one batch / attempts in it).
     attempt_duration: Histogram = field(default_factory=Histogram)
+    # plugin_execution_duration_seconds{plugin, extension_point}
+    # (metrics.go:256) — SAMPLED at ~10% like the reference
+    # (schedule_one.go:48,104 pluginMetricsSamplePercent): the batch
+    # engine's per-plugin measurable units are each op's FEATURIZE slice
+    # (the device pass fuses the rest) and each host plugin's
+    # Reserve/Permit/PreBind call.
+    plugin_execution: dict[tuple[str, str], Histogram] = field(
+        default_factory=dict
+    )
+    # Deterministic PER-SITE sampling counters (the reference uses
+    # rand.Intn(100); modular counters keep benches reproducible, and
+    # per-site keying prevents interleaved call sites from aliasing onto
+    # fixed residues — one site permanently sampled, another never).
+    _sample_ticks: dict[str, int] = field(default_factory=dict)
+
+    def sample_plugins(self, site: str) -> bool:
+        """True for ~1 in 10 calls FROM THIS SITE — the per-batch gate."""
+        tick = (self._sample_ticks.get(site, 0) + 1) % 10
+        self._sample_ticks[site] = tick
+        return tick == 0
+
+    def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
+        h = self.plugin_execution.get((plugin, point))
+        if h is None:
+            h = self.plugin_execution[(plugin, point)] = Histogram()
+        h.observe(seconds)
 
     def observe_point(self, point: str, seconds: float) -> None:
         self.extension_point[point].observe(seconds)
@@ -101,4 +127,9 @@ class MetricsRegistry:
             },
             "pod_scheduling_sli_duration_seconds": self.scheduling_sli.summary(),
             "scheduling_attempt_duration_seconds": self.attempt_duration.summary(),
+            "plugin_execution_duration_seconds": {
+                f"{plugin}/{point}": h.summary()
+                for (plugin, point), h in sorted(self.plugin_execution.items())
+                if h.n
+            },
         }

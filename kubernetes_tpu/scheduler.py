@@ -1338,9 +1338,18 @@ class TPUScheduler:
         grow vocab/schema (forcing a state rebuild at dispatch).  Always
         pads to the full batch size: one batch shape → one XLA program."""
         t0 = time.perf_counter()
-        batch, deltas, active = build_pod_batch(
-            [qp.pod for qp in infos], self.builder, profile, self.batch_size
+        # ~10% of batches record per-plugin featurize durations
+        # (plugin_execution_duration_seconds, metrics.go:256).
+        sample = (
+            {} if self.metrics.registry.sample_plugins("featurize") else None
         )
+        batch, deltas, active = build_pod_batch(
+            [qp.pod for qp in infos], self.builder, profile, self.batch_size,
+            sample_into=sample,
+        )
+        if sample:
+            for op_name, secs in sample.items():
+                self.metrics.registry.observe_plugin(op_name, "Featurize", secs)
         return {
             "batch": batch, "deltas": deltas, "active": active,
             "feat_s": time.perf_counter() - t0,
@@ -1754,6 +1763,9 @@ class TPUScheduler:
         race_rollback: set[str] = set()  # transient (PV race): retry on timer
         prebind_parked: set[str] = set()  # pods gone to the PreBind wait room
         prebind_s = 0.0
+        # Per-plugin sampled Reserve durations: ONE gate per batch (the
+        # reference samples per scheduling attempt, schedule_one.go:104).
+        sample_rp = bool(entries) and m.registry.sample_plugins("reserve")
         for qp, node_name, score, feasn in entries:
             g, gpl = self._permit_group(qp.pod)
             if g in rollback:
@@ -1781,7 +1793,13 @@ class TPUScheduler:
             ]
             t_pb = time.perf_counter() if relevant else 0.0
             for rp in relevant:
+                t_rp = time.perf_counter() if sample_rp else 0.0
                 u = rp.reserve(qp.pod, node_name, self)
+                if sample_rp:
+                    m.registry.observe_plugin(
+                        getattr(rp, "name", type(rp).__name__), "Reserve",
+                        time.perf_counter() - t_rp,
+                    )
                 if u is None:
                     for rp2, u2 in reversed(undos):
                         rp2.unreserve(u2, self)

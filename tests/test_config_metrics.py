@@ -108,3 +108,25 @@ def test_dump_state_and_consistency_check():
     assert d["pods"]["default/p"]["bound"] is True
     assert d["queue"]["pending"] == 0
     s.check_consistency()
+
+
+def test_plugin_execution_sampled_metrics():
+    """plugin_execution_duration_seconds{plugin, point} (metrics.go:256,
+    ~10% sampled like schedule_one.go:48): per-op featurize slices and
+    host Reserve plugin calls appear in the registry summary after enough
+    batches for the sampling gate to fire."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    s = TPUScheduler(batch_size=2)
+    s.add_node(
+        make_node("n1").capacity({"cpu": "64", "memory": "64Gi", "pods": 110}).obj()
+    )
+    for i in range(30):  # ≥10 batches → the 1-in-10 gate fires
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "100m"}).label("app", f"a{i}").obj())
+        s.schedule_all_pending()
+    series = s.metrics.registry.summary()["plugin_execution_duration_seconds"]
+    assert any(k.endswith("/Featurize") for k in series), series
+    # Each sampled series carries counts and latency quantiles.
+    sample = next(iter(series.values()))
+    assert sample["count"] >= 1 and "p99" in sample
