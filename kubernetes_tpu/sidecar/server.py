@@ -103,19 +103,38 @@ class SidecarServer:
                     conns.discard(self.request)
 
             def _serve_frames(self) -> None:
+                subscribed = False
                 while True:
                     try:
                         env = read_frame(self.request)
+                    except TimeoutError:
+                        # Subscribed sockets carry a write timeout (push
+                        # backpressure bound) which applies to this idle
+                        # read too — just keep listening for EOF.
+                        continue
                     except (ValueError, OSError):
                         return
                     if env is None:
                         return
+                    if subscribed:
+                        # The push stream is one-way after the subscribe
+                        # ack; a request frame here would race the pushes
+                        # (two writers interleaving on one socket).  Drop
+                        # the connection — the protocol violation is the
+                        # client's.
+                        return
                     out = pb.Envelope(seq=env.seq)
+                    responded = False
                     try:
                         with lock:
-                            _dispatch(sched, env, out, front)
+                            responded = _dispatch(
+                                sched, env, out, front, self.request
+                            )
                     except Exception as exc:  # surface, don't kill the server
                         out.response.error = f"{type(exc).__name__}: {exc}"
+                    if responded:
+                        subscribed = True
+                        continue
                     try:
                         write_frame(self.request, out)
                     except OSError:  # peer (or close()) severed mid-dispatch
@@ -166,9 +185,68 @@ class SidecarServer:
 
 
 def _dispatch(
-    sched: TPUScheduler, env: pb.Envelope, out: pb.Envelope, front=None
-) -> None:
+    sched: TPUScheduler,
+    env: pb.Envelope,
+    out: pb.Envelope,
+    front=None,
+    conn=None,
+) -> bool:
+    """Handle one frame.  Returns True when the response was already
+    written inside the dispatch lock (the subscribe handshake — its ack
+    must be ordered against subsequent Push frames on the same socket,
+    and every write to a subscriber happens under this lock)."""
     kind = env.WhichOneof("msg")
+    if kind == "subscribe":
+        # Turn this connection into a decision push stream (watch-stream
+        # idiom).  Requires the speculative frontend — without it there
+        # are no speculative decisions to stream.
+        if front is None:
+            raise ValueError("subscribe requires speculation enabled")
+        if conn is None:
+            raise ValueError("subscribe needs a connection")
+        out.response.SetInParent()
+        write_frame(conn, out)  # ack, ordered before any push frame
+        # Bounded-blocking pushes: a subscriber that stops draining its
+        # socket must not wedge the dispatch lock (and with it every
+        # other connection).  The timeout turns backpressure into an
+        # OSError and the frontend drops the sink — a stalled subscriber
+        # has missed frames and must resubscribe anyway.
+        conn.settimeout(5.0)
+
+        def _sink(e, c=conn):
+            try:
+                write_frame(c, e)
+            except OSError:
+                # A failed/timed-out push leaves a partial frame on the
+                # socket — unrecoverable for the stream.  shutdown() (not
+                # close()) wakes the handler thread blocked in recv on
+                # this fd without freeing the fd for reuse under it; the
+                # handler's normal exit path owns the close (the same
+                # pattern SidecarServer.close() uses).
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise
+
+        front.add_sink(_sink)
+        return True
+    if kind == "health":
+        # healthz/readyz analog (cmd/kube-scheduler/app/server.go:181–210):
+        # a liveness surface the host can probe beyond a failed dial.
+        import json as _json
+
+        state = {
+            "healthy": True,
+            "ready": True,
+            "nodes": len(sched.cache.nodes),
+            "pods": len(sched.cache.pods),
+            "pending": len(sched.queue),
+            "speculation": front is not None,
+            "epoch": front.epoch if front is not None else 0,
+        }
+        out.response.health_json = _json.dumps(state).encode()
+        return False
     if kind == "add":
         if env.add.kind == "PendingPod":
             # A pending-pod HINT (speculate.py): the host's informer saw an
@@ -184,9 +262,9 @@ def _dispatch(
             # feeding affinity namespaceSelector matching.
             import json
 
-            if front is not None:
-                front.invalidate()
             data = json.loads(env.add.object_json)
+            if front is not None:
+                front.note_add("NamespaceLabels", data)
             sched.builder.set_namespace_labels(data["namespace"], data["labels"])
             out.response.SetInParent()
             return
@@ -330,6 +408,31 @@ class SidecarClient:
         env = pb.Envelope()
         env.dump.SetInParent()
         return json.loads(self._call(env).response.dump_json)
+
+    def health(self) -> dict:
+        """healthz/readyz probe (app/server.go:181–210 analog)."""
+        import json
+
+        env = pb.Envelope()
+        env.health.SetInParent()
+        return json.loads(self._call(env).response.health_json)
+
+    def subscribe(self) -> None:
+        """Turn THIS connection into a decision push stream.  After the
+        ack, use read_push() exclusively — request methods would desync
+        against the server-initiated frames."""
+        env = pb.Envelope()
+        env.subscribe.SetInParent()
+        self._call(env)
+
+    def read_push(self) -> pb.Push | None:
+        """Blocking read of the next Push frame (None on EOF)."""
+        env = read_frame(self.sock)
+        if env is None:
+            return None
+        if env.WhichOneof("msg") != "push":
+            raise RuntimeError("non-push frame on a subscribed connection")
+        return env.push
 
     def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
         env = pb.Envelope()

@@ -109,10 +109,19 @@ def test_mutation_invalidates_and_rolls_back():
             client.add("PendingPod", p)
         (r0,) = client.schedule([pods[0]], drain=False)
         assert r0.node_name
-        # A real cluster mutation: a NEW node appears.
+        # A NEW node appearing does NOT stale committed bind decisions
+        # (upstream pods scheduled against a pre-add snapshot keep their
+        # bindings too) — the cache survives, scoped invalidation.
         client.add("Node", node("n-new", cpu="4"))
         (r1,) = client.schedule([pods[1]], drain=False)
         assert r1.node_name
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] == 0
+        # A label change on a chosen node remaps topology domains —
+        # THAT is a global mutation and rolls the cache back.
+        n0 = node("n0", cpu="4")
+        n0.metadata.labels["pool"] = "tainted"
+        client.add("Node", n0)
         stats = client.dump()["speculation"]
         assert stats["invalidations"] >= 1
         assert stats["rolled_back"] >= 1

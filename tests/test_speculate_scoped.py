@@ -1,0 +1,394 @@
+"""Scoped speculative invalidation + the decision push stream.
+
+VERDICT r4 next-4: per-decision dependency sets (node touched, domain
+reads, volume/DRA use, gang membership) so a cluster event invalidates
+only INTERSECTING decisions — the O(changed) principle of the reference's
+generation-diff snapshot (backend/cache/cache.go:186) applied to the
+speculation cache.  Plus the subscribe/push surface (VERDICT r4 next-1):
+decisions stream to subscribers as epoch-ordered frames so the host
+plugin answers PreFilter from a local map with no wire round trip."""
+
+import tempfile
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+
+def node(name: str, cpu: str = "8", labels: dict | None = None):
+    b = make_node(name).capacity({"cpu": cpu, "memory": "32Gi", "pods": 110})
+    for k, v in (labels or {}).items():
+        b = b.label(k, v)
+    return b.obj()
+
+
+def pod(name: str, cpu: str = "1", priority: int = 0):
+    p = make_pod(name).req({"cpu": cpu})
+    if priority:
+        p = p.priority(priority)
+    return p.obj()
+
+
+def _spec_server(batch_size=8, lookahead=None):
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(
+        path,
+        scheduler=TPUScheduler(batch_size=batch_size),
+        speculate=True,
+        lookahead=lookahead,
+    )
+    srv.serve_background()
+    return srv, SidecarClient(path), path
+
+
+def test_foreign_bind_invalidates_only_its_node():
+    """A bind we didn't decide consumes ONE node's resources: decisions
+    on other nodes (no domain terms) survive it."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0", cpu="4"))
+        client.add("Node", node("n1", cpu="4"))
+        pods = [pod(f"p{i}") for i in range(6)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        # Foreign pod bound to n0 by another profile.
+        foreign = pod("foreign", cpu="1")
+        foreign.spec.node_name = "n0"
+        client.add("Pod", foreign)
+        stats = client.dump()["speculation"]
+        # Scoped: only decisions ON n0 rolled back, not the whole cache.
+        assert stats["full_invalidations"] == 0
+        cached_before = stats["speculated"] - stats["rolled_back"]
+        assert cached_before > 0  # some survivors still cached
+        # Survivors still serve as hits; evictees recompute on miss.
+        hits0 = stats["hits"]
+        for p in pods[1:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        stats = client.dump()["speculation"]
+        assert stats["hits"] > hits0
+        dump = client.dump()
+        assert dump["mirror_equal"]
+        # Capacity respected post-recompute: n0 holds the foreign pod too.
+        cpu_used = {}
+        for rec in dump["pods"].values():
+            cpu_used[rec["node"]] = cpu_used.get(rec["node"], 0) + 1
+        assert all(c <= 4 for c in cpu_used.values())
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_node_add_wakes_unschedulable_verdicts():
+    """A cached 'no feasible node' verdict is invalidated by new capacity
+    (the node-add queueing hint, scheduling_queue.go:1029) — without
+    disturbing committed placements."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0", cpu="2"))
+        pods = [pod(f"p{i}", cpu="2") for i in range(3)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name == "n0"
+        # p1/p2 got unschedulable verdicts in the same batch (no room).
+        (r1,) = client.schedule([pods[1]], drain=False)
+        assert not r1.node_name
+        client.add("Node", node("n-new", cpu="4"))
+        stats = client.dump()["speculation"]
+        assert stats["full_invalidations"] == 0
+        # p2's cached unschedulable verdict was scoped out; the re-ask
+        # recomputes against the new node and fits.
+        (r2,) = client.schedule([pods[2]], drain=False)
+        assert r2.node_name == "n-new"
+        # p1 re-asks after its backoff: also recomputed, fits now.
+        (r1b,) = client.schedule([pods[1]], drain=False)
+        assert r1b.node_name == "n-new"
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_volume_event_spares_volumeless_decisions():
+    """A StorageClass upsert touches only volume-dependent decisions;
+    plain pods' cached decisions survive."""
+    from kubernetes_tpu.api import types as t
+
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        client.add(
+            "StorageClass",
+            t.StorageClass(name="fast", provisioner="csi.example.com"),
+        )
+        stats = client.dump()["speculation"]
+        assert stats["full_invalidations"] == 0
+        assert stats["rolled_back"] == 0  # no cached decision uses volumes
+        for p in pods[1:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        stats = client.dump()["speculation"]
+        assert stats["hits"] == 3  # all served from the surviving cache
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_push_stream_serves_decisions_without_wire_calls():
+    """Subscribe → decisions arrive as Push frames after the miss batch;
+    the emulated plugin-local map then answers without Schedule calls,
+    and the bind echo retires entries without invalidation."""
+    srv, client, path = _spec_server()
+    sub = None
+    try:
+        client.add("Node", node("n0"))
+        client.add("Node", node("n1"))
+        sub = SidecarClient(path)
+        sub.subscribe()
+        pods = [pod(f"p{i}") for i in range(8)]
+        for p in pods:
+            client.add("PendingPod", p)
+        # One wire miss computes the batch and pushes the co-scheduled 7.
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        push = sub.read_push()
+        assert push is not None and not push.invalidate_all
+        local = {d.pod_uid: d for d in push.decisions}
+        assert len(local) == 7  # requested pod rides the response, not the push
+        assert r0.pod_uid not in local
+        # The plugin-local map answers the remaining pods with NO wire call.
+        for p in pods[1:]:
+            d = local.pop(p.uid)
+            assert d.node_name
+            # Host binds it; the informer echo is a confirmation.
+            p.spec.node_name = d.node_name
+            client.add("Pod", p)
+        stats = client.dump()["speculation"]
+        assert stats["pushed"] == 7
+        assert stats["invalidations"] == 0  # echoes confirmed, not mutated
+        assert stats["hits"] == 0  # nothing needed the wire hit path
+        dump = client.dump()
+        assert dump["mirror_equal"]
+        assert len(dump["pods"]) == 8
+    finally:
+        if sub is not None:
+            sub.close()
+        client.close()
+        srv.close()
+
+
+def test_push_invalidation_precedes_recomputed_decisions():
+    """Stream-order contract: the invalidation frame (epoch bump) arrives
+    BEFORE any decision recomputed after it, so an in-order subscriber
+    can never hold a rolled-back decision."""
+    srv, client, path = _spec_server()
+    sub = None
+    try:
+        client.add("Node", node("n0", cpu="4", labels={"zone": "a"}))
+        sub = SidecarClient(path)
+        sub.subscribe()
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        first = sub.read_push()
+        assert len(first.decisions) == 3
+        epoch0 = first.epoch
+        # Global mutation: label change → full rollback.
+        client.add("Node", node("n0", cpu="4", labels={"zone": "b"}))
+        inv = sub.read_push()
+        assert inv.invalidate_all
+        assert inv.epoch == epoch0 + 1
+        # Recompute lands at the NEW epoch, after the invalidation frame.
+        (r1,) = client.schedule([pods[1]], drain=False)
+        assert r1.node_name
+        nxt = sub.read_push()
+        assert not nxt.invalidate_all
+        assert nxt.epoch == epoch0 + 1
+        assert all(d.pod_uid != r1.pod_uid for d in nxt.decisions)
+    finally:
+        if sub is not None:
+            sub.close()
+        client.close()
+        srv.close()
+
+
+def test_scoped_push_invalidation_names_uids():
+    """A scoped rollback pushes the specific uids, not invalidate_all."""
+    srv, client, path = _spec_server()
+    sub = None
+    try:
+        client.add("Node", node("n0", cpu="4"))
+        client.add("Node", node("n1", cpu="4"))
+        sub = SidecarClient(path)
+        sub.subscribe()
+        pods = [pod(f"p{i}") for i in range(6)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        push = sub.read_push()
+        by_node: dict[str, list] = {}
+        for d in push.decisions:
+            by_node.setdefault(d.node_name, []).append(d.pod_uid)
+        # Foreign bind on n0: only n0's cached decisions roll back.
+        foreign = pod("foreign")
+        foreign.spec.node_name = "n0"
+        client.add("Pod", foreign)
+        inv = sub.read_push()
+        assert not inv.invalidate_all
+        invalidated = set(inv.invalidate_uids)
+        assert invalidated  # n0 had at least one cached decision
+        expect_n0 = {u for u in by_node.get("n0", []) if u != r0.pod_uid}
+        assert invalidated == expect_n0
+    finally:
+        if sub is not None:
+            sub.close()
+        client.close()
+        srv.close()
+
+
+def test_reverse_antiaffinity_escalates_domain_events():
+    """An EXISTING pod's required anti-affinity constrains future pods
+    (existingAntiAffinityCounts, interpodaffinity/filtering.go:155) — so
+    once such a pod is in the mirror, a domain event must stale even
+    TERMS-FREE cached decisions (they may sit in the constrained domain)."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0", labels={"zone": "a"}))
+        client.add("Node", node("n1", labels={"zone": "a"}))
+        # A bound pod with required anti-affinity against app=web pods.
+        guard = (
+            make_pod("guard")
+            .req({"cpu": "1"})
+            .pod_anti_affinity_in("app", ["web"], "zone")
+            .node("n0")
+            .obj()
+        )
+        client.add("Pod", guard)
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        # A pod delete is a domain event (domains=True in note_remove);
+        # with the reverse flag set, the terms-free cached decisions are
+        # invalidated too — NOT kept alive by their own empty DepSets.
+        client.remove("Pod", guard.uid)
+        stats = client.dump()["speculation"]
+        assert stats["rolled_back"] >= 1
+        for p in pods[1:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        assert client.dump()["mirror_equal"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_incoming_antiaffinity_bind_full_rollback():
+    """A foreign bind CARRYING required anti-affinity imposes a reverse
+    constraint no cached DepSet anticipated → full rollback, even for
+    decisions on other nodes."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0", labels={"zone": "a"}))
+        client.add("Node", node("n1", labels={"zone": "a"}))
+        pods = [pod(f"p{i}") for i in range(4)]
+        for p in pods:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        foreign = (
+            make_pod("foreign")
+            .req({"cpu": "1"})
+            .pod_anti_affinity_in("app", ["web"], "zone")
+            .node("n1")
+            .obj()
+        )
+        client.add("Pod", foreign)
+        stats = client.dump()["speculation"]
+        assert stats["full_invalidations"] == 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_node_add_invalidates_spread_decisions():
+    """A new node is a new (empty) topology domain: cached DoNotSchedule
+    spread placements can now violate maxSkew and must recompute."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0", labels={"zone": "a"}))
+        client.add("Node", node("n1", labels={"zone": "b"}))
+        spread = [
+            make_pod(f"s{i}")
+            .req({"cpu": "1"})
+            .label("app", "web")
+            .spread_constraint(
+                1, "zone", "DoNotSchedule",
+                label_key="app", label_values=["web"],
+            )
+            .obj()
+            for i in range(4)
+        ]
+        for p in spread:
+            client.add("PendingPod", p)
+        (r0,) = client.schedule([spread[0]], drain=False)
+        assert r0.node_name
+        rolled0 = client.dump()["speculation"]["rolled_back"]
+        client.add("Node", node("n2", labels={"zone": "c"}))
+        stats = client.dump()["speculation"]
+        assert stats["rolled_back"] > rolled0  # spread decisions recompute
+        for p in spread[1:]:
+            (r,) = client.schedule([p], drain=False)
+            assert r.node_name
+        # Post-recompute the placements respect maxSkew over 3 zones.
+        dump = client.dump()
+        zones = {"n0": "a", "n1": "b", "n2": "c"}
+        per_zone = {"a": 0, "b": 0, "c": 0}
+        for rec in dump["pods"].values():
+            per_zone[zones[rec["node"]]] += 1
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_drain_bound_exhaustion_is_counted():
+    """VERDICT r4 weak-4: when _run_batch's 64-batch bound runs out with
+    the requested pod still queued, the synthesized 'no feasible node' is
+    counted as drain_exhausted (the availability lie made visible)."""
+    srv, client, _ = _spec_server(batch_size=1, lookahead=128)
+    try:
+        client.add("Node", node("n0", cpu="256"))
+        # 80 higher-priority hints starve the requested pod past the bound.
+        for i in range(80):
+            client.add("PendingPod", pod(f"vip-{i}", priority=10))
+        target = pod("steerage", priority=0)
+        (r,) = client.schedule([target], drain=False)
+        assert not r.node_name  # under-delivered, not truly infeasible
+        stats = client.dump()["speculation"]
+        assert stats["drain_exhausted"] == 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_health_surface():
+    """healthz/readyz analog over the wire (app/server.go:181–210)."""
+    srv, client, _ = _spec_server()
+    try:
+        client.add("Node", node("n0"))
+        h = client.health()
+        assert h["healthy"] and h["ready"]
+        assert h["nodes"] == 1
+        assert h["speculation"] is True
+    finally:
+        client.close()
+        srv.close()
