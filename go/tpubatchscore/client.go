@@ -4,16 +4,41 @@
 package tpubatchscore
 
 import (
+	"errors"
 	"fmt"
 	"net"
 	"sync"
 )
 
+// ErrSidecarDown marks transport-level failures (dial/read/write) as
+// opposed to sidecar-reported errors.  PreFilter degrades these to an
+// Unschedulable status — the pod requeues and retries instead of the
+// whole scheduling cycle erroring (the host's failure-response story,
+// SURVEY §5; cmd/kube-scheduler/app/server.go:181 healthz precedent).
+var ErrSidecarDown = errors.New("sidecar unreachable")
+
+// ResyncObject is one object the owner re-ships after a reconnect — the
+// informer-store replay (the Go analog of the Python host's
+// ResyncingClient, sidecar/host.py: the HOST holds informer truth, a
+// restarted sidecar's mirror is rebuilt from it).
+type ResyncObject struct {
+	Kind string
+	JSON []byte
+}
+
 // Client speaks the sidecar protocol over a unix-domain (or TCP) socket.
+// On a transport failure it redials once and, when the owner provides
+// ResyncObjects, replays the informer store before re-issuing the failed
+// call — so a restarted sidecar never serves from an empty mirror.
 type Client struct {
-	mu   sync.Mutex
-	conn net.Conn
-	seq  uint64
+	mu      sync.Mutex
+	conn    net.Conn
+	seq     uint64
+	network string
+	addr    string
+	// ResyncObjects returns the full object store to replay after a
+	// reconnect (nodes first, then pods — dependency order).  Optional.
+	ResyncObjects func() []ResyncObject
 }
 
 // Dial connects to the sidecar.  network is "unix" or "tcp".
@@ -22,23 +47,21 @@ func Dial(network, addr string) (*Client, error) {
 	if err != nil {
 		return nil, err
 	}
-	return &Client{conn: conn}, nil
+	return &Client{conn: conn, network: network, addr: addr}, nil
 }
 
 func (c *Client) Close() error { return c.conn.Close() }
 
-// call sends one envelope and waits for its response.
-func (c *Client) call(env *Envelope) (*Response, error) {
-	c.mu.Lock()
-	defer c.mu.Unlock()
+// callLocked runs one request/response on the current connection.
+func (c *Client) callLocked(env *Envelope) (*Response, error) {
 	c.seq++
 	env.Seq = c.seq
 	if err := WriteFrame(c.conn, env); err != nil {
-		return nil, err
+		return nil, fmt.Errorf("%w: %v", ErrSidecarDown, err)
 	}
 	resp, err := ReadFrame(c.conn)
 	if err != nil {
-		return nil, err
+		return nil, fmt.Errorf("%w: %v", ErrSidecarDown, err)
 	}
 	if resp.Seq != env.Seq {
 		return nil, fmt.Errorf("seq mismatch: sent %d got %d", env.Seq, resp.Seq)
@@ -50,6 +73,35 @@ func (c *Client) call(env *Envelope) (*Response, error) {
 		return nil, fmt.Errorf("sidecar: %s", resp.Response.Error)
 	}
 	return resp.Response, nil
+}
+
+// call sends one envelope and waits for its response.  On a transport
+// failure it redials once, replays the owner's object store, and
+// re-issues the call; if the sidecar is still down the ErrSidecarDown
+// surfaces for the caller to degrade on (PreFilter → Unschedulable).
+func (c *Client) call(env *Envelope) (*Response, error) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	resp, err := c.callLocked(env)
+	if err == nil || !errors.Is(err, ErrSidecarDown) {
+		return resp, err
+	}
+	conn, derr := net.Dial(c.network, c.addr)
+	if derr != nil {
+		return nil, err // still down; surface the original failure
+	}
+	_ = c.conn.Close()
+	c.conn = conn
+	if c.ResyncObjects != nil {
+		for _, obj := range c.ResyncObjects() {
+			if _, rerr := c.callLocked(&Envelope{
+				Add: &AddObject{Kind: obj.Kind, ObjectJSON: obj.JSON},
+			}); rerr != nil {
+				return nil, fmt.Errorf("resync replay: %w", rerr)
+			}
+		}
+	}
+	return c.callLocked(env)
 }
 
 // AddObject upserts a cluster object (Node, Pod, PersistentVolume, …).
@@ -80,4 +132,25 @@ func (c *Client) Dump() ([]byte, error) {
 		return nil, err
 	}
 	return resp.DumpJSON, nil
+}
+
+// Health probes the sidecar's healthz/readyz analog and returns its JSON
+// state (app/server.go:181–210's /healthz applied to the sidecar).
+func (c *Client) Health() ([]byte, error) {
+	resp, err := c.call(&Envelope{Health: &HealthRequest{}})
+	if err != nil {
+		return nil, err
+	}
+	return resp.HealthJSON, nil
+}
+
+// Subscribe performs the subscription handshake and hands the raw
+// connection to the caller: after the ack the connection is a ONE-WAY
+// push stream (read with ReadFrame; request methods on it would desync).
+// The Client must not be used afterwards.
+func (c *Client) Subscribe() (net.Conn, error) {
+	if _, err := c.call(&Envelope{Subscribe: &SubscribeRequest{}}); err != nil {
+		return nil, err
+	}
+	return c.conn, nil
 }

@@ -1,0 +1,187 @@
+// subscriber.go: the plugin-local decision cache fed by the sidecar's
+// push stream, and the coalesced PendingPods hint flusher.
+//
+// The speculative sidecar answers the host's one-pod-per-cycle loop
+// (pkg/scheduler/scheduler.go:470) from a decision cache; streaming those
+// decisions HERE lets PreFilter answer from a local map with no wire
+// round trip at all — the cached-placement precedent of
+// .status.nominatedNodeName (schedule_one.go:491–502), applied to every
+// pod.  Ordering contract (proto/sidecar.proto Push): frames apply in
+// stream order; an invalidation frame precedes any decision recomputed
+// after it, so this cache can never serve a decision from a rolled-back
+// epoch.  Nominations are never pushed — preemption always travels the
+// wire (PostFilter owns the victim DELETEs).
+package tpubatchscore
+
+import (
+	"sync"
+	"time"
+
+	"k8s.io/klog/v2"
+)
+
+// decisionCache is the plugin-local map.  Entries are consumed
+// (popped) on PreFilter hits: a decision answers exactly one cycle, the
+// way the sidecar's own cache entries are popped on delivery.
+type decisionCache struct {
+	mu     sync.Mutex
+	m      map[string]Decision
+	epoch  uint64
+	hits   uint64
+	misses uint64
+}
+
+func newDecisionCache() *decisionCache {
+	return &decisionCache{m: make(map[string]Decision)}
+}
+
+func (c *decisionCache) pop(uid string) (Decision, bool) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	d, ok := c.m[uid]
+	if ok {
+		delete(c.m, uid)
+		c.hits++
+	} else {
+		c.misses++
+	}
+	return d, ok
+}
+
+func (c *decisionCache) apply(p *Push) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	// Invalidations first — the sidecar emits rollbacks and the decisions
+	// recomputed after them as separate frames, in epoch order.
+	if p.InvalidateAll {
+		clear(c.m)
+	}
+	for _, uid := range p.InvalidateUIDs {
+		delete(c.m, uid)
+	}
+	c.epoch = p.Epoch
+	for _, d := range p.Decisions {
+		c.m[d.PodUID] = d
+	}
+}
+
+func (c *decisionCache) reset() {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	clear(c.m)
+}
+
+// subscribeLoop dials its own connection, subscribes, and applies Push
+// frames until the stream dies; then it drops the whole cache (frames
+// were missed — the map may hold rolled-back decisions) and redials with
+// backoff.  Every miss falls back to the wire, so a dead stream only
+// costs performance, never correctness.
+func (p *Plugin) subscribeLoop(network, addr string) {
+	backoff := 100 * time.Millisecond
+	for {
+		client, err := Dial(network, addr)
+		if err != nil {
+			time.Sleep(backoff)
+			if backoff < 5*time.Second {
+				backoff *= 2
+			}
+			continue
+		}
+		conn, err := client.Subscribe()
+		if err != nil {
+			_ = client.Close()
+			time.Sleep(backoff)
+			if backoff < 5*time.Second {
+				backoff *= 2
+			}
+			continue
+		}
+		backoff = 100 * time.Millisecond
+		klog.V(2).InfoS("tpubatchscore: decision push stream subscribed")
+		for {
+			env, err := ReadFrame(conn)
+			if err != nil {
+				break
+			}
+			if env.Push != nil {
+				p.decisions.apply(env.Push)
+			}
+		}
+		_ = conn.Close()
+		// The stream broke mid-flight: invalidations may have been lost.
+		p.decisions.reset()
+		klog.V(2).InfoS("tpubatchscore: push stream lost; cache dropped, redialing")
+	}
+}
+
+// hintFlusher coalesces PendingPod hints into PendingPods array frames:
+// informer handlers fire once per pod, but one frame per hint pays one
+// ack per hint — batching the backlog is the same trade client-go's
+// Reflector makes for its initial List.
+type hintFlusher struct {
+	mu     sync.Mutex
+	buf    [][]byte
+	timer  *time.Timer
+	client *Client
+}
+
+const (
+	hintFlushBytes = 256              // flush when this many hints are queued
+	hintFlushDelay = 2 * time.Millisecond // or this long after the first
+)
+
+func (f *hintFlusher) add(raw []byte) {
+	f.mu.Lock()
+	f.buf = append(f.buf, raw)
+	if len(f.buf) >= hintFlushBytes {
+		buf := f.takeLocked()
+		f.mu.Unlock()
+		f.send(buf)
+		return
+	}
+	if f.timer == nil {
+		f.timer = time.AfterFunc(hintFlushDelay, f.flush)
+	}
+	f.mu.Unlock()
+}
+
+func (f *hintFlusher) takeLocked() [][]byte {
+	buf := f.buf
+	f.buf = nil
+	if f.timer != nil {
+		f.timer.Stop()
+		f.timer = nil
+	}
+	return buf
+}
+
+func (f *hintFlusher) flush() {
+	f.mu.Lock()
+	buf := f.takeLocked()
+	f.mu.Unlock()
+	f.send(buf)
+}
+
+func (f *hintFlusher) send(buf [][]byte) {
+	if len(buf) == 0 {
+		return
+	}
+	// Join into one JSON array: [obj,obj,...] — each element is already
+	// canonical JSON from ConvertPod.
+	n := 2
+	for _, b := range buf {
+		n += len(b) + 1
+	}
+	arr := make([]byte, 0, n)
+	arr = append(arr, '[')
+	for i, b := range buf {
+		if i > 0 {
+			arr = append(arr, ',')
+		}
+		arr = append(arr, b...)
+	}
+	arr = append(arr, ']')
+	if err := f.client.AddObject("PendingPods", arr); err != nil {
+		klog.V(4).InfoS("tpubatchscore: hint flush failed", "err", err)
+	}
+}

@@ -71,12 +71,15 @@ func readVarint(b []byte) (uint64, int, error) {
 // Envelope mirrors sidecar.proto Envelope; exactly one of the oneof
 // pointers is set.
 type Envelope struct {
-	Seq      uint64
-	Add      *AddObject
-	Remove   *RemoveObject
-	Schedule *ScheduleBatchRequest
-	Response *Response
-	Dump     *DumpRequest
+	Seq       uint64
+	Add       *AddObject
+	Remove    *RemoveObject
+	Schedule  *ScheduleBatchRequest
+	Response  *Response
+	Dump      *DumpRequest
+	Subscribe *SubscribeRequest
+	Push      *Push
+	Health    *HealthRequest
 }
 
 type AddObject struct {
@@ -96,6 +99,31 @@ type ScheduleBatchRequest struct {
 
 type DumpRequest struct{}
 
+// SubscribeRequest turns the connection into a one-way decision push
+// stream (sidecar.proto SubscribeRequest).
+type SubscribeRequest struct{}
+
+// HealthRequest probes the sidecar's healthz/readyz analog.
+type HealthRequest struct{}
+
+// Decision is one pushed speculative verdict (sidecar.proto Decision).
+type Decision struct {
+	PodUID               string
+	NodeName             string // "" = unschedulable verdict
+	Score                int64
+	FeasibleNodes        int32
+	UnschedulablePlugins []string
+}
+
+// Push is the subscription payload: invalidations first, then decisions
+// decided at Epoch — stream order IS the consistency contract.
+type Push struct {
+	Epoch          uint64
+	InvalidateAll  bool
+	InvalidateUIDs []string
+	Decisions      []Decision
+}
+
 type PodResult struct {
 	PodUID               string
 	NodeName             string
@@ -109,9 +137,10 @@ type PodResult struct {
 }
 
 type Response struct {
-	Error    string
-	Results  []PodResult
-	DumpJSON []byte
+	Error      string
+	Results    []PodResult
+	DumpJSON   []byte
+	HealthJSON []byte
 }
 
 // --- marshal ---------------------------------------------------------------
@@ -192,6 +221,46 @@ func (m *Response) marshal() []byte {
 	if len(m.DumpJSON) > 0 {
 		b = appendBytesField(b, 3, m.DumpJSON)
 	}
+	if len(m.HealthJSON) > 0 {
+		b = appendBytesField(b, 4, m.HealthJSON)
+	}
+	return b
+}
+
+func (m *Decision) marshal() []byte {
+	var b []byte
+	if m.PodUID != "" {
+		b = appendStringField(b, 1, m.PodUID)
+	}
+	if m.NodeName != "" {
+		b = appendStringField(b, 2, m.NodeName)
+	}
+	if m.Score != 0 {
+		b = appendUintField(b, 3, uint64(m.Score))
+	}
+	if m.FeasibleNodes != 0 {
+		b = appendUintField(b, 4, uint64(uint32(m.FeasibleNodes)))
+	}
+	for _, p := range m.UnschedulablePlugins {
+		b = appendStringField(b, 5, p)
+	}
+	return b
+}
+
+func (m *Push) marshal() []byte {
+	var b []byte
+	if m.Epoch != 0 {
+		b = appendUintField(b, 1, m.Epoch)
+	}
+	if m.InvalidateAll {
+		b = appendUintField(b, 2, 1)
+	}
+	for _, u := range m.InvalidateUIDs {
+		b = appendStringField(b, 3, u)
+	}
+	for i := range m.Decisions {
+		b = appendBytesField(b, 4, m.Decisions[i].marshal())
+	}
 	return b
 }
 
@@ -214,6 +283,12 @@ func (m *Envelope) Marshal() []byte {
 		b = appendBytesField(b, 5, m.Response.marshal())
 	case m.Dump != nil:
 		b = appendBytesField(b, 6, []byte{})
+	case m.Subscribe != nil:
+		b = appendBytesField(b, 7, []byte{})
+	case m.Push != nil:
+		b = appendBytesField(b, 8, m.Push.marshal())
+	case m.Health != nil:
+		b = appendBytesField(b, 9, []byte{})
 	}
 	return b
 }
@@ -311,9 +386,59 @@ func unmarshalResponse(b []byte) (*Response, error) {
 			r.Results = append(r.Results, pr)
 		case 3:
 			r.DumpJSON = append([]byte(nil), f.buf...)
+		case 4:
+			r.HealthJSON = append([]byte(nil), f.buf...)
 		}
 	}
 	return r, nil
+}
+
+func unmarshalDecision(b []byte) (Decision, error) {
+	var d Decision
+	fs, err := fields(b)
+	if err != nil {
+		return d, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			d.PodUID = string(f.buf)
+		case 2:
+			d.NodeName = string(f.buf)
+		case 3:
+			d.Score = int64(f.num)
+		case 4:
+			d.FeasibleNodes = int32(f.num)
+		case 5:
+			d.UnschedulablePlugins = append(d.UnschedulablePlugins, string(f.buf))
+		}
+	}
+	return d, nil
+}
+
+func unmarshalPush(b []byte) (*Push, error) {
+	p := &Push{}
+	fs, err := fields(b)
+	if err != nil {
+		return nil, err
+	}
+	for _, f := range fs {
+		switch f.tag {
+		case 1:
+			p.Epoch = f.num
+		case 2:
+			p.InvalidateAll = f.num != 0
+		case 3:
+			p.InvalidateUIDs = append(p.InvalidateUIDs, string(f.buf))
+		case 4:
+			d, err := unmarshalDecision(f.buf)
+			if err != nil {
+				return nil, err
+			}
+			p.Decisions = append(p.Decisions, d)
+		}
+	}
+	return p, nil
 }
 
 func unmarshalAddObject(b []byte) (*AddObject, error) {
@@ -389,6 +514,12 @@ func (m *Envelope) Unmarshal(b []byte) error {
 			m.Response, err = unmarshalResponse(f.buf)
 		case 6:
 			m.Dump = &DumpRequest{}
+		case 7:
+			m.Subscribe = &SubscribeRequest{}
+		case 8:
+			m.Push, err = unmarshalPush(f.buf)
+		case 9:
+			m.Health = &HealthRequest{}
 		}
 		if err != nil {
 			return err

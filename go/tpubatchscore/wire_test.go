@@ -46,6 +46,7 @@ func TestGoldenFramesRoundTrip(t *testing.T) {
 		t.Fatalf("no framestream fixtures at %s: %v", pattern, err)
 	}
 	var sawSchedule, sawVictims, sawDump bool
+	var sawSubscribe, sawPush, sawInval, sawHealth, sawPendingBatch bool
 	for _, p := range paths {
 		frames := readFixture(t, filepath.Base(p))
 		if len(frames) == 0 {
@@ -67,6 +68,23 @@ func TestGoldenFramesRoundTrip(t *testing.T) {
 			if env.Dump != nil {
 				sawDump = true
 			}
+			if env.Subscribe != nil {
+				sawSubscribe = true
+			}
+			if env.Push != nil {
+				if len(env.Push.Decisions) > 0 {
+					sawPush = true
+				}
+				if env.Push.InvalidateAll || len(env.Push.InvalidateUIDs) > 0 {
+					sawInval = true
+				}
+			}
+			if env.Health != nil {
+				sawHealth = true
+			}
+			if env.Add != nil && env.Add.Kind == "PendingPods" {
+				sawPendingBatch = true
+			}
 			if env.Response != nil {
 				for _, r := range env.Response.Results {
 					if len(r.VictimUIDs) > 1 {
@@ -78,6 +96,9 @@ func TestGoldenFramesRoundTrip(t *testing.T) {
 	}
 	if !sawSchedule || !sawVictims || !sawDump {
 		t.Error("fixtures no longer exercise schedule + multi-victim preemption + dump")
+	}
+	if !sawSubscribe || !sawPush || !sawInval || !sawHealth || !sawPendingBatch {
+		t.Error("fixtures no longer exercise subscribe + push (decisions & invalidations) + health + batched hints")
 	}
 }
 

@@ -1,0 +1,190 @@
+"""Generated per-dataclass JSON (de)serializers.
+
+`serialize._build` / `serialize.to_dict` walk type hints reflectively on
+every call — ~114µs to rebuild a Pod, ~80µs to serialize one.  At the
+sidecar's wire rates (10k+ pods per measured window, one JSON object per
+informer event) that reflection is the single largest host-side cost of
+the integrated path.  This module generates a specialized builder/dumper
+function per dataclass once (the same trade the reference makes by
+generating ugorji/json codecs for its API types instead of reflecting:
+k8s.io/apimachinery generated.pb.go + deepcopy-gen), then runs at plain
+attribute/dict speed (~8µs/pod).
+
+Semantics are identical to the reflective versions and pinned by
+tests/test_types.py round-trips plus the golden object fixtures:
+  - builders: missing keys fall back to dataclass defaults (any KeyError
+    routes the whole object through the generic `fallback` builder);
+    None stays None for Optional fields.
+  - dumpers: every field is emitted (the canonical form — no omitempty),
+    tuples/dicts of primitives pass through uncopied (json.dumps treats
+    tuples as arrays; nothing mutates the result before encoding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+_PRIMITIVES = (str, int, float, bool)
+
+
+def _is_passthrough(tp: Any) -> bool:
+    """Types whose JSON form needs no per-element work in either
+    direction (primitives and Any)."""
+    return tp in _PRIMITIVES or tp is Any or tp is object
+
+
+class _Gen:
+    """One code generator; `builder(cls)` / `dumper(cls)` memoize
+    per-dataclass functions compiled into a shared namespace."""
+
+    def __init__(self, fallback):
+        # fallback(cls, data) — the reflective builder, used when a fast
+        # builder sees a missing key (hand-written JSON omitting fields).
+        self.ns: dict[str, Any] = {"_fallback": fallback, "_tuple": tuple}
+        self.builders: dict[type, Any] = {}
+        self.dumpers: dict[type, Any] = {}
+
+    # -- building (JSON data -> dataclass) --------------------------------
+
+    def _bexpr(self, tp: Any, src: str, depth: int) -> str:
+        origin = get_origin(tp)
+        if origin is typing.Union:
+            args = [a for a in get_args(tp) if a is not type(None)]
+            # Mirrors serialize._build: the first non-None arm wins.
+            inner = self._bexpr(args[0], src, depth)
+            if inner == src:
+                return src
+            return f"(None if {src} is None else {inner})"
+        if origin is tuple:
+            args = get_args(tp)
+            if len(args) == 2 and args[1] is Ellipsis:
+                var = f"x{depth}"
+                inner = self._bexpr(args[0], var, depth + 1)
+                if inner == var:
+                    return f"_tuple({src})"
+                return f"_tuple({inner} for {var} in {src})"
+            # Fixed-arity tuples in the object model are primitive pairs
+            # (LabelSelector.match_labels) — elementwise work never needed.
+            if all(_is_passthrough(a) for a in args):
+                return f"_tuple({src})"
+            raise NotImplementedError(f"fixed tuple of non-primitives: {tp}")
+        if origin is list:
+            (elem,) = get_args(tp) or (Any,)
+            var = f"x{depth}"
+            inner = self._bexpr(elem, var, depth + 1)
+            if inner == var:
+                return f"list({src})"
+            return f"[{inner} for {var} in {src}]"
+        if origin is dict:
+            args = get_args(tp)
+            if not args:
+                return f"dict({src})"
+            _, vt = args
+            var = f"v{depth}"
+            inner = self._bexpr(vt, var, depth + 1)
+            if inner == var:
+                return f"dict({src})"
+            return f"{{k{depth}: {inner} for k{depth}, {var} in {src}.items()}}"
+        if isinstance(tp, type) and dataclasses.is_dataclass(tp):
+            return f"{self._builder_name(tp)}({src})"
+        return src  # primitive / Any / opaque
+
+    def _builder_name(self, cls: type) -> str:
+        name = f"_b_{cls.__name__}"
+        if cls not in self.builders:
+            self.builders[cls] = None  # cycle guard; body fills it below
+            self._gen_builder(cls, name)
+        return name
+
+    def _gen_builder(self, cls: type, name: str) -> None:
+        hints = get_type_hints(cls)
+        cls_ref = f"_c_{cls.__name__}"
+        self.ns[cls_ref] = cls
+        lines = [f"def {name}(d):", "    try:", f"        return {cls_ref}("]
+        for f in dataclasses.fields(cls):
+            expr = self._bexpr(hints[f.name], f"d[{f.name!r}]", 0)
+            lines.append(f"            {f.name}={expr},")
+        lines += [
+            "        )",
+            "    except KeyError:",
+            # A producer omitted a field (hand-written JSON): take the
+            # reflective path, which applies dataclass defaults per key.
+            f"        return _fallback({cls_ref}, d)",
+        ]
+        exec("\n".join(lines), self.ns)  # noqa: S102 — our own generated code
+        self.builders[cls] = self.ns[name]
+
+    def builder(self, cls: type):
+        fn = self.builders.get(cls)
+        if fn is None:
+            self._builder_name(cls)
+            fn = self.builders[cls]
+        return fn
+
+    # -- dumping (dataclass -> JSON-able data) -----------------------------
+
+    def _dexpr(self, tp: Any, src: str, depth: int) -> str:
+        origin = get_origin(tp)
+        if origin is typing.Union:
+            args = [a for a in get_args(tp) if a is not type(None)]
+            inner = self._dexpr(args[0], src, depth)
+            if inner == src:
+                return src
+            return f"(None if {src} is None else {inner})"
+        if origin is tuple:
+            args = get_args(tp)
+            if len(args) == 2 and args[1] is Ellipsis:
+                var = f"x{depth}"
+                inner = self._dexpr(args[0], var, depth + 1)
+                if inner == var:
+                    return src  # tuple of primitives: dumps emits arrays
+                return f"[{inner} for {var} in {src}]"
+            if all(_is_passthrough(a) for a in args):
+                return src
+            raise NotImplementedError(f"fixed tuple of non-primitives: {tp}")
+        if origin is list:
+            (elem,) = get_args(tp) or (Any,)
+            var = f"x{depth}"
+            inner = self._dexpr(elem, var, depth + 1)
+            if inner == var:
+                return src
+            return f"[{inner} for {var} in {src}]"
+        if origin is dict:
+            args = get_args(tp)
+            if not args:
+                return src
+            _, vt = args
+            var = f"v{depth}"
+            inner = self._dexpr(vt, var, depth + 1)
+            if inner == var:
+                return src
+            return f"{{k{depth}: {inner} for k{depth}, {var} in {src}.items()}}"
+        if isinstance(tp, type) and dataclasses.is_dataclass(tp):
+            return f"{self._dumper_name(tp)}({src})"
+        return src
+
+    def _dumper_name(self, cls: type) -> str:
+        name = f"_d_{cls.__name__}"
+        if cls not in self.dumpers:
+            self.dumpers[cls] = None
+            self._gen_dumper(cls, name)
+        return name
+
+    def _gen_dumper(self, cls: type, name: str) -> None:
+        hints = get_type_hints(cls)
+        lines = [f"def {name}(o):", "    return {"]
+        for f in dataclasses.fields(cls):
+            expr = self._dexpr(hints[f.name], f"o.{f.name}", 0)
+            lines.append(f"        {f.name!r}: {expr},")
+        lines += ["    }"]
+        exec("\n".join(lines), self.ns)  # noqa: S102
+        self.dumpers[cls] = self.ns[name]
+
+    def dumper(self, cls: type):
+        fn = self.dumpers.get(cls)
+        if fn is None:
+            self._dumper_name(cls)
+            fn = self.dumpers[cls]
+        return fn

@@ -18,6 +18,20 @@ from . import types as t
 _HINTS_CACHE: dict[type, dict[str, Any]] = {}
 
 
+def _codegen():
+    # Deferred: codegen imports back into this module's _build as the
+    # missing-key fallback.
+    global _GEN
+    if _GEN is None:
+        from . import codegen
+
+        _GEN = codegen._Gen(_build)
+    return _GEN
+
+
+_GEN = None
+
+
 def to_dict(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {
@@ -32,7 +46,19 @@ def to_dict(obj: Any) -> Any:
 
 
 def to_json(obj: Any) -> bytes:
-    return json.dumps(to_dict(obj), sort_keys=True).encode()
+    """Canonical JSON bytes.  Dataclasses go through the generated
+    per-type dumper (codegen.py — byte-identical to the reflective
+    to_dict path, ~8× faster); anything else through to_dict."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        data = _codegen().dumper(type(obj))(obj)
+    else:
+        data = to_dict(obj)
+    return json.dumps(data, sort_keys=True).encode()
+
+
+def build(tp: type, data: Any):
+    """Fast reconstruction via the generated per-type builder."""
+    return _codegen().builder(tp)(data)
 
 
 def _build(tp: Any, data: Any) -> Any:
@@ -72,11 +98,35 @@ def _build(tp: Any, data: Any) -> Any:
 
 
 def pod_from_json(raw: bytes | str) -> t.Pod:
-    return _build(t.Pod, json.loads(raw))
+    return pod_from_data(json.loads(raw))
+
+
+def pod_from_data(data: dict) -> t.Pod:
+    """Pod from parsed JSON data, pre-stamping the featurization
+    signature (engine/features.py `_featsig`) for unassigned, un-pinned
+    pods: identical template-stamped pods share identical canonical spec
+    JSON, so the sort-keys dump of the parsed subtrees IS a valid cache
+    key — computed here at C speed instead of the per-pod `_sig` tree
+    walk the in-process path pays.  (Key spaces never collide: wire keys
+    are JSON strings, in-process keys are nested tuples.)"""
+    pod = build(t.Pod, data)
+    spec = data.get("spec")
+    if spec is not None and not spec.get("node_name"):
+        from ..engine.features import pin_name
+
+        if pin_name(pod) is None:
+            meta = data.get("metadata") or {}
+            labels = meta.get("labels")
+            pod._featsig = (
+                meta.get("namespace") or "default",
+                json.dumps(labels, sort_keys=True) if labels else "",
+                json.dumps(spec, sort_keys=True),
+            )
+    return pod
 
 
 def node_from_json(raw: bytes | str) -> t.Node:
-    return _build(t.Node, json.loads(raw))
+    return build(t.Node, json.loads(raw))
 
 
 # Kind name → (type, scheduler add-method name) for the sidecar's AddObject.
@@ -102,5 +152,7 @@ KINDS: dict[str, tuple[type, str]] = {
 
 
 def from_json(kind: str, raw: bytes | str):
+    if kind == "Pod":
+        return pod_from_data(json.loads(raw))
     tp, _ = KINDS[kind]
-    return _build(tp, json.loads(raw))
+    return build(tp, json.loads(raw))

@@ -162,9 +162,12 @@ def build_pod_batch(
     keys: list = []
     pins: list = []
     for pod in pods:
+        # The memo is profile-independent (the cache's version token, not
+        # the key, carries the profile); wire-built pods arrive with it
+        # pre-stamped from the raw JSON (serialize.pod_from_data).
         memo = getattr(pod, "_featsig", None)
-        if memo is not None and memo[0] == profile.name:
-            keys.append(memo[1])
+        if memo is not None:
+            keys.append(memo)
             pins.append(None)
             continue
         pin = pin_name(pod)
@@ -177,7 +180,7 @@ def build_pod_batch(
             )
             continue
         key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
-        pod._featsig = (profile.name, key)
+        pod._featsig = key
         keys.append(key)
         pins.append(None)
     if force_active is not None:

@@ -894,8 +894,8 @@ class PreemptionEvaluator:
         sigs = np.zeros(k, np.int32)
         for i, p in enumerate(pods):
             memo = getattr(p, "_featsig", None)
-            if memo is not None and memo[0] == profile.name:
-                key_ = memo[1]
+            if memo is not None:
+                key_ = memo
             else:
                 key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
             sigs[i] = sig_first.setdefault(key_, i)

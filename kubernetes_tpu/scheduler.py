@@ -274,6 +274,10 @@ class TPUScheduler:
         # synchronously inside a request; a prefetch would strand pods).
         self._prefetched: tuple | None = None
         self._prefetch_enabled = True
+        # Called between the async device dispatch and the blocking fetch
+        # of each batch — host work done here (the speculative frontend's
+        # hint parse/build) hides under the in-flight pass.
+        self.post_dispatch_hook = None
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -1309,6 +1313,11 @@ class TPUScheduler:
             ctx["spec"] = self.preemption.dispatch_speculative(ctx, prepacked)
             if ctx["spec"] is not None:
                 tr.step("dispatched speculative preemption")
+        if self.post_dispatch_hook is not None:
+            # Deserialization/admission work rides the in-flight pass
+            # (and feeds the queue the prefetch below pops from).
+            self.post_dispatch_hook()
+            tr.step("ran post-dispatch hook")
         # Overlap featurize(k+1) with device(k) — the VERDICT r1 host
         # ceiling.  Gated off when the active ops read mutable host
         # catalogs (volume/DRA binds bump the feature version every

@@ -142,3 +142,122 @@ class ResyncingClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+class DecisionCache:
+    """The plugin-local decision map fed by the sidecar's push stream —
+    the Python emulation of the Go plugin's subscriber goroutine
+    (go/tpubatchscore/plugin.go Subscriber), used by tests and the
+    integrated benchmark driver.
+
+    Owns its own subscribed connection and applies Push frames strictly
+    in stream order, which is the whole consistency contract
+    (proto/sidecar.proto Push): an invalidation frame precedes any
+    decision recomputed after it, so an in-order consumer can never hold
+    a decision from a rolled-back epoch.  A dedicated reader thread keeps
+    the socket drained at all times (a stalled subscriber is dropped by
+    the sidecar's bounded-blocking push); ``drain()`` then applies the
+    buffered frames in the consumer's thread.  After a miss response the
+    triggering batch's pushes were written BEFORE the response (same
+    dispatch lock), so ``drain(min_frames=1)`` only ever waits out the
+    reader thread's scheduling latency, not the sidecar."""
+
+    def __init__(self, path: str):
+        import threading
+
+        self.client = SidecarClient(path)
+        self.client.subscribe()
+        self.sock = self.client.sock
+        self.buf = bytearray()
+        self.map: dict[str, pb.Decision] = {}
+        self.epoch = 0
+        self.frames = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        # The reader thread ONLY moves bytes off the socket — the Go
+        # plugin's subscriber goroutine.  It must always be draining:
+        # push frames can exceed the socket buffers (a big batch's
+        # decisions), and the sidecar's bounded-blocking push drops a
+        # subscriber whose socket stays full.  Frame parsing and map
+        # application stay in the consumer thread, in stream order.
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                chunk = self.sock.recv(1 << 20)
+            except OSError:
+                chunk = b""
+            with self._cond:
+                if chunk:
+                    self.buf += chunk
+                else:
+                    self._closed = True
+                self._cond.notify_all()
+            if not chunk:
+                return
+
+    def drain(self, min_frames: int = 0, timeout: float = 1.0) -> int:
+        """Apply every complete buffered Push frame; with ``min_frames``,
+        wait up to ``timeout`` for at least that many (after a miss
+        response, the triggering batch's pushes were written before the
+        response, but the reader thread may still be mid-recv)."""
+        deadline = None
+        n = 0
+        while True:
+            with self._cond:
+                frames, self.buf = self._frames_from(self.buf)
+                if not frames and n < min_frames and not self._closed:
+                    import time as _t
+
+                    if deadline is None:
+                        deadline = _t.monotonic() + timeout
+                    left = deadline - _t.monotonic()
+                    if left > 0:
+                        self._cond.wait(left)
+                        continue
+            for push in frames:
+                self._apply(push)
+            n += len(frames)
+            if n >= min_frames or not frames:
+                break
+        self.frames += n
+        if n < min_frames and self._closed:
+            raise ConnectionError("push stream closed")
+        return n
+
+    @staticmethod
+    def _frames_from(buf: bytearray) -> tuple[list, bytearray]:
+        out = []
+        off = 0
+        while len(buf) - off >= 4:
+            ln = int.from_bytes(buf[off : off + 4], "big")
+            if len(buf) - off - 4 < ln:
+                break
+            env = pb.Envelope()
+            env.ParseFromString(bytes(buf[off + 4 : off + 4 + ln]))
+            out.append(env.push)
+            off += 4 + ln
+        return out, buf[off:] if off else buf
+
+    def _apply(self, push: pb.Push) -> None:
+        # Invalidations first — a frame never carries both a rollback and
+        # decisions from before it (the sidecar emits them separately, in
+        # epoch order).
+        if push.invalidate_all:
+            self.map.clear()
+        for uid in push.invalidate_uids:
+            self.map.pop(uid, None)
+        self.epoch = push.epoch
+        for d in push.decisions:
+            self.map[d.pod_uid] = d
+
+    def pop(self, uid: str) -> pb.Decision | None:
+        """Consume the cached decision for ``uid`` (PreFilter answering
+        from the local map — schedule_one.go:491–502's cached-placement
+        precedent), or None → the caller falls back to the wire."""
+        return self.map.pop(uid, None)
+
+    def close(self) -> None:
+        self.client.close()

@@ -257,6 +257,17 @@ def _dispatch(
                 front.add_hint_raw(env.add.object_json)
             out.response.SetInParent()
             return
+        if env.add.kind == "PendingPods":
+            # Batched hints: one frame carrying a JSON ARRAY of pods.  The
+            # plugin's informer handlers fire per pod, but nothing forces
+            # one frame per event — a flusher goroutine coalescing its
+            # backlog sends one array and pays one ack (the same batching
+            # client-go's Reflector does for its initial List).  The blob
+            # is parsed lazily, under a later batch's device pass.
+            if front is not None:
+                front.add_hint_blob(env.add.object_json)
+            out.response.SetInParent()
+            return
         if env.add.kind == "NamespaceLabels":
             # {"namespace": ..., "labels": {...}} — the namespace informer
             # feeding affinity namespaceSelector matching.
@@ -364,36 +375,75 @@ class SidecarClient:
         self._call(env)
 
     def add_stream(self, kind: str, objs) -> None:
-        """Pipelined adds: ship every frame, then drain the responses.
-        Models the Go informer handlers, which fire asynchronously and
-        don't gate the next event on the previous ack (frames are still
-        processed in order — the protocol is sequential per connection).
-        ALL responses are drained before any error is raised, so a failed
-        add cannot desync the connection for later calls."""
-        seqs = []
+        """Pipelined adds: ship frames while draining responses as they
+        arrive.  Models the Go informer handlers, which fire
+        asynchronously and don't gate the next event on the previous ack
+        (frames are still processed in order — the protocol is sequential
+        per connection).  Writes and reads interleave via select —
+        write-everything-then-read deadlocks once the in-flight frames
+        exceed the socket buffers (the server blocks writing acks, stops
+        reading, and both sides stall).  ALL responses are drained before
+        any error is raised, so a failed add cannot desync the connection
+        for later calls."""
+        import select
+
+        pending = bytearray()
         for obj in objs:
             env = pb.Envelope()
             env.add.kind = kind
             env.add.object_json = serialize.to_json(obj)
             self._seq += 1
             env.seq = self._seq
-            write_frame(self.sock, env)
-            seqs.append(self._seq)
+            payload = env.SerializeToString()
+            pending += _LEN.pack(len(payload)) + payload
+        want = self._seq - len(objs)
+        last = self._seq
         errors = []
-        for want in seqs:
-            resp = read_frame(self.sock)
-            if resp is None:
-                raise ConnectionError("sidecar closed the connection")
-            if resp.seq != want:
-                raise RuntimeError(
-                    f"protocol desync: seq {resp.seq} != {want}"
+        view = memoryview(pending)
+        sock = self.sock
+        sock.setblocking(False)
+        try:
+            while want < last or view:
+                rl, wl, _ = select.select(
+                    [sock], [sock] if view else [], []
                 )
-            if resp.response.error:
-                errors.append(resp.response.error)
+                if wl:
+                    try:
+                        n = sock.send(view[: 1 << 20])
+                    except BlockingIOError:
+                        n = 0
+                    view = view[n:]
+                if rl:
+                    sock.setblocking(True)
+                    try:
+                        resp = read_frame(sock)
+                    finally:
+                        sock.setblocking(False)
+                    if resp is None:
+                        raise ConnectionError("sidecar closed the connection")
+                    want += 1
+                    if resp.seq != want:
+                        raise RuntimeError(
+                            f"protocol desync: seq {resp.seq} != {want}"
+                        )
+                    if resp.response.error:
+                        errors.append(resp.response.error)
+        finally:
+            sock.setblocking(True)
         if errors:
             raise RuntimeError(
-                f"{len(errors)} of {len(seqs)} adds failed; first: {errors[0]}"
+                f"{len(errors)} of {len(objs)} adds failed; first: {errors[0]}"
             )
+
+    def add_pending_batch(self, pods) -> None:
+        """One PendingPods frame carrying a JSON array of pods (the
+        coalesced-hint form — see the server's PendingPods branch)."""
+        env = pb.Envelope()
+        env.add.kind = "PendingPods"
+        env.add.object_json = (
+            b"[" + b",".join(serialize.to_json(p) for p in pods) + b"]"
+        )
+        self._call(env)
 
     def remove(self, kind: str, uid: str) -> None:
         env = pb.Envelope()

@@ -68,6 +68,7 @@ Consistency contract:
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..api import types as t
@@ -149,6 +150,13 @@ class SpeculativeFrontend:
         self.sched = sched
         # How many hinted pods join a miss's batch (device batch = 1 + this).
         self.lookahead = lookahead or (sched.batch_size - 1)
+        # Coalesced PendingPods frames, kept as UNPARSED JSON arrays: the
+        # ingestion ack returns immediately and the parse/build cost runs
+        # in _on_dispatched — i.e. under an in-flight device pass.
+        self.raw_blobs: list[bytes] = []
+        # Hint uids whose pool entry is still a raw dict, in arrival
+        # order — the build queue _on_dispatched drains.
+        self._unbuilt: deque[str] = deque()
         self.hints: dict[str, t.Pod] = {}
         self.cached: dict[str, ScheduleOutcome] = {}
         self.deps: dict[str, DepSet] = {}
@@ -174,10 +182,19 @@ class SpeculativeFrontend:
         # Push sinks: callables taking a pb.Envelope (the server wraps the
         # subscriber socket write).  A sink raising OSError is dropped.
         self._sinks: list = []
-        # Batches run synchronously inside a request here; a prefetched
-        # batch would strand pods popped for it (they'd produce outcomes
-        # only on the NEXT request's batch, racing the host's ask order).
-        sched._prefetch_enabled = False
+        # Prefetch (featurize k+1 overlapping device k) stays ON: a
+        # prefetched batch's pods produce outcomes on the NEXT
+        # schedule_batch call, and _run_batch keeps draining until the
+        # requested pod's outcome lands — a pod held in a prefetched
+        # batch is reached by the drain loop, never stranded.  Staleness
+        # is version-guarded at dispatch (_dispatch_batch drops work whose
+        # feature_version moved), and deletions dissolve the prefetch
+        # (scheduler.delete_pod).
+        # The post-dispatch hook runs hint parse/build/admission between
+        # the async device dispatch and the blocking fetch — that host
+        # work hides under the in-flight pass (the same overlap trick as
+        # the featurize prefetch, applied to deserialization).
+        sched.post_dispatch_hook = self._on_dispatched
 
     # -- push stream --------------------------------------------------------
 
@@ -256,12 +273,60 @@ class SpeculativeFrontend:
         data = json.loads(raw)
         self._add_hint(self._uid_of(data), data)
 
-    def _add_hint(self, uid: str, obj) -> None:
-        if uid in self.cached or uid in self.delivered:
+    def add_hint_data(self, data: dict) -> None:
+        self._add_hint(self._uid_of(data), data)
+
+    def add_hint_blob(self, raw: bytes) -> None:
+        """A coalesced PendingPods frame, deferred whole: parsed by
+        _parse_blobs under a device pass (or on first demand)."""
+        self.raw_blobs.append(raw)
+
+    def _parse_blobs(self) -> None:
+        """Parse every deferred blob into the hint pool.  A pool entry
+        that already exists WINS over a blob entry — the pool entry
+        arrived later (a direct informer add/update), the blob was queued
+        first."""
+        if not self.raw_blobs:
             return
+        import json
+
+        blobs, self.raw_blobs = self.raw_blobs, []
+        for raw in blobs:
+            for data in json.loads(raw):
+                uid = self._uid_of(data)
+                if uid in self.hints:
+                    continue
+                if self._add_hint(uid, data):
+                    self._unbuilt.append(uid)
+
+    def _build_hints(self, budget: int) -> None:
+        """Convert up to ``budget`` raw-dict pool entries into built
+        t.Pod objects (the expensive half of deserialization), oldest
+        first."""
+        unbuilt = self._unbuilt
+        hints = self.hints
+        while budget > 0 and unbuilt:
+            uid = unbuilt.popleft()
+            obj = hints.get(uid)
+            if isinstance(obj, dict):
+                hints[uid] = self._hint_pod(obj)
+                budget -= 1
+
+    def _on_dispatched(self) -> None:
+        """scheduler.post_dispatch_hook: a device pass is in flight; do
+        the deserialization work now, under it — and feed the queue so
+        the scheduler's featurize-prefetch has a next batch to pop."""
+        self._parse_blobs()
+        self._build_hints(self.sched.batch_size * 2)
+        self._admit_hints(self.sched.batch_size)
+
+    def _add_hint(self, uid: str, obj) -> bool:
+        if uid in self.cached or uid in self.delivered:
+            return False
         if uid in self.sched.cache.pods:
-            return  # already bound/assumed in the mirror
+            return False  # already bound/assumed in the mirror
         self.hints[uid] = obj
+        return True
 
     @staticmethod
     def _hint_priority(obj) -> int:
@@ -274,7 +339,7 @@ class SpeculativeFrontend:
         if isinstance(obj, dict):
             from ..api import serialize
 
-            return serialize._build(t.Pod, obj)
+            return serialize.pod_from_data(obj)
         return obj
 
     # -- mutation classification -------------------------------------------
@@ -484,6 +549,11 @@ class SpeculativeFrontend:
 
     def note_remove(self, kind: str, uid: str) -> None:
         if kind == "Pod":
+            if self.raw_blobs:
+                # The deleted pod may sit in an unparsed blob; parsing
+                # later would resurrect it.  Deletes are rare next to
+                # hints — pay the parse on this path.
+                self._parse_blobs()
             if not (
                 uid in self.cached
                 or uid in self.delivered
@@ -573,9 +643,23 @@ class SpeculativeFrontend:
 
     # -- the request path ---------------------------------------------------
 
+    def _prefetched_uids(self) -> frozenset:
+        """Uids held in the scheduler's prefetched batch: popped from the
+        queue (so _in_active can't dedup them) but not yet scheduled —
+        re-adding one would run it twice and double-commit."""
+        pre = self.sched._prefetched
+        if pre is None:
+            return frozenset()
+        return frozenset(qp.pod.uid for qp in pre[0])
+
     def _admit_hints(self, budget: int) -> None:
-        if budget <= 0 or not self.hints:
+        if budget <= 0:
             return
+        if len(self.hints) < budget:
+            self._parse_blobs()
+        if not self.hints:
+            return
+        in_flight = self._prefetched_uids()
         # Admit in QueueSort order (priority desc, arrival order) — the
         # host activeQ's comparator, so speculation follows its pop order.
         order = sorted(
@@ -587,16 +671,19 @@ class SpeculativeFrontend:
                 uid in self.sched.cache.pods
                 or uid in self.cached
                 or uid in self.delivered
+                or uid in in_flight
             ):
                 # Stale hint: the pod was meanwhile scheduled from the
-                # queue (it rode in via a plain informer add too).
-                # Re-admitting would double-commit it.
+                # queue or is mid-flight in the prefetched batch (it rode
+                # in via a plain informer add too).  Re-admitting would
+                # double-commit it.
                 continue
             self.sched.add_pod(self._hint_pod(obj))
 
     def _run_batch(self, requested: t.Pod) -> None:
         self.hints.pop(requested.uid, None)
-        self.sched.add_pod(requested)
+        if requested.uid not in self._prefetched_uids():
+            self.sched.add_pod(requested)
         self._admit_hints(self.lookahead)
         # The requested pod may sort below admitted hints or behind
         # event-woken stragglers; keep draining batches until its outcome
@@ -618,7 +705,11 @@ class SpeculativeFrontend:
             self._push_decisions(fresh)
             if requested.uid in self.cached:
                 return
-            if not outs and not len(self.sched.queue):
+            if (
+                not outs
+                and not len(self.sched.queue)
+                and self.sched._prefetched is None
+            ):
                 return  # parked (gated / gang quorum / foreign scheduler)
         # Bound exhausted with the pod still queued: the synthesized
         # "no feasible node" below is an availability lie (the pod may
@@ -628,7 +719,9 @@ class SpeculativeFrontend:
     def flush_hints_to_queue(self) -> None:
         """Drain-request prelude: roll back the cache, then move every
         pending hint into the scheduler's queue so the drain sees the full
-        pod set (the frontend owns hint storage — hints may be raw dicts)."""
+        pod set (the frontend owns hint storage — hints may be raw dicts
+        or still-unparsed blobs)."""
+        self._parse_blobs()
         self.invalidate()
         self._admit_hints(len(self.hints))
 
@@ -646,7 +739,7 @@ class SpeculativeFrontend:
             results.append(
                 self._serve_one(
                     self._uid_of(data),
-                    lambda d=data: serialize._build(t.Pod, d),
+                    lambda d=data: serialize.pod_from_data(d),
                 )
             )
         return results

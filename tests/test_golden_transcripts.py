@@ -38,7 +38,11 @@ import sys
 sys.path.insert(
     0, os.path.join(os.path.dirname(__file__), "..", "scripts")
 )
-from gen_golden_transcripts import session_schedulers  # noqa: E402
+from gen_golden_transcripts import (  # noqa: E402
+    session_schedulers,
+    session_server_kwargs,
+    wait_for_backoffs,
+)
 
 SESSIONS = {f"{stem}.framestream": stem for stem in session_schedulers()}
 
@@ -49,14 +53,23 @@ def _make_scheduler(stem: str) -> TPUScheduler:
 
 def test_every_framestream_fixture_is_replayed():
     """A new .framestream fixture must join SESSIONS (the Go round-trip
-    test globs; the Python replay must not silently skip it)."""
+    test globs; the Python replay must not silently skip it).  The
+    *_push stream fixtures are server-output companions of their session,
+    verified inside that session's replay."""
     import glob
 
     on_disk = {
         os.path.basename(p)
         for p in glob.glob(os.path.join(GOLDEN_DIR, "*.framestream"))
     }
-    assert on_disk == set(SESSIONS)
+    push = {
+        name.replace("_session", "_push")
+        for name in SESSIONS
+        if os.path.exists(
+            os.path.join(GOLDEN_DIR, name.replace("_session", "_push"))
+        )
+    }
+    assert on_disk == set(SESSIONS) | push
 
 
 def read_fixture(path=GOLDEN):
@@ -80,12 +93,16 @@ def make_server_sock():
     def _make(profile_name):
         with tempfile.TemporaryDirectory() as td:
             path = os.path.join(td, "sidecar.sock")
-            srv = sidecar.SidecarServer(path, scheduler=_make_scheduler(profile_name))
+            srv = sidecar.SidecarServer(
+                path,
+                scheduler=_make_scheduler(profile_name),
+                **session_server_kwargs().get(profile_name, {}),
+            )
             srv.serve_background()
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             sock.connect(path)
             try:
-                yield sock
+                yield sock, srv, path
             finally:
                 sock.close()
                 srv.close()
@@ -97,22 +114,54 @@ def make_server_sock():
 def test_replay_golden_session(make_server_sock, fixture_name):
     frames = read_fixture(os.path.join(GOLDEN_DIR, fixture_name))
     assert frames, "empty fixture — regenerate with scripts/gen_golden_transcripts.py"
-    with make_server_sock(SESSIONS[fixture_name]) as server_sock:
-        _replay(frames, server_sock)
+    push_name = fixture_name.replace("_session", "_push")
+    push_path = os.path.join(GOLDEN_DIR, push_name)
+    with make_server_sock(SESSIONS[fixture_name]) as (server_sock, srv, path):
+        if not os.path.exists(push_path):
+            _replay(frames, server_sock, srv)
+            return
+        # The session records a companion decision push stream on a
+        # second connection: subscribe exactly as recorded, replay the
+        # requests, then assert the pushed frames match byte-for-byte —
+        # the push stream is deterministic because every push is written
+        # inside the dispatch of a recorded request.
+        push_frames = read_fixture(push_path)
+        assert push_frames[0][0] == b">", "push fixture must start with subscribe"
+        sub = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sub.connect(path)
+        try:
+            sub.sendall(
+                struct.pack(">I", len(push_frames[0][1])) + push_frames[0][1]
+            )
+            ack = _read_frame(sub)
+            assert ack == push_frames[1][1], "subscribe ack diverged"
+            _replay(frames, server_sock, srv)
+            sub.settimeout(5.0)
+            for i, (direction, want) in enumerate(push_frames[2:]):
+                assert direction == b"<"
+                got = _read_frame(sub)
+                env = pb.Envelope.FromString(got)
+                assert got == want, (
+                    f"push frame {i} diverged from the recording\n"
+                    f"want: {pb.Envelope.FromString(want)}\ngot:  {env}"
+                )
+        finally:
+            sub.close()
 
 
-def _replay(frames, server_sock):
+def _replay(frames, server_sock, srv):
     i = 0
     while i < len(frames):
         direction, payload = frames[i]
         assert direction == b">", f"frame {i}: expected client frame"
-        # The recorded scenario sleeps through a backoff between the
-        # delete and the final drain; reproduce the pause so the woken
-        # pod's backoff has expired when the drain frame arrives.
+        # Before an empty drain, the recorder waited for every backoff to
+        # EXPIRE (wait_for_backoffs — the same helper, so recording and
+        # replay see identical retry sets in the drain; a fixed pause
+        # raced the backoff clock on both sides and flaked this test).
         env = pb.Envelope()
         env.ParseFromString(payload)
         if env.WhichOneof("msg") == "schedule" and not env.schedule.pod_json:
-            time.sleep(1.2)
+            wait_for_backoffs(srv.scheduler.queue)
         server_sock.sendall(struct.pack(">I", len(payload)) + payload)
         # Collect the expected response frame from the fixture.
         assert i + 1 < len(frames) and frames[i + 1][0] == b"<"

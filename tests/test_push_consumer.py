@@ -1,0 +1,199 @@
+"""Push-consumer path: the plugin-local decision map (host.DecisionCache)
+fed by the sidecar's subscription stream, plus the sidecar health surface
+(VERDICT r4 missing-1 / missing-7).
+
+The Go plugin's subscriber goroutine (go/tpubatchscore/subscriber.go) is
+pinned by the golden transcripts; these tests drive the same protocol
+end-to-end in-process: subscribe, speculative batches pushing decisions,
+epoch-ordered invalidation, hit consumption without a wire call, and the
+health probe the host uses beyond a failed dial
+(cmd/kube-scheduler/app/server.go:181–210 analog)."""
+
+import tempfile
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import DEFAULT_PROFILE
+from kubernetes_tpu.ops.common import registered_subset
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar.host import DecisionCache
+from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+
+def _server(speculate=True, **kw):
+    path = tempfile.mktemp(suffix=".sock")
+    sched = TPUScheduler(
+        profile=registered_subset(DEFAULT_PROFILE),
+        batch_size=kw.pop("batch_size", 8),
+        chunk_size=1,
+    )
+    srv = SidecarServer(path, scheduler=sched, speculate=speculate, **kw)
+    srv.serve_background()
+    return path, srv
+
+
+def _nodes(client, n=3, cpu="4"):
+    for i in range(n):
+        client.add(
+            "Node",
+            make_node(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": 20})
+            .obj(),
+        )
+
+
+def test_push_hit_answers_without_wire_call():
+    path, srv = _server()
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    try:
+        _nodes(client)
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+        client.add_pending_batch(pods)
+        # Miss on p0 triggers one batch; p1..p3's decisions are pushed.
+        (r0,) = client.schedule([pods[0]], drain=False)
+        assert r0.node_name
+        cache.drain(min_frames=1)
+        served = 0
+        for p in pods[1:]:
+            d = cache.pop(p.uid)
+            assert d is not None, f"{p.uid} not pushed"
+            assert d.node_name
+            served += 1
+        assert served == 3
+        stats = client.dump()["speculation"]
+        assert stats["pushed"] == 3
+        assert stats["misses"] == 1 and stats["hits"] == 0
+    finally:
+        cache.close()
+        client.close()
+        srv.close()
+
+
+def test_invalidation_precedes_recomputed_decisions():
+    """Stream order: after a full rollback (node label change), the
+    consumer applying frames in order holds only post-rollback decisions,
+    and the epoch monotonically advances."""
+    path, srv = _server()
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    try:
+        _nodes(client)
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+        client.add_pending_batch(pods)
+        (r0,) = client.schedule([pods[0]], drain=False)
+        cache.drain(min_frames=1)
+        assert cache.epoch == 0 and len(cache.map) == 3
+        # Label change → full rollback → epoch bump, invalidate_all frame.
+        n0 = (
+            make_node("n0")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": 20})
+            .label("team", "x")
+            .obj()
+        )
+        client.add("Node", n0)
+        # Recompute: miss on p1 re-batches the rolled-back hints.
+        (r1,) = client.schedule([pods[1]], drain=False)
+        assert r1.node_name
+        cache.drain(min_frames=2)  # invalidation frame + new decisions
+        assert cache.epoch == 1
+        # Only post-rollback decisions present (p2, p3 recomputed at e1).
+        assert set(cache.map) == {pods[2].uid, pods[3].uid}
+        stats = client.dump()["speculation"]
+        assert stats["full_invalidations"] == 1
+    finally:
+        cache.close()
+        client.close()
+        srv.close()
+
+
+def test_scoped_invalidation_rides_stream():
+    """A foreign bind invalidates only intersecting decisions; the stream
+    carries invalidate_uids, not invalidate_all."""
+    path, srv = _server()
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    try:
+        _nodes(client)
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+        client.add_pending_batch(pods)
+        client.schedule([pods[0]], drain=False)
+        cache.drain(min_frames=1)
+        assert len(cache.map) == 3
+        # Bind a foreign pod onto one cached decision's node.
+        victim_uid, victim_node = next(
+            (uid, d.node_name) for uid, d in cache.map.items()
+        )
+        foreign = (
+            make_pod("foreign").req({"cpu": "1"}).node(victim_node).obj()
+        )
+        client.add("Pod", foreign)
+        cache.drain(min_frames=1)
+        assert victim_uid not in cache.map
+        # Decisions on other nodes survived.
+        assert any(
+            d.node_name != victim_node for d in cache.map.values()
+        ) or len(cache.map) == 0
+        stats = client.dump()["speculation"]
+        assert stats["invalidations"] >= 1
+        assert stats["full_invalidations"] == 0
+    finally:
+        cache.close()
+        client.close()
+        srv.close()
+
+
+def test_unschedulable_verdict_pushed_with_diagnosis():
+    path, srv = _server()
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    try:
+        _nodes(client, n=1, cpu="2")
+        fits = make_pod("fits").req({"cpu": "1"}).obj()
+        huge = make_pod("huge").req({"cpu": "99"}).obj()
+        client.add_pending_batch([fits, huge])
+        client.schedule([fits], drain=False)
+        cache.drain(min_frames=1)
+        d = cache.pop(huge.uid)
+        assert d is not None and d.node_name == ""
+        assert "NodeResourcesFit" in list(d.unschedulable_plugins)
+    finally:
+        cache.close()
+        client.close()
+        srv.close()
+
+
+def test_health_probe_and_kill_sidecar():
+    """The health frame answers liveness/readiness + cache shape; when
+    the sidecar dies, the subscriber's drain sees the closed stream and
+    a request client gets a connection error — the signals the Go plugin
+    degrades on (plugin.go ErrSidecarDown → Unschedulable status)."""
+    path, srv = _server()
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    _nodes(client, n=2)
+    h = client.health()
+    assert h["healthy"] and h["ready"]
+    assert h["nodes"] == 2 and h["speculation"] is True
+    assert h["epoch"] == 0
+    srv.close()
+    with pytest.raises((ConnectionError, OSError, RuntimeError)):
+        client.schedule([make_pod("p").req({"cpu": "1"}).obj()], drain=False)
+    with pytest.raises(ConnectionError):
+        # The reader thread observed EOF; a drain waiting for frames must
+        # surface it rather than hang.
+        cache.drain(min_frames=1, timeout=2.0)
+    client.close()
+    cache.close()
+
+
+def test_health_without_speculation():
+    path, srv = _server(speculate=False)
+    client = SidecarClient(path)
+    try:
+        h = client.health()
+        assert h["healthy"] and h["speculation"] is False
+    finally:
+        client.close()
+        srv.close()
