@@ -99,6 +99,14 @@ func (p *Plugin) subscribeLoop(network, addr string) {
 		backoff = 100 * time.Millisecond
 		klog.V(2).InfoS("tpubatchscore: decision push stream subscribed")
 		for {
+			// Liveness bound: unix sockets deliver EOF on a sidecar
+			// crash, but a TCP peer can die silently — without a
+			// deadline this loop would serve ever-staler cached
+			// decisions whose invalidations can never arrive.  The
+			// sidecar keepalives the stream (serve --keepalive,
+			// default 10s) well inside this window; a quiet minute
+			// means the stream is gone.
+			_ = conn.SetReadDeadline(time.Now().Add(60 * time.Second))
 			env, err := ReadFrame(conn)
 			if err != nil {
 				break
@@ -119,30 +127,29 @@ func (p *Plugin) subscribeLoop(network, addr string) {
 // ack per hint — batching the backlog is the same trade client-go's
 // Reflector makes for its initial List.
 type hintFlusher struct {
-	mu     sync.Mutex
+	mu     sync.Mutex // guards buf/timer
+	sendMu sync.Mutex // serializes take+send as one unit (see flush)
 	buf    [][]byte
 	timer  *time.Timer
 	client *Client
 }
 
 const (
-	hintFlushBytes = 256              // flush when this many hints are queued
+	hintFlushBytes = 256                  // flush when this many hints are queued
 	hintFlushDelay = 2 * time.Millisecond // or this long after the first
 )
 
 func (f *hintFlusher) add(raw []byte) {
 	f.mu.Lock()
 	f.buf = append(f.buf, raw)
-	if len(f.buf) >= hintFlushBytes {
-		buf := f.takeLocked()
-		f.mu.Unlock()
-		f.send(buf)
-		return
-	}
-	if f.timer == nil {
+	full := len(f.buf) >= hintFlushBytes
+	if !full && f.timer == nil {
 		f.timer = time.AfterFunc(hintFlushDelay, f.flush)
 	}
 	f.mu.Unlock()
+	if full {
+		f.flush()
+	}
 }
 
 func (f *hintFlusher) takeLocked() [][]byte {
@@ -155,7 +162,14 @@ func (f *hintFlusher) takeLocked() [][]byte {
 	return buf
 }
 
+// flush drains the buffer and sends it — atomically with respect to
+// other flushes.  sendMu spans the take AND the send: DeleteFunc calls
+// flush() before RemoveObject to keep a pod's hint ordered before its
+// delete, and that guarantee needs "buffer empty" to imply "sent", not
+// "taken by a timer goroutine that hasn't reached the socket yet".
 func (f *hintFlusher) flush() {
+	f.sendMu.Lock()
+	defer f.sendMu.Unlock()
 	f.mu.Lock()
 	buf := f.takeLocked()
 	f.mu.Unlock()

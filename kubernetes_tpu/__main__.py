@@ -146,8 +146,20 @@ def cmd_serve(args) -> int:
         )
     else:
         sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)
-    srv = SidecarServer(args.socket, scheduler=sched)
-    print(f"sidecar listening on {args.socket}", flush=True)
+    srv = SidecarServer(
+        args.socket,
+        scheduler=sched,
+        speculate=args.speculate,
+        # Keepalive bounds a silently-partitioned subscriber's staleness
+        # (the Go side reads with a 60s deadline); meaningless without
+        # the push stream.
+        keepalive_s=args.keepalive if args.speculate else None,
+    )
+    print(
+        f"sidecar listening on {args.socket}"
+        + (" (speculative)" if args.speculate else ""),
+        flush=True,
+    )
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -200,6 +212,14 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--config", default="")
     s.add_argument("--batch-size", type=int, default=256)
     s.add_argument("--chunk-size", type=int, default=1)
+    s.add_argument(
+        "--speculate", action="store_true",
+        help="enable the speculative frontend + decision push stream",
+    )
+    s.add_argument(
+        "--keepalive", type=float, default=10.0,
+        help="push-stream keepalive interval in seconds (speculate only)",
+    )
     s.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("bench", help="run benchmark workloads")

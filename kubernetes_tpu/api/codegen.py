@@ -23,6 +23,7 @@ tests/test_types.py round-trips plus the golden object fixtures:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import typing
 from typing import Any, get_args, get_origin, get_type_hints
 
@@ -45,6 +46,11 @@ class _Gen:
         self.ns: dict[str, Any] = {"_fallback": fallback, "_tuple": tuple}
         self.builders: dict[type, Any] = {}
         self.dumpers: dict[type, Any] = {}
+        # Generation is guarded: the sidecar server threads share this
+        # generator with the client side of in-process tests, and the
+        # None cycle-guard placeholder must never leak to a second
+        # thread as "the compiled function".
+        self._lock = threading.Lock()
 
     # -- building (JSON data -> dataclass) --------------------------------
 
@@ -119,8 +125,11 @@ class _Gen:
     def builder(self, cls: type):
         fn = self.builders.get(cls)
         if fn is None:
-            self._builder_name(cls)
-            fn = self.builders[cls]
+            with self._lock:
+                if self.builders.get(cls) is None:
+                    self.builders.pop(cls, None)
+                    self._builder_name(cls)
+                fn = self.builders[cls]
         return fn
 
     # -- dumping (dataclass -> JSON-able data) -----------------------------
@@ -185,6 +194,9 @@ class _Gen:
     def dumper(self, cls: type):
         fn = self.dumpers.get(cls)
         if fn is None:
-            self._dumper_name(cls)
-            fn = self.dumpers[cls]
+            with self._lock:
+                if self.dumpers.get(cls) is None:
+                    self.dumpers.pop(cls, None)
+                    self._dumper_name(cls)
+                fn = self.dumpers[cls]
         return fn

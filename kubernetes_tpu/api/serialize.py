@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import typing
 from typing import Any, get_args, get_origin, get_type_hints
 
@@ -20,16 +21,22 @@ _HINTS_CACHE: dict[type, dict[str, Any]] = {}
 
 def _codegen():
     # Deferred: codegen imports back into this module's _build as the
-    # missing-key fallback.
+    # missing-key fallback.  Double-checked init — server threads and the
+    # in-process client race the first call.
     global _GEN
-    if _GEN is None:
-        from . import codegen
+    g = _GEN
+    if g is None:
+        with _GEN_LOCK:
+            if _GEN is None:
+                from . import codegen
 
-        _GEN = codegen._Gen(_build)
-    return _GEN
+                _GEN = codegen._Gen(_build)
+            g = _GEN
+    return g
 
 
 _GEN = None
+_GEN_LOCK = threading.Lock()
 
 
 def to_dict(obj: Any) -> Any:

@@ -72,6 +72,7 @@ def run_integrated(
     batch_size: int,
     chunk_size: int,
     push_cache: bool = False,
+    churn_every: int = 0,
 ) -> dict:
     path = tempfile.mktemp(suffix=".sock")
     sched = TPUScheduler(
@@ -113,6 +114,8 @@ def run_integrated(
         pods = [_pod(f"pod-{i}") for i in range(measured_pods)]
         scheduled = 0
         wire_calls = 0
+        local_hits = 0
+        churn_i = 0
         t0 = time.perf_counter()
         if speculate and cache is not None:
             # The informer pre-stream, coalesced: the plugin's flusher
@@ -120,7 +123,18 @@ def run_integrated(
             # measured window — no free lunch).
             client.add_pending_batch(pods)
             wire_calls += 1
-            for p in pods:
+            for i, p in enumerate(pods):
+                if churn_every and i and i % churn_every == 0:
+                    # The scheduler_perf churn op over the wire
+                    # (harness.py _node_churn): a node add + the previous
+                    # churn node's removal, mid-window — the events that
+                    # drive scoped invalidation.
+                    client.add("Node", _node(100000 + churn_i))
+                    if churn_i > 0:
+                        client.remove("Node", f"node-{100000 + churn_i - 1}")
+                        wire_calls += 1
+                    wire_calls += 1
+                    churn_i += 1
                 uid = p.uid
                 d = cache.pop(uid)
                 if d is None:
@@ -140,8 +154,10 @@ def run_integrated(
                     if r.node_name:
                         scheduled += 1
                     cache.drain(min_frames=1, timeout=0.05)
-                elif d.node_name:
-                    scheduled += 1
+                else:
+                    local_hits += 1
+                    if d.node_name:
+                        scheduled += 1
         else:
             if speculate:
                 # The informer pre-stream: hints ride the same wire, inside
@@ -169,6 +185,10 @@ def run_integrated(
             if dt > 0
             else None,
             "wire_calls": wire_calls,
+            "local_hits": local_hits if cache is not None else None,
+            "hit_rate": round(local_hits / measured_pods, 4)
+            if cache is not None
+            else None,
             "push_frames": cache.frames if cache is not None else None,
             "device_s": round(m.device_time_s, 3),
             "featurize_s": round(m.featurize_time_s, 3),
@@ -200,6 +220,14 @@ INTEGRATED = {
     "integrated_speculative_5kn_10kpods": dict(
         nodes=5000, warm_pods=4096, measured_pods=10000, speculate=True,
         batch_size=4096, chunk_size=128, push_cache=True,
+    ),
+    # Same shape under the mixed-churn event mix (VERDICT r4 missing-4):
+    # node add/remove pairs fire through the wire mid-window at the native
+    # row's per-batch rate, exercising dependency-scoped invalidation —
+    # the row records the plugin-local hit rate under churn.
+    "integrated_speculative_churn_5kn_10kpods": dict(
+        nodes=5000, warm_pods=4096, measured_pods=10000, speculate=True,
+        batch_size=4096, chunk_size=128, push_cache=True, churn_every=4096,
     ),
 }
 

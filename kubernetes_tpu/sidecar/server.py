@@ -66,6 +66,7 @@ class SidecarServer:
         scheduler: TPUScheduler | None = None,
         speculate: bool = False,
         lookahead: int | None = None,
+        keepalive_s: float | None = None,
         **kw,
     ):
         self.path = path
@@ -91,6 +92,22 @@ class SidecarServer:
         # threaded but dispatch is serialized (concurrency belongs to the
         # host side).
         lock = threading.Lock()
+        self._lock = lock
+        self._keepalive_stop = threading.Event()
+        if keepalive_s and front is not None:
+            # Push-stream keepalive: an empty Push frame at the current
+            # epoch, so a subscriber behind a silent TCP partition can
+            # bound its staleness with a read deadline (the Go
+            # subscriber's 60s window; tests/fixtures leave this off to
+            # stay deterministic).
+            def _beat():
+                while not self._keepalive_stop.wait(keepalive_s):
+                    with lock:
+                        env = pb.Envelope()
+                        env.push.epoch = front.epoch
+                        front._emit(env)
+
+            threading.Thread(target=_beat, daemon=True).start()
 
         conns: set[socket.socket] = set()
         self._conns = conns
@@ -165,6 +182,7 @@ class SidecarServer:
         self._server.serve_forever()
 
     def close(self) -> None:
+        self._keepalive_stop.set()
         self._server.shutdown()
         self._server.server_close()
         # Sever live connections too: handler threads otherwise keep

@@ -615,7 +615,12 @@ class SpeculativeFrontend:
         self.stats.invalidations += 1
         self.epoch += 1
         self._push_invalidation(None if uids is None else sel)
-        for uid in sel:
+        # Iterate in the cache's COMMIT order, not set order: rolled-back
+        # pods re-enter the hint pool in this order, and _admit_hints'
+        # stable priority sort preserves it for ties — set iteration is
+        # hash-randomized and made the recomputed batch order (and the
+        # golden push fixture) differ across PYTHONHASHSEED.
+        for uid in [u for u in self.cached if u in sel]:
             out = self.cached.pop(uid)
             self.deps.pop(uid, None)
             if out.node_name:

@@ -197,3 +197,21 @@ def test_health_without_speculation():
     finally:
         client.close()
         srv.close()
+
+
+def test_keepalive_frames_bound_staleness():
+    """With keepalive_s set, subscribers receive empty Push frames at the
+    current epoch while the sidecar idles — the liveness signal the Go
+    subscriber's read deadline relies on over TCP."""
+    path, srv = _server(keepalive_s=0.1)
+    client = SidecarClient(path)
+    cache = DecisionCache(path)
+    try:
+        _nodes(client, n=1)
+        n = cache.drain(min_frames=2, timeout=3.0)
+        assert n >= 2  # at least two heartbeats
+        assert cache.epoch == 0 and not cache.map
+    finally:
+        cache.close()
+        client.close()
+        srv.close()
