@@ -99,14 +99,18 @@ func (p *Plugin) subscribeLoop(network, addr string) {
 		backoff = 100 * time.Millisecond
 		klog.V(2).InfoS("tpubatchscore: decision push stream subscribed")
 		for {
-			// Liveness bound: unix sockets deliver EOF on a sidecar
-			// crash, but a TCP peer can die silently — without a
-			// deadline this loop would serve ever-staler cached
-			// decisions whose invalidations can never arrive.  The
-			// sidecar keepalives the stream (serve --keepalive,
-			// default 10s) well inside this window; a quiet minute
-			// means the stream is gone.
-			_ = conn.SetReadDeadline(time.Now().Add(60 * time.Second))
+			// Liveness bound, TCP only: a TCP peer can die silently
+			// behind a partition, and without a deadline this loop
+			// would serve ever-staler cached decisions whose
+			// invalidations can never arrive.  The sidecar keepalives
+			// the stream (serve --keepalive, default 10s) well inside
+			// this window; a quiet minute means the stream is gone.
+			// Unix sockets deliver EOF on any sidecar death, so no
+			// deadline applies — a keepalive-less local sidecar must
+			// not have its idle stream torn down once a minute.
+			if network != "unix" {
+				_ = conn.SetReadDeadline(time.Now().Add(60 * time.Second))
+			}
 			env, err := ReadFrame(conn)
 			if err != nil {
 				break

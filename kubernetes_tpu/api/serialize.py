@@ -108,14 +108,27 @@ def pod_from_json(raw: bytes | str) -> t.Pod:
     return pod_from_data(json.loads(raw))
 
 
+def featsig_from_data(namespace, labels, spec_data) -> tuple:
+    """THE featurization-cache key constructor — the single source for
+    both entry paths (wire pods here via pod_from_data; in-process pods
+    via engine/features.pod_sig), so identical templates always share
+    cache entries: the key is (namespace, sort-keys labels JSON or "",
+    sort-keys spec JSON) over the canonical data model, and the two
+    paths produce string-identical dumps because the canonical dumper
+    emits exactly the parsed wire shape."""
+    return (
+        namespace or "default",
+        json.dumps(labels, sort_keys=True) if labels else "",
+        json.dumps(spec_data, sort_keys=True),
+    )
+
+
 def pod_from_data(data: dict) -> t.Pod:
     """Pod from parsed JSON data, pre-stamping the featurization
     signature (engine/features.py `_featsig`) for unassigned, un-pinned
     pods: identical template-stamped pods share identical canonical spec
-    JSON, so the sort-keys dump of the parsed subtrees IS a valid cache
-    key — computed here at C speed instead of the per-pod `_sig` tree
-    walk the in-process path pays.  (Key spaces never collide: wire keys
-    are JSON strings, in-process keys are nested tuples.)"""
+    JSON, so the sort-keys dump of the parsed subtrees IS the cache key —
+    computed here at C speed."""
     pod = build(t.Pod, data)
     spec = data.get("spec")
     if spec is not None and not spec.get("node_name"):
@@ -123,11 +136,8 @@ def pod_from_data(data: dict) -> t.Pod:
 
         if pin_name(pod) is None:
             meta = data.get("metadata") or {}
-            labels = meta.get("labels")
-            pod._featsig = (
-                meta.get("namespace") or "default",
-                json.dumps(labels, sort_keys=True) if labels else "",
-                json.dumps(spec, sort_keys=True),
+            pod._featsig = featsig_from_data(
+                meta.get("namespace"), meta.get("labels"), spec
             )
     return pod
 

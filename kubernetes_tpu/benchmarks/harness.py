@@ -853,11 +853,12 @@ _register(
     Workload(
         name="preemption_async_5kn",
         baseline_pods_per_sec=200.0,
-        # chunk 256 is the measured sweet spot for the all-fail→preempt
-        # shape: fewer scan steps dominate until same-node collision
-        # deferrals explode the strict tail (512 → 1158 deferrals).
+        # chunk 128 re-ranked as the sweet spot after the fused tail +
+        # uniform all-fail shortcut landed (interleaved 128/256 draws;
+        # collision deferrals now resolve on-device, so the old
+        # 512-explodes-the-tail constraint is gone).
         build=lambda: TPUScheduler(
-            profile=fit_only_profile(), batch_size=1024, chunk_size=256
+            profile=fit_only_profile(), batch_size=1024, chunk_size=128
         ),
         nodes=lambda s: _basic_nodes(5000, cpu="4", mem="16Gi")(s),
         warmup=_preemption_async_warm,

@@ -35,8 +35,6 @@ opcommon.feature_fill("dra_claim_unalloc", 0)
 # STATUS; the featurize cache keys on spec only).
 opcommon.feature_fill("nominated_row", -1)
 
-_DC_FIELDS: dict[type, tuple[str, ...]] = {}
-
 # The empty-case singletons (hoisted: building even a cache key per pod
 # costs more than it saves at millions of pods).
 _PORTS_EMPTY = const_array(POD_PORT_SLOTS, -1, np.int32)
@@ -65,25 +63,21 @@ def pin_name(pod: t.Pod):
     return None
 
 
-def _sig(o):
-    """Canonical hashable signature of an API object tree.  Workload pods are
-    stamped from templates, so (namespace, labels, spec) collapses thousands
-    of pods onto a handful of signatures — the key of the featurization cache
-    (names/uids are excluded: featurization never reads them)."""
-    if isinstance(o, (str, int, float, bool, type(None))):
-        return o
-    if isinstance(o, dict):
-        return tuple(sorted((k, _sig(v)) for k, v in o.items()))
-    if isinstance(o, (list, tuple)):
-        return tuple(_sig(x) for x in o)
-    cls = o.__class__
-    flds = _DC_FIELDS.get(cls)
-    if flds is None:
-        if not dataclasses.is_dataclass(o):
-            return o  # hashable leaf (frozen helper types)
-        flds = tuple(f.name for f in dataclasses.fields(o))
-        _DC_FIELDS[cls] = flds
-    return (cls.__qualname__,) + tuple(_sig(getattr(o, n)) for n in flds)
+def pod_sig(pod: t.Pod):
+    """The featurization cache key for an in-process pod.  Workload pods
+    are stamped from templates, so (namespace, labels, spec) collapses
+    thousands of pods onto a handful of signatures (names/uids excluded:
+    featurization never reads them).  Built through the ONE shared key
+    constructor (serialize.featsig_from_data — the same function that
+    stamps wire pods), so wire-fed and in-process copies of one template
+    share cache entries by string equality."""
+    from ..api import serialize
+
+    return serialize.featsig_from_data(
+        pod.namespace,
+        pod.metadata.labels,
+        serialize._codegen().dumper(t.PodSpec)(pod.spec),
+    )
 
 
 _PODSPEC_FIELDS: tuple[str, ...] = ()
@@ -179,7 +173,7 @@ def build_pod_batch(
                 else None
             )
             continue
-        key = (pod.namespace, _sig(pod.metadata.labels), _sig(pod.spec))
+        key = pod_sig(pod)
         pod._featsig = key
         keys.append(key)
         pins.append(None)
@@ -233,6 +227,31 @@ def build_pod_batch(
     if builder.feat_cache is None or builder.feat_cache[0] != version:
         builder.feat_cache = (version, {}, [])
     store = builder.feat_cache[1]
+    # Uniform-batch stack cache: a template workload's whole batch is ONE
+    # signature, so the stacked (k, …) tensors are a pure function of
+    # (signature, count, k) under the version token — tile once, reuse
+    # across batches (the per-pod stack/pad loop was the residual
+    # featurize cost after the row cache).  The returned dict is shallow-
+    # copied per use: consumers assign fresh keys (nominated_row,
+    # uniform_all, pin_row) but never mutate the arrays.
+    uniform_key = None
+    uniform_version = version
+    if (
+        sample_into is None
+        and force_active is None
+        and pods
+        and keys[0] is not None
+        and all(k2 == keys[0] for k2 in keys)
+    ):
+        uniform_key = ("#stacked", keys[0], len(pods), k)
+        hit = store.get(uniform_key)
+        if hit is not None:
+            batch, delta0 = hit
+            return (
+                dict(batch),
+                [dict(delta0) for _ in range(len(pods))],
+                active,
+            )
     # Pin templates: (ns, labels, spec, feats, delta) per distinct pinned
     # template, living beside the key store under the same version token.
     templates = builder.feat_cache[2]
@@ -407,4 +426,13 @@ def build_pod_batch(
         batch[key] = np.pad(stacked, pad_width)
     batch["valid"] = np.zeros(k, np.bool_)
     batch["valid"][: len(pods)] = True
+    if (
+        uniform_key is not None
+        and (builder.feature_version(), profile, active) == uniform_version
+    ):
+        # Compared against the version captured BEFORE featurizing: a
+        # batch whose first pod grew a vocabulary must not be cached (its
+        # row legitimately lacks the new feature bits — the same ordering
+        # invariant the per-pod store honors above).
+        store[uniform_key] = (dict(batch), dict(deltas[0]))
     return batch, deltas, active

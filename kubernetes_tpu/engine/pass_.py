@@ -366,6 +366,16 @@ def build_pass(
             static.update(op.static(profile, schema, builder_res_col))
     ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
     c = chunk
+    # Fused strict tail eligibility (see the tail block in run): every
+    # active op node-axis-only, chunked, not parity mode.
+    _effective = frozenset(o.name for o in filter_ops) | frozenset(
+        o.name for o, _ in score_ops
+    )
+    fuse_tail = (
+        chunk > 1
+        and profile.percentage_of_nodes_to_score == 100
+        and _effective <= PINNED_SAFE_OPS
+    )
 
     # Truncated (parity) mode — percentage_of_nodes_to_score != 100:
     # reproduce the reference's adaptive search truncation semantics
@@ -412,6 +422,10 @@ def build_pass(
         )
         k = batch["valid"].shape[0]
         assert k % c == 0, f"batch size {k} not a multiple of chunk {c}"
+        batch = dict(batch)
+        # Scalar flag (not a per-pod feature): every pod in the batch is
+        # featurization-identical.  Popped before the chunk reshape.
+        uniform_all = batch.pop("uniform_all", None)
         cbatch = jax.tree_util.tree_map(
             lambda x: x.reshape((k // c, c) + x.shape[1:]), batch
         )
@@ -554,12 +568,111 @@ def build_pass(
         start0 = (
             inv["scan_start"].astype(jnp.uint32) if truncated else jnp.uint32(0)
         )
-        (state, _gd, _ed, _st), out = lax.scan(
-            step, (state, dom0.group_dom, dom0.et_dom, start0), (cbatch, steps)
-        )
+
+        def _run_scan(st0):
+            carry_, out_ = lax.scan(
+                step, (st0, dom0.group_dom, dom0.et_dom, start0), (cbatch, steps)
+            )
+            return carry_, out_
+
+        uniform = uniform_all if fuse_tail else None
+        if uniform is not None:
+            # Template-batch all-fail shortcut: when every pod in the
+            # batch is featurization-identical (the scheduler ships the
+            # flag) and the REPRESENTATIVE is feasible nowhere, every pod
+            # fails identically — the scan would commit nothing and each
+            # chunk would reproduce the same verdict k/c times.  One
+            # evaluation replaces the whole scan (the full-cluster
+            # preemption shape: the main pass exists only to prove
+            # failure before the chained dry-run does the real work).
+            # Sound under the fused-tail gating (node-axis-only ops) —
+            # no domain reads, no commits, so pod order cannot matter.
+            pf0 = {kk: v[0, 0] for kk, v in cbatch.items()}
+            dctx0 = dataclasses.replace(ctx_nom, dom=dom0)
+            _p0, _b0, feas0, fail0, _pr0 = eval_pod(
+                state, dctx0, pf0, steps[0, 0], start0
+            )
+            allfail = uniform & (feas0 == 0) & batch["valid"][0]
+
+            def fail_branch(st0):
+                carry_ = (st0, dom0.group_dom, dom0.et_dom, start0)
+                valid = cbatch["valid"]  # (k//c, c)
+                out_ = PassResult(
+                    picks=jnp.full(valid.shape, -1, _p0.dtype),
+                    scores=jnp.zeros(valid.shape, _b0.dtype),
+                    feasible_counts=jnp.zeros(valid.shape, feas0.dtype),
+                    fail_masks=jnp.where(valid, fail0, jnp.zeros((), fail0.dtype)),
+                    processed=jnp.zeros(valid.shape, _pr0.dtype),
+                )
+                return carry_, out_
+
+            carry, out = lax.cond(allfail, fail_branch, _run_scan, state)
+        else:
+            carry, out = _run_scan(state)
         out = jax.tree_util.tree_map(
             lambda x: x.reshape((k,) + x.shape[2:]), out
         )
+        if fuse_tail:
+            # FUSED strict tail (VERDICT r4 missing-2): chunk-deferred pods
+            # (pick == -2) re-run against the committed state INSIDE this
+            # program, so their verdicts ride the main fetch instead of a
+            # second host→device round trip (the tunnel RTT was a third of
+            # the preemption row's wall time).  Sound exactly when the
+            # host tail's re-featurization would be an identity: every
+            # active op reads only node-axis state (PINNED_SAFE_OPS — no
+            # domain tables, no vocab-order-dependent features), so the
+            # original feature rows are still correct against the
+            # post-commit state.  Residual re-deferrals (chunk-mates
+            # colliding again) still drain to the host tail.
+            deferred1 = out.picks == -2
+            batch2 = dict(batch)
+            batch2["valid"] = batch["valid"] & deferred1
+            cbatch2 = jax.tree_util.tree_map(
+                lambda x: x.reshape((k // c, c) + x.shape[1:]), batch2
+            )
+            steps2 = (
+                seed_base.astype(jnp.uint32)
+                + jnp.uint32(k)
+                + jnp.arange(k, dtype=jnp.uint32)
+            ).reshape(k // c, c)
+
+            def step_tail(carry2, xs):
+                pf, _si = xs
+                # Chunks with no deferred rows skip the whole evaluation
+                # (typically all but one): the deferral clusters in the
+                # chunk whose mates collided.
+                return lax.cond(
+                    pf["valid"].any(),
+                    lambda c_: step(c_, xs),
+                    lambda c_: (
+                        c_,
+                        PassResult(
+                            picks=jnp.full((c,), -1, out.picks.dtype),
+                            scores=jnp.zeros((c,), out.scores.dtype),
+                            feasible_counts=jnp.zeros(
+                                (c,), out.feasible_counts.dtype
+                            ),
+                            fail_masks=jnp.zeros((c,), out.fail_masks.dtype),
+                            processed=jnp.zeros((c,), out.processed.dtype),
+                        ),
+                    ),
+                    carry2,
+                )
+
+            carry, out2 = lax.scan(step_tail, carry, (cbatch2, steps2))
+            out2 = jax.tree_util.tree_map(
+                lambda x: x.reshape((k,) + x.shape[2:]), out2
+            )
+            out = PassResult(
+                picks=jnp.where(deferred1, out2.picks, out.picks),
+                scores=jnp.where(deferred1, out2.scores, out.scores),
+                feasible_counts=jnp.where(
+                    deferred1, out2.feasible_counts, out.feasible_counts
+                ),
+                fail_masks=jnp.where(deferred1, out2.fail_masks, out.fail_masks),
+                processed=out.processed,
+            )
+        state = carry[0]
         return state, out
 
     return run

@@ -888,7 +888,7 @@ class PreemptionEvaluator:
     def _sig_ids(self, pods, profile, k: int):
         """Chunk-sharing signatures (first-index representative ids) for
         the dry-run's rank-split, padded to k."""
-        from .engine.features import _sig
+        from .engine.features import pod_sig
 
         sig_first: dict = {}
         sigs = np.zeros(k, np.int32)
@@ -897,7 +897,7 @@ class PreemptionEvaluator:
             if memo is not None:
                 key_ = memo
             else:
-                key_ = (p.namespace, _sig(p.metadata.labels), _sig(p.spec))
+                key_ = pod_sig(p)
             sigs[i] = sig_first.setdefault(key_, i)
         return sigs, sig_first
 
@@ -925,8 +925,13 @@ class PreemptionEvaluator:
         sigs, sig_first = self._sig_ids(pods, profile, k)
         chunk = self._chunk_for(sig_first, k)
         fn = self._pass(profile, active, pack["n_pdbs"], chunk)
+        # The scheduler's template-batch flag is a scalar the dry-run's
+        # per-pod reshape cannot carry.
+        batch_d = {
+            k2: v for k2, v in ctx["batch_d"].items() if k2 != "uniform_all"
+        }
         out, _fs, _fp = _chain_speculative(
-            fn, ctx["new_state"], ctx["batch_d"], ctx["result"].picks,
+            fn, ctx["new_state"], batch_d, ctx["result"].picks,
             jax.device_put((elig, sigs)), ctx["inv_d"], pack["d_prio"],
             pack["d_vic_req"], pack["d_vic_nonzero"], pack["d_vic_start"],
             pack["d_vfeat"], pack["d_pdb"], pack["d_allowed"],

@@ -1516,6 +1516,15 @@ class TPUScheduler:
             profile, self.builder.schema, self.builder.res_col, work["active"],
             chunk,
         )
+        if chunk > 1 and not self._truncated:
+            # Template-batch flag for the pass's all-fail shortcut: every
+            # pod featurization-identical (pass_.py uniform_all).  Pods
+            # without a signature memo (pinned shapes) count as distinct.
+            sigs = {
+                getattr(qp.pod, "_featsig", None) or i
+                for i, qp in enumerate(infos)
+            }
+            work["batch"]["uniform_all"] = np.bool_(len(sigs) == 1)
         # ONE coalesced host→device transfer for the whole input pytree:
         # letting the jit boundary ship each feature/invariant array
         # individually costs a full tunnel round trip per array (~60ms each
@@ -1663,7 +1672,11 @@ class TPUScheduler:
             tail_placed = any(picks[i] >= 0 for i in all_deferred)
         t2 = time.perf_counter()
         self._last_batch_meta = (
-            {k: (v.shape, np.asarray(v).dtype) for k, v in batch.items()},
+            {
+                k: (v.shape, np.asarray(v).dtype)
+                for k, v in batch.items()
+                if k != "uniform_all"  # scalar flag, not a feature row
+            },
             active,
         )
         self.builder.absorb_device_state(new_state)
@@ -1969,7 +1982,7 @@ class TPUScheduler:
                 rows = {
                     key: [np.asarray(arr)[i] for i, _, _ in failed]
                     for key, arr in batch.items()
-                    if key not in ("valid", "pin_row")
+                    if key not in ("valid", "pin_row", "uniform_all")
                 }
                 results = self.preemption.preempt_batch(
                     [qp.pod for _, qp, _ in failed], rows, active,
