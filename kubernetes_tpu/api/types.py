@@ -683,10 +683,14 @@ class PodGroup:
 @dataclass
 class Device:
     """resource.k8s.io BasicDevice (api/resource/v1alpha3/types.go:205):
-    one named device instance with typed attributes (bool/int/string)."""
+    one named device instance with typed attributes (bool/int/string) and
+    capacity quantities (canonical integer units, like every quantity in
+    the object model — CEL ``device.capacity`` terms compare against
+    these, dra_cel.py)."""
 
     name: str
     attributes: dict = field(default_factory=dict)
+    capacity: dict = field(default_factory=dict)
 
 
 @dataclass

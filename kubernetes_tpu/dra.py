@@ -96,10 +96,12 @@ class ClaimCatalog:
         reference fails allocation on CEL compile errors, allocator.go:159)."""
         sig = pool_sig(device_class, selectors)
         if sig not in self.pools:
-            reqs: list = []
-            for s in selectors:
-                reqs.extend(dra_cel.compile_selector(s))
-            self.pools[sig] = (device_class, tuple(reqs))
+            # DNF: a union of requirement-conjunction branches (`||` maps
+            # onto the pool machinery as branch union — one pool, one
+            # count column, matching = any branch holds).
+            self.pools[sig] = (
+                device_class, dra_cel.compile_selectors(tuple(selectors))
+            )
             self.pools_by_class.setdefault(device_class, []).append(sig)
             self.new_pools.append(sig)
         return sig
@@ -128,7 +130,9 @@ class ClaimCatalog:
     def pool_cap(self, node: str, sig: str) -> int:
         """Devices on ``node`` matching the pool (allocated or not)."""
         cls, reqs = self.pools[sig]
-        if not reqs:
+        if not reqs or reqs == ((),):
+            # Selector-less pool (compile_selectors(()) is the vacuous
+            # single empty branch): every device matches — O(1) count.
             return self.slices.get((node, cls), 0)
         devs = self.devices.get((node, cls), {})
         return sum(1 for attrs in devs.values() if dra_cel.matches(reqs, attrs))
@@ -279,7 +283,24 @@ class ClaimCatalog:
         devs = self.devices.setdefault(key, {})
         if s.devices:
             for d in s.devices:
-                devs[d.name] = d.attributes
+                # Capacity quantities live beside the attributes under
+                # reserved "capacity://" keys (dra_cel.CAPACITY_PREFIX),
+                # so capacity terms reuse the requirement machinery.
+                attrs = d.attributes
+                if d.capacity:
+                    attrs = dict(attrs)
+                    for ck, cv in d.capacity.items():
+                        # Normalize quantity strings ("40Gi") to canonical
+                        # ints here — a raw string would silently fail
+                        # every capacity comparison (ordered ops require
+                        # numbers), the exact silent-mismatch class this
+                        # subsystem turns into loud errors.
+                        attrs[dra_cel.CAPACITY_PREFIX + ck] = (
+                            cv
+                            if isinstance(cv, int) and not isinstance(cv, bool)
+                            else t.parse_quantity(cv)
+                        )
+                devs[d.name] = attrs
         else:
             base = len(devs)
             for i in range(s.count):

@@ -757,7 +757,14 @@ class RefStructuredClaims:
             devs = self.devices.setdefault(key, {})
             if s.devices:
                 for d in s.devices:
-                    devs[d.name] = d.attributes
+                    # Capacity quantities join the attr dict under the
+                    # same reserved prefix the engine uses, so test
+                    # predicates can read them; the predicates themselves
+                    # stay plain Python (independent of dra_cel).
+                    attrs = dict(d.attributes)
+                    for ck, cv in getattr(d, "capacity", {}).items():
+                        attrs[f"capacity://{ck}"] = cv
+                    devs[d.name] = attrs
             else:
                 base = len(devs)
                 for i in range(s.count):

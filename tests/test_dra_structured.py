@@ -22,50 +22,180 @@ from reference_impl import RefStructuredClaims, fits_request, fit_score
 
 
 def test_cel_compile_comparisons():
-    reqs = dra_cel.compile_selector(
+    br = dra_cel.compile_selector(
         'device.attributes["gpu.example.com/memory"].int >= 40'
     )
-    assert reqs[0].matches({"gpu.example.com/memory": 80})
-    assert not reqs[0].matches({"gpu.example.com/memory": 16})
-    assert not reqs[0].matches({})  # CEL error on missing attr → no match
+    assert dra_cel.matches(br, {"gpu.example.com/memory": 80})
+    assert not dra_cel.matches(br, {"gpu.example.com/memory": 16})
+    assert not dra_cel.matches(br, {})  # CEL error on missing attr → no match
 
 
 def test_cel_compile_conjunction_and_types():
-    reqs = dra_cel.compile_selector(
+    br = dra_cel.compile_selector(
         'device.attributes["arch"].string == "hopper" && '
         'device.attributes["nvlink"].bool == true'
     )
-    assert dra_cel.matches(reqs, {"arch": "hopper", "nvlink": True})
-    assert not dra_cel.matches(reqs, {"arch": "hopper", "nvlink": False})
-    assert not dra_cel.matches(reqs, {"arch": "ada", "nvlink": True})
+    assert dra_cel.matches(br, {"arch": "hopper", "nvlink": True})
+    assert not dra_cel.matches(br, {"arch": "hopper", "nvlink": False})
+    assert not dra_cel.matches(br, {"arch": "ada", "nvlink": True})
 
 
 def test_cel_in_exists_truthy():
-    assert dra_cel.compile_selector(
-        'device.attributes["arch"] in ["a", "b"]'
-    )[0].matches({"arch": "b"})
-    assert dra_cel.compile_selector('"cc" in device.attributes')[0].matches(
-        {"cc": 9}
+    m = lambda expr, attrs: dra_cel.matches(  # noqa: E731
+        dra_cel.compile_selector(expr), attrs
     )
-    assert dra_cel.compile_selector(
-        '!("cc" in device.attributes)'
-    )[0].matches({})
-    assert dra_cel.compile_selector('device.attributes["nvlink"]')[0].matches(
-        {"nvlink": True}
-    )
-    assert dra_cel.compile_selector(
-        '!device.attributes["nvlink"]'
-    )[0].matches({"nvlink": False})
+    assert m('device.attributes["arch"] in ["a", "b"]', {"arch": "b"})
+    assert m('"cc" in device.attributes', {"cc": 9})
+    assert m('!("cc" in device.attributes)', {})
+    assert m('device.attributes["nvlink"]', {"nvlink": True})
+    assert m('!device.attributes["nvlink"]', {"nvlink": False})
 
 
-def test_cel_rejects_unsupported():
+def test_cel_disjunction_and_parens():
+    """`||` compiles to DNF branch unions (VERDICT r4 missing-3);
+    parentheses group, && distributes over grouped ||."""
+    m = lambda expr, attrs: dra_cel.matches(  # noqa: E731
+        dra_cel.compile_selector(expr), attrs
+    )
+    e = (
+        'device.attributes["arch"].string == "hopper" || '
+        'device.attributes["mem"].int >= 80'
+    )
+    assert m(e, {"arch": "hopper", "mem": 16})
+    assert m(e, {"arch": "ada", "mem": 80})
+    assert not m(e, {"arch": "ada", "mem": 16})
+    # Grouping + distribution: (A || B) && C.
+    g = (
+        '(device.attributes["arch"].string == "hopper" || '
+        'device.attributes["arch"].string == "blackwell") && '
+        'device.attributes["nvlink"].bool == true'
+    )
+    assert m(g, {"arch": "blackwell", "nvlink": True})
+    assert not m(g, {"arch": "blackwell", "nvlink": False})
+    assert not m(g, {"arch": "ada", "nvlink": True})
+    # Nested groups.
+    n = (
+        'device.attributes["a"].int >= 1 && '
+        '(device.attributes["b"].int >= 2 || '
+        '("c" in device.attributes && device.attributes["d"].int < 0))'
+    )
+    assert m(n, {"a": 1, "b": 2})
+    assert m(n, {"a": 1, "b": 0, "c": True, "d": -1})
+    assert not m(n, {"a": 1, "b": 0, "c": True, "d": 0})
+    assert not m(n, {"a": 0, "b": 2})
+
+
+def test_cel_capacity_terms():
+    """device.capacity quantity comparisons (cel/compile_test.go:151
+    shapes) via the repo's canonical quantity units."""
+    m = lambda expr, attrs: dra_cel.matches(  # noqa: E731
+        dra_cel.compile_selector(expr), attrs
+    )
+    gi40 = 40 * 1024**3
+    dev = {"capacity://memory": gi40}
+    assert m('device.capacity["memory"].isGreaterThan(quantity("10Gi"))', dev)
+    assert not m('device.capacity["memory"].isGreaterThan(quantity("40Gi"))', dev)
+    assert m('device.capacity["memory"].isLessThan(quantity("1Ti"))', dev)
+    assert m('device.capacity["memory"].isEqualTo(quantity("40Gi"))', dev)
+    # Operator sugar with a quantity literal.
+    assert m('device.capacity["memory"] >= quantity("40Gi")', dev)
+    assert m('device.capacity["memory"] != quantity("39Gi")', dev)
+    assert not m('device.capacity["memory"] < quantity("40Gi")', dev)
+    # Existence + missing-capacity no-match.
+    assert m('"memory" in device.capacity', dev)
+    assert not m('"hugepages" in device.capacity', dev)
+    assert m('!("hugepages" in device.capacity)', dev)
+    assert not m('device.capacity["hugepages"] > quantity("1")', dev)
+    # Capacity composes with attributes and disjunction.
+    e = (
+        'device.attributes["arch"].string == "hopper" && '
+        '(device.capacity["memory"] >= quantity("80Gi") || '
+        'device.attributes["nvlink"].bool == true)'
+    )
+    assert m(e, {"arch": "hopper", "nvlink": True, "capacity://memory": gi40})
+    assert m(
+        e, {"arch": "hopper", "nvlink": False, "capacity://memory": 2 * gi40}
+    )
+    assert not m(
+        e, {"arch": "hopper", "nvlink": False, "capacity://memory": gi40}
+    )
+
+
+def test_cel_dnf_branch_bound_and_residue():
+    # Residue stays a hard config error (semver/string fns/bind/driver).
     for bad in (
-        'device.attributes["x"].int >= 40 || device.attributes["y"].bool',
-        "device.capacity['x'] > quantity('1Gi')",
         'device.attributes["x"].matches("re.*")',
+        'device.attributes["v"].isGreaterThan(semver("1.0.0"))',
+        'cel.bind(dra, device.attributes["d"], dra.x)',
+        'device.driver == "dra.example.com"',
+        "",
     ):
         with pytest.raises(ValueError):
             dra_cel.compile_selector(bad)
+    # Adversarial DNF blowup is bounded, not silently truncated.
+    blowup = " && ".join(
+        f'(device.attributes["a{i}"].int >= 1 || '
+        f'device.attributes["b{i}"].int >= 1)'
+        for i in range(8)
+    )
+    with pytest.raises(ValueError):
+        dra_cel.compile_selector(blowup)
+
+
+def test_cel_mixed_type_disjunction_sorts():
+    """int-vs-str branches on one attribute must canonicalize, not
+    TypeError (review finding: the sort key is type-tagged)."""
+    br = dra_cel.compile_selector(
+        'device.attributes["x"].int == 1 || '
+        'device.attributes["x"].string == "a"'
+    )
+    assert dra_cel.matches(br, {"x": 1})
+    assert dra_cel.matches(br, {"x": "a"})
+    assert not dra_cel.matches(br, {"x": 2})
+    assert dra_cel.canonical(
+        ('device.attributes["x"].int == 1 && device.attributes["x"].string == "a"',)
+    )
+
+
+def test_capacity_string_quantities_normalized():
+    """Wire-shaped capacity strings ("40Gi") normalize to canonical ints
+    at slice ingestion (review finding: a raw string silently failed
+    every comparison)."""
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    s = TPUScheduler(batch_size=4)
+    s.add_resource_slice(
+        t.ResourceSlice(
+            node_name="nx", device_class=GPU,
+            devices=(
+                t.Device(name="d0", capacity={"memory": "40Gi"}),
+            ),
+        )
+    )
+    devs = s.builder.dra.devices[("nx", GPU)]
+    assert devs["d0"]["capacity://memory"] == 40 * 1024**3
+    br = dra_cel.compile_selector(
+        'device.capacity["memory"] >= quantity("40Gi")'
+    )
+    assert dra_cel.matches(br, devs["d0"])
+
+
+def test_cel_canonical_dedups_disjunction_order():
+    a = dra_cel.canonical(
+        ('device.attributes["x"].int >= 1 || device.attributes["y"].int >= 2',)
+    )
+    b = dra_cel.canonical(
+        ('device.attributes["y"].int >= 2 || device.attributes["x"].int >= 1',)
+    )
+    assert a == b
+    # Duplicate branches collapse.
+    c = dra_cel.canonical(
+        (
+            'device.attributes["x"].int >= 1 || '
+            'device.attributes["x"].int >= 1',
+        )
+    )
+    assert c == dra_cel.canonical(('device.attributes["x"].int >= 1',))
 
 
 def test_canonical_signature_dedups_equivalent():
@@ -228,6 +358,120 @@ def test_structured_parity_vs_scalar_oracle():
         oracle_claims.commit(pod, best)
         states[best].pods.append(pod)
     assert engine == expected, (engine, expected)
+    assert s.builder.host_mirror_equal()
+
+
+CAP_OR_ADA = (
+    'device.capacity["memory"] >= quantity("40Gi") || '
+    'device.attributes["arch"].string == "ada"'
+)
+
+
+def cap_or_ada_pred(attrs):
+    return (
+        attrs.get("capacity://memory", 0) >= 40 * 1024**3
+        or attrs.get("arch") == "ada"
+    )
+
+
+def test_capacity_disjunction_parity_vs_scalar_oracle():
+    """The full-CEL additions end to end (VERDICT r4 missing-3): a
+    capacity-quantity + disjunction selector drives pool columns and the
+    exact allocator; decisions match the independent scalar oracle whose
+    predicate is plain Python."""
+    profile = Profile(
+        name="dra",
+        filters=("NodeResourcesFit", "DynamicResources"),
+        scorers=(("NodeResourcesFit", 1),),
+    )
+    s = TPUScheduler(profile=profile, batch_size=4)
+
+    def devs_for(name):
+        gi = 1024**3
+        table = {
+            # (mem-capacity Gi, arch) per device
+            "n0": [(16, "hopper"), (16, "hopper")],   # no match
+            "n1": [(80, "hopper"), (16, "ada")],      # both match
+            "n2": [(40, "blackwell")],                # capacity branch
+            "n3": [(16, "ada"), (16, "hopper")],      # attr branch
+        }
+        return tuple(
+            t.Device(
+                name=f"d{i}",
+                attributes={"arch": a},
+                capacity={"memory": m * gi},
+            )
+            for i, (m, a) in enumerate(table[name])
+        )
+
+    nodes = []
+    slices = []
+    for name, cpu in (("n0", "30"), ("n1", "22"), ("n2", "14"), ("n3", "6")):
+        node = make_node(name).capacity(
+            {"cpu": cpu, "memory": "64Gi", "pods": 110}
+        ).obj()
+        nodes.append(node)
+        s.add_node(node)
+        sl = t.ResourceSlice(
+            node_name=name, device_class=GPU, devices=devs_for(name)
+        )
+        slices.append(copy.deepcopy(sl))
+        s.add_resource_slice(sl)
+
+    claims = []
+    predicates = {}
+    pods = []
+    for i in range(5):
+        count = 2 if i == 0 else 1  # the 2-device claim only fits n1
+        c = t.ResourceClaim(
+            name=f"c{i}",
+            requests=(
+                t.DeviceRequest("r0", GPU, count=count, selectors=(CAP_OR_ADA,)),
+            ),
+        )
+        claims.append(c)
+        predicates[c.uid] = {"r0": cap_or_ada_pred}
+        s.add_resource_claim(copy.deepcopy(c))
+        pod = make_pod(f"p{i}").req({"cpu": "1"}).resource_claim(f"c{i}").obj()
+        pods.append(pod)
+        s.add_pod(copy.deepcopy(pod))
+
+    engine = {o.pod.name: o.node_name for o in s.schedule_all_pending()}
+
+    oracle_claims = RefStructuredClaims(
+        claims=copy.deepcopy(claims), slices=slices, predicates=predicates
+    )
+    from reference_impl import RefNodeState
+
+    states = {n.name: RefNodeState(node=n) for n in nodes}
+    expected = {}
+    for pod in pods:
+        feasible = [
+            n
+            for n in nodes
+            if not fits_request(pod, states[n.name])
+            and oracle_claims.filter(pod, n)
+        ]
+        if not feasible:
+            expected[pod.name] = None
+            continue
+        scored = [
+            (fit_score(pod, states[n.name], "LeastAllocated"), -i, n.name)
+            for i, n in enumerate(nodes)
+            if n in feasible
+        ]
+        best = max(scored)[2]
+        expected[pod.name] = best
+        oracle_claims.commit(pod, best)
+        states[best].pods.append(pod)
+    assert engine == expected, (engine, expected)
+    # Allocated device names honor the disjunction (no non-matching picks).
+    for c in s.builder.dra.claims.values():
+        if c.allocated_node:
+            key = (c.allocated_node, GPU)
+            devs = s.builder.dra.devices[key]
+            for _req, d in c.allocated_devices:
+                assert cap_or_ada_pred(devs[d]), (c.name, d)
     assert s.builder.host_mirror_equal()
 
 
@@ -395,15 +639,15 @@ def test_node_remove_readd_replays_corrections():
 def test_cel_bool_int_type_strict():
     # CEL type-errors on bool-vs-int (True must not equal 1); Ne on a type
     # error is also a no-match, not a match.
-    eq = dra_cel.compile_selector('device.attributes["nvlink"].bool == true')[0]
-    assert not eq.matches({"nvlink": 1})
-    assert eq.matches({"nvlink": True})
-    ne = dra_cel.compile_selector('device.attributes["nvlink"].bool != true')[0]
-    assert not ne.matches({"nvlink": 1})
-    assert ne.matches({"nvlink": False})
-    inop = dra_cel.compile_selector('device.attributes["x"] in [1, 2]')[0]
-    assert not inop.matches({"x": True})
-    assert inop.matches({"x": 1})
+    m = lambda expr, attrs: dra_cel.matches(  # noqa: E731
+        dra_cel.compile_selector(expr), attrs
+    )
+    assert not m('device.attributes["nvlink"].bool == true', {"nvlink": 1})
+    assert m('device.attributes["nvlink"].bool == true', {"nvlink": True})
+    assert not m('device.attributes["nvlink"].bool != true', {"nvlink": 1})
+    assert m('device.attributes["nvlink"].bool != true', {"nvlink": False})
+    assert not m('device.attributes["x"] in [1, 2]', {"x": True})
+    assert m('device.attributes["x"] in [1, 2]', {"x": 1})
 
 
 def test_pod_referencing_claim_twice_allocates_once():
