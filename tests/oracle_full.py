@@ -324,8 +324,57 @@ class FullOracleScheduler:
                     break
             if not dra_hard_ok:
                 continue
-            res_fail = not dra_filter(pod, n, self.claims)
+            # RWOP exclusivity is the engine's remaining evict-all route
+            # (preemption.py divergences): a blocked preemptor skips the
+            # reprieve; everything else — device conflicts, CSI attach
+            # counts, DRA device shortage — releases in the what-if (r5).
+            res_fail = any(
+                pvc is not None
+                and t.RWOP in pvc.access_modes
+                and self.pvc_users.get(pvc.uid, 0) > 0
+                for pvc in self.vols.pod_pvcs(pod)
+            )
             keep = [p for p in st.pods if p.spec.priority >= prio]
+
+            def dra_filter_trial(removed: list[t.Pod]) -> bool:
+                """dra_filter with the victims' claim charges released:
+                a claim frees its devices on n exactly when evicting the
+                removed set would empty its reservations — the same
+                reserved_for rule the eviction code below applies, so the
+                what-if and post-eviction truth agree (review finding:
+                a claim co-reserved by an external consumer never
+                releases)."""
+                removed_uids = {p.uid for p in removed}
+                released: dict[str, int] = {}
+                seen: set[str] = set()
+                for p in removed:
+                    for claim in self.claims.pod_claims(p):
+                        if (
+                            claim is None
+                            or claim.uid in seen
+                            or claim.allocated_node != n.name
+                            or not set(claim.reserved_for) <= removed_uids
+                        ):
+                            continue
+                        seen.add(claim.uid)
+                        released[claim.device_class] = (
+                            released.get(claim.device_class, 0) + claim.count
+                        )
+                need: dict[str, int] = {}
+                for claim in self.claims.pod_claims(pod):
+                    if claim is None:
+                        return False
+                    if claim.allocated_node:
+                        if claim.allocated_node != n.name:
+                            return False
+                        continue
+                    need[claim.device_class] = (
+                        need.get(claim.device_class, 0) + claim.count
+                    )
+                for cls, cnt in need.items():
+                    if self.claims.free(n.name, cls) + released.get(cls, 0) < cnt:
+                        return False
+                return True
 
             def ok_with(removed: list[t.Pod]) -> bool:
                 trial = {
@@ -344,6 +393,20 @@ class FullOracleScheduler:
                 if not spread_filter(pod, self.nodes, trial)[n.name]:
                     return False
                 if not ipa_filter(pod, self.nodes, trial, self.ns_labels)[n.name]:
+                    return False
+                # Volume/DRA releases (r5): the trial pod set drives the
+                # device-conflict and attach-count checks directly; DRA
+                # uses the claim-crossing release above.  The RWOP check
+                # is excluded here (empty user map) exactly like the
+                # engine's what-if forces vr_rwop_ok — the res_fail
+                # evict-all route owns RWOP semantics.
+                if not volume_restrictions_filter(
+                    pod, st2.pods, self.vols, {}
+                ):
+                    return False
+                if not node_volume_limits_filter(pod, n, st2.pods, self.vols):
+                    return False
+                if not dra_filter_trial(removed):
                     return False
                 return True
 
@@ -412,6 +475,26 @@ class FullOracleScheduler:
             self.states[name].pods.remove(v)
             for i in matched(v):
                 self.pdbs[i].disruptions_allowed -= 1
+            # The engine's delete_pod releases the victim's claim
+            # reservations (the DRA claim-release control loop: a claim
+            # deallocates when its last reserver goes) and its RWOP usage
+            # counts — the retry validates against post-eviction truth on
+            # both sides.
+            for claim in self.claims.pod_claims(v):
+                if claim is None:
+                    continue
+                claim.reserved_for = tuple(
+                    u for u in claim.reserved_for if u != v.uid
+                )
+                if claim.allocated_node and not claim.reserved_for:
+                    key = (claim.allocated_node, claim.device_class)
+                    self.claims.allocated[key] = (
+                        self.claims.allocated.get(key, 0) - claim.count
+                    )
+                    claim.allocated_node = ""
+            for pvc in self.vols.pod_pvcs(v):
+                if pvc is not None and self.pvc_users.get(pvc.uid):
+                    self.pvc_users[pvc.uid] -= 1
         self.nominator[pod.uid] = (name, pod)
         return Decision(
             pod=pod, node=None, nominated=name,
@@ -672,6 +755,60 @@ def build_fixture(n_nodes: int = 304, n_pending: int = 120, n_tiny: int = 10,
             .scheduling_gate("example.com/hold").obj()
             for i in range(2)
         ]
+        # Volume/DRA preemption theater (r5): nodes feasible ONLY via a
+        # volume/DRA victim, with a same-priority bystander that must
+        # REPRIEVE — pins the what-if's released volume/DRA tensors (the
+        # old evict-all route would take the bystander too).
+        nodes.append(
+            make_node("volpre-0")
+            .capacity({"cpu": "64", "memory": "64Gi", "pods": 64})
+            .zone("zone-0").region("r1").label("pool", "volpre").obj()
+        )
+        bound.append(
+            make_pod("vpre-holder").req({"cpu": "500m"}).priority(1)
+            .label("kind", "holder").start_time(300.0)
+            .device_volume("shared-disk-0").node("volpre-0").obj()
+        )
+        bound.append(
+            make_pod("vpre-bystander").req({"cpu": "500m"}).priority(1)
+            .label("kind", "bystander").start_time(301.0)
+            .node("volpre-0").obj()
+        )
+        vol_pending.append(
+            make_pod("vip-vol").req({"cpu": "500m"}).priority(50)
+            .node_affinity_in("pool", ["volpre"])
+            .device_volume("shared-disk-0").obj()
+        )
+        nodes.append(
+            make_node("drapre-0")
+            .capacity({"cpu": "64", "memory": "64Gi", "pods": 64})
+            .zone("zone-1").region("r1").label("pool", "drapre").obj()
+        )
+        slices.append(
+            t.ResourceSlice(node_name="drapre-0", device_class="pgpu", count=1)
+        )
+        held = t.ResourceClaim(
+            name="dheld", device_class="pgpu", count=1,
+            allocated_node="drapre-0",
+            reserved_for=("default/dpre-holder",),
+        )
+        dclaims.append(held)
+        dclaims.append(t.ResourceClaim(name="dwant", device_class="pgpu", count=1))
+        bound.append(
+            make_pod("dpre-holder").req({"cpu": "500m"}).priority(1)
+            .label("kind", "holder").start_time(302.0)
+            .resource_claim("dheld").node("drapre-0").obj()
+        )
+        bound.append(
+            make_pod("dpre-bystander").req({"cpu": "500m"}).priority(1)
+            .label("kind", "bystander").start_time(303.0)
+            .node("drapre-0").obj()
+        )
+        vol_pending.append(
+            make_pod("vip-dra").req({"cpu": "500m"}).priority(50)
+            .node_affinity_in("pool", ["drapre"])
+            .resource_claim("dwant").obj()
+        )
         pending = pending + vol_pending + gated
         objects = dict(
             classes=classes, pvs=pvs, pvcs=pvcs, csinodes=csinodes,

@@ -671,6 +671,13 @@ class RefClaims:
             key = (s.node_name, s.device_class)
             self.slices[key] = self.slices.get(key, 0) + s.count
         self.allocated: dict[tuple[str, str], int] = {}
+        # Pre-allocated claims consume their devices the moment they
+        # arrive (the engine's external-allocation phantom charge,
+        # dra.ClaimCatalog.add_claim).
+        for c in self.claims.values():
+            if c.allocated_node:
+                key = (c.allocated_node, c.device_class)
+                self.allocated[key] = self.allocated.get(key, 0) + c.count
 
     def pod_claims(self, pod):
         return [
