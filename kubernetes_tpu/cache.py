@@ -43,6 +43,11 @@ class NodeRecord:
     pods: dict[str, t.Pod] = field(default_factory=dict)  # uid → pod
     generation: int = 0
     zone: str = ""
+    # Pod-membership generation: bumped (from a cache-global monotonic
+    # counter, so values never collide across row reuse) whenever this
+    # node's pod set or any resident pod's object changes.  The preemption
+    # evaluator keys its incremental victim-staging cache on it.
+    pods_gen: int = 0
 
 
 class NodeTree:
@@ -105,6 +110,11 @@ class Cache:
         self._row_to_name: dict[int, str] = {}
         self.node_tree = NodeTree()
         self._order_cache: tuple[int, np.ndarray] | None = None
+        self._pods_gen = 0
+
+    def _bump_pods_gen(self, rec: NodeRecord) -> None:
+        self._pods_gen += 1
+        rec.pods_gen = self._pods_gen
 
     # -- nodes ---------------------------------------------------------------
 
@@ -126,9 +136,11 @@ class Cache:
             self._next_row += 1
         self._generation += 1
         zone = _zone_of(node)
-        self.nodes[node.name] = NodeRecord(
+        rec = NodeRecord(
             node=node, row=row, generation=self._generation, zone=zone
         )
+        self._bump_pods_gen(rec)
+        self.nodes[node.name] = rec
         self.builder.set_node_row(row, node)
         self._row_to_name[row] = node.name
         self.node_tree.add(zone, node.name)
@@ -182,6 +194,7 @@ class Cache:
         pr = PodRecord(pod=pod, node_name=node_name, delta=delta, bound=True)
         self.pods[pod.uid] = pr
         rec.pods[pod.uid] = pod
+        self._bump_pods_gen(rec)
         self.builder.apply_pod_delta(rec.row, delta, +1, device_already=device_already)
 
     def assume_pod(
@@ -202,6 +215,7 @@ class Cache:
         )
         self.pods[pod.uid] = pr
         rec.pods[pod.uid] = pod
+        self._bump_pods_gen(rec)
         self.builder.apply_pod_delta(rec.row, delta, +1, device_already=device_already)
 
     def finish_binding(self, uid: str) -> None:
@@ -213,6 +227,7 @@ class Cache:
         pr = self.pods.pop(uid)
         rec = self.nodes[pr.node_name]
         rec.pods.pop(uid, None)
+        self._bump_pods_gen(rec)
         self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
 
     def remove_pod(self, uid: str) -> None:
@@ -222,6 +237,7 @@ class Cache:
         rec = self.nodes.get(pr.node_name)
         if rec is not None:
             rec.pods.pop(uid, None)
+            self._bump_pods_gen(rec)
             self.builder.apply_pod_delta(rec.row, pr.delta, -1, device_already=False)
 
     def update_pod(self, pod: t.Pod) -> None:
@@ -236,6 +252,7 @@ class Cache:
         pr.pod = pod
         pr.delta = delta
         rec.pods[pod.uid] = pod
+        self._bump_pods_gen(rec)
         self.builder.apply_pod_delta(rec.row, delta, +1, device_already=False)
 
     def cleanup_assumed(

@@ -88,27 +88,39 @@ class EventCtx:
     max_slots: int = 0  # free pod slots upper bound
 
 
-def _fits_free(reqs: list[np.ndarray], ctx: EventCtx) -> np.ndarray:
-    """(K,) bool: which request vectors the event's freed capacity could
-    seat — THE fit predicate, shared by the scalar hint and the queue's
-    batched wake path so the two cannot drift.  A pod needing a resource
-    column the affected nodes don't expose never wakes."""
-    k = len(reqs)
+def _pack_reqs(reqs: list[np.ndarray]) -> np.ndarray:
+    """Stack request vectors into one (K, maxR) int64 matrix (zero-padded;
+    a missing column means the pod does not request that resource)."""
+    mx = max((q.shape[0] for q in reqs), default=0)
+    reqm = np.zeros((len(reqs), max(mx, 1)), np.int64)
+    for i, q in enumerate(reqs):
+        reqm[i, : q.shape[0]] = q
+    return reqm
+
+
+def _fits_packed(reqm: np.ndarray, ctx: EventCtx) -> np.ndarray:
+    """(K,) bool over a prepacked request matrix: which pods the event's
+    freed capacity could seat — THE fit predicate, shared by the scalar
+    hint and the queue's batched wake path so the two cannot drift.  A pod
+    needing a resource column the affected nodes don't expose never
+    wakes."""
+    k = reqm.shape[0]
     if ctx.max_slots < 1:
         return np.zeros(k, np.bool_)
     r = ctx.max_free.shape[0]
-    reqm = np.zeros((k, r), np.int64)
-    overflow = np.zeros(k, np.bool_)
-    for i, req in enumerate(reqs):
-        n = min(req.shape[0], r)
-        reqm[i, :n] = req[:n]
-        if req.shape[0] > r and req[r:].any():
-            overflow[i] = True
+    head = reqm[:, :r]
+    free = ctx.max_free[: head.shape[1]]
     # The fit filter's per-resource escape: a resource the pod does not
     # request never blocks it (negative free in an unrequested column —
     # nominated-claim subtraction — must not pin the pod asleep).
-    fits = ((reqm == 0) | (reqm <= ctx.max_free[None, :])).all(axis=1)
-    return fits & ~overflow
+    fits = ((head == 0) | (head <= free[None, :])).all(axis=1)
+    if reqm.shape[1] > r:
+        fits &= ~(reqm[:, r:] != 0).any(axis=1)
+    return fits
+
+
+def _fits_free(reqs: list[np.ndarray], ctx: EventCtx) -> np.ndarray:
+    return _fits_packed(_pack_reqs(reqs), ctx)
 
 
 def _fit_hint(qp: "QueuedPodInfo", event: "Event", ctx: EventCtx) -> bool:
@@ -147,6 +159,9 @@ class QueuedPodInfo:
     # takes the full pass (the scheduler's _pin_rows skips it).  Reset when
     # a fresh nomination is recorded.
     nom_pin_failed: bool = False
+    # Requeue-verdict class this pod was filed under when it entered the
+    # unschedulable pool (set by _unsched_insert, read by _unsched_remove).
+    unsched_class: tuple | None = None
 
 
 class SchedulingQueue:
@@ -162,6 +177,14 @@ class SchedulingQueue:
         self._active: list = []  # heap of (-priority, timestamp, seq, uid)
         self._backoff: list = []  # heap of (expiry, seq, uid)
         self._unschedulable: dict[str, QueuedPodInfo] = {}
+        # Verdict-class index over the unschedulable pool: pods whose
+        # requeue verdict is identical for every event share a class
+        # ((rejecting plugins, delta presence) — valid while every
+        # registered hint is the batched fit hint), so on_event computes
+        # ONE verdict per class and one vectorized fit check over a cached
+        # request matrix instead of a Python walk of a 15k-pod pool.
+        self._unsched_classes: dict[tuple, dict[str, QueuedPodInfo]] = {}
+        self._unsched_req_cache: dict[tuple, tuple[list, np.ndarray]] = {}
         self._info: dict[str, QueuedPodInfo] = {}
         self._in_active: set[str] = set()
         self.initial_backoff_s = initial_backoff_s
@@ -324,7 +347,7 @@ class SchedulingQueue:
             (-qp.pod.spec.priority, qp.timestamp, next(self._seq), qp.pod.uid),
         )
         self._in_active.add(qp.pod.uid)
-        self._unschedulable.pop(qp.pod.uid, None)
+        self._unsched_remove(qp.pod.uid)
 
     def pop_batch(self, k: int) -> list[QueuedPodInfo]:
         """Pop up to k pods in QueueSort order — the batch analog of
@@ -352,6 +375,34 @@ class SchedulingQueue:
                 return self.max_backoff_s
         return d
 
+    def _unsched_insert(self, qp: QueuedPodInfo) -> None:
+        # Idempotent under re-classification: a uid already pooled under a
+        # different rejecting-plugin set must leave its old class index.
+        if qp.pod.uid in self._unschedulable:
+            self._unsched_remove(qp.pod.uid)
+        self._unschedulable[qp.pod.uid] = qp
+        ck = (
+            frozenset(qp.unschedulable_plugins)
+            if qp.unschedulable_plugins
+            else None,
+            qp.delta is None,
+        )
+        qp.unsched_class = ck
+        self._unsched_classes.setdefault(ck, {})[qp.pod.uid] = qp
+        self._unsched_req_cache.pop(ck, None)
+
+    def _unsched_remove(self, uid: str) -> QueuedPodInfo | None:
+        qp = self._unschedulable.pop(uid, None)
+        if qp is None:
+            return None
+        pool = self._unsched_classes.get(qp.unsched_class)
+        if pool is not None:
+            pool.pop(uid, None)
+            if not pool:
+                self._unsched_classes.pop(qp.unsched_class, None)
+        self._unsched_req_cache.pop(qp.unsched_class, None)
+        return qp
+
     def add_unschedulable(self, qp: QueuedPodInfo, plugins: set[str]) -> None:
         """AddUnschedulableIfNotPresent (scheduling_queue.go:728): pods that
         failed go to the unschedulable pool keyed by what rejected them.
@@ -363,7 +414,7 @@ class SchedulingQueue:
             if g in self.gang_min:
                 self._park_gang_member(qp)
                 return
-        self._unschedulable[qp.pod.uid] = qp
+        self._unsched_insert(qp)
 
     def add_backoff(self, qp: QueuedPodInfo) -> None:
         expiry = self._clock() + self.backoff_duration(qp.attempts)
@@ -412,7 +463,7 @@ class SchedulingQueue:
             if now - qp.timestamp > self.max_unschedulable_s
         ]
         for uid in stale:
-            self._push_active(self._unschedulable.pop(uid))
+            self._push_active(self._unsched_remove(uid))
         n = len(stale)
         for g in list(self._gang_pool):
             if any(
@@ -453,48 +504,62 @@ class SchedulingQueue:
             return _fit_hint(qp, event, ctx)
         return v
 
+    def _class_reqs(self, ck: tuple) -> tuple[list, np.ndarray]:
+        """(uids, packed request matrix) for one verdict class, cached
+        until the class's membership changes (insert/remove invalidate)."""
+        cached = self._unsched_req_cache.get(ck)
+        if cached is None:
+            pool = self._unsched_classes.get(ck, {})
+            uids = list(pool)
+            cached = (uids, _pack_reqs([pool[u].delta["req"] for u in uids]))
+            self._unsched_req_cache[ck] = cached
+        return cached
+
     def on_event(self, event: Event, ctx: EventCtx | None = None) -> int:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1029): wake
         unschedulable pods whose rejecting plugins care about this event
         (filtered through the object-aware hints when ``ctx`` is given)."""
-        woken = []
-        fit_uids: list[str] = []
-        fit_reqs: list[np.ndarray] = []
+        woken: list[str] = []
         # The verdict depends only on (rejecting plugins, delta presence)
         # as long as every registered hint is the BATCHED fit hint — one
-        # computation per distinct class instead of per pod (a preemption
-        # burst scans a 15k-pod pool per POD_DELETE; the per-pod verdict
-        # walk was ~15% of the preemption-async measured window).
-        vcache: dict | None = (
-            {}
-            if all(h is _fit_hint for h in PLUGIN_HINTS.values())
-            else None
-        )
-        for uid, qp in self._unschedulable.items():
-            if vcache is not None:
-                ck = (
-                    frozenset(qp.unschedulable_plugins)
-                    if qp.unschedulable_plugins
-                    else None,
-                    qp.delta is None,
-                )
-                verdict = vcache.get(ck, _MISS)
-                if verdict is _MISS:
-                    verdict = self._requeue_verdict(qp, event, ctx)
-                    vcache[ck] = verdict
-            else:
+        # verdict per CLASS over the maintained index instead of a Python
+        # walk of the pool (a preemption burst scans a 15k-pod pool per
+        # POD_DELETE; the per-pod verdict walk was ~15% of the
+        # preemption-async measured window), and the fit classes check one
+        # cached request matrix per class in a single vectorized compare.
+        if all(h is _fit_hint for h in PLUGIN_HINTS.values()):
+            for ck in list(self._unsched_classes):
+                pool = self._unsched_classes.get(ck)
+                if not pool:
+                    continue
+                rep = next(iter(pool.values()))
+                verdict = self._requeue_verdict(rep, event, ctx)
+                if verdict is True:
+                    woken.extend(pool)
+                elif verdict == "fit":
+                    uids, reqm = self._class_reqs(ck)
+                    fits = _fits_packed(reqm, ctx)
+                    woken.extend(u for u, ok in zip(uids, fits) if ok)
+        else:
+            # Custom hints registered: per-pod verdicts, but the fit checks
+            # still batch into one vectorized compare (the per-pod numpy
+            # path costs ~0.5s per event over a 15k-pod pool).
+            fit_uids: list[str] = []
+            fit_reqs: list[np.ndarray] = []
+            for uid, qp in self._unschedulable.items():
                 verdict = self._requeue_verdict(qp, event, ctx)
-            if verdict is True:
-                woken.append(uid)
-            elif verdict == "fit":
-                fit_uids.append(uid)
-                fit_reqs.append(qp.delta["req"])
-        if fit_uids:
-            fits = _fits_free(fit_reqs, ctx)
-            woken.extend(uid for uid, ok in zip(fit_uids, fits) if ok)
+                if verdict is True:
+                    woken.append(uid)
+                elif verdict == "fit":
+                    fit_uids.append(uid)
+                    fit_reqs.append(qp.delta["req"])
+            if fit_uids:
+                fits = _fits_free(fit_reqs, ctx)
+                woken.extend(u for u, ok in zip(fit_uids, fits) if ok)
         for uid in woken:
-            qp = self._unschedulable.pop(uid)
-            self.add_backoff(qp)
+            qp = self._unsched_remove(uid)
+            if qp is not None:
+                self.add_backoff(qp)
         # Parked gangs re-try when an event the gang cares about fires —
         # membership changes (the GangScheduling mask) OR anything the
         # members' own rejecting plugins wait on (a gang blocked by taints
@@ -541,7 +606,7 @@ class SchedulingQueue:
 
     def delete(self, uid: str) -> None:
         self._in_active.discard(uid)
-        self._unschedulable.pop(uid, None)
+        self._unsched_remove(uid)
         self._gated.pop(uid, None)
         qp = self._info.pop(uid, None)
         if qp is not None and qp.pod.spec.pod_group:

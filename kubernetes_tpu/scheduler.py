@@ -31,6 +31,24 @@ from .queue import Event, EventCtx, QueuedPodInfo, SchedulingQueue
 from .utils import device_fetch
 from .snapshot import SnapshotBuilder
 
+from functools import partial  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=3)
+def _expand_uniform(small, valid, nomrow, k):
+    """Broadcast a uniform batch's single representative feature row to
+    the full batch axis on device (see _dispatch_batch: identical rows
+    need not ride the tunnel k times)."""
+    out = {
+        kk: jnp.broadcast_to(v[0], (k,) + v.shape[1:])
+        for kk, v in small.items()
+    }
+    out["valid"] = valid
+    out["nominated_row"] = nomrow
+    return out
+
 
 @dataclass
 class ScheduleOutcome:
@@ -462,6 +480,9 @@ class TPUScheduler:
                 node_rec = self.cache.nodes.get(pr.node_name)
                 if node_rec is not None:
                     node_rec.pods[pod.uid] = pod
+                    # start_time feeds victim ordering: the staged victim
+                    # tensors for this node are stale.
+                    self.cache._bump_pods_gen(node_rec)
                 return
             self.cache.update_pod(pod)
             self.queue.on_event(
@@ -1516,6 +1537,7 @@ class TPUScheduler:
             profile, self.builder.schema, self.builder.res_col, work["active"],
             chunk,
         )
+        uniform = False
         if chunk > 1 and not self._truncated:
             # Template-batch flag for the pass's all-fail shortcut: every
             # pod featurization-identical (pass_.py uniform_all).  Pods
@@ -1524,13 +1546,35 @@ class TPUScheduler:
                 getattr(qp.pod, "_featsig", None) or i
                 for i, qp in enumerate(infos)
             }
-            work["batch"]["uniform_all"] = np.bool_(len(sigs) == 1)
+            uniform = len(sigs) == 1
+            work["batch"]["uniform_all"] = np.bool_(uniform)
         # ONE coalesced host→device transfer for the whole input pytree:
         # letting the jit boundary ship each feature/invariant array
         # individually costs a full tunnel round trip per array (~60ms each
         # when the device is busy — the dominant per-batch fixed cost on
         # axon), so ~20 arrays ride one batched_device_put instead.
-        batch_d, inv_d = jax.device_put((work["batch"], inv))
+        batch_np = work["batch"]
+        if uniform:
+            # A uniform batch's feature rows are identical by the same
+            # signature equality the all-fail shortcut trusts: ship ONE
+            # representative row and broadcast on device — ~0.5MB of
+            # identical rows otherwise ride the tunnel every preemption/
+            # daemonset batch.  valid (padding) and nominated_row (injected
+            # post-featurize) genuinely vary per pod and ship in full.
+            bkeys = tuple(sorted(
+                kk for kk in batch_np
+                if kk not in ("valid", "nominated_row", "uniform_all")
+            ))
+            small = {kk: np.ascontiguousarray(batch_np[kk][:1]) for kk in bkeys}
+            small_d, valid_d, nom_d, inv_d = jax.device_put(
+                (small, batch_np["valid"], batch_np["nominated_row"], inv)
+            )
+            batch_d = _expand_uniform(
+                small_d, valid_d, nom_d, batch_np["valid"].shape[0]
+            )
+            batch_d["uniform_all"] = batch_np["uniform_all"]
+        else:
+            batch_d, inv_d = jax.device_put((batch_np, inv))
         new_state, result = run(state, batch_d, inv_d, np.uint32(self._cycle))
         self._cycle += len(infos)
         return dict(
@@ -1938,14 +1982,20 @@ class TPUScheduler:
                 m.e2e_latency_samples.append(lat)
                 m.registry.scheduling_sli.observe(lat)
         # Diagnosis from the device's per-op fail bitmask (bit order =
-        # filter_op_names): which plugins rejected nodes this cycle.
+        # filter_op_names): which plugins rejected nodes this cycle.  A
+        # uniform failing batch (5k no-fit pods, the Unschedulable shape)
+        # produces ONE distinct mask — build each mask's plugin set once.
         bit_names = filter_op_names(profile, active)
+        mask_sets: dict[int, set] = {}
         failed2 = []
         for i, qp, _ in failed:
             mask = int(fails[i])
-            plugins = {
-                name for b, name in enumerate(bit_names) if mask & (1 << b)
-            }
+            plugins = mask_sets.get(mask)
+            if plugins is None:
+                plugins = {
+                    name for b, name in enumerate(bit_names) if mask & (1 << b)
+                }
+                mask_sets[mask] = plugins
             diag = Diagnosis(unschedulable_plugins=plugins)
             outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]), diagnosis=diag)
             m.unschedulable += 1

@@ -1,0 +1,88 @@
+"""Phase-level wall timing of the preemption_async measured batch."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubernetes_tpu.benchmarks.harness import WORKLOADS
+import kubernetes_tpu.scheduler as S
+import kubernetes_tpu.preemption as P
+from kubernetes_tpu import utils
+
+TIMES = {}
+
+import jax
+
+def build():
+    s = w.build()
+    w.nodes(s)
+    w.warmup(s)
+    s.schedule_all_pending(wait_backoff=True)
+    s.warm_tail()
+    return s
+
+w = WORKLOADS["preemption_async_5kn"]
+
+
+def wrap(obj, name, label):
+    orig = getattr(obj, name)
+
+    def inner(*a, **k):
+        t0 = time.perf_counter()
+        out = orig(*a, **k)
+        TIMES[label] = TIMES.get(label, 0.0) + time.perf_counter() - t0
+        return out
+
+    setattr(obj, name, inner)
+
+
+wrap(S.TPUScheduler, "_featurize_batch", "featurize")
+wrap(P.PreemptionEvaluator, "pack_victims", "pack_victims")
+wrap(P.PreemptionEvaluator, "dispatch_speculative", "dispatch_spec")
+wrap(P.PreemptionEvaluator, "collect_speculative", "collect_spec")
+wrap(S.TPUScheduler, "_commit_preempted", "commit_preempted")
+wrap(S.TPUScheduler, "_dispatch_batch", "dispatch_total")
+def split_fetch(mod, label):
+    def inner(tree):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tree)
+        t1 = time.perf_counter()
+        out = utils.device_fetch.__wrapped__(tree) if hasattr(utils.device_fetch, '__wrapped__') else _orig_fetch(tree)
+        t2 = time.perf_counter()
+        TIMES[label + ".wait"] = TIMES.get(label + ".wait", 0.0) + t1 - t0
+        TIMES[label + ".xfer"] = TIMES.get(label + ".xfer", 0.0) + t2 - t1
+        return out
+    setattr(mod, "device_fetch", inner)
+
+_orig_fetch = utils.device_fetch
+split_fetch(S, "fetch_sched")
+split_fetch(P, "fetch_preempt")
+
+for trial in range(3):
+    s = build()
+    TIMES.clear()
+    for i in range(1000):
+        from kubernetes_tpu.api.wrappers import make_pod
+
+        s.add_pod(
+            make_pod(f"vip-t{trial}-{i}").req({"cpu": "2", "memory": "4Gi"})
+            .priority(1000).obj()
+        )
+    t0 = time.perf_counter()
+    scheduled = 0
+    while scheduled < 1000:
+        out = s.schedule_batch()
+        if not out:
+            if len(s.queue) or s._prefetched is not None:
+                continue
+            if s.queue.sleep_until_backoff():
+                continue
+            break
+        scheduled += sum(1 for o in out if o.node_name)
+    dt = time.perf_counter() - t0
+    print(f"trial {trial}: scheduled={scheduled} wall={dt:.3f}s "
+          f"rate={scheduled/dt:.0f}/s x={scheduled/dt/200:.1f}")
+    for k, v in sorted(TIMES.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:22s} {v:.3f}s")

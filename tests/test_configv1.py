@@ -595,7 +595,7 @@ def test_profile_without_scheduling_gates_ignores_gates():
         make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
     )
     gated = make_pod("g").req({"cpu": "1"}).obj()
-    gated.spec.scheduling_gates = ("wait",)
+    gated.spec.scheduling_gates = (t.PodSchedulingGate("wait"),)
     sched.add_pod(gated)
     sched.schedule_batch()
     # Without the SchedulingGates plugin the gate field is inert.

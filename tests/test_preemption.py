@@ -629,3 +629,45 @@ def test_external_claim_release_not_doubled():
     vip = [o for o in out if o.pod.name == "vip"]
     assert all(o.node_name is None and not o.nominated_node for o in vip), out
     assert "default/holder" in s.cache.pods  # nobody evicted
+
+
+def test_incremental_repack_survives_slot_width_growth():
+    # Regression (r5 review): the incremental victim-staging cache must
+    # rebuild the mega-buffer when a dirty victim widens a per-victim slot
+    # dim (here: device volumes 1 → 2) — the scatter path would otherwise
+    # write a 2-wide slice into the staged 1-wide column span (crash), or
+    # silently corrupt adjacent columns.
+    s = sched(profile=_vol_profile())
+    s.add_node(make_node("n1").capacity({"cpu": "64", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "64", "pods": 110}).obj())
+    # n2 is pinned shut by an unevictable filler so every vip must fight
+    # for n1.
+    s.add_pod(
+        make_pod("filler").req({"cpu": "64"}).priority(1000).node("n2").obj()
+    )
+    s.add_pod(
+        make_pod("holder").req({"cpu": "1"}).priority(1)
+        .device_volume("disk-1").node("n1").obj()
+    )
+    # First preemption stages the pack with 1-wide device-volume slots.
+    s.add_pod(
+        make_pod("vip1").req({"cpu": "1"}).priority(100)
+        .device_volume("disk-1").obj()
+    )
+    s.schedule_all_pending(wait_backoff=True)
+    assert "default/holder" not in s.cache.pods
+    # A new victim with TWO device volumes dirties n1 and widens the slot
+    # dim; the repack must take the full-rebuild branch.
+    s.add_pod(
+        make_pod("holder2").req({"cpu": "1"}).priority(1)
+        .device_volume("disk-2").device_volume("disk-3").node("n1").obj()
+    )
+    s.add_pod(
+        make_pod("vip2").req({"cpu": "1"}).priority(100)
+        .device_volume("disk-2").obj()
+    )
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip2 = [o for o in out if o.pod.name == "vip2" and o.node_name]
+    assert vip2 and vip2[0].node_name == "n1"
+    assert "default/holder2" not in s.cache.pods
+    assert "default/vip1" in s.cache.pods  # reprieved bystander
