@@ -1143,18 +1143,21 @@ class PreemptionEvaluator:
         full pack (idx = all rows) and the incremental dirty-row scatter
         (a divergence here would corrupt victim tensors on exactly one of
         the two paths)."""
+        # ``idx`` may be slice(None) (full pack — plain slice writes, no
+        # fancy-index temporaries) or an int row array (incremental).
+        nrows = buf.shape[0] if isinstance(idx, slice) else len(idx)
         vic_req = A["vic_req"]
         buf[idx, :, 0] = A["vic_prio"][idx]
         buf[idx, :, 1 : 1 + r] = vic_req[idx]
         buf[idx, :, 1 + r : 3 + r] = A["vic_nonzero"][idx]
         buf[idx, :, 3 + r] = A["vic_start"][idx].view(np.int64)
         pdb_words = max(1, (n_pdbs + 63) // 64)
-        # Accumulate each word OFF-buffer, then one fancy-index assignment:
+        # Accumulate each word OFF-buffer, then one assignment:
         # ``out=buf[idx, ...]`` would write into the copy a fancy index
         # returns, silently dropping every PDB bit.
         vic_pdb = A["vic_pdb"]
         for w_i in range(pdb_words):
-            word = np.zeros((len(idx), buf.shape[1]), np.int64)
+            word = np.zeros((nrows, buf.shape[1]), np.int64)
             for i in range(w_i * 64, min((w_i + 1) * 64, n_pdbs)):
                 word |= vic_pdb[idx, :, i].astype(np.int64) << (i % 64)
             buf[idx, :, 4 + r + w_i] = word
@@ -1202,7 +1205,7 @@ class PreemptionEvaluator:
         # One extra FINAL column carries pdb_allowed (written below) —
         # allocated upfront so nothing re-copies the multi-MB buffer.
         buf = np.zeros((n, vu, k_cols + 1), np.int64)
-        self._pack_buf_rows(A, buf, np.arange(n), r, n_pdbs)
+        self._pack_buf_rows(A, buf, slice(None), r, n_pdbs)
         # pdb_allowed rides in the DEDICATED final column, one value per
         # node row (buf[i, 0, -1] = allowed[i]) — no extra round trip.
         # Only possible while n_pdbs ≤ N; beyond that (more PDBs than node

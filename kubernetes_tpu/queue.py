@@ -138,9 +138,6 @@ PLUGIN_HINTS = {
     "NodeResourcesFit": _fit_hint,
 }
 
-_MISS = object()  # verdict-cache sentinel (None is not a verdict)
-
-
 @dataclass(order=False)
 class QueuedPodInfo:
     """Mirror of framework.QueuedPodInfo (types.go:362)."""

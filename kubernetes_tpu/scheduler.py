@@ -335,6 +335,20 @@ class TPUScheduler:
         )
         # All-invalid batch: commits nothing; discard the (identical) state.
         strict(state, sub, inv, np.uint32(0))
+        # Uniform-batch broadcast program (_expand_uniform): template
+        # workloads' first uniform batch would otherwise pay this XLA
+        # compile mid-window (warmup batches with per-pod labels never
+        # take the uniform path).
+        kfull = next(iter(shapes.values()))[0][0]
+        small = {
+            k: np.zeros((1,) + shape[1:], dtype)
+            for k, (shape, dtype) in shapes.items()
+            if k not in ("valid", "nominated_row", "pin_row")
+        }
+        _expand_uniform(
+            small, np.zeros(kfull, np.bool_), np.full(kfull, -1, np.int32),
+            kfull,
+        )
 
     # -- cluster events (the informer surface, eventhandlers.go:341) ---------
 
