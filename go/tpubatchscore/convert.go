@@ -81,6 +81,9 @@ type jToleration struct {
 	Effect   string `json:"effect"`
 	Key      string `json:"key"`
 	Operator string `json:"operator"`
+	// nil encodes as null (the sidecar's canonical dump of an unset
+	// TolerationSeconds); seconds as float64 like status.start_time.
+	TolerationSeconds *float64 `json:"toleration_seconds"`
 	Value    string `json:"value"`
 }
 
@@ -346,10 +349,15 @@ func ConvertPod(pod *v1.Pod) ([]byte, error) {
 		j.Status.StartTime = float64(pod.Status.StartTime.Unix())
 	}
 	for _, t := range pod.Spec.Tolerations {
-		j.Spec.Tolerations = append(j.Spec.Tolerations, jToleration{
+		jt := jToleration{
 			Key: t.Key, Operator: string(t.Operator), Value: t.Value,
 			Effect: string(t.Effect),
-		})
+		}
+		if t.TolerationSeconds != nil {
+			secs := float64(*t.TolerationSeconds)
+			jt.TolerationSeconds = &secs
+		}
+		j.Spec.Tolerations = append(j.Spec.Tolerations, jt)
 	}
 	for _, c := range pod.Spec.TopologySpreadConstraints {
 		sc := jSpreadConstraint{

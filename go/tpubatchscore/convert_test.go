@@ -20,6 +20,8 @@ import (
 	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
 )
 
+func int64Ptr(v int64) *int64 { return &v }
+
 // normalize collapses JSON-decoded trees for structural comparison:
 // nulls and empty containers are equivalent (the sidecar's from_json
 // defaults them), numbers compare as float64.
@@ -102,6 +104,9 @@ func TestConvertPodMatchesGolden(t *testing.T) {
 			Tolerations: []v1.Toleration{{
 				Key: "dedicated", Operator: v1.TolerationOpEqual,
 				Value: "gpu", Effect: v1.TaintEffectNoSchedule,
+			}, {
+				Key: "maintenance", Operator: v1.TolerationOpExists,
+				Effect: v1.TaintEffectNoExecute, TolerationSeconds: int64Ptr(300),
 			}},
 			Affinity: &v1.Affinity{
 				PodAntiAffinity: &v1.PodAntiAffinity{

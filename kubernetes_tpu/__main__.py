@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .framework.config import DEFAULT_PROFILE, Profile, ScoringStrategy, validate_profile
@@ -146,6 +147,23 @@ def cmd_serve(args) -> int:
         )
     else:
         sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)
+    lease = None
+    if args.leader_elect:
+        # Single-active-sidecar guarantee (cmd-level leaderElectAndRun,
+        # app/server.go:140): standbys park here until the incumbent
+        # releases or dies, then take over the socket.
+        from .framework.leaderelection import FileLease
+
+        lease = FileLease(args.lease_file, identity=f"serve-{os.getpid()}")
+        holder = lease.holder()
+        if not lease.acquire(block=False):
+            print(
+                f"waiting for lease {args.lease_file}"
+                + (f" held by {holder.get('holderIdentity')}" if holder else ""),
+                flush=True,
+            )
+            lease.acquire(block=True)
+        print(f"acquired lease {args.lease_file}", flush=True)
     srv = SidecarServer(
         args.socket,
         scheduler=sched,
@@ -154,6 +172,9 @@ def cmd_serve(args) -> int:
         # (the Go side reads with a 60s deadline); meaningless without
         # the push stream.
         keepalive_s=args.keepalive if args.speculate else None,
+        health_extra=(
+            {"leader": True, "leaseFile": args.lease_file} if lease else {}
+        ),
     )
     print(
         f"sidecar listening on {args.socket}"
@@ -164,6 +185,9 @@ def cmd_serve(args) -> int:
         srv.serve_forever()
     except KeyboardInterrupt:
         srv.close()
+    finally:
+        if lease is not None:
+            lease.release()
     return 0
 
 
@@ -219,6 +243,14 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument(
         "--keepalive", type=float, default=10.0,
         help="push-stream keepalive interval in seconds (speculate only)",
+    )
+    s.add_argument(
+        "--leader-elect", action="store_true",
+        help="park until the lease file's flock is free (single active sidecar)",
+    )
+    s.add_argument(
+        "--lease-file", default="/tmp/kubernetes_tpu-serve.lease",
+        help="leader-election lease path (see framework/leaderelection.py)",
     )
     s.set_defaults(fn=cmd_serve)
 

@@ -280,6 +280,9 @@ class Toleration:
     operator: str = TOLERATION_OP_EQUAL
     value: str = ""
     effect: str = ""  # empty matches all effects
+    # NoExecute grace (v1.Toleration.TolerationSeconds): None = tolerate
+    # forever; N = the taint-eviction controller evicts after N seconds.
+    toleration_seconds: Optional[float] = None
 
     def tolerates(self, taint: Taint) -> bool:
         """Mirror of v1helper.TolerationsTolerateTaint single-taint check
@@ -762,3 +765,10 @@ class PodDisruptionBudget:
     selector: Optional[LabelSelector] = None
     disruptions_allowed: int = 0
     namespace: str = "default"
+    # Spec fields (policy/v1 PDBSpec): when either is set, the in-process
+    # DisruptionController (controllers.py) recomputes disruptions_allowed
+    # from live pod state; when both are None the field above is the
+    # informer-fed status and stays untouched.  int or "N%" strings
+    # (intstr.IntOrString).
+    min_available: Optional[int | str] = None
+    max_unavailable: Optional[int | str] = None

@@ -74,9 +74,12 @@ class PodWrapper:
         return self
 
     def toleration(
-        self, key: str = "", op: str = t.TOLERATION_OP_EQUAL, value: str = "", effect: str = ""
+        self, key: str = "", op: str = t.TOLERATION_OP_EQUAL, value: str = "",
+        effect: str = "", seconds: float | None = None,
     ) -> "PodWrapper":
-        self._pod.spec.tolerations += (t.Toleration(key, op, value, effect),)
+        self._pod.spec.tolerations += (
+            t.Toleration(key, op, value, effect, toleration_seconds=seconds),
+        )
         return self
 
     def host_port(self, port: int, protocol: str = "TCP", host_ip: str = "") -> "PodWrapper":

@@ -1,0 +1,200 @@
+"""Control loops living beside the scheduler — the kube-controller-manager
+slice the scheduling stack actually depends on (SURVEY §2.4 names the two
+that interact with scheduling: disruption and tainteviction).
+
+DisruptionController: recomputes each PodDisruptionBudget's
+status.disruptionsAllowed from live pod state, the way
+pkg/controller/disruption/disruption.go:732 (trySync → getExpectedPodCount
+→ updatePdbStatus) does, so preemption's budget accounting
+(filterPodsWithPDBViolation, pickOneNodeForPreemption criterion 1) reads a
+status that tracks the cluster rather than a hand-fed constant.
+
+Formula (disruption.go:803 getExpectedPodCount, :993 updatePdbStatus):
+  - maxUnavailable set: desiredHealthy = expected − scale(maxUnavailable,
+    expected, round UP), floored at 0.
+  - minAvailable int: desiredHealthy = minAvailable, expected = len(pods).
+  - minAvailable "N%": desiredHealthy = scale(N%, expected, round UP).
+  - disruptionsAllowed = max(0, currentHealthy − desiredHealthy).
+
+Divergences (documented): expectedCount for percentage/maxUnavailable
+budgets comes from the matching pods' controllers' scale upstream
+(getExpectedScale walks ReplicaSet/Deployment owners); this repo has no
+workload controllers, so expected = len(matching pods) — upstream's own
+unmanaged-pods fallback behavior.  The disrupted-pods map (eviction-API
+in-flight grace, :747 buildDisruptedPodMap) is unnecessary: evictions here
+are synchronous deletes, and the preemption path's immediate decrement
+(preemption.py _interpret_dryrun) models the eviction-time debit the
+reference applies in the eviction subresource handler."""
+
+from __future__ import annotations
+
+import math
+import time
+
+from .api import types as t
+
+
+def scale_int_or_percent(value: int | str, total: int, round_up: bool) -> int:
+    """intstr.GetScaledValueFromIntOrPercent: ints pass through; "N%"
+    scales against ``total`` (disruption.go passes roundUp=true)."""
+    if isinstance(value, int):
+        return value
+    s = value.strip()
+    if not s.endswith("%"):
+        raise ValueError(f"invalid IntOrString {value!r}: not an int or percent")
+    pct = int(s[:-1])
+    scaled = total * pct / 100.0
+    return math.ceil(scaled) if round_up else math.floor(scaled)
+
+
+class DisruptionController:
+    """Recompute disruptionsAllowed for every budget that carries SPEC
+    fields (min_available / max_unavailable).  Spec-less budgets keep
+    their informer-fed status untouched — the wire path feeds
+    status.disruptionsAllowed directly and remains authoritative for
+    them."""
+
+    def __init__(self, scheduler) -> None:
+        self.sched = scheduler
+
+    def _matching(self, pdb: t.PodDisruptionBudget) -> list:
+        cache = self.sched.cache
+        return [
+            pr
+            for pr in cache.pods.values()
+            if pr.pod.namespace == pdb.namespace
+            and t.label_selector_matches(pdb.selector, pr.pod.metadata.labels)
+        ]
+
+    def sync_one(self, pdb: t.PodDisruptionBudget) -> None:
+        if pdb.min_available is None and pdb.max_unavailable is None:
+            return  # status-managed by the informer feed
+        matching = self._matching(pdb)
+        expected = len(matching)
+        # Healthy = running-and-ready (countHealthyPods, :909).  The
+        # scheduling-level analog: a cached pod is bound or assumed onto a
+        # node; queued pods are not healthy.
+        healthy = sum(1 for pr in matching if pr.bound or pr.assumed)
+        if pdb.max_unavailable is not None:
+            mu = scale_int_or_percent(pdb.max_unavailable, expected, True)
+            desired = max(0, expected - mu)
+        elif isinstance(pdb.min_available, int):
+            desired = pdb.min_available
+        else:
+            desired = scale_int_or_percent(pdb.min_available, expected, True)
+        pdb.disruptions_allowed = max(0, healthy - desired)
+
+    def sync(self) -> None:
+        for pdb in self.sched.pdbs.values():
+            self.sync_one(pdb)
+
+
+class TaintEvictionController:
+    """NoExecute taint eviction — pkg/controller/tainteviction/
+    taint_eviction.go:84 (TaintEvictionController; processPodOnNode +
+    getMinTolerationTime semantics):
+
+      - a bound pod on a node with NoExecute taints it does NOT fully
+        tolerate is evicted immediately;
+      - a fully-tolerating pod whose matching tolerations carry
+        tolerationSeconds is evicted after the MINIMUM of those seconds
+        (a nil-seconds toleration alone means tolerate forever);
+      - removing the taints cancels the pending eviction.
+
+    In-process adaptation: upstream's per-pod timed workqueue
+    (TimedWorkerQueue) becomes a deadline map ticked from the scheduler's
+    batch loop (the same time-gated sweep that expires assumed pods);
+    eviction is the scheduler's delete_pod — the API DELETE the upstream
+    controller issues, minus the apiserver."""
+
+    def __init__(self, scheduler) -> None:
+        self.sched = scheduler
+        self.pending: dict[str, float] = {}  # pod uid → eviction deadline
+        self.evictions = 0
+
+    def _no_execute(self, node: t.Node) -> list[t.Taint]:
+        return [
+            taint
+            for taint in node.spec.taints
+            if taint.effect == t.EFFECT_NO_EXECUTE
+        ]
+
+    def handle_node(self, node: t.Node, now: float | None = None) -> None:
+        """Re-evaluate every pod on the node after a taint change
+        (handleNodeUpdate, taint_eviction.go:331)."""
+        rec = self.sched.cache.nodes.get(node.name)
+        if rec is None:
+            return
+        taints = self._no_execute(node)
+        now = time.monotonic() if now is None else now
+        if not taints:
+            # Taints gone: cancel pending evictions for this node's pods
+            # (cancelWorkWithEvent).
+            for uid in list(self.pending):
+                pr = self.sched.cache.pods.get(uid)
+                if pr is None or pr.node_name == node.name:
+                    self.pending.pop(uid, None)
+            return
+        for uid, pod in list(rec.pods.items()):
+            self.evaluate(uid, pod, taints, now)
+
+    def handle_pod_assigned(self, pod: t.Pod, node_name: str) -> None:
+        """A pod landed on (or arrived bound to) a node: if that node
+        carries NoExecute taints, judge the pod (handlePodUpdate,
+        taint_eviction.go:366)."""
+        rec = self.sched.cache.nodes.get(node_name)
+        if rec is None:
+            return
+        taints = self._no_execute(rec.node)
+        if taints:
+            self.evaluate(pod.uid, pod, taints, time.monotonic())
+
+    def evaluate(
+        self, uid: str, pod: t.Pod, taints: list[t.Taint], now: float
+    ) -> None:
+        used: list[t.Toleration] = []
+        for taint in taints:
+            matching = [
+                tol for tol in pod.spec.tolerations if tol.tolerates(taint)
+            ]
+            if not matching:
+                # Not fully tolerated: evict now (processPodOnNode's
+                # len(usedTolerations) < len(taints) branch).
+                self.pending.pop(uid, None)
+                self._evict(uid)
+                return
+            used.extend(matching)
+        # getMinTolerationTime: min over the used tolerations that SET
+        # seconds; none set = tolerate forever.
+        secs = [
+            tol.toleration_seconds
+            for tol in used
+            if tol.toleration_seconds is not None
+        ]
+        if not secs:
+            self.pending.pop(uid, None)
+            return
+        # Keep an existing (earlier) deadline: re-evaluation on unrelated
+        # taint churn must not re-arm the timer from `now` — upstream
+        # keeps the scheduled eviction when its start time is unchanged
+        # (processPodOnNode's scheduledEviction.CreatedAt check); a
+        # re-evaluation may only TIGHTEN the deadline (a new taint with a
+        # shorter toleration).  A full taint removal cleared pending, so
+        # a later re-taint starts a fresh clock.
+        deadline = now + max(0.0, min(secs))
+        prev = self.pending.get(uid)
+        self.pending[uid] = deadline if prev is None else min(prev, deadline)
+
+    def tick(self, now: float | None = None) -> int:
+        """Fire due evictions; returns how many fired."""
+        now = time.monotonic() if now is None else now
+        due = [uid for uid, dl in self.pending.items() if dl <= now]
+        for uid in due:
+            self.pending.pop(uid, None)
+            self._evict(uid)
+        return len(due)
+
+    def _evict(self, uid: str) -> None:
+        if uid in self.sched.cache.pods:
+            self.evictions += 1
+            self.sched.delete_pod(uid)

@@ -1,0 +1,100 @@
+"""Leader election for the sidecar process — the single-active-scheduler
+guarantee kube-scheduler gets from client-go's lease machinery.
+
+Reference: cmd/kube-scheduler/app/server.go:140–170 (leaderElectAndRun
+wraps the scheduler loop in leaderelection.RunOrDie over a Lease object)
+and staging/src/k8s.io/client-go/tools/leaderelection/leaderelection.go
+(acquire → renew loop → OnStartedLeading/OnStoppedLeading).
+
+TPU-host adaptation: the reference's Lease object lives in the apiserver
+because candidates run on different machines.  The sidecar's candidates
+share a HOST (they guard one device/socket), so the lease is a kernel
+advisory lock on a file — `flock(LOCK_EX)`.  That replaces the reference's
+renew-deadline/clock-skew machinery with a strictly stronger primitive:
+the kernel releases the lock the instant the holder dies (crash failover
+with zero staleness window, where upstream waits out leaseDuration), and
+"renewal" is implicit in holding the fd.  What is kept: blocking acquire
+(standbys park until the incumbent goes), an identity record for
+observability (the Lease's holderIdentity field), and release on clean
+shutdown (leaderelection.go:295 releases the lease so successors need not
+wait out the duration).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+
+
+class FileLease:
+    """An exclusive host-local lease: whoever holds the flock is leader.
+
+    The lock file persists across holders (unlinking would race a standby
+    that already opened the old inode); the JSON body names the current
+    holder for operators, like `kubectl get lease -o yaml` shows
+    holderIdentity."""
+
+    def __init__(self, path: str, identity: str | None = None) -> None:
+        self.path = path
+        self.identity = identity or f"pid-{os.getpid()}"
+        self._fd: int | None = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self, block: bool = True) -> bool:
+        """Take the lease; with ``block`` park until the incumbent releases
+        or dies (the standby pattern, leaderelection.go:245 acquire loop).
+        Returns False only in non-blocking mode with a live incumbent."""
+        if self._fd is not None:
+            return True
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | (0 if block else fcntl.LOCK_NB))
+        except OSError:
+            os.close(fd)
+            return False
+        # Record the holder AFTER winning (the loser must not clobber the
+        # incumbent's record).
+        os.ftruncate(fd, 0)
+        os.pwrite(
+            fd,
+            json.dumps(
+                {"holderIdentity": self.identity, "pid": os.getpid(),
+                 "acquiredAt": time.time()}
+            ).encode(),
+            0,
+        )
+        self._fd = fd
+        return True
+
+    def holder(self) -> dict | None:
+        """The recorded holder (observability only — the flock, not this
+        record, is the source of truth; a crashed holder's record lingers
+        until the next acquire overwrites it)."""
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+            return json.loads(raw) if raw else None
+        except (OSError, ValueError):
+            return None
+
+    def release(self) -> None:
+        """Clean handoff (leaderelection.go:295 ReleaseOnCancel): drop the
+        record, then the lock, so a standby wakes immediately."""
+        if self._fd is None:
+            return
+        os.ftruncate(self._fd, 0)
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        self._fd = None
+
+    def __enter__(self) -> "FileLease":
+        self.acquire(block=True)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

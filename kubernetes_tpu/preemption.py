@@ -741,6 +741,12 @@ class PreemptionEvaluator:
         # filterPodsWithPDBViolation + the reprieve loop), so the minimal
         # fitting prefix prefers non-violating victims.
         pdbs = list(getattr(sched, "pdbs", {}).values())
+        # Spec-carrying budgets track live pod state (the disruption
+        # controller's reconcile, disruption.go:732): recompute before the
+        # pack classifies violating victims against disruptionsAllowed.
+        dc = getattr(sched, "disruption_controller", None)
+        if dc is not None and pdbs:
+            dc.sync()  # sync_one no-ops for spec-less (informer-fed) budgets
         n_pdbs = _bucket(len(pdbs), 1)
 
         def matched_pdbs(p: t.Pod) -> list[int]:

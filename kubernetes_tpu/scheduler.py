@@ -217,6 +217,10 @@ class TPUScheduler:
         # PodDisruptionBudgets (preemption criterion 1, the disruption
         # controller's state in-process).
         self.pdbs: dict[str, t.PodDisruptionBudget] = {}
+        from .controllers import DisruptionController, TaintEvictionController
+
+        self.disruption_controller = DisruptionController(self)
+        self.taint_eviction = TaintEvictionController(self)
         # Nominator (backend/queue/nominator.go): preemptors' claims on
         # their freed nodes — uid → (node name, row delta, priority).  The
         # fit filter counts these on their nodes so a same/next-batch pod
@@ -404,6 +408,9 @@ class TPUScheduler:
         ev = Event(0)
         if old_node.spec.taints != node.spec.taints:
             ev |= Event.NODE_TAINT
+            # NoExecute eviction judges the node's pods on a taint change
+            # (tainteviction handleNodeUpdate).
+            self.taint_eviction.handle_node(node)
         if old_node.metadata.labels != node.metadata.labels:
             ev |= Event.NODE_LABEL
         if (
@@ -468,6 +475,9 @@ class TPUScheduler:
                 self.gang_bound[pod.spec.pod_group] = (
                     self.gang_bound.get(pod.spec.pod_group, 0) + 1
                 )
+            # A pod arriving bound to a NoExecute-tainted node is judged
+            # immediately (tainteviction handlePodUpdate).
+            self.taint_eviction.handle_pod_assigned(pod, pod.spec.node_name)
             self.queue.on_event(Event.POD_ADD)
         else:
             self.queue.add(pod)
@@ -626,8 +636,11 @@ class TPUScheduler:
 
     def add_pdb(self, pdb: t.PodDisruptionBudget) -> None:
         """PodDisruptionBudget informer: preemption counts victims against
-        these budgets (pickOneNodeForPreemption criterion 1)."""
+        these budgets (pickOneNodeForPreemption criterion 1).  Budgets
+        carrying SPEC fields get their status recomputed from live pod
+        state by the disruption controller (controllers.py)."""
         self.pdbs[pdb.name] = pdb
+        self.disruption_controller.sync_one(pdb)
 
     def _debit_gang(self, group: str) -> None:
         left = self.gang_bound.get(group, 0) - 1
@@ -853,6 +866,7 @@ class TPUScheduler:
         self.cache.assume_pod(
             qp.pod, res.node_name, device_already=False, delta=delta
         )
+        self.taint_eviction.handle_pod_assigned(qp.pod, res.node_name)
         # A live nomination from an earlier nominate-path round is spent
         # now (the placed path pops it on assume; a bound pod would leak
         # the claim forever otherwise).
@@ -1269,6 +1283,8 @@ class TPUScheduler:
             # Permit-room waiters are assumed deliberately (gang quorum) and
             # expire through expire_waiting_gangs, not the TTL.
             self._next_assumed_sweep = now + 1.0
+            if self.taint_eviction.pending:
+                self.taint_eviction.tick(now)
             waiting = {
                 e[0].pod.uid
                 for entries in self.permit_waiting.values()
@@ -1946,6 +1962,10 @@ class TPUScheduler:
                 continue
             qp.pod.spec.node_name = node_name
             self.cache.finish_binding(qp.pod.uid)
+            # Self-placed pods get their NoExecute judgment at bind (the
+            # reference's handlePodUpdate fires on the binding update) —
+            # a tolerationSeconds toleration starts its clock here.
+            self.taint_eviction.handle_pod_assigned(qp.pod, node_name)
             self.queue.done(qp.pod.uid)
             outcome = ScheduleOutcome(qp.pod, node_name, score, feasn)
             outcomes.append(outcome)

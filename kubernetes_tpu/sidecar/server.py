@@ -67,9 +67,15 @@ class SidecarServer:
         speculate: bool = False,
         lookahead: int | None = None,
         keepalive_s: float | None = None,
+        health_extra: dict | None = None,
         **kw,
     ):
         self.path = path
+        # Extra health-frame fields (e.g. leader-election state from
+        # cmd_serve) merged into every health response.  The handler
+        # closure captures this DICT object — mutate its contents to
+        # change later responses; rebinding the attribute has no effect.
+        self.health_extra = health_extra = health_extra or {}
         self.scheduler = scheduler or TPUScheduler(**kw)
         # Wire deployments hand nominations back to the host (it owns the
         # victims' API deletes); the in-process inline commit would act on
@@ -145,7 +151,8 @@ class SidecarServer:
                     try:
                         with lock:
                             responded = _dispatch(
-                                sched, env, out, front, self.request
+                                sched, env, out, front, self.request,
+                                health_extra,
                             )
                     except Exception as exc:  # surface, don't kill the server
                         out.response.error = f"{type(exc).__name__}: {exc}"
@@ -208,6 +215,7 @@ def _dispatch(
     out: pb.Envelope,
     front=None,
     conn=None,
+    health_extra: dict | None = None,
 ) -> bool:
     """Handle one frame.  Returns True when the response was already
     written inside the dispatch lock (the subscribe handshake — its ack
@@ -263,6 +271,8 @@ def _dispatch(
             "speculation": front is not None,
             "epoch": front.epoch if front is not None else 0,
         }
+        if health_extra:
+            state.update(health_extra)
         out.response.health_json = _json.dumps(state).encode()
         return False
     if kind == "add":

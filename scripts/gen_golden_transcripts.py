@@ -551,6 +551,10 @@ def main():
         .label("app", "web")
         .priority(7)
         .toleration("dedicated", value="gpu", effect=t.EFFECT_NO_SCHEDULE)
+        .toleration(
+            "maintenance", op=t.TOLERATION_OP_EXISTS,
+            effect=t.EFFECT_NO_EXECUTE, seconds=300,
+        )
         .host_port(8080)
         .pod_anti_affinity_in("app", ["web"], "topology.kubernetes.io/zone")
         .spread_constraint(

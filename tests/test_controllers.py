@@ -1,0 +1,298 @@
+"""DisruptionController — the PDB-status reconcile
+(pkg/controller/disruption/disruption.go:732 trySync; formula at :803
+getExpectedPodCount and :993 updatePdbStatus)."""
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.controllers import scale_int_or_percent
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def sched(batch_size=8):
+    return TPUScheduler(profile=fit_only_profile(), batch_size=batch_size)
+
+
+def _pdb(name, labels, **kw):
+    return t.PodDisruptionBudget(
+        name=name,
+        selector=t.LabelSelector(match_labels=tuple(labels.items())),
+        **kw,
+    )
+
+
+def test_scale_int_or_percent_matches_intstr():
+    # intstr.GetScaledValueFromIntOrPercent semantics.
+    assert scale_int_or_percent(3, 10, True) == 3  # ints pass through
+    assert scale_int_or_percent("50%", 3, True) == 2  # ceil(1.5)
+    assert scale_int_or_percent("50%", 3, False) == 1  # floor(1.5)
+    assert scale_int_or_percent("100%", 7, True) == 7
+    assert scale_int_or_percent("0%", 7, True) == 0
+    with pytest.raises(ValueError):
+        scale_int_or_percent("half", 10, True)
+
+
+def _bind_app_pods(s, n, label=("app", "db")):
+    s.add_node(make_node("n1").capacity({"cpu": "64", "pods": 110}).obj())
+    for i in range(n):
+        s.add_pod(
+            make_pod(f"p{i}").req({"cpu": "1"}).label(*label).node("n1").obj()
+        )
+
+
+def test_min_available_int():
+    s = sched()
+    _bind_app_pods(s, 5)
+    pdb = _pdb("db", {"app": "db"}, min_available=3)
+    s.add_pdb(pdb)
+    # 5 healthy − 3 desired = 2 allowed, computed at add time.
+    assert pdb.disruptions_allowed == 2
+
+
+def test_min_available_percent_rounds_up():
+    s = sched()
+    _bind_app_pods(s, 3)
+    pdb = _pdb("db", {"app": "db"}, min_available="50%")
+    s.add_pdb(pdb)
+    # desired = ceil(3 × 50%) = 2 → allowed = 1.
+    assert pdb.disruptions_allowed == 1
+
+
+def test_max_unavailable():
+    s = sched()
+    _bind_app_pods(s, 4)
+    pdb = _pdb("db", {"app": "db"}, max_unavailable=1)
+    s.add_pdb(pdb)
+    assert pdb.disruptions_allowed == 1
+    pdb2 = _pdb("db2", {"app": "db"}, max_unavailable="50%")
+    s.add_pdb(pdb2)
+    # mu = ceil(4 × 50%) = 2 → desired = 2 → allowed = 2.
+    assert pdb2.disruptions_allowed == 2
+
+
+def test_selector_and_namespace_scope():
+    s = sched()
+    _bind_app_pods(s, 2)
+    s.add_pod(
+        make_pod("other").req({"cpu": "1"}).label("app", "web").node("n1").obj()
+    )
+    pdb = _pdb("db", {"app": "db"}, min_available=1, namespace="prod")
+    s.add_pdb(pdb)
+    assert pdb.disruptions_allowed == 0  # wrong namespace: zero matching
+    pdb2 = _pdb("db2", {"app": "db"}, min_available=1)
+    s.add_pdb(pdb2)
+    assert pdb2.disruptions_allowed == 1  # the web pod doesn't count
+
+
+def test_queued_pods_are_not_healthy():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "2", "pods": 110}).obj())
+    s.add_pod(make_pod("bound").req({"cpu": "1"}).label("app", "db").node("n1").obj())
+    # Queued (never scheduled): matches the selector but is not healthy.
+    s.queue.add(make_pod("pending").req({"cpu": "999"}).label("app", "db").obj())
+    pdb = _pdb("db", {"app": "db"}, min_available=1)
+    s.add_pdb(pdb)
+    assert pdb.disruptions_allowed == 0  # 1 healthy − 1 desired
+
+
+def test_spec_less_pdb_keeps_informer_status():
+    s = sched()
+    _bind_app_pods(s, 5)
+    pdb = _pdb("db", {"app": "db"}, disruptions_allowed=7)
+    s.add_pdb(pdb)
+    assert pdb.disruptions_allowed == 7  # untouched: wire-fed status
+
+
+def test_preemption_honors_controller_computed_budget():
+    # End-to-end: the controller computes allowed=1 for three db victims;
+    # a preemptor needing two evictions must take at most one db pod
+    # without violating — the PDB-violating victim sorts into the
+    # reprieve-first class and the final set violates as little as the
+    # reference would (criterion 1 minimizes violations, it does not
+    # forbid them).
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "4", "pods": 110}).obj())
+    for i in range(3):
+        s.add_pod(
+            make_pod(f"db{i}").req({"cpu": "1"}).priority(1)
+            .label("app", "db").start_time(float(i)).node("n1").obj()
+        )
+    s.add_pod(
+        make_pod("loose").req({"cpu": "1"}).priority(1).node("n1").obj()
+    )
+    pdb = _pdb("db", {"app": "db"}, min_available=2)
+    s.add_pdb(pdb)
+    assert pdb.disruptions_allowed == 1
+    s.add_pod(make_pod("vip").req({"cpu": "2"}).priority(100).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    vip = [o for o in out if o.pod.name == "vip" and o.node_name]
+    assert vip and vip[0].node_name == "n1"
+    evicted = {u.split("/")[-1] for o in out for u in o.victim_uids}
+    # Two evictions needed; the unprotected pod must be among them and at
+    # most one db pod may go (budget 1).
+    assert "loose" in evicted
+    assert len(evicted & {"db0", "db1", "db2"}) <= 1
+    # The eviction debited the budget; a resync from live state agrees
+    # (2 healthy db pods, minAvailable 2 → 0 allowed).
+    s.disruption_controller.sync()
+    assert pdb.disruptions_allowed == 0
+
+
+# ---------------------------------------------------------------------------
+# TaintEvictionController (pkg/controller/tainteviction/taint_eviction.go)
+# ---------------------------------------------------------------------------
+
+
+def _tainted(name, *taints):
+    n = make_node(name).capacity({"cpu": "8", "pods": 110})
+    for key, effect in taints:
+        n = n.taint(key, "true", effect)
+    return n.obj()
+
+
+def test_no_execute_evicts_intolerant_pod():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("victim").req({"cpu": "1"}).node("n1").obj())
+    s.add_pod(
+        make_pod("safe").req({"cpu": "1"})
+        .toleration("maint", op=t.TOLERATION_OP_EXISTS, effect=t.EFFECT_NO_EXECUTE)
+        .node("n1").obj()
+    )
+    s.update_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    assert "default/victim" not in s.cache.pods  # evicted immediately
+    assert "default/safe" in s.cache.pods  # tolerates forever
+    assert s.taint_eviction.evictions == 1
+    assert not s.taint_eviction.pending
+
+
+def test_no_schedule_taint_does_not_evict():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("p").req({"cpu": "1"}).node("n1").obj())
+    s.update_node(_tainted("n1", ("maint", t.EFFECT_NO_SCHEDULE)))
+    assert "default/p" in s.cache.pods
+
+
+def test_toleration_seconds_schedules_delayed_eviction():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("graced").req({"cpu": "1"})
+        .toleration(
+            "maint", op=t.TOLERATION_OP_EXISTS,
+            effect=t.EFFECT_NO_EXECUTE, seconds=30,
+        )
+        .node("n1").obj()
+    )
+    tec = s.taint_eviction
+    tainted = _tainted("n1", ("maint", t.EFFECT_NO_EXECUTE))
+    s.update_node(tainted)
+    uid = "default/graced"
+    assert uid in s.cache.pods and uid in tec.pending
+    # Not due yet.
+    assert tec.tick(tec.pending[uid] - 1.0) == 0
+    assert uid in s.cache.pods
+    # Due: evicted.
+    deadline = tec.pending[uid]
+    assert tec.tick(deadline) == 1
+    assert uid not in s.cache.pods
+
+
+def test_min_toleration_seconds_wins():
+    # Two matching tolerations, 300s and 30s: min wins
+    # (getMinTolerationTime).
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration("maint", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=300)
+        .toleration("", op=t.TOLERATION_OP_EXISTS, seconds=30)
+        .node("n1").obj()
+    )
+    now = 1000.0
+    s.taint_eviction.handle_node(
+        s.cache.nodes["n1"].node, now
+    )  # no taints yet: no-op
+    s.update_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    uid = "default/p"
+    dl = s.taint_eviction.pending[uid]
+    import time as _time
+
+    assert dl - _time.monotonic() < 35  # the 30s toleration bounds it
+
+
+def test_taint_removal_cancels_pending():
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration("maint", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=60)
+        .node("n1").obj()
+    )
+    s.update_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    assert s.taint_eviction.pending
+    s.update_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    assert not s.taint_eviction.pending
+    assert "default/p" in s.cache.pods
+
+
+def test_pod_arriving_bound_to_tainted_node_is_judged():
+    s = sched()
+    s.add_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    s.add_pod(make_pod("late").req({"cpu": "1"}).node("n1").obj())
+    assert "default/late" not in s.cache.pods  # evicted on arrival
+
+
+def test_taint_churn_does_not_rearm_deadline():
+    # Regression (r5 review): unrelated taint changes re-run evaluate();
+    # the pending deadline must not be pushed out from `now` each time
+    # (upstream keeps the scheduled eviction's original start).
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration("maint", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=300)
+        .toleration("extra", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE)
+        .node("n1").obj()
+    )
+    s.update_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    uid = "default/p"
+    first = s.taint_eviction.pending[uid]
+    # A second, tolerated-forever taint appears later: re-evaluation must
+    # keep the original deadline.
+    s.update_node(_tainted(
+        "n1", ("maint", t.EFFECT_NO_EXECUTE), ("extra", t.EFFECT_NO_EXECUTE)
+    ))
+    assert s.taint_eviction.pending[uid] == first
+    # A shorter toleration appearing may only TIGHTEN the deadline.
+    s.taint_eviction.evaluate(
+        uid, s.cache.pods[uid].pod,
+        [t.Taint("maint", "true", t.EFFECT_NO_EXECUTE)], first - 1000.0,
+    )
+    assert s.taint_eviction.pending[uid] < first
+
+
+def test_self_scheduled_pod_gets_no_execute_timer():
+    # Regression (r5 review): a pod THIS scheduler places onto a tainted
+    # node (it tolerates the taint, so the filter admits it) must start
+    # its tolerationSeconds clock at bind, like the reference's
+    # handlePodUpdate on the binding update.
+    s = sched()
+    s.add_node(_tainted("n1", ("maint", t.EFFECT_NO_EXECUTE)))
+    s.add_pod(
+        make_pod("timed").req({"cpu": "1"})
+        .toleration("maint", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=60)
+        .obj()
+    )
+    out = s.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.pod.name == "timed" and o.node_name]
+    assert placed and placed[0].node_name == "n1"
+    assert "default/timed" in s.taint_eviction.pending

@@ -1,0 +1,103 @@
+"""FileLease — the serve-mode leader-election analog
+(cmd/kube-scheduler/app/server.go:140 leaderElectAndRun; client-go
+leaderelection.go acquire/release semantics, with the kernel flock
+standing in for the renew loop)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from kubernetes_tpu.framework.leaderelection import FileLease
+
+
+def test_exclusive_acquire_and_handoff(tmp_path):
+    path = str(tmp_path / "lease")
+    a = FileLease(path, identity="a")
+    b = FileLease(path, identity="b")
+    assert a.acquire(block=False)
+    assert a.held
+    # A live incumbent blocks a non-blocking challenger.
+    assert not b.acquire(block=False)
+    assert not b.held
+    assert a.holder()["holderIdentity"] == "a"
+    # Clean release hands off immediately (ReleaseOnCancel).
+    a.release()
+    assert not a.held
+    assert b.acquire(block=False)
+    assert b.holder()["holderIdentity"] == "b"
+    b.release()
+
+
+def test_reacquire_is_idempotent(tmp_path):
+    lease = FileLease(str(tmp_path / "lease"))
+    assert lease.acquire(block=False)
+    assert lease.acquire(block=False)  # already held: no-op True
+    lease.release()
+    lease.release()  # double release: no-op
+
+
+def test_context_manager(tmp_path):
+    path = str(tmp_path / "lease")
+    with FileLease(path, identity="ctx") as lease:
+        assert lease.held
+        assert not FileLease(path).acquire(block=False)
+    assert FileLease(path).acquire(block=False)
+
+
+def test_crash_failover(tmp_path):
+    """A SIGKILLed holder's lease frees instantly (the flock dies with the
+    process) — the property upstream approximates by waiting out
+    leaseDuration after the holder stops renewing."""
+    path = str(tmp_path / "lease")
+    ready = str(tmp_path / "ready")
+    child = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            f"""
+import time, pathlib
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from kubernetes_tpu.framework.leaderelection import FileLease
+lease = FileLease({path!r}, identity="doomed")
+assert lease.acquire(block=False)
+pathlib.Path({ready!r}).write_text("up")
+time.sleep(60)
+""",
+        ],
+    )
+    try:
+        deadline = time.time() + 30
+        while not os.path.exists(ready):
+            assert time.time() < deadline, "child never acquired"
+            assert child.poll() is None, "child died early"
+            time.sleep(0.05)
+        standby = FileLease(path, identity="successor")
+        assert not standby.acquire(block=False)  # incumbent alive
+        assert standby.holder()["holderIdentity"] == "doomed"
+        child.kill()
+        child.wait(timeout=10)
+        # The kernel released the flock with the process: immediate takeover.
+        deadline = time.time() + 5
+        while not standby.acquire(block=False):
+            assert time.time() < deadline, "lease not freed by holder death"
+            time.sleep(0.02)
+        assert standby.holder()["holderIdentity"] == "successor"
+        standby.release()
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+
+def test_holder_record_tolerates_garbage(tmp_path):
+    path = str(tmp_path / "lease")
+    with open(path, "w") as f:
+        f.write("not-json")
+    lease = FileLease(path, identity="x")
+    assert lease.holder() is None  # unreadable record, not a crash
+    assert lease.acquire(block=False)  # flock ignores the body
+    assert lease.holder()["holderIdentity"] == "x"
+    lease.release()
