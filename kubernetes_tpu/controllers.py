@@ -56,6 +56,7 @@ class DisruptionController:
 
     def __init__(self, scheduler) -> None:
         self.sched = scheduler
+        self._last_sync: tuple | None = None
 
     def _matching(self, pdb: t.PodDisruptionBudget) -> list:
         cache = self.sched.cache
@@ -85,8 +86,18 @@ class DisruptionController:
         pdb.disruptions_allowed = max(0, healthy - desired)
 
     def sync(self) -> None:
+        # Reconcile is event-driven upstream; the in-process analog gates
+        # on the cache's global pod generation — an unchanged pod set (and
+        # unchanged budget count) needs no rescan, so a preemption burst
+        # pays one O(pods × spec-budgets) pass per batch of changes, not
+        # one per attempt.
+        cache = self.sched.cache
+        key = (cache._pods_gen, len(self.sched.pdbs))
+        if key == self._last_sync:
+            return
         for pdb in self.sched.pdbs.values():
             self.sync_one(pdb)
+        self._last_sync = key
 
 
 class TaintEvictionController:
@@ -109,7 +120,12 @@ class TaintEvictionController:
 
     def __init__(self, scheduler) -> None:
         self.sched = scheduler
-        self.pending: dict[str, float] = {}  # pod uid → eviction deadline
+        # pod uid → (armed_at, deadline).  armed_at is the time the FIRST
+        # judgment scheduled the eviction (upstream's
+        # scheduledEviction.CreatedAt); re-evaluations recompute the
+        # deadline from it with the CURRENT taint set, so unrelated taint
+        # churn neither extends nor wrongly keeps a removed taint's grace.
+        self.pending: dict[str, tuple[float, float]] = {}
         self.evictions = 0
 
     def _no_execute(self, node: t.Node) -> list[t.Taint]:
@@ -174,21 +190,22 @@ class TaintEvictionController:
         if not secs:
             self.pending.pop(uid, None)
             return
-        # Keep an existing (earlier) deadline: re-evaluation on unrelated
-        # taint churn must not re-arm the timer from `now` — upstream
-        # keeps the scheduled eviction when its start time is unchanged
-        # (processPodOnNode's scheduledEviction.CreatedAt check); a
-        # re-evaluation may only TIGHTEN the deadline (a new taint with a
-        # shorter toleration).  A full taint removal cleared pending, so
+        # Deadline = armed_at + min(current graces): the clock starts at
+        # the FIRST judgment (processPodOnNode keeps
+        # scheduledEviction.CreatedAt across re-evaluations, so unrelated
+        # taint churn cannot push the eviction out), while the grace is
+        # recomputed against the CURRENT taint set (removing the
+        # short-grace taint while a longer-tolerated one remains restores
+        # the longer deadline).  A full taint removal cleared pending, so
         # a later re-taint starts a fresh clock.
-        deadline = now + max(0.0, min(secs))
         prev = self.pending.get(uid)
-        self.pending[uid] = deadline if prev is None else min(prev, deadline)
+        armed_at = prev[0] if prev is not None else now
+        self.pending[uid] = (armed_at, armed_at + max(0.0, min(secs)))
 
     def tick(self, now: float | None = None) -> int:
         """Fire due evictions; returns how many fired."""
         now = time.monotonic() if now is None else now
-        due = [uid for uid, dl in self.pending.items() if dl <= now]
+        due = [uid for uid, (_, dl) in self.pending.items() if dl <= now]
         for uid in due:
             self.pending.pop(uid, None)
             self._evict(uid)

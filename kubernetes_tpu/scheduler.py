@@ -606,6 +606,10 @@ class TPUScheduler:
                 if d["qp"].pod.uid != uid
             ]
         self.nominator.pop(uid, None)
+        # A deleted pod's pending NoExecute eviction dies with it — a
+        # re-created pod with the same namespace/name must not inherit
+        # the old deadline.
+        self.taint_eviction.pending.pop(uid, None)
         # DRA: drop the pod's claim reservations; claims nobody reserves
         # deallocate (the resourceclaim controller's cleanup).  Externally-
         # charged claims discharge their phantom row reservation here.
@@ -866,7 +870,6 @@ class TPUScheduler:
         self.cache.assume_pod(
             qp.pod, res.node_name, device_already=False, delta=delta
         )
-        self.taint_eviction.handle_pod_assigned(qp.pod, res.node_name)
         # A live nomination from an earlier nominate-path round is spent
         # now (the placed path pops it on assume; a bound pod would leak
         # the claim forever otherwise).
@@ -875,6 +878,9 @@ class TPUScheduler:
         qp.pod.status.nominated_node_name = ""
         self.cache.finish_binding(qp.pod.uid)
         self.queue.done(qp.pod.uid)
+        # NoExecute judgment at bind, after the binding bookkeeping (an
+        # immediate eviction deletes the cache entry).
+        self.taint_eviction.handle_pod_assigned(qp.pod, res.node_name)
         outcome.node_name = res.node_name
         outcome.nominated_node = res.node_name
         outcome.victims = len(res.victims)
@@ -976,6 +982,7 @@ class TPUScheduler:
         m = self.metrics
         qp.pod.spec.node_name = entry["node"]
         self.cache.finish_binding(qp.pod.uid)
+        self.taint_eviction.handle_pod_assigned(qp.pod, entry["node"])
         if qp.pod.spec.pod_group:
             self.gang_bound[qp.pod.spec.pod_group] = (
                 self.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
@@ -1222,6 +1229,7 @@ class TPUScheduler:
             return _fail_bind(undos)
         qp.pod.spec.node_name = best
         self.cache.finish_binding(qp.pod.uid)
+        self.taint_eviction.handle_pod_assigned(qp.pod, best)
         self.queue.done(qp.pod.uid)
         if m.scheduled == 0:
             m.first_scheduled_ts = now

@@ -1,0 +1,53 @@
+"""Merge two isolated sweep recordings into BENCH_SWEEP_r05.jsonl:
+per row the better draw, with the other sweep's value and any solo
+re-runs disclosed beside it (the r4 recording format)."""
+
+import json
+import sys
+
+
+def load(path):
+    rows = {}
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        d = json.loads(line)
+        if "name" in d and "error" not in d:
+            rows[d["name"]] = d
+    return rows
+
+
+def main(path_a, path_b, out, note, solo_path=None):
+    a, b = load(path_a), load(path_b)
+    solo = {}
+    if solo_path:
+        for line in open(solo_path):
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            solo.setdefault(d["name"], []).append(d["vs_baseline"])
+    names = list(dict.fromkeys(list(a) + list(b)))
+    with open(out, "w") as f:
+        f.write(json.dumps({"note": note}) + "\n")
+        for name in names:
+            ra, rb = a.get(name), b.get(name)
+            va = ra.get("vs_baseline") if ra else None
+            vb = rb.get("vs_baseline") if rb else None
+            if ra is None or (rb is not None and (vb or 0) > (va or 0)):
+                best, other, tag = rb, va, "B"
+            else:
+                best, other, tag = ra, vb, "A"
+            row = dict(best)
+            row["sweep"] = tag
+            if other is not None:
+                row["other_sweep_vs_baseline"] = other
+            if name in solo:
+                row["solo_reruns_vs_baseline"] = solo[name]
+            f.write(json.dumps(row) + "\n")
+    print(f"wrote {out}: {len(names)} rows")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
