@@ -1,0 +1,157 @@
+"""Reflector — the client-go list/watch/resync slice
+(tools/cache/reflector.go ListAndWatch; shared_informer.go resync;
+DeltaFIFO Replace semantics for relists)."""
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.informers import FakeSource, Reflector
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def sched():
+    return TPUScheduler(profile=fit_only_profile(), batch_size=8)
+
+
+def _node(name, cpu="8"):
+    return make_node(name).capacity({"cpu": cpu, "pods": 110}).obj()
+
+
+def test_list_then_watch_feeds_scheduler():
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    nodes = Reflector(s, "Node", src.lister, src.watcher)
+    pods = None
+    assert nodes.step() == 1  # initial LIST
+    assert "n1" in s.cache.nodes
+    # Watch events resume from the established version.
+    src.add("n2", _node("n2"))
+    psrc = FakeSource()
+    pods = Reflector(s, "Pod", psrc.lister, psrc.watcher)
+    pods.step()
+    psrc.add("default/p1", make_pod("p1").req({"cpu": "1"}).obj())
+    assert nodes.step() == 1 and "n2" in s.cache.nodes
+    assert pods.step() == 1
+    out = s.schedule_all_pending()
+    assert [(o.pod.name, bool(o.node_name)) for o in out] == [("p1", True)]
+
+
+def test_watch_delete_and_update_route_correctly():
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    r = Reflector(s, "Node", src.lister, src.watcher)
+    r.step()
+    psrc = FakeSource()
+    pr = Reflector(s, "Pod", psrc.lister, psrc.watcher)
+    pr.step()
+    bound = make_pod("p1").req({"cpu": "1"}).node("n1").obj()
+    psrc.add("default/p1", bound)
+    pr.step()
+    assert "default/p1" in s.cache.pods
+    psrc.delete("default/p1")
+    pr.step()
+    assert "default/p1" not in s.cache.pods
+    # Node update flows through the diffing update path.
+    src.update("n1", _node("n1", cpu="16"))
+    r.step()
+    assert s.cache.nodes["n1"].node.status.allocatable["cpu"] > 0
+
+
+def test_stale_watch_relists_and_repairs_missed_delete():
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    src.add("n2", _node("n2"))
+    r = Reflector(s, "Node", src.lister, src.watcher)
+    r.step()
+    assert set(s.cache.nodes) == {"n1", "n2"}
+    # The watch gap: n2 deleted and history compacted — the resume point
+    # is gone, so the next step relists and the REPLACE repairs the
+    # missed delete.
+    src.delete("n2")
+    src.add("n3", _node("n3"))
+    src.compact()
+    r.step()
+    assert r.relists == 1
+    assert set(s.cache.nodes) == {"n1", "n3"}
+
+
+def test_list_replace_deletes_vanished_pods():
+    s = sched()
+    nsrc = FakeSource()
+    nsrc.add("n1", _node("n1"))
+    Reflector(s, "Node", nsrc.lister, nsrc.watcher).step()
+    psrc = FakeSource()
+    pr = Reflector(s, "Pod", psrc.lister, psrc.watcher)
+    psrc.add("default/gone", make_pod("gone").req({"cpu": "1"}).node("n1").obj())
+    pr.step()  # initial list delivers the bound pod
+    assert "default/gone" in s.cache.pods
+    psrc.delete("default/gone")
+    psrc.compact()
+    pr.step()  # stale → relist → replace issues the delete
+    assert "default/gone" not in s.cache.pods
+
+
+def test_resync_redelivers_as_updates():
+    ticks = [0.0]
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    r = Reflector(
+        s, "Node", src.lister, src.watcher, resync_s=10.0,
+        clock=lambda: ticks[0],
+    )
+    r.step()
+    assert r.step() == 0  # nothing new, timer not due
+    ticks[0] = 11.0
+    assert r.step() == 1  # the stored node re-delivered as an update
+    assert "n1" in s.cache.nodes
+
+
+def test_replace_diffs_against_scheduler_not_just_store():
+    # Regression (r5 review): objects seeded directly on the scheduler
+    # before the Reflector attached are still repaired by LIST-as-replace.
+    s = sched()
+    s.add_node(_node("pre-seeded"))
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    r = Reflector(s, "Node", src.lister, src.watcher)
+    r.step()
+    assert "pre-seeded" not in s.cache.nodes  # absent from the list: deleted
+    assert "n1" in s.cache.nodes
+
+
+def test_step_counts_relist_deliveries():
+    # Regression (r5 review): the relist path returns delivered events,
+    # not the surviving store size — deletes count.
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    src.add("n2", _node("n2"))
+    r = Reflector(s, "Node", src.lister, src.watcher)
+    r.step()
+    src.delete("n1")
+    src.delete("n2")
+    src.compact()
+    assert r.step() == 2  # two DELETED deliveries, store now empty
+    assert not s.cache.nodes
+
+
+def test_relist_restarts_resync_period():
+    # Regression (r5 review): a relist re-delivered everything; the
+    # resync timer restarts so the next step doesn't double-deliver.
+    ticks = [0.0]
+    s = sched()
+    src = FakeSource()
+    src.add("n1", _node("n1"))
+    r = Reflector(s, "Node", src.lister, src.watcher, resync_s=10.0,
+                  clock=lambda: ticks[0])
+    r.step()
+    ticks[0] = 9.9
+    src.compact()
+    src.add("n2", _node("n2"))  # post-compaction event: resume point is gone
+    assert r.step() >= 1  # relist (stale) delivered n2 + survivor update
+    assert r.step() == 0  # timer restarted at 9.9+10: not due at 9.9
+    ticks[0] = 21.0
+    assert r.step() == 2  # resync re-delivers both stored nodes
