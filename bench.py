@@ -32,6 +32,16 @@ def main() -> None:
                     "device_s": r["device_s"],
                     "featurize_s": r["featurize_s"],
                     "batches": r["batches"],
+                    # Per-extension-point latency histograms (p50/p99 +
+                    # overflow) and span stats ride the headline payload so
+                    # the perf trajectory carries them from this PR on.
+                    "extension_points": r["metrics_summary"][
+                        "extension_point_duration_seconds"
+                    ],
+                    "attempt_duration": r["metrics_summary"][
+                        "scheduling_attempt_duration_seconds"
+                    ],
+                    "slow_cycles": r["spans"]["slow_cycles"],
                 },
             }
         )

@@ -144,6 +144,42 @@ func (c *Client) Health() ([]byte, error) {
 	return resp.HealthJSON, nil
 }
 
+// Metrics scrapes the sidecar's registry in Prometheus text exposition
+// format — the host can merge these series into its own /metrics.
+func (c *Client) Metrics() ([]byte, error) {
+	resp, err := c.call(&Envelope{Metrics: &MetricsRequest{}})
+	if err != nil {
+		return nil, err
+	}
+	return resp.MetricsText, nil
+}
+
+// Events reads the sidecar's event-recorder ring (JSON array of
+// aggregated Scheduled/FailedScheduling/Preempted/GangWaiting records).
+func (c *Client) Events() ([]byte, error) {
+	resp, err := c.call(&Envelope{Events: &EventsRequest{}})
+	if err != nil {
+		return nil, err
+	}
+	return resp.EventsJSON, nil
+}
+
+// ScheduleTraced is Schedule with host trace propagation: the sidecar's
+// batch span joins (traceID, parentSpanID) and its own span id is
+// returned alongside the results for the host span to link.
+func (c *Client) ScheduleTraced(
+	podJSON [][]byte, drain bool, traceID, parentSpanID string,
+) ([]PodResult, string, error) {
+	resp, err := c.call(&Envelope{Schedule: &ScheduleBatchRequest{
+		PodJSON: podJSON, Drain: drain,
+		TraceID: traceID, ParentSpanID: parentSpanID,
+	}})
+	if err != nil {
+		return nil, "", err
+	}
+	return resp.Results, resp.SpanID, nil
+}
+
 // Subscribe performs the subscription handshake and hands the raw
 // connection to the caller: after the ack the connection is a ONE-WAY
 // push stream (read with ReadFrame; request methods on it would desync).

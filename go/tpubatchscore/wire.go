@@ -80,6 +80,8 @@ type Envelope struct {
 	Subscribe *SubscribeRequest
 	Push      *Push
 	Health    *HealthRequest
+	Metrics   *MetricsRequest
+	Events    *EventsRequest
 }
 
 type AddObject struct {
@@ -95,6 +97,11 @@ type RemoveObject struct {
 type ScheduleBatchRequest struct {
 	PodJSON [][]byte
 	Drain   bool
+	// Cross-boundary trace propagation: the host span's ids.  The
+	// sidecar's batch span joins this trace and its span id comes back
+	// on Response.SpanID.
+	TraceID      string
+	ParentSpanID string
 }
 
 type DumpRequest struct{}
@@ -105,6 +112,13 @@ type SubscribeRequest struct{}
 
 // HealthRequest probes the sidecar's healthz/readyz analog.
 type HealthRequest struct{}
+
+// MetricsRequest scrapes the sidecar's registry in Prometheus text
+// exposition format (byte-identical to its plain-HTTP /metrics).
+type MetricsRequest struct{}
+
+// EventsRequest reads the sidecar's event-recorder ring as a JSON array.
+type EventsRequest struct{}
 
 // Decision is one pushed speculative verdict (sidecar.proto Decision).
 type Decision struct {
@@ -137,10 +151,13 @@ type PodResult struct {
 }
 
 type Response struct {
-	Error      string
-	Results    []PodResult
-	DumpJSON   []byte
-	HealthJSON []byte
+	Error       string
+	Results     []PodResult
+	DumpJSON    []byte
+	HealthJSON  []byte
+	MetricsText []byte // MetricsRequest: Prometheus text exposition
+	EventsJSON  []byte // EventsRequest: event ring as a JSON array
+	SpanID      string // server-side batch span for traced schedules
 }
 
 // --- marshal ---------------------------------------------------------------
@@ -174,6 +191,12 @@ func (m *ScheduleBatchRequest) marshal() []byte {
 	}
 	if m.Drain {
 		b = appendUintField(b, 2, 1)
+	}
+	if m.TraceID != "" {
+		b = appendStringField(b, 3, m.TraceID)
+	}
+	if m.ParentSpanID != "" {
+		b = appendStringField(b, 4, m.ParentSpanID)
 	}
 	return b
 }
@@ -223,6 +246,15 @@ func (m *Response) marshal() []byte {
 	}
 	if len(m.HealthJSON) > 0 {
 		b = appendBytesField(b, 4, m.HealthJSON)
+	}
+	if len(m.MetricsText) > 0 {
+		b = appendBytesField(b, 5, m.MetricsText)
+	}
+	if len(m.EventsJSON) > 0 {
+		b = appendBytesField(b, 6, m.EventsJSON)
+	}
+	if m.SpanID != "" {
+		b = appendStringField(b, 7, m.SpanID)
 	}
 	return b
 }
@@ -289,6 +321,10 @@ func (m *Envelope) Marshal() []byte {
 		b = appendBytesField(b, 8, m.Push.marshal())
 	case m.Health != nil:
 		b = appendBytesField(b, 9, []byte{})
+	case m.Metrics != nil:
+		b = appendBytesField(b, 10, []byte{})
+	case m.Events != nil:
+		b = appendBytesField(b, 11, []byte{})
 	}
 	return b
 }
@@ -388,6 +424,12 @@ func unmarshalResponse(b []byte) (*Response, error) {
 			r.DumpJSON = append([]byte(nil), f.buf...)
 		case 4:
 			r.HealthJSON = append([]byte(nil), f.buf...)
+		case 5:
+			r.MetricsText = append([]byte(nil), f.buf...)
+		case 6:
+			r.EventsJSON = append([]byte(nil), f.buf...)
+		case 7:
+			r.SpanID = string(f.buf)
 		}
 	}
 	return r, nil
@@ -487,6 +529,10 @@ func unmarshalSchedule(b []byte) (*ScheduleBatchRequest, error) {
 			m.PodJSON = append(m.PodJSON, append([]byte(nil), f.buf...))
 		case 2:
 			m.Drain = f.num != 0
+		case 3:
+			m.TraceID = string(f.buf)
+		case 4:
+			m.ParentSpanID = string(f.buf)
 		}
 	}
 	return m, nil
@@ -520,6 +566,10 @@ func (m *Envelope) Unmarshal(b []byte) error {
 			m.Push, err = unmarshalPush(f.buf)
 		case 9:
 			m.Health = &HealthRequest{}
+		case 10:
+			m.Metrics = &MetricsRequest{}
+		case 11:
+			m.Events = &EventsRequest{}
 		}
 		if err != nil {
 			return err

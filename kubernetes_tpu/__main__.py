@@ -3,8 +3,10 @@
 Subcommands:
   validate <config.json>          strict config validation (apis/config/validation)
   serve --socket PATH [...]       host the engine behind the sidecar protocol
+                                  (--http-port adds /metrics + /healthz + /events)
   bench [workload ...]            the scheduler_perf-style harness
   dump --socket PATH              debugger state dump of a live sidecar
+  metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
 
 Config file format (the KubeSchedulerConfiguration analog, JSON):
   {
@@ -175,10 +177,20 @@ def cmd_serve(args) -> int:
         health_extra=(
             {"leader": True, "leaseFile": args.lease_file} if lease else {}
         ),
+        # Plain-HTTP observability (/metrics, /healthz, /events) for an
+        # unmodified Prometheus; the framed `metrics` frame serves the
+        # same bytes to hosts already on the socket.
+        http_port=args.http_port if args.http_port >= 0 else None,
+        http_host=args.http_host,
     )
     print(
         f"sidecar listening on {args.socket}"
-        + (" (speculative)" if args.speculate else ""),
+        + (" (speculative)" if args.speculate else "")
+        + (
+            f", http observability on :{srv.http.port}"
+            if srv.http is not None
+            else ""
+        ),
         flush=True,
     )
     try:
@@ -216,6 +228,20 @@ def cmd_dump(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Scrape a live sidecar's registry over the socket (the `metrics`
+    frame) — same bytes its /metrics HTTP endpoint serves."""
+    from .sidecar import SidecarClient
+
+    client = SidecarClient(args.socket)
+    if args.events:
+        print(json.dumps(client.events(), indent=2))
+    else:
+        print(client.metrics(), end="")
+    client.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     import logging
 
@@ -245,6 +271,16 @@ def main(argv: list[str] | None = None) -> int:
         help="push-stream keepalive interval in seconds (speculate only)",
     )
     s.add_argument(
+        "--http-port", type=int, default=-1, metavar="PORT",
+        help="serve /metrics + /healthz + /events over plain HTTP "
+        "(0 = ephemeral port, -1 = disabled)",
+    )
+    s.add_argument(
+        "--http-host", default="127.0.0.1", metavar="HOST",
+        help="bind address for the HTTP observability listener "
+        "(0.0.0.0 for off-host Prometheus scrapes)",
+    )
+    s.add_argument(
         "--leader-elect", action="store_true",
         help="park until the lease file's flock is free (single active sidecar)",
     )
@@ -262,6 +298,16 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("dump", help="debugger dump of a live sidecar")
     d.add_argument("--socket", required=True)
     d.set_defaults(fn=cmd_dump)
+
+    mtr = sub.add_parser(
+        "metrics", help="scrape a live sidecar (Prometheus text / events)"
+    )
+    mtr.add_argument("--socket", required=True)
+    mtr.add_argument(
+        "--events", action="store_true",
+        help="print the event-recorder ring as JSON instead of metrics",
+    )
+    mtr.set_defaults(fn=cmd_metrics)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -353,7 +353,12 @@ class PodSpec:
     node_name: str = ""
     scheduler_name: str = "default-scheduler"
     scheduling_gates: tuple[PodSchedulingGate, ...] = ()
-    volumes: tuple["Volume", ...] = ()
+    # NB: no quotes around Volume — the module's lazy annotations resolve
+    # the whole string at get_type_hints time, but a QUOTED name inside a
+    # PEP-585 generic stays a plain str forever (3.10 never converts it
+    # to a ForwardRef), which made the generated dumper emit raw Volume
+    # objects and broke every JSON path that serialized a volume pod.
+    volumes: tuple[Volume, ...] = ()
     # Gang scheduling (coscheduling-style): name of the pod's PodGroup.
     pod_group: str = ""
     # ResourceClaim names in the pod's namespace (spec.resourceClaims).

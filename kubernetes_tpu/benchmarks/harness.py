@@ -63,12 +63,17 @@ def run_workload(w: Workload) -> dict:
     w.warmup(sched)
     sched.schedule_all_pending(wait_backoff=w.wait_backoff)
     sched.warm_tail()
-    # Reset measurement state after warmup compilations.
+    # Reset measurement state after warmup compilations.  The registry
+    # resets IN PLACE (histograms/counters cleared, collectors and event
+    # counter handles kept) so the per-extension-point p50/p99 embedded in
+    # the result cover the measured window only.
     m = sched.metrics
     m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
     m.preemptions = m.deferred = 0
     m.device_time_s = m.featurize_time_s = 0.0
     m.e2e_latency_samples = []
+    m.registry.reset()
+    sched.slow_spans.clear()
 
     expected = w.measured(sched)
     windows: list[tuple[float, int]] = []  # (timestamp, scheduled so far)
@@ -149,7 +154,34 @@ def run_workload(w: Workload) -> dict:
         "batches": m.batches,
         "preemptions": m.preemptions,
         "deferred": m.deferred,
+        # Registry summary over the measured window: per-extension-point
+        # p50/p99, attempt-duration and SLI histograms (with overflow
+        # counts), sampled per-plugin series, and the event counters — the
+        # BENCH_*.json trajectory carries these from this PR onward.
+        "metrics_summary": round_floats(m.registry.summary()),
+        # Span stats: slow-cycle count + the worst recorded span tree
+        # (threshold = sched.trace_threshold_s).
+        "spans": {
+            "slow_cycles": len(sched.slow_spans),
+            "slowest": max(
+                (s for s in sched.slow_spans),
+                key=lambda s: s["duration_ms"],
+                default=None,
+            ),
+        },
     }
+
+
+def round_floats(obj, ndigits: int = 6):
+    """Round every float in a nested summary (raw perf_counter deltas make
+    the JSON lines needlessly long)."""
+    if isinstance(obj, float):
+        return round(obj, ndigits)
+    if isinstance(obj, dict):
+        return {k: round_floats(v, ndigits) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [round_floats(v, ndigits) for v in obj]
+    return obj
 
 
 # --------------------------------------------------------------------------

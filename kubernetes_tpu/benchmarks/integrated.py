@@ -46,6 +46,7 @@ from ..ops.common import registered_subset
 from ..scheduler import TPUScheduler
 from ..sidecar.host import DecisionCache
 from ..sidecar.server import SidecarClient, SidecarServer
+from .harness import round_floats
 
 BASELINE_BASIC_5K = 270.0  # performance-config.yaml:51
 
@@ -110,6 +111,7 @@ def run_integrated(
         m = sched.metrics
         m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
         m.device_time_s = m.featurize_time_s = 0.0
+        m.registry.reset()  # measured-window-only histograms (harness.py)
 
         pods = [_pod(f"pod-{i}") for i in range(measured_pods)]
         scheduled = 0
@@ -194,6 +196,7 @@ def run_integrated(
             "featurize_s": round(m.featurize_time_s, 3),
             "batches": m.batches,
             "speculation": stats,
+            "metrics_summary": round_floats(m.registry.summary()),
         }
     finally:
         if cache is not None:

@@ -896,6 +896,11 @@ class PassCache:
     def __init__(self) -> None:
         self._cache: dict = {}
 
+    def __len__(self) -> int:
+        """Built program variants held — the jax_compiled_programs gauge
+        (each entry traced+compiled its own XLA program family)."""
+        return len(self._cache)
+
     def get(
         self,
         profile: Profile,

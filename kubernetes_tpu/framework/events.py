@@ -1,0 +1,145 @@
+"""Event recorder: the client-go tools/record EventBroadcaster analog.
+
+The reference scheduler narrates every decision through an event recorder
+(schedule_one.go fitError → ``FailedScheduling``, bind success →
+``Scheduled``, preemption.go:362 → ``Preempted``); operators watch those
+events, not logs, to see why a pod is stuck.  This module is that surface
+for the in-process/sidecar engine: structured events aggregated into a
+bounded ring (the EventAggregator's dedup-by-(object, reason) correlator,
+tools/record/events_cache.go), counted into the metrics registry
+(``scheduler_events_total{reason}``), fanned out to registered sinks, and
+readable over the sidecar protocol's ``events`` frame."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+# The reference's two event types (core/v1 EventTypeNormal/Warning).
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    """One aggregated event series (core/v1 Event: count/firstTimestamp/
+    lastTimestamp carry the aggregation, note the latest message)."""
+
+    object: str          # "namespace/name" ref of the regarding object
+    type: str            # Normal | Warning
+    reason: str          # Scheduled | FailedScheduling | Preempted | …
+    note: str
+    component: str = "tpu-scheduler"
+    count: int = 1
+    first_ts: float = 0.0
+    last_ts: float = 0.0
+    # Structured payload (e.g. FailedScheduling's diagnosis plugin set).
+    extra: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        d = {
+            "object": self.object,
+            "type": self.type,
+            "reason": self.reason,
+            "note": self.note,
+            "component": self.component,
+            "count": self.count,
+            "first_ts": round(self.first_ts, 3),
+            "last_ts": round(self.last_ts, 3),
+        }
+        if self.extra:
+            d.update(self.extra)
+        return d
+
+
+class EventBroadcaster:
+    """Bounded, aggregating event store + fan-out (EventBroadcaster +
+    EventAggregator in one).  Thread-safe: the scheduler thread emits
+    while HTTP/sidecar scrape threads read."""
+
+    def __init__(self, registry=None, capacity: int = 512, clock=time.time):
+        self.capacity = capacity
+        self._clock = clock
+        self._events: OrderedDict[tuple, Event] = OrderedDict()
+        self._sinks: list = []
+        self._lock = threading.Lock()
+        self._counter = (
+            registry.counter(
+                "scheduler_events_total",
+                "Events emitted by the scheduler, by reason.",
+            )
+            if registry is not None
+            else None
+        )
+
+    def new_recorder(self, component: str = "tpu-scheduler") -> "EventRecorder":
+        return EventRecorder(self, component)
+
+    def add_sink(self, fn) -> None:
+        """Register a callable(Event) invoked on every emission (the
+        StartEventWatcher analog; exceptions are the sink's problem)."""
+        self._sinks.append(fn)
+
+    def emit(self, event: Event) -> None:
+        with self._lock:
+            key = (event.object, event.reason)
+            cur = self._events.get(key)
+            if cur is not None:
+                cur.count += 1
+                cur.last_ts = event.last_ts
+                cur.note = event.note
+                cur.type = event.type
+                # Unconditional: a later emission WITHOUT a payload must
+                # not keep an earlier one's (e.g. a rollback-path
+                # FailedScheduling showing a stale diagnosis plugin set).
+                cur.extra = event.extra
+                self._events.move_to_end(key)
+            else:
+                self._events[key] = event
+                while len(self._events) > self.capacity:
+                    self._events.popitem(last=False)
+        if self._counter is not None:
+            self._counter.inc(reason=event.reason)
+        for fn in self._sinks:
+            fn(event)
+
+    def list(self, limit: int | None = None) -> list[dict]:
+        """Events as JSON-ready dicts, oldest-activity first; ``limit``
+        keeps the newest N (0 means none, None means all)."""
+        with self._lock:
+            events = [e.as_dict() for e in self._events.values()]
+        if limit is None:
+            return events
+        return events[-limit:] if limit > 0 else []
+
+    def count(self, reason: str) -> int:
+        """Total emissions for a reason (reads the registry counter when
+        wired, else sums the ring — the ring undercounts past evictions)."""
+        if self._counter is not None:
+            return int(self._counter.get(reason=reason))
+        with self._lock:
+            return sum(
+                e.count for e in self._events.values() if e.reason == reason
+            )
+
+
+class EventRecorder:
+    """The per-component recorder handle (record.EventRecorder.Eventf)."""
+
+    def __init__(self, broadcaster: EventBroadcaster, component: str):
+        self.broadcaster = broadcaster
+        self.component = component
+
+    def event(
+        self, obj: str, etype: str, reason: str, note: str, **extra
+    ) -> None:
+        now = self.broadcaster._clock()
+        self.broadcaster.emit(
+            Event(
+                object=obj, type=etype, reason=reason, note=note,
+                component=self.component, first_ts=now, last_ts=now,
+                extra=extra,
+            )
+        )

@@ -4,11 +4,16 @@ The analog of pkg/scheduler/metrics/metrics.go: per-extension-point
 duration histograms (framework_extension_point_duration_seconds:245),
 e2e scheduling SLI (pod_scheduling_sli_duration_seconds:225), and the
 attempt counters.  Prometheus-style exponential buckets; `summary()`
-renders the same quantities scheduler_perf thresholds read."""
+renders the same quantities scheduler_perf thresholds read, and
+`render_text()` emits the full registry in Prometheus text exposition
+format (the component-base /metrics handler analog) so the sidecar's
+`metrics` frame and the plain-HTTP `/metrics` endpoint serve the same
+bytes."""
 
 from __future__ import annotations
 
 import bisect
+import math
 from dataclasses import dataclass, field
 
 
@@ -42,19 +47,30 @@ class Histogram:
         self.total += v
         self.n += 1
 
+    @property
+    def overflow(self) -> int:
+        """Observations beyond the last finite bucket (the +Inf cell)."""
+        return self.counts[-1]
+
     def quantile(self, q: float) -> float:
         """Bucket-interpolated quantile (what Prometheus histogram_quantile
-        computes)."""
+        computes).  A quantile that falls in the +Inf overflow cell returns
+        the last finite bound without interpolation — Prometheus semantics
+        ("the upper bound of the second highest bucket is returned"); a
+        boundary target must not be absorbed by a lower bucket whose
+        cumulative count merely touches it when the mass actually sits in
+        the overflow cell."""
         if self.n == 0:
             return 0.0
         target = q * self.n
         seen = 0
         lo = 0.0
         for i, c in enumerate(self.counts):
-            if seen + c >= target:
-                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
-                frac = (target - seen) / c if c else 0.0
-                return lo + (hi - lo) * frac
+            if c and seen + c >= target:
+                if i >= len(self.buckets):
+                    return self.buckets[-1]  # +Inf cell: no finite ceiling
+                hi = self.buckets[i]
+                return lo + (hi - lo) * ((target - seen) / c)
             seen += c
             lo = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
         return lo
@@ -66,7 +82,90 @@ class Histogram:
             "p50": self.quantile(0.50),
             "p90": self.quantile(0.90),
             "p99": self.quantile(0.99),
+            # Saturation signal: a non-zero overflow means the quantiles
+            # above are clipped at buckets[-1] (+Inf semantics).
+            "overflow": self.counts[-1],
         }
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _format_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    parts = []
+    for name, value in key:
+        v = str(value).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        parts.append(f'{name}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _format_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+@dataclass
+class Counter:
+    """Monotonic counter family (component-base CounterVec): one value per
+    label set; the empty label set is the plain-counter case."""
+
+    name: str
+    help: str = ""
+    values: dict[tuple, float] = field(default_factory=dict)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _labels_key(labels)
+        self.values[key] = self.values.get(key, 0.0) + amount
+
+    def set(self, value: float, **labels) -> None:
+        """Collector-only escape hatch: sync the cell to an externally
+        maintained monotonic count (SchedulerMetrics ints)."""
+        self.values[_labels_key(labels)] = float(value)
+
+    def get(self, **labels) -> float:
+        return self.values.get(_labels_key(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self.values.values())
+
+
+@dataclass
+class Gauge:
+    """Gauge family (GaugeVec): set-to-current-value semantics."""
+
+    name: str
+    help: str = ""
+    values: dict[tuple, float] = field(default_factory=dict)
+
+    def set(self, value: float, **labels) -> None:
+        self.values[_labels_key(labels)] = float(value)
+
+    def get(self, **labels) -> float:
+        return self.values.get(_labels_key(labels), 0.0)
+
+
+def _render_histogram(
+    out: list[str], name: str, cells: list[tuple[tuple, Histogram]], help_: str
+) -> None:
+    """One exposition block per histogram family: cumulative _bucket lines
+    (le is cumulative-≤, ending at +Inf == _count), then _sum/_count."""
+    out.append(f"# HELP {name} {help_}")
+    out.append(f"# TYPE {name} histogram")
+    for key, h in cells:
+        cum = 0
+        for bound, c in zip(h.buckets, h.counts):
+            cum += c
+            lk = key + (("le", _format_value(bound)),)
+            out.append(f"{name}_bucket{_format_labels(lk)} {cum}")
+        lk = key + (("le", "+Inf"),)
+        out.append(f"{name}_bucket{_format_labels(lk)} {h.n}")
+        out.append(f"{name}_sum{_format_labels(key)} {_format_value(h.total)}")
+        out.append(f"{name}_count{_format_labels(key)} {h.n}")
 
 
 # Extension points the batch engine times (the batch analogs of the
@@ -99,6 +198,15 @@ class MetricsRegistry:
     plugin_execution: dict[tuple[str, str], Histogram] = field(
         default_factory=dict
     )
+    # Counter/gauge families by name (schedule_attempts_total,
+    # scheduler_events_total{reason}, queue-depth gauges, …).
+    counters: dict[str, Counter] = field(default_factory=dict)
+    gauges: dict[str, Gauge] = field(default_factory=dict)
+    # Scrape-time collectors: callables(registry) run by render_text()
+    # before rendering, so point-in-time gauges (queue depths, cache
+    # sizes, device memory) are fresh at every exposition without the hot
+    # loop paying per-batch gauge updates.
+    collectors: list = field(default_factory=list)
     # Deterministic PER-SITE sampling counters (the reference uses
     # rand.Intn(100); modular counters keep benches reproducible, and
     # per-site keying prevents interleaved call sites from aliasing onto
@@ -111,6 +219,42 @@ class MetricsRegistry:
         self._sample_ticks[site] = tick
         return tick == 0
 
+    def counter(self, name: str, help_: str = "") -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name, help_)
+        return c
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name, help_)
+        return g
+
+    def add_collector(self, fn) -> None:
+        self.collectors.append(fn)
+
+    def reset(self) -> None:
+        """Clear every observation IN PLACE (the bench harness resets after
+        warmup).  Collectors and family objects survive — holders of a
+        Counter/Gauge reference (the event recorder) keep writing to the
+        same cells."""
+        for h in self._all_histograms():
+            h.counts = [0] * (len(h.buckets) + 1)
+            h.total, h.n = 0.0, 0
+        self.plugin_execution.clear()
+        for c in self.counters.values():
+            c.values.clear()
+        for g in self.gauges.values():
+            g.values.clear()
+        self._sample_ticks.clear()
+
+    def _all_histograms(self):
+        yield from self.extension_point.values()
+        yield self.scheduling_sli
+        yield self.attempt_duration
+        yield from self.plugin_execution.values()
+
     def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
         h = self.plugin_execution.get((plugin, point))
         if h is None:
@@ -121,6 +265,12 @@ class MetricsRegistry:
         self.extension_point[point].observe(seconds)
 
     def summary(self) -> dict:
+        # Collector-backed series must be as fresh here as in render_text:
+        # the dump frame and bench payloads read summary(), and stale
+        # schedule_attempts_total next to live events_total would hand an
+        # operator two disagreeing views of "one registry".
+        for fn in self.collectors:
+            fn(self)
         return {
             "extension_point_duration_seconds": {
                 p: h.summary() for p, h in self.extension_point.items() if h.n
@@ -132,4 +282,72 @@ class MetricsRegistry:
                 for (plugin, point), h in sorted(self.plugin_execution.items())
                 if h.n
             },
+            "counters": {
+                name: {
+                    _format_labels(k) or "total": v
+                    for k, v in sorted(c.values.items())
+                }
+                for name, c in sorted(self.counters.items())
+                if c.values
+            },
+            "gauges": {
+                name: {
+                    _format_labels(k) or "value": v
+                    for k, v in sorted(g.values.items())
+                }
+                for name, g in sorted(self.gauges.items())
+                if g.values
+            },
         }
+
+    def render_text(self) -> str:
+        """Prometheus text exposition (format version 0.0.4) of the whole
+        registry — the same bytes whether scraped over HTTP or the sidecar
+        `metrics` frame."""
+        for fn in self.collectors:
+            fn(self)
+        out: list[str] = []
+        for name, c in sorted(self.counters.items()):
+            if not c.values:
+                continue
+            out.append(f"# HELP {name} {c.help}")
+            out.append(f"# TYPE {name} counter")
+            for key, v in sorted(c.values.items()):
+                out.append(f"{name}{_format_labels(key)} {_format_value(v)}")
+        for name, g in sorted(self.gauges.items()):
+            if not g.values:
+                continue
+            out.append(f"# HELP {name} {g.help}")
+            out.append(f"# TYPE {name} gauge")
+            for key, v in sorted(g.values.items()):
+                out.append(f"{name}{_format_labels(key)} {_format_value(v)}")
+        _render_histogram(
+            out, "scheduling_attempt_duration_seconds",
+            [((), self.attempt_duration)],
+            "Per-batch scheduling attempt duration (featurize + device).",
+        )
+        _render_histogram(
+            out, "pod_scheduling_sli_duration_seconds",
+            [((), self.scheduling_sli)],
+            "E2e pod scheduling latency, enqueue to bind.",
+        )
+        _render_histogram(
+            out, "framework_extension_point_duration_seconds",
+            [
+                ((("extension_point", p),), h)
+                for p, h in sorted(self.extension_point.items())
+                if h.n
+            ],
+            "Per-extension-point batch duration.",
+        )
+        if self.plugin_execution:
+            _render_histogram(
+                out, "plugin_execution_duration_seconds",
+                [
+                    ((("extension_point", point), ("plugin", plugin)), h)
+                    for (plugin, point), h in sorted(self.plugin_execution.items())
+                    if h.n
+                ],
+                "Sampled per-plugin execution duration.",
+            )
+        return "\n".join(out) + "\n"

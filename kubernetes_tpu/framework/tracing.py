@@ -4,56 +4,157 @@ The reference wraps each scheduling cycle in a poor-man's span trace and
 dumps the step log only when the cycle was slow (schedule_one.go:412
 ``utiltrace.New("Scheduling", ...)`` + ``LogIfLong(100ms)``); real OTel
 spans exist in the apiserver/kubelet but not the scheduler.  This module
-is that shape: cheap always-on step timestamps, emitted only past a
-threshold.  For deep device-side visibility the CLI's ``bench
---profile-dir`` wraps the run in ``jax.profiler.trace`` (SURVEY §5:
-"add JAX profiler traces on the sidecar")."""
+is that shape — cheap always-on step timestamps, emitted only past a
+threshold — extended two ways for the two-process split:
+
+* **Nested child spans** (``Trace.nest``, the ``utiltrace.Nest`` analog):
+  a slow root logs its whole subtree, children indented with their own
+  steps, so "the batch was slow" decomposes into which phase was.
+* **Stable trace/span ids**: every span carries a random ``trace_id``
+  (inherited from its parent) and its own ``span_id``; the sidecar
+  envelope threads the client's ids to the server (ScheduleBatchRequest
+  trace_id/parent_span_id), so a server-side batch span logged here
+  carries the HOST's trace id and the two processes' logs join on it.
+
+For deep device-side visibility the CLI's ``bench --profile-dir`` wraps
+the run in ``jax.profiler.trace`` (SURVEY §5: "add JAX profiler traces on
+the sidecar")."""
 
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 logger = logging.getLogger("kubernetes_tpu")
 
 
+def new_id(nbytes: int = 8) -> str:
+    """Random lowercase-hex id (the W3C traceparent shape, truncated)."""
+    return os.urandom(nbytes).hex()
+
+
 class Trace:
     """utiltrace.New analog: record (step, t) pairs; log them all iff the
-    total exceeded ``threshold_s`` (LogIfLong)."""
+    total exceeded ``threshold_s`` (LogIfLong).  Children created with
+    ``nest()`` share the trace id and are logged (and serialized by
+    ``as_dict``) as a subtree of their root."""
 
-    __slots__ = ("name", "threshold_s", "fields", "_t0", "_steps")
+    __slots__ = (
+        "name", "threshold_s", "fields", "trace_id", "span_id",
+        "parent_span_id", "children", "_parent", "_t0", "_t_end", "_steps",
+        "_logged", "_on_slow",
+    )
 
-    def __init__(self, name: str, threshold_s: float = 0.1, **fields):
+    def __init__(
+        self,
+        name: str,
+        threshold_s: float = 0.1,
+        *,
+        parent: "Trace | None" = None,
+        trace_id: str | None = None,
+        parent_span_id: str | None = None,
+        on_slow=None,
+        **fields,
+    ):
         self.name = name
         self.threshold_s = threshold_s
         self.fields = fields
+        self._parent = parent
+        if parent is not None:
+            trace_id = parent.trace_id
+            parent_span_id = parent.span_id
+        self.trace_id = trace_id or new_id(8)
+        self.span_id = new_id(4)
+        # Set without a parent object when the parent span lives in another
+        # process (the sidecar envelope's trace_id/parent_span_id pair).
+        self.parent_span_id = parent_span_id
+        self.children: list[Trace] = []
         self._t0 = time.perf_counter()
+        self._t_end: float | None = None
         self._steps: list[tuple[str, float]] = []
+        self._logged = False
+        self._on_slow = on_slow
 
     def step(self, msg: str) -> None:
         self._steps.append((msg, time.perf_counter()))
 
-    def log_if_long(self, threshold_s: float | None = None) -> bool:
-        """Emit the step log when the span ran long.  Returns whether it
-        logged (the reference logs at V(2) through klog; here the
-        ``kubernetes_tpu`` logger at INFO)."""
-        threshold = self.threshold_s if threshold_s is None else threshold_s
-        total = time.perf_counter() - self._t0
-        if total <= threshold:
-            return False
-        parts = [
-            f'"{self.name}" total={total * 1000:.1f}ms '
-            + " ".join(f"{k}={v}" for k, v in self.fields.items())
+    def nest(self, name: str, **fields) -> "Trace":
+        """Open a child span (utiltrace.Nest): same trace id, own span id.
+        Children never self-log — the root emits the whole tree."""
+        child = Trace(name, threshold_s=self.threshold_s, parent=self, **fields)
+        self.children.append(child)
+        return child
+
+    def end(self) -> None:
+        if self._t_end is None:
+            self._t_end = time.perf_counter()
+
+    def total_s(self) -> float:
+        return (self._t_end if self._t_end is not None else time.perf_counter()) - self._t0
+
+    def _header(self) -> str:
+        ids = f"trace={self.trace_id} span={self.span_id}"
+        if self.parent_span_id:
+            ids += f" parent={self.parent_span_id}"
+        tail = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        return (
+            f'"{self.name}" total={self.total_s() * 1000:.1f}ms {ids}'
+            + (f" {tail}" if tail else "")
+        )
+
+    def _render(self, parts: list[str], indent: str) -> None:
+        parts.append(indent + self._header())
+        events: list[tuple[float, str, Trace | None]] = [
+            (ts, msg, None) for msg, ts in self._steps
         ]
+        events.extend((c._t0, "", c) for c in self.children)
         prev = self._t0
-        for msg, ts in self._steps:
-            parts.append(f"  {msg} (+{(ts - prev) * 1000:.1f}ms)")
-            prev = ts
+        for ts, msg, child in sorted(events, key=lambda e: e[0]):
+            if child is not None:
+                child._render(parts, indent + "  ")
+            else:
+                parts.append(f"{indent}  {msg} (+{(ts - prev) * 1000:.1f}ms)")
+                prev = ts
+
+    def log_if_long(self, threshold_s: float | None = None) -> bool:
+        """Emit the span tree when the span ran long.  Returns whether it
+        logged THIS call (the reference logs at V(2) through klog; here the
+        ``kubernetes_tpu`` logger at INFO).  Emission is idempotent: a span
+        already logged by an explicit call is not re-logged by ``__exit__``
+        (or a second explicit call)."""
+        if self._logged:
+            return False
+        threshold = self.threshold_s if threshold_s is None else threshold_s
+        if self.total_s() <= threshold:
+            return False
+        self._logged = True
+        parts: list[str] = []
+        self._render(parts, "")
         logger.info("\n".join(parts))
+        if self._on_slow is not None:
+            self._on_slow(self)
         return True
+
+    def as_dict(self) -> dict:
+        """JSON-ready span tree (the `dump` frame's slow-span payload)."""
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "duration_ms": round(self.total_s() * 1000, 3),
+            "fields": {k: str(v) for k, v in self.fields.items()},
+            "steps": [
+                [msg, round((ts - self._t0) * 1000, 3)] for msg, ts in self._steps
+            ],
+            "children": [c.as_dict() for c in self.children],
+        }
 
     def __enter__(self) -> "Trace":
         return self
 
     def __exit__(self, *exc) -> None:
-        self.log_if_long()
+        self.end()
+        if self._parent is None:
+            self.log_if_long()

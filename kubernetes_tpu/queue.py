@@ -618,13 +618,26 @@ class SchedulingQueue:
         """Pod scheduled successfully; drop bookkeeping."""
         self._info.pop(uid, None)
 
-    def dump(self) -> dict:
-        """Queue state for the debugger dump (keeps the privates here)."""
+    def depths(self) -> dict[str, int]:
+        """Per-class queue depths — the scheduler_pending_pods{queue=…}
+        gauge payload (metrics.go:121 PendingPods) and the dump's counts.
+        Label values match the reference's queue names where one exists."""
         return {
             "active": len(self._in_active),
             "backoff": len(self._backoff),
-            "pending": self.pending_count(),
             "unschedulable": len(self._unschedulable),
             "gated": len(self._gated),
+            "gang-parked": sum(len(p) for p in self._gang_pool.values()),
+        }
+
+    def dump(self) -> dict:
+        """Queue state for the debugger dump (keeps the privates here)."""
+        d = self.depths()
+        return {
+            "active": d["active"],
+            "backoff": d["backoff"],
+            "pending": self.pending_count(),
+            "unschedulable": d["unschedulable"],
+            "gated": d["gated"],
             "gang_pool": {g: sorted(p) for g, p in self._gang_pool.items()},
         }

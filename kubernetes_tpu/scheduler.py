@@ -11,6 +11,7 @@ unschedulable pods back to the queue."""
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -21,6 +22,7 @@ from .cache import Cache
 from .engine.features import build_pod_batch
 from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
+from .framework.events import NORMAL, WARNING, EventBroadcaster
 from .framework.metrics import MetricsRegistry
 from .framework.status import Diagnosis
 from .framework.tracing import Trace
@@ -195,6 +197,22 @@ class TPUScheduler:
         self.builder.feature_gates = self.feature_gates
         self.passes = PassCache()
         self.metrics = SchedulerMetrics()
+        # Event recorder (client-go record.EventBroadcaster analog): the
+        # structured Scheduled/FailedScheduling/Preempted/GangWaiting
+        # narration, counted into scheduler_events_total{reason} and
+        # readable via the sidecar `events` frame.
+        self.events = EventBroadcaster(registry=self.metrics.registry)
+        self.recorder = self.events.new_recorder()
+        # Cross-boundary tracing: (trace_id, parent_span_id) of the REMOTE
+        # caller's span — the sidecar server sets it from the envelope so
+        # the next batch's root span joins the client's trace.
+        self.trace_parent: tuple[str, str | None] | None = None
+        # The most recent batch's root span (the server echoes its span_id
+        # in the schedule response) and a ring of slow span trees for the
+        # debugger dump.
+        self.last_batch_span: Trace | None = None
+        self.slow_spans: deque = deque(maxlen=16)
+        self._install_metric_collectors()
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None
         # Inline preemptor commit (perf mode): a successful dry-run commits
         # the preemptor immediately instead of nominate + requeue — sound
@@ -308,6 +326,94 @@ class TPUScheduler:
         for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
                     "topology.kubernetes.io/region"):
             self.builder.ensure_topo_key(key)
+
+    def _install_metric_collectors(self) -> None:
+        """Register the scrape-time gauge/counter sync on the registry:
+        point-in-time series (queue depths, cache sizes, compiled-program
+        and device-memory stats) are sampled when `/metrics` or the
+        sidecar `metrics` frame renders, so the hot loop pays nothing."""
+        reg = self.metrics.registry
+        # Hot-path counter cached as an attribute (registry.reset() clears
+        # values in place, so the handle stays valid across bench resets).
+        self._dispatch_counter = reg.counter(
+            "device_dispatch_total",
+            "Device pass dispatches by kind (batch/pinned/tail/eval).",
+        )
+        attempts = reg.counter(
+            "schedule_attempts_total",
+            "Scheduling attempts by result (metrics.go:138 analog).",
+        )
+        preempt = reg.counter(
+            "preemption_attempts_total", "Successful preemption candidates."
+        )
+        batches = reg.counter(
+            "scheduler_batches_total",
+            "Device batches run; kinds partition (full + pinned = all).",
+        )
+        deferred = reg.counter(
+            "scheduler_deferred_pods_total",
+            "Pods deferred to the strict tail by chunk conflicts.",
+        )
+        pending = reg.gauge(
+            "scheduler_pending_pods", "Pending pods by queue class."
+        )
+        cache_g = reg.gauge(
+            "scheduler_cache_size", "Cached cluster objects by kind."
+        )
+        snap = reg.gauge(
+            "snapshot_node_rows", "Device snapshot node-row capacity."
+        )
+        programs = reg.gauge(
+            "jax_compiled_programs", "Compiled XLA program variants held."
+        )
+        devmem = reg.gauge(
+            "device_memory_bytes",
+            "Device allocator stats when the backend reports them.",
+        )
+
+        def collect(_reg) -> None:
+            m = self.metrics
+            # The reference's partitioning label set {scheduled,
+            # unschedulable, error} (metrics.go:138): the cells sum to the
+            # attempt total, so sum(rate(...)) dashboards stay honest.
+            # "error" is the residual — attempts whose pods are neither
+            # bound nor pooled (in-flight waits, rollbacks).
+            attempts.set(m.scheduled, result="scheduled")
+            attempts.set(m.unschedulable, result="unschedulable")
+            attempts.set(
+                max(m.schedule_attempts - m.scheduled - m.unschedulable, 0),
+                result="error",
+            )
+            preempt.set(m.preemptions)
+            # Disjoint cells (m.batches counts every batch, pinned ones
+            # included): sum() over the label reproduces the true total.
+            batches.set(max(m.batches - m.pinned_batches, 0), kind="full")
+            batches.set(m.pinned_batches, kind="pinned")
+            deferred.set(m.deferred)
+            for q, depth in self.queue.depths().items():
+                pending.set(depth, queue=q)
+            cache_g.set(len(self.cache.nodes), kind="nodes")
+            cache_g.set(len(self.cache.pods), kind="pods")
+            cache_g.set(
+                sum(1 for p in self.cache.pods.values() if p.assumed),
+                kind="assumed",
+            )
+            snap.set(getattr(self.builder.schema, "N", 0) or 0)
+            programs.set(len(self.passes) + len(self._eval_passes))
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+            except Exception:  # CPU backends return None / lack the call
+                stats = {}
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if k in stats:
+                    devmem.set(stats[k], kind=k)
+
+        reg.add_collector(collect)
+
+    def _note_slow_span(self, tr: Trace) -> None:
+        """on_slow hook: keep the logged span TREE for the debugger dump
+        (the `dump` frame surfaces the joined host↔sidecar trace)."""
+        self.slow_spans.append(tr.as_dict())
 
     def warm_tail(self) -> None:
         """Pre-compile the programs a measured window would otherwise
@@ -792,6 +898,10 @@ class TPUScheduler:
             },
             "mirror_equal": self.builder.host_mirror_equal(),
             "metrics": self.metrics.registry.summary(),
+            # Slow-cycle span trees (cross-boundary: server-side spans
+            # carry the client's trace id) and the recent event ring.
+            "slow_spans": list(self.slow_spans),
+            "events": self.events.list(limit=50),
         }
 
     def check_consistency(self) -> None:
@@ -825,11 +935,21 @@ class TPUScheduler:
         outcome.victim_names = tuple(
             f"{v.namespace}/{v.name}" for v in res.victims
         )
+        self._emit_preempted(qp.pod, res)
         self.nominator[qp.pod.uid] = (
             res.node_name, delta, qp.pod.spec.priority
         )
         qp.nom_pin_failed = False  # fresh nomination: the pin may try again
         self.queue.add(qp.pod)
+
+    def _emit_preempted(self, preemptor: t.Pod, res) -> None:
+        """Preempted events on the victims (preemption.go:362 emits on
+        each victim pod; the reference's reason is "Preempted")."""
+        for v in res.victims:
+            self.recorder.event(
+                v.uid, NORMAL, "Preempted",
+                f"Preempted by {preemptor.uid} on node {res.node_name}",
+            )
 
     def _fits_now(self, node_name: str, delta: dict) -> bool:
         """Host-truth capacity re-check before INLINE-committing a
@@ -867,6 +987,7 @@ class TPUScheduler:
         nominated retry would do next batch — minus a full device pass."""
         m = self.metrics
         m.preemptions += 1
+        self._emit_preempted(qp.pod, res)
         self.cache.assume_pod(
             qp.pod, res.node_name, device_already=False, delta=delta
         )
@@ -897,6 +1018,11 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
+        self.recorder.event(
+            qp.pod.uid, NORMAL, "Scheduled",
+            f"Successfully assigned {qp.pod.uid} to {res.node_name} "
+            "(inline preemption commit)",
+        )
 
     def _permit_group(self, pod: t.Pod):
         """The (group, owning PermitPlugin) a pod waits under, or
@@ -1003,6 +1129,11 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
+        self.recorder.event(
+            qp.pod.uid, NORMAL, "Scheduled",
+            f"Successfully assigned {qp.pod.uid} to {entry['node']} "
+            "(PreBind wait completed)",
+        )
         return ScheduleOutcome(
             qp.pod, entry["node"], entry["score"], entry["feasn"]
         )
@@ -1125,6 +1256,7 @@ class TPUScheduler:
                 nomrow = rec_n.row
         pf["nominated_row"] = np.int32(nomrow)
         feasible, total = device_fetch(run(state, pf, inv))
+        self._dispatch_counter.inc(kind="eval")
         m.featurize_time_s += t1 - t0
         m.device_time_s += time.perf_counter() - t1
         rows = np.nonzero(feasible)[0]
@@ -1145,6 +1277,12 @@ class TPUScheduler:
             m.unschedulable += 1
             # Extender rejections requeue on any event (schedule_one.go:528).
             plugins = {"Extender"} if names else set(profile.filters)
+            self.recorder.event(
+                qp.pod.uid, WARNING, "FailedScheduling",
+                f"0/{self.cache.node_count()} nodes available: rejected by "
+                + ", ".join(sorted(plugins)),
+                plugins=sorted(plugins),
+            )
             qp.delta = deltas[0]
             outcome = ScheduleOutcome(
                 qp.pod, None, 0, len(names),
@@ -1236,6 +1374,10 @@ class TPUScheduler:
         m.scheduled += 1
         m.last_scheduled_ts = now
         m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
+        self.recorder.event(
+            qp.pod.uid, NORMAL, "Scheduled",
+            f"Successfully assigned {qp.pod.uid} to {best}",
+        )
         if (
             self.consistency_check_every
             and m.batches % self.consistency_check_every == 0
@@ -1318,30 +1460,41 @@ class TPUScheduler:
             work = None
         if not infos:
             return []
-        if self.extenders:
-            # Extender chain: per-pod eval-only path (see extender.py).
-            out: list[ScheduleOutcome] = []
-            for qp in infos:
-                out.append(self._schedule_one_extender(qp))
-            return out
-        if len(self.profiles) == 1:
-            # Cycle span (utiltrace "Scheduling" + LogIfLong,
-            # schedule_one.go:412): step log emitted only past the
-            # threshold.  schedule_batch covers a whole BATCH, so the
-            # default threshold is per-batch, not per-pod.
-            with Trace(
-                "ScheduleBatch", self.trace_threshold_s, pods=len(infos)
-            ) as tr:
+        # Cycle span (utiltrace "Scheduling" + LogIfLong,
+        # schedule_one.go:412): step log emitted only past the threshold.
+        # schedule_batch covers a whole BATCH, so the default threshold is
+        # per-batch, not per-pod.  When a remote caller's trace context is
+        # installed (the sidecar envelope's trace_id/parent_span_id) this
+        # root span joins that trace, so a slow server-side cycle logs the
+        # CLIENT's trace id — on EVERY path: single-profile, multi-profile,
+        # and the extender chain all share the one root span contract.
+        tp = self.trace_parent
+        with Trace(
+            "ScheduleBatch", self.trace_threshold_s,
+            trace_id=tp[0] if tp else None,
+            parent_span_id=tp[1] if tp else None,
+            on_slow=self._note_slow_span,
+            pods=len(infos),
+        ) as tr:
+            self.last_batch_span = tr
+            if self.extenders:
+                # Extender chain: per-pod eval-only path (see extender.py).
+                out: list[ScheduleOutcome] = []
+                for qp in infos:
+                    out.append(self._schedule_one_extender(qp))
+                tr.step("extender chain complete")
+                return out
+            if len(self.profiles) == 1:
                 return self._batch_traced(tr, infos, work)
-
-        by_profile: dict[str, list[QueuedPodInfo]] = {}
-        for qp in infos:
-            prof = self._profile_for(qp.pod) or self.profile
-            by_profile.setdefault(prof.name, []).append(qp)
-        out = []
-        for name, group in by_profile.items():
-            out.extend(self._schedule_infos(group, self.profiles[name]))
-        return out
+            by_profile: dict[str, list[QueuedPodInfo]] = {}
+            for qp in infos:
+                prof = self._profile_for(qp.pod) or self.profile
+                by_profile.setdefault(prof.name, []).append(qp)
+            out = []
+            for name, group in by_profile.items():
+                with tr.nest("ProfileBatch", profile=name, pods=len(group)):
+                    out.extend(self._schedule_infos(group, self.profiles[name]))
+            return out
 
     def _batch_traced(
         self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None
@@ -1349,7 +1502,8 @@ class TPUScheduler:
         """One single-profile batch under the cycle span (exception-safe:
         Trace.__exit__ emits the step log for slow batches even when the
         batch raises — exactly the batches an operator needs timed)."""
-        ctx = self._dispatch_batch(infos, self.profile, work)
+        with tr.nest("DevicePassDispatch") as _sp:
+            ctx = self._dispatch_batch(infos, self.profile, work)
         tr.step("dispatched device pass")
         # Overlap victim packing + transfer with the in-flight device pass
         # when recent batches needed preemption (the dispatch is async; the
@@ -1396,7 +1550,8 @@ class TPUScheduler:
                     nxt, self._featurize_batch(nxt, self.profile)
                 )
                 tr.step("prefetched next batch")
-        out = self._complete_batch(ctx)
+        with tr.nest("CompleteBatch"):
+            out = self._complete_batch(ctx)
         tr.step("completed (bind/permit/postfilter)")
         return out
 
@@ -1524,6 +1679,7 @@ class TPUScheduler:
                 new_state, result = run(state, batch_d, inv_d)
                 self._cycle += len(infos)
                 self.metrics.pinned_batches += 1
+                self._dispatch_counter.inc(kind="pinned")
                 return dict(
                     work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
                     new_state=new_state, result=result, t1=t1,
@@ -1615,6 +1771,7 @@ class TPUScheduler:
             batch_d, inv_d = jax.device_put((batch_np, inv))
         new_state, result = run(state, batch_d, inv_d, np.uint32(self._cycle))
         self._cycle += len(infos)
+        self._dispatch_counter.inc(kind="batch")
         return dict(
             work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
             batch_d=batch_d, new_state=new_state, result=result, t1=t1,
@@ -1733,6 +1890,7 @@ class TPUScheduler:
                         (res.picks, res.scores, res.feasible_counts, res.fail_masks)
                     )
                     self._cycle += len(idx)
+                    self._dispatch_counter.inc(kind="tail")
                     picks[idx], scores[idx], feas[idx], fails[idx] = (
                         p2[: len(idx)], s2[: len(idx)], f2[: len(idx)], fl2[: len(idx)],
                     )
@@ -1852,6 +2010,13 @@ class TPUScheduler:
             self.permit_wait_since.pop(g, None)
             self.permit_wait_owner.pop(g, None)
             entries.extend(self.permit_waiting.pop(g, ()))
+        for g in wait:
+            # One GangWaiting per group per batch (the coscheduling
+            # plugin's Permit-wait narration); the ring aggregates repeats.
+            self.recorder.event(
+                f"podgroup/{g}", NORMAL, "GangWaiting",
+                f"gang {g} waiting on Permit for quorum",
+            )
 
         # Phase 3 — Reserve + PreBind + bind: each registered ReservePlugin
         # reserves host-side state on the chosen node (VolumeBinding PreBind
@@ -2016,8 +2181,21 @@ class TPUScheduler:
                     m.first_scheduled_ts = now
                 m.scheduled += 1
                 m.last_scheduled_ts = now
+                self.recorder.event(
+                    outcome.pod.uid, NORMAL, "Scheduled",
+                    f"Successfully assigned {outcome.pod.uid} to "
+                    f"{outcome.node_name}",
+                )
             else:
                 m.unschedulable += 1
+                # Rollback/race failures carry no device diagnosis; the
+                # engine-rejected failures get theirs (with the plugin
+                # set) in the diagnosis loop below.
+                self.recorder.event(
+                    outcome.pod.uid, WARNING, "FailedScheduling",
+                    f"0/{self.cache.node_count()} nodes available "
+                    "(batch rollback or lost race)",
+                )
         for qp in latency_qps:
             if qp.pod.spec.node_name:
                 lat = now - qp.initial_attempt_timestamp
@@ -2041,6 +2219,14 @@ class TPUScheduler:
             diag = Diagnosis(unschedulable_plugins=plugins)
             outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]), diagnosis=diag)
             m.unschedulable += 1
+            # FailedScheduling with the diagnosis plugin set (the fitError
+            # message shape: "0/N nodes are available: ...").
+            self.recorder.event(
+                qp.pod.uid, WARNING, "FailedScheduling",
+                f"0/{self.cache.node_count()} nodes available: rejected by "
+                + (", ".join(sorted(plugins)) if plugins else "no feasible nodes"),
+                plugins=sorted(plugins),
+            )
             outcomes.append(outcome)
             failed2.append((i, qp, outcome))
         failed = failed2

@@ -112,7 +112,15 @@ class ResyncingClient:
         # targets the NEW connection, not the dead one's bound method.
         return self._with_resync(lambda: self._client.dump())
 
-    def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
+    def metrics(self) -> str:
+        return self._with_resync(lambda: self._client.metrics())
+
+    def events(self) -> list[dict]:
+        return self._with_resync(lambda: self._client.events())
+
+    def schedule(
+        self, pods=(), drain: bool = True, trace=None
+    ) -> list[pb.PodResult]:
         # Pending pods enter the store UNBOUND first: if the sidecar dies
         # mid-call the replay re-submits them (at-least-once; the engine's
         # upsert path makes re-delivery idempotent).
@@ -120,7 +128,7 @@ class ResyncingClient:
         for p in pods:
             self._record("Pod", p)
         results = self._with_resync(
-            lambda: self._client.schedule(pods, drain=drain)
+            lambda: self._client.schedule(pods, drain=drain, trace=trace)
         )
         # Record bindings: the reference host persists them via the
         # apiserver; here the store is that persistence, so a later replay

@@ -1,0 +1,114 @@
+"""Plain-HTTP observability endpoint: /metrics, /healthz, /events.
+
+The reference scheduler serves /metrics and /healthz from its secure
+serving port (cmd/kube-scheduler/app/server.go:181–210 newHealthEndpoints
++ the component-base metrics handler); the sidecar's analog is this tiny
+threaded HTTP listener, started by ``cmd_serve --http-port`` (or
+``SidecarServer(http_port=...)``) next to the framed-socket protocol so
+an unmodified Prometheus can scrape the engine without speaking frames.
+
+The text payload is byte-identical to the sidecar `metrics` frame — both
+render the same ``MetricsRegistry`` — which is what the tier-1 smoke test
+asserts."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# The Prometheus text exposition content type (format version 0.0.4).
+CONTENT_TYPE_TEXT = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def health_state(scheduler, extra: dict | None = None) -> dict:
+    """The /healthz (and sidecar health-frame) payload: liveness plus the
+    cheap state counts an operator probes first."""
+    state = {
+        "healthy": True,
+        "ready": True,
+        "nodes": len(scheduler.cache.nodes),
+        "pods": len(scheduler.cache.pods),
+        "pending": len(scheduler.queue),
+    }
+    if extra:
+        state.update(extra)
+    return state
+
+
+class ObservabilityHTTPServer:
+    """Threaded HTTP listener over one scheduler's registry/events.
+
+    Port 0 binds an ephemeral port (tests); read ``self.port`` after
+    construction.  ``lock`` serializes /metrics against the scheduler:
+    render_text() iterates (and its collectors mutate) dicts the
+    scheduling thread concurrently grows, so an unlocked scrape can hit
+    "dictionary changed size during iteration".  SidecarServer passes its
+    dispatch lock — a scrape then reads a quiescent scheduler, exactly
+    like the framed `metrics` kind; standalone embedders get a private
+    lock, which at least serializes concurrent scrapes."""
+
+    def __init__(
+        self,
+        scheduler,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        health_extra: dict | None = None,
+        lock: "threading.Lock | None" = None,
+    ):
+        self.scheduler = scheduler
+        self.health_extra = health_extra if health_extra is not None else {}
+        self.lock = lock if lock is not None else threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    with outer.lock:
+                        body = outer.scheduler.metrics.registry.render_text()
+                    self._send(200, CONTENT_TYPE_TEXT, body.encode())
+                elif path == "/healthz":
+                    # Answering at all IS the liveness signal (the healthz
+                    # contract), so NO dispatch lock here: a probe must not
+                    # hang behind a long batch — /metrics is the deeper,
+                    # serialized probe.  health_state only does len() calls
+                    # (GIL-atomic snapshots).
+                    state = health_state(outer.scheduler, outer.health_extra)
+                    self._send(
+                        200, "application/json", json.dumps(state).encode()
+                    )
+                elif path == "/events":
+                    # EventBroadcaster.list() takes the broadcaster's own
+                    # lock; no scheduler state is touched.
+                    self._send(
+                        200, "application/json",
+                        json.dumps(outer.scheduler.events.list()).encode(),
+                    )
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # scrapes are not news
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -68,6 +68,8 @@ class SidecarServer:
         lookahead: int | None = None,
         keepalive_s: float | None = None,
         health_extra: dict | None = None,
+        http_port: int | None = None,
+        http_host: str = "127.0.0.1",
         **kw,
     ):
         self.path = path
@@ -178,6 +180,20 @@ class SidecarServer:
         if os.path.exists(path):
             os.unlink(path)
         self._server = Server(path, Handler)
+        # Optional plain-HTTP observability listener (/metrics, /healthz,
+        # /events) over the SAME scheduler — Prometheus scrapes it while
+        # the Go host speaks frames; 0 binds an ephemeral port (tests).
+        self.http = None
+        if http_port is not None:
+            from .metrics_http import ObservabilityHTTPServer
+
+            # Scrapes share the dispatch lock: render-time collectors read
+            # scheduler dicts the dispatch thread mutates.
+            self.http = ObservabilityHTTPServer(
+                self.scheduler, http_port, host=http_host,
+                health_extra=health_extra, lock=lock,
+            )
+            self.http.serve_background()
 
     def serve_background(self) -> None:
         self._thread = threading.Thread(
@@ -190,6 +206,8 @@ class SidecarServer:
 
     def close(self) -> None:
         self._keepalive_stop.set()
+        if self.http is not None:
+            self.http.close()
         self._server.shutdown()
         self._server.server_close()
         # Sever live connections too: handler threads otherwise keep
@@ -260,20 +278,25 @@ def _dispatch(
     if kind == "health":
         # healthz/readyz analog (cmd/kube-scheduler/app/server.go:181–210):
         # a liveness surface the host can probe beyond a failed dial.
+        # Same payload shape the plain-HTTP /healthz serves.
         import json as _json
 
-        state = {
-            "healthy": True,
-            "ready": True,
-            "nodes": len(sched.cache.nodes),
-            "pods": len(sched.cache.pods),
-            "pending": len(sched.queue),
-            "speculation": front is not None,
-            "epoch": front.epoch if front is not None else 0,
-        }
-        if health_extra:
-            state.update(health_extra)
+        from .metrics_http import health_state
+
+        state = health_state(sched, health_extra)
+        state["speculation"] = front is not None
+        state["epoch"] = front.epoch if front is not None else 0
         out.response.health_json = _json.dumps(state).encode()
+        return False
+    if kind == "metrics":
+        # Prometheus text exposition over the wire — byte-identical to the
+        # plain-HTTP /metrics scrape (one registry, one renderer).
+        out.response.metrics_text = sched.metrics.registry.render_text().encode()
+        return False
+    if kind == "events":
+        import json as _json
+
+        out.response.events_json = _json.dumps(sched.events.list()).encode()
         return False
     if kind == "add":
         if env.add.kind == "PendingPod":
@@ -330,20 +353,35 @@ def _dispatch(
             state["speculation"] = front.stats.as_dict()
         out.response.dump_json = json.dumps(state).encode()
     elif kind == "schedule":
-        if front is not None and not env.schedule.drain:
-            outcomes = front.schedule_raw(list(env.schedule.pod_json))
-        else:
-            if front is not None:
-                # A drain request bypasses the cache; flush it first so
-                # drained decisions and cached ones cannot double-commit.
-                front.flush_hints_to_queue()
-            for raw in env.schedule.pod_json:
-                sched.add_pod(serialize.pod_from_json(raw))
-            outcomes = (
-                sched.schedule_all_pending()
-                if env.schedule.drain
-                else sched.schedule_batch()
+        # Cross-boundary trace join: install the client's trace context so
+        # the batch's root span (scheduler.py ScheduleBatch) carries the
+        # HOST's trace id — a slow server-side cycle then logs an id the
+        # operator can grep in both processes' logs.
+        if env.schedule.trace_id:
+            sched.trace_parent = (
+                env.schedule.trace_id, env.schedule.parent_span_id or None
             )
+        sched.last_batch_span = None
+        try:
+            if front is not None and not env.schedule.drain:
+                outcomes = front.schedule_raw(list(env.schedule.pod_json))
+            else:
+                if front is not None:
+                    # A drain request bypasses the cache; flush it first so
+                    # drained decisions and cached ones cannot double-commit.
+                    front.flush_hints_to_queue()
+                for raw in env.schedule.pod_json:
+                    sched.add_pod(serialize.pod_from_json(raw))
+                outcomes = (
+                    sched.schedule_all_pending()
+                    if env.schedule.drain
+                    else sched.schedule_batch()
+                )
+        finally:
+            sched.trace_parent = None
+        span = sched.last_batch_span
+        if span is not None and env.schedule.trace_id:
+            out.response.span_id = span.span_id
         for o in outcomes:
             r = out.response.results.add()
             r.pod_uid = o.pod.uid
@@ -495,6 +533,22 @@ class SidecarClient:
         env.health.SetInParent()
         return json.loads(self._call(env).response.health_json)
 
+    def metrics(self) -> str:
+        """Scrape the registry in Prometheus text exposition format —
+        byte-identical to the sidecar's plain-HTTP /metrics payload."""
+        env = pb.Envelope()
+        env.metrics.SetInParent()
+        return self._call(env).response.metrics_text.decode()
+
+    def events(self) -> list[dict]:
+        """Read the event-recorder ring (Scheduled / FailedScheduling /
+        Preempted / GangWaiting, aggregated)."""
+        import json
+
+        env = pb.Envelope()
+        env.events.SetInParent()
+        return json.loads(self._call(env).response.events_json)
+
     def subscribe(self) -> None:
         """Turn THIS connection into a decision push stream.  After the
         ack, use read_push() exclusively — request methods would desync
@@ -512,12 +566,24 @@ class SidecarClient:
             raise RuntimeError("non-push frame on a subscribed connection")
         return env.push
 
-    def schedule(self, pods=(), drain: bool = True) -> list[pb.PodResult]:
+    def schedule(
+        self, pods=(), drain: bool = True, trace=None
+    ) -> list[pb.PodResult]:
+        """``trace`` (a framework.tracing.Trace) propagates the host span's
+        (trace_id, span_id) through the envelope; the server's batch span
+        joins that trace and its span_id comes back on the response, where
+        it is recorded as a step on the host span (the joined tree)."""
         env = pb.Envelope()
         env.schedule.drain = drain
+        if trace is not None:
+            env.schedule.trace_id = trace.trace_id
+            env.schedule.parent_span_id = trace.span_id
         for p in pods:
             env.schedule.pod_json.append(serialize.to_json(p))
-        return list(self._call(env).response.results)
+        resp = self._call(env)
+        if trace is not None and resp.response.span_id:
+            trace.step(f"sidecar batch span={resp.response.span_id}")
+        return list(resp.response.results)
 
     def close(self) -> None:
         self.sock.close()

@@ -1,0 +1,118 @@
+"""Regenerate kubernetes_tpu/sidecar/sidecar_pb2.py WITHOUT protoc.
+
+The container has the protobuf Python runtime but no protoc binary, so
+schema evolution edits the serialized FileDescriptorProto directly: parse
+the current generated module's descriptor bytes, apply the (idempotent)
+delta below, and re-emit the builder-style _pb2 module.  Keep
+proto/sidecar.proto in sync BY HAND — it stays the human-readable source
+of truth; this script is the compiler.
+
+Usage: python scripts/gen_sidecar_pb2.py   (writes the module in place)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from google.protobuf import descriptor_pb2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "kubernetes_tpu", "sidecar", "sidecar_pb2.py")
+PKG = ".kubernetes_tpu.sidecar.v1"
+
+F = descriptor_pb2.FieldDescriptorProto
+
+
+def _msg(fdp, name):
+    for m in fdp.message_type:
+        if m.name == name:
+            return m
+    raise KeyError(name)
+
+
+def _has_field(msg, name) -> bool:
+    return any(f.name == name for f in msg.field)
+
+
+def _add_field(msg, name, number, ftype, *, type_name=None, oneof=None):
+    if _has_field(msg, name):
+        return
+    f = msg.field.add()
+    f.name = name
+    f.number = number
+    f.label = F.LABEL_OPTIONAL
+    f.type = ftype
+    if type_name:
+        f.type_name = type_name
+    if oneof is not None:
+        f.oneof_index = oneof
+    parts = name.split("_")
+    f.json_name = parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+def _add_empty_message(fdp, name):
+    if not any(m.name == name for m in fdp.message_type):
+        fdp.message_type.add().name = name
+
+
+def evolve(fdp: descriptor_pb2.FileDescriptorProto) -> None:
+    """The observability delta (PR: metrics/events frames + span ids)."""
+    _add_empty_message(fdp, "MetricsRequest")
+    _add_empty_message(fdp, "EventsRequest")
+    env = _msg(fdp, "Envelope")
+    # Envelope's single oneof "msg" is index 0.
+    _add_field(env, "metrics", 10, F.TYPE_MESSAGE,
+               type_name=f"{PKG}.MetricsRequest", oneof=0)
+    _add_field(env, "events", 11, F.TYPE_MESSAGE,
+               type_name=f"{PKG}.EventsRequest", oneof=0)
+    sched = _msg(fdp, "ScheduleBatchRequest")
+    _add_field(sched, "trace_id", 3, F.TYPE_STRING)
+    _add_field(sched, "parent_span_id", 4, F.TYPE_STRING)
+    resp = _msg(fdp, "Response")
+    _add_field(resp, "metrics_text", 5, F.TYPE_BYTES)
+    _add_field(resp, "events_json", 6, F.TYPE_BYTES)
+    _add_field(resp, "span_id", 7, F.TYPE_STRING)
+
+
+TEMPLATE = '''# -*- coding: utf-8 -*-
+# Generated protocol buffer code.  DO NOT EDIT BY HAND —
+# regenerate with scripts/gen_sidecar_pb2.py (protoc-free: the serialized
+# FileDescriptorProto is evolved programmatically; proto/sidecar.proto is
+# the human-readable source of truth).
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({payload!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'sidecar_pb2', globals())
+# @@protoc_insertion_point(module_scope)
+'''
+
+
+def main() -> int:
+    # Parse the CURRENT module's serialized descriptor (imports register it
+    # in the default pool of THIS process only; the write below is what
+    # matters).
+    sys.path.insert(0, REPO)
+    from kubernetes_tpu.sidecar import sidecar_pb2 as cur
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.ParseFromString(cur.DESCRIPTOR.serialized_pb)
+    evolve(fdp)
+    with open(OUT, "w") as f:
+        f.write(TEMPLATE.format(payload=fdp.SerializeToString()))
+    print(f"wrote {OUT} ({len(fdp.SerializeToString())} descriptor bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

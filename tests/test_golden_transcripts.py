@@ -183,15 +183,18 @@ def _replay(frames, server_sock, srv):
 
 
 def _dump_body(payload: bytes):
-    """(seq, canonical dump state minus metrics) for dump responses, else
-    None."""
+    """(seq, canonical dump state minus the timing-dependent series) for
+    dump responses, else None.  Metrics, the event ring (wall-clock
+    timestamps + counts that vary with backoff timing), and slow-cycle
+    span trees are narration, not scheduling state."""
     import json as _json
 
     env = pb.Envelope.FromString(payload)
     if env.WhichOneof("msg") != "response" or not env.response.dump_json:
         return None
     d = _json.loads(env.response.dump_json)
-    d.pop("metrics", None)
+    for k in ("metrics", "events", "slow_spans"):
+        d.pop(k, None)
     return env.seq, _json.dumps(d, sort_keys=True)
 
 

@@ -1,0 +1,267 @@
+"""End-to-end observability: Prometheus exposition (sidecar frame + plain
+HTTP), histogram edge cases, nested spans with cross-boundary trace ids,
+and the scheduler event recorder."""
+
+import json
+import logging
+import re
+import tempfile
+import urllib.request
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.events import NORMAL, EventBroadcaster
+from kubernetes_tpu.framework.metrics import Histogram, MetricsRegistry
+from kubernetes_tpu.framework.tracing import Trace
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar import SidecarClient, SidecarServer
+
+
+# -- metrics edge cases ------------------------------------------------------
+
+
+def test_empty_histogram_summary():
+    s = Histogram().summary()
+    assert s == {
+        "count": 0, "avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+        "overflow": 0,
+    }
+
+
+def test_overflow_bucket_quantile_returns_last_finite_bound():
+    # 90 observations in the first bucket, 10 beyond the last: the p99
+    # target (99) falls in the +Inf cell — Prometheus semantics return the
+    # last finite bound, never a value interpolated below it.
+    h = Histogram(buckets=[1.0, 2.0])
+    for _ in range(90):
+        h.observe(0.5)
+    for _ in range(10):
+        h.observe(30.0)
+    assert h.quantile(0.99) == 2.0
+    assert h.summary()["overflow"] == 10
+    # All mass beyond the last bucket: every quantile clamps.
+    h2 = Histogram(buckets=[1.0, 2.0])
+    for _ in range(10):
+        h2.observe(99.0)
+    assert h2.quantile(0.5) == 2.0 and h2.quantile(0.99) == 2.0
+    assert h2.overflow == 10
+
+
+def test_sample_plugins_per_site_independence():
+    # Interleaved call sites must not alias onto shared residues: each
+    # site fires on ITS OWN every-10th call.
+    reg = MetricsRegistry()
+    a = [reg.sample_plugins("a") for _ in range(20)]
+    b = [reg.sample_plugins("b") for _ in range(10)]
+    assert sum(a) == 2 and a[9] and a[19]
+    assert sum(b) == 1 and b[9]
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # metric name
+    r'(\{[a-zA-Z0-9_]+="[^"]*"(,[a-zA-Z0-9_]+="[^"]*")*\})?'  # labels
+    r' (-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|\+Inf|-Inf|NaN)$'  # value
+)
+
+
+def test_render_text_line_format_and_cumulative_buckets():
+    reg = MetricsRegistry()
+    reg.counter("events_total", "events").inc(reason="Scheduled")
+    reg.gauge("depth", "queue depth").set(3, queue="active")
+    reg.attempt_duration.observe(0.004)
+    reg.attempt_duration.observe(1e9)  # overflow observation
+    text = reg.render_text()
+    assert text.endswith("\n")
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _SAMPLE_RE.match(line), line
+    buckets = [
+        ln for ln in text.splitlines()
+        if ln.startswith("scheduling_attempt_duration_seconds_bucket")
+    ]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1] == 'scheduling_attempt_duration_seconds_bucket{le="+Inf"} 2'
+    assert "scheduling_attempt_duration_seconds_count 2" in text
+
+
+def test_registry_reset_keeps_handles_and_collectors():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "x")
+    c.inc()
+    reg.add_collector(lambda r: r.gauge("live", "live").set(7))
+    reg.attempt_duration.observe(1.0)
+    reg.reset()
+    assert c.get() == 0 and reg.attempt_duration.n == 0
+    c.inc()  # the pre-reset handle still writes the live family
+    text = reg.render_text()
+    assert "x_total 1" in text and "live 7" in text
+
+
+# -- tracing -----------------------------------------------------------------
+
+
+def test_nested_spans_share_trace_id_and_serialize_as_tree():
+    with Trace("root", threshold_s=99.0, pods=2) as root:
+        with root.nest("child", phase="dispatch") as child:
+            child.step("s1")
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id == root.span_id
+    assert child.span_id != root.span_id
+    d = root.as_dict()
+    assert d["children"][0]["name"] == "child"
+    assert d["children"][0]["steps"][0][0] == "s1"
+    assert d["children"][0]["parent_span_id"] == d["span_id"]
+
+
+def test_log_if_long_is_idempotent(caplog):
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+        tr = Trace("slowspan", threshold_s=0.0)
+        tr.step("a")
+        assert tr.log_if_long() is True
+        assert tr.log_if_long() is False     # second explicit call
+        tr.__exit__(None, None, None)        # and the ctx-manager exit
+    assert sum("slowspan" in r.message for r in caplog.records) == 1
+
+
+def test_remote_parent_ids_reach_the_log_header():
+    tr = Trace("server", threshold_s=99.0, trace_id="cafe", parent_span_id="beef")
+    assert tr.trace_id == "cafe" and tr.parent_span_id == "beef"
+    hdr = tr._header()
+    assert "trace=cafe" in hdr and "parent=beef" in hdr
+
+
+# -- events ------------------------------------------------------------------
+
+
+def test_event_broadcaster_aggregates_counts_and_fans_out():
+    reg = MetricsRegistry()
+    b = EventBroadcaster(registry=reg, capacity=4)
+    rec = b.new_recorder()
+    seen = []
+    b.add_sink(seen.append)
+    for _ in range(3):
+        rec.event("default/p", NORMAL, "Scheduled", "assigned")
+    evs = b.list()
+    assert len(evs) == 1 and evs[0]["count"] == 3
+    assert b.count("Scheduled") == 3
+    assert reg.counter("scheduler_events_total").get(reason="Scheduled") == 3
+    assert len(seen) == 3
+    for i in range(6):  # capacity eviction keeps the newest series
+        rec.event(f"default/q{i}", NORMAL, "Churn", "n")
+    assert len(b.list()) <= 4
+    assert b.count("Churn") == 6  # the counter survives ring eviction
+
+
+def test_scheduler_emits_structured_events():
+    s = TPUScheduler(batch_size=8)
+    s.add_node(make_node("n1").capacity({"cpu": "4", "pods": 110}).obj())
+    s.add_pod(make_pod("ok").req({"cpu": "1"}).obj())
+    s.add_pod(make_pod("stuck").req({"cpu": "999"}).obj())
+    s.schedule_all_pending()
+    by_reason = {e["reason"]: e for e in s.events.list()}
+    sch = by_reason["Scheduled"]
+    assert sch["type"] == "Normal"
+    assert "Successfully assigned default/ok to n1" in sch["note"]
+    fail = by_reason["FailedScheduling"]
+    assert fail["type"] == "Warning"
+    assert "NodeResourcesFit" in fail["plugins"]
+    assert s.events.count("Scheduled") == 1
+
+
+# -- the tier-1 smoke test: frame scrape == HTTP scrape ----------------------
+
+
+def _attempt_samples(text: str) -> dict:
+    return {
+        ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines()
+        if ln.startswith("schedule_attempts_total")
+    }
+
+
+def test_sidecar_metrics_frame_and_http_agree():
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(
+        path, scheduler=TPUScheduler(batch_size=16), http_port=0
+    )
+    srv.serve_background()
+    try:
+        client = SidecarClient(path)
+        client.add(
+            "Node",
+            make_node("n1")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+            .obj(),
+        )
+        res = client.schedule([make_pod("p").req({"cpu": "1"}).obj()])
+        assert res[0].node_name == "n1"
+        frame_text = client.metrics()
+        base = f"http://127.0.0.1:{srv.http.port}"
+        http_text = (
+            urllib.request.urlopen(f"{base}/metrics", timeout=5).read().decode()
+        )
+        fa, ha = _attempt_samples(frame_text), _attempt_samples(http_text)
+        assert fa == ha, (fa, ha)
+        assert fa['schedule_attempts_total{result="scheduled"}'] >= 1
+        for needle in (
+            "scheduling_attempt_duration_seconds_bucket",
+            'scheduler_pending_pods{queue="active"}',
+            'scheduler_pending_pods{queue="backoff"}',
+            'scheduler_pending_pods{queue="unschedulable"}',
+            'scheduler_pending_pods{queue="gang-parked"}',
+            'scheduler_events_total{reason="Scheduled"}',
+            'scheduler_cache_size{kind="nodes"}',
+            "jax_compiled_programs",
+            "device_dispatch_total",
+        ):
+            assert needle in http_text, needle
+        hz = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+        )
+        assert hz["healthy"] and hz["nodes"] == 1
+        assert any(e["reason"] == "Scheduled" for e in client.events())
+        client.close()
+    finally:
+        srv.close()
+
+
+def test_trace_id_crosses_the_sidecar_boundary(caplog):
+    path = tempfile.mktemp(suffix=".sock")
+    sched = TPUScheduler(batch_size=4)
+    sched.trace_threshold_s = 0.0  # every server-side batch is "slow"
+    srv = SidecarServer(path, scheduler=sched)
+    srv.serve_background()
+    try:
+        client = SidecarClient(path)
+        client.add(
+            "Node", make_node("n1").capacity({"cpu": "4", "pods": 110}).obj()
+        )
+        host_span = Trace("HostScheduleRPC", threshold_s=99.0)
+        with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+            client.schedule(
+                [make_pod("p").req({"cpu": "1"}).obj()], trace=host_span
+            )
+        # The server-side slow-cycle log carries the CLIENT's trace id.
+        assert any(
+            f"trace={host_span.trace_id}" in r.message
+            and "ScheduleBatch" in r.message
+            for r in caplog.records
+        )
+        # The host span linked the server's child span id from the response…
+        links = [
+            msg for msg, _ in host_span._steps
+            if msg.startswith("sidecar batch span=")
+        ]
+        assert links
+        server_span_id = links[0].split("=", 1)[1]
+        # …and the joined tree is in the dump's slow-span ring.
+        dump = client.dump()
+        assert any(
+            sp["trace_id"] == host_span.trace_id
+            and sp["span_id"] == server_span_id
+            for sp in dump["slow_spans"]
+        )
+        client.close()
+    finally:
+        srv.close()
