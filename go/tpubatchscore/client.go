@@ -8,6 +8,7 @@ import (
 	"fmt"
 	"net"
 	"sync"
+	"time"
 )
 
 // ErrSidecarDown marks transport-level failures (dial/read/write) as
@@ -16,6 +17,28 @@ import (
 // whole scheduling cycle erroring (the host's failure-response story,
 // SURVEY §5; cmd/kube-scheduler/app/server.go:181 healthz precedent).
 var ErrSidecarDown = errors.New("sidecar unreachable")
+
+// ErrBreakerOpen marks a call refused because the circuit breaker is
+// open: BreakerThreshold consecutive transport failures mean the sidecar
+// is down or hung, and hammering it per cycle only adds Deadline of
+// latency to every pod.  The plugin degrades these to a Skip status —
+// the pod schedules through the host's default path until a later call
+// (the half-open probe, once BreakerCooldown elapses) finds the sidecar
+// answering again.  Mirrors sidecar/host.py's breaker + degraded mode.
+var ErrBreakerOpen = errors.New("sidecar breaker open")
+
+// DefaultDeadline bounds every sidecar round trip (SetDeadline on the
+// connection): a hung sidecar fails calls in bounded time instead of
+// wedging the scheduling cycle on a recv that never returns.
+const DefaultDeadline = 5 * time.Second
+
+// DefaultBreakerThreshold / DefaultBreakerCooldown: consecutive failures
+// that open the breaker, and how long it stays open before a half-open
+// probe call is allowed through.
+const (
+	DefaultBreakerThreshold = 3
+	DefaultBreakerCooldown  = 2 * time.Second
+)
 
 // ResyncObject is one object the owner re-ships after a reconnect — the
 // informer-store replay (the Go analog of the Python host's
@@ -39,6 +62,15 @@ type Client struct {
 	// ResyncObjects returns the full object store to replay after a
 	// reconnect (nodes first, then pods — dependency order).  Optional.
 	ResyncObjects func() []ResyncObject
+	// Deadline bounds each round trip (0 → DefaultDeadline; negative
+	// disables).  Applied via SetDeadline before every write.
+	Deadline time.Duration
+	// BreakerThreshold/BreakerCooldown configure the circuit breaker
+	// (0 → the defaults above).
+	BreakerThreshold int
+	BreakerCooldown  time.Duration
+	failures         int       // consecutive transport failures
+	openUntil        time.Time // breaker open until this instant
 }
 
 // Dial connects to the sidecar.  network is "unix" or "tcp".
@@ -52,10 +84,46 @@ func Dial(network, addr string) (*Client, error) {
 
 func (c *Client) Close() error { return c.conn.Close() }
 
-// callLocked runs one request/response on the current connection.
+func (c *Client) deadline() time.Duration {
+	if c.Deadline == 0 {
+		return DefaultDeadline
+	}
+	return c.Deadline
+}
+
+func (c *Client) breakerThreshold() int {
+	if c.BreakerThreshold == 0 {
+		return DefaultBreakerThreshold
+	}
+	return c.BreakerThreshold
+}
+
+func (c *Client) breakerCooldown() time.Duration {
+	if c.BreakerCooldown == 0 {
+		return DefaultBreakerCooldown
+	}
+	return c.BreakerCooldown
+}
+
+// noteFailure counts one failed attempt; at the threshold the breaker
+// opens for the cooldown window.
+func (c *Client) noteFailure() {
+	c.failures++
+	if c.failures >= c.breakerThreshold() {
+		c.openUntil = time.Now().Add(c.breakerCooldown())
+	}
+}
+
+// callLocked runs one request/response on the current connection, under
+// the per-call deadline — a hung sidecar surfaces as an i/o timeout
+// (ErrSidecarDown) in bounded time.
 func (c *Client) callLocked(env *Envelope) (*Response, error) {
 	c.seq++
 	env.Seq = c.seq
+	if d := c.deadline(); d > 0 {
+		_ = c.conn.SetDeadline(time.Now().Add(d))
+		defer c.conn.SetDeadline(time.Time{})
+	}
 	if err := WriteFrame(c.conn, env); err != nil {
 		return nil, fmt.Errorf("%w: %v", ErrSidecarDown, err)
 	}
@@ -75,19 +143,32 @@ func (c *Client) callLocked(env *Envelope) (*Response, error) {
 	return resp.Response, nil
 }
 
-// call sends one envelope and waits for its response.  On a transport
-// failure it redials once, replays the owner's object store, and
-// re-issues the call; if the sidecar is still down the ErrSidecarDown
-// surfaces for the caller to degrade on (PreFilter → Unschedulable).
+// call sends one envelope and waits for its response.  While the breaker
+// is open it refuses immediately with ErrBreakerOpen (the plugin's
+// Skip→default-path signal).  On a transport failure it redials once,
+// replays the owner's object store, and re-issues the call; if the
+// sidecar is still down the ErrSidecarDown surfaces for the caller to
+// degrade on (PreFilter → Unschedulable) and the failure counts toward
+// opening the breaker.
 func (c *Client) call(env *Envelope) (*Response, error) {
 	c.mu.Lock()
 	defer c.mu.Unlock()
+	if c.failures >= c.breakerThreshold() && time.Now().Before(c.openUntil) {
+		return nil, fmt.Errorf("%w: %d consecutive failures", ErrBreakerOpen, c.failures)
+	}
+	// Past openUntil the breaker is HALF-OPEN: this call probes; success
+	// resets the count, failure re-opens the window (noteFailure).
 	resp, err := c.callLocked(env)
-	if err == nil || !errors.Is(err, ErrSidecarDown) {
+	if err == nil {
+		c.failures = 0
+		return resp, nil
+	}
+	if !errors.Is(err, ErrSidecarDown) {
 		return resp, err
 	}
 	conn, derr := net.Dial(c.network, c.addr)
 	if derr != nil {
+		c.noteFailure()
 		return nil, err // still down; surface the original failure
 	}
 	_ = c.conn.Close()
@@ -97,11 +178,18 @@ func (c *Client) call(env *Envelope) (*Response, error) {
 			if _, rerr := c.callLocked(&Envelope{
 				Add: &AddObject{Kind: obj.Kind, ObjectJSON: obj.JSON},
 			}); rerr != nil {
+				c.noteFailure()
 				return nil, fmt.Errorf("resync replay: %w", rerr)
 			}
 		}
 	}
-	return c.callLocked(env)
+	resp, err = c.callLocked(env)
+	if err != nil {
+		c.noteFailure()
+	} else {
+		c.failures = 0
+	}
+	return resp, err
 }
 
 // AddObject upserts a cluster object (Node, Pod, PersistentVolume, …).

@@ -341,6 +341,19 @@ func (p *Plugin) PreFilter(ctx context.Context, state *framework.CycleState, pod
 	// No plugin-level mutex: the Client serializes the wire itself, and the
 	// scheduling loop is one pod at a time anyway (scheduler.go:470).
 	results, err := p.client.Schedule([][]byte{raw}, false)
+	if errors.Is(err, ErrBreakerOpen) {
+		// Breaker open: the sidecar has been failing for consecutive
+		// calls and the client refuses to add a deadline of latency per
+		// pod.  Skip removes this plugin from the whole cycle
+		// (Filter/Score/PostFilter included), so the profile's remaining
+		// plugins schedule the pod host-side — the DEGRADED mode of the
+		// Python host (sidecar/host.py), expressed in framework terms.
+		// Once the cooldown elapses a later call half-opens the breaker
+		// and wire dispatch resumes by itself.
+		klog.V(2).InfoS("sidecar breaker open; degrading to default path",
+			"pod", klog.KObj(pod))
+		return nil, framework.NewStatus(framework.Skip)
+	}
 	if errors.Is(err, ErrSidecarDown) {
 		// Degrade, don't error: the pod requeues with a visible reason
 		// and retries when the sidecar returns (the informer stream plus
@@ -396,7 +409,10 @@ func (p *Plugin) Filter(ctx context.Context, state *framework.CycleState, pod *v
 func (p *Plugin) Score(ctx context.Context, state *framework.CycleState, pod *v1.Pod, nodeName string) (int64, *framework.Status) {
 	d, err := state.Read(stateKey)
 	if err != nil {
-		return 0, framework.AsStatus(err)
+		// No sidecar verdict this cycle (PreFilter skipped on an open
+		// breaker): score neutrally instead of erroring the cycle — the
+		// default plugins own the decision in degraded mode.
+		return 0, nil
 	}
 	sd := d.(*stateData)
 	if nodeName == sd.result.NodeName {
@@ -413,7 +429,10 @@ func (p *Plugin) ScoreExtensions() framework.ScoreExtensions { return nil }
 func (p *Plugin) PostFilter(ctx context.Context, state *framework.CycleState, pod *v1.Pod, _ framework.NodeToStatusReader) (*framework.PostFilterResult, *framework.Status) {
 	d, err := state.Read(stateKey)
 	if err != nil {
-		return nil, framework.AsStatus(err)
+		// No sidecar verdict this cycle (PreFilter skipped on an open
+		// breaker): no nomination to relay — requeue, don't error.
+		return nil, framework.NewStatus(framework.Unschedulable,
+			"sidecar degraded: no preemption verdict")
 	}
 	sd := d.(*stateData)
 	if sd.result.NominatedNode == "" {

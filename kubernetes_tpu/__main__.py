@@ -219,10 +219,14 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _cli_deadline(args) -> float | None:
+    return args.deadline if args.deadline and args.deadline > 0 else None
+
+
 def cmd_dump(args) -> int:
     from .sidecar import SidecarClient
 
-    client = SidecarClient(args.socket)
+    client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
     print(json.dumps(client.dump(), indent=2, sort_keys=True))
     client.close()
     return 0
@@ -233,7 +237,7 @@ def cmd_metrics(args) -> int:
     frame) — same bytes its /metrics HTTP endpoint serves."""
     from .sidecar import SidecarClient
 
-    client = SidecarClient(args.socket)
+    client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
     if args.events:
         print(json.dumps(client.events(), indent=2))
     else:
@@ -297,12 +301,21 @@ def main(argv: list[str] | None = None) -> int:
 
     d = sub.add_parser("dump", help="debugger dump of a live sidecar")
     d.add_argument("--socket", required=True)
+    d.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds (a hung sidecar fails the "
+        "probe in bounded time); <=0 waits forever",
+    )
     d.set_defaults(fn=cmd_dump)
 
     mtr = sub.add_parser(
         "metrics", help="scrape a live sidecar (Prometheus text / events)"
     )
     mtr.add_argument("--socket", required=True)
+    mtr.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds; <=0 waits forever",
+    )
     mtr.add_argument(
         "--events", action="store_true",
         help="print the event-recorder ring as JSON instead of metrics",

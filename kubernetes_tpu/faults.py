@@ -1,0 +1,229 @@
+"""Deterministic fault injection for the host↔sidecar dispatch path.
+
+The two-tier split (SURVEY §7) puts a process boundary in the middle of
+the scheduling loop, and the reference scheduler's answer to a flaky
+apiserver — error → backoff requeue, keep making progress
+(schedule_one.go handleSchedulingFailure) — must hold across it.  This
+module is the test substrate for that claim: a ``FaultPlan`` describes a
+reproducible sequence of transport and engine failures, wraps the client
+side of the sidecar socket pair and the scheduler's engine dispatch, and
+fires each fault on exactly the Nth matching call.  Seeded, counted and
+recorded, so a failing fault-matrix case replays bit-identically.
+
+Fault kinds on the wire (applied when the client writes a request frame):
+
+- ``hang``          — swallow the request; the sidecar never sees it, the
+                      client's recv blocks until its deadline fires (the
+                      hung-sidecar shape: process alive, dispatch wedged).
+- ``slow``          — delay the request by ``delay_s`` then deliver it
+                      (degraded-but-alive; must NOT trip deadlines when
+                      ``delay_s`` < the client deadline).
+- ``crash``         — deliver nothing and sever the connection (the
+                      sidecar died mid-call; recv sees EOF immediately).
+- ``partial_write`` — deliver a torn frame (half the bytes) then sever
+                      (crash mid-write; the server's framed read must
+                      treat the tail as EOF, not parse garbage).
+
+Engine faults (applied when the scheduler dispatches a device batch):
+
+- ``engine``        — raise from inside the batch.  With ``pod`` set, the
+                      rule poisons that pod: every batch containing it
+                      raises (the poison-pod shape quarantine exists
+                      for); without ``pod``, the Nth dispatch raises once
+                      (a transient engine failure).
+
+Every fired fault is appended to ``plan.fired`` as ``(kind, op, count)``;
+two plans built from the same rules and seed fire identically, which is
+what ``replay()`` returns and what scripts/run_fault_matrix.py sweeps.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+_LEN = struct.Struct(">I")
+
+
+class EngineFault(RuntimeError):
+    """An engine failure attributable to specific pods.  The scheduler's
+    batch recovery uses ``pod_uids`` to isolate the poison pods directly;
+    an exception without attribution is bisected instead."""
+
+    def __init__(self, msg: str, pod_uids: tuple[str, ...] = ()):
+        super().__init__(msg)
+        self.pod_uids = tuple(pod_uids)
+
+
+@dataclass
+class FaultRule:
+    """One fault: ``kind`` fired on the ``nth`` call matching ``op``.
+
+    ``op`` matches the envelope's message kind ("schedule", "add",
+    "remove", "dump", …) or "*" for any request frame; engine rules
+    ignore it.  ``every`` keeps firing from the nth match onward (a
+    persistently hung sidecar); pod-keyed engine rules are inherently
+    ``every`` — the poison is a property of the pod, not of one call."""
+
+    kind: str                 # hang | slow | crash | partial_write | engine
+    op: str = "*"
+    nth: int = 1
+    every: bool = False
+    times: int = 0            # with every: fire at most this many (0 = ∞)
+    delay_s: float = 0.05     # slow: injected latency
+    pod: str | None = None    # engine: poison pod uid
+    attributed: bool = True   # engine: raise EngineFault(pod_uids) vs bare
+
+
+class FaultPlan:
+    """A seeded, replayable fault schedule.
+
+    Wire faults install via ``wrap(sock)`` (or ``wrap_client(client)``);
+    engine faults install via ``install_engine(scheduler)``.  The plan is
+    shared mutable state across every wrapped socket — reconnects re-wrap
+    the fresh socket through the same plan, so an ``every`` rule keeps
+    biting across resyncs exactly like a genuinely wedged sidecar would."""
+
+    def __init__(self, rules: list[FaultRule] | None = None, seed: int = 0):
+        self.seed = seed
+        self.rules = list(rules or ())
+        self.rng = random.Random(seed)
+        self.fired: list[tuple[str, str, int]] = []
+        self._op_counts: dict[str, int] = {}
+        self._engine_calls = 0
+        self._lock = threading.Lock()
+
+    # -- construction ------------------------------------------------------
+
+    def add_rule(self, kind: str, **kw) -> "FaultPlan":
+        self.rules.append(FaultRule(kind, **kw))
+        return self
+
+    def replay(self) -> "FaultPlan":
+        """A fresh plan with the same rules and seed — fires identically
+        against the same call sequence (the reproducibility contract)."""
+        return FaultPlan(
+            [FaultRule(**vars(r)) for r in self.rules], seed=self.seed
+        )
+
+    # -- wire side ---------------------------------------------------------
+
+    def wrap(self, sock: socket.socket) -> "FaultySocket":
+        return FaultySocket(sock, self)
+
+    def wrap_client(self, client) -> None:
+        """Wrap a SidecarClient's live socket in place."""
+        client.sock = self.wrap(client.sock)
+
+    def _match_wire(self, op: str) -> FaultRule | None:
+        with self._lock:
+            count = self._op_counts.get(op, 0) + 1
+            self._op_counts[op] = count
+            for r in self.rules:
+                if r.kind == "engine" or r.op not in ("*", op):
+                    continue
+                if count == r.nth or (
+                    r.every
+                    and count >= r.nth
+                    and (r.times == 0 or count < r.nth + r.times)
+                ):
+                    self.fired.append((r.kind, op, count))
+                    return r
+        return None
+
+    # -- engine side -------------------------------------------------------
+
+    def install_engine(self, scheduler) -> None:
+        scheduler.fault_injector = self
+
+    def on_engine_dispatch(self, pods) -> None:
+        """Called by TPUScheduler at the top of every device-batch
+        dispatch (bisect retries included).  Raises to poison the batch."""
+        with self._lock:
+            self._engine_calls += 1
+            n = self._engine_calls
+            for r in self.rules:
+                if r.kind != "engine":
+                    continue
+                if r.pod is not None:
+                    poisoned = [p.uid for p in pods if p.uid == r.pod]
+                    if not poisoned:
+                        continue
+                    self.fired.append(("engine", r.pod, n))
+                    if r.attributed:
+                        raise EngineFault(
+                            f"injected engine fault for {r.pod}",
+                            tuple(poisoned),
+                        )
+                    raise RuntimeError(
+                        f"injected unattributed engine fault (batch of "
+                        f"{len(pods)})"
+                    )
+                if n == r.nth or (r.every and n >= r.nth):
+                    self.fired.append(("engine", "*", n))
+                    raise EngineFault("injected engine fault", ())
+
+
+def _frame_op(data: bytes) -> str:
+    """Envelope message kind of one length-prefixed frame ("?" when the
+    buffer isn't a single parseable frame — faults still count it)."""
+    try:
+        (n,) = _LEN.unpack(data[:4])
+        if len(data) != 4 + n:
+            return "?"
+        from .sidecar import sidecar_pb2 as pb  # lazy: avoid import cycle
+
+        env = pb.Envelope()
+        env.ParseFromString(data[4:])
+        return env.WhichOneof("msg") or "?"
+    except Exception:
+        return "?"
+
+
+class FaultySocket:
+    """A socket proxy applying a FaultPlan to outbound request frames.
+
+    Clients write one full frame per ``sendall`` (write_frame), so the
+    proxy can classify the envelope and consult the plan per call.  Reads
+    and everything else delegate untouched — response-side faults are
+    modeled as request-side ones (a swallowed request IS an unanswered
+    call from where the client sits)."""
+
+    def __init__(self, sock: socket.socket, plan: FaultPlan):
+        self._sock = sock
+        self._plan = plan
+
+    def sendall(self, data: bytes) -> None:
+        rule = self._plan._match_wire(_frame_op(data))
+        if rule is None:
+            return self._sock.sendall(data)
+        if rule.kind == "slow":
+            time.sleep(rule.delay_s)
+            return self._sock.sendall(data)
+        if rule.kind == "hang":
+            return None  # swallowed: the sidecar never sees the request
+        if rule.kind == "partial_write":
+            torn = data[: max(1, len(data) // 2)]
+            try:
+                self._sock.sendall(torn)
+            finally:
+                self._sever()
+            return None
+        if rule.kind == "crash":
+            self._sever()
+            return None
+        raise ValueError(f"unknown wire fault {rule.kind!r}")
+
+    def _sever(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)

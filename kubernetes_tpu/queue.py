@@ -184,6 +184,14 @@ class SchedulingQueue:
         self._unsched_req_cache: dict[tuple, tuple[list, np.ndarray]] = {}
         self._info: dict[str, QueuedPodInfo] = {}
         self._in_active: set[str] = set()
+        # Quarantine pool: pods whose presence in a batch made the ENGINE
+        # raise (poison pods, isolated by the scheduler's batch bisect).
+        # Unlike the unschedulable pool, no cluster event re-admits them —
+        # the failure is a property of the pod, not of capacity — so they
+        # sit here until an operator (or a spec update, which invalidates
+        # the poison featurization) releases them back through the backoff
+        # machinery.  Surfaced as scheduler_pending_pods{queue="quarantine"}.
+        self._quarantine: dict[str, QueuedPodInfo] = {}
         self.initial_backoff_s = initial_backoff_s
         self.max_backoff_s = max_backoff_s
         self.max_unschedulable_s = max_unschedulable_s
@@ -225,8 +233,42 @@ class SchedulingQueue:
             + len(self._backoff)
             + len(self._unschedulable)
             + len(self._gated)
+            + len(self._quarantine)
             + sum(len(p) for p in self._gang_pool.values())
         )
+
+    # -- quarantine ------------------------------------------------------------
+
+    def quarantine(self, qp: QueuedPodInfo) -> None:
+        """Isolate a poison pod (its batch made the engine raise).  Re-owns
+        the info entry pop_batch dropped; the pod leaves every other pool
+        and stays out of scheduling until released."""
+        uid = qp.pod.uid
+        self._info[uid] = qp
+        self._in_active.discard(uid)
+        self._unsched_remove(uid)
+        qp.unschedulable_plugins = {"EngineFault"}
+        qp.timestamp = self._clock()
+        qp.delta = None  # featurization is suspect — never trust it again
+        self._quarantine[uid] = qp
+
+    def quarantined(self) -> list[str]:
+        return list(self._quarantine)
+
+    def release_quarantine(self, uid: str | None = None) -> int:
+        """Hand quarantined pod(s) back through the backoff machinery (an
+        operator action after a fix, or the update path after a spec
+        change).  Backoff grows with the pod's accumulated attempts, so a
+        still-poisonous pod re-quarantines at a bounded retry rate instead
+        of wedging batches back-to-back."""
+        uids = [uid] if uid is not None else list(self._quarantine)
+        n = 0
+        for u in uids:
+            qp = self._quarantine.pop(u, None)
+            if qp is not None:
+                self.add_backoff(qp)
+                n += 1
+        return n
 
     # -- gang admission --------------------------------------------------------
 
@@ -275,6 +317,12 @@ class SchedulingQueue:
 
     def add(self, pod: t.Pod) -> None:
         now = self._clock()
+        if pod.uid in self._quarantine:
+            # Informer re-deliveries must not resurrect a poison pod into
+            # the active queue; spec CHANGES go through update(), which
+            # does release it (new featurization, new chance).
+            self._quarantine[pod.uid].pod = pod
+            return
         qp = self._info.get(pod.uid)
         if qp is None:
             qp = QueuedPodInfo(pod=pod, timestamp=now, initial_attempt_timestamp=now)
@@ -586,6 +634,13 @@ class SchedulingQueue:
             or qp.pod.spec != pod.spec
         )
         qp.pod = pod
+        if pod.uid in self._quarantine:
+            # A spec/label change invalidates the poison featurization:
+            # give the pod another chance, behind backoff (its attempt
+            # count keeps the retry rate bounded if it is still poison).
+            if changed:
+                self.release_quarantine(pod.uid)
+            return
         if qp.gated and not pod.spec.scheduling_gates:
             self.remove_gate(pod.uid)
             return
@@ -605,6 +660,7 @@ class SchedulingQueue:
         self._in_active.discard(uid)
         self._unsched_remove(uid)
         self._gated.pop(uid, None)
+        self._quarantine.pop(uid, None)
         qp = self._info.pop(uid, None)
         if qp is not None and qp.pod.spec.pod_group:
             self._untrack_gang_member(qp.pod)
@@ -628,6 +684,7 @@ class SchedulingQueue:
             "unschedulable": len(self._unschedulable),
             "gated": len(self._gated),
             "gang-parked": sum(len(p) for p in self._gang_pool.values()),
+            "quarantine": len(self._quarantine),
         }
 
     def dump(self) -> dict:
@@ -640,4 +697,5 @@ class SchedulingQueue:
             "unschedulable": d["unschedulable"],
             "gated": d["gated"],
             "gang_pool": {g: sorted(p) for g, p in self._gang_pool.items()},
+            "quarantine": sorted(self._quarantine),
         }

@@ -20,6 +20,7 @@ import numpy as np
 from .api import types as t
 from .cache import Cache
 from .engine.features import build_pod_batch
+from .faults import EngineFault
 from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
 from .framework.events import NORMAL, WARNING, EventBroadcaster
@@ -318,6 +319,12 @@ class TPUScheduler:
         # of each batch — host work done here (the speculative frontend's
         # hint parse/build) hides under the in-flight pass.
         self.post_dispatch_hook = None
+        # Fault injection hook (faults.FaultPlan.install_engine): called
+        # with the batch's pods at the top of every device dispatch.  None
+        # in production; the batch-recovery path it exercises (bisect +
+        # quarantine) is always armed — a REAL engine exception takes the
+        # same road.
+        self.fault_injector = None
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -353,6 +360,17 @@ class TPUScheduler:
         deferred = reg.counter(
             "scheduler_deferred_pods_total",
             "Pods deferred to the strict tail by chunk conflicts.",
+        )
+        # Poison-batch recovery observability: how often the engine raised
+        # mid-batch and how many pods ended up isolated.  The quarantine
+        # DEPTH rides scheduler_pending_pods{queue="quarantine"} below.
+        self._engine_fault_counter = reg.counter(
+            "scheduler_engine_faults_total",
+            "Engine exceptions caught by the batch-recovery path.",
+        )
+        self._quarantine_counter = reg.counter(
+            "scheduler_quarantined_pods_total",
+            "Pods isolated into the quarantine pool after engine faults.",
         )
         pending = reg.gauge(
             "scheduler_pending_pods", "Pending pods by queue class."
@@ -574,6 +592,12 @@ class TPUScheduler:
                 # double-apply the resource delta and gang credit (ADVICE r2).
                 self.update_pod(pod)
                 return
+            # A pod we knew as PENDING arriving bound (another scheduler —
+            # or this host's degraded mode — bound it; the replay after a
+            # resync re-ships it with its node) must leave the queue: a
+            # later drain re-scheduling an already-bound pod would
+            # double-apply its resource delta.
+            self.queue.delete(pod.uid)
             self.cache.add_pod(pod)
             # Informer-delivered bound gang members count toward quorum —
             # delete_pod debits symmetrically.
@@ -586,6 +610,13 @@ class TPUScheduler:
             self.taint_eviction.handle_pod_assigned(pod, pod.spec.node_name)
             self.queue.on_event(Event.POD_ADD)
         else:
+            if pod.uid in self.cache.pods:
+                # At-least-once re-delivery: a pod we already hold bound/
+                # assumed arriving WITHOUT its node (a host's resync replay
+                # recorded it before the binding response landed).  The
+                # commit already happened — re-queueing would double-apply
+                # its resource delta on the next drain.
+                return
             self.queue.add(pod)
 
     def update_pod(self, pod: t.Pod) -> None:
@@ -597,6 +628,16 @@ class TPUScheduler:
         anti-affinity pod wakes when the blocking pod's label changes."""
         pr = self.cache.pods.get(pod.uid)
         if pr is not None:
+            if pod.spec.node_name and pod.spec.node_name != pr.node_name:
+                # The upsert carries a DIFFERENT node: host truth rebound
+                # the pod (a resync replay overriding a stale local
+                # placement — the host store is the apiserver analog).
+                # Relocate via remove+add (cache.go updatePod's
+                # removePod+addPod contract); cache.update_pod alone only
+                # rewrites the delta on the pod's current node.
+                self.delete_pod(pod.uid, notify=False)
+                self.add_pod(pod)
+                return
             old = pr.pod
             if (
                 old.metadata.labels == pod.metadata.labels
@@ -1485,7 +1526,10 @@ class TPUScheduler:
                 tr.step("extender chain complete")
                 return out
             if len(self.profiles) == 1:
-                return self._batch_traced(tr, infos, work)
+                try:
+                    return self._batch_traced(tr, infos, work)
+                except Exception as exc:
+                    return self._recover_batch(infos, self.profile, exc)
             by_profile: dict[str, list[QueuedPodInfo]] = {}
             for qp in infos:
                 prof = self._profile_for(qp.pod) or self.profile
@@ -1493,7 +1537,14 @@ class TPUScheduler:
             out = []
             for name, group in by_profile.items():
                 with tr.nest("ProfileBatch", profile=name, pods=len(group)):
-                    out.extend(self._schedule_infos(group, self.profiles[name]))
+                    try:
+                        out.extend(
+                            self._schedule_infos(group, self.profiles[name])
+                        )
+                    except Exception as exc:
+                        out.extend(
+                            self._recover_batch(group, self.profiles[name], exc)
+                        )
             return out
 
     def _batch_traced(
@@ -1649,6 +1700,12 @@ class TPUScheduler:
         """Flush state and dispatch the device pass (async).  A prefetched
         ``work`` is dropped when anything featurization reads changed since
         (catalog binds, vocab growth from another profile's batch)."""
+        if self.fault_injector is not None:
+            # Injected engine faults fire HERE — before featurization and
+            # any state mutation — so the recovery path retries against
+            # clean state, exactly like an exception thrown by the real
+            # featurize/dispatch code below would.
+            self.fault_injector.on_engine_dispatch([qp.pod for qp in infos])
         if work is not None and work["version"] != self.builder.feature_version():
             work = None  # stale prefetch
         if work is None:
@@ -1783,6 +1840,87 @@ class TPUScheduler:
     ) -> list[ScheduleOutcome]:
         profile = profile or self.profile
         return self._complete_batch(self._dispatch_batch(infos, profile))
+
+    # -- poison-batch recovery ---------------------------------------------
+
+    def _recover_batch(
+        self, infos: list[QueuedPodInfo], profile: Profile, exc: Exception
+    ) -> list[ScheduleOutcome]:
+        """An engine exception failed a whole batch: isolate the poison
+        pod(s) and complete the healthy remainder, so one bad pod can
+        never wedge the cluster (handleSchedulingFailure's keep-making-
+        progress contract, applied to a batch).
+
+        An ``EngineFault`` that names its pods is split directly; an
+        anonymous exception is bisected — halve, retry, recurse — which
+        terminates in O(k log k) sub-batches and quarantines exactly the
+        singletons that still raise alone.  The device mirror is rebuilt
+        from host truth before every retry: a mid-batch failure leaves it
+        suspect, and host staging is the authoritative cache."""
+        self._engine_fault_counter.inc()
+        self.rebuild_device_state()
+        # A mid-COMMIT failure (_complete_batch phase 2+) leaves part of
+        # the batch already assumed in the host cache; re-dispatching
+        # those pods would double-apply their resource deltas.  They are
+        # committed — report their cached placement instead of retrying.
+        out: list[ScheduleOutcome] = []
+        uncommitted: list[QueuedPodInfo] = []
+        for qp in infos:
+            pr = self.cache.pods.get(qp.pod.uid)
+            if pr is not None and pr.node_name:
+                out.append(ScheduleOutcome(qp.pod, pr.node_name))
+            else:
+                uncommitted.append(qp)
+        infos = uncommitted
+        if not infos:
+            return out
+        if isinstance(exc, EngineFault) and exc.pod_uids:
+            poison = [qp for qp in infos if qp.pod.uid in exc.pod_uids]
+            healthy = [qp for qp in infos if qp.pod.uid not in exc.pod_uids]
+            if poison:
+                out.extend(self._quarantine_poison(qp, exc) for qp in poison)
+                if healthy:
+                    out.extend(self._schedule_safe(healthy, profile))
+                return out
+        if len(infos) == 1:
+            out.append(self._quarantine_poison(infos[0], exc))
+            return out
+        mid = len(infos) // 2
+        for half in (infos[:mid], infos[mid:]):
+            out.extend(self._schedule_safe(half, profile))
+        return out
+
+    def _schedule_safe(
+        self, infos: list[QueuedPodInfo], profile: Profile
+    ) -> list[ScheduleOutcome]:
+        try:
+            return self._schedule_infos(infos, profile)
+        except Exception as exc:
+            return self._recover_batch(infos, profile, exc)
+
+    def _quarantine_poison(
+        self, qp: QueuedPodInfo, exc: Exception
+    ) -> ScheduleOutcome:
+        """Park one poison pod in the queue's quarantine pool and narrate
+        it: a FailedScheduling event carrying the exception (the operator's
+        why-is-my-pod-stuck surface) plus the quarantine counters."""
+        self.queue.quarantine(qp)
+        self._quarantine_counter.inc()
+        # The failed batch never reached _complete_batch's per-pod attempt
+        # accounting: count the attempt here so the exported
+        # schedule_attempts_total cells keep summing to the attempt total.
+        self.metrics.schedule_attempts += 1
+        self.metrics.unschedulable += 1
+        self.recorder.event(
+            qp.pod.uid, WARNING, "FailedScheduling",
+            f"pod quarantined: engine dispatch raised "
+            f"{type(exc).__name__}: {exc}",
+            quarantined=True,
+        )
+        return ScheduleOutcome(
+            qp.pod, None,
+            diagnosis=Diagnosis(unschedulable_plugins={"EngineFault"}),
+        )
 
     def _complete_batch(self, ctx: dict) -> list[ScheduleOutcome]:
         infos, profile = ctx["infos"], ctx["profile"]

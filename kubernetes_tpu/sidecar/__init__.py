@@ -2,4 +2,12 @@
 (the Go kube-scheduler's out-of-tree plugin set, or the bundled native C++
 client) uses to drive the TPU engine.  See proto/sidecar.proto."""
 
-from .server import SidecarClient, SidecarServer, read_frame, write_frame  # noqa: F401
+from .server import (  # noqa: F401
+    DeadlineExceeded,
+    FrameError,
+    SidecarClient,
+    SidecarServer,
+    read_frame,
+    read_frame_resync,
+    write_frame,
+)

@@ -1,4 +1,4 @@
-"""Host-side resync: survive a sidecar crash/restart.
+"""Host-side resilience: survive a sidecar that crashes, restarts, OR hangs.
 
 The reference scheduler is stateless across restarts — etcd is the truth
 and a restarted scheduler rebuilds cache+queue from informer LIST+WATCH
@@ -9,20 +9,36 @@ reconnects and replays its object store, and the fresh sidecar rebuilds
 exactly like the reference rebuilds from the apiserver.
 
 ``ResyncingClient`` is that host piece: a SidecarClient wrapper that
-mirrors every object it ships (the informer-store analog), detects a dead
-connection on any call, reconnects with backoff, replays the full store
-in dependency order, and then re-issues the failed call.  Bound pods are
-replayed WITH their node (the host learned the binding from the schedule
-response — in the reference the binding lives in etcd), so a restarted
-sidecar's resource accounting matches the pre-crash cluster."""
+mirrors every object it ships (the informer-store analog), puts a
+deadline on every call, detects a dead OR hung connection, reconnects
+with jittered bounded retries, replays the full store in dependency
+order, and re-issues the failed call.  Bound pods are replayed WITH
+their node (the host learned the binding from the schedule response — in
+the reference the binding lives in etcd), so a restarted sidecar's
+resource accounting matches the pre-crash cluster.
+
+Beyond the resync: a CIRCUIT BREAKER.  After ``breaker_threshold``
+consecutive failures the client stops hammering the sidecar and enters
+DEGRADED mode — filter/score evaluate host-side on a local engine built
+from the same mirrored store (the in-process ops path the wire normally
+bypasses; being the same deterministic engine, degraded bindings are
+bit-identical to healthy ones) — while a background thread re-probes the
+sidecar and the next dispatch after a successful probe replays the store
+and resumes wire dispatch.  Observable via ``scheduler_sidecar_state``
+and ``scheduler_degraded_dispatches_total`` on ``client.registry``; the
+same semantics are mirrored by the Go plugin (go/tpubatchscore/client.go
+SetDeadline + breaker, plugin.go Skip→default path)."""
 
 from __future__ import annotations
 
+import random
+import threading
 import time
 
 from ..api import serialize
+from ..framework.metrics import MetricsRegistry
 from . import sidecar_pb2 as pb
-from .server import SidecarClient
+from .server import DeadlineExceeded, SidecarClient, fill_result
 
 # Replay order: everything a pod references must exist before the pod.
 _REPLAY_ORDER = (
@@ -37,20 +53,92 @@ def _key(kind: str, obj) -> str:
     return obj.uid if kind == "Pod" else obj.name
 
 
+class BreakerOpen(ConnectionError):
+    """The circuit breaker tripped: the sidecar keeps failing and calls
+    now degrade to host-side evaluation instead of hammering it."""
+
+
 class ResyncingClient:
     def __init__(
         self,
         path: str,
         max_reconnect_s: float = 10.0,
         retry_interval_s: float = 0.05,
+        deadline_s: float = 5.0,
+        max_call_retries: int = 3,
+        breaker_threshold: int = 3,
+        probe_interval_s: float = 0.5,
+        fallback_factory=None,
+        socket_wrapper=None,
+        registry=None,
+        seed: int = 0,
     ):
         self.path = path
         self.max_reconnect_s = max_reconnect_s
         self.retry_interval_s = retry_interval_s
+        # Per-call deadline (the SetDeadline the Go client mirrors): a
+        # HUNG sidecar — process alive, dispatch wedged — fails calls in
+        # bounded time instead of blocking the host forever.
+        self.deadline_s = deadline_s
+        # Reconnect+reissue attempts per call before the failure escapes.
+        self.max_call_retries = max_call_retries
+        # Consecutive failures (across calls) that open the breaker.
+        self.breaker_threshold = breaker_threshold
+        self.probe_interval_s = probe_interval_s
+        # Degraded-mode engine factory; None → a default TPUScheduler.
+        # Wire deployments pass the factory that matches the sidecar's
+        # configuration so degraded decisions are bit-identical.
+        self.fallback_factory = fallback_factory
+        # Optional socket decorator applied on every (re)connect — the
+        # fault-injection seam (faults.FaultPlan.wrap), so injected
+        # faults survive reconnects like a genuinely sick sidecar would.
+        self.socket_wrapper = socket_wrapper
         self.resyncs = 0  # observable: how many times the store was replayed
+        self.degraded = False
+        self._rng = random.Random(seed)  # jitter source, seedable
+        self._consecutive_failures = 0
         self._store: dict[str, dict[str, object]] = {k: {} for k in _REPLAY_ORDER}
         self._ns_labels: dict[str, dict] = {}
-        self._client = SidecarClient(path)
+        self.registry = registry or MetricsRegistry()
+        self._state_gauge = self.registry.gauge(
+            "scheduler_sidecar_state",
+            "Sidecar dispatch state (1 on the active cell).",
+        )
+        self._degraded_counter = self.registry.counter(
+            "scheduler_degraded_dispatches_total",
+            "Schedule dispatches evaluated host-side (breaker open).",
+        )
+        self._timeout_counter = self.registry.counter(
+            "scheduler_sidecar_call_timeouts_total",
+            "Sidecar calls that hit the per-call deadline.",
+        )
+        self._breaker_counter = self.registry.counter(
+            "scheduler_sidecar_breaker_trips_total",
+            "Times consecutive failures opened the circuit breaker.",
+        )
+        self._fallback = None
+        # Deletes applied while DEGRADED never reached the sidecar; a
+        # hung-but-alive sidecar still holds those objects, so the
+        # recovery replay (upserts only) must reconcile removals first.
+        self._tombstones: list[tuple[str, str]] = []
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._probe_conn: SidecarClient | None = None
+        self._lock = threading.Lock()  # guards the probe handover
+        self._client = self._connect()
+        self._set_state("healthy")
+
+    # -- wiring ------------------------------------------------------------
+
+    def _connect(self) -> SidecarClient:
+        client = SidecarClient(self.path, deadline_s=self.deadline_s)
+        if self.socket_wrapper is not None:
+            client.sock = self.socket_wrapper(client.sock)
+        return client
+
+    def _set_state(self, state: str) -> None:
+        for s in ("healthy", "degraded"):
+            self._state_gauge.set(1.0 if s == state else 0.0, state=s)
 
     # -- informer-store bookkeeping ---------------------------------------
 
@@ -63,7 +151,7 @@ class ResyncingClient:
         deadline = time.monotonic() + self.max_reconnect_s
         while True:
             try:
-                self._client = SidecarClient(self.path)
+                self._client = self._connect()
                 break
             except OSError:
                 if time.monotonic() > deadline:
@@ -82,41 +170,259 @@ class ResyncingClient:
             for obj in self._store.get(kind, {}).values():
                 self._client.add(kind, obj)
 
+    def _note_failure(self, exc: Exception) -> None:
+        """Count one failed attempt; trips the breaker at the threshold."""
+        if isinstance(exc, DeadlineExceeded):
+            self._timeout_counter.inc()
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.breaker_threshold:
+            self._enter_degraded()
+            raise BreakerOpen(
+                f"{self._consecutive_failures} consecutive sidecar failures"
+                f" (last: {exc})"
+            ) from exc
+
     def _with_resync(self, fn):
-        """Run ``fn`` against the live client; on a dead connection,
-        reconnect+replay once and re-issue."""
+        """Run ``fn`` against the live client.  On a dead/hung connection,
+        reconnect+replay and re-issue in a BOUNDED loop with jittered
+        sleeps — a second crash during the replay or the re-issued call is
+        retried, not fatal.  ``breaker_threshold`` consecutive failures
+        raise BreakerOpen instead (the caller degrades host-side)."""
+        attempts = 0
+        while True:
+            try:
+                result = fn()
+            except (ConnectionError, BrokenPipeError, OSError) as exc:
+                failure = exc
+            else:
+                self._consecutive_failures = 0
+                return result
+            while True:
+                self._note_failure(failure)  # may raise BreakerOpen
+                attempts += 1
+                if attempts > self.max_call_retries:
+                    raise failure
+                time.sleep(self.retry_interval_s * (0.5 + self._rng.random()))
+                try:
+                    self._reconnect()
+                    break
+                except (ConnectionError, BrokenPipeError, OSError) as exc:
+                    failure = exc
+
+    # -- degraded mode -----------------------------------------------------
+
+    def _enter_degraded(self) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self._breaker_counter.inc()
+        self._set_state("degraded")
         try:
-            return fn()
+            self._client.close()
+        except OSError:
+            pass
+        self._start_probe()
+
+    def _start_probe(self) -> None:
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True
+        )
+        self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        """Background re-probe while degraded: dial + health until the
+        sidecar answers, then park the verified connection for the next
+        dispatch — the replay must interleave with the store, which only
+        the caller's thread may touch."""
+        while not self._probe_stop.wait(self.probe_interval_s):
+            try:
+                conn = self._connect()
+                conn.health()
+            except Exception:
+                continue
+            with self._lock:
+                if self._probe_stop.is_set():
+                    # close() already drained the handover slot: a
+                    # connection parked now would leak.
+                    conn.close()
+                    return
+                self._probe_conn = conn
+            return
+
+    def _maybe_recover(self) -> None:
+        """Complete a recovery the probe thread initiated: replay the
+        store through its verified connection and resume wire dispatch."""
+        if not self.degraded:
+            return
+        with self._lock:
+            conn, self._probe_conn = self._probe_conn, None
+        if conn is None:
+            return
+        self._client = conn
+        try:
+            if self._tombstones:
+                # The sidecar survived the outage WITH state: deletes made
+                # while degraded (node removals, preemption victims) must
+                # land before the upsert replay, or recovery resurrects
+                # phantom objects a later batch could bind onto.  Node
+                # removes are guarded by the live dump (remove_node of an
+                # unknown node is a server error); pod deletes are
+                # idempotent for unknown uids.
+                state = self._client.dump()
+                for kind, uid in self._tombstones:
+                    if kind == "Node" and uid not in state.get("nodes", {}):
+                        continue
+                    self._client.remove(kind, uid)
+            self._replay()
         except (ConnectionError, BrokenPipeError, OSError):
-            self._reconnect()
-            return fn()
+            # Died again between probe and replay: stay degraded.
+            self._start_probe()
+            return
+        self._tombstones.clear()
+        self.resyncs += 1
+        self.degraded = False
+        self._consecutive_failures = 0
+        self._set_state("healthy")
+        self._fallback = None  # its bindings live in the store; rebuild fresh
+
+    def _ensure_fallback(self):
+        """The degraded-mode engine, built by replaying the mirrored store
+        host-side — the same in-process ops/eval path the wire normally
+        offloads, so a breaker-open host keeps making progress with
+        bit-identical decisions."""
+        if self._fallback is None:
+            from ..scheduler import TPUScheduler
+
+            fb = (self.fallback_factory or TPUScheduler)()
+            for ns, labels in self._ns_labels.items():
+                fb.builder.set_namespace_labels(ns, dict(labels))
+            for kind in _REPLAY_ORDER:
+                for obj in self._store.get(kind, {}).values():
+                    getattr(fb, serialize.KINDS[kind][1])(obj)
+            self._fallback = fb
+        return self._fallback
+
+    def _dispatch_degraded(self, pods, drain: bool) -> list[pb.PodResult]:
+        self._degraded_counter.inc()
+        fb = self._ensure_fallback()
+        for p in pods:
+            fb.update_pod(p)
+        outcomes = fb.schedule_all_pending() if drain else fb.schedule_batch()
+        results = [fill_result(pb.PodResult(), o) for o in outcomes]
+        for r in results:
+            for vu in r.victim_uids:
+                # Victims evicted host-side: the hung sidecar still holds
+                # them bound — reconcile on recovery.
+                self._tombstones.append(("Pod", vu))
+        return results
 
     # -- client surface ----------------------------------------------------
 
+    def _call_or_degraded(self, wire_fn, degraded_fn):
+        """The whole client-surface protocol in ONE place: finish any
+        recovery the probe initiated, serve host-side while degraded,
+        otherwise try the wire — with resync retries — and degrade when
+        the breaker opens mid-call.  ``wire_fn`` must re-read
+        ``self._client`` (a lambda over the attribute) so a retry after a
+        reconnect targets the NEW connection."""
+        self._maybe_recover()
+        if not self.degraded:
+            try:
+                return self._with_resync(wire_fn)
+            except BreakerOpen:
+                pass
+        return degraded_fn()
+
     def set_namespace_labels(self, namespace: str, labels: dict) -> None:
         self._ns_labels[namespace] = dict(labels)
-        self._with_resync(
-            lambda: self._client.set_namespace_labels(namespace, labels)
+        self._call_or_degraded(
+            lambda: self._client.set_namespace_labels(namespace, labels),
+            lambda: self._ensure_fallback().builder.set_namespace_labels(
+                namespace, dict(labels)
+            ),
         )
 
     def add(self, kind: str, obj) -> None:
         self._record(kind, obj)
-        self._with_resync(lambda: self._client.add(kind, obj))
+        self._call_or_degraded(
+            lambda: self._client.add(kind, obj),
+            lambda: self._fallback_add(kind, obj),
+        )
+
+    def _fallback_add(self, kind: str, obj) -> None:
+        fb = self._ensure_fallback()
+        getattr(fb, serialize.KINDS[kind][1])(obj)
 
     def remove(self, kind: str, uid: str) -> None:
         self._store.get(kind, {}).pop(uid, None)
-        self._with_resync(lambda: self._client.remove(kind, uid))
+        if kind == "Node":
+            # Pods on a removed node vanish from scheduling state (the
+            # engine's remove_node contract); the store must mirror that
+            # or a later replay re-adds pods bound to a node that no
+            # longer exists — a server-side error that wedges the replay.
+            self._store["Pod"] = {
+                u: p
+                for u, p in self._store["Pod"].items()
+                if p.spec.node_name != uid
+            }
+        self._call_or_degraded(
+            lambda: self._client.remove(kind, uid),
+            lambda: self._fallback_remove(kind, uid),
+        )
+
+    def _fallback_remove(self, kind: str, uid: str) -> None:
+        self._tombstones.append((kind, uid))
+        fb = self._ensure_fallback()
+        if kind == "Node":
+            # Tolerant: when the breaker opened on this very remove, the
+            # fallback was just built from the store that ALREADY dropped
+            # the node — there is nothing left to remove.
+            if uid in fb.cache.nodes:
+                fb.remove_node(uid)
+        elif kind == "Pod":
+            fb.delete_pod(uid)  # lenient for unknown uids
+
+    # Observability reads during an outage must not FORCE the fallback
+    # engine into existence (its build replays the whole mirrored store —
+    # seconds at scale) and must keep serving the outage-describing host
+    # series: read the fallback only when a dispatch already built it.
 
     def dump(self) -> dict:
-        # NB: lambda re-reads self._client so the retry after a reconnect
-        # targets the NEW connection, not the dead one's bound method.
-        return self._with_resync(lambda: self._client.dump())
+        return self._call_or_degraded(
+            lambda: self._client.dump(),
+            lambda: (
+                self._fallback.dump_state()
+                if self._fallback is not None
+                else {
+                    "degraded": True,
+                    "store": {k: len(v) for k, v in self._store.items() if v},
+                }
+            ),
+        )
+
+    def _degraded_metrics(self) -> str:
+        text = self.registry.render_text()
+        if self._fallback is not None:
+            # Disjoint family names: the host registry carries the
+            # scheduler_sidecar_* series, the engine its scheduling ones.
+            text += self._fallback.metrics.registry.render_text()
+        return text
 
     def metrics(self) -> str:
-        return self._with_resync(lambda: self._client.metrics())
+        return self._call_or_degraded(
+            lambda: self._client.metrics(), self._degraded_metrics
+        )
 
     def events(self) -> list[dict]:
-        return self._with_resync(lambda: self._client.events())
+        return self._call_or_degraded(
+            lambda: self._client.events(),
+            lambda: (
+                self._fallback.events.list()
+                if self._fallback is not None
+                else []
+            ),
+        )
 
     def schedule(
         self, pods=(), drain: bool = True, trace=None
@@ -127,18 +433,16 @@ class ResyncingClient:
         pods = list(pods)
         for p in pods:
             self._record("Pod", p)
-        results = self._with_resync(
-            lambda: self._client.schedule(pods, drain=drain, trace=trace)
+        results = self._call_or_degraded(
+            lambda: self._client.schedule(pods, drain=drain, trace=trace),
+            lambda: self._dispatch_degraded(pods, drain),
         )
         # Record bindings: the reference host persists them via the
         # apiserver; here the store is that persistence, so a later replay
         # re-adds bound pods as cache adds with their node set.
         by_uid = {p.uid: p for p in pods}
         for r in results:
-            p = by_uid.get(r.pod_uid)
-            if p is None:
-                rec = self._store["Pod"].get(r.pod_uid)
-                p = rec if rec is not None else None
+            p = by_uid.get(r.pod_uid) or self._store["Pod"].get(r.pod_uid)
             if p is None:
                 continue
             if r.node_name:
@@ -149,6 +453,11 @@ class ResyncingClient:
         return results
 
     def close(self) -> None:
+        self._probe_stop.set()
+        with self._lock:
+            conn, self._probe_conn = self._probe_conn, None
+        if conn is not None:
+            conn.close()
         self._client.close()
 
 
@@ -168,7 +477,13 @@ class DecisionCache:
     buffered frames in the consumer's thread.  After a miss response the
     triggering batch's pushes were written BEFORE the response (same
     dispatch lock), so ``drain(min_frames=1)`` only ever waits out the
-    reader thread's scheduling latency, not the sidecar."""
+    reader thread's scheduling latency, not the sidecar.
+
+    Across a sidecar RESTART the map is a dead epoch: the reader thread
+    sees EOF, ``drain`` surfaces ConnectionError instead of pretending
+    liveness, and the consumer falls back to the wire for every pod (a
+    miss is always correct — the wire path re-evaluates) until it builds
+    a fresh DecisionCache against the new sidecar."""
 
     def __init__(self, path: str):
         import threading

@@ -20,11 +20,27 @@ import struct
 import threading
 
 from ..api import serialize
-from ..scheduler import TPUScheduler
+from ..scheduler import ScheduleOutcome, TPUScheduler
 from . import sidecar_pb2 as pb
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
+# Bound on how much of an oversized frame the server will stream-discard
+# to stay synchronized.  A length beyond this is almost certainly a
+# garbage header (the stream is byte-desynced), so the connection drops
+# instead of reading gigabytes of nothing.
+MAX_DISCARD = 4 * MAX_FRAME
+
+
+class FrameError(Exception):
+    """A malformed frame.  ``recoverable`` means its bytes were fully
+    consumed — the connection is still frame-synchronized and can carry
+    an error response; otherwise the stream is hopelessly desynced and
+    the connection must drop."""
+
+    def __init__(self, msg: str, recoverable: bool):
+        super().__init__(msg)
+        self.recoverable = recoverable
 
 
 def write_frame(sock: socket.socket, env: pb.Envelope) -> None:
@@ -54,6 +70,40 @@ def read_frame(sock: socket.socket) -> pb.Envelope | None:
         return None
     env = pb.Envelope()
     env.ParseFromString(payload)
+    return env
+
+
+def read_frame_resync(sock: socket.socket) -> pb.Envelope | None:
+    """Server-side framed read that SURVIVES a malformed frame where
+    possible: an oversized length is stream-discarded and a garbage
+    payload consumed, both raising a recoverable FrameError so the caller
+    can answer with an error response instead of severing the connection
+    (one bad message must not drop its healthy sibling requests).  Only a
+    length too absurd to discard is unrecoverable."""
+    header = _read_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        if n > MAX_DISCARD:
+            raise FrameError(
+                f"frame length {n} beyond discard bound", recoverable=False
+            )
+        remaining = n
+        while remaining:
+            chunk = sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                return None  # EOF mid-discard
+            remaining -= len(chunk)
+        raise FrameError(f"frame too large: {n}", recoverable=True)
+    payload = _read_exact(sock, n)
+    if payload is None:
+        return None
+    env = pb.Envelope()
+    try:
+        env.ParseFromString(payload)
+    except Exception as exc:  # framing intact, payload garbage
+        raise FrameError(f"unparseable frame: {exc}", recoverable=True)
     return env
 
 
@@ -129,13 +179,33 @@ class SidecarServer:
 
             def _serve_frames(self) -> None:
                 subscribed = False
+                malformed = sched.metrics.registry.counter(
+                    "sidecar_malformed_frames_total",
+                    "Client frames rejected as oversized or unparseable.",
+                )
                 while True:
                     try:
-                        env = read_frame(self.request)
+                        env = read_frame_resync(self.request)
                     except TimeoutError:
                         # Subscribed sockets carry a write timeout (push
                         # backpressure bound) which applies to this idle
                         # read too — just keep listening for EOF.
+                        continue
+                    except FrameError as fe:
+                        malformed.inc()
+                        if not fe.recoverable or subscribed:
+                            # Desynced stream, or a write onto a one-way
+                            # push stream: the connection is done.
+                            return
+                        # Frame consumed, stream synchronized: answer with
+                        # an error response (seq 0 — the malformed payload
+                        # never yielded one) and keep serving.
+                        err = pb.Envelope()
+                        err.response.error = f"bad frame: {fe}"
+                        try:
+                            write_frame(self.request, err)
+                        except OSError:
+                            return
                         continue
                     except (ValueError, OSError):
                         return
@@ -370,50 +440,103 @@ def _dispatch(
                     # A drain request bypasses the cache; flush it first so
                     # drained decisions and cached ones cannot double-commit.
                     front.flush_hints_to_queue()
+                req_uids = []
                 for raw in env.schedule.pod_json:
-                    sched.add_pod(serialize.pod_from_json(raw))
+                    p = serialize.pod_from_json(raw)
+                    req_uids.append(p.uid)
+                    sched.add_pod(p)
                 outcomes = (
                     sched.schedule_all_pending()
                     if env.schedule.drain
                     else sched.schedule_batch()
                 )
+                outcomes = list(outcomes)
+                # At-least-once completion: a re-issued call (the host
+                # timed out and lost the first response) may ask about
+                # pods an earlier execution already committed — add_pod
+                # dropped them, so the drain yields no outcome.  Answer
+                # from the cache; the committed placement IS the
+                # decision.  Pods still in a wait room (Permit/PreBind)
+                # stay unanswered — their bind is not final.
+                answered = {o.pod.uid for o in outcomes}
+                waiting = {
+                    e[0].pod.uid
+                    for lst in sched.permit_waiting.values()
+                    for e in lst
+                } | set(sched.prebind_waiting)
+                for uid in req_uids:
+                    if uid in answered or uid in waiting:
+                        continue
+                    pr = sched.cache.pods.get(uid)
+                    if pr is not None and pr.node_name:
+                        outcomes.append(
+                            ScheduleOutcome(pr.pod, pr.node_name)
+                        )
         finally:
             sched.trace_parent = None
         span = sched.last_batch_span
         if span is not None and env.schedule.trace_id:
             out.response.span_id = span.span_id
         for o in outcomes:
-            r = out.response.results.add()
-            r.pod_uid = o.pod.uid
-            r.node_name = o.node_name or ""
-            r.score = o.score
-            r.feasible_nodes = o.feasible_nodes
-            r.nominated_node = o.nominated_node or ""
-            r.victims = o.victims
-            r.victim_uids.extend(o.victim_uids)
-            r.victim_names.extend(o.victim_names)
-            if o.diagnosis is not None:
-                r.unschedulable_plugins.extend(
-                    sorted(o.diagnosis.unschedulable_plugins)
-                )
+            fill_result(out.response.results.add(), o)
     else:
         raise ValueError(f"unhandled message {kind}")
+
+
+def fill_result(r: pb.PodResult, o) -> pb.PodResult:
+    """ScheduleOutcome → wire PodResult.  Shared with the host's degraded
+    dispatch (sidecar/host.py), so the two serializations cannot drift."""
+    r.pod_uid = o.pod.uid
+    r.node_name = o.node_name or ""
+    r.score = o.score
+    r.feasible_nodes = o.feasible_nodes
+    r.nominated_node = o.nominated_node or ""
+    r.victims = o.victims
+    r.victim_uids.extend(o.victim_uids)
+    r.victim_names.extend(o.victim_names)
+    if o.diagnosis is not None:
+        r.unschedulable_plugins.extend(
+            sorted(o.diagnosis.unschedulable_plugins)
+        )
+    return r
+
+
+class DeadlineExceeded(ConnectionError):
+    """A per-call deadline fired: the sidecar is reachable but not
+    answering (hung, or drowning).  Distinct from a plain ConnectionError
+    so the resilient host can count timeouts separately."""
 
 
 class SidecarClient:
     """Minimal Python client (the same framing the native C++ client in
     native/sidecar_client.cc speaks)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, deadline_s: float | None = None):
+        """``deadline_s`` bounds every request/response round trip: a hung
+        sidecar (process alive, dispatch wedged) turns into a TimeoutError
+        the caller can retry/degrade on, instead of a recv that blocks
+        forever.  None (the default) keeps unbounded blocking — fixtures
+        and the golden transcripts rely on it; resilient hosts
+        (sidecar/host.py ResyncingClient) always set one."""
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(path)
+        self.deadline_s = deadline_s
+        if deadline_s is not None:
+            self.sock.settimeout(deadline_s)
         self._seq = 0
 
     def _call(self, env: pb.Envelope) -> pb.Envelope:
         self._seq += 1
         env.seq = self._seq
         write_frame(self.sock, env)
-        resp = read_frame(self.sock)
+        try:
+            resp = read_frame(self.sock)
+        except TimeoutError as exc:
+            # The response may still arrive later — the connection is
+            # desynced and must be treated as dead, not retried in place.
+            raise DeadlineExceeded(
+                f"sidecar call deadline ({self.deadline_s}s) exceeded"
+            ) from exc
         if resp is None:
             raise ConnectionError("sidecar closed the connection")
         if resp.seq != self._seq:
@@ -495,7 +618,9 @@ class SidecarClient:
                     if resp.response.error:
                         errors.append(resp.response.error)
         finally:
-            sock.setblocking(True)
+            # setblocking(True) wipes any configured timeout; restore the
+            # per-call deadline for subsequent requests.
+            sock.settimeout(self.deadline_s)
         if errors:
             raise RuntimeError(
                 f"{len(errors)} of {len(objs)} adds failed; first: {errors[0]}"
@@ -556,6 +681,9 @@ class SidecarClient:
         env = pb.Envelope()
         env.subscribe.SetInParent()
         self._call(env)
+        # Push streams idle legitimately (no decisions to push): the
+        # request/response deadline does not apply to them.
+        self.sock.settimeout(None)
 
     def read_push(self) -> pb.Push | None:
         """Blocking read of the next Push frame (None on EOF)."""

@@ -15,3 +15,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # Markers used by the tier-1 selection (`-m 'not slow'`) and the
+    # fault-injection matrix (scripts/run_fault_matrix.py runs the full
+    # grid; the fast subset in tests/test_faults.py stays in tier-1).
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from tier-1 (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers", "faults: fault-injection matrix tests"
+    )

@@ -1,0 +1,630 @@
+"""Fault-injection harness: the dispatch path must DEGRADE, not die.
+
+The acceptance claims of the robustness tentpole (ISSUE 2):
+
+- a HUNG sidecar (alive process, wedged dispatch) trips the per-call
+  deadline and then the circuit breaker, and the host completes the same
+  workload in degraded mode — host-side evaluation on the mirrored store
+  — with IDENTICAL bindings;
+- a POISON pod (engine dispatch raises whenever its batch contains it)
+  is quarantined while the rest of its batch binds;
+- a SECOND crash during the resync replay is retried, not fatal;
+- a malformed frame gets an error response and the connection keeps
+  serving its healthy sibling requests;
+- the whole fault matrix (scripts/run_fault_matrix.py) leaves binding
+  decisions unchanged — the fast subset runs here in tier-1.
+"""
+
+import os
+import socket
+import struct
+import sys
+import tempfile
+import time
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.faults import EngineFault, FaultPlan
+from kubernetes_tpu.framework.config import fit_only_profile
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.queue import SchedulingQueue
+from kubernetes_tpu.sidecar import server as sidecar
+from kubernetes_tpu.sidecar import sidecar_pb2 as pb
+from kubernetes_tpu.sidecar.host import ResyncingClient
+from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+_LEN = struct.Struct(">I")
+
+
+def _node(name, cpu="4"):
+    return make_node(name).capacity(
+        {"cpu": cpu, "memory": "16Gi", "pods": 110}
+    ).obj()
+
+
+def _mk_sched(**kw):
+    kw.setdefault("profile", fit_only_profile())
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("chunk_size", 1)
+    return TPUScheduler(**kw)
+
+
+def _serve(**kw):
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(path, scheduler=_mk_sched(**kw))
+    srv.serve_background()
+    return path, srv
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+
+
+def _frame(op):
+    env = pb.Envelope()
+    if op == "add":
+        env.add.kind = "Node"
+        env.add.object_json = b"{}"
+    else:
+        getattr(env, op).SetInParent()
+    payload = env.SerializeToString()
+    return _LEN.pack(len(payload)) + payload
+
+
+def _drive_plan(plan):
+    a, b = socket.socketpair()
+    wrapped = plan.wrap(a)
+    try:
+        for op in ("add", "add", "schedule", "schedule", "add"):
+            try:
+                wrapped.sendall(_frame(op))
+            except OSError:
+                pass  # a crash rule severed the socket; keep counting ops
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+    return list(plan.fired)
+
+
+def test_fault_plan_fires_deterministically_and_replays():
+    plan = (
+        FaultPlan(seed=3)
+        .add_rule("slow", op="add", nth=2, delay_s=0.0)
+        .add_rule("crash", op="schedule", nth=2)
+    )
+    fired = _drive_plan(plan)
+    assert fired == [("slow", "add", 2), ("crash", "schedule", 2)]
+    # replay(): same rules + seed → identical firing sequence.
+    assert _drive_plan(plan.replay()) == fired
+
+
+def test_fault_rule_every_with_times_cap():
+    plan = FaultPlan().add_rule("hang", op="add", nth=1, every=True, times=2)
+    a, b = socket.socketpair()
+    wrapped = plan.wrap(a)
+    b.settimeout(0.5)
+    try:
+        wrapped.sendall(_frame("add"))  # swallowed (1)
+        wrapped.sendall(_frame("add"))  # swallowed (2)
+        wrapped.sendall(_frame("add"))  # delivered: cap exhausted
+        data = b.recv(1 << 16)
+        assert data == _frame("add")  # exactly one frame came through
+    finally:
+        a.close()
+        b.close()
+    assert plan.fired == [("hang", "add", 1), ("hang", "add", 2)]
+
+
+# ---------------------------------------------------------------------------
+# Hung sidecar → deadline + breaker → degraded mode, identical bindings
+
+
+def _workload(client, n_nodes=3, n_pods=5):
+    for i in range(n_nodes):
+        client.add("Node", _node(f"n{i}"))
+    pods = [make_pod(f"p{i}").req({"cpu": "2"}).obj() for i in range(n_pods)]
+    res = client.schedule(pods, drain=True)
+    return {r.pod_uid: r.node_name for r in res}
+
+
+def test_hung_sidecar_trips_breaker_and_degrades_with_identical_bindings():
+    # Baseline: healthy wire dispatch.
+    path, srv = _serve()
+    client = ResyncingClient(path, deadline_s=30.0)
+    try:
+        baseline = _workload(client)
+    finally:
+        client.close()
+        srv.close()
+    assert all(baseline.values())  # 5×2cpu over 3×4cpu nodes: all bind
+
+    # Same workload against a sidecar whose schedule dispatch hangs
+    # forever (health hangs too, so the background probe cannot recover
+    # mid-test and every dispatch stays host-side).
+    plan = (
+        FaultPlan(seed=1)
+        .add_rule("hang", op="schedule", every=True)
+        .add_rule("hang", op="health", every=True)
+    )
+    path, srv = _serve()
+    client = ResyncingClient(
+        path,
+        deadline_s=0.4,
+        retry_interval_s=0.01,
+        probe_interval_s=0.05,
+        breaker_threshold=3,
+        socket_wrapper=plan.wrap,
+        fallback_factory=_mk_sched,
+    )
+    try:
+        degraded = _workload(client)
+        assert client.degraded
+        assert degraded == baseline  # bit-identical decisions, host-side
+        reg = client.registry
+        assert reg.counter("scheduler_degraded_dispatches_total").total() == 1
+        assert reg.counter("scheduler_sidecar_breaker_trips_total").total() == 1
+        assert reg.counter("scheduler_sidecar_call_timeouts_total").total() >= 3
+        assert reg.gauge("scheduler_sidecar_state").get(state="degraded") == 1
+        assert reg.gauge("scheduler_sidecar_state").get(state="healthy") == 0
+        # Still making progress while degraded: capacity accounting holds
+        # (6th 2-cpu pod takes the last slot, the 7th finds none).
+        (r6,) = client.schedule([make_pod("p5").req({"cpu": "2"}).obj()])
+        assert r6.node_name
+        (r7,) = client.schedule([make_pod("p6").req({"cpu": "2"}).obj()])
+        assert r7.node_name == ""
+        assert reg.counter("scheduler_degraded_dispatches_total").total() == 3
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_degraded_host_recovers_when_sidecar_heals():
+    # The hang clears after 3 schedule frames (times=3): the breaker
+    # opens, the workload completes host-side, the background probe finds
+    # the sidecar answering, and the next dispatch replays the store —
+    # including the bindings made WHILE degraded — and resumes the wire.
+    plan = FaultPlan(seed=2).add_rule(
+        "hang", op="schedule", nth=1, every=True, times=3
+    )
+    path, srv = _serve()
+    client = ResyncingClient(
+        path,
+        deadline_s=0.4,
+        retry_interval_s=0.01,
+        probe_interval_s=0.05,
+        breaker_threshold=3,
+        socket_wrapper=plan.wrap,
+        fallback_factory=_mk_sched,
+    )
+    try:
+        bound = _workload(client)  # degrades mid-call, completes host-side
+        assert client.degraded and all(bound.values())
+        deadline = time.monotonic() + 5.0
+        while client.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+            client.add("Node", _node("late", cpu="2"))  # any call recovers
+        assert not client.degraded
+        assert client.registry.gauge(
+            "scheduler_sidecar_state"
+        ).get(state="healthy") == 1
+        # The aggressive 0.4s deadline existed to trip the breaker fast;
+        # the recovered sidecar's FIRST batch pays its XLA compile, which
+        # must not be misread as another hang.
+        client.deadline_s = 30.0
+        client._client.sock.settimeout(30.0)
+        # Wire dispatch resumed AND the degraded-mode bindings were
+        # replayed: 3×4cpu held 5×2cpu pods, so exactly one 2-cpu slot
+        # remains (plus the late 2-cpu node's one slot).
+        r = client.schedule(
+            [make_pod(f"q{i}").req({"cpu": "2"}).obj() for i in range(3)]
+        )
+        placed = [x for x in r if x.node_name]
+        assert len(placed) == 2, [(x.pod_uid, x.node_name) for x in r]
+        # The sidecar agrees with the host store about every binding.
+        dump = client.dump()
+        for uid, node in bound.items():
+            assert dump["pods"][uid]["node"] == node
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_breaker_trip_on_a_remove_degrades_without_crashing():
+    # The breaker can open on the REMOVE call itself.  The store already
+    # dropped the node before dispatch, so the just-built fallback never
+    # contained it — the degraded removal must tolerate that, not crash
+    # the resilience path with a KeyError.
+    plan = (
+        FaultPlan(seed=6)
+        .add_rule("hang", op="remove", every=True)
+        .add_rule("hang", op="health", every=True)
+    )
+    path, srv = _serve()
+    client = ResyncingClient(
+        path,
+        deadline_s=0.4,
+        retry_interval_s=0.01,
+        probe_interval_s=0.05,
+        breaker_threshold=3,
+        socket_wrapper=plan.wrap,
+        fallback_factory=_mk_sched,
+    )
+    try:
+        client.add("Node", _node("n0"))
+        client.add("Node", _node("n1"))
+        client.remove("Node", "n1")  # hangs → breaker → degraded, no raise
+        assert client.degraded
+        # An observability scrape while degraded keeps the host series.
+        text = client.metrics()
+        assert "scheduler_sidecar_breaker_trips_total 1" in text
+        assert 'scheduler_sidecar_state{state="degraded"} 1' in text
+        # The removal took effect host-side: only n0 remains to bind on.
+        res = client.schedule(
+            [make_pod(f"p{i}").req({"cpu": "2"}).obj() for i in range(3)]
+        )
+        assert sorted(r.node_name for r in res) == ["", "n0", "n0"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_degraded_window_removals_reconciled_on_recovery():
+    # A HUNG sidecar keeps its state: deletes applied while the breaker
+    # was open never reached it, so the recovery replay must reconcile
+    # them — otherwise a later batch can bind onto a phantom node.
+    plan = FaultPlan(seed=5).add_rule(
+        "hang", op="schedule", nth=1, every=True, times=3
+    )
+    path, srv = _serve()
+    client = ResyncingClient(
+        path,
+        deadline_s=0.4,
+        retry_interval_s=0.01,
+        probe_interval_s=0.05,
+        breaker_threshold=3,
+        socket_wrapper=plan.wrap,
+        fallback_factory=_mk_sched,
+    )
+    try:
+        client.add("Node", _node("n0", cpu="8"))
+        client.add("Node", _node("n1", cpu="1"))  # too small for any pod
+        res = client.schedule(
+            [make_pod(f"p{i}").req({"cpu": "2"}).obj() for i in range(2)]
+        )
+        assert client.degraded
+        assert all(r.node_name == "n0" for r in res)
+        client.remove("Node", "n1")  # sidecar never hears this
+        deadline = time.monotonic() + 5.0
+        while client.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+            client.events()
+        assert not client.degraded
+        dump = client.dump()
+        assert set(dump["nodes"]) == {"n0"}, dump["nodes"]  # no phantom n1
+        for i in range(2):
+            assert dump["pods"][f"default/p{i}"]["node"] == "n0"
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_node_removal_purges_its_bound_pods_from_the_replay_store():
+    # remove_node vaporizes the node's pods from scheduling state; the
+    # host store must mirror that, or the post-restart replay re-adds
+    # pods bound to a node that no longer exists and the replay wedges
+    # on a server-side error.
+    path, srv = _serve()
+    client = ResyncingClient(path, max_reconnect_s=5.0, deadline_s=30.0)
+    try:
+        client.add("Node", _node("gone"))
+        (r,) = client.schedule([make_pod("rider").req({"cpu": "2"}).obj()])
+        assert r.node_name == "gone"
+        client.remove("Node", "gone")
+        srv.close()
+        srv = SidecarServer(path, scheduler=_mk_sched())
+        srv.serve_background()
+        dump = client.dump()  # triggers the resync replay
+        assert client.resyncs == 1
+        assert dump["nodes"] == {} and dump["pods"] == {}
+    finally:
+        client.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Poison-batch quarantine
+
+
+@pytest.mark.parametrize("attributed", [True, False])
+def test_poison_pod_quarantined_and_healthy_batch_binds(attributed):
+    s = _mk_sched(queue=SchedulingQueue(initial_backoff_s=0.02))
+    s.add_node(_node("n0", cpu="8"))
+    s.add_node(_node("n1", cpu="8"))
+    plan = FaultPlan().add_rule(
+        "engine", pod="default/bad", attributed=attributed
+    )
+    plan.install_engine(s)
+    pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    pods.insert(2, make_pod("bad").req({"cpu": "1"}).obj())
+    for p in pods:
+        s.add_pod(p)
+    out = s.schedule_all_pending()
+    by_uid = {o.pod.uid: o for o in out}
+    assert by_uid["default/bad"].node_name is None
+    assert by_uid["default/bad"].diagnosis.unschedulable_plugins == {
+        "EngineFault"
+    }
+    for i in range(4):
+        assert by_uid[f"default/p{i}"].node_name, f"p{i} did not bind"
+    assert s.queue.depths()["quarantine"] == 1
+    assert s.queue.quarantined() == ["default/bad"]
+    reg = s.metrics.registry
+    assert reg.counter("scheduler_quarantined_pods_total").total() == 1
+    faults = reg.counter("scheduler_engine_faults_total").total()
+    # Attribution short-circuits the bisect; anonymous exceptions pay
+    # one recovery per failing sub-batch on the way down.
+    assert faults == 1 if attributed else faults > 1
+    ev = [e for e in s.events.list() if e["reason"] == "FailedScheduling"]
+    assert any("quarantined" in e["note"] and "default/bad" in e["object"]
+               for e in ev)
+
+    # Release: the pod re-enters through the backoff machinery; with the
+    # fault gone it binds like any other pod.
+    plan.rules.clear()
+    assert s.queue.release_quarantine() == 1
+    time.sleep(0.05)
+    out2 = s.schedule_all_pending(wait_backoff=True)
+    assert {o.pod.uid: o.node_name for o in out2}["default/bad"]
+    assert s.queue.depths()["quarantine"] == 0
+
+
+def test_poison_pod_quarantined_over_the_wire():
+    path = tempfile.mktemp(suffix=".sock")
+    sched = _mk_sched()
+    FaultPlan().add_rule("engine", pod="default/bad").install_engine(sched)
+    srv = SidecarServer(path, scheduler=sched)
+    srv.serve_background()
+    client = SidecarClient(path)
+    try:
+        client.add("Node", _node("n0", cpu="8"))
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(3)]
+        pods.append(make_pod("bad").req({"cpu": "1"}).obj())
+        results = {r.pod_uid: r for r in client.schedule(pods, drain=True)}
+        assert results["default/bad"].node_name == ""
+        assert list(results["default/bad"].unschedulable_plugins) == [
+            "EngineFault"
+        ]
+        for i in range(3):
+            assert results[f"default/p{i}"].node_name
+        dump = client.dump()
+        assert dump["queue"]["quarantine"] == ["default/bad"]
+        assert 'scheduler_pending_pods{queue="quarantine"} 1' in client.metrics()
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_transient_engine_fault_does_not_quarantine_whole_batch():
+    # An UNKEYED one-shot engine fault (n-th dispatch raises once, e.g. a
+    # flaky allocator): the bisect retries succeed and nobody is
+    # quarantined.
+    s = _mk_sched()
+    s.add_node(_node("n0", cpu="8"))
+    plan = FaultPlan().add_rule("engine", nth=1)
+    plan.install_engine(s)
+    for i in range(4):
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending()
+    assert all(o.node_name for o in out)
+    assert s.queue.depths()["quarantine"] == 0
+    assert s.metrics.registry.counter(
+        "scheduler_engine_faults_total"
+    ).total() == 1
+
+
+def test_engine_fault_carries_pod_attribution():
+    exc = EngineFault("boom", ("u1", "u2"))
+    assert exc.pod_uids == ("u1", "u2")
+
+
+# ---------------------------------------------------------------------------
+# Mid-replay second crash (satellite: bounded reconnect loop)
+
+
+def test_second_crash_during_replay_still_recovers():
+    path, srv = _serve()
+    # The 5th add frame crosses the wire during the post-restart REPLAY
+    # (2 setup adds + the replay's 2 node adds precede it, so it is the
+    # first bound-pod replay): the connection is severed mid-replay — the
+    # old single-retry hole — and the bounded loop must reconnect and
+    # replay again instead of surfacing OSError.
+    plan = FaultPlan(seed=4).add_rule("crash", op="add", nth=5)
+    client = ResyncingClient(
+        path,
+        max_reconnect_s=5.0,
+        retry_interval_s=0.01,
+        deadline_s=30.0,
+        socket_wrapper=plan.wrap,
+    )
+    try:
+        client.add("Node", _node("n0"))
+        client.add("Node", _node("n1"))
+        pods = [make_pod(f"a{i}").req({"cpu": "2"}).obj() for i in range(2)]
+        bound1 = {r.pod_uid: r.node_name for r in client.schedule(pods)}
+        assert all(bound1.values())
+
+        srv.close()
+        srv = SidecarServer(path, scheduler=_mk_sched())
+        srv.serve_background()
+
+        res = client.schedule([make_pod("b0").req({"cpu": "2"}).obj()])
+        assert {r.pod_uid: r.node_name for r in res}["default/b0"]
+        # The crash DID fire mid-replay (resyncs counts only COMPLETED
+        # replays: the torn one doesn't, its successful retry does).
+        assert plan.fired == [("crash", "add", 5)]
+        assert client.resyncs == 1
+        assert not client.degraded  # two failures < breaker threshold
+        # Accounting survived both the restart and the torn replay.
+        dump = client.dump()
+        for uid, node in bound1.items():
+            assert dump["pods"][uid]["node"] == node
+        assert dump["mirror_equal"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_reissued_schedule_reports_committed_bindings():
+    # At-least-once completion: the host times out, loses the response,
+    # and re-issues the call for pods the first execution already bound.
+    # The re-issued call must answer with the COMMITTED placement, not
+    # silently drop the pod (and never double-bind it).
+    path, srv = _serve()
+    client = SidecarClient(path)
+    try:
+        client.add("Node", _node("n0"))
+        p = make_pod("dup").req({"cpu": "2"}).obj()
+        (r1,) = client.schedule([p], drain=True)
+        assert r1.node_name
+        (r2,) = client.schedule([p], drain=True)
+        assert r2.pod_uid == p.uid and r2.node_name == r1.node_name
+        # Bound once: the node holds one copy of the delta.
+        dump = client.dump()
+        assert dump["nodes"]["n0"]["pods"] == [p.uid]
+        assert dump["mirror_equal"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_bound_pod_upsert_with_different_node_relocates():
+    # Host truth can REBIND a pod the local engine placed elsewhere (a
+    # stale buffered schedule frame processed after the host already
+    # bound the pod in degraded mode; the recovery replay then ships the
+    # authoritative binding).  The upsert must relocate — accounting
+    # moves with it and the device mirror follows.
+    s = _mk_sched()
+    s.add_node(_node("a", cpu="4"))
+    s.add_node(_node("b", cpu="4"))
+    p = make_pod("mv").req({"cpu": "2"}).node("a").obj()
+    s.add_pod(p)
+    assert s.cache.pods["default/mv"].node_name == "a"
+    import copy
+
+    moved = copy.deepcopy(p)
+    moved.spec.node_name = "b"
+    s.update_pod(moved)
+    assert s.cache.pods["default/mv"].node_name == "b"
+    assert "default/mv" not in s.cache.nodes["a"].pods
+    assert "default/mv" in s.cache.nodes["b"].pods
+    assert s.builder.host_mirror_equal()
+    # Capacity followed the move: a 4-cpu pod fits only the vacated "a"
+    # ("b" holds mv's 2 of 4); a second one fits nowhere.
+    for i in range(2):
+        s.add_pod(make_pod(f"f{i}").req({"cpu": "4"}).obj())
+    placed = {o.pod.uid: o.node_name for o in s.schedule_all_pending()}
+    assert placed["default/f0"] == "a" and placed["default/f1"] is None, placed
+
+
+# ---------------------------------------------------------------------------
+# Malformed frames: error response + resynchronization (satellite)
+
+
+def _raw_call(sock, env):
+    payload = env.SerializeToString()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+    return _read_response(sock)
+
+
+def _read_response(sock):
+    header = b""
+    while len(header) < 4:
+        chunk = sock.recv(4 - len(header))
+        assert chunk, "connection severed"
+        header += chunk
+    (n,) = _LEN.unpack(header)
+    buf = b""
+    while len(buf) < n:
+        buf += sock.recv(n - len(buf))
+    env = pb.Envelope()
+    env.ParseFromString(buf)
+    return env
+
+
+def test_garbage_frame_gets_error_response_and_siblings_survive():
+    path, srv = _serve()
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(path)
+    sock.settimeout(5.0)
+    try:
+        # A framing-intact but unparseable payload: error response, not a
+        # severed connection.
+        junk = b"\xff\xff\xff\xff\xff"
+        sock.sendall(_LEN.pack(len(junk)) + junk)
+        resp = _read_response(sock)
+        assert "bad frame" in resp.response.error
+        # The healthy sibling request on the SAME connection still works.
+        env = pb.Envelope(seq=1)
+        env.health.SetInParent()
+        resp = _raw_call(sock, env)
+        assert resp.seq == 1 and resp.response.health_json
+        assert (
+            srv.scheduler.metrics.registry.counter(
+                "sidecar_malformed_frames_total"
+            ).total() == 1
+        )
+    finally:
+        sock.close()
+        srv.close()
+
+
+def test_oversized_frame_discarded_then_resynchronized(monkeypatch):
+    monkeypatch.setattr(sidecar, "MAX_FRAME", 1024)
+    monkeypatch.setattr(sidecar, "MAX_DISCARD", 4096)
+    path, srv = _serve()
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(path)
+    sock.settimeout(5.0)
+    try:
+        # Oversized but discardable: the server streams past it and keeps
+        # the connection.
+        sock.sendall(_LEN.pack(2000) + b"\x00" * 2000)
+        resp = _read_response(sock)
+        assert "frame too large" in resp.response.error
+        env = pb.Envelope(seq=1)
+        env.health.SetInParent()
+        resp = _raw_call(sock, env)
+        assert resp.seq == 1 and resp.response.health_json
+        # Beyond the discard bound: a garbage header, connection drops
+        # (clean EOF or RST depending on what the kernel buffered).
+        sock.sendall(_LEN.pack(100_000) + b"\x00" * 16)
+        try:
+            data = sock.recv(4)
+        except OSError:
+            data = b""
+        assert data == b""
+    finally:
+        sock.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix (fast subset; the full grid lives in
+# scripts/run_fault_matrix.py)
+
+
+@pytest.mark.faults
+def test_fault_matrix_fast_subset():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+    )
+    from run_fault_matrix import matrix_cases, run_matrix
+
+    cases = matrix_cases(
+        fault_kinds=("crash", "partial_write"), frame_kinds=("schedule",)
+    ) + matrix_cases(fault_kinds=("slow",), frame_kinds=("add",))
+    assert run_matrix(cases, verbose=False) == []

@@ -17,7 +17,7 @@ from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.framework.config import DEFAULT_PROFILE
 from kubernetes_tpu.ops.common import registered_subset
 from kubernetes_tpu.scheduler import TPUScheduler
-from kubernetes_tpu.sidecar.host import DecisionCache
+from kubernetes_tpu.sidecar.host import DecisionCache, ResyncingClient
 from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
 
 
@@ -186,6 +186,80 @@ def test_health_probe_and_kill_sidecar():
         cache.drain(min_frames=1, timeout=2.0)
     client.close()
     cache.close()
+
+
+def test_decision_cache_across_sidecar_restart_miss_falls_back_to_wire():
+    """The DOCUMENTED restart behavior (host.DecisionCache docstring): the
+    cache's reader thread sees EOF when the sidecar dies, so after a
+    restart the map is a dead epoch — drains surface the closed stream
+    rather than pretending liveness, pops for new pods miss, and the wire
+    fallback (through the host's resync replay) still answers correctly
+    with the pre-crash accounting intact."""
+    path, srv = _server()
+    feeder = ResyncingClient(path, max_reconnect_s=5.0)
+    cache = DecisionCache(path)
+    try:
+        _nodes(feeder, n=2, cpu="4")
+        pods = [make_pod(f"p{i}").req({"cpu": "2"}).obj() for i in range(3)]
+        for p in pods:
+            (r,) = feeder.schedule([p], drain=True)
+            assert r.node_name
+
+        # KILL the sidecar; bring up a FRESH one on the same socket.
+        srv.close()
+        srv = SidecarServer(
+            path,
+            scheduler=TPUScheduler(
+                profile=registered_subset(DEFAULT_PROFILE), batch_size=8,
+                chunk_size=1,
+            ),
+            speculate=True,
+        )
+        srv.serve_background()
+
+        # The stale map never serves again: the reader observed EOF, and
+        # a drain waiting for frames says so instead of hanging.
+        with pytest.raises(ConnectionError):
+            cache.drain(min_frames=1, timeout=1.0)
+        # New pod: the consumer MISSES locally → wire fallback.  The
+        # feeder's resync replays nodes + the three bound pods, so the
+        # answer is capacity-correct: exactly one 2-cpu slot remains
+        # (2 nodes × 4 cpu − 3 × 2 cpu).
+        newpod = make_pod("post-restart").req({"cpu": "2"}).obj()
+        assert cache.pop(newpod.uid) is None
+        (r,) = feeder.schedule([newpod], drain=True)
+        assert feeder.resyncs == 1 and r.node_name
+        (r2,) = feeder.schedule(
+            [make_pod("overflow").req({"cpu": "2"}).obj()], drain=True
+        )
+        assert r2.node_name == ""
+        # A fresh cache against the restarted sidecar resumes service
+        # (new capacity first: the cluster above is deliberately full).
+        feeder.add(
+            "Node",
+            make_node("extra")
+            .capacity({"cpu": "2", "memory": "8Gi", "pods": 20})
+            .obj(),
+        )
+        cache2 = DecisionCache(path)
+        try:
+            hint = make_pod("hinted").req({"cpu": "1"}).obj()
+            probe = make_pod("probe").req({"cpu": "1"}).obj()
+            sub = SidecarClient(path)
+            try:
+                sub.add_pending_batch([probe, hint])
+                (rp,) = sub.schedule([probe], drain=False)
+                assert rp.node_name
+                cache2.drain(min_frames=1)
+                assert cache2.pop(hint.uid) is not None
+            finally:
+                sub.close()
+        finally:
+            cache2.close()
+    finally:
+        cache.close()
+        feeder.close()
+        srv.close()
 
 
 def test_health_without_speculation():
