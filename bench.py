@@ -12,12 +12,72 @@ scheduler_perf-style suite (each workload prints its own JSON DataItem).
 from __future__ import annotations
 
 import json
+import os
+import sys
+import tempfile
+
+# Bench guard (PR 3): the headline number must stay within this factor
+# of the last recorded trajectory point even WITH journaling enabled —
+# the write-ahead log is supposed to cost fsyncs, not throughput.  The
+# 5% boundary is recorded (within_5pct) and warned, not exit-gated: the
+# TPU tunnel's slow windows read whole sweeps ~20% low for ~30min at a
+# time (README measurement discipline), so a hard 5% gate on absolute
+# throughput would flake.  HARD_FLOOR is the beyond-any-weather line
+# that does fail the run — a real durability tax, not tunnel noise.
+GUARD_REFERENCE = os.path.join(os.path.dirname(__file__), "BENCH_r05.json")
+GUARD_TOLERANCE = 0.05
+HARD_FLOOR = 0.70
 
 
-def main() -> None:
+def _journal_guard(value: float) -> dict | None:
+    try:
+        with open(GUARD_REFERENCE) as f:
+            doc = json.load(f)
+        # The recorded trajectory wraps the bench payload under "parsed"
+        # (the driver's capture format); tolerate a raw payload too.
+        ref = (doc.get("parsed") or doc)["value"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    ratio = value / ref if ref else 0.0
+    guard = {
+        "reference": ref,
+        "reference_file": os.path.basename(GUARD_REFERENCE),
+        "ratio": round(ratio, 4),
+        "within_5pct": ratio >= 1.0 - GUARD_TOLERANCE,
+    }
+    if not guard["within_5pct"]:
+        print(
+            f"bench guard: headline {value} pods/s is "
+            f"{(1.0 - ratio) * 100:.1f}% below {ref} "
+            f"({guard['reference_file']}) with journaling enabled",
+            file=sys.stderr,
+        )
+    return guard
+
+
+def main() -> int:
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
 
-    r = run_workload(WORKLOADS["density_5kn_30kpods_default"])
+    # The headline runs WITH the write-ahead journal armed (fsync on
+    # every append) so the recorded trajectory carries journaling's true
+    # overhead, and the guard below catches a durability change that
+    # taxes the hot path.  Snapshot cadence 4: the 30k-pod run is ~8
+    # batches at batch 4096, so the serve default of 64 would never
+    # checkpoint inside the window — 4 puts a couple of full-store
+    # snapshot writes INTO the measured number.
+    with tempfile.TemporaryDirectory() as td:
+        from kubernetes_tpu.journal import Journal
+
+        journal = Journal(td, epoch=1)
+
+        def attach(sched) -> None:
+            sched.attach_journal(journal, snapshot_every_batches=4)
+
+        r = run_workload(
+            WORKLOADS["density_5kn_30kpods_default"], attach=attach
+        )
+        jstats = journal.stats()
+    guard = _journal_guard(r["pods_per_sec"])
     print(
         json.dumps(
             {
@@ -25,6 +85,7 @@ def main() -> None:
                 "value": r["pods_per_sec"],
                 "unit": "pods/s",
                 "vs_baseline": r["vs_baseline"],
+                "journal_guard": guard,
                 "detail": {
                     "scheduled": r["scheduled"],
                     "seconds": r["seconds"],
@@ -42,11 +103,33 @@ def main() -> None:
                         "scheduling_attempt_duration_seconds"
                     ],
                     "slow_cycles": r["spans"]["slow_cycles"],
+                    # Journal overhead for the whole run (warmup included;
+                    # appends ride the commit path, so the per-append p99
+                    # is the durability tax on a binding).
+                    "journal": {
+                        "appends": jstats["appends"],
+                        "fsyncs": jstats["fsyncs"],
+                        "snapshots": jstats["snapshots"],
+                        "journal_append_p99_us": jstats["append_p99_us"],
+                        "append_p50_us": round(
+                            journal.append_latency.quantile(0.50) * 1e6, 3
+                        ),
+                        "wal_bytes": jstats["wal_bytes"],
+                    },
                 },
             }
         )
     )
+    if guard is not None and guard["ratio"] < HARD_FLOOR:
+        print(
+            f"bench guard HARD FAIL: ratio {guard['ratio']} below "
+            f"{HARD_FLOOR} — beyond tunnel variance, journaling (or a "
+            "regression riding with it) is taxing the hot path",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -3,7 +3,10 @@
 Subcommands:
   validate <config.json>          strict config validation (apis/config/validation)
   serve --socket PATH [...]       host the engine behind the sidecar protocol
-                                  (--http-port adds /metrics + /healthz + /events)
+                                  (--http-port adds /metrics + /healthz + /events;
+                                  --journal-dir arms crash-safe durable state)
+  recover --journal-dir DIR       offline recovery: rebuild scheduler state from
+                                  snapshot + journal and print what survived
   bench [workload ...]            the scheduler_perf-style harness
   dump --socket PATH              debugger state dump of a live sidecar
   metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
@@ -121,9 +124,9 @@ def cmd_validate(args) -> int:
     return 1 if bad else 0
 
 
-def cmd_serve(args) -> int:
+def _build_scheduler(args):
+    """serve/recover's shared scheduler construction (config or flags)."""
     from .scheduler import TPUScheduler
-    from .sidecar import SidecarServer
 
     if args.config:
         cfg = load_config(args.config)
@@ -149,6 +152,34 @@ def cmd_serve(args) -> int:
         )
     else:
         sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)
+    return sched
+
+
+def _open_journal(journal_dir: str, fsync: bool):
+    """Acquire the journal directory's own lease (the fencing-epoch
+    source — distinct from the serve socket's lease, which guards the
+    SOCKET) and open the write-ahead journal under it.  Returns
+    (lease, journal)."""
+    from .framework.leaderelection import FileLease, read_epoch
+    from .journal import Journal
+
+    os.makedirs(journal_dir, exist_ok=True)
+    lease_path = os.path.join(journal_dir, "lease")
+    lease = FileLease(lease_path, identity=f"journal-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        journal_dir,
+        epoch=lease.epoch,
+        fence=lambda: read_epoch(lease_path),
+        fsync=fsync,
+    )
+    return lease, journal
+
+
+def cmd_serve(args) -> int:
+    from .sidecar import SidecarServer
+
+    sched = _build_scheduler(args)
     lease = None
     if args.leader_elect:
         # Single-active-sidecar guarantee (cmd-level leaderElectAndRun,
@@ -166,6 +197,18 @@ def cmd_serve(args) -> int:
             )
             lease.acquire(block=True)
         print(f"acquired lease {args.lease_file}", flush=True)
+    journal_lease = journal = None
+    if args.journal_dir:
+        # Crash-safe durable state (journal.py): the server recovers the
+        # pre-crash world from snapshot + write-ahead log before its
+        # first frame, and every commit this tenure is fenced by the
+        # journal lease's epoch.
+        journal_lease, journal = _open_journal(
+            args.journal_dir, fsync=args.journal_fsync == "always"
+        )
+    health = {"leader": True, "leaseFile": args.lease_file} if lease else {}
+    if journal is not None:
+        health["journalDir"] = args.journal_dir
     srv = SidecarServer(
         args.socket,
         scheduler=sched,
@@ -174,15 +217,22 @@ def cmd_serve(args) -> int:
         # (the Go side reads with a 60s deadline); meaningless without
         # the push stream.
         keepalive_s=args.keepalive if args.speculate else None,
-        health_extra=(
-            {"leader": True, "leaseFile": args.lease_file} if lease else {}
-        ),
+        health_extra=health,
         # Plain-HTTP observability (/metrics, /healthz, /events) for an
         # unmodified Prometheus; the framed `metrics` frame serves the
         # same bytes to hosts already on the socket.
         http_port=args.http_port if args.http_port >= 0 else None,
         http_host=args.http_host,
+        journal=journal,
+        snapshot_every_batches=args.snapshot_every,
     )
+    if srv.recovery_stats is not None:
+        print(
+            f"recovered from {args.journal_dir}: "
+            f"{json.dumps(srv.recovery_stats, sort_keys=True)} "
+            f"(epoch {journal.epoch})",
+            flush=True,
+        )
     print(
         f"sidecar listening on {args.socket}"
         + (" (speculative)" if args.speculate else "")
@@ -198,8 +248,43 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         srv.close()
     finally:
+        if journal_lease is not None:
+            journal_lease.release()
         if lease is not None:
             lease.release()
+    return 0
+
+
+def cmd_recover(args) -> int:
+    """Offline recovery: rebuild a scheduler from the journal directory
+    and print what survived — the operator's post-crash triage surface
+    (and the `recover` half the chaos harness drives end to end)."""
+    from .journal import recover
+
+    sched = _build_scheduler(args)
+    lease, journal = _open_journal(
+        args.journal_dir, fsync=args.journal_fsync == "always"
+    )
+    try:
+        stats = recover(sched, journal)
+        summary = {
+            "journal": journal.stats(),
+            "recovery": stats,
+            "nodes": len(sched.cache.nodes),
+            "bound_pods": sum(
+                1 for pr in sched.cache.pods.values() if pr.bound
+            ),
+            "queue": sched.queue.depths(),
+            "quarantine": sched.queue.quarantined(),
+            "bindings": {
+                uid: pr.node_name
+                for uid, pr in sorted(sched.cache.pods.items())
+                if pr.bound
+            },
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        lease.release()
     return 0
 
 
@@ -292,7 +377,34 @@ def main(argv: list[str] | None = None) -> int:
         "--lease-file", default="/tmp/kubernetes_tpu-serve.lease",
         help="leader-election lease path (see framework/leaderelection.py)",
     )
+    s.add_argument(
+        "--journal-dir", default="",
+        help="write-ahead binding journal directory (crash-safe durable "
+        "state; empty = in-memory only, the pre-PR-3 behavior)",
+    )
+    s.add_argument(
+        "--journal-fsync", choices=("always", "never"), default="always",
+        help="fsync policy for journal appends (snapshots always fsync); "
+        "'never' trades the last few records for append latency",
+    )
+    s.add_argument(
+        "--snapshot-every", type=int, default=64, metavar="BATCHES",
+        help="checkpoint the store+queue and truncate the journal every "
+        "N batches (0 disables periodic snapshots)",
+    )
     s.set_defaults(fn=cmd_serve)
+
+    rec = sub.add_parser(
+        "recover", help="offline recovery report from a journal directory"
+    )
+    rec.add_argument("--journal-dir", required=True)
+    rec.add_argument("--config", default="")
+    rec.add_argument("--batch-size", type=int, default=256)
+    rec.add_argument("--chunk-size", type=int, default=1)
+    rec.add_argument(
+        "--journal-fsync", choices=("always", "never"), default="always"
+    )
+    rec.set_defaults(fn=cmd_recover)
 
     b = sub.add_parser("bench", help="run benchmark workloads")
     b.add_argument("workloads", nargs="*")

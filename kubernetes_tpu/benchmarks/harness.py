@@ -57,8 +57,13 @@ def _throughput_percentiles(samples: list[float]) -> dict:
     }
 
 
-def run_workload(w: Workload) -> dict:
+def run_workload(w: Workload, attach: Callable | None = None) -> dict:
+    """``attach`` is called with the freshly built scheduler before any
+    objects land — the hook bench.py uses to arm the write-ahead journal
+    so the headline run measures journaling overhead in-band."""
     sched = w.build()
+    if attach is not None:
+        attach(sched)
     w.nodes(sched)
     w.warmup(sched)
     sched.schedule_all_pending(wait_backoff=w.wait_backoff)

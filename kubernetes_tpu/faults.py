@@ -32,6 +32,17 @@ Engine faults (applied when the scheduler dispatches a device batch):
                       for); without ``pod``, the Nth dispatch raises once
                       (a transient engine failure).
 
+Process-kill faults (the crash analog of the wire matrix, PR 3): a
+``KillSwitch`` SIGKILLs the process at a named crash point inside the
+write-ahead journal (journal.py) — ``pre-append`` (decision lost),
+``post-append`` (durable but unapplied), ``torn-append`` (half a record
+on disk), ``mid-snapshot`` (torn checkpoint temp), ``mid-truncate``
+(snapshot replaced, log not yet truncated).  Armed from the environment
+(``TPU_JOURNAL_KILL=point:nth``) so a child process under
+scripts/run_fault_matrix.py --kill dies exactly once, at exactly the
+probed window; the parent then recovers a fresh process from the journal
+and asserts bit-identical bindings.
+
 Every fired fault is appended to ``plan.fired`` as ``(kind, op, count)``;
 two plans built from the same rules and seed fire identically, which is
 what ``replay()`` returns and what scripts/run_fault_matrix.py sweeps.
@@ -39,7 +50,9 @@ what ``replay()`` returns and what scripts/run_fault_matrix.py sweeps.
 
 from __future__ import annotations
 
+import os
 import random
+import signal
 import socket
 import struct
 import threading
@@ -166,6 +179,53 @@ class FaultPlan:
                 if n == r.nth or (r.every and n >= r.nth):
                     self.fired.append(("engine", "*", n))
                     raise EngineFault("injected engine fault", ())
+
+
+KILL_POINTS = (
+    "pre-append", "post-append", "torn-append", "mid-snapshot",
+    "mid-truncate",
+)
+
+
+class KillSwitch:
+    """A process-kill fault: SIGKILL self when the Nth hit of the armed
+    crash point arrives.  The journal consults the module-level
+    ``journal.CRASH`` switch at every point via ``should_fire`` (which
+    counts EVERY point so nth is deterministic per point) and calls
+    ``fire`` only on a match — SIGKILL is not catchable, so the process
+    dies exactly where a power cut would have killed it."""
+
+    def __init__(self, point: str, nth: int = 1):
+        assert point in KILL_POINTS, point
+        self.point = point
+        self.nth = nth
+        self.counts: dict[str, int] = {}
+
+    def should_fire(self, point: str) -> bool:
+        c = self.counts.get(point, 0) + 1
+        self.counts[point] = c
+        return point == self.point and c == self.nth
+
+    def fire(self) -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # SIGKILL delivery races the return; never proceed
+
+    def arm(self) -> "KillSwitch":
+        from . import journal as _journal
+
+        _journal.CRASH = self
+        return self
+
+    @classmethod
+    def from_env(cls, var: str = "TPU_JOURNAL_KILL") -> "KillSwitch | None":
+        """``TPU_JOURNAL_KILL=point[:nth]`` — the child-process arming
+        protocol the kill matrix uses (the switch must be armed in the
+        victim process, not the sweeping parent)."""
+        spec = os.environ.get(var, "")
+        if not spec:
+            return None
+        point, _, nth = spec.partition(":")
+        return cls(point, int(nth or 1))
 
 
 def _frame_op(data: bytes) -> str:

@@ -18,7 +18,15 @@ with zero staleness window, where upstream waits out leaseDuration), and
 observability (the Lease's holderIdentity field), and release on clean
 shutdown (leaderelection.go:295 releases the lease so successors need not
 wait out the duration).
-"""
+
+The lease also carries a MONOTONIC EPOCH (the Lease's leaseTransitions
+analog): every successful acquire reads the previous holder's recorded
+epoch — a crashed holder's record lingers, which is exactly what keeps
+the counter monotonic across failovers — and writes epoch+1.  The epoch
+is the fencing token the write-ahead binding journal (journal.py) stamps
+on every record: a deposed leader that lingers past failover appends
+with a stale epoch and is rejected at append time and ignored at replay,
+so it can never corrupt durable state it no longer owns."""
 
 from __future__ import annotations
 
@@ -26,6 +34,17 @@ import fcntl
 import json
 import os
 import time
+
+
+def read_epoch(path: str) -> int:
+    """The epoch recorded in a lease file (0 when absent/unreadable) —
+    the journal's fence source: cheap enough to consult per append."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        return int(json.loads(raw).get("epoch", 0)) if raw else 0
+    except (OSError, ValueError, AttributeError, TypeError):
+        return 0
 
 
 class FileLease:
@@ -40,6 +59,8 @@ class FileLease:
         self.path = path
         self.identity = identity or f"pid-{os.getpid()}"
         self._fd: int | None = None
+        # The fencing epoch of THIS holder's tenure; 0 until acquired.
+        self.epoch: int = 0
 
     @property
     def held(self) -> bool:
@@ -58,16 +79,31 @@ class FileLease:
             os.close(fd)
             return False
         # Record the holder AFTER winning (the loser must not clobber the
-        # incumbent's record).
+        # incumbent's record).  The epoch continues from whatever the file
+        # records — a crashed holder's lingering record, a clean release's
+        # epoch-only record — so it is monotonic across every transition.
+        self.epoch = read_epoch(self.path) + 1
         os.ftruncate(fd, 0)
         os.pwrite(
             fd,
             json.dumps(
                 {"holderIdentity": self.identity, "pid": os.getpid(),
-                 "acquiredAt": time.time()}
+                 "acquiredAt": time.time(), "epoch": self.epoch}
             ).encode(),
             0,
         )
+        os.fsync(fd)  # the fencing token must survive a host crash
+        # A freshly created lease file needs its directory entry durable
+        # too, or a crash could lose the file and reset the epoch
+        # sequence — letting a successor reuse a deposed epoch.
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
         self._fd = fd
         return True
 
@@ -84,10 +120,14 @@ class FileLease:
 
     def release(self) -> None:
         """Clean handoff (leaderelection.go:295 ReleaseOnCancel): drop the
-        record, then the lock, so a standby wakes immediately."""
+        holder record, then the lock, so a standby wakes immediately.  The
+        EPOCH stays in the file — truncating it would reset the fencing
+        counter and let a successor reuse a deposed leader's epoch."""
         if self._fd is None:
             return
         os.ftruncate(self._fd, 0)
+        os.pwrite(self._fd, json.dumps({"epoch": self.epoch}).encode(), 0)
+        os.fsync(self._fd)
         fcntl.flock(self._fd, fcntl.LOCK_UN)
         os.close(self._fd)
         self._fd = None

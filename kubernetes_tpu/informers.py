@@ -83,6 +83,20 @@ class Reflector:
         # LIST-replace diffing and resync (cache.Store behind DeltaFIFO).
         self.store: dict[str, object] = {}
         self.relists = 0
+        # Crash-recovery overlay (reconcile_after_recovery): while set, a
+        # listed pod arriving UNBOUND whose uid maps to a recovered
+        # binding is delivered WITH that node — the journal is the bind
+        # authority when the relist hasn't (or never) observed the bind.
+        # A listed pod bound elsewhere is delivered as-is and wins
+        # (update_pod relocates — relist truth over a stale local view).
+        self.recovered_bindings: dict[str, str] = {}
+        # Same contract for NOMINATIONS (scheduler-authored pod status —
+        # the reference PATCHes .status.nominatedNodeName to the
+        # apiserver, so a relist would carry it; our recovered journal
+        # state is that authority here): a listed pod still unbound keeps
+        # its recovered nomination, or the preemptor would lose its
+        # claim on the freed node across the restart.
+        self.recovered_nominations: dict[str, str] = {}
 
     # -- delivery into the scheduler's handler surface ----------------------
 
@@ -101,7 +115,24 @@ class Reflector:
             if ev == DELETED:
                 uid = obj if isinstance(obj, str) else _uid_of("Pod", obj)
                 s.delete_pod(uid)
-            elif ev == ADDED:
+                return
+            if not obj.spec.node_name and (
+                self.recovered_bindings or self.recovered_nominations
+            ):
+                node = self.recovered_bindings.get(obj.uid)
+                nom = self.recovered_nominations.get(obj.uid)
+                if node is not None or nom is not None:
+                    # Re-apply the journal's binding/nomination onto the
+                    # listed object (copy: the lister's object is host
+                    # truth and must not be mutated in place).
+                    import copy
+
+                    obj = copy.deepcopy(obj)
+                    if node is not None:
+                        obj.spec.node_name = node
+                    elif nom is not None:
+                        obj.status.nominated_node_name = nom
+            if ev == ADDED:
                 s.add_pod(obj)
             else:
                 s.update_pod(obj)
@@ -180,6 +211,56 @@ class Reflector:
         for obj in list(self.store.values()):
             self._deliver(MODIFIED, obj)
         return len(self.store)
+
+
+def reconcile_after_recovery(scheduler, node_reflector, pod_reflector) -> dict:
+    """Cold-start recovery ordering (journal.py docstring step 3): after
+    journal.recover() rebuilt the scheduler from snapshot + fenced
+    replay, reconcile against a fresh LIST.
+
+    1. Nodes relist first (bindings need rows to land on) — LIST-as-
+       replace, so nodes gone from host truth vanish with their pods.
+    2. Journal bind records whose node was unknown at replay time
+       (scheduler._recovered_bindings) re-apply now that the LIST may
+       have delivered the node; bindings whose node never relists are
+       dropped — the node is truly gone, the pods reschedule.
+    3. Pods relist under the recovered-bindings overlay: a listed pod
+       the journal holds bound but the relist shows unbound keeps the
+       journal's binding (re-applied), a listed pod bound elsewhere wins
+       as host truth (update_pod relocates), and pods absent from the
+       relist are deleted (DeltaFIFO Replace).
+    """
+    stats = {"nodes": node_reflector.run_once()}
+    pending = getattr(scheduler, "_recovered_bindings", None) or {}
+    applied = dropped = 0
+    if pending:
+        from .api import serialize
+
+        for uid, d in list(pending.items()):
+            if d["node"] in scheduler.cache.nodes:
+                pod = serialize.pod_from_data(d["pod"])
+                pod.spec.node_name = d["node"]
+                scheduler.add_pod(pod)
+                applied += 1
+            else:
+                dropped += 1
+            pending.pop(uid, None)
+    stats["late_bindings_applied"] = applied
+    stats["late_bindings_dropped"] = dropped
+    pod_reflector.recovered_bindings = {
+        uid: pr.node_name
+        for uid, pr in scheduler.cache.pods.items()
+        if pr.node_name
+    }
+    pod_reflector.recovered_nominations = {
+        uid: node for uid, (node, _d, _p) in scheduler.nominator.items()
+    }
+    try:
+        stats["pods"] = pod_reflector.run_once()
+    finally:
+        pod_reflector.recovered_bindings = {}
+        pod_reflector.recovered_nominations = {}
+    return stats
 
 
 class FakeSource:

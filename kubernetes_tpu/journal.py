@@ -1,0 +1,510 @@
+"""Crash-safe scheduler state: the write-ahead binding journal.
+
+The reference scheduler is stateless because etcd is the durable truth
+(SURVEY layer 0, etcd3/store.go): a `kill -9` of kube-scheduler loses
+nothing — bindings live in the apiserver, the queue rebuilds from a LIST.
+Our host process kept bindings, queue/backoff state and the quarantine
+pool in dicts, so a host kill silently forgot in-flight commits and could
+double-bind on restart.  This module is the etcd stand-in:
+
+- ``Journal``: a length-prefixed, CRC-checked write-ahead log.  Every
+  binding/preemption/quarantine decision is appended — and fsync'd —
+  BEFORE it is applied to live state, so the decision survives a crash
+  landing anywhere after the append.  A torn final record (crash mid-
+  write) fails its CRC/length check and is truncated away at open; the
+  decision it described was never applied, so dropping it is exactly
+  the etcd semantics of an unacknowledged write.
+
+- Epoch fencing: every record is stamped with the holder's lease epoch
+  (framework/leaderelection.py FileLease.epoch).  Appends check the
+  fence (the lease file's current epoch) and the log's own running
+  maximum; a deposed leader lingering past failover gets
+  ``StaleEpochError`` instead of a write, and — belt and braces — replay
+  drops any record whose epoch is below the running maximum at its
+  position, so even a racing stale append cannot resurrect state.
+
+- Snapshots: ``snapshot()`` writes the full scheduler store + queue
+  (backoff clocks, attempts, the quarantine pool) as one fsync'd JSON
+  document via temp-file + ``os.replace`` (a crash mid-snapshot leaves
+  the previous snapshot intact), then truncates the log at the snapshot
+  barrier.  Records carry a monotonic ``seq`` and the snapshot stores
+  the last included seq, so a crash BETWEEN the replace and the truncate
+  replays nothing twice.
+
+- Recovery: ``recover(scheduler, journal)`` rebuilds a fresh scheduler
+  from snapshot + fenced journal replay.  The caller then reconciles
+  against a LIST (informers.reconcile_after_recovery): journal bindings
+  absent from the relist are re-applied, relist bindings absent from the
+  journal win as host truth — the same DeltaFIFO-replace discipline a
+  restarted kube-scheduler gets from its informer LIST.
+
+Crash-point hooks: the module-level ``CRASH`` switch (faults.KillSwitch)
+is consulted at the named points (pre-append, torn-append, post-append,
+mid-snapshot, mid-truncate) so the chaos harness
+(scripts/run_fault_matrix.py --kill) can SIGKILL the process at each
+window and assert recovery lands bit-identical bindings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import zlib
+
+from .framework.metrics import Histogram, exponential_buckets
+
+_HDR = struct.Struct(">II")  # payload length, crc32(payload)
+MAX_RECORD = 64 << 20
+
+# Process-kill fault switch (faults.KillSwitch): None in production.
+# Consulted at every named crash point; ``should_fire`` counts hits and
+# returns True on the armed point's Nth, ``fire`` SIGKILLs the process.
+CRASH = None
+
+
+def _crash(point: str) -> None:
+    c = CRASH
+    if c is not None and c.should_fire(point):
+        c.fire()
+
+
+class StaleEpochError(RuntimeError):
+    """An append was fenced: the writer's lease epoch is older than the
+    current leader's.  The deposed holder must stop committing — its
+    decisions no longer own the cluster."""
+
+
+class Journal:
+    """One journal directory: ``journal.wal`` + ``snapshot.json``.
+
+    ``epoch`` is the holder's fencing token (FileLease.epoch); ``fence``
+    is an optional zero-arg callable returning the CURRENT authoritative
+    epoch (leaderelection.read_epoch over the lease file) consulted on
+    every append.  ``fsync`` False trades durability of the last few
+    records for append latency (the fsync knob README documents); the
+    snapshot path always fsyncs — it is the recovery floor."""
+
+    WAL = "journal.wal"
+    SNAP = "snapshot.json"
+
+    def __init__(
+        self,
+        directory: str,
+        epoch: int = 0,
+        fence=None,
+        fsync: bool = True,
+    ) -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.epoch = epoch
+        self.fence = fence
+        self.fsync_enabled = fsync
+        # recover() mutes appends while it replays the log through the
+        # scheduler's own mutation surface (those calls would otherwise
+        # re-journal every replayed decision).
+        self.muted = False
+        # Observability (exported as scheduler_journal_* by the
+        # scheduler's collector once attached).
+        self.appends = 0
+        self.fsyncs = 0
+        self.fenced = 0  # appends rejected by the epoch fence
+        self.snapshots = 0
+        self.truncations = 0
+        self.replayed = 0  # records applied by the last replay()
+        self.replay_fenced = 0  # records dropped stale by the last replay()
+        self.torn_bytes = 0  # trailing bytes dropped by open-time repair
+        self.append_latency = Histogram(
+            buckets=exponential_buckets(1e-6, 2, 24)
+        )
+        self.wal_path = os.path.join(directory, self.WAL)
+        self.snap_path = os.path.join(directory, self.SNAP)
+        # A leftover snapshot temp file is a torn snapshot write: the
+        # replace never happened, so the previous snapshot (if any) is
+        # the valid one and the temp is garbage.
+        tmp = self.snap_path + ".tmp"
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        snap = self.load_snapshot()
+        self.snapshot_seq = snap["seq"] if snap else 0
+        self._max_epoch = snap["epoch"] if snap else 0
+        self.seq = self.snapshot_seq
+        # Scan the existing log: learn seq/epoch high-water marks and
+        # truncate a torn tail (a record whose bytes were cut by a crash
+        # mid-append — its decision was never applied, so it never was).
+        good_off = 0
+        for off, rec in self._scan():
+            self.seq = max(self.seq, rec["q"])
+            self._max_epoch = max(self._max_epoch, rec["e"])
+            good_off = off
+        try:
+            size = os.path.getsize(self.wal_path)
+        except OSError:
+            size = 0
+        if size > good_off:
+            self.torn_bytes = size - good_off
+            with open(self.wal_path, "r+b") as f:
+                f.truncate(good_off)
+                os.fsync(f.fileno())
+        self._f = open(self.wal_path, "ab")
+        # The WAL's directory entry must be durable too: fsync'ing only
+        # the file data leaves a freshly created journal.wal losable with
+        # everything in it on some filesystems until the first snapshot's
+        # directory fsync — defeating --journal-fsync always.
+        self._fsync_dir()
+        # Where this writer believes the log ends.  A mismatch at append
+        # time means ANOTHER writer touched the file (a successor leader
+        # appending, or its snapshot truncating) — the self-fencing
+        # tripwire for deposed holders running without a fence callable.
+        self._expected_size = min(size, good_off) if size else 0
+
+    # -- the write path ----------------------------------------------------
+
+    def _current_epoch(self) -> int:
+        cur = self._max_epoch
+        if self.fence is not None:
+            cur = max(cur, self.fence())
+        return cur
+
+    def _check_fence(self) -> None:
+        # Self-fencing tripwire: if the log's size is not where this
+        # writer left it, another holder has written (or truncated at a
+        # snapshot barrier) — adopt the file's epoch high-water mark
+        # before judging our own.
+        try:
+            size = os.path.getsize(self.wal_path)
+        except OSError:
+            size = 0
+        if size != self._expected_size:
+            for _off, rec in self._scan():
+                self._max_epoch = max(self._max_epoch, rec["e"])
+            snap = self.load_snapshot()
+            if snap is not None:
+                self._max_epoch = max(self._max_epoch, snap["epoch"])
+            self._expected_size = size
+        cur = self._current_epoch()
+        if self.epoch < cur:
+            self.fenced += 1
+            raise StaleEpochError(
+                f"journal writer epoch {self.epoch} fenced by epoch {cur}"
+            )
+
+    def append(self, rtype: str, data: dict) -> int | None:
+        """Durably record one decision BEFORE it is applied.  Returns the
+        record's seq, or None while muted (recovery replay).  Raises
+        StaleEpochError when this writer has been deposed."""
+        if self.muted:
+            return None
+        self._check_fence()
+        _crash("pre-append")
+        self.seq += 1
+        payload = json.dumps(
+            {"e": self.epoch, "q": self.seq, "t": rtype, "d": data},
+            separators=(",", ":"),
+        ).encode()
+        buf = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        c = CRASH
+        if c is not None and c.should_fire("torn-append"):
+            # Crash mid-write: leave half the record's bytes on disk (the
+            # torn-tail shape open-time repair must absorb), make them
+            # durable so recovery actually sees them, then die.
+            self._f.write(buf[: _HDR.size + max(1, len(payload) // 2)])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            c.fire()
+        t0 = time.perf_counter()
+        self._f.write(buf)
+        self._f.flush()
+        if self.fsync_enabled:
+            os.fsync(self._f.fileno())
+            self.fsyncs += 1
+        self.append_latency.observe(time.perf_counter() - t0)
+        self.appends += 1
+        self._max_epoch = max(self._max_epoch, self.epoch)
+        self._expected_size = self._f.tell()
+        _crash("post-append")
+        return self.seq
+
+    def snapshot(self, state: dict) -> None:
+        """Checkpoint the full scheduler state and truncate the log at the
+        barrier.  Atomic: temp + fsync + os.replace, so a crash at any
+        point leaves either the old snapshot + full log or the new
+        snapshot (+ a log whose records the seq filter skips)."""
+        if self.muted:
+            return
+        self._check_fence()
+        doc = {"epoch": self.epoch, "seq": self.seq, "state": state}
+        blob = json.dumps(doc, separators=(",", ":")).encode()
+        tmp = self.snap_path + ".tmp"
+        c = CRASH
+        with open(tmp, "wb") as f:
+            if c is not None and c.should_fire("mid-snapshot"):
+                # Crash mid-snapshot-write: a durable torn temp file the
+                # next open must discard (the replace never happened).
+                f.write(blob[: max(1, len(blob) // 2)])
+                f.flush()
+                os.fsync(f.fileno())
+                c.fire()
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        self._fsync_dir()
+        self.snapshots += 1
+        self.snapshot_seq = self.seq
+        _crash("mid-truncate")
+        # Truncate at the barrier: every surviving record is covered by
+        # the snapshot's seq.  A crash landing before this point replays
+        # them through the seq filter — harmless.
+        os.ftruncate(self._f.fileno(), 0)
+        if self.fsync_enabled:
+            os.fsync(self._f.fileno())
+        self._expected_size = 0
+        self.truncations += 1
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    # -- the read path -----------------------------------------------------
+
+    def _scan(self):
+        """Yield (end_offset, record) for every valid record in the log,
+        stopping at the first torn/corrupt one (everything after a bad
+        record is untrustworthy — the stream lost its framing).
+        Torn-tail truncation itself happens at __init__."""
+        try:
+            with open(self.wal_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return
+        off = 0
+        while len(blob) - off >= _HDR.size:
+            n, crc = _HDR.unpack_from(blob, off)
+            if n > MAX_RECORD or len(blob) - off - _HDR.size < n:
+                break  # torn tail / garbage length
+            payload = blob[off + _HDR.size : off + _HDR.size + n]
+            if zlib.crc32(payload) != crc:
+                break  # corrupt record: stop, don't guess
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                break
+            off += _HDR.size + n
+            yield off, rec
+
+    def load_snapshot(self) -> dict | None:
+        """The last durable checkpoint, or None (missing/corrupt — a
+        corrupt snapshot means the replace itself was interrupted by
+        something this format can't have produced; treat as cold)."""
+        try:
+            with open(self.snap_path, "rb") as f:
+                doc = json.loads(f.read())
+            if not isinstance(doc, dict) or "seq" not in doc:
+                return None
+            return doc
+        except (OSError, ValueError):
+            return None
+
+    def replay(self) -> tuple[dict | None, list[dict], dict]:
+        """(snapshot doc or None, post-snapshot records in order, stats).
+        Records already covered by the snapshot barrier (seq <= the
+        snapshot's) are skipped; records from a deposed epoch (below the
+        running maximum at their position) are dropped as fenced."""
+        snap = self.load_snapshot()
+        snap_seq = snap["seq"] if snap else 0
+        max_e = snap["epoch"] if snap else 0
+        records: list[dict] = []
+        fenced = 0
+        for _off, rec in self._scan():
+            if rec["e"] < max_e:
+                fenced += 1
+                continue
+            max_e = rec["e"]
+            if rec["q"] <= snap_seq:
+                continue
+            records.append(rec)
+        self.replayed = len(records)
+        self.replay_fenced = fenced
+        return snap, records, {
+            "snapshot": snap is not None,
+            "snapshot_seq": snap_seq,
+            "records": len(records),
+            "fenced": fenced,
+            "torn_bytes": self.torn_bytes,
+        }
+
+    def stats(self) -> dict:
+        try:
+            wal_bytes = os.path.getsize(self.wal_path)
+        except OSError:
+            wal_bytes = 0
+        return {
+            "dir": self.dir,
+            "epoch": self.epoch,
+            "seq": self.seq,
+            "snapshot_seq": self.snapshot_seq,
+            "appends": self.appends,
+            "fsyncs": self.fsyncs,
+            "fenced": self.fenced,
+            "snapshots": self.snapshots,
+            "truncations": self.truncations,
+            "replayed": self.replayed,
+            "replay_fenced": self.replay_fenced,
+            "torn_bytes": self.torn_bytes,
+            "wal_bytes": wal_bytes,
+            "append_p99_us": round(
+                self.append_latency.quantile(0.99) * 1e6, 3
+            ),
+        }
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+# -- scheduler state <-> snapshot documents --------------------------------
+
+
+def scheduler_state(sched) -> dict:
+    """The snapshot document for one TPUScheduler: host store (nodes in
+    row order, so restore reproduces row assignment), bound pods, the
+    queue's durable state (backoff clocks, attempts, quarantine), gang
+    credit, groups/PDBs, and live nominations.  Assumed-but-unbound pods
+    (Permit/PreBind wait rooms) snapshot as PENDING — their bind was
+    never final, so a restart retries them, like the reference retries
+    an in-flight binding its informer never confirmed."""
+    from .api import serialize
+
+    waiting = [
+        e[0] for entries in sched.permit_waiting.values() for e in entries
+    ] + [e["qp"] for e in sched.prebind_waiting.values()]
+    queue_state = sched.queue.durable_state()
+    for qp in waiting:
+        queue_state["entries"].append(
+            {
+                "pod": serialize.to_dict(qp.pod),
+                "pool": "active",
+                "attempts": qp.attempts,
+                "age": 0.0,
+                "plugins": [],
+            }
+        )
+    return {
+        "nodes": [
+            serialize.to_dict(rec.node)
+            for rec in sorted(sched.cache.nodes.values(), key=lambda r: r.row)
+        ],
+        "pods": [
+            {"pod": serialize.to_dict(pr.pod), "node": pr.node_name}
+            for uid, pr in sched.cache.pods.items()
+            if pr.bound
+        ],
+        "queue": queue_state,
+        "gang_bound": dict(sched.gang_bound),
+        "pod_groups": [
+            serialize.to_dict(g) for g in sched.pod_groups.values()
+        ],
+        "pdbs": [serialize.to_dict(p) for p in sched.pdbs.values()],
+        "nominated": {
+            uid: {"node": node, "priority": prio}
+            for uid, (node, _delta, prio) in sched.nominator.items()
+        },
+    }
+
+
+def recover(sched, journal: Journal) -> dict:
+    """Rebuild a FRESH scheduler from durable state: apply the snapshot,
+    then replay post-barrier journal records with epoch fencing.  Bind
+    records naming a node the snapshot doesn't hold are parked on
+    ``sched._recovered_bindings`` for the LIST reconcile
+    (informers.reconcile_after_recovery) to re-apply once the node
+    relists.  Returns replay stats.  Call BEFORE attach_journal — the
+    replay drives the scheduler's own mutation surface, which must not
+    re-journal."""
+    from .api import serialize
+
+    snap, records, stats = journal.replay()
+    journal.muted = True
+    try:
+        if snap is not None:
+            st = snap["state"]
+            for data in st.get("nodes", ()):
+                sched.add_node(
+                    serialize.build(serialize.KINDS["Node"][0], data)
+                )
+            for g in st.get("pod_groups", ()):
+                sched.add_pod_group(
+                    serialize.build(serialize.KINDS["PodGroup"][0], g)
+                )
+            for p in st.get("pdbs", ()):
+                sched.add_pdb(
+                    serialize.build(
+                        serialize.KINDS["PodDisruptionBudget"][0], p
+                    )
+                )
+            for entry in st.get("pods", ()):
+                pod = serialize.pod_from_data(entry["pod"])
+                pod.spec.node_name = entry["node"]
+                if entry["node"] in sched.cache.nodes:
+                    sched.add_pod(pod)
+            # Gang credit AFTER the bound adds (add_pod already credited
+            # informer-delivered bound members; don't double-count —
+            # overwrite with the snapshot's authoritative counts).
+            sched.gang_bound = dict(st.get("gang_bound", {}))
+            sched.queue.restore_state(st.get("queue", {}))
+            for uid, info in st.get("nominated", {}).items():
+                qp = sched.queue._info.get(uid)
+                if qp is not None and info["node"] in sched.cache.nodes:
+                    sched.nominator[uid] = (
+                        info["node"],
+                        sched.builder.pod_delta_vectors(qp.pod),
+                        info.get("priority", 0),
+                    )
+        pending: dict[str, dict] = {}
+        for rec in records:
+            rtype, d = rec["t"], rec["d"]
+            if rtype == "bind":
+                pod = serialize.pod_from_data(d["pod"])
+                pod.spec.node_name = d["node"]
+                if d["node"] in sched.cache.nodes:
+                    sched.add_pod(pod)
+                else:
+                    pending[pod.uid] = d
+            elif rtype == "delete":
+                pending.pop(d["uid"], None)
+                sched.delete_pod(d["uid"])
+            elif rtype == "preempt":
+                # Victims arrive via their own delete records; what the
+                # preempt record restores is the NOMINATION — the claim
+                # that routes the still-pending preemptor's retry onto
+                # its freed node (nominator.go AddNominatedPod).
+                qp = sched.queue._info.get(d["uid"])
+                if qp is not None and d["node"] in sched.cache.nodes:
+                    qp.pod.status.nominated_node_name = d["node"]
+                    sched.nominator[d["uid"]] = (
+                        d["node"],
+                        sched.builder.pod_delta_vectors(qp.pod),
+                        d.get("priority", 0),
+                    )
+            elif rtype == "quarantine":
+                sched.queue.restore_quarantine(
+                    serialize.pod_from_data(d["pod"]),
+                    attempts=d.get("attempts", 1),
+                )
+            elif rtype == "release_quarantine":
+                sched.queue.release_quarantine(d.get("uid"))
+        sched._recovered_bindings = pending
+        stats["pending_bindings"] = len(pending)
+    finally:
+        journal.muted = False
+    return stats

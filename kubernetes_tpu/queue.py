@@ -223,6 +223,11 @@ class SchedulingQueue:
         # installs a pod → bool predicate saying whether the pod's profile
         # runs SchedulingGates; None = every profile does.
         self.gates_apply_to = None
+        # Write-ahead binding journal (journal.Journal), attached by
+        # TPUScheduler.attach_journal.  The queue journals the one durable
+        # decision IT owns — releasing a quarantined pod — before applying
+        # it; everything else is journaled at the scheduler's commit sites.
+        self.journal = None
 
     def __len__(self) -> int:
         return len(self._in_active)
@@ -264,11 +269,31 @@ class SchedulingQueue:
         uids = [uid] if uid is not None else list(self._quarantine)
         n = 0
         for u in uids:
+            if u in self._quarantine and self.journal is not None:
+                # Write-ahead: the release is a durable decision — a
+                # restart must not resurrect the pod into quarantine.
+                self.journal.append("release_quarantine", {"uid": u})
             qp = self._quarantine.pop(u, None)
             if qp is not None:
                 self.add_backoff(qp)
                 n += 1
         return n
+
+    def restore_quarantine(self, pod: t.Pod, attempts: int = 1) -> None:
+        """Recovery path (journal.recover): re-isolate a pod a journal
+        record says was quarantined, preserving its accumulated attempt
+        count so the post-release backoff damping survives the restart.
+        The pod may also exist as a snapshot-restored PENDING entry (the
+        quarantine decision postdates the snapshot) — quarantine() pulls
+        it out of whatever pool it sits in."""
+        qp = self._info.get(pod.uid)
+        if qp is None:
+            now = self._clock()
+            qp = QueuedPodInfo(
+                pod=pod, timestamp=now, initial_attempt_timestamp=now
+            )
+        qp.attempts = max(qp.attempts, attempts)
+        self.quarantine(qp)
 
     # -- gang admission --------------------------------------------------------
 
@@ -493,7 +518,10 @@ class SchedulingQueue:
         while self._backoff and self._backoff[0][0] <= now:
             _, _, uid = heapq.heappop(self._backoff)
             qp = self._info.get(uid)
-            if qp is not None:
+            # A stale heap entry must not spring a quarantined pod (a
+            # restored snapshot can hold a pod in backoff that a later
+            # journal record moved to quarantine).
+            if qp is not None and uid not in self._quarantine:
                 self._push_active(qp)
                 n += 1
         return n
@@ -673,6 +701,118 @@ class SchedulingQueue:
     def done(self, uid: str) -> None:
         """Pod scheduled successfully; drop bookkeeping."""
         self._info.pop(uid, None)
+
+    # -- durability (journal.py snapshot surface) ------------------------------
+
+    def durable_state(self) -> dict:
+        """Serialize every queued pod for a journal snapshot.  Clocks are
+        RELATIVE (backoff remaining, age since first enqueue): monotonic
+        timestamps don't survive a process, so restore_state rebases them
+        on the restoring process's clock — a pod 3s into a 10s backoff
+        resumes with ~7s left, not a reset."""
+        from .api import serialize
+
+        now = self._clock()
+        backoff_left: dict[str, float] = {}
+        for exp, _seq, uid in self._backoff:
+            left = max(0.0, exp - now)
+            # Duplicate heap entries: keep the earliest expiry (the one
+            # flush_backoff would honor first).
+            if uid not in backoff_left or left < backoff_left[uid]:
+                backoff_left[uid] = left
+        entries: list[dict] = []
+        seen: set[str] = set()
+
+        def ent(qp: QueuedPodInfo, pool: str, **extra) -> None:
+            if qp.pod.uid in seen:
+                return
+            seen.add(qp.pod.uid)
+            entries.append(
+                {
+                    "pod": serialize.to_dict(qp.pod),
+                    "pool": pool,
+                    "attempts": qp.attempts,
+                    "age": max(0.0, now - qp.initial_attempt_timestamp),
+                    "plugins": sorted(qp.unschedulable_plugins),
+                    **extra,
+                }
+            )
+
+        for uid, qp in self._quarantine.items():
+            ent(qp, "quarantine")
+        for uid, qp in self._gated.items():
+            ent(qp, "gated")
+        for uid, qp in self._unschedulable.items():
+            ent(qp, "unschedulable")
+        for pool in self._gang_pool.values():
+            for qp in pool.values():
+                ent(qp, "gang")
+        for uid in self._in_active:
+            ent(self._info[uid], "active")
+        for uid, left in backoff_left.items():
+            qp = self._info.get(uid)
+            if qp is not None:
+                ent(qp, "backoff", backoff_remaining_s=round(left, 6))
+        return {"entries": entries}
+
+    def restore_state(self, state: dict) -> int:
+        """Rebuild the pools from a durable_state() document (recovery).
+        Pods already present — bound pods the snapshot's store section
+        restored first, say — are skipped; gang members re-park through
+        the normal admission machinery so quorum logic stays live."""
+        from .api import serialize
+
+        now = self._clock()
+        n = 0
+        for e in state.get("entries", ()):
+            pod = serialize.pod_from_data(e["pod"])
+            uid = pod.uid
+            if uid in self._info or uid in self._quarantine:
+                continue
+            qp = QueuedPodInfo(
+                pod=pod,
+                timestamp=now,
+                initial_attempt_timestamp=now - float(e.get("age", 0.0)),
+                attempts=int(e.get("attempts", 0)),
+                unschedulable_plugins=set(e.get("plugins", ())),
+            )
+            self._info[uid] = qp
+            pool = e.get("pool", "active")
+            if pool == "quarantine":
+                qp.unschedulable_plugins = qp.unschedulable_plugins or {
+                    "EngineFault"
+                }
+                self._quarantine[uid] = qp
+            elif pool == "gated":
+                qp.gated = True
+                self._gated[uid] = qp
+            elif pool == "unschedulable":
+                if pod.spec.pod_group:
+                    self._track_gang_member(qp)
+                self._unsched_insert(qp)
+            elif pool == "gang":
+                self._park_gang_member(qp)
+            elif pool == "backoff":
+                if pod.spec.pod_group:
+                    self._track_gang_member(qp)
+                heapq.heappush(
+                    self._backoff,
+                    (
+                        now + float(e.get("backoff_remaining_s", 0.0)),
+                        next(self._seq),
+                        uid,
+                    ),
+                )
+            else:
+                if pod.spec.pod_group:
+                    self._track_gang_member(qp)
+                self._push_active(qp)
+            n += 1
+        # Parked gangs whose quorum is already reachable release now (a
+        # restart must not strand a quorum-complete gang).
+        for g in list(self._gang_pool):
+            self._try_admit_gang(g)
+        return n
 
     def depths(self) -> dict[str, int]:
         """Per-class queue depths — the scheduler_pending_pods{queue=…}

@@ -72,6 +72,8 @@ class ResyncingClient:
         socket_wrapper=None,
         registry=None,
         seed: int = 0,
+        journal=None,
+        journal_snapshot_every: int = 256,
     ):
         self.path = path
         self.max_reconnect_s = max_reconnect_s
@@ -125,8 +127,26 @@ class ResyncingClient:
         self._probe_thread: threading.Thread | None = None
         self._probe_conn: SidecarClient | None = None
         self._lock = threading.Lock()  # guards the probe handover
+        # Durable replay store (journal.Journal): when given, every
+        # object upsert/remove and every learned BINDING is journaled
+        # before the in-memory mirror mutates, and the mirror itself is
+        # REBUILT from snapshot+journal at construction — a host kill no
+        # longer forgets what it told the sidecar, and the post-crash
+        # replay ships the same bound world a live host would have.
+        self.journal = journal
+        self.journal_snapshot_every = journal_snapshot_every
+        if journal is not None:
+            self._load_durable()
         self._client = self._connect()
         self._set_state("healthy")
+        if journal is not None and (
+            self._ns_labels or any(self._store.values())
+        ):
+            # Cold-start recovery: the fresh connection gets the durable
+            # world before any caller traffic (the reference's
+            # WaitForCacheSync-then-schedule ordering).
+            self._replay()
+            self.resyncs += 1
 
     # -- wiring ------------------------------------------------------------
 
@@ -144,6 +164,82 @@ class ResyncingClient:
 
     def _record(self, kind: str, obj) -> None:
         self._store.setdefault(kind, {})[_key(kind, obj)] = obj
+
+    # -- durable replay store (journal.py) ---------------------------------
+
+    def _obj_from_data(self, kind: str, data: dict):
+        if kind == "Pod":
+            return serialize.pod_from_data(data)
+        return serialize.build(serialize.KINDS[kind][0], data)
+
+    def _load_durable(self) -> None:
+        """Rebuild the replay store from snapshot + fenced journal replay
+        (instead of only from the live mirror a dead process took with
+        it)."""
+        snap, records, _stats = self.journal.replay()
+        if snap is not None:
+            st = snap["state"]
+            self._ns_labels = dict(st.get("ns_labels", {}))
+            for kind, objs in st.get("store", {}).items():
+                self._store[kind] = {}
+                for data in objs:
+                    obj = self._obj_from_data(kind, data)
+                    self._store[kind][_key(kind, obj)] = obj
+        for rec in records:
+            rtype, d = rec["t"], rec["d"]
+            if rtype == "add":
+                obj = self._obj_from_data(d["kind"], d["obj"])
+                self._store.setdefault(d["kind"], {})[
+                    _key(d["kind"], obj)
+                ] = obj
+            elif rtype == "remove":
+                self._apply_remove_local(d["kind"], d["uid"])
+            elif rtype == "bind":
+                p = self._store["Pod"].get(d["uid"])
+                if p is not None:
+                    p.spec.node_name = d["node"]
+            elif rtype == "ns":
+                self._ns_labels[d["namespace"]] = dict(d["labels"])
+
+    def _journal_mutation(self, rtype: str, data: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(rtype, data)
+
+    def _maybe_checkpoint(self) -> None:
+        """Checkpoint cadence — call AFTER the mutation has been applied
+        to the in-memory store: the snapshot's seq covers every appended
+        record and truncates the log, so snapshotting a store that does
+        not yet hold the last record would durably lose it (the exact
+        double-bind window the journal exists to close)."""
+        j = self.journal
+        if (
+            j is not None
+            and self.journal_snapshot_every
+            and j.seq - j.snapshot_seq >= self.journal_snapshot_every
+        ):
+            j.snapshot(
+                {
+                    "ns_labels": dict(self._ns_labels),
+                    "store": {
+                        kind: [serialize.to_dict(o) for o in objs.values()]
+                        for kind, objs in self._store.items()
+                        if objs
+                    },
+                }
+            )
+
+    def _apply_remove_local(self, kind: str, uid: str) -> None:
+        self._store.get(kind, {}).pop(uid, None)
+        if kind == "Node":
+            # Pods on a removed node vanish from scheduling state (the
+            # engine's remove_node contract); the store must mirror that
+            # or a later replay re-adds pods bound to a node that no
+            # longer exists — a server-side error that wedges the replay.
+            self._store["Pod"] = {
+                u: p
+                for u, p in self._store["Pod"].items()
+                if p.spec.node_name != uid
+            }
 
     # -- reconnect + replay ------------------------------------------------
 
@@ -335,7 +431,11 @@ class ResyncingClient:
         return degraded_fn()
 
     def set_namespace_labels(self, namespace: str, labels: dict) -> None:
+        self._journal_mutation(
+            "ns", {"namespace": namespace, "labels": dict(labels)}
+        )
         self._ns_labels[namespace] = dict(labels)
+        self._maybe_checkpoint()
         self._call_or_degraded(
             lambda: self._client.set_namespace_labels(namespace, labels),
             lambda: self._ensure_fallback().builder.set_namespace_labels(
@@ -344,7 +444,11 @@ class ResyncingClient:
         )
 
     def add(self, kind: str, obj) -> None:
+        self._journal_mutation(
+            "add", {"kind": kind, "obj": serialize.to_dict(obj)}
+        )
         self._record(kind, obj)
+        self._maybe_checkpoint()
         self._call_or_degraded(
             lambda: self._client.add(kind, obj),
             lambda: self._fallback_add(kind, obj),
@@ -355,17 +459,9 @@ class ResyncingClient:
         getattr(fb, serialize.KINDS[kind][1])(obj)
 
     def remove(self, kind: str, uid: str) -> None:
-        self._store.get(kind, {}).pop(uid, None)
-        if kind == "Node":
-            # Pods on a removed node vanish from scheduling state (the
-            # engine's remove_node contract); the store must mirror that
-            # or a later replay re-adds pods bound to a node that no
-            # longer exists — a server-side error that wedges the replay.
-            self._store["Pod"] = {
-                u: p
-                for u, p in self._store["Pod"].items()
-                if p.spec.node_name != uid
-            }
+        self._journal_mutation("remove", {"kind": kind, "uid": uid})
+        self._apply_remove_local(kind, uid)
+        self._maybe_checkpoint()
         self._call_or_degraded(
             lambda: self._client.remove(kind, uid),
             lambda: self._fallback_remove(kind, uid),
@@ -429,9 +525,13 @@ class ResyncingClient:
     ) -> list[pb.PodResult]:
         # Pending pods enter the store UNBOUND first: if the sidecar dies
         # mid-call the replay re-submits them (at-least-once; the engine's
-        # upsert path makes re-delivery idempotent).
+        # upsert path makes re-delivery idempotent).  Journaled for the
+        # same reason — a restarted HOST must re-submit them too.
         pods = list(pods)
         for p in pods:
+            self._journal_mutation(
+                "add", {"kind": "Pod", "obj": serialize.to_dict(p)}
+            )
             self._record("Pod", p)
         results = self._call_or_degraded(
             lambda: self._client.schedule(pods, drain=drain, trace=trace),
@@ -446,10 +546,21 @@ class ResyncingClient:
             if p is None:
                 continue
             if r.node_name:
+                # Write-ahead: the learned binding is durable before the
+                # mirror records it — a host kill between the response
+                # and the next replay can no longer forget a commit the
+                # sidecar already made (the double-bind window).
+                self._journal_mutation(
+                    "bind", {"uid": r.pod_uid, "node": r.node_name}
+                )
                 p.spec.node_name = r.node_name
             for vu in r.victim_uids:
                 # Preemption victims were deleted sidecar-side; mirror that.
+                self._journal_mutation(
+                    "remove", {"kind": "Pod", "uid": vu}
+                )
                 self._store["Pod"].pop(vu, None)
+        self._maybe_checkpoint()
         return results
 
     def close(self) -> None:

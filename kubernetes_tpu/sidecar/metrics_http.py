@@ -31,6 +31,15 @@ def health_state(scheduler, extra: dict | None = None) -> dict:
         "pods": len(scheduler.cache.pods),
         "pending": len(scheduler.queue),
     }
+    journal = getattr(scheduler, "journal", None)
+    if journal is not None:
+        # Durability probes: the epoch the writer holds and how far the
+        # log has grown past its last checkpoint.
+        state["journal"] = {
+            "epoch": journal.epoch,
+            "seq": journal.seq,
+            "snapshot_seq": journal.snapshot_seq,
+        }
     if extra:
         state.update(extra)
     return state

@@ -120,6 +120,8 @@ class SidecarServer:
         health_extra: dict | None = None,
         http_port: int | None = None,
         http_host: str = "127.0.0.1",
+        journal=None,
+        snapshot_every_batches: int = 64,
         **kw,
     ):
         self.path = path
@@ -129,6 +131,19 @@ class SidecarServer:
         # change later responses; rebinding the attribute has no effect.
         self.health_extra = health_extra = health_extra or {}
         self.scheduler = scheduler or TPUScheduler(**kw)
+        # Durability (journal.py): recover BEFORE serving — the first
+        # frame must see the pre-crash world, exactly like the reference
+        # waits out WaitForCacheSync before its loop — then arm the
+        # write-ahead hooks for this tenure.
+        if journal is not None:
+            from ..journal import recover
+
+            self.recovery_stats = recover(self.scheduler, journal)
+            self.scheduler.attach_journal(
+                journal, snapshot_every_batches=snapshot_every_batches
+            )
+        else:
+            self.recovery_stats = None
         # Wire deployments hand nominations back to the host (it owns the
         # victims' API deletes); the in-process inline commit would act on
         # them sidecar-side and desync the two views.

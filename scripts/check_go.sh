@@ -1,0 +1,19 @@
+#!/usr/bin/env sh
+# Compile-check the Go half (go/README.md): `go vet` + `go build` over
+# the out-of-tree plugin set and the scheduler binary.  The build image
+# has no Go toolchain, so the guard makes this a silent no-op there —
+# CI hosts that do carry one (and developers) get the real check.
+# Hooked into the test entrypoint via tests/test_go_build.py.
+set -eu
+
+if ! command -v go >/dev/null 2>&1; then
+    echo "check_go: no go toolchain on PATH; skipping (source-only image)"
+    exit 0
+fi
+
+cd "$(dirname "$0")/../go"
+echo "check_go: go vet ./..."
+go vet ./...
+echo "check_go: go build ./..."
+go build ./...
+echo "check_go: ok"

@@ -44,6 +44,38 @@ from kubernetes_tpu.sidecar import server as sidecar  # noqa: E402
 GOLDEN = os.path.join(os.path.dirname(__file__), "..", "tests", "golden")
 
 
+def write_atomic(path: str, data: bytes) -> None:
+    """Torn-write-safe fixture emission: temp file in the same directory
+    + os.replace, so an interrupted regeneration (^C, OOM-kill, a crash
+    mid-write) can never leave a half-written .framestream/.json that
+    poisons every later conformance run with byte-diff noise.  The
+    temp carries the pid so concurrent regens can't collide."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def write_atomic_json(path: str, obj) -> None:
+    write_atomic(path, json.dumps(obj, indent=1, sort_keys=True).encode())
+
+
+def write_atomic_frames(path: str, frames) -> None:
+    write_atomic(
+        path,
+        b"".join(
+            direction + struct.pack(">I", len(payload)) + payload
+            for direction, payload in frames
+        ),
+    )
+
+
 def session_schedulers() -> dict:
     """fixture stem → scheduler factory — the SINGLE source for both the
     recording side (main) and the replay side
@@ -519,10 +551,9 @@ def main():
         ),
         drive_basic,
     )
-    out = os.path.join(GOLDEN, "basic_session.framestream")
-    with open(out, "wb") as f:
-        for direction, payload in frames:
-            f.write(direction + struct.pack(">I", len(payload)) + payload)
+    write_atomic_frames(
+        os.path.join(GOLDEN, "basic_session.framestream"), frames
+    )
     # Human-readable summary next to the binary (review aid; not asserted).
     summary = {
         "frames": len(frames),
@@ -539,12 +570,12 @@ def main():
             {"pod": r.pod_uid, "node": r.node_name} for r in results2
         ],
     }
-    with open(os.path.join(GOLDEN, "basic_session.json"), "w") as f:
-        json.dump(summary, f, indent=1, sort_keys=True)
+    write_atomic_json(os.path.join(GOLDEN, "basic_session.json"), summary)
     # Canonical-JSON object fixtures for the Go converter test.
     nodes, bound, _pending = scenario_objects()
-    with open(os.path.join(GOLDEN, "golden_node.json"), "wb") as f:
-        f.write(serialize.to_json(nodes[0]))
+    write_atomic(
+        os.path.join(GOLDEN, "golden_node.json"), serialize.to_json(nodes[0])
+    )
     pod = (
         make_pod("golden", namespace="ns1")
         .req({"cpu": "1500m", "memory": "2Gi"})
@@ -562,8 +593,7 @@ def main():
         )
         .obj()
     )
-    with open(os.path.join(GOLDEN, "golden_pod.json"), "wb") as f:
-        f.write(serialize.to_json(pod))
+    write_atomic(os.path.join(GOLDEN, "golden_pod.json"), serialize.to_json(pod))
 
     # ---- full-surface default-profile session (VERDICT r3 weak-5) --------
     from kubernetes_tpu.framework.config import DEFAULT_PROFILE
@@ -576,9 +606,9 @@ def main():
         ),
         drive_default,
     )
-    with open(os.path.join(GOLDEN, "default_session.framestream"), "wb") as f:
-        for direction, payload in frames_d:
-            f.write(direction + struct.pack(">I", len(payload)) + payload)
+    write_atomic_frames(
+        os.path.join(GOLDEN, "default_session.framestream"), frames_d
+    )
     rows = lambda rs: [  # noqa: E731
         {
             "pod": r.pod_uid,
@@ -588,17 +618,16 @@ def main():
         }
         for r in rs
     ]
-    with open(os.path.join(GOLDEN, "default_session.json"), "w") as f:
-        json.dump(
-            {
-                "frames": len(frames_d),
-                "schedule_results": rows(res1),
-                "after_victim_deletes": rows(res2),
-                "after_updates": rows(res3),
-                "dump_keys": sorted(dump.keys()),
-            },
-            f, indent=1, sort_keys=True,
-        )
+    write_atomic_json(
+        os.path.join(GOLDEN, "default_session.json"),
+        {
+            "frames": len(frames_d),
+            "schedule_results": rows(res1),
+            "after_victim_deletes": rows(res2),
+            "after_updates": rows(res3),
+            "dump_keys": sorted(dump.keys()),
+        },
+    )
     # Canonical-JSON fixtures for EVERY wire kind (full convert surface;
     # the richest instance of each from the default scenario).
     nodes_d, bound_d, volume_objects, pending_d = default_scenario_objects()
@@ -618,31 +647,29 @@ def main():
         name = getattr(obj, "name", getattr(obj, "node_name", "obj"))
         fullest[f"golden_{kind.lower()}_{name.replace('/', '_')}.json"] = obj
     for fname, obj in fullest.items():
-        with open(os.path.join(GOLDEN, fname), "wb") as f:
-            f.write(serialize.to_json(obj))
+        write_atomic(os.path.join(GOLDEN, fname), serialize.to_json(obj))
 
     # ---- speculative session: subscribe/push/health/PendingPods ----------
     req_frames, push_frames, (r0, r1, r2, h1, h2, dump_s) = record_speculative()
-    with open(os.path.join(GOLDEN, "speculative_session.framestream"), "wb") as f:
-        for direction, payload in req_frames:
-            f.write(direction + struct.pack(">I", len(payload)) + payload)
-    with open(os.path.join(GOLDEN, "speculative_push.framestream"), "wb") as f:
-        for direction, payload in push_frames:
-            f.write(direction + struct.pack(">I", len(payload)) + payload)
-    with open(os.path.join(GOLDEN, "speculative_session.json"), "w") as f:
-        json.dump(
-            {
-                "request_frames": len(req_frames),
-                "push_frames": len(push_frames),
-                "miss_then_hit": [
-                    {"pod": r.pod_uid, "node": r.node_name}
-                    for r in (r0, r1, r2)
-                ],
-                "health": [h1, h2],
-                "speculation": dump_s.get("speculation"),
-            },
-            f, indent=1, sort_keys=True,
-        )
+    write_atomic_frames(
+        os.path.join(GOLDEN, "speculative_session.framestream"), req_frames
+    )
+    write_atomic_frames(
+        os.path.join(GOLDEN, "speculative_push.framestream"), push_frames
+    )
+    write_atomic_json(
+        os.path.join(GOLDEN, "speculative_session.json"),
+        {
+            "request_frames": len(req_frames),
+            "push_frames": len(push_frames),
+            "miss_then_hit": [
+                {"pod": r.pod_uid, "node": r.node_name}
+                for r in (r0, r1, r2)
+            ],
+            "health": [h1, h2],
+            "speculation": dump_s.get("speculation"),
+        },
+    )
     print(
         f"wrote {len(frames)} basic + {len(frames_d)} default-session + "
         f"{len(req_frames)}+{len(push_frames)} speculative-session frames "

@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Fault-matrix sweep: every wire fault × every frame kind, against the
-golden-transcript scenario, asserting BINDING DECISIONS ARE UNCHANGED.
+golden-transcript scenario, asserting BINDING DECISIONS ARE UNCHANGED —
+plus (``--kill``) the CRASH matrix: SIGKILL the host at every journal
+injection point and assert recovery lands bit-identical bindings.
 
 The claim under test is the north star's robustness clause: the two-tier
 host↔sidecar split must produce bit-identical binding decisions whether
@@ -22,11 +24,27 @@ tier-1 via tests/test_faults.py::test_fault_matrix_fast; this script
 sweeps the whole grid:
 
     JAX_PLATFORMS=cpu python scripts/run_fault_matrix.py
+
+The CRASH matrix (PR 3's host-kill analog of the wire grid) drives the
+same scenario in a CHILD process with the write-ahead journal armed and
+``TPU_JOURNAL_KILL=point:nth`` SIGKILLing it at one journal crash point
+(kubernetes_tpu/faults.py KillSwitch); the parent then runs a fresh
+recovery child — snapshot + fenced journal replay + LIST reconcile
+(informers.reconcile_after_recovery) + an idempotent re-run of the
+scenario tail — and asserts the final binding map is bit-identical to an
+uninterrupted run.  Host truth (the apiserver stand-in) is a durable
+tombstone file written ahead of every delete, mirroring the reference's
+ordering: the victim's API DELETE commits in etcd BEFORE the scheduler's
+local state moves.
+
+    JAX_PLATFORMS=cpu python scripts/run_fault_matrix.py --kill
 """
 
 from __future__ import annotations
 
+import json
 import os
+import subprocess
 import sys
 import tempfile
 
@@ -37,6 +55,19 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 FAULT_KINDS = ("hang", "crash", "partial_write", "slow")
 FRAME_KINDS = ("add", "remove", "schedule")
+
+# The crash grid: every journal injection point, probed both early (the
+# first commit of the session) and late (after state has accumulated —
+# snapshots have run, the log has truncated).  torn-append leaves half a
+# record's bytes on disk; mid-snapshot a torn checkpoint temp;
+# mid-truncate a replaced snapshot with the log still full.
+KILL_CASES = (
+    ("pre-append", 1), ("pre-append", 3),
+    ("post-append", 1), ("post-append", 2),
+    ("torn-append", 1), ("torn-append", 2),
+    ("mid-snapshot", 1), ("mid-snapshot", 2),
+    ("mid-truncate", 1), ("mid-truncate", 2),
+)
 
 # Per-call deadline for the sweep: small enough that a hang case costs
 # ~deadline per retry, large enough that a CPU-backend device pass (with
@@ -131,7 +162,223 @@ def run_matrix(cases=None, verbose=True) -> list[str]:
     return failures
 
 
+# -- the crash (host-kill) matrix ------------------------------------------
+
+
+def _truth_deleted_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "truth.deleted")
+
+
+def _truth_delete(state_dir: str, uid: str) -> None:
+    """Durably tombstone a pod in host truth BEFORE the scheduler's local
+    state changes — the apiserver-commit ordering the reference gets from
+    prepareCandidate's API DELETE landing in etcd first."""
+    with open(_truth_deleted_path(state_dir), "a") as f:
+        f.write(uid + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _truth_deleted(state_dir: str) -> set:
+    try:
+        with open(_truth_deleted_path(state_dir)) as f:
+            return {line.strip() for line in f if line.strip()}
+    except OSError:
+        return set()
+
+
+def _journaled_scheduler(state_dir: str):
+    """(scheduler, journal): the golden basic-session scheduler with the
+    write-ahead journal armed under the journal lease's fencing epoch,
+    and delete_pod interposed to tombstone host truth first."""
+    from gen_golden_transcripts import session_schedulers
+
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+
+    sched = session_schedulers()["basic_session"]()
+    lease_path = os.path.join(state_dir, "lease")
+    lease = FileLease(lease_path, identity=f"kill-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        state_dir, epoch=lease.epoch, fence=lambda: read_epoch(lease_path)
+    )
+    orig_delete = sched.delete_pod
+
+    def delete_pod(uid: str, notify: bool = True) -> None:
+        _truth_delete(state_dir, uid)
+        orig_delete(uid, notify)
+
+    sched.delete_pod = delete_pod
+    return sched, journal
+
+
+def _run_scenario_tail(sched) -> dict:
+    """The scenario's scheduling steps — idempotent, so the recovery
+    child re-runs them verbatim: already-committed pods are answered
+    from the cache, the delete of an already-deleted pod is a no-op."""
+    from gen_golden_transcripts import wait_for_backoffs
+
+    sched.schedule_all_pending(wait_backoff=True)
+    sched.delete_pod("default/bound-2")
+    wait_for_backoffs(sched.queue)
+    sched.schedule_all_pending(wait_backoff=True)
+    return {
+        uid: pr.node_name
+        for uid, pr in sched.cache.pods.items()
+        if pr.bound
+    }
+
+
+def kill_child(state_dir: str) -> None:
+    """The victim: run the scenario with journaling armed (snapshot every
+    batch, so every injection point gets live windows).  When
+    TPU_JOURNAL_KILL is set the process SIGKILLs itself mid-commit;
+    otherwise it writes the final binding map."""
+    from gen_golden_transcripts import scenario_objects
+
+    from kubernetes_tpu.faults import KillSwitch
+
+    sched, journal = _journaled_scheduler(state_dir)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in bound:
+        sched.add_pod(p)
+    for p in pending:
+        sched.add_pod(p)
+    bindings = _run_scenario_tail(sched)
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def recover_child(state_dir: str) -> None:
+    """The successor: fresh scheduler, recover from snapshot + fenced
+    journal replay, reconcile against the host-truth LIST (original
+    objects minus durable tombstones), then re-run the scenario tail
+    idempotently and write the final binding map."""
+    import copy
+
+    from gen_golden_transcripts import scenario_objects
+
+    from kubernetes_tpu.informers import FakeSource, Reflector, reconcile_after_recovery
+    from kubernetes_tpu.journal import recover
+
+    sched, journal = _journaled_scheduler(state_dir)
+    recover(sched, journal)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    nodes, bound, pending = scenario_objects()
+    deleted = _truth_deleted(state_dir)
+    src_n, src_p = FakeSource(), FakeSource()
+    for n in nodes:
+        src_n.add(n.name, copy.deepcopy(n))
+    for p in bound + pending:
+        if p.uid not in deleted:
+            src_p.add(p.uid, copy.deepcopy(p))
+    reconcile_after_recovery(
+        sched,
+        Reflector(sched, "Node", src_n.lister, src_n.watcher),
+        Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+    )
+    bindings = _run_scenario_tail(sched)
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def _spawn(mode: str, state_dir: str, kill: str | None = None) -> int:
+    env = dict(os.environ)
+    env.pop("TPU_JOURNAL_KILL", None)
+    if kill:
+        env["TPU_JOURNAL_KILL"] = kill
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), mode, state_dir],
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode not in (0, -9):
+        sys.stderr.write(proc.stdout + proc.stderr)
+    return proc.returncode
+
+
+def _read_bindings(state_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(state_dir, "bindings.json")) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
+    """SIGKILL the scenario at each journal crash point, recover, and
+    compare final bindings to an uninterrupted run.  Returns the labels
+    that diverged (empty == crash matrix green)."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "baseline kill-child run failed"
+        failures = []
+        for point, nth in cases:
+            label = f"kill:{point}@{nth}"
+            state_dir = os.path.join(td, f"{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn("--kill-child", state_dir, kill=f"{point}:{nth}")
+            if rc == 0:
+                # The armed point's Nth hit never arrived (an honest
+                # cell, like the wire grid's "fault never matched") —
+                # but the run must still agree with the baseline.
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}")
+            elif verbose:
+                print(f"ok   {label}: recovered bit-identical bindings")
+        return failures
+
+
 def main() -> int:
+    if "--kill-child" in sys.argv:
+        kill_child(sys.argv[sys.argv.index("--kill-child") + 1])
+        return 0
+    if "--recover-child" in sys.argv:
+        recover_child(sys.argv[sys.argv.index("--recover-child") + 1])
+        return 0
+    if "--kill" in sys.argv:
+        failures = run_kill_matrix()
+        if failures:
+            print(f"{len(failures)} of {len(KILL_CASES)} kill cases diverged: {failures}")
+            return 1
+        print(
+            f"all {len(KILL_CASES)} crash-matrix cases recovered to "
+            "bit-identical bindings"
+        )
+        return 0
     # The full grid also sweeps nth=2 (the fault lands mid-session, after
     # state has accumulated — for schedule, the post-delete drain) — both
     # phases must hold.  The scenario carries a single remove frame, so

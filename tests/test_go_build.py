@@ -1,0 +1,29 @@
+"""CI hook for the Go half: go vet + go build over go/ (VERDICT r5 noted
+main.go had never been compiled).  The check lives in
+scripts/check_go.sh behind a `command -v go` guard; here it rides the
+tier-1 entrypoint — skipped (not silently passed) when the image carries
+no Go toolchain, so a host with one gets the real compile."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_go.sh")
+
+
+def test_check_go_script_exists_and_is_executable():
+    assert os.path.exists(SCRIPT)
+    assert os.access(SCRIPT, os.X_OK), "scripts/check_go.sh must be +x"
+
+
+@pytest.mark.skipif(
+    shutil.which("go") is None, reason="no Go toolchain in this image"
+)
+def test_go_vet_and_build():
+    proc = subprocess.run(
+        ["sh", SCRIPT], capture_output=True, text=True, timeout=600
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -10,7 +10,7 @@ import subprocess
 import sys
 import time
 
-from kubernetes_tpu.framework.leaderelection import FileLease
+from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
 
 
 def test_exclusive_acquire_and_handoff(tmp_path):
@@ -90,6 +90,50 @@ time.sleep(60)
         if child.poll() is None:
             child.kill()
             child.wait()
+
+
+def test_epoch_monotonic_across_transitions(tmp_path):
+    """The fencing epoch (leaseTransitions analog) strictly increases
+    across every kind of handoff — clean release, crash (record lingers),
+    re-acquire — so a journal record's epoch totally orders tenures."""
+    path = str(tmp_path / "lease")
+    a = FileLease(path, identity="a")
+    assert a.acquire(block=False)
+    assert a.epoch == 1
+    a.release()
+    # Clean release keeps the epoch in the file (resetting it would let a
+    # successor reuse a deposed leader's fencing token).
+    assert read_epoch(path) == 1
+    b = FileLease(path, identity="b")
+    assert b.acquire(block=False)
+    assert b.epoch == 2
+    # Crash: the flock dies with the process but the record lingers — the
+    # next acquire reads it and continues the sequence.
+    os.close(b._fd)
+    b._fd = None
+    c = FileLease(path, identity="c")
+    assert c.acquire(block=False)
+    assert c.epoch == 3
+    assert read_epoch(path) == 3
+    c.release()
+    # Same object re-acquiring gets a fresh tenure, not its old epoch.
+    assert c.acquire(block=False)
+    assert c.epoch == 4
+    c.release()
+
+
+def test_epoch_survives_garbage_record(tmp_path):
+    """An unreadable record restarts the epoch sequence at 1 rather than
+    crashing the acquire (availability over a perfect counter — the
+    journal's replay-side fence still orders records within the file)."""
+    path = str(tmp_path / "lease")
+    with open(path, "w") as f:
+        f.write("not-json")
+    assert read_epoch(path) == 0
+    lease = FileLease(path, identity="x")
+    assert lease.acquire(block=False)
+    assert lease.epoch == 1
+    lease.release()
 
 
 def test_holder_record_tolerates_garbage(tmp_path):
