@@ -55,6 +55,29 @@ def _journal_guard(value: float) -> dict | None:
     return guard
 
 
+def _lint_clean() -> bool | None:
+    """Zero unsuppressed tpulint findings (scripts/check_lint.py --json)?
+    Rides the bench payload so a recorded trajectory point also certifies
+    the invariants (WAL ordering, kernel determinism, metrics hygiene,
+    wire exhaustiveness) held when the number was taken.  None when the
+    check itself could not run."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(__file__), "scripts", "check_lint.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--json"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        return bool(json.loads(proc.stdout)["clean"])
+    except Exception:
+        return None
+
+
 def main() -> int:
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
 
@@ -86,6 +109,7 @@ def main() -> int:
                 "unit": "pods/s",
                 "vs_baseline": r["vs_baseline"],
                 "journal_guard": guard,
+                "lint_clean": _lint_clean(),
                 "detail": {
                     "scheduled": r["scheduled"],
                     "seconds": r["seconds"],

@@ -1,0 +1,14 @@
+// sidecarlint is the vet driver for the repo's custom Go analyzers
+// (currently sidecardeadline).  Built and run by scripts/check_go.sh:
+//
+//	go build -o sidecarlint ./cmd/sidecarlint     # in go/analyzers
+//	go vet -vettool=./sidecarlint ./tpubatchscore # in go/
+package main
+
+import (
+	"golang.org/x/tools/go/analysis/singlechecker"
+
+	"tpu-scheduler/analyzers/sidecardeadline"
+)
+
+func main() { singlechecker.Main(sidecardeadline.Analyzer) }

@@ -1,0 +1,151 @@
+// Package sidecardeadline enforces the wire-client failure-model
+// invariants on the tpubatchscore package: every sidecar round trip
+// (a WriteFrame/ReadFrame call on a net.Conn) runs under a deadline,
+// and no frame I/O error is discarded.
+//
+// The contract it machine-checks is the one client.go documents by
+// hand: a hung sidecar must surface as an i/o timeout in bounded time
+// (SetDeadline before the frame exchange — callLocked), and transport
+// errors must reach the breaker/degrade logic, never a blank
+// identifier.  wire.go itself is exempt: its WriteFrame/ReadFrame are
+// the framing primitives over io.Writer/io.Reader and cannot set
+// deadlines — the obligation sits with every caller that owns the
+// connection.  Error use is judged structurally: a frame call whose
+// result is provably discarded (a bare expression statement, or an
+// assignment binding only blank identifiers) is flagged; anything that
+// binds or forwards the error passes.
+//
+// A deliberate exception is annotated
+//
+//	//sidecarlint:nodeadline <reason>
+//
+// in the function's doc comment (none exist today).
+package sidecardeadline
+
+import (
+	"go/ast"
+	"path/filepath"
+	"strings"
+
+	"golang.org/x/tools/go/analysis"
+)
+
+// Analyzer is the vet-compatible entry point (go vet -vettool).
+var Analyzer = &analysis.Analyzer{
+	Name: "sidecardeadline",
+	Doc:  "sidecar round trips must set a deadline and check frame I/O errors (WriteFrame/ReadFrame callers outside wire.go)",
+	Run:  run,
+}
+
+var frameFuncs = map[string]bool{"WriteFrame": true, "ReadFrame": true}
+
+var deadlineFuncs = map[string]bool{
+	"SetDeadline":      true,
+	"SetReadDeadline":  true,
+	"SetWriteDeadline": true,
+}
+
+func run(pass *analysis.Pass) (interface{}, error) {
+	for _, file := range pass.Files {
+		name := filepath.Base(pass.Fset.File(file.Pos()).Name())
+		if name == "wire.go" || strings.HasSuffix(name, "_test.go") {
+			// wire.go defines the primitives over io.Writer/io.Reader;
+			// tests exercise codecs on in-memory buffers.
+			continue
+		}
+		for _, decl := range file.Decls {
+			fn, ok := decl.(*ast.FuncDecl)
+			if !ok || fn.Body == nil || allowed(fn) {
+				continue
+			}
+			checkFunc(pass, fn)
+		}
+	}
+	return nil, nil
+}
+
+func allowed(fn *ast.FuncDecl) bool {
+	if fn.Doc == nil {
+		return false
+	}
+	for _, c := range fn.Doc.List {
+		if strings.Contains(c.Text, "//sidecarlint:nodeadline") {
+			return true
+		}
+	}
+	return false
+}
+
+// checkFunc flags (a) frame calls whose error result is provably
+// discarded and (b) functions doing frame I/O with no deadline call in
+// scope.
+func checkFunc(pass *analysis.Pass, fn *ast.FuncDecl) {
+	var frameCalls []*ast.CallExpr
+	var discarded []*ast.CallExpr
+	hasDeadline := false
+
+	ast.Inspect(fn.Body, func(n ast.Node) bool {
+		switch node := n.(type) {
+		case *ast.CallExpr:
+			if isDeadlineCall(node) {
+				hasDeadline = true
+			}
+			if isFrameCall(node) {
+				frameCalls = append(frameCalls, node)
+			}
+		case *ast.ExprStmt:
+			// WriteFrame(conn, env) as a bare statement: error dropped.
+			if call, ok := node.X.(*ast.CallExpr); ok && isFrameCall(call) {
+				discarded = append(discarded, call)
+			}
+		case *ast.AssignStmt:
+			// _ = WriteFrame(...) / _, _ = ReadFrame(...): only blank
+			// identifiers bound — error dropped.  A single non-blank
+			// binding keeps the error reachable and passes.
+			if len(node.Rhs) != 1 {
+				return true
+			}
+			call, ok := node.Rhs[0].(*ast.CallExpr)
+			if !ok || !isFrameCall(call) {
+				return true
+			}
+			for _, lhs := range node.Lhs {
+				if id, ok := lhs.(*ast.Ident); !ok || id.Name != "_" {
+					return true
+				}
+			}
+			discarded = append(discarded, call)
+		}
+		return true
+	})
+
+	if len(frameCalls) == 0 {
+		return
+	}
+	if !hasDeadline {
+		pass.Reportf(frameCalls[0].Pos(),
+			"%s performs sidecar frame I/O without setting a connection "+
+				"deadline (SetDeadline/SetReadDeadline) — a hung sidecar "+
+				"blocks this path forever", fn.Name.Name)
+	}
+	for _, call := range discarded {
+		pass.Reportf(call.Pos(),
+			"frame I/O error discarded in %s — transport failures must "+
+				"reach the breaker/degrade path", fn.Name.Name)
+	}
+}
+
+func isFrameCall(call *ast.CallExpr) bool {
+	switch fn := call.Fun.(type) {
+	case *ast.Ident:
+		return frameFuncs[fn.Name]
+	case *ast.SelectorExpr:
+		return frameFuncs[fn.Sel.Name]
+	}
+	return false
+}
+
+func isDeadlineCall(call *ast.CallExpr) bool {
+	sel, ok := call.Fun.(*ast.SelectorExpr)
+	return ok && deadlineFuncs[sel.Sel.Name]
+}

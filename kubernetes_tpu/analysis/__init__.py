@@ -1,0 +1,34 @@
+"""tpulint — AST-based invariant checker for this repository.
+
+Four rule families turn hand-maintained conventions into machine-checked
+invariants (see each module's docstring for the full contract):
+
+- ``wal``     — journal-before-apply ordering in the commit paths
+                (:mod:`.rules_wal`);
+- ``det``     — wall-clock/entropy/set-order purity of the scoring
+                kernels (:mod:`.rules_determinism`);
+- ``metrics`` — namespace prefix, single registration, consistent label
+                schema per family (:mod:`.rules_metrics`);
+- ``wire``    — proto ↔ server handler ↔ client method exhaustiveness
+                (:mod:`.rules_wire`).
+
+Run via ``scripts/check_lint.py`` (tier-1 hooks it through
+``tests/test_static_analysis.py``, the same pattern as
+``scripts/check_go.sh`` / ``tests/test_go_build.py``).  Suppress a
+deliberate exception inline with ``# tpulint: disable=<rule>`` plus a
+reason in the surrounding comment; grandfather a finding only through
+``tpulint_baseline.json`` with a written justification.
+
+This package imports nothing outside the stdlib, so the runner can load
+it standalone (without the JAX-importing package root).
+"""
+
+from .core import (  # noqa: F401
+    BaselineError,
+    Finding,
+    LintResult,
+    Rule,
+    default_rules,
+    load_baseline,
+    run_lint,
+)

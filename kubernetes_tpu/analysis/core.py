@@ -1,0 +1,298 @@
+"""tpulint core: the AST-walking invariant engine.
+
+The system's headline guarantee — bit-identical binding decisions across
+wire/degraded/crash-recovery paths — rests on conventions that nothing
+used to machine-check between PRs: journal-before-apply ordering in the
+commit paths (journal.py), pure-deterministic scoring kernels (ops/,
+engine/), one coherent metrics namespace (framework/metrics.py), and a
+wire protocol whose every frame kind has a live handler and client
+method.  Each convention is a :class:`Rule` here; ``run_lint`` walks the
+rule's scoped files once, hands shared parse trees to every rule, and
+applies the suppression + baseline filters.
+
+Vocabulary:
+
+- **Finding** — one violation: rule id, repo-relative path, line,
+  message, and a line-independent ``key`` used for baseline matching
+  (line numbers churn; keys survive refactors that keep the symbol).
+- **Suppression** — ``# tpulint: disable=<rule>[,<rule>...]`` on the
+  finding's line (or alone on the line above it) silences it; a rule
+  FAMILY name (``wal``, ``det``, ``metrics``, ``wire``) silences the
+  whole family; ``all`` silences everything on that line.  A
+  ``# tpulint: disable-file=<rule>`` comment within the first five
+  lines silences a file.
+- **Baseline** — a committed JSON file of grandfathered finding keys.
+  Every entry MUST carry a non-empty written ``justification``; the
+  runner refuses a baseline that merely lists keys (grandfathering
+  without a reason is how invariants rot).
+
+The engine is dependency-free stdlib (``ast`` + ``re`` + ``json``) so
+``scripts/check_lint.py`` can load it without importing the package
+root (which pulls JAX).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str  # stable baseline key: "<rule>::<path>::<token>"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def make_key(rule: str, path: str, token: str) -> str:
+    return f"{rule}::{path}::{token}"
+
+
+@dataclass
+class FileCtx:
+    """One parsed source file shared by every rule that scopes it."""
+
+    path: str  # repo-relative
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+class Rule:
+    """One rule family.  ``name`` is the family prefix (``wal``, ``det``,
+    ``metrics``, ``wire``); individual findings carry ids like
+    ``wal-apply-before-journal``."""
+
+    name = "rule"
+
+    def files(self, root) -> list[str]:
+        """Repo-relative paths this rule wants parsed (existing only)."""
+        raise NotImplementedError
+
+    def run(self, ctxs: dict[str, FileCtx], root) -> list[Finding]:
+        raise NotImplementedError
+
+
+# -- suppressions -----------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([\w\-,]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*tpulint:\s*disable-file=([\w\-,]+)")
+
+
+def _rules_match(names: str, rule: str) -> bool:
+    family = rule.split("-", 1)[0]
+    for name in names.split(","):
+        name = name.strip()
+        if name in ("all", rule, family):
+            return True
+    return False
+
+
+def is_suppressed(finding: Finding, ctx: FileCtx | None) -> bool:
+    if ctx is None:
+        return False
+    # File-level pragma in the header.
+    for line in ctx.lines[:5]:
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m and _rules_match(m.group(1), finding.rule):
+            return True
+    # Same line, or a standalone comment on the line above.
+    for lineno in (finding.line, finding.line - 1):
+        if 1 <= lineno <= len(ctx.lines):
+            text = ctx.lines[lineno - 1]
+            if lineno != finding.line and not text.lstrip().startswith("#"):
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m and _rules_match(m.group(1), finding.rule):
+                return True
+    return False
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """The baseline file is malformed or carries an unjustified entry."""
+
+
+def load_baseline(path) -> dict[str, dict]:
+    """key → entry.  Raises BaselineError for entries without a written
+    justification — the baseline records *why* a finding is tolerated,
+    not just that it is."""
+    import os
+
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except ValueError as exc:
+            raise BaselineError(f"unparseable baseline {path}: {exc}")
+    entries = doc.get("findings", []) if isinstance(doc, dict) else doc
+    out: dict[str, dict] = {}
+    for entry in entries:
+        key = entry.get("key")
+        just = (entry.get("justification") or "").strip()
+        if not key:
+            raise BaselineError(f"baseline entry missing 'key': {entry}")
+        if not just:
+            raise BaselineError(
+                f"baseline entry for {key!r} has no justification — "
+                "grandfathered findings must say why"
+            )
+        out[key] = entry
+    return out
+
+
+# -- the runner -------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]  # unsuppressed, un-baselined — the failures
+    suppressed: int
+    baselined: int
+    stale_baseline: list[str]  # baseline keys no rule produced
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "stale_baseline": self.stale_baseline,
+            "clean": self.clean,
+        }
+
+
+def default_rules() -> list[Rule]:
+    from .rules_determinism import DeterminismRule
+    from .rules_metrics import MetricsRule
+    from .rules_wal import WalRule
+    from .rules_wire import WireRule
+
+    return [WalRule(), DeterminismRule(), MetricsRule(), WireRule()]
+
+
+def run_lint(root, rules=None, baseline=None) -> LintResult:
+    """Run ``rules`` (default: all four families) over the tree at
+    ``root``.  ``baseline`` is a key → entry dict (see load_baseline)."""
+    import os
+
+    rules = default_rules() if rules is None else rules
+    baseline = baseline or {}
+    ctxs: dict[str, FileCtx] = {}
+    findings: list[Finding] = []
+    for rule in rules:
+        scoped: dict[str, FileCtx] = {}
+        for rel in rule.files(root):
+            if rel not in ctxs:
+                full = os.path.join(root, rel)
+                if not os.path.exists(full):
+                    continue
+                with open(full, "r", encoding="utf-8") as f:
+                    src = f.read()
+                if rel.endswith(".py"):
+                    try:
+                        tree = ast.parse(src, filename=rel)
+                    except SyntaxError as exc:
+                        findings.append(
+                            Finding(
+                                rule="parse-error",
+                                path=rel,
+                                line=exc.lineno or 1,
+                                message=f"unparseable: {exc.msg}",
+                                key=make_key("parse-error", rel, "syntax"),
+                            )
+                        )
+                        continue
+                else:
+                    tree = ast.Module(body=[], type_ignores=[])
+                ctxs[rel] = FileCtx(path=rel, source=src, tree=tree)
+            if rel in ctxs:
+                scoped[rel] = ctxs[rel]
+        findings.extend(rule.run(scoped, root))
+
+    kept: list[Finding] = []
+    suppressed = 0
+    baselined = 0
+    seen_keys: set[str] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        seen_keys.add(f.key)
+        if is_suppressed(f, ctxs.get(f.path)):
+            suppressed += 1
+            continue
+        if f.key in baseline:
+            baselined += 1
+            continue
+        kept.append(f)
+    stale = sorted(k for k in baseline if k not in seen_keys)
+    return LintResult(
+        findings=kept,
+        suppressed=suppressed,
+        baselined=baselined,
+        stale_baseline=stale,
+    )
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'self.journal.append' for nested Attribute chains, None when the
+    chain bottoms out in anything but a Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.Module):
+    """Yield (qualname, FunctionDef) for every function/method, including
+    nested ones (qualname joins with '.')."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")

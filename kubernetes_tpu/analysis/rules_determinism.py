@@ -1,0 +1,164 @@
+"""Determinism: the scoring kernels must be bit-identical, run to run.
+
+The parity story (wire == degraded == crash-recovery bindings, the A/B
+oracle, the golden transcripts, PYTHONHASHSEED-proof push fixtures) all
+assume the batch engine is a pure function of its inputs.  Code in
+``ops/``, ``engine/`` and the speculative frontend therefore must not:
+
+- read wall clocks (``time.time``/``time_ns``, ``datetime.now``/
+  ``utcnow``) — ``time.perf_counter``/``monotonic`` stay allowed: they
+  feed latency metrics, never decisions;
+- draw entropy (``random.*``, ``os.urandom``, ``uuid.uuid4``);
+- iterate a bare set where the element order can reach an output —
+  syntactically visible set expressions (literals, comprehensions,
+  ``set()``/``frozenset()`` calls, unions of those) used directly as a
+  ``for``/comprehension iterable or materialized via ``list()``/
+  ``tuple()``.  ``sorted(...)`` over a set is the fix and is exempt.
+  (Named variables of set type are invisible to a syntactic pass; the
+  speculative frontend's documented commit-order iteration is exactly
+  the idiom this rule pushes toward.)
+- key on ``id()`` — CPython address order varies per process.
+
+Findings: ``det-wallclock``, ``det-random``, ``det-set-iteration``,
+``det-id-key``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, Rule, dotted_name, make_key
+
+WALLCLOCK = {
+    "time.time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+ENTROPY_MODULES = {"random"}
+ENTROPY_CALLS = {"os.urandom", "uuid.uuid4", "uuid.uuid1", "secrets.token_bytes"}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+class DeterminismRule(Rule):
+    name = "det"
+
+    def files(self, root) -> list[str]:
+        rels = ["kubernetes_tpu/sidecar/speculate.py"]
+        for sub in ("ops", "engine"):
+            top = os.path.join(root, "kubernetes_tpu", sub)
+            # Recursive: a future subpackage under ops/ or engine/ must not
+            # silently escape the determinism contract.
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        rels.append(
+                            os.path.relpath(
+                                os.path.join(dirpath, name), root
+                            ).replace(os.sep, "/")
+                        )
+        return rels
+
+    def run(self, ctxs, root) -> list[Finding]:
+        out: list[Finding] = []
+        for path, ctx in ctxs.items():
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    out.extend(self._check_call(path, node))
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    out.extend(self._check_iter(path, node.iter))
+                elif isinstance(
+                    node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+                ):
+                    for gen in node.generators:
+                        out.extend(self._check_iter(path, gen.iter))
+        return out
+
+    def _check_call(self, path: str, call: ast.Call) -> list[Finding]:
+        out: list[Finding] = []
+        name = dotted_name(call.func)
+        if name in WALLCLOCK:
+            out.append(
+                Finding(
+                    rule="det-wallclock",
+                    path=path,
+                    line=call.lineno,
+                    message=(
+                        f"{name}() in a determinism-critical module — "
+                        "wall-clock reads vary run to run; use "
+                        "time.perf_counter for latency metrics and keep "
+                        "clocks out of decisions"
+                    ),
+                    key=make_key("det-wallclock", path, f"{name}:{call.lineno}"),
+                )
+            )
+        if name is not None:
+            head = name.split(".")[0]
+            if head in ENTROPY_MODULES or name in ENTROPY_CALLS:
+                out.append(
+                    Finding(
+                        rule="det-random",
+                        path=path,
+                        line=call.lineno,
+                        message=(
+                            f"{name}() draws entropy in a determinism-"
+                            "critical module — decisions must be a pure "
+                            "function of cluster state"
+                        ),
+                        key=make_key("det-random", path, f"{name}:{call.lineno}"),
+                    )
+                )
+        if isinstance(call.func, ast.Name):
+            if call.func.id == "id" and len(call.args) == 1:
+                out.append(
+                    Finding(
+                        rule="det-id-key",
+                        path=path,
+                        line=call.lineno,
+                        message=(
+                            "builtin id() in a determinism-critical module "
+                            "— CPython addresses vary per process; key on "
+                            "a stable identity (uid/name) instead"
+                        ),
+                        key=make_key("det-id-key", path, f"id:{call.lineno}"),
+                    )
+                )
+            if call.func.id in ("list", "tuple") and call.args:
+                if _is_set_expr(call.args[0]):
+                    out.append(self._set_finding(path, call.lineno, "materialized"))
+        return out
+
+    def _check_iter(self, path: str, it: ast.AST) -> list[Finding]:
+        if _is_set_expr(it):
+            return [self._set_finding(path, it.lineno, "iterated")]
+        return []
+
+    def _set_finding(self, path: str, line: int, verb: str) -> Finding:
+        return Finding(
+            rule="det-set-iteration",
+            path=path,
+            line=line,
+            message=(
+                f"bare set {verb} in order-sensitive position — set "
+                "iteration order is hash-randomized (PYTHONHASHSEED); "
+                "wrap in sorted(...) or iterate an ordered container"
+            ),
+            key=make_key("det-set-iteration", path, f"set:{line}"),
+        )

@@ -1,0 +1,207 @@
+"""Metrics hygiene: one namespace, one registration, one label schema.
+
+Families constructed against ``framework/metrics.py`` (``reg.counter``/
+``reg.gauge`` get-or-create calls, direct ``Counter``/``Gauge``
+constructions, and the histogram exposition names fed to
+``_render_histogram``) must:
+
+- ``metrics-prefix`` — carry a ``scheduler_`` or ``sidecar_`` prefix, so
+  the joint host+sidecar scrape stays navigable and collision-free (the
+  component-base convention of a per-component subsystem prefix);
+- ``metrics-duplicate`` — be constructed at exactly one source site per
+  name: two sites registering one name either alias each other's cells
+  through the get-or-create path (divergent help strings, silent) or
+  fork disjoint families in different registries under one name
+  (dashboards double-count);
+- ``metrics-labels`` — use one label-key set per name across every
+  ``.inc()``/``.set()`` call site: Prometheus treats each label-key
+  combination as a separate series, so an inconsistent writer splits one
+  logical series into unjoinable halves.
+
+The tracker resolves handles through simple assignments (``x =
+reg.counter(...)``, ``self._c = reg.counter(...)``, including
+conditional expressions) within a file; cross-file handle passing is out
+of scope for a syntactic pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, Rule, make_key, str_const
+
+PREFIXES = ("scheduler_", "sidecar_")
+CONSTRUCTORS = {"counter": "Counter", "gauge": "Gauge"}
+DIRECT_CLASSES = {"Counter", "Gauge", "Histogram"}
+
+
+def _find_metric_call(expr: ast.AST):
+    """(kind, name, node) for the first counter/gauge construction inside
+    ``expr`` (descends through IfExp/BoolOp wrappers), else None."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in CONSTRUCTORS:
+            name = str_const(node.args[0]) if node.args else None
+            if name is not None:
+                return CONSTRUCTORS[fn.attr], name, node
+        if isinstance(fn, ast.Name) and fn.id in DIRECT_CLASSES:
+            name = str_const(node.args[0]) if node.args else None
+            if name is not None:
+                return fn.id, name, node
+    return None
+
+
+class MetricsRule(Rule):
+    name = "metrics"
+
+    def files(self, root) -> list[str]:
+        rels: list[str] = []
+        pkg = os.path.join(root, "kubernetes_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", "analysis")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    rels.append(
+                        os.path.relpath(os.path.join(dirpath, name), root)
+                        .replace(os.sep, "/")
+                    )
+        return rels
+
+    def run(self, ctxs, root) -> list[Finding]:
+        out: list[Finding] = []
+        # name → [(path, line)]
+        sites: dict[str, list[tuple[str, int]]] = {}
+        # name → {frozenset(label keys) → (path, line)}
+        labels: dict[str, dict[frozenset, tuple[str, int]]] = {}
+
+        for path, ctx in sorted(ctxs.items()):
+            handles: dict[str, str] = {}  # symbol → metric name
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    hit = _find_metric_call(node) if self._is_site(node) else None
+                    if hit is not None:
+                        _kind, name, call = hit
+                        sites.setdefault(name, []).append((path, call.lineno))
+                        if not name.startswith(PREFIXES):
+                            out.append(
+                                Finding(
+                                    rule="metrics-prefix",
+                                    path=path,
+                                    line=call.lineno,
+                                    message=(
+                                        f"metric family {name!r} lacks the "
+                                        "scheduler_/sidecar_ namespace "
+                                        "prefix"
+                                    ),
+                                    key=make_key("metrics-prefix", path, name),
+                                )
+                            )
+                    # Histogram exposition names.
+                    if (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "_render_histogram"
+                        and len(node.args) >= 2
+                    ):
+                        name = str_const(node.args[1])
+                        if name is not None and not name.startswith(PREFIXES):
+                            out.append(
+                                Finding(
+                                    rule="metrics-prefix",
+                                    path=path,
+                                    line=node.lineno,
+                                    message=(
+                                        f"histogram family {name!r} lacks "
+                                        "the scheduler_/sidecar_ namespace "
+                                        "prefix"
+                                    ),
+                                    key=make_key("metrics-prefix", path, name),
+                                )
+                            )
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    hit = _find_metric_call(node.value)
+                    if hit is not None:
+                        sym = self._symbol(node.targets[0])
+                        if sym is not None:
+                            handles[sym] = hit[1]
+
+            # Label-key consistency over resolved handles.
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (
+                    isinstance(fn, ast.Attribute) and fn.attr in ("inc", "set")
+                ):
+                    continue
+                sym = self._symbol(fn.value)
+                if sym is None or sym not in handles:
+                    continue
+                name = handles[sym]
+                keyset = frozenset(
+                    kw.arg for kw in node.keywords if kw.arg is not None
+                )
+                prev = labels.setdefault(name, {})
+                if keyset not in prev:
+                    prev[keyset] = (path, node.lineno)
+
+        for name, sitelist in sorted(sites.items()):
+            if len(sitelist) > 1:
+                first = sitelist[0]
+                for path, line in sitelist[1:]:
+                    out.append(
+                        Finding(
+                            rule="metrics-duplicate",
+                            path=path,
+                            line=line,
+                            message=(
+                                f"metric family {name!r} is also "
+                                f"constructed at {first[0]}:{first[1]} — "
+                                "register each family exactly once"
+                            ),
+                            key=make_key("metrics-duplicate", path, name),
+                        )
+                    )
+        for name, keysets in sorted(labels.items()):
+            if len(keysets) > 1:
+                rendered = sorted(
+                    "{" + ",".join(sorted(ks)) + "}" for ks in keysets
+                )
+                path, line = sorted(keysets.values())[0]
+                out.append(
+                    Finding(
+                        rule="metrics-labels",
+                        path=path,
+                        line=line,
+                        message=(
+                            f"metric family {name!r} is written with "
+                            f"inconsistent label sets {rendered} — one "
+                            "label schema per name, or the series forks"
+                        ),
+                        key=make_key("metrics-labels", path, name),
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _is_site(node: ast.Call) -> bool:
+        """True when this very call constructs a family (not merely
+        contains one in an argument)."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in CONSTRUCTORS:
+            return bool(node.args) and str_const(node.args[0]) is not None
+        if isinstance(fn, ast.Name) and fn.id in DIRECT_CLASSES:
+            return bool(node.args) and str_const(node.args[0]) is not None
+        return False
+
+    @staticmethod
+    def _symbol(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            return f"{node.value.id}.{node.attr}"
+        return None

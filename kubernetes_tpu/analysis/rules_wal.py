@@ -1,0 +1,137 @@
+"""WAL discipline: journal-before-apply in the commit paths.
+
+The write-ahead contract (journal.py): every bind/preempt/quarantine/
+delete decision is appended — and fsync'd — BEFORE it is applied to live
+state, so a crash landing anywhere after the append replays the
+decision instead of forgetting it.  The commit paths in ``scheduler.py``
+and ``queue.py`` maintain that ordering by hand; this rule machine-checks
+it.
+
+Model (flow-insensitive, per function):
+
+- **journal calls** — ``self._journal_append(...)`` /
+  ``self._journal_bind(...)`` and any ``<recv>.append(...)`` whose
+  receiver chain ends in ``journal`` (``self.journal.append``).
+- **apply markers** — the calls that make a journaled decision live:
+  ``finish_binding`` (a binding becomes durable scheduling truth; the
+  preceding ``assume_pod`` is revocable optimistic state and deliberately
+  NOT a marker — reserve-plugin failure forgets it without a journal
+  record) and ``quarantine`` (a pod enters the durable quarantine pool).
+
+Findings:
+
+- ``wal-unjournaled-apply`` — a function applies journaled state without
+  any journal call in scope.  Recovery/replay paths that are themselves
+  driven by the journal (appends muted) suppress inline with a reason.
+- ``wal-apply-before-journal`` — a function has both, but an apply site
+  precedes the first journal call: the apply-then-append window the
+  crash matrix exists to close.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, dotted_name, make_key, walk_functions
+
+JOURNAL_SELF_METHODS = {"_journal_append", "_journal_bind", "_journal_mutation"}
+APPLY_MARKERS = {"finish_binding", "quarantine"}
+
+
+def _is_journal_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in JOURNAL_SELF_METHODS:
+            return True
+        if fn.attr == "append":
+            recv = dotted_name(fn.value)
+            if recv is not None and recv.split(".")[-1] in ("journal", "j"):
+                return True
+    return False
+
+
+def _apply_marker(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in APPLY_MARKERS:
+        return fn.attr
+    return None
+
+
+class WalRule(Rule):
+    name = "wal"
+
+    def files(self, root) -> list[str]:
+        return [
+            "kubernetes_tpu/scheduler.py",
+            "kubernetes_tpu/queue.py",
+        ]
+
+    def run(self, ctxs, root) -> list[Finding]:
+        out: list[Finding] = []
+        for path, ctx in ctxs.items():
+            for qualname, fn in walk_functions(ctx.tree):
+                journal_lines: list[int] = []
+                applies: list[tuple[int, str]] = []
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _is_journal_call(node):
+                        journal_lines.append(node.lineno)
+                    marker = _apply_marker(node)
+                    if marker is not None:
+                        applies.append((node.lineno, marker))
+                if not applies:
+                    continue
+                # The marker's own definition is not a call site.
+                if qualname.split(".")[-1] in APPLY_MARKERS and not journal_lines:
+                    applies = [
+                        (ln, m)
+                        for ln, m in applies
+                        if m != qualname.split(".")[-1]
+                    ]
+                    if not applies:
+                        continue
+                if not journal_lines:
+                    for ln, marker in applies:
+                        out.append(
+                            Finding(
+                                rule="wal-unjournaled-apply",
+                                path=path,
+                                line=ln,
+                                message=(
+                                    f"{qualname} applies journaled state "
+                                    f"({marker}) with no journal append in "
+                                    "scope — a crash here forgets the "
+                                    "decision"
+                                ),
+                                key=make_key(
+                                    "wal-unjournaled-apply",
+                                    path,
+                                    f"{qualname}:{marker}",
+                                ),
+                            )
+                        )
+                    continue
+                first_journal = min(journal_lines)
+                for ln, marker in applies:
+                    if ln < first_journal:
+                        out.append(
+                            Finding(
+                                rule="wal-apply-before-journal",
+                                path=path,
+                                line=ln,
+                                message=(
+                                    f"{qualname} applies {marker} at line "
+                                    f"{ln} before its first journal append "
+                                    f"(line {first_journal}) — the apply-"
+                                    "then-append window the WAL exists to "
+                                    "close"
+                                ),
+                                key=make_key(
+                                    "wal-apply-before-journal",
+                                    path,
+                                    f"{qualname}:{marker}",
+                                ),
+                            )
+                        )
+        return out

@@ -1,0 +1,177 @@
+"""Wire exhaustiveness: every declared frame kind is actually spoken.
+
+``proto/sidecar.proto``'s ``Envelope.msg`` oneof is the protocol's
+vocabulary.  A kind declared there but unhandled in the server is a
+frame the host can legally send and the sidecar answers with
+``unhandled message`` — a protocol hole no test exercises until an
+operator does.  A kind with no client surface is dead weight that will
+drift.  The Go codec (``go/tpubatchscore/wire.go``) mirrors the same
+set by hand, which is exactly why the Python side needs a machine
+check.
+
+Model:
+
+- **declared kinds** — field names of the ``oneof msg`` block in
+  ``proto/sidecar.proto`` (comment-stripped text parse; the .proto is
+  the single source of truth — ``sidecar_pb2.py`` is generated from it).
+- **server handlers** — string comparisons against the ``kind``
+  variable inside ``sidecar/server.py``'s ``_dispatch`` (``kind ==
+  "add"`` / ``kind in ("a", "b")``).  ``response``/``push`` are
+  server→client kinds and need no request handler.
+- **client surface** — ``env.<kind>`` / ``resp.<kind>`` envelope-field
+  accesses across ``sidecar/server.py`` (SidecarClient) and
+  ``sidecar/host.py`` (ResyncingClient/DecisionCache): every kind must
+  be constructible or consumable by the host side.
+
+Findings: ``wire-missing-handler``, ``wire-missing-client``,
+``wire-unknown-kind`` (a handler comparison against a string the proto
+does not declare — the vice-versa direction).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Rule, make_key, str_const
+
+SERVER_TO_CLIENT = {"response", "push"}
+ENVELOPE_VARS = {"env", "resp", "out"}
+
+_ONEOF_RE = re.compile(r"oneof\s+msg\s*\{(.*?)\}", re.S)
+_FIELD_RE = re.compile(r"^\s*\w+\s+(\w+)\s*=\s*\d+\s*;", re.M)
+
+
+def declared_kinds(proto_text: str) -> list[str]:
+    text = re.sub(r"//[^\n]*", "", proto_text)
+    m = _ONEOF_RE.search(text)
+    if m is None:
+        return []
+    return _FIELD_RE.findall(m.group(1))
+
+
+class WireRule(Rule):
+    name = "wire"
+
+    PROTO = "proto/sidecar.proto"
+    SERVER = "kubernetes_tpu/sidecar/server.py"
+    HOST = "kubernetes_tpu/sidecar/host.py"
+
+    def files(self, root) -> list[str]:
+        return [self.PROTO, self.SERVER, self.HOST]
+
+    def run(self, ctxs, root) -> list[Finding]:
+        proto = ctxs.get(self.PROTO)
+        server = ctxs.get(self.SERVER)
+        if proto is None or server is None:
+            return []
+        kinds = declared_kinds(proto.source)
+        if not kinds:
+            return [
+                Finding(
+                    rule="wire-unknown-kind",
+                    path=self.PROTO,
+                    line=1,
+                    message="no `oneof msg` block found in the proto",
+                    key=make_key("wire-unknown-kind", self.PROTO, "no-oneof"),
+                )
+            ]
+        out: list[Finding] = []
+
+        handled_lines = self._handled_lines(server.tree)
+        handled = set(handled_lines)
+        for kind in kinds:
+            if kind in SERVER_TO_CLIENT:
+                continue
+            if kind not in handled:
+                out.append(
+                    Finding(
+                        rule="wire-missing-handler",
+                        path=self.SERVER,
+                        line=1,
+                        message=(
+                            f"frame kind {kind!r} is declared in the proto "
+                            "but has no handler branch in _dispatch"
+                        ),
+                        key=make_key("wire-missing-handler", self.SERVER, kind),
+                    )
+                )
+        for kind in sorted(handled - set(kinds)):
+            out.append(
+                Finding(
+                    rule="wire-unknown-kind",
+                    path=self.SERVER,
+                    line=handled_lines.get(kind, 1),
+                    message=(
+                        f"_dispatch handles kind {kind!r}, which the proto "
+                        "does not declare — regenerate sidecar_pb2 or drop "
+                        "the branch"
+                    ),
+                    key=make_key("wire-unknown-kind", self.SERVER, kind),
+                )
+            )
+
+        client_surface = set()
+        for path in (self.SERVER, self.HOST):
+            ctx = ctxs.get(path)
+            if ctx is not None:
+                client_surface |= self._envelope_fields(ctx.tree)
+        for kind in kinds:
+            if kind not in client_surface:
+                out.append(
+                    Finding(
+                        rule="wire-missing-client",
+                        path=self.HOST if self.HOST in ctxs else self.SERVER,
+                        line=1,
+                        message=(
+                            f"frame kind {kind!r} has no client surface — "
+                            "no env.<kind> construction or consumption in "
+                            "the client modules"
+                        ),
+                        key=make_key(
+                            "wire-missing-client",
+                            self.HOST if self.HOST in ctxs else self.SERVER,
+                            kind,
+                        ),
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _handled_lines(tree: ast.Module) -> dict[str, int]:
+        """kind → line of its `kind == "<str>"` / `kind in (...)`
+        comparison, anywhere in the server module (the dispatch helper
+        plus any kind-specific prelude)."""
+        out: dict[str, int] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not (
+                isinstance(node.left, ast.Name) and node.left.id == "kind"
+            ):
+                continue
+            for op, comp in zip(node.ops, node.comparators):
+                if not isinstance(op, (ast.Eq, ast.In)):
+                    continue
+                values = (
+                    comp.elts
+                    if isinstance(comp, (ast.Tuple, ast.List, ast.Set))
+                    else [comp]
+                )
+                for v in values:
+                    s = str_const(v)
+                    if s is not None:
+                        out.setdefault(s, node.lineno)
+        return out
+
+    @staticmethod
+    def _envelope_fields(tree: ast.Module) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ENVELOPE_VARS
+            ):
+                out.add(node.attr)
+        return out

@@ -897,7 +897,7 @@ class PassCache:
         self._cache: dict = {}
 
     def __len__(self) -> int:
-        """Built program variants held — the jax_compiled_programs gauge
+        """Built program variants held — the scheduler_jax_compiled_programs gauge
         (each entry traced+compiled its own XLA program family)."""
         return len(self._cache)
 

@@ -198,7 +198,7 @@ class MetricsRegistry:
     plugin_execution: dict[tuple[str, str], Histogram] = field(
         default_factory=dict
     )
-    # Counter/gauge families by name (schedule_attempts_total,
+    # Counter/gauge families by name (scheduler_schedule_attempts_total,
     # scheduler_events_total{reason}, queue-depth gauges, …).
     counters: dict[str, Counter] = field(default_factory=dict)
     gauges: dict[str, Gauge] = field(default_factory=dict)
@@ -267,8 +267,8 @@ class MetricsRegistry:
     def summary(self) -> dict:
         # Collector-backed series must be as fresh here as in render_text:
         # the dump frame and bench payloads read summary(), and stale
-        # schedule_attempts_total next to live events_total would hand an
-        # operator two disagreeing views of "one registry".
+        # scheduler_schedule_attempts_total next to live events_total
+        # would hand an operator two disagreeing views of "one registry".
         for fn in self.collectors:
             fn(self)
         return {

@@ -385,6 +385,7 @@ def scheduler_state(sched) -> dict:
     an in-flight binding its informer never confirmed."""
     from .api import serialize
 
+    front = getattr(sched, "_spec_frontend", None)
     waiting = [
         e[0] for entries in sched.permit_waiting.values() for e in entries
     ] + [e["qp"] for e in sched.prebind_waiting.values()]
@@ -419,6 +420,16 @@ def scheduler_state(sched) -> dict:
             uid: {"node": node, "priority": prio}
             for uid, (node, _delta, prio) in sched.nominator.items()
         },
+        # Speculative decision-cache epoch: the cached DECISIONS are
+        # assumed state and deliberately not persisted (recovery re-derives
+        # them), but the epoch counter must survive — push subscribers hold
+        # epoch-stamped entries, and a frontend reborn at 0 would emit
+        # frames that violate the stream's monotonic-epoch contract.
+        "spec_epoch": (
+            front.epoch
+            if front is not None
+            else getattr(sched, "_recovered_spec_epoch", 0)
+        ),
     }
 
 
@@ -461,6 +472,7 @@ def recover(sched, journal: Journal) -> dict:
             # informer-delivered bound members; don't double-count —
             # overwrite with the snapshot's authoritative counts).
             sched.gang_bound = dict(st.get("gang_bound", {}))
+            sched._recovered_spec_epoch = st.get("spec_epoch", 0)
             sched.queue.restore_state(st.get("queue", {}))
             for uid, info in st.get("nominated", {}).items():
                 qp = sched.queue._info.get(uid)
@@ -503,6 +515,13 @@ def recover(sched, journal: Journal) -> dict:
                 )
             elif rtype == "release_quarantine":
                 sched.queue.release_quarantine(d.get("uid"))
+            elif rtype == "spec_epoch":
+                # The speculative frontend's epoch at its last invalidation
+                # (post-snapshot).  A frontend attached after recovery
+                # resumes from here.
+                sched._recovered_spec_epoch = max(
+                    getattr(sched, "_recovered_spec_epoch", 0), d["epoch"]
+                )
         sched._recovered_bindings = pending
         stats["pending_bindings"] = len(pending)
     finally:

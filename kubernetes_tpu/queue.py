@@ -293,7 +293,9 @@ class SchedulingQueue:
                 pod=pod, timestamp=now, initial_attempt_timestamp=now
             )
         qp.attempts = max(qp.attempts, attempts)
-        self.quarantine(qp)
+        # Replay applies a decision the journal already holds; appends are
+        # muted during recovery, so re-journaling here is wrong by design.
+        self.quarantine(qp)  # tpulint: disable=wal-unjournaled-apply
 
     # -- gang admission --------------------------------------------------------
 

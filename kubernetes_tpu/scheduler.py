@@ -331,6 +331,14 @@ class TPUScheduler:
         self.journal = None
         self.snapshot_every_batches = 0
         self._last_snapshot_batch = 0
+        # Speculative frontend (sidecar/speculate.py), when one wraps this
+        # scheduler: registered so snapshots can persist its decision-cache
+        # epoch.  _recovered_spec_epoch carries the journaled epoch across
+        # recovery, so a restarted frontend resumes the monotonic sequence
+        # instead of cold-starting at 0 (subscribers hold epoch-stamped
+        # decisions; a reset would violate the Push ordering contract).
+        self._spec_frontend = None
+        self._recovered_spec_epoch = 0
         # Journal bind records whose node was unknown at recovery time —
         # informers.reconcile_after_recovery re-applies them once the
         # LIST delivers the node (or drops them when it never does).
@@ -353,15 +361,16 @@ class TPUScheduler:
         # Hot-path counter cached as an attribute (registry.reset() clears
         # values in place, so the handle stays valid across bench resets).
         self._dispatch_counter = reg.counter(
-            "device_dispatch_total",
+            "scheduler_device_dispatch_total",
             "Device pass dispatches by kind (batch/pinned/tail/eval).",
         )
         attempts = reg.counter(
-            "schedule_attempts_total",
+            "scheduler_schedule_attempts_total",
             "Scheduling attempts by result (metrics.go:138 analog).",
         )
         preempt = reg.counter(
-            "preemption_attempts_total", "Successful preemption candidates."
+            "scheduler_preemption_attempts_total",
+            "Successful preemption candidates.",
         )
         batches = reg.counter(
             "scheduler_batches_total",
@@ -389,13 +398,14 @@ class TPUScheduler:
             "scheduler_cache_size", "Cached cluster objects by kind."
         )
         snap = reg.gauge(
-            "snapshot_node_rows", "Device snapshot node-row capacity."
+            "scheduler_snapshot_node_rows", "Device snapshot node-row capacity."
         )
         programs = reg.gauge(
-            "jax_compiled_programs", "Compiled XLA program variants held."
+            "scheduler_jax_compiled_programs",
+            "Compiled XLA program variants held.",
         )
         devmem = reg.gauge(
-            "device_memory_bytes",
+            "scheduler_device_memory_bytes",
             "Device allocator stats when the backend reports them.",
         )
 
@@ -2063,7 +2073,8 @@ class TPUScheduler:
         self._quarantine_counter.inc()
         # The failed batch never reached _complete_batch's per-pod attempt
         # accounting: count the attempt here so the exported
-        # schedule_attempts_total cells keep summing to the attempt total.
+        # scheduler_schedule_attempts_total cells keep summing to the
+        # attempt total.
         self.metrics.schedule_attempts += 1
         self.metrics.unschedulable += 1
         self.recorder.event(

@@ -167,7 +167,13 @@ class SpeculativeFrontend:
         self.delivered: dict[str, str] = {}
         self.stats = SpecStats()
         # Monotonic speculation epoch; bumped by every invalidation.
-        self.epoch = 0
+        # Resumes from the journaled value when the scheduler was recovered
+        # (journal.recover stashes it): subscribers hold epoch-stamped
+        # decisions, so a restarted frontend must continue the sequence,
+        # not restart it — and registering on the scheduler lets snapshots
+        # checkpoint the live value (journal.scheduler_state).
+        self.epoch = getattr(sched, "_recovered_spec_epoch", 0)
+        sched._spec_frontend = self
         # Reverse domain dependencies: an EXISTING pod's required
         # anti-affinity constrains FUTURE pods (the symmetry the reference
         # computes as existingAntiAffinityCounts,
@@ -614,6 +620,17 @@ class SpeculativeFrontend:
                 }
         self.stats.invalidations += 1
         self.epoch += 1
+        # Write-ahead: the epoch bump is durable before the invalidation is
+        # applied (pushed/rolled back), so recovery resumes the monotonic
+        # sequence the PR 3 roadmap gap left cold-starting.  Muted during
+        # recovery like every other append.
+        j = self.sched.journal
+        if j is not None:
+            j.append("spec_epoch", {"epoch": self.epoch})
+        # Mirror onto the scheduler too: a frontend re-created IN PROCESS
+        # (not just across a crash) must also resume from here, or it
+        # would re-emit epochs subscribers already hold.
+        self.sched._recovered_spec_epoch = self.epoch
         self._push_invalidation(None if uids is None else sel)
         # Iterate in the cache's COMMIT order, not set order: rolled-back
         # pods re-enter the hint pool in this order, and _admit_hints'

@@ -1,6 +1,9 @@
 #!/usr/bin/env sh
-# Compile-check the Go half (go/README.md): `go vet` + `go build` over
-# the out-of-tree plugin set and the scheduler binary.  The build image
+# Compile- and lint-check the Go half (go/README.md): gofmt cleanliness,
+# `go vet` + `go build` over the out-of-tree plugin set and the scheduler
+# binary, and the custom sidecardeadline analyzer (go/analyzers/ —
+# every WriteFrame/ReadFrame caller outside wire.go must set a
+# connection deadline and keep the error reachable).  The build image
 # has no Go toolchain, so the guard makes this a silent no-op there —
 # CI hosts that do carry one (and developers) get the real check.
 # Hooked into the test entrypoint via tests/test_go_build.py.
@@ -12,8 +15,39 @@ if ! command -v go >/dev/null 2>&1; then
 fi
 
 cd "$(dirname "$0")/../go"
+
+echo "check_go: gofmt -l"
+fmt_dirty="$(gofmt -l .)"
+if [ -n "$fmt_dirty" ]; then
+    echo "check_go: gofmt-dirty files:" >&2
+    echo "$fmt_dirty" >&2
+    exit 1
+fi
+
 echo "check_go: go vet ./..."
 go vet ./...
 echo "check_go: go build ./..."
 go build ./...
+
+# Custom analyzers (separate module so x/tools stays out of the plugin
+# tree).  go.sum is generated on first use (`go mod tidy` — needs module
+# proxy access); its stderr is kept so an offline failure is attributable
+# instead of surfacing later as a cryptic "missing go.sum entry".
+if [ -d analyzers ]; then
+    echo "check_go: building sidecarlint analyzer"
+    lint_dir="$(mktemp -d)"
+    trap 'rm -rf "$lint_dir"' EXIT
+    lint_bin="$lint_dir/sidecarlint"
+    (
+        cd analyzers
+        if [ ! -f go.sum ]; then
+            echo "check_go: go mod tidy (generating analyzers/go.sum)"
+            go mod tidy
+        fi
+        go build -o "$lint_bin" ./cmd/sidecarlint
+    )
+    echo "check_go: go vet -vettool=sidecarlint ./tpubatchscore"
+    go vet -vettool="$lint_bin" ./tpubatchscore
+fi
+
 echo "check_go: ok"

@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""tpulint runner: the repo's static invariants, enforced in tier-1.
+
+Same pattern as scripts/check_go.sh / tests/test_go_build.py: the check
+lives here, tests/test_static_analysis.py rides it into the test
+entrypoint.  Exits 0 when the repo carries zero unsuppressed findings.
+
+Usage:
+    python scripts/check_lint.py                # human-readable report
+    python scripts/check_lint.py --json         # machine-readable (CI/bench)
+    python scripts/check_lint.py --write-baseline
+        # regenerate tpulint_baseline.json from the current findings —
+        # every entry gets a TODO justification you MUST fill in before
+        # committing (the runner refuses unjustified baselines)
+    python scripts/check_lint.py --root DIR [--baseline FILE]
+        # lint a different tree (the fixture tests use this)
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 configuration error
+(malformed or unjustified baseline).
+
+The engine lives in kubernetes_tpu/analysis/ but is loaded WITHOUT
+importing the package root (which pulls JAX) — linting must stay cheap
+enough to run on every test invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_NAME = "tpulint_baseline.json"
+
+
+def load_tpulint(root: str = REPO):
+    """Import kubernetes_tpu/analysis as a standalone package named
+    ``tpulint`` (skipping the JAX-importing kubernetes_tpu/__init__)."""
+    if "tpulint" in sys.modules:
+        return sys.modules["tpulint"]
+    pkgdir = os.path.join(root, "kubernetes_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "tpulint",
+        os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tpulint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(root: str, baseline_path: str | None = None):
+    """(LintResult, baseline dict).  Raises tpulint.BaselineError."""
+    tpulint = load_tpulint()
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    baseline = tpulint.load_baseline(baseline_path)
+    return tpulint.run_lint(root, baseline=baseline), baseline
+
+
+def write_baseline(root: str, path: str) -> int:
+    tpulint = load_tpulint()
+    result = tpulint.run_lint(root, baseline={})
+    doc = {
+        "_comment": (
+            "tpulint grandfathered findings.  Every entry needs a written "
+            "justification; regenerate with scripts/check_lint.py "
+            "--write-baseline and fill in the TODOs."
+        ),
+        "findings": [
+            {
+                "key": f.key,
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+                "justification": "TODO: justify or fix",
+            }
+            for f in result.findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    print(f"check_lint: wrote {len(result.findings)} entries to {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO)
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--write-baseline", action="store_true")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    if args.write_baseline:
+        return write_baseline(root, baseline_path)
+
+    tpulint = load_tpulint()
+    try:
+        result, _baseline = run(root, baseline_path)
+    except tpulint.BaselineError as exc:
+        if args.as_json:
+            print(json.dumps({"error": str(exc), "clean": False}))
+        else:
+            print(f"check_lint: baseline error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(result.as_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for key in result.stale_baseline:
+            print(
+                f"check_lint: warning: stale baseline entry {key} "
+                "(finding no longer produced — prune it)",
+                file=sys.stderr,
+            )
+        print(
+            f"check_lint: {len(result.findings)} finding(s), "
+            f"{result.baselined} baselined, {result.suppressed} suppressed"
+        )
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""Seeded determinism violations (tests/test_static_analysis.py)."""
+
+import os
+import random
+import time
+
+
+def featurize(pods):
+    # POSITIVE det-wallclock: a decision input read from the wall clock.
+    stamp = time.time()
+    # POSITIVE det-random: entropy in a scoring kernel.
+    jitter = random.random()
+    # POSITIVE det-random: os.urandom.
+    salt = os.urandom(4)
+    out = []
+    # POSITIVE det-set-iteration: hash-ordered iteration reaches the output.
+    for name in {p.name for p in pods}:
+        out.append(name)
+    # POSITIVE det-set-iteration: materialized set order.
+    order = list(set(out))
+    # POSITIVE det-id-key: process-address identity as a key.
+    keys = {id(p): p for p in pods}
+    return stamp, jitter, salt, order, keys

@@ -1,0 +1,17 @@
+"""Determinism negative fixture: the allowed idioms (perf_counter for
+latency, sorted() over sets, stable uid keys) produce zero findings."""
+
+import time
+
+
+def featurize(pods):
+    t0 = time.perf_counter()  # latency metric, not a decision input
+    names = {p.name for p in pods}
+    ordered = sorted(names)  # sets sort before any order-sensitive use
+    keys = {p.uid: p for p in pods}  # stable identity, not id()
+    seen = set()
+    for p in pods:  # iterating the ordered input, membership on the set
+        if p.uid in seen:
+            continue
+        seen.add(p.uid)
+    return time.perf_counter() - t0, ordered, keys

@@ -1,0 +1,20 @@
+"""Seeded metrics-hygiene violations (tests/test_static_analysis.py)."""
+
+
+def install(reg):
+    # POSITIVE metrics-prefix: no scheduler_/sidecar_ namespace.
+    bad = reg.counter("attempts_total", "Unprefixed family.")
+    bad.inc()
+    # POSITIVE metrics-duplicate: same family registered at two sites.
+    first = reg.counter("scheduler_dup_total", "Registered here...")
+    first.inc()
+    # POSITIVE metrics-labels: one name written with two label schemas.
+    split = reg.counter("scheduler_split_total", "Forked series.")
+    split.inc(result="ok")
+    split.inc(kind="batch")
+
+
+def install_again(reg):
+    # ...and POSITIVE metrics-duplicate again here.
+    second = reg.counter("scheduler_dup_total", "Divergent help string.")
+    second.inc()

@@ -1,0 +1,11 @@
+"""Metrics-hygiene negative fixture: prefixed, single-site, one label
+schema per family — zero findings."""
+
+
+def install(reg):
+    c = reg.counter("scheduler_good_total", "Prefixed, one site.")
+    c.inc(result="ok")
+    c.inc(result="error")
+    g = reg.gauge("sidecar_depth", "Sidecar-prefixed gauge.")
+    g.set(3.0, queue="active")
+    g.set(0.0, queue="backoff")

@@ -1,0 +1,13 @@
+"""Suppression fixture: the same seeded WAL violations as wal_bad, but
+annotated with `# tpulint: disable=...` — the engine must report none."""
+
+
+class SuppressedScheduler:
+    def replay_apply(self, qp, node):
+        # Recovery replay applies decisions the journal already holds.
+        self.cache.finish_binding(qp.pod.uid)  # tpulint: disable=wal-unjournaled-apply
+
+    def replay_quarantine(self, qp):
+        # Family-level suppression on the preceding comment line:
+        # tpulint: disable=wal
+        self.queue.quarantine(qp)

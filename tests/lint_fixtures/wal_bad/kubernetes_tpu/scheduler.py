@@ -1,0 +1,24 @@
+"""Seeded WAL-discipline violations (tests/test_static_analysis.py).
+
+Not importable product code — a miniature commit path whose ordering is
+deliberately wrong, so each wal-* rule demonstrably fires.
+"""
+
+
+class BadScheduler:
+    def commit_apply_then_append(self, qp, node):
+        # POSITIVE wal-apply-before-journal: the binding goes live before
+        # the write-ahead record exists — a crash between the two forgets
+        # a decision the cluster already acted on.
+        self.cache.finish_binding(qp.pod.uid)
+        self._journal_bind(qp.pod, node)
+
+    def quarantine_without_journal(self, qp):
+        # POSITIVE wal-unjournaled-apply: durable quarantine state mutated
+        # with no journal append anywhere in the function.
+        self.queue.quarantine(qp)
+
+    def healthy_commit(self, qp, node):
+        # NEGATIVE: journal-before-apply, the required shape.
+        self._journal_bind(qp.pod, node)
+        self.cache.finish_binding(qp.pod.uid)

@@ -1,0 +1,16 @@
+"""WAL-discipline negative fixture: every apply site is dominated by a
+journal append (tests/test_static_analysis.py expects zero findings)."""
+
+
+class GoodScheduler:
+    def commit(self, qp, node):
+        self._journal_bind(qp.pod, node)
+        qp.pod.spec.node_name = node
+        self.cache.finish_binding(qp.pod.uid)
+
+    def quarantine_poison(self, qp):
+        self.journal.append("quarantine", {"uid": qp.pod.uid})
+        self.queue.quarantine(qp)
+
+    def no_apply_sites_here(self, qp):
+        self.queue.done(qp.pod.uid)

@@ -1,0 +1,48 @@
+"""Seeded wire fixture server: handles add/remove/dump plus a `bogus`
+kind the proto never declared; `schedule` and `cancel` are unhandled."""
+
+
+def _dispatch(sched, env, out):
+    kind = env.WhichOneof("msg")
+    if kind == "add":
+        sched.add(env.add.kind)
+        out.response.SetInParent()
+    elif kind == "remove":
+        sched.remove(env.remove.uid)
+        out.response.SetInParent()
+    elif kind == "dump":
+        out.response.SetInParent()
+    elif kind == "bogus":
+        out.response.SetInParent()
+
+
+class FixtureClient:
+    def add(self, kind):
+        env = self._envelope()
+        env.add.kind = kind
+        return self._call(env)
+
+    def remove(self, uid):
+        env = self._envelope()
+        env.remove.uid = uid
+        return self._call(env)
+
+    def schedule(self, drain=True):
+        env = self._envelope()
+        env.schedule.drain = drain
+        return self._call(env)
+
+    def dump(self):
+        env = self._envelope()
+        env.dump.SetInParent()
+        return self._call(env)
+
+    def _call(self, env):
+        resp = self._roundtrip(env)
+        if resp.response.error:
+            raise RuntimeError(resp.response.error)
+        return resp
+
+    def read_push(self):
+        env = self._read()
+        return env.push

@@ -1,0 +1,33 @@
+"""Wire negative fixture: complete handler + client coverage."""
+
+
+def _dispatch(sched, env, out):
+    kind = env.WhichOneof("msg")
+    if kind == "add":
+        sched.add(env.add.kind)
+        out.response.SetInParent()
+    elif kind == "remove":
+        sched.remove(env.remove.uid)
+        out.response.SetInParent()
+
+
+class FixtureClient:
+    def add(self, kind):
+        env = self._envelope()
+        env.add.kind = kind
+        return self._call(env)
+
+    def remove(self, uid):
+        env = self._envelope()
+        env.remove.uid = uid
+        return self._call(env)
+
+    def _call(self, env):
+        resp = self._roundtrip(env)
+        if resp.response.error:
+            raise RuntimeError(resp.response.error)
+        return resp
+
+    def read_push(self):
+        env = self._read()
+        return env.push

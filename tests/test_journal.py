@@ -580,3 +580,59 @@ def test_recover_cli_reports_bindings(tmp_path):
     assert report["bindings"] == want
     assert report["recovery"]["snapshot"] is True
     assert report["journal"]["epoch"] >= 1  # the journal lease's tenure
+
+
+# -- speculative decision-cache epoch (the PR 3 roadmap gap) ----------------
+
+
+def test_spec_epoch_journaled_and_recovered(tmp_path):
+    """The speculative frontend's epoch is journaled on every invalidation
+    and restored by recovery: a restarted frontend resumes the monotonic
+    sequence (subscribers hold epoch-stamped decisions — a cold start at 0
+    would violate the Push stream's monotonic-epoch contract)."""
+    from kubernetes_tpu.sidecar.speculate import SpeculativeFrontend
+
+    j = Journal(str(tmp_path), epoch=1)
+    s1 = small_sched()
+    s1.add_node(node("n1"))
+    s1.attach_journal(j)
+    f1 = SpeculativeFrontend(s1)
+    assert f1.epoch == 0
+    # Miss with a hinted co-pod: the hint is speculated and cached.
+    f1.add_hint(pod("extra"))
+    out = f1._serve_one("default/p1", lambda: pod("p1"))
+    assert out.node_name == "n1"
+    assert f1.cached, "the hinted pod should hold a cached decision"
+    f1.invalidate()  # full rollback → epoch 1, write-ahead spec_epoch record
+    f1.invalidate({"default/never-cached"})  # scoped no-op: no bump
+    assert f1.epoch == 1
+    j.close()
+
+    # An in-process frontend swap (no crash) must also resume, not reset:
+    # subscribers hold epoch-stamped decisions from the old frontend.
+    f1b = SpeculativeFrontend(s1)
+    assert f1b.epoch == 1, "re-created frontend must not re-emit epoch 0"
+
+    # Records-only recovery (no snapshot covered the epoch record).
+    j2 = Journal(str(tmp_path), epoch=2)
+    s2 = small_sched()
+    recover(s2, j2)
+    f2 = SpeculativeFrontend(s2)
+    assert f2.epoch == 1, "recovered frontend must resume the epoch"
+
+    # Snapshot path: checkpoint with the live frontend attached, truncate
+    # the log, recover again — the epoch rides the snapshot document.
+    s2.add_node(node("n1"))
+    s2.attach_journal(j2)
+    f2.add_hint(pod("extra2"))
+    f2._serve_one("default/p2", lambda: pod("p2"))
+    f2.invalidate()  # epoch 2, journaled
+    j2.snapshot(scheduler_state(s2))
+    j2.close()
+    j3 = Journal(str(tmp_path), epoch=3)
+    s3 = small_sched()
+    recover(s3, j3)
+    assert s3._recovered_spec_epoch == 2
+    f3 = SpeculativeFrontend(s3)
+    assert f3.epoch == 2
+    j3.close()

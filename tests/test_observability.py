@@ -176,7 +176,7 @@ def _attempt_samples(text: str) -> dict:
     return {
         ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
         for ln in text.splitlines()
-        if ln.startswith("schedule_attempts_total")
+        if ln.startswith("scheduler_schedule_attempts_total")
     }
 
 
@@ -203,7 +203,7 @@ def test_sidecar_metrics_frame_and_http_agree():
         )
         fa, ha = _attempt_samples(frame_text), _attempt_samples(http_text)
         assert fa == ha, (fa, ha)
-        assert fa['schedule_attempts_total{result="scheduled"}'] >= 1
+        assert fa['scheduler_schedule_attempts_total{result="scheduled"}'] >= 1
         for needle in (
             "scheduling_attempt_duration_seconds_bucket",
             'scheduler_pending_pods{queue="active"}',
@@ -212,8 +212,8 @@ def test_sidecar_metrics_frame_and_http_agree():
             'scheduler_pending_pods{queue="gang-parked"}',
             'scheduler_events_total{reason="Scheduled"}',
             'scheduler_cache_size{kind="nodes"}',
-            "jax_compiled_programs",
-            "device_dispatch_total",
+            "scheduler_jax_compiled_programs",
+            "scheduler_device_dispatch_total",
         ):
             assert needle in http_text, needle
         hz = json.loads(

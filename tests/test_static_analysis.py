@@ -1,0 +1,274 @@
+"""tpulint: the AST-based invariant checker (kubernetes_tpu/analysis/).
+
+Two halves, same pattern as scripts/check_go.sh / tests/test_go_build.py:
+
+- the REPO must be clean — ``scripts/check_lint.py`` exits 0 with zero
+  unsuppressed findings (the WAL/determinism/metrics/wire invariants
+  hold on the real tree);
+- each rule family must demonstrably FIRE — seeded-violation fixture
+  trees under tests/lint_fixtures/ carry ≥2 positive cases per family
+  plus a negative tree that yields nothing, and the suppression +
+  baseline machinery is exercised end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_lint.py")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import check_lint  # noqa: E402
+
+tpulint = check_lint.load_tpulint()
+
+
+def lint(tree: str, baseline: dict | None = None):
+    return tpulint.run_lint(os.path.join(FIXTURES, tree), baseline=baseline)
+
+
+def rules_of(result) -> list[str]:
+    return [f.rule for f in result.findings]
+
+
+# -- the repo itself --------------------------------------------------------
+
+
+def test_check_lint_script_exists_and_is_executable():
+    assert os.path.exists(SCRIPT)
+    assert os.access(SCRIPT, os.X_OK), "scripts/check_lint.py must be +x"
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: zero unsuppressed findings on the real tree."""
+    proc = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_repo_json_mode_for_ci():
+    """--json is the bench/CI surface: machine-checkable cleanliness."""
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--json"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is True
+    assert doc["findings"] == []
+    # The grandfathered histogram names ride the baseline, each justified.
+    assert doc["baselined"] >= 4
+    assert doc["stale_baseline"] == []
+
+
+def test_repo_baseline_entries_are_justified():
+    baseline = tpulint.load_baseline(
+        os.path.join(REPO, "tpulint_baseline.json")
+    )
+    assert baseline, "the committed baseline should not be empty"
+    for key, entry in baseline.items():
+        assert entry["justification"].strip(), key
+        assert not entry["justification"].startswith("TODO"), key
+
+
+# -- rule family: WAL discipline -------------------------------------------
+
+
+def test_wal_rules_fire_on_seeded_violations():
+    got = rules_of(lint("wal_bad"))
+    assert got.count("wal-apply-before-journal") == 1
+    assert got.count("wal-unjournaled-apply") == 1
+    assert len(got) == 2, got  # healthy_commit stays silent
+
+
+def test_wal_negative_tree_is_clean():
+    assert lint("wal_ok").findings == []
+
+
+# -- rule family: determinism ----------------------------------------------
+
+
+def test_det_rules_fire_on_seeded_violations():
+    got = rules_of(lint("det_bad"))
+    assert got.count("det-wallclock") == 1
+    assert got.count("det-random") == 2  # random.random + os.urandom
+    assert got.count("det-set-iteration") == 2  # for-loop + list(set(...))
+    assert got.count("det-id-key") == 1
+
+
+def test_det_negative_tree_is_clean():
+    # perf_counter, sorted(set), uid keys: the allowed idioms.
+    assert lint("det_ok").findings == []
+
+
+# -- rule family: metrics hygiene ------------------------------------------
+
+
+def test_metrics_rules_fire_on_seeded_violations():
+    result = lint("metrics_bad")
+    got = rules_of(result)
+    assert got.count("metrics-prefix") == 1
+    assert got.count("metrics-duplicate") == 1  # reported at the 2nd site
+    assert got.count("metrics-labels") == 1
+    msgs = {f.rule: f.message for f in result.findings}
+    assert "scheduler_dup_total" in msgs["metrics-duplicate"]
+    assert "{kind}" in msgs["metrics-labels"]
+    assert "{result}" in msgs["metrics-labels"]
+
+
+def test_metrics_negative_tree_is_clean():
+    assert lint("metrics_ok").findings == []
+
+
+# -- rule family: wire exhaustiveness --------------------------------------
+
+
+def test_wire_rules_fire_on_seeded_violations():
+    result = lint("wire_bad")
+    by_rule: dict[str, list[str]] = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.key)
+    missing = by_rule["wire-missing-handler"]
+    assert len(missing) == 2
+    assert any(k.endswith("::schedule") for k in missing)
+    assert any(k.endswith("::cancel") for k in missing)
+    assert [k.split("::")[-1] for k in by_rule["wire-unknown-kind"]] == ["bogus"]
+    assert [k.split("::")[-1] for k in by_rule["wire-missing-client"]] == [
+        "cancel"
+    ]
+
+
+def test_wire_negative_tree_is_clean():
+    assert lint("wire_ok").findings == []
+
+
+def test_wire_kinds_parse_from_the_real_proto():
+    with open(os.path.join(REPO, "proto", "sidecar.proto")) as f:
+        text = f.read()
+    from tpulint.rules_wire import declared_kinds
+
+    assert declared_kinds(text) == [
+        "add", "remove", "schedule", "response", "dump", "subscribe",
+        "push", "health", "metrics", "events",
+    ]
+
+
+# -- suppressions -----------------------------------------------------------
+
+
+def test_inline_suppressions_silence_findings():
+    result = lint("suppressed")
+    assert result.findings == []
+    assert result.suppressed == 2  # same-line id + family name on prev line
+
+
+def test_suppression_requires_matching_rule():
+    """A disable for a DIFFERENT family must not silence a wal finding;
+    the family name and the exact rule id both must."""
+    import ast
+
+    from tpulint.core import FileCtx, Finding, is_suppressed
+
+    fake = Finding(
+        rule="wal-unjournaled-apply", path="x.py", line=1, message="m", key="k"
+    )
+
+    def ctx(pragma: str) -> FileCtx:
+        return FileCtx(
+            path="x.py",
+            source=f"self.queue.quarantine(qp)  # tpulint: disable={pragma}\n",
+            tree=ast.parse("pass"),
+        )
+
+    assert not is_suppressed(fake, ctx("det"))
+    assert not is_suppressed(fake, ctx("wal-apply-before-journal"))
+    assert is_suppressed(fake, ctx("wal"))
+    assert is_suppressed(fake, ctx("wal-unjournaled-apply"))
+    assert is_suppressed(fake, ctx("all"))
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def test_baseline_suppresses_exactly_its_keys(tmp_path):
+    bad = lint("wal_bad")
+    keys = [f.key for f in bad.findings]
+    baseline = {
+        keys[0]: {"key": keys[0], "justification": "fixture grandfather"}
+    }
+    result = lint("wal_bad", baseline=baseline)
+    assert [f.key for f in result.findings] == keys[1:]
+    assert result.baselined == 1
+    assert result.stale_baseline == []
+
+
+def test_baseline_reports_stale_entries():
+    baseline = {
+        "wal-unjournaled-apply::gone.py::f:quarantine": {
+            "key": "wal-unjournaled-apply::gone.py::f:quarantine",
+            "justification": "was fixed",
+        }
+    }
+    result = lint("wal_ok", baseline=baseline)
+    assert result.stale_baseline == [
+        "wal-unjournaled-apply::gone.py::f:quarantine"
+    ]
+
+
+def test_unjustified_baseline_is_refused(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"findings": [{"key": "a::b::c"}]}))
+    with pytest.raises(tpulint.BaselineError):
+        tpulint.load_baseline(str(path))
+    # And the runner turns it into exit code 2, not a silent pass.
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT,
+            "--root", os.path.join(FIXTURES, "wal_ok"),
+            "--baseline", str(path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_write_baseline_then_clean(tmp_path):
+    """--write-baseline on a seeded tree + filled-in justifications must
+    bring the runner to exit 0 (the documented regeneration flow)."""
+    path = tmp_path / "baseline.json"
+    root = os.path.join(FIXTURES, "det_bad")
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--write-baseline",
+            "--root", root, "--baseline", str(path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(path.read_text())
+    assert doc["findings"], "seeded tree must produce baseline entries"
+    for entry in doc["findings"]:
+        entry["justification"] = "fixture: seeded on purpose"
+    path.write_text(json.dumps(doc))
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT,
+            "--root", root, "--baseline", str(path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
