@@ -110,6 +110,12 @@ def main() -> int:
                 "vs_baseline": r["vs_baseline"],
                 "journal_guard": guard,
                 "lint_clean": _lint_clean(),
+                # Per-phase attribution of the measured window (flight
+                # recorder tiling): which phase a future regression ate.
+                # coverage = tiled phases / measured wall time; the
+                # acceptance bar is >= 0.95 (warned below, not exit-gated
+                # — same tunnel-weather reasoning as the 5% guard).
+                "phase_attribution": r["phase_attribution"],
                 "detail": {
                     "scheduled": r["scheduled"],
                     "seconds": r["seconds"],
@@ -144,6 +150,13 @@ def main() -> int:
             }
         )
     )
+    if r["phase_attribution"]["coverage"] < 0.95:
+        print(
+            f"bench: phase attribution covers only "
+            f"{r['phase_attribution']['coverage']:.1%} of measured wall "
+            "time (target >= 95%) — the tiling is leaking",
+            file=sys.stderr,
+        )
     if guard is not None and guard["ratio"] < HARD_FLOOR:
         print(
             f"bench guard HARD FAIL: ratio {guard['ratio']} below "

@@ -10,6 +10,7 @@ Subcommands:
   bench [workload ...]            the scheduler_perf-style harness
   dump --socket PATH              debugger state dump of a live sidecar
   metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
+  flight --socket PATH            flight-recorder readout (per-batch phase attribution)
 
 Config file format (the KubeSchedulerConfiguration analog, JSON):
   {
@@ -243,10 +244,18 @@ def cmd_serve(args) -> int:
         ),
         flush=True,
     )
+    # Graceful-kill black box: SIGTERM dumps the flight-recorder ring
+    # (per-batch phase attribution + transition markers) before the
+    # process exits — the last evidence an operator gets from a pod
+    # being terminated.  SIGKILL is the chaos harness's business.
+    sched.flight.install_sigterm()
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         srv.close()
+    except SystemExit:
+        srv.close()
+        raise
     finally:
         if journal_lease is not None:
             journal_lease.release()
@@ -327,6 +336,19 @@ def cmd_metrics(args) -> int:
         print(json.dumps(client.events(), indent=2))
     else:
         print(client.metrics(), end="")
+    client.close()
+    return 0
+
+
+def cmd_flight(args) -> int:
+    """Read a live sidecar's flight recorder (the `flight` frame): the
+    per-batch phase-attribution ring + transition markers, as the same
+    JSON document the auto-dumps write.  Pipe into
+    scripts/profile_report.py for the phase-attribution table."""
+    from .sidecar import SidecarClient
+
+    client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
+    print(json.dumps(client.flight(limit=args.limit), indent=1, sort_keys=True))
     client.close()
     return 0
 
@@ -433,6 +455,21 @@ def main(argv: list[str] | None = None) -> int:
         help="print the event-recorder ring as JSON instead of metrics",
     )
     mtr.set_defaults(fn=cmd_metrics)
+
+    fl = sub.add_parser(
+        "flight",
+        help="read a live sidecar's flight recorder (phase attribution)",
+    )
+    fl.add_argument("--socket", required=True)
+    fl.add_argument(
+        "--limit", type=int, default=0,
+        help="newest N records only (0 = the whole ring)",
+    )
+    fl.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds; <=0 waits forever",
+    )
+    fl.set_defaults(fn=cmd_flight)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -32,3 +32,4 @@ from .core import (  # noqa: F401
     load_baseline,
     run_lint,
 )
+from .rules_metrics import collect_catalog  # noqa: F401

@@ -32,8 +32,14 @@ import os
 from .core import Finding, Rule, make_key, str_const
 
 PREFIXES = ("scheduler_", "sidecar_")
-CONSTRUCTORS = {"counter": "Counter", "gauge": "Gauge"}
+CONSTRUCTORS = {
+    "counter": "Counter",
+    "gauge": "Gauge",
+    "histogram": "HistogramFamily",
+}
 DIRECT_CLASSES = {"Counter", "Gauge", "Histogram"}
+# Writer methods whose keyword arguments are the family's label keys.
+WRITERS = ("inc", "set", "observe")
 
 
 def _find_metric_call(expr: ast.AST):
@@ -135,7 +141,7 @@ class MetricsRule(Rule):
                     continue
                 fn = node.func
                 if not (
-                    isinstance(fn, ast.Attribute) and fn.attr in ("inc", "set")
+                    isinstance(fn, ast.Attribute) and fn.attr in WRITERS
                 ):
                     continue
                 sym = self._symbol(fn.value)
@@ -205,3 +211,109 @@ class MetricsRule(Rule):
         if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
             return f"{node.value.id}.{node.attr}"
         return None
+
+
+# -- metrics catalog (scripts/check_lint.py --catalog) ----------------------
+
+# The built-in unlabeled histograms render through _render_histogram with
+# cell keys built inline; their label keys are recovered from the string
+# constants inside the cells expression (see _cell_labels).
+_HISTOGRAM_TYPE = "histogram"
+_TYPE_OF = {"Counter": "counter", "Gauge": "gauge", "HistogramFamily": _HISTOGRAM_TYPE}
+
+
+def _cell_labels(cells_expr: ast.AST) -> set[str]:
+    """Label keys of a ``_render_histogram`` cells expression: every
+    2-tuple whose first element is a string constant names a label
+    (``(("extension_point", p),)`` shapes)."""
+    out: set[str] = set()
+    for node in ast.walk(cells_expr):
+        if (
+            isinstance(node, ast.Tuple)
+            and len(node.elts) == 2
+            and str_const(node.elts[0]) is not None
+        ):
+            out.add(str_const(node.elts[0]))
+    return out
+
+
+def collect_catalog(root) -> list[dict]:
+    """Statically collect every metric family the package can expose:
+    ``{name, type, labels, help, path}`` entries from the same surface
+    the hygiene rules police (reg.counter/gauge/histogram get-or-create
+    sites, direct constructions, and ``_render_histogram`` exposition
+    names).  The README "Metrics catalog" section is generated from this
+    — and a tier-1 test holds the two (and the live registry) together."""
+    from .core import FileCtx
+
+    rule = MetricsRule()
+    entries: dict[str, dict] = {}
+    label_keys: dict[str, set] = {}
+    for rel in rule.files(root):
+        full = os.path.join(root, rel)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=rel)
+        except (OSError, SyntaxError):
+            continue
+        ctx = FileCtx(path=rel, source=src, tree=tree)
+        handles: dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                hit = _find_metric_call(node.value)
+                if hit is not None:
+                    sym = rule._symbol(node.targets[0])
+                    if sym is not None:
+                        handles[sym] = hit[1]
+            if not isinstance(node, ast.Call):
+                continue
+            if rule._is_site(node):
+                kind, name, call = _find_metric_call(node)
+                help_ = (
+                    str_const(call.args[1]) if len(call.args) > 1 else None
+                ) or ""
+                cur = entries.setdefault(
+                    name,
+                    {"name": name, "type": _TYPE_OF.get(kind, kind.lower()),
+                     "help": help_, "path": rel},
+                )
+                if help_ and not cur["help"]:
+                    cur["help"] = help_
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "_render_histogram"
+                and len(node.args) >= 2
+                and str_const(node.args[1]) is not None
+            ):
+                name = str_const(node.args[1])
+                help_ = (
+                    str_const(node.args[3]) if len(node.args) > 3 else None
+                ) or ""
+                entries.setdefault(
+                    name,
+                    {"name": name, "type": _HISTOGRAM_TYPE, "help": help_,
+                     "path": rel},
+                )
+                if len(node.args) > 2:
+                    label_keys.setdefault(name, set()).update(
+                        _cell_labels(node.args[2])
+                    )
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in WRITERS):
+                continue
+            sym = rule._symbol(fn.value)
+            if sym is None or sym not in handles:
+                continue
+            label_keys.setdefault(handles[sym], set()).update(
+                kw.arg for kw in node.keywords if kw.arg is not None
+            )
+    out = []
+    for name in sorted(entries):
+        e = entries[name]
+        e["labels"] = sorted(label_keys.get(name, ()))
+        out.append(e)
+    return out

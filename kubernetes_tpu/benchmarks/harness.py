@@ -142,11 +142,36 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
         else None
     )
 
+    # Flight-recorder phase attribution over the measured window: the
+    # per-batch tiled segments (featurize/device/commit/snapshot/other)
+    # summed from the scheduler_phase_duration_seconds family — their sum
+    # over wall time is the coverage the bench guard reports (journal
+    # append/fsync are sub-slices of the tiled phases and stay out of the
+    # sum).
+    phases: dict[str, float] = {}
+    fam = m.registry.histograms.get("scheduler_phase_duration_seconds")
+    if fam is not None:
+        for key, h in sorted(fam.cells.items()):
+            label = dict(key).get("phase")
+            if label and h.n:
+                phases[label] = round(h.total, 6)
+    tiled = sum(
+        v for k, v in phases.items()
+        if k not in ("journal_append", "journal_fsync")
+    )
+    phase_attribution = {
+        "phases": phases,
+        "tiled_s": round(tiled, 6),
+        "wall_s": round(dt, 6),
+        "coverage": round(tiled / dt, 4) if dt > 0 else 0.0,
+    }
+
     return {
         "name": w.name,
         "scheduled": scheduled,
         "expected": expected,
         "seconds": round(dt, 3),
+        "phase_attribution": phase_attribution,
         "pods_per_sec": round(scheduled / dt, 1) if dt > 0 else 0.0,
         "throughput": {k: round(v, 1) for k, v in pct.items()},
         "latency_ms": latency_ms,

@@ -1,0 +1,186 @@
+"""Flight recorder: a bounded in-memory ring of per-batch attribution.
+
+The headline throughput claim is one wall-clock number; when it regresses
+— or when a batch is quarantined, the breaker trips, or a host dies —
+nothing in a metrics scrape says *which phase* ate the time or what the
+scheduler was doing in the seconds before the event.  Production
+schedulers live on per-phase attribution (Gavel's heterogeneity-aware
+policies, arxiv 2008.09213, schedule against measured per-phase costs;
+the constraint-packing line of arxiv 2511.08373 likewise assumes the
+operator can see where scheduling latency goes).  This module is the
+black box that survives the incident:
+
+- one structured :class:`dict` record per scheduled batch — batch seq,
+  trace id, pod counts, per-phase timings (featurize / device / commit /
+  journal append+fsync / snapshot), per-plugin durations when the batch
+  was sampled, and dispatch kind;
+- state-transition **markers** (breaker trip, degraded entry/exit,
+  quarantine, engine fault, recovery, resync) interleaved in the same
+  ring, so a dump reads as a timeline;
+- automatic JSON **dumps** on the events an operator will be paged for
+  (engine fault, quarantine, breaker trip, SIGTERM) plus on-demand dumps
+  via the sidecar ``flight`` frame, ``GET /debug/flight``, and the
+  ``flight`` CLI subcommand.
+
+The ring is bounded (default ``DEFAULT_CAPACITY`` records) and appends
+are O(1) under one lock — always-on is the point: the interesting batch
+is the one you didn't know to instrument.  Timing uses ``perf_counter``
+(monotonic; exempt from the det-wallclock lint); the wall-clock ``ts``
+on each record exists for operators joining dumps to external logs and
+never feeds a scheduling decision.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+
+DEFAULT_CAPACITY = 4096
+
+# Auto-dump destination: TPU_FLIGHT_DIR wins (the chaos harness points it
+# at the cell's state dir), else the system temp dir.
+ENV_DUMP_DIR = "TPU_FLIGHT_DIR"
+
+
+class FlightRecorder:
+    """Bounded ring of batch records + transition markers.
+
+    Thread-safe: the scheduling thread appends while HTTP/sidecar scrape
+    threads snapshot.  ``component`` tags records and dump filenames so a
+    host-side and a sidecar-side recorder dumping into one directory stay
+    distinguishable."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        component: str = "scheduler",
+        dump_dir: str | None = None,
+        clock=time.time,
+    ):
+        self.capacity = max(1, int(capacity))
+        self.component = component
+        self.dump_dir = dump_dir
+        self._clock = clock
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dumps = 0
+        self.last_dump_path: str | None = None
+        self.last_dump_reason: str | None = None
+
+    # -- recording ---------------------------------------------------------
+
+    def record_batch(self, rec: dict) -> dict:
+        """Append one per-batch record (the caller fills phases/ids); the
+        recorder stamps seq + wall-clock ts and returns the stored dict."""
+        with self._lock:
+            self._seq += 1
+            # Reserved stamps win over caller fields — the ring's seq/ts
+            # are ITS timeline, not the caller's numbering space.
+            stored = dict(rec)
+            stored.update(
+                kind="batch", seq=self._seq, ts=round(self._clock(), 3)
+            )
+            self._ring.append(stored)
+        return stored
+
+    def record_marker(self, event: str, **fields) -> dict:
+        """Append a state-transition marker (breaker_trip, degraded_enter,
+        degraded_exit, quarantine, engine_fault, recovery, resync, …)."""
+        with self._lock:
+            self._seq += 1
+            stored = dict(fields)
+            stored.update(
+                kind="marker",
+                seq=self._seq,
+                ts=round(self._clock(), 3),
+                event=event,
+            )
+            self._ring.append(stored)
+        return stored
+
+    # -- reading -----------------------------------------------------------
+
+    def records(self, limit: int | None = None) -> list[dict]:
+        """Newest-last records; ``limit`` keeps the newest N (None/0 = all)."""
+        with self._lock:
+            out = list(self._ring)
+        if limit:
+            out = out[-limit:]
+        return out
+
+    def snapshot(self, limit: int | None = None) -> dict:
+        """The JSON-ready dump payload (also what auto-dumps write)."""
+        records = self.records(limit)
+        return {
+            "component": self.component,
+            "capacity": self.capacity,
+            "recorded": self._seq,
+            "count": len(records),
+            "dumps": self.dumps,
+            "records": records,
+        }
+
+    # -- dumping -----------------------------------------------------------
+
+    def _resolve_dump_dir(self) -> str:
+        return (
+            self.dump_dir
+            or os.environ.get(ENV_DUMP_DIR)
+            or tempfile.gettempdir()
+        )
+
+    def dump(self, reason: str, path: str | None = None) -> str | None:
+        """Write the ring as JSON.  Returns the path, or None when the
+        write failed — a failing dump must never take the scheduler with
+        it (the recorder is an observer, not a participant)."""
+        payload = self.snapshot()
+        payload["reason"] = reason
+        if path is None:
+            self.dumps += 1
+            path = os.path.join(
+                self._resolve_dump_dir(),
+                f"flight-{self.component}-{os.getpid()}-"
+                f"{self.dumps:03d}-{reason}.json",
+            )
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError:
+            return None
+        self.last_dump_path = path
+        self.last_dump_reason = reason
+        return path
+
+    def install_sigterm(self) -> bool:
+        """Dump on SIGTERM (chaining any previous handler) — the graceful
+        half of the kill story; SIGKILL is what the chaos harness proves
+        recovery against.  Main-thread only (signal module contract);
+        returns whether the handler installed."""
+        import signal
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                self.dump("sigterm")
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    raise SystemExit(143)
+
+            signal.signal(signal.SIGTERM, _on_term)
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+
+def load_dump(path: str) -> dict:
+    """Read one flight dump (the profile_report.py entry point)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

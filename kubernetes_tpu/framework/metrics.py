@@ -149,6 +149,42 @@ class Gauge:
         return self.values.get(_labels_key(labels), 0.0)
 
 
+@dataclass
+class HistogramFamily:
+    """Labeled histogram family (HistogramVec): one Histogram cell per
+    label set, observed as ``fam.observe(seconds, phase="device")``.  The
+    per-phase/per-plugin duration families
+    (``scheduler_phase_duration_seconds`` &co.) live here — the fixed
+    EXTENSION_POINTS dict predates label-set cells and stays for its
+    upstream-parity exposition name."""
+
+    name: str
+    help: str = ""
+    cells: dict[tuple, Histogram] = field(default_factory=dict)
+
+    def observe(self, v: float, **labels) -> None:
+        key = _labels_key(labels)
+        h = self.cells.get(key)
+        if h is None:
+            h = self.cells[key] = Histogram()
+        h.observe(v)
+
+    def cell(self, **labels) -> Histogram | None:
+        return self.cells.get(_labels_key(labels))
+
+    def sum(self, **labels) -> float:
+        """Total observed seconds for one cell (0.0 when never observed)."""
+        h = self.cells.get(_labels_key(labels))
+        return h.total if h is not None else 0.0
+
+    def summary(self) -> dict:
+        return {
+            _format_labels(k) or "total": dict(h.summary(), sum=h.total)
+            for k, h in sorted(self.cells.items())
+            if h.n
+        }
+
+
 def _render_histogram(
     out: list[str], name: str, cells: list[tuple[tuple, Histogram]], help_: str
 ) -> None:
@@ -202,6 +238,9 @@ class MetricsRegistry:
     # scheduler_events_total{reason}, queue-depth gauges, …).
     counters: dict[str, Counter] = field(default_factory=dict)
     gauges: dict[str, Gauge] = field(default_factory=dict)
+    # Labeled histogram families by name (scheduler_phase_duration_seconds
+    # {phase}, scheduler_plugin_duration_seconds{plugin,extension_point}).
+    histograms: dict[str, HistogramFamily] = field(default_factory=dict)
     # Scrape-time collectors: callables(registry) run by render_text()
     # before rendering, so point-in-time gauges (queue depths, cache
     # sizes, device memory) are fresh at every exposition without the hot
@@ -231,6 +270,12 @@ class MetricsRegistry:
             g = self.gauges[name] = Gauge(name, help_)
         return g
 
+    def histogram(self, name: str, help_: str = "") -> HistogramFamily:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = HistogramFamily(name, help_)
+        return h
+
     def add_collector(self, fn) -> None:
         self.collectors.append(fn)
 
@@ -243,6 +288,10 @@ class MetricsRegistry:
             h.counts = [0] * (len(h.buckets) + 1)
             h.total, h.n = 0.0, 0
         self.plugin_execution.clear()
+        # Family objects survive (holders keep their handles); the label
+        # cells are observations and go.
+        for hf in self.histograms.values():
+            hf.cells.clear()
         for c in self.counters.values():
             c.values.clear()
         for g in self.gauges.values():
@@ -298,6 +347,11 @@ class MetricsRegistry:
                 for name, g in sorted(self.gauges.items())
                 if g.values
             },
+            "histograms": {
+                name: hf.summary()
+                for name, hf in sorted(self.histograms.items())
+                if hf.cells
+            },
         }
 
     def render_text(self) -> str:
@@ -350,4 +404,8 @@ class MetricsRegistry:
                 ],
                 "Sampled per-plugin execution duration.",
             )
+        for name, hf in sorted(self.histograms.items()):
+            cells = [(k, h) for k, h in sorted(hf.cells.items()) if h.n]
+            if cells:
+                _render_histogram(out, name, cells, hf.help)
         return "\n".join(out) + "\n"

@@ -109,6 +109,7 @@ class Journal:
         # scheduler's collector once attached).
         self.appends = 0
         self.fsyncs = 0
+        self.fsync_s = 0.0  # cumulative append-path fsync seconds
         self.fenced = 0  # appends rejected by the epoch fence
         self.snapshots = 0
         self.truncations = 0
@@ -217,7 +218,9 @@ class Journal:
         self._f.write(buf)
         self._f.flush()
         if self.fsync_enabled:
+            tf = time.perf_counter()
             os.fsync(self._f.fileno())
+            self.fsync_s += time.perf_counter() - tf
             self.fsyncs += 1
         self.append_latency.observe(time.perf_counter() - t0)
         self.appends += 1
@@ -353,6 +356,7 @@ class Journal:
             "snapshot_seq": self.snapshot_seq,
             "appends": self.appends,
             "fsyncs": self.fsyncs,
+            "fsync_s": round(self.fsync_s, 6),
             "fenced": self.fenced,
             "snapshots": self.snapshots,
             "truncations": self.truncations,
@@ -526,4 +530,28 @@ def recover(sched, journal: Journal) -> dict:
         stats["pending_bindings"] = len(pending)
     finally:
         journal.muted = False
+    # Flight-recorder timeline: recovery is a state transition an operator
+    # reconstructing an incident needs on the same axis as the batches —
+    # and the dump is the artifact the crash harness asserts each killed
+    # cell leaves behind.
+    flight = getattr(sched, "flight", None)
+    if flight is not None:
+        flight.record_marker(
+            "recovery",
+            journal_epoch=journal.epoch,
+            journal_seq=journal.seq,
+            **stats,
+        )
+        # Dump only when recovery found something — a snapshot, replayable
+        # records, or a torn tail the open-time repair truncated (a crash
+        # mid-first-append leaves ONLY torn bytes, and that cell still
+        # deserves its evidence).  A true cold start is not an incident,
+        # and every test server would otherwise shed a file per
+        # construction.
+        if (
+            stats.get("snapshot")
+            or stats.get("records")
+            or stats.get("torn_bytes")
+        ):
+            flight.dump("recovery")
     return stats

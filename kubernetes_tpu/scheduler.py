@@ -24,6 +24,7 @@ from .faults import EngineFault
 from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
 from .framework.events import NORMAL, WARNING, EventBroadcaster
+from .framework.flight import FlightRecorder
 from .framework.metrics import MetricsRegistry
 from .framework.status import Diagnosis
 from .framework.tracing import Trace
@@ -123,6 +124,7 @@ class TPUScheduler:
         consistency_check_every: int = 0,
         feature_gates=None,
         inline_preempt_commit: bool | None = None,
+        flight_capacity: int = 4096,
     ):
         from .framework.features import DEFAULT_GATES
 
@@ -204,6 +206,20 @@ class TPUScheduler:
         # readable via the sidecar `events` frame.
         self.events = EventBroadcaster(registry=self.metrics.registry)
         self.recorder = self.events.new_recorder()
+        # Flight recorder (framework/flight.py): one per-phase attribution
+        # record per scheduled batch + state-transition markers, in a
+        # bounded ring.  Always on; auto-dumps on engine fault/quarantine
+        # (and SIGTERM via the CLI), readable via the `flight` frame,
+        # GET /debug/flight, and the `flight` subcommand.
+        self.flight = FlightRecorder(capacity=flight_capacity)
+        # Per-schedule_batch phase accumulator (set by schedule_batch,
+        # filled by _dispatch_batch/_complete_batch; None outside a batch
+        # so direct _schedule_infos callers skip recording).
+        self._flight_acc: dict | None = None
+        # True while inside the batch-recovery bisect: nested recoveries
+        # record markers but only the OUTERMOST failure writes the
+        # auto-dump (a 256-pod bisect must not shed a file per halving).
+        self._recovering = False
         # Cross-boundary tracing: (trace_id, parent_span_id) of the REMOTE
         # caller's span — the sidecar server sets it from the envelope so
         # the next batch's root span joins the client's trace.
@@ -363,6 +379,20 @@ class TPUScheduler:
         self._dispatch_counter = reg.counter(
             "scheduler_device_dispatch_total",
             "Device pass dispatches by kind (batch/pinned/tail/eval).",
+        )
+        # Flight-recorder phase attribution (the tiled per-batch segments;
+        # journal_append/journal_fsync nest inside featurize+commit and
+        # are exported for the durability-tax view, not the tiling sum).
+        self._phase_hist = reg.histogram(
+            "scheduler_phase_duration_seconds",
+            "Per-batch scheduling phase duration, by phase.",
+        )
+        # The tpulint-clean companion of the upstream-parity
+        # plugin_execution_duration_seconds exposition: same sampled
+        # observations, scheduler_-prefixed family.
+        self._plugin_hist = reg.histogram(
+            "scheduler_plugin_duration_seconds",
+            "Sampled per-plugin duration, by plugin and extension point.",
         )
         attempts = reg.counter(
             "scheduler_schedule_attempts_total",
@@ -556,6 +586,83 @@ class TPUScheduler:
         """on_slow hook: keep the logged span TREE for the debugger dump
         (the `dump` frame surfaces the joined host↔sidecar trace)."""
         self.slow_spans.append(tr.as_dict())
+
+    # -- flight recorder (framework/flight.py) -------------------------------
+
+    def _trace_id(self) -> str | None:
+        """The current batch's trace id (joins events and flight records
+        to the span tree — and, over the wire, to the HOST's trace)."""
+        span = self.last_batch_span
+        return span.trace_id if span is not None else None
+
+    def _trace_extra(self) -> dict:
+        """Event extra carrying the originating trace id, so an event can
+        be joined to its batch's flight record and span tree."""
+        tid = self._trace_id()
+        return {"trace_id": tid} if tid else {}
+
+    def _flight_add(self, key: str, n) -> None:
+        acc = self._flight_acc
+        if acc is not None:
+            acc[key] = acc.get(key, 0) + n
+
+    def _observe_plugin(self, plugin: str, point: str, secs: float) -> None:
+        """One sampled per-plugin duration, fanned to the upstream-parity
+        exposition, the scheduler_plugin_duration_seconds family, and the
+        current flight record."""
+        self.metrics.registry.observe_plugin(plugin, point, secs)
+        self._plugin_hist.observe(secs, plugin=plugin, extension_point=point)
+        acc = self._flight_acc
+        if acc is not None:
+            key = f"{plugin}/{point}"
+            acc["plugins"][key] = acc["plugins"].get(key, 0.0) + secs
+
+    def _record_flight(self, acc: dict, t0: float, snap_s: float, jbase) -> None:
+        """Finalize one per-batch flight record: close the phase tiling
+        (featurize/device/commit/snapshot + the explicit `other` residual
+        — pop, expiry sweeps, loop overhead), attach the journal's
+        append/fsync slice deltas, and observe every phase into
+        scheduler_phase_duration_seconds."""
+        phases = acc["phases"]
+        if snap_s > 0:
+            phases["snapshot"] = phases.get("snapshot", 0.0) + snap_s
+        wall = time.perf_counter() - t0
+        phases["other"] = max(wall - sum(phases.values()), 0.0)
+        rec = {
+            "pods": acc["pods"],
+            "scheduled": acc["scheduled"],
+            "unschedulable": acc["unschedulable"],
+            "deferred": acc.get("deferred", 0),
+            "dispatch": acc["dispatches"],
+            "wall_s": round(wall, 6),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+        }
+        if acc["plugins"]:
+            rec["plugins"] = {
+                k: round(v, 6) for k, v in sorted(acc["plugins"].items())
+            }
+        j = self.journal
+        if j is not None and jbase is not None:
+            append_s = j.append_latency.total - jbase[2]
+            fsync_s = j.fsync_s - jbase[3]
+            rec["journal"] = {
+                "appends": j.appends - jbase[0],
+                "fsyncs": j.fsyncs - jbase[1],
+                "append_s": round(append_s, 6),
+                "fsync_s": round(fsync_s, 6),
+            }
+            # Sub-slices of featurize/commit (journaled deletes can land
+            # pre-dispatch), exported for the durability-tax view — they
+            # deliberately stay OUT of the tiling sum above.
+            self._phase_hist.observe(append_s, phase="journal_append")
+            self._phase_hist.observe(fsync_s, phase="journal_fsync")
+        span = self.last_batch_span
+        if span is not None:
+            rec["trace_id"] = span.trace_id
+            rec["span_id"] = span.span_id
+        for k, v in phases.items():
+            self._phase_hist.observe(v, phase=k)
+        self.flight.record_batch(rec)
 
     def warm_tail(self) -> None:
         """Pre-compile the programs a measured window would otherwise
@@ -1135,6 +1242,7 @@ class TPUScheduler:
             self.recorder.event(
                 v.uid, NORMAL, "Preempted",
                 f"Preempted by {preemptor.uid} on node {res.node_name}",
+                **self._trace_extra(),
             )
 
     def _fits_now(self, node_name: str, delta: dict) -> bool:
@@ -1470,6 +1578,7 @@ class TPUScheduler:
                 f"0/{self.cache.node_count()} nodes available: rejected by "
                 + ", ".join(sorted(plugins)),
                 plugins=sorted(plugins),
+                **self._trace_extra(),
             )
             qp.delta = deltas[0]
             outcome = ScheduleOutcome(
@@ -1604,14 +1713,35 @@ class TPUScheduler:
         per profile (pods group by .spec.scheduler_name).  Binds completed
         between batches by informer-driven notify_prebind are prepended to
         the returned outcomes."""
-        out = self._schedule_batch_inner()
-        if self._prebind_outcomes:
-            out = self._prebind_outcomes + list(out)
-            self._prebind_outcomes = []
-        # Checkpoint at the quiescent point between batches (assume/forget
-        # deltas settled); the cadence gate inside keeps this free when
-        # journaling is off or the log hasn't grown.
-        self.maybe_snapshot()
+        t0 = time.perf_counter()
+        j = self.journal
+        jbase = (
+            (j.appends, j.fsyncs, j.append_latency.total, j.fsync_s)
+            if j is not None
+            else None
+        )
+        acc = self._flight_acc = {
+            "phases": {}, "plugins": {}, "pods": 0,
+            "scheduled": 0, "unschedulable": 0, "dispatches": [],
+        }
+        snap_s = 0.0
+        try:
+            out = self._schedule_batch_inner()
+            if self._prebind_outcomes:
+                out = self._prebind_outcomes + list(out)
+                self._prebind_outcomes = []
+            # Checkpoint at the quiescent point between batches (assume/
+            # forget deltas settled); the cadence gate inside keeps this
+            # free when journaling is off or the log hasn't grown.
+            t_snap = time.perf_counter()
+            self.maybe_snapshot()
+            snap_s = time.perf_counter() - t_snap
+        finally:
+            self._flight_acc = None
+            # One record per batch that actually dispatched (empty polls
+            # and the per-pod extender path stay off the ring).
+            if acc["pods"]:
+                self._record_flight(acc, t0, snap_s, jbase)
         return out
 
     def _schedule_batch_inner(self) -> list[ScheduleOutcome]:
@@ -1775,7 +1905,7 @@ class TPUScheduler:
         )
         if sample:
             for op_name, secs in sample.items():
-                self.metrics.registry.observe_plugin(op_name, "Featurize", secs)
+                self._observe_plugin(op_name, "Featurize", secs)
         return {
             "batch": batch, "deltas": deltas, "active": active,
             "feat_s": time.perf_counter() - t0,
@@ -1852,6 +1982,7 @@ class TPUScheduler:
         """Flush state and dispatch the device pass (async).  A prefetched
         ``work`` is dropped when anything featurization reads changed since
         (catalog binds, vocab growth from another profile's batch)."""
+        t_f0 = time.perf_counter()  # flight tiling: featurize segment start
         if self.fault_injector is not None:
             # Injected engine faults fire HERE — before featurization and
             # any state mutation — so the recovery path retries against
@@ -1891,7 +2022,7 @@ class TPUScheduler:
                 self._dispatch_counter.inc(kind="pinned")
                 return dict(
                     work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
-                    new_state=new_state, result=result, t1=t1,
+                    new_state=new_state, result=result, t1=t1, t_f0=t_f0,
                     schema=self.builder.schema, chunk=self.chunk_size,
                     pinned=True, nom_pinned=nom_pinned,
                 )
@@ -1984,7 +2115,7 @@ class TPUScheduler:
         return dict(
             work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
             batch_d=batch_d, new_state=new_state, result=result, t1=t1,
-            schema=self.builder.schema, chunk=chunk,
+            t_f0=t_f0, schema=self.builder.schema, chunk=chunk,
         )
 
     def _schedule_infos(
@@ -2010,37 +2141,58 @@ class TPUScheduler:
         from host truth before every retry: a mid-batch failure leaves it
         suspect, and host staging is the authoritative cache."""
         self._engine_fault_counter.inc()
-        self.rebuild_device_state()
-        # A mid-COMMIT failure (_complete_batch phase 2+) leaves part of
-        # the batch already assumed in the host cache; re-dispatching
-        # those pods would double-apply their resource deltas.  They are
-        # committed — report their cached placement instead of retrying.
-        out: list[ScheduleOutcome] = []
-        uncommitted: list[QueuedPodInfo] = []
-        for qp in infos:
-            pr = self.cache.pods.get(qp.pod.uid)
-            if pr is not None and pr.node_name:
-                out.append(ScheduleOutcome(qp.pod, pr.node_name))
-            else:
-                uncommitted.append(qp)
-        infos = uncommitted
-        if not infos:
-            return out
-        if isinstance(exc, EngineFault) and exc.pod_uids:
-            poison = [qp for qp in infos if qp.pod.uid in exc.pod_uids]
-            healthy = [qp for qp in infos if qp.pod.uid not in exc.pod_uids]
-            if poison:
-                out.extend(self._quarantine_poison(qp, exc) for qp in poison)
-                if healthy:
-                    out.extend(self._schedule_safe(healthy, profile))
+        self.flight.record_marker(
+            "engine_fault",
+            error=f"{type(exc).__name__}: {exc}",
+            pods=len(infos),
+            **self._trace_extra(),
+        )
+        outer = not self._recovering
+        self._recovering = True
+        try:
+            self.rebuild_device_state()
+            # A mid-COMMIT failure (_complete_batch phase 2+) leaves part
+            # of the batch already assumed in the host cache;
+            # re-dispatching those pods would double-apply their resource
+            # deltas.  They are committed — report their cached placement
+            # instead of retrying.
+            out: list[ScheduleOutcome] = []
+            uncommitted: list[QueuedPodInfo] = []
+            for qp in infos:
+                pr = self.cache.pods.get(qp.pod.uid)
+                if pr is not None and pr.node_name:
+                    out.append(ScheduleOutcome(qp.pod, pr.node_name))
+                else:
+                    uncommitted.append(qp)
+            infos = uncommitted
+            if not infos:
                 return out
-        if len(infos) == 1:
-            out.append(self._quarantine_poison(infos[0], exc))
+            if isinstance(exc, EngineFault) and exc.pod_uids:
+                poison = [qp for qp in infos if qp.pod.uid in exc.pod_uids]
+                healthy = [
+                    qp for qp in infos if qp.pod.uid not in exc.pod_uids
+                ]
+                if poison:
+                    out.extend(
+                        self._quarantine_poison(qp, exc) for qp in poison
+                    )
+                    if healthy:
+                        out.extend(self._schedule_safe(healthy, profile))
+                    return out
+            if len(infos) == 1:
+                out.append(self._quarantine_poison(infos[0], exc))
+                return out
+            mid = len(infos) // 2
+            for half in (infos[:mid], infos[mid:]):
+                out.extend(self._schedule_safe(half, profile))
             return out
-        mid = len(infos) // 2
-        for half in (infos[:mid], infos[mid:]):
-            out.extend(self._schedule_safe(half, profile))
-        return out
+        finally:
+            if outer:
+                self._recovering = False
+                # ONE dump per incident, written after the whole recovery
+                # (bisect + quarantines) so the artifact carries every
+                # marker — not one file per halving or per poison pod.
+                self.flight.dump("engine_fault")
 
     def _schedule_safe(
         self, infos: list[QueuedPodInfo], profile: Profile
@@ -2071,6 +2223,15 @@ class TPUScheduler:
             )
         self.queue.quarantine(qp)
         self._quarantine_counter.inc()
+        # Marker only: quarantine is always reached inside the batch-
+        # recovery path, whose outermost exit writes the one dump for the
+        # whole incident (quarantine markers included).
+        self.flight.record_marker(
+            "quarantine",
+            pod=qp.pod.uid,
+            error=f"{type(exc).__name__}: {exc}",
+            **self._trace_extra(),
+        )
         # The failed batch never reached _complete_batch's per-pod attempt
         # accounting: count the attempt here so the exported
         # scheduler_schedule_attempts_total cells keep summing to the
@@ -2082,6 +2243,7 @@ class TPUScheduler:
             f"pod quarantined: engine dispatch raised "
             f"{type(exc).__name__}: {exc}",
             quarantined=True,
+            **self._trace_extra(),
         )
         return ScheduleOutcome(
             qp.pod, None,
@@ -2151,6 +2313,7 @@ class TPUScheduler:
                 picks.copy(), scores.copy(), feas.copy(), fails.copy()
             )
             self.metrics.deferred += len(deferred)
+            self._flight_add("deferred", len(deferred))
 
             def run_tail(idx_list: list[int], chunk_level: int, size: int) -> list[int]:
                 """Re-featurize + re-run the given pods against the committed
@@ -2369,7 +2532,7 @@ class TPUScheduler:
                 t_rp = time.perf_counter() if sample_rp else 0.0
                 u = rp.reserve(qp.pod, node_name, self)
                 if sample_rp:
-                    m.registry.observe_plugin(
+                    self._observe_plugin(
                         getattr(rp, "name", type(rp).__name__), "Reserve",
                         time.perf_counter() - t_rp,
                     )
@@ -2504,6 +2667,7 @@ class TPUScheduler:
                     outcome.pod.uid, WARNING, "FailedScheduling",
                     f"0/{self.cache.node_count()} nodes available "
                     "(batch rollback or lost race)",
+                    **self._trace_extra(),
                 )
         for qp in latency_qps:
             if qp.pod.spec.node_name:
@@ -2535,6 +2699,7 @@ class TPUScheduler:
                 f"0/{self.cache.node_count()} nodes available: rejected by "
                 + (", ".join(sorted(plugins)) if plugins else "no feasible nodes"),
                 plugins=sorted(plugins),
+                **self._trace_extra(),
             )
             outcomes.append(outcome)
             failed2.append((i, qp, outcome))
@@ -2649,6 +2814,26 @@ class TPUScheduler:
         ):
             # Quiescent point: host assume/forget deltas all applied.
             self.check_consistency()
+        acc = self._flight_acc
+        if acc is not None:
+            # Flight tiling for this dispatch→complete unit: the three
+            # segments share boundary timestamps, so they sum to the
+            # unit's wall time exactly (multi-profile batches accumulate
+            # one unit per group; `other` in _record_flight absorbs the
+            # gaps between units).
+            t_flight_end = time.perf_counter()
+            ph = acc["phases"]
+            ph["featurize"] = ph.get("featurize", 0.0) + (t1 - ctx["t_f0"])
+            ph["device"] = ph.get("device", 0.0) + (t2 - t1)
+            ph["commit"] = ph.get("commit", 0.0) + (t_flight_end - t2)
+            acc["pods"] += len(infos)
+            acc["scheduled"] += sum(1 for o in outcomes if o.node_name)
+            acc["unschedulable"] += sum(
+                1 for o in outcomes if not o.node_name
+            )
+            acc["dispatches"].append(
+                "pinned" if ctx.get("pinned") else "batch"
+            )
         return outcomes
 
     def schedule_all_pending(

@@ -36,6 +36,7 @@ import threading
 import time
 
 from ..api import serialize
+from ..framework.flight import FlightRecorder
 from ..framework.metrics import MetricsRegistry
 from . import sidecar_pb2 as pb
 from .server import DeadlineExceeded, SidecarClient, fill_result
@@ -118,6 +119,18 @@ class ResyncingClient:
             "scheduler_sidecar_breaker_trips_total",
             "Times consecutive failures opened the circuit breaker.",
         )
+        # Wire round-trip attribution (the host half of the flight
+        # recorder's phase story: what the sidecar's own phases can't see
+        # is the tunnel + retry + resync cost of reaching it).
+        self._rt_hist = self.registry.histogram(
+            "scheduler_sidecar_round_trip_duration_seconds",
+            "Wire round-trip duration of sidecar calls (retries and "
+            "resyncs included), by call kind.",
+        )
+        # Host-side flight recorder: per-schedule wire timings plus the
+        # breaker/degraded/resync transition markers; breaker trips
+        # auto-dump (the incident the ring exists for).
+        self.flight_recorder = FlightRecorder(component="host")
         self._fallback = None
         # Deletes applied while DEGRADED never reached the sidecar; a
         # hung-but-alive sidecar still holds those objects, so the
@@ -127,6 +140,11 @@ class ResyncingClient:
         self._probe_thread: threading.Thread | None = None
         self._probe_conn: SidecarClient | None = None
         self._lock = threading.Lock()  # guards the probe handover
+        # Serializes the whole client surface: the framed protocol is one
+        # request/response stream per socket, so a metrics scrape thread
+        # (ObservabilityHTTPServer(client=...)) interleaving with the
+        # scheduling thread would desync seq numbers — or worse, frames.
+        self._call_lock = threading.Lock()
         # Durable replay store (journal.Journal): when given, every
         # object upsert/remove and every learned BINDING is journaled
         # before the in-memory mirror mutates, and the mirror itself is
@@ -147,6 +165,13 @@ class ResyncingClient:
             # WaitForCacheSync-then-schedule ordering).
             self._replay()
             self.resyncs += 1
+            # The dump is the artifact a killed-host chaos cell asserts:
+            # a restarted host leaves evidence of what it recovered.
+            self.flight_recorder.record_marker(
+                "recovery",
+                store={k: len(v) for k, v in self._store.items() if v},
+            )
+            self.flight_recorder.dump("recovery")
 
     # -- wiring ------------------------------------------------------------
 
@@ -258,6 +283,7 @@ class ResyncingClient:
                 time.sleep(self.retry_interval_s)
         self._replay()
         self.resyncs += 1
+        self.flight_recorder.record_marker("resync", resyncs=self.resyncs)
 
     def _replay(self) -> None:
         for ns, labels in self._ns_labels.items():
@@ -313,6 +339,13 @@ class ResyncingClient:
         self.degraded = True
         self._breaker_counter.inc()
         self._set_state("degraded")
+        # The page-worthy transition: mark it and persist the evidence
+        # (the ring holds the wire timings leading up to the trip).
+        self.flight_recorder.record_marker(
+            "breaker_trip", consecutive_failures=self._consecutive_failures
+        )
+        self.flight_recorder.record_marker("degraded_enter")
+        self.flight_recorder.dump("breaker_trip")
         try:
             self._client.close()
         except OSError:
@@ -380,6 +413,9 @@ class ResyncingClient:
         self.degraded = False
         self._consecutive_failures = 0
         self._set_state("healthy")
+        self.flight_recorder.record_marker(
+            "degraded_exit", resyncs=self.resyncs
+        )
         self._fallback = None  # its bindings live in the store; rebuild fresh
 
     def _ensure_fallback(self):
@@ -415,20 +451,35 @@ class ResyncingClient:
 
     # -- client surface ----------------------------------------------------
 
-    def _call_or_degraded(self, wire_fn, degraded_fn):
+    def _call_or_degraded(self, wire_fn, degraded_fn, kind: str = "call"):
         """The whole client-surface protocol in ONE place: finish any
         recovery the probe initiated, serve host-side while degraded,
         otherwise try the wire — with resync retries — and degrade when
         the breaker opens mid-call.  ``wire_fn`` must re-read
         ``self._client`` (a lambda over the attribute) so a retry after a
-        reconnect targets the NEW connection."""
-        self._maybe_recover()
-        if not self.degraded:
-            try:
-                return self._with_resync(wire_fn)
-            except BreakerOpen:
-                pass
-        return degraded_fn()
+        reconnect targets the NEW connection.  Successful wire calls are
+        timed into the round-trip histogram under ``kind`` (retries and
+        replays included — the cost of REACHING the sidecar is exactly
+        what the sidecar's own phase timings cannot see).  The call lock
+        makes the surface thread-safe: one request/response at a time on
+        the shared framed socket (and one mutator at a time on the
+        store/fallback) — without it an HTTP scrape thread
+        (ObservabilityHTTPServer(client=...)) interleaving with the
+        scheduling thread would desync the frame stream."""
+        with self._call_lock:
+            self._maybe_recover()
+            if not self.degraded:
+                t0 = time.perf_counter()
+                try:
+                    result = self._with_resync(wire_fn)
+                except BreakerOpen:
+                    pass
+                else:
+                    self._rt_hist.observe(
+                        time.perf_counter() - t0, call=kind
+                    )
+                    return result
+            return degraded_fn()
 
     def set_namespace_labels(self, namespace: str, labels: dict) -> None:
         self._journal_mutation(
@@ -441,6 +492,7 @@ class ResyncingClient:
             lambda: self._ensure_fallback().builder.set_namespace_labels(
                 namespace, dict(labels)
             ),
+            kind="add",
         )
 
     def add(self, kind: str, obj) -> None:
@@ -452,6 +504,7 @@ class ResyncingClient:
         self._call_or_degraded(
             lambda: self._client.add(kind, obj),
             lambda: self._fallback_add(kind, obj),
+            kind="add",
         )
 
     def _fallback_add(self, kind: str, obj) -> None:
@@ -465,6 +518,7 @@ class ResyncingClient:
         self._call_or_degraded(
             lambda: self._client.remove(kind, uid),
             lambda: self._fallback_remove(kind, uid),
+            kind="remove",
         )
 
     def _fallback_remove(self, kind: str, uid: str) -> None:
@@ -495,7 +549,53 @@ class ResyncingClient:
                     "store": {k: len(v) for k, v in self._store.items() if v},
                 }
             ),
+            kind="dump",
         )
+
+    def host_health(self) -> dict:
+        """The host's OWN health block (no wire touched): breaker and
+        degraded state, so a liveness probe can tell degraded-but-serving
+        from healthy — and from dead."""
+        return {
+            "sidecar_state": "degraded" if self.degraded else "healthy",
+            "degraded": self.degraded,
+            "breaker": {
+                "consecutive_failures": self._consecutive_failures,
+                "threshold": self.breaker_threshold,
+                "trips": int(self._breaker_counter.total()),
+            },
+            "resyncs": self.resyncs,
+            "pending_tombstones": len(self._tombstones),
+            "journal_armed": self.journal is not None,
+        }
+
+    def health(self) -> dict:
+        """healthz through the host: the sidecar's health frame when the
+        wire is up, a host-synthesized liveness payload when degraded —
+        always carrying the ``host`` breaker/degraded block."""
+        state = self._call_or_degraded(
+            lambda: self._client.health(),
+            # Degraded-but-serving IS healthy for a liveness probe; the
+            # host block below says which kind of healthy.
+            lambda: {"healthy": True, "ready": True, "source": "host"},
+            kind="health",
+        )
+        state["host"] = self.host_health()
+        return state
+
+    def flight(self, limit: int = 0) -> dict:
+        """Flight-recorder readout through the host: the sidecar's ring
+        when reachable (plus the host's own ring under ``host`` — wire
+        round-trip timings and breaker/resync markers), the host ring
+        alone while degraded."""
+        doc = self._call_or_degraded(
+            lambda: self._client.flight(limit),
+            lambda: {"component": "scheduler", "unreachable": True,
+                     "records": []},
+            kind="flight",
+        )
+        doc["host"] = self.flight_recorder.snapshot(limit or None)
+        return doc
 
     def _degraded_metrics(self) -> str:
         text = self.registry.render_text()
@@ -507,7 +607,8 @@ class ResyncingClient:
 
     def metrics(self) -> str:
         return self._call_or_degraded(
-            lambda: self._client.metrics(), self._degraded_metrics
+            lambda: self._client.metrics(), self._degraded_metrics,
+            kind="metrics",
         )
 
     def events(self) -> list[dict]:
@@ -518,6 +619,7 @@ class ResyncingClient:
                 if self._fallback is not None
                 else []
             ),
+            kind="events",
         )
 
     def schedule(
@@ -533,10 +635,29 @@ class ResyncingClient:
                 "add", {"kind": "Pod", "obj": serialize.to_dict(p)}
             )
             self._record("Pod", p)
+        t_wire = time.perf_counter()
         results = self._call_or_degraded(
             lambda: self._client.schedule(pods, drain=drain, trace=trace),
             lambda: self._dispatch_degraded(pods, drain),
+            kind="schedule",
         )
+        # Host flight record: the wire (or degraded host-eval) cost of
+        # this dispatch — the phase the sidecar's own recorder can't see.
+        # Empty drain polls stay off the ring (same gate as the
+        # scheduler side): a 0.3s settle loop would otherwise evict every
+        # incident-relevant record within minutes.
+        if pods or any(r.node_name for r in results):
+            self.flight_recorder.record_batch(
+                {
+                    "call": "schedule",
+                    "pods": len(pods),
+                    "bound": sum(1 for r in results if r.node_name),
+                    "degraded": self.degraded,
+                    "phases": {
+                        "wire": round(time.perf_counter() - t_wire, 6)
+                    },
+                }
+            )
         # Record bindings: the reference host persists them via the
         # apiserver; here the store is that persistence, so a later replay
         # re-adds bound pods as cache adds with their node set.

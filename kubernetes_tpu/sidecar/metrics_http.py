@@ -1,4 +1,5 @@
-"""Plain-HTTP observability endpoint: /metrics, /healthz, /events.
+"""Plain-HTTP observability endpoint: /metrics, /healthz, /events,
+/debug/flight.
 
 The reference scheduler serves /metrics and /healthz from its secure
 serving port (cmd/kube-scheduler/app/server.go:181–210 newHealthEndpoints
@@ -9,7 +10,19 @@ an unmodified Prometheus can scrape the engine without speaking frames.
 
 The text payload is byte-identical to the sidecar `metrics` frame — both
 render the same ``MetricsRegistry`` — which is what the tier-1 smoke test
-asserts."""
+asserts.
+
+Two backings, one handler:
+
+- ``scheduler=`` (the sidecar deployment): serve the engine's registry,
+  events, flight ring and health directly.
+- ``client=`` (a host deployment's ``ResyncingClient``): serve THROUGH
+  the resilient client — while the breaker is open, /metrics and /events
+  keep answering from the host's own registry/fallback (the
+  degraded-but-serving contract PR 2 established for the in-process
+  path), and /healthz carries the breaker/degraded block so a liveness
+  probe can tell degraded from dead.
+"""
 
 from __future__ import annotations
 
@@ -23,7 +36,9 @@ CONTENT_TYPE_TEXT = "text/plain; version=0.0.4; charset=utf-8"
 
 def health_state(scheduler, extra: dict | None = None) -> dict:
     """The /healthz (and sidecar health-frame) payload: liveness plus the
-    cheap state counts an operator probes first."""
+    cheap state counts an operator probes first.  ``journal_armed`` is
+    explicit either way — a probe must distinguish "durable and current"
+    from "never journaling" without guessing from a missing key."""
     state = {
         "healthy": True,
         "ready": True,
@@ -32,6 +47,7 @@ def health_state(scheduler, extra: dict | None = None) -> dict:
         "pending": len(scheduler.queue),
     }
     journal = getattr(scheduler, "journal", None)
+    state["journal_armed"] = journal is not None
     if journal is not None:
         # Durability probes: the epoch the writer holds and how far the
         # log has grown past its last checkpoint.
@@ -45,8 +61,23 @@ def health_state(scheduler, extra: dict | None = None) -> dict:
     return state
 
 
+def _parse_limit(path: str) -> int:
+    """?limit=N from a request path (0 = whole ring / default)."""
+    if "?" not in path:
+        return 0
+    for part in path.split("?", 1)[1].split("&"):
+        if part.startswith("limit="):
+            try:
+                return max(0, int(part[len("limit="):]))
+            except ValueError:
+                return 0
+    return 0
+
+
 class ObservabilityHTTPServer:
-    """Threaded HTTP listener over one scheduler's registry/events.
+    """Threaded HTTP listener over one scheduler's registry/events — or,
+    with ``client=``, over a host's ResyncingClient (see module
+    docstring).
 
     Port 0 binds an ephemeral port (tests); read ``self.port`` after
     construction.  ``lock`` serializes /metrics against the scheduler:
@@ -59,13 +90,17 @@ class ObservabilityHTTPServer:
 
     def __init__(
         self,
-        scheduler,
+        scheduler=None,
         port: int = 0,
         host: str = "127.0.0.1",
         health_extra: dict | None = None,
         lock: "threading.Lock | None" = None,
+        client=None,
     ):
+        if (scheduler is None) == (client is None):
+            raise ValueError("pass exactly one of scheduler= or client=")
         self.scheduler = scheduler
+        self.client = client
         self.health_extra = health_extra if health_extra is not None else {}
         self.lock = lock if lock is not None else threading.Lock()
         outer = self
@@ -74,16 +109,16 @@ class ObservabilityHTTPServer:
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    with outer.lock:
-                        body = outer.scheduler.metrics.registry.render_text()
+                    body = outer._metrics()
                     self._send(200, CONTENT_TYPE_TEXT, body.encode())
                 elif path == "/healthz":
                     # Answering at all IS the liveness signal (the healthz
-                    # contract), so NO dispatch lock here: a probe must not
-                    # hang behind a long batch — /metrics is the deeper,
-                    # serialized probe.  health_state only does len() calls
-                    # (GIL-atomic snapshots).
-                    state = health_state(outer.scheduler, outer.health_extra)
+                    # contract), so NO dispatch lock on the scheduler
+                    # path: a probe must not hang behind a long batch —
+                    # /metrics is the deeper, serialized probe.
+                    # health_state only does len() calls (GIL-atomic
+                    # snapshots); the client path is deadline-bounded.
+                    state = outer._health()
                     self._send(
                         200, "application/json", json.dumps(state).encode()
                     )
@@ -92,7 +127,14 @@ class ObservabilityHTTPServer:
                     # lock; no scheduler state is touched.
                     self._send(
                         200, "application/json",
-                        json.dumps(outer.scheduler.events.list()).encode(),
+                        json.dumps(outer._events()).encode(),
+                    )
+                elif path == "/debug/flight":
+                    # Flight-recorder readout — same JSON the `flight`
+                    # frame and the auto-dumps produce.
+                    doc = outer._flight(_parse_limit(self.path))
+                    self._send(
+                        200, "application/json", json.dumps(doc).encode()
                     )
                 else:
                     self._send(404, "text/plain", b"not found\n")
@@ -111,6 +153,35 @@ class ObservabilityHTTPServer:
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
+
+    # -- backends ----------------------------------------------------------
+
+    def _metrics(self) -> str:
+        if self.client is not None:
+            # The ResyncingClient serves the wire text when healthy and
+            # the host registry (+ fallback engine, if built) when the
+            # breaker is open — /metrics answers either way.
+            return self.client.metrics()
+        with self.lock:
+            return self.scheduler.metrics.registry.render_text()
+
+    def _health(self) -> dict:
+        if self.client is not None:
+            state = self.client.health()
+            if self.health_extra:
+                state.update(self.health_extra)
+            return state
+        return health_state(self.scheduler, self.health_extra)
+
+    def _events(self) -> list:
+        if self.client is not None:
+            return self.client.events()
+        return self.scheduler.events.list()
+
+    def _flight(self, limit: int) -> dict:
+        if self.client is not None:
+            return self.client.flight(limit)
+        return self.scheduler.flight.snapshot(limit or None)
 
     def serve_background(self) -> None:
         self._thread = threading.Thread(

@@ -383,6 +383,16 @@ def _dispatch(
 
         out.response.events_json = _json.dumps(sched.events.list()).encode()
         return False
+    if kind == "flight":
+        # Flight-recorder readout: the per-batch phase-attribution ring +
+        # transition markers as one JSON document (framework/flight.py) —
+        # same payload the auto-dumps write and /debug/flight serves.
+        import json as _json
+
+        out.response.flight_json = _json.dumps(
+            sched.flight.snapshot(env.flight.limit or None)
+        ).encode()
+        return False
     if kind == "add":
         if env.add.kind == "PendingPod":
             # A pending-pod HINT (speculate.py): the host's informer saw an
@@ -688,6 +698,17 @@ class SidecarClient:
         env = pb.Envelope()
         env.events.SetInParent()
         return json.loads(self._call(env).response.events_json)
+
+    def flight(self, limit: int = 0) -> dict:
+        """Read the flight recorder: per-batch phase attribution records
+        + state-transition markers (``limit`` keeps the newest N)."""
+        import json
+
+        env = pb.Envelope()
+        env.flight.SetInParent()
+        if limit:
+            env.flight.limit = limit
+        return json.loads(self._call(env).response.flight_json)
 
     def subscribe(self) -> None:
         """Turn THIS connection into a decision push stream.  After the

@@ -13,7 +13,7 @@ from google.protobuf import symbol_database as _symbol_database
 _sym_db = _symbol_database.Default()
 
 
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\rsidecar.proto\x12\x19kubernetes_tpu.sidecar.v1"\xfe\x04\n\x08Envelope\x12\x0b\n\x03seq\x18\x01 \x01(\x04\x123\n\x03add\x18\x02 \x01(\x0b2$.kubernetes_tpu.sidecar.v1.AddObjectH\x00\x129\n\x06remove\x18\x03 \x01(\x0b2\'.kubernetes_tpu.sidecar.v1.RemoveObjectH\x00\x12C\n\x08schedule\x18\x04 \x01(\x0b2/.kubernetes_tpu.sidecar.v1.ScheduleBatchRequestH\x00\x127\n\x08response\x18\x05 \x01(\x0b2#.kubernetes_tpu.sidecar.v1.ResponseH\x00\x126\n\x04dump\x18\x06 \x01(\x0b2&.kubernetes_tpu.sidecar.v1.DumpRequestH\x00\x12@\n\tsubscribe\x18\x07 \x01(\x0b2+.kubernetes_tpu.sidecar.v1.SubscribeRequestH\x00\x12/\n\x04push\x18\x08 \x01(\x0b2\x1f.kubernetes_tpu.sidecar.v1.PushH\x00\x12:\n\x06health\x18\t \x01(\x0b2(.kubernetes_tpu.sidecar.v1.HealthRequestH\x00\x12E\n\x07metrics\x18\n \x01(\x0b2).kubernetes_tpu.sidecar.v1.MetricsRequestH\x00R\x07metrics\x12B\n\x06events\x18\x0b \x01(\x0b2(.kubernetes_tpu.sidecar.v1.EventsRequestH\x00R\x06eventsB\x05\n\x03msg".\n\tAddObject\x12\x0c\n\x04kind\x18\x01 \x01(\t\x12\x13\n\x0bobject_json\x18\x02 \x01(\x0c")\n\x0cRemoveObject\x12\x0c\n\x04kind\x18\x01 \x01(\t\x12\x0b\n\x03uid\x18\x02 \x01(\t"x\n\x14ScheduleBatchRequest\x12\x10\n\x08pod_json\x18\x01 \x03(\x0c\x12\r\n\x05drain\x18\x02 \x01(\x08\x12\x19\n\x08trace_id\x18\x03 \x01(\tR\x07traceId\x12$\n\x0eparent_span_id\x18\x04 \x01(\tR\x0cparentSpanId"\xc9\x01\n\tPodResult\x12\x0f\n\x07pod_uid\x18\x01 \x01(\t\x12\x11\n\tnode_name\x18\x02 \x01(\t\x12\r\n\x05score\x18\x03 \x01(\x03\x12\x16\n\x0efeasible_nodes\x18\x04 \x01(\x05\x12\x1d\n\x15unschedulable_plugins\x18\x05 \x03(\t\x12\x16\n\x0enominated_node\x18\x06 \x01(\t\x12\x0f\n\x07victims\x18\x07 \x01(\x05\x12\x13\n\x0bvictim_uids\x18\x08 \x03(\t\x12\x14\n\x0cvictim_names\x18\t \x03(\t"\r\n\x0bDumpRequest"\x12\n\x10SubscribeRequest"~\n\x04Push\x12\r\n\x05epoch\x18\x01 \x01(\x04\x12\x16\n\x0einvalidate_all\x18\x02 \x01(\x08\x12\x17\n\x0finvalidate_uids\x18\x03 \x03(\t\x126\n\tdecisions\x18\x04 \x03(\x0b2#.kubernetes_tpu.sidecar.v1.Decision"t\n\x08Decision\x12\x0f\n\x07pod_uid\x18\x01 \x01(\t\x12\x11\n\tnode_name\x18\x02 \x01(\t\x12\r\n\x05score\x18\x03 \x01(\x03\x12\x16\n\x0efeasible_nodes\x18\x04 \x01(\x05\x12\x1d\n\x15unschedulable_plugins\x18\x05 \x03(\t"\x0f\n\rHealthRequest"\xd5\x01\n\x08Response\x12\r\n\x05error\x18\x01 \x01(\t\x125\n\x07results\x18\x02 \x03(\x0b2$.kubernetes_tpu.sidecar.v1.PodResult\x12\x11\n\tdump_json\x18\x03 \x01(\x0c\x12\x13\n\x0bhealth_json\x18\x04 \x01(\x0c\x12!\n\x0cmetrics_text\x18\x05 \x01(\x0cR\x0bmetricsText\x12\x1f\n\x0bevents_json\x18\x06 \x01(\x0cR\neventsJson\x12\x17\n\x07span_id\x18\x07 \x01(\tR\x06spanId"\x10\n\x0eMetricsRequest"\x0f\n\rEventsRequestb\x06proto3')
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\rsidecar.proto\x12\x19kubernetes_tpu.sidecar.v1"\xc2\x05\n\x08Envelope\x12\x0b\n\x03seq\x18\x01 \x01(\x04\x123\n\x03add\x18\x02 \x01(\x0b2$.kubernetes_tpu.sidecar.v1.AddObjectH\x00\x129\n\x06remove\x18\x03 \x01(\x0b2\'.kubernetes_tpu.sidecar.v1.RemoveObjectH\x00\x12C\n\x08schedule\x18\x04 \x01(\x0b2/.kubernetes_tpu.sidecar.v1.ScheduleBatchRequestH\x00\x127\n\x08response\x18\x05 \x01(\x0b2#.kubernetes_tpu.sidecar.v1.ResponseH\x00\x126\n\x04dump\x18\x06 \x01(\x0b2&.kubernetes_tpu.sidecar.v1.DumpRequestH\x00\x12@\n\tsubscribe\x18\x07 \x01(\x0b2+.kubernetes_tpu.sidecar.v1.SubscribeRequestH\x00\x12/\n\x04push\x18\x08 \x01(\x0b2\x1f.kubernetes_tpu.sidecar.v1.PushH\x00\x12:\n\x06health\x18\t \x01(\x0b2(.kubernetes_tpu.sidecar.v1.HealthRequestH\x00\x12E\n\x07metrics\x18\n \x01(\x0b2).kubernetes_tpu.sidecar.v1.MetricsRequestH\x00R\x07metrics\x12B\n\x06events\x18\x0b \x01(\x0b2(.kubernetes_tpu.sidecar.v1.EventsRequestH\x00R\x06events\x12B\n\x06flight\x18\x0c \x01(\x0b2(.kubernetes_tpu.sidecar.v1.FlightRequestH\x00R\x06flightB\x05\n\x03msg".\n\tAddObject\x12\x0c\n\x04kind\x18\x01 \x01(\t\x12\x13\n\x0bobject_json\x18\x02 \x01(\x0c")\n\x0cRemoveObject\x12\x0c\n\x04kind\x18\x01 \x01(\t\x12\x0b\n\x03uid\x18\x02 \x01(\t"x\n\x14ScheduleBatchRequest\x12\x10\n\x08pod_json\x18\x01 \x03(\x0c\x12\r\n\x05drain\x18\x02 \x01(\x08\x12\x19\n\x08trace_id\x18\x03 \x01(\tR\x07traceId\x12$\n\x0eparent_span_id\x18\x04 \x01(\tR\x0cparentSpanId"\xc9\x01\n\tPodResult\x12\x0f\n\x07pod_uid\x18\x01 \x01(\t\x12\x11\n\tnode_name\x18\x02 \x01(\t\x12\r\n\x05score\x18\x03 \x01(\x03\x12\x16\n\x0efeasible_nodes\x18\x04 \x01(\x05\x12\x1d\n\x15unschedulable_plugins\x18\x05 \x03(\t\x12\x16\n\x0enominated_node\x18\x06 \x01(\t\x12\x0f\n\x07victims\x18\x07 \x01(\x05\x12\x13\n\x0bvictim_uids\x18\x08 \x03(\t\x12\x14\n\x0cvictim_names\x18\t \x03(\t"\r\n\x0bDumpRequest"\x12\n\x10SubscribeRequest"~\n\x04Push\x12\r\n\x05epoch\x18\x01 \x01(\x04\x12\x16\n\x0einvalidate_all\x18\x02 \x01(\x08\x12\x17\n\x0finvalidate_uids\x18\x03 \x03(\t\x126\n\tdecisions\x18\x04 \x03(\x0b2#.kubernetes_tpu.sidecar.v1.Decision"t\n\x08Decision\x12\x0f\n\x07pod_uid\x18\x01 \x01(\t\x12\x11\n\tnode_name\x18\x02 \x01(\t\x12\r\n\x05score\x18\x03 \x01(\x03\x12\x16\n\x0efeasible_nodes\x18\x04 \x01(\x05\x12\x1d\n\x15unschedulable_plugins\x18\x05 \x03(\t"\x0f\n\rHealthRequest"\xf6\x01\n\x08Response\x12\r\n\x05error\x18\x01 \x01(\t\x125\n\x07results\x18\x02 \x03(\x0b2$.kubernetes_tpu.sidecar.v1.PodResult\x12\x11\n\tdump_json\x18\x03 \x01(\x0c\x12\x13\n\x0bhealth_json\x18\x04 \x01(\x0c\x12!\n\x0cmetrics_text\x18\x05 \x01(\x0cR\x0bmetricsText\x12\x1f\n\x0bevents_json\x18\x06 \x01(\x0cR\neventsJson\x12\x17\n\x07span_id\x18\x07 \x01(\tR\x06spanId\x12\x1f\n\x0bflight_json\x18\x08 \x01(\x0cR\nflightJson"\x10\n\x0eMetricsRequest"\x0f\n\rEventsRequest"%\n\rFlightRequest\x12\x14\n\x05limit\x18\x01 \x01(\rR\x05limitb\x06proto3')
 
 _builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
 _builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'sidecar_pb2', globals())

@@ -14,6 +14,10 @@ Usage:
         # committing (the runner refuses unjustified baselines)
     python scripts/check_lint.py --root DIR [--baseline FILE]
         # lint a different tree (the fixture tests use this)
+    python scripts/check_lint.py --catalog
+        # print the metrics catalog (family, type, labels, help) as the
+        # markdown table README's "Metrics catalog" section embeds — a
+        # tier-1 test asserts the README matches this output
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 configuration error
 (malformed or unjustified baseline).
@@ -88,15 +92,38 @@ def write_baseline(root: str, path: str) -> int:
     return 0
 
 
+def render_catalog(root: str) -> str:
+    """The metrics catalog as a markdown table — the generated body of
+    README's "Metrics catalog" section (between the metrics-catalog
+    markers), statically collected from the same surface the metrics
+    hygiene rules police."""
+    tpulint = load_tpulint()
+    lines = [
+        "| family | type | labels | help |",
+        "|---|---|---|---|",
+    ]
+    for e in tpulint.collect_catalog(root):
+        labels = ", ".join(f"`{k}`" for k in e["labels"]) or "—"
+        lines.append(
+            f"| `{e['name']}` | {e['type']} | {labels} | {e['help']} |"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=REPO)
     ap.add_argument("--baseline", default=None)
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--catalog", action="store_true")
     args = ap.parse_args(argv)
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    if args.catalog:
+        print(render_catalog(root))
+        return 0
 
     if args.write_baseline:
         return write_baseline(root, baseline_path)

@@ -73,6 +73,13 @@ def evolve(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _add_field(resp, "metrics_text", 5, F.TYPE_BYTES)
     _add_field(resp, "events_json", 6, F.TYPE_BYTES)
     _add_field(resp, "span_id", 7, F.TYPE_STRING)
+    # The flight-recorder delta (PR: per-phase attribution readout).
+    _add_empty_message(fdp, "FlightRequest")
+    flight = _msg(fdp, "FlightRequest")
+    _add_field(flight, "limit", 1, F.TYPE_UINT32)
+    _add_field(env, "flight", 12, F.TYPE_MESSAGE,
+               type_name=f"{PKG}.FlightRequest", oneof=0)
+    _add_field(resp, "flight_json", 8, F.TYPE_BYTES)
 
 
 TEMPLATE = '''# -*- coding: utf-8 -*-

@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Turn a flight-recorder dump into a phase-attribution table.
+
+Input: the JSON document the flight recorder produces everywhere — an
+auto-dump file (engine fault / quarantine / breaker trip / SIGTERM /
+recovery), `python -m kubernetes_tpu flight --socket S`, or
+`GET /debug/flight` (pipe via `-`).  Output: where the time went —
+aggregate per-phase seconds and share, per-batch percentiles, the
+sampled per-plugin table, and the transition-marker timeline.
+
+    python scripts/profile_report.py /tmp/flight-scheduler-123-001-quarantine.json
+    python -m kubernetes_tpu flight --socket S | python scripts/profile_report.py -
+
+Stdlib-only on purpose: this must run on the operator's laptop against a
+dump scp'd out of an incident, with no JAX (or repo) install.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1000:.1f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def _table(rows: list[tuple], headers: tuple) -> str:
+    widths = [
+        max(len(str(r[i])) for r in rows + [headers])
+        for i in range(len(headers))
+    ]
+    lines = [
+        "  ".join(str(h).ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def report(doc: dict) -> str:
+    out: list[str] = []
+    records = doc.get("records", [])
+    batches = [r for r in records if r.get("kind") == "batch"]
+    markers = [r for r in records if r.get("kind") == "marker"]
+    out.append(
+        f"flight dump: component={doc.get('component', '?')} "
+        f"records={len(records)} (capacity {doc.get('capacity', '?')}, "
+        f"{doc.get('recorded', len(records))} recorded lifetime)"
+        + (f" reason={doc['reason']}" if doc.get("reason") else "")
+    )
+
+    if batches:
+        # Aggregate per-phase attribution.
+        totals: dict[str, float] = {}
+        per_batch: dict[str, list[float]] = {}
+        wall = 0.0
+        for b in batches:
+            wall += b.get("wall_s", 0.0)
+            for phase, secs in (b.get("phases") or {}).items():
+                totals[phase] = totals.get(phase, 0.0) + secs
+                per_batch.setdefault(phase, []).append(secs)
+        tiled = sum(
+            v for k, v in totals.items()
+            if k not in ("journal_append", "journal_fsync")
+        )
+        pods = sum(b.get("pods", 0) for b in batches)
+        bound = sum(b.get("scheduled", b.get("bound", 0)) for b in batches)
+        out.append(
+            f"\n{len(batches)} batches, {pods} pods ({bound} bound), "
+            f"{_fmt_s(wall)} batch wall time"
+        )
+        rows = []
+        for phase, total in sorted(totals.items(), key=lambda kv: -kv[1]):
+            samples = per_batch[phase]
+            share = total / wall if wall > 0 else 0.0
+            rows.append(
+                (
+                    phase,
+                    _fmt_s(total),
+                    f"{share:6.1%}",
+                    _fmt_s(_percentile(samples, 0.50)),
+                    _fmt_s(_percentile(samples, 0.99)),
+                )
+            )
+        out.append(
+            _table(rows, ("phase", "total", "share", "p50/batch", "p99/batch"))
+        )
+        if wall > 0:
+            out.append(
+                f"tiled phases cover {tiled / wall:.1%} of batch wall time "
+                "(journal_append/journal_fsync nest inside the tiles)"
+            )
+
+        # Sampled per-plugin durations.
+        plugins: dict[str, float] = {}
+        for b in batches:
+            for key, secs in (b.get("plugins") or {}).items():
+                plugins[key] = plugins.get(key, 0.0) + secs
+        if plugins:
+            out.append("\nsampled per-plugin durations:")
+            out.append(
+                _table(
+                    [
+                        (k, _fmt_s(v))
+                        for k, v in sorted(plugins.items(), key=lambda kv: -kv[1])
+                    ],
+                    ("plugin/point", "total (sampled)"),
+                )
+            )
+
+    if markers:
+        out.append("\ntransition markers:")
+        for mk in markers:
+            fields = {
+                k: v
+                for k, v in mk.items()
+                if k not in ("kind", "seq", "ts", "event")
+            }
+            tail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+            out.append(
+                f"  seq={mk.get('seq', '?')} ts={mk.get('ts', '?')} "
+                f"{mk.get('event', '?')}" + (f" {tail}" if tail else "")
+            )
+
+    # A host-merged document (ResyncingClient.flight()) nests the host's
+    # own ring under "host": report it recursively.
+    host = doc.get("host")
+    if isinstance(host, dict) and host.get("records"):
+        out.append("\n--- host ring ---")
+        out.append(report(host))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 1:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    if args[0] == "-":
+        doc = json.load(sys.stdin)
+    else:
+        with open(args[0], "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    print(report(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

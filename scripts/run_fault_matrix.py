@@ -69,6 +69,26 @@ KILL_CASES = (
     ("mid-truncate", 1), ("mid-truncate", 2),
 )
 
+# The WIRE crash subset (the ROADMAP layer-0 gap): the same scenario
+# deployed as two processes — a journaled sidecar serving the framed
+# socket and a journaled ResyncingClient host driving it — with HOST and
+# SIDECAR SIGKILLed independently at journal injection points.  The
+# killed side restarts (host: cold-start journal replay + store resync;
+# sidecar: snapshot + fenced replay before its first frame, then the
+# host's reconnect replay), the scenario tail re-runs idempotently, and
+# the final binding map must be bit-identical to an unkilled wire run.
+# Each killed cell must also leave a READABLE flight dump (the recovery
+# auto-dump) in the cell's state dir.  Points are chosen past the first
+# durable record, so a restart always has something to recover.
+WIRE_KILL_CASES = (
+    ("host", "post-append", 1),
+    ("host", "torn-append", 3),
+    ("host", "mid-snapshot", 1),
+    ("sidecar", "post-append", 1),
+    ("sidecar", "torn-append", 1),
+    ("sidecar", "pre-append", 2),
+)
+
 # Per-call deadline for the sweep: small enough that a hang case costs
 # ~deadline per retry, large enough that a CPU-backend device pass (with
 # its XLA compile on first touch) never trips it spuriously.
@@ -294,6 +314,8 @@ def _spawn(mode: str, state_dir: str, kill: str | None = None) -> int:
     env.pop("TPU_JOURNAL_KILL", None)
     if kill:
         env["TPU_JOURNAL_KILL"] = kill
+    # Recovery flight dumps stay in the cell's state dir, not /tmp.
+    env["TPU_FLIGHT_DIR"] = state_dir
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), mode, state_dir],
         env=env,
@@ -362,6 +384,261 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the WIRE crash matrix (host and sidecar killed independently) ---------
+
+
+def _wire_lease_journal(jdir: str, who: str):
+    """(lease, journal) for one side's own journal directory — each side
+    fences its log with its own lease epoch, exactly like the two real
+    deployments would."""
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+
+    os.makedirs(jdir, exist_ok=True)
+    lease_path = os.path.join(jdir, "lease")
+    lease = FileLease(lease_path, identity=f"{who}-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        jdir, epoch=lease.epoch, fence=lambda: read_epoch(lease_path)
+    )
+    return lease, journal
+
+
+def wire_sidecar_child(state_dir: str) -> None:
+    """The sidecar half: the golden basic-session scheduler behind the
+    framed socket, write-ahead journal armed (snapshot every batch).  A
+    restart recovers snapshot + fenced replay before its first frame
+    (SidecarServer's recover-before-serve contract); when
+    TPU_JOURNAL_KILL is set, the process SIGKILLs itself mid-commit."""
+    from gen_golden_transcripts import session_schedulers
+
+    from kubernetes_tpu.faults import KillSwitch
+    from kubernetes_tpu.sidecar.server import SidecarServer
+
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    _lease, journal = _wire_lease_journal(
+        os.path.join(state_dir, "sidecar-journal"), "wire-sidecar"
+    )
+    srv = SidecarServer(
+        os.path.join(state_dir, "sidecar.sock"),
+        scheduler=session_schedulers()["basic_session"](),
+        journal=journal,
+        snapshot_every_batches=1,
+    )
+    srv.serve_forever()
+
+
+def wire_host_child(state_dir: str) -> None:
+    """The host half: a journaled ResyncingClient driving the scenario
+    over the wire.  Breaker effectively disabled and retries generous —
+    the cell under test is crash recovery, not degraded mode, so a dead
+    sidecar is ridden out through reconnect+replay while the parent
+    restarts it.  Idempotent: a restarted host re-runs the whole script
+    (already-committed pods are answered from the sidecar's cache)."""
+    import time as _time
+
+    from gen_golden_transcripts import scenario_objects
+
+    from kubernetes_tpu.faults import KillSwitch
+    from kubernetes_tpu.sidecar.host import ResyncingClient
+
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    lease, journal = _wire_lease_journal(
+        os.path.join(state_dir, "host-journal"), "wire-host"
+    )
+    client = ResyncingClient(
+        os.path.join(state_dir, "sidecar.sock"),
+        max_reconnect_s=60.0,
+        retry_interval_s=0.1,
+        deadline_s=DEADLINE_S,
+        max_call_retries=50,
+        breaker_threshold=10**9,
+        journal=journal,
+        journal_snapshot_every=4,
+    )
+    try:
+        nodes, bound, pending = scenario_objects()
+        for n in nodes:
+            client.add("Node", n)
+        for p in bound:
+            client.add("Pod", p)
+        client.schedule(pods=pending, drain=True)
+        client.remove("Pod", "default/bound-2")
+
+        def bindings() -> dict:
+            state = client.dump()
+            return {
+                uid: info["node"]
+                for uid, info in state.get("pods", {}).items()
+                if info.get("bound")
+            }
+
+        # Settle loop (the cross-process stand-in for wait_for_backoffs):
+        # drain until the binding map is stable across three rounds — the
+        # preemptor's nominated retry sits behind a backoff timer.
+        last, stable = None, 0
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline and stable < 3:
+            client.schedule(pods=[], drain=True)
+            cur = bindings()
+            if cur == last:
+                stable += 1
+            else:
+                last, stable = cur, 0
+            _time.sleep(0.3)
+        with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+            json.dump(last or {}, f, sort_keys=True)
+    finally:
+        client.close()
+        lease.release()
+
+
+def _spawn_bg(mode: str, state_dir: str, kill: str | None = None):
+    env = dict(os.environ)
+    env.pop("TPU_JOURNAL_KILL", None)
+    if kill:
+        env["TPU_JOURNAL_KILL"] = kill
+    # Flight auto-dumps (the recovery dump each killed cell must leave)
+    # land in the cell's state dir.
+    env["TPU_FLIGHT_DIR"] = state_dir
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mode, state_dir],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _wait_socket(state_dir: str, timeout_s: float = 30.0) -> bool:
+    """Wait until the sidecar is actually ACCEPTING on its socket.  A
+    bare existence check is dead code here: SIGKILL never unlinks the
+    unix socket file, so the stale path from the killed instance would
+    satisfy it before the restarted server has bound."""
+    import socket as _socket
+    import time as _time
+
+    path = os.path.join(state_dir, "sidecar.sock")
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        try:
+            s.connect(path)
+            return True
+        except OSError:
+            _time.sleep(0.05)
+        finally:
+            s.close()
+    return False
+
+
+def _flight_dump_ok(state_dir: str) -> bool:
+    """A readable recovery flight dump exists in the cell's state dir."""
+    import glob
+
+    for path in glob.glob(os.path.join(state_dir, "flight-*recovery*.json")):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if any(
+                r.get("event") == "recovery" for r in doc.get("records", [])
+            ):
+                return True
+        except (OSError, ValueError):
+            continue
+    return False
+
+
+def _run_wire_cell(state_dir: str, side: str | None, kill: str | None):
+    """One wire session: start sidecar + host children, restart whichever
+    side gets SIGKILLed, return (bindings, kill_fired)."""
+    import time as _time
+
+    os.makedirs(state_dir, exist_ok=True)
+    host = None
+    sidecar = _spawn_bg(
+        "--wire-sidecar-child", state_dir,
+        kill if side == "sidecar" else None,
+    )
+    try:
+        assert _wait_socket(state_dir), "sidecar socket never appeared"
+        host = _spawn_bg(
+            "--wire-host-child", state_dir, kill if side == "host" else None
+        )
+        kill_fired = False
+        while True:
+            rc = host.poll()
+            if sidecar.poll() is not None:
+                # The sidecar died (the armed kill, if targeting it).  A
+                # clean exit here is unexpected either way — restart it;
+                # recovery-before-first-frame brings the pre-crash world
+                # back and the host's resync replays the store.
+                kill_fired = kill_fired or sidecar.returncode == -9
+                sidecar = _spawn_bg("--wire-sidecar-child", state_dir)
+                if not _wait_socket(state_dir):
+                    return None, kill_fired
+            if rc is not None:
+                if rc == -9:
+                    # The host died mid-commit: restart it; cold-start
+                    # journal replay + idempotent scenario re-run.
+                    kill_fired = True
+                    host = _spawn_bg("--wire-host-child", state_dir)
+                    continue
+                if rc != 0:
+                    _out, err = host.communicate()
+                    sys.stderr.write(err or "")
+                    return None, kill_fired
+                break
+            _time.sleep(0.05)
+        return _read_bindings(state_dir), kill_fired
+    finally:
+        # Reap BOTH children on every exit path — an early return (a
+        # restarted sidecar that never binds) must not leak a host still
+        # writing into the about-to-be-deleted tempdir.
+        for proc in (host, sidecar):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def run_wire_kill_matrix(cases=WIRE_KILL_CASES, verbose=True) -> list[str]:
+    """SIGKILL host and sidecar independently at journal crash points in
+    a two-process wire deployment; assert bit-identical recovery AND a
+    readable flight dump per killed cell.  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "wire-baseline")
+        baseline, _fired = _run_wire_cell(base_dir, None, None)
+        assert baseline, "wire baseline produced no bindings"
+        failures = []
+        for side, point, nth in cases:
+            label = f"wirekill:{side}:{point}@{nth}"
+            state_dir = os.path.join(td, f"wire-{side}-{point}-{nth}")
+            got, fired = _run_wire_cell(state_dir, side, f"{point}:{nth}")
+            if got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: fired={fired} diff={diff}")
+                continue
+            if fired and not _flight_dump_ok(state_dir):
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: no readable recovery flight dump")
+                continue
+            if verbose:
+                status = "ok  " if fired else "ok (kill never fired)"
+                print(f"{status} {label}")
+        return failures
+
+
 def main() -> int:
     if "--kill-child" in sys.argv:
         kill_child(sys.argv[sys.argv.index("--kill-child") + 1])
@@ -369,14 +646,26 @@ def main() -> int:
     if "--recover-child" in sys.argv:
         recover_child(sys.argv[sys.argv.index("--recover-child") + 1])
         return 0
+    if "--wire-sidecar-child" in sys.argv:
+        wire_sidecar_child(
+            sys.argv[sys.argv.index("--wire-sidecar-child") + 1]
+        )
+        return 0
+    if "--wire-host-child" in sys.argv:
+        wire_host_child(sys.argv[sys.argv.index("--wire-host-child") + 1])
+        return 0
     if "--kill" in sys.argv:
         failures = run_kill_matrix()
+        # The wire-deployment subset rides --kill (the ROADMAP layer-0
+        # gap): host and sidecar SIGKILLed independently.
+        failures += run_wire_kill_matrix()
+        total = len(KILL_CASES) + len(WIRE_KILL_CASES)
         if failures:
-            print(f"{len(failures)} of {len(KILL_CASES)} kill cases diverged: {failures}")
+            print(f"{len(failures)} of {total} kill cases diverged: {failures}")
             return 1
         print(
-            f"all {len(KILL_CASES)} crash-matrix cases recovered to "
-            "bit-identical bindings"
+            f"all {total} crash-matrix cases (in-process + wire) "
+            "recovered to bit-identical bindings with flight dumps"
         )
         return 0
     # The full grid also sweeps nth=2 (the fault lands mid-session, after

@@ -16,6 +16,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Flight-recorder auto-dumps (engine faults, quarantines, breaker trips,
+# recoveries — paths the fault/journal suites exercise on purpose) land
+# in a per-session scratch dir instead of shedding files into /tmp.
+import atexit  # noqa: E402
+import tempfile  # noqa: E402
+
+if "TPU_FLIGHT_DIR" not in os.environ:
+    _flight_dir = tempfile.TemporaryDirectory(prefix="tpu-flight-tests-")
+    os.environ["TPU_FLIGHT_DIR"] = _flight_dir.name
+    atexit.register(_flight_dir.cleanup)
+
 
 def pytest_configure(config):
     # Markers used by the tier-1 selection (`-m 'not slow'`) and the

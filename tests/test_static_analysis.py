@@ -157,7 +157,7 @@ def test_wire_kinds_parse_from_the_real_proto():
 
     assert declared_kinds(text) == [
         "add", "remove", "schedule", "response", "dump", "subscribe",
-        "push", "health", "metrics", "events",
+        "push", "health", "metrics", "events", "flight",
     ]
 
 
@@ -272,3 +272,51 @@ def test_write_baseline_then_clean(tmp_path):
         timeout=60,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- metrics catalog (README "Metrics catalog" section) ---------------------
+
+CATALOG_BEGIN = "<!-- metrics-catalog:begin -->"
+CATALOG_END = "<!-- metrics-catalog:end -->"
+
+
+def _catalog_output() -> str:
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--catalog"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout.strip()
+
+
+def test_readme_metrics_catalog_matches_generator():
+    """README's catalog section is generated, not hand-maintained: the
+    committed table must be byte-identical to --catalog's output (the
+    regeneration flow: paste the new table between the markers)."""
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    assert CATALOG_BEGIN in readme and CATALOG_END in readme
+    section = readme.split(CATALOG_BEGIN, 1)[1].split(CATALOG_END, 1)[0]
+    assert section.strip() == _catalog_output()
+
+
+def test_catalog_names_and_labels_are_statically_complete():
+    """Every cataloged family carries a type and the known labeled
+    families carry their label keys — the static collection resolves
+    handles, not just literals."""
+    tp = check_lint.load_tpulint()
+    entries = {e["name"]: e for e in tp.collect_catalog(REPO)}
+    assert entries["scheduler_phase_duration_seconds"]["labels"] == ["phase"]
+    assert entries["scheduler_plugin_duration_seconds"]["labels"] == [
+        "extension_point", "plugin",
+    ]
+    assert entries["scheduler_events_total"]["labels"] == ["reason"]
+    assert entries["scheduler_schedule_attempts_total"]["labels"] == ["result"]
+    assert (
+        entries["scheduler_sidecar_round_trip_duration_seconds"]["labels"]
+        == ["call"]
+    )
+    for e in entries.values():
+        assert e["type"] in ("counter", "gauge", "histogram"), e
