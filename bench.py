@@ -78,6 +78,62 @@ def _lint_clean() -> bool | None:
         return None
 
 
+def _slo_block() -> dict | None:
+    """Serving percentiles for the trajectory: a short seeded in-process
+    soak (loadgen/) rides every headline payload from BENCH_r06 on, so
+    the recorded points carry p50/p99/p999 decision latency and the
+    speculation miss rate next to the throughput number.  Budget comes
+    from TPU_SLO_BUDGET_MS (default 250).  None when the soak itself
+    could not run — the headline must never die for its sidecar."""
+    try:
+        budget_ms = float(os.environ.get("TPU_SLO_BUDGET_MS", "250"))
+        from kubernetes_tpu.loadgen.soak import SoakConfig, run_soak
+
+        art = run_soak(
+            SoakConfig(
+                seed=6,
+                nodes=64,
+                zones=8,
+                churn_nodes=2,
+                rate_pods_per_s=100.0,
+                duration_s=4.0,
+                knee_points=(8.0,),
+                knee_phase_s=1.0,
+                invalidation_rate_per_s=0.25,
+                node_flap_period_s=0.0,
+                live_pod_cap=300,
+                slo_budget_ms=budget_ms,
+                batch_size=128,
+                chunk_size=32,
+                warm_pods=128,
+                two_process=False,
+                pace="virtual",
+                journal_fsync="never",
+            )
+        )
+    except Exception as exc:
+        print(f"bench: slo soak failed: {exc}", file=sys.stderr)
+        return None
+    slo = art["slo"]
+    block = {
+        "p50_ms": slo["p50_ms"],
+        "p99_ms": slo["p99_ms"],
+        "p999_ms": slo["p999_ms"],
+        "budget_ms": budget_ms,
+        "violations": slo["violations"],
+        "decisions": slo["decisions"],
+        "miss_rate": art["speculation"]["miss_rate"],
+    }
+    if block["p99_ms"] > budget_ms:
+        print(
+            f"bench: soak p99 {block['p99_ms']}ms exceeds the "
+            f"{budget_ms}ms SLO budget ({block['violations']} violations "
+            f"in {block['decisions']} decisions)",
+            file=sys.stderr,
+        )
+    return block
+
+
 def main() -> int:
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
 
@@ -110,6 +166,10 @@ def main() -> int:
                 "vs_baseline": r["vs_baseline"],
                 "journal_guard": guard,
                 "lint_clean": _lint_clean(),
+                # Serving percentiles (loadgen short soak): p50/p99/p999
+                # decision latency + speculation miss rate, with a
+                # stderr warning when p99 blows the configured budget.
+                "slo": _slo_block(),
                 # Per-phase attribution of the measured window (flight
                 # recorder tiling): which phase a future regression ate.
                 # coverage = tiled phases / measured wall time; the

@@ -8,6 +8,8 @@ Subcommands:
   recover --journal-dir DIR       offline recovery: rebuild scheduler state from
                                   snapshot + journal and print what survived
   bench [workload ...]            the scheduler_perf-style harness
+  soak [--seconds N ...]          open-loop traffic soak: SLO percentiles,
+                                  speculation miss-rate knee, journal growth
   dump --socket PATH              debugger state dump of a live sidecar
   metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
   flight --socket PATH            flight-recorder readout (per-batch phase attribution)
@@ -313,6 +315,58 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_soak(args) -> int:
+    """Open-loop soak (loadgen/): drive the deployment for --seconds at
+    --rate pods/s, then sweep the speculation miss-rate knee over
+    --knee-points invalidation intensities.  Prints the artifact JSON
+    (the SOAK_rNN.json schema) and optionally writes it to --out."""
+    from .loadgen.soak import SoakConfig, run_soak, strip_private
+
+    knee = tuple(
+        float(x) for x in args.knee_points.split(",") if x.strip()
+    )
+    cfg = SoakConfig(
+        seed=args.seed,
+        nodes=args.nodes,
+        zones=args.zones,
+        churn_nodes=args.churn_nodes,
+        rate_pods_per_s=args.rate,
+        diurnal=args.diurnal,
+        mix=args.mix,
+        duration_s=args.seconds,
+        knee_points=knee,
+        knee_phase_s=args.knee_phase,
+        invalidation_rate_per_s=args.invalidation_rate,
+        node_flap_period_s=args.flap_period,
+        flap_down_s=args.flap_down,
+        cold_consumer_period_s=args.cold_consumer_period,
+        live_pod_cap=args.live_pod_cap,
+        slo_budget_ms=args.slo_budget_ms,
+        batch_size=args.batch_size,
+        chunk_size=args.chunk_size,
+        two_process=not args.in_process,
+        journal_dir=args.journal_dir,
+        journal_fsync=args.journal_fsync,
+        snapshot_every=args.snapshot_every,
+        pace=args.pace,
+        out_dir=args.out_dir,
+    )
+    artifact = strip_private(run_soak(cfg))
+    doc = json.dumps(artifact, indent=1, sort_keys=True)
+    print(doc)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    if artifact["slo"]["p99_ms"] > cfg.slo_budget_ms:
+        print(
+            f"soak: p99 {artifact['slo']['p99_ms']}ms exceeds the "
+            f"{cfg.slo_budget_ms}ms SLO budget "
+            f"({artifact['slo']['violations']} violations)",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def _cli_deadline(args) -> float | None:
     return args.deadline if args.deadline and args.deadline > 0 else None
 
@@ -432,6 +486,52 @@ def main(argv: list[str] | None = None) -> int:
     b.add_argument("workloads", nargs="*")
     b.add_argument("--profile-dir", default="", help="write a jax.profiler trace here")
     b.set_defaults(fn=cmd_bench)
+
+    sk = sub.add_parser(
+        "soak", help="open-loop traffic soak (SLO percentiles + knee)"
+    )
+    sk.add_argument("--seed", type=int, default=6)
+    sk.add_argument("--seconds", type=float, default=60.0,
+                    help="sustained-phase duration (the SLO window)")
+    sk.add_argument("--rate", type=float, default=60.0,
+                    help="mean arrival rate, pods/s (open-loop)")
+    sk.add_argument("--nodes", type=int, default=200)
+    sk.add_argument("--zones", type=int, default=10)
+    sk.add_argument("--churn-nodes", type=int, default=8)
+    sk.add_argument("--mix", default="basic",
+                    help="workload mix (loadgen.workloads.MIXES)")
+    sk.add_argument("--diurnal", action="store_true",
+                    help="diurnally-modulated arrivals instead of flat Poisson")
+    sk.add_argument("--knee-points", default="0.5,2,8,32,128", metavar="R,R,...",
+                    help="invalidation intensities (events/s) for the knee sweep")
+    sk.add_argument("--knee-phase", type=float, default=20.0,
+                    help="seconds per knee intensity point")
+    sk.add_argument("--invalidation-rate", type=float, default=0.1,
+                    help="baseline invalidation events/s during the sustained phase")
+    sk.add_argument("--flap-period", type=float, default=30.0,
+                    help="seconds between node flaps (0 disables)")
+    sk.add_argument("--flap-down", type=float, default=2.0)
+    sk.add_argument("--cold-consumer-period", type=float, default=0.0,
+                    help="seconds between cold push-consumer restarts (0 disables)")
+    sk.add_argument("--live-pod-cap", type=int, default=2000,
+                    help="bound pods beyond this retire oldest-first")
+    sk.add_argument("--slo-budget-ms", type=float, default=250.0)
+    sk.add_argument("--batch-size", type=int, default=512)
+    sk.add_argument("--chunk-size", type=int, default=64)
+    sk.add_argument("--in-process", action="store_true",
+                    help="host the sidecar in-process instead of spawning serve")
+    sk.add_argument("--journal-dir", default="",
+                    help="journal directory (default: a run-scoped temp dir)")
+    sk.add_argument("--journal-fsync", choices=("always", "never"),
+                    default="always")
+    sk.add_argument("--snapshot-every", type=int, default=64)
+    sk.add_argument("--pace", choices=("real", "virtual"), default="real",
+                    help="real = follow the arrival schedule's wall deadlines; "
+                    "virtual = issue back to back (determinism checks)")
+    sk.add_argument("--out", default="", help="also write the artifact JSON here")
+    sk.add_argument("--out-dir", default="",
+                    help="flight-dump / artifact directory (default: temp)")
+    sk.set_defaults(fn=cmd_soak)
 
     d = sub.add_parser("dump", help="debugger dump of a live sidecar")
     d.add_argument("--socket", required=True)

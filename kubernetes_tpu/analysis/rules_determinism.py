@@ -2,13 +2,18 @@
 
 The parity story (wire == degraded == crash-recovery bindings, the A/B
 oracle, the golden transcripts, PYTHONHASHSEED-proof push fixtures) all
-assume the batch engine is a pure function of its inputs.  Code in
-``ops/``, ``engine/`` and the speculative frontend therefore must not:
+assume the batch engine is a pure function of its inputs — and the soak
+story (loadgen/) assumes the TRAFFIC is too: a generator whose arrivals
+read wall clocks or ambient entropy cannot replay, so same-seed soaks
+could never assert bit-identical bindings.  Code in ``ops/``,
+``engine/``, ``loadgen/`` and the speculative frontend therefore must
+not:
 
 - read wall clocks (``time.time``/``time_ns``, ``datetime.now``/
   ``utcnow``) — ``time.perf_counter``/``monotonic`` stay allowed: they
   feed latency metrics, never decisions;
-- draw entropy (``random.*``, ``os.urandom``, ``uuid.uuid4``);
+- draw entropy (``random.*``, ``os.urandom``, ``uuid.uuid4``) — seeded
+  ``numpy.random.Generator`` streams are the loadgen idiom and pass;
 - iterate a bare set where the element order can reach an output —
   syntactically visible set expressions (literals, comprehensions,
   ``set()``/``frozenset()`` calls, unions of those) used directly as a
@@ -61,7 +66,7 @@ class DeterminismRule(Rule):
 
     def files(self, root) -> list[str]:
         rels = ["kubernetes_tpu/sidecar/speculate.py"]
-        for sub in ("ops", "engine"):
+        for sub in ("ops", "engine", "loadgen"):
             top = os.path.join(root, "kubernetes_tpu", sub)
             # Recursive: a future subpackage under ops/ or engine/ must not
             # silently escape the determinism contract.

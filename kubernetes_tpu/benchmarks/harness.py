@@ -146,8 +146,8 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
     # per-batch tiled segments (featurize/device/commit/snapshot/other)
     # summed from the scheduler_phase_duration_seconds family — their sum
     # over wall time is the coverage the bench guard reports (journal
-    # append/fsync are sub-slices of the tiled phases and stay out of the
-    # sum).
+    # append/fsync and the speculative frontend's hint_decode are
+    # sub-slices of / overlap the tiled phases and stay out of the sum).
     phases: dict[str, float] = {}
     fam = m.registry.histograms.get("scheduler_phase_duration_seconds")
     if fam is not None:
@@ -157,7 +157,7 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
                 phases[label] = round(h.total, 6)
     tiled = sum(
         v for k, v in phases.items()
-        if k not in ("journal_append", "journal_fsync")
+        if k not in ("journal_append", "journal_fsync", "hint_decode")
     )
     phase_attribution = {
         "phases": phases,

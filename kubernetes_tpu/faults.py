@@ -36,8 +36,10 @@ Process-kill faults (the crash analog of the wire matrix, PR 3): a
 ``KillSwitch`` SIGKILLs the process at a named crash point inside the
 write-ahead journal (journal.py) — ``pre-append`` (decision lost),
 ``post-append`` (durable but unapplied), ``torn-append`` (half a record
-on disk), ``mid-snapshot`` (torn checkpoint temp), ``mid-truncate``
-(snapshot replaced, log not yet truncated).  Armed from the environment
+on disk), ``pre-snapshot`` (compaction about to start), ``mid-snapshot``
+(torn checkpoint temp), ``mid-truncate`` (snapshot replaced, log not
+yet truncated), ``post-truncate`` (compaction cycle just completed).
+Armed from the environment
 (``TPU_JOURNAL_KILL=point:nth``) so a child process under
 scripts/run_fault_matrix.py --kill dies exactly once, at exactly the
 probed window; the parent then recovers a fresh process from the journal
@@ -182,8 +184,8 @@ class FaultPlan:
 
 
 KILL_POINTS = (
-    "pre-append", "post-append", "torn-append", "mid-snapshot",
-    "mid-truncate",
+    "pre-append", "post-append", "torn-append", "pre-snapshot",
+    "mid-snapshot", "mid-truncate", "post-truncate",
 )
 
 

@@ -237,6 +237,7 @@ class Journal:
         if self.muted:
             return
         self._check_fence()
+        _crash("pre-snapshot")
         doc = {"epoch": self.epoch, "seq": self.seq, "state": state}
         blob = json.dumps(doc, separators=(",", ":")).encode()
         tmp = self.snap_path + ".tmp"
@@ -265,6 +266,7 @@ class Journal:
             os.fsync(self._f.fileno())
         self._expected_size = 0
         self.truncations += 1
+        _crash("post-truncate")
 
     def _fsync_dir(self) -> None:
         try:

@@ -1,0 +1,28 @@
+"""Open-loop traffic generator + soak subsystem.
+
+``arrivals`` draws seeded Poisson/diurnal arrival schedules,
+``workloads`` mixes the sweep families' pod shapes, ``scenarios``
+scripts fault/churn/invalidation events, and ``soak`` drives the real
+deployment (two-process ``serve --journal-dir --speculate`` or an
+in-process server) against them, recording SLO latency percentiles, the
+speculation miss-rate knee, and journal growth.  Everything is a pure
+function of the seed — tpulint's determinism family covers this package.
+"""
+
+from .arrivals import coalesce, diurnal_offsets, poisson_offsets
+from .scenarios import build_events
+from .soak import PushConsumer, SoakConfig, run_soak, strip_private
+from .workloads import MIXES, WorkloadMix
+
+__all__ = [
+    "MIXES",
+    "PushConsumer",
+    "SoakConfig",
+    "WorkloadMix",
+    "build_events",
+    "coalesce",
+    "diurnal_offsets",
+    "poisson_offsets",
+    "run_soak",
+    "strip_private",
+]

@@ -1,0 +1,117 @@
+"""Open-loop arrival processes for the traffic generator.
+
+Every number the repo recorded before this PR came from one-shot replays:
+a fixed pod population drained as fast as the scheduler can go.  A
+production control plane is not drained — it is *arrived at*: pods show
+up on their own clock, whether or not the scheduler is keeping up.  The
+difference is the whole point of an OPEN-LOOP generator (the
+methodology scheduler_perf's closed drains cannot express, and the one
+robust-scheduling work evaluates against — a policy's value shows under
+shifted arrival distributions, not a single trace): the arrival schedule
+is drawn AHEAD OF TIME from the process below, so a slow scheduler
+builds backlog and its latency percentiles degrade honestly instead of
+the load politely waiting.
+
+Determinism contract (enforced by tpulint's determinism family, which
+covers this package): every schedule is a pure function of its
+``(seed, parameters)`` — seeded ``numpy.random.Generator`` only, no wall
+clocks, no ambient entropy.  Re-running a soak with the same seed
+replays the exact same arrival offsets, which is what makes a soak's
+final bindings reproducible end to end.
+
+Two processes:
+
+- ``poisson_offsets``: homogeneous Poisson at ``rate_per_s`` —
+  exponential inter-arrival gaps, the memoryless baseline.
+- ``diurnal_offsets``: a non-homogeneous Poisson whose rate swings
+  sinusoidally between ``base_rate`` and ``peak_rate`` over ``period_s``
+  (the day/night curve of real traffic), realized by Lewis-Shedler
+  thinning: draw candidates at the peak rate, keep each with probability
+  ``rate(t)/peak`` — exact, and still a pure function of the seed.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _rng(seed: int) -> np.random.Generator:
+    """The one RNG constructor every loadgen module uses: an explicit
+    PCG64 stream keyed by the seed, so schedules are stable across numpy
+    versions that re-tune ``default_rng``."""
+    return np.random.Generator(np.random.PCG64(int(seed)))
+
+
+def poisson_offsets(
+    rate_per_s: float, duration_s: float, seed: int
+) -> list[float]:
+    """Arrival offsets (seconds from phase start, ascending) of a
+    homogeneous Poisson process over ``[0, duration_s)``."""
+    if rate_per_s <= 0 or duration_s <= 0:
+        return []
+    rng = _rng(seed)
+    out: list[float] = []
+    t = 0.0
+    # Draw gaps in chunks (vectorized) until the horizon is passed; the
+    # draw COUNT consumed from the stream depends only on the draws
+    # themselves, so the schedule stays a pure function of the seed.
+    chunk = max(16, int(rate_per_s * duration_s * 1.25) + 16)
+    while True:
+        for gap in rng.exponential(1.0 / rate_per_s, size=chunk):
+            t += float(gap)
+            if t >= duration_s:
+                return out
+            out.append(round(t, 9))
+        chunk = max(16, chunk // 4)
+
+
+def diurnal_rate(
+    t: float, base_rate: float, peak_rate: float, period_s: float
+) -> float:
+    """The instantaneous rate of the diurnal curve: ``base`` at t=0,
+    cresting to ``peak`` half a period in."""
+    swing = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+    return base_rate + (peak_rate - base_rate) * swing
+
+
+def diurnal_offsets(
+    base_rate: float,
+    peak_rate: float,
+    period_s: float,
+    duration_s: float,
+    seed: int,
+) -> list[float]:
+    """Arrival offsets of the diurnally-modulated Poisson process
+    (Lewis-Shedler thinning at ``peak_rate``)."""
+    if peak_rate <= 0 or duration_s <= 0:
+        return []
+    if peak_rate < base_rate:
+        raise ValueError("peak_rate must be >= base_rate")
+    rng = _rng(seed)
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak_rate))
+        if t >= duration_s:
+            return out
+        accept = diurnal_rate(t, base_rate, peak_rate, period_s) / peak_rate
+        if float(rng.random()) < accept:
+            out.append(round(t, 9))
+
+
+def coalesce(
+    offsets: list[float], window_s: float
+) -> list[tuple[float, list[int]]]:
+    """Group arrival indices into hint-coalescing windows: one
+    ``(window_start, [arrival indices])`` entry per non-empty window.
+    This is the flusher-goroutine shape the sidecar's ``PendingPods``
+    frame exists for — the informer fires per pod, but hints ship as one
+    array frame per window."""
+    if window_s <= 0:
+        return [(off, [i]) for i, off in enumerate(offsets)]
+    windows: dict[int, list[int]] = {}
+    for i, off in enumerate(offsets):
+        windows.setdefault(int(off / window_s), []).append(i)
+    return [(w * window_s, idxs) for w, idxs in sorted(windows.items())]

@@ -1,0 +1,150 @@
+"""Fault/churn scenario scripts for the soak driver.
+
+A soak that only arrives pods proves throughput, not robustness.  These
+scripts inject the events a production control plane actually sees —
+node flaps, consumers restarting cold, and mutation mixes chosen to be
+ADVERSARIAL to the speculative frontend's decision cache
+(sidecar/speculate.py's scoped-invalidation rules) — as a seeded,
+replayable event list the driver merges into the arrival schedule.
+
+Invalidation kinds, by blast radius against the cache:
+
+- ``inv_label``    — re-add a node with a changed label value.  Labels
+  remap topology domains, so the frontend's documented fallback is a
+  FULL rollback: every cached decision recomputes.  This is the
+  worst-case event the miss-rate knee is measured against.
+- ``inv_capacity`` — re-add a node with its allocatable cpu nudged.  A
+  capacity-only change invalidates decisions ON that node plus
+  unschedulable verdicts — the scoped path.
+- ``inv_ns``       — flip a namespace label.  Stales domain-dependent
+  decisions and unschedulable verdicts (namespaceSelector matching);
+  affinity-free mixes shrug it off, which is exactly the scoping the
+  knee curve should show.
+
+Every script is a pure function of ``(seed, parameters)`` (seeded
+``numpy.random.Generator``; offsets derive from the same arrival
+machinery), so a re-run replays the identical event sequence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .arrivals import _rng, poisson_offsets
+
+# Invalidation mix: (kind, weight).  Label rewrites are deliberately the
+# minority — one full rollback stales everything, so an even mix would
+# drown the scoped kinds' signal.
+DEFAULT_INV_MIX: tuple[tuple[str, float], ...] = (
+    ("inv_capacity", 0.6),
+    ("inv_label", 0.25),
+    ("inv_ns", 0.15),
+)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One scripted occurrence at ``t`` seconds into the phase.
+    ``data`` is kind-specific: node index for flaps/invalidations, a
+    counter for namespace flips and cold consumers."""
+
+    t: float
+    kind: str
+    data: int = 0
+
+
+def invalidation_events(
+    rate_per_s: float,
+    duration_s: float,
+    seed: int,
+    *,
+    nodes: int,
+    mix: tuple[tuple[str, float], ...] = DEFAULT_INV_MIX,
+) -> list[Event]:
+    """A Poisson stream of invalidation events at ``rate_per_s`` — the
+    knob the miss-rate knee sweep turns."""
+    offsets = poisson_offsets(rate_per_s, duration_s, seed)
+    if not offsets:
+        return []
+    rng = _rng(seed + 1)  # kind/target stream, distinct from the offsets
+    kinds = [k for k, _w in mix]
+    total = sum(w for _k, w in mix)
+    weights = [w / total for _k, w in mix]
+    out = []
+    for off in offsets:
+        kind = str(rng.choice(kinds, p=weights))
+        target = int(rng.integers(0, nodes))
+        out.append(Event(t=off, kind=kind, data=target))
+    return out
+
+
+def node_flap_events(
+    period_s: float,
+    down_s: float,
+    duration_s: float,
+    *,
+    churn_nodes: int,
+) -> list[Event]:
+    """Periodic node flaps over a dedicated churn pool: every
+    ``period_s`` one churn node goes down (its bound pods vanish with
+    it — the engine's remove contract) and returns ``down_s`` later.
+    Round-robin over the pool, so flaps never overlap on one node."""
+    if period_s <= 0 or churn_nodes <= 0:
+        return []
+    out = []
+    k = 0
+    t = period_s
+    while t < duration_s:
+        node = k % churn_nodes
+        out.append(Event(t=t, kind="flap_down", data=node))
+        if t + down_s < duration_s:
+            out.append(Event(t=t + down_s, kind="flap_up", data=node))
+        k += 1
+        t += period_s
+    return out
+
+
+def cold_consumer_events(period_s: float, duration_s: float) -> list[Event]:
+    """Periodic push-consumer restarts: the driver drops its decision
+    map mid-stream and subscribes a fresh (cold) connection — the
+    plugin-process-restart shape.  A cold consumer misses to the wire
+    until the push stream re-warms its map; the soak's hit rate carries
+    the cost honestly."""
+    if period_s <= 0:
+        return []
+    out = []
+    k = 0
+    t = period_s
+    while t < duration_s:
+        out.append(Event(t=t, kind="cold_consumer", data=k))
+        k += 1
+        t += period_s
+    return out
+
+
+def build_events(
+    duration_s: float,
+    seed: int,
+    *,
+    nodes: int,
+    churn_nodes: int = 0,
+    invalidation_rate_per_s: float = 0.0,
+    inv_mix: tuple[tuple[str, float], ...] = DEFAULT_INV_MIX,
+    node_flap_period_s: float = 0.0,
+    flap_down_s: float = 1.0,
+    cold_consumer_period_s: float = 0.0,
+) -> list[Event]:
+    """One phase's full scenario script, merged and time-ordered.
+    Ties break by (kind, data) so the order is total and seed-stable."""
+    events = (
+        invalidation_events(
+            invalidation_rate_per_s, duration_s, seed, nodes=nodes,
+            mix=inv_mix,
+        )
+        + node_flap_events(
+            node_flap_period_s, flap_down_s, duration_s,
+            churn_nodes=churn_nodes,
+        )
+        + cold_consumer_events(cold_consumer_period_s, duration_s)
+    )
+    return sorted(events, key=lambda e: (e.t, e.kind, e.data))

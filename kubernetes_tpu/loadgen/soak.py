@@ -1,0 +1,788 @@
+"""The soak harness: drive the deployment with open-loop traffic and
+measure it like a service.
+
+One-shot replays answer "how fast can it drain"; this answers the
+production questions the ROADMAP's sustained-traffic item asks:
+
+- **SLO percentiles** — per-decision serving latency (p50/p99/p999)
+  against a configured budget, measured open-loop: arrivals come on
+  their own schedule (arrivals.py), so a scheduler falling behind
+  accrues backlog and its tail degrades honestly.
+- **The speculation miss-rate knee** — a decision-cache miss costs a
+  full wire round trip + device pass (~195 ms in the recorded
+  integrated_serial row) while a hit costs a local map pop.  The knee
+  sweep ramps the invalidation intensity (scenarios.py) across phases
+  and records where the hit rate collapses and the latency crosses the
+  miss cost — the number nothing measured before this PR.
+- **Journal growth under an unbounded stream** — the driver retires old
+  bound pods (the live-pod cap) so binds+deletes append forever; the
+  WAL must stay bounded through snapshot+truncate compaction cycles
+  (journal.py), observed directly as the sampled ``journal.wal`` size.
+
+Determinism: the full wire-operation sequence (hints, per-pod decisions,
+retirements, scenario events) is a pure function of the seed — events
+execute in pre-computed schedule order, and real-time pacing only delays
+WHEN an operation is issued, never which or in what order.  Re-running
+with one seed therefore reproduces the arrival schedule exactly and
+lands bit-identical final bindings, in either pacing mode.  The
+deterministic push consumer below is part of that contract: pushes are
+written to the subscriber socket under the dispatch lock BEFORE the
+triggering call's response, so once a wire call returns, every frame it
+caused is already buffered — a non-blocking drain sees a deterministic
+prefix of the stream (the threaded ``DecisionCache`` trades that for
+always-on draining; the single-threaded driver doesn't need it).
+
+Deployments: ``two_process=True`` spawns the real ``serve
+--journal-dir --speculate`` CLI as a child and drives it over the unix
+socket (the acceptance configuration); ``two_process=False`` hosts the
+SidecarServer in-process (tier-1 smoke, bench.py's slo block).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from ..api.wrappers import make_node, make_pod
+from ..framework.metrics import MetricsRegistry
+from ..journal import Journal
+from ..sidecar.host import DecisionCache, ResyncingClient
+from ..sidecar.server import SidecarClient
+from .arrivals import coalesce, diurnal_offsets, poisson_offsets
+from .scenarios import DEFAULT_INV_MIX, build_events
+from .workloads import WorkloadMix
+
+
+@dataclass
+class SoakConfig:
+    seed: int = 6
+    # Fleet: `nodes` serving nodes + `churn_nodes` flap targets.
+    nodes: int = 200
+    zones: int = 10
+    churn_nodes: int = 8
+    # Arrivals (open-loop).
+    rate_pods_per_s: float = 60.0
+    diurnal: bool = False
+    diurnal_peak_factor: float = 2.0  # peak = factor × base rate
+    diurnal_period_s: float = 120.0
+    hint_coalesce_s: float = 0.25
+    mix: str = "basic"
+    # Phases: one sustained phase (the SLO source), then the knee sweep.
+    duration_s: float = 60.0
+    knee_points: tuple[float, ...] = (0.5, 2.0, 8.0, 32.0, 128.0)
+    knee_phase_s: float = 20.0
+    # Background churn during EVERY phase.
+    invalidation_rate_per_s: float = 0.1
+    node_flap_period_s: float = 30.0
+    flap_down_s: float = 2.0
+    cold_consumer_period_s: float = 0.0
+    # The unbounded-stream bound: completed (bound) pods beyond this cap
+    # retire oldest-first, so capacity recycles and the journal sees a
+    # perpetual bind+delete append stream.
+    live_pod_cap: int = 2000
+    # SLO.
+    slo_budget_ms: float = 250.0
+    # Engine shape.
+    batch_size: int = 512
+    chunk_size: int = 64
+    warm_pods: int = 256
+    # Deployment.
+    two_process: bool = False
+    journal_dir: str = ""  # empty → a temp dir (two-process always journals)
+    journal_fsync: str = "always"
+    snapshot_every: int = 64
+    # "real" paces operations to the arrival schedule's wall deadlines
+    # (latency includes backlog); "virtual" issues them back to back
+    # (latency = service time) — same operation sequence either way.
+    pace: str = "real"
+    # Artifact directory (flight dumps, final flight ring); empty → temp.
+    out_dir: str = ""
+
+
+def _sha(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _pct(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+def _lat_summary(values: list[float]) -> dict:
+    return {
+        "decisions": len(values),
+        "p50_ms": round(_pct(values, 50) * 1e3, 3),
+        "p99_ms": round(_pct(values, 99) * 1e3, 3),
+        "p999_ms": round(_pct(values, 99.9) * 1e3, 3),
+        "mean_ms": round(
+            float(np.mean(values)) * 1e3 if values else 0.0, 3
+        ),
+        "max_ms": round(max(values) * 1e3 if values else 0.0, 3),
+    }
+
+
+class PushConsumer:
+    """Single-threaded push-stream consumer (the deterministic sibling
+    of ``DecisionCache``): subscribes its own connection and drains
+    whatever is already buffered, non-blocking.  Apply semantics are the
+    stream contract shared with DecisionCache._apply — invalidations
+    first, then the epoch, then the frame's decisions."""
+
+    def __init__(self, path: str):
+        self.client = SidecarClient(path)
+        self.client.subscribe()
+        self.sock = self.client.sock
+        self.sock.setblocking(False)
+        self.buf = bytearray()
+        self.map: dict = {}
+        self.epoch = 0
+        self.frames = 0
+        self.dead = False
+
+    def drain_available(self) -> int:
+        """Apply every complete frame currently buffered (never blocks).
+        Frames a completed wire call emitted are guaranteed present —
+        the sidecar wrote them before that call's response."""
+        if self.dead:
+            return 0
+        while True:
+            try:
+                chunk = self.sock.recv(1 << 20)
+            except BlockingIOError:
+                break
+            except OSError:
+                self.dead = True
+                break
+            if not chunk:  # EOF: the stream is a dead epoch
+                self.dead = True
+                break
+            self.buf += chunk
+        frames, self.buf = DecisionCache._frames_from(self.buf)
+        for push in frames:
+            if push.invalidate_all:
+                self.map.clear()
+            for uid in push.invalidate_uids:
+                self.map.pop(uid, None)
+            self.epoch = push.epoch
+            for d in push.decisions:
+                self.map[d.pod_uid] = d
+        self.frames += len(frames)
+        return len(frames)
+
+    def pop(self, uid: str):
+        return self.map.pop(uid, None)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+@dataclass
+class _PhaseResult:
+    name: str
+    invalidation_rate_per_s: float
+    wall_s: float = 0.0
+    decisions: int = 0
+    bound: int = 0
+    hits: int = 0
+    misses: int = 0
+    latencies: list = field(default_factory=list)
+    miss_latencies: list = field(default_factory=list)
+    violations: int = 0
+    retired: int = 0
+    events_applied: dict = field(default_factory=dict)
+
+
+class _Driver:
+    """One soak run's host side: the ResyncingClient, the push consumer,
+    the retirement window, and the journal-size sampler."""
+
+    def __init__(self, cfg: SoakConfig, sock: str, journal_dir: str):
+        self.cfg = cfg
+        self.registry = MetricsRegistry()
+        # The SLO families (README metrics catalog): per-decision serving
+        # latency by phase, violations against the budget, the budget.
+        self._slo_hist = self.registry.histogram(
+            "scheduler_slo_decision_latency_seconds",
+            "Per-decision serving latency of the open-loop soak driver "
+            "(arrival deadline to decision), by phase.",
+        )
+        self._slo_violations = self.registry.counter(
+            "scheduler_slo_violations_total",
+            "Soak decisions whose serving latency exceeded the SLO "
+            "budget.",
+        )
+        self.registry.gauge(
+            "scheduler_slo_budget_seconds",
+            "Configured SLO latency budget for the soak driver.",
+        ).set(cfg.slo_budget_ms / 1e3)
+        self.client = ResyncingClient(
+            sock, deadline_s=120.0, seed=cfg.seed, registry=self.registry
+        )
+        self.consumer = PushConsumer(sock)
+        self.cold_consumers = 0
+        self.journal_dir = journal_dir
+        self.wal_samples: list[int] = []
+        self.compactions_observed = 0
+        self._wal_prev = 0
+        # Node objects by name (re-adds must diff against the live shape).
+        self.node_objs: dict[str, object] = {}
+        self._cap_toggle: dict[int, int] = {}
+        self._label_epoch: dict[int, int] = {}
+        self._ns_epoch = 0
+        self.mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+        self.pods_by_uid: dict[str, object] = {}
+        # Bound uids, oldest first.  A deque: the retirement window
+        # front-pops once per decision at steady state, and an O(n)
+        # list.pop(0) over live_pod_cap entries would tax the paced
+        # serving path itself.
+        self.live: deque[str] = deque()
+        self.retired = 0
+
+    # -- fleet -------------------------------------------------------------
+
+    def _serving_node(self, i: int, cpu: str = "16", label_epoch: int = 0):
+        w = (
+            make_node(f"lgn-{i}")
+            .capacity({"cpu": cpu, "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % self.cfg.zones}")
+            .region("region-1")
+        )
+        if label_epoch:
+            w = w.label("loadgen.tpu/epoch", str(label_epoch))
+        return w.obj()
+
+    def _churn_node(self, i: int):
+        return (
+            make_node(f"churn-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % self.cfg.zones}")
+            .region("region-1")
+            .obj()
+        )
+
+    def build_fleet(self) -> None:
+        for i in range(self.cfg.nodes):
+            n = self._serving_node(i)
+            self.node_objs[n.metadata.name] = n
+            self.client.add("Node", n)
+        for i in range(self.cfg.churn_nodes):
+            n = self._churn_node(i)
+            self.node_objs[n.metadata.name] = n
+            self.client.add("Node", n)
+
+    def warmup(self) -> None:
+        """Compile the device programs and the speculative machinery out
+        of the measured window, then retire the warm wave so phase 0
+        starts from an empty live set (and the deletes are exercised
+        before anything is measured)."""
+        warm = [
+            make_pod(f"lgwarm-{i}")
+            .req({"cpu": "50m", "memory": "64Mi"})
+            .obj()
+            for i in range(self.cfg.warm_pods)
+        ]
+        half = len(warm) // 2
+        self.client.add_pending_batch(warm[:half])
+        for p in warm[:half]:
+            self.client.schedule([p], drain=False)
+        if len(warm) > half:
+            self.client.schedule(warm[half:], drain=True)
+        for p in warm:
+            self.client.remove("Pod", p.uid)
+        self.consumer.drain_available()
+        self.consumer.map.clear()
+
+    # -- scenario application ----------------------------------------------
+
+    def apply_event(self, ev) -> None:
+        if ev.kind == "inv_capacity":
+            i = ev.data % self.cfg.nodes
+            self._cap_toggle[i] = 1 - self._cap_toggle.get(i, 0)
+            n = self._serving_node(
+                i,
+                cpu="15" if self._cap_toggle[i] else "16",
+                label_epoch=self._label_epoch.get(i, 0),
+            )
+            self.node_objs[n.metadata.name] = n
+            self.client.add("Node", n)
+        elif ev.kind == "inv_label":
+            i = ev.data % self.cfg.nodes
+            self._label_epoch[i] = self._label_epoch.get(i, 0) + 1
+            n = self._serving_node(
+                i,
+                cpu="15" if self._cap_toggle.get(i) else "16",
+                label_epoch=self._label_epoch[i],
+            )
+            self.node_objs[n.metadata.name] = n
+            self.client.add("Node", n)
+        elif ev.kind == "inv_ns":
+            self._ns_epoch += 1
+            self.client.set_namespace_labels(
+                "loadgen-churn", {"epoch": str(self._ns_epoch)}
+            )
+        elif ev.kind == "flap_down":
+            name = f"churn-{ev.data}"
+            # The node's bound pods vanish with it (engine contract);
+            # drop them from the retirement window too.
+            gone = {
+                uid
+                for uid in self.live
+                if getattr(
+                    self.pods_by_uid.get(uid), "_lg_node", None
+                ) == name
+            }
+            if gone:
+                self.live = deque(
+                    u for u in self.live if u not in gone
+                )
+                for u in gone:
+                    self.pods_by_uid.pop(u, None)
+            self.client.remove("Node", name)
+        elif ev.kind == "flap_up":
+            n = self._churn_node(ev.data)
+            self.node_objs[n.metadata.name] = n
+            self.client.add("Node", n)
+        elif ev.kind == "cold_consumer":
+            # The push consumer restarts cold mid-stream: decision map
+            # gone, fresh subscription, misses until the stream re-warms.
+            self.consumer.close()
+            self.consumer = PushConsumer(self.client.path)
+            self.cold_consumers += 1
+        else:
+            raise ValueError(f"unknown scenario event {ev.kind!r}")
+
+    # -- decisions ----------------------------------------------------------
+
+    def decide(self, pod, res: _PhaseResult, deadline: float | None) -> None:
+        """Serve one arrival: local map first (the plugin's PreFilter
+        path), wire on miss.  Latency is measured from the arrival's
+        schedule deadline (real pace — backlog included) or from issue
+        (virtual pace)."""
+        uid = pod.uid
+        t_issue = time.perf_counter()
+        self.consumer.drain_available()
+        d = self.consumer.pop(uid)
+        node = None
+        if d is None:
+            res.misses += 1
+            results = self.client.schedule([pod], drain=False)
+            for r in results:
+                if r.pod_uid == uid and r.node_name:
+                    node = r.node_name
+            self.consumer.drain_available()
+            t_done = time.perf_counter()
+            res.miss_latencies.append(t_done - t_issue)
+        else:
+            res.hits += 1
+            node = d.node_name or None
+            t_done = time.perf_counter()
+        base = t_issue if deadline is None else min(deadline, t_issue)
+        lat = t_done - base
+        res.latencies.append(lat)
+        self._slo_hist.observe(lat, phase=res.name)
+        if lat > self.cfg.slo_budget_ms / 1e3:
+            res.violations += 1
+            self._slo_violations.inc(phase=res.name)
+        res.decisions += 1
+        if node:
+            res.bound += 1
+            pod._lg_node = node
+            self.pods_by_uid[uid] = pod
+            self.live.append(uid)
+            while len(self.live) > self.cfg.live_pod_cap:
+                old = self.live.popleft()
+                self.pods_by_uid.pop(old, None)
+                self.client.remove("Pod", old)
+                res.retired += 1
+                self.retired += 1
+
+    # -- journal growth ------------------------------------------------------
+
+    def sample_wal(self) -> None:
+        if not self.journal_dir:
+            return
+        try:
+            size = os.path.getsize(
+                os.path.join(self.journal_dir, Journal.WAL)
+            )
+        except OSError:
+            size = 0
+        if size < self._wal_prev:
+            # Truncation happened between samples: one observed
+            # compaction cycle (snapshot + truncate).
+            self.compactions_observed += 1
+        self._wal_prev = size
+        self.wal_samples.append(size)
+
+    def close(self) -> None:
+        try:
+            self.consumer.close()
+        except OSError:
+            pass
+        self.client.close()
+
+
+def _phase_specs(cfg: SoakConfig) -> list[tuple[str, float, float]]:
+    specs = [("sustained", cfg.duration_s, cfg.invalidation_rate_per_s)]
+    for k, rate in enumerate(cfg.knee_points):
+        specs.append((f"knee-{k}", cfg.knee_phase_s, float(rate)))
+    return specs
+
+
+def _run_phase(
+    driver: _Driver,
+    cfg: SoakConfig,
+    phase_index: int,
+    name: str,
+    duration_s: float,
+    inv_rate: float,
+    arrival_base: int,
+) -> tuple[_PhaseResult, list[float]]:
+    """Merge the phase's arrival schedule, hint windows, and scenario
+    script into one time-ordered operation list and execute it."""
+    seed = cfg.seed * 1_000_003 + phase_index
+    if cfg.diurnal:
+        offsets = diurnal_offsets(
+            cfg.rate_pods_per_s,
+            cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
+            cfg.diurnal_period_s,
+            duration_s,
+            seed,
+        )
+    else:
+        offsets = poisson_offsets(cfg.rate_pods_per_s, duration_s, seed)
+    pods = [driver.mix.pod(arrival_base + i) for i in range(len(offsets))]
+    scenario = build_events(
+        duration_s,
+        seed + 500_009,
+        nodes=cfg.nodes,
+        churn_nodes=cfg.churn_nodes,
+        invalidation_rate_per_s=inv_rate,
+        inv_mix=DEFAULT_INV_MIX,
+        node_flap_period_s=cfg.node_flap_period_s,
+        flap_down_s=cfg.flap_down_s,
+        cold_consumer_period_s=cfg.cold_consumer_period_s,
+    )
+    # Merge: (t, class, idx) — hints flush at their window start ahead
+    # of same-instant decisions; scenario events order between them by
+    # their own timestamps.  The tuple sort is total and seed-stable.
+    ops: list[tuple[float, int, int, object]] = []
+    for w_start, idxs in coalesce(offsets, cfg.hint_coalesce_s):
+        ops.append((w_start, 0, idxs[0], idxs))
+    for j, ev in enumerate(scenario):
+        ops.append((ev.t, 1, j, ev))
+    for i, off in enumerate(offsets):
+        ops.append((off, 2, i, i))
+    ops.sort(key=lambda e: (e[0], e[1], e[2]))
+
+    res = _PhaseResult(name=name, invalidation_rate_per_s=inv_rate)
+    t0 = time.perf_counter()
+    for t_ev, klass, _idx, payload in ops:
+        if cfg.pace == "real":
+            delay = (t0 + t_ev) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        if klass == 0:
+            driver.client.add_pending_batch(
+                [pods[i] for i in payload]
+            )
+            driver.sample_wal()
+        elif klass == 1:
+            driver.apply_event(payload)
+            res.events_applied[payload.kind] = (
+                res.events_applied.get(payload.kind, 0) + 1
+            )
+            driver.sample_wal()
+        else:
+            deadline = t0 + t_ev if cfg.pace == "real" else None
+            driver.decide(pods[payload], res, deadline)
+    driver.sample_wal()
+    res.wall_s = round(time.perf_counter() - t0, 3)
+    return res, offsets
+
+
+def _knee_analysis(
+    phases: list[_PhaseResult], miss_cost_ms: float
+) -> dict:
+    """The knee curve: hit rate and latency per invalidation intensity,
+    plus the located knee — the first intensity where the hit rate
+    drops below 0.5 (the cache serves less than it misses) or the
+    median decision costs more than a miss (speculation stopped
+    paying)."""
+    points = []
+    knee = None
+    for p in phases:
+        total = p.hits + p.misses
+        hit_rate = p.hits / total if total else 0.0
+        point = {
+            "intensity_per_s": p.invalidation_rate_per_s,
+            "hit_rate": round(hit_rate, 4),
+            "decisions": total,
+            "p50_ms": round(_pct(p.latencies, 50) * 1e3, 3),
+            "p99_ms": round(_pct(p.latencies, 99) * 1e3, 3),
+            "mean_ms": round(
+                float(np.mean(p.latencies)) * 1e3 if p.latencies else 0.0,
+                3,
+            ),
+        }
+        points.append(point)
+        collapsed = hit_rate < 0.5 or (
+            miss_cost_ms > 0 and point["p50_ms"] > miss_cost_ms
+        )
+        if knee is None and collapsed:
+            knee = p.invalidation_rate_per_s
+    return {
+        "miss_cost_ms": round(miss_cost_ms, 3),
+        "points": points,
+        "knee_intensity_per_s": knee,
+    }
+
+
+def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
+    """The real deployment: ``python -m kubernetes_tpu serve`` as a
+    child process, journaled and speculative, flight dumps into the
+    artifact directory."""
+    argv = [
+        sys.executable, "-m", "kubernetes_tpu", "serve",
+        "--socket", sock,
+        "--speculate",
+        "--batch-size", str(cfg.batch_size),
+        "--chunk-size", str(cfg.chunk_size),
+        "--journal-dir", journal_dir,
+        "--journal-fsync", cfg.journal_fsync,
+        "--snapshot-every", str(cfg.snapshot_every),
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TPU_FLIGHT_DIR"] = out_dir
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        env=env,
+    )
+    deadline = time.monotonic() + 180.0
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            raise RuntimeError(
+                f"serve child exited rc={proc.returncode}: {out[-2000:]}"
+            )
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError("serve child never bound its socket")
+        time.sleep(0.05)
+    return proc
+
+
+def run_soak(cfg: SoakConfig) -> dict:
+    """Execute one soak and return the artifact document (the
+    ``SOAK_rNN.json`` schema README documents)."""
+    tmp = tempfile.TemporaryDirectory(prefix="tpu-soak-")
+    out_dir = cfg.out_dir or tmp.name
+    os.makedirs(out_dir, exist_ok=True)
+    # Only dumps shed by THIS run count as its incidents — a persistent
+    # out_dir may hold earlier runs' flight dumps (names embed the
+    # child's pid, so they are never overwritten).
+    pre_existing = set(os.listdir(out_dir))
+    journal_dir = cfg.journal_dir or os.path.join(tmp.name, "journal")
+    sock = os.path.join(tmp.name, "soak.sock")
+    proc = None
+    srv = None
+    t_setup = time.perf_counter()
+    if cfg.two_process:
+        proc = _spawn_serve(cfg, sock, journal_dir, out_dir)
+    else:
+        from ..framework.leaderelection import FileLease, read_epoch
+        from ..sidecar.server import SidecarServer
+
+        os.makedirs(journal_dir, exist_ok=True)
+        lease_path = os.path.join(journal_dir, "lease")
+        lease = FileLease(lease_path, identity=f"soak-{os.getpid()}")
+        lease.acquire(block=True)
+        journal = Journal(
+            journal_dir,
+            epoch=lease.epoch,
+            fence=lambda: read_epoch(lease_path),
+            fsync=cfg.journal_fsync == "always",
+        )
+        srv = SidecarServer(
+            sock,
+            batch_size=cfg.batch_size,
+            chunk_size=cfg.chunk_size,
+            speculate=True,
+            journal=journal,
+            snapshot_every_batches=cfg.snapshot_every,
+        )
+        srv.serve_background()
+
+    driver = None
+    phases: list[_PhaseResult] = []
+    arrival_hashes: list[str] = []
+    all_offsets: list[list[float]] = []
+    try:
+        driver = _Driver(cfg, sock, journal_dir)
+        driver.build_fleet()
+        driver.warmup()
+        setup_s = round(time.perf_counter() - t_setup, 3)
+        arrival_base = 0
+        for k, (name, dur, rate) in enumerate(_phase_specs(cfg)):
+            res, offsets = _run_phase(
+                driver, cfg, k, name, dur, rate, arrival_base
+            )
+            arrival_base += len(offsets)
+            phases.append(res)
+            arrival_hashes.append(_sha([round(o, 9) for o in offsets]))
+            all_offsets.append(offsets)
+        dump = driver.client.dump()
+        bindings = {
+            uid: rec["node"]
+            for uid, rec in dump.get("pods", {}).items()
+            if rec.get("node")
+        }
+        flight = driver.client.flight()
+        flight_path = os.path.join(out_dir, "soak-flight.json")
+        with open(flight_path, "w", encoding="utf-8") as f:
+            json.dump(flight, f, indent=1, sort_keys=True)
+    finally:
+        if driver is not None:
+            driver.close()
+        if srv is not None:
+            srv.close()
+            lease.release()
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    sustained = phases[0]
+    knee_phases = phases[1:]
+    miss_cost_ms = round(
+        float(np.mean(sustained.miss_latencies)) * 1e3
+        if sustained.miss_latencies
+        else 0.0,
+        3,
+    )
+    slo = dict(
+        _lat_summary(sustained.latencies),
+        budget_ms=cfg.slo_budget_ms,
+        violations=sustained.violations,
+        violation_rate=round(
+            sustained.violations / max(1, sustained.decisions), 4
+        ),
+    )
+    spec_stats = dump.get("speculation") or {}
+    total_hits = sum(p.hits for p in phases)
+    total_misses = sum(p.misses for p in phases)
+    incidents = sorted(
+        f
+        for f in os.listdir(out_dir)
+        if f.startswith("flight-")
+        and f.endswith(".json")
+        and f not in pre_existing
+    )
+    wal_max = max(driver.wal_samples) if driver.wal_samples else 0
+    journal_stats = dump.get("journal") or {}
+    artifact = {
+        "metric": "soak_slo_knee_journal",
+        "seed": cfg.seed,
+        "config": asdict(cfg),
+        "setup_s": setup_s,
+        "wall_s": round(sum(p.wall_s for p in phases), 3),
+        "slo": slo,
+        "sustained_pods_per_sec": round(
+            sustained.decisions / sustained.wall_s
+            if sustained.wall_s
+            else 0.0,
+            1,
+        ),
+        "speculation": {
+            "hits": total_hits,
+            "misses": total_misses,
+            "miss_rate": round(
+                total_misses / max(1, total_hits + total_misses), 4
+            ),
+            "sidecar": spec_stats,
+        },
+        "knee": _knee_analysis(knee_phases, miss_cost_ms),
+        "journal": {
+            "dir_sampled": bool(driver.wal_samples),
+            "wal_bytes_max": wal_max,
+            "wal_bytes_final": (
+                driver.wal_samples[-1] if driver.wal_samples else 0
+            ),
+            "compactions_observed": driver.compactions_observed,
+            # Bounded = compaction cycled repeatedly AND the final size
+            # sits strictly below the high-water mark (a WAL that grows
+            # monotonically to the end compacted too early to count).
+            "bounded": bool(
+                driver.compactions_observed >= 2
+                and driver.wal_samples
+                and driver.wal_samples[-1] < wal_max
+            ),
+            "stats": journal_stats,
+        },
+        "phases": [
+            {
+                "name": p.name,
+                "invalidation_rate_per_s": p.invalidation_rate_per_s,
+                "wall_s": p.wall_s,
+                "decisions": p.decisions,
+                "bound": p.bound,
+                "hits": p.hits,
+                "misses": p.misses,
+                "retired": p.retired,
+                "violations": p.violations,
+                "events": dict(sorted(p.events_applied.items())),
+                "latency": _lat_summary(p.latencies),
+            }
+            for p in phases
+        ],
+        "workload_mix": dict(driver.mix.counts),
+        "cold_consumers": driver.cold_consumers,
+        "retired_total": driver.retired,
+        "bound_final": len(bindings),
+        "determinism": {
+            "arrival_sha256": _sha(arrival_hashes),
+            "bindings_sha256": _sha(sorted(bindings.items())),
+            "arrivals_total": sum(len(o) for o in all_offsets),
+        },
+        "incidents": incidents,
+        "flight": os.path.basename(flight_path),
+        "pace": cfg.pace,
+    }
+    # Keep the raw offsets available to callers (the determinism smoke
+    # compares them across runs) without bloating the JSON artifact.
+    artifact["_arrival_offsets"] = all_offsets
+    return artifact
+
+
+def strip_private(artifact: dict) -> dict:
+    """The committed-artifact view: drop the underscore-keyed raw data
+    callers use in-process, and normalize to JSON-native types (config
+    tuples become lists) so the document round-trips byte-stable."""
+    return json.loads(
+        json.dumps(
+            {k: v for k, v in artifact.items() if not k.startswith("_")}
+        )
+    )

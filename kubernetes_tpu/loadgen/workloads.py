@@ -1,0 +1,104 @@
+"""Workload mixes for the traffic generator, drawn from the sweep families.
+
+The benchmark harness already defines the pod shapes the whole recorded
+trajectory is built on (benchmarks/harness.py: the BASELINE configs and
+the upstream performance-config.yaml ports).  The generator reuses those
+exact templates — a soak should stress the same constraint families the
+one-shot sweeps measure, not a new ad-hoc shape — and mixes them by
+seeded draw, so a mix is as replayable as the arrival schedule feeding
+it.
+
+Two deliberate deltas from the sweep shapes:
+
+- pods are renamed into the generator's own ``lg-{index}`` namespace
+  (indices are globally unique across a soak's phases, so a 5-minute
+  stream never collides with itself or the warmup wave);
+- the default requests are scaled DOWN (``small_requests``): an
+  unbounded stream against a fixed fleet must not throttle on capacity
+  before the retirement churn (soak.py's live-pod cap) starts freeing
+  it.
+"""
+
+from __future__ import annotations
+
+from ..api import types as t
+
+# The sweep families this module draws from (benchmarks/harness.py is
+# the single source of the shapes; importing it keeps the soak's pods
+# byte-identical to the sweep's).
+from ..benchmarks.harness import (
+    _pod_affinity,
+    _pod_basic,
+    _pod_node_affinity,
+    _pod_pref_anti,
+    _pod_spread,
+)
+from .arrivals import _rng
+
+TEMPLATES = {
+    "basic": _pod_basic,
+    "spread": _pod_spread,
+    "affinity": _pod_affinity,
+    "pref_anti": _pod_pref_anti,
+    "node_affinity": _pod_node_affinity,
+}
+
+# name → ((template, weight), ...).  Weights normalize at draw time.
+MIXES: dict[str, tuple[tuple[str, float], ...]] = {
+    # The headline shape: BASELINE #4's basic pods.
+    "basic": (("basic", 1.0),),
+    # MixedSchedulingBasePod's spirit under sustained traffic: mostly
+    # basic pods with a constraint-carrying minority (the minority is
+    # what keeps the speculative frontend's domain-dependency scoping
+    # honest — an affinity-free soak would never exercise it).
+    "mixed": (
+        ("basic", 0.70),
+        ("spread", 0.10),
+        ("pref_anti", 0.10),
+        ("node_affinity", 0.10),
+    ),
+    # Adversarial for the decision cache: every pod carries terms, so
+    # every domain event intersects every cached decision.
+    "domains": (("affinity", 0.40), ("spread", 0.30), ("pref_anti", 0.30)),
+}
+
+
+class WorkloadMix:
+    """A seeded pod factory over one mix: ``pod(i)`` builds arrival i's
+    pod, choosing its template by a seeded draw (a pure function of
+    ``(seed, i)`` order — the factory must be called in arrival order,
+    which the driver does by construction)."""
+
+    def __init__(self, mix: str, seed: int, small_requests: bool = True):
+        if mix not in MIXES:
+            raise ValueError(f"unknown mix {mix!r}; have {sorted(MIXES)}")
+        self.mix = mix
+        entries = MIXES[mix]
+        total = sum(w for _n, w in entries)
+        self._names = [n for n, _w in entries]
+        self._weights = [w / total for _n, w in entries]
+        self._rng = _rng(seed)
+        self.small_requests = small_requests
+        self.counts: dict[str, int] = {n: 0 for n in self._names}
+
+    def pod(self, i: int) -> t.Pod:
+        name = (
+            self._names[0]
+            if len(self._names) == 1
+            else str(self._rng.choice(self._names, p=self._weights))
+        )
+        self.counts[name] += 1
+        pod = TEMPLATES[name](i)
+        # The generator's own naming space; rename BEFORE any uid access
+        # (Pod.uid memoizes on first read).
+        pod.metadata.name = f"lg-{i}"
+        if self.small_requests:
+            # A sustained stream must not exhaust the fleet before the
+            # retirement churn frees capacity; tiny requests put the
+            # binding pressure on pods-per-node, where the live-pod cap
+            # governs.
+            pod.spec.containers[0].requests = {
+                "cpu": t.parse_quantity("50m", "cpu"),
+                "memory": t.parse_quantity("64Mi", "memory"),
+            }
+        return pod

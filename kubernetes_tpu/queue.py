@@ -16,6 +16,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from enum import IntFlag, auto
 
@@ -67,6 +68,10 @@ PLUGIN_REQUEUE_EVENTS: dict[str, Event] = {
 DEFAULT_POD_INITIAL_BACKOFF_S = 1.0
 DEFAULT_POD_MAX_BACKOFF_S = 10.0
 DEFAULT_MAX_UNSCHEDULABLE_DURATION_S = 300.0
+# Quarantine-release history window (SchedulingQueue.release_history):
+# bounded so an unbounded release stream cannot grow the queue's durable
+# state — compaction trims snapshots to this trailing window.
+RELEASE_HISTORY_MAX = 256
 
 
 @dataclass
@@ -192,6 +197,13 @@ class SchedulingQueue:
         # the poison featurization) releases them back through the backoff
         # machinery.  Surfaced as scheduler_pending_pods{queue="quarantine"}.
         self._quarantine: dict[str, QueuedPodInfo] = {}
+        # Release history: the trailing window of quarantine releases
+        # (operator actions worth triaging after the fact).  BOUNDED —
+        # over an unbounded soak the release stream never ends, so the
+        # ring trims itself and snapshots carry only this window; the
+        # journal's release_quarantine records beyond it are reclaimed
+        # by the next snapshot+truncate compaction cycle.
+        self.release_history: deque = deque(maxlen=RELEASE_HISTORY_MAX)
         self.initial_backoff_s = initial_backoff_s
         self.max_backoff_s = max_backoff_s
         self.max_unschedulable_s = max_unschedulable_s
@@ -276,6 +288,17 @@ class SchedulingQueue:
             qp = self._quarantine.pop(u, None)
             if qp is not None:
                 self.add_backoff(qp)
+                # Triage trail: what was released and after how many
+                # attempts.  The deque bounds itself (RELEASE_HISTORY_MAX)
+                # — the clock is the queue's own (monotonic by default,
+                # rebased across restarts like every other queue clock).
+                self.release_history.append(
+                    {
+                        "uid": u,
+                        "attempts": qp.attempts,
+                        "ts": round(self._clock(), 3),
+                    }
+                )
                 n += 1
         return n
 
@@ -755,7 +778,21 @@ class SchedulingQueue:
             qp = self._info.get(uid)
             if qp is not None:
                 ent(qp, "backoff", backoff_remaining_s=round(left, 6))
-        return {"entries": entries}
+        return {
+            "entries": entries,
+            # Already trimmed to the trailing window (bounded deque):
+            # the snapshot can never grow with the release stream.
+            # Clocks rebase as ages (like backoff remaining-seconds) —
+            # raw monotonic stamps are meaningless in the next process.
+            "release_history": [
+                {
+                    "uid": e["uid"],
+                    "attempts": e["attempts"],
+                    "age_s": round(max(0.0, now - e["ts"]), 3),
+                }
+                for e in self.release_history
+            ],
+        }
 
     def restore_state(self, state: dict) -> int:
         """Rebuild the pools from a durable_state() document (recovery).
@@ -810,6 +847,15 @@ class SchedulingQueue:
                     self._track_gang_member(qp)
                 self._push_active(qp)
             n += 1
+        # The release-history window survives restarts (its ring bound
+        # applies on restore too — an over-long stored list trims).
+        # Stored ages rebase onto this process's clock; a raw "ts" from
+        # an in-process ring copy passes through unchanged.
+        for rec in state.get("release_history", ()):
+            e = dict(rec)
+            if "age_s" in e:
+                e["ts"] = round(now - e.pop("age_s"), 3)
+            self.release_history.append(e)
         # Parked gangs whose quorum is already reachable release now (a
         # restart must not strand a quorum-complete gang).
         for g in list(self._gang_pool):

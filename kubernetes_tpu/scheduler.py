@@ -335,6 +335,12 @@ class TPUScheduler:
         # of each batch — host work done here (the speculative frontend's
         # hint parse/build) hides under the in-flight pass.
         self.post_dispatch_hook = None
+        # Uids of the batch currently in flight (popped, not yet
+        # committed).  The post-dispatch hook's admission path must not
+        # re-add one of these to the active queue: the commit's
+        # queue.done() would strand a stale active entry and a later
+        # pop_batch would find a uid with no info record.
+        self._inflight_uids: frozenset = frozenset()
         # Fault injection hook (faults.FaultPlan.install_engine): called
         # with the batch's pods at the top of every device dispatch.  None
         # in production; the batch-recovery path it exercises (bisect +
@@ -1835,6 +1841,15 @@ class TPUScheduler:
         """One single-profile batch under the cycle span (exception-safe:
         Trace.__exit__ emits the step log for slow batches even when the
         batch raises — exactly the batches an operator needs timed)."""
+        self._inflight_uids = frozenset(qp.pod.uid for qp in infos)
+        try:
+            return self._batch_traced_inner(tr, infos, work)
+        finally:
+            self._inflight_uids = frozenset()
+
+    def _batch_traced_inner(
+        self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None
+    ) -> list[ScheduleOutcome]:
         with tr.nest("DevicePassDispatch") as _sp:
             ctx = self._dispatch_batch(infos, self.profile, work)
         tr.step("dispatched device pass")

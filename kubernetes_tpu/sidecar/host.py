@@ -511,6 +511,20 @@ class ResyncingClient:
         fb = self._ensure_fallback()
         getattr(fb, serialize.KINDS[kind][1])(obj)
 
+    def add_pending_batch(self, pods) -> None:
+        """Ship one coalesced PendingPods hint frame (the flusher shape
+        the soak driver and the Go plugin's informer backlog use).
+        Hints are NOT cluster mutations: they are neither journaled nor
+        mirrored into the replay store (a pod the scheduler never asks
+        about must not be replayed into a restarted sidecar as if it
+        were state), and while degraded they are simply dropped — the
+        pods arrive again through Schedule, which is always correct."""
+        self._call_or_degraded(
+            lambda: self._client.add_pending_batch(pods),
+            lambda: None,
+            kind="add",
+        )
+
     def remove(self, kind: str, uid: str) -> None:
         self._journal_mutation("remove", {"kind": kind, "uid": uid})
         self._apply_remove_local(kind, uid)

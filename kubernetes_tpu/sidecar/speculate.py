@@ -68,6 +68,7 @@ Consistency contract:
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -153,7 +154,15 @@ class SpeculativeFrontend:
         # Coalesced PendingPods frames, kept as UNPARSED JSON arrays: the
         # ingestion ack returns immediately and the parse/build cost runs
         # in _on_dispatched — i.e. under an in-flight device pass.
+        # Parsing is INCREMENTAL (a cursor into the blob being decoded):
+        # a miss only pays for the pods its batch can admit, never a full
+        # multi-MiB array decode on the critical path — at 10k hinted
+        # pods the whole-array json.loads was the single biggest
+        # non-device host cost in the push-consumer path (~1.3s, fully
+        # exposed on the FIRST miss, before any device pass it could
+        # hide under was in flight).
         self.raw_blobs: list[bytes] = []
+        self._blob_cursor: tuple[str, int] | None = None
         # Hint uids whose pool entry is still a raw dict, in arrival
         # order — the build queue _on_dispatched drains.
         self._unbuilt: deque[str] = deque()
@@ -201,6 +210,39 @@ class SpeculativeFrontend:
         # work hides under the in-flight pass (the same overlap trick as
         # the featurize prefetch, applied to deserialization).
         sched.post_dispatch_hook = self._on_dispatched
+        # Speculation exposition (scheduler_speculation_* — the soak's
+        # miss-rate knee reads these off a live scrape instead of the
+        # dump frame).  Collector-backed: the hot path keeps bumping the
+        # plain SpecStats ints; scrape time syncs the cells.  Registered
+        # once per scheduler and resolved through _spec_frontend, so a
+        # re-created frontend keeps exporting without re-registering.
+        reg = sched.metrics.registry
+        if not getattr(sched, "_spec_metrics_registered", False):
+            sched._spec_metrics_registered = True
+            events_total = reg.counter(
+                "scheduler_speculation_events_total",
+                "Speculative-frontend decision-cache events by kind "
+                "(hits, misses, invalidations, rolled_back, speculated, "
+                "pushed, drain_exhausted, full_invalidations).",
+            )
+            hit_ratio = reg.gauge(
+                "scheduler_speculation_hit_ratio",
+                "Decision-cache hit ratio (hits / (hits + misses)) since "
+                "the frontend started.",
+            )
+
+            def collect(_reg) -> None:
+                front = getattr(sched, "_spec_frontend", None)
+                if front is None:
+                    return
+                for k, v in front.stats.as_dict().items():
+                    events_total.set(float(v), event=k)
+                served = front.stats.hits + front.stats.misses
+                hit_ratio.set(
+                    front.stats.hits / served if served else 0.0
+                )
+
+            reg.add_collector(collect)
 
     # -- push stream --------------------------------------------------------
 
@@ -287,23 +329,77 @@ class SpeculativeFrontend:
         _parse_blobs under a device pass (or on first demand)."""
         self.raw_blobs.append(raw)
 
-    def _parse_blobs(self) -> None:
-        """Parse every deferred blob into the hint pool.  A pool entry
-        that already exists WINS over a blob entry — the pool entry
-        arrived later (a direct informer add/update), the blob was queued
-        first."""
-        if not self.raw_blobs:
+    def _parse_blobs(self, need: int | None = None) -> None:
+        """Parse deferred blobs into the hint pool — up to ``need`` NEW
+        pool entries (None = everything).  A pool entry that already
+        exists WINS over a blob entry — the pool entry arrived later (a
+        direct informer add/update), the blob was queued first.
+
+        Incremental by design: ``raw_decode`` consumes one pod object
+        per step and the cursor persists across calls, so the cost of a
+        large coalesced frame amortizes across batches (and hides under
+        in-flight device passes via _on_dispatched) instead of landing
+        whole on the first miss.  Partial parsing means the priority
+        sort in _admit_hints only sees the decoded prefix — hints are
+        best-effort speculation, so a deep-in-the-blob priority
+        inversion costs at most one deferred speculation, never a wrong
+        answer.  The decode time is observed as the ``hint_decode``
+        phase (a sub-slice, like journal_append — it overlaps device
+        time and stays out of the tiling sum)."""
+        if need is not None and need <= 0:
+            return
+        if not self.raw_blobs and self._blob_cursor is None:
             return
         import json
 
-        blobs, self.raw_blobs = self.raw_blobs, []
-        for raw in blobs:
-            for data in json.loads(raw):
-                uid = self._uid_of(data)
-                if uid in self.hints:
-                    continue
-                if self._add_hint(uid, data):
-                    self._unbuilt.append(uid)
+        t0 = time.perf_counter()
+        decoder = json.JSONDecoder()
+        added = 0
+        try:
+            while self.raw_blobs or self._blob_cursor is not None:
+                if self._blob_cursor is None:
+                    text = self.raw_blobs.pop(0).decode("utf-8")
+                    pos = 0
+                    while pos < len(text) and text[pos] in " \t\n\r":
+                        pos += 1
+                    if pos >= len(text):
+                        continue
+                    if text[pos] != "[":
+                        raise ValueError(
+                            "PendingPods frame is not a JSON array"
+                        )
+                    self._blob_cursor = (text, pos + 1)
+                text, pos = self._blob_cursor
+                while True:
+                    while pos < len(text) and text[pos] in " \t\n\r,":
+                        pos += 1
+                    if pos >= len(text) or text[pos] == "]":
+                        self._blob_cursor = None
+                        break
+                    data, pos = decoder.raw_decode(text, pos)
+                    uid = self._uid_of(data)
+                    if uid not in self.hints and self._add_hint(uid, data):
+                        self._unbuilt.append(uid)
+                        added += 1
+                        if need is not None and added >= need:
+                            self._blob_cursor = (text, pos)
+                            return
+        except ValueError:
+            # A malformed blob cannot be resumed (framing inside the
+            # array is lost); drop its remainder and surface the error
+            # where the old whole-array parse would have.
+            self._blob_cursor = None
+            raise
+        finally:
+            self._observe_decode(time.perf_counter() - t0)
+
+    def _observe_decode(self, secs: float) -> None:
+        """Attribute hint deserialization to the phase split
+        (scheduler_phase_duration_seconds{phase="hint_decode"}) — the
+        evidence surface for the push-consumer host-cost work."""
+        hist = getattr(self.sched, "_phase_hist", None)
+        if hist is not None:
+            hist.observe(secs, phase="hint_decode")
 
     def _build_hints(self, budget: int) -> None:
         """Convert up to ``budget`` raw-dict pool entries into built
@@ -311,18 +407,20 @@ class SpeculativeFrontend:
         first."""
         unbuilt = self._unbuilt
         hints = self.hints
+        t0 = time.perf_counter()
         while budget > 0 and unbuilt:
             uid = unbuilt.popleft()
             obj = hints.get(uid)
             if isinstance(obj, dict):
                 hints[uid] = self._hint_pod(obj)
                 budget -= 1
+        self._observe_decode(time.perf_counter() - t0)
 
     def _on_dispatched(self) -> None:
         """scheduler.post_dispatch_hook: a device pass is in flight; do
         the deserialization work now, under it — and feed the queue so
         the scheduler's featurize-prefetch has a next batch to pop."""
-        self._parse_blobs()
+        self._parse_blobs(self.sched.batch_size * 2)
         self._build_hints(self.sched.batch_size * 2)
         self._admit_hints(self.sched.batch_size)
 
@@ -331,6 +429,15 @@ class SpeculativeFrontend:
             return False
         if uid in self.sched.cache.pods:
             return False  # already bound/assumed in the mirror
+        if uid in self.sched._inflight_uids:
+            # The pod is IN the batch currently dispatching (it arrived
+            # both as a direct Schedule request and in a
+            # still-unparsed blob, and the incremental parse reached it
+            # mid-flight).  Re-pooling it would re-admit it to the
+            # active queue under the commit's feet — the commit's
+            # queue.done() would strand a stale active entry.  Its
+            # outcome is already on the way; drop the duplicate hint.
+            return False
         self.hints[uid] = obj
         return True
 
@@ -555,10 +662,10 @@ class SpeculativeFrontend:
 
     def note_remove(self, kind: str, uid: str) -> None:
         if kind == "Pod":
-            if self.raw_blobs:
+            if self.raw_blobs or self._blob_cursor is not None:
                 # The deleted pod may sit in an unparsed blob; parsing
                 # later would resurrect it.  Deletes are rare next to
-                # hints — pay the parse on this path.
+                # hints — pay the full parse on this path.
                 self._parse_blobs()
             if not (
                 uid in self.cached
@@ -678,10 +785,15 @@ class SpeculativeFrontend:
         if budget <= 0:
             return
         if len(self.hints) < budget:
-            self._parse_blobs()
+            # Top up from the deferred blobs — only as many pods as this
+            # admission can use (the incremental-parse contract).
+            self._parse_blobs(budget - len(self.hints))
         if not self.hints:
             return
-        in_flight = self._prefetched_uids()
+        # Both in-flight sets: the prefetched NEXT batch and the batch
+        # currently dispatching (post_dispatch_hook runs inside it) —
+        # re-admitting a member of either would double-commit it.
+        in_flight = self._prefetched_uids() | self.sched._inflight_uids
         # Admit in QueueSort order (priority desc, arrival order) — the
         # host activeQ's comparator, so speculation follows its pop order.
         order = sorted(

@@ -1,15 +1,19 @@
 #!/usr/bin/env python3
-"""Turn a flight-recorder dump into a phase-attribution table.
+"""Turn a flight-recorder dump — or a SOAK artifact — into tables.
 
 Input: the JSON document the flight recorder produces everywhere — an
 auto-dump file (engine fault / quarantine / breaker trip / SIGTERM /
 recovery), `python -m kubernetes_tpu flight --socket S`, or
-`GET /debug/flight` (pipe via `-`).  Output: where the time went —
-aggregate per-phase seconds and share, per-batch percentiles, the
-sampled per-plugin table, and the transition-marker timeline.
+`GET /debug/flight` (pipe via `-`) — or a soak artifact
+(``SOAK_rNN.json`` from scripts/run_soak.py / the ``soak``
+subcommand).  Output: where the time went — aggregate per-phase seconds
+and share, per-batch percentiles, the sampled per-plugin table, and the
+transition-marker timeline; for soak artifacts, the SLO block, the
+miss-rate knee curve, journal growth, and the per-phase serving table.
 
     python scripts/profile_report.py /tmp/flight-scheduler-123-001-quarantine.json
     python -m kubernetes_tpu flight --socket S | python scripts/profile_report.py -
+    python scripts/profile_report.py SOAK_r06.json
 
 Stdlib-only on purpose: this must run on the operator's laptop against a
 dump scp'd out of an incident, with no JAX (or repo) install.
@@ -71,7 +75,7 @@ def report(doc: dict) -> str:
                 per_batch.setdefault(phase, []).append(secs)
         tiled = sum(
             v for k, v in totals.items()
-            if k not in ("journal_append", "journal_fsync")
+            if k not in ("journal_append", "journal_fsync", "hint_decode")
         )
         pods = sum(b.get("pods", 0) for b in batches)
         bound = sum(b.get("scheduled", b.get("bound", 0)) for b in batches)
@@ -98,7 +102,8 @@ def report(doc: dict) -> str:
         if wall > 0:
             out.append(
                 f"tiled phases cover {tiled / wall:.1%} of batch wall time "
-                "(journal_append/journal_fsync nest inside the tiles)"
+                "(journal_append/journal_fsync/hint_decode nest inside or "
+                "overlap the tiles)"
             )
 
         # Sampled per-plugin durations.
@@ -141,6 +146,87 @@ def report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def soak_report(doc: dict) -> str:
+    """Render one SOAK_rNN.json artifact: SLO, knee curve, journal
+    growth, per-phase serving table."""
+    out = []
+    cfg = doc.get("config", {})
+    out.append(
+        f"soak artifact: seed={doc.get('seed')} pace={doc.get('pace')} "
+        f"mix={cfg.get('mix')} nodes={cfg.get('nodes')} "
+        f"rate={cfg.get('rate_pods_per_s')}/s wall={doc.get('wall_s')}s"
+    )
+    slo = doc.get("slo", {})
+    out.append(
+        f"\nSLO (sustained phase, budget {slo.get('budget_ms')}ms): "
+        f"p50 {slo.get('p50_ms')}ms  p99 {slo.get('p99_ms')}ms  "
+        f"p999 {slo.get('p999_ms')}ms  "
+        f"violations {slo.get('violations')}/{slo.get('decisions')} "
+        f"({100 * slo.get('violation_rate', 0):.2f}%)  "
+        f"sustained {doc.get('sustained_pods_per_sec')} pods/s"
+    )
+    knee = doc.get("knee", {})
+    if knee.get("points"):
+        out.append(
+            f"\nmiss-rate knee (miss cost {knee.get('miss_cost_ms')}ms, "
+            f"knee @ {knee.get('knee_intensity_per_s')} invalidations/s):"
+        )
+        rows = [
+            (
+                p["intensity_per_s"], f"{p['hit_rate']:.1%}",
+                p["decisions"], f"{p['p50_ms']}ms", f"{p['p99_ms']}ms",
+            )
+            for p in knee["points"]
+        ]
+        out.append(
+            _table(rows, ("inval/s", "hit rate", "decisions", "p50", "p99"))
+        )
+    j = doc.get("journal", {})
+    out.append(
+        f"\njournal: wal max {j.get('wal_bytes_max')}B, "
+        f"final {j.get('wal_bytes_final')}B, "
+        f"{j.get('compactions_observed')} compaction cycles observed, "
+        f"bounded={j.get('bounded')}"
+    )
+    phases = doc.get("phases", [])
+    if phases:
+        out.append("\nper-phase serving:")
+        rows = []
+        for p in phases:
+            lat = p.get("latency", {})
+            rows.append(
+                (
+                    p["name"], p.get("invalidation_rate_per_s"),
+                    p.get("decisions"), p.get("hits"), p.get("misses"),
+                    f"{lat.get('p50_ms')}ms", f"{lat.get('p99_ms')}ms",
+                    p.get("retired"),
+                )
+            )
+        out.append(
+            _table(
+                rows,
+                ("phase", "inval/s", "dec", "hits", "miss", "p50", "p99",
+                 "retired"),
+            )
+        )
+    det = doc.get("determinism", {})
+    if det:
+        out.append(
+            f"\ndeterminism: arrivals sha {det.get('arrival_sha256', '')[:12]}… "
+            f"bindings sha {det.get('bindings_sha256', '')[:12]}…"
+            + (
+                "  (cross-check: identical)"
+                if (doc.get("determinism_check") or {}).get(
+                    "bindings_identical"
+                )
+                else ""
+            )
+        )
+    if doc.get("incidents"):
+        out.append(f"incidents: {', '.join(doc['incidents'])}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if len(args) != 1:
@@ -151,7 +237,12 @@ def main(argv=None) -> int:
     else:
         with open(args[0], "r", encoding="utf-8") as f:
             doc = json.load(f)
-    print(report(doc))
+    if doc.get("metric") == "soak_slo_knee_journal" or (
+        "knee" in doc and "slo" in doc
+    ):
+        print(soak_report(doc))
+    else:
+        print(report(doc))
     return 0
 
 

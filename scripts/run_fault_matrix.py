@@ -65,8 +65,10 @@ KILL_CASES = (
     ("pre-append", 1), ("pre-append", 3),
     ("post-append", 1), ("post-append", 2),
     ("torn-append", 1), ("torn-append", 2),
+    ("pre-snapshot", 1), ("pre-snapshot", 2),
     ("mid-snapshot", 1), ("mid-snapshot", 2),
     ("mid-truncate", 1), ("mid-truncate", 2),
+    ("post-truncate", 1), ("post-truncate", 2),
 )
 
 # The WIRE crash subset (the ROADMAP layer-0 gap): the same scenario

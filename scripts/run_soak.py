@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""The recorded-soak runner: the ≥5-minute seeded soak of the REAL
+two-process journaled deployment behind the committed SOAK_rNN.json
+artifacts, plus the determinism cross-check the acceptance bar asks
+for.
+
+Three parts, one document:
+
+1. **Determinism check** (fast, in-process, virtual pace): the soak
+   config's seed is run twice and the arrival-schedule and
+   final-binding hashes must match bit for bit — recorded under
+   ``determinism_check`` so the artifact carries its own replayability
+   proof.  The operation sequence is identical between virtual and
+   real pacing (soak.py's contract), so this also certifies the main
+   run's op stream.
+2. **The main soak** (two-process, real pace): ``python -m
+   kubernetes_tpu serve --journal-dir --speculate`` as a child,
+   driven at the configured arrival rate for the sustained phase, then
+   the miss-rate knee sweep across the invalidation intensities.
+3. The merged artifact is written to ``--out`` (SOAK_r06.json for the
+   r06 recording).
+
+    JAX_PLATFORMS=cpu python scripts/run_soak.py --out SOAK_r06.json
+
+Render with ``python scripts/profile_report.py SOAK_r06.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def r06_config(args) -> "SoakConfig":
+    from kubernetes_tpu.loadgen.soak import SoakConfig
+
+    return SoakConfig(
+        seed=args.seed,
+        nodes=args.nodes,
+        zones=10,
+        churn_nodes=4,
+        rate_pods_per_s=args.rate,
+        diurnal=args.diurnal,
+        # Peak 1.5× base: the crest runs near the measured single-box
+        # capacity, so the SLO percentiles honestly carry crest backlog
+        # without the whole run drowning.
+        diurnal_peak_factor=1.5,
+        diurnal_period_s=120.0,
+        mix=args.mix,
+        duration_s=args.sustained,
+        knee_points=tuple(
+            float(x) for x in args.knee_points.split(",") if x.strip()
+        ),
+        knee_phase_s=args.knee_phase,
+        invalidation_rate_per_s=0.2,
+        node_flap_period_s=45.0,
+        flap_down_s=2.0,
+        cold_consumer_period_s=60.0,
+        live_pod_cap=args.live_pod_cap,
+        slo_budget_ms=args.slo_budget_ms,
+        batch_size=args.batch_size,
+        chunk_size=32,
+        warm_pods=128,
+        two_process=True,
+        journal_fsync=args.journal_fsync,
+        snapshot_every=args.snapshot_every,
+        pace="real",
+        out_dir=args.out_dir,
+    )
+
+
+def determinism_check(cfg) -> dict:
+    """Two short same-seed virtual runs over a scaled-down copy of the
+    config: the replayability proof that rides the artifact."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import run_soak
+
+    small = dataclasses.replace(
+        cfg,
+        nodes=min(cfg.nodes, 32),
+        churn_nodes=2,
+        duration_s=3.0,
+        knee_points=(8.0,),
+        knee_phase_s=1.0,
+        live_pod_cap=100,
+        warm_pods=64,
+        batch_size=64,
+        chunk_size=16,
+        two_process=False,
+        pace="virtual",
+        journal_fsync="never",
+        out_dir="",
+        journal_dir="",
+        node_flap_period_s=2.0,
+        cold_consumer_period_s=2.5,
+    )
+    a = run_soak(small)
+    b = run_soak(small)
+    return {
+        "seed": small.seed,
+        "runs": 2,
+        "arrival_schedule_identical": (
+            a["_arrival_offsets"] == b["_arrival_offsets"]
+        ),
+        "arrival_sha256": a["determinism"]["arrival_sha256"],
+        "bindings_identical": (
+            a["determinism"]["bindings_sha256"]
+            == b["determinism"]["bindings_sha256"]
+        ),
+        "bindings_sha256": a["determinism"]["bindings_sha256"],
+        "bound_final": a["bound_final"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="SOAK_r06.json")
+    ap.add_argument("--out-dir", default="",
+                    help="flight-dump directory (default: alongside --out)")
+    ap.add_argument("--seed", type=int, default=6)
+    # Defaults calibrated for the CPU build box (2 cores): basic mix at
+    # 100 nodes sustains ~30 decisions/s with a ~210ms miss cost; 24/s
+    # base with a 1.5× diurnal crest keeps the crest near capacity.
+    ap.add_argument("--rate", type=float, default=24.0)
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--mix", default="basic")
+    ap.add_argument("--diurnal", action="store_true", default=True)
+    ap.add_argument("--no-diurnal", dest="diurnal", action="store_false")
+    ap.add_argument("--sustained", type=float, default=180.0)
+    ap.add_argument("--knee-points", default="0.5,2,8,32,128")
+    ap.add_argument("--knee-phase", type=float, default=30.0)
+    ap.add_argument("--live-pod-cap", type=int, default=2000)
+    ap.add_argument("--slo-budget-ms", type=float, default=250.0)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--journal-fsync", choices=("always", "never"),
+                    default="always")
+    ap.add_argument("--snapshot-every", type=int, default=24)
+    ap.add_argument("--skip-determinism-check", action="store_true")
+    args = ap.parse_args()
+    if not args.out_dir:
+        args.out_dir = os.path.join(
+            os.path.dirname(os.path.abspath(args.out)) or ".",
+            "soak_dumps",
+        )
+
+    from kubernetes_tpu.loadgen.soak import run_soak, strip_private
+
+    cfg = r06_config(args)
+    check = None
+    if not args.skip_determinism_check:
+        print("run_soak: determinism cross-check (2× virtual)…", flush=True)
+        check = determinism_check(cfg)
+        print(f"run_soak: {json.dumps(check)}", flush=True)
+        if not (
+            check["arrival_schedule_identical"]
+            and check["bindings_identical"]
+        ):
+            print("run_soak: DETERMINISM CHECK FAILED", file=sys.stderr)
+            return 1
+
+    total = cfg.duration_s + len(cfg.knee_points) * cfg.knee_phase_s
+    print(
+        f"run_soak: main soak — two-process, seed {cfg.seed}, "
+        f"{cfg.rate_pods_per_s} pods/s, {total:.0f}s scheduled "
+        f"({cfg.duration_s:.0f}s sustained + {len(cfg.knee_points)} knee "
+        f"points × {cfg.knee_phase_s:.0f}s)…",
+        flush=True,
+    )
+    artifact = strip_private(run_soak(cfg))
+    artifact["determinism_check"] = check
+    artifact["environment"] = {
+        "backend": os.environ.get("JAX_PLATFORMS", ""),
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"run_soak: wrote {args.out} — "
+        f"p50/p99/p999 {artifact['slo']['p50_ms']}/"
+        f"{artifact['slo']['p99_ms']}/{artifact['slo']['p999_ms']}ms, "
+        f"{artifact['sustained_pods_per_sec']} pods/s sustained, "
+        f"{artifact['journal']['compactions_observed']} compactions, "
+        f"knee {artifact['knee']['knee_intensity_per_s']}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Seeded loadgen determinism violations: a traffic generator whose
+arrivals read wall clocks or ambient entropy cannot replay, so a
+same-seed soak could never assert bit-identical bindings."""
+
+import random
+import time
+
+
+def arrivals(rate, duration):
+    # POSITIVE det-wallclock: arrival schedule anchored to the wall clock.
+    t = time.time()
+    out = []
+    while t < duration:
+        # POSITIVE det-random: bare-`random` inter-arrival gaps — the
+        # schedule differs every run (numpy.random.Generator(seed) is
+        # the allowed idiom).
+        t += random.expovariate(rate)
+        out.append(t)
+    return out

@@ -1,0 +1,19 @@
+"""Loadgen determinism negative fixture: the allowed idioms — a seeded
+``numpy.random.Generator`` stream for arrivals, ``perf_counter`` for
+pacing/latency measurement (never a decision input), injected clocks."""
+
+import time
+
+import numpy as np
+
+
+def arrivals(rate, duration, seed, clock=time.perf_counter):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    t0 = clock()  # pacing reference, not a schedule input
+    out, t = [], 0.0
+    for gap in rng.exponential(1.0 / rate, size=64):
+        t += float(gap)
+        if t >= duration:
+            break
+        out.append(t)
+    return out, clock() - t0

@@ -524,6 +524,138 @@ def test_host_checkpoint_covers_latest_mutation(tmp_path):
         assert pods["w"]["spec"]["node_name"] == "n0"
 
 
+# -- online compaction (bounded WAL over unbounded streams) -----------------
+
+
+def _append_stream(sched, cycles: int = 12, per_cycle: int = 3):
+    """An unbounded-stream stand-in: each cycle binds fresh pods and
+    retires the ones bound two cycles ago (the soak driver's live-pod
+    cap), so the journal sees a perpetual bind+delete append stream."""
+    wal = os.path.join(sched.journal.dir, Journal.WAL)
+    sizes = []
+    bound_cycles: list[list[str]] = []
+    for c in range(cycles):
+        batch = []
+        for j in range(per_cycle):
+            p = pod(f"st-{c}-{j}")
+            batch.append(p.uid)
+            sched.add_pod(p)
+        sched.schedule_all_pending()
+        bound_cycles.append(batch)
+        if len(bound_cycles) > 2:
+            for uid in bound_cycles.pop(0):
+                sched.delete_pod(uid)
+        sizes.append(os.path.getsize(wal))
+    return sizes
+
+
+def test_wal_bounded_under_unbounded_append_stream(tmp_path):
+    """Compaction guard: over a long bind+delete stream, the snapshot
+    cadence keeps journal.wal bounded (truncations observed repeatedly,
+    high-water mark well under the cadence-free growth) and recovery
+    from the compacted state is still bit-identical."""
+    # Cadence-free reference: the WAL grows monotonically.
+    j_free = Journal(str(tmp_path / "free"), epoch=1)
+    s_free = scenario_sched()
+    s_free.attach_journal(j_free)  # no snapshot cadence
+    free_sizes = _append_stream(s_free)
+    assert free_sizes == sorted(free_sizes)
+
+    # Compacted run: same stream, snapshot every 2 batches.
+    j = Journal(str(tmp_path / "compact"), epoch=1)
+    s1 = scenario_sched()
+    s1.attach_journal(j, snapshot_every_batches=2)
+    sizes = _append_stream(s1)
+    assert j.truncations >= 2, "compaction must cycle during the stream"
+    assert max(sizes) < 0.6 * free_sizes[-1], (
+        f"WAL high-water {max(sizes)} not bounded vs cadence-free "
+        f"{free_sizes[-1]}"
+    )
+    # The compacted journal still recovers the exact final world.
+    want = bindings_of(s1)
+    s2 = scenario_sched()
+    recover(s2, Journal(str(tmp_path / "compact"), epoch=2))
+    assert bindings_of(s2) == want
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("point", ["pre-snapshot", "post-truncate"])
+def test_mid_compaction_sigkill_recovers_bit_identical(point):
+    """The compaction cycle's own crash windows (the KILL_POINTS this PR
+    added around snapshot+truncate): SIGKILL just before the checkpoint
+    begins and just after the truncate lands, and assert recovery is
+    bit-identical to an uninterrupted run."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import tempfile
+
+    from run_fault_matrix import _read_bindings, _spawn
+
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "base")
+        os.makedirs(base)
+        assert _spawn("--kill-child", base) == 0
+        baseline = _read_bindings(base)
+        assert baseline
+        case = os.path.join(td, "case")
+        os.makedirs(case)
+        rc = _spawn("--kill-child", case, kill=f"{point}:1")
+        assert rc == -9, f"child survived the {point} SIGKILL (rc={rc})"
+        assert _spawn("--recover-child", case) == 0
+        assert _read_bindings(case) == baseline
+
+
+def test_quarantine_release_history_is_trimmed():
+    """The release history is a bounded ring: an unbounded release
+    stream keeps only the trailing RELEASE_HISTORY_MAX entries, the
+    window survives a durable_state round trip, and an over-long stored
+    list trims on restore."""
+    from kubernetes_tpu.queue import RELEASE_HISTORY_MAX, QueuedPodInfo
+
+    clock = [100.0]
+    q = SchedulingQueue(clock=lambda: clock[0])
+    n = RELEASE_HISTORY_MAX + 44
+    for i in range(n):
+        p = pod(f"q-{i}")
+        qp = QueuedPodInfo(
+            pod=p, timestamp=clock[0], initial_attempt_timestamp=clock[0],
+            attempts=i % 5,
+        )
+        q.quarantine(qp)
+        assert q.release_quarantine(p.uid) == 1
+        q.delete(p.uid)  # released pods leave; only the history remains
+        clock[0] += 1.0
+    assert len(q.release_history) == RELEASE_HISTORY_MAX
+    uids = [e["uid"] for e in q.release_history]
+    assert uids[0] == "default/q-44"  # the oldest 44 were trimmed
+    assert uids[-1] == f"default/q-{n - 1}"
+    # The window rides durable_state (stamps stored as ages — raw
+    # monotonic clocks are meaningless in the next process) and
+    # restores trimmed, rebased onto the restoring clock.
+    state = q.durable_state()
+    assert len(state["release_history"]) == RELEASE_HISTORY_MAX
+    assert all(
+        "age_s" in e and "ts" not in e for e in state["release_history"]
+    )
+    clock[0] += 50.0
+    q2 = SchedulingQueue(clock=lambda: clock[0])
+    q2.restore_state(state)
+    assert [e["uid"] for e in q2.release_history] == uids
+    assert all(
+        abs((b["ts"] - a["ts"]) - 50.0) < 1e-6
+        for a, b in zip(q.release_history, q2.release_history)
+    )
+    # An over-long stored list (a snapshot from a future, larger bound)
+    # trims to this process's window instead of growing unboundedly.
+    state["release_history"] = [
+        {"uid": f"x-{i}", "attempts": 0, "ts": 0.0}
+        for i in range(RELEASE_HISTORY_MAX + 100)
+    ]
+    q3 = SchedulingQueue(clock=lambda: clock[0])
+    q3.restore_state(state)
+    assert len(q3.release_history) == RELEASE_HISTORY_MAX
+    assert q3.release_history[-1]["uid"] == f"x-{RELEASE_HISTORY_MAX + 99}"
+
+
 # -- the crash matrix (fast subset; --kill sweeps the grid) -----------------
 
 

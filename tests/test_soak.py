@@ -1,0 +1,195 @@
+"""Tier-1 soak smoke (loadgen/): a seconds-scale seeded soak runs end
+to end in-process, populates the SLO-percentile and miss-rate-knee
+fields, and is deterministic — the same seed reproduces the arrival
+schedule exactly and lands bit-identical final bindings.  The
+committed SOAK_rNN.json artifacts come from scripts/run_soak.py's
+minutes-scale two-process run; this is the always-on guard that the
+harness itself stays correct and replayable."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.loadgen.arrivals import (
+    coalesce,
+    diurnal_offsets,
+    poisson_offsets,
+)
+from kubernetes_tpu.loadgen.scenarios import build_events
+from kubernetes_tpu.loadgen.soak import SoakConfig, run_soak, strip_private
+from kubernetes_tpu.loadgen.workloads import WorkloadMix
+
+
+def smoke_config(seed: int = 3) -> SoakConfig:
+    return SoakConfig(
+        seed=seed,
+        nodes=16,
+        zones=4,
+        churn_nodes=2,
+        rate_pods_per_s=100.0,
+        duration_s=2.0,
+        knee_points=(2.0, 20.0),
+        knee_phase_s=1.0,
+        invalidation_rate_per_s=0.5,
+        node_flap_period_s=1.0,
+        flap_down_s=0.3,
+        cold_consumer_period_s=1.5,
+        live_pod_cap=60,
+        batch_size=32,
+        chunk_size=8,
+        warm_pods=32,
+        two_process=False,
+        pace="virtual",  # no sleeping: the smoke is seconds-scale
+        snapshot_every=4,
+        journal_fsync="never",  # container fsync is ~10ms; smoke stays fast
+    )
+
+
+# -- the generators alone ---------------------------------------------------
+
+
+def test_poisson_schedule_is_seeded_and_sorted():
+    a = poisson_offsets(50.0, 10.0, seed=7)
+    b = poisson_offsets(50.0, 10.0, seed=7)
+    c = poisson_offsets(50.0, 10.0, seed=8)
+    assert a == b
+    assert a != c
+    assert a == sorted(a)
+    assert all(0.0 <= t < 10.0 for t in a)
+    # Rate sanity: ~500 expected, Poisson sd ~22.
+    assert 350 < len(a) < 650
+
+
+def test_diurnal_schedule_modulates_rate():
+    offs = diurnal_offsets(
+        base_rate=10.0, peak_rate=100.0, period_s=10.0, duration_s=10.0,
+        seed=5,
+    )
+    assert offs == diurnal_offsets(10.0, 100.0, 10.0, 10.0, seed=5)
+    # The crest (middle of the period) must carry several times the
+    # trough's arrivals.
+    trough = sum(1 for t in offs if t < 2.0 or t >= 8.0)
+    crest = sum(1 for t in offs if 3.0 <= t < 7.0)
+    assert crest > 2 * max(1, trough)
+
+
+def test_coalesce_windows_preserve_indices():
+    offs = [0.05, 0.1, 0.3, 0.31, 0.9]
+    windows = coalesce(offs, 0.25)
+    assert [idxs for _t, idxs in windows] == [[0, 1], [2, 3], [4]]
+    assert [t for t, _ in windows] == [0.0, 0.25, 0.75]
+
+
+def test_scenario_script_is_seeded():
+    kw = dict(
+        nodes=8, churn_nodes=2, invalidation_rate_per_s=5.0,
+        node_flap_period_s=1.0, cold_consumer_period_s=2.0,
+    )
+    a = build_events(5.0, seed=11, **kw)
+    assert a == build_events(5.0, seed=11, **kw)
+    assert a != build_events(5.0, seed=12, **kw)
+    kinds = {e.kind for e in a}
+    assert "flap_down" in kinds and "flap_up" in kinds
+    assert "cold_consumer" in kinds
+    assert kinds & {"inv_capacity", "inv_label", "inv_ns"}
+    assert [e.t for e in a] == sorted(e.t for e in a)
+
+
+def test_workload_mix_is_seeded_and_renames():
+    a = WorkloadMix("mixed", seed=4)
+    b = WorkloadMix("mixed", seed=4)
+    pods_a = [a.pod(i) for i in range(40)]
+    pods_b = [b.pod(i) for i in range(40)]
+    assert [p.uid for p in pods_a] == [p.uid for p in pods_b]
+    assert all(p.metadata.name == f"lg-{i}" for i, p in enumerate(pods_a))
+    assert a.counts == b.counts
+    assert sum(a.counts.values()) == 40
+    with pytest.raises(ValueError):
+        WorkloadMix("no-such-mix", seed=0)
+
+
+# -- the harness end to end -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def soak_artifacts():
+    """Run the smoke soak TWICE with one seed (the determinism
+    contract is the expensive half of the assertion set — share the
+    runs across tests)."""
+    return run_soak(smoke_config()), run_soak(smoke_config())
+
+
+def test_soak_runs_end_to_end_and_populates_fields(soak_artifacts):
+    art, _ = soak_artifacts
+    slo = art["slo"]
+    assert slo["decisions"] > 100
+    assert slo["p50_ms"] >= 0.0
+    assert slo["p99_ms"] >= slo["p50_ms"]
+    assert slo["p999_ms"] >= slo["p99_ms"]
+    assert slo["budget_ms"] == 250.0
+    assert art["sustained_pods_per_sec"] > 0
+    # Knee fields: one point per configured intensity, each populated.
+    knee = art["knee"]
+    assert [p["intensity_per_s"] for p in knee["points"]] == [2.0, 20.0]
+    for p in knee["points"]:
+        assert p["decisions"] > 0
+        assert 0.0 <= p["hit_rate"] <= 1.0
+        assert p["p99_ms"] >= p["p50_ms"] >= 0.0
+    assert knee["miss_cost_ms"] > 0
+    # Speculation served from the cache at least once, missed at least
+    # once (the knee needs both sides).
+    spec = art["speculation"]
+    assert spec["hits"] > 0 and spec["misses"] > 0
+    assert 0.0 < spec["miss_rate"] < 1.0
+    # The sidecar's own stats rode the dump.
+    assert spec["sidecar"]["speculated"] > 0
+    # Journal growth was observed and stayed bounded (the snapshot
+    # cadence truncated at least twice over the stream).
+    j = art["journal"]
+    assert j["dir_sampled"]
+    assert j["compactions_observed"] >= 2
+    assert j["stats"]["truncations"] >= 2
+    assert j["bounded"]
+    # Retirement kept the live set capped.
+    assert art["retired_total"] > 0
+    assert art["bound_final"] <= smoke_config().live_pod_cap
+    # Scenario machinery actually fired.
+    assert art["cold_consumers"] >= 1
+    flaps = sum(
+        p["events"].get("flap_down", 0) for p in art["phases"]
+    )
+    assert flaps >= 1
+
+
+def test_soak_same_seed_same_schedule_and_bindings(soak_artifacts):
+    a, b = soak_artifacts
+    # Identical arrival schedule, offset for offset…
+    assert a["_arrival_offsets"] == b["_arrival_offsets"]
+    assert (
+        a["determinism"]["arrival_sha256"]
+        == b["determinism"]["arrival_sha256"]
+    )
+    # …and bit-identical final bindings.
+    assert (
+        a["determinism"]["bindings_sha256"]
+        == b["determinism"]["bindings_sha256"]
+    )
+    assert a["bound_final"] == b["bound_final"]
+    assert a["determinism"]["arrivals_total"] > 0
+
+
+def test_soak_artifact_is_json_clean(soak_artifacts):
+    art, _ = soak_artifacts
+    doc = strip_private(art)
+    assert "_arrival_offsets" not in doc
+    # The committed-artifact view must round-trip as plain JSON.
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_different_seed_changes_schedule(soak_artifacts):
+    a, _ = soak_artifacts
+    c = run_soak(smoke_config(seed=4))
+    assert (
+        c["determinism"]["arrival_sha256"]
+        != a["determinism"]["arrival_sha256"]
+    )

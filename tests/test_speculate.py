@@ -361,3 +361,57 @@ def test_delete_of_plain_hint_keeps_cache():
     finally:
         client.close()
         srv.close()
+
+
+def test_unparsed_blob_duplicate_of_inflight_pod_does_not_strand_queue():
+    """Soak-found regression (PR 6): a pod arriving BOTH via the queue
+    (informer add / direct Schedule) and in a still-unparsed PendingPods
+    blob must not be re-admitted to the active queue by the mid-batch
+    incremental parse (post_dispatch_hook) while its batch is in flight.
+    With a deep backlog the prefetch pop does not re-absorb the re-added
+    (newest-timestamp) entry, so the commit's queue.done() strands a
+    stale active uid and the NEXT pop_batch KeyErrors into the
+    poison-batch machinery (the KeyError('default/lg-2650') engine
+    fault the first r06 soak recorded)."""
+    from kubernetes_tpu.api import serialize
+    from kubernetes_tpu.sidecar.speculate import SpeculativeFrontend
+
+    sched = TPUScheduler(batch_size=8)
+    front = SpeculativeFrontend(sched)
+    sched.add_node(node("n0", cpu="64"))
+    x = pod("x")
+    # x is queued FIRST (oldest timestamp — it leads the next batch),
+    # then a backlog deep enough that the prefetch pop fills without
+    # ever reaching a re-added x.
+    sched.add_pod(x)
+    for i in range(16):
+        sched.add_pod(pod(f"f{i}"))
+    # The duplicate of x rides a SECOND coalesced blob: the first blob
+    # satisfies the pre-dispatch admission budget (lookahead = 7), so
+    # the incremental parse only reaches x's blob mid-flight, inside
+    # the post-dispatch hook.
+    front.add_hint_blob(
+        b"[" + b",".join(
+            serialize.to_json(pod(f"h{i}")) for i in range(7)
+        ) + b"]"
+    )
+    front.add_hint_blob(b"[" + serialize.to_json(pod("x")) + b"]")
+    out = front._serve_one(x.uid, lambda: pod("x"))
+    assert out.node_name
+    # The queue invariant holds: every active uid still has its info
+    # record; x is not stranded; nothing was quarantined by a recovery
+    # bisect.
+    assert set(sched.queue._in_active) <= set(sched.queue._info)
+    assert x.uid not in sched.queue._in_active
+    assert sched.queue.quarantined() == []
+    # The whole backlog drains cleanly (pre-fix: KeyError -> engine
+    # fault -> bisect -> quarantine).
+    sched.schedule_all_pending()
+    faults = sched.metrics.registry.counter(
+        "scheduler_engine_faults_total"
+    )
+    assert faults.total() == 0
+    bound = {
+        uid for uid, pr in sched.cache.pods.items() if pr.bound
+    }
+    assert {f"default/f{i}" for i in range(16)} | {x.uid} <= bound

@@ -98,14 +98,23 @@ def test_wal_negative_tree_is_clean():
 
 def test_det_rules_fire_on_seeded_violations():
     got = rules_of(lint("det_bad"))
-    assert got.count("det-wallclock") == 1
-    assert got.count("det-random") == 2  # random.random + os.urandom
+    # ops/badop.py seeds one wallclock; loadgen/gen.py seeds another —
+    # the determinism family must cover the traffic generator too (a
+    # soak's replayability is part of the parity story).
+    assert got.count("det-wallclock") == 2
+    assert got.count("det-random") == 3  # random.random + os.urandom + expovariate
     assert got.count("det-set-iteration") == 2  # for-loop + list(set(...))
     assert got.count("det-id-key") == 1
 
 
+def test_det_rules_cover_loadgen():
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/loadgen/gen.py" in paths
+
+
 def test_det_negative_tree_is_clean():
-    # perf_counter, sorted(set), uid keys: the allowed idioms.
+    # perf_counter, sorted(set), uid keys, seeded numpy Generators,
+    # injected clocks: the allowed idioms (ops + loadgen trees).
     assert lint("det_ok").findings == []
 
 
