@@ -10,6 +10,10 @@ Subcommands:
   bench [workload ...]            the scheduler_perf-style harness
   soak [--seconds N ...]          open-loop traffic soak: SLO percentiles,
                                   speculation miss-rate knee, journal growth
+  fleet <action> --map PATH       shard-map administration for the
+                                  partitioned fleet (init/status/split/
+                                  merge/rebalance); serve --shard-of k/N
+                                  joins a process to one shard
   dump --socket PATH              debugger state dump of a live sidecar
   metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
   flight --socket PATH            flight-recorder readout (per-batch phase attribution)
@@ -179,10 +183,38 @@ def _open_journal(journal_dir: str, fsync: bool):
     return lease, journal
 
 
+def _fleet_owner_for(args, sched):
+    """serve --shard-of k/N: bind this process to one shard of the
+    partitioned fleet — load (or initialize) the shard map, install the
+    shard guard, and return the ShardOwner the `fleet` frame dispatches
+    through.  The serve journal (--journal-dir) doubles as the shard's
+    WAL; the shard map file is shared by every owner and the router."""
+    from .fleet import ShardMap, ShardOwner
+
+    k, _, n = args.shard_of.partition("/")
+    shard_id, n_shards = int(k), int(n)
+    if not 0 <= shard_id < n_shards:
+        raise SystemExit(f"--shard-of {args.shard_of}: need 0 <= k < N")
+    if os.path.exists(args.shard_map):
+        shard_map = ShardMap.load(args.shard_map)
+    else:
+        shard_map = ShardMap(n_shards=n_shards)
+        shard_map.save(args.shard_map)
+    return ShardOwner(shard_id, sched, shard_map)
+
+
 def cmd_serve(args) -> int:
     from .sidecar import SidecarServer
 
     sched = _build_scheduler(args)
+    fleet_owner = None
+    if args.shard_of:
+        if not args.journal_dir:
+            # The serve journal doubles as the shard's WAL; an owner
+            # without one would silently no-op every gang_reserve/bind/
+            # handoff append the fleet's convergence story depends on.
+            raise SystemExit("--shard-of requires --journal-dir")
+        fleet_owner = _fleet_owner_for(args, sched)
     lease = None
     if args.leader_elect:
         # Single-active-sidecar guarantee (cmd-level leaderElectAndRun,
@@ -212,6 +244,9 @@ def cmd_serve(args) -> int:
     health = {"leader": True, "leaseFile": args.lease_file} if lease else {}
     if journal is not None:
         health["journalDir"] = args.journal_dir
+    if fleet_owner is not None:
+        health["shard"] = fleet_owner.shard_id
+        health["shardMap"] = args.shard_map
     srv = SidecarServer(
         args.socket,
         scheduler=sched,
@@ -228,6 +263,7 @@ def cmd_serve(args) -> int:
         http_host=args.http_host,
         journal=journal,
         snapshot_every_batches=args.snapshot_every,
+        fleet_owner=fleet_owner,
     )
     if srv.recovery_stats is not None:
         print(
@@ -367,6 +403,41 @@ def cmd_soak(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Shard-map administration (the operator surface of the partitioned
+    fleet): init/status edit nothing but the fsync'd, epoch-versioned map
+    file; split/merge/rebalance mutate the map AND print the handoff
+    record the acquiring owner must journal before the data moves
+    (fleet/router.py apply_handoff orchestrates the live transfer; this
+    command is the offline half)."""
+    from .fleet import ShardMap
+
+    if args.action == "init":
+        m = ShardMap(n_shards=args.shards, n_buckets=args.buckets)
+        m.save(args.map)
+        print(json.dumps({"initialized": args.map, **m.to_doc()}, indent=1))
+        return 0
+    m = ShardMap.load(args.map)
+    if args.action == "status":
+        doc = m.to_doc()
+        doc["shard_buckets"] = {
+            str(s): sum(1 for b in m.buckets if b == s) for s in m.shard_ids()
+        }
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    if args.action == "split":
+        rec = m.split(args.shard, args.new_shard)
+    elif args.action == "merge":
+        rec = m.merge(args.into, args.absorbed)
+    elif args.action == "rebalance":
+        rec = m.rebalance(args.shards)
+    else:
+        raise SystemExit(f"unknown fleet action {args.action!r}")
+    m.save(args.map)
+    print(json.dumps({"handoff": rec, "map": m.to_doc()}, indent=1))
+    return 0
+
+
 def _cli_deadline(args) -> float | None:
     return args.deadline if args.deadline and args.deadline > 0 else None
 
@@ -468,7 +539,38 @@ def main(argv: list[str] | None = None) -> int:
         help="checkpoint the store+queue and truncate the journal every "
         "N batches (0 disables periodic snapshots)",
     )
+    s.add_argument(
+        "--shard-of", default="", metavar="K/N",
+        help="join the partitioned fleet as shard K of N: only shard-map-"
+        "owned nodes are absorbed, and the `fleet` frame (propose/commit/"
+        "reserve/handoff ops) is served (kubernetes_tpu/fleet)",
+    )
+    s.add_argument(
+        "--shard-map", default="/tmp/kubernetes_tpu-shardmap.json",
+        help="fsync'd, epoch-versioned shard-map file shared by every "
+        "owner and the fleet router (created if absent)",
+    )
     s.set_defaults(fn=cmd_serve)
+
+    fle = sub.add_parser(
+        "fleet", help="shard-map administration for the partitioned fleet"
+    )
+    fle.add_argument(
+        "action", choices=("init", "status", "split", "merge", "rebalance")
+    )
+    fle.add_argument("--map", required=True, help="shard-map file path")
+    fle.add_argument("--shards", type=int, default=2,
+                     help="shard count (init/rebalance)")
+    fle.add_argument("--buckets", type=int, default=64,
+                     help="fixed bucket count (init)")
+    fle.add_argument("--shard", type=int, default=0, help="shard to split")
+    fle.add_argument("--new-shard", type=int, default=1,
+                     help="shard receiving the split half")
+    fle.add_argument("--into", type=int, default=0,
+                     help="surviving shard (merge)")
+    fle.add_argument("--absorbed", type=int, default=1,
+                     help="shard being absorbed (merge)")
+    fle.set_defaults(fn=cmd_fleet)
 
     rec = sub.add_parser(
         "recover", help="offline recovery report from a journal directory"

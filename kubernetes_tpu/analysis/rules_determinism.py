@@ -6,8 +6,9 @@ assume the batch engine is a pure function of its inputs — and the soak
 story (loadgen/) assumes the TRAFFIC is too: a generator whose arrivals
 read wall clocks or ambient entropy cannot replay, so same-seed soaks
 could never assert bit-identical bindings.  Code in ``ops/``,
-``engine/``, ``loadgen/`` and the speculative frontend therefore must
-not:
+``engine/``, ``loadgen/``, ``fleet/`` (the router's hash routing and
+host-side selectHost mirror must replay bit-identically too) and the
+speculative frontend therefore must not:
 
 - read wall clocks (``time.time``/``time_ns``, ``datetime.now``/
   ``utcnow``) — ``time.perf_counter``/``monotonic`` stay allowed: they
@@ -66,7 +67,7 @@ class DeterminismRule(Rule):
 
     def files(self, root) -> list[str]:
         rels = ["kubernetes_tpu/sidecar/speculate.py"]
-        for sub in ("ops", "engine", "loadgen"):
+        for sub in ("ops", "engine", "loadgen", "fleet"):
             top = os.path.join(root, "kubernetes_tpu", sub)
             # Recursive: a future subpackage under ops/ or engine/ must not
             # silently escape the determinism contract.

@@ -35,7 +35,11 @@ import ast
 from .core import Finding, Rule, dotted_name, make_key, walk_functions
 
 JOURNAL_SELF_METHODS = {"_journal_append", "_journal_bind", "_journal_mutation"}
-APPLY_MARKERS = {"finish_binding", "quarantine"}
+# Apply markers: finish_binding / quarantine (the single-scheduler commit
+# paths) plus apply_handoff — the fleet's shard-transfer apply
+# (fleet/owner.py import_nodes): a handoff made live without its journal
+# record first is a transfer the next takeover cannot redo.
+APPLY_MARKERS = {"finish_binding", "quarantine", "apply_handoff"}
 
 
 def _is_journal_call(call: ast.Call) -> bool:
@@ -64,6 +68,12 @@ class WalRule(Rule):
         return [
             "kubernetes_tpu/scheduler.py",
             "kubernetes_tpu/queue.py",
+            # The fleet's handoff/intent append sites ride the same
+            # discipline: gang_reserve/gang_abort/handoff records are
+            # appended by scheduler.py's fleet surface (already covered),
+            # and the owner/router transfer paths carry apply_handoff.
+            "kubernetes_tpu/fleet/owner.py",
+            "kubernetes_tpu/fleet/router.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:

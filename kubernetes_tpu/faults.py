@@ -186,6 +186,10 @@ class FaultPlan:
 KILL_POINTS = (
     "pre-append", "post-append", "torn-append", "pre-snapshot",
     "mid-snapshot", "mid-truncate", "post-truncate",
+    # Fleet handoff window (fleet/shardmap.py): the transfer is journaled
+    # but the shard-map file rewrite has not landed — takeover must redo
+    # the idempotent rewrite from the journal.
+    "pre-map-write",
 )
 
 

@@ -1,0 +1,342 @@
+"""One shard's owner: a TPUScheduler scoped to the shard's nodes behind
+its own lease epoch and write-ahead journal.
+
+The owner is deliberately thin — the scheduler already knows how to
+evaluate, reserve, commit, journal, and recover; this class binds one
+instance to a shard identity (the shard-map predicate installed as
+``shard_guard``), a ``FileLease`` whose epoch fences the shard's
+journal, and the fleet protocol surface the router drives:
+
+- ``propose`` / ``commit`` / ``reserve`` / ``commit_reserved`` /
+  ``abort`` — the scatter-gather schedule + gang 2PC halves
+  (scheduler.propose_pod and friends);
+- ``preempt_propose`` / ``preempt_execute`` — the cross-shard
+  preemption halves (a partition cannot pick a victim on a foreign
+  shard locally);
+- ``export_nodes`` / ``import_nodes`` — the journaled handoff payload
+  (split/merge/rebalance/takeover move nodes WITH their bound pods,
+  and the acquiring owner write-ahead journals every imported binding
+  so its shard stays self-contained for the next failover).
+
+``fleet_dispatch`` is the single wire entry point: the sidecar server's
+``fleet`` Envelope frame routes ``{op, payload}`` JSON here, so an
+owner process started with ``serve --shard-of k/N`` speaks the same
+protocol as an in-process owner."""
+
+from __future__ import annotations
+
+import os
+
+from ..api import serialize, types as t
+from ..framework.leaderelection import FileLease, read_epoch
+from ..journal import Journal, recover as journal_recover
+from .shardmap import ShardMap
+
+
+class ShardOwner:
+    def __init__(
+        self,
+        shard_id: int,
+        scheduler,
+        shard_map: ShardMap | None = None,
+        state_dir: str | None = None,
+        journal_fsync: bool = True,
+        snapshot_every_batches: int = 8,
+    ) -> None:
+        self.shard_id = shard_id
+        self.sched = scheduler
+        self.shard_map = shard_map
+        self.state_dir = state_dir
+        self.lease: FileLease | None = None
+        self.journal: Journal | None = None
+        self.recovery_stats: dict | None = None
+        self.handoffs_in = 0
+        self.handoffs_out = 0
+        if shard_map is not None:
+            scheduler.shard_guard = (
+                lambda name: shard_map.owner_of(name) == shard_id
+            )
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            lease_path = os.path.join(state_dir, "lease")
+            self.lease = FileLease(
+                lease_path, identity=f"shard{shard_id}-{os.getpid()}"
+            )
+            self.lease.acquire(block=True)
+            self.journal = Journal(
+                state_dir,
+                epoch=self.lease.epoch,
+                fence=lambda: read_epoch(lease_path),
+                fsync=journal_fsync,
+            )
+            # Recover BEFORE arming the write-ahead hooks (the replay
+            # drives the scheduler's own mutation surface).
+            self.recovery_stats = journal_recover(scheduler, self.journal)
+            scheduler.attach_journal(
+                self.journal, snapshot_every_batches=snapshot_every_batches
+            )
+
+    # -- object feed -------------------------------------------------------
+
+    def add_object(self, kind: str, obj) -> None:
+        getattr(self.sched, serialize.KINDS[kind][1])(obj)
+
+    def remove_object(self, kind: str, uid: str) -> dict | None:
+        """Returns the freed-capacity summary for a Pod delete (the
+        router's POD_DELETE wake hint — only this owner can see the
+        node's host arrays), or — for a Node delete — the identities of
+        the bound pods that vanished with it, so the router can purge
+        its routing entries and debit fleet-wide gang credit."""
+        if kind == "Node":
+            dropped = [
+                pr.pod
+                for pr in self.sched.cache.pods.values()
+                if pr.bound and pr.node_name == uid
+            ]
+            self.sched.remove_node(uid)
+            return {
+                "dropped": sorted(p.uid for p in dropped),
+                "dropped_groups": sorted(
+                    p.spec.pod_group for p in dropped if p.spec.pod_group
+                ),
+            }
+        if kind == "Pod":
+            pr = self.sched.cache.pods.get(uid)
+            node = pr.node_name if pr is not None else None
+            self.sched.delete_pod(uid)
+            return self.sched.fleet_free_ctx([node]) if node else None
+        raise ValueError(f"cannot remove kind {kind}")
+
+    # -- the scatter-gather schedule surface -------------------------------
+
+    def propose(self, pod: t.Pod) -> dict:
+        return self.sched.propose_pod(pod)
+
+    def commit(self, pod: t.Pod, node_name: str):
+        return self.sched.commit_proposed(pod, node_name)
+
+    def reserve(self, pod: t.Pod, node_name: str, gang: str) -> bool:
+        return self.sched.reserve_proposed(pod, node_name, gang=gang)
+
+    def commit_reserved(self, uid: str):
+        return self.sched.commit_reserved(uid)
+
+    def abort(self, uid: str) -> None:
+        self.sched.abort_reserved(uid)
+
+    def preempt_propose(self, pod: t.Pod) -> dict | None:
+        return self.sched.preempt_propose(pod)
+
+    def preempt_execute(
+        self, pod: t.Pod, node_name: str, victim_uids: list[str]
+    ) -> dict:
+        return self.sched.execute_preemption(pod, node_name, victim_uids)
+
+    # -- handoff (split / merge / rebalance / takeover) --------------------
+
+    def export_nodes(self, names: list[str]) -> dict:
+        """Serialize the named nodes + their bound pods for a handoff.
+        The exporting side drops them AFTER the acquiring side has
+        journaled the import (the router orchestrates the order)."""
+        nodes, pods = [], []
+        for name in names:
+            rec = self.sched.cache.nodes.get(name)
+            if rec is None:
+                continue
+            nodes.append(serialize.to_dict(rec.node))
+            for pr in self.sched.cache.pods.values():
+                if pr.bound and pr.node_name == name:
+                    pods.append(
+                        {"pod": serialize.to_dict(pr.pod), "node": name}
+                    )
+        return {"nodes": nodes, "pods": pods}
+
+    def drop_nodes(self, names: list[str]) -> None:
+        """The exporting half's release: forget the nodes (and with them
+        their bound pods) once the acquiring owner holds them durably."""
+        for name in names:
+            if name in self.sched.cache.nodes:
+                self.sched.remove_node(name)
+        self.handoffs_out += 1
+
+    def import_nodes(self, record: dict, payload: dict) -> None:
+        """The acquiring half: journal the handoff record FIRST (a crash
+        after the append and before the map write is redone from the
+        journal — shardmap.py), then apply the transfer.  The WAL rule
+        (analysis/rules_wal.py) machine-checks this ordering: the
+        apply_handoff marker must be dominated by a journal append."""
+        sched = self.sched
+        sched._journal_append("handoff", **record)
+        self.apply_handoff(payload)
+
+    def apply_handoff(self, payload: dict) -> None:
+        """Make a journaled handoff live: adopt the nodes, then journal +
+        apply every transferred binding so this shard's journal alone can
+        reproduce its state at the next failover."""
+        sched = self.sched
+        for data in payload.get("nodes", ()):
+            node = serialize.build(serialize.KINDS["Node"][0], data)
+            sched.add_node(node)
+        for entry in payload.get("pods", ()):
+            pod = serialize.pod_from_data(entry["pod"])
+            pod.spec.node_name = entry["node"]
+            sched._journal_bind(pod, entry["node"])
+            sched.add_pod(pod)
+        self.handoffs_in += 1
+
+    def apply_recovered_bindings(self) -> int:
+        """Journal bind records whose node was unknown at replay time
+        (scheduler._recovered_bindings) re-apply once the host-truth
+        relist delivered the node — the shard-local half of
+        informers.reconcile_after_recovery.  Bindings whose node never
+        relisted are dropped (the node is truly gone; the pods
+        reschedule through the router)."""
+        sched = self.sched
+        pending = getattr(sched, "_recovered_bindings", None) or {}
+        applied = 0
+        for uid, d in sorted(pending.items()):
+            if d["node"] in sched.cache.nodes:
+                pod = serialize.pod_from_data(d["pod"])
+                pod.spec.node_name = d["node"]
+                sched.add_pod(pod)
+                applied += 1
+            pending.pop(uid, None)
+        return applied
+
+    # -- cluster-global side effects mirrored locally ----------------------
+
+    def debit_pdb(self, name: str, n: int) -> None:
+        self.sched.apply_pdb_debit(name, n)
+
+    def free_ctx(self, names: list[str]) -> dict | None:
+        return self.sched.fleet_free_ctx(names)
+
+    # -- the uniform call surface ------------------------------------------
+
+    def call(self, op: str, payload: dict) -> dict:
+        """The router's single entry point — identical semantics whether
+        the owner is in-process (here) or behind the sidecar socket
+        (WireShardOwner): JSON-dict in, JSON-dict out."""
+        return fleet_dispatch(self, op, payload)
+
+    # -- observability -----------------------------------------------------
+
+    def bindings(self) -> dict:
+        return {
+            uid: pr.node_name
+            for uid, pr in sorted(self.sched.cache.pods.items())
+            if pr.bound
+        }
+
+    def stats(self) -> dict:
+        out = {
+            "shard": self.shard_id,
+            "nodes": len(self.sched.cache.nodes),
+            "bound_pods": sum(
+                1 for pr in self.sched.cache.pods.values() if pr.bound
+            ),
+            "rejected_nodes": self.sched.shard_rejected_nodes,
+            "handoffs_in": self.handoffs_in,
+            "handoffs_out": self.handoffs_out,
+            "epoch": self.lease.epoch if self.lease else 0,
+        }
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        if self.recovery_stats is not None:
+            out["recovery"] = self.recovery_stats
+        return out
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+        if self.lease is not None:
+            self.lease.release()
+
+
+def fleet_dispatch(owner: ShardOwner, op: str, payload: dict) -> dict:
+    """The wire entry point: one ``fleet`` Envelope frame = one op.
+    Pods ride as canonical JSON dicts (the AddObject convention); every
+    response is a JSON-clean dict."""
+    if op == "propose":
+        return owner.propose(serialize.pod_from_data(payload["pod"]))
+    if op == "commit":
+        o = owner.commit(
+            serialize.pod_from_data(payload["pod"]), payload["node"]
+        )
+        return {"bound": o.node_name if o is not None else None}
+    if op == "reserve":
+        ok = owner.reserve(
+            serialize.pod_from_data(payload["pod"]),
+            payload["node"],
+            payload.get("gang", ""),
+        )
+        return {"ok": ok}
+    if op == "commit_reserved":
+        o = owner.commit_reserved(payload["uid"])
+        return {"bound": o.node_name if o is not None else None}
+    if op == "abort":
+        owner.abort(payload["uid"])
+        return {}
+    if op == "preempt_propose":
+        cand = owner.preempt_propose(serialize.pod_from_data(payload["pod"]))
+        return cand if cand is not None else {}
+    if op == "preempt_execute":
+        return owner.preempt_execute(
+            serialize.pod_from_data(payload["pod"]),
+            payload["node"],
+            payload.get("victims", []),
+        )
+    if op == "add":
+        owner.add_object(
+            payload["kind"],
+            serialize.build(
+                serialize.KINDS[payload["kind"]][0], payload["object"]
+            ),
+        )
+        return {}
+    if op == "remove":
+        res = owner.remove_object(payload["kind"], payload["uid"])
+        if payload["kind"] == "Node":
+            return res or {}
+        return {"freed": res} if res is not None else {}
+    if op == "reconcile":
+        return {"applied": owner.apply_recovered_bindings()}
+    if op == "pdb_debit":
+        owner.debit_pdb(payload["name"], payload["n"])
+        return {}
+    if op == "free_ctx":
+        ctx = owner.free_ctx(payload["names"])
+        return ctx if ctx is not None else {}
+    if op == "export_nodes":
+        return owner.export_nodes(payload["names"])
+    if op == "drop_nodes":
+        owner.drop_nodes(payload["names"])
+        return {}
+    if op == "import_nodes":
+        owner.import_nodes(payload["record"], payload["payload"])
+        return {}
+    if op == "bindings":
+        return {
+            "bindings": owner.bindings(),
+            # Per-gang bound counts on THIS shard — the router sums them
+            # to rebuild fleet-wide quorum credit after a takeover.
+            "gang_bound": dict(owner.sched.gang_bound),
+        }
+    if op == "stats":
+        return owner.stats()
+    raise ValueError(f"unknown fleet op {op!r}")
+
+
+class WireShardOwner:
+    """A shard owner behind the sidecar socket (``serve --shard-of``):
+    the same ``call`` surface as an in-process ShardOwner, carried by the
+    ``fleet`` Envelope frame (sidecar/server.py).  The router cannot tell
+    the difference — which is the point: the in-process fleet the tests
+    oracle against and the multi-process fleet an operator deploys run
+    the same protocol."""
+
+    def __init__(self, client) -> None:
+        self.client = client  # SidecarClient / ResyncingClient
+
+    def call(self, op: str, payload: dict) -> dict:
+        return self.client.fleet(op, payload)

@@ -1,0 +1,686 @@
+"""The fleet router: the thin front door of the partitioned control plane.
+
+One router + N shard owners reproduce ONE scheduler's decisions.  The
+router owns global admission (a real ``SchedulingQueue`` — arrival order,
+backoff, precise fit-wake hints, gang parking) and the two decisions a
+partition cannot make locally; the owners own everything else (evaluation,
+reserve chains, journaling, recovery) behind their own lease epochs.
+
+Scatter-gather scheduling
+    For each popped pod the router gathers eval-only per-node verdicts
+    from every shard (``propose`` — the same compiled pass the extender
+    path uses) and makes the global selectHost decision ITSELF, with the
+    device kernel's exact math mirrored on the host: highest total score
+    wins, ties resolved by the splitmix32 counter hash over snapshot row
+    order (engine/pass_.py ``select_host`` / ``_hash_u32``), the counter
+    being the same ``_cycle`` sequence a single scheduler would have
+    burned.  Global row order is reconstructed by mirroring the cache's
+    row allocator (LIFO free list) over the fleet-wide node feed.  The
+    winner commits on its shard.  This reproduces the single scheduler
+    bit-identically whenever per-node verdicts are shard-independent —
+    true for filter semantics and additive per-node scores; score ops
+    that normalize over the global candidate set trade exactness for
+    partition locality (the Tesserae compromise: partition the cluster,
+    preserve the constraints that matter).
+
+Routing and misroutes
+    Each pod hashes to a HOME shard (crc32 over its uid, skipping shards
+    that currently own no nodes — the feasibility-aware part).  The hash
+    predicts locality; the global argmax decides.  A winner other than
+    the home shard is a MISROUTE: the pod is forwarded to the winning
+    owner and counted (``scheduler_fleet_forwarded_pods_total``).
+
+Cross-shard preemption
+    A pod with no feasible node scatter-gathers DRY-RUN candidates
+    (``preempt_propose`` — nothing applied), compares them by the
+    pickOneNodeForPreemption lexicographic key + global row order, and
+    executes only the winner on its owning shard.  Per-shard minimization
+    followed by a cross-shard key compare equals one global minimization
+    because every criterion is a per-candidate property.  PDB debits are
+    broadcast so every shard's future violation counts stay global;
+    nominations and their fit-overlay claims need no broadcast — the
+    freed node lives on the shard that holds the nominator entry.
+
+Gang admission spanning shards (two-phase reserve/commit)
+    Members admitted by the queue's quorum gate reserve on their winning
+    shards (phase 1: ``gang_reserve`` intent journaled, resources
+    assumed, Reserve chain run); when reserved + already-bound credit
+    reaches minMember the router commits every reservation (phase 2:
+    journaled bind).  A crash between phases leaves intents without bind
+    records — recovery resolves them PRESUMED ABORT (journal.recover) and
+    the router re-admits the gang from scratch, so the fleet converges.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api import serialize, types as t
+from ..queue import Event, EventCtx, QueuedPodInfo, SchedulingQueue
+from ..scheduler import ScheduleOutcome
+from .shardmap import ShardMap, stable_shard_hash
+
+
+def _hash_u32(x: int) -> int:
+    """Host mirror of engine/pass_.py ``_hash_u32`` (splitmix32-style
+    avalanche, uint32 wraparound) — the tie-break RNG must be bit-equal
+    to the device kernel's or fleet and single-scheduler picks diverge on
+    score ties."""
+    x &= 0xFFFFFFFF
+    x = ((x ^ (x >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+    x = ((x ^ (x >> 15)) * 0x846CA68B) & 0xFFFFFFFF
+    return (x ^ (x >> 16)) & 0xFFFFFFFF
+
+
+@dataclass
+class _GangRoom:
+    """Reserved-but-uncommitted members of one gang (phase 1 done)."""
+
+    members: list[tuple[str, int]] = field(default_factory=list)  # (uid, shard)
+    pods: dict[str, t.Pod] = field(default_factory=dict)
+    # The queue infos, kept so a rollback re-parks members with their
+    # attempt counts intact (queue.requeue_gang_member's contract).
+    qps: dict[str, QueuedPodInfo] = field(default_factory=dict)
+    # The ScheduleOutcome emitted at reserve time, per member — phase 2
+    # flips node_name on these in place, so the batch that reached
+    # quorum reports EVERY member bound (the queue admits a gang into
+    # one batch, so the outcomes are still in flight when commit runs).
+    outcomes: dict[str, "ScheduleOutcome"] = field(default_factory=dict)
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        owners: dict,
+        shard_map: ShardMap,
+        batch_size: int = 256,
+        tie_break_seed: int = 0,
+        registry=None,
+    ) -> None:
+        self.owners = dict(owners)
+        self.shard_map = shard_map
+        self.batch_size = batch_size
+        self.tie_break_seed = tie_break_seed
+        self.queue = SchedulingQueue()
+        # Fleet-wide gang credit: bound members across EVERY shard plus
+        # reservations held in the 2PC rooms — the same quantity the
+        # single scheduler's gang_bound+permit_waiting lambda feeds its
+        # queue (scheduler.py), so quorum admission decisions agree.
+        self.gang_bound: dict[str, int] = {}
+        self._gang_rooms: dict[str, _GangRoom] = {}
+        self.gang_min: dict[str, int] = {}
+        self.queue.gang_credit = lambda g: self.gang_bound.get(g, 0) + (
+            len(self._gang_rooms[g].members) if g in self._gang_rooms else 0
+        )
+        for owner in self.owners.values():
+            # In-process owners consult the fleet-wide credit from their
+            # own admission gates too (scheduler.fleet_gang_credit).
+            sched = getattr(owner, "sched", None)
+            if sched is not None:
+                sched.fleet_gang_credit = (
+                    lambda g: self.gang_bound.get(g, 0)
+                )
+        # Mirror of the single scheduler's cache row allocator (LIFO free
+        # list) over the FLEET-WIDE node feed: global position ==
+        # the snapshot row a single scheduler would have assigned, which
+        # is the tie-break enumeration order select_host uses.
+        self._node_pos: dict[str, int] = {}
+        self._free_pos: list[int] = []
+        self._next_pos = 0
+        # Live nodes per shard, maintained incrementally (add_node /
+        # remove_object / apply_handoff) — home_shard consults this per
+        # pod, and recomputing it would cost one crc32 per node per pod.
+        self._shard_node_count: dict[int, int] = {}
+        # Where each bound pod lives (commit bookkeeping + removals).
+        self._pod_shard: dict[str, int] = {}
+        # Outcomes flipped by a gang commit — drained by schedule_batch,
+        # so a member reserved in an EARLIER batch (reported unbound
+        # there) still surfaces as bound in the batch whose quorum
+        # committed it.
+        self._gang_committed: list[ScheduleOutcome] = []
+        # The single scheduler's _cycle sequence (tie-break step counter).
+        self._cycle = 0
+        self.profile_filters: tuple[str, ...] = ()
+        # -- observability (the scheduler_fleet_* families) ---------------
+        if registry is None:
+            from ..framework.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._shard_nodes = registry.gauge(
+            "scheduler_fleet_shard_nodes",
+            "Nodes owned per shard (the shard-map ownership gauge).",
+        )
+        self._cross_calls = registry.counter(
+            "scheduler_fleet_cross_shard_calls_total",
+            "Fleet protocol calls issued to shard owners, by op.",
+        )
+        self._forwarded = registry.counter(
+            "scheduler_fleet_forwarded_pods_total",
+            "Pods committed on a shard other than their hash-routed home "
+            "(misroutes forwarded to the global winner).",
+        )
+        self._handoffs = registry.counter(
+            "scheduler_fleet_handoffs_total",
+            "Shard-map handoffs orchestrated (split/merge/assign/"
+            "rebalance/takeover), by op.",
+        )
+        self._preempt_xshard = registry.counter(
+            "scheduler_fleet_cross_shard_preemptions_total",
+            "Preemptions where the preemptor's home and the victim's "
+            "shard differ.",
+        )
+        self._gang_commits = registry.counter(
+            "scheduler_fleet_gang_commits_total",
+            "Gang 2PC phase transitions, by phase (reserve/commit/abort).",
+        )
+
+    # -- owner RPC ---------------------------------------------------------
+
+    def _call(self, shard: int, op: str, payload: dict) -> dict:
+        self._cross_calls.inc(op=op)
+        return self.owners[shard].call(op, payload)
+
+    def shard_ids(self) -> list[int]:
+        return sorted(self.owners)
+
+    # -- the object feed (the informer surface, partitioned) ---------------
+
+    def add_object(self, kind: str, obj) -> None:
+        if kind == "Node":
+            self.add_node(obj)
+            return
+        if kind == "Pod" and not obj.spec.node_name:
+            self.add_pod(obj)
+            return
+        data = serialize.to_dict(obj)
+        if kind == "Pod":
+            # A bound pod belongs to the shard owning its node; everything
+            # else is cluster-scoped state every owner needs (PDBs for
+            # violation counts, PodGroups for reserve plugins, volumes…).
+            shard = self.shard_map.owner_of(obj.spec.node_name)
+            known = obj.uid in self._pod_shard
+            self._pod_shard[obj.uid] = shard
+            self._call(shard, "add", {"kind": kind, "object": data})
+            g = obj.spec.pod_group
+            if g and not known:
+                # Re-deliveries (and takeover re-feeds of adopted
+                # bindings) must not double-count quorum credit.
+                self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
+            return
+        if kind == "PodGroup":
+            self.gang_min[obj.name] = obj.min_member
+            self.queue.register_gang(obj.name, obj.min_member)
+        for shard in self.shard_ids():
+            self._call(shard, "add", {"kind": kind, "object": data})
+
+    def add_node(self, node: t.Node) -> None:
+        shard = self.shard_map.owner_of(node.name)
+        if node.name not in self._node_pos:
+            pos = self._free_pos.pop() if self._free_pos else self._next_pos
+            if pos == self._next_pos:
+                self._next_pos += 1
+            self._node_pos[node.name] = pos
+            self._shard_node_count[shard] = (
+                self._shard_node_count.get(shard, 0) + 1
+            )
+        self._call(
+            shard, "add", {"kind": "Node", "object": serialize.to_dict(node)}
+        )
+        self._shard_nodes.set(
+            self._call(shard, "stats", {})["nodes"], shard=str(shard)
+        )
+        ctx = self._call(shard, "free_ctx", {"names": [node.name]})
+        self.queue.on_event(Event.NODE_ADD, self._ctx(ctx))
+
+    def add_pod(self, pod: t.Pod) -> None:
+        if pod.uid in self._pod_shard:
+            # Already bound on some shard (a recovery re-feed, or an
+            # at-least-once informer re-delivery): the committed placement
+            # IS the decision — re-queueing would double-schedule.
+            return
+        self.queue.add(pod)
+
+    def reconcile_recovered(self) -> int:
+        """After a takeover's node re-feed: every owner re-applies journal
+        bind records that were parked because their node was unknown at
+        replay time (owner.apply_recovered_bindings).  Call before
+        adopt_bindings so adopted routing covers the late bindings."""
+        return sum(
+            self._call(s, "reconcile", {})["applied"] for s in self.shard_ids()
+        )
+
+    def adopt_bindings(self) -> None:
+        """Rebuild the router's bookkeeping from the owners' recovered
+        truth (takeover/restart): pod→shard routing and fleet-wide gang
+        credit come back from each shard's journal-recovered cache, so an
+        idempotent re-feed of the scenario skips what already committed."""
+        for shard in self.shard_ids():
+            res = self._call(shard, "bindings", {})
+            for uid in res["bindings"]:
+                self._pod_shard[uid] = shard
+            for g, n in res.get("gang_bound", {}).items():
+                self.gang_bound[g] = self.gang_bound.get(g, 0) + n
+
+    def remove_object(self, kind: str, uid: str) -> None:
+        if kind == "Node":
+            shard = self.shard_map.owner_of(uid)
+            res = self._call(shard, "remove", {"kind": "Node", "uid": uid})
+            pos = self._node_pos.pop(uid, None)
+            if pos is not None:
+                self._free_pos.append(pos)
+                left = self._shard_node_count.get(shard, 0) - 1
+                if left > 0:
+                    self._shard_node_count[shard] = left
+                else:
+                    self._shard_node_count.pop(shard, None)
+            # The node's bound pods vanished with it on the owner —
+            # purge the router's routing entries (an informer re-feed
+            # must be able to reschedule them, like the single
+            # scheduler's unbound re-add) and debit fleet-wide gang
+            # credit for evaporated members, or a later gang would
+            # count ghosts toward quorum.
+            for puid in res.get("dropped", ()):
+                self._pod_shard.pop(puid, None)
+            for g in res.get("dropped_groups", ()):
+                n = self.gang_bound.get(g, 0) - 1
+                if n > 0:
+                    self.gang_bound[g] = n
+                else:
+                    self.gang_bound.pop(g, None)
+            self._shard_nodes.set(
+                self._call(shard, "stats", {})["nodes"], shard=str(shard)
+            )
+            return
+        if kind != "Pod":
+            raise ValueError(f"cannot remove kind {kind}")
+        shard = self._pod_shard.pop(uid, None)
+        if shard is not None:
+            res = self._call(shard, "remove", {"kind": "Pod", "uid": uid})
+            self.queue.on_event(Event.POD_DELETE, self._ctx(res.get("freed")))
+        else:
+            self.queue.delete(uid)
+
+    @staticmethod
+    def _ctx(doc: dict | None) -> EventCtx | None:
+        if not doc:
+            return None
+        return EventCtx(
+            max_free=np.asarray(doc["max_free"], np.int64),
+            max_slots=doc["max_slots"],
+        )
+
+    # -- routing -----------------------------------------------------------
+
+    def home_shard(self, pod: t.Pod) -> int:
+        """Feasibility-aware hash route: crc32 over the pod uid across
+        the shards that currently own nodes (an empty shard can never
+        host, so hashing a pod there would guarantee a misroute).  The
+        per-shard node counts are maintained incrementally — this runs
+        once per scheduled pod."""
+        viable = sorted(
+            s for s in self.shard_ids() if self._shard_node_count.get(s)
+        ) or self.shard_ids()
+        return viable[stable_shard_hash(pod.uid, len(viable))]
+
+    # -- scatter-gather scheduling ----------------------------------------
+
+    def _propose_all(self, pod: t.Pod) -> dict[int, dict]:
+        data = serialize.to_dict(pod)
+        return {
+            shard: self._call(shard, "propose", {"pod": data})
+            for shard in self.shard_ids()
+        }
+
+    def _select(
+        self, proposals: dict[int, dict], pod: t.Pod, step: int
+    ) -> tuple[str, int] | None:
+        """The global selectHost: (node, shard) or None.  Mirrors
+        select_host exactly — nominated fast path first, then argmax with
+        the counter-hash tie-break enumerated in global row order."""
+        nn = pod.status.nominated_node_name
+        if nn:
+            for shard, prop in proposals.items():
+                if prop.get("nominated") == nn:
+                    return nn, shard
+        cands: list[tuple[int, str, int, int]] = []  # (pos, name, shard, score)
+        for shard, prop in proposals.items():
+            for name, score in zip(prop["feasible"], prop["scores"]):
+                pos = self._node_pos.get(name)
+                if pos is not None:
+                    cands.append((pos, name, shard, score))
+        if not cands:
+            return None
+        cands.sort()
+        best = max(c[3] for c in cands)
+        ties = [c for c in cands if c[3] == best]
+        tie_rand = _hash_u32(
+            (self.tie_break_seed * 0x9E3779B1 + step) & 0xFFFFFFFF
+        )
+        pick = ties[tie_rand % len(ties)]
+        return pick[1], pick[2]
+
+    def _schedule_one(
+        self, qp: QueuedPodInfo, step: int
+    ) -> tuple[ScheduleOutcome, bool]:
+        """One scatter-gather cycle.  Returns (outcome, run_postfilter):
+        preemption is NOT attempted here — the single scheduler runs
+        PostFilter after the whole batch scan (scheduler._complete_batch),
+        and committing evictions mid-batch would show later batch-mates a
+        state the oracle's in-scan evaluation never saw."""
+        pod = qp.pod  # attempts already bumped by pop_batch
+        home = self.home_shard(pod)
+        proposals = self._propose_all(pod)
+        req = proposals[home].get("req")
+        if req is not None:
+            # The fit-wake hint's request vector (the single scheduler
+            # keeps the featurized delta on the queued info the same way).
+            qp.delta = {"req": np.asarray(req, np.int64)}
+        picked = self._select(proposals, pod, step)
+        g = pod.spec.pod_group
+        if picked is None:
+            if g and g in self.gang_min:
+                # A gang member with no feasible node sinks the whole
+                # gang (all-or-nothing): abort every held reservation
+                # and re-admit damped — leaving the partial room parked
+                # would strand reserved capacity on the other shards.
+                self._rollback_gang(g)
+                self.queue.add_backoff(qp)
+                return ScheduleOutcome(pod, None), False
+            return ScheduleOutcome(pod, None), True
+        node_name, shard = picked
+        if shard != home:
+            self._forwarded.inc()
+        if g and g in self.gang_min:
+            return self._reserve_gang_member(qp, node_name, shard, g), False
+        res = self._call(
+            shard,
+            "commit",
+            {"pod": serialize.to_dict(pod), "node": node_name},
+        )
+        if res.get("bound") is None:
+            # A Reserve plugin refused on the winner — the cycle-error
+            # path: retry behind backoff (handleSchedulingFailure), no
+            # PostFilter (the pod was feasible; the refusal is transient).
+            self.queue.add_backoff(qp)
+            return ScheduleOutcome(pod, None), False
+        self._pod_shard[pod.uid] = shard
+        self.queue.done(pod.uid)
+        return ScheduleOutcome(pod, node_name), False
+
+    def _postfilter(self, qp: QueuedPodInfo, outcome: ScheduleOutcome) -> None:
+        """The batch-completion failure path (one failed pod): cross-shard
+        preemption, else the unschedulable pool.  Known divergence from
+        the single scheduler: same-batch preemptors dry-run sequentially
+        here (each sees the previous one's evictions) where the batched
+        engine dry-runs them against one snapshot with consumed-victim
+        dedup — identical for a single preemptor per batch."""
+        pod = qp.pod
+        res = self._preempt(pod)
+        if res is not None:
+            outcome.nominated_node = res["node"]
+            outcome.victims = len(res["victims"])
+            outcome.victim_uids = tuple(res["victims"])
+            # The nominated retry re-enters the ACTIVE queue (the single
+            # scheduler's _record_preemption does queue.add, not backoff).
+            self.queue.add(pod)
+            return
+        # No candidate anywhere: park on the unschedulable pool.  The
+        # proposals carry no per-plugin diagnosis, so the requeue mask is
+        # the profile's whole filter set — the same fallback the single
+        # scheduler takes for an empty diagnosis.
+        self.queue.add_unschedulable(
+            qp, set(self.profile_filters) or {"NodeResourcesFit"}
+        )
+
+    # -- cross-shard preemption -------------------------------------------
+
+    def _preempt(self, pod: t.Pod) -> dict | None:
+        data = serialize.to_dict(pod)
+        cands: list[tuple[list, int, int, dict]] = []
+        for shard in self.shard_ids():
+            prop = self._call(shard, "preempt_propose", {"pod": data})
+            if not prop or "node" not in prop:
+                continue
+            pos = self._node_pos.get(prop["node"])
+            if pos is None:
+                continue
+            cands.append((prop["key"], pos, shard, prop))
+        if not cands:
+            return None
+        key, _pos, shard, prop = min(cands, key=lambda c: (c[0], c[1]))
+        res = self._call(
+            shard,
+            "preempt_execute",
+            {
+                "pod": data,
+                "node": prop["node"],
+                "victims": [v["uid"] for v in prop["victims"]],
+            },
+        )
+        if shard != self.home_shard(pod):
+            self._preempt_xshard.inc()
+        # Cluster-global side effects of a shard-local eviction: PDB
+        # budgets everywhere, fleet-wide gang credit, the router's own
+        # pod→shard map, and the freed-capacity wake hint.
+        for debit in res.get("pdb_debits", ()):
+            for other in self.shard_ids():
+                if other != shard:
+                    self._call(other, "pdb_debit", debit)
+        for g in res.get("victim_groups", ()):
+            left = self.gang_bound.get(g, 0) - 1
+            if left > 0:
+                self.gang_bound[g] = left
+            else:
+                self.gang_bound.pop(g, None)
+        for uid in res["victims"]:
+            self._pod_shard.pop(uid, None)
+        pod.status.nominated_node_name = res["node"]
+        self.queue.on_event(Event.POD_DELETE, self._ctx(res.get("freed")))
+        return res
+
+    # -- gang 2PC ----------------------------------------------------------
+
+    def _reserve_gang_member(
+        self, qp: QueuedPodInfo, node_name: str, shard: int, g: str
+    ) -> ScheduleOutcome:
+        pod = qp.pod
+        ok = self._call(
+            shard,
+            "reserve",
+            {"pod": serialize.to_dict(pod), "node": node_name, "gang": g},
+        )
+        if not ok.get("ok"):
+            self._rollback_gang(g)
+            self.queue.add_backoff(qp)
+            return ScheduleOutcome(pod, None)
+        self._gang_commits.inc(phase="reserve")
+        room = self._gang_rooms.setdefault(g, _GangRoom())
+        room.members.append((pod.uid, shard))
+        room.pods[pod.uid] = pod
+        room.qps[pod.uid] = qp
+        out = ScheduleOutcome(pod, None)
+        room.outcomes[pod.uid] = out
+        self.queue.done(pod.uid)
+        # Phase 2 fires the moment quorum is reachable: reservations in
+        # the room plus members already bound anywhere in the fleet.
+        if len(room.members) + self.gang_bound.get(g, 0) >= self.gang_min.get(
+            g, 1
+        ):
+            self._commit_gang(g, pod)
+        else:
+            # Reserve credit grew (the room counts toward gang_credit):
+            # parked mates may now be admissible — the router's analog of
+            # the coscheduling plugin's post-batch re-attempt.  Damped:
+            # re-admission goes through backoff.
+            self.queue.readmit_gang(g)
+        return out
+
+    def _commit_gang(self, g: str, trigger: t.Pod) -> None:
+        room = self._gang_rooms.pop(g)
+        for uid, shard in room.members:
+            res = self._call(shard, "commit_reserved", {"uid": uid})
+            self._gang_commits.inc(phase="commit")
+            self._pod_shard[uid] = shard
+            self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
+            room.outcomes[uid].node_name = res.get("bound")
+            self._gang_committed.append(room.outcomes[uid])
+
+    def _rollback_gang(self, g: str) -> None:
+        """Abort every held reservation of gang ``g`` (a member failed
+        phase 1): journaled gang_abort per member, resources released,
+        members re-queued behind backoff — the damped re-admission the
+        single scheduler's rollback path takes."""
+        room = self._gang_rooms.pop(g, None)
+        if room is None:
+            return
+        for uid, shard in room.members:
+            self._call(shard, "abort", {"uid": uid})
+            self._gang_commits.inc(phase="abort")
+            # Park without instant re-admission (the gang just failed
+            # with exactly these members), attempts preserved.
+            self.queue.requeue_gang_member(room.qps[uid])
+        # Retry damped, behind backoff — in a quiet cluster no event
+        # would ever re-admit an already-quorate parked gang.
+        self.queue.readmit_gang(g)
+
+    # -- the batch loop ----------------------------------------------------
+
+    def schedule_batch(self) -> list[ScheduleOutcome]:
+        infos = self.queue.pop_batch(self.batch_size)
+        if not infos:
+            return []
+        base = self._cycle
+        outcomes: list[ScheduleOutcome] = []
+        failed: list[tuple[QueuedPodInfo, ScheduleOutcome]] = []
+        for i, qp in enumerate(infos):
+            out, run_pf = self._schedule_one(qp, base + i)
+            outcomes.append(out)
+            if run_pf:
+                failed.append((qp, out))
+        # The single scheduler burns one tie-break step per popped pod
+        # (scheduler.py _dispatch_batch: _cycle += len(infos)).
+        self._cycle += len(infos)
+        # PostFilter phase, batch order — evictions land only after the
+        # whole scan, like scheduler._complete_batch.
+        for qp, out in failed:
+            self._postfilter(qp, out)
+        bound = [o for o in outcomes if o.node_name]
+        seen = {o.pod.uid for o in outcomes}
+        # Members reserved in an earlier batch whose gang committed now.
+        bound.extend(o for o in self._gang_committed if o.pod.uid not in seen)
+        self._gang_committed.clear()
+        return bound
+
+    def schedule_all_pending(
+        self, max_rounds: int = 10_000, wait_backoff: bool = False
+    ) -> list[ScheduleOutcome]:
+        all_outcomes: list[ScheduleOutcome] = []
+        for _ in range(max_rounds):
+            out = self.schedule_batch()
+            if out:
+                all_outcomes.extend(out)
+                continue
+            if len(self.queue):
+                continue
+            if wait_backoff and self.queue.sleep_until_backoff():
+                continue
+            break
+        return all_outcomes
+
+    # -- reshaping (split / merge / rebalance) -----------------------------
+
+    def apply_handoff(self, record: dict, map_path: str | None = None) -> None:
+        """Execute one shard-map transfer end to end, in the order that
+        makes a crash anywhere convergent: the ACQUIRING owner journals
+        the handoff record and imports the nodes (with their bound pods,
+        each binding re-journaled into ITS journal), the map file is
+        rewritten at the record's version, and only then does the losing
+        owner drop its copies.  The map on ``self.shard_map`` is already
+        mutated (split/merge/assign bumped the version and returned
+        ``record``); fleet/takeover.py replays exactly this sequence when
+        recovery finds a handoff record newer than the on-disk map."""
+        if record.get("op") == "rebalance":
+            # Every owner may owe nodes to every other: the record names
+            # no single (src, dst) pair, so sweep all ordered pairs —
+            # export filters to the source's actual copies, so pairs
+            # with nothing to move are skipped cheaply.
+            moves = [
+                (s, d)
+                for s in self.shard_ids()
+                for d in self.shard_ids()
+                if s != d
+            ]
+        else:
+            src, dst = record.get("from", -1), record["to"]
+            if src not in self.owners or dst not in self.owners:
+                raise ValueError(f"handoff {record} names an unknown shard")
+            moves = [(src, dst)]
+        # Imports first (each journaled by its acquirer), ONE map write,
+        # then the drops — a crash anywhere leaves every transfer either
+        # redoable from a journal or still held by its source.
+        drops: list[tuple[int, list[str]]] = []
+        touched: set[int] = set()
+        for src, dst in moves:
+            # The nodes that move: everything the NEW map assigns to dst
+            # that the source owner still holds (export filters to its
+            # copies).
+            names = [
+                n
+                for n in sorted(self._node_pos)
+                if self.shard_map.owner_of(n) == dst
+            ]
+            payload = self._call(src, "export_nodes", {"names": names})
+            moved = [n["metadata"]["name"] for n in payload["nodes"]]
+            if not moved:
+                continue
+            self._call(
+                dst, "import_nodes", {"record": record, "payload": payload}
+            )
+            drops.append((src, moved))
+            touched |= {src, dst}
+            for name in moved:
+                left = self._shard_node_count.get(src, 0) - 1
+                if left > 0:
+                    self._shard_node_count[src] = left
+                else:
+                    self._shard_node_count.pop(src, None)
+                self._shard_node_count[dst] = (
+                    self._shard_node_count.get(dst, 0) + 1
+                )
+            for entry in payload.get("pods", ()):
+                meta = entry["pod"]["metadata"]
+                uid = meta.get("uid") or f"{meta['namespace']}/{meta['name']}"
+                self._pod_shard[uid] = dst
+        if map_path:
+            self.shard_map.save(map_path)
+        for src, moved in drops:
+            self._call(src, "drop_nodes", {"names": moved})
+        self._handoffs.inc(op=record.get("op", "?"))
+        for shard in sorted(touched):
+            self._shard_nodes.set(
+                self._call(shard, "stats", {})["nodes"], shard=str(shard)
+            )
+
+    # -- observability -----------------------------------------------------
+
+    def bindings(self) -> dict:
+        out: dict[str, str] = {}
+        for shard in self.shard_ids():
+            out.update(self._call(shard, "bindings", {})["bindings"])
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "shards": {
+                str(s): self._call(s, "stats", {}) for s in self.shard_ids()
+            },
+            "cycle": self._cycle,
+            "queue": self.queue.depths(),
+            "gang_bound": dict(self.gang_bound),
+            "gang_rooms": {
+                g: sorted(r.pods) for g, r in self._gang_rooms.items()
+            },
+        }

@@ -1,0 +1,200 @@
+"""The shard map: which owner holds which nodes, durably.
+
+The fleet's ownership record is one fsync'd JSON file shared by every
+owner and the router — the analog of the consistent-hash ring a
+partitioned placement service keeps in its coordination store (Tesserae
+partitions the cluster the same way).  Assignment is two-level:
+
+- ``buckets``: a fixed-size array (B entries) of shard ids; a node maps
+  to ``buckets[crc32(name) % B]``.  Fixed buckets make split/merge/
+  rebalance a bucket-remapping, not a node-by-node migration plan, and
+  crc32 (not builtin ``hash``) keeps the mapping identical across
+  processes and PYTHONHASHSEED values.
+- ``overrides``: explicit node → shard pins that beat the bucket rule
+  (targeted rebalance, takeover pinning).
+
+Every write is epoch-versioned and atomic: ``version`` increments
+monotonically, ``epoch`` records the writer's lease epoch, and the file
+lands via temp + fsync + ``os.replace`` + directory fsync, so a crash
+mid-write leaves the previous map intact.  Readers reject a version
+that moves backwards — a deposed owner replaying a stale map cannot
+roll ownership back.
+
+Handoffs (split/merge/rebalance/takeover) are JOURNALED by the
+acquiring owner BEFORE the map file is rewritten (the WAL
+journal-before-apply discipline): a crash between the append and the
+replace leaves a handoff record whose ``version`` exceeds the file's,
+and recovery redoes the idempotent rewrite — the transfer converges."""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+DEFAULT_BUCKETS = 64
+
+
+def stable_shard_hash(name: str, modulus: int) -> int:
+    """Cross-process-stable bucket index for a node or pod name."""
+    return zlib.crc32(name.encode()) % max(modulus, 1)
+
+
+class StaleMapError(RuntimeError):
+    """A shard-map write lost the version race: the file on disk is
+    newer than the map this writer loaded.  Reload and retry."""
+
+
+class ShardMap:
+    def __init__(
+        self,
+        n_shards: int = 1,
+        n_buckets: int = DEFAULT_BUCKETS,
+        buckets: list[int] | None = None,
+        overrides: dict[str, int] | None = None,
+        version: int = 0,
+        epoch: int = 0,
+    ) -> None:
+        if buckets is None:
+            # Initial layout: buckets dealt round-robin, so shard sizes
+            # differ by at most one bucket.
+            buckets = [i % max(n_shards, 1) for i in range(n_buckets)]
+        self.buckets = list(buckets)
+        self.overrides = dict(overrides or {})
+        self.version = version
+        self.epoch = epoch
+
+    # -- assignment --------------------------------------------------------
+
+    def shard_ids(self) -> list[int]:
+        present = {s for s in self.buckets} | {
+            s for s in self.overrides.values()
+        }
+        return sorted(present)
+
+    def owner_of(self, node_name: str) -> int:
+        ov = self.overrides.get(node_name)
+        if ov is not None:
+            return ov
+        return self.buckets[stable_shard_hash(node_name, len(self.buckets))]
+
+    def nodes_of(self, shard: int, node_names) -> list[str]:
+        """The subset of ``node_names`` this shard owns, in given order."""
+        return [n for n in node_names if self.owner_of(n) == shard]
+
+    # -- reshaping ---------------------------------------------------------
+
+    def assign(self, node_name: str, shard: int) -> dict:
+        """Pin one node to a shard (targeted rebalance / takeover pin).
+        Returns the handoff record describing the transfer."""
+        prev = self.owner_of(node_name)
+        self.overrides[node_name] = shard
+        return self._handoff("assign", prev, shard, nodes=[node_name])
+
+    def split(self, shard: int, new_shard: int) -> dict:
+        """Split a shard: the second half of its buckets (in bucket
+        order) moves to ``new_shard``.  Returns the handoff record."""
+        owned = [i for i, s in enumerate(self.buckets) if s == shard]
+        moving = owned[len(owned) // 2 :]
+        for i in moving:
+            self.buckets[i] = new_shard
+        for n, s in sorted(self.overrides.items()):
+            if s == shard and stable_shard_hash(n, len(self.buckets)) in moving:
+                self.overrides[n] = new_shard
+        return self._handoff("split", shard, new_shard, buckets=moving)
+
+    def merge(self, into: int, absorbed: int) -> dict:
+        """Merge ``absorbed``'s buckets and overrides into ``into`` —
+        the takeover shape: a dead owner's whole shard transfers."""
+        moving = [i for i, s in enumerate(self.buckets) if s == absorbed]
+        for i in moving:
+            self.buckets[i] = into
+        for n, s in sorted(self.overrides.items()):
+            if s == absorbed:
+                self.overrides[n] = into
+        return self._handoff("merge", absorbed, into, buckets=moving)
+
+    def rebalance(self, n_shards: int) -> dict:
+        """Re-deal every bucket round-robin over ``n_shards`` shards and
+        drop overrides — the from-scratch layout for a resized fleet."""
+        self.buckets = [i % max(n_shards, 1) for i in range(len(self.buckets))]
+        self.overrides = {}
+        return self._handoff("rebalance", -1, -1, n_shards=n_shards)
+
+    def _handoff(self, op: str, src: int, dst: int, **extra) -> dict:
+        """The journaled transfer record: version is bumped HERE, before
+        any file write, so the acquiring owner appends the record first
+        and the map write at that version is idempotently redoable."""
+        self.version += 1
+        rec = {"op": op, "from": src, "to": dst, "version": self.version}
+        rec.update(extra)
+        return rec
+
+    # -- durability --------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        return {
+            "version": self.version,
+            "epoch": self.epoch,
+            "buckets": list(self.buckets),
+            "overrides": dict(sorted(self.overrides.items())),
+        }
+
+    def save(self, path: str, epoch: int | None = None) -> None:
+        """Atomic, fsync'd write.  Refuses to clobber unless strictly
+        NEWER than the file (a deposed writer whose version merely caught
+        up to the successor's must not roll ownership back either) —
+        StaleMapError; the caller reloads and reapplies.  A version-0
+        file (fresh init) may be rewritten."""
+        if epoch is not None:
+            self.epoch = epoch
+        cur = read_version(path)
+        if cur and cur >= self.version:
+            raise StaleMapError(
+                f"shard map at {path} is at version {cur}, "
+                f"writer holds {self.version}"
+            )
+        from .. import journal as _journal
+
+        # The handoff crash window under test (faults.KILL_POINTS
+        # "pre-map-write"): the acquiring owner has journaled the
+        # transfer but the map file still shows the old layout — takeover
+        # redoes the rewrite from the journal (takeover.py
+        # redo_lost_map_writes).
+        _journal._crash("pre-map-write")
+        blob = json.dumps(self.to_doc(), sort_keys=True).encode()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    @classmethod
+    def load(cls, path: str) -> "ShardMap":
+        with open(path, "rb") as f:
+            doc = json.loads(f.read())
+        return cls(
+            buckets=doc["buckets"],
+            overrides=doc.get("overrides", {}),
+            version=doc.get("version", 0),
+            epoch=doc.get("epoch", 0),
+        )
+
+
+def read_version(path: str) -> int:
+    """The on-disk map's version (0 when absent/corrupt) — the cheap
+    staleness probe writers consult before replacing the file."""
+    try:
+        with open(path, "rb") as f:
+            return int(json.loads(f.read()).get("version", 0))
+    except (OSError, ValueError, AttributeError, TypeError):
+        return 0

@@ -1,0 +1,127 @@
+"""Takeover: a dead owner's shard comes back, bit-identically.
+
+Two shapes, both fenced by the lease epoch:
+
+- **Restart takeover** (``recover_shard``): a fresh process re-acquires
+  the dead owner's lease — the flock is free the instant the holder dies,
+  and the acquire bumps the fencing epoch, so a deposed owner that is
+  merely wedged (not dead) can never append past its successor.
+  ShardOwner construction then replays snapshot + write-ahead log
+  (journal.recover): the same records produce the same state, which is
+  what the shard-failover kill matrix asserts
+  (scripts/run_fault_matrix.py --fleet-kill).
+
+- **Survivor takeover** (``absorb_shard``): a surviving owner adopts the
+  dead shard wholesale.  The dead journal is first recovered behind its
+  own epoch bump (a ghost owner — nothing schedules on it), then the
+  shard transfers through the SAME journaled handoff path a planned
+  merge uses: ``shard_map.merge`` bumps the map version and yields the
+  handoff record, the survivor journals it and imports the nodes with
+  their bindings (each re-journaled into ITS log, so the survivor's
+  journal alone reproduces the merged shard at the next failover), and
+  only then is the map file rewritten.
+
+A crash BETWEEN the handoff append and the map rewrite is the window
+``redo_lost_map_writes`` closes: recovery surfaces journaled handoff
+records (scheduler._recovered_handoffs); any record whose version
+exceeds the on-disk map's is re-applied idempotently — the transfer
+converges no matter where the writer died."""
+
+from __future__ import annotations
+
+from .owner import ShardOwner
+from .shardmap import ShardMap, read_version
+
+
+def redo_handoff(shard_map: ShardMap, record: dict) -> None:
+    """Re-apply one journaled handoff record to a (possibly stale) map —
+    the idempotent redo: records carry the full bucket/override delta, so
+    applying one twice lands on the same map."""
+    op = record["op"]
+    if op in ("split", "merge"):
+        for i in record.get("buckets", ()):
+            shard_map.buckets[i] = record["to"]
+    elif op == "assign":
+        for n in record.get("nodes", ()):
+            shard_map.overrides[n] = record["to"]
+    elif op == "rebalance":
+        n_shards = record["n_shards"]
+        shard_map.buckets = [
+            i % max(n_shards, 1) for i in range(len(shard_map.buckets))
+        ]
+        shard_map.overrides = {}
+    shard_map.version = max(shard_map.version, record["version"])
+
+
+def redo_lost_map_writes(owner: ShardOwner, map_path: str) -> int:
+    """Close the append→rewrite crash window: every recovered handoff
+    record newer than the on-disk map is redone and the map rewritten.
+    Returns how many records were redone."""
+    recovered = getattr(owner.sched, "_recovered_handoffs", None) or []
+    disk_version = read_version(map_path)
+    lost = [r for r in recovered if r["version"] > disk_version]
+    if not lost:
+        return 0
+    shard_map = owner.shard_map or ShardMap.load(map_path)
+    for rec in sorted(lost, key=lambda r: r["version"]):
+        redo_handoff(shard_map, rec)
+    shard_map.save(map_path)
+    return len(lost)
+
+
+def recover_shard(
+    state_dir: str,
+    scheduler_factory,
+    shard_id: int,
+    shard_map: ShardMap | None = None,
+    map_path: str | None = None,
+) -> ShardOwner:
+    """Restart takeover: re-own a dead owner's shard from its journal
+    directory.  The lease acquire fences the deposed epoch; construction
+    replays snapshot + WAL; lost map writes are redone.  The caller
+    reconciles against the host-truth LIST afterwards
+    (informers.reconcile_after_recovery) exactly like a single-scheduler
+    restart — recovery parks journal bindings whose nodes the snapshot
+    did not cover, and the relist re-applies them."""
+    owner = ShardOwner(
+        shard_id, scheduler_factory(), shard_map, state_dir=state_dir
+    )
+    if map_path:
+        redo_lost_map_writes(owner, map_path)
+    if shard_map is not None:
+        # Enforce the (possibly just-redone) map on recovered state: a
+        # crash between a handoff's import and the exporter's drop leaves
+        # the SOURCE's snapshot still holding transferred nodes — the
+        # guard only filters live adds, so takeover finishes the drop.
+        for name in sorted(owner.sched.cache.nodes):
+            if shard_map.owner_of(name) != shard_id:
+                owner.sched.remove_node(name)
+                owner.handoffs_out += 1
+    return owner
+
+
+def absorb_shard(
+    survivor: ShardOwner,
+    dead_state_dir: str,
+    dead_shard_id: int,
+    scheduler_factory,
+    shard_map: ShardMap,
+    map_path: str | None = None,
+) -> dict:
+    """Survivor takeover: recover the dead shard behind an epoch bump,
+    then merge it into the survivor through the journaled handoff path.
+    Returns the handoff record."""
+    ghost = ShardOwner(
+        dead_shard_id, scheduler_factory(), None, state_dir=dead_state_dir
+    )
+    try:
+        record = shard_map.merge(
+            into=survivor.shard_id, absorbed=dead_shard_id
+        )
+        payload = ghost.export_nodes(sorted(ghost.sched.cache.nodes))
+        survivor.import_nodes(record, payload)
+        if map_path:
+            shard_map.save(map_path)
+    finally:
+        ghost.close()
+    return record

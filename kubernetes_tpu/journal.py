@@ -489,6 +489,17 @@ def recover(sched, journal: Journal) -> dict:
                         info.get("priority", 0),
                     )
         pending: dict[str, dict] = {}
+        # Fleet 2PC intents (fleet/owner.py): a ``gang_reserve`` with no
+        # matching bind or ``gang_abort`` is an in-doubt reservation the
+        # crash orphaned — PRESUMED ABORT: the assume it described was
+        # never durable truth, so replay applies nothing and the router
+        # re-admits the gang from scratch.  Surfaced for observability.
+        in_doubt: dict[str, dict] = {}
+        # Shard-map handoffs (fleet/shardmap.py): the acquiring owner
+        # journals the transfer BEFORE rewriting the map file; a handoff
+        # record whose version exceeds the on-disk map's means the
+        # rewrite was lost — takeover redoes it idempotently.
+        handoffs: list[dict] = []
         for rec in records:
             rtype, d = rec["t"], rec["d"]
             if rtype == "bind":
@@ -528,8 +539,24 @@ def recover(sched, journal: Journal) -> dict:
                 sched._recovered_spec_epoch = max(
                     getattr(sched, "_recovered_spec_epoch", 0), d["epoch"]
                 )
+            elif rtype == "gang_reserve":
+                in_doubt[d["uid"]] = d
+            elif rtype == "gang_abort":
+                in_doubt.pop(d["uid"], None)
+            elif rtype == "handoff":
+                handoffs.append(d)
+        # A bind record resolves its reservation (phase 2 completed) —
+        # whether it applied directly or parked for the LIST reconcile.
+        for uid in [
+            u for u in in_doubt if u in sched.cache.pods or u in pending
+        ]:
+            in_doubt.pop(uid, None)
         sched._recovered_bindings = pending
+        sched._recovered_gang_intents = in_doubt
+        sched._recovered_handoffs = handoffs
         stats["pending_bindings"] = len(pending)
+        stats["in_doubt_reservations"] = len(in_doubt)
+        stats["handoffs"] = len(handoffs)
     finally:
         journal.muted = False
     # Flight-recorder timeline: recovery is a state transition an operator

@@ -777,6 +777,365 @@ def run_soak(cfg: SoakConfig) -> dict:
     return artifact
 
 
+# -- the partitioned-fleet soak ---------------------------------------------
+
+FLEET_INV_MIX: tuple[tuple[str, float], ...] = (
+    # The fleet feed has no namespace-label op (owners take the KINDS
+    # surface only), so the churn budget splits over the two node-shaped
+    # invalidations.
+    ("inv_capacity", 0.7),
+    ("inv_label", 0.3),
+)
+
+
+def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
+    """Soak the PARTITIONED fleet (kubernetes_tpu/fleet): open-loop
+    arrivals scatter-gathered by the router over ``shards`` journaled
+    shard owners, with the existing loadgen scenarios re-aimed at the
+    fleet's failure surfaces —
+
+    - **node flaps hit ONE shard**: the churn pool is pinned to shard 0
+      by shard-map overrides, so a flapping shard's SLO degrades while
+      the others' hold (visible in the per-shard percentiles);
+    - **cold router restarts** (the fleet's cold-consumer analog): the
+      ``cold_consumer`` scenario event tears the router down mid-stream
+      and rebuilds it from the owners' truth (adopt_bindings) — pending
+      pods re-feed, bound pods must not double-schedule;
+    - **per-shard SLO percentiles + WAL growth**: each decision's latency
+      is attributed to the shard that committed it, and every owner's
+      journal is sampled for bounded-compaction evidence.
+
+    Same determinism contract as run_soak: the operation sequence is a
+    pure function of the seed, so same-seed runs land bit-identical
+    final bindings (the --shards determinism cross-check in
+    scripts/run_soak.py asserts exactly that)."""
+    from ..fleet import FleetRouter, ShardMap, ShardOwner
+    from ..scheduler import TPUScheduler
+
+    tmp = tempfile.TemporaryDirectory(prefix="tpu-fleet-soak-")
+    out_dir = cfg.out_dir or tmp.name
+    os.makedirs(out_dir, exist_ok=True)
+    journal_root = cfg.journal_dir or os.path.join(tmp.name, "journal")
+    smap = ShardMap(n_shards=shards)
+    for i in range(cfg.churn_nodes):
+        smap.assign(f"churn-{i}", 0)  # flaps land on shard 0 only
+    owners: dict[int, ShardOwner] = {}
+    for k in range(shards):
+        owners[k] = ShardOwner(
+            k,
+            TPUScheduler(batch_size=cfg.batch_size, chunk_size=1),
+            smap,
+            state_dir=os.path.join(journal_root, f"shard{k}"),
+            journal_fsync=cfg.journal_fsync == "always",
+            snapshot_every_batches=cfg.snapshot_every,
+        )
+    mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+    node_objs: dict[str, object] = {}
+    feed_order: list[str] = []
+    router_restarts = 0
+
+    def mk_router() -> FleetRouter:
+        r = FleetRouter(owners, smap, batch_size=cfg.batch_size)
+        r.profile_filters = tuple(owners[0].sched.profile.filters)
+        return r
+
+    def feed_node(r: FleetRouter, n) -> None:
+        name = n.metadata.name
+        if name not in node_objs:
+            feed_order.append(name)
+        node_objs[name] = n
+        r.add_object("Node", n)
+
+    router = mk_router()
+    for i in range(cfg.nodes):
+        feed_node(
+            router,
+            make_node(f"lgn-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % cfg.zones}")
+            .region("region-1")
+            .obj(),
+        )
+    for i in range(cfg.churn_nodes):
+        feed_node(
+            router,
+            make_node(f"churn-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % cfg.zones}")
+            .region("region-1")
+            .obj(),
+        )
+    # Warm the compiled eval passes out of the measured window.  Two
+    # things force a recompile mid-stream if not warmed here: a pod
+    # class whose active-op set first appears inside the window, and the
+    # inv_label scenario's epoch labels growing the node-label vocab
+    # (a new schema keys a new compiled pass — one ~20s CPU-box compile
+    # lands squarely on the measured percentiles).  So the warm wave
+    # draws from the SAME WorkloadMix templates (renamed far outside the
+    # stream's index space) and the vocab is pre-seeded with the epoch
+    # label values the scenario can reach, then the node is restored.
+    warm_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 104_729 + 31)
+    for epoch in range(1, 5):
+        feed_node(
+            router,
+            make_node("lgn-0")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone("zone-0")
+            .region("region-1")
+            .label("loadgen.tpu/epoch", str(epoch))
+            .obj(),
+        )
+    warm = [warm_mix.pod(10_000_000 + i) for i in range(min(cfg.warm_pods, 48))]
+    for p in warm:
+        router.add_pod(p)
+    router.schedule_all_pending()
+    # Compile the preemption dry-run programs too (they otherwise first
+    # fire when the cluster fills, deep inside the measured window).
+    # preempt_propose is eval-only: nothing is deleted or nominated.
+    warm_preemptor = (
+        make_pod("lgwarm-preemptor").req({"cpu": "12"}).priority(100).obj()
+    )
+    for owner in owners.values():
+        owner.preempt_propose(warm_preemptor)
+    for p in warm:
+        if p.uid in router._pod_shard:
+            router.remove_object("Pod", p.uid)
+        else:
+            router.queue.delete(p.uid)
+    # Restore lgn-0 to its unlabeled serving shape.
+    feed_node(
+        router,
+        make_node("lgn-0")
+        .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+        .zone("zone-0")
+        .region("region-1")
+        .obj(),
+    )
+
+    cap_toggle: dict[int, int] = {}
+    label_epoch: dict[int, int] = {}
+    live: deque[str] = deque()
+    pods_by_uid: dict[str, object] = {}
+    pending: dict[str, object] = {}  # decided-but-unbound, for restarts
+    per_shard_lat: dict[int, list[float]] = {k: [] for k in owners}
+    wal_prev: dict[int, int] = {k: 0 for k in owners}
+    wal_samples: dict[int, list[int]] = {k: [] for k in owners}
+    compactions: dict[int, int] = {k: 0 for k in owners}
+
+    def sample_wal() -> None:
+        for k in owners:
+            try:
+                size = os.path.getsize(
+                    os.path.join(journal_root, f"shard{k}", Journal.WAL)
+                )
+            except OSError:
+                size = 0
+            if size < wal_prev[k]:
+                compactions[k] += 1
+            wal_prev[k] = size
+            wal_samples[k].append(size)
+
+    def serving_node(i: int):
+        w = (
+            make_node(f"lgn-{i}")
+            .capacity(
+                {
+                    "cpu": "15" if cap_toggle.get(i) else "16",
+                    "memory": "64Gi",
+                    "pods": 110,
+                }
+            )
+            .zone(f"zone-{i % cfg.zones}")
+            .region("region-1")
+        )
+        if label_epoch.get(i):
+            w = w.label("loadgen.tpu/epoch", str(label_epoch[i]))
+        return w.obj()
+
+    def apply_event(ev) -> None:
+        nonlocal router, router_restarts
+        if ev.kind == "inv_capacity":
+            i = ev.data % cfg.nodes
+            cap_toggle[i] = 1 - cap_toggle.get(i, 0)
+            feed_node(router, serving_node(i))
+        elif ev.kind == "inv_label":
+            i = ev.data % cfg.nodes
+            label_epoch[i] = label_epoch.get(i, 0) + 1
+            feed_node(router, serving_node(i))
+        elif ev.kind == "flap_down":
+            name = f"churn-{ev.data}"
+            gone = sorted(
+                uid
+                for uid in live
+                if getattr(pods_by_uid.get(uid), "_lg_node", None) == name
+            )
+            if gone:
+                gone_set = set(gone)
+                for u in gone:
+                    pods_by_uid.pop(u, None)
+                live_kept = deque(u for u in live if u not in gone_set)
+                live.clear()
+                live.extend(live_kept)
+            if name in node_objs and name in router._node_pos:
+                router.remove_object("Node", name)
+        elif ev.kind == "flap_up":
+            feed_node(router, node_objs[f"churn-{ev.data}"])
+        elif ev.kind == "cold_consumer":
+            # Cold ROUTER restart: the front door is rebuilt from the
+            # owners' truth mid-stream.  Node positions re-derive from
+            # the recorded feed order (the row-allocator mirror must
+            # land where the dead router's did), bindings re-adopt, and
+            # still-pending pods re-feed.
+            router = mk_router()
+            for name in feed_order:
+                if name in node_objs:
+                    router.add_object("Node", node_objs[name])
+            router.reconcile_recovered()
+            router.adopt_bindings()
+            for uid in sorted(pending):
+                router.add_pod(pending[uid])
+            router_restarts += 1
+        else:
+            raise ValueError(f"unknown fleet scenario event {ev.kind!r}")
+
+    res = _PhaseResult(
+        name="fleet-sustained",
+        invalidation_rate_per_s=cfg.invalidation_rate_per_s,
+    )
+
+    def decide(pod, deadline: float | None) -> None:
+        uid = pod.uid
+        t_issue = time.perf_counter()
+        router.add_pod(pod)
+        outs = router.schedule_all_pending()
+        node = None
+        for o in outs:
+            if o.pod.uid == uid and o.node_name:
+                node = o.node_name
+        shard = router._pod_shard.get(uid)
+        t_done = time.perf_counter()
+        base = t_issue if deadline is None else min(deadline, t_issue)
+        lat = t_done - base
+        res.latencies.append(lat)
+        if shard is not None:
+            per_shard_lat[shard].append(lat)
+        if lat > cfg.slo_budget_ms / 1e3:
+            res.violations += 1
+        res.decisions += 1
+        if node:
+            res.bound += 1
+            pod._lg_node = node
+            pods_by_uid[uid] = pod
+            pending.pop(uid, None)
+            live.append(uid)
+            while len(live) > cfg.live_pod_cap:
+                old = live.popleft()
+                pods_by_uid.pop(old, None)
+                pending.pop(old, None)
+                if old in router._pod_shard:
+                    router.remove_object("Pod", old)
+                res.retired += 1
+        else:
+            pending[uid] = pod
+
+    seed = cfg.seed * 1_000_003
+    if cfg.diurnal:
+        offsets = diurnal_offsets(
+            cfg.rate_pods_per_s,
+            cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
+            cfg.diurnal_period_s,
+            cfg.duration_s,
+            seed,
+        )
+    else:
+        offsets = poisson_offsets(cfg.rate_pods_per_s, cfg.duration_s, seed)
+    pods = [mix.pod(i) for i in range(len(offsets))]
+    scenario = build_events(
+        cfg.duration_s,
+        seed + 500_009,
+        nodes=cfg.nodes,
+        churn_nodes=cfg.churn_nodes,
+        invalidation_rate_per_s=cfg.invalidation_rate_per_s,
+        inv_mix=FLEET_INV_MIX,
+        node_flap_period_s=cfg.node_flap_period_s,
+        flap_down_s=cfg.flap_down_s,
+        cold_consumer_period_s=cfg.cold_consumer_period_s,
+    )
+    ops: list[tuple[float, int, int, object]] = []
+    for j, ev in enumerate(scenario):
+        ops.append((ev.t, 1, j, ev))
+    for i, off in enumerate(offsets):
+        ops.append((off, 2, i, i))
+    ops.sort(key=lambda e: (e[0], e[1], e[2]))
+    t0 = time.perf_counter()
+    for t_ev, klass, _idx, payload in ops:
+        if cfg.pace == "real":
+            delay = (t0 + t_ev) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        if klass == 1:
+            apply_event(payload)
+            res.events_applied[payload.kind] = (
+                res.events_applied.get(payload.kind, 0) + 1
+            )
+            sample_wal()
+        else:
+            deadline = t0 + t_ev if cfg.pace == "real" else None
+            decide(pods[payload], deadline)
+    sample_wal()
+    res.wall_s = round(time.perf_counter() - t0, 3)
+
+    bindings = router.bindings()
+    stats = router.stats()
+    registry_summary = router.registry.summary()
+    for owner in owners.values():
+        owner.close()
+    slo = dict(
+        _lat_summary(res.latencies),
+        budget_ms=cfg.slo_budget_ms,
+        violations=res.violations,
+        violation_rate=round(res.violations / max(1, res.decisions), 4),
+    )
+    artifact = {
+        "metric": "fleet_soak_slo_per_shard",
+        "seed": cfg.seed,
+        "shards": shards,
+        "config": asdict(cfg),
+        "wall_s": res.wall_s,
+        "decisions": res.decisions,
+        "bound": res.bound,
+        "retired": res.retired,
+        "sustained_pods_per_sec": round(
+            res.decisions / res.wall_s if res.wall_s else 0.0, 1
+        ),
+        "slo": slo,
+        "per_shard": {
+            str(k): {
+                "slo": _lat_summary(per_shard_lat[k]),
+                "wal_bytes_max": max(wal_samples[k], default=0),
+                "wal_bytes_final": (
+                    wal_samples[k][-1] if wal_samples[k] else 0
+                ),
+                "compactions_observed": compactions[k],
+                "owner": stats["shards"][str(k)],
+            }
+            for k in sorted(owners)
+        },
+        "events": dict(sorted(res.events_applied.items())),
+        "router_restarts": router_restarts,
+        "fleet_metrics": registry_summary,
+        "determinism": {
+            "arrival_sha256": _sha([round(o, 9) for o in offsets]),
+            "bindings_sha256": _sha(sorted(bindings.items())),
+            "arrivals_total": len(offsets),
+        },
+        "bound_final": len(bindings),
+        "pace": cfg.pace,
+    }
+    artifact["_arrival_offsets"] = [list(offsets)]
+    return artifact
+
+
 def strip_private(artifact: dict) -> dict:
     """The committed-artifact view: drop the underscore-keyed raw data
     callers use in-process, and normalize to JSON-native types (config

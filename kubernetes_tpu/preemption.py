@@ -1257,6 +1257,7 @@ class PreemptionEvaluator:
         profile=None,
         candidate_filter=None,
         prepacked: dict | None = None,
+        dry_run: bool = False,
     ) -> list[PreemptionResult | None]:
         """Run preemption for the failed pods of one scheduling batch.
         ``batch_rows`` are each pod's already-built feature dict rows.
@@ -1266,7 +1267,12 @@ class PreemptionEvaluator:
         ProcessPreemption hook (preemption.go:249 callExtenders).  The
         reference consults extenders over the full candidate list before
         selection; the batched engine selects first and filters the one
-        chosen candidate (divergence documented in extender.py)."""
+        chosen candidate (divergence documented in extender.py).
+
+        ``dry_run`` returns the chosen candidates WITHOUT applying them
+        (no victim deletion, PDB debit, or nomination) — the fleet's
+        cross-shard arbitration evaluates every shard's best candidate
+        and executes only the global winner (fleet/router.py)."""
         sched = self.sched
         profile = profile or sched.profile
         cache, builder = sched.cache, sched.builder
@@ -1331,7 +1337,7 @@ class PreemptionEvaluator:
         # delete events wake them).
 
         return self._interpret_dryrun(
-            pods, picks, vmasks, pack, candidate_filter
+            pods, picks, vmasks, pack, candidate_filter, dry_run=dry_run
         )
 
     def _eligibility(self, pods, batch_req=None) -> list[bool]:
@@ -1451,11 +1457,14 @@ class PreemptionEvaluator:
         return dict(zip(idxs, results))
 
     def _interpret_dryrun(
-        self, pods, picks, vmasks, pack, candidate_filter=None
+        self, pods, picks, vmasks, pack, candidate_filter=None,
+        dry_run: bool = False,
     ) -> list[PreemptionResult | None]:
         """prepareCandidate over fetched dry-run results: delete victims,
         nominate; consumed victims dedup across same-pass preemptors.
-        Shared by the synchronous path and collect_speculative."""
+        Shared by the synchronous path and collect_speculative.  With
+        ``dry_run`` the candidates are returned un-applied (see
+        preempt_batch)."""
         sched = self.sched
         cache = sched.cache
         pdbs, matched_pdbs = pack["pdbs"], pack["matched_pdbs"]
@@ -1480,6 +1489,16 @@ class PreemptionEvaluator:
                 pod, node_name, victims
             ):
                 results.append(None)
+                continue
+            if dry_run:
+                # Evaluation only: the fleet router compares this shard's
+                # candidate against the other shards' before anything is
+                # applied.  Victims still dedup within the pass so two
+                # same-pass preemptors cannot both claim one victim.
+                consumed.update(v.uid for v in victims)
+                results.append(
+                    PreemptionResult(node_name=node_name, victims=victims)
+                )
                 continue
             # prepareCandidate: delete victims, nominate the node.  The host
             # deltas mark rows dirty; the next state() flush re-syncs the

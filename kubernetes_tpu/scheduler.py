@@ -302,8 +302,10 @@ class TPUScheduler:
         # the default only surfaces genuinely slow batches).
         self.trace_threshold_s = 2.0
         self._next_assumed_sweep = 0.0
-        self.queue.gang_credit = lambda g: self.gang_bound.get(g, 0) + len(
-            self.permit_waiting.get(g, ())
+        self.queue.gang_credit = lambda g: (
+            self.gang_bound.get(g, 0)
+            + len(self.permit_waiting.get(g, ()))
+            + self.fleet_gang_credit(g)
         )
         if mesh is not None:
             # Multi-chip: node axis sharded over the mesh (parallel/mesh.py);
@@ -365,6 +367,27 @@ class TPUScheduler:
         # informers.reconcile_after_recovery re-applies them once the
         # LIST delivers the node (or drops them when it never does).
         self._recovered_bindings: dict[str, dict] = {}
+        # Fleet recovery surfaces (journal.recover): crash-orphaned 2PC
+        # reservations (presumed abort — the router re-admits the gang)
+        # and journaled shard-map handoffs (takeover redoes a lost map
+        # write idempotently).
+        self._recovered_gang_intents: dict[str, dict] = {}
+        self._recovered_handoffs: list[dict] = []
+        # Shard scope (fleet/owner.py): a fleet owner's store holds ONLY
+        # its shard's nodes.  When set, add_node consults the predicate
+        # and drops foreign nodes (counted — a misconfigured feed should
+        # be visible, not silently absorbed into the wrong shard).
+        self.shard_guard = None
+        self.shard_rejected_nodes = 0
+        # In-flight fleet 2PC reservations: pod uid → {pod, node, undos,
+        # gang} between reserve_proposed and commit/abort_reserved.
+        self._fleet_reserved: dict[str, dict] = {}
+        # Gang quorum credit earned on OTHER shards (fleet/router.py
+        # installs a counter over its fleet-wide gang_bound): the queue's
+        # PreEnqueue admission must count members a different owner
+        # already bound, or a gang split across shards never reaches
+        # quorum anywhere.
+        self.fleet_gang_credit = lambda g: 0
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -718,6 +741,11 @@ class TPUScheduler:
     # -- cluster events (the informer surface, eventhandlers.go:341) ---------
 
     def add_node(self, node: t.Node) -> None:
+        if self.shard_guard is not None and not self.shard_guard(node.name):
+            # Not this shard's node (fleet partitioning): the shard map,
+            # not the feed, decides ownership.
+            self.shard_rejected_nodes += 1
+            return
         self.cache.add_node(node)
         # Replay CSINode/ResourceSlices that arrived before their Node
         # (informer races).
@@ -1524,7 +1552,6 @@ class TPUScheduler:
         run PostFilter preemption with extender ProcessPreemption veto
         (schedule_one.go:749); gang Permit semantics remain batch-path
         only (an extender profile schedules pod-at-a-time)."""
-        from .engine.pass_ import build_eval_pass
         from .extender import run_extender_chain
 
         profile = self._profile_for(qp.pod) or self.profile
@@ -1532,33 +1559,12 @@ class TPUScheduler:
         m.schedule_attempts += 1
         m.batches += 1
         t0 = time.perf_counter()
-        batch, deltas, active = build_pod_batch([qp.pod], self.builder, profile, 1)
-        inv = self._full_inv()
-        t1 = time.perf_counter()
-        state = self.builder.state()
-        key = (
-            profile, self.builder.schema,
-            tuple(sorted(self.builder.res_col.items())), active,
+        # Resolve the pod's own nomination to a row (like _inject_nomrows)
+        # — only worth the lookup when any nominated claims exist.
+        nomrow = self._resolve_nomrow(qp.pod) if self.nominator else -1
+        batch, deltas, active, inv, feasible, total, t1 = self._run_eval_pass(
+            qp.pod, profile, nomrow
         )
-        run = self._eval_passes.get(key)
-        if run is None:
-            run = build_eval_pass(
-                profile, self.builder.schema, self.builder.res_col, active
-            )
-            self._eval_passes[key] = run
-        pf = {k: np.asarray(v)[0] for k, v in batch.items() if k != "valid"}
-        # Resolve the pod's own nomination to a row (like _inject_nomrows):
-        # without it, a retrying preemptor's own nominated claim in the fit
-        # overlay makes its freed node look full to itself.
-        nomrow = -1
-        nn = qp.pod.status.nominated_node_name
-        if nn and self.nominator:
-            rec_n = self.cache.nodes.get(nn)
-            if rec_n is not None:
-                nomrow = rec_n.row
-        pf["nominated_row"] = np.int32(nomrow)
-        feasible, total = device_fetch(run(state, pf, inv))
-        self._dispatch_counter.inc(kind="eval")
         m.featurize_time_s += t1 - t0
         m.device_time_s += time.perf_counter() - t1
         rows = np.nonzero(feasible)[0]
@@ -1688,6 +1694,323 @@ class TPUScheduler:
         ):
             self.check_consistency()
         return ScheduleOutcome(qp.pod, best, combined[best], len(nodes))
+
+    # -- fleet protocol surface (fleet/owner.py) ---------------------------
+    #
+    # A shard owner schedules pods it does not own end to end: the router
+    # scatter-gathers per-shard PROPOSALS (eval-only per-node verdicts),
+    # makes the global selectHost decision itself, and commits on the
+    # winning shard — so an N-shard fleet reproduces the single
+    # scheduler's choice whenever per-node scores are shard-independent
+    # (trivially true for the filter-only golden profile; score ops that
+    # normalize over the candidate set trade this for partition locality,
+    # the Tesserae compromise documented in fleet/router.py).
+
+    def _resolve_nomrow(self, pod: t.Pod) -> int:
+        """The pod's own nominated node as a snapshot row (-1 when unset
+        or unknown) — without it, a retrying preemptor's nominated claim
+        in the fit overlay makes its freed node look full to itself."""
+        nn = pod.status.nominated_node_name
+        if nn:
+            rec_n = self.cache.nodes.get(nn)
+            if rec_n is not None:
+                return rec_n.row
+        return -1
+
+    def _run_eval_pass(self, pod: t.Pod, profile, nomrow: int):
+        """One-pod eval-only device pass (build_eval_pass, cached per
+        (profile, schema, res_col, active)): featurize, run, fetch.
+        Shared by the extender path (_schedule_one_extender) and the
+        fleet propose path so the cache key and nomination handling
+        cannot drift apart.  Returns (batch, deltas, active, inv,
+        feasible, total, t_featurized) — the timestamp splits featurize
+        from device time for the callers that meter them."""
+        from .engine.pass_ import build_eval_pass
+
+        batch, deltas, active = build_pod_batch(
+            [pod], self.builder, profile, 1
+        )
+        inv = self._full_inv()
+        t_feat = time.perf_counter()
+        state = self.builder.state()
+        key = (
+            profile, self.builder.schema,
+            tuple(sorted(self.builder.res_col.items())), active,
+        )
+        run = self._eval_passes.get(key)
+        if run is None:
+            run = build_eval_pass(
+                profile, self.builder.schema, self.builder.res_col, active
+            )
+            self._eval_passes[key] = run
+        pf = {k: np.asarray(v)[0] for k, v in batch.items() if k != "valid"}
+        pf["nominated_row"] = np.int32(nomrow)
+        feasible, total = device_fetch(run(state, pf, inv))
+        self._dispatch_counter.inc(kind="eval")
+        return batch, deltas, active, inv, feasible, total, t_feat
+
+    def propose_pod(self, pod: t.Pod) -> dict:
+        """Eval-only proposal: this shard's per-node verdicts for one pod
+        — feasible node names (snapshot row order), their total scores,
+        and the pod's resolved nomination when locally feasible.  No
+        commit, no queue interaction; the same compiled eval pass the
+        extender path uses (_run_eval_pass)."""
+        if not self.cache.nodes:
+            return {"feasible": [], "scores": [], "nominated": None}
+        profile = self._profile_for(pod) or self.profile
+        nomrow = self._resolve_nomrow(pod)
+        batch, _deltas, _active, _inv, feasible, total, _t = (
+            self._run_eval_pass(pod, profile, nomrow)
+        )
+        rows = np.nonzero(feasible)[0]
+        names = [self.cache.node_name_at_row(int(r)) for r in rows]
+        nn = pod.status.nominated_node_name
+        return {
+            "feasible": names,
+            "scores": [int(total[r]) for r in rows],
+            "nominated": nn if nomrow >= 0 and bool(feasible[nomrow]) else None,
+            # The pod's featurized request vector — the router's queue
+            # needs it for the precise fit-wake hint (queue._fit_hint),
+            # which the single scheduler gets from its own deltas.
+            "req": [int(x) for x in np.asarray(batch["req"])[0]],
+        }
+
+    def reserve_proposed(self, pod: t.Pod, node_name: str, gang: str = "") -> bool:
+        """Phase 1 of the fleet's two-phase commit: assume the pod onto
+        the node and run the Reserve chain, journaling a ``gang_reserve``
+        INTENT first — a crash between phases leaves the intent without a
+        bind record, which recovery resolves as presumed-abort (the
+        assume was never durable truth).  Returns False (fully unwound)
+        when a Reserve plugin refuses."""
+        self._journal_append(
+            "gang_reserve", uid=pod.uid, node=node_name, gang=gang
+        )
+        delta = self.builder.pod_delta_vectors(pod)
+        self.cache.assume_pod(pod, node_name, device_already=False, delta=delta)
+        undos: list = []
+        for rp in self._reserve_for(pod):
+            if not rp.relevant(pod, self):
+                continue
+            u = rp.reserve(pod, node_name, self)
+            if u is None:
+                for rp2, u2 in reversed(undos):
+                    rp2.unreserve(u2, self)
+                self.cache.forget_pod(pod.uid)
+                return False
+            undos.append((rp, u))
+        self._fleet_reserved[pod.uid] = {
+            "pod": pod, "node": node_name, "undos": undos, "gang": gang,
+        }
+        return True
+
+    def abort_reserved(self, uid: str) -> None:
+        """2PC abort: unwind the Reserve chain and forget the assume.
+        Journaled (``gang_abort``) so replay distinguishes a resolved
+        intent from a crash-orphaned one — either way nothing durable
+        was applied, so replay applies nothing."""
+        entry = self._fleet_reserved.pop(uid, None)
+        if entry is None:
+            return
+        self._journal_append("gang_abort", uid=uid, gang=entry["gang"])
+        for rp, u in reversed(entry["undos"]):
+            rp.unreserve(u, self)
+        self.cache.forget_pod(uid)
+
+    def commit_reserved(self, uid: str) -> ScheduleOutcome | None:
+        """Phase 2: the binding becomes durable truth — journal the bind
+        record, then finish the binding (WAL journal-before-apply)."""
+        entry = self._fleet_reserved.pop(uid, None)
+        if entry is None:
+            return None
+        pod, node_name = entry["pod"], entry["node"]
+        self._journal_bind(pod, node_name)
+        self.nominator.pop(pod.uid, None)
+        pod.spec.node_name = node_name
+        pod.status.nominated_node_name = ""
+        self.cache.finish_binding(pod.uid)
+        self.taint_eviction.handle_pod_assigned(pod, node_name)
+        g = pod.spec.pod_group
+        if g:
+            self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
+        m = self.metrics
+        now = time.monotonic()
+        if m.scheduled == 0:
+            m.first_scheduled_ts = now
+        m.scheduled += 1
+        m.last_scheduled_ts = now
+        self.recorder.event(
+            pod.uid, NORMAL, "Scheduled",
+            f"Successfully assigned {pod.uid} to {node_name}",
+        )
+        # One fleet commit ≈ one reference scheduling cycle (the extender
+        # path counts the same way): tick the snapshot cadence, or a
+        # fleet owner's WAL would grow forever — the router never drives
+        # schedule_batch, so the batch-loop call site can't fire here.
+        self.metrics.batches += 1
+        self.maybe_snapshot()
+        return ScheduleOutcome(pod, node_name)
+
+    def commit_proposed(self, pod: t.Pod, node_name: str) -> ScheduleOutcome | None:
+        """One-phase commit for a routed singleton pod (no gang): reserve
+        + immediate commit, the fleet analog of the extender path's bind
+        tail."""
+        self.metrics.schedule_attempts += 1
+        if not self.reserve_proposed(pod, node_name):
+            self.metrics.unschedulable += 1
+            return None
+        return self.commit_reserved(pod.uid)
+
+    def preempt_propose(self, pod: t.Pod) -> dict | None:
+        """Dry-run preemption for a foreign pod against THIS shard's
+        nodes: the best local candidate (node + victim identities +
+        the pickOneNode comparison key material) or None.  Nothing is
+        applied — the router compares candidates across shards and calls
+        execute_preemption on the winner only."""
+        if self.preemption is None or not self.cache.nodes:
+            return None
+        profile = self._profile_for(pod) or self.profile
+        batch, _deltas, active = build_pod_batch([pod], self.builder, profile, 1)
+        rows = {k: [np.asarray(v)[0]] for k, v in batch.items() if k != "valid"}
+        res = self.preemption.preempt_batch(
+            [pod], rows, active, self._full_inv(), profile=profile,
+            dry_run=True,
+        )[0]
+        if res is None:
+            return None
+        return {
+            "node": res.node_name,
+            "victims": [
+                {
+                    "uid": v.uid,
+                    "name": f"{v.namespace}/{v.name}",
+                    "priority": v.spec.priority,
+                    "start_time": v.status.start_time,
+                    "pod_group": v.spec.pod_group,
+                }
+                for v in res.victims
+            ],
+            # pickOneNodeForPreemption's lexicographic key over THIS
+            # candidate (preemption.py eval_one, chunk==1 branch), so the
+            # router's cross-shard arbitration reproduces the global
+            # pick: per-shard minimization then a key compare across the
+            # shard winners equals one global minimization, because every
+            # criterion is a per-candidate property.
+            "key": self._preempt_key(res.victims),
+        }
+
+    def _preempt_key(self, victims) -> list[int]:
+        """[pdb violations, max victim priority, priority sum, victim
+        count, negated-earliest-start] — ascending-lexicographic, exactly
+        the device's chunk==1 narrowing order (latest earliest-start
+        among the HIGHEST-priority victims wins, in microseconds)."""
+        violations = 0
+        for pdb in self.pdbs.values():
+            cnt = sum(
+                1
+                for v in victims
+                if v.namespace == pdb.namespace
+                and t.label_selector_matches(pdb.selector, v.metadata.labels)
+            )
+            violations += max(0, cnt - pdb.disruptions_allowed)
+        prios = [v.spec.priority for v in victims]
+        max_prio = max(prios) if prios else -1
+        starts = [
+            v.status.start_time
+            for v in victims
+            if v.spec.priority == max_prio and v.status.start_time is not None
+        ]
+        if starts:
+            start_key = int(-min(starts) * 1e6)
+        else:
+            start_key = -(2**61)
+        return [violations, max_prio, sum(prios), len(victims), start_key]
+
+    def execute_preemption(
+        self, pod: t.Pod, node_name: str, victim_uids: list[str]
+    ) -> dict:
+        """Apply a chosen preemption on THIS shard (the victim owner's
+        half of the cross-shard protocol): delete the victims (each
+        deletion write-ahead journaled by delete_pod), debit PDB budgets,
+        journal the preemptor's NOMINATION claim, and protect the freed
+        node in the fit overlay so a same-round pod cannot steal it."""
+        victims = []
+        for uid in victim_uids:
+            pr = self.cache.pods.get(uid)
+            if pr is not None:
+                victims.append(pr.pod)
+        debits: dict[str, int] = {}
+        for vic in victims:
+            self.delete_pod(vic.uid, notify=False)
+            for pdb in self.pdbs.values():
+                if vic.namespace == pdb.namespace and t.label_selector_matches(
+                    pdb.selector, vic.metadata.labels
+                ):
+                    pdb.disruptions_allowed -= 1
+                    debits[pdb.name] = debits.get(pdb.name, 0) + 1
+        self._journal_append(
+            "preempt",
+            uid=pod.uid,
+            node=node_name,
+            priority=pod.spec.priority,
+            victims=[v.uid for v in victims],
+        )
+        self.metrics.preemptions += 1
+        pod.status.nominated_node_name = node_name
+        self.nominator[pod.uid] = (
+            node_name,
+            self.builder.pod_delta_vectors(pod),
+            pod.spec.priority,
+        )
+        rec = self.cache.nodes.get(node_name)
+        if rec is not None:
+            self.queue.on_event(Event.POD_DELETE, self._free_ctx({rec.row}))
+        for v in victims:
+            self.recorder.event(
+                v.uid, NORMAL, "Preempted",
+                f"Preempted by {pod.uid} on node {node_name}",
+            )
+        return {
+            "node": node_name,
+            "victims": [v.uid for v in victims],
+            # Evicted gang members: the router debits its FLEET-wide
+            # quorum credit (the local _debit_gang ran inside delete_pod).
+            "victim_groups": [
+                v.spec.pod_group for v in victims if v.spec.pod_group
+            ],
+            # PDB state is cluster-global but budgets are debited where
+            # the victim died — the router broadcasts these to the other
+            # shards (apply_pdb_debit) so every owner's pickOneNode
+            # violation counts match the single scheduler's.
+            "pdb_debits": [{"name": n, "n": c} for n, c in sorted(debits.items())],
+            # Freed capacity on the victims' node, nominated claims
+            # already subtracted — the router's POD_DELETE wake hint.
+            "freed": self.fleet_free_ctx([node_name]),
+        }
+
+    def apply_pdb_debit(self, name: str, n: int) -> None:
+        """Mirror a foreign shard's preemption debit on the local PDB copy
+        (the router broadcasts execute_preemption's pdb_debits)."""
+        pdb = self.pdbs.get(name)
+        if pdb is not None:
+            pdb.disruptions_allowed -= n
+
+    def fleet_free_ctx(self, node_names: list[str]) -> dict | None:
+        """JSON-able free-capacity summary of the named nodes (the
+        EventCtx payload, queue.py) — the router rebuilds an EventCtx from
+        it to drive ITS queue's precise fit-wake hints, since only the
+        owning shard can see the node's host arrays."""
+        rows = {
+            self.cache.nodes[nm].row
+            for nm in node_names
+            if nm in self.cache.nodes
+        }
+        if not rows:
+            return None
+        ctx = self._free_ctx(rows)
+        return {
+            "max_free": [int(x) for x in ctx.max_free],
+            "max_slots": int(ctx.max_slots),
+        }
 
     def _full_inv(self) -> dict:
         """Batch invariants, plus — in truncated (parity) mode only — the

@@ -611,6 +611,26 @@ class ResyncingClient:
         doc["host"] = self.flight_recorder.snapshot(limit or None)
         return doc
 
+    def fleet(self, op: str, payload: dict | None = None) -> dict:
+        """One partitioned-fleet protocol op against a shard owner behind
+        this client (fleet/owner.py).  Fleet ops have NO degraded
+        fallback by design: a shard owner the breaker gave up on is
+        exactly the condition the fleet answers with TAKEOVER
+        (fleet/takeover.py) — scheduling around it host-side would fork
+        the shard's journal."""
+
+        def _unreachable() -> dict:
+            raise ConnectionError(
+                f"fleet op {op!r}: shard owner unreachable (degraded) — "
+                "take the shard over instead of degrading"
+            )
+
+        return self._call_or_degraded(
+            lambda: self._client.fleet(op, payload),
+            _unreachable,
+            kind="fleet",
+        )
+
     def _degraded_metrics(self) -> str:
         text = self.registry.render_text()
         if self._fallback is not None:

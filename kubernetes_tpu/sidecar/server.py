@@ -122,6 +122,7 @@ class SidecarServer:
         http_host: str = "127.0.0.1",
         journal=None,
         snapshot_every_batches: int = 64,
+        fleet_owner=None,
         **kw,
     ):
         self.path = path
@@ -144,6 +145,11 @@ class SidecarServer:
             )
         else:
             self.recovery_stats = None
+        # Partitioned-fleet owner (fleet/owner.py, `serve --shard-of`):
+        # the `fleet` frame dispatches through it.  Hung off the scheduler
+        # so _dispatch — which receives only the scheduler — can reach it.
+        self.fleet_owner = fleet_owner
+        self.scheduler._fleet_owner = fleet_owner
         # Wire deployments hand nominations back to the host (it owns the
         # victims' API deletes); the in-process inline commit would act on
         # them sidecar-side and desync the two views.
@@ -392,6 +398,24 @@ def _dispatch(
         out.response.flight_json = _json.dumps(
             sched.flight.snapshot(env.flight.limit or None)
         ).encode()
+        return False
+    if kind == "fleet":
+        # Partitioned-fleet protocol (fleet/owner.py fleet_dispatch): one
+        # frame = one op against this process's shard owner.  Requires
+        # `serve --shard-of` — a plain sidecar has no shard identity.
+        import json as _json
+
+        owner = getattr(sched, "_fleet_owner", None)
+        if owner is None:
+            raise ValueError("fleet ops require serve --shard-of")
+        from ..fleet.owner import fleet_dispatch
+
+        result = fleet_dispatch(
+            owner,
+            env.fleet.op,
+            _json.loads(env.fleet.payload_json or b"{}"),
+        )
+        out.response.fleet_json = _json.dumps(result).encode()
         return False
     if kind == "add":
         if env.add.kind == "PendingPod":
@@ -709,6 +733,17 @@ class SidecarClient:
         if limit:
             env.flight.limit = limit
         return json.loads(self._call(env).response.flight_json)
+
+    def fleet(self, op: str, payload: dict | None = None) -> dict:
+        """One partitioned-fleet protocol op against a shard owner
+        (``serve --shard-of``): propose/commit/reserve/…, JSON in and
+        out (fleet/owner.py fleet_dispatch)."""
+        import json
+
+        env = pb.Envelope()
+        env.fleet.op = op
+        env.fleet.payload_json = json.dumps(payload or {}).encode()
+        return json.loads(self._call(env).response.fleet_json or b"{}")
 
     def subscribe(self) -> None:
         """Turn THIS connection into a decision push stream.  After the

@@ -80,6 +80,15 @@ def evolve(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _add_field(env, "flight", 12, F.TYPE_MESSAGE,
                type_name=f"{PKG}.FlightRequest", oneof=0)
     _add_field(resp, "flight_json", 8, F.TYPE_BYTES)
+    # The fleet delta (PR: partitioned scheduler fleet): one frame kind
+    # carrying {op, payload_json} to a shard owner.
+    _add_empty_message(fdp, "FleetRequest")
+    fleet = _msg(fdp, "FleetRequest")
+    _add_field(fleet, "op", 1, F.TYPE_STRING)
+    _add_field(fleet, "payload_json", 2, F.TYPE_BYTES)
+    _add_field(env, "fleet", 13, F.TYPE_MESSAGE,
+               type_name=f"{PKG}.FleetRequest", oneof=0)
+    _add_field(resp, "fleet_json", 9, F.TYPE_BYTES)
 
 
 TEMPLATE = '''# -*- coding: utf-8 -*-

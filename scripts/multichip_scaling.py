@@ -204,10 +204,78 @@ def beyond_hbm(n_nodes_big: int = 4_194_304, n_pods: int = 192) -> dict:
     return result
 
 
+def north_star(
+    n_devices: int = 8,
+    n_nodes: int = 5000,
+    scale: int = 115,
+    batch_size: int = 256,
+    chunk_size: int = 32,
+) -> dict:
+    """The ROADMAP's multichip-evidence leg at north-star scale: the full
+    default profile + gang + preemption mix (``__graft_entry__
+    .build_scale_scheduler``) at 5k nodes / ~30k pods, node axis sharded
+    over the mesh, asserted BIT-IDENTICAL (placements, preemption counts,
+    final device state) against an unsharded run of the same workload —
+    dryrun_multichip's oracle at 100× its default pod count."""
+    from __graft_entry__ import compare_scale_runs
+    from kubernetes_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_devices)
+    t0 = time.perf_counter()
+    sh, sh_place, n_pods = compare_scale_runs(
+        mesh,
+        n_nodes=n_nodes,
+        scale=scale,
+        batch_size=batch_size,
+        chunk_size=chunk_size,
+    )
+    wall_s = round(time.perf_counter() - t0, 1)
+    placed = sum(1 for v in sh_place.values() if v)
+    vips = sum(1 for k, v in sh_place.items() if k.startswith("vip") and v)
+    result = {
+        "mode": "north-star-dryrun",
+        "n_devices": n_devices,
+        "mesh": dict(mesh.shape),
+        "nodes": n_nodes,
+        "pods": n_pods + 4,  # + the VIP preemptors
+        "scale": scale,
+        "batch_size": batch_size,
+        "chunk_size": chunk_size,
+        "placed": placed,
+        "gang_members_placed": sum(
+            1 for k, v in sh_place.items() if k.startswith("g") and v
+        ),
+        "preemptions": sh.metrics.preemptions,
+        "vips_placed": vips,
+        "bit_identical_to_unsharded": True,  # compare_scale_runs asserted
+        "wall_s_both_runs": wall_s,
+        "backend": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+    return result
+
+
 if __name__ == "__main__":
     if "--beyond-hbm" in sys.argv:
         rest = [int(a) for a in sys.argv[1:] if not a.startswith("-")]
         beyond_hbm(*rest)
+    elif "--north-star" in sys.argv:
+        rest = [int(a) for a in sys.argv[1:] if not a.startswith("-")]
+        north_star(*rest)
+    elif "--r07" in sys.argv:
+        # The committed-artifact mode (MULTICHIP_r07.json): the
+        # 1/2/4/8-device scaling table over the large node axis, plus the
+        # north-star dryrun — 5k nodes / ~30k pods, full default profile
+        # with gang + preemption, sharded-vs-unsharded bit-identical.
+        doc = {
+            "scaling_table": main(16384, 256),
+            "north_star_dryrun": north_star(),
+        }
+        out = sys.argv[sys.argv.index("--r07") + 1]
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}")
     else:
         args = [int(a) for a in sys.argv[1:3]]
         main(*args)

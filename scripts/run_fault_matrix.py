@@ -71,6 +71,27 @@ KILL_CASES = (
     ("post-truncate", 1), ("post-truncate", 2),
 )
 
+# The FLEET crash subset (shard failover): the golden scenario driven by
+# a 2-shard partitioned fleet (kubernetes_tpu/fleet) — every owner
+# journaled under its own lease epoch, a mid-scenario journaled handoff
+# (node reassignment between shards) in the script — with the process
+# SIGKILLed at journal injection points, pre-map-write included (the
+# handoff's append→map-rewrite window).  Recovery is a TAKEOVER: fresh
+# owners re-acquire each shard's lease (epoch bump fences the deposed
+# writer), replay snapshot + fenced WAL, redo any journaled handoff the
+# map file never saw, re-feed host truth idempotently, and re-run the
+# scenario tail.  Final fleet bindings must be bit-identical to an
+# unkilled fleet run, with a readable recovery flight dump per killed
+# cell.
+FLEET_KILL_CASES = (
+    ("post-append", 1),
+    ("post-append", 4),
+    ("torn-append", 1),
+    ("pre-append", 3),
+    ("mid-snapshot", 1),
+    ("pre-map-write", 1),
+)
+
 # The WIRE crash subset (the ROADMAP layer-0 gap): the same scenario
 # deployed as two processes — a journaled sidecar serving the framed
 # socket and a journaled ResyncingClient host driving it — with HOST and
@@ -386,6 +407,190 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the FLEET crash matrix (shard failover via takeover) ------------------
+
+
+def _fleet_build(state_dir: str, recover: bool = False):
+    """(router, owners, map_path): a 2-shard journaled fleet running the
+    golden basic-session configuration, every owner's delete_pod
+    tombstoning host truth first (the same apiserver-commit ordering the
+    single-process matrix models).  ``recover`` builds the owners through
+    takeover.recover_shard — lease re-acquire, snapshot+WAL replay, lost
+    map writes redone, map enforced on recovered state."""
+    from gen_golden_transcripts import session_schedulers
+
+    from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+    from kubernetes_tpu.fleet.takeover import recover_shard
+
+    map_path = os.path.join(state_dir, "shardmap.json")
+    if os.path.exists(map_path):
+        smap = ShardMap.load(map_path)
+    else:
+        smap = ShardMap(n_shards=2, n_buckets=16)
+        smap.save(map_path)
+    factory = session_schedulers()["basic_session"]
+    owners = {}
+    for k in range(2):
+        sdir = os.path.join(state_dir, f"shard{k}")
+        os.makedirs(sdir, exist_ok=True)
+        if recover:
+            owner = recover_shard(sdir, factory, k, smap, map_path=map_path)
+        else:
+            owner = ShardOwner(
+                k, factory(), smap, state_dir=sdir, snapshot_every_batches=1
+            )
+        orig_delete = owner.sched.delete_pod
+
+        def delete_pod(uid: str, notify: bool = True, _orig=orig_delete):
+            _truth_delete(state_dir, uid)
+            _orig(uid, notify)
+
+        owner.sched.delete_pod = delete_pod
+        owners[k] = owner
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    return router, owners, map_path
+
+
+def _fleet_initial_owner_of(name: str) -> int:
+    from kubernetes_tpu.fleet import ShardMap
+
+    return ShardMap(n_shards=2, n_buckets=16).owner_of(name)
+
+
+def _fleet_tail(router, map_path: str, state_dir: str) -> dict:
+    """The fleet scenario tail — idempotent, like _run_scenario_tail: a
+    takeover re-runs it verbatim (committed pods are skipped by the
+    router's adopted bindings, the handoff re-applies only if its map
+    assignment never landed)."""
+    from gen_golden_transcripts import wait_for_backoffs
+
+    router.schedule_all_pending(wait_backoff=True)
+    # Mid-scenario journaled handoff: node-1 (and its bound pod) moves to
+    # the other shard — the pre-map-write window under test.
+    init = _fleet_initial_owner_of("node-1")
+    if router.shard_map.owner_of("node-1") == init:
+        rec = router.shard_map.assign("node-1", 1 - init)
+        router.apply_handoff(rec, map_path)
+    if "default/bound-2" in router._pod_shard:
+        router.remove_object("Pod", "default/bound-2")
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+    bindings = router.bindings()
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+    return bindings
+
+
+def fleet_kill_child(state_dir: str) -> None:
+    """The victim: drive the golden scenario through a 2-shard journaled
+    fleet (snapshot every batch).  TPU_JOURNAL_KILL SIGKILLs the process
+    at the armed point — whichever owner's journal (or the shard map
+    write) hits it first, exactly where a power cut would land."""
+    from gen_golden_transcripts import scenario_objects
+
+    from kubernetes_tpu.faults import KillSwitch
+
+    router, owners, map_path = _fleet_build(state_dir)
+    # Armed AFTER construction: the map-init save is setup, not the
+    # handoff window pre-map-write probes — and killing before anything
+    # durable exists would leave a cell with nothing to recover.
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in bound:
+        router.add_object("Pod", p)
+    for p in pending:
+        router.add_pod(p)
+    _fleet_tail(router, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+
+
+def fleet_recover_child(state_dir: str) -> None:
+    """The takeover: fresh owners recover each shard behind an epoch
+    bump, the router adopts the recovered bindings, host truth re-feeds
+    idempotently (tombstoned pods stay deleted), and the scenario tail
+    re-runs."""
+    from gen_golden_transcripts import scenario_objects
+
+    router, owners, map_path = _fleet_build(state_dir, recover=True)
+    deleted = _truth_deleted(state_dir)
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    # Parked journal bindings re-apply now that the nodes relisted, THEN
+    # the router adopts the complete recovered truth — pods bound
+    # pre-crash are skipped by the idempotent re-feed below.
+    router.reconcile_recovered()
+    router.adopt_bindings()
+    for p in bound:
+        if p.uid not in deleted:
+            router.add_object("Pod", p)
+    for p in pending:
+        if p.uid not in deleted:
+            router.add_pod(p)
+    _fleet_tail(router, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+
+
+def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
+    """SIGKILL the 2-shard fleet at each journal/handoff crash point,
+    take the shards over, and compare final fleet bindings to an
+    unkilled fleet run (plus a readable recovery flight dump per killed
+    cell).  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "fleet-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--fleet-kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "fleet baseline run failed"
+        failures = []
+        for point, nth in cases:
+            label = f"fleetkill:{point}@{nth}"
+            state_dir = os.path.join(td, f"fleet-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn("--fleet-kill-child", state_dir, kill=f"{point}:{nth}")
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--fleet-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}")
+                continue
+            if not _flight_dump_ok(state_dir):
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: no readable recovery flight dump")
+                continue
+            if verbose:
+                print(f"ok   {label}: takeover recovered bit-identical bindings")
+        return failures
+
+
 # -- the WIRE crash matrix (host and sidecar killed independently) ---------
 
 
@@ -656,17 +861,41 @@ def main() -> int:
     if "--wire-host-child" in sys.argv:
         wire_host_child(sys.argv[sys.argv.index("--wire-host-child") + 1])
         return 0
+    if "--fleet-kill-child" in sys.argv:
+        fleet_kill_child(sys.argv[sys.argv.index("--fleet-kill-child") + 1])
+        return 0
+    if "--fleet-recover-child" in sys.argv:
+        fleet_recover_child(
+            sys.argv[sys.argv.index("--fleet-recover-child") + 1]
+        )
+        return 0
+    if "--fleet-kill" in sys.argv:
+        # The shard-failover subset alone (also rides --kill).
+        failures = run_fleet_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(FLEET_KILL_CASES)} fleet kill "
+                f"cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(FLEET_KILL_CASES)} shard-failover cases recovered "
+            "to bit-identical bindings with flight dumps"
+        )
+        return 0
     if "--kill" in sys.argv:
         failures = run_kill_matrix()
         # The wire-deployment subset rides --kill (the ROADMAP layer-0
         # gap): host and sidecar SIGKILLed independently.
         failures += run_wire_kill_matrix()
-        total = len(KILL_CASES) + len(WIRE_KILL_CASES)
+        # The shard-failover subset (fleet takeover) rides --kill too.
+        failures += run_fleet_kill_matrix()
+        total = len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")
             return 1
         print(
-            f"all {total} crash-matrix cases (in-process + wire) "
+            f"all {total} crash-matrix cases (in-process + wire + fleet) "
             "recovered to bit-identical bindings with flight dumps"
         )
         return 0

@@ -120,8 +120,105 @@ def determinism_check(cfg) -> dict:
     }
 
 
+def fleet_determinism_check(cfg, shards: int) -> dict:
+    """Two short same-seed virtual fleet runs — the fleet's replayability
+    proof (router scatter-gather included), recorded on the artifact."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import run_fleet_soak
+
+    small = dataclasses.replace(
+        cfg,
+        nodes=min(cfg.nodes, 32),
+        churn_nodes=2,
+        duration_s=3.0,
+        live_pod_cap=100,
+        warm_pods=32,
+        batch_size=64,
+        chunk_size=1,
+        two_process=False,
+        pace="virtual",
+        journal_fsync="never",
+        out_dir="",
+        journal_dir="",
+        node_flap_period_s=2.0,
+        cold_consumer_period_s=2.5,
+    )
+    a = run_fleet_soak(small, shards)
+    b = run_fleet_soak(small, shards)
+    return {
+        "seed": small.seed,
+        "shards": shards,
+        "runs": 2,
+        "arrival_schedule_identical": (
+            a["_arrival_offsets"] == b["_arrival_offsets"]
+        ),
+        "bindings_identical": (
+            a["determinism"]["bindings_sha256"]
+            == b["determinism"]["bindings_sha256"]
+        ),
+        "bindings_sha256": a["determinism"]["bindings_sha256"],
+        "bound_final": a["bound_final"],
+    }
+
+
+def run_fleet(args) -> int:
+    """--shards N: soak the partitioned fleet (kubernetes_tpu/fleet)
+    through the loadgen scenarios — flaps pinned to shard 0, periodic
+    cold router restarts — and record the fleet SOAK artifact with
+    per-shard SLO percentiles."""
+    from kubernetes_tpu.loadgen.soak import run_fleet_soak, strip_private
+
+    cfg = r06_config(args)
+    check = None
+    if not args.skip_determinism_check:
+        print(
+            f"run_soak: fleet determinism cross-check (2× virtual, "
+            f"{args.shards} shards)…",
+            flush=True,
+        )
+        check = fleet_determinism_check(cfg, args.shards)
+        print(f"run_soak: {json.dumps(check)}", flush=True)
+        if not (
+            check["arrival_schedule_identical"]
+            and check["bindings_identical"]
+        ):
+            print("run_soak: FLEET DETERMINISM CHECK FAILED", file=sys.stderr)
+            return 1
+    print(
+        f"run_soak: fleet soak — {args.shards} shards, seed {cfg.seed}, "
+        f"{cfg.rate_pods_per_s} pods/s for {cfg.duration_s:.0f}s…",
+        flush=True,
+    )
+    artifact = strip_private(run_fleet_soak(cfg, args.shards))
+    artifact["determinism_check"] = check
+    artifact["environment"] = {
+        "backend": os.environ.get("JAX_PLATFORMS", ""),
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    shard_p99 = {
+        k: v["slo"]["p99_ms"] for k, v in artifact["per_shard"].items()
+    }
+    print(
+        f"run_soak: wrote {args.out} — fleet p50/p99 "
+        f"{artifact['slo']['p50_ms']}/{artifact['slo']['p99_ms']}ms, "
+        f"per-shard p99 {shard_p99}, "
+        f"{artifact['router_restarts']} router restarts, "
+        f"{artifact['sustained_pods_per_sec']} pods/s sustained",
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shards", type=int, default=0,
+                    help="soak the partitioned fleet with N shard owners "
+                    "instead of the two-process speculative deployment")
     ap.add_argument("--out", default="SOAK_r06.json")
     ap.add_argument("--out-dir", default="",
                     help="flight-dump directory (default: alongside --out)")
@@ -150,6 +247,9 @@ def main() -> int:
             os.path.dirname(os.path.abspath(args.out)) or ".",
             "soak_dumps",
         )
+
+    if args.shards:
+        return run_fleet(args)
 
     from kubernetes_tpu.loadgen.soak import run_soak, strip_private
 

@@ -1,0 +1,19 @@
+"""Seeded fleet determinism violations: a router whose shard hashing
+draws entropy or reads wall clocks routes the same pod differently every
+run — the N-shard vs single-scheduler oracle could never hold."""
+
+import random
+import time
+
+
+def route(pod_uid, n_shards):
+    # POSITIVE det-random: entropy in the routing decision — crc32 over
+    # the uid (shardmap.stable_shard_hash) is the deterministic idiom.
+    return random.randrange(n_shards)
+
+
+def tie_break(candidates):
+    # POSITIVE det-wallclock: a wall-clock-seeded tie-break diverges from
+    # the device kernel's counter-hash mirror run to run.
+    seed = int(time.time())
+    return candidates[seed % len(candidates)]

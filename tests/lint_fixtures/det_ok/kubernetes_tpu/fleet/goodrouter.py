@@ -1,0 +1,14 @@
+"""Fleet determinism negative fixture: crc32 routing and counter-hash
+tie-breaks are pure functions of their inputs (zero findings)."""
+
+import zlib
+
+
+def route(pod_uid: str, n_shards: int) -> int:
+    return zlib.crc32(pod_uid.encode()) % max(n_shards, 1)
+
+
+def tie_break(candidates, step: int):
+    x = (step * 0x9E3779B1) & 0xFFFFFFFF
+    x = ((x ^ (x >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+    return sorted(candidates)[x % len(candidates)]

@@ -1,0 +1,21 @@
+"""Seeded fleet WAL violations: a shard handoff made live without its
+journal record first is a transfer the next takeover cannot redo."""
+
+
+class BadOwner:
+    def import_without_journal(self, record, payload):
+        # POSITIVE wal-unjournaled-apply: the handoff applies with no
+        # journal append anywhere in scope — a crash here strands the
+        # nodes on neither shard's journal.
+        self.apply_handoff(payload)
+
+    def import_apply_then_append(self, record, payload):
+        # POSITIVE wal-apply-before-journal: apply precedes the append —
+        # the exact window pre-map-write crashes into.
+        self.apply_handoff(payload)
+        self.sched._journal_append("handoff", **record)
+
+    def healthy_import(self, record, payload):
+        # NEGATIVE: journal-before-apply, the required shape.
+        self.sched._journal_append("handoff", **record)
+        self.apply_handoff(payload)

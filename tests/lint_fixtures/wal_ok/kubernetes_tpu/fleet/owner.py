@@ -1,0 +1,11 @@
+"""Fleet WAL negative fixture: the handoff journals before it applies
+(tests/test_static_analysis.py expects zero findings)."""
+
+
+class GoodOwner:
+    def import_nodes(self, record, payload):
+        self.sched._journal_append("handoff", **record)
+        self.apply_handoff(payload)
+
+    def no_apply_sites(self, names):
+        return [n for n in names if n in self.sched.cache.nodes]

@@ -1,0 +1,592 @@
+"""Partitioned scheduler fleet (kubernetes_tpu/fleet): shard-map
+split/merge round-trips, misroute forwarding, cross-shard preemption,
+gang 2PC spanning shards (including crash-between-phases replay), shard
+takeover, and the N∈{2,4} vs single-scheduler bit-identical oracle on
+the golden scenarios.
+
+The oracle discipline carries over from every prior PR: a fleet of N
+owners coordinated by the router must reproduce ONE scheduler's
+decisions byte for byte — scatter-gather proposals, a host-side
+selectHost mirror (global row order + splitmix32 counter-hash
+tie-break), and the 2PC/preemption arbitration exist exactly to make
+that true."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from gen_golden_transcripts import (  # noqa: E402
+    scenario_objects,
+    session_schedulers,
+    wait_for_backoffs,
+)
+
+from kubernetes_tpu.api import types as t  # noqa: E402
+from kubernetes_tpu.api.wrappers import make_node, make_pod  # noqa: E402
+from kubernetes_tpu.fleet import (  # noqa: E402
+    FleetRouter,
+    ShardMap,
+    ShardOwner,
+)
+from kubernetes_tpu.fleet.shardmap import (  # noqa: E402
+    StaleMapError,
+    stable_shard_hash,
+)
+from kubernetes_tpu.fleet.takeover import (  # noqa: E402
+    absorb_shard,
+    recover_shard,
+    redo_handoff,
+)
+from kubernetes_tpu.framework.config import fit_only_profile  # noqa: E402
+from kubernetes_tpu.scheduler import TPUScheduler  # noqa: E402
+
+
+def mk_sched() -> TPUScheduler:
+    return TPUScheduler(profile=fit_only_profile(), batch_size=8, chunk_size=1)
+
+
+def big_node(name: str, cpu: str = "4"):
+    return (
+        make_node(name)
+        .capacity({"cpu": cpu, "memory": "16Gi", "pods": 16})
+        .obj()
+    )
+
+
+def build_fleet(
+    n_shards: int = 2,
+    pin: dict[str, int] | None = None,
+    state_root: str | None = None,
+    factory=mk_sched,
+):
+    """(router, owners, map): a fleet with optional node→shard pins (so
+    targeted tests control ownership exactly) and optional journaling."""
+    smap = ShardMap(n_shards=n_shards, n_buckets=16)
+    for name, shard in (pin or {}).items():
+        smap.overrides[name] = shard
+    owners = {}
+    for k in range(n_shards):
+        sdir = os.path.join(state_root, f"shard{k}") if state_root else None
+        owners[k] = ShardOwner(
+            k, factory(), smap, state_dir=sdir, snapshot_every_batches=1
+        )
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    return router, owners, smap
+
+
+def name_homing_to(shard: int, n_shards: int, stem: str = "pod") -> str:
+    """A pod name whose uid hash-routes to ``shard`` when all
+    ``n_shards`` shards are viable (home_shard sorts viable ids, so with
+    every shard populated the index IS the shard id)."""
+    for i in range(1000):
+        name = f"{stem}-{i}"
+        if stable_shard_hash(f"default/{name}", n_shards) == shard:
+            return name
+    raise AssertionError("unreachable")
+
+
+# -- shard map ---------------------------------------------------------------
+
+
+def test_shardmap_split_merge_round_trip(tmp_path):
+    m = ShardMap(n_shards=1, n_buckets=16)
+    names = [f"node-{i}" for i in range(24)]
+    assert all(m.owner_of(n) == 0 for n in names)
+
+    rec = m.split(0, 1)
+    assert rec["op"] == "split" and rec["version"] == 1
+    split_owned = {n: m.owner_of(n) for n in names}
+    assert set(split_owned.values()) == {0, 1}
+
+    # Save/load round-trips the exact assignment.
+    path = str(tmp_path / "map.json")
+    m.save(path)
+    loaded = ShardMap.load(path)
+    assert {n: loaded.owner_of(n) for n in names} == split_owned
+    assert loaded.version == m.version
+
+    # Merge restores the pre-split world, at a strictly newer version.
+    rec2 = m.merge(into=0, absorbed=1)
+    assert rec2["version"] == 2
+    assert all(m.owner_of(n) == 0 for n in names)
+
+
+def test_shardmap_save_rejects_stale_writer(tmp_path):
+    path = str(tmp_path / "map.json")
+    m = ShardMap(n_shards=2, n_buckets=16)
+    m.split(0, 1)
+    m.save(path)
+    stale = ShardMap(n_shards=2, n_buckets=16)  # version 0 < disk's 1
+    with pytest.raises(StaleMapError):
+        stale.save(path)
+
+
+def test_handoff_record_redo_is_idempotent():
+    """takeover.redo_handoff applied twice lands on the same map — the
+    property that makes the append→map-rewrite crash window safe."""
+    m = ShardMap(n_shards=2, n_buckets=16)
+    rec = m.split(0, 2)
+    stale = ShardMap(n_shards=2, n_buckets=16)
+    redo_handoff(stale, rec)
+    once = (list(stale.buckets), dict(stale.overrides), stale.version)
+    redo_handoff(stale, rec)
+    assert (list(stale.buckets), dict(stale.overrides), stale.version) == once
+    assert stale.buckets == m.buckets
+
+
+def test_shard_guard_drops_foreign_nodes():
+    smap = ShardMap(n_shards=2, n_buckets=16)
+    smap.overrides["mine"] = 0
+    smap.overrides["yours"] = 1
+    owner = ShardOwner(0, mk_sched(), smap)
+    owner.sched.add_node(big_node("mine"))
+    owner.sched.add_node(big_node("yours"))
+    assert sorted(owner.sched.cache.nodes) == ["mine"]
+    assert owner.sched.shard_rejected_nodes == 1
+
+
+# -- routing and misroute forwarding ----------------------------------------
+
+
+def test_misroute_forwards_to_global_winner():
+    """A pod whose hash-home shard has no feasible node commits on the
+    winning shard and is counted as forwarded."""
+    pin = {"full": 0, "roomy": 1}
+    router, owners, _ = build_fleet(2, pin=pin)
+    router.add_object("Node", big_node("full", cpu="1"))
+    router.add_object("Node", big_node("roomy", cpu="4"))
+    # Saturate shard 0's node so only shard 1 is feasible.
+    blocker = make_pod("blocker").req({"cpu": "1"}).node("full").obj()
+    router.add_object("Pod", blocker)
+
+    name = name_homing_to(0, 2, "misroute")
+    pod = make_pod(name).req({"cpu": "2"}).obj()
+    assert router.home_shard(pod) == 0
+    router.add_pod(pod)
+    outs = router.schedule_all_pending()
+    assert [(o.pod.name, o.node_name) for o in outs] == [(name, "roomy")]
+    assert router.bindings()[pod.uid] == "roomy"
+    assert router._pod_shard[pod.uid] == 1
+    assert router._forwarded.get() == 1
+    # The owner caches agree with the router's bookkeeping.
+    assert pod.uid in owners[1].bindings()
+    assert pod.uid not in owners[0].bindings()
+
+
+def test_home_shard_skips_empty_shards():
+    """Feasibility-aware hashing: a shard owning zero nodes is never a
+    home (hashing a pod there would guarantee a misroute)."""
+    router, _, _ = build_fleet(2, pin={"only": 1})
+    router.add_object("Node", big_node("only"))
+    for i in range(8):
+        pod = make_pod(f"p{i}").req({"cpu": "1"}).obj()
+        assert router.home_shard(pod) == 1
+
+
+# -- cross-shard preemption --------------------------------------------------
+
+
+def test_cross_shard_preemption_with_pdb_broadcast():
+    """A high-priority pod preempts a victim on a FOREIGN shard; the
+    victim's PDB debit is broadcast so every owner's budget view stays
+    cluster-global."""
+    pin = {"away": 1, "spare": 0}
+    router, owners, _ = build_fleet(2, pin=pin)
+    router.add_object("Node", big_node("away", cpu="4"))
+    victim = (
+        make_pod("victim")
+        .req({"cpu": "4"})
+        .label("app", "sacrificial")
+        .priority(1)
+        .start_time(1.0)
+        .node("away")
+        .obj()
+    )
+    router.add_object("Pod", victim)
+    pdb = t.PodDisruptionBudget(
+        name="guard",
+        selector={"app": "sacrificial"},
+        disruptions_allowed=2,
+    )
+    router.add_object("PodDisruptionBudget", pdb)
+
+    name = name_homing_to(0, 2, "vip")
+    # Shard 0 needs a node or home_shard collapses to shard 1; too small
+    # for the preemptor, so the only candidate is shard 1's victim.
+    router.add_object("Node", big_node("spare", cpu="1"))
+    vip = make_pod(name).req({"cpu": "3"}).priority(100).obj()
+    assert router.home_shard(vip) == 0
+    router.add_pod(vip)
+    router.schedule_all_pending(wait_backoff=True)
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+
+    bindings = router.bindings()
+    assert bindings[vip.uid] == "away"
+    assert victim.uid not in bindings
+    assert router._preempt_xshard.get() == 1
+    # The debit landed on BOTH owners' PDB copies.
+    for owner in owners.values():
+        assert owner.sched.pdbs["guard"].disruptions_allowed == 1
+
+
+# -- gang 2PC spanning shards ------------------------------------------------
+
+
+def gang_pod(name: str, group: str, cpu: str = "3") -> t.Pod:
+    return make_pod(name).req({"cpu": cpu}).pod_group(group).obj()
+
+
+def feed_gang_fleet(router, group: str = "g1", members: int = 2):
+    router.add_object("Node", big_node("left", cpu="4"))
+    router.add_object("Node", big_node("right", cpu="4"))
+    router.add_object("PodGroup", t.PodGroup(name=group, min_member=members))
+    pods = [gang_pod(f"m{i}", group) for i in range(members)]
+    return pods
+
+
+def test_gang_2pc_spans_shards():
+    """minMember=2 with one feasible node per shard: phase 1 reserves on
+    each winning shard, phase 2 commits both — and below quorum nothing
+    schedules (the members park in the router queue's gang pool)."""
+    pin = {"left": 0, "right": 1}
+    router, owners, _ = build_fleet(2, pin=pin)
+    pods = feed_gang_fleet(router)
+    router.add_pod(pods[0])
+    assert router.schedule_all_pending() == []
+    assert router.bindings() == {}
+
+    router.add_pod(pods[1])
+    outs = router.schedule_all_pending()
+    assert sorted(o.pod.name for o in outs if o.node_name) == ["m0", "m1"]
+    bindings = router.bindings()
+    assert sorted(bindings) == ["default/m0", "default/m1"]
+    # One member per shard: the gang genuinely spanned the partition.
+    assert {bindings[u] for u in bindings} == {"left", "right"}
+    assert router.gang_bound == {"g1": 2}
+    assert router._gang_commits.get(phase="reserve") == 2
+    assert router._gang_commits.get(phase="commit") == 2
+    for owner in owners.values():
+        assert owner.sched.gang_bound == {"g1": 1}
+        assert owner.sched._fleet_reserved == {}
+
+
+def test_gang_2pc_rollback_on_reserve_refusal():
+    """A member failing phase 1 aborts every held reservation: no
+    partial gang survives, resources release, members retry via
+    backoff."""
+    pin = {"left": 0, "right": 1}
+    router, owners, _ = build_fleet(2, pin=pin)
+    router.add_object("Node", big_node("left", cpu="4"))
+    router.add_object("Node", big_node("right", cpu="1"))  # can't host a member
+    router.add_object("PodGroup", t.PodGroup(name="g1", min_member=2))
+    for i in range(2):
+        router.add_pod(gang_pod(f"m{i}", "g1"))
+    router.schedule_all_pending()
+    # Both feasible only on "left", which fits one member: the second's
+    # reserve fails (insufficient room after the first's assume) or never
+    # proposes — either way nothing may commit.
+    assert router.bindings() == {}
+    assert router.gang_bound == {}
+    for owner in owners.values():
+        assert owner.sched._fleet_reserved == {}
+        assert not any(
+            pr.bound for pr in owner.sched.cache.pods.values()
+        )
+    # Capacity arrives → the gang re-admits and commits whole.
+    router.add_object("Node", big_node("more", cpu="8"))
+    outs = router.schedule_all_pending(wait_backoff=True)
+    assert sorted(o.pod.name for o in outs if o.node_name) == ["m0", "m1"]
+    assert router.gang_bound == {"g1": 2}
+
+
+def test_gang_2pc_crash_between_phases_replays_presumed_abort(tmp_path):
+    """SIGKILL between phase 1 and phase 2: the journal holds
+    ``gang_reserve`` intents with no bind records.  Recovery resolves
+    them presumed-abort (nothing applied, intents surfaced), and a fresh
+    fleet re-admits the gang from scratch — converging to the same
+    bindings an uncrashed fleet lands."""
+    pin = {"left": 0, "right": 1}
+
+    # The uncrashed reference.
+    ref_router, ref_owners, _ = build_fleet(2, pin=pin)
+    pods = feed_gang_fleet(ref_router)
+    for p in pods:
+        ref_router.add_pod(p)
+    ref_router.schedule_all_pending()
+    reference = ref_router.bindings()
+    assert sorted(reference) == ["default/m0", "default/m1"]
+
+    # The crashed run: commit_gang "crashes" before any phase-2 call —
+    # reserves are journaled, commits never happen, owners die.
+    root = str(tmp_path / "crash")
+    router, owners, _ = build_fleet(2, pin=pin, state_root=root)
+    pods = feed_gang_fleet(router)
+    for p in pods:
+        router.add_pod(p)
+
+    class Crashed(RuntimeError):
+        pass
+
+    def crash(_g, _trigger):
+        raise Crashed()
+
+    router._commit_gang = crash
+    with pytest.raises(Crashed):
+        router.schedule_all_pending()
+    for owner in owners.values():
+        assert owner.sched._fleet_reserved  # phase 1 really happened
+        # Simulate the kill: no abort runs, nothing is unwound.  The
+        # flock must drop (a dead process's does instantly) or the
+        # takeover's blocking acquire would wait on ourselves; release
+        # keeps the epoch, so the successor still fences above it.
+        owner.journal.close()
+        owner.lease.release()
+
+    # Takeover: fresh owners replay each shard's journal.
+    recovered = {}
+    for k in range(2):
+        recovered[k] = recover_shard(
+            os.path.join(root, f"shard{k}"), mk_sched, k,
+            ShardMap(n_shards=2, n_buckets=16, overrides=pin),
+        )
+        stats = recovered[k].recovery_stats
+        assert stats["in_doubt_reservations"] == 1  # the orphaned intent
+        assert not any(
+            pr.bound for pr in recovered[k].sched.cache.pods.values()
+        )
+
+    smap = ShardMap(n_shards=2, n_buckets=16, overrides=pin)
+    router2 = FleetRouter(recovered, smap, batch_size=8)
+    router2.profile_filters = tuple(recovered[0].sched.profile.filters)
+    # Host-truth re-feed first (nodes relist), then parked journal
+    # bindings re-apply, then the router adopts the recovered truth —
+    # the same order the shard-failover kill matrix drives.
+    pods = feed_gang_fleet(router2)
+    router2.reconcile_recovered()
+    router2.adopt_bindings()
+    # Gang re-admission from scratch.
+    for p in pods:
+        router2.add_pod(p)
+    router2.schedule_all_pending(wait_backoff=True)
+    assert router2.bindings() == reference
+    for owner in recovered.values():
+        owner.close()
+
+
+def test_gang_2pc_crash_mid_phase_two_converges(tmp_path):
+    """Crash AFTER one member committed but before the other: replay
+    binds the committed member (its bind record is durable), presumed-
+    aborts the other's intent, and re-admission completes the gang —
+    quorum credit counts the already-bound member."""
+    pin = {"left": 0, "right": 1}
+    root = str(tmp_path / "crash2")
+    router, owners, _ = build_fleet(2, pin=pin, state_root=root)
+    pods = feed_gang_fleet(router)
+    for p in pods:
+        router.add_pod(p)
+
+    class Crashed(RuntimeError):
+        pass
+
+    orig = FleetRouter._commit_gang
+    calls = {"n": 0}
+
+    def crash_after_first(self, g, trigger):
+        room = self._gang_rooms[g]
+        uid, shard = room.members[0]
+        self._call(shard, "commit_reserved", {"uid": uid})  # member 1 lands
+        raise Crashed()
+
+    router._commit_gang = crash_after_first.__get__(router)
+    with pytest.raises(Crashed):
+        router.schedule_all_pending()
+    for owner in owners.values():
+        owner.journal.close()  # the kill: no abort, lease flock drops
+        owner.lease.release()
+
+    recovered = {
+        k: recover_shard(
+            os.path.join(root, f"shard{k}"), mk_sched, k,
+            ShardMap(n_shards=2, n_buckets=16, overrides=pin),
+        )
+        for k in range(2)
+    }
+    in_doubt = sum(
+        o.recovery_stats["in_doubt_reservations"] for o in recovered.values()
+    )
+    assert in_doubt == 1  # the other member's orphaned intent
+
+    smap = ShardMap(n_shards=2, n_buckets=16, overrides=pin)
+    router2 = FleetRouter(recovered, smap, batch_size=8)
+    router2.profile_filters = tuple(recovered[0].sched.profile.filters)
+    pods = feed_gang_fleet(router2)  # host-truth node relist
+    router2.reconcile_recovered()
+    router2.adopt_bindings()
+    # Exactly the phase-2 half that landed survived the crash.
+    bound_now = {u for o in recovered.values() for u in o.bindings()}
+    assert len(bound_now) == 1
+    assert router2.gang_bound == {"g1": 1}  # adopted credit
+    for p in pods:
+        router2.add_pod(p)  # the bound member's re-feed is a no-op
+    router2.schedule_all_pending(wait_backoff=True)
+    bindings = router2.bindings()
+    assert sorted(bindings) == ["default/m0", "default/m1"]
+    assert router2.gang_bound == {"g1": 2}
+    for owner in recovered.values():
+        owner.close()
+
+
+def test_rebalance_handoff_moves_nodes_live(tmp_path):
+    """A rebalance record (no single src/dst) sweeps every owner pair:
+    pinned nodes return to their bucket owners with their bound pods,
+    and the map file lands at the record's version."""
+    pin = {"a": 0, "b": 1}
+    router, owners, smap = build_fleet(2, pin=pin)
+    router.add_object("Node", big_node("a"))
+    router.add_object("Node", big_node("b"))
+    pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    for p in pods:
+        router.add_pod(p)
+    router.schedule_all_pending()
+    before = router.bindings()
+    assert len(before) == 4
+
+    map_path = str(tmp_path / "map.json")
+    rec = smap.rebalance(2)  # drops the overrides: bucket rule decides
+    router.apply_handoff(rec, map_path)
+    assert router.bindings() == before  # bindings survive the reshuffle
+    # Every node now lives where the bucket rule puts it.
+    for name in ("a", "b"):
+        holder = [
+            k for k, o in owners.items() if name in o.sched.cache.nodes
+        ]
+        assert holder == [smap.owner_of(name)]
+    assert ShardMap.load(map_path).version == rec["version"]
+    # Routing still works post-rebalance.
+    extra = make_pod("post").req({"cpu": "1"}).obj()
+    router.add_pod(extra)
+    router.schedule_all_pending()
+    assert extra.uid in router.bindings()
+
+
+# -- takeover ---------------------------------------------------------------
+
+
+def test_survivor_absorbs_dead_shard(tmp_path):
+    """absorb_shard: the survivor adopts a dead owner's nodes AND
+    bindings through the journaled merge path; the merged map routes
+    everything to the survivor."""
+    pin = {"left": 0, "right": 1}
+    root = str(tmp_path / "fleet")
+    router, owners, smap = build_fleet(2, pin=pin, state_root=root)
+    router.add_object("Node", big_node("left"))
+    router.add_object("Node", big_node("right"))
+    for i in range(3):
+        router.add_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    router.schedule_all_pending()
+    before = router.bindings()
+    assert len(before) == 3
+
+    # Shard 1 dies (journal closed, lease released — the flock frees).
+    dead_bindings = owners[1].bindings()
+    owners[1].close()
+
+    map_path = str(tmp_path / "map.json")
+    smap.save(map_path)
+    record = absorb_shard(
+        owners[0], os.path.join(root, "shard1"), 1, mk_sched, smap,
+        map_path=map_path,
+    )
+    assert record["op"] == "merge"
+    # The survivor now holds every binding, including the dead shard's.
+    survivor = owners[0].bindings()
+    assert before == dict(survivor)
+    for uid in dead_bindings:
+        assert survivor[uid] == dead_bindings[uid]
+    assert smap.owner_of("right") == 0
+    assert ShardMap.load(map_path).owner_of("right") == 0
+    owners[0].close()
+
+
+def test_router_restart_adopts_without_double_scheduling():
+    """A cold router rebuild (the fleet's cold-consumer analog) adopts
+    the owners' truth: bound pods re-fed as objects do not re-queue, and
+    the row-allocator mirror re-derives from the node re-feed."""
+    pin = {"left": 0, "right": 1}
+    router, owners, smap = build_fleet(2, pin=pin)
+    nodes = [big_node("left"), big_node("right")]
+    for n in nodes:
+        router.add_object("Node", n)
+    pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    for p in pods:
+        router.add_pod(p)
+    router.schedule_all_pending()
+    before = router.bindings()
+    assert len(before) == 4
+
+    router2 = FleetRouter(owners, smap, batch_size=8)
+    router2.profile_filters = tuple(owners[0].sched.profile.filters)
+    for n in nodes:
+        router2.add_object("Node", n)
+    router2.adopt_bindings()
+    for p in pods:
+        router2.add_pod(p)  # all already bound → no-ops
+    assert len(router2.queue) == 0
+    assert router2.schedule_all_pending() == []
+    assert router2.bindings() == before
+
+
+# -- the oracle --------------------------------------------------------------
+
+
+def run_single(stem: str) -> dict:
+    sched = session_schedulers()[stem]()
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in bound:
+        sched.add_pod(p)
+    for p in pending:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    wait_for_backoffs(sched.queue)
+    sched.schedule_all_pending(wait_backoff=True)
+    return {
+        uid: pr.node_name
+        for uid, pr in sorted(sched.cache.pods.items())
+        if pr.bound
+    }
+
+
+def run_fleet(stem: str, n_shards: int) -> dict:
+    smap = ShardMap(n_shards=n_shards, n_buckets=16)
+    factory = session_schedulers()[stem]
+    owners = {k: ShardOwner(k, factory(), smap) for k in range(n_shards)}
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in bound:
+        router.add_object("Pod", p)
+    for p in pending:
+        router.add_pod(p)
+    router.schedule_all_pending(wait_backoff=True)
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+    return router.bindings()
+
+
+@pytest.mark.parametrize("stem", ["basic_session", "default_session"])
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_fleet_binds_bit_identical_to_single_scheduler(stem, n_shards):
+    """The acceptance oracle: an N-shard fleet reproduces the single
+    scheduler's bindings on the golden scenario — same nodes, same pods,
+    same preemption victim, same unschedulable leftover — for both the
+    fit-only and the full default profile."""
+    assert run_fleet(stem, n_shards) == run_single(stem)

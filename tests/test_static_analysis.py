@@ -84,9 +84,16 @@ def test_repo_baseline_entries_are_justified():
 
 def test_wal_rules_fire_on_seeded_violations():
     got = rules_of(lint("wal_bad"))
-    assert got.count("wal-apply-before-journal") == 1
-    assert got.count("wal-unjournaled-apply") == 1
-    assert len(got) == 2, got  # healthy_commit stays silent
+    # One of each in the scheduler fixture + one of each in the fleet
+    # handoff fixture (apply_handoff is an apply marker).
+    assert got.count("wal-apply-before-journal") == 2
+    assert got.count("wal-unjournaled-apply") == 2
+    assert len(got) == 4, got  # the healthy shapes stay silent
+
+
+def test_wal_rules_cover_fleet_handoffs():
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/fleet/owner.py" in paths
 
 
 def test_wal_negative_tree_is_clean():
@@ -98,11 +105,12 @@ def test_wal_negative_tree_is_clean():
 
 def test_det_rules_fire_on_seeded_violations():
     got = rules_of(lint("det_bad"))
-    # ops/badop.py seeds one wallclock; loadgen/gen.py seeds another —
-    # the determinism family must cover the traffic generator too (a
-    # soak's replayability is part of the parity story).
-    assert got.count("det-wallclock") == 2
-    assert got.count("det-random") == 3  # random.random + os.urandom + expovariate
+    # ops/badop.py seeds one wallclock; loadgen/gen.py and
+    # fleet/badrouter.py seed the others — the determinism family must
+    # cover the traffic generator AND the fleet router (hash routing and
+    # the selectHost mirror are part of the oracle story).
+    assert got.count("det-wallclock") == 3
+    assert got.count("det-random") == 4  # random.random/randrange + os.urandom + expovariate
     assert got.count("det-set-iteration") == 2  # for-loop + list(set(...))
     assert got.count("det-id-key") == 1
 
@@ -110,6 +118,11 @@ def test_det_rules_fire_on_seeded_violations():
 def test_det_rules_cover_loadgen():
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/loadgen/gen.py" in paths
+
+
+def test_det_rules_cover_fleet():
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/fleet/badrouter.py" in paths
 
 
 def test_det_negative_tree_is_clean():
@@ -166,7 +179,7 @@ def test_wire_kinds_parse_from_the_real_proto():
 
     assert declared_kinds(text) == [
         "add", "remove", "schedule", "response", "dump", "subscribe",
-        "push", "health", "metrics", "events", "flight",
+        "push", "health", "metrics", "events", "flight", "fleet",
     ]
 
 
