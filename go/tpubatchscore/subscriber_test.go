@@ -1,0 +1,132 @@
+// subscriber_test.go: the decision cache's ordering contract against the
+// golden push stream (tests/golden/speculative_push.framestream, recorded
+// by scripts/gen_golden_transcripts.py).  subscriber.go claims that a
+// consumer applying Push frames in stream order can never serve a
+// decision from a rolled-back epoch; the fixture now carries the edges
+// that claim has to survive — full rollbacks with recomputes after,
+// scoped invalidate_uids (capacity nudges and foreign binds), and a
+// TERMINAL rollback with no recompute after it (the consumer must end
+// empty-handed, not serving the last pre-rollback decision).
+//
+// Runs wherever a Go toolchain exists (the sidecar image has none):
+//   cd go && go test ./tpubatchscore/
+package tpubatchscore
+
+import (
+	"testing"
+)
+
+func TestPushStreamEpochOrdering(t *testing.T) {
+	frames := readFixture(t, "speculative_push.framestream")
+	cache := newDecisionCache()
+	var lastEpoch uint64
+	// uid → whether the stream's LAST mention of it was a decision or an
+	// invalidation — computed independently of the cache, so the final
+	// comparison checks apply()'s ordering, not restates it.
+	lastMention := map[string]string{}
+	sawRollback, sawScoped, terminalRollback := false, false, false
+	pushes := 0
+	for i, f := range frames {
+		env := &Envelope{}
+		if err := env.Unmarshal(f[1]); err != nil {
+			t.Fatalf("frame %d: unmarshal: %v", i, err)
+		}
+		p := env.Push
+		if p == nil {
+			continue
+		}
+		pushes++
+		if p.Epoch < lastEpoch {
+			t.Fatalf("push epoch went backwards: %d after %d", p.Epoch, lastEpoch)
+		}
+		lastEpoch = p.Epoch
+		if p.InvalidateAll {
+			sawRollback = true
+			for uid := range lastMention {
+				lastMention[uid] = "invalidated"
+			}
+		}
+		if len(p.InvalidateUIDs) > 0 {
+			sawScoped = true
+		}
+		for _, uid := range p.InvalidateUIDs {
+			lastMention[uid] = "invalidated"
+		}
+		for _, d := range p.Decisions {
+			lastMention[d.PodUID] = "decision"
+		}
+		cache.apply(p)
+		terminalRollback = p.InvalidateAll && len(p.Decisions) == 0
+	}
+	if pushes == 0 {
+		t.Fatal("fixture carries no push frames")
+	}
+	if !sawRollback || !sawScoped {
+		t.Error("fixture no longer exercises full + scoped invalidations")
+	}
+	if !terminalRollback {
+		t.Error("fixture no longer ends on a terminal rollback (invalidate_all, no recompute)")
+	}
+	if cache.epoch != lastEpoch {
+		t.Errorf("cache epoch %d != stream epoch %d", cache.epoch, lastEpoch)
+	}
+	// The contract: the cache holds exactly the uids whose LAST mention
+	// was a decision — nothing from a rolled-back epoch survives, and no
+	// surviving decision is lost.
+	for uid, last := range lastMention {
+		d, ok := cache.pop(uid)
+		if last == "decision" && !ok {
+			t.Errorf("lost surviving decision for %s", uid)
+		}
+		if last == "invalidated" && ok {
+			t.Errorf("served rolled-back decision for %s on %q", uid, d.NodeName)
+		}
+	}
+	cache.mu.Lock()
+	leftover := len(cache.m)
+	cache.mu.Unlock()
+	if leftover != 0 {
+		t.Errorf("cache holds %d entries the stream never decided", leftover)
+	}
+}
+
+func TestDecisionCacheRollbackEdges(t *testing.T) {
+	c := newDecisionCache()
+	c.apply(&Push{Epoch: 1, Decisions: []Decision{{PodUID: "a", NodeName: "n1"}}})
+	// One frame carrying BOTH a rollback and recomputed decisions:
+	// invalidations apply FIRST, so the frame's own decisions survive.
+	c.apply(&Push{
+		Epoch:         2,
+		InvalidateAll: true,
+		Decisions:     []Decision{{PodUID: "b", NodeName: "n2"}},
+	})
+	if _, ok := c.pop("a"); ok {
+		t.Error("rolled-back decision a survived the invalidate_all")
+	}
+	d, ok := c.pop("b")
+	if !ok || d.NodeName != "n2" {
+		t.Error("same-frame recompute lost")
+	}
+	if _, ok := c.pop("b"); ok {
+		t.Error("pop must consume the entry")
+	}
+	// Scoped invalidation with a same-frame re-decide of one of its uids.
+	c.apply(&Push{Epoch: 3, Decisions: []Decision{
+		{PodUID: "x", NodeName: "n1"},
+		{PodUID: "y", NodeName: "n1"},
+	}})
+	c.apply(&Push{
+		Epoch:          4,
+		InvalidateUIDs: []string{"x", "y"},
+		Decisions:      []Decision{{PodUID: "x", NodeName: "n3"}},
+	})
+	if _, ok := c.pop("y"); ok {
+		t.Error("scoped-invalidated y survived")
+	}
+	if d, ok := c.pop("x"); !ok || d.NodeName != "n3" {
+		t.Error("re-decided x must serve the fresh placement")
+	}
+	if c.epoch != 4 {
+		t.Errorf("epoch not tracked: %d", c.epoch)
+	}
+}

@@ -207,6 +207,20 @@ def cmd_serve(args) -> int:
     from .sidecar import SidecarServer
 
     sched = _build_scheduler(args)
+    node_grace = getattr(args, "node_grace_s", 0.0)
+    if node_grace > 0:
+        # Arm the failure-response loop (ISSUE 9): heartbeat staleness →
+        # NotReady/Unreachable taints → tolerationSeconds eviction →
+        # requeue, plus the pod-GC horizon sweep.
+        sched.node_lifecycle.arm(
+            grace_period_s=node_grace,
+            unreachable_after_s=(
+                getattr(args, "node_unreachable_s", 0.0) or node_grace * 2.5
+            ),
+        )
+        sched.pod_gc.arm(
+            gc_horizon_s=getattr(args, "gc_horizon_s", 0.0) or node_grace * 6
+        )
     fleet_owner = None
     if args.shard_of:
         if not args.journal_dir:
@@ -538,6 +552,24 @@ def main(argv: list[str] | None = None) -> int:
         "--snapshot-every", type=int, default=64, metavar="BATCHES",
         help="checkpoint the store+queue and truncate the journal every "
         "N batches (0 disables periodic snapshots)",
+    )
+    s.add_argument(
+        "--node-grace-s", type=float, default=0.0, metavar="SECONDS",
+        help="arm the node-lifecycle controller: a Lease-tracked node "
+        "whose heartbeat is older than this (on the logical Lease clock) "
+        "is tainted NotReady, its pods evicted after tolerationSeconds "
+        "and requeued (0 = disarmed, the consumer-only behavior)",
+    )
+    s.add_argument(
+        "--node-unreachable-s", type=float, default=0.0, metavar="SECONDS",
+        help="staleness beyond which a NotReady node becomes Unreachable "
+        "(0 = 2.5 × --node-grace-s)",
+    )
+    s.add_argument(
+        "--gc-horizon-s", type=float, default=0.0, metavar="SECONDS",
+        help="pod-GC horizon: pods still bound to a node Unreachable this "
+        "long are evicted+requeued regardless of tolerations "
+        "(0 = 6 × --node-grace-s)",
     )
     s.add_argument(
         "--shard-of", default="", metavar="K/N",

@@ -38,8 +38,20 @@ JOURNAL_SELF_METHODS = {"_journal_append", "_journal_bind", "_journal_mutation"}
 # Apply markers: finish_binding / quarantine (the single-scheduler commit
 # paths) plus apply_handoff — the fleet's shard-transfer apply
 # (fleet/owner.py import_nodes): a handoff made live without its journal
-# record first is a transfer the next takeover cannot redo.
-APPLY_MARKERS = {"finish_binding", "quarantine", "apply_handoff"}
+# record first is a transfer the next takeover cannot redo.  ISSUE 9 adds
+# the failure-response loop's apply sites: _apply_node_taints (a
+# node-lifecycle taint write made live without its ``taint`` record
+# replays a dead node as healthy), _apply_eviction and _unwind_pod (an
+# eviction/deletion applied ahead of its record loses the pod — or
+# resurrects its binding — across a crash).
+APPLY_MARKERS = {
+    "finish_binding",
+    "quarantine",
+    "apply_handoff",
+    "_apply_node_taints",
+    "_apply_eviction",
+    "_unwind_pod",
+}
 
 
 def _is_journal_call(call: ast.Call) -> bool:
@@ -74,6 +86,10 @@ class WalRule(Rule):
             # and the owner/router transfer paths carry apply_handoff.
             "kubernetes_tpu/fleet/owner.py",
             "kubernetes_tpu/fleet/router.py",
+            # The failure-response controllers (node lifecycle / pod GC /
+            # taint eviction) drive the journaled taint-write and evict
+            # paths — any direct marker call here must journal first.
+            "kubernetes_tpu/controllers.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:
@@ -92,15 +108,13 @@ class WalRule(Rule):
                         applies.append((node.lineno, marker))
                 if not applies:
                     continue
-                # The marker's own definition is not a call site.
+                # Inside a marker's OWN definition, marker calls are the
+                # apply being implemented (its own name) or a delegated
+                # apply half (e.g. _apply_eviction → _unwind_pod) — the
+                # journal duty lives at the marker's call sites, which
+                # this rule checks separately.
                 if qualname.split(".")[-1] in APPLY_MARKERS and not journal_lines:
-                    applies = [
-                        (ln, m)
-                        for ln, m in applies
-                        if m != qualname.split(".")[-1]
-                    ]
-                    if not applies:
-                        continue
+                    continue
                 if not journal_lines:
                     for ln, marker in applies:
                         out.append(

@@ -165,6 +165,25 @@ KINDS: dict[str, tuple[type, str]] = {
     "PodDisruptionBudget": (t.PodDisruptionBudget, "add_pdb"),
     "ResourceClaim": (t.ResourceClaim, "add_resource_claim"),
     "ResourceSlice": (t.ResourceSlice, "add_resource_slice"),
+    # Node-heartbeat lease (coordination.k8s.io): renewals feed the
+    # node-lifecycle controller's staleness clock (controllers.py).
+    "Lease": (t.Lease, "renew_node_lease"),
+}
+
+# Kind name → scheduler remove-method for the kinds that support watch
+# DELETED events (the Reflector's full object surface and the sidecar's
+# remove frame).  Pod/Node keep their historical direct routes
+# (delete_pod / remove_node); the method takes the object's uid/name.
+REMOVERS: dict[str, str] = {
+    "Node": "remove_node",
+    "Pod": "delete_pod",
+    "PersistentVolume": "remove_pv",
+    "PersistentVolumeClaim": "remove_pvc",
+    "StorageClass": "remove_storage_class",
+    "CSINode": "remove_csinode",
+    "PodDisruptionBudget": "remove_pdb",
+    "ResourceClaim": "remove_resource_claim",
+    "ResourceSlice": "remove_resource_slice",
 }
 
 

@@ -509,6 +509,23 @@ class Node:
         return self.metadata.name
 
 
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease, reduced to the kubelet node-heartbeat
+    use (pkg/kubelet/nodelease): ``renew_time`` is the holder's last
+    renewal in the FEED's clock domain (seconds).  The node-lifecycle
+    controller (controllers.py) judges node liveness from Lease renewals —
+    nodes that never renew a lease are exempt, so embedders that only feed
+    Node objects keep the pre-lease behavior."""
+
+    node_name: str
+    renew_time: float = 0.0
+
+    @property
+    def name(self) -> str:  # the wire store keys non-Pod kinds by .name
+        return self.node_name
+
+
 # ---------------------------------------------------------------------------
 # Scalar (host-side) selector evaluation — the reference semantics that the
 # vectorized ops must reproduce; also used directly for rare host-side paths.

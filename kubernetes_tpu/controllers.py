@@ -1,6 +1,8 @@
 """Control loops living beside the scheduler — the kube-controller-manager
 slice the scheduling stack actually depends on (SURVEY §2.4 names the two
-that interact with scheduling: disruption and tainteviction).
+that interact with scheduling: disruption and tainteviction; ISSUE 9 adds
+the failure-response WRITER half: nodelifecycle + podgc, closing the
+node-dies → taint → grace → evict → requeue → reschedule loop).
 
 DisruptionController: recomputes each PodDisruptionBudget's
 status.disruptionsAllowed from live pod state, the way
@@ -29,7 +31,6 @@ reference applies in the eviction subresource handler."""
 from __future__ import annotations
 
 import math
-import time
 
 from .api import types as t
 
@@ -116,16 +117,30 @@ class TaintEvictionController:
     (TimedWorkerQueue) becomes a deadline map ticked from the scheduler's
     batch loop (the same time-gated sweep that expires assumed pods);
     eviction is the scheduler's delete_pod — the API DELETE the upstream
-    controller issues, minus the apiserver."""
+    controller issues, minus the apiserver — or, when the node-lifecycle
+    loop is armed (``requeue_evictions``), the scheduler's journaled
+    evict_pod: binding dropped, pod re-queued unbound, the workload-
+    controller-recreates-the-pod half of the production sequence this
+    repo has no controllers to provide."""
 
     def __init__(self, scheduler) -> None:
         self.sched = scheduler
         # pod uid → (armed_at, deadline).  armed_at is the time the FIRST
-        # judgment scheduled the eviction (upstream's
-        # scheduledEviction.CreatedAt); re-evaluations recompute the
-        # deadline from it with the CURRENT taint set, so unrelated taint
-        # churn neither extends nor wrongly keeps a removed taint's grace.
+        # still-present taint was judged; the deadline is min over the
+        # CURRENT taints of (that taint's first-seen time + its grace),
+        # so unrelated taint churn neither extends nor wrongly keeps a
+        # removed taint's grace — and a taint REMOVED AND RE-ADDED gets a
+        # fresh clock for its own grace (the per-taint refinement of
+        # upstream's single scheduledEviction.CreatedAt, which would
+        # inherit the stale timer — the re-arm gap ISSUE 9 names).
         self.pending: dict[str, tuple[float, float]] = {}
+        # pod uid → {(taint key, value, effect): first-seen ts} for pods
+        # with a pending eviction; read only while the uid is pending.
+        self._seen: dict[str, dict[tuple, float]] = {}
+        # Evict-as-requeue (armed with the node-lifecycle controller):
+        # the evicted pod re-enters the queue unbound and reschedules on
+        # a surviving node instead of vanishing.
+        self.requeue_evictions = False
         self.evictions = 0
 
     def _no_execute(self, node: t.Node) -> list[t.Taint]:
@@ -135,6 +150,13 @@ class TaintEvictionController:
             if taint.effect == t.EFFECT_NO_EXECUTE
         ]
 
+    def cancel(self, uid: str) -> None:
+        """Drop a pending eviction and its per-taint clock — the single
+        cancellation path (pod deleted, taints gone, GC of a stale
+        terminating entry)."""
+        self.pending.pop(uid, None)
+        self._seen.pop(uid, None)
+
     def handle_node(self, node: t.Node, now: float | None = None) -> None:
         """Re-evaluate every pod on the node after a taint change
         (handleNodeUpdate, taint_eviction.go:331)."""
@@ -142,14 +164,14 @@ class TaintEvictionController:
         if rec is None:
             return
         taints = self._no_execute(node)
-        now = time.monotonic() if now is None else now
+        now = self.sched._now() if now is None else now
         if not taints:
             # Taints gone: cancel pending evictions for this node's pods
             # (cancelWorkWithEvent).
             for uid in list(self.pending):
                 pr = self.sched.cache.pods.get(uid)
                 if pr is None or pr.node_name == node.name:
-                    self.pending.pop(uid, None)
+                    self.cancel(uid)
             return
         for uid, pod in list(rec.pods.items()):
             self.evaluate(uid, pod, taints, now)
@@ -163,12 +185,17 @@ class TaintEvictionController:
             return
         taints = self._no_execute(rec.node)
         if taints:
-            self.evaluate(pod.uid, pod, taints, time.monotonic())
+            self.evaluate(pod.uid, pod, taints, self.sched._now())
 
     def evaluate(
         self, uid: str, pod: t.Pod, taints: list[t.Taint], now: float
     ) -> None:
-        used: list[t.Toleration] = []
+        # Per-taint judgment: each present taint contributes (first-seen
+        # ts, grace) — grace = min over its MATCHING tolerations that set
+        # seconds (getMinTolerationTime per taint); a taint whose matching
+        # tolerations are all nil-seconds is tolerated forever and bounds
+        # nothing.
+        per_taint: list[tuple[tuple, float | None]] = []
         for taint in taints:
             matching = [
                 tol for tol in pod.spec.tolerations if tol.tolerates(taint)
@@ -176,42 +203,372 @@ class TaintEvictionController:
             if not matching:
                 # Not fully tolerated: evict now (processPodOnNode's
                 # len(usedTolerations) < len(taints) branch).
-                self.pending.pop(uid, None)
+                self.cancel(uid)
                 self._evict(uid)
                 return
-            used.extend(matching)
-        # getMinTolerationTime: min over the used tolerations that SET
-        # seconds; none set = tolerate forever.
-        secs = [
-            tol.toleration_seconds
-            for tol in used
-            if tol.toleration_seconds is not None
-        ]
-        if not secs:
-            self.pending.pop(uid, None)
+            secs = [
+                tol.toleration_seconds
+                for tol in matching
+                if tol.toleration_seconds is not None
+            ]
+            tid = (taint.key, taint.value, taint.effect)
+            per_taint.append((tid, min(secs) if secs else None))
+        if all(grace is None for _tid, grace in per_taint):
+            # Every taint tolerated forever: nothing schedules an eviction.
+            self.cancel(uid)
             return
-        # Deadline = armed_at + min(current graces): the clock starts at
-        # the FIRST judgment (processPodOnNode keeps
-        # scheduledEviction.CreatedAt across re-evaluations, so unrelated
-        # taint churn cannot push the eviction out), while the grace is
-        # recomputed against the CURRENT taint set (removing the
-        # short-grace taint while a longer-tolerated one remains restores
-        # the longer deadline).  A full taint removal cleared pending, so
-        # a later re-taint starts a fresh clock.
-        prev = self.pending.get(uid)
-        armed_at = prev[0] if prev is not None else now
-        self.pending[uid] = (armed_at, armed_at + max(0.0, min(secs)))
+        # Each taint's clock starts at ITS first judgment while pending —
+        # a re-evaluation keeps surviving taints' start times (unrelated
+        # churn cannot push the eviction out), a removed taint's entry is
+        # dropped (removing the short-grace taint while a longer-tolerated
+        # one remains restores the longer deadline), and a taint removed
+        # AND re-added re-enters with a fresh clock instead of inheriting
+        # the stale timer.  A full taint removal cancelled the pending
+        # entry, so a later re-taint of everything starts entirely fresh.
+        prev_seen = self._seen.get(uid, {}) if uid in self.pending else {}
+        seen: dict[tuple, float] = {}
+        deadlines: list[float] = []
+        for tid, grace in per_taint:
+            first = prev_seen.get(tid, now)
+            seen[tid] = first
+            if grace is not None:
+                deadlines.append(first + max(0.0, grace))
+        self._seen[uid] = seen
+        self.pending[uid] = (min(seen.values()), min(deadlines))
 
     def tick(self, now: float | None = None) -> int:
         """Fire due evictions; returns how many fired."""
-        now = time.monotonic() if now is None else now
+        now = self.sched._now() if now is None else now
         due = [uid for uid, (_, dl) in self.pending.items() if dl <= now]
         for uid in due:
-            self.pending.pop(uid, None)
+            self.cancel(uid)
             self._evict(uid)
         return len(due)
 
     def _evict(self, uid: str) -> None:
         if uid in self.sched.cache.pods:
             self.evictions += 1
-            self.sched.delete_pod(uid)
+            if self.requeue_evictions:
+                self.sched.evict_pod(uid, reason="taint-eviction")
+            else:
+                self.sched.delete_pod(uid)
+
+
+# ---------------------------------------------------------------------------
+# NodeLifecycleController — the taint WRITER half of the failure-response
+# loop (pkg/controller/nodelifecycle/node_lifecycle_controller.go)
+# ---------------------------------------------------------------------------
+
+# Upstream's condition taints (node_lifecycle_controller.go:64
+# UnreachableTaintTemplate / NotReadyTaintTemplate; the NoSchedule pair is
+# the condition-based taint loop, doNoScheduleTaintingPass).
+NOT_READY_TAINT_KEY = "node.kubernetes.io/not-ready"
+UNREACHABLE_TAINT_KEY = "node.kubernetes.io/unreachable"
+LIFECYCLE_TAINT_KEYS = frozenset(
+    {NOT_READY_TAINT_KEY, UNREACHABLE_TAINT_KEY}
+)
+
+NODE_READY = "ready"
+NODE_NOT_READY = "notready"
+NODE_UNREACHABLE = "unreachable"
+
+
+def lifecycle_taints(state: str) -> tuple[t.Taint, ...]:
+    """The taint pair a lifecycle state implies: the NoSchedule condition
+    taint plus the NoExecute eviction trigger (TaintBasedEvictions)."""
+    key = {
+        NODE_NOT_READY: NOT_READY_TAINT_KEY,
+        NODE_UNREACHABLE: UNREACHABLE_TAINT_KEY,
+    }.get(state)
+    if key is None:
+        return ()
+    return (
+        t.Taint(key, "", t.EFFECT_NO_SCHEDULE),
+        t.Taint(key, "", t.EFFECT_NO_EXECUTE),
+    )
+
+
+def state_from_taints(taints: tuple[t.Taint, ...]) -> str:
+    """Derive the lifecycle state a node's taints encode — the recovery
+    path's state source (journal replay re-applies the taints; the
+    controller must not re-write them or re-count the transition)."""
+    keys = {taint.key for taint in taints}
+    if UNREACHABLE_TAINT_KEY in keys:
+        return NODE_UNREACHABLE
+    if NOT_READY_TAINT_KEY in keys:
+        return NODE_NOT_READY
+    return NODE_READY
+
+
+class NodeLifecycleController:
+    """Track per-node heartbeat freshness from wire-fed Lease renewals and
+    write the NotReady/Unreachable taints through the scheduler's
+    JOURNALED update path (scheduler.write_node_taints — WAL discipline,
+    so a crash mid-transition replays deterministically).
+
+    Clock model: liveness is judged on a LOGICAL clock — the high-water
+    mark of every Lease ``renew_time`` the feed delivered — not wall
+    time.  A node is stale when OTHER nodes' renewals have advanced the
+    clock past its own last renewal + grace.  That makes the whole
+    failure-response sequence a pure function of the operation stream:
+    the soak's virtual and real pacing modes, and a crash-recovery
+    replay, all transition at the identical points (the determinism the
+    chaos harness's bit-identical-reschedule oracle needs).  Upstream
+    gets the same effect from the apiserver's single clock stamping every
+    Lease renewal.
+
+    Disarmed (the default) the controller only records renewals: nodes
+    are never tainted, so embedders that don't feed Leases keep the
+    pre-lifecycle behavior.  ``arm()`` enables transitions and flips the
+    TaintEvictionController to evict-as-requeue (the full production
+    sequence: staleness → taint → tolerationSeconds grace → eviction →
+    requeue → reschedule on a surviving node)."""
+
+    def __init__(
+        self,
+        scheduler,
+        grace_period_s: float = 40.0,
+        unreachable_after_s: float = 100.0,
+    ) -> None:
+        self.sched = scheduler
+        # Upstream defaults: node-monitor-grace-period 40s; the
+        # unreachable horizon has no single upstream knob (Ready=Unknown
+        # is immediate once the grace lapses) — ours staggers the two
+        # states so both transitions are observable.
+        self.grace_period_s = grace_period_s
+        self.unreachable_after_s = unreachable_after_s
+        self.armed = False
+        # node name → last Lease renew_time (the feed's clock domain).
+        self.heartbeats: dict[str, float] = {}
+        # node name → lifecycle state (absent == ready).
+        self.states: dict[str, str] = {}
+        self._hw = 0.0  # logical-clock high-water mark
+        self.transitions = 0
+
+    def arm(
+        self,
+        grace_period_s: float | None = None,
+        unreachable_after_s: float | None = None,
+    ) -> None:
+        if grace_period_s is not None:
+            self.grace_period_s = grace_period_s
+        if unreachable_after_s is not None:
+            self.unreachable_after_s = unreachable_after_s
+        if self.unreachable_after_s < self.grace_period_s:
+            self.unreachable_after_s = self.grace_period_s
+        self.armed = True
+        # Evictions feed the requeue path: the evicted pod reschedules
+        # elsewhere (this repo has no workload controllers to recreate it).
+        self.sched.taint_eviction.requeue_evictions = True
+
+    def now(self) -> float:
+        return self._hw
+
+    # -- feed --------------------------------------------------------------
+
+    def renew(self, name: str, ts: float) -> None:
+        """One Lease renewal (scheduler.renew_node_lease).  Renewals are
+        monotone per node (a stale replayed Lease cannot rewind the
+        clock); the fleet re-judges when the renewal ADVANCES the logical
+        clock — the tick is op-driven, not timer-driven.  A same-stamp
+        renewal (the rest of a heartbeat round) skips the fleet scan:
+        judging an identical clock again is O(N) of no-ops per node, an
+        O(N²) round at fleet scale — unless the renewing node itself was
+        non-ready (its fresh heartbeat is the recovery the tick must
+        write).  Deterministic either way: the skip is a pure function
+        of (ts, states)."""
+        if ts > self.heartbeats.get(name, -1.0):
+            self.heartbeats[name] = ts
+        advanced = ts > self._hw
+        if advanced:
+            self._hw = ts
+        if self.armed and (
+            advanced or self.states.get(name, NODE_READY) != NODE_READY
+        ):
+            self.tick()
+
+    def observe_node(self, node: t.Node) -> None:
+        """A Node add/update delivered its CURRENT taints: adopt the
+        lifecycle state they encode (recovery replay re-applies our taint
+        writes through this path; re-writing or re-counting the
+        transition would diverge the journal from the uninterrupted
+        run).  The GC's unreachable clock follows the adoption — a
+        recovered dead node must still age toward the GC horizon."""
+        state = state_from_taints(node.spec.taints)
+        if state == NODE_READY:
+            self.states.pop(node.name, None)
+        else:
+            self.states[node.name] = state
+        self.sched.pod_gc.note_state(node.name, state, self._hw)
+
+    def forget_node(self, name: str) -> None:
+        self.heartbeats.pop(name, None)
+        self.states.pop(name, None)
+
+    # -- transitions -------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> int:
+        """Judge every leased node against the logical clock and write
+        the implied taint transitions; then run the consumers that share
+        this clock (taint eviction deadlines, the pod-GC sweep).  Returns
+        the number of transitions applied."""
+        if not self.armed:
+            return 0
+        if now is not None and now > self._hw:
+            self._hw = now
+        now = self._hw
+        fired = 0
+        for name in sorted(self.heartbeats):
+            if name not in self.sched.cache.nodes:
+                continue
+            age = now - self.heartbeats[name]
+            if age <= self.grace_period_s:
+                target = NODE_READY
+            elif age <= self.unreachable_after_s:
+                target = NODE_NOT_READY
+            else:
+                target = NODE_UNREACHABLE
+            if self.states.get(name, NODE_READY) != target:
+                self._transition(name, target, now)
+                fired += 1
+        # The downstream consumers tick on the same clock, in causal
+        # order: taints just written arm deadlines (handle_node inside
+        # the update path), due deadlines evict, and the GC sweeps what
+        # eviction cannot reach (tolerate-forever pods on long-dead
+        # nodes, stale terminating entries).
+        self.sched.taint_eviction.tick(now)
+        self.sched.pod_gc.sweep(now)
+        return fired
+
+    def _transition(self, name: str, target: str, now: float) -> None:
+        rec = self.sched.cache.nodes.get(name)
+        if rec is None:
+            return
+        keep = tuple(
+            taint
+            for taint in rec.node.spec.taints
+            if taint.key not in LIFECYCLE_TAINT_KEYS
+        )
+        self.sched.write_node_taints(
+            name, keep + lifecycle_taints(target), reason=f"lifecycle:{target}"
+        )
+        if target == NODE_READY:
+            self.states.pop(name, None)
+        else:
+            self.states[name] = target
+        self.transitions += 1
+        self.sched._note_lifecycle_transition(target)
+        self.sched.pod_gc.note_state(name, target, now)
+        flight = getattr(self.sched, "flight", None)
+        if flight is not None:
+            flight.record_marker(
+                "node_lifecycle",
+                node=name,
+                to=target,
+                heartbeat=self.heartbeats.get(name, 0.0),
+                logical_now=now,
+            )
+            if target == NODE_UNREACHABLE:
+                # A node death is an incident: shed the evidence the way
+                # engine faults and breaker trips do.
+                flight.dump("node-unreachable")
+
+    def stats(self) -> dict:
+        counts = {NODE_READY: 0, NODE_NOT_READY: 0, NODE_UNREACHABLE: 0}
+        for name in self.heartbeats:
+            counts[self.states.get(name, NODE_READY)] += 1
+        return {
+            "armed": self.armed,
+            "grace_period_s": self.grace_period_s,
+            "unreachable_after_s": self.unreachable_after_s,
+            "logical_now": self._hw,
+            "tracked": len(self.heartbeats),
+            "transitions": self.transitions,
+            "states": counts,
+        }
+
+
+class PodGCController:
+    """The podgc slice (pkg/controller/podgc/gc_controller.go) this
+    scheduler actually needs — the sweeps that reclaim pods the taint
+    path cannot:
+
+    - **unreachable** (gcOrphaned's spirit): pods bound to a node that
+      has been Unreachable past ``gc_horizon_s`` — tolerate-forever pods
+      a NoExecute eviction never touches — are evicted through the
+      journaled requeue path (upstream force-deletes and lets the
+      workload controller recreate; with no controllers here, requeue IS
+      the recreate).
+    - **orphaned**: recovery bindings whose node never relisted
+      (informers.reconcile_after_recovery) requeue instead of silently
+      dropping — the journal said these pods existed; losing the node
+      must not lose the pods.
+    - **terminating** (gcUnscheduledTerminating's analog): pending
+      taint-eviction deadlines whose pod vanished with its node — stale
+      timers that would otherwise leak until they misfire against a
+      recreated uid."""
+
+    def __init__(self, scheduler, gc_horizon_s: float = 300.0) -> None:
+        self.sched = scheduler
+        self.gc_horizon_s = gc_horizon_s
+        self.armed = False
+        self.collected = {"unreachable": 0, "orphaned": 0, "terminating": 0}
+        # node name → logical ts of its transition to Unreachable.
+        self._unreachable_since: dict[str, float] = {}
+
+    def arm(self, gc_horizon_s: float | None = None) -> None:
+        if gc_horizon_s is not None:
+            self.gc_horizon_s = gc_horizon_s
+        self.armed = True
+
+    def note_state(self, name: str, state: str, now: float) -> None:
+        if state == NODE_UNREACHABLE:
+            self._unreachable_since.setdefault(name, now)
+        else:
+            self._unreachable_since.pop(name, None)
+
+    def forget_node(self, name: str) -> None:
+        self._unreachable_since.pop(name, None)
+
+    def _collect(self, reason: str) -> None:
+        self.collected[reason] += 1
+        self.sched._note_pod_gc(reason)
+
+    def collect_orphan(self, uid: str, pod: t.Pod) -> None:
+        """A recovered journal binding whose node never relisted: the
+        node is gone, the pod is not — journal the eviction and requeue
+        it unbound (reconcile_after_recovery's drop leg routes here)."""
+        self.sched.evict_pod(uid, reason="pod-gc-orphaned", pod=pod)
+        self._collect("orphaned")
+
+    def sweep(self, now: float) -> int:
+        """Run the GC legs; returns pods collected this sweep."""
+        if not self.armed:
+            return 0
+        n = 0
+        cache = self.sched.cache
+        for name in sorted(self._unreachable_since):
+            if now - self._unreachable_since[name] < self.gc_horizon_s:
+                continue
+            rec = cache.nodes.get(name)
+            if rec is None:
+                self._unreachable_since.pop(name, None)
+                continue
+            for uid in sorted(rec.pods):
+                self.sched.evict_pod(uid, reason="pod-gc-unreachable")
+                self._collect("unreachable")
+                n += 1
+        tec = self.sched.taint_eviction
+        for uid in list(tec.pending):
+            if uid not in cache.pods:
+                tec.cancel(uid)
+                self._collect("terminating")
+        return n
+
+    def stats(self) -> dict:
+        return {
+            "armed": self.armed,
+            "gc_horizon_s": self.gc_horizon_s,
+            "collected": dict(self.collected),
+            "unreachable_nodes": sorted(self._unreachable_since),
+        }

@@ -38,6 +38,31 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 
+# The full object surface the plugins consume (ISSUE 9): kind →
+# (uid function, scheduler upsert method, scheduler remove method).
+# Pod/Node keep their dedicated delivery branches in _deliver (recovery
+# overlays, diffing update routes); every other kind routes through this
+# table — the generated add_*/remove_* informer handler pairs.
+KIND_HANDLERS: dict[str, tuple[Callable[[object], str], str, str]] = {
+    "PersistentVolume": (lambda o: o.name, "add_pv", "remove_pv"),
+    "PersistentVolumeClaim": (lambda o: o.uid, "add_pvc", "remove_pvc"),
+    "StorageClass": (
+        lambda o: o.name, "add_storage_class", "remove_storage_class"
+    ),
+    "CSINode": (lambda o: o.name, "add_csinode", "remove_csinode"),
+    "PodDisruptionBudget": (lambda o: o.name, "add_pdb", "remove_pdb"),
+    "ResourceClaim": (
+        lambda o: o.uid, "add_resource_claim", "remove_resource_claim"
+    ),
+    "ResourceSlice": (
+        lambda o: f"{o.node_name}/{o.device_class}",
+        "add_resource_slice",
+        "remove_resource_slice",
+    ),
+}
+
+REFLECTED_KINDS = ("Node", "Pod") + tuple(KIND_HANDLERS)
+
 
 class StaleResourceVersion(Exception):
     """Raised by a watcher whose resume point has been compacted away —
@@ -47,12 +72,17 @@ class StaleResourceVersion(Exception):
 def _uid_of(kind: str, obj) -> str:
     if kind == "Node":
         return obj.name if isinstance(obj, t.Node) else str(obj)
-    return obj.uid  # pods carry namespace/name uids
+    if kind == "Pod":
+        return obj.uid  # pods carry namespace/name uids
+    if isinstance(obj, str):
+        return obj
+    return KIND_HANDLERS[kind][0](obj)
 
 
 class Reflector:
     """Keep a scheduler fed from a (lister, watcher) source for one KIND
-    ("Pod" or "Node").
+    — "Pod", "Node", or any entry of :data:`KIND_HANDLERS` (the full
+    object surface the plugins consume).
 
     ``lister() -> (resource_version, [objects])`` — the full state.
     ``watcher(resource_version) -> iterable of (rv, type, object)`` —
@@ -70,7 +100,7 @@ class Reflector:
         resync_s: float = 0.0,
         clock=time.monotonic,
     ) -> None:
-        assert kind in ("Pod", "Node"), kind
+        assert kind in REFLECTED_KINDS, kind
         self.sched = scheduler
         self.kind = kind
         self.lister = lister
@@ -97,17 +127,50 @@ class Reflector:
         # its recovered nomination, or the preemptor would lose its
         # claim on the freed node across the restart.
         self.recovered_nominations: dict[str, str] = {}
+        # And for node-lifecycle TAINTS (scheduler-authored node spec —
+        # upstream's node-lifecycle controller PATCHes them to the
+        # apiserver, so a relist carries them; here the journal's taint
+        # records are that authority): while set, a listed node is
+        # delivered with its recovered lifecycle taints merged in, or the
+        # LIST-replace would silently heal a dead node and cancel every
+        # pending eviction the replay just re-armed.
+        self.recovered_taints: dict[str, tuple] = {}
 
     # -- delivery into the scheduler's handler surface ----------------------
 
     def _deliver(self, ev: str, obj) -> None:
         s = self.sched
+        if self.kind in KIND_HANDLERS:
+            uid_fn, add_m, remove_m = KIND_HANDLERS[self.kind]
+            if ev == DELETED:
+                uid = obj if isinstance(obj, str) else uid_fn(obj)
+                getattr(s, remove_m)(uid)
+            else:
+                # The add_* handlers are upserts (informer re-delivery
+                # is routine) — MODIFIED routes through the same method.
+                getattr(s, add_m)(obj)
+            return
         if self.kind == "Node":
             if ev == DELETED:
                 name = obj if isinstance(obj, str) else _uid_of("Node", obj)
                 if name in s.cache.nodes:
                     s.remove_node(name)
-            elif ev == ADDED:
+                return
+            if self.recovered_taints:
+                recovered = self.recovered_taints.get(obj.name)
+                if recovered:
+                    from .controllers import LIFECYCLE_TAINT_KEYS
+
+                    listed = tuple(
+                        taint
+                        for taint in obj.spec.taints
+                        if taint.key not in LIFECYCLE_TAINT_KEYS
+                    )
+                    import copy
+
+                    obj = copy.deepcopy(obj)
+                    obj.spec.taints = listed + tuple(recovered)
+            if ev == ADDED:
                 s.add_node(obj)
             else:
                 s.update_node(obj)
@@ -146,10 +209,30 @@ class Reflector:
         objects an embedder seeded directly before attaching the
         Reflector (client-go's Replace diffs against the shared informer
         cache, which is the same store the handlers fed)."""
+        s = self.sched
         if self.kind == "Node":
-            return set(self.sched.cache.nodes)
-        # Bound/assumed pods live in the cache; pending pods in the queue.
-        return set(self.sched.cache.pods) | set(self.sched.queue._info)
+            return set(s.cache.nodes)
+        if self.kind == "Pod":
+            # Bound/assumed pods live in the cache; pending in the queue.
+            return set(s.cache.pods) | set(s.queue._info)
+        vols = s.builder.volumes
+        if self.kind == "PersistentVolume":
+            return set(vols.pvs)
+        if self.kind == "PersistentVolumeClaim":
+            return set(vols.pvcs)
+        if self.kind == "StorageClass":
+            return set(vols.classes)
+        if self.kind == "CSINode":
+            return set(vols.csinodes)
+        if self.kind == "PodDisruptionBudget":
+            return set(s.pdbs)
+        if self.kind == "ResourceClaim":
+            return set(s.builder.dra.claims)
+        if self.kind == "ResourceSlice":
+            return {
+                f"{n}/{c}" for (n, c) in s.builder.dra.slices
+            }
+        raise AssertionError(self.kind)
 
     def run_once(self) -> int:
         """LIST: replace the scheduler's view of this kind.  New objects
@@ -213,40 +296,72 @@ class Reflector:
         return len(self.store)
 
 
-def reconcile_after_recovery(scheduler, node_reflector, pod_reflector) -> dict:
+def reconcile_after_recovery(
+    scheduler, node_reflector, pod_reflector, object_reflectors=()
+) -> dict:
     """Cold-start recovery ordering (journal.py docstring step 3): after
     journal.recover() rebuilt the scheduler from snapshot + fenced
     replay, reconcile against a fresh LIST.
 
     1. Nodes relist first (bindings need rows to land on) — LIST-as-
        replace, so nodes gone from host truth vanish with their pods.
-    2. Journal bind records whose node was unknown at replay time
+    2. The OBJECT catalogs relist (``object_reflectors``: any
+       KIND_HANDLERS kinds — PV/PVC/StorageClass/CSINode/PDB/
+       ResourceClaim/ResourceSlice) before pods, because pod
+       featurization and the plugins read them.
+    3. Journal bind records whose node was unknown at replay time
        (scheduler._recovered_bindings) re-apply now that the LIST may
        have delivered the node; bindings whose node never relists are
-       dropped — the node is truly gone, the pods reschedule.
-    3. Pods relist under the recovered-bindings overlay: a listed pod
+       GC'd — the node is truly gone, so an ARMED pod-GC requeues the
+       pods (journaled ``evict``) to reschedule on surviving nodes,
+       and a disarmed one drops them (the pre-GC behavior).
+    4. Pods relist under the recovered-bindings overlay: a listed pod
        the journal holds bound but the relist shows unbound keeps the
        journal's binding (re-applied), a listed pod bound elsewhere wins
        as host truth (update_pod relocates), and pods absent from the
        relist are deleted (DeltaFIFO Replace).
     """
-    stats = {"nodes": node_reflector.run_once()}
+    from .controllers import LIFECYCLE_TAINT_KEYS
+
+    node_reflector.recovered_taints = {
+        name: tuple(
+            taint
+            for taint in rec.node.spec.taints
+            if taint.key in LIFECYCLE_TAINT_KEYS
+        )
+        for name, rec in scheduler.cache.nodes.items()
+        if any(
+            taint.key in LIFECYCLE_TAINT_KEYS
+            for taint in rec.node.spec.taints
+        )
+    }
+    try:
+        stats = {"nodes": node_reflector.run_once()}
+    finally:
+        node_reflector.recovered_taints = {}
+    for refl in object_reflectors:
+        stats[f"objects:{refl.kind}"] = refl.run_once()
     pending = getattr(scheduler, "_recovered_bindings", None) or {}
-    applied = dropped = 0
+    applied = dropped = requeued = 0
     if pending:
         from .api import serialize
 
+        pod_gc = getattr(scheduler, "pod_gc", None)
         for uid, d in list(pending.items()):
+            pod = serialize.pod_from_data(d["pod"])
             if d["node"] in scheduler.cache.nodes:
-                pod = serialize.pod_from_data(d["pod"])
                 pod.spec.node_name = d["node"]
                 scheduler.add_pod(pod)
                 applied += 1
+            elif pod_gc is not None and pod_gc.armed:
+                pod_gc.collect_orphan(uid, pod)
+                requeued += 1
             else:
                 dropped += 1
             pending.pop(uid, None)
     stats["late_bindings_applied"] = applied
     stats["late_bindings_dropped"] = dropped
+    stats["late_bindings_requeued"] = requeued
     pod_reflector.recovered_bindings = {
         uid: pr.node_name
         for uid, pr in scheduler.cache.pods.items()
@@ -261,6 +376,41 @@ def reconcile_after_recovery(scheduler, node_reflector, pod_reflector) -> dict:
         pod_reflector.recovered_bindings = {}
         pod_reflector.recovered_nominations = {}
     return stats
+
+
+class ReflectorSet:
+    """One Reflector per kind over a shared-or-per-kind source surface —
+    the SharedInformerFactory analog.  ``sources`` maps kind →
+    (lister, watcher); step order is deterministic: Node first (rows
+    before bindings), then the object catalogs, Pod last (featurization
+    reads the catalogs)."""
+
+    # Node first (rows before bindings), catalogs next, Pod LAST —
+    # featurization and the volume/DRA plugins read the catalogs, so a
+    # cold-start pod list must never be judged against empty ones.
+    _ORDER = {
+        k: i
+        for i, k in enumerate(("Node",) + tuple(KIND_HANDLERS) + ("Pod",))
+    }
+
+    def __init__(self, scheduler, sources: dict, resync_s: float = 0.0):
+        self.reflectors: dict[str, Reflector] = {}
+        for kind in sorted(
+            sources, key=lambda k: (self._ORDER.get(k, 99), k)
+        ):
+            lister, watcher = sources[kind]
+            self.reflectors[kind] = Reflector(
+                scheduler, kind, lister, watcher, resync_s=resync_s
+            )
+
+    def step(self) -> int:
+        return sum(r.step() for r in self.reflectors.values())
+
+    def run_once(self) -> int:
+        return sum(r.run_once() for r in self.reflectors.values())
+
+    def __getitem__(self, kind: str) -> Reflector:
+        return self.reflectors[kind]
 
 
 class FakeSource:

@@ -436,6 +436,25 @@ def scheduler_state(sched) -> dict:
             if front is not None
             else getattr(sched, "_recovered_spec_epoch", 0)
         ),
+        # Failure-response loop (ISSUE 9): the lifecycle LOGICAL clock +
+        # per-node heartbeats (the feed's clock keeps running across a
+        # restart — recovering at zero would make every restored grace
+        # fire instantly on the first renewal) and the incident counters
+        # (a snapshot truncates the evict records that would otherwise
+        # restore them — a recovered process must not report a clean
+        # bill for an outage it just replayed).  evicted_uids capped:
+        # loop-closure accounting is about recent incidents, not an
+        # unbounded ledger.
+        "node_lifecycle": {
+            "heartbeats": dict(sched.node_lifecycle.heartbeats),
+            "hw": sched.node_lifecycle._hw,
+            "transitions": sched.node_lifecycle.transitions,
+        },
+        "failure_response": {
+            "taint_evictions": sched.taint_eviction.evictions,
+            "pod_gc_collected": dict(sched.pod_gc.collected),
+            "evicted_uids": sorted(sched._evicted_uids)[:4096],
+        },
     }
 
 
@@ -469,6 +488,20 @@ def recover(sched, journal: Journal) -> dict:
                         serialize.KINDS["PodDisruptionBudget"][0], p
                     )
                 )
+            # The lifecycle LOGICAL clock restores BEFORE the bound-pod
+            # re-adds below: handle_pod_assigned arms eviction deadlines
+            # at _now(), and arming them against a rewound zero would
+            # fire every restored grace on the feed's first continuing
+            # renewal (the instant-eviction bug, one ordering level in).
+            nl = st.get("node_lifecycle")
+            if nl:
+                for nname, ts in nl.get("heartbeats", {}).items():
+                    if ts > sched.node_lifecycle.heartbeats.get(nname, -1.0):
+                        sched.node_lifecycle.heartbeats[nname] = ts
+                sched.node_lifecycle._hw = max(
+                    sched.node_lifecycle._hw, nl.get("hw", 0.0)
+                )
+                sched.node_lifecycle.transitions = nl.get("transitions", 0)
             for entry in st.get("pods", ()):
                 pod = serialize.pod_from_data(entry["pod"])
                 pod.spec.node_name = entry["node"]
@@ -479,6 +512,17 @@ def recover(sched, journal: Journal) -> dict:
             # overwrite with the snapshot's authoritative counts).
             sched.gang_bound = dict(st.get("gang_bound", {}))
             sched._recovered_spec_epoch = st.get("spec_epoch", 0)
+            fr = st.get("failure_response")
+            if fr:
+                sched.taint_eviction.evictions = fr.get("taint_evictions", 0)
+                sched.pod_gc.collected.update(
+                    {
+                        k: v
+                        for k, v in fr.get("pod_gc_collected", {}).items()
+                        if k in sched.pod_gc.collected
+                    }
+                )
+                sched._evicted_uids.update(fr.get("evicted_uids", ()))
             sched.queue.restore_state(st.get("queue", {}))
             for uid, info in st.get("nominated", {}).items():
                 qp = sched.queue._info.get(uid)
@@ -512,6 +556,61 @@ def recover(sched, journal: Journal) -> dict:
             elif rtype == "delete":
                 pending.pop(d["uid"], None)
                 sched.delete_pod(d["uid"])
+            elif rtype == "taint":
+                # Node-lifecycle taint write (ISSUE 9): re-apply the
+                # journaled taint set through the same apply path — the
+                # NODE_TAINT event re-arms eviction deadlines and the
+                # lifecycle controller adopts the state the taints
+                # encode.  The record's ts advances the logical clock
+                # FIRST, so the re-armed deadlines start from the
+                # incident's time, not a rewound zero.  A node the
+                # snapshot doesn't hold is gone; its taints died with
+                # it.
+                sched.node_lifecycle._hw = max(
+                    sched.node_lifecycle._hw, d.get("ts", 0.0)
+                )
+                from .api import types as api_types
+
+                taints = tuple(
+                    serialize.build(api_types.Taint, nd)
+                    for nd in d["taints"]
+                )
+                # Each taint record IS a lifecycle transition: restore
+                # the incident counter (the apply path only ADOPTS state
+                # — recounting there would double on live writes).
+                from .controllers import state_from_taints
+
+                sched.node_lifecycle.transitions += 1
+                sched._note_lifecycle_transition(state_from_taints(taints))
+                if d["node"] in sched.cache.nodes:
+                    sched._apply_node_taints(d["node"], taints)
+            elif rtype == "evict":
+                # Taint-eviction / pod-GC requeue: the binding unwinds
+                # and the pod re-enters the queue unbound — replay keeps
+                # the crash-interrupted eviction's requeue instead of
+                # losing the pod.
+                pending.pop(d["uid"], None)
+                reason = d.get("reason", "")
+                sched.node_lifecycle._hw = max(
+                    sched.node_lifecycle._hw, d.get("ts", 0.0)
+                )
+                sched._apply_eviction(
+                    d["uid"], serialize.pod_from_data(d["pod"]), reason=reason
+                )
+                # Restore the incident counters the decision sites would
+                # have bumped (the record's reason says whose eviction
+                # this was) — the scheduler_taint_evictions_total /
+                # scheduler_pod_gc_total families must carry an
+                # incident's counts ACROSS the crash, or a recovered
+                # process reports a clean bill for an outage it just
+                # replayed.
+                if reason == "taint-eviction":
+                    sched.taint_eviction.evictions += 1
+                elif reason.startswith("pod-gc-"):
+                    key = reason[len("pod-gc-"):]
+                    if key in sched.pod_gc.collected:
+                        sched.pod_gc.collected[key] += 1
+                        sched._note_pod_gc(key)
             elif rtype == "preempt":
                 # Victims arrive via their own delete records; what the
                 # preempt record restores is the NOMINATION — the claim

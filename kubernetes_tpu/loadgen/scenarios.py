@@ -104,6 +104,53 @@ def node_flap_events(
     return out
 
 
+def node_death_events(
+    period_s: float,
+    down_s: float,
+    duration_s: float,
+    *,
+    churn_nodes: int,
+) -> list[Event]:
+    """Periodic node DEATHS over the churn pool — unlike ``flap_down``
+    (an informer delete: the node object vanishes), a death leaves the
+    Node object in place and silences its heartbeat: the node-lifecycle
+    controller must DETECT the staleness, write the NotReady/Unreachable
+    taints, and the eviction/requeue machinery must move its pods to
+    survivors.  ``node_revive`` resumes the heartbeat (taints clear).
+    Round-robin over the pool so at most one churn node is dead at a
+    time (the logical Lease clock keeps advancing on the others)."""
+    if period_s <= 0 or churn_nodes <= 0:
+        return []
+    out = []
+    k = 0
+    t = period_s
+    while t < duration_s:
+        node = k % churn_nodes
+        out.append(Event(t=t, kind="node_death", data=node))
+        if t + down_s < duration_s:
+            out.append(Event(t=t + down_s, kind="node_revive", data=node))
+        k += 1
+        t += period_s
+    return out
+
+
+def lease_tick_events(interval_s: float, duration_s: float) -> list[Event]:
+    """The heartbeat schedule: every ``interval_s`` the driver renews the
+    Leases of every currently-alive lease-tracked node, stamping the
+    SCENARIO clock — node liveness becomes a pure function of the event
+    stream (deterministic in both pacing modes)."""
+    if interval_s <= 0:
+        return []
+    out = []
+    k = 0
+    t = interval_s
+    while t < duration_s:
+        out.append(Event(t=t, kind="lease_tick", data=k))
+        k += 1
+        t += interval_s
+    return out
+
+
 def cold_consumer_events(period_s: float, duration_s: float) -> list[Event]:
     """Periodic push-consumer restarts: the driver drops its decision
     map mid-stream and subscribes a fresh (cold) connection — the
@@ -133,6 +180,9 @@ def build_events(
     node_flap_period_s: float = 0.0,
     flap_down_s: float = 1.0,
     cold_consumer_period_s: float = 0.0,
+    node_death_period_s: float = 0.0,
+    node_death_down_s: float = 8.0,
+    lease_interval_s: float = 0.0,
 ) -> list[Event]:
     """One phase's full scenario script, merged and time-ordered.
     Ties break by (kind, data) so the order is total and seed-stable."""
@@ -146,5 +196,10 @@ def build_events(
             churn_nodes=churn_nodes,
         )
         + cold_consumer_events(cold_consumer_period_s, duration_s)
+        + node_death_events(
+            node_death_period_s, node_death_down_s, duration_s,
+            churn_nodes=churn_nodes,
+        )
+        + lease_tick_events(lease_interval_s, duration_s)
     )
     return sorted(events, key=lambda e: (e.t, e.kind, e.data))

@@ -86,6 +86,18 @@ class SoakConfig:
     node_flap_period_s: float = 30.0
     flap_down_s: float = 2.0
     cold_consumer_period_s: float = 0.0
+    # Node-DEATH scenario (ISSUE 9): a churn node stops heartbeating
+    # (the object stays), the server's node-lifecycle controller writes
+    # the NotReady/Unreachable taints, its pods evict + requeue +
+    # reschedule on survivors, and a revive clears the taints.  Armed by
+    # node_grace_s > 0; Leases renew every lease_interval_s stamping the
+    # SCENARIO clock (liveness is a pure function of the op stream).
+    node_death_period_s: float = 0.0
+    node_death_down_s: float = 8.0
+    lease_interval_s: float = 1.0
+    node_grace_s: float = 0.0  # 0 = lifecycle disarmed (pre-ISSUE-9 soak)
+    node_unreachable_s: float = 0.0  # 0 = grace × 2.5
+    gc_horizon_s: float = 0.0  # 0 = grace × 6
     # The unbounded-stream bound: completed (bound) pods beyond this cap
     # retire oldest-first, so capacity recycles and the journal sees a
     # perpetual bind+delete append stream.
@@ -243,6 +255,14 @@ class _Driver:
         self._label_epoch: dict[int, int] = {}
         self._ns_epoch = 0
         self.mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+        # Node-death bookkeeping: churn nodes currently silenced, the
+        # cumulative scenario-clock offset (Lease stamps must stay
+        # monotone across phases), and event counts.
+        self.dead: set[str] = set()
+        self.time_base = 0.0
+        self.node_deaths = 0
+        self.node_revives = 0
+        self.lease_renewals = 0
         self.pods_by_uid: dict[str, object] = {}
         # Bound uids, oldest first.  A deque: the retirement window
         # front-pops once per decision at steady state, and an O(n)
@@ -282,6 +302,48 @@ class _Driver:
             n = self._churn_node(i)
             self.node_objs[n.metadata.name] = n
             self.client.add("Node", n)
+        if self.cfg.node_grace_s > 0:
+            from ..api import types as t
+            from ..controllers import (
+                NODE_NOT_READY,
+                NODE_UNREACHABLE,
+                lifecycle_taints,
+            )
+
+            # Pre-seed the lifecycle taint keys into the featurization
+            # vocab BEFORE warmup compiles the device programs: the
+            # first mid-soak transition would otherwise grow the taint
+            # schema and pay a full XLA recompile inside the measured
+            # window (the same trap the fleet soak's label-epoch
+            # pre-seeding closes).
+            import dataclasses
+
+            probe = self.node_objs["churn-0"]
+            tainted = dataclasses.replace(
+                probe,
+                spec=dataclasses.replace(
+                    probe.spec,
+                    taints=lifecycle_taints(NODE_NOT_READY)
+                    + lifecycle_taints(NODE_UNREACHABLE),
+                ),
+            )
+            self.client.add("Node", tainted)
+            self.client.add("Node", probe)
+            # Only churn nodes carry Leases: the lifecycle controller
+            # governs exactly the pool the death scenario targets, and
+            # the serving fleet stays exempt (unleased nodes are never
+            # tainted).
+            for i in range(self.cfg.churn_nodes):
+                self.client.add("Lease", t.Lease(f"churn-{i}", 0.0))
+
+    def _renew_alive_leases(self, ts: float) -> None:
+        from ..api import types as t
+
+        for i in range(self.cfg.churn_nodes):
+            name = f"churn-{i}"
+            if name not in self.dead and name in self.node_objs:
+                self.client.add("Lease", t.Lease(name, ts))
+                self.lease_renewals += 1
 
     def warmup(self) -> None:
         """Compile the device programs and the speculative machinery out
@@ -361,6 +423,25 @@ class _Driver:
             self.consumer.close()
             self.consumer = PushConsumer(self.client.path)
             self.cold_consumers += 1
+        elif ev.kind == "node_death":
+            # The node object STAYS; its heartbeat goes silent.  The
+            # server's lifecycle controller must detect the staleness,
+            # taint, evict, and reschedule its pods — nothing else in
+            # the op stream touches the dead node.
+            self.dead.add(f"churn-{ev.data % max(1, self.cfg.churn_nodes)}")
+            self.node_deaths += 1
+        elif ev.kind == "node_revive":
+            from ..api import types as t
+
+            name = f"churn-{ev.data % max(1, self.cfg.churn_nodes)}"
+            self.dead.discard(name)
+            # A fresh renewal at the current scenario clock clears the
+            # lifecycle taints (the node rejoined).
+            self.client.add("Lease", t.Lease(name, self.time_base + ev.t))
+            self.lease_renewals += 1
+            self.node_revives += 1
+        elif ev.kind == "lease_tick":
+            self._renew_alive_leases(self.time_base + ev.t)
         else:
             raise ValueError(f"unknown scenario event {ev.kind!r}")
 
@@ -465,6 +546,7 @@ def _run_phase(
     else:
         offsets = poisson_offsets(cfg.rate_pods_per_s, duration_s, seed)
     pods = [driver.mix.pod(arrival_base + i) for i in range(len(offsets))]
+    armed = cfg.node_grace_s > 0
     scenario = build_events(
         duration_s,
         seed + 500_009,
@@ -475,6 +557,9 @@ def _run_phase(
         node_flap_period_s=cfg.node_flap_period_s,
         flap_down_s=cfg.flap_down_s,
         cold_consumer_period_s=cfg.cold_consumer_period_s,
+        node_death_period_s=cfg.node_death_period_s if armed else 0.0,
+        node_death_down_s=cfg.node_death_down_s,
+        lease_interval_s=cfg.lease_interval_s if armed else 0.0,
     )
     # Merge: (t, class, idx) — hints flush at their window start ahead
     # of same-instant decisions; scenario events order between them by
@@ -510,6 +595,9 @@ def _run_phase(
             deadline = t0 + t_ev if cfg.pace == "real" else None
             driver.decide(pods[payload], res, deadline)
     driver.sample_wal()
+    # Lease stamps must stay monotone across phases: advance the
+    # scenario-clock base by this phase's span.
+    driver.time_base += duration_s
     res.wall_s = round(time.perf_counter() - t0, 3)
     return res, offsets
 
@@ -565,6 +653,13 @@ def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
     ]
+    if cfg.node_grace_s > 0:
+        argv += [
+            "--node-grace-s", str(cfg.node_grace_s),
+            "--node-unreachable-s",
+            str(cfg.node_unreachable_s or cfg.node_grace_s * 2.5),
+            "--gc-horizon-s", str(cfg.gc_horizon_s or cfg.node_grace_s * 6),
+        ]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["TPU_FLIGHT_DIR"] = out_dir
@@ -631,6 +726,16 @@ def run_soak(cfg: SoakConfig) -> dict:
             journal=journal,
             snapshot_every_batches=cfg.snapshot_every,
         )
+        if cfg.node_grace_s > 0:
+            srv.scheduler.node_lifecycle.arm(
+                grace_period_s=cfg.node_grace_s,
+                unreachable_after_s=(
+                    cfg.node_unreachable_s or cfg.node_grace_s * 2.5
+                ),
+            )
+            srv.scheduler.pod_gc.arm(
+                gc_horizon_s=cfg.gc_horizon_s or cfg.node_grace_s * 6
+            )
         srv.serve_background()
 
     driver = None
@@ -651,6 +756,14 @@ def run_soak(cfg: SoakConfig) -> dict:
             phases.append(res)
             arrival_hashes.append(_sha([round(o, 9) for o in offsets]))
             all_offsets.append(offsets)
+        if cfg.node_grace_s > 0:
+            # Run to quiescence before measuring loop closure: requeued
+            # eviction victims still in flight — or rolled back by the
+            # final phase's invalidation churn — get their final
+            # placements, so `reschedules` counts completed loops, not
+            # the instant's pool state.  (Deterministic: the drain is
+            # part of the op sequence in both same-seed runs.)
+            driver.client.schedule([], drain=True)
         dump = driver.client.dump()
         bindings = {
             uid: rec["node"]
@@ -703,6 +816,41 @@ def run_soak(cfg: SoakConfig) -> dict:
     )
     wal_max = max(driver.wal_samples) if driver.wal_samples else 0
     journal_stats = dump.get("journal") or {}
+    node_loss = None
+    if cfg.node_grace_s > 0:
+        # Evictions counted by the server (taint eviction + GC); a
+        # RESCHEDULE is a live pod whose final binding differs from the
+        # node the driver first saw it bound to.
+        lifecycle = dump.get("node_lifecycle") or {}
+        gc_stats = dump.get("pod_gc") or {}
+        moved = sum(
+            1
+            for uid, node in bindings.items()
+            if uid in driver.pods_by_uid
+            and getattr(driver.pods_by_uid[uid], "_lg_node", node) != node
+        )
+        gc_collected = sum(
+            (gc_stats.get("collected") or {}).values()
+        )
+        ev = dump.get("evictions") or {}
+        node_loss = {
+            "node_deaths": driver.node_deaths,
+            "node_revives": driver.node_revives,
+            "lease_renewals": driver.lease_renewals,
+            "lifecycle": lifecycle,
+            "pod_gc": gc_stats,
+            "evictions": ev.get("total", 0),
+            "gc_collected": gc_collected,
+            # Loop closure per pod (server-counted): distinct evicted
+            # uids, and how many of them are bound AGAIN at the end —
+            # eviction → requeue → reschedule completed.
+            "evicted_uids": ev.get("evicted_uids", 0),
+            "reschedules": ev.get("rebound", 0),
+            # Broader churn: live pods whose final placement differs
+            # from the first-delivered decision (includes speculative
+            # full-rollback re-placements, not just evictions).
+            "placements_moved": moved,
+        }
     artifact = {
         "metric": "soak_slo_knee_journal",
         "seed": cfg.seed,
@@ -759,6 +907,7 @@ def run_soak(cfg: SoakConfig) -> dict:
             for p in phases
         ],
         "workload_mix": dict(driver.mix.counts),
+        "node_loss": node_loss,
         "cold_consumers": driver.cold_consumers,
         "retired_total": driver.retired,
         "bound_final": len(bindings),

@@ -252,10 +252,36 @@ class TPUScheduler:
         # PodDisruptionBudgets (preemption criterion 1, the disruption
         # controller's state in-process).
         self.pdbs: dict[str, t.PodDisruptionBudget] = {}
-        from .controllers import DisruptionController, TaintEvictionController
+        from .controllers import (
+            DisruptionController,
+            NodeLifecycleController,
+            PodGCController,
+            TaintEvictionController,
+        )
 
+        # Controller clock override (tests / deterministic harnesses):
+        # None = the default domain (wall monotonic, or the node-lifecycle
+        # controller's logical clock once armed) — see _now().
+        self.clock = None
         self.disruption_controller = DisruptionController(self)
         self.taint_eviction = TaintEvictionController(self)
+        # The failure-response WRITER half (ISSUE 9): heartbeat-staleness
+        # taint writer + pod GC.  Disarmed by default — nodes that never
+        # renew a Lease are exempt, so embedders keep the consumer-only
+        # behavior until they arm the loop (serve --node-grace-s).
+        self.node_lifecycle = NodeLifecycleController(self)
+        self.pod_gc = PodGCController(self)
+        # Called with the node name after a journaled taint write applies
+        # (the speculative frontend registers an invalidation here —
+        # taints flip feasibility globally, exactly like a wire-fed taint
+        # change through its note_add path).
+        self.taints_changed_hook = None
+        # Uids ever evicted through the requeue path (taint eviction /
+        # pod GC) — the dump's loop-closure evidence: an evicted uid
+        # bound again means eviction → requeue → reschedule completed
+        # for that pod.  Membership-only (no iteration-order dependence);
+        # journal replay repopulates it, so the count survives a crash.
+        self._evicted_uids: set[str] = set()
         # Nominator (backend/queue/nominator.go): preemptors' claims on
         # their freed nodes — uid → (node name, row delta, priority).  The
         # fit filter counts these on their nodes so a same/next-batch pod
@@ -450,6 +476,26 @@ class TPUScheduler:
             "scheduler_quarantined_pods_total",
             "Pods isolated into the quarantine pool after engine faults.",
         )
+        # Failure-response loop (controllers.py): lifecycle transitions
+        # are counted at the write site; the per-state gauge, the GC
+        # reasons and the eviction total are scraped below.
+        self._lifecycle_transitions = reg.counter(
+            "scheduler_node_lifecycle_transitions_total",
+            "Node lifecycle state transitions written as taints, by "
+            "target state.",
+        )
+        self._pod_gc_counter = reg.counter(
+            "scheduler_pod_gc_total",
+            "Pods collected by the GC sweeps, by reason.",
+        )
+        lifecycle_state = reg.gauge(
+            "scheduler_node_lifecycle_state",
+            "Lease-tracked nodes by lifecycle state.",
+        )
+        taint_evictions = reg.counter(
+            "scheduler_taint_evictions_total",
+            "Pods evicted by the NoExecute taint-eviction controller.",
+        )
         pending = reg.gauge(
             "scheduler_pending_pods", "Pending pods by queue class."
         )
@@ -489,6 +535,9 @@ class TPUScheduler:
             deferred.set(m.deferred)
             for q, depth in self.queue.depths().items():
                 pending.set(depth, queue=q)
+            for state, count in self.node_lifecycle.stats()["states"].items():
+                lifecycle_state.set(count, state=state)
+            taint_evictions.set(self.taint_eviction.evictions)
             cache_g.set(len(self.cache.nodes), kind="nodes")
             cache_g.set(len(self.cache.pods), kind="pods")
             cache_g.set(
@@ -738,6 +787,136 @@ class TPUScheduler:
             kfull,
         )
 
+    # -- controller clock / the failure-response loop (ISSUE 9) --------------
+
+    def _note_lifecycle_transition(self, target: str) -> None:
+        self._lifecycle_transitions.inc(to=target)
+
+    def _note_pod_gc(self, reason: str) -> None:
+        self._pod_gc_counter.inc(reason=reason)
+
+    def _now(self) -> float:
+        """The controllers' shared clock: an explicit override wins
+        (tests); an ARMED node-lifecycle controller supplies its logical
+        clock (the Lease high-water mark — liveness, taint grace, GC
+        horizons and eviction deadlines all become a pure function of the
+        fed operation stream, which is what makes the chaos harness's
+        bit-identical-reschedule oracle and the soak's same-seed
+        determinism hold); otherwise wall monotonic (the pre-lifecycle
+        behavior every existing caller sees)."""
+        if self.clock is not None:
+            return self.clock()
+        if self.node_lifecycle.armed:
+            return self.node_lifecycle.now()
+        return time.monotonic()
+
+    def renew_node_lease(self, lease: t.Lease) -> None:
+        """Lease informer (coordination.k8s.io): one node-heartbeat
+        renewal.  Feeds the node-lifecycle controller's staleness clock;
+        armed, a renewal also drives the transition/eviction/GC tick."""
+        self.node_lifecycle.renew(lease.node_name, lease.renew_time)
+
+    def write_node_taints(
+        self, name: str, taints: tuple, reason: str = ""
+    ) -> bool:
+        """Write a node's full taint set through the journaled update
+        path (the node-lifecycle controller's API PATCH analog).  The
+        decision is write-ahead journaled BEFORE it applies, so a crash
+        mid-transition replays it deterministically; an identical taint
+        set is a no-op and journals nothing.  Returns whether a write
+        happened."""
+        rec = self.cache.nodes.get(name)
+        if rec is None:
+            return False
+        taints = tuple(taints)
+        if rec.node.spec.taints == taints:
+            return False
+        from .api import serialize
+
+        self._journal_append(
+            "taint",
+            node=name,
+            taints=[serialize.to_dict(taint) for taint in taints],
+            reason=reason,
+            # The logical time of the write: replay advances the
+            # lifecycle clock here, so a recovered process re-arms
+            # eviction deadlines against the incident's clock instead of
+            # a rewound zero (a feed whose clock kept running would
+            # otherwise fire every restored grace instantly).
+            ts=self._now(),
+        )
+        self._apply_node_taints(name, taints)
+        return True
+
+    def _apply_node_taints(self, name: str, taints: tuple) -> None:
+        """Apply a (journaled) taint set: route through update_node so
+        the precise NODE_TAINT requeue event fires and the NoExecute
+        eviction re-judges the node's pods — exactly what a wire-fed
+        taint update would do.  Also the journal-replay apply site."""
+        rec = self.cache.nodes.get(name)
+        if rec is None:
+            return
+        import copy
+
+        node = copy.deepcopy(rec.node)
+        node.spec.taints = tuple(taints)
+        self.update_node(node)
+        if self.taints_changed_hook is not None:
+            # The speculative frontend's decision cache reads taints as
+            # global feasibility: invalidate like a wire-fed taint change.
+            self.taints_changed_hook(name)
+
+    def evict_pod(
+        self, uid: str, reason: str = "eviction", pod: t.Pod | None = None
+    ) -> bool:
+        """Journaled evict-with-requeue: the binding is dropped and the
+        pod re-enters the queue UNBOUND, to reschedule on a surviving
+        node — the eviction half of upstream's sequence fused with the
+        workload controller's recreate half (this repo has none).  The
+        ``evict`` record is write-ahead journaled so a crash between the
+        eviction and the re-bind replays the requeue instead of losing
+        the pod.  ``pod`` supplies the object when the uid is not cached
+        (a recovered orphan binding whose node never relisted)."""
+        pr = self.cache.pods.get(uid)
+        source = pr.pod if pr is not None else pod
+        if source is None:
+            return False
+        import copy
+
+        from .api import serialize
+
+        requeued = copy.deepcopy(source)
+        requeued.spec.node_name = ""
+        requeued.status.nominated_node_name = ""
+        requeued.__dict__.pop("_uid", None)
+        self._journal_append(
+            "evict",
+            uid=uid,
+            pod=serialize.to_dict(requeued),
+            reason=reason,
+            ts=self._now(),
+        )
+        self._apply_eviction(uid, requeued, reason=reason)
+        return True
+
+    def _apply_eviction(
+        self, uid: str, requeued: t.Pod, reason: str = "eviction"
+    ) -> None:
+        """Apply a (journaled) eviction: unwind the binding's state, then
+        requeue the unbound copy.  Also the journal-replay apply site —
+        replaying an evict for a pod the snapshot never bound still
+        requeues it (the delete half no-ops)."""
+        self._unwind_pod(uid, notify=False)
+        self._evicted_uids.add(uid)
+        self.recorder.event(
+            uid,
+            NORMAL,
+            "Evicted",
+            f"Evicted {uid} ({reason}); requeued for rescheduling",
+            **self._trace_extra(),
+        )
+        self.add_pod(requeued)
+
     # -- cluster events (the informer surface, eventhandlers.go:341) ---------
 
     def add_node(self, node: t.Node) -> None:
@@ -778,6 +957,9 @@ class TPUScheduler:
                 self.builder.apply_dra_correction(
                     self.cache.row_of(node.name), corr, +1
                 )
+        # Lifecycle state rides the node's taints (recovery replay and
+        # wire-fed taints both land here); heartbeats ride Leases.
+        self.node_lifecycle.observe_node(node)
         self.queue.on_event(
             Event.NODE_ADD, self._free_ctx({self.cache.row_of(node.name)})
         )
@@ -798,7 +980,9 @@ class TPUScheduler:
         if old_node.spec.taints != node.spec.taints:
             ev |= Event.NODE_TAINT
             # NoExecute eviction judges the node's pods on a taint change
-            # (tainteviction handleNodeUpdate).
+            # (tainteviction handleNodeUpdate); the lifecycle controller
+            # adopts whatever state the new taint set encodes.
+            self.node_lifecycle.observe_node(node)
             self.taint_eviction.handle_node(node)
         if old_node.metadata.labels != node.metadata.labels:
             ev |= Event.NODE_LABEL
@@ -844,6 +1028,10 @@ class TPUScheduler:
         if rec is not None and self.permit_waiting:
             for qp, _n, _s, _f in self._drop_permit_waiters(set(rec.pods)):
                 self.queue.requeue_gang_member(qp)
+        # A deleted node leaves the lifecycle/GC tracking maps — its
+        # pods vanished with it, so there is nothing left to collect.
+        self.node_lifecycle.forget_node(name)
+        self.pod_gc.forget_node(name)
 
     def add_pod(self, pod: t.Pod) -> None:
         """Unassigned pods enter the queue; assigned pods enter the cache
@@ -989,6 +1177,13 @@ class TPUScheduler:
         # informer delete) is durable before any state unwinds — recovery
         # must not resurrect a deleted pod's binding.
         self._journal_append("delete", uid=uid)
+        self._unwind_pod(uid, notify)
+
+    def _unwind_pod(self, uid: str, notify: bool = True) -> None:
+        """The state unwind a pod's departure requires — shared by
+        delete_pod (journaled ``delete``) and _apply_eviction (journaled
+        ``evict``): prefetch dissolution, wait-room exits, nomination and
+        eviction-timer cleanup, DRA release, cache/queue removal."""
         # A pod held in the prefetched batch would otherwise be scheduled
         # after its deletion: dissolve the prefetch back into the queue.
         if self._prefetched is not None and any(
@@ -1024,8 +1219,8 @@ class TPUScheduler:
         self.nominator.pop(uid, None)
         # A deleted pod's pending NoExecute eviction dies with it — a
         # re-created pod with the same namespace/name must not inherit
-        # the old deadline.
-        self.taint_eviction.pending.pop(uid, None)
+        # the old deadline (or its per-taint clocks).
+        self.taint_eviction.cancel(uid)
         # DRA: drop the pod's claim reservations; claims nobody reserves
         # deallocate (the resourceclaim controller's cleanup).  Externally-
         # charged claims discharge their phantom row reservation here.
@@ -1180,6 +1375,96 @@ class TPUScheduler:
             self.builder.set_csinode_limits(rec.row, csinode)
         self.queue.on_event(Event.NODE_UPDATE)
 
+    # -- object deletions (the generalized Reflector's DELETED surface) ------
+    # A watch DELETED (or a LIST-replace repairing a missed delete) must
+    # land for every kind the plugins consume, not just Pod/Node — these
+    # are the removal halves of the add_* informer handlers above.
+
+    def remove_pv(self, name: str) -> None:
+        vols = self.builder.volumes
+        pv = vols.pvs.pop(name, None)
+        if pv is None:
+            return
+        vols.unbound.get(pv.storage_class, {}).pop(name, None)
+        vols.epoch += 1
+
+    def remove_pvc(self, uid: str) -> None:
+        vols = self.builder.volumes
+        pvc = vols.pvcs.pop(uid, None)
+        if pvc is None:
+            return
+        # An open provisioning intent dies with its claim.
+        vols.provisioning.pop(uid, None)
+        vols.pvc_users.pop(uid, None)
+        vols.epoch += 1
+
+    def remove_storage_class(self, name: str) -> None:
+        if self.builder.volumes.classes.pop(name, None) is not None:
+            self.builder.volumes.epoch += 1
+
+    def remove_csinode(self, name: str) -> None:
+        vols = self.builder.volumes
+        old = vols.csinodes.pop(name, None)
+        if old is None:
+            return
+        vols.epoch += 1
+        rec = self.cache.nodes.get(name)
+        if rec is not None:
+            # Restore the removed drivers to the no-CSINode default
+            # (unlimited — the snapshot's 2^31-1 fill).
+            self.builder.set_csinode_limits(
+                rec.row,
+                t.CSINode(
+                    name, {d: 2**31 - 1 for d in old.driver_limits}
+                ),
+            )
+        self.queue.on_event(Event.NODE_UPDATE)
+
+    def remove_pdb(self, name: str) -> None:
+        self.pdbs.pop(name, None)
+
+    def remove_resource_claim(self, uid: str) -> None:
+        """A deleted claim discharges whatever it held: route a
+        deallocated copy through the diffing add path (which reverses
+        external row charges and corrections), then drop the object."""
+        cat = self.builder.dra
+        claim = cat.claims.get(uid)
+        if claim is None:
+            return
+        if claim.allocated_node:
+            import dataclasses
+
+            self.add_resource_claim(
+                dataclasses.replace(
+                    claim,
+                    allocated_node="",
+                    reserved_for=(),
+                    allocated_devices=(),
+                )
+            )
+        cat.claims.pop(uid, None)
+        self.queue.on_event(Event.CLAIM_ADD)
+
+    def remove_resource_slice(self, uid: str) -> None:
+        """``uid`` is the Reflector's composite "node/device_class" key;
+        the node's published capacity for that class drops to zero."""
+        node_name, device_class = uid.split("/", 1)
+        cat = self.builder.dra
+        key = (node_name, device_class)
+        if cat.slices.pop(key, None) is None:
+            return
+        cat.devices.pop(key, None)
+        cat.device_owner.pop(key, None)
+        cat.epoch += 1
+        rec = self.cache.nodes.get(node_name)
+        if rec is not None:
+            # Caps recompute to 0 over the emptied device set; allocated
+            # charges stay until their claims release (upstream drains a
+            # slice before deleting it — a dangling allocation is the
+            # claim's problem, not the slice informer's).
+            self.builder.set_dra_cap(rec.row, node_name, device_class)
+        self.queue.on_event(Event.CLAIM_ADD)
+
     # -- scheduling ------------------------------------------------------------
 
     def dump_state(self) -> dict:
@@ -1192,6 +1477,22 @@ class TPUScheduler:
             base = {"journal": self.journal.stats()}
         else:
             base = {}
+        if self.node_lifecycle.armed:
+            # Only when the failure-response loop is armed — the golden
+            # dump fixtures pin the disarmed shape (like the journal key).
+            base["node_lifecycle"] = self.node_lifecycle.stats()
+            base["pod_gc"] = self.pod_gc.stats()
+            rebound = sum(
+                1
+                for uid in self._evicted_uids
+                if (pr := self.cache.pods.get(uid)) is not None and pr.bound
+            )
+            base["evictions"] = {
+                "total": self.taint_eviction.evictions,
+                "evicted_uids": len(self._evicted_uids),
+                # Loop closure per pod: evicted uids bound again.
+                "rebound": rebound,
+            }
         return {
             **base,
             "nodes": {
@@ -2085,8 +2386,16 @@ class TPUScheduler:
             # Permit-room waiters are assumed deliberately (gang quorum) and
             # expire through expire_waiting_gangs, not the TTL.
             self._next_assumed_sweep = now + 1.0
-            if self.taint_eviction.pending:
-                self.taint_eviction.tick(now)
+            if self.node_lifecycle.armed:
+                # One lifecycle tick chains the whole failure-response
+                # clock (transitions → eviction deadlines → GC sweep) on
+                # the logical Lease clock.
+                self.node_lifecycle.tick()
+            else:
+                if self.taint_eviction.pending:
+                    self.taint_eviction.tick(self._now())
+                if self.pod_gc.armed:
+                    self.pod_gc.sweep(self._now())
             waiting = {
                 e[0].pod.uid
                 for entries in self.permit_waiting.values()

@@ -546,6 +546,10 @@ class ResyncingClient:
                 fb.remove_node(uid)
         elif kind == "Pod":
             fb.delete_pod(uid)  # lenient for unknown uids
+        else:
+            remover = serialize.REMOVERS.get(kind)
+            if remover is not None:
+                getattr(fb, remover)(uid)  # the removers tolerate unknowns
 
     # Observability reads during an outage must not FORCE the fallback
     # engine into existence (its build replays the whole mirrored store —

@@ -457,12 +457,10 @@ def _dispatch(
     elif kind == "remove":
         if front is not None:
             front.note_remove(env.remove.kind, env.remove.uid)
-        if env.remove.kind == "Node":
-            sched.remove_node(env.remove.uid)
-        elif env.remove.kind == "Pod":
-            sched.delete_pod(env.remove.uid)
-        else:
+        remover = serialize.REMOVERS.get(env.remove.kind)
+        if remover is None:
             raise ValueError(f"cannot remove kind {env.remove.kind}")
+        getattr(sched, remover)(env.remove.uid)
         out.response.SetInParent()
     elif kind == "dump":
         import json

@@ -183,6 +183,12 @@ class SpeculativeFrontend:
         # checkpoint the live value (journal.scheduler_state).
         self.epoch = getattr(sched, "_recovered_spec_epoch", 0)
         sched._spec_frontend = self
+        # Node-lifecycle taint writes originate INSIDE the scheduler (a
+        # Lease renewal trips the transition), so they never pass through
+        # note_add — the scheduler calls back here instead.  Taints flip
+        # feasibility globally: same full rollback as a wire-fed taint
+        # change through the Node branch below.
+        sched.taints_changed_hook = lambda _name: self.invalidate()
         # Reverse domain dependencies: an EXISTING pod's required
         # anti-affinity constrains FUTURE pods (the symmetry the reference
         # computes as existingAntiAffinityCounts,
@@ -657,6 +663,11 @@ class SpeculativeFrontend:
             # Only preemption verdicts read PDB budgets; bind decisions
             # don't.  Nominations are always in scope.
             self._scope()
+            return
+        if kind == "Lease":
+            # A heartbeat renewal mutates no scheduling state by itself;
+            # the taint transitions it may trip invalidate through the
+            # scheduler's taints_changed_hook (registered in __init__).
             return
         self.invalidate()
 

@@ -1,11 +1,13 @@
 #!/usr/bin/env sh
-# Compile- and lint-check the Go half (go/README.md): gofmt cleanliness,
-# `go vet` + `go build` over the out-of-tree plugin set and the scheduler
-# binary, and the custom sidecardeadline analyzer (go/analyzers/ —
-# every WriteFrame/ReadFrame caller outside wire.go must set a
-# connection deadline and keep the error reachable).  The build image
-# has no Go toolchain, so the guard makes this a silent no-op there —
-# CI hosts that do carry one (and developers) get the real check.
+# Compile-, lint- AND test-check the Go half (go/README.md): gofmt
+# cleanliness, `go vet` + `go build` + `go test` over the out-of-tree
+# plugin set and the scheduler binary (the golden framestream round trip,
+# converter goldens, and subscriber.go's epoch-ordering contract), and
+# the custom sidecardeadline analyzer (go/analyzers/ — every
+# WriteFrame/ReadFrame caller outside wire.go must set a connection
+# deadline and keep the error reachable).  The build image has no Go
+# toolchain, so the guard makes this a silent no-op there — CI hosts
+# that do carry one (and developers) get the real check.
 # Hooked into the test entrypoint via tests/test_go_build.py.
 set -eu
 
@@ -28,6 +30,12 @@ echo "check_go: go vet ./..."
 go vet ./...
 echo "check_go: go build ./..."
 go build ./...
+# Actually EXECUTE the tests (ISSUE 9): the golden-framestream round
+# trip, the converter goldens, and subscriber.go's epoch-ordering
+# contract against the recorded push stream's rollback edges.  vet+build
+# alone never ran a line of the 1.9k LoC.
+echo "check_go: go test ./..."
+go test ./...
 
 # Custom analyzers (separate module so x/tools stays out of the plugin
 # tree).  go.sum is generated on first use (`go mod tidy` — needs module

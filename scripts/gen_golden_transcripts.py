@@ -496,6 +496,46 @@ def drive_speculative(client, sub):
     # PendingPods path must not resurrect it).
     client.add_pending_batch([pods[5]])
     client.remove("Pod", pods[5].uid)
+    # ---- epoch-rollback edges (ISSUE 9) ---------------------------------
+    # The subscriber contract (go/tpubatchscore/subscriber.go) claims a
+    # consumer applying frames in stream order can never serve a decision
+    # from a rolled-back epoch.  Pin the edge shapes in the recording:
+    # a scoped invalidate_uids from a capacity change, TWO back-to-back
+    # full rollbacks with no recompute between (the epoch jumps twice
+    # with no decisions in flight), then a recompute whose fresh
+    # decisions ride the bumped epoch.
+    late = [
+        make_pod(f"sq{i}").req({"cpu": "1"}).label("app", "spec").obj()
+        for i in range(3)
+    ]
+    client.add_pending_batch(late)
+    # Miss on sq0: sq1/sq2's co-scheduled decisions ride the stream.
+    (_r3,) = client.schedule([late[0]], drain=False)
+    # Capacity-only nudge on sn1: decisions ON sn1 invalidate (scoped
+    # invalidate_uids — grown/shrunk capacity re-checks placements there).
+    n1c = copy.deepcopy(nodes[1])
+    n1c.status.allocatable = dict(n1c.status.allocatable)
+    n1c.status.allocatable["cpu"] = n1c.status.allocatable["cpu"] - 500
+    client.add("Node", n1c)
+    # Two label rollbacks back to back: invalidate_all twice, nothing
+    # recomputed between — the epoch-rollback edge a consumer must ride
+    # without ever serving a stale entry.
+    n0c = copy.deepcopy(nodes[0])
+    n0c.metadata.labels = dict(n0c.metadata.labels, team="y")
+    client.add("Node", n0c)
+    n0d = copy.deepcopy(nodes[0])
+    n0d.metadata.labels = dict(n0d.metadata.labels, team="z")
+    client.add("Node", n0d)
+    # Recompute under the bumped epoch: sq1 misses to the wire, sq2's
+    # fresh decision rides the stream at the new epoch.
+    (_r4,) = client.schedule([late[1]], drain=False)
+    # Terminal rollback: a final invalidate_all with NO recompute after —
+    # the consumer must end with an empty map for the undelivered uids
+    # (serving sq2's rolled-back decision here is exactly the staleness
+    # the ordering contract forbids).
+    n0e = copy.deepcopy(nodes[0])
+    n0e.metadata.labels = dict(n0e.metadata.labels, team="w")
+    client.add("Node", n0e)
     h2 = client.health()
     dump = client.dump()
     return r0, r1, r2, h1, h2, dump

@@ -188,6 +188,19 @@ def soak_report(doc: dict) -> str:
         f"{j.get('compactions_observed')} compaction cycles observed, "
         f"bounded={j.get('bounded')}"
     )
+    nl = doc.get("node_loss")
+    if nl:
+        lc = nl.get("lifecycle", {})
+        out.append(
+            f"\nnode loss: {nl.get('node_deaths')} deaths / "
+            f"{nl.get('node_revives')} revives, "
+            f"{lc.get('transitions')} lifecycle transitions "
+            f"(states {lc.get('states')}), "
+            f"{nl.get('evictions')} evictions, "
+            f"{nl.get('gc_collected')} GC-collected, "
+            f"{nl.get('reschedules')} pods rescheduled elsewhere, "
+            f"{nl.get('lease_renewals')} lease renewals"
+        )
     phases = doc.get("phases", [])
     if phases:
         out.append("\nper-phase serving:")

@@ -92,6 +92,32 @@ FLEET_KILL_CASES = (
     ("pre-map-write", 1),
 )
 
+# The NODE-LOSS subset (ISSUE 9): the full failure-response production
+# sequence — a node stops heartbeating mid-scenario, the node-lifecycle
+# controller detects staleness on the logical Lease clock and WRITES the
+# NotReady→Unreachable taints (journaled), tolerationSeconds graces are
+# honored, the taint-eviction controller evicts, evicted pods requeue and
+# the final drain reschedules them bit-identically onto surviving nodes —
+# with the process SIGKILLed at journal points along the way, INCLUDING
+# between the taint-write and the eviction (post-append on the taint
+# record), and each killed cell leaving a readable flight dump + the
+# scheduler_node_lifecycle_* / scheduler_pod_gc_* metric families in its
+# metrics snapshot.  Append order in the scenario (snapshot-every-batch
+# truncations interleave): bind×2 (the pending pods), taint(not-ready),
+# evict(v1), taint(unreachable), evict(v2), evict(sticky — the pod-GC
+# horizon), then the rebinds.
+NODE_LOSS_CASES = (
+    ("post-append", 3),   # right AFTER the not-ready taint write — the
+                          # taint-write→eviction window the ISSUE names
+    ("pre-append", 4),    # before the first eviction's record
+    ("torn-append", 4),   # the first eviction's record torn mid-write
+    ("post-append", 5),   # after the unreachable taint write
+    ("pre-append", 6),    # before the second eviction
+    ("post-append", 7),   # after the pod-GC eviction, before its rebind
+    ("mid-snapshot", 2),  # checkpoint torn mid-incident
+    ("post-truncate", 1),
+)
+
 # The WIRE crash subset (the ROADMAP layer-0 gap): the same scenario
 # deployed as two processes — a journaled sidecar serving the framed
 # socket and a journaled ResyncingClient host driving it — with HOST and
@@ -591,6 +617,331 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the NODE-LOSS matrix (the failure-response loop under SIGKILL) --------
+
+
+def _truth_evicted_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "truth.evicted")
+
+
+def _truth_evict(state_dir: str, uid: str) -> None:
+    """Durably record an eviction in host truth BEFORE local state moves —
+    the apiserver-side effect (pod deleted + controller recreates it
+    unbound) lands in etcd first, exactly like the delete tombstones."""
+    with open(_truth_evicted_path(state_dir), "a") as f:
+        f.write(uid + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _truth_evicted(state_dir: str) -> set:
+    try:
+        with open(_truth_evicted_path(state_dir)) as f:
+            return {line.strip() for line in f if line.strip()}
+    except OSError:
+        return set()
+
+
+def _node_loss_scheduler(state_dir: str):
+    """A journaled scheduler with the failure-response loop ARMED (grace
+    5s / unreachable 12s / GC horizon 20s on the logical Lease clock) and
+    TaintToleration in the filter set (a requeued eviction victim must
+    not rebind to the cordoned dead node).  delete_pod AND evict_pod
+    tombstone host truth first."""
+    from kubernetes_tpu.framework.config import Profile
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    sched = TPUScheduler(
+        profile=Profile(
+            name="node-loss",
+            filters=(
+                "NodeUnschedulable", "NodeName", "TaintToleration",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1), ("TaintToleration", 3)),
+        ),
+        batch_size=8,
+        chunk_size=1,
+    )
+    sched.node_lifecycle.arm(grace_period_s=5.0, unreachable_after_s=12.0)
+    sched.pod_gc.arm(gc_horizon_s=20.0)
+    lease_path = os.path.join(state_dir, "lease")
+    lease = FileLease(lease_path, identity=f"nodeloss-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        state_dir, epoch=lease.epoch, fence=lambda: read_epoch(lease_path)
+    )
+    orig_delete = sched.delete_pod
+    orig_evict = sched.evict_pod
+
+    def delete_pod(uid: str, notify: bool = True) -> None:
+        _truth_delete(state_dir, uid)
+        orig_delete(uid, notify)
+
+    def evict_pod(uid: str, reason: str = "eviction", pod=None) -> bool:
+        _truth_evict(state_dir, uid)
+        return orig_evict(uid, reason=reason, pod=pod)
+
+    sched.delete_pod = delete_pod
+    sched.evict_pod = evict_pod
+    return sched, journal
+
+
+def node_loss_objects():
+    """The node-death scenario: 4 nodes (nd1 is the doomed one), three
+    pods riding nd1 with distinct grace shapes — v1 (4s tolerationSeconds,
+    evicted in the NotReady window), v2 (8s, re-armed by the
+    NotReady→Unreachable taint swap, evicted later), sticky (tolerates
+    every NoExecute forever; only the pod-GC horizon reclaims it) — a
+    filler bound elsewhere, and two pending pods."""
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+    from kubernetes_tpu.controllers import (
+        NOT_READY_TAINT_KEY,
+        UNREACHABLE_TAINT_KEY,
+    )
+
+    nodes = [
+        make_node("nd1").capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+        .zone("z0").obj(),
+        make_node("n2").capacity({"cpu": "6", "memory": "12Gi", "pods": 110})
+        .zone("z0").obj(),
+        make_node("n3").capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+        .zone("z1").obj(),
+        make_node("n4").capacity({"cpu": "4", "memory": "8Gi", "pods": 110})
+        .zone("z1").obj(),
+    ]
+
+    def graced(w, seconds):
+        return (
+            w.toleration(NOT_READY_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                         effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+            .toleration(UNREACHABLE_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                        effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+        )
+
+    bound = [
+        graced(make_pod("v1").req({"cpu": "1", "memory": "1Gi"}), 4)
+        .node("nd1").obj(),
+        graced(make_pod("v2").req({"cpu": "2", "memory": "2Gi"}), 8)
+        .node("nd1").obj(),
+        make_pod("sticky").req({"cpu": "1", "memory": "1Gi"})
+        .toleration("", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE)
+        .node("nd1").obj(),
+        make_pod("filler").req({"cpu": "2", "memory": "2Gi"}).node("n2").obj(),
+    ]
+    pending = [
+        make_pod("p1").req({"cpu": "1", "memory": "1Gi"}).obj(),
+        make_pod("p2").req({"cpu": "1", "memory": "1Gi"}).obj(),
+    ]
+    return nodes, bound, pending
+
+
+# Survivor Lease schedule: every 2 logical seconds to t=40 — carries the
+# scenario past NotReady (>5), Unreachable (>12), v2's re-armed grace
+# (14+8) and the GC horizon (14+20).
+NODE_LOSS_LEASE_TS = tuple(float(ts) for ts in range(2, 41, 2))
+
+
+def _node_loss_tail(sched, state_dir: str) -> dict:
+    """The scenario tail — idempotent: Lease renewals are monotone (a
+    replayed-stale stamp is ignored) and the transition history is a pure
+    function of the lease schedule, so a recovery child re-running the
+    full schedule converges to the uninterrupted run's state."""
+    from kubernetes_tpu.api import types as t
+
+    sched.schedule_all_pending(wait_backoff=True)
+    for name in ("nd1", "n2", "n3", "n4"):
+        sched.renew_node_lease(t.Lease(name, 0.0))
+    for ts in NODE_LOSS_LEASE_TS:
+        for name in ("n2", "n3", "n4"):  # nd1 went silent after t=0
+            sched.renew_node_lease(t.Lease(name, ts))
+    sched.schedule_all_pending(wait_backoff=True)
+    bindings = {
+        uid: pr.node_name
+        for uid, pr in sched.cache.pods.items()
+        if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+    with open(os.path.join(state_dir, "metrics.json"), "w") as f:
+        json.dump(
+            {
+                "registry": sched.metrics.registry.summary(),
+                "node_lifecycle": sched.node_lifecycle.stats(),
+                "pod_gc": sched.pod_gc.stats(),
+                "taint_evictions": sched.taint_eviction.evictions,
+            },
+            f,
+            sort_keys=True,
+            default=str,
+        )
+    return bindings
+
+
+def node_loss_child(state_dir: str) -> None:
+    """The victim: run the node-death scenario with journaling armed;
+    TPU_JOURNAL_KILL lands the SIGKILL at the armed journal point —
+    post-append on the taint record being the taint-write→eviction
+    window the acceptance bar names."""
+    from kubernetes_tpu.faults import KillSwitch
+
+    sched, journal = _node_loss_scheduler(state_dir)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, bound, pending = node_loss_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in bound:
+        sched.add_pod(p)
+    for p in pending:
+        sched.add_pod(p)
+    _node_loss_tail(sched, state_dir)
+
+
+def node_loss_recover_child(state_dir: str) -> None:
+    """The successor: recover from snapshot + fenced replay (taint and
+    evict records re-apply), reconcile against host truth — the dead
+    node relists in its ORIGINAL untainted shape and the Reflector's
+    recovered-taints overlay re-applies the journal-authored lifecycle
+    taints; evicted pods relist UNBOUND (their durable eviction
+    tombstones are the apiserver's recreate) — then re-run the lease
+    schedule: renewals are monotone, so the transition history replays
+    and converges on the uninterrupted timeline."""
+    import copy
+
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+    from kubernetes_tpu.journal import recover
+
+    sched, journal = _node_loss_scheduler(state_dir)
+    recover(sched, journal)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    nodes, bound, pending = node_loss_objects()
+    deleted = _truth_deleted(state_dir)
+    evicted = _truth_evicted(state_dir)
+    src_n, src_p = FakeSource(), FakeSource()
+    for n in nodes:
+        src_n.add(n.name, copy.deepcopy(n))
+    for p in bound + pending:
+        if p.uid in deleted:
+            continue
+        obj = copy.deepcopy(p)
+        if obj.uid in evicted:
+            obj.spec.node_name = ""  # host truth: recreated unbound
+        src_p.add(obj.uid, obj)
+    reconcile_after_recovery(
+        sched,
+        Reflector(sched, "Node", src_n.lister, src_n.watcher),
+        Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+    )
+    _node_loss_tail(sched, state_dir)
+
+
+def _node_loss_cell_evidence(state_dir: str) -> list[str]:
+    """What a killed cell must leave behind: a readable recovery flight
+    dump AND a metrics snapshot carrying the scheduler_node_lifecycle_* /
+    scheduler_pod_gc_* families with real counts.  Returns the missing
+    pieces (empty == complete)."""
+    missing = []
+    if not _flight_dump_ok(state_dir):
+        missing.append("flight-dump")
+    try:
+        with open(os.path.join(state_dir, "metrics.json")) as f:
+            doc = json.load(f)
+        blob = json.dumps(doc)
+        for fam in (
+            "scheduler_node_lifecycle_transitions_total",
+            "scheduler_node_lifecycle_state",
+            "scheduler_pod_gc_total",
+            "scheduler_taint_evictions_total",
+        ):
+            if fam not in blob:
+                missing.append(f"metrics:{fam}")
+        if doc.get("node_lifecycle", {}).get("transitions", 0) < 1:
+            missing.append("metrics:no-transitions")
+        if doc.get("taint_evictions", 0) < 1:
+            missing.append("metrics:no-evictions")
+        if doc.get("pod_gc", {}).get("collected", {}).get("unreachable", 0) < 1:
+            missing.append("metrics:no-gc")
+    except (OSError, ValueError):
+        missing.append("metrics.json")
+    return missing
+
+
+def run_node_loss_matrix(cases=NODE_LOSS_CASES, verbose=True) -> list[str]:
+    """SIGKILL the node-death scenario at each journal point (taint
+    writes and evictions included), recover, and require (a) final
+    bindings bit-identical to the uninterrupted run — the evicted pods
+    REBOUND on surviving nodes, not merely deleted — and (b) a readable
+    flight dump + lifecycle/GC metrics per killed cell."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "node-loss-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--node-loss-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "node-loss baseline run failed"
+        # The baseline itself must show the loop closed: every nd1 pod
+        # rebound elsewhere.
+        for uid in ("default/v1", "default/v2", "default/sticky"):
+            assert baseline.get(uid) not in (None, "", "nd1"), (
+                f"baseline did not reschedule {uid}: {baseline}"
+            )
+        failures = []
+        for point, nth in cases:
+            label = f"nodeloss:{point}@{nth}"
+            state_dir = os.path.join(td, f"nl-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn("--node-loss-child", state_dir, kill=f"{point}:{nth}")
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--node-loss-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}")
+                continue
+            missing = _node_loss_cell_evidence(state_dir)
+            if missing:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: missing evidence {missing}")
+                continue
+            if verbose:
+                print(
+                    f"ok   {label}: taint→grace→evict→requeue→rebind "
+                    "recovered bit-identical, flight dump + metrics present"
+                )
+        return failures
+
+
 # -- the WIRE crash matrix (host and sidecar killed independently) ---------
 
 
@@ -853,6 +1204,29 @@ def main() -> int:
     if "--recover-child" in sys.argv:
         recover_child(sys.argv[sys.argv.index("--recover-child") + 1])
         return 0
+    if "--node-loss-child" in sys.argv:
+        node_loss_child(sys.argv[sys.argv.index("--node-loss-child") + 1])
+        return 0
+    if "--node-loss-recover-child" in sys.argv:
+        node_loss_recover_child(
+            sys.argv[sys.argv.index("--node-loss-recover-child") + 1]
+        )
+        return 0
+    if "--node-loss" in sys.argv:
+        # The failure-response-loop subset alone (also rides --kill).
+        failures = run_node_loss_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(NODE_LOSS_CASES)} node-loss "
+                f"cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(NODE_LOSS_CASES)} node-loss cases: staleness → "
+            "taint → grace → eviction → requeue → bit-identical reschedule, "
+            "with a flight dump + lifecycle/GC metrics per cell"
+        )
+        return 0
     if "--wire-sidecar-child" in sys.argv:
         wire_sidecar_child(
             sys.argv[sys.argv.index("--wire-sidecar-child") + 1]
@@ -890,7 +1264,12 @@ def main() -> int:
         failures += run_wire_kill_matrix()
         # The shard-failover subset (fleet takeover) rides --kill too.
         failures += run_fleet_kill_matrix()
-        total = len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
+        # And the failure-response-loop subset (node death mid-scenario).
+        failures += run_node_loss_matrix()
+        total = (
+            len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
+            + len(NODE_LOSS_CASES)
+        )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")
             return 1

@@ -41,6 +41,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def r06_config(args) -> "SoakConfig":
     from kubernetes_tpu.loadgen.soak import SoakConfig
 
+    node_loss = {}
+    if getattr(args, "node_loss", False):
+        # The failure-response soak (ISSUE 9, SOAK_r09): churn nodes die
+        # mid-soak (heartbeat silenced, object kept) — the server must
+        # detect staleness on the logical Lease clock, write the
+        # NotReady/Unreachable taints, evict after tolerationSeconds,
+        # requeue, and reschedule on survivors; revives clear the taints.
+        # Flaps are disabled for the recording so every churn event on
+        # the pool exercises DETECTION, not informer deletes.
+        node_loss = dict(
+            node_death_period_s=30.0,
+            node_death_down_s=12.0,
+            lease_interval_s=1.0,
+            node_grace_s=3.0,
+            node_unreachable_s=7.0,
+            gc_horizon_s=18.0,
+            node_flap_period_s=0.0,
+        )
     return SoakConfig(
         seed=args.seed,
         nodes=args.nodes,
@@ -60,7 +78,7 @@ def r06_config(args) -> "SoakConfig":
         ),
         knee_phase_s=args.knee_phase,
         invalidation_rate_per_s=0.2,
-        node_flap_period_s=45.0,
+        node_flap_period_s=node_loss.pop("node_flap_period_s", 45.0),
         flap_down_s=2.0,
         cold_consumer_period_s=60.0,
         live_pod_cap=args.live_pod_cap,
@@ -73,6 +91,7 @@ def r06_config(args) -> "SoakConfig":
         snapshot_every=args.snapshot_every,
         pace="real",
         out_dir=args.out_dir,
+        **node_loss,
     )
 
 
@@ -102,6 +121,19 @@ def determinism_check(cfg) -> dict:
         node_flap_period_s=2.0,
         cold_consumer_period_s=2.5,
     )
+    if cfg.node_grace_s > 0:
+        # Scale the node-death clocks into the 3s window so the check
+        # exercises death → taint → evict → requeue too.
+        small = dataclasses.replace(
+            small,
+            node_flap_period_s=0.0,
+            node_death_period_s=1.2,
+            node_death_down_s=1.0,
+            lease_interval_s=0.2,
+            node_grace_s=0.4,
+            node_unreachable_s=0.8,
+            gc_horizon_s=1.5,
+        )
     a = run_soak(small)
     b = run_soak(small)
     return {
@@ -219,7 +251,11 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=0,
                     help="soak the partitioned fleet with N shard owners "
                     "instead of the two-process speculative deployment")
-    ap.add_argument("--out", default="SOAK_r06.json")
+    ap.add_argument("--node-loss", action="store_true",
+                    help="arm the node-lifecycle loop and kill churn-node "
+                    "heartbeats mid-soak: staleness → taints → eviction → "
+                    "requeue → reschedule, recorded as SOAK_r09.json")
+    ap.add_argument("--out", default="")
     ap.add_argument("--out-dir", default="",
                     help="flight-dump directory (default: alongside --out)")
     ap.add_argument("--seed", type=int, default=6)
@@ -242,6 +278,8 @@ def main() -> int:
     ap.add_argument("--snapshot-every", type=int, default=24)
     ap.add_argument("--skip-determinism-check", action="store_true")
     args = ap.parse_args()
+    if not args.out:
+        args.out = "SOAK_r09.json" if args.node_loss else "SOAK_r06.json"
     if not args.out_dir:
         args.out_dir = os.path.join(
             os.path.dirname(os.path.abspath(args.out)) or ".",
@@ -293,6 +331,17 @@ def main() -> int:
         f"knee {artifact['knee']['knee_intensity_per_s']}",
         flush=True,
     )
+    nl = artifact.get("node_loss")
+    if nl:
+        print(
+            f"run_soak: node-loss — {nl['node_deaths']} deaths / "
+            f"{nl['node_revives']} revives, "
+            f"{nl['lifecycle'].get('transitions', 0)} lifecycle "
+            f"transitions, {nl['evictions']} evictions, "
+            f"{nl['reschedules']} reschedules, "
+            f"GC {nl['gc_collected']}",
+            flush=True,
+        )
     return 0
 
 

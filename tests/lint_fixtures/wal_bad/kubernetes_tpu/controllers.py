@@ -1,0 +1,25 @@
+"""Seeded WAL-discipline violations for the failure-response apply sites
+(ISSUE 9): the node-lifecycle taint write and the evict-with-requeue path
+must journal BEFORE they apply, like every other commit."""
+
+
+class BadLifecycle:
+    def transition_apply_then_journal(self, name, taints):
+        # POSITIVE wal-apply-before-journal: the taint set goes live
+        # before its ``taint`` record exists — a crash in the window
+        # replays a dead node as healthy.
+        self.sched._apply_node_taints(name, taints)
+        self.sched._journal_append("taint", node=name)
+
+    def evict_without_journal(self, uid, pod):
+        # POSITIVE wal-unjournaled-apply: an eviction applied with no
+        # journal call in scope — a crash forgets the requeue and the
+        # pod is lost.
+        self.sched._apply_eviction(uid, pod)
+
+    def healthy_transition(self, name, taints, uid, pod):
+        # NEGATIVE: journal-before-apply for both new markers.
+        self.sched._journal_append("taint", node=name)
+        self.sched._apply_node_taints(name, taints)
+        self.sched._journal_append("evict", uid=uid)
+        self.sched._apply_eviction(uid, pod)

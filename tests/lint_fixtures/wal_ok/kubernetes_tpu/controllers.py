@@ -1,0 +1,20 @@
+"""WAL-discipline negative fixture for the failure-response apply sites:
+journal-before-apply for taint writes and evictions, plus a marker's own
+definition delegating to another marker (the journal duty lives at its
+call sites — zero findings expected)."""
+
+
+class GoodLifecycle:
+    def write_taints(self, name, taints):
+        self.sched._journal_append("taint", node=name)
+        self.sched._apply_node_taints(name, taints)
+
+    def evict(self, uid, pod):
+        self.sched._journal_append("evict", uid=uid)
+        self.sched._apply_eviction(uid, pod)
+
+    def _apply_eviction(self, uid, pod):
+        # A marker's own definition may delegate to another marker —
+        # the caller journals (the write_taints/evict shapes above).
+        self._unwind_pod(uid)
+        self.queue_add(pod)

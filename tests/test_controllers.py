@@ -341,9 +341,225 @@ def test_removed_short_grace_taint_restores_longer_deadline():
         [t.Taint("b", "true", t.EFFECT_NO_EXECUTE)], 1010.0,
     )
     assert tec.pending[uid] == (1000.0, 1600.0)
-    # Unrelated churn with both taints never extends past the armed start.
+    # Taint a RE-ADDED at 1020: its grace clock restarts at the re-add
+    # (1020 + 30 = 1050), it does not inherit the stale 1000-based timer
+    # (the ISSUE 9 re-arm fix) — while b keeps its original 1000 start.
     tec.evaluate(uid, s.cache.pods[uid].pod, taints_ab, 1020.0)
-    assert tec.pending[uid] == (1000.0, 1030.0)
+    assert tec.pending[uid] == (1000.0, 1050.0)
+
+
+def test_taint_removed_and_readded_resets_deadline():
+    # The ISSUE 9 re-arm gap: with ANOTHER NoExecute taint keeping the
+    # pending entry alive, a taint removed and re-added must reset its
+    # tolerationSeconds deadline rather than inherit the stale timer.
+    s = sched()
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration("short", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=10)
+        .toleration("forever", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE)
+        .node("n1").obj()
+    )
+    tec = s.taint_eviction
+    uid = "default/p"
+    pod = s.cache.pods[uid].pod
+    short = t.Taint("short", "true", t.EFFECT_NO_EXECUTE)
+    forever = t.Taint("forever", "true", t.EFFECT_NO_EXECUTE)
+    tec.evaluate(uid, pod, [short, forever], 100.0)
+    assert tec.pending[uid] == (100.0, 110.0)
+    # `short` removed at 105 — `forever` keeps the entry pending (its
+    # matching toleration is nil-seconds, so nothing bounds a deadline
+    # but the pod stays judged).
+    tec.evaluate(uid, pod, [forever], 105.0)
+    assert uid not in tec.pending  # no bounded grace left
+    # Re-judged with `short` back at 108: a fresh 10s clock from 108,
+    # NOT the stale 110 deadline inherited from the first arming.
+    tec.evaluate(uid, pod, [short, forever], 108.0)
+    assert tec.pending[uid][1] == 118.0
+    # The stale-timer shape (the bug): eviction must NOT fire at 110.
+    assert tec.tick(110.0) == 0
+    assert tec.tick(118.0) == 1
+    assert uid not in s.cache.pods
+
+
+# ---------------------------------------------------------------------------
+# NodeLifecycleController + PodGCController — the failure-response WRITER
+# half (ISSUE 9): heartbeat staleness → taint write → eviction → requeue.
+# ---------------------------------------------------------------------------
+
+
+from kubernetes_tpu.controllers import (  # noqa: E402
+    NODE_NOT_READY,
+    NODE_UNREACHABLE,
+    NOT_READY_TAINT_KEY,
+    UNREACHABLE_TAINT_KEY,
+)
+
+
+def _lease(s, name, ts):
+    s.renew_node_lease(t.Lease(name, ts))
+
+
+def _armed_sched(grace=5.0, unreachable=12.0, gc=30.0):
+    # TaintToleration in the filter set: a requeued eviction victim must
+    # not land straight back on the tainted node it was evicted from.
+    from kubernetes_tpu.framework.config import Profile
+
+    s = TPUScheduler(
+        profile=Profile(
+            name="fit-taints",
+            filters=(
+                "NodeUnschedulable", "NodeName", "TaintToleration",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+    )
+    s.node_lifecycle.arm(grace_period_s=grace, unreachable_after_s=unreachable)
+    s.pod_gc.arm(gc_horizon_s=gc)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    _lease(s, "n1", 0.0)
+    _lease(s, "n2", 0.0)
+    return s
+
+
+def test_lifecycle_transitions_ready_notready_unreachable():
+    s = _armed_sched()
+    # n2 keeps renewing; n1 went quiet at t=0.
+    _lease(s, "n2", 4.0)
+    assert s.node_lifecycle.states == {}  # age 4 <= grace 5
+    _lease(s, "n2", 6.0)
+    assert s.node_lifecycle.states == {"n1": NODE_NOT_READY}
+    keys = {taint.key for taint in s.cache.nodes["n1"].node.spec.taints}
+    assert keys == {NOT_READY_TAINT_KEY}
+    effects = {
+        taint.effect for taint in s.cache.nodes["n1"].node.spec.taints
+    }
+    assert effects == {t.EFFECT_NO_SCHEDULE, t.EFFECT_NO_EXECUTE}
+    _lease(s, "n2", 13.0)
+    assert s.node_lifecycle.states == {"n1": NODE_UNREACHABLE}
+    keys = {taint.key for taint in s.cache.nodes["n1"].node.spec.taints}
+    assert keys == {UNREACHABLE_TAINT_KEY}
+
+
+def test_lifecycle_recovery_clears_taints():
+    s = _armed_sched()
+    _lease(s, "n2", 6.0)
+    assert s.node_lifecycle.states == {"n1": NODE_NOT_READY}
+    # n1 comes back: a fresh renewal clears the lifecycle taints and the
+    # state returns to ready.
+    _lease(s, "n1", 7.0)
+    assert s.node_lifecycle.states == {}
+    assert s.cache.nodes["n1"].node.spec.taints == ()
+
+
+def test_lifecycle_taint_write_preserves_foreign_taints():
+    s = _armed_sched()
+    s.update_node(
+        make_node("n1").capacity({"cpu": "8", "pods": 110})
+        .taint("dedicated", "gpu", t.EFFECT_NO_SCHEDULE).obj()
+    )
+    _lease(s, "n2", 6.0)
+    keys = {taint.key for taint in s.cache.nodes["n1"].node.spec.taints}
+    assert keys == {"dedicated", NOT_READY_TAINT_KEY}
+    _lease(s, "n1", 7.0)  # recovery keeps the foreign taint
+    keys = {taint.key for taint in s.cache.nodes["n1"].node.spec.taints}
+    assert keys == {"dedicated"}
+
+
+def test_lifecycle_eviction_requeues_and_reschedules():
+    # The full loop in-process: staleness → taint → tolerationSeconds
+    # grace → eviction → requeue → rebind on the surviving node.
+    s = _armed_sched()
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration(NOT_READY_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=3)
+        .toleration(UNREACHABLE_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=3)
+        .node("n1").obj()
+    )
+    _lease(s, "n2", 6.0)  # n1 → NotReady at logical 6; grace clock arms
+    assert "default/p" in s.taint_eviction.pending
+    _lease(s, "n2", 8.0)  # not due yet (6 + 3 = 9)
+    assert "default/p" in s.cache.pods
+    _lease(s, "n2", 9.5)  # due: evicted and requeued unbound
+    assert "default/p" not in s.cache.pods
+    assert s.taint_eviction.evictions == 1
+    out = s.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.pod.uid == "default/p" and o.node_name]
+    assert placed and placed[0].node_name == "n2"
+
+
+def test_journaled_taint_write_is_noop_when_identical():
+    s = _armed_sched()
+    _lease(s, "n2", 6.0)
+    taints = s.cache.nodes["n1"].node.spec.taints
+    assert s.write_node_taints("n1", taints) is False  # identical set
+    assert s.write_node_taints("missing", ()) is False  # unknown node
+
+
+def test_pod_gc_unreachable_horizon_collects_tolerating_pods():
+    # A tolerate-forever pod sits through NotReady and Unreachable; the
+    # GC horizon finally requeues it.
+    s = _armed_sched(gc=20.0)
+    s.add_pod(
+        make_pod("sticky").req({"cpu": "1"})
+        # Tolerates every NoExecute taint forever (eviction immunity) but
+        # not NoSchedule — the realistic daemon shape: the GC must reclaim
+        # it, and the rebind must avoid the still-cordoned dead node.
+        .toleration("", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE)
+        .node("n1").obj()
+    )
+    _lease(s, "n2", 13.0)  # n1 unreachable at 13
+    assert "default/sticky" in s.cache.pods  # tolerated: no eviction
+    _lease(s, "n2", 30.0)  # 13 + 20 = 33 not reached
+    assert "default/sticky" in s.cache.pods
+    _lease(s, "n2", 34.0)
+    assert "default/sticky" not in s.cache.pods
+    assert s.pod_gc.collected["unreachable"] == 1
+    out = s.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.pod.uid == "default/sticky" and o.node_name]
+    assert placed and placed[0].node_name == "n2"
+
+
+def test_pod_gc_clears_stale_terminating_entries():
+    s = _armed_sched()
+    s.add_pod(
+        make_pod("p").req({"cpu": "1"})
+        .toleration(NOT_READY_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=60)
+        .node("n1").obj()
+    )
+    _lease(s, "n2", 6.0)
+    assert "default/p" in s.taint_eviction.pending
+    # The node vanishes entirely (informer delete): its pods vaporize,
+    # but the pending deadline would leak without the GC's terminating
+    # sweep.
+    s.remove_node("n1")
+    assert "default/p" not in s.cache.pods
+    _lease(s, "n2", 7.0)
+    assert "default/p" not in s.taint_eviction.pending
+    assert s.pod_gc.collected["terminating"] == 1
+
+
+def test_unleased_nodes_are_exempt():
+    # Nodes that never renew a Lease are invisible to the lifecycle
+    # controller even when armed — embedders feeding only Node objects
+    # keep the consumer-only behavior.
+    s = sched()
+    s.node_lifecycle.arm(grace_period_s=1.0, unreachable_after_s=2.0)
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    _lease(s, "n2", 0.0)
+    _lease(s, "n2", 50.0)
+    assert s.cache.nodes["n1"].node.spec.taints == ()
+    assert s.node_lifecycle.states == {}
 
 
 def test_preemptor_onto_tainted_node_evicts_cleanly():

@@ -138,6 +138,178 @@ def test_step_counts_relist_deliveries():
     assert not s.cache.nodes
 
 
+# ---------------------------------------------------------------------------
+# Generalized Reflector (ISSUE 9): the full object surface the plugins
+# consume — per-kind stores, relist-replace, stale retry, recovery.
+# ---------------------------------------------------------------------------
+
+from kubernetes_tpu.api import types as t  # noqa: E402
+from kubernetes_tpu.informers import (  # noqa: E402
+    KIND_HANDLERS,
+    ReflectorSet,
+    reconcile_after_recovery,
+)
+
+
+def _pv(name, cap=10):
+    return t.PersistentVolume(
+        name=name, capacity=cap, storage_class="standard"
+    )
+
+
+def _pvc(name, ns="default"):
+    return t.PersistentVolumeClaim(
+        name=name, namespace=ns, storage_class="standard", request=1
+    )
+
+
+def _pdb(name, allowed=2):
+    return t.PodDisruptionBudget(
+        name=name,
+        selector=t.LabelSelector(match_labels=(("app", "db"),)),
+        disruptions_allowed=allowed,
+    )
+
+
+def test_generalized_reflector_feeds_every_kind():
+    s = sched()
+    sources = {}
+    objs = {
+        "PersistentVolume": ("pv1", _pv("pv1")),
+        "PersistentVolumeClaim": ("default/c1", _pvc("c1")),
+        "StorageClass": ("standard", t.StorageClass(name="standard")),
+        "CSINode": ("n1", t.CSINode("n1", {"ebs": 4})),
+        "PodDisruptionBudget": ("db", _pdb("db")),
+        "ResourceClaim": (
+            "default/rc1", t.ResourceClaim(name="rc1", device_class="tpu")
+        ),
+        "ResourceSlice": (
+            "n1/tpu", t.ResourceSlice(node_name="n1", device_class="tpu",
+                                      count=4)
+        ),
+    }
+    for kind, (uid, obj) in objs.items():
+        src = FakeSource()
+        src.add(uid, obj)
+        sources[kind] = (src.lister, src.watcher)
+    # A pod referencing a PVC rides along: the set must deliver it LAST
+    # (a cold-start pod judged against empty catalogs would mis-classify
+    # its claims), with Node first.
+    nsrc, psrc = FakeSource(), FakeSource()
+    nsrc.add("n1", _node("n1"))
+    psrc.add(
+        "default/vp",
+        make_pod("vp").req({"cpu": "1"}).pvc_volume("c1").node("n1").obj(),
+    )
+    sources["Node"] = (nsrc.lister, nsrc.watcher)
+    sources["Pod"] = (psrc.lister, psrc.watcher)
+    rset = ReflectorSet(s, sources)
+    kinds_in_order = list(rset.reflectors)
+    assert kinds_in_order[0] == "Node" and kinds_in_order[-1] == "Pod"
+    assert rset.run_once() == len(objs) + 2
+    assert "default/vp" in s.cache.pods
+    vols = s.builder.volumes
+    assert "pv1" in vols.pvs and "default/c1" in vols.pvcs
+    assert "standard" in vols.classes and "n1" in vols.csinodes
+    assert "db" in s.pdbs
+    assert "default/rc1" in s.builder.dra.claims
+    assert ("n1", "tpu") in s.builder.dra.slices
+
+
+def test_pv_relist_replace_repairs_missed_delete():
+    s = sched()
+    src = FakeSource()
+    src.add("pv1", _pv("pv1"))
+    src.add("pv2", _pv("pv2"))
+    r = Reflector(s, "PersistentVolume", src.lister, src.watcher)
+    r.step()
+    assert set(s.builder.volumes.pvs) == {"pv1", "pv2"}
+    # Watch gap: pv2 deleted, pv3 added, history compacted — the stale
+    # resume point forces a relist and the REPLACE repairs the delete.
+    src.delete("pv2")
+    src.add("pv3", _pv("pv3"))
+    src.compact()
+    r.step()
+    assert r.relists == 1
+    assert set(s.builder.volumes.pvs) == {"pv1", "pv3"}
+    # The unbound index followed the delete (candidates_for reads it).
+    assert "pv2" not in s.builder.volumes.unbound.get("standard", {})
+
+
+def test_pvc_and_pdb_relist_replace():
+    s = sched()
+    csrc, bsrc = FakeSource(), FakeSource()
+    csrc.add("default/c1", _pvc("c1"))
+    csrc.add("default/c2", _pvc("c2"))
+    bsrc.add("db", _pdb("db"))
+    cr = Reflector(s, "PersistentVolumeClaim", csrc.lister, csrc.watcher)
+    br = Reflector(s, "PodDisruptionBudget", bsrc.lister, bsrc.watcher)
+    cr.step()
+    br.step()
+    assert set(s.builder.volumes.pvcs) == {"default/c1", "default/c2"}
+    assert "db" in s.pdbs
+    csrc.delete("default/c2")
+    csrc.compact()  # StaleResourceVersion → relist-and-replace
+    bsrc.delete("db")
+    cr.step()
+    br.step()
+    assert set(s.builder.volumes.pvcs) == {"default/c1"}
+    assert "db" not in s.pdbs
+
+
+def test_object_reflector_stale_version_retries():
+    s = sched()
+    src = FakeSource()
+    src.add("db", _pdb("db", allowed=1))
+    r = Reflector(s, "PodDisruptionBudget", src.lister, src.watcher)
+    r.step()
+    src.update("db", _pdb("db", allowed=5))
+    src.compact()
+    n = r.step()  # stale → relist delivers the update
+    assert n >= 1 and r.relists == 1
+    assert s.pdbs["db"].disruptions_allowed == 5
+
+
+def test_reconcile_after_recovery_relists_object_catalogs():
+    # A recovered scheduler reconciles PV/PVC/PDB alongside nodes/pods:
+    # catalogs repopulate from the LIST, pre-seeded strays are replaced.
+    s = sched()
+    s.add_pv(_pv("stale-pv"))  # pre-crash stray absent from host truth
+    s.add_pdb(_pdb("stale-db"))
+    nsrc, psrc = FakeSource(), FakeSource()
+    nsrc.add("n1", _node("n1"))
+    pvsrc, pvcsrc, pdbsrc = FakeSource(), FakeSource(), FakeSource()
+    pvsrc.add("pv1", _pv("pv1"))
+    pvcsrc.add("default/c1", _pvc("c1"))
+    pdbsrc.add("db", _pdb("db"))
+    stats = reconcile_after_recovery(
+        s,
+        Reflector(s, "Node", nsrc.lister, nsrc.watcher),
+        Reflector(s, "Pod", psrc.lister, psrc.watcher),
+        object_reflectors=(
+            Reflector(s, "PersistentVolume", pvsrc.lister, pvsrc.watcher),
+            Reflector(
+                s, "PersistentVolumeClaim", pvcsrc.lister, pvcsrc.watcher
+            ),
+            Reflector(
+                s, "PodDisruptionBudget", pdbsrc.lister, pdbsrc.watcher
+            ),
+        ),
+    )
+    assert stats["objects:PersistentVolume"] == 2  # stray delete + add
+    assert set(s.builder.volumes.pvs) == {"pv1"}
+    assert set(s.builder.volumes.pvcs) == {"default/c1"}
+    assert set(s.pdbs) == {"db"}
+
+
+def test_kind_handlers_cover_the_plugin_surface():
+    # The generalized surface must carry every catalog the plugins read.
+    assert set(KIND_HANDLERS) == {
+        "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
+        "CSINode", "PodDisruptionBudget", "ResourceClaim", "ResourceSlice",
+    }
+
+
 def test_relist_restarts_resync_period():
     # Regression (r5 review): a relist re-delivered everything; the
     # resync timer restarts so the next step doesn't double-deliver.

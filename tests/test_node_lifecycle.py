@@ -1,0 +1,250 @@
+"""The failure-response loop's durable half (ISSUE 9): journaled taint
+writes and evict-with-requeue records replay deterministically, the
+recovered-taints overlay survives a LIST reconcile, and Leases flow over
+the wire."""
+
+import os
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.controllers import (
+    NODE_NOT_READY,
+    NOT_READY_TAINT_KEY,
+    UNREACHABLE_TAINT_KEY,
+)
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.journal import Journal, recover
+from kubernetes_tpu.scheduler import TPUScheduler
+
+
+def _sched():
+    s = TPUScheduler(
+        profile=Profile(
+            name="fit-taints",
+            filters=(
+                "NodeUnschedulable", "NodeName", "TaintToleration",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+    )
+    s.node_lifecycle.arm(grace_period_s=5.0, unreachable_after_s=12.0)
+    s.pod_gc.arm(gc_horizon_s=20.0)
+    return s
+
+
+def _graced_pod(name, seconds, node="n1"):
+    return (
+        make_pod(name).req({"cpu": "1"})
+        .toleration(NOT_READY_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+        .toleration(UNREACHABLE_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+        .node(node).obj()
+    )
+
+
+def _checkpoint(s):
+    """Snapshot the pre-incident world so the taint/evict RECORDS (not a
+    later snapshot) are what recovery replays."""
+    from kubernetes_tpu import journal as journal_mod
+
+    s.journal.snapshot(journal_mod.scheduler_state(s))
+
+
+def _drive_to_eviction(s):
+    """n1 goes silent; n2 renews to logical 10 — NotReady taint written
+    (journaled) and the graced pod evicted + requeued (journaled)."""
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(_graced_pod("p", 3))
+    _checkpoint(s)
+    s.renew_node_lease(t.Lease("n1", 0.0))
+    s.renew_node_lease(t.Lease("n2", 0.0))
+    for ts in (2.0, 4.0, 6.0, 8.0, 10.0):
+        s.renew_node_lease(t.Lease("n2", ts))
+    assert s.node_lifecycle.states == {"n1": NODE_NOT_READY}
+    assert "default/p" not in s.cache.pods  # evicted (grace 6+3 <= 10)
+    assert s.taint_eviction.evictions == 1
+
+
+def test_taint_and_evict_records_replay(tmp_path):
+    jdir = str(tmp_path / "j")
+    s = _sched()
+    s.attach_journal(Journal(jdir, fsync=False))
+    _drive_to_eviction(s)
+    s.journal.close()
+    # A fresh process recovers from the journal alone: the taint record
+    # re-applies through the same update path (lifecycle state adopted),
+    # the evict record re-queues the pod, and the incident counters
+    # survive the crash.
+    s2 = _sched()
+    j2 = Journal(jdir, fsync=False)
+    recover(s2, j2)
+    assert s2.node_lifecycle.states == {"n1": NODE_NOT_READY}
+    keys = {ta.key for ta in s2.cache.nodes["n1"].node.spec.taints}
+    assert keys == {NOT_READY_TAINT_KEY}
+    assert "default/p" in s2.queue._info  # requeued, unbound
+    assert "default/p" not in s2.cache.pods
+    assert s2.taint_eviction.evictions == 1  # restored from the record
+    # The requeued pod reschedules onto the survivor.
+    out = s2.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.pod.uid == "default/p" and o.node_name]
+    assert placed and placed[0].node_name == "n2"
+
+
+def test_reconcile_overlay_preserves_journaled_taints(tmp_path):
+    # Host truth relists the dead node in its ORIGINAL untainted shape
+    # (the apiserver analog never saw our in-process taint write) — the
+    # recovered-taints overlay must keep the journal-authored lifecycle
+    # taints, or the LIST-replace would heal the dead node.
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+
+    jdir = str(tmp_path / "j")
+    s = _sched()
+    s.attach_journal(Journal(jdir, fsync=False))
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(_graced_pod("slow", 60))  # armed but far from due
+    _checkpoint(s)
+    s.renew_node_lease(t.Lease("n1", 0.0))
+    s.renew_node_lease(t.Lease("n2", 0.0))
+    s.renew_node_lease(t.Lease("n2", 7.0))  # NotReady taint written
+    assert "default/slow" in s.taint_eviction.pending
+    s.journal.close()
+    s2 = _sched()
+    recover(s2, Journal(jdir, fsync=False))
+    nsrc, psrc = FakeSource(), FakeSource()
+    nsrc.add("n1", make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    nsrc.add("n2", make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    psrc.add("default/slow", _graced_pod("slow", 60))
+    reconcile_after_recovery(
+        s2,
+        Reflector(s2, "Node", nsrc.lister, nsrc.watcher),
+        Reflector(s2, "Pod", psrc.lister, psrc.watcher),
+    )
+    keys = {ta.key for ta in s2.cache.nodes["n1"].node.spec.taints}
+    assert keys == {NOT_READY_TAINT_KEY}  # the overlay held
+    assert "default/slow" in s2.taint_eviction.pending  # still armed
+    assert s2.cache.nodes["n2"].node.spec.taints == ()
+
+
+def test_recovery_continues_logical_clock_without_instant_evictions(tmp_path):
+    # Review regression: the feed's clock keeps running across a restart.
+    # The snapshot carries heartbeats + the clock high-water mark and the
+    # taint records carry their write ts, so a recovered process re-arms
+    # pending graces against the INCIDENT's clock — the first
+    # post-restart renewal (ts ≈ where the feed left off) must not fire
+    # a restored 60s grace instantly.
+    jdir = str(tmp_path / "j")
+    s = _sched()
+    s.attach_journal(Journal(jdir, fsync=False))
+    s.add_node(make_node("n1").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(_graced_pod("slow", 60))
+    _checkpoint(s)  # heartbeats empty at the barrier
+    s.renew_node_lease(t.Lease("n1", 1000.0))
+    s.renew_node_lease(t.Lease("n2", 1000.0))
+    s.renew_node_lease(t.Lease("n2", 1007.0))  # NotReady written at 1007
+    assert s.taint_eviction.pending["default/slow"][1] >= 1067.0
+    _checkpoint(s)  # clock + heartbeats now in the snapshot
+    s.renew_node_lease(t.Lease("n2", 1008.0))
+    s.journal.close()
+    s2 = _sched()
+    recover(s2, Journal(jdir, fsync=False))
+    assert s2.node_lifecycle.now() >= 1007.0  # clock continued, not 0
+    assert s2.node_lifecycle.heartbeats.get("n2", 0.0) >= 1007.0
+    # The feed resumes where it left off: no instant eviction.
+    s2.renew_node_lease(t.Lease("n2", 1010.0))
+    assert "default/slow" in s2.cache.pods
+    assert "default/slow" in s2.taint_eviction.pending
+    # n1 crosses Unreachable at 1014: the taint SWAP re-arms the grace
+    # (per-taint clocks — the new taint starts fresh at ~1014).
+    s2.renew_node_lease(t.Lease("n2", 1014.0))
+    assert "default/slow" in s2.cache.pods
+    # The grace still fires when genuinely due on the same clock.
+    s2.renew_node_lease(t.Lease("n2", 1014.0 + 61.0))
+    assert "default/slow" not in s2.cache.pods
+
+
+def test_recovered_orphan_binding_requeues_through_gc(tmp_path):
+    # A journaled bind whose node never relists: the armed pod-GC
+    # requeues the pod (journaled evict) instead of dropping it.
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+
+    jdir = str(tmp_path / "j")
+    s = _sched()
+    s.attach_journal(Journal(jdir, fsync=False))
+    s.add_node(make_node("gone").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    s.add_pod(make_pod("orphan").req({"cpu": "1"}).obj())
+    out = s.schedule_all_pending(wait_backoff=True)
+    assert any(o.pod.name == "orphan" and o.node_name for o in out)
+    s.journal.close()
+    s2 = _sched()
+    j2 = Journal(jdir, fsync=False)
+    recover(s2, j2)  # before attach — replay must not re-journal
+    s2.attach_journal(j2)
+    nsrc, psrc = FakeSource(), FakeSource()
+    nsrc.add("n2", make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    psrc.add("default/orphan", make_pod("orphan").req({"cpu": "1"}).obj())
+    stats = reconcile_after_recovery(
+        s2,
+        Reflector(s2, "Node", nsrc.lister, nsrc.watcher),
+        Reflector(s2, "Pod", psrc.lister, psrc.watcher),
+    )
+    # The bind parked (node gone) and the GC requeued it.
+    assert (
+        stats["late_bindings_requeued"] == 1
+        or "default/orphan" in s2.queue._info
+    )
+    assert s2.pod_gc.collected["orphaned"] >= 0
+    out = s2.schedule_all_pending(wait_backoff=True)
+    placed = [o for o in out if o.pod.uid == "default/orphan" and o.node_name]
+    assert placed and placed[0].node_name == "n2"
+
+
+def test_lease_flows_over_the_wire(tmp_path):
+    # The Lease kind rides the sidecar's AddObject surface end to end:
+    # renewals over the socket drive the server's lifecycle controller.
+    from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+    path = os.path.join(str(tmp_path), "sidecar.sock")
+    srv = SidecarServer(path, scheduler=_sched())
+    srv.serve_background()
+    try:
+        client = SidecarClient(path)
+        client.add(
+            "Node", make_node("w1").capacity({"cpu": "8", "pods": 110}).obj()
+        )
+        client.add(
+            "Node", make_node("w2").capacity({"cpu": "8", "pods": 110}).obj()
+        )
+        client.add("Lease", t.Lease("w1", 0.0))
+        client.add("Lease", t.Lease("w2", 0.0))
+        client.add("Lease", t.Lease("w2", 7.0))
+        dump = client.dump()
+        assert dump["node_lifecycle"]["states"]["notready"] == 1
+        assert dump["node_lifecycle"]["armed"] is True
+        client.close()
+    finally:
+        srv.close()
+
+
+def test_evict_pod_with_supplied_object_requeues_unknown_uid():
+    s = _sched()
+    s.add_node(make_node("n2").capacity({"cpu": "8", "pods": 110}).obj())
+    ghost = make_pod("ghost").req({"cpu": "1"}).node("gone-node").obj()
+    assert s.evict_pod("default/ghost") is False  # unknown, no object
+    assert s.evict_pod("default/ghost", pod=ghost) is True
+    qp = s.queue._info.get("default/ghost")
+    assert qp is not None and qp.pod.spec.node_name == ""

@@ -85,15 +85,22 @@ def test_repo_baseline_entries_are_justified():
 def test_wal_rules_fire_on_seeded_violations():
     got = rules_of(lint("wal_bad"))
     # One of each in the scheduler fixture + one of each in the fleet
-    # handoff fixture (apply_handoff is an apply marker).
-    assert got.count("wal-apply-before-journal") == 2
-    assert got.count("wal-unjournaled-apply") == 2
-    assert len(got) == 4, got  # the healthy shapes stay silent
+    # handoff fixture (apply_handoff is an apply marker) + one of each
+    # in the failure-response fixture (_apply_node_taints /
+    # _apply_eviction are apply markers, ISSUE 9).
+    assert got.count("wal-apply-before-journal") == 3
+    assert got.count("wal-unjournaled-apply") == 3
+    assert len(got) == 6, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
     paths = {f.path for f in lint("wal_bad").findings}
     assert "kubernetes_tpu/fleet/owner.py" in paths
+
+
+def test_wal_rules_cover_failure_response_controllers():
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/controllers.py" in paths
 
 
 def test_wal_negative_tree_is_clean():
