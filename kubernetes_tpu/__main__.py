@@ -183,12 +183,16 @@ def _open_journal(journal_dir: str, fsync: bool):
     return lease, journal
 
 
-def _fleet_owner_for(args, sched):
+def _fleet_owner_for(args, sched, lifecycle=None):
     """serve --shard-of k/N: bind this process to one shard of the
     partitioned fleet — load (or initialize) the shard map, install the
     shard guard, and return the ShardOwner the `fleet` frame dispatches
     through.  The serve journal (--journal-dir) doubles as the shard's
-    WAL; the shard map file is shared by every owner and the router."""
+    WAL; the shard map file is shared by every owner and the router.
+    ``lifecycle`` arms the PER-OWNER failure-response loop (ISSUE 10):
+    the shard judges its own nodes from the Lease frames the router
+    routes here, and its evictions ride fleet responses back to the
+    router for fleet-wide requeue."""
     from .fleet import ShardMap, ShardOwner
 
     k, _, n = args.shard_of.partition("/")
@@ -200,7 +204,7 @@ def _fleet_owner_for(args, sched):
     else:
         shard_map = ShardMap(n_shards=n_shards)
         shard_map.save(args.shard_map)
-    return ShardOwner(shard_id, sched, shard_map)
+    return ShardOwner(shard_id, sched, shard_map, lifecycle=lifecycle)
 
 
 def cmd_serve(args) -> int:
@@ -208,8 +212,26 @@ def cmd_serve(args) -> int:
 
     sched = _build_scheduler(args)
     node_grace = getattr(args, "node_grace_s", 0.0)
+    lifecycle = None
     if node_grace > 0:
-        # Arm the failure-response loop (ISSUE 9): heartbeat staleness →
+        lifecycle = {
+            "node_grace_s": node_grace,
+            "node_unreachable_s": getattr(args, "node_unreachable_s", 0.0),
+            "gc_horizon_s": getattr(args, "gc_horizon_s", 0.0),
+        }
+    fleet_owner = None
+    if args.shard_of:
+        if not args.journal_dir:
+            # The serve journal doubles as the shard's WAL; an owner
+            # without one would silently no-op every gang_reserve/bind/
+            # handoff append the fleet's convergence story depends on.
+            raise SystemExit("--shard-of requires --journal-dir")
+        # The lifecycle flags arm PER OWNER (ShardOwner installs the
+        # eviction-requeue hook the router drains) — before ISSUE 10 the
+        # arming below was single-process only.
+        fleet_owner = _fleet_owner_for(args, sched, lifecycle=lifecycle)
+    elif lifecycle is not None:
+        # Single-process arming (ISSUE 9): heartbeat staleness →
         # NotReady/Unreachable taints → tolerationSeconds eviction →
         # requeue, plus the pod-GC horizon sweep.
         sched.node_lifecycle.arm(
@@ -221,14 +243,6 @@ def cmd_serve(args) -> int:
         sched.pod_gc.arm(
             gc_horizon_s=getattr(args, "gc_horizon_s", 0.0) or node_grace * 6
         )
-    fleet_owner = None
-    if args.shard_of:
-        if not args.journal_dir:
-            # The serve journal doubles as the shard's WAL; an owner
-            # without one would silently no-op every gang_reserve/bind/
-            # handoff append the fleet's convergence story depends on.
-            raise SystemExit("--shard-of requires --journal-dir")
-        fleet_owner = _fleet_owner_for(args, sched)
     lease = None
     if args.leader_elect:
         # Single-active-sidecar guarantee (cmd-level leaderElectAndRun,
@@ -437,6 +451,36 @@ def cmd_fleet(args) -> int:
         doc["shard_buckets"] = {
             str(s): sum(1 for b in m.buckets if b == s) for s in m.shard_ids()
         }
+        if args.sockets:
+            # Live per-owner state over the wire (`serve --shard-of`
+            # children): nodes/bindings plus the failure-response block —
+            # armed flag, ready/notready/unreachable counts, eviction and
+            # GC counters, requeues the router has not drained yet.
+            from .sidecar import SidecarClient
+
+            owners = {}
+            for sock in args.sockets.split(","):
+                sock = sock.strip()
+                if not sock:
+                    continue
+                try:
+                    client = SidecarClient(
+                        sock, deadline_s=_cli_deadline(args)
+                    )
+                    try:
+                        stats = client.fleet("stats", {})
+                    finally:
+                        client.close()
+                    owners[sock] = {
+                        "shard": stats.get("shard"),
+                        "nodes": stats.get("nodes"),
+                        "bound_pods": stats.get("bound_pods"),
+                        "epoch": stats.get("epoch"),
+                        "lifecycle": stats.get("lifecycle", {}),
+                    }
+                except (OSError, RuntimeError) as exc:
+                    owners[sock] = {"unreachable": str(exc)}
+            doc["owners"] = owners
         print(json.dumps(doc, indent=1, sort_keys=True))
         return 0
     if args.action == "split":
@@ -558,7 +602,10 @@ def main(argv: list[str] | None = None) -> int:
         help="arm the node-lifecycle controller: a Lease-tracked node "
         "whose heartbeat is older than this (on the logical Lease clock) "
         "is tainted NotReady, its pods evicted after tolerationSeconds "
-        "and requeued (0 = disarmed, the consumer-only behavior)",
+        "and requeued (0 = disarmed, the consumer-only behavior); with "
+        "--shard-of the loop arms PER OWNER — the router routes Lease "
+        "frames to the owning shard and requeues its evictions "
+        "fleet-wide",
     )
     s.add_argument(
         "--node-unreachable-s", type=float, default=0.0, metavar="SECONDS",
@@ -602,6 +649,18 @@ def main(argv: list[str] | None = None) -> int:
                      help="surviving shard (merge)")
     fle.add_argument("--absorbed", type=int, default=1,
                      help="shard being absorbed (merge)")
+    fle.add_argument(
+        "--sockets", default="", metavar="SOCK,SOCK,...",
+        help="status only: also query these live `serve --shard-of` "
+        "owners over the wire and report per-owner node/binding counts "
+        "plus lifecycle state (armed, ready/notready/unreachable, "
+        "evictions, pending requeues)",
+    )
+    fle.add_argument(
+        "--deadline", type=float, default=5.0,
+        help="per-owner probe deadline in seconds (status --sockets); "
+        "<=0 waits forever",
+    )
     fle.set_defaults(fn=cmd_fleet)
 
     rec = sub.add_parser(

@@ -23,10 +23,16 @@ speculative frontend therefore must not:
   (Named variables of set type are invisible to a syntactic pass; the
   speculative frontend's documented commit-order iteration is exactly
   the idiom this rule pushes toward.)
-- key on ``id()`` — CPython address order varies per process.
+- key on ``id()`` — CPython address order varies per process;
+- route or bucket by builtin ``hash()`` — string hashing is salted per
+  process (PYTHONHASHSEED), so a router hashing a pod uid or a shard
+  map hashing a node name with it would assign DIFFERENT owners in
+  different processes: the fleet's Lease frames, home-shard routing and
+  ownership records all key on ``zlib.crc32`` (shardmap.py
+  ``stable_shard_hash``) for exactly this reason.
 
 Findings: ``det-wallclock``, ``det-random``, ``det-set-iteration``,
-``det-id-key``.
+``det-id-key``, ``det-builtin-hash``.
 """
 
 from __future__ import annotations
@@ -132,6 +138,24 @@ class DeterminismRule(Rule):
                     )
                 )
         if isinstance(call.func, ast.Name):
+            if call.func.id == "hash" and len(call.args) == 1:
+                out.append(
+                    Finding(
+                        rule="det-builtin-hash",
+                        path=path,
+                        line=call.lineno,
+                        message=(
+                            "builtin hash() in a determinism-critical "
+                            "module — string hashing is salted per "
+                            "process (PYTHONHASHSEED); route/bucket with "
+                            "zlib.crc32 (fleet/shardmap.py "
+                            "stable_shard_hash) instead"
+                        ),
+                        key=make_key(
+                            "det-builtin-hash", path, f"hash:{call.lineno}"
+                        ),
+                    )
+                )
             if call.func.id == "id" and len(call.args) == 1:
                 out.append(
                     Finding(

@@ -475,7 +475,11 @@ class NodeLifecycleController:
 
     def stats(self) -> dict:
         counts = {NODE_READY: 0, NODE_NOT_READY: 0, NODE_UNREACHABLE: 0}
-        for name in self.heartbeats:
+        # Heartbeat-tracked nodes PLUS nodes whose state was adopted
+        # from taints before any renewal arrived (a takeover's relist,
+        # a survivor's mid-incident absorb): `fleet status` must report
+        # an adopted dead node as unreachable, not omit it.
+        for name in sorted(set(self.heartbeats) | set(self.states)):
             counts[self.states.get(name, NODE_READY)] += 1
         return {
             "armed": self.armed,

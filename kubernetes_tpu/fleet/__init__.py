@@ -31,5 +31,10 @@ failover (scripts/run_fault_matrix.py --kill)."""
 
 from .router import FleetRouter  # noqa: F401
 from .shardmap import ShardMap  # noqa: F401
-from .owner import ShardOwner, WireShardOwner, fleet_dispatch  # noqa: F401
+from .owner import (  # noqa: F401
+    FleetOwnerUnreachable,
+    ShardOwner,
+    WireShardOwner,
+    fleet_dispatch,
+)
 from .takeover import absorb_shard, recover_shard  # noqa: F401

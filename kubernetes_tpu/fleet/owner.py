@@ -42,6 +42,7 @@ class ShardOwner:
         state_dir: str | None = None,
         journal_fsync: bool = True,
         snapshot_every_batches: int = 8,
+        lifecycle: dict | None = None,
     ) -> None:
         self.shard_id = shard_id
         self.sched = scheduler
@@ -52,6 +53,40 @@ class ShardOwner:
         self.recovery_stats: dict | None = None
         self.handoffs_in = 0
         self.handoffs_out = 0
+        # Evictions the shard's OWN controllers decided (node-lifecycle
+        # taint eviction, pod GC): the owner's local queue is never
+        # drained by the router, so the evicted pod rides the next fleet
+        # response back to the router, which requeues it fleet-wide —
+        # the cross-shard half of the failure-response loop.  Journal
+        # replay routes here too (takeover surfaces crash-interrupted
+        # evictions instead of stranding them).
+        self.evictions_out: list[dict] = []
+        # Replay-surfaced evictions (journal recovery re-applied an
+        # ``evict`` record): held apart from the live buffer so they
+        # NEVER ride an ordinary response during the takeover's host-
+        # truth re-feed — only the adopting router's explicit
+        # drain_evictions takes them, and that path filters entries
+        # whose pod already rebound (a later bind record).
+        self.recovered_evictions: list[dict] = []
+        scheduler.eviction_requeue_hook = self._on_eviction
+        # Per-owner failure-response loop: the shard's lifecycle
+        # controller judges ITS nodes from the Lease frames the router
+        # routes here.  Armed BEFORE recovery — replayed taint/evict
+        # records must apply under the armed clock semantics.
+        if lifecycle and lifecycle.get("node_grace_s", 0) > 0:
+            grace = float(lifecycle["node_grace_s"])
+            scheduler.node_lifecycle.arm(
+                grace_period_s=grace,
+                unreachable_after_s=(
+                    float(lifecycle.get("node_unreachable_s") or 0)
+                    or grace * 2.5
+                ),
+            )
+            scheduler.pod_gc.arm(
+                gc_horizon_s=(
+                    float(lifecycle.get("gc_horizon_s") or 0) or grace * 6
+                )
+            )
         if shard_map is not None:
             scheduler.shard_guard = (
                 lambda name: shard_map.owner_of(name) == shard_id
@@ -75,10 +110,89 @@ class ShardOwner:
             scheduler.attach_journal(
                 self.journal, snapshot_every_batches=snapshot_every_batches
             )
+        # Journal-authored lifecycle taints must survive the takeover's
+        # host-truth node re-feed (the apiserver would have carried the
+        # controller's PATCH, so a relist delivers them; here the
+        # replayed journal is that authority).  Same overlay contract as
+        # informers.Reflector.recovered_taints, applied at the owner's
+        # add surface because the fleet re-feed bypasses the Reflector.
+        # `serve --shard-of` recovers through the SERVE journal AFTER
+        # this constructor runs — SidecarServer refreshes the overlay
+        # once its recovery completes.
+        self._recovered_taints: dict[str, tuple] = {}
+        self.refresh_recovered_taints()
+
+    def refresh_recovered_taints(self) -> None:
+        """Snapshot the journal-recovered lifecycle taints for the
+        host-truth re-feed overlay.  Called at construction (the
+        state_dir recovery path has already replayed by then) and again
+        by SidecarServer after a `serve --shard-of` recovery (which runs
+        AFTER this owner is built, against the serve journal)."""
+        from ..controllers import LIFECYCLE_TAINT_KEYS
+
+        for name, rec in self.sched.cache.nodes.items():
+            recovered = tuple(
+                taint
+                for taint in rec.node.spec.taints
+                if taint.key in LIFECYCLE_TAINT_KEYS
+            )
+            if recovered:
+                self._recovered_taints[name] = recovered
+
+    # -- the failure-response loop (per-owner lifecycle) -------------------
+
+    def _on_eviction(self, uid: str, pod: t.Pod, reason: str) -> None:
+        """scheduler.eviction_requeue_hook: buffer the evicted (now
+        unbound) pod for the router — it rides the next fleet response
+        (fleet_dispatch attaches ``evicted``) and requeues fleet-wide.
+        PDB budgets are debited here and broadcast by the router, the
+        same cluster-global bookkeeping a cross-shard preemption gets
+        (taint eviction is a disruption like any other; the single
+        scheduler sees every pod, so its disruption controller recomputes
+        — a partition cannot, hence the explicit debit)."""
+        debits = self.sched.debit_matching_pdbs(pod)
+        bucket = (
+            self.recovered_evictions
+            if getattr(self.sched, "_in_recovery", False)
+            else self.evictions_out
+        )
+        bucket.append(
+            {
+                "uid": uid,
+                "pod": serialize.to_dict(pod),
+                "reason": reason,
+                "group": pod.spec.pod_group or "",
+                "pdb_debits": [
+                    {"name": n, "n": c} for n, c in sorted(debits.items())
+                ],
+            }
+        )
+
+    def drain_evictions(self) -> list[dict]:
+        """Everything pending: the replay-surfaced bucket first (the
+        incident predates whatever fired live since), then the live
+        buffer."""
+        out = self.recovered_evictions + self.evictions_out
+        self.recovered_evictions = []
+        self.evictions_out = []
+        return out
 
     # -- object feed -------------------------------------------------------
 
     def add_object(self, kind: str, obj) -> None:
+        if kind == "Node" and self._recovered_taints:
+            recovered = self._recovered_taints.pop(obj.name, None)
+            if recovered:
+                import copy
+
+                from ..controllers import LIFECYCLE_TAINT_KEYS
+
+                obj = copy.deepcopy(obj)
+                obj.spec.taints = tuple(
+                    taint
+                    for taint in obj.spec.taints
+                    if taint.key not in LIFECYCLE_TAINT_KEYS
+                ) + tuple(recovered)
         getattr(self.sched, serialize.KINDS[kind][1])(obj)
 
     def remove_object(self, kind: str, uid: str) -> dict | None:
@@ -229,6 +343,10 @@ class ShardOwner:
         }
 
     def stats(self) -> dict:
+        # serve --shard-of owners journal through the SERVE journal
+        # (scheduler.attach_journal), not an owner-held one — report
+        # whichever is armed.
+        journal = self.journal or getattr(self.sched, "journal", None)
         out = {
             "shard": self.shard_id,
             "nodes": len(self.sched.cache.nodes),
@@ -238,10 +356,29 @@ class ShardOwner:
             "rejected_nodes": self.sched.shard_rejected_nodes,
             "handoffs_in": self.handoffs_in,
             "handoffs_out": self.handoffs_out,
-            "epoch": self.lease.epoch if self.lease else 0,
+            "epoch": (
+                self.lease.epoch
+                if self.lease
+                else getattr(journal, "epoch", 0)
+            ),
+            # Per-owner failure-response state (`fleet status` renders
+            # this): armed flag, ready/notready/unreachable counts, the
+            # logical clock, eviction/GC counters, pending requeues the
+            # router has not yet drained.
+            "lifecycle": {
+                "armed": self.sched.node_lifecycle.armed,
+                "states": self.sched.node_lifecycle.stats()["states"],
+                "logical_now": self.sched.node_lifecycle.now(),
+                "transitions": self.sched.node_lifecycle.transitions,
+                "taint_evictions": self.sched.taint_eviction.evictions,
+                "pod_gc_collected": dict(self.sched.pod_gc.collected),
+                "pending_eviction_requeues": (
+                    len(self.evictions_out) + len(self.recovered_evictions)
+                ),
+            },
         }
-        if self.journal is not None:
-            out["journal"] = self.journal.stats()
+        if journal is not None:
+            out["journal"] = journal.stats()
         if self.recovery_stats is not None:
             out["recovery"] = self.recovery_stats
         return out
@@ -253,10 +390,71 @@ class ShardOwner:
             self.lease.release()
 
 
+# Ops whose handling can FIRE controller evictions on the owner (a Lease
+# renewal ticking the lifecycle loop, a taint-carrying node update, a
+# commit onto a NoExecute-tainted node, an imported incident, a replayed
+# journal surfacing at reconcile): their responses carry the drained
+# eviction buffer so the router requeues fleet-wide without an extra
+# round trip.  Read-only ops (stats/bindings/propose) never drain — a
+# CLI probe must not swallow evictions the router is owed.
+_EVICTION_BEARING_OPS = frozenset(
+    {
+        "add",
+        "remove",
+        "tick",
+        "import_nodes",
+        "reconcile",
+        "commit",
+        "commit_reserved",
+        "preempt_execute",
+    }
+)
+
+
 def fleet_dispatch(owner: ShardOwner, op: str, payload: dict) -> dict:
     """The wire entry point: one ``fleet`` Envelope frame = one op.
     Pods ride as canonical JSON dicts (the AddObject convention); every
     response is a JSON-clean dict."""
+    res = _dispatch_op(owner, op, payload)
+    if owner.evictions_out and op in _EVICTION_BEARING_OPS:
+        # Live evictions only — the recovered bucket waits for the
+        # explicit drain (its staleness filter needs adopted routing).
+        # COPIED, not cleared: the buffer empties only on the router's
+        # ``ack_evictions`` — a response lost to a deadline would
+        # otherwise take the only copy with it, and the idempotent
+        # retry's empty response would leave the pod unbound forever.
+        # Re-delivery is safe: the router dedupes on evicted_pending.
+        res = dict(res)
+        res["evicted"] = list(owner.evictions_out)
+    return res
+
+
+def _dispatch_op(owner: ShardOwner, op: str, payload: dict) -> dict:
+    if op == "drain_evictions":
+        # The explicit drain (router takeover/adopt): crash-interrupted
+        # evictions the journal replay re-surfaced come back to whichever
+        # router adopts the shard.  Copied, ack-cleared — like the live
+        # attach above.
+        return {"evicted": owner.recovered_evictions + owner.evictions_out}
+    if op == "ack_evictions":
+        # The router durably absorbed (queued or staleness-filtered)
+        # these evictions; stop re-delivering them.  Idempotent.
+        acked = set(payload.get("uids", ()))
+        owner.evictions_out = [
+            e for e in owner.evictions_out if e["uid"] not in acked
+        ]
+        owner.recovered_evictions = [
+            e for e in owner.recovered_evictions if e["uid"] not in acked
+        ]
+        return {}
+    if op == "tick":
+        # A fleet-wide logical-clock advance (the router saw a renewal
+        # elsewhere): judge this shard's nodes at the new clock.  No-op
+        # while disarmed — and for an armed shard this is exactly how a
+        # shard whose only leased node died learns that time passed.
+        return {
+            "fired": owner.sched.node_lifecycle.tick(payload.get("now"))
+        }
     if op == "propose":
         return owner.propose(serialize.pod_from_data(payload["pod"]))
     if op == "commit":
@@ -327,16 +525,134 @@ def fleet_dispatch(owner: ShardOwner, op: str, payload: dict) -> dict:
     raise ValueError(f"unknown fleet op {op!r}")
 
 
+class FleetOwnerUnreachable(ConnectionError):
+    """A wire shard owner exhausted its deadline/retry budget (hung, or
+    dead and not coming back on reconnect).  The fleet's answer is
+    TAKEOVER (fleet/takeover.py) — restart or survivor-absorb the shard
+    behind an epoch bump — never host-side scheduling around it."""
+
+
+# Ops a WireShardOwner must NOT blindly re-issue after a connection
+# failure: the first attempt may have applied server-side (a commit that
+# landed before the response was lost would double-assume on retry).
+# The fleet-level recovery path — takeover + journal replay + idempotent
+# re-feed — resolves their fate instead.
+_NON_RETRIABLE_OPS = frozenset(
+    {
+        "commit",
+        "commit_reserved",
+        "reserve",
+        "abort",
+        "preempt_execute",
+        "import_nodes",
+        "drop_nodes",
+        "pdb_debit",
+    }
+)
+
+
 class WireShardOwner:
     """A shard owner behind the sidecar socket (``serve --shard-of``):
     the same ``call`` surface as an in-process ShardOwner, carried by the
     ``fleet`` Envelope frame (sidecar/server.py).  The router cannot tell
     the difference — which is the point: the in-process fleet the tests
     oracle against and the multi-process fleet an operator deploys run
-    the same protocol."""
+    the same protocol.
 
-    def __init__(self, client) -> None:
+    Every call is bounded by the client's per-call deadline; a timeout
+    or dropped connection on an idempotent op reconnects and retries up
+    to ``max_retries`` times (counted as ``scheduler_fleet_call_*``),
+    then — or immediately for non-idempotent ops — raises
+    ``FleetOwnerUnreachable`` so the driver degrades to takeover instead
+    of wedging scatter-gather on one hung owner forever."""
+
+    def __init__(
+        self,
+        client=None,
+        *,
+        path: str | None = None,
+        deadline_s: float | None = None,
+        max_retries: int = 2,
+        registry=None,
+        shard_id: int | None = None,
+    ) -> None:
+        if client is None:
+            if path is None:
+                raise ValueError("WireShardOwner needs a client or a path")
+            from ..sidecar.server import SidecarClient
+
+            client = SidecarClient(path, deadline_s=deadline_s)
         self.client = client  # SidecarClient / ResyncingClient
+        self.path = path
+        self.deadline_s = deadline_s
+        self.max_retries = max_retries
+        self.shard_id = shard_id
+        if registry is None:
+            from ..framework.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._timeouts = registry.counter(
+            "scheduler_fleet_call_timeouts_total",
+            "Wire fleet-protocol calls that exceeded the per-call "
+            "deadline, by op.",
+        )
+        self._retry_counter = registry.counter(
+            "scheduler_fleet_call_retries_total",
+            "Wire fleet-protocol calls re-issued after a timeout or "
+            "dropped connection, by op.",
+        )
+
+    def _reconnect(self) -> None:
+        from ..sidecar.server import SidecarClient
+
+        try:
+            self.client.close()
+        except OSError:
+            pass
+        self.client = SidecarClient(self.path, deadline_s=self.deadline_s)
+
+    def close(self) -> None:
+        try:
+            self.client.close()
+        except OSError:
+            pass
 
     def call(self, op: str, payload: dict) -> dict:
-        return self.client.fleet(op, payload)
+        from ..sidecar.server import DeadlineExceeded
+
+        attempts = 0
+        while True:
+            try:
+                return self.client.fleet(op, payload)
+            except (ConnectionError, TimeoutError, OSError) as exc:
+                if isinstance(exc, (DeadlineExceeded, TimeoutError)):
+                    self._timeouts.inc(op=op)
+                shard = (
+                    f"shard {self.shard_id}"
+                    if self.shard_id is not None
+                    else "shard owner"
+                )
+                if (
+                    op in _NON_RETRIABLE_OPS
+                    or attempts >= self.max_retries
+                    or self.path is None
+                ):
+                    err = FleetOwnerUnreachable(
+                        f"{shard}: fleet op {op!r} failed after "
+                        f"{attempts + 1} attempt(s) ({exc}) — take the "
+                        "shard over"
+                    )
+                    err.shard_id = self.shard_id
+                    raise err from exc
+                attempts += 1
+                self._retry_counter.inc(op=op)
+                try:
+                    self._reconnect()
+                except OSError as rexc:
+                    err = FleetOwnerUnreachable(
+                        f"{shard}: reconnect for fleet op {op!r} refused "
+                        f"({rexc}) — take the shard over"
+                    )
+                    err.shard_id = self.shard_id
+                    raise err from rexc

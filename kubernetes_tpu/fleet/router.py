@@ -176,12 +176,45 @@ class FleetRouter:
             "scheduler_fleet_gang_commits_total",
             "Gang 2PC phase transitions, by phase (reserve/commit/abort).",
         )
+        # -- the fleet-native failure-response loop -----------------------
+        self._lease_frames = registry.counter(
+            "scheduler_fleet_lifecycle_lease_frames_total",
+            "Lease renewals routed to the owning shard's lifecycle "
+            "controller, by shard.",
+        )
+        self._lifecycle_evictions = registry.counter(
+            "scheduler_fleet_lifecycle_evictions_total",
+            "Controller evictions absorbed from shard owners and "
+            "requeued fleet-wide, by shard (the shard that evicted).",
+        )
+        self._lifecycle_rebinds = registry.counter(
+            "scheduler_fleet_lifecycle_rebinds_total",
+            "Evicted pods rebound through the router, by whether the "
+            "new shard differs from the evicting one (cross_shard).",
+        )
+        # Evicted pods absorbed but not yet rebound: uid → (pod, the
+        # shard that evicted it).  A cold router restart re-adopts these
+        # (readopt_evictions) the way `pending` pods re-feed.
+        self.evicted_pending: dict[str, tuple[t.Pod, int]] = {}
+        # True only inside drain_evictions (takeover/adopt): replayed
+        # evict records whose pod REBOUND before the crash are stale —
+        # the just-adopted _pod_shard is owner truth there, so bound
+        # uids are skipped instead of re-queued.
+        self._adopt_filter = False
+        # The fleet-wide logical clock (Lease renew_time high-water
+        # mark): advances broadcast a ``tick`` to non-owning shards.
+        self._lifecycle_hw = 0.0
 
     # -- owner RPC ---------------------------------------------------------
 
     def _call(self, shard: int, op: str, payload: dict) -> dict:
         self._cross_calls.inc(op=op)
-        return self.owners[shard].call(op, payload)
+        res = self.owners[shard].call(op, payload)
+        if isinstance(res, dict):
+            evicted = res.pop("evicted", None)
+            if evicted:
+                self._absorb_evictions(shard, evicted)
+        return res
 
     def shard_ids(self) -> list[int]:
         return sorted(self.owners)
@@ -191,6 +224,40 @@ class FleetRouter:
     def add_object(self, kind: str, obj) -> None:
         if kind == "Node":
             self.add_node(obj)
+            return
+        if kind == "Lease":
+            # A node heartbeat concerns exactly one lifecycle controller:
+            # the owning shard's.  The FRAME routes there (crc32 shard
+            # map — the same deterministic hash every owner consults; a
+            # foreign owner tracking the Lease would taint a node it
+            # does not hold), but the logical CLOCK it advances is
+            # global knowledge — upstream's apiserver stamps one clock
+            # for every controller.  So when a renewal advances the
+            # fleet-wide high-water mark, every OTHER shard gets a bare
+            # ``tick`` at the new clock: a shard whose only leased node
+            # went silent would otherwise never judge it (its local
+            # clock would freeze at the last renewal it ever saw).
+            # Evictions either call fires ride back on the responses
+            # (_call absorbs them).
+            shard = self.shard_map.owner_of(obj.node_name)
+            self._lease_frames.inc(shard=str(shard))
+            advanced = obj.renew_time > self._lifecycle_hw
+            self._call(
+                shard,
+                "add",
+                {"kind": "Lease", "object": serialize.to_dict(obj)},
+            )
+            if advanced:
+                for other in self.shard_ids():
+                    if other != shard:
+                        self._call(
+                            other, "tick", {"now": obj.renew_time}
+                        )
+                # Advance the mark only after every call landed: a
+                # FleetOwnerUnreachable mid-broadcast leaves it behind,
+                # so the post-takeover re-issue broadcasts again (ticks
+                # at an already-seen clock are idempotent no-ops).
+                self._lifecycle_hw = obj.renew_time
             return
         if kind == "Pod" and not obj.spec.node_name:
             self.add_pod(obj)
@@ -264,6 +331,112 @@ class FleetRouter:
             for g, n in res.get("gang_bound", {}).items():
                 self.gang_bound[g] = self.gang_bound.get(g, 0) + n
 
+    def _absorb_evictions(self, shard: int, evicted: list[dict]) -> None:
+        """Close the cross-shard half of the failure-response loop: a
+        shard owner's controller evicted these pods (taint eviction /
+        pod GC — journaled owner-side).  The router purges its routing
+        entry, debits fleet-wide gang credit, broadcasts the PDB debits
+        to every other owner, and requeues the unbound pod through ITS
+        queue — the next scatter-gather can rebind it on any shard."""
+        for rec in evicted:
+            uid = rec["uid"]
+            if self._adopt_filter and uid in self._pod_shard:
+                # Takeover drain: the journal replay re-surfaced an evict
+                # whose pod rebound before the crash (a later bind record
+                # adopt_bindings just re-read) — requeueing would
+                # double-schedule it.
+                continue
+            if uid in self.evicted_pending:
+                # Already absorbed by THIS router (live at-least-once
+                # delivery): debits and counters were applied then.
+                continue
+            self._pod_shard.pop(uid, None)
+            g = rec.get("group")
+            if g:
+                left = self.gang_bound.get(g, 0) - 1
+                if left > 0:
+                    self.gang_bound[g] = left
+                else:
+                    self.gang_bound.pop(g, None)
+            # PDB debits broadcast at-least-once: a FRESH router draining
+            # a replayed evict record cannot know whether the dead router
+            # already broadcast this debit pre-crash (the same window
+            # preemption's pdb_debits have) — budget accounting errs
+            # toward conservative.
+            for debit in rec.get("pdb_debits", ()):
+                for other in self.shard_ids():
+                    if other != shard:
+                        self._call(other, "pdb_debit", debit)
+            self._lifecycle_evictions.inc(shard=str(shard))
+            pod = serialize.pod_from_data(rec["pod"])
+            self.evicted_pending[uid] = (pod, shard)
+            self.queue.add(pod)
+        # Ack only after the WHOLE list is absorbed: the owner keeps
+        # re-delivering until then, so a lost response, a retried call,
+        # or an exception mid-absorb (a pdb_debit broadcast hitting a
+        # dead owner) never strands an eviction — re-delivery is deduped
+        # on evicted_pending above.
+        self._call(
+            shard,
+            "ack_evictions",
+            {"uids": [rec["uid"] for rec in evicted]},
+        )
+
+    def drain_evictions(self) -> int:
+        """Explicitly drain every owner's eviction buffer (takeover /
+        cold-router adopt): crash-interrupted evictions the journal
+        replay re-surfaced requeue here.  Call AFTER adopt_bindings —
+        the adopted routing is what filters replay-stale records whose
+        pod already rebound.  Returns the pods requeued."""
+        before = len(self.evicted_pending)
+        self._adopt_filter = True
+        try:
+            for shard in self.shard_ids():
+                self._call(shard, "drain_evictions", {})
+        finally:
+            self._adopt_filter = False
+        return len(self.evicted_pending) - before
+
+    def readopt_evictions(
+        self, prior: dict[str, tuple[t.Pod, int]]
+    ) -> int:
+        """A cold router restart inherits the dead router's absorbed-but-
+        unbound evictions (the soak's router-restart path): requeue the
+        ones still unbound, keeping the evicting-shard attribution so
+        cross-shard rebind accounting survives the restart."""
+        n = 0
+        for uid, (pod, shard) in sorted(prior.items()):
+            if uid in self._pod_shard or uid in self.evicted_pending:
+                continue
+            self.evicted_pending[uid] = (pod, shard)
+            self.queue.add(pod)
+            n += 1
+        return n
+
+    def _note_rebind(self, uid: str, shard: int) -> None:
+        ev = self.evicted_pending.pop(uid, None)
+        if ev is not None:
+            self._lifecycle_rebinds.inc(
+                cross_shard="true" if shard != ev[1] else "false"
+            )
+
+    def lifecycle_stats(self) -> dict:
+        """Fleet-wide failure-response summary (`fleet status`, the
+        fleet soak's node_loss block): per-owner lifecycle state plus
+        the router's eviction/rebind loop-closure counters."""
+        return {
+            "per_shard": {
+                str(s): self._call(s, "stats", {}).get("lifecycle", {})
+                for s in self.shard_ids()
+            },
+            "evictions_absorbed": int(self._lifecycle_evictions.total()),
+            "rebinds": int(self._lifecycle_rebinds.total()),
+            "cross_shard_rebinds": int(
+                self._lifecycle_rebinds.get(cross_shard="true")
+            ),
+            "pending_rebinds": len(self.evicted_pending),
+        }
+
     def remove_object(self, kind: str, uid: str) -> None:
         if kind == "Node":
             shard = self.shard_map.owner_of(uid)
@@ -296,6 +469,7 @@ class FleetRouter:
             return
         if kind != "Pod":
             raise ValueError(f"cannot remove kind {kind}")
+        self.evicted_pending.pop(uid, None)
         shard = self._pod_shard.pop(uid, None)
         if shard is not None:
             res = self._call(shard, "remove", {"kind": "Pod", "uid": uid})
@@ -408,6 +582,7 @@ class FleetRouter:
             return ScheduleOutcome(pod, None), False
         self._pod_shard[pod.uid] = shard
         self.queue.done(pod.uid)
+        self._note_rebind(pod.uid, shard)
         return ScheduleOutcome(pod, node_name), False
 
     def _postfilter(self, qp: QueuedPodInfo, outcome: ScheduleOutcome) -> None:
@@ -524,6 +699,7 @@ class FleetRouter:
             res = self._call(shard, "commit_reserved", {"uid": uid})
             self._gang_commits.inc(phase="commit")
             self._pod_shard[uid] = shard
+            self._note_rebind(uid, shard)
             self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
             room.outcomes[uid].node_name = res.get("bound")
             self._gang_committed.append(room.outcomes[uid])

@@ -75,6 +75,7 @@ def recover_shard(
     shard_id: int,
     shard_map: ShardMap | None = None,
     map_path: str | None = None,
+    lifecycle: dict | None = None,
 ) -> ShardOwner:
     """Restart takeover: re-own a dead owner's shard from its journal
     directory.  The lease acquire fences the deposed epoch; construction
@@ -82,9 +83,19 @@ def recover_shard(
     reconciles against the host-truth LIST afterwards
     (informers.reconcile_after_recovery) exactly like a single-scheduler
     restart — recovery parks journal bindings whose nodes the snapshot
-    did not cover, and the relist re-applies them."""
+    did not cover, and the relist re-applies them.  ``lifecycle``
+    re-arms the per-owner failure-response loop BEFORE replay (an armed
+    shard must recover armed, or replayed taint records would apply
+    under disarmed clock semantics and a mid-incident death would stall
+    at the taint); crash-interrupted evictions the replay re-surfaces
+    sit in ``owner.evictions_out`` until the adopting router drains
+    them (router.drain_evictions)."""
     owner = ShardOwner(
-        shard_id, scheduler_factory(), shard_map, state_dir=state_dir
+        shard_id,
+        scheduler_factory(),
+        shard_map,
+        state_dir=state_dir,
+        lifecycle=lifecycle,
     )
     if map_path:
         redo_lost_map_writes(owner, map_path)
@@ -107,19 +118,66 @@ def absorb_shard(
     scheduler_factory,
     shard_map: ShardMap,
     map_path: str | None = None,
+    lifecycle: dict | None = None,
 ) -> dict:
     """Survivor takeover: recover the dead shard behind an epoch bump,
     then merge it into the survivor through the journaled handoff path.
-    Returns the handoff record."""
+    The ghost replay may re-surface a mid-incident eviction (the dead
+    owner journaled the evict but never handed the pod to a router) —
+    those transfer to the SURVIVOR's eviction buffer, so the next router
+    drain finishes the loop on whichever shard has room.  The dead
+    shard's lifecycle bookkeeping (heartbeats, taints, GC clocks) rides
+    the node objects and the survivor's own controller adopts it at
+    import.  Returns the handoff record."""
     ghost = ShardOwner(
-        dead_shard_id, scheduler_factory(), None, state_dir=dead_state_dir
+        dead_shard_id,
+        scheduler_factory(),
+        None,
+        state_dir=dead_state_dir,
+        lifecycle=lifecycle,
     )
     try:
         record = shard_map.merge(
             into=survivor.shard_id, absorbed=dead_shard_id
         )
+        # Heartbeat history moves with the nodes — merged BEFORE the
+        # import so the survivor's clock judges the adopted nodes
+        # against their real last renewals, not as freshly unleased (a
+        # dead node absorbed mid-incident must keep aging toward its
+        # eviction/GC horizons).
+        nl = survivor.sched.node_lifecycle
+        for name, ts in sorted(ghost.sched.node_lifecycle.heartbeats.items()):
+            if ts > nl.heartbeats.get(name, -1.0):
+                nl.heartbeats[name] = ts
+            if ts > nl._hw:
+                nl._hw = ts
         payload = ghost.export_nodes(sorted(ghost.sched.cache.nodes))
         survivor.import_nodes(record, payload)
+        # The import adopted unreachable state at the survivor's current
+        # clock; the ghost's transition stamps are the true zero points
+        # of the GC horizon — the earlier stamp wins.
+        for name, ts in sorted(
+            ghost.sched.pod_gc._unreachable_since.items()
+        ):
+            cur = survivor.sched.pod_gc._unreachable_since.get(name)
+            if cur is None or ts < cur:
+                survivor.sched.pod_gc._unreachable_since[name] = ts
+        # The absorbed incident's pending requeues survive with the
+        # survivor — in its RECOVERED bucket, so only the adopting
+        # router's explicit drain (which filters entries whose pod
+        # already rebound) takes them.  The ghost's LOCAL PDB debits died
+        # with it, and the router's later broadcast skips the reporting
+        # shard (it assumes the evicting owner debited itself) — so the
+        # survivor applies them now, or its budget would permit one
+        # disruption too many.
+        moved = ghost.drain_evictions()
+        for rec in moved:
+            for debit in rec.get("pdb_debits", ()):
+                survivor.sched.apply_pdb_debit(debit["name"], debit["n"])
+        survivor.recovered_evictions.extend(moved)
+        # Journal-authored lifecycle taints the ghost replayed must also
+        # survive the SURVIVOR's next host-truth node re-feed.
+        survivor._recovered_taints.update(ghost._recovered_taints)
         if map_path:
             shard_map.save(map_path)
     finally:

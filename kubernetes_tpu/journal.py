@@ -449,6 +449,15 @@ def scheduler_state(sched) -> dict:
             "heartbeats": dict(sched.node_lifecycle.heartbeats),
             "hw": sched.node_lifecycle._hw,
             "transitions": sched.node_lifecycle.transitions,
+            # The GC's per-node unreachable clock: snapshot restore
+            # re-adopts state from node taints at clock 0 (the nodes
+            # load before the clock block), so without the original
+            # transition stamps a recovered owner would age a dead node
+            # toward the GC horizon from zero — sweeping EARLIER than
+            # the uninterrupted run and diverging the chaos oracle.
+            "gc_unreachable_since": dict(
+                sched.pod_gc._unreachable_since
+            ),
         },
         "failure_response": {
             "taint_evictions": sched.taint_eviction.evictions,
@@ -471,6 +480,10 @@ def recover(sched, journal: Journal) -> dict:
 
     snap, records, stats = journal.replay()
     journal.muted = True
+    # Visible to replay-driven hooks (fleet/owner.py routes replay-
+    # surfaced evictions to a recovery bucket only the adopting router's
+    # explicit drain — which filters replay-stale entries — may take).
+    sched._in_recovery = True
     try:
         if snap is not None:
             st = snap["state"]
@@ -502,6 +515,11 @@ def recover(sched, journal: Journal) -> dict:
                     sched.node_lifecycle._hw, nl.get("hw", 0.0)
                 )
                 sched.node_lifecycle.transitions = nl.get("transitions", 0)
+                # Overwrite the note_state(…, 0.0) entries the node adds
+                # above planted: the snapshot's transition stamps are the
+                # GC horizon's true zero point.
+                for nname, ts in nl.get("gc_unreachable_since", {}).items():
+                    sched.pod_gc._unreachable_since[nname] = float(ts)
             for entry in st.get("pods", ()):
                 pod = serialize.pod_from_data(entry["pod"])
                 pod.spec.node_name = entry["node"]
@@ -563,12 +581,19 @@ def recover(sched, journal: Journal) -> dict:
                 # lifecycle controller adopts the state the taints
                 # encode.  The record's ts advances the logical clock
                 # FIRST, so the re-armed deadlines start from the
-                # incident's time, not a rewound zero.  A node the
-                # snapshot doesn't hold is gone; its taints died with
-                # it.
-                sched.node_lifecycle._hw = max(
-                    sched.node_lifecycle._hw, d.get("ts", 0.0)
-                )
+                # incident's time, not a rewound zero — but ONLY when
+                # there is lifecycle state to continue from (snapshot-
+                # restored heartbeats): with no snapshot, the feed must
+                # re-derive the whole incident from its op stream, and a
+                # pre-advanced clock would compress the NotReady→
+                # Unreachable grace ladder into one instant transition
+                # (the fleet node-loss matrix's late-kill cells).  A
+                # node the snapshot doesn't hold is gone; its taints
+                # died with it.
+                if sched.node_lifecycle.heartbeats:
+                    sched.node_lifecycle._hw = max(
+                        sched.node_lifecycle._hw, d.get("ts", 0.0)
+                    )
                 from .api import types as api_types
 
                 taints = tuple(
@@ -591,9 +616,11 @@ def recover(sched, journal: Journal) -> dict:
                 # losing the pod.
                 pending.pop(d["uid"], None)
                 reason = d.get("reason", "")
-                sched.node_lifecycle._hw = max(
-                    sched.node_lifecycle._hw, d.get("ts", 0.0)
-                )
+                if sched.node_lifecycle.heartbeats:
+                    # Same clock-continuation gate as the taint replay.
+                    sched.node_lifecycle._hw = max(
+                        sched.node_lifecycle._hw, d.get("ts", 0.0)
+                    )
                 sched._apply_eviction(
                     d["uid"], serialize.pod_from_data(d["pod"]), reason=reason
                 )
@@ -658,6 +685,7 @@ def recover(sched, journal: Journal) -> dict:
         stats["handoffs"] = len(handoffs)
     finally:
         journal.muted = False
+        sched._in_recovery = False
     # Flight-recorder timeline: recovery is a state transition an operator
     # reconstructing an incident needs on the same axis as the batches —
     # and the dump is the artifact the crash harness asserts each killed

@@ -639,6 +639,63 @@ def _knee_analysis(
     }
 
 
+def _lifecycle_argv(cfg: SoakConfig) -> list[str]:
+    """The `serve` lifecycle-arming flags a node-loss soak needs (shared
+    by the single-process and fleet child spawns)."""
+    if cfg.node_grace_s <= 0:
+        return []
+    return [
+        "--node-grace-s", str(cfg.node_grace_s),
+        "--node-unreachable-s",
+        str(cfg.node_unreachable_s or cfg.node_grace_s * 2.5),
+        "--gc-horizon-s", str(cfg.gc_horizon_s or cfg.node_grace_s * 6),
+    ]
+
+
+def _launch_serve(
+    argv: list[str], out_dir: str, sock: str, label: str,
+    deadline_s: float,
+):
+    """Spawn one `serve` child and wait for its socket.  Output goes to
+    a per-child LOG FILE in the artifact directory, never an unread
+    PIPE — a chatty child (cycle-span logging, takeover restarts) would
+    otherwise block on a full pipe mid-soak and read as a hung owner."""
+    log_path = os.path.join(out_dir, f"{label}.log")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TPU_FLIGHT_DIR"] = out_dir
+    log = open(log_path, "a", encoding="utf-8")
+    try:
+        proc = subprocess.Popen(
+            argv,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            env=env,
+        )
+    finally:
+        log.close()  # the child holds its own dup
+    deadline = time.monotonic() + deadline_s
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            try:
+                with open(log_path, encoding="utf-8") as f:
+                    out = f.read()
+            except OSError:
+                out = ""
+            raise RuntimeError(
+                f"{label} exited rc={proc.returncode}: {out[-2000:]}"
+            )
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"{label} never bound its socket")
+        time.sleep(0.05)
+    return proc
+
+
 def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
     """The real deployment: ``python -m kubernetes_tpu serve`` as a
     child process, journaled and speculative, flight dumps into the
@@ -652,39 +709,8 @@ def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
         "--journal-dir", journal_dir,
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
-    ]
-    if cfg.node_grace_s > 0:
-        argv += [
-            "--node-grace-s", str(cfg.node_grace_s),
-            "--node-unreachable-s",
-            str(cfg.node_unreachable_s or cfg.node_grace_s * 2.5),
-            "--gc-horizon-s", str(cfg.gc_horizon_s or cfg.node_grace_s * 6),
-        ]
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env["TPU_FLIGHT_DIR"] = out_dir
-    proc = subprocess.Popen(
-        argv,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)
-        ))),
-        env=env,
-    )
-    deadline = time.monotonic() + 180.0
-    while not os.path.exists(sock):
-        if proc.poll() is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            raise RuntimeError(
-                f"serve child exited rc={proc.returncode}: {out[-2000:]}"
-            )
-        if time.monotonic() > deadline:
-            proc.kill()
-            raise RuntimeError("serve child never bound its socket")
-        time.sleep(0.05)
-    return proc
+    ] + _lifecycle_argv(cfg)
+    return _launch_serve(argv, out_dir, sock, "serve", deadline_s=180.0)
 
 
 def run_soak(cfg: SoakConfig) -> dict:
@@ -937,6 +963,36 @@ FLEET_INV_MIX: tuple[tuple[str, float], ...] = (
 )
 
 
+def _spawn_shard_serve(
+    cfg: SoakConfig,
+    shard: int,
+    shards: int,
+    sock: str,
+    map_path: str,
+    journal_dir: str,
+    out_dir: str,
+):
+    """One REAL fleet owner: ``python -m kubernetes_tpu serve --shard-of
+    k/N`` as a child process — its own journal, the shared shard-map
+    file, the lifecycle flags armed per owner when the soak injects node
+    deaths, flight dumps + the child's log into the artifact
+    directory."""
+    argv = [
+        sys.executable, "-m", "kubernetes_tpu", "serve",
+        "--socket", sock,
+        "--shard-of", f"{shard}/{shards}",
+        "--shard-map", map_path,
+        "--batch-size", str(cfg.batch_size),
+        "--chunk-size", "1",
+        "--journal-dir", journal_dir,
+        "--journal-fsync", cfg.journal_fsync,
+        "--snapshot-every", str(cfg.snapshot_every),
+    ] + _lifecycle_argv(cfg)
+    return _launch_serve(
+        argv, out_dir, sock, f"serve-shard{shard}", deadline_s=300.0
+    )
+
+
 def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     """Soak the PARTITIONED fleet (kubernetes_tpu/fleet): open-loop
     arrivals scatter-gathered by the router over ``shards`` journaled
@@ -946,299 +1002,537 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     - **node flaps hit ONE shard**: the churn pool is pinned to shard 0
       by shard-map overrides, so a flapping shard's SLO degrades while
       the others' hold (visible in the per-shard percentiles);
+    - **node DEATHS inside a shard** (``node_grace_s > 0``): churn-node
+      heartbeats go silent, the OWNING shard's lifecycle controller
+      writes the taints and evicts, and the router requeues the evicted
+      pods to rebind on whichever shard has room — the cross-shard half
+      of the failure-response loop, counted per shard;
     - **cold router restarts** (the fleet's cold-consumer analog): the
       ``cold_consumer`` scenario event tears the router down mid-stream
       and rebuilds it from the owners' truth (adopt_bindings) — pending
-      pods re-feed, bound pods must not double-schedule;
+      pods re-feed, bound pods must not double-schedule, absorbed-but-
+      unbound evictions re-adopt;
     - **per-shard SLO percentiles + WAL growth**: each decision's latency
       is attributed to the shard that committed it, and every owner's
       journal is sampled for bounded-compaction evidence.
+
+    ``cfg.two_process=True`` runs the REAL multi-process fleet: N
+    ``serve --shard-of k/N`` children over the unix-socket wire, driven
+    through ``WireShardOwner`` with per-call deadlines — a hung or dead
+    owner degrades to TAKEOVER (the child restarts, recovers its own
+    journal before its first frame, and the router re-adopts) instead of
+    wedging scatter-gather.
 
     Same determinism contract as run_soak: the operation sequence is a
     pure function of the seed, so same-seed runs land bit-identical
     final bindings (the --shards determinism cross-check in
     scripts/run_soak.py asserts exactly that)."""
-    from ..fleet import FleetRouter, ShardMap, ShardOwner
+    from ..fleet import (
+        FleetOwnerUnreachable,
+        FleetRouter,
+        ShardMap,
+        ShardOwner,
+        WireShardOwner,
+    )
     from ..scheduler import TPUScheduler
 
     tmp = tempfile.TemporaryDirectory(prefix="tpu-fleet-soak-")
     out_dir = cfg.out_dir or tmp.name
     os.makedirs(out_dir, exist_ok=True)
     journal_root = cfg.journal_dir or os.path.join(tmp.name, "journal")
+    armed = cfg.node_grace_s > 0
+    lifecycle = (
+        {
+            "node_grace_s": cfg.node_grace_s,
+            "node_unreachable_s": cfg.node_unreachable_s,
+            "gc_horizon_s": cfg.gc_horizon_s,
+        }
+        if armed
+        else None
+    )
     smap = ShardMap(n_shards=shards)
     for i in range(cfg.churn_nodes):
-        smap.assign(f"churn-{i}", 0)  # flaps land on shard 0 only
-    owners: dict[int, ShardOwner] = {}
+        smap.assign(f"churn-{i}", 0)  # flaps/deaths land on shard 0 only
+    registry = MetricsRegistry()
+    owners: dict[int, object] = {}
+    procs: dict[int, object] = {}
+    socks: dict[int, str] = {}
+    map_path = os.path.join(tmp.name, "shardmap.json")
+
+    def spawn_owner(k: int):
+        if not cfg.two_process:
+            return ShardOwner(
+                k,
+                TPUScheduler(batch_size=cfg.batch_size, chunk_size=1),
+                smap,
+                state_dir=os.path.join(journal_root, f"shard{k}"),
+                journal_fsync=cfg.journal_fsync == "always",
+                snapshot_every_batches=cfg.snapshot_every,
+                lifecycle=lifecycle,
+            )
+        socks[k] = os.path.join(tmp.name, f"shard{k}.sock")
+        procs[k] = _spawn_shard_serve(
+            cfg, k, shards, socks[k], map_path,
+            os.path.join(journal_root, f"shard{k}"), out_dir,
+        )
+        return WireShardOwner(
+            path=socks[k],
+            deadline_s=120.0,
+            max_retries=2,
+            registry=registry,
+            shard_id=k,
+        )
+
+    if cfg.two_process:
+        smap.save(map_path)  # shared ownership record, before any child
     for k in range(shards):
-        owners[k] = ShardOwner(
-            k,
-            TPUScheduler(batch_size=cfg.batch_size, chunk_size=1),
-            smap,
-            state_dir=os.path.join(journal_root, f"shard{k}"),
-            journal_fsync=cfg.journal_fsync == "always",
-            snapshot_every_batches=cfg.snapshot_every,
-        )
-    mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
-    node_objs: dict[str, object] = {}
-    feed_order: list[str] = []
-    router_restarts = 0
+        owners[k] = spawn_owner(k)
+    # Children die with the run, success or not: any exception out of
+    # the warmup or the op loop (a protocol desync, an assertion, a
+    # KeyboardInterrupt) must not leak N serve processes holding
+    # journal leases and sockets.
+    try:
+        mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+        node_objs: dict[str, object] = {}
+        feed_order: list[str] = []
+        router_restarts = 0
+        owner_takeovers = 0
 
-    def mk_router() -> FleetRouter:
-        r = FleetRouter(owners, smap, batch_size=cfg.batch_size)
-        r.profile_filters = tuple(owners[0].sched.profile.filters)
-        return r
+        def mk_router() -> FleetRouter:
+            r = FleetRouter(
+                owners, smap, batch_size=cfg.batch_size, registry=registry
+            )
+            if cfg.two_process:
+                from ..framework.config import DEFAULT_PROFILE
 
-    def feed_node(r: FleetRouter, n) -> None:
-        name = n.metadata.name
-        if name not in node_objs:
-            feed_order.append(name)
-        node_objs[name] = n
-        r.add_object("Node", n)
+                r.profile_filters = tuple(DEFAULT_PROFILE.filters)
+            else:
+                r.profile_filters = tuple(owners[0].sched.profile.filters)
+            return r
 
-    router = mk_router()
-    for i in range(cfg.nodes):
-        feed_node(
-            router,
-            make_node(f"lgn-{i}")
-            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
-            .zone(f"zone-{i % cfg.zones}")
-            .region("region-1")
-            .obj(),
+        def feed_node(r: FleetRouter, n) -> None:
+            name = n.metadata.name
+            if name not in node_objs:
+                feed_order.append(name)
+            node_objs[name] = n
+            r.add_object("Node", n)
+
+        router = mk_router()
+        for i in range(cfg.nodes):
+            feed_node(
+                router,
+                make_node(f"lgn-{i}")
+                .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+                .zone(f"zone-{i % cfg.zones}")
+                .region("region-1")
+                .obj(),
+            )
+        for i in range(cfg.churn_nodes):
+            feed_node(
+                router,
+                make_node(f"churn-{i}")
+                .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+                .zone(f"zone-{i % cfg.zones}")
+                .region("region-1")
+                .obj(),
+            )
+        if armed:
+            from ..api import types as t
+            from ..controllers import (
+                NODE_NOT_READY,
+                NODE_UNREACHABLE,
+                lifecycle_taints,
+            )
+
+            # Pre-seed the lifecycle taint keys into EVERY owner's
+            # featurization vocab BEFORE warmup compiles the device
+            # programs.  Two traps close here: (1) the first mid-soak
+            # transition would otherwise grow the taint schema and pay a
+            # full XLA recompile inside the measured window (PR 9's
+            # single-scheduler trap); (2) TaintToleration's is_active gate
+            # keys on the LOCAL vocab — a shard that never interned a taint
+            # would skip the op while the churn shard runs it, skewing the
+            # reverse-normalized baseline (+MaxNodeScore×weight on the
+            # tainted shard's nodes) and funnelling every decision there.
+            # With the vocab uniform, lifecycle taints carry exactly
+            # upstream's score semantics: none (only PreferNoSchedule
+            # counts), so per-shard normalization agrees.
+            import dataclasses
+
+            def preseed(name: str) -> None:
+                probe = node_objs[name]
+                tainted = dataclasses.replace(
+                    probe,
+                    spec=dataclasses.replace(
+                        probe.spec,
+                        taints=lifecycle_taints(NODE_NOT_READY)
+                        + lifecycle_taints(NODE_UNREACHABLE),
+                    ),
+                )
+                router.add_object("Node", tainted)
+                router.add_object("Node", probe)
+
+            preseed("churn-0")  # shard 0 (the pinned churn pool)
+            seeded = {smap.owner_of("churn-0")}
+            for i in range(cfg.nodes):
+                name = f"lgn-{i}"
+                k = smap.owner_of(name)
+                if k not in seeded:
+                    seeded.add(k)
+                    preseed(name)
+                if len(seeded) == shards:
+                    break
+            # Only churn nodes carry Leases: the per-owner lifecycle loop
+            # governs exactly the death-eligible pool; the serving fleet
+            # stays exempt (unleased nodes are never tainted).
+            for i in range(cfg.churn_nodes):
+                router.add_object("Lease", t.Lease(f"churn-{i}", 0.0))
+
+        # Warm the compiled eval passes out of the measured window.  Two
+        # things force a recompile mid-stream if not warmed here: a pod
+        # class whose active-op set first appears inside the window, and the
+        # inv_label scenario's epoch labels growing the node-label vocab
+        # (a new schema keys a new compiled pass — one ~20s CPU-box compile
+        # lands squarely on the measured percentiles).  So the warm wave
+        # draws from the SAME WorkloadMix templates (renamed far outside the
+        # stream's index space) and the vocab is pre-seeded with the epoch
+        # label values the scenario can reach, then the node is restored.
+        warm_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 104_729 + 31)
+        for epoch in range(1, 5):
+            feed_node(
+                router,
+                make_node("lgn-0")
+                .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+                .zone("zone-0")
+                .region("region-1")
+                .label("loadgen.tpu/epoch", str(epoch))
+                .obj(),
+            )
+        warm = [warm_mix.pod(10_000_000 + i) for i in range(min(cfg.warm_pods, 48))]
+        for p in warm:
+            router.add_pod(p)
+        router.schedule_all_pending()
+        # Compile the preemption dry-run programs too (they otherwise first
+        # fire when the cluster fills, deep inside the measured window).
+        # preempt_propose is eval-only: nothing is deleted or nominated.
+        from ..api import serialize
+
+        warm_preemptor = (
+            make_pod("lgwarm-preemptor").req({"cpu": "12"}).priority(100).obj()
         )
-    for i in range(cfg.churn_nodes):
-        feed_node(
-            router,
-            make_node(f"churn-{i}")
-            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
-            .zone(f"zone-{i % cfg.zones}")
-            .region("region-1")
-            .obj(),
-        )
-    # Warm the compiled eval passes out of the measured window.  Two
-    # things force a recompile mid-stream if not warmed here: a pod
-    # class whose active-op set first appears inside the window, and the
-    # inv_label scenario's epoch labels growing the node-label vocab
-    # (a new schema keys a new compiled pass — one ~20s CPU-box compile
-    # lands squarely on the measured percentiles).  So the warm wave
-    # draws from the SAME WorkloadMix templates (renamed far outside the
-    # stream's index space) and the vocab is pre-seeded with the epoch
-    # label values the scenario can reach, then the node is restored.
-    warm_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 104_729 + 31)
-    for epoch in range(1, 5):
+        for owner in owners.values():
+            owner.call(
+                "preempt_propose", {"pod": serialize.to_dict(warm_preemptor)}
+            )
+        for p in warm:
+            if p.uid in router._pod_shard:
+                router.remove_object("Pod", p.uid)
+            else:
+                router.queue.delete(p.uid)
+        # Restore lgn-0 to its unlabeled serving shape.
         feed_node(
             router,
             make_node("lgn-0")
             .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
             .zone("zone-0")
             .region("region-1")
-            .label("loadgen.tpu/epoch", str(epoch))
             .obj(),
         )
-    warm = [warm_mix.pod(10_000_000 + i) for i in range(min(cfg.warm_pods, 48))]
-    for p in warm:
-        router.add_pod(p)
-    router.schedule_all_pending()
-    # Compile the preemption dry-run programs too (they otherwise first
-    # fire when the cluster fills, deep inside the measured window).
-    # preempt_propose is eval-only: nothing is deleted or nominated.
-    warm_preemptor = (
-        make_pod("lgwarm-preemptor").req({"cpu": "12"}).priority(100).obj()
-    )
-    for owner in owners.values():
-        owner.preempt_propose(warm_preemptor)
-    for p in warm:
-        if p.uid in router._pod_shard:
-            router.remove_object("Pod", p.uid)
-        else:
-            router.queue.delete(p.uid)
-    # Restore lgn-0 to its unlabeled serving shape.
-    feed_node(
-        router,
-        make_node("lgn-0")
-        .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
-        .zone("zone-0")
-        .region("region-1")
-        .obj(),
-    )
 
-    cap_toggle: dict[int, int] = {}
-    label_epoch: dict[int, int] = {}
-    live: deque[str] = deque()
-    pods_by_uid: dict[str, object] = {}
-    pending: dict[str, object] = {}  # decided-but-unbound, for restarts
-    per_shard_lat: dict[int, list[float]] = {k: [] for k in owners}
-    wal_prev: dict[int, int] = {k: 0 for k in owners}
-    wal_samples: dict[int, list[int]] = {k: [] for k in owners}
-    compactions: dict[int, int] = {k: 0 for k in owners}
+        cap_toggle: dict[int, int] = {}
+        label_epoch: dict[int, int] = {}
+        live: deque[str] = deque()
+        pods_by_uid: dict[str, object] = {}
+        pending: dict[str, object] = {}  # decided-but-unbound, for restarts
+        dead: set[str] = set()  # churn nodes with silenced heartbeats
+        node_deaths = 0
+        node_revives = 0
+        lease_renewals = 0
+        per_shard_lat: dict[int, list[float]] = {k: [] for k in owners}
+        wal_prev: dict[int, int] = {k: 0 for k in owners}
+        wal_samples: dict[int, list[int]] = {k: [] for k in owners}
+        compactions: dict[int, int] = {k: 0 for k in owners}
 
-    def sample_wal() -> None:
-        for k in owners:
-            try:
-                size = os.path.getsize(
-                    os.path.join(journal_root, f"shard{k}", Journal.WAL)
+        def sample_wal() -> None:
+            for k in owners:
+                try:
+                    size = os.path.getsize(
+                        os.path.join(journal_root, f"shard{k}", Journal.WAL)
+                    )
+                except OSError:
+                    size = 0
+                if size < wal_prev[k]:
+                    compactions[k] += 1
+                wal_prev[k] = size
+                wal_samples[k].append(size)
+
+        def serving_node(i: int):
+            w = (
+                make_node(f"lgn-{i}")
+                .capacity(
+                    {
+                        "cpu": "15" if cap_toggle.get(i) else "16",
+                        "memory": "64Gi",
+                        "pods": 110,
+                    }
                 )
-            except OSError:
-                size = 0
-            if size < wal_prev[k]:
-                compactions[k] += 1
-            wal_prev[k] = size
-            wal_samples[k].append(size)
-
-    def serving_node(i: int):
-        w = (
-            make_node(f"lgn-{i}")
-            .capacity(
-                {
-                    "cpu": "15" if cap_toggle.get(i) else "16",
-                    "memory": "64Gi",
-                    "pods": 110,
-                }
+                .zone(f"zone-{i % cfg.zones}")
+                .region("region-1")
             )
-            .zone(f"zone-{i % cfg.zones}")
-            .region("region-1")
-        )
-        if label_epoch.get(i):
-            w = w.label("loadgen.tpu/epoch", str(label_epoch[i]))
-        return w.obj()
+            if label_epoch.get(i):
+                w = w.label("loadgen.tpu/epoch", str(label_epoch[i]))
+            return w.obj()
 
-    def apply_event(ev) -> None:
-        nonlocal router, router_restarts
-        if ev.kind == "inv_capacity":
-            i = ev.data % cfg.nodes
-            cap_toggle[i] = 1 - cap_toggle.get(i, 0)
-            feed_node(router, serving_node(i))
-        elif ev.kind == "inv_label":
-            i = ev.data % cfg.nodes
-            label_epoch[i] = label_epoch.get(i, 0) + 1
-            feed_node(router, serving_node(i))
-        elif ev.kind == "flap_down":
-            name = f"churn-{ev.data}"
-            gone = sorted(
-                uid
-                for uid in live
-                if getattr(pods_by_uid.get(uid), "_lg_node", None) == name
-            )
-            if gone:
-                gone_set = set(gone)
-                for u in gone:
-                    pods_by_uid.pop(u, None)
-                live_kept = deque(u for u in live if u not in gone_set)
-                live.clear()
-                live.extend(live_kept)
-            if name in node_objs and name in router._node_pos:
-                router.remove_object("Node", name)
-        elif ev.kind == "flap_up":
-            feed_node(router, node_objs[f"churn-{ev.data}"])
-        elif ev.kind == "cold_consumer":
-            # Cold ROUTER restart: the front door is rebuilt from the
-            # owners' truth mid-stream.  Node positions re-derive from
-            # the recorded feed order (the row-allocator mirror must
-            # land where the dead router's did), bindings re-adopt, and
-            # still-pending pods re-feed.
-            router = mk_router()
+        def rebuild_router() -> FleetRouter:
+            """A fresh front door over the owners' truth (cold restart or
+            post-takeover re-adopt): node positions re-derive from the
+            recorded feed order (the row-allocator mirror must land where
+            the dead router's did), parked journal bindings re-apply,
+            bindings re-adopt, crash-surfaced evictions drain, the dead
+            router's absorbed-but-unbound evictions re-adopt, and
+            still-pending pods re-feed."""
+            prior_evicted = dict(router.evicted_pending) if router else {}
+            r = mk_router()
             for name in feed_order:
                 if name in node_objs:
-                    router.add_object("Node", node_objs[name])
-            router.reconcile_recovered()
-            router.adopt_bindings()
+                    r.add_object("Node", node_objs[name])
+            if armed:
+                # The owners keep their own heartbeat state; the router only
+                # needs its clock high-water mark back so the next renewal's
+                # broadcast gate behaves — harmless extra ticks otherwise.
+                r._lifecycle_hw = router._lifecycle_hw if router else 0.0
+            r.reconcile_recovered()
+            r.adopt_bindings()
+            r.drain_evictions()
+            r.readopt_evictions(prior_evicted)
             for uid in sorted(pending):
-                router.add_pod(pending[uid])
-            router_restarts += 1
-        else:
-            raise ValueError(f"unknown fleet scenario event {ev.kind!r}")
+                r.add_pod(pending[uid])
+            return r
 
-    res = _PhaseResult(
-        name="fleet-sustained",
-        invalidation_rate_per_s=cfg.invalidation_rate_per_s,
-    )
+        def revive_owner(k: int) -> None:
+            """Bounded-retry exhausted on shard ``k`` (hung or dead child):
+            TAKEOVER — kill whatever is left, restart the serve child (it
+            recovers its own journal before the first frame), and rebuild
+            the router over the recovered truth."""
+            nonlocal router, owner_takeovers
+            proc = procs.get(k)
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            try:
+                owners[k].close()
+            except OSError:
+                pass
+            if os.path.exists(socks[k]):
+                os.unlink(socks[k])
+            owners[k] = spawn_owner(k)
+            owner_takeovers += 1
+            router = rebuild_router()
 
-    def decide(pod, deadline: float | None) -> None:
-        uid = pod.uid
-        t_issue = time.perf_counter()
-        router.add_pod(pod)
-        outs = router.schedule_all_pending()
-        node = None
-        for o in outs:
-            if o.pod.uid == uid and o.node_name:
-                node = o.node_name
-        shard = router._pod_shard.get(uid)
-        t_done = time.perf_counter()
-        base = t_issue if deadline is None else min(deadline, t_issue)
-        lat = t_done - base
-        res.latencies.append(lat)
-        if shard is not None:
-            per_shard_lat[shard].append(lat)
-        if lat > cfg.slo_budget_ms / 1e3:
-            res.violations += 1
-        res.decisions += 1
-        if node:
-            res.bound += 1
-            pod._lg_node = node
-            pods_by_uid[uid] = pod
-            pending.pop(uid, None)
-            live.append(uid)
-            while len(live) > cfg.live_pod_cap:
-                old = live.popleft()
-                pods_by_uid.pop(old, None)
-                pending.pop(old, None)
-                if old in router._pod_shard:
-                    router.remove_object("Pod", old)
-                res.retired += 1
-        else:
-            pending[uid] = pod
+        def apply_event(ev) -> None:
+            nonlocal router, router_restarts, node_deaths, node_revives
+            nonlocal lease_renewals
+            if ev.kind == "inv_capacity":
+                i = ev.data % cfg.nodes
+                cap_toggle[i] = 1 - cap_toggle.get(i, 0)
+                feed_node(router, serving_node(i))
+            elif ev.kind == "inv_label":
+                i = ev.data % cfg.nodes
+                label_epoch[i] = label_epoch.get(i, 0) + 1
+                feed_node(router, serving_node(i))
+            elif ev.kind == "node_death":
+                # The Node object STAYS; its heartbeat goes silent.  The
+                # OWNING shard's lifecycle controller must detect the
+                # staleness, taint, evict — and the router must rebind the
+                # evicted pods on surviving shards.
+                dead.add(f"churn-{ev.data % max(1, cfg.churn_nodes)}")
+                node_deaths += 1
+            elif ev.kind == "node_revive":
+                from ..api import types as t
 
-    seed = cfg.seed * 1_000_003
-    if cfg.diurnal:
-        offsets = diurnal_offsets(
-            cfg.rate_pods_per_s,
-            cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
-            cfg.diurnal_period_s,
-            cfg.duration_s,
-            seed,
+                name = f"churn-{ev.data % max(1, cfg.churn_nodes)}"
+                dead.discard(name)
+                router.add_object("Lease", t.Lease(name, ev.t))
+                lease_renewals += 1
+                node_revives += 1
+            elif ev.kind == "lease_tick":
+                from ..api import types as t
+
+                for i in range(cfg.churn_nodes):
+                    name = f"churn-{i}"
+                    if name not in dead and name in node_objs:
+                        router.add_object("Lease", t.Lease(name, ev.t))
+                        lease_renewals += 1
+            elif ev.kind == "flap_down":
+                name = f"churn-{ev.data}"
+                gone = sorted(
+                    uid
+                    for uid in live
+                    if getattr(pods_by_uid.get(uid), "_lg_node", None) == name
+                )
+                if gone:
+                    gone_set = set(gone)
+                    for u in gone:
+                        pods_by_uid.pop(u, None)
+                    live_kept = deque(u for u in live if u not in gone_set)
+                    live.clear()
+                    live.extend(live_kept)
+                if name in node_objs and name in router._node_pos:
+                    router.remove_object("Node", name)
+            elif ev.kind == "flap_up":
+                feed_node(router, node_objs[f"churn-{ev.data}"])
+            elif ev.kind == "cold_consumer":
+                # Cold ROUTER restart: the front door is rebuilt from the
+                # owners' truth mid-stream — bound pods must not
+                # double-schedule, and absorbed-but-unbound evictions
+                # survive the restart (readopt_evictions).
+                router = rebuild_router()
+                router_restarts += 1
+            else:
+                raise ValueError(f"unknown fleet scenario event {ev.kind!r}")
+
+        res = _PhaseResult(
+            name="fleet-sustained",
+            invalidation_rate_per_s=cfg.invalidation_rate_per_s,
         )
-    else:
-        offsets = poisson_offsets(cfg.rate_pods_per_s, cfg.duration_s, seed)
-    pods = [mix.pod(i) for i in range(len(offsets))]
-    scenario = build_events(
-        cfg.duration_s,
-        seed + 500_009,
-        nodes=cfg.nodes,
-        churn_nodes=cfg.churn_nodes,
-        invalidation_rate_per_s=cfg.invalidation_rate_per_s,
-        inv_mix=FLEET_INV_MIX,
-        node_flap_period_s=cfg.node_flap_period_s,
-        flap_down_s=cfg.flap_down_s,
-        cold_consumer_period_s=cfg.cold_consumer_period_s,
-    )
-    ops: list[tuple[float, int, int, object]] = []
-    for j, ev in enumerate(scenario):
-        ops.append((ev.t, 1, j, ev))
-    for i, off in enumerate(offsets):
-        ops.append((off, 2, i, i))
-    ops.sort(key=lambda e: (e[0], e[1], e[2]))
-    t0 = time.perf_counter()
-    for t_ev, klass, _idx, payload in ops:
-        if cfg.pace == "real":
-            delay = (t0 + t_ev) - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
-        if klass == 1:
-            apply_event(payload)
-            res.events_applied[payload.kind] = (
-                res.events_applied.get(payload.kind, 0) + 1
-            )
-            sample_wal()
-        else:
-            deadline = t0 + t_ev if cfg.pace == "real" else None
-            decide(pods[payload], deadline)
-    sample_wal()
-    res.wall_s = round(time.perf_counter() - t0, 3)
 
-    bindings = router.bindings()
-    stats = router.stats()
-    registry_summary = router.registry.summary()
-    for owner in owners.values():
-        owner.close()
+        def decide(pod, deadline: float | None) -> None:
+            uid = pod.uid
+            t_issue = time.perf_counter()
+            router.add_pod(pod)
+            outs = router.schedule_all_pending()
+            node = None
+            for o in outs:
+                if o.pod.uid == uid and o.node_name:
+                    node = o.node_name
+                elif o.node_name and o.pod.uid in pods_by_uid:
+                    # A rebind (an evicted pod rescheduled mid-decision):
+                    # keep the live-window's node attribution current, or a
+                    # later flap of the DEAD node would prune the survivor.
+                    pods_by_uid[o.pod.uid]._lg_node = o.node_name
+            shard = router._pod_shard.get(uid)
+            t_done = time.perf_counter()
+            base = t_issue if deadline is None else min(deadline, t_issue)
+            lat = t_done - base
+            res.latencies.append(lat)
+            if shard is not None:
+                per_shard_lat[shard].append(lat)
+            if lat > cfg.slo_budget_ms / 1e3:
+                res.violations += 1
+            res.decisions += 1
+            if node:
+                res.bound += 1
+                pod._lg_node = node
+                pods_by_uid[uid] = pod
+                pending.pop(uid, None)
+                live.append(uid)
+                while len(live) > cfg.live_pod_cap:
+                    old = live.popleft()
+                    pods_by_uid.pop(old, None)
+                    pending.pop(old, None)
+                    if old in router._pod_shard:
+                        router.remove_object("Pod", old)
+                    res.retired += 1
+            else:
+                pending[uid] = pod
+
+        seed = cfg.seed * 1_000_003
+        if cfg.diurnal:
+            offsets = diurnal_offsets(
+                cfg.rate_pods_per_s,
+                cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
+                cfg.diurnal_period_s,
+                cfg.duration_s,
+                seed,
+            )
+        else:
+            offsets = poisson_offsets(cfg.rate_pods_per_s, cfg.duration_s, seed)
+        pods = [mix.pod(i) for i in range(len(offsets))]
+        scenario = build_events(
+            cfg.duration_s,
+            seed + 500_009,
+            nodes=cfg.nodes,
+            churn_nodes=cfg.churn_nodes,
+            invalidation_rate_per_s=cfg.invalidation_rate_per_s,
+            inv_mix=FLEET_INV_MIX,
+            node_flap_period_s=cfg.node_flap_period_s,
+            flap_down_s=cfg.flap_down_s,
+            cold_consumer_period_s=cfg.cold_consumer_period_s,
+            node_death_period_s=cfg.node_death_period_s if armed else 0.0,
+            node_death_down_s=cfg.node_death_down_s,
+            lease_interval_s=cfg.lease_interval_s if armed else 0.0,
+        )
+        ops: list[tuple[float, int, int, object]] = []
+        for j, ev in enumerate(scenario):
+            ops.append((ev.t, 1, j, ev))
+        for i, off in enumerate(offsets):
+            ops.append((off, 2, i, i))
+        ops.sort(key=lambda e: (e[0], e[1], e[2]))
+        t0 = time.perf_counter()
+
+        def execute(klass: int, payload, t_ev: float) -> None:
+            if klass == 1:
+                apply_event(payload)
+                res.events_applied[payload.kind] = (
+                    res.events_applied.get(payload.kind, 0) + 1
+                )
+                sample_wal()
+            else:
+                deadline = t0 + t_ev if cfg.pace == "real" else None
+                decide(pods[payload], deadline)
+
+        for t_ev, klass, _idx, payload in ops:
+            if cfg.pace == "real":
+                delay = (t0 + t_ev) - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            try:
+                execute(klass, payload, t_ev)
+            except FleetOwnerUnreachable as exc:
+                # Bounded retry exhausted on one owner: takeover (restart
+                # the serve child — it recovers its journal before the first
+                # frame — and re-adopt), then re-issue the op once against
+                # the recovered fleet.  Idempotent by the same contracts the
+                # kill matrix proves: bound pods re-adopt, adds upsert.
+                shard = getattr(exc, "shard_id", None)
+                if shard is None or not cfg.two_process:
+                    raise
+                revive_owner(shard)
+                execute(klass, payload, t_ev)
+        sample_wal()
+        res.wall_s = round(time.perf_counter() - t0, 3)
+
+        bindings = router.bindings()
+        stats = router.stats()
+        node_loss = None
+        if armed:
+            lc = router.lifecycle_stats()
+            node_loss = {
+                "node_deaths": node_deaths,
+                "node_revives": node_revives,
+                "lease_renewals": lease_renewals,
+                "evictions_absorbed": lc["evictions_absorbed"],
+                "rebinds": lc["rebinds"],
+                "cross_shard_rebinds": lc["cross_shard_rebinds"],
+                "pending_rebinds": lc["pending_rebinds"],
+                "per_shard_lifecycle": lc["per_shard"],
+            }
+        registry_summary = router.registry.summary()
+    finally:
+        for owner in owners.values():
+            try:
+                owner.close()
+            except OSError:
+                pass
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
     slo = dict(
         _lat_summary(res.latencies),
         budget_ms=cfg.slo_budget_ms,
@@ -1272,6 +1566,11 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         },
         "events": dict(sorted(res.events_applied.items())),
         "router_restarts": router_restarts,
+        "owner_takeovers": owner_takeovers,
+        "deployment": (
+            "multi-process" if cfg.two_process else "in-process"
+        ),
+        "node_loss": node_loss,
         "fleet_metrics": registry_summary,
         "determinism": {
             "arrival_sha256": _sha([round(o, 9) for o in offsets]),

@@ -414,6 +414,13 @@ class TPUScheduler:
         # already bound, or a gang split across shards never reaches
         # quorum anywhere.
         self.fleet_gang_credit = lambda g: 0
+        # Eviction requeue sink (fleet/owner.py): a shard owner's local
+        # queue is never drained by the router, so an armed lifecycle
+        # controller's evict-as-requeue must hand the unbound pod BACK to
+        # the router (which can rebind it on a different shard) instead
+        # of parking it locally.  None (the default) keeps the single-
+        # scheduler behavior: the evicted pod re-enters this queue.
+        self.eviction_requeue_hook = None
         # Rotating scan start (schedule_one.go nextStartNodeIndex).
         self._next_start = 0
         # Shapes of the last scheduled batch (for warm_tail precompilation).
@@ -915,7 +922,14 @@ class TPUScheduler:
             f"Evicted {uid} ({reason}); requeued for rescheduling",
             **self._trace_extra(),
         )
-        self.add_pod(requeued)
+        if self.eviction_requeue_hook is not None:
+            # Fleet owner: the router requeues (and may rebind the pod on
+            # a DIFFERENT shard); journal replay routes here too, so a
+            # takeover surfaces crash-interrupted evictions to the router
+            # instead of stranding them in a queue nothing drains.
+            self.eviction_requeue_hook(uid, requeued, reason)
+        else:
+            self.add_pod(requeued)
 
     # -- cluster events (the informer surface, eventhandlers.go:341) ---------
 
@@ -2242,12 +2256,8 @@ class TPUScheduler:
         debits: dict[str, int] = {}
         for vic in victims:
             self.delete_pod(vic.uid, notify=False)
-            for pdb in self.pdbs.values():
-                if vic.namespace == pdb.namespace and t.label_selector_matches(
-                    pdb.selector, vic.metadata.labels
-                ):
-                    pdb.disruptions_allowed -= 1
-                    debits[pdb.name] = debits.get(pdb.name, 0) + 1
+            for name, n in self.debit_matching_pdbs(vic).items():
+                debits[name] = debits.get(name, 0) + n
         self._journal_append(
             "preempt",
             uid=pod.uid,
@@ -2287,6 +2297,21 @@ class TPUScheduler:
             # already subtracted — the router's POD_DELETE wake hint.
             "freed": self.fleet_free_ctx([node_name]),
         }
+
+    def debit_matching_pdbs(self, pod: t.Pod) -> dict[str, int]:
+        """Debit every budget matching ``pod`` by one disruption and
+        return {pdb name: debit} — the single accounting shared by the
+        preemption path (execute_preemption) and the fleet owner's
+        eviction path (fleet/owner.py _on_eviction); the router
+        broadcasts the returned debits to the other shards."""
+        debits: dict[str, int] = {}
+        for pdb in self.pdbs.values():
+            if pod.namespace == pdb.namespace and t.label_selector_matches(
+                pdb.selector, pod.metadata.labels
+            ):
+                pdb.disruptions_allowed -= 1
+                debits[pdb.name] = debits.get(pdb.name, 0) + 1
+        return debits
 
     def apply_pdb_debit(self, name: str, n: int) -> None:
         """Mirror a foreign shard's preemption debit on the local PDB copy

@@ -150,6 +150,13 @@ class SidecarServer:
         # so _dispatch — which receives only the scheduler — can reach it.
         self.fleet_owner = fleet_owner
         self.scheduler._fleet_owner = fleet_owner
+        if fleet_owner is not None and journal is not None:
+            # The owner was constructed BEFORE the serve-journal recovery
+            # above replayed the pre-crash world — its recovered-taints
+            # overlay (journal-authored lifecycle taints must survive the
+            # router's host-truth node re-feed) would otherwise stay
+            # empty in every `serve --shard-of` restart.
+            fleet_owner.refresh_recovered_taints()
         # Wire deployments hand nominations back to the host (it owns the
         # victims' API deletes); the in-process inline commit would act on
         # them sidecar-side and desync the two views.

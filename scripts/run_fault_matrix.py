@@ -942,6 +942,415 @@ def run_node_loss_matrix(cases=NODE_LOSS_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the FLEET node-loss matrix (the failure-response loop, fleet-native) --
+
+# ISSUE 10: the node-death production sequence driven through the
+# PARTITIONED fleet — Lease frames route to the owning shard, the owner's
+# lifecycle controller journals the taints, its evictions ride fleet
+# responses back to the router and rebind CROSS-SHARD — with the process
+# SIGKILLed at journal points along the way, including inside the
+# taint-write→eviction window (post-append on shard 0's taint record) and
+# inside a mid-incident handoff's append→map-rewrite window
+# (pre-map-write while nd1 is NotReady and eviction deadlines are armed).
+# Recovery is a TAKEOVER: fresh armed owners replay snapshot + fenced WAL
+# (replay-surfaced evictions park in the recovered bucket), the router
+# adopts bindings, drains the pending requeues, host truth re-feeds
+# idempotently, and the full lease schedule re-runs (renewals are
+# monotone).  Final fleet bindings must be bit-identical to an unkilled
+# fleet run — which itself must be bit-identical to the ARMED single
+# scheduler on the same profile (the node-loss oracle).  Cell nths map
+# to the baseline's recorded append sequence (both shards' journals +
+# map writes interleave; the kill switch counts per point per process):
+# appends 1–4 = p1/p2 commits (shard 1), 5 = the NotReady taint
+# (shard 0, clock 6), 6–8 = the mid-incident handoff record + the two
+# re-journaled imported binds (shard 0, clock 8), then the handoff's
+# map rewrite (pre-map-write@1 — the init save precedes arming),
+# 9 = v1's evict (clock 10), 10 = the Unreachable taint (clock 14),
+# 11 = v2's evict (clock 22), 12 = sticky's GC evict (clock 34),
+# 13–18 = the three rebind commits.
+FLEET_NODE_LOSS_CASES = (
+    ("post-append", 5),   # right AFTER the not-ready taint record — the
+                          # taint-write→eviction window the ISSUE names
+    ("torn-append", 6),   # the mid-incident handoff record torn
+    ("pre-map-write", 1), # handoff journaled, map rewrite lost — while
+                          # nd1 is NotReady and deadlines are armed
+    ("pre-append", 9),    # before the first eviction's record
+    ("torn-append", 9),   # the first eviction's record torn mid-write
+    ("post-append", 10),  # after the unreachable taint write
+    ("pre-append", 11),   # before the second eviction
+    ("post-append", 12),  # after the GC eviction, before its rebind
+    ("mid-snapshot", 3),  # checkpoint torn right after the first rebind
+    ("post-truncate", 2),
+)
+
+# The dead node lives in shard 0; n3 starts in shard 1 and hands off to
+# shard 0 mid-incident, so the transfer window overlaps the outage.
+FLEET_NODE_LOSS_PINS = {"nd1": 0, "n2": 0, "n3": 1, "n4": 1}
+FLEET_LIFECYCLE = {
+    "node_grace_s": 5.0,
+    "node_unreachable_s": 12.0,
+    "gc_horizon_s": 20.0,
+}
+
+
+def _fleet_node_loss_sched():
+    """The PARTITION-EXACT node-loss profile: TaintToleration stays a
+    filter (a requeued victim must not rebind to the cordoned dead node)
+    but is NOT a scorer — it normalizes over the candidate set, and
+    per-shard normalization forks from the global one whenever a tainted
+    node exists in some shards and not others (the documented Tesserae
+    compromise in fleet/router.py).  Filters and per-node additive
+    scores are shard-independent, so this profile holds the
+    fleet-vs-single oracle bit for bit."""
+    from kubernetes_tpu.framework.config import Profile
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    return TPUScheduler(
+        profile=Profile(
+            name="fleet-node-loss",
+            filters=(
+                "NodeUnschedulable", "NodeName", "TaintToleration",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+        chunk_size=1,
+    )
+
+
+def _fleet_node_loss_build(state_dir: str, recover: bool = False):
+    """(router, owners, map_path): a 2-shard journaled fleet with the
+    failure-response loop ARMED PER OWNER, every owner's delete_pod AND
+    evict_pod tombstoning host truth first."""
+    from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+    from kubernetes_tpu.fleet.takeover import recover_shard
+
+    map_path = os.path.join(state_dir, "shardmap.json")
+    if os.path.exists(map_path):
+        smap = ShardMap.load(map_path)
+    else:
+        smap = ShardMap(
+            n_shards=2, n_buckets=16,
+            overrides=dict(FLEET_NODE_LOSS_PINS),
+        )
+        smap.save(map_path)
+    owners = {}
+    for k in range(2):
+        sdir = os.path.join(state_dir, f"shard{k}")
+        os.makedirs(sdir, exist_ok=True)
+        if recover:
+            owner = recover_shard(
+                sdir, _fleet_node_loss_sched, k, smap,
+                map_path=map_path, lifecycle=FLEET_LIFECYCLE,
+            )
+        else:
+            owner = ShardOwner(
+                k, _fleet_node_loss_sched(), smap, state_dir=sdir,
+                snapshot_every_batches=1, lifecycle=FLEET_LIFECYCLE,
+            )
+        orig_delete = owner.sched.delete_pod
+        orig_evict = owner.sched.evict_pod
+
+        def delete_pod(uid, notify=True, _orig=orig_delete):
+            _truth_delete(state_dir, uid)
+            _orig(uid, notify)
+
+        def evict_pod(uid, reason="eviction", pod=None, _orig=orig_evict):
+            _truth_evict(state_dir, uid)
+            return _orig(uid, reason=reason, pod=pod)
+
+        owner.sched.delete_pod = delete_pod
+        owner.sched.evict_pod = evict_pod
+        owners[k] = owner
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    return router, owners, map_path
+
+
+def _fleet_node_loss_tail(
+    router, owners, map_path: str, state_dir: str,
+    initial_schedule: bool = True,
+):
+    """The fleet node-death scenario tail — idempotent like the single
+    one: Lease renewals are monotone, the handoff re-applies only if its
+    map assignment never landed, committed pods are skipped by adopted
+    routing.  A RECOVERY run passes ``initial_schedule=False``: pods the
+    host truth re-fed unbound (tombstone-evicted mid-incident) must not
+    schedule against un-re-derived state — the dead node relists
+    untainted, and binding anything before the lease re-run re-cordons
+    it would hand out placements the unkilled run never offered."""
+    from gen_golden_transcripts import wait_for_backoffs
+
+    from kubernetes_tpu.api import types as t
+
+    if initial_schedule:
+        router.schedule_all_pending(wait_backoff=True)
+    for name in ("nd1", "n2", "n3", "n4"):
+        router.add_object("Lease", t.Lease(name, 0.0))
+    for ts in NODE_LOSS_LEASE_TS:
+        if ts == 8.0 and router.shard_map.owner_of("n3") == 1:
+            # Mid-INCIDENT handoff: nd1 went NotReady at clock 6 and its
+            # eviction deadlines are armed while n3 (and its bound pods)
+            # transfers shard 1 → shard 0 through the journaled path —
+            # the pre-map-write window overlapping the outage.
+            rec = router.shard_map.assign("n3", 0)
+            router.apply_handoff(rec, map_path)
+        for name in ("n2", "n3", "n4"):  # nd1 went silent after t=0
+            router.add_object("Lease", t.Lease(name, ts))
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+    bindings = router.bindings()
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+    with open(os.path.join(state_dir, "metrics.json"), "w") as f:
+        json.dump(
+            {
+                "router": {
+                    "registry": router.registry.summary(),
+                    "lifecycle": router.lifecycle_stats(),
+                },
+                "owners": {
+                    str(k): {
+                        "registry": o.sched.metrics.registry.summary(),
+                        "stats": o.stats(),
+                    }
+                    for k, o in sorted(owners.items())
+                },
+            },
+            f,
+            sort_keys=True,
+            default=str,
+        )
+    return bindings
+
+
+def fleet_node_loss_child(state_dir: str) -> None:
+    """The victim: the node-death scenario through a 2-shard armed
+    journaled fleet; TPU_JOURNAL_KILL SIGKILLs at the armed point —
+    whichever owner's journal (or the mid-incident map write) hits it."""
+    from kubernetes_tpu.faults import KillSwitch
+
+    router, owners, map_path = _fleet_node_loss_build(state_dir)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, bound, pending = node_loss_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in bound:
+        router.add_object("Pod", p)
+    for p in pending:
+        router.add_pod(p)
+    _fleet_node_loss_tail(router, owners, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+
+
+def fleet_node_loss_single_child(state_dir: str) -> None:
+    """The ORACLE half: the same scenario and lease schedule through ONE
+    armed scheduler on the same partition-exact profile — the fleet
+    baseline must reproduce these bindings bit for bit."""
+    from kubernetes_tpu.api import types as t
+
+    from gen_golden_transcripts import wait_for_backoffs
+
+    sched = _fleet_node_loss_sched()
+    sched.node_lifecycle.arm(
+        grace_period_s=FLEET_LIFECYCLE["node_grace_s"],
+        unreachable_after_s=FLEET_LIFECYCLE["node_unreachable_s"],
+    )
+    sched.pod_gc.arm(gc_horizon_s=FLEET_LIFECYCLE["gc_horizon_s"])
+    nodes, bound, pending = node_loss_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in bound + pending:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    for name in ("nd1", "n2", "n3", "n4"):
+        sched.renew_node_lease(t.Lease(name, 0.0))
+    for ts in NODE_LOSS_LEASE_TS:
+        for name in ("n2", "n3", "n4"):
+            sched.renew_node_lease(t.Lease(name, ts))
+    wait_for_backoffs(sched.queue)
+    sched.schedule_all_pending(wait_backoff=True)
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(
+            {
+                uid: pr.node_name
+                for uid, pr in sched.cache.pods.items()
+                if pr.bound
+            },
+            f,
+            sort_keys=True,
+        )
+
+
+def fleet_node_loss_recover_child(state_dir: str) -> None:
+    """The takeover: fresh ARMED owners recover each shard (lost map
+    writes redone, replay-surfaced evictions parked in the recovered
+    bucket), the router adopts bindings then drains the pending
+    requeues, host truth re-feeds idempotently (the owner-side
+    recovered-taints overlay keeps journal-authored lifecycle taints
+    across the untainted relist; evicted pods relist unbound), and the
+    full lease schedule re-runs to convergence."""
+    import copy
+
+    router, owners, map_path = _fleet_node_loss_build(state_dir, recover=True)
+    deleted = _truth_deleted(state_dir)
+    evicted = _truth_evicted(state_dir)
+    nodes, bound, pending = node_loss_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    router.reconcile_recovered()
+    router.adopt_bindings()
+    router.drain_evictions()
+    for p in bound + pending:
+        if p.uid in deleted:
+            continue
+        obj = copy.deepcopy(p)
+        if obj.uid in evicted and obj.uid not in router._pod_shard:
+            obj.spec.node_name = ""  # host truth: recreated unbound
+        elif obj.uid in router._pod_shard:
+            # Already (re)bound per the owners' journals — deliver the
+            # adopted placement, not the stale original node.
+            continue
+        router.add_object("Pod", obj)
+    # Restore the tie-break cycle: the unkilled router burned one step
+    # per QUEUE-scheduled pod (the scenario's pending pods) before the
+    # incident's rebinds — adopted commits say how many of those pops
+    # already happened, so the recovery's rebind steps line up with the
+    # baseline's and score ties break identically.
+    router._cycle = sum(1 for p in pending if p.uid in router._pod_shard)
+    _fleet_node_loss_tail(
+        router, owners, map_path, state_dir, initial_schedule=False
+    )
+    for owner in owners.values():
+        owner.close()
+
+
+def _fleet_node_loss_cell_evidence(state_dir: str) -> list[str]:
+    """A killed fleet cell must leave: a readable recovery flight dump,
+    per-owner lifecycle/GC metrics with real counts (transitions and
+    evictions restored across the crash), and router loop closure —
+    every eviction absorbed and rebound, nothing pending."""
+    missing = []
+    if not _flight_dump_ok(state_dir):
+        missing.append("flight-dump")
+    try:
+        with open(os.path.join(state_dir, "metrics.json")) as f:
+            doc = json.load(f)
+        blob = json.dumps(doc)
+        for fam in (
+            "scheduler_node_lifecycle_transitions_total",
+            "scheduler_pod_gc_total",
+            "scheduler_fleet_lifecycle_lease_frames_total",
+            "scheduler_fleet_lifecycle_evictions_total",
+        ):
+            if fam not in blob:
+                missing.append(f"metrics:{fam}")
+        shard0 = doc["owners"]["0"]["stats"]["lifecycle"]
+        if not shard0["armed"]:
+            missing.append("lifecycle:not-armed")
+        if shard0["transitions"] < 1:
+            missing.append("lifecycle:no-transitions")
+        if shard0["taint_evictions"] < 1:
+            missing.append("lifecycle:no-evictions")
+        if sum(shard0["pod_gc_collected"].values()) < 1:
+            missing.append("lifecycle:no-gc")
+        if shard0["pending_eviction_requeues"] != 0:
+            missing.append("lifecycle:stranded-requeues")
+        lc = doc["router"]["lifecycle"]
+        if lc["pending_rebinds"] != 0:
+            missing.append("router:pending-rebinds")
+    except (OSError, ValueError, KeyError):
+        missing.append("metrics.json")
+    return missing
+
+
+def run_fleet_node_loss_matrix(
+    cases=FLEET_NODE_LOSS_CASES, verbose=True
+) -> list[str]:
+    """SIGKILL the fleet node-death scenario at each journal point,
+    take the shards over, and require (a) final bindings bit-identical
+    to the unkilled fleet — which must itself match the armed single
+    scheduler (the node-loss oracle) — and (b) flight dump + lifecycle
+    metrics + loop closure per killed cell."""
+    with tempfile.TemporaryDirectory() as td:
+        oracle_dir = os.path.join(td, "fleet-nl-single")
+        os.makedirs(oracle_dir)
+        rc = _spawn("--fleet-node-loss-single-child", oracle_dir)
+        oracle = _read_bindings(oracle_dir)
+        assert rc == 0 and oracle, "fleet node-loss single oracle failed"
+        base_dir = os.path.join(td, "fleet-nl-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--fleet-node-loss-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "fleet node-loss baseline failed"
+        failures = []
+        if baseline != oracle:
+            failures.append("fleetnodeloss:oracle")
+            if verbose:
+                diff = {
+                    k: (oracle.get(k), baseline.get(k))
+                    for k in set(oracle) | set(baseline)
+                    if oracle.get(k) != baseline.get(k)
+                }
+                print(f"FAIL fleet-vs-single oracle: diff={diff}")
+        elif verbose:
+            print("ok   fleetnodeloss:oracle (fleet == armed single)")
+        # The baseline itself must show the loop closed cross-shard.
+        for uid in ("default/v1", "default/v2", "default/sticky"):
+            assert baseline.get(uid) not in (None, "", "nd1"), (
+                f"fleet baseline did not reschedule {uid}: {baseline}"
+            )
+        for point, nth in cases:
+            label = f"fleetnodeloss:{point}@{nth}"
+            state_dir = os.path.join(td, f"fnl-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn(
+                "--fleet-node-loss-child", state_dir, kill=f"{point}:{nth}"
+            )
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--fleet-node-loss-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}")
+                continue
+            missing = _fleet_node_loss_cell_evidence(state_dir)
+            if missing:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: missing evidence {missing}")
+                continue
+            if verbose:
+                print(
+                    f"ok   {label}: takeover replayed the incident, "
+                    "evictions finished, bindings bit-identical"
+                )
+        return failures
+
+
 # -- the WIRE crash matrix (host and sidecar killed independently) ---------
 
 
@@ -1235,6 +1644,37 @@ def main() -> int:
     if "--wire-host-child" in sys.argv:
         wire_host_child(sys.argv[sys.argv.index("--wire-host-child") + 1])
         return 0
+    if "--fleet-node-loss-child" in sys.argv:
+        fleet_node_loss_child(
+            sys.argv[sys.argv.index("--fleet-node-loss-child") + 1]
+        )
+        return 0
+    if "--fleet-node-loss-single-child" in sys.argv:
+        fleet_node_loss_single_child(
+            sys.argv[sys.argv.index("--fleet-node-loss-single-child") + 1]
+        )
+        return 0
+    if "--fleet-node-loss-recover-child" in sys.argv:
+        fleet_node_loss_recover_child(
+            sys.argv[sys.argv.index("--fleet-node-loss-recover-child") + 1]
+        )
+        return 0
+    if "--fleet-node-loss" in sys.argv:
+        # The fleet-native failure-response subset (also rides --kill).
+        failures = run_fleet_node_loss_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(FLEET_NODE_LOSS_CASES)} fleet "
+                f"node-loss cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(FLEET_NODE_LOSS_CASES)} fleet node-loss cases: "
+            "per-owner staleness → journaled taint → eviction → router "
+            "requeue → cross-shard rebind recovered bit-identical (fleet "
+            "== armed single), flight dump + lifecycle metrics per cell"
+        )
+        return 0
     if "--fleet-kill-child" in sys.argv:
         fleet_kill_child(sys.argv[sys.argv.index("--fleet-kill-child") + 1])
         return 0
@@ -1266,9 +1706,11 @@ def main() -> int:
         failures += run_fleet_kill_matrix()
         # And the failure-response-loop subset (node death mid-scenario).
         failures += run_node_loss_matrix()
+        # And its fleet-native form (node death inside a shard).
+        failures += run_fleet_node_loss_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
-            + len(NODE_LOSS_CASES)
+            + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -154,7 +154,9 @@ def determinism_check(cfg) -> dict:
 
 def fleet_determinism_check(cfg, shards: int) -> dict:
     """Two short same-seed virtual fleet runs — the fleet's replayability
-    proof (router scatter-gather included), recorded on the artifact."""
+    proof (router scatter-gather included; with node loss armed, the
+    whole Lease-route → per-owner taint → evict → cross-shard-rebind
+    chain rides the checked op stream too), recorded on the artifact."""
     import dataclasses
 
     from kubernetes_tpu.loadgen.soak import run_fleet_soak
@@ -176,6 +178,19 @@ def fleet_determinism_check(cfg, shards: int) -> dict:
         node_flap_period_s=2.0,
         cold_consumer_period_s=2.5,
     )
+    if cfg.node_grace_s > 0:
+        # Scale the node-death clocks into the 3s window so the check
+        # exercises death → taint → evict → cross-shard rebind too.
+        small = dataclasses.replace(
+            small,
+            node_flap_period_s=0.0,
+            node_death_period_s=1.2,
+            node_death_down_s=1.0,
+            lease_interval_s=0.2,
+            node_grace_s=0.4,
+            node_unreachable_s=0.8,
+            gc_horizon_s=1.5,
+        )
     a = run_fleet_soak(small, shards)
     b = run_fleet_soak(small, shards)
     return {
@@ -194,11 +209,59 @@ def fleet_determinism_check(cfg, shards: int) -> dict:
     }
 
 
+def fleet_scaling_sweep(args, base_cfg) -> list[dict]:
+    """Shard-count scaling evidence (does N shards serve N× the
+    sustained rate?): short VIRTUAL-pace multi-process runs at
+    N ∈ {1, 2, 4} — back-to-back issue measures service throughput, not
+    the arrival pacing — each against real ``serve --shard-of``
+    children.  CPU-box numbers: all children share the same cores, so
+    the curve documents protocol overhead, not TPU-box shard scaling."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import run_fleet_soak
+
+    out = []
+    for n in (1, 2, 4):
+        cfg = dataclasses.replace(
+            base_cfg,
+            duration_s=args.scaling_seconds,
+            # Surplus arrivals: back-to-back issue must be service-bound,
+            # not arrival-bound, or every N would "sustain" the same rate.
+            rate_pods_per_s=max(base_cfg.rate_pods_per_s, 40.0),
+            pace="virtual",
+            two_process=True,
+            node_death_period_s=0.0,
+            lease_interval_s=0.0,
+            node_grace_s=0.0,  # pure serving rate: no lifecycle churn
+            cold_consumer_period_s=0.0,
+            node_flap_period_s=0.0,
+            out_dir="",
+            journal_dir="",
+        )
+        print(f"run_soak: scaling point — {n} shard(s)…", flush=True)
+        art = run_fleet_soak(cfg, n)
+        out.append(
+            {
+                "shards": n,
+                "decisions": art["decisions"],
+                "wall_s": art["wall_s"],
+                "sustained_pods_per_sec": art["sustained_pods_per_sec"],
+                "slo_p50_ms": art["slo"]["p50_ms"],
+                "slo_p99_ms": art["slo"]["p99_ms"],
+            }
+        )
+        print(f"run_soak: {json.dumps(out[-1])}", flush=True)
+    return out
+
+
 def run_fleet(args) -> int:
     """--shards N: soak the partitioned fleet (kubernetes_tpu/fleet)
-    through the loadgen scenarios — flaps pinned to shard 0, periodic
-    cold router restarts — and record the fleet SOAK artifact with
-    per-shard SLO percentiles."""
+    through the loadgen scenarios — flaps (or, with --node-loss, node
+    DEATHS) pinned to shard 0, periodic cold router restarts — against
+    REAL ``serve --shard-of`` children driven over the wire, and record
+    the fleet SOAK artifact with per-shard SLO percentiles, the
+    cross-shard eviction loop closure, and the shard-count scaling
+    sweep."""
     from kubernetes_tpu.loadgen.soak import run_fleet_soak, strip_private
 
     cfg = r06_config(args)
@@ -218,12 +281,17 @@ def run_fleet(args) -> int:
             print("run_soak: FLEET DETERMINISM CHECK FAILED", file=sys.stderr)
             return 1
     print(
-        f"run_soak: fleet soak — {args.shards} shards, seed {cfg.seed}, "
-        f"{cfg.rate_pods_per_s} pods/s for {cfg.duration_s:.0f}s…",
+        f"run_soak: fleet soak — {args.shards} MULTI-PROCESS shards "
+        f"(serve --shard-of children), seed {cfg.seed}, "
+        f"{cfg.rate_pods_per_s} pods/s for {cfg.duration_s:.0f}s"
+        + (", node-loss armed" if cfg.node_grace_s > 0 else "")
+        + "…",
         flush=True,
     )
     artifact = strip_private(run_fleet_soak(cfg, args.shards))
     artifact["determinism_check"] = check
+    if not args.skip_scaling:
+        artifact["scaling"] = fleet_scaling_sweep(args, cfg)
     artifact["environment"] = {
         "backend": os.environ.get("JAX_PLATFORMS", ""),
         "python": platform.python_version(),
@@ -243,6 +311,17 @@ def run_fleet(args) -> int:
         f"{artifact['sustained_pods_per_sec']} pods/s sustained",
         flush=True,
     )
+    nl = artifact.get("node_loss")
+    if nl:
+        print(
+            f"run_soak: fleet node-loss — {nl['node_deaths']} deaths / "
+            f"{nl['node_revives']} revives, "
+            f"{nl['evictions_absorbed']} evictions absorbed, "
+            f"{nl['rebinds']} rebinds "
+            f"({nl['cross_shard_rebinds']} cross-shard), "
+            f"{nl['pending_rebinds']} pending",
+            flush=True,
+        )
     return 0
 
 
@@ -277,9 +356,20 @@ def main() -> int:
                     default="always")
     ap.add_argument("--snapshot-every", type=int, default=24)
     ap.add_argument("--skip-determinism-check", action="store_true")
+    ap.add_argument("--skip-scaling", action="store_true",
+                    help="fleet only: skip the N∈{1,2,4} shard-count "
+                    "scaling sweep")
+    ap.add_argument("--scaling-seconds", type=float, default=45.0,
+                    help="duration of each scaling-sweep point")
     args = ap.parse_args()
     if not args.out:
-        args.out = "SOAK_r09.json" if args.node_loss else "SOAK_r06.json"
+        if args.shards:
+            args.out = (
+                "SOAK_FLEET_r10.json" if args.node_loss
+                else "SOAK_FLEET_r07.json"
+            )
+        else:
+            args.out = "SOAK_r09.json" if args.node_loss else "SOAK_r06.json"
     if not args.out_dir:
         args.out_dir = os.path.join(
             os.path.dirname(os.path.abspath(args.out)) or ".",

@@ -17,3 +17,11 @@ def tie_break(candidates):
     # the device kernel's counter-hash mirror run to run.
     seed = int(time.time())
     return candidates[seed % len(candidates)]
+
+
+def lease_home(node_name, n_shards):
+    # POSITIVE det-builtin-hash: builtin hash() is PYTHONHASHSEED-salted,
+    # so two processes would route the same node's Lease frames to
+    # DIFFERENT lifecycle controllers — crc32 (stable_shard_hash) is the
+    # cross-process-stable idiom.
+    return hash(node_name) % n_shards

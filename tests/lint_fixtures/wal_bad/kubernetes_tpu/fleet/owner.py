@@ -19,3 +19,29 @@ class BadOwner:
         # NEGATIVE: journal-before-apply, the required shape.
         self.sched._journal_append("handoff", **record)
         self.apply_handoff(payload)
+
+
+class BadLifecycleOwner:
+    """ISSUE 10: the owner-side taint/evict apply sites — a shard's
+    lifecycle controller driving them without the journal first would
+    replay a dead node as healthy (or lose the evicted pod) at the next
+    takeover."""
+
+    def taint_without_journal(self, name, taints):
+        # POSITIVE wal-unjournaled-apply: an owner writing a lifecycle
+        # taint set live with no ``taint`` record in scope.
+        self.sched._apply_node_taints(name, taints)
+
+    def evict_apply_then_append(self, uid, pod):
+        # POSITIVE wal-apply-before-journal: the eviction unwinds before
+        # its ``evict`` record exists — the crash window loses the
+        # requeue the router is owed.
+        self.sched._apply_eviction(uid, pod)
+        self.sched._journal_append("evict", uid=uid)
+
+    def healthy_evict(self, name, taints, uid, pod):
+        # NEGATIVE: journal-before-apply for both owner-side sites.
+        self.sched._journal_append("taint", node=name)
+        self.sched._apply_node_taints(name, taints)
+        self.sched._journal_append("evict", uid=uid)
+        self.sched._apply_eviction(uid, pod)

@@ -9,3 +9,10 @@ class GoodOwner:
 
     def no_apply_sites(self, names):
         return [n for n in names if n in self.sched.cache.nodes]
+
+    def lifecycle_evict(self, name, taints, uid, pod):
+        # Owner-side taint/evict: journal-before-apply (zero findings).
+        self.sched._journal_append("taint", node=name)
+        self.sched._apply_node_taints(name, taints)
+        self.sched._journal_append("evict", uid=uid)
+        self.sched._apply_eviction(uid, pod)

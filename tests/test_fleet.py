@@ -590,3 +590,457 @@ def test_fleet_binds_bit_identical_to_single_scheduler(stem, n_shards):
     same preemption victim, same unschedulable leftover — for both the
     fit-only and the full default profile."""
     assert run_fleet(stem, n_shards) == run_single(stem)
+
+
+# -- the fleet-native failure-response loop (ISSUE 10) -----------------------
+
+# ONE definition of the partition-exact node-loss profile and its clock
+# constants: the chaos matrix owns them (run_fault_matrix.py documents
+# why TaintToleration stays filter-only there), and this suite's
+# "fleet == armed single" oracle must assert the SAME claim the matrix
+# sweeps — two drifting copies would silently split them.
+import run_fault_matrix as _rfm  # noqa: E402  (scripts/ on sys.path above)
+
+LIFECYCLE = _rfm.FLEET_LIFECYCLE
+
+
+def mk_lifecycle_sched() -> TPUScheduler:
+    return _rfm._fleet_node_loss_sched()
+
+
+def arm_single() -> TPUScheduler:
+    sched = mk_lifecycle_sched()
+    sched.node_lifecycle.arm(
+        grace_period_s=LIFECYCLE["node_grace_s"],
+        unreachable_after_s=LIFECYCLE["node_unreachable_s"],
+    )
+    sched.pod_gc.arm(gc_horizon_s=LIFECYCLE["gc_horizon_s"])
+    return sched
+
+
+def build_lifecycle_fleet(
+    n_shards: int = 2,
+    pin: dict[str, int] | None = None,
+    state_root: str | None = None,
+):
+    router, owners, smap = build_fleet(
+        n_shards, pin=pin, state_root=state_root, factory=mk_lifecycle_sched
+    )
+    # build_fleet constructs disarmed owners; re-arm through the same
+    # dict `serve --shard-of --node-grace-s` passes.
+    for owner in owners.values():
+        owner.sched.node_lifecycle.arm(
+            grace_period_s=LIFECYCLE["node_grace_s"],
+            unreachable_after_s=LIFECYCLE["node_unreachable_s"],
+        )
+        owner.sched.pod_gc.arm(gc_horizon_s=LIFECYCLE["gc_horizon_s"])
+    return router, owners, smap
+
+
+def graced_pod(name: str, seconds: int, cpu: str = "1"):
+    from kubernetes_tpu.controllers import (
+        NOT_READY_TAINT_KEY,
+        UNREACHABLE_TAINT_KEY,
+    )
+
+    return (
+        make_pod(name)
+        .req({"cpu": cpu})
+        .toleration(NOT_READY_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+        .toleration(UNREACHABLE_TAINT_KEY, op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE, seconds=seconds)
+    )
+
+
+def test_lease_frames_route_to_owning_shard_only():
+    """A Lease renewal reaches exactly the owning shard's lifecycle
+    controller — a foreign owner tracking the heartbeat would taint a
+    node it does not hold."""
+    pin = {"left": 0, "right": 1}
+    router, owners, _ = build_lifecycle_fleet(2, pin=pin)
+    router.add_object("Node", big_node("left"))
+    router.add_object("Node", big_node("right"))
+    router.add_object("Lease", t.Lease("left", 1.0))
+    router.add_object("Lease", t.Lease("right", 2.0))
+    router.add_object("Lease", t.Lease("left", 3.0))
+    assert owners[0].sched.node_lifecycle.heartbeats == {"left": 3.0}
+    assert owners[1].sched.node_lifecycle.heartbeats == {"right": 2.0}
+    assert router._lease_frames.get(shard="0") == 2
+    assert router._lease_frames.get(shard="1") == 1
+
+
+def test_node_death_evicts_and_rebinds_on_another_shard():
+    """The cross-shard half of loop closure: a node dies inside shard 0,
+    the owner's lifecycle controller taints + evicts, and the router
+    requeues the pod to rebind on shard 1 — routing purged, gang credit
+    debited, PDB debits broadcast, cross-shard rebind counted."""
+    pin = {"doomed": 0, "spare": 0, "roomy": 1}
+    router, owners, _ = build_lifecycle_fleet(2, pin=pin)
+    router.add_object("Node", big_node("doomed", cpu="4"))
+    # spare keeps shard 0 viable for hashing but cannot host the victim.
+    router.add_object("Node", big_node("spare", cpu="1"))
+    router.add_object("Node", big_node("roomy", cpu="4"))
+    victim = (
+        graced_pod("victim", 4, cpu="2")
+        .label("app", "guarded")
+        .node("doomed")
+        .obj()
+    )
+    router.add_object("Pod", victim)
+    pdb = t.PodDisruptionBudget(
+        name="guard", selector={"app": "guarded"}, disruptions_allowed=3
+    )
+    router.add_object("PodDisruptionBudget", pdb)
+    assert router._pod_shard[victim.uid] == 0
+
+    for name in ("doomed", "spare", "roomy"):
+        router.add_object("Lease", t.Lease(name, 0.0))
+    for ts in range(2, 13, 2):  # doomed goes silent after t=0
+        for name in ("spare", "roomy"):
+            router.add_object("Lease", t.Lease(name, float(ts)))
+    # Staleness (>5) wrote the NotReady pair on shard 0 and the 4s
+    # toleration expired: the eviction rode a Lease response back.
+    assert owners[0].sched.taint_eviction.evictions == 1
+    assert victim.uid in router.evicted_pending
+    assert victim.uid not in router._pod_shard
+    assert router._lifecycle_evictions.get(shard="0") == 1
+    # PDB debit broadcast: both owners' copies show the disruption.
+    for owner in owners.values():
+        assert owner.sched.pdbs["guard"].disruptions_allowed == 2
+
+    outs = router.schedule_all_pending(wait_backoff=True)
+    assert [(o.pod.name, o.node_name) for o in outs if o.node_name] == [
+        ("victim", "roomy")
+    ]
+    assert router._pod_shard[victim.uid] == 1
+    assert victim.uid not in router.evicted_pending
+    assert router._lifecycle_rebinds.get(cross_shard="true") == 1
+    assert router.lifecycle_stats()["cross_shard_rebinds"] == 1
+
+
+def node_loss_feed(router_or_sched, fleet: bool):
+    """The scripted node-death op stream (run_fault_matrix's scenario),
+    driven identically through a single armed scheduler or the fleet."""
+    import run_fault_matrix as rfm
+
+    nodes, bound, pending = rfm.node_loss_objects()
+    if fleet:
+        r = router_or_sched
+        for n in nodes:
+            r.add_object("Node", n)
+        for p in bound:
+            r.add_object("Pod", p)
+        for p in pending:
+            r.add_pod(p)
+        r.schedule_all_pending(wait_backoff=True)
+        for name in ("nd1", "n2", "n3", "n4"):
+            r.add_object("Lease", t.Lease(name, 0.0))
+        for ts in rfm.NODE_LOSS_LEASE_TS:
+            for name in ("n2", "n3", "n4"):
+                r.add_object("Lease", t.Lease(name, ts))
+        wait_for_backoffs(r.queue)
+        r.schedule_all_pending(wait_backoff=True)
+        return r.bindings()
+    s = router_or_sched
+    for n in nodes:
+        s.add_node(n)
+    for p in bound + pending:
+        s.add_pod(p)
+    s.schedule_all_pending(wait_backoff=True)
+    for name in ("nd1", "n2", "n3", "n4"):
+        s.renew_node_lease(t.Lease(name, 0.0))
+    for ts in rfm.NODE_LOSS_LEASE_TS:
+        for name in ("n2", "n3", "n4"):
+            s.renew_node_lease(t.Lease(name, ts))
+    wait_for_backoffs(s.queue)
+    s.schedule_all_pending(wait_backoff=True)
+    return {
+        uid: pr.node_name
+        for uid, pr in sorted(s.cache.pods.items())
+        if pr.bound
+    }
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_fleet_node_loss_binds_bit_identical_to_armed_single(n_shards):
+    """The node-loss oracle: an N-shard fleet with per-owner lifecycle
+    reproduces the ARMED single scheduler's response to a scripted node
+    death bit for bit — same taint timeline, same evictions (graced v1/
+    v2 plus the GC-horizon sticky pod), same rebind placements."""
+    single = node_loss_feed(arm_single(), fleet=False)
+    # The doomed node's pods all rebound somewhere real.
+    for uid in ("default/v1", "default/v2", "default/sticky"):
+        assert single.get(uid) not in (None, "", "nd1"), single
+    smap = ShardMap(n_shards=n_shards, n_buckets=16)
+    owners = {
+        k: ShardOwner(k, mk_lifecycle_sched(), smap, lifecycle=LIFECYCLE)
+        for k in range(n_shards)
+    }
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    assert node_loss_feed(router, fleet=True) == single
+    # Loop closure is visible fleet-side: evictions absorbed, all
+    # rebound, nothing pending.
+    stats = router.lifecycle_stats()
+    assert stats["evictions_absorbed"] == 3
+    assert stats["rebinds"] == 3
+    assert stats["pending_rebinds"] == 0
+
+
+def test_owner_snapshot_persists_lifecycle_clock(tmp_path):
+    """The per-owner snapshot carries the logical clock, heartbeats and
+    the GC's unreachable stamps: a takeover resumes the incident's
+    timeline instead of rewinding to zero."""
+    pin = {"left": 0}
+    root = str(tmp_path / "fleet")
+    smap = ShardMap(n_shards=1, n_buckets=16, overrides=pin)
+    owner = ShardOwner(
+        0, mk_lifecycle_sched(), smap,
+        state_dir=os.path.join(root, "shard0"),
+        snapshot_every_batches=1, lifecycle=LIFECYCLE,
+    )
+    owner.add_object("Node", big_node("left"))
+    sticky = (
+        make_pod("sticky").req({"cpu": "1"})
+        .toleration("", op=t.TOLERATION_OP_EXISTS,
+                    effect=t.EFFECT_NO_EXECUTE)
+        .node("left").obj()
+    )
+    owner.add_object("Pod", sticky)
+    owner.add_object("Lease", t.Lease("left", 0.0))
+    # Advance the clock via a second (pinned) node's renewals until left
+    # is Unreachable, then snapshot.
+    smap.overrides["other"] = 0
+    owner.add_object("Node", big_node("other"))
+    owner.add_object("Lease", t.Lease("other", 14.0))
+    assert owner.sched.node_lifecycle.stats()["states"]["unreachable"] == 1
+    since = dict(owner.sched.pod_gc._unreachable_since)
+    assert since.get("left") is not None
+    from kubernetes_tpu import journal as journal_mod
+
+    owner.journal.snapshot(journal_mod.scheduler_state(owner.sched))
+    owner.close()
+
+    recovered = recover_shard(
+        os.path.join(root, "shard0"), mk_lifecycle_sched, 0, smap,
+        lifecycle=LIFECYCLE,
+    )
+    nl = recovered.sched.node_lifecycle
+    assert nl.now() == 14.0
+    assert nl.heartbeats["other"] == 14.0
+    assert recovered.sched.pod_gc._unreachable_since == since
+    recovered.close()
+
+
+def test_takeover_replays_incident_and_finishes_eviction(tmp_path):
+    """The double failure: the node dies in shard 0, the owner journals
+    the NotReady taint, then the OWNER is killed inside the taint-write→
+    eviction window.  Takeover (recover_shard) replays the taint, the
+    host-truth re-feed keeps it (the owner-side recovered-taints
+    overlay), the remaining lease schedule finishes the eviction, and
+    the router requeues the pod onto the surviving shard — converging to
+    the unkilled fleet's bindings."""
+    pin = {"doomed": 0, "spare": 0, "roomy": 1}
+    nodes = lambda: [  # noqa: E731
+        big_node("doomed", cpu="4"),
+        big_node("spare", cpu="1"),
+        big_node("roomy", cpu="4"),
+    ]
+    victim = lambda: graced_pod("victim", 4, cpu="2").node("doomed").obj()  # noqa: E731
+
+    def feed(router, upto: float):
+        for n in nodes():
+            router.add_object("Node", n)
+        router.add_object("Pod", victim())
+        for name in ("doomed", "spare", "roomy"):
+            router.add_object("Lease", t.Lease(name, 0.0))
+        for ts in range(2, int(upto) + 1, 2):
+            for name in ("spare", "roomy"):
+                router.add_object("Lease", t.Lease(name, float(ts)))
+
+    # The unkilled reference.
+    ref_router, _, _ = build_lifecycle_fleet(2, pin=pin)
+    feed(ref_router, 12.0)
+    ref_router.schedule_all_pending(wait_backoff=True)
+    reference = ref_router.bindings()
+    assert reference["default/victim"] == "roomy"
+
+    # The doomed run: stop at t=6 — the NotReady taint (grace 5) is
+    # journaled, the 4s tolerationSeconds deadline (6+4=10) has NOT
+    # fired.  Checkpoint shard 0 (the snapshot carries the tainted node,
+    # the heartbeats and the clock), then kill the owners (journals
+    # close, leases release).
+    root = str(tmp_path / "crash")
+    router, owners, _ = build_lifecycle_fleet(2, pin=pin, state_root=root)
+    feed(router, 6.0)
+    assert owners[0].sched.node_lifecycle.stats()["states"]["notready"] == 1
+    assert owners[0].sched.taint_eviction.evictions == 0
+    assert owners[0].sched.taint_eviction.pending  # deadline armed
+    from kubernetes_tpu import journal as journal_mod
+
+    owners[0].journal.snapshot(journal_mod.scheduler_state(owners[0].sched))
+    for owner in owners.values():
+        owner.journal.close()
+        owner.lease.release()
+
+    # Takeover: fresh armed owners replay each shard's journal; the
+    # taint record re-applies and re-arms the deadline against the
+    # RESTORED clock.
+    recovered = {
+        k: recover_shard(
+            os.path.join(root, f"shard{k}"), mk_lifecycle_sched, k,
+            ShardMap(n_shards=2, n_buckets=16, overrides=pin),
+            lifecycle=LIFECYCLE,
+        )
+        for k in range(2)
+    }
+    from kubernetes_tpu.controllers import NODE_NOT_READY
+
+    assert recovered[0].sched.node_lifecycle.states == {
+        "doomed": NODE_NOT_READY
+    }
+    smap2 = ShardMap(n_shards=2, n_buckets=16, overrides=pin)
+    router2 = FleetRouter(recovered, smap2, batch_size=8)
+    router2.profile_filters = tuple(recovered[0].sched.profile.filters)
+    # Host-truth re-feed: the dead node relists UNTAINTED — the owner's
+    # recovered-taints overlay must keep the journal-authored pair.
+    for n in nodes():
+        router2.add_object("Node", n)
+    router2.reconcile_recovered()
+    router2.adopt_bindings()
+    router2.drain_evictions()
+    router2.add_object("Pod", victim())  # still bound per host truth
+    rec0 = recovered[0].sched.cache.nodes["doomed"]
+    assert any(
+        taint.key == "node.kubernetes.io/not-ready"
+        for taint in rec0.node.spec.taints
+    )
+    # Re-run the FULL lease schedule (renewals are monotone: the replayed
+    # prefix is a no-op against recovered state) — t=8..12 expires the
+    # re-armed grace, the eviction journals on shard 0 and the pod
+    # rebinds on shard 1.
+    for name in ("doomed", "spare", "roomy"):
+        router2.add_object("Lease", t.Lease(name, 0.0))
+    for ts in range(2, 13, 2):
+        for name in ("spare", "roomy"):
+            router2.add_object("Lease", t.Lease(name, float(ts)))
+    router2.schedule_all_pending(wait_backoff=True)
+    assert router2.bindings() == reference
+    assert router2._lifecycle_rebinds.get(cross_shard="true") == 1
+    for owner in recovered.values():
+        owner.close()
+
+
+def test_absorb_shard_carries_pending_evictions(tmp_path):
+    """Survivor takeover mid-incident: the dead owner's journal holds an
+    evict record whose pod never rebound.  absorb_shard transfers the
+    pending requeue (and the heartbeat history) to the survivor; the
+    adopting router drains it and completes the loop."""
+    pin = {"doomed": 0, "spare": 0, "roomy": 1}
+    root = str(tmp_path / "fleet")
+    router, owners, smap = build_lifecycle_fleet(2, pin=pin, state_root=root)
+    router.add_object("Node", big_node("doomed", cpu="4"))
+    router.add_object("Node", big_node("spare", cpu="1"))
+    router.add_object("Node", big_node("roomy", cpu="4"))
+    victim = graced_pod("victim", 4, cpu="2").node("doomed").obj()
+    router.add_object("Pod", victim)
+    for name in ("doomed", "spare", "roomy"):
+        router.add_object("Lease", t.Lease(name, 0.0))
+    for ts in (2.0, 4.0, 6.0, 8.0):
+        for name in ("spare", "roomy"):
+            router.add_object("Lease", t.Lease(name, ts))
+    # Checkpoint mid-incident (the taint is in the snapshotted node
+    # state, the heartbeat history with it; no commit ever ticked the
+    # cadence on shard 0), then let the eviction fire — its record lands
+    # in the post-barrier WAL.
+    from kubernetes_tpu import journal as journal_mod
+
+    owners[0].journal.snapshot(journal_mod.scheduler_state(owners[0].sched))
+    for ts in (10.0, 12.0):
+        for name in ("spare", "roomy"):
+            router.add_object("Lease", t.Lease(name, ts))
+    # Evicted on shard 0, absorbed by the router — but shard 0 dies
+    # before any rebind: the requeue is lost WITH the router (a fresh
+    # one adopts from the owners), so the journaled evict record is the
+    # only durable copy.
+    assert victim.uid in router.evicted_pending
+    owners[0].journal.close()
+    owners[0].lease.release()
+
+    survivor = owners[1]
+    record = absorb_shard(
+        survivor, os.path.join(root, "shard0"), 0, mk_lifecycle_sched,
+        smap, lifecycle=LIFECYCLE,
+    )
+    assert record["op"] == "merge"
+    # The replayed evict transferred to the survivor's RECOVERED bucket
+    # (only the adopting router's explicit drain takes it).
+    assert [e["uid"] for e in survivor.recovered_evictions] == [victim.uid]
+    router2 = FleetRouter({1: survivor}, smap, batch_size=8)
+    router2.profile_filters = tuple(survivor.sched.profile.filters)
+    # Host-truth node re-feed (UNTAINTED shapes): the absorbed
+    # recovered-taints overlay must keep the dead node cordoned.
+    for n in ("doomed", "spare", "roomy"):
+        router2.add_object("Node", big_node(n, cpu={"doomed": "4",
+                                                    "spare": "1",
+                                                    "roomy": "4"}[n]))
+    assert any(
+        taint.key == "node.kubernetes.io/not-ready"
+        or taint.key == "node.kubernetes.io/unreachable"
+        for taint in survivor.sched.cache.nodes["doomed"].node.spec.taints
+    )
+    router2.adopt_bindings()
+    assert router2.drain_evictions() == 1
+    router2.schedule_all_pending(wait_backoff=True)
+    assert router2.bindings()["default/victim"] == "roomy"
+    survivor.close()
+
+
+def test_wire_owner_deadline_retry_and_unreachable(tmp_path):
+    """WireShardOwner: a hung owner trips the per-call deadline (counted),
+    an idempotent op reconnects and retries (counted), and a dead owner
+    exhausts the budget into FleetOwnerUnreachable — takeover's cue."""
+    from kubernetes_tpu.faults import FaultPlan
+    from kubernetes_tpu.fleet import FleetOwnerUnreachable, WireShardOwner
+    from kubernetes_tpu.framework.metrics import MetricsRegistry
+    from kubernetes_tpu.sidecar.server import SidecarClient, SidecarServer
+
+    smap = ShardMap(n_shards=1, n_buckets=16)
+    owner = ShardOwner(0, mk_sched(), smap)
+    sock = str(tmp_path / "owner.sock")
+    srv = SidecarServer(sock, scheduler=owner.sched, fleet_owner=owner)
+    srv.serve_background()
+    try:
+        registry = MetricsRegistry()
+        # First connection hangs on the first fleet frame: the deadline
+        # fires, the wire owner reconnects (fresh, unwrapped socket) and
+        # the retry succeeds.
+        plan = FaultPlan(seed=3).add_rule("hang", op="fleet", nth=1)
+        client = SidecarClient(sock, deadline_s=0.5)
+        client.sock = plan.wrap(client.sock)
+        wire = WireShardOwner(
+            client, path=sock, deadline_s=0.5, max_retries=2,
+            registry=registry, shard_id=0,
+        )
+        stats = wire.call("stats", {})
+        assert stats["shard"] == 0
+        assert registry.counter(
+            "scheduler_fleet_call_timeouts_total"
+        ).get(op="stats") == 1
+        assert registry.counter(
+            "scheduler_fleet_call_retries_total"
+        ).get(op="stats") == 1
+        # Dead owner: the server goes away, reconnects are refused, and
+        # the bounded budget degrades to FleetOwnerUnreachable.
+        srv.close()
+        if os.path.exists(sock):
+            os.unlink(sock)
+        with pytest.raises(FleetOwnerUnreachable):
+            wire.call("stats", {})
+        # A non-idempotent op never retries — straight to takeover.
+        with pytest.raises(FleetOwnerUnreachable):
+            wire.call("commit", {"pod": {}, "node": "x"})
+        wire.close()
+    finally:
+        srv.close()

@@ -87,10 +87,12 @@ def test_wal_rules_fire_on_seeded_violations():
     # One of each in the scheduler fixture + one of each in the fleet
     # handoff fixture (apply_handoff is an apply marker) + one of each
     # in the failure-response fixture (_apply_node_taints /
-    # _apply_eviction are apply markers, ISSUE 9).
-    assert got.count("wal-apply-before-journal") == 3
-    assert got.count("wal-unjournaled-apply") == 3
-    assert len(got) == 6, got  # the healthy shapes stay silent
+    # _apply_eviction are apply markers, ISSUE 9) + one of each in the
+    # OWNER-side lifecycle fixture (a shard's controller driving the
+    # taint/evict apply sites, ISSUE 10).
+    assert got.count("wal-apply-before-journal") == 4
+    assert got.count("wal-unjournaled-apply") == 4
+    assert len(got) == 8, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
@@ -120,6 +122,9 @@ def test_det_rules_fire_on_seeded_violations():
     assert got.count("det-random") == 4  # random.random/randrange + os.urandom + expovariate
     assert got.count("det-set-iteration") == 2  # for-loop + list(set(...))
     assert got.count("det-id-key") == 1
+    # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10): builtin
+    # hash() over a node name assigns different owners per process.
+    assert got.count("det-builtin-hash") == 1
 
 
 def test_det_rules_cover_loadgen():
