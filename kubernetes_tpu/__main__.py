@@ -12,7 +12,9 @@ Subcommands:
                                   speculation miss-rate knee, journal growth
   fleet <action> --map PATH       shard-map administration for the
                                   partitioned fleet (init/status/split/
-                                  merge/rebalance); serve --shard-of k/N
+                                  merge/rebalance, plus `autoscale`: an
+                                  offline load-driven decision pass over
+                                  live owners); serve --shard-of k/N
                                   joins a process to one shard
   dump --socket PATH              debugger state dump of a live sidecar
   metrics --socket PATH           Prometheus text scrape (or --events) of a live sidecar
@@ -197,11 +199,20 @@ def _fleet_owner_for(args, sched, lifecycle=None):
 
     k, _, n = args.shard_of.partition("/")
     shard_id, n_shards = int(k), int(n)
-    if not 0 <= shard_id < n_shards:
-        raise SystemExit(f"--shard-of {args.shard_of}: need 0 <= k < N")
     if os.path.exists(args.shard_map):
+        # An existing map is the ownership truth; K may exceed the
+        # original N — the elastic fleet spawns owners for shard ids the
+        # autoscaler's splits create (the child adopts the live map via
+        # the `set_map` fleet op before its first import).
+        if shard_id < 0:
+            raise SystemExit(f"--shard-of {args.shard_of}: need k >= 0")
         shard_map = ShardMap.load(args.shard_map)
     else:
+        if not 0 <= shard_id < n_shards:
+            raise SystemExit(
+                f"--shard-of {args.shard_of}: need 0 <= k < N to "
+                "initialize a fresh map"
+            )
         shard_map = ShardMap(n_shards=n_shards)
         shard_map.save(args.shard_map)
     return ShardOwner(shard_id, sched, shard_map, lifecycle=lifecycle)
@@ -481,18 +492,188 @@ def cmd_fleet(args) -> int:
                 except (OSError, RuntimeError) as exc:
                     owners[sock] = {"unreachable": str(exc)}
             doc["owners"] = owners
+        state_path = _autoscale_state_path(args)
+        if os.path.exists(state_path):
+            # The autoscaler's status mirror (live loop or `fleet
+            # autoscale` invocations): per-shard imbalance/queue/SLO
+            # snapshot, last action + cooldowns, window budget.
+            try:
+                with open(state_path) as f:
+                    doc["autoscaler"] = json.load(f)
+            except (OSError, ValueError) as exc:
+                doc["autoscaler"] = {"unreadable": str(exc)}
         print(json.dumps(doc, indent=1, sort_keys=True))
         return 0
+    if args.action == "autoscale":
+        return _fleet_autoscale(args, m)
     if args.action == "split":
-        rec = m.split(args.shard, args.new_shard)
+        rec = m.split(args.shard, args.new_shard, drop_pins=args.drop_pins)
     elif args.action == "merge":
         rec = m.merge(args.into, args.absorbed)
     elif args.action == "rebalance":
-        rec = m.rebalance(args.shards)
+        # Re-deal over the LIVE ids when the operator's --shards merely
+        # restates the current count (a gapped id space after merges
+        # must not resurrect an ownerless shard); an explicitly
+        # DIFFERENT count is a resize statement — ids 0..N-1, the
+        # operator is declaring those owners will exist.
+        live = m.shard_ids()
+        rec = m.rebalance(
+            ids=live if args.shards == len(live) else list(range(args.shards)),
+            drop_pins=args.drop_pins,
+        )
     else:
         raise SystemExit(f"unknown fleet action {args.action!r}")
     m.save(args.map)
     print(json.dumps({"handoff": rec, "map": m.to_doc()}, indent=1))
+    return 0
+
+
+def _autoscale_state_path(args) -> str:
+    return getattr(args, "state", "") or f"{args.map}.autoscaler.json"
+
+
+def _fleet_autoscale(args, m) -> int:
+    """One offline autoscaler decision pass (the `fleet autoscale`
+    action): probe each live owner's monotone commit counter over the
+    wire, difference against the state file's last probe into a window,
+    run the SAME decision core the live loop uses (fleet/autoscaler.py
+    ``choose_action``) under the same cooldown/budget damping, and print
+    the recommendation — with ``--apply``, also mutate the map file
+    (split/merge/rebalance, the offline half; the printed handoff record
+    is what the acquiring owner must journal before data moves, exactly
+    like the other fleet actions)."""
+    import time
+
+    from .fleet import AutoscalerConfig, choose_action
+    from .sidecar import SidecarClient
+
+    cfg = AutoscalerConfig(
+        split_imbalance_hi=args.split_hi,
+        merge_imbalance_lo=args.merge_lo,
+        cooldown_s=args.cooldown,
+        window_s=args.window,
+        max_actions_per_window=args.budget,
+        min_window_decisions=args.min_decisions,
+        min_shards=args.min_shards,
+        max_shards=args.max_shards,
+    )
+    state_path = _autoscale_state_path(args)
+    state: dict = {}
+    if os.path.exists(state_path):
+        try:
+            with open(state_path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            state = {}
+    now = time.time()
+    commits: dict[int, int] = {}
+    unreachable: list[str] = []
+    for sock in (s.strip() for s in args.sockets.split(",")):
+        if not sock:
+            continue
+        try:
+            client = SidecarClient(sock, deadline_s=_cli_deadline(args))
+            try:
+                stats = client.fleet("stats", {})
+            finally:
+                client.close()
+            commits[int(stats["shard"])] = int(
+                stats.get("load", {}).get("commits_total", 0)
+            )
+        except (OSError, RuntimeError) as exc:
+            unreachable.append(f"{sock}: {exc}")
+    doc: dict = {"clock": round(now, 3), "map": args.map}
+    if unreachable:
+        # Stale stats never drive an action — same contract as the live
+        # loop's FleetOwnerUnreachable deferral.
+        doc["deferred"] = "owner-unreachable"
+        doc["unreachable"] = unreachable
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 1
+    buckets_owned = {
+        s: sum(1 for b in m.buckets if b == s) for s in m.shard_ids()
+    }
+    unprobed = sorted(set(buckets_owned) - set(commits))
+    if unprobed:
+        # A map shard with no probing socket is exactly as stale as an
+        # unreachable one: defaulting its window to zero would read a
+        # live, busy shard as cold and --apply could merge it away.
+        doc["deferred"] = "unprobed-shard"
+        doc["unprobed_shards"] = unprobed
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 1
+    last = state.get("last_probe", {})
+    reset = sorted(
+        s for s, c in commits.items() if c < int(last.get(str(s), 0))
+    )
+    if reset:
+        # The monotone counter moved BACKWARDS: the owner restarted
+        # since the last probe (journal replay never re-counts commits),
+        # so this window is unknowable — clamping it to zero would read
+        # a busy, just-recovered shard as cold and --apply could merge
+        # it away.  Re-baseline and defer; the next probe has a real
+        # window.
+        doc["deferred"] = "counter-reset"
+        doc["reset_shards"] = reset
+        state["last_probe"] = {str(s): c for s, c in commits.items()}
+        state["last_run"] = doc
+        tmp = f"{state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(state, f, indent=1, sort_keys=True)
+        os.replace(tmp, state_path)
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 1
+    window = {
+        s: c - int(last.get(str(s), 0)) for s, c in commits.items()
+    }
+    action_times = [
+        t for t in state.get("action_times", ()) if t > now - cfg.window_s
+    ]
+    blocked = frozenset(
+        int(s)
+        for s, until in state.get("cooldown_until", {}).items()
+        if until > now
+    )
+    doc["window_commits"] = {str(s): window[s] for s in sorted(window)}
+    if len(action_times) >= cfg.max_actions_per_window:
+        action, reason = None, "budget"
+    else:
+        action, reason = choose_action(window, buckets_owned, cfg, blocked)
+    if action is None:
+        doc["action"] = None
+        doc["deferred"] = reason
+    else:
+        doc["action"] = action
+        if args.apply:
+            if action["op"] == "split":
+                rec = m.split(action["from"], action["to"],
+                              drop_pins=args.drop_pins)
+            elif action["op"] == "merge":
+                rec = m.merge(into=action["to"], absorbed=action["from"])
+            else:
+                rec = m.rebalance(
+                    ids=action.get("shards") or m.shard_ids(),
+                    drop_pins=args.drop_pins,
+                )
+            m.save(args.map)
+            doc["handoff"] = rec
+            doc["map_doc"] = m.to_doc()
+            action_times.append(now)
+            cooldowns = state.get("cooldown_until", {})
+            for s in (action.get("from"), action.get("to")):
+                if s is not None:
+                    cooldowns[str(s)] = now + cfg.cooldown_s
+            state["cooldown_until"] = cooldowns
+        else:
+            doc["note"] = "dry run; pass --apply to mutate the map"
+    state["last_probe"] = {str(s): c for s, c in commits.items()}
+    state["action_times"] = action_times
+    state["last_run"] = doc
+    tmp = f"{state_path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(state, f, indent=1, sort_keys=True)
+    os.replace(tmp, state_path)
+    print(json.dumps(doc, indent=1, sort_keys=True))
     return 0
 
 
@@ -635,7 +816,10 @@ def main(argv: list[str] | None = None) -> int:
         "fleet", help="shard-map administration for the partitioned fleet"
     )
     fle.add_argument(
-        "action", choices=("init", "status", "split", "merge", "rebalance")
+        "action",
+        choices=(
+            "init", "status", "split", "merge", "rebalance", "autoscale",
+        ),
     )
     fle.add_argument("--map", required=True, help="shard-map file path")
     fle.add_argument("--shards", type=int, default=2,
@@ -661,6 +845,39 @@ def main(argv: list[str] | None = None) -> int:
         help="per-owner probe deadline in seconds (status --sockets); "
         "<=0 waits forever",
     )
+    fle.add_argument(
+        "--drop-pins", action="store_true",
+        help="split only: explicitly DROP the split shard's override "
+        "pins (they fall back to the bucket rule and the names ride the "
+        "handoff record); by default pins survive on the source — never "
+        "silently remapped",
+    )
+    fle.add_argument(
+        "--state", default="", metavar="PATH",
+        help="autoscaler state/status file (cooldowns, budget, last "
+        "probe; default: <map>.autoscaler.json — `fleet status` embeds "
+        "it when present)",
+    )
+    fle.add_argument(
+        "--apply", action="store_true",
+        help="autoscale only: mutate the map file when the decision "
+        "core recommends an action (default: dry-run print)",
+    )
+    fle.add_argument("--split-hi", type=float, default=1.6,
+                     help="autoscale: split at imbalance ratio >= this")
+    fle.add_argument("--merge-lo", type=float, default=0.35,
+                     help="autoscale: merge at imbalance ratio <= this")
+    fle.add_argument("--cooldown", type=float, default=60.0,
+                     help="autoscale: per-shard cooldown seconds")
+    fle.add_argument("--window", type=float, default=300.0,
+                     help="autoscale: actions-per-window budget window")
+    fle.add_argument("--budget", type=int, default=2,
+                     help="autoscale: max actions per window")
+    fle.add_argument("--min-decisions", type=int, default=12,
+                     help="autoscale: window commits below this are "
+                     "noise (no action)")
+    fle.add_argument("--min-shards", type=int, default=1)
+    fle.add_argument("--max-shards", type=int, default=8)
     fle.set_defaults(fn=cmd_fleet)
 
     rec = sub.add_parser(

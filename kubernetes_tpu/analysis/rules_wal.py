@@ -90,6 +90,11 @@ class WalRule(Rule):
             # taint eviction) drive the journaled taint-write and evict
             # paths — any direct marker call here must journal first.
             "kubernetes_tpu/controllers.py",
+            # The elastic autoscaler (ISSUE 11) orchestrates live
+            # resharding through apply_handoff — an action path that
+            # made a transfer live without the acquiring owner's record
+            # first would be un-redoable at the next takeover.
+            "kubernetes_tpu/fleet/autoscaler.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:

@@ -190,6 +190,15 @@ KILL_POINTS = (
     # but the shard-map file rewrite has not landed — takeover must redo
     # the idempotent rewrite from the journal.
     "pre-map-write",
+    # The two remaining windows inside a live resize (ISSUE 11, the
+    # autoscaler-initiated handoff): the acquiring owner has journaled
+    # the handoff record but not yet imported a single node
+    # (post-journal/pre-import — fleet/owner.py import_nodes), and the
+    # map file is rewritten but the losing owner still holds its copies
+    # (mid-drop — fleet/router.py apply_handoff; takeover's map
+    # enforcement finishes the interrupted drop).
+    "post-handoff-append",
+    "mid-drop",
 )
 
 

@@ -23,6 +23,12 @@ preserve the global constraints.
   reserve/commit with journaled intent records).
 - ``takeover``: a dead owner's shard is taken over by a survivor with
   bit-identical journal replay behind an epoch bump.
+- ``autoscaler``: the elastic half (ISSUE 11) — a deterministic
+  load-driven control loop that watches per-shard binding-rate
+  imbalance / queue depth / SLO / reachability on the logical clock
+  and issues live split/merge/rebalance handoffs through the same
+  journaled path, with hysteresis, cooldowns, and an actions-per-window
+  budget so flapping load cannot thrash the map.
 
 The oracle discipline carries over: an N-shard fleet binds
 bit-identically to the single-scheduler run on the golden scenarios
@@ -38,3 +44,8 @@ from .owner import (  # noqa: F401
     fleet_dispatch,
 )
 from .takeover import absorb_shard, recover_shard  # noqa: F401
+from .autoscaler import (  # noqa: F401
+    AutoscalerConfig,
+    FleetAutoscaler,
+    choose_action,
+)

@@ -1,0 +1,496 @@
+"""Elastic shard autoscaler: load-driven live resharding (ISSUE 11).
+
+The shard map has journaled split/merge/rebalance and a live takeover
+path; nothing drove them — PR 10's 100%/0% shard-skew incident showed
+the fleet cannot heal its own imbalance.  This module closes that gap:
+a deterministic control loop that watches the per-shard signals the
+fleet already exports and issues live handoffs through the SAME
+journaled ``apply_handoff`` path the kill matrix proves crash-safe, so
+a mid-resize SIGKILL is a non-event (``run_fault_matrix.py
+--autoscale-kill``).  Tesserae (arxiv 2508.04953) is the grounding:
+partitions must resize under load, and resizing must be as crash-safe
+as the placements themselves.
+
+Signals (gathered at each tick, on the LOGICAL clock the caller feeds —
+the soak's scenario clock, the kill matrix's scripted clock — never a
+wall read; this module rides tpulint's determinism family):
+
+- **binding-rate imbalance** — the router's monotone per-shard commit
+  counters (``router.binds_by_shard``) differenced into a per-tick
+  window; a shard's share × N is its imbalance ratio (1.0 = fair).
+  This is the DECIDING signal: a pure function of the op stream, so
+  same-seed soaks replay the same action sequence bit for bit.
+- **queue depth** — the router queue's backlog, reported in the status
+  block (pressure context for operators; not a trigger by itself).
+- **SLO latency** — per-shard decision latencies fed by the driver
+  (``note_latency``); wall-derived, so ADVISORY by default: the p99
+  snapshot rides the status block, and only an explicitly configured
+  ``slo_split_gate_ms`` makes it gate splits (documented trade: the
+  gate costs same-seed reproducibility under real pacing).
+- **owner reachability** — a ``FleetOwnerUnreachable`` out of the tick's
+  stats probe (or reported by the driver via ``note_unreachable``)
+  DEFERS the whole tick: the loop never acts on stale stats; the shard
+  is additionally held out of actions for ``unreachable_holdoff_s``.
+
+Damping — flapping load must not thrash the map:
+
+- **hysteresis band**: split at ratio ≥ ``split_imbalance_hi``, merge at
+  ratio ≤ ``merge_imbalance_lo``; anything between is the dead band and
+  produces zero actions.
+- **per-shard cooldowns**: every shard a handoff touched is held for
+  ``cooldown_s`` of logical time.
+- **actions-per-window budget**: at most ``max_actions_per_window``
+  handoffs per trailing ``window_s``, fleet-wide.
+- **quiet gate**: fewer than ``min_window_decisions`` commits in the
+  window is noise, not signal — no action.
+
+Actions, all through the journaled handoff path:
+
+- **split** the hottest shard into a fresh shard id (``max(ids)+1``;
+  ``owner_provider`` supplies the new owner — a ShardOwner in-process, a
+  ``serve --shard-of`` child + WireShardOwner in the real fleet).
+  Override pins survive by default (shardmap.split's contract) unless
+  ``split_drops_pins`` explicitly drops them.
+- **merge** the coldest shard into the next-coldest (``owner_retirer``
+  stops the drained owner), never below ``min_shards``.
+- **rebalance** when the fleet is at ``max_shards`` and still hot — the
+  round-robin re-deal is the only remaining lever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass
+
+from ..framework.flight import FlightRecorder
+from .owner import FleetOwnerUnreachable
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    # Hysteresis band on the imbalance ratio (window share × N shards;
+    # 1.0 = perfectly fair).  Between lo and hi nothing happens.
+    split_imbalance_hi: float = 1.6
+    merge_imbalance_lo: float = 0.35
+    # Decision cadence and damping, all in LOGICAL seconds.
+    decide_every_s: float = 5.0
+    cooldown_s: float = 20.0
+    window_s: float = 60.0
+    max_actions_per_window: int = 2
+    # Fewer window commits than this is noise, not load signal.
+    min_window_decisions: int = 12
+    # Fleet-size clamps.
+    min_shards: int = 1
+    max_shards: int = 8
+    # A shard reported unreachable is held out of actions this long
+    # after the report (on top of the tick-wide stale-stats deferral).
+    unreachable_holdoff_s: float = 15.0
+    # shardmap.split: pins survive unless this explicitly drops them.
+    split_drops_pins: bool = False
+    # 0 disables the wall-latency gate (the deterministic default); > 0
+    # requires the hot shard's window p99 (ms) to exceed it before a
+    # split fires — trades same-seed reproducibility for SLO coupling.
+    slo_split_gate_ms: float = 0.0
+    # Bounded per-shard latency sample ring (status snapshot only).
+    latency_samples: int = 512
+
+
+def choose_action(
+    window_binds: dict[int, int],
+    buckets_owned: dict[int, int],
+    cfg: AutoscalerConfig,
+    blocked: frozenset[int] = frozenset(),
+) -> tuple[dict | None, str | None]:
+    """The pure decision core, shared by the live loop and the ``fleet
+    autoscale`` CLI: given the window's per-shard commit counts and the
+    map's per-shard bucket counts, return ``(action, None)`` or
+    ``(None, deferral_reason)``.  Deterministic: shards iterate sorted,
+    ties break toward the lowest id.  ``blocked`` shards (cooldown,
+    unreachable holdoff) can neither source nor receive a handoff."""
+    shards = sorted(buckets_owned)
+    n = len(shards)
+    total = sum(window_binds.get(s, 0) for s in shards)
+    if n == 0:
+        return None, "no-shards"
+    if total < cfg.min_window_decisions:
+        return None, "quiet"
+    ratios = {
+        s: (window_binds.get(s, 0) / total) * n for s in shards
+    }
+    hot = min(shards, key=lambda s: (-ratios[s], s))
+    cold = min(shards, key=lambda s: (ratios[s], s))
+    if ratios[hot] >= cfg.split_imbalance_hi:
+        if hot in blocked:
+            return None, "cooldown"
+        if n < cfg.max_shards:
+            if buckets_owned.get(hot, 0) < 2:
+                # A one-bucket (or pure-pin) shard cannot split without
+                # emptying itself — shardmap.split refuses; so do we.
+                return None, "atomic-shard"
+            return (
+                {"op": "split", "from": hot, "to": max(shards) + 1},
+                None,
+            )
+        if any(s in blocked for s in shards):
+            return None, "cooldown"
+        # At max_shards and still hot: the round-robin re-deal is the
+        # only remaining lever.  The LIVE ids ride the action — after a
+        # merge the id space has gaps, and dealing to range(n) would
+        # hand buckets to an ownerless shard.
+        return {"op": "rebalance", "n_shards": n, "shards": shards}, None
+    if ratios[cold] <= cfg.merge_imbalance_lo and n > cfg.min_shards:
+        into = min(
+            (s for s in shards if s != cold), key=lambda s: (ratios[s], s)
+        )
+        if cold in blocked or into in blocked:
+            return None, "cooldown"
+        return {"op": "merge", "from": cold, "to": into}, None
+    return None, "in-band"
+
+
+class FleetAutoscaler:
+    """The live control loop over one FleetRouter.  Drive ``tick(now)``
+    on the logical clock (the soak wires it to ``autoscale_tick``
+    scenario events); feed ``note_latency``/``note_unreachable`` as
+    decisions and failures happen.  ``owner_provider(shard_id)`` must
+    return a registered-ready owner for split-created shards;
+    ``owner_retirer(shard_id, owner)`` stops a merged-away one (default:
+    ``owner.close()``)."""
+
+    def __init__(
+        self,
+        router,
+        config: AutoscalerConfig | None = None,
+        *,
+        map_path: str | None = None,
+        owner_provider=None,
+        owner_retirer=None,
+        registry=None,
+        state_path: str | None = None,
+        flight: FlightRecorder | None = None,
+    ) -> None:
+        self.router = router
+        self.cfg = config or AutoscalerConfig()
+        self.map_path = map_path
+        self.owner_provider = owner_provider
+        self.owner_retirer = owner_retirer
+        self.state_path = state_path
+        self._now = 0.0
+        if flight is None:
+            # The marker ring is timestamped on the LOGICAL clock — a
+            # wall read here would put this module's decisions one
+            # import away from nondeterminism.
+            flight = FlightRecorder(
+                capacity=256,
+                component="fleet-autoscaler",
+                clock=lambda: self._now,
+            )
+        self.flight = flight
+        self._last_decide: float | None = None
+        self._bind_marks: dict[int, int] = {}
+        self._window_binds: dict[int, int] = {}
+        self._window_total = 0
+        self._cooldown_until: dict[int, float] = {}
+        self._unreachable_until: dict[int, float] = {}
+        self._action_times: list[float] = []
+        self.actions: list[dict] = []
+        self.last_action: dict | None = None
+        self.deferrals: dict[str, int] = {}
+        self._lat: dict[int, list[float]] = {}
+        if registry is None:
+            registry = router.registry
+        self.registry = registry
+        self._m_actions = registry.counter(
+            "scheduler_fleet_autoscaler_actions_total",
+            "Live resharding actions the autoscaler issued, by op "
+            "(split/merge/rebalance).",
+        )
+        self._m_deferrals = registry.counter(
+            "scheduler_fleet_autoscaler_deferrals_total",
+            "Autoscaler ticks that chose not to act, by reason "
+            "(in-band/quiet/cooldown/budget/owner-unreachable/"
+            "atomic-shard/no-owner-provider/slo-gate).",
+        )
+        self._m_imbalance = registry.gauge(
+            "scheduler_fleet_autoscaler_imbalance_ratio",
+            "Per-shard window binding share × shard count (1.0 = fair), "
+            "as of the last tick.",
+        )
+        self._m_shards = registry.gauge(
+            "scheduler_fleet_autoscaler_shards",
+            "Shard count after the last autoscaler tick.",
+        )
+        self._m_budget = registry.gauge(
+            "scheduler_fleet_autoscaler_budget_remaining",
+            "Actions still allowed in the trailing budget window.",
+        )
+
+    # -- driver-fed signals ------------------------------------------------
+
+    def note_latency(self, shard: int, seconds: float) -> None:
+        """Per-decision SLO latency attributed to the committing shard
+        (status snapshot; gates nothing unless slo_split_gate_ms > 0)."""
+        ring = self._lat.setdefault(shard, [])
+        ring.append(seconds)
+        if len(ring) > self.cfg.latency_samples:
+            del ring[: len(ring) - self.cfg.latency_samples]
+
+    def note_unreachable(self, shard: int) -> None:
+        """A fleet call to this owner just exhausted its deadline/retry
+        budget: hold it out of actions — takeover owns its fate."""
+        self._unreachable_until[shard] = (
+            self._now + self.cfg.unreachable_holdoff_s
+        )
+
+    def rebind_router(self, router) -> None:
+        """Follow a rebuilt front door (cold router restart / takeover
+        re-adopt): the decision window restarts at the new router's
+        commit counters — half-old, half-new windows would read restart
+        churn as load skew."""
+        self.router = router
+        self._bind_marks = dict(router.binds_by_shard)
+
+    def prime_from_bindings(self) -> None:
+        """Seed the decision window from the router's ADOPTED binding
+        distribution (takeover/restart: the fresh window counters would
+        otherwise read an imbalanced fleet as quiet).  The cumulative
+        distribution is the same pure function of the op stream the
+        window rates derive from, so a recovery re-decision matches the
+        decision the dead fleet made."""
+        dist: dict[int, int] = {}
+        for shard in self.router._pod_shard.values():
+            dist[shard] = dist.get(shard, 0) + 1
+        self._window_binds = dist
+        self._window_total = sum(dist.values())
+        self._bind_marks = dict(self.router.binds_by_shard)
+        self._primed = True
+
+    _primed = False
+
+    # -- the control loop --------------------------------------------------
+
+    def tick(self, now: float) -> list[dict]:
+        """One pass of the control loop at logical time ``now``.
+        Returns the actions taken (at most one per tick — the damped
+        cadence; the budget bounds the trailing window besides)."""
+        self._now = float(now)
+        if (
+            self._last_decide is not None
+            and now - self._last_decide < self.cfg.decide_every_s
+        ):
+            return []
+        self._last_decide = now
+        # Stale-stats gate: probe every owner before reading anything —
+        # a hung owner means the imbalance picture is partial, and a
+        # partial picture must DEFER, never act.
+        try:
+            for shard in self.router.shard_ids():
+                self.router._call(shard, "stats", {})
+        except FleetOwnerUnreachable as exc:
+            shard = getattr(exc, "shard_id", None)
+            if shard is not None:
+                self.note_unreachable(shard)
+            self._defer("owner-unreachable")
+            return []
+        cur = dict(self.router.binds_by_shard)
+        if not self._primed:
+            window = {
+                s: cur.get(s, 0) - self._bind_marks.get(s, 0)
+                for s in self.router.shard_ids()
+            }
+            self._window_binds = window
+            self._window_total = sum(window.values())
+        self._primed = False
+        self._bind_marks = cur
+        buckets_owned = self._buckets_owned()
+        n = len(buckets_owned)
+        self._m_shards.set(n)
+        total = self._window_total
+        for s in sorted(buckets_owned):
+            ratio = (
+                (self._window_binds.get(s, 0) / total) * n if total else 0.0
+            )
+            self._m_imbalance.set(round(ratio, 4), shard=str(s))
+        used = sum(
+            1 for t in self._action_times if t > now - self.cfg.window_s
+        )
+        self._m_budget.set(max(0, self.cfg.max_actions_per_window - used))
+        if used >= self.cfg.max_actions_per_window:
+            self._defer("budget")
+            return []
+        blocked = frozenset(
+            s
+            for s in buckets_owned
+            if self._cooldown_until.get(s, -1.0) > now
+            or self._unreachable_until.get(s, -1.0) > now
+        )
+        action, reason = choose_action(
+            self._window_binds, buckets_owned, self.cfg, blocked
+        )
+        if action is None:
+            self._defer(reason or "in-band")
+            return []
+        if action["op"] == "split" and self.cfg.slo_split_gate_ms > 0:
+            p99 = self._p99_ms(action["from"])
+            if p99 < self.cfg.slo_split_gate_ms:
+                self._defer("slo-gate")
+                return []
+        done = self._execute(action, now)
+        return [done] if done is not None else []
+
+    def _buckets_owned(self) -> dict[int, int]:
+        """Per-shard bucket counts, derived from the MAP — the ownership
+        truth.  A registered owner that holds no buckets (a recovered
+        directory whose handoff record was torn before it became
+        durable) is not a fleet member for sizing purposes, so a
+        takeover's re-decision picks the SAME new shard id the dead
+        fleet picked."""
+        smap = self.router.shard_map
+        owned: dict[int, int] = {}
+        for s in smap.buckets:
+            owned[s] = owned.get(s, 0) + 1
+        for s in smap.overrides.values():
+            owned.setdefault(s, 0)
+        return owned
+
+    def _defer(self, reason: str) -> None:
+        self.deferrals[reason] = self.deferrals.get(reason, 0) + 1
+        self._m_deferrals.inc(reason=reason)
+        self._persist()
+
+    def _p99_ms(self, shard: int) -> float:
+        ring = sorted(self._lat.get(shard, ()))
+        if not ring:
+            return 0.0
+        idx = min(len(ring) - 1, int(len(ring) * 0.99))
+        return ring[idx] * 1e3
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, action: dict, now: float) -> dict | None:
+        router = self.router
+        smap = router.shard_map
+        op = action["op"]
+        if op == "split":
+            new_id = action["to"]
+            if new_id not in router.owners:
+                # A takeover's re-decision may find the target owner
+                # already recovered from its journal directory (the
+                # dead fleet created it before the record tore) —
+                # reuse it; a second construction would fight its lease.
+                if self.owner_provider is None:
+                    self._defer("no-owner-provider")
+                    return None
+                router.add_owner(new_id, self.owner_provider(new_id))
+            rec = smap.split(
+                action["from"], new_id,
+                drop_pins=self.cfg.split_drops_pins,
+            )
+            touched = [action["from"], new_id]
+        elif op == "merge":
+            rec = smap.merge(into=action["to"], absorbed=action["from"])
+            touched = [action["from"], action["to"]]
+        else:  # rebalance — over the LIVE ids; pins survive unless the
+            # split policy explicitly drops them (an autonomous re-deal
+            # must not silently erase operator/takeover pins).
+            rec = smap.rebalance(
+                ids=action.get("shards") or router.shard_ids(),
+                drop_pins=self.cfg.split_drops_pins,
+            )
+            touched = router.shard_ids()
+        # Guards first (set_map — nothing durable), then the journaled
+        # transfer: the acquiring owner appends the handoff record,
+        # imports, the map file lands at the record's version, the
+        # source drops.  A SIGKILL anywhere inside is exactly what
+        # --autoscale-kill sweeps.
+        router.push_map()
+        # The journal duty is the ACQUIRING owner's: owner.import_nodes
+        # appends the handoff record before a node moves — the loop only
+        # orchestrates, so the WAL rule's apply-site check is satisfied
+        # one layer down (exactly like the matrix/soak call sites).
+        # tpulint: disable=wal-unjournaled-apply
+        router.apply_handoff(rec, self.map_path)
+        if op == "merge":
+            drained = router.remove_owner(action["from"])
+            if self.owner_retirer is not None:
+                self.owner_retirer(action["from"], drained)
+            else:
+                drained.close()
+        self._action_times.append(now)
+        self._action_times = [
+            t for t in self._action_times if t > now - self.cfg.window_s
+        ]
+        for s in touched:
+            self._cooldown_until[s] = now + self.cfg.cooldown_s
+        done = dict(action)
+        done.update(clock=round(now, 3), version=rec["version"])
+        self.actions.append(done)
+        self.last_action = done
+        self._m_actions.inc(op=op)
+        self.flight.record_marker(f"autoscale_{op}", **done)
+        self._persist()
+        return done
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The `fleet status` autoscaler block: per-shard imbalance /
+        queue-depth / SLO snapshot, last action + cooldown state, and
+        the actions-this-window budget."""
+        now = self._now
+        buckets_owned = self._buckets_owned()
+        n = len(buckets_owned)
+        total = self._window_total
+        shards = {}
+        for s in sorted(buckets_owned):
+            w = self._window_binds.get(s, 0)
+            shards[str(s)] = {
+                "window_binds": w,
+                "share": round(w / total, 4) if total else 0.0,
+                "imbalance_ratio": (
+                    round((w / total) * n, 4) if total else 0.0
+                ),
+                "buckets": buckets_owned[s],
+                "nodes": self.router._shard_node_count.get(s, 0),
+                "slo_p99_ms": round(self._p99_ms(s), 3),
+                "cooldown_remaining_s": round(
+                    max(0.0, self._cooldown_until.get(s, 0.0) - now), 3
+                ),
+                "unreachable_holdoff_s": round(
+                    max(0.0, self._unreachable_until.get(s, 0.0) - now), 3
+                ),
+            }
+        used = sum(
+            1 for t in self._action_times if t > now - self.cfg.window_s
+        )
+        return {
+            "clock": round(now, 3),
+            "shards": shards,
+            "queue_depth": len(self.router.queue),
+            "window_decisions": total,
+            "last_action": self.last_action,
+            "actions_total": len(self.actions),
+            "deferrals": dict(sorted(self.deferrals.items())),
+            "budget": {
+                "window_s": self.cfg.window_s,
+                "max_actions_per_window": self.cfg.max_actions_per_window,
+                "used_in_window": used,
+                "remaining": max(
+                    0, self.cfg.max_actions_per_window - used
+                ),
+            },
+            "config": asdict(self.cfg),
+        }
+
+    def _persist(self) -> None:
+        """Atomically mirror the status block to ``state_path`` (the
+        `fleet status`/`fleet autoscale` CLI surface; no fsync — this is
+        an observability mirror, not scheduling truth)."""
+        if not self.state_path:
+            return
+        doc = self.status()
+        tmp = f"{self.state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.state_path)

@@ -53,6 +53,11 @@ class ShardOwner:
         self.recovery_stats: dict | None = None
         self.handoffs_in = 0
         self.handoffs_out = 0
+        # Monotone commit counter — the owner-side load signal the
+        # autoscaler's wire probes diff (`stats`'s ``load`` block):
+        # commits made HERE, not bindings adopted via handoff import,
+        # so a transfer never reads as served traffic.
+        self.commits_total = 0
         # Evictions the shard's OWN controllers decided (node-lifecycle
         # taint eviction, pod GC): the owner's local queue is never
         # drained by the router, so the evicted pod rides the next fleet
@@ -227,13 +232,19 @@ class ShardOwner:
         return self.sched.propose_pod(pod)
 
     def commit(self, pod: t.Pod, node_name: str):
-        return self.sched.commit_proposed(pod, node_name)
+        out = self.sched.commit_proposed(pod, node_name)
+        if out is not None and out.node_name:
+            self.commits_total += 1
+        return out
 
     def reserve(self, pod: t.Pod, node_name: str, gang: str) -> bool:
         return self.sched.reserve_proposed(pod, node_name, gang=gang)
 
     def commit_reserved(self, uid: str):
-        return self.sched.commit_reserved(uid)
+        out = self.sched.commit_reserved(uid)
+        if out is not None and out.node_name:
+            self.commits_total += 1
+        return out
 
     def abort(self, uid: str) -> None:
         self.sched.abort_reserved(uid)
@@ -279,8 +290,15 @@ class ShardOwner:
         journal — shardmap.py), then apply the transfer.  The WAL rule
         (analysis/rules_wal.py) machine-checks this ordering: the
         apply_handoff marker must be dominated by a journal append."""
+        from .. import journal as _journal
+
         sched = self.sched
         sched._journal_append("handoff", **record)
+        # The post-journal/pre-import window (faults.KILL_POINTS
+        # "post-handoff-append", ISSUE 11): the record is durable but no
+        # node has moved — takeover redoes the lost map write from the
+        # journal and the host-truth re-feed routes the nodes here.
+        _journal._crash("post-handoff-append")
         self.apply_handoff(payload)
 
     def apply_handoff(self, payload: dict) -> None:
@@ -316,6 +334,33 @@ class ShardOwner:
                 applied += 1
             pending.pop(uid, None)
         return applied
+
+    def set_map(self, doc: dict) -> None:
+        """Adopt a shard-map revision the router is ABOUT to make durable
+        (an autoscaler resize): the guard must agree with the new
+        ownership before the import lands — a wire owner spawned for a
+        split-created shard otherwise rejects every imported node (its
+        file-loaded map predates the split), and the losing owner's
+        guard must start refusing moved nodes once the drop completes.
+        Nothing durable happens here: the map FILE is still written by
+        the orchestrating router at the handoff's version (after the
+        journaled imports), so a crash before that write leaves the old
+        map and takeover's redo converges as ever.  Idempotent; a stale
+        doc (older version than the one held) is ignored."""
+        held = self.shard_map
+        if held is not None and doc.get("version", 0) < held.version:
+            return
+        new_map = ShardMap(
+            buckets=doc["buckets"],
+            overrides=doc.get("overrides", {}),
+            version=doc.get("version", 0),
+            epoch=doc.get("epoch", 0),
+        )
+        self.shard_map = new_map
+        sid = self.shard_id
+        self.sched.shard_guard = (
+            lambda name: new_map.owner_of(name) == sid
+        )
 
     # -- cluster-global side effects mirrored locally ----------------------
 
@@ -356,6 +401,10 @@ class ShardOwner:
             "rejected_nodes": self.sched.shard_rejected_nodes,
             "handoffs_in": self.handoffs_in,
             "handoffs_out": self.handoffs_out,
+            # The autoscaler's owner-side load signal: monotone commit
+            # count (wire probes diff successive reads into a window
+            # rate) — handoff-imported bindings excluded by design.
+            "load": {"commits_total": self.commits_total},
             "epoch": (
                 self.lease.epoch
                 if self.lease
@@ -512,6 +561,9 @@ def _dispatch_op(owner: ShardOwner, op: str, payload: dict) -> dict:
         return {}
     if op == "import_nodes":
         owner.import_nodes(payload["record"], payload["payload"])
+        return {}
+    if op == "set_map":
+        owner.set_map(payload["doc"])
         return {}
     if op == "bindings":
         return {

@@ -135,6 +135,10 @@ class FleetRouter:
         self._shard_node_count: dict[int, int] = {}
         # Where each bound pod lives (commit bookkeeping + removals).
         self._pod_shard: dict[str, int] = {}
+        # Monotone per-shard commit counters — the binding-rate signal
+        # the autoscaler windows by differencing (handoff-imported
+        # bindings deliberately excluded: a transfer is not served load).
+        self.binds_by_shard: dict[int, int] = {}
         # Outcomes flipped by a gang commit — drained by schedule_batch,
         # so a member reserved in an EARLIER batch (reported unbound
         # there) still surfaces as bound in the batch whose quorum
@@ -218,6 +222,43 @@ class FleetRouter:
 
     def shard_ids(self) -> list[int]:
         return sorted(self.owners)
+
+    # -- elastic membership (the autoscaler's owner lifecycle) -------------
+
+    def add_owner(self, shard: int, owner) -> None:
+        """Register a freshly created owner for a split-created shard —
+        the in-process half of what the ctor does per owner (fleet-wide
+        gang credit visibility).  The shard owns nothing until a handoff
+        imports nodes into it."""
+        self.owners[shard] = owner
+        sched = getattr(owner, "sched", None)
+        if sched is not None:
+            sched.fleet_gang_credit = lambda g: self.gang_bound.get(g, 0)
+
+    def remove_owner(self, shard: int):
+        """Deregister a merged-away shard's owner AFTER its handoff
+        drained it (apply_handoff moved every node and binding).  Returns
+        the owner for the caller to retire (close journals / stop the
+        serve child); refuses while the shard still owns nodes."""
+        if self._shard_node_count.get(shard):
+            raise ValueError(
+                f"shard {shard} still owns "
+                f"{self._shard_node_count[shard]} node(s); merge it away "
+                "before removing its owner"
+            )
+        self._shard_node_count.pop(shard, None)
+        self.binds_by_shard.pop(shard, None)
+        return self.owners.pop(shard)
+
+    def push_map(self) -> None:
+        """Ship the CURRENT in-memory shard map to every owner
+        (``set_map``): guards must agree with a just-mutated map before
+        the handoff's imports land — a wire owner's file-loaded copy
+        predates the resize.  Nothing durable; the map file write stays
+        where apply_handoff puts it (after the journaled imports)."""
+        doc = self.shard_map.to_doc()
+        for shard in self.shard_ids():
+            self._call(shard, "set_map", {"doc": doc})
 
     # -- the object feed (the informer surface, partitioned) ---------------
 
@@ -581,6 +622,7 @@ class FleetRouter:
             self.queue.add_backoff(qp)
             return ScheduleOutcome(pod, None), False
         self._pod_shard[pod.uid] = shard
+        self.binds_by_shard[shard] = self.binds_by_shard.get(shard, 0) + 1
         self.queue.done(pod.uid)
         self._note_rebind(pod.uid, shard)
         return ScheduleOutcome(pod, node_name), False
@@ -699,6 +741,9 @@ class FleetRouter:
             res = self._call(shard, "commit_reserved", {"uid": uid})
             self._gang_commits.inc(phase="commit")
             self._pod_shard[uid] = shard
+            self.binds_by_shard[shard] = (
+                self.binds_by_shard.get(shard, 0) + 1
+            )
             self._note_rebind(uid, shard)
             self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
             room.outcomes[uid].node_name = res.get("bound")
@@ -832,6 +877,13 @@ class FleetRouter:
                 self._pod_shard[uid] = dst
         if map_path:
             self.shard_map.save(map_path)
+        # The mid-drop window (faults.KILL_POINTS, ISSUE 11): the map is
+        # durable at the new version but the losing owner still holds
+        # its copies — takeover's map-enforcement sweep finishes the
+        # interrupted drop (takeover.recover_shard).
+        from .. import journal as _journal
+
+        _journal._crash("mid-drop")
         for src, moved in drops:
             self._call(src, "drop_nodes", {"names": moved})
         self._handoffs.inc(op=record.get("op", "?"))
@@ -855,6 +907,9 @@ class FleetRouter:
             },
             "cycle": self._cycle,
             "queue": self.queue.depths(),
+            "binds_by_shard": {
+                str(k): v for k, v in sorted(self.binds_by_shard.items())
+            },
             "gang_bound": dict(self.gang_bound),
             "gang_rooms": {
                 g: sorted(r.pods) for g, r in self._gang_rooms.items()

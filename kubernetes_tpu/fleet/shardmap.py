@@ -91,21 +91,50 @@ class ShardMap:
         self.overrides[node_name] = shard
         return self._handoff("assign", prev, shard, nodes=[node_name])
 
-    def split(self, shard: int, new_shard: int) -> dict:
+    def split(
+        self, shard: int, new_shard: int, drop_pins: bool = False
+    ) -> dict:
         """Split a shard: the second half of its buckets (in bucket
-        order) moves to ``new_shard``.  Returns the handoff record."""
+        order) moves to ``new_shard``.  Returns the handoff record.
+
+        Override pins naming ``shard`` are never silently remapped to the
+        new shard: by default they SURVIVE on the source (a pin is an
+        operator/takeover decision the autoscaler must not second-guess);
+        ``drop_pins=True`` explicitly drops them instead — the pinned
+        nodes fall back to the bucket rule, and the dropped names ride
+        the handoff record (``pins_dropped``) so a takeover redo replays
+        the same choice.  A shard owning fewer than two buckets cannot
+        split (moving its only bucket would be a rename that empties the
+        source) — ValueError, before any version bump."""
         owned = [i for i, s in enumerate(self.buckets) if s == shard]
+        if len(owned) < 2:
+            raise ValueError(
+                f"shard {shard} owns {len(owned)} bucket(s); a split "
+                "needs at least 2 to leave both sides non-empty"
+            )
         moving = owned[len(owned) // 2 :]
         for i in moving:
             self.buckets[i] = new_shard
-        for n, s in sorted(self.overrides.items()):
-            if s == shard and stable_shard_hash(n, len(self.buckets)) in moving:
-                self.overrides[n] = new_shard
-        return self._handoff("split", shard, new_shard, buckets=moving)
+        pins_dropped: list[str] = []
+        if drop_pins:
+            for n, s in sorted(self.overrides.items()):
+                if s == shard:
+                    del self.overrides[n]
+                    pins_dropped.append(n)
+        return self._handoff(
+            "split", shard, new_shard, buckets=moving,
+            pins_dropped=pins_dropped,
+        )
 
     def merge(self, into: int, absorbed: int) -> dict:
         """Merge ``absorbed``'s buckets and overrides into ``into`` —
-        the takeover shape: a dead owner's whole shard transfers."""
+        the takeover shape: a dead owner's whole shard transfers.
+        Merging a shard into itself is refused (the no-op would still
+        bump the version and look like a transfer to takeover); merging
+        the last two shards down to N=1 is legal — the map degenerates
+        to the single-scheduler shape and the router serves it."""
+        if into == absorbed:
+            raise ValueError(f"cannot merge shard {into} into itself")
         moving = [i for i, s in enumerate(self.buckets) if s == absorbed]
         for i in moving:
             self.buckets[i] = into
@@ -114,12 +143,31 @@ class ShardMap:
                 self.overrides[n] = into
         return self._handoff("merge", absorbed, into, buckets=moving)
 
-    def rebalance(self, n_shards: int) -> dict:
-        """Re-deal every bucket round-robin over ``n_shards`` shards and
-        drop overrides — the from-scratch layout for a resized fleet."""
-        self.buckets = [i % max(n_shards, 1) for i in range(len(self.buckets))]
-        self.overrides = {}
-        return self._handoff("rebalance", -1, -1, n_shards=n_shards)
+    def rebalance(
+        self,
+        n_shards: int | None = None,
+        ids: list[int] | None = None,
+        drop_pins: bool = False,
+    ) -> dict:
+        """Re-deal every bucket round-robin over the given shard ids —
+        the from-scratch layout for a resized fleet.  ``ids`` names the
+        LIVE shards explicitly (after merges the id space has gaps;
+        dealing to ``range(n)`` would assign buckets to an ownerless
+        shard); ``n_shards`` alone means ids ``0..n-1``.  Pins follow
+        the split contract: they SURVIVE unless ``drop_pins`` explicitly
+        drops them, recorded on the handoff record for the redo."""
+        if ids is None:
+            ids = list(range(max(n_shards or 1, 1)))
+        ids = sorted(ids)
+        self.buckets = [ids[i % len(ids)] for i in range(len(self.buckets))]
+        pins_dropped: list[str] = []
+        if drop_pins:
+            pins_dropped = sorted(self.overrides)
+            self.overrides = {}
+        return self._handoff(
+            "rebalance", -1, -1, n_shards=len(ids), ids=ids,
+            pins_dropped=pins_dropped,
+        )
 
     def _handoff(self, op: str, src: int, dst: int, **extra) -> dict:
         """The journaled transfer record: version is bumped HERE, before

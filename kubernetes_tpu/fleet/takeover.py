@@ -41,15 +41,23 @@ def redo_handoff(shard_map: ShardMap, record: dict) -> None:
     if op in ("split", "merge"):
         for i in record.get("buckets", ()):
             shard_map.buckets[i] = record["to"]
+        # A split that explicitly dropped the source's pins records the
+        # names — the redo must replay the same choice (pins otherwise
+        # SURVIVE a split; shardmap.split never silently remaps them).
+        for n in record.get("pins_dropped", ()):
+            shard_map.overrides.pop(n, None)
     elif op == "assign":
         for n in record.get("nodes", ()):
             shard_map.overrides[n] = record["to"]
     elif op == "rebalance":
-        n_shards = record["n_shards"]
+        ids = sorted(
+            record.get("ids") or range(max(record["n_shards"], 1))
+        )
         shard_map.buckets = [
-            i % max(n_shards, 1) for i in range(len(shard_map.buckets))
+            ids[i % len(ids)] for i in range(len(shard_map.buckets))
         ]
-        shard_map.overrides = {}
+        for n in record.get("pins_dropped", ()):
+            shard_map.overrides.pop(n, None)
     shard_map.version = max(shard_map.version, record["version"])
 
 

@@ -151,6 +151,24 @@ def lease_tick_events(interval_s: float, duration_s: float) -> list[Event]:
     return out
 
 
+def autoscale_tick_events(interval_s: float, duration_s: float) -> list[Event]:
+    """The elastic-fleet control-loop cadence: every ``interval_s`` the
+    driver ticks the shard autoscaler at the SCENARIO clock — the
+    resize decision stream is a pure function of the op schedule (the
+    same logical-clock discipline the lease ticks follow), so same-seed
+    soaks replay the identical split/merge history."""
+    if interval_s <= 0:
+        return []
+    out = []
+    k = 0
+    t = interval_s
+    while t < duration_s:
+        out.append(Event(t=t, kind="autoscale_tick", data=k))
+        k += 1
+        t += interval_s
+    return out
+
+
 def cold_consumer_events(period_s: float, duration_s: float) -> list[Event]:
     """Periodic push-consumer restarts: the driver drops its decision
     map mid-stream and subscribes a fresh (cold) connection — the
@@ -183,6 +201,7 @@ def build_events(
     node_death_period_s: float = 0.0,
     node_death_down_s: float = 8.0,
     lease_interval_s: float = 0.0,
+    autoscale_interval_s: float = 0.0,
 ) -> list[Event]:
     """One phase's full scenario script, merged and time-ordered.
     Ties break by (kind, data) so the order is total and seed-stable."""
@@ -201,5 +220,6 @@ def build_events(
             churn_nodes=churn_nodes,
         )
         + lease_tick_events(lease_interval_s, duration_s)
+        + autoscale_tick_events(autoscale_interval_s, duration_s)
     )
     return sorted(events, key=lambda e: (e.t, e.kind, e.data))

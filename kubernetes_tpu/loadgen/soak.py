@@ -58,7 +58,7 @@ from ..framework.metrics import MetricsRegistry
 from ..journal import Journal
 from ..sidecar.host import DecisionCache, ResyncingClient
 from ..sidecar.server import SidecarClient
-from .arrivals import coalesce, diurnal_offsets, poisson_offsets
+from .arrivals import _rng, coalesce, diurnal_offsets, poisson_offsets
 from .scenarios import DEFAULT_INV_MIX, build_events
 from .workloads import WorkloadMix
 
@@ -98,6 +98,35 @@ class SoakConfig:
     node_grace_s: float = 0.0  # 0 = lifecycle disarmed (pre-ISSUE-9 soak)
     node_unreachable_s: float = 0.0  # 0 = grace × 2.5
     gc_horizon_s: float = 0.0  # 0 = grace × 6
+    # Elastic-fleet autoscaler (ISSUE 11; fleet soak only).  armed by
+    # autoscale=True: the driver ticks the shard autoscaler every
+    # autoscale_interval_s of SCENARIO time, and hot_fraction of
+    # arrivals carry a node selector only the hot pool (the serving
+    # nodes shard 0 owns at build time) satisfies — the diurnal crest
+    # concentrates their load on one shard until a split trips.
+    autoscale: bool = False
+    hot_fraction: float = 0.0
+    autoscale_interval_s: float = 5.0
+    autoscale_split_hi: float = 1.6
+    autoscale_merge_lo: float = 0.25
+    autoscale_cooldown_s: float = 30.0
+    autoscale_window_s: float = 60.0
+    autoscale_budget: int = 2
+    autoscale_min_decisions: int = 12
+    autoscale_max_shards: int = 4
+    # A deterministic pre-bound population scheduled BEFORE the measured
+    # window (hot-marked like the stream): the owners' stores start
+    # saturated, so the per-owner snapshot pause — the tail-latency
+    # mechanism the split halves — is in force from the first window
+    # instead of only materializing late in the run.
+    preload_bound: int = 0
+    # Pre/post comparison window for the split-recovery evidence block,
+    # and the settle gap that separates the RESIZE TRANSITION (the
+    # journaled import re-fsyncs every moved binding — a real, bounded,
+    # one-time cost the artifact reports explicitly) from the
+    # steady-state window the recovery claim compares.
+    autoscale_compare_window_s: float = 30.0
+    autoscale_compare_settle_s: float = 10.0
     # The unbounded-stream bound: completed (bound) pods beyond this cap
     # retire oldest-first, so capacity recycles and the journal sees a
     # perpetual bind+delete append stream.
@@ -1028,6 +1057,8 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     final bindings (the --shards determinism cross-check in
     scripts/run_soak.py asserts exactly that)."""
     from ..fleet import (
+        AutoscalerConfig,
+        FleetAutoscaler,
         FleetOwnerUnreachable,
         FleetRouter,
         ShardMap,
@@ -1053,6 +1084,16 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     smap = ShardMap(n_shards=shards)
     for i in range(cfg.churn_nodes):
         smap.assign(f"churn-{i}", 0)  # flaps/deaths land on shard 0 only
+    # The hot pool (ISSUE 11's hot-spot scenario): the serving nodes the
+    # INITIAL map buckets onto shard 0 carry the hot label, and
+    # hot_fraction of arrivals select on it — their load concentrates
+    # there until the autoscaler's split moves half the pool (bucketed,
+    # not pinned: pins survive splits by design and would anchor it).
+    hot_serving = (
+        {i for i in range(cfg.nodes) if smap.owner_of(f"lgn-{i}") == 0}
+        if cfg.hot_fraction > 0
+        else set()
+    )
     registry = MetricsRegistry()
     owners: dict[int, object] = {}
     procs: dict[int, object] = {}
@@ -1118,15 +1159,17 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             r.add_object("Node", n)
 
         router = mk_router()
+        autoscaler = None  # built below, once the sampling dicts exist
         for i in range(cfg.nodes):
-            feed_node(
-                router,
+            w = (
                 make_node(f"lgn-{i}")
                 .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
                 .zone(f"zone-{i % cfg.zones}")
                 .region("region-1")
-                .obj(),
             )
+            if i in hot_serving:
+                w = w.label("loadgen.tpu/hot", "1")
+            feed_node(router, w.obj())
         for i in range(cfg.churn_nodes):
             feed_node(
                 router,
@@ -1199,16 +1242,25 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         # label values the scenario can reach, then the node is restored.
         warm_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 104_729 + 31)
         for epoch in range(1, 5):
-            feed_node(
-                router,
+            w = (
                 make_node("lgn-0")
                 .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
                 .zone("zone-0")
                 .region("region-1")
                 .label("loadgen.tpu/epoch", str(epoch))
-                .obj(),
             )
+            if 0 in hot_serving:
+                w = w.label("loadgen.tpu/hot", "1")
+            feed_node(router, w.obj())
         warm = [warm_mix.pod(10_000_000 + i) for i in range(min(cfg.warm_pods, 48))]
+        if hot_serving:
+            # Half the warm wave carries the hot selector so the
+            # NodeAffinity op and its selector schema compile OUTSIDE
+            # the measured window (a first hot arrival would otherwise
+            # pay the XLA compile mid-soak).
+            for j, p in enumerate(warm):
+                if j % 2 == 0:
+                    p.spec.node_selector["loadgen.tpu/hot"] = "1"
         for p in warm:
             router.add_pod(p)
         router.schedule_all_pending()
@@ -1229,15 +1281,16 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 router.remove_object("Pod", p.uid)
             else:
                 router.queue.delete(p.uid)
-        # Restore lgn-0 to its unlabeled serving shape.
-        feed_node(
-            router,
+        # Restore lgn-0 to its serving shape (epoch label cleared).
+        w = (
             make_node("lgn-0")
             .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
             .zone("zone-0")
             .region("region-1")
-            .obj(),
         )
+        if 0 in hot_serving:
+            w = w.label("loadgen.tpu/hot", "1")
+        feed_node(router, w.obj())
 
         cap_toggle: dict[int, int] = {}
         label_epoch: dict[int, int] = {}
@@ -1266,6 +1319,78 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 wal_prev[k] = size
                 wal_samples[k].append(size)
 
+        autoscale_actions: list[dict] = []
+        lat_trace: list[tuple[float, int, float]] = []  # (t, shard, lat)
+
+        def autoscale_provider(k: int):
+            """Owner for a split-created shard: the same spawn path the
+            build uses (a real `serve --shard-of k/N` child in the
+            multi-process fleet — the map file may predate the split;
+            the router's set_map push closes that before the import),
+            plus fresh sampling slots."""
+            o = spawn_owner(k)
+            owners[k] = o
+            wal_prev.setdefault(k, 0)
+            wal_samples.setdefault(k, [])
+            compactions.setdefault(k, 0)
+            per_shard_lat.setdefault(k, [])
+            return o
+
+        def autoscale_retirer(k: int, owner) -> None:
+            """A merged-away shard's owner drains and stops; its serve
+            child (if any) terminates now and is reaped with the rest."""
+            owners.pop(k, None)
+            try:
+                owner.close()
+            except OSError:
+                pass
+            proc = procs.get(k)
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+
+        if cfg.autoscale:
+            autoscaler = FleetAutoscaler(
+                router,
+                AutoscalerConfig(
+                    split_imbalance_hi=cfg.autoscale_split_hi,
+                    merge_imbalance_lo=cfg.autoscale_merge_lo,
+                    decide_every_s=cfg.autoscale_interval_s,
+                    cooldown_s=cfg.autoscale_cooldown_s,
+                    window_s=cfg.autoscale_window_s,
+                    max_actions_per_window=cfg.autoscale_budget,
+                    min_window_decisions=cfg.autoscale_min_decisions,
+                    max_shards=cfg.autoscale_max_shards,
+                ),
+                map_path=map_path if cfg.two_process else None,
+                owner_provider=autoscale_provider,
+                owner_retirer=autoscale_retirer,
+                registry=registry,
+                state_path=os.path.join(out_dir, "autoscaler.json"),
+            )
+
+        if cfg.preload_bound:
+            # The pre-bound population: seeded, hot-marked like the
+            # stream, scheduled through the real router path (journals
+            # and all) before the window opens.  Rides the live-pod cap
+            # like any stream binding, so retirement churns it.
+            pre_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 31 + 7)
+            pre_rng = _rng(cfg.seed * 1_000_003 + 313_131)
+            pre_draws = pre_rng.random(cfg.preload_bound)
+            for i in range(cfg.preload_bound):
+                p = pre_mix.pod(20_000_000 + i)
+                if cfg.hot_fraction > 0 and pre_draws[i] < cfg.hot_fraction:
+                    p.spec.node_selector["loadgen.tpu/hot"] = "1"
+                router.add_pod(p)
+            for o in router.schedule_all_pending():
+                if o.node_name:
+                    o.pod._lg_node = o.node_name
+                    pods_by_uid[o.pod.uid] = o.pod
+                    live.append(o.pod.uid)
+            if autoscaler is not None:
+                # Preload binds are setup, not window signal: the first
+                # decision window opens at the stream.
+                autoscaler.rebind_router(router)
+
         def serving_node(i: int):
             w = (
                 make_node(f"lgn-{i}")
@@ -1281,6 +1406,10 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             )
             if label_epoch.get(i):
                 w = w.label("loadgen.tpu/epoch", str(label_epoch[i]))
+            if i in hot_serving:
+                # Hot-pool membership is fixed at build time — an
+                # invalidation re-feed must not quietly shrink it.
+                w = w.label("loadgen.tpu/hot", "1")
             return w.obj()
 
         def rebuild_router() -> FleetRouter:
@@ -1307,6 +1436,10 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             r.readopt_evictions(prior_evicted)
             for uid in sorted(pending):
                 r.add_pod(pending[uid])
+            if autoscaler is not None:
+                # The control loop follows the front door: fresh commit
+                # counters mean the next window starts at the restart.
+                autoscaler.rebind_router(r)
             return r
 
         def revive_owner(k: int) -> None:
@@ -1388,6 +1521,13 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 # survive the restart (readopt_evictions).
                 router = rebuild_router()
                 router_restarts += 1
+            elif ev.kind == "autoscale_tick":
+                # The elastic control loop, on the scenario clock: the
+                # binding-rate window is a pure function of the op
+                # stream, so the split/merge history replays same-seed.
+                if autoscaler is not None:
+                    for act in autoscaler.tick(ev.t):
+                        autoscale_actions.append(dict(act, t=ev.t))
             else:
                 raise ValueError(f"unknown fleet scenario event {ev.kind!r}")
 
@@ -1396,7 +1536,7 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             invalidation_rate_per_s=cfg.invalidation_rate_per_s,
         )
 
-        def decide(pod, deadline: float | None) -> None:
+        def decide(pod, deadline: float | None, t_ev: float = 0.0) -> None:
             uid = pod.uid
             t_issue = time.perf_counter()
             router.add_pod(pod)
@@ -1416,7 +1556,10 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             lat = t_done - base
             res.latencies.append(lat)
             if shard is not None:
-                per_shard_lat[shard].append(lat)
+                per_shard_lat.setdefault(shard, []).append(lat)
+                if autoscaler is not None:
+                    autoscaler.note_latency(shard, lat)
+                lat_trace.append((t_ev, shard, lat))
             if lat > cfg.slo_budget_ms / 1e3:
                 res.violations += 1
             res.decisions += 1
@@ -1448,6 +1591,29 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         else:
             offsets = poisson_offsets(cfg.rate_pods_per_s, cfg.duration_s, seed)
         pods = [mix.pod(i) for i in range(len(offsets))]
+        if cfg.hot_fraction > 0:
+            # A dedicated seeded stream marks hot arrivals (a pure
+            # function of (seed, arrival schedule) — the hot-spot skew
+            # replays).  Under diurnal arrivals the hot PROBABILITY
+            # rides the same day/night swing as the rate: off-crest
+            # traffic spreads fleet-wide (imbalance in-band), the crest
+            # concentrates on the hot pool — so the split trips exactly
+            # when the skew hurts, not at the first quiet tick.
+            from .arrivals import diurnal_rate
+
+            hot_rng = _rng(seed + 424_243)
+            draws = hot_rng.random(len(offsets))
+            for i, p in enumerate(pods):
+                p_hot = (
+                    diurnal_rate(
+                        offsets[i], 0.0, cfg.hot_fraction,
+                        cfg.diurnal_period_s,
+                    )
+                    if cfg.diurnal
+                    else cfg.hot_fraction
+                )
+                if draws[i] < p_hot:
+                    p.spec.node_selector["loadgen.tpu/hot"] = "1"
         scenario = build_events(
             cfg.duration_s,
             seed + 500_009,
@@ -1461,6 +1627,9 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             node_death_period_s=cfg.node_death_period_s if armed else 0.0,
             node_death_down_s=cfg.node_death_down_s,
             lease_interval_s=cfg.lease_interval_s if armed else 0.0,
+            autoscale_interval_s=(
+                cfg.autoscale_interval_s if cfg.autoscale else 0.0
+            ),
         )
         ops: list[tuple[float, int, int, object]] = []
         for j, ev in enumerate(scenario):
@@ -1479,7 +1648,7 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 sample_wal()
             else:
                 deadline = t0 + t_ev if cfg.pace == "real" else None
-                decide(pods[payload], deadline)
+                decide(pods[payload], deadline, t_ev)
 
         for t_ev, klass, _idx, payload in ops:
             if cfg.pace == "real":
@@ -1497,6 +1666,11 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 shard = getattr(exc, "shard_id", None)
                 if shard is None or not cfg.two_process:
                     raise
+                if autoscaler is not None:
+                    # Stale stats never drive a resize: the autoscaler
+                    # holds the shard out of actions while takeover
+                    # owns its fate.
+                    autoscaler.note_unreachable(shard)
                 revive_owner(shard)
                 execute(klass, payload, t_ev)
         sample_wal()
@@ -1504,6 +1678,82 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
 
         bindings = router.bindings()
         stats = router.stats()
+        autoscale = None
+        if cfg.autoscale and autoscaler is not None:
+            W = cfg.autoscale_compare_window_s
+
+            def _win_p99(shard_ids, lo: float, hi: float) -> dict:
+                lats = [
+                    lat
+                    for t, s, lat in lat_trace
+                    if lo <= t < hi and (shard_ids is None or s in shard_ids)
+                ]
+                return {
+                    "decisions": len(lats),
+                    "p99_ms": round(_pct(lats, 99) * 1e3, 3),
+                    "p50_ms": round(_pct(lats, 50) * 1e3, 3),
+                }
+
+            # Split-recovery evidence: for each split, the SPLIT shard's
+            # SLO in the window before vs the strictest honest "after" —
+            # the worst of the two shards now sharing its load, measured
+            # AFTER the settle gap (the transition window, where the
+            # journaled import re-fsyncs every moved binding, is
+            # reported separately — a resize is not free, it is bounded
+            # and crash-safe).
+            settle = cfg.autoscale_compare_settle_s
+            recovery = []
+            for act in autoscale_actions:
+                if act["op"] != "split":
+                    continue
+                ts = act["t"]
+                src, dst = act["from"], act["to"]
+                pre = _win_p99({src}, ts - W, ts)
+                post_src = _win_p99({src}, ts + settle, ts + settle + W)
+                post_dst = _win_p99({dst}, ts + settle, ts + settle + W)
+                post = max(
+                    (post_src, post_dst), key=lambda d: d["p99_ms"]
+                )
+                recovery.append(
+                    {
+                        "t_split": round(ts, 3),
+                        "shard": src,
+                        "new_shard": dst,
+                        "window_s": W,
+                        "settle_s": settle,
+                        "pre": pre,
+                        "transition": _win_p99(
+                            {src, dst}, ts, ts + settle
+                        ),
+                        "post_worst_of_pair": post,
+                        "post_src": post_src,
+                        "post_new": post_dst,
+                        "global_pre": _win_p99(None, ts - W, ts),
+                        "global_post": _win_p99(
+                            None, ts + settle, ts + settle + W
+                        ),
+                        "p99_recovered": (
+                            post["p99_ms"] < pre["p99_ms"]
+                            if pre["decisions"] and post["decisions"]
+                            else None
+                        ),
+                    }
+                )
+            autoscale = {
+                "enabled": True,
+                "hot_fraction": cfg.hot_fraction,
+                "hot_serving_nodes": len(hot_serving),
+                "actions": autoscale_actions,
+                "splits": sum(
+                    1 for a in autoscale_actions if a["op"] == "split"
+                ),
+                "merges": sum(
+                    1 for a in autoscale_actions if a["op"] == "merge"
+                ),
+                "deferrals": dict(sorted(autoscaler.deferrals.items())),
+                "split_recovery": recovery,
+                "status": autoscaler.status(),
+            }
         node_loss = None
         if armed:
             lc = router.lifecycle_stats()
@@ -1570,6 +1820,7 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         "deployment": (
             "multi-process" if cfg.two_process else "in-process"
         ),
+        "autoscale": autoscale,
         "node_loss": node_loss,
         "fleet_metrics": registry_summary,
         "determinism": {

@@ -188,6 +188,23 @@ def soak_report(doc: dict) -> str:
         f"{j.get('compactions_observed')} compaction cycles observed, "
         f"bounded={j.get('bounded')}"
     )
+    asc = doc.get("autoscale")
+    if asc:
+        out.append(
+            f"\nautoscale: {asc.get('splits')} split(s) / "
+            f"{asc.get('merges')} merge(s) over "
+            f"{asc.get('hot_serving_nodes')} hot nodes "
+            f"(hot fraction {asc.get('hot_fraction')}), "
+            f"deferrals {asc.get('deferrals')}"
+        )
+        for rec in asc.get("split_recovery", ()):
+            pre, post = rec.get("pre", {}), rec.get("post_worst_of_pair", {})
+            out.append(
+                f"  split @{rec.get('t_split')}s shard {rec.get('shard')}"
+                f"→+{rec.get('new_shard')}: p99 {pre.get('p99_ms')}ms → "
+                f"{post.get('p99_ms')}ms "
+                f"(recovered: {rec.get('p99_recovered')})"
+            )
     nl = doc.get("node_loss")
     if nl:
         lc = nl.get("lifecycle", {})

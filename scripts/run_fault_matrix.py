@@ -38,6 +38,12 @@ ordering: the victim's API DELETE commits in etcd BEFORE the scheduler's
 local state moves.
 
     JAX_PLATFORMS=cpu python scripts/run_fault_matrix.py --kill
+
+Subsets: ``--fleet-kill`` (shard failover), ``--node-loss`` /
+``--fleet-node-loss`` (the failure-response loop), ``--autoscale-kill``
+(SIGKILL inside an autoscaler-initiated live resize — ISSUE 11); all
+ride ``--kill``.  ``--only CELL`` narrows any matrix to labels
+containing the substring, and every cell line prints its wall time.
 """
 
 from __future__ import annotations
@@ -138,10 +144,65 @@ WIRE_KILL_CASES = (
     ("sidecar", "pre-append", 2),
 )
 
+# The AUTOSCALE crash subset (ISSUE 11): a 2-shard fleet with its load
+# deliberately skewed (hot pods carry a selector only shard-0 nodes
+# satisfy), the elastic autoscaler trips a SPLIT of the hot shard into a
+# fresh journaled owner, and the process is SIGKILLed at the named
+# points INSIDE that autoscaler-initiated handoff — the record durable
+# but nothing imported (post-handoff-append), imports journaled but the
+# map rewrite lost (pre-map-write), map durable but the source's drop
+# interrupted (mid-drop), the handoff record torn mid-write, an imported
+# binding's re-journal durable but unapplied, and a checkpoint torn
+# mid-resize.  Recovery is a takeover over every shard directory on
+# disk: lost map writes redo from the acquirer's journal, the map
+# enforcement sweep finishes interrupted drops, the router adopts, the
+# autoscaler re-primes its window FROM THE ADOPTED BINDINGS and
+# re-decides — a split that never became durable re-fires identically
+# (same hot shard, same new id), one that did reads as balanced and the
+# tick is a no-op.  Final bindings AND the final map must be
+# bit-identical to an unkilled run.  Nths map to the scenario's
+# recorded append sequence (each commit = gang_reserve intent + bind):
+# appends 1–20 = the ten pre-resize commits, 21 = the handoff record
+# (torn-append@21 tears it), 22–26 = the imported bindings' re-journals
+# on the acquiring owner, 27–30 = the post-resize commits;
+# mid-snapshot@11 is the checkpoint torn right after the first
+# post-resize commit.
+AUTOSCALE_KILL_CASES = (
+    ("post-handoff-append", 1),
+    ("pre-map-write", 1),
+    ("mid-drop", 1),
+    ("torn-append", 21),
+    ("post-append", 22),
+    ("post-append", 28),
+    ("mid-snapshot", 11),
+)
+
 # Per-call deadline for the sweep: small enough that a hang case costs
 # ~deadline per retry, large enough that a CPU-backend device pass (with
 # its XLA compile on first touch) never trips it spuriously.
 DEADLINE_S = 30.0
+
+# --only CELL (substring match on the printed labels) narrows any matrix
+# to the named cells — the triage loop's re-run-one-cell surface.
+ONLY: str | None = None
+
+
+def _selected(label: str) -> bool:
+    return ONLY is None or ONLY in label
+
+
+def _cell_t0() -> float:
+    import time as _time
+
+    return _time.perf_counter()
+
+
+def _cell_dt(t0: float) -> str:
+    """Per-cell wall-time suffix for the verbose lines — triage needs to
+    know WHICH cell eats the sweep's minutes."""
+    import time as _time
+
+    return f" ({_time.perf_counter() - t0:.1f}s)"
 
 
 def _drive(plan=None):
@@ -214,6 +275,9 @@ def run_matrix(cases=None, verbose=True) -> list[str]:
     assert baseline, "baseline produced no decisions"
     failures = []
     for label, plan in cases if cases is not None else matrix_cases():
+        if not _selected(label):
+            continue
+        t0 = _cell_t0()
         got = _drive(plan)
         fired = list(plan.fired)
         if got != baseline:
@@ -224,10 +288,10 @@ def run_matrix(cases=None, verbose=True) -> list[str]:
                     for k in set(baseline) | set(got)
                     if baseline.get(k) != got.get(k)
                 }
-                print(f"FAIL {label}: fired={fired} diff={diff}")
+                print(f"FAIL {label}: fired={fired} diff={diff}{_cell_dt(t0)}")
         elif verbose:
             status = "ok  " if fired else "ok (fault never matched)"
-            print(f"{status} {label}: fired={fired}")
+            print(f"{status} {label}: fired={fired}{_cell_dt(t0)}")
     return failures
 
 
@@ -397,6 +461,9 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
         failures = []
         for point, nth in cases:
             label = f"kill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
             state_dir = os.path.join(td, f"{point}-{nth}")
             os.makedirs(state_dir)
             rc = _spawn("--kill-child", state_dir, kill=f"{point}:{nth}")
@@ -410,7 +477,7 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
                     failures.append(label)
                     status = "FAIL (no kill, diverged)"
                 if verbose:
-                    print(f"{status} {label}")
+                    print(f"{status} {label}{_cell_dt(t0)}")
                 continue
             if rc != -9:
                 failures.append(label)
@@ -427,9 +494,12 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
                         for k in set(baseline) | set(got or {})
                         if baseline.get(k) != (got or {}).get(k)
                     }
-                    print(f"FAIL {label}: rc={rc} diff={diff}")
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
             elif verbose:
-                print(f"ok   {label}: recovered bit-identical bindings")
+                print(
+                    f"ok   {label}: recovered bit-identical bindings"
+                    f"{_cell_dt(t0)}"
+                )
         return failures
 
 
@@ -578,6 +648,9 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
         failures = []
         for point, nth in cases:
             label = f"fleetkill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
             state_dir = os.path.join(td, f"fleet-{point}-{nth}")
             os.makedirs(state_dir)
             rc = _spawn("--fleet-kill-child", state_dir, kill=f"{point}:{nth}")
@@ -588,7 +661,7 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
                     failures.append(label)
                     status = "FAIL (no kill, diverged)"
                 if verbose:
-                    print(f"{status} {label}")
+                    print(f"{status} {label}{_cell_dt(t0)}")
                 continue
             if rc != -9:
                 failures.append(label)
@@ -605,7 +678,7 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
                         for k in set(baseline) | set(got or {})
                         if baseline.get(k) != (got or {}).get(k)
                     }
-                    print(f"FAIL {label}: rc={rc} diff={diff}")
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
                 continue
             if not _flight_dump_ok(state_dir):
                 failures.append(label)
@@ -613,7 +686,10 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
                     print(f"FAIL {label}: no readable recovery flight dump")
                 continue
             if verbose:
-                print(f"ok   {label}: takeover recovered bit-identical bindings")
+                print(
+                    f"ok   {label}: takeover recovered bit-identical "
+                    f"bindings{_cell_dt(t0)}"
+                )
         return failures
 
 
@@ -899,6 +975,9 @@ def run_node_loss_matrix(cases=NODE_LOSS_CASES, verbose=True) -> list[str]:
         failures = []
         for point, nth in cases:
             label = f"nodeloss:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
             state_dir = os.path.join(td, f"nl-{point}-{nth}")
             os.makedirs(state_dir)
             rc = _spawn("--node-loss-child", state_dir, kill=f"{point}:{nth}")
@@ -909,7 +988,7 @@ def run_node_loss_matrix(cases=NODE_LOSS_CASES, verbose=True) -> list[str]:
                     failures.append(label)
                     status = "FAIL (no kill, diverged)"
                 if verbose:
-                    print(f"{status} {label}")
+                    print(f"{status} {label}{_cell_dt(t0)}")
                 continue
             if rc != -9:
                 failures.append(label)
@@ -937,7 +1016,8 @@ def run_node_loss_matrix(cases=NODE_LOSS_CASES, verbose=True) -> list[str]:
             if verbose:
                 print(
                     f"ok   {label}: taint→grace→evict→requeue→rebind "
-                    "recovered bit-identical, flight dump + metrics present"
+                    "recovered bit-identical, flight dump + metrics "
+                    f"present{_cell_dt(t0)}"
                 )
         return failures
 
@@ -1306,6 +1386,9 @@ def run_fleet_node_loss_matrix(
             )
         for point, nth in cases:
             label = f"fleetnodeloss:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
             state_dir = os.path.join(td, f"fnl-{point}-{nth}")
             os.makedirs(state_dir)
             rc = _spawn(
@@ -1318,7 +1401,7 @@ def run_fleet_node_loss_matrix(
                     failures.append(label)
                     status = "FAIL (no kill, diverged)"
                 if verbose:
-                    print(f"{status} {label}")
+                    print(f"{status} {label}{_cell_dt(t0)}")
                 continue
             if rc != -9:
                 failures.append(label)
@@ -1346,7 +1429,453 @@ def run_fleet_node_loss_matrix(
             if verbose:
                 print(
                     f"ok   {label}: takeover replayed the incident, "
-                    "evictions finished, bindings bit-identical"
+                    f"evictions finished, bindings bit-identical"
+                    f"{_cell_dt(t0)}"
+                )
+        return failures
+
+
+# -- the AUTOSCALE crash matrix (live resharding under SIGKILL, ISSUE 11) --
+
+
+AUTOSCALE_N_BUCKETS = 16
+
+
+def _autoscale_cfg():
+    from kubernetes_tpu.fleet import AutoscalerConfig
+
+    # Thresholds tuned so the scenario's 8-hot/2-cold skew (pre-split
+    # ratio 1.6) trips exactly ONE split and the post-split distribution
+    # (max ratio 1.5 — five of the eight hot pods ride the moved nodes)
+    # sits strictly in-band — a takeover's re-decision (window re-primed
+    # from adopted bindings) must converge to the same one-action
+    # history, killed anywhere.
+    return AutoscalerConfig(
+        split_imbalance_hi=1.55,
+        merge_imbalance_lo=0.05,
+        decide_every_s=0.0,
+        cooldown_s=0.0,
+        window_s=100.0,
+        max_actions_per_window=2,
+        min_window_decisions=4,
+        max_shards=4,
+    )
+
+
+def _autoscale_sched():
+    """Partition-exact profile with NodeAffinity (the hot pods steer via
+    node_selector) — filters + an additive scorer only, so fleet sizing
+    never perturbs the per-node verdicts themselves."""
+    from kubernetes_tpu.framework.config import Profile
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    return TPUScheduler(
+        profile=Profile(
+            name="autoscale",
+            filters=(
+                "NodeUnschedulable", "NodeName", "NodeAffinity",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+        chunk_size=1,
+    )
+
+
+def _autoscale_node_names():
+    """Six hot names bucket-owned by shard 0 and two cold ones by shard
+    1 under the initial 2-shard map — crc32 is cross-process stable, so
+    the skew is a property of the names, not of overrides (pins survive
+    splits by design and would anchor the load).  The hot six straddle
+    the split boundary (three in the bucket half a split keeps, three in
+    the half it moves), so the post-split distribution sits comfortably
+    in-band and the one-split history is stable under re-decision."""
+    from kubernetes_tpu.fleet import ShardMap
+    from kubernetes_tpu.fleet.shardmap import stable_shard_hash
+
+    probe = ShardMap(n_shards=2, n_buckets=AUTOSCALE_N_BUCKETS)
+    owned = [i for i, s in enumerate(probe.buckets) if s == 0]
+    keep_half = set(owned[: len(owned) // 2])
+    move_half = set(owned[len(owned) // 2:])
+    cands = [f"an{i}" for i in range(400)]
+    keep = [
+        n for n in cands
+        if stable_shard_hash(n, AUTOSCALE_N_BUCKETS) in keep_half
+    ][:3]
+    move = [
+        n for n in cands
+        if stable_shard_hash(n, AUTOSCALE_N_BUCKETS) in move_half
+    ][:3]
+    hot = keep + move
+    cold = [n for n in cands if probe.owner_of(n) == 1][:2]
+    return hot, cold
+
+
+def autoscale_objects():
+    """The skewed-load scenario: hot nodes carry ``hot=1`` and distinct
+    capacities (no score ties anywhere in the run — recovery re-burns
+    tie-break steps at different batch boundaries), hot pods carry the
+    matching selector, so shard 0 commits 8 of 10 decisions and the
+    imbalance ratio lands at 1.6 — above the 1.5 split threshold."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+    hot, cold = _autoscale_node_names()
+    nodes = [
+        make_node(n)
+        .capacity({"cpu": str(8 + i), "memory": "32Gi", "pods": 64})
+        .label("hot", "1")
+        .obj()
+        for i, n in enumerate(hot)
+    ] + [
+        make_node(n)
+        .capacity({"cpu": str(4 + i), "memory": "16Gi", "pods": 64})
+        .obj()
+        for i, n in enumerate(cold)
+    ]
+    pending = [
+        make_pod(f"h{i}")
+        .req({"cpu": f"{500 + i * 10}m", "memory": "256Mi"})
+        .node_selector({"hot": "1"})
+        .obj()
+        for i in range(8)
+    ] + [
+        make_pod(f"f{i}")
+        .req({"cpu": f"{300 + i * 10}m", "memory": "128Mi"})
+        .obj()
+        for i in range(2)
+    ]
+    post = [
+        make_pod(f"post{i}")
+        .req({"cpu": f"{200 + i * 10}m", "memory": "64Mi"})
+        .node_selector({"hot": "1"})
+        .obj()
+        for i in range(2)
+    ]
+    return nodes, pending, post
+
+
+def _autoscale_build(state_dir: str, recover: bool = False):
+    """(router, autoscaler, owners, map_path): the skewed 2-shard
+    journaled fleet with the elastic autoscaler wired over it.
+    ``recover`` takes over every shard DIRECTORY on disk — the map may
+    not have heard of a split-created shard whose handoff record is the
+    only durable trace (redo_lost_map_writes closes exactly that)."""
+    import glob
+
+    from kubernetes_tpu.fleet import (
+        FleetAutoscaler,
+        FleetRouter,
+        ShardMap,
+        ShardOwner,
+    )
+    from kubernetes_tpu.fleet.takeover import recover_shard
+
+    map_path = os.path.join(state_dir, "shardmap.json")
+    if os.path.exists(map_path):
+        smap = ShardMap.load(map_path)
+    else:
+        smap = ShardMap(n_shards=2, n_buckets=AUTOSCALE_N_BUCKETS)
+        smap.save(map_path)
+
+    def _wrap_truth(owner):
+        orig_delete = owner.sched.delete_pod
+
+        def delete_pod(uid, notify=True, _orig=orig_delete):
+            _truth_delete(state_dir, uid)
+            _orig(uid, notify)
+
+        owner.sched.delete_pod = delete_pod
+        return owner
+
+    def make_owner(k: int) -> ShardOwner:
+        sdir = os.path.join(state_dir, f"shard{k}")
+        os.makedirs(sdir, exist_ok=True)
+        return _wrap_truth(
+            ShardOwner(
+                k, _autoscale_sched(), smap, state_dir=sdir,
+                snapshot_every_batches=1,
+            )
+        )
+
+    owners = {}
+    if recover:
+        from kubernetes_tpu.fleet.shardmap import read_version
+        from kubernetes_tpu.fleet.takeover import redo_handoff
+
+        # Take over every shard DIRECTORY on disk — a split-created
+        # shard may exist only as a journal whose handoff record is the
+        # sole durable trace of the resize.  No map enforcement here:
+        # mid-transfer, bindings can live solely on the LOSING side, and
+        # an enforcement drop would force re-scheduling (placements
+        # could diverge); the recovery child instead FINISHES the
+        # transfer through the journaled import path.
+        shard_ids = sorted(
+            {
+                int(os.path.basename(d)[len("shard"):])
+                for d in glob.glob(os.path.join(state_dir, "shard*"))
+                if os.path.isdir(d)
+                and os.path.basename(d)[len("shard"):].isdigit()
+            }
+            | set(smap.shard_ids())
+        )
+        for k in shard_ids:
+            sdir = os.path.join(state_dir, f"shard{k}")
+            os.makedirs(sdir, exist_ok=True)
+            owners[k] = _wrap_truth(
+                recover_shard(sdir, _autoscale_sched, k, shard_map=None)
+            )
+        # Redo lost map writes from every owner's recovered handoff
+        # records (the append→map-rewrite window), then install guards
+        # at the converged map.
+        lost = []
+        for k in sorted(owners):
+            recs = (
+                getattr(owners[k].sched, "_recovered_handoffs", None)
+                or []
+            )
+            lost += [r for r in recs if r["version"] > smap.version]
+        for rec in sorted(lost, key=lambda r: r["version"]):
+            redo_handoff(smap, rec)
+        if smap.version > read_version(map_path):
+            smap.save(map_path)
+        doc = smap.to_doc()
+        for k in sorted(owners):
+            owners[k].set_map(doc)
+    else:
+        for k in range(2):
+            owners[k] = make_owner(k)
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    autoscaler = FleetAutoscaler(
+        router,
+        _autoscale_cfg(),
+        map_path=map_path,
+        owner_provider=make_owner,
+        state_path=os.path.join(state_dir, "autoscaler.json"),
+    )
+    return router, autoscaler, owners, map_path
+
+
+def _autoscale_tail(
+    router, autoscaler, owners, map_path: str, state_dir: str,
+    initial_schedule: bool = True,
+):
+    """The scenario tail — idempotent: the script's one autoscaler
+    evaluation ran against the VERSION-0 map, and the map version is the
+    durable marker of whether its effect landed.  A recovery whose map
+    is still at version 0 re-primes from the adopted bindings (the
+    pre-resize distribution — the kill necessarily predates any
+    post-resize commit) and re-decides the identical split; a recovery
+    whose map already advanced ticks unprimed, reads a near-empty
+    window, and defers (quiet) — the resize is history, not a pending
+    decision.  Post-resize pods prove the elastic fleet still serves."""
+    from gen_golden_transcripts import wait_for_backoffs
+
+    if initial_schedule:
+        router.schedule_all_pending(wait_backoff=True)
+    if router.shard_map.version == 0:
+        autoscaler.prime_from_bindings()
+    autoscaler.tick(1.0)
+    _nodes, _pending, post = autoscale_objects()
+    for p in post:
+        if p.uid not in router._pod_shard:
+            router.add_pod(p)
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+    bindings = router.bindings()
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+    with open(os.path.join(state_dir, "autoscale.json"), "w") as f:
+        json.dump(
+            {
+                "map": router.shard_map.to_doc(),
+                "actions": autoscaler.actions,
+                "deferrals": autoscaler.deferrals,
+                "status": autoscaler.status(),
+                "registry": router.registry.summary(),
+            },
+            f,
+            sort_keys=True,
+            default=str,
+        )
+    return bindings
+
+
+def autoscale_kill_child(state_dir: str) -> None:
+    """The victim: skewed load trips the autoscaler's split;
+    TPU_JOURNAL_KILL SIGKILLs inside the autoscaler-initiated handoff
+    (post-handoff-append / pre-map-write / mid-drop / torn record /
+    imported-bind re-journal / checkpoint)."""
+    from kubernetes_tpu.faults import KillSwitch
+
+    router, autoscaler, owners, map_path = _autoscale_build(state_dir)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, pending, _post = autoscale_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in pending:
+        router.add_pod(p)
+    _autoscale_tail(router, autoscaler, owners, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+
+
+def autoscale_recover_child(state_dir: str) -> None:
+    """The takeover: every shard directory recovers behind an epoch
+    bump, lost map writes redo, the map-enforcement sweep finishes
+    interrupted drops, the router adopts, and the tail re-runs — the
+    autoscaler's re-decision converging on the same one-split history."""
+    router, autoscaler, owners, map_path = _autoscale_build(
+        state_dir, recover=True
+    )
+    deleted = _truth_deleted(state_dir)
+    nodes, pending, post = autoscale_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    # Finish any transfer the crash interrupted: nodes a losing owner
+    # still holds that the (possibly just-redone) map assigns elsewhere
+    # move NOW through the journaled import path — their bindings ride
+    # along instead of being dropped and re-scheduled, so placements
+    # stay bit-identical to the unkilled run.  The synthetic record's
+    # version equals the durable map's, so a later recovery never
+    # mistakes it for a lost map write; with nothing left to move the
+    # sweep is a no-op.
+    router.apply_handoff(
+        {"op": "rebalance", "version": router.shard_map.version}, None
+    )
+    router.reconcile_recovered()
+    router.adopt_bindings()
+    for p in pending:
+        if p.uid not in deleted and p.uid not in router._pod_shard:
+            router.add_pod(p)
+    # Tie-break continuity (the fleet node-loss recovery's trick): the
+    # dead router burned one step per queue-scheduled pod, post-resize
+    # commits included.
+    router._cycle = sum(
+        1 for p in pending + post if p.uid in router._pod_shard
+    )
+    _autoscale_tail(router, autoscaler, owners, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+
+
+def _autoscale_cell_evidence(state_dir: str) -> list[str]:
+    """A killed autoscale cell must leave: a readable recovery flight
+    dump, a final map showing the split (3 shards), exactly one split in
+    the converged action history or a no-op tick over an already-resized
+    map, and the scheduler_fleet_autoscaler_* families in the metrics
+    snapshot."""
+    missing = []
+    if not _flight_dump_ok(state_dir):
+        missing.append("flight-dump")
+    try:
+        with open(os.path.join(state_dir, "autoscale.json")) as f:
+            doc = json.load(f)
+        shards = sorted(set(doc["map"]["buckets"]))
+        if len(shards) != 3:
+            missing.append(f"map:{len(shards)}-shards")
+        blob = json.dumps(doc)
+        if "scheduler_fleet_autoscaler_imbalance_ratio" not in blob:
+            missing.append("metrics:imbalance_ratio")
+        # The recovery's tick either re-acted (actions_total) or read
+        # the durable resize and deferred (deferrals_total) — one of
+        # the two families must have materialized.
+        if (
+            "scheduler_fleet_autoscaler_actions_total" not in blob
+            and "scheduler_fleet_autoscaler_deferrals_total" not in blob
+        ):
+            missing.append("metrics:no-autoscaler-families")
+    except (OSError, ValueError, KeyError):
+        missing.append("autoscale.json")
+    return missing
+
+
+def run_autoscale_kill_matrix(
+    cases=AUTOSCALE_KILL_CASES, verbose=True
+) -> list[str]:
+    """SIGKILL the fleet inside an autoscaler-initiated split at each
+    named point, take the shards over, and require final bindings AND
+    the final shard map bit-identical to an unkilled run, plus a flight
+    dump + autoscaler metrics per killed cell."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "autoscale-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--autoscale-kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "autoscale baseline run failed"
+        with open(os.path.join(base_dir, "autoscale.json")) as f:
+            base_auto = json.load(f)
+        base_map = base_auto["map"]
+        assert [a["op"] for a in base_auto["actions"]] == ["split"], (
+            f"baseline must trip exactly one split: {base_auto['actions']}"
+        )
+        failures = []
+        for point, nth in cases:
+            label = f"autoscalekill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
+            state_dir = os.path.join(td, f"as-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn(
+                "--autoscale-kill-child", state_dir, kill=f"{point}:{nth}"
+            )
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}{_cell_dt(t0)}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--autoscale-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+                continue
+            try:
+                with open(os.path.join(state_dir, "autoscale.json")) as f:
+                    got_map = json.load(f)["map"]
+            except (OSError, ValueError, KeyError):
+                got_map = None
+            if got_map is None or (
+                got_map["buckets"] != base_map["buckets"]
+                or got_map["overrides"] != base_map["overrides"]
+            ):
+                failures.append(label)
+                if verbose:
+                    print(
+                        f"FAIL {label}: recovered map diverged "
+                        f"({got_map} vs {base_map}){_cell_dt(t0)}"
+                    )
+                continue
+            missing = _autoscale_cell_evidence(state_dir)
+            if missing:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: missing evidence {missing}")
+                continue
+            if verbose:
+                print(
+                    f"ok   {label}: mid-resize kill converged — same "
+                    f"split, same map, bit-identical bindings"
+                    f"{_cell_dt(t0)}"
                 )
         return failures
 
@@ -1583,6 +2112,9 @@ def run_wire_kill_matrix(cases=WIRE_KILL_CASES, verbose=True) -> list[str]:
         failures = []
         for side, point, nth in cases:
             label = f"wirekill:{side}:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
             state_dir = os.path.join(td, f"wire-{side}-{point}-{nth}")
             got, fired = _run_wire_cell(state_dir, side, f"{point}:{nth}")
             if got != baseline:
@@ -1602,11 +2134,21 @@ def run_wire_kill_matrix(cases=WIRE_KILL_CASES, verbose=True) -> list[str]:
                 continue
             if verbose:
                 status = "ok  " if fired else "ok (kill never fired)"
-                print(f"{status} {label}")
+                print(f"{status} {label}{_cell_dt(t0)}")
         return failures
 
 
 def main() -> int:
+    global ONLY
+    if "--only" in sys.argv:
+        # Narrow any matrix to cells whose label contains the given
+        # substring (e.g. --only autoscalekill:pre-map-write@1) and
+        # print per-cell wall time — the one-cell triage loop.
+        ONLY = sys.argv[sys.argv.index("--only") + 1]
+        print(
+            f"--only {ONLY!r}: running matching cells only (the summary "
+            "line still counts the full case list)"
+        )
     if "--kill-child" in sys.argv:
         kill_child(sys.argv[sys.argv.index("--kill-child") + 1])
         return 0
@@ -1675,6 +2217,32 @@ def main() -> int:
             "== armed single), flight dump + lifecycle metrics per cell"
         )
         return 0
+    if "--autoscale-kill-child" in sys.argv:
+        autoscale_kill_child(
+            sys.argv[sys.argv.index("--autoscale-kill-child") + 1]
+        )
+        return 0
+    if "--autoscale-recover-child" in sys.argv:
+        autoscale_recover_child(
+            sys.argv[sys.argv.index("--autoscale-recover-child") + 1]
+        )
+        return 0
+    if "--autoscale-kill" in sys.argv:
+        # The mid-resize subset alone (also rides --kill): SIGKILL
+        # inside an autoscaler-initiated split.
+        failures = run_autoscale_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(AUTOSCALE_KILL_CASES)} "
+                f"autoscale kill cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(AUTOSCALE_KILL_CASES)} autoscale kill cases: a "
+            "SIGKILL inside the live resize converged to the same split, "
+            "same map, bit-identical bindings"
+        )
+        return 0
     if "--fleet-kill-child" in sys.argv:
         fleet_kill_child(sys.argv[sys.argv.index("--fleet-kill-child") + 1])
         return 0
@@ -1708,9 +2276,13 @@ def main() -> int:
         failures += run_node_loss_matrix()
         # And its fleet-native form (node death inside a shard).
         failures += run_fleet_node_loss_matrix()
+        # And the elastic-resize subset (SIGKILL inside an autoscaler-
+        # initiated split).
+        failures += run_autoscale_kill_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
             + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
+            + len(AUTOSCALE_KILL_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -59,6 +59,53 @@ def r06_config(args) -> "SoakConfig":
             gc_horizon_s=18.0,
             node_flap_period_s=0.0,
         )
+    autoscale = {}
+    if getattr(args, "autoscale", False):
+        # The elastic-fleet hot-spot soak (ISSUE 11, SOAK_FLEET_r11):
+        # hot arrivals ride the diurnal swing onto the serving nodes the
+        # initial map buckets onto shard 0 (hot probability peaks with
+        # the crest), and the autoscaler's split must trip live AT the
+        # crest — with the split shard's p99 measurably recovering in
+        # the settled post-split window.  Calibration notes, all
+        # CPU-box-honest: min_window_decisions=60 confines decisions to
+        # crest windows (trough windows are statistically quiet);
+        # split_hi=1.65 sits under the crest's ~1.7 observed ratio
+        # (LeastAllocated steers the free minority AWAY from the fuller
+        # hot nodes, capping the share near hot_fraction) and above
+        # every off-crest ratio; flaps/cold-restarts are disabled so the
+        # SLO movement is attributable to the resize alone; the
+        # recording runs the IN-PROCESS fleet — a multi-process resize
+        # on this 2-core box is dominated by the new serve child's
+        # ~15s boot+compile, which would drown the steady-state claim
+        # (the multi-process resize path is recorded separately as the
+        # artifact's two_process_leg).
+        autoscale = dict(
+            autoscale=True,
+            hot_fraction=0.85,
+            autoscale_interval_s=5.0,
+            autoscale_split_hi=1.65,
+            autoscale_merge_lo=0.2,
+            autoscale_cooldown_s=45.0,
+            autoscale_window_s=120.0,
+            autoscale_budget=1,
+            autoscale_min_decisions=60,
+            autoscale_max_shards=3,
+            # The settled post window [t+30, t+60) mirrors the pre
+            # window's diurnal phase around the crest AND clears the
+            # resize transition: re-journaling ~1k moved bindings
+            # (fsync'd — crash safety is not suspended for a resize)
+            # plus the backlog it queues is a multi-second one-time
+            # cost the artifact reports under `transition`.
+            autoscale_compare_settle_s=30.0,
+            node_flap_period_s=0.0,
+            cold_consumer_period_s=0.0,
+            two_process=False,
+            # Saturated stores from the first window: the snapshot
+            # pause (~60µs/pod of store on this box) is the p99 driver
+            # the split halves — 2000 pre-bound pods put the hot
+            # owner's pause well above the scheduling-noise floor.
+            preload_bound=2000,
+        )
     return SoakConfig(
         seed=args.seed,
         nodes=args.nodes,
@@ -78,20 +125,25 @@ def r06_config(args) -> "SoakConfig":
         ),
         knee_phase_s=args.knee_phase,
         invalidation_rate_per_s=0.2,
-        node_flap_period_s=node_loss.pop("node_flap_period_s", 45.0),
+        node_flap_period_s=autoscale.pop(
+            "node_flap_period_s", node_loss.pop("node_flap_period_s", 45.0)
+        ),
         flap_down_s=2.0,
-        cold_consumer_period_s=60.0,
+        cold_consumer_period_s=autoscale.pop(
+            "cold_consumer_period_s", 60.0
+        ),
         live_pod_cap=args.live_pod_cap,
         slo_budget_ms=args.slo_budget_ms,
         batch_size=args.batch_size,
         chunk_size=32,
         warm_pods=128,
-        two_process=True,
+        two_process=autoscale.pop("two_process", True),
         journal_fsync=args.journal_fsync,
         snapshot_every=args.snapshot_every,
         pace="real",
         out_dir=args.out_dir,
         **node_loss,
+        **autoscale,
     )
 
 
@@ -191,9 +243,29 @@ def fleet_determinism_check(cfg, shards: int) -> dict:
             node_unreachable_s=0.8,
             gc_horizon_s=1.5,
         )
+    if cfg.autoscale:
+        # Scale the autoscaler clocks into a window long enough for the
+        # hot-spot skew to trip a split — the checked op stream must
+        # include the resize itself.  The diurnal period shrinks to the
+        # window (the crest, where the hot probability peaks, must
+        # occur) and the band/quiet gates relax to the small run's
+        # statistics.
+        small = dataclasses.replace(
+            small,
+            duration_s=8.0,
+            rate_pods_per_s=max(cfg.rate_pods_per_s, 20.0),
+            diurnal_period_s=8.0,
+            autoscale_interval_s=2.0,
+            autoscale_cooldown_s=3.0,
+            autoscale_split_hi=1.4,
+            autoscale_min_decisions=8,
+            node_flap_period_s=0.0,
+            cold_consumer_period_s=0.0,
+            preload_bound=0,
+        )
     a = run_fleet_soak(small, shards)
     b = run_fleet_soak(small, shards)
-    return {
+    out = {
         "seed": small.seed,
         "shards": shards,
         "runs": 2,
@@ -207,6 +279,17 @@ def fleet_determinism_check(cfg, shards: int) -> dict:
         "bindings_sha256": a["determinism"]["bindings_sha256"],
         "bound_final": a["bound_final"],
     }
+    if cfg.autoscale:
+        # The elastic fleet's replayability claim covers the ACTION
+        # sequence too: same seed, same splits/merges at the same
+        # scenario clocks.
+        acts = lambda art: [  # noqa: E731
+            (x["op"], x["t"], x.get("from"), x.get("to"))
+            for x in (art.get("autoscale") or {}).get("actions", ())
+        ]
+        out["autoscale_actions_identical"] = acts(a) == acts(b)
+        out["autoscale_actions"] = acts(a)
+    return out
 
 
 def fleet_scaling_sweep(args, base_cfg) -> list[dict]:
@@ -235,6 +318,8 @@ def fleet_scaling_sweep(args, base_cfg) -> list[dict]:
             node_grace_s=0.0,  # pure serving rate: no lifecycle churn
             cold_consumer_period_s=0.0,
             node_flap_period_s=0.0,
+            autoscale=False,  # fixed N per point — that's the sweep
+            hot_fraction=0.0,
             out_dir="",
             journal_dir="",
         )
@@ -277,19 +362,87 @@ def run_fleet(args) -> int:
         if not (
             check["arrival_schedule_identical"]
             and check["bindings_identical"]
+            and check.get("autoscale_actions_identical", True)
         ):
             print("run_soak: FLEET DETERMINISM CHECK FAILED", file=sys.stderr)
             return 1
+        if cfg.autoscale and not any(
+            op == "split" for op, *_ in check.get("autoscale_actions", ())
+        ):
+            print(
+                "run_soak: autoscale determinism check tripped no split",
+                file=sys.stderr,
+            )
+            return 1
     print(
-        f"run_soak: fleet soak — {args.shards} MULTI-PROCESS shards "
-        f"(serve --shard-of children), seed {cfg.seed}, "
+        f"run_soak: fleet soak — {args.shards} "
+        + (
+            "MULTI-PROCESS shards (serve --shard-of children)"
+            if cfg.two_process
+            else "in-process shards"
+        )
+        + f", seed {cfg.seed}, "
         f"{cfg.rate_pods_per_s} pods/s for {cfg.duration_s:.0f}s"
         + (", node-loss armed" if cfg.node_grace_s > 0 else "")
+        + (", autoscaler armed" if cfg.autoscale else "")
         + "…",
         flush=True,
     )
     artifact = strip_private(run_fleet_soak(cfg, args.shards))
     artifact["determinism_check"] = check
+    if cfg.autoscale:
+        # The multi-process resize path, recorded: a short virtual-pace
+        # leg against REAL `serve --shard-of` children where the split
+        # spawns a new serve child mid-stream (an id beyond the original
+        # N — the router pushes the live map via set_map before the
+        # import).  Virtual pace: the leg proves the elastic mechanics
+        # and correctness, not SLO (a new child's ~15s boot on this box
+        # is the documented transition cost).
+        import dataclasses
+
+        two_proc = dataclasses.replace(
+            cfg,
+            two_process=True,
+            pace="virtual",
+            duration_s=16.0,
+            diurnal_period_s=12.0,
+            rate_pods_per_s=max(cfg.rate_pods_per_s, 20.0),
+            nodes=min(cfg.nodes, 32),
+            churn_nodes=2,
+            live_pod_cap=150,
+            warm_pods=32,
+            batch_size=64,
+            autoscale_interval_s=2.0,
+            autoscale_cooldown_s=4.0,
+            autoscale_split_hi=1.4,
+            autoscale_min_decisions=8,
+            preload_bound=0,
+            out_dir="",
+            journal_dir="",
+        )
+        print("run_soak: multi-process elastic leg…", flush=True)
+        leg = strip_private(run_fleet_soak(two_proc, args.shards))
+        leg_auto = leg.get("autoscale") or {}
+        artifact["two_process_leg"] = {
+            "deployment": leg["deployment"],
+            "actions": leg_auto.get("actions", []),
+            "splits": leg_auto.get("splits", 0),
+            "deferrals": leg_auto.get("deferrals", {}),
+            "bound_final": leg["bound_final"],
+            "decisions": leg["decisions"],
+            "bindings_sha256": leg["determinism"]["bindings_sha256"],
+        }
+        print(
+            f"run_soak: two-process leg — {leg_auto.get('splits', 0)} "
+            f"split(s), {leg['bound_final']} bound",
+            flush=True,
+        )
+        if leg_auto.get("splits", 0) < 1:
+            print(
+                "run_soak: TWO-PROCESS LEG TRIPPED NO SPLIT",
+                file=sys.stderr,
+            )
+            return 1
     if not args.skip_scaling:
         artifact["scaling"] = fleet_scaling_sweep(args, cfg)
     artifact["environment"] = {
@@ -322,6 +475,29 @@ def run_fleet(args) -> int:
             f"{nl['pending_rebinds']} pending",
             flush=True,
         )
+    asc = artifact.get("autoscale")
+    if asc:
+        print(
+            f"run_soak: autoscale — {asc['splits']} split(s) / "
+            f"{asc['merges']} merge(s), actions {asc['actions']}, "
+            f"deferrals {asc['deferrals']}",
+            flush=True,
+        )
+        for rec in asc["split_recovery"]:
+            print(
+                f"run_soak: split@{rec['t_split']}s shard "
+                f"{rec['shard']}→+{rec['new_shard']}: p99 "
+                f"{rec['pre']['p99_ms']}ms → "
+                f"{rec['post_worst_of_pair']['p99_ms']}ms "
+                f"(recovered: {rec['p99_recovered']})",
+                flush=True,
+            )
+        if asc["splits"] < 1:
+            print(
+                "run_soak: AUTOSCALE SOAK TRIPPED NO SPLIT",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 
@@ -334,6 +510,11 @@ def main() -> int:
                     help="arm the node-lifecycle loop and kill churn-node "
                     "heartbeats mid-soak: staleness → taints → eviction → "
                     "requeue → reschedule, recorded as SOAK_r09.json")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="fleet only: arm the elastic shard autoscaler and "
+                    "the hot-spot diurnal mix — skew must trip a live "
+                    "split with the per-shard p99 recovering, recorded as "
+                    "SOAK_FLEET_r11.json")
     ap.add_argument("--out", default="")
     ap.add_argument("--out-dir", default="",
                     help="flight-dump directory (default: alongside --out)")
@@ -362,12 +543,28 @@ def main() -> int:
     ap.add_argument("--scaling-seconds", type=float, default=45.0,
                     help="duration of each scaling-sweep point")
     args = ap.parse_args()
+    if args.autoscale and not args.shards:
+        args.shards = 2
+    if args.autoscale:
+        # r11 calibration (only where the flag was left at its default):
+        # offered load under the in-process ceiling so the tail is
+        # pause-driven, the live-pod store saturating well before the
+        # crest (pre/post windows compare saturated stores), snapshots
+        # frequent enough that the hot owner's pause drives the p99.
+        if args.rate == 24.0:
+            args.rate = 10.0
+        if args.live_pod_cap == 2000:
+            args.live_pod_cap = 2600
+        if args.snapshot_every == 24:
+            args.snapshot_every = 8
     if not args.out:
         if args.shards:
-            args.out = (
-                "SOAK_FLEET_r10.json" if args.node_loss
-                else "SOAK_FLEET_r07.json"
-            )
+            if args.autoscale:
+                args.out = "SOAK_FLEET_r11.json"
+            elif args.node_loss:
+                args.out = "SOAK_FLEET_r10.json"
+            else:
+                args.out = "SOAK_FLEET_r07.json"
         else:
             args.out = "SOAK_r09.json" if args.node_loss else "SOAK_r06.json"
     if not args.out_dir:

@@ -1,0 +1,19 @@
+"""Seeded autoscaler determinism violations: a control loop that reads
+wall clocks or iterates bare sets resizes the fleet differently every
+run — same-seed soaks could never replay the split/merge history."""
+
+import time
+
+
+def should_split(last_action_ts, cooldown_s):
+    # POSITIVE det-wallclock: cooldowns must run on the LOGICAL clock
+    # the caller feeds, never a wall read.
+    return time.time() - last_action_ts > cooldown_s
+
+
+def pick_hot_shard(window_binds):
+    # POSITIVE det-set-iteration: bare set iteration order is
+    # hash-randomized — two processes would pick different "hottest"
+    # shards on equal counts; sorted(...) is the idiom.
+    for shard in {s for s in window_binds}:
+        return shard

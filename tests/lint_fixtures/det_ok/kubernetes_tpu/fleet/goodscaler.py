@@ -1,0 +1,16 @@
+"""Healthy autoscaler idioms: logical clocks injected by the caller,
+sorted shard iteration, deterministic tie-breaks."""
+
+
+def should_split(now, last_action_ts, cooldown_s):
+    # NEGATIVE: the clock is a parameter (the scenario/logical clock).
+    return now - last_action_ts > cooldown_s
+
+
+def pick_hot_shard(window_binds, n):
+    # NEGATIVE: sorted iteration, ties toward the lowest shard id.
+    total = sum(window_binds.values()) or 1
+    return min(
+        sorted(window_binds),
+        key=lambda s: (-(window_binds[s] / total) * n, s),
+    )

@@ -1,0 +1,24 @@
+"""Seeded autoscaler WAL violations (ISSUE 11): a resize action made
+live without the acquiring owner's handoff record first is a transfer
+the next takeover cannot redo — the autoscaler's action path must stay
+on the journaled orchestration."""
+
+
+class BadAutoscaler:
+    def split_without_journal(self, rec, map_path):
+        # POSITIVE wal-unjournaled-apply: the live resize applies with
+        # no journal append anywhere in scope — a SIGKILL inside leaves
+        # the moved nodes on neither owner's journal.
+        self.router.apply_handoff(rec, map_path)
+
+    def split_apply_then_append(self, rec, map_path):
+        # POSITIVE wal-apply-before-journal: the transfer goes live
+        # before its record exists — exactly the window the
+        # --autoscale-kill matrix SIGKILLs into.
+        self.router.apply_handoff(rec, map_path)
+        self.owner.sched._journal_append("handoff", **rec)
+
+    def healthy_split(self, rec, map_path):
+        # NEGATIVE: journal-before-apply, the required shape.
+        self.owner.sched._journal_append("handoff", **rec)
+        self.router.apply_handoff(rec, map_path)

@@ -1,0 +1,412 @@
+"""Elastic shard autoscaler (kubernetes_tpu/fleet/autoscaler.py,
+ISSUE 11): decision coverage for the deterministic control loop —
+hysteresis (oscillation inside the band produces zero actions),
+per-shard cooldowns, the actions-per-window budget, stale-stats
+deferral on FleetOwnerUnreachable, same-seed determinism of the action
+sequence — plus live split/merge end-to-end on an in-process fleet.
+
+The crash half (SIGKILL inside an autoscaler-initiated handoff) lives
+in scripts/run_fault_matrix.py --autoscale-kill; the load half (the
+hot-spot diurnal soak tripping a split with p99 recovery) in
+scripts/run_soak.py --autoscale."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod  # noqa: E402
+from kubernetes_tpu.fleet import (  # noqa: E402
+    AutoscalerConfig,
+    FleetAutoscaler,
+    FleetOwnerUnreachable,
+    FleetRouter,
+    ShardMap,
+    ShardOwner,
+    choose_action,
+)
+from kubernetes_tpu.framework.config import Profile  # noqa: E402
+from kubernetes_tpu.scheduler import TPUScheduler  # noqa: E402
+
+
+def mk_sched() -> TPUScheduler:
+    return TPUScheduler(
+        profile=Profile(
+            name="autoscaler-test",
+            filters=(
+                "NodeUnschedulable", "NodeName", "NodeAffinity",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+        chunk_size=1,
+    )
+
+
+def build_fleet(n_shards: int = 2, n_buckets: int = 16):
+    smap = ShardMap(n_shards=n_shards, n_buckets=n_buckets)
+    owners = {k: ShardOwner(k, mk_sched(), smap) for k in range(n_shards)}
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    return router, owners, smap
+
+
+def cfg(**kw) -> AutoscalerConfig:
+    base = dict(
+        split_imbalance_hi=1.5,
+        merge_imbalance_lo=0.25,
+        decide_every_s=0.0,
+        cooldown_s=0.0,
+        window_s=100.0,
+        max_actions_per_window=100,
+        min_window_decisions=4,
+        max_shards=8,
+    )
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def feed_window(router, binds: dict) -> None:
+    """Simulate one window of commits: bump the router's monotone
+    per-shard counters by ``binds``."""
+    for s, n in binds.items():
+        router.binds_by_shard[s] = router.binds_by_shard.get(s, 0) + n
+
+
+def scaler(router, config, **kw) -> FleetAutoscaler:
+    kw.setdefault(
+        "owner_provider", lambda k: ShardOwner(k, mk_sched(), router.shard_map)
+    )
+    return FleetAutoscaler(router, config, **kw)
+
+
+# -- the pure decision core --------------------------------------------------
+
+
+def test_choose_action_split_merge_and_band():
+    c = cfg()
+    act, _ = choose_action({0: 8, 1: 2}, {0: 8, 1: 8}, c)
+    assert act == {"op": "split", "from": 0, "to": 2}
+    act, reason = choose_action({0: 5, 1: 5}, {0: 8, 1: 8}, c)
+    assert act is None and reason == "in-band"
+    # Coldest merges into the next-coldest, never into itself (split
+    # takes priority, so the warm shards must sit inside the band).
+    act, _ = choose_action(
+        {0: 5, 1: 5, 2: 0},
+        {0: 6, 1: 6, 2: 4},
+        cfg(split_imbalance_hi=2.0, merge_imbalance_lo=0.3),
+    )
+    assert act == {"op": "merge", "from": 2, "to": 0}
+    # At max_shards and still hot: rebalance is the remaining lever,
+    # carrying the LIVE shard ids for the executor's re-deal.
+    act, _ = choose_action({0: 9, 1: 1}, {0: 8, 1: 8}, cfg(max_shards=2))
+    assert act == {"op": "rebalance", "n_shards": 2, "shards": [0, 1]}
+
+
+def test_choose_action_quiet_and_atomic_guards():
+    act, reason = choose_action({0: 2, 1: 0}, {0: 8, 1: 8}, cfg())
+    assert act is None and reason == "quiet"
+    # A one-bucket shard cannot split without emptying itself.
+    act, reason = choose_action({0: 10, 1: 0}, {0: 1, 1: 15}, cfg())
+    assert act is None and reason == "atomic-shard"
+
+
+# -- hysteresis --------------------------------------------------------------
+
+
+def test_oscillation_inside_the_band_never_acts():
+    """The dead band: shares swinging between the thresholds (ratios
+    1.2 ↔ 0.8 against hi=1.5 / lo=0.25) produce ZERO actions no matter
+    how long the oscillation runs."""
+    router, _owners, _smap = build_fleet(2)
+    asc = scaler(router, cfg())
+    for i in range(20):
+        feed_window(router, {0: 6, 1: 4} if i % 2 == 0 else {0: 4, 1: 6})
+        assert asc.tick(float(i + 1)) == []
+    assert asc.actions == []
+    assert asc.deferrals.get("in-band", 0) == 20
+
+
+# -- cooldowns ---------------------------------------------------------------
+
+
+def test_cooldown_blocks_the_shards_a_handoff_touched():
+    router, _owners, _smap = build_fleet(2)
+    asc = scaler(router, cfg(cooldown_s=10.0))
+    feed_window(router, {0: 9, 1: 1})
+    assert [a["op"] for a in asc.tick(1.0)] == ["split"]
+    # Shard 0 stays hot but is cooling down: deferred, not re-split.
+    feed_window(router, {0: 9, 1: 1, 2: 1})
+    assert asc.tick(2.0) == []
+    assert asc.deferrals.get("cooldown", 0) == 1
+    # Past the cooldown the same signal acts again.
+    feed_window(router, {0: 9, 1: 1, 2: 1})
+    acted = asc.tick(12.0)
+    assert [a["op"] for a in acted] == ["split"]
+
+
+# -- the actions-per-window budget -------------------------------------------
+
+
+def test_budget_bounds_actions_per_window():
+    router, _owners, _smap = build_fleet(2)
+    asc = scaler(
+        router,
+        cfg(max_actions_per_window=1, window_s=50.0, cooldown_s=0.0),
+    )
+    feed_window(router, {0: 9, 1: 1})
+    assert len(asc.tick(1.0)) == 1
+    feed_window(router, {0: 9, 1: 1, 2: 1})
+    assert asc.tick(2.0) == []
+    assert asc.deferrals.get("budget", 0) == 1
+    # The window slides: the budget frees up once the action ages out.
+    feed_window(router, {0: 9, 1: 1, 2: 1})
+    assert len(asc.tick(60.0)) == 1
+
+
+# -- stale stats -------------------------------------------------------------
+
+
+class _UnreachableOwner:
+    """Wraps an owner; every ``stats`` probe exhausts its retry budget
+    the way a hung serve child would."""
+
+    def __init__(self, inner, shard_id):
+        self.inner = inner
+        self.shard_id = shard_id
+
+    def call(self, op, payload):
+        if op == "stats":
+            err = FleetOwnerUnreachable(f"shard {self.shard_id} hung")
+            err.shard_id = self.shard_id
+            raise err
+        return self.inner.call(op, payload)
+
+
+def test_unreachable_owner_defers_the_whole_tick():
+    """Stale stats never drive a resize: a hung owner defers the tick
+    outright (no action on the partial picture) and holds the shard out
+    of actions for the holdoff window."""
+    router, owners, _smap = build_fleet(2)
+    asc = scaler(router, cfg(unreachable_holdoff_s=30.0))
+    router.owners[1] = _UnreachableOwner(owners[1], 1)
+    feed_window(router, {0: 9, 1: 1})
+    assert asc.tick(1.0) == []
+    assert asc.deferrals.get("owner-unreachable", 0) == 1
+    assert asc.actions == []
+    # The owner comes back; the held-out window still blocks shard 1
+    # from being party to a handoff, but shard 0's split may proceed.
+    router.owners[1] = owners[1]
+    feed_window(router, {0: 9, 1: 1})
+    acted = asc.tick(2.0)
+    assert [a["op"] for a in acted] == ["split"]
+    assert asc._unreachable_until[1] > 2.0
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_same_signal_script_yields_identical_action_sequence():
+    """The action history is a pure function of the (window, clock)
+    script — the property the soak's 2× same-seed check rides."""
+    script = [
+        (1.0, {0: 9, 1: 1}),
+        (2.0, {0: 5, 1: 5, 2: 2}),
+        (3.0, {0: 2, 1: 9, 2: 1}),
+        (9.0, {0: 1, 1: 10, 2: 1}),
+        (15.0, {0: 4, 1: 4, 2: 4}),
+    ]
+
+    def run():
+        router, _owners, _smap = build_fleet(2)
+        asc = scaler(router, cfg(cooldown_s=5.0, max_actions_per_window=3))
+        history = []
+        for now, binds in script:
+            feed_window(router, binds)
+            history.extend(asc.tick(now))
+        return history
+
+    a, b = run(), run()
+    assert a == b
+    assert [x["op"] for x in a].count("split") >= 1
+
+
+# -- live resharding end-to-end ----------------------------------------------
+
+
+def hot_node(name: str, cpu: int):
+    return (
+        make_node(name)
+        .capacity({"cpu": str(cpu), "memory": "32Gi", "pods": 64})
+        .label("hot", "1")
+        .obj()
+    )
+
+
+def test_live_split_moves_load_and_keeps_serving():
+    """Skewed real load trips a split; the new owner imports the moved
+    nodes WITH their bindings and post-resize pods still schedule."""
+    router, owners, smap = build_fleet(2)
+    names0 = [n for n in (f"an{i}" for i in range(100))
+              if smap.owner_of(n) == 0][:6]
+    names1 = [n for n in (f"an{i}" for i in range(100))
+              if smap.owner_of(n) == 1][:2]
+    for i, n in enumerate(names0):
+        router.add_object("Node", hot_node(n, 8 + i))
+    for i, n in enumerate(names1):
+        router.add_object(
+            "Node",
+            make_node(n)
+            .capacity({"cpu": str(4 + i), "memory": "16Gi", "pods": 64})
+            .obj(),
+        )
+    for i in range(8):
+        router.add_pod(
+            make_pod(f"h{i}")
+            .req({"cpu": f"{500 + i * 10}m", "memory": "256Mi"})
+            .node_selector({"hot": "1"})
+            .obj()
+        )
+    for i in range(2):
+        router.add_pod(
+            make_pod(f"f{i}")
+            .req({"cpu": f"{300 + i * 10}m", "memory": "128Mi"})
+            .obj()
+        )
+    bound = router.schedule_all_pending(wait_backoff=True)
+    assert sum(1 for o in bound if o.node_name) == 10
+    before = router.bindings()
+    asc = scaler(router, cfg())
+    acted = asc.tick(1.0)
+    assert [a["op"] for a in acted] == ["split"]
+    new_id = acted[0]["to"]
+    assert new_id in router.owners
+    assert router._shard_node_count.get(new_id, 0) > 0
+    # Bindings survived the move bit-for-bit.
+    assert router.bindings() == before
+    # The moved nodes' pods now live on the new owner's journal-ready
+    # cache (export rode the handoff).
+    assert owners  # the original dict still serves shards 0/1
+    router.add_pod(
+        make_pod("post").req({"cpu": "200m", "memory": "64Mi"})
+        .node_selector({"hot": "1"}).obj()
+    )
+    out = router.schedule_all_pending(wait_backoff=True)
+    assert any(o.node_name for o in out)
+    status = asc.status()
+    assert status["last_action"]["op"] == "split"
+    assert str(new_id) in status["shards"]
+
+
+def test_live_merge_down_to_single_shard_still_serves():
+    """The cold half of elasticity, to the edge: merge the fleet down
+    to N=1 — the degenerate map (every bucket one shard) must keep
+    scheduling through the router."""
+    router, owners, smap = build_fleet(2)
+    names = [f"mn{i}" for i in range(4)]
+    for i, n in enumerate(names):
+        router.add_object(
+            "Node",
+            make_node(n)
+            .capacity({"cpu": str(6 + i), "memory": "16Gi", "pods": 32})
+            .obj(),
+        )
+    for i in range(6):
+        router.add_pod(
+            make_pod(f"m{i}").req({"cpu": f"{400 + i * 10}m"}).obj()
+        )
+    assert sum(
+        1 for o in router.schedule_all_pending(wait_backoff=True)
+        if o.node_name
+    ) == 6
+    before = router.bindings()
+    # Make shard-0's window cold enough to merge (all recent load on 1).
+    retired = []
+    asc = scaler(
+        router,
+        cfg(
+            split_imbalance_hi=3.0,
+            merge_imbalance_lo=0.3,
+            min_window_decisions=4,
+        ),
+        owner_retirer=lambda k, o: retired.append(k),
+    )
+    router.binds_by_shard = {0: 0, 1: 10}
+    asc._bind_marks = {}
+    acted = asc.tick(1.0)
+    assert [a["op"] for a in acted] == ["merge"]
+    assert acted[0] == dict(
+        op="merge", **{"from": 0, "to": 1},
+        clock=1.0, version=acted[0]["version"],
+    )
+    assert retired == [0]
+    assert router.shard_ids() == [1]
+    assert sorted(set(smap.buckets)) == [1]
+    assert router.bindings() == before
+    router.add_pod(make_pod("post-merge").req({"cpu": "300m"}).obj())
+    out = router.schedule_all_pending(wait_backoff=True)
+    assert any(o.node_name for o in out)
+    # Below min_shards nothing merges: the single shard is the floor.
+    router.binds_by_shard[1] += 10
+    assert asc.tick(2.0) == []
+
+
+def test_merge_floor_respects_min_shards():
+    router, _owners, _smap = build_fleet(2)
+    asc = scaler(
+        router,
+        cfg(split_imbalance_hi=3.0, merge_imbalance_lo=0.6, min_shards=2),
+    )
+    feed_window(router, {0: 1, 1: 9})
+    assert asc.tick(1.0) == []
+    assert asc.deferrals.get("in-band", 0) == 1
+
+
+def test_split_defers_without_an_owner_provider():
+    router, _owners, _smap = build_fleet(2)
+    asc = FleetAutoscaler(router, cfg())  # no owner_provider
+    feed_window(router, {0: 9, 1: 1})
+    assert asc.tick(1.0) == []
+    assert asc.deferrals.get("no-owner-provider", 0) == 1
+
+
+def test_status_block_shape(tmp_path):
+    router, _owners, _smap = build_fleet(2)
+    state = tmp_path / "autoscaler.json"
+    asc = scaler(router, cfg(), state_path=str(state))
+    asc.note_latency(0, 0.05)
+    feed_window(router, {0: 6, 1: 4})
+    asc.tick(1.0)
+    doc = asc.status()
+    assert set(doc["shards"]) == {"0", "1"}
+    for blk in doc["shards"].values():
+        for key in (
+            "window_binds", "imbalance_ratio", "nodes", "slo_p99_ms",
+            "cooldown_remaining_s",
+        ):
+            assert key in blk
+    assert doc["budget"]["max_actions_per_window"] == 100
+    assert "queue_depth" in doc
+    # The tick persisted the mirror for `fleet status`.
+    assert state.exists()
+
+
+def test_slo_gate_defers_split_when_p99_is_healthy():
+    router, _owners, _smap = build_fleet(2)
+    asc = scaler(router, cfg(slo_split_gate_ms=100.0))
+    asc.note_latency(0, 0.005)  # 5ms — healthy
+    feed_window(router, {0: 9, 1: 1})
+    assert asc.tick(1.0) == []
+    assert asc.deferrals.get("slo-gate", 0) == 1
+    # Degraded p99 opens the gate.
+    for _ in range(50):
+        asc.note_latency(0, 0.5)
+    feed_window(router, {0: 9, 1: 1})
+    assert [a["op"] for a in asc.tick(2.0)] == ["split"]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

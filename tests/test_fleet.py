@@ -115,6 +115,134 @@ def test_shardmap_split_merge_round_trip(tmp_path):
     assert all(m.owner_of(n) == 0 for n in names)
 
 
+def test_shardmap_split_pins_survive_by_default():
+    """ISSUE 11 regression: override pins naming the split shard are an
+    operator/takeover decision — a split must NEVER silently remap them
+    to the new shard; they stay pinned to the source."""
+    m = ShardMap(n_shards=2, n_buckets=16)
+    m.overrides["pinned-a"] = 0
+    m.overrides["pinned-b"] = 0
+    m.overrides["foreign"] = 1
+    rec = m.split(0, 2)
+    assert rec["pins_dropped"] == []
+    assert m.overrides == {"pinned-a": 0, "pinned-b": 0, "foreign": 1}
+    assert m.owner_of("pinned-a") == 0
+    assert m.owner_of("pinned-b") == 0
+
+
+def test_shardmap_split_drop_pins_is_explicit_and_recorded():
+    """The only way a pin leaves a split: drop_pins=True removes the
+    source's pins (they fall back to the bucket rule) and the handoff
+    record carries the names so a takeover redo replays the choice."""
+    m = ShardMap(n_shards=2, n_buckets=16)
+    m.overrides["pinned-a"] = 0
+    m.overrides["foreign"] = 1
+    rec = m.split(0, 2, drop_pins=True)
+    assert rec["pins_dropped"] == ["pinned-a"]
+    assert "pinned-a" not in m.overrides
+    assert m.overrides == {"foreign": 1}  # other shards' pins untouched
+    # The redo replays the drop on a stale map.
+    stale = ShardMap(n_shards=2, n_buckets=16)
+    stale.overrides["pinned-a"] = 0
+    stale.overrides["foreign"] = 1
+    redo_handoff(stale, rec)
+    assert stale.buckets == m.buckets
+    assert stale.overrides == m.overrides
+
+
+def test_shardmap_split_refuses_an_atomic_shard():
+    """A shard owning fewer than two buckets cannot split — moving its
+    only bucket would be a rename that empties the source.  Refused
+    BEFORE any version bump (a refused action must not advance the
+    ownership record)."""
+    m = ShardMap(buckets=[0] + [1] * 15)
+    with pytest.raises(ValueError):
+        m.split(0, 2)
+    assert m.version == 0
+
+
+def test_shardmap_merge_refuses_self_and_reaches_n1():
+    """merge(x, x) is refused pre-version-bump; merging the last two
+    shards down to N=1 is legal and leaves the degenerate
+    single-scheduler map."""
+    m = ShardMap(n_shards=2, n_buckets=16)
+    with pytest.raises(ValueError):
+        m.merge(into=0, absorbed=0)
+    assert m.version == 0
+    rec = m.merge(into=0, absorbed=1)
+    assert rec["version"] == 1
+    assert m.shard_ids() == [0]
+    assert all(s == 0 for s in m.buckets)
+
+
+def test_live_merge_to_single_shard_through_the_router():
+    """merge down to N=1 end-to-end: the handoff moves the absorbed
+    shard's nodes AND bindings through the journaled path and the
+    single remaining owner keeps scheduling."""
+    router, owners, smap = build_fleet(2, pin={"s0": 0, "s1": 1})
+    a, b = "s0", "s1"
+    router.add_object("Node", big_node(a))
+    router.add_object("Node", big_node(b, cpu="6"))
+    for i in range(4):
+        router.add_pod(
+            make_pod(f"mrg{i}").req({"cpu": f"{400 + 10 * i}m"}).obj()
+        )
+    bound = router.schedule_all_pending(wait_backoff=True)
+    assert sum(1 for o in bound if o.node_name) == 4
+    before = router.bindings()
+    rec = smap.merge(into=0, absorbed=1)
+    router.apply_handoff(rec)
+    drained = router.remove_owner(1)
+    drained.close()
+    assert router.shard_ids() == [0]
+    assert router.bindings() == before
+    assert owners[0].sched.cache.nodes.keys() >= {a, b}
+    router.add_pod(make_pod("post-n1").req({"cpu": "300m"}).obj())
+    out = router.schedule_all_pending(wait_backoff=True)
+    assert any(o.node_name for o in out)
+
+
+def test_shardmap_rebalance_respects_live_ids_and_pins():
+    """Post-review regressions: a rebalance after merges (gapped id
+    space) must deal buckets over the LIVE ids — never to an ownerless
+    shard — and pins follow the split contract: survive by default,
+    dropped only explicitly and recorded for the redo."""
+    m = ShardMap(n_shards=2, n_buckets=16)
+    m.split(0, 2)
+    m.merge(into=0, absorbed=1)  # live ids now {0, 2} — 1 is a gap
+    m.overrides["pinned"] = 2
+    rec = m.rebalance(ids=[0, 2])
+    assert set(m.buckets) == {0, 2}
+    assert rec["ids"] == [0, 2] and rec["pins_dropped"] == []
+    assert m.overrides == {"pinned": 2}  # survived
+    rec2 = m.rebalance(ids=[0, 2], drop_pins=True)
+    assert rec2["pins_dropped"] == ["pinned"]
+    assert m.overrides == {}
+    # The redo replays both: gapped ids and the recorded pin drop.
+    stale = ShardMap(n_shards=2, n_buckets=16)
+    stale.overrides["pinned"] = 2
+    redo_handoff(stale, rec)
+    assert set(stale.buckets) == {0, 2}
+    assert stale.overrides == {"pinned": 2}
+    redo_handoff(stale, rec2)
+    assert stale.overrides == {}
+    assert stale.buckets == m.buckets
+
+
+def test_autoscaler_rebalance_action_carries_live_ids():
+    """The decision core names the live shards in its rebalance action
+    (the executor deals over them), so an id-gapped fleet at max_shards
+    never re-deals buckets to an ownerless shard."""
+    from kubernetes_tpu.fleet import AutoscalerConfig, choose_action
+
+    act, _ = choose_action(
+        {0: 9, 2: 1},
+        {0: 8, 2: 8},
+        AutoscalerConfig(max_shards=2, min_window_decisions=4),
+    )
+    assert act == {"op": "rebalance", "n_shards": 2, "shards": [0, 2]}
+
+
 def test_shardmap_save_rejects_stale_writer(tmp_path):
     path = str(tmp_path / "map.json")
     m = ShardMap(n_shards=2, n_buckets=16)

@@ -95,6 +95,20 @@ def test_scenario_script_is_seeded():
     assert [e.t for e in a] == sorted(e.t for e in a)
 
 
+def test_autoscale_ticks_ride_the_scenario_clock():
+    """ISSUE 11: the elastic control loop's cadence is scripted like
+    every other scenario event — interval-regular, merged in time
+    order, absent when disarmed."""
+    kw = dict(nodes=8, churn_nodes=2, invalidation_rate_per_s=1.0)
+    a = build_events(10.0, seed=11, autoscale_interval_s=2.5, **kw)
+    ticks = [e for e in a if e.kind == "autoscale_tick"]
+    assert [e.t for e in ticks] == [2.5, 5.0, 7.5]
+    assert [e.data for e in ticks] == [0, 1, 2]
+    assert [e.t for e in a] == sorted(e.t for e in a)
+    off = build_events(10.0, seed=11, **kw)
+    assert not [e for e in off if e.kind == "autoscale_tick"]
+
+
 def test_workload_mix_is_seeded_and_renames():
     a = WorkloadMix("mixed", seed=4)
     b = WorkloadMix("mixed", seed=4)

@@ -89,15 +89,22 @@ def test_wal_rules_fire_on_seeded_violations():
     # in the failure-response fixture (_apply_node_taints /
     # _apply_eviction are apply markers, ISSUE 9) + one of each in the
     # OWNER-side lifecycle fixture (a shard's controller driving the
-    # taint/evict apply sites, ISSUE 10).
-    assert got.count("wal-apply-before-journal") == 4
-    assert got.count("wal-unjournaled-apply") == 4
-    assert len(got) == 8, got  # the healthy shapes stay silent
+    # taint/evict apply sites, ISSUE 10) + one of each in the elastic
+    # autoscaler fixture (a resize action applying its handoff without
+    # the acquiring owner's record, ISSUE 11).
+    assert got.count("wal-apply-before-journal") == 5
+    assert got.count("wal-unjournaled-apply") == 5
+    assert len(got) == 10, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
     paths = {f.path for f in lint("wal_bad").findings}
     assert "kubernetes_tpu/fleet/owner.py" in paths
+
+
+def test_wal_rules_cover_the_autoscaler():
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/fleet/autoscaler.py" in paths
 
 
 def test_wal_rules_cover_failure_response_controllers():
@@ -118,9 +125,11 @@ def test_det_rules_fire_on_seeded_violations():
     # fleet/badrouter.py seed the others — the determinism family must
     # cover the traffic generator AND the fleet router (hash routing and
     # the selectHost mirror are part of the oracle story).
-    assert got.count("det-wallclock") == 3
+    # badscaler.py (ISSUE 11) seeds a wallclock cooldown + a bare-set
+    # hottest-shard pick on top of the prior families' counts.
+    assert got.count("det-wallclock") == 4
     assert got.count("det-random") == 4  # random.random/randrange + os.urandom + expovariate
-    assert got.count("det-set-iteration") == 2  # for-loop + list(set(...))
+    assert got.count("det-set-iteration") == 3  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10): builtin
     # hash() over a node name assigns different owners per process.
