@@ -160,7 +160,11 @@ def _build_scheduler(args):
             queue=queue,
         )
     else:
-        sched = TPUScheduler(batch_size=args.batch_size, chunk_size=args.chunk_size)
+        sched = TPUScheduler(
+            batch_size=args.batch_size,
+            chunk_size=args.chunk_size,
+            tenant_attribution=not getattr(args, "no_observability", False),
+        )
     return sched
 
 
@@ -215,7 +219,10 @@ def _fleet_owner_for(args, sched, lifecycle=None):
             )
         shard_map = ShardMap(n_shards=n_shards)
         shard_map.save(args.shard_map)
-    return ShardOwner(shard_id, sched, shard_map, lifecycle=lifecycle)
+    return ShardOwner(
+        shard_id, sched, shard_map, lifecycle=lifecycle,
+        observability=not getattr(args, "no_observability", False),
+    )
 
 
 def cmd_serve(args) -> int:
@@ -488,6 +495,11 @@ def cmd_fleet(args) -> int:
                         "bound_pods": stats.get("bound_pods"),
                         "epoch": stats.get("epoch"),
                         "lifecycle": stats.get("lifecycle", {}),
+                        # Per-shard tenant skew (top-K tenants by window
+                        # commits from the owner's stats mirror): an
+                        # operator sees which tenants dominate a shard
+                        # without a soak run.
+                        "tenants": stats.get("tenants", {}),
                     }
                 except (OSError, RuntimeError) as exc:
                     owners[sock] = {"unreachable": str(exc)}
@@ -567,6 +579,7 @@ def _fleet_autoscale(args, m) -> int:
             state = {}
     now = time.time()
     commits: dict[int, int] = {}
+    nodes_owned: dict[int, int] = {}
     unreachable: list[str] = []
     for sock in (s.strip() for s in args.sockets.split(",")):
         if not sock:
@@ -580,6 +593,11 @@ def _fleet_autoscale(args, m) -> int:
             commits[int(stats["shard"])] = int(
                 stats.get("load", {}).get("commits_total", 0)
             )
+            # The capacity denominator of the imbalance signal: window
+            # share is judged against the shard's NODE share (a shard
+            # hosting half the fleet is not "hot" for serving half the
+            # binds).
+            nodes_owned[int(stats["shard"])] = int(stats.get("nodes", 0))
         except (OSError, RuntimeError) as exc:
             unreachable.append(f"{sock}: {exc}")
     doc: dict = {"clock": round(now, 3), "map": args.map}
@@ -635,10 +653,13 @@ def _fleet_autoscale(args, m) -> int:
         if until > now
     )
     doc["window_commits"] = {str(s): window[s] for s in sorted(window)}
+    doc["nodes_owned"] = {str(s): nodes_owned[s] for s in sorted(nodes_owned)}
     if len(action_times) >= cfg.max_actions_per_window:
         action, reason = None, "budget"
     else:
-        action, reason = choose_action(window, buckets_owned, cfg, blocked)
+        action, reason = choose_action(
+            window, buckets_owned, cfg, blocked, nodes_owned=nodes_owned
+        )
     if action is None:
         doc["action"] = None
         doc["deferred"] = reason
@@ -804,6 +825,13 @@ def main(argv: list[str] | None = None) -> int:
         help="join the partitioned fleet as shard K of N: only shard-map-"
         "owned nodes are absorbed, and the `fleet` frame (propose/commit/"
         "reserve/handoff ops) is served (kubernetes_tpu/fleet)",
+    )
+    s.add_argument(
+        "--no-observability", action="store_true",
+        help="disable tenant attribution and the owner-side fleet "
+        "observability surface (per-op flight records, op spans) — "
+        "decisions are bit-identical either way; the soak's "
+        "observability A/B leg passes this to serve children",
     )
     s.add_argument(
         "--shard-map", default="/tmp/kubernetes_tpu-shardmap.json",

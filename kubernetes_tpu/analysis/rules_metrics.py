@@ -16,7 +16,16 @@ constructions, and the histogram exposition names fed to
 - ``metrics-labels`` — use one label-key set per name across every
   ``.inc()``/``.set()`` call site: Prometheus treats each label-key
   combination as a separate series, so an inconsistent writer splits one
-  logical series into unjoinable halves.
+  logical series into unjoinable halves;
+- ``metrics-tenant-label`` — every ``tenant=`` label value written by a
+  metric writer must come from the bounded-cardinality helper
+  (``TenantLabeler.label_for``, framework/metrics.py) or be a literal:
+  tenant ids arrive from pod labels — an unbounded, caller-controlled
+  value space — and one raw per-pod string as a label value is an
+  unbounded-cardinality series leak.  The tracker accepts a direct
+  ``…label_for(…)`` call, a symbol assigned from an expression
+  containing one, the ``TENANT_FALLBACK`` constant, and string
+  literals (a literal is bounded by construction).
 
 The tracker resolves handles through simple assignments (``x =
 reg.counter(...)``, ``self._c = reg.counter(...)``, including
@@ -40,6 +49,36 @@ CONSTRUCTORS = {
 DIRECT_CLASSES = {"Counter", "Gauge", "Histogram"}
 # Writer methods whose keyword arguments are the family's label keys.
 WRITERS = ("inc", "set", "observe")
+
+
+def _contains_label_for(expr: ast.AST) -> bool:
+    """True when ``expr`` contains a ``…label_for(…)`` call (the bounded
+    tenant labeler's one entry point) — descends through IfExp/BoolOp
+    wrappers like the construction finder does."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "label_for":
+                return True
+            if isinstance(fn, ast.Name) and fn.id == "label_for":
+                return True
+    return False
+
+
+def _tenant_value_ok(expr: ast.AST, ok_syms: set[str]) -> bool:
+    """Is this ``tenant=`` keyword value bounded?  Literals, the
+    TENANT_FALLBACK constant, direct label_for calls, and symbols
+    assigned from a label_for-containing expression pass; anything else
+    (raw pod strings, f-strings, attribute reads) is a cardinality
+    leak."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name) and expr.id == "TENANT_FALLBACK":
+        return True
+    if _contains_label_for(expr):
+        return True
+    sym = MetricsRule._symbol(expr)
+    return sym is not None and sym in ok_syms
 
 
 def _find_metric_call(expr: ast.AST):
@@ -87,6 +126,7 @@ class MetricsRule(Rule):
 
         for path, ctx in sorted(ctxs.items()):
             handles: dict[str, str] = {}  # symbol → metric name
+            tenant_ok: set[str] = set()  # symbols fed by label_for
             for node in ast.walk(ctx.tree):
                 if isinstance(node, ast.Call):
                     hit = _find_metric_call(node) if self._is_site(node) else None
@@ -134,8 +174,15 @@ class MetricsRule(Rule):
                         sym = self._symbol(node.targets[0])
                         if sym is not None:
                             handles[sym] = hit[1]
+                    if _contains_label_for(node.value):
+                        sym = self._symbol(node.targets[0])
+                        if sym is not None:
+                            tenant_ok.add(sym)
 
-            # Label-key consistency over resolved handles.
+            # Label-key consistency over resolved handles, plus the
+            # bounded-tenant check over EVERY writer call (handle
+            # resolution not required — the tenant rule polices the
+            # label value, not the family).
             for node in ast.walk(ctx.tree):
                 if not isinstance(node, ast.Call):
                     continue
@@ -144,6 +191,31 @@ class MetricsRule(Rule):
                     isinstance(fn, ast.Attribute) and fn.attr in WRITERS
                 ):
                     continue
+                for kw in node.keywords:
+                    if kw.arg == "tenant" and not _tenant_value_ok(
+                        kw.value, tenant_ok
+                    ):
+                        try:
+                            token = ast.unparse(kw.value)[:48]
+                        except Exception:
+                            token = "expr"
+                        out.append(
+                            Finding(
+                                rule="metrics-tenant-label",
+                                path=path,
+                                line=node.lineno,
+                                message=(
+                                    "tenant label value must come from "
+                                    "the bounded-cardinality helper "
+                                    "(TenantLabeler.label_for) — a raw "
+                                    f"string here ({token!r}) leaks "
+                                    "unbounded series"
+                                ),
+                                key=make_key(
+                                    "metrics-tenant-label", path, token
+                                ),
+                            )
+                        )
                 sym = self._symbol(fn.value)
                 if sym is None or sym not in handles:
                     continue

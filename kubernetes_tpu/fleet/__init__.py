@@ -48,4 +48,5 @@ from .autoscaler import (  # noqa: F401
     AutoscalerConfig,
     FleetAutoscaler,
     choose_action,
+    imbalance_ratios,
 )

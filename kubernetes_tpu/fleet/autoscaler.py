@@ -96,18 +96,48 @@ class AutoscalerConfig:
     latency_samples: int = 512
 
 
+def imbalance_ratios(
+    window_binds: dict[int, int],
+    shards: list[int],
+    nodes_owned: dict[int, int] | None = None,
+) -> dict[int, float]:
+    """Per-shard imbalance ratio, 1.0 = fair.  CAPACITY-AWARE when node
+    counts are known: a shard's window binding share is measured against
+    its NODE share, so a shard holding half the fleet's nodes serving
+    half the binds reads 1.0 — fair for what it hosts — instead of the
+    capacity-blind ``share × N`` that read it as permanently hot (the
+    ROADMAP follow-up from PR 11).  Without node counts (or for a shard
+    with zero nodes) the ``share × N`` baseline stands in."""
+    n = len(shards)
+    total = sum(window_binds.get(s, 0) for s in shards)
+    nodes_total = (
+        sum(nodes_owned.get(s, 0) for s in shards) if nodes_owned else 0
+    )
+    out: dict[int, float] = {}
+    for s in shards:
+        share = (window_binds.get(s, 0) / total) if total else 0.0
+        node_share = (
+            nodes_owned.get(s, 0) / nodes_total if nodes_total else 0.0
+        )
+        out[s] = share / node_share if node_share > 0 else share * n
+    return out
+
+
 def choose_action(
     window_binds: dict[int, int],
     buckets_owned: dict[int, int],
     cfg: AutoscalerConfig,
     blocked: frozenset[int] = frozenset(),
+    nodes_owned: dict[int, int] | None = None,
 ) -> tuple[dict | None, str | None]:
     """The pure decision core, shared by the live loop and the ``fleet
     autoscale`` CLI: given the window's per-shard commit counts and the
     map's per-shard bucket counts, return ``(action, None)`` or
     ``(None, deferral_reason)``.  Deterministic: shards iterate sorted,
     ties break toward the lowest id.  ``blocked`` shards (cooldown,
-    unreachable holdoff) can neither source nor receive a handoff."""
+    unreachable holdoff) can neither source nor receive a handoff.
+    ``nodes_owned`` makes the imbalance signal capacity-aware (see
+    ``imbalance_ratios``)."""
     shards = sorted(buckets_owned)
     n = len(shards)
     total = sum(window_binds.get(s, 0) for s in shards)
@@ -115,9 +145,7 @@ def choose_action(
         return None, "no-shards"
     if total < cfg.min_window_decisions:
         return None, "quiet"
-    ratios = {
-        s: (window_binds.get(s, 0) / total) * n for s in shards
-    }
+    ratios = imbalance_ratios(window_binds, shards, nodes_owned)
     hot = min(shards, key=lambda s: (-ratios[s], s))
     cold = min(shards, key=lambda s: (ratios[s], s))
     if ratios[hot] >= cfg.split_imbalance_hi:
@@ -306,12 +334,12 @@ class FleetAutoscaler:
         buckets_owned = self._buckets_owned()
         n = len(buckets_owned)
         self._m_shards.set(n)
-        total = self._window_total
+        nodes_owned = self._nodes_owned()
+        ratios = imbalance_ratios(
+            self._window_binds, sorted(buckets_owned), nodes_owned
+        )
         for s in sorted(buckets_owned):
-            ratio = (
-                (self._window_binds.get(s, 0) / total) * n if total else 0.0
-            )
-            self._m_imbalance.set(round(ratio, 4), shard=str(s))
+            self._m_imbalance.set(round(ratios[s], 4), shard=str(s))
         used = sum(
             1 for t in self._action_times if t > now - self.cfg.window_s
         )
@@ -326,7 +354,8 @@ class FleetAutoscaler:
             or self._unreachable_until.get(s, -1.0) > now
         )
         action, reason = choose_action(
-            self._window_binds, buckets_owned, self.cfg, blocked
+            self._window_binds, buckets_owned, self.cfg, blocked,
+            nodes_owned=nodes_owned,
         )
         if action is None:
             self._defer(reason or "in-band")
@@ -338,6 +367,12 @@ class FleetAutoscaler:
                 return []
         done = self._execute(action, now)
         return [done] if done is not None else []
+
+    def _nodes_owned(self) -> dict[int, int]:
+        """Per-shard live node counts (the router maintains them
+        incrementally) — the capacity denominator of the imbalance
+        signal.  Deterministic: a pure function of the object feed."""
+        return dict(self.router._shard_node_count)
 
     def _buckets_owned(self) -> dict[int, int]:
         """Per-shard bucket counts, derived from the MAP — the ownership
@@ -440,19 +475,28 @@ class FleetAutoscaler:
         the actions-this-window budget."""
         now = self._now
         buckets_owned = self._buckets_owned()
-        n = len(buckets_owned)
         total = self._window_total
+        nodes_owned = self._nodes_owned()
+        nodes_total = sum(
+            nodes_owned.get(s, 0) for s in buckets_owned
+        )
+        ratios = imbalance_ratios(
+            self._window_binds, sorted(buckets_owned), nodes_owned
+        )
         shards = {}
         for s in sorted(buckets_owned):
             w = self._window_binds.get(s, 0)
             shards[str(s)] = {
                 "window_binds": w,
                 "share": round(w / total, 4) if total else 0.0,
-                "imbalance_ratio": (
-                    round((w / total) * n, 4) if total else 0.0
+                "imbalance_ratio": round(ratios[s], 4),
+                "node_share": (
+                    round(nodes_owned.get(s, 0) / nodes_total, 4)
+                    if nodes_total
+                    else 0.0
                 ),
                 "buckets": buckets_owned[s],
-                "nodes": self.router._shard_node_count.get(s, 0),
+                "nodes": nodes_owned.get(s, 0),
                 "slo_p99_ms": round(self._p99_ms(s), 3),
                 "cooldown_remaining_s": round(
                     max(0.0, self._cooldown_until.get(s, 0.0) - now), 3

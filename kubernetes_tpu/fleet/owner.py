@@ -26,9 +26,12 @@ protocol as an in-process owner."""
 from __future__ import annotations
 
 import os
+import time
 
 from ..api import serialize, types as t
 from ..framework.leaderelection import FileLease, read_epoch
+from ..framework.metrics import pod_tenant
+from ..framework.tracing import Trace
 from ..journal import Journal, recover as journal_recover
 from .shardmap import ShardMap
 
@@ -43,11 +46,25 @@ class ShardOwner:
         journal_fsync: bool = True,
         snapshot_every_batches: int = 8,
         lifecycle: dict | None = None,
+        observability: bool = True,
     ) -> None:
         self.shard_id = shard_id
         self.sched = scheduler
         self.shard_map = shard_map
         self.state_dir = state_dir
+        # Observability surface (ISSUE 12): per-op flight records on the
+        # scheduler's ring (logical-clock-stamped, merged fleet-wide by
+        # framework/flight.merge_fleet), op spans joining the router's
+        # trace, and per-tenant commit tracking.  Purely observational —
+        # off, the owner binds bit-identically.
+        self.observability = observability
+        # The current fleet op's span (router trace context) and logical
+        # clock, set per dispatch by fleet_dispatch.
+        self._op_span: Trace | None = None
+        self._op_lc: float | None = None
+        # Monotone per-tenant commit counts (bounded label space via the
+        # scheduler's tenant labeler) — `fleet status`'s tenants block.
+        self.tenant_commits: dict[str, int] = {}
         self.lease: FileLease | None = None
         self.journal: Journal | None = None
         self.recovery_stats: dict | None = None
@@ -228,22 +245,95 @@ class ShardOwner:
 
     # -- the scatter-gather schedule surface -------------------------------
 
+    def _tenant_label(self, pod: t.Pod) -> str:
+        """The pod's BOUNDED tenant label (the scheduler's labeler when
+        attribution is armed; the raw-or-fallback value otherwise never
+        leaves this owner's in-memory stats)."""
+        tm = self.sched.tenant_metrics
+        if tm is not None:
+            return tm.labeler.label_for(pod_tenant(pod))
+        return pod_tenant(pod) or "-"
+
+    def _flight_op(self, op: str, pod: t.Pod, rec: dict) -> None:
+        """One per-op flight record on the scheduler's ring: shard- and
+        logical-clock-stamped so merge_fleet can interleave every owner's
+        log into one fleet timeline."""
+        rec.update(op=op, shard=self.shard_id)
+        if self._op_lc is not None:
+            rec["lc"] = self._op_lc
+        self.sched.flight.record_batch(rec)
+
     def propose(self, pod: t.Pod) -> dict:
-        return self.sched.propose_pod(pod)
+        if not self.observability:
+            return self.sched.propose_pod(pod)
+        t0 = time.perf_counter()
+        span = self._op_span
+        res = self.sched.propose_pod(pod, span=span)
+        feat_s = res.get("feat_s", 0.0)
+        dev_s = res.get("dev_s", 0.0)
+        self._flight_op(
+            "propose",
+            pod,
+            {
+                "pods": 1,
+                "scheduled": 0,
+                "wall_s": round(time.perf_counter() - t0, 6),
+                "phases": {"featurize": feat_s, "device": dev_s},
+            },
+        )
+        return res
 
     def commit(self, pod: t.Pod, node_name: str):
+        t0 = time.perf_counter()
         out = self.sched.commit_proposed(pod, node_name)
-        if out is not None and out.node_name:
+        bound = out is not None and out.node_name
+        tlabel = None
+        if bound:
             self.commits_total += 1
+            if self.observability:
+                tlabel = self._tenant_label(pod)
+                self.tenant_commits[tlabel] = (
+                    self.tenant_commits.get(tlabel, 0) + 1
+                )
+        if self.observability:
+            wall = round(time.perf_counter() - t0, 6)
+            rec = {
+                "pods": 1,
+                "scheduled": 1 if bound else 0,
+                "wall_s": wall,
+                "phases": {"commit": wall},
+            }
+            if tlabel is not None:
+                rec["tenant"] = tlabel
+            self._flight_op("commit", pod, rec)
         return out
 
     def reserve(self, pod: t.Pod, node_name: str, gang: str) -> bool:
         return self.sched.reserve_proposed(pod, node_name, gang=gang)
 
     def commit_reserved(self, uid: str):
+        t0 = time.perf_counter()
         out = self.sched.commit_reserved(uid)
         if out is not None and out.node_name:
             self.commits_total += 1
+            if self.observability:
+                tlabel = self._tenant_label(out.pod)
+                self.tenant_commits[tlabel] = (
+                    self.tenant_commits.get(tlabel, 0) + 1
+                )
+                self._flight_op(
+                    "commit_reserved",
+                    out.pod,
+                    {
+                        "pods": 1,
+                        "scheduled": 1,
+                        "tenant": tlabel,
+                        "wall_s": round(time.perf_counter() - t0, 6),
+                        "phases": {
+                            "commit": round(time.perf_counter() - t0, 6)
+                        },
+                    },
+                )
         return out
 
     def abort(self, uid: str) -> None:
@@ -283,6 +373,11 @@ class ShardOwner:
             if name in self.sched.cache.nodes:
                 self.sched.remove_node(name)
         self.handoffs_out += 1
+        if self.observability:
+            fields = {"shard": self.shard_id, "nodes": len(names)}
+            if self._op_lc is not None:
+                fields["lc"] = self._op_lc
+            self.sched.flight.record_marker("handoff_out", **fields)
 
     def import_nodes(self, record: dict, payload: dict) -> None:
         """The acquiring half: journal the handoff record FIRST (a crash
@@ -315,6 +410,15 @@ class ShardOwner:
             sched._journal_bind(pod, entry["node"])
             sched.add_pod(pod)
         self.handoffs_in += 1
+        if self.observability:
+            fields = {
+                "shard": self.shard_id,
+                "nodes": len(payload.get("nodes", ())),
+                "pods": len(payload.get("pods", ())),
+            }
+            if self._op_lc is not None:
+                fields["lc"] = self._op_lc
+            self.sched.flight.record_marker("handoff_in", **fields)
 
     def apply_recovered_bindings(self) -> int:
         """Journal bind records whose node was unknown at replay time
@@ -405,6 +509,18 @@ class ShardOwner:
             # count (wire probes diff successive reads into a window
             # rate) — handoff-imported bindings excluded by design.
             "load": {"commits_total": self.commits_total},
+            # Per-tenant commit skew (`fleet status`'s tenants block):
+            # top-K tenants by monotone commit count, bounded label
+            # space (the scheduler's tenant labeler).  Operators diff
+            # successive reads for a window view, same as `load`.
+            "tenants": {
+                "top": sorted(
+                    self.tenant_commits.items(),
+                    key=lambda kv: (-kv[1], kv[0]),
+                )[:5],
+                "distinct": len(self.tenant_commits),
+                "commits_total": sum(self.tenant_commits.values()),
+            },
             "epoch": (
                 self.lease.epoch
                 if self.lease
@@ -463,8 +579,41 @@ _EVICTION_BEARING_OPS = frozenset(
 def fleet_dispatch(owner: ShardOwner, op: str, payload: dict) -> dict:
     """The wire entry point: one ``fleet`` Envelope frame = one op.
     Pods ride as canonical JSON dicts (the AddObject convention); every
-    response is a JSON-clean dict."""
-    res = _dispatch_op(owner, op, payload)
+    response is a JSON-clean dict.
+
+    Observability envelope keys (popped before dispatch, all optional):
+    ``trace_id``/``parent_span_id`` — the router's span context; the op
+    runs under an owner-side span that joins the router's trace (its
+    serialized tree rides back as ``_span``, so the router's slow-span
+    dump shows the complete router→owner→sidecar path) — and ``lc``, the
+    router's logical clock, stamped onto the owner's flight records so
+    merge_fleet interleaves per-owner logs deterministically."""
+    payload = dict(payload)
+    trace_id = payload.pop("trace_id", None)
+    parent_span_id = payload.pop("parent_span_id", None)
+    lc = payload.pop("lc", None)
+    span = None
+    if trace_id and owner.observability:
+        span = Trace(
+            f"FleetOp:{op}",
+            threshold_s=getattr(owner.sched, "trace_threshold_s", 2.0),
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+            on_slow=owner.sched._note_slow_span,
+            shard=owner.shard_id,
+        )
+    owner._op_span = span
+    owner._op_lc = lc if lc is not None else owner._op_lc
+    try:
+        res = _dispatch_op(owner, op, payload)
+    finally:
+        owner._op_span = None
+        if span is not None:
+            span.end()
+            span.log_if_long()
+    if span is not None:
+        res = dict(res)
+        res["_span"] = span.as_dict()
     if owner.evictions_out and op in _EVICTION_BEARING_OPS:
         # Live evictions only — the recovered bucket waits for the
         # explicit drain (its staleness filter needs adopted routing).

@@ -53,11 +53,15 @@ Gang admission spanning shards (two-phase reserve/commit)
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..api import serialize, types as t
+from ..framework.flight import FlightRecorder
+from ..framework.tracing import Trace
 from ..queue import Event, EventCtx, QueuedPodInfo, SchedulingQueue
 from ..scheduler import ScheduleOutcome
 from .shardmap import ShardMap, stable_shard_hash
@@ -98,6 +102,7 @@ class FleetRouter:
         batch_size: int = 256,
         tie_break_seed: int = 0,
         registry=None,
+        observability: bool = True,
     ) -> None:
         self.owners = dict(owners)
         self.shard_map = shard_map
@@ -208,13 +213,82 @@ class FleetRouter:
         # The fleet-wide logical clock (Lease renew_time high-water
         # mark): advances broadcast a ``tick`` to non-owning shards.
         self._lifecycle_hw = 0.0
+        # -- fleet observability (ISSUE 12) -------------------------------
+        # Everything below is OBSERVATIONAL: with observability off the
+        # router routes and binds bit-identically (the soak's on-vs-off
+        # determinism check holds exactly this).
+        self.observability = observability
+        # Fleet-aggregated per-tenant counters: the router counts at ITS
+        # admission/commit sites, so the scheduler_tenant_* families on
+        # this registry are the cross-shard totals while each owner's
+        # registry carries the per-shard split.
+        from ..framework.metrics import TenantMetrics
+
+        self.tenant_metrics = (
+            TenantMetrics(registry) if observability else None
+        )
+        if self.tenant_metrics is not None:
+            self.queue.tenant_note = self.tenant_metrics.note_pod
+        # The router's own flight ring: one record per scatter-gather
+        # batch, logical-clock-stamped — merge_fleet folds it with the
+        # owners' rings into the fleet timeline.
+        self.flight = FlightRecorder(component="router")
+        # Driver-fed logical clock (the soak's scenario clock); None →
+        # the tie-break cycle counter (monotone, deterministic).
+        self._lc: float | None = None
+        # Cross-process slow-span ring: a slow fleet batch logs its
+        # whole router→owner→sidecar tree here (owners' op spans ride
+        # back on the RPC responses and attach as remote children).
+        self.slow_spans: deque = deque(maxlen=16)
+        self.trace_threshold_s = 2.0
+        # Per-batch phase accumulator (scatter/commit/postfilter wall
+        # slices), filled by _schedule_one and finalized into one
+        # router flight record per schedule_batch.
+        self._batch_phases: dict | None = None
+
+    # -- observability helpers ---------------------------------------------
+
+    def lc(self) -> float:
+        """The current logical clock: the driver's scenario clock when
+        fed (note_logical_time), else the tie-break cycle counter —
+        either way a pure function of the op stream."""
+        return self._lc if self._lc is not None else float(self._cycle)
+
+    def note_logical_time(self, t: float) -> None:
+        self._lc = float(t)
+
+    def _note_slow_span(self, tr: Trace) -> None:
+        self.slow_spans.append(tr.as_dict())
+
+    def _note_tenant(self, event: str, pod_or_tenant) -> None:
+        if self.tenant_metrics is None:
+            return
+        if isinstance(pod_or_tenant, (str, type(None))):
+            self.tenant_metrics.note(event, pod_or_tenant)
+        else:
+            self.tenant_metrics.note_pod(event, pod_or_tenant)
 
     # -- owner RPC ---------------------------------------------------------
 
-    def _call(self, shard: int, op: str, payload: dict) -> dict:
+    def _call(
+        self, shard: int, op: str, payload: dict, span: Trace | None = None
+    ) -> dict:
         self._cross_calls.inc(op=op)
+        if self.observability:
+            # The observability envelope: the logical clock every call
+            # (owners stamp their flight records with it) and — when the
+            # caller opened a span — the trace context, so the owner's
+            # op span joins this trace and rides back as a remote child.
+            payload = dict(payload)
+            payload["lc"] = self.lc()
+            if span is not None:
+                payload["trace_id"] = span.trace_id
+                payload["parent_span_id"] = span.span_id
         res = self.owners[shard].call(op, payload)
         if isinstance(res, dict):
+            rspan = res.pop("_span", None)
+            if rspan is not None and span is not None:
+                span.attach_remote(rspan)
             evicted = res.pop("evicted", None)
             if evicted:
                 self._absorb_evictions(shard, evicted)
@@ -542,12 +616,23 @@ class FleetRouter:
 
     # -- scatter-gather scheduling ----------------------------------------
 
-    def _propose_all(self, pod: t.Pod) -> dict[int, dict]:
+    def _propose_all(
+        self, pod: t.Pod, span: Trace | None = None
+    ) -> dict[int, dict]:
         data = serialize.to_dict(pod)
-        return {
-            shard: self._call(shard, "propose", {"pod": data})
-            for shard in self.shard_ids()
-        }
+        out: dict[int, dict] = {}
+        for shard in self.shard_ids():
+            child = (
+                span.nest("ProposeRPC", shard=shard)
+                if span is not None
+                else None
+            )
+            out[shard] = self._call(
+                shard, "propose", {"pod": data}, span=child
+            )
+            if child is not None:
+                child.end()
+        return out
 
     def _select(
         self, proposals: dict[int, dict], pod: t.Pod, step: int
@@ -578,7 +663,7 @@ class FleetRouter:
         return pick[1], pick[2]
 
     def _schedule_one(
-        self, qp: QueuedPodInfo, step: int
+        self, qp: QueuedPodInfo, step: int, span: Trace | None = None
     ) -> tuple[ScheduleOutcome, bool]:
         """One scatter-gather cycle.  Returns (outcome, run_postfilter):
         preemption is NOT attempted here — the single scheduler runs
@@ -586,8 +671,14 @@ class FleetRouter:
         and committing evictions mid-batch would show later batch-mates a
         state the oracle's in-scan evaluation never saw."""
         pod = qp.pod  # attempts already bumped by pop_batch
+        acc = self._batch_phases
         home = self.home_shard(pod)
-        proposals = self._propose_all(pod)
+        t0 = time.perf_counter()
+        proposals = self._propose_all(pod, span)
+        if acc is not None:
+            acc["scatter"] = (
+                acc.get("scatter", 0.0) + time.perf_counter() - t0
+            )
         req = proposals[home].get("req")
         if req is not None:
             # The fit-wake hint's request vector (the single scheduler
@@ -610,11 +701,24 @@ class FleetRouter:
             self._forwarded.inc()
         if g and g in self.gang_min:
             return self._reserve_gang_member(qp, node_name, shard, g), False
+        child = (
+            span.nest("CommitRPC", shard=shard, node=node_name)
+            if span is not None
+            else None
+        )
+        t1 = time.perf_counter()
         res = self._call(
             shard,
             "commit",
             {"pod": serialize.to_dict(pod), "node": node_name},
+            span=child,
         )
+        if child is not None:
+            child.end()
+        if acc is not None:
+            acc["commit"] = (
+                acc.get("commit", 0.0) + time.perf_counter() - t1
+            )
         if res.get("bound") is None:
             # A Reserve plugin refused on the winner — the cycle-error
             # path: retry behind backoff (handleSchedulingFailure), no
@@ -625,6 +729,7 @@ class FleetRouter:
         self.binds_by_shard[shard] = self.binds_by_shard.get(shard, 0) + 1
         self.queue.done(pod.uid)
         self._note_rebind(pod.uid, shard)
+        self._note_tenant("bound", pod)
         return ScheduleOutcome(pod, node_name), False
 
     def _postfilter(self, qp: QueuedPodInfo, outcome: ScheduleOutcome) -> None:
@@ -692,6 +797,8 @@ class FleetRouter:
                 self.gang_bound[g] = left
             else:
                 self.gang_bound.pop(g, None)
+        for tenant in res.get("victim_tenants", ()):
+            self._note_tenant("preempted", tenant or None)
         for uid in res["victims"]:
             self._pod_shard.pop(uid, None)
         pod.status.nominated_node_name = res["node"]
@@ -745,6 +852,7 @@ class FleetRouter:
                 self.binds_by_shard.get(shard, 0) + 1
             )
             self._note_rebind(uid, shard)
+            self._note_tenant("bound", room.pods[uid])
             self.gang_bound[g] = self.gang_bound.get(g, 0) + 1
             room.outcomes[uid].node_name = res.get("bound")
             self._gang_committed.append(room.outcomes[uid])
@@ -773,26 +881,74 @@ class FleetRouter:
         infos = self.queue.pop_batch(self.batch_size)
         if not infos:
             return []
+        t0 = time.perf_counter()
+        tr: Trace | None = None
+        if self.observability:
+            # The batch root span: per-pod child spans fan out with the
+            # owner RPCs, whose op spans ride back as remote children —
+            # a slow batch dumps the whole router→owner→sidecar tree.
+            tr = Trace(
+                "FleetScheduleBatch",
+                threshold_s=self.trace_threshold_s,
+                on_slow=self._note_slow_span,
+                pods=len(infos),
+            )
+            self._batch_phases = {}
         base = self._cycle
         outcomes: list[ScheduleOutcome] = []
         failed: list[tuple[QueuedPodInfo, ScheduleOutcome]] = []
-        for i, qp in enumerate(infos):
-            out, run_pf = self._schedule_one(qp, base + i)
-            outcomes.append(out)
-            if run_pf:
-                failed.append((qp, out))
-        # The single scheduler burns one tie-break step per popped pod
-        # (scheduler.py _dispatch_batch: _cycle += len(infos)).
-        self._cycle += len(infos)
-        # PostFilter phase, batch order — evictions land only after the
-        # whole scan, like scheduler._complete_batch.
-        for qp, out in failed:
-            self._postfilter(qp, out)
+        try:
+            for i, qp in enumerate(infos):
+                sp = (
+                    tr.nest("SchedulePod", pod=qp.pod.uid)
+                    if tr is not None
+                    else None
+                )
+                out, run_pf = self._schedule_one(qp, base + i, span=sp)
+                if sp is not None:
+                    sp.end()
+                outcomes.append(out)
+                if run_pf:
+                    failed.append((qp, out))
+            # The single scheduler burns one tie-break step per popped pod
+            # (scheduler.py _dispatch_batch: _cycle += len(infos)).
+            self._cycle += len(infos)
+            # PostFilter phase, batch order — evictions land only after
+            # the whole scan, like scheduler._complete_batch.
+            t_pf = time.perf_counter()
+            for qp, out in failed:
+                self._postfilter(qp, out)
+            if self._batch_phases is not None and failed:
+                self._batch_phases["postfilter"] = (
+                    time.perf_counter() - t_pf
+                )
+        finally:
+            acc, self._batch_phases = self._batch_phases, None
+            if tr is not None:
+                tr.end()
+                tr.log_if_long()
         bound = [o for o in outcomes if o.node_name]
         seen = {o.pod.uid for o in outcomes}
         # Members reserved in an earlier batch whose gang committed now.
         bound.extend(o for o in self._gang_committed if o.pod.uid not in seen)
         self._gang_committed.clear()
+        if self.observability:
+            wall = time.perf_counter() - t0
+            phases = {k: round(v, 6) for k, v in (acc or {}).items()}
+            phases["other"] = round(
+                max(wall - sum(phases.values()), 0.0), 6
+            )
+            rec = {
+                "lc": self.lc(),
+                "pods": len(infos),
+                "scheduled": len(bound),
+                "wall_s": round(wall, 6),
+                "phases": phases,
+            }
+            if tr is not None:
+                rec["trace_id"] = tr.trace_id
+                rec["span_id"] = tr.span_id
+            self.flight.record_batch(rec)
         return bound
 
     def schedule_all_pending(
@@ -901,7 +1057,7 @@ class FleetRouter:
         return out
 
     def stats(self) -> dict:
-        return {
+        out = {
             "shards": {
                 str(s): self._call(s, "stats", {}) for s in self.shard_ids()
             },
@@ -915,3 +1071,34 @@ class FleetRouter:
                 g: sorted(r.pods) for g, r in self._gang_rooms.items()
             },
         }
+        if self.tenant_metrics is not None:
+            # Fleet-aggregated per-tenant view (the per-shard split rides
+            # each owner's stats["tenants"] above).
+            out["tenants"] = self.tenant_metrics.snapshot()
+        return out
+
+    def fleet_flight_snapshots(
+        self, limit: int | None = None
+    ) -> tuple[list[dict], list[str]]:
+        """Every component's flight snapshot + merge labels — the input
+        pair ``framework/flight.merge_fleet`` takes: each owner's ring
+        (over the wire via the ``flight`` frame for serve children,
+        in-process via the scheduler's recorder) plus the router's own."""
+        snaps: list[dict] = []
+        names: list[str] = []
+        for shard in self.shard_ids():
+            owner = self.owners[shard]
+            sched = getattr(owner, "sched", None)
+            if sched is not None:
+                snap = sched.flight.snapshot(limit)
+            else:
+                client = getattr(owner, "client", None)
+                try:
+                    snap = client.flight(limit or 0) if client else {}
+                except (ConnectionError, TimeoutError, OSError):
+                    snap = {}
+            snaps.append(snap or {"records": []})
+            names.append(f"owner-{shard}")
+        snaps.append(self.flight.snapshot(limit))
+        names.append("router")
+        return snaps, names

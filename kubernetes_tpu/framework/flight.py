@@ -184,3 +184,231 @@ def load_dump(path: str) -> dict:
     """Read one flight dump (the profile_report.py entry point)."""
     with open(path, "r", encoding="utf-8") as f:
         return json.load(f)
+
+
+# -- federated fleet merge ---------------------------------------------------
+#
+# A partitioned fleet sheds N disjoint flight logs (one per owner, plus
+# the router's).  ``merge_fleet`` folds them into ONE fleet document with
+# two distinct sections:
+#
+# - ``timeline`` — the deterministic event sequence, ordered on the
+#   LOGICAL clock (the ``lc`` field callers stamp on records: the soak's
+#   scenario clock, the router's cycle counter).  Wall-derived fields
+#   (ts, wall_s, phases) are stripped, so two same-seed runs produce a
+#   byte-identical timeline (``timeline_sha256`` is the replayability
+#   hash the soak artifact records).
+# - ``wall`` / ``critical_path`` — the attribution sections, computed
+#   from the records' wall timestamps: per-component busy time, fleet
+#   union busy time, the overlap between components (parallelism), and
+#   a critical-path sweep that attributes each instant of fleet busy
+#   time to the (component, phase) slice doing the gating WORK — among
+#   the slices active at that instant, the innermost one (shortest
+#   enclosing batch), so a router blocked on an owner RPC credits the
+#   owner's device pass, not its own wait.
+#   Honest about being wall-derived: excluded from the timeline hash.
+
+# Phase keys that nest inside (or overlap) the tiled phases — excluded
+# from tiling, same list profile_report uses.
+TILED_EXCLUDE = ("journal_append", "journal_fsync", "hint_decode")
+# Canonical within-batch tiling order for the critical-path sweep;
+# phases not listed sort after, alphabetically.
+PHASE_ORDER = (
+    "featurize", "eval", "device", "scatter", "select", "commit",
+    "snapshot", "other",
+)
+
+# Deterministic record fields the merged timeline keeps (everything
+# wall-derived stays out — the hash must replay).
+_TIMELINE_FIELDS = (
+    "event", "pods", "scheduled", "unschedulable", "deferred",
+    "dispatch", "tenant", "op", "shard", "from", "to", "clock", "version",
+)
+
+
+def _phase_rank(name: str) -> tuple:
+    try:
+        return (PHASE_ORDER.index(name), "")
+    except ValueError:
+        return (len(PHASE_ORDER), name)
+
+
+def _merge_intervals(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    out: list[tuple[float, float]] = []
+    for start, end in sorted(intervals):
+        if out and start <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], end))
+        else:
+            out.append((start, end))
+    return out
+
+
+def _critical_path(slices: list[tuple]) -> dict[tuple[str, str], float]:
+    """Sweep the phase slices ((start, end, component, phase,
+    batch_len)) and attribute each elementary busy segment to the
+    INNERMOST active slice — the one belonging to the shortest enclosing
+    batch.  A router batch encloses the owner RPCs it blocks on, so
+    during an owner's device pass the owner's slice (not the router's
+    wait) gets the time; when only the enclosing component is busy
+    (select, bookkeeping) it takes the attribution itself.  Ties break
+    on (component, phase) — stable and deterministic."""
+    import heapq
+
+    events: list[tuple[float, int, int]] = []
+    for i, (start, end, _c, _p, _bl) in enumerate(slices):
+        if end > start:
+            events.append((start, 1, i))
+            events.append((end, 0, i))
+    events.sort()
+    out: dict[tuple[str, str], float] = {}
+    active: set[int] = set()
+    heap: list[tuple] = []  # (batch_len, component, phase, idx), lazy-deleted
+    prev: float | None = None
+    for ts, kind, idx in events:
+        if prev is not None and active and ts > prev:
+            while heap and heap[0][3] not in active:
+                heapq.heappop(heap)
+            if heap:
+                _bl, comp, phase, _i = heap[0]
+                key = (comp, phase)
+                out[key] = out.get(key, 0.0) + (ts - prev)
+        if kind == 1:
+            active.add(idx)
+            _s, _e, comp, phase, batch_len = slices[idx]
+            heapq.heappush(heap, (batch_len, comp, phase, idx))
+        else:
+            active.discard(idx)
+        prev = ts
+    return out
+
+
+def merge_fleet(
+    snapshots: list[dict], names: list[str] | None = None
+) -> dict:
+    """Merge per-component flight snapshots (``FlightRecorder.snapshot``
+    documents) into one fleet timeline + attribution document.  ``names``
+    overrides the components' self-reported names (the fleet soak labels
+    owners ``owner-K`` and the front door ``router``); duplicate names
+    get ``#2``-style suffixes so records stay attributable."""
+    comps: list[tuple[str, list[dict]]] = []
+    seen: set[str] = set()
+    for i, snap in enumerate(snapshots):
+        name = (
+            names[i]
+            if names is not None and i < len(names)
+            else snap.get("component", f"component-{i}")
+        )
+        base, k = name, 2
+        while name in seen:
+            name = f"{base}#{k}"
+            k += 1
+        seen.add(name)
+        comps.append((name, list(snap.get("records") or ())))
+
+    timeline: list[dict] = []
+    slices: list[tuple] = []
+    comp_stats: dict[str, dict] = {}
+    comp_intervals: dict[str, list] = {}
+    for name, records in comps:
+        stats = comp_stats.setdefault(
+            name,
+            {"records": 0, "batches": 0, "markers": 0, "busy_s": 0.0,
+             "phases": {}},
+        )
+        for rec in records:
+            stats["records"] += 1
+            entry = {
+                "component": name,
+                "seq": rec.get("seq", 0),
+                "kind": rec.get("kind", "?"),
+            }
+            if rec.get("lc") is not None:
+                entry["lc"] = rec["lc"]
+            for key in _TIMELINE_FIELDS:
+                if key in rec:
+                    entry[key] = rec[key]
+            timeline.append(entry)
+            if rec.get("kind") == "marker":
+                stats["markers"] += 1
+                continue
+            if rec.get("kind") != "batch":
+                continue
+            stats["batches"] += 1
+            wall = float(rec.get("wall_s") or 0.0)
+            ts = rec.get("ts")
+            if wall <= 0 or ts is None:
+                continue
+            end = float(ts)
+            start = end - wall
+            comp_intervals.setdefault(name, []).append((start, end))
+            cursor = start
+            phases = rec.get("phases") or {}
+            for phase in sorted(phases, key=_phase_rank):
+                if phase in TILED_EXCLUDE:
+                    continue
+                dur = float(phases[phase])
+                if dur <= 0:
+                    continue
+                stats["phases"][phase] = (
+                    stats["phases"].get(phase, 0.0) + dur
+                )
+                slices.append(
+                    (cursor, min(cursor + dur, end), name, phase, wall)
+                )
+                cursor += dur
+    # The deterministic spine: logical-clock order, lc-less records after
+    # (grouped per component in ring order).
+    timeline.sort(
+        key=lambda e: (
+            0 if "lc" in e else 1,
+            e.get("lc", 0.0),
+            e["component"],
+            e["seq"],
+        )
+    )
+    import hashlib
+
+    timeline_sha = hashlib.sha256(
+        json.dumps(timeline, sort_keys=True).encode()
+    ).hexdigest()
+
+    all_intervals: list[tuple[float, float]] = []
+    for name, intervals in comp_intervals.items():
+        merged = _merge_intervals(intervals)
+        comp_stats[name]["busy_s"] = round(
+            sum(e - s for s, e in merged), 6
+        )
+        all_intervals.extend(merged)
+    union = _merge_intervals(all_intervals)
+    union_s = sum(e - s for s, e in union)
+    busy_total = sum(c["busy_s"] for c in comp_stats.values())
+    crit = _critical_path(slices)
+    critical_path = [
+        {
+            "component": comp,
+            "phase": phase,
+            "seconds": round(secs, 6),
+            "share": round(secs / union_s, 4) if union_s else 0.0,
+        }
+        for (comp, phase), secs in sorted(
+            crit.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+    ]
+    for stats in comp_stats.values():
+        stats["phases"] = {
+            k: round(v, 6) for k, v in sorted(stats["phases"].items())
+        }
+    return {
+        "metric": "fleet_flight_merge",
+        "components": {k: comp_stats[k] for k in sorted(comp_stats)},
+        "timeline": timeline,
+        "timeline_events": len(timeline),
+        "timeline_sha256": timeline_sha,
+        "wall": {
+            "busy_s_total": round(busy_total, 6),
+            "union_busy_s": round(union_s, 6),
+            "overlap_s": round(max(busy_total - union_s, 0.0), 6),
+            "parallelism": round(busy_total / union_s, 4) if union_s else 0.0,
+        },
+        "critical_path": critical_path,
+    }

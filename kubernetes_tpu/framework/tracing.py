@@ -42,8 +42,8 @@ class Trace:
 
     __slots__ = (
         "name", "threshold_s", "fields", "trace_id", "span_id",
-        "parent_span_id", "children", "_parent", "_t0", "_t_end", "_steps",
-        "_logged", "_on_slow",
+        "parent_span_id", "children", "remote_children", "_parent", "_t0",
+        "_t_end", "_steps", "_logged", "_on_slow",
     )
 
     def __init__(
@@ -70,6 +70,11 @@ class Trace:
         # process (the sidecar envelope's trace_id/parent_span_id pair).
         self.parent_span_id = parent_span_id
         self.children: list[Trace] = []
+        # Serialized span trees from ANOTHER process that joined this
+        # span (a fleet owner's op span riding back on the RPC
+        # response).  Rendered and dumped as children; they carry their
+        # own ids so the tree stays greppable across process logs.
+        self.remote_children: list[dict] = []
         self._t0 = time.perf_counter()
         self._t_end: float | None = None
         self._steps: list[tuple[str, float]] = []
@@ -85,6 +90,16 @@ class Trace:
         child = Trace(name, threshold_s=self.threshold_s, parent=self, **fields)
         self.children.append(child)
         return child
+
+    def attach_remote(self, span_dict: dict) -> None:
+        """Join a serialized span tree from another process as a child of
+        THIS span (the router attaches the owner's op span returned on
+        the fleet RPC).  The remote dict keeps its own trace/span ids —
+        a well-formed remote span carries this trace's id and this
+        span's id as its parent, which ``stitch_spans`` also verifies
+        post-hoc over dumps."""
+        if span_dict:
+            self.remote_children.append(span_dict)
 
     def end(self) -> None:
         if self._t_end is None:
@@ -116,6 +131,8 @@ class Trace:
             else:
                 parts.append(f"{indent}  {msg} (+{(ts - prev) * 1000:.1f}ms)")
                 prev = ts
+        for rc in self.remote_children:
+            render_span_dict(rc, parts, indent + "  ")
 
     def log_if_long(self, threshold_s: float | None = None) -> bool:
         """Emit the span tree when the span ran long.  Returns whether it
@@ -148,7 +165,8 @@ class Trace:
             "steps": [
                 [msg, round((ts - self._t0) * 1000, 3)] for msg, ts in self._steps
             ],
-            "children": [c.as_dict() for c in self.children],
+            "children": [c.as_dict() for c in self.children]
+            + list(self.remote_children),
         }
 
     def __enter__(self) -> "Trace":
@@ -158,3 +176,53 @@ class Trace:
         self.end()
         if self._parent is None:
             self.log_if_long()
+
+
+def render_span_dict(span: dict, parts: list[str], indent: str = "") -> None:
+    """Render a SERIALIZED span tree (``as_dict`` shape) the way a live
+    span renders — used for remote children stitched into a local tree
+    and by profile_report's slow-span view."""
+    ids = f"trace={span.get('trace_id')} span={span.get('span_id')}"
+    if span.get("parent_span_id"):
+        ids += f" parent={span['parent_span_id']}"
+    tail = " ".join(f"{k}={v}" for k, v in (span.get("fields") or {}).items())
+    parts.append(
+        f'{indent}"{span.get("name")}" '
+        f"total={span.get('duration_ms', 0):.1f}ms {ids}"
+        + (f" {tail}" if tail else "")
+    )
+    for msg, offset_ms in span.get("steps") or ():
+        parts.append(f"{indent}  {msg} (@{offset_ms:.1f}ms)")
+    for child in span.get("children") or ():
+        render_span_dict(child, parts, indent + "  ")
+
+
+def stitch_spans(spans: list[dict]) -> list[dict]:
+    """Join serialized span trees from MULTIPLE processes into forests:
+    a span whose ``(trace_id, parent_span_id)`` matches another span's
+    ``(trace_id, span_id)`` becomes that span's child (copies — inputs
+    are not mutated).  Returns the roots (spans whose parent is absent
+    from the input), each carrying its full cross-process subtree —
+    the post-hoc version of ``Trace.attach_remote`` for dumps collected
+    after the fact (router → owner → sidecar joined offline)."""
+    import copy
+
+    nodes = [copy.deepcopy(s) for s in spans]
+
+    by_id: dict[tuple, dict] = {}
+
+    def index(span: dict) -> None:
+        by_id[(span.get("trace_id"), span.get("span_id"))] = span
+        for child in span.get("children") or ():
+            index(child)
+
+    for span in nodes:
+        index(span)
+    roots = []
+    for span in nodes:
+        parent = by_id.get((span.get("trace_id"), span.get("parent_span_id")))
+        if parent is not None and parent is not span:
+            parent.setdefault("children", []).append(span)
+        else:
+            roots.append(span)
+    return roots

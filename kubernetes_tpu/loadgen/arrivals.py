@@ -101,6 +101,35 @@ def diurnal_offsets(
             out.append(round(t, 9))
 
 
+def burst_offsets(
+    base_rate: float,
+    burst_rate: float,
+    burst_start_s: float,
+    burst_end_s: float,
+    duration_s: float,
+    seed: int,
+) -> list[float]:
+    """Arrival offsets of a piecewise-constant-rate Poisson process:
+    ``base_rate`` outside ``[burst_start_s, burst_end_s)``, ``burst_rate``
+    inside — the one-tenant-bursts shape the tenant-starvation scenario
+    drives (realized by Lewis-Shedler thinning at the max rate, so the
+    schedule stays a pure function of the seed like every other
+    process here)."""
+    peak = max(base_rate, burst_rate)
+    if peak <= 0 or duration_s <= 0:
+        return []
+    rng = _rng(seed)
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= duration_s:
+            return out
+        rate = burst_rate if burst_start_s <= t < burst_end_s else base_rate
+        if float(rng.random()) < rate / peak:
+            out.append(round(t, 9))
+
+
 def coalesce(
     offsets: list[float], window_s: float
 ) -> list[tuple[float, list[int]]]:

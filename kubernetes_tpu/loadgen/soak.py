@@ -54,11 +54,23 @@ from dataclasses import asdict, dataclass, field
 import numpy as np
 
 from ..api.wrappers import make_node, make_pod
-from ..framework.metrics import MetricsRegistry
+from ..framework.flight import merge_fleet
+from ..framework.metrics import (
+    TENANT_FALLBACK,
+    MetricsRegistry,
+    TenantMetrics,
+    pod_tenant,
+)
 from ..journal import Journal
 from ..sidecar.host import DecisionCache, ResyncingClient
 from ..sidecar.server import SidecarClient
-from .arrivals import _rng, coalesce, diurnal_offsets, poisson_offsets
+from .arrivals import (
+    _rng,
+    burst_offsets,
+    coalesce,
+    diurnal_offsets,
+    poisson_offsets,
+)
 from .scenarios import DEFAULT_INV_MIX, build_events
 from .workloads import WorkloadMix
 
@@ -148,6 +160,23 @@ class SoakConfig:
     pace: str = "real"
     # Artifact directory (flight dumps, final flight ring); empty → temp.
     out_dir: str = ""
+    # -- tenant attribution (ISSUE 12) ----------------------------------
+    # Weighted tenant draw for the stream: ((name, weight), ...) — every
+    # arrival carries the scheduler.tpu/tenant label, drawn by its own
+    # seeded stream (the template draw sequence is untouched).
+    tenants: tuple = ()
+    # Per-tenant arrival STREAMS (the tenant_starvation scenario; fleet
+    # soak only): tuple of dicts {"name", "rate_pods_per_s", and
+    # optionally "burst_factor"/"burst_start_s"/"burst_end_s"} — each
+    # tenant arrives on its own seeded schedule (steady Poisson, or a
+    # piecewise burst), merged time-ordered.  Non-empty replaces the
+    # single rate_pods_per_s/diurnal schedule.
+    tenant_streams: tuple = ()
+    # Master observability switch: tenant attribution, fleet tracing and
+    # flight logical-clock stamping.  Decisions are bit-identical with
+    # it on or off — the tenant artifact's obs-off leg asserts exactly
+    # that (observability must observe, never steer).
+    observability: bool = True
 
 
 def _sha(obj) -> str:
@@ -172,6 +201,56 @@ def _lat_summary(values: list[float]) -> dict:
             float(np.mean(values)) * 1e3 if values else 0.0, 3
         ),
         "max_ms": round(max(values) * 1e3 if values else 0.0, 3),
+    }
+
+
+def _slo_families(registry: MetricsRegistry, budget_ms: float):
+    """The soak SLO families — ONE construction site shared by the
+    single-scheduler driver and the fleet soak (metrics hygiene: one
+    registration per name).  Both latency families carry the bounded
+    ``tenant`` label next to ``phase`` (ISSUE 12: whose p99 blew up)."""
+    hist = registry.histogram(
+        "scheduler_slo_decision_latency_seconds",
+        "Per-decision serving latency of the open-loop soak driver "
+        "(arrival deadline to decision), by phase and tenant.",
+    )
+    violations = registry.counter(
+        "scheduler_slo_violations_total",
+        "Soak decisions whose serving latency exceeded the SLO "
+        "budget, by phase and tenant.",
+    )
+    registry.gauge(
+        "scheduler_slo_budget_seconds",
+        "Configured SLO latency budget for the soak driver.",
+    ).set(budget_ms / 1e3)
+    return hist, violations
+
+
+def _tenant_summary(phases: list["_PhaseResult"]) -> dict:
+    """Aggregate the phases' per-tenant splits into the artifact's
+    tenants block: decisions/bound/violations + the latency percentile
+    split, keyed by raw tenant id ("-" = untagged)."""
+    lat: dict[str, list] = {}
+    cnt: dict[str, int] = {}
+    bound: dict[str, int] = {}
+    viol: dict[str, int] = {}
+    for p in phases:
+        for k, v in p.tenant_latencies.items():
+            lat.setdefault(k, []).extend(v)
+        for k, v in p.tenant_counts.items():
+            cnt[k] = cnt.get(k, 0) + v
+        for k, v in p.tenant_bound.items():
+            bound[k] = bound.get(k, 0) + v
+        for k, v in p.tenant_violations.items():
+            viol[k] = viol.get(k, 0) + v
+    return {
+        k: dict(
+            _lat_summary(lat[k]),
+            arrivals=cnt.get(k, 0),
+            bound=bound.get(k, 0),
+            violations=viol.get(k, 0),
+        )
+        for k in sorted(lat)
     }
 
 
@@ -244,6 +323,11 @@ class _PhaseResult:
     violations: int = 0
     retired: int = 0
     events_applied: dict = field(default_factory=dict)
+    # Per-tenant split (raw tenant id → samples/counts; "-" = untagged).
+    tenant_latencies: dict = field(default_factory=dict)
+    tenant_counts: dict = field(default_factory=dict)
+    tenant_bound: dict = field(default_factory=dict)
+    tenant_violations: dict = field(default_factory=dict)
 
 
 class _Driver:
@@ -254,21 +338,16 @@ class _Driver:
         self.cfg = cfg
         self.registry = MetricsRegistry()
         # The SLO families (README metrics catalog): per-decision serving
-        # latency by phase, violations against the budget, the budget.
-        self._slo_hist = self.registry.histogram(
-            "scheduler_slo_decision_latency_seconds",
-            "Per-decision serving latency of the open-loop soak driver "
-            "(arrival deadline to decision), by phase.",
+        # latency by phase AND tenant, violations against the budget, the
+        # budget gauge.
+        self._slo_hist, self._slo_violations = _slo_families(
+            self.registry, cfg.slo_budget_ms
         )
-        self._slo_violations = self.registry.counter(
-            "scheduler_slo_violations_total",
-            "Soak decisions whose serving latency exceeded the SLO "
-            "budget.",
+        # Driver-side tenant attribution (bounded labeler + admission
+        # counters mirroring the server's); None with observability off.
+        self.tenant_metrics = (
+            TenantMetrics(self.registry) if cfg.observability else None
         )
-        self.registry.gauge(
-            "scheduler_slo_budget_seconds",
-            "Configured SLO latency budget for the soak driver.",
-        ).set(cfg.slo_budget_ms / 1e3)
         self.client = ResyncingClient(
             sock, deadline_s=120.0, seed=cfg.seed, registry=self.registry
         )
@@ -283,7 +362,9 @@ class _Driver:
         self._cap_toggle: dict[int, int] = {}
         self._label_epoch: dict[int, int] = {}
         self._ns_epoch = 0
-        self.mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+        self.mix = WorkloadMix(
+            cfg.mix, seed=cfg.seed * 7919 + 11, tenants=cfg.tenants
+        )
         # Node-death bookkeeping: churn nodes currently silenced, the
         # cumulative scenario-clock offset (Lease stamps must stay
         # monotone across phases), and event counts.
@@ -379,12 +460,19 @@ class _Driver:
         of the measured window, then retire the warm wave so phase 0
         starts from an empty live set (and the deletes are exercised
         before anything is measured)."""
-        warm = [
-            make_pod(f"lgwarm-{i}")
-            .req({"cpu": "50m", "memory": "64Mi"})
-            .obj()
-            for i in range(self.cfg.warm_pods)
-        ]
+        from ..framework.metrics import TENANT_LABEL_KEY
+
+        # Tenant labels grow the pod-label vocab — warm them too, or the
+        # first tagged arrival recompiles inside the measured window.
+        warm_tenants = [name for name, _w in self.cfg.tenants]
+        warm = []
+        for i in range(self.cfg.warm_pods):
+            w = make_pod(f"lgwarm-{i}").req({"cpu": "50m", "memory": "64Mi"})
+            if warm_tenants:
+                w = w.label(
+                    TENANT_LABEL_KEY, warm_tenants[i % len(warm_tenants)]
+                )
+            warm.append(w.obj())
         half = len(warm) // 2
         self.client.add_pending_batch(warm[:half])
         for p in warm[:half]:
@@ -502,13 +590,32 @@ class _Driver:
         base = t_issue if deadline is None else min(deadline, t_issue)
         lat = t_done - base
         res.latencies.append(lat)
-        self._slo_hist.observe(lat, phase=res.name)
+        tenant = pod_tenant(pod)
+        tlabel = (
+            self.tenant_metrics.labeler.label_for(tenant)
+            if self.tenant_metrics is not None
+            else TENANT_FALLBACK
+        )
+        tkey = tenant or "-"
+        res.tenant_latencies.setdefault(tkey, []).append(lat)
+        res.tenant_counts[tkey] = res.tenant_counts.get(tkey, 0) + 1
+        if self.tenant_metrics is not None:
+            # The driver-side mirror of the server's admission counter
+            # (one arrival = one admission in the open-loop stream).
+            self.tenant_metrics.note("admitted", tenant)
+            if node:
+                self.tenant_metrics.note("bound", tenant)
+        self._slo_hist.observe(lat, phase=res.name, tenant=tlabel)
         if lat > self.cfg.slo_budget_ms / 1e3:
             res.violations += 1
-            self._slo_violations.inc(phase=res.name)
+            res.tenant_violations[tkey] = (
+                res.tenant_violations.get(tkey, 0) + 1
+            )
+            self._slo_violations.inc(phase=res.name, tenant=tlabel)
         res.decisions += 1
         if node:
             res.bound += 1
+            res.tenant_bound[tkey] = res.tenant_bound.get(tkey, 0) + 1
             pod._lg_node = node
             self.pods_by_uid[uid] = pod
             self.live.append(uid)
@@ -962,6 +1069,19 @@ def run_soak(cfg: SoakConfig) -> dict:
             for p in phases
         ],
         "workload_mix": dict(driver.mix.counts),
+        "tenants": (
+            dict(
+                per_tenant=_tenant_summary(phases),
+                counters=(
+                    driver.tenant_metrics.snapshot()
+                    if driver.tenant_metrics is not None
+                    else {}
+                ),
+                mix=dict(driver.mix.tenant_counts),
+            )
+            if cfg.tenants
+            else None
+        ),
         "node_loss": node_loss,
         "cold_consumers": driver.cold_consumers,
         "retired_total": driver.retired,
@@ -1016,7 +1136,8 @@ def _spawn_shard_serve(
         "--journal-dir", journal_dir,
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
-    ] + _lifecycle_argv(cfg)
+    ] + ([] if cfg.observability else ["--no-observability"]) \
+      + _lifecycle_argv(cfg)
     return _launch_serve(
         argv, out_dir, sock, f"serve-shard{shard}", deadline_s=300.0
     )
@@ -1104,12 +1225,17 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         if not cfg.two_process:
             return ShardOwner(
                 k,
-                TPUScheduler(batch_size=cfg.batch_size, chunk_size=1),
+                TPUScheduler(
+                    batch_size=cfg.batch_size,
+                    chunk_size=1,
+                    tenant_attribution=cfg.observability,
+                ),
                 smap,
                 state_dir=os.path.join(journal_root, f"shard{k}"),
                 journal_fsync=cfg.journal_fsync == "always",
                 snapshot_every_batches=cfg.snapshot_every,
                 lifecycle=lifecycle,
+                observability=cfg.observability,
             )
         socks[k] = os.path.join(tmp.name, f"shard{k}.sock")
         procs[k] = _spawn_shard_serve(
@@ -1133,7 +1259,15 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     # KeyboardInterrupt) must not leak N serve processes holding
     # journal leases and sockets.
     try:
-        mix = WorkloadMix(cfg.mix, seed=cfg.seed * 7919 + 11)
+        mix = WorkloadMix(
+            cfg.mix, seed=cfg.seed * 7919 + 11, tenants=cfg.tenants
+        )
+        slo_hist, slo_violations = _slo_families(
+            registry, cfg.slo_budget_ms
+        )
+        tenant_metrics = (
+            TenantMetrics(registry) if cfg.observability else None
+        )
         node_objs: dict[str, object] = {}
         feed_order: list[str] = []
         router_restarts = 0
@@ -1141,7 +1275,8 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
 
         def mk_router() -> FleetRouter:
             r = FleetRouter(
-                owners, smap, batch_size=cfg.batch_size, registry=registry
+                owners, smap, batch_size=cfg.batch_size, registry=registry,
+                observability=cfg.observability,
             )
             if cfg.two_process:
                 from ..framework.config import DEFAULT_PROFILE
@@ -1159,6 +1294,9 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             r.add_object("Node", n)
 
         router = mk_router()
+        # Build/warmup flight records sort ahead of the measured window
+        # on the logical axis.
+        router.note_logical_time(-1.0)
         autoscaler = None  # built below, once the sampling dicts exist
         for i in range(cfg.nodes):
             w = (
@@ -1252,7 +1390,36 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             if 0 in hot_serving:
                 w = w.label("loadgen.tpu/hot", "1")
             feed_node(router, w.obj())
-        warm = [warm_mix.pod(10_000_000 + i) for i in range(min(cfg.warm_pods, 48))]
+        # Tenant labels grow the pod-label vocab: the warm wave must
+        # carry every tenant the stream will, or the first tenant-tagged
+        # arrival pays a full XLA recompile inside the measured window
+        # (the same trap the epoch/hot-label pre-seeds close).
+        warm_tenants = [
+            str(ts["name"]) for ts in cfg.tenant_streams
+        ] or [name for name, _w in mix.tenants]
+        n_warm = min(cfg.warm_pods, 48)
+        warm = [
+            warm_mix.pod(
+                10_000_000 + i,
+                # BLOCK-assigned (not cycled): the group vocab interns
+                # label SETS, so every (template-label, tenant) combo
+                # must appear in warmup — a cycled assignment correlates
+                # tenant with the template's i%10 label and covers only
+                # half the combos, leaving a schema growth (and its XLA
+                # recompile) for the first unlucky mid-window arrival.
+                tenant=(
+                    warm_tenants[
+                        min(
+                            (i * len(warm_tenants)) // max(n_warm, 1),
+                            len(warm_tenants) - 1,
+                        )
+                    ]
+                    if warm_tenants
+                    else None
+                ),
+            )
+            for i in range(n_warm)
+        ]
         if hot_serving:
             # Half the warm wave carries the hot selector so the
             # NodeAffinity op and its selector schema compile OUTSIDE
@@ -1291,6 +1458,17 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         if 0 in hot_serving:
             w = w.label("loadgen.tpu/hot", "1")
         feed_node(router, w.obj())
+        # The warm deletions above marked node rows dirty: the NEXT eval
+        # pass pays the dirty-row scatter-flush XLA compile (~0.5s/owner
+        # on this box — the single scheduler's warm_tail covers this,
+        # fleet owners never call it).  One throwaway propose per owner
+        # absorbs it outside the measured window; propose is eval-only.
+        flush_probe = warm_mix.pod(
+            10_900_000,
+            tenant=warm_tenants[0] if warm_tenants else None,
+        )
+        for owner in owners.values():
+            owner.call("propose", {"pod": serialize.to_dict(flush_probe)})
 
         cap_toggle: dict[int, int] = {}
         label_epoch: dict[int, int] = {}
@@ -1422,6 +1600,9 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             still-pending pods re-feed."""
             prior_evicted = dict(router.evicted_pending) if router else {}
             r = mk_router()
+            # The logical clock follows the front door: adoption-time
+            # flight records keep the scenario axis.
+            r.note_logical_time(router.lc() if router else -1.0)
             for name in feed_order:
                 if name in node_objs:
                     r.add_object("Node", node_objs[name])
@@ -1535,6 +1716,19 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             name="fleet-sustained",
             invalidation_rate_per_s=cfg.invalidation_rate_per_s,
         )
+        # The burst window (first bursting tenant stream), for the
+        # in-burst/off-burst per-tenant split: FIFO queueing is shared,
+        # so the honest starvation evidence is WHERE the queueing lands
+        # (the burst window) and WHOSE traffic dominates it.
+        burst_win = next(
+            (
+                (float(ts["burst_start_s"]), float(ts["burst_end_s"]))
+                for ts in cfg.tenant_streams
+                if float(ts.get("burst_factor", 1.0)) != 1.0
+            ),
+            None,
+        )
+        burst_lat: dict[tuple[str, bool], list] = {}
 
         def decide(pod, deadline: float | None, t_ev: float = 0.0) -> None:
             uid = pod.uid
@@ -1555,6 +1749,19 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             base = t_issue if deadline is None else min(deadline, t_issue)
             lat = t_done - base
             res.latencies.append(lat)
+            tenant = pod_tenant(pod)
+            tlabel = (
+                tenant_metrics.labeler.label_for(tenant)
+                if tenant_metrics is not None
+                else TENANT_FALLBACK
+            )
+            tkey = tenant or "-"
+            res.tenant_latencies.setdefault(tkey, []).append(lat)
+            res.tenant_counts[tkey] = res.tenant_counts.get(tkey, 0) + 1
+            if burst_win is not None:
+                in_burst = burst_win[0] <= t_ev < burst_win[1]
+                burst_lat.setdefault((tkey, in_burst), []).append(lat)
+            slo_hist.observe(lat, phase=res.name, tenant=tlabel)
             if shard is not None:
                 per_shard_lat.setdefault(shard, []).append(lat)
                 if autoscaler is not None:
@@ -1562,9 +1769,14 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 lat_trace.append((t_ev, shard, lat))
             if lat > cfg.slo_budget_ms / 1e3:
                 res.violations += 1
+                res.tenant_violations[tkey] = (
+                    res.tenant_violations.get(tkey, 0) + 1
+                )
+                slo_violations.inc(phase=res.name, tenant=tlabel)
             res.decisions += 1
             if node:
                 res.bound += 1
+                res.tenant_bound[tkey] = res.tenant_bound.get(tkey, 0) + 1
                 pod._lg_node = node
                 pods_by_uid[uid] = pod
                 pending.pop(uid, None)
@@ -1580,17 +1792,54 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 pending[uid] = pod
 
         seed = cfg.seed * 1_000_003
-        if cfg.diurnal:
-            offsets = diurnal_offsets(
-                cfg.rate_pods_per_s,
-                cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
-                cfg.diurnal_period_s,
-                cfg.duration_s,
-                seed,
+        tenant_of_arrival: list[str | None] = []
+        if cfg.tenant_streams:
+            # The tenant-starvation shape: each tenant arrives on its
+            # OWN seeded schedule (steady Poisson or a piecewise burst),
+            # merged time-ordered — (t, stream index, intra-stream
+            # index) is a total, seed-stable order.
+            streams: list[tuple[str, list[float]]] = []
+            for j, ts in enumerate(cfg.tenant_streams):
+                rate = float(ts["rate_pods_per_s"])
+                factor = float(ts.get("burst_factor", 1.0))
+                sseed = seed + 8_627 + j * 1_009
+                if factor != 1.0:
+                    offs = burst_offsets(
+                        rate,
+                        rate * factor,
+                        float(ts.get("burst_start_s", 0.0)),
+                        float(ts.get("burst_end_s", 0.0)),
+                        cfg.duration_s,
+                        sseed,
+                    )
+                else:
+                    offs = poisson_offsets(rate, cfg.duration_s, sseed)
+                streams.append((str(ts["name"]), offs))
+            merged_arrivals = sorted(
+                (t_off, j, k)
+                for j, (_name, offs) in enumerate(streams)
+                for k, t_off in enumerate(offs)
             )
+            offsets = [a[0] for a in merged_arrivals]
+            tenant_of_arrival = [streams[a[1]][0] for a in merged_arrivals]
+            pods = [
+                mix.pod(i, tenant=tenant_of_arrival[i])
+                for i in range(len(offsets))
+            ]
         else:
-            offsets = poisson_offsets(cfg.rate_pods_per_s, cfg.duration_s, seed)
-        pods = [mix.pod(i) for i in range(len(offsets))]
+            if cfg.diurnal:
+                offsets = diurnal_offsets(
+                    cfg.rate_pods_per_s,
+                    cfg.rate_pods_per_s * cfg.diurnal_peak_factor,
+                    cfg.diurnal_period_s,
+                    cfg.duration_s,
+                    seed,
+                )
+            else:
+                offsets = poisson_offsets(
+                    cfg.rate_pods_per_s, cfg.duration_s, seed
+                )
+            pods = [mix.pod(i) for i in range(len(offsets))]
         if cfg.hot_fraction > 0:
             # A dedicated seeded stream marks hot arrivals (a pure
             # function of (seed, arrival schedule) — the hot-spot skew
@@ -1640,6 +1889,10 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         t0 = time.perf_counter()
 
         def execute(klass: int, payload, t_ev: float) -> None:
+            # Flight records downstream of this op (router batch, owner
+            # propose/commit, handoff markers) carry the SCENARIO clock —
+            # the logical axis the merged fleet timeline orders on.
+            router.note_logical_time(t_ev)
             if klass == 1:
                 apply_event(payload)
                 res.events_applied[payload.kind] = (
@@ -1767,6 +2020,30 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 "pending_rebinds": lc["pending_rebinds"],
                 "per_shard_lifecycle": lc["per_shard"],
             }
+        fleet_timeline = None
+        merged_sha = None
+        if cfg.observability:
+            # The federated flight merge: every owner's ring (over the
+            # wire for serve children) + the router's, folded into one
+            # fleet timeline on the scenario clock with per-phase
+            # overlap and critical-path attribution.  The deterministic
+            # timeline hash rides the determinism block — two same-seed
+            # runs must merge byte-identically.
+            snaps, names = router.fleet_flight_snapshots()
+            merged = merge_fleet(snaps, names)
+            merged["slow_spans"] = list(router.slow_spans)
+            merged_sha = merged["timeline_sha256"]
+            merged_path = os.path.join(out_dir, "fleet-flight-merged.json")
+            with open(merged_path, "w", encoding="utf-8") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            fleet_timeline = {
+                "file": os.path.basename(merged_path),
+                "timeline_sha256": merged_sha,
+                "events": merged["timeline_events"],
+                "components": merged["components"],
+                "wall": merged["wall"],
+                "critical_path_top": merged["critical_path"][:8],
+            }
         registry_summary = router.registry.summary()
     finally:
         for owner in owners.values():
@@ -1822,10 +2099,68 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         ),
         "autoscale": autoscale,
         "node_loss": node_loss,
+        "tenants": (
+            dict(
+                per_tenant=_tenant_summary([res]),
+                counters=(
+                    tenant_metrics.snapshot()
+                    if tenant_metrics is not None
+                    else {}
+                ),
+                per_shard_commits={
+                    str(k): (stats["shards"][str(k)].get("tenants") or {})
+                    for k in sorted(owners)
+                },
+                burst_split=(
+                    {
+                        "window_s": list(burst_win),
+                        "per_tenant": {
+                            tkey: {
+                                "in_burst": _lat_summary(
+                                    burst_lat.get((tkey, True), [])
+                                ),
+                                "off_burst": _lat_summary(
+                                    burst_lat.get((tkey, False), [])
+                                ),
+                            }
+                            for tkey in sorted(
+                                {k for k, _b in burst_lat}
+                            )
+                        },
+                        # Whose traffic the burst window's queueing
+                        # lands on: each tenant's share of the window's
+                        # decisions.
+                        "in_burst_share": {
+                            tkey: round(
+                                len(burst_lat.get((tkey, True), []))
+                                / max(
+                                    1,
+                                    sum(
+                                        len(v)
+                                        for (_k, b), v in burst_lat.items()
+                                        if b
+                                    ),
+                                ),
+                                4,
+                            )
+                            for tkey in sorted(
+                                {k for k, b in burst_lat if b}
+                            )
+                        },
+                    }
+                    if burst_win is not None
+                    else None
+                ),
+            )
+            if (cfg.tenants or cfg.tenant_streams)
+            else None
+        ),
+        "fleet_timeline": fleet_timeline,
         "fleet_metrics": registry_summary,
         "determinism": {
             "arrival_sha256": _sha([round(o, 9) for o in offsets]),
             "bindings_sha256": _sha(sorted(bindings.items())),
+            "timeline_sha256": merged_sha,
             "arrivals_total": len(offsets),
         },
         "bound_final": len(bindings),

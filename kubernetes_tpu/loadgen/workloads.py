@@ -22,6 +22,7 @@ Two deliberate deltas from the sweep shapes:
 from __future__ import annotations
 
 from ..api import types as t
+from ..framework.metrics import TENANT_LABEL_KEY
 
 # The sweep families this module draws from (benchmarks/harness.py is
 # the single source of the shapes; importing it keeps the soak's pods
@@ -67,9 +68,22 @@ class WorkloadMix:
     """A seeded pod factory over one mix: ``pod(i)`` builds arrival i's
     pod, choosing its template by a seeded draw (a pure function of
     ``(seed, i)`` order — the factory must be called in arrival order,
-    which the driver does by construction)."""
+    which the driver does by construction).
 
-    def __init__(self, mix: str, seed: int, small_requests: bool = True):
+    Tenants (ISSUE 12): ``tenants`` turns the factory into a
+    tenant-tagged stream — each pod carries the canonical
+    ``scheduler.tpu/tenant`` label, drawn from the weighted tenant set
+    by its own seeded stream (so adding tenants never perturbs the
+    template draw sequence), or forced per pod via ``pod(i, tenant=…)``
+    (the starvation scenario's per-tenant arrival streams)."""
+
+    def __init__(
+        self,
+        mix: str,
+        seed: int,
+        small_requests: bool = True,
+        tenants: tuple[tuple[str, float], ...] = (),
+    ):
         if mix not in MIXES:
             raise ValueError(f"unknown mix {mix!r}; have {sorted(MIXES)}")
         self.mix = mix
@@ -80,8 +94,15 @@ class WorkloadMix:
         self._rng = _rng(seed)
         self.small_requests = small_requests
         self.counts: dict[str, int] = {n: 0 for n in self._names}
+        self.tenants = tuple((str(n), float(w)) for n, w in tenants)
+        self._tenant_rng = _rng(seed * 69_061 + 5) if self.tenants else None
+        if self.tenants:
+            tw = sum(w for _n, w in self.tenants)
+            self._tenant_names = [n for n, _w in self.tenants]
+            self._tenant_weights = [w / tw for _n, w in self.tenants]
+        self.tenant_counts: dict[str, int] = {}
 
-    def pod(self, i: int) -> t.Pod:
+    def pod(self, i: int, tenant: str | None = None) -> t.Pod:
         name = (
             self._names[0]
             if len(self._names) == 1
@@ -92,6 +113,24 @@ class WorkloadMix:
         # The generator's own naming space; rename BEFORE any uid access
         # (Pod.uid memoizes on first read).
         pod.metadata.name = f"lg-{i}"
+        if tenant is None and self.tenants:
+            tenant = (
+                self._tenant_names[0]
+                if len(self._tenant_names) == 1
+                else str(
+                    self._tenant_rng.choice(
+                        self._tenant_names, p=self._tenant_weights
+                    )
+                )
+            )
+        if tenant:
+            # Labels may be shared with the template — copy before
+            # tagging so tenants never alias across pods.
+            pod.metadata.labels = dict(pod.metadata.labels or {})
+            pod.metadata.labels[TENANT_LABEL_KEY] = tenant
+            self.tenant_counts[tenant] = (
+                self.tenant_counts.get(tenant, 0) + 1
+            )
         if self.small_requests:
             # A sustained stream must not exhaust the fleet before the
             # retirement churn frees capacity; tiny requests put the

@@ -240,6 +240,13 @@ class SchedulingQueue:
         # decision IT owns — releasing a quarantined pod — before applying
         # it; everything else is journaled at the scheduler's commit sites.
         self.journal = None
+        # Tenant attribution hook (framework/metrics.py TenantMetrics
+        # .note_pod), installed by the scheduler/router when tenant
+        # attribution is armed: called with ("admitted", pod) on a pod's
+        # FIRST queue entry and ("deferred", pod) on every backoff /
+        # unschedulable parking — the queue-admission leg of the
+        # per-tenant fairness counters.  None = attribution off.
+        self.tenant_note = None
 
     def __len__(self) -> int:
         return len(self._in_active)
@@ -377,6 +384,8 @@ class SchedulingQueue:
         if qp is None:
             qp = QueuedPodInfo(pod=pod, timestamp=now, initial_attempt_timestamp=now)
             self._info[pod.uid] = qp
+            if self.tenant_note is not None:
+                self.tenant_note("admitted", pod)
         qp.pod = pod
         # PreEnqueue: SchedulingGates holds gated pods out of every queue
         # (plugins/schedulinggates/scheduling_gates.go).
@@ -503,6 +512,8 @@ class SchedulingQueue:
         failed go to the unschedulable pool keyed by what rejected them.
         Members of a registered gang park in the gang pool instead."""
         qp.unschedulable_plugins = plugins
+        if self.tenant_note is not None:
+            self.tenant_note("deferred", qp.pod)
         g = qp.pod.spec.pod_group
         if g:
             self._track_gang_member(qp)
@@ -512,6 +523,8 @@ class SchedulingQueue:
         self._unsched_insert(qp)
 
     def add_backoff(self, qp: QueuedPodInfo) -> None:
+        if self.tenant_note is not None:
+            self.tenant_note("deferred", qp.pod)
         expiry = self._clock() + self.backoff_duration(qp.attempts)
         heapq.heappush(self._backoff, (expiry, next(self._seq), qp.pod.uid))
 

@@ -25,7 +25,7 @@ from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
 from .framework.events import NORMAL, WARNING, EventBroadcaster
 from .framework.flight import FlightRecorder
-from .framework.metrics import MetricsRegistry
+from .framework.metrics import MetricsRegistry, TenantMetrics, pod_tenant
 from .framework.status import Diagnosis
 from .framework.tracing import Trace
 from .intern import InternTable
@@ -125,6 +125,7 @@ class TPUScheduler:
         feature_gates=None,
         inline_preempt_commit: bool | None = None,
         flight_capacity: int = 4096,
+        tenant_attribution: bool = True,
     ):
         from .framework.features import DEFAULT_GATES
 
@@ -230,6 +231,16 @@ class TPUScheduler:
         self.last_batch_span: Trace | None = None
         self.slow_spans: deque = deque(maxlen=16)
         self._install_metric_collectors()
+        # Per-tenant SLO attribution (ISSUE 12): pods carry a tenant id
+        # (framework/metrics.py TENANT_LABEL_KEY); admission / bind /
+        # preemption / deferral count into the bounded-cardinality
+        # scheduler_tenant_*_total families.  Observational only — a
+        # scheduler with attribution off binds bit-identically.
+        self.tenant_metrics = (
+            TenantMetrics(self.metrics.registry) if tenant_attribution else None
+        )
+        if self.tenant_metrics is not None:
+            self.queue.tenant_note = self.tenant_metrics.note_pod
         self.preemption = PreemptionEvaluator(self) if enable_preemption else None
         # Inline preemptor commit (perf mode): a successful dry-run commits
         # the preemptor immediately instead of nominate + requeue — sound
@@ -685,6 +696,13 @@ class TPUScheduler:
         be joined to its batch's flight record and span tree."""
         tid = self._trace_id()
         return {"trace_id": tid} if tid else {}
+
+    def _note_tenant(self, event: str, pod: t.Pod) -> None:
+        """Count one tenant event (bound/preempted; admission/deferral
+        ride the queue's tenant_note hook) — a no-op with attribution
+        off."""
+        if self.tenant_metrics is not None:
+            self.tenant_metrics.note(event, pod_tenant(pod))
 
     def _flight_add(self, key: str, n) -> None:
         acc = self._flight_acc
@@ -1588,6 +1606,7 @@ class TPUScheduler:
         """Preempted events on the victims (preemption.go:362 emits on
         each victim pod; the reference's reason is "Preempted")."""
         for v in res.victims:
+            self._note_tenant("preempted", v)
             self.recorder.event(
                 v.uid, NORMAL, "Preempted",
                 f"Preempted by {preemptor.uid} on node {res.node_name}",
@@ -1662,6 +1681,7 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
+        self._note_tenant("bound", qp.pod)
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {res.node_name} "
@@ -1774,6 +1794,7 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
+        self._note_tenant("bound", qp.pod)
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {entry['node']} "
@@ -1999,6 +2020,7 @@ class TPUScheduler:
         m.scheduled += 1
         m.last_scheduled_ts = now
         m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
+        self._note_tenant("bound", qp.pod)
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {best}",
@@ -2064,19 +2086,33 @@ class TPUScheduler:
         self._dispatch_counter.inc(kind="eval")
         return batch, deltas, active, inv, feasible, total, t_feat
 
-    def propose_pod(self, pod: t.Pod) -> dict:
+    def propose_pod(self, pod: t.Pod, span: Trace | None = None) -> dict:
         """Eval-only proposal: this shard's per-node verdicts for one pod
         — feasible node names (snapshot row order), their total scores,
         and the pod's resolved nomination when locally feasible.  No
         commit, no queue interaction; the same compiled eval pass the
-        extender path uses (_run_eval_pass)."""
+        extender path uses (_run_eval_pass).  ``span`` (the fleet op
+        span the router's trace context opened) gains Featurize /
+        DevicePass children — the sidecar leg of the joined
+        router→owner→sidecar tree — and the result carries the
+        feat_s/dev_s split for the owner's flight record."""
         if not self.cache.nodes:
             return {"feasible": [], "scores": [], "nominated": None}
         profile = self._profile_for(pod) or self.profile
         nomrow = self._resolve_nomrow(pod)
-        batch, _deltas, _active, _inv, feasible, total, _t = (
+        t0 = time.perf_counter()
+        batch, _deltas, _active, _inv, feasible, total, t_feat = (
             self._run_eval_pass(pod, profile, nomrow)
         )
+        t_end = time.perf_counter()
+        if span is not None:
+            # Post-hoc children over the measured boundaries: the eval
+            # pass ran featurize then the device program; the sub-spans
+            # carry those exact windows.
+            feat = span.nest("Featurize")
+            feat._t0, feat._t_end = t0, t_feat
+            dev = span.nest("DevicePass")
+            dev._t0, dev._t_end = t_feat, t_end
         rows = np.nonzero(feasible)[0]
         names = [self.cache.node_name_at_row(int(r)) for r in rows]
         nn = pod.status.nominated_node_name
@@ -2088,6 +2124,11 @@ class TPUScheduler:
             # needs it for the precise fit-wake hint (queue._fit_hint),
             # which the single scheduler gets from its own deltas.
             "req": [int(x) for x in np.asarray(batch["req"])[0]],
+            # The featurize/device wall split, for the owner's per-op
+            # flight record (phase attribution in the merged fleet
+            # timeline; wall-derived — never hashed).
+            "feat_s": round(t_feat - t0, 6),
+            "dev_s": round(t_end - t_feat, 6),
         }
 
     def reserve_proposed(self, pod: t.Pod, node_name: str, gang: str = "") -> bool:
@@ -2153,6 +2194,7 @@ class TPUScheduler:
             m.first_scheduled_ts = now
         m.scheduled += 1
         m.last_scheduled_ts = now
+        self._note_tenant("bound", pod)
         self.recorder.event(
             pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {pod.uid} to {node_name}",
@@ -2276,6 +2318,7 @@ class TPUScheduler:
         if rec is not None:
             self.queue.on_event(Event.POD_DELETE, self._free_ctx({rec.row}))
         for v in victims:
+            self._note_tenant("preempted", v)
             self.recorder.event(
                 v.uid, NORMAL, "Preempted",
                 f"Preempted by {pod.uid} on node {node_name}",
@@ -2288,6 +2331,10 @@ class TPUScheduler:
             "victim_groups": [
                 v.spec.pod_group for v in victims if v.spec.pod_group
             ],
+            # Raw victim tenant ids — the router feeds them through ITS
+            # bounded labeler into the fleet-aggregated preempted counter
+            # (the victim pods live only on this shard).
+            "victim_tenants": [pod_tenant(v) or "" for v in victims],
             # PDB state is cluster-global but budgets are debited where
             # the victim died — the router broadcasts these to the other
             # shards (apply_pdb_debit) so every owner's pickOneNode
@@ -3325,6 +3372,7 @@ class TPUScheduler:
                     m.first_scheduled_ts = now
                 m.scheduled += 1
                 m.last_scheduled_ts = now
+                self._note_tenant("bound", outcome.pod)
                 self.recorder.event(
                     outcome.pod.uid, NORMAL, "Scheduled",
                     f"Successfully assigned {outcome.pod.uid} to "

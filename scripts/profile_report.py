@@ -15,13 +15,30 @@ miss-rate knee curve, journal growth, and the per-phase serving table.
     python -m kubernetes_tpu flight --socket S | python scripts/profile_report.py -
     python scripts/profile_report.py SOAK_r06.json
 
+Fleet mode (``--fleet``): render ONE merged timeline from a partitioned
+fleet's flight logs — either a pre-merged document (the fleet soak's
+``fleet-flight-merged.json``, or a SOAK artifact carrying a
+``fleet_timeline`` block) or several raw per-owner dumps merged on the
+spot::
+
+    python scripts/profile_report.py --fleet fleet-flight-merged.json
+    python scripts/profile_report.py --fleet owner0.json owner1.json router.json
+
+Output: per-component batch/phase totals, fleet busy-time overlap
+(parallelism), the critical-path attribution (which component+phase
+gated each instant of fleet busy time), the logical-clock timeline
+tail, and any slow-span trees (the joined router→owner→sidecar path).
+
 Stdlib-only on purpose: this must run on the operator's laptop against a
-dump scp'd out of an incident, with no JAX (or repo) install.
+dump scp'd out of an incident, with no JAX (or repo) install — merging
+raw dumps loads ``framework/flight.py`` by file path (it is itself
+stdlib-only), never the JAX-importing package root.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 
@@ -205,6 +222,41 @@ def soak_report(doc: dict) -> str:
                 f"{post.get('p99_ms')}ms "
                 f"(recovered: {rec.get('p99_recovered')})"
             )
+    tn = doc.get("tenants")
+    if tn and tn.get("per_tenant"):
+        out.append("\nper-tenant SLO split:")
+        rows = [
+            (
+                name, t.get("arrivals"), t.get("decisions"),
+                t.get("bound"), f"{t.get('p50_ms')}ms",
+                f"{t.get('p99_ms')}ms", f"{t.get('p999_ms')}ms",
+                t.get("violations"),
+            )
+            for name, t in sorted(tn["per_tenant"].items())
+        ]
+        out.append(
+            _table(
+                rows,
+                ("tenant", "arrivals", "dec", "bound", "p50", "p99",
+                 "p999", "viol"),
+            )
+        )
+        counters = tn.get("counters") or {}
+        if counters:
+            out.append("admission-fairness counters (per tenant):")
+            for name, c in sorted(counters.items()):
+                pairs = " ".join(
+                    f"{k}={int(v)}" for k, v in sorted(c.items())
+                )
+                out.append(f"  {name}: {pairs}")
+    ft = doc.get("fleet_timeline")
+    if ft:
+        out.append(
+            f"\nfleet timeline: {ft.get('events')} events merged "
+            f"(sha {str(ft.get('timeline_sha256', ''))[:12]}…), "
+            f"parallelism {(ft.get('wall') or {}).get('parallelism')}× — "
+            f"render with `profile_report.py --fleet {ft.get('file')}`"
+        )
     nl = doc.get("node_loss")
     if nl:
         lc = nl.get("lifecycle", {})
@@ -257,19 +309,154 @@ def soak_report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def _load_flight_module():
+    """Import ``kubernetes_tpu/framework/flight.py`` by FILE PATH (it is
+    stdlib-only; the package root imports JAX and must stay out)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "kubernetes_tpu", "framework", "flight.py",
+    )
+    spec = importlib.util.spec_from_file_location("_tpu_flight", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def fleet_report(doc: dict, timeline_tail: int = 40) -> str:
+    """Render one merged fleet document (framework/flight.merge_fleet):
+    per-component totals, overlap/parallelism, critical-path
+    attribution, the logical-clock timeline tail, and slow-span trees."""
+    out: list[str] = []
+    comps = doc.get("components", {})
+    # A SOAK artifact's fleet_timeline block stores the count under
+    # "events"; a raw merge document under "timeline_events".
+    n_events = doc.get("timeline_events", doc.get("events"))
+    out.append(
+        f"fleet flight merge: {len(comps)} components, "
+        f"{n_events} timeline events "
+        f"(timeline sha {str(doc.get('timeline_sha256', ''))[:12]}…)"
+    )
+    rows = []
+    for name, c in sorted(comps.items()):
+        phases = ", ".join(
+            f"{k} {_fmt_s(v)}" for k, v in sorted(
+                (c.get("phases") or {}).items(), key=lambda kv: -kv[1]
+            )
+        )
+        rows.append(
+            (name, c.get("batches", 0), c.get("markers", 0),
+             _fmt_s(c.get("busy_s", 0.0)), phases or "-")
+        )
+    out.append(
+        _table(rows, ("component", "batches", "markers", "busy", "phases"))
+    )
+    wall = doc.get("wall", {})
+    out.append(
+        f"\nfleet wall: components busy {_fmt_s(wall.get('busy_s_total', 0))} "
+        f"over {_fmt_s(wall.get('union_busy_s', 0))} union busy time — "
+        f"overlap {_fmt_s(wall.get('overlap_s', 0))}, "
+        f"parallelism {wall.get('parallelism', 0)}×"
+    )
+    crit = doc.get("critical_path") or doc.get("critical_path_top") or []
+    if crit:
+        out.append("\ncritical path (which slice gated fleet progress):")
+        out.append(
+            _table(
+                [
+                    (c["component"], c["phase"], _fmt_s(c["seconds"]),
+                     f"{c['share']:.1%}")
+                    for c in crit
+                ],
+                ("component", "phase", "seconds", "share"),
+            )
+        )
+    timeline = doc.get("timeline") or []
+    if timeline:
+        tail = timeline[-timeline_tail:]
+        out.append(
+            f"\ntimeline (logical clock; last {len(tail)} of "
+            f"{len(timeline)}):"
+        )
+        for e in tail:
+            extra = {
+                k: v
+                for k, v in e.items()
+                if k not in ("component", "seq", "kind", "lc")
+            }
+            tail_s = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+            out.append(
+                f"  lc={e.get('lc', '-')} {e['component']}#{e.get('seq')} "
+                f"{e.get('kind')}" + (f" {tail_s}" if tail_s else "")
+            )
+    for span in doc.get("slow_spans") or []:
+        out.append("\nslow span (joined router→owner→sidecar tree):")
+        parts: list[str] = []
+        _render_span(span, parts, "  ")
+        out.extend(parts)
+    return "\n".join(out)
+
+
+def _render_span(span: dict, parts: list[str], indent: str) -> None:
+    """Serialized span tree renderer (tracing.render_span_dict's shape,
+    re-implemented here so the report stays repo-free)."""
+    ids = f"trace={span.get('trace_id')} span={span.get('span_id')}"
+    if span.get("parent_span_id"):
+        ids += f" parent={span['parent_span_id']}"
+    fields = " ".join(
+        f"{k}={v}" for k, v in (span.get("fields") or {}).items()
+    )
+    parts.append(
+        f'{indent}"{span.get("name")}" '
+        f"total={span.get('duration_ms', 0)}ms {ids}"
+        + (f" {fields}" if fields else "")
+    )
+    for msg, off in span.get("steps") or ():
+        parts.append(f"{indent}  {msg} (@{off}ms)")
+    for child in span.get("children") or ():
+        _render_span(child, parts, indent + "  ")
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    if len(args) != 1:
+    fleet = False
+    if args and args[0] == "--fleet":
+        fleet = True
+        args = args[1:]
+    if not args or (not fleet and len(args) != 1):
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    if args[0] == "-":
-        doc = json.load(sys.stdin)
-    else:
-        with open(args[0], "r", encoding="utf-8") as f:
-            doc = json.load(f)
-    if doc.get("metric") == "soak_slo_knee_journal" or (
-        "knee" in doc and "slo" in doc
-    ):
+
+    def load(arg: str) -> dict:
+        if arg == "-":
+            return json.load(sys.stdin)
+        with open(arg, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    if fleet:
+        if len(args) == 1:
+            doc = load(args[0])
+            if doc.get("metric") == "fleet_flight_merge":
+                print(fleet_report(doc))
+                return 0
+            if doc.get("fleet_timeline"):
+                # A fleet SOAK artifact: render its merged-timeline
+                # block (the full merged document sits next to the
+                # artifact under the file it names).
+                print(fleet_report(doc["fleet_timeline"]))
+                return 0
+            # A single raw dump still merges (degenerate fleet of one).
+            docs = [doc]
+        else:
+            docs = [load(a) for a in args]
+        flight_mod = _load_flight_module()
+        print(fleet_report(flight_mod.merge_fleet(docs)))
+        return 0
+    doc = load(args[0])
+    if str(doc.get("metric", "")).startswith(
+        ("soak_", "fleet_soak_", "tenant_soak")
+    ) or ("knee" in doc and "slo" in doc):
         print(soak_report(doc))
     else:
         print(report(doc))

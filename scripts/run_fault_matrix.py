@@ -1444,12 +1444,15 @@ AUTOSCALE_N_BUCKETS = 16
 def _autoscale_cfg():
     from kubernetes_tpu.fleet import AutoscalerConfig
 
-    # Thresholds tuned so the scenario's 8-hot/2-cold skew (pre-split
-    # ratio 1.6) trips exactly ONE split and the post-split distribution
-    # (max ratio 1.5 — five of the eight hot pods ride the moved nodes)
-    # sits strictly in-band — a takeover's re-decision (window re-primed
-    # from adopted bindings) must converge to the same one-action
-    # history, killed anywhere.
+    # Thresholds tuned so the scenario's 8-hot/2-cold commit skew over a
+    # CAPACITY-SYMMETRIC map (six nodes per shard — the imbalance metric
+    # measures window share against NODE share, so only skew the
+    # capacity does not explain counts) lands shard 0 at ratio
+    # 0.8/0.5 = 1.6 and trips exactly ONE split; the recovery's
+    # re-decision (window re-primed from adopted bindings when the map
+    # is still pre-resize) converges to the same one-action history,
+    # killed anywhere, and a post-resize tick reads a near-empty window
+    # and defers (quiet).
     return AutoscalerConfig(
         split_imbalance_hi=1.55,
         merge_imbalance_lo=0.05,
@@ -1484,13 +1487,16 @@ def _autoscale_sched():
 
 
 def _autoscale_node_names():
-    """Six hot names bucket-owned by shard 0 and two cold ones by shard
+    """Six hot names bucket-owned by shard 0 and six cold ones by shard
     1 under the initial 2-shard map — crc32 is cross-process stable, so
     the skew is a property of the names, not of overrides (pins survive
-    splits by design and would anchor the load).  The hot six straddle
-    the split boundary (three in the bucket half a split keeps, three in
-    the half it moves), so the post-split distribution sits comfortably
-    in-band and the one-split history is stable under re-decision."""
+    splits by design and would anchor the load).  Node counts are EQUAL
+    per shard on purpose: the imbalance metric is capacity-aware
+    (window share vs node share), so the 8/2 commit skew reads as load
+    the capacity does not explain.  The hot six straddle the split
+    boundary (three in the bucket half a split keeps, three in the half
+    it moves), so the moved nodes carry real bindings through the
+    journaled import."""
     from kubernetes_tpu.fleet import ShardMap
     from kubernetes_tpu.fleet.shardmap import stable_shard_hash
 
@@ -1508,7 +1514,7 @@ def _autoscale_node_names():
         if stable_shard_hash(n, AUTOSCALE_N_BUCKETS) in move_half
     ][:3]
     hot = keep + move
-    cold = [n for n in cands if probe.owner_of(n) == 1][:2]
+    cold = [n for n in cands if probe.owner_of(n) == 1][:6]
     return hot, cold
 
 
@@ -1516,8 +1522,11 @@ def autoscale_objects():
     """The skewed-load scenario: hot nodes carry ``hot=1`` and distinct
     capacities (no score ties anywhere in the run — recovery re-burns
     tie-break steps at different batch boundaries), hot pods carry the
-    matching selector, so shard 0 commits 8 of 10 decisions and the
-    imbalance ratio lands at 1.6 — above the 1.5 split threshold."""
+    matching selector and cold pods the ``cold=1`` selector (placement
+    skew is a property of the pod set, not of score accidents), so
+    shard 0 commits 8 of 10 decisions over half the fleet's nodes and
+    the capacity-aware imbalance ratio lands at 0.8/0.5 = 1.6 — above
+    the 1.55 split threshold."""
     from kubernetes_tpu.api.wrappers import make_node, make_pod
 
     hot, cold = _autoscale_node_names()
@@ -1530,6 +1539,7 @@ def autoscale_objects():
     ] + [
         make_node(n)
         .capacity({"cpu": str(4 + i), "memory": "16Gi", "pods": 64})
+        .label("cold", "1")
         .obj()
         for i, n in enumerate(cold)
     ]
@@ -1542,6 +1552,7 @@ def autoscale_objects():
     ] + [
         make_pod(f"f{i}")
         .req({"cpu": f"{300 + i * 10}m", "memory": "128Mi"})
+        .node_selector({"cold": "1"})
         .obj()
         for i in range(2)
     ]

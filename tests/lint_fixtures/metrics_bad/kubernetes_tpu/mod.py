@@ -18,3 +18,14 @@ def install_again(reg):
     # ...and POSITIVE metrics-duplicate again here.
     second = reg.counter("scheduler_dup_total", "Divergent help string.")
     second.inc()
+
+
+def tenant_leak(reg, pod):
+    # POSITIVE metrics-tenant-label: a raw per-pod string reaches the
+    # tenant label (unbounded cardinality) — must route through
+    # TenantLabeler.label_for.
+    c = reg.counter("scheduler_tenant_probe_total", "Tenant probe.")
+    c.inc(tenant=pod.metadata.labels["scheduler.tpu/tenant"])
+    raw = pod.metadata.name
+    # POSITIVE metrics-tenant-label again: a symbol NOT fed by label_for.
+    c.inc(tenant=raw)

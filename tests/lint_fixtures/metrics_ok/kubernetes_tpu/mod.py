@@ -9,3 +9,19 @@ def install(reg):
     g = reg.gauge("sidecar_depth", "Sidecar-prefixed gauge.")
     g.set(3.0, queue="active")
     g.set(0.0, queue="backoff")
+
+
+def tenant_bounded(reg, labeler, pod, TENANT_FALLBACK="-"):
+    """Every accepted tenant-label shape: a direct label_for call, a
+    symbol assigned from one (conditional expressions included), the
+    fallback constant, and string literals."""
+    t = reg.counter("scheduler_tenant_good_total", "Bounded tenants.")
+    t.inc(tenant=labeler.label_for("team-a"))
+    label = (
+        labeler.label_for(pod.metadata.labels.get("x"))
+        if labeler is not None
+        else TENANT_FALLBACK
+    )
+    t.inc(tenant=label)
+    t.inc(tenant=TENANT_FALLBACK)
+    t.inc(tenant="-")

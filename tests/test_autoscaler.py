@@ -106,6 +106,39 @@ def test_choose_action_split_merge_and_band():
     assert act == {"op": "rebalance", "n_shards": 2, "shards": [0, 1]}
 
 
+def test_capacity_aware_imbalance_on_asymmetric_map():
+    """The ROADMAP follow-up (ISSUE 12 satellite): window share is
+    measured against a shard's NODE share, not 1/N — a shard holding
+    3/4 of the fleet's nodes serving 3/4 of the binds is FAIR (ratio
+    1.0), where the capacity-blind metric read it as permanently hot
+    (share × N = 1.5, at the split threshold forever)."""
+    from kubernetes_tpu.fleet import imbalance_ratios
+
+    c = cfg()  # split_hi 1.5
+    window = {0: 75, 1: 25}
+    buckets = {0: 8, 1: 8}
+    nodes = {0: 75, 1: 25}
+    ratios = imbalance_ratios(window, [0, 1], nodes)
+    assert ratios == {0: 1.0, 1: 1.0}
+    act, reason = choose_action(window, buckets, c, nodes_owned=nodes)
+    assert act is None and reason == "in-band"
+    # The capacity-blind baseline (no node counts) still reads it hot —
+    # the exact bias the node-share denominator removes.
+    act_blind, _ = choose_action(window, buckets, c)
+    assert act_blind == {"op": "split", "from": 0, "to": 2}
+    # Load the capacity does NOT explain still trips: the node-poor
+    # shard drawing 3/4 of the binds is genuinely hot (ratio 3.0).
+    hot_window = {0: 25, 1: 75}
+    ratios = imbalance_ratios(hot_window, [0, 1], nodes)
+    assert ratios[1] == 3.0
+    act, _ = choose_action(hot_window, buckets, c, nodes_owned=nodes)
+    assert act == {"op": "split", "from": 1, "to": 2}
+    # A shard with zero nodes falls back to the share × N baseline (no
+    # denominator to judge against).
+    ratios = imbalance_ratios({0: 10, 1: 0}, [0, 1], {0: 10, 1: 0})
+    assert ratios == {0: 1.0, 1: 0.0}
+
+
 def test_choose_action_quiet_and_atomic_guards():
     act, reason = choose_action({0: 2, 1: 0}, {0: 8, 1: 8}, cfg())
     assert act is None and reason == "quiet"
@@ -250,10 +283,13 @@ def test_live_split_moves_load_and_keeps_serving():
     """Skewed real load trips a split; the new owner imports the moved
     nodes WITH their bindings and post-resize pods still schedule."""
     router, owners, smap = build_fleet(2)
+    # Equal node counts per shard: the imbalance metric is
+    # capacity-aware (window share vs NODE share), so only a load skew
+    # the capacity does not explain trips the split.
     names0 = [n for n in (f"an{i}" for i in range(100))
               if smap.owner_of(n) == 0][:6]
     names1 = [n for n in (f"an{i}" for i in range(100))
-              if smap.owner_of(n) == 1][:2]
+              if smap.owner_of(n) == 1][:6]
     for i, n in enumerate(names0):
         router.add_object("Node", hot_node(n, 8 + i))
     for i, n in enumerate(names1):

@@ -155,6 +155,16 @@ def test_det_negative_tree_is_clean():
 # -- rule family: metrics hygiene ------------------------------------------
 
 
+def test_metrics_tenant_label_rule():
+    """metrics-tenant-label: raw strings reaching a tenant= label fire;
+    label_for-fed values, assigned symbols, constants stay clean."""
+    got = [f.rule for f in lint("metrics_bad").findings]
+    assert got.count("metrics-tenant-label") == 2
+    assert not any(
+        f.rule == "metrics-tenant-label" for f in lint("metrics_ok").findings
+    )
+
+
 def test_metrics_rules_fire_on_seeded_violations():
     result = lint("metrics_bad")
     got = rules_of(result)

@@ -1,0 +1,411 @@
+"""Per-tenant SLO attribution + federated fleet observability
+(ISSUE 12): the bounded tenant labeler, tenant counters end-to-end
+(queue admission → bind → preemption/deferral), frame-vs-HTTP agreement
+for the tenant-labeled families, the joined router→owner→sidecar trace
+tree, and the federated flight merge (deterministic timeline,
+overlap/critical-path attribution)."""
+
+import json
+import re
+import tempfile
+import urllib.request
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.flight import FlightRecorder, merge_fleet
+from kubernetes_tpu.framework.metrics import (
+    TENANT_FALLBACK,
+    TENANT_LABEL_KEY,
+    MetricsRegistry,
+    TenantLabeler,
+    TenantMetrics,
+    pod_tenant,
+)
+from kubernetes_tpu.framework.tracing import stitch_spans
+from kubernetes_tpu.framework.config import Profile
+from kubernetes_tpu.loadgen.workloads import WorkloadMix
+from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar import SidecarClient, SidecarServer
+
+
+def tenant_pod(name: str, tenant: str, cpu: str = "1"):
+    return (
+        make_pod(name).req({"cpu": cpu}).label(TENANT_LABEL_KEY, tenant).obj()
+    )
+
+
+# -- the bounded labeler -----------------------------------------------------
+
+
+def test_tenant_labeler_bounds_cardinality():
+    lab = TenantLabeler(limit=2)
+    assert lab.label_for("a") == "a"
+    assert lab.label_for("b") == "b"
+    # Over the cap: collapses into the fallback cell, counted.
+    assert lab.label_for("c") == TENANT_FALLBACK
+    assert lab.label_for(None) == TENANT_FALLBACK
+    assert lab.label_for("") == TENANT_FALLBACK
+    # Known tenants keep answering by name.
+    assert lab.label_for("a") == "a"
+    assert lab.overflowed == 1
+    assert lab.known() == ["a", "b"]
+
+
+def test_tenant_metrics_snapshot_shape():
+    reg = MetricsRegistry()
+    tm = TenantMetrics(reg, limit=4)
+    tm.note("admitted", "team-a")
+    tm.note("admitted", "team-a")
+    tm.note("bound", "team-a")
+    tm.note("deferred", None)
+    snap = tm.snapshot()
+    assert snap["team-a"] == {"admitted": 2.0, "bound": 1.0}
+    assert snap[TENANT_FALLBACK] == {"deferred": 1.0}
+    # The families render under the scheduler_ namespace.
+    text = reg.render_text()
+    assert 'scheduler_tenant_admitted_total{tenant="team-a"} 2' in text
+
+
+# -- the workload generator --------------------------------------------------
+
+
+def test_workload_mix_tenant_draw_is_deterministic():
+    a = WorkloadMix("basic", seed=7, tenants=(("t1", 0.5), ("t2", 0.5)))
+    b = WorkloadMix("basic", seed=7, tenants=(("t1", 0.5), ("t2", 0.5)))
+    ta = [pod_tenant(a.pod(i)) for i in range(40)]
+    tb = [pod_tenant(b.pod(i)) for i in range(40)]
+    assert ta == tb
+    assert set(ta) == {"t1", "t2"}
+    # The explicit override (per-tenant arrival streams) wins.
+    assert pod_tenant(a.pod(100, tenant="forced")) == "forced"
+    # Tenants ride their own seeded stream: the template draw sequence
+    # is identical with tenants off.
+    c = WorkloadMix("mixed", seed=9)
+    d = WorkloadMix("mixed", seed=9, tenants=(("x", 1.0),))
+    for i in range(30):
+        c.pod(i)
+        d.pod(i)
+    assert c.counts == d.counts
+
+
+# -- scheduler-side counters -------------------------------------------------
+
+
+def test_scheduler_tenant_counters_end_to_end():
+    sched = TPUScheduler(batch_size=16)
+    sched.add_node(
+        make_node("n1").capacity(
+            {"cpu": "4", "memory": "16Gi", "pods": 10}
+        ).obj()
+    )
+    sched.add_pod(tenant_pod("p1", "team-a"))
+    sched.add_pod(tenant_pod("p2", "team-b"))
+    # Infeasible: defers to the unschedulable pool.
+    sched.add_pod(tenant_pod("p3", "team-b", cpu="64"))
+    sched.schedule_all_pending()
+    snap = sched.tenant_metrics.snapshot()
+    assert snap["team-a"]["admitted"] == 1
+    assert snap["team-a"]["bound"] == 1
+    assert snap["team-b"]["admitted"] == 2
+    assert snap["team-b"]["bound"] == 1
+    assert snap["team-b"]["deferred"] >= 1
+    # Attribution off: no tenant machinery at all, decisions unchanged.
+    off = TPUScheduler(batch_size=16, tenant_attribution=False)
+    assert off.tenant_metrics is None
+
+
+def test_tenant_families_frame_and_http_agree():
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(
+        path, scheduler=TPUScheduler(batch_size=16), http_port=0
+    )
+    srv.serve_background()
+    try:
+        client = SidecarClient(path)
+        client.add(
+            "Node",
+            make_node("n1")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+            .obj(),
+        )
+        res = client.schedule(
+            [tenant_pod("p1", "team-a"), tenant_pod("p2", "team-b")]
+        )
+        assert all(r.node_name for r in res)
+        pat = re.compile(r"^scheduler_tenant_.*$", re.M)
+        frame_lines = sorted(pat.findall(client.metrics()))
+        http_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http.port}/metrics", timeout=5
+        ).read().decode()
+        http_lines = sorted(pat.findall(http_text))
+        assert frame_lines == http_lines
+        assert (
+            'scheduler_tenant_bound_total{tenant="team-a"} 1' in frame_lines
+        )
+        assert (
+            'scheduler_tenant_admitted_total{tenant="team-b"} 1'
+            in frame_lines
+        )
+        client.close()
+    finally:
+        srv.close()
+
+
+# -- the fleet: aggregation + joined traces ----------------------------------
+
+
+def mk_sched() -> TPUScheduler:
+    return TPUScheduler(
+        profile=Profile(
+            name="tenant-test",
+            filters=(
+                "NodeUnschedulable", "NodeName", "NodeAffinity",
+                "NodeResourcesFit",
+            ),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        batch_size=8,
+        chunk_size=1,
+    )
+
+
+def build_fleet(n_shards: int = 2):
+    smap = ShardMap(n_shards=n_shards, n_buckets=16)
+    owners = {k: ShardOwner(k, mk_sched(), smap) for k in range(n_shards)}
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    for i in range(6):
+        router.add_object(
+            "Node",
+            make_node(f"an{i}")
+            .capacity({"cpu": str(4 + i), "memory": "16Gi", "pods": 64})
+            .obj(),
+        )
+    return router, owners, smap
+
+
+def test_router_aggregates_and_owners_split_tenants():
+    router, owners, _smap = build_fleet(2)
+    for i in range(4):
+        router.add_pod(tenant_pod(f"a{i}", "team-a", cpu="200m"))
+    for i in range(2):
+        router.add_pod(tenant_pod(f"b{i}", "team-b", cpu="200m"))
+    out = router.schedule_all_pending(wait_backoff=True)
+    assert sum(1 for o in out if o.node_name) == 6
+    # Fleet-aggregated at the router.
+    agg = router.tenant_metrics.snapshot()
+    assert agg["team-a"]["admitted"] == 4 and agg["team-a"]["bound"] == 4
+    assert agg["team-b"]["bound"] == 2
+    assert router.stats()["tenants"]["team-a"]["bound"] == 4
+    # Per-shard split on the owners (commit-site counting + the stats
+    # mirror's top-K block).
+    per_shard = {
+        k: dict(o.stats()["tenants"]["top"]) for k, o in owners.items()
+    }
+    assert sum(d.get("team-a", 0) for d in per_shard.values()) == 4
+    assert sum(d.get("team-b", 0) for d in per_shard.values()) == 2
+
+
+def _find(span: dict, name: str) -> dict | None:
+    if span.get("name") == name:
+        return span
+    for child in span.get("children") or ():
+        hit = _find(child, name)
+        if hit is not None:
+            return hit
+    return None
+
+
+def test_fleet_trace_tree_joins_router_owner_sidecar():
+    router, _owners, _smap = build_fleet(2)
+    router.trace_threshold_s = 0.0  # every batch is "slow": dump it
+    router.add_pod(tenant_pod("p1", "team-a", cpu="200m"))
+    out = router.schedule_all_pending(wait_backoff=True)
+    assert any(o.node_name for o in out)
+    assert router.slow_spans
+    root = router.slow_spans[0]
+    assert root["name"] == "FleetScheduleBatch"
+    pod_span = _find(root, "SchedulePod")
+    assert pod_span is not None
+    rpc = _find(pod_span, "ProposeRPC")
+    assert rpc is not None
+    # The owner's op span rode back on the RPC response and joined as a
+    # remote child — same trace id, parented on the RPC span.
+    op = _find(rpc, "FleetOp:propose")
+    assert op is not None
+    assert op["trace_id"] == root["trace_id"]
+    assert op["parent_span_id"] == rpc["span_id"]
+    # ...and carries the sidecar-leg device spans.
+    assert _find(op, "Featurize") is not None
+    assert _find(op, "DevicePass") is not None
+    commit = _find(pod_span, "CommitRPC")
+    assert commit is not None
+    assert _find(commit, "FleetOp:commit") is not None
+
+
+def test_stitch_spans_joins_cross_process_dumps():
+    # Two "processes": a root span dumped by one, a child dumped by the
+    # other, joined post-hoc on (trace_id, parent_span_id).
+    root = {
+        "name": "root", "trace_id": "t1", "span_id": "r",
+        "parent_span_id": None, "children": [],
+    }
+    remote = {
+        "name": "remote-op", "trace_id": "t1", "span_id": "x",
+        "parent_span_id": "r", "children": [],
+    }
+    orphan = {
+        "name": "other", "trace_id": "t2", "span_id": "y",
+        "parent_span_id": "gone", "children": [],
+    }
+    roots = stitch_spans([root, remote, orphan])
+    assert [r["name"] for r in roots] == ["root", "other"]
+    assert roots[0]["children"][0]["name"] == "remote-op"
+    # Inputs are not mutated.
+    assert root["children"] == []
+
+
+# -- the federated flight merge ----------------------------------------------
+
+
+def _snap(component: str, records: list[dict]) -> dict:
+    rec = FlightRecorder(component=component, clock=lambda: 0.0)
+    return {"component": component, "records": records}
+
+
+def test_merge_fleet_timeline_orders_on_logical_clock():
+    a = _snap("owner-0", [
+        {"kind": "batch", "seq": 1, "lc": 2.0, "ts": 10.0, "wall_s": 0.5,
+         "pods": 1, "scheduled": 1, "phases": {"commit": 0.5}},
+        {"kind": "marker", "seq": 2, "lc": 3.0, "event": "handoff_in"},
+    ])
+    b = _snap("router", [
+        {"kind": "batch", "seq": 1, "lc": 1.0, "ts": 10.2, "wall_s": 0.9,
+         "pods": 1, "scheduled": 1, "phases": {"scatter": 0.9}},
+    ])
+    merged = merge_fleet([a, b])
+    kinds = [(e["component"], e.get("lc")) for e in merged["timeline"]]
+    assert kinds == [("router", 1.0), ("owner-0", 2.0), ("owner-0", 3.0)]
+    # Wall-derived fields never reach the hashed timeline.
+    assert all(
+        "ts" not in e and "wall_s" not in e and "phases" not in e
+        for e in merged["timeline"]
+    )
+    # Same snapshots with DIFFERENT wall numbers: identical timeline sha.
+    b2 = _snap("router", [dict(b["records"][0], ts=99.0, wall_s=0.1,
+                               phases={"scatter": 0.1})])
+    merged2 = merge_fleet([a, b2])
+    assert merged2["timeline_sha256"] == merged["timeline_sha256"]
+
+
+def test_merge_fleet_overlap_and_innermost_critical_path():
+    # Router busy [0, 1.0] (scatter), owner busy [0.2, 0.8] (device):
+    # overlap 0.6s; the owner's slice is the INNERMOST active work and
+    # takes the critical path while it runs; the router takes the rest.
+    router = _snap("router", [
+        {"kind": "batch", "seq": 1, "ts": 1.0, "wall_s": 1.0,
+         "pods": 1, "scheduled": 1, "phases": {"scatter": 1.0}},
+    ])
+    owner = _snap("owner-0", [
+        {"kind": "batch", "seq": 1, "ts": 0.8, "wall_s": 0.6,
+         "pods": 1, "scheduled": 0, "phases": {"device": 0.6}},
+    ])
+    merged = merge_fleet([router, owner])
+    wall = merged["wall"]
+    assert abs(wall["busy_s_total"] - 1.6) < 1e-6
+    assert abs(wall["union_busy_s"] - 1.0) < 1e-6
+    assert abs(wall["overlap_s"] - 0.6) < 1e-6
+    crit = {
+        (c["component"], c["phase"]): c["seconds"]
+        for c in merged["critical_path"]
+    }
+    assert abs(crit[("owner-0", "device")] - 0.6) < 1e-6
+    assert abs(crit[("router", "scatter")] - 0.4) < 1e-6
+
+
+def test_merge_fleet_duplicate_names_disambiguate():
+    a = _snap("scheduler", [{"kind": "marker", "seq": 1, "event": "x"}])
+    b = _snap("scheduler", [{"kind": "marker", "seq": 1, "event": "y"}])
+    merged = merge_fleet([a, b])
+    assert sorted(merged["components"]) == ["scheduler", "scheduler#2"]
+    named = merge_fleet([a, b], names=["owner-0", "owner-1"])
+    assert sorted(named["components"]) == ["owner-0", "owner-1"]
+
+
+def test_fleet_soak_merged_timeline_is_deterministic():
+    """2× same-seed in-process fleet soak → byte-identical merged
+    timeline (the federated flight merge is part of the determinism
+    contract), and observability off leaves bindings bit-identical."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import SoakConfig, run_fleet_soak
+
+    cfg = SoakConfig(
+        seed=21, nodes=12, churn_nodes=2, duration_s=1.5,
+        rate_pods_per_s=10.0, live_pod_cap=40, warm_pods=16,
+        batch_size=32, two_process=False, pace="virtual",
+        journal_fsync="never", node_flap_period_s=0.0,
+        cold_consumer_period_s=0.0,
+        tenant_streams=(
+            {"name": "steady", "rate_pods_per_s": 5.0},
+            {"name": "bursty", "rate_pods_per_s": 3.0,
+             "burst_factor": 4.0, "burst_start_s": 0.5,
+             "burst_end_s": 1.0},
+        ),
+    )
+    a = run_fleet_soak(cfg, 2)
+    b = run_fleet_soak(cfg, 2)
+    assert a["determinism"]["bindings_sha256"] == (
+        b["determinism"]["bindings_sha256"]
+    )
+    assert a["determinism"]["timeline_sha256"] is not None
+    assert a["determinism"]["timeline_sha256"] == (
+        b["determinism"]["timeline_sha256"]
+    )
+    # The per-tenant split is present and sums to the decisions.
+    per_tenant = a["tenants"]["per_tenant"]
+    assert set(per_tenant) <= {"steady", "bursty", "-"}
+    assert sum(t["decisions"] for t in per_tenant.values()) == (
+        a["decisions"]
+    )
+    off = run_fleet_soak(
+        dataclasses.replace(cfg, observability=False), 2
+    )
+    assert off["determinism"]["bindings_sha256"] == (
+        a["determinism"]["bindings_sha256"]
+    )
+    assert off["fleet_timeline"] is None
+    assert off["tenants"]["counters"] == {}
+
+
+def test_profile_report_renders_fleet_merge(tmp_path, capsys):
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+    )
+    import profile_report
+
+    router = _snap("router", [
+        {"kind": "batch", "seq": 1, "lc": 1.0, "ts": 1.0, "wall_s": 1.0,
+         "pods": 2, "scheduled": 2, "phases": {"scatter": 1.0}},
+    ])
+    owner = _snap("owner-0", [
+        {"kind": "batch", "seq": 1, "lc": 1.0, "ts": 0.8, "wall_s": 0.6,
+         "pods": 1, "scheduled": 1, "phases": {"device": 0.6}},
+    ])
+    merged = merge_fleet([router, owner])
+    p = tmp_path / "merged.json"
+    p.write_text(json.dumps(merged))
+    assert profile_report.main(["--fleet", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet flight merge" in out
+    assert "critical path" in out
+    assert "owner-0" in out and "router" in out
+    # Raw dumps merge on the spot too (flight.py loaded by file path).
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(router))
+    pb.write_text(json.dumps(owner))
+    assert profile_report.main(["--fleet", str(pa), str(pb)]) == 0
+    assert "parallelism" in capsys.readouterr().out
