@@ -24,7 +24,11 @@ import tempfile
 # time (README measurement discipline), so a hard 5% gate on absolute
 # throughput would flake.  HARD_FLOOR is the beyond-any-weather line
 # that does fail the run — a real durability tax, not tunnel noise.
-GUARD_REFERENCE = os.path.join(os.path.dirname(__file__), "BENCH_r05.json")
+# Reference re-anchored to BENCH_r06 (PR 13): a CPU-box point, like the
+# box these guards run on — the TPU-recorded BENCH_r05 stays committed
+# as the last hardware-bound point (ROADMAP's re-record item) but
+# comparing a CPU run against it only ever measured the hardware.
+GUARD_REFERENCE = os.path.join(os.path.dirname(__file__), "BENCH_r06.json")
 GUARD_TOLERANCE = 0.05
 HARD_FLOOR = 0.70
 
@@ -53,6 +57,57 @@ def _journal_guard(value: float) -> dict | None:
             file=sys.stderr,
         )
     return guard
+
+
+def _flagship_block() -> dict | None:
+    """The explicitly-named worst case (BASELINE config #3,
+    interpodaffinity_1kn_10kpods) rides every headline payload from
+    BENCH_r06 on, with a journal_guard-style guard against the last
+    recorded point — a regression on the flagship row fails loudly
+    instead of hiding until the next full sweep.  None when the row
+    itself could not run (the headline must never die for its sidecar)."""
+    try:
+        from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
+
+        r = run_workload(WORKLOADS["interpodaffinity_1kn_10kpods"])
+    except Exception as exc:
+        print(f"bench: flagship row failed: {exc}", file=sys.stderr)
+        return None
+    block = {
+        "name": r["name"],
+        "value": r["pods_per_sec"],
+        "vs_baseline": r["vs_baseline"],
+        "seconds": r["seconds"],
+        "device_s": r["device_s"],
+        "featurize_s": r["featurize_s"],
+        "batches": r["batches"],
+        "deferred": r["deferred"],
+        "packed_batches": r["packed_batches"],
+        "pack_collisions": r["pack_collisions"],
+        "dom_carry": r["dom_carry"],
+        "phase_attribution": r["phase_attribution"],
+    }
+    try:
+        with open(GUARD_REFERENCE) as f:
+            doc = json.load(f)
+        ref = (doc.get("parsed") or doc)["flagship"]["value"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return block
+    ratio = block["value"] / ref if ref else 0.0
+    block["guard"] = {
+        "reference": ref,
+        "reference_file": os.path.basename(GUARD_REFERENCE),
+        "ratio": round(ratio, 4),
+        "within_5pct": ratio >= 1.0 - GUARD_TOLERANCE,
+    }
+    if not block["guard"]["within_5pct"]:
+        print(
+            f"bench guard: flagship row {block['value']} pods/s is "
+            f"{(1.0 - ratio) * 100:.1f}% below {ref} "
+            f"({block['guard']['reference_file']})",
+            file=sys.stderr,
+        )
+    return block
 
 
 def _lint_clean() -> bool | None:
@@ -157,6 +212,7 @@ def main() -> int:
         )
         jstats = journal.stats()
     guard = _journal_guard(r["pods_per_sec"])
+    flagship = _flagship_block()
     print(
         json.dumps(
             {
@@ -165,6 +221,10 @@ def main() -> int:
                 "unit": "pods/s",
                 "vs_baseline": r["vs_baseline"],
                 "journal_guard": guard,
+                # The flagship worst-case row (BASELINE #3) with its own
+                # 5%-guard against the last recorded point: regressions
+                # on interpodaffinity_1kn_10kpods fail loudly here.
+                "flagship": flagship,
                 "lint_clean": _lint_clean(),
                 # Serving percentiles (loadgen short soak): p50/p99/p999
                 # decision latency + speculation miss rate, with a
@@ -222,6 +282,15 @@ def main() -> int:
             f"bench guard HARD FAIL: ratio {guard['ratio']} below "
             f"{HARD_FLOOR} — beyond tunnel variance, journaling (or a "
             "regression riding with it) is taxing the hot path",
+            file=sys.stderr,
+        )
+        return 1
+    fg = (flagship or {}).get("guard")
+    if fg is not None and fg["ratio"] < HARD_FLOOR:
+        print(
+            f"bench guard HARD FAIL: flagship row ratio {fg['ratio']} "
+            f"below {HARD_FLOOR} — the interpodaffinity worst case "
+            "regressed beyond tunnel variance",
             file=sys.stderr,
         )
         return 1

@@ -75,6 +75,8 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
     m = sched.metrics
     m.batches = m.schedule_attempts = m.scheduled = m.unschedulable = 0
     m.preemptions = m.deferred = 0
+    m.packed_batches = m.pack_collisions = 0
+    m.dom_carry_hits = m.dom_carry_rebuilds = 0
     m.device_time_s = m.featurize_time_s = 0.0
     m.e2e_latency_samples = []
     m.registry.reset()
@@ -184,6 +186,16 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
         "batches": m.batches,
         "preemptions": m.preemptions,
         "deferred": m.deferred,
+        # Conflict-aware packing + carried DomTables (ISSUE 13): how many
+        # measured batches reordered, the residual same-chunk collisions
+        # their plans accepted, and the carry hit/rebuild split — the
+        # sweep-level evidence that deferral cascades stay eliminated.
+        "packed_batches": m.packed_batches,
+        "pack_collisions": m.pack_collisions,
+        "dom_carry": {
+            "hits": m.dom_carry_hits,
+            "rebuilds": m.dom_carry_rebuilds,
+        },
         # Registry summary over the measured window: per-extension-point
         # p50/p99, attempt-duration and SLI histograms (with overflow
         # counts), sampled per-plugin series, and the event counters — the

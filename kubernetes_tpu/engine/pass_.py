@@ -93,20 +93,29 @@ class DomTables(NamedTuple):
     et_host: jax.Array  # (ET,) bool — term t's key is the hostname key
 
 
-def build_dom(state: ClusterState, et_slot: jax.Array, et_host: jax.Array, dv: int) -> DomTables:
-    """Full rebuild of the domain tables from the cluster state — one set of
-    MXU matmuls per device pass (amortized over the whole pod batch)."""
-    onehot = make_topo_onehot(state.topo_vals, dv)  # (N, TK, DV)
+def _dom_aggregates(
+    state: ClusterState, onehot: jax.Array, et_slot: jax.Array, dv: int
+) -> tuple[jax.Array, jax.Array]:
+    """(group_dom, et_dom): the expensive per-domain aggregate matmuls —
+    the piece a carried-over DomTables skips (see build_pass carry_dom)."""
     group_dom = jnp.einsum(
         "gn,nkd->gkd", state.group_counts.astype(jnp.float32), onehot
     )
-    et_vals = jnp.take(state.topo_vals, et_slot, axis=1).T  # (ET, N)
     et_f = state.et_counts.astype(jnp.float32)  # (ET, N)
     tk = state.topo_vals.shape[1]
     et_dom = jnp.zeros((et_f.shape[0], dv), jnp.float32)
     for k in range(tk):  # static TK, unrolled: TK small (ET,N)x(N,DV) matmuls
         sel = jnp.where((et_slot == k)[:, None], et_f, 0.0)
         et_dom = et_dom + sel @ onehot[:, k, :]
+    return group_dom, et_dom
+
+
+def build_dom(state: ClusterState, et_slot: jax.Array, et_host: jax.Array, dv: int) -> DomTables:
+    """Full rebuild of the domain tables from the cluster state — one set of
+    MXU matmuls per device pass (amortized over the whole pod batch)."""
+    onehot = make_topo_onehot(state.topo_vals, dv)  # (N, TK, DV)
+    group_dom, et_dom = _dom_aggregates(state, onehot, et_slot, dv)
+    et_vals = jnp.take(state.topo_vals, et_slot, axis=1).T  # (ET, N)
     return DomTables(onehot, group_dom, et_dom, et_vals, et_slot, et_host)
 
 
@@ -339,6 +348,7 @@ def build_pass(
     builder_res_col: dict[str, int],
     active: frozenset[str] | None = None,
     chunk: int = 1,
+    carry_dom: bool = False,
 ):
     """Compile the batch pass for one (profile, schema, active-op-set, chunk).
 
@@ -349,7 +359,25 @@ def build_pass(
     op set, or the chunk size changes — the analog of building a
     frameworkImpl per profile (profile/profile.go:50) with per-cycle Skip
     sets, plus XLA compilation.  Result picks: node row ≥ 0, -1
-    unschedulable, -2 deferred to a strict pass (see module docstring)."""
+    unschedulable, -2 deferred to a strict pass (see module docstring).
+
+    ``batch["step_offset"]`` (optional, (K,) i32): per-pod tie-break step
+    offsets — the scheduler ships each pod's ORIGINAL dispatch position so
+    the selectHost tie seed rides the pod, not the slot.  A packed
+    (reordered) batch and its strict-tail re-runs then draw the exact seed
+    the chunk_size=1 sequential scan would have drawn, which is what keeps
+    packed bindings bit-identical to the parity oracle.  Absent (direct
+    callers), positions default to arange — the pre-packing behavior.
+
+    ``carry_dom=True`` changes the signature to
+    run(state, batch, inv, seed_base, dom_group, dom_et, dom_valid)
+    → (state, PassResult, (group_dom, et_dom)): when ``dom_valid`` the
+    expensive domain-aggregate rebuild (``_dom_aggregates``) is skipped and
+    the carried tables are used (the scan maintained them incrementally
+    last batch); the final tables ride back so the scheduler can carry
+    them batch to batch, rebuilding only on host-side invalidation (see
+    scheduler._dom_carry_valid).  The carry is derivable state — recovery
+    never persists it."""
     filter_ops = [
         opcommon.get(n)
         for n in profile.filters
@@ -401,13 +429,36 @@ def build_pass(
         num = jnp.maximum(nvalid * percentage // 100, 100)
         return jnp.where(nvalid < 100, nvalid, num)
 
-    @jax.jit
-    def run(state: ClusterState, batch: dict, inv: dict, seed_base: jax.Array):
+    def _run(
+        state: ClusterState,
+        batch: dict,
+        inv: dict,
+        seed_base: jax.Array,
+        dom_group: jax.Array | None = None,
+        dom_et: jax.Array | None = None,
+        dom_valid: jax.Array | None = None,
+    ):
         # Domain tables: rebuilt once per pass, maintained incrementally by
         # the scan's commit.  The one-hot and per-term value gathers are
         # scan-invariant, so the scan body closes over them instead of
-        # recomputing per step (the r1 anti-affinity bottleneck).
-        dom0 = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        # recomputing per step (the r1 anti-affinity bottleneck).  With
+        # carry_dom the aggregate rebuild itself is skipped whenever the
+        # caller carried last batch's tables (dom_valid) — the cond keeps
+        # ONE compiled program either way.
+        if carry_dom:
+            onehot = make_topo_onehot(state.topo_vals, schema.DV)
+            group0, et0 = lax.cond(
+                dom_valid,
+                lambda _: (dom_group, dom_et),
+                lambda _: _dom_aggregates(state, onehot, inv["et_slot"], schema.DV),
+                None,
+            )
+            et_vals = jnp.take(state.topo_vals, inv["et_slot"], axis=1).T
+            dom0 = DomTables(
+                onehot, group0, et0, et_vals, inv["et_slot"], inv["et_host"]
+            )
+        else:
+            dom0 = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
         # Nominated-pod overlay for the fit filter (framework.go:973
         # RunFilterPluginsWithNominatedPods); the scheduler always ships it
         # (zeros when no pods are nominated, so the compiled program is
@@ -426,12 +477,19 @@ def build_pass(
         # Scalar flag (not a per-pod feature): every pod in the batch is
         # featurization-identical.  Popped before the chunk reshape.
         uniform_all = batch.pop("uniform_all", None)
+        # Tie-break step offsets ride the POD (its original dispatch
+        # position), not the slot — a packed batch's seeds match the
+        # sequential scan's.  Popped before the reshape (no op reads it).
+        step_off = batch.pop("step_offset", None)
         cbatch = jax.tree_util.tree_map(
             lambda x: x.reshape((k // c, c) + x.shape[1:]), batch
         )
-        steps = (
-            seed_base.astype(jnp.uint32) + jnp.arange(k, dtype=jnp.uint32)
-        ).reshape(k // c, c)
+        offs = (
+            jnp.arange(k, dtype=jnp.uint32)
+            if step_off is None
+            else step_off.astype(jnp.uint32)
+        )
+        steps = (seed_base.astype(jnp.uint32) + offs).reshape(k // c, c)
 
         def eval_pod(state, dctx, pf, step_idx, start):
             """One reference scheduling cycle's decision (no commit)."""
@@ -630,11 +688,11 @@ def build_pass(
             cbatch2 = jax.tree_util.tree_map(
                 lambda x: x.reshape((k // c, c) + x.shape[1:]), batch2
             )
-            steps2 = (
-                seed_base.astype(jnp.uint32)
-                + jnp.uint32(k)
-                + jnp.arange(k, dtype=jnp.uint32)
-            ).reshape(k // c, c)
+            # Pod-identity seeds: the tail re-evaluation IS the pod's real
+            # decision (the deferred first-round result is discarded), so
+            # it draws the pod's own step seed — exactly the seed the
+            # sequential scan would have used.
+            steps2 = steps
 
             def step_tail(carry2, xs):
                 pf, _si = xs
@@ -673,7 +731,15 @@ def build_pass(
                 processed=out.processed,
             )
         state = carry[0]
-        return state, out
+        return state, out, (carry[1], carry[2])
+
+    if carry_dom:
+        return jax.jit(_run)
+
+    @jax.jit
+    def run(state: ClusterState, batch: dict, inv: dict, seed_base: jax.Array):
+        st, out, _dom = _run(state, batch, inv, seed_base)
+        return st, out
 
     return run
 
@@ -908,11 +974,15 @@ class PassCache:
         res_col: dict[str, int],
         active: frozenset[str] | None = None,
         chunk: int = 1,
+        carry_dom: bool = False,
     ):
-        key = (profile, schema, tuple(sorted(res_col.items())), active, chunk)
+        key = (
+            profile, schema, tuple(sorted(res_col.items())), active, chunk,
+            carry_dom,
+        )
         fn = self._cache.get(key)
         if fn is None:
-            fn = build_pass(profile, schema, res_col, active, chunk)
+            fn = build_pass(profile, schema, res_col, active, chunk, carry_dom)
             self._cache[key] = fn
         return fn
 

@@ -25,7 +25,15 @@ TPU design: existing pods' terms are interned into a term vocabulary; the
 cluster state carries per-(term, node) carrier counts (et_counts), updated by
 the same commit delta that moves resources.  Featurization matches the
 incoming pod against every interned term once (host-side string work), and
-compiles the pod's own terms to group bitmasks.  On device, all domain
+compiles the pod's own terms to group bitmasks.
+
+The HARD-read masks this op emits (``ipa_ra_allmask``/``ipa_rs_groups``
+group reads, ``ipa_et_match ∧ ipa_et_anti`` term reads vs ``ipa_own_terms``
+writes) are load-bearing twice: the chunked pass's conflict deferral
+(engine/pass_.py ``_conflict_pairs``) AND the conflict-aware chunk packer's
+class derivation (engine/packing.py ``conflict_classes``) both consume
+them — renaming a key must update both, or packed batches silently lose
+their sequential-equivalence guarantee.  On device, all domain
 tallies come from the engine's DomTables (engine/pass_.py): ``group_dom``
 (G, TK, DV) and ``et_dom`` (ET, DV) are built once per pass with MXU matmuls
 and updated incrementally as the scan commits pods, so each step only does

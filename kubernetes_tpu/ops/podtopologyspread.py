@@ -27,6 +27,14 @@ topology-value ids; per-domain sums/minima are segment reductions into a
 (DV,)-bucketed table, gathered back per node.  Node-inclusion policies reuse
 the NodeAffinity and TaintToleration ops' device filters on the same pod
 features.
+
+The DoNotSchedule constraint masks (``tps_h_groups`` — the ``tps_h``
+prefix is the HARD subset) are load-bearing twice: the chunked pass's
+conflict deferral (engine/pass_.py ``_conflict_pairs``) AND the
+conflict-aware chunk packer's class derivation (engine/packing.py
+``conflict_classes``) both consume them — renaming the key must update
+both, or packed batches silently lose their sequential-equivalence
+guarantee.
 """
 
 from __future__ import annotations

@@ -517,28 +517,23 @@ def build_preempt_pass(
                 rel_vec, rel_nz_vec,
             )
 
-        # Chunked mode: one PACKED key per node — the five criteria as
-        # saturating bit fields, so ordering by the i64 approximates the
-        # lexicographic order (tie granularity coarsens at the saturation
-        # bounds; a documented chunked-mode divergence).  The step assigns
-        # same-key chunk-mates the 1st, 2nd, … best nodes in one shot —
-        # identical preemptors (the async-preemption shape) otherwise all
-        # converge on one node and serialize.
-        def sat(x, bits):
-            return jnp.clip(x.astype(jnp.int64), 0, (1 << bits) - 1)
-
-        # 7-bit violations field: 127<<55 = 2^62 − 2^55 keeps every packed
-        # key strictly below the infeasible sentinel 2^62 (8 bits saturated
-        # at 255<<55 ≈ 9.2e18 would overflow past it, silently hiding
-        # feasible nodes with ≥128 violations).
-        key = (
-            (sat(violations, 7) << 55)
-            | (sat(max_prio.astype(jnp.int64) + 1, 21) << 34)
-            | (sat(prio_sum >> 6, 14) << 20)
-            | (sat(n_vic, 8) << 12)
-            | sat((start_key + (jnp.int64(1) << 61)) >> 50, 12)
+        # Chunked mode: the five criteria ride out RAW for an exact
+        # lexicographic rank order in the step (jnp.lexsort) — the old
+        # saturating bit-packed i64 quantized sub-granularity differences
+        # away (a start_key gap under 2^50 collapsed, so the rank-0 pick —
+        # the representative's own candidate — could diverge from the
+        # chunk=1 narrowing; ISSUE 13's parity oracle pinned it).  The
+        # step assigns same-signature chunk-mates the 1st, 2nd, … best
+        # nodes in one shot — identical preemptors (the async-preemption
+        # shape) otherwise all converge on one node and serialize.
+        crit = (
+            violations,
+            max_prio.astype(jnp.int64),
+            prio_sum,
+            n_vic.astype(jnp.int64),
+            start_key,
         )
-        return key, possible, vic_mask, n_vic, rel_all, relnz_all
+        return crit, possible, vic_mask, n_vic, rel_all, relnz_all
 
     def step(carry, pf, dctx, vfeat, vic_pdb, pdb_allowed):
         state, vic_prio, vic_req, vic_nonzero, vic_start = carry
@@ -568,7 +563,7 @@ def build_preempt_pass(
             # idx0 == 0 there — behavior unchanged.)
             idx0 = jnp.argmax(pf["valid"])
             pf0 = jax.tree_util.tree_map(lambda x: x[idx0], pf)
-            key, possible, vic_mask_all, n_vic_all, rel_all, relnz_all = eval_one(
+            crit, possible, vic_mask_all, n_vic_all, rel_all, relnz_all = eval_one(
                 state, vic_prio, vic_req, vic_nonzero, vic_start, pf0, dctx,
                 vfeat, vic_pdb, pdb_allowed,
             )
@@ -580,9 +575,14 @@ def build_preempt_pass(
             samesig = pf["sig"] == pf["sig"][idx0]
             eligible = pf["valid"] & samesig
             big = jnp.int64(2**62)
-            masked = jnp.where(possible, key, big)  # (N,)
-            order = jnp.argsort(masked)  # (N,)
-            srt = masked[order]
+            # EXACT lexicographic candidate order (pickOneNode criteria,
+            # most-significant last in the lexsort key list; lexsort is
+            # stable, so full ties keep snapshot row order — exactly the
+            # chunk=1 narrowing's argmax-first tie-break).  Infeasible
+            # nodes sort last via the sentinel on the primary criterion.
+            vio_m = jnp.where(possible, crit[0], big)  # (N,)
+            order = jnp.lexsort((crit[4], crit[3], crit[2], crit[1], vio_m))
+            srt = vio_m[order]
             rank = jnp.cumsum(eligible.astype(jnp.int32)) - 1  # (C,)
             safe_rank = jnp.clip(rank, 0, n - 1)
             row = order[safe_rank]

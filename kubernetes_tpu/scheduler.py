@@ -20,6 +20,7 @@ import numpy as np
 from .api import types as t
 from .cache import Cache
 from .engine.features import build_pod_batch
+from .engine.packing import pack_batch
 from .faults import EngineFault
 from .engine.pass_ import PassCache, filter_op_names
 from .framework.config import DEFAULT_PROFILE, Profile
@@ -83,6 +84,17 @@ class SchedulerMetrics:
     preemptions: int = 0
     deferred: int = 0  # chunk-conflict deferrals resolved by the strict tail
     pinned_batches: int = 0  # batches served by the pinned fast path
+    # Conflict-aware chunk packing (engine/packing.py): batches reordered,
+    # residual same-chunk collisions the plans accepted, and the last
+    # batch's plan shape (width / class count) for the gauges.
+    packed_batches: int = 0
+    pack_collisions: int = 0
+    pack_width: int = 0
+    pack_classes: int = 0
+    # Carried DomTables (ISSUE 13): main-pass dispatches that reused last
+    # batch's domain aggregates vs. ones that rebuilt from cluster state.
+    dom_carry_hits: int = 0
+    dom_carry_rebuilds: int = 0
     batches: int = 0
     device_time_s: float = 0.0
     featurize_time_s: float = 0.0
@@ -200,6 +212,16 @@ class TPUScheduler:
         # plfeature.Features snapshot, plugins/registry.go:49).
         self.builder.feature_gates = self.feature_gates
         self.passes = PassCache()
+        # Carried DomTables (ISSUE 13): the previous main pass's final
+        # (group_dom, et_dom) device arrays plus the (schema,
+        # mutation_epoch) token they are valid under.  Derivable state — a
+        # restart/recovery rebuilds from the journaled store and the carry
+        # starts cold; any host-side mutation (node churn, deletes,
+        # preemption evictions, recovery reconcile) bumps the builder's
+        # mutation_epoch and forces the next pass to rebuild on device.
+        self._dom_carry: tuple | None = None
+        self._dom_token: tuple | None = None
+        self._dom_zeros: dict[tuple, tuple] = {}
         self.metrics = SchedulerMetrics()
         # Event recorder (client-go record.EventBroadcaster analog): the
         # structured Scheduled/FailedScheduling/Preempted/GangWaiting
@@ -483,6 +505,29 @@ class TPUScheduler:
             "scheduler_deferred_pods_total",
             "Pods deferred to the strict tail by chunk conflicts.",
         )
+        # Conflict-aware chunk packing + carried DomTables (ISSUE 13).
+        packed = reg.counter(
+            "scheduler_chunk_packed_batches_total",
+            "Batches reordered by the conflict-aware chunk packer.",
+        )
+        pack_coll = reg.counter(
+            "scheduler_chunk_pack_collisions_total",
+            "Residual same-chunk same-class pods accepted by pack plans "
+            "(each is an expected strict-tail deferral).",
+        )
+        pack_width = reg.gauge(
+            "scheduler_chunk_pack_width",
+            "Chunk width the last pack plan chose.",
+        )
+        pack_classes = reg.gauge(
+            "scheduler_chunk_pack_classes",
+            "Conflict classes in the last packed batch.",
+        )
+        dom_carry = reg.counter(
+            "scheduler_chunk_dom_carry_total",
+            "Main-pass dispatches by domain-table source (carried vs "
+            "rebuilt from cluster state).",
+        )
         # Poison-batch recovery observability: how often the engine raised
         # mid-batch and how many pods ended up isolated.  The quarantine
         # DEPTH rides scheduler_pending_pods{queue="quarantine"} below.
@@ -551,6 +596,12 @@ class TPUScheduler:
             batches.set(max(m.batches - m.pinned_batches, 0), kind="full")
             batches.set(m.pinned_batches, kind="pinned")
             deferred.set(m.deferred)
+            packed.set(m.packed_batches)
+            pack_coll.set(m.pack_collisions)
+            pack_width.set(m.pack_width)
+            pack_classes.set(m.pack_classes)
+            dom_carry.set(m.dom_carry_hits, result="hit")
+            dom_carry.set(m.dom_carry_rebuilds, result="rebuild")
             for q, depth in self.queue.depths().items():
                 pending.set(depth, queue=q)
             for state, count in self.node_lifecycle.stats()["states"].items():
@@ -790,13 +841,16 @@ class TPUScheduler:
             k: np.zeros((ts,) + shape[1:], dtype) for k, (shape, dtype) in shapes.items()
         }
         sub["valid"] = np.zeros(ts, np.bool_)
+        sub.setdefault("step_offset", np.zeros(ts, np.int32))
         inv = self._full_inv()
         state = self.builder.state()
         strict = self.passes.get(
-            self.profile, self.builder.schema, self.builder.res_col, active, 1
+            self.profile, self.builder.schema, self.builder.res_col, active, 1,
+            carry_dom=True,
         )
         # All-invalid batch: commits nothing; discard the (identical) state.
-        strict(state, sub, inv, np.uint32(0))
+        ph = self._dom_placeholder()
+        strict(state, sub, inv, np.uint32(0), ph[0], ph[1], np.bool_(False))
         # Uniform-batch broadcast program (_expand_uniform): template
         # workloads' first uniform batch would otherwise pay this XLA
         # compile mid-window (warmup batches with per-pod labels never
@@ -2385,6 +2439,23 @@ class TPUScheduler:
             "max_slots": int(ctx.max_slots),
         }
 
+    def _dom_placeholder(self) -> tuple:
+        """Schema-shaped zero (group_dom, et_dom) arrays for rebuild-path
+        dispatches — the compiled pass takes the carry operands either way
+        (ONE program; the cond picks rebuild when dom_valid is False)."""
+        s = self.builder.schema
+        key = (s.G, s.TK, s.DV, s.ET)
+        ph = self._dom_zeros.get(key)
+        if ph is None:
+            if len(self._dom_zeros) > 4:
+                self._dom_zeros.clear()
+            ph = (
+                jnp.zeros((s.G, s.TK, s.DV), jnp.float32),
+                jnp.zeros((s.ET, s.DV), jnp.float32),
+            )
+            self._dom_zeros[key] = ph
+        return ph
+
     def _full_inv(self) -> dict:
         """Batch invariants, plus — in truncated (parity) mode only — the
         scan-order inputs (zone-interleaved positions, rotating start); the
@@ -2717,6 +2788,17 @@ class TPUScheduler:
         # Batch invariants (interned term → topo slot) may grow TK/DV: build
         # them after featurization, before the state flush.
         inv = self._full_inv()
+        # Carried-DomTables validity must be judged BEFORE state() clears
+        # the dirty flags: the carry is sound only when nothing host-side
+        # mutated since it was stashed (mutation_epoch) AND no dirty rows
+        # are about to be flushed into the device state under it.
+        dom_ok = (
+            self._dom_carry is not None
+            and self._dom_token
+            == (self.builder.schema, self.builder.mutation_epoch)
+            and not self.builder._dirty_all
+            and not self.builder._dirty_rows
+        )
         state = self.builder.state()
         # Pinned fast path (PreFilterResult node-set reduction): every pod
         # resolved to one candidate row and no active op needs the domain
@@ -2737,6 +2819,9 @@ class TPUScheduler:
                 batch_d, inv_d = jax.device_put((work["batch"], inv))
                 new_state, result = run(state, batch_d, inv_d)
                 self._cycle += len(infos)
+                # The pinned pass commits on device without returning its
+                # domain tables — the carry no longer matches device state.
+                self._dom_carry = None
                 self.metrics.pinned_batches += 1
                 self._dispatch_counter.inc(kind="pinned")
                 return dict(
@@ -2746,49 +2831,55 @@ class TPUScheduler:
                     pinned=True, nom_pinned=nom_pinned,
                 )
         chunk = self.chunk_size
+        cycle0 = self._cycle
+        pack_s = 0.0
         if chunk > 1 and work["active"] & {
             "PodTopologySpread", "InterPodAffinity", "NodePorts"
         }:
-            # Adaptive chunk from the ACTUAL batch composition: a pod defers
-            # when an earlier chunk-mate shares its interaction class (same
-            # label group with hard spread/affinity reads), and heavy
-            # deferral makes the strict tail dominate (e.g. the hard-spread
-            # workload's 10 label groups fill any 64-chunk with conflicts).
-            # Pick the largest chunk whose same-group duplicate count stays
-            # under the threshold — pop order matters (templates cycle), so
-            # count real chunk slices, not an expectation.
-            deltas = work["deltas"]
-            b = work["batch"]
-            npods = len(deltas)
-            # Only pods with HARD group reads defer (soft terms drift).
-            hard = np.zeros(npods, np.bool_)
-            for key2 in ("tps_h_valid", "ipa_ra_allmask", "ipa_rs_valid"):
-                if key2 in b:
-                    hard |= np.asarray(b[key2])[:npods].any(axis=-1)
-            if "ipa_et_match" in b:
-                hard |= (
-                    np.asarray(b["ipa_et_match"])[:npods]
-                    & np.asarray(b["ipa_et_anti"])[:npods]
-                ).any(axis=-1)
-
-            def dup_count(c: int) -> int:
-                est = 0
-                for lo in range(0, npods, c):
-                    seen: set[int] = set()
-                    for j in range(lo, min(lo + c, npods)):
-                        g = deltas[j]["group"]
-                        if g in seen:
-                            if hard[j]:
-                                est += 1
-                        else:
-                            seen.add(g)
-                return est
-
-            while chunk > 1 and dup_count(chunk) > 0.3 * len(infos):
-                chunk //= 2
+            # Conflict-aware chunk packing (engine/packing.py): same-class
+            # pods (the hard write→read signals the device defers on) land
+            # in DIFFERENT chunk slices at the widest collision-free width,
+            # with class-relative order preserved — the scan stays
+            # sequential-equivalent and the deferral cascade never forms.
+            # Replaces the old duplicate-count chunk halving, which shrank
+            # device parallelism exactly when affinity workloads needed it
+            # most (and re-walked every pod per halving iteration on this
+            # hot path).
+            t_pack0 = time.perf_counter()
+            npods = len(infos)
+            plan = pack_batch(work["batch"], npods, chunk)
+            chunk = plan.width
+            if plan.perm is not None:
+                perm = plan.perm
+                infos = [infos[j] for j in perm]
+                work["deltas"] = [work["deltas"][j] for j in perm]
+                full_perm = np.arange(self.batch_size, dtype=np.int64)
+                full_perm[:npods] = perm
+                work["batch"] = {
+                    key2: np.asarray(arr)[full_perm]
+                    for key2, arr in work["batch"].items()
+                }
+                # Tie-break seeds ride the pod: row r re-draws the seed of
+                # its ORIGINAL dispatch position, so the packed scan picks
+                # exactly what the sequential scan would have picked.
+                soff = np.arange(self.batch_size, dtype=np.int32)
+                soff[:npods] = perm
+                work["batch"]["step_offset"] = soff
+                self.metrics.packed_batches += 1
+                self._flight_add("packed", 1)
+            self.metrics.pack_collisions += plan.collisions
+            self.metrics.pack_width = plan.width
+            self.metrics.pack_classes = plan.n_classes
+            pack_s = time.perf_counter() - t_pack0
+        if "step_offset" not in work["batch"]:
+            # Identity offsets: ONE compiled program shape whether or not
+            # this batch was reordered.
+            work["batch"]["step_offset"] = np.arange(
+                self.batch_size, dtype=np.int32
+            )
         run = self.passes.get(
             profile, self.builder.schema, self.builder.res_col, work["active"],
-            chunk,
+            chunk, carry_dom=True,
         )
         uniform = False
         if chunk > 1 and not self._truncated:
@@ -2816,25 +2907,38 @@ class TPUScheduler:
             # post-featurize) genuinely vary per pod and ship in full.
             bkeys = tuple(sorted(
                 kk for kk in batch_np
-                if kk not in ("valid", "nominated_row", "uniform_all")
+                if kk not in (
+                    "valid", "nominated_row", "uniform_all", "step_offset"
+                )
             ))
             small = {kk: np.ascontiguousarray(batch_np[kk][:1]) for kk in bkeys}
-            small_d, valid_d, nom_d, inv_d = jax.device_put(
-                (small, batch_np["valid"], batch_np["nominated_row"], inv)
+            small_d, valid_d, nom_d, soff_d, inv_d = jax.device_put(
+                (small, batch_np["valid"], batch_np["nominated_row"],
+                 batch_np["step_offset"], inv)
             )
             batch_d = _expand_uniform(
                 small_d, valid_d, nom_d, batch_np["valid"].shape[0]
             )
             batch_d["uniform_all"] = batch_np["uniform_all"]
+            batch_d["step_offset"] = soff_d
         else:
             batch_d, inv_d = jax.device_put((batch_np, inv))
-        new_state, result = run(state, batch_d, inv_d, np.uint32(self._cycle))
+        dom_in = self._dom_carry if dom_ok else self._dom_placeholder()
+        new_state, result, dom_out = run(
+            state, batch_d, inv_d, np.uint32(cycle0), dom_in[0], dom_in[1],
+            np.bool_(dom_ok),
+        )
+        if dom_ok:
+            self.metrics.dom_carry_hits += 1
+        else:
+            self.metrics.dom_carry_rebuilds += 1
         self._cycle += len(infos)
         self._dispatch_counter.inc(kind="batch")
         return dict(
             work, infos=infos, profile=profile, inv=inv, inv_d=inv_d,
             batch_d=batch_d, new_state=new_state, result=result, t1=t1,
             t_f0=t_f0, schema=self.builder.schema, chunk=chunk,
+            cycle0=cycle0, pack_s=pack_s, dom_out=dom_out,
         )
 
     def _schedule_infos(
@@ -3037,12 +3141,22 @@ class TPUScheduler:
             def run_tail(idx_list: list[int], chunk_level: int, size: int) -> list[int]:
                 """Re-featurize + re-run the given pods against the committed
                 state; fills the result arrays and returns indices that
-                deferred AGAIN (possible only when chunk_level > 1)."""
+                deferred AGAIN (possible only when chunk_level > 1).
+
+                Seeds: the tail re-run IS each pod's real decision, so it
+                draws the pod's ORIGINAL step seed (batch seed base +
+                per-pod step offset) — tie-breaks agree with the
+                sequential chunk=1 scan, and the tail never advances
+                ``_cycle`` (the next batch's seeds stay aligned with the
+                parity oracle's).  The main pass's domain tables thread
+                through as a valid carry: nothing host-side mutates
+                between the scan and its tail."""
                 nonlocal new_state
                 run2 = self.passes.get(
                     profile, self.builder.schema, self.builder.res_col,
-                    active, chunk_level,
+                    active, chunk_level, carry_dom=True,
                 )
+                soff_batch = np.asarray(batch["step_offset"], np.int32)
                 still: list[int] = []
                 for lo in range(0, len(idx_list), size):
                     idx = idx_list[lo : lo + size]
@@ -3068,14 +3182,17 @@ class TPUScheduler:
                             sub[key2] = np.pad(
                                 arr, padw, constant_values=FEATURE_FILLS.get(key2, 0)
                             )
+                    sub["step_offset"] = np.zeros(size, np.int32)
+                    sub["step_offset"][: len(idx)] = soff_batch[idx]
                     sub_d = jax.device_put(sub)  # one coalesced transfer
-                    new_state, res = run2(
-                        new_state, sub_d, ctx["inv_d"], np.uint32(self._cycle)
+                    dom_cur = ctx["dom_out"]
+                    new_state, res, ctx["dom_out"] = run2(
+                        new_state, sub_d, ctx["inv_d"], np.uint32(ctx["cycle0"]),
+                        dom_cur[0], dom_cur[1], np.bool_(True),
                     )
                     p2, s2, f2, fl2 = device_fetch(
                         (res.picks, res.scores, res.feasible_counts, res.fail_masks)
                     )
-                    self._cycle += len(idx)
                     self._dispatch_counter.inc(kind="tail")
                     picks[idx], scores[idx], feas[idx], fails[idx] = (
                         p2[: len(idx)], s2[: len(idx)], f2[: len(idx)], fl2[: len(idx)],
@@ -3106,6 +3223,20 @@ class TPUScheduler:
             active,
         )
         self.builder.absorb_device_state(new_state)
+        # Carry the scan-maintained domain tables into the next batch —
+        # valid only under the exact (schema, mutation_epoch) they were
+        # stashed at; any host mutation in between forces a device-side
+        # rebuild.  A batch whose prefetch grew the schema mid-flight
+        # drops the carry (its arrays are shaped for the old buckets).
+        if ctx.get("pinned"):
+            pass  # carry already dropped at dispatch
+        elif ctx["schema"] == self.builder.schema and "dom_out" in ctx:
+            self._dom_carry = ctx["dom_out"]
+            self._dom_token = (
+                self.builder.schema, self.builder.mutation_epoch
+            )
+        else:
+            self._dom_carry = None
 
         outcomes: list[ScheduleOutcome] = []
         now = time.monotonic()
@@ -3543,8 +3674,13 @@ class TPUScheduler:
             # gaps between units).
             t_flight_end = time.perf_counter()
             ph = acc["phases"]
+            pack_s = ctx.get("pack_s", 0.0)
             ph["featurize"] = ph.get("featurize", 0.0) + (t1 - ctx["t_f0"])
-            ph["device"] = ph.get("device", 0.0) + (t2 - t1)
+            # The packer runs between t1 and dispatch: carve its slice out
+            # of the device segment so the tiling still sums to wall time.
+            if pack_s > 0.0:
+                ph["packing"] = ph.get("packing", 0.0) + pack_s
+            ph["device"] = ph.get("device", 0.0) + (t2 - t1 - pack_s)
             ph["commit"] = ph.get("commit", 0.0) + (t_flight_end - t2)
             acc["pods"] += len(infos)
             acc["scheduled"] += sum(1 for o in outcomes if o.node_name)

@@ -211,6 +211,19 @@ def parse_label_int(v: str) -> int:
         return int(INT_SENTINEL)
 
 
+class _DirtyRows(set):
+    """Dirty-row set that bumps the builder's mutation epoch on add — the
+    one funnel every host-side row mutation already goes through."""
+
+    def __init__(self, builder: "SnapshotBuilder"):
+        super().__init__()
+        self._builder = builder
+
+    def add(self, row: int) -> None:
+        self._builder.mutation_epoch += 1
+        super().add(row)
+
+
 class SnapshotBuilder:
     """Owns the host staging arrays, the intern table, and the device mirror.
 
@@ -257,13 +270,34 @@ class SnapshotBuilder:
         self.dra = ClaimCatalog()
         self.host = _host_arrays(self.schema)
         self._device: ClusterState | None = None
-        self._dirty_rows: set[int] = set()
+        # Monotonic host-mutation counter: bumps on EVERY dirtying host
+        # write (row dirtied or full-rebuild flagged) — the validity token
+        # for derived device-side caches (the scheduler's carried DomTables
+        # key on (schema, mutation_epoch): any host mutation since the
+        # carry was stashed forces a rebuild).  Bumped centrally by the
+        # _DirtyRows set and the _dirty_all property so a future mutation
+        # site cannot forget it.
+        self.mutation_epoch = 0
+        self._dirty_rows: _DirtyRows = _DirtyRows(self)
         self._dirty_all = True  # device needs a full (re)build
         # Resource-name → column index (fixed columns pre-assigned).
         self.res_col: dict[str, int] = {r: i for i, r in enumerate(FIXED_RESOURCES)}
         # Featurization cache (engine/features.py): version token → per-pod
         # feature/delta entries valid only while no vocabulary/schema grows.
         self.feat_cache: tuple[tuple, dict, list] | None = None
+
+    @property
+    def _dirty_all(self) -> bool:
+        return self._dirty_all_flag
+
+    @_dirty_all.setter
+    def _dirty_all(self, value: bool) -> None:
+        # Setting (not clearing) the full-rebuild flag is a host mutation:
+        # bump the epoch so derived device caches (carried DomTables)
+        # invalidate.  Clearing happens only in state() after the flush.
+        if value:
+            self.mutation_epoch += 1
+        self._dirty_all_flag = value
 
     # -- capacity management -------------------------------------------------
 

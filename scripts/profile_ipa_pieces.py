@@ -1,6 +1,17 @@
 """Microbench each IPA device piece inside a 2048-step scan to find the
-per-step bottleneck on real TPU. Ad-hoc, not part of the suite."""
+per-step bottleneck on real TPU. Ad-hoc, not part of the suite.
 
+``--pack [workload …]`` instead reports PACK QUALITY for real benchmark
+workloads (default: the flagship interpodaffinity row + its pod_affinity
+sibling): the first measured batch's conflict-class histogram, the
+residual strict-tail deferrals the packer would accept at each chunk
+width, and the width the plan chooses — the before/after attribution
+evidence ISSUE 13's acceptance asks for.
+
+    JAX_PLATFORMS=cpu python scripts/profile_ipa_pieces.py --pack
+"""
+
+import sys
 import time
 
 import jax
@@ -9,6 +20,72 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+
+def pack_report(names: list[str]) -> None:
+    """Per-workload pack-quality table over the first measured batch."""
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from kubernetes_tpu.benchmarks.harness import WORKLOADS
+    from kubernetes_tpu.engine.features import build_pod_batch
+    from kubernetes_tpu.engine.packing import (
+        conflict_classes,
+        pack_batch,
+        residual_collisions,
+    )
+
+    for name in names:
+        w = WORKLOADS[name]
+        sched = w.build()
+        w.nodes(sched)
+        w.measured(sched)  # enqueue the measured pods
+        infos = sched.queue.pop_batch(sched.batch_size)
+        t0 = time.perf_counter()
+        batch, _deltas, active = build_pod_batch(
+            [qp.pod for qp in infos], sched.builder, sched.profile,
+            sched.batch_size,
+        )
+        feat_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        cls = conflict_classes(batch, len(infos))
+        plan = pack_batch(batch, len(infos), sched.chunk_size)
+        pack_ms = (time.perf_counter() - t0) * 1e3
+        sizes = np.bincount(cls)
+        hist = np.bincount(sizes[sizes > 0])
+        print(
+            f"== {name}: batch {len(infos)} @ chunk {sched.chunk_size} "
+            f"(featurize {feat_ms:.0f}ms, pack {pack_ms:.0f}ms)"
+        )
+        print(
+            f"   classes {sizes.size}  max {int(sizes.max(initial=0))}  "
+            f"plan: width {plan.width}  reorder "
+            f"{'yes' if plan.perm is not None else 'no'}  "
+            f"residual collisions {plan.collisions}"
+        )
+        print("   class-size histogram (size: classes):", end=" ")
+        print(
+            ", ".join(
+                f"{s}:{int(c)}" for s, c in enumerate(hist) if s > 0 and c > 0
+            )
+        )
+        print("   residual deferrals per chunk width:")
+        width = sched.chunk_size
+        while width >= 1:
+            print(
+                f"      width {width:4d}: "
+                f"{residual_collisions(cls, len(infos), width)}"
+            )
+            width //= 2
+
+
+if "--pack" in sys.argv:
+    names = [a for a in sys.argv[sys.argv.index("--pack") + 1 :]
+             if not a.startswith("-")]
+    pack_report(
+        names or ["interpodaffinity_1kn_10kpods", "pod_affinity_5kn_5kpods"]
+    )
+    sys.exit(0)
 
 N, TK, DV, G, ET, K, T = 5120, 4, 128, 128, 128, 2048, 2
 

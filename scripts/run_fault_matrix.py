@@ -503,6 +503,214 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the PACK crash subset (ISSUE 13: packed chunks + carried DomTables) ----
+
+# The conflict-aware packer's crash claim: the packed batch order and the
+# carried DomTables are DERIVABLE state — a SIGKILL mid-batch (between a
+# packed batch's journaled binds, with the carry warm) recovers from the
+# journaled store alone, rebuilds the tables on device, and completes with
+# bindings bit-identical to an uninterrupted packed run — which itself
+# binds bit-identical to the chunk_size=1 sequential configuration on the
+# same scenario (asserted once per sweep, ahead of the cells).
+PACK_KILL_CASES = (
+    ("post-append", 2),   # mid-batch: part of the batch's binds durable
+    ("torn-append", 3),   # a bind record torn mid-write inside the batch
+    ("mid-snapshot", 1),  # checkpoint torn while the carry is warm
+    ("mid-truncate", 1),  # log truncation interrupted after a snapshot
+)
+
+
+def pack_scenario_objects():
+    """Conflict-heavy scenario whose every score is UNIQUE and
+    commit-invariant: the only scorer is NodeAffinity over per-pod
+    rotated preferred-tier weights (state-independent, so the chunked
+    mode's documented chunk-start resource-score drift cannot fire, and
+    distinct weights leave no tie for the recovery child's resumed
+    tie-break counter to flip), while the CLUSTERED anti-affinity colors
+    make the packer actually reorder (the old duplicate-count halving
+    would have collapsed the chunk)."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+    nodes = [
+        make_node(f"pk{i}")
+        .capacity({"cpu": "16", "memory": "16Gi", "pods": 32})
+        .zone(f"z{i % 4}")
+        .label("tier", f"t{i}")
+        .obj()
+        for i in range(12)
+    ]
+    pods = []
+    for i in range(24):
+        color = i // 4  # clustered: 6 colors × 4 pods (= zones: all bind)
+        w = make_pod(f"pp{i:02d}").req({"cpu": "100m"}).label(
+            "color", f"c{color}"
+        ).pod_anti_affinity_in(
+            "color", [f"c{color}"], "topology.kubernetes.io/zone"
+        )
+        for j in range(12):
+            w = w.preferred_node_affinity_in(
+                "tier", [f"t{j}"], weight=((j + 5 * i) % 12) + 1
+            )
+        pods.append(w.obj())
+    return nodes, pods
+
+
+def _pack_scheduler(state_dir: str, chunk: int):
+    from kubernetes_tpu.framework.config import Profile
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+    from kubernetes_tpu.ops.common import registered_subset
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    sched = TPUScheduler(
+        profile=registered_subset(
+            Profile(
+                name="pack-kill",
+                filters=("NodeResourcesFit", "NodeAffinity", "InterPodAffinity"),
+                scorers=(("NodeAffinity", 2),),
+            )
+        ),
+        batch_size=8,
+        chunk_size=chunk,
+        enable_preemption=False,
+    )
+    lease_path = os.path.join(state_dir, "lease")
+    lease = FileLease(lease_path, identity=f"packkill-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        state_dir, epoch=lease.epoch, fence=lambda: read_epoch(lease_path)
+    )
+    return sched, journal
+
+
+def _pack_child(state_dir: str, chunk: int) -> None:
+    from kubernetes_tpu.faults import KillSwitch
+
+    sched, journal = _pack_scheduler(state_dir, chunk)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, pods = pack_scenario_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in pods:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    bindings = {
+        uid: pr.node_name for uid, pr in sched.cache.pods.items() if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def pack_kill_child(state_dir: str) -> None:
+    _pack_child(state_dir, chunk=4)
+
+
+def pack_seq_child(state_dir: str) -> None:
+    """The chunk_size=1 parity configuration on the SAME scenario — the
+    packed baseline must reproduce its bindings byte for byte."""
+    _pack_child(state_dir, chunk=1)
+
+
+def pack_recover_child(state_dir: str) -> None:
+    import copy
+
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+    from kubernetes_tpu.journal import recover
+
+    sched, journal = _pack_scheduler(state_dir, chunk=4)
+    recover(sched, journal)
+    # The carried DomTables are process state: recovery must start cold
+    # and rebuild from the journaled store on the next dispatch.
+    assert sched._dom_carry is None, "dom carry survived recovery"
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    nodes, pods = pack_scenario_objects()
+    src_n, src_p = FakeSource(), FakeSource()
+    for n in nodes:
+        src_n.add(n.name, copy.deepcopy(n))
+    for p in pods:
+        src_p.add(p.uid, copy.deepcopy(p))
+    reconcile_after_recovery(
+        sched,
+        Reflector(sched, "Node", src_n.lister, src_n.watcher),
+        Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+    )
+    sched.schedule_all_pending(wait_backoff=True)
+    bindings = {
+        uid: pr.node_name for uid, pr in sched.cache.pods.items() if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def run_pack_kill_matrix(cases=PACK_KILL_CASES, verbose=True) -> list[str]:
+    """SIGKILL the packed scenario at journal points mid-batch, recover,
+    and compare final bindings to an uninterrupted packed run (itself
+    asserted identical to the chunk=1 run).  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "pack-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--pack-kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "pack baseline run failed"
+        seq_dir = os.path.join(td, "pack-seq")
+        os.makedirs(seq_dir)
+        rc = _spawn("--pack-seq-child", seq_dir)
+        seq = _read_bindings(seq_dir)
+        assert rc == 0 and seq == baseline, (
+            "packed run diverged from the chunk=1 parity configuration: "
+            f"{ {k: (baseline.get(k), (seq or {}).get(k)) for k in set(baseline) | set(seq or {}) if baseline.get(k) != (seq or {}).get(k)} }"
+        )
+        if verbose:
+            print("ok   packkill:baseline == chunk1 parity configuration")
+        failures = []
+        for point, nth in cases:
+            label = f"packkill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
+            state_dir = os.path.join(td, f"pack-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn("--pack-kill-child", state_dir, kill=f"{point}:{nth}")
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}{_cell_dt(t0)}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--pack-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+            elif verbose:
+                print(
+                    f"ok   {label}: recovery rebuilt DomTables, bindings "
+                    f"bit-identical{_cell_dt(t0)}"
+                )
+        return failures
+
+
 # -- the FLEET crash matrix (shard failover via takeover) ------------------
 
 
@@ -2166,6 +2374,33 @@ def main() -> int:
     if "--recover-child" in sys.argv:
         recover_child(sys.argv[sys.argv.index("--recover-child") + 1])
         return 0
+    if "--pack-kill-child" in sys.argv:
+        pack_kill_child(sys.argv[sys.argv.index("--pack-kill-child") + 1])
+        return 0
+    if "--pack-seq-child" in sys.argv:
+        pack_seq_child(sys.argv[sys.argv.index("--pack-seq-child") + 1])
+        return 0
+    if "--pack-recover-child" in sys.argv:
+        pack_recover_child(
+            sys.argv[sys.argv.index("--pack-recover-child") + 1]
+        )
+        return 0
+    if "--pack-kill" in sys.argv:
+        # The packed-chunk/DomTables-carry subset alone (rides --kill).
+        failures = run_pack_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(PACK_KILL_CASES)} pack kill "
+                f"cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(PACK_KILL_CASES)} pack kill cases: mid-batch "
+            "SIGKILL under the conflict-aware packer recovered with "
+            "DomTables rebuilt from the journaled store, bindings "
+            "bit-identical (packed baseline == chunk1 parity)"
+        )
+        return 0
     if "--node-loss-child" in sys.argv:
         node_loss_child(sys.argv[sys.argv.index("--node-loss-child") + 1])
         return 0
@@ -2290,10 +2525,12 @@ def main() -> int:
         # And the elastic-resize subset (SIGKILL inside an autoscaler-
         # initiated split).
         failures += run_autoscale_kill_matrix()
+        # And the packed-chunk/DomTables-carry subset (ISSUE 13).
+        failures += run_pack_kill_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
             + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
-            + len(AUTOSCALE_KILL_CASES)
+            + len(AUTOSCALE_KILL_CASES) + len(PACK_KILL_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -1,0 +1,21 @@
+"""Seeded chunk-packer determinism violations: a packer that iterates a
+bare set or buckets by builtin hash() assigns pods to DIFFERENT chunk
+slices in different processes — the packed scan's bindings could never
+stay bit-identical to the chunk=1 parity oracle."""
+
+
+def deal_classes(class_of):
+    # POSITIVE det-set-iteration: bare-set iteration order is
+    # hash-randomized — the chunk each class lands in would vary run to
+    # run; sorted(...) over the ids is the idiom.
+    order = []
+    for cls in {c for c in class_of}:
+        order.append(cls)
+    return order
+
+
+def slice_for(pod_uid, width):
+    # POSITIVE det-builtin-hash: builtin hash() is PYTHONHASHSEED-salted;
+    # chunk-slice assignment must key on stable ids (zlib.crc32 or the
+    # pod's original batch position), never on salted string hashes.
+    return hash(pod_uid) % width

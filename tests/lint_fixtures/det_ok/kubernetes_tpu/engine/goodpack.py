@@ -1,0 +1,12 @@
+"""Healthy chunk-packer idioms: deterministic class order (sorted ids,
+ties on first appearance) and slice assignment from stable positions."""
+
+
+def deal_classes(class_of):
+    # NEGATIVE: sorted iteration — class order is a pure function of ids.
+    return sorted({c for c in class_of})
+
+
+def slice_for(position, width):
+    # NEGATIVE: the pod's original batch position is a stable identity.
+    return position % width

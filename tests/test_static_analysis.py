@@ -127,13 +127,16 @@ def test_det_rules_fire_on_seeded_violations():
     # the selectHost mirror are part of the oracle story).
     # badscaler.py (ISSUE 11) seeds a wallclock cooldown + a bare-set
     # hottest-shard pick on top of the prior families' counts.
+    # engine/badpack.py (ISSUE 13) seeds a bare-set chunk deal + a
+    # hash()-bucketed slice assignment on top of the prior families'.
     assert got.count("det-wallclock") == 4
     assert got.count("det-random") == 4  # random.random/randrange + os.urandom + expovariate
-    assert got.count("det-set-iteration") == 3  # for-loops + list(set(...))
+    assert got.count("det-set-iteration") == 4  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
-    # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10): builtin
-    # hash() over a node name assigns different owners per process.
-    assert got.count("det-builtin-hash") == 1
+    # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
+    # bucketing (ISSUE 13): builtin hash() assigns different owners /
+    # slices per process.
+    assert got.count("det-builtin-hash") == 2
 
 
 def test_det_rules_cover_loadgen():
@@ -144,6 +147,13 @@ def test_det_rules_cover_loadgen():
 def test_det_rules_cover_fleet():
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/fleet/badrouter.py" in paths
+
+
+def test_det_rules_cover_engine_packing():
+    # The chunk packer (engine/packing.py) decides batch ORDER — squarely
+    # inside the determinism contract; the engine/ walk must cover it.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/engine/badpack.py" in paths
 
 
 def test_det_negative_tree_is_clean():
