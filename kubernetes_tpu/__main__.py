@@ -160,10 +160,16 @@ def _build_scheduler(args):
             queue=queue,
         )
     else:
+        from .framework.config import named_extra_profiles
+
         sched = TPUScheduler(
             batch_size=args.batch_size,
             chunk_size=args.chunk_size,
             tenant_attribution=not getattr(args, "no_observability", False),
+            # Named extra profiles (ISSUE 14: throughput-aware /
+            # learned-scorer) registered beside the default; pods select
+            # by schedulerName.  Full profile control stays with --config.
+            profiles=named_extra_profiles(getattr(args, "profile", "")),
         )
     return sched
 
@@ -397,6 +403,34 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _parse_hetero_pools(spec: str) -> tuple:
+    """--hetero-pools 'tpu-v4=5,tpu-v5e=3' → ((class, weight), ...).
+    Malformed entries are CLI usage errors, not tracebacks."""
+    pools = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        cls, sep, w = entry.partition("=")
+        if not sep or not cls.strip():
+            raise SystemExit(
+                f"--hetero-pools: entry {entry!r} must be CLASS=WEIGHT"
+            )
+        try:
+            weight = int(w)
+        except ValueError:
+            raise SystemExit(
+                f"--hetero-pools: weight {w!r} for {cls.strip()!r} must "
+                "be an integer"
+            )
+        if weight < 1:
+            raise SystemExit(
+                f"--hetero-pools: weight for {cls.strip()!r} must be >= 1"
+            )
+        pools.append((cls.strip(), weight))
+    return tuple(pools)
+
+
 def cmd_soak(args) -> int:
     """Open-loop soak (loadgen/): drive the deployment for --seconds at
     --rate pods/s, then sweep the speculation miss-rate knee over
@@ -415,6 +449,8 @@ def cmd_soak(args) -> int:
         rate_pods_per_s=args.rate,
         diurnal=args.diurnal,
         mix=args.mix,
+        hetero_pools=_parse_hetero_pools(args.hetero_pools),
+        profile=args.profile,
         duration_s=args.seconds,
         knee_points=knee,
         knee_phase_s=args.knee_phase,
@@ -759,6 +795,13 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--batch-size", type=int, default=256)
     s.add_argument("--chunk-size", type=int, default=1)
     s.add_argument(
+        "--profile", default="",
+        choices=("", "default", "throughput-aware", "learned-scorer"),
+        help="register a named extra profile beside the default (ISSUE "
+        "14 heterogeneity scorers); pods select it by schedulerName — "
+        "full profile control (matrices, weights files) via --config",
+    )
+    s.add_argument(
         "--speculate", action="store_true",
         help="enable the speculative frontend + decision push stream",
     )
@@ -938,6 +981,14 @@ def main(argv: list[str] | None = None) -> int:
     sk.add_argument("--churn-nodes", type=int, default=8)
     sk.add_argument("--mix", default="basic",
                     help="workload mix (loadgen.workloads.MIXES)")
+    sk.add_argument("--hetero-pools", default="", metavar="CLASS=W,...",
+                    help="accelerator-class node pools, e.g. "
+                    "'tpu-v4=5,tpu-v5e=3,gpu-a100=2' (ISSUE 14; empty = "
+                    "homogeneous)")
+    sk.add_argument("--profile", default="",
+                    choices=("", "default", "throughput-aware", "learned-scorer"),
+                    help="extra registered profile the stream selects by "
+                    "schedulerName (pair with --mix hetero)")
     sk.add_argument("--diurnal", action="store_true",
                     help="diurnally-modulated arrivals instead of flat Poisson")
     sk.add_argument("--knee-points", default="0.5,2,8,32,128", metavar="R,R,...",

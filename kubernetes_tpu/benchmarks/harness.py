@@ -942,6 +942,133 @@ _register(
 )
 
 
+# ---------------------------------------------------------------------------
+# Heterogeneous clusters (ISSUE 14): mixed accelerator-class node pools +
+# the ThroughputAware / LearnedScorer profiles, selected by schedulerName
+# through the multi-profile map (its own compiled XLA program family).
+# ---------------------------------------------------------------------------
+
+# Pool deal for the mixed fleets: 50% tpu-v4, 30% tpu-v5e, 20% gpu-a100
+# (deterministic by node index — the same fleet every run).
+HETERO_POOLS: tuple[tuple[str, int], ...] = (
+    ("tpu-v4", 5), ("tpu-v5e", 3), ("gpu-a100", 2),
+)
+
+
+def hetero_accel_for(i: int, pools: tuple[tuple[str, int], ...] = HETERO_POOLS) -> str:
+    """Accelerator class of node ``i`` under the weighted pool deal."""
+    total = max(sum(w for _a, w in pools), 1)
+    r = i % total
+    for accel, w in pools:
+        if r < w:
+            return accel
+        r -= w
+    return pools[-1][0]
+
+
+def _hetero_nodes(n: int, zones: int = 10):
+    from ..ops.throughput import ACCEL_LABEL_KEY
+
+    def add(s: TPUScheduler):
+        for i in range(n):
+            s.add_node(
+                make_node(f"node-{i}")
+                .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+                .zone(f"zone-{i % zones}")
+                .region("region-1")
+                .label(ACCEL_LABEL_KEY, hetero_accel_for(i))
+                .obj()
+            )
+
+    return add
+
+
+def _pod_hetero(i: int, scheduler_name: str = "throughput-aware-scheduler") -> t.Pod:
+    from ..ops.throughput import (
+        DEFAULT_THROUGHPUT_MATRIX,
+        WORKLOAD_CLASS_LABEL_KEY,
+    )
+
+    classes = [w for w, _row in DEFAULT_THROUGHPUT_MATRIX]
+    return (
+        make_pod(f"pod-{i}")
+        .req({"cpu": "100m", "memory": "256Mi"})
+        .label("app", f"app-{i % 10}")
+        .label(WORKLOAD_CLASS_LABEL_KEY, classes[i % len(classes)])
+        .scheduler(scheduler_name)
+        .obj()
+    )
+
+
+def _pod_hetero_learned(i: int) -> t.Pod:
+    return _pod_hetero(i, scheduler_name="learned-scorer-scheduler")
+
+
+def _hetero_build(batch: int = 4096, chunk: int = 64):
+    def build() -> TPUScheduler:
+        from ..ops.learned import learned_scorer_profile
+        from ..ops.throughput import throughput_aware_profile
+
+        return TPUScheduler(
+            profile=registered_subset(DEFAULT_PROFILE),
+            profiles=[throughput_aware_profile(), learned_scorer_profile()],
+            batch_size=batch,
+            chunk_size=chunk,
+        )
+
+    return build
+
+
+def _hetero_warm(template: Callable[[int], t.Pod], count: int = 1024):
+    def warm(s: TPUScheduler) -> None:
+        from ..ops.throughput import preseed_hetero_vocab
+
+        # Pre-seed the accelerator-class + workload-class vocabularies
+        # (and the throughput-matrix row keys) BEFORE the warm wave
+        # compiles the device programs — without it the first mid-window
+        # heterogeneous pod grows the topo/label vocab and pays the XLA
+        # recompile inside the measured window (the PR 9/PR 10
+        # taint-vocab trap, heterogeneity edition).
+        preseed_hetero_vocab(s.builder)
+        _warm(template, count)(s)
+
+    return warm
+
+
+_register(
+    Workload(
+        name="hetero_1kn_5kpods",
+        baseline_pods_per_sec=270.0,
+        build=_hetero_build(),
+        nodes=_hetero_nodes(1000),
+        warmup=_hetero_warm(_pod_hetero),
+        measured=_measured(_pod_hetero, 5000),
+    )
+)
+
+_register(
+    Workload(
+        name="hetero_5kn_10kpods",
+        baseline_pods_per_sec=270.0,
+        build=_hetero_build(),
+        nodes=_hetero_nodes(5000),
+        warmup=_hetero_warm(_pod_hetero, 2048),
+        measured=_measured(_pod_hetero, 10000),
+    )
+)
+
+_register(
+    Workload(
+        name="hetero_learned_1kn_5kpods",
+        baseline_pods_per_sec=270.0,
+        build=_hetero_build(),
+        nodes=_hetero_nodes(1000),
+        warmup=_hetero_warm(_pod_hetero_learned),
+        measured=_measured(_pod_hetero_learned, 5000),
+    )
+)
+
+
 # SchedulingWithMixedChurn: node churn interleaved with measured batches
 # (the churn op, scheduler_perf.go:89).
 def _node_churn(s: TPUScheduler, i: int) -> None:

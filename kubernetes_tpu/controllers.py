@@ -400,6 +400,21 @@ class NodeLifecycleController:
         else:
             self.states[node.name] = state
         self.sched.pod_gc.note_state(node.name, state, self._hw)
+        # Journal-recovered transition stamps (journal.recover): adoption
+        # happens at the RE-FEED's clock, but the GC horizon's zero point
+        # is the recorded transition clock — a takeover restoring
+        # heartbeats by Lease relist (not schedule re-derivation) must
+        # not age a dead node from the feed time and sweep late.
+        stamps = getattr(self.sched, "_recovered_taint_stamps", None)
+        if stamps:
+            rec = stamps.get(node.name)
+            if rec is not None and rec[1] == state:
+                stamps.pop(node.name, None)
+                if state == NODE_UNREACHABLE:
+                    since = self.sched.pod_gc._unreachable_since
+                    cur = since.get(node.name)
+                    if cur is None or rec[2] < cur:
+                        since[node.name] = rec[2]
 
     def forget_node(self, name: str) -> None:
         self.heartbeats.pop(name, None)

@@ -801,6 +801,10 @@ def build_eval_pass(
 PINNED_SAFE_OPS = frozenset({
     "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
     "NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
+    # Heterogeneity scorers (ISSUE 14): per-node gathers of topo_vals /
+    # alloc / num_pods — node-axis state only, no domain tables, no
+    # feasible-set normalization.
+    "ThroughputAware", "LearnedScorer",
 })
 
 

@@ -160,6 +160,18 @@ class ShardOwner:
             )
             if recovered:
                 self._recovered_taints[name] = recovered
+        # A SNAPSHOTLESS replay holds taint records for nodes no store
+        # entry carries (the WAL-only takeover) — their journaled taints
+        # must survive the re-feed too, with observe_node's adoption
+        # correcting the GC stamp to the recorded transition clock.
+        for name, rec in getattr(
+            self.sched, "_recovered_taint_stamps", {}
+        ).items():
+            taints = tuple(
+                taint for taint in rec[0] if taint.key in LIFECYCLE_TAINT_KEYS
+            )
+            if taints and name not in self._recovered_taints:
+                self._recovered_taints[name] = taints
 
     # -- the failure-response loop (per-owner lifecycle) -------------------
 

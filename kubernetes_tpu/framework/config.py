@@ -76,6 +76,12 @@ PLUGIN_POINTS: dict[str, frozenset] = {
     # a PermitPlugin (framework/coscheduling.py) — enabled by default as a
     # documented extension beyond the upstream default set.
     "Coscheduling": frozenset({"permit"}),
+    # Heterogeneity subsystem (ISSUE 14): genuinely non-upstream score
+    # ops hosted by the same profile machinery — the Gavel-style
+    # throughput-matrix objective (ops/throughput.py) and the committed
+    # fixed-weight MLP (ops/learned.py).
+    "ThroughputAware": frozenset({"score"}),
+    "LearnedScorer": frozenset({"score"}),
 }
 
 # Known out-of-tree plugins: names the config parser accepts with opaque
@@ -230,6 +236,16 @@ class Profile:
     # through a foreign plugin set (the Go-side TPUBatchScore) is valid
     # config but is served by the sidecar, not the in-process engine.
     foreign: tuple[tuple[str, str], ...] = ()  # (name, json-encoded args)
+    # Heterogeneity-aware scoring (ISSUE 14, ops/throughput.py): the
+    # per-(workload-class, accelerator-class) throughput matrix —
+    # ((workload_class, ((accel_class, milli_throughput), ...)), ...) —
+    # deterministic profile config the ThroughputAware op bakes into its
+    # featurized score tables.  Empty ⇒ the op is inactive.
+    throughput_matrix: tuple[tuple[str, tuple[tuple[str, int], ...]], ...] = ()
+    # LearnedScorer MLP weights (ops/learned.py load_weights output:
+    # ((w1 rows...), (b1...), (w2...), b2)) — the committed inference
+    # artifact, static under jit.  Empty ⇒ the op is inactive.
+    learned_weights: tuple = ()
 
 
 DEFAULT_PLUGIN_WEIGHTS = {name: w for name, w in Profile().scorers}
@@ -366,6 +382,52 @@ def validate_profile(profile: Profile) -> list[str]:
     for name, args_json in profile.foreign:
         if name not in FOREIGN_PLUGIN_POINTS:
             errs.append(f"foreign[{name!r}]: unknown out-of-tree plugin")
+    # Heterogeneity config (ISSUE 14): an enabled op without its config
+    # artifact would silently score a constant — a config error, caught
+    # here like every other args-shape violation.
+    scorer_names = {s for s, _w in profile.scorers}
+    seen_classes: set[str] = set()
+    for wclass, row in profile.throughput_matrix:
+        if wclass in seen_classes:
+            errs.append(f"throughput_matrix[{wclass!r}]: duplicate workload class")
+        seen_classes.add(wclass)
+        if not row:
+            errs.append(f"throughput_matrix[{wclass!r}]: empty accelerator row")
+        elif not any(
+            isinstance(tput, int) and tput > 0 for _a, tput in row
+        ):
+            # An all-zero row has no best-case normalizer — the op's
+            # featurizer divides by the row max, so this is a config
+            # error, not a schedule-time surprise.
+            errs.append(
+                f"throughput_matrix[{wclass!r}]: row needs at least one "
+                "positive throughput"
+            )
+        seen_accels: set[str] = set()
+        for accel, tput in row:
+            if accel in seen_accels:
+                errs.append(
+                    f"throughput_matrix[{wclass!r}][{accel!r}]: duplicate accelerator"
+                )
+            seen_accels.add(accel)
+            if not isinstance(tput, int) or tput < 0:
+                errs.append(
+                    f"throughput_matrix[{wclass!r}][{accel!r}]: throughput "
+                    f"{tput!r} must be a non-negative int"
+                )
+    if "ThroughputAware" in scorer_names and not profile.throughput_matrix:
+        errs.append("scorers[ThroughputAware]: profile.throughput_matrix is empty")
+    if "LearnedScorer" in scorer_names and not profile.learned_weights:
+        errs.append("scorers[LearnedScorer]: profile.learned_weights is empty")
+    if profile.learned_weights:
+        lw = profile.learned_weights
+        if len(lw) != 4 or not (lw[0] and lw[1] and lw[2] is not None):
+            errs.append("learned_weights: want ((w1...), (b1...), (w2...), b2)")
+        else:
+            w1, b1, w2, _b2 = lw
+            hidden = len(b1)
+            if any(len(r) != hidden for r in w1) or len(w2) != hidden:
+                errs.append("learned_weights: inconsistent hidden width")
     return errs
 
 
@@ -376,3 +438,41 @@ def fit_only_profile() -> Profile:
         filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit"),
         scorers=(("NodeResourcesFit", 1),),
     )
+
+
+# serve --profile short names → the profile's schedulerName (ISSUE 14).
+NAMED_PROFILE_SCHEDULERS = {
+    "": "",
+    "default": "",
+    "throughput-aware": "throughput-aware-scheduler",
+    "learned-scorer": "learned-scorer-scheduler",
+}
+
+
+def named_extra_profiles(name: str) -> list[Profile]:
+    """Extra profiles registered beside the default for a ``--profile``
+    short name (serve CLI / soak config).  Lazy op imports: ops.common
+    imports this module at package init."""
+    if name in ("", "default"):
+        return []
+    if name == "throughput-aware":
+        from ..ops.throughput import throughput_aware_profile
+
+        return [throughput_aware_profile()]
+    if name == "learned-scorer":
+        from ..ops.learned import learned_scorer_profile
+
+        return [learned_scorer_profile()]
+    raise ValueError(
+        f"unknown profile {name!r}; have {sorted(NAMED_PROFILE_SCHEDULERS)}"
+    )
+
+
+def profile_scheduler_name(name: str) -> str:
+    """The schedulerName a stream stamps to select a named profile."""
+    try:
+        return NAMED_PROFILE_SCHEDULERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown profile {name!r}; have {sorted(NAMED_PROFILE_SCHEDULERS)}"
+        ) from None

@@ -78,6 +78,9 @@ _PLUGIN_SET_KEYS = {"multiPoint", *EXTENSION_POINTS}
 _PLUGIN_LIST_KEYS = {"enabled", "disabled"}
 _ARG_PLUGINS = {
     "NodeResourcesFit", "InterPodAffinity", "NodeAffinity", "PodTopologySpread",
+    # Heterogeneity scorers (ISSUE 14): the throughput matrix and the
+    # learned-weights artifact ship as pluginConfig args.
+    "ThroughputAware", "LearnedScorer",
 }
 _EXTENDER_KEYS = {
     "urlPrefix", "filterVerb", "preemptVerb", "prioritizeVerb", "weight",
@@ -399,6 +402,48 @@ def _apply_plugin_config(
                     _spread_constraint(c, f"{p}.defaultConstraints[{j}]")
                     for j, c in enumerate(args.get("defaultConstraints", []))
                 )
+        elif name == "ThroughputAware":
+            # {"matrix": {workloadClass: {accelClass: milliThroughput}}}
+            # — the Gavel matrix as profile config (ops/throughput.py).
+            bad = set(args) - {"matrix"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            matrix = args.get("matrix", {})
+            if not isinstance(matrix, dict):
+                raise _err(p, "matrix must be an object")
+            rows = []
+            for wclass, row in matrix.items():
+                if not isinstance(row, dict) or not row:
+                    raise _err(p, f"matrix[{wclass!r}] must be a non-empty object")
+                try:
+                    entries = tuple((str(a), int(tp)) for a, tp in row.items())
+                except (TypeError, ValueError):
+                    raise _err(p, f"matrix[{wclass!r}]: throughputs must be ints")
+                if not any(tp > 0 for _a, tp in entries):
+                    # The op normalizes by the row max; an all-zero row
+                    # is a config error, not a schedule-time divide.
+                    raise _err(
+                        p,
+                        f"matrix[{wclass!r}]: row needs at least one "
+                        "positive throughput",
+                    )
+                rows.append((str(wclass), entries))
+            kwargs["throughput_matrix"] = tuple(rows)
+        elif name == "LearnedScorer":
+            # {"weightsFile": path} — the committed MLP artifact, loaded
+            # and shape-validated at CONFIG time (ops/learned.py): a bad
+            # weights file is a config error, caught before serving.
+            bad = set(args) - {"weightsFile"}
+            if bad:
+                raise _err(p, f"unknown args {sorted(bad)}")
+            from ..ops.learned import DEFAULT_WEIGHTS_PATH, load_weights
+
+            wpath = args.get("weightsFile", DEFAULT_WEIGHTS_PATH)
+            try:
+                kwargs["learned_weights"] = load_weights(wpath)
+            except (OSError, ValueError, KeyError) as e:
+                raise _err(p, f"weightsFile {wpath!r}: {e}")
+
     if foreign_args:
         kwargs["foreign"] = tuple(sorted(foreign_args.items()))
 

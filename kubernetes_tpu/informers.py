@@ -59,6 +59,13 @@ KIND_HANDLERS: dict[str, tuple[Callable[[object], str], str, str]] = {
         "add_resource_slice",
         "remove_resource_slice",
     ),
+    # coordination.k8s.io Lease (node heartbeats): ADD/MODIFY is a renewal
+    # (monotone — a stale replayed stamp cannot rewind the clock), DELETE/
+    # absence-from-relist drops the node from heartbeat tracking.  This is
+    # the relist surface ROADMAP's takeover rung names: a recovering
+    # owner LISTS Leases to restore pre-crash heartbeat state instead of
+    # re-deriving it from a re-fed renewal schedule.
+    "Lease": (lambda o: o.node_name, "renew_node_lease", "remove_node_lease"),
 }
 
 REFLECTED_KINDS = ("Node", "Pod") + tuple(KIND_HANDLERS)
@@ -232,6 +239,8 @@ class Reflector:
             return {
                 f"{n}/{c}" for (n, c) in s.builder.dra.slices
             }
+        if self.kind == "Lease":
+            return set(s.node_lifecycle.heartbeats)
         raise AssertionError(self.kind)
 
     def run_once(self) -> int:
@@ -297,7 +306,11 @@ class Reflector:
 
 
 def reconcile_after_recovery(
-    scheduler, node_reflector, pod_reflector, object_reflectors=()
+    scheduler,
+    node_reflector,
+    pod_reflector,
+    object_reflectors=(),
+    lease_reflector=None,
 ) -> dict:
     """Cold-start recovery ordering (journal.py docstring step 3): after
     journal.recover() rebuilt the scheduler from snapshot + fenced
@@ -320,6 +333,11 @@ def reconcile_after_recovery(
        journal's binding (re-applied), a listed pod bound elsewhere wins
        as host truth (update_pod relocates), and pods absent from the
        relist are deleted (DeltaFIFO Replace).
+    5. ``lease_reflector`` (when given) relists Lease objects LAST — the
+       takeover rung ROADMAP names: heartbeat state restores from host
+       truth's CURRENT renewals instead of re-deriving from a re-fed
+       schedule.  Last because an armed controller's relist-driven tick
+       may taint/evict, which must judge the fully reconciled pod set.
     """
     from .controllers import LIFECYCLE_TAINT_KEYS
 
@@ -375,6 +393,8 @@ def reconcile_after_recovery(
     finally:
         pod_reflector.recovered_bindings = {}
         pod_reflector.recovered_nominations = {}
+    if lease_reflector is not None:
+        stats["leases"] = lease_reflector.run_once()
     return stats
 
 

@@ -562,6 +562,10 @@ def recover(sched, journal: Journal) -> dict:
         # record whose version exceeds the on-disk map's means the
         # rewrite was lost — takeover redoes it idempotently.
         handoffs: list[dict] = []
+        # node → (taints, state, ts) of its LAST replayed taint record
+        # (records replay in order, so the latest wins) — the overlay +
+        # GC-stamp source for nodes the host-truth re-feed re-delivers.
+        taint_stamps: dict[str, tuple] = {}
         for rec in records:
             rtype, d = rec["t"], rec["d"]
             if rtype == "bind":
@@ -607,6 +611,19 @@ def recover(sched, journal: Journal) -> dict:
 
                 sched.node_lifecycle.transitions += 1
                 sched._note_lifecycle_transition(state_from_taints(taints))
+                # Remember the record's (taints, state, clock) whether or
+                # not the node is resident: a host-truth re-feed (the
+                # takeover drivers) re-delivers the node, the overlay
+                # re-applies these taints, and observe_node's adoption
+                # corrects the GC horizon's zero point to the RECORDED
+                # transition clock — without it a snapshotless recovery
+                # that restores heartbeats by Lease RELIST (instead of
+                # re-deriving the incident from a re-fed schedule) would
+                # stamp unreachable_since at the feed clock and sweep
+                # later than the uninterrupted run.
+                taint_stamps[d["node"]] = (
+                    taints, state_from_taints(taints), d.get("ts", 0.0)
+                )
                 if d["node"] in sched.cache.nodes:
                     sched._apply_node_taints(d["node"], taints)
             elif rtype == "evict":
@@ -680,6 +697,7 @@ def recover(sched, journal: Journal) -> dict:
         sched._recovered_bindings = pending
         sched._recovered_gang_intents = in_doubt
         sched._recovered_handoffs = handoffs
+        sched._recovered_taint_stamps = taint_stamps
         stats["pending_bindings"] = len(pending)
         stats["in_doubt_reservations"] = len(in_doubt)
         stats["handoffs"] = len(handoffs)

@@ -54,6 +54,7 @@ from dataclasses import asdict, dataclass, field
 import numpy as np
 
 from ..api.wrappers import make_node, make_pod
+from ..framework.config import named_extra_profiles, profile_scheduler_name
 from ..framework.flight import merge_fleet
 from ..framework.metrics import (
     TENANT_FALLBACK,
@@ -177,6 +178,33 @@ class SoakConfig:
     # it on or off — the tenant artifact's obs-off leg asserts exactly
     # that (observability must observe, never steer).
     observability: bool = True
+    # -- heterogeneous clusters (ISSUE 14) ------------------------------
+    # Accelerator-class pools for the serving/churn fleet:
+    # ((accel_class, int_weight), ...) — nodes deal their
+    # ``scheduler.tpu/accel`` label deterministically by index.  Empty ⇒
+    # homogeneous (the pre-ISSUE-14 fleet).
+    hetero_pools: tuple = ()
+    # Extra registered profile served beside the default ("" |
+    # "throughput-aware" | "learned-scorer"); the stream selects it by
+    # schedulerName (WorkloadMix.scheduler_name).  Pair with
+    # mix="hetero" + hetero_pools for the heterogeneous soak.
+    profile: str = ""
+
+
+def _accel_label(cfg: SoakConfig, w, i: int):
+    """Deal the accelerator-class label over the configured pools
+    (ISSUE 14) — the SAME weighted deal the bench fleets use
+    (benchmarks.harness.hetero_accel_for), so soak and sweep node
+    distributions can never drift apart.  Deterministic by node index:
+    a re-add mid-soak (capacity toggle, epoch label, fleet re-feed)
+    reproduces the node's class.  No-op without hetero_pools."""
+    pools = tuple((a, int(wt)) for a, wt in cfg.hetero_pools)
+    if not pools:
+        return w
+    from ..benchmarks.harness import hetero_accel_for
+    from ..ops.throughput import ACCEL_LABEL_KEY
+
+    return w.label(ACCEL_LABEL_KEY, hetero_accel_for(i, pools))
 
 
 def _sha(obj) -> str:
@@ -363,7 +391,10 @@ class _Driver:
         self._label_epoch: dict[int, int] = {}
         self._ns_epoch = 0
         self.mix = WorkloadMix(
-            cfg.mix, seed=cfg.seed * 7919 + 11, tenants=cfg.tenants
+            cfg.mix,
+            seed=cfg.seed * 7919 + 11,
+            tenants=cfg.tenants,
+            scheduler_name=profile_scheduler_name(cfg.profile),
         )
         # Node-death bookkeeping: churn nodes currently silenced, the
         # cumulative scenario-clock offset (Lease stamps must stay
@@ -383,6 +414,9 @@ class _Driver:
 
     # -- fleet -------------------------------------------------------------
 
+    def _accel_label(self, w, i: int):
+        return _accel_label(self.cfg, w, i)
+
     def _serving_node(self, i: int, cpu: str = "16", label_epoch: int = 0):
         w = (
             make_node(f"lgn-{i}")
@@ -390,18 +424,19 @@ class _Driver:
             .zone(f"zone-{i % self.cfg.zones}")
             .region("region-1")
         )
+        w = self._accel_label(w, i)
         if label_epoch:
             w = w.label("loadgen.tpu/epoch", str(label_epoch))
         return w.obj()
 
     def _churn_node(self, i: int):
-        return (
+        return self._accel_label(
             make_node(f"churn-{i}")
             .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
             .zone(f"zone-{i % self.cfg.zones}")
-            .region("region-1")
-            .obj()
-        )
+            .region("region-1"),
+            i,
+        ).obj()
 
     def build_fleet(self) -> None:
         for i in range(self.cfg.nodes):
@@ -466,13 +501,43 @@ class _Driver:
         # first tagged arrival recompiles inside the measured window.
         warm_tenants = [name for name, _w in self.cfg.tenants]
         warm = []
-        for i in range(self.cfg.warm_pods):
-            w = make_pod(f"lgwarm-{i}").req({"cpu": "50m", "memory": "64Mi"})
-            if warm_tenants:
-                w = w.label(
-                    TENANT_LABEL_KEY, warm_tenants[i % len(warm_tenants)]
-                )
-            warm.append(w.obj())
+        if self.cfg.profile:
+            # Heterogeneous warm wave (ISSUE 14): one pod per MIX
+            # TEMPLATE round-robin, so every (label set, workload class)
+            # group — and the class-active compiled program — lands in
+            # warmup.  This is the wire-side half of the accel-vocab
+            # pre-seed: the first hetero pod's featurize interns the
+            # matrix's accelerator classes and backfills the labeled
+            # node rows' topo slots HERE, not inside the measured
+            # window (the PR 9/PR 10 taint-vocab trap).
+            from ..api import types as t
+            from .workloads import MIXES, TEMPLATES
+
+            names = [n for n, _w in MIXES[self.cfg.mix]]
+            sched_name = profile_scheduler_name(self.cfg.profile)
+            for i in range(self.cfg.warm_pods):
+                p = TEMPLATES[names[i % len(names)]](10**6 + i)
+                p.metadata.name = f"lgwarm-{i}"
+                p.metadata.labels = dict(p.metadata.labels or {})
+                if sched_name:
+                    p.spec.scheduler_name = sched_name
+                if warm_tenants:
+                    p.metadata.labels[TENANT_LABEL_KEY] = warm_tenants[
+                        i % len(warm_tenants)
+                    ]
+                p.spec.containers[0].requests = {
+                    "cpu": t.parse_quantity("50m", "cpu"),
+                    "memory": t.parse_quantity("64Mi", "memory"),
+                }
+                warm.append(p)
+        else:
+            for i in range(self.cfg.warm_pods):
+                w = make_pod(f"lgwarm-{i}").req({"cpu": "50m", "memory": "64Mi"})
+                if warm_tenants:
+                    w = w.label(
+                        TENANT_LABEL_KEY, warm_tenants[i % len(warm_tenants)]
+                    )
+                warm.append(w.obj())
         half = len(warm) // 2
         self.client.add_pending_batch(warm[:half])
         for p in warm[:half]:
@@ -845,7 +910,7 @@ def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
         "--journal-dir", journal_dir,
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
-    ] + _lifecycle_argv(cfg)
+    ] + (["--profile", cfg.profile] if cfg.profile else []) + _lifecycle_argv(cfg)
     return _launch_serve(argv, out_dir, sock, "serve", deadline_s=180.0)
 
 
@@ -884,6 +949,7 @@ def run_soak(cfg: SoakConfig) -> dict:
             sock,
             batch_size=cfg.batch_size,
             chunk_size=cfg.chunk_size,
+            profiles=named_extra_profiles(cfg.profile),
             speculate=True,
             journal=journal,
             snapshot_every_batches=cfg.snapshot_every,
@@ -1137,6 +1203,7 @@ def _spawn_shard_serve(
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
     ] + ([] if cfg.observability else ["--no-observability"]) \
+      + (["--profile", cfg.profile] if cfg.profile else []) \
       + _lifecycle_argv(cfg)
     return _launch_serve(
         argv, out_dir, sock, f"serve-shard{shard}", deadline_s=300.0
@@ -1229,6 +1296,7 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                     batch_size=cfg.batch_size,
                     chunk_size=1,
                     tenant_attribution=cfg.observability,
+                    profiles=named_extra_profiles(cfg.profile),
                 ),
                 smap,
                 state_dir=os.path.join(journal_root, f"shard{k}"),
@@ -1260,7 +1328,10 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     # journal leases and sockets.
     try:
         mix = WorkloadMix(
-            cfg.mix, seed=cfg.seed * 7919 + 11, tenants=cfg.tenants
+            cfg.mix,
+            seed=cfg.seed * 7919 + 11,
+            tenants=cfg.tenants,
+            scheduler_name=profile_scheduler_name(cfg.profile),
         )
         slo_hist, slo_violations = _slo_families(
             registry, cfg.slo_budget_ms
@@ -1305,17 +1376,21 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 .zone(f"zone-{i % cfg.zones}")
                 .region("region-1")
             )
+            w = _accel_label(cfg, w, i)
             if i in hot_serving:
                 w = w.label("loadgen.tpu/hot", "1")
             feed_node(router, w.obj())
         for i in range(cfg.churn_nodes):
             feed_node(
                 router,
-                make_node(f"churn-{i}")
-                .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
-                .zone(f"zone-{i % cfg.zones}")
-                .region("region-1")
-                .obj(),
+                _accel_label(
+                    cfg,
+                    make_node(f"churn-{i}")
+                    .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+                    .zone(f"zone-{i % cfg.zones}")
+                    .region("region-1"),
+                    i,
+                ).obj(),
             )
         if armed:
             from ..api import types as t
@@ -1378,7 +1453,11 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         # draws from the SAME WorkloadMix templates (renamed far outside the
         # stream's index space) and the vocab is pre-seeded with the epoch
         # label values the scenario can reach, then the node is restored.
-        warm_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 104_729 + 31)
+        warm_mix = WorkloadMix(
+            cfg.mix,
+            seed=cfg.seed * 104_729 + 31,
+            scheduler_name=profile_scheduler_name(cfg.profile),
+        )
         for epoch in range(1, 5):
             w = (
                 make_node("lgn-0")
@@ -1551,7 +1630,11 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             # stream, scheduled through the real router path (journals
             # and all) before the window opens.  Rides the live-pod cap
             # like any stream binding, so retirement churns it.
-            pre_mix = WorkloadMix(cfg.mix, seed=cfg.seed * 31 + 7)
+            pre_mix = WorkloadMix(
+                cfg.mix,
+                seed=cfg.seed * 31 + 7,
+                scheduler_name=profile_scheduler_name(cfg.profile),
+            )
             pre_rng = _rng(cfg.seed * 1_000_003 + 313_131)
             pre_draws = pre_rng.random(cfg.preload_bound)
             for i in range(cfg.preload_bound):

@@ -34,7 +34,25 @@ from ..benchmarks.harness import (
     _pod_pref_anti,
     _pod_spread,
 )
+from ..ops.throughput import DEFAULT_THROUGHPUT_MATRIX, WORKLOAD_CLASS_LABEL_KEY
 from .arrivals import _rng
+
+
+def _hetero_template(wclass: str):
+    """A per-workload-class pod template (ISSUE 14): the basic shape plus
+    the ``scheduler.tpu/workload-class`` label the ThroughputAware /
+    LearnedScorer profiles read.  SchedulerName is stamped by the
+    WorkloadMix (the driver decides which registered profile serves the
+    stream), so one template set serves both hetero profiles."""
+
+    def tmpl(i: int) -> t.Pod:
+        pod = _pod_basic(i)
+        pod.metadata.labels = dict(pod.metadata.labels or {})
+        pod.metadata.labels[WORKLOAD_CLASS_LABEL_KEY] = wclass
+        return pod
+
+    return tmpl
+
 
 TEMPLATES = {
     "basic": _pod_basic,
@@ -43,6 +61,13 @@ TEMPLATES = {
     "pref_anti": _pod_pref_anti,
     "node_affinity": _pod_node_affinity,
 }
+# One template per throughput-matrix workload class:
+# hetero_train-large / hetero_train-small / hetero_serve / hetero_batch.
+HETERO_TEMPLATES = {
+    f"hetero_{wclass}": _hetero_template(wclass)
+    for wclass, _row in DEFAULT_THROUGHPUT_MATRIX
+}
+TEMPLATES.update(HETERO_TEMPLATES)
 
 # name → ((template, weight), ...).  Weights normalize at draw time.
 MIXES: dict[str, tuple[tuple[str, float], ...]] = {
@@ -61,6 +86,16 @@ MIXES: dict[str, tuple[tuple[str, float], ...]] = {
     # Adversarial for the decision cache: every pod carries terms, so
     # every domain event intersects every cached decision.
     "domains": (("affinity", 0.40), ("spread", 0.30), ("pref_anti", 0.30)),
+    # Heterogeneous-cluster stream (ISSUE 14): a class-labeled majority
+    # over mixed accelerator pools — every matrix row stays hot, a
+    # class-less minority keeps the class-inactive program path warm.
+    "hetero": (
+        ("basic", 0.20),
+        ("hetero_train-large", 0.20),
+        ("hetero_train-small", 0.20),
+        ("hetero_serve", 0.25),
+        ("hetero_batch", 0.15),
+    ),
 }
 
 
@@ -83,10 +118,16 @@ class WorkloadMix:
         seed: int,
         small_requests: bool = True,
         tenants: tuple[tuple[str, float], ...] = (),
+        scheduler_name: str = "",
     ):
         if mix not in MIXES:
             raise ValueError(f"unknown mix {mix!r}; have {sorted(MIXES)}")
         self.mix = mix
+        # Non-empty: every pod of the stream selects this registered
+        # profile by schedulerName (the hetero soak's profile selection
+        # — scheduler.py _profile_for routes it to the profile's own
+        # compiled program family).
+        self.scheduler_name = scheduler_name
         entries = MIXES[mix]
         total = sum(w for _n, w in entries)
         self._names = [n for n, _w in entries]
@@ -113,6 +154,8 @@ class WorkloadMix:
         # The generator's own naming space; rename BEFORE any uid access
         # (Pod.uid memoizes on first read).
         pod.metadata.name = f"lg-{i}"
+        if self.scheduler_name:
+            pod.spec.scheduler_name = self.scheduler_name
         if tenant is None and self.tenants:
             tenant = (
                 self._tenant_names[0]

@@ -5,11 +5,13 @@ from . import (  # noqa: F401
     dynamicresources,
     imagelocality,
     interpodaffinity,
+    learned,
     nodeaffinity,
     nodeports,
     noderesources,
     podtopologyspread,
     tainttoleration,
+    throughput,
     trivial,
     volumes,
 )

@@ -1,0 +1,232 @@
+"""LearnedScorer: a fixed-weight MLP scoring program under the profile map.
+
+The proof (ROADMAP's learned-scoring direction) that the config/profile
+machinery hosts ARBITRARY scoring programs, not just upstream plugin
+ports: a small multi-layer perceptron over featurized (pod, node) columns
+evaluated INSIDE the same compiled batch pass as every other op.
+Inference-only and fully deterministic — the weights are a committed
+artifact (``learned_weights.json``, loaded once per profile; no training,
+no entropy, no wallclock), and the forward pass is written as explicitly
+associated elementwise float32 arithmetic (unrolled over the fixed
+feature/hidden dims) so the reduction order is IDENTICAL whatever the
+node-axis shape or sharding — a fleet shard evaluating its partition
+reproduces the single scheduler's per-node scores bit for bit.
+
+Input features per (pod, node) — all node-axis state or pod base
+features, nothing cross-node (no feasible-set reductions; the fleet
+contract of ops/throughput.py applies):
+
+  0. free-cpu fraction      (alloc − req)/alloc, 0 for cpu-less rows
+  1. free-memory fraction   same, memory column
+  2. pod-count fraction     num_pods/allowed_pods
+  3. normalized throughput  ops/throughput score table gather / 100
+  4. request pressure       pod cpu request / node cpu allocatable
+
+Output: sigmoid(tanh(x·W1 + b1)·W2 + b2) mapped to [0, MAX_NODE_SCORE]
+via floor(y·MAX + 0.5) in float32 — deterministic rounding, no data-
+dependent normalization.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+
+from ..api import types as t
+from ..framework.config import MAX_NODE_SCORE, Profile
+from ..snapshot import RES_CPU, RES_MEMORY
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import gather_mask
+from .throughput import DEFAULT_THROUGHPUT_MATRIX, _tp_features
+
+# The committed inference artifact: weights live beside the op, loaded
+# once per profile construction (never per pod / per pass).
+DEFAULT_WEIGHTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "learned_weights.json"
+)
+
+N_FEATURES = 5
+
+
+def load_weights(path: str = DEFAULT_WEIGHTS_PATH) -> tuple:
+    """Load + validate the committed MLP artifact into the hashable
+    nested-tuple form Profile.learned_weights carries:
+    ``((w1 rows...), (b1...), (w2...), b2)`` with w1 (F, H), b1 (H,),
+    w2 (H,), b2 scalar.  Strict: wrong shapes or non-finite values are
+    config errors, not runtime surprises."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != 1:
+        raise ValueError(f"learned_weights: unsupported version {doc.get('version')!r}")
+    w1 = doc["w1"]
+    b1 = doc["b1"]
+    w2 = doc["w2"]
+    b2 = doc["b2"]
+    if len(w1) != N_FEATURES:
+        raise ValueError(
+            f"learned_weights: w1 has {len(w1)} feature rows, want {N_FEATURES}"
+        )
+    hidden = len(b1)
+    if hidden < 1:
+        raise ValueError("learned_weights: empty hidden layer")
+    for i, row in enumerate(w1):
+        if len(row) != hidden:
+            raise ValueError(f"learned_weights: w1[{i}] has {len(row)} cols, want {hidden}")
+    if len(w2) != hidden:
+        raise ValueError(f"learned_weights: w2 has {len(w2)} entries, want {hidden}")
+    flat = [x for row in w1 for x in row] + list(b1) + list(w2) + [b2]
+    for x in flat:
+        if not math.isfinite(float(x)):
+            raise ValueError("learned_weights: non-finite weight")
+    return (
+        tuple(tuple(float(x) for x in row) for row in w1),
+        tuple(float(x) for x in b1),
+        tuple(float(x) for x in w2),
+        float(b2),
+    )
+
+
+def reference_scores(
+    pod, nodes, weights, matrix=DEFAULT_THROUGHPUT_MATRIX, num_pods=None
+):
+    """Pure-Python float32 oracle of the device forward pass (parity
+    tests): same feature extraction, same association order.
+    ``num_pods`` maps node name → pods already on it (default empty)."""
+    from .throughput import node_accel_class, pod_workload_class
+
+    w1, b1, w2, b2 = weights
+    row = dict(matrix).get(pod_workload_class(pod)) if matrix else None
+    best = max(max((tp for _a, tp in row), default=1), 1) if row else 1
+    by_accel = dict(row) if row else {}
+    req = pod.resource_request()
+    req_cpu = req.get(t.CPU, 0)
+    req_mem = req.get(t.MEMORY, 0)
+    out = []
+    for n in nodes:
+        alloc_cpu = n.status.allocatable.get(t.CPU, 0)
+        alloc_mem = n.status.allocatable.get(t.MEMORY, 0)
+        allowed = n.status.allocatable.get(t.PODS, 110)
+        f32 = np.float32
+        x = [
+            max(f32(alloc_cpu - req_cpu) / f32(max(alloc_cpu, 1)), f32(0.0)),
+            max(f32(alloc_mem - req_mem) / f32(max(alloc_mem, 1)), f32(0.0)),
+            f32((num_pods or {}).get(n.name, 0)) / f32(max(allowed, 1)),
+            f32(by_accel.get(node_accel_class(n) or "", 0) * MAX_NODE_SCORE // best)
+            / f32(MAX_NODE_SCORE),
+            f32(req_cpu) / f32(max(alloc_cpu, 1)),
+        ]
+        h = []
+        for j in range(len(b1)):
+            acc = f32(b1[j])
+            for i in range(len(x)):
+                acc = f32(acc + f32(f32(w1[i][j]) * f32(x[i])))
+            h.append(np.tanh(acc, dtype=np.float32))
+        y = f32(b2)
+        for j in range(len(b1)):
+            y = f32(y + f32(f32(w2[j]) * h[j]))
+        y = f32(1.0) / f32(1.0 + np.exp(-y, dtype=np.float32))
+        out.append(int(np.floor(f32(y * MAX_NODE_SCORE) + f32(0.5))))
+    return out
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    profile = fctx.profile
+    matrix = profile.throughput_matrix if profile is not None else ()
+    feats = _tp_features(pod, fctx, matrix)
+    req = pod.resource_request()
+    return {
+        "tp_scores": feats["tp_scores"],
+        "tp_slot": feats["tp_slot"],
+        "ls_req_cpu": np.int64(req.get(t.CPU, 0)),
+        "ls_req_mem": np.int64(req.get(t.MEMORY, 0)),
+    }
+
+
+def _static(profile: Profile, schema, builder_res_col) -> dict:
+    """Bake the weight tuples into the trace (profile config is static
+    under jit — each weights artifact compiles its own program)."""
+    return {"learned_weights": profile.learned_weights}
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    import jax.numpy as jnp
+
+    weights = ctx.static.get("learned_weights")
+    if not weights:
+        return jnp.zeros(state.valid.shape, jnp.int64)
+    w1, b1, w2, b2 = weights
+    f32 = jnp.float32
+    alloc_cpu = state.alloc[:, RES_CPU].astype(f32)
+    alloc_mem = state.alloc[:, RES_MEMORY].astype(f32)
+    safe_cpu = jnp.maximum(alloc_cpu, 1.0)
+    safe_mem = jnp.maximum(alloc_mem, 1.0)
+    req_cpu = pf["ls_req_cpu"].astype(f32)
+    req_mem = pf["ls_req_mem"].astype(f32)
+    vals = jnp.take(state.topo_vals, pf["tp_slot"], axis=1)
+    tput = gather_mask(pf["tp_scores"], vals[:, None])[:, 0].astype(f32)
+    x = [
+        jnp.maximum((alloc_cpu - req_cpu) / safe_cpu, 0.0),
+        jnp.maximum((alloc_mem - req_mem) / safe_mem, 0.0),
+        state.num_pods.astype(f32) / jnp.maximum(state.allowed_pods.astype(f32), 1.0),
+        tput / f32(MAX_NODE_SCORE),
+        req_cpu / safe_cpu,
+    ]
+    # Unrolled, explicitly associated forward pass: the Python loops fix
+    # the reduction order at trace time (no dot_general whose internal
+    # order could vary with shape/sharding), so every shard — and the
+    # single scheduler — computes bit-equal float32 per-node scores.
+    hs = []
+    for j in range(len(b1)):
+        acc = jnp.full(alloc_cpu.shape, f32(b1[j]))
+        for i in range(len(x)):
+            acc = acc + f32(w1[i][j]) * x[i]
+        hs.append(jnp.tanh(acc))
+    y = jnp.full(alloc_cpu.shape, f32(b2))
+    for j in range(len(b1)):
+        y = y + f32(w2[j]) * hs[j]
+    y = 1.0 / (1.0 + jnp.exp(-y))
+    return jnp.floor(y * f32(MAX_NODE_SCORE) + f32(0.5)).astype(jnp.int64)
+
+
+feature_fill("ls_req_cpu", 0)
+feature_fill("ls_req_mem", 0)
+
+
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # Weights are profile config: uniform across pods AND across fleet
+    # shards (no per-shard vocab dependence), so activation can never
+    # skew a partition.
+    profile = fctx.profile
+    return profile is not None and bool(profile.learned_weights)
+
+
+register(
+    OpDef(
+        name="LearnedScorer",
+        featurize=featurize,
+        score=score_fn,
+        static=_static,
+        is_active=is_active,
+    )
+)
+
+
+def learned_scorer_profile(
+    weights_path: str = DEFAULT_WEIGHTS_PATH,
+    matrix: tuple = DEFAULT_THROUGHPUT_MATRIX,
+    weight: int = 3,
+) -> Profile:
+    """The learned-scorer profile: default plugins + the MLP scorer,
+    selected by ``schedulerName: learned-scorer-scheduler``.  The matrix
+    rides along so feature 3 (normalized throughput) is live — the
+    learned program SUBSUMES the hand-written throughput objective."""
+    base = Profile()
+    return Profile(
+        name="learned-scorer-scheduler",
+        scorers=base.scorers + (("LearnedScorer", weight),),
+        throughput_matrix=tuple((w, tuple(r)) for w, r in matrix),
+        learned_weights=load_weights(weights_path),
+    )

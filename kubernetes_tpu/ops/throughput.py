@@ -1,0 +1,200 @@
+"""ThroughputAware: Gavel-style heterogeneity-aware scoring, vectorized.
+
+Reference (Gavel, arxiv 2008.09213): heterogeneity-aware policies rank
+accelerators per job class by a measured per-(job-class, accelerator-type)
+throughput matrix and allocate each job the accelerator time where its
+NORMALIZED effective throughput is highest.  This op is the score-plugin
+projection of that objective onto the one-shot placement decision: a
+candidate node scores its accelerator class's throughput for the pod's
+workload class, normalized by the class's best-case throughput across the
+matrix row — a profile-config constant, so the score is a pure per-node
+property.
+
+TPU design: the accelerator class rides the existing device matrix as a
+TOPOLOGY KEY (``scheduler.tpu/accel`` — node pools label their class, e.g.
+``tpu-v4`` / ``tpu-v5e`` / ``gpu-a100``); node rows carry the interned
+class id in ``state.topo_vals`` like any zone/region value, so the
+heterogeneous cluster model adds ZERO new ClusterState fields.  Pod
+featurization resolves the pod's workload class (``scheduler.tpu/
+workload-class`` label) against the profile's throughput matrix ONCE,
+producing a (DV,) pre-normalized score table; the device score is a single
+gather per node — no string ops, no host loop, O(1) per (pod, node).
+
+Determinism/fleet contract: the normalizer is the STATIC matrix-row max
+(profile config), never the feasible-set max — per-node scores are
+partition-independent, so a fleet of shard owners reproduces the single
+scheduler bit for bit (the Tesserae compromise documented in
+fleet/router.py never engages; contrast DefaultNormalizeScore ops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import types as t
+from ..framework.config import MAX_NODE_SCORE, Profile
+from .common import FeaturizeContext, OpDef, PassContext, feature_fill, register
+from .helpers import gather_mask
+
+# The accelerator-class node label (the heterogeneous cluster model's one
+# knob: a node pool's class is a label, featurized as a topology key).
+ACCEL_LABEL_KEY = "scheduler.tpu/accel"
+# The pod-side workload class selecting the matrix row.
+WORKLOAD_CLASS_LABEL_KEY = "scheduler.tpu/workload-class"
+
+# The default per-(workload-class, accelerator-class) throughput matrix —
+# integer milli-throughput (relative units; only ratios matter).  Shaped
+# like Gavel's measured matrices: orderings DIFFER per class (v5e wins
+# serving, v4 wins large training, the GPU wins preprocessing), which is
+# exactly what a heterogeneity-UNAWARE scorer cannot express.
+DEFAULT_THROUGHPUT_MATRIX: tuple[tuple[str, tuple[tuple[str, int], ...]], ...] = (
+    ("train-large", (("tpu-v4", 1000), ("tpu-v5e", 520), ("gpu-a100", 410))),
+    ("train-small", (("tpu-v4", 760), ("tpu-v5e", 980), ("gpu-a100", 650))),
+    ("serve", (("tpu-v4", 540), ("tpu-v5e", 1000), ("gpu-a100", 720))),
+    ("batch", (("tpu-v4", 330), ("tpu-v5e", 450), ("gpu-a100", 1000))),
+)
+
+
+def matrix_accel_classes(
+    matrix: tuple[tuple[str, tuple[tuple[str, int], ...]], ...]
+) -> tuple[str, ...]:
+    """Every accelerator class any matrix row names, first-seen order."""
+    seen: dict[str, None] = {}
+    for _wclass, row in matrix:
+        for accel, _tput in row:
+            seen.setdefault(accel, None)
+    return tuple(seen)
+
+
+def pod_workload_class(pod: t.Pod) -> str | None:
+    return pod.metadata.labels.get(WORKLOAD_CLASS_LABEL_KEY)
+
+
+def node_accel_class(node: t.Node) -> str | None:
+    return node.metadata.labels.get(ACCEL_LABEL_KEY)
+
+
+def reference_scores(
+    pod: t.Pod, nodes: list[t.Node], matrix=DEFAULT_THROUGHPUT_MATRIX
+) -> list[int]:
+    """Pure-Python oracle for the device score (tests/test_heterogeneity
+    parity): per-node normalized effective throughput in
+    [0, MAX_NODE_SCORE], 0 for unlabeled nodes / unknown classes."""
+    row = dict(matrix).get(pod_workload_class(pod))
+    if not row:
+        return [0 for _ in nodes]
+    best = max(max(tput for _accel, tput in row), 1)
+    by_accel = dict(row)
+    return [
+        (by_accel.get(node_accel_class(n) or "", 0) * MAX_NODE_SCORE) // best
+        for n in nodes
+    ]
+
+
+def preseed_hetero_vocab(builder, matrix=DEFAULT_THROUGHPUT_MATRIX) -> None:
+    """Pre-seed the accelerator-class vocabulary (and the matrix's row
+    keys) into the featurization vocab BEFORE warmup compiles the device
+    programs — the heterogeneity analog of the lifecycle-taint/tenant
+    pre-seeds (PR 9/PR 12): without it the FIRST mid-window heterogeneous
+    pod or freshly-labeled node grows the topo/label vocab (and possibly
+    the DV bucket) and pays a full XLA recompile inside the measured
+    window.  Idempotent; safe on a builder that never sees hetero pods
+    (interning adds vocabulary entries, never behavior)."""
+    it = builder.interns
+    builder.ensure_topo_key(ACCEL_LABEL_KEY)
+    it.label_keys.id(ACCEL_LABEL_KEY)
+    it.label_keys.id(WORKLOAD_CLASS_LABEL_KEY)
+    for accel in matrix_accel_classes(matrix):
+        it.topo_value_id(ACCEL_LABEL_KEY, accel)
+        it.label_pairs.id((ACCEL_LABEL_KEY, accel))
+    for wclass, _row in matrix:
+        it.label_pairs.id((WORKLOAD_CLASS_LABEL_KEY, wclass))
+    builder._ensure(DV=it.max_topo_vocab())
+
+
+def _tp_features(pod: t.Pod, fctx: FeaturizeContext, matrix) -> dict:
+    """(tp_scores (DV,) i64, tp_slot () i32): the pod's pre-normalized
+    per-accelerator-class score table and the accel topology slot.
+    Shared with the learned scorer's throughput input feature."""
+    builder = fctx.builder
+    it = fctx.interns
+    slot = builder.ensure_topo_key(ACCEL_LABEL_KEY)
+    row = dict(matrix).get(pod_workload_class(pod)) if matrix else None
+    if row:
+        # Intern every class in the row BEFORE sizing the table: a class
+        # no node carries yet still gets its stable id (and the DV grow
+        # happens here, host-side, not mid-pass).
+        vids = {it.topo_value_id(ACCEL_LABEL_KEY, accel): tput for accel, tput in row}
+        builder._ensure(DV=it.max_topo_vocab())
+    else:
+        vids = {}
+    dv = builder.schema.DV
+    scores = np.zeros(dv, np.int64)
+    if vids:
+        # validate_profile rejects all-zero rows; the max(…, 1) keeps an
+        # unvalidated embedder-built profile at score 0 instead of a
+        # schedule-time divide.
+        best = max(max(vids.values()), 1)
+        for vid, tput in vids.items():
+            if vid < dv:
+                scores[vid] = tput * MAX_NODE_SCORE // best
+    return {"tp_scores": scores, "tp_slot": np.int32(slot)}
+
+
+def featurize(pod: t.Pod, fctx: FeaturizeContext) -> dict:
+    matrix = fctx.profile.throughput_matrix if fctx.profile is not None else ()
+    return _tp_features(pod, fctx, matrix)
+
+
+def score_fn(state, pf, ctx: PassContext, feasible):
+    import jax.numpy as jnp
+
+    # Node's accelerator-class id at the accel topo slot ((N,); -1 when
+    # the node carries no class label → gather_mask scores it 0).
+    vals = jnp.take(state.topo_vals, pf["tp_slot"], axis=1)
+    return gather_mask(pf["tp_scores"], vals[:, None])[:, 0].astype(jnp.int64)
+
+
+feature_fill("tp_scores", 0)
+feature_fill("tp_slot", 0)
+
+
+def is_active(pod: t.Pod, fctx: FeaturizeContext) -> bool:
+    # All-zero scores are a constant the engine may skip: no matrix row
+    # for the pod's class, or no node anywhere carries the accel label
+    # (then every gather lands on -1/absent ids).  A shard whose nodes
+    # are all unlabeled skipping the op is bit-identical to running it —
+    # the scores it would compute are exactly zero (no feasible-set
+    # normalization), so fleet shards never diverge on activation.
+    profile = fctx.profile
+    if profile is None or not profile.throughput_matrix:
+        return False
+    if pod_workload_class(pod) not in dict(profile.throughput_matrix):
+        return False
+    return ACCEL_LABEL_KEY in fctx.interns.label_keys
+
+
+register(
+    OpDef(
+        name="ThroughputAware",
+        featurize=featurize,
+        score=score_fn,
+        is_active=is_active,
+    )
+)
+
+
+def throughput_aware_profile(
+    matrix: tuple = DEFAULT_THROUGHPUT_MATRIX, weight: int = 3
+) -> Profile:
+    """The heterogeneity-aware profile: the full default plugin set plus
+    the ThroughputAware scorer, selected by pods naming
+    ``schedulerName: throughput-aware-scheduler``.  Registered beside the
+    default via ``TPUScheduler(profiles=[throughput_aware_profile()])``
+    (the multi-profile map compiles it as its own XLA program family)."""
+    base = Profile()
+    return Profile(
+        name="throughput-aware-scheduler",
+        scorers=base.scorers + (("ThroughputAware", weight),),
+        throughput_matrix=tuple((w, tuple(r)) for w, r in matrix),
+    )

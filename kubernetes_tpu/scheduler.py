@@ -528,6 +528,33 @@ class TPUScheduler:
             "Main-pass dispatches by domain-table source (carried vs "
             "rebuilt from cluster state).",
         )
+        # Heterogeneity attribution (ISSUE 14): armed only when a
+        # registered profile ships a throughput matrix — homogeneous
+        # deployments pay nothing and export no empty families.
+        # _hetero_classes caches the bounded label vocabularies
+        # (accelerator classes × workload classes from the matrix
+        # config; off-config values fold to "other").
+        matrix_accels: set = set()
+        matrix_classes: set = set()
+        for p in self.profiles.values():
+            for wclass, row in p.throughput_matrix:
+                matrix_classes.add(wclass)
+                matrix_accels.update(a for a, _tp in row)
+        self._hetero_classes = (
+            (frozenset(matrix_accels), frozenset(matrix_classes))
+            if matrix_classes
+            else None
+        )
+        self._hetero_bound = reg.counter(
+            "scheduler_hetero_bound_total",
+            "Pods bound, by the chosen node's accelerator class and the "
+            "pod's workload class (heterogeneity profiles).",
+        )
+        self._profile_bound = reg.counter(
+            "scheduler_profile_bound_total",
+            "Pods bound per scheduler profile (the multi-profile map's "
+            "serving split).",
+        )
         # Poison-batch recovery observability: how often the engine raised
         # mid-batch and how many pods ended up isolated.  The quarantine
         # DEPTH rides scheduler_pending_pods{queue="quarantine"} below.
@@ -755,6 +782,36 @@ class TPUScheduler:
         if self.tenant_metrics is not None:
             self.tenant_metrics.note(event, pod_tenant(pod))
 
+    def _note_bound(self, pod: t.Pod, node_name: str) -> None:
+        """Per-bind attribution, every bind path: the tenant counter
+        plus — when any registered profile carries a throughput matrix —
+        the heterogeneity split (scheduler_hetero_bound_total by the
+        chosen node's accelerator class × the pod's workload class;
+        label values bounded by the matrix config, everything else
+        folds to "-"/"other") and the per-profile serving split
+        (scheduler_profile_bound_total, bounded by the profile map)."""
+        self._note_tenant("bound", pod)
+        if self._hetero_classes is None:
+            return
+        accels, wclasses = self._hetero_classes
+        from .ops.throughput import ACCEL_LABEL_KEY, WORKLOAD_CLASS_LABEL_KEY
+
+        rec = self.cache.nodes.get(node_name)
+        accel = (
+            rec.node.metadata.labels.get(ACCEL_LABEL_KEY, "")
+            if rec is not None
+            else ""
+        )
+        wclass = pod.metadata.labels.get(WORKLOAD_CLASS_LABEL_KEY, "")
+        self._hetero_bound.inc(
+            accel=(accel if accel in accels else "other") if accel else "-",
+            workload_class=(
+                (wclass if wclass in wclasses else "other") if wclass else "-"
+            ),
+        )
+        profile = self._profile_for(pod) or self.profile
+        self._profile_bound.inc(profile=profile.name)
+
     def _flight_add(self, key: str, n) -> None:
         acc = self._flight_acc
         if acc is not None:
@@ -894,6 +951,15 @@ class TPUScheduler:
         renewal.  Feeds the node-lifecycle controller's staleness clock;
         armed, a renewal also drives the transition/eviction/GC tick."""
         self.node_lifecycle.renew(lease.node_name, lease.renew_time)
+
+    def remove_node_lease(self, node_name: str) -> None:
+        """Lease DELETED (or absent from a relist): the node drops out of
+        heartbeat tracking — unleased nodes are lifecycle-exempt, the
+        documented pre-ISSUE-9 behavior.  The Lease Reflector's
+        LIST-as-replace delivers this (informers.KIND_HANDLERS), so a
+        takeover that relists Leases converges on exactly the host-truth
+        tracked set."""
+        self.node_lifecycle.forget_node(node_name)
 
     def write_node_taints(
         self, name: str, taints: tuple, reason: str = ""
@@ -1735,7 +1801,7 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
-        self._note_tenant("bound", qp.pod)
+        self._note_bound(qp.pod, res.node_name)
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {res.node_name} "
@@ -1848,7 +1914,7 @@ class TPUScheduler:
         lat = now - qp.initial_attempt_timestamp
         m.e2e_latency_samples.append(lat)
         m.registry.scheduling_sli.observe(lat)
-        self._note_tenant("bound", qp.pod)
+        self._note_bound(qp.pod, entry["node"])
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {entry['node']} "
@@ -2074,7 +2140,7 @@ class TPUScheduler:
         m.scheduled += 1
         m.last_scheduled_ts = now
         m.e2e_latency_samples.append(now - qp.initial_attempt_timestamp)
-        self._note_tenant("bound", qp.pod)
+        self._note_bound(qp.pod, best)
         self.recorder.event(
             qp.pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {qp.pod.uid} to {best}",
@@ -2248,7 +2314,7 @@ class TPUScheduler:
             m.first_scheduled_ts = now
         m.scheduled += 1
         m.last_scheduled_ts = now
-        self._note_tenant("bound", pod)
+        self._note_bound(pod, node_name)
         self.recorder.event(
             pod.uid, NORMAL, "Scheduled",
             f"Successfully assigned {pod.uid} to {node_name}",
@@ -3503,7 +3569,7 @@ class TPUScheduler:
                     m.first_scheduled_ts = now
                 m.scheduled += 1
                 m.last_scheduled_ts = now
-                self._note_tenant("bound", outcome.pod)
+                self._note_bound(outcome.pod, outcome.node_name)
                 self.recorder.event(
                     outcome.pod.uid, NORMAL, "Scheduled",
                     f"Successfully assigned {outcome.pod.uid} to "

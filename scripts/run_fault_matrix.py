@@ -320,6 +320,55 @@ def _truth_deleted(state_dir: str) -> set:
         return set()
 
 
+def _truth_lease_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "truth.leases")
+
+
+def _truth_lease(state_dir: str, name: str, ts: float) -> None:
+    """Durably record a Lease renewal in host truth BEFORE the local
+    apply — the apiserver holds the Lease object, so a successor's LIST
+    sees every renewal the kubelet committed, including ones the dead
+    owner never consumed.  Append-only like the other truth files (a
+    torn final line is skipped by the reader)."""
+    with open(_truth_lease_path(state_dir), "a") as f:
+        f.write(f"{name} {ts}\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _truth_leases(state_dir: str) -> dict:
+    """Host truth's CURRENT Lease per node: the max recorded renewal —
+    what a LIST of coordination.k8s.io Leases returns."""
+    out: dict[str, float] = {}
+    try:
+        with open(_truth_lease_path(state_dir)) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) != 2:
+                    continue  # torn tail line
+                try:
+                    ts = float(parts[1])
+                except ValueError:
+                    continue
+                if ts > out.get(parts[0], -1.0):
+                    out[parts[0]] = ts
+    except OSError:
+        pass
+    return out
+
+
+def _record_lease_truth(sched, state_dir: str) -> None:
+    """Interpose renew_node_lease to commit host truth first (the
+    victim's side of the Lease-relist takeover contract)."""
+    orig = sched.renew_node_lease
+
+    def renew(lease, _orig=orig):
+        _truth_lease(state_dir, lease.node_name, lease.renew_time)
+        _orig(lease)
+
+    sched.renew_node_lease = renew
+
+
 def _journaled_scheduler(state_dir: str):
     """(scheduler, journal): the golden basic-session scheduler with the
     write-ahead journal armed under the journal lease's fencing epoch,
@@ -1031,19 +1080,25 @@ def node_loss_objects():
 NODE_LOSS_LEASE_TS = tuple(float(ts) for ts in range(2, 41, 2))
 
 
-def _node_loss_tail(sched, state_dir: str) -> dict:
-    """The scenario tail — idempotent: Lease renewals are monotone (a
-    replayed-stale stamp is ignored) and the transition history is a pure
-    function of the lease schedule, so a recovery child re-running the
-    full schedule converges to the uninterrupted run's state."""
+def _node_loss_tail(sched, state_dir: str, lease_floor: dict | None = None) -> dict:
+    """The scenario tail.  A recovery child passes ``lease_floor`` — the
+    per-node stamps its Lease RELIST restored (takeover rung: heartbeat
+    state comes from listing host truth's Lease objects, NOT from
+    re-deriving it out of a re-fed schedule) — and feeds only the
+    renewals newer than the floor; transitions are a pure function of
+    the logical clock, so the run converges to the uninterrupted
+    timeline either way."""
     from kubernetes_tpu.api import types as t
 
+    fl = lease_floor or {}
     sched.schedule_all_pending(wait_backoff=True)
     for name in ("nd1", "n2", "n3", "n4"):
-        sched.renew_node_lease(t.Lease(name, 0.0))
+        if 0.0 > fl.get(name, -1.0):
+            sched.renew_node_lease(t.Lease(name, 0.0))
     for ts in NODE_LOSS_LEASE_TS:
         for name in ("n2", "n3", "n4"):  # nd1 went silent after t=0
-            sched.renew_node_lease(t.Lease(name, ts))
+            if ts > fl.get(name, -1.0):
+                sched.renew_node_lease(t.Lease(name, ts))
     sched.schedule_all_pending(wait_backoff=True)
     bindings = {
         uid: pr.node_name
@@ -1076,6 +1131,7 @@ def node_loss_child(state_dir: str) -> None:
 
     sched, journal = _node_loss_scheduler(state_dir)
     sched.attach_journal(journal, snapshot_every_batches=1)
+    _record_lease_truth(sched, state_dir)
     ks = KillSwitch.from_env()
     if ks is not None:
         ks.arm()
@@ -1095,11 +1151,14 @@ def node_loss_recover_child(state_dir: str) -> None:
     node relists in its ORIGINAL untainted shape and the Reflector's
     recovered-taints overlay re-applies the journal-authored lifecycle
     taints; evicted pods relist UNBOUND (their durable eviction
-    tombstones are the apiserver's recreate) — then re-run the lease
-    schedule: renewals are monotone, so the transition history replays
-    and converges on the uninterrupted timeline."""
+    tombstones are the apiserver's recreate); the Lease RELIST (the
+    ROADMAP takeover rung) restores pre-crash heartbeat state from host
+    truth's CURRENT Lease objects, and only the post-crash slice of the
+    schedule re-feeds — transitions are a pure function of the logical
+    clock, so the history converges on the uninterrupted timeline."""
     import copy
 
+    from kubernetes_tpu.api import types as t
     from kubernetes_tpu.informers import (
         FakeSource,
         Reflector,
@@ -1113,7 +1172,8 @@ def node_loss_recover_child(state_dir: str) -> None:
     nodes, bound, pending = node_loss_objects()
     deleted = _truth_deleted(state_dir)
     evicted = _truth_evicted(state_dir)
-    src_n, src_p = FakeSource(), FakeSource()
+    lease_truth = _truth_leases(state_dir)
+    src_n, src_p, src_l = FakeSource(), FakeSource(), FakeSource()
     for n in nodes:
         src_n.add(n.name, copy.deepcopy(n))
     for p in bound + pending:
@@ -1123,12 +1183,17 @@ def node_loss_recover_child(state_dir: str) -> None:
         if obj.uid in evicted:
             obj.spec.node_name = ""  # host truth: recreated unbound
         src_p.add(obj.uid, obj)
+    for name in sorted(lease_truth):
+        src_l.add(name, t.Lease(name, lease_truth[name]))
     reconcile_after_recovery(
         sched,
         Reflector(sched, "Node", src_n.lister, src_n.watcher),
         Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+        lease_reflector=Reflector(
+            sched, "Lease", src_l.lister, src_l.watcher
+        ),
     )
-    _node_loss_tail(sched, state_dir)
+    _node_loss_tail(sched, state_dir, lease_floor=lease_truth)
 
 
 def _node_loss_cell_evidence(state_dir: str) -> list[str]:
@@ -1359,6 +1424,7 @@ def _fleet_node_loss_build(state_dir: str, recover: bool = False):
 def _fleet_node_loss_tail(
     router, owners, map_path: str, state_dir: str,
     initial_schedule: bool = True,
+    lease_floor: dict | None = None,
 ):
     """The fleet node-death scenario tail — idempotent like the single
     one: Lease renewals are monotone, the handoff re-applies only if its
@@ -1367,15 +1433,28 @@ def _fleet_node_loss_tail(
     host truth re-fed unbound (tombstone-evicted mid-incident) must not
     schedule against un-re-derived state — the dead node relists
     untainted, and binding anything before the lease re-run re-cordons
-    it would hand out placements the unkilled run never offered."""
+    it would hand out placements the unkilled run never offered.
+    ``lease_floor`` (recovery only) is the per-node stamp set the Lease
+    relist already restored — only newer renewals re-feed (the takeover
+    rung: relist, don't re-derive); the VICTIM run (floor None) records
+    every renewal into host truth before applying it."""
     from gen_golden_transcripts import wait_for_backoffs
 
     from kubernetes_tpu.api import types as t
 
+    record = lease_floor is None
+    fl = lease_floor or {}
+
+    def renew(name: str, ts: float) -> None:
+        if record:
+            _truth_lease(state_dir, name, ts)
+        if ts > fl.get(name, -1.0):
+            router.add_object("Lease", t.Lease(name, ts))
+
     if initial_schedule:
         router.schedule_all_pending(wait_backoff=True)
     for name in ("nd1", "n2", "n3", "n4"):
-        router.add_object("Lease", t.Lease(name, 0.0))
+        renew(name, 0.0)
     for ts in NODE_LOSS_LEASE_TS:
         if ts == 8.0 and router.shard_map.owner_of("n3") == 1:
             # Mid-INCIDENT handoff: nd1 went NotReady at clock 6 and its
@@ -1385,7 +1464,7 @@ def _fleet_node_loss_tail(
             rec = router.shard_map.assign("n3", 0)
             router.apply_handoff(rec, map_path)
         for name in ("n2", "n3", "n4"):  # nd1 went silent after t=0
-            router.add_object("Lease", t.Lease(name, ts))
+            renew(name, ts)
     wait_for_backoffs(router.queue)
     router.schedule_all_pending(wait_backoff=True)
     bindings = router.bindings()
@@ -1480,9 +1559,13 @@ def fleet_node_loss_recover_child(state_dir: str) -> None:
     bucket), the router adopts bindings then drains the pending
     requeues, host truth re-feeds idempotently (the owner-side
     recovered-taints overlay keeps journal-authored lifecycle taints
-    across the untainted relist; evicted pods relist unbound), and the
-    full lease schedule re-runs to convergence."""
+    across the untainted relist; evicted pods relist unbound), the Lease
+    RELIST restores kill-point heartbeat state from host truth (the
+    ROADMAP takeover rung — relist, don't re-derive), and only the
+    post-kill slice of the lease schedule re-feeds to convergence."""
     import copy
+
+    from kubernetes_tpu.api import types as t
 
     router, owners, map_path = _fleet_node_loss_build(state_dir, recover=True)
     deleted = _truth_deleted(state_dir)
@@ -1510,8 +1593,16 @@ def fleet_node_loss_recover_child(state_dir: str) -> None:
     # already happened, so the recovery's rebind steps line up with the
     # baseline's and score ties break identically.
     router._cycle = sum(1 for p in pending if p.uid in router._pod_shard)
+    # Lease relist: host truth's CURRENT renewals (the kill-point
+    # stamps) feed once, restoring the logical clock and heartbeat set
+    # the dead fleet held — idempotent against the owners' own
+    # journal-replayed lifecycle state.
+    lease_truth = _truth_leases(state_dir)
+    for name in sorted(lease_truth):
+        router.add_object("Lease", t.Lease(name, lease_truth[name]))
     _fleet_node_loss_tail(
-        router, owners, map_path, state_dir, initial_schedule=False
+        router, owners, map_path, state_dir, initial_schedule=False,
+        lease_floor=lease_truth,
     )
     for owner in owners.values():
         owner.close()

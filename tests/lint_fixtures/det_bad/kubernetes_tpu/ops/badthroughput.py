@@ -1,0 +1,29 @@
+"""Seeded determinism violations in a heterogeneity score path (ISSUE
+14): a throughput-matrix/weight loader and scorer that read clocks,
+draw entropy, and bucket by salted hash — everything the A/B oracle
+forbids (tests/test_static_analysis.py counts these)."""
+
+import random
+import time
+
+
+def load_weights(path):
+    with open(path) as f:
+        rows = f.read().split()
+    # POSITIVE det-random: jitter drawn into the loaded weights.
+    return [float(r) + random.gauss(0.0, 0.01) for r in rows]
+
+
+def score(pods, matrix):
+    # POSITIVE det-wallclock: a decision input read from the wall clock.
+    freshness = time.time()
+    out = {}
+    for pod in pods:
+        # POSITIVE det-builtin-hash: salted hash() routes the matrix row.
+        row = matrix[hash(pod.workload_class) % len(matrix)]
+        out[pod.uid] = row[0] * freshness
+    # POSITIVE det-set-iteration: hash-ordered accel classes reach the
+    # output ranking.
+    for accel in {r[1] for r in matrix}:
+        out[accel] = 0
+    return out

@@ -1,0 +1,24 @@
+"""Determinism negative fixture for the heterogeneity score path: the
+allowed idioms — perf_counter for latency only, sorted() over the
+accel-class set, weights loaded verbatim from the committed artifact —
+produce zero findings."""
+
+import json
+import time
+
+
+def load_weights(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return tuple(tuple(float(x) for x in row) for row in doc["w1"])
+
+
+def score(pods, matrix):
+    t0 = time.perf_counter()  # latency metric, not a decision input
+    by_class = {wclass: row for wclass, row in matrix}
+    out = {}
+    for pod in pods:  # input order, stable uid keys
+        out[pod.uid] = by_class.get(pod.workload_class, ((), 0))
+    for accel in sorted({r[1] for r in matrix}):  # sets sort before use
+        out.setdefault(accel, 0)
+    return time.perf_counter() - t0, out

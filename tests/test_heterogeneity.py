@@ -1,0 +1,499 @@
+"""Heterogeneity-aware scheduling (ISSUE 14): accelerator-class node
+pools, the ThroughputAware throughput-matrix score op, the LearnedScorer
+fixed-weight MLP, and both profiles under the A/B oracle discipline —
+device scores match a pure-Python reference, same-seed streams replay,
+and an N=2 fleet binds bit-identical to the single scheduler."""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+from kubernetes_tpu.framework.config import (
+    Profile,
+    named_extra_profiles,
+    profile_scheduler_name,
+    validate_profile,
+)
+from kubernetes_tpu.loadgen.workloads import WorkloadMix
+from kubernetes_tpu.ops import learned as learned_mod
+from kubernetes_tpu.ops.throughput import (
+    ACCEL_LABEL_KEY,
+    DEFAULT_THROUGHPUT_MATRIX,
+    WORKLOAD_CLASS_LABEL_KEY,
+    preseed_hetero_vocab,
+    reference_scores,
+    throughput_aware_profile,
+)
+from kubernetes_tpu.ops.learned import learned_scorer_profile, load_weights
+from kubernetes_tpu.scheduler import TPUScheduler
+
+ACCELS = ("tpu-v4", "tpu-v5e", "gpu-a100")
+CLASSES = tuple(w for w, _row in DEFAULT_THROUGHPUT_MATRIX)
+
+
+def hetero_node(i: int, accel: str | None, cpu: str = "16"):
+    w = make_node(f"hn-{i}").capacity(
+        {"cpu": cpu, "memory": "64Gi", "pods": 110}
+    ).zone(f"zone-{i % 3}")
+    if accel:
+        w = w.label(ACCEL_LABEL_KEY, accel)
+    return w.obj()
+
+
+def class_pod(i: int, wclass: str | None, scheduler: str = "", cpu: str = "500m"):
+    w = make_pod(f"hp-{i}").req({"cpu": cpu, "memory": "1Gi"}).label(
+        "app", f"app-{i % 4}"
+    )
+    if wclass:
+        w = w.label(WORKLOAD_CLASS_LABEL_KEY, wclass)
+    if scheduler:
+        w = w.scheduler(scheduler)
+    return w.obj()
+
+
+def tp_only_profile() -> Profile:
+    return Profile(
+        name="tp-only",
+        filters=("NodeUnschedulable", "NodeResourcesFit"),
+        scorers=(("ThroughputAware", 1),),
+        throughput_matrix=DEFAULT_THROUGHPUT_MATRIX,
+    )
+
+
+# -- score parity vs the pure-Python reference ------------------------------
+
+
+@pytest.mark.parametrize("wclass", CLASSES)
+def test_throughput_scores_match_reference(wclass):
+    """Device per-node scores == the Gavel normalized-effective-throughput
+    oracle, for every matrix row, over labeled + unlabeled nodes."""
+    s = TPUScheduler(profile=tp_only_profile(), batch_size=8)
+    nodes = [hetero_node(i, a) for i, a in enumerate(ACCELS + (None,))]
+    for n in nodes:
+        s.add_node(n)
+    pod = class_pod(0, wclass)
+    res = s.propose_pod(pod)
+    assert res["feasible"] == [n.metadata.name for n in nodes]
+    assert res["scores"] == reference_scores(pod, nodes)
+
+
+def test_unknown_class_and_unlabeled_cluster_score_zero():
+    s = TPUScheduler(profile=tp_only_profile(), batch_size=8)
+    nodes = [hetero_node(i, a) for i, a in enumerate(ACCELS)]
+    for n in nodes:
+        s.add_node(n)
+    # A class no matrix row names scores 0 everywhere (and the reference
+    # agrees) — the op is a constant, so is_active may legally skip it.
+    pod = class_pod(1, "video-transcode")
+    assert reference_scores(pod, nodes) == [0, 0, 0]
+    out = s.propose_pod(pod)
+    assert set(out["scores"]) == {0}
+
+
+@pytest.mark.parametrize(
+    "wclass,best",
+    [("train-large", "tpu-v4"), ("serve", "tpu-v5e"), ("batch", "gpu-a100")],
+)
+def test_each_class_binds_its_best_accelerator(wclass, best):
+    """The heterogeneity-aware objective actually steers placement: each
+    workload class lands on the accelerator its matrix row ranks first
+    (per-class orderings DIFFER — what a class-agnostic scorer cannot
+    express)."""
+    s = TPUScheduler(profile=tp_only_profile(), batch_size=8)
+    by_accel = {}
+    for i, a in enumerate(ACCELS):
+        n = hetero_node(i, a)
+        by_accel[n.metadata.name] = a
+        s.add_node(n)
+    s.add_pod(class_pod(2, wclass))
+    out = s.schedule_all_pending()
+    assert by_accel[out[0].node_name] == best
+
+
+def test_profile_selected_by_scheduler_name():
+    """ThroughputAwareProfile registers beside the default: pods naming
+    it steer by throughput, default pods don't (the multi-profile map,
+    profile/profile.go:47)."""
+    s = TPUScheduler(
+        profile=Profile(
+            name="default-scheduler",
+            filters=("NodeUnschedulable", "NodeResourcesFit"),
+            scorers=(("NodeResourcesFit", 1),),
+        ),
+        profiles=[
+            dataclasses.replace(
+                throughput_aware_profile(),
+                filters=("NodeUnschedulable", "NodeResourcesFit"),
+                scorers=(("ThroughputAware", 1),),
+            )
+        ],
+        batch_size=8,
+    )
+    # v5e node is busier (less free cpu) so fit scoring prefers the v4
+    # node; serve's throughput row prefers v5e.
+    s.add_node(hetero_node(0, "tpu-v4", cpu="16"))
+    s.add_node(hetero_node(1, "tpu-v5e", cpu="8"))
+    s.add_pod(class_pod(3, "serve", scheduler="throughput-aware-scheduler"))
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "hn-1"  # throughput wins
+    s.add_pod(class_pod(4, "serve"))  # default profile: fit only
+    out = s.schedule_all_pending()
+    assert out[0].node_name == "hn-0"  # LeastAllocated wins
+
+
+# -- the learned scorer -----------------------------------------------------
+
+
+def learned_only_profile() -> Profile:
+    return Profile(
+        name="ls-only",
+        filters=("NodeUnschedulable", "NodeResourcesFit"),
+        scorers=(("LearnedScorer", 1),),
+        throughput_matrix=DEFAULT_THROUGHPUT_MATRIX,
+        learned_weights=load_weights(),
+    )
+
+
+def test_learned_scores_match_reference_and_replay():
+    s = TPUScheduler(profile=learned_only_profile(), batch_size=8)
+    nodes = [
+        hetero_node(0, "tpu-v4", cpu="16"),
+        hetero_node(1, "tpu-v5e", cpu="8"),
+        hetero_node(2, "gpu-a100", cpu="32"),
+        hetero_node(3, None, cpu="4"),
+    ]
+    for n in nodes:
+        s.add_node(n)
+    pod = class_pod(5, "train-large")
+    got = s.propose_pod(pod)["scores"]
+    assert got == learned_mod.reference_scores(pod, nodes, load_weights())
+    # Deterministic, run to run: a fresh scheduler (fresh compile)
+    # reproduces the scores bit for bit.
+    s2 = TPUScheduler(profile=learned_only_profile(), batch_size=8)
+    for n in nodes:
+        s2.add_node(n)
+    assert s2.propose_pod(class_pod(5, "train-large"))["scores"] == got
+
+
+def test_load_weights_rejects_bad_artifacts(tmp_path):
+    good = json.load(open(learned_mod.DEFAULT_WEIGHTS_PATH))
+
+    def write(doc):
+        p = tmp_path / "w.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    assert load_weights(write(good))  # the committed artifact round-trips
+    bad = dict(good, version=2)
+    with pytest.raises(ValueError, match="version"):
+        load_weights(write(bad))
+    bad = dict(good, w1=good["w1"][:-1])
+    with pytest.raises(ValueError, match="feature rows"):
+        load_weights(write(bad))
+    bad = dict(good, w2=good["w2"] + [0.1])
+    with pytest.raises(ValueError, match="entries"):
+        load_weights(write(bad))
+    bad = dict(good, b2=float("nan"))
+    with pytest.raises(ValueError, match="non-finite"):
+        load_weights(write(bad))
+
+
+def test_validate_profile_catches_hetero_config_errors():
+    p = Profile(name="x", scorers=(("ThroughputAware", 1),))
+    assert any("throughput_matrix is empty" in e for e in validate_profile(p))
+    p = Profile(name="x", scorers=(("LearnedScorer", 1),))
+    assert any("learned_weights is empty" in e for e in validate_profile(p))
+    p = Profile(
+        name="x",
+        throughput_matrix=(("a", ()),),
+    )
+    assert any("empty accelerator row" in e for e in validate_profile(p))
+    p = Profile(
+        name="x",
+        throughput_matrix=(("a", (("v4", -1),)),),
+    )
+    assert any("non-negative" in e for e in validate_profile(p))
+    # And the shipped profiles validate clean.
+    assert validate_profile(throughput_aware_profile()) == []
+    assert validate_profile(learned_scorer_profile()) == []
+
+
+def test_named_extra_profiles_round_trip():
+    (tp,) = named_extra_profiles("throughput-aware")
+    assert tp.name == profile_scheduler_name("throughput-aware")
+    (ls,) = named_extra_profiles("learned-scorer")
+    assert ls.name == profile_scheduler_name("learned-scorer")
+    assert named_extra_profiles("") == []
+    with pytest.raises(ValueError):
+        named_extra_profiles("nope")
+
+
+# -- the heterogeneous WorkloadMix ------------------------------------------
+
+
+def mix_fingerprint(seed: int, n: int = 60):
+    mix = WorkloadMix(
+        "hetero", seed=seed, scheduler_name="throughput-aware-scheduler"
+    )
+    out = []
+    for i in range(n):
+        p = mix.pod(i)
+        out.append(
+            (
+                p.metadata.name,
+                p.spec.scheduler_name,
+                tuple(sorted(p.metadata.labels.items())),
+            )
+        )
+    return out, dict(mix.counts)
+
+
+def test_hetero_mix_same_seed_is_bit_identical():
+    a, ca = mix_fingerprint(17)
+    b, cb = mix_fingerprint(17)
+    assert a == b and ca == cb
+    # Every template of the mix appears (the classes stay hot).
+    assert all(v > 0 for v in ca.values()), ca
+
+
+def test_hetero_mix_different_seed_diverges():
+    a, _ = mix_fingerprint(17)
+    b, _ = mix_fingerprint(18)
+    assert a != b
+
+
+def test_hetero_mix_same_seed_binds_identical():
+    """Scheduler-level determinism of the heterogeneous stream: the same
+    seeded mix through two fresh schedulers (mixed pools registered
+    both times) lands bit-identical bindings."""
+
+    def run():
+        s = TPUScheduler(
+            profile=throughput_aware_profile(), batch_size=16, chunk_size=4
+        )
+        preseed_hetero_vocab(s.builder)
+        for i in range(9):
+            s.add_node(hetero_node(i, ACCELS[i % 3]))
+        mix = WorkloadMix("hetero", seed=23)
+        for i in range(40):
+            s.add_pod(mix.pod(i))
+        s.schedule_all_pending(wait_backoff=True)
+        return {
+            uid: pr.node_name
+            for uid, pr in sorted(s.cache.pods.items())
+            if pr.bound
+        }
+
+    first = run()
+    assert first and first == run()
+
+
+# -- vocab pre-seed (the XLA-recompile satellite) ---------------------------
+
+
+def test_preseed_freezes_schema_before_hetero_traffic():
+    """After preseed_hetero_vocab, neither labeled nodes nor class-
+    labeled pods grow the schema — the first mid-window heterogeneous
+    pod cannot force an XLA recompile (the PR 9/PR 10 taint-vocab trap,
+    heterogeneity edition).  Idempotent by construction."""
+    s = TPUScheduler(profile=throughput_aware_profile(), batch_size=8)
+    preseed_hetero_vocab(s.builder)
+    preseed_hetero_vocab(s.builder)  # idempotent
+    for i in range(6):
+        s.add_node(hetero_node(i, ACCELS[i % 3]))
+    schema_before = s.builder.schema
+    for i, wclass in enumerate(CLASSES):
+        s.add_pod(class_pod(100 + i, wclass, scheduler="throughput-aware-scheduler"))
+    s.schedule_all_pending()
+    # The compiled-pass key is (profile, SCHEMA, res_col, active, ...) —
+    # an unchanged schema means no hetero-driven recompile.  (Pod label
+    # GROUPS still intern per label set, as for any workload; they ride
+    # the G bucket, untouched here.)
+    assert s.builder.schema == schema_before
+
+
+# -- the A/B oracle: N=2 fleet vs single scheduler --------------------------
+
+
+def hetero_scenario():
+    """The heterogeneous golden scenario: 9 mixed-pool nodes with uneven
+    capacity + 24 pods over every workload class (and a class-less
+    minority), so throughput scoring, fit scoring and tie-breaks all
+    engage."""
+    nodes = [
+        hetero_node(i, ACCELS[i % 3], cpu=("8" if i % 2 else "16"))
+        for i in range(9)
+    ]
+    pods = [
+        class_pod(i, CLASSES[i % 4] if i % 5 else None, cpu="900m")
+        for i in range(24)
+    ]
+    return nodes, pods
+
+
+def run_single_hetero(profile: Profile) -> dict:
+    sched = TPUScheduler(profile=profile, batch_size=8, chunk_size=1)
+    nodes, pods = hetero_scenario()
+    for n in nodes:
+        sched.add_node(n)
+    for p in pods:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    return {
+        uid: pr.node_name
+        for uid, pr in sorted(sched.cache.pods.items())
+        if pr.bound
+    }
+
+
+def run_fleet_hetero(profile: Profile, n_shards: int) -> dict:
+    smap = ShardMap(n_shards=n_shards, n_buckets=16)
+    owners = {
+        k: ShardOwner(
+            k,
+            TPUScheduler(profile=profile, batch_size=8, chunk_size=1),
+            smap,
+        )
+        for k in range(n_shards)
+    }
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    nodes, pods = hetero_scenario()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in pods:
+        router.add_pod(p)
+    router.schedule_all_pending(wait_backoff=True)
+    return router.bindings()
+
+
+def test_throughput_fleet_binds_bit_identical_to_single():
+    """The acceptance oracle: an N=2 fleet under ThroughputAwareProfile
+    reproduces the single scheduler's bindings byte for byte — the
+    static matrix-row normalizer keeps per-node scores partition-
+    independent, so the Tesserae compromise never engages."""
+    profile = throughput_aware_profile()
+    single = run_single_hetero(profile)
+    assert single  # the scenario actually binds
+    assert run_fleet_hetero(profile, 2) == single
+
+
+def test_learned_fleet_binds_bit_identical_to_single():
+    """Same oracle for the learned scorer: the unrolled float32 forward
+    pass is elementwise per node, so shard partitioning cannot perturb
+    a single score bit."""
+    profile = learned_scorer_profile()
+    single = run_single_hetero(profile)
+    assert single
+    assert run_fleet_hetero(profile, 2) == single
+
+
+# -- profile config (configv1) ----------------------------------------------
+
+
+def test_throughput_matrix_ships_in_profile_config(tmp_path):
+    """The KubeSchedulerConfiguration surface carries the matrix and the
+    weights file as pluginConfig args — validated at parse time."""
+    from kubernetes_tpu.__main__ import load_config
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [
+            {
+                "schedulerName": "hetero",
+                "plugins": {
+                    "score": {"enabled": [{"name": "ThroughputAware", "weight": 3}]}
+                },
+                "pluginConfig": [
+                    {
+                        "name": "ThroughputAware",
+                        "args": {
+                            "matrix": {
+                                "serve": {"tpu-v5e": 1000, "tpu-v4": 540},
+                                "batch": {"gpu-a100": 1000},
+                            }
+                        },
+                    },
+                    {
+                        "name": "LearnedScorer",
+                        "args": {
+                            "weightsFile": learned_mod.DEFAULT_WEIGHTS_PATH
+                        },
+                    },
+                ],
+            }
+        ],
+    }
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(doc))
+    cfg = load_config(str(path))
+    prof = cfg["profiles"][0]
+    assert prof.throughput_matrix == (
+        ("serve", (("tpu-v5e", 1000), ("tpu-v4", 540))),
+        ("batch", (("gpu-a100", 1000),)),
+    )
+    assert prof.learned_weights == load_weights()
+    assert ("ThroughputAware", 3) in prof.scorers
+    # A malformed matrix is a config-time error.
+    doc["profiles"][0]["pluginConfig"][0]["args"]["matrix"] = {"serve": {}}
+    path.write_text(json.dumps(doc))
+    with pytest.raises(Exception):
+        load_config(str(path))
+
+
+# -- Lease relist on the Reflector surface (the takeover rung) --------------
+
+
+def test_lease_relist_restores_and_replaces_heartbeats():
+    """"Lease" joins the reflected object surface: a LIST restores
+    host truth's current renewals into the lifecycle controller
+    (monotone), and leases absent from a relist drop their nodes from
+    tracking — the takeover driver's relist contract."""
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.informers import FakeSource, Reflector
+
+    s = TPUScheduler(batch_size=8)
+    s.add_node(hetero_node(0, None))
+    s.add_node(hetero_node(1, None))
+    src = FakeSource()
+    src.add("hn-0", t.Lease("hn-0", 5.0))
+    src.add("hn-1", t.Lease("hn-1", 3.0))
+    refl = Reflector(s, "Lease", src.lister, src.watcher)
+    refl.run_once()
+    assert s.node_lifecycle.heartbeats == {"hn-0": 5.0, "hn-1": 3.0}
+    # A stale stamp cannot rewind; a newer one advances.
+    src.update("hn-0", t.Lease("hn-0", 2.0))
+    src.delete("hn-1")
+    refl.step()
+    assert s.node_lifecycle.heartbeats == {"hn-0": 5.0}
+    # LIST-as-replace repairs a missed delete.
+    refl.run_once()
+    assert set(s.node_lifecycle.heartbeats) == {"hn-0"}
+
+
+def test_reconcile_after_recovery_accepts_lease_reflector():
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+
+    s = TPUScheduler(batch_size=8)
+    node = hetero_node(0, None)
+    src_n, src_p, src_l = FakeSource(), FakeSource(), FakeSource()
+    src_n.add(node.name, node)
+    src_l.add(node.name, t.Lease(node.name, 7.0))
+    stats = reconcile_after_recovery(
+        s,
+        Reflector(s, "Node", src_n.lister, src_n.watcher),
+        Reflector(s, "Pod", src_p.lister, src_p.watcher),
+        lease_reflector=Reflector(s, "Lease", src_l.lister, src_l.watcher),
+    )
+    assert stats["leases"] == 1
+    assert s.node_lifecycle.heartbeats == {node.name: 7.0}

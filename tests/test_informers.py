@@ -303,10 +303,13 @@ def test_reconcile_after_recovery_relists_object_catalogs():
 
 
 def test_kind_handlers_cover_the_plugin_surface():
-    # The generalized surface must carry every catalog the plugins read.
+    # The generalized surface must carry every catalog the plugins read,
+    # plus Lease (ISSUE 14's takeover rung: heartbeat state relists from
+    # host truth instead of re-deriving from a re-fed schedule).
     assert set(KIND_HANDLERS) == {
         "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
         "CSINode", "PodDisruptionBudget", "ResourceClaim", "ResourceSlice",
+        "Lease",
     }
 
 

@@ -129,14 +129,18 @@ def test_det_rules_fire_on_seeded_violations():
     # hottest-shard pick on top of the prior families' counts.
     # engine/badpack.py (ISSUE 13) seeds a bare-set chunk deal + a
     # hash()-bucketed slice assignment on top of the prior families'.
-    assert got.count("det-wallclock") == 4
-    assert got.count("det-random") == 4  # random.random/randrange + os.urandom + expovariate
-    assert got.count("det-set-iteration") == 4  # for-loops + list(set(...))
+    # ops/badthroughput.py (ISSUE 14) seeds a wallclock score input,
+    # weight-loader jitter, a hash()-routed matrix row and a bare-set
+    # accel-class ranking — the heterogeneity score/loader paths the
+    # determinism family must cover.
+    assert got.count("det-wallclock") == 5
+    assert got.count("det-random") == 5  # + gauss jitter in the weight loader
+    assert got.count("det-set-iteration") == 5  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
-    # bucketing (ISSUE 13): builtin hash() assigns different owners /
-    # slices per process.
-    assert got.count("det-builtin-hash") == 2
+    # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14): builtin
+    # hash() assigns different owners / slices / rows per process.
+    assert got.count("det-builtin-hash") == 3
 
 
 def test_det_rules_cover_loadgen():
