@@ -158,6 +158,7 @@ def _build_scheduler(args):
             feature_gates=cfg.get("feature_gates"),
             extenders=cfg.get("extenders"),
             queue=queue,
+            pipeline_depth=getattr(args, "pipeline_depth", 1),
         )
     else:
         from .framework.config import named_extra_profiles
@@ -165,6 +166,7 @@ def _build_scheduler(args):
         sched = TPUScheduler(
             batch_size=args.batch_size,
             chunk_size=args.chunk_size,
+            pipeline_depth=getattr(args, "pipeline_depth", 1),
             tenant_attribution=not getattr(args, "no_observability", False),
             # Named extra profiles (ISSUE 14: throughput-aware /
             # learned-scorer) registered beside the default; pods select
@@ -794,6 +796,14 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--config", default="")
     s.add_argument("--batch-size", type=int, default=256)
     s.add_argument("--chunk-size", type=int, default=1)
+    s.add_argument(
+        "--pipeline-depth", type=int, default=1, metavar="DEPTH",
+        help="software-pipeline the batch loop (ISSUE 15): depth 1 is "
+        "the serial parity configuration; depth 2 dispatches batch k+1 "
+        "before draining batch k's group-committed journal records, so "
+        "the fsync + apply stage runs under the in-flight device pass "
+        "(bindings bit-identical either way)",
+    )
     s.add_argument(
         "--profile", default="",
         choices=("", "default", "throughput-aware", "learned-scorer"),

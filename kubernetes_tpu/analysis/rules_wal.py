@@ -95,6 +95,11 @@ class WalRule(Rule):
             # made a transfer live without the acquiring owner's record
             # first would be un-redoable at the next takeover.
             "kubernetes_tpu/fleet/autoscaler.py",
+            # The pipelined commit drain (ISSUE 15): the batch loop's
+            # finish_binding apply sites moved here — every staged bind
+            # must journal (inside the group barrier) before the drain
+            # applies it.
+            "kubernetes_tpu/engine/pipeline.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:

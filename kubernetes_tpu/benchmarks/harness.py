@@ -57,11 +57,20 @@ def _throughput_percentiles(samples: list[float]) -> dict:
     }
 
 
-def run_workload(w: Workload, attach: Callable | None = None) -> dict:
+def run_workload(
+    w: Workload,
+    attach: Callable | None = None,
+    pipeline_depth: int | None = None,
+) -> dict:
     """``attach`` is called with the freshly built scheduler before any
     objects land — the hook bench.py uses to arm the write-ahead journal
-    so the headline run measures journaling overhead in-band."""
+    so the headline run measures journaling overhead in-band.
+    ``pipeline_depth`` overrides the scheduler's batch-loop pipelining
+    (ISSUE 15): depth 2 drains each batch's group-committed journal
+    records under the next batch's in-flight device pass."""
     sched = w.build()
+    if pipeline_depth is not None:
+        sched.pipeline_depth = max(1, int(pipeline_depth))
     if attach is not None:
         attach(sched)
     w.nodes(sched)
@@ -96,8 +105,9 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
             break
         out = sched.schedule_batch()
         if not out:
-            if len(sched.queue) or sched._prefetched is not None:
-                continue  # WaitOnPermit or prefetched batch; keep going
+            if len(sched.queue) or sched.has_inflight_work:
+                continue  # WaitOnPermit or in-flight (prefetched /
+                # predispatched) batch; keep going
             if w.wait_backoff and sched.queue.sleep_until_backoff():
                 continue
             break
@@ -161,11 +171,19 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
         v for k, v in phases.items()
         if k not in ("journal_append", "journal_fsync", "hint_decode")
     )
+    # With the pipeline on, tiled stage seconds can EXCEED wall time —
+    # the excess is the wall the overlap saved vs running the stages
+    # serially (coverage > 1.0 is the pipeline working, not a leak).
+    overlap_saved = max(tiled - dt, 0.0)
     phase_attribution = {
         "phases": phases,
         "tiled_s": round(tiled, 6),
         "wall_s": round(dt, 6),
         "coverage": round(tiled / dt, 4) if dt > 0 else 0.0,
+        "overlap": {
+            "saved_s": round(overlap_saved, 6),
+            "coverage": round(overlap_saved / tiled, 4) if tiled > 0 else 0.0,
+        },
     }
 
     return {
@@ -195,6 +213,27 @@ def run_workload(w: Workload, attach: Callable | None = None) -> dict:
         "dom_carry": {
             "hits": m.dom_carry_hits,
             "rebuilds": m.dom_carry_rebuilds,
+        },
+        # Software pipeline (ISSUE 15): predispatch double-buffer hits vs
+        # invalidations, drain placement, and the wall seconds overlap
+        # saved over the measured window.
+        "pipeline": {
+            "depth": sched.pipeline_depth,
+            "predispatch_hits": int(
+                sched._pipeline_predispatch_counter.get(result="hit")
+            ),
+            "predispatch_invalidated": int(
+                sched._pipeline_predispatch_counter.get(result="invalidated")
+            ),
+            "drains_overlapped": int(
+                sched._pipeline_drain_counter.get(kind="overlapped")
+            ),
+            "drains_inline": int(
+                sched._pipeline_drain_counter.get(kind="inline")
+            ),
+            "overlap_saved_s": round(
+                sched._pipeline_overlap_counter.total(), 6
+            ),
         },
         # Registry summary over the measured window: per-extension-point
         # p50/p99, attempt-duration and SLI histograms (with overflow
@@ -1229,7 +1268,9 @@ _register(
 )
 
 
-def main(names: list[str] | None = None) -> list[dict]:
+def main(
+    names: list[str] | None = None, pipeline_depth: int | None = None
+) -> list[dict]:
     if names:
         unknown = [n for n in names if n not in WORKLOADS]
         if unknown:
@@ -1240,13 +1281,15 @@ def main(names: list[str] | None = None) -> list[dict]:
     for name, w in WORKLOADS.items():
         if names and name not in names:
             continue
-        r = run_workload(w)
+        r = run_workload(w, pipeline_depth=pipeline_depth)
         print(json.dumps(r), flush=True)
         results.append(r)
     return results
 
 
-def main_isolated(names: list[str] | None = None) -> list[dict]:
+def main_isolated(
+    names: list[str] | None = None, pipeline_depth: int | None = None
+) -> list[dict]:
     """Run each workload in a FRESH subprocess — the sweep analog of
     scheduler_perf's per-case process isolation.  A long-lived process
     accumulates host allocator/GC pressure that degrades later workloads
@@ -1275,10 +1318,21 @@ def main_isolated(names: list[str] | None = None) -> list[dict]:
             if name in INTEGRATED
             else "kubernetes_tpu.benchmarks.harness"
         )
-        proc = subprocess.run(
-            [_sys.executable, "-m", module, name],
-            capture_output=True, text=True,
-        )
+        argv = [_sys.executable, "-m", module, name]
+        if pipeline_depth is not None and module.endswith("harness"):
+            argv += ["--pipeline-depth", str(pipeline_depth)]
+        elif pipeline_depth is not None:
+            # INTEGRATED rows drive a serve child per-pod over the wire;
+            # the depth knob is not threaded through that deployment yet
+            # (ROADMAP's pipeline follow-up) — say so rather than let a
+            # sweep read as uniformly depth-N.
+            print(
+                f"harness: {name} is an integrated row — "
+                f"--pipeline-depth {pipeline_depth} not applied "
+                "(serve child runs at default depth)",
+                file=_sys.stderr,
+            )
+        proc = subprocess.run(argv, capture_output=True, text=True)
         line = ""
         for ln in proc.stdout.splitlines():
             ln = ln.strip()
@@ -1297,11 +1351,17 @@ if __name__ == "__main__":
     import sys
 
     args = sys.argv[1:]
+    depth = None
+    if "--pipeline-depth" in args:
+        i = args.index("--pipeline-depth")
+        depth = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
     if args and args[0] == "--isolated":
-        main_isolated(args[1:] or None)
+        main_isolated(args[1:] or None, pipeline_depth=depth)
     elif len(args) == 1:
-        main(args)  # single workload: in-process (the subprocess leaf)
+        # single workload: in-process (the subprocess leaf)
+        main(args, pipeline_depth=depth)
     elif not args:
-        main_isolated(None)  # default sweep: per-workload isolation
+        main_isolated(None, pipeline_depth=depth)  # default sweep
     else:
-        main(args)
+        main(args, pipeline_depth=depth)

@@ -1,0 +1,251 @@
+"""Software-pipelined batch commit: staged binds, group-commit drain,
+and the predispatch double buffer (ISSUE 15).
+
+The serial batch loop interleaves three kinds of work that have no data
+dependence on each other once the device pass has been dispatched:
+
+- **featurize(k+1)** — host CPU building the next batch's feature rows
+  (already overlapped by the scheduler's prefetch since PR 6);
+- **device(k)** — the compiled pass, running asynchronously on the
+  accelerator from dispatch until the completion fetch;
+- **commit/journal(k-1)** — host bookkeeping plus the write-ahead
+  journal's durability barrier (the fsync bill BENCH_r06 measured at
+  37.8s of a 76.2s wall).
+
+This module supplies the two pieces that turn the loop into a real
+pipeline (the generalization of PR 6's ``post_dispatch_hook``
+amortization into a stage engine):
+
+- :class:`CommitTicket` / :func:`drain_commit` — the commit stage is
+  SPLIT.  ``_complete_batch`` stages every bind (reserve plugins run,
+  cache assumed, outcome built) into a ticket; ``drain_commit`` then
+  journals the whole ticket inside ONE ``journal.group()`` barrier and
+  applies the binds only after the group's single fsync has returned —
+  journal-before-apply preserved strictly, at group scope (tpulint's
+  WAL family checks this file).  At pipeline depth 1 the drain runs at
+  exactly the point the serial loop applied binds inline; at depth >= 2
+  the scheduler dispatches batch k+1 FIRST, so the fsync and the apply
+  loop execute under the in-flight device pass.
+
+- :class:`Predispatch` / :func:`predispatch_valid` — the double buffer
+  for the dispatch stage: batch k+1 (already featurized by the
+  prefetch) is dispatched at the END of batch k's cycle, before the
+  drain, so the device is never idle while the host commits.  The
+  predispatched pass ran against the host state visible at dispatch
+  time; ``predispatch_valid`` re-checks every token that state could
+  have changed under (feature version, mutation epoch, schema, dirty
+  rows, live nominations) when the next cycle picks the pass up — a
+  mismatch discards the pass, rolls the tie-break cycle counter back,
+  and re-dispatches exactly as the serial loop would have, so bindings
+  stay bit-identical to pipeline depth 1 (the parity oracle).
+
+Determinism: this module decides nothing — staging order is the
+serial loop's entry order, the drain applies in that order, and every
+validity token is a pure function of scheduler state (the determinism
+lint family covers this file like the rest of ``engine/``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..framework.events import NORMAL
+from ..journal import _crash
+
+
+@dataclass
+class StagedBind:
+    """One bind that passed Permit + Reserve and awaits its group's
+    durability barrier.  ``outcome`` is the ScheduleOutcome already in
+    the batch's outcome list (node set optimistically at stage time; a
+    same-batch race rollback clears it and unstages the bind)."""
+
+    qp: object  # QueuedPodInfo
+    node_name: str
+    outcome: object  # ScheduleOutcome
+    # Once-only accounting ran (gang quorum credit, counters, events):
+    # a resumed drain may replay a partially applied bind's idempotent
+    # state steps, but must never credit it twice.
+    counted: bool = False
+
+
+@dataclass
+class CommitTicket:
+    """The staged commit group of one batch: binds whose journal records
+    and applies drain together under one group fsync."""
+
+    staged: list = field(default_factory=list)
+    # Batch commit clock (time.monotonic at phase 1) — latency samples
+    # and first/last-scheduled stamps use it so a deferred drain reports
+    # the same numbers the inline apply would have.
+    now: float = 0.0
+    drained: bool = False
+    # Drain progress: staged[:journaled] have records WRITTEN to the
+    # log, barriered means the group's fsync RETURNED (written is not
+    # durable), staged[:applied] are live.  A drain interrupted by an
+    # exception (deposed-writer fence, fsync OSError) leaves drained
+    # False with these markers on the completed prefix, so the recovery
+    # drain resumes exactly what remains — never re-journaling, never
+    # silently abandoning the group, and never applying ahead of a
+    # barrier that has not actually returned.
+    journaled: int = 0
+    barriered: bool = False
+    applied: int = 0
+    # Membership index (never iterated): rollback paths and the
+    # scheduler's metrics loop ask "is this uid staged?".
+    _uids: set = field(default_factory=set)
+
+    def stage(self, qp, node_name: str, outcome) -> None:
+        self.staged.append(StagedBind(qp, node_name, outcome))
+        self._uids.add(qp.pod.uid)
+
+    def unstage(self, uid: str) -> None:
+        """Remove a bind a same-batch race rolled back (its record was
+        never journaled; nothing to undo on the log)."""
+        self._uids.discard(uid)
+        self.staged = [sb for sb in self.staged if sb.qp.pod.uid != uid]
+
+    def holds(self, uid: str) -> bool:
+        return uid in self._uids
+
+    def __len__(self) -> int:
+        return len(self.staged)
+
+
+def drain_commit(sched, ticket: CommitTicket) -> float:
+    """Journal + apply one staged commit group.  Returns the drain's
+    host seconds (the flight recorder's ``drain`` stage segment).
+
+    Ordering contract (the WAL family's apply sites live here):
+
+    1. every staged bind's record is appended inside ONE
+       ``journal.group()`` — written and flushed, fsync deferred;
+    2. the group barrier returns — all records durable in one fsync;
+    3. only then does any bind apply (spec mutation, finish_binding,
+       queue bookkeeping, events/metrics), in stage order.
+
+    A crash before or inside the barrier applied nothing; recovery
+    replays the durable prefix and reschedules the rest — the
+    pipeline cells of scripts/run_fault_matrix.py probe exactly these
+    windows (stage-boundary / mid-group-fsync / post-group-fsync /
+    torn-group-tail).
+
+    An in-process EXCEPTION mid-drain (epoch fence, fsync error) leaves
+    ``drained`` False with the ticket's journaled/applied counters
+    marking the completed prefix: the group's `__exit__` has already
+    made that prefix durable, and a retry (the recovery path's
+    ``_drain_pending``) resumes from the counters — never re-journaling
+    a record, never reporting an unapplied bind as committed.
+    """
+    if ticket.drained:
+        return 0.0
+    if not ticket.staged:
+        ticket.drained = True
+        return 0.0
+    t0 = time.perf_counter()
+    # The commit stage is fully staged, nothing journaled yet — the
+    # stage-boundary crash window (at depth >= 2 a device pass for the
+    # NEXT batch is typically in flight right now).
+    _crash("stage-boundary")
+    journal = sched.journal
+    if journal is not None and not ticket.barriered:
+        if ticket.journaled < len(ticket.staged):
+            with journal.group():
+                for sb in ticket.staged[ticket.journaled :]:
+                    sched._journal_bind(sb.qp.pod, sb.node_name)
+                    ticket.journaled += 1
+        else:
+            # Every record is already written; only the group's fsync
+            # raised on the last attempt.  Re-entering group() would see
+            # zero pending appends and skip the fsync — re-run the
+            # barrier explicitly instead.
+            journal.barrier()
+        ticket.barriered = True
+    # Group fsync returned: every record in the group is durable.
+    # Apply in stage order — identical to the serial loop's inline
+    # order, just batched behind the single barrier.
+    m = sched.metrics
+    now = ticket.now
+    for sb in ticket.staged[ticket.applied :]:
+        qp, node_name = sb.qp, sb.node_name
+        # State steps — each idempotent, so a resume may replay a
+        # partially applied bind from the top.
+        qp.pod.spec.node_name = node_name
+        sched.cache.finish_binding(qp.pod.uid)
+        # Self-placed pods get their NoExecute judgment at bind (the
+        # reference's handlePodUpdate fires on the binding update).
+        sched.taint_eviction.handle_pod_assigned(qp.pod, node_name)
+        sched.queue.done(qp.pod.uid)
+        if not sb.counted:
+            sb.counted = True
+            # Gang quorum credit first (state-critical), observational
+            # accounting after — a fault below loses at most one bind's
+            # metrics, never credit, and a resume never double-counts.
+            if qp.pod.spec.pod_group:
+                sched.gang_bound[qp.pod.spec.pod_group] = (
+                    sched.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
+                )
+            if m.scheduled == 0:
+                m.first_scheduled_ts = now
+            m.scheduled += 1
+            m.last_scheduled_ts = now
+            sched._note_bound(qp.pod, node_name)
+            sched.recorder.event(
+                qp.pod.uid, NORMAL, "Scheduled",
+                f"Successfully assigned {qp.pod.uid} to {node_name}",
+            )
+            lat = now - qp.initial_attempt_timestamp
+            m.e2e_latency_samples.append(lat)
+            m.registry.scheduling_sli.observe(lat)
+        ticket.applied += 1
+    ticket.drained = True
+    return time.perf_counter() - t0
+
+
+@dataclass
+class Predispatch:
+    """A device pass dispatched one cycle early (the double buffer).
+
+    ``infos`` is the batch in its ORIGINAL pop order (the packer may
+    have permuted ``ctx['infos']``; an invalidated predispatch must
+    re-dispatch from the unpermuted order or the re-pack would see
+    pre-permuted input and diverge from the serial loop)."""
+
+    infos: list
+    ctx: dict
+    profile: object
+    # Validity tokens, captured at dispatch:
+    version: tuple  # builder.feature_version()
+    mutation_epoch: int
+    schema: object
+    nominator_token: tuple
+    cycle0: int  # _cycle before the dispatch (rollback target)
+    t_dispatch: float = 0.0
+
+
+def nominator_token(sched) -> tuple:
+    """Stable fingerprint of the live nominations a dispatch read
+    (_full_inv's nom_* arrays and _inject_nomrows both depend on them):
+    any change between predispatch and pickup must invalidate."""
+    return tuple(
+        sorted(
+            (uid, node, prio)
+            for uid, (node, _delta, prio) in sched.nominator.items()
+        )
+    )
+
+
+def predispatch_valid(sched, pd: Predispatch) -> bool:
+    """True when nothing the predispatched pass read has changed since
+    dispatch — the pass's decisions are exactly what a fresh dispatch
+    would compute, so the pipeline may complete it as-is."""
+    b = sched.builder
+    return (
+        pd.version == b.feature_version()
+        and pd.mutation_epoch == b.mutation_epoch
+        and pd.schema == b.schema
+        and not b._dirty_all
+        and not b._dirty_rows
+        and pd.nominator_token == nominator_token(sched)
+    )

@@ -199,6 +199,20 @@ KILL_POINTS = (
     # enforcement finishes the interrupted drop).
     "post-handoff-append",
     "mid-drop",
+    # Group-commit / pipeline windows (ISSUE 15, engine/pipeline.py +
+    # journal.py group()): the commit stage is staged but nothing
+    # journaled yet (stage-boundary — the drain is about to run, often
+    # under an in-flight device pass), the group's records are written
+    # but the single fsync barrier has not returned (mid-group-fsync —
+    # none of the group applied), the barrier returned but the applies
+    # have not run (post-group-fsync — durable, unapplied: replay makes
+    # the whole group live), and the group's LAST record torn mid-write
+    # (torn-group-tail — open-time repair truncates it; the complete
+    # prefix replays).
+    "stage-boundary",
+    "mid-group-fsync",
+    "post-group-fsync",
+    "torn-group-tail",
 )
 
 

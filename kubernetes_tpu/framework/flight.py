@@ -212,10 +212,12 @@ def load_dump(path: str) -> dict:
 # from tiling, same list profile_report uses.
 TILED_EXCLUDE = ("journal_append", "journal_fsync", "hint_decode")
 # Canonical within-batch tiling order for the critical-path sweep;
-# phases not listed sort after, alphabetically.
+# phases not listed sort after, alphabetically.  predispatch (the next
+# batch's early dispatch) and drain (the group-committed journal fsync +
+# applies) are the pipeline stages ISSUE 15 added after commit.
 PHASE_ORDER = (
     "featurize", "eval", "device", "scatter", "select", "commit",
-    "snapshot", "other",
+    "predispatch", "drain", "snapshot", "other",
 )
 
 # Deterministic record fields the merged timeline keeps (everything

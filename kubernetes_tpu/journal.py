@@ -15,6 +15,18 @@ double-bind on restart.  This module is the etcd stand-in:
   decision it described was never applied, so dropping it is exactly
   the etcd semantics of an unacknowledged write.
 
+- Group commit (ISSUE 15): ``with journal.group():`` batches the
+  appends of one commit stage into ONE fsync at group exit — the
+  classic WAL group-commit optimization (one durability barrier per
+  batch instead of one per binding).  Journal-before-apply is
+  preserved STRICTLY: callers stage their applies and run them only
+  after ``group()`` returns, so no decision in the group is applied
+  until the group's single fsync has returned.  A crash inside the
+  group leaves a clean prefix (possibly with a torn tail the open-time
+  repair truncates); none of the group's decisions were applied, so
+  recovery replays exactly the acknowledged prefix — unacknowledged
+  appends were never made live.
+
 - Epoch fencing: every record is stamped with the holder's lease epoch
   (framework/leaderelection.py FileLease.epoch).  Appends check the
   fence (the lease file's current epoch) and the log's own running
@@ -111,6 +123,16 @@ class Journal:
         self.fsyncs = 0
         self.fsync_s = 0.0  # cumulative append-path fsync seconds
         self.fenced = 0  # appends rejected by the epoch fence
+        # Group commit (ISSUE 15): appends made inside a `with
+        # journal.group():` block defer their fsync to ONE barrier at
+        # group exit.  _group_depth nests (an inner group rides the
+        # outermost barrier); _group_pending counts records awaiting it.
+        self._group_depth = 0
+        self._group_pending = 0
+        self.group_commits = 0  # barriers that fsync'd >= 1 record
+        self.group_appends = 0  # appends whose fsync was deferred
+        self.last_group_size = 0
+        self.max_group_size = 0
         self.snapshots = 0
         self.truncations = 0
         self.replayed = 0  # records applied by the last replay()
@@ -214,10 +236,30 @@ class Journal:
             self._f.flush()
             os.fsync(self._f.fileno())
             c.fire()
+        if (
+            self._group_depth
+            and c is not None
+            and c.should_fire("torn-group-tail")
+        ):
+            # Crash mid-write INSIDE a group: earlier group records are
+            # complete (written, unfsynced), this one is torn — the
+            # torn-group-tail shape.  None of them were applied (applies
+            # wait for the group fsync), so recovery's prefix replay +
+            # idempotent re-run must converge on identical bindings.
+            self._f.write(buf[: _HDR.size + max(1, len(payload) // 2)])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            c.fire()
         t0 = time.perf_counter()
         self._f.write(buf)
         self._f.flush()
-        if self.fsync_enabled:
+        if self._group_depth:
+            # Group commit: durability deferred to the group's single
+            # fsync barrier (group_commit) — the caller must not apply
+            # this decision until that barrier returns.
+            self._group_pending += 1
+            self.group_appends += 1
+        elif self.fsync_enabled:
             tf = time.perf_counter()
             os.fsync(self._f.fileno())
             self.fsync_s += time.perf_counter() - tf
@@ -228,6 +270,65 @@ class Journal:
         self._expected_size = self._f.tell()
         _crash("post-append")
         return self.seq
+
+    # -- group commit (ISSUE 15) -------------------------------------------
+
+    def group(self) -> "_JournalGroup":
+        """One fsync barrier for every append made inside the block::
+
+            with journal.group():
+                for decision in batch:
+                    journal.append(...)   # written, fsync deferred
+            # barrier returned: the whole group is durable — apply now.
+
+        Nested groups ride the outermost barrier.  With fsync disabled
+        the barrier is a no-op (same durability trade the fsync knob
+        already documents); muted journals skip everything.
+        """
+        return _JournalGroup(self)
+
+    def _group_begin(self) -> None:
+        self._group_depth += 1
+
+    def _group_commit(self) -> None:
+        """Leave the group; at the outermost exit, fsync ONCE for every
+        record appended inside.  Applies staged on this group must run
+        only after this returns — journal-before-apply at group scope."""
+        self._group_depth -= 1
+        if self._group_depth > 0:
+            return
+        pending, self._group_pending = self._group_pending, 0
+        if not pending:
+            return
+        self.last_group_size = pending
+        self.max_group_size = max(self.max_group_size, pending)
+        # The group's records are written (flushed) but not yet durable;
+        # a SIGKILL here must recover to the same bindings with NONE of
+        # the group applied.
+        _crash("mid-group-fsync")
+        if self.fsync_enabled:
+            tf = time.perf_counter()
+            os.fsync(self._f.fileno())
+            self.fsync_s += time.perf_counter() - tf
+            self.fsyncs += 1
+        self.group_commits += 1
+        # Durable but not yet applied — the post-append analog at group
+        # scope: recovery replays the whole group.
+        _crash("post-group-fsync")
+
+    def barrier(self) -> None:
+        """Re-run a durability barrier: fsync everything written so far
+        (fsync is file-wide and idempotent).  The drain-resume path uses
+        it when a group's records were ALL appended but the group's own
+        fsync raised — re-entering ``group()`` would see zero pending
+        appends and skip the fsync, silently acknowledging undurable
+        records."""
+        if self.fsync_enabled:
+            tf = time.perf_counter()
+            os.fsync(self._f.fileno())
+            self.fsync_s += time.perf_counter() - tf
+            self.fsyncs += 1
+        self.group_commits += 1
 
     def snapshot(self, state: dict) -> None:
         """Checkpoint the full scheduler state and truncate the log at the
@@ -360,6 +461,10 @@ class Journal:
             "fsyncs": self.fsyncs,
             "fsync_s": round(self.fsync_s, 6),
             "fenced": self.fenced,
+            "group_commits": self.group_commits,
+            "group_appends": self.group_appends,
+            "last_group_size": self.last_group_size,
+            "max_group_size": self.max_group_size,
             "snapshots": self.snapshots,
             "truncations": self.truncations,
             "replayed": self.replayed,
@@ -376,6 +481,24 @@ class Journal:
             self._f.close()
         except OSError:
             pass
+
+
+class _JournalGroup:
+    """Context manager for one group-commit barrier (Journal.group).
+    Exceptions still commit the records already appended — a half-staged
+    batch's durable prefix is acknowledged state the recovery replay
+    must see (dropping it would forget fsync-pending decisions whose
+    bytes may already be on disk)."""
+
+    def __init__(self, journal: Journal):
+        self._j = journal
+
+    def __enter__(self) -> Journal:
+        self._j._group_begin()
+        return self._j
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._j._group_commit()
 
 
 # -- scheduler state <-> snapshot documents --------------------------------

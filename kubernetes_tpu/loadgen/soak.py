@@ -150,6 +150,10 @@ class SoakConfig:
     batch_size: int = 512
     chunk_size: int = 64
     warm_pods: int = 256
+    # Software pipeline (ISSUE 15): depth 1 = serial parity; depth 2
+    # overlaps the group-committed journal drain with the next batch's
+    # in-flight device pass (bindings bit-identical either way).
+    pipeline_depth: int = 1
     # Deployment.
     two_process: bool = False
     journal_dir: str = ""  # empty → a temp dir (two-process always journals)
@@ -910,6 +914,7 @@ def _spawn_serve(cfg: SoakConfig, sock: str, journal_dir: str, out_dir: str):
         "--journal-dir", journal_dir,
         "--journal-fsync", cfg.journal_fsync,
         "--snapshot-every", str(cfg.snapshot_every),
+        "--pipeline-depth", str(cfg.pipeline_depth),
     ] + (["--profile", cfg.profile] if cfg.profile else []) + _lifecycle_argv(cfg)
     return _launch_serve(argv, out_dir, sock, "serve", deadline_s=180.0)
 
@@ -949,6 +954,7 @@ def run_soak(cfg: SoakConfig) -> dict:
             sock,
             batch_size=cfg.batch_size,
             chunk_size=cfg.chunk_size,
+            pipeline_depth=cfg.pipeline_depth,
             profiles=named_extra_profiles(cfg.profile),
             speculate=True,
             journal=journal,

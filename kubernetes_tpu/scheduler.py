@@ -138,6 +138,7 @@ class TPUScheduler:
         inline_preempt_commit: bool | None = None,
         flight_capacity: int = 4096,
         tenant_attribution: bool = True,
+        pipeline_depth: int = 1,
     ):
         from .framework.features import DEFAULT_GATES
 
@@ -388,10 +389,35 @@ class TPUScheduler:
         self.consistency_check_every = consistency_check_every
         # Prefetched next batch: (infos, featurize work) — schedule_batch
         # featurizes batch k+1 while the device crunches batch k.  The
-        # speculative sidecar frontend disables this (its batches run
-        # synchronously inside a request; a prefetch would strand pods).
+        # speculative sidecar frontend counts these uids among its
+        # in-flight set (speculate._prefetched_uids) so hint admission
+        # never double-commits a prefetched pod.
         self._prefetched: tuple | None = None
         self._prefetch_enabled = True
+        # Software pipeline (ISSUE 15, engine/pipeline.py): depth 1 is
+        # the serial loop (the parity oracle) — commits stage + drain at
+        # exactly the inline-apply point, one group fsync per batch.
+        # Depth >= 2 additionally dispatches batch k+1 BEFORE draining
+        # batch k's staged commit group, so the fsync and the apply loop
+        # run under the in-flight device pass (featurize(k+1) already
+        # overlaps device(k) via the prefetch).  Bindings stay
+        # bit-identical across depths: the predispatched pass is
+        # discarded and re-dispatched whenever any state it read changed
+        # (engine/pipeline.predispatch_valid).
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # The current batch's staged commit group (engine/pipeline.py
+        # CommitTicket) — never outlives its schedule_batch call.
+        self._pending_ticket = None
+        # A device pass dispatched one cycle early (Predispatch), picked
+        # up by the next schedule_batch.
+        self._predispatched = None
+        # Adaptive predispatch gate: every invalidated predispatch threw
+        # away a full device pass and re-dispatched (churn workloads
+        # mutate host state between EVERY batch, so the double buffer
+        # only doubles device cost there).  Consecutive invalidations
+        # back the gate off — skip-and-decay halves the retry rate under
+        # sustained churn while recovering immediately once hits return.
+        self._pd_consec_invalid = 0
         # Called between the async device dispatch and the blocking fetch
         # of each batch — host work done here (the speculative frontend's
         # hint parse/build) hides under the in-flight pass.
@@ -555,6 +581,26 @@ class TPUScheduler:
             "Pods bound per scheduler profile (the multi-profile map's "
             "serving split).",
         )
+        # Software pipeline (ISSUE 15): predispatch double-buffer hits vs
+        # invalidations (a miss re-dispatches serially — correctness is
+        # free, overlap is not), drain placement (overlapped under an
+        # in-flight pass vs inline at the serial point), and the wall
+        # seconds the overlap actually saved (per-batch stage sum minus
+        # batch wall, the flight recorder's overlap-coverage numerator).
+        self._pipeline_predispatch_counter = reg.counter(
+            "scheduler_pipeline_predispatch_total",
+            "Predispatched device passes by pickup result "
+            "(hit/invalidated).",
+        )
+        self._pipeline_drain_counter = reg.counter(
+            "scheduler_pipeline_drains_total",
+            "Staged commit-group drains by placement (overlapped/inline).",
+        )
+        self._pipeline_overlap_counter = reg.counter(
+            "scheduler_pipeline_overlap_saved_seconds_total",
+            "Wall seconds saved by stage overlap (serial stage sum minus "
+            "batch wall, clamped at zero).",
+        )
         # Poison-batch recovery observability: how often the engine raised
         # mid-batch and how many pods ended up isolated.  The quarantine
         # DEPTH rides scheduler_pending_pods{queue="quarantine"} below.
@@ -679,6 +725,15 @@ class TPUScheduler:
             "scheduler_journal_fenced_total",
             "Appends rejected by the lease-epoch fence (deposed writer).",
         )
+        group_commits = reg.counter(
+            "scheduler_journal_group_commits_total",
+            "Group-commit fsync barriers (one durability fsync per "
+            "staged commit group).",
+        )
+        group_size = reg.gauge(
+            "scheduler_journal_last_group_size",
+            "Records covered by the last group-commit fsync barrier.",
+        )
         snaps = reg.counter(
             "scheduler_journal_snapshots_total",
             "Checkpoints written (log truncated at each barrier).",
@@ -701,6 +756,8 @@ class TPUScheduler:
             appends.set(j.appends)
             fsyncs.set(j.fsyncs)
             fenced.set(j.fenced)
+            group_commits.set(j.group_commits)
+            group_size.set(j.last_group_size)
             snaps.set(j.snapshots)
             replayed.set(j.replayed)
             seq_g.set(j.seq)
@@ -817,6 +874,115 @@ class TPUScheduler:
         if acc is not None:
             acc[key] = acc.get(key, 0) + n
 
+    def _flight_phase(self, key: str, secs: float) -> None:
+        """Accumulate one tiled phase segment (drain/predispatch — the
+        pipeline stages recorded outside _complete_batch's tiling)."""
+        acc = self._flight_acc
+        if acc is not None and secs > 0:
+            ph = acc["phases"]
+            ph[key] = ph.get(key, 0.0) + secs
+
+    # -- software pipeline (ISSUE 15, engine/pipeline.py) ---------------------
+
+    def _pipeline_active(self) -> bool:
+        """Deferred drain + predispatch apply only on the single-profile
+        batch path: multi-profile groups, extender chains, and truncated
+        (parity) mode keep the serial order — depth 1 everywhere."""
+        return (
+            self.pipeline_depth >= 2
+            and not self._truncated
+            and len(self.profiles) == 1
+            and not self.extenders
+        )
+
+    @property
+    def has_inflight_work(self) -> bool:
+        """Work popped from the queue but not yet completed: a prefetched
+        (featurized) batch or a predispatched device pass.  Drivers that
+        loop on queue length must also drain these."""
+        return self._prefetched is not None or self._predispatched is not None
+
+    def _drain_pending(self, overlapped: bool) -> float:
+        """Drain the current staged commit group (group fsync + applies,
+        engine/pipeline.drain_commit).  Returns the drain's host seconds;
+        records the `drain` flight phase and the placement counter."""
+        ticket = self._pending_ticket
+        if ticket is None or ticket.drained:
+            return 0.0
+        from .engine.pipeline import drain_commit
+
+        drain_s = drain_commit(self, ticket)
+        # Fully drained: release the scheduler's reference so an idle
+        # process does not pin the last batch's pods/outcomes until the
+        # next batch overwrites the slot.  (A mid-drain exception leaves
+        # the ticket in place with its progress counters — the recovery
+        # drain resumes it.)
+        self._pending_ticket = None
+        if ticket.staged:
+            self._flight_phase("drain", drain_s)
+            self._pipeline_drain_counter.inc(
+                kind="overlapped" if overlapped else "inline"
+            )
+        return drain_s
+
+    def _predispatch_next(self, tr) -> bool:
+        """Dispatch the prefetched batch k+1 NOW (before batch k's drain)
+        so the drain's fsync + applies run under the in-flight device
+        pass.  The pass is picked up — or invalidated and re-dispatched —
+        by the next schedule_batch (engine/pipeline.predispatch_valid).
+        Returns whether a pass was dispatched."""
+        pre = self._prefetched
+        if pre is None:
+            return False
+        if self._pd_consec_invalid > 0:
+            # Churn regime: a recent predispatch was thrown away at
+            # pickup — it cost a whole wasted device pass.  Sit this
+            # batch out and decay, so sustained churn converges to ~one
+            # probe per penalty window instead of doubling device time
+            # every batch, while a single transient mutation costs only
+            # a few skipped overlaps.
+            self._pd_consec_invalid -= 1
+            return False
+        infos, work = pre
+        if work["version"] != self.builder.feature_version():
+            return False  # stale featurization: let the serial path redo it
+        from .engine.pipeline import Predispatch, nominator_token
+
+        self._prefetched = None
+        cycle0 = self._cycle
+        t_pd = time.perf_counter()
+        try:
+            # _dispatch_batch may permute its local infos (the packer);
+            # keep OUR list in original pop order for re-dispatch.  The
+            # packer also rebinds work["batch"]/work["deltas"] on the
+            # dict it is handed — dispatch a shallow COPY so a failure
+            # below cannot restore a work dict whose rows were permuted
+            # while infos kept pop order (the serial retry would read
+            # each pod against another pod's feature row).
+            ctx = self._dispatch_batch(list(infos), self.profile, dict(work))
+        except Exception:
+            # A dispatch failure (engine fault) must surface inside the
+            # VICTIM batch's own cycle for recovery attribution: restore
+            # the pop and let the next cycle dispatch serially.
+            self._cycle = cycle0
+            self._prefetched = (infos, work)
+            return False
+        self._predispatched = Predispatch(
+            infos=list(infos),
+            ctx=ctx,
+            profile=self.profile,
+            version=self.builder.feature_version(),
+            mutation_epoch=self.builder.mutation_epoch,
+            schema=self.builder.schema,
+            nominator_token=nominator_token(self),
+            cycle0=cycle0,
+            t_dispatch=t_pd,
+        )
+        self._flight_phase("predispatch", time.perf_counter() - t_pd)
+        if tr is not None:
+            tr.step("predispatched next batch")
+        return True
+
     def _observe_plugin(self, plugin: str, point: str, secs: float) -> None:
         """One sampled per-plugin duration, fanned to the upstream-parity
         exposition, the scheduler_plugin_duration_seconds family, and the
@@ -838,7 +1004,14 @@ class TPUScheduler:
         if snap_s > 0:
             phases["snapshot"] = phases.get("snapshot", 0.0) + snap_s
         wall = time.perf_counter() - t0
-        phases["other"] = max(wall - sum(phases.values()), 0.0)
+        # Per-stage serial sum BEFORE the residual: with the pipeline on,
+        # a predispatched batch's device window started in the PREVIOUS
+        # call, so the stage sum can exceed this call's wall — the excess
+        # is exactly the wall time stage overlap saved vs running the
+        # stages serially.
+        serial_s = sum(phases.values())
+        saved_s = max(serial_s - wall, 0.0)
+        phases["other"] = max(wall - serial_s, 0.0)
         rec = {
             "pods": acc["pods"],
             "scheduled": acc["scheduled"],
@@ -848,6 +1021,20 @@ class TPUScheduler:
             "wall_s": round(wall, 6),
             "phases": {k: round(v, 6) for k, v in phases.items()},
         }
+        if self.pipeline_depth >= 2:
+            serial_total = serial_s + phases["other"]
+            rec["overlap"] = {
+                "serial_s": round(serial_total, 6),
+                "saved_s": round(saved_s, 6),
+                # wall saved vs the serial stage sum — 0.0 with nothing
+                # overlapped, approaching the device share as the commit
+                # stage fully hides under the next in-flight pass.
+                "coverage": round(saved_s / serial_total, 4)
+                if serial_total > 0
+                else 0.0,
+            }
+            if saved_s > 0:
+                self._pipeline_overlap_counter.inc(saved_s)
         if acc["plugins"]:
             rec["plugins"] = {
                 k: round(v, 6) for k, v in sorted(acc["plugins"].items())
@@ -1331,6 +1518,27 @@ class TPUScheduler:
         self._journal_append("delete", uid=uid)
         self._unwind_pod(uid, notify)
 
+    def _mark_inflight(self, infos: list) -> None:
+        """A prefetched or predispatched batch is now in flight for real:
+        gang members leave the queue's pending-quorum tracking (the pop
+        re-tracked them so a dissolved batch could reactivate cleanly)."""
+        for qp in infos:
+            if qp.pod.spec.pod_group:
+                self.queue._untrack_gang_member(qp.pod)
+
+    def _dissolve_inflight(self, infos: list, uid: str) -> None:
+        """Hand an in-flight batch (prefetched or predispatched) back to
+        the queue minus the departing pod: the dead member is dropped —
+        the pop re-tracked it in _gang_members (gang_pending quorum
+        credit), so untrack or the dead uid overcounts quorum forever
+        and Permit waits on a ghost — and every survivor reactivates."""
+        for qp in infos:
+            if qp.pod.uid == uid:
+                self.queue._info.pop(uid, None)
+                self.queue._untrack_gang_member(qp.pod)
+                continue
+            self.queue.reactivate(qp)
+
     def _unwind_pod(self, uid: str, notify: bool = True) -> None:
         """The state unwind a pod's departure requires — shared by
         delete_pod (journaled ``delete``) and _apply_eviction (journaled
@@ -1343,15 +1551,19 @@ class TPUScheduler:
         ):
             infos_p, _work = self._prefetched
             self._prefetched = None
-            for qp in infos_p:
-                if qp.pod.uid == uid:
-                    # Prefetch re-tracked this member in _gang_members
-                    # (gang_pending quorum credit); untrack or the dead uid
-                    # overcounts quorum forever and Permit waits on a ghost.
-                    self.queue._info.pop(uid, None)
-                    self.queue._untrack_gang_member(qp.pod)
-                    continue
-                self.queue.reactivate(qp)
+            self._dissolve_inflight(infos_p, uid)
+        # Same for a PREDISPATCHED batch (ISSUE 15): the early device
+        # pass included the pod, and an unbound pod's deletion moves no
+        # validity token (no cache entry → no dirty row), so pickup
+        # would complete the pass and bind a deleted pod.  Discard the
+        # pass outright — rewind the tie-break cycle counter and hand
+        # the surviving members back to the queue, exactly like the
+        # prefetch dissolution above.
+        pd = self._predispatched
+        if pd is not None and any(qp.pod.uid == uid for qp in pd.infos):
+            self._predispatched = None
+            self._cycle = pd.cycle0
+            self._dissolve_inflight(pd.infos, uid)
         self._drop_permit_waiters({uid})
         # A deleted pod leaves the PreBind wait room: revert its Reserve
         # chain now (the cache entry goes below with the delete); scrub it
@@ -2569,6 +2781,11 @@ class TPUScheduler:
             if self._prebind_outcomes:
                 out = self._prebind_outcomes + list(out)
                 self._prebind_outcomes = []
+            # Pipeline safety net: a staged commit group never outlives
+            # its schedule_batch call (the outcomes below report applied,
+            # durable binds; the snapshot must see them too).  Normally a
+            # no-op — _batch_traced_inner drained already.
+            self._drain_pending(overlapped=False)
             # Checkpoint at the quiescent point between batches (assume/
             # forget deltas settled); the cadence gate inside keeps this
             # free when journaling is off or the log hasn't grown.
@@ -2620,11 +2837,19 @@ class TPUScheduler:
                 self.queue.add(pod)
         pre = self._prefetched
         self._prefetched = None
-        if pre is not None:
+        pd = self._predispatched
+        self._predispatched = None
+        if pd is not None:
+            # A device pass dispatched one cycle early (the pipeline's
+            # double buffer) — validated or re-dispatched below.  infos
+            # is the ORIGINAL pop order (the packer may have permuted
+            # the dispatched ctx's copy).
+            infos = pd.infos
+            work = None
+            self._mark_inflight(infos)
+        elif pre is not None:
             infos, work = pre
-            for qp in infos:  # now in flight for real
-                if qp.pod.spec.pod_group:
-                    self.queue._untrack_gang_member(qp.pod)
+            self._mark_inflight(infos)
         else:
             infos = self.queue.pop_batch(self.batch_size)
             work = None
@@ -2656,7 +2881,7 @@ class TPUScheduler:
                 return out
             if len(self.profiles) == 1:
                 try:
-                    return self._batch_traced(tr, infos, work)
+                    return self._batch_traced(tr, infos, work, pd)
                 except Exception as exc:
                     return self._recover_batch(infos, self.profile, exc)
             by_profile: dict[str, list[QueuedPodInfo]] = {}
@@ -2677,23 +2902,54 @@ class TPUScheduler:
             return out
 
     def _batch_traced(
-        self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None
+        self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None,
+        pd=None,
     ) -> list[ScheduleOutcome]:
         """One single-profile batch under the cycle span (exception-safe:
         Trace.__exit__ emits the step log for slow batches even when the
         batch raises — exactly the batches an operator needs timed)."""
         self._inflight_uids = frozenset(qp.pod.uid for qp in infos)
         try:
-            return self._batch_traced_inner(tr, infos, work)
+            return self._batch_traced_inner(tr, infos, work, pd)
         finally:
             self._inflight_uids = frozenset()
 
     def _batch_traced_inner(
-        self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None
+        self, tr: Trace, infos: list[QueuedPodInfo], work: dict | None,
+        pd=None,
     ) -> list[ScheduleOutcome]:
-        with tr.nest("DevicePassDispatch") as _sp:
-            ctx = self._dispatch_batch(infos, self.profile, work)
-        tr.step("dispatched device pass")
+        if pd is not None:
+            from .engine.pipeline import predispatch_valid
+
+            if predispatch_valid(self, pd):
+                # Nothing the early dispatch read has changed: complete
+                # the in-flight pass as-is (its device time overlapped
+                # the previous batch's drain and the inter-call gap).
+                ctx = pd.ctx
+                self._pipeline_predispatch_counter.inc(result="hit")
+                self._pd_consec_invalid = 0
+                tr.step("picked up predispatched device pass")
+            else:
+                # Host state moved under the early dispatch (informer
+                # mutation, taint write, nomination change): discard the
+                # pass, rewind the tie-break cycle counter, and dispatch
+                # against current truth — exactly what the serial loop
+                # would compute, so bindings stay bit-identical.
+                self._cycle = pd.cycle0
+                self._pipeline_predispatch_counter.inc(result="invalidated")
+                # Each miss burned a device pass: back the gate off for
+                # a few batches (capped so it always re-probes; a hit
+                # resets instantly).
+                self._pd_consec_invalid = min(
+                    self._pd_consec_invalid + 4, 16
+                )
+                with tr.nest("DevicePassDispatch"):
+                    ctx = self._dispatch_batch(infos, self.profile, None)
+                tr.step("re-dispatched invalidated predispatch")
+        else:
+            with tr.nest("DevicePassDispatch") as _sp:
+                ctx = self._dispatch_batch(infos, self.profile, work)
+            tr.step("dispatched device pass")
         # Overlap victim packing + transfer with the in-flight device pass
         # when recent batches needed preemption (the dispatch is async; the
         # ~O(nodes) packing walk rides inside the pass's device time).
@@ -2740,7 +2996,23 @@ class TPUScheduler:
                 )
                 tr.step("prefetched next batch")
         with tr.nest("CompleteBatch"):
-            out = self._complete_batch(ctx)
+            out = self._complete_batch(
+                ctx, defer_drain=self._pipeline_active()
+            )
+        # Pipeline depth >= 2: dispatch batch k+1 BEFORE draining batch
+        # k's staged commit group, so the group fsync and the apply loop
+        # run while the device crunches the next pass.  With no next
+        # batch (queue dry) the drain runs inline — still one fsync for
+        # the whole group.
+        predispatched = False
+        ticket = self._pending_ticket
+        if (
+            ticket is not None
+            and not ticket.drained
+            and self._pipeline_active()
+        ):
+            predispatched = self._predispatch_next(tr)
+        self._drain_pending(overlapped=predispatched)
         tr.step("completed (bind/permit/postfilter)")
         return out
 
@@ -3029,6 +3301,11 @@ class TPUScheduler:
         singletons that still raise alone.  The device mirror is rebuilt
         from host truth before every retry: a mid-batch failure leaves it
         suspect, and host staging is the authoritative cache."""
+        # A deferred commit group staged before the exception holds real,
+        # reserve-complete binds: drain it first (journal + apply) so the
+        # cached-placement check below sees them as the committed pods
+        # they are — not as retriable in-flight state.
+        self._drain_pending(overlapped=False)
         self._engine_fault_counter.inc()
         self.flight.record_marker(
             "engine_fault",
@@ -3139,7 +3416,9 @@ class TPUScheduler:
             diagnosis=Diagnosis(unschedulable_plugins={"EngineFault"}),
         )
 
-    def _complete_batch(self, ctx: dict) -> list[ScheduleOutcome]:
+    def _complete_batch(
+        self, ctx: dict, defer_drain: bool = False
+    ) -> list[ScheduleOutcome]:
         infos, profile = ctx["infos"], ctx["profile"]
         batch, deltas, active = ctx["batch"], ctx["deltas"], ctx["active"]
         nomrow, inv = ctx["nomrow"], ctx["inv"]
@@ -3306,6 +3585,15 @@ class TPUScheduler:
 
         outcomes: list[ScheduleOutcome] = []
         now = time.monotonic()
+        # The batch's staged commit group (engine/pipeline.CommitTicket):
+        # binds that pass Permit + Reserve stage here and journal + apply
+        # together under ONE group fsync — at the serial point below
+        # (depth 1, or any batch with failures), or deferred under the
+        # next batch's in-flight device pass (_batch_traced_inner).
+        from .engine.pipeline import CommitTicket
+
+        ticket = CommitTicket(now=now)
+        self._pending_ticket = ticket
         m = self.metrics
         m.batches += 1
         m.featurize_time_s += ctx["feat_s"]
@@ -3411,7 +3699,6 @@ class TPUScheduler:
         # reverting peers' reservations — so a gang never lands partially
         # bound below minMember (ADVICE r1).
         finalized_by_group: dict[str, list] = {}
-        latency_qps: list[QueuedPodInfo] = []
         race_rollback: set[str] = set()  # transient (PV race): retry on timer
         prebind_parked: set[str] = set()  # pods gone to the PreBind wait room
         prebind_s = 0.0
@@ -3470,12 +3757,14 @@ class TPUScheduler:
                     rollback.add(g)
                     race_rollback.add(g)
                     gpl.on_rollback(qp, self)
+                    # Same-batch mates are still STAGED (their journal
+                    # records unwritten, gang credit uncounted): unstage
+                    # — nothing on the log or in spec to unwind.
                     for qp2, out2, undos2 in finalized_by_group.pop(g, ()):
                         for rp2, u2 in reversed(undos2):
                             rp2.unreserve(u2, self)
+                        ticket.unstage(qp2.pod.uid)
                         self.cache.forget_pod(qp2.pod.uid)
-                        qp2.pod.spec.node_name = None
-                        self._debit_gang(g)
                         out2.node_name, out2.score = None, 0
                         gpl.on_rollback(qp2, self)
                     # Same-batch mates already parked in the PreBind wait
@@ -3516,27 +3805,16 @@ class TPUScheduler:
                 }
                 prebind_parked.add(qp.pod.uid)
                 continue
-            # Write-ahead: the binding is durable before it is applied
-            # (spec mutation + finish_binding below) — the crash analog of
-            # etcd acknowledging the binding subresource write before the
-            # scheduler trusts it.
-            self._journal_bind(qp.pod, node_name)
-            qp.pod.spec.node_name = node_name
-            self.cache.finish_binding(qp.pod.uid)
-            # Self-placed pods get their NoExecute judgment at bind (the
-            # reference's handlePodUpdate fires on the binding update) —
-            # a tolerationSeconds toleration starts its clock here.
-            self.taint_eviction.handle_pod_assigned(qp.pod, node_name)
-            self.queue.done(qp.pod.uid)
+            # Write-ahead at GROUP scope (engine/pipeline.drain_commit):
+            # the bind STAGES here; its journal record and its apply
+            # (spec mutation + finish_binding + queue/gang bookkeeping)
+            # both happen at the drain, where the whole group's records
+            # go durable under ONE fsync before any of them applies —
+            # the crash analog of etcd acknowledging a batched txn
+            # before the scheduler trusts any write in it.
             outcome = ScheduleOutcome(qp.pod, node_name, score, feasn)
             outcomes.append(outcome)
-            latency_qps.append(qp)
-            if qp.pod.spec.pod_group:
-                # Gang STATE bookkeeping (informer-style, like add_pod's
-                # bound-member credit) — stays with the scheduler.
-                self.gang_bound[qp.pod.spec.pod_group] = (
-                    self.gang_bound.get(qp.pod.spec.pod_group, 0) + 1
-                )
+            ticket.stage(qp, node_name, outcome)
             if g:
                 finalized_by_group.setdefault(g, []).append(
                     (qp, outcome, undos)
@@ -3562,9 +3840,25 @@ class TPUScheduler:
                 plugin.post_batch(plugin_waits, self)
         if prebind_s:
             m.registry.observe_point("PreBind", prebind_s)
-        # Metrics after rollbacks settled (success = outcome kept its node).
+        # Drain the staged commit group at the SERIAL point — unless the
+        # pipeline defers it under the next dispatch.  Any batch with
+        # failures drains here regardless: PostFilter's victim deletes
+        # journal with their own fsyncs, and the WAL's replay order must
+        # keep this batch's bind records AHEAD of them (delete-then-bind
+        # replay would resurrect a preempted pod).
+        drain_inline_s = 0.0
+        if not defer_drain or failed:
+            drain_inline_s = self._drain_pending(overlapped=False)
+        # Metrics after rollbacks settled (success = outcome kept its
+        # node).  Staged successes are accounted by the drain (inline
+        # above at depth 1, under the next device pass at depth >= 2).
         for outcome in outcomes:
             if outcome.node_name:
+                if ticket.holds(outcome.pod.uid):
+                    continue  # success accounting rides the drain
+                # Not staged: an inline preemptor commit
+                # (_commit_preempted journals + applies directly) —
+                # its success accounting happens here.
                 if m.scheduled == 0:
                     m.first_scheduled_ts = now
                 m.scheduled += 1
@@ -3586,11 +3880,6 @@ class TPUScheduler:
                     "(batch rollback or lost race)",
                     **self._trace_extra(),
                 )
-        for qp in latency_qps:
-            if qp.pod.spec.node_name:
-                lat = now - qp.initial_attempt_timestamp
-                m.e2e_latency_samples.append(lat)
-                m.registry.scheduling_sli.observe(lat)
         # Diagnosis from the device's per-op fail bitmask (bit order =
         # filter_op_names): which plugins rejected nodes this cycle.  A
         # uniform failing batch (5k no-fit pods, the Unschedulable shape)
@@ -3747,7 +4036,12 @@ class TPUScheduler:
             if pack_s > 0.0:
                 ph["packing"] = ph.get("packing", 0.0) + pack_s
             ph["device"] = ph.get("device", 0.0) + (t2 - t1 - pack_s)
-            ph["commit"] = ph.get("commit", 0.0) + (t_flight_end - t2)
+            # An inline drain ran inside the commit window and recorded
+            # its own `drain` segment — carve it out so the tiling still
+            # sums to wall time.
+            ph["commit"] = ph.get("commit", 0.0) + max(
+                t_flight_end - t2 - drain_inline_s, 0.0
+            )
             acc["pods"] += len(infos)
             acc["scheduled"] += sum(1 for o in outcomes if o.node_name)
             acc["unschedulable"] += sum(
@@ -3770,10 +4064,10 @@ class TPUScheduler:
             if out:
                 all_outcomes.extend(out)
                 continue
-            if len(self.queue) or self._prefetched is not None:
+            if len(self.queue) or self.has_inflight_work:
                 # A whole batch can yield zero outcomes (members moved to
-                # the WaitOnPermit room) while pods remain active or
-                # prefetched.
+                # the WaitOnPermit room) while pods remain active,
+                # prefetched, or predispatched.
                 continue
             if wait_backoff and self.queue.sleep_until_backoff():
                 continue

@@ -230,6 +230,16 @@ class ResyncingClient:
         if self.journal is not None:
             self.journal.append(rtype, data)
 
+    def _journal_group(self):
+        """One group-commit fsync barrier for a batch of mutations
+        (journal.group(), ISSUE 15) — a no-op context when the replay
+        store is unjournaled."""
+        import contextlib
+
+        if self.journal is None:
+            return contextlib.nullcontext()
+        return self.journal.group()
+
     def _maybe_checkpoint(self) -> None:
         """Checkpoint cadence — call AFTER the mutation has been applied
         to the in-memory store: the snapshot's seq covers every appended
@@ -666,12 +676,18 @@ class ResyncingClient:
         # Pending pods enter the store UNBOUND first: if the sidecar dies
         # mid-call the replay re-submits them (at-least-once; the engine's
         # upsert path makes re-delivery idempotent).  Journaled for the
-        # same reason — a restarted HOST must re-submit them too.
+        # same reason — a restarted HOST must re-submit them too.  Group
+        # commit (ISSUE 15): ONE fsync barrier for the whole batch's add
+        # records instead of one per pod, with the store mutations (the
+        # apply) deferred past the barrier — journal-before-apply at
+        # group scope, same contract as the scheduler's commit drain.
         pods = list(pods)
+        with self._journal_group():
+            for p in pods:
+                self._journal_mutation(
+                    "add", {"kind": "Pod", "obj": serialize.to_dict(p)}
+                )
         for p in pods:
-            self._journal_mutation(
-                "add", {"kind": "Pod", "obj": serialize.to_dict(p)}
-            )
             self._record("Pod", p)
         t_wire = time.perf_counter()
         results = self._call_or_degraded(
@@ -700,25 +716,36 @@ class ResyncingClient:
         # apiserver; here the store is that persistence, so a later replay
         # re-adds bound pods as cache adds with their node set.
         by_uid = {p.uid: p for p in pods}
-        for r in results:
-            p = by_uid.get(r.pod_uid) or self._store["Pod"].get(r.pod_uid)
-            if p is None:
-                continue
-            if r.node_name:
-                # Write-ahead: the learned binding is durable before the
-                # mirror records it — a host kill between the response
-                # and the next replay can no longer forget a commit the
-                # sidecar already made (the double-bind window).
-                self._journal_mutation(
-                    "bind", {"uid": r.pod_uid, "node": r.node_name}
-                )
-                p.spec.node_name = r.node_name
-            for vu in r.victim_uids:
-                # Preemption victims were deleted sidecar-side; mirror that.
-                self._journal_mutation(
-                    "remove", {"kind": "Pod", "uid": vu}
-                )
-                self._store["Pod"].pop(vu, None)
+        staged_binds: list[tuple] = []  # (pod, node) applied post-barrier
+        staged_removes: list[str] = []
+        with self._journal_group():
+            for r in results:
+                p = by_uid.get(r.pod_uid) or self._store["Pod"].get(r.pod_uid)
+                if p is None:
+                    continue
+                if r.node_name:
+                    # Write-ahead: the learned binding is durable before
+                    # the mirror records it — a host kill between the
+                    # response and the next replay can no longer forget a
+                    # commit the sidecar already made (the double-bind
+                    # window).  The whole batch's records share one group
+                    # fsync; the mirror mutations below run only after
+                    # the barrier returned.
+                    self._journal_mutation(
+                        "bind", {"uid": r.pod_uid, "node": r.node_name}
+                    )
+                    staged_binds.append((p, r.node_name))
+                for vu in r.victim_uids:
+                    # Preemption victims were deleted sidecar-side;
+                    # mirror that.
+                    self._journal_mutation(
+                        "remove", {"kind": "Pod", "uid": vu}
+                    )
+                    staged_removes.append(vu)
+        for p, node_name in staged_binds:
+            p.spec.node_name = node_name
+        for vu in staged_removes:
+            self._store["Pod"].pop(vu, None)
         self._maybe_checkpoint()
         return results
 

@@ -784,13 +784,18 @@ class SpeculativeFrontend:
     # -- the request path ---------------------------------------------------
 
     def _prefetched_uids(self) -> frozenset:
-        """Uids held in the scheduler's prefetched batch: popped from the
-        queue (so _in_active can't dedup them) but not yet scheduled —
+        """Uids held in the scheduler's prefetched (featurized) or
+        predispatched (ISSUE 15 pipeline) batch: popped from the queue
+        (so _in_active can't dedup them) but not yet scheduled —
         re-adding one would run it twice and double-commit."""
+        uids = set()
         pre = self.sched._prefetched
-        if pre is None:
-            return frozenset()
-        return frozenset(qp.pod.uid for qp in pre[0])
+        if pre is not None:
+            uids.update(qp.pod.uid for qp in pre[0])
+        pd = self.sched._predispatched
+        if pd is not None:
+            uids.update(qp.pod.uid for qp in pd.infos)
+        return frozenset(uids)
 
     def _admit_hints(self, budget: int) -> None:
         if budget <= 0:
@@ -853,7 +858,7 @@ class SpeculativeFrontend:
             if (
                 not outs
                 and not len(self.sched.queue)
-                and self.sched._prefetched is None
+                and not self.sched.has_inflight_work
             ):
                 return  # parked (gated / gang quorum / foreign scheduler)
         # Bound exhausted with the pod still queued: the synthesized

@@ -122,6 +122,22 @@ def report(doc: dict) -> str:
                 "(journal_append/journal_fsync/hint_decode nest inside or "
                 "overlap the tiles)"
             )
+        # Pipeline overlap (ISSUE 15): per-batch stage records carry the
+        # wall saved vs the serial stage sum when featurize / device /
+        # commit-drain overlapped.
+        ov = [b["overlap"] for b in batches if b.get("overlap")]
+        if ov:
+            saved = sum(o.get("saved_s", 0.0) for o in ov)
+            serial = sum(o.get("serial_s", 0.0) for o in ov)
+            overlapped = sum(1 for o in ov if o.get("saved_s", 0.0) > 0)
+            out.append(
+                f"pipeline overlap: {_fmt_s(saved)} wall saved vs "
+                f"{_fmt_s(serial)} serial stage sum "
+                f"({saved / serial:.1%} coverage) across "
+                f"{overlapped}/{len(ov)} overlapped batches"
+                if serial > 0
+                else "pipeline overlap: no stage records"
+            )
 
         # Sampled per-plugin durations.
         plugins: dict[str, float] = {}

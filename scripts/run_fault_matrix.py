@@ -41,9 +41,12 @@ local state moves.
 
 Subsets: ``--fleet-kill`` (shard failover), ``--node-loss`` /
 ``--fleet-node-loss`` (the failure-response loop), ``--autoscale-kill``
-(SIGKILL inside an autoscaler-initiated live resize — ISSUE 11); all
-ride ``--kill``.  ``--only CELL`` narrows any matrix to labels
-containing the substring, and every cell line prints its wall time.
+(SIGKILL inside an autoscaler-initiated live resize — ISSUE 11),
+``--pack-kill`` (packed chunks + carried DomTables — ISSUE 13),
+``--pipeline-kill`` (SIGKILL inside the pipelined commit drain's
+group-commit windows — ISSUE 15); all ride ``--kill``.  ``--only CELL``
+narrows any matrix to labels containing the substring, and every cell
+line prints its wall time.
 """
 
 from __future__ import annotations
@@ -756,6 +759,192 @@ def run_pack_kill_matrix(cases=PACK_KILL_CASES, verbose=True) -> list[str]:
                 print(
                     f"ok   {label}: recovery rebuilt DomTables, bindings "
                     f"bit-identical{_cell_dt(t0)}"
+                )
+        return failures
+
+
+# -- the PIPELINE crash subset (ISSUE 15: group commit + overlapped drain) --
+
+# The pipelined commit drain's crash claim: a staged commit group is
+# all-or-nothing-ACKNOWLEDGED — records go durable under ONE group fsync
+# and no bind applies until the barrier returns, while a predispatched
+# device pass for the NEXT batch is typically in flight over the drain.
+# A SIGKILL anywhere inside the window (commit staged but nothing
+# journaled; group written but the fsync not returned; fsync returned but
+# nothing applied; the group's tail record torn mid-write) must recover
+# to bindings bit-identical to an uninterrupted pipelined run — which
+# itself binds bit-identical to the depth-1 serial configuration on the
+# same scenario (asserted once per sweep, ahead of the cells).
+PIPELINE_KILL_CASES = (
+    ("stage-boundary", 1),    # staged, nothing journaled (first batch)
+    ("stage-boundary", 3),    # same window, state accumulated
+    ("mid-group-fsync", 1),   # group written, barrier not returned
+    ("mid-group-fsync", 2),
+    ("post-group-fsync", 1),  # durable, nothing applied
+    ("torn-group-tail", 2),   # a group's tail record torn mid-write
+)
+
+
+def _pipeline_scheduler(state_dir: str, depth: int):
+    """The pack-kill scenario's scheduler shape (unique, commit-invariant
+    scores — see pack_scenario_objects) at pipeline depth ``depth``:
+    batch 8 over 24 pods = 3+ batches, so predispatch + overlapped
+    drains genuinely engage before the armed kill point fires.  Reuses
+    _pack_scheduler so the two matrices can never drift apart on the
+    profile shape the tie-free guarantee rests on."""
+    sched, journal = _pack_scheduler(state_dir, chunk=4)
+    sched.pipeline_depth = depth
+    return sched, journal
+
+
+def _pipeline_child(state_dir: str, depth: int) -> None:
+    from kubernetes_tpu.faults import KillSwitch
+
+    sched, journal = _pipeline_scheduler(state_dir, depth)
+    sched.attach_journal(journal, snapshot_every_batches=2)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, pods = pack_scenario_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in pods:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    bindings = {
+        uid: pr.node_name for uid, pr in sched.cache.pods.items() if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def pipeline_kill_child(state_dir: str) -> None:
+    _pipeline_child(state_dir, depth=2)
+
+
+def pipeline_seq_child(state_dir: str) -> None:
+    """The depth-1 serial parity configuration on the SAME scenario —
+    the pipelined baseline must reproduce its bindings byte for byte."""
+    _pipeline_child(state_dir, depth=1)
+
+
+def pipeline_recover_child(state_dir: str) -> None:
+    import copy
+
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+    from kubernetes_tpu.journal import recover
+
+    from kubernetes_tpu.api import serialize
+
+    sched, journal = _pipeline_scheduler(state_dir, depth=2)
+    # The durable truth BEFORE replay mutates anything: bind uids in the
+    # snapshot plus post-barrier records (replay() is a read-only scan;
+    # this scenario journals no deletes, so the set only grows).
+    snap, records, _ = journal.replay()
+    durable = {
+        serialize.pod_from_data(p["pod"]).uid
+        for p in (snap or {"state": {}})["state"].get("pods", ())
+    }
+    durable.update(r["d"]["uid"] for r in records if r["t"] == "bind")
+    recover(sched, journal)
+    # A staged-but-unbarriered group must never have applied: every
+    # binding recovery produced — applied to the cache or parked for the
+    # LIST reconcile — must trace to a durable record.  (The final
+    # bindings comparison proves completeness; this pins the DIRECTION:
+    # nothing live ahead of its group's fsync.)
+    applied = {
+        uid for uid, pr in sched.cache.pods.items() if pr.bound
+    } | set(sched._recovered_bindings)
+    assert applied <= durable, (
+        f"bindings with no durable record: {sorted(applied - durable)}"
+    )
+    sched.attach_journal(journal, snapshot_every_batches=2)
+    nodes, pods = pack_scenario_objects()
+    src_n, src_p = FakeSource(), FakeSource()
+    for n in nodes:
+        src_n.add(n.name, copy.deepcopy(n))
+    for p in pods:
+        src_p.add(p.uid, copy.deepcopy(p))
+    reconcile_after_recovery(
+        sched,
+        Reflector(sched, "Node", src_n.lister, src_n.watcher),
+        Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+    )
+    sched.schedule_all_pending(wait_backoff=True)
+    bindings = {
+        uid: pr.node_name for uid, pr in sched.cache.pods.items() if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+
+
+def run_pipeline_kill_matrix(
+    cases=PIPELINE_KILL_CASES, verbose=True
+) -> list[str]:
+    """SIGKILL the pipelined scenario inside the group-commit drain
+    windows, recover, and compare final bindings to an uninterrupted
+    pipelined run (itself asserted identical to the depth-1 serial
+    configuration).  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "pipe-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--pipeline-kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        assert rc == 0 and baseline, "pipeline baseline run failed"
+        seq_dir = os.path.join(td, "pipe-seq")
+        os.makedirs(seq_dir)
+        rc = _spawn("--pipeline-seq-child", seq_dir)
+        seq = _read_bindings(seq_dir)
+        assert rc == 0 and seq == baseline, (
+            "pipelined run diverged from the depth-1 parity configuration: "
+            f"{ {k: (baseline.get(k), (seq or {}).get(k)) for k in set(baseline) | set(seq or {}) if baseline.get(k) != (seq or {}).get(k)} }"
+        )
+        if verbose:
+            print("ok   pipekill:baseline == depth-1 parity configuration")
+        failures = []
+        for point, nth in cases:
+            label = f"pipekill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
+            state_dir = os.path.join(td, f"pipe-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn(
+                "--pipeline-kill-child", state_dir, kill=f"{point}:{nth}"
+            )
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}{_cell_dt(t0)}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--pipeline-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+            elif verbose:
+                print(
+                    f"ok   {label}: group-commit window recovered, "
+                    f"bindings bit-identical{_cell_dt(t0)}"
                 )
         return failures
 
@@ -2492,6 +2681,37 @@ def main() -> int:
             "bit-identical (packed baseline == chunk1 parity)"
         )
         return 0
+    if "--pipeline-kill-child" in sys.argv:
+        pipeline_kill_child(
+            sys.argv[sys.argv.index("--pipeline-kill-child") + 1]
+        )
+        return 0
+    if "--pipeline-seq-child" in sys.argv:
+        pipeline_seq_child(
+            sys.argv[sys.argv.index("--pipeline-seq-child") + 1]
+        )
+        return 0
+    if "--pipeline-recover-child" in sys.argv:
+        pipeline_recover_child(
+            sys.argv[sys.argv.index("--pipeline-recover-child") + 1]
+        )
+        return 0
+    if "--pipeline-kill" in sys.argv:
+        # The group-commit/overlapped-drain subset alone (rides --kill).
+        failures = run_pipeline_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(PIPELINE_KILL_CASES)} pipeline "
+                f"kill cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(PIPELINE_KILL_CASES)} pipeline kill cases: SIGKILL "
+            "inside the group-commit drain windows recovered with NO "
+            "staged bind applied ahead of its group fsync, bindings "
+            "bit-identical (pipelined baseline == depth-1 parity)"
+        )
+        return 0
     if "--node-loss-child" in sys.argv:
         node_loss_child(sys.argv[sys.argv.index("--node-loss-child") + 1])
         return 0
@@ -2618,10 +2838,13 @@ def main() -> int:
         failures += run_autoscale_kill_matrix()
         # And the packed-chunk/DomTables-carry subset (ISSUE 13).
         failures += run_pack_kill_matrix()
+        # And the pipelined group-commit drain subset (ISSUE 15).
+        failures += run_pipeline_kill_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
             + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
             + len(AUTOSCALE_KILL_CASES) + len(PACK_KILL_CASES)
+            + len(PIPELINE_KILL_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -137,6 +137,7 @@ def r06_config(args) -> "SoakConfig":
         batch_size=args.batch_size,
         chunk_size=32,
         warm_pods=128,
+        pipeline_depth=args.pipeline_depth,
         two_process=autoscale.pop("two_process", True),
         journal_fsync=args.journal_fsync,
         snapshot_every=args.snapshot_every,
@@ -785,6 +786,12 @@ def main() -> int:
     ap.add_argument("--live-pod-cap", type=int, default=2000)
     ap.add_argument("--slo-budget-ms", type=float, default=250.0)
     ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument(
+        "--pipeline-depth", type=int, default=1,
+        help="software-pipeline the serve child's batch loop (ISSUE 15; "
+        "depth 2 overlaps the group-committed journal drain with the "
+        "next in-flight device pass, bindings bit-identical)",
+    )
     ap.add_argument("--journal-fsync", choices=("always", "never"),
                     default="always")
     ap.add_argument("--snapshot-every", type=int, default=24)

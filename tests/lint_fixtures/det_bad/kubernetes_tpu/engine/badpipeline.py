@@ -1,0 +1,31 @@
+"""Seeded stage-scheduler determinism violations (ISSUE 15): a pipeline
+that orders its drain by salted hashes, validates predispatches against
+wall clocks, or iterates staged uids as a bare set would apply commits
+in a different order per process — bindings could never stay
+bit-identical to the depth-1 parity oracle."""
+
+import time
+
+
+def predispatch_expired(pd):
+    # POSITIVE det-wallclock: predispatch validity must be a pure
+    # function of scheduler state (feature version / mutation epoch),
+    # never of wall time — two runs would invalidate different passes.
+    return time.time() - pd.t_dispatch > 0.5
+
+
+def drain_order(ticket):
+    # POSITIVE det-set-iteration: bare-set iteration order is
+    # hash-randomized; the drain must apply in STAGE order (the serial
+    # loop's entry order), not whatever the uid set yields.
+    order = []
+    for uid in {sb.qp.pod.uid for sb in ticket.staged}:
+        order.append(uid)
+    return order
+
+
+def group_slot(uid, groups):
+    # POSITIVE det-builtin-hash: builtin hash() is PYTHONHASHSEED-salted
+    # — the commit group a bind lands in would differ per process; key
+    # on the staged position or zlib.crc32 instead.
+    return hash(uid) % groups

@@ -1,0 +1,25 @@
+"""Healthy stage-scheduler idioms: perf_counter for stage timing (never
+a decision input), stage-order drains, stable-token validity checks."""
+
+import time
+
+
+def drain_timing(ticket):
+    # perf_counter is allowed: it feeds the flight recorder's drain
+    # segment, never a scheduling decision.
+    t0 = time.perf_counter()
+    order = [sb.qp.pod.uid for sb in ticket.staged]  # stage order
+    return order, time.perf_counter() - t0
+
+
+def predispatch_valid(pd, builder):
+    # Validity as a pure function of scheduler state tokens.
+    return (
+        pd.version == builder.feature_version()
+        and pd.mutation_epoch == builder.mutation_epoch
+    )
+
+
+def staged_report(ticket):
+    # sorted(...) over a set is the deterministic-iteration idiom.
+    return sorted({sb.node_name for sb in ticket.staged})

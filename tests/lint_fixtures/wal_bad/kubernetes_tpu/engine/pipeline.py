@@ -1,0 +1,32 @@
+"""Seeded pipeline-drain WAL violations (ISSUE 15): the staged commit
+group's applies live in the drain — a drain that applies a bind before
+(or without) its group's journal records re-opens exactly the
+apply-then-append window the group fsync barrier exists to close."""
+
+
+class BadDrain:
+    def drain_without_journal(self, sched, ticket):
+        # POSITIVE wal-unjournaled-apply: the staged binds go live with
+        # no journal append in scope — a SIGKILL mid-drain forgets every
+        # decision in the group.
+        for sb in ticket.staged:
+            sb.qp.pod.spec.node_name = sb.node_name
+            sched.cache.finish_binding(sb.qp.pod.uid)
+
+    def drain_apply_then_group(self, sched, ticket):
+        # POSITIVE wal-apply-before-journal: applies run BEFORE the
+        # group's records are even appended — the mid-group-fsync crash
+        # cell would find live bindings with no durable records.
+        for sb in ticket.staged:
+            sched.cache.finish_binding(sb.qp.pod.uid)
+        with sched.journal.group():
+            for sb in ticket.staged:
+                sched._journal_bind(sb.qp.pod, sb.node_name)
+
+    def healthy_drain(self, sched, ticket):
+        # NEGATIVE: group-journal first, applies only after the barrier.
+        with sched.journal.group():
+            for sb in ticket.staged:
+                sched._journal_bind(sb.qp.pod, sb.node_name)
+        for sb in ticket.staged:
+            sched.cache.finish_binding(sb.qp.pod.uid)

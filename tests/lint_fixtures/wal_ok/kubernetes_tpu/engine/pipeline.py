@@ -1,0 +1,16 @@
+"""Healthy pipeline-drain shapes: the group's records are appended (and
+fsync'd by the barrier) before any staged bind applies — the real
+module's drain_commit ordering."""
+
+
+class GoodDrain:
+    def drain(self, sched, ticket):
+        # Journal-before-apply at group scope: append every record
+        # inside the barrier, apply only after it returns.
+        with sched.journal.group():
+            for sb in ticket.staged:
+                sched._journal_bind(sb.qp.pod, sb.node_name)
+        for sb in ticket.staged:
+            sb.qp.pod.spec.node_name = sb.node_name
+            sched.cache.finish_binding(sb.qp.pod.uid)
+            sched.queue.done(sb.qp.pod.uid)

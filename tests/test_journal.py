@@ -656,6 +656,109 @@ def test_quarantine_release_history_is_trimmed():
     assert q3.release_history[-1]["uid"] == f"x-{RELEASE_HISTORY_MAX + 99}"
 
 
+# -- group commit (ISSUE 15) ------------------------------------------------
+
+
+def test_group_commit_one_fsync_per_group(tmp_path):
+    """Appends inside ``journal.group()`` defer their fsync to ONE
+    barrier at group exit; nested groups ride the outermost barrier."""
+    j = Journal(str(tmp_path), epoch=1)
+    f0 = j.fsyncs
+    with j.group():
+        j.append("bind", {"uid": "a", "node": "n1"})
+        with j.group():  # nested: no inner barrier
+            j.append("bind", {"uid": "b", "node": "n2"})
+        j.append("bind", {"uid": "c", "node": "n1"})
+        assert j.fsyncs == f0  # nothing durable yet
+    assert j.fsyncs == f0 + 1
+    assert j.group_commits == 1
+    assert j.group_appends == 3
+    assert j.last_group_size == 3 and j.max_group_size == 3
+    # An empty group costs nothing.
+    with j.group():
+        pass
+    assert j.fsyncs == f0 + 1 and j.group_commits == 1
+    # Outside a group, appends fsync immediately as before.
+    j.append("delete", {"uid": "a"})
+    assert j.fsyncs == f0 + 2
+    # Every record is on the log (the group deferred durability only).
+    _snap, records, _stats = j.replay()
+    assert [r["t"] for r in records] == ["bind", "bind", "bind", "delete"]
+
+
+def test_group_commit_no_apply_before_group_fsync(tmp_path):
+    """The commit drain's ordering contract: every staged bind's record
+    is appended, then the group's SINGLE fsync barrier returns, and only
+    then does any bind apply (finish_binding) — instrumented end to end
+    through a real schedule_batch."""
+    events = []
+    sched = small_sched(enable_preemption=False)
+    journal = Journal(str(tmp_path), epoch=1)
+
+    orig_append = journal.append
+
+    def rec_append(rtype, data):
+        events.append(("append", rtype))
+        return orig_append(rtype, data)
+
+    journal.append = rec_append
+    orig_commit = journal._group_commit
+
+    def rec_commit():
+        was_outermost = journal._group_depth == 1
+        had_pending = journal._group_pending > 0
+        orig_commit()
+        if was_outermost and had_pending:
+            events.append(("group-fsync",))
+
+    journal._group_commit = rec_commit
+    sched.attach_journal(journal)
+    orig_fb = sched.cache.finish_binding
+
+    def rec_fb(uid):
+        events.append(("apply", uid))
+        orig_fb(uid)
+
+    sched.cache.finish_binding = rec_fb
+    for i in range(4):
+        sched.add_node(node(f"gc-n{i}"))
+    for i in range(6):
+        sched.add_pod(pod(f"gc-p{i}"))
+    out = sched.schedule_batch()
+    assert sum(1 for o in out if o.node_name) == 6
+    kinds = [e[0] for e in events]
+    assert kinds == ["append"] * 6 + ["group-fsync"] + ["apply"] * 6, kinds
+    # And the applies ran in stage order = the batch's outcome order.
+    applied = [e[1] for e in events if e[0] == "apply"]
+    assert applied == [o.pod.uid for o in out if o.node_name]
+
+
+@pytest.mark.faults
+def test_mid_pipeline_sigkill_recovers_bit_identical():
+    """One pipeline crash cell end to end through the real harness: a
+    SIGKILL between the group's buffered appends and the fsync barrier
+    (mid-group-fsync — records written, NONE applied) must recover to
+    bindings bit-identical to an uninterrupted pipelined run.
+    scripts/run_fault_matrix.py --pipeline-kill sweeps all six cells."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import tempfile
+
+    from run_fault_matrix import _read_bindings, _spawn
+
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "base")
+        os.makedirs(base)
+        assert _spawn("--pipeline-kill-child", base) == 0
+        baseline = _read_bindings(base)
+        assert baseline
+        case = os.path.join(td, "case")
+        os.makedirs(case)
+        rc = _spawn("--pipeline-kill-child", case, kill="mid-group-fsync:1")
+        assert rc == -9, f"child survived the SIGKILL point (rc={rc})"
+        assert _spawn("--pipeline-recover-child", case) == 0
+        assert _read_bindings(case) == baseline
+
+
 # -- the crash matrix (fast subset; --kill sweeps the grid) -----------------
 
 

@@ -91,10 +91,12 @@ def test_wal_rules_fire_on_seeded_violations():
     # OWNER-side lifecycle fixture (a shard's controller driving the
     # taint/evict apply sites, ISSUE 10) + one of each in the elastic
     # autoscaler fixture (a resize action applying its handoff without
-    # the acquiring owner's record, ISSUE 11).
-    assert got.count("wal-apply-before-journal") == 5
-    assert got.count("wal-unjournaled-apply") == 5
-    assert len(got) == 10, got  # the healthy shapes stay silent
+    # the acquiring owner's record, ISSUE 11) + one of each in the
+    # pipeline-drain fixture (a staged commit group applied before —
+    # or without — its group's journal records, ISSUE 15).
+    assert got.count("wal-apply-before-journal") == 6
+    assert got.count("wal-unjournaled-apply") == 6
+    assert len(got) == 12, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
@@ -110,6 +112,13 @@ def test_wal_rules_cover_the_autoscaler():
 def test_wal_rules_cover_failure_response_controllers():
     paths = {f.path for f in lint("wal_bad").findings}
     assert "kubernetes_tpu/controllers.py" in paths
+
+
+def test_wal_rules_cover_pipeline_drain():
+    # The batch loop's finish_binding apply sites moved into the
+    # pipelined drain (ISSUE 15) — the WAL family must follow them.
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/engine/pipeline.py" in paths
 
 
 def test_wal_negative_tree_is_clean():
@@ -133,14 +142,18 @@ def test_det_rules_fire_on_seeded_violations():
     # weight-loader jitter, a hash()-routed matrix row and a bare-set
     # accel-class ranking — the heterogeneity score/loader paths the
     # determinism family must cover.
-    assert got.count("det-wallclock") == 5
+    # engine/badpipeline.py (ISSUE 15) seeds a wallclock predispatch
+    # validity check, a bare-set drain order and a hash()-bucketed
+    # commit-group slot — the stage scheduler's determinism surface.
+    assert got.count("det-wallclock") == 6
     assert got.count("det-random") == 5  # + gauss jitter in the weight loader
-    assert got.count("det-set-iteration") == 5  # for-loops + list(set(...))
+    assert got.count("det-set-iteration") == 6  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
-    # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14): builtin
-    # hash() assigns different owners / slices / rows per process.
-    assert got.count("det-builtin-hash") == 3
+    # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14) + commit-group
+    # slotting (ISSUE 15): builtin hash() assigns different owners /
+    # slices / rows / groups per process.
+    assert got.count("det-builtin-hash") == 4
 
 
 def test_det_rules_cover_loadgen():
@@ -158,6 +171,13 @@ def test_det_rules_cover_engine_packing():
     # inside the determinism contract; the engine/ walk must cover it.
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/engine/badpack.py" in paths
+
+
+def test_det_rules_cover_pipeline():
+    # The stage scheduler (engine/pipeline.py) decides commit ORDER and
+    # predispatch validity — inside the determinism contract.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/engine/badpipeline.py" in paths
 
 
 def test_det_negative_tree_is_clean():
