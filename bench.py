@@ -196,6 +196,45 @@ def _slo_block() -> dict | None:
     return block
 
 
+def _load_sentinel():
+    """Import scripts/bench_sentinel.py by file path (stdlib-only, the
+    profile_report idiom) — the declarative guard table lives there so
+    the tier-1 ``--check`` gate and this embedding share one table."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(__file__), "scripts", "bench_sentinel.py"
+    )
+    spec = importlib.util.spec_from_file_location("_bench_sentinel", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _measured_provenance() -> dict | None:
+    """Provenance of the committed measured-matrix artifact
+    (framework/measured.py), riding every bench payload from this PR on:
+    the artifact file's sha plus its derivation window and source sha,
+    so a trajectory point records WHICH measured matrix was current.
+    None when no artifact is committed yet."""
+    import hashlib
+
+    path = os.path.join(os.path.dirname(__file__), "measured_matrix.json")
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        doc = json.loads(raw)
+    except (OSError, ValueError):
+        return None
+    return {
+        "file": os.path.basename(path),
+        "sha256": hashlib.sha256(raw).hexdigest(),
+        "version": doc.get("version"),
+        "window": doc.get("window"),
+        "source_sha256": (doc.get("source") or {}).get("sha256"),
+    }
+
+
 def main() -> int:
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
 
@@ -225,9 +264,7 @@ def main() -> int:
         jstats = journal.stats()
     guard = _journal_guard(r["pods_per_sec"])
     flagship = _flagship_block()
-    print(
-        json.dumps(
-            {
+    payload = {
                 "metric": "scheduling_throughput_5k_nodes_30k_pods_default_plugins",
                 "value": r["pods_per_sec"],
                 "unit": "pods/s",
@@ -288,9 +325,20 @@ def main() -> int:
                         "wal_bytes": jstats["wal_bytes"],
                     },
                 },
-            }
-        )
-    )
+    }
+    # The declarative sentinel (ISSUE 16): every guard the table names,
+    # evaluated against THIS payload + the committed references — the
+    # generalization of journal_guard/flagship above (kept for artifact
+    # continuity; the exit decision below is the sentinel's).
+    sentinel_mod = None
+    try:
+        sentinel_mod = _load_sentinel()
+        payload["sentinel"] = sentinel_mod.evaluate(payload)
+    except Exception as exc:
+        print(f"bench: sentinel evaluation failed: {exc}", file=sys.stderr)
+        payload["sentinel"] = None
+    payload["measured_matrix"] = _measured_provenance()
+    print(json.dumps(payload))
     if r["phase_attribution"]["coverage"] < 0.95:
         print(
             f"bench: phase attribution covers only "
@@ -298,23 +346,35 @@ def main() -> int:
             "time (target >= 95%) — the tiling is leaking",
             file=sys.stderr,
         )
-    if guard is not None and guard["ratio"] < HARD_FLOOR:
+    sentinel = payload.get("sentinel")
+    if sentinel is not None and sentinel["hard_failures"]:
         print(
-            f"bench guard HARD FAIL: ratio {guard['ratio']} below "
-            f"{HARD_FLOOR} — beyond tunnel variance, journaling (or a "
-            "regression riding with it) is taxing the hot path",
+            "bench guard HARD FAIL: sentinel floors breached — "
+            f"{', '.join(sentinel['hard_failures'])} (beyond tunnel "
+            "variance; see the sentinel block / bench_sentinel.py)",
             file=sys.stderr,
         )
         return 1
-    fg = (flagship or {}).get("guard")
-    if fg is not None and fg["ratio"] < HARD_FLOOR:
-        print(
-            f"bench guard HARD FAIL: flagship row ratio {fg['ratio']} "
-            f"below {HARD_FLOOR} — the interpodaffinity worst case "
-            "regressed beyond tunnel variance",
-            file=sys.stderr,
-        )
-        return 1
+    if sentinel is None:
+        # Sentinel unavailable (table unloadable): the legacy hard
+        # floors stay the backstop.
+        if guard is not None and guard["ratio"] < HARD_FLOOR:
+            print(
+                f"bench guard HARD FAIL: ratio {guard['ratio']} below "
+                f"{HARD_FLOOR} — beyond tunnel variance, journaling (or "
+                "a regression riding with it) is taxing the hot path",
+                file=sys.stderr,
+            )
+            return 1
+        fg = (flagship or {}).get("guard")
+        if fg is not None and fg["ratio"] < HARD_FLOOR:
+            print(
+                f"bench guard HARD FAIL: flagship row ratio {fg['ratio']} "
+                f"below {HARD_FLOOR} — the interpodaffinity worst case "
+                "regressed beyond tunnel variance",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 

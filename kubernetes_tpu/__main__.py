@@ -163,16 +163,38 @@ def _build_scheduler(args):
     else:
         from .framework.config import named_extra_profiles
 
+        # Named extra profiles (ISSUE 14: throughput-aware /
+        # learned-scorer) registered beside the default; pods select
+        # by schedulerName.  Full profile control stays with --config.
+        profiles = named_extra_profiles(getattr(args, "profile", ""))
+        mm_doc = None
+        mm_path = getattr(args, "measured_matrix", "")
+        if mm_path:
+            # ISSUE 16: arm a MEASURED throughput matrix (the flight-
+            # derived measured_matrix.json artifact) — it replaces the
+            # synthetic matrix in the throughput-aware profile,
+            # registering the profile if --profile did not.
+            from .framework import measured
+            from .ops.throughput import throughput_aware_profile
+
+            try:
+                mm_doc = measured.load(mm_path)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"--measured-matrix {mm_path}: {e}")
+            profiles = [
+                p for p in profiles if p.name != "throughput-aware-scheduler"
+            ] + [throughput_aware_profile(matrix=measured.matrix_rows(mm_doc))]
         sched = TPUScheduler(
             batch_size=args.batch_size,
             chunk_size=args.chunk_size,
             pipeline_depth=getattr(args, "pipeline_depth", 1),
             tenant_attribution=not getattr(args, "no_observability", False),
-            # Named extra profiles (ISSUE 14: throughput-aware /
-            # learned-scorer) registered beside the default; pods select
-            # by schedulerName.  Full profile control stays with --config.
-            profiles=named_extra_profiles(getattr(args, "profile", "")),
+            profiles=profiles,
         )
+        if mm_doc is not None:
+            # Publish the armed rows into the gauge family so a scrape
+            # shows exactly what the profile scores against.
+            sched.note_measured_matrix(mm_doc)
     return sched
 
 
@@ -542,6 +564,33 @@ def cmd_fleet(args) -> int:
                 except (OSError, RuntimeError) as exc:
                     owners[sock] = {"unreachable": str(exc)}
             doc["owners"] = owners
+            # Measured-throughput block (ISSUE 16): fold every reachable
+            # owner's flight ring into the fleet's measured matrix —
+            # what `measured --out` would commit, inline in status.
+            from .framework import measured
+            from .sidecar import SidecarClient as _SC
+
+            snaps = []
+            for sock in args.sockets.split(","):
+                sock = sock.strip()
+                if not sock or "unreachable" in owners.get(sock, {}):
+                    continue
+                try:
+                    client = _SC(sock, deadline_s=_cli_deadline(args))
+                    try:
+                        snaps.append(client.flight(limit=0))
+                    finally:
+                        client.close()
+                except (OSError, RuntimeError):
+                    continue
+            if snaps:
+                mdoc = measured.derive(snaps)
+                doc["measured_throughput"] = {
+                    "matrix": mdoc["matrix"],
+                    "binds": mdoc["window"]["binds"],
+                    "records": mdoc["window"]["records"],
+                    "source_sha256": mdoc["source"]["sha256"],
+                }
         state_path = _autoscale_state_path(args)
         if os.path.exists(state_path):
             # The autoscaler's status mirror (live loop or `fleet
@@ -776,6 +825,69 @@ def cmd_flight(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Export a live sidecar's flight ring as Perfetto/Chrome
+    trace-event JSON (framework/trace_export.py) — same rendering the
+    HTTP ``GET /debug/trace`` surface and scripts/export_trace.py
+    produce, so a live deployment exports without file access.  Open the
+    output in https://ui.perfetto.dev or chrome://tracing."""
+    from .framework import trace_export
+    from .sidecar import SidecarClient
+
+    client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
+    try:
+        doc = client.flight(limit=args.limit)
+    finally:
+        client.close()
+    text = trace_export.render(doc, timebase=args.timebase)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
+def cmd_measured(args) -> int:
+    """Derive a measured throughput-matrix artifact
+    (framework/measured.py) from flight dumps — committed soak dumps,
+    merge_fleet documents, or a live sidecar's ring (--socket)."""
+    from .framework import measured
+
+    docs = []
+    if args.socket:
+        from .sidecar import SidecarClient
+
+        client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
+        try:
+            docs.append(client.flight(limit=0))
+        finally:
+            client.close()
+    for path in args.dumps:
+        with open(path, "r", encoding="utf-8") as f:
+            docs.append(json.load(f))
+    if not docs:
+        raise SystemExit("measured: need --socket and/or flight dump files")
+    doc = measured.derive(docs, lc_lo=args.lc_lo, lc_hi=args.lc_hi)
+    if not doc["matrix"]:
+        raise SystemExit(
+            "measured: no (workload class, accel class) binds in the "
+            "window — run a heterogeneity profile workload first"
+        )
+    measured.validate(doc)
+    if args.out:
+        measured.save(doc, args.out)
+        print(
+            f"wrote {args.out} — {len(doc['matrix'])} workload classes, "
+            f"{doc['window']['binds']} binds "
+            f"(source sha {doc['source']['sha256'][:12]}…)"
+        )
+    else:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     import logging
 
@@ -810,6 +922,14 @@ def main(argv: list[str] | None = None) -> int:
         help="register a named extra profile beside the default (ISSUE "
         "14 heterogeneity scorers); pods select it by schedulerName — "
         "full profile control (matrices, weights files) via --config",
+    )
+    s.add_argument(
+        "--measured-matrix", default="", metavar="PATH",
+        help="arm a measured throughput matrix artifact (ISSUE 16: "
+        "framework/measured.py measured_matrix.json) — the throughput-"
+        "aware profile scores against the MEASURED rows instead of the "
+        "synthetic committed matrix, and the rows are published as "
+        "scheduler_measured_throughput_millis gauges",
     )
     s.add_argument(
         "--speculate", action="store_true",
@@ -1069,6 +1189,60 @@ def main(argv: list[str] | None = None) -> int:
         help="per-call deadline in seconds; <=0 waits forever",
     )
     fl.set_defaults(fn=cmd_flight)
+
+    tr = sub.add_parser(
+        "trace",
+        help="export a live sidecar's flight ring as Perfetto/Chrome "
+        "trace-event JSON",
+    )
+    tr.add_argument("--socket", required=True)
+    tr.add_argument(
+        "--limit", type=int, default=0,
+        help="newest N records only (0 = the whole ring)",
+    )
+    tr.add_argument(
+        "--timebase", default="logical", choices=("logical", "wall"),
+        help="logical = the deterministic timeline (wall fields "
+        "stripped, byte-stable across same-seed runs); wall = honest "
+        "wall-clock attribution",
+    )
+    tr.add_argument(
+        "--out", default="", help="write here instead of stdout"
+    )
+    tr.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds; <=0 waits forever",
+    )
+    tr.set_defaults(fn=cmd_trace)
+
+    ms = sub.add_parser(
+        "measured",
+        help="derive a measured throughput-matrix artifact from flight "
+        "dumps or a live sidecar",
+    )
+    ms.add_argument(
+        "dumps", nargs="*",
+        help="flight dump / merge_fleet JSON files to fold",
+    )
+    ms.add_argument("--socket", default="", help="also fold a live ring")
+    ms.add_argument(
+        "--lc-lo", type=float, default=None,
+        help="logical window lower bound (inclusive)",
+    )
+    ms.add_argument(
+        "--lc-hi", type=float, default=None,
+        help="logical window upper bound (exclusive)",
+    )
+    ms.add_argument(
+        "--out", default="",
+        help="write the artifact here (e.g. measured_matrix.json) "
+        "instead of stdout",
+    )
+    ms.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds; <=0 waits forever",
+    )
+    ms.set_defaults(fn=cmd_measured)
 
     args = ap.parse_args(argv)
     return args.fn(args)

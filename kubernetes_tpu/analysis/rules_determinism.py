@@ -72,7 +72,16 @@ class DeterminismRule(Rule):
     name = "det"
 
     def files(self, root) -> list[str]:
-        rels = ["kubernetes_tpu/sidecar/speculate.py"]
+        rels = [
+            "kubernetes_tpu/sidecar/speculate.py",
+            # PR 16's derived-artifact surfaces promise byte-identical
+            # output across same-seed runs: the measured-matrix deriver
+            # must window on the logical clock (never wall time) and
+            # iterate its cells in sorted order, and the trace exporter's
+            # logical timebase must never read a clock at all.
+            "kubernetes_tpu/framework/measured.py",
+            "kubernetes_tpu/framework/trace_export.py",
+        ]
         for sub in ("ops", "engine", "loadgen", "fleet"):
             top = os.path.join(root, "kubernetes_tpu", sub)
             # Recursive: a future subpackage under ops/ or engine/ must not

@@ -200,6 +200,14 @@ def drain_commit(sched, ticket: CommitTicket) -> float:
             m.registry.scheduling_sli.observe(lat)
         ticket.applied += 1
     ticket.drained = True
+    # Stage flight fields: deterministic drain counts on the current
+    # batch's flight record — the trace exporter sizes/labels the drain
+    # slice from these, never from wall seconds (which differ run to
+    # run).  A recovery drain outside a batch has no accumulator; the
+    # guard inside _flight_add keeps this a no-op there.
+    sched._flight_add("drained", ticket.applied)
+    if journal is not None:
+        sched._flight_add("group_fsyncs", 1)
     return time.perf_counter() - t0
 
 

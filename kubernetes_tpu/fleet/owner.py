@@ -317,6 +317,14 @@ class ShardOwner:
             }
             if tlabel is not None:
                 rec["tenant"] = tlabel
+            if bound:
+                # The bounded workload-class|accel key of this bind —
+                # the fleet-mode input framework/measured.py folds into
+                # measured throughput rows (merge_fleet keeps it on the
+                # deterministic timeline).
+                hkey = self.sched.hetero_bind_key(pod, node_name)
+                if hkey is not None:
+                    rec["hetero"] = {hkey: 1}
             self._flight_op("commit", pod, rec)
         return out
 
@@ -333,19 +341,19 @@ class ShardOwner:
                 self.tenant_commits[tlabel] = (
                     self.tenant_commits.get(tlabel, 0) + 1
                 )
-                self._flight_op(
-                    "commit_reserved",
-                    out.pod,
-                    {
-                        "pods": 1,
-                        "scheduled": 1,
-                        "tenant": tlabel,
-                        "wall_s": round(time.perf_counter() - t0, 6),
-                        "phases": {
-                            "commit": round(time.perf_counter() - t0, 6)
-                        },
+                rec = {
+                    "pods": 1,
+                    "scheduled": 1,
+                    "tenant": tlabel,
+                    "wall_s": round(time.perf_counter() - t0, 6),
+                    "phases": {
+                        "commit": round(time.perf_counter() - t0, 6)
                     },
-                )
+                }
+                hkey = self.sched.hetero_bind_key(out.pod, out.node_name)
+                if hkey is not None:
+                    rec["hetero"] = {hkey: 1}
+                self._flight_op("commit_reserved", out.pod, rec)
         return out
 
     def abort(self, uid: str) -> None:

@@ -1102,3 +1102,16 @@ class FleetRouter:
         snaps.append(self.flight.snapshot(limit))
         names.append("router")
         return snaps, names
+
+    def measured_throughput(
+        self, lc_lo: float | None = None, lc_hi: float | None = None
+    ) -> dict:
+        """Fold the whole fleet's flight rings (owners + router) into one
+        measured throughput-matrix artifact (framework/measured.py): the
+        live analog of ``kubernetes-tpu measured --socket`` for an
+        in-process fleet.  Deterministic — derived purely from per-batch
+        hetero bind counts on the logical window, never wall time."""
+        from ..framework import measured
+
+        snaps, _names = self.fleet_flight_snapshots()
+        return measured.derive(snaps, lc_lo=lc_lo, lc_hi=lc_hi)

@@ -404,10 +404,26 @@ def _apply_plugin_config(
                 )
         elif name == "ThroughputAware":
             # {"matrix": {workloadClass: {accelClass: milliThroughput}}}
-            # — the Gavel matrix as profile config (ops/throughput.py).
-            bad = set(args) - {"matrix"}
+            # — the Gavel matrix as profile config (ops/throughput.py) —
+            # or {"matrixFile": path}: a MEASURED matrix artifact
+            # (framework/measured.py), loaded and schema/version/
+            # finiteness-validated at CONFIG time like the learned
+            # scorer's weightsFile; a bad artifact is a config error,
+            # caught before serving.
+            bad = set(args) - {"matrix", "matrixFile"}
             if bad:
                 raise _err(p, f"unknown args {sorted(bad)}")
+            if "matrixFile" in args:
+                if "matrix" in args:
+                    raise _err(p, "matrix and matrixFile are exclusive")
+                from ..ops.throughput import load_matrix
+
+                mpath = args["matrixFile"]
+                try:
+                    kwargs["throughput_matrix"] = load_matrix(str(mpath))
+                except (OSError, ValueError, KeyError) as e:
+                    raise _err(p, f"matrixFile {mpath!r}: {e}")
+                continue
             matrix = args.get("matrix", {})
             if not isinstance(matrix, dict):
                 raise _err(p, "matrix must be an object")

@@ -221,10 +221,15 @@ PHASE_ORDER = (
 )
 
 # Deterministic record fields the merged timeline keeps (everything
-# wall-derived stays out — the hash must replay).
+# wall-derived stays out — the hash must replay).  ``hetero`` (the
+# per-record {workload_class|accel: binds} split) and ``drained``/
+# ``group_fsyncs`` (the pipeline drain's counts) ride along so a merged
+# fleet doc still carries the inputs framework/measured.py folds into
+# measured throughput rows and the trace exporter sizes stages from.
 _TIMELINE_FIELDS = (
     "event", "pods", "scheduled", "unschedulable", "deferred",
     "dispatch", "tenant", "op", "shard", "from", "to", "clock", "version",
+    "hetero", "drained", "group_fsyncs",
 )
 
 

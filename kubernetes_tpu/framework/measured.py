@@ -1,0 +1,220 @@
+"""Measured throughput matrices: fold flight records into the Gavel
+matrix the throughput-aware profile scores against (ISSUE 16 tentpole).
+
+PR 14's profile ships a SYNTHETIC committed matrix; Gavel (arxiv
+2008.09213) assumes *measured* throughputs.  The flight recorder already
+stamps every bind with its bounded ``"workload_class|accel"`` key (the
+per-batch ``hetero`` field, scheduler.hetero_bind_key; fleet owners
+stamp the same key on per-op commit records and merge_fleet keeps it on
+the deterministic timeline) — this module is the missing half of the
+learning loop: derive, validate, and round-trip the
+``measured_matrix.json`` artifact.
+
+Determinism contract (the acceptance oracle): the derivation consumes
+ONLY deterministic record fields — bind counts and logical positions
+(``lc`` when stamped, ring ``seq`` otherwise).  Wall-clock fields
+(``ts``, ``wall_s``, ``phases``) never participate, mirroring
+merge_fleet's timeline-hash discipline, so two same-seed soaks emit
+byte-identical artifacts.  Milli-throughput is integer-normalized per
+row (``binds * scale // row_max``): the best-measured accelerator in
+each workload-class row scores ``scale`` (1000), preserving the observed
+per-row preference ORDER — exactly what the op's static row-max
+normalizer needs for partition-independent scores (the N=2 fleet
+oracle).
+
+Stdlib-only, like profile_report: the sentinel, the CLI and the HTTP
+surfaces load this without touching JAX.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+
+MEASURED_VERSION = 1
+MEASURED_KIND = "measured_throughput_matrix"
+DEFAULT_SCALE = 1000
+DEFAULT_ARTIFACT = "measured_matrix.json"
+
+
+def _records_of(doc) -> list[tuple[str, list[dict]]]:
+    """Normalize any flight-shaped document to ``[(component, records)]``:
+    a ``FlightRecorder.snapshot`` dump, a ``merge_fleet`` document (its
+    deterministic ``timeline`` carries the ``hetero`` field), or a bare
+    record list."""
+    if isinstance(doc, list):
+        return [("records", doc)]
+    if not isinstance(doc, dict):
+        raise ValueError(f"not a flight document: {type(doc).__name__}")
+    if doc.get("metric") == "fleet_flight_merge":
+        out: dict[str, list[dict]] = {}
+        for entry in doc.get("timeline") or ():
+            out.setdefault(entry.get("component", "?"), []).append(entry)
+        return sorted(out.items())
+    name = str(doc.get("component", "component"))
+    return [(name, list(doc.get("records") or ()))]
+
+
+def _position(rec: dict) -> float:
+    """A record's logical position: the stamped logical clock when the
+    driver fed one (fleet records, soak scenario time), the ring seq
+    otherwise — both deterministic, never the wall ``ts``."""
+    lc = rec.get("lc")
+    if lc is not None:
+        return float(lc)
+    return float(rec.get("seq", 0))
+
+
+def fold(docs, lc_lo=None, lc_hi=None):
+    """Fold flight documents into per-(workload_class, accel) bind
+    counts over the half-open logical window ``[lc_lo, lc_hi)`` (None =
+    open end).  Returns ``(cells, spine)`` where ``cells`` maps
+    ``wclass -> accel -> binds`` and ``spine`` is the deterministic
+    provenance list ``[component, position, [[key, n], ...]]`` the
+    artifact's source sha256 is computed over."""
+    if isinstance(docs, dict):
+        docs = [docs]
+    else:
+        docs = list(docs)
+        if not (
+            docs
+            and all(isinstance(d, dict) for d in docs)
+            and any("records" in d or "timeline" in d for d in docs)
+        ):
+            # A bare record list (no snapshot envelopes): one pseudo-doc.
+            docs = [docs]
+    cells: dict[str, dict[str, int]] = {}
+    spine: list = []
+    for doc in docs:
+        for component, records in _records_of(doc):
+            for rec in records:
+                hetero = rec.get("hetero")
+                if not hetero:
+                    continue
+                pos = _position(rec)
+                if lc_lo is not None and pos < lc_lo:
+                    continue
+                if lc_hi is not None and pos >= lc_hi:
+                    continue
+                items = sorted(hetero.items())
+                spine.append([component, pos, items])
+                for key, n in items:
+                    wclass, _sep, accel = str(key).partition("|")
+                    # Unlabeled pods/nodes ("-") carry no class signal —
+                    # a matrix row for them would never match a label.
+                    if wclass == "-" or accel == "-" or not accel:
+                        continue
+                    row = cells.setdefault(wclass, {})
+                    row[accel] = row.get(accel, 0) + int(n)
+    spine.sort(key=lambda e: (e[1], e[0]))
+    return cells, spine
+
+
+def derive(docs, lc_lo=None, lc_hi=None, scale: int = DEFAULT_SCALE) -> dict:
+    """Derive the versioned measured-matrix artifact document from
+    flight documents (see :func:`fold` for the window semantics).
+    Deterministic: integer milli rows, sorted keys, wall fields never
+    consulted — two same-seed runs produce byte-identical artifacts
+    through :func:`save`."""
+    if scale <= 0:
+        raise ValueError(f"scale must be positive, got {scale}")
+    cells, spine = fold(docs, lc_lo=lc_lo, lc_hi=lc_hi)
+    matrix: dict[str, dict[str, int]] = {}
+    binds = 0
+    for wclass in sorted(cells):
+        row = cells[wclass]
+        row_max = max(row.values())
+        binds += sum(row.values())
+        matrix[wclass] = {
+            accel: (row[accel] * scale) // row_max for accel in sorted(row)
+        }
+    components = sorted({e[0] for e in spine})
+    source_sha = hashlib.sha256(
+        json.dumps(spine, sort_keys=True).encode()
+    ).hexdigest()
+    return {
+        "version": MEASURED_VERSION,
+        "kind": MEASURED_KIND,
+        "scale": scale,
+        "window": {
+            "lc_lo": lc_lo,
+            "lc_hi": lc_hi,
+            "records": len(spine),
+            "binds": binds,
+        },
+        "source": {"components": components, "sha256": source_sha},
+        "cells": {w: dict(sorted(cells[w].items())) for w in sorted(cells)},
+        "matrix": matrix,
+    }
+
+
+def validate(doc: dict) -> dict:
+    """Schema/version/finiteness-validate one artifact document (the
+    ops/throughput.py loader's contract, mirroring ops/learned
+    load_weights): raises ValueError on anything a profile must not
+    score against."""
+    if not isinstance(doc, dict):
+        raise ValueError("measured matrix artifact must be a JSON object")
+    if doc.get("version") != MEASURED_VERSION:
+        raise ValueError(
+            f"unsupported measured matrix version {doc.get('version')!r} "
+            f"(want {MEASURED_VERSION})"
+        )
+    if doc.get("kind") != MEASURED_KIND:
+        raise ValueError(f"unsupported artifact kind {doc.get('kind')!r}")
+    matrix = doc.get("matrix")
+    if not isinstance(matrix, dict) or not matrix:
+        raise ValueError("matrix must be a non-empty object")
+    for wclass in sorted(matrix):
+        row = matrix[wclass]
+        if not isinstance(row, dict) or not row:
+            raise ValueError(f"matrix[{wclass!r}] must be a non-empty object")
+        for accel in sorted(row):
+            tp = row[accel]
+            if isinstance(tp, bool) or not isinstance(tp, (int, float)):
+                raise ValueError(
+                    f"matrix[{wclass!r}][{accel!r}]: not a number: {tp!r}"
+                )
+            if not math.isfinite(tp) or tp < 0:
+                raise ValueError(
+                    f"matrix[{wclass!r}][{accel!r}]: non-finite or "
+                    f"negative throughput {tp!r}"
+                )
+        if not any(row[a] > 0 for a in sorted(row)):
+            raise ValueError(
+                f"matrix[{wclass!r}]: row needs at least one positive "
+                "throughput"
+            )
+    return doc
+
+
+def matrix_rows(doc: dict) -> tuple:
+    """The profile's hashable tuple-of-rows form
+    (``Profile.throughput_matrix``) from a validated artifact — sorted,
+    integer milli, interchangeable with the synthetic committed matrix."""
+    matrix = validate(doc)["matrix"]
+    return tuple(
+        (
+            str(wclass),
+            tuple((str(a), int(matrix[wclass][a])) for a in sorted(matrix[wclass])),
+        )
+        for wclass in sorted(matrix)
+    )
+
+
+def save(doc: dict, path: str) -> str:
+    """Write one artifact — sorted keys, indent 1, trailing newline, the
+    repo's committed-artifact byte discipline (same-doc saves are
+    byte-identical)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load(path: str) -> dict:
+    """Read + validate one artifact file (ValueError on schema drift,
+    OSError on a missing file — both config errors at the caller)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return validate(json.load(f))

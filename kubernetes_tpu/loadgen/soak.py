@@ -2122,16 +2122,38 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             merged = merge_fleet(snaps, names)
             merged["slow_spans"] = list(router.slow_spans)
             merged_sha = merged["timeline_sha256"]
+            # The Perfetto twin: the merged timeline rendered as
+            # trace-event JSON on the logical timebase (wall fields
+            # stripped — same-seed runs export byte-identically), written
+            # next to the merged doc and stamped into it so the fleet
+            # renderer (scripts/profile_report.py) can link the artifact.
+            from ..framework import trace_export
+
+            trace_name = "fleet-trace.json"
+            merged["perfetto"] = trace_name
             merged_path = os.path.join(out_dir, "fleet-flight-merged.json")
             with open(merged_path, "w", encoding="utf-8") as f:
                 json.dump(merged, f, indent=1, sort_keys=True)
+            with open(
+                os.path.join(out_dir, trace_name), "w", encoding="utf-8"
+            ) as f:
+                f.write(trace_export.render(merged, timebase="logical"))
+            # Flight-derived measured throughput over the same rings
+            # (empty matrix when the scenario has no hetero classes).
+            mt = router.measured_throughput()
             fleet_timeline = {
                 "file": os.path.basename(merged_path),
+                "perfetto": trace_name,
                 "timeline_sha256": merged_sha,
                 "events": merged["timeline_events"],
                 "components": merged["components"],
                 "wall": merged["wall"],
                 "critical_path_top": merged["critical_path"][:8],
+                "measured_throughput": {
+                    "matrix": mt["matrix"],
+                    "binds": mt["window"]["binds"],
+                    "source_sha256": mt["source"]["sha256"],
+                },
             }
         registry_summary = router.registry.summary()
     finally:

@@ -184,6 +184,17 @@ register(
 )
 
 
+def load_matrix(path: str) -> tuple:
+    """Load a measured ``measured_matrix.json`` artifact (ISSUE 16:
+    framework/measured.py — schema/version/finiteness-validated) into
+    the profile's tuple-of-rows form, interchangeable with
+    DEFAULT_THROUGHPUT_MATRIX.  ValueError/OSError are config errors at
+    the caller (configv1 ``matrixFile``, ``serve --measured-matrix``)."""
+    from ..framework import measured
+
+    return measured.matrix_rows(measured.load(path))
+
+
 def throughput_aware_profile(
     matrix: tuple = DEFAULT_THROUGHPUT_MATRIX, weight: int = 3
 ) -> Profile:

@@ -581,6 +581,12 @@ class TPUScheduler:
             "Pods bound per scheduler profile (the multi-profile map's "
             "serving split).",
         )
+        self._measured_tput = reg.gauge(
+            "scheduler_measured_throughput_millis",
+            "Flight-derived measured milli-throughput per (workload "
+            "class, accelerator class) — published when a measured "
+            "matrix artifact is armed (framework/measured.py).",
+        )
         # Software pipeline (ISSUE 15): predispatch double-buffer hits vs
         # invalidations (a miss re-dispatches serially — correctness is
         # free, overlap is not), drain placement (overlapped under an
@@ -848,8 +854,30 @@ class TPUScheduler:
         folds to "-"/"other") and the per-profile serving split
         (scheduler_profile_bound_total, bounded by the profile map)."""
         self._note_tenant("bound", pod)
-        if self._hetero_classes is None:
+        key = self.hetero_bind_key(pod, node_name)
+        if key is None:
             return
+        wl, al = key.split("|", 1)
+        self._hetero_bound.inc(accel=al, workload_class=wl)
+        # The per-batch heterogeneity split on the flight record — the
+        # deterministic input framework/measured.py folds into measured
+        # throughput rows (counts, never wall time).
+        acc = self._flight_acc
+        if acc is not None:
+            h = acc.setdefault("hetero", {})
+            h[key] = h.get(key, 0) + 1
+        profile = self._profile_for(pod) or self.profile
+        self._profile_bound.inc(profile=profile.name)
+
+    def hetero_bind_key(self, pod: t.Pod, node_name: str) -> str | None:
+        """The bounded ``"workload_class|accel"`` key for one bind — None
+        when no registered profile carries a throughput matrix.  Label
+        values are bounded by the matrix config (everything else folds to
+        "-"/"other"), shared by the hetero counter, the per-batch flight
+        ``hetero`` field, and the fleet owners' per-op commit records, so
+        measured-matrix derivation sees one vocabulary everywhere."""
+        if self._hetero_classes is None:
+            return None
         accels, wclasses = self._hetero_classes
         from .ops.throughput import ACCEL_LABEL_KEY, WORKLOAD_CLASS_LABEL_KEY
 
@@ -860,14 +888,29 @@ class TPUScheduler:
             else ""
         )
         wclass = pod.metadata.labels.get(WORKLOAD_CLASS_LABEL_KEY, "")
-        self._hetero_bound.inc(
-            accel=(accel if accel in accels else "other") if accel else "-",
-            workload_class=(
-                (wclass if wclass in wclasses else "other") if wclass else "-"
-            ),
-        )
-        profile = self._profile_for(pod) or self.profile
-        self._profile_bound.inc(profile=profile.name)
+        al = (accel if accel in accels else "other") if accel else "-"
+        wl = (wclass if wclass in wclasses else "other") if wclass else "-"
+        return f"{wl}|{al}"
+
+    def note_measured_matrix(self, matrix) -> None:
+        """Publish a measured throughput matrix into the
+        scheduler_measured_throughput_millis gauge family — called when
+        serve arms a measured artifact (``--measured-matrix``) so a
+        scrape shows exactly which rows the profile scores against.
+        Accepts the profile's tuple-of-rows form, a measured artifact
+        document, or its ``{wclass: {accel: milli}}`` mapping."""
+        rows = matrix.get("matrix", matrix) if isinstance(matrix, dict) else matrix
+        if isinstance(rows, dict):
+            rows = tuple(
+                (w, tuple(sorted(r.items()))) for w, r in sorted(rows.items())
+            )
+        for wclass, row in rows:
+            for accel, milli in row:
+                self._measured_tput.set(
+                    float(milli),
+                    workload_class=str(wclass),
+                    accel=str(accel),
+                )
 
     def _flight_add(self, key: str, n) -> None:
         acc = self._flight_acc
@@ -1035,6 +1078,14 @@ class TPUScheduler:
             }
             if saved_s > 0:
                 self._pipeline_overlap_counter.inc(saved_s)
+        if acc.get("hetero"):
+            rec["hetero"] = {
+                k: acc["hetero"][k] for k in sorted(acc["hetero"])
+            }
+        if acc.get("drained"):
+            rec["drained"] = acc["drained"]
+        if acc.get("group_fsyncs"):
+            rec["group_fsyncs"] = acc["group_fsyncs"]
         if acc["plugins"]:
             rec["plugins"] = {
                 k: round(v, 6) for k, v in sorted(acc["plugins"].items())

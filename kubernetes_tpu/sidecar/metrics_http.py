@@ -136,6 +136,14 @@ class ObservabilityHTTPServer:
                     self._send(
                         200, "application/json", json.dumps(doc).encode()
                     )
+                elif path == "/debug/trace":
+                    # Perfetto/Chrome trace-event rendering of the same
+                    # ring (framework/trace_export.py) — open the body
+                    # in ui.perfetto.dev / chrome://tracing.  Logical
+                    # timebase: deterministic, wall fields stripped —
+                    # byte-identical to the `trace` CLI subcommand.
+                    body = outer._trace(_parse_limit(self.path))
+                    self._send(200, "application/json", body.encode())
                 else:
                     self._send(404, "text/plain", b"not found\n")
 
@@ -182,6 +190,11 @@ class ObservabilityHTTPServer:
         if self.client is not None:
             return self.client.flight(limit)
         return self.scheduler.flight.snapshot(limit or None)
+
+    def _trace(self, limit: int) -> str:
+        from ..framework import trace_export
+
+        return trace_export.render(self._flight(limit), timebase="logical")
 
     def serve_background(self) -> None:
         self._thread = threading.Thread(

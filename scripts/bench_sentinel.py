@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Declarative bench/SLO regression sentinel (ISSUE 16 tentpole c).
+
+PR 3's journal_guard and PR 11's flagship floor were two hand-rolled
+ad-hoc checks; this generalizes them into ONE declarative guard table
+evaluated over the committed BENCH_*/SOAK_*/OBS_TAX trajectory:
+
+  headline           ratio vs the newest committed bench point
+  flagship           ratio vs its newest committed point
+  journal_fsyncs     group commit must stay group commit (a per-append
+                     fsync regression is ~3 orders of magnitude)
+  overlap_coverage   the pipeline's overlap must stay engaged
+  slo_p99            decision latency vs the recorded budget
+  obs_tax            the observability A/B gate (<= 2%)
+
+Each guard has a WARN boundary (reported, tunnel weather happens — see
+README measurement discipline) and a HARD floor (exit 1: beyond any
+weather, a real regression).  ``bench.py`` embeds the same evaluation as
+a ``sentinel`` block in every payload it prints, and the tier-1 gate
+runs ``--check`` against the committed trajectory — a regressing PR
+fails BEFORE it records an artifact.
+
+Stdlib-only (loaded by file path from bench.py and the tier-1 test):
+
+    python scripts/bench_sentinel.py --check
+    python scripts/bench_sentinel.py --payload fresh_payload.json
+    JAX_PLATFORMS=cpu python bench.py | python scripts/bench_sentinel.py --payload -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+# ---------------------------------------------------------------------------
+# The guard table.  ``value`` paths index into the bench payload under
+# test; ``source`` guards read their value from a committed artifact
+# family instead (newest round wins).  Ops:
+#   ratio_min — value / reference must stay >= warn (warn) / hard (fail)
+#   max       — value must stay <= warn / hard
+#   min       — value must stay >= warn / hard
+# ``budget_key`` (slo_p99) scales warn/hard off the payload's recorded
+# budget instead of a constant.
+GUARDS = (
+    {
+        "name": "headline",
+        "value": ("value",),
+        "reference": {"family": "BENCH_r*.json", "path": ("value",)},
+        "op": "ratio_min",
+        "warn": 0.95,
+        "hard": 0.70,
+        "why": "headline pods/s vs the newest committed trajectory point",
+    },
+    {
+        "name": "flagship",
+        "value": ("flagship", "value"),
+        "reference": {"family": "BENCH_r*.json", "path": ("flagship", "value")},
+        "op": "ratio_min",
+        "warn": 0.95,
+        "hard": 0.70,
+        "why": "interpodaffinity worst case vs its newest committed point",
+    },
+    {
+        "name": "journal_fsyncs",
+        "value": ("detail", "journal", "fsyncs"),
+        "op": "max",
+        "warn": 16,
+        "hard": 64,
+        "why": "group commit: one fsync barrier per staged group — a "
+        "per-append regression is O(appends) barriers",
+    },
+    {
+        "name": "overlap_coverage",
+        "value": ("phase_attribution", "overlap", "coverage"),
+        "op": "min",
+        "warn": 0.10,
+        "hard": 0.02,
+        "why": "the pipeline's stage overlap must stay engaged "
+        "(PR 15's whole point)",
+    },
+    {
+        "name": "slo_p99",
+        "value": ("slo", "p99_ms"),
+        "op": "max",
+        "budget_key": ("slo", "budget_ms"),
+        "warn": 1.0,   # x budget
+        "hard": 4.0,   # x budget
+        "why": "decision latency p99 vs the recorded SLO budget",
+    },
+    {
+        "name": "obs_tax",
+        "source": {"family": "OBS_TAX_r*.json", "path": ("tax",)},
+        "op": "max",
+        "warn": 0.015,
+        "hard": 0.02,
+        "why": "the observability A/B gate: attribution + exporter "
+        "surfaces must cost <= 2% throughput",
+    },
+)
+
+
+def newest_artifact(root: str, family: str) -> str | None:
+    """The newest committed round of one artifact family
+    (``BENCH_r*.json`` → the highest ``r<N>``)."""
+    rx = re.compile(re.escape(family).replace(r"\*", r"(\d+)") + r"$")
+    best, best_n = None, -1
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return None
+    for name in names:
+        m = rx.match(name)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = name, int(m.group(1))
+    return os.path.join(root, best) if best else None
+
+
+def load_payload(path: str) -> dict:
+    """One bench payload — raw, or the recorded-trajectory wrapper
+    (``{"parsed": payload}``, the driver's capture format)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc.get("parsed") or doc
+
+
+def _dig(doc, path):
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur
+
+
+def _eval_guard(guard: dict, payload: dict | None, root: str) -> dict:
+    out = {
+        "name": guard["name"],
+        "op": guard["op"],
+        "why": guard["why"],
+        "status": "pass",
+    }
+    # The value under test: from the payload, or from a committed
+    # artifact family (obs_tax — the payload never carries it).
+    if "source" in guard:
+        src = newest_artifact(root, guard["source"]["family"])
+        if src is None:
+            out["status"] = "missing"
+            out["missing"] = guard["source"]["family"]
+            return out
+        out["source_file"] = os.path.basename(src)
+        try:
+            value = _dig(load_payload(src), guard["source"]["path"])
+        except (OSError, ValueError):
+            value = None
+    else:
+        value = _dig(payload or {}, guard["value"])
+    if value is None:
+        out["status"] = "missing"
+        out["missing"] = "/".join(guard.get("value", guard.get("source", {}).get("path", ())))
+        return out
+    out["value"] = value
+    warn, hard = guard["warn"], guard["hard"]
+    if "budget_key" in guard:
+        budget = _dig(payload or {}, guard["budget_key"])
+        if budget is None:
+            out["status"] = "missing"
+            out["missing"] = "/".join(guard["budget_key"])
+            return out
+        warn, hard = warn * budget, hard * budget
+    if guard["op"] == "ratio_min":
+        ref_path = newest_artifact(root, guard["reference"]["family"])
+        if ref_path is None:
+            out["status"] = "missing"
+            out["missing"] = guard["reference"]["family"]
+            return out
+        out["reference_file"] = os.path.basename(ref_path)
+        try:
+            ref = _dig(load_payload(ref_path), guard["reference"]["path"])
+        except (OSError, ValueError):
+            ref = None
+        if not ref:
+            out["status"] = "missing"
+            out["missing"] = "/".join(guard["reference"]["path"])
+            return out
+        out["reference"] = ref
+        ratio = float(value) / float(ref)
+        out["ratio"] = round(ratio, 4)
+        out["warn_below"], out["hard_below"] = warn, hard
+        if ratio < hard:
+            out["status"] = "hard_fail"
+        elif ratio < warn:
+            out["status"] = "warn"
+        return out
+    out["warn_limit"], out["hard_limit"] = warn, hard
+    v = float(value)
+    if guard["op"] == "max":
+        if v > hard:
+            out["status"] = "hard_fail"
+        elif v > warn:
+            out["status"] = "warn"
+    elif guard["op"] == "min":
+        if v < hard:
+            out["status"] = "hard_fail"
+        elif v < warn:
+            out["status"] = "warn"
+    else:
+        raise ValueError(f"unknown guard op {guard['op']!r}")
+    return out
+
+
+def evaluate(payload: dict | None, root: str = REPO) -> dict:
+    """Evaluate the guard table against one bench payload (None = the
+    artifact-only guards).  The returned block is what bench.py embeds
+    as ``payload["sentinel"]``."""
+    guards = [_eval_guard(g, payload, root) for g in GUARDS]
+    hard = [g["name"] for g in guards if g["status"] == "hard_fail"]
+    warns = [g["name"] for g in guards if g["status"] == "warn"]
+    missing = [g["name"] for g in guards if g["status"] == "missing"]
+    return {
+        "guards": guards,
+        "hard_failures": hard,
+        "warnings": warns,
+        "missing": missing,
+        "ok": not hard,
+    }
+
+
+def check_committed(root: str = REPO) -> dict:
+    """``--check``: the tier-1 gate.  The newest committed bench point
+    IS the payload under test — the ratio guards degenerate to 1.0 (the
+    trajectory cannot regress against itself) while the absolute floors
+    (fsync count, overlap coverage, SLO budget, obs tax) re-verify that
+    the committed artifacts still clear the table; any unreadable or
+    schema-drifted artifact surfaces as ``missing``."""
+    newest = newest_artifact(root, "BENCH_r*.json")
+    payload = load_payload(newest) if newest else None
+    block = evaluate(payload, root)
+    block["checked"] = os.path.basename(newest) if newest else None
+    return block
+
+
+def _print_table(block: dict) -> None:
+    for g in block["guards"]:
+        mark = {"pass": "ok  ", "warn": "WARN", "hard_fail": "FAIL",
+                "missing": "miss"}[g["status"]]
+        if "ratio" in g:
+            detail = (
+                f"ratio {g['ratio']} vs {g.get('reference')} "
+                f"({g.get('reference_file', '?')}; warn<{g['warn_below']} "
+                f"hard<{g['hard_below']})"
+            )
+        elif "value" in g:
+            lim = (
+                f"warn>{g['warn_limit']} hard>{g['hard_limit']}"
+                if g["op"] == "max"
+                else f"warn<{g['warn_limit']} hard<{g['hard_limit']}"
+            )
+            src = f" ({g['source_file']})" if "source_file" in g else ""
+            detail = f"value {g['value']}{src} ({lim})"
+        else:
+            detail = f"missing {g.get('missing', '?')}"
+        print(f"sentinel: {mark} {g['name']:<18} {detail}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--check", action="store_true",
+        help="evaluate the committed trajectory (the tier-1 gate)",
+    )
+    mode.add_argument(
+        "--payload", metavar="FILE",
+        help="evaluate one bench payload JSON ('-' = stdin) against the "
+        "committed references",
+    )
+    ap.add_argument(
+        "--root", default=REPO,
+        help="repo root holding the committed artifacts",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="print the sentinel block as JSON"
+    )
+    args = ap.parse_args(argv)
+    if args.check:
+        block = check_committed(args.root)
+    else:
+        if args.payload == "-":
+            doc = json.load(sys.stdin)
+            payload = doc.get("parsed") or doc
+        else:
+            payload = load_payload(args.payload)
+        block = evaluate(payload, args.root)
+    if args.json:
+        print(json.dumps(block, indent=1, sort_keys=True))
+    else:
+        _print_table(block)
+        if block.get("checked"):
+            print(f"sentinel: checked {block['checked']}")
+    if block["hard_failures"]:
+        print(
+            f"sentinel: HARD FAIL — {', '.join(block['hard_failures'])} "
+            "breached the floor (beyond tunnel variance)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Render flight dumps / merge_fleet documents as Perfetto trace-event
+JSON (ISSUE 16 tentpole b) — the file-side twin of the ``trace`` CLI
+subcommand and ``GET /debug/trace``.
+
+Stdlib-only, like profile_report.py: the rendering core
+(kubernetes_tpu/framework/trace_export.py) is loaded by file path, so
+this runs anywhere a dump landed — no JAX, no package import.
+
+    python scripts/export_trace.py soak_dumps/soak-flight.json
+    python scripts/export_trace.py --timebase wall --out run.trace.json \
+        soak_dumps/fleet-flight-merged.json
+    cat dump.json | python scripts/export_trace.py -
+
+Open the output in https://ui.perfetto.dev or chrome://tracing.  The
+default logical timebase strips every wall-derived field — two same-seed
+runs export byte-identical traces (the diffable artifact); ``--timebase
+wall`` renders honest wall attribution instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_trace_export():
+    """Import kubernetes_tpu/framework/trace_export.py by FILE PATH (it
+    is stdlib-only; the package root imports JAX and must stay out)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "kubernetes_tpu", "framework", "trace_export.py",
+    )
+    spec = importlib.util.spec_from_file_location("_tpu_trace_export", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "files", nargs="+",
+        help="flight dump / merge_fleet JSON files ('-' = stdin)",
+    )
+    ap.add_argument(
+        "--timebase", default="logical", choices=("logical", "wall"),
+        help="logical = deterministic timeline (default, byte-stable "
+        "across same-seed runs); wall = wall-clock attribution",
+    )
+    ap.add_argument(
+        "--limit", type=int, default=0,
+        help="newest N records per component (0 = all)",
+    )
+    ap.add_argument(
+        "--out", default="",
+        help="output path (single input only); default stdout; with "
+        "multiple inputs, writes <input>.trace.json next to each",
+    )
+    args = ap.parse_args(argv)
+    mod = load_trace_export()
+    if args.out and len(args.files) > 1:
+        ap.error("--out takes a single input file")
+    for path in args.files:
+        if path == "-":
+            doc = json.load(sys.stdin)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        try:
+            text = mod.render(doc, timebase=args.timebase, limit=args.limit)
+        except ValueError as e:
+            print(f"export_trace: {path}: {e}", file=sys.stderr)
+            return 1
+        if args.out:
+            dest = args.out
+        elif len(args.files) > 1 and path != "-":
+            dest = f"{os.path.splitext(path)[0]}.trace.json"
+        else:
+            dest = ""
+        if dest:
+            with open(dest, "w", encoding="utf-8") as f:
+                f.write(text)
+            n = len(json.loads(text)["traceEvents"])
+            print(f"export_trace: wrote {dest} ({n} events)", file=sys.stderr)
+        else:
+            sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

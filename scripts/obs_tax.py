@@ -1,9 +1,18 @@
 #!/usr/bin/env python
-"""Quantify the observability tax (ISSUE 12 satellite): the headline
-bench workload run with tenant attribution ON (the default — per-tenant
-counters at admission/bind/preempt/defer) vs OFF, interleaved A/B so
-box weather averages out.  Gate: the enabled run must cost <= 2%
-throughput (reported; exit 1 beyond the gate).
+"""Quantify the observability tax (ISSUE 12 satellite; re-recorded for
+ISSUE 16): the headline bench workload run with the observability
+surfaces ON (the default — per-tenant counters at admission/bind/
+preempt/defer, plus PR 16's per-batch hetero flight fields and pipeline
+stage counts) vs OFF, interleaved A/B so box weather averages out.
+Gate: the enabled run must cost <= 2% throughput (reported; exit 1
+beyond the gate).
+
+The ON leg additionally pays the PR 16 EXPORT surfaces after the run —
+a full Perfetto trace render (framework/trace_export.py) and a
+measured-matrix derivation (framework/measured.py) over the whole
+flight ring — and the A/B compares the ON leg's ALL-IN rate (scheduled
+pods over run seconds + export seconds) against the OFF leg, so the
+recorded tax includes the exporter's cost, not just the recorder's.
 
 Fleet tracing's cost does not ride the single-scheduler headline — its
 surface (span fan-out + flight lc stamps on the router/owner path) is
@@ -11,7 +20,7 @@ exercised and bounded by the fleet soak instead, whose observability
 on-vs-off leg proves bit-identical bindings (scripts/run_soak.py
 --tenant).
 
-    JAX_PLATFORMS=cpu python scripts/obs_tax.py --out OBS_TAX_r12.json
+    JAX_PLATFORMS=cpu python scripts/obs_tax.py --out OBS_TAX_r16.json
 """
 
 from __future__ import annotations
@@ -29,10 +38,15 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 GATE = 0.02  # <= 2% throughput cost
 
 
-def run_once(obs: bool) -> float:
+def run_once(obs: bool) -> dict:
+    import time
+
     from kubernetes_tpu.benchmarks import WORKLOADS, run_workload
 
+    holder: dict = {}
+
     def attach(sched) -> None:
+        holder["sched"] = sched
         if not obs:
             # The off leg: no tenant machinery at all (the ctor flag's
             # effect, applied post-construction because the harness owns
@@ -41,24 +55,56 @@ def run_once(obs: bool) -> float:
             sched.queue.tenant_note = None
 
     r = run_workload(WORKLOADS["density_5kn_30kpods_default"], attach=attach)
-    return float(r["pods_per_sec"])
+    out = {
+        "pods_per_sec": float(r["pods_per_sec"]),
+        "seconds": float(r["seconds"]),
+        "scheduled": int(r["scheduled"]),
+    }
+    if obs:
+        # The ON leg pays the export surfaces too: one full Perfetto
+        # render + one measured-matrix derivation over the ring.
+        from kubernetes_tpu.framework import measured, trace_export
+
+        snap = holder["sched"].flight.snapshot()
+        t0 = time.perf_counter()
+        text = trace_export.render(snap)
+        t1 = time.perf_counter()
+        measured.derive(snap)
+        t2 = time.perf_counter()
+        out["export"] = {
+            "records": snap["count"],
+            "trace_s": round(t1 - t0, 6),
+            "trace_bytes": len(text),
+            "derive_s": round(t2 - t1, 6),
+        }
+        export_s = t2 - t0
+        out["pods_per_sec_all_in"] = round(
+            out["scheduled"] / (out["seconds"] + export_s), 1
+        ) if out["seconds"] + export_s > 0 else 0.0
+    return out
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default="OBS_TAX_r12.json")
+    ap.add_argument("--out", default="OBS_TAX_r16.json")
     ap.add_argument("--runs", type=int, default=2,
                     help="A/B pairs (interleaved on/off)")
     args = ap.parse_args()
     on_runs: list[float] = []
     off_runs: list[float] = []
+    exports: list[dict] = []
     for i in range(args.runs):
         # Interleave: on, off, on, off — slow-window drift hits both.
-        v_on = run_once(True)
-        print(f"obs_tax: run {i}: attribution ON  {v_on} pods/s",
+        r_on = run_once(True)
+        v_on = r_on["pods_per_sec_all_in"]
+        exports.append(r_on["export"])
+        print(f"obs_tax: run {i}: observability ON  {v_on} pods/s all-in "
+              f"(raw {r_on['pods_per_sec']}, export "
+              f"{r_on['export']['trace_s'] + r_on['export']['derive_s']:.4f}s)",
               flush=True)
-        v_off = run_once(False)
-        print(f"obs_tax: run {i}: attribution OFF {v_off} pods/s",
+        r_off = run_once(False)
+        v_off = r_off["pods_per_sec"]
+        print(f"obs_tax: run {i}: observability OFF {v_off} pods/s",
               flush=True)
         on_runs.append(v_on)
         off_runs.append(v_off)
@@ -72,6 +118,7 @@ def main() -> int:
         "runs": args.runs,
         "pods_per_sec_on": on_runs,
         "pods_per_sec_off": off_runs,
+        "export": exports,
         "best_on": best_on,
         "best_off": best_off,
         "tax": round(tax, 4),

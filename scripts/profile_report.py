@@ -273,6 +273,18 @@ def soak_report(doc: dict) -> str:
             f"parallelism {(ft.get('wall') or {}).get('parallelism')}× — "
             f"render with `profile_report.py --fleet {ft.get('file')}`"
         )
+        if ft.get("perfetto"):
+            out.append(
+                f"perfetto trace: {ft['perfetto']} (next to the merged "
+                "doc; open in ui.perfetto.dev / chrome://tracing)"
+            )
+        mt = ft.get("measured_throughput") or {}
+        if mt.get("matrix"):
+            out.append(
+                f"measured throughput ({mt.get('binds')} binds folded, "
+                f"source sha {str(mt.get('source_sha256', ''))[:12]}…):"
+            )
+            out.append(_measured_matrix_table(mt["matrix"]))
     nl = doc.get("node_loss")
     if nl:
         lc = nl.get("lifecycle", {})
@@ -325,6 +337,71 @@ def soak_report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def _measured_matrix_table(matrix: dict) -> str:
+    """Render one measured (or synthetic) milli-throughput matrix —
+    workload-class rows × accelerator-class columns."""
+    accels = sorted({a for row in matrix.values() for a in row})
+    rows = [
+        (wclass, *(row.get(a, "-") for a in accels))
+        for wclass, row in sorted(matrix.items())
+    ]
+    return _table(rows, ("workload class", *accels))
+
+
+def bench_report(doc: dict) -> str:
+    """Render one bench payload (bench.py stdout / BENCH_rNN.json):
+    headline + flagship, then the PR 16 blocks — the sentinel guard
+    table and the measured-matrix provenance stamp."""
+    out = [
+        f"bench payload: {doc.get('metric')} = {doc.get('value')} "
+        f"{doc.get('unit', '')}".rstrip()
+    ]
+    fl = doc.get("flagship") or {}
+    if fl:
+        out.append(
+            f"flagship: {fl.get('metric', fl.get('name', '?'))} = "
+            f"{fl.get('value')} {fl.get('unit', '')}".rstrip()
+        )
+    sent = doc.get("sentinel")
+    if sent:
+        out.append(
+            f"\nsentinel: ok={sent.get('ok')} "
+            f"hard_failures={sent.get('hard_failures')} "
+            f"warnings={sent.get('warnings')} missing={sent.get('missing')}"
+        )
+        rows = []
+        for g in sent.get("guards", ()):
+            if "ratio" in g:
+                detail = (
+                    f"ratio {g['ratio']} vs {g.get('reference')} "
+                    f"[{g.get('reference_file', '?')}]"
+                )
+                limits = f"warn<{g.get('warn_below')} hard<{g.get('hard_below')}"
+            elif "value" in g:
+                src = f" [{g['source_file']}]" if "source_file" in g else ""
+                detail = f"value {g['value']}{src}"
+                cmp_ = "<" if g.get("op") == "min" else ">"
+                limits = (
+                    f"warn{cmp_}{g.get('warn_limit')} "
+                    f"hard{cmp_}{g.get('hard_limit')}"
+                )
+            else:
+                detail = f"missing {g.get('missing', '?')}"
+                limits = "-"
+            rows.append((g["name"], g["status"], detail, limits))
+        out.append(_table(rows, ("guard", "status", "detail", "limits")))
+    mm = doc.get("measured_matrix")
+    if mm:
+        win = mm.get("window") or {}
+        out.append(
+            f"\nmeasured matrix: {mm.get('file')} v{mm.get('version')} "
+            f"(artifact sha {str(mm.get('sha256', ''))[:12]}…, "
+            f"{win.get('binds')} binds over {win.get('records')} records, "
+            f"lc window [{win.get('lc_lo')}, {win.get('lc_hi')}])"
+        )
+    return "\n".join(out)
+
+
 def _load_flight_module():
     """Import ``kubernetes_tpu/framework/flight.py`` by FILE PATH (it is
     stdlib-only; the package root imports JAX and must stay out)."""
@@ -354,6 +431,13 @@ def fleet_report(doc: dict, timeline_tail: int = 40) -> str:
         f"{n_events} timeline events "
         f"(timeline sha {str(doc.get('timeline_sha256', ''))[:12]}…)"
     )
+    if doc.get("perfetto"):
+        # The fleet soak writes the trace-event twin next to the merged
+        # doc and stamps the filename here.
+        out.append(
+            f"perfetto trace: {doc['perfetto']} (open in ui.perfetto.dev "
+            "/ chrome://tracing)"
+        )
     rows = []
     for name, c in sorted(comps.items()):
         phases = ", ".join(
@@ -470,10 +554,17 @@ def main(argv=None) -> int:
         print(fleet_report(flight_mod.merge_fleet(docs)))
         return 0
     doc = load(args[0])
+    if isinstance(doc.get("parsed"), dict):
+        # A recorded-trajectory wrapper (the driver's capture format).
+        doc = doc["parsed"]
     if str(doc.get("metric", "")).startswith(
         ("soak_", "fleet_soak_", "tenant_soak")
     ) or ("knee" in doc and "slo" in doc):
         print(soak_report(doc))
+    elif "sentinel" in doc or str(doc.get("metric", "")).startswith(
+        "scheduling_throughput"
+    ):
+        print(bench_report(doc))
     else:
         print(report(doc))
     return 0

@@ -1,0 +1,29 @@
+"""Seeded determinism violations in a measured-matrix deriver (ISSUE
+16): a fold that windows on the WALL clock and iterates its rows in
+hash order — the two ways a "measured" artifact silently stops being
+byte-identical across same-seed runs
+(tests/test_static_analysis.py counts these)."""
+
+import time
+
+
+def fold(records):
+    # POSITIVE det-wallclock: the fold window anchored on wall time —
+    # two same-seed runs derive different windows, different artifacts.
+    lc_hi = time.time()
+    cells = {}
+    for rec in records:
+        if rec.get("ts", 0) > lc_hi:
+            continue
+        for key, n in (rec.get("hetero") or {}).items():
+            cells[key] = cells.get(key, 0) + n
+    return cells
+
+
+def matrix_rows(cells):
+    rows = []
+    # POSITIVE det-set-iteration: hash-ordered row iteration reaches the
+    # serialized artifact (the row order IS the byte order).
+    for key in {k for k in cells}:
+        rows.append((key, cells[key]))
+    return rows

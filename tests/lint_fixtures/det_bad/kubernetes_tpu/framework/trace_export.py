@@ -1,0 +1,16 @@
+"""Seeded determinism violation in a trace exporter (ISSUE 16): a
+"logical" timebase that quietly anchors on the wall clock — the export
+can never be byte-identical across same-seed runs
+(tests/test_static_analysis.py counts it)."""
+
+import datetime
+
+
+def emit_logical(records):
+    # POSITIVE det-wallclock: the logical timeline's epoch read from the
+    # wall clock — every export differs in every ts field.
+    epoch = datetime.datetime.now()
+    events = []
+    for i, rec in enumerate(records):
+        events.append({"ts": epoch.timestamp() + i, "name": rec.get("kind")})
+    return events

@@ -1,0 +1,21 @@
+"""The allowed idioms for a measured-matrix deriver: logical-clock
+windowing from the RECORDS (never a wall read) and sorted row
+iteration — byte-identical artifacts across same-seed runs."""
+
+
+def fold(records, lc_lo=None, lc_hi=None):
+    cells = {}
+    for rec in records:
+        pos = rec.get("lc", rec.get("seq", 0))
+        if lc_lo is not None and pos < lc_lo:
+            continue
+        if lc_hi is not None and pos > lc_hi:
+            continue
+        for key, n in (rec.get("hetero") or {}).items():
+            cells[key] = cells.get(key, 0) + n
+    return cells
+
+
+def matrix_rows(cells):
+    # NEGATIVE: sorted() over the key set is the fix and is exempt.
+    return [(key, cells[key]) for key in sorted(set(cells))]

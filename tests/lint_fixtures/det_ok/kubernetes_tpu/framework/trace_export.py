@@ -1,0 +1,12 @@
+"""The allowed idiom for a trace exporter's logical timebase: ordinal
+slots derived from record positions alone — no clock anywhere."""
+
+
+def emit_logical(records):
+    ordered = sorted(
+        records, key=lambda r: (r.get("lc", r.get("seq", 0)), r.get("seq", 0))
+    )
+    return [
+        {"ts": i * 1000, "name": rec.get("kind")}
+        for i, rec in enumerate(ordered)
+    ]

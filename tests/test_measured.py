@@ -1,0 +1,173 @@
+"""Measured throughput matrices (ISSUE 16 tentpole a): flight records
+fold into per-(workload class, accelerator class) milli-throughput
+artifacts — deterministically (2× same-seed runs derive byte-identical
+JSON), loadable wherever the synthetic matrix is accepted, and inert
+under the A/B oracle (a measured profile binds bit-identically in an
+N=2 fleet, exactly like the synthetic one)."""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.framework import measured
+from kubernetes_tpu.ops.throughput import (
+    load_matrix,
+    throughput_aware_profile,
+)
+from kubernetes_tpu.scheduler import TPUScheduler
+
+from test_heterogeneity import (
+    hetero_scenario,
+    run_fleet_hetero,
+    run_single_hetero,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED = os.path.join(REPO, "measured_matrix.json")
+
+
+def hetero_flight_snapshot():
+    """One hetero golden-scenario run's flight snapshot — the deriver's
+    input (per-batch ``hetero`` bind counts ride every batch record)."""
+    sched = TPUScheduler(
+        profile=throughput_aware_profile(), batch_size=8, chunk_size=1
+    )
+    nodes, pods = hetero_scenario()
+    for n in nodes:
+        sched.add_node(n)
+    for p in pods:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    return sched.flight.snapshot()
+
+
+def render(doc: dict) -> str:
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+# -- derivation --------------------------------------------------------------
+
+
+def test_batches_carry_hetero_bind_counts():
+    snap = hetero_flight_snapshot()
+    hetero = [
+        r["hetero"]
+        for r in snap["records"]
+        if r.get("kind") == "batch" and r.get("hetero")
+    ]
+    assert hetero, "hetero scenario batches must stamp hetero bind counts"
+    assert all(
+        "|" in key and n > 0 for h in hetero for key, n in h.items()
+    )
+
+
+def test_derive_builds_a_row_normalized_matrix():
+    doc = measured.derive(hetero_flight_snapshot())
+    measured.validate(doc)
+    assert doc["version"] == measured.MEASURED_VERSION
+    assert doc["kind"] == measured.MEASURED_KIND
+    # Integer row-max normalization: the best accel per class is exactly
+    # the scale, every cell is a non-negative int.
+    for row in doc["matrix"].values():
+        assert max(row.values()) == doc["scale"]
+        assert all(isinstance(v, int) and v >= 0 for v in row.values())
+    assert doc["window"]["binds"] > 0
+
+
+def test_two_same_seed_derivations_are_byte_identical():
+    """The determinism acceptance leg: derive → serialize twice from two
+    fresh same-seed runs — byte-identical artifacts."""
+    a = render(measured.derive(hetero_flight_snapshot()))
+    b = render(measured.derive(hetero_flight_snapshot()))
+    assert a == b
+
+
+def test_save_load_round_trip(tmp_path):
+    doc = measured.derive(hetero_flight_snapshot())
+    path = tmp_path / "mm.json"
+    measured.save(doc, str(path))
+    assert measured.load(str(path)) == doc
+
+
+def test_logical_window_restricts_the_fold():
+    snap = hetero_flight_snapshot()
+    full = measured.derive(snap)
+    clipped = measured.fold([snap], lc_lo=None, lc_hi=-1.0)
+    assert clipped[0] == {}  # nothing sits below the window
+    assert full["window"]["binds"] > 0
+
+
+def test_validate_rejects_malformed_artifacts():
+    good = measured.derive(hetero_flight_snapshot())
+    for mutate in (
+        lambda d: d.update(version=99),
+        lambda d: d.update(kind="nope"),
+        lambda d: d.update(matrix={}),
+        lambda d: d["matrix"].update(batch={"gpu-a100": float("nan")}),
+        lambda d: d["matrix"].update(batch={"gpu-a100": -5}),
+        lambda d: d["matrix"].update(batch={"gpu-a100": 0}),
+    ):
+        doc = json.loads(json.dumps(good))
+        mutate(doc)
+        with pytest.raises(ValueError):
+            measured.validate(doc)
+
+
+# -- the committed artifact --------------------------------------------------
+
+
+def test_committed_artifact_matches_a_fresh_derivation():
+    """measured_matrix.json IS a golden: the committed bytes must equal
+    what the hetero golden scenario derives today — a silent behavior
+    drift in the bind path shows up here as a stale artifact."""
+    with open(COMMITTED, "r", encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == render(measured.derive(hetero_flight_snapshot()))
+
+
+def test_loader_accepts_the_committed_artifact():
+    rows = load_matrix(COMMITTED)
+    assert rows
+    for wclass, accel_rows in rows:
+        assert isinstance(wclass, str) and accel_rows
+        assert all(
+            isinstance(a, str) and isinstance(m, int)
+            for a, m in accel_rows
+        )
+    # matrix_rows is the same tuple form the synthetic profile takes.
+    assert rows == measured.matrix_rows(measured.load(COMMITTED))
+
+
+# -- the A/B oracle: measured vs synthetic, single vs N=2 fleet --------------
+
+
+def test_measured_profile_binds_bit_identical_under_fleet_oracle():
+    """The acceptance leg: a profile built FROM the measured artifact
+    stays bit-identical between the single scheduler and an N=2 fleet —
+    the measured matrix rides the same static row-max normalizer, so
+    partitioning cannot perturb a score bit.  The synthetic profile's
+    own leg (test_heterogeneity) keeps holding alongside."""
+    doc = measured.load(COMMITTED)
+    profile = throughput_aware_profile(matrix=measured.matrix_rows(doc))
+    single = run_single_hetero(profile)
+    assert single
+    assert run_fleet_hetero(profile, 2) == single
+
+
+# -- the gauge + scheduler arming -------------------------------------------
+
+
+def test_note_measured_matrix_publishes_the_gauge_family():
+    doc = measured.load(COMMITTED)
+    sched = TPUScheduler(batch_size=8)
+    sched.note_measured_matrix(doc)
+    text = sched.metrics.registry.render_text()
+    assert "scheduler_measured_throughput_millis" in text
+    for wclass, row in doc["matrix"].items():
+        for accel, milli in row.items():
+            needle = (
+                "scheduler_measured_throughput_millis{"
+                f'accel="{accel}",workload_class="{wclass}"}} {milli}'
+            )
+            assert needle in text, needle

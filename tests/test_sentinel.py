@@ -1,0 +1,140 @@
+"""The declarative bench/SLO regression sentinel (ISSUE 16 tentpole c):
+one guard table over the committed BENCH/OBS_TAX trajectory — pass /
+warn / hard-floor semantics, missing-artifact handling, the bench.py
+``sentinel`` payload block, and the tier-1 ``--check`` gate."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_sentinel.py")
+
+
+def load_sentinel():
+    spec = importlib.util.spec_from_file_location("_tpu_sentinel", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sentinel = load_sentinel()
+
+
+def committed_payload() -> dict:
+    path = sentinel.newest_artifact(REPO, "BENCH_r*.json")
+    assert path, "the repo commits a bench trajectory"
+    return sentinel.load_payload(path)
+
+
+# -- guard semantics ---------------------------------------------------------
+
+
+def test_committed_trajectory_passes_every_guard():
+    block = sentinel.evaluate(committed_payload())
+    assert block["ok"], block
+    assert block["hard_failures"] == []
+    assert block["missing"] == []
+    assert {g["name"] for g in block["guards"]} == {
+        "headline", "flagship", "journal_fsyncs", "overlap_coverage",
+        "slo_p99", "obs_tax",
+    }
+
+
+def test_warn_band_reports_without_failing():
+    """A 7% headline dip: beyond the 5% warn band, inside the 30% hard
+    floor — reported as warn, never an exit failure."""
+    payload = committed_payload()
+    payload["value"] = payload["value"] * 0.93
+    block = sentinel.evaluate(payload)
+    assert "headline" in block["warnings"]
+    assert block["ok"] and block["hard_failures"] == []
+
+
+def test_hard_floor_breach_fails():
+    """Half the headline + a per-append fsync regression: two hard
+    floors breached, ok=False."""
+    payload = committed_payload()
+    payload["value"] = payload["value"] * 0.5
+    payload["detail"]["journal"]["fsyncs"] = 32048
+    block = sentinel.evaluate(payload)
+    assert set(block["hard_failures"]) >= {"headline", "journal_fsyncs"}
+    assert not block["ok"]
+    statuses = {g["name"]: g["status"] for g in block["guards"]}
+    assert statuses["headline"] == "hard_fail"
+    assert statuses["journal_fsyncs"] == "hard_fail"
+
+
+def test_slo_guard_scales_off_the_recorded_budget():
+    payload = committed_payload()
+    budget = payload["slo"]["budget_ms"]
+    payload["slo"]["p99_ms"] = budget * 4 + 1  # past the 4x hard ceiling
+    block = sentinel.evaluate(payload)
+    assert "slo_p99" in block["hard_failures"]
+
+
+def test_missing_artifacts_report_as_missing_not_failure(tmp_path):
+    """Against an empty root every reference/source guard degrades to
+    'missing' — visible, but never a hard failure (a fresh checkout
+    without artifacts must not hard-fail the gate)."""
+    block = sentinel.evaluate(committed_payload(), root=str(tmp_path))
+    assert block["ok"]
+    assert set(block["missing"]) >= {"headline", "flagship", "obs_tax"}
+
+
+def test_missing_payload_fields_report_as_missing():
+    block = sentinel.evaluate({})
+    statuses = {g["name"]: g["status"] for g in block["guards"]}
+    assert statuses["headline"] == "missing"
+    assert statuses["journal_fsyncs"] == "missing"
+    assert statuses["slo_p99"] == "missing"
+    assert statuses["obs_tax"] == "pass"  # artifact-sourced, payload-free
+    assert block["ok"]  # missing is loud, not fatal
+
+
+def test_newest_artifact_picks_the_highest_round(tmp_path):
+    for n in (2, 10, 9):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+    got = sentinel.newest_artifact(str(tmp_path), "BENCH_r*.json")
+    assert os.path.basename(got) == "BENCH_r10.json"
+
+
+# -- the CLI gate ------------------------------------------------------------
+
+
+def run_cli(*args, stdin: str | None = None):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        input=stdin,
+    )
+
+
+def test_check_gate_passes_on_the_committed_trajectory():
+    """The tier-1 gate: `bench_sentinel.py --check` exits 0 on the
+    repo's own committed artifacts."""
+    proc = run_cli("--check")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "sentinel: checked BENCH_r" in proc.stdout
+
+
+def test_check_gate_fails_on_a_synthetic_regression(tmp_path):
+    payload = committed_payload()
+    payload["value"] = payload["value"] * 0.5
+    fixture = tmp_path / "regressed.json"
+    fixture.write_text(json.dumps(payload))
+    proc = run_cli("--payload", str(fixture))
+    assert proc.returncode == 1
+    assert "HARD FAIL" in proc.stderr
+
+
+def test_payload_stdin_and_json_mode():
+    proc = run_cli("--payload", "-", "--json",
+                   stdin=json.dumps(committed_payload()))
+    assert proc.returncode == 0, proc.stderr
+    block = json.loads(proc.stdout)
+    assert block["ok"] and block["hard_failures"] == []

@@ -145,9 +145,12 @@ def test_det_rules_fire_on_seeded_violations():
     # engine/badpipeline.py (ISSUE 15) seeds a wallclock predispatch
     # validity check, a bare-set drain order and a hash()-bucketed
     # commit-group slot — the stage scheduler's determinism surface.
-    assert got.count("det-wallclock") == 6
+    # framework/measured.py + framework/trace_export.py (ISSUE 16) seed
+    # a wallclock fold window, a wallclock trace epoch and a bare-set
+    # row iteration — the derived-artifact byte-identity surfaces.
+    assert got.count("det-wallclock") == 8
     assert got.count("det-random") == 5  # + gauss jitter in the weight loader
-    assert got.count("det-set-iteration") == 6  # for-loops + list(set(...))
+    assert got.count("det-set-iteration") == 7  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
     # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14) + commit-group
@@ -178,6 +181,15 @@ def test_det_rules_cover_pipeline():
     # predispatch validity — inside the determinism contract.
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/engine/badpipeline.py" in paths
+
+
+def test_det_rules_cover_derived_artifacts():
+    # The measured-matrix deriver and the trace exporter (ISSUE 16)
+    # promise byte-identical artifacts across same-seed runs — the
+    # explicit-rel list must reach both framework/ modules.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/framework/measured.py" in paths
+    assert "kubernetes_tpu/framework/trace_export.py" in paths
 
 
 def test_det_negative_tree_is_clean():

@@ -1,0 +1,192 @@
+"""Perfetto/Chrome trace-event export (ISSUE 16 tentpole b): any flight
+dump or merged fleet document renders as trace_event JSON — valid,
+byte-identical on the logical timebase across same-seed runs (wall
+fields stripped), golden-pinned against a committed incident dump, and
+served identically over HTTP ``GET /debug/trace`` and the CLI path."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework import trace_export
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sidecar.metrics_http import ObservabilityHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DUMP = os.path.join(
+    REPO, "soak_dumps", "flight-scheduler-38208-001-node-unreachable.json"
+)
+SOAK_DUMP = os.path.join(REPO, "soak_dumps", "soak-flight.json")
+MERGED = os.path.join(REPO, "soak_dumps", "fleet-flight-merged.json")
+GOLDEN = os.path.join(REPO, "tests", "golden", "flight_trace.json")
+
+
+def load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def run_scheduler() -> TPUScheduler:
+    s = TPUScheduler(batch_size=8)
+    for i in range(3):
+        s.add_node(
+            make_node(f"n{i}").capacity({"cpu": "8", "pods": 110}).obj()
+        )
+    for i in range(12):
+        s.add_pod(make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+    s.schedule_all_pending()
+    return s
+
+
+# -- validity ----------------------------------------------------------------
+
+
+def assert_valid_trace(doc: dict) -> None:
+    assert set(doc) == {"displayTimeUnit", "otherData", "traceEvents"}
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    for e in events:
+        assert e["ph"] in ("M", "X", "i"), e
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "X":
+            # Wall-anchored slices may carry fractional µs; the logical
+            # timebase emits pure ints (pinned below).
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        if e["ph"] == "i":
+            assert e["s"] == "t"
+    # Every pid/tid pair used by a slice is named by metadata.
+    named = {
+        (e["pid"], e.get("tid"))
+        for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    for e in events:
+        if e["ph"] in ("X", "i"):
+            assert (e["pid"], e["tid"]) in named, e
+
+
+def test_live_ring_renders_valid_trace_event_json():
+    doc = json.loads(trace_export.render(run_scheduler().flight.snapshot()))
+    assert_valid_trace(doc)
+    # The logical timebase slots on integer microseconds only.
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+
+
+def test_committed_dumps_render_on_both_timebases():
+    for path in (DUMP, SOAK_DUMP, MERGED):
+        for timebase in ("logical", "wall"):
+            doc = json.loads(load_and_render(path, timebase))
+            assert_valid_trace(doc)
+
+
+def load_and_render(path: str, timebase: str) -> str:
+    return trace_export.render(load(path), timebase=timebase)
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_logical_timebase_strips_wall_fields():
+    """Same records, different wall weather → byte-identical logical
+    export.  The wall timebase may differ; the logical one may not."""
+    doc = load(SOAK_DUMP)
+    warped = copy.deepcopy(doc)
+    for rec in warped["records"]:
+        if "ts" in rec:
+            rec["ts"] += 1234.5
+        if "wall_s" in rec:
+            rec["wall_s"] *= 3.0
+        for phase in list(rec.get("phases") or {}):
+            rec["phases"][phase] *= 2.0
+    a = trace_export.render(doc, timebase="logical")
+    b = trace_export.render(warped, timebase="logical")
+    assert a == b
+    text = json.dumps(json.loads(a))
+    assert '"wall_s"' not in text and '"trace_id"' not in text
+
+
+def test_two_same_seed_runs_export_byte_identical():
+    a = trace_export.render(run_scheduler().flight.snapshot())
+    b = trace_export.render(run_scheduler().flight.snapshot())
+    assert a == b
+
+
+def test_pipeline_phases_render_as_overlapping_track():
+    """The PR 15 story must be visible: predispatch/drain slices land on
+    their own track (tid 2) and overlap the stage tiles' span on tid 1
+    within the same batch slot."""
+    snap = run_scheduler().flight.snapshot()
+    events = json.loads(trace_export.render(snap))["traceEvents"]
+    stage = [e for e in events if e["ph"] == "X" and e.get("tid") == 1]
+    pipe = [e for e in events if e["ph"] == "X" and e.get("tid") == 2]
+    assert stage and pipe, "both tracks must carry slices"
+    # At least one pipeline slice overlaps a stage slice in time.
+    assert any(
+        p["ts"] < s["ts"] + s["dur"] and s["ts"] < p["ts"] + p["dur"]
+        for p in pipe
+        for s in stage
+    )
+
+
+# -- the golden --------------------------------------------------------------
+
+
+def test_golden_trace_for_committed_incident_dump():
+    """tests/golden/flight_trace.json pins the exporter's bytes for one
+    committed incident dump — renderer drift is a conscious regold."""
+    with open(GOLDEN, "r", encoding="utf-8") as f:
+        golden = f.read()
+    assert golden == load_and_render(DUMP, "logical")
+
+
+# -- the serving surfaces ----------------------------------------------------
+
+
+def test_http_debug_trace_agrees_with_direct_render():
+    sched = run_scheduler()
+    srv = ObservabilityHTTPServer(scheduler=sched, port=0)
+    srv.serve_background()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(
+            f"{base}/debug/trace", timeout=5
+        ).read().decode()
+        assert body == trace_export.render(
+            sched.flight.snapshot(), timebase="logical"
+        )
+        limited = urllib.request.urlopen(
+            f"{base}/debug/trace?limit=2", timeout=5
+        ).read().decode()
+        assert json.loads(limited)["traceEvents"]
+    finally:
+        srv.close()
+
+
+def test_cli_exporter_agrees_with_http_shape():
+    """scripts/export_trace.py (the file-side twin) renders the same
+    bytes trace_export.render does for the same document."""
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "t.json")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "export_trace.py"),
+                DUMP,
+                "--out",
+                out,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        with open(out, "r", encoding="utf-8") as f:
+            assert f.read() == load_and_render(DUMP, "logical")
