@@ -561,6 +561,13 @@ def cmd_fleet(args) -> int:
                         # without a soak run.
                         "tenants": stats.get("tenants", {}),
                     }
+                    if stats.get("fairness") is not None:
+                        # Weighted-fair admission mirror (router push,
+                        # set_admission): fleet weights/caps plus the
+                        # per-tenant status as of the last push —
+                        # credit balances, virtual-time lag, pending
+                        # depth, oldest wait, starvation-SLO verdict.
+                        owners[sock]["fairness"] = stats["fairness"]
                 except (OSError, RuntimeError) as exc:
                     owners[sock] = {"unreachable": str(exc)}
             doc["owners"] = owners

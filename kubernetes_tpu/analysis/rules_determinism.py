@@ -81,6 +81,11 @@ class DeterminismRule(Rule):
             # logical timebase must never read a clock at all.
             "kubernetes_tpu/framework/measured.py",
             "kubernetes_tpu/framework/trace_export.py",
+            # ISSUE 17: the weighted-fair admission policy IS replayed
+            # decision state — a wall-clock read, salted hash or
+            # unordered iteration in its ledger arithmetic diverges the
+            # recovered admission order from the interrupted run's.
+            "kubernetes_tpu/framework/fairness.py",
         ]
         for sub in ("ops", "engine", "loadgen", "fleet"):
             top = os.path.join(root, "kubernetes_tpu", sub)

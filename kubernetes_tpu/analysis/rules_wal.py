@@ -51,6 +51,12 @@ APPLY_MARKERS = {
     "_apply_node_taints",
     "_apply_eviction",
     "_unwind_pod",
+    # ISSUE 17: a WFQ debit batch made durable (the fairness ledger's
+    # commit-drain apply).  Applying debits before their ``admission``
+    # record is in the group barrier would let a crash admit pods the
+    # journal never heard of — recovery would re-select them in a
+    # different order.
+    "apply_admission",
 }
 
 
@@ -100,6 +106,11 @@ class WalRule(Rule):
             # must journal (inside the group barrier) before the drain
             # applies it.
             "kubernetes_tpu/engine/pipeline.py",
+            # Weighted-fair admission (ISSUE 17): the policy's durable
+            # ledger advances only through apply_admission — journaled
+            # first by the commit drain; the replay path is journal-
+            # driven by construction.
+            "kubernetes_tpu/framework/fairness.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:

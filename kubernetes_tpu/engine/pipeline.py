@@ -92,6 +92,17 @@ class CommitTicket:
     journaled: int = 0
     barriered: bool = False
     applied: int = 0
+    # Weighted-fair admission debits of THIS batch's pops (framework/
+    # fairness intent records, pop order).  Captured at ticket creation
+    # so a depth-2 prefetch pop for batch k+1 can never smuggle its
+    # debits into batch k's group.  Journaled as one "admission" record
+    # FIRST inside the group (a bind is only durable together with the
+    # debit that admitted it), applied to the durable ledger after the
+    # barrier; the two flags make an interrupted drain resume without
+    # re-journaling or double-debiting.
+    admission: list | None = None
+    admission_journaled: bool = False
+    admission_applied: bool = False
     # Membership index (never iterated): rollback paths and the
     # scheduler's metrics loop ask "is this uid staged?".
     _uids: set = field(default_factory=set)
@@ -140,7 +151,7 @@ def drain_commit(sched, ticket: CommitTicket) -> float:
     """
     if ticket.drained:
         return 0.0
-    if not ticket.staged:
+    if not ticket.staged and not ticket.admission:
         ticket.drained = True
         return 0.0
     t0 = time.perf_counter()
@@ -150,8 +161,19 @@ def drain_commit(sched, ticket: CommitTicket) -> float:
     _crash("stage-boundary")
     journal = sched.journal
     if journal is not None and not ticket.barriered:
-        if ticket.journaled < len(ticket.staged):
+        need_admission = bool(ticket.admission) and not ticket.admission_journaled
+        if ticket.journaled < len(ticket.staged) or need_admission:
             with journal.group():
+                if need_admission:
+                    # The batch's fairness debits ride the SAME barrier
+                    # as its binds, ahead of them: a crash either loses
+                    # the whole group (restored pods re-pop through the
+                    # identical ledger) or recovers debits + binds
+                    # together — admission order replays bit-identical.
+                    sched._journal_append(
+                        "admission", debits=ticket.admission
+                    )
+                    ticket.admission_journaled = True
                 for sb in ticket.staged[ticket.journaled :]:
                     sched._journal_bind(sb.qp.pod, sb.node_name)
                     ticket.journaled += 1
@@ -163,6 +185,13 @@ def drain_commit(sched, ticket: CommitTicket) -> float:
             journal.barrier()
         ticket.barriered = True
     # Group fsync returned: every record in the group is durable.
+    if ticket.admission and not ticket.admission_applied:
+        # Debits are durable (journaled above, inside the barrier) —
+        # advance the DURABLE fairness ledger to match the effective
+        # ledger's pop-time debits.  Flag-guarded so an in-process
+        # resume of an interrupted drain never double-debits.
+        sched.queue.admission.apply_admission(ticket.admission)
+        ticket.admission_applied = True
     # Apply in stage order — identical to the serial loop's inline
     # order, just batched behind the single barrier.
     m = sched.metrics

@@ -68,6 +68,9 @@ class ShardOwner:
         self.lease: FileLease | None = None
         self.journal: Journal | None = None
         self.recovery_stats: dict | None = None
+        # Mirrored weighted-fair admission document (router push via
+        # set_admission) — weights/caps + the router's status snapshot.
+        self.admission_doc: dict | None = None
         self.handoffs_in = 0
         self.handoffs_out = 0
         # Monotone commit counter — the owner-side load signal the
@@ -486,6 +489,20 @@ class ShardOwner:
             lambda name: new_map.owner_of(name) == sid
         )
 
+    def set_admission(self, doc: dict) -> None:
+        """Mirror the router's weighted-fair admission document (the
+        set_map-style push): inherit the fleet weights into this owner's
+        OWN armed policy, if any (a shard scheduling its local queue
+        under fairness must agree with the fleet on accelerator-time
+        shares), and hold the document — including the router's
+        per-tenant status snapshot — for the stats surface, where
+        `fleet status --sockets` renders the fairness view.  Idempotent;
+        nothing durable (weights re-push on every arm/update)."""
+        self.admission_doc = dict(doc)
+        adm = getattr(self.sched.queue, "admission", None)
+        if adm is not None:
+            adm.set_weights(doc.get("weights", {}))
+
     # -- cluster-global side effects mirrored locally ----------------------
 
     def debit_pdb(self, name: str, n: int) -> None:
@@ -566,6 +583,11 @@ class ShardOwner:
             out["journal"] = journal.stats()
         if self.recovery_stats is not None:
             out["recovery"] = self.recovery_stats
+        if self.admission_doc is not None:
+            # The mirrored fairness view (router push, set_admission):
+            # weights/caps plus the per-tenant status snapshot as of the
+            # last push — credit balances, virtual-time lag, SLO verdicts.
+            out["fairness"] = self.admission_doc
         return out
 
     def close(self) -> None:
@@ -733,6 +755,9 @@ def _dispatch_op(owner: ShardOwner, op: str, payload: dict) -> dict:
         return {}
     if op == "set_map":
         owner.set_map(payload["doc"])
+        return {}
+    if op == "set_admission":
+        owner.set_admission(payload["doc"])
         return {}
     if op == "bindings":
         return {

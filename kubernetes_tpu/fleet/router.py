@@ -334,6 +334,46 @@ class FleetRouter:
         for shard in self.shard_ids():
             self._call(shard, "set_map", {"doc": doc})
 
+    # -- weighted-fair admission (framework/fairness, ISSUE 17) -------------
+
+    def arm_admission(self, policy) -> None:
+        """Arm weighted-fair admission on the router's queue — the
+        fleet-wide admission point (owners receive already-admitted
+        assignments, so fairness decided here IS the fleet's admission
+        order).  The policy inherits the router's logical clock unless
+        the caller injected one, and the weight/cap document ships to
+        every owner immediately (set_map-style push)."""
+        if policy.clock is None:
+            policy.clock = self.lc
+        self.queue.arm_admission(policy)
+        self.push_admission()
+
+    def admission_doc(self) -> dict:
+        """The admission document owners mirror: the weight/cap/SLO
+        knobs plus the router's current per-tenant fairness status
+        (weight, credit balance, virtual-time lag, SLO verdict) — the
+        state mirror `fleet status --sockets` renders per owner."""
+        adm = self.queue.admission
+        return {
+            "weights": {t: adm.weights[t] for t in sorted(adm.weights)},
+            "rate_pods_per_s": adm.rate,
+            "burst": adm.burst,
+            "aging_max_wait_s": adm.aging_max_wait_s,
+            "slo_wait_budget_s": adm.slo_wait_budget_s,
+            "status": adm.status(),
+        }
+
+    def push_admission(self) -> None:
+        """Ship the admission document to every owner (``set_admission``
+        — the push_map pattern: idempotent, nothing durable).  Owners
+        inherit the weights for their own armed policies, if any, and
+        mirror the document into their stats surface."""
+        if self.queue.admission is None:
+            return
+        payload = {"doc": self.admission_doc()}
+        for shard in self.shard_ids():
+            self._call(shard, "set_admission", payload)
+
     # -- the object feed (the informer surface, partitioned) ---------------
 
     def add_object(self, kind: str, obj) -> None:
@@ -881,6 +921,17 @@ class FleetRouter:
         infos = self.queue.pop_batch(self.batch_size)
         if not infos:
             return []
+        if self.queue.admission is not None:
+            # No journal fronts the router's fairness ledger (a cold
+            # restart rebuilds it from scratch — deterministically, the
+            # restart is a seeded scenario event), so debit intents
+            # finalize AT pop: the durable ledger and admitted_log
+            # advance in admission order with nothing left in flight.
+            adm = self.queue.admission
+            # tpulint: disable=wal-unjournaled-apply
+            adm.apply_admission(
+                adm.take_intents([qp.pod.uid for qp in infos])
+            )
         t0 = time.perf_counter()
         tr: Trace | None = None
         if self.observability:
@@ -961,6 +1012,12 @@ class FleetRouter:
                 all_outcomes.extend(out)
                 continue
             if len(self.queue):
+                if self.queue.last_pop_throttled:
+                    # Weighted-fair admission: queued pods remain but
+                    # every tenant is credit-blocked — only logical-clock
+                    # advance (refill / aging escape) can admit them, so
+                    # polling again this instant would spin max_rounds.
+                    break
                 continue
             if wait_backoff and self.queue.sleep_until_backoff():
                 continue
@@ -1075,6 +1132,11 @@ class FleetRouter:
             # Fleet-aggregated per-tenant view (the per-shard split rides
             # each owner's stats["tenants"] above).
             out["tenants"] = self.tenant_metrics.snapshot()
+        if self.queue.admission is not None:
+            # Live fairness view at the fleet-wide admission point:
+            # per-tenant weight, credit balance, virtual-time lag, and
+            # starvation-SLO verdict (owners mirror the pushed copy).
+            out["fairness"] = self.queue.admission.status()
         return out
 
     def fleet_flight_snapshots(

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import zlib
 from dataclasses import dataclass, field
 
 
@@ -238,13 +239,24 @@ def pod_tenant(pod) -> str | None:
 
 class TenantLabeler:
     """Bounded-cardinality admission of tenant label values: the first
-    ``limit`` distinct tenants keep their names; later ones collapse
-    into the ``"-"`` overflow cell (counted in ``overflowed``).
-    Deterministic for a deterministic op stream — admission is
-    first-seen order."""
+    ``limit`` distinct tenants keep their names (the top-K exact tier);
+    later ones collapse into the ``"-"`` overflow cell — or, with
+    ``hash_buckets > 0``, into one of that many HASHED tail cells
+    (``~00`` … ``~NN``), so a thousands-of-tenants fleet still gets
+    per-bucket attribution without cardinality blowup.  Total distinct
+    label values are bounded by ``limit + hash_buckets + 1``.
+    Deterministic for a deterministic op stream — exact-tier admission
+    is first-seen order, and bucketing keys on ``zlib.crc32`` (never the
+    salted builtin ``hash()``), so same-seed runs and sibling processes
+    agree on every bucket assignment."""
 
-    def __init__(self, limit: int = TENANT_CARDINALITY_LIMIT):
+    def __init__(
+        self,
+        limit: int = TENANT_CARDINALITY_LIMIT,
+        hash_buckets: int = 0,
+    ):
         self.limit = max(0, int(limit))
+        self.hash_buckets = max(0, int(hash_buckets))
         self._seen: dict[str, None] = {}  # insertion-ordered set
         self.overflowed = 0
 
@@ -258,6 +270,9 @@ class TenantLabeler:
             self._seen[tname] = None
             return tname
         self.overflowed += 1
+        if self.hash_buckets > 0:
+            bucket = zlib.crc32(tname.encode("utf-8")) % self.hash_buckets
+            return f"~{bucket:02d}"
         return TENANT_FALLBACK
 
     def known(self) -> list[str]:
@@ -274,8 +289,13 @@ class TenantMetrics:
 
     EVENTS = ("admitted", "bound", "preempted", "deferred")
 
-    def __init__(self, registry: "MetricsRegistry", limit: int = TENANT_CARDINALITY_LIMIT):
-        self.labeler = TenantLabeler(limit)
+    def __init__(
+        self,
+        registry: "MetricsRegistry",
+        limit: int = TENANT_CARDINALITY_LIMIT,
+        hash_buckets: int = 0,
+    ):
+        self.labeler = registry.tenant_labeler(limit, hash_buckets)
         self._counters = {
             "admitted": registry.counter(
                 "scheduler_tenant_admitted_total",
@@ -365,6 +385,10 @@ class MetricsRegistry:
     # per-site keying prevents interleaved call sites from aliasing onto
     # fixed residues — one site permanently sampled, another never).
     _sample_ticks: dict[str, int] = field(default_factory=dict)
+    # The registry-wide tenant labeler (``tenant_labeler()``): shared by
+    # every TenantMetrics on this registry so the exact tier is one
+    # table, not one per holder.
+    _tenant_labeler: "TenantLabeler | None" = None
 
     def sample_plugins(self, site: str) -> bool:
         """True for ~1 in 10 calls FROM THIS SITE — the per-batch gate."""
@@ -389,6 +413,29 @@ class MetricsRegistry:
         if h is None:
             h = self.histograms[name] = HistogramFamily(name, help_)
         return h
+
+    def tenant_labeler(
+        self,
+        limit: int = TENANT_CARDINALITY_LIMIT,
+        hash_buckets: int = 0,
+    ) -> TenantLabeler:
+        """ONE labeler per registry.  Every ``tenant=`` writer sharing
+        this registry (the soak driver's TenantMetrics, the fleet
+        router's, the admission policy's SLO families) must share one
+        exact-tier table, or each holds an independent top-K and the
+        registry-wide distinct label count multiplies past the
+        ``limit + hash_buckets + 1`` bound.  First caller fixes the
+        shape; a later caller asking for a wider hashed tail widens the
+        shared labeler in place (callers run at setup, before any
+        overflow, so bucket assignments stay deterministic)."""
+        lb = self._tenant_labeler
+        if lb is None:
+            lb = self._tenant_labeler = TenantLabeler(
+                limit, hash_buckets=hash_buckets
+            )
+        elif hash_buckets > lb.hash_buckets:
+            lb.hash_buckets = int(hash_buckets)
+        return lb
 
     def add_collector(self, fn) -> None:
         self.collectors.append(fn)

@@ -798,6 +798,19 @@ def recover(sched, journal: Journal) -> dict:
                 )
             elif rtype == "release_quarantine":
                 sched.queue.release_quarantine(d.get("uid"))
+            elif rtype == "admission":
+                # Weighted-fair admission debits (framework/fairness):
+                # one record per commit group, ahead of the group's
+                # binds.  Replay advances BOTH fairness ledgers — after
+                # recovery the effective ledger equals the durable one,
+                # so the next pop selects exactly what the uninterrupted
+                # run selected (the --tenant-kill cells' bit-identical
+                # admission-order contract).  A journal recovered into
+                # an unarmed queue skips silently (arming is config).
+                if sched.queue.admission is not None:
+                    sched.queue.admission.replay_admission(
+                        d.get("debits", ())
+                    )
             elif rtype == "spec_epoch":
                 # The speculative frontend's epoch at its last invalidation
                 # (post-snapshot).  A frontend attached after recovery

@@ -172,11 +172,26 @@ class SoakConfig:
     tenants: tuple = ()
     # Per-tenant arrival STREAMS (the tenant_starvation scenario; fleet
     # soak only): tuple of dicts {"name", "rate_pods_per_s", and
-    # optionally "burst_factor"/"burst_start_s"/"burst_end_s"} — each
-    # tenant arrives on its own seeded schedule (steady Poisson, or a
-    # piecewise burst), merged time-ordered.  Non-empty replaces the
-    # single rate_pods_per_s/diurnal schedule.
+    # optionally "burst_factor"/"burst_start_s"/"burst_end_s", plus
+    # "workload_class" — the throughput-matrix row its fairness weight
+    # derives from when admission is armed} — each tenant arrives on its
+    # own seeded schedule (steady Poisson, or a piecewise burst), merged
+    # time-ordered.  Non-empty replaces the single
+    # rate_pods_per_s/diurnal schedule.
     tenant_streams: tuple = ()
+    # Weighted-fair admission (ISSUE 17): arm framework/fairness on the
+    # fleet router's queue.  Dict of FairAdmission knobs —
+    # {"rate_pods_per_s", "burst", "aging_max_wait_s",
+    # "slo_wait_budget_s"}; weights derive from the synthetic throughput
+    # matrix over the tenant_streams' workload_class mapping (uniform
+    # when unmapped).  None ⇒ UNARMED: the pre-fairness FIFO admission,
+    # bit-identical to pre-PR runs.
+    admission: dict | None = None
+    # Hashed tail tier for the tenant labeler (TenantLabeler
+    # hash_buckets): 0 keeps pure top-K + "-" overflow; > 0 routes
+    # over-cap tenants into that many crc32 buckets (~NN labels) — the
+    # thousands-of-tenants leg's bounded-cardinality contract.
+    tenant_hash_buckets: int = 0
     # Master observability switch: tenant attribution, fleet tracing and
     # flight logical-clock stamping.  Decisions are bit-identical with
     # it on or off — the tenant artifact's obs-off leg asserts exactly
@@ -244,7 +259,12 @@ def _slo_families(registry: MetricsRegistry, budget_ms: float):
     hist = registry.histogram(
         "scheduler_slo_decision_latency_seconds",
         "Per-decision serving latency of the open-loop soak driver "
-        "(arrival deadline to decision), by phase and tenant.",
+        "(arrival deadline to decision), by phase, tenant and component "
+        "(total = queue_wait + service: queue_wait is time spent waiting "
+        "for admission — driver backlog or a fairness rate cap — and "
+        "service is the scheduler's own time, so a capped tenant's "
+        "self-inflicted wait is attributed to the cap, not to "
+        "scheduler slowness).",
     )
     violations = registry.counter(
         "scheduler_slo_violations_total",
@@ -674,7 +694,20 @@ class _Driver:
             self.tenant_metrics.note("admitted", tenant)
             if node:
                 self.tenant_metrics.note("bound", tenant)
-        self._slo_hist.observe(lat, phase=res.name, tenant=tlabel)
+        # Component split: total = queue_wait + service.  queue_wait is
+        # the pre-service wait (driver backlog under real pace — the
+        # deadline predating issue), service the serving call itself.
+        self._slo_hist.observe(
+            lat, phase=res.name, tenant=tlabel, component="total"
+        )
+        self._slo_hist.observe(
+            max(0.0, t_issue - base),
+            phase=res.name, tenant=tlabel, component="queue_wait",
+        )
+        self._slo_hist.observe(
+            t_done - t_issue,
+            phase=res.name, tenant=tlabel, component="service",
+        )
         if lat > self.cfg.slo_budget_ms / 1e3:
             res.violations += 1
             res.tenant_violations[tkey] = (
@@ -1343,12 +1376,55 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             registry, cfg.slo_budget_ms
         )
         tenant_metrics = (
-            TenantMetrics(registry) if cfg.observability else None
+            TenantMetrics(registry, hash_buckets=cfg.tenant_hash_buckets)
+            if cfg.observability
+            else None
         )
         node_objs: dict[str, object] = {}
         feed_order: list[str] = []
         router_restarts = 0
         owner_takeovers = 0
+        # Durable admission order across router rebuilds: a cold restart
+        # rebuilds the router (fresh fairness ledger — deterministic, the
+        # restart is a seeded scenario event), so the run-wide order is
+        # the concatenation of every router generation's admitted_log.
+        admission_order: list[str] = []
+
+        def mk_admission_policy():
+            """One FairAdmission per router generation: weights are
+            accelerator-time shares from the synthetic throughput matrix
+            over the streams' workload_class mapping and the configured
+            hetero pools (uniform fallback when unmapped); clock is the
+            router's logical clock (arm_admission injects it); metrics
+            ride the soak registry when observability is on — and only
+            observe: decisions are identical with it off."""
+            from ..framework.fairness import FairAdmission, weights_from_matrix
+            from ..ops.throughput import DEFAULT_THROUGHPUT_MATRIX
+
+            a = dict(cfg.admission or {})
+            classes = {
+                str(ts["name"]): str(ts["workload_class"])
+                for ts in cfg.tenant_streams
+                if ts.get("workload_class")
+            }
+            pools = (
+                {str(ac): int(wt) for ac, wt in cfg.hetero_pools} or None
+            )
+            return FairAdmission(
+                weights=weights_from_matrix(
+                    DEFAULT_THROUGHPUT_MATRIX, classes, pools
+                ),
+                rate_pods_per_s=float(a.get("rate_pods_per_s", 0.0)),
+                burst=float(a.get("burst", 8.0)),
+                aging_max_wait_s=float(a.get("aging_max_wait_s", 30.0)),
+                slo_wait_budget_s=float(a.get("slo_wait_budget_s", 60.0)),
+                registry=registry if tenant_metrics is not None else None,
+                labeler=(
+                    tenant_metrics.labeler
+                    if tenant_metrics is not None
+                    else None
+                ),
+            )
 
         def mk_router() -> FleetRouter:
             r = FleetRouter(
@@ -1554,6 +1630,13 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         )
         for owner in owners.values():
             owner.call("propose", {"pod": serialize.to_dict(flush_probe)})
+        if cfg.admission is not None:
+            # Arm AFTER warmup: the warm wave must flood through
+            # unthrottled (finite burst credits at a frozen logical clock
+            # would starve half the label-combo compiles out of the warm
+            # window) and the measured window must open on a clean
+            # fairness ledger.
+            router.arm_admission(mk_admission_policy())
 
         cap_toggle: dict[int, int] = {}
         label_epoch: dict[int, int] = {}
@@ -1688,7 +1771,17 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             router's absorbed-but-unbound evictions re-adopt, and
             still-pending pods re-feed."""
             prior_evicted = dict(router.evicted_pending) if router else {}
+            if router and router.queue.admission is not None:
+                # Harvest the dying generation's admitted order before the
+                # fresh ledger starts from zero: the run-wide admission
+                # order is the concatenation across generations.
+                admission_order.extend(router.queue.admission.admitted_log)
             r = mk_router()
+            if cfg.admission is not None:
+                # Mid-run rebuilds arm at build (no warm wave to protect):
+                # the re-fed pending pods below enqueue straight into the
+                # fresh generation's ledger.
+                r.arm_admission(mk_admission_policy())
             # The logical clock follows the front door: adoption-time
             # flight records keep the scenario axis.
             r.note_logical_time(router.lc() if router else -1.0)
@@ -1819,15 +1912,52 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         )
         burst_lat: dict[tuple[str, bool], list] = {}
 
+        # Arrival metadata of decided-but-unbound pods (rate-capped or
+        # unschedulable): uid → (deadline, arrival t_ev, arrival issue
+        # stamp, raw tenant).  When a LATER decide's scheduling round
+        # finally binds one, its full latency is accounted from the
+        # ORIGINAL arrival — queue_wait for the capped span, service for
+        # the round that bound it.
+        pending_meta: dict[str, tuple] = {}
+
+        def _observe_split(
+            tlabel: str, total: float, qwait: float, svc: float
+        ) -> None:
+            slo_hist.observe(
+                total, phase=res.name, tenant=tlabel, component="total"
+            )
+            slo_hist.observe(
+                qwait, phase=res.name, tenant=tlabel, component="queue_wait"
+            )
+            slo_hist.observe(
+                svc, phase=res.name, tenant=tlabel, component="service"
+            )
+
+        def _retire_overflow() -> None:
+            while len(live) > cfg.live_pod_cap:
+                old = live.popleft()
+                pods_by_uid.pop(old, None)
+                pending.pop(old, None)
+                pending_meta.pop(old, None)
+                if old in router._pod_shard:
+                    router.remove_object("Pod", old)
+                res.retired += 1
+
         def decide(pod, deadline: float | None, t_ev: float = 0.0) -> None:
             uid = pod.uid
             t_issue = time.perf_counter()
             router.add_pod(pod)
             outs = router.schedule_all_pending()
             node = None
+            late_binds: list[tuple[str, str]] = []
             for o in outs:
                 if o.pod.uid == uid and o.node_name:
                     node = o.node_name
+                elif o.node_name and o.pod.uid in pending:
+                    # A deferred pod (rate-capped on an earlier arrival)
+                    # bound in THIS round: full accounting below, from
+                    # its original arrival stamps.
+                    late_binds.append((o.pod.uid, o.node_name))
                 elif o.node_name and o.pod.uid in pods_by_uid:
                     # A rebind (an evicted pod rescheduled mid-decision):
                     # keep the live-window's node attribution current, or a
@@ -1837,7 +1967,6 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             t_done = time.perf_counter()
             base = t_issue if deadline is None else min(deadline, t_issue)
             lat = t_done - base
-            res.latencies.append(lat)
             tenant = pod_tenant(pod)
             tlabel = (
                 tenant_metrics.labeler.label_for(tenant)
@@ -1845,23 +1974,33 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 else TENANT_FALLBACK
             )
             tkey = tenant or "-"
-            res.tenant_latencies.setdefault(tkey, []).append(lat)
             res.tenant_counts[tkey] = res.tenant_counts.get(tkey, 0) + 1
-            if burst_win is not None:
-                in_burst = burst_win[0] <= t_ev < burst_win[1]
-                burst_lat.setdefault((tkey, in_burst), []).append(lat)
-            slo_hist.observe(lat, phase=res.name, tenant=tlabel)
-            if shard is not None:
-                per_shard_lat.setdefault(shard, []).append(lat)
-                if autoscaler is not None:
-                    autoscaler.note_latency(shard, lat)
-                lat_trace.append((t_ev, shard, lat))
-            if lat > cfg.slo_budget_ms / 1e3:
-                res.violations += 1
-                res.tenant_violations[tkey] = (
-                    res.tenant_violations.get(tkey, 0) + 1
+            # Armed admission defers an unbound pod's SLO sample to its
+            # BIND (the exactly-once accounting below) — sampling the
+            # arrival attempt too would double-count the pod and bury
+            # the capped span's queue_wait.  Unarmed keeps the pre-
+            # fairness accounting bit for bit.
+            sample_now = node is not None or router.queue.admission is None
+            if sample_now:
+                res.latencies.append(lat)
+                res.tenant_latencies.setdefault(tkey, []).append(lat)
+                if burst_win is not None:
+                    in_burst = burst_win[0] <= t_ev < burst_win[1]
+                    burst_lat.setdefault((tkey, in_burst), []).append(lat)
+                _observe_split(
+                    tlabel, lat, max(0.0, t_issue - base), t_done - t_issue
                 )
-                slo_violations.inc(phase=res.name, tenant=tlabel)
+                if shard is not None:
+                    per_shard_lat.setdefault(shard, []).append(lat)
+                    if autoscaler is not None:
+                        autoscaler.note_latency(shard, lat)
+                    lat_trace.append((t_ev, shard, lat))
+                if lat > cfg.slo_budget_ms / 1e3:
+                    res.violations += 1
+                    res.tenant_violations[tkey] = (
+                        res.tenant_violations.get(tkey, 0) + 1
+                    )
+                    slo_violations.inc(phase=res.name, tenant=tlabel)
             res.decisions += 1
             if node:
                 res.bound += 1
@@ -1869,16 +2008,63 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 pod._lg_node = node
                 pods_by_uid[uid] = pod
                 pending.pop(uid, None)
+                pending_meta.pop(uid, None)
                 live.append(uid)
-                while len(live) > cfg.live_pod_cap:
-                    old = live.popleft()
-                    pods_by_uid.pop(old, None)
-                    pending.pop(old, None)
-                    if old in router._pod_shard:
-                        router.remove_object("Pod", old)
-                    res.retired += 1
+                _retire_overflow()
             else:
                 pending[uid] = pod
+                pending_meta[uid] = (deadline, t_ev, t_issue, tenant)
+            for buid, bnode in late_binds:
+                bpod = pending.pop(buid, None)
+                meta = pending_meta.pop(buid, None)
+                if bpod is None:
+                    continue
+                res.bound += 1
+                bpod._lg_node = bnode
+                pods_by_uid[buid] = bpod
+                live.append(buid)
+                if meta is not None:
+                    b_deadline, b_t_ev, b_issue, b_tenant = meta
+                    b_base = (
+                        b_issue
+                        if b_deadline is None
+                        else min(b_deadline, b_issue)
+                    )
+                    # The capped span (arrival → this round) is
+                    # queue_wait; only this round's scheduling time is
+                    # service — the cap's cost lands on the cap.
+                    b_qwait = max(0.0, t_issue - b_base)
+                    b_svc = t_done - t_issue
+                    b_lat = b_qwait + b_svc
+                    b_tkey = b_tenant or "-"
+                    b_tlabel = (
+                        tenant_metrics.labeler.label_for(b_tenant)
+                        if tenant_metrics is not None
+                        else TENANT_FALLBACK
+                    )
+                    res.latencies.append(b_lat)
+                    res.tenant_latencies.setdefault(b_tkey, []).append(
+                        b_lat
+                    )
+                    res.tenant_bound[b_tkey] = (
+                        res.tenant_bound.get(b_tkey, 0) + 1
+                    )
+                    if burst_win is not None:
+                        b_in = burst_win[0] <= b_t_ev < burst_win[1]
+                        burst_lat.setdefault((b_tkey, b_in), []).append(
+                            b_lat
+                        )
+                    _observe_split(b_tlabel, b_lat, b_qwait, b_svc)
+                    if b_lat > cfg.slo_budget_ms / 1e3:
+                        res.violations += 1
+                        res.tenant_violations[b_tkey] = (
+                            res.tenant_violations.get(b_tkey, 0) + 1
+                        )
+                        slo_violations.inc(
+                            phase=res.name, tenant=b_tlabel
+                        )
+            if late_binds:
+                _retire_overflow()
 
         seed = cfg.seed * 1_000_003
         tenant_of_arrival: list[str | None] = []
@@ -2268,6 +2454,26 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
         ),
         "fleet_timeline": fleet_timeline,
         "fleet_metrics": registry_summary,
+        "admission": (
+            dict(
+                armed=True,
+                status=router.queue.admission.status(),
+                # Run-wide admission order: every dead generation's
+                # harvested log plus the final router's — the cross-run
+                # determinism oracle for WFQ ordering.
+                admission_order_sha256=_sha(
+                    list(admission_order)
+                    + list(router.queue.admission.admitted_log)
+                ),
+                admitted_total=(
+                    len(admission_order)
+                    + len(router.queue.admission.admitted_log)
+                ),
+            )
+            if cfg.admission is not None
+            and router.queue.admission is not None
+            else None
+        ),
         "determinism": {
             "arrival_sha256": _sha([round(o, 9) for o in offsets]),
             "bindings_sha256": _sha(sorted(bindings.items())),

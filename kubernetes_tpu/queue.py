@@ -23,6 +23,7 @@ from enum import IntFlag, auto
 import numpy as np
 
 from .api import types as t
+from .framework import fairness
 
 
 class Event(IntFlag):
@@ -173,8 +174,21 @@ class SchedulingQueue:
         max_backoff_s: float = DEFAULT_POD_MAX_BACKOFF_S,
         max_unschedulable_s: float = DEFAULT_MAX_UNSCHEDULABLE_DURATION_S,
         clock=time.monotonic,
+        admission_policy=None,
     ):
         self._clock = clock
+        # Weighted-fair admission (framework/fairness.FairAdmission), OFF
+        # by default: unarmed, pop_batch is the byte-identical pre-fairness
+        # QueueSort path.  Armed, active pods pool into per-tenant heaps
+        # and the policy's WFQ/credit state picks which tenant's head pops
+        # next.  Arm at construction or via arm_admission().
+        self.admission = admission_policy
+        self._tenant_active: dict[str, list] = {}
+        # True after a pop_batch that returned short NOT because the
+        # active pool drained but because every queued tenant is credit-
+        # blocked — drain loops must stop polling on this instead of
+        # spinning on len(queue) (aging re-arms eligibility later).
+        self.last_pop_throttled = False
         self._seq = itertools.count()
         self._active: list = []  # heap of (-priority, timestamp, seq, uid)
         self._backoff: list = []  # heap of (expiry, seq, uid)
@@ -446,16 +460,46 @@ class SchedulingQueue:
         if qp.pod.uid in self._in_active:
             return
         qp.timestamp = self._clock()
-        heapq.heappush(
-            self._active,
-            (-qp.pod.spec.priority, qp.timestamp, next(self._seq), qp.pod.uid),
+        item = (
+            -qp.pod.spec.priority,
+            qp.timestamp,
+            next(self._seq),
+            qp.pod.uid,
         )
+        if self.admission is not None:
+            # Armed: active pods pool per tenant (QueueSort order WITHIN
+            # a tenant; the policy orders ACROSS tenants) and the policy
+            # stamps first-enqueue for aging/starvation accounting.
+            tenant = fairness.tenant_of(qp.pod)
+            heapq.heappush(self._tenant_active.setdefault(tenant, []), item)
+            self.admission.note_enqueue(tenant, qp.pod.uid)
+        else:
+            heapq.heappush(self._active, item)
         self._in_active.add(qp.pod.uid)
         self._unsched_remove(qp.pod.uid)
 
+    def arm_admission(self, policy) -> None:
+        """Arm weighted-fair admission on a live queue: migrate the
+        active heap into per-tenant heaps (heap tuples carry over — the
+        within-tenant QueueSort order is preserved) and stamp every
+        migrated pod's enqueue with the policy so aging starts now."""
+        self.admission = policy
+        self._tenant_active = {}
+        drained, self._active = self._active, []
+        for item in drained:
+            uid = item[3]
+            if uid not in self._in_active:
+                continue  # stale heap entry — drop, like pop_batch would
+            tenant = fairness.tenant_of(self._info[uid].pod)
+            heapq.heappush(self._tenant_active.setdefault(tenant, []), item)
+            policy.note_enqueue(tenant, uid)
+
     def pop_batch(self, k: int) -> list[QueuedPodInfo]:
         """Pop up to k pods in QueueSort order — the batch analog of
-        activeQueue.pop (active_queue.go:186)."""
+        activeQueue.pop (active_queue.go:186).  With admission armed the
+        order is the fairness policy's WFQ admission order instead."""
+        if self.admission is not None:
+            return self._pop_batch_admission(k)
         self.flush_backoff()
         out: list[QueuedPodInfo] = []
         while self._active and len(out) < k:
@@ -466,6 +510,59 @@ class SchedulingQueue:
             qp = self._info[uid]
             qp.attempts += 1
             self._untrack_gang_member(qp.pod)  # in-flight, no longer pending
+            out.append(qp)
+        return out
+
+    def _pop_batch_admission(self, k: int) -> list[QueuedPodInfo]:
+        """The armed pop path: each slot asks the policy which queued
+        tenant admits next (WFQ tags + credits + aging escape), then pops
+        that tenant's QueueSort head.  Deterministic: candidates are the
+        sorted tenant names with a live head, the clock is the policy's
+        logical clock, and every debit lands in the policy's intent set
+        for the commit drain to journal."""
+        self.flush_backoff()
+        self.last_pop_throttled = False
+        out: list[QueuedPodInfo] = []
+        while len(out) < k:
+            # Recovery carry-over first: a pod whose admission record
+            # survived the crash but whose bind did not is ALREADY
+            # admitted (durable debit + admitted_log entry) — it re-enters
+            # the batch in durable admission order, ahead of and without
+            # re-debiting new WFQ selections.  Its heap entry goes stale
+            # and is pruned lazily below, like a delete's.
+            pre = self.admission.take_preadmitted(self._in_active)
+            if pre is not None:
+                self._in_active.discard(pre)
+                qp = self._info[pre]
+                qp.attempts += 1
+                self._untrack_gang_member(qp.pod)
+                out.append(qp)
+                continue
+            tenants = []
+            for t in sorted(self._tenant_active):
+                heap = self._tenant_active[t]
+                while heap and heap[0][3] not in self._in_active:
+                    heapq.heappop(heap)  # stale entry (deleted/updated)
+                if heap:
+                    tenants.append(t)
+                else:
+                    del self._tenant_active[t]
+            if not tenants:
+                break
+            now = self.admission.now()
+            picked = self.admission.select(tenants, now)
+            if picked is None:
+                # Pods are queued but every tenant is credit-blocked:
+                # throttled, not starved — aging re-arms eligibility.
+                self.last_pop_throttled = True
+                break
+            tenant, escape = picked
+            _, _, _, uid = heapq.heappop(self._tenant_active[tenant])
+            self._in_active.discard(uid)
+            qp = self._info[uid]
+            qp.attempts += 1
+            self._untrack_gang_member(qp.pod)  # in-flight, no longer pending
+            self.admission.admit(tenant, uid, now, escape)
             out.append(qp)
         return out
 
@@ -724,6 +821,11 @@ class SchedulingQueue:
 
     def delete(self, uid: str) -> None:
         self._in_active.discard(uid)
+        if self.admission is not None:
+            # A deleted pod's enqueue stamp must not keep holding the
+            # tenant's aging escape open (its heap entry goes stale and
+            # drops lazily at the next pop).
+            self.admission.forget(uid)
         self._unsched_remove(uid)
         self._gated.pop(uid, None)
         self._quarantine.pop(uid, None)
@@ -785,13 +887,41 @@ class SchedulingQueue:
         for pool in self._gang_pool.values():
             for qp in pool.values():
                 ent(qp, "gang")
-        for uid in self._in_active:
+        if self.admission is not None:
+            # In-flight pops whose debits are not yet group-committed:
+            # presumed-aborted on recovery, so they re-enter ACTIVE at
+            # the FRONT in pop order — the restored WFQ ledger predates
+            # their debits and re-selects them exactly as the
+            # interrupted run did.  If the crash DID leave their group
+            # durable, replay supersedes this entry: a bind record's
+            # bound upsert deletes the queue entry (scheduler.add_pod),
+            # and a surviving admission record consumes it through the
+            # preadmitted drain ahead of any fresh selection.
+            for uid in self.admission.pending_intents():
+                qp = self._info.get(uid)
+                if qp is not None and uid not in self._in_active:
+                    ent(qp, "active")
+        # Active pods emit in QueueSort heap order, NOT set order: the
+        # restorer re-pushes entries in document order with fresh seqs and
+        # one shared timestamp, so the stored order IS the recovered pop
+        # order — iterating the _in_active set here would bake one
+        # process's hash order into the snapshot and scramble the armed
+        # per-tenant heads (the tenant kill cells catch this).
+        live = (
+            [it for h in self._tenant_active.values() for it in h]
+            if self.admission is not None
+            else list(self._active)
+        )
+        for item in sorted(live):
+            if item[3] in self._in_active:
+                ent(self._info[item[3]], "active")
+        for uid in sorted(self._in_active):  # heap-orphan backstop
             ent(self._info[uid], "active")
         for uid, left in backoff_left.items():
             qp = self._info.get(uid)
             if qp is not None:
                 ent(qp, "backoff", backoff_remaining_s=round(left, 6))
-        return {
+        out = {
             "entries": entries,
             # Already trimmed to the trailing window (bounded deque):
             # the snapshot can never grow with the release stream.
@@ -806,6 +936,13 @@ class SchedulingQueue:
                 for e in self.release_history
             ],
         }
+        if self.admission is not None:
+            # The DURABLE fairness ledger (WFQ tags, credit balances,
+            # per-tenant attempts, rebased enqueue stamps): snapshot +
+            # journaled "admission" records replay the exact selection
+            # state, so recovery admits in the identical order.
+            out["admission"] = self.admission.durable_state()
+        return out
 
     def restore_state(self, state: dict) -> int:
         """Rebuild the pools from a durable_state() document (recovery).
@@ -815,6 +952,12 @@ class SchedulingQueue:
         from .api import serialize
 
         now = self._clock()
+        # Admission restores FIRST: the pod entries below re-enter through
+        # _push_active → note_enqueue, which keeps an already-present
+        # (rebased) stamp — accumulated starvation wait survives the crash.
+        adm = state.get("admission")
+        if adm is not None and self.admission is not None:
+            self.admission.restore_state(adm)
         n = 0
         for e in state.get("entries", ()):
             pod = serialize.pod_from_data(e["pod"])

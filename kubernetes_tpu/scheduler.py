@@ -3644,6 +3644,15 @@ class TPUScheduler:
         from .engine.pipeline import CommitTicket
 
         ticket = CommitTicket(now=now)
+        if self.queue.admission is not None:
+            # This batch's weighted-fair debits (pop order), captured by
+            # the batch's OWN uids — at depth 2 the prefetch has already
+            # popped batch k+1, whose intents must ride k+1's ticket.
+            # Failed pods' debits stay in: an admission attempt costs
+            # credit whether or not the bind lands.
+            ticket.admission = self.queue.admission.take_intents(
+                [qp.pod.uid for qp in infos]
+            )
         self._pending_ticket = ticket
         m = self.metrics
         m.batches += 1
@@ -4119,6 +4128,15 @@ class TPUScheduler:
                 # A whole batch can yield zero outcomes (members moved to
                 # the WaitOnPermit room) while pods remain active,
                 # prefetched, or predispatched.
+                if (
+                    self.queue.last_pop_throttled
+                    and not self.has_inflight_work
+                ):
+                    # Pods remain but every tenant is credit-blocked
+                    # (weighted-fair admission): looping cannot admit
+                    # them — only the logical clock can, via refill or
+                    # the aging escape.  Stop instead of spinning.
+                    break
                 continue
             if wait_backoff and self.queue.sleep_until_backoff():
                 continue

@@ -12,6 +12,9 @@ evaluated over the committed BENCH_*/SOAK_*/OBS_TAX trajectory:
   overlap_coverage   the pipeline's overlap must stay engaged
   slo_p99            decision latency vs the recorded budget
   obs_tax            the observability A/B gate (<= 2%)
+  fair_steady_p99    fairness isolation: the steady tenant's p99 under a
+                     capped burst vs its recorded solo-baseline tolerance
+  fair_starvation    starvation-SLO violations in the fairness soak (= 0)
 
 Each guard has a WARN boundary (reported, tunnel weather happens — see
 README measurement discipline) and a HARD floor (exit 1: beyond any
@@ -42,6 +45,9 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 # test; ``source`` guards read their value from a committed artifact
 # family instead (newest round wins).  Ops:
 #   ratio_min — value / reference must stay >= warn (warn) / hard (fail)
+#   ratio_paths_max — value / denom (``denom_path``, SAME source doc)
+#               must stay <= warn / hard — for artifacts that record
+#               their own baseline next to the measurement
 #   max       — value must stay <= warn / hard
 #   min       — value must stay >= warn / hard
 # ``budget_key`` (slo_p99) scales warn/hard off the payload's recorded
@@ -101,6 +107,33 @@ GUARDS = (
         "why": "the observability A/B gate: attribution + exporter "
         "surfaces must cost <= 2% throughput",
     },
+    {
+        "name": "fair_steady_p99",
+        "source": {
+            "family": "SOAK_TENANT_r*.json",
+            "path": ("fairness", "steady_p99_ms"),
+            "denom_path": ("fairness", "steady_tolerance_ms"),
+        },
+        "op": "ratio_paths_max",
+        "warn": 0.85,
+        "hard": 1.0,
+        "why": "fairness isolation: the steady tenant's p99 under a "
+        "capped x8 burst vs its recorded solo-baseline tolerance "
+        "(>= 1.0 means the burst moved a bystander's tail)",
+    },
+    {
+        "name": "fair_starvation",
+        "source": {
+            "family": "SOAK_TENANT_r*.json",
+            "path": ("fairness", "starvation_violations"),
+        },
+        "op": "max",
+        "warn": 0,
+        "hard": 0,
+        "why": "starvation-SLO violations in the committed fairness "
+        "soak: rate caps may throttle but aging escape must keep "
+        "every tenant's wait under its SLO budget",
+    },
 )
 
 
@@ -145,7 +178,9 @@ def _eval_guard(guard: dict, payload: dict | None, root: str) -> dict:
         "status": "pass",
     }
     # The value under test: from the payload, or from a committed
-    # artifact family (obs_tax — the payload never carries it).
+    # artifact family (obs_tax, the fairness soak — the payload never
+    # carries them).
+    denom = None
     if "source" in guard:
         src = newest_artifact(root, guard["source"]["family"])
         if src is None:
@@ -154,9 +189,12 @@ def _eval_guard(guard: dict, payload: dict | None, root: str) -> dict:
             return out
         out["source_file"] = os.path.basename(src)
         try:
-            value = _dig(load_payload(src), guard["source"]["path"])
+            src_doc = load_payload(src)
         except (OSError, ValueError):
-            value = None
+            src_doc = None
+        value = _dig(src_doc or {}, guard["source"]["path"])
+        if "denom_path" in guard["source"]:
+            denom = _dig(src_doc or {}, guard["source"]["denom_path"])
     else:
         value = _dig(payload or {}, guard["value"])
     if value is None:
@@ -194,6 +232,20 @@ def _eval_guard(guard: dict, payload: dict | None, root: str) -> dict:
         if ratio < hard:
             out["status"] = "hard_fail"
         elif ratio < warn:
+            out["status"] = "warn"
+        return out
+    if guard["op"] == "ratio_paths_max":
+        if not denom:
+            out["status"] = "missing"
+            out["missing"] = "/".join(guard["source"]["denom_path"])
+            return out
+        out["reference"] = denom
+        ratio = float(value) / float(denom)
+        out["ratio"] = round(ratio, 4)
+        out["warn_above"], out["hard_above"] = warn, hard
+        if ratio > hard:
+            out["status"] = "hard_fail"
+        elif ratio > warn:
             out["status"] = "warn"
         return out
     out["warn_limit"], out["hard_limit"] = warn, hard
@@ -249,10 +301,14 @@ def _print_table(block: dict) -> None:
         mark = {"pass": "ok  ", "warn": "WARN", "hard_fail": "FAIL",
                 "missing": "miss"}[g["status"]]
         if "ratio" in g:
+            lim = (
+                f"warn>{g['warn_above']} hard>{g['hard_above']}"
+                if "warn_above" in g
+                else f"warn<{g['warn_below']} hard<{g['hard_below']}"
+            )
+            src = g.get("reference_file") or g.get("source_file", "?")
             detail = (
-                f"ratio {g['ratio']} vs {g.get('reference')} "
-                f"({g.get('reference_file', '?')}; warn<{g['warn_below']} "
-                f"hard<{g['hard_below']})"
+                f"ratio {g['ratio']} vs {g.get('reference')} ({src}; {lim})"
             )
         elif "value" in g:
             lim = (

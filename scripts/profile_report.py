@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 
 
@@ -179,6 +180,48 @@ def report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def _decision_latency_split(doc: dict) -> str:
+    """Table of scheduler_slo_decision_latency_seconds by tenant and
+    component (total / queue_wait / service), folded over phases from
+    the artifact's registry dump.  The split separates admission wait
+    (driver backlog or a fairness rate cap) from the scheduler's own
+    service time — a capped tenant shows a fat queue_wait next to an
+    unchanged service column."""
+    hists = (doc.get("fleet_metrics") or {}).get("histograms") or {}
+    cells = hists.get("scheduler_slo_decision_latency_seconds") or {}
+    agg: dict[tuple, list] = {}
+    for key, cell in cells.items():
+        labels = dict(re.findall(r'(\w+)="([^"]*)"', key))
+        comp = labels.get("component", "total")
+        tenant = labels.get("tenant", "-")
+        a = agg.setdefault((tenant, comp), [0, 0.0])
+        a[0] += cell.get("count", 0)
+        a[1] += cell.get("sum", 0.0)
+    if not any(comp != "total" for _, comp in agg):
+        return ""
+    rows = []
+    for tenant in sorted({t for t, _ in agg}):
+        def _mean(comp):
+            n, s = agg.get((tenant, comp), (0, 0.0))
+            return (s / n * 1e3) if n else 0.0
+        total, qwait, svc = (
+            _mean("total"), _mean("queue_wait"), _mean("service")
+        )
+        n = agg.get((tenant, "total"), (0, 0.0))[0]
+        rows.append(
+            (
+                tenant, n, f"{total:.1f}ms", f"{qwait:.1f}ms",
+                f"{svc:.1f}ms",
+                f"{100 * qwait / total:.0f}%" if total else "-",
+            )
+        )
+    return _table(
+        rows,
+        ("tenant", "samples", "mean total", "queue_wait", "service",
+         "wait share"),
+    )
+
+
 def soak_report(doc: dict) -> str:
     """Render one SOAK_rNN.json artifact: SLO, knee curve, journal
     growth, per-phase serving table."""
@@ -265,6 +308,40 @@ def soak_report(doc: dict) -> str:
                     f"{k}={int(v)}" for k, v in sorted(c.items())
                 )
                 out.append(f"  {name}: {pairs}")
+        split = _decision_latency_split(doc)
+        if split:
+            out.append(
+                "decision-latency component split (queue_wait = admission "
+                "wait — backlog or rate cap; service = scheduler time):"
+            )
+            out.append(split)
+    adm = doc.get("admission")
+    if adm and adm.get("armed"):
+        st = adm.get("status") or {}
+        out.append(
+            f"\nweighted-fair admission: vtime {st.get('vtime')}  "
+            f"admitted {adm.get('admitted_total')} "
+            f"(order sha {str(adm.get('admission_order_sha256', ''))[:12]}…)  "
+            f"throttle hits {st.get('throttle_hits')}  aging escapes "
+            f"{st.get('aging_escapes')}  starvation violations "
+            f"{st.get('starvation_violations')}"
+        )
+        rows = [
+            (
+                name, t.get("weight"), t.get("credits"),
+                t.get("vtime_lag"), t.get("pending"),
+                t.get("oldest_wait_s"), t.get("slo"),
+            )
+            for name, t in sorted((st.get("tenants") or {}).items())
+        ]
+        if rows:
+            out.append(
+                _table(
+                    rows,
+                    ("tenant", "weight", "credits", "vt-lag", "pending",
+                     "oldest-wait", "slo"),
+                )
+            )
     ft = doc.get("fleet_timeline")
     if ft:
         out.append(

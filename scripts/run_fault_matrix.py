@@ -763,6 +763,261 @@ def run_pack_kill_matrix(cases=PACK_KILL_CASES, verbose=True) -> list[str]:
         return failures
 
 
+# -- the TENANT crash subset (ISSUE 17: weighted-fair admission) ------------
+
+# The fairness ledger's crash claim: WFQ virtual-time tags, burst-credit
+# balances, and pending-age stamps are journaled state — the commit drain
+# journals each batch's ``admission`` debit record inside the group
+# barrier before applying it to the durable ledger, and snapshots carry
+# the ledger with its ABSOLUTE logical clock.  A SIGKILL mid-burst
+# (credits exhausted, throttled tenants queued, aging escapes coming)
+# must recover and complete with the ADMISSION ORDER and the bindings
+# both bit-identical to an uninterrupted run — including the asymmetric
+# cases where an admission record survives but its batch's binds do not
+# (the pod re-admits WITHOUT a second debit, in durable order) and vice
+# versa.  The scenario drives three weighted tenants (2:1:0.5) through a
+# rate cap small enough that the initial burst credits exhaust mid-run
+# and the tail drains on refills and aging escapes, on a stepwise
+# logical clock the recovery child resumes at the recovered high-water
+# mark.  Append order per batch: admission record first, then the
+# batch's binds (snapshot-every-batch truncations interleave).
+TENANT_KILL_CASES = (
+    ("post-append", 2),   # admission durable, its batch's binds lost
+    ("torn-append", 3),   # a bind of the first batch torn mid-write
+    ("post-append", 7),   # mid-burst: a later batch's admission durable
+    ("torn-append", 6),   # a later batch's admission record torn
+    ("mid-snapshot", 1),  # ledger checkpoint torn while throttled
+    ("mid-truncate", 2),  # truncation interrupted post-snapshot
+)
+
+
+def tenant_scenario_objects():
+    """Three tenants with deliberately unequal pod counts on a cluster
+    with room for all of them: the claim under test is ORDER, so every
+    pod binds and the only degree of freedom is the admission sequence
+    (NodeResourcesFit scoring makes placement order-sensitive)."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+    from kubernetes_tpu.framework.metrics import TENANT_LABEL_KEY
+
+    nodes = [
+        make_node(f"tn{i}")
+        .capacity({"cpu": "8", "memory": "16Gi", "pods": 16})
+        .zone(f"z{i % 2}")
+        .obj()
+        for i in range(4)
+    ]
+    pods = [
+        make_pod(f"tp-{t}-{i:02d}").req({"cpu": "200m"}).label(
+            TENANT_LABEL_KEY, t
+        ).obj()
+        for t, n in (("ten-a", 10), ("ten-b", 8), ("ten-c", 6))
+        for i in range(n)
+    ]
+    return nodes, pods
+
+
+def _tenant_scheduler(state_dir: str):
+    from kubernetes_tpu.framework.config import Profile
+    from kubernetes_tpu.framework.fairness import FairAdmission
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+    from kubernetes_tpu.ops.common import registered_subset
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    sched = TPUScheduler(
+        profile=registered_subset(
+            Profile(
+                name="tenant-kill",
+                filters=("NodeResourcesFit",),
+                scorers=(("NodeResourcesFit", 1),),
+            )
+        ),
+        batch_size=4,
+        enable_preemption=False,
+    )
+    # No injected clock: the policy runs on its note_time high-water
+    # mark, which the snapshot carries absolutely and replayed debits
+    # re-advance — the recovery child resumes the wave loop from it.
+    sched.queue.arm_admission(
+        FairAdmission(
+            weights={"ten-a": 2.0, "ten-b": 1.0, "ten-c": 0.5},
+            rate_pods_per_s=2.0,
+            burst=3.0,
+            aging_max_wait_s=3.0,
+            slo_wait_budget_s=50.0,
+        )
+    )
+    lease_path = os.path.join(state_dir, "lease")
+    lease = FileLease(lease_path, identity=f"tenantkill-{os.getpid()}")
+    lease.acquire(block=True)
+    journal = Journal(
+        state_dir, epoch=lease.epoch, fence=lambda: read_epoch(lease_path)
+    )
+    return sched, journal
+
+
+def _tenant_drive(sched, t0: int = 0) -> None:
+    """Stepwise logical waves: each wave advances the admission clock
+    one logical second and drains everything admissible (the armed queue
+    reports throttled when every tenant is credit-blocked — the wave
+    loop, not polling, advances refills and aging).  The horizon is far
+    past the 24 pods' drain point; both children run the same waves."""
+    adm = sched.queue.admission
+    for t in range(t0, 40):
+        adm.note_time(float(t))
+        sched.schedule_all_pending(wait_backoff=True)
+        if not len(sched.queue) and not sched.has_inflight_work:
+            break
+
+
+def _tenant_write_result(sched, state_dir: str) -> None:
+    bindings = {
+        uid: pr.node_name
+        for uid, pr in sched.cache.pods.items()
+        if pr.bound
+    }
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(bindings, f, sort_keys=True)
+    with open(os.path.join(state_dir, "admission.json"), "w") as f:
+        json.dump(list(sched.queue.admission.admitted_log), f)
+
+
+def tenant_kill_child(state_dir: str) -> None:
+    from kubernetes_tpu.faults import KillSwitch
+
+    sched, journal = _tenant_scheduler(state_dir)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    nodes, pods = tenant_scenario_objects()
+    for n in nodes:
+        sched.add_node(n)
+    for p in pods:
+        sched.add_pod(p)
+    _tenant_drive(sched)
+    _tenant_write_result(sched, state_dir)
+
+
+def tenant_recover_child(state_dir: str) -> None:
+    import copy
+
+    from kubernetes_tpu.informers import (
+        FakeSource,
+        Reflector,
+        reconcile_after_recovery,
+    )
+    from kubernetes_tpu.journal import recover
+
+    sched, journal = _tenant_scheduler(state_dir)
+    recover(sched, journal)
+    sched.attach_journal(journal, snapshot_every_batches=1)
+    nodes, pods = tenant_scenario_objects()
+    src_n, src_p = FakeSource(), FakeSource()
+    for n in nodes:
+        src_n.add(n.name, copy.deepcopy(n))
+    for p in pods:
+        src_p.add(p.uid, copy.deepcopy(p))
+    reconcile_after_recovery(
+        sched,
+        Reflector(sched, "Node", src_n.lister, src_n.watcher),
+        Reflector(sched, "Pod", src_p.lister, src_p.watcher),
+    )
+    # The selectHost tie-break seed is the pod's global dispatch index
+    # (scheduler._cycle at dispatch + batch offset) — not durable state.
+    # In this retry-free scenario every admitted pod consumes exactly one
+    # dispatch slot, so the recovered counter is the durably-bound count:
+    # carried-over pods (admission durable, binds lost) re-dispatch at
+    # precisely the slots they occupied in the uninterrupted run, because
+    # the preadmitted drain preserves their admission order and batch
+    # boundaries don't shift per-pod seeds.
+    sched._cycle = sum(1 for pr in sched.cache.pods.values() if pr.bound)
+    # Resume the wave loop AT the recovered clock high-water mark —
+    # re-running the interrupted wave is idempotent: replayed admissions
+    # are in the ledger (their unbound pods re-admit via the carry-over,
+    # debit-free), and refills are min-clamped linear, so stepping the
+    # same wave twice cannot over-refill.
+    _tenant_drive(sched, t0=int(sched.queue.admission.now()))
+    _tenant_write_result(sched, state_dir)
+
+
+def _read_admission(state_dir: str) -> list | None:
+    try:
+        with open(os.path.join(state_dir, "admission.json")) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def run_tenant_kill_matrix(
+    cases=TENANT_KILL_CASES, verbose=True
+) -> list[str]:
+    """SIGKILL the weighted-fair admission scenario at journal points
+    mid-burst, recover, and compare final bindings AND the durable
+    admission order to an uninterrupted run.  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        base_dir = os.path.join(td, "tenant-baseline")
+        os.makedirs(base_dir)
+        rc = _spawn("--tenant-kill-child", base_dir)
+        baseline = _read_bindings(base_dir)
+        base_order = _read_admission(base_dir)
+        assert rc == 0 and baseline and base_order, (
+            "tenant baseline run failed"
+        )
+        assert sorted(baseline) == sorted(base_order), (
+            "tenant baseline did not drain: bindings and admission order "
+            "cover different pods"
+        )
+        failures = []
+        for point, nth in cases:
+            label = f"tenantkill:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
+            state_dir = os.path.join(td, f"tenant-{point}-{nth}")
+            os.makedirs(state_dir)
+            rc = _spawn(
+                "--tenant-kill-child", state_dir, kill=f"{point}:{nth}"
+            )
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                order = _read_admission(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline or order != base_order:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}{_cell_dt(t0)}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn("--tenant-recover-child", state_dir)
+            got = _read_bindings(state_dir)
+            order = _read_admission(state_dir)
+            if rc != 0 or got != baseline or order != base_order:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    odiff = order != base_order
+                    print(
+                        f"FAIL {label}: rc={rc} diff={diff} "
+                        f"order_diverged={odiff}{_cell_dt(t0)}"
+                    )
+            elif verbose:
+                print(
+                    f"ok   {label}: recovered bit-identical bindings + "
+                    f"admission order{_cell_dt(t0)}"
+                )
+        return failures
+
+
 # -- the PIPELINE crash subset (ISSUE 15: group commit + overlapped drain) --
 
 # The pipelined commit drain's crash claim: a staged commit group is
@@ -2681,6 +2936,32 @@ def main() -> int:
             "bit-identical (packed baseline == chunk1 parity)"
         )
         return 0
+    if "--tenant-kill-child" in sys.argv:
+        tenant_kill_child(
+            sys.argv[sys.argv.index("--tenant-kill-child") + 1]
+        )
+        return 0
+    if "--tenant-recover-child" in sys.argv:
+        tenant_recover_child(
+            sys.argv[sys.argv.index("--tenant-recover-child") + 1]
+        )
+        return 0
+    if "--tenant-kill" in sys.argv:
+        # The weighted-fair admission subset alone (rides --kill).
+        failures = run_tenant_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(TENANT_KILL_CASES)} tenant kill "
+                f"cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(TENANT_KILL_CASES)} tenant kill cases: SIGKILL "
+            "mid-burst under weighted-fair admission recovered the WFQ "
+            "ledger from snapshot + journaled debits, admission order "
+            "AND bindings bit-identical"
+        )
+        return 0
     if "--pipeline-kill-child" in sys.argv:
         pipeline_kill_child(
             sys.argv[sys.argv.index("--pipeline-kill-child") + 1]
@@ -2840,11 +3121,13 @@ def main() -> int:
         failures += run_pack_kill_matrix()
         # And the pipelined group-commit drain subset (ISSUE 15).
         failures += run_pipeline_kill_matrix()
+        # And the weighted-fair admission subset (ISSUE 17).
+        failures += run_tenant_kill_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
             + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
             + len(AUTOSCALE_KILL_CASES) + len(PACK_KILL_CASES)
-            + len(PIPELINE_KILL_CASES)
+            + len(PIPELINE_KILL_CASES) + len(TENANT_KILL_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -578,6 +578,328 @@ def run_tenant(args) -> int:
     return 0
 
 
+def run_tenant_fair(args) -> int:
+    """--tenant-fair: the weighted-fair admission soak (ISSUE 17),
+    recorded as SOAK_TENANT_r17.json — the r12 starvation scenario
+    re-run with framework/fairness ARMED on the fleet router's queue.
+    Five legs, one document:
+
+    1. determinism cross-check (2× virtual, armed): bit-identical
+       bindings, timeline, AND admission order (the WFQ ledger is
+       deterministic on the logical clock);
+    2. armed-vs-unarmed cross-check (virtual): the SAME config without
+       the admission block binds identically to a pre-fairness run —
+       arming is what changes admission order, OFF stays off;
+    3. the SOLO baseline (real pace, multi-process, armed): the steady
+       tenant alone — under its rate cap the bucket never empties, so
+       this is its uncontended p99;
+    4. the MAIN armed run (real pace, multi-process): both streams, the
+       bursty tenant's ×burst-factor spike clipped by its token bucket.
+       Gates: the steady tenant's p99 within the r12 solo tolerance,
+       ZERO starvation-SLO violations, and the cap demonstrably engaged
+       (throttle hits > 0);
+    5. the hashed-tier leg (virtual): ≥1k tenants through the labeler's
+       crc32 tail tier — per-tenant label cardinality must stay under
+       top-K + buckets + 1 while admission stays armed.
+
+    Weights derive from the synthetic throughput matrix over the
+    streams' workload_class mapping (steady=serve, bursty=train-large):
+    accelerator-time share, not nominal pod count."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import run_fleet_soak, strip_private
+
+    streams = tuple(
+        dict(ts, workload_class=wc)
+        for ts, wc in zip(tenant_streams(args), ("serve", "train-large"))
+    )
+    # Knobs calibrated to the streams: the steady tenant (8 pods/s)
+    # stays under the refill rate and never throttles; the bursty tenant's
+    # ×8 spike (32 pods/s offered) drains its burst credits and clips
+    # HARD to the refill rate for the window — the cap must hold the
+    # total admitted stream under fleet saturation or the bystander's
+    # tail moves with the burst (the whole point of the gate).  Aging
+    # escapes before the starvation budget, so a capped tenant can be
+    # THROTTLED for a long burst but structurally never STARVED.
+    admission = {
+        "rate_pods_per_s": 10.0,
+        "burst": 12.0,
+        "aging_max_wait_s": 40.0,
+        "slo_wait_budget_s": 60.0,
+    }
+    cfg = dataclasses.replace(
+        r06_config(args),
+        diurnal=False,
+        tenant_streams=streams,
+        admission=admission,
+        node_flap_period_s=0.0,
+        cold_consumer_period_s=0.0,
+        two_process=True,
+    )
+    shards = args.shards or 2
+
+    def small(base, **kw):
+        kw.setdefault(
+            "tenant_streams",
+            tuple(
+                dict(ts, burst_start_s=2.5, burst_end_s=5.0)
+                if "burst_factor" in ts
+                else ts
+                for ts in base.tenant_streams
+            ),
+        )
+        return dataclasses.replace(
+            base,
+            nodes=min(base.nodes, 32),
+            churn_nodes=2,
+            duration_s=8.0,
+            live_pod_cap=120,
+            warm_pods=32,
+            batch_size=64,
+            two_process=False,
+            pace="virtual",
+            journal_fsync="never",
+            out_dir="",
+            journal_dir="",
+            **kw,
+        )
+
+    check_cfg = small(cfg)
+    print(
+        "run_soak: fair-admission determinism cross-check (2× virtual, "
+        "armed)…",
+        flush=True,
+    )
+    a = run_fleet_soak(check_cfg, shards)
+    b = run_fleet_soak(check_cfg, shards)
+    adm_a = a.get("admission") or {}
+    adm_b = b.get("admission") or {}
+    check = {
+        "seed": check_cfg.seed,
+        "runs": 2,
+        "arrival_schedule_identical": (
+            a["_arrival_offsets"] == b["_arrival_offsets"]
+        ),
+        "bindings_identical": (
+            a["determinism"]["bindings_sha256"]
+            == b["determinism"]["bindings_sha256"]
+        ),
+        "bindings_sha256": a["determinism"]["bindings_sha256"],
+        "timeline_identical": (
+            a["determinism"]["timeline_sha256"] is not None
+            and a["determinism"]["timeline_sha256"]
+            == b["determinism"]["timeline_sha256"]
+        ),
+        # The new oracle surface: the WFQ ledger's admission ORDER must
+        # replay bit-identically, not just the placements it produced.
+        "admission_order_identical": (
+            adm_a.get("admission_order_sha256") is not None
+            and adm_a.get("admission_order_sha256")
+            == adm_b.get("admission_order_sha256")
+        ),
+        "admission_order_sha256": adm_a.get("admission_order_sha256"),
+        "admitted_total": adm_a.get("admitted_total"),
+        "bound_final": a["bound_final"],
+    }
+    print(f"run_soak: {json.dumps(check)}", flush=True)
+    if not (
+        check["arrival_schedule_identical"]
+        and check["bindings_identical"]
+        and check["timeline_identical"]
+        and check["admission_order_identical"]
+    ):
+        print("run_soak: FAIR-ADMISSION DETERMINISM CHECK FAILED",
+              file=sys.stderr)
+        return 1
+    print("run_soak: armed-vs-unarmed cross-check…", flush=True)
+    unarmed = run_fleet_soak(
+        dataclasses.replace(check_cfg, admission=None), shards
+    )
+    arming_check = {
+        # Unarmed must look exactly like the pre-fairness scheduler
+        # (no admission block at all in its artifact)…
+        "unarmed_has_no_admission_block": unarmed.get("admission") is None,
+        # …and arming must actually STEER: identical bindings would mean
+        # the policy is decorative.
+        "armed_bindings_differ_from_unarmed": (
+            unarmed["determinism"]["bindings_sha256"]
+            != a["determinism"]["bindings_sha256"]
+        ),
+    }
+    print(f"run_soak: {json.dumps(arming_check)}", flush=True)
+    if not all(arming_check.values()):
+        print("run_soak: ARMING CROSS-CHECK FAILED", file=sys.stderr)
+        return 1
+
+    solo_cfg = dataclasses.replace(cfg, tenant_streams=(streams[0],))
+    print(
+        f"run_soak: SOLO baseline — steady tenant alone at "
+        f"{streams[0]['rate_pods_per_s']} pods/s under the armed cap "
+        f"for {cfg.duration_s:.0f}s (multi-process, {shards} shards)…",
+        flush=True,
+    )
+    solo = strip_private(run_fleet_soak(solo_cfg, shards))
+    solo_steady = (solo.get("tenants") or {}).get("per_tenant", {}).get(
+        "steady", {}
+    )
+    print(
+        f"run_soak: solo steady p50/p99/p999 "
+        f"{solo_steady.get('p50_ms')}/{solo_steady.get('p99_ms')}/"
+        f"{solo_steady.get('p999_ms')}ms",
+        flush=True,
+    )
+    print(
+        f"run_soak: ARMED run — steady {streams[0]['rate_pods_per_s']} "
+        f"pods/s + bursty {streams[1]['rate_pods_per_s']} pods/s "
+        f"(×{streams[1]['burst_factor']} over "
+        f"[{streams[1]['burst_start_s']:.0f}, "
+        f"{streams[1]['burst_end_s']:.0f})s), cap "
+        f"{admission['rate_pods_per_s']} pods/s + "
+        f"{admission['burst']} burst credits, multi-process…",
+        flush=True,
+    )
+    artifact = strip_private(run_fleet_soak(cfg, shards))
+    per_tenant = (artifact.get("tenants") or {}).get("per_tenant", {})
+    steady = per_tenant.get("steady", {})
+    bursty = per_tenant.get("bursty", {})
+    status = (artifact.get("admission") or {}).get("status") or {}
+    t_status = status.get("tenants") or {}
+    solo_p99 = solo_steady.get("p99_ms") or 0.0
+    # The r12 tolerance, unchanged — the claim is that the same formula
+    # that documented FIFO's bounded interference now holds WITH the
+    # policy actively clipping the burst.
+    tol_ms = round(
+        min(max(solo_p99 * 2.0, solo_p99 + 75.0), cfg.slo_budget_ms), 3
+    )
+    burst_split = (artifact.get("tenants") or {}).get("burst_split") or {}
+    fairness = {
+        "burst": streams[1],
+        "admission": admission,
+        "weights": {
+            t: (t_status.get(t) or {}).get("weight")
+            for t in ("steady", "bursty")
+        },
+        "steady_p99_ms": steady.get("p99_ms"),
+        "solo_steady_p99_ms": solo_p99,
+        "steady_tolerance_ms": tol_ms,
+        "tolerance_rule": (
+            "min(max(2x solo p99, solo p99 + 75ms), slo budget)"
+        ),
+        "steady_within_solo_baseline": (
+            steady.get("p99_ms") is not None
+            and steady.get("p99_ms") <= tol_ms
+        ),
+        "bursty_p99_ms": bursty.get("p99_ms"),
+        "bursty_p999_ms": bursty.get("p999_ms"),
+        "throttle_hits": status.get("throttle_hits"),
+        "aging_escapes": status.get("aging_escapes"),
+        "starvation_violations": status.get("starvation_violations"),
+        "capped_tenant_starved": (t_status.get("bursty") or {}).get(
+            "starved"
+        ),
+        "cap_engaged": bool(status.get("throttle_hits")),
+        "zero_starvation": (
+            status.get("starvation_violations") == 0
+            and not (t_status.get("bursty") or {}).get("starved")
+        ),
+        "in_burst_share": burst_split.get("in_burst_share"),
+        "burst_split": burst_split.get("per_tenant"),
+    }
+    print(
+        "run_soak: hashed-tier leg — 1024 tenants through the crc32 "
+        "tail (virtual)…",
+        flush=True,
+    )
+    hashed_cfg = small(
+        cfg,
+        tenant_streams=(),
+        tenants=tuple(
+            (f"team-{i:04d}", 1.0 + (i % 7) * 0.25) for i in range(1024)
+        ),
+        tenant_hash_buckets=64,
+    )
+    hashed = run_fleet_soak(hashed_cfg, shards)
+    # The bounded surface is the METRICS registry's tenant label sets
+    # (the artifact's per_tenant block stays keyed by raw tenant id by
+    # design — driver-side attribution, not exposition): collect every
+    # tenant="…" label value across the registry dump.
+    import re as _re
+
+    labels: set[str] = set()
+    fm = hashed.get("fleet_metrics") or {}
+    for family in ("counters", "histograms", "gauges"):
+        for cells in (fm.get(family) or {}).values():
+            for key in cells:
+                labels.update(_re.findall(r'tenant="([^"]*)"', key))
+    from kubernetes_tpu.framework.metrics import TENANT_CARDINALITY_LIMIT
+
+    label_cap = TENANT_CARDINALITY_LIMIT + hashed_cfg.tenant_hash_buckets + 1
+    hashed_check = {
+        "tenants_offered": len(hashed_cfg.tenants),
+        "hash_buckets": hashed_cfg.tenant_hash_buckets,
+        "distinct_labels": len(labels),
+        "hashed_labels": sum(1 for x in labels if x.startswith("~")),
+        "label_cap": label_cap,
+        "cardinality_bounded": 0 < len(labels) <= label_cap,
+        "admission_armed": (hashed.get("admission") or {}).get("armed"),
+        "admitted_total": (hashed.get("admission") or {}).get(
+            "admitted_total"
+        ),
+    }
+    print(f"run_soak: {json.dumps(hashed_check)}", flush=True)
+    if not (
+        hashed_check["cardinality_bounded"]
+        and hashed_check["hashed_labels"] > 0
+        and hashed_check["admission_armed"]
+    ):
+        print("run_soak: HASHED-TIER LEG FAILED", file=sys.stderr)
+        return 1
+    doc = {
+        **artifact,
+        "metric": "tenant_soak_fair_admission",
+        "fairness": fairness,
+        "solo": {
+            "slo": solo.get("slo"),
+            "tenants": solo.get("tenants"),
+            "decisions": solo.get("decisions"),
+            "wall_s": solo.get("wall_s"),
+        },
+        "determinism_check": check,
+        "arming_check": arming_check,
+        "hashed_tier_check": hashed_check,
+    }
+    doc["environment"] = {
+        "backend": os.environ.get("JAX_PLATFORMS", ""),
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"run_soak: wrote {args.out} — steady p99 "
+        f"{fairness['steady_p99_ms']}ms (solo {solo_p99}ms, tolerance "
+        f"{tol_ms}ms, within={fairness['steady_within_solo_baseline']}), "
+        f"throttle hits {fairness['throttle_hits']}, starvation "
+        f"violations {fairness['starvation_violations']}, capped tenant "
+        f"starved={fairness['capped_tenant_starved']}",
+        flush=True,
+    )
+    if not fairness["steady_within_solo_baseline"]:
+        print("run_soak: STEADY TENANT BLEW ITS SOLO BASELINE",
+              file=sys.stderr)
+        return 1
+    if not fairness["zero_starvation"]:
+        print("run_soak: CAPPED TENANT HIT ITS STARVATION SLO",
+              file=sys.stderr)
+        return 1
+    if not fairness["cap_engaged"]:
+        print("run_soak: RATE CAP NEVER ENGAGED — scenario mis-calibrated",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_fleet(args) -> int:
     """--shards N: soak the partitioned fleet (kubernetes_tpu/fleet)
     through the loadgen scenarios — flaps (or, with --node-loss, node
@@ -759,6 +1081,12 @@ def main() -> int:
                     "tenant-tagged streams over a multi-process fleet, "
                     "one bursting mid-run — per-tenant SLO split + solo "
                     "baseline, recorded as SOAK_TENANT_r12.json")
+    ap.add_argument("--tenant-fair", action="store_true",
+                    help="the weighted-fair admission soak (ISSUE 17): "
+                    "the r12 starvation scenario with WFQ + rate caps "
+                    "armed on the router queue, plus the armed "
+                    "determinism and ≥1k-tenant hashed-tier legs, "
+                    "recorded as SOAK_TENANT_r17.json")
     ap.add_argument("--steady-rate", type=float, default=8.0,
                     help="tenant soak: the steady tenant's arrival rate")
     ap.add_argument("--bursty-rate", type=float, default=4.0,
@@ -802,7 +1130,7 @@ def main() -> int:
     ap.add_argument("--scaling-seconds", type=float, default=45.0,
                     help="duration of each scaling-sweep point")
     args = ap.parse_args()
-    if (args.autoscale or args.tenant) and not args.shards:
+    if (args.autoscale or args.tenant or args.tenant_fair) and not args.shards:
         args.shards = 2
     if args.autoscale:
         # r11 calibration (only where the flag was left at its default):
@@ -817,7 +1145,9 @@ def main() -> int:
         if args.snapshot_every == 24:
             args.snapshot_every = 8
     if not args.out:
-        if args.tenant:
+        if args.tenant_fair:
+            args.out = "SOAK_TENANT_r17.json"
+        elif args.tenant:
             args.out = "SOAK_TENANT_r12.json"
         elif args.shards:
             if args.autoscale:
@@ -834,6 +1164,8 @@ def main() -> int:
             "soak_dumps",
         )
 
+    if args.tenant_fair:
+        return run_tenant_fair(args)
     if args.tenant:
         return run_tenant(args)
     if args.shards:

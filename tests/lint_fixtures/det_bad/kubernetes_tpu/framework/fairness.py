@@ -1,0 +1,43 @@
+"""Seeded determinism violations in a weighted-fair admission policy
+(ISSUE 17): a wall-clock credit refill, a random tie-break, a bare-set
+tenant scan and a salted-hash overflow bucket — the four ways a
+replayed admission order silently diverges from the interrupted run's
+(tests/test_static_analysis.py counts these)."""
+
+import random
+import time
+
+
+class BadAdmission:
+    def __init__(self):
+        self.credits = {}
+        self.vfinish = {}
+
+    def refill(self, tenant, rate):
+        # POSITIVE det-wallclock: credits refilled off wall time — the
+        # recovered ledger refills a different amount than the
+        # interrupted run did, and the replayed admission order drifts.
+        now = time.time()
+        self.credits[tenant] = self.credits.get(tenant, 0.0) + rate * now
+        return now
+
+    def select(self, tenants):
+        best = None
+        # POSITIVE det-set-iteration: a hash-ordered tenant scan breaks
+        # ties by whatever PYTHONHASHSEED dealt this process — sibling
+        # shards disagree on the admission order.
+        for tenant in set(tenants):
+            key = self.vfinish.get(tenant, 0.0)
+            if best is None or key < best[0]:
+                best = (key, tenant)
+            elif key == best[0] and random.random() < 0.5:
+                # POSITIVE det-random: a coin-flip tie-break can never
+                # replay — same-seed runs admit different tenants.
+                best = (key, tenant)
+        return best
+
+    def overflow_bucket(self, tenant, buckets):
+        # POSITIVE det-builtin-hash: the salted builtin hash() assigns a
+        # different overflow bucket per process — the hashed metric tier
+        # and the journaled admission state stop agreeing.
+        return hash(tenant) % buckets

@@ -1,0 +1,33 @@
+"""The allowed idioms for a weighted-fair admission policy: the
+LOGICAL clock injected by the caller, sorted tenant scans with name
+tie-breaks, and crc32 overflow bucketing — a recovered ledger replays
+the exact admission order of the interrupted run."""
+
+import zlib
+
+
+class GoodAdmission:
+    def __init__(self, clock):
+        self.clock = clock  # injected logical clock, never a wall read
+        self.credits = {}
+        self.vfinish = {}
+
+    def refill(self, tenant, rate):
+        now = self.clock()
+        self.credits[tenant] = self.credits.get(tenant, 0.0) + rate * now
+        return now
+
+    def select(self, tenants):
+        best = None
+        # NEGATIVE: sorted() over the candidate set is the fix — ties
+        # break on the sorted tenant name, identically in every process.
+        for tenant in sorted(set(tenants)):
+            key = (self.vfinish.get(tenant, 0.0), tenant)
+            if best is None or key < best:
+                best = key
+        return best
+
+    def overflow_bucket(self, tenant, buckets):
+        # NEGATIVE: crc32 is unsalted — every process, every run, the
+        # same bucket.
+        return zlib.crc32(tenant.encode("utf-8")) % buckets

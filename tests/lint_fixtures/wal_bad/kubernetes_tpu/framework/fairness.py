@@ -1,0 +1,28 @@
+"""Seeded fairness-ledger WAL violations (ISSUE 17): the durable WFQ
+ledger advances only through apply_admission, and the debit batch's
+``admission`` record must be inside the group barrier FIRST — applying
+debits the journal never heard of lets a crash re-select those pods in
+a different order than the run it interrupted."""
+
+
+class BadCommitDrain:
+    def drain_without_journal(self, sched, ticket):
+        # POSITIVE wal-unjournaled-apply: the debit batch goes durable
+        # with no journal append in scope — a SIGKILL here forgets the
+        # admissions while their ledger debits survive the snapshot.
+        sched.queue.admission.apply_admission(ticket.admission)
+
+    def drain_apply_then_group(self, sched, ticket):
+        # POSITIVE wal-apply-before-journal: debits applied BEFORE the
+        # group appends the admission record — the mid-group-fsync crash
+        # cell would find a durable ledger with no record to replay.
+        sched.queue.admission.apply_admission(ticket.admission)
+        with sched.journal.group():
+            sched._journal_append("admission", debits=ticket.admission)
+
+    def healthy_drain(self, sched, ticket):
+        # NEGATIVE: the admission record rides the group barrier first;
+        # debits apply only after the fsync returns.
+        with sched.journal.group():
+            sched._journal_append("admission", debits=ticket.admission)
+        sched.queue.admission.apply_admission(ticket.admission)

@@ -1,0 +1,15 @@
+"""Healthy fairness-ledger commit shape: the debit batch's
+``admission`` record is appended inside the group barrier before any
+bind record, and the durable ledger advances only after the fsync
+returns — the real drain_commit ordering."""
+
+
+class GoodCommitDrain:
+    def drain(self, sched, ticket):
+        with sched.journal.group():
+            sched._journal_append("admission", debits=ticket.admission)
+            for sb in ticket.staged:
+                sched._journal_bind(sb.qp.pod, sb.node_name)
+        sched.queue.admission.apply_admission(ticket.admission)
+        for sb in ticket.staged:
+            sched.cache.finish_binding(sb.qp.pod.uid)

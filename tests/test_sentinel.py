@@ -39,7 +39,7 @@ def test_committed_trajectory_passes_every_guard():
     assert block["missing"] == []
     assert {g["name"] for g in block["guards"]} == {
         "headline", "flagship", "journal_fsyncs", "overlap_coverage",
-        "slo_p99", "obs_tax",
+        "slo_p99", "obs_tax", "fair_steady_p99", "fair_starvation",
     }
 
 
@@ -81,7 +81,10 @@ def test_missing_artifacts_report_as_missing_not_failure(tmp_path):
     without artifacts must not hard-fail the gate)."""
     block = sentinel.evaluate(committed_payload(), root=str(tmp_path))
     assert block["ok"]
-    assert set(block["missing"]) >= {"headline", "flagship", "obs_tax"}
+    assert set(block["missing"]) >= {
+        "headline", "flagship", "obs_tax",
+        "fair_steady_p99", "fair_starvation",
+    }
 
 
 def test_missing_payload_fields_report_as_missing():

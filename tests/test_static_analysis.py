@@ -93,10 +93,12 @@ def test_wal_rules_fire_on_seeded_violations():
     # autoscaler fixture (a resize action applying its handoff without
     # the acquiring owner's record, ISSUE 11) + one of each in the
     # pipeline-drain fixture (a staged commit group applied before —
-    # or without — its group's journal records, ISSUE 15).
-    assert got.count("wal-apply-before-journal") == 6
-    assert got.count("wal-unjournaled-apply") == 6
-    assert len(got) == 12, got  # the healthy shapes stay silent
+    # or without — its group's journal records, ISSUE 15) + one of each
+    # in the fairness-ledger fixture (a WFQ debit batch applied before
+    # — or without — its ``admission`` record, ISSUE 17).
+    assert got.count("wal-apply-before-journal") == 7
+    assert got.count("wal-unjournaled-apply") == 7
+    assert len(got) == 14, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
@@ -119,6 +121,13 @@ def test_wal_rules_cover_pipeline_drain():
     # pipelined drain (ISSUE 15) — the WAL family must follow them.
     paths = {f.path for f in lint("wal_bad").findings}
     assert "kubernetes_tpu/engine/pipeline.py" in paths
+
+
+def test_wal_rules_cover_the_fairness_ledger():
+    # The WFQ debit apply (apply_admission) became an apply marker in
+    # ISSUE 17 — the WAL family must reach framework/fairness.py.
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/framework/fairness.py" in paths
 
 
 def test_wal_negative_tree_is_clean():
@@ -148,15 +157,19 @@ def test_det_rules_fire_on_seeded_violations():
     # framework/measured.py + framework/trace_export.py (ISSUE 16) seed
     # a wallclock fold window, a wallclock trace epoch and a bare-set
     # row iteration — the derived-artifact byte-identity surfaces.
-    assert got.count("det-wallclock") == 8
-    assert got.count("det-random") == 5  # + gauss jitter in the weight loader
-    assert got.count("det-set-iteration") == 7  # for-loops + list(set(...))
+    # framework/fairness.py (ISSUE 17) seeds a wallclock credit refill,
+    # a random tie-break, a bare-set tenant scan and a salted-hash
+    # overflow bucket — the replayed-admission-order surface.
+    assert got.count("det-wallclock") == 9
+    assert got.count("det-random") == 6  # + gauss jitter in the weight loader
+    assert got.count("det-set-iteration") == 8  # for-loops + list(set(...))
     assert got.count("det-id-key") == 1
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
     # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14) + commit-group
-    # slotting (ISSUE 15): builtin hash() assigns different owners /
-    # slices / rows / groups per process.
-    assert got.count("det-builtin-hash") == 4
+    # slotting (ISSUE 15) + tenant overflow bucketing (ISSUE 17):
+    # builtin hash() assigns different owners / slices / rows / groups /
+    # buckets per process.
+    assert got.count("det-builtin-hash") == 5
 
 
 def test_det_rules_cover_loadgen():
@@ -190,6 +203,13 @@ def test_det_rules_cover_derived_artifacts():
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/framework/measured.py" in paths
     assert "kubernetes_tpu/framework/trace_export.py" in paths
+
+
+def test_det_rules_cover_the_admission_policy():
+    # The fairness policy's ledger arithmetic IS replayed decision
+    # state (ISSUE 17) — the explicit-rel list must reach it.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/framework/fairness.py" in paths
 
 
 def test_det_negative_tree_is_clean():

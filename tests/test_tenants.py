@@ -9,9 +9,15 @@ import json
 import re
 import tempfile
 import urllib.request
+import zlib
 
 from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.fairness import (
+    FairAdmission,
+    weights_from_matrix,
+)
 from kubernetes_tpu.framework.flight import FlightRecorder, merge_fleet
+from kubernetes_tpu.framework.measured import matrix_rows
 from kubernetes_tpu.framework.metrics import (
     TENANT_FALLBACK,
     TENANT_LABEL_KEY,
@@ -24,6 +30,8 @@ from kubernetes_tpu.framework.tracing import stitch_spans
 from kubernetes_tpu.framework.config import Profile
 from kubernetes_tpu.loadgen.workloads import WorkloadMix
 from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+from kubernetes_tpu.ops.throughput import DEFAULT_THROUGHPUT_MATRIX
+from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.scheduler import TPUScheduler
 from kubernetes_tpu.sidecar import SidecarClient, SidecarServer
 
@@ -409,3 +417,168 @@ def test_profile_report_renders_fleet_merge(tmp_path, capsys):
     pb.write_text(json.dumps(owner))
     assert profile_report.main(["--fleet", str(pa), str(pb)]) == 0
     assert "parallelism" in capsys.readouterr().out
+
+
+# -- weighted-fair admission (ISSUE 17) --------------------------------------
+
+
+def test_wfq_admission_order_is_deterministic_and_weighted():
+    def run():
+        pol = FairAdmission(weights={"a": 2.0, "b": 1.0, "c": 0.5})
+        q = SchedulingQueue(clock=lambda: 0.0, admission_policy=pol)
+        for t in ("a", "b", "c"):
+            for i in range(8):
+                q.add(tenant_pod(f"{t}-{i}", t))
+        order = []
+        while True:
+            batch = q.pop_batch(4)
+            if not batch:
+                break
+            order.extend(qp.pod.uid for qp in batch)
+        return order
+
+    o1, o2 = run(), run()
+    assert o1 == o2 and len(o1) == 24
+    # Accelerator-time WFQ, not round-robin: over the first 7 slots the
+    # 2 : 1 : 0.5 weights admit 4 a's, 2 b's and 1 c (virtual-finish
+    # tags advance by cost/weight; ties break on the sorted name).
+    head = o1[:7]
+    counts = {t: sum(1 for u in head if f"/{t}-" in u) for t in "abc"}
+    assert counts == {"a": 4, "b": 2, "c": 1}
+    # Within one tenant, QueueSort (arrival) order is untouched.
+    a_pops = [u for u in o1 if "/a-" in u]
+    assert a_pops == sorted(a_pops, key=lambda u: int(u.rsplit("-", 1)[1]))
+
+
+def test_weights_from_matrix_synthetic_and_measured():
+    classes = {"steady": "serve", "bursty": "train-large"}
+    w = weights_from_matrix(DEFAULT_THROUGHPUT_MATRIX, classes)
+    # train-large throughput is lower on the mean pool, so its
+    # accelerator-TIME share (the weight) is higher; shares normalize
+    # to mean 1.0 over the mapped tenants.
+    assert w["bursty"] > w["steady"]
+    assert abs((w["bursty"] + w["steady"]) / 2 - 1.0) < 1e-9
+    # Unmapped classes and an empty matrix fall back to uniform 1.0.
+    w2 = weights_from_matrix(
+        DEFAULT_THROUGHPUT_MATRIX, {**classes, "misc": "no-such-class"}
+    )
+    assert w2["misc"] == 1.0
+    assert weights_from_matrix((), classes) == {"bursty": 1.0, "steady": 1.0}
+    # The MEASURED artifact's row form is interchangeable with the
+    # synthetic committed matrix (framework/measured.matrix_rows).
+    doc = {
+        "version": 1,
+        "kind": "measured_throughput_matrix",
+        "matrix": {
+            "serve": {"tpu-v4": 540, "tpu-v5e": 1000},
+            "train-large": {"tpu-v4": 1000, "tpu-v5e": 520},
+        },
+    }
+    wm = weights_from_matrix(matrix_rows(doc), classes)
+    assert wm["bursty"] > wm["steady"]
+    # Hetero pools re-weight the mix: an all-v4 pool makes serve the
+    # expensive class (540 vs train-large's 1000 on v4).
+    wp = weights_from_matrix(matrix_rows(doc), classes, pools={"tpu-v4": 4})
+    assert wp["steady"] > wp["bursty"]
+
+
+def test_rate_cap_credit_exhaustion_and_refill_on_logical_clock():
+    pol = FairAdmission(
+        weights={},
+        rate_pods_per_s=1.0,
+        burst=2.0,
+        aging_max_wait_s=100.0,
+        slo_wait_budget_s=100.0,
+    )
+    q = SchedulingQueue(clock=lambda: 0.0, admission_policy=pol)
+    for i in range(5):
+        q.add(tenant_pod(f"p-{i}", "team-a"))
+    # Burst credits admit 2, then the tenant is credit-blocked — the
+    # queue reports THROTTLED (not drained) so pollers stop spinning.
+    assert [qp.pod.name for qp in q.pop_batch(10)] == ["p-0", "p-1"]
+    assert q.last_pop_throttled
+    assert pol.status()["throttle_hits"] >= 1
+    # One LOGICAL second refills one credit; no wall clock anywhere.
+    pol.note_time(1.0)
+    assert [qp.pod.name for qp in q.pop_batch(10)] == ["p-2"]
+    # Refill is min-clamped at the burst ceiling: a long idle gap buys
+    # at most `burst` credits, not rate x gap.
+    pol.note_time(100.0)
+    assert [qp.pod.name for qp in q.pop_batch(10)] == ["p-3", "p-4"]
+    assert pol.status()["tenants"]["team-a"]["credits"] == 0.0
+    assert not q.last_pop_throttled  # drained, not blocked
+
+
+def test_aging_escape_admits_a_starved_head_and_counts_the_violation():
+    pol = FairAdmission(
+        weights={},
+        rate_pods_per_s=0.01,
+        burst=1.0,
+        aging_max_wait_s=5.0,
+        slo_wait_budget_s=4.0,
+    )
+    q = SchedulingQueue(clock=lambda: 0.0, admission_policy=pol)
+    q.add(tenant_pod("p-0", "team-a"))
+    q.add(tenant_pod("p-1", "team-a"))
+    assert [qp.pod.name for qp in q.pop_batch(10)] == ["p-0"]
+    assert q.last_pop_throttled
+    # Past the aging bound the escape admits the head DESPITE an empty
+    # bucket; the wait also blew the (tighter) starvation budget, so the
+    # violation counters the soak/kill gates read both tick.
+    pol.note_time(6.0)
+    assert [qp.pod.name for qp in q.pop_batch(10)] == ["p-1"]
+    st = pol.status()
+    assert st["aging_escapes"] == 1
+    assert st["starvation_violations"] == 1
+    assert st["tenants"]["team-a"]["starved"] == 1
+
+
+def test_hashed_tail_tier_bounds_labels_and_is_shared_per_registry():
+    lab = TenantLabeler(limit=4, hash_buckets=8)
+    labels = {lab.label_for(f"team-{i:03d}") for i in range(100)}
+    hashed = {l for l in labels if l.startswith("~")}
+    assert len(labels - hashed) == 4
+    assert 0 < len(hashed) <= 8
+    assert len(labels) <= 4 + 8 + 1
+    # crc32 bucketing — stable across processes and runs, unlike the
+    # salted builtin hash().
+    assert lab.label_for("team-099") == "~{:02d}".format(
+        zlib.crc32(b"team-099") % 8
+    )
+    # ONE labeler per registry: a second TenantMetrics on the same
+    # registry shares the exact-tier table instead of forking its own
+    # top-K — the fleet registry carries the driver's, the router's and
+    # the admission policy's tenant= writers at once, and the bound
+    # holds over their union.
+    reg = MetricsRegistry()
+    tm1 = TenantMetrics(reg, limit=2, hash_buckets=4)
+    tm2 = TenantMetrics(reg)
+    assert tm2.labeler is tm1.labeler
+    tm1.note("admitted", "a")
+    tm1.note("admitted", "b")
+    tm2.note("admitted", "c")
+    assert tm2.labeler.label_for("c").startswith("~")
+
+
+def test_fleet_admission_is_bit_identical_across_runs():
+    def run():
+        router, _owners, _smap = build_fleet(2)
+        pol = FairAdmission(weights={"team-a": 2.0, "team-b": 1.0})
+        router.arm_admission(pol)
+        tenant_of_uid = {}
+        for i in range(5):
+            for t in ("team-a", "team-b"):
+                p = tenant_pod(f"{t[-1]}{i}", t, cpu="200m")
+                tenant_of_uid[p.uid] = t
+                router.add_pod(p)
+        out = router.schedule_all_pending(wait_backoff=True)
+        binds = sorted((o.pod.uid, o.node_name) for o in out)
+        return binds, list(pol.admitted_log), tenant_of_uid
+
+    (b1, log1, tmap), (b2, log2, _) = run(), run()
+    assert b1 == b2
+    assert log1 == log2 and len(log1) == 10
+    # The armed order interleaves by WEIGHT, not arrival: 2:1 admits
+    # 4 team-a in the first 6 slots.
+    head = [tmap[u] for u in log1[:6]]
+    assert head.count("team-a") == 4 and head.count("team-b") == 2
