@@ -268,7 +268,19 @@ def cmd_serve(args) -> int:
             "gc_horizon_s": getattr(args, "gc_horizon_s", 0.0),
         }
     fleet_owner = None
-    if args.shard_of:
+    if getattr(args, "standby", False):
+        # Warm-standby child (ISSUE 18): boot + compile NOW, own nothing.
+        # The scheduler is warmed by the spawner over the ordinary wire
+        # surface; fleet frames park at the StandbyServe shim until an
+        # ``adopt_shard`` promotion builds the real ShardOwner (lease
+        # claim + journal recovery) around the already-warm scheduler.
+        if args.shard_of:
+            raise SystemExit("--standby and --shard-of are exclusive: a "
+                             "standby owns nothing until promoted")
+        from .fleet.standby import StandbyServe
+
+        fleet_owner = StandbyServe(sched)
+    elif args.shard_of:
         if not args.journal_dir:
             # The serve journal doubles as the shard's WAL; an owner
             # without one would silently no-op every gang_reserve/bind/
@@ -321,8 +333,11 @@ def cmd_serve(args) -> int:
     if journal is not None:
         health["journalDir"] = args.journal_dir
     if fleet_owner is not None:
-        health["shard"] = fleet_owner.shard_id
-        health["shardMap"] = args.shard_map
+        if getattr(args, "standby", False):
+            health["standby"] = True
+        else:
+            health["shard"] = fleet_owner.shard_id
+            health["shardMap"] = args.shard_map
     srv = SidecarServer(
         args.socket,
         scheduler=sched,
@@ -608,6 +623,18 @@ def cmd_fleet(args) -> int:
                     doc["autoscaler"] = json.load(f)
             except (OSError, ValueError) as exc:
                 doc["autoscaler"] = {"unreadable": str(exc)}
+        standby_path = f"{args.map}.standby.json"
+        if os.path.exists(standby_path):
+            # The warm-standby pool's status mirror (ISSUE 18,
+            # fleet/standby.py _write_mirror): pool size vs target,
+            # per-slot warm age + schema version, promotion and
+            # stale-eviction totals — the same atomic-mirror pattern as
+            # the autoscaler block above.
+            try:
+                with open(standby_path) as f:
+                    doc["standby"] = json.load(f)
+            except (OSError, ValueError) as exc:
+                doc["standby"] = {"unreadable": str(exc)}
         print(json.dumps(doc, indent=1, sort_keys=True))
         return 0
     if args.action == "autoscale":
@@ -1005,6 +1032,15 @@ def main(argv: list[str] | None = None) -> int:
         help="join the partitioned fleet as shard K of N: only shard-map-"
         "owned nodes are absorbed, and the `fleet` frame (propose/commit/"
         "reserve/handoff ops) is served (kubernetes_tpu/fleet)",
+    )
+    s.add_argument(
+        "--standby", action="store_true",
+        help="boot as a warm-standby fleet child (ISSUE 18): compile the "
+        "engine against the live featurization schema and park — no "
+        "shard, no journal, lease unclaimed — until a router promotes it "
+        "via the `fleet` frame's adopt_shard op (fleet/standby.py); "
+        "promotion is a journaled handoff + lease claim instead of a "
+        "~15s cold boot; mutually exclusive with --shard-of",
     )
     s.add_argument(
         "--no-observability", action="store_true",

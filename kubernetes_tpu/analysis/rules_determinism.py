@@ -87,6 +87,12 @@ class DeterminismRule(Rule):
             # recovered admission order from the interrupted run's.
             "kubernetes_tpu/framework/fairness.py",
         ]
+        # The recursive walk below picks up fleet/standby.py and
+        # loadgen/checkpoint.py (ISSUE 18) — the warm-standby pool's
+        # slot selection and the checkpoint writer's state digest are
+        # replayed decision state, so wall clocks / entropy / salted
+        # hashing there would diverge a resumed run from its
+        # uninterrupted twin.
         for sub in ("ops", "engine", "loadgen", "fleet"):
             top = os.path.join(root, "kubernetes_tpu", sub)
             # Recursive: a future subpackage under ops/ or engine/ must not

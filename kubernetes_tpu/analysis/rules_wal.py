@@ -57,6 +57,15 @@ APPLY_MARKERS = {
     # journal never heard of — recovery would re-select them in a
     # different order.
     "apply_admission",
+    # ISSUE 18: the warm-standby pool's promotion apply
+    # (fleet/standby.py) — a slot made "promoted" without its pool WAL
+    # record first could be offered twice after a crash (two owners
+    # handed the same warm child) — and the soak checkpoint writer's
+    # os.replace apply (loadgen/checkpoint.py) — a generation made live
+    # without its journaled digest first leaves resume nothing to
+    # verify bit-identity against.
+    "finish_promotion",
+    "finish_checkpoint",
 }
 
 
@@ -111,6 +120,14 @@ class WalRule(Rule):
             # first by the commit drain; the replay path is journal-
             # driven by construction.
             "kubernetes_tpu/framework/fairness.py",
+            # Warm-standby promotion (ISSUE 18): finish_promotion must
+            # follow the pool's own WAL append, or a crashed promotion
+            # could offer the same warm child to two owners.
+            "kubernetes_tpu/fleet/standby.py",
+            # The soak checkpoint writer (ISSUE 18): finish_checkpoint
+            # (the os.replace apply) must follow the generation-journal
+            # append carrying the digest resume verifies against.
+            "kubernetes_tpu/loadgen/checkpoint.py",
         ]
 
     def run(self, ctxs, root) -> list[Finding]:

@@ -213,6 +213,24 @@ KILL_POINTS = (
     "mid-group-fsync",
     "post-group-fsync",
     "torn-group-tail",
+    # Warm-standby promotion windows (ISSUE 18, fleet/standby.py): the
+    # pool picked a warm child but has not claimed it (standby-pre-claim
+    # — the claim file does not exist; a restarted promoter re-picks),
+    # the claim and the pool's journal record landed but the apply has
+    # not run (standby-mid-promotion — replay finishes the promotion
+    # bookkeeping; the fleet-side map/handoff truth is the takeover
+    # machinery's as usual), and the promotion applied but the caller
+    # died before using the owner (standby-post-promote — the slot is
+    # consumed either way; the map write it feeds is covered by
+    # pre-map-write).
+    "standby-pre-claim",
+    "standby-mid-promotion",
+    "standby-post-promote",
+    # Soak-driver checkpoint window (ISSUE 18, loadgen/checkpoint.py):
+    # the new checkpoint is fully written and fsync'd under a temp name
+    # but os.replace has not run — resume must come up on the PREVIOUS
+    # complete checkpoint, never a torn half.
+    "mid-checkpoint",
 )
 
 

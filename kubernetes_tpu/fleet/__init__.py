@@ -44,6 +44,7 @@ from .owner import (  # noqa: F401
     fleet_dispatch,
 )
 from .takeover import absorb_shard, recover_shard  # noqa: F401
+from .standby import StandbyPool, StandbyServe  # noqa: F401
 from .autoscaler import (  # noqa: F401
     AutoscalerConfig,
     FleetAutoscaler,

@@ -630,6 +630,13 @@ def fleet_dispatch(owner: ShardOwner, op: str, payload: dict) -> dict:
     dump shows the complete router→owner→sidecar path) — and ``lc``, the
     router's logical clock, stamped onto the owner's flight records so
     merge_fleet interleaves per-owner logs deterministically."""
+    # A `serve --standby` child parks a StandbyServe shim here until
+    # adopted (ISSUE 18): it answers standby_status/adopt_shard itself
+    # and, once the real ShardOwner exists, delegates every op straight
+    # back through this dispatcher.
+    hook = getattr(owner, "standby_dispatch", None)
+    if hook is not None:
+        return hook(op, dict(payload))
     payload = dict(payload)
     trace_id = payload.pop("trace_id", None)
     parent_span_id = payload.pop("parent_span_id", None)

@@ -338,6 +338,41 @@ class TenantMetrics:
         return out
 
 
+class StandbyMetrics:
+    """The warm-standby pool's observability block (ISSUE 18) — the ONE
+    construction site for the ``scheduler_fleet_standby_*`` families
+    (metrics hygiene), held by fleet/standby.py's StandbyPool."""
+
+    def __init__(self, registry: "MetricsRegistry"):
+        self.pool_size = registry.gauge(
+            "scheduler_fleet_standby_pool_size",
+            "Warm standby children currently idle in the pool "
+            "(claimed/promoted slots excluded).",
+        )
+        self.promotions = registry.counter(
+            "scheduler_fleet_standby_promotions_total",
+            "Standby promotions served, by reason "
+            "(autoscale-split/revive/takeover).",
+        )
+        self.warm_age = registry.gauge(
+            "scheduler_fleet_standby_warm_age_seconds",
+            "Monotonic age of each idle standby since its warmup "
+            "finished, by slot.",
+        )
+        self.stale_evictions = registry.counter(
+            "scheduler_fleet_standby_schema_stale_evictions_total",
+            "Standbys retired (and respawned) because their compiled "
+            "featurization schema no longer matched the live vocab — "
+            "never promoted.",
+        )
+        self.promotion_seconds = registry.histogram(
+            "scheduler_fleet_standby_promotion_seconds",
+            "Wall seconds from promotion request to a serving owner "
+            "(the O(handoff) cost a cold boot would have paid ~15s for), "
+            "by reason.",
+        )
+
+
 # Extension points the batch engine times (the batch analogs of the
 # reference's per-point spans).
 EXTENSION_POINTS = (

@@ -1,0 +1,124 @@
+"""Resumable soak driver: atomic checkpoints of the driver's full
+deterministic state (ISSUE 18).
+
+Hour-scale runs die with the harness — a SIGKILLed soak driver used to
+mean starting the hour over.  The driver is (lint-enforced) a pure
+function of (config, seed, logical clock), so its state is exactly
+checkpointable: arrival cursors, scenario op index, RNG generator
+states, SLO/latency accumulators, per-tenant ledgers, logical clock.
+This module is the soak-driver twin of the scheduler's own WAL
+discipline:
+
+- ``CheckpointWriter.write(state)`` — serialize to a temp file, fsync,
+  append the generation record (digest) to the writer's own journal,
+  then ``finish_checkpoint``: os.replace + directory fsync (the
+  shardmap discipline).  The ``mid-checkpoint`` crash point sits between
+  the journal append and the apply — a SIGKILL there leaves the
+  PREVIOUS complete checkpoint live, never a torn half.
+- ``load_checkpoint(path)`` — reads the live file and verifies the
+  embedded digest over the state block, so a corrupt file is a loud
+  error, not a silently divergent resume.
+
+`run_soak.py --resume` then replays the op prefix `[0, op_index)` in
+virtual pace (deterministic regeneration — sleeps skipped), asserts the
+regenerated driver digest matches the checkpoint's, and continues the
+remaining ops at the configured pace: the final artifact is
+bit-identical to an uninterrupted same-seed run
+(tests/test_soak.py; run_fault_matrix.py --standby-kill's ckpt cells)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .. import journal as _journal
+
+
+def state_digest(state: dict) -> str:
+    """Canonical digest of a checkpoint state block (sort_keys JSON →
+    sha256) — the bit-identity witness resume verifies against."""
+    blob = json.dumps(state, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class CheckpointWriter:
+    """Atomic generation-journaled checkpoint writer for one soak run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._dir = d
+        self.generation = 0
+        self._jf = open(f"{path}.journal", "a", encoding="utf-8")
+        self.journal = self  # receiver alias: self.journal.append(...)
+
+    def append(self, rec: dict) -> None:
+        """Fsync'd JSONL append to the generation journal — the WAL half
+        that precedes every ``finish_checkpoint`` apply."""
+        self._jf.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._jf.flush()
+        os.fsync(self._jf.fileno())
+
+    def write(self, state: dict) -> str:
+        """Write one checkpoint generation; returns its digest."""
+        self.generation += 1
+        digest = state_digest(state)
+        doc = {
+            "generation": self.generation,
+            "digest": digest,
+            "state": state,
+        }
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.journal.append(
+            {"op": "checkpoint", "generation": self.generation,
+             "digest": digest, "op_index": state.get("op_index")}
+        )
+        _journal._crash("mid-checkpoint")
+        self.finish_checkpoint(tmp)
+        return digest
+
+    def finish_checkpoint(self, tmp: str) -> None:
+        """The apply half (WAL marker — journaled first by ``write``):
+        the new generation becomes the live checkpoint atomically."""
+        os.replace(tmp, self.path)
+        dfd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def close(self) -> None:
+        try:
+            self._jf.close()
+        except OSError:
+            pass
+
+
+def load_checkpoint(path: str) -> dict | None:
+    """The live checkpoint's verified document, or None when absent.
+    A present-but-corrupt file (torn write would need a torn os.replace,
+    i.e. a broken filesystem; digest mismatch means tampering or a
+    divergent writer) raises ValueError — resuming from it would
+    silently break the bit-identity promise."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError:
+        return None
+    except ValueError as exc:
+        raise ValueError(f"corrupt checkpoint {path}: {exc}") from exc
+    got = state_digest(doc.get("state", {}))
+    want = doc.get("digest")
+    if got != want:
+        raise ValueError(
+            f"checkpoint {path} digest mismatch: state hashes to "
+            f"{got[:12]}… but records {str(want)[:12]}…"
+        )
+    return doc

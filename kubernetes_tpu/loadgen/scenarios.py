@@ -187,6 +187,16 @@ def cold_consumer_events(period_s: float, duration_s: float) -> list[Event]:
     return out
 
 
+def one_shot_events(spec) -> list[Event]:
+    """Scripted one-shot events from a ``((t, kind, data), ...)`` spec —
+    the production-day composition's hand-placed incidents (a cold
+    router restart at a known second, a node death during the diurnal
+    crest) merged into the generated stream by the fleet soak.  The spec
+    is part of the config, so the merged schedule stays a pure function
+    of (config, seed)."""
+    return [Event(t=float(t), kind=str(k), data=int(d)) for t, k, d in spec]
+
+
 def build_events(
     duration_s: float,
     seed: int,

@@ -48,6 +48,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import zlib
 from collections import deque
 from dataclasses import asdict, dataclass, field
 
@@ -72,7 +73,8 @@ from .arrivals import (
     diurnal_offsets,
     poisson_offsets,
 )
-from .scenarios import DEFAULT_INV_MIX, build_events
+from .checkpoint import CheckpointWriter, load_checkpoint, state_digest
+from .scenarios import DEFAULT_INV_MIX, build_events, one_shot_events
 from .workloads import WorkloadMix
 
 
@@ -208,6 +210,37 @@ class SoakConfig:
     # schedulerName (WorkloadMix.scheduler_name).  Pair with
     # mix="hetero" + hetero_pools for the heterogeneous soak.
     profile: str = ""
+    # -- warm-standby owner pool (ISSUE 18; fleet soak only) ------------
+    # > 0 arms fleet/standby.py: that many pre-forked, pre-warmed serve
+    # children (XLA compiled against the live featurization schema,
+    # journal dir pre-created, lease unclaimed) kept behind the
+    # autoscaler's owner_provider and revive_owner's takeover path —
+    # promotion is a journaled handoff + lease claim (O(handoff)), not a
+    # ~15s cold boot.  0 ⇒ unarmed: both paths cold-spawn exactly as
+    # before, byte-identical to the pre-ISSUE-18 soak.
+    standby_pool: int = 0
+    standby_dir: str = ""  # pool WAL + mirror dir; empty → tmp/standby
+    # -- resumable driver (ISSUE 18) ------------------------------------
+    # Non-empty arms loadgen/checkpoint.py: every checkpoint_every_ops
+    # executed ops the driver atomically checkpoints its FULL
+    # deterministic state (op cursor, logical clock, RNG generator
+    # states, SLO/latency accumulators, per-tenant ledgers) plus the
+    # wall-derived observability accumulators.  resume=True replays the
+    # checkpointed op prefix in virtual pace against fresh journal dirs,
+    # verifies the regenerated state digest, restores the observability
+    # accumulators, and continues — bit-identical to an uninterrupted
+    # same-seed run.
+    checkpoint_path: str = ""
+    checkpoint_every_ops: int = 0
+    resume: bool = False
+    # Test hook (run_fault_matrix.py --standby-kill; tests/test_soak.py):
+    # SIGKILL the driver process immediately after executing op N
+    # (post-checkpoint-write when N lands on a boundary).  0 = disarmed.
+    kill_after_op: int = 0
+    # Extra scripted one-shot scenario events merged into the generated
+    # stream: ((t, kind, data), ...) — the production-day composition
+    # uses this for the scripted cold router restart and node deaths.
+    scripted_events: tuple = ()
 
 
 def _accel_label(cfg: SoakConfig, w, i: int):
@@ -1249,6 +1282,185 @@ def _spawn_shard_serve(
     )
 
 
+def _spawn_standby_serve(cfg: SoakConfig, sock: str, out_dir: str, slot: int):
+    """One warm-standby fleet child: ``serve --standby`` — engine booted
+    and compiled, no shard, no journal, lease unclaimed — parked until a
+    promotion's adopt_shard frame (fleet/standby.py).  Lifecycle knobs
+    ride the adopt payload, not the argv: a slot is shard-agnostic."""
+    argv = [
+        sys.executable, "-m", "kubernetes_tpu", "serve",
+        "--socket", sock,
+        "--standby",
+        "--batch-size", str(cfg.batch_size),
+        "--chunk-size", "1",
+    ] + ([] if cfg.observability else ["--no-observability"]) \
+      + (["--profile", cfg.profile] if cfg.profile else [])
+    return _launch_serve(
+        argv, out_dir, sock, f"standby{slot}", deadline_s=300.0
+    )
+
+
+def _standby_warm_objs(
+    cfg: SoakConfig, warm_tenants, hot: bool, armed: bool, epoch_hi: int = 4
+):
+    """The standby warm wave (ISSUE 18): every label-schema axis the
+    live stream can reach — zones, accelerator classes, epoch labels,
+    the hot selector, lifecycle taints, tenant/template label combos —
+    built as objects a parked child exercises BEFORE promotion, so
+    adoption never pays an XLA recompile mid-incident.  Mirrors
+    run_fleet_soak's own warmup (same WorkloadMix template space,
+    disjoint index range + ``sbwarm-`` node names: everything here is
+    removed again after compiling, leaving only the grown vocab)."""
+    nodes = []
+    for i in range(max(cfg.zones, 12)):
+        w = (
+            make_node(f"sbwarm-{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone(f"zone-{i % max(cfg.zones, 1)}")
+            .region("region-1")
+        )
+        w = _accel_label(cfg, w, i)
+        if hot:
+            w = w.label("loadgen.tpu/hot", "1")
+        nodes.append(w.obj())
+    epoch_nodes = []
+    for epoch in range(1, epoch_hi + 1):
+        w = (
+            make_node("sbwarm-0")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .zone("zone-0")
+            .region("region-1")
+            .label("loadgen.tpu/epoch", str(epoch))
+        )
+        if hot:
+            w = w.label("loadgen.tpu/hot", "1")
+        epoch_nodes.append(_accel_label(cfg, w, 0).obj())
+    tainted = []
+    if armed:
+        import dataclasses
+
+        from ..controllers import (
+            NODE_NOT_READY,
+            NODE_UNREACHABLE,
+            lifecycle_taints,
+        )
+
+        probe = nodes[0]
+        tainted.append(
+            dataclasses.replace(
+                probe,
+                spec=dataclasses.replace(
+                    probe.spec,
+                    taints=lifecycle_taints(NODE_NOT_READY)
+                    + lifecycle_taints(NODE_UNREACHABLE),
+                ),
+            )
+        )
+    warm_mix = WorkloadMix(
+        cfg.mix,
+        seed=cfg.seed * 104_729 + 31,
+        scheduler_name=profile_scheduler_name(cfg.profile),
+    )
+    n_warm = min(cfg.warm_pods, 48)
+    pods = [
+        warm_mix.pod(
+            30_000_000 + i,
+            # Block-assigned tenants — the same combo-coverage argument
+            # as the fleet warmup's own wave.
+            tenant=(
+                warm_tenants[
+                    min(
+                        (i * len(warm_tenants)) // max(n_warm, 1),
+                        len(warm_tenants) - 1,
+                    )
+                ]
+                if warm_tenants
+                else None
+            ),
+        )
+        for i in range(n_warm)
+    ]
+    if hot:
+        for j, p in enumerate(pods):
+            if j % 2 == 0:
+                p.spec.node_selector["loadgen.tpu/hot"] = "1"
+    preemptor = (
+        make_pod("sbwarm-preemptor").req({"cpu": "12"}).priority(100).obj()
+    )
+    probe_pod = warm_mix.pod(
+        30_900_000, tenant=warm_tenants[0] if warm_tenants else None
+    )
+    return nodes, epoch_nodes, tainted, pods, preemptor, probe_pod
+
+
+def _warm_standby_sched(
+    cfg: SoakConfig, sched, warm_tenants, hot: bool, armed: bool,
+    epoch_hi: int = 4,
+) -> None:
+    """Warm an IN-PROCESS standby scheduler: add every schema-growing
+    node variant, bind + delete a combo-covering pod wave, dry-run the
+    preemptor, remove the warm nodes, and absorb the dirty-row flush
+    with one eval-only probe — the promoted owner's journal recovery
+    then replays real objects into an already-compiled engine."""
+    nodes, epoch_nodes, tainted, pods, preemptor, probe = _standby_warm_objs(
+        cfg, warm_tenants, hot, armed, epoch_hi
+    )
+    for n in nodes:
+        sched.add_node(n)
+    for n in epoch_nodes:
+        sched.add_node(n)
+    sched.add_node(nodes[0])  # restore sbwarm-0's epoch-free shape
+    for n in tainted:
+        sched.add_node(n)
+    if tainted:
+        sched.add_node(nodes[0])
+    for p in pods:
+        sched.update_pod(p)
+    sched.schedule_all_pending()
+    sched.preempt_propose(preemptor)
+    for p in pods:
+        sched.delete_pod(p.uid)
+    for n in nodes:
+        sched.remove_node(n.metadata.name)
+    sched.propose_pod(probe)
+
+
+def _warm_standby_wire(
+    cfg: SoakConfig, sock: str, warm_tenants, hot: bool, armed: bool,
+    epoch_hi: int = 4,
+) -> None:
+    """The two-process twin of ``_warm_standby_sched``: drive the same
+    warm wave into a parked `serve --standby` child over its socket
+    (the preempt dry-run rides the fleet frame, which StandbyServe
+    allows pre-adoption for exactly this)."""
+    from ..api import serialize
+
+    nodes, epoch_nodes, tainted, pods, preemptor, probe = _standby_warm_objs(
+        cfg, warm_tenants, hot, armed, epoch_hi
+    )
+    client = SidecarClient(sock, deadline_s=300.0)
+    try:
+        for n in nodes:
+            client.add("Node", n)
+        for n in epoch_nodes:
+            client.add("Node", n)
+        client.add("Node", nodes[0])
+        for n in tainted:
+            client.add("Node", n)
+        if tainted:
+            client.add("Node", nodes[0])
+        client.schedule(pods, drain=True)
+        client.fleet("preempt_propose", {"pod": serialize.to_dict(preemptor)})
+        for p in pods:
+            client.remove("Pod", p.uid)
+        for n in nodes:
+            client.remove("Node", n.metadata.name)
+        client.schedule([probe], drain=True)
+        client.remove("Pod", probe.uid)
+    finally:
+        client.close()
+
+
 def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     """Soak the PARTITIONED fleet (kubernetes_tpu/fleet): open-loop
     arrivals scatter-gathered by the router over ``shards`` journaled
@@ -1294,10 +1506,29 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     )
     from ..scheduler import TPUScheduler
 
+    ckpt_prior = None
+    resume_from = 0
+    if cfg.resume:
+        if not cfg.checkpoint_path:
+            raise ValueError("SoakConfig.resume requires checkpoint_path")
+        ckpt_prior = load_checkpoint(cfg.checkpoint_path)
+        if ckpt_prior is None:
+            raise RuntimeError(
+                f"resume requested but no checkpoint at {cfg.checkpoint_path}"
+            )
+        resume_from = int(ckpt_prior["state"]["det"]["op_index"])
     tmp = tempfile.TemporaryDirectory(prefix="tpu-fleet-soak-")
     out_dir = cfg.out_dir or tmp.name
     os.makedirs(out_dir, exist_ok=True)
     journal_root = cfg.journal_dir or os.path.join(tmp.name, "journal")
+    if cfg.resume:
+        # Replay regenerates every owner journal from op 0 — recovering a
+        # prior run's journals UNDERNEATH the replay would double-apply
+        # its state, so a resumed run always writes fresh shard journals,
+        # keyed by the checkpoint generation it resumed from.
+        journal_root = os.path.join(
+            journal_root, f"resume-g{int(ckpt_prior['generation'])}"
+        )
     armed = cfg.node_grace_s > 0
     lifecycle = (
         {
@@ -1365,6 +1596,8 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
     # the warmup or the op loop (a protocol desync, an assertion, a
     # KeyboardInterrupt) must not leak N serve processes holding
     # journal leases and sockets.
+    standby = None
+    ckpt = None
     try:
         mix = WorkloadMix(
             cfg.mix,
@@ -1638,6 +1871,139 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             # fairness ledger.
             router.arm_admission(mk_admission_policy())
 
+        # -- warm-standby owner pool (ISSUE 18) ------------------------
+        # Built AFTER warmup so the slots compile against the same live
+        # schema the fleet just finished growing.  The schema version is
+        # a crc32 over every axis the warm wave covers — when the live
+        # vocab outgrows it mid-run (an epoch label past the warm range),
+        # stale slots are retired + respawned against the wider range,
+        # never promoted.
+        standby_promotions: list[dict] = []
+        standby_cold = 0
+        warm_epoch_hi = [4]
+
+        def _live_schema() -> int:
+            return zlib.crc32(
+                json.dumps(
+                    [
+                        sorted(warm_tenants),
+                        sorted(str(a) for a, _w in cfg.hetero_pools),
+                        cfg.profile,
+                        bool(hot_serving),
+                        cfg.admission is not None,
+                        armed,
+                        warm_epoch_hi[0],
+                    ],
+                    sort_keys=True,
+                ).encode("utf-8")
+            )
+
+        if cfg.standby_pool > 0:
+            from ..fleet.standby import StandbyPool
+
+            def _standby_factory(slot_id: int):
+                if not cfg.two_process:
+                    sb_sched = TPUScheduler(
+                        batch_size=cfg.batch_size,
+                        chunk_size=1,
+                        tenant_attribution=cfg.observability,
+                        profiles=named_extra_profiles(cfg.profile),
+                    )
+                    _warm_standby_sched(
+                        cfg, sb_sched, warm_tenants, bool(hot_serving),
+                        armed, warm_epoch_hi[0],
+                    )
+                    return {"sched": sb_sched}
+                sb_sock = os.path.join(tmp.name, f"standby{slot_id}.sock")
+                sb_proc = _spawn_standby_serve(cfg, sb_sock, out_dir, slot_id)
+                _warm_standby_wire(
+                    cfg, sb_sock, warm_tenants, bool(hot_serving), armed,
+                    warm_epoch_hi[0],
+                )
+                return {"sock": sb_sock, "proc": sb_proc}
+
+            def _standby_retire(payload) -> None:
+                sb_proc = payload.get("proc")
+                if sb_proc is not None and sb_proc.poll() is None:
+                    sb_proc.send_signal(signal.SIGTERM)
+                sb_sock = payload.get("sock")
+                if sb_sock and os.path.exists(sb_sock):
+                    os.unlink(sb_sock)
+
+            standby = StandbyPool(
+                cfg.standby_dir or os.path.join(tmp.name, "standby"),
+                _standby_factory,
+                size=cfg.standby_pool,
+                schema_version=_live_schema(),
+                registry=registry,
+                retire=_standby_retire,
+                mirror_path=(
+                    f"{map_path}.standby.json" if cfg.two_process else None
+                ),
+            )
+
+        def promote_owner(k: int, reason: str):
+            """Draw a warm child from the standby pool for shard ``k``
+            (autoscale split or takeover revive): journaled claim +
+            adopt_shard handoff + lease claim — O(handoff), not a cold
+            boot.  A pool miss falls back to the cold spawn path the
+            fleet always had (counted, never hidden)."""
+            nonlocal standby_cold
+            t0p = time.perf_counter()
+            payload = standby.promote(k, reason)
+            if payload is None:
+                standby_cold += 1
+                o = spawn_owner(k)
+                standby_promotions.append(
+                    {
+                        "shard": k, "reason": reason, "from_pool": False,
+                        "latency_s": round(time.perf_counter() - t0p, 4),
+                        "t": round(router.lc() if router else -1.0, 3),
+                    }
+                )
+                return o
+            sdir = os.path.join(journal_root, f"shard{k}")
+            if not cfg.two_process:
+                o = ShardOwner(
+                    k,
+                    payload["sched"],
+                    smap,
+                    state_dir=sdir,
+                    journal_fsync=cfg.journal_fsync == "always",
+                    snapshot_every_batches=cfg.snapshot_every,
+                    lifecycle=lifecycle,
+                    observability=cfg.observability,
+                )
+            else:
+                socks[k] = payload["sock"]
+                procs[k] = payload["proc"]
+                o = WireShardOwner(
+                    path=socks[k],
+                    deadline_s=120.0,
+                    max_retries=2,
+                    registry=registry,
+                    shard_id=k,
+                )
+                o.call(
+                    "adopt_shard",
+                    {
+                        "shard_id": k,
+                        "map_path": map_path,
+                        "journal_dir": sdir,
+                        "journal_fsync": cfg.journal_fsync == "always",
+                        "snapshot_every": cfg.snapshot_every,
+                        "lifecycle": lifecycle,
+                    },
+                )
+            standby_promotions.append(
+                {
+                    "shard": k, "reason": reason, "from_pool": True,
+                    "latency_s": round(time.perf_counter() - t0p, 4),
+                    "t": round(router.lc() if router else -1.0, 3),
+                }
+            )
+            return o
+
         cap_toggle: dict[int, int] = {}
         label_epoch: dict[int, int] = {}
         live: deque[str] = deque()
@@ -1673,8 +2039,14 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             build uses (a real `serve --shard-of k/N` child in the
             multi-process fleet — the map file may predate the split;
             the router's set_map push closes that before the import),
-            plus fresh sampling slots."""
-            o = spawn_owner(k)
+            plus fresh sampling slots.  With the standby pool armed the
+            owner comes pre-warmed from the pool instead (ISSUE 18) —
+            the split's new shard skips the child's cold boot."""
+            o = (
+                promote_owner(k, "autoscale-split")
+                if standby is not None
+                else spawn_owner(k)
+            )
             owners[k] = o
             wal_prev.setdefault(k, 0)
             wal_samples.setdefault(k, [])
@@ -1819,9 +2191,18 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 owners[k].close()
             except OSError:
                 pass
-            if os.path.exists(socks[k]):
+            if socks.get(k) and os.path.exists(socks[k]):
                 os.unlink(socks[k])
-            owners[k] = spawn_owner(k)
+            # With the standby pool armed, the replacement comes WARM
+            # (ISSUE 18): promotion = journaled handoff + lease claim
+            # over the dead owner's journal dir, and the recovery replay
+            # lands in an already-compiled engine — the ~15s boot the
+            # takeover used to pay mid-incident disappears.
+            owners[k] = (
+                promote_owner(k, "revive")
+                if standby is not None
+                else spawn_owner(k)
+            )
             owner_takeovers += 1
             router = rebuild_router()
 
@@ -1836,6 +2217,14 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 i = ev.data % cfg.nodes
                 label_epoch[i] = label_epoch.get(i, 0) + 1
                 feed_node(router, serving_node(i))
+                if standby is not None and label_epoch[i] > warm_epoch_hi[0]:
+                    # The epoch label grew past the warm range: the live
+                    # featurization schema is now ahead of the pool's
+                    # compiled programs.  Stale slots retire + respawn
+                    # against the widened range — NEVER promote — so a
+                    # later promotion still lands in a current engine.
+                    warm_epoch_hi[0] = label_epoch[i]
+                    standby.sync_schema(_live_schema())
             elif ev.kind == "node_death":
                 # The Node object STAYS; its heartbeat goes silent.  The
                 # OWNING shard's lifecycle controller must detect the
@@ -1891,6 +2280,20 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 if autoscaler is not None:
                     for act in autoscaler.tick(ev.t):
                         autoscale_actions.append(dict(act, t=ev.t))
+            elif ev.kind == "owner_kill":
+                # Scripted owner SIGKILL (the production-day incident
+                # schedule): a serve child dies mid-stream.  Two-process,
+                # the NEXT op that touches its shard exhausts bounded
+                # retry and takes over — drawing the replacement from
+                # the standby pool when armed; in-process the takeover
+                # is synchronous (there is no child to die under us).
+                k = sorted(owners)[ev.data % len(owners)]
+                if cfg.two_process:
+                    proc = procs.get(k)
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                else:
+                    revive_owner(k)
             else:
                 raise ValueError(f"unknown fleet scenario event {ev.kind!r}")
 
@@ -2155,12 +2558,142 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 cfg.autoscale_interval_s if cfg.autoscale else 0.0
             ),
         )
+        if cfg.scripted_events:
+            # Hand-placed production-day incidents (owner kills, cold
+            # router restarts, node deaths at scripted seconds) merged
+            # into the generated stream.  Only re-sorted when armed: the
+            # legacy schedule stays byte-identical otherwise.
+            scenario = sorted(
+                list(scenario) + one_shot_events(cfg.scripted_events),
+                key=lambda e: (e.t, e.kind, e.data),
+            )
         ops: list[tuple[float, int, int, object]] = []
         for j, ev in enumerate(scenario):
             ops.append((ev.t, 1, j, ev))
         for i, off in enumerate(offsets):
             ops.append((off, 2, i, i))
         ops.sort(key=lambda e: (e[0], e[1], e[2]))
+
+        # -- resumable-driver state (ISSUE 18) -------------------------
+        # The driver is (lint-enforced) a pure function of (config,
+        # seed, logical clock): every RNG draw is pre-computed above, so
+        # the deterministic state is exactly the op cursor plus the
+        # replayable accumulators — digest-verified on resume.  The
+        # wall-derived observability accumulators ride a separate block,
+        # restored verbatim (a replay cannot re-measure the past).
+        def _det_state(op_index: int, clock: float) -> dict:
+            adm: list[str] = []
+            if router.queue.admission is not None:
+                adm = list(router.queue.admission.admitted_log)
+            return {
+                "op_index": int(op_index),
+                "clock": round(float(clock), 9),
+                "decisions": res.decisions,
+                "bound": res.bound,
+                "retired": res.retired,
+                "tenant_counts": dict(sorted(res.tenant_counts.items())),
+                "tenant_bound": dict(sorted(res.tenant_bound.items())),
+                "events_applied": dict(sorted(res.events_applied.items())),
+                "router_restarts": router_restarts,
+                "node_deaths": node_deaths,
+                "node_revives": node_revives,
+                "lease_renewals": lease_renewals,
+                "cap_toggle": sorted(cap_toggle.items()),
+                "label_epoch": sorted(label_epoch.items()),
+                "dead": sorted(dead),
+                "live_sha": _sha(list(live)),
+                "pending_sha": _sha(sorted(pending)),
+                "bindings_sha": _sha(sorted(router.bindings().items())),
+                "admission_sha": _sha(list(admission_order) + adm),
+                "autoscale_sha": _sha(
+                    [
+                        [
+                            a.get("op"), a.get("from"), a.get("to"),
+                            round(float(a.get("t", 0.0)), 9),
+                        ]
+                        for a in autoscale_actions
+                    ]
+                ),
+                "shards": sorted(owners),
+            }
+
+        def _obs_state() -> dict:
+            return {
+                "latencies": list(res.latencies),
+                "violations": res.violations,
+                "tenant_latencies": {
+                    k: list(v)
+                    for k, v in sorted(res.tenant_latencies.items())
+                },
+                "tenant_violations": dict(
+                    sorted(res.tenant_violations.items())
+                ),
+                "per_shard_lat": {
+                    str(k): list(v)
+                    for k, v in sorted(per_shard_lat.items())
+                },
+                "lat_trace": [[t, s, l] for t, s, l in lat_trace],
+                "burst_lat": {
+                    f"{tk}\x1f{int(b)}": list(v)
+                    for (tk, b), v in sorted(burst_lat.items())
+                },
+                "owner_takeovers": owner_takeovers,
+                "wal_samples": {
+                    str(k): list(v) for k, v in sorted(wal_samples.items())
+                },
+                "wal_prev": {
+                    str(k): v for k, v in sorted(wal_prev.items())
+                },
+                "compactions": {
+                    str(k): v for k, v in sorted(compactions.items())
+                },
+            }
+
+        def _restore_obs(obs: dict) -> None:
+            nonlocal owner_takeovers
+            res.latencies[:] = [float(v) for v in obs["latencies"]]
+            res.violations = int(obs["violations"])
+            res.tenant_latencies.clear()
+            res.tenant_latencies.update(
+                {k: [float(v) for v in vs]
+                 for k, vs in obs["tenant_latencies"].items()}
+            )
+            res.tenant_violations.clear()
+            res.tenant_violations.update(
+                {k: int(v) for k, v in obs["tenant_violations"].items()}
+            )
+            per_shard_lat.clear()
+            per_shard_lat.update(
+                {int(k): [float(v) for v in vs]
+                 for k, vs in obs["per_shard_lat"].items()}
+            )
+            lat_trace[:] = [
+                (float(t), int(s), float(l)) for t, s, l in obs["lat_trace"]
+            ]
+            burst_lat.clear()
+            for key, vs in obs["burst_lat"].items():
+                tk, b = key.split("\x1f")
+                burst_lat[(tk, bool(int(b)))] = [float(v) for v in vs]
+            owner_takeovers = int(obs["owner_takeovers"])
+            wal_samples.clear()
+            wal_samples.update(
+                {int(k): [int(v) for v in vs]
+                 for k, vs in obs["wal_samples"].items()}
+            )
+            wal_prev.clear()
+            wal_prev.update(
+                {int(k): int(v) for k, v in obs["wal_prev"].items()}
+            )
+            compactions.clear()
+            compactions.update(
+                {int(k): int(v) for k, v in obs["compactions"].items()}
+            )
+
+        digest_verified = None
+        if cfg.checkpoint_path:
+            ckpt = CheckpointWriter(cfg.checkpoint_path)
+            if ckpt_prior is not None:
+                ckpt.generation = int(ckpt_prior["generation"])
         t0 = time.perf_counter()
 
         def execute(klass: int, payload, t_ev: float) -> None:
@@ -2175,11 +2708,25 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                 )
                 sample_wal()
             else:
-                deadline = t0 + t_ev if cfg.pace == "real" else None
+                deadline = (
+                    t0 + t_ev
+                    if cfg.pace == "real" and not replay_active[0]
+                    else None
+                )
                 decide(pods[payload], deadline, t_ev)
 
+        # Replay prefix (resume): ops [0, resume_from) re-execute in
+        # virtual pace — deterministic regeneration of the driver and
+        # fleet state, sleeps skipped — then the regenerated digest is
+        # verified against the checkpoint, the wall-derived accumulators
+        # restore, and the wall origin rebases so the remaining ops pace
+        # exactly as the uninterrupted run's would have.
+        replay_active = [resume_from > 0]
+        op_i = 0
+        last_t = 0.0
         for t_ev, klass, _idx, payload in ops:
-            if cfg.pace == "real":
+            replay_active[0] = op_i < resume_from
+            if cfg.pace == "real" and not replay_active[0]:
                 delay = (t0 + t_ev) - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
@@ -2201,8 +2748,54 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
                     autoscaler.note_unreachable(shard)
                 revive_owner(shard)
                 execute(klass, payload, t_ev)
+            op_i += 1
+            last_t = t_ev
+            if replay_active[0] and op_i == resume_from:
+                # End of the replayed prefix: the regenerated driver
+                # state must hash exactly to what the checkpoint
+                # recorded, or the resume would silently diverge.
+                want = ckpt_prior["state"]["det"]
+                got = _det_state(op_i, t_ev)
+                if state_digest(got) != state_digest(want):
+                    diffs = [
+                        k
+                        for k in sorted(set(got) | set(want))
+                        if got.get(k) != want.get(k)
+                    ]
+                    raise RuntimeError(
+                        "resume digest mismatch at op "
+                        f"{op_i}: replay diverged on {diffs}"
+                    )
+                _restore_obs(ckpt_prior["state"]["obs"])
+                digest_verified = True
+                t0 = time.perf_counter() - t_ev
+            if (
+                ckpt is not None
+                and cfg.checkpoint_every_ops > 0
+                and op_i > resume_from
+                and op_i % cfg.checkpoint_every_ops == 0
+            ):
+                ckpt.write(
+                    {"det": _det_state(op_i, t_ev), "obs": _obs_state()}
+                )
+            if (
+                cfg.kill_after_op
+                and op_i == cfg.kill_after_op
+                and op_i > resume_from
+            ):
+                # Test hook (--standby-kill ckpt cells; tests/test_soak):
+                # die HARD right here — after the boundary checkpoint
+                # when op_i lands on one, mid-interval otherwise.
+                os.kill(os.getpid(), signal.SIGKILL)
+        if cfg.resume and not digest_verified:
+            raise RuntimeError(
+                f"resume op index {resume_from} was never reached "
+                f"({op_i} ops in schedule) — checkpoint/config mismatch"
+            )
         sample_wal()
         res.wall_s = round(time.perf_counter() - t0, 3)
+        driver_state_sha = state_digest(_det_state(op_i, last_t))
+        standby_status = standby.status() if standby is not None else None
 
         bindings = router.bindings()
         stats = router.stats()
@@ -2343,6 +2936,13 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             }
         registry_summary = router.registry.summary()
     finally:
+        if standby is not None:
+            try:
+                standby.close()  # retires (SIGTERMs) un-promoted slots
+            except OSError:
+                pass
+        if ckpt is not None:
+            ckpt.close()
         for owner in owners.values():
             try:
                 owner.close()
@@ -2474,16 +3074,58 @@ def run_fleet_soak(cfg: SoakConfig, shards: int = 2) -> dict:
             and router.queue.admission is not None
             else None
         ),
+        "standby": (
+            dict(
+                enabled=True,
+                pool=standby_status,
+                promotions=standby_promotions,
+                served_from_pool=sum(
+                    1 for p in standby_promotions if p["from_pool"]
+                ),
+                cold_fallbacks=standby_cold,
+                promotion_latency=_lat_summary(
+                    [
+                        p["latency_s"]
+                        for p in standby_promotions
+                        if p["from_pool"]
+                    ]
+                ),
+            )
+            if standby is not None
+            else None
+        ),
+        "resume": (
+            dict(
+                enabled=True,
+                resumed=bool(cfg.resume),
+                resume_op_index=resume_from,
+                checkpoint_generation=(
+                    ckpt.generation if ckpt is not None else 0
+                ),
+                checkpoint_every_ops=cfg.checkpoint_every_ops,
+                digest_verified=digest_verified,
+            )
+            if cfg.checkpoint_path
+            else None
+        ),
         "determinism": {
             "arrival_sha256": _sha([round(o, 9) for o in offsets]),
             "bindings_sha256": _sha(sorted(bindings.items())),
             "timeline_sha256": merged_sha,
+            # The driver's own final-state digest (ISSUE 18): the same
+            # function the resume checkpoint verifies — equal between a
+            # --resume'd run and its uninterrupted same-seed twin.
+            "driver_state_sha256": driver_state_sha,
             "arrivals_total": len(offsets),
         },
         "bound_final": len(bindings),
         "pace": cfg.pace,
     }
     artifact["_arrival_offsets"] = [list(offsets)]
+    # Raw (t, shard, latency) samples for callers that window SLOs
+    # around incidents (run_soak.py --prod's per-phase evidence) —
+    # underscore-keyed: strip_private drops it from the committed JSON.
+    artifact["_lat_trace"] = [[t, s, lat] for t, s, lat in lat_trace]
     return artifact
 
 

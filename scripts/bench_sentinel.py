@@ -134,6 +134,49 @@ GUARDS = (
         "soak: rate caps may throttle but aging escape must keep "
         "every tenant's wait under its SLO budget",
     },
+    {
+        "name": "prod_service_p99",
+        "source": {
+            "family": "SOAK_PROD_r*.json",
+            "path": ("service_slo", "worst_p99_ms"),
+            "denom_path": ("slo", "budget_ms"),
+        },
+        "op": "ratio_paths_max",
+        "warn": 1.0,
+        "hard": 1.5,
+        "why": "production day: the worst per-tenant SERVICE p99 (the "
+        "component split strips each throttled tenant's cap-attributed "
+        "queue wait) vs the recorded SLO budget — the composed chaos "
+        "must not erode the scheduler's own service time "
+        "(r18 recorded 253ms/250ms = 1.01, a standing warn)",
+    },
+    {
+        "name": "prod_recovery_p99",
+        "source": {
+            "family": "SOAK_PROD_r*.json",
+            "path": ("incident_windows", "worst_recovery_p99_ms"),
+            "denom_path": ("incident_windows", "steady", "p99_ms"),
+        },
+        "op": "ratio_paths_max",
+        "warn": 3.0,
+        "hard": 10.0,
+        "why": "production day: the worst post-incident recovery "
+        "window's p99 vs steady state — every incident's tail must "
+        "SETTLE, not smear into the next window",
+    },
+    {
+        "name": "prod_promotion_max",
+        "source": {
+            "family": "SOAK_PROD_r*.json",
+            "path": ("standby", "promotion_latency", "max_ms"),
+        },
+        "op": "max",
+        "warn": 5000,
+        "hard": 7500,
+        "why": "production day: worst warm-standby promotion latency "
+        "(ms) — a promotion drifting toward the ~15s cold boot means "
+        "the pool stopped being warm",
+    },
 )
 
 

@@ -375,6 +375,99 @@ def soak_report(doc: dict) -> str:
             f"{nl.get('reschedules')} pods rescheduled elsewhere, "
             f"{nl.get('lease_renewals')} lease renewals"
         )
+    sb = doc.get("standby")
+    if sb and sb.get("enabled"):
+        pool = sb.get("pool") or {}
+        lat = sb.get("promotion_latency") or {}
+        out.append(
+            f"\nwarm-standby pool: {sb.get('served_from_pool')} "
+            f"promotion(s) served warm, {sb.get('cold_fallbacks')} cold "
+            f"fallback(s) — warm promotion p50 {lat.get('p50_ms')}ms, "
+            f"max {lat.get('max_ms')}ms; pool size "
+            f"{pool.get('pool_size')}/{pool.get('size_target')}, "
+            f"{pool.get('schema_stale_evictions')} schema-stale "
+            f"eviction(s), {pool.get('misses')} miss(es)"
+        )
+        rows = [
+            (
+                p.get("t"), p.get("shard"), p.get("reason"),
+                "warm" if p.get("from_pool") else "COLD",
+                f"{p.get('latency_s')}s",
+            )
+            for p in sb.get("promotions") or ()
+        ]
+        if rows:
+            out.append(
+                _table(rows, ("t", "shard", "reason", "path", "latency"))
+            )
+    rs = doc.get("resume")
+    if rs and rs.get("enabled"):
+        out.append(
+            f"\nresumable driver: checkpoint every "
+            f"{rs.get('checkpoint_every_ops')} ops, generation "
+            f"{rs.get('checkpoint_generation')}"
+            + (
+                f" — RESUMED from op {rs.get('resume_op_index')} "
+                f"(digest verified: {rs.get('digest_verified')})"
+                if rs.get("resumed")
+                else ""
+            )
+        )
+    for twin in doc.get("resume_twin_check") or ():
+        out.append(
+            f"  resume twin '{twin.get('name')}': kill@op"
+            f"{twin.get('kill_after_op')} → resumed from op "
+            f"{twin.get('resume_op_index')}, bit-identical "
+            f"{twin.get('bit_identical')}"
+        )
+    iw = doc.get("incident_windows")
+    if iw:
+        steady = iw.get("steady") or {}
+        out.append(
+            f"\nincident windows ({iw.get('window_s')}s incident + "
+            f"{iw.get('window_s')}s recovery; steady = outside all "
+            f"windows): steady p50 {steady.get('p50_ms')}ms p99 "
+            f"{steady.get('p99_ms')}ms over {steady.get('decisions')} "
+            f"decisions"
+        )
+        rows = [
+            (
+                p.get("t"), p.get("family"),
+                (p.get("incident") or {}).get("decisions"),
+                f"{(p.get('incident') or {}).get('p99_ms')}ms",
+                f"{(p.get('recovery') or {}).get('p99_ms')}ms",
+            )
+            for p in iw.get("incidents") or ()
+        ]
+        if rows:
+            out.append(
+                _table(
+                    rows,
+                    ("t", "incident", "dec", "p99-in", "p99-recovery"),
+                )
+            )
+    svc = doc.get("service_slo")
+    if svc and svc.get("worst_p99_ms") is not None:
+        per = svc.get("per_tenant_service_p99_ms") or {}
+        out.append(
+            "\nservice-only p99 (cap-attributed queue wait stripped via "
+            "the component split): worst "
+            f"{svc.get('worst_p99_ms')}ms — "
+            + ", ".join(f"{t} {v}ms" for t, v in per.items())
+        )
+    gates = doc.get("production_gates")
+    if gates:
+        out.append(
+            f"\nproduction gates: starvation violations "
+            f"{gates.get('starvation_violations')}, "
+            f"{gates.get('promotions')} promotion(s) "
+            f"({', '.join(gates.get('promotion_reasons') or ())}) all from "
+            f"pool={gates.get('every_owner_from_pool')}, max promotion "
+            f"{gates.get('max_promotion_latency_s')}s vs "
+            f"{gates.get('cold_boot_baseline_s')}s cold boot, "
+            f"{gates.get('splits')} split(s), all families active="
+            f"{gates.get('all_families_active')}"
+        )
     phases = doc.get("phases", [])
     if phases:
         out.append("\nper-phase serving:")
@@ -598,6 +691,13 @@ def _render_span(span: dict, parts: list[str], indent: str) -> None:
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     fleet = False
+    prod = False
+    if args and args[0] == "--prod":
+        # Force the soak rendering (standby pool, resume twins, incident
+        # windows, production gates) — the production-day artifact routes
+        # there by metric anyway; the flag covers partial/renamed docs.
+        prod = True
+        args = args[1:]
     if args and args[0] == "--fleet":
         fleet = True
         args = args[1:]
@@ -634,7 +734,7 @@ def main(argv=None) -> int:
     if isinstance(doc.get("parsed"), dict):
         # A recorded-trajectory wrapper (the driver's capture format).
         doc = doc["parsed"]
-    if str(doc.get("metric", "")).startswith(
+    if prod or str(doc.get("metric", "")).startswith(
         ("soak_", "fleet_soak_", "tenant_soak")
     ) or ("knee" in doc and "slo" in doc):
         print(soak_report(doc))

@@ -474,11 +474,19 @@ def recover_child(state_dir: str) -> None:
         json.dump(bindings, f, sort_keys=True)
 
 
-def _spawn(mode: str, state_dir: str, kill: str | None = None) -> int:
+def _spawn(
+    mode: str,
+    state_dir: str,
+    kill: str | None = None,
+    extra_env: dict | None = None,
+) -> int:
     env = dict(os.environ)
     env.pop("TPU_JOURNAL_KILL", None)
+    env.pop("TPU_STANDBY_POOL", None)
     if kill:
         env["TPU_JOURNAL_KILL"] = kill
+    if extra_env:
+        env.update(extra_env)
     # Recovery flight dumps stay in the cell's state dir, not /tmp.
     env["TPU_FLIGHT_DIR"] = state_dir
     proc = subprocess.run(
@@ -1207,6 +1215,36 @@ def run_pipeline_kill_matrix(
 # -- the FLEET crash matrix (shard failover via takeover) ------------------
 
 
+def _takeover_factory(state_dir: str, base_factory):
+    """Per-shard scheduler factories for the RECOVERY path.  Unarmed
+    (TPU_STANDBY_POOL unset/0) every shard gets the cold ``base_factory``
+    — the pre-ISSUE-18 takeover, untouched.  Armed, takeover owners draw
+    their schedulers from a warm-standby pool (fleet/standby.py) with the
+    cold factory as the miss fallback.  The pool only changes WHO serves
+    the recovered shard; recover_shard's journal replay decides WHAT it
+    owns — so armed and unarmed recoveries must land byte-identical
+    bindings (the standbykill:fleet cell asserts exactly that)."""
+    n = int(os.environ.get("TPU_STANDBY_POOL", "0") or 0)
+    if n <= 0:
+        return lambda k: base_factory
+    from kubernetes_tpu.fleet.standby import StandbyPool
+
+    pool = StandbyPool(
+        os.path.join(state_dir, "standby-takeover"),
+        lambda sid: {"sched": base_factory()},
+        size=n,
+    )
+
+    def for_shard(k):
+        def factory():
+            payload = pool.promote(k, "takeover")
+            return payload["sched"] if payload else base_factory()
+
+        return factory
+
+    return for_shard
+
+
 def _fleet_build(state_dir: str, recover: bool = False):
     """(router, owners, map_path): a 2-shard journaled fleet running the
     golden basic-session configuration, every owner's delete_pod
@@ -1226,12 +1264,13 @@ def _fleet_build(state_dir: str, recover: bool = False):
         smap = ShardMap(n_shards=2, n_buckets=16)
         smap.save(map_path)
     factory = session_schedulers()["basic_session"]
+    take = _takeover_factory(state_dir, factory) if recover else None
     owners = {}
     for k in range(2):
         sdir = os.path.join(state_dir, f"shard{k}")
         os.makedirs(sdir, exist_ok=True)
         if recover:
-            owner = recover_shard(sdir, factory, k, smap, map_path=map_path)
+            owner = recover_shard(sdir, take(k), k, smap, map_path=map_path)
         else:
             owner = ShardOwner(
                 k, factory(), smap, state_dir=sdir, snapshot_every_batches=1
@@ -1390,6 +1429,386 @@ def run_fleet_kill_matrix(cases=FLEET_KILL_CASES, verbose=True) -> list[str]:
                 print(
                     f"ok   {label}: takeover recovered bit-identical "
                     f"bindings{_cell_dt(t0)}"
+                )
+        return failures
+
+
+# -- the STANDBY kill matrix (ISSUE 18) ------------------------------------
+#
+# The warm-standby pool's crash story splits in two.  FLEET-STATE
+# correctness across a SIGKILL anywhere in a promotion is the EXISTING
+# takeover/redo machinery's job — the pool's only own obligation is to
+# NEVER OFFER A SLOT TWICE (claim file + pool-WAL replay), which
+# _standby_pool_invariant checks in every recovery.  The RESUMABLE SOAK
+# DRIVER's crash story is the checkpoint writer's: a kill inside the
+# write window (digest journaled, os.replace unapplied) must leave the
+# last durable generation as the resume anchor, and a --resume'd run
+# must finish bit-identical to an uninterrupted same-seed twin.
+
+STANDBY_KILL_CASES = (
+    # The promotion window (fleet/standby.py promote): killed before the
+    # O_EXCL claim, after claim + pool-WAL append but before the
+    # finish_promotion apply, and right after the apply.
+    ("promo", "standby-pre-claim", 1),
+    ("promo", "standby-mid-promotion", 1),
+    ("promo", "standby-post-promote", 1),
+    # The promoted owner's handoff window: killed after the handoff
+    # record's append, and between the append and the shard-map rewrite
+    # (the "router killed between lease claim and map write" cell).
+    ("promo", "post-handoff-append", 1),
+    ("promo", "pre-map-write", 1),
+    # The soak driver SIGKILLed inside its SECOND checkpoint's write
+    # window — mid-checkpoint: generation record journaled, os.replace
+    # never applied; --resume must anchor on generation 1.
+    ("ckpt", "mid-checkpoint", 2),
+    # Satellite-2 byte-identity: the ordinary shard-failover cell with
+    # TPU_STANDBY_POOL=2 armed in the RECOVERY child — takeover owners
+    # drawn warm from a pool instead of cold factories, same bindings.
+    ("fleet", "post-append", 3),
+)
+
+# The resumable-driver cell's soak shape: small, virtual-paced, with the
+# standby pool armed AND a scripted owner kill in the replayed prefix —
+# the resume leg re-executes a pool promotion during replay, composing
+# both halves of the ISSUE in one cell.
+STANDBY_CKPT_CFG = dict(
+    seed=11, nodes=32, zones=4, churn_nodes=4, rate_pods_per_s=24.0,
+    duration_s=6.0, knee_points=(), invalidation_rate_per_s=0.15,
+    node_flap_period_s=0.0, pace="virtual", batch_size=64, chunk_size=16,
+    warm_pods=24, live_pod_cap=300, standby_pool=1,
+    checkpoint_every_ops=30, scripted_events=((2.5, "owner_kill", 1),),
+)
+
+
+def _standby_pool_records(state_dir: str) -> list[dict]:
+    from kubernetes_tpu.fleet.standby import JOURNAL_NAME, _PoolJournal
+
+    return _PoolJournal.replay(
+        os.path.join(state_dir, "standby", JOURNAL_NAME)
+    )
+
+
+def _standby_pool_invariant(state_dir: str) -> None:
+    """The pool's no-double-offer contract: at most ONE promote record
+    per slot id, and every promote record sits behind its O_EXCL claim
+    file (the append is only reachable through a won claim)."""
+    per_slot: dict[int, int] = {}
+    for rec in _standby_pool_records(state_dir):
+        if rec.get("op") == "promote":
+            sid = int(rec["slot"])
+            per_slot[sid] = per_slot.get(sid, 0) + 1
+            claim = os.path.join(state_dir, "standby", f"slot-{sid}.claim")
+            assert os.path.exists(claim), (
+                f"promote record for slot {sid} without a claim file"
+            )
+    doubled = {s: n for s, n in sorted(per_slot.items()) if n > 1}
+    assert not doubled, f"slots offered twice: {doubled}"
+
+
+def standby_promo_child(state_dir: str) -> None:
+    """The victim: a cold 2-shard fleet feeds the golden scenario's
+    nodes + bound pods (durable per-shard appends), then shard-1's owner
+    DIES and its replacement comes from a warm-standby POOL promotion
+    (claim → pool-WAL append → finish_promotion) — a journaled TAKEOVER
+    over the dead owner's journal dir, not a cold boot — after which the
+    rebuilt router runs the scenario tail.  TPU_JOURNAL_KILL SIGKILLs
+    inside the promotion window or inside the promoted fleet's
+    handoff."""
+    from gen_golden_transcripts import scenario_objects, session_schedulers
+
+    from kubernetes_tpu.faults import KillSwitch
+    from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+    from kubernetes_tpu.fleet.standby import StandbyPool
+
+    map_path = os.path.join(state_dir, "shardmap.json")
+    smap = ShardMap(n_shards=2, n_buckets=16)
+    smap.save(map_path)
+    factory = session_schedulers()["basic_session"]
+    pool = StandbyPool(
+        os.path.join(state_dir, "standby"),
+        lambda sid: {"sched": factory()},
+        size=1,
+    )
+
+    def wrap_delete(owner):
+        orig_delete = owner.sched.delete_pod
+
+        def delete_pod(uid, notify=True, _orig=orig_delete):
+            _truth_delete(state_dir, uid)
+            _orig(uid, notify)
+
+        owner.sched.delete_pod = delete_pod
+        return owner
+
+    owners = {}
+    for k in range(2):
+        sdir = os.path.join(state_dir, f"shard{k}")
+        os.makedirs(sdir, exist_ok=True)
+        owners[k] = wrap_delete(
+            ShardOwner(
+                k, factory(), smap, state_dir=sdir, snapshot_every_batches=1
+            )
+        )
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in bound:
+        router.add_object("Pod", p)
+    for p in pending:
+        router.add_pod(p)
+    # First batch SCHEDULES before the incident: every kill cell's
+    # takeover then has durable journaled binds to recover (and a
+    # recovery flight dump to leave as evidence) — an owner dying over
+    # an empty journal would be a cold start, not an incident.
+    router.schedule_all_pending(wait_backoff=True)
+    # Shard-1's owner dies mid-incident (close releases the flock the
+    # way a SIGKILL's process exit would).  Armed HERE: the points under
+    # test are the REPLACEMENT's promotion window and the promoted
+    # fleet's handoff — never the cold build or the initial map save.
+    owners[1].close()
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    payload = pool.promote(1, "takeover")
+    sched1 = payload["sched"] if payload else factory()
+    owners[1] = wrap_delete(
+        ShardOwner(
+            1, sched1, smap,
+            state_dir=os.path.join(state_dir, "shard1"),
+            snapshot_every_batches=1,
+        )
+    )
+    # Rebuild the router over the recovered truth (the revive_owner
+    # idiom): nodes relist, parked journal bindings re-apply, the router
+    # adopts, bound pods re-feed idempotently, then the tail runs.
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    deleted = _truth_deleted(state_dir)
+    for n in nodes:
+        router.add_object("Node", n)
+    router.reconcile_recovered()
+    router.adopt_bindings()
+    for p in bound:
+        if p.uid not in deleted:
+            router.add_object("Pod", p)
+    for p in pending:
+        if p.uid not in deleted:
+            router.add_pod(p)
+    _fleet_tail(router, map_path, state_dir)
+    for owner in owners.values():
+        owner.close()
+    pool.close()
+
+
+def standby_promo_recover_child(state_dir: str) -> None:
+    """The takeover: verify the pool never double-offered, reopen it
+    (WAL replay marks consumed slots — a claim file without its promote
+    record is a promotion that died between claim and append,
+    conservatively consumed), then recover BOTH shards through
+    recover_shard with takeover owners drawn from the pool, re-run the
+    tail, and re-verify the invariant (recovery's own promotions land
+    on fresh slot ids)."""
+    from gen_golden_transcripts import scenario_objects, session_schedulers
+
+    from kubernetes_tpu.fleet import FleetRouter, ShardMap
+    from kubernetes_tpu.fleet.standby import StandbyPool
+    from kubernetes_tpu.fleet.takeover import recover_shard
+
+    _standby_pool_invariant(state_dir)
+    map_path = os.path.join(state_dir, "shardmap.json")
+    smap = ShardMap.load(map_path)
+    factory = session_schedulers()["basic_session"]
+    pool = StandbyPool(
+        os.path.join(state_dir, "standby"),
+        lambda sid: {"sched": factory()},
+        size=2,
+    )
+    owners = {}
+    for k in range(2):
+        sdir = os.path.join(state_dir, f"shard{k}")
+        os.makedirs(sdir, exist_ok=True)
+
+        def take(k=k):
+            payload = pool.promote(k, "takeover")
+            return payload["sched"] if payload else factory()
+
+        owner = recover_shard(sdir, take, k, smap, map_path=map_path)
+        orig_delete = owner.sched.delete_pod
+
+        def delete_pod(uid, notify=True, _orig=orig_delete):
+            _truth_delete(state_dir, uid)
+            _orig(uid, notify)
+
+        owner.sched.delete_pod = delete_pod
+        owners[k] = owner
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    deleted = _truth_deleted(state_dir)
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    router.reconcile_recovered()
+    router.adopt_bindings()
+    for p in bound:
+        if p.uid not in deleted:
+            router.add_object("Pod", p)
+    for p in pending:
+        if p.uid not in deleted:
+            router.add_pod(p)
+    _fleet_tail(router, map_path, state_dir)
+    _standby_pool_invariant(state_dir)
+    with open(os.path.join(state_dir, "standby-recovery.json"), "w") as f:
+        json.dump(pool.status(), f, sort_keys=True)
+    for owner in owners.values():
+        owner.close()
+    pool.close()
+
+
+def standby_ckpt_child(state_dir: str) -> None:
+    """The victim: a small armed fleet soak (standby pool + scripted
+    owner kill + checkpoint every 30 ops) with the kill switch armed —
+    mid-checkpoint:2 SIGKILLs inside the second checkpoint's write
+    window, after its generation record's journal append but before the
+    os.replace apply."""
+    from kubernetes_tpu.faults import KillSwitch
+    from kubernetes_tpu.loadgen.soak import SoakConfig, run_fleet_soak
+
+    ks = KillSwitch.from_env()
+    if ks is not None:
+        ks.arm()
+    cfg = SoakConfig(
+        out_dir=os.path.join(state_dir, "out"),
+        journal_dir=os.path.join(state_dir, "journal"),
+        checkpoint_path=os.path.join(state_dir, "soak.ckpt"),
+        **STANDBY_CKPT_CFG,
+    )
+    art = run_fleet_soak(cfg, shards=2)
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(art["determinism"], f, sort_keys=True)
+
+
+def standby_ckpt_recover_child(state_dir: str) -> None:
+    """--resume: anchor on the last DURABLE checkpoint generation,
+    replay the op prefix in virtual pace against fresh journal dirs,
+    verify the regenerated state digest, finish the run — the
+    determinism block (bindings, timeline, driver-state digests) must be
+    bit-identical to an uninterrupted same-seed twin's."""
+    from kubernetes_tpu.loadgen.soak import SoakConfig, run_fleet_soak
+
+    cfg = SoakConfig(
+        out_dir=os.path.join(state_dir, "out-resume"),
+        journal_dir=os.path.join(state_dir, "journal"),
+        checkpoint_path=os.path.join(state_dir, "soak.ckpt"),
+        resume=True,
+        **STANDBY_CKPT_CFG,
+    )
+    art = run_fleet_soak(cfg, shards=2)
+    assert art["resume"]["resumed"] and art["resume"]["digest_verified"], (
+        art["resume"]
+    )
+    with open(os.path.join(state_dir, "bindings.json"), "w") as f:
+        json.dump(art["determinism"], f, sort_keys=True)
+
+
+def run_standby_kill_matrix(cases=STANDBY_KILL_CASES, verbose=True) -> list[str]:
+    """SIGKILL inside the standby promotion window, the promoted fleet's
+    handoff, and the soak driver's checkpoint write; recover (pool
+    reopen + takeover, or --resume) and compare against unkilled
+    baselines.  Also proves satellite-2 byte-identity: the pool-backed
+    promo baseline equals the cold fleet baseline, and a pool-armed
+    fleet recovery equals the unarmed one.  Returns diverged labels."""
+    with tempfile.TemporaryDirectory() as td:
+        failures = []
+        promo_base = os.path.join(td, "standby-promo-baseline")
+        os.makedirs(promo_base)
+        rc = _spawn("--standby-promo-child", promo_base)
+        promo_baseline = _read_bindings(promo_base)
+        assert rc == 0 and promo_baseline, "standby promo baseline failed"
+        fleet_base = os.path.join(td, "fleet-baseline")
+        os.makedirs(fleet_base)
+        rc = _spawn("--fleet-kill-child", fleet_base)
+        fleet_baseline = _read_bindings(fleet_base)
+        assert rc == 0 and fleet_baseline, "fleet baseline failed"
+        if promo_baseline != fleet_baseline:
+            # The pool must change WHO serves shard 1, never WHAT the
+            # fleet binds.
+            failures.append("standbykill:promo-baseline-parity")
+            if verbose:
+                print(
+                    "FAIL standbykill: pool-promoted fleet baseline "
+                    "diverged from the cold fleet baseline"
+                )
+        ckpt_base = os.path.join(td, "standby-ckpt-baseline")
+        os.makedirs(ckpt_base)
+        rc = _spawn("--standby-ckpt-child", ckpt_base)
+        ckpt_baseline = _read_bindings(ckpt_base)
+        assert rc == 0 and ckpt_baseline, "standby ckpt baseline failed"
+        for family, point, nth in cases:
+            label = f"standbykill:{family}:{point}@{nth}"
+            if not _selected(label):
+                continue
+            t0 = _cell_t0()
+            state_dir = os.path.join(td, f"standby-{family}-{point}-{nth}")
+            os.makedirs(state_dir)
+            if family == "promo":
+                child, recover, baseline, extra = (
+                    "--standby-promo-child",
+                    "--standby-promo-recover-child",
+                    promo_baseline,
+                    None,
+                )
+            elif family == "ckpt":
+                child, recover, baseline, extra = (
+                    "--standby-ckpt-child",
+                    "--standby-ckpt-recover-child",
+                    ckpt_baseline,
+                    None,
+                )
+            else:  # the satellite-2 fleet cell: pool-armed RECOVERY
+                child, recover, baseline, extra = (
+                    "--fleet-kill-child",
+                    "--fleet-recover-child",
+                    fleet_baseline,
+                    {"TPU_STANDBY_POOL": "2"},
+                )
+            rc = _spawn(child, state_dir, kill=f"{point}:{nth}")
+            if rc == 0:
+                got = _read_bindings(state_dir)
+                status = "ok (kill never fired)"
+                if got != baseline:
+                    failures.append(label)
+                    status = "FAIL (no kill, diverged)"
+                if verbose:
+                    print(f"{status} {label}{_cell_dt(t0)}")
+                continue
+            if rc != -9:
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: child exited {rc}, expected SIGKILL")
+                continue
+            rc = _spawn(recover, state_dir, extra_env=extra)
+            got = _read_bindings(state_dir)
+            if rc != 0 or got != baseline:
+                failures.append(label)
+                if verbose:
+                    diff = {
+                        k: (baseline.get(k), (got or {}).get(k))
+                        for k in set(baseline) | set(got or {})
+                        if baseline.get(k) != (got or {}).get(k)
+                    }
+                    print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+                continue
+            if family != "ckpt" and not _flight_dump_ok(state_dir):
+                failures.append(label)
+                if verbose:
+                    print(f"FAIL {label}: no readable recovery flight dump")
+                continue
+            if verbose:
+                print(
+                    f"ok   {label}: recovered bit-identical"
+                    f"{_cell_dt(t0)}"
                 )
         return failures
 
@@ -1832,13 +2251,18 @@ def _fleet_node_loss_build(state_dir: str, recover: bool = False):
             overrides=dict(FLEET_NODE_LOSS_PINS),
         )
         smap.save(map_path)
+    take = (
+        _takeover_factory(state_dir, _fleet_node_loss_sched)
+        if recover
+        else None
+    )
     owners = {}
     for k in range(2):
         sdir = os.path.join(state_dir, f"shard{k}")
         os.makedirs(sdir, exist_ok=True)
         if recover:
             owner = recover_shard(
-                sdir, _fleet_node_loss_sched, k, smap,
+                sdir, take(k), k, smap,
                 map_path=map_path, lifecycle=FLEET_LIFECYCLE,
             )
         else:
@@ -3081,6 +3505,42 @@ def main() -> int:
             "same map, bit-identical bindings"
         )
         return 0
+    if "--standby-promo-child" in sys.argv:
+        standby_promo_child(
+            sys.argv[sys.argv.index("--standby-promo-child") + 1]
+        )
+        return 0
+    if "--standby-promo-recover-child" in sys.argv:
+        standby_promo_recover_child(
+            sys.argv[sys.argv.index("--standby-promo-recover-child") + 1]
+        )
+        return 0
+    if "--standby-ckpt-child" in sys.argv:
+        standby_ckpt_child(
+            sys.argv[sys.argv.index("--standby-ckpt-child") + 1]
+        )
+        return 0
+    if "--standby-ckpt-recover-child" in sys.argv:
+        standby_ckpt_recover_child(
+            sys.argv[sys.argv.index("--standby-ckpt-recover-child") + 1]
+        )
+        return 0
+    if "--standby-kill" in sys.argv:
+        # The warm-standby promotion + resumable-driver subset (ISSUE
+        # 18; also rides --kill).
+        failures = run_standby_kill_matrix()
+        if failures:
+            print(
+                f"{len(failures)} of {len(STANDBY_KILL_CASES)} standby "
+                f"kill cases diverged: {failures}"
+            )
+            return 1
+        print(
+            f"all {len(STANDBY_KILL_CASES)} standby kill cases: SIGKILL "
+            "inside the promotion window / checkpoint write recovered "
+            "bit-identical with no slot offered twice"
+        )
+        return 0
     if "--fleet-kill-child" in sys.argv:
         fleet_kill_child(sys.argv[sys.argv.index("--fleet-kill-child") + 1])
         return 0
@@ -3123,11 +3583,14 @@ def main() -> int:
         failures += run_pipeline_kill_matrix()
         # And the weighted-fair admission subset (ISSUE 17).
         failures += run_tenant_kill_matrix()
+        # And the warm-standby promotion + resumable driver (ISSUE 18).
+        failures += run_standby_kill_matrix()
         total = (
             len(KILL_CASES) + len(WIRE_KILL_CASES) + len(FLEET_KILL_CASES)
             + len(NODE_LOSS_CASES) + len(FLEET_NODE_LOSS_CASES)
             + len(AUTOSCALE_KILL_CASES) + len(PACK_KILL_CASES)
             + len(PIPELINE_KILL_CASES) + len(TENANT_KILL_CASES)
+            + len(STANDBY_KILL_CASES)
         )
         if failures:
             print(f"{len(failures)} of {total} kill cases diverged: {failures}")

@@ -1062,7 +1062,586 @@ def run_fleet(args) -> int:
     return 0
 
 
+PROD_OUT_DEFAULT = "SOAK_PROD_r18.json"
+
+# The ~15s serve-child cold boot+compile this box pays without the
+# standby pool — the SOAK_FLEET_r11 recording's documented multi-process
+# resize transition cost, and the baseline every promotion latency in
+# the production-day artifact is compared against.
+PROD_COLD_BOOT_BASELINE_S = 15.0
+
+
+def prod_config(args) -> "SoakConfig":
+    """--prod: the ISSUE-18 "production day" composition — every
+    scenario family the repo has grown, armed AT ONCE over the real
+    multi-process fleet at real pace for the --sustained window:
+
+    - diurnal tenant-tagged heterogeneous traffic (web/batch/train over
+      v5e/v5p pools) under ARMED weighted-fair admission — the per-tenant
+      rate cap clips the crest, aging escapes keep throttled ≠ starved;
+    - node DEATHS on the lifecycle loop (heartbeat silenced → staleness
+      on the lease clock → taints → eviction → requeue → reschedule,
+      revive clears), plus continuous adversarial invalidations;
+    - periodic COLD router restarts (journal recovery mid-traffic);
+    - scripted owner kills — revive_owner's takeover draws the
+      replacement serve child from the WARM STANDBY POOL (journaled
+      promotion + lease claim, not a ~15s cold boot);
+    - the elastic autoscaler armed: the crest's hot skew must trip a
+      live split whose new shard ALSO comes from the pool;
+    - the resumable checkpointer armed on a STABLE state dir, so a
+      killed run continues with ``--prod --resume`` bit-identical."""
+    import dataclasses
+
+    return dataclasses.replace(
+        r06_config(args),
+        mix="hetero",
+        hetero_pools=(("v5e", 2), ("v5p", 1)),
+        tenants=(("web", 3.0), ("batch", 1.5), ("train", 1.0)),
+        admission={
+            # The cap sits between the dominant tenant's trough and
+            # crest demand (web draws ~55% of the stream: ~6.5 pods/s
+            # average, ~9.8 at the 1.5× crest), so the bucket clips
+            # crests while troughs refill it; aging escapes before the
+            # starvation budget — throttled, structurally never starved.
+            "rate_pods_per_s": 8.0,
+            "burst": 16.0,
+            "aging_max_wait_s": 40.0,
+            "slo_wait_budget_s": 60.0,
+        },
+        diurnal=True,
+        diurnal_period_s=300.0,
+        knee_points=(),
+        node_death_period_s=240.0,
+        node_death_down_s=25.0,
+        lease_interval_s=1.0,
+        node_grace_s=5.0,
+        node_unreachable_s=12.0,
+        gc_horizon_s=40.0,
+        node_flap_period_s=0.0,
+        cold_consumer_period_s=270.0,
+        invalidation_rate_per_s=0.2,
+        autoscale=True,
+        hot_fraction=0.85,
+        autoscale_interval_s=15.0,
+        autoscale_split_hi=1.5,
+        autoscale_merge_lo=0.1,
+        # One split per crest at most: the cooldown spans two diurnal
+        # periods so the budget refill can't thrash the map mid-run.
+        autoscale_cooldown_s=600.0,
+        autoscale_window_s=120.0,
+        autoscale_budget=1,
+        autoscale_min_decisions=40,
+        autoscale_max_shards=3,
+        autoscale_compare_settle_s=30.0,
+        standby_pool=2,
+        checkpoint_every_ops=400,
+        two_process=True,
+        pace="real",
+        # Two owner kills, one per half: the first lands off-crest, the
+        # second near the late crest — both revives must come warm.
+        scripted_events=tuple(
+            (round(args.sustained * f, 1), "owner_kill", s)
+            for f, s in ((0.35, 1), (0.8, 0))
+        ),
+    )
+
+
+def prod_small(base, **kw) -> "SoakConfig":
+    """The production-day composition scaled to a virtual in-process
+    leg (same families armed, seconds not minutes) — the determinism
+    cross-check and the kill/resume twins run THIS shape."""
+    import dataclasses
+
+    kw.setdefault("scripted_events", ((6.0, "owner_kill", 1),))
+    kw.setdefault("checkpoint_path", "")
+    kw.setdefault("checkpoint_every_ops", 0)
+    kw.setdefault("out_dir", "")
+    kw.setdefault("journal_dir", "")
+    kw.setdefault("standby_dir", "")
+    return dataclasses.replace(
+        base,
+        nodes=32,
+        churn_nodes=4,
+        duration_s=30.0,
+        rate_pods_per_s=20.0,
+        diurnal_period_s=12.0,
+        live_pod_cap=300,
+        warm_pods=32,
+        batch_size=64,
+        chunk_size=16,
+        two_process=False,
+        pace="virtual",
+        node_death_period_s=9.0,
+        node_death_down_s=4.0,
+        node_grace_s=2.0,
+        node_unreachable_s=5.0,
+        gc_horizon_s=12.0,
+        cold_consumer_period_s=11.0,
+        autoscale_interval_s=2.0,
+        autoscale_cooldown_s=60.0,
+        autoscale_window_s=12.0,
+        autoscale_min_decisions=8,
+        autoscale_split_hi=1.3,
+        standby_pool=1,
+        **kw,
+    )
+
+
+def _prod_child(spec_path: str) -> int:
+    """Hidden child entry (``run_soak.py --prod-child spec.json``) for
+    the resume-twin leg and tests/test_soak.py: run ONE fleet soak from
+    a JSON spec and write the oracle surfaces to ``spec.json.result``.
+    A spec with ``kill_after_op`` SIGKILLs itself mid-run — the parent
+    asserts on the .result the RESUMED run writes over the same dirs."""
+    from kubernetes_tpu.loadgen.soak import SoakConfig, run_fleet_soak
+
+    with open(spec_path, encoding="utf-8") as f:
+        spec = json.load(f)
+    cfg = SoakConfig(**spec["cfg"])
+    art = run_fleet_soak(cfg, int(spec.get("shards", 2)))
+    out = {
+        "determinism": art["determinism"],
+        "resume": art["resume"],
+        "standby": {
+            k: (art.get("standby") or {}).get(k)
+            for k in ("enabled", "served_from_pool", "cold_fallbacks")
+        },
+        "admission_order_sha256": (art.get("admission") or {}).get(
+            "admission_order_sha256"
+        ),
+        "bound_final": art["bound_final"],
+        "events": art.get("events") or {},
+    }
+    with open(spec_path + ".result", "w", encoding="utf-8") as f:
+        json.dump(out, f, sort_keys=True)
+        f.write("\n")
+    return 0
+
+
+def _prod_resume_twin(args, cfg, shards, name, every, kill_at) -> dict | None:
+    """One kill/resume round-trip at production shape (virtual pace,
+    subprocesses): run the uninterrupted TWIN, SIGKILL a same-seed run
+    after op ``kill_at``, resume it from its checkpoint, and require
+    every determinism digest to match the twin bit for bit."""
+    import dataclasses
+    import shutil
+    import signal
+    import subprocess
+
+    base_dir = os.path.join(args.out_dir, f"prod-resume-{name}")
+    shutil.rmtree(base_dir, ignore_errors=True)
+    os.makedirs(base_dir, exist_ok=True)
+
+    def spec_for(spec_name, leg, **kw):
+        leg_dir = os.path.join(base_dir, leg)
+        c = prod_small(
+            cfg,
+            out_dir=os.path.join(leg_dir, "out"),
+            journal_dir=os.path.join(leg_dir, "journal"),
+            standby_dir=os.path.join(leg_dir, "standby"),
+            checkpoint_path=os.path.join(leg_dir, "soak.ckpt"),
+            checkpoint_every_ops=every,
+            **kw,
+        )
+        path = os.path.join(base_dir, f"{spec_name}.spec.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"cfg": dataclasses.asdict(c), "shards": shards}, f)
+        return path
+
+    def run_spec(path):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--prod-child", path],
+            capture_output=True, text=True, timeout=900,
+        )
+
+    def result_of(path):
+        with open(path + ".result", encoding="utf-8") as f:
+            return json.load(f)
+
+    twin_spec = spec_for("twin", "twin")
+    killed_spec = spec_for("killed", "main", kill_after_op=kill_at)
+    resumed_spec = spec_for("resumed", "main", resume=True)
+
+    twin = run_spec(twin_spec)
+    if twin.returncode != 0:
+        print(f"run_soak: prod resume twin '{name}' UNINTERRUPTED LEG "
+              f"FAILED rc={twin.returncode}\n{twin.stderr[-3000:]}",
+              file=sys.stderr)
+        return None
+    killed = run_spec(killed_spec)
+    if killed.returncode != -signal.SIGKILL:
+        print(f"run_soak: prod resume twin '{name}' kill@op{kill_at} did "
+              f"not SIGKILL (rc={killed.returncode})\n"
+              f"{killed.stderr[-3000:]}", file=sys.stderr)
+        return None
+    resumed = run_spec(resumed_spec)
+    if resumed.returncode != 0:
+        print(f"run_soak: prod resume twin '{name}' RESUMED LEG FAILED "
+              f"rc={resumed.returncode}\n{resumed.stderr[-3000:]}",
+              file=sys.stderr)
+        return None
+    det = result_of(resumed_spec)["determinism"]
+    twin_det = result_of(twin_spec)["determinism"]
+    rs = result_of(resumed_spec)["resume"]
+    keys = ("arrival_sha256", "bindings_sha256", "timeline_sha256",
+            "driver_state_sha256", "arrivals_total")
+    mismatches = [k for k in keys if det.get(k) != twin_det.get(k)]
+    ok = not mismatches and rs.get("resumed") and rs.get("digest_verified")
+    if not ok:
+        print(f"run_soak: prod resume twin '{name}' NOT bit-identical — "
+              f"mismatched {mismatches}, resume={rs}", file=sys.stderr)
+        return None
+    return {
+        "name": name,
+        "checkpoint_every_ops": every,
+        "kill_after_op": kill_at,
+        "resume_op_index": rs.get("resume_op_index"),
+        "checkpoint_generation": rs.get("checkpoint_generation"),
+        "digest_verified": rs.get("digest_verified"),
+        "bit_identical": True,
+        "driver_state_sha256": det.get("driver_state_sha256"),
+    }
+
+
+def _prod_lat_summary(lats) -> dict:
+    out = {"decisions": len(lats)}
+    if lats:
+        xs = sorted(lats)
+
+        def pct(q):
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1000.0, 3)
+
+        out.update(p50_ms=pct(0.50), p99_ms=pct(0.99), max_ms=pct(1.0))
+    return out
+
+
+def prod_service_slo(artifact) -> dict:
+    """Per-tenant SERVICE p99 (ms) from the component-split decision
+    histograms.  Under armed rate caps, total decision latency carries
+    each throttled tenant's self-inflicted queue wait (the cap working,
+    attributed by the ``component`` label) — the number the production
+    sentinel holds to the solo budget is the scheduler's own service
+    time, which the caps must NOT erode."""
+    hists = (artifact.get("fleet_metrics") or {}).get("histograms") or {}
+    family = hists.get("scheduler_slo_decision_latency_seconds") or {}
+    per_tenant = {}
+    for labels, h in family.items():
+        if 'component="service"' not in labels:
+            continue
+        tenant = labels.split('tenant="', 1)[-1].split('"', 1)[0]
+        per_tenant[tenant] = round(float(h["p99"]) * 1000.0, 3)
+    return {
+        "per_tenant_service_p99_ms": dict(sorted(per_tenant.items())),
+        "worst_p99_ms": max(per_tenant.values(), default=None),
+    }
+
+
+def prod_phases(art, cfg, window_s=30.0) -> dict:
+    """Per-phase incident windows over the raw latency trace (the
+    artifact's pre-strip ``_lat_trace``): for each production incident —
+    standby promotion (owner revive or autoscale split), node death,
+    cold router restart — the latency percentiles inside the
+    ``[t, t+W)`` incident window and the ``[t+W, t+2W)`` recovery
+    window, plus the steady-state percentiles over everything OUTSIDE
+    any window.  Evidence the report renders, computed driver-side from
+    the same trace the SLO block summarizes."""
+    trace = art.get("_lat_trace") or []
+    incidents = []
+    for p in (art.get("standby") or {}).get("promotions") or []:
+        if p.get("t", -1.0) >= 0.0:
+            incidents.append((f"standby-promotion:{p['reason']}", p["t"]))
+    for t, kind, _data in cfg.scripted_events or ():
+        if kind == "owner_kill":
+            incidents.append(("owner-kill", float(t)))
+    for kind, period in (
+        ("node-death", cfg.node_death_period_s),
+        ("cold-router-restart", cfg.cold_consumer_period_s),
+    ):
+        t = period
+        while period > 0.0 and t < cfg.duration_s:
+            incidents.append((kind, t))
+            t += period
+    incidents.sort(key=lambda x: (x[1], x[0]))
+    spans = [(t, t + 2 * window_s) for _f, t in incidents]
+    steady = [
+        lat for t, _s, lat in trace
+        if not any(lo <= t < hi for lo, hi in spans)
+    ]
+    phases = []
+    for fam, t in incidents:
+        win = [lat for tt, _s, lat in trace if t <= tt < t + window_s]
+        rec = [
+            lat for tt, _s, lat in trace
+            if t + window_s <= tt < t + 2 * window_s
+        ]
+        phases.append({
+            "family": fam,
+            "t": round(t, 3),
+            "window_s": window_s,
+            "incident": _prod_lat_summary(win),
+            "recovery": _prod_lat_summary(rec),
+        })
+    return {
+        "window_s": window_s,
+        "steady": _prod_lat_summary(steady),
+        "incidents": phases,
+        # The sentinel's settle guard: the WORST recovery window's p99.
+        "worst_recovery_p99_ms": max(
+            (
+                p["recovery"]["p99_ms"]
+                for p in phases
+                if "p99_ms" in p["recovery"]
+            ),
+            default=None,
+        ),
+    }
+
+
+def run_prod(args) -> int:
+    """--prod: the hour-scale "production day" recording (ISSUE 18),
+    written as SOAK_PROD_r18.json.  Three legs, one document:
+
+    1. determinism cross-check (2× virtual, full composition small):
+       bindings, timeline, admission order AND the driver-state digest
+       must replay bit for bit with every family armed at once;
+    2. kill/resume twins (virtual, subprocesses): a same-seed run is
+       SIGKILLed at a checkpoint BOUNDARY and again MID-INTERVAL, each
+       resumed from its checkpoint — both must match an uninterrupted
+       twin on every determinism digest;
+    3. the MAIN run (real pace, multi-process, --sustained seconds):
+       the full composition, checkpointing to a STABLE state dir under
+       --out-dir so a killed run continues with ``--prod --resume``.
+
+    Gates (stderr + rc 1, artifact still written): zero starvation
+    violations, every owner revive AND autoscale split served from the
+    warm pool (no cold fallbacks) with promotion latency well under the
+    ~15s cold-boot baseline, the split actually tripping, and every
+    scenario family active in the event ledger."""
+    import dataclasses
+
+    from kubernetes_tpu.loadgen.soak import run_fleet_soak, strip_private
+
+    cfg = prod_config(args)
+    shards = args.shards or 2
+    state = os.path.join(args.out_dir, "prod-state")
+    os.makedirs(state, exist_ok=True)
+    prechecks_path = os.path.join(state, "prechecks.json")
+
+    if args.resume and os.path.exists(prechecks_path):
+        # Resuming the main leg: the prechecks already passed for this
+        # config before the kill — reuse their recorded result rather
+        # than re-running legs the checkpoint does not cover.
+        with open(prechecks_path, encoding="utf-8") as f:
+            pre = json.load(f)
+        print(f"run_soak: --resume — prechecks reloaded from "
+              f"{prechecks_path}; continuing the main leg from its "
+              f"checkpoint…", flush=True)
+    else:
+        check_cfg = prod_small(cfg)
+        print("run_soak: production-day determinism cross-check (2× "
+              "virtual, all families armed)…", flush=True)
+        a = run_fleet_soak(check_cfg, shards)
+        b = run_fleet_soak(check_cfg, shards)
+        adm_a = a.get("admission") or {}
+        check = {
+            "seed": check_cfg.seed,
+            "runs": 2,
+            "arrival_schedule_identical": (
+                a["_arrival_offsets"] == b["_arrival_offsets"]
+            ),
+            "bindings_identical": (
+                a["determinism"]["bindings_sha256"]
+                == b["determinism"]["bindings_sha256"]
+            ),
+            "timeline_identical": (
+                a["determinism"]["timeline_sha256"] is not None
+                and a["determinism"]["timeline_sha256"]
+                == b["determinism"]["timeline_sha256"]
+            ),
+            "admission_order_identical": (
+                adm_a.get("admission_order_sha256") is not None
+                and adm_a.get("admission_order_sha256")
+                == (b.get("admission") or {}).get("admission_order_sha256")
+            ),
+            "driver_state_identical": (
+                a["determinism"]["driver_state_sha256"]
+                == b["determinism"]["driver_state_sha256"]
+            ),
+            "driver_state_sha256": a["determinism"]["driver_state_sha256"],
+            "bound_final": a["bound_final"],
+            "events": a.get("events") or {},
+        }
+        print(f"run_soak: {json.dumps(check)}", flush=True)
+        if not (
+            check["arrival_schedule_identical"]
+            and check["bindings_identical"]
+            and check["timeline_identical"]
+            and check["admission_order_identical"]
+            and check["driver_state_identical"]
+        ):
+            print("run_soak: PRODUCTION-DAY DETERMINISM CHECK FAILED",
+                  file=sys.stderr)
+            return 1
+
+        print("run_soak: kill/resume twins — checkpoint boundary + "
+              "mid-interval (virtual, subprocesses)…", flush=True)
+        twins = []
+        for name, every, kill_at in (
+            ("boundary", 40, 40),
+            ("mid-interval", 40, 57),
+        ):
+            t = _prod_resume_twin(args, cfg, shards, name, every, kill_at)
+            if t is None:
+                print("run_soak: PRODUCTION-DAY RESUME TWIN FAILED",
+                      file=sys.stderr)
+                return 1
+            print(f"run_soak: resume twin '{name}' — kill@op{kill_at}, "
+                  f"resumed from op {t['resume_op_index']} "
+                  f"(generation {t['checkpoint_generation']}), "
+                  f"bit-identical", flush=True)
+            twins.append(t)
+        pre = {"determinism_check": check, "resume_twin_check": twins}
+        with open(prechecks_path, "w", encoding="utf-8") as f:
+            json.dump(pre, f, sort_keys=True)
+            f.write("\n")
+
+    cfg_main = dataclasses.replace(
+        cfg,
+        out_dir=args.out_dir,
+        journal_dir=os.path.join(state, "journal"),
+        standby_dir=os.path.join(state, "standby"),
+        checkpoint_path=os.path.join(state, "soak.ckpt"),
+        resume=bool(args.resume),
+    )
+    print(
+        f"run_soak: PRODUCTION DAY — {shards} multi-process shards, seed "
+        f"{cfg_main.seed}, {cfg_main.rate_pods_per_s} pods/s diurnal "
+        f"(hetero mix, tenants {[t for t, _w in cfg_main.tenants]}) for "
+        f"{cfg_main.duration_s:.0f}s; admission + lifecycle + autoscale + "
+        f"standby pool ({cfg_main.standby_pool}) armed, checkpoint every "
+        f"{cfg_main.checkpoint_every_ops} ops → {cfg_main.checkpoint_path}"
+        + (" [RESUMING]" if cfg_main.resume else "")
+        + "…",
+        flush=True,
+    )
+    raw = run_fleet_soak(cfg_main, shards)
+    phases = prod_phases(raw, cfg_main, window_s=45.0)
+    artifact = strip_private(raw)
+
+    sb = artifact.get("standby") or {}
+    promos = sb.get("promotions") or []
+    reasons = sorted({p["reason"] for p in promos})
+    max_promo = max((p["latency_s"] for p in promos), default=None)
+    adm_status = (artifact.get("admission") or {}).get("status") or {}
+    t_status = adm_status.get("tenants") or {}
+    asc = artifact.get("autoscale") or {}
+    ev = artifact.get("events") or {}
+    families = {
+        "invalidations": sum(
+            v for k, v in ev.items() if k.startswith("inv_")
+        ),
+        "node_deaths": ev.get("node_death", 0),
+        "node_revives": ev.get("node_revive", 0),
+        "cold_router_restarts": ev.get("cold_consumer", 0),
+        "owner_kills": ev.get("owner_kill", 0),
+        "autoscale_ticks": ev.get("autoscale_tick", 0),
+        "throttle_hits": adm_status.get("throttle_hits", 0),
+    }
+    gates = {
+        "starvation_violations": adm_status.get("starvation_violations"),
+        "any_tenant_starved": any(
+            (v or {}).get("starved") for v in t_status.values()
+        ),
+        "zero_starvation": (
+            adm_status.get("starvation_violations") == 0
+            and not any((v or {}).get("starved") for v in t_status.values())
+        ),
+        "cap_engaged": bool(adm_status.get("throttle_hits")),
+        "promotions": len(promos),
+        "served_from_pool": sb.get("served_from_pool"),
+        "cold_fallbacks": sb.get("cold_fallbacks"),
+        "every_owner_from_pool": (
+            len(promos) > 0
+            and sb.get("cold_fallbacks") == 0
+            and sb.get("served_from_pool") == len(promos)
+        ),
+        "promotion_reasons": reasons,
+        "revive_and_split_from_pool": (
+            {"revive", "autoscale-split"} <= set(reasons)
+        ),
+        "max_promotion_latency_s": max_promo,
+        "cold_boot_baseline_s": PROD_COLD_BOOT_BASELINE_S,
+        "promotion_well_under_cold_boot": (
+            max_promo is not None
+            and max_promo < PROD_COLD_BOOT_BASELINE_S / 2.0
+        ),
+        "splits": asc.get("splits", 0),
+        "split_tripped": asc.get("splits", 0) >= 1,
+        "router_restarts": artifact.get("router_restarts"),
+        "owner_takeovers": artifact.get("owner_takeovers"),
+        "families_active": families,
+        "all_families_active": all(v > 0 for v in families.values()),
+    }
+    doc = {
+        **artifact,
+        "metric": "fleet_soak_production_day",
+        "incident_windows": phases,
+        "service_slo": prod_service_slo(artifact),
+        "production_gates": gates,
+        "determinism_check": pre["determinism_check"],
+        "resume_twin_check": pre["resume_twin_check"],
+        "environment": {
+            "backend": os.environ.get("JAX_PLATFORMS", ""),
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+        },
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"run_soak: wrote {args.out} — p50/p99 "
+        f"{artifact['slo']['p50_ms']}/{artifact['slo']['p99_ms']}ms over "
+        f"{artifact['decisions']} decisions in {artifact['wall_s']}s; "
+        f"{gates['promotions']} promotions from the pool "
+        f"({', '.join(reasons) or 'none'}; max {max_promo}s vs "
+        f"{PROD_COLD_BOOT_BASELINE_S}s cold boot), "
+        f"{gates['splits']} split(s), "
+        f"{gates['starvation_violations']} starvation violations, "
+        f"families {json.dumps(families)}",
+        flush=True,
+    )
+    rc = 0
+    if not gates["zero_starvation"]:
+        print("run_soak: PRODUCTION DAY: A TENANT STARVED", file=sys.stderr)
+        rc = 1
+    if not gates["every_owner_from_pool"]:
+        print("run_soak: PRODUCTION DAY: A PROMOTION FELL BACK TO COLD "
+              "SPAWN (or no promotion happened)", file=sys.stderr)
+        rc = 1
+    if not gates["revive_and_split_from_pool"]:
+        print("run_soak: PRODUCTION DAY: MISSING A PROMOTION REASON — "
+              f"saw {reasons}, need revive + autoscale-split",
+              file=sys.stderr)
+        rc = 1
+    if not gates["promotion_well_under_cold_boot"]:
+        print(f"run_soak: PRODUCTION DAY: PROMOTION LATENCY {max_promo}s "
+              f"NOT ≪ {PROD_COLD_BOOT_BASELINE_S}s", file=sys.stderr)
+        rc = 1
+    if not gates["split_tripped"]:
+        print("run_soak: PRODUCTION DAY: AUTOSCALER TRIPPED NO SPLIT",
+              file=sys.stderr)
+        rc = 1
+    if not gates["all_families_active"]:
+        print(f"run_soak: PRODUCTION DAY: A SCENARIO FAMILY NEVER FIRED — "
+              f"{json.dumps(families)}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--prod-child":
+        return _prod_child(sys.argv[2])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--shards", type=int, default=0,
                     help="soak the partitioned fleet with N shard owners "
@@ -1087,6 +1666,20 @@ def main() -> int:
                     "armed on the router queue, plus the armed "
                     "determinism and ≥1k-tenant hashed-tier legs, "
                     "recorded as SOAK_TENANT_r17.json")
+    ap.add_argument("--prod", action="store_true",
+                    help="the hour-scale 'production day' soak (ISSUE "
+                    "18): diurnal tenant-tagged hetero traffic under "
+                    "armed WFQ admission, node deaths, cold router "
+                    "restarts, adversarial invalidations, scripted "
+                    "owner kills revived from the WARM STANDBY POOL, "
+                    "and autoscale splits served from it too — with "
+                    "the resumable checkpointer armed, recorded as "
+                    f"{PROD_OUT_DEFAULT}")
+    ap.add_argument("--resume", action="store_true",
+                    help="--prod only: resume a killed production-day "
+                    "main leg from its checkpoint in "
+                    "<out-dir>/prod-state (bit-identical to an "
+                    "uninterrupted same-seed run)")
     ap.add_argument("--steady-rate", type=float, default=8.0,
                     help="tenant soak: the steady tenant's arrival rate")
     ap.add_argument("--bursty-rate", type=float, default=4.0,
@@ -1130,8 +1723,19 @@ def main() -> int:
     ap.add_argument("--scaling-seconds", type=float, default=45.0,
                     help="duration of each scaling-sweep point")
     args = ap.parse_args()
-    if (args.autoscale or args.tenant or args.tenant_fair) and not args.shards:
+    if (
+        args.autoscale or args.tenant or args.tenant_fair or args.prod
+    ) and not args.shards:
         args.shards = 2
+    if args.prod:
+        # Production-day calibration (only where the flag was left at
+        # its default): a 30-minute sustained window, and an offered
+        # rate whose 1.5× crest two multi-process shards sustain on
+        # this box WITH the admission cap clipping the dominant tenant.
+        if args.sustained == 180.0:
+            args.sustained = 1800.0
+        if args.rate == 24.0:
+            args.rate = 12.0
     if args.autoscale:
         # r11 calibration (only where the flag was left at its default):
         # offered load under the in-process ceiling so the tail is
@@ -1145,7 +1749,9 @@ def main() -> int:
         if args.snapshot_every == 24:
             args.snapshot_every = 8
     if not args.out:
-        if args.tenant_fair:
+        if args.prod:
+            args.out = PROD_OUT_DEFAULT
+        elif args.tenant_fair:
             args.out = "SOAK_TENANT_r17.json"
         elif args.tenant:
             args.out = "SOAK_TENANT_r12.json"
@@ -1164,6 +1770,8 @@ def main() -> int:
             "soak_dumps",
         )
 
+    if args.prod:
+        return run_prod(args)
     if args.tenant_fair:
         return run_tenant_fair(args)
     if args.tenant:

@@ -1,0 +1,27 @@
+"""Seeded standby-pool determinism violations: slot selection is
+replayed decision state — a pool that ages slots on wall clocks,
+scans them as a bare set or buckets claims with salted hash() promotes
+DIFFERENT children in the resumed run than the interrupted one did."""
+
+import time
+
+
+def slot_age(born_ts):
+    # POSITIVE det-wallclock: warm-age must come from the injected
+    # monotonic clock the pool records at spawn, never a wall read.
+    return time.time() - born_ts
+
+
+def oldest_slot(slot_ids):
+    # POSITIVE det-set-iteration: bare set iteration order is
+    # hash-randomized — two reopens would promote different "oldest"
+    # slots on equal ages; sorted(...) is the idiom.
+    for sid in {s for s in slot_ids}:
+        return sid
+
+
+def claim_bucket(slot_name, n):
+    # POSITIVE det-builtin-hash: PYTHONHASHSEED-salted claim bucketing
+    # would send racing owners to different slots per process; the
+    # fleet keys on zlib.crc32 (shardmap.stable_shard_hash).
+    return hash(slot_name) % n

@@ -1,0 +1,26 @@
+"""Seeded checkpoint-writer determinism violations: the checkpoint IS
+the resumed run's replay oracle — a digest stamped with wall time, a
+jittered cadence or an id()-keyed state map can never verify
+bit-identity against the uninterrupted twin."""
+
+import random
+from datetime import datetime
+
+
+def stamp_generation(generation):
+    # POSITIVE det-wallclock: a wall-clock stamp inside digest-covered
+    # state diverges every resume; timestamps belong in the obs half.
+    return {"generation": generation, "at": datetime.now()}
+
+
+def next_checkpoint_due(op_index, every):
+    # POSITIVE det-random: a jittered cadence moves the checkpoint
+    # boundary between runs — the kill matrix could never pin a cell
+    # to "exactly at generation N".
+    return op_index + every + int(random.random() * 4)
+
+
+def state_key(op):
+    # POSITIVE det-id-key: CPython addresses vary per process — a
+    # resumed run could never find the interrupted run's entry.
+    return id(op)

@@ -1,0 +1,19 @@
+"""Standby-pool determinism negative fixture: monotonic ages, sorted
+slot scans and crc32 bucketing (zero findings expected)."""
+
+import time
+import zlib
+
+
+def slot_age(born_mono):
+    # perf_counter/monotonic feed observability, never decisions.
+    return time.monotonic() - born_mono
+
+
+def oldest_slot(slot_ids):
+    for sid in sorted(slot_ids):
+        return sid
+
+
+def claim_bucket(slot_name, n):
+    return zlib.crc32(slot_name.encode()) % n

@@ -1,0 +1,15 @@
+"""Checkpoint-writer determinism negative fixture: logical stamps,
+fixed cadence, stable keys (zero findings expected)."""
+
+
+def stamp_generation(generation, virtual_clock):
+    # Digest-covered state carries the LOGICAL clock the driver feeds.
+    return {"generation": generation, "at": virtual_clock}
+
+
+def next_checkpoint_due(op_index, every):
+    return op_index + every
+
+
+def state_key(op):
+    return op["uid"]

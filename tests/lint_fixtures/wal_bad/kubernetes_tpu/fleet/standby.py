@@ -1,0 +1,22 @@
+"""Seeded standby-pool WAL violations: a promotion made live without
+its pool WAL record first is a warm child two owners can be handed
+after a crash (the claim file alone is not replayable intent)."""
+
+
+class BadPool:
+    def promote_without_journal(self, slot, shard_id):
+        # POSITIVE wal-unjournaled-apply: the slot flips to "promoted"
+        # with no pool-WAL append anywhere in scope — a reopen after a
+        # crash here re-offers the consumed slot.
+        self.finish_promotion(slot, shard_id)
+
+    def promote_apply_then_append(self, slot, shard_id, rec):
+        # POSITIVE wal-apply-before-journal: apply precedes the append —
+        # the exact window the standby kill-matrix cells crash into.
+        self.finish_promotion(slot, shard_id)
+        self.journal.append(rec)
+
+    def healthy_promote(self, slot, shard_id, rec):
+        # NEGATIVE: append-before-apply, the required shape.
+        self.journal.append(rec)
+        self.finish_promotion(slot, shard_id)

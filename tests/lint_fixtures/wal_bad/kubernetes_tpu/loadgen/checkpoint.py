@@ -1,0 +1,22 @@
+"""Seeded checkpoint-writer WAL violations: a generation made live
+(os.replace) without its journaled digest first leaves a resumed run
+nothing to verify bit-identity against."""
+
+
+class BadCheckpointer:
+    def publish_without_journal(self, tmp_path, generation):
+        # POSITIVE wal-unjournaled-apply: the generation goes live with
+        # no digest record in scope — resume cannot prove the prefix.
+        self.finish_checkpoint(tmp_path, generation)
+
+    def publish_apply_then_append(self, tmp_path, generation, rec):
+        # POSITIVE wal-apply-before-journal: the os.replace apply runs
+        # before the digest append — a crash between them publishes a
+        # checkpoint the journal never heard of.
+        self.finish_checkpoint(tmp_path, generation)
+        self._journal_append("checkpoint", **rec)
+
+    def healthy_publish(self, tmp_path, generation, rec):
+        # NEGATIVE: digest journaled first, then the atomic publish.
+        self._journal_append("checkpoint", **rec)
+        self.finish_checkpoint(tmp_path, generation)

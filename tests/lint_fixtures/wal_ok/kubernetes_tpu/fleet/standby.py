@@ -1,0 +1,11 @@
+"""Standby-pool WAL negative fixture: the promotion journals before it
+applies (tests/test_static_analysis.py expects zero findings)."""
+
+
+class GoodPool:
+    def promote(self, slot, shard_id, rec):
+        self.journal.append(rec)
+        self.finish_promotion(slot, shard_id)
+
+    def no_apply_sites(self, slots):
+        return [s for s in slots if s.warm]

@@ -1,0 +1,8 @@
+"""Checkpoint-writer WAL negative fixture: digest journaled first,
+then the atomic publish (zero findings expected)."""
+
+
+class GoodCheckpointer:
+    def publish(self, tmp_path, generation, rec):
+        self._journal_append("checkpoint", **rec)
+        self.finish_checkpoint(tmp_path, generation)

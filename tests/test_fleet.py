@@ -1172,3 +1172,188 @@ def test_wire_owner_deadline_retry_and_unreachable(tmp_path):
         wire.close()
     finally:
         srv.close()
+
+
+# -- the warm-standby owner pool (ISSUE 18) ---------------------------------
+
+from kubernetes_tpu.fleet.standby import (  # noqa: E402
+    JOURNAL_NAME,
+    StandbyPool,
+    StandbyServe,
+)
+
+
+def slot_factory(log=None):
+    """A pool factory whose payload records its slot id (and carries a
+    real warm scheduler, so a promoted payload is immediately usable)."""
+
+    def factory(slot_id: int):
+        if log is not None:
+            log.append(slot_id)
+        return {"slot": slot_id, "sched": mk_sched()}
+
+    return factory
+
+
+def test_standby_promotes_oldest_slot_and_refills(tmp_path):
+    sd = str(tmp_path / "pool")
+    pool = StandbyPool(sd, slot_factory(), size=2)
+    assert pool.status()["pool_size"] == 2
+    payload = pool.promote(1, "takeover")
+    # Oldest warm slot first, claim file written, pool topped back up
+    # BEHIND the promotion (the next incident finds it full again).
+    assert payload["slot"] == 0
+    assert os.path.exists(os.path.join(sd, "slot-0.claim"))
+    assert pool.status()["pool_size"] == 2
+    assert pool.status()["promotions"] == {"takeover": 1}
+    # The promoted payload is a live scheduler: it can own a shard and
+    # bind immediately — that is what "warm" means.
+    owner = ShardOwner(0, payload["sched"], ShardMap(n_shards=1))
+    owner.sched.add_node(big_node("sb-n1"))
+    owner.sched.add_pod(make_pod("sb-p1").req({"cpu": "1"}).obj())
+    out = owner.sched.schedule_all_pending(wait_backoff=True)
+    assert [o.node_name for o in out if o.pod.name == "sb-p1"] == ["sb-n1"]
+
+
+def test_standby_stale_schema_never_promoted_evicted_instead(tmp_path):
+    retired = []
+    pool = StandbyPool(
+        str(tmp_path / "pool"),
+        slot_factory(),
+        size=2,
+        schema_version=1,
+        retire=lambda payload: retired.append(payload["slot"]),
+    )
+    # The live featurization schema moves on while the pool hasn't
+    # synced yet: every warm slot is stale, promote must MISS (a stale
+    # XLA cache would recompile mid-incident — the exact cost the pool
+    # pre-pays), never hand one out.
+    pool.schema_version = 2
+    assert pool.promote(0, "takeover") is None
+    assert pool.misses == 1
+    # sync_schema retires + respawns: the stale slots exit via eviction
+    # only, and the respawned slots (new ids, live schema) promote.
+    pool.schema_version = 1
+    assert pool.sync_schema(2) == 2
+    assert retired == [0, 1]
+    assert pool.stale_evictions == 2
+    payload = pool.promote(0, "revive")
+    assert payload is not None and payload["slot"] >= 2
+    assert pool.status()["schema_stale_evictions"] == 2
+
+
+def test_standby_claim_race_loser_skips_to_next_slot(tmp_path):
+    pool = StandbyPool(str(tmp_path / "pool"), slot_factory(), size=2)
+    # Another promoter (a racing router over the same state_dir) wins
+    # slot 0's O_EXCL claim first; this promoter must skip to slot 1,
+    # never double-offer the claimed one.
+    assert pool._try_claim(0)
+    payload = pool.promote(3, "takeover")
+    assert payload["slot"] == 1
+    assert any(s.state == "claimed-elsewhere" for s in pool.slots)
+
+
+def test_standby_wal_replay_never_reoffers_consumed_slots(tmp_path):
+    sd = str(tmp_path / "pool")
+    pool = StandbyPool(sd, slot_factory(), size=2)
+    assert pool.promote(1, "takeover")["slot"] == 0
+    pool.close()
+    # Reopen (a restarted router): the WAL says slot 0 was consumed and
+    # ids 0-2 were spawned — the new incarnation spawns FRESH ids only
+    # and still remembers the promotion ledger.
+    reopened = StandbyPool(sd, slot_factory(), size=2)
+    assert {s.slot_id for s in reopened.idle()}.isdisjoint({0, 1, 2})
+    assert reopened.promotions == {"takeover": 1}
+    assert reopened.promote(0, "revive")["slot"] >= 3
+
+
+def test_standby_orphan_claim_is_conservatively_consumed(tmp_path):
+    sd = str(tmp_path / "pool")
+    pool = StandbyPool(sd, slot_factory(), size=1)
+    # A promotion that died between the claim and the WAL append leaves
+    # only the claim file behind (the standby-pre-claim/-mid-promotion
+    # kill window).  Reopen must treat the id as consumed.
+    assert pool._try_claim(0)
+    pool.close()
+    reopened = StandbyPool(sd, slot_factory(), size=1)
+    assert all(s.slot_id != 0 for s in reopened.slots)
+    assert reopened.promote(0, "takeover")["slot"] != 0
+
+
+def test_standby_wal_tolerates_torn_tail(tmp_path):
+    sd = str(tmp_path / "pool")
+    pool = StandbyPool(sd, slot_factory(), size=1)
+    pool.promote(1, "takeover")
+    pool.close()
+    # SIGKILL mid-append tears the last record: the complete prefix
+    # stands, the torn line is dropped, reopen still never re-offers.
+    with open(os.path.join(sd, JOURNAL_NAME), "a", encoding="utf-8") as f:
+        f.write('{"op": "promote", "slot": 1, "rea')
+    reopened = StandbyPool(sd, slot_factory(), size=1)
+    assert reopened.promotions == {"takeover": 1}
+    assert all(s.slot_id not in (0,) for s in reopened.idle())
+
+
+def test_standby_mirror_is_atomic_and_current(tmp_path):
+    import json as _json
+
+    sd = str(tmp_path / "pool")
+    pool = StandbyPool(sd, slot_factory(), size=2)
+    pool.promote(1, "takeover")
+    with open(os.path.join(sd, "standby.json"), encoding="utf-8") as f:
+        mirror = _json.load(f)
+    # `fleet status --sockets` renders THIS file without touching the
+    # pool: it must match live status (modulo the monotonic ages).
+    live = pool.status()
+    for doc in (mirror, live):
+        for s in doc["slots"]:
+            s.pop("warm_age_s", None)
+    assert mirror == live
+    assert mirror["promotions_total"] == 1
+
+
+def test_standby_serve_adopts_via_dispatch(tmp_path):
+    sched = mk_sched()
+    serve = StandbyServe(sched, schema_version=7)
+    st = serve.standby_dispatch("standby_status", {})
+    assert st["standby"] is True and st["schema_version"] == 7
+    # Pre-adoption, real fleet ops are refused — the child owns nothing.
+    with pytest.raises(ValueError):
+        serve.standby_dispatch("stats", {})
+    res = serve.standby_dispatch(
+        "adopt_shard",
+        {
+            "shard_id": 1,
+            "map": {"buckets": ShardMap(n_shards=2).buckets},
+            "journal_dir": str(tmp_path / "journal"),
+        },
+    )
+    assert res["adopted"] == 1 and res["already"] is False
+    # Post-adoption the SAME dispatch surface flips to the real owner.
+    st = serve.standby_dispatch("standby_status", {})
+    assert st["standby"] is False and st["adopted_shard"] == 1
+    again = serve.standby_dispatch("adopt_shard", {"shard_id": 1})
+    assert again["already"] is True
+
+
+def test_standby_serve_preadoption_preempt_is_eval_only(tmp_path):
+    sched = mk_sched()
+    sched.add_node(
+        make_node("pe-n1").capacity({"cpu": "1", "pods": 110}).obj()
+    )
+    sched.add_pod(
+        make_pod("pe-victim").req({"cpu": "1"}).priority(1).node("pe-n1").obj()
+    )
+    serve = StandbyServe(sched)
+    from kubernetes_tpu.api import serialize
+
+    contender = serialize.to_dict(
+        make_pod("pe-contender").req({"cpu": "1"}).priority(100).obj()
+    )
+    res = serve.standby_dispatch("preempt_propose", {"pod": contender})
+    # Dry run only: whatever the proposal says, NOTHING was deleted or
+    # nominated — the child is still parked and unadopted, the victim
+    # still bound.
+    assert isinstance(res, dict)
+    assert serve.owner is None
+    assert "default/pe-victim" in sched.cache.pods

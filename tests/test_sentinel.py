@@ -40,6 +40,7 @@ def test_committed_trajectory_passes_every_guard():
     assert {g["name"] for g in block["guards"]} == {
         "headline", "flagship", "journal_fsyncs", "overlap_coverage",
         "slo_p99", "obs_tax", "fair_steady_p99", "fair_starvation",
+        "prod_service_p99", "prod_recovery_p99", "prod_promotion_max",
     }
 
 

@@ -95,10 +95,14 @@ def test_wal_rules_fire_on_seeded_violations():
     # pipeline-drain fixture (a staged commit group applied before —
     # or without — its group's journal records, ISSUE 15) + one of each
     # in the fairness-ledger fixture (a WFQ debit batch applied before
-    # — or without — its ``admission`` record, ISSUE 17).
-    assert got.count("wal-apply-before-journal") == 7
-    assert got.count("wal-unjournaled-apply") == 7
-    assert len(got) == 14, got  # the healthy shapes stay silent
+    # — or without — its ``admission`` record, ISSUE 17) + one of each
+    # in the standby-pool fixture (a promotion made live before — or
+    # without — its pool WAL record, ISSUE 18) + one of each in the
+    # checkpoint-writer fixture (a generation published before — or
+    # without — its journaled digest, ISSUE 18).
+    assert got.count("wal-apply-before-journal") == 9
+    assert got.count("wal-unjournaled-apply") == 9
+    assert len(got) == 18, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
@@ -128,6 +132,21 @@ def test_wal_rules_cover_the_fairness_ledger():
     # ISSUE 17 — the WAL family must reach framework/fairness.py.
     paths = {f.path for f in lint("wal_bad").findings}
     assert "kubernetes_tpu/framework/fairness.py" in paths
+
+
+def test_wal_rules_cover_standby_promotion():
+    # The warm-standby pool's finish_promotion apply (ISSUE 18) — a
+    # slot consumed without its WAL record is re-offered after a crash.
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/fleet/standby.py" in paths
+
+
+def test_wal_rules_cover_the_checkpoint_writer():
+    # The soak checkpointer's finish_checkpoint apply (ISSUE 18) — a
+    # generation published before its digest record leaves resume
+    # nothing to verify bit-identity against.
+    paths = {f.path for f in lint("wal_bad").findings}
+    assert "kubernetes_tpu/loadgen/checkpoint.py" in paths
 
 
 def test_wal_negative_tree_is_clean():
@@ -160,16 +179,21 @@ def test_det_rules_fire_on_seeded_violations():
     # framework/fairness.py (ISSUE 17) seeds a wallclock credit refill,
     # a random tie-break, a bare-set tenant scan and a salted-hash
     # overflow bucket — the replayed-admission-order surface.
-    assert got.count("det-wallclock") == 9
-    assert got.count("det-random") == 6  # + gauss jitter in the weight loader
-    assert got.count("det-set-iteration") == 8  # for-loops + list(set(...))
-    assert got.count("det-id-key") == 1
+    # fleet/badstandby.py + loadgen/badcheckpoint.py (ISSUE 18) seed a
+    # wallclock slot age, a wallclock generation stamp, a bare-set
+    # oldest-slot scan, a salted-hash claim bucket, a jittered
+    # checkpoint cadence and an id()-keyed replay map — the warm-standby
+    # selection and resume-oracle surfaces.
+    assert got.count("det-wallclock") == 11
+    assert got.count("det-random") == 7  # + gauss jitter in the weight loader
+    assert got.count("det-set-iteration") == 9  # for-loops + list(set(...))
+    assert got.count("det-id-key") == 2
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
     # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14) + commit-group
     # slotting (ISSUE 15) + tenant overflow bucketing (ISSUE 17):
     # builtin hash() assigns different owners / slices / rows / groups /
-    # buckets per process.
-    assert got.count("det-builtin-hash") == 5
+    # buckets per process + standby claim bucketing (ISSUE 18).
+    assert got.count("det-builtin-hash") == 6
 
 
 def test_det_rules_cover_loadgen():
@@ -210,6 +234,14 @@ def test_det_rules_cover_the_admission_policy():
     # state (ISSUE 17) — the explicit-rel list must reach it.
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/framework/fairness.py" in paths
+
+
+def test_det_rules_cover_standby_and_checkpoint():
+    # Slot selection and the checkpoint digest are replayed decision
+    # state (ISSUE 18) — the fleet/ and loadgen/ walks must reach both.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/fleet/badstandby.py" in paths
+    assert "kubernetes_tpu/loadgen/badcheckpoint.py" in paths
 
 
 def test_det_negative_tree_is_clean():
