@@ -10,7 +10,16 @@ invariants (see each module's docstring for the full contract):
 - ``metrics`` — namespace prefix, single registration, consistent label
                 schema per family (:mod:`.rules_metrics`);
 - ``wire``    — proto ↔ server handler ↔ client method exhaustiveness
-                (:mod:`.rules_wire`).
+                (:mod:`.rules_wire`);
+- ``jax``     — device discipline for the compiled pass: no host syncs,
+                retraces, or donated-buffer reuse inside jit, and
+                partition-exactness registry enforcement
+                (:mod:`.rules_jax`).
+
+The ``wal`` and ``jax`` families are *flow-aware*: they run on
+:mod:`.flow`'s intra-function CFGs plus a cross-file call graph with
+per-function summaries, so invariants are proven interprocedurally (a
+helper that journals on every path counts wherever it is called).
 
 Run via ``scripts/check_lint.py`` (tier-1 hooks it through
 ``tests/test_static_analysis.py``, the same pattern as
@@ -31,5 +40,18 @@ from .core import (  # noqa: F401
     default_rules,
     load_baseline,
     run_lint,
+)
+from .core import (  # noqa: F401
+    ParseCache,
+    Pragma,
+    collect_pragmas,
+    rule_docs,
+)
+from .flow import (  # noqa: F401
+    FlowIndex,
+    FuncUnit,
+    build_cfg,
+    must_facts,
+    reads_after,
 )
 from .rules_metrics import collect_catalog  # noqa: F401

@@ -34,7 +34,10 @@ root (which pulls JAX).
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
+import os
+import pickle
 import re
 from dataclasses import dataclass, field
 
@@ -46,6 +49,13 @@ class Finding:
     line: int
     message: str
     key: str  # stable baseline key: "<rule>::<path>::<token>"
+    # Extra (path, line) sites whose suppressions also silence this
+    # finding.  The flow-aware WAL rules report an interprocedural chain
+    # at its outermost frontier, but a pragma at any hop of the chain —
+    # e.g. the terminal apply site a recovery path deliberately leaves
+    # unjournaled — still covers it: the suppression documents the site,
+    # wherever the chain is reported from.
+    also: tuple = ()
 
     def as_dict(self) -> dict:
         return {
@@ -108,24 +118,78 @@ def _rules_match(names: str, rule: str) -> bool:
     return False
 
 
+@dataclass(frozen=True)
+class Pragma:
+    """One suppression comment, addressable so the runner can prove it
+    still matches something.  A pragma no unsuppressed finding needs is
+    dead weight that hides future regressions — ``run_lint`` reports it
+    in ``LintResult.unused_suppressions`` and the runner exits 2."""
+
+    path: str
+    line: int  # lineno of the comment (file-level pragmas too)
+    names: str
+    file_level: bool
+
+    def render(self) -> str:
+        kind = "disable-file" if self.file_level else "disable"
+        return f"{self.path}:{self.line}: tpulint: {kind}={self.names}"
+
+
+def collect_pragmas(ctx: FileCtx) -> list[Pragma]:
+    out: list[Pragma] = []
+    for i, text in enumerate(ctx.lines[:5], start=1):
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            out.append(Pragma(ctx.path, i, m.group(1), True))
+    for i, text in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out.append(Pragma(ctx.path, i, m.group(1), False))
+    return out
+
+
+def _match_pragma(
+    finding: Finding,
+    ctxs: dict[str, FileCtx],
+    pragmas: dict[str, list[Pragma]],
+) -> Pragma | None:
+    """The pragma (if any) that silences ``finding``, checking the
+    finding's own site first and then every chain hop in ``also``."""
+    sites = [(finding.path, finding.line)] + [tuple(s) for s in finding.also]
+    for path, line in sites:
+        ctx = ctxs.get(path)
+        plist = pragmas.get(path)
+        if ctx is None or not plist:
+            continue
+        for p in plist:
+            if p.file_level and _rules_match(p.names, finding.rule):
+                return p
+        for lineno in (line, line - 1):
+            if not 1 <= lineno <= len(ctx.lines):
+                continue
+            text = ctx.lines[lineno - 1]
+            # a pragma on the line above must be a standalone comment
+            if lineno != line and not text.lstrip().startswith("#"):
+                continue
+            for p in plist:
+                if (
+                    not p.file_level
+                    and p.line == lineno
+                    and _rules_match(p.names, finding.rule)
+                ):
+                    return p
+    return None
+
+
 def is_suppressed(finding: Finding, ctx: FileCtx | None) -> bool:
+    """Single-file compatibility wrapper over :func:`_match_pragma`
+    (chain hops in other files are not visible here)."""
     if ctx is None:
         return False
-    # File-level pragma in the header.
-    for line in ctx.lines[:5]:
-        m = _SUPPRESS_FILE_RE.search(line)
-        if m and _rules_match(m.group(1), finding.rule):
-            return True
-    # Same line, or a standalone comment on the line above.
-    for lineno in (finding.line, finding.line - 1):
-        if 1 <= lineno <= len(ctx.lines):
-            text = ctx.lines[lineno - 1]
-            if lineno != finding.line and not text.lstrip().startswith("#"):
-                continue
-            m = _SUPPRESS_RE.search(text)
-            if m and _rules_match(m.group(1), finding.rule):
-                return True
-    return False
+    return (
+        _match_pragma(finding, {ctx.path: ctx}, {ctx.path: collect_pragmas(ctx)})
+        is not None
+    )
 
 
 # -- baseline ---------------------------------------------------------------
@@ -173,6 +237,10 @@ class LintResult:
     suppressed: int
     baselined: int
     stale_baseline: list[str]  # baseline keys no rule produced
+    # pragmas that silenced nothing this run (rendered "path:line: ...").
+    # Like stale baseline keys, these are exit-2 material in a full run:
+    # the suppression surface may only shrink.
+    unused_suppressions: list[str] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -184,24 +252,84 @@ class LintResult:
             "suppressed": self.suppressed,
             "baselined": self.baselined,
             "stale_baseline": self.stale_baseline,
+            "unused_suppressions": self.unused_suppressions,
             "clean": self.clean,
         }
 
 
 def default_rules() -> list[Rule]:
     from .rules_determinism import DeterminismRule
+    from .rules_jax import JaxRule
     from .rules_metrics import MetricsRule
     from .rules_wal import WalRule
     from .rules_wire import WireRule
 
-    return [WalRule(), DeterminismRule(), MetricsRule(), WireRule()]
+    return [WalRule(), DeterminismRule(), MetricsRule(), WireRule(), JaxRule()]
 
 
-def run_lint(root, rules=None, baseline=None) -> LintResult:
+def rule_docs() -> dict[str, dict]:
+    """``rule id → doc dict`` collected from every rules module's DOCS
+    (the check_lint --explain / --rule-catalog surface).  Collected
+    lazily so importing core stays cheap, and asserted complete: a rule
+    module that grows a finding without documenting it fails loudly in
+    the catalog tests rather than silently shipping an unexplainable
+    finding."""
+    from . import rules_determinism, rules_jax, rules_metrics, rules_wal, rules_wire
+
+    docs: dict[str, dict] = {}
+    for mod in (rules_wal, rules_determinism, rules_metrics, rules_wire, rules_jax):
+        for rule_id, doc in mod.DOCS.items():
+            if rule_id in docs:
+                raise ValueError(f"duplicate rule doc: {rule_id}")
+            docs[rule_id] = doc
+    return docs
+
+
+class ParseCache:
+    """Parse trees keyed by content hash, pickled under ``cache_dir``.
+
+    Best-effort on both ends: a missing/corrupt entry re-parses, a
+    failed store is ignored.  Keyed purely by source bytes, so a stale
+    entry is impossible — edits change the key."""
+
+    def __init__(self, cache_dir: str):
+        self.dir = cache_dir
+        self.hits = 0
+        self.misses = 0
+
+    def _slot(self, source: str) -> str:
+        digest = hashlib.sha256(source.encode("utf-8")).hexdigest()
+        return os.path.join(self.dir, f"{digest}.ast.pkl")
+
+    def load(self, source: str) -> ast.Module | None:
+        try:
+            with open(self._slot(source), "rb") as f:
+                tree = pickle.load(f)
+        except Exception:
+            self.misses += 1
+            return None
+        if not isinstance(tree, ast.Module):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return tree
+
+    def store(self, source: str, tree: ast.Module) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            slot = self._slot(source)
+            tmp = slot + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, slot)
+        except Exception:
+            pass
+
+
+def run_lint(root, rules=None, baseline=None, cache=None) -> LintResult:
     """Run ``rules`` (default: all four families) over the tree at
-    ``root``.  ``baseline`` is a key → entry dict (see load_baseline)."""
-    import os
-
+    ``root``.  ``baseline`` is a key → entry dict (see load_baseline);
+    ``cache`` an optional :class:`ParseCache`."""
     rules = default_rules() if rules is None else rules
     baseline = baseline or {}
     ctxs: dict[str, FileCtx] = {}
@@ -216,19 +344,23 @@ def run_lint(root, rules=None, baseline=None) -> LintResult:
                 with open(full, "r", encoding="utf-8") as f:
                     src = f.read()
                 if rel.endswith(".py"):
-                    try:
-                        tree = ast.parse(src, filename=rel)
-                    except SyntaxError as exc:
-                        findings.append(
-                            Finding(
-                                rule="parse-error",
-                                path=rel,
-                                line=exc.lineno or 1,
-                                message=f"unparseable: {exc.msg}",
-                                key=make_key("parse-error", rel, "syntax"),
+                    tree = cache.load(src) if cache is not None else None
+                    if tree is None:
+                        try:
+                            tree = ast.parse(src, filename=rel)
+                        except SyntaxError as exc:
+                            findings.append(
+                                Finding(
+                                    rule="parse-error",
+                                    path=rel,
+                                    line=exc.lineno or 1,
+                                    message=f"unparseable: {exc.msg}",
+                                    key=make_key("parse-error", rel, "syntax"),
+                                )
                             )
-                        )
-                        continue
+                            continue
+                        if cache is not None:
+                            cache.store(src, tree)
                 else:
                     tree = ast.Module(body=[], type_ignores=[])
                 ctxs[rel] = FileCtx(path=rel, source=src, tree=tree)
@@ -236,13 +368,17 @@ def run_lint(root, rules=None, baseline=None) -> LintResult:
                 scoped[rel] = ctxs[rel]
         findings.extend(rule.run(scoped, root))
 
+    pragmas = {path: collect_pragmas(ctx) for path, ctx in ctxs.items()}
+    used: set[tuple[str, int]] = set()
     kept: list[Finding] = []
     suppressed = 0
     baselined = 0
     seen_keys: set[str] = set()
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
         seen_keys.add(f.key)
-        if is_suppressed(f, ctxs.get(f.path)):
+        pragma = _match_pragma(f, ctxs, pragmas)
+        if pragma is not None:
+            used.add((pragma.path, pragma.line))
             suppressed += 1
             continue
         if f.key in baseline:
@@ -250,11 +386,18 @@ def run_lint(root, rules=None, baseline=None) -> LintResult:
             continue
         kept.append(f)
     stale = sorted(k for k in baseline if k not in seen_keys)
+    unused = sorted(
+        p.render()
+        for plist in pragmas.values()
+        for p in plist
+        if (p.path, p.line) not in used
+    )
     return LintResult(
         findings=kept,
         suppressed=suppressed,
         baselined=baselined,
         stale_baseline=stale,
+        unused_suppressions=unused,
     )
 
 

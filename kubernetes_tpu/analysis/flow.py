@@ -1,0 +1,684 @@
+"""Flow engine for tpulint: CFGs, a call graph, and dataflow summaries.
+
+PR 4's rule families matched per-function syntax: a journal append and an
+apply marker were compared by line number *inside one function*, so any
+helper indirection — journaling via ``_stage()`` or applying via a
+wrapper — either false-positived (forcing a suppression) or vanished
+behind the ``APPLY_MARKERS`` exemption (callers of a marker-named helper
+were only checked one level up).  This module closes that blind spot:
+
+- :func:`build_cfg` lowers a function body to a statement-granularity
+  control-flow graph (``If``/``While``/``For``/``Try``/``With``/``Match``,
+  ``return``/``raise``/``break``/``continue``);
+- :class:`FlowIndex` indexes every function in a set of files, resolves
+  call sites to definitions, and maintains the reverse (caller) edges;
+- :func:`must_facts` runs a forward *must* analysis over a CFG (join is
+  set intersection), answering "which facts definitely hold before this
+  call site" — the primitive behind "journals before applying" and
+  "fsyncs before publishing";
+- :func:`all_paths_summary` lifts that to a bottom-up interprocedural
+  fixpoint: "does this function establish fact F on every normal return
+  path", counting both direct events and calls to functions already
+  summarized as establishing F;
+- :func:`reads_after` is the forward *may* query used by
+  ``jax-donation-reuse`` (is a name read on some path after a call,
+  before being rebound).
+
+Deliberate approximations (all biased toward the cheap side for a lint,
+and documented where a rule depends on them):
+
+- Call ordering inside one statement is positional ``(lineno, col)``,
+  not evaluation order; the commit paths never interleave a journal and
+  an apply in a single expression.
+- ``try`` bodies conservatively edge into every handler from every body
+  block (an exception can fire anywhere), which can only *shrink* the
+  must-set — safe for dominance proofs.
+- Calls under short-circuit operators and inside comprehensions count as
+  events even though they may execute zero times.
+- ``for`` bodies are assumed to run at least once (the drain idiom
+  journals a batch in one loop, applies it in the next; an empty batch
+  applies nothing either); ``while`` bodies keep strict zero-iteration
+  semantics.
+- Paths that end in ``raise`` are not "normal returns": a commit helper
+  that aborts by raising never reaches its caller's apply site.
+- Code made unreachable by ``return``/``raise`` is skipped when sampling
+  fact sets (dead code cannot violate an ordering discipline at runtime).
+
+Stdlib-only, like the rest of the package: the runner loads this without
+importing the JAX-pulling package root.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import FileCtx, dotted_name, walk_functions
+
+# --------------------------------------------------------------------------
+# payload wrappers
+#
+# Block payloads hold either plain (simple) statements or one of these
+# wrappers for the executable head of a compound statement, so rules can
+# attach events to branch tests and ``with`` headers.
+
+
+class BranchTest:
+    """Executable head of an ``if``/``while``/``for``/``match``.
+
+    Sits in the block *before* the branch, so a fact attached to it (the
+    journal-handle guard heuristic in rules_wal) is visible on every
+    outgoing edge.
+    """
+
+    __slots__ = ("node", "exprs")
+
+    def __init__(self, node: ast.stmt, exprs: Sequence[ast.expr]):
+        self.node = node
+        self.exprs = list(exprs)
+
+
+class WithHeader:
+    """Context-manager expressions of a ``with`` statement."""
+
+    __slots__ = ("node", "exprs")
+
+    def __init__(self, node: ast.stmt):
+        self.node = node
+        self.exprs = [item.context_expr for item in node.items]
+
+
+PayloadItem = object  # ast.stmt | BranchTest | WithHeader
+
+
+def iter_calls(item: PayloadItem) -> List[ast.Call]:
+    """``ast.Call`` nodes executed by a payload item, in source order.
+
+    Bodies of nested function/class definitions and lambdas are skipped —
+    they do not run where they appear (their decorators and argument
+    defaults do).
+    """
+    roots: List[ast.AST]
+    if isinstance(item, (BranchTest, WithHeader)):
+        roots = list(item.exprs)
+    else:
+        roots = [item]
+    out: List[ast.Call] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            for dec in n.decorator_list:
+                visit(dec)
+            args = getattr(n, "args", None)
+            if args is not None:
+                for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+                    visit(d)
+            return
+        if isinstance(n, ast.Lambda):
+            return
+        if isinstance(n, ast.Call):
+            out.append(n)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    for r in roots:
+        visit(r)
+    return sorted(out, key=lambda c: (c.lineno, c.col_offset))
+
+
+# --------------------------------------------------------------------------
+# CFG
+
+
+@dataclass
+class Block:
+    bid: int
+    payload: List[PayloadItem] = field(default_factory=list)
+    succs: List[int] = field(default_factory=list)
+
+
+@dataclass
+class CFG:
+    blocks: List[Block]
+    entry: int
+    exits: List[int]  # blocks that end in ``return`` or fall off the end
+
+    def preds(self) -> Dict[int, List[int]]:
+        p: Dict[int, List[int]] = {b.bid: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                p[s].append(b.bid)
+        return p
+
+    def payload_items(self) -> Iterator[PayloadItem]:
+        for b in self.blocks:
+            yield from b.payload
+
+    def calls(self) -> List[ast.Call]:
+        out: List[ast.Call] = []
+        for item in self.payload_items():
+            out.extend(iter_calls(item))
+        return out
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.blocks: List[Block] = []
+        self.exits: List[int] = []
+        # (loop header bid, loop after bid) for break/continue targets
+        self.loops: List[Tuple[int, int]] = []
+
+    def new(self) -> int:
+        b = Block(bid=len(self.blocks))
+        self.blocks.append(b)
+        return b.bid
+
+    def edge(self, a: int, b: int) -> None:
+        if b not in self.blocks[a].succs:
+            self.blocks[a].succs.append(b)
+
+    def seq(self, stmts: Sequence[ast.stmt], cur: Optional[int]) -> Optional[int]:
+        for s in stmts:
+            if cur is None:
+                # unreachable tail — keep a block (no preds ⇒ never sampled)
+                cur = self.new()
+            cur = self.stmt(s, cur)
+        return cur
+
+    def stmt(self, s: ast.stmt, cur: int) -> Optional[int]:
+        if isinstance(s, ast.If):
+            self.blocks[cur].payload.append(BranchTest(s, [s.test]))
+            body_entry = self.new()
+            self.edge(cur, body_entry)
+            body_exit = self.seq(s.body, body_entry)
+            if s.orelse:
+                else_entry = self.new()
+                self.edge(cur, else_entry)
+                else_exit = self.seq(s.orelse, else_entry)
+            else:
+                else_exit = cur
+            if body_exit is None and else_exit is None:
+                return None
+            after = self.new()
+            if body_exit is not None:
+                self.edge(body_exit, after)
+            if else_exit is not None:
+                self.edge(else_exit, after)
+            return after
+
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            header = self.new()
+            self.edge(cur, header)
+            head_exprs = [s.test] if isinstance(s, ast.While) else [s.iter]
+            self.blocks[header].payload.append(BranchTest(s, head_exprs))
+            after = self.new()
+            self.loops.append((header, after))
+            body_entry = self.new()
+            self.edge(header, body_entry)
+            body_exit = self.seq(s.body, body_entry)
+            if body_exit is not None:
+                self.edge(body_exit, header)
+            self.loops.pop()
+            # ``for`` bodies count as executing at least once: the drain
+            # idiom journals a batch in one loop and applies it in the
+            # next, and a zero-iteration drain applies nothing either —
+            # strict must-analysis would flag every batched journal.
+            # ``while`` keeps strict (zero-iteration) semantics.
+            at_least_once = (
+                isinstance(s, (ast.For, ast.AsyncFor))
+                and not s.orelse
+                and body_exit is not None
+            )
+            if s.orelse:
+                else_entry = self.new()
+                self.edge(header, else_entry)
+                else_exit = self.seq(s.orelse, else_entry)
+                if else_exit is not None:
+                    self.edge(else_exit, after)
+            elif at_least_once:
+                self.edge(body_exit, after)
+            else:
+                self.edge(header, after)
+            return after
+
+        if isinstance(s, ast.Try):
+            body_entry = self.new()
+            self.edge(cur, body_entry)
+            lo = body_entry
+            body_exit = self.seq(s.body, body_entry)
+            hi = len(self.blocks)
+            if s.orelse and body_exit is not None:
+                oe = self.new()
+                self.edge(body_exit, oe)
+                body_exit = self.seq(s.orelse, oe)
+            tails: List[int] = [] if body_exit is None else [body_exit]
+            for h in s.handlers:
+                he = self.new()
+                # an exception can fire before or anywhere inside the body
+                self.edge(cur, he)
+                for bid in range(lo, hi):
+                    self.edge(bid, he)
+                hx = self.seq(h.body, he)
+                if hx is not None:
+                    tails.append(hx)
+            if s.finalbody:
+                fin = self.new()
+                for t in tails:
+                    self.edge(t, fin)
+                return self.seq(s.finalbody, fin)
+            if not tails:
+                return None
+            join = self.new()
+            for t in tails:
+                self.edge(t, join)
+            return join
+
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            self.blocks[cur].payload.append(WithHeader(s))
+            return self.seq(s.body, cur)
+
+        if isinstance(s, ast.Match):
+            self.blocks[cur].payload.append(BranchTest(s, [s.subject]))
+            after = self.new()
+            self.edge(cur, after)  # no case may match
+            for case in s.cases:
+                ce = self.new()
+                self.edge(cur, ce)
+                cx = self.seq(case.body, ce)
+                if cx is not None:
+                    self.edge(cx, after)
+            return after
+
+        if isinstance(s, ast.Return):
+            self.blocks[cur].payload.append(s)
+            self.exits.append(cur)
+            return None
+
+        if isinstance(s, ast.Raise):
+            self.blocks[cur].payload.append(s)
+            return None  # aborting path: not a normal return
+
+        if isinstance(s, ast.Break):
+            if self.loops:
+                self.edge(cur, self.loops[-1][1])
+            return None
+
+        if isinstance(s, ast.Continue):
+            if self.loops:
+                self.edge(cur, self.loops[-1][0])
+            return None
+
+        self.blocks[cur].payload.append(s)
+        return cur
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    b = _Builder()
+    entry = b.new()
+    tail = b.seq(fn.body, entry)
+    if tail is not None:
+        b.exits.append(tail)  # implicit ``return None``
+    return CFG(blocks=b.blocks, entry=entry, exits=b.exits)
+
+
+# --------------------------------------------------------------------------
+# must-analysis
+#
+# gen(payload_item) -> iterable of (anchor, facts): ``anchor`` is a node
+# (usually an ast.Call) at which the in-flight fact set is sampled, or
+# None to add facts without sampling (the guard heuristic).  ``facts``
+# are hashable tokens established immediately after the anchor.
+
+GenFn = Callable[[PayloadItem], Iterable[Tuple[Optional[ast.AST], Iterable[str]]]]
+
+
+def must_facts(
+    cfg: CFG, gen: GenFn
+) -> Tuple[Dict[int, FrozenSet[str]], Optional[FrozenSet[str]]]:
+    """Forward must-analysis over ``cfg``.
+
+    Returns ``(at, exit_facts)``: ``at[id(anchor)]`` is the set of facts
+    that hold on *every* path reaching the anchor; ``exit_facts`` is the
+    intersection over all normal exits, or ``None`` when the function has
+    no normal exit (every path raises — vacuously "establishes
+    everything", since callers never resume after it).
+    """
+    preds = cfg.preds()
+    out: Dict[int, Optional[FrozenSet[str]]] = {b.bid: None for b in cfg.blocks}
+
+    def block_in(bid: int) -> Optional[FrozenSet[str]]:
+        if bid == cfg.entry:
+            return frozenset()
+        acc: Optional[FrozenSet[str]] = None
+        for p in preds[bid]:
+            po = out[p]
+            if po is None:
+                continue  # TOP predecessor: does not constrain the meet
+            acc = po if acc is None else (acc & po)
+        return acc
+
+    def transfer(bid: int, facts: FrozenSet[str], record: Optional[Dict[int, FrozenSet[str]]]) -> FrozenSet[str]:
+        for item in cfg.blocks[bid].payload:
+            for anchor, add in gen(item):
+                if anchor is not None and record is not None:
+                    record[id(anchor)] = facts
+                new = frozenset(add)
+                if new:
+                    facts = facts | new
+        return facts
+
+    # fixpoint on block OUT sets
+    changed = True
+    while changed:
+        changed = False
+        for b in cfg.blocks:
+            facts_in = block_in(b.bid)
+            if facts_in is None:
+                continue  # unreachable (or not yet reached)
+            new_out = transfer(b.bid, facts_in, None)
+            if out[b.bid] is None or out[b.bid] != new_out:
+                out[b.bid] = new_out
+                changed = True
+
+    # final sampling pass with stabilized INs
+    at: Dict[int, FrozenSet[str]] = {}
+    for b in cfg.blocks:
+        facts_in = block_in(b.bid)
+        if facts_in is None:
+            continue  # unreachable: never sampled (dead code is exempt)
+        transfer(b.bid, facts_in, at)
+
+    exit_facts: Optional[FrozenSet[str]] = None
+    for e in cfg.exits:
+        eo = out[e]
+        if eo is None:
+            continue  # unreachable exit block
+        exit_facts = eo if exit_facts is None else (exit_facts & eo)
+    return at, exit_facts
+
+
+# --------------------------------------------------------------------------
+# function index + call graph
+
+
+@dataclass
+class FuncUnit:
+    path: str
+    qualname: str
+    name: str  # last qualname segment
+    node: ast.AST
+    cfg: CFG
+
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qualname)
+
+
+#: Attribute names too generic to resolve through the call graph — these
+#: collide with builtin container/file protocol methods, so ``x.append()``
+#: must never bind to an unrelated ``def append`` that happens to share a
+#: scanned file.  Name-based event detection (journal receivers, apply
+#: markers) runs *before* resolution and is unaffected.
+GENERIC_ATTRS = frozenset(
+    {
+        "append", "add", "pop", "get", "set", "items", "keys", "values",
+        "update", "extend", "remove", "discard", "clear", "copy", "sort",
+        "close", "write", "read", "flush", "open", "send", "recv", "put",
+        "join", "split", "strip", "encode", "decode", "format", "observe",
+        "inc", "dec", "count", "index", "insert", "setdefault", "release",
+        "acquire", "start", "stop", "run", "wait", "result", "submit",
+    }
+)
+
+
+class FlowIndex:
+    """Every function in a set of files, with call-site resolution.
+
+    Resolution is intentionally modest: a call binds to a definition when
+    the callee's terminal name matches exactly one function in the same
+    file, or failing that exactly one function across the indexed set.
+    Ambiguity (two ``apply_handoff`` defs) and :data:`GENERIC_ATTRS`
+    resolve to nothing — for a *must*-style lint, an unresolved call is
+    simply not an event, which biases toward reporting, and reported
+    chains are then human-verified.
+    """
+
+    def __init__(self, ctxs: Iterable[FileCtx]):
+        self.units: List[FuncUnit] = []
+        self.by_key: Dict[Tuple[str, str], FuncUnit] = {}
+        self._by_name: Dict[str, List[FuncUnit]] = {}
+        self._by_path_name: Dict[Tuple[str, str], List[FuncUnit]] = {}
+        self._callers: Optional[Dict[Tuple[str, str], List[Tuple[FuncUnit, ast.Call]]]] = None
+        for ctx in ctxs:
+            for qualname, fn in walk_functions(ctx.tree):
+                unit = FuncUnit(
+                    path=ctx.path,
+                    qualname=qualname,
+                    name=qualname.split(".")[-1],
+                    node=fn,
+                    cfg=build_cfg(fn),
+                )
+                self.units.append(unit)
+                self.by_key[unit.key()] = unit
+                self._by_name.setdefault(unit.name, []).append(unit)
+                self._by_path_name.setdefault((ctx.path, unit.name), []).append(unit)
+
+    def resolve(self, path: str, call: ast.Call) -> Optional[FuncUnit]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+            if name in GENERIC_ATTRS or name.startswith("__"):
+                return None
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        else:
+            return None
+        local = self._by_path_name.get((path, name), ())
+        if len(local) == 1:
+            return local[0]
+        if local:
+            return None  # ambiguous within the file
+        everywhere = self._by_name.get(name, ())
+        if len(everywhere) == 1:
+            return everywhere[0]
+        return None
+
+    def callers(self, unit: FuncUnit) -> List[Tuple[FuncUnit, ast.Call]]:
+        """Call sites across the index that resolve to ``unit``."""
+        if self._callers is None:
+            rev: Dict[Tuple[str, str], List[Tuple[FuncUnit, ast.Call]]] = {}
+            for u in self.units:
+                for call in u.cfg.calls():
+                    v = self.resolve(u.path, call)
+                    if v is not None and v.key() != u.key():
+                        rev.setdefault(v.key(), []).append((u, call))
+            self._callers = rev
+        return self._callers.get(unit.key(), [])
+
+    def transitive_callees(self, roots: Iterable[FuncUnit]) -> List[FuncUnit]:
+        """Roots plus everything reachable from them through resolvable
+        calls (the "touches device values" closure for the jax family)."""
+        seen: Set[Tuple[str, str]] = set()
+        order: List[FuncUnit] = []
+        stack = list(roots)
+        while stack:
+            u = stack.pop()
+            if u.key() in seen:
+                continue
+            seen.add(u.key())
+            order.append(u)
+            for call in u.cfg.calls():
+                v = self.resolve(u.path, call)
+                if v is not None and v.key() not in seen:
+                    stack.append(v)
+        return order
+
+
+# --------------------------------------------------------------------------
+# interprocedural all-paths summaries
+
+
+def all_paths_summary(
+    index: FlowIndex,
+    fact: str,
+    direct: Callable[[FuncUnit, ast.Call], bool],
+    guard: Optional[Callable[[ast.If], bool]] = None,
+) -> Dict[Tuple[str, str], bool]:
+    """``summary[unit.key()]`` — does the unit establish ``fact`` on every
+    normal return path?  Counts direct events (``direct(unit, call)``)
+    and calls to units already summarized True; iterates to a fixpoint,
+    so mutual recursion converges from below (all-False), never
+    over-claiming.
+
+    ``guard(if_node)`` implements the escape-hatch heuristic: when it
+    returns True for a ``BranchTest`` whose guarded body contains an
+    event, the event is treated as unconditional (see rules_wal for the
+    journal-handle guard this exists for).
+    """
+    summary: Dict[Tuple[str, str], bool] = {u.key(): False for u in index.units}
+
+    def branch_establishes(unit: FuncUnit, node: ast.AST) -> bool:
+        body = getattr(node, "body", [])
+        for stmt in body:
+            for call in iter_calls(stmt):
+                if direct(unit, call):
+                    return True
+                v = index.resolve(unit.path, call)
+                if v is not None and summary.get(v.key()):
+                    return True
+        return False
+
+    def unit_establishes(unit: FuncUnit) -> bool:
+        def gen(item: PayloadItem):
+            if (
+                guard is not None
+                and isinstance(item, BranchTest)
+                and isinstance(item.node, ast.If)
+                and guard(item.node)
+                and branch_establishes(unit, item.node)
+            ):
+                yield None, (fact,)
+            for call in iter_calls(item):
+                v = index.resolve(unit.path, call)
+                if direct(unit, call) or (v is not None and summary.get(v.key())):
+                    yield call, (fact,)
+                else:
+                    yield call, ()
+
+        _, exit_facts = must_facts(unit.cfg, gen)
+        # no normal exit ⇒ callers never resume ⇒ vacuously establishes
+        return exit_facts is None or fact in exit_facts
+
+    changed = True
+    while changed:
+        changed = False
+        for u in index.units:
+            if not summary[u.key()] and unit_establishes(u):
+                summary[u.key()] = True
+                changed = True
+    return summary
+
+
+# --------------------------------------------------------------------------
+# forward may-reach reads (jax-donation-reuse)
+
+
+def _reads_in(node: ast.AST, name: str) -> List[ast.AST]:
+    """Loads of ``name`` inside ``node`` (AugAssign targets count: they
+    read before writing)."""
+    hits: List[ast.AST] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == name and isinstance(n.ctx, ast.Load):
+            hits.append(n)
+        elif isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name) and n.target.id == name:
+            hits.append(n.target)
+    return hits
+
+
+def _rebinds(item: PayloadItem, name: str) -> bool:
+    """Does this payload item rebind ``name`` outright (killing taint)?
+
+    AugAssign is *not* a kill — it reads the old buffer first.
+    """
+    node = item.node if isinstance(item, (BranchTest, WithHeader)) else item
+    if isinstance(node, ast.Assign):
+        return any(isinstance(t, ast.Name) and t.id == name for t in node.targets)
+    if isinstance(node, ast.AnnAssign):
+        return isinstance(node.target, ast.Name) and node.target.id == name
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return any(
+            isinstance(n, ast.Name) and n.id == name for n in ast.walk(node.target)
+        )
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        return any(
+            item_.optional_vars is not None
+            and any(
+                isinstance(n, ast.Name) and n.id == name
+                for n in ast.walk(item_.optional_vars)
+            )
+            for item_ in node.items
+        )
+    if isinstance(node, ast.Delete):
+        return any(isinstance(t, ast.Name) and t.id == name for t in node.targets)
+    return False
+
+
+def reads_after(cfg: CFG, anchor: ast.Call, name: str) -> Optional[ast.AST]:
+    """First read of ``name`` on some path strictly after ``anchor``,
+    before the name is rebound.  Returns the reading node or None.
+
+    Reads inside the anchor's own statement are ignored (they are the
+    dispatch arguments themselves); a rebinding anchor statement —
+    ``state = step(state)``, the donation idiom — kills tracking
+    immediately.
+    """
+    # locate the anchor's (block, payload index)
+    pos: Optional[Tuple[int, int]] = None
+    for b in cfg.blocks:
+        for i, item in enumerate(b.payload):
+            if any(c is anchor for c in iter_calls(item)):
+                pos = (b.bid, i)
+                break
+        if pos:
+            break
+    if pos is None:
+        return None
+    start_bid, start_idx = pos
+    start_item = cfg.blocks[start_bid].payload[start_idx]
+    if _rebinds(start_item, name):
+        return None
+
+    def scan(items: Sequence[PayloadItem]) -> Tuple[Optional[ast.AST], bool]:
+        """(first read, killed?) scanning payload items in order."""
+        for item in items:
+            scope = (
+                item.exprs if isinstance(item, (BranchTest, WithHeader)) else [item]
+            )
+            for sub in scope:
+                hits = _reads_in(sub, name)
+                if hits:
+                    return hits[0], True
+            if _rebinds(item, name):
+                return None, True
+        return None, False
+
+    # rest of the anchor's own block
+    hit, killed = scan(cfg.blocks[start_bid].payload[start_idx + 1 :])
+    if hit is not None:
+        return hit
+    if killed:
+        return None
+
+    seen: Set[int] = {start_bid}
+    stack = list(cfg.blocks[start_bid].succs)
+    while stack:
+        bid = stack.pop()
+        if bid in seen:
+            continue
+        seen.add(bid)
+        hit, killed = scan(cfg.blocks[bid].payload)
+        if hit is not None:
+            return hit
+        if not killed:
+            stack.extend(cfg.blocks[bid].succs)
+    return None

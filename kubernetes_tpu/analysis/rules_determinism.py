@@ -212,3 +212,43 @@ class DeterminismRule(Rule):
             ),
             key=make_key("det-set-iteration", path, f"set:{line}"),
         )
+
+
+#: rule documentation consumed by check_lint --explain / --rule-catalog
+DOCS = {
+    "det-wallclock": {
+        "family": "det",
+        "summary": "Wall-clock read (time.time/now) inside a scoring or decision path.",
+        "scope": "Scoring kernels and decision paths under ops/, engine/, loadgen/, fleet/.",
+        "rationale": "Replay equivalence (paper §2) requires decisions to be a pure function of the journaled inputs; a wall-clock read makes re-execution diverge from the recorded run.",
+        "fix": "Thread the tick/timestamp in from the journaled envelope instead of reading the clock.",
+    },
+    "det-random": {
+        "family": "det",
+        "summary": "Unseeded RNG use in a decision path.",
+        "scope": "Same decision-path scope as det-wallclock.",
+        "rationale": "Unseeded randomness breaks bit-identical replay; every stochastic choice must flow from the journaled seed.",
+        "fix": "Derive randomness from the journaled seed (jax.random with an explicit key, or the seeded stdlib Random instance).",
+    },
+    "det-set-iteration": {
+        "family": "det",
+        "summary": "Bare set iterated/materialized in an order-sensitive position.",
+        "scope": "Decision paths; iteration feeding scores, packing or serialization.",
+        "rationale": "Set order is hash-randomized per process (PYTHONHASHSEED) — the same inputs can produce different orderings, hence different bindings.",
+        "fix": "Wrap in sorted(...) or keep an ordered container.",
+    },
+    "det-builtin-hash": {
+        "family": "det",
+        "summary": "Builtin hash() used where the value feeds a decision.",
+        "scope": "Decision paths.",
+        "rationale": "str/bytes hashing is salted per process; hashes must be stable across restarts to replay.",
+        "fix": "Use the repo's stable hash helper (_hash_u32 / hashlib) instead.",
+    },
+    "det-id-key": {
+        "family": "det",
+        "summary": "id() used as a key or ordering basis.",
+        "scope": "Decision paths.",
+        "rationale": "Object addresses differ across runs; any ordering or keying by id() is unreproducible.",
+        "fix": "Key by a stable identifier (uid, name) instead.",
+    },
+}

@@ -1,0 +1,736 @@
+"""JAX device discipline: purity of the batched pod×node pass.
+
+The paper's replay guarantee assumes the device pass is a pure,
+trace-stable function: bindings are bit-identical across wire, degraded
+and crash-recovery paths only if nothing inside the compiled region
+syncs to host, retraces per call, or silently reads donated buffers —
+and the fleet's scatter-gather is decision-identical to one scheduler
+only for score ops that do NOT normalize over the global candidate set
+(the Tesserae compromise, fleet/router.py ``PARTITION_INEXACT_OPS``).
+
+This family runs WITHOUT importing JAX (the check_lint contract):
+device contexts are discovered structurally on the flow engine
+(:mod:`.flow`) —
+
+- functions decorated ``@jax.jit`` / wrapped ``f = jax.jit(f, ...)``;
+- functions handed to ``lax.cond``/``lax.scan``/``lax.while_loop``/
+  ``jax.vmap`` and friends;
+- op kernels registered through ``OpDef(...)`` (``featurize=``/
+  ``filter=``/``score=``/``hard_filter=``);
+- everything transitively called from those roots
+  (:meth:`flow.FlowIndex.transitive_callees` — the "touches device
+  values" closure).
+
+Inside a device context, a *device value* is (heuristically) any
+``jnp.``/``lax.`` call result, any read of the conventional traced
+parameters (``state``/``pf``/``feasible``/``carry``), or a local
+assigned from one (taint) — with ``.shape``/``.dtype``/``.ndim`` reads
+pruned, since those are static under trace.
+
+Findings:
+
+- ``jax-host-sync`` — ``.item()``/``.tolist()``/``.block_until_ready()``
+  on a device value, ``float()``/``int()``/``bool()``/``np.asarray()``
+  over one, or an ``if``/``while``/``assert`` whose test contains one:
+  each is a blocking device→host transfer inside the pass (or a
+  tracer-leak TypeError waiting to happen).
+- ``jax-retrace-hazard`` — a call to a jitted entry point passing an
+  unhashable display (list/dict/set) or a per-call-varying expression
+  (call/arithmetic) in a ``static_argnums``/``static_argnames``
+  position: every distinct value recompiles the kernel.
+- ``jax-donation-reuse`` — a bare name passed in a
+  ``donate_argnums``/``donate_argnames`` position and read again on
+  some path after the dispatch, before rebinding.  The donation idiom
+  ``state = step(state)`` is clean (the rebind kills tracking); reading
+  the stale handle is use-after-free on device memory.
+- ``jax-partition-unsafe`` — an op's ``score`` kernel (or a helper it
+  calls) reduces over the candidate axis — ``jnp.max/min/sum/...`` or a
+  ``.sum()``-style method whose operand mentions ``feasible`` /
+  ``state.valid`` / a value derived from them — without the op being
+  registered in ``fleet/router.py``'s ``PARTITION_INEXACT_OPS``; stale
+  registry entries flag too, so registry and ops/ mirror exactly.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import FileCtx, Finding, Rule, dotted_name, make_key, str_const
+from .flow import FlowIndex, FuncUnit, reads_after
+
+#: conventional traced-parameter names inside the pass (engine/pass_.py,
+#: ops/ kernel signatures)
+DEVICE_BASES = {"state", "pf", "feasible", "carry"}
+
+#: attribute reads that are static under trace — never device values
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "name"}
+
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+CAST_FUNCS = {"float", "int", "bool"}
+NP_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+#: jnp reductions that collapse the candidate axis when fed a
+#: feasibility-masked operand (jnp.maximum/minimum are elementwise and
+#: deliberately absent)
+CANDIDATE_REDUCERS = {
+    "jnp.max", "jnp.min", "jnp.sum", "jnp.mean", "jnp.prod",
+    "jnp.argmax", "jnp.argmin", "jnp.any", "jnp.all", "jnp.median",
+}
+REDUCER_METHODS = {"sum", "max", "min", "mean", "any", "all", "argmax", "argmin", "prod"}
+
+#: functions whose function-typed arguments execute under trace
+JAX_COMBINATORS_PREFIX = ("jax.", "lax.")
+
+OPDEF_KERNEL_KWARGS = {"featurize", "filter", "score", "hard_filter", "is_active"}
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's own body, skipping nested def/class subtrees
+    (they are separate units) but descending into lambdas (their bodies
+    run under this unit's trace)."""
+
+    def visit(n):
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield from visit(child)
+
+    for stmt in fn.body:
+        yield from visit(stmt)
+
+
+def _device_expr(node: ast.AST, tainted: set[str]) -> bool:
+    """Does this expression (sub)tree produce/contain a device value?"""
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return _device_expr(node.value, tainted)
+    if isinstance(node, ast.Name):
+        return node.id in DEVICE_BASES or node.id in tainted
+    if isinstance(node, ast.Compare):
+        # Two host-static idioms that merely *mention* device names:
+        # ``"key" in pf`` inspects dict keys, not array values, and
+        # ``x is (not) None`` is Python identity — neither reads device
+        # data, so neither forces a sync even when pf/x are traced.
+        if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) and (
+            isinstance(node.left, ast.Constant) and isinstance(node.left.value, str)
+        ):
+            return False
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and any(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in [node.left, *node.comparators]
+        ):
+            return False
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d is not None and d.split(".", 1)[0] in ("jnp", "lax"):
+            return True
+        if d is not None and d.startswith("jax."):
+            return True
+        parts = [node.func] if not isinstance(node.func, ast.Name) else []
+        parts += list(node.args) + [kw.value for kw in node.keywords]
+        return any(_device_expr(p, tainted) for p in parts)
+    return any(_device_expr(c, tainted) for c in ast.iter_child_nodes(node))
+
+
+def _unit_taint(fn: ast.AST) -> set[str]:
+    """Names assigned (directly or transitively) from device expressions
+    within the unit — order-insensitive fixpoint."""
+    tainted: set[str] = set()
+    assigns: list[tuple[str, ast.AST]] = []
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        assigns.append((n.id, node.value))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                assigns.append((node.target.id, node.value))
+    changed = True
+    while changed:
+        changed = False
+        for name, value in assigns:
+            if name not in tainted and _device_expr(value, tainted):
+                tainted.add(name)
+                changed = True
+    return tainted
+
+
+def _feasible_taint(fn: ast.AST) -> set[str]:
+    """Names derived from the feasibility mask within the unit."""
+    tainted: set[str] = set()
+
+    def mentions(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and (n.id == "feasible" or n.id in tainted):
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == "valid":
+                base = dotted_name(n.value)
+                if base is not None and base.split(".")[-1] == "state":
+                    return True
+        return False
+
+    assigns = []
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigns.append((t.id, node.value))
+    changed = True
+    while changed:
+        changed = False
+        for name, value in assigns:
+            if name not in tainted and mentions(value):
+                tainted.add(name)
+                changed = True
+    return tainted
+
+
+class _JitWrapper:
+    """One jitted entry point: how calls to ``name`` map to static and
+    donated argument positions."""
+
+    def __init__(self, name, target, static_nums, static_names, donate_nums, donate_names):
+        self.name = name
+        self.target = target  # FuncUnit | None
+        self.static_nums = static_nums
+        self.static_names = static_names
+        self.donate_nums = donate_nums
+        self.donate_names = donate_names
+
+    def arg_name(self, idx: int) -> str | None:
+        if self.target is None:
+            return None
+        args = self.target.node.args
+        names = [a.arg for a in args.posonlyargs + args.args]
+        if names and names[0] in ("self", "cls"):
+            pass  # kernels are free functions; keep literal mapping
+        return names[idx] if idx < len(names) else None
+
+    def static_positions(self) -> set[int]:
+        out = set(self.static_nums)
+        if self.target is not None:
+            args = self.target.node.args
+            names = [a.arg for a in args.posonlyargs + args.args]
+            for s in self.static_names:
+                if s in names:
+                    out.add(names.index(s))
+        return out
+
+    def donate_positions(self) -> set[int]:
+        out = set(self.donate_nums)
+        if self.target is not None:
+            args = self.target.node.args
+            names = [a.arg for a in args.posonlyargs + args.args]
+            for s in self.donate_names:
+                if s in names:
+                    out.add(names.index(s))
+        return out
+
+
+def _int_tuple(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _str_tuple(node: ast.AST) -> list[str]:
+    s = str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _is_jit_expr(node: ast.AST) -> ast.Call | None:
+    """The ``jax.jit(...)``/``partial(jax.jit, ...)`` call if ``node`` is
+    one (possibly through functools.partial), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted_name(node.func)
+    if d in ("jax.jit", "jit"):
+        return node
+    if d in ("partial", "functools.partial") and node.args:
+        inner = dotted_name(node.args[0])
+        if inner in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+class JaxRule(Rule):
+    name = "jax"
+
+    def files(self, root) -> list[str]:
+        rels = [
+            # the sidecar device path: the RPC server drives the
+            # compiled pass, host.py mirrors its math
+            "kubernetes_tpu/sidecar/server.py",
+            "kubernetes_tpu/sidecar/host.py",
+            # the exactness registry the partition rule enforces
+            "kubernetes_tpu/fleet/router.py",
+        ]
+        for sub in ("engine", "ops"):
+            top = os.path.join(root, "kubernetes_tpu", sub)
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        rels.append(
+                            os.path.relpath(
+                                os.path.join(dirpath, name), root
+                            ).replace(os.sep, "/")
+                        )
+        return rels
+
+    # -- device-context discovery -------------------------------------
+
+    def _wrappers_and_roots(
+        self, index: FlowIndex, ctxs: dict[str, FileCtx]
+    ) -> tuple[list[_JitWrapper], list[FuncUnit]]:
+        wrappers: list[_JitWrapper] = []
+        roots: list[FuncUnit] = []
+
+        def local_units(path: str, name: str) -> list[FuncUnit]:
+            return [u for u in index.units if u.path == path and u.name == name]
+
+        consumed: set[int] = set()  # jit Call nodes already wrapped
+
+        def wrapper_from_jit(path, jit, exposed_name):
+            consumed.add(id(jit))
+            fn_arg = jit.args[0] if jit.args else None
+            if dotted_name(fn_arg) in ("jax.jit", "jit"):
+                # partial(jax.jit, ...) — the wrapped fn arrives later
+                fn_arg = jit.args[1] if len(jit.args) > 1 else None
+            targets = (
+                local_units(path, fn_arg.id) if isinstance(fn_arg, ast.Name) else []
+            )
+            roots.extend(targets)
+            kw = {k.arg: k.value for k in jit.keywords}
+            empty = ast.Tuple(elts=[], ctx=ast.Load())
+            wrappers.append(
+                _JitWrapper(
+                    exposed_name,
+                    targets[0] if len(targets) == 1 else None,
+                    _int_tuple(kw.get("static_argnums", empty)),
+                    _str_tuple(kw.get("static_argnames", empty)),
+                    _int_tuple(kw.get("donate_argnums", empty)),
+                    _str_tuple(kw.get("donate_argnames", empty)),
+                )
+            )
+
+        for path, ctx in ctxs.items():
+            for node in ast.walk(ctx.tree):
+                # name = jax.jit(fn, ...): call sites use the assigned name
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    jit = _is_jit_expr(node.value)
+                    if jit is not None and isinstance(node.targets[0], ast.Name):
+                        wrapper_from_jit(path, jit, node.targets[0].id)
+                        continue
+                # decorated defs: @jax.jit / @partial(jax.jit, ...)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        jit = None
+                        if dotted_name(dec) in ("jax.jit", "jit"):
+                            jit = dec if isinstance(dec, ast.Call) else None
+                            is_jit = True
+                        else:
+                            jit = _is_jit_expr(dec)
+                            is_jit = jit is not None
+                        if not is_jit:
+                            continue
+                        targets = local_units(path, node.name)
+                        target = targets[0] if len(targets) == 1 else None
+                        if jit is not None:
+                            consumed.add(id(jit))
+                        kw = {k.arg: k.value for k in (jit.keywords if jit else [])}
+                        wrappers.append(
+                            _JitWrapper(
+                                node.name,
+                                target,
+                                _int_tuple(kw.get("static_argnums", ast.Tuple(elts=[], ctx=ast.Load()))),
+                                _str_tuple(kw.get("static_argnames", ast.Tuple(elts=[], ctx=ast.Load()))),
+                                _int_tuple(kw.get("donate_argnums", ast.Tuple(elts=[], ctx=ast.Load()))),
+                                _str_tuple(kw.get("donate_argnames", ast.Tuple(elts=[], ctx=ast.Load()))),
+                            )
+                        )
+                        roots.extend(targets)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                # anonymous jax.jit(g) call (e.g. ``return jax.jit(_run)``):
+                # wrapped fn is a device root; callers hold the returned
+                # callable under arbitrary names, so expose under the
+                # wrapped fn's own name
+                jit = _is_jit_expr(node)
+                if jit is not None:
+                    if id(jit) not in consumed:
+                        fn_arg = jit.args[0] if jit.args else None
+                        if dotted_name(fn_arg) in ("jax.jit", "jit"):
+                            fn_arg = jit.args[1] if len(jit.args) > 1 else None
+                        if isinstance(fn_arg, ast.Name):
+                            wrapper_from_jit(path, jit, fn_arg.id)
+                    continue
+                # lax.cond/scan/while_loop, jax.vmap, ... — function args
+                # execute under trace
+                if d is not None and d.startswith(JAX_COMBINATORS_PREFIX):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            roots.extend(local_units(path, a.id))
+                # OpDef(...) kernels
+                fn_name = d.split(".")[-1] if d else None
+                if fn_name == "OpDef":
+                    for k in node.keywords:
+                        if k.arg in OPDEF_KERNEL_KWARGS and isinstance(k.value, ast.Name):
+                            roots.extend(local_units(path, k.value.id))
+        return wrappers, roots
+
+    # -- the rule entrypoint ------------------------------------------
+
+    def run(self, ctxs: dict[str, FileCtx], root) -> list[Finding]:
+        index = FlowIndex(ctxs.values())
+        wrappers, roots = self._wrappers_and_roots(index, ctxs)
+        device_units = index.transitive_callees(roots)
+        out: list[Finding] = []
+        out.extend(self._host_sync(device_units))
+        out.extend(self._retrace(index, ctxs, wrappers))
+        out.extend(self._donation(index, ctxs, wrappers))
+        out.extend(self._partition(index, ctxs))
+        return out
+
+    # -- jax-host-sync -------------------------------------------------
+
+    def _host_sync(self, device_units: list[FuncUnit]) -> list[Finding]:
+        out: list[Finding] = []
+
+        def emit(unit, node, what, detail):
+            out.append(
+                Finding(
+                    rule="jax-host-sync",
+                    path=unit.path,
+                    line=node.lineno,
+                    message=(
+                        f"{unit.qualname} (device context) {detail} — a "
+                        "blocking device->host sync inside the compiled "
+                        "pass (or a tracer leak at trace time)"
+                    ),
+                    key=make_key(
+                        "jax-host-sync", unit.path, f"{unit.qualname}:{what}"
+                    ),
+                )
+            )
+
+        for unit in device_units:
+            tainted = _unit_taint(unit.node)
+            for node in _own_nodes(unit.node):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    if (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr in SYNC_METHODS
+                        and _device_expr(fn.value, tainted)
+                    ):
+                        emit(unit, node, fn.attr, f"calls .{fn.attr}() on a device value")
+                    elif (
+                        isinstance(fn, ast.Name)
+                        and fn.id in CAST_FUNCS
+                        and node.args
+                        and _device_expr(node.args[0], tainted)
+                    ):
+                        emit(unit, node, fn.id, f"casts a device value with {fn.id}()")
+                    else:
+                        d = dotted_name(fn)
+                        if (
+                            d in NP_SYNC_CALLS
+                            and node.args
+                            and _device_expr(node.args[0], tainted)
+                        ):
+                            emit(unit, node, d, f"materializes a device value via {d}()")
+                elif isinstance(node, (ast.If, ast.While)):
+                    if _device_expr(node.test, tainted):
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        emit(
+                            unit,
+                            node,
+                            f"branch:{node.lineno}",
+                            f"branches ({kind}) on a device value",
+                        )
+                elif isinstance(node, ast.Assert):
+                    if _device_expr(node.test, tainted):
+                        emit(unit, node, f"assert:{node.lineno}", "asserts on a device value")
+        return out
+
+    # -- jax-retrace-hazard --------------------------------------------
+
+    def _retrace(self, index, ctxs, wrappers: list[_JitWrapper]) -> list[Finding]:
+        out: list[Finding] = []
+        by_name: dict[str, list[_JitWrapper]] = {}
+        for w in wrappers:
+            if w.static_positions() or w.static_names:
+                by_name.setdefault(w.name, []).append(w)
+        if not by_name:
+            return out
+        for path, ctx in ctxs.items():
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                callee = d.split(".")[-1] if d else None
+                for w in by_name.get(callee, ()):  # usually 0 or 1
+                    static = w.static_positions()
+                    checks: list[tuple[ast.AST, str]] = []
+                    for i, a in enumerate(node.args):
+                        if i in static:
+                            checks.append((a, f"positional {i}"))
+                    for k in node.keywords:
+                        if k.arg in w.static_names:
+                            checks.append((k.value, f"keyword {k.arg}"))
+                    for a, where in checks:
+                        if isinstance(
+                            a, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+                        ):
+                            problem = "an unhashable container"
+                        elif isinstance(a, (ast.Call, ast.BinOp, ast.JoinedStr)):
+                            problem = "a per-call-varying expression"
+                        else:
+                            continue
+                        out.append(
+                            Finding(
+                                rule="jax-retrace-hazard",
+                                path=path,
+                                line=node.lineno,
+                                message=(
+                                    f"call to jitted {w.name} passes {problem} "
+                                    f"as static arg ({where}) — every distinct "
+                                    "value recompiles the kernel (unhashables "
+                                    "TypeError at dispatch)"
+                                ),
+                                key=make_key(
+                                    "jax-retrace-hazard", path, f"{w.name}:{where}"
+                                ),
+                            )
+                        )
+        return out
+
+    # -- jax-donation-reuse --------------------------------------------
+
+    def _donation(self, index: FlowIndex, ctxs, wrappers: list[_JitWrapper]) -> list[Finding]:
+        out: list[Finding] = []
+        by_name: dict[str, list[_JitWrapper]] = {}
+        for w in wrappers:
+            if w.donate_positions() or w.donate_names:
+                by_name.setdefault(w.name, []).append(w)
+        if not by_name:
+            return out
+        for unit in index.units:
+            for call in unit.cfg.calls():
+                d = dotted_name(call.func)
+                callee = d.split(".")[-1] if d else None
+                for w in by_name.get(callee, ()):
+                    donated: list[str] = []
+                    positions = w.donate_positions()
+                    for i, a in enumerate(call.args):
+                        if i in positions and isinstance(a, ast.Name):
+                            donated.append(a.id)
+                    for k in call.keywords:
+                        if k.arg in w.donate_names and isinstance(k.value, ast.Name):
+                            donated.append(k.value.id)
+                    for name in donated:
+                        hit = reads_after(unit.cfg, call, name)
+                        if hit is None:
+                            continue
+                        out.append(
+                            Finding(
+                                rule="jax-donation-reuse",
+                                path=unit.path,
+                                line=getattr(hit, "lineno", call.lineno),
+                                message=(
+                                    f"{unit.qualname} reads {name!r} after "
+                                    f"donating it to jitted {w.name} (line "
+                                    f"{call.lineno}) — the buffer is dead on "
+                                    "device; rebind the result instead "
+                                    f"({name} = {w.name}(...))"
+                                ),
+                                key=make_key(
+                                    "jax-donation-reuse",
+                                    unit.path,
+                                    f"{unit.qualname}:{w.name}:{name}",
+                                ),
+                            )
+                        )
+        return out
+
+    # -- jax-partition-unsafe ------------------------------------------
+
+    def _registry(self, ctxs) -> tuple[set[str], str | None, int]:
+        """(names, router path, assignment line) of PARTITION_INEXACT_OPS."""
+        for path, ctx in ctxs.items():
+            if not path.endswith("fleet/router.py"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not any(
+                    isinstance(t, ast.Name) and t.id == "PARTITION_INEXACT_OPS"
+                    for t in node.targets
+                ):
+                    continue
+                val = node.value
+                if isinstance(val, ast.Call):
+                    val = val.args[0] if val.args else None
+                names: set[str] = set()
+                if isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                    for e in val.elts:
+                        s = str_const(e)
+                        if s is not None:
+                            names.add(s)
+                return names, path, node.lineno
+        return set(), None, 0
+
+    def _partition(self, index: FlowIndex, ctxs) -> list[Finding]:
+        registry, reg_path, reg_line = self._registry(ctxs)
+        out: list[Finding] = []
+        seen_inexact: set[str] = set()
+
+        for path, ctx in ctxs.items():
+            if "/ops/" not in path:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if not d or d.split(".")[-1] != "OpDef":
+                    continue
+                op_name = None
+                score_name = None
+                for k in node.keywords:
+                    if k.arg == "name":
+                        op_name = str_const(k.value)
+                    elif k.arg == "score" and isinstance(k.value, ast.Name):
+                        score_name = k.value.id
+                if op_name is None and node.args:
+                    op_name = str_const(node.args[0])
+                if op_name is None or score_name is None:
+                    continue
+                score_units = [
+                    u for u in index.units if u.path == path and u.name == score_name
+                ]
+                hit = None
+                for u in index.transitive_callees(score_units):
+                    hit = self._candidate_reduction(u)
+                    if hit is not None:
+                        break
+                if hit is None:
+                    continue
+                seen_inexact.add(op_name)
+                if op_name in registry:
+                    continue
+                hit_unit, hit_line, hit_what = hit
+                out.append(
+                    Finding(
+                        rule="jax-partition-unsafe",
+                        path=path,
+                        line=node.lineno,
+                        message=(
+                            f"score op {op_name!r} reduces over the global "
+                            f"candidate axis ({hit_what} in "
+                            f"{hit_unit.qualname}, {hit_unit.path}:{hit_line}) "
+                            "but is not registered in fleet/router.py "
+                            "PARTITION_INEXACT_OPS — per-shard evaluation "
+                            "silently diverges from a single scheduler"
+                        ),
+                        key=make_key("jax-partition-unsafe", path, f"op:{op_name}"),
+                    )
+                )
+        if reg_path is not None:
+            for stale in sorted(registry - seen_inexact):
+                out.append(
+                    Finding(
+                        rule="jax-partition-unsafe",
+                        path=reg_path,
+                        line=reg_line,
+                        message=(
+                            f"PARTITION_INEXACT_OPS lists {stale!r} but no "
+                            "registered score op reduces over the candidate "
+                            "axis under that name — stale entry (was the op "
+                            "renamed or its normalization removed?)"
+                        ),
+                        key=make_key("jax-partition-unsafe", reg_path, f"stale:{stale}"),
+                    )
+                )
+        return out
+
+    def _candidate_reduction(self, unit: FuncUnit):
+        """(unit, line, what) of the first candidate-axis reduction over
+        feasibility-derived data in this unit, else None."""
+        tainted = _feasible_taint(unit.node)
+
+        def mentions(node: ast.AST) -> bool:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and (n.id == "feasible" or n.id in tainted):
+                    return True
+                if isinstance(n, ast.Attribute) and n.attr == "valid":
+                    base = dotted_name(n.value)
+                    if base is not None and base.split(".")[-1] == "state":
+                        return True
+            return False
+
+        for node in _own_nodes(unit.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d in CANDIDATE_REDUCERS:
+                operand = list(node.args) + [k.value for k in node.keywords]
+                if any(mentions(a) for a in operand):
+                    return unit, node.lineno, d
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in REDUCER_METHODS
+                and mentions(fn.value)
+            ):
+                return unit, node.lineno, f".{fn.attr}()"
+        return None
+
+
+#: rule documentation consumed by check_lint --explain / --rule-catalog
+DOCS = {
+    "jax-host-sync": {
+        "family": "jax",
+        "summary": "Blocking device->host transfer inside a compiled-pass context.",
+        "scope": "Device contexts: @jax.jit / jax.jit(...) functions, lax.cond/scan/vmap operands, OpDef kernels, and everything they transitively call under engine/, ops/ and the sidecar device path.",
+        "rationale": ".item()/.tolist()/float()/np.asarray() and if/while/assert over a traced value either stall the pass on a transfer every invocation or TypeError at trace time — the paper's throughput model assumes the pass never leaves the device mid-step.",
+        "fix": "Keep the select on device (lax.cond/jnp.where); move genuinely host-side reads outside the jitted region. Dict-key membership and `is None` checks are recognized as host-static and never flagged.",
+    },
+    "jax-retrace-hazard": {
+        "family": "jax",
+        "summary": "Unhashable or per-call-varying value in a static_argnums/static_argnames position.",
+        "scope": "Call sites of jitted entry points declaring static arguments.",
+        "rationale": "Every distinct static value compiles a fresh kernel; containers additionally TypeError at dispatch. A hot path passing f-strings or fresh expressions retraces per call and destroys the amortized-compile assumption.",
+        "fix": "Pass hashable constants drawn from a small closed set, or make the argument traced.",
+    },
+    "jax-donation-reuse": {
+        "family": "jax",
+        "summary": "A donated buffer read again after dispatch, before rebinding.",
+        "scope": "Call sites of jitted entry points declaring donate_argnums/donate_argnames.",
+        "rationale": "Donation hands the buffer to the runtime for reuse — the double-buffered state update relies on it — so a later read through the old name observes freed or overwritten device memory.",
+        "fix": "Rebind the result over the donated name (state = step(state, ...)); the rebind idiom is recognized as clean.",
+    },
+    "jax-partition-unsafe": {
+        "family": "jax",
+        "summary": "A score op reduces over the global candidate axis without a PARTITION_INEXACT_OPS entry (or the registry lists an op that no longer reduces).",
+        "scope": "ops/ OpDef score kernels (and helpers they call) vs fleet/router.py's PARTITION_INEXACT_OPS.",
+        "rationale": "Fleet shards score only their slice; any cross-candidate normalization (max/min/sum over feasible) diverges from a single scheduler. The router degrades such ops deterministically — but only for ops it knows about, so the registry must mirror ops/ exactly in both directions.",
+        "fix": "Register the op in PARTITION_INEXACT_OPS with a why-comment, or restate the score as per-candidate gather math; prune entries whose reduction was removed.",
+    },
+}

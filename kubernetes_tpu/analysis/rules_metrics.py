@@ -389,3 +389,36 @@ def collect_catalog(root) -> list[dict]:
         e["labels"] = sorted(label_keys.get(name, ()))
         out.append(e)
     return out
+
+
+#: rule documentation consumed by check_lint --explain / --rule-catalog
+DOCS = {
+    "metrics-prefix": {
+        "family": "metrics",
+        "summary": "Metric family name missing the tpusched_ namespace prefix.",
+        "scope": "All metric registrations (framework/metrics surface).",
+        "rationale": "Dashboards and the bench sentinel select on the namespace; an unprefixed family silently drops out of every aggregate.",
+        "fix": "Rename to tpusched_<area>_<name>; grandfathered names ride tpulint_baseline.json with a justification.",
+    },
+    "metrics-duplicate": {
+        "family": "metrics",
+        "summary": "The same metric family registered more than once.",
+        "scope": "All metric registrations.",
+        "rationale": "Double registration either throws at import or silently forks the series, depending on registry — both corrupt the export.",
+        "fix": "Register once at module scope and share the handle.",
+    },
+    "metrics-labels": {
+        "family": "metrics",
+        "summary": "Inconsistent label schema across uses of one metric family.",
+        "scope": "All metric record/observe sites.",
+        "rationale": "A family must present one label set; mixed schemas make the series unjoinable and break recording rules.",
+        "fix": "Settle one label tuple per family and pass every label at every site.",
+    },
+    "metrics-tenant-label": {
+        "family": "metrics",
+        "summary": "Per-tenant metric missing the tenant label.",
+        "scope": "Fairness/admission metric sites.",
+        "rationale": "The WFQ starvation SLO (ISSUE 17) aggregates by tenant; an unlabeled sample is unattributable.",
+        "fix": "Pass tenant=<id> at the record site.",
+    },
+}

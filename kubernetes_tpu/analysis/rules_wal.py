@@ -1,38 +1,61 @@
-"""WAL discipline: journal-before-apply in the commit paths.
+"""WAL discipline: journal-before-apply and fsync-before-publish,
+proven interprocedurally on the flow engine (:mod:`.flow`).
 
 The write-ahead contract (journal.py): every bind/preempt/quarantine/
 delete decision is appended — and fsync'd — BEFORE it is applied to live
-state, so a crash landing anywhere after the append replays the
-decision instead of forgetting it.  The commit paths in ``scheduler.py``
-and ``queue.py`` maintain that ordering by hand; this rule machine-checks
-it.
+state, so a crash landing anywhere after the append replays the decision
+instead of forgetting it.  PR 4's version of this rule compared line
+numbers inside one function, which left the helper blind spot: a journal
+append moved into ``_stage()`` false-positived the caller, and an apply
+buried under a wrapper was only checked one level up via the
+``APPLY_MARKERS`` name list.  This rewrite proves the ordering along
+call chains:
 
-Model (flow-insensitive, per function):
+- a call to a function that journals on **every** normal return path
+  counts as a journal event at the call site
+  (:func:`flow.all_paths_summary`);
+- an apply buried N calls deep surfaces at the outermost frontier where
+  no journal dominates it, reported once with the chain in the message
+  (``via _stage → _do_commit, 2 calls deep``);
+- a suppression at **any** hop of the chain still covers the finding
+  (``Finding.also``), so recovery paths keep their documented pragmas at
+  the apply site they actually exempt.
 
-- **journal calls** — ``self._journal_append(...)`` /
-  ``self._journal_bind(...)`` and any ``<recv>.append(...)`` whose
-  receiver chain ends in ``journal`` (``self.journal.append``).
-- **apply markers** — the calls that make a journaled decision live:
-  ``finish_binding`` (a binding becomes durable scheduling truth; the
-  preceding ``assume_pod`` is revocable optimistic state and deliberately
-  NOT a marker — reserve-plugin failure forgets it without a journal
-  record) and ``quarantine`` (a pod enters the durable quarantine pool).
+**Journal-handle guard heuristic**: a journal event under
+``if self.journal is not None:`` (or ``if journal is not None and ...``)
+counts as unconditional — the else-path means no WAL is configured (or
+the group is already barriered), in which case there is nothing to
+journal before applying.  Recognized by an ``if`` whose test mentions a
+name ending in ``journal``.
 
 Findings:
 
-- ``wal-unjournaled-apply`` — a function applies journaled state without
-  any journal call in scope.  Recovery/replay paths that are themselves
-  driven by the journal (appends muted) suppress inline with a reason.
-- ``wal-apply-before-journal`` — a function has both, but an apply site
-  precedes the first journal call: the apply-then-append window the
-  crash matrix exists to close.
+- ``wal-unjournaled-apply`` — an apply is reachable with no journal
+  append anywhere on the chain.
+- ``wal-apply-before-journal`` — the chain does journal, but an apply
+  site precedes it: the apply-then-append window the crash matrix
+  exists to close.
+- ``wal-unsynced-publish`` — an ``os.replace``/``os.rename`` that makes
+  bytes durable scheduling truth is reachable without an ``os.fsync``
+  dominating it: after a crash the published file may hold garbage the
+  recovery path trusts.  Scoped to the WAL/snapshot/standby/checkpoint
+  publish paths; ``fleet/autoscaler.py`` is deliberately out of scope —
+  its ``_persist`` mirror is observability, not scheduling truth, and
+  documents its missing fsync.
+
+``journal.py`` itself is exempt from the apply rules: its recovery path
+replays decisions the journal already holds (appends muted), so
+journaling there would double-write every record.  It stays in scope for
+the publish rule (snapshot/rotate) and for call-graph summaries.
 """
 
 from __future__ import annotations
 
 import ast
+from dataclasses import dataclass
 
-from .core import Finding, Rule, dotted_name, make_key, walk_functions
+from .core import FileCtx, Finding, Rule, dotted_name, make_key
+from .flow import BranchTest, FlowIndex, FuncUnit, all_paths_summary, iter_calls, must_facts
 
 JOURNAL_SELF_METHODS = {"_journal_append", "_journal_bind", "_journal_mutation"}
 # Apply markers: finish_binding / quarantine (the single-scheduler commit
@@ -68,6 +91,25 @@ APPLY_MARKERS = {
     "finish_checkpoint",
 }
 
+#: files exempt from the apply rules but indexed for summaries/publish
+REPLAY_FILES = {"kubernetes_tpu/journal.py"}
+
+#: the publish (fsync-before-rename) rule's scope — the paths whose
+#: renamed files ARE scheduling truth after a crash
+PUBLISH_FILES = {
+    "kubernetes_tpu/journal.py",
+    "kubernetes_tpu/fleet/shardmap.py",
+    "kubernetes_tpu/fleet/standby.py",
+    "kubernetes_tpu/loadgen/checkpoint.py",
+    "kubernetes_tpu/engine/pipeline.py",
+}
+
+PUBLISH_CALLS = {"os.replace", "os.rename"}
+
+#: interprocedural chains deeper than this stop propagating (recursion
+#: backstop; real commit paths are ≤ 2 hops)
+MAX_CHAIN = 3
+
 
 def _is_journal_call(call: ast.Call) -> bool:
     fn = call.func
@@ -86,6 +128,39 @@ def _apply_marker(call: ast.Call) -> str | None:
     if isinstance(fn, ast.Attribute) and fn.attr in APPLY_MARKERS:
         return fn.attr
     return None
+
+
+def _is_fsync_call(call: ast.Call) -> bool:
+    return dotted_name(call.func) == "os.fsync"
+
+
+def _publish_marker(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    return name if name in PUBLISH_CALLS else None
+
+
+def _journal_guard(if_node: ast.If) -> bool:
+    """``if <test mentions a journal handle>:`` — the guarded body's
+    journal events count as unconditional (no-WAL else-path)."""
+    for node in ast.walk(if_node.test):
+        name = dotted_name(node)
+        if name is not None and name.split(".")[-1] == "journal":
+            return True
+    return False
+
+
+@dataclass(frozen=True)
+class _Site:
+    """One unprotected apply/publish, relative to the unit it lives in.
+
+    ``via`` walks from this unit down to the terminal direct site;
+    ``hops`` carries each deeper anchor (path, line) so a pragma at any
+    hop still suppresses the frontier finding."""
+
+    marker: str
+    line: int  # anchor line in the owning unit
+    via: tuple  # callee qualnames, outermost first
+    hops: tuple  # ((path, line), ...) matching ``via`` + terminal site
 
 
 class WalRule(Rule):
@@ -128,72 +203,285 @@ class WalRule(Rule):
             # (the os.replace apply) must follow the generation-journal
             # append carrying the digest resume verifies against.
             "kubernetes_tpu/loadgen/checkpoint.py",
+            # ISSUE 19 (flow engine): journal.py joins the scope for the
+            # publish rule (snapshot/rotate fsync discipline) and so the
+            # call graph can see journal-helper bodies; its replay path
+            # stays exempt from the apply rules (see module docstring).
+            "kubernetes_tpu/journal.py",
+            # fleet/shardmap.py's atomic map publish (fsync + replace) is
+            # the durable half of every handoff — publish rule scope.
+            "kubernetes_tpu/fleet/shardmap.py",
+            # Interprocedural fixture surface (absent from the real tree,
+            # so skipped there): deep helper-chain commit shapes proving
+            # the frontier reporting works N calls down.
+            "kubernetes_tpu/deepcommit.py",
         ]
 
-    def run(self, ctxs, root) -> list[Finding]:
-        out: list[Finding] = []
-        for path, ctx in ctxs.items():
-            for qualname, fn in walk_functions(ctx.tree):
-                journal_lines: list[int] = []
-                applies: list[tuple[int, str]] = []
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Call):
+    # -- shared frontier machinery ------------------------------------
+
+    def _frontier(
+        self,
+        index: FlowIndex,
+        fact: str,
+        direct_event,
+        direct_site,
+        guard,
+        in_scope,
+        exempt,
+    ) -> dict[tuple, frozenset]:
+        """``unit.key() → frozenset[_Site]`` of apply/publish sites not
+        dominated by ``fact``, with sites of non-exempt callees folded in
+        (the interprocedural fixpoint)."""
+        summaries = all_paths_summary(index, fact, direct_event, guard)
+        unprot: dict[tuple, frozenset] = {u.key(): frozenset() for u in index.units}
+
+        def branch_has_event(unit: FuncUnit, if_node: ast.If) -> bool:
+            for stmt in if_node.body:
+                for call in iter_calls(stmt):
+                    if direct_event(unit, call):
+                        return True
+                    v = index.resolve(unit.path, call)
+                    if v is not None and summaries.get(v.key()):
+                        return True
+            return False
+
+        def analyze(unit: FuncUnit) -> frozenset:
+            def gen(item):
+                if (
+                    guard is not None
+                    and isinstance(item, BranchTest)
+                    and isinstance(item.node, ast.If)
+                    and guard(item.node)
+                    and branch_has_event(unit, item.node)
+                ):
+                    yield None, (fact,)
+                for call in iter_calls(item):
+                    est = direct_event(unit, call)
+                    if not est:
+                        v = index.resolve(unit.path, call)
+                        est = v is not None and summaries.get(v.key(), False)
+                    yield call, ((fact,) if est else ())
+
+            at, _ = must_facts(unit.cfg, gen)
+            sites: set[_Site] = set()
+            for call in unit.cfg.calls():
+                facts = at.get(id(call))
+                if facts is None or fact in facts:
+                    continue  # dead code, or dominated
+                marker = direct_site(unit, call)
+                if marker is not None:
+                    sites.add(_Site(marker, call.lineno, (), ()))
+                    continue
+                v = index.resolve(unit.path, call)
+                if v is None or exempt(v) or v.key() == unit.key():
+                    continue
+                for s in unprot[v.key()]:
+                    if len(s.via) >= MAX_CHAIN:
                         continue
-                    if _is_journal_call(node):
-                        journal_lines.append(node.lineno)
-                    marker = _apply_marker(node)
-                    if marker is not None:
-                        applies.append((node.lineno, marker))
-                if not applies:
-                    continue
-                # Inside a marker's OWN definition, marker calls are the
-                # apply being implemented (its own name) or a delegated
-                # apply half (e.g. _apply_eviction → _unwind_pod) — the
-                # journal duty lives at the marker's call sites, which
-                # this rule checks separately.
-                if qualname.split(".")[-1] in APPLY_MARKERS and not journal_lines:
-                    continue
-                if not journal_lines:
-                    for ln, marker in applies:
-                        out.append(
-                            Finding(
-                                rule="wal-unjournaled-apply",
-                                path=path,
-                                line=ln,
-                                message=(
-                                    f"{qualname} applies journaled state "
-                                    f"({marker}) with no journal append in "
-                                    "scope — a crash here forgets the "
-                                    "decision"
-                                ),
-                                key=make_key(
-                                    "wal-unjournaled-apply",
-                                    path,
-                                    f"{qualname}:{marker}",
-                                ),
-                            )
+                    sites.add(
+                        _Site(
+                            s.marker,
+                            call.lineno,
+                            (v.qualname,) + s.via,
+                            ((v.path, s.line),) + s.hops,
                         )
+                    )
+            return frozenset(sites)
+
+        changed = True
+        while changed:
+            changed = False
+            for u in index.units:
+                if exempt(u) or not in_scope(u):
                     continue
-                first_journal = min(journal_lines)
-                for ln, marker in applies:
-                    if ln < first_journal:
-                        out.append(
-                            Finding(
-                                rule="wal-apply-before-journal",
-                                path=path,
-                                line=ln,
-                                message=(
-                                    f"{qualname} applies {marker} at line "
-                                    f"{ln} before its first journal append "
-                                    f"(line {first_journal}) — the apply-"
-                                    "then-append window the WAL exists to "
-                                    "close"
-                                ),
-                                key=make_key(
-                                    "wal-apply-before-journal",
-                                    path,
-                                    f"{qualname}:{marker}",
-                                ),
-                            )
-                        )
+                sites = analyze(u)
+                if sites != unprot[u.key()]:
+                    unprot[u.key()] = sites
+                    changed = True
+        return unprot
+
+    def _report(
+        self,
+        index: FlowIndex,
+        unprot: dict[tuple, frozenset],
+        in_scope,
+        exempt,
+        build_finding,
+    ) -> list[Finding]:
+        """Emit findings at the frontier: a unit's unprotected sites are
+        reported only when no in-scope caller exists to inherit them —
+        otherwise the unprotected caller carries them (or protects
+        them)."""
+        out: list[Finding] = []
+        for u in index.units:
+            if exempt(u) or not in_scope(u):
+                continue
+            sites = unprot[u.key()]
+            if not sites:
+                continue
+            callers = [c for c, _ in index.callers(u) if in_scope(c) and not exempt(c)]
+            if callers:
+                continue
+            for s in sorted(sites, key=lambda s: (s.line, s.marker, s.via)):
+                out.append(build_finding(u, s))
         return out
+
+    # -- the rule entrypoint ------------------------------------------
+
+    def run(self, ctxs: dict[str, FileCtx], root) -> list[Finding]:
+        index = FlowIndex(ctxs.values())
+        out: list[Finding] = []
+        out.extend(self._run_apply(index))
+        out.extend(self._run_publish(index))
+        return out
+
+    def _run_apply(self, index: FlowIndex) -> list[Finding]:
+        def direct_event(unit: FuncUnit, call: ast.Call) -> bool:
+            return _is_journal_call(call)
+
+        def direct_site(unit: FuncUnit, call: ast.Call) -> str | None:
+            return _apply_marker(call)
+
+        def in_scope(unit: FuncUnit) -> bool:
+            return unit.path not in REPLAY_FILES
+
+        def has_direct_journal(unit: FuncUnit) -> bool:
+            return any(_is_journal_call(c) for c in unit.cfg.calls())
+
+        def exempt(unit: FuncUnit) -> bool:
+            # Inside a marker's OWN definition, marker calls are the
+            # apply being implemented or a delegated apply half
+            # (_apply_eviction → _unwind_pod) — the journal duty lives at
+            # the marker's call sites.  A marker definition that journals
+            # internally (fleet/owner.py apply_handoff) is checked like
+            # any other function but still never propagates upward.
+            return unit.name in APPLY_MARKERS and not has_direct_journal(unit)
+
+        unprot = self._frontier(
+            index,
+            "journal",
+            direct_event,
+            direct_site,
+            _journal_guard,
+            in_scope,
+            exempt,
+        )
+
+        # transitive "any journal activity at all" — distinguishes the
+        # two finding kinds exactly as the per-function rule did
+        jany: dict[tuple, bool] = {
+            u.key(): any(_is_journal_call(c) for c in u.cfg.calls())
+            for u in index.units
+        }
+        changed = True
+        while changed:
+            changed = False
+            for u in index.units:
+                if jany[u.key()]:
+                    continue
+                for call in u.cfg.calls():
+                    v = index.resolve(u.path, call)
+                    if v is not None and jany.get(v.key()):
+                        jany[u.key()] = True
+                        changed = True
+                        break
+
+        def build(unit: FuncUnit, s: _Site) -> Finding:
+            if s.via:
+                depth = len(s.via)
+                chain = " -> ".join(s.via)
+                where = f"via {chain} ({depth} call{'s' if depth > 1 else ''} deep)"
+            else:
+                where = "directly"
+            if jany[unit.key()]:
+                rule = "wal-apply-before-journal"
+                tail = (
+                    "before any journal append dominates it — the apply-"
+                    "then-append window the WAL exists to close"
+                )
+            else:
+                rule = "wal-unjournaled-apply"
+                tail = (
+                    "with no journal append on the path — a crash here "
+                    "forgets the decision"
+                )
+            return Finding(
+                rule=rule,
+                path=unit.path,
+                line=s.line,
+                message=f"{unit.qualname} applies {s.marker} {where} {tail}",
+                key=make_key(rule, unit.path, f"{unit.qualname}:{s.marker}"),
+                also=s.hops,
+            )
+
+        # Marker-named defs that DO journal internally are analyzed but
+        # never propagated (exempt() is False for them only when they
+        # journal) — they report locally like any frontier unit.
+        return self._report(index, unprot, in_scope, exempt, build)
+
+    def _run_publish(self, index: FlowIndex) -> list[Finding]:
+        def direct_event(unit: FuncUnit, call: ast.Call) -> bool:
+            return _is_fsync_call(call)
+
+        def direct_site(unit: FuncUnit, call: ast.Call) -> str | None:
+            return _publish_marker(call)
+
+        def in_scope(unit: FuncUnit) -> bool:
+            return unit.path in PUBLISH_FILES
+
+        def exempt(unit: FuncUnit) -> bool:
+            return False
+
+        unprot = self._frontier(
+            index, "fsync", direct_event, direct_site, None, in_scope, exempt
+        )
+
+        def build(unit: FuncUnit, s: _Site) -> Finding:
+            if s.via:
+                depth = len(s.via)
+                chain = " -> ".join(s.via)
+                where = f"via {chain} ({depth} call{'s' if depth > 1 else ''} deep)"
+            else:
+                where = "directly"
+            return Finding(
+                rule="wal-unsynced-publish",
+                path=unit.path,
+                line=s.line,
+                message=(
+                    f"{unit.qualname} publishes with {s.marker} {where} "
+                    "without an os.fsync dominating it — after a crash "
+                    "the renamed file may hold garbage recovery trusts"
+                ),
+                key=make_key(
+                    "wal-unsynced-publish", unit.path, f"{unit.qualname}:{s.marker}"
+                ),
+                also=s.hops,
+            )
+
+        return self._report(index, unprot, in_scope, exempt, build)
+
+
+#: rule documentation consumed by check_lint --explain / --rule-catalog
+DOCS = {
+    "wal-apply-before-journal": {
+        "family": "wal",
+        "summary": "A durable apply site runs before the journal record that makes it redoable.",
+        "scope": "Commit paths: scheduler, queue, fleet owner/router/autoscaler/standby, controllers, engine/pipeline, framework/fairness, loadgen/checkpoint.",
+        "rationale": "A crash between apply and append forgets a decision the cluster already acted on — recovery cannot redo what was never recorded. Proven interprocedurally: the apply may sit several helper calls below the function that owns the ordering.",
+        "fix": "Append the journal record (or call a helper proven to journal on every path) before the apply; suppress with `# tpulint: disable=wal-apply-before-journal` plus a written reason at any hop of the reported chain.",
+    },
+    "wal-unjournaled-apply": {
+        "family": "wal",
+        "summary": "A durable apply site with no journal activity anywhere on its call chain.",
+        "scope": "Same commit paths as wal-apply-before-journal.",
+        "rationale": "State mutated with no write-ahead record at all is silently lossy across restarts — the recovery scan has nothing to replay.",
+        "fix": "Journal the mutation first; if the site is deliberately volatile (observability mirror), suppress with a reason.",
+    },
+    "wal-unsynced-publish": {
+        "family": "wal",
+        "summary": "os.replace/os.rename publish not dominated by an os.fsync of the payload.",
+        "scope": "journal.py, fleet/shardmap.py, fleet/standby.py, loadgen/checkpoint.py, engine/pipeline.py.",
+        "rationale": "Atomic rename is only atomic about NAMES — without the data fsync the renamed file can hold garbage after a crash, and recovery trusts whatever it finds under the published name.",
+        "fix": "fsync the file object (directly or via a flush helper that syncs on every path) before the rename.",
+    },
+}

@@ -175,3 +175,29 @@ class WireRule(Rule):
             ):
                 out.add(node.attr)
         return out
+
+
+#: rule documentation consumed by check_lint --explain / --rule-catalog
+DOCS = {
+    "wire-missing-handler": {
+        "family": "wire",
+        "summary": "Proto RPC with no server handler.",
+        "scope": "proto/sidecar.proto vs kubernetes_tpu/sidecar/server.py.",
+        "rationale": "The wire surface is checked exhaustively both ways; a declared RPC nobody serves fails only at first call, in production.",
+        "fix": "Implement the handler or drop the RPC from the proto.",
+    },
+    "wire-missing-client": {
+        "family": "wire",
+        "summary": "Proto RPC with no client method.",
+        "scope": "proto/sidecar.proto vs the sidecar client surface.",
+        "rationale": "An RPC without a client binding is dead wire surface — or a client hand-rolling the call without the envelope checks.",
+        "fix": "Add the client method or drop the RPC.",
+    },
+    "wire-unknown-kind": {
+        "family": "wire",
+        "summary": "Server handles or client sends a kind absent from the proto.",
+        "scope": "Same wire surface.",
+        "rationale": "Kinds invented outside the proto skip schema review and version gating; peers on the pinned proto reject them.",
+        "fix": "Declare the kind in proto/sidecar.proto first.",
+    },
+}

@@ -78,6 +78,38 @@ def _hash_u32(x: int) -> int:
     return (x ^ (x >> 16)) & 0xFFFFFFFF
 
 
+# The Tesserae compromise, as a registry instead of a docstring: score
+# ops whose math reduces over the GLOBAL candidate axis (a max/min/sum
+# across all feasible nodes) cannot be reproduced exactly by per-shard
+# evaluation — each shard normalizes against its own candidates, so the
+# gathered verdicts the router argmaxes over may differ from what one
+# scheduler would have computed.  Every op listed here accepts that
+# divergence deliberately (partition the cluster, preserve the
+# constraints that matter); an op that reduces over the candidate set
+# WITHOUT being listed is a silent fleet-vs-single divergence, and
+# tpulint's ``jax-partition-unsafe`` rule fails the build on it.  The
+# same rule flags stale entries, so this set mirrors ops/ exactly.
+#
+# Orthogonal to engine/pass_.py PINNED_SAFE_OPS (node-axis-only *state*):
+# ImageLocality reads only node-axis state yet normalizes its spread
+# ratio over the feasible count, so it is pinned-safe but
+# partition-inexact.
+PARTITION_INEXACT_OPS = frozenset({
+    # spread = nodes-with-image / total valid nodes (state.valid.sum()).
+    "ImageLocality",
+    # min/max over jnp.where(feasible, raw, ±big) rescales to [0, 100].
+    "InterPodAffinity",
+    # DefaultNormalizeScore: raw * 100 // max over feasible (helpers.py).
+    "NodeAffinity",
+    # topoSize/domain minima count *scored* (feasible ∧ keys) candidates,
+    # and the final rescale min/maxes over the scored mask.
+    "PodTopologySpread",
+    # DefaultNormalizeScore, reversed (fewer intolerable taints is
+    # better) — same feasible-set max.
+    "TaintToleration",
+})
+
+
 @dataclass
 class _GangRoom:
     """Reserved-but-uncommitted members of one gang (phase 1 done)."""
@@ -1137,6 +1169,10 @@ class FleetRouter:
             # per-tenant weight, credit balance, virtual-time lag, and
             # starvation-SLO verdict (owners mirror the pushed copy).
             out["fairness"] = self.queue.admission.status()
+        # Which score families are shard-approximate in this deployment —
+        # operators comparing fleet vs single-scheduler transcripts read
+        # this before filing a parity bug.
+        out["partition_inexact_ops"] = sorted(PARTITION_INEXACT_OPS)
         return out
 
     def fleet_flight_snapshots(

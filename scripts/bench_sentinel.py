@@ -15,6 +15,9 @@ evaluated over the committed BENCH_*/SOAK_*/OBS_TAX trajectory:
   fair_steady_p99    fairness isolation: the steady tenant's p99 under a
                      capped burst vs its recorded solo-baseline tolerance
   fair_starvation    starvation-SLO violations in the fairness soak (= 0)
+  lint_findings      tpulint unsuppressed findings on the tree (= 0)
+  lint_suppressions  tpulint suppression budget (pragmas are documented
+                     exceptions, not a pressure valve)
 
 Each guard has a WARN boundary (reported, tunnel weather happens — see
 README measurement discipline) and a HARD floor (exit 1: beyond any
@@ -165,6 +168,30 @@ GUARDS = (
         "SETTLE, not smear into the next window",
     },
     {
+        "name": "lint_findings",
+        "live": "lint",
+        "path": ("findings",),
+        "op": "max",
+        "warn": 0,
+        "hard": 0,
+        "why": "tpulint unsuppressed findings: the static invariants "
+        "(WAL ordering, determinism, metrics/wire hygiene, JAX device "
+        "discipline) hold on the tree under test — the only live-"
+        "measured guard, since lint state is not a committed artifact",
+    },
+    {
+        "name": "lint_suppressions",
+        "live": "lint",
+        "path": ("suppressions",),
+        "op": "max",
+        "warn": 3,
+        "hard": 8,
+        "why": "tpulint suppression budget: pragmas are documented "
+        "exceptions (the committed tree carries three), not a pressure "
+        "valve — growth past the hard cap means an invariant is being "
+        "argued with instead of upheld",
+    },
+    {
         "name": "prod_promotion_max",
         "source": {
             "family": "SOAK_PROD_r*.json",
@@ -178,6 +205,40 @@ GUARDS = (
         "the pool stopped being warm",
     },
 )
+
+
+_LINT_CACHE: dict = {}
+
+
+def _lint_stats(root: str) -> dict | None:
+    """Live tpulint roll-up (finding/suppression counts) for the
+    ``live: lint`` guards — the one source kind that measures the tree
+    under test itself rather than a committed artifact.  Loads the
+    runner by file path (stdlib-only stays stdlib-only), memoized per
+    root since two guards share one lint run."""
+    if root in _LINT_CACHE:
+        return _LINT_CACHE[root]
+    stats = None
+    try:
+        import importlib.util
+
+        runner = os.path.join(root, "scripts", "check_lint.py")
+        spec = importlib.util.spec_from_file_location("_sentinel_check_lint", runner)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        tpulint = mod.load_tpulint()
+        baseline = tpulint.load_baseline(os.path.join(root, mod.BASELINE_NAME))
+        result = tpulint.run_lint(
+            root, baseline=baseline, cache=mod.make_cache(root)
+        )
+        stats = {
+            "findings": len(result.findings),
+            "suppressions": result.suppressed,
+        }
+    except Exception:
+        stats = None  # surfaces as a ``missing`` guard, not a crash
+    _LINT_CACHE[root] = stats
+    return stats
 
 
 def newest_artifact(root: str, family: str) -> str | None:
@@ -224,7 +285,14 @@ def _eval_guard(guard: dict, payload: dict | None, root: str) -> dict:
     # artifact family (obs_tax, the fairness soak — the payload never
     # carries them).
     denom = None
-    if "source" in guard:
+    if "live" in guard:
+        stats = _lint_stats(root)
+        value = _dig(stats or {}, guard["path"])
+        if value is None:
+            out["status"] = "missing"
+            out["missing"] = f"live:{guard['live']}"
+            return out
+    elif "source" in guard:
         src = newest_artifact(root, guard["source"]["family"])
         if src is None:
             out["status"] = "missing"

@@ -18,9 +18,29 @@ Usage:
         # print the metrics catalog (family, type, labels, help) as the
         # markdown table README's "Metrics catalog" section embeds — a
         # tier-1 test asserts the README matches this output
+    python scripts/check_lint.py --rule-catalog
+        # print the RULE catalog (id, family, what it catches, remedy)
+        # as the markdown table README's "Rule catalog" section embeds
+        # (--catalog was already taken by the metrics table)
+    python scripts/check_lint.py --explain wal-unsynced-publish
+    python scripts/check_lint.py --explain "metrics-prefix::path::name:x"
+        # explain a rule id — or a finding/baseline key — in full:
+        # scope, rationale, remedy, and the baseline justification when
+        # the key is grandfathered
+    python scripts/check_lint.py --sarif
+        # SARIF 2.1.0 on stdout, for code-scanning UIs
+    python scripts/check_lint.py --changed kubernetes_tpu/queue.py ...
+        # fast mode: run only the rule families whose file scope
+        # intersects the given paths (stale-baseline and
+        # unused-suppression enforcement is skipped — a partial run
+        # cannot prove absence)
+
+Parse trees are cached under <root>/.tpulint_cache/ keyed by content
+hash (set TPULINT_CACHE=0 to disable).
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 configuration error
-(malformed or unjustified baseline).
+(malformed or unjustified baseline, stale baseline entries, or unused
+suppressions — the lint config must describe the tree it lints).
 
 The engine lives in kubernetes_tpu/analysis/ but is loaded WITHOUT
 importing the package root (which pulls JAX) — linting must stay cheap
@@ -110,6 +130,123 @@ def render_catalog(root: str) -> str:
     return "\n".join(lines)
 
 
+def render_rule_catalog() -> str:
+    """All lint rules as a markdown table — the generated body of
+    README's "Rule catalog" section (between the rule-catalog markers).
+    One row per rule id, grouped by family in registration order."""
+    tpulint = load_tpulint()
+    lines = [
+        "| rule | family | what it catches | remedy |",
+        "|---|---|---|---|",
+    ]
+    for rule_id, doc in tpulint.rule_docs().items():
+        lines.append(
+            f"| `{rule_id}` | {doc['family']} | {doc['summary']} | {doc['fix']} |"
+        )
+    return "\n".join(lines)
+
+
+def explain(key: str, root: str, baseline_path: str) -> int:
+    """Explain a rule id or a finding/baseline key on stdout."""
+    tpulint = load_tpulint()
+    docs = tpulint.rule_docs()
+    rule_id = key.split("::", 1)[0]
+    doc = docs.get(rule_id)
+    if doc is None:
+        known = ", ".join(sorted(docs))
+        print(f"check_lint: unknown rule {rule_id!r} (known: {known})", file=sys.stderr)
+        return 2
+    print(f"{rule_id} ({doc['family']} family)")
+    print(f"  what:      {doc['summary']}")
+    print(f"  scope:     {doc['scope']}")
+    print(f"  rationale: {doc['rationale']}")
+    print(f"  remedy:    {doc['fix']}")
+    if "::" in key:
+        try:
+            baseline = tpulint.load_baseline(baseline_path)
+        except tpulint.BaselineError:
+            baseline = {}
+        entry = baseline.get(key)
+        if entry is not None:
+            print(f"  baselined: yes — {entry['justification']}")
+        else:
+            print("  baselined: no (key not in the baseline)")
+    return 0
+
+
+def render_sarif(result, root: str) -> dict:
+    """The run as minimal SARIF 2.1.0 (code-scanning import surface)."""
+    tpulint = load_tpulint()
+    docs = tpulint.rule_docs()
+    rules = [
+        {
+            "id": rule_id,
+            "shortDescription": {"text": doc["summary"]},
+            "fullDescription": {"text": doc["rationale"]},
+            "help": {"text": doc["fix"]},
+        }
+        for rule_id, doc in docs.items()
+    ]
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = [
+        {
+            "ruleId": f.rule,
+            "ruleIndex": index.get(f.rule, -1),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        for f in result.findings
+    ]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "scripts/check_lint.py",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def select_rules(tpulint, root: str, changed: list[str]):
+    """The subset of default rules whose file scope intersects
+    ``changed`` (paths relative to root or absolute)."""
+    rels = set()
+    for p in changed:
+        ap = os.path.abspath(p)
+        rel = os.path.relpath(ap, root) if ap.startswith(root) else p
+        rels.add(rel.replace(os.sep, "/"))
+    picked = []
+    for rule in tpulint.default_rules():
+        scope = set(rule.files(root))
+        if scope & rels:
+            picked.append(rule)
+    return picked
+
+
+def make_cache(root: str):
+    """ParseCache under <root>/.tpulint_cache, honoring TPULINT_CACHE=0."""
+    if os.environ.get("TPULINT_CACHE", "1") == "0":
+        return None
+    tpulint = load_tpulint()
+    return tpulint.ParseCache(os.path.join(root, ".tpulint_cache"))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=REPO)
@@ -117,6 +254,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--catalog", action="store_true")
+    ap.add_argument("--rule-catalog", action="store_true")
+    ap.add_argument("--explain", metavar="KEY")
+    ap.add_argument("--sarif", action="store_true")
+    ap.add_argument("--changed", nargs="+", metavar="PATH")
     args = ap.parse_args(argv)
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
@@ -125,12 +266,31 @@ def main(argv=None) -> int:
         print(render_catalog(root))
         return 0
 
+    if args.rule_catalog:
+        print(render_rule_catalog())
+        return 0
+
+    if args.explain:
+        return explain(args.explain, root, baseline_path)
+
     if args.write_baseline:
         return write_baseline(root, baseline_path)
 
     tpulint = load_tpulint()
+    rules = None
+    if args.changed:
+        rules = select_rules(tpulint, root, args.changed)
+        if not rules:
+            if args.as_json:
+                print(json.dumps({"findings": [], "clean": True, "rules_run": []}))
+            else:
+                print("check_lint: no rule scope intersects the changed paths")
+            return 0
     try:
-        result, _baseline = run(root, baseline_path)
+        baseline = tpulint.load_baseline(baseline_path)
+        result = tpulint.run_lint(
+            root, rules=rules, baseline=baseline, cache=make_cache(root)
+        )
     except tpulint.BaselineError as exc:
         if args.as_json:
             print(json.dumps({"error": str(exc), "clean": False}))
@@ -138,22 +298,43 @@ def main(argv=None) -> int:
             print(f"check_lint: baseline error: {exc}", file=sys.stderr)
         return 2
 
-    if args.as_json:
-        print(json.dumps(result.as_dict(), indent=2))
+    # A partial (--changed) run cannot prove a suppression or baseline
+    # entry unused — only full runs enforce config hygiene.
+    enforce_config = not args.changed
+    config_rot = enforce_config and bool(
+        result.stale_baseline or result.unused_suppressions
+    )
+
+    if args.sarif:
+        print(json.dumps(render_sarif(result, root), indent=2))
+    elif args.as_json:
+        doc = result.as_dict()
+        if args.changed:
+            doc["rules_run"] = [r.name for r in rules]
+        print(json.dumps(doc, indent=2))
     else:
         for f in result.findings:
             print(f.render())
-        for key in result.stale_baseline:
-            print(
-                f"check_lint: warning: stale baseline entry {key} "
-                "(finding no longer produced — prune it)",
-                file=sys.stderr,
-            )
+        if enforce_config:
+            for key in result.stale_baseline:
+                print(
+                    f"check_lint: stale baseline entry {key} "
+                    "(finding no longer produced — prune it)",
+                    file=sys.stderr,
+                )
+            for sup in result.unused_suppressions:
+                print(
+                    f"check_lint: unused suppression {sup} "
+                    "(no finding matches — remove it)",
+                    file=sys.stderr,
+                )
         print(
             f"check_lint: {len(result.findings)} finding(s), "
             f"{result.baselined} baselined, {result.suppressed} suppressed"
         )
-    return 0 if result.clean else 1
+    if not result.clean:
+        return 1
+    return 2 if config_rot else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,79 @@
+"""Seeded jax-family violations, device-discipline half
+(tests/test_static_analysis.py).
+
+Miniature compiled-pass shapes where each hazard the rules exist for is
+committed on purpose: host syncs inside jit, retrace-per-call static
+args, and donated buffers read after dispatch.
+"""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@jax.jit
+def _kernel(state, pf):
+    total = jnp.sum(state.req * pf["weight"])
+    # POSITIVE jax-host-sync: .item() forces a device->host transfer on
+    # every pass invocation.
+    budget = total.item()
+    # POSITIVE jax-host-sync: float() over a traced value is the same
+    # sync spelled differently (and a TypeError under trace).
+    norm = float(jnp.max(total))
+    # POSITIVE jax-host-sync: branching on a device value blocks on the
+    # transfer (lax.cond is the on-device form).
+    if jnp.any(state.valid):
+        norm = norm + 1.0
+    return total, budget, norm
+
+
+@jax.jit
+def _outer(state, pf):
+    # The sync hides one call down — the closure walk still finds it.
+    return _scale(state, pf)
+
+
+def _scale(state, pf):
+    # POSITIVE jax-host-sync (reported against _scale, a device context
+    # by closure): asserting on a traced value syncs.
+    assert state.valid.any()
+    return state.req * pf["weight"]
+
+
+def _step(state, pf, ks):
+    return state.req[ks]
+
+
+step = jax.jit(_step, static_argnums=(2,))
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def _ranked(state, pf, mode):
+    return state.req * (2 if mode == "wide" else 1)
+
+
+def drive_retrace(state, pf, names):
+    # POSITIVE jax-retrace-hazard: a list in a static position is
+    # unhashable — TypeError at dispatch.
+    a = step(state, pf, [1, 2, 3])
+    # POSITIVE jax-retrace-hazard: a fresh expression per call in a
+    # static position recompiles the kernel every time.
+    b = step(state, pf, len(names) + 1)
+    # POSITIVE jax-retrace-hazard: same hazard through static_argnames.
+    c = _ranked(state, pf, mode="wide-%d" % len(names))
+    return a, b, c
+
+
+def _apply(state, pf):
+    return state
+
+
+apply_step = jax.jit(_apply, donate_argnums=(0,))
+
+
+def drive_donation(state, pf):
+    out = apply_step(state, pf)
+    # POSITIVE jax-donation-reuse: ``state`` was donated at dispatch —
+    # this read touches a buffer the runtime already reused.
+    stale = state.num_pods
+    return out, stale

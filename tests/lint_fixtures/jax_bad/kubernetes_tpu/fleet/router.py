@@ -1,0 +1,11 @@
+"""Fixture router registry for the jax-partition-unsafe rule: lists an
+op nobody defines (stale) and omits the one that actually reduces over
+the candidate axis (ShardBlindAffinity, ops/badop.py)."""
+
+PARTITION_INEXACT_OPS = frozenset(
+    {
+        # POSITIVE (stale entry): no registered score op of this name
+        # reduces over the candidate axis.
+        "GhostOp",
+    }
+)

@@ -1,0 +1,37 @@
+"""Seeded jax-partition-unsafe violation: a score op that normalizes
+over the GLOBAL candidate axis without being registered in the router's
+PARTITION_INEXACT_OPS — per-shard evaluation would silently diverge from
+a single scheduler."""
+
+import jax.numpy as jnp
+
+from ..framework import OpDef
+
+
+def score_fn(state, pf, ctx, feasible):
+    raw = pf["affinity_rows"].sum(axis=1)
+    # The hazard: max over ALL feasible candidates — each fleet shard
+    # sees only its own slice, so the normalizer differs per shard.
+    peak = jnp.max(jnp.where(feasible, raw, 0))
+    return jnp.where(feasible, (raw * 100) // jnp.maximum(peak, 1), 0)
+
+
+def gather_score_fn(state, pf, ctx, feasible):
+    # NEGATIVE shape in the bad tree: pure per-candidate gather math,
+    # no cross-candidate reduction — stays unregistered AND unflagged.
+    return jnp.where(feasible, pf["local_hint"], 0)
+
+
+BAD_OP = OpDef(
+    name="ShardBlindAffinity",
+    featurize=None,
+    filter=None,
+    score=score_fn,
+)
+
+GATHER_OP = OpDef(
+    name="LocalHint",
+    featurize=None,
+    filter=None,
+    score=gather_score_fn,
+)

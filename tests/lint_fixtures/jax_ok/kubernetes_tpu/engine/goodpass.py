@@ -1,0 +1,67 @@
+"""jax-family negative fixture, device-discipline half: the same pass
+shapes as the bad tree with every hazard spelled the disciplined way.
+Zero findings expected — including the host-static idioms (`"k" in pf`,
+`x is None`, `.shape` reads) the rules must NOT confuse for syncs."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def _kernel(state, pf):
+    total = jnp.sum(state.req * pf["weight"])
+    # Device-side branch: lax.cond keeps the select on device.
+    norm = lax.cond(
+        jnp.any(state.valid),
+        lambda t: t + 1.0,
+        lambda t: t,
+        jnp.max(total),
+    )
+    # Host-static idioms that merely mention traced names:
+    if "port_keys" in pf:
+        total = total + jnp.sum(pf["port_keys"])
+    k = state.req.shape[0]
+    if k > 1:
+        total = total * 2
+    return total, norm
+
+
+@jax.jit
+def _outer(state, pf):
+    return _scale(state, pf)
+
+
+def _scale(state, pf, bias=None):
+    # Identity-vs-None on a traced argument is host-static.
+    if bias is None:
+        return state.req * pf["weight"]
+    return state.req * pf["weight"] + bias
+
+
+def _step(state, pf, ks):
+    return state.req[ks]
+
+
+step = jax.jit(_step, static_argnums=(2,))
+
+
+def drive_static(state, pf):
+    # Hashable constants in static positions: one trace, no churn.
+    a = step(state, pf, 3)
+    b = step(state, pf, 7)
+    return a, b
+
+
+def _apply(state, pf):
+    return state
+
+
+apply_step = jax.jit(_apply, donate_argnums=(0,))
+
+
+def drive_donation(state, pf):
+    # The donation idiom: rebind the result over the donated name —
+    # nothing reads the dead buffer.
+    state = apply_step(state, pf)
+    return state.num_pods

@@ -1,0 +1,9 @@
+"""Fixture router registry, healthy twin: exactly the ops that reduce
+over the candidate axis, nothing stale."""
+
+PARTITION_INEXACT_OPS = frozenset(
+    {
+        # ops/goodop.py score_fn normalizes by the global feasible peak.
+        "ShardBlindAffinity",
+    }
+)

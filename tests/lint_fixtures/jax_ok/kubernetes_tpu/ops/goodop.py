@@ -1,0 +1,32 @@
+"""jax-partition-unsafe negative fixture: the op that reduces over the
+candidate axis IS registered in the fixture router's
+PARTITION_INEXACT_OPS, and the gather-only op needs no entry."""
+
+import jax.numpy as jnp
+
+from ..framework import OpDef
+
+
+def score_fn(state, pf, ctx, feasible):
+    raw = pf["affinity_rows"].sum(axis=1)
+    peak = jnp.max(jnp.where(feasible, raw, 0))
+    return jnp.where(feasible, (raw * 100) // jnp.maximum(peak, 1), 0)
+
+
+def gather_score_fn(state, pf, ctx, feasible):
+    return jnp.where(feasible, pf["local_hint"], 0)
+
+
+REGISTERED_OP = OpDef(
+    name="ShardBlindAffinity",
+    featurize=None,
+    filter=None,
+    score=score_fn,
+)
+
+GATHER_OP = OpDef(
+    name="LocalHint",
+    featurize=None,
+    filter=None,
+    score=gather_score_fn,
+)

@@ -1,0 +1,40 @@
+"""Seeded INTERPROCEDURAL WAL violations (tests/test_static_analysis.py).
+
+The pre-flow engine matched journal/apply pairs per function, so an
+apply site buried inside a helper was invisible from the caller — the
+blind spot ISSUE 19 closes.  Each positive here hides the apply one or
+two calls below the function that owns the ordering decision; the
+finding must surface at the FRONTIER (the outermost caller with no
+in-scope callers of its own), naming the chain.
+"""
+
+
+class DeepScheduler:
+    # -- two-call-deep unjournaled apply --------------------------------
+
+    def commit_via_helpers(self, qp, node):
+        # POSITIVE wal-unjournaled-apply, reported HERE: no journal
+        # activity anywhere on the chain, and the actual apply is two
+        # calls down (commit_via_helpers -> _stage -> _land).
+        self._stage(qp, node)
+
+    def _stage(self, qp, node):
+        self._land(qp, node)
+
+    def _land(self, qp, node):
+        self.cache.finish_binding(qp.pod.uid)
+
+    # -- two-call-deep apply racing the journal -------------------------
+
+    def commit_then_record(self, qp, node):
+        # POSITIVE wal-apply-before-journal, reported HERE: the helper
+        # chain lands the binding first, the journal record comes after
+        # — a crash between the two forgets an applied decision.
+        self._stage_fast(qp, node)
+        self._journal_bind(qp.pod, node)
+
+    def _stage_fast(self, qp, node):
+        self._land_fast(qp, node)
+
+    def _land_fast(self, qp, node):
+        self.cache.finish_binding(qp.pod.uid)

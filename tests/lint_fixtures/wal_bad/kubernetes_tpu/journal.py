@@ -1,0 +1,36 @@
+"""Seeded wal-unsynced-publish violations: atomic-rename publishes whose
+bytes were never forced to disk first.  os.replace is only atomic about
+NAMES — without the fsync the renamed file can hold garbage after a
+crash, and recovery trusts whatever it finds there.
+"""
+
+import os
+
+
+class BadSnapshotter:
+    def rotate(self, path, tmp):
+        # POSITIVE wal-unsynced-publish: rename with no fsync anywhere
+        # on the path.
+        with open(tmp, "wb") as f:
+            f.write(self._encode())
+        os.replace(tmp, path)
+
+    def publish_via_helper(self, path, tmp):
+        # POSITIVE, reported HERE (the frontier): the helper does the
+        # rename, no caller or callee ever fsyncs.
+        with open(tmp, "wb") as f:
+            f.write(self._encode())
+        self._swap(tmp, path)
+
+    def _swap(self, tmp, path):
+        os.replace(tmp, path)
+
+    def fsync_on_one_branch_only(self, path, tmp, fast):
+        # POSITIVE: the fast path skips the fsync, so the rename is not
+        # DOMINATED by it — must-analysis catches the racy branch.
+        f = open(tmp, "wb")
+        f.write(self._encode())
+        if not fast:
+            os.fsync(f.fileno())
+        f.close()
+        os.rename(tmp, path)

@@ -1,0 +1,35 @@
+"""Interprocedural WAL negative fixture: the ordering is right but only
+visible ACROSS functions — the shapes the per-function engine either
+false-positived on (helper journals, caller applies) or could not credit
+at all (journal and apply both buried in helpers, correctly ordered).
+Zero findings expected."""
+
+
+class DeepGoodScheduler:
+    def commit(self, qp, node):
+        # The journal record is appended by a helper; the per-function
+        # matcher saw an apply with no journal here and cried wolf.  The
+        # flow engine proves _record journals on every path, so the
+        # apply below is dominated.
+        self._record(qp, node)
+        self.cache.finish_binding(qp.pod.uid)
+
+    def _record(self, qp, node):
+        self._journal_bind(qp.pod, node)
+
+    def commit_all_buried(self, qp, node):
+        # Journal AND apply both live in helpers, ordered correctly.
+        self._record(qp, node)
+        self._land(qp, node)
+
+    def _land(self, qp, node):
+        self.cache.finish_binding(qp.pod.uid)
+
+    def commit_helper_owns_ordering(self, qp, node):
+        # The helper itself journals-then-applies; every caller is clean
+        # by construction.
+        self._record_and_land(qp, node)
+
+    def _record_and_land(self, qp, node):
+        self._journal_bind(qp.pod, node)
+        self.cache.finish_binding(qp.pod.uid)

@@ -1,0 +1,38 @@
+"""wal-unsynced-publish negative fixture: every rename is dominated by a
+data fsync — directly, via a flush helper, or on both arms of a branch.
+Zero findings expected."""
+
+import os
+
+
+class GoodSnapshotter:
+    def rotate(self, path, tmp):
+        with open(tmp, "wb") as f:
+            f.write(self._encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def rotate_via_flush_helper(self, path, tmp):
+        # The fsync lives in a helper; the flow engine proves _flush
+        # syncs on every path, so this rename is dominated.
+        f = open(tmp, "wb")
+        f.write(self._encode())
+        self._flush(f)
+        f.close()
+        os.replace(tmp, path)
+
+    def _flush(self, f):
+        f.flush()
+        os.fsync(f.fileno())
+
+    def rotate_both_branches(self, path, tmp, compress):
+        f = open(tmp, "wb")
+        if compress:
+            f.write(self._encode_compressed())
+            os.fsync(f.fileno())
+        else:
+            f.write(self._encode())
+            os.fsync(f.fileno())
+        f.close()
+        os.rename(tmp, path)

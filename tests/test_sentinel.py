@@ -41,6 +41,7 @@ def test_committed_trajectory_passes_every_guard():
         "headline", "flagship", "journal_fsyncs", "overlap_coverage",
         "slo_p99", "obs_tax", "fair_steady_p99", "fair_starvation",
         "prod_service_p99", "prod_recovery_p99", "prod_promotion_max",
+        "lint_findings", "lint_suppressions",
     }
 
 
@@ -96,6 +97,27 @@ def test_missing_payload_fields_report_as_missing():
     assert statuses["slo_p99"] == "missing"
     assert statuses["obs_tax"] == "pass"  # artifact-sourced, payload-free
     assert block["ok"]  # missing is loud, not fatal
+
+
+def test_lint_guards_ride_the_live_tree():
+    """The lint guard rows are live-sourced (they run tpulint, not a
+    payload field): zero unsuppressed findings, and the suppression
+    count stays inside its warn band so pragma creep surfaces here."""
+    block = sentinel.evaluate(committed_payload())
+    guards = {g["name"]: g for g in block["guards"]}
+    assert guards["lint_findings"]["status"] == "pass"
+    assert guards["lint_findings"]["value"] == 0
+    assert guards["lint_suppressions"]["status"] == "pass"
+    assert guards["lint_suppressions"]["value"] >= 1
+
+
+def test_lint_guards_degrade_to_missing_off_tree(tmp_path):
+    """Against a root with no lintable tree the live source reports
+    missing — loud, never a hard failure (same contract as artifacts)."""
+    block = sentinel.evaluate(committed_payload(), root=str(tmp_path))
+    statuses = {g["name"]: g["status"] for g in block["guards"]}
+    assert statuses["lint_findings"] == "missing"
+    assert statuses["lint_suppressions"] == "missing"
 
 
 def test_newest_artifact_picks_the_highest_round(tmp_path):

@@ -3,8 +3,9 @@
 Two halves, same pattern as scripts/check_go.sh / tests/test_go_build.py:
 
 - the REPO must be clean — ``scripts/check_lint.py`` exits 0 with zero
-  unsuppressed findings (the WAL/determinism/metrics/wire invariants
-  hold on the real tree);
+  unsuppressed findings (the WAL/determinism/metrics/wire/JAX
+  invariants hold on the real tree — the WAL and JAX families proven
+  interprocedurally on the flow engine since ISSUE 19);
 - each rule family must demonstrably FIRE — seeded-violation fixture
   trees under tests/lint_fixtures/ carry ≥2 positive cases per family
   plus a negative tree that yields nothing, and the suppression +
@@ -99,10 +100,15 @@ def test_wal_rules_fire_on_seeded_violations():
     # in the standby-pool fixture (a promotion made live before — or
     # without — its pool WAL record, ISSUE 18) + one of each in the
     # checkpoint-writer fixture (a generation published before — or
-    # without — its journaled digest, ISSUE 18).
-    assert got.count("wal-apply-before-journal") == 9
-    assert got.count("wal-unjournaled-apply") == 9
-    assert len(got) == 18, got  # the healthy shapes stay silent
+    # without — its journaled digest, ISSUE 18) + one of each in the
+    # deep helper-chain fixture (the apply buried TWO calls below the
+    # commit path — the interprocedural blind spot ISSUE 19 closes).
+    assert got.count("wal-apply-before-journal") == 10
+    assert got.count("wal-unjournaled-apply") == 10
+    # ISSUE 19's publish sub-rule: three unsynced-rename shapes in the
+    # journal.py snapshotter fixture (direct, via helper, one-branch).
+    assert got.count("wal-unsynced-publish") == 3
+    assert len(got) == 23, got  # the healthy shapes stay silent
 
 
 def test_wal_rules_cover_fleet_handoffs():
@@ -471,3 +477,509 @@ def test_catalog_names_and_labels_are_statically_complete():
     )
     for e in entries.values():
         assert e["type"] in ("counter", "gauge", "histogram"), e
+
+
+# -- flow engine (ISSUE 19 tentpole core) -----------------------------------
+
+
+def _flow():
+    from tpulint import flow
+
+    return flow
+
+
+def _units_of(src: str, *names):
+    """FlowIndex over a one-file tree plus the named FuncUnits."""
+    import ast
+
+    flow = _flow()
+    from tpulint.core import FileCtx
+
+    ctx = FileCtx(path="m.py", source=src, tree=ast.parse(src))
+    index = flow.FlowIndex([ctx])
+    by_name = {u.name: u for u in index.units}
+    return (index,) + tuple(by_name[n] for n in names)
+
+
+def _mark_gen():
+    """A gen function for must_facts: mark() establishes "marked",
+    every call site samples the in-flight fact set."""
+    flow = _flow()
+
+    def gen(item):
+        for c in flow.iter_calls(item):
+            if getattr(c.func, "id", "") == "mark":
+                yield c, ("marked",)
+            else:
+                yield c, ()
+
+    return gen
+
+
+def _call_at(unit, line):
+    (call,) = [c for c in unit.cfg.calls() if c.lineno == line]
+    return call
+
+
+def test_flow_must_facts_branch_join_is_intersection():
+    """must-analysis: a fact established on only ONE arm of an if does
+    not survive the join; established on BOTH arms it does."""
+    flow = _flow()
+    src = (
+        "def one_arm(x):\n"
+        "    if x:\n"
+        "        mark()\n"
+        "    done()\n"
+        "def both_arms(x):\n"
+        "    if x:\n"
+        "        mark()\n"
+        "    else:\n"
+        "        mark()\n"
+        "    done()\n"
+    )
+    _, one, both = _units_of(src, "one_arm", "both_arms")
+    at, exit_facts = flow.must_facts(one.cfg, _mark_gen())
+    assert "marked" not in at[id(_call_at(one, 4))]
+    assert "marked" not in exit_facts
+    at, exit_facts = flow.must_facts(both.cfg, _mark_gen())
+    assert "marked" in at[id(_call_at(both, 10))]
+    assert "marked" in exit_facts
+
+
+def test_flow_for_loop_has_at_least_once_semantics():
+    """The drain idiom: journal each item in one for-loop, apply in the
+    next.  Strict zero-iteration semantics would flag every batched
+    journal, so for-bodies (without orelse) count as having run."""
+    flow = _flow()
+    src = (
+        "def f(items):\n"
+        "    for i in items:\n"
+        "        mark()\n"
+        "    done()\n"
+    )
+    _, unit = _units_of(src, "f")
+    at, _ = flow.must_facts(unit.cfg, _mark_gen())
+    assert "marked" in at[id(_call_at(unit, 4))]
+
+
+def test_flow_while_loop_stays_strict():
+    """while-loops keep the zero-iteration path: a fact established only
+    inside the body does not dominate the statement after."""
+    flow = _flow()
+    src = (
+        "def f(x):\n"
+        "    while x:\n"
+        "        mark()\n"
+        "    done()\n"
+    )
+    _, unit = _units_of(src, "f")
+    at, _ = flow.must_facts(unit.cfg, _mark_gen())
+    assert "marked" not in at[id(_call_at(unit, 4))]
+
+
+def test_flow_raise_paths_are_not_normal_returns():
+    """A helper that aborts by raising on the unjournaled path still
+    summarizes as establishing the fact — callers never resume after
+    the raise, so the apply site is unreachable on that path."""
+    flow = _flow()
+    src = (
+        "def f(x):\n"
+        "    if not x:\n"
+        "        raise ValueError(x)\n"
+        "    mark()\n"
+        "    done()\n"
+    )
+    _, unit = _units_of(src, "f")
+    _, exit_facts = flow.must_facts(unit.cfg, _mark_gen())
+    assert "marked" in exit_facts
+
+
+def test_flow_call_resolution_skips_generic_attrs():
+    """x.append/x.get never resolve to a local def of the same name —
+    the denylist keeps container methods out of the call graph."""
+    src = (
+        "def append(v):\n"
+        "    helper()\n"
+        "def f(out, v):\n"
+        "    out.append(v)\n"
+        "    record(v)\n"
+        "def record(v):\n"
+        "    pass\n"
+    )
+    index, unit = _units_of(src, "f")
+    resolved = {
+        (getattr(c.func, "attr", None) or getattr(c.func, "id", None)):
+        index.resolve("m.py", c)
+        for c in unit.cfg.calls()
+    }
+    assert resolved["append"] is None
+    assert resolved["record"] is not None and resolved["record"].name == "record"
+
+
+def test_flow_reads_after_rebind_kills():
+    """reads_after: a read on some path after the anchor is found, but a
+    rebind at the anchor statement itself (x = f(x)) kills tracking."""
+    flow = _flow()
+    src = (
+        "def f(state):\n"
+        "    out = dispatch(state)\n"
+        "    return state.field\n"
+        "def g(state):\n"
+        "    state = dispatch(state)\n"
+        "    return state.field\n"
+    )
+    index, unit_f, unit_g = _units_of(src, "f", "g")
+    (call_f,) = list(unit_f.cfg.calls())
+    (call_g,) = list(unit_g.cfg.calls())
+    assert flow.reads_after(unit_f.cfg, call_f, "state") is not None
+    assert flow.reads_after(unit_g.cfg, call_g, "state") is None
+
+
+# -- interprocedural WAL (ISSUE 19 tentpole, first half) --------------------
+
+
+def test_wal_catches_apply_buried_two_calls_deep():
+    """The acceptance shape: the apply is two helper calls below the
+    commit path; the finding surfaces at the FRONTIER with the chain."""
+    result = lint("wal_bad")
+    deep = [f for f in result.findings if f.path == "kubernetes_tpu/deepcommit.py"]
+    assert len(deep) == 2
+    by_rule = {f.rule: f for f in deep}
+    unj = by_rule["wal-unjournaled-apply"]
+    assert "commit_via_helpers" in unj.message
+    assert "2 calls deep" in unj.message
+    assert "_stage" in unj.message and "_land" in unj.message
+    # the chain hops ride the finding so a pragma at any hop suppresses
+    assert len(unj.also) == 2
+    abj = by_rule["wal-apply-before-journal"]
+    assert "commit_then_record" in abj.message
+    assert "2 calls deep" in abj.message
+
+
+def test_wal_helper_journal_no_longer_false_positives():
+    """The old per-function matcher flagged a caller whose journal
+    append lives in a helper; the flow engine proves the helper journals
+    on every path (wal_ok/deepcommit.py would fire 4+ findings under
+    the old engine)."""
+    result = lint("wal_ok")
+    assert result.findings == []
+
+
+def test_wal_publish_rule_fires_and_chains():
+    """fsync-before-rename, including through helpers: three seeded
+    shapes (direct, via helper with the chain in the message, fsync on
+    only one branch)."""
+    pubs = [
+        f for f in lint("wal_bad").findings if f.rule == "wal-unsynced-publish"
+    ]
+    assert len(pubs) == 3
+    assert all(f.path == "kubernetes_tpu/journal.py" for f in pubs)
+    via = [f for f in pubs if "_swap" in f.message]
+    assert len(via) == 1 and "1 call deep" in via[0].message
+
+
+def test_wal_chain_suppression_covers_any_hop(tmp_path):
+    """A pragma at a deeper hop of the chain suppresses the frontier
+    finding — recovery paths keep their pragma at the apply site."""
+    pkg = tmp_path / "kubernetes_tpu"
+    pkg.mkdir()
+    (pkg / "deepcommit.py").write_text(
+        "class C:\n"
+        "    def commit(self, qp):\n"
+        "        self._stage(qp)\n"
+        "    def _stage(self, qp):\n"
+        "        # recovery re-applies what the journal already holds\n"
+        "        # tpulint: disable=wal-unjournaled-apply\n"
+        "        self.cache.finish_binding(qp.uid)\n"
+    )
+    result = tpulint.run_lint(str(tmp_path))
+    assert result.findings == []
+    assert result.suppressed == 1
+    assert result.unused_suppressions == []
+
+
+# -- rule family: jax device discipline (ISSUE 19 tentpole, second half) ----
+
+
+def test_jax_rules_fire_on_seeded_violations():
+    """Each of the four jax rules fires on the bad tree (acceptance)."""
+    got = rules_of(lint("jax_bad"))
+    # .item() + float() + if-branch in the jitted kernel, assert in a
+    # helper reached through the device-context closure:
+    assert got.count("jax-host-sync") == 4
+    # unhashable list + varying expression in static_argnums positions,
+    # varying f-string-equivalent through static_argnames:
+    assert got.count("jax-retrace-hazard") == 3
+    # donated state read through the stale name after dispatch:
+    assert got.count("jax-donation-reuse") == 1
+    # one unregistered reducing op + one stale registry entry:
+    assert got.count("jax-partition-unsafe") == 2
+    assert len(got) == 10, got
+
+
+def test_jax_host_sync_reaches_helpers_via_closure():
+    """The device-context closure: the assert lives in _scale, which is
+    only a device context because a jitted function calls it."""
+    finds = [f for f in lint("jax_bad").findings if f.rule == "jax-host-sync"]
+    assert any("_scale" in f.message and "assert" in f.message for f in finds)
+
+
+def test_jax_partition_registry_is_mirrored_both_ways():
+    """Missing entry AND stale entry both fire — the registry must
+    mirror ops/ exactly."""
+    finds = [
+        f for f in lint("jax_bad").findings if f.rule == "jax-partition-unsafe"
+    ]
+    tokens = sorted(f.key.split("::")[-1] for f in finds)
+    assert tokens == ["op:ShardBlindAffinity", "stale:GhostOp"]
+    stale = [f for f in finds if "GhostOp" in f.key]
+    assert stale[0].path == "kubernetes_tpu/fleet/router.py"
+
+
+def test_jax_negative_tree_is_clean():
+    """The disciplined twins: lax.cond branches, shape-based branching,
+    dict-membership tests, is-None checks, hashable static args and the
+    rebind donation idiom all stay silent."""
+    assert lint("jax_ok").findings == []
+
+
+def test_jax_real_tree_registry_matches_ops():
+    """The real fleet/router.py PARTITION_INEXACT_OPS mirrors the real
+    ops/ reducers exactly — zero jax findings repo-wide rides
+    test_repo_is_lint_clean; this pins the registry contents so a
+    rename shows up here, not just as a lint failure."""
+    from tpulint.rules_jax import JaxRule
+
+    rule = JaxRule()
+    findings = tpulint.run_lint(REPO, rules=[rule]).findings
+    assert findings == []
+
+
+# -- unused suppressions & stale baseline are exit 2 (ISSUE 19) -------------
+
+
+def test_unused_suppression_is_reported_and_exits_2(tmp_path):
+    pkg = tmp_path / "kubernetes_tpu"
+    pkg.mkdir()
+    (pkg / "scheduler.py").write_text(
+        "class S:\n"
+        "    def ok(self, qp, node):\n"
+        "        self._journal_bind(qp.pod, node)\n"
+        "        # tpulint: disable=wal-unjournaled-apply\n"
+        "        self.cache.finish_binding(qp.pod.uid)\n"
+    )
+    result = tpulint.run_lint(str(tmp_path))
+    assert result.findings == []
+    assert len(result.unused_suppressions) == 1
+    assert "scheduler.py:4" in result.unused_suppressions[0]
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unused suppression" in proc.stderr
+
+
+def test_stale_baseline_is_exit_2(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"findings": [{
+        "key": "wal-unjournaled-apply::gone.py::f:quarantine",
+        "justification": "was fixed long ago",
+    }]}))
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT,
+            "--root", os.path.join(FIXTURES, "wal_ok"),
+            "--baseline", str(baseline),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "stale baseline" in proc.stderr
+
+
+def test_changed_mode_skips_config_enforcement(tmp_path):
+    """--changed is the pre-commit fast path: partial runs cannot prove
+    a suppression unused or a baseline entry stale, so they must not
+    exit 2 for config rot."""
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"findings": [{
+        "key": "wal-unjournaled-apply::gone.py::f:quarantine",
+        "justification": "stale on purpose",
+    }]}))
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT,
+            "--root", os.path.join(FIXTURES, "wal_ok"),
+            "--baseline", str(baseline),
+            "--changed", "kubernetes_tpu/scheduler.py",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- CLI surfaces: --explain / --sarif / --rule-catalog / --changed ---------
+
+
+def test_explain_rule_id():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--explain", "wal-unsynced-publish"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for field in ("what:", "scope:", "rationale:", "remedy:"):
+        assert field in proc.stdout
+
+
+def test_explain_baselined_key_shows_justification():
+    key = (
+        "metrics-prefix::kubernetes_tpu/framework/metrics.py::"
+        "scheduling_attempt_duration_seconds"
+    )
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--explain", key],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baselined: yes" in proc.stdout
+    assert "kube-scheduler" in proc.stdout  # the justification text
+
+
+def test_explain_unknown_rule_is_exit_2():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--explain", "no-such-rule"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "known:" in proc.stderr
+
+
+def test_sarif_output_shape():
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--sarif",
+            "--root", os.path.join(FIXTURES, "jax_bad"),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1  # findings present
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"jax-host-sync", "jax-retrace-hazard", "jax-donation-reuse",
+            "jax-partition-unsafe"} <= rule_ids
+    assert len(run["results"]) == 10
+    r0 = run["results"][0]
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].startswith("kubernetes_tpu/")
+    assert loc["region"]["startLine"] >= 1
+    # every result's ruleIndex points at its rule metadata
+    rules = run["tool"]["driver"]["rules"]
+    for r in run["results"]:
+        assert rules[r["ruleIndex"]]["id"] == r["ruleId"]
+
+
+def test_rule_docs_are_complete():
+    """Every finding any fixture produces has a DOCS entry with the four
+    required fields — a rule without documentation fails here, not in a
+    user's --explain."""
+    docs = tpulint.rule_docs()
+    fired = set()
+    for tree in ("wal_bad", "det_bad", "metrics_bad", "wire_bad", "jax_bad"):
+        fired.update(rules_of(lint(tree)))
+    missing = fired - set(docs)
+    assert not missing, f"rules without DOCS: {missing}"
+    for rule_id, doc in docs.items():
+        for field in ("family", "summary", "scope", "rationale", "fix"):
+            assert doc.get(field, "").strip(), f"{rule_id}.{field}"
+
+
+RULE_CATALOG_BEGIN = "<!-- rule-catalog:begin -->"
+RULE_CATALOG_END = "<!-- rule-catalog:end -->"
+
+
+def test_readme_rule_catalog_matches_generator():
+    """README's rule catalog is generated, not hand-maintained —
+    byte-identical to --rule-catalog output (same contract as the
+    metrics catalog; regenerate by pasting between the markers)."""
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--rule-catalog"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    assert RULE_CATALOG_BEGIN in readme and RULE_CATALOG_END in readme
+    section = readme.split(RULE_CATALOG_BEGIN, 1)[1].split(RULE_CATALOG_END, 1)[0]
+    assert section.strip() == proc.stdout.strip()
+
+
+def test_changed_mode_selects_intersecting_rules():
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--json",
+            "--changed", "kubernetes_tpu/queue.py",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert "wal" in doc["rules_run"]
+    assert "jax" not in doc["rules_run"]
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--json",
+            "--changed", "kubernetes_tpu/ops/helpers.py",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    doc = json.loads(proc.stdout)
+    assert "jax" in doc["rules_run"]
+    assert "wal" not in doc["rules_run"]
+
+
+def test_changed_mode_with_no_intersection_is_noop():
+    proc = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--json",
+            "--changed", "docs/nothing_here.py",
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is True and doc["rules_run"] == []
+
+
+# -- parse-tree cache -------------------------------------------------------
+
+
+def test_parse_cache_round_trip(tmp_path):
+    """Second run over the same sources is served from the cache; an
+    edited file misses (content-hash keying makes staleness impossible)."""
+    root = os.path.join(FIXTURES, "wal_ok")
+    tp = check_lint.load_tpulint()
+    cache = tp.ParseCache(str(tmp_path / "c"))
+    first = tp.run_lint(root, cache=cache)
+    assert first.findings == []
+    assert cache.misses > 0 and cache.hits == 0
+    cache2 = tp.ParseCache(str(tmp_path / "c"))
+    second = tp.run_lint(root, cache=cache2)
+    assert second.findings == []
+    assert cache2.hits > 0 and cache2.misses == 0
+
+
+def test_parse_cache_corrupt_entry_reparses(tmp_path):
+    root = os.path.join(FIXTURES, "wal_ok")
+    tp = check_lint.load_tpulint()
+    cache = tp.ParseCache(str(tmp_path / "c"))
+    tp.run_lint(root, cache=cache)
+    for name in os.listdir(str(tmp_path / "c")):
+        with open(os.path.join(str(tmp_path / "c"), name), "wb") as f:
+            f.write(b"garbage")
+    cache2 = tp.ParseCache(str(tmp_path / "c"))
+    result = tp.run_lint(root, cache=cache2)
+    assert result.findings == []  # corrupt entries fall back to parsing
