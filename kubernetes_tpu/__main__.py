@@ -883,6 +883,23 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_explain(args) -> int:
+    """Explain one pod's scheduling decision over the socket (the
+    `explain` frame): per-op per-node filter verdicts with the rejecting
+    plugin named, per-op score columns, the selectHost tie-break trace,
+    and the recorded live decision — same JSON the HTTP
+    ``GET /debug/explain?uid=`` surface serves."""
+    from .sidecar import SidecarClient
+
+    client = SidecarClient(args.socket, deadline_s=_cli_deadline(args))
+    try:
+        doc = client.explain(args.uid, seq=args.seq)
+    finally:
+        client.close()
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0 if "error" not in doc else 1
+
+
 def cmd_measured(args) -> int:
     """Derive a measured throughput-matrix artifact
     (framework/measured.py) from flight dumps — committed soak dumps,
@@ -1257,6 +1274,24 @@ def main(argv: list[str] | None = None) -> int:
         help="per-call deadline in seconds; <=0 waits forever",
     )
     tr.set_defaults(fn=cmd_trace)
+
+    ex = sub.add_parser(
+        "explain",
+        help="explain one pod's scheduling decision: per-op attribution "
+        "columns + the selectHost tie-break trace",
+    )
+    ex.add_argument("--socket", required=True)
+    ex.add_argument("uid", help="pod uid (namespace/name)")
+    ex.add_argument(
+        "--seq", type=int, default=0,
+        help="pin the journal reconstruction point to just before this "
+        "seq (0 = let the recorded capsule choose)",
+    )
+    ex.add_argument(
+        "--deadline", type=float, default=10.0,
+        help="per-call deadline in seconds; <=0 waits forever",
+    )
+    ex.set_defaults(fn=cmd_explain)
 
     ms = sub.add_parser(
         "measured",

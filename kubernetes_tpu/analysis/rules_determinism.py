@@ -86,6 +86,12 @@ class DeterminismRule(Rule):
             # unordered iteration in its ledger arithmetic diverges the
             # recovered admission order from the interrupted run's.
             "kubernetes_tpu/framework/fairness.py",
+            # ISSUE 20: decision provenance replays the device's own
+            # tie-break arithmetic (hash_u32, select_host_trace) and
+            # diffs records field by field — a wall clock, entropy
+            # source or unordered iteration here would make an explain
+            # disagree with the decision it explains.
+            "kubernetes_tpu/framework/provenance.py",
         ]
         # The recursive walk below picks up fleet/standby.py and
         # loadgen/checkpoint.py (ISSUE 18) — the warm-standby pool's

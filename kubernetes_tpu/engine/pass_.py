@@ -796,6 +796,96 @@ def build_eval_pass(
     return run
 
 
+def score_op_names(
+    profile: Profile, active: frozenset[str] | None
+) -> list[tuple[str, int]]:
+    """Score-op (name, weight) column order of build_attribution_pass's
+    score stack for one compiled pass — the scorer analog of
+    filter_op_names."""
+    return [
+        (n, w)
+        for n, w in profile.scorers
+        if (active is None or n in active) and opcommon.get(n).score is not None
+    ]
+
+
+def build_attribution_pass(
+    profile: Profile,
+    schema: Schema,
+    builder_res_col: dict[str, int],
+    active: frozenset[str] | None = None,
+):
+    """Attribution variant of build_eval_pass (decision provenance):
+    the SAME op calls in the SAME order with the SAME dtypes, but every
+    intermediate column is returned instead of folded away.
+
+    Returns run(state, pf, inv) →
+      (ok_cols  (F, N) bool — each filter op's independent verdict,
+                row order = filter_op_names(profile, active);
+       feasible (N,)  bool — the conjunction, as eval computes it;
+       score_cols (S, N) i64 — each scorer's NORMALIZED column over the
+                final feasible set (pre-weight), row order =
+                score_op_names(profile, active);
+       total    (N,)  i64 — the weighted sum, bit-identical to the
+                commit pass's TotalScore vector).
+
+    Debug/read path only — never dispatched from the hot loop, so the
+    extra outputs cost nothing when provenance is unarmed."""
+    filter_ops = [
+        opcommon.get(n) for n in profile.filters if active is None or n in active
+    ]
+    score_ops = [
+        (opcommon.get(n), w)
+        for n, w in profile.scorers
+        if active is None or n in active
+    ]
+    static: dict = {}
+    for op in {o.name: o for o in filter_ops + [o for o, _ in score_ops]}.values():
+        if op.static is not None:
+            static.update(op.static(profile, schema, builder_res_col))
+    ctx = opcommon.PassContext(profile=profile, schema=schema, static=static)
+
+    @jax.jit
+    def run(state: ClusterState, pf: dict, inv: dict):
+        dom = build_dom(state, inv["et_slot"], inv["et_host"], schema.DV)
+        dctx = dataclasses.replace(
+            ctx,
+            dom=dom,
+            nom=(
+                (inv["nom_req"], inv["nom_cnt"], inv["nom_prio"])
+                if "nom_req" in inv
+                else None
+            ),
+        )
+        feasible = state.valid
+        ok_cols = []
+        for op in filter_ops:
+            if op.filter is not None:
+                ok = op.filter(state, pf, dctx)
+                ok_cols.append(ok)
+                feasible &= ok
+        total = jnp.zeros(schema.N, jnp.int64)
+        score_cols = []
+        for op, weight in score_ops:
+            if op.score is not None:
+                col = op.score(state, pf, dctx, feasible)
+                score_cols.append(col)
+                total += col * jnp.int64(weight)
+        ok_stack = (
+            jnp.stack(ok_cols)
+            if ok_cols
+            else jnp.zeros((0, schema.N), jnp.bool_)
+        )
+        sc_stack = (
+            jnp.stack(score_cols)
+            if score_cols
+            else jnp.zeros((0, schema.N), jnp.int64)
+        )
+        return ok_stack, feasible, sc_stack, total
+
+    return run
+
+
 # Ops whose filter/score read ONLY node-axis state (no domain tables, no
 # cross-pod conflict classes) — the op subset the pinned fast path handles.
 PINNED_SAFE_OPS = frozenset({

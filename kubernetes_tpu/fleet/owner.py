@@ -298,6 +298,33 @@ class ShardOwner:
         )
         return res
 
+    def explain(
+        self, uid: str, pod_data: dict | None = None, seq: int | None = None
+    ) -> dict:
+        """Decision-provenance readout for this shard's partition
+        (scheduler.explain_pod): the local record when the pod lives
+        here (plus its serialized pod so the router can scatter), else
+        an attribution run of the supplied pod against this shard's
+        nodes — the router's merge path.  Read-only."""
+        out: dict = {"shard": self.shard_id}
+        pr = self.sched.cache.pods.get(uid)
+        qp = self.sched.queue._info.get(uid)
+        if pr is not None or qp is not None:
+            out["record"] = self.sched.explain_pod(uid, seq=seq or None)
+            out["pod"] = serialize.to_dict(pr.pod if pr is not None else qp.pod)
+            if pr is not None:
+                out["bound_node"] = pr.node_name
+        elif pod_data is not None:
+            pod = serialize.pod_from_data(pod_data)
+            # The binding shard serialized its committed copy: strip the
+            # binding so NodeName cannot pin the pod to a node this
+            # shard does not own.
+            pod.spec.node_name = ""
+            out["record"] = self.sched.explain_pod(uid, pod=pod)
+        else:
+            out["record"] = {"uid": uid, "error": "not on this shard"}
+        return out
+
     def commit(self, pod: t.Pod, node_name: str):
         t0 = time.perf_counter()
         out = self.sched.commit_proposed(pod, node_name)
@@ -704,6 +731,10 @@ def _dispatch_op(owner: ShardOwner, op: str, payload: dict) -> dict:
         }
     if op == "propose":
         return owner.propose(serialize.pod_from_data(payload["pod"]))
+    if op == "explain":
+        return owner.explain(
+            payload["uid"], payload.get("pod"), payload.get("seq")
+        )
     if op == "commit":
         o = owner.commit(
             serialize.pod_from_data(payload["pod"]), payload["node"]

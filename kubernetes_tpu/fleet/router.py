@@ -54,7 +54,7 @@ Gang admission spanning shards (two-phase reserve/commit)
 from __future__ import annotations
 
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -183,6 +183,10 @@ class FleetRouter:
         self._gang_committed: list[ScheduleOutcome] = []
         # The single scheduler's _cycle sequence (tie-break step counter).
         self._cycle = 0
+        # Decision provenance: the tie-break step each scheduled pod's
+        # _select drew — bounded, insert-ordered, consumed by explain()
+        # to reconstruct the router-side selectHost bit-for-bit.
+        self._decision_steps: "OrderedDict[str, int]" = OrderedDict()
         self.profile_filters: tuple[str, ...] = ()
         # -- observability (the scheduler_fleet_* families) ---------------
         if registry is None:
@@ -744,6 +748,13 @@ class FleetRouter:
         state the oracle's in-scan evaluation never saw."""
         pod = qp.pod  # attempts already bumped by pop_batch
         acc = self._batch_phases
+        if self.observability:
+            # Provenance: remember the tie-break step this decision drew
+            # so explain() can replay _select exactly (newest wins).
+            self._decision_steps.pop(pod.uid, None)
+            self._decision_steps[pod.uid] = step
+            while len(self._decision_steps) > 4096:
+                self._decision_steps.popitem(last=False)
         home = self.home_shard(pod)
         t0 = time.perf_counter()
         proposals = self._propose_all(pod, span)
@@ -1174,6 +1185,124 @@ class FleetRouter:
         # this before filing a parity bug.
         out["partition_inexact_ops"] = sorted(PARTITION_INEXACT_OPS)
         return out
+
+    def explain(self, uid: str, seq: int = 0) -> dict:
+        """Fleet-wide decision provenance: locate the pod's shard, pull
+        its local record (and serialized pod), scatter an explain of the
+        SAME pod to every other shard, and merge the partitions — global
+        per-node totals in row order (_node_pos, the single scheduler's
+        enumeration), the union of first-reject verdicts, and the
+        router-side selectHost reconstructed from the recorded tie-break
+        step.  Annotates the routing path: home shard, binding shard,
+        misroute, and which active score families are shard-approximate
+        (PARTITION_INEXACT_OPS)."""
+        shards = self.shard_ids()
+        base = pod_data = None
+        bound_shard = self._pod_shard.get(uid)
+        if bound_shard is not None and bound_shard in self.owners:
+            base = self._call(
+                bound_shard, "explain", {"uid": uid, "seq": seq}
+            )
+        else:
+            for s in shards:
+                r = self._call(s, "explain", {"uid": uid, "seq": seq})
+                if r.get("pod") is not None:
+                    bound_shard, base = s, r
+                    break
+        if base is None or base.get("pod") is None:
+            return {"uid": uid, "error": "unknown pod (no shard owns it)"}
+        pod_data = base["pod"]
+        pod = serialize.pod_from_data(pod_data)
+        per_shard: dict[int, dict] = {bound_shard: base["record"]}
+        for s in shards:
+            if s == bound_shard:
+                continue
+            per_shard[s] = self._call(
+                s, "explain", {"uid": uid, "pod": pod_data}
+            )["record"]
+        # Merge the partitions by node name into global row order.
+        total: dict[str, int] = {}
+        feasible: dict[str, int] = {}
+        first_reject: dict[str, str] = {}
+        shard_of: dict[str, int] = {}
+        for s in sorted(per_shard):
+            rec = per_shard[s]
+            if "error" in rec:
+                continue
+            for i, name in enumerate(rec["nodes"]):
+                total[name] = rec["total"][i]
+                feasible[name] = rec["feasible"][i]
+                shard_of[name] = s
+            first_reject.update(rec.get("first_reject", {}))
+        step = self._decision_steps.get(uid)
+        cands = sorted(
+            (pos, name)
+            for name, pos in self._node_pos.items()
+            if feasible.get(name)
+        )
+        select: dict = {
+            "tie_break_seed": self.tie_break_seed,
+            "step": step,
+            "tie_count": 0,
+            "pick": None,
+        }
+        pick = None
+        nn = pod.status.nominated_node_name
+        if nn and feasible.get(nn):
+            # The nominated fast path _select takes before ranking.
+            pick = nn
+            select["nominated_fast_path"] = True
+        elif cands:
+            best = max(total[n] for _, n in cands)
+            ties = [(p, n) for p, n in cands if total[n] == best]
+            tie_rand = None
+            if step is not None:
+                tie_rand = _hash_u32(
+                    (self.tie_break_seed * 0x9E3779B1 + step) & 0xFFFFFFFF
+                )
+            kth = (tie_rand or 0) % len(ties)
+            pick = ties[kth][1]
+            select.update(
+                best=best,
+                tie_count=len(ties),
+                tie_rand=tie_rand,
+                kth=kth,
+                tie_rows=[p for p, _ in ties[:64]],
+                nominated_fast_path=False,
+            )
+        select["pick"] = pick
+        home = self.home_shard(pod)
+        bound_node = base.get("bound_node")
+        active = base["record"].get("active") or []
+        doc = {
+            "uid": uid,
+            "mode": "fleet",
+            "home_shard": home,
+            "bound_shard": bound_shard,
+            "misrouted": bound_node is not None and bound_shard != home,
+            "partition_inexact_ops": sorted(
+                PARTITION_INEXACT_OPS & set(active)
+            ),
+            "shards": {str(s): per_shard[s] for s in sorted(per_shard)},
+            "nodes": [n for _, n in sorted(
+                (p, n) for n, p in self._node_pos.items()
+            )],
+            "total": {n: total[n] for n in sorted(total)},
+            "feasible": sorted(n for n in feasible if feasible[n]),
+            "first_reject": first_reject,
+            "picked_shard": shard_of.get(pick) if pick else None,
+            "select": select,
+            "picked_node": pick,
+            "bound_node": bound_node,
+        }
+        # Current-mode shard records re-rank against the LIVE stores, so
+        # a pick differing from the binding is expected once later pods
+        # shifted the landscape — the field name says exactly what the
+        # comparison means.
+        doc["would_pick_again"] = (
+            (pick == bound_node) if bound_node and step is not None else None
+        )
+        return doc
 
     def fleet_flight_snapshots(
         self, limit: int | None = None

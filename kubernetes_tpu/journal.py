@@ -419,11 +419,14 @@ class Journal:
         except (OSError, ValueError):
             return None
 
-    def replay(self) -> tuple[dict | None, list[dict], dict]:
+    def replay(self, count: bool = True) -> tuple[dict | None, list[dict], dict]:
         """(snapshot doc or None, post-snapshot records in order, stats).
         Records already covered by the snapshot barrier (seq <= the
         snapshot's) are skipped; records from a deposed epoch (below the
-        running maximum at their position) are dropped as fenced."""
+        running maximum at their position) are dropped as fenced.
+        ``count=False`` leaves the replayed/replay_fenced counters alone
+        — the read-only mode the provenance reconstruction uses against
+        a LIVE journal (an explain must not dent the recovery metrics)."""
         snap = self.load_snapshot()
         snap_seq = snap["seq"] if snap else 0
         max_e = snap["epoch"] if snap else 0
@@ -437,8 +440,9 @@ class Journal:
             if rec["q"] <= snap_seq:
                 continue
             records.append(rec)
-        self.replayed = len(records)
-        self.replay_fenced = fenced
+        if count:
+            self.replayed = len(records)
+            self.replay_fenced = fenced
         return snap, records, {
             "snapshot": snap is not None,
             "snapshot_seq": snap_seq,
@@ -599,10 +603,72 @@ def recover(sched, journal: Journal) -> dict:
     relists.  Returns replay stats.  Call BEFORE attach_journal — the
     replay drives the scheduler's own mutation surface, which must not
     re-journal."""
+    snap, records, stats = journal.replay()
+    _apply_replay(sched, journal, snap, records, stats)
+    # Flight-recorder timeline: recovery is a state transition an operator
+    # reconstructing an incident needs on the same axis as the batches —
+    # and the dump is the artifact the crash harness asserts each killed
+    # cell leaves behind.
+    flight = getattr(sched, "flight", None)
+    if flight is not None:
+        flight.record_marker(
+            "recovery",
+            journal_epoch=journal.epoch,
+            journal_seq=journal.seq,
+            **stats,
+        )
+        # Dump only when recovery found something — a snapshot, replayable
+        # records, or a torn tail the open-time repair truncated (a crash
+        # mid-first-append leaves ONLY torn bytes, and that cell still
+        # deserves its evidence).  A true cold start is not an incident,
+        # and every test server would otherwise shed a file per
+        # construction.
+        if (
+            stats.get("snapshot")
+            or stats.get("records")
+            or stats.get("torn_bytes")
+        ):
+            flight.dump("recovery")
+    return stats
+
+
+def reconstruct_at(sched, journal: Journal, upto_seq: int) -> dict:
+    """Read-only state reconstruction: rebuild a FRESH scheduler's state
+    AS OF journal seq ``upto_seq`` (snapshot + records with seq <=
+    upto_seq) — the decision-provenance time machine (explain a committed
+    binding against the store it was decided against).  Unlike recover(),
+    nothing is truncated and no journal counters move, so it is safe
+    against a LIVE journal; the target scheduler must be journal-less
+    (its replayed mutations must not re-journal).  Raises ValueError when
+    the snapshot barrier already covers seqs past ``upto_seq`` — the WAL
+    prefix needed to stop earlier is gone."""
+    if getattr(sched, "journal", None) is not None:
+        raise ValueError(
+            "reconstruct_at target must not have a journal attached"
+        )
+    snap, records, stats = journal.replay(count=False)
+    snap_seq = snap["seq"] if snap else 0
+    if snap_seq > upto_seq:
+        raise ValueError(
+            f"snapshot barrier at seq {snap_seq} already covers seq "
+            f"{upto_seq}; the pre-{upto_seq} WAL prefix was truncated"
+        )
+    records = [r for r in records if r["q"] <= upto_seq]
+    stats["records"] = len(records)
+    stats["upto_seq"] = upto_seq
+    _apply_replay(sched, None, snap, records, stats)
+    return stats
+
+
+def _apply_replay(sched, journal, snap, records, stats) -> None:
+    """Apply one (snapshot, records) replay onto a fresh scheduler — the
+    shared core of recover() and reconstruct_at().  Mutes the journal
+    (when given) around the replay: the replay drives the scheduler's
+    own mutation surface, which must not re-journal."""
     from .api import serialize
 
-    snap, records, stats = journal.replay()
-    journal.muted = True
+    if journal is not None:
+        journal.muted = True
     # Visible to replay-driven hooks (fleet/owner.py routes replay-
     # surfaced evictions to a recovery bucket only the adopting router's
     # explicit drain — which filters replay-stale entries — may take).
@@ -838,30 +904,6 @@ def recover(sched, journal: Journal) -> dict:
         stats["in_doubt_reservations"] = len(in_doubt)
         stats["handoffs"] = len(handoffs)
     finally:
-        journal.muted = False
+        if journal is not None:
+            journal.muted = False
         sched._in_recovery = False
-    # Flight-recorder timeline: recovery is a state transition an operator
-    # reconstructing an incident needs on the same axis as the batches —
-    # and the dump is the artifact the crash harness asserts each killed
-    # cell leaves behind.
-    flight = getattr(sched, "flight", None)
-    if flight is not None:
-        flight.record_marker(
-            "recovery",
-            journal_epoch=journal.epoch,
-            journal_seq=journal.seq,
-            **stats,
-        )
-        # Dump only when recovery found something — a snapshot, replayable
-        # records, or a torn tail the open-time repair truncated (a crash
-        # mid-first-append leaves ONLY torn bytes, and that cell still
-        # deserves its evidence).  A true cold start is not an incident,
-        # and every test server would otherwise shed a file per
-        # construction.
-        if (
-            stats.get("snapshot")
-            or stats.get("records")
-            or stats.get("torn_bytes")
-        ):
-            flight.dump("recovery")
-    return stats

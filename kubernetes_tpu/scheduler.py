@@ -384,6 +384,20 @@ class TPUScheduler:
                 "chunk_size=1 (sequential-equivalent scan)"
             )
         self._eval_passes: dict = {}  # extender path: per-profile eval pass
+        # Decision provenance (framework/provenance.py): OFF by default —
+        # a ProvenanceRing only once arm_provenance() is called, so the
+        # unarmed hot path pays a single `is not None` test per bind and
+        # stays byte-identical.  The attribution passes compile lazily on
+        # the first explain, never from the scheduling loop.
+        self.provenance = None
+        self._attr_passes: dict = {}
+        # Placed-but-not-yet-journaled tie-break steps (uid → device
+        # step), staged at phase-1 and drained into the bind WAL record
+        # so journal-mode explain reproduces selectHost exactly even
+        # when the ring was never armed.  Only populated while a journal
+        # or the ring is attached; entries for pods whose bind rolls
+        # back are overwritten at their next placement.
+        self._tie_pending: dict = {}
         # Periodic host↔device comparer (the cache debugger's SIGUSR2 check
         # run on a schedule): 0 = disabled.
         self.consistency_check_every = consistency_check_every
@@ -618,6 +632,15 @@ class TPUScheduler:
             "scheduler_quarantined_pods_total",
             "Pods isolated into the quarantine pool after engine faults.",
         )
+        # Rejection attribution (NodeToStatusMap analog): which plugin
+        # made a pod unschedulable.  Incremented once per rejecting
+        # plugin at the filter-reject diagnosis site, and as
+        # plugin="EngineFault" at quarantine parks — label cardinality
+        # is bounded by the profiles' filter-op registry.
+        self._unsched_reasons = reg.counter(
+            "scheduler_unschedulable_reasons_total",
+            "Unschedulable verdicts attributed to the rejecting plugin.",
+        )
         # Failure-response loop (controllers.py): lifecycle transitions
         # are counted at the write site; the per-state gauge, the GC
         # reasons and the eviction total are scraped below.
@@ -786,14 +809,20 @@ class TPUScheduler:
         if self.journal is not None:
             from .api import serialize
 
-            self.journal.append(
-                "bind",
-                {
-                    "uid": pod.uid,
-                    "node": node_name,
-                    "pod": serialize.to_dict(pod),
-                },
-            )
+            data = {
+                "uid": pod.uid,
+                "node": node_name,
+                "pod": serialize.to_dict(pod),
+            }
+            # Decision provenance rides the WAL: the device tie-break
+            # step makes a journal-mode explain's selectHost trace exact
+            # without the in-memory ring (replay ignores the field).
+            tie = self._tie_pending.pop(pod.uid, None)
+            if tie is not None and tie >= 0:
+                data["tie"] = tie
+            seq = self.journal.append("bind", data)
+            if self.provenance is not None and seq is not None:
+                self.provenance.note_seq(pod.uid, seq)
 
     def maybe_snapshot(self) -> bool:
         """Checkpoint when the cadence is due AND the log has grown since
@@ -2514,6 +2543,259 @@ class TPUScheduler:
             "dev_s": round(t_end - t_feat, 6),
         }
 
+    # -- decision provenance (framework/provenance.py) ---------------------
+
+    def arm_provenance(self, capacity: int = 4096) -> None:
+        """Start recording decision capsules (explain-this-binding).
+        Idempotent; OFF by default — unarmed runs pay one `is not None`
+        test per bind and stay byte-identical."""
+        if self.provenance is None:
+            from .framework.provenance import ProvenanceRing
+
+            self.provenance = ProvenanceRing(capacity)
+
+    def _tie_step_of(self, i, ctx, batch) -> int:
+        """The device tie-break step for batch slot ``i`` — cycle base
+        plus the slot's step offset, the exact value select_and_commit
+        hashed.  -1 on the pinned fast path (no per-step scan seed)."""
+        soff = batch.get("step_offset")
+        if soff is None:
+            return -1
+        return (
+            int(ctx.get("cycle0", 0)) + int(np.asarray(soff)[i])
+        ) & 0xFFFFFFFF
+
+    def _provenance_capture(
+        self, uid, node_name, row, i, ctx, batch, scores, feas, fails, profile
+    ) -> None:
+        """Record one live decision into the armed ring — called from the
+        commit path only when arm_provenance() ran."""
+        from .framework.provenance import DecisionCapsule
+
+        tie_step = self._tie_step_of(i, ctx, batch)
+        cap = DecisionCapsule(
+            uid=uid,
+            node=node_name,
+            row=int(row),
+            score=int(scores[i]),
+            feasn=int(feas[i]),
+            fail_mask=int(fails[i]),
+            tie_step=tie_step,
+            profile=profile.name,
+            nomrow=int(ctx["nomrow"][i]),
+            kind="pinned" if ctx.get("pinned") else "batch",
+        )
+        cap.preemption = self.provenance.take_pending_preemption(uid)
+        self.provenance.record(cap)
+
+    def _run_attribution_pass(self, pod: t.Pod, profile, nomrow: int):
+        """One-pod attribution pass (build_attribution_pass, cached like
+        _eval_passes): featurize, run, fetch.  Returns (active, ok_cols
+        (F,N), feasible (N,), score_cols (S,N), total (N,))."""
+        from .engine.pass_ import build_attribution_pass
+
+        batch, _deltas, active = build_pod_batch(
+            [pod], self.builder, profile, 1
+        )
+        inv = self._full_inv()
+        state = self.builder.state()
+        key = (
+            profile, self.builder.schema,
+            tuple(sorted(self.builder.res_col.items())), active,
+        )
+        run = self._attr_passes.get(key)
+        if run is None:
+            run = build_attribution_pass(
+                profile, self.builder.schema, self.builder.res_col, active
+            )
+            self._attr_passes[key] = run
+        pf = {k: np.asarray(v)[0] for k, v in batch.items() if k != "valid"}
+        pf["nominated_row"] = np.int32(nomrow)
+        ok_cols, feasible, score_cols, total = device_fetch(
+            run(state, pf, inv)
+        )
+        self._dispatch_counter.inc(kind="eval")
+        return active, ok_cols, feasible, score_cols, total
+
+    def _provenance_sibling(self) -> "TPUScheduler":
+        """A fresh, journal-less scheduler with this one's compiled-pass
+        configuration — the reconstruction target for journal-mode
+        explain.  The sibling never schedules; it only holds replayed
+        state for the attribution pass."""
+        return type(self)(
+            profile=self.profile,
+            batch_size=self.batch_size,
+            chunk_size=self.chunk_size,
+            profiles=[
+                p
+                for n, p in sorted(self.profiles.items())
+                if n != self.profile.name
+            ],
+            feature_gates=self.feature_gates,
+            enable_preemption=self.preemption is not None,
+        )
+
+    def explain_pod(
+        self,
+        uid: str,
+        seq: int | None = None,
+        mode: str | None = None,
+        pod: t.Pod | None = None,
+    ) -> dict:
+        """The structured decision record for one pod: re-run its
+        Filter+Score through the attribution pass against the CURRENT
+        store, or (``mode="journal"``, or automatically when the armed
+        ring recorded the bind's journal seq) against a journal-
+        reconstructed store as of just before its bind record — per-op
+        per-node filter verdicts with the rejecting plugin named, per-op
+        normalized score columns, the selectHost tie-break trace, and
+        the recorded live decision when provenance was armed.  Read
+        path only: nothing commits, no queue state moves."""
+        from .engine.pass_ import filter_op_names, score_op_names
+        from .framework import provenance as prov
+
+        cap = self.provenance.get(uid) if self.provenance is not None else None
+        # Local pod wins over a caller-supplied one (fleet scatter passes
+        # ``pod=`` so a shard that never saw the pod can still attribute
+        # it against its partition of nodes).
+        pr = self.cache.pods.get(uid)
+        if pr is not None:
+            pod = pr.pod
+        else:
+            qp = self.queue._info.get(uid)
+            if qp is not None:
+                pod = qp.pod
+        if pod is None:
+            return {"uid": uid, "error": "unknown pod (not bound, not queued)"}
+        upto = None
+        if seq is not None and seq > 0:
+            upto = seq - 1
+            # An explicit seq targets ONE decision; a ring capsule
+            # stamped with a different seq describes another (newer)
+            # bind of this uid and must not color this record.
+            if cap is not None and cap.seq is not None and cap.seq != seq:
+                cap = None
+        elif (
+            mode != "current"
+            and cap is not None
+            and cap.seq is not None
+            and self.journal is not None
+        ):
+            upto = cap.seq - 1
+        if mode == "journal" and upto is None:
+            return {
+                "uid": uid,
+                "error": (
+                    "journal mode needs a journaled, provenance-recorded "
+                    "bind (or an explicit seq)"
+                ),
+            }
+        target, used_mode, notes = self, "current", []
+        wal_tie: int | None = None
+        from .api import serialize
+
+        if upto is not None and self.journal is not None:
+            from . import journal as journal_mod
+
+            sib = self._provenance_sibling()
+            try:
+                journal_mod.reconstruct_at(sib, self.journal, upto)
+                target, used_mode = sib, "journal"
+                # The bind record (seq upto+1) serialized the pod BEFORE
+                # spec.node_name was stamped — that pre-bind pod is what
+                # the device actually featurized — and carries the tie-
+                # break step, so the selectHost trace is exact without
+                # an armed ring.
+                for rec_j in self.journal.replay(count=False)[1]:
+                    if (
+                        rec_j["q"] == upto + 1
+                        and rec_j["t"] == "bind"
+                        and rec_j["d"].get("uid") == uid
+                    ):
+                        pod = serialize.pod_from_data(rec_j["d"]["pod"])
+                        wal_tie = rec_j["d"].get("tie")
+                        break
+            except ValueError as exc:
+                # The snapshot barrier passed the bind seq: the WAL
+                # prefix is gone — degrade to the current store, loudly.
+                used_mode = "current"
+                notes.append(f"reconstruction unavailable: {exc}")
+        if used_mode == "current" and pr is not None:
+            # Already placed: re-filtering the live pod would pin
+            # NodeName to its bound node and double-count its own
+            # committed usage.  Strip the binding on a copy; the
+            # verdicts still include the pod's own resources.
+            pod = serialize.pod_from_data(serialize.to_dict(pod))
+            pod.spec.node_name = ""
+            notes.append(
+                "pod already placed: current-mode verdicts include its "
+                "own committed usage (use journal mode for bit-identity)"
+            )
+        profile = self._profile_for(pod) or self.profile
+        # A surviving capsule describes THIS decision (a mismatched-seq
+        # one was dropped above), so its recorded nomination row wins —
+        # the reconstructed store resolves nominations as of the replay
+        # point, not as the device saw them at decision time.
+        if used_mode == "journal" and cap is not None:
+            nomrow = cap.nomrow
+        else:
+            nomrow = target._resolve_nomrow(pod)
+        if not target.cache.nodes:
+            return {"uid": uid, "mode": used_mode, "error": "no nodes"}
+        active, ok_cols, feasible, score_cols, total = (
+            target._run_attribution_pass(pod, profile, nomrow)
+        )
+        # Trim the schema's padding rows: real nodes only, row order
+        # preserved (padding rows are never feasible, so the kth-tie
+        # cumsum over the filtered arrays is unchanged).
+        rows = [
+            r
+            for r in range(int(np.asarray(total).shape[0]))
+            if target.cache.node_name_at_row(r) is not None
+        ]
+        names = [target.cache.node_name_at_row(r) for r in rows]
+        idx = np.asarray(rows, np.int64)
+        pos_of = {r: p for p, r in enumerate(rows)}
+        ok_f = (
+            np.asarray(ok_cols)[:, idx]
+            if np.asarray(ok_cols).size
+            else np.zeros((0, len(rows)), bool)
+        )
+        sc_f = (
+            np.asarray(score_cols)[:, idx]
+            if np.asarray(score_cols).size
+            else np.zeros((0, len(rows)), np.int64)
+        )
+        rec = prov.assemble_record(
+            uid=uid,
+            mode=used_mode,
+            profile=profile,
+            active=active,
+            node_names=names,
+            filter_names=filter_op_names(profile, active),
+            score_ops=score_op_names(profile, active),
+            ok_cols=ok_f,
+            feasible=np.asarray(feasible)[idx],
+            score_cols=sc_f,
+            total=np.asarray(total)[idx],
+            nomrow=pos_of.get(int(nomrow), -1),
+            capsule=cap,
+            truncated=self._truncated,
+            tie_step=wal_tie,
+        )
+        rec["bound_node"] = pr.node_name if pr is not None else None
+        if self.provenance is None:
+            notes.append(
+                "provenance unarmed: no recorded live decision; "
+                "tie step recovered from the bind WAL record"
+                if wal_tie is not None
+                else "provenance unarmed: no recorded live decision; "
+                "tie-break trace degrades to kth=0"
+            )
+        if notes:
+            rec["note"] = "; ".join(notes)
+        return rec
+
     def reserve_proposed(self, pod: t.Pod, node_name: str, gang: str = "") -> bool:
         """Phase 1 of the fleet's two-phase commit: assume the pod onto
         the node and run the Reserve chain, journaling a ``gang_reserve``
@@ -2679,6 +2961,17 @@ class TPUScheduler:
             if pr is not None:
                 victims.append(pr.pod)
         debits: dict[str, int] = {}
+        if self.provenance is not None and victims:
+            # Rationale BEFORE the deletes: _preempt_key reads the PDB
+            # budgets the loop below debits.
+            self.provenance.note_preemption(
+                pod.uid,
+                {
+                    "node": node_name,
+                    "victims": [v.uid for v in victims],
+                    "key": self._preempt_key(victims),
+                },
+            )
         for vic in victims:
             self.delete_pod(vic.uid, notify=False)
             for name, n in self.debit_matching_pdbs(vic).items():
@@ -3440,6 +3733,7 @@ class TPUScheduler:
             )
         self.queue.quarantine(qp)
         self._quarantine_counter.inc()
+        self._unsched_reasons.inc(plugin="EngineFault")
         # Marker only: quarantine is always reached inside the batch-
         # recovery path, whose outermost exit writes the one dump for the
         # whole incident (quarantine markers included).
@@ -3687,6 +3981,15 @@ class TPUScheduler:
                     self.nominator.pop(qp.pod.uid, None)
                 qp.pod.status.nominated_node_name = ""
                 placed.append((i, qp, node_name))
+                if self.journal is not None or self.provenance is not None:
+                    self._tie_pending[qp.pod.uid] = self._tie_step_of(
+                        i, ctx, batch
+                    )
+                if self.provenance is not None:
+                    self._provenance_capture(
+                        qp.pod.uid, node_name, row, i, ctx, batch,
+                        scores, feas, fails, profile,
+                    )
             elif row == -3:
                 continue  # already requeued (schema grew mid-flight)
             else:
@@ -3958,6 +4261,8 @@ class TPUScheduler:
             diag = Diagnosis(unschedulable_plugins=plugins)
             outcome = ScheduleOutcome(qp.pod, None, 0, int(feas[i]), diagnosis=diag)
             m.unschedulable += 1
+            for name in sorted(plugins):
+                self._unsched_reasons.inc(plugin=name)
             # FailedScheduling with the diagnosis plugin set (the fitError
             # message shape: "0/N nodes are available: ...").
             self.recorder.event(
@@ -4023,6 +4328,17 @@ class TPUScheduler:
         )
         for (i, qp, outcome), res in zip(failed, results):
             if res is not None:
+                if self.provenance is not None:
+                    # pickOneNode rationale BEFORE the commit path's
+                    # victim deletes debit the PDB budgets the key reads.
+                    self.provenance.note_preemption(
+                        qp.pod.uid,
+                        {
+                            "node": res.node_name,
+                            "victims": [v.uid for v in res.victims],
+                            "key": self._preempt_key(res.victims),
+                        },
+                    )
                 if (
                     self.inline_preempt_commit
                     and self._can_commit_inline(qp)

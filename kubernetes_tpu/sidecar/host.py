@@ -625,6 +625,21 @@ class ResyncingClient:
         doc["host"] = self.flight_recorder.snapshot(limit or None)
         return doc
 
+    def explain(self, uid: str, seq: int = 0) -> dict:
+        """Decision-provenance readout through the host: the sidecar's
+        record when reachable, the warm-standby fallback engine's while
+        degraded (its ring only holds decisions IT made), else an
+        unreachable marker — never an exception for a read path."""
+        return self._call_or_degraded(
+            lambda: self._client.explain(uid, seq),
+            lambda: (
+                self._fallback.explain_pod(uid, seq=seq or None)
+                if self._fallback is not None
+                else {"uid": uid, "error": "sidecar unreachable (degraded)"}
+            ),
+            kind="explain",
+        )
+
     def fleet(self, op: str, payload: dict | None = None) -> dict:
         """One partitioned-fleet protocol op against a shard owner behind
         this client (fleet/owner.py).  Fleet ops have NO degraded

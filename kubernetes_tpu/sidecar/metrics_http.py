@@ -1,5 +1,5 @@
 """Plain-HTTP observability endpoint: /metrics, /healthz, /events,
-/debug/flight.
+/debug/flight, /debug/trace, /debug/explain.
 
 The reference scheduler serves /metrics and /healthz from its secure
 serving port (cmd/kube-scheduler/app/server.go:181–210 newHealthEndpoints
@@ -74,6 +74,19 @@ def _parse_limit(path: str) -> int:
     return 0
 
 
+def _parse_q(path: str, key: str) -> str:
+    """?key=value from a request path ("" when absent), %-decoded so a
+    "namespace/pod" uid survives the query string."""
+    from urllib.parse import unquote
+
+    if "?" not in path:
+        return ""
+    for part in path.split("?", 1)[1].split("&"):
+        if part.startswith(key + "="):
+            return unquote(part[len(key) + 1:])
+    return ""
+
+
 class ObservabilityHTTPServer:
     """Threaded HTTP listener over one scheduler's registry/events — or,
     with ``client=``, over a host's ResyncingClient (see module
@@ -136,6 +149,27 @@ class ObservabilityHTTPServer:
                     self._send(
                         200, "application/json", json.dumps(doc).encode()
                     )
+                elif path == "/debug/explain":
+                    # Decision provenance: one pod's structured decision
+                    # record (framework/provenance.py) — same JSON the
+                    # `explain` frame and CLI subcommand produce.
+                    uid = _parse_q(self.path, "uid")
+                    if not uid:
+                        self._send(
+                            400, "text/plain", b"missing ?uid=\n"
+                        )
+                        return
+                    seq = _parse_q(self.path, "seq")
+                    try:
+                        seq_n = int(seq) if seq else 0
+                    except ValueError:
+                        self._send(400, "text/plain", b"bad ?seq=\n")
+                        return
+                    doc = outer._explain(uid, seq_n)
+                    self._send(
+                        200, "application/json",
+                        json.dumps(doc, sort_keys=True).encode(),
+                    )
                 elif path == "/debug/trace":
                     # Perfetto/Chrome trace-event rendering of the same
                     # ring (framework/trace_export.py) — open the body
@@ -190,6 +224,12 @@ class ObservabilityHTTPServer:
         if self.client is not None:
             return self.client.flight(limit)
         return self.scheduler.flight.snapshot(limit or None)
+
+    def _explain(self, uid: str, seq: int = 0) -> dict:
+        if self.client is not None:
+            return self.client.explain(uid, seq)
+        with self.lock:
+            return self.scheduler.explain_pod(uid, seq=seq or None)
 
     def _trace(self, limit: int) -> str:
         from ..framework import trace_export

@@ -406,6 +406,19 @@ def _dispatch(
             sched.flight.snapshot(env.flight.limit or None)
         ).encode()
         return False
+    if kind == "explain":
+        # Decision provenance (framework/provenance.py): one pod's
+        # structured decision record — per-op attribution, the selectHost
+        # tie-break trace, and the journal-reconstructed bit-identity
+        # replay when available.  Read path only; sorted keys so two
+        # same-seed servers emit byte-identical documents.
+        import json as _json
+
+        doc = sched.explain_pod(
+            env.explain.uid, seq=env.explain.seq or None
+        )
+        out.response.explain_json = _json.dumps(doc, sort_keys=True).encode()
+        return False
     if kind == "fleet":
         # Partitioned-fleet protocol (fleet/owner.py fleet_dispatch): one
         # frame = one op against this process's shard owner.  Requires
@@ -738,6 +751,20 @@ class SidecarClient:
         if limit:
             env.flight.limit = limit
         return json.loads(self._call(env).response.flight_json)
+
+    def explain(self, uid: str, seq: int = 0) -> dict:
+        """One pod's decision-provenance record
+        (framework/provenance.py): per-op attribution columns, the
+        selectHost tie-break trace, and the recorded live decision.
+        ``seq`` pins the journal reconstruction point (0 = let the
+        recorded capsule choose)."""
+        import json
+
+        env = pb.Envelope()
+        env.explain.uid = uid
+        if seq:
+            env.explain.seq = seq
+        return json.loads(self._call(env).response.explain_json or b"{}")
 
     def fleet(self, op: str, payload: dict | None = None) -> dict:
         """One partitioned-fleet protocol op against a shard owner

@@ -12,6 +12,8 @@ evaluated over the committed BENCH_*/SOAK_*/OBS_TAX trajectory:
   overlap_coverage   the pipeline's overlap must stay engaged
   slo_p99            decision latency vs the recorded budget
   obs_tax            the observability A/B gate (<= 2%)
+  explain_tax        the armed explain readout's share of the ON leg
+                     (decision provenance, same 2% gate)
   fair_steady_p99    fairness isolation: the steady tenant's p99 under a
                      capped burst vs its recorded solo-baseline tolerance
   fair_starvation    starvation-SLO violations in the fairness soak (= 0)
@@ -109,6 +111,16 @@ GUARDS = (
         "hard": 0.02,
         "why": "the observability A/B gate: attribution + exporter "
         "surfaces must cost <= 2% throughput",
+    },
+    {
+        "name": "explain_tax",
+        "source": {"family": "OBS_TAX_r*.json", "path": ("explain_tax",)},
+        "op": "max",
+        "warn": 0.015,
+        "hard": 0.02,
+        "why": "decision provenance: a warm armed explain_pod readout "
+        "(the recurring cost; the one-time pass compile rides the "
+        "headline tax) must stay under the observability gate",
     },
     {
         "name": "fair_steady_p99",

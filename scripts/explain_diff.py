@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""First-divergence auditor for the bit-identity oracles.
+
+Every bit-identity harness in this repo (the kill matrix, fleet
+failover, packed-vs-sequential, pipeline-vs-serial) asserts that two
+runs produce the SAME binding sequence — and a failure used to surface
+as a bare final-map diff with zero localization.  This auditor walks two
+journaled runs' bind sequences to the FIRST divergent decision, rebuilds
+each side's store as of just before that bind (journal.reconstruct_at —
+the decision-provenance time machine), re-runs the pod's Filter+Score
+through the attribution pass on both sides, and diffs the two decision
+records down to the exact (op, node) cell and tie-break field
+(framework/provenance.diff_records).
+
+Usage:
+  python scripts/explain_diff.py A_STATE_DIR B_STATE_DIR \
+      [--session basic_session]
+
+where each STATE_DIR is a journal directory (journal.wal +
+snapshot.json) as written by scripts/run_fault_matrix.py children or the
+soak driver, and --session names the gen_golden_transcripts scheduler
+factory both runs used.  Exit 0 when the sequences agree, 1 with a
+localized JSON report when they diverge.
+
+Library surface (imported by run_fault_matrix.py and tests):
+  bind_sequence(dir)            -> (snapshot_bindings, [bind dicts])
+  first_divergence(a, b)        -> divergence dict | None
+  explain_divergence(a_dir, b_dir, factory) -> localized report dict
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _factory(session: str):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from gen_golden_transcripts import session_schedulers
+
+    return session_schedulers()[session]
+
+
+def bind_sequence(state_dir: str) -> tuple[int, dict, list[dict]]:
+    """(snapshot barrier seq, snapshot bindings, bind records in seq
+    order) from a journal directory.  The snapshot's bound set covers
+    any prefix the barrier absorbed; the records carry the replayable
+    decision sequence."""
+    from kubernetes_tpu.journal import Journal
+
+    journal = Journal(state_dir)
+    snap, records, _stats = journal.replay(count=False)
+    snap_binds = {}
+    if snap:
+        for entry in (snap.get("state") or {}).get("pods", ()):
+            snap_binds[entry["pod"]["metadata"]["uid"]] = entry["node"]
+    binds = [
+        {"seq": r["q"], "uid": r["d"]["uid"], "node": r["d"]["node"]}
+        for r in records
+        if r["t"] == "bind"
+    ]
+    return (snap["seq"] if snap else 0), snap_binds, binds
+
+
+def first_divergence(
+    a: tuple[int, dict, list[dict]], b: tuple[int, dict, list[dict]]
+) -> dict | None:
+    """The first decision where two runs disagree.  When both snapshot
+    barriers sit at the same seq, both WALs carry the same post-barrier
+    window and the bind LISTS compare positionally — the first divergent
+    decision, even when one side bound a pod the other skipped.  With
+    skewed barriers (the kill matrix: victim died early, baseline ran
+    on), align by journal seq — the global decision clock — and fall
+    back to comparing final binding maps for the prefix whose order one
+    side's snapshot absorbed.  None when everything comparable agrees."""
+    a_seq, a_snap, a_binds = a
+    b_seq, b_snap, b_binds = b
+    if a_seq == b_seq:
+        for ra, rb in zip(a_binds, b_binds):
+            if (ra["uid"], ra["node"]) != (rb["uid"], rb["node"]):
+                return {"seq": ra["seq"], "a": ra, "b": rb}
+        if len(a_binds) != len(b_binds):
+            i = min(len(a_binds), len(b_binds))
+            ra = a_binds[i] if i < len(a_binds) else None
+            rb = b_binds[i] if i < len(b_binds) else None
+            return {"seq": (ra or rb)["seq"], "a": ra, "b": rb}
+        return None
+    a_by = {r["seq"]: r for r in a_binds}
+    b_by = {r["seq"]: r for r in b_binds}
+    for s in sorted(set(a_by) & set(b_by)):
+        ra, rb = a_by[s], b_by[s]
+        if (ra["uid"], ra["node"]) != (rb["uid"], rb["node"]):
+            return {"seq": s, "a": ra, "b": rb}
+    full_a = dict(a_snap)
+    full_a.update({r["uid"]: r["node"] for r in a_binds})
+    full_b = dict(b_snap)
+    full_b.update({r["uid"]: r["node"] for r in b_binds})
+    for uid in sorted(set(full_a) | set(full_b)):
+        if full_a.get(uid) != full_b.get(uid):
+            ra = next((r for r in a_binds if r["uid"] == uid), None)
+            rb = next((r for r in b_binds if r["uid"] == uid), None)
+            return {
+                "uid": uid,
+                "a": ra
+                or ({"uid": uid, "node": full_a[uid]} if uid in full_a else None),
+                "b": rb
+                or ({"uid": uid, "node": full_b[uid]} if uid in full_b else None),
+                "order_lost": True,
+            }
+    return None
+
+
+def _explain_side(
+    state_dir: str, factory, uid: str, seq: int | None
+) -> dict:
+    """One side's decision record: fresh scheduler, full recovery (so
+    the pod is findable), then explain with the reconstruction point
+    pinned to just before ``seq``.  seq=None (the bind was absorbed
+    into the snapshot, its record gone) explains against the recovered
+    final store — weaker, but still names verdicts and score columns."""
+    from kubernetes_tpu import journal as journal_mod
+
+    sched = factory()
+    journal = journal_mod.Journal(state_dir)
+    journal_mod.recover(sched, journal)
+    sched.journal = journal  # read-only here: explain never appends
+    try:
+        return sched.explain_pod(uid, seq=seq)
+    finally:
+        sched.journal = None
+
+
+def explain_divergence(
+    a_dir: str, b_dir: str, factory, verbose: bool = False
+) -> dict:
+    """The localized report: walk both journals to the first divergent
+    bind, explain that decision on BOTH reconstructed stores, and diff
+    the records to the first divergent cell.  ``factory`` builds the
+    scheduler configuration both runs used (same profile / batch /
+    chunk — anything else is a harness bug, not a divergence)."""
+    from kubernetes_tpu.framework.provenance import diff_records
+
+    a_side = bind_sequence(a_dir)
+    b_side = bind_sequence(b_dir)
+    report: dict = {
+        "a_dir": a_dir,
+        "b_dir": b_dir,
+        "a_binds": len(a_side[2]),
+        "b_binds": len(b_side[2]),
+    }
+    div = first_divergence(a_side, b_side)
+    report["divergence"] = div
+    if div is None:
+        return report
+    # Explain each side's OWN decision at its own seq — when the two
+    # sides even bound different pods at the divergence index, both
+    # records (and their stores) are evidence.
+    for side, rec, sdir in (("a", div["a"], a_dir), ("b", div["b"], b_dir)):
+        if rec is None:
+            continue
+        try:
+            report[f"{side}_explain"] = _explain_side(
+                sdir, factory, rec["uid"], rec.get("seq")
+            )
+        except Exception as exc:  # an unexplainable side is still a report
+            report[f"{side}_explain"] = {
+                "uid": rec["uid"],
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+    ea, eb = report.get("a_explain"), report.get("b_explain")
+    if (
+        ea is not None
+        and eb is not None
+        and "error" not in ea
+        and "error" not in eb
+        and div["a"]["uid"] == div["b"]["uid"]
+    ):
+        report["first_divergent_cell"] = diff_records(ea, eb)
+    if verbose:
+        print(render(report))
+    return report
+
+
+def render(report: dict) -> str:
+    """The human-readable localization block the oracle harnesses print
+    under a FAIL line."""
+    div = report.get("divergence")
+    if div is None:
+        return "explain_diff: bind sequences agree"
+    where = (
+        f"seq {div['seq']}"
+        if "seq" in div
+        else f"pod {div['uid']} (decision order lost to the snapshot barrier)"
+    )
+    lines = [
+        f"explain_diff: FIRST DIVERGENCE at {where}: "
+        f"a={div.get('a') and (div['a']['uid'], div['a']['node'])} "
+        f"b={div.get('b') and (div['b']['uid'], div['b']['node'])}"
+    ]
+    cell = report.get("first_divergent_cell")
+    if cell is not None:
+        lines.append(f"  first divergent cell: {json.dumps(cell, sort_keys=True)}")
+    elif cell is None and "first_divergent_cell" in report:
+        lines.append(
+            "  records are identical — the divergence is in commit "
+            "interleaving (same decision, different order), not in any "
+            "per-op column"
+        )
+    for side in ("a", "b"):
+        ex = report.get(f"{side}_explain")
+        if ex is None:
+            continue
+        if "error" in ex:
+            lines.append(f"  {side}: explain failed: {ex['error']}")
+            continue
+        sel = ex.get("select", {})
+        lines.append(
+            f"  {side}: pod {ex['uid']} -> {ex.get('picked_node')} "
+            f"(mode={ex.get('mode')}, ties={sel.get('tie_count')}, "
+            f"kth={sel.get('kth')}, seed={sel.get('tie_break_seed')}, "
+            f"step={sel.get('tie_step')})"
+        )
+        fr = ex.get("first_reject") or {}
+        if fr:
+            lines.append(
+                "     first_reject: "
+                + ", ".join(f"{n}<-{p}" for n, p in sorted(fr.items()))
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    session = "basic_session"
+    args = []
+    it = iter(argv)
+    for a in it:
+        if a.startswith("--session="):
+            session = a.split("=", 1)[1]
+        elif a == "--session":
+            session = next(it, session)
+        else:
+            args.append(a)
+    if len(args) != 2:
+        print(__doc__)
+        return 2
+    report = explain_divergence(args[0], args[1], _factory(session))
+    print(render(report))
+    print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    return 0 if report["divergence"] is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -89,6 +89,15 @@ def evolve(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _add_field(env, "fleet", 13, F.TYPE_MESSAGE,
                type_name=f"{PKG}.FleetRequest", oneof=0)
     _add_field(resp, "fleet_json", 9, F.TYPE_BYTES)
+    # The decision-provenance delta (PR: explain-this-binding): one frame
+    # kind asking for a pod's structured decision record.
+    _add_empty_message(fdp, "ExplainRequest")
+    explain = _msg(fdp, "ExplainRequest")
+    _add_field(explain, "uid", 1, F.TYPE_STRING)
+    _add_field(explain, "seq", 2, F.TYPE_UINT64)
+    _add_field(env, "explain", 14, F.TYPE_MESSAGE,
+               type_name=f"{PKG}.ExplainRequest", oneof=0)
+    _add_field(resp, "explain_json", 10, F.TYPE_BYTES)
 
 
 TEMPLATE = '''# -*- coding: utf-8 -*-

@@ -34,6 +34,52 @@ from kubernetes_tpu.sidecar import SidecarClient, SidecarServer  # noqa: E402
 from test_parity import OracleScheduler, _nodes, _pod  # noqa: E402
 
 
+def _explain_first_mismatch(sched, mismatches: dict) -> dict | None:
+    """Decision-record localization for the first mismatched pod (lowest
+    uid): re-run its Filter+Score through the engine's attribution pass
+    and report each contested node's verdict, rejecting plugin, and
+    per-op score column — so an A/B FAIL names the (pod, op, node)
+    responsible instead of a bare uid→(got, want) pair.  Post-hoc by
+    construction (the store has moved past the decision); best-effort,
+    never raises."""
+    if not mismatches:
+        return None
+    uid = sorted(mismatches)[0]
+    got, want = mismatches[uid]
+    try:
+        rec = sched.explain_pod(uid)
+    except Exception as exc:  # localization must never mask the FAIL
+        return {"uid": uid, "error": f"{type(exc).__name__}: {exc}"}
+    if "error" in rec:
+        return {"uid": uid, "got": got, "want": want, "error": rec["error"]}
+    doc = {
+        "uid": uid,
+        "mode": rec.get("mode"),
+        "picked_node": rec.get("picked_node"),
+        "select": rec.get("select"),
+        "note": rec.get("note"),
+    }
+    nodes = rec.get("nodes") or []
+    for tag, node in (("got", got), ("want", want)):
+        if not node:
+            doc[tag] = None
+            continue
+        if node not in nodes:
+            doc[tag] = {"node": node, "error": "node not in store"}
+            continue
+        r = nodes.index(node)
+        doc[tag] = {
+            "node": node,
+            "feasible": rec["feasible"][r],
+            "first_reject": (rec.get("first_reject") or {}).get(node),
+            "total": rec["total"][r],
+            "score_cols": {
+                op: cols[r] for op, cols in rec["score_cols"].items()
+            },
+        }
+    return doc
+
+
 def main_default(n_nodes: int = 1000, n_pending: int = 1200) -> dict:
     """Default-profile A/B over the wire, preemption ON: engine (parity
     mode, behind the framed-socket sidecar) vs the full scalar oracle
@@ -136,6 +182,8 @@ def main_default(n_nodes: int = 1000, n_pending: int = 1200) -> dict:
         "nom_ok": got_nom == want_nom,
         "vic_ok": got_vic == want_vic,
     }
+    if mm_bind:
+        out["first_divergence"] = _explain_first_mismatch(sched, mm_bind)
     print(json.dumps(out))
     return out
 
@@ -145,12 +193,10 @@ def main(n_nodes: int = 304, n_pods: int = 200) -> dict:
     prof = replace(fit_only_profile(), percentage_of_nodes_to_score=None)
 
     path = tempfile.mktemp(suffix=".sock")
-    srv = SidecarServer(
-        path,
-        scheduler=TPUScheduler(
-            profile=prof, batch_size=32, chunk_size=1, enable_preemption=False
-        ),
+    sched = TPUScheduler(
+        profile=prof, batch_size=32, chunk_size=1, enable_preemption=False
     )
+    srv = SidecarServer(path, scheduler=sched)
     srv.serve_background()
     client = SidecarClient(path)
     try:
@@ -173,6 +219,8 @@ def main(n_nodes: int = 304, n_pods: int = 200) -> dict:
         "mismatches": len(mismatches),
         "sample": dict(list(mismatches.items())[:3]),
     }
+    if mismatches:
+        out["first_divergence"] = _explain_first_mismatch(sched, mismatches)
     print(json.dumps(out))
     return out
 

@@ -415,6 +415,30 @@ def _run_scenario_tail(sched) -> dict:
     }
 
 
+def _audit_divergence(baseline_dir: str, state_dir: str, factory) -> None:
+    """On a bit-identity FAIL, localize the first divergent decision —
+    walk both cells' journals to the first disagreeing bind, reconstruct
+    each side's store as of that decision, and print the (pod, op, node)
+    cell instead of leaving a bare final-map diff.  Best-effort: the
+    audit must never mask the FAIL it annotates."""
+    try:
+        import explain_diff
+
+        report = explain_diff.explain_divergence(
+            baseline_dir, state_dir, factory
+        )
+        for line in explain_diff.render(report).splitlines():
+            print(f"     {line}")
+    except Exception as exc:
+        print(f"     explain_diff audit unavailable: {type(exc).__name__}: {exc}")
+
+
+def _basic_session_factory():
+    from gen_golden_transcripts import session_schedulers
+
+    return session_schedulers()["basic_session"]()
+
+
 def kill_child(state_dir: str) -> None:
     """The victim: run the scenario with journaling armed (snapshot every
     batch, so every injection point gets live windows).  When
@@ -555,6 +579,9 @@ def run_kill_matrix(cases=KILL_CASES, verbose=True) -> list[str]:
                         if baseline.get(k) != (got or {}).get(k)
                     }
                     print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+                    _audit_divergence(
+                        base_dir, state_dir, _basic_session_factory
+                    )
             elif verbose:
                 print(
                     f"ok   {label}: recovered bit-identical bindings"
@@ -615,14 +642,15 @@ def pack_scenario_objects():
     return nodes, pods
 
 
-def _pack_scheduler(state_dir: str, chunk: int):
+def _pack_bare_scheduler(chunk: int):
+    """The pack-kill scenario's scheduler configuration alone (no lease,
+    no journal) — shared by the children and the explain_diff audit's
+    reconstruction factory, so the two can never drift apart."""
     from kubernetes_tpu.framework.config import Profile
-    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
-    from kubernetes_tpu.journal import Journal
     from kubernetes_tpu.ops.common import registered_subset
     from kubernetes_tpu.scheduler import TPUScheduler
 
-    sched = TPUScheduler(
+    return TPUScheduler(
         profile=registered_subset(
             Profile(
                 name="pack-kill",
@@ -634,6 +662,13 @@ def _pack_scheduler(state_dir: str, chunk: int):
         chunk_size=chunk,
         enable_preemption=False,
     )
+
+
+def _pack_scheduler(state_dir: str, chunk: int):
+    from kubernetes_tpu.framework.leaderelection import FileLease, read_epoch
+    from kubernetes_tpu.journal import Journal
+
+    sched = _pack_bare_scheduler(chunk)
     lease_path = os.path.join(state_dir, "lease")
     lease = FileLease(lease_path, identity=f"packkill-{os.getpid()}")
     lease.acquire(block=True)
@@ -763,6 +798,9 @@ def run_pack_kill_matrix(cases=PACK_KILL_CASES, verbose=True) -> list[str]:
                         if baseline.get(k) != (got or {}).get(k)
                     }
                     print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+                    _audit_divergence(
+                        base_dir, state_dir, lambda: _pack_bare_scheduler(4)
+                    )
             elif verbose:
                 print(
                     f"ok   {label}: recovery rebuilt DomTables, bindings "
@@ -1204,6 +1242,9 @@ def run_pipeline_kill_matrix(
                         if baseline.get(k) != (got or {}).get(k)
                     }
                     print(f"FAIL {label}: rc={rc} diff={diff}{_cell_dt(t0)}")
+                    _audit_divergence(
+                        base_dir, state_dir, lambda: _pack_bare_scheduler(4)
+                    )
             elif verbose:
                 print(
                     f"ok   {label}: group-commit window recovered, "

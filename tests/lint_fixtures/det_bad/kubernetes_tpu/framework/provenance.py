@@ -1,0 +1,50 @@
+"""Seeded determinism violations in a decision-provenance recorder
+(ISSUE 20): a wall-clock capsule stamp, a coin-flip tie-break in the
+selectHost reconstruction, a bare-set ring sweep and a salted-hash tie
+rand — the four ways an explain record silently disagrees with the
+decision it claims to explain (tests/test_static_analysis.py counts
+these)."""
+
+import random
+import time
+
+
+class BadProvenanceRing:
+    def __init__(self, capacity=4096):
+        self.capacity = capacity
+        self.capsules = {}
+
+    def record(self, uid, node, score):
+        # POSITIVE det-wallclock: the capsule is stamped with wall time —
+        # two explains of the same decision carry different stamps, and
+        # the record diff flags a divergence that never happened.
+        self.capsules[uid] = {
+            "node": node,
+            "score": score,
+            "at": time.time(),
+        }
+
+    def sweep(self, keep):
+        evicted = []
+        # POSITIVE det-set-iteration: a hash-ordered sweep evicts
+        # whichever capsules PYTHONHASHSEED dealt first — same-seed
+        # runs disagree on which decisions remain explainable.
+        for uid in set(self.capsules):
+            if uid not in keep:
+                evicted.append(uid)
+        return evicted
+
+    def reconstruct_pick(self, ties):
+        # POSITIVE det-random: a coin-flip kth can never replay the
+        # device's tie-break — explain picks a different node than the
+        # committed binding on every other run.
+        kth = 0
+        if len(ties) > 1 and random.random() < 0.5:
+            kth = 1
+        return ties[kth]
+
+    def tie_rand(self, uid, step):
+        # POSITIVE det-builtin-hash: the salted builtin hash() produces
+        # a different tie rand per process — the reconstructed argmax
+        # trace and the journaled decision stop agreeing.
+        return hash((uid, step)) & 0xFFFFFFFF

@@ -1,0 +1,36 @@
+"""The allowed idioms for a decision-provenance recorder: capsules
+stamped with the journal seq (a logical clock), sorted ring sweeps,
+the device's own seeded avalanche hash for tie rands — an explain
+record reproduces the committed decision bit for bit, every run."""
+
+import zlib
+
+
+class GoodProvenanceRing:
+    def __init__(self, capacity=4096):
+        self.capacity = capacity
+        self.capsules = {}
+
+    def record(self, uid, node, score, seq):
+        # NEGATIVE: the bind record's journal seq is the stamp — a
+        # logical clock both the live run and the replay share.
+        self.capsules[uid] = {"node": node, "score": score, "seq": seq}
+
+    def sweep(self, keep):
+        evicted = []
+        # NEGATIVE: sorted() over the ring is the fix — every process
+        # evicts the same capsules in the same order.
+        for uid in sorted(set(self.capsules)):
+            if uid not in keep:
+                evicted.append(uid)
+        return evicted
+
+    def reconstruct_pick(self, ties, tie_rand):
+        # NEGATIVE: kth comes from the device's own journaled tie rand —
+        # the reconstruction replays the committed pick exactly.
+        return ties[tie_rand % len(ties)]
+
+    def tie_rand(self, uid, step):
+        # NEGATIVE: crc32 is unsalted — every process derives the same
+        # tie rand from the same (uid, step).
+        return zlib.crc32(f"{uid}:{step}".encode("utf-8")) & 0xFFFFFFFF

@@ -1,0 +1,346 @@
+"""Decision provenance (framework/provenance.py) + the first-divergence
+auditor (scripts/explain_diff.py): explain-vs-actual bit-identity on the
+golden sessions, exact (pod, op, node) localization of a seeded
+same-seed divergence, fleet-vs-single explain agreement on the
+partition-exact profile, the three read surfaces (frame / HTTP / CLI)
+serving one JSON document, and the unarmed zero-cost contract.
+
+The oracle discipline: every bit-identity harness in this repo asserts
+two runs bind identically — this suite asserts the EXPLANATION of a
+binding is itself bit-identical to the decision it explains (selectHost
+trace, score vector, tie-break seed), and that when two runs do
+diverge, the auditor names the exact first divergent cell instead of a
+bare hash mismatch."""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import explain_diff  # noqa: E402
+import run_fault_matrix as rfm  # noqa: E402
+from gen_golden_transcripts import (  # noqa: E402
+    scenario_objects,
+    session_schedulers,
+    wait_for_backoffs,
+)
+
+from kubernetes_tpu.journal import Journal, scheduler_state  # noqa: E402
+from kubernetes_tpu.sidecar.server import (  # noqa: E402
+    SidecarClient,
+    SidecarServer,
+)
+
+PENDING_UIDS = ("default/easy", "default/picky", "default/vip")
+
+
+def _basic_factory():
+    return session_schedulers()["basic_session"]()
+
+
+def run_session(stem, state_dir=None, arm=True, mutate=None):
+    """Drive the golden scenario through one scheduler: optional journal
+    (pre-bind snapshot barrier, so reconstruct_at has the node topology),
+    optional armed provenance ring, optional fixture mutation (the
+    seeded-divergence knob)."""
+    sched = session_schedulers()[stem]()
+    nodes, bound, pending = scenario_objects()
+    if mutate is not None:
+        mutate(nodes, bound, pending)
+    for n in nodes:
+        sched.add_node(n)
+    for p in bound:
+        sched.add_pod(p)
+    if state_dir is not None:
+        j = Journal(state_dir, epoch=1)
+        # Topology barrier BEFORE the first bind: reconstruction needs
+        # the nodes from a snapshot, and snapshot cadence 0 means the
+        # barrier never advances past a bind seq we want to explain.
+        j.snapshot(scheduler_state(sched))
+        sched.attach_journal(j)
+    if arm:
+        sched.arm_provenance()
+    for p in pending:
+        sched.add_pod(p)
+    sched.schedule_all_pending(wait_backoff=True)
+    wait_for_backoffs(sched.queue)
+    sched.schedule_all_pending(wait_backoff=True)
+    return sched
+
+
+def bindings_of(sched) -> dict:
+    return {
+        uid: pr.node_name
+        for uid, pr in sorted(sched.cache.pods.items())
+        if pr.bound
+    }
+
+
+# ---------------------------------------------------------------------------
+# Explain-vs-actual: the record reproduces the live decision
+
+
+@pytest.mark.parametrize("stem", ["basic_session", "default_session"])
+def test_explain_is_bit_identical_to_live_decision(stem, tmp_path):
+    """Acceptance: explaining a committed binding in journal mode
+    reproduces the identical selectHost (seed, step, rand, kth, pick)
+    and total-score vector the live decision used — on both golden
+    session profiles, preemption included."""
+    sched = run_session(stem, state_dir=str(tmp_path))
+    binds = bindings_of(sched)
+    explained = 0
+    for uid in PENDING_UIDS:
+        if uid not in binds:
+            continue
+        cap = sched.provenance.get(uid)
+        assert cap is not None and cap.seq is not None, uid
+        rec = sched.explain_pod(uid)
+        assert rec.get("error") is None, rec
+        assert rec["mode"] == "journal", rec.get("note")
+        # The headline agreement bit: picked node AND its total match
+        # the recorded live decision.
+        assert rec["agrees"] is True, (uid, rec["select"], rec["decision"])
+        assert rec["picked_node"] == binds[uid] == cap.node
+        row = rec["nodes"].index(cap.node)
+        assert rec["total"][row] == cap.score
+        # The selectHost trace replays the device's own draw, not a
+        # degraded kth=0: same seed, same step, feasible count matches.
+        sel = rec["select"]
+        assert sel["tie_break_seed"] == sched.profile.tie_break_seed
+        assert sel["tie_step"] == cap.tie_step
+        assert sum(rec["feasible"]) == cap.feasn
+        # Pinning the seq explicitly targets the same decision.
+        pinned = sched.explain_pod(uid, seq=cap.seq)
+        assert json.dumps(pinned, sort_keys=True) == json.dumps(
+            rec, sort_keys=True
+        )
+        explained += 1
+    assert explained >= 2  # easy + vip always bind; picky profile-dependent
+
+
+def test_preemption_rationale_rides_the_record(tmp_path):
+    """vip preempts on the default profile: its record carries the
+    victims and the pickOneNode rationale the live decision used."""
+    sched = run_session("default_session", state_dir=str(tmp_path))
+    rec = sched.explain_pod("default/vip")
+    assert rec["agrees"] is True
+    decision = rec["decision"]
+    assert decision is not None
+    pre = decision.get("preemption")
+    assert pre, rec
+    assert pre.get("victims"), pre
+
+
+def test_unschedulable_pod_names_the_rejecting_plugin():
+    """The NodeToStatusMap analog: huge (99 cpu) is infeasible
+    everywhere, and every node's first_reject names NodeResourcesFit."""
+    sched = run_session("basic_session", arm=False)
+    rec = sched.explain_pod("default/huge")
+    assert rec.get("error") is None, rec
+    assert not any(rec["feasible"])
+    assert rec["picked_node"] is None
+    assert set(rec["first_reject"]) == set(rec["nodes"])
+    assert set(rec["first_reject"].values()) == {"NodeResourcesFit"}
+    # Unarmed: the note says the tie trace is degraded, loudly.
+    assert "unarmed" in rec.get("note", "")
+
+
+def test_unschedulable_reasons_counter_names_the_plugin():
+    """The metrics twin of first_reject: huge's rejections count into
+    scheduler_unschedulable_reasons_total{plugin="NodeResourcesFit"}."""
+    sched = run_session("basic_session", arm=False)
+    text = sched.metrics.registry.render_text()
+    assert "scheduler_unschedulable_reasons_total" in text
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("scheduler_unschedulable_reasons_total")
+        and "NodeResourcesFit" in ln
+    )
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+
+
+def test_unarmed_runs_stay_byte_identical_and_build_no_passes():
+    """The zero-cost contract: arming changes no binding, and the
+    attribution pass is compiled lazily by explain only — scheduling
+    never builds one, armed or not."""
+    a = run_session("basic_session", arm=False)
+    b = run_session("basic_session", arm=True)
+    assert bindings_of(a) == bindings_of(b)
+    assert a.provenance is None
+    assert a._attr_passes == {} and b._attr_passes == {}
+    assert len(b.provenance) >= 2
+
+
+# ---------------------------------------------------------------------------
+# The first-divergence auditor
+
+
+def _shrink_node1(nodes, bound, pending):
+    # node-1 loses the 1 cpu of headroom the bound-1 pod left: easy
+    # becomes infeasible THERE (and only there), so the tie set shrinks
+    # from 4 rows to 3 and the same tie rand picks a different node.
+    nodes[1].status.capacity["cpu"] = "3"
+    nodes[1].status.allocatable["cpu"] = "3"
+
+
+def _two_runs(tmp_path, mutate_b=None):
+    a_dir = os.path.join(str(tmp_path), "a")
+    b_dir = os.path.join(str(tmp_path), "b")
+    os.makedirs(a_dir)
+    os.makedirs(b_dir)
+    # Unarmed on purpose: journal-mode explain must be exact from the
+    # WAL alone (the bind record carries the tie-break step).
+    run_session("basic_session", state_dir=a_dir, arm=False)
+    run_session("basic_session", state_dir=b_dir, arm=False, mutate=mutate_b)
+    return a_dir, b_dir
+
+
+def test_auditor_localizes_seeded_divergence_to_exact_cell(tmp_path):
+    """Acceptance: a seeded same-seed divergence (one node's capacity
+    perturbed) is localized to the exact first (pod, op, node) — the
+    filter column that flipped — not a bare hash mismatch."""
+    a_dir, b_dir = _two_runs(tmp_path, mutate_b=_shrink_node1)
+    report = explain_diff.explain_divergence(a_dir, b_dir, _basic_factory)
+    div = report["divergence"]
+    assert div is not None
+    # Both sides disagree on the SAME pod's placement (first divergent
+    # decision), and both sides' explains are clean journal-mode.
+    assert div["a"]["uid"] == div["b"]["uid"]
+    assert div["a"]["node"] != div["b"]["node"]
+    for side in ("a_explain", "b_explain"):
+        assert report[side].get("error") is None
+        assert report[side]["mode"] == "journal"
+    # Each side's explain reproduces its own journaled bind.
+    assert report["a_explain"]["picked_node"] == div["a"]["node"]
+    assert report["b_explain"]["picked_node"] == div["b"]["node"]
+    cell = report["first_divergent_cell"]
+    assert cell is not None
+    assert cell["component"] == "filter"
+    assert cell["op"] == "NodeResourcesFit"
+    assert cell["node"] == "node-1"
+    # The human rendering names the pinpoint too.
+    text = explain_diff.render(report)
+    assert "FIRST DIVERGENCE" in text
+    assert "NodeResourcesFit" in text
+
+
+def test_auditor_reports_agreement_on_identical_runs(tmp_path):
+    a_dir, b_dir = _two_runs(tmp_path)
+    report = explain_diff.explain_divergence(a_dir, b_dir, _basic_factory)
+    assert report["divergence"] is None
+    assert "agree" in explain_diff.render(report)
+
+
+def test_fault_matrix_audit_hook_prints_the_pinpoint(tmp_path, capsys):
+    """The wiring satellite: run_fault_matrix's FAIL path hands the two
+    journals to the auditor and prints the localized report."""
+    a_dir, b_dir = _two_runs(tmp_path, mutate_b=_shrink_node1)
+    rfm._audit_divergence(a_dir, b_dir, _basic_factory)
+    out = capsys.readouterr().out
+    assert "FIRST DIVERGENCE" in out
+    assert "NodeResourcesFit" in out
+
+
+def test_explain_diff_cli_exit_codes(tmp_path, capsys):
+    a_dir, b_dir = _two_runs(tmp_path, mutate_b=_shrink_node1)
+    assert explain_diff.main([a_dir, b_dir]) == 1
+    assert "NodeResourcesFit" in capsys.readouterr().out
+    assert explain_diff.main([a_dir, a_dir]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet explain == single explain (partition-exact profile)
+
+
+def test_fleet_explain_matches_single_scheduler_explain():
+    """On the partition-exact fit-only profile the router's merged
+    record must agree with the single scheduler's: per-node totals,
+    feasible set, first-reject verdicts, and the reconstructed pick."""
+    from kubernetes_tpu.fleet import FleetRouter, ShardMap, ShardOwner
+
+    single = run_session("basic_session", arm=True)
+
+    smap = ShardMap(n_shards=2, n_buckets=16)
+    owners = {k: ShardOwner(k, _basic_factory(), smap) for k in range(2)}
+    router = FleetRouter(owners, smap, batch_size=8)
+    router.profile_filters = tuple(owners[0].sched.profile.filters)
+    nodes, bound, pending = scenario_objects()
+    for n in nodes:
+        router.add_object("Node", n)
+    for p in bound:
+        router.add_object("Pod", p)
+    for p in pending:
+        router.add_pod(p)
+    router.schedule_all_pending(wait_backoff=True)
+    wait_for_backoffs(router.queue)
+    router.schedule_all_pending(wait_backoff=True)
+    fleet_binds = router.bindings()
+    assert fleet_binds == bindings_of(single)
+
+    checked = 0
+    for uid in PENDING_UIDS:
+        if uid not in fleet_binds:
+            continue
+        fdoc = router.explain(uid)
+        srec = single.explain_pod(uid)
+        assert fdoc.get("error") is None, fdoc
+        assert srec.get("error") is None, srec
+        assert fdoc["mode"] == "fleet"
+        s_total = dict(zip(srec["nodes"], srec["total"]))
+        s_feas = sorted(
+            n for n, f in zip(srec["nodes"], srec["feasible"]) if f
+        )
+        assert fdoc["total"] == s_total, uid
+        assert fdoc["feasible"] == s_feas, uid
+        assert fdoc["first_reject"] == srec["first_reject"], uid
+        assert fdoc["picked_node"] == srec["picked_node"], (
+            uid, fdoc["select"], srec["select"],
+        )
+        assert fdoc["bound_node"] == fleet_binds[uid]
+        # Partition-exact: no score family is flagged shard-approximate.
+        assert fdoc["partition_inexact_ops"] == []
+        checked += 1
+    assert checked >= 2
+
+
+# ---------------------------------------------------------------------------
+# The three read surfaces serve one document
+
+
+def test_explain_frame_http_and_cli_agree(capsys):
+    from kubernetes_tpu.__main__ import main as cli_main
+
+    sched = _basic_factory()
+    sched.arm_provenance()
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SidecarServer(path, scheduler=sched, http_port=0)
+    srv.serve_background()
+    client = SidecarClient(path)
+    try:
+        nodes, bound, pending = scenario_objects()
+        for n in nodes:
+            client.add("Node", n)
+        for p in bound:
+            client.add("Pod", p)
+        client.schedule(pending, drain=True)
+        frame = client.explain("default/easy")
+        assert frame.get("error") is None, frame
+        assert frame["picked_node"] is not None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http.port}/debug/explain?uid=default/easy"
+        ) as r:
+            assert r.status == 200
+            http_doc = json.loads(r.read())
+        assert cli_main(["explain", "--socket", path, "default/easy"]) == 0
+        cli_doc = json.loads(capsys.readouterr().out)
+        want = json.dumps(frame, sort_keys=True)
+        assert json.dumps(http_doc, sort_keys=True) == want
+        assert json.dumps(cli_doc, sort_keys=True) == want
+    finally:
+        client.close()
+        srv.close()

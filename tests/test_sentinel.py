@@ -39,7 +39,8 @@ def test_committed_trajectory_passes_every_guard():
     assert block["missing"] == []
     assert {g["name"] for g in block["guards"]} == {
         "headline", "flagship", "journal_fsyncs", "overlap_coverage",
-        "slo_p99", "obs_tax", "fair_steady_p99", "fair_starvation",
+        "slo_p99", "obs_tax", "explain_tax", "fair_steady_p99",
+        "fair_starvation",
         "prod_service_p99", "prod_recovery_p99", "prod_promotion_max",
         "lint_findings", "lint_suppressions",
     }

@@ -190,16 +190,22 @@ def test_det_rules_fire_on_seeded_violations():
     # oldest-slot scan, a salted-hash claim bucket, a jittered
     # checkpoint cadence and an id()-keyed replay map — the warm-standby
     # selection and resume-oracle surfaces.
-    assert got.count("det-wallclock") == 11
-    assert got.count("det-random") == 7  # + gauss jitter in the weight loader
-    assert got.count("det-set-iteration") == 9  # for-loops + list(set(...))
+    # framework/provenance.py (ISSUE 20) seeds a wallclock capsule
+    # stamp, a coin-flip tie-break reconstruction, a bare-set ring
+    # sweep and a salted-hash tie rand — the explain-this-binding
+    # record surface, whose whole contract is bit-identity with the
+    # decision it explains.
+    assert got.count("det-wallclock") == 12
+    assert got.count("det-random") == 8  # + gauss jitter in the weight loader
+    assert got.count("det-set-iteration") == 10  # for-loops + list(set(...))
     assert got.count("det-id-key") == 2
     # PYTHONHASHSEED-salted Lease/shard routing (ISSUE 10) + chunk-slice
     # bucketing (ISSUE 13) + matrix-row routing (ISSUE 14) + commit-group
     # slotting (ISSUE 15) + tenant overflow bucketing (ISSUE 17):
     # builtin hash() assigns different owners / slices / rows / groups /
-    # buckets per process + standby claim bucketing (ISSUE 18).
-    assert got.count("det-builtin-hash") == 6
+    # buckets per process + standby claim bucketing (ISSUE 18) + tie-rand
+    # derivation in the provenance reconstruction (ISSUE 20).
+    assert got.count("det-builtin-hash") == 7
 
 
 def test_det_rules_cover_loadgen():
@@ -248,6 +254,14 @@ def test_det_rules_cover_standby_and_checkpoint():
     paths = {f.path for f in lint("det_bad").findings}
     assert "kubernetes_tpu/fleet/badstandby.py" in paths
     assert "kubernetes_tpu/loadgen/badcheckpoint.py" in paths
+
+
+def test_det_rules_cover_the_provenance_recorder():
+    # The decision-provenance recorder (ISSUE 20) replays the device's
+    # tie-break arithmetic and diffs records field by field — the
+    # explicit-rel list must reach framework/provenance.py.
+    paths = {f.path for f in lint("det_bad").findings}
+    assert "kubernetes_tpu/framework/provenance.py" in paths
 
 
 def test_det_negative_tree_is_clean():
@@ -315,6 +329,7 @@ def test_wire_kinds_parse_from_the_real_proto():
     assert declared_kinds(text) == [
         "add", "remove", "schedule", "response", "dump", "subscribe",
         "push", "health", "metrics", "events", "flight", "fleet",
+        "explain",
     ]
 
 
